@@ -1,0 +1,100 @@
+"""mxnet_tpu — a TPU-native deep learning framework with MXNet's capabilities.
+
+Ground-up JAX/XLA re-design of Apache MXNet (reference: Adnios/incubator-mxnet,
+see SURVEY.md): imperative NDArray/NumPy frontends with an eager autograd tape,
+Gluon Block/HybridBlock model authoring where hybridize() compiles traced
+subgraphs with jax.jit (the CachedOp analog), `mx.tpu()` device contexts over
+PJRT, optimizers as fused on-device update fns, and `kvstore='tpu_dist'`
+data-parallel training over ICI via XLA collectives.
+
+Usage mirrors the reference:
+
+    import mxnet_tpu as mx
+    from mxnet_tpu import autograd, gluon, np, npx
+
+    x = mx.np.ones((2, 3), device=mx.tpu(0))
+    net = mx.gluon.nn.Dense(4)
+    net.initialize()
+    with autograd.record():
+        y = net(x).sum()
+    y.backward()
+"""
+from __future__ import annotations
+
+__version__ = "0.1.0"
+
+from . import autograd, base, device, engine
+from . import _random
+from .base import MXNetError
+from .device import (
+    Context,
+    Device,
+    cpu,
+    cpu_pinned,
+    current_device,
+    gpu,
+    num_gpus,
+    num_tpus,
+    tpu,
+)
+from . import ndarray
+from . import ndarray as nd
+from . import numpy as np  # noqa: A004 - intentional: mx.np
+from . import numpy_extension as npx
+from .ndarray import NDArray
+
+# random: stateful global seed + legacy mx.random namespace
+from .numpy import random  # noqa: E402
+
+# subpackages loaded lazily-ish but imported eagerly for API parity
+from . import initializer  # noqa: E402
+from . import optimizer  # noqa: E402
+from . import lr_scheduler  # noqa: E402
+from . import kvstore as kv  # noqa: E402
+from . import kvstore  # noqa: E402
+from . import gluon  # noqa: E402
+from . import util  # noqa: E402
+from . import runtime  # noqa: E402
+from . import profiler  # noqa: E402
+
+waitall = engine.waitall
+
+
+def seed(s, ctx="all"):
+    """Seed all framework RNGs (reference: mx.random.seed)."""
+    _random.seed(s, ctx)
+
+
+__all__ = [
+    "NDArray",
+    "MXNetError",
+    "Context",
+    "Device",
+    "cpu",
+    "cpu_pinned",
+    "gpu",
+    "tpu",
+    "num_gpus",
+    "num_tpus",
+    "current_device",
+    "autograd",
+    "nd",
+    "np",
+    "npx",
+    "ndarray",
+    "gluon",
+    "initializer",
+    "optimizer",
+    "lr_scheduler",
+    "kvstore",
+    "kv",
+    "random",
+    "seed",
+    "waitall",
+    "engine",
+    "device",
+    "base",
+    "util",
+    "runtime",
+    "profiler",
+]

@@ -1,0 +1,69 @@
+"""Global stateful RNG over jax PRNG keys.
+
+The reference keeps per-device stateful RNG resources
+(include/mxnet/random_generator.h, ResourceRequest::kRandom). JAX RNG is
+functional (explicit keys), so this module provides the stateful facade:
+a process-global key advanced by splitting on every draw (`next_key`), seeded
+by `mx.random.seed(...)` — preserving the reference API while staying
+reproducible. During jit tracing (HybridBlock with dropout etc.), eager key
+draws are illegal; the trace context provides a traced key via
+`push_key_provider` (see gluon/block.py), the analog of the reference passing
+the RNG resource into the op (FResourceRequest).
+"""
+from __future__ import annotations
+
+import threading
+
+import jax
+
+
+class _RNG(threading.local):
+    def __init__(self):
+        self.key = None
+        self.providers = []  # stack of callables returning traced keys
+
+
+_rng = _RNG()
+_DEFAULT_SEED = 0
+
+
+def seed(seed_state=None, ctx="all"):  # noqa: ARG001 - ctx kept for API parity
+    """Seed the global RNG (reference: mx.random.seed)."""
+    if seed_state is None:
+        import os
+
+        seed_state = int.from_bytes(os.urandom(4), "little")
+    _rng.key = jax.random.PRNGKey(int(seed_state))
+
+
+def next_key():
+    """Return a fresh PRNG key, advancing global state (or the trace provider)."""
+    if _rng.providers:
+        return _rng.providers[-1]()
+    if _rng.key is None:
+        _rng.key = jax.random.PRNGKey(_DEFAULT_SEED)
+    _rng.key, sub = jax.random.split(_rng.key)
+    return sub
+
+
+def push_key_provider(provider):
+    _rng.providers.append(provider)
+
+
+def pop_key_provider():
+    _rng.providers.pop()
+
+
+class key_provider:
+    """Context manager installing a traced-key provider during jit tracing."""
+
+    def __init__(self, provider):
+        self._p = provider
+
+    def __enter__(self):
+        push_key_provider(self._p)
+        return self
+
+    def __exit__(self, *exc):
+        pop_key_provider()
+        return False

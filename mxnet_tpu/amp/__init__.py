@@ -1,0 +1,163 @@
+"""AMP — automatic mixed precision (reference: python/mxnet/amp/, 2321 LoC).
+
+TPU re-design: bf16 is the native mixed-precision dtype; unlike fp16-on-GPU,
+bf16's fp32-range exponent makes loss scaling unnecessary (the reference's
+dynamic LossScaler exists for fp16 and is kept as an API shim). The
+reference's cast-list machinery (amp/lists/symbol_fp16.py) maps to a simple
+policy: matmul/conv compute in bf16, reductions/norms accumulate in fp32 —
+which XLA does automatically once params/inputs are bf16 and normalization
+ops upcast internally (see ops/nn.py batch_norm/rms_norm).
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as _np
+
+from ..base import normalize_dtype
+from ..ndarray.ndarray import NDArray
+
+__all__ = ["init", "init_trainer", "scale_loss", "unscale",
+           "convert_hybrid_block", "convert_model", "LossScaler",
+           "list_lp16_ops", "list_fp32_ops"]
+
+_initialized = False
+_target_dtype = "bfloat16"
+
+# op classes that stay fp32 under AMP (the reference's FP32_FUNCS analog):
+# softmax/log/exp/norms accumulate in fp32 inside their implementations.
+_FP32_OPS = ["softmax", "log_softmax", "batch_norm", "layer_norm",
+             "group_norm", "instance_norm", "rms_norm", "norm", "mean",
+             "sum", "exp", "log"]
+_LP16_OPS = ["convolution", "deconvolution", "fully_connected", "matmul",
+             "dot", "einsum", "rnn"]
+
+
+def list_lp16_ops(target_dtype="bfloat16"):  # noqa: ARG001
+    return list(_LP16_OPS)
+
+
+def list_fp32_ops(target_dtype="bfloat16"):  # noqa: ARG001
+    return list(_FP32_OPS)
+
+
+def init(target_dtype="bfloat16", target_precision_ops=None,
+         conditional_fp32_ops=None, fp32_ops=None):  # noqa: ARG001
+    """Enable AMP (reference: amp.init). On TPU this sets the default policy
+    used by convert_hybrid_block / Trainer AMP hooks."""
+    global _initialized, _target_dtype
+    _target_dtype = "bfloat16" if target_dtype in ("float16", "fp16",
+                                                   "bfloat16", "bf16") \
+        else target_dtype
+    _initialized = True
+
+
+def init_trainer(trainer):
+    """Attach a loss scaler to the trainer (fp16 parity; no-op for bf16)."""
+    trainer._amp_loss_scaler = LossScaler()
+    return trainer
+
+
+def scale_loss(loss, trainer):
+    """Context manager scaling the loss (reference: amp.scale_loss).
+
+    bf16 needs no scaling; returned object supports `with` and yields the
+    (unscaled) loss for drop-in compatibility.
+    """
+    import contextlib
+
+    scaler = getattr(trainer, "_amp_loss_scaler", None)
+
+    @contextlib.contextmanager
+    def ctx():
+        if scaler is None or _target_dtype == "bfloat16":
+            yield loss
+        else:
+            scaled = loss * scaler.loss_scale
+            yield scaled
+
+    return ctx()
+
+
+def unscale(trainer):
+    """Unscale gradients after backward (fp16 path; bf16 no-op)."""
+    scaler = getattr(trainer, "_amp_loss_scaler", None)
+    if scaler is None or scaler.loss_scale == 1.0:
+        return
+    inv = 1.0 / scaler.loss_scale
+    for p in trainer._params:
+        if p.grad_req != "null":
+            for g in p.list_grad():
+                g._data = g._data * inv
+                g._version += 1
+
+
+def _cast_param(p, dtype, keep_fp32=False):
+    name = p.name.lower()
+    # norms' scale/shift and running stats stay fp32 (cast-list analog)
+    if keep_fp32 or any(k in name for k in ("gamma", "beta", "running",
+                                            "moving")):
+        return
+    p.cast(dtype)
+
+
+def convert_hybrid_block(net, target_dtype="bfloat16", target_dtype_ops=None,
+                         fp32_ops=None, conditional_fp32_ops=None,
+                         excluded_sym_names=None, device=None,
+                         cast_params_offline=True):  # noqa: ARG001
+    """Convert a HybridBlock to mixed precision (reference: amp.py:676
+    convert_hybrid_block): params cast to bf16 except norm/scale params;
+    the compiled program then runs matmuls/convs on the MXU in bf16.
+    """
+    dtype = normalize_dtype("bfloat16" if target_dtype in (
+        "float16", "fp16", "bfloat16", "bf16") else target_dtype)
+    for p in net.collect_params().values():
+        if p._data_map is not None or p.shape is not None:
+            _cast_param(p, dtype)
+    net._clear_cached()
+    # wrap forward so inputs are cast on entry
+    orig_forward = net.forward
+
+    def forward(*args):
+        cast_args = [
+            a.astype(dtype) if isinstance(a, NDArray)
+            and _np.issubdtype(a.dtype, _np.floating) else a
+            for a in args
+        ]
+        return orig_forward(*cast_args)
+
+    net.forward = forward
+    return net
+
+
+convert_model = convert_hybrid_block
+
+
+class LossScaler:
+    """Dynamic loss scaler (reference: amp/loss_scaler.py). Needed for fp16
+    only; bf16 training keeps scale 1."""
+
+    def __init__(self, init_scale=2 ** 16, scale_factor=2.0,
+                 scale_window=2000):
+        self.loss_scale = 1.0 if _target_dtype == "bfloat16" else init_scale
+        self._factor = scale_factor
+        self._window = scale_window
+        self._unskipped = 0
+
+    def has_overflow(self, params):
+        for p in params:
+            if p.grad_req == "null":
+                continue
+            g = p.grad()
+            if not bool(jnp.isfinite(g._data).all()):
+                return True
+        return False
+
+    def update_scale(self, overflow):
+        if overflow:
+            self.loss_scale = max(self.loss_scale / self._factor, 1.0)
+            self._unskipped = 0
+        else:
+            self._unskipped += 1
+            if self._unskipped >= self._window:
+                self.loss_scale *= self._factor
+                self._unskipped = 0

@@ -1,0 +1,386 @@
+"""Imperative autograd: record/pause scopes, tape, backward.
+
+Re-design of the reference's autograd (python/mxnet/autograd.py +
+src/imperative/imperative.cc RecordOp/Backward + src/nnvm/gradient.cc) for a
+functional backend. Instead of building an NNVM graph and running a symbolic
+MXGradient pass, we record an eager tape: every op executed under `record()`
+whose inputs require grad is run through `jax.vjp`, which both computes the
+forward value and returns a pullback closure holding the residuals on device.
+`backward()` is then a reverse-topological sweep calling the pullbacks — the
+tape *is* the backward graph, with residual storage playing the role of the
+reference's saved forward buffers.
+
+grad_req semantics ('write'/'add'/'null') follow the reference
+(python/mxnet/gluon/parameter.py, kAddTo in the C++ executor).
+"""
+from __future__ import annotations
+
+import threading
+from contextlib import contextmanager
+
+import jax
+import numpy as _np
+
+__all__ = [
+    "record",
+    "pause",
+    "train_mode",
+    "predict_mode",
+    "is_recording",
+    "is_training",
+    "set_recording",
+    "set_training",
+    "mark_variables",
+    "backward",
+    "grad",
+    "Function",
+]
+
+float0 = jax.dtypes.float0
+
+
+class _State(threading.local):
+    def __init__(self):
+        self.recording = False
+        self.training = False
+        self.suspended = 0  # >0 while tracing a CachedOp: per-op taping is off
+
+
+_state = _State()
+
+
+def is_recording():
+    """True iff inside a `record()` scope (reference: autograd.is_recording)."""
+    return _state.recording
+
+
+def is_training():
+    """True iff in train mode (reference: autograd.is_training)."""
+    return _state.training
+
+
+def set_recording(is_rec):
+    prev = _state.recording
+    _state.recording = bool(is_rec)
+    return prev
+
+
+def set_training(train):
+    prev = _state.training
+    _state.training = bool(train)
+    return prev
+
+
+@contextmanager
+def _scope(recording=None, training=None):
+    prev_r = _state.recording
+    prev_t = _state.training
+    if recording is not None:
+        _state.recording = recording
+    if training is not None:
+        _state.training = training
+    try:
+        yield
+    finally:
+        _state.recording = prev_r
+        _state.training = prev_t
+
+
+def record(train_mode=True):  # noqa: ARG001 - name parity with reference
+    """Scope in which executed ops are recorded for backward."""
+    return _scope(recording=True, training=train_mode)
+
+
+def pause(train_mode=False):
+    """Scope in which recording (and by default training mode) is off."""
+    return _scope(recording=False, training=train_mode)
+
+
+def train_mode():
+    """Scope forcing train-mode behavior (dropout active etc.)."""
+    return _scope(training=True)
+
+
+def predict_mode():
+    """Scope forcing inference-mode behavior."""
+    return _scope(training=False)
+
+
+@contextmanager
+def suspend_taping():
+    """Internal: disable per-op taping (used while tracing a CachedOp —
+    the traced subgraph becomes ONE tape node via jax.vjp on the jitted fn,
+    the analog of CachedOp::Backward on the full subgraph)."""
+    _state.suspended += 1
+    try:
+        yield
+    finally:
+        _state.suspended -= 1
+
+
+def taping_active():
+    return _state.recording and _state.suspended == 0
+
+
+# ---------------------------------------------------------------------------
+# Tape
+# ---------------------------------------------------------------------------
+
+class TapeNode:
+    """One recorded op: a pullback + references to its input arrays.
+
+    `inputs` are the NDArray objects passed to the op. For each we snapshot its
+    tape entry at record time (mutation may later redirect the array), the
+    analog of the reference capturing `autograd_entry_` per NDArray
+    (include/mxnet/imperative.h AGInfo).
+    """
+
+    __slots__ = (
+        "vjp_fn",
+        "inputs",
+        "input_entries",
+        "out_avals",
+        "multi_out",
+        "name",
+    )
+
+    def __init__(self, vjp_fn, inputs, input_entries, out_avals, multi_out, name):
+        self.vjp_fn = vjp_fn
+        self.inputs = inputs
+        self.input_entries = input_entries
+        self.out_avals = out_avals  # list of (shape, dtype)
+        self.multi_out = multi_out
+        self.name = name
+
+
+def _zero_cotangent(shape, dtype):
+    import jax.numpy as jnp
+
+    if _np.issubdtype(_np.dtype(dtype), _np.inexact):
+        return jnp.zeros(shape, dtype)
+    # integer/bool primal outputs take float0 cotangents
+    return _np.zeros(shape, dtype=float0)
+
+
+def mark_variables(variables, gradients, grad_reqs="write"):
+    """Attach gradient buffers to arrays (reference: MXAutogradMarkVariables).
+
+    After this, ops consuming `variables` under record() are taped and
+    `backward()` writes into `gradients` according to grad_req.
+    """
+    if isinstance(grad_reqs, str):
+        grad_reqs = [grad_reqs] * len(variables)
+    for var, gradbuf, req in zip(variables, gradients, grad_reqs):
+        var._grad = gradbuf
+        var._grad_req = req
+        var._tape_entry = None
+
+
+def _collect_graph(head_entries):
+    """Topological order of tape nodes reachable from the heads."""
+    order = []
+    seen = set()
+    stack = [e[0] for e in head_entries if e is not None]
+    # iterative DFS post-order
+    work = [(n, False) for n in stack]
+    while work:
+        node, processed = work.pop()
+        if processed:
+            order.append(node)
+            continue
+        if id(node) in seen:
+            continue
+        seen.add(id(node))
+        work.append((node, True))
+        for ent in node.input_entries:
+            if ent is not None and id(ent[0]) not in seen:
+                work.append((ent[0], False))
+    return order  # already topological (producers before consumers)
+
+
+def backward(heads, head_grads=None, retain_graph=False, train_mode=True):  # noqa: ARG001
+    """Run backward from `heads`, landing gradients in marked variables.
+
+    Matches reference semantics (src/imperative/imperative.cc:438 Backward):
+    default head gradient is ones; grad_req 'write' overwrites, 'add'
+    accumulates across backward calls.
+    """
+    import jax.numpy as jnp
+
+    from .ndarray.ndarray import NDArray
+
+    if isinstance(heads, NDArray):
+        heads = [heads]
+    if head_grads is None:
+        head_grads = [None] * len(heads)
+    elif isinstance(head_grads, NDArray):
+        head_grads = [head_grads]
+
+    # cotangent accumulators: id(node) -> list per output
+    cot = {}
+    node_by_id = {}
+    # gradients destined for marked variables: id(var) -> jax array
+    var_grads = {}
+    var_by_id = {}
+
+    def _acc_var(var, g):
+        if var._grad_req == "null" or var._grad is None:
+            return
+        key = id(var)
+        var_by_id[key] = var
+        if key in var_grads:
+            var_grads[key] = var_grads[key] + g
+        else:
+            var_grads[key] = g
+
+    head_entries = []
+    for h, hg in zip(heads, head_grads):
+        seed = hg._data if hg is not None else jnp.ones_like(h._data)
+        entry = h._tape_entry
+        head_entries.append(entry)
+        if entry is None:
+            if h._grad is not None:
+                _acc_var(h, seed)
+                continue
+            raise ValueError(
+                "one of the backward heads was not computed inside a "
+                "record() scope and has no attached grad"
+            )
+        node, idx = entry
+        node_by_id[id(node)] = node
+        slots = cot.setdefault(id(node), [None] * len(node.out_avals))
+        slots[idx] = seed if slots[idx] is None else slots[idx] + seed
+
+    order = _collect_graph(head_entries)
+
+    for node in reversed(order):
+        slots = cot.pop(id(node), None)
+        if slots is None:
+            continue  # no cotangent reached this node
+        full = []
+        for s, (shape, dtype) in zip(slots, node.out_avals):
+            full.append(s if s is not None else _zero_cotangent(shape, dtype))
+        out_ct = tuple(full) if node.multi_out else full[0]
+        if node.vjp_fn is None:
+            raise RuntimeError(
+                "tape already freed; call backward(retain_graph=True) to "
+                "backprop through the same graph twice"
+            )
+        in_cts = node.vjp_fn(out_ct)
+        for var, ent, g in zip(node.inputs, node.input_entries, in_cts):
+            if g is None or (hasattr(g, "dtype") and g.dtype == float0):
+                continue
+            if ent is not None:
+                pnode, pidx = ent
+                slots2 = cot.setdefault(id(pnode), [None] * len(pnode.out_avals))
+                slots2[pidx] = g if slots2[pidx] is None else slots2[pidx] + g
+            elif var is not None and var._grad is not None:
+                _acc_var(var, g)
+        if not retain_graph:
+            node.vjp_fn = None  # free residuals eagerly
+
+    # land gradients
+    for key, g in var_grads.items():
+        var = var_by_id[key]
+        gradbuf = var._grad
+        if var._grad_req == "add":
+            gradbuf._data = gradbuf._data + g.astype(gradbuf._data.dtype)
+        else:
+            gradbuf._data = g.astype(gradbuf._data.dtype)
+        gradbuf._version += 1
+
+
+def grad(heads, variables, head_grads=None, retain_graph=None, create_graph=False,
+         train_mode=True):  # noqa: ARG001
+    """Return gradients of heads w.r.t. variables instead of writing .grad.
+
+    Reference: python/mxnet/autograd.py:grad. create_graph (higher-order) is
+    not yet supported — documented limitation for this round.
+    """
+    from .ndarray.ndarray import NDArray
+
+    if create_graph:
+        raise NotImplementedError("create_graph=True (higher-order grad) TBD")
+    if isinstance(variables, NDArray):
+        variables = [variables]
+    saved = [(v._grad, v._grad_req) for v in variables]
+    zeros = []
+    for v in variables:
+        z = v.zeros_like() if hasattr(v, "zeros_like") else None
+        zeros.append(z)
+        v._grad = z
+        v._grad_req = "write"
+    try:
+        backward(heads, head_grads, retain_graph=bool(retain_graph), train_mode=train_mode)
+        out = [v._grad for v in variables]
+    finally:
+        for v, (g, r) in zip(variables, saved):
+            v._grad, v._grad_req = g, r
+    return out
+
+
+class Function:
+    """Custom differentiable function (reference: autograd.Function,
+    python/mxnet/autograd.py:369).
+
+    Subclass and implement `forward(self, *inputs)` and
+    `backward(self, *output_grads)` in terms of NDArrays. Tensors needed by
+    backward can be stashed with `save_for_backward`.
+    """
+
+    def __init__(self):
+        self._saved = ()
+
+    def save_for_backward(self, *arrays):
+        self._saved = arrays
+
+    @property
+    def saved_tensors(self):
+        return self._saved
+
+    def forward(self, *inputs):
+        raise NotImplementedError
+
+    def backward(self, *output_grads):
+        raise NotImplementedError
+
+    def __call__(self, *inputs):
+        from .ndarray.ndarray import NDArray, _wrap_out
+
+        with pause():
+            outputs = self.forward(*inputs)
+        multi = isinstance(outputs, (tuple, list))
+        outs = list(outputs) if multi else [outputs]
+
+        if taping_active() and any(
+            isinstance(i, NDArray) and i._requires_grad_entry for i in inputs
+        ):
+            nd_inputs = [i for i in inputs if isinstance(i, NDArray)]
+
+            func = self
+
+            def vjp_fn(out_ct):
+                cts = out_ct if multi else (out_ct,)
+                with pause():
+                    grads = func.backward(*[_wrap_out(c) for c in cts])
+                if not isinstance(grads, (tuple, list)):
+                    grads = (grads,)
+                it = iter(grads)
+                result = []
+                for i in inputs:
+                    g = next(it)
+                    if isinstance(i, NDArray):
+                        result.append(None if g is None else g._data)
+                return tuple(result)
+
+            node = TapeNode(
+                vjp_fn,
+                nd_inputs,
+                [i._tape_entry for i in nd_inputs],
+                [(o.shape, o.dtype) for o in outs],
+                multi_out=multi,
+                name=type(self).__name__,
+            )
+            for idx, o in enumerate(outs):
+                o._tape_entry = (node, idx)
+        return outputs

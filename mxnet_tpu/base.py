@@ -1,0 +1,116 @@
+"""Base utilities: dtype normalization, registries, errors.
+
+TPU-native re-design of the reference's `python/mxnet/base.py` +
+`include/mxnet/base.h` roles (dtype/ctx plumbing, registry helpers). No C ABI is
+needed here: the "FFI" of this framework is the JAX/XLA python binding itself.
+"""
+from __future__ import annotations
+
+import numpy as _np
+
+__all__ = [
+    "MXNetError",
+    "DeferredInitializationError",
+    "normalize_dtype",
+    "dtype_name",
+    "registry",
+    "string_types",
+    "numeric_types",
+    "integer_types",
+]
+
+
+class MXNetError(RuntimeError):
+    """Framework-level error (parity with the reference's MXNetError)."""
+
+
+class DeferredInitializationError(MXNetError):
+    """Raised when a deferred-init Parameter's data is accessed before shape is known.
+
+    Reference: python/mxnet/gluon/parameter.py (DeferredInitializationError).
+    """
+
+
+string_types = (str,)
+numeric_types = (float, int, _np.generic)
+integer_types = (int, _np.integer)
+
+# Canonical dtype names supported on TPU. fp64 is emulated/slow on TPU but kept
+# for CPU-mesh testing parity.
+_DTYPE_ALIASES = {
+    "float": "float32",
+    "double": "float64",
+    "half": "float16",
+    "bf16": "bfloat16",
+    "int": "int32",
+    "long": "int64",
+    "bool": "bool_",
+    "boolean": "bool_",
+}
+
+
+def normalize_dtype(dtype):
+    """Return a numpy-compatible dtype object (ml_dtypes covers bfloat16).
+
+    Accepts strings, numpy dtypes, python types, jax dtypes; None passes through.
+    """
+    if dtype is None:
+        return None
+    if isinstance(dtype, str):
+        dtype = _DTYPE_ALIASES.get(dtype, dtype)
+        if dtype == "bfloat16":
+            import ml_dtypes
+
+            return _np.dtype(ml_dtypes.bfloat16)
+        if dtype == "bool_":
+            return _np.dtype(_np.bool_)
+        return _np.dtype(dtype)
+    if dtype is bool:
+        return _np.dtype(_np.bool_)
+    return _np.dtype(dtype)
+
+
+def dtype_name(dtype):
+    """Canonical string name of a dtype."""
+    d = normalize_dtype(dtype)
+    return d.name if d is not None else None
+
+
+class _Registry:
+    """Name -> object registry with alias support.
+
+    Mirrors the reference's `mxnet.registry` (python/mxnet/registry.py) which in
+    turn mirrors dmlc registry behavior: case-insensitive lookup, re-register
+    warns and overrides.
+    """
+
+    def __init__(self, kind):
+        self._kind = kind
+        self._reg = {}
+
+    def register(self, obj, name=None):
+        key = (name or getattr(obj, "__name__", None) or str(obj)).lower()
+        self._reg[key] = obj
+        return obj
+
+    def get(self, name):
+        key = name.lower()
+        if key not in self._reg:
+            raise KeyError(
+                f"{self._kind} '{name}' is not registered. "
+                f"Known: {sorted(self._reg)}"
+            )
+        return self._reg[key]
+
+    def find(self, name):
+        return self._reg.get(name.lower())
+
+    def create(self, name, *args, **kwargs):
+        return self.get(name)(*args, **kwargs)
+
+    def list(self):
+        return sorted(self._reg)
+
+
+def registry(kind):
+    return _Registry(kind)

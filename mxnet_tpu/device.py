@@ -1,0 +1,186 @@
+"""Device / Context abstraction.
+
+Re-design of the reference's `python/mxnet/device.py` (Context/Device) for TPU:
+`mx.tpu(i)` resolves to a PJRT TPU device; `mx.cpu()` to host. The reference's
+`mx.gpu(i)` is kept as an alias for "the i-th accelerator" so models written
+against the MXNet API keep running.
+
+Device placement semantics: creation ops honor the *current device* (a
+thread-local stack, entered with `with mx.Device('tpu', 0):` exactly like the
+reference's `with mx.Context(...)`). Compute follows its inputs (XLA runs the op
+where the operands live), matching the reference's "ops run on the context of
+their inputs" rule (src/imperative/imperative_utils.h GetContext).
+"""
+from __future__ import annotations
+
+import threading
+import warnings
+
+import jax
+
+__all__ = ["Device", "Context", "cpu", "gpu", "tpu", "cpu_pinned", "num_gpus",
+           "num_tpus", "current_device", "default_device"]
+
+_DEVTYPE_ALIASES = {
+    "cpu_pinned": "cpu",
+    "cpu_shared": "cpu",
+}
+
+# Accelerator device types: resolve to the default-backend accelerator. 'gpu' is
+# accepted for reference-API compatibility and resolves to the accelerator
+# backend actually present (tpu here).
+_ACCEL_TYPES = ("tpu", "gpu", "cuda")
+
+
+class Device:
+    """A device descriptor, hashable and comparable.
+
+    Also usable as a context manager to set the default creation device,
+    mirroring `with mx.Context(...)` in the reference
+    (python/mxnet/device.py:Device.__enter__).
+    """
+
+    _tls = threading.local()
+    _warned_fallback = set()
+
+    def __init__(self, device_type, device_id=0):
+        if isinstance(device_type, Device):
+            device_id = device_type.device_id
+            device_type = device_type.device_type
+        device_type = _DEVTYPE_ALIASES.get(device_type, device_type)
+        self.device_type = device_type
+        self.device_id = int(device_id)
+
+    # -- identity ---------------------------------------------------------
+    def __eq__(self, other):
+        return (
+            isinstance(other, Device)
+            and self.device_type == other.device_type
+            and self.device_id == other.device_id
+        )
+
+    def __hash__(self):
+        return hash((self.device_type, self.device_id))
+
+    def __repr__(self):
+        return f"{self.device_type}({self.device_id})"
+
+    __str__ = __repr__
+
+    # -- resolution to a PJRT device -------------------------------------
+    @property
+    def jax_device(self):
+        """Resolve to a concrete jax (PJRT) device.
+
+        If the requested platform is absent (e.g. `tpu(0)` in a CPU-mesh test
+        run), fall back to the default backend's devices so code written for
+        TPU runs anywhere; warn once per platform.
+        """
+        dt = self.device_type
+        if dt in _ACCEL_TYPES:
+            try:
+                devs = jax.devices(dt if dt == "tpu" else "tpu")
+            except RuntimeError:
+                devs = None
+            if not devs:
+                try:
+                    devs = jax.devices("gpu")
+                except RuntimeError:
+                    devs = None
+            if not devs:
+                if dt not in Device._warned_fallback:
+                    Device._warned_fallback.add(dt)
+                    warnings.warn(
+                        f"device type '{dt}' not available; falling back to "
+                        f"default backend '{jax.default_backend()}'",
+                        stacklevel=2,
+                    )
+                devs = jax.devices()
+        else:
+            devs = jax.devices(dt)
+        return devs[self.device_id % len(devs)]
+
+    # -- default-device stack --------------------------------------------
+    def __enter__(self):
+        stack = getattr(Device._tls, "stack", None)
+        if stack is None:
+            stack = Device._tls.stack = []
+        stack.append(self)
+        return self
+
+    def __exit__(self, *exc):
+        Device._tls.stack.pop()
+        return False
+
+
+# The reference calls this class Context in 1.x and Device in 2.x; keep both.
+Context = Device
+
+
+def cpu(device_id=0):
+    """Return a CPU device."""
+    return Device("cpu", device_id)
+
+
+def cpu_pinned(device_id=0):
+    """Pinned host memory context (parity alias; host memory on TPU hosts)."""
+    return Device("cpu", device_id)
+
+
+def tpu(device_id=0):
+    """Return the i-th TPU device — the native accelerator context."""
+    return Device("tpu", device_id)
+
+
+def gpu(device_id=0):
+    """Reference-compat alias: the i-th accelerator (TPU here)."""
+    return Device("gpu", device_id)
+
+
+def _accel_count():
+    try:
+        return len(jax.devices("tpu"))
+    except RuntimeError:
+        pass
+    try:
+        return len(jax.devices("gpu"))
+    except RuntimeError:
+        return 0
+
+
+def num_gpus():
+    """Number of accelerator devices (reference: mx.device.num_gpus)."""
+    return _accel_count()
+
+
+def num_tpus():
+    """Number of TPU devices visible to this process."""
+    return _accel_count()
+
+
+def current_device():
+    """The device new arrays are created on (innermost `with device:` scope)."""
+    stack = getattr(Device._tls, "stack", None)
+    if stack:
+        return stack[-1]
+    return default_device()
+
+
+_default = None
+
+
+def default_device():
+    """Process default: the first accelerator if present, else cpu."""
+    global _default
+    if _default is None:
+        backend = jax.default_backend()
+        _default = Device("tpu" if backend in ("tpu", "gpu") else "cpu", 0)
+    return _default
+
+
+def from_jax_device(d):
+    """Map a concrete jax device back to a Device descriptor."""
+    plat = d.platform
+    if plat in ("tpu", "gpu"):
+        return Device("tpu", d.id)
+    return Device("cpu", d.id)

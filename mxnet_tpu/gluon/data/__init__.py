@@ -1,0 +1,15 @@
+"""Data pipeline (reference: python/mxnet/gluon/data/)."""
+from . import vision  # noqa: F401
+from .dataloader import DataLoader  # noqa: F401
+from .dataset import (  # noqa: F401
+    ArrayDataset,
+    Dataset,
+    RecordFileDataset,
+    SimpleDataset,
+)
+from .sampler import (  # noqa: F401
+    BatchSampler,
+    RandomSampler,
+    Sampler,
+    SequentialSampler,
+)

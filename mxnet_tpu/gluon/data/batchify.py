@@ -1,0 +1,69 @@
+"""Batchify functions (reference: python/mxnet/gluon/data/batchify.py)."""
+from __future__ import annotations
+
+import numpy as _np
+
+from ...ndarray.ndarray import NDArray
+
+__all__ = ["Stack", "Pad", "Group", "default_batchify_fn"]
+
+
+def _stack_arrs(arrs):
+    from ... import numpy as mnp
+
+    if isinstance(arrs[0], NDArray):
+        return mnp.stack(arrs)
+    out = _np.stack([_np.asarray(a) for a in arrs])
+    return mnp.array(out)
+
+
+def default_batchify_fn(data):
+    """Stack samples; tuples are batchified per-field (reference:
+    dataloader.py default_batchify_fn)."""
+    if isinstance(data[0], (tuple, list)):
+        return tuple(default_batchify_fn([d[i] for d in data])
+                     for i in range(len(data[0])))
+    return _stack_arrs(data)
+
+
+class Stack:
+    def __call__(self, data):
+        return _stack_arrs(data)
+
+
+class Pad:
+    """Pad variable-length samples to the batch max (reference: Pad)."""
+
+    def __init__(self, axis=0, val=0, dtype=None):
+        self._axis = axis
+        self._val = val
+        self._dtype = dtype
+
+    def __call__(self, data):
+        from ... import numpy as mnp
+
+        arrs = [_np.asarray(d) for d in data]
+        max_len = max(a.shape[self._axis] for a in arrs)
+        padded = []
+        for a in arrs:
+            pad_width = [(0, 0)] * a.ndim
+            pad_width[self._axis] = (0, max_len - a.shape[self._axis])
+            padded.append(_np.pad(a, pad_width, constant_values=self._val))
+        out = _np.stack(padded)
+        if self._dtype:
+            out = out.astype(self._dtype)
+        return mnp.array(out)
+
+
+class Group:
+    """Apply one batchify fn per tuple field (reference: Tuple/Group)."""
+
+    def __init__(self, *fns):
+        if len(fns) == 1 and isinstance(fns[0], (list, tuple)):
+            fns = fns[0]
+        self._fns = fns
+
+    def __call__(self, data):
+        assert len(data[0]) == len(self._fns)
+        return tuple(fn([d[i] for d in data])
+                     for i, fn in enumerate(self._fns))

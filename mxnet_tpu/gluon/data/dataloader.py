@@ -1,0 +1,89 @@
+"""DataLoader (reference: python/mxnet/gluon/data/dataloader.py:123-305).
+
+The reference's multiprocessing workers + shared-memory NDArray pickling are
+a CPU-side mechanism; the TPU-native pipeline keeps batches as host numpy
+until the last moment and lets `device_put` (async) overlap H2D with compute.
+num_workers>0 uses a thread pool (the GIL is released in numpy/decode work;
+TPU input pipelines are rarely Python-bound the way OpenCV-on-CPU was) and a
+prefetch queue mirroring iter_prefetcher.h.
+"""
+from __future__ import annotations
+
+import queue
+import threading
+
+import numpy as _np
+
+from .batchify import default_batchify_fn
+from .sampler import BatchSampler, RandomSampler, SequentialSampler
+
+__all__ = ["DataLoader", "default_batchify_fn"]
+
+
+class DataLoader:
+    def __init__(self, dataset, batch_size=None, shuffle=False, sampler=None,
+                 last_batch=None, batch_sampler=None, batchify_fn=None,
+                 num_workers=0, pin_memory=False, prefetch=None,
+                 thread_pool=False, timeout=120, try_nopython=None):  # noqa: ARG002
+        self._dataset = dataset
+        self._pin_memory = pin_memory
+        self._timeout = timeout
+        if batch_sampler is None:
+            if batch_size is None:
+                raise ValueError("batch_size required without batch_sampler")
+            if sampler is None:
+                sampler = RandomSampler(len(dataset)) if shuffle \
+                    else SequentialSampler(len(dataset))
+            elif shuffle:
+                raise ValueError("shuffle and sampler are exclusive")
+            batch_sampler = BatchSampler(sampler, batch_size,
+                                         last_batch or "keep")
+        self._batch_sampler = batch_sampler
+        self._num_workers = max(0, num_workers)
+        self._prefetch = max(0, prefetch if prefetch is not None
+                             else 2 * self._num_workers)
+        self._batchify_fn = batchify_fn or default_batchify_fn
+
+    def _make_batch(self, indices):
+        samples = [self._dataset[i] for i in indices]
+        return self._batchify_fn(samples)
+
+    def __iter__(self):
+        if self._num_workers == 0:
+            for indices in self._batch_sampler:
+                yield self._make_batch(indices)
+            return
+        yield from self._threaded_iter()
+
+    def _threaded_iter(self):
+        """Prefetching thread pool (the iter_prefetcher.h analog)."""
+        batches = list(self._batch_sampler)
+        out_q = queue.Queue(maxsize=max(self._prefetch, 1))
+        stop = threading.Event()
+
+        def producer():
+            try:
+                for indices in batches:
+                    if stop.is_set():
+                        return
+                    out_q.put(self._make_batch(indices))
+            except Exception as e:  # propagate to consumer
+                out_q.put(e)
+            finally:
+                out_q.put(StopIteration)
+
+        t = threading.Thread(target=producer, daemon=True)
+        t.start()
+        try:
+            while True:
+                item = out_q.get(timeout=self._timeout)
+                if item is StopIteration:
+                    return
+                if isinstance(item, Exception):
+                    raise item
+                yield item
+        finally:
+            stop.set()
+
+    def __len__(self):
+        return len(self._batch_sampler)

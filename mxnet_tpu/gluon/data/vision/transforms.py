@@ -1,0 +1,211 @@
+"""Vision transforms (reference: gluon/data/vision/transforms/).
+
+Transforms operate on host numpy HWC uint8 images (the loader side), keeping
+device work for the batched compute path — the TPU-friendly split.
+"""
+from __future__ import annotations
+
+import numpy as _np
+
+from ...block import Block
+from ...nn.basic_layers import Sequential
+
+__all__ = ["Compose", "Cast", "ToTensor", "Normalize", "Resize", "CenterCrop",
+           "RandomResizedCrop", "RandomCrop", "RandomFlipLeftRight",
+           "RandomFlipTopBottom", "RandomBrightness", "RandomContrast",
+           "RandomSaturation", "RandomLighting"]
+
+
+def _as_np(x):
+    from ....ndarray.ndarray import NDArray
+
+    if isinstance(x, NDArray):
+        return x.asnumpy()
+    return _np.asarray(x)
+
+
+class Compose(Sequential):
+    """Chain transforms (reference: transforms.Compose)."""
+
+    def __init__(self, transforms):
+        super().__init__()
+        for t in transforms:
+            self.add(t if isinstance(t, Block) else _Fn(t))
+
+
+class _Fn(Block):
+    def __init__(self, fn):
+        super().__init__()
+        self._fn = fn
+
+    def forward(self, x):
+        return self._fn(x)
+
+
+class Cast(Block):
+    def __init__(self, dtype="float32"):
+        super().__init__()
+        self._dtype = dtype
+
+    def forward(self, x):
+        return _as_np(x).astype(self._dtype)
+
+
+class ToTensor(Block):
+    """HWC uint8 [0,255] -> CHW float32 [0,1] (reference: ToTensor)."""
+
+    def forward(self, x):
+        x = _as_np(x)
+        if x.ndim == 2:
+            x = x[:, :, None]
+        return (x.astype(_np.float32) / 255.0).transpose(2, 0, 1)
+
+
+class Normalize(Block):
+    """Channel-wise (x - mean) / std on CHW (reference: Normalize)."""
+
+    def __init__(self, mean=0.0, std=1.0):
+        super().__init__()
+        self._mean = _np.asarray(mean, _np.float32).reshape(-1, 1, 1)
+        self._std = _np.asarray(std, _np.float32).reshape(-1, 1, 1)
+
+    def forward(self, x):
+        return (_as_np(x) - self._mean) / self._std
+
+
+def _resize_np(img, size):
+    """Nearest+bilinear resize without cv2 (HWC numpy)."""
+    import jax
+    import jax.numpy as jnp
+
+    h, w = (size, size) if isinstance(size, int) else (size[1], size[0])
+    out = jax.image.resize(jnp.asarray(img, jnp.float32),
+                           (h, w, img.shape[2]), "bilinear")
+    return _np.asarray(out)
+
+
+class Resize(Block):
+    def __init__(self, size, keep_ratio=False, interpolation=1):  # noqa: ARG002
+        super().__init__()
+        self._size = size
+
+    def forward(self, x):
+        x = _as_np(x)
+        if x.ndim == 2:
+            x = x[:, :, None]
+        return _resize_np(x, self._size)
+
+
+class CenterCrop(Block):
+    def __init__(self, size):
+        super().__init__()
+        self._size = (size, size) if isinstance(size, int) else size
+
+    def forward(self, x):
+        x = _as_np(x)
+        w, h = self._size
+        y0 = max((x.shape[0] - h) // 2, 0)
+        x0 = max((x.shape[1] - w) // 2, 0)
+        return x[y0 : y0 + h, x0 : x0 + w]
+
+
+class RandomCrop(Block):
+    def __init__(self, size, pad=None):
+        super().__init__()
+        self._size = (size, size) if isinstance(size, int) else size
+        self._pad = pad
+
+    def forward(self, x):
+        x = _as_np(x)
+        if self._pad:
+            p = self._pad
+            x = _np.pad(x, ((p, p), (p, p), (0, 0)), mode="constant")
+        w, h = self._size
+        y0 = _np.random.randint(0, max(x.shape[0] - h, 0) + 1)
+        x0 = _np.random.randint(0, max(x.shape[1] - w, 0) + 1)
+        return x[y0 : y0 + h, x0 : x0 + w]
+
+
+class RandomResizedCrop(Block):
+    def __init__(self, size, scale=(0.08, 1.0), ratio=(3 / 4, 4 / 3),
+                 interpolation=1):  # noqa: ARG002
+        super().__init__()
+        self._size = size
+        self._scale = scale
+        self._ratio = ratio
+
+    def forward(self, x):
+        x = _as_np(x)
+        area = x.shape[0] * x.shape[1]
+        for _ in range(10):
+            target = _np.random.uniform(*self._scale) * area
+            ar = _np.random.uniform(*self._ratio)
+            w = int(round((target * ar) ** 0.5))
+            h = int(round((target / ar) ** 0.5))
+            if w <= x.shape[1] and h <= x.shape[0]:
+                y0 = _np.random.randint(0, x.shape[0] - h + 1)
+                x0 = _np.random.randint(0, x.shape[1] - w + 1)
+                crop = x[y0 : y0 + h, x0 : x0 + w]
+                return _resize_np(crop, self._size)
+        return _resize_np(x, self._size)
+
+
+class RandomFlipLeftRight(Block):
+    def forward(self, x):
+        x = _as_np(x)
+        return x[:, ::-1] if _np.random.rand() < 0.5 else x
+
+
+class RandomFlipTopBottom(Block):
+    def forward(self, x):
+        x = _as_np(x)
+        return x[::-1] if _np.random.rand() < 0.5 else x
+
+
+class _Jitter(Block):
+    def __init__(self, amount):
+        super().__init__()
+        self._amount = amount
+
+    def _factor(self):
+        return 1.0 + _np.random.uniform(-self._amount, self._amount)
+
+
+class RandomBrightness(_Jitter):
+    def forward(self, x):
+        return _np.clip(_as_np(x).astype(_np.float32) * self._factor(),
+                        0, 255)
+
+
+class RandomContrast(_Jitter):
+    def forward(self, x):
+        x = _as_np(x).astype(_np.float32)
+        mean = x.mean()
+        return _np.clip((x - mean) * self._factor() + mean, 0, 255)
+
+
+class RandomSaturation(_Jitter):
+    def forward(self, x):
+        x = _as_np(x).astype(_np.float32)
+        gray = x.mean(axis=-1, keepdims=True)
+        return _np.clip((x - gray) * self._factor() + gray, 0, 255)
+
+
+class RandomLighting(Block):
+    """AlexNet-style PCA lighting noise (reference: RandomLighting)."""
+
+    _eigval = _np.array([55.46, 4.794, 1.148], _np.float32)
+    _eigvec = _np.array(
+        [[-0.5675, 0.7192, 0.4009],
+         [-0.5808, -0.0045, -0.814],
+         [-0.5836, -0.6948, 0.4203]], _np.float32)
+
+    def __init__(self, alpha=0.1):
+        super().__init__()
+        self._alpha = alpha
+
+    def forward(self, x):
+        x = _as_np(x).astype(_np.float32)
+        a = _np.random.normal(0, self._alpha, 3).astype(_np.float32)
+        rgb = (self._eigvec @ (a * self._eigval)).reshape(1, 1, 3)
+        return _np.clip(x + rgb, 0, 255)

@@ -1,0 +1,320 @@
+"""Loss layers (reference: python/mxnet/gluon/loss.py, 1009 LoC)."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..ndarray.ndarray import apply_op
+from .block import HybridBlock
+
+__all__ = ["Loss", "L2Loss", "L1Loss", "SigmoidBinaryCrossEntropyLoss",
+           "SigmoidBCELoss", "SoftmaxCrossEntropyLoss", "SoftmaxCELoss",
+           "KLDivLoss", "HuberLoss", "HingeLoss", "SquaredHingeLoss",
+           "LogisticLoss", "TripletLoss", "CosineEmbeddingLoss",
+           "PoissonNLLLoss", "CTCLoss"]
+
+
+def _reduce(x, weight, sample_weight, batch_axis):
+    if sample_weight is not None:
+        x = x * sample_weight
+    if weight is not None:
+        x = x * weight
+    axes = tuple(i for i in range(x.ndim) if i != batch_axis)
+    return jnp.mean(x, axis=axes) if axes else x
+
+
+class Loss(HybridBlock):
+    def __init__(self, weight=None, batch_axis=0):
+        super().__init__()
+        self._weight = weight
+        self._batch_axis = batch_axis
+
+    def __repr__(self):
+        return f"{type(self).__name__}(batch_axis={self._batch_axis}, w={self._weight})"
+
+
+class L2Loss(Loss):
+    """0.5*(pred-label)^2 (reference: loss.py:L2Loss)."""
+
+    def __init__(self, weight=1.0, batch_axis=0):
+        super().__init__(weight, batch_axis)
+
+    def forward(self, pred, label, sample_weight=None):
+        w, ba = self._weight, self._batch_axis
+
+        def fn(p, l, sw=None):  # noqa: E741
+            loss = jnp.square(l.reshape(p.shape) - p) / 2.0
+            return _reduce(loss, w, sw, ba)
+
+        if sample_weight is not None:
+            return apply_op(fn, pred, label, sample_weight)
+        return apply_op(fn, pred, label)
+
+
+class L1Loss(Loss):
+    def __init__(self, weight=None, batch_axis=0):
+        super().__init__(weight, batch_axis)
+
+    def forward(self, pred, label, sample_weight=None):
+        w, ba = self._weight, self._batch_axis
+
+        def fn(p, l, sw=None):  # noqa: E741
+            return _reduce(jnp.abs(l.reshape(p.shape) - p), w, sw, ba)
+
+        if sample_weight is not None:
+            return apply_op(fn, pred, label, sample_weight)
+        return apply_op(fn, pred, label)
+
+
+class SigmoidBinaryCrossEntropyLoss(Loss):
+    """BCE over logits (reference: SigmoidBCELoss)."""
+
+    def __init__(self, from_sigmoid=False, weight=None, batch_axis=0):
+        super().__init__(weight, batch_axis)
+        self._from_sigmoid = from_sigmoid
+
+    def forward(self, pred, label, sample_weight=None, pos_weight=None):
+        w, ba, fs = self._weight, self._batch_axis, self._from_sigmoid
+
+        def fn(p, l, sw=None):  # noqa: E741
+            l2 = l.reshape(p.shape)
+            if not fs:
+                mx = jnp.maximum(p, 0)
+                loss = mx - p * l2 + jnp.log1p(jnp.exp(-jnp.abs(p)))
+            else:
+                eps = 1e-12
+                loss = -(l2 * jnp.log(p + eps)
+                         + (1 - l2) * jnp.log(1 - p + eps))
+            return _reduce(loss, w, sw, ba)
+
+        if sample_weight is not None:
+            return apply_op(fn, pred, label, sample_weight)
+        return apply_op(fn, pred, label)
+
+
+SigmoidBCELoss = SigmoidBinaryCrossEntropyLoss
+
+
+class SoftmaxCrossEntropyLoss(Loss):
+    """Softmax CE (reference: SoftmaxCrossEntropyLoss).
+
+    sparse_label=True takes class indices; else one-hot/probabilities."""
+
+    def __init__(self, axis=-1, sparse_label=True, from_logits=False,
+                 weight=None, batch_axis=0):
+        super().__init__(weight, batch_axis)
+        self._axis = axis
+        self._sparse = sparse_label
+        self._from_logits = from_logits
+
+    def forward(self, pred, label, sample_weight=None):
+        axis, sparse, logits = self._axis, self._sparse, self._from_logits
+        w, ba = self._weight, self._batch_axis
+
+        def fn(p, l, sw=None):  # noqa: E741
+            logp = p if logits else jax.nn.log_softmax(p, axis=axis)
+            if sparse:
+                li = l.astype(jnp.int32)
+                ax = axis % logp.ndim
+                lshape = logp.shape[:ax] + logp.shape[ax + 1:]
+                picked = jnp.take_along_axis(
+                    logp, jnp.expand_dims(li.reshape(lshape), ax), axis=ax)
+                loss = -jnp.squeeze(picked, ax)
+            else:
+                loss = -jnp.sum(logp * l.reshape(logp.shape), axis=axis)
+            return _reduce(loss, w, sw, ba)
+
+        if sample_weight is not None:
+            return apply_op(fn, pred, label, sample_weight)
+        return apply_op(fn, pred, label)
+
+
+SoftmaxCELoss = SoftmaxCrossEntropyLoss
+
+
+class KLDivLoss(Loss):
+    def __init__(self, from_logits=True, axis=-1, weight=None, batch_axis=0):
+        super().__init__(weight, batch_axis)
+        self._from_logits = from_logits
+        self._axis = axis
+
+    def forward(self, pred, label, sample_weight=None):
+        fl, axis, w, ba = self._from_logits, self._axis, self._weight, \
+            self._batch_axis
+
+        def fn(p, l, sw=None):  # noqa: E741
+            logp = p if fl else jax.nn.log_softmax(p, axis=axis)
+            loss = l * (jnp.log(l + 1e-12) - logp)
+            return _reduce(jnp.mean(loss, axis=axis), w, sw, ba)
+
+        if sample_weight is not None:
+            return apply_op(fn, pred, label, sample_weight)
+        return apply_op(fn, pred, label)
+
+
+class HuberLoss(Loss):
+    def __init__(self, rho=1.0, weight=None, batch_axis=0):
+        super().__init__(weight, batch_axis)
+        self._rho = rho
+
+    def forward(self, pred, label, sample_weight=None):
+        rho, w, ba = self._rho, self._weight, self._batch_axis
+
+        def fn(p, l, sw=None):  # noqa: E741
+            d = jnp.abs(l.reshape(p.shape) - p)
+            loss = jnp.where(d > rho, d - 0.5 * rho, 0.5 / rho * d * d)
+            return _reduce(loss, w, sw, ba)
+
+        if sample_weight is not None:
+            return apply_op(fn, pred, label, sample_weight)
+        return apply_op(fn, pred, label)
+
+
+class HingeLoss(Loss):
+    def __init__(self, margin=1, weight=None, batch_axis=0):
+        super().__init__(weight, batch_axis)
+        self._margin = margin
+
+    def forward(self, pred, label, sample_weight=None):
+        m, w, ba = self._margin, self._weight, self._batch_axis
+
+        def fn(p, l, sw=None):  # noqa: E741
+            return _reduce(jnp.maximum(0.0, m - p * l.reshape(p.shape)),
+                           w, sw, ba)
+
+        if sample_weight is not None:
+            return apply_op(fn, pred, label, sample_weight)
+        return apply_op(fn, pred, label)
+
+
+class SquaredHingeLoss(HingeLoss):
+    def forward(self, pred, label, sample_weight=None):
+        m, w, ba = self._margin, self._weight, self._batch_axis
+
+        def fn(p, l, sw=None):  # noqa: E741
+            return _reduce(
+                jnp.square(jnp.maximum(0.0, m - p * l.reshape(p.shape))),
+                w, sw, ba)
+
+        if sample_weight is not None:
+            return apply_op(fn, pred, label, sample_weight)
+        return apply_op(fn, pred, label)
+
+
+class LogisticLoss(Loss):
+    def __init__(self, weight=None, batch_axis=0, label_format="signed"):
+        super().__init__(weight, batch_axis)
+        self._fmt = label_format
+
+    def forward(self, pred, label, sample_weight=None):
+        fmt, w, ba = self._fmt, self._weight, self._batch_axis
+
+        def fn(p, l, sw=None):  # noqa: E741
+            l2 = l.reshape(p.shape)
+            if fmt == "binary":
+                l2 = 2 * l2 - 1
+            loss = jnp.log1p(jnp.exp(-p * l2))
+            return _reduce(loss, w, sw, ba)
+
+        if sample_weight is not None:
+            return apply_op(fn, pred, label, sample_weight)
+        return apply_op(fn, pred, label)
+
+
+class TripletLoss(Loss):
+    def __init__(self, margin=1, weight=None, batch_axis=0):
+        super().__init__(weight, batch_axis)
+        self._margin = margin
+
+    def forward(self, pred, positive, negative, sample_weight=None):  # noqa: ARG002
+        m, w, ba = self._margin, self._weight, self._batch_axis
+
+        def fn(p, pos, neg):
+            axes = tuple(range(1, p.ndim))
+            loss = jnp.sum(jnp.square(p - pos) - jnp.square(p - neg),
+                           axis=axes) + m
+            return _reduce(jnp.maximum(loss, 0.0), w, None, ba)
+
+        return apply_op(fn, pred, positive, negative)
+
+
+class CosineEmbeddingLoss(Loss):
+    def __init__(self, weight=None, batch_axis=0, margin=0):
+        super().__init__(weight, batch_axis)
+        self._margin = margin
+
+    def forward(self, input1, input2, label, sample_weight=None):  # noqa: ARG002
+        m, w, ba = self._margin, self._weight, self._batch_axis
+
+        def fn(a, b, l):  # noqa: E741
+            cos = jnp.sum(a * b, -1) / (
+                jnp.linalg.norm(a, axis=-1) * jnp.linalg.norm(b, axis=-1)
+                + 1e-12)
+            l2 = l.reshape(cos.shape)
+            loss = jnp.where(l2 == 1, 1 - cos,
+                             jnp.maximum(0.0, cos - m))
+            return _reduce(loss, w, None, ba)
+
+        return apply_op(fn, input1, input2, label)
+
+
+class PoissonNLLLoss(Loss):
+    def __init__(self, weight=None, from_logits=True, batch_axis=0,
+                 compute_full=False):
+        super().__init__(weight, batch_axis)
+        self._from_logits = from_logits
+        self._full = compute_full
+
+    def forward(self, pred, label, sample_weight=None, epsilon=1e-08):
+        fl, full, w, ba = self._from_logits, self._full, self._weight, \
+            self._batch_axis
+
+        def fn(p, l, sw=None):  # noqa: E741
+            t = l.reshape(p.shape)
+            if fl:
+                loss = jnp.exp(p) - t * p
+            else:
+                loss = p - t * jnp.log(p + epsilon)
+            if full:
+                stirling = (t * jnp.log(t + epsilon) - t
+                            + 0.5 * jnp.log(2 * jnp.pi * (t + epsilon)))
+                loss = loss + jnp.where(t > 1, stirling,
+                                        jnp.zeros_like(stirling))
+            return _reduce(loss, w, sw, ba)
+
+        if sample_weight is not None:
+            return apply_op(fn, pred, label, sample_weight)
+        return apply_op(fn, pred, label)
+
+
+class CTCLoss(Loss):
+    """CTC loss (reference: loss.py:CTCLoss over src/operator/nn/ctc_loss.cc
+    / warp-ctc). Implemented over optax.ctc_loss (XLA-lowered)."""
+
+    def __init__(self, layout="NTC", label_layout="NT", weight=None):
+        super().__init__(weight, 0)
+        assert layout in ("NTC", "TNC")
+        self._layout = layout
+        self._label_layout = label_layout
+
+    def forward(self, pred, label, pred_lengths=None, label_lengths=None):
+        import optax
+
+        layout, w = self._layout, self._weight
+
+        def fn(p, l, pl=None, ll=None):  # noqa: E741
+            if layout == "TNC":
+                p = jnp.swapaxes(p, 0, 1)
+            n, t = p.shape[0], p.shape[1]
+            logitpad = jnp.zeros((n, t)) if pl is None else (
+                jnp.arange(t)[None, :] >= pl[:, None]).astype(p.dtype)
+            lt = l.shape[1]
+            labelpad = jnp.zeros((n, lt)) if ll is None else (
+                jnp.arange(lt)[None, :] >= ll[:, None]).astype(p.dtype)
+            loss = optax.ctc_loss(p, logitpad, l.astype(jnp.int32), labelpad,
+                                  blank_id=0)
+            if w is not None:
+                loss = loss * w
+            return loss
+
+        return apply_op(fn, pred, label, pred_lengths, label_lengths)

@@ -1,0 +1,338 @@
+"""Evaluation metrics (reference: python/mxnet/gluon/metric.py, 1867 LoC)."""
+from __future__ import annotations
+
+import numpy as _np
+
+from ..base import registry
+from ..ndarray.ndarray import NDArray
+
+_REG = registry("metric")
+
+__all__ = ["EvalMetric", "Accuracy", "TopKAccuracy", "F1", "MCC", "MAE",
+           "MSE", "RMSE", "CrossEntropy", "Perplexity", "PearsonCorrelation",
+           "Loss", "CompositeEvalMetric", "CustomMetric", "create", "np"]
+
+
+def _to_np(x):
+    if isinstance(x, NDArray):
+        return x.asnumpy()
+    return _np.asarray(x)
+
+
+def create(metric, *args, **kwargs):
+    if isinstance(metric, EvalMetric):
+        return metric
+    if callable(metric):
+        return CustomMetric(metric, *args, **kwargs)
+    if isinstance(metric, list):
+        out = CompositeEvalMetric()
+        for m in metric:
+            out.add(create(m, *args, **kwargs))
+        return out
+    return _REG.create(metric, *args, **kwargs)
+
+
+class EvalMetric:
+    def __init__(self, name, output_names=None, label_names=None, **kwargs):
+        self.name = name
+        self.output_names = output_names
+        self.label_names = label_names
+        self._kwargs = kwargs
+        self.reset()
+
+    def reset(self):
+        self.num_inst = 0
+        self.sum_metric = 0.0
+
+    def update(self, labels, preds):
+        raise NotImplementedError
+
+    def get(self):
+        if self.num_inst == 0:
+            return self.name, float("nan")
+        return self.name, self.sum_metric / self.num_inst
+
+    def get_name_value(self):
+        name, value = self.get()
+        if not isinstance(name, list):
+            name = [name]
+        if not isinstance(value, list):
+            value = [value]
+        return list(zip(name, value))
+
+    def __str__(self):
+        return f"EvalMetric: {dict(self.get_name_value())}"
+
+
+def _register(klass):
+    _REG.register(klass)
+    return klass
+
+
+@_register
+class Accuracy(EvalMetric):
+    def __init__(self, axis=-1, name="accuracy", **kwargs):
+        super().__init__(name, **kwargs)
+        self.axis = axis
+
+    def update(self, labels, preds):
+        labels = labels if isinstance(labels, (list, tuple)) else [labels]
+        preds = preds if isinstance(preds, (list, tuple)) else [preds]
+        for label, pred in zip(labels, preds):
+            label = _to_np(label)
+            pred = _to_np(pred)
+            if pred.ndim > label.ndim:
+                pred = pred.argmax(self.axis)
+            pred = pred.astype(_np.int64).reshape(-1)
+            label = label.astype(_np.int64).reshape(-1)
+            self.sum_metric += float((pred == label).sum())
+            self.num_inst += len(label)
+
+
+@_register
+class TopKAccuracy(EvalMetric):
+    def __init__(self, top_k=1, name="top_k_accuracy", **kwargs):
+        super().__init__(f"{name}_{top_k}", **kwargs)
+        self.top_k = top_k
+
+    def update(self, labels, preds):
+        labels = labels if isinstance(labels, (list, tuple)) else [labels]
+        preds = preds if isinstance(preds, (list, tuple)) else [preds]
+        for label, pred in zip(labels, preds):
+            label = _to_np(label).astype(_np.int64)
+            pred = _to_np(pred)
+            topk = _np.argsort(-pred, axis=-1)[..., : self.top_k]
+            hit = (topk == label[..., None]).any(axis=-1)
+            self.sum_metric += float(hit.sum())
+            self.num_inst += hit.size
+
+
+@_register
+class F1(EvalMetric):
+    def __init__(self, name="f1", average="macro", threshold=0.5, **kwargs):
+        super().__init__(name, **kwargs)
+        self.average = average
+        self.threshold = threshold
+        self.reset_stats()
+
+    def reset_stats(self):
+        self._tp = self._fp = self._fn = 0.0
+
+    def reset(self):
+        super().reset()
+        if hasattr(self, "_tp"):
+            self.reset_stats()
+
+    def update(self, labels, preds):
+        labels = labels if isinstance(labels, (list, tuple)) else [labels]
+        preds = preds if isinstance(preds, (list, tuple)) else [preds]
+        for label, pred in zip(labels, preds):
+            label = _to_np(label).reshape(-1).astype(_np.int64)
+            pred = _to_np(pred)
+            if pred.ndim > 1 and pred.shape[-1] > 1:
+                pred = pred.argmax(-1).reshape(-1)
+            else:
+                pred = (pred.reshape(-1) > self.threshold).astype(_np.int64)
+            self._tp += float(((pred == 1) & (label == 1)).sum())
+            self._fp += float(((pred == 1) & (label == 0)).sum())
+            self._fn += float(((pred == 0) & (label == 1)).sum())
+            self.num_inst += len(label)
+
+    def get(self):
+        prec = self._tp / max(self._tp + self._fp, 1e-12)
+        rec = self._tp / max(self._tp + self._fn, 1e-12)
+        f1 = 2 * prec * rec / max(prec + rec, 1e-12)
+        return self.name, f1 if self.num_inst else float("nan")
+
+
+@_register
+class MCC(EvalMetric):
+    def __init__(self, name="mcc", **kwargs):
+        super().__init__(name, **kwargs)
+        self._tp = self._tn = self._fp = self._fn = 0.0
+
+    def reset(self):
+        super().reset()
+        self._tp = self._tn = self._fp = self._fn = 0.0
+
+    def update(self, labels, preds):
+        labels = labels if isinstance(labels, (list, tuple)) else [labels]
+        preds = preds if isinstance(preds, (list, tuple)) else [preds]
+        for label, pred in zip(labels, preds):
+            label = _to_np(label).reshape(-1).astype(_np.int64)
+            pred = _to_np(pred)
+            if pred.ndim > 1 and pred.shape[-1] > 1:
+                pred = pred.argmax(-1).reshape(-1)
+            else:
+                pred = (pred.reshape(-1) > 0.5).astype(_np.int64)
+            self._tp += float(((pred == 1) & (label == 1)).sum())
+            self._tn += float(((pred == 0) & (label == 0)).sum())
+            self._fp += float(((pred == 1) & (label == 0)).sum())
+            self._fn += float(((pred == 0) & (label == 1)).sum())
+            self.num_inst += len(label)
+
+    def get(self):
+        tp, tn, fp, fn = self._tp, self._tn, self._fp, self._fn
+        denom = ((tp + fp) * (tp + fn) * (tn + fp) * (tn + fn)) ** 0.5
+        mcc = (tp * tn - fp * fn) / denom if denom else 0.0
+        return self.name, mcc if self.num_inst else float("nan")
+
+
+@_register
+class MAE(EvalMetric):
+    def __init__(self, name="mae", **kwargs):
+        super().__init__(name, **kwargs)
+
+    def update(self, labels, preds):
+        labels = labels if isinstance(labels, (list, tuple)) else [labels]
+        preds = preds if isinstance(preds, (list, tuple)) else [preds]
+        for label, pred in zip(labels, preds):
+            label = _to_np(label)
+            pred = _to_np(pred).reshape(label.shape)
+            self.sum_metric += float(_np.abs(label - pred).mean()) * len(label)
+            self.num_inst += len(label)
+
+
+@_register
+class MSE(EvalMetric):
+    def __init__(self, name="mse", **kwargs):
+        super().__init__(name, **kwargs)
+
+    def update(self, labels, preds):
+        labels = labels if isinstance(labels, (list, tuple)) else [labels]
+        preds = preds if isinstance(preds, (list, tuple)) else [preds]
+        for label, pred in zip(labels, preds):
+            label = _to_np(label)
+            pred = _to_np(pred).reshape(label.shape)
+            self.sum_metric += float(((label - pred) ** 2).mean()) * len(label)
+            self.num_inst += len(label)
+
+
+@_register
+class RMSE(MSE):
+    def __init__(self, name="rmse", **kwargs):
+        super().__init__(name=name, **kwargs)
+
+    def get(self):
+        name, value = super().get()
+        return name, value ** 0.5 if value == value else value
+
+
+@_register
+class CrossEntropy(EvalMetric):
+    def __init__(self, eps=1e-12, name="cross-entropy", **kwargs):
+        super().__init__(name, **kwargs)
+        self.eps = eps
+
+    def update(self, labels, preds):
+        labels = labels if isinstance(labels, (list, tuple)) else [labels]
+        preds = preds if isinstance(preds, (list, tuple)) else [preds]
+        for label, pred in zip(labels, preds):
+            label = _to_np(label).reshape(-1).astype(_np.int64)
+            pred = _to_np(pred)
+            prob = pred[_np.arange(len(label)), label]
+            self.sum_metric += float(-_np.log(prob + self.eps).sum())
+            self.num_inst += len(label)
+
+
+@_register
+class Perplexity(CrossEntropy):
+    def __init__(self, ignore_label=None, name="perplexity", **kwargs):
+        super().__init__(name=name, **kwargs)
+        self.ignore_label = ignore_label
+
+    def get(self):
+        if self.num_inst == 0:
+            return self.name, float("nan")
+        return self.name, float(_np.exp(self.sum_metric / self.num_inst))
+
+
+@_register
+class PearsonCorrelation(EvalMetric):
+    def __init__(self, name="pearsonr", **kwargs):
+        super().__init__(name, **kwargs)
+        self._labels = []
+        self._preds = []
+
+    def reset(self):
+        super().reset()
+        self._labels, self._preds = [], []
+
+    def update(self, labels, preds):
+        labels = labels if isinstance(labels, (list, tuple)) else [labels]
+        preds = preds if isinstance(preds, (list, tuple)) else [preds]
+        for label, pred in zip(labels, preds):
+            self._labels.append(_to_np(label).reshape(-1))
+            self._preds.append(_to_np(pred).reshape(-1))
+            self.num_inst += len(self._labels[-1])
+
+    def get(self):
+        if not self._labels:
+            return self.name, float("nan")
+        x = _np.concatenate(self._labels)
+        y = _np.concatenate(self._preds)
+        return self.name, float(_np.corrcoef(x, y)[0, 1])
+
+
+@_register
+class Loss(EvalMetric):
+    def __init__(self, name="loss", **kwargs):
+        super().__init__(name, **kwargs)
+
+    def update(self, _, preds):
+        preds = preds if isinstance(preds, (list, tuple)) else [preds]
+        for pred in preds:
+            p = _to_np(pred)
+            self.sum_metric += float(p.sum())
+            self.num_inst += p.size
+
+
+class CompositeEvalMetric(EvalMetric):
+    def __init__(self, metrics=None, name="composite", **kwargs):
+        super().__init__(name, **kwargs)
+        self.metrics = [create(m) for m in (metrics or [])]
+
+    def add(self, metric):
+        self.metrics.append(create(metric))
+
+    def reset(self):
+        for m in getattr(self, "metrics", []):
+            m.reset()
+
+    def update(self, labels, preds):
+        for m in self.metrics:
+            m.update(labels, preds)
+
+    def get(self):
+        names, values = [], []
+        for m in self.metrics:
+            n, v = m.get()
+            names.append(n)
+            values.append(v)
+        return names, values
+
+
+class CustomMetric(EvalMetric):
+    def __init__(self, feval, name="custom", allow_extra_outputs=False,
+                 **kwargs):  # noqa: ARG002
+        super().__init__(f"custom({name})", **kwargs)
+        self._feval = feval
+
+    def update(self, labels, preds):
+        labels = labels if isinstance(labels, (list, tuple)) else [labels]
+        preds = preds if isinstance(preds, (list, tuple)) else [preds]
+        for label, pred in zip(labels, preds):
+            out = self._feval(_to_np(label), _to_np(pred))
+            if isinstance(out, tuple):
+                s, n = out
+                self.sum_metric += s
+                self.num_inst += n
+            else:
+                self.sum_metric += out
+                self.num_inst += 1
+
+
+np = _np  # parity: reference metric module exposes numpy as .np
+_REG.register(Accuracy, "acc")
+_REG.register(CrossEntropy, "ce")
+_REG.register(TopKAccuracy, "top_k_acc")

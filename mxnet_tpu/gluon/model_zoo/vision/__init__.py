@@ -1,0 +1,89 @@
+"""Vision model zoo (reference: python/mxnet/gluon/model_zoo/vision/:
+alexnet, densenet, inception, mobilenet, resnet, squeezenet, vgg).
+
+Pretrained-weight download is not available (no egress); `pretrained=True`
+raises with a pointer to load_parameters.
+"""
+from .alexnet import AlexNet, alexnet  # noqa: F401
+from .densenet import (  # noqa: F401
+    DenseNet,
+    densenet121,
+    densenet161,
+    densenet169,
+    densenet201,
+)
+from .inception import Inception3, inception_v3  # noqa: F401
+from .lenet import LeNet, lenet  # noqa: F401
+from .mobilenet import (  # noqa: F401
+    MobileNet,
+    MobileNetV2,
+    get_mobilenet,
+    get_mobilenet_v2,
+    mobilenet0_25,
+    mobilenet0_5,
+    mobilenet0_75,
+    mobilenet1_0,
+    mobilenet_v2_0_25,
+    mobilenet_v2_0_5,
+    mobilenet_v2_0_75,
+    mobilenet_v2_1_0,
+)
+from .resnet import (  # noqa: F401
+    ResNetV1,
+    ResNetV2,
+    get_resnet,
+    resnet18_v1,
+    resnet18_v2,
+    resnet34_v1,
+    resnet34_v2,
+    resnet50_v1,
+    resnet50_v2,
+    resnet101_v1,
+    resnet101_v2,
+    resnet152_v1,
+    resnet152_v2,
+)
+from .squeezenet import SqueezeNet, squeezenet1_0, squeezenet1_1  # noqa: F401
+from .vgg import (  # noqa: F401
+    VGG,
+    get_vgg,
+    vgg11,
+    vgg11_bn,
+    vgg13,
+    vgg13_bn,
+    vgg16,
+    vgg16_bn,
+    vgg19,
+    vgg19_bn,
+)
+
+_MODELS = {}
+
+
+def _register_models():
+    import sys
+
+    mod = sys.modules[__name__]
+    for name in ["alexnet", "densenet121", "densenet161", "densenet169",
+                 "densenet201", "inception_v3", "lenet",
+                 "mobilenet0_25", "mobilenet0_5", "mobilenet0_75",
+                 "mobilenet1_0", "mobilenet_v2_0_25", "mobilenet_v2_0_5",
+                 "mobilenet_v2_0_75", "mobilenet_v2_1_0",
+                 "resnet18_v1", "resnet34_v1", "resnet50_v1", "resnet101_v1",
+                 "resnet152_v1", "resnet18_v2", "resnet34_v2", "resnet50_v2",
+                 "resnet101_v2", "resnet152_v2", "squeezenet1_0",
+                 "squeezenet1_1", "vgg11", "vgg11_bn", "vgg13", "vgg13_bn",
+                 "vgg16", "vgg16_bn", "vgg19", "vgg19_bn"]:
+        _MODELS[name] = getattr(mod, name)
+
+
+_register_models()
+
+
+def get_model(name, **kwargs):
+    """Create a model by name (reference: model_zoo/vision/__init__.py)."""
+    name = name.lower()
+    if name not in _MODELS:
+        raise ValueError(
+            f"unknown model '{name}'; available: {sorted(_MODELS)}")
+    return _MODELS[name](**kwargs)

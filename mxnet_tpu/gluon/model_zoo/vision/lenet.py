@@ -1,0 +1,32 @@
+"""LeNet-5 for MNIST — BASELINE.json config #1's model."""
+from __future__ import annotations
+
+from ... import nn
+from ...block import HybridBlock
+
+
+class LeNet(HybridBlock):
+    """Classic LeNet (conv-pool x2 + dense x3), NCHW 28x28 inputs."""
+
+    def __init__(self, classes=10, **kwargs):  # noqa: ARG002
+        super().__init__()
+        self.features = nn.HybridSequential()
+        self.features.add(
+            nn.Conv2D(6, kernel_size=5, padding=2, activation="tanh"),
+            nn.AvgPool2D(pool_size=2, strides=2),
+            nn.Conv2D(16, kernel_size=5, activation="tanh"),
+            nn.AvgPool2D(pool_size=2, strides=2),
+            nn.Flatten(),
+            nn.Dense(120, activation="tanh"),
+            nn.Dense(84, activation="tanh"),
+        )
+        self.output = nn.Dense(classes)
+
+    def forward(self, x):
+        return self.output(self.features(x))
+
+
+def lenet(classes=10, pretrained=False, **kwargs):
+    if pretrained:
+        raise ValueError("no pretrained weights bundled")
+    return LeNet(classes=classes, **kwargs)

@@ -1,0 +1,71 @@
+"""Activation layers (reference: python/mxnet/gluon/nn/activations.py)."""
+from __future__ import annotations
+
+from ... import numpy_extension as npx
+from ..block import HybridBlock
+from ..parameter import Parameter
+
+__all__ = ["LeakyReLU", "PReLU", "ELU", "SELU", "Swish", "SiLU", "GELU"]
+
+
+class LeakyReLU(HybridBlock):
+    def __init__(self, alpha=0.01):
+        super().__init__()
+        self._alpha = alpha
+
+    def forward(self, x):
+        return npx.leaky_relu(x, act_type="leaky", slope=self._alpha)
+
+    def __repr__(self):
+        return f"LeakyReLU({self._alpha})"
+
+
+class PReLU(HybridBlock):
+    def __init__(self, alpha_initializer=None, in_channels=1):
+        super().__init__()
+        from ... import initializer
+
+        self.alpha = Parameter(
+            "alpha", shape=(in_channels,),
+            init=alpha_initializer or initializer.Constant(0.25))
+
+    def forward(self, x):
+        return npx.leaky_relu(x, self.alpha.data_for(x), act_type="prelu")
+
+
+class ELU(HybridBlock):
+    def __init__(self, alpha=1.0):
+        super().__init__()
+        self._alpha = alpha
+
+    def forward(self, x):
+        return npx.leaky_relu(x, act_type="elu", slope=self._alpha)
+
+
+class SELU(HybridBlock):
+    def forward(self, x):
+        return npx.leaky_relu(x, act_type="selu")
+
+
+class GELU(HybridBlock):
+    def __init__(self, approximation="erf"):
+        super().__init__()
+        self._approx = approximation
+
+    def forward(self, x):
+        act = "gelu" if self._approx == "erf" else "gelu"
+        return npx.activation(x, act)
+
+
+class Swish(HybridBlock):
+    def __init__(self, beta=1.0):
+        super().__init__()
+        self._beta = beta
+
+    def forward(self, x):
+        if self._beta == 1.0:
+            return npx.activation(x, "silu")
+        return x * npx.activation(x * self._beta, "sigmoid")
+
+
+SiLU = Swish

@@ -1,0 +1,419 @@
+"""Basic layers (reference: python/mxnet/gluon/nn/basic_layers.py).
+
+Every layer's forward is pure NDArray->NDArray through the npx/apply_op path,
+so the same code runs eagerly (taped) and under CachedOp tracing (jit).
+Deferred init: unknown input dims (0) are inferred on first forward.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as _np
+
+from ... import autograd as ag
+from ... import numpy_extension as npx
+from ...ndarray.ndarray import NDArray, apply_op
+from ...ops import nn as _nn
+from ..block import Block, HybridBlock, current_state_sink
+from ..parameter import Parameter
+
+__all__ = ["Sequential", "HybridSequential", "Dense", "Dropout", "BatchNorm",
+           "SyncBatchNorm", "LayerNorm", "GroupNorm", "InstanceNorm",
+           "Embedding", "Flatten", "Lambda", "HybridLambda", "Concatenate",
+           "HybridConcatenate", "Identity", "Activation", "HybridBlock"]
+
+
+class Sequential(Block):
+    """Sequential container (reference: nn.Sequential)."""
+
+    def __init__(self, *blocks):
+        super().__init__()
+        for b in blocks:
+            self.add(b)
+
+    def add(self, *blocks):
+        for b in blocks:
+            self.register_child(b)
+        return self
+
+    def forward(self, x, *args):
+        for block in self._children.values():
+            x = block(x, *args)
+            args = ()
+        return x
+
+    def __getitem__(self, key):
+        children = list(self._children.values())
+        if isinstance(key, slice):
+            net = type(self)()
+            net.add(*children[key])
+            return net
+        return children[key]
+
+    def __len__(self):
+        return len(self._children)
+
+    def __iter__(self):
+        return iter(self._children.values())
+
+
+class HybridSequential(HybridBlock):
+    """Sequential that compiles as ONE jit program when hybridized
+    (reference: nn.HybridSequential)."""
+
+    def __init__(self, *blocks):
+        super().__init__()
+        for b in blocks:
+            self.add(b)
+
+    def add(self, *blocks):
+        for b in blocks:
+            self.register_child(b)
+        return self
+
+    def forward(self, x, *args):
+        for block in self._children.values():
+            x = block(x, *args)
+            args = ()
+        return x
+
+    def __getitem__(self, key):
+        children = list(self._children.values())
+        if isinstance(key, slice):
+            net = type(self)()
+            net.add(*children[key])
+            return net
+        return children[key]
+
+    def __len__(self):
+        return len(self._children)
+
+    def __iter__(self):
+        return iter(self._children.values())
+
+
+class Dense(HybridBlock):
+    """Fully connected layer (reference: nn.Dense; op FullyConnected)."""
+
+    def __init__(self, units, activation=None, use_bias=True, flatten=True,
+                 dtype="float32", weight_initializer=None,
+                 bias_initializer="zeros", in_units=0):
+        super().__init__()
+        self._units = units
+        self._flatten = flatten
+        self._activation = activation
+        self.weight = Parameter("weight", shape=(units, in_units),
+                                dtype=dtype, init=weight_initializer,
+                                allow_deferred_init=True)
+        self.bias = (
+            Parameter("bias", shape=(units,), dtype=dtype,
+                      init=bias_initializer, allow_deferred_init=True)
+            if use_bias else None
+        )
+
+    def forward(self, x):
+        if self.weight._is_deferred:
+            in_units = (
+                int(_np.prod(x.shape[1:])) if self._flatten else x.shape[-1])
+            self.weight._finish_deferred_init((self._units, in_units))
+        if self.bias is not None and self.bias._is_deferred:
+            self.bias._finish_deferred_init((self._units,))
+        w = self.weight.data_for(x)
+        b = self.bias.data_for(x) if self.bias is not None else None
+        if b is None:
+            out = npx.fully_connected(x, w, flatten=self._flatten)
+        else:
+            out = npx.fully_connected(x, w, b, flatten=self._flatten)
+        if self._activation is not None:
+            out = npx.activation(out, self._activation)
+        return out
+
+    def __repr__(self):
+        return f"Dense({self._units}, in_units={self.weight.shape[1]})"
+
+
+class Dropout(HybridBlock):
+    """Dropout (reference: nn.Dropout)."""
+
+    def __init__(self, rate, axes=()):
+        super().__init__()
+        self._rate = rate
+        self._axes = axes
+
+    def forward(self, x):
+        if self._rate <= 0:
+            return x
+        return npx.dropout(x, p=self._rate, axes=self._axes or None)
+
+    def __repr__(self):
+        return f"Dropout(p = {self._rate}, axes={self._axes})"
+
+
+class BatchNorm(HybridBlock):
+    """Batch normalization with moving stats (reference: nn.BatchNorm).
+
+    Running-stat updates go through the trace state sink when compiled (the
+    mutable-aux-input analog of nn/batch_norm.cc) and mutate eagerly
+    otherwise.
+    """
+
+    def __init__(self, axis=1, momentum=0.9, epsilon=1e-5, center=True,
+                 scale=True, use_global_stats=False,
+                 beta_initializer="zeros", gamma_initializer="ones",
+                 running_mean_initializer="zeros",
+                 running_variance_initializer="ones", in_channels=0,
+                 **kwargs):  # noqa: ARG002
+        super().__init__()
+        self._axis = axis
+        self._momentum = momentum
+        self._epsilon = epsilon
+        self._center = center
+        self._scale = scale
+        self._use_global_stats = use_global_stats
+        sh = (in_channels,)
+        self.gamma = Parameter("gamma", shape=sh,
+                               init=gamma_initializer,
+                               allow_deferred_init=True,
+                               differentiable=scale)
+        self.beta = Parameter("beta", shape=sh, init=beta_initializer,
+                              allow_deferred_init=True,
+                              differentiable=center)
+        self.running_mean = Parameter("running_mean", shape=sh,
+                                      init=running_mean_initializer,
+                                      grad_req="null",
+                                      allow_deferred_init=True)
+        self.running_var = Parameter("running_var", shape=sh,
+                                     init=running_variance_initializer,
+                                     grad_req="null",
+                                     allow_deferred_init=True)
+
+    def _defer(self, x):
+        c = x.shape[self._axis]
+        for p in (self.gamma, self.beta, self.running_mean, self.running_var):
+            if p._is_deferred:
+                p._finish_deferred_init((c,))
+
+    def forward(self, x):
+        self._defer(x)
+        gamma = self.gamma.data_for(x)
+        beta = self.beta.data_for(x)
+        rmean = self.running_mean.data_for(x)
+        rvar = self.running_var.data_for(x)
+        if not self._scale:
+            gamma = apply_op(jnp.ones_like, gamma)
+        training = ag.is_training() and not self._use_global_stats
+        out, nm, nv = apply_op(
+            lambda a, g, b, m, v: _nn.batch_norm(
+                a, g, b, m, v, eps=self._epsilon, momentum=self._momentum,
+                training=training, use_global_stats=self._use_global_stats,
+                axis=self._axis),
+            x, gamma, beta, rmean, rvar, name="BatchNorm")
+        if training:
+            sink = current_state_sink()
+            if sink is not None:
+                sink.record(self.running_mean, nm._data)
+                sink.record(self.running_var, nv._data)
+            else:
+                self.running_mean.data_for(x)._assign_from(nm.detach())
+                self.running_var.data_for(x)._assign_from(nv.detach())
+        return out
+
+    def __repr__(self):
+        return (f"BatchNorm(axis={self._axis}, momentum={self._momentum}, "
+                f"in_channels={self.gamma.shape[0]})")
+
+
+class SyncBatchNorm(BatchNorm):
+    """Cross-device BatchNorm (reference: contrib SyncBatchNorm).
+
+    Under the sharded trainer, batch stats are computed over the global batch
+    automatically by XLA SPMD; as a standalone layer it equals BatchNorm."""
+
+    def __init__(self, in_channels=0, num_devices=None, **kwargs):  # noqa: ARG002
+        super().__init__(in_channels=in_channels, **kwargs)
+
+
+class LayerNorm(HybridBlock):
+    """Layer normalization (reference: nn.LayerNorm)."""
+
+    def __init__(self, axis=-1, epsilon=1e-5, center=True, scale=True,
+                 beta_initializer="zeros", gamma_initializer="ones",
+                 in_channels=0):
+        super().__init__()
+        self._axis = axis
+        self._epsilon = epsilon
+        self._center = center
+        self._scale = scale
+        self.gamma = Parameter("gamma", shape=(in_channels,),
+                               init=gamma_initializer,
+                               allow_deferred_init=True,
+                               differentiable=scale)
+        self.beta = Parameter("beta", shape=(in_channels,),
+                              init=beta_initializer,
+                              allow_deferred_init=True,
+                              differentiable=center)
+
+    def forward(self, x):
+        c = x.shape[self._axis]
+        for p in (self.gamma, self.beta):
+            if p._is_deferred:
+                p._finish_deferred_init((c,))
+        return npx.layer_norm(x, self.gamma.data_for(x),
+                              self.beta.data_for(x), axis=self._axis,
+                              eps=self._epsilon)
+
+
+class GroupNorm(HybridBlock):
+    """Group normalization (reference: nn.GroupNorm)."""
+
+    def __init__(self, num_groups=1, epsilon=1e-5, center=True, scale=True,
+                 beta_initializer="zeros", gamma_initializer="ones",
+                 in_channels=0):
+        super().__init__()
+        self._num_groups = num_groups
+        self._epsilon = epsilon
+        self.gamma = Parameter("gamma", shape=(in_channels,),
+                               init=gamma_initializer,
+                               allow_deferred_init=True,
+                               differentiable=scale)
+        self.beta = Parameter("beta", shape=(in_channels,),
+                              init=beta_initializer,
+                              allow_deferred_init=True,
+                              differentiable=center)
+
+    def forward(self, x):
+        c = x.shape[1]
+        for p in (self.gamma, self.beta):
+            if p._is_deferred:
+                p._finish_deferred_init((c,))
+        return npx.group_norm(x, self.gamma.data_for(x),
+                              self.beta.data_for(x),
+                              num_groups=self._num_groups,
+                              eps=self._epsilon)
+
+
+class InstanceNorm(HybridBlock):
+    """Instance normalization (reference: nn.InstanceNorm)."""
+
+    def __init__(self, axis=1, epsilon=1e-5, center=True, scale=True,
+                 beta_initializer="zeros", gamma_initializer="ones",
+                 in_channels=0):  # noqa: ARG002
+        super().__init__()
+        self._epsilon = epsilon
+        self.gamma = Parameter("gamma", shape=(in_channels,),
+                               init=gamma_initializer,
+                               allow_deferred_init=True,
+                               differentiable=scale)
+        self.beta = Parameter("beta", shape=(in_channels,),
+                              init=beta_initializer,
+                              allow_deferred_init=True,
+                              differentiable=center)
+
+    def forward(self, x):
+        c = x.shape[1]
+        for p in (self.gamma, self.beta):
+            if p._is_deferred:
+                p._finish_deferred_init((c,))
+        return npx.instance_norm(x, self.gamma.data_for(x),
+                                 self.beta.data_for(x), eps=self._epsilon)
+
+
+class Embedding(HybridBlock):
+    """Embedding lookup (reference: nn.Embedding).
+
+    Gradient w.r.t. weight is a dense scatter-add (the reference's
+    row_sparse grad option is deliberately dense on TPU)."""
+
+    def __init__(self, input_dim, output_dim, dtype="float32",
+                 weight_initializer=None, sparse_grad=False):
+        super().__init__()
+        if sparse_grad:
+            import warnings
+
+            warnings.warn("sparse_grad is ignored on TPU (dense scatter)",
+                          stacklevel=2)
+        self._input_dim = input_dim
+        self._output_dim = output_dim
+        self.weight = Parameter("weight", shape=(input_dim, output_dim),
+                                dtype=dtype, init=weight_initializer)
+
+    def forward(self, x):
+        return npx.embedding(x, self.weight.data_for(x))
+
+    def __repr__(self):
+        return f"Embedding({self._input_dim} -> {self._output_dim})"
+
+
+class Flatten(HybridBlock):
+    """Flatten to (N, -1) (reference: nn.Flatten)."""
+
+    def forward(self, x):
+        return x.reshape((x.shape[0], -1))
+
+    def __repr__(self):
+        return "Flatten"
+
+
+class Identity(HybridBlock):
+    def forward(self, x):
+        return x
+
+
+class Lambda(Block):
+    """Wrap a function as a layer (reference: nn.Lambda)."""
+
+    def __init__(self, function):
+        super().__init__()
+        self._func = function
+
+    def forward(self, *args):
+        return self._func(*args)
+
+
+class HybridLambda(HybridBlock):
+    def __init__(self, function):
+        super().__init__()
+        self._func = function
+
+    def forward(self, *args):
+        return self._func(*args)
+
+
+class Concatenate(Sequential):
+    """Run children on the same input, concat outputs (reference:
+    contrib Concurrent / nn.Concatenate)."""
+
+    def __init__(self, axis=-1):
+        super().__init__()
+        self._axis = axis
+
+    def forward(self, x):
+        from ... import numpy as np
+
+        outs = [block(x) for block in self._children.values()]
+        return np.concatenate(outs, axis=self._axis)
+
+
+class HybridConcatenate(HybridSequential):
+    def __init__(self, axis=-1):
+        super().__init__()
+        self._axis = axis
+
+    def forward(self, x):
+        from ... import numpy as np
+
+        outs = [block(x) for block in self._children.values()]
+        return np.concatenate(outs, axis=self._axis)
+
+
+class Activation(HybridBlock):
+    """Activation layer (reference: nn.Activation)."""
+
+    def __init__(self, activation):
+        super().__init__()
+        self._act = activation
+
+    def forward(self, x):
+        return npx.activation(x, self._act)
+
+    def __repr__(self):
+        return f"Activation({self._act})"

@@ -1,0 +1,287 @@
+"""Convolution, pooling and padding layers
+(reference: python/mxnet/gluon/nn/conv_layers.py). NCHW layouts."""
+from __future__ import annotations
+
+import numpy as _np
+
+from ... import numpy_extension as npx
+from ...ndarray.ndarray import apply_op
+from ..block import HybridBlock
+from ..parameter import Parameter
+
+__all__ = ["Conv1D", "Conv2D", "Conv3D", "Conv1DTranspose", "Conv2DTranspose",
+           "Conv3DTranspose", "MaxPool1D", "MaxPool2D", "MaxPool3D",
+           "AvgPool1D", "AvgPool2D", "AvgPool3D", "GlobalMaxPool1D",
+           "GlobalMaxPool2D", "GlobalMaxPool3D", "GlobalAvgPool1D",
+           "GlobalAvgPool2D", "GlobalAvgPool3D", "ReflectionPad2D"]
+
+
+def _tup(v, n):
+    if isinstance(v, int):
+        return (v,) * n
+    return tuple(v)
+
+
+class _Conv(HybridBlock):
+    def __init__(self, channels, kernel_size, strides, padding, dilation,
+                 groups, use_bias, in_channels, activation,
+                 weight_initializer, bias_initializer, ndim, transpose=False,
+                 output_padding=0):
+        super().__init__()
+        self._channels = channels
+        self._ndim = ndim
+        self._kernel = _tup(kernel_size, ndim)
+        self._strides = _tup(strides, ndim)
+        self._padding = _tup(padding, ndim)
+        self._dilation = _tup(dilation, ndim)
+        self._groups = groups
+        self._activation = activation
+        self._transpose = transpose
+        self._output_padding = _tup(output_padding, ndim)
+        if transpose:
+            wshape = (in_channels, channels // groups) + self._kernel
+        else:
+            wshape = (channels, in_channels // groups if in_channels else 0) \
+                + self._kernel
+        self.weight = Parameter("weight", shape=wshape,
+                                init=weight_initializer,
+                                allow_deferred_init=True)
+        self.bias = (Parameter("bias", shape=(channels,),
+                               init=bias_initializer or "zeros")
+                     if use_bias else None)
+
+    def forward(self, x):
+        c_in = x.shape[1]
+        if self.weight._is_deferred:
+            if self._transpose:
+                self.weight._finish_deferred_init(
+                    (c_in, self._channels // self._groups) + self._kernel)
+            else:
+                self.weight._finish_deferred_init(
+                    (self._channels, c_in // self._groups) + self._kernel)
+        w = self.weight.data_for(x)
+        b = self.bias.data_for(x) if self.bias is not None else None
+        if self._transpose:
+            args = (x, w) if b is None else (x, w, b)
+            out = npx.deconvolution(
+                *args, stride=self._strides, pad=self._padding,
+                dilate=self._dilation, output_padding=self._output_padding,
+                groups=self._groups)
+        else:
+            args = (x, w) if b is None else (x, w, b)
+            out = npx.convolution(
+                *args, stride=self._strides, pad=self._padding,
+                dilate=self._dilation, groups=self._groups)
+        if self._activation:
+            out = npx.activation(out, self._activation)
+        return out
+
+    def __repr__(self):
+        return (f"{type(self).__name__}({self._channels}, "
+                f"kernel_size={self._kernel}, stride={self._strides})")
+
+
+class Conv1D(_Conv):
+    def __init__(self, channels, kernel_size, strides=1, padding=0,
+                 dilation=1, groups=1, layout="NCW", activation=None,
+                 use_bias=True, weight_initializer=None,
+                 bias_initializer="zeros", in_channels=0):
+        assert layout == "NCW", "only channels-first supported"
+        super().__init__(channels, kernel_size, strides, padding, dilation,
+                         groups, use_bias, in_channels, activation,
+                         weight_initializer, bias_initializer, 1)
+
+
+class Conv2D(_Conv):
+    def __init__(self, channels, kernel_size, strides=(1, 1), padding=(0, 0),
+                 dilation=(1, 1), groups=1, layout="NCHW", activation=None,
+                 use_bias=True, weight_initializer=None,
+                 bias_initializer="zeros", in_channels=0):
+        assert layout == "NCHW", "only channels-first supported"
+        super().__init__(channels, kernel_size, strides, padding, dilation,
+                         groups, use_bias, in_channels, activation,
+                         weight_initializer, bias_initializer, 2)
+
+
+class Conv3D(_Conv):
+    def __init__(self, channels, kernel_size, strides=(1, 1, 1),
+                 padding=(0, 0, 0), dilation=(1, 1, 1), groups=1,
+                 layout="NCDHW", activation=None, use_bias=True,
+                 weight_initializer=None, bias_initializer="zeros",
+                 in_channels=0):
+        assert layout == "NCDHW", "only channels-first supported"
+        super().__init__(channels, kernel_size, strides, padding, dilation,
+                         groups, use_bias, in_channels, activation,
+                         weight_initializer, bias_initializer, 3)
+
+
+class Conv1DTranspose(_Conv):
+    def __init__(self, channels, kernel_size, strides=1, padding=0,
+                 output_padding=0, dilation=1, groups=1, layout="NCW",
+                 activation=None, use_bias=True, weight_initializer=None,
+                 bias_initializer="zeros", in_channels=0):
+        assert layout == "NCW"
+        super().__init__(channels, kernel_size, strides, padding, dilation,
+                         groups, use_bias, in_channels, activation,
+                         weight_initializer, bias_initializer, 1,
+                         transpose=True, output_padding=output_padding)
+
+
+class Conv2DTranspose(_Conv):
+    def __init__(self, channels, kernel_size, strides=(1, 1), padding=(0, 0),
+                 output_padding=(0, 0), dilation=(1, 1), groups=1,
+                 layout="NCHW", activation=None, use_bias=True,
+                 weight_initializer=None, bias_initializer="zeros",
+                 in_channels=0):
+        assert layout == "NCHW"
+        super().__init__(channels, kernel_size, strides, padding, dilation,
+                         groups, use_bias, in_channels, activation,
+                         weight_initializer, bias_initializer, 2,
+                         transpose=True, output_padding=output_padding)
+
+
+class Conv3DTranspose(_Conv):
+    def __init__(self, channels, kernel_size, strides=(1, 1, 1),
+                 padding=(0, 0, 0), output_padding=(0, 0, 0),
+                 dilation=(1, 1, 1), groups=1, layout="NCDHW",
+                 activation=None, use_bias=True, weight_initializer=None,
+                 bias_initializer="zeros", in_channels=0):
+        assert layout == "NCDHW"
+        super().__init__(channels, kernel_size, strides, padding, dilation,
+                         groups, use_bias, in_channels, activation,
+                         weight_initializer, bias_initializer, 3,
+                         transpose=True, output_padding=output_padding)
+
+
+class _Pool(HybridBlock):
+    def __init__(self, pool_size, strides, padding, ndim, pool_type,
+                 global_pool=False, count_include_pad=True, ceil_mode=False):
+        super().__init__()
+        self._kernel = _tup(pool_size, ndim)
+        self._strides = _tup(strides if strides is not None else pool_size,
+                             ndim)
+        self._padding = _tup(padding, ndim)
+        self._pool_type = pool_type
+        self._global = global_pool
+        self._count_include_pad = count_include_pad
+        if ceil_mode:
+            raise NotImplementedError("ceil_mode pooling not supported")
+
+    def forward(self, x):
+        return npx.pooling(
+            x, kernel=self._kernel, pool_type=self._pool_type,
+            stride=self._strides, pad=self._padding,
+            global_pool=self._global,
+            count_include_pad=self._count_include_pad)
+
+    def __repr__(self):
+        return (f"{type(self).__name__}(size={self._kernel}, "
+                f"stride={self._strides}, padding={self._padding})")
+
+
+class MaxPool1D(_Pool):
+    def __init__(self, pool_size=2, strides=None, padding=0, layout="NCW",
+                 ceil_mode=False):
+        assert layout == "NCW"
+        super().__init__(pool_size, strides, padding, 1, "max",
+                         ceil_mode=ceil_mode)
+
+
+class MaxPool2D(_Pool):
+    def __init__(self, pool_size=(2, 2), strides=None, padding=0,
+                 layout="NCHW", ceil_mode=False):
+        assert layout == "NCHW"
+        super().__init__(pool_size, strides, padding, 2, "max",
+                         ceil_mode=ceil_mode)
+
+
+class MaxPool3D(_Pool):
+    def __init__(self, pool_size=(2, 2, 2), strides=None, padding=0,
+                 layout="NCDHW", ceil_mode=False):
+        assert layout == "NCDHW"
+        super().__init__(pool_size, strides, padding, 3, "max",
+                         ceil_mode=ceil_mode)
+
+
+class AvgPool1D(_Pool):
+    def __init__(self, pool_size=2, strides=None, padding=0, layout="NCW",
+                 ceil_mode=False, count_include_pad=True):
+        assert layout == "NCW"
+        super().__init__(pool_size, strides, padding, 1, "avg",
+                         count_include_pad=count_include_pad,
+                         ceil_mode=ceil_mode)
+
+
+class AvgPool2D(_Pool):
+    def __init__(self, pool_size=(2, 2), strides=None, padding=0,
+                 layout="NCHW", ceil_mode=False, count_include_pad=True):
+        assert layout == "NCHW"
+        super().__init__(pool_size, strides, padding, 2, "avg",
+                         count_include_pad=count_include_pad,
+                         ceil_mode=ceil_mode)
+
+
+class AvgPool3D(_Pool):
+    def __init__(self, pool_size=(2, 2, 2), strides=None, padding=0,
+                 layout="NCDHW", ceil_mode=False, count_include_pad=True):
+        assert layout == "NCDHW"
+        super().__init__(pool_size, strides, padding, 3, "avg",
+                         count_include_pad=count_include_pad,
+                         ceil_mode=ceil_mode)
+
+
+class _GlobalPool(_Pool):
+    def __init__(self, ndim, pool_type):
+        super().__init__(1, 1, 0, ndim, pool_type, global_pool=True)
+
+
+class GlobalMaxPool1D(_GlobalPool):
+    def __init__(self, layout="NCW"):
+        assert layout == "NCW"
+        super().__init__(1, "max")
+
+
+class GlobalMaxPool2D(_GlobalPool):
+    def __init__(self, layout="NCHW"):
+        assert layout == "NCHW"
+        super().__init__(2, "max")
+
+
+class GlobalMaxPool3D(_GlobalPool):
+    def __init__(self, layout="NCDHW"):
+        assert layout == "NCDHW"
+        super().__init__(3, "max")
+
+
+class GlobalAvgPool1D(_GlobalPool):
+    def __init__(self, layout="NCW"):
+        assert layout == "NCW"
+        super().__init__(1, "avg")
+
+
+class GlobalAvgPool2D(_GlobalPool):
+    def __init__(self, layout="NCHW"):
+        assert layout == "NCHW"
+        super().__init__(2, "avg")
+
+
+class GlobalAvgPool3D(_GlobalPool):
+    def __init__(self, layout="NCDHW"):
+        assert layout == "NCDHW"
+        super().__init__(3, "avg")
+
+
+class ReflectionPad2D(HybridBlock):
+    """Reflection padding (reference: nn.ReflectionPad2D)."""
+
+    def __init__(self, padding=0):
+        super().__init__()
+        self._padding = _tup(padding, 2)
+
+    def forward(self, x):
+        import jax.numpy as jnp
+
+        ph, pw = self._padding
+        return apply_op(
+            lambda v: jnp.pad(
+                v, ((0, 0), (0, 0), (ph, ph), (pw, pw)), mode="reflect"), x)

@@ -1,0 +1,284 @@
+"""Gluon Parameter (reference: python/mxnet/gluon/parameter.py:47).
+
+Holds weight data (+ gradient buffer) with deferred initialization: a
+Parameter created with unknown dims (0/-1/None) materializes on the first
+forward once the layer infers the full shape. Supports per-device copies for
+multi-device data-parallel training (the reference's `ctx` list), grad_req
+write/add/null, lr_mult/wd_mult, and trace mode (during CachedOp tracing the
+parameter temporarily exposes a jax tracer instead of its concrete buffer).
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as _np
+
+from .. import initializer as init_mod
+from ..base import DeferredInitializationError, normalize_dtype
+from ..device import Device, current_device
+from ..ndarray.ndarray import NDArray, _wrap_out
+
+__all__ = ["Parameter", "Constant"]
+
+
+def _shape_known(shape):
+    return shape is not None and all(
+        d is not None and int(d) > 0 for d in shape
+    )
+
+
+class Parameter:
+    def __init__(self, name="weight", grad_req="write", shape=None,
+                 dtype=_np.float32, lr_mult=1.0, wd_mult=1.0, init=None,
+                 allow_deferred_init=False, differentiable=True,
+                 stype="default", grad_stype="default"):  # noqa: ARG002
+        self._name = name
+        self._shape = tuple(shape) if shape is not None else None
+        self.dtype = normalize_dtype(dtype)
+        self.lr_mult = lr_mult
+        self.wd_mult = wd_mult
+        self.init = init
+        self.allow_deferred_init = allow_deferred_init
+        self.grad_req = grad_req if differentiable else "null"
+        self._data_map = None  # {Device: NDArray}
+        self._grad_map = None
+        self._ctx_list = None
+        self._deferred = None  # (init, device_list, default_init)
+        self._traced_data = None  # tracer visible during CachedOp tracing
+        self._structure = None  # (prefix path) set by Block registration
+
+    # -- identity ----------------------------------------------------------
+    @property
+    def name(self):
+        return self._name
+
+    @name.setter
+    def name(self, value):
+        self._name = value
+
+    @property
+    def shape(self):
+        return self._shape
+
+    @shape.setter
+    def shape(self, new_shape):
+        if self._shape is None:
+            self._shape = tuple(new_shape)
+            return
+        # fill unknown dims; known dims must match (reference shape merge)
+        merged = []
+        for old, new in zip(self._shape, new_shape):
+            if old in (0, -1, None):
+                merged.append(new)
+            else:
+                if new not in (0, -1, None) and int(old) != int(new):
+                    raise ValueError(
+                        f"Parameter {self._name}: shape mismatch "
+                        f"{self._shape} vs {tuple(new_shape)}")
+                merged.append(old)
+        self._shape = tuple(merged)
+
+    def __repr__(self):
+        return (f"Parameter {self._name} (shape={self._shape}, "
+                f"dtype={self.dtype})")
+
+    # -- initialization ----------------------------------------------------
+    def initialize(self, init=None, device=None, default_init=None,
+                   force_reinit=False, ctx=None):
+        if device is None:
+            device = ctx
+        if device is None:
+            device = current_device()
+        devices = device if isinstance(device, (list, tuple)) else [device]
+        devices = [d if isinstance(d, Device) else Device(d) for d in devices]
+        if self._data_map is not None and not force_reinit:
+            return
+        default_init = default_init or init_mod.Uniform()
+        if not _shape_known(self._shape):
+            if not self.allow_deferred_init:
+                raise ValueError(
+                    f"Cannot initialize Parameter {self._name}: unknown "
+                    f"shape {self._shape} and allow_deferred_init=False")
+            self._deferred = (init, devices, default_init)
+            return
+        self._finish_init(init, devices, default_init)
+
+    def _finish_init(self, init, devices, default_init):
+        initializer = init_mod.create(init) if init is not None else (
+            init_mod.create(self.init) if self.init is not None
+            else default_init)
+        master = initializer.init_array(self._name, self._shape, self.dtype)
+        self._ctx_list = list(devices)
+        self._data_map = {}
+        self._grad_map = {}
+        for d in devices:
+            self._data_map[d] = master.copyto(d)
+            if self.grad_req != "null":
+                g = _wrap_out(jnp.zeros(self._shape, self.dtype))
+                self._grad_map[d] = g.copyto(d)
+                self._data_map[d]._grad = self._grad_map[d]
+                self._data_map[d]._grad_req = self.grad_req
+        self._deferred = None
+
+    def _finish_deferred_init(self, shape=None):
+        """Complete deferred init once the full shape is known."""
+        if shape is not None:
+            self.shape = shape
+        if self._deferred is None:
+            raise DeferredInitializationError(
+                f"Parameter {self._name} was not initialized "
+                f"(call .initialize() first)")
+        if not _shape_known(self._shape):
+            raise DeferredInitializationError(
+                f"Parameter {self._name}: shape still unknown {self._shape}")
+        init, devices, default_init = self._deferred
+        self._finish_init(init, devices, default_init)
+
+    @property
+    def _is_deferred(self):
+        return self._data_map is None and self._deferred is not None
+
+    def _check_initialized(self, device=None):
+        if self._data_map is None:
+            if self._deferred is not None:
+                raise DeferredInitializationError(
+                    f"Parameter {self._name} deferred-init pending")
+            raise RuntimeError(
+                f"Parameter {self._name} has not been initialized. "
+                "Call .initialize() on the Block first")
+        if device is not None and device not in self._data_map:
+            raise RuntimeError(
+                f"Parameter {self._name} not initialized on {device}; "
+                f"it lives on {list(self._data_map)}")
+
+    # -- data access -------------------------------------------------------
+    def data(self, ctx=None, device=None):
+        """The parameter value on `device` (primary device by default).
+
+        During CachedOp tracing returns the traced stand-in (the analog of
+        the reference feeding param NDArrays as CachedOp inputs).
+        """
+        if self._traced_data is not None:
+            return self._traced_data
+        device = device if device is not None else ctx
+        self._check_initialized(
+            device if isinstance(device, Device) else None)
+        if device is None:
+            return self._data_map[self._ctx_list[0]]
+        if not isinstance(device, Device):
+            device = Device(device)
+        if device not in self._data_map:
+            raise RuntimeError(
+                f"Parameter {self._name} not initialized on {device}")
+        return self._data_map[device]
+
+    def data_for(self, x):
+        """Copy co-located with NDArray x (layers use this in forward)."""
+        if self._traced_data is not None:
+            return self._traced_data
+        self._check_initialized()
+        if len(self._data_map) == 1:
+            return self._data_map[self._ctx_list[0]]
+        dev = x.device
+        return self._data_map.get(dev, self._data_map[self._ctx_list[0]])
+
+    def list_data(self):
+        self._check_initialized()
+        return [self._data_map[d] for d in self._ctx_list]
+
+    def grad(self, ctx=None, device=None):
+        device = device if device is not None else ctx
+        self._check_initialized()
+        if self.grad_req == "null":
+            raise RuntimeError(
+                f"Parameter {self._name} has grad_req='null'")
+        if device is None:
+            return self._grad_map[self._ctx_list[0]]
+        if not isinstance(device, Device):
+            device = Device(device)
+        return self._grad_map[device]
+
+    def list_grad(self):
+        self._check_initialized()
+        return [self._grad_map[d] for d in self._ctx_list]
+
+    def list_ctx(self):
+        self._check_initialized()
+        return list(self._ctx_list)
+
+    list_device = list_ctx
+
+    def set_data(self, data):
+        """Set value on all devices (reference: Parameter.set_data)."""
+        if self._data_map is None:
+            if self._deferred is not None:
+                # deferred-init param: the incoming value fixes the shape
+                self.shape = data.shape
+                self._finish_deferred_init()
+                self.set_data(data)
+                return
+            raise RuntimeError(
+                f"Parameter {self._name} has not been initialized; call "
+                ".initialize() before set_data (reference parity)")
+        if not isinstance(data, NDArray):
+            data = NDArray(jnp.asarray(data, self.dtype))
+        for d in self._ctx_list:
+            arr = self._data_map[d]
+            arr._data = jnp.asarray(data._data, arr._data.dtype)
+            arr._version += 1
+
+    def zero_grad(self):
+        if self._grad_map:
+            for g in self._grad_map.values():
+                g._data = jnp.zeros_like(g._data)
+                g._version += 1
+
+    def reset_ctx(self, ctx=None, device=None):
+        device = device if device is not None else ctx
+        devices = device if isinstance(device, (list, tuple)) else [device]
+        devices = [d if isinstance(d, Device) else Device(d) for d in devices]
+        self._check_initialized()
+        master = self._data_map[self._ctx_list[0]]
+        self._ctx_list = devices
+        self._data_map = {d: master.copyto(d) for d in devices}
+        if self.grad_req != "null":
+            self._grad_map = {}
+            for d in devices:
+                g = _wrap_out(jnp.zeros(self._shape, self.dtype)).copyto(d)
+                self._grad_map[d] = g
+                self._data_map[d]._grad = g
+                self._data_map[d]._grad_req = self.grad_req
+
+    reset_device = reset_ctx
+
+    def cast(self, dtype):
+        dtype = normalize_dtype(dtype)
+        self.dtype = dtype
+        if self._data_map is not None:
+            for d, arr in self._data_map.items():
+                arr._data = arr._data.astype(dtype)
+                arr._version += 1
+            for g in (self._grad_map or {}).values():
+                g._data = g._data.astype(dtype)
+
+    # misc
+    def var(self):
+        raise NotImplementedError(
+            "symbol API not supported; use HybridBlock tracing")
+
+
+class Constant(Parameter):
+    """Non-learnable constant parameter (reference: gluon Constant)."""
+
+    def __init__(self, value, name="const"):
+        if not isinstance(value, NDArray):
+            value = NDArray(jnp.asarray(value))
+        super().__init__(name=name, grad_req="null", shape=value.shape,
+                         dtype=value.dtype,
+                         init=init_mod.Constant(0.0))
+        self._value = value
+
+    def _finish_init(self, init, devices, default_init):  # noqa: ARG002
+        self._ctx_list = list(devices)
+        self._data_map = {d: self._value.copyto(d) for d in devices}
+        self._grad_map = {}
+        self._deferred = None

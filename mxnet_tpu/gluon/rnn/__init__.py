@@ -1,0 +1,10 @@
+"""Recurrent layers (reference: python/mxnet/gluon/rnn/)."""
+from .rnn_cell import (  # noqa: F401
+    GRUCell,
+    HybridSequentialRNNCell,
+    LSTMCell,
+    RecurrentCell,
+    RNNCell,
+    SequentialRNNCell,
+)
+from .rnn_layer import GRU, LSTM, RNN  # noqa: F401

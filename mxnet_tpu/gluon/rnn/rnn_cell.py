@@ -1,0 +1,202 @@
+"""RNN cells for step-wise unrolling (reference: gluon/rnn/rnn_cell.py)."""
+from __future__ import annotations
+
+from ... import numpy as mnp
+from ... import numpy_extension as npx
+from ...ndarray.ndarray import apply_op
+from ..block import HybridBlock
+from ..parameter import Parameter
+
+__all__ = ["RecurrentCell", "RNNCell", "LSTMCell", "GRUCell",
+           "SequentialRNNCell", "HybridSequentialRNNCell"]
+
+
+class RecurrentCell(HybridBlock):
+    """Base cell: single-step forward(x_t, states) -> (out, states)."""
+
+    def state_info(self, batch_size=0):
+        raise NotImplementedError
+
+    def begin_state(self, batch_size=0, func=None, **kwargs):  # noqa: ARG002
+        return [mnp.zeros(info["shape"])
+                for info in self.state_info(batch_size)]
+
+    def unroll(self, length, inputs, begin_state=None, layout="NTC",
+               merge_outputs=None, valid_length=None):  # noqa: ARG002
+        """Python unroll over time steps (reference: RecurrentCell.unroll).
+
+        Under hybridize the whole unroll is traced into one XLA program —
+        the compiler pipelines the steps (no python overhead at run time).
+        """
+        axis = 1 if layout == "NTC" else 0
+        batch = inputs.shape[0 if layout == "NTC" else 1]
+        states = begin_state if begin_state is not None \
+            else self.begin_state(batch)
+        outputs = []
+        for t in range(length):
+            x_t = inputs[:, t] if axis == 1 else inputs[t]
+            out, states = self(x_t, states)
+            outputs.append(out)
+        if merge_outputs is False:
+            return outputs, states
+        stacked = mnp.stack(outputs, axis=axis)
+        return stacked, states
+
+
+class RNNCell(RecurrentCell):
+    def __init__(self, hidden_size, activation="tanh", input_size=0,
+                 i2h_weight_initializer=None, h2h_weight_initializer=None,
+                 i2h_bias_initializer="zeros", h2h_bias_initializer="zeros"):
+        super().__init__()
+        self._hidden = hidden_size
+        self._act = activation
+        self.i2h_weight = Parameter("i2h_weight",
+                                    shape=(hidden_size, input_size),
+                                    init=i2h_weight_initializer,
+                                    allow_deferred_init=True)
+        self.h2h_weight = Parameter("h2h_weight",
+                                    shape=(hidden_size, hidden_size),
+                                    init=h2h_weight_initializer)
+        self.i2h_bias = Parameter("i2h_bias", shape=(hidden_size,),
+                                  init=i2h_bias_initializer)
+        self.h2h_bias = Parameter("h2h_bias", shape=(hidden_size,),
+                                  init=h2h_bias_initializer)
+
+    def state_info(self, batch_size=0):
+        return [{"shape": (batch_size, self._hidden)}]
+
+    def forward(self, x, states):
+        if self.i2h_weight._is_deferred:
+            self.i2h_weight._finish_deferred_init(
+                (self._hidden, x.shape[-1]))
+        h = states[0]
+        i2h = npx.fully_connected(x, self.i2h_weight.data_for(x),
+                                  self.i2h_bias.data_for(x), flatten=False)
+        h2h = npx.fully_connected(h, self.h2h_weight.data_for(x),
+                                  self.h2h_bias.data_for(x), flatten=False)
+        out = npx.activation(i2h + h2h, self._act)
+        return out, [out]
+
+
+class LSTMCell(RecurrentCell):
+    def __init__(self, hidden_size, input_size=0,
+                 i2h_weight_initializer=None, h2h_weight_initializer=None,
+                 i2h_bias_initializer="zeros", h2h_bias_initializer="zeros"):
+        super().__init__()
+        self._hidden = hidden_size
+        self.i2h_weight = Parameter("i2h_weight",
+                                    shape=(4 * hidden_size, input_size),
+                                    init=i2h_weight_initializer,
+                                    allow_deferred_init=True)
+        self.h2h_weight = Parameter("h2h_weight",
+                                    shape=(4 * hidden_size, hidden_size),
+                                    init=h2h_weight_initializer)
+        self.i2h_bias = Parameter("i2h_bias", shape=(4 * hidden_size,),
+                                  init=i2h_bias_initializer)
+        self.h2h_bias = Parameter("h2h_bias", shape=(4 * hidden_size,),
+                                  init=h2h_bias_initializer)
+
+    def state_info(self, batch_size=0):
+        return [{"shape": (batch_size, self._hidden)},
+                {"shape": (batch_size, self._hidden)}]
+
+    def forward(self, x, states):
+        import jax
+        import jax.numpy as jnp
+
+        if self.i2h_weight._is_deferred:
+            self.i2h_weight._finish_deferred_init(
+                (4 * self._hidden, x.shape[-1]))
+
+        def fn(x_, h, c, wi, wh, bi, bh):
+            gates = x_ @ wi.T + bi + h @ wh.T + bh
+            i, f, g, o = jnp.split(gates, 4, axis=-1)
+            c_new = jax.nn.sigmoid(f) * c + jax.nn.sigmoid(i) * jnp.tanh(g)
+            h_new = jax.nn.sigmoid(o) * jnp.tanh(c_new)
+            return h_new, c_new
+
+        h, c = apply_op(fn, x, states[0], states[1],
+                        self.i2h_weight.data_for(x),
+                        self.h2h_weight.data_for(x),
+                        self.i2h_bias.data_for(x),
+                        self.h2h_bias.data_for(x), name="LSTMCell")
+        return h, [h, c]
+
+
+class GRUCell(RecurrentCell):
+    def __init__(self, hidden_size, input_size=0,
+                 i2h_weight_initializer=None, h2h_weight_initializer=None,
+                 i2h_bias_initializer="zeros", h2h_bias_initializer="zeros"):
+        super().__init__()
+        self._hidden = hidden_size
+        self.i2h_weight = Parameter("i2h_weight",
+                                    shape=(3 * hidden_size, input_size),
+                                    init=i2h_weight_initializer,
+                                    allow_deferred_init=True)
+        self.h2h_weight = Parameter("h2h_weight",
+                                    shape=(3 * hidden_size, hidden_size),
+                                    init=h2h_weight_initializer)
+        self.i2h_bias = Parameter("i2h_bias", shape=(3 * hidden_size,),
+                                  init=i2h_bias_initializer)
+        self.h2h_bias = Parameter("h2h_bias", shape=(3 * hidden_size,),
+                                  init=h2h_bias_initializer)
+
+    def state_info(self, batch_size=0):
+        return [{"shape": (batch_size, self._hidden)}]
+
+    def forward(self, x, states):
+        import jax
+        import jax.numpy as jnp
+
+        if self.i2h_weight._is_deferred:
+            self.i2h_weight._finish_deferred_init(
+                (3 * self._hidden, x.shape[-1]))
+
+        def fn(x_, h, wi, wh, bi, bh):
+            gi = x_ @ wi.T + bi
+            gh = h @ wh.T + bh
+            ir, iz, in_ = jnp.split(gi, 3, axis=-1)
+            hr, hz, hn = jnp.split(gh, 3, axis=-1)
+            r = jax.nn.sigmoid(ir + hr)
+            z = jax.nn.sigmoid(iz + hz)
+            n = jnp.tanh(in_ + r * hn)
+            return (1 - z) * n + z * h
+
+        h = apply_op(fn, x, states[0],
+                     self.i2h_weight.data_for(x),
+                     self.h2h_weight.data_for(x),
+                     self.i2h_bias.data_for(x),
+                     self.h2h_bias.data_for(x), name="GRUCell")
+        return h, [h]
+
+
+class SequentialRNNCell(RecurrentCell):
+    """Stack cells (reference: SequentialRNNCell)."""
+
+    def __init__(self):
+        super().__init__()
+
+    def add(self, cell):
+        self.register_child(cell)
+
+    def state_info(self, batch_size=0):
+        out = []
+        for cell in self._children.values():
+            out.extend(cell.state_info(batch_size))
+        return out
+
+    def __len__(self):
+        return len(self._children)
+
+    def forward(self, x, states):
+        next_states = []
+        p = 0
+        for cell in self._children.values():
+            n = len(cell.state_info())
+            x, new = cell(x, states[p : p + n])
+            p += n
+            next_states.extend(new)
+        return x, next_states
+
+
+HybridSequentialRNNCell = SequentialRNNCell

@@ -1,0 +1,162 @@
+"""Gluon Trainer (reference: python/mxnet/gluon/trainer.py:79).
+
+Applies an Optimizer to a set of Parameters, with gradient aggregation
+through a KVStore: per-device gradients are summed (pushpull) and every
+device's weight copy updated — the reference's `_allreduce_grads` +
+`_update` path (trainer.py:402,451). With kvstore='tpu_dist' the aggregation
+is an XLA collective; update_on_kvstore=True runs the optimizer inside the
+store (the dist server analog).
+"""
+from __future__ import annotations
+
+import pickle
+
+from .. import optimizer as opt_mod
+from ..kvstore import KVStoreBase, create as kv_create
+from ..ndarray.ndarray import NDArray
+from .parameter import Parameter
+
+__all__ = ["Trainer"]
+
+
+class Trainer:
+    def __init__(self, params, optimizer, optimizer_params=None, kvstore=None,
+                 compression_params=None, update_on_kvstore=None,
+                 batch_axis=0):  # noqa: ARG002
+        if isinstance(params, dict):
+            param_list = [params[k] for k in sorted(params)]
+            self._param_names = sorted(params)
+        elif isinstance(params, (list, tuple)):
+            param_list = list(params)
+            self._param_names = [p.name for p in param_list]
+        else:
+            raise ValueError("params must be dict/list of Parameters")
+        for p in param_list:
+            if not isinstance(p, Parameter):
+                raise ValueError(f"expected Parameter, got {type(p)}")
+        self._params = param_list
+        self._scale = 1.0
+        optimizer_params = optimizer_params or {}
+        self._optimizer = opt_mod.create(optimizer, **optimizer_params) \
+            if not isinstance(optimizer, opt_mod.Optimizer) else optimizer
+        self._optimizer.param_dict = dict(enumerate(self._params))
+        self._states = [None] * len(self._params)
+        self._states_created = [False] * len(self._params)
+        if isinstance(kvstore, KVStoreBase):
+            self._kvstore = kvstore
+        elif isinstance(kvstore, str) and kvstore not in (None, "None"):
+            self._kvstore = kv_create(kvstore)
+        else:
+            self._kvstore = None
+        self._update_on_kvstore = bool(update_on_kvstore) and \
+            self._kvstore is not None
+        if self._update_on_kvstore:
+            self._kvstore.set_optimizer(self._optimizer)
+        self._kv_initialized = False
+
+    @property
+    def optimizer(self):
+        return self._optimizer
+
+    @property
+    def learning_rate(self):
+        return self._optimizer.learning_rate
+
+    def set_learning_rate(self, lr):
+        self._optimizer.set_learning_rate(lr)
+
+    def _ensure_states(self, i, weight):
+        if not self._states_created[i]:
+            self._states[i] = self._optimizer.create_state_multi_precision(
+                i, weight)
+            self._states_created[i] = True
+
+    def allreduce_grads(self):
+        """Aggregate gradients across device copies via the kvstore
+        (reference: trainer.py:402 _allreduce_grads)."""
+        kv = self._kvstore
+        if kv is None:
+            return
+        distributed = getattr(kv, "num_workers", 1) > 1 or \
+            kv.is_capable("pushpull")
+        for i, p in enumerate(self._params):
+            if p.grad_req == "null":
+                continue
+            grads = p.list_grad()
+            if len(grads) == 1 and not distributed:
+                continue  # single copy, local store: nothing to reduce
+            kv.pushpull(i, grads, out=grads, priority=-i)
+
+    def step(self, batch_size, ignore_stale_grad=False):
+        """allreduce + optimizer update, scaling grads by 1/batch_size
+        (reference: trainer.py:341)."""
+        self._optimizer.rescale_grad = self._scale / batch_size
+        self.allreduce_grads()
+        self.update(batch_size, ignore_stale_grad, _skip_rescale=True)
+
+    def update(self, batch_size, ignore_stale_grad=False,
+               _skip_rescale=False):
+        if not _skip_rescale:
+            self._optimizer.rescale_grad = self._scale / batch_size
+        if not hasattr(self, "_grad_versions"):
+            self._grad_versions = {}
+        for i, p in enumerate(self._params):
+            if p.grad_req == "null":
+                continue
+            p._check_initialized()
+            for dev in p.list_ctx():
+                w = p.data(dev)
+                g = p.grad(dev)
+                # stale = grad buffer untouched since the last update
+                # (reference: Parameter._fresh_grad per-step flag)
+                fresh = self._grad_versions.get(i) != g._version
+                if not ignore_stale_grad or fresh:
+                    self._ensure_states(i, w)
+                    self._optimizer.update_multi_precision(
+                        i, w, g, self._states[i])
+                    self._grad_versions[i] = g._version
+                break  # update primary; replicate below
+            if len(p.list_ctx()) > 1:
+                primary = p.data(p.list_ctx()[0])
+                for dev in p.list_ctx()[1:]:
+                    primary.copyto(p.data(dev))
+
+    def zero_grad(self):
+        for p in self._params:
+            if p.grad_req != "null" and p._data_map is not None:
+                p.zero_grad()
+
+    # -- checkpoint --------------------------------------------------------
+    def save_states(self, fname):
+        """Serialize optimizer states (reference: trainer.py:489)."""
+        def to_np(s):
+            if s is None:
+                return None
+            if isinstance(s, NDArray):
+                return s.asnumpy()
+            return [to_np(x) for x in s]
+
+        payload = {
+            "states": [to_np(s) for s in self._states],
+            "created": list(self._states_created),
+            "num_update": self._optimizer.num_update,
+        }
+        with open(fname, "wb") as f:
+            pickle.dump(payload, f)
+
+    def load_states(self, fname):
+        import jax.numpy as jnp
+
+        with open(fname, "rb") as f:
+            payload = pickle.load(f)
+
+        def from_np(s):
+            if s is None:
+                return None
+            if isinstance(s, list):
+                return tuple(from_np(x) for x in s)
+            return NDArray(jnp.asarray(s))
+
+        self._states = [from_np(s) for s in payload["states"]]
+        self._states_created = list(payload["created"])
+        self._optimizer.num_update = payload["num_update"]

@@ -1,0 +1,235 @@
+"""Weight initializers (reference: python/mxnet/initializer.py, 832 LoC).
+
+Same registry + string-alias behavior: `net.initialize(init='xavier')` works.
+Initializers draw from the global stateful RNG (mx._random) so mx.seed()
+reproduces parameter init exactly.
+"""
+from __future__ import annotations
+
+import json
+import math
+
+import jax
+import jax.numpy as jnp
+
+from . import _random
+from .base import registry
+from .ndarray.ndarray import NDArray
+
+_REG = registry("initializer")
+
+__all__ = ["Initializer", "Zero", "One", "Constant", "Uniform", "Normal",
+           "Orthogonal", "Xavier", "MSRAPrelu", "Bilinear", "LSTMBias",
+           "register", "create"]
+
+
+def register(klass):
+    _REG.register(klass)
+    # also register lowercase short alias (Xavier -> xavier)
+    return klass
+
+
+def create(init, **kwargs):
+    if isinstance(init, Initializer):
+        return init
+    if isinstance(init, str):
+        if init.startswith("["):  # serialized [name, kwargs] form
+            name, kw = json.loads(init)
+            return _REG.create(name, **kw)
+        return _REG.create(init, **kwargs)
+    raise TypeError(f"cannot create initializer from {init!r}")
+
+
+class Initializer:
+    """Base initializer. Subclasses implement _init_weight(name, shape, dtype)
+    returning a jax array."""
+
+    def __init__(self, **kwargs):
+        self._kwargs = kwargs
+
+    def dumps(self):
+        return json.dumps([type(self).__name__.lower(), self._kwargs])
+
+    def __call__(self, name, arr=None):
+        """Initialize `arr` in place based on the parameter name's suffix,
+        mirroring reference dispatch (weight/bias/gamma/beta/...)."""
+        if arr is None:
+            name, arr = getattr(name, "name", str(name)), name
+            name = str(name)
+        shape, dtype = arr.shape, arr.dtype
+        lname = name.lower()
+        if lname.endswith("bias") or lname.endswith("beta") or \
+                lname.endswith("running_mean") or lname.endswith("moving_mean"):
+            data = jnp.zeros(shape, dtype)
+        elif lname.endswith("gamma") or lname.endswith("running_var") or \
+                lname.endswith("moving_var"):
+            data = jnp.ones(shape, dtype)
+        else:
+            data = self._init_weight(name, shape, dtype)
+        if isinstance(arr, NDArray):
+            arr._data = jnp.asarray(data, dtype)
+            arr._version += 1
+        return arr
+
+    def init_array(self, name, shape, dtype):
+        out = NDArray(jnp.zeros(shape, dtype))
+        self(name, out)
+        return out
+
+    def _init_weight(self, name, shape, dtype):
+        raise NotImplementedError
+
+    def __repr__(self):
+        return f"{type(self).__name__}({self._kwargs})"
+
+
+@register
+class Zero(Initializer):
+    def _init_weight(self, name, shape, dtype):
+        return jnp.zeros(shape, dtype)
+
+
+_REG.register(Zero, "zeros")
+
+
+@register
+class One(Initializer):
+    def _init_weight(self, name, shape, dtype):
+        return jnp.ones(shape, dtype)
+
+
+_REG.register(One, "ones")
+
+
+@register
+class Constant(Initializer):
+    def __init__(self, value=0.0):
+        super().__init__(value=value)
+        self.value = value
+
+    def _init_weight(self, name, shape, dtype):
+        return jnp.full(shape, self.value, dtype)
+
+
+@register
+class Uniform(Initializer):
+    def __init__(self, scale=0.07):
+        super().__init__(scale=scale)
+        self.scale = scale
+
+    def _init_weight(self, name, shape, dtype):
+        key = _random.next_key()
+        return jax.random.uniform(key, shape, jnp.float32, -self.scale,
+                                  self.scale).astype(dtype)
+
+
+@register
+class Normal(Initializer):
+    def __init__(self, sigma=0.01):
+        super().__init__(sigma=sigma)
+        self.sigma = sigma
+
+    def _init_weight(self, name, shape, dtype):
+        key = _random.next_key()
+        return (jax.random.normal(key, shape, jnp.float32)
+                * self.sigma).astype(dtype)
+
+
+@register
+class Orthogonal(Initializer):
+    def __init__(self, scale=1.414, rand_type="uniform"):
+        super().__init__(scale=scale, rand_type=rand_type)
+        self.scale = scale
+
+    def _init_weight(self, name, shape, dtype):
+        key = _random.next_key()
+        flat = (shape[0], int(jnp.prod(jnp.asarray(shape[1:]))))
+        out = jax.nn.initializers.orthogonal(self.scale)(key, flat, jnp.float32)
+        return out.reshape(shape).astype(dtype)
+
+
+def _fans(shape, factor_type):
+    hw = 1
+    for d in shape[2:]:
+        hw *= d
+    fan_out = shape[0] * hw
+    fan_in = (shape[1] if len(shape) > 1 else shape[0]) * hw
+    if factor_type == "avg":
+        return (fan_in + fan_out) / 2.0
+    if factor_type == "in":
+        return fan_in
+    return fan_out
+
+
+@register
+class Xavier(Initializer):
+    """Xavier/Glorot (reference initializer.py:Xavier; default for Gluon)."""
+
+    def __init__(self, rnd_type="uniform", factor_type="avg", magnitude=3):
+        super().__init__(rnd_type=rnd_type, factor_type=factor_type,
+                         magnitude=magnitude)
+        self.rnd_type = rnd_type
+        self.factor_type = factor_type
+        self.magnitude = magnitude
+
+    def _init_weight(self, name, shape, dtype):
+        factor = max(_fans(shape, self.factor_type), 1.0)
+        scale = math.sqrt(self.magnitude / factor)
+        key = _random.next_key()
+        if self.rnd_type == "uniform":
+            w = jax.random.uniform(key, shape, jnp.float32, -scale, scale)
+        else:
+            w = jax.random.normal(key, shape, jnp.float32) * scale
+        return w.astype(dtype)
+
+
+@register
+class MSRAPrelu(Xavier):
+    """He initialization (reference: MSRAPrelu)."""
+
+    def __init__(self, factor_type="avg", slope=0.25):
+        magnitude = 2.0 / (1 + slope ** 2)
+        super().__init__("gaussian", factor_type, magnitude)
+        self._kwargs = {"factor_type": factor_type, "slope": slope}
+
+
+@register
+class Bilinear(Initializer):
+    """Bilinear upsampling kernel (reference: Bilinear, for Deconvolution)."""
+
+    def _init_weight(self, name, shape, dtype):
+        import numpy as onp
+
+        weight = onp.zeros(shape, dtype="float32")
+        f = math.ceil(shape[3] / 2.0)
+        c = (2 * f - 1 - f % 2) / (2.0 * f)
+        for i in range(int(onp.prod(shape))):
+            x = i % shape[3]
+            y = (i // shape[3]) % shape[2]
+            flat = weight.reshape(-1)
+            flat[i] = (1 - abs(x / f - c)) * (1 - abs(y / f - c))
+        return jnp.asarray(weight, dtype)
+
+
+@register
+class LSTMBias(Initializer):
+    """Forget-gate bias = 1 (reference: LSTMBias)."""
+
+    def __init__(self, forget_bias=1.0):
+        super().__init__(forget_bias=forget_bias)
+        self.forget_bias = forget_bias
+
+    def _init_weight(self, name, shape, dtype):
+        b = jnp.zeros(shape, dtype)
+        n = shape[0] // 4
+        return b.at[n : 2 * n].set(self.forget_bias)
+
+
+# friendly aliases matching the reference registry
+_REG.register(Xavier, "xavier")
+_REG.register(MSRAPrelu, "msra")
+_REG.register(Normal, "gaussian")
+_REG.register(Uniform, "uniform")
+_REG.register(Normal, "normal")
+_REG.register(Zero, "zero")
+_REG.register(One, "one")

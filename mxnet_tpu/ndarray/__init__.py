@@ -1,0 +1,49 @@
+"""mx.nd — the legacy imperative NDArray namespace.
+
+Reference: python/mxnet/ndarray/ (24k LoC of generated wrappers). In this
+framework `mx.np` is the primary frontend; `mx.nd` re-exports the same NDArray
+plus the common creation/math functions under their legacy names so
+reference-era scripts keep working.
+"""
+from ..numpy import (  # noqa: F401
+    arange,
+    array,
+    concatenate,
+    full,
+    linspace,
+    ones,
+    ones_like,
+    zeros,
+    zeros_like,
+)
+from .ndarray import NDArray, apply_op, from_jax, waitall  # noqa: F401
+
+concat = concatenate
+
+# legacy op names commonly used in reference scripts
+from ..numpy import (  # noqa: F401,E402
+    abs,  # noqa: A004
+    add,
+    argmax,
+    argmin,
+    broadcast_to,
+    clip,
+    dot,
+    exp,
+    log,
+    maximum,
+    mean,
+    minimum,
+    multiply,
+    power,
+    sqrt,
+    square,
+    stack,
+    subtract,
+    sum,  # noqa: A004
+    tanh,
+    transpose,
+    where,
+)
+from ..numpy.random import normal as random_normal  # noqa: E402
+from ..numpy.random import uniform as random_uniform  # noqa: E402

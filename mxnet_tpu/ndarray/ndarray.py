@@ -1,0 +1,616 @@
+"""NDArray: an engine-tracked, mutable n-dim array over immutable jax.Arrays.
+
+Re-design of the reference NDArray (include/mxnet/ndarray.h:81,
+src/ndarray/ndarray.cc) for the XLA/PJRT world:
+
+  * the reference's Chunk{storage, Engine::Var} pair becomes a single
+    `jax.Array` handle — PJRT owns the HBM buffer, XLA tracks dependencies;
+  * mutation (`a[:]=v`, `a+=b`, fused optimizer updates) is implemented by
+    computing a fresh functional value and swapping the handle, bumping
+    `_version` — exactly the reference's `ThreadedVar::version_` bump on a
+    write dependency (src/engine/threaded_engine.h:122);
+  * eager ops dispatch through `apply_op`, which (a) unwraps inputs,
+    (b) runs the pure jax function (async on device), (c) wraps outputs, and
+    (d) when autograd is recording, routes the call through `jax.vjp` and
+    records a TapeNode — the analog of Imperative::Invoke + RecordOp
+    (src/imperative/imperative.cc:105,235);
+  * `wait_to_read` / `asnumpy` are the sync points, as in the reference
+    (ndarray.h:394; NDArray::SyncCopyToCPU).
+
+Sparse storage types (row_sparse/CSR) are intentionally NOT carried over:
+XLA has no sparse buffers; embedding-gradient style sparsity is handled by
+dense scatter-adds which XLA fuses. This is a documented capability decision,
+not an omission (SURVEY.md §7 hard part (c)).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as _np
+
+from .. import autograd as ag
+from .. import engine
+from ..base import MXNetError, normalize_dtype
+from ..device import Device, current_device, from_jax_device
+
+__all__ = ["NDArray", "apply_op", "array", "from_jax", "waitall"]
+
+_Tracer = jax.core.Tracer
+
+
+def _is_concrete(data):
+    return not isinstance(data, _Tracer)
+
+
+class NDArray:
+    """Mutable array facade over a jax.Array (or a tracer during jit tracing)."""
+
+    __array_priority__ = 1000.0
+
+    __slots__ = (
+        "_data",
+        "_device",
+        "_grad",
+        "_grad_req",
+        "_tape_entry",
+        "_version",
+        "__weakref__",
+    )
+
+    def __init__(self, data, device=None):
+        self._data = data
+        self._device = device
+        self._grad = None
+        self._grad_req = "null"
+        self._tape_entry = None
+        self._version = 0
+        if _is_concrete(data):
+            engine.track(self)
+
+    # ------------------------------------------------------------------
+    # metadata
+    # ------------------------------------------------------------------
+    @property
+    def shape(self):
+        return tuple(self._data.shape)
+
+    @property
+    def dtype(self):
+        return _np.dtype(self._data.dtype)
+
+    @property
+    def size(self):
+        s = 1
+        for d in self._data.shape:
+            s *= int(d)
+        return s
+
+    @property
+    def ndim(self):
+        return self._data.ndim
+
+    @property
+    def device(self):
+        if self._device is not None:
+            return self._device
+        if _is_concrete(self._data):
+            devs = getattr(self._data, "devices", None)
+            if devs is not None:
+                return from_jax_device(next(iter(self._data.devices())))
+        return current_device()
+
+    # reference-compat aliases
+    ctx = device
+    context = device
+
+    @property
+    def stype(self):
+        return "default"  # sparse storage types not supported (see module doc)
+
+    @property
+    def grad(self):
+        return self._grad
+
+    @property
+    def _requires_grad_entry(self):
+        """True if ops consuming this array must be taped."""
+        return self._tape_entry is not None or (
+            self._grad is not None and self._grad_req != "null"
+        )
+
+    # ------------------------------------------------------------------
+    # sync / host transfer
+    # ------------------------------------------------------------------
+    def wait_to_read(self):
+        engine.wait_to_read(self)
+        return self
+
+    def wait_to_write(self):
+        engine.wait_to_read(self)
+        return self
+
+    def asnumpy(self):
+        """Blocking copy to host numpy (reference: NDArray::SyncCopyToCPU)."""
+        return _np.asarray(self._data)
+
+    def item(self):
+        return self.asnumpy().item()
+
+    def asscalar(self):
+        if self.size != 1:
+            raise ValueError("The current array is not a scalar")
+        return self.item()
+
+    def __float__(self):
+        return float(self.item())
+
+    def __int__(self):
+        return int(self.item())
+
+    def __bool__(self):
+        if self.size == 0:
+            return False
+        if self.size == 1:
+            return bool(self.item())
+        raise ValueError(
+            "The truth value of an array with more than one element is ambiguous"
+        )
+
+    def __len__(self):
+        if self.ndim == 0:
+            raise TypeError("len() of unsized object")
+        return self.shape[0]
+
+    def __iter__(self):
+        for i in range(len(self)):
+            yield self[i]
+
+    def __repr__(self):
+        if _is_concrete(self._data):
+            return f"{self.asnumpy()!r} <NDArray {self.shape} @{self.device}>"
+        return f"<NDArray traced {self.shape} {self.dtype}>"
+
+    # numpy protocol
+    def __array__(self, dtype=None, copy=None):  # noqa: ARG002
+        a = self.asnumpy()
+        return a.astype(dtype) if dtype is not None else a
+
+    def __dlpack__(self, **kwargs):
+        return self._data.__dlpack__(**kwargs)
+
+    def __dlpack_device__(self):
+        return self._data.__dlpack_device__()
+
+    # ------------------------------------------------------------------
+    # autograd surface
+    # ------------------------------------------------------------------
+    def attach_grad(self, grad_req="write", stype=None):  # noqa: ARG002
+        """Attach a zero-initialized gradient buffer (reference:
+        python/mxnet/ndarray/ndarray.py attach_grad)."""
+        self._grad = _wrap_out(jnp.zeros_like(self._data))
+        self._grad_req = grad_req
+        self._tape_entry = None
+        return self
+
+    def drop_grad(self):
+        self._grad = None
+        self._grad_req = "null"
+
+    def backward(self, out_grad=None, retain_graph=False, train_mode=True):
+        ag.backward([self], [out_grad], retain_graph=retain_graph,
+                    train_mode=train_mode)
+
+    def detach(self):
+        out = NDArray(self._data, self._device)
+        return out
+
+    # ------------------------------------------------------------------
+    # device movement / copies
+    # ------------------------------------------------------------------
+    def as_in_context(self, device):
+        return self.as_in_ctx(device)
+
+    def as_in_ctx(self, device):
+        device = Device(device) if not isinstance(device, Device) else device
+        if self.device == device:
+            return self
+        return self.copyto(device)
+
+    to_device = as_in_ctx
+
+    def copyto(self, other):
+        """Copy to a device or into another NDArray (reference: CopyFromTo,
+        src/ndarray/ndarray.cc:1370)."""
+        if isinstance(other, Device):
+            data = jax.device_put(self._data, other.jax_device)
+            return NDArray(data, other)
+        if isinstance(other, NDArray):
+            other._data = jax.device_put(self._data, other.device.jax_device)
+            other._version += 1
+            return other
+        raise TypeError(f"copyto does not support type {type(other)}")
+
+    def copy(self):
+        return _wrap_out(jnp.copy(self._data), self._device)
+
+    def astype(self, dtype, copy=True):
+        dtype = normalize_dtype(dtype)
+        if not copy and self.dtype == dtype:
+            return self
+        return apply_op(lambda x: x.astype(dtype), self)
+
+    def as_np_ndarray(self):
+        return self
+
+    def as_nd_ndarray(self):
+        return self
+
+    # ------------------------------------------------------------------
+    # shape manipulation (differentiable, taped via apply_op)
+    # ------------------------------------------------------------------
+    def reshape(self, *shape, **kwargs):  # noqa: ARG002
+        if len(shape) == 1 and isinstance(shape[0], (tuple, list)):
+            shape = tuple(shape[0])
+        return apply_op(lambda x: jnp.reshape(x, shape), self)
+
+    def transpose(self, *axes):
+        if len(axes) == 1 and isinstance(axes[0], (tuple, list)):
+            axes = tuple(axes[0])
+        ax = axes if axes else None
+        return apply_op(lambda x: jnp.transpose(x, ax), self)
+
+    @property
+    def T(self):
+        return self.transpose()
+
+    def flatten(self):
+        return self.reshape((-1,))
+
+    def squeeze(self, axis=None):
+        return apply_op(lambda x: jnp.squeeze(x, axis), self)
+
+    def expand_dims(self, axis):
+        return apply_op(lambda x: jnp.expand_dims(x, axis), self)
+
+    def swapaxes(self, a1, a2):
+        return apply_op(lambda x: jnp.swapaxes(x, a1, a2), self)
+
+    def repeat(self, repeats, axis=None):
+        return apply_op(lambda x: jnp.repeat(x, repeats, axis), self)
+
+    def broadcast_to(self, shape):
+        return apply_op(lambda x: jnp.broadcast_to(x, shape), self)
+
+    def split(self, indices_or_sections, axis=0):
+        return apply_op(
+            lambda x: tuple(jnp.split(x, indices_or_sections, axis)), self
+        )
+
+    def take(self, indices, axis=None, mode="clip"):
+        return apply_op(
+            lambda x, i: jnp.take(x, i, axis=axis, mode=mode), self, indices
+        )
+
+    def clip(self, a_min=None, a_max=None):
+        return apply_op(lambda x: jnp.clip(x, a_min, a_max), self)
+
+    def zeros_like(self):
+        return _wrap_out(jnp.zeros_like(self._data), self._device)
+
+    def ones_like(self):
+        return _wrap_out(jnp.ones_like(self._data), self._device)
+
+    def tolist(self):
+        return self.asnumpy().tolist()
+
+    # reductions / common math as methods
+    def sum(self, axis=None, keepdims=False, dtype=None):
+        return apply_op(
+            lambda x: jnp.sum(x, axis=axis, keepdims=keepdims,
+                              dtype=normalize_dtype(dtype)), self)
+
+    def mean(self, axis=None, keepdims=False, dtype=None):
+        return apply_op(
+            lambda x: jnp.mean(x, axis=axis, keepdims=keepdims,
+                               dtype=normalize_dtype(dtype)), self)
+
+    def max(self, axis=None, keepdims=False):
+        return apply_op(lambda x: jnp.max(x, axis=axis, keepdims=keepdims), self)
+
+    def min(self, axis=None, keepdims=False):
+        return apply_op(lambda x: jnp.min(x, axis=axis, keepdims=keepdims), self)
+
+    def prod(self, axis=None, keepdims=False):
+        return apply_op(lambda x: jnp.prod(x, axis=axis, keepdims=keepdims), self)
+
+    def argmax(self, axis=None):
+        return apply_op(lambda x: jnp.argmax(x, axis=axis), self)
+
+    def argmin(self, axis=None):
+        return apply_op(lambda x: jnp.argmin(x, axis=axis), self)
+
+    def std(self, axis=None, ddof=0, keepdims=False):
+        return apply_op(
+            lambda x: jnp.std(x, axis=axis, ddof=ddof, keepdims=keepdims), self)
+
+    def var(self, axis=None, ddof=0, keepdims=False):
+        return apply_op(
+            lambda x: jnp.var(x, axis=axis, ddof=ddof, keepdims=keepdims), self)
+
+    def cumsum(self, axis=None, dtype=None):
+        return apply_op(
+            lambda x: jnp.cumsum(x, axis=axis, dtype=normalize_dtype(dtype)), self)
+
+    def dot(self, other):
+        return apply_op(jnp.dot, self, other)
+
+    def abs(self):
+        return apply_op(jnp.abs, self)
+
+    def sqrt(self):
+        return apply_op(jnp.sqrt, self)
+
+    def exp(self):
+        return apply_op(jnp.exp, self)
+
+    def log(self):
+        return apply_op(jnp.log, self)
+
+    def round(self, decimals=0):
+        return apply_op(lambda x: jnp.round(x, decimals), self)
+
+    def sigmoid(self):
+        return apply_op(jax.nn.sigmoid, self)
+
+    def relu(self):
+        return apply_op(jax.nn.relu, self)
+
+    def tanh(self):
+        return apply_op(jnp.tanh, self)
+
+    def norm(self, ord=None, axis=None, keepdims=False):
+        return apply_op(
+            lambda x: jnp.linalg.norm(x, ord=ord, axis=axis, keepdims=keepdims),
+            self)
+
+    # ------------------------------------------------------------------
+    # indexing
+    # ------------------------------------------------------------------
+    def _index(self, key):
+        if isinstance(key, NDArray):
+            return key._data
+        if isinstance(key, tuple):
+            return tuple(k._data if isinstance(k, NDArray) else k for k in key)
+        return key
+
+    def __getitem__(self, key):
+        key = self._index(key)
+        return apply_op(lambda x: x[key], self)
+
+    def __setitem__(self, key, value):
+        """In-place write: functional scatter + handle swap + version bump."""
+        key = self._index(key)
+        if isinstance(value, NDArray):
+            new = apply_op(
+                lambda x, v: x.at[key].set(v.astype(x.dtype)), self, value)
+        else:
+            new = apply_op(lambda x: x.at[key].set(value), self)
+        self._assign_from(new)
+
+    def _assign_from(self, other):
+        self._data = other._data
+        self._tape_entry = other._tape_entry
+        self._version += 1
+
+    # ------------------------------------------------------------------
+    # arithmetic operators
+    # ------------------------------------------------------------------
+    def _binary(self, other, fn, reverse=False):
+        if isinstance(other, NDArray):
+            if reverse:
+                return apply_op(fn, other, self)
+            return apply_op(fn, self, other)
+        if reverse:
+            return apply_op(lambda x: fn(other, x), self)
+        return apply_op(lambda x: fn(x, other), self)
+
+    def __add__(self, o):
+        return self._binary(o, jnp.add)
+
+    __radd__ = __add__
+
+    def __sub__(self, o):
+        return self._binary(o, jnp.subtract)
+
+    def __rsub__(self, o):
+        return self._binary(o, jnp.subtract, reverse=True)
+
+    def __mul__(self, o):
+        return self._binary(o, jnp.multiply)
+
+    __rmul__ = __mul__
+
+    def __truediv__(self, o):
+        return self._binary(o, jnp.divide)
+
+    def __rtruediv__(self, o):
+        return self._binary(o, jnp.divide, reverse=True)
+
+    def __floordiv__(self, o):
+        return self._binary(o, jnp.floor_divide)
+
+    def __rfloordiv__(self, o):
+        return self._binary(o, jnp.floor_divide, reverse=True)
+
+    def __mod__(self, o):
+        return self._binary(o, jnp.mod)
+
+    def __rmod__(self, o):
+        return self._binary(o, jnp.mod, reverse=True)
+
+    def __pow__(self, o):
+        return self._binary(o, jnp.power)
+
+    def __rpow__(self, o):
+        return self._binary(o, jnp.power, reverse=True)
+
+    def __matmul__(self, o):
+        return self._binary(o, jnp.matmul)
+
+    def __rmatmul__(self, o):
+        return self._binary(o, jnp.matmul, reverse=True)
+
+    def __neg__(self):
+        return apply_op(jnp.negative, self)
+
+    def __pos__(self):
+        return self
+
+    def __abs__(self):
+        return apply_op(jnp.abs, self)
+
+    def __invert__(self):
+        return apply_op(jnp.invert, self)
+
+    # comparisons
+    def __eq__(self, o):
+        return self._binary(o, lambda a, b: a == b)
+
+    def __ne__(self, o):
+        return self._binary(o, lambda a, b: a != b)
+
+    def __lt__(self, o):
+        return self._binary(o, lambda a, b: a < b)
+
+    def __le__(self, o):
+        return self._binary(o, lambda a, b: a <= b)
+
+    def __gt__(self, o):
+        return self._binary(o, lambda a, b: a > b)
+
+    def __ge__(self, o):
+        return self._binary(o, lambda a, b: a >= b)
+
+    __hash__ = object.__hash__
+
+    # logical
+    def __and__(self, o):
+        return self._binary(o, jnp.bitwise_and)
+
+    def __or__(self, o):
+        return self._binary(o, jnp.bitwise_or)
+
+    def __xor__(self, o):
+        return self._binary(o, jnp.bitwise_xor)
+
+    # in-place: compute functionally, swap handle (version bump)
+    def _inplace(self, other, fn):
+        new = self._binary(other, fn)
+        self._assign_from(new)
+        return self
+
+    def __iadd__(self, o):
+        return self._inplace(o, jnp.add)
+
+    def __isub__(self, o):
+        return self._inplace(o, jnp.subtract)
+
+    def __imul__(self, o):
+        return self._inplace(o, jnp.multiply)
+
+    def __itruediv__(self, o):
+        return self._inplace(o, jnp.divide)
+
+    def __imod__(self, o):
+        return self._inplace(o, jnp.mod)
+
+
+# ---------------------------------------------------------------------------
+# op application (the Imperative::Invoke analog)
+# ---------------------------------------------------------------------------
+
+def _wrap_out(data, device=None):
+    return NDArray(data, device)
+
+
+def apply_op(fn, *args, name=None):
+    """Run pure jax function `fn` over NDArray/raw args; tape when recording.
+
+    `fn` receives raw jax arrays in the positions where NDArrays were passed;
+    other args go through untouched. Returns NDArray or tuple of NDArrays,
+    mirroring fn's output structure.
+    """
+    nd_pos = [i for i, a in enumerate(args) if isinstance(a, NDArray)]
+    datas = [args[i]._data for i in nd_pos]
+
+    if len(nd_pos) == len(args):
+        pure = fn
+    else:
+        def pure(*xs):
+            call = list(args)
+            for i, x in zip(nd_pos, xs):
+                call[i] = x
+            return fn(*call)
+
+    record = ag.taping_active() and any(
+        args[i]._requires_grad_entry for i in nd_pos
+    )
+
+    if record:
+        out, vjp_fn = jax.vjp(pure, *datas)
+    else:
+        out = pure(*datas)
+
+    multi = isinstance(out, (tuple, list))
+    outs = list(out) if multi else [out]
+    wrapped = [_wrap_out(o) for o in outs]
+
+    if record:
+        nd_inputs = [args[i] for i in nd_pos]
+        node = ag.TapeNode(
+            vjp_fn,
+            nd_inputs,
+            [a._tape_entry for a in nd_inputs],
+            [(tuple(o.shape), o.dtype) for o in outs],
+            multi_out=multi,
+            name=name or getattr(fn, "__name__", "op"),
+        )
+        for idx, w in enumerate(wrapped):
+            w._tape_entry = (node, idx)
+
+    return tuple(wrapped) if multi else wrapped[0]
+
+
+# ---------------------------------------------------------------------------
+# creation
+# ---------------------------------------------------------------------------
+
+def _creation_device(device):
+    if device is None:
+        return current_device()
+    return device if isinstance(device, Device) else Device(device)
+
+
+def from_jax(data, device=None):
+    return NDArray(data, device)
+
+
+def array(source, dtype=None, device=None, ctx=None):
+    """Create an NDArray on `device` from array-like/NDArray."""
+    device = _creation_device(device if device is not None else ctx)
+    dtype = normalize_dtype(dtype)
+    if isinstance(source, NDArray):
+        data = source._data
+        if dtype is not None and data.dtype != dtype:
+            data = data.astype(dtype)
+        return NDArray(jax.device_put(data, device.jax_device), device)
+    arr = _np.asarray(source)
+    if dtype is None and arr.dtype == _np.float64:
+        dtype = _np.dtype(_np.float32)  # reference default dtype is float32
+    if dtype is not None:
+        arr = arr.astype(dtype)
+    return NDArray(jax.device_put(arr, device.jax_device), device)
+
+
+def waitall():
+    engine.waitall()

@@ -1,0 +1,12 @@
+"""mx.np — NumPy-compatible frontend (the Gluon-2.0 default array API).
+
+Re-design of the reference's `python/mxnet/numpy/` (multiarray.py 13k LoC of
+generated `_npi_*` wrappers): instead of codegen over an NNVM registry, ops are
+generated over `jax.numpy` by `multiarray._make_np_module`, with handwritten
+creation/random/linalg where device placement or MXNet semantics differ.
+Every function dispatches through `apply_op`, so it is taped under
+autograd.record() and traceable under hybridize/jit.
+"""
+from . import linalg, random  # noqa: F401
+from .multiarray import *  # noqa: F401,F403
+from .multiarray import __all__, ndarray  # noqa: F401

@@ -1,0 +1,49 @@
+"""mx.np.linalg — linear algebra over jnp.linalg / XLA.
+
+Reference: src/operator/numpy/linalg/ (`_npi_*` linalg ops backed by
+LAPACK/cuSOLVER) and the `la_op` suite (potrf, gelqf, syrk...). On TPU these
+lower to XLA's decomposition HLOs; MXU handles the inner gemms.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ..ndarray.ndarray import apply_op
+
+_FNS = """
+cholesky det slogdet eig eigh eigvals eigvalsh inv lstsq matrix_power
+matrix_rank norm pinv qr solve svd svdvals tensorinv tensorsolve cond
+multi_dot matrix_norm vector_norm cross outer matmul trace diagonal
+""".split()
+
+__all__ = list(_FNS)
+
+
+def _wrap(name):
+    jfn = getattr(jnp.linalg, name)
+
+    def fn(*args, **kwargs):
+        from ..ndarray.ndarray import NDArray
+
+        nd_args = [a for a in args if isinstance(a, NDArray)]
+        if not nd_args:
+            out = jfn(*args, **kwargs)
+            if isinstance(out, tuple):
+                return tuple(NDArray(o) for o in out)
+            return NDArray(out)
+
+        def pure(*xs):
+            it = iter(xs)
+            call = [next(it) if isinstance(a, NDArray) else a for a in args]
+            out = jfn(*call, **kwargs)
+            return tuple(out) if isinstance(out, tuple) else out
+
+        return apply_op(pure, *nd_args, name=f"linalg.{name}")
+
+    fn.__name__ = name
+    return fn
+
+
+for _name in _FNS:
+    if hasattr(jnp.linalg, _name):
+        globals()[_name] = _wrap(_name)

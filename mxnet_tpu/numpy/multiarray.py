@@ -1,0 +1,360 @@
+"""mx.np function corpus.
+
+Generated wrappers over jax.numpy (see _UNARY/_BINARY/_REDUCE/_OTHER lists)
+plus handwritten creation ops honoring the current Device, mirroring the
+reference's `python/mxnet/numpy/multiarray.py` + function_base/creation
+namespaces (139 `_npi_*` C++ ops, SURVEY.md §2.2).
+"""
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as _np
+
+from ..base import normalize_dtype
+from ..device import Device, current_device
+from ..ndarray.ndarray import NDArray, apply_op
+from ..ndarray.ndarray import array as _nd_array
+
+ndarray = NDArray
+
+pi = _np.pi
+e = _np.e
+euler_gamma = _np.euler_gamma
+inf = _np.inf
+nan = _np.nan
+newaxis = None
+
+_DTYPE_KW = ("dtype",)
+
+
+def _fix_kwargs(kwargs):
+    if "ctx" in kwargs:
+        kwargs.pop("ctx")
+    if "device" in kwargs:
+        kwargs.pop("device")
+    if "out" in kwargs and kwargs["out"] is None:
+        kwargs.pop("out")
+    if "dtype" in kwargs:
+        kwargs["dtype"] = normalize_dtype(kwargs["dtype"])
+    return kwargs
+
+
+def _wrap_jnp(jnp_fn, n_array_args):
+    """Make an mx.np function from a jnp function.
+
+    The first `n_array_args` positional args are treated as (potential)
+    arrays and routed through apply_op; everything else is closed over.
+    """
+
+    @functools.wraps(jnp_fn)
+    def wrapped(*args, **kwargs):
+        kwargs = _fix_kwargs(dict(kwargs))
+        arr_args = args[:n_array_args]
+        rest = args[n_array_args:]
+        nd_args = [a for a in arr_args if isinstance(a, NDArray)]
+        if not nd_args:
+            # pure python/numpy inputs: still produce an NDArray
+            out = jnp_fn(*args, **kwargs)
+        else:
+            def fn(*xs):
+                it = iter(xs)
+                call = [
+                    next(it) if isinstance(a, NDArray) else a for a in arr_args
+                ]
+                return jnp_fn(*call, *rest, **kwargs)
+
+            return apply_op(fn, *nd_args, name=jnp_fn.__name__)
+        if isinstance(out, (tuple, list)):
+            return tuple(NDArray(o) for o in out)
+        return NDArray(out)
+
+    return wrapped
+
+
+# --- generated corpus ------------------------------------------------------
+_UNARY = """
+abs absolute arccos arccosh arcsin arcsinh arctan arctanh bitwise_invert
+bitwise_not cbrt ceil conj conjugate cos cosh degrees exp exp2 expm1 fabs
+floor invert isfinite isinf isnan isneginf isposinf log log10 log1p log2
+logical_not negative positive radians reciprocal rint sign signbit sin sinh
+sqrt square tan tanh trunc angle real imag i0 sinc nan_to_num
+""".split()
+
+_BINARY = """
+add arctan2 bitwise_and bitwise_or bitwise_xor copysign divide equal
+float_power floor_divide fmax fmin fmod gcd greater greater_equal heaviside
+hypot lcm ldexp left_shift less less_equal logaddexp logaddexp2 logical_and
+logical_or logical_xor maximum minimum mod multiply not_equal power remainder
+right_shift subtract true_divide divmod pow
+""".split()
+
+_REDUCE = """
+all any amax amin argmax argmin cumprod cumsum max mean median min nanargmax
+nanargmin nancumprod nancumsum nanmax nanmean nanmedian nanmin nanprod nanstd
+nansum nanvar prod ptp std sum var count_nonzero average quantile percentile
+""".split()
+
+# functions whose first arg is an array; extra args may be arrays too but the
+# common case is handled: we scan the first 4 positional args for NDArrays.
+_OTHER = """
+reshape ravel transpose swapaxes moveaxis rollaxis squeeze expand_dims
+broadcast_to broadcast_arrays flip fliplr flipud rot90 roll
+concatenate stack vstack hstack dstack column_stack split array_split hsplit
+vsplit dsplit tile repeat pad
+take take_along_axis put_along_axis choose compress extract searchsorted
+argsort sort lexsort partition argpartition flatnonzero nonzero argwhere where
+diag diagflat diagonal trace tril triu tri eye identity vander
+dot vdot inner outer matmul tensordot einsum kron cross
+clip round around floor_divide
+unique union1d intersect1d setdiff1d setxor1d in1d isin
+atleast_1d atleast_2d atleast_3d
+meshgrid indices unravel_index ravel_multi_index diag_indices
+tril_indices triu_indices
+histogram histogram2d histogramdd bincount digitize corrcoef cov
+convolve correlate interp gradient diff ediff1d trapezoid
+polyval polyfit roots
+sort_complex real_if_close
+isclose allclose array_equal array_equiv
+cumulative_sum
+flatnonzero packbits unpackbits
+apply_along_axis
+nanquantile nanpercentile
+insert delete append resize trim_zeros
+fill_diagonal
+select piecewise
+""".split()
+
+__all__ = ["ndarray", "array", "zeros", "ones", "empty", "full", "arange",
+           "linspace", "logspace", "zeros_like", "ones_like", "full_like",
+           "empty_like", "asarray", "ascontiguousarray", "frombuffer",
+           "copy", "may_share_memory", "shares_memory", "astype", "abs",
+           "shape", "ndim", "size", "result_type", "can_cast", "promote_types",
+           "dtype", "finfo", "iinfo", "bool_", "pi", "e", "inf", "nan",
+           "newaxis", "euler_gamma",
+           "float16", "float32", "float64", "bfloat16", "int8", "int16",
+           "int32", "int64", "uint8", "uint16", "uint32", "uint64"]
+
+_g = globals()
+for _name in set(_UNARY):
+    if hasattr(jnp, _name):
+        _g[_name] = _wrap_jnp(getattr(jnp, _name), 1)
+        __all__.append(_name)
+for _name in set(_BINARY):
+    if hasattr(jnp, _name):
+        _g[_name] = _wrap_jnp(getattr(jnp, _name), 2)
+        __all__.append(_name)
+for _name in set(_REDUCE):
+    if hasattr(jnp, _name):
+        _g[_name] = _wrap_jnp(getattr(jnp, _name), 1)
+        __all__.append(_name)
+for _name in set(_OTHER):
+    if _name in _g:
+        continue
+    if hasattr(jnp, _name):
+        _g[_name] = _wrap_jnp(getattr(jnp, _name), 4)
+        __all__.append(_name)
+
+
+def _seq_wrap(jnp_fn):
+    """Wrapper for functions taking a sequence of arrays first (concat etc.)."""
+
+    @functools.wraps(jnp_fn)
+    def wrapped(seq, *args, **kwargs):
+        kwargs = _fix_kwargs(dict(kwargs))
+        seq = list(seq)
+        nd_args = [a for a in seq if isinstance(a, NDArray)]
+        if not nd_args:
+            return NDArray(jnp_fn(seq, *args, **kwargs))
+
+        def fn(*xs):
+            it = iter(xs)
+            call = [next(it) if isinstance(a, NDArray) else a for a in seq]
+            return jnp_fn(call, *args, **kwargs)
+
+        return apply_op(fn, *nd_args, name=jnp_fn.__name__)
+
+    return wrapped
+
+
+for _name in ("concatenate", "stack", "vstack", "hstack", "dstack",
+              "column_stack", "meshgrid", "broadcast_arrays", "block"):
+    if hasattr(jnp, _name):
+        _g[_name] = _seq_wrap(getattr(jnp, _name))
+        if _name not in __all__:
+            __all__.append(_name)
+
+concat = _g.get("concatenate")
+
+
+def einsum(subscripts, *operands, **kwargs):
+    """Einstein summation (reference: np_einsum_op with path optimizer —
+    here XLA does the contraction-order optimization)."""
+    kwargs = _fix_kwargs(dict(kwargs))
+    nd_args = [a for a in operands if isinstance(a, NDArray)]
+
+    def fn(*xs):
+        it = iter(xs)
+        call = [next(it) if isinstance(a, NDArray) else a for a in operands]
+        return jnp.einsum(subscripts, *call, **kwargs)
+
+    if not nd_args:
+        return NDArray(jnp.einsum(subscripts, *operands, **kwargs))
+    return apply_op(fn, *nd_args, name="einsum")
+
+
+# --- dtypes (exported like numpy scalars) ---------------------------------
+float16 = _np.float16
+float32 = _np.float32
+float64 = _np.float64
+int8 = _np.int8
+int16 = _np.int16
+int32 = _np.int32
+int64 = _np.int64
+uint8 = _np.uint8
+uint16 = _np.uint16
+uint32 = _np.uint32
+uint64 = _np.uint64
+bool_ = _np.bool_
+try:
+    import ml_dtypes
+
+    bfloat16 = ml_dtypes.bfloat16
+except ImportError:  # pragma: no cover
+    bfloat16 = None
+
+dtype = _np.dtype
+finfo = jnp.finfo
+iinfo = jnp.iinfo
+result_type = jnp.result_type
+can_cast = jnp.can_cast
+promote_types = jnp.promote_types
+
+
+# --- creation --------------------------------------------------------------
+
+def _device_of(kwargs):
+    dev = kwargs.pop("device", None)
+    if dev is None:
+        dev = kwargs.pop("ctx", None)
+    if dev is None:
+        return current_device()
+    return dev if isinstance(dev, Device) else Device(dev)
+
+
+def array(object, dtype=None, **kwargs):  # noqa: A002
+    return _nd_array(object, dtype=dtype, device=_device_of(kwargs))
+
+
+def asarray(a, dtype=None, **kwargs):
+    if isinstance(a, NDArray) and (dtype is None or a.dtype == normalize_dtype(dtype)):
+        return a
+    return array(a, dtype=dtype, **kwargs)
+
+
+ascontiguousarray = asarray
+
+
+def frombuffer(buffer, dtype=float, **kwargs):
+    return array(_np.frombuffer(buffer, dtype=dtype), **kwargs)
+
+
+def _creation(jnp_fn):
+    def fn(shape, dtype=None, order="C", **kwargs):  # noqa: ARG001
+        dev = _device_of(kwargs)
+        dtype = normalize_dtype(dtype) or _np.float32
+        data = jax.device_put(jnp_fn(shape, dtype), dev.jax_device)
+        return NDArray(data, dev)
+
+    return fn
+
+
+zeros = _creation(jnp.zeros)
+ones = _creation(jnp.ones)
+empty = _creation(jnp.zeros)  # XLA has no uninitialized buffers
+
+
+def full(shape, fill_value, dtype=None, order="C", **kwargs):  # noqa: ARG001
+    dev = _device_of(kwargs)
+    if isinstance(fill_value, NDArray):
+        fill_value = fill_value._data
+    data = jnp.full(shape, fill_value, normalize_dtype(dtype))
+    return NDArray(jax.device_put(data, dev.jax_device), dev)
+
+
+def zeros_like(a, dtype=None, **kwargs):  # noqa: ARG001
+    x = a._data if isinstance(a, NDArray) else a
+    return NDArray(jnp.zeros_like(x, dtype=normalize_dtype(dtype)))
+
+
+def ones_like(a, dtype=None, **kwargs):  # noqa: ARG001
+    x = a._data if isinstance(a, NDArray) else a
+    return NDArray(jnp.ones_like(x, dtype=normalize_dtype(dtype)))
+
+
+def full_like(a, fill_value, dtype=None, **kwargs):  # noqa: ARG001
+    x = a._data if isinstance(a, NDArray) else a
+    return NDArray(jnp.full_like(x, fill_value, dtype=normalize_dtype(dtype)))
+
+
+def empty_like(a, dtype=None, **kwargs):
+    return zeros_like(a, dtype=dtype, **kwargs)
+
+
+def arange(start, stop=None, step=1, dtype=None, **kwargs):
+    dev = _device_of(kwargs)
+    data = jnp.arange(start, stop, step, normalize_dtype(dtype))
+    if data.dtype == jnp.float64:
+        data = data.astype(jnp.float32)
+    return NDArray(jax.device_put(data, dev.jax_device), dev)
+
+
+def linspace(start, stop, num=50, endpoint=True, retstep=False, dtype=None,
+             axis=0, **kwargs):
+    dev = _device_of(kwargs)
+    out = jnp.linspace(start, stop, num, endpoint=endpoint, retstep=retstep,
+                       dtype=normalize_dtype(dtype), axis=axis)
+    if retstep:
+        return NDArray(jax.device_put(out[0], dev.jax_device), dev), out[1]
+    return NDArray(jax.device_put(out, dev.jax_device), dev)
+
+
+def logspace(start, stop, num=50, endpoint=True, base=10.0, dtype=None,
+             axis=0, **kwargs):
+    dev = _device_of(kwargs)
+    out = jnp.logspace(start, stop, num, endpoint=endpoint, base=base,
+                       dtype=normalize_dtype(dtype), axis=axis)
+    return NDArray(jax.device_put(out, dev.jax_device), dev)
+
+
+def copy(a):
+    return a.copy() if isinstance(a, NDArray) else array(a)
+
+
+def astype(a, dtype):
+    return a.astype(dtype)
+
+
+def shape(a):
+    return a.shape if isinstance(a, NDArray) else _np.shape(a)
+
+
+def ndim(a):
+    return a.ndim if isinstance(a, NDArray) else _np.ndim(a)
+
+
+def size(a, axis=None):
+    if isinstance(a, NDArray):
+        return a.size if axis is None else a.shape[axis]
+    return _np.size(a, axis)
+
+
+def may_share_memory(a, b, max_work=None):  # noqa: ARG001
+    da = a._data if isinstance(a, NDArray) else a
+    db = b._data if isinstance(b, NDArray) else b
+    return da is db
+
+
+shares_memory = may_share_memory

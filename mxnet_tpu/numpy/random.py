@@ -1,0 +1,235 @@
+"""mx.np.random — numpy-compatible random sampling over jax PRNG.
+
+Reference: src/operator/numpy/random/ (`_npi_*` sampling ops) and
+python/mxnet/numpy/random.py. Stateful global key lives in mx._random.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as _np
+
+from .. import _random
+from ..base import normalize_dtype
+from ..ndarray.ndarray import NDArray
+
+__all__ = ["seed", "uniform", "normal", "randn", "rand", "randint", "choice",
+           "shuffle", "permutation", "gamma", "beta", "exponential", "poisson",
+           "bernoulli", "binomial", "negative_binomial", "multinomial",
+           "multivariate_normal", "laplace", "logistic", "gumbel", "pareto",
+           "power", "rayleigh", "weibull", "lognormal", "chisquare", "f",
+           "standard_normal", "standard_cauchy", "standard_exponential"]
+
+seed = _random.seed
+
+
+def _shape(size):
+    if size is None:
+        return ()
+    if isinstance(size, int):
+        return (size,)
+    return tuple(size)
+
+
+def _f32(dtype):
+    d = normalize_dtype(dtype)
+    return _np.dtype(_np.float32) if d is None else d
+
+
+def _unwrap(x):
+    return x._data if isinstance(x, NDArray) else x
+
+
+def uniform(low=0.0, high=1.0, size=None, dtype=None, **kwargs):  # noqa: ARG001
+    key = _random.next_key()
+    out = jax.random.uniform(key, _shape(size), _f32(dtype),
+                             minval=_unwrap(low), maxval=_unwrap(high))
+    return NDArray(out)
+
+
+def normal(loc=0.0, scale=1.0, size=None, dtype=None, **kwargs):  # noqa: ARG001
+    key = _random.next_key()
+    out = jax.random.normal(key, _shape(size), _f32(dtype))
+    return NDArray(out * _unwrap(scale) + _unwrap(loc))
+
+
+def standard_normal(size=None, dtype=None):
+    return normal(0.0, 1.0, size, dtype)
+
+
+def randn(*shape):
+    return normal(size=shape)
+
+
+def rand(*shape):
+    return uniform(size=shape)
+
+
+def randint(low, high=None, size=None, dtype=None, **kwargs):  # noqa: ARG001
+    if high is None:
+        low, high = 0, low
+    d = normalize_dtype(dtype) or _np.dtype(_np.int32)
+    key = _random.next_key()
+    out = jax.random.randint(key, _shape(size), int(low), int(high), dtype=d)
+    return NDArray(out)
+
+
+def choice(a, size=None, replace=True, p=None, **kwargs):  # noqa: ARG001
+    key = _random.next_key()
+    a_ = _unwrap(a)
+    if isinstance(a_, int):
+        a_ = jnp.arange(a_)
+    out = jax.random.choice(key, a_, _shape(size), replace=replace,
+                            p=_unwrap(p) if p is not None else None)
+    return NDArray(out)
+
+
+def permutation(x):
+    key = _random.next_key()
+    x_ = _unwrap(x)
+    if isinstance(x_, int):
+        x_ = jnp.arange(x_)
+    return NDArray(jax.random.permutation(key, x_))
+
+
+def shuffle(x):
+    """In-place shuffle along the first axis (reference: _npi_shuffle)."""
+    key = _random.next_key()
+    x._data = jax.random.permutation(key, x._data)
+    x._version += 1
+
+
+def gamma(shape, scale=1.0, size=None, dtype=None, **kwargs):  # noqa: ARG001
+    key = _random.next_key()
+    sz = _shape(size) if size is not None else jnp.shape(_unwrap(shape))
+    out = jax.random.gamma(key, _unwrap(shape), sz, _f32(dtype))
+    return NDArray(out * _unwrap(scale))
+
+
+def beta(a, b, size=None, dtype=None):
+    key = _random.next_key()
+    sz = _shape(size) if size is not None else None
+    return NDArray(jax.random.beta(key, _unwrap(a), _unwrap(b), sz, _f32(dtype)))
+
+
+def exponential(scale=1.0, size=None, dtype=None):
+    key = _random.next_key()
+    return NDArray(jax.random.exponential(key, _shape(size), _f32(dtype))
+                   * _unwrap(scale))
+
+
+standard_exponential = exponential
+
+
+def poisson(lam=1.0, size=None, dtype=None):
+    key = _random.next_key()
+    d = normalize_dtype(dtype) or _np.dtype(_np.int32)
+    return NDArray(jax.random.poisson(key, _unwrap(lam), _shape(size), d))
+
+
+def bernoulli(prob=None, logit=None, size=None, dtype=None):
+    key = _random.next_key()
+    if prob is None:
+        prob = jax.nn.sigmoid(_unwrap(logit))
+    else:
+        prob = _unwrap(prob)
+    sz = _shape(size) if size is not None else jnp.shape(prob)
+    out = jax.random.bernoulli(key, prob, sz)
+    return NDArray(out.astype(_f32(dtype)))
+
+
+def binomial(n, p, size=None, dtype=None):
+    key = _random.next_key()
+    sz = _shape(size) if size is not None else None
+    out = jax.random.binomial(key, _unwrap(n), _unwrap(p), shape=sz)
+    d = normalize_dtype(dtype)
+    return NDArray(out if d is None else out.astype(d))
+
+
+def negative_binomial(n, p, size=None, dtype=None):  # noqa: ARG001
+    # NB(n,p) = Poisson(Gamma(n, (1-p)/p))
+    key1 = _random.next_key()
+    key2 = _random.next_key()
+    n_, p_ = _unwrap(n), _unwrap(p)
+    sz = _shape(size)
+    lam = jax.random.gamma(key1, n_, sz) * ((1.0 - p_) / p_)
+    return NDArray(jax.random.poisson(key2, lam))
+
+
+def multinomial(n, pvals, size=None):
+    key = _random.next_key()
+    sz = _shape(size)
+    out = jax.random.multinomial(key, n, jnp.asarray(_unwrap(pvals)),
+                                 shape=sz + jnp.shape(_unwrap(pvals)) if sz else None)
+    return NDArray(out)
+
+
+def multivariate_normal(mean, cov, size=None, check_valid=None, tol=None):  # noqa: ARG001
+    key = _random.next_key()
+    sz = _shape(size) if size is not None else None
+    out = jax.random.multivariate_normal(key, _unwrap(mean), _unwrap(cov),
+                                         shape=sz)
+    return NDArray(out)
+
+
+def laplace(loc=0.0, scale=1.0, size=None, dtype=None):
+    key = _random.next_key()
+    out = jax.random.laplace(key, _shape(size), _f32(dtype))
+    return NDArray(out * _unwrap(scale) + _unwrap(loc))
+
+
+def logistic(loc=0.0, scale=1.0, size=None, dtype=None):
+    key = _random.next_key()
+    out = jax.random.logistic(key, _shape(size), _f32(dtype))
+    return NDArray(out * _unwrap(scale) + _unwrap(loc))
+
+
+def gumbel(loc=0.0, scale=1.0, size=None, dtype=None):
+    key = _random.next_key()
+    out = jax.random.gumbel(key, _shape(size), _f32(dtype))
+    return NDArray(out * _unwrap(scale) + _unwrap(loc))
+
+
+def pareto(a, size=None, dtype=None):
+    key = _random.next_key()
+    return NDArray(jax.random.pareto(key, _unwrap(a), _shape(size), _f32(dtype))
+                   - 1.0)
+
+
+def power(a, size=None, dtype=None):
+    key = _random.next_key()
+    u = jax.random.uniform(key, _shape(size), _f32(dtype))
+    return NDArray(u ** (1.0 / _unwrap(a)))
+
+
+def rayleigh(scale=1.0, size=None, dtype=None):
+    key = _random.next_key()
+    u = jax.random.uniform(key, _shape(size), _f32(dtype))
+    return NDArray(_unwrap(scale) * jnp.sqrt(-2.0 * jnp.log1p(-u)))
+
+
+def weibull(a, size=None, dtype=None):
+    key = _random.next_key()
+    return NDArray(jax.random.weibull_min(key, 1.0, _unwrap(a), _shape(size),
+                                          _f32(dtype)))
+
+
+def lognormal(mean=0.0, sigma=1.0, size=None, dtype=None):
+    return normal(mean, sigma, size, dtype).exp()
+
+
+def chisquare(df, size=None, dtype=None):
+    key = _random.next_key()
+    return NDArray(jax.random.chisquare(key, _unwrap(df), shape=_shape(size),
+                                        dtype=_f32(dtype)))
+
+
+def f(dfnum, dfden, size=None, dtype=None):
+    key = _random.next_key()
+    return NDArray(jax.random.f(key, _unwrap(dfnum), _unwrap(dfden),
+                                shape=_shape(size), dtype=_f32(dtype)))
+
+
+def standard_cauchy(size=None, dtype=None):
+    key = _random.next_key()
+    return NDArray(jax.random.cauchy(key, _shape(size), _f32(dtype)))

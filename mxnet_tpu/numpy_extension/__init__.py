@@ -1,0 +1,153 @@
+"""mx.npx — operators beyond the NumPy standard (NN primitives etc.).
+
+Reference: python/mxnet/numpy_extension/ (the `_npx_*` namespace: activation,
+batch_norm, convolution, pooling, fully_connected, embedding, topk, pick,
+one_hot, sequence ops...). Here each wraps a pure op from mxnet_tpu.ops.nn via
+apply_op, so they are taped and traceable.
+"""
+from __future__ import annotations
+
+import functools
+
+from .. import _random
+from ..autograd import is_training
+from ..ndarray.ndarray import NDArray, apply_op
+from ..ops import nn as _nn
+
+__all__ = [
+    "activation", "leaky_relu", "relu", "sigmoid", "softmax", "log_softmax",
+    "softmin", "fully_connected", "convolution", "deconvolution", "pooling",
+    "batch_norm", "layer_norm", "group_norm", "instance_norm", "rms_norm",
+    "lrn", "dropout", "embedding", "one_hot", "pick", "topk", "sequence_mask",
+    "sequence_last", "sequence_reverse", "l2_normalization", "upsampling",
+    "moments", "gamma", "erf", "erfinv", "set_np", "reset_np", "is_np_array",
+    "is_np_shape", "use_np", "cpu", "gpu", "tpu", "num_gpus", "current_device",
+    "waitall",
+]
+
+
+def _op(fn, n_arrays):
+    @functools.wraps(fn)
+    def wrapped(*args, **kwargs):
+        arrs = args[:n_arrays]
+        rest = args[n_arrays:]
+        nd = [a for a in arrs if isinstance(a, NDArray)]
+
+        def pure(*xs):
+            it = iter(xs)
+            call = [next(it) if isinstance(a, NDArray) else a for a in arrs]
+            return fn(*call, *rest, **kwargs)
+
+        return apply_op(pure, *nd, name=fn.__name__)
+
+    return wrapped
+
+
+activation = _op(_nn.activation, 1)
+leaky_relu = _op(_nn.leaky_relu, 2)
+softmax = _op(_nn.softmax, 1)
+log_softmax = _op(_nn.log_softmax, 1)
+softmin = _op(_nn.softmin, 1)
+fully_connected = _op(_nn.dense, 3)
+convolution = _op(_nn.conv, 3)
+deconvolution = _op(_nn.conv_transpose, 3)
+pooling = _op(_nn.pool, 1)
+layer_norm = _op(_nn.layer_norm, 3)
+group_norm = _op(_nn.group_norm, 3)
+instance_norm = _op(_nn.instance_norm, 3)
+rms_norm = _op(_nn.rms_norm, 2)
+lrn = _op(_nn.lrn, 1)
+embedding = _op(_nn.embedding, 2)
+one_hot = _op(_nn.one_hot, 1)
+pick = _op(_nn.pick, 2)
+topk = _op(_nn.topk, 1)
+sequence_mask = _op(_nn.sequence_mask, 2)
+sequence_last = _op(_nn.sequence_last, 2)
+sequence_reverse = _op(_nn.sequence_reverse, 2)
+l2_normalization = _op(_nn.l2_normalization, 1)
+upsampling = _op(_nn.upsample, 1)
+moments = _op(_nn.moments, 1)
+
+
+def relu(x):
+    return activation(x, "relu")
+
+
+def sigmoid(x):
+    return activation(x, "sigmoid")
+
+
+def batch_norm(x, gamma, beta, running_mean, running_var, eps=1e-5,
+               momentum=0.9, fix_gamma=False, use_global_stats=False,
+               output_mean_var=False, axis=1):
+    """Eager batch_norm; updates running stats in place like the reference op
+    (mutable aux inputs of nn/batch_norm.cc)."""
+    training = is_training() and not use_global_stats
+    if fix_gamma:
+        gamma = gamma.ones_like()
+    out, nm, nv = _op(_nn.batch_norm, 5)(
+        x, gamma, beta, running_mean, running_var, eps=eps, momentum=momentum,
+        training=training, use_global_stats=use_global_stats, axis=axis)
+    if training:
+        running_mean._assign_from(nm.detach())
+        running_var._assign_from(nv.detach())
+    if output_mean_var:
+        return out, nm, nv
+    return out
+
+
+def dropout(x, p=0.5, axes=None, mode="training"):
+    training = is_training() or mode == "always"
+    if not training or p <= 0:
+        return x
+    key = _random.next_key()
+    return _op(_nn.dropout, 1)(x, key, p=p, training=True, axes=axes)
+
+
+def gamma(x):
+    import jax.scipy.special as jsp
+
+    return apply_op(lambda v: jsp.gamma(v) if hasattr(jsp, "gamma")
+                    else __import__("jax.numpy", fromlist=["exp"]).exp(jsp.gammaln(v)), x)
+
+
+def erf(x):
+    import jax.scipy.special as jsp
+
+    return apply_op(jsp.erf, x)
+
+
+def erfinv(x):
+    import jax.scipy.special as jsp
+
+    return apply_op(jsp.erfinv, x)
+
+
+# --- npx namespace/device utilities (API parity) ---------------------------
+from ..device import cpu, current_device, gpu, num_gpus, tpu  # noqa: E402
+from ..engine import waitall  # noqa: E402
+
+_np_active = True
+
+
+def set_np(shape=True, array=True, dtype=False):  # noqa: ARG001
+    """Parity no-op: this framework is numpy-semantics native."""
+    global _np_active
+    _np_active = True
+
+
+def reset_np():
+    set_np()
+
+
+def is_np_array():
+    return _np_active
+
+
+def is_np_shape():
+    return _np_active
+
+
+def use_np(func):
+    """Decorator parity with npx.use_np — identity here."""
+    return func

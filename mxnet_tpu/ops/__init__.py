@@ -1,0 +1,14 @@
+"""Pure-jax op implementations — the kernel corpus.
+
+This package is the TPU analog of the reference's `src/operator/` (225k LoC of
+C++/CUDA kernels): every function here is a *pure* function of jax arrays,
+lowered by XLA onto the MXU/VPU, fused automatically. The NDArray/np frontends
+wrap these through `apply_op` for eager+taped execution; Gluon layers call
+them directly inside traced forwards.
+
+Layout convention: NCHW/NCW/NCDHW ("channels first"), matching the reference's
+default conv/pool layout so model code ports unchanged. XLA transposes
+internally to its preferred layout at negligible cost on TPU.
+"""
+from . import nn  # noqa: F401
+from .registry import list_ops, register_op, get_op  # noqa: F401

@@ -1,0 +1,499 @@
+"""Neural-net primitive ops as pure jax functions (NCHW layouts).
+
+TPU re-design of src/operator/nn/ (convolution, fully_connected, pooling,
+batch_norm, layer_norm, softmax, activation, dropout...): each op is a pure
+function lowered by XLA — conv → MXU convolution HLO, pooling →
+reduce_window, norms → fused VPU chains. cuDNN/oneDNN dispatch layers are
+unnecessary.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from .registry import register_op
+
+# ---------------------------------------------------------------------------
+# dense / linear
+# ---------------------------------------------------------------------------
+
+
+@register_op("FullyConnected")
+def dense(x, weight, bias=None, flatten=True):
+    """y = x @ W^T + b (reference: src/operator/nn/fully_connected.cc).
+
+    weight layout (out_units, in_units) matches the reference so checkpoints
+    map 1:1. With flatten=True input is reshaped to (N, -1) first.
+    """
+    if flatten and x.ndim > 2:
+        x = x.reshape(x.shape[0], -1)
+    y = jnp.matmul(x, weight.T)
+    if bias is not None:
+        y = y + bias
+    return y
+
+
+# ---------------------------------------------------------------------------
+# convolution
+# ---------------------------------------------------------------------------
+
+def _spec(ndim):
+    # NC + spatial; kernel OI + spatial
+    sp = "DHW"[-ndim:] if ndim <= 3 else None
+    return ("NC" + sp, "OI" + sp, "NC" + sp)
+
+
+@register_op("Convolution")
+def conv(x, weight, bias=None, stride=None, pad=None, dilate=None, groups=1):
+    """N-d convolution, NC+spatial layout, weight (O, I/g, *k).
+
+    Reference: src/operator/nn/convolution.cc. Lowers to a single XLA
+    conv_general_dilated → MXU.
+    """
+    nd = x.ndim - 2
+    stride = stride or (1,) * nd
+    pad = pad or (0,) * nd
+    dilate = dilate or (1,) * nd
+    if isinstance(stride, int):
+        stride = (stride,) * nd
+    if isinstance(pad, int):
+        pad = (pad,) * nd
+    if isinstance(dilate, int):
+        dilate = (dilate,) * nd
+    dn = lax.conv_dimension_numbers(x.shape, weight.shape, _spec(nd))
+    y = lax.conv_general_dilated(
+        x,
+        weight,
+        window_strides=tuple(stride),
+        padding=[(p, p) for p in pad],
+        rhs_dilation=tuple(dilate),
+        dimension_numbers=dn,
+        feature_group_count=groups,
+        preferred_element_type=None,
+    )
+    if bias is not None:
+        y = y + bias.reshape((1, -1) + (1,) * nd)
+    return y
+
+
+@register_op("Deconvolution")
+def conv_transpose(x, weight, bias=None, stride=None, pad=None, dilate=None,
+                   output_padding=None, groups=1):
+    """Transposed convolution (reference: src/operator/nn/deconvolution.cc).
+
+    weight (I, O/g, *k) like the reference; implemented as the gradient of
+    conv via lax.conv_transpose with IO spatial kernel spec.
+    """
+    nd = x.ndim - 2
+    stride = stride or (1,) * nd
+    pad = pad or (0,) * nd
+    output_padding = output_padding or (0,) * nd
+    if isinstance(stride, int):
+        stride = (stride,) * nd
+    if isinstance(pad, int):
+        pad = (pad,) * nd
+    if isinstance(output_padding, int):
+        output_padding = (output_padding,) * nd
+    sp = "DHW"[-nd:]
+    dn = lax.conv_dimension_numbers(
+        x.shape, weight.shape, ("NC" + sp, "IO" + sp, "NC" + sp)
+    )
+    k = weight.shape[2:]
+    # padding for transpose conv: k - 1 - p on both sides, + output_padding low
+    padding = [
+        (ki - 1 - pi, ki - 1 - pi + opi)
+        for ki, pi, opi in zip(k, pad, output_padding)
+    ]
+    y = lax.conv_general_dilated(
+        x,
+        weight,
+        window_strides=(1,) * nd,
+        padding=padding,
+        lhs_dilation=tuple(stride),
+        dimension_numbers=dn,
+        feature_group_count=groups,
+    )
+    if bias is not None:
+        y = y + bias.reshape((1, -1) + (1,) * nd)
+    return y
+
+
+# ---------------------------------------------------------------------------
+# pooling
+# ---------------------------------------------------------------------------
+
+
+@register_op("Pooling")
+def pool(x, kernel, pool_type="max", stride=None, pad=None, global_pool=False,
+         count_include_pad=True):
+    """Max/avg/lp pooling via reduce_window (reference: nn/pooling.cc)."""
+    nd = x.ndim - 2
+    if global_pool:
+        kernel = x.shape[2:]
+        stride = (1,) * nd
+        pad = (0,) * nd
+    if isinstance(kernel, int):
+        kernel = (kernel,) * nd
+    stride = stride or kernel
+    if isinstance(stride, int):
+        stride = (stride,) * nd
+    pad = pad or (0,) * nd
+    if isinstance(pad, int):
+        pad = (pad,) * nd
+    window = (1, 1) + tuple(kernel)
+    strides = (1, 1) + tuple(stride)
+    padding = ((0, 0), (0, 0)) + tuple((p, p) for p in pad)
+    if pool_type == "max":
+        init = -jnp.inf if jnp.issubdtype(x.dtype, jnp.floating) else jnp.iinfo(x.dtype).min
+        return lax.reduce_window(x, init, lax.max, window, strides, padding)
+    if pool_type in ("avg", "sum"):
+        s = lax.reduce_window(x, 0.0 if jnp.issubdtype(x.dtype, jnp.floating)
+                              else 0, lax.add, window, strides, padding)
+        if pool_type == "sum":
+            return s
+        if count_include_pad:
+            denom = 1
+            for k in kernel:
+                denom *= k
+            return s / denom
+        ones = jnp.ones(x.shape[2:], x.dtype)[None, None]
+        counts = lax.reduce_window(ones, 0.0, lax.add, window, strides, padding)
+        return s / counts
+    if pool_type == "lp":
+        p = 2.0
+        s = lax.reduce_window(jnp.abs(x) ** p, 0.0, lax.add, window, strides,
+                              padding)
+        return s ** (1.0 / p)
+    raise ValueError(f"unknown pool_type {pool_type}")
+
+
+# ---------------------------------------------------------------------------
+# normalization
+# ---------------------------------------------------------------------------
+
+
+@register_op("BatchNorm")
+def batch_norm(x, gamma, beta, moving_mean, moving_var, eps=1e-5,
+               momentum=0.9, training=True, use_global_stats=False, axis=1):
+    """Batch normalization (reference: nn/batch_norm.cc).
+
+    Returns (out, new_mean, new_var). The stateful moving-stat update is done
+    by the caller (BatchNorm layer / state sink), keeping this function pure.
+    """
+    reduce_axes = tuple(i for i in range(x.ndim) if i != axis)
+    bshape = [1] * x.ndim
+    bshape[axis] = x.shape[axis]
+    if training and not use_global_stats:
+        mean = jnp.mean(x, axis=reduce_axes)
+        var = jnp.var(x, axis=reduce_axes)
+        new_mean = moving_mean * momentum + mean * (1 - momentum)
+        new_var = moving_var * momentum + var * (1 - momentum)
+    else:
+        mean, var = moving_mean, moving_var
+        new_mean, new_var = moving_mean, moving_var
+    inv = lax.rsqrt(var.astype(jnp.float32) + eps).astype(x.dtype)
+    out = (x - mean.reshape(bshape).astype(x.dtype)) * inv.reshape(bshape)
+    out = out * gamma.reshape(bshape).astype(x.dtype) + beta.reshape(bshape).astype(x.dtype)
+    return out, new_mean, new_var
+
+
+@register_op("LayerNorm")
+def layer_norm(x, gamma, beta, axis=-1, eps=1e-5):
+    """Layer normalization (reference: nn/layer_norm.cc)."""
+    mean = jnp.mean(x, axis=axis, keepdims=True)
+    var = jnp.var(x, axis=axis, keepdims=True)
+    out = (x - mean) * lax.rsqrt(var + eps)
+    if gamma is not None:
+        bshape = [1] * x.ndim
+        bshape[axis] = x.shape[axis]
+        out = out * gamma.reshape(bshape)
+        if beta is not None:
+            out = out + beta.reshape(bshape)
+    return out
+
+
+@register_op("GroupNorm")
+def group_norm(x, gamma, beta, num_groups, eps=1e-5):
+    """Group normalization over NC+spatial (reference: nn/group_norm.cc)."""
+    n, c = x.shape[:2]
+    g = num_groups
+    xg = x.reshape((n, g, c // g) + x.shape[2:])
+    axes = tuple(range(2, xg.ndim))
+    mean = jnp.mean(xg, axis=axes, keepdims=True)
+    var = jnp.var(xg, axis=axes, keepdims=True)
+    xg = (xg - mean) * lax.rsqrt(var + eps)
+    out = xg.reshape(x.shape)
+    bshape = (1, c) + (1,) * (x.ndim - 2)
+    if gamma is not None:
+        out = out * gamma.reshape(bshape)
+    if beta is not None:
+        out = out + beta.reshape(bshape)
+    return out
+
+
+@register_op("InstanceNorm")
+def instance_norm(x, gamma, beta, eps=1e-5):
+    """Instance norm = group norm with one group per channel."""
+    return group_norm(x, gamma, beta, num_groups=x.shape[1], eps=eps)
+
+
+@register_op("RMSNorm")
+def rms_norm(x, gamma, axis=-1, eps=1e-6):
+    """RMSNorm — modern-transformer extension beyond the reference set."""
+    ms = jnp.mean(jnp.square(x.astype(jnp.float32)), axis=axis, keepdims=True)
+    out = (x.astype(jnp.float32) * lax.rsqrt(ms + eps)).astype(x.dtype)
+    if gamma is not None:
+        out = out * gamma
+    return out
+
+
+@register_op("LRN")
+def lrn(x, nsize=5, alpha=1e-4, beta=0.75, knorm=2.0):
+    """Local response normalization (reference: nn/lrn.cc)."""
+    sq = jnp.square(x)
+    half = nsize // 2
+    sq_pad = jnp.pad(sq, ((0, 0), (half, half)) + ((0, 0),) * (x.ndim - 2))
+    acc = sum(
+        lax.dynamic_slice_in_dim(sq_pad, i, x.shape[1], axis=1)
+        for i in range(nsize)
+    )
+    return x / (knorm + alpha / nsize * acc) ** beta
+
+
+# ---------------------------------------------------------------------------
+# softmax family
+# ---------------------------------------------------------------------------
+
+
+@register_op("softmax")
+def softmax(x, axis=-1, length=None, temperature=None):
+    """Softmax with optional sequence-length masking (reference: nn/softmax.cc)."""
+    if temperature is not None and temperature != 1.0:
+        x = x / temperature
+    if length is not None:
+        mask = jnp.arange(x.shape[axis]) < jnp.expand_dims(length, -1)
+        shape = [1] * x.ndim
+        shape[0] = x.shape[0]
+        shape[axis] = x.shape[axis]
+        x = jnp.where(mask.reshape(shape), x, -jnp.inf)
+    return jax.nn.softmax(x, axis=axis)
+
+
+@register_op("log_softmax")
+def log_softmax(x, axis=-1, temperature=None):
+    if temperature is not None and temperature != 1.0:
+        x = x / temperature
+    return jax.nn.log_softmax(x, axis=axis)
+
+
+@register_op("softmin")
+def softmin(x, axis=-1):
+    return jax.nn.softmax(-x, axis=axis)
+
+
+# ---------------------------------------------------------------------------
+# activations
+# ---------------------------------------------------------------------------
+
+_ACTS = {
+    "relu": jax.nn.relu,
+    "sigmoid": jax.nn.sigmoid,
+    "tanh": jnp.tanh,
+    "softrelu": jax.nn.softplus,
+    "softsign": jax.nn.soft_sign,
+    "softmax": jax.nn.softmax,
+    "log_softmax": jax.nn.log_softmax,
+    "gelu": jax.nn.gelu,
+    "erf_gelu": lambda x: jax.nn.gelu(x, approximate=False),
+    "silu": jax.nn.silu,
+    "swish": jax.nn.silu,
+    "mish": lambda x: x * jnp.tanh(jax.nn.softplus(x)),
+    "hard_sigmoid": jax.nn.hard_sigmoid,
+    "hard_swish": jax.nn.hard_swish,
+    "relu6": lambda x: jnp.clip(x, 0.0, 6.0),
+    "identity": lambda x: x,
+}
+
+
+@register_op("Activation")
+def activation(x, act_type="relu"):
+    """Activation dispatch (reference: nn/activation.cc act_type enum)."""
+    try:
+        return _ACTS[act_type](x)
+    except KeyError:
+        raise ValueError(f"unknown act_type '{act_type}'") from None
+
+
+@register_op("LeakyReLU")
+def leaky_relu(x, gamma=None, act_type="leaky", slope=0.25):
+    """LeakyReLU family (reference: leaky_relu.cc: leaky/prelu/elu/selu/gelu)."""
+    if act_type == "leaky":
+        return jnp.where(x >= 0, x, slope * x)
+    if act_type == "prelu":
+        ndim = x.ndim
+        if gamma.ndim == 1 and ndim > 2:
+            gamma = gamma.reshape((1, -1) + (1,) * (ndim - 2))
+        return jnp.where(x >= 0, x, gamma * x)
+    if act_type == "elu":
+        return jnp.where(x >= 0, x, slope * jnp.expm1(x))
+    if act_type == "selu":
+        return jax.nn.selu(x)
+    if act_type == "gelu":
+        return jax.nn.gelu(x, approximate=False)
+    if act_type == "rrelu":
+        return jnp.where(x >= 0, x, slope * x)  # eval-mode rrelu
+    raise ValueError(f"unknown act_type '{act_type}'")
+
+
+# ---------------------------------------------------------------------------
+# dropout
+# ---------------------------------------------------------------------------
+
+
+@register_op("Dropout")
+def dropout(x, key, p=0.5, training=True, axes=None):
+    """Inverted dropout (reference: nn/dropout.cc). Key is explicit — the
+    stateful facade supplies it (mx._random.next_key / trace provider)."""
+    if not training or p <= 0.0:
+        return x
+    shape = list(x.shape)
+    if axes:
+        # `axes` are the axes the mask is SHARED along (reference
+        # nn/dropout.cc axes param): mask broadcasts over them.
+        for ax in axes:
+            shape[ax] = 1
+    keep = 1.0 - p
+    mask = jax.random.bernoulli(key, keep, tuple(shape))
+    return jnp.where(mask, x / keep, jnp.zeros((), x.dtype))
+
+
+# ---------------------------------------------------------------------------
+# indexing / embedding / misc NN ops
+# ---------------------------------------------------------------------------
+
+
+@register_op("Embedding")
+def embedding(indices, weight):
+    """Embedding lookup (reference: tensor/indexing_op.cc Embedding).
+
+    Gather on MXU-friendly layout; gradient is a dense scatter-add (the
+    reference's row_sparse grad path is deliberately dense here — see
+    ndarray.py module doc on sparse).
+    """
+    return jnp.take(weight, indices.astype(jnp.int32), axis=0)
+
+
+@register_op("one_hot")
+def one_hot(indices, depth, on_value=1.0, off_value=0.0, dtype=jnp.float32):
+    oh = jax.nn.one_hot(indices.astype(jnp.int32), depth, dtype=dtype)
+    if on_value != 1.0 or off_value != 0.0:
+        oh = oh * (on_value - off_value) + off_value
+    return oh
+
+
+@register_op("pick")
+def pick(x, index, axis=-1, keepdims=False, mode="clip"):
+    """Pick elements along axis by index (reference: tensor/broadcast_reduce_op_index.cc)."""
+    index = index.astype(jnp.int32)
+    if mode == "clip":
+        index = jnp.clip(index, 0, x.shape[axis] - 1)
+    else:
+        index = index % x.shape[axis]
+    picked = jnp.take_along_axis(x, jnp.expand_dims(index, axis), axis=axis)
+    return picked if keepdims else jnp.squeeze(picked, axis=axis)
+
+
+@register_op("topk")
+def topk(x, k=1, axis=-1, ret_typ="indices", is_ascend=False):
+    """Top-k (reference: tensor/ordering_op.cc). Uses lax.top_k on last axis."""
+    xm = jnp.moveaxis(x, axis, -1)
+    if is_ascend:
+        vals, idx = lax.top_k(-xm, k)
+        vals = -vals
+    else:
+        vals, idx = lax.top_k(xm, k)
+    vals = jnp.moveaxis(vals, -1, axis)
+    idx = jnp.moveaxis(idx, -1, axis)
+    if ret_typ == "indices":
+        return idx
+    if ret_typ == "value":
+        return vals
+    if ret_typ == "both":
+        return vals, idx
+    raise ValueError(f"unknown ret_typ {ret_typ}")
+
+
+@register_op("sequence_mask")
+def sequence_mask(x, sequence_length=None, use_sequence_length=False,
+                  value=0.0, axis=0):
+    """Mask sequences beyond their length (reference: sequence_mask.cc)."""
+    if not use_sequence_length or sequence_length is None:
+        return x
+    steps = jnp.arange(x.shape[axis])
+    # x: (T, N, ...) if axis==0 else (N, T, ...)
+    if axis == 0:
+        mask = steps[:, None] < sequence_length[None, :]
+    else:
+        mask = steps[None, :] < sequence_length[:, None]
+    mask = mask.reshape(mask.shape + (1,) * (x.ndim - 2))
+    return jnp.where(mask, x, jnp.asarray(value, x.dtype))
+
+
+@register_op("sequence_last")
+def sequence_last(x, sequence_length=None, use_sequence_length=False, axis=0):
+    if not use_sequence_length or sequence_length is None:
+        return jnp.take(x, -1, axis=axis)
+    idx = (sequence_length - 1).astype(jnp.int32)
+    if axis == 0:
+        return jnp.take_along_axis(
+            x, idx.reshape((1, -1) + (1,) * (x.ndim - 2)), axis=0
+        ).squeeze(0)
+    return jnp.take_along_axis(
+        x, idx.reshape((-1, 1) + (1,) * (x.ndim - 2)), axis=1
+    ).squeeze(1)
+
+
+@register_op("sequence_reverse")
+def sequence_reverse(x, sequence_length=None, use_sequence_length=False,
+                     axis=0):
+    if not use_sequence_length or sequence_length is None:
+        return jnp.flip(x, axis=axis)
+    t = x.shape[axis]
+    steps = jnp.arange(t)
+    # reversed index within each sequence, identity beyond length
+    if axis != 0:
+        raise NotImplementedError("sequence_reverse supports axis=0 (T,N,...)")
+    lengths = sequence_length.astype(jnp.int32)
+    rev = jnp.where(steps[:, None] < lengths[None, :],
+                    lengths[None, :] - 1 - steps[:, None], steps[:, None])
+    return jnp.take_along_axis(x, rev.reshape(rev.shape + (1,) * (x.ndim - 2)),
+                               axis=0)
+
+
+@register_op("l2_normalization")
+def l2_normalization(x, eps=1e-10, mode="instance"):
+    if mode == "instance":
+        axes = tuple(range(1, x.ndim))
+    elif mode == "channel":
+        axes = (1,)
+    else:  # spatial
+        axes = tuple(range(2, x.ndim))
+    norm = jnp.sqrt(jnp.sum(jnp.square(x), axis=axes, keepdims=True) + eps)
+    return x / norm
+
+
+@register_op("UpSampling")
+def upsample(x, scale=2, sample_type="nearest"):
+    """Spatial upsampling (reference: nn/upsampling.cc)."""
+    n, c, h, w = x.shape
+    if sample_type == "nearest":
+        return jax.image.resize(x, (n, c, h * scale, w * scale), "nearest")
+    return jax.image.resize(x, (n, c, h * scale, w * scale), "bilinear")
+
+
+@register_op("moments")
+def moments(x, axes=None, keepdims=False):
+    mean = jnp.mean(x, axis=axes, keepdims=keepdims)
+    var = jnp.var(x, axis=axes, keepdims=keepdims)
+    return mean, var

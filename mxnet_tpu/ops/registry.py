@@ -1,0 +1,32 @@
+"""Operator registry.
+
+The reference registers 595 ops via NNVM_REGISTER_OP with attrs
+(FCompute/FInferShape/FGradient..., include/mxnet/op_attr_types.h). Here an op
+is a pure jax function — shape/dtype inference is `jax.eval_shape` (free),
+gradients are `jax.vjp` (free), fusion is XLA (free). The registry exists for
+discoverability, docs, and the external-extension surface (lib_api.h parity):
+third parties can `register_op` a pure function and it becomes available to
+the frontends and to CachedOp tracing with autograd support for free.
+"""
+from __future__ import annotations
+
+_OPS = {}
+
+
+def register_op(name, fn=None):
+    """Register a pure jax function as a named operator."""
+    def _do(f):
+        _OPS[name] = f
+        return f
+
+    if fn is not None:
+        return _do(fn)
+    return _do
+
+
+def get_op(name):
+    return _OPS[name]
+
+
+def list_ops():
+    return sorted(_OPS)

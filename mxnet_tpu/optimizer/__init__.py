@@ -1,0 +1,30 @@
+"""Optimizers (reference: python/mxnet/optimizer/, 21 files + fused update ops
+in src/operator/optimizer_op.cc).
+
+Design: in the reference, optimizer updates are *operators* that run on-device
+through the engine (sgd_mom_update etc.). Here each optimizer defines a pure
+update rule jitted once per (class, shapes) — XLA fuses the whole update into
+one kernel on device, the analog of the fused multi-tensor update ops.
+"""
+from .optimizer import (  # noqa: F401
+    AdaBelief,
+    AdaDelta,
+    AdaGrad,
+    Adam,
+    AdamW,
+    DCASGD,
+    Ftrl,
+    LAMB,
+    LARS,
+    NAG,
+    Nadam,
+    Optimizer,
+    RMSProp,
+    SGD,
+    SGLD,
+    Signum,
+    create,
+    register,
+)
+
+Test = SGD  # reference exports a Test optimizer alias for unit tests

@@ -1,0 +1,611 @@
+"""Optimizer base + algorithm zoo.
+
+API parity with the reference Optimizer (python/mxnet/optimizer/optimizer.py):
+create_state(index, weight) / update(index, weight, grad, state),
+lr_scheduler + lr_mult/wd_mult, rescale_grad, clip_gradient,
+update_multi_precision (fp32 master weights for bf16/fp16 params).
+
+Each algorithm implements `_rule(w, g, state, lr, wd, hyper) -> (new_w,
+new_state)` as a pure jax function; `update()` runs it through a per-class
+jit cache and swaps the weight handle in place (engine version bump).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as _np
+
+from ..base import registry
+from ..ndarray.ndarray import NDArray, _wrap_out
+
+_REG = registry("optimizer")
+
+__all__ = ["Optimizer", "register", "create"]
+
+
+def register(klass):
+    _REG.register(klass)
+    return klass
+
+
+def create(name, **kwargs):
+    if isinstance(name, Optimizer):
+        return name
+    return _REG.create(name, **kwargs)
+
+
+def _unwrap(x):
+    return x._data if isinstance(x, NDArray) else x
+
+
+class Optimizer:
+    """Base optimizer (reference: optimizer.py:Optimizer)."""
+
+    _jit_cache = {}
+
+    def __init__(self, rescale_grad=1.0, param_idx2name=None, wd=0.0,
+                 clip_gradient=None, learning_rate=None, lr_scheduler=None,
+                 multi_precision=False, param_dict=None, aggregate_num=None,
+                 use_fused_step=True, **kwargs):  # noqa: ARG002
+        self.rescale_grad = rescale_grad
+        self.lr = learning_rate if learning_rate is not None else 0.01
+        self.lr_scheduler = lr_scheduler
+        if lr_scheduler is not None and learning_rate is not None:
+            self.lr_scheduler.base_lr = learning_rate
+        self.wd = wd
+        self.clip_gradient = clip_gradient
+        self.multi_precision = multi_precision
+        self.num_update = 0
+        self._index_update_count = {}
+        self.idx2name = param_idx2name or {}
+        self.param_dict = param_dict or {}
+        self.lr_mult = {}
+        self.wd_mult = {}
+
+    # -- hyperparameter plumbing (parity) --------------------------------
+    @property
+    def learning_rate(self):
+        if self.lr_scheduler is not None:
+            return self.lr_scheduler(self.num_update)
+        return self.lr
+
+    def set_learning_rate(self, lr):
+        if self.lr_scheduler is not None:
+            raise ValueError("lr_scheduler is set; cannot set learning rate")
+        self.lr = lr
+
+    def set_lr_mult(self, args_lr_mult):
+        self.lr_mult = dict(args_lr_mult)
+
+    def set_wd_mult(self, args_wd_mult):
+        self.wd_mult = dict(args_wd_mult)
+
+    def _update_count(self, index):
+        if index not in self._index_update_count:
+            self._index_update_count[index] = 0
+        self._index_update_count[index] += 1
+        self.num_update = max(self.num_update,
+                              self._index_update_count[index])
+
+    def _get_lr(self, index):
+        lr = self.learning_rate
+        param = self.param_dict.get(index)
+        if param is not None:
+            lr *= getattr(param, "lr_mult", 1.0)
+        elif index in self.lr_mult:
+            lr *= self.lr_mult[index]
+        elif index in self.idx2name:
+            lr *= self.lr_mult.get(self.idx2name[index], 1.0)
+        return lr
+
+    def _get_wd(self, index):
+        wd = self.wd
+        param = self.param_dict.get(index)
+        if param is not None:
+            wd *= getattr(param, "wd_mult", 1.0)
+        elif index in self.wd_mult:
+            wd *= self.wd_mult[index]
+        elif index in self.idx2name:
+            wd *= self.wd_mult.get(self.idx2name[index], 1.0)
+        return wd
+
+    # -- state ------------------------------------------------------------
+    def create_state(self, index, weight):  # noqa: ARG002
+        return None
+
+    def create_state_multi_precision(self, index, weight):
+        low_precision = weight.dtype.name in ("float16", "bfloat16")
+        if self.multi_precision and low_precision:
+            master = _wrap_out(weight._data.astype(jnp.float32))
+            return (master, self.create_state(index, master))
+        return self.create_state(index, weight)
+
+    # -- hyper vector passed into the jitted rule -------------------------
+    def _hyper(self):
+        """Dynamic (non-recompiling) hyperparameters as a dict of scalars."""
+        return {}
+
+    # -- the pure rule; subclasses override -------------------------------
+    @staticmethod
+    def _rule(w, g, state, lr, wd, hyper):
+        raise NotImplementedError
+
+    def _preprocess(self, g, w, wd, hyper):  # noqa: ARG002
+        g = g * hyper["rescale_grad"]
+        if self.clip_gradient is not None:
+            g = jnp.clip(g, -self.clip_gradient, self.clip_gradient)
+        return g
+
+    def _jitted(self):
+        cls = type(self)
+        key = (cls, self.clip_gradient)
+        fn = Optimizer._jit_cache.get(key)
+        if fn is None:
+            clip = self.clip_gradient
+
+            def step(w, g, state, lr, wd, hyper):
+                g = g * hyper["rescale_grad"]
+                if clip is not None:
+                    g = jnp.clip(g, -clip, clip)
+                return cls._rule(w, g, state, lr, wd, hyper)
+
+            fn = jax.jit(step)
+            Optimizer._jit_cache[key] = fn
+        return fn
+
+    # -- public update ----------------------------------------------------
+    def update(self, index, weight, grad, state):
+        """Single-param update; index/weight/grad may be lists (fused loop)."""
+        if isinstance(index, (list, tuple)):
+            for i, w, g, s in zip(index, weight, grad, state):
+                self.update(i, w, g, s)
+            return
+        self._update_count(index)
+        lr = self._get_lr(index)
+        wd = self._get_wd(index)
+        hyper = dict(self._hyper())
+        hyper["rescale_grad"] = self.rescale_grad
+        hyper["t"] = self._index_update_count[index]
+        state_data = jax.tree_util.tree_map(
+            _unwrap, state, is_leaf=lambda x: isinstance(x, NDArray))
+        new_w, new_state = self._jitted()(
+            weight._data, grad._data, state_data, lr, wd, hyper)
+        weight._data = new_w
+        weight._version += 1
+        _write_state(state, new_state)
+
+    def update_multi_precision(self, index, weight, grad, state):
+        if isinstance(index, (list, tuple)):
+            for i, w, g, s in zip(index, weight, grad, state):
+                self.update_multi_precision(i, w, g, s)
+            return
+        use_mp = (
+            isinstance(state, tuple)
+            and len(state) == 2
+            and isinstance(state[0], NDArray)
+            and state[0].dtype == _np.float32
+            and weight.dtype != _np.float32
+        )
+        if not use_mp:
+            self.update(index, weight, grad, state)
+            return
+        master, inner = state
+        grad32 = _wrap_out(grad._data.astype(jnp.float32))
+        self.update(index, master, grad32, inner)
+        weight._data = master._data.astype(weight._data.dtype)
+        weight._version += 1
+
+    def __repr__(self):
+        return f"{type(self).__name__}(learning_rate={self.learning_rate})"
+
+
+def _write_state(state, new_state):
+    """Write new raw state arrays back into NDArray state containers."""
+    if state is None:
+        return
+    if isinstance(state, NDArray):
+        state._data = new_state
+        state._version += 1
+        return
+    for s, ns in zip(state, new_state):
+        _write_state(s, ns)
+
+
+def _zeros_like(weight, dtype=None):
+    return _wrap_out(jnp.zeros_like(weight._data, dtype=dtype))
+
+
+# ---------------------------------------------------------------------------
+# algorithms
+# ---------------------------------------------------------------------------
+
+
+@register
+class SGD(Optimizer):
+    """SGD with momentum (reference: optimizer/sgd.py; op sgd_mom_update)."""
+
+    def __init__(self, learning_rate=0.01, momentum=0.0, lazy_update=False,
+                 **kwargs):  # noqa: ARG002 - lazy_update is a sparse-only knob
+        super().__init__(learning_rate=learning_rate, **kwargs)
+        self.momentum = momentum
+
+    def create_state(self, index, weight):
+        if self.momentum == 0.0:
+            return None
+        return _zeros_like(weight)
+
+    def _hyper(self):
+        return {"momentum": self.momentum}
+
+    @staticmethod
+    def _rule(w, g, state, lr, wd, hyper):
+        g = g + wd * w
+        if state is None:
+            return w - lr * g, None
+        mom = hyper["momentum"] * state - lr * g
+        return w + mom, mom
+
+
+@register
+class NAG(SGD):
+    """Nesterov accelerated SGD (reference: optimizer/nag.py)."""
+
+    @staticmethod
+    def _rule(w, g, state, lr, wd, hyper):
+        g = g + wd * w
+        if state is None:
+            return w - lr * g, None
+        mom = hyper["momentum"] * state - lr * g
+        return w + hyper["momentum"] * mom - lr * g, mom
+
+
+@register
+class Signum(Optimizer):
+    """Sign-momentum SGD (reference: optimizer/sgd.py Signum)."""
+
+    def __init__(self, learning_rate=0.01, momentum=0.9, wd_lh=0.0, **kwargs):
+        super().__init__(learning_rate=learning_rate, **kwargs)
+        self.momentum = momentum
+        self.wd_lh = wd_lh
+
+    def create_state(self, index, weight):
+        if self.momentum == 0.0:
+            return None
+        return _zeros_like(weight)
+
+    def _hyper(self):
+        return {"momentum": self.momentum, "wd_lh": self.wd_lh}
+
+    @staticmethod
+    def _rule(w, g, state, lr, wd, hyper):
+        g = g + wd * w
+        if state is None:
+            return w - lr * jnp.sign(g), None
+        mom = hyper["momentum"] * state - (1 - hyper["momentum"]) * g
+        new_w = w + lr * jnp.sign(mom) - lr * hyper["wd_lh"] * w
+        return new_w, mom
+
+
+@register
+class SGLD(Optimizer):
+    """Stochastic gradient Langevin dynamics (reference: optimizer/sgld.py)."""
+
+    def __init__(self, learning_rate=0.01, **kwargs):
+        super().__init__(learning_rate=learning_rate, **kwargs)
+
+    def update(self, index, weight, grad, state):
+        from .. import _random
+
+        self._update_count(index)
+        lr = self._get_lr(index)
+        wd = self._get_wd(index)
+        g = grad._data * self.rescale_grad
+        if self.clip_gradient is not None:
+            g = jnp.clip(g, -self.clip_gradient, self.clip_gradient)
+        g = g + wd * weight._data
+        noise = jax.random.normal(_random.next_key(), weight.shape,
+                                  jnp.float32) * jnp.sqrt(lr)
+        weight._data = (weight._data - lr / 2 * g
+                        + noise.astype(weight._data.dtype))
+        weight._version += 1
+
+
+@register
+class DCASGD(Optimizer):
+    """Delay-compensated async SGD (reference: optimizer/dcasgd.py)."""
+
+    def __init__(self, learning_rate=0.01, momentum=0.0, lamda=0.04, **kwargs):
+        super().__init__(learning_rate=learning_rate, **kwargs)
+        self.momentum = momentum
+        self.lamda = lamda
+
+    def create_state(self, index, weight):
+        mom = None if self.momentum == 0.0 else _zeros_like(weight)
+        return (mom, _wrap_out(jnp.copy(weight._data)))
+
+    def _hyper(self):
+        return {"momentum": self.momentum, "lamda": self.lamda}
+
+    @staticmethod
+    def _rule(w, g, state, lr, wd, hyper):
+        mom, prev_w = state
+        comp = g + wd * w + hyper["lamda"] * g * g * (w - prev_w)
+        if mom is None:
+            new_mom = None
+            upd = -lr * comp
+        else:
+            new_mom = hyper["momentum"] * mom - lr * comp
+            upd = new_mom
+        return w + upd, (new_mom, w + upd)
+
+
+@register
+class Adam(Optimizer):
+    """Adam (reference: optimizer/adam.py; op adam_update)."""
+
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
+                 epsilon=1e-8, **kwargs):
+        super().__init__(learning_rate=learning_rate, **kwargs)
+        self.beta1, self.beta2, self.epsilon = beta1, beta2, epsilon
+
+    def create_state(self, index, weight):
+        return (_zeros_like(weight), _zeros_like(weight))
+
+    def _hyper(self):
+        return {"beta1": self.beta1, "beta2": self.beta2, "eps": self.epsilon}
+
+    @staticmethod
+    def _rule(w, g, state, lr, wd, hyper):
+        m, v = state
+        b1, b2, t = hyper["beta1"], hyper["beta2"], hyper["t"]
+        g = g + wd * w
+        m = b1 * m + (1 - b1) * g
+        v = b2 * v + (1 - b2) * g * g
+        lr_t = lr * jnp.sqrt(1 - b2 ** t) / (1 - b1 ** t)
+        return w - lr_t * m / (jnp.sqrt(v) + hyper["eps"]), (m, v)
+
+
+@register
+class AdamW(Adam):
+    """Decoupled weight decay Adam (reference: contrib adamw.py)."""
+
+    @staticmethod
+    def _rule(w, g, state, lr, wd, hyper):
+        m, v = state
+        b1, b2, t = hyper["beta1"], hyper["beta2"], hyper["t"]
+        m = b1 * m + (1 - b1) * g
+        v = b2 * v + (1 - b2) * g * g
+        mhat = m / (1 - b1 ** t)
+        vhat = v / (1 - b2 ** t)
+        return w - lr * (mhat / (jnp.sqrt(vhat) + hyper["eps"]) + wd * w), (m, v)
+
+
+@register
+class Nadam(Adam):
+    """Nesterov Adam (reference: optimizer/nadam.py)."""
+
+    @staticmethod
+    def _rule(w, g, state, lr, wd, hyper):
+        m, v = state
+        b1, b2, t = hyper["beta1"], hyper["beta2"], hyper["t"]
+        g = g + wd * w
+        m = b1 * m + (1 - b1) * g
+        v = b2 * v + (1 - b2) * g * g
+        mhat = m / (1 - b1 ** (t + 1))
+        vhat = v / (1 - b2 ** t)
+        m_bar = b1 * mhat + (1 - b1) * g / (1 - b1 ** t)
+        return w - lr * m_bar / (jnp.sqrt(vhat) + hyper["eps"]), (m, v)
+
+
+@register
+class AdaBelief(Adam):
+    """AdaBelief (reference: optimizer/adabelief.py)."""
+
+    @staticmethod
+    def _rule(w, g, state, lr, wd, hyper):
+        m, s = state
+        b1, b2, t = hyper["beta1"], hyper["beta2"], hyper["t"]
+        g = g + wd * w
+        m = b1 * m + (1 - b1) * g
+        s = b2 * s + (1 - b2) * jnp.square(g - m) + hyper["eps"]
+        lr_t = lr * jnp.sqrt(1 - b2 ** t) / (1 - b1 ** t)
+        return w - lr_t * m / (jnp.sqrt(s) + hyper["eps"]), (m, s)
+
+
+@register
+class RMSProp(Optimizer):
+    """RMSProp, optionally centered (reference: optimizer/rmsprop.py)."""
+
+    def __init__(self, learning_rate=0.001, rho=0.9, momentum=0.9,
+                 epsilon=1e-8, centered=False, **kwargs):
+        super().__init__(learning_rate=learning_rate, **kwargs)
+        self.rho, self.momentum, self.epsilon = rho, momentum, epsilon
+        self.centered = centered
+
+    def create_state(self, index, weight):
+        if self.centered:
+            return (_zeros_like(weight), _zeros_like(weight),
+                    _zeros_like(weight))
+        return (_zeros_like(weight),)
+
+    def _hyper(self):
+        return {"rho": self.rho, "momentum": self.momentum,
+                "eps": self.epsilon}
+
+    @staticmethod
+    def _rule(w, g, state, lr, wd, hyper):
+        rho, eps = hyper["rho"], hyper["eps"]
+        g = g + wd * w
+        if len(state) == 1:
+            (n,) = state
+            n = rho * n + (1 - rho) * g * g
+            return w - lr * g / (jnp.sqrt(n) + eps), (n,)
+        n, mg, delta = state
+        n = rho * n + (1 - rho) * g * g
+        mg = rho * mg + (1 - rho) * g
+        delta = hyper["momentum"] * delta - lr * g / (
+            jnp.sqrt(n - mg * mg + eps))
+        return w + delta, (n, mg, delta)
+
+
+@register
+class AdaGrad(Optimizer):
+    """AdaGrad (reference: optimizer/adagrad.py)."""
+
+    def __init__(self, learning_rate=0.01, epsilon=1e-7, **kwargs):
+        super().__init__(learning_rate=learning_rate, **kwargs)
+        self.epsilon = epsilon
+
+    def create_state(self, index, weight):
+        return _zeros_like(weight)
+
+    def _hyper(self):
+        return {"eps": self.epsilon}
+
+    @staticmethod
+    def _rule(w, g, state, lr, wd, hyper):
+        g = g + wd * w
+        hist = state + g * g
+        return w - lr * g / (jnp.sqrt(hist) + hyper["eps"]), hist
+
+
+@register
+class AdaDelta(Optimizer):
+    """AdaDelta (reference: optimizer/adadelta.py)."""
+
+    def __init__(self, learning_rate=1.0, rho=0.90, epsilon=1e-5, **kwargs):
+        super().__init__(learning_rate=learning_rate, **kwargs)
+        self.rho, self.epsilon = rho, epsilon
+
+    def create_state(self, index, weight):
+        return (_zeros_like(weight), _zeros_like(weight))
+
+    def _hyper(self):
+        return {"rho": self.rho, "eps": self.epsilon}
+
+    @staticmethod
+    def _rule(w, g, state, lr, wd, hyper):
+        acc_g, acc_d = state
+        rho, eps = hyper["rho"], hyper["eps"]
+        g = g + wd * w
+        acc_g = rho * acc_g + (1 - rho) * g * g
+        delta = jnp.sqrt(acc_d + eps) / jnp.sqrt(acc_g + eps) * g
+        acc_d = rho * acc_d + (1 - rho) * delta * delta
+        return w - lr * delta, (acc_g, acc_d)
+
+
+@register
+class Ftrl(Optimizer):
+    """FTRL-proximal (reference: optimizer/ftrl.py)."""
+
+    def __init__(self, learning_rate=0.1, lamda1=0.01, beta=1.0, **kwargs):
+        super().__init__(learning_rate=learning_rate, **kwargs)
+        self.lamda1, self.beta = lamda1, beta
+
+    def create_state(self, index, weight):
+        return (_zeros_like(weight), _zeros_like(weight))  # z, n
+
+    def _hyper(self):
+        return {"lamda1": self.lamda1, "beta": self.beta}
+
+    @staticmethod
+    def _rule(w, g, state, lr, wd, hyper):
+        z, n = state
+        l1, beta = hyper["lamda1"], hyper["beta"]
+        sigma = (jnp.sqrt(n + g * g) - jnp.sqrt(n)) / lr
+        z = z + g - sigma * w
+        n = n + g * g
+        new_w = jnp.where(
+            jnp.abs(z) > l1,
+            -(z - jnp.sign(z) * l1) / ((beta + jnp.sqrt(n)) / lr + wd),
+            jnp.zeros_like(w),
+        )
+        return new_w, (z, n)
+
+
+@register
+class LAMB(Optimizer):
+    """Layer-wise adaptive moments for batch training (reference: lamb.py)."""
+
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
+                 epsilon=1e-6, lower_bound=None, upper_bound=None,
+                 bias_correction=True, **kwargs):
+        super().__init__(learning_rate=learning_rate, **kwargs)
+        self.beta1, self.beta2, self.epsilon = beta1, beta2, epsilon
+        self.lower_bound, self.upper_bound = lower_bound, upper_bound
+        self.bias_correction = bias_correction
+
+    def create_state(self, index, weight):
+        return (_zeros_like(weight), _zeros_like(weight))
+
+    def _hyper(self):
+        return {"beta1": self.beta1, "beta2": self.beta2, "eps": self.epsilon,
+                "lower": self.lower_bound or 0.0,
+                "upper": self.upper_bound or -1.0,
+                "bias_corr": 1.0 if self.bias_correction else 0.0}
+
+    @staticmethod
+    def _rule(w, g, state, lr, wd, hyper):
+        m, v = state
+        b1, b2, t = hyper["beta1"], hyper["beta2"], hyper["t"]
+        m = b1 * m + (1 - b1) * g
+        v = b2 * v + (1 - b2) * g * g
+        bc = hyper["bias_corr"]
+        mhat = jnp.where(bc > 0, m / (1 - b1 ** t), m)
+        vhat = jnp.where(bc > 0, v / (1 - b2 ** t), v)
+        r = mhat / (jnp.sqrt(vhat) + hyper["eps"]) + wd * w
+        w_norm = jnp.linalg.norm(w)
+        r_norm = jnp.linalg.norm(r)
+        ratio = jnp.where((w_norm > 0) & (r_norm > 0), w_norm / r_norm, 1.0)
+        ratio = jnp.maximum(ratio, hyper["lower"])
+        ratio = jnp.where(hyper["upper"] > 0,
+                          jnp.minimum(ratio, jnp.abs(hyper["upper"])), ratio)
+        return w - lr * ratio * r, (m, v)
+
+
+@register
+class LARS(Optimizer):
+    """Layer-wise adaptive rate scaling (reference: optimizer/lars.py)."""
+
+    def __init__(self, learning_rate=0.1, momentum=0.9, eta=0.001,
+                 epsilon=1e-8, **kwargs):
+        super().__init__(learning_rate=learning_rate, **kwargs)
+        self.momentum, self.eta, self.epsilon = momentum, eta, epsilon
+
+    def create_state(self, index, weight):
+        return _zeros_like(weight)
+
+    def _hyper(self):
+        return {"momentum": self.momentum, "eta": self.eta,
+                "eps": self.epsilon}
+
+    @staticmethod
+    def _rule(w, g, state, lr, wd, hyper):
+        w_norm = jnp.linalg.norm(w)
+        g_norm = jnp.linalg.norm(g)
+        trust = jnp.where(
+            (w_norm > 0) & (g_norm > 0),
+            hyper["eta"] * w_norm / (g_norm + wd * w_norm + hyper["eps"]),
+            1.0,
+        )
+        g = g + wd * w
+        mom = hyper["momentum"] * state + lr * trust * g
+        return w - mom, mom
+
+
+# registered lowercase aliases for reference parity
+_REG.register(SGD, "sgd")
+_REG.register(NAG, "nag")
+_REG.register(Adam, "adam")
+_REG.register(AdamW, "adamw")
+_REG.register(Nadam, "nadam")
+_REG.register(RMSProp, "rmsprop")
+_REG.register(AdaGrad, "adagrad")
+_REG.register(AdaDelta, "adadelta")
+_REG.register(Ftrl, "ftrl")
+_REG.register(LAMB, "lamb")
+_REG.register(LARS, "lars")
+_REG.register(Signum, "signum")
+_REG.register(SGLD, "sgld")
+_REG.register(DCASGD, "dcasgd")
+_REG.register(AdaBelief, "adabelief")

@@ -1,0 +1,16 @@
+"""Parallelism over device meshes — the SPMD core.
+
+The reference's only strategy is data parallelism via kvstore (SURVEY.md
+§2.4); this package provides DP at parity *plus* the sharding axes the
+reference lacks (TP/SP), expressed the TPU-native way: a `jax.sharding.Mesh`
+with named axes, sharding specs on params/activations, and XLA-inserted
+collectives over ICI.
+
+Modules:
+  mesh        — mesh construction helpers (dp/tp/sp axes, multi-host aware)
+  collectives — psum/all_gather/reduce_scatter/ppermute wrappers
+  data_parallel — sharded training step builder (grad psum over 'dp')
+"""
+from . import collectives, mesh  # noqa: F401
+from .data_parallel import make_data_parallel_step  # noqa: F401
+from .mesh import make_mesh  # noqa: F401

@@ -1,0 +1,78 @@
+"""Collective wrappers over XLA (psum/all_gather/reduce_scatter/ppermute).
+
+These replace the reference's entire comm layer: CommCPU/CommDevice reduce
+(src/kvstore/comm.h), tree allreduce (comm_tree.h), NCCL (kvstore_nccl.h) and
+ps-lite push/pull — all become XLA collectives that ride ICI within a slice
+and DCN across slices, scheduled asynchronously by the compiler.
+"""
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+from jax.experimental.shard_map import shard_map
+
+__all__ = ["psum_tree", "allreduce_mean", "all_gather", "reduce_scatter",
+           "ring_permute"]
+
+
+def psum_tree(tree, mesh, axis="dp"):
+    """Allreduce-sum a pytree of per-device arrays sharded over `axis`.
+
+    Inputs are arrays sharded batch-first over the mesh axis; output is the
+    sum, replicated. This is one jitted shard_map — XLA emits a single fused
+    all-reduce for the whole tree (the multi-tensor aggregation the reference
+    implements by hand in CommDevice::ReduceImpl).
+    """
+
+    @partial(
+        shard_map,
+        mesh=mesh,
+        in_specs=(P(axis),),
+        out_specs=P(),
+    )
+    def _reduce(t):
+        return jax.tree_util.tree_map(lambda x: jax.lax.psum(x, axis), t)
+
+    return jax.jit(_reduce)(tree)
+
+
+def allreduce_mean(tree, mesh, axis="dp"):
+    n = mesh.shape[axis]
+    summed = psum_tree(tree, mesh, axis)
+    return jax.tree_util.tree_map(lambda x: x / n, summed)
+
+
+def all_gather(x, mesh, axis="dp", tiled=True):
+    """All-gather along a mesh axis (reference analog: broadcast fan-out)."""
+
+    @partial(shard_map, mesh=mesh, in_specs=P(axis), out_specs=P())
+    def _ag(v):
+        return jax.lax.all_gather(v, axis, tiled=tiled)
+
+    return jax.jit(_ag)(x)
+
+
+def reduce_scatter(x, mesh, axis="dp"):
+    """Reduce-scatter along a mesh axis (ZeRO-style sharded grads)."""
+
+    @partial(shard_map, mesh=mesh, in_specs=P(axis), out_specs=P(axis))
+    def _rs(v):
+        return jax.lax.psum_scatter(v, axis, tiled=True)
+
+    return jax.jit(_rs)(x)
+
+
+def ring_permute(x, mesh, axis="sp", shift=1):
+    """Neighbor exchange along a ring — the building block of ring attention
+    / context parallelism (a capability the reference lacks; SURVEY.md §5)."""
+    n = mesh.shape[axis]
+    perm = [(i, (i + shift) % n) for i in range(n)]
+
+    @partial(shard_map, mesh=mesh, in_specs=P(axis), out_specs=P(axis))
+    def _pp(v):
+        return jax.lax.ppermute(v, axis, perm)
+
+    return jax.jit(_pp)(x)

@@ -1,0 +1,58 @@
+"""Device-mesh construction.
+
+The TPU scaling recipe (scaling-book): pick a mesh whose inner axes map to
+ICI-adjacent chips, annotate shardings, let XLA insert collectives. Multi-host
+is transparent: jax.devices() spans the slice once jax.distributed is
+initialized (the tools/launch.py analog).
+"""
+from __future__ import annotations
+
+import numpy as _np
+
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec
+
+__all__ = ["make_mesh", "Mesh", "NamedSharding", "PartitionSpec",
+           "data_parallel_mesh", "local_mesh"]
+
+
+def make_mesh(axes, devices=None):
+    """Build a Mesh from {axis_name: size}; size -1 infers the remainder.
+
+    Example: make_mesh({'dp': -1, 'tp': 4}) on 32 chips -> 8x4 mesh.
+    Axis order puts the *last* axis innermost (fastest-varying), which on TPU
+    means adjacent chips — put tp/sp axes last so their collectives ride
+    nearest-neighbor ICI links.
+    """
+    devices = list(devices if devices is not None else jax.devices())
+    n = len(devices)
+    names = list(axes.keys())
+    sizes = list(axes.values())
+    unknown = [i for i, s in enumerate(sizes) if s in (-1, None)]
+    known = 1
+    for s in sizes:
+        if s not in (-1, None):
+            known *= s
+    if unknown:
+        if n % known:
+            raise ValueError(f"{n} devices not divisible by {known}")
+        sizes[unknown[0]] = n // known
+        for i in unknown[1:]:
+            sizes[i] = 1
+    total = 1
+    for s in sizes:
+        total *= s
+    if total != n:
+        raise ValueError(f"mesh {dict(zip(names, sizes))} != {n} devices")
+    arr = _np.array(devices).reshape(sizes)
+    return Mesh(arr, tuple(names))
+
+
+def data_parallel_mesh(devices=None):
+    """1-D 'dp' mesh over all devices (the kvstore='tpu_dist' topology)."""
+    return make_mesh({"dp": -1}, devices)
+
+
+def local_mesh(axes=None):
+    """Mesh over this process's local devices only."""
+    return make_mesh(axes or {"dp": -1}, jax.local_devices())

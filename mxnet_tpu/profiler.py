@@ -1,0 +1,107 @@
+"""Profiler over jax.profiler / XPlane.
+
+Reference: src/profiler/ (Chrome-trace JSON dump of engine ops) +
+python/mxnet/profiler.py. The TPU analog is the XLA profiler: traces capture
+device compute, HBM transfers, and collectives, viewable in TensorBoard or
+Perfetto. The op-name scoping mechanism (ProfilerScope, profiler.h:1339) maps
+to jax.named_scope, which annotates HLO and shows up in the trace.
+"""
+from __future__ import annotations
+
+import contextlib
+import os
+import time
+
+import jax
+
+_config = {"filename": "profile.json", "profile_all": False}
+_running = False
+_trace_dir = None
+
+
+def set_config(**kwargs):
+    """Accepts reference kwargs (filename, profile_all, aggregate_stats...)."""
+    _config.update(kwargs)
+
+
+def set_state(state="stop", profile_process="worker"):  # noqa: ARG001
+    global _running, _trace_dir
+    if state == "run" and not _running:
+        _trace_dir = _config.get("trace_dir") or os.path.join(
+            os.path.dirname(os.path.abspath(_config["filename"])) or ".",
+            "jax_trace",
+        )
+        jax.profiler.start_trace(_trace_dir)
+        _running = True
+    elif state == "stop" and _running:
+        jax.profiler.stop_trace()
+        _running = False
+
+
+def start():
+    set_state("run")
+
+
+def stop():
+    set_state("stop")
+
+
+def dump(finished=True, profile_process="worker"):  # noqa: ARG001
+    """Trace data is written by stop_trace; kept for API parity."""
+    if _running:
+        stop()
+
+
+def dumps(reset=False):  # noqa: ARG001
+    return f"trace dir: {_trace_dir}" if _trace_dir else "profiler not run"
+
+
+@contextlib.contextmanager
+def scope(name="<unk>"):
+    """Name scope annotating HLO ops (reference: profiler.Scope)."""
+    with jax.named_scope(name):
+        yield
+
+
+class Task:
+    """Named task timing (reference: profiler.Task) — host-side wall timing."""
+
+    def __init__(self, name, domain=None):  # noqa: ARG002
+        self.name = name
+        self._t0 = None
+        self.elapsed = 0.0
+
+    def start(self):
+        self._t0 = time.perf_counter()
+
+    def stop(self):
+        if self._t0 is not None:
+            self.elapsed += time.perf_counter() - self._t0
+            self._t0 = None
+
+
+Frame = Task
+Event = Task
+
+
+class Counter:
+    def __init__(self, name, domain=None, value=0):  # noqa: ARG002
+        self.name = name
+        self.value = value
+
+    def set_value(self, v):
+        self.value = v
+
+    def increment(self, delta=1):
+        self.value += delta
+
+    def decrement(self, delta=1):
+        self.value -= delta
+
+
+def pause(profile_process="worker"):  # noqa: ARG001
+    pass
+
+
+def resume(profile_process="worker"):  # noqa: ARG001
+    pass

@@ -1,0 +1,209 @@
+"""RecordIO file format (reference: python/mxnet/recordio.py +
+src/recordio.cc / tools/im2rec.cc).
+
+Binary-compatible with dmlc RecordIO: each record is
+  [magic:4B][lrec:4B][payload][pad to 4B]
+where lrec's upper 3 bits are a continuation flag (0=whole record) and the
+lower 29 bits the payload length. IRHeader packing (label/id) matches
+mx.recordio.pack so .rec datasets written by the reference load unchanged.
+"""
+from __future__ import annotations
+
+import os
+import struct
+from collections import namedtuple
+
+import numpy as _np
+
+__all__ = ["MXRecordIO", "MXIndexedRecordIO", "IndexedRecordIO", "IRHeader",
+           "pack", "unpack", "pack_img", "unpack_img"]
+
+_MAGIC = 0xCED7230A
+_LFLAG_BITS = 29
+_LEN_MASK = (1 << _LFLAG_BITS) - 1
+
+
+class MXRecordIO:
+    """Sequential reader/writer."""
+
+    def __init__(self, uri, flag="r"):
+        self.uri = uri
+        self.flag = flag
+        self.open()
+
+    def open(self):
+        if self.flag == "w":
+            self._fh = open(self.uri, "wb")
+        elif self.flag == "r":
+            self._fh = open(self.uri, "rb")
+        else:
+            raise ValueError("flag must be 'r' or 'w'")
+        self.is_open = True
+
+    def close(self):
+        if self.is_open:
+            self._fh.close()
+            self.is_open = False
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:
+            pass
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+        return False
+
+    def reset(self):
+        self.close()
+        self.open()
+
+    def tell(self):
+        return self._fh.tell()
+
+    def seek(self, pos):
+        self._fh.seek(pos)
+
+    def write(self, buf):
+        assert self.flag == "w"
+        if isinstance(buf, str):
+            buf = buf.encode()
+        n = len(buf)
+        self._fh.write(struct.pack("<II", _MAGIC, n & _LEN_MASK))
+        self._fh.write(buf)
+        pad = (4 - n % 4) % 4
+        if pad:
+            self._fh.write(b"\x00" * pad)
+
+    def read(self):
+        assert self.flag == "r"
+        head = self._fh.read(8)
+        if len(head) < 8:
+            return None
+        magic, lrec = struct.unpack("<II", head)
+        if magic != _MAGIC:
+            raise IOError(f"invalid record magic {magic:#x} in {self.uri}")
+        n = lrec & _LEN_MASK
+        data = self._fh.read(n)
+        pad = (4 - n % 4) % 4
+        if pad:
+            self._fh.read(pad)
+        return data
+
+
+class MXIndexedRecordIO(MXRecordIO):
+    """Random-access reader/writer with a .idx sidecar
+    (reference: MXIndexedRecordIO)."""
+
+    def __init__(self, idx_path, uri=None, flag="r", key_type=int):
+        if uri is None:  # single-arg form: derive idx from rec path
+            uri = idx_path
+            idx_path = os.path.splitext(uri)[0] + ".idx"
+        self.idx_path = idx_path
+        self.idx = {}
+        self.keys = []
+        self.key_type = key_type
+        super().__init__(uri, flag)
+        if flag == "r" and os.path.exists(idx_path):
+            with open(idx_path) as f:
+                for line in f:
+                    key, pos = line.strip().split("\t")
+                    key = key_type(key)
+                    self.idx[key] = int(pos)
+                    self.keys.append(key)
+
+    def close(self):
+        if self.flag == "w" and getattr(self, "is_open", False):
+            with open(self.idx_path, "w") as f:
+                for key in self.keys:
+                    f.write(f"{key}\t{self.idx[key]}\n")
+        super().close()
+
+    def __len__(self):
+        return len(self.keys)
+
+    def write_idx(self, idx, buf):
+        pos = self.tell()
+        self.write(buf)
+        self.idx[idx] = pos
+        self.keys.append(idx)
+
+    def read_idx(self, idx):
+        if idx not in self.idx:
+            idx = self.keys[idx]
+        self.seek(self.idx[idx])
+        return self.read()
+
+
+IndexedRecordIO = MXIndexedRecordIO
+
+IRHeader = namedtuple("IRHeader", ["flag", "label", "id", "id2"])
+_IR_FORMAT = "<IfQQ"
+_IR_SIZE = struct.calcsize(_IR_FORMAT)
+
+
+def pack(header, s):
+    """Pack IRHeader + payload (reference: recordio.py pack)."""
+    header = IRHeader(*header)
+    if isinstance(header.label, (int, float)):
+        hdr = struct.pack(_IR_FORMAT, 0, float(header.label), header.id,
+                          header.id2)
+    else:
+        label = _np.asarray(header.label, dtype=_np.float32)
+        hdr = struct.pack(_IR_FORMAT, label.size, 0.0, header.id, header.id2)
+        hdr += label.tobytes()
+    if isinstance(s, str):
+        s = s.encode()
+    return hdr + s
+
+
+def unpack(s):
+    """Unpack to (IRHeader, payload)."""
+    flag, label, id_, id2 = struct.unpack(_IR_FORMAT, s[:_IR_SIZE])
+    s = s[_IR_SIZE:]
+    if flag > 0:
+        label = _np.frombuffer(s[: flag * 4], _np.float32)
+        s = s[flag * 4:]
+    return IRHeader(flag, label, id_, id2), s
+
+
+def pack_img(header, img, quality=95, img_fmt=".jpg"):
+    """Pack an image array (requires pillow for jpeg; .npy always works)."""
+    if img_fmt == ".npy":
+        import io as _io
+
+        buf = _io.BytesIO()
+        _np.save(buf, _np.asarray(img))
+        return pack(header, buf.getvalue())
+    try:
+        import io as _io
+
+        from PIL import Image
+
+        buf = _io.BytesIO()
+        Image.fromarray(_np.asarray(img)).save(
+            buf, format="JPEG" if img_fmt in (".jpg", ".jpeg") else "PNG",
+            quality=quality)
+        return pack(header, buf.getvalue())
+    except ImportError as e:
+        raise RuntimeError("pack_img needs pillow; use img_fmt='.npy'") from e
+
+
+def unpack_img(s, iscolor=-1):  # noqa: ARG001
+    header, payload = unpack(s)
+    if payload[:6] == b"\x93NUMPY":
+        import io as _io
+
+        return header, _np.load(_io.BytesIO(payload))
+    try:
+        import io as _io
+
+        from PIL import Image
+
+        return header, _np.asarray(Image.open(_io.BytesIO(payload)))
+    except ImportError as e:
+        raise RuntimeError("unpack_img needs pillow for jpeg/png") from e

@@ -1,0 +1,54 @@
+"""Runtime feature introspection (reference: python/mxnet/runtime.py +
+src/libinfo.cc feature flags).
+
+The reference exposes compile-time flags (CUDA/CUDNN/ONEDNN/DIST_KVSTORE...)
+via `feature_list()`. Here features are runtime properties of the JAX/PJRT
+installation.
+"""
+from __future__ import annotations
+
+from collections import namedtuple
+
+import jax
+
+Feature = namedtuple("Feature", ["name", "enabled"])
+
+
+def _features():
+    backend = jax.default_backend()
+    feats = {
+        "TPU": backend == "tpu",
+        "GPU": backend == "gpu",
+        "CPU": True,
+        "XLA": True,
+        "PALLAS": backend == "tpu",
+        "BF16": True,
+        "INT8": True,
+        "DIST_KVSTORE": True,  # tpu_dist over jax.distributed
+        "OPENCV": False,
+        "CUDA": False,
+        "CUDNN": False,
+        "ONEDNN": False,
+        "TVM_OP": False,
+        "SIGNAL_HANDLER": True,
+        "F16C": True,
+        "INT64_TENSOR_SIZE": True,
+    }
+    return [Feature(k, v) for k, v in feats.items()]
+
+
+class Features(dict):
+    def __init__(self):
+        super().__init__([(f.name, f) for f in _features()])
+
+    def is_enabled(self, name):
+        return self[name.upper()].enabled
+
+
+def feature_list():
+    return _features()
+
+
+def print_summary():
+    for f in _features():
+        print(f"{'✔' if f.enabled else '✖'} {f.name}")

@@ -1,0 +1,153 @@
+"""Test toolkit (reference: python/mxnet/test_utils.py, 2608 LoC).
+
+Ports the numeric-oracle pattern: assert_almost_equal with dtype-aware
+tolerances, finite-difference gradient checking against the autograd tape,
+and device consistency checks (TPU vs CPU-jax replaces CPU vs GPU).
+"""
+from __future__ import annotations
+
+import numpy as _np
+
+from .base import normalize_dtype
+from .device import cpu, current_device, tpu
+from .ndarray.ndarray import NDArray
+
+__all__ = ["assert_almost_equal", "almost_equal", "same", "rand_ndarray",
+           "random_arrays", "check_numeric_gradient", "check_consistency",
+           "default_device", "default_rtol_atol", "effective_dtype"]
+
+_RTOL = {
+    "float16": 1e-2,
+    "bfloat16": 3e-2,
+    "float32": 1e-4,
+    "float64": 1e-6,
+}
+_ATOL = {
+    "float16": 1e-3,
+    "bfloat16": 1e-2,
+    "float32": 1e-5,
+    "float64": 1e-8,
+}
+
+
+def default_device():
+    return current_device()
+
+
+def effective_dtype(arr):
+    return _np.dtype(arr.dtype)
+
+
+def default_rtol_atol(*arrays):
+    rtol = atol = 0.0
+    for a in arrays:
+        name = _np.dtype(a.dtype).name
+        rtol = max(rtol, _RTOL.get(name, 1e-4))
+        atol = max(atol, _ATOL.get(name, 1e-5))
+    return rtol, atol
+
+
+def _as_np(x):
+    if isinstance(x, NDArray):
+        return x.asnumpy()
+    return _np.asarray(x)
+
+
+def same(a, b):
+    return _np.array_equal(_as_np(a), _as_np(b))
+
+
+def almost_equal(a, b, rtol=None, atol=None, equal_nan=False):
+    a, b = _as_np(a), _as_np(b)
+    if rtol is None or atol is None:
+        d_rtol, d_atol = default_rtol_atol(a, b)
+        rtol = rtol if rtol is not None else d_rtol
+        atol = atol if atol is not None else d_atol
+    return _np.allclose(a.astype(_np.float64), b.astype(_np.float64),
+                        rtol=rtol, atol=atol, equal_nan=equal_nan)
+
+
+def assert_almost_equal(a, b, rtol=None, atol=None, names=("a", "b"),
+                        equal_nan=False):
+    a_np, b_np = _as_np(a), _as_np(b)
+    if rtol is None or atol is None:
+        d_rtol, d_atol = default_rtol_atol(a_np, b_np)
+        rtol = rtol if rtol is not None else d_rtol
+        atol = atol if atol is not None else d_atol
+    if not _np.allclose(a_np.astype(_np.float64), b_np.astype(_np.float64),
+                        rtol=rtol, atol=atol, equal_nan=equal_nan):
+        diff = _np.abs(a_np.astype(_np.float64) - b_np.astype(_np.float64))
+        rel = diff / (_np.abs(b_np.astype(_np.float64)) + 1e-12)
+        raise AssertionError(
+            f"{names[0]} != {names[1]} (rtol={rtol}, atol={atol}): "
+            f"max abs diff {diff.max():.3e}, max rel diff {rel.max():.3e}\n"
+            f"{names[0]}: {a_np.reshape(-1)[:8]}...\n"
+            f"{names[1]}: {b_np.reshape(-1)[:8]}...")
+
+
+def rand_ndarray(shape, dtype="float32", device=None, low=-1.0, high=1.0):
+    from .numpy import array
+
+    data = _np.random.uniform(low, high, size=shape).astype(
+        normalize_dtype(dtype))
+    return array(data, device=device)
+
+
+def random_arrays(*shapes, dtype="float32"):
+    out = [_np.random.uniform(-1, 1, s).astype(dtype) for s in shapes]
+    return out[0] if len(out) == 1 else out
+
+
+def check_numeric_gradient(fn, inputs, eps=1e-3, rtol=1e-2, atol=1e-3):
+    """Compare autograd gradients to central finite differences
+    (reference: test_utils.py check_numeric_gradient)."""
+    from . import autograd
+    from .numpy import array
+
+    inputs = [i if isinstance(i, NDArray) else array(i) for i in inputs]
+    for x in inputs:
+        x.attach_grad()
+    with autograd.record():
+        out = fn(*inputs)
+        total = out.sum() if out.ndim > 0 else out
+    total.backward()
+    analytic = [x.grad.asnumpy().copy() for x in inputs]
+
+    for idx, x in enumerate(inputs):
+        base = x.asnumpy().astype(_np.float64)
+        numeric = _np.zeros_like(base)
+        flat = base.reshape(-1)
+        num_flat = numeric.reshape(-1)
+        for i in range(flat.size):
+            orig = flat[i]
+            flat[i] = orig + eps
+            xp = array(base.reshape(base.shape).astype(x.dtype))
+            args = [inputs[j] if j != idx else xp for j in range(len(inputs))]
+            fp = float(fn(*args).sum().item())
+            flat[i] = orig - eps
+            xm = array(base.reshape(base.shape).astype(x.dtype))
+            args = [inputs[j] if j != idx else xm for j in range(len(inputs))]
+            fm = float(fn(*args).sum().item())
+            flat[i] = orig
+            num_flat[i] = (fp - fm) / (2 * eps)
+        if not _np.allclose(analytic[idx], numeric, rtol=rtol, atol=atol):
+            raise AssertionError(
+                f"gradient mismatch on input {idx}: "
+                f"analytic {analytic[idx].reshape(-1)[:5]} vs "
+                f"numeric {num_flat[:5]}")
+
+
+def check_consistency(fn, inputs, devices=None, rtol=None, atol=None):
+    """Run fn on several devices and compare (the reference's CPU↔GPU oracle,
+    here CPU↔TPU when TPU is present)."""
+    from .numpy import array
+
+    devices = devices or [cpu(0), tpu(0)]
+    results = []
+    for dev in devices:
+        dev_inputs = [array(i, device=dev) if not isinstance(i, NDArray)
+                      else i.as_in_ctx(dev) for i in inputs]
+        results.append(_as_np(fn(*dev_inputs)))
+    for r in results[1:]:
+        assert_almost_equal(results[0], r, rtol=rtol, atol=atol)
+    return results[0]

@@ -1,0 +1,44 @@
+"""Misc utilities (reference: python/mxnet/util.py)."""
+from __future__ import annotations
+
+import functools
+
+
+def use_np_shape(func):
+    return func
+
+
+def use_np_array(func):
+    return func
+
+
+def use_np(func):
+    return func
+
+
+def wrap_ctx_to_device_func(func):
+    """Accept both ctx= and device= kwargs (reference 2.x migration shim)."""
+
+    @functools.wraps(func)
+    def wrapped(*args, **kwargs):
+        if "ctx" in kwargs and "device" not in kwargs:
+            kwargs["device"] = kwargs.pop("ctx")
+        return func(*args, **kwargs)
+
+    return wrapped
+
+
+def get_gpu_count():
+    from .device import num_gpus
+
+    return num_gpus()
+
+
+def get_gpu_memory(dev_id=0):  # noqa: ARG001
+    import jax
+
+    try:
+        stats = jax.local_devices()[dev_id].memory_stats()
+        return stats.get("bytes_in_use", 0), stats.get("bytes_limit", 0)
+    except Exception:  # pragma: no cover
+        return 0, 0

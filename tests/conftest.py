@@ -1,0 +1,41 @@
+"""Test fixtures (reference: conftest.py:61-127 — seeded repro + waitall).
+
+Tests run on a virtual 8-device CPU mesh so multi-chip sharding paths are
+exercised without TPU hardware (the driver separately dry-runs multichip).
+"""
+import os
+
+# Must be set before jax import: 8 virtual CPU devices, CPU platform.
+os.environ["JAX_PLATFORMS"] = "cpu"
+flags = os.environ.get("XLA_FLAGS", "")
+if "host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8").strip()
+
+import jax  # noqa: E402
+
+# The environment's TPU-tunnel plugin (axon) force-overrides jax_platforms
+# to "axon,cpu" from sitecustomize, ignoring JAX_PLATFORMS. Tests must be
+# hermetic on the CPU mesh, so set the config back before any backend init.
+jax.config.update("jax_platforms", "cpu")
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture(autouse=True)
+def seed_rng():
+    """Seed all framework RNGs per test (reference: module_scope_seed)."""
+    import mxnet_tpu as mx
+
+    mx.seed(0)
+    yield
+
+
+@pytest.fixture(autouse=True, scope="module")
+def waitall_between_modules():
+    """Sync between test modules so async failures attribute correctly
+    (reference conftest autouse waitall)."""
+    yield
+    import mxnet_tpu as mx
+
+    mx.waitall()

@@ -1,0 +1,203 @@
+"""Autograd tests (reference: tests/python/unittest/test_autograd.py)."""
+import numpy as onp
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import autograd, np
+from mxnet_tpu.test_utils import assert_almost_equal, check_numeric_gradient
+
+
+def test_simple_grad():
+    x = np.array([1.0, 2.0, 3.0])
+    x.attach_grad()
+    with autograd.record():
+        y = (x * x).sum()
+    y.backward()
+    assert_almost_equal(x.grad, 2 * x.asnumpy())
+
+
+def test_chain():
+    x = np.array([0.5, 1.5])
+    x.attach_grad()
+    with autograd.record():
+        y = np.exp(np.sin(x)).sum()
+    y.backward()
+    expected = onp.cos(x.asnumpy()) * onp.exp(onp.sin(x.asnumpy()))
+    assert_almost_equal(x.grad, expected)
+
+
+def test_multi_input():
+    a = np.array([1.0, 2.0])
+    b = np.array([3.0, 4.0])
+    a.attach_grad()
+    b.attach_grad()
+    with autograd.record():
+        c = (a * b + a).sum()
+    c.backward()
+    assert_almost_equal(a.grad, b.asnumpy() + 1)
+    assert_almost_equal(b.grad, a.asnumpy())
+
+
+def test_head_grad():
+    x = np.array([1.0, 2.0])
+    x.attach_grad()
+    with autograd.record():
+        y = x * 3
+    y.backward(np.array([10.0, 20.0]))
+    assert_almost_equal(x.grad, onp.array([30.0, 60.0]))
+
+
+def test_grad_req_add():
+    x = np.array([1.0, 2.0])
+    x.attach_grad(grad_req="add")
+    for _ in range(3):
+        with autograd.record():
+            y = (x * 2).sum()
+        y.backward()
+    assert_almost_equal(x.grad, onp.array([6.0, 6.0]))
+
+
+def test_grad_req_write_overwrites():
+    x = np.array([1.0])
+    x.attach_grad()
+    for _ in range(3):
+        with autograd.record():
+            y = (x * 2).sum()
+        y.backward()
+    assert_almost_equal(x.grad, onp.array([2.0]))
+
+
+def test_is_recording_training():
+    assert not autograd.is_recording()
+    with autograd.record():
+        assert autograd.is_recording()
+        assert autograd.is_training()
+        with autograd.pause():
+            assert not autograd.is_recording()
+    with autograd.predict_mode():
+        assert not autograd.is_training()
+    with autograd.train_mode():
+        assert autograd.is_training()
+
+
+def test_detach():
+    x = np.array([2.0])
+    x.attach_grad()
+    with autograd.record():
+        y = x * 3
+        z = (y.detach() * x).sum()
+    z.backward()
+    # d z/dx = y.detach() = 6 (no flow through detached branch)
+    assert_almost_equal(x.grad, onp.array([6.0]))
+
+
+def test_no_record_no_tape():
+    x = np.array([1.0])
+    x.attach_grad()
+    y = x * 2  # outside record
+    with pytest.raises(Exception):
+        y.backward()
+
+
+def test_mark_variables():
+    x = np.array([1.0, 2.0])
+    g = np.zeros((2,))
+    autograd.mark_variables([x], [g])
+    with autograd.record():
+        y = (x ** 3).sum()
+    y.backward()
+    assert_almost_equal(g, 3 * x.asnumpy() ** 2)
+
+
+def test_grad_function():
+    x = np.array([1.0, 2.0, 3.0])
+    x.attach_grad()
+    with autograd.record():
+        y = (x * x).sum()
+    (gx,) = autograd.grad(y, [x])
+    assert_almost_equal(gx, 2 * x.asnumpy())
+    # .grad buffer untouched by autograd.grad
+    assert float(x.grad.asnumpy().sum()) == 0.0
+
+
+def test_retain_graph():
+    x = np.array([2.0])
+    x.attach_grad()
+    with autograd.record():
+        y = (x * x).sum()
+    y.backward(retain_graph=True)
+    y.backward()
+    assert_almost_equal(x.grad, onp.array([4.0]))
+
+
+def test_double_backward_freed():
+    x = np.array([2.0])
+    x.attach_grad()
+    with autograd.record():
+        y = (x * x).sum()
+    y.backward()
+    with pytest.raises(Exception):
+        y.backward()
+
+
+def test_custom_function():
+    class Sigmoid(autograd.Function):
+        def forward(self, x):
+            y = 1.0 / (1.0 + np.exp(-x))
+            self.save_for_backward(y)
+            return y
+
+        def backward(self, dy):
+            (y,) = self.saved_tensors
+            return dy * y * (1 - y)
+
+    x = np.random.uniform(size=(5,))
+    x.attach_grad()
+    f = Sigmoid()
+    with autograd.record():
+        y = f(x).sum()
+    y.backward()
+    s = 1 / (1 + onp.exp(-x.asnumpy()))
+    assert_almost_equal(x.grad, s * (1 - s), rtol=1e-4, atol=1e-5)
+
+
+def test_through_indexing():
+    x = np.array([[1.0, 2.0], [3.0, 4.0]])
+    x.attach_grad()
+    with autograd.record():
+        y = (x[0] * 2).sum()
+    y.backward()
+    assert_almost_equal(x.grad, onp.array([[2.0, 2.0], [0.0, 0.0]]))
+
+
+def test_through_reductions_and_broadcast():
+    x = np.ones((3, 4))
+    x.attach_grad()
+    with autograd.record():
+        y = (x.mean(axis=0) * np.arange(4)).sum()
+    y.backward()
+    expected = onp.tile(onp.arange(4) / 3.0, (3, 1))
+    assert_almost_equal(x.grad, expected)
+
+
+def test_numeric_gradient_elemwise():
+    check_numeric_gradient(lambda x: (np.tanh(x) * x).sum(),
+                           [onp.random.uniform(-1, 1, (4,))])
+
+
+def test_numeric_gradient_matmul():
+    check_numeric_gradient(
+        lambda a, b: np.dot(a, b).sum(),
+        [onp.random.uniform(-1, 1, (3, 4)),
+         onp.random.uniform(-1, 1, (4, 2))])
+
+
+def test_grad_through_inplace_read():
+    # after in-place mutation, tape uses the value at op time
+    x = np.array([1.0, 2.0])
+    x.attach_grad()
+    with autograd.record():
+        y = (x * 2).sum()
+    x += 100  # mutate after recording
+    y.backward()
+    assert_almost_equal(x.grad, onp.array([2.0, 2.0]))

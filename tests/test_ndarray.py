@@ -1,0 +1,178 @@
+"""NDArray semantics tests (reference: tests/python/unittest/test_ndarray.py)."""
+import numpy as onp
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import np
+from mxnet_tpu.test_utils import assert_almost_equal
+
+
+def test_creation():
+    a = np.zeros((2, 3))
+    assert a.shape == (2, 3)
+    assert a.dtype == onp.float32
+    b = np.ones((4,), dtype="int32")
+    assert b.dtype == onp.int32
+    c = np.array([[1, 2], [3, 4]])
+    assert c.shape == (2, 2)
+    d = np.full((2, 2), 7.0)
+    assert float(d.sum()) == 28.0
+    e = np.arange(10)
+    assert e.shape == (10,)
+    assert float(e[3]) == 3.0
+
+
+def test_arithmetic():
+    a = np.array([1.0, 2.0, 3.0])
+    b = np.array([4.0, 5.0, 6.0])
+    assert_almost_equal(a + b, onp.array([5, 7, 9]))
+    assert_almost_equal(a - b, onp.array([-3, -3, -3]))
+    assert_almost_equal(a * b, onp.array([4, 10, 18]))
+    assert_almost_equal(b / a, onp.array([4, 2.5, 2]))
+    assert_almost_equal(a ** 2, onp.array([1, 4, 9]))
+    assert_almost_equal(2 + a, onp.array([3, 4, 5]))
+    assert_almost_equal(2 - a, onp.array([1, 0, -1]))
+    assert_almost_equal(-a, onp.array([-1, -2, -3]))
+    assert_almost_equal(a @ b, onp.array(32.0))
+
+
+def test_inplace_version_bump():
+    a = np.ones((3,))
+    v0 = a._version
+    a += 1
+    assert a._version == v0 + 1
+    assert_almost_equal(a, onp.array([2, 2, 2]))
+    a *= 3
+    assert_almost_equal(a, onp.array([6, 6, 6]))
+
+
+def test_indexing():
+    a = np.arange(12).reshape((3, 4))
+    assert_almost_equal(a[1], onp.array([4, 5, 6, 7]))
+    assert_almost_equal(a[:, 1], onp.array([1, 5, 9]))
+    assert_almost_equal(a[1:, 2:], onp.array([[6, 7], [10, 11]]))
+    a[0, 0] = 100
+    assert float(a[0, 0]) == 100.0
+    a[1] = np.zeros((4,))
+    assert float(a[1].sum()) == 0.0
+    # boolean mask
+    b = np.array([1.0, -2.0, 3.0])
+    mask = b > 0
+    assert_almost_equal(b[mask], onp.array([1.0, 3.0]))
+
+
+def test_reshape_transpose():
+    a = np.arange(6).reshape((2, 3))
+    assert a.T.shape == (3, 2)
+    assert a.reshape(3, 2).shape == (3, 2)
+    assert a.reshape((-1,)).shape == (6,)
+    assert a.flatten().shape == (6,)
+    assert np.expand_dims(a, 0).shape == (1, 2, 3)
+    assert a.squeeze().shape == (2, 3)
+
+
+def test_reductions():
+    a = np.array([[1.0, 2.0], [3.0, 4.0]])
+    assert float(a.sum()) == 10.0
+    assert float(a.mean()) == 2.5
+    assert float(a.max()) == 4.0
+    assert float(a.min()) == 1.0
+    assert_almost_equal(a.sum(axis=0), onp.array([4, 6]))
+    assert_almost_equal(a.sum(axis=1, keepdims=True), onp.array([[3], [7]]))
+    assert int(a.argmax()) == 3
+
+
+def test_astype_copy():
+    a = np.array([1.5, 2.5])
+    b = a.astype("int32")
+    assert b.dtype == onp.int32
+    c = a.copy()
+    c += 1
+    assert float(a.sum()) == 4.0  # copy is independent
+
+
+def test_device_roundtrip():
+    a = np.ones((2, 2), device=mx.cpu())
+    assert a.device == mx.cpu(0)
+    b = a.as_in_ctx(mx.cpu(0))
+    assert b is a  # same device: no copy
+    c = a.copyto(mx.cpu(0))
+    assert c is not a
+
+
+def test_asnumpy_waitall():
+    a = np.ones((4, 4))
+    b = a * 2
+    onp.testing.assert_allclose(b.asnumpy(), onp.full((4, 4), 2.0))
+    mx.waitall()
+    b.wait_to_read()
+
+
+def test_concat_stack_split():
+    a = np.ones((2, 3))
+    b = np.zeros((2, 3))
+    c = np.concatenate([a, b], axis=0)
+    assert c.shape == (4, 3)
+    d = np.stack([a, b])
+    assert d.shape == (2, 2, 3)
+    parts = np.split(np.arange(10), 2)
+    assert parts[0].shape == (5,)
+
+
+def test_comparison_ops():
+    a = np.array([1.0, 2.0, 3.0])
+    b = np.array([2.0, 2.0, 2.0])
+    assert (a == b).asnumpy().tolist() == [False, True, False]
+    assert (a < b).asnumpy().tolist() == [True, False, False]
+    assert (a >= 2).asnumpy().tolist() == [False, True, True]
+
+
+def test_scalar_conversion():
+    a = np.array([3.5])
+    assert float(a) == 3.5
+    assert a.item() == pytest.approx(3.5)
+    with pytest.raises(ValueError):
+        bool(np.ones((2, 2)))
+
+
+def test_broadcasting():
+    a = np.ones((3, 1))
+    b = np.ones((1, 4))
+    assert (a + b).shape == (3, 4)
+    c = np.broadcast_to(np.ones((1, 3)), (2, 3))
+    assert c.shape == (2, 3)
+
+
+def test_einsum_matmul_dot():
+    a = np.array([[1.0, 2.0], [3.0, 4.0]])
+    b = np.array([[5.0, 6.0], [7.0, 8.0]])
+    assert_almost_equal(np.dot(a, b), onp.dot(a.asnumpy(), b.asnumpy()))
+    assert_almost_equal(np.einsum("ij,jk->ik", a, b),
+                        onp.dot(a.asnumpy(), b.asnumpy()))
+
+
+def test_numpy_protocol():
+    a = np.array([1.0, 2.0])
+    arr = onp.asarray(a)
+    assert arr.tolist() == [1.0, 2.0]
+
+
+def test_linalg():
+    a = np.array([[4.0, 0.0], [0.0, 9.0]])
+    w = np.linalg.cholesky(a)
+    assert_almost_equal(w, onp.array([[2.0, 0.0], [0.0, 3.0]]))
+    assert float(np.linalg.det(a)) == pytest.approx(36.0)
+    inv = np.linalg.inv(a)
+    assert_almost_equal(np.dot(a, inv), onp.eye(2))
+
+
+def test_random_shapes_seeded():
+    mx.seed(7)
+    a = np.random.uniform(size=(3, 3))
+    mx.seed(7)
+    b = np.random.uniform(size=(3, 3))
+    assert_almost_equal(a, b)
+    c = np.random.normal(2.0, 0.5, size=(1000,))
+    assert abs(float(c.mean()) - 2.0) < 0.1
+    d = np.random.randint(0, 10, size=(100,))
+    assert int(d.min()) >= 0 and int(d.max()) < 10
