@@ -1,0 +1,127 @@
+"""Headline benchmark: ResNet-50 training throughput (images/sec/chip).
+
+Prints ONE JSON line:
+  {"metric": ..., "value": N, "unit": "img/s", "vs_baseline": N}
+
+Baseline: the reference's best published single-GPU training number —
+ResNet-50 fp32 b=128 at 363.69 img/s on 1x V100 (BASELINE.md,
+docs perf.md:243-253). We train in bf16 (TPU-native dtype, the AMP
+policy's default) with the same global batch on one chip.
+
+Run on the TPU chip by default; falls back to CPU (honest, slow) if the
+chip is unreachable so the driver always gets a JSON line.
+"""
+from __future__ import annotations
+
+import json
+import sys
+import time
+
+BASELINE_IMG_S = 363.69  # V100 fp32 b=128 training (perf.md:243-253)
+BATCH = 128
+WARMUP = 3
+ITERS = 10
+
+
+def _probe_accelerator(timeout=90):
+    """Check device init in a subprocess — a wedged TPU tunnel HANGS
+    rather than raising, so an in-process try/except can't catch it."""
+    import subprocess
+
+    try:
+        out = subprocess.run(
+            [sys.executable, "-c",
+             "import jax; print(jax.devices()[0].platform)"],
+            capture_output=True, timeout=timeout, text=True)
+        if out.returncode == 0:
+            return out.stdout.strip().splitlines()[-1]
+    except subprocess.TimeoutExpired:
+        pass
+    return None
+
+
+def main():
+    import jax
+    import jax.numpy as jnp
+
+    platform = _probe_accelerator()
+    if platform is None or platform == "cpu":
+        print("accelerator unreachable; falling back to CPU",
+              file=sys.stderr)
+        jax.config.update("jax_platforms", "cpu")
+        platform = "cpu"
+    dev = jax.devices()[0]
+
+    batch = BATCH if platform != "cpu" else 4
+    iters = ITERS if platform != "cpu" else 1
+    warmup = WARMUP if platform != "cpu" else 1
+
+    import mxnet_tpu as mx
+    from mxnet_tpu.gluon.model_zoo.vision import resnet50_v1
+
+    mx.seed(0)
+    net = resnet50_v1(classes=1000)
+    net.initialize()
+    # bf16 params via the AMP policy (norm params stay fp32)
+    from mxnet_tpu import amp
+
+    amp.convert_hybrid_block(net, target_dtype="bfloat16")
+
+    # warm the deferred shapes with one tiny eager pass
+    net(mx.np.ones((2, 3, 224, 224), dtype="bfloat16"))
+
+    fwd, params = net.as_pure_function(training=True)
+    trainable = set(net.trainable_param_names())
+
+    rng = jax.random.PRNGKey(0)
+    x = jax.random.normal(rng, (batch, 3, 224, 224), jnp.bfloat16)
+    y = jax.random.randint(jax.random.PRNGKey(1), (batch,), 0, 1000)
+    momenta = {n: jnp.zeros_like(a) for n, a in params.items()
+               if n in trainable}
+
+    def train_step(params, momenta, x, y, key):
+        def loss_fn(pd):
+            out, new_pd = fwd(pd, key, x)
+            logp = jax.nn.log_softmax(out.astype(jnp.float32), axis=-1)
+            nll = -jnp.take_along_axis(logp, y[:, None], axis=-1).mean()
+            return nll, new_pd
+
+        (loss, new_pd), grads = jax.value_and_grad(
+            loss_fn, has_aux=True)(params)
+        new_params = {}
+        new_mom = {}
+        for n, p in params.items():
+            if n in momenta:
+                g = grads[n].astype(jnp.float32)
+                m = 0.9 * momenta[n].astype(jnp.float32) - 0.1 * g
+                new_mom[n] = m.astype(momenta[n].dtype)
+                new_params[n] = (p.astype(jnp.float32) + m).astype(p.dtype)
+            else:
+                new_params[n] = new_pd[n]
+        return new_params, new_mom, loss
+
+    step = jax.jit(train_step, donate_argnums=(0, 1))
+
+    key = jax.random.PRNGKey(2)
+    for _ in range(warmup):
+        params, momenta, loss = step(params, momenta, x, y, key)
+    loss.block_until_ready()
+
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        params, momenta, loss = step(params, momenta, x, y, key)
+    loss.block_until_ready()
+    dt = time.perf_counter() - t0
+
+    img_s = batch * iters / dt
+    print(json.dumps({
+        "metric": f"resnet50_train_bf16_b{batch}_imgs_per_sec_per_chip"
+                  + ("" if platform != "cpu" else "_CPU_FALLBACK"),
+        "value": round(img_s, 2),
+        "unit": "img/s",
+        "vs_baseline": round(img_s / BASELINE_IMG_S, 4),
+    }))
+
+
+if __name__ == "__main__":
+    main()

@@ -52,6 +52,8 @@ from . import optimizer  # noqa: E402
 from . import lr_scheduler  # noqa: E402
 from . import kvstore as kv  # noqa: E402
 from . import kvstore  # noqa: E402
+from . import io  # noqa: E402
+from . import recordio  # noqa: E402
 from . import gluon  # noqa: E402
 from . import util  # noqa: E402
 from . import runtime  # noqa: E402

@@ -277,6 +277,33 @@ def _default_init():
     return initializer.Uniform()
 
 
+def _traced_forward(block, params, training, param_data, key, input_datas):
+    """Shared trace body for the CachedOp jit and as_pure_function: run
+    block.forward with traced param stand-ins, a folded-key RNG provider,
+    and a state sink collecting aux writes. Returns (out_datas, sink)."""
+    sink = _StateSink()
+    counter = [0]
+
+    def key_provider():
+        counter[0] += 1
+        return jax.random.fold_in(key, counter[0])
+
+    wrapped = [NDArray(d) for d in input_datas]
+    with ag.suspend_taping(), ag._scope(training=training), \
+            _push_sink(sink), _random.key_provider(key_provider):
+        for name, p in params:
+            p._traced_data = NDArray(param_data[name])
+        try:
+            out = block.forward(*wrapped)
+        finally:
+            for _, p in params:
+                p._traced_data = None
+    out_datas = jax.tree_util.tree_map(
+        lambda a: a._data if isinstance(a, NDArray) else a, out,
+        is_leaf=lambda a: isinstance(a, NDArray))
+    return out_datas, sink
+
+
 # ---------------------------------------------------------------------------
 # HybridBlock
 # ---------------------------------------------------------------------------
@@ -349,26 +376,8 @@ class HybridBlock(Block):
         block = self
 
         def cached_fn(param_data, key, *input_datas):
-            sink = _StateSink()
-            counter = [0]
-
-            def key_provider():
-                counter[0] += 1
-                return jax.random.fold_in(key, counter[0])
-
-            wrapped = [NDArray(d) for d in input_datas]
-            with ag.suspend_taping(), ag._scope(training=training), \
-                    _push_sink(sink), _random.key_provider(key_provider):
-                for name, p in params:
-                    p._traced_data = NDArray(param_data[name])
-                try:
-                    out = block.forward(*wrapped)
-                finally:
-                    for _, p in params:
-                        p._traced_data = None
-            out_datas = jax.tree_util.tree_map(
-                lambda a: a._data if isinstance(a, NDArray) else a, out,
-                is_leaf=lambda a: isinstance(a, NDArray))
+            out_datas, sink = _traced_forward(
+                block, params, training, param_data, key, input_datas)
             # trace-time side effect: remember which params get aux updates
             # (per train/predict variant — predict traces have no BN updates)
             block._state_params[training] = list(sink.params)
@@ -442,6 +451,37 @@ class HybridBlock(Block):
         for hook in getattr(self, "_fwd_hooks", ()):
             hook(self, args, out)
         return out
+
+    # -- pure functional view ---------------------------------------------
+    def as_pure_function(self, training=False):
+        """Return (fn, params) where fn(params, key, *inputs) ->
+        (out, new_params) is a PURE jax function of the whole block.
+
+        This is the TPU-native export of the CachedOp: the function is
+        jit/pjit/shard_map-able, differentiable, and shardable; aux-state
+        updates (BN running stats) come back in new_params instead of
+        mutating. Used by bench.py, __graft_entry__ and the sharded
+        training paths.
+        """
+        params = sorted(self.collect_params().items())
+        block = self
+
+        def fn(param_data, key, *input_datas):
+            out_datas, sink = _traced_forward(
+                block, params, training, param_data, key, input_datas)
+            name_of = {id(p): n for n, p in params}
+            new_params = dict(param_data)
+            for p, v in zip(sink.params, sink.values):
+                new_params[name_of[id(p)]] = v
+            return out_datas, new_params
+
+        param_data = {n: p.data()._data for n, p in params}
+        return fn, param_data
+
+    def trainable_param_names(self):
+        """Names of params with grad_req != 'null' (BN stats excluded)."""
+        return [n for n, p in sorted(self.collect_params().items())
+                if p.grad_req != "null"]
 
     # -- export ------------------------------------------------------------
     def export(self, path, epoch=0, remove_amp_cast=True):  # noqa: ARG002

@@ -1,0 +1,16 @@
+"""Estimator fit loop (reference: gluon/contrib/estimator/)."""
+from .estimator import Estimator  # noqa: F401
+from .event_handler import (  # noqa: F401
+    BatchBegin,
+    BatchEnd,
+    CheckpointHandler,
+    EarlyStoppingHandler,
+    EpochBegin,
+    EpochEnd,
+    LoggingHandler,
+    MetricHandler,
+    StoppingHandler,
+    TrainBegin,
+    TrainEnd,
+    ValidationHandler,
+)

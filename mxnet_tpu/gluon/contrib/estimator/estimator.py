@@ -1,0 +1,115 @@
+"""Estimator — high-level fit loop (reference: estimator/estimator.py)."""
+from __future__ import annotations
+
+from .... import autograd
+from ....device import current_device
+from ...metric import Accuracy, EvalMetric, Loss as LossMetric
+from ...trainer import Trainer
+from .event_handler import (
+    BatchBegin,
+    BatchEnd,
+    EpochBegin,
+    EpochEnd,
+    LoggingHandler,
+    MetricHandler,
+    StoppingHandler,
+    TrainBegin,
+    TrainEnd,
+)
+
+__all__ = ["Estimator"]
+
+
+class Estimator:
+    def __init__(self, net, loss, train_metrics=None, val_metrics=None,
+                 initializer=None, trainer=None, device=None, context=None,
+                 evaluation_loss=None, val_net=None, val_loss=None,  # noqa: ARG002
+                 batch_processor=None):  # noqa: ARG002
+        self.net = net
+        self.loss = loss
+        self.device = device or context or current_device()
+        if train_metrics is None:
+            train_metrics = [Accuracy()]
+        elif isinstance(train_metrics, EvalMetric):
+            train_metrics = [train_metrics]
+        self.train_metrics = list(train_metrics) + [LossMetric("train_loss")]
+        if val_metrics is None:
+            val_metrics = [Accuracy(name="val_accuracy")]
+        elif isinstance(val_metrics, EvalMetric):
+            val_metrics = [val_metrics]
+        self.val_metrics = list(val_metrics)
+        if initializer is not None:
+            net.initialize(init=initializer, device=self.device)
+        else:
+            try:
+                for p in net.collect_params().values():
+                    p._check_initialized()
+            except Exception:
+                net.initialize(device=self.device)
+        self.trainer = trainer or Trainer(
+            net.collect_params(), "sgd", {"learning_rate": 0.001})
+
+    def _batch_fn(self, batch):
+        data, label = batch[0], batch[1]
+        return (data.as_in_ctx(self.device), label.as_in_ctx(self.device))
+
+    def evaluate(self, val_data, batch_fn=None):
+        """Run validation using the dedicated val metrics — train metric
+        objects are left untouched (reference keeps the two sets separate)."""
+        for m in self.val_metrics:
+            m.reset()
+        for batch in val_data:
+            data, label = (batch_fn or self._batch_fn)(batch)
+            pred = self.net(data)
+            for m in self.val_metrics:
+                m.update(label, pred)
+        return {m.get()[0]: m.get()[1] for m in self.val_metrics}
+
+    def fit(self, train_data, val_data=None, epochs=None, event_handlers=None,
+            batches=None, batch_fn=None):
+        if (epochs is None) == (batches is None):
+            raise ValueError(
+                "fit() needs exactly one of epochs / batches "
+                "(reference: estimator.py fit)")
+        handlers = list(event_handlers or [])
+        stopper = StoppingHandler(epochs, batches)
+        handlers.append(stopper)
+        if not any(isinstance(h, MetricHandler) for h in handlers):
+            handlers.append(MetricHandler(self.train_metrics))
+        if not any(isinstance(h, LoggingHandler) for h in handlers):
+            handlers.append(LoggingHandler(metrics=self.train_metrics))
+        handlers.sort(key=lambda h: getattr(h, "priority", 0))
+
+        def fire(kind, *args, **kwargs):
+            stop = False
+            for h in handlers:
+                if isinstance(h, kind_map[kind]):
+                    if getattr(h, kind)(self, *args, **kwargs):
+                        stop = True
+            return stop
+
+        kind_map = {
+            "train_begin": TrainBegin, "train_end": TrainEnd,
+            "epoch_begin": EpochBegin, "epoch_end": EpochEnd,
+            "batch_begin": BatchBegin, "batch_end": BatchEnd,
+        }
+
+        fire("train_begin")
+        while not stopper.stop_training:
+            fire("epoch_begin")
+            for batch in train_data:
+                fire("batch_begin")
+                data, label = (batch_fn or self._batch_fn)(batch)
+                with autograd.record():
+                    pred = self.net(data)
+                    loss = self.loss(pred, label)
+                loss.backward()
+                self.trainer.step(data.shape[0])
+                if fire("batch_end", pred=pred, label=label, loss=loss):
+                    break
+            if val_data is not None:
+                self.evaluate(val_data, batch_fn)
+            if fire("epoch_end"):
+                break
+        fire("train_end")
+        return self

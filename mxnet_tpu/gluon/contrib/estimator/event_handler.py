@@ -1,0 +1,257 @@
+"""Estimator event handlers (reference: estimator/event_handler.py:37-336)."""
+from __future__ import annotations
+
+import logging
+import os
+import time
+
+__all__ = ["TrainBegin", "TrainEnd", "EpochBegin", "EpochEnd", "BatchBegin",
+           "BatchEnd", "StoppingHandler", "MetricHandler", "LoggingHandler",
+           "ValidationHandler", "CheckpointHandler", "EarlyStoppingHandler"]
+
+
+class TrainBegin:
+    def train_begin(self, estimator, *args, **kwargs):
+        pass
+
+
+class TrainEnd:
+    def train_end(self, estimator, *args, **kwargs):
+        pass
+
+
+class EpochBegin:
+    def epoch_begin(self, estimator, *args, **kwargs):
+        pass
+
+
+class EpochEnd:
+    def epoch_end(self, estimator, *args, **kwargs):
+        pass
+
+
+class BatchBegin:
+    def batch_begin(self, estimator, *args, **kwargs):
+        pass
+
+
+class BatchEnd:
+    def batch_end(self, estimator, *args, **kwargs):
+        pass
+
+
+class StoppingHandler(TrainBegin, BatchEnd, EpochEnd):
+    """Stop at max_epoch/max_batch (reference: StoppingHandler)."""
+
+    def __init__(self, max_epoch=None, max_batch=None):
+        self.max_epoch = max_epoch
+        self.max_batch = max_batch
+        self.current_batch = 0
+        self.current_epoch = 0
+        self.stop_training = False
+
+    def train_begin(self, estimator, *args, **kwargs):
+        self.current_batch = 0
+        self.current_epoch = 0
+
+    def batch_end(self, estimator, *args, **kwargs):
+        self.current_batch += 1
+        if self.max_batch and self.current_batch >= self.max_batch:
+            self.stop_training = True
+        return self.stop_training
+
+    def epoch_end(self, estimator, *args, **kwargs):
+        self.current_epoch += 1
+        if self.max_epoch and self.current_epoch >= self.max_epoch:
+            self.stop_training = True
+        return self.stop_training
+
+
+class MetricHandler(EpochBegin, BatchEnd):
+    """Update train metrics per batch (reference: MetricHandler:122)."""
+
+    def __init__(self, metrics, priority=-1000):
+        self.metrics = metrics
+        self.priority = priority
+
+    def epoch_begin(self, estimator, *args, **kwargs):
+        for m in self.metrics:
+            m.reset()
+
+    def batch_end(self, estimator, *args, **kwargs):
+        pred = kwargs.get("pred")
+        label = kwargs.get("label")
+        loss = kwargs.get("loss")
+        for m in self.metrics:
+            from ...metric import Loss as LossMetric
+
+            if isinstance(m, LossMetric):
+                m.update(0, loss)
+            else:
+                m.update(label, pred)
+
+
+class LoggingHandler(TrainBegin, TrainEnd, EpochBegin, EpochEnd, BatchEnd):
+    """Periodic logging (reference: LoggingHandler:226)."""
+
+    def __init__(self, log_interval="epoch", metrics=None, priority=1000):
+        self.log_interval = log_interval
+        self.metrics = metrics or []
+        self.priority = priority
+        self.batch_index = 0
+        self.current_epoch = 0
+        self.logger = logging.getLogger("mxnet_tpu.estimator")
+
+    def train_begin(self, estimator, *args, **kwargs):
+        self.train_start = time.time()
+        self.logger.info("Training begin")
+
+    def train_end(self, estimator, *args, **kwargs):
+        self.logger.info("Training done in %.1fs",
+                         time.time() - self.train_start)
+
+    def epoch_begin(self, estimator, *args, **kwargs):
+        self.epoch_start = time.time()
+        self.batch_index = 0
+
+    def epoch_end(self, estimator, *args, **kwargs):
+        msg = f"[Epoch {self.current_epoch}] done in " \
+              f"{time.time() - self.epoch_start:.1f}s"
+        for m in self.metrics:
+            name, value = m.get()
+            msg += f" {name}={value:.4f}"
+        self.logger.info(msg)
+        self.current_epoch += 1
+
+    def batch_end(self, estimator, *args, **kwargs):
+        if isinstance(self.log_interval, int):
+            self.batch_index += 1
+            if self.batch_index % self.log_interval == 0:
+                msg = f"[Epoch {self.current_epoch}][Batch {self.batch_index}]"
+                for m in self.metrics:
+                    name, value = m.get()
+                    msg += f" {name}={value:.4f}"
+                self.logger.info(msg)
+
+
+class ValidationHandler(TrainBegin, BatchEnd, EpochEnd):
+    """Run validation periodically (reference: ValidationHandler)."""
+
+    def __init__(self, val_data, eval_fn, epoch_period=1, batch_period=None,
+                 priority=-1000):
+        self.val_data = val_data
+        self.eval_fn = eval_fn
+        self.epoch_period = epoch_period
+        self.batch_period = batch_period
+        self.priority = priority
+        self.current_epoch = 0
+        self.current_batch = 0
+
+    def batch_end(self, estimator, *args, **kwargs):
+        self.current_batch += 1
+        if self.batch_period and self.current_batch % self.batch_period == 0:
+            self.eval_fn(self.val_data)
+
+    def epoch_end(self, estimator, *args, **kwargs):
+        self.current_epoch += 1
+        if self.epoch_period and self.current_epoch % self.epoch_period == 0:
+            self.eval_fn(self.val_data)
+
+
+class CheckpointHandler(TrainBegin, BatchEnd, EpochEnd):
+    """Periodic / best-only checkpointing (reference: CheckpointHandler:336)."""
+
+    def __init__(self, model_dir, model_prefix="model", monitor=None,
+                 verbose=0, save_best=False, mode="auto", epoch_period=1,
+                 batch_period=None, max_checkpoints=5,
+                 resume_from_checkpoint=False):  # noqa: ARG002
+        self.model_dir = model_dir
+        self.model_prefix = model_prefix
+        self.monitor = monitor
+        self.save_best = save_best
+        self.epoch_period = epoch_period
+        self.batch_period = batch_period
+        self.max_checkpoints = max_checkpoints
+        self.verbose = verbose
+        self.current_epoch = 0
+        self.current_batch = 0
+        self.best = None
+        if mode == "min" or (mode == "auto" and monitor is not None
+                             and "loss" in monitor.get()[0]):
+            self.monitor_op = lambda new, best: new < best
+        else:
+            self.monitor_op = lambda new, best: new > best
+        self.saved = []
+        os.makedirs(model_dir, exist_ok=True)
+
+    def _save(self, estimator, tag, rotate=True):
+        path = os.path.join(self.model_dir,
+                            f"{self.model_prefix}-{tag}.params")
+        estimator.net.save_parameters(path)
+        if rotate:
+            # rotation applies only to periodic checkpoints; the 'best'
+            # checkpoint overwrites in place and is never rotated away
+            self.saved.append(path)
+            while len(self.saved) > self.max_checkpoints:
+                old = self.saved.pop(0)
+                if os.path.exists(old):
+                    os.remove(old)
+        if estimator.trainer is not None:
+            estimator.trainer.save_states(path + ".states")
+
+    def batch_end(self, estimator, *args, **kwargs):
+        self.current_batch += 1
+        if self.batch_period and self.current_batch % self.batch_period == 0:
+            self._save(estimator, f"batch{self.current_batch}")
+
+    def epoch_end(self, estimator, *args, **kwargs):
+        self.current_epoch += 1
+        if self.epoch_period and self.current_epoch % self.epoch_period == 0:
+            if self.save_best and self.monitor is not None:
+                _, value = self.monitor.get()
+                if self.best is None or self.monitor_op(value, self.best):
+                    self.best = value
+                    self._save(estimator, "best", rotate=False)
+            else:
+                self._save(estimator, f"epoch{self.current_epoch}")
+
+
+class EarlyStoppingHandler(TrainBegin, EpochEnd, TrainEnd):
+    """Stop when a metric stops improving (reference: EarlyStoppingHandler)."""
+
+    def __init__(self, monitor, min_delta=0, patience=0, mode="auto",
+                 baseline=None):
+        self.monitor = monitor
+        self.min_delta = min_delta
+        self.patience = patience
+        self.baseline = baseline
+        self.wait = 0
+        self.stopped_epoch = 0
+        self.current_epoch = 0
+        self.stop_training = False
+        if mode == "min" or (mode == "auto" and "loss" in monitor.get()[0]):
+            self.monitor_op = lambda new, best: new < best - min_delta
+            self.best = float("inf")
+        else:
+            self.monitor_op = lambda new, best: new > best + min_delta
+            self.best = -float("inf")
+        if baseline is not None:
+            self.best = baseline
+
+    def epoch_end(self, estimator, *args, **kwargs):
+        _, value = self.monitor.get()
+        if value == value and self.monitor_op(value, self.best):
+            self.best = value
+            self.wait = 0
+        else:
+            self.wait += 1
+            if self.wait >= self.patience:
+                self.stopped_epoch = self.current_epoch
+                self.stop_training = True
+        self.current_epoch += 1
+        return self.stop_training
+
+    def train_end(self, estimator, *args, **kwargs):
+        if self.stopped_epoch > 0:
+            logging.getLogger("mxnet_tpu.estimator").info(
+                "Early stop at epoch %d", self.stopped_epoch)

@@ -1,0 +1,300 @@
+"""Legacy data-iterator API (reference: python/mxnet/io/ + src/io/ —
+MXNET_REGISTER_IO_ITER iterators: MNISTIter, ImageRecordIter, CSVIter,
+NDArrayIter...).
+
+TPU re-design: the C++ prefetcher/batchloader threads (iter_prefetcher.h)
+are replaced by the DataLoader's prefetching thread pool; these classes keep
+the DataIter surface (provide_data/provide_label, DataBatch with pad) for
+reference-era training scripts.
+"""
+from __future__ import annotations
+
+import os
+from collections import namedtuple
+
+import numpy as _np
+
+from ..ndarray.ndarray import NDArray
+from .. import numpy as mnp
+
+__all__ = ["DataDesc", "DataBatch", "DataIter", "NDArrayIter", "CSVIter",
+           "MNISTIter", "ImageRecordIter", "ResizeIter", "PrefetchingIter"]
+
+
+class DataDesc(namedtuple("DataDesc", ["name", "shape"])):
+    def __new__(cls, name, shape, dtype=_np.float32, layout="NCHW"):
+        ret = super().__new__(cls, name, shape)
+        ret.dtype = dtype
+        ret.layout = layout
+        return ret
+
+
+class DataBatch:
+    """One batch (reference: io.DataBatch): data/label lists + pad count."""
+
+    def __init__(self, data, label=None, pad=0, index=None,
+                 provide_data=None, provide_label=None):
+        self.data = data
+        self.label = label
+        self.pad = pad
+        self.index = index
+        self.provide_data = provide_data
+        self.provide_label = provide_label
+
+
+class DataIter:
+    """Base iterator (reference: io.DataIter)."""
+
+    def __init__(self, batch_size=0):
+        self.batch_size = batch_size
+
+    def __iter__(self):
+        return self
+
+    def reset(self):
+        pass
+
+    def next(self):
+        raise NotImplementedError
+
+    def __next__(self):
+        return self.next()
+
+    def iter_next(self):
+        try:
+            self._next_batch = self.next()
+            return True
+        except StopIteration:
+            return False
+
+    def getdata(self):
+        return self._next_batch.data
+
+    def getlabel(self):
+        return self._next_batch.label
+
+    def getpad(self):
+        return self._next_batch.pad
+
+
+class NDArrayIter(DataIter):
+    """Iterate over in-memory arrays (reference: io.NDArrayIter):
+    shuffle, last_batch_handle pad/discard/roll_over."""
+
+    def __init__(self, data, label=None, batch_size=1, shuffle=False,
+                 last_batch_handle="pad", data_name="data",
+                 label_name="softmax_label"):
+        super().__init__(batch_size)
+        self._data = self._init_arrays(data, data_name)
+        self._label = self._init_arrays(label, label_name)
+        self._shuffle = shuffle
+        self._last = last_batch_handle
+        self._n = self._data[0][1].shape[0]
+        self._order = _np.arange(self._n)
+        self._cursor = 0
+        self._leftover = None  # roll_over remainder from the prior epoch
+        self.reset()
+
+    @staticmethod
+    def _init_arrays(arrays, default_name):
+        if arrays is None:
+            return []
+        if isinstance(arrays, (list, tuple)):
+            arrays = {f"{default_name}{i}" if i else default_name: a
+                      for i, a in enumerate(arrays)}
+        elif not isinstance(arrays, dict):
+            arrays = {default_name: arrays}
+        out = []
+        for name, a in arrays.items():
+            if isinstance(a, NDArray):
+                a = a.asnumpy()
+            out.append((name, _np.asarray(a)))
+        return out
+
+    @property
+    def provide_data(self):
+        return [DataDesc(n, (self.batch_size,) + a.shape[1:], a.dtype)
+                for n, a in self._data]
+
+    @property
+    def provide_label(self):
+        return [DataDesc(n, (self.batch_size,) + a.shape[1:], a.dtype)
+                for n, a in self._label]
+
+    def reset(self):
+        if self._shuffle:
+            _np.random.shuffle(self._order)
+        self._cursor = 0
+
+    def next(self):
+        prefix = None
+        if self._leftover is not None:
+            # roll_over: last epoch's remainder starts this epoch's batch
+            prefix, self._leftover = self._leftover, None
+        need = self.batch_size - (len(prefix) if prefix is not None else 0)
+        if self._cursor >= self._n and prefix is None:
+            raise StopIteration
+        end = self._cursor + need
+        idx = self._order[self._cursor : end]
+        pad = 0
+        if end > self._n:
+            if self._last == "discard":
+                self._cursor = end
+                raise StopIteration
+            if self._last == "pad":
+                pad = end - self._n
+                idx = _np.concatenate([idx, self._order[: pad]])
+            elif self._last == "roll_over":
+                # withhold the short remainder until the next epoch
+                self._cursor = end
+                self._leftover = (_np.concatenate([prefix, idx])
+                                  if prefix is not None else idx)
+                raise StopIteration
+        self._cursor = end
+        if prefix is not None:
+            idx = _np.concatenate([prefix, idx])
+        data = [mnp.array(a[idx]) for _, a in self._data]
+        label = [mnp.array(a[idx]) for _, a in self._label]
+        return DataBatch(data, label, pad=pad,
+                         provide_data=self.provide_data,
+                         provide_label=self.provide_label)
+
+
+class CSVIter(NDArrayIter):
+    """CSV file iterator (reference: src/io/iter_csv.cc)."""
+
+    def __init__(self, data_csv, data_shape, label_csv=None, label_shape=(1,),
+                 batch_size=1, **kwargs):
+        data = _np.loadtxt(data_csv, delimiter=",", dtype=_np.float32)
+        data = data.reshape((-1,) + tuple(data_shape))
+        label = None
+        if label_csv is not None:
+            label = _np.loadtxt(label_csv, delimiter=",", dtype=_np.float32)
+            label = label.reshape((-1,) + tuple(label_shape))
+        super().__init__(data, label, batch_size, **kwargs)
+
+
+class MNISTIter(NDArrayIter):
+    """MNIST idx-file iterator (reference: src/io/iter_mnist.cc)."""
+
+    def __init__(self, image=None, label=None, batch_size=128, shuffle=True,
+                 flat=False, path_root=None, train=True, **kwargs):  # noqa: ARG002
+        from ..gluon.data.vision import MNIST
+
+        root = path_root or os.path.dirname(image or "") or \
+            "~/.mxnet/datasets/mnist"
+        ds = MNIST(root=root, train=train)
+        imgs = ds._data.astype(_np.float32) / 255.0
+        imgs = imgs.reshape(len(imgs), -1) if flat else \
+            imgs.transpose(0, 3, 1, 2)
+        super().__init__(imgs, ds._label.astype(_np.float32), batch_size,
+                         shuffle=shuffle)
+
+
+class ImageRecordIter(DataIter):
+    """RecordIO image iterator (reference: src/io/iter_image_recordio_2.cc).
+
+    Streams packed images from a .rec file written by im2rec/pack_img.
+    """
+
+    def __init__(self, path_imgrec, data_shape, batch_size=1,
+                 shuffle=False, label_width=1, **kwargs):  # noqa: ARG002
+        super().__init__(batch_size)
+        from ..recordio import IndexedRecordIO, unpack_img
+
+        self._rec = IndexedRecordIO(path_imgrec)
+        self._unpack = unpack_img
+        self._shape = tuple(data_shape)
+        self._shuffle = shuffle
+        self._order = _np.arange(len(self._rec))
+        self._cursor = 0
+        self.reset()
+
+    def reset(self):
+        if self._shuffle:
+            _np.random.shuffle(self._order)
+        self._cursor = 0
+
+    def next(self):
+        if self._cursor + self.batch_size > len(self._order):
+            raise StopIteration
+        imgs, labels = [], []
+        for i in self._order[self._cursor : self._cursor + self.batch_size]:
+            header, img = self._unpack(self._rec.read_idx(int(i)))
+            if img.ndim == 2:
+                img = img[:, :, None]
+            imgs.append(img.transpose(2, 0, 1).astype(_np.float32))
+            labels.append(_np.float32(header.label)
+                          if _np.isscalar(header.label) or
+                          getattr(header.label, "ndim", 0) == 0
+                          else header.label)
+        self._cursor += self.batch_size
+        return DataBatch([mnp.array(_np.stack(imgs))],
+                         [mnp.array(_np.stack(labels))])
+
+
+class ResizeIter(DataIter):
+    """Resize an iterator to a fixed number of batches (reference:
+    io.ResizeIter)."""
+
+    def __init__(self, data_iter, size, reset_internal=True):
+        super().__init__(data_iter.batch_size)
+        self._iter = data_iter
+        self._size = size
+        self._reset_internal = reset_internal
+        self._count = 0
+
+    def reset(self):
+        self._count = 0
+        if self._reset_internal:
+            self._iter.reset()
+
+    def next(self):
+        if self._count >= self._size:
+            raise StopIteration
+        self._count += 1
+        try:
+            return self._iter.next()
+        except StopIteration:
+            self._iter.reset()
+            return self._iter.next()
+
+
+class PrefetchingIter(DataIter):
+    """Threaded prefetcher over one or more iterators (reference:
+    io.PrefetchingIter over iter_prefetcher.h)."""
+
+    def __init__(self, iters, rename_data=None, rename_label=None):  # noqa: ARG002
+        import queue
+        import threading
+
+        if not isinstance(iters, (list, tuple)):
+            iters = [iters]
+        super().__init__(iters[0].batch_size)
+        self._iters = iters
+        self._queue = queue.Queue(maxsize=4)
+        self._stop = threading.Event()
+
+        def worker():
+            while not self._stop.is_set():
+                try:
+                    batches = [it.next() for it in self._iters]
+                except StopIteration:
+                    self._queue.put(None)
+                    return
+                self._queue.put(batches)
+
+        self._thread = threading.Thread(target=worker, daemon=True)
+        self._thread.start()
+
+    def next(self):
+        if self._stop.is_set():
+            raise StopIteration  # already exhausted; producer is gone
+        item = self._queue.get()
+        if item is None:
+            self._stop.set()
+            raise StopIteration
+        return item[0] if len(item) == 1 else item
+
+    def reset(self):
+        raise NotImplementedError("recreate PrefetchingIter to reset")

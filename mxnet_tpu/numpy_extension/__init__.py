@@ -14,7 +14,10 @@ from ..autograd import is_training
 from ..ndarray.ndarray import NDArray, apply_op
 from ..ops import nn as _nn
 
+from .control_flow import cond, foreach, while_loop  # noqa: F401
+
 __all__ = [
+    "cond", "foreach", "while_loop",
     "activation", "leaky_relu", "relu", "sigmoid", "softmax", "log_softmax",
     "softmin", "fully_connected", "convolution", "deconvolution", "pooling",
     "batch_norm", "layer_norm", "group_norm", "instance_norm", "rms_norm",

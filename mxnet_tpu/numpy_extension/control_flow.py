@@ -1,0 +1,152 @@
+"""Control-flow ops (reference: src/operator/control_flow.cc — npx.foreach,
+npx.while_loop, npx.cond).
+
+TPU-native: these lower to lax.scan / lax.while_loop / lax.cond so they are
+traceable inside a hybridized block (the reference needed special stateful
+CachedOp machinery; XLA control-flow HLOs replace it). Eager mode runs the
+same lax ops immediately. Autograd flows through scan/cond via apply_op;
+while_loop is forward-only (same as the reference, which has no
+while_loop gradient).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ..ndarray.ndarray import NDArray, apply_op
+
+__all__ = ["foreach", "while_loop", "cond"]
+
+
+def _unwrap_tree(t):
+    return jax.tree_util.tree_map(
+        lambda a: a._data if isinstance(a, NDArray) else a, t,
+        is_leaf=lambda a: isinstance(a, NDArray))
+
+
+def _wrap_tree(t):
+    return jax.tree_util.tree_map(NDArray, t)
+
+
+def foreach(body, data, init_states):
+    """Scan `body(x_t, states) -> (out_t, new_states)` over axis 0 of data.
+
+    Reference: npx.foreach (control_flow.cc). Lowers to ONE lax.scan —
+    XLA pipelines the loop; gradients supported (scan has a VJP).
+    """
+    multi_data = isinstance(data, (list, tuple))
+    datas = list(data) if multi_data else [data]
+    multi_state = isinstance(init_states, (list, tuple))
+    states0 = list(init_states) if multi_state else [init_states]
+    nd_inputs = datas + states0
+
+    def fn(*flat):
+        xs = flat[: len(datas)]
+        st = list(flat[len(datas):])
+
+        def step(carry, x_slices):
+            x_in = [NDArray(s) for s in x_slices]
+            s_in = [NDArray(c) for c in carry]
+            out, new_states = body(
+                x_in if multi_data else x_in[0],
+                s_in if multi_state else s_in[0])
+            outs = [o._data for o in (out if isinstance(out, (list, tuple))
+                                      else [out])]
+            ns = [s._data for s in (new_states
+                                    if isinstance(new_states, (list, tuple))
+                                    else [new_states])]
+            return tuple(ns), tuple(outs)
+
+        final, stacked = lax.scan(step, tuple(st), tuple(xs))
+        return tuple(stacked) + tuple(final)
+
+    result = apply_op(fn, *nd_inputs, name="foreach")
+    if not isinstance(result, tuple):
+        result = (result,)
+    # count outputs by running shapes: outs come first, then states
+    n_states = len(states0)
+    outs = result[: len(result) - n_states]
+    finals = result[len(result) - n_states:]
+    out = outs if len(outs) > 1 else outs[0]
+    fin = list(finals) if multi_state else finals[0]
+    return out, fin
+
+
+def while_loop(cond, func, loop_vars, max_iterations=None):
+    """While loop (reference: npx.while_loop, python/mxnet contrib
+    while_loop contract): `cond(*loop_vars) -> bool`,
+    `func(*loop_vars) -> (step_output, new_loop_vars)`; returns
+    `(outputs, final_loop_vars)` where outputs are stacked along a new
+    first dim of size `max_iterations` (rows beyond the actual step count
+    keep their initialized zeros, matching the reference's symbolic-mode
+    padding). Forward-only, like the reference.
+    """
+    if max_iterations is None:
+        raise ValueError("max_iterations is required (reference parity)")
+    multi = isinstance(loop_vars, (list, tuple))
+    lv = list(loop_vars) if multi else [loop_vars]
+    datas = tuple(v._data if isinstance(v, NDArray) else jnp.asarray(v)
+                  for v in lv)
+
+    def run_cond(vars_):
+        out = cond(*[NDArray(c) for c in vars_])
+        return (out._data if isinstance(out, NDArray)
+                else jnp.asarray(out)).reshape(()).astype(bool)
+
+    def run_func(vars_):
+        step_out, new_vars = func(*[NDArray(c) for c in vars_])
+        outs = step_out if isinstance(step_out, (list, tuple)) else [step_out]
+        nv = new_vars if isinstance(new_vars, (list, tuple)) else [new_vars]
+        return (
+            tuple(o._data if isinstance(o, NDArray) else jnp.asarray(o)
+                  for o in outs),
+            tuple(v._data if isinstance(v, NDArray) else jnp.asarray(v)
+                  for v in nv),
+        )
+
+    # shapes of step outputs via abstract eval (no FLOPs)
+    out_shapes = jax.eval_shape(lambda vs: run_func(vs)[0], datas)
+    buffers = tuple(jnp.zeros((max_iterations,) + s.shape, s.dtype)
+                    for s in out_shapes)
+
+    def cond_fn(carry):
+        i, vars_, _ = carry
+        return jnp.logical_and(i < max_iterations, run_cond(vars_))
+
+    def body_fn(carry):
+        i, vars_, bufs = carry
+        outs, new_vars = run_func(vars_)
+        bufs = tuple(lax.dynamic_update_index_in_dim(b, o, i, 0)
+                     for b, o in zip(bufs, outs))
+        return i + 1, new_vars, bufs
+
+    _, final_vars, bufs = lax.while_loop(
+        cond_fn, body_fn, (jnp.int32(0), datas, buffers))
+    outputs = [NDArray(b) for b in bufs]
+    finals = [NDArray(f) for f in final_vars]
+    out = outputs if len(outputs) > 1 else outputs[0]
+    fin = finals if multi else finals[0]
+    return out, fin
+
+
+def cond(pred, then_func, else_func, inputs=()):
+    """Conditional (reference: npx.cond). Both branches traced; XLA picks at
+    run time — differentiable."""
+    ins = list(inputs) if isinstance(inputs, (list, tuple)) else [inputs]
+    nd_inputs = [pred] + ins
+
+    def fn(p, *xs):
+        p_bool = p.reshape(()).astype(bool)
+
+        def tb(args):
+            out = then_func(*[NDArray(a) for a in args])
+            return _unwrap_tree(out)
+
+        def eb(args):
+            out = else_func(*[NDArray(a) for a in args])
+            return _unwrap_tree(out)
+
+        return lax.cond(p_bool, tb, eb, tuple(xs))
+
+    return apply_op(fn, *nd_inputs, name="cond")

@@ -11,6 +11,7 @@ Modules:
   collectives — psum/all_gather/reduce_scatter/ppermute wrappers
   data_parallel — sharded training step builder (grad psum over 'dp')
 """
-from . import collectives, mesh  # noqa: F401
+from . import collectives, mesh, ring_attention  # noqa: F401
 from .data_parallel import make_data_parallel_step  # noqa: F401
 from .mesh import make_mesh  # noqa: F401
+from .ring_attention import ring_attention_sharded  # noqa: F401

@@ -48,7 +48,8 @@ def allreduce_mean(tree, mesh, axis="dp"):
 def all_gather(x, mesh, axis="dp", tiled=True):
     """All-gather along a mesh axis (reference analog: broadcast fan-out)."""
 
-    @partial(shard_map, mesh=mesh, in_specs=P(axis), out_specs=P())
+    @partial(shard_map, mesh=mesh, in_specs=P(axis), out_specs=P(),
+             check_rep=False)
     def _ag(v):
         return jax.lax.all_gather(v, axis, tiled=tiled)
 
@@ -56,9 +57,14 @@ def all_gather(x, mesh, axis="dp", tiled=True):
 
 
 def reduce_scatter(x, mesh, axis="dp"):
-    """Reduce-scatter along a mesh axis (ZeRO-style sharded grads)."""
+    """Reduce-scatter along a mesh axis (ZeRO-style sharded grads).
 
-    @partial(shard_map, mesh=mesh, in_specs=P(axis), out_specs=P(axis))
+    Input: per-device full copies (replicated layout); output: each device
+    keeps the reduced 1/n slice, laid out sharded over `axis`.
+    """
+
+    @partial(shard_map, mesh=mesh, in_specs=P(), out_specs=P(axis),
+             check_rep=False)
     def _rs(v):
         return jax.lax.psum_scatter(v, axis, tiled=True)
 

@@ -59,11 +59,15 @@ def make_shard_map_step(loss_fn, update_fn, mesh, axis="dp"):
     """Explicit-collective variant: per-device bodies + lax.psum on grads."""
     from jax.experimental.shard_map import shard_map
 
+    # check_rep=False: jax's replication checker rewrites grads of
+    # replicated (P()) inputs with an extra psum, inflating them by the
+    # axis size; with it off we own the collectives (explicit pmean).
     @partial(
         shard_map,
         mesh=mesh,
         in_specs=(P(), P(), P(axis), P()),
         out_specs=(P(), P(), P()),
+        check_rep=False,
     )
     def body(params, opt_state, batch, lr):
         loss, grads = jax.value_and_grad(loss_fn)(params, batch)

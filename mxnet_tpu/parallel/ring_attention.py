@@ -1,0 +1,107 @@
+"""Ring attention — sequence/context parallelism over the ICI ring.
+
+A capability the reference lacks entirely (SURVEY.md §5 "Long-context /
+sequence parallelism — absent"), built TPU-first: the sequence axis is
+sharded over a mesh axis; each device holds a Q/K/V shard and K/V blocks
+rotate around the ring via lax.ppermute while a numerically-stable streaming
+softmax (online max/denominator) accumulates the output. Compute on each hop
+overlaps the neighbor exchange (XLA schedules ppermute async), so the
+attention cost is flat in the number of devices while max sequence length
+scales linearly with them.
+
+References (public): Liu et al., "Ring Attention with Blockwise
+Transformers" (2023); the streaming-softmax recurrence is the
+FlashAttention online-softmax.
+"""
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+__all__ = ["ring_attention", "ring_attention_sharded"]
+
+
+def _stable_block(q, k, v, o, m, l, scale, mask=None):
+    """One blockwise-attention accumulation step (online softmax)."""
+    s = jnp.einsum("bhqd,bhkd->bhqk", q, k) * scale
+    if mask is not None:
+        s = jnp.where(mask, s, -jnp.inf)
+    m_blk = jnp.max(s, axis=-1, keepdims=True)
+    m_new = jnp.maximum(m, m_blk)
+    # guard -inf rows (fully masked block): exp(-inf - -inf) -> use where
+    p = jnp.exp(s - jnp.where(jnp.isneginf(m_new), 0.0, m_new))
+    corr = jnp.exp(jnp.where(jnp.isneginf(m), 0.0, m)
+                   - jnp.where(jnp.isneginf(m_new), 0.0, m_new))
+    corr = jnp.where(jnp.isneginf(m), 0.0, corr)
+    l_new = l * corr + jnp.sum(p, axis=-1, keepdims=True)
+    o_new = o * corr + jnp.einsum("bhqk,bhkd->bhqd", p, v)
+    return o_new, m_new, l_new
+
+
+def ring_attention(q, k, v, axis_name, causal=False, scale=None):
+    """Per-device body: full attention over a sequence sharded on
+    `axis_name`. Call inside shard_map/pjit; q,k,v are local shards
+    (batch, heads, seq_local, head_dim)."""
+    n = jax.lax.axis_size(axis_name)
+    my = jax.lax.axis_index(axis_name)
+    s_local = q.shape[2]
+    if scale is None:
+        scale = q.shape[-1] ** -0.5
+
+    o = jnp.zeros_like(q, dtype=jnp.float32)
+    m = jnp.full(q.shape[:3] + (1,), -jnp.inf, jnp.float32)
+    l = jnp.zeros(q.shape[:3] + (1,), jnp.float32)  # noqa: E741
+
+    qf = q.astype(jnp.float32)
+    perm = [(i, (i + 1) % n) for i in range(n)]
+
+    def body(i, carry):
+        o, m, l, k_blk, v_blk = carry  # noqa: E741
+        src = (my - i) % n  # which device's K/V block we now hold
+        if causal:
+            q_idx = my * s_local + jnp.arange(s_local)[:, None]
+            k_idx = src * s_local + jnp.arange(k_blk.shape[2])[None, :]
+            mask = (q_idx >= k_idx)[None, None]
+        else:
+            mask = None
+        o, m, l = _stable_block(  # noqa: E741
+            qf, k_blk.astype(jnp.float32), v_blk.astype(jnp.float32),
+            o, m, l, scale, mask)
+        k_blk = jax.lax.ppermute(k_blk, axis_name, perm)
+        v_blk = jax.lax.ppermute(v_blk, axis_name, perm)
+        return o, m, l, k_blk, v_blk
+
+    o, m, l, _, _ = jax.lax.fori_loop(  # noqa: E741
+        0, n, body, (o, m, l, k, v))
+    out = o / jnp.where(l == 0, 1.0, l)
+    return out.astype(q.dtype)
+
+
+_jit_cache = {}
+
+
+def ring_attention_sharded(q, k, v, mesh, axis="sp", causal=False,
+                           scale=None):
+    """Convenience wrapper: shard (b, h, S, d) arrays on the sequence dim
+    over `axis` and run ring attention as one jitted shard_map program.
+    The jitted program is cached per (mesh, axis, causal, scale) so training
+    loops hit the compile cache."""
+    from jax.experimental.shard_map import shard_map
+
+    key = (mesh, axis, causal, scale)
+    run = _jit_cache.get(key)
+    if run is None:
+        spec = P(None, None, axis, None)
+
+        @partial(shard_map, mesh=mesh, in_specs=(spec, spec, spec),
+                 out_specs=spec, check_rep=False)
+        def body(ql, kl, vl):
+            return ring_attention(ql, kl, vl, axis, causal=causal,
+                                  scale=scale)
+
+        run = jax.jit(body)
+        _jit_cache[key] = run
+    return run(q, k, v)

@@ -1,0 +1,257 @@
+"""Gluon Block/layer tests (reference: tests/python/unittest/test_gluon.py)."""
+import numpy as onp
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import autograd, gluon, np
+from mxnet_tpu.gluon import nn
+from mxnet_tpu.test_utils import assert_almost_equal
+
+
+def test_dense_shapes_and_deferred_init():
+    net = nn.Dense(5)
+    net.initialize()
+    x = np.ones((4, 3))
+    y = net(x)
+    assert y.shape == (4, 5)
+    assert net.weight.shape == (5, 3)
+    assert net.bias.shape == (5,)
+
+
+def test_dense_no_flatten():
+    net = nn.Dense(7, flatten=False)
+    net.initialize()
+    y = net(np.ones((2, 3, 4)))
+    assert y.shape == (2, 3, 7)
+
+
+def test_sequential_indexing():
+    net = nn.HybridSequential()
+    net.add(nn.Dense(4), nn.Dense(3), nn.Dense(2))
+    assert len(net) == 3
+    assert isinstance(net[1], nn.Dense)
+    sub = net[1:]
+    assert len(sub) == 2
+
+
+def test_collect_params_names():
+    net = nn.HybridSequential()
+    net.add(nn.Dense(4), nn.Dense(3))
+    params = net.collect_params()
+    assert "0.weight" in params and "1.bias" in params
+    weights = net.collect_params(".*weight")
+    assert set(weights) == {"0.weight", "1.weight"}
+
+
+def test_hybridize_matches_eager():
+    mx.seed(3)
+    net = nn.HybridSequential()
+    net.add(nn.Dense(16, activation="relu"), nn.Dense(8))
+    net.initialize()
+    x = np.random.uniform(size=(4, 12))
+    eager = net(x).asnumpy()
+    net.hybridize()
+    compiled = net(x).asnumpy()
+    assert_almost_equal(eager, compiled, rtol=1e-5, atol=1e-6)
+
+
+def test_hybridize_grad_matches_eager():
+    mx.seed(4)
+    net = nn.HybridSequential()
+    net.add(nn.Dense(8, activation="tanh"), nn.Dense(1))
+    net.initialize()
+    x = np.random.uniform(size=(4, 6))
+    with autograd.record():
+        y = net(x).sum()
+    y.backward()
+    eager_grads = {k: p.grad().asnumpy().copy()
+                   for k, p in net.collect_params().items()}
+    net.hybridize()
+    with autograd.record():
+        y = net(x).sum()
+    y.backward()
+    for k, p in net.collect_params().items():
+        assert_almost_equal(eager_grads[k], p.grad(), rtol=1e-4, atol=1e-5,
+                            names=(f"eager:{k}", f"hybrid:{k}"))
+
+
+def test_conv2d():
+    net = nn.Conv2D(4, kernel_size=3, padding=1)
+    net.initialize()
+    y = net(np.ones((2, 3, 8, 8)))
+    assert y.shape == (2, 4, 8, 8)
+    assert net.weight.shape == (4, 3, 3, 3)
+
+
+def test_conv_stride_dilation_groups():
+    net = nn.Conv2D(8, 3, strides=2, padding=1, groups=2, in_channels=4)
+    net.initialize()
+    y = net(np.ones((1, 4, 8, 8)))
+    assert y.shape == (1, 8, 4, 4)
+
+
+def test_conv_transpose():
+    net = nn.Conv2DTranspose(3, kernel_size=4, strides=2, padding=1)
+    net.initialize()
+    y = net(np.ones((1, 2, 8, 8)))
+    assert y.shape == (1, 3, 16, 16)
+
+
+def test_pooling():
+    x = np.random.uniform(size=(1, 2, 8, 8))
+    assert nn.MaxPool2D(2)(x).shape == (1, 2, 4, 4)
+    assert nn.AvgPool2D(2)(x).shape == (1, 2, 4, 4)
+    assert nn.GlobalAvgPool2D()(x).shape == (1, 2, 1, 1)
+    # max pool really takes the max
+    m = nn.MaxPool2D(8)(x).asnumpy()
+    assert_almost_equal(m.reshape(2), x.asnumpy().max(axis=(2, 3)).reshape(2))
+
+
+def test_batchnorm_moving_stats():
+    net = nn.BatchNorm(momentum=0.5)
+    net.initialize()
+    x = np.random.normal(3.0, 2.0, size=(32, 4))
+    with autograd.record():
+        net(x)
+    rm = net.running_mean.data().asnumpy()
+    # after one update: 0.5*0 + 0.5*batch_mean
+    assert_almost_equal(rm, x.asnumpy().mean(0) * 0.5, rtol=1e-2, atol=1e-2)
+    # inference uses running stats (deterministic)
+    y1 = net(x).asnumpy()
+    y2 = net(x).asnumpy()
+    assert_almost_equal(y1, y2)
+
+
+def test_layernorm_normalizes():
+    net = nn.LayerNorm()
+    net.initialize()
+    x = np.random.uniform(1, 5, size=(4, 10))
+    y = net(x).asnumpy()
+    assert abs(y.mean(-1)).max() < 1e-5
+    assert abs(y.std(-1) - 1).max() < 1e-2
+
+
+def test_embedding():
+    net = nn.Embedding(10, 4)
+    net.initialize()
+    idx = np.array([[1, 2], [3, 4]], dtype="int32")
+    y = net(idx)
+    assert y.shape == (2, 2, 4)
+    w = net.weight.data().asnumpy()
+    assert_almost_equal(y.asnumpy()[0, 0], w[1])
+
+
+def test_dropout_modes():
+    net = nn.Dropout(0.5)
+    net.initialize()
+    x = np.ones((100,))
+    # predict mode: identity
+    assert_almost_equal(net(x), onp.ones(100))
+    with autograd.record():
+        y = net(x).asnumpy()
+    assert (y == 0).sum() > 10  # some dropped
+    kept = y[y != 0]
+    assert_almost_equal(kept, onp.full_like(kept, 2.0))  # inverted scaling
+
+
+def test_activations():
+    x = np.array([-2.0, -0.5, 0.0, 1.0])
+    assert_almost_equal(nn.Activation("relu")(x),
+                        onp.maximum(x.asnumpy(), 0))
+    assert_almost_equal(nn.LeakyReLU(0.1)(x),
+                        onp.where(x.asnumpy() > 0, x.asnumpy(),
+                                  0.1 * x.asnumpy()))
+    elu = nn.ELU(1.0)(x).asnumpy()
+    expected = onp.where(x.asnumpy() > 0, x.asnumpy(),
+                         onp.expm1(x.asnumpy()))
+    assert_almost_equal(elu, expected, rtol=1e-4, atol=1e-5)
+
+
+def test_prelu_param():
+    net = nn.PReLU()
+    net.initialize()
+    y = net(np.array([-4.0, 4.0]))
+    assert_almost_equal(y, onp.array([-1.0, 4.0]))  # alpha=0.25
+
+
+def test_save_load_parameters(tmp_path):
+    net = nn.HybridSequential()
+    net.add(nn.Dense(4), nn.Dense(2))
+    net.initialize()
+    x = np.ones((1, 3))
+    y1 = net(x).asnumpy()
+    path = str(tmp_path / "model.params")
+    net.save_parameters(path)
+    net2 = nn.HybridSequential()
+    net2.add(nn.Dense(4), nn.Dense(2))
+    net2.load_parameters(path)
+    assert_almost_equal(net2(x), y1)
+
+
+def test_cast_dtype():
+    net = nn.Dense(4, in_units=3)
+    net.initialize()
+    net.cast("float16")
+    assert net.weight.dtype == onp.float16
+    y = net(np.ones((2, 3), dtype="float16"))
+    assert y.dtype == onp.float16
+
+
+def test_block_setattr_replaces_child():
+    net = nn.HybridSequential()
+    net.add(nn.Dense(4))
+    block = gluon.Block()
+    block.child = nn.Dense(2)
+    block.child = nn.Dense(3)  # replacement
+    assert len(block._children) == 1
+
+
+def test_custom_hybrid_block():
+    class Residual(gluon.HybridBlock):
+        def __init__(self):
+            super().__init__()
+            self.dense = nn.Dense(8, in_units=8)
+
+        def forward(self, x):
+            return x + self.dense(x)
+
+    net = Residual()
+    net.initialize()
+    x = np.random.uniform(size=(2, 8))
+    eager = net(x).asnumpy()
+    net.hybridize()
+    assert_almost_equal(net(x), eager, rtol=1e-5, atol=1e-6)
+
+
+def test_forward_hook():
+    calls = []
+    net = nn.Dense(2, in_units=2)
+    net.initialize()
+    net.register_forward_hook(lambda blk, args, out: calls.append(out.shape))
+    net(np.ones((3, 2)))
+    assert calls == [(3, 2)]
+
+
+def test_summary(capsys):
+    net = nn.HybridSequential()
+    net.add(nn.Dense(4, in_units=3), nn.Dense(2, in_units=4))
+    net.initialize()
+    total = net.summary()
+    assert total == (3 * 4 + 4) + (4 * 2 + 2)
+
+
+def test_zero_grad():
+    net = nn.Dense(2, in_units=2)
+    net.initialize()
+    with autograd.record():
+        y = net(np.ones((1, 2))).sum()
+    y.backward()
+    assert abs(net.weight.grad().asnumpy()).sum() > 0
+    net.zero_grad()
+    assert abs(net.weight.grad().asnumpy()).sum() == 0
+
+
+def test_uninitialized_raises():
+    net = nn.Dense(2, in_units=2)
+    with pytest.raises(RuntimeError, match="initialize"):
+        net(np.ones((1, 2)))

@@ -1,0 +1,159 @@
+"""Trainer + optimizer tests (reference: test_gluon_trainer.py,
+test_optimizer.py)."""
+import numpy as onp
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import autograd, gluon, np, optimizer
+from mxnet_tpu.gluon import nn
+from mxnet_tpu.test_utils import assert_almost_equal
+
+
+def _simple_net():
+    net = nn.Dense(1, in_units=2, use_bias=False)
+    net.initialize(init=mx.initializer.Constant(1.0))
+    return net
+
+
+def test_sgd_step_math():
+    net = _simple_net()
+    tr = gluon.Trainer(net.collect_params(), "sgd", {"learning_rate": 0.1})
+    x = np.array([[1.0, 2.0]])
+    with autograd.record():
+        y = net(x).sum()
+    y.backward()
+    tr.step(1)
+    # grad = x; w_new = 1 - 0.1 * x
+    assert_almost_equal(net.weight.data(), onp.array([[0.9, 0.8]]))
+
+
+def test_sgd_momentum_math():
+    opt = optimizer.SGD(learning_rate=0.1, momentum=0.9)
+    w = np.array([1.0])
+    g = np.array([1.0])
+    state = opt.create_state(0, w)
+    opt.update(0, w, g, state)
+    assert_almost_equal(w, onp.array([0.9]))  # mom = -0.1
+    opt.update(0, w, g, state)
+    # mom = 0.9*-0.1 - 0.1 = -0.19; w = 0.9 - 0.19 = 0.71
+    assert_almost_equal(w, onp.array([0.71]))
+
+
+def test_adam_converges_quadratic():
+    opt = optimizer.Adam(learning_rate=0.1)
+    w = np.array([5.0])
+    state = opt.create_state(0, w)
+    for _ in range(100):
+        g = 2 * (w - np.array([2.0]))  # d/dw (w-2)^2
+        opt.update(0, w, g.detach(), state)
+    assert abs(float(w) - 2.0) < 0.1
+
+
+@pytest.mark.parametrize("name", ["sgd", "nag", "adam", "adamw", "nadam",
+                                  "rmsprop", "adagrad", "adadelta", "ftrl",
+                                  "lamb", "lars", "signum", "adabelief"])
+def test_all_optimizers_decrease_loss(name):
+    mx.seed(1)
+    net = nn.Dense(1, in_units=4, use_bias=False)
+    net.initialize()
+    lr = {"adadelta": 1.0, "ftrl": 0.5, "lars": 0.05}.get(name, 0.05)
+    tr = gluon.Trainer(net.collect_params(), name, {"learning_rate": lr})
+    x = np.random.uniform(-1, 1, size=(32, 4))
+    target = np.random.uniform(-1, 1, size=(32, 1))
+    lf = gluon.loss.L2Loss()
+    losses = []
+    for _ in range(25):
+        with autograd.record():
+            L = lf(net(x), target)
+        L.backward()
+        tr.step(32)
+        losses.append(float(L.mean()))
+    assert losses[-1] < losses[0], f"{name}: {losses[0]} -> {losses[-1]}"
+
+
+def test_wd_shrinks_weights():
+    net = _simple_net()
+    tr = gluon.Trainer(net.collect_params(), "sgd",
+                       {"learning_rate": 0.1, "wd": 0.5})
+    x = np.array([[0.0, 0.0]])  # zero grad from data
+    with autograd.record():
+        y = net(x).sum()
+    y.backward()
+    tr.step(1)
+    assert_almost_equal(net.weight.data(), onp.array([[0.95, 0.95]]))
+
+
+def test_clip_gradient():
+    opt = optimizer.SGD(learning_rate=1.0, clip_gradient=0.5)
+    w = np.array([0.0])
+    opt.update(0, w, np.array([100.0]), None)
+    assert_almost_equal(w, onp.array([-0.5]))
+
+
+def test_lr_scheduler():
+    sched = mx.lr_scheduler.FactorScheduler(step=2, factor=0.5, base_lr=1.0)
+    opt = optimizer.SGD(learning_rate=1.0, lr_scheduler=sched)
+    assert opt.learning_rate == 1.0
+    opt._update_count(0)
+    opt._update_count(0)
+    opt._update_count(0)
+    assert opt.learning_rate == 0.5
+
+
+def test_trainer_learning_rate_set():
+    net = _simple_net()
+    tr = gluon.Trainer(net.collect_params(), "sgd", {"learning_rate": 0.1})
+    tr.set_learning_rate(0.01)
+    assert tr.learning_rate == 0.01
+
+
+def test_trainer_save_load_states(tmp_path):
+    net = nn.Dense(2, in_units=2)
+    net.initialize()
+    tr = gluon.Trainer(net.collect_params(), "adam", {"learning_rate": 0.1})
+    x = np.ones((1, 2))
+    for _ in range(3):
+        with autograd.record():
+            L = net(x).sum()
+        L.backward()
+        tr.step(1)
+    path = str(tmp_path / "trainer.states")
+    tr.save_states(path)
+    tr2 = gluon.Trainer(net.collect_params(), "adam",
+                        {"learning_rate": 0.1})
+    tr2.load_states(path)
+    assert tr2._optimizer.num_update == tr._optimizer.num_update
+    s1 = tr._states[0][0].asnumpy()
+    s2 = tr2._states[0][0].asnumpy()
+    assert_almost_equal(s1, s2)
+
+
+def test_multi_precision_bf16():
+    opt = optimizer.SGD(learning_rate=0.1, momentum=0.9,
+                        multi_precision=True)
+    w = np.ones((4,), dtype="bfloat16")
+    g = np.full((4,), 0.001, dtype="bfloat16")
+    state = opt.create_state_multi_precision(0, w)
+    master, _ = state
+    assert master.dtype == onp.float32
+    for _ in range(10):
+        opt.update_multi_precision(0, w, g, state)
+    # fp32 master accumulates small updates that bf16 alone would lose
+    assert float(master.asnumpy()[0]) < 1.0
+    assert w.dtype == onp.dtype("bfloat16") if hasattr(onp, "dtype") else True
+
+
+def test_grad_accumulation_pattern():
+    # grad_req='add' + manual zero: the reference's grad-accumulation recipe
+    net = nn.Dense(1, in_units=2, use_bias=False)
+    net.initialize(init=mx.initializer.Constant(1.0))
+    net.weight.grad_req = "add"
+    net.weight._data_map[net.weight._ctx_list[0]]._grad_req = "add"
+    tr = gluon.Trainer(net.collect_params(), "sgd", {"learning_rate": 0.1})
+    x = np.array([[1.0, 1.0]])
+    for _ in range(2):
+        with autograd.record():
+            y = net(x).sum()
+        y.backward()
+    # accumulated grad = 2*x
+    assert_almost_equal(net.weight.grad(), onp.array([[2.0, 2.0]]))

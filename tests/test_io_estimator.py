@@ -1,0 +1,167 @@
+"""io iterators, control flow, estimator, recordio tests
+(reference: test_io.py, test_contrib_control_flow.py, estimator tests)."""
+import numpy as onp
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import gluon, io, npx, np, recordio
+from mxnet_tpu.test_utils import assert_almost_equal
+
+
+def test_ndarray_iter_pad():
+    it = io.NDArrayIter(onp.arange(20).reshape(10, 2).astype("f"),
+                        onp.arange(10).astype("f"), batch_size=4,
+                        last_batch_handle="pad")
+    batches = list(it)
+    assert len(batches) == 3
+    assert batches[-1].pad == 2
+    assert batches[0].data[0].shape == (4, 2)
+
+
+def test_ndarray_iter_discard():
+    it = io.NDArrayIter(onp.zeros((10, 2), "f"), batch_size=4,
+                        last_batch_handle="discard")
+    assert len(list(it)) == 2
+
+
+def test_ndarray_iter_roll_over():
+    it = io.NDArrayIter(onp.arange(10).astype("f"), batch_size=4,
+                        last_batch_handle="roll_over", shuffle=False)
+    epoch1 = list(it)
+    assert len(epoch1) == 2  # remainder withheld
+    it.reset()
+    epoch2 = list(it)
+    # first batch of epoch2 starts with the held-over samples [8, 9]
+    first = epoch2[0].data[0].asnumpy()
+    assert first.shape == (4,)
+    assert first[0] == 8.0 and first[1] == 9.0
+
+
+def test_csv_iter(tmp_path):
+    path = tmp_path / "data.csv"
+    onp.savetxt(path, onp.arange(12).reshape(6, 2), delimiter=",")
+    it = io.CSVIter(str(path), data_shape=(2,), batch_size=3)
+    batches = list(it)
+    assert len(batches) == 2
+    assert batches[0].data[0].shape == (3, 2)
+
+
+def test_recordio_roundtrip(tmp_path):
+    path = str(tmp_path / "data.rec")
+    w = recordio.MXRecordIO(path, "w")
+    for i in range(5):
+        w.write(f"record-{i}".encode())
+    w.close()
+    r = recordio.MXRecordIO(path, "r")
+    items = []
+    while True:
+        item = r.read()
+        if item is None:
+            break
+        items.append(item)
+    assert items == [f"record-{i}".encode() for i in range(5)]
+
+
+def test_indexed_recordio_and_pack(tmp_path):
+    path = str(tmp_path / "data.rec")
+    w = recordio.MXIndexedRecordIO(str(tmp_path / "data.idx"), path, "w")
+    for i in range(4):
+        header = recordio.IRHeader(0, float(i), i, 0)
+        w.write_idx(i, recordio.pack(header, f"payload{i}"))
+    w.close()
+    r = recordio.MXIndexedRecordIO(str(tmp_path / "data.idx"), path, "r")
+    assert len(r) == 4
+    header, payload = recordio.unpack(r.read_idx(2))
+    assert header.label == 2.0
+    assert payload == b"payload2"
+
+
+def test_foreach():
+    out, fin = npx.foreach(lambda x, s: (x + s, x + s),
+                           np.arange(5).astype("float32"), np.array(0.0))
+    assert_almost_equal(out, onp.array([0.0, 1, 3, 6, 10]))
+    assert float(fin) == 10.0
+
+
+def test_foreach_grad():
+    x = np.arange(4).astype("float32")
+    x.attach_grad()
+    with mx.autograd.record():
+        out, fin = npx.foreach(lambda xt, s: (xt * s, s + xt), x,
+                               np.array(1.0))
+        L = fin.sum()
+    L.backward()
+    assert_almost_equal(x.grad, onp.ones(4))
+
+
+def test_while_loop_contract():
+    # reference contract: func -> (step_output, new_loop_vars)
+    out, fin = npx.while_loop(
+        cond=lambda i, s: i < 4,
+        func=lambda i, s: (s, (i + 1, s + i)),
+        loop_vars=(np.array(0), np.array(0)),
+        max_iterations=6)
+    # outputs padded to max_iterations
+    assert out.shape == (6,)
+    assert_almost_equal(out.asnumpy()[:4], onp.array([0, 0, 1, 3]))
+    assert int(fin[0]) == 4 and int(fin[1]) == 6
+
+
+def test_while_loop_requires_max_iterations():
+    with pytest.raises(ValueError, match="max_iterations"):
+        npx.while_loop(lambda i: i < 2, lambda i: (i, (i,)),
+                       (np.array(0),))
+
+
+def test_cond():
+    assert float(npx.cond(np.array(True), lambda x: x * 2, lambda x: x * 3,
+                          np.array(4.0))) == 8.0
+    assert float(npx.cond(np.array(False), lambda x: x * 2, lambda x: x * 3,
+                          np.array(4.0))) == 12.0
+
+
+def test_estimator_fit_and_validate(tmp_path):
+    mx.seed(0)
+    net = gluon.nn.Dense(2, in_units=3)
+    net.initialize()
+    est = gluon.contrib.estimator.Estimator(
+        net, gluon.loss.SoftmaxCrossEntropyLoss(),
+        trainer=gluon.Trainer(net.collect_params(), "sgd",
+                              {"learning_rate": 0.1}))
+    data = [(np.random.uniform(size=(4, 3)), np.array([0, 1, 0, 1]))]
+    est.fit(data, val_data=data, epochs=2)
+    result = est.evaluate(data)
+    assert "val_accuracy" in result
+
+
+def test_estimator_requires_one_stop_criterion():
+    net = gluon.nn.Dense(2, in_units=3)
+    net.initialize()
+    est = gluon.contrib.estimator.Estimator(
+        net, gluon.loss.SoftmaxCrossEntropyLoss())
+    with pytest.raises(ValueError, match="exactly one"):
+        est.fit([], epochs=None, batches=None)
+
+
+def test_checkpoint_handler_best_not_rotated(tmp_path):
+    from mxnet_tpu.gluon.contrib.estimator import CheckpointHandler
+    from mxnet_tpu.gluon.metric import Accuracy
+
+    net = gluon.nn.Dense(2, in_units=3)
+    net.initialize()
+
+    class _Est:
+        pass
+
+    est = _Est()
+    est.net = net
+    est.trainer = None
+    metric = Accuracy()
+    metric.update(np.array([1]), np.array([[0.0, 1.0]]))
+    h = CheckpointHandler(str(tmp_path), save_best=True, monitor=metric,
+                          max_checkpoints=2, mode="max")
+    import os
+
+    for _ in range(5):
+        h.epoch_end(est)
+    assert os.path.exists(str(tmp_path / "model-best.params"))

@@ -1,0 +1,105 @@
+"""KVStore tests (reference: test_kvstore.py, test_kvstore_custom.py)."""
+import numpy as onp
+
+import mxnet_tpu as mx
+from mxnet_tpu import kvstore, np
+from mxnet_tpu.kvstore import KVStoreBase
+from mxnet_tpu.test_utils import assert_almost_equal
+
+
+def test_local_init_push_pull():
+    kv = kvstore.create("local")
+    kv.init("3", np.ones((2, 2)))
+    out = np.zeros((2, 2))
+    kv.pull("3", out=out)
+    assert_almost_equal(out, onp.ones((2, 2)))
+    kv.push("3", np.full((2, 2), 7.0))
+    kv.pull("3", out=out)
+    assert_almost_equal(out, onp.full((2, 2), 8.0))
+
+
+def test_local_push_aggregates_list():
+    kv = kvstore.create("local")
+    kv.init("k", np.zeros((3,)))
+    kv.push("k", [np.ones((3,)), np.full((3,), 2.0)])
+    out = np.zeros((3,))
+    kv.pull("k", out=out)
+    assert_almost_equal(out, onp.full((3,), 3.0))
+
+
+def test_pushpull():
+    kv = kvstore.create("device")
+    vals = [np.ones((4,)), np.full((4,), 3.0)]
+    outs = [np.zeros((4,)), np.zeros((4,))]
+    kv.pushpull("0", vals, out=outs)
+    for o in outs:
+        assert_almost_equal(o, onp.full((4,), 4.0))
+
+
+def test_server_side_optimizer():
+    kv = kvstore.create("local")
+    kv.set_optimizer(mx.optimizer.SGD(learning_rate=0.1))
+    kv.init("0", np.ones((2,)))
+    kv.push("0", np.ones((2,)))  # grad = 1 -> w = 1 - 0.1
+    out = np.zeros((2,))
+    kv.pull("0", out=out)
+    assert_almost_equal(out, onp.full((2,), 0.9))
+
+
+def test_tpu_dist_store():
+    kv = kvstore.create("tpu_dist")
+    assert kv.rank == 0
+    assert kv.num_workers == 1
+    vals = [np.ones((8,)), np.full((8,), 2.0)]
+    outs = [np.zeros((8,)), np.zeros((8,))]
+    kv.pushpull(0, vals, out=outs)
+    for o in outs:
+        assert_almost_equal(o, onp.full((8,), 3.0))
+    out2 = [np.zeros((8,))]
+    kv.broadcast(1, np.full((8,), 5.0), out=out2)
+    assert_almost_equal(out2[0], onp.full((8,), 5.0))
+
+
+def test_dist_aliases_map_to_tpu_dist():
+    from mxnet_tpu.kvstore.tpu_dist import TPUDist
+
+    for name in ("dist_sync", "dist_async", "nccl", "p3", "horovod"):
+        assert isinstance(kvstore.create(name), TPUDist)
+
+
+def test_custom_store_registration():
+    @KVStoreBase.register
+    class MyStore(KVStoreBase):
+        def broadcast(self, key, value, out, priority=0):
+            value.copyto(out if not isinstance(out, list) else out[0])
+
+        def pushpull(self, key, value, out=None, priority=0):
+            if out is not None:
+                value.copyto(out if not isinstance(out, list) else out[0])
+
+    kv = kvstore.create("mystore")
+    out = np.zeros((2,))
+    kv.broadcast("k", np.ones((2,)), out)
+    assert_almost_equal(out, onp.ones((2,)))
+
+
+def test_teststore():
+    kv = kvstore.create("teststore")
+    out = np.zeros((2,))
+    kv.pushpull("a", [np.ones((2,)), np.ones((2,))], out=out)
+    assert_almost_equal(out, onp.full((2,), 2.0))
+
+
+def test_trainer_with_kvstore():
+    from mxnet_tpu import autograd, gluon
+
+    net = gluon.nn.Dense(1, in_units=2, use_bias=False)
+    net.initialize(init=mx.initializer.Constant(1.0))
+    tr = gluon.Trainer(net.collect_params(), "sgd",
+                       {"learning_rate": 0.1}, kvstore="tpu_dist")
+    x = np.array([[1.0, 2.0]])
+    with autograd.record():
+        y = net(x).sum()
+    y.backward()
+    tr.step(1)
+    assert_almost_equal(net.weight.data(), onp.array([[0.9, 0.8]]))

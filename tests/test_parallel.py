@@ -1,0 +1,135 @@
+"""Parallelism tests on the 8-device virtual CPU mesh
+(reference analog: tests/nightly/dist_*_kvstore.py run as multi-process;
+here multi-device SPMD on one host — SURVEY.md §4 implication (d))."""
+import jax
+import jax.numpy as jnp
+import numpy as onp
+import pytest
+
+from mxnet_tpu.parallel import collectives, make_mesh
+from mxnet_tpu.parallel.data_parallel import (
+    make_data_parallel_step,
+    make_shard_map_step,
+)
+from mxnet_tpu.parallel.ring_attention import ring_attention_sharded
+from mxnet_tpu.test_utils import assert_almost_equal
+
+pytestmark = pytest.mark.skipif(
+    len(jax.devices()) < 8, reason="needs 8-device mesh")
+
+
+def test_make_mesh_infer():
+    mesh = make_mesh({"dp": -1, "tp": 2})
+    assert mesh.shape == {"dp": 4, "tp": 2}
+    mesh2 = make_mesh({"dp": -1})
+    assert mesh2.shape == {"dp": 8}
+
+
+def test_psum_tree():
+    mesh = make_mesh({"dp": -1})
+    x = jnp.arange(8.0).reshape(8, 1)  # shard i holds value i
+    out = collectives.psum_tree((x,), mesh, "dp")
+    assert float(out[0][0, 0]) == 28.0
+
+
+def test_all_gather_reduce_scatter():
+    mesh = make_mesh({"dp": -1})
+    x = jnp.arange(8.0)
+    g = collectives.all_gather(x, mesh, "dp")
+    assert g.shape == (8,)
+    rs = collectives.reduce_scatter(jnp.ones((8,)), mesh, "dp")
+    assert rs.shape == (8,)
+    assert_almost_equal(onp.asarray(rs), onp.full((8,), 8.0))
+
+
+def test_ring_permute():
+    mesh = make_mesh({"sp": -1})
+    x = jnp.arange(8.0)
+    y = collectives.ring_permute(x, mesh, "sp", shift=1)
+    # each shard (1 elem) moves to the next device
+    assert_almost_equal(onp.asarray(y), onp.roll(onp.arange(8.0), 1))
+
+
+def _loss_fn(params, batch):
+    x, y = batch
+    pred = x @ params["w"] + params["b"]
+    return jnp.mean((pred - y) ** 2)
+
+
+def _sgd(params, grads, opt_state, lr):
+    new_params = jax.tree_util.tree_map(lambda p, g: p - lr * g, params,
+                                        grads)
+    return new_params, opt_state
+
+
+def _toy_data():
+    rng = onp.random.RandomState(0)
+    x = rng.rand(16, 4).astype(onp.float32)
+    w = rng.rand(4, 1).astype(onp.float32)
+    y = x @ w
+    params = {"w": jnp.zeros((4, 1)), "b": jnp.zeros((1,))}
+    return params, (jnp.asarray(x), jnp.asarray(y))
+
+
+def test_gspmd_data_parallel_step_matches_single_device():
+    mesh = make_mesh({"dp": -1})
+    params, batch = _toy_data()
+    step = make_data_parallel_step(_loss_fn, _sgd, mesh, donate=False)
+    p_sharded, _, loss_sharded = step(params, None, batch, 0.1)
+
+    # single-device oracle
+    loss_ref, grads = jax.value_and_grad(_loss_fn)(params, batch)
+    p_ref, _ = _sgd(params, grads, None, 0.1)
+    assert_almost_equal(float(loss_sharded), float(loss_ref), rtol=1e-5,
+                        atol=1e-6)
+    assert_almost_equal(onp.asarray(p_sharded["w"]), onp.asarray(p_ref["w"]),
+                        rtol=1e-5, atol=1e-6)
+
+
+def test_shard_map_step_matches_gspmd():
+    mesh = make_mesh({"dp": -1})
+    params, batch = _toy_data()
+    # oracle first: the step donates its params buffers
+    loss_ref, grads = jax.value_and_grad(_loss_fn)(params, batch)
+    p_ref, _ = _sgd(params, grads, None, 0.1)
+    step = make_shard_map_step(_loss_fn, _sgd, mesh)
+    p1, _, loss1 = step(params, None, batch, 0.1)
+    assert_almost_equal(float(loss1), float(loss_ref), rtol=1e-5, atol=1e-6)
+    assert_almost_equal(onp.asarray(p1["w"]), onp.asarray(p_ref["w"]),
+                        rtol=1e-5, atol=1e-6)
+
+
+def _vanilla_attention(q, k, v, causal=False):
+    scale = q.shape[-1] ** -0.5
+    s = onp.einsum("bhqd,bhkd->bhqk", q, k) * scale
+    if causal:
+        S = q.shape[2]
+        mask = onp.tril(onp.ones((S, S), bool))
+        s = onp.where(mask[None, None], s, -onp.inf)
+    p = onp.exp(s - s.max(-1, keepdims=True))
+    p = p / p.sum(-1, keepdims=True)
+    return onp.einsum("bhqk,bhkd->bhqd", p, v)
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_ring_attention_matches_vanilla(causal):
+    mesh = make_mesh({"sp": -1})
+    rng = onp.random.RandomState(0)
+    b, h, s, d = 2, 2, 16, 8  # s=16 over 8 devices -> 2 per shard
+    q = rng.randn(b, h, s, d).astype(onp.float32)
+    k = rng.randn(b, h, s, d).astype(onp.float32)
+    v = rng.randn(b, h, s, d).astype(onp.float32)
+    out = ring_attention_sharded(jnp.asarray(q), jnp.asarray(k),
+                                 jnp.asarray(v), mesh=mesh, axis="sp",
+                                 causal=causal)
+    ref = _vanilla_attention(q, k, v, causal)
+    assert_almost_equal(onp.asarray(out), ref, rtol=1e-4, atol=1e-5)
+
+
+def test_dryrun_multichip():
+    import sys
+
+    sys.path.insert(0, "/root/repo")
+    import __graft_entry__ as g
+
+    g.dryrun_multichip(8)
