@@ -1,0 +1,326 @@
+"""ctypes bindings for the native runtime (native/mxtpu_runtime.cc).
+
+The reference's native layer (src/engine/threaded_engine.cc dependency
+scheduler, src/storage/pooled_storage_manager.h, dmlc RecordIO,
+src/io/iter_prefetcher.h) is re-designed here as a single C++ shared
+library with a C ABI, consumed via ctypes (no pybind11 in this image).
+
+Loading policy: use a prebuilt native/build/libmxtpu.so; if missing, try
+building it with `make` (g++ is in the image); if that fails, NATIVE is
+None and pure-Python fallbacks take over — the framework stays importable
+everywhere.
+"""
+from __future__ import annotations
+
+import atexit
+import ctypes
+import os
+import subprocess
+import sys
+
+_NATIVE_DIR = os.path.join(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))), "native")
+_SO_PATH = os.path.join(_NATIVE_DIR, "build", "libmxtpu.so")
+
+_fn_t = ctypes.CFUNCTYPE(ctypes.c_int, ctypes.c_void_p,
+                         ctypes.POINTER(ctypes.c_char), ctypes.c_size_t)
+_del_t = ctypes.CFUNCTYPE(None, ctypes.c_void_p)
+
+
+def _build():
+    try:
+        subprocess.run(["make", "-C", _NATIVE_DIR], capture_output=True,
+                       timeout=300, check=True)
+        return True
+    except Exception as e:  # pragma: no cover - build env dependent
+        print(f"mxnet_tpu: native build failed ({e}); "
+              "falling back to pure python", file=sys.stderr)
+        return False
+
+
+def _load():
+    if os.environ.get("MXTPU_DISABLE_NATIVE"):
+        return None
+    if not os.path.exists(_SO_PATH) and not _build():
+        return None
+    try:
+        lib = ctypes.CDLL(_SO_PATH)
+    except OSError as e:  # pragma: no cover
+        print(f"mxnet_tpu: cannot load {_SO_PATH}: {e}", file=sys.stderr)
+        return None
+    lib.MXTGetLastError.restype = ctypes.c_char_p
+    lib.MXTLibVersion.restype = ctypes.c_char_p
+    lib.MXTEngineNewVar.restype = ctypes.c_void_p
+    lib.MXTEngineDeleteVar.argtypes = [ctypes.c_void_p]
+    lib.MXTEnginePushAsync.argtypes = [
+        _fn_t, _del_t, ctypes.c_void_p,
+        ctypes.POINTER(ctypes.c_void_p), ctypes.c_int,
+        ctypes.POINTER(ctypes.c_void_p), ctypes.c_int,
+        ctypes.c_int, ctypes.c_int]
+    lib.MXTEngineWaitForVar.argtypes = [ctypes.c_void_p]
+    lib.MXTEngineVarVersion.argtypes = [ctypes.c_void_p]
+    lib.MXTEngineVarVersion.restype = ctypes.c_uint64
+    lib.MXTEnginePending.restype = ctypes.c_int64
+    lib.MXTEngineLiveVars.restype = ctypes.c_int64
+    lib.MXTStorageAlloc.argtypes = [ctypes.c_int64]
+    lib.MXTStorageAlloc.restype = ctypes.c_void_p
+    lib.MXTStorageFree.argtypes = [ctypes.c_void_p]
+    lib.MXTStorageDirectFree.argtypes = [ctypes.c_void_p]
+    lib.MXTStorageStats.argtypes = [ctypes.POINTER(ctypes.c_int64)] * 3
+    lib.MXTRecordIOWriterCreate.argtypes = [ctypes.c_char_p]
+    lib.MXTRecordIOWriterCreate.restype = ctypes.c_void_p
+    lib.MXTRecordIOWriterWrite.argtypes = [
+        ctypes.c_void_p, ctypes.c_char_p, ctypes.c_int64]
+    lib.MXTRecordIOWriterTell.argtypes = [ctypes.c_void_p]
+    lib.MXTRecordIOWriterTell.restype = ctypes.c_int64
+    lib.MXTRecordIOWriterFree.argtypes = [ctypes.c_void_p]
+    lib.MXTRecordIOReaderCreate.argtypes = [ctypes.c_char_p]
+    lib.MXTRecordIOReaderCreate.restype = ctypes.c_void_p
+    lib.MXTRecordIOReaderRead.argtypes = [
+        ctypes.c_void_p, ctypes.POINTER(ctypes.c_void_p)]
+    lib.MXTRecordIOReaderRead.restype = ctypes.c_int64
+    lib.MXTRecordIOReaderSeek.argtypes = [ctypes.c_void_p, ctypes.c_int64]
+    lib.MXTRecordIOReaderTell.argtypes = [ctypes.c_void_p]
+    lib.MXTRecordIOReaderTell.restype = ctypes.c_int64
+    lib.MXTRecordIOReaderFree.argtypes = [ctypes.c_void_p]
+    lib.MXTPipelineCreate.argtypes = [ctypes.c_int, ctypes.c_int]
+    lib.MXTPipelineCreate.restype = ctypes.c_void_p
+    lib.MXTPipelineSubmit.argtypes = [ctypes.c_void_p, _fn_t, _del_t,
+                                      ctypes.c_void_p]
+    lib.MXTPipelineSubmit.restype = ctypes.c_int64
+    lib.MXTPipelinePop.argtypes = [ctypes.c_void_p,
+                                   ctypes.POINTER(ctypes.c_int),
+                                   ctypes.POINTER(ctypes.c_void_p)]
+    lib.MXTPipelinePop.restype = ctypes.c_int64
+    lib.MXTPipelineFree.argtypes = [ctypes.c_void_p]
+    return lib
+
+
+NATIVE = _load()
+
+
+def available():
+    return NATIVE is not None
+
+
+if NATIVE is not None:
+    # Engine worker threads hold ctypes callbacks into Python; stop them
+    # before interpreter teardown (reference: Engine shutdown in
+    # src/initialize.cc fork/exit handlers).
+    @atexit.register
+    def _shutdown():  # pragma: no cover - process teardown
+        try:
+            NATIVE.MXTEngineWaitAll()
+            NATIVE.MXTEngineShutdown()
+        except Exception:
+            pass
+
+
+# Live per-op fn callbacks, keyed by op id. The single module-level deleter
+# below frees them. Keeping ONE never-freed deleter CFUNCTYPE avoids a
+# use-after-free: a per-op deleter closure would drop its own ffi trampoline
+# while the C++ worker thread is still executing it. Freeing the *fn*
+# callback from inside the deleter is safe — by deleter time fn has
+# returned (Engine::Execute runs fn, then Complete runs the deleter).
+_live_op_callbacks = {}
+
+
+@_del_t
+def _GLOBAL_OP_DONE(ctx):
+    _live_op_callbacks.pop(ctx or 0, None)
+
+
+class NativeEngine:
+    """Python wrapper over the C++ dependency engine.
+
+    Ops are python callables pushed with read/write var lists; the C++
+    scheduler runs them on its worker pool once deps clear, serializing
+    conflicting accesses and bumping var versions on write (reference
+    semantics: Engine::PushAsync / ThreadedVar, include/mxnet/engine.h:213).
+    """
+
+    def __init__(self):
+        if NATIVE is None:
+            raise RuntimeError("native runtime unavailable")
+        self._lib = NATIVE
+        self._next_id = 1  # 0 is reserved: NULL ctx maps to it
+
+    def new_var(self):
+        return self._lib.MXTEngineNewVar()
+
+    def delete_var(self, var):
+        self._lib.MXTEngineDeleteVar(var)
+
+    def var_version(self, var):
+        return self._lib.MXTEngineVarVersion(var)
+
+    def push(self, fn, const_vars=(), mutable_vars=(), priority=0, io=False):
+        """Push async op. fn() runs on an engine worker thread."""
+        cid = self._next_id
+        self._next_id += 1
+
+        def _run(_ctx, err_buf, err_len):
+            try:
+                fn()
+                return 0
+            except Exception as e:  # propagate into engine error path
+                msg = f"{type(e).__name__}: {e}".encode()[:err_len - 1]
+                ctypes.memmove(err_buf, msg + b"\x00", len(msg) + 1)
+                return -1
+
+        cb = _fn_t(_run)
+        _live_op_callbacks[cid] = cb
+        ncv = len(const_vars)
+        nmv = len(mutable_vars)
+        cv = (ctypes.c_void_p * max(ncv, 1))(*const_vars)
+        mv = (ctypes.c_void_p * max(nmv, 1))(*mutable_vars)
+        self._lib.MXTEnginePushAsync(cb, _GLOBAL_OP_DONE, cid, cv, ncv,
+                                     mv, nmv, int(priority), 1 if io else 0)
+
+    def wait_for_var(self, var):
+        if self._lib.MXTEngineWaitForVar(var) != 0:
+            raise RuntimeError(
+                self._lib.MXTGetLastError().decode(errors="replace"))
+
+    def wait_all(self):
+        if self._lib.MXTEngineWaitAll() != 0:
+            raise RuntimeError(
+                self._lib.MXTGetLastError().decode(errors="replace"))
+
+    def pending(self):
+        return self._lib.MXTEnginePending()
+
+
+_engine = None
+
+
+def engine():
+    """Process-wide NativeEngine singleton (None if native unavailable)."""
+    global _engine
+    if _engine is None and NATIVE is not None:
+        _engine = NativeEngine()
+    return _engine
+
+
+class NativePipeline:
+    """Ordered prefetch pipeline: tasks run on C++ worker threads, results
+    pop in submission order with bounded-capacity back-pressure
+    (reference: iter_prefetcher.h / _MultiWorkerIter)."""
+
+    def __init__(self, num_threads=2, capacity=4):
+        if NATIVE is None:
+            raise RuntimeError("native runtime unavailable")
+        self._lib = NATIVE
+        self._h = NATIVE.MXTPipelineCreate(num_threads, capacity)
+        self._results = {}
+        self._callbacks = {}
+        self._next = 0
+
+    def submit(self, fn):
+        """fn() -> result; runs on a pipeline worker thread."""
+        tid = self._next
+        self._next += 1
+
+        def _run(_ctx, err_buf, err_len):
+            try:
+                self._results[tid] = (True, fn())
+                return 0
+            except Exception as e:
+                self._results[tid] = (False, e)
+                return -1
+
+        cb = _fn_t(_run)
+        self._callbacks[tid] = cb
+        ticket = self._lib.MXTPipelineSubmit(self._h, cb, _del_t(0), None)
+        if ticket < 0:
+            raise RuntimeError("pipeline closed")
+        return ticket
+
+    def pop(self):
+        """Next result in submission order; raises task exceptions here."""
+        status = ctypes.c_int()
+        ctx = ctypes.c_void_p()
+        ticket = self._lib.MXTPipelinePop(
+            self._h, ctypes.byref(status), ctypes.byref(ctx))
+        if ticket < 0:
+            raise StopIteration
+        self._callbacks.pop(ticket, None)
+        ok, val = self._results.pop(ticket)
+        if not ok:
+            raise val
+        return val
+
+    def close(self):
+        if self._h:
+            self._lib.MXTPipelineFree(self._h)
+            self._h = None
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:
+            pass
+
+
+class NativeRecordWriter:
+    def __init__(self, path):
+        h = NATIVE.MXTRecordIOWriterCreate(str(path).encode())
+        if not h:
+            raise IOError(NATIVE.MXTGetLastError().decode())
+        self._h = h
+
+    def tell(self):
+        return NATIVE.MXTRecordIOWriterTell(self._h)
+
+    def write(self, buf: bytes):
+        if NATIVE.MXTRecordIOWriterWrite(self._h, buf, len(buf)) != 0:
+            raise IOError(NATIVE.MXTGetLastError().decode())
+
+    def close(self):
+        if self._h:
+            NATIVE.MXTRecordIOWriterFree(self._h)
+            self._h = None
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:
+            pass
+
+
+class NativeRecordReader:
+    def __init__(self, path):
+        h = NATIVE.MXTRecordIOReaderCreate(str(path).encode())
+        if not h:
+            raise IOError(NATIVE.MXTGetLastError().decode())
+        self._h = h
+
+    def tell(self):
+        return NATIVE.MXTRecordIOReaderTell(self._h)
+
+    def seek(self, pos):
+        NATIVE.MXTRecordIOReaderSeek(self._h, pos)
+
+    def read(self):
+        """Next record payload as bytes (b'' is a valid empty record),
+        or None at EOF."""
+        data = ctypes.c_void_p()
+        n = NATIVE.MXTRecordIOReaderRead(self._h, ctypes.byref(data))
+        if n == -2:
+            return None
+        if n < 0:
+            raise IOError(NATIVE.MXTGetLastError().decode())
+        if n == 0:
+            return b""
+        return ctypes.string_at(data, n)
+
+    def close(self):
+        if self._h:
+            NATIVE.MXTRecordIOReaderFree(self._h)
+            self._h = None
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:
+            pass

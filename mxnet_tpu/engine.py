@@ -30,7 +30,8 @@ import weakref
 
 import jax
 
-__all__ = ["waitall", "wait_to_read", "set_bulk_size", "bulk", "engine_type"]
+__all__ = ["waitall", "wait_to_read", "set_bulk_size", "bulk", "engine_type",
+           "push", "new_var", "wait_for_var", "native_engine"]
 
 # Weak set of live NDArrays handed out by this framework; waitall() blocks on
 # the ones still alive. Arrays that died were either donated or their work is
@@ -59,12 +60,63 @@ def waitall():
     """Block until all outstanding device work has completed.
 
     Device-side failures deferred by async dispatch are raised here, matching
-    the reference's WaitForAll exception rethrow semantics.
+    the reference's WaitForAll exception rethrow semantics. Also drains the
+    native host engine (engine-pushed IO/compute tasks).
     """
     for arr in list(_live):
         data = getattr(arr, "_data", None)
         if data is not None and hasattr(data, "block_until_ready"):
             data.block_until_ready()
+    eng = native_engine()
+    if eng is not None:
+        eng.wait_all()
+
+
+def native_engine():
+    """The C++ dependency engine singleton (None without native lib).
+
+    Device compute is scheduled by XLA/PJRT; this engine schedules *host*
+    work pushed with read/write variable sets — data-pipeline stages,
+    checkpoint IO, custom host ops — with the reference's semantics
+    (versioned vars, conflicting-access serialization, deferred
+    exceptions; native/mxtpu_runtime.cc; reference
+    src/engine/threaded_engine.{h,cc}).
+    """
+    from . import _native
+
+    return _native.engine()
+
+
+def new_var():
+    """Allocate an engine variable (reference: Engine::NewVariable)."""
+    eng = native_engine()
+    if eng is None:
+        raise RuntimeError("native engine unavailable")
+    return eng.new_var()
+
+
+def push(fn, const_vars=(), mutable_vars=(), priority=0, io=False):
+    """Push an async host op with dependencies (Engine::PushAsync).
+
+    In NaiveEngine mode the op runs synchronously on the calling thread
+    (reference: naive_engine.cc — deterministic debugging)."""
+    if is_naive():
+        fn()
+        return
+    eng = native_engine()
+    if eng is None:
+        fn()
+        return
+    eng.push(fn, const_vars, mutable_vars, priority, io)
+
+
+def wait_for_var(var):
+    """Block until all ops touching `var` completed; rethrows deferred
+    exceptions attached to it (reference: Engine::WaitForVar +
+    ThrowException, threaded_engine.cc:520)."""
+    eng = native_engine()
+    if eng is not None:
+        eng.wait_for_var(var)
 
 
 def wait_to_read(arr):

@@ -53,7 +53,36 @@ class DataLoader:
             for indices in self._batch_sampler:
                 yield self._make_batch(indices)
             return
-        yield from self._threaded_iter()
+        from ... import _native
+        if _native.available():
+            yield from self._native_iter()
+        else:
+            yield from self._threaded_iter()
+
+    def _native_iter(self):
+        """Native ordered pipeline: batches decode on C++ worker threads
+        (num_workers wide), pop in order with back-pressure
+        (native/mxtpu_runtime.cc Pipeline; reference: _MultiWorkerIter)."""
+        from ... import _native
+
+        batches = list(self._batch_sampler)
+        pipe = _native.NativePipeline(
+            num_threads=self._num_workers,
+            capacity=max(self._prefetch, self._num_workers))
+        try:
+            submitted = 0
+            popped = 0
+            # prime the pipeline, then steady-state: pop one / push one
+            while popped < len(batches):
+                while (submitted < len(batches)
+                       and submitted - popped < max(self._prefetch, 1)):
+                    indices = batches[submitted]
+                    pipe.submit(lambda ix=indices: self._make_batch(ix))
+                    submitted += 1
+                yield pipe.pop()
+                popped += 1
+        finally:
+            pipe.close()
 
     def _threaded_iter(self):
         """Prefetching thread pool (the iter_prefetcher.h analog)."""

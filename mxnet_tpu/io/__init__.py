@@ -145,10 +145,12 @@ class NDArrayIter(DataIter):
                 pad = end - self._n
                 idx = _np.concatenate([idx, self._order[: pad]])
             elif self._last == "roll_over":
-                # withhold the short remainder until the next epoch
+                # withhold the short remainder until the next epoch.
+                # copy: idx is a view of _order, which reset() may
+                # shuffle in place under it
                 self._cursor = end
                 self._leftover = (_np.concatenate([prefix, idx])
-                                  if prefix is not None else idx)
+                                  if prefix is not None else idx.copy())
                 raise StopIteration
         self._cursor = end
         if prefix is not None:
@@ -215,6 +217,27 @@ class ImageRecordIter(DataIter):
             _np.random.shuffle(self._order)
         self._cursor = 0
 
+    @property
+    def provide_data(self):
+        return [DataDesc("data", (self.batch_size,) + self._shape)]
+
+    @property
+    def provide_label(self):
+        return [DataDesc("softmax_label", (self.batch_size,))]
+
+    def _fit_shape(self, img):
+        """Resize (nearest) + channel-fix decoded HWC image to data_shape
+        (C,H,W) — the iter_image_recordio_2.cc decode-resize stage."""
+        c, h, w = self._shape
+        if img.shape[2] != c:
+            img = img[:, :, :1].repeat(c, axis=2) if c > 1 \
+                else img.mean(axis=2, keepdims=True)
+        if img.shape[:2] != (h, w):
+            ri = (_np.arange(h) * img.shape[0] // h)
+            ci = (_np.arange(w) * img.shape[1] // w)
+            img = img[ri[:, None], ci[None, :]]
+        return img
+
     def next(self):
         if self._cursor + self.batch_size > len(self._order):
             raise StopIteration
@@ -223,6 +246,7 @@ class ImageRecordIter(DataIter):
             header, img = self._unpack(self._rec.read_idx(int(i)))
             if img.ndim == 2:
                 img = img[:, :, None]
+            img = self._fit_shape(img)
             imgs.append(img.transpose(2, 0, 1).astype(_np.float32))
             labels.append(_np.float32(header.label)
                           if _np.isscalar(header.label) or
@@ -230,7 +254,9 @@ class ImageRecordIter(DataIter):
                           else header.label)
         self._cursor += self.batch_size
         return DataBatch([mnp.array(_np.stack(imgs))],
-                         [mnp.array(_np.stack(labels))])
+                         [mnp.array(_np.stack(labels))],
+                         provide_data=self.provide_data,
+                         provide_label=self.provide_label)
 
 
 class ResizeIter(DataIter):

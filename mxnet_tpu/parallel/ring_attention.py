@@ -58,8 +58,7 @@ def ring_attention(q, k, v, axis_name, causal=False, scale=None):
     qf = q.astype(jnp.float32)
     perm = [(i, (i + 1) % n) for i in range(n)]
 
-    def body(i, carry):
-        o, m, l, k_blk, v_blk = carry  # noqa: E741
+    def accum(i, o, m, l, k_blk, v_blk):  # noqa: E741
         src = (my - i) % n  # which device's K/V block we now hold
         if causal:
             q_idx = my * s_local + jnp.arange(s_local)[:, None]
@@ -67,15 +66,22 @@ def ring_attention(q, k, v, axis_name, causal=False, scale=None):
             mask = (q_idx >= k_idx)[None, None]
         else:
             mask = None
-        o, m, l = _stable_block(  # noqa: E741
+        return _stable_block(
             qf, k_blk.astype(jnp.float32), v_blk.astype(jnp.float32),
             o, m, l, scale, mask)
+
+    def body(i, carry):
+        o, m, l, k_blk, v_blk = carry  # noqa: E741
+        o, m, l = accum(i, o, m, l, k_blk, v_blk)  # noqa: E741
         k_blk = jax.lax.ppermute(k_blk, axis_name, perm)
         v_blk = jax.lax.ppermute(v_blk, axis_name, perm)
         return o, m, l, k_blk, v_blk
 
-    o, m, l, _, _ = jax.lax.fori_loop(  # noqa: E741
-        0, n, body, (o, m, l, k, v))
+    # n-1 hops with permute; the final block accumulates outside the loop
+    # so the ring doesn't pay a wasted last-iteration ppermute pair
+    o, m, l, k_last, v_last = jax.lax.fori_loop(  # noqa: E741
+        0, n - 1, body, (o, m, l, k, v))
+    o, m, l = accum(n - 1, o, m, l, k_last, v_last)  # noqa: E741
     out = o / jnp.where(l == 0, 1.0, l)
     return out.astype(q.dtype)
 

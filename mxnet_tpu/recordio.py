@@ -23,26 +23,51 @@ _LFLAG_BITS = 29
 _LEN_MASK = (1 << _LFLAG_BITS) - 1
 
 
+def _native_mod():
+    from . import _native
+
+    return _native if _native.available() else None
+
+
 class MXRecordIO:
-    """Sequential reader/writer."""
+    """Sequential reader/writer.
+
+    Backed by the native C++ reader/writer (native/mxtpu_runtime.cc,
+    buffered stdio — the src/recordio.cc equivalent) when libmxtpu is
+    available; pure-python struct fallback otherwise. Both speak the same
+    bytes."""
 
     def __init__(self, uri, flag="r"):
         self.uri = uri
         self.flag = flag
+        self._native = None
         self.open()
 
     def open(self):
+        nat = _native_mod()
         if self.flag == "w":
-            self._fh = open(self.uri, "wb")
+            if nat:
+                self._native = nat.NativeRecordWriter(self.uri)
+                self._fh = None
+            else:
+                self._fh = open(self.uri, "wb")
         elif self.flag == "r":
-            self._fh = open(self.uri, "rb")
+            if nat:
+                self._native = nat.NativeRecordReader(self.uri)
+                self._fh = None
+            else:
+                self._fh = open(self.uri, "rb")
         else:
             raise ValueError("flag must be 'r' or 'w'")
         self.is_open = True
 
     def close(self):
         if self.is_open:
-            self._fh.close()
+            if self._native is not None:
+                self._native.close()
+                self._native = None
+            else:
+                self._fh.close()
             self.is_open = False
 
     def __del__(self):
@@ -63,15 +88,24 @@ class MXRecordIO:
         self.open()
 
     def tell(self):
+        if self._native is not None:
+            return self._native.tell()
         return self._fh.tell()
 
     def seek(self, pos):
-        self._fh.seek(pos)
+        assert self.flag == "r", "seek is reader-only (reference parity)"
+        if self._native is not None:
+            self._native.seek(pos)
+        else:
+            self._fh.seek(pos)
 
     def write(self, buf):
         assert self.flag == "w"
         if isinstance(buf, str):
             buf = buf.encode()
+        if self._native is not None:
+            self._native.write(bytes(buf))
+            return
         n = len(buf)
         self._fh.write(struct.pack("<II", _MAGIC, n & _LEN_MASK))
         self._fh.write(buf)
@@ -81,6 +115,8 @@ class MXRecordIO:
 
     def read(self):
         assert self.flag == "r"
+        if self._native is not None:
+            return self._native.read()
         head = self._fh.read(8)
         if len(head) < 8:
             return None

@@ -1,0 +1,96 @@
+"""Host storage manager over the native pooled allocator.
+
+Reference: src/storage/pooled_storage_manager.h (PooledStorageManager with
+RoundPower2/RoundMultiple bucketing, env-tuned) + include/mxnet/storage.h
+(Storage::Get()->Alloc/Free/DirectFree). On TPU, *device* (HBM) memory is
+owned by PJRT/XLA — pooling there would fight the runtime — so this
+manager serves the host side: staging buffers for the data pipeline,
+RecordIO scratch, shared buffers for zero-copy numpy views.
+
+Env knobs (reference: MXNET_GPU_MEM_POOL_TYPE etc.):
+  MXTPU_MEM_POOL_TYPE = round_power2 | round_multiple | naive
+  MXTPU_MEM_POOL_GRANULARITY (round_multiple bucket size, default 128)
+  MXTPU_MEM_POOL_LIMIT_MB (pool cap, default 2048)
+"""
+from __future__ import annotations
+
+import ctypes
+
+import numpy as _np
+
+from . import _native
+
+__all__ = ["alloc", "free", "direct_free", "release_all", "stats",
+           "empty_pinned", "Handle"]
+
+
+class Handle:
+    """An allocation from the native pool (reference: Storage::Handle)."""
+
+    __slots__ = ("ptr", "size")
+
+    def __init__(self, ptr, size):
+        self.ptr = ptr
+        self.size = size
+
+    def as_numpy(self, dtype=_np.uint8, shape=None):
+        """Zero-copy numpy view over the native buffer."""
+        dtype = _np.dtype(dtype)
+        count = self.size // dtype.itemsize
+        buf = (ctypes.c_char * self.size).from_address(self.ptr)
+        arr = _np.frombuffer(buf, dtype=dtype, count=count)
+        return arr.reshape(shape) if shape is not None else arr
+
+
+def _lib():
+    if _native.NATIVE is None:
+        raise RuntimeError("native storage pool unavailable "
+                           "(set MXTPU_DISABLE_NATIVE=0 and ensure g++)")
+    return _native.NATIVE
+
+
+def alloc(size) -> Handle:
+    """Pooled allocation (reference: Storage::Get()->Alloc)."""
+    ptr = _lib().MXTStorageAlloc(int(size))
+    if not ptr:
+        raise MemoryError(f"native alloc of {size} bytes failed")
+    return Handle(ptr, int(size))
+
+
+def free(handle: Handle):
+    """Return to pool (reference: Storage::Free — pooled, not released)."""
+    _lib().MXTStorageFree(handle.ptr)
+    handle.ptr = None
+
+
+def direct_free(handle: Handle):
+    """Bypass the pool and release to the OS (Storage::DirectFree)."""
+    _lib().MXTStorageDirectFree(handle.ptr)
+    handle.ptr = None
+
+
+def release_all():
+    """Drop all pooled (free-listed) buffers."""
+    _lib().MXTStorageReleaseAll()
+
+
+def stats():
+    """dict(used_bytes, pooled_bytes, total_allocs)."""
+    used = ctypes.c_int64()
+    pooled = ctypes.c_int64()
+    allocs = ctypes.c_int64()
+    _lib().MXTStorageStats(ctypes.byref(used), ctypes.byref(pooled),
+                           ctypes.byref(allocs))
+    return {"used_bytes": used.value, "pooled_bytes": pooled.value,
+            "total_allocs": allocs.value}
+
+
+def empty_pinned(shape, dtype=_np.float32):
+    """Numpy array over a pooled 64B-aligned buffer — the host staging
+    buffer pattern for fast device_put (reference: pinned memory in
+    cpu_pinned context)."""
+    dtype = _np.dtype(dtype)
+    size = int(_np.prod(shape)) * dtype.itemsize
+    h = alloc(max(size, 1))
+    arr = h.as_numpy(dtype=dtype, shape=shape)
+    return arr, h

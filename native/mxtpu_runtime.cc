@@ -1,0 +1,879 @@
+// mxtpu native runtime: threaded dependency engine, pooled storage,
+// RecordIO, ordered prefetch pipeline.
+//
+// TPU-native re-design of the reference's native runtime layer
+// (reference: src/engine/threaded_engine.{h,cc} — versioned-variable
+// dependency scheduling; src/storage/pooled_storage_manager.h — bucketed
+// memory pools; src/recordio / tools/im2rec.cc — dmlc RecordIO;
+// src/io/iter_prefetcher.h — threaded prefetch). On TPU the *device*
+// compute path is XLA/PJRT, so this engine schedules the host side:
+// imperative op launches, data-pipeline stages, checkpoint IO — anything
+// pushed with read/write variable sets. The public semantics match the
+// reference: async push, per-var serialization of conflicting accesses,
+// version bump on write, deferred exception rethrow at WaitForVar/WaitAll.
+//
+// C ABI only (consumed from Python via ctypes — see mxnet_tpu/_native.py).
+// All functions return 0 on success, -1 on error (message via
+// MXTGetLastError).
+
+#include <algorithm>
+#include <atomic>
+#include <condition_variable>
+#include <cstdint>
+#include <cstdio>
+#include <cstdlib>
+#include <cstring>
+#include <deque>
+#include <functional>
+#include <map>
+#include <memory>
+#include <mutex>
+#include <queue>
+#include <string>
+#include <thread>
+#include <unordered_map>
+#include <vector>
+
+#define MXT_API extern "C" __attribute__((visibility("default")))
+
+// ---------------------------------------------------------------------------
+// error handling
+// ---------------------------------------------------------------------------
+static thread_local std::string g_last_error;
+
+MXT_API const char* MXTGetLastError() { return g_last_error.c_str(); }
+
+static int fail(const std::string& msg) {
+  g_last_error = msg;
+  return -1;
+}
+
+// ---------------------------------------------------------------------------
+// Engine: versioned-variable dependency scheduler
+// ---------------------------------------------------------------------------
+// Callback contract: int fn(void* ctx, char* err, size_t errlen).
+// Return nonzero to signal failure; write a message into err.
+// The deleter (may be null) is invoked exactly once after the callback
+// ran (or was cancelled at shutdown).
+typedef int (*mxt_fn_t)(void*, char*, size_t);
+typedef void (*mxt_del_t)(void*);
+
+namespace mxt {
+
+struct Opr;
+
+// One scheduling entry on a variable's pending queue.
+struct VarBlock {
+  Opr* opr;
+  bool write;
+};
+
+// Engine variable: serializes conflicting accesses, carries a version
+// (bumped per completed write) and a deferred exception.
+struct Var {
+  std::mutex mu;
+  std::deque<VarBlock> queue;   // pending ops in program order
+  int active_readers = 0;       // currently running readers
+  bool writer_active = false;   // currently running writer
+  uint64_t version = 0;
+  std::string exception;        // first failure touching this var
+  bool to_delete = false;
+};
+
+struct Opr {
+  mxt_fn_t fn;
+  mxt_del_t deleter;
+  void* ctx;
+  int priority;                  // higher runs first
+  int prop;                      // 0=normal 1=io/copy
+  uint64_t seq;                  // FIFO tiebreak
+  std::vector<Var*> const_vars;
+  std::vector<Var*> mutable_vars;
+  std::atomic<int> wait{0};      // deps not yet granted
+  std::string error;
+};
+
+struct OprCompare {
+  bool operator()(const Opr* a, const Opr* b) const {
+    if (a->priority != b->priority) return a->priority < b->priority;
+    return a->seq > b->seq;  // earlier seq first
+  }
+};
+
+class Engine {
+ public:
+  static Engine* Get() {
+    static Engine* e = new Engine();
+    return e;
+  }
+
+  Engine() {
+    const char* nw = getenv("MXTPU_CPU_WORKER_NTHREADS");
+    // host engine ops are IO/GIL-bound: floor at 4 workers so inter-op
+    // parallelism survives small containers (reference default is per-
+    // device pools; MXNET_CPU_WORKER_NTHREADS analog)
+    int n = nw ? atoi(nw) : (int)std::thread::hardware_concurrency();
+    if (n < 4 && !nw) n = 4;
+    if (n < 1) n = 1;
+    if (n > 64) n = 64;
+    const char* niow = getenv("MXTPU_IO_WORKER_NTHREADS");
+    int nio = niow ? atoi(niow) : 2;
+    if (nio < 1) nio = 1;
+    Start(n, nio);
+  }
+
+  void Start(int n_workers, int n_io) {
+    std::lock_guard<std::mutex> lk(lifecycle_mu_);
+    if (running_) return;
+    stop_ = false;
+    running_ = true;
+    for (int i = 0; i < n_workers; ++i)
+      workers_.emplace_back([this] { WorkerLoop(&normal_q_); });
+    for (int i = 0; i < n_io; ++i)
+      workers_.emplace_back([this] { WorkerLoop(&io_q_); });
+  }
+
+  // Stop all workers. Pending ops are cancelled (deleters still run).
+  void Shutdown() {
+    std::lock_guard<std::mutex> lk(lifecycle_mu_);
+    if (!running_) return;
+    WaitAllLocked();
+    {
+      std::lock_guard<std::mutex> l2(normal_q_.mu);
+      std::lock_guard<std::mutex> l3(io_q_.mu);
+      stop_ = true;
+    }
+    normal_q_.cv.notify_all();
+    io_q_.cv.notify_all();
+    for (auto& t : workers_) t.join();
+    workers_.clear();
+    running_ = false;
+  }
+
+  Var* NewVar() {
+    std::lock_guard<std::mutex> lk(vars_mu_);
+    Var* v = new Var();
+    live_vars_++;
+    return v;
+  }
+
+  // Mark var for deletion once its queue drains.
+  void DeleteVar(Var* v) {
+    bool now = false;
+    {
+      std::lock_guard<std::mutex> lk(v->mu);
+      v->to_delete = true;
+      now = v->queue.empty() && v->active_readers == 0 && !v->writer_active;
+    }
+    if (now) ReapVar(v);
+  }
+
+  void Push(mxt_fn_t fn, mxt_del_t del, void* ctx, Var** cvars, int nc,
+            Var** mvars, int nm, int priority, int prop) {
+    Opr* op = new Opr();
+    op->fn = fn;
+    op->deleter = del;
+    op->ctx = ctx;
+    op->priority = priority;
+    op->prop = prop;
+    op->seq = seq_++;
+    op->const_vars.assign(cvars, cvars + nc);
+    op->mutable_vars.assign(mvars, mvars + nm);
+    // dedupe: a var both read and written is a write
+    for (Var* m : op->mutable_vars)
+      op->const_vars.erase(
+          std::remove(op->const_vars.begin(), op->const_vars.end(), m),
+          op->const_vars.end());
+    {
+      std::lock_guard<std::mutex> lk(pending_mu_);
+      pending_++;
+    }
+    // Register with every var. wait starts at nvars+1 so the op can't
+    // dispatch while we're still appending (the +1 removed at the end).
+    op->wait.store((int)(op->const_vars.size() + op->mutable_vars.size()) + 1);
+    for (Var* v : op->const_vars) AppendRead(v, op);
+    for (Var* v : op->mutable_vars) AppendWrite(v, op);
+    DecWait(op);
+  }
+
+  void WaitForVar(Var* v) {
+    // Push a no-op write... a read is enough: it runs once all prior
+    // writes completed. Use a sync block.
+    struct Sync {
+      std::mutex mu;
+      std::condition_variable cv;
+      bool done = false;
+    } sync;
+    auto cb = [](void* c, char*, size_t) -> int {
+      Sync* s = (Sync*)c;
+      std::lock_guard<std::mutex> lk(s->mu);
+      s->done = true;
+      s->cv.notify_all();
+      return 0;
+    };
+    Var* cv = v;
+    Push(cb, nullptr, &sync, &cv, 1, nullptr, 0, /*priority=*/1 << 20, 0);
+    std::unique_lock<std::mutex> lk(sync.mu);
+    sync.cv.wait(lk, [&] { return sync.done; });
+    std::string msg;
+    {
+      std::lock_guard<std::mutex> vlk(v->mu);
+      if (!v->exception.empty()) {
+        msg = v->exception;
+        v->exception.clear();  // consumed
+      }
+    }
+    if (!msg.empty()) {
+      // consume the matching global entry so a later WaitAll doesn't
+      // re-raise an already-handled failure
+      std::lock_guard<std::mutex> elk(global_exc_mu_);
+      for (auto it = global_exceptions_.begin();
+           it != global_exceptions_.end(); ++it) {
+        if (*it == msg) {
+          global_exceptions_.erase(it);
+          break;
+        }
+      }
+      g_last_error = msg;
+      throw std::runtime_error(msg);
+    }
+  }
+
+  void WaitAll() {
+    std::unique_lock<std::mutex> lk(pending_mu_);
+    pending_cv_.wait(lk, [&] { return pending_ == 0; });
+    std::lock_guard<std::mutex> elk(global_exc_mu_);
+    if (!global_exceptions_.empty()) {
+      std::string msg = global_exceptions_.front();
+      global_exceptions_.clear();
+      g_last_error = msg;
+      throw std::runtime_error(msg);
+    }
+  }
+
+  uint64_t VarVersion(Var* v) {
+    std::lock_guard<std::mutex> lk(v->mu);
+    return v->version;
+  }
+
+  int64_t Pending() {
+    std::lock_guard<std::mutex> lk(pending_mu_);
+    return pending_;
+  }
+
+  int64_t LiveVars() { return live_vars_.load(); }
+
+ private:
+  struct Queue {
+    std::mutex mu;
+    std::priority_queue<Opr*, std::vector<Opr*>, OprCompare> q;
+    std::condition_variable cv;
+  };
+
+  void AppendRead(Var* v, Opr* op) {
+    bool ready = false;
+    {
+      std::lock_guard<std::mutex> lk(v->mu);
+      // a read may proceed immediately iff no pending or active writer
+      bool writer_pending = v->writer_active;
+      for (auto& b : v->queue)
+        if (b.write) { writer_pending = true; break; }
+      if (!writer_pending) {
+        v->active_readers++;
+        ready = true;
+      } else {
+        v->queue.push_back({op, false});
+      }
+    }
+    if (ready) DecWait(op);
+  }
+
+  void AppendWrite(Var* v, Opr* op) {
+    bool ready = false;
+    {
+      std::lock_guard<std::mutex> lk(v->mu);
+      if (v->queue.empty() && v->active_readers == 0 && !v->writer_active) {
+        v->writer_active = true;
+        ready = true;
+      } else {
+        v->queue.push_back({op, true});
+      }
+    }
+    if (ready) DecWait(op);
+  }
+
+  void DecWait(Opr* op) {
+    if (op->wait.fetch_sub(1) == 1) Dispatch(op);
+  }
+
+  void Dispatch(Opr* op) {
+    Queue* q = op->prop == 1 ? &io_q_ : &normal_q_;
+    {
+      std::lock_guard<std::mutex> lk(q->mu);
+      q->q.push(op);
+    }
+    q->cv.notify_one();
+  }
+
+  void WorkerLoop(Queue* q) {
+    for (;;) {
+      Opr* op = nullptr;
+      {
+        std::unique_lock<std::mutex> lk(q->mu);
+        q->cv.wait(lk, [&] { return stop_ || !q->q.empty(); });
+        if (stop_ && q->q.empty()) return;
+        op = q->q.top();
+        q->q.pop();
+      }
+      Execute(op);
+    }
+  }
+
+  void Execute(Opr* op) {
+    char err[1024];
+    err[0] = 0;
+    int rc = 0;
+    try {
+      rc = op->fn(op->ctx, err, sizeof(err));
+    } catch (...) {
+      rc = -1;
+      snprintf(err, sizeof(err), "uncaught C++ exception in engine op");
+    }
+    if (rc != 0)
+      op->error = err[0] ? err : "engine op failed";
+    Complete(op);
+  }
+
+  void Complete(Opr* op) {
+    if (!op->error.empty()) {
+      // attach the exception to every mutated var (reference semantics:
+      // per-var exception_ptr) and to the global list for WaitAll.
+      for (Var* v : op->mutable_vars) {
+        std::lock_guard<std::mutex> lk(v->mu);
+        if (v->exception.empty()) v->exception = op->error;
+      }
+      std::lock_guard<std::mutex> lk(global_exc_mu_);
+      global_exceptions_.push_back(op->error);
+    }
+    for (Var* v : op->const_vars) CompleteRead(v);
+    for (Var* v : op->mutable_vars) CompleteWrite(v);
+    if (op->deleter) op->deleter(op->ctx);
+    delete op;
+    {
+      std::lock_guard<std::mutex> lk(pending_mu_);
+      pending_--;
+    }
+    pending_cv_.notify_all();
+  }
+
+  void CompleteRead(Var* v) {
+    std::vector<Opr*> ready;
+    bool reap = false;
+    {
+      std::lock_guard<std::mutex> lk(v->mu);
+      v->active_readers--;
+      ScheduleNext(v, &ready);
+      reap = v->to_delete && v->queue.empty() && v->active_readers == 0 &&
+             !v->writer_active;
+    }
+    for (Opr* o : ready) DecWait(o);
+    if (reap) ReapVar(v);
+  }
+
+  void CompleteWrite(Var* v) {
+    std::vector<Opr*> ready;
+    bool reap = false;
+    {
+      std::lock_guard<std::mutex> lk(v->mu);
+      v->writer_active = false;
+      v->version++;
+      ScheduleNext(v, &ready);
+      reap = v->to_delete && v->queue.empty() && v->active_readers == 0 &&
+             !v->writer_active;
+    }
+    for (Opr* o : ready) DecWait(o);
+    if (reap) ReapVar(v);
+  }
+
+  // Grant queued entries now runnable. Called with v->mu held.
+  void ScheduleNext(Var* v, std::vector<Opr*>* ready) {
+    if (v->writer_active || v->active_readers > 0) {
+      // readers may still join if head of queue is a read run
+      while (!v->writer_active && !v->queue.empty() && !v->queue.front().write) {
+        v->active_readers++;
+        ready->push_back(v->queue.front().opr);
+        v->queue.pop_front();
+      }
+      return;
+    }
+    if (v->queue.empty()) return;
+    if (v->queue.front().write) {
+      v->writer_active = true;
+      ready->push_back(v->queue.front().opr);
+      v->queue.pop_front();
+    } else {
+      while (!v->queue.empty() && !v->queue.front().write) {
+        v->active_readers++;
+        ready->push_back(v->queue.front().opr);
+        v->queue.pop_front();
+      }
+    }
+  }
+
+  void ReapVar(Var* v) {
+    live_vars_--;
+    delete v;
+  }
+
+  std::mutex lifecycle_mu_;
+  bool running_ = false;
+  std::atomic<bool> stop_{false};
+  std::vector<std::thread> workers_;
+  Queue normal_q_, io_q_;
+  std::mutex vars_mu_;
+  std::atomic<int64_t> live_vars_{0};
+  std::atomic<uint64_t> seq_{0};
+  std::mutex pending_mu_;
+  std::condition_variable pending_cv_;
+  int64_t pending_ = 0;
+  std::mutex global_exc_mu_;
+  std::vector<std::string> global_exceptions_;
+
+  void WaitAllLocked() {
+    std::unique_lock<std::mutex> lk(pending_mu_);
+    pending_cv_.wait(lk, [&] { return pending_ == 0; });
+  }
+};
+
+}  // namespace mxt
+
+MXT_API void* MXTEngineNewVar() { return mxt::Engine::Get()->NewVar(); }
+
+MXT_API void MXTEngineDeleteVar(void* v) {
+  mxt::Engine::Get()->DeleteVar((mxt::Var*)v);
+}
+
+MXT_API int MXTEnginePushAsync(mxt_fn_t fn, mxt_del_t del, void* ctx,
+                               void** const_vars, int n_const,
+                               void** mutable_vars, int n_mut, int priority,
+                               int prop) {
+  mxt::Engine::Get()->Push(fn, del, ctx, (mxt::Var**)const_vars, n_const,
+                           (mxt::Var**)mutable_vars, n_mut, priority, prop);
+  return 0;
+}
+
+MXT_API int MXTEngineWaitForVar(void* v) {
+  try {
+    mxt::Engine::Get()->WaitForVar((mxt::Var*)v);
+    return 0;
+  } catch (const std::exception& e) {
+    return fail(e.what());
+  }
+}
+
+MXT_API int MXTEngineWaitAll() {
+  try {
+    mxt::Engine::Get()->WaitAll();
+    return 0;
+  } catch (const std::exception& e) {
+    return fail(e.what());
+  }
+}
+
+MXT_API uint64_t MXTEngineVarVersion(void* v) {
+  return mxt::Engine::Get()->VarVersion((mxt::Var*)v);
+}
+
+MXT_API int64_t MXTEnginePending() { return mxt::Engine::Get()->Pending(); }
+
+MXT_API int64_t MXTEngineLiveVars() { return mxt::Engine::Get()->LiveVars(); }
+
+MXT_API void MXTEngineShutdown() { mxt::Engine::Get()->Shutdown(); }
+
+// ---------------------------------------------------------------------------
+// Storage: pooled host allocator with bucketing strategies
+// (reference: src/storage/pooled_storage_manager.h — RoundPower2 /
+// RoundMultiple buckets, env-tuned; here for host staging buffers — device
+// HBM is owned by PJRT).
+// ---------------------------------------------------------------------------
+namespace mxt {
+
+class StoragePool {
+ public:
+  static StoragePool* Get() {
+    static StoragePool* p = new StoragePool();
+    return p;
+  }
+
+  StoragePool() {
+    const char* t = getenv("MXTPU_MEM_POOL_TYPE");
+    type_ = t ? std::string(t) : "round_power2";
+    const char* g = getenv("MXTPU_MEM_POOL_GRANULARITY");
+    granularity_ = g ? (size_t)atoll(g) : 128;
+    if (granularity_ < 8) granularity_ = 8;
+    const char* limit = getenv("MXTPU_MEM_POOL_LIMIT_MB");
+    pool_limit_ = limit ? (size_t)atoll(limit) << 20 : (size_t)1 << 31;  // 2GB
+  }
+
+  size_t RoundSize(size_t s) const {
+    if (type_ == "naive") return s;
+    if (type_ == "round_multiple")
+      return ((s + granularity_ - 1) / granularity_) * granularity_;
+    // round_power2
+    if (s < 32) return 32;
+    size_t p = 1;
+    while (p < s) p <<= 1;
+    return p;
+  }
+
+  void* Alloc(size_t size) {
+    if (size == 0) size = 1;
+    size_t bucket = RoundSize(size);
+    {
+      std::lock_guard<std::mutex> lk(mu_);
+      auto it = pool_.find(bucket);
+      if (it != pool_.end() && !it->second.empty()) {
+        void* p = it->second.back();
+        it->second.pop_back();
+        pooled_bytes_ -= bucket;
+        used_[p] = bucket;
+        used_bytes_ += bucket;
+        return p;
+      }
+    }
+    void* p = nullptr;
+    if (posix_memalign(&p, 64, bucket) != 0) return nullptr;
+    std::lock_guard<std::mutex> lk(mu_);
+    used_[p] = bucket;
+    used_bytes_ += bucket;
+    total_allocs_++;
+    return p;
+  }
+
+  int Free(void* p) {
+    std::lock_guard<std::mutex> lk(mu_);
+    auto it = used_.find(p);
+    if (it == used_.end()) return -1;
+    size_t bucket = it->second;
+    used_.erase(it);
+    used_bytes_ -= bucket;
+    if (type_ == "naive" || pooled_bytes_ + bucket > pool_limit_) {
+      free(p);
+    } else {
+      pool_[bucket].push_back(p);
+      pooled_bytes_ += bucket;
+    }
+    return 0;
+  }
+
+  int DirectFree(void* p) {
+    std::lock_guard<std::mutex> lk(mu_);
+    auto it = used_.find(p);
+    if (it == used_.end()) return -1;
+    used_bytes_ -= it->second;
+    used_.erase(it);
+    free(p);
+    return 0;
+  }
+
+  void ReleaseAll() {
+    std::lock_guard<std::mutex> lk(mu_);
+    for (auto& kv : pool_)
+      for (void* p : kv.second) free(p);
+    pool_.clear();
+    pooled_bytes_ = 0;
+  }
+
+  void Stats(int64_t* used, int64_t* pooled, int64_t* allocs) {
+    std::lock_guard<std::mutex> lk(mu_);
+    *used = (int64_t)used_bytes_;
+    *pooled = (int64_t)pooled_bytes_;
+    *allocs = (int64_t)total_allocs_;
+  }
+
+ private:
+  std::string type_;
+  size_t granularity_;
+  size_t pool_limit_;
+  std::mutex mu_;
+  std::map<size_t, std::vector<void*>> pool_;
+  std::unordered_map<void*, size_t> used_;
+  size_t pooled_bytes_ = 0, used_bytes_ = 0, total_allocs_ = 0;
+};
+
+}  // namespace mxt
+
+MXT_API void* MXTStorageAlloc(int64_t size) {
+  return mxt::StoragePool::Get()->Alloc((size_t)size);
+}
+
+MXT_API int MXTStorageFree(void* p) {
+  if (mxt::StoragePool::Get()->Free(p) != 0)
+    return fail("MXTStorageFree: unknown pointer");
+  return 0;
+}
+
+MXT_API int MXTStorageDirectFree(void* p) {
+  if (mxt::StoragePool::Get()->DirectFree(p) != 0)
+    return fail("MXTStorageDirectFree: unknown pointer");
+  return 0;
+}
+
+MXT_API void MXTStorageReleaseAll() { mxt::StoragePool::Get()->ReleaseAll(); }
+
+MXT_API void MXTStorageStats(int64_t* used, int64_t* pooled,
+                             int64_t* allocs) {
+  mxt::StoragePool::Get()->Stats(used, pooled, allocs);
+}
+
+// ---------------------------------------------------------------------------
+// RecordIO (binary-compatible with dmlc RecordIO: [magic][lrec][payload][pad])
+// ---------------------------------------------------------------------------
+namespace mxt {
+
+static const uint32_t kRecMagic = 0xCED7230A;
+static const uint32_t kLenMask = (1u << 29) - 1;
+
+struct RecordWriter {
+  FILE* f;
+  explicit RecordWriter(const char* path) { f = fopen(path, "wb"); }
+  ~RecordWriter() {
+    if (f) fclose(f);
+  }
+  int64_t Tell() { return ftell(f); }
+  int Write(const void* data, uint32_t len) {
+    uint32_t head[2] = {kRecMagic, len & kLenMask};
+    if (fwrite(head, 4, 2, f) != 2) return -1;
+    if (len && fwrite(data, 1, len, f) != len) return -1;
+    uint32_t pad = (4 - len % 4) % 4;
+    static const char zeros[4] = {0, 0, 0, 0};
+    if (pad && fwrite(zeros, 1, pad, f) != pad) return -1;
+    return 0;
+  }
+};
+
+struct RecordReader {
+  FILE* f;
+  std::vector<char> buf;
+  explicit RecordReader(const char* path) {
+    f = fopen(path, "rb");
+    if (f) setvbuf(f, nullptr, _IOFBF, 1 << 20);
+  }
+  ~RecordReader() {
+    if (f) fclose(f);
+  }
+  int64_t Tell() { return ftell(f); }
+  void Seek(int64_t pos) { fseek(f, pos, SEEK_SET); }
+  // returns payload length (>=0), -2 at EOF, -1 on corrupt file
+  // (0 is a valid empty record, distinct from EOF — matches the python
+  // fallback reader)
+  int64_t Read() {
+    uint32_t head[2];
+    if (fread(head, 4, 2, f) != 2) return -2;
+    if (head[0] != kRecMagic) return -1;
+    uint32_t len = head[1] & kLenMask;
+    buf.resize(len);
+    if (len && fread(buf.data(), 1, len, f) != len) return -1;
+    uint32_t pad = (4 - len % 4) % 4;
+    if (pad) fseek(f, pad, SEEK_CUR);
+    return (int64_t)len;
+  }
+};
+
+}  // namespace mxt
+
+MXT_API void* MXTRecordIOWriterCreate(const char* path) {
+  auto* w = new mxt::RecordWriter(path);
+  if (!w->f) {
+    delete w;
+    fail(std::string("cannot open for write: ") + path);
+    return nullptr;
+  }
+  return w;
+}
+
+MXT_API int MXTRecordIOWriterWrite(void* h, const void* data, int64_t len) {
+  if (((mxt::RecordWriter*)h)->Write(data, (uint32_t)len) != 0)
+    return fail("RecordIO write failed");
+  return 0;
+}
+
+MXT_API int64_t MXTRecordIOWriterTell(void* h) {
+  return ((mxt::RecordWriter*)h)->Tell();
+}
+
+MXT_API void MXTRecordIOWriterFree(void* h) {
+  delete (mxt::RecordWriter*)h;
+}
+
+MXT_API void* MXTRecordIOReaderCreate(const char* path) {
+  auto* r = new mxt::RecordReader(path);
+  if (!r->f) {
+    delete r;
+    fail(std::string("cannot open for read: ") + path);
+    return nullptr;
+  }
+  return r;
+}
+
+// Returns record length >=0 (0 = valid empty record), -2 = EOF,
+// -1 = corrupt; *data points at an internal buffer valid until the next
+// Read on this handle.
+MXT_API int64_t MXTRecordIOReaderRead(void* h, const void** data) {
+  auto* r = (mxt::RecordReader*)h;
+  int64_t n = r->Read();
+  if (n == -1) {
+    fail("corrupt RecordIO file");
+    return -1;
+  }
+  if (n == -2) return -2;
+  *data = r->buf.data();
+  return n;
+}
+
+MXT_API void MXTRecordIOReaderSeek(void* h, int64_t pos) {
+  ((mxt::RecordReader*)h)->Seek(pos);
+}
+
+MXT_API int64_t MXTRecordIOReaderTell(void* h) {
+  return ((mxt::RecordReader*)h)->Tell();
+}
+
+MXT_API void MXTRecordIOReaderFree(void* h) {
+  delete (mxt::RecordReader*)h;
+}
+
+// ---------------------------------------------------------------------------
+// Ordered prefetch pipeline (reference: src/io/iter_prefetcher.h +
+// multiprocessing _MultiWorkerIter in gluon/data/dataloader.py — here a
+// native thread pool that executes submitted tasks out of order but yields
+// completions *in submission order*, with bounded capacity back-pressure).
+// ---------------------------------------------------------------------------
+namespace mxt {
+
+class Pipeline {
+ public:
+  Pipeline(int n_threads, int capacity)
+      : capacity_(capacity < 1 ? 1 : capacity) {
+    if (n_threads < 1) n_threads = 1;
+    for (int i = 0; i < n_threads; ++i)
+      threads_.emplace_back([this] { Loop(); });
+  }
+
+  ~Pipeline() {
+    {
+      std::lock_guard<std::mutex> lk(mu_);
+      stop_ = true;
+    }
+    cv_work_.notify_all();
+    cv_done_.notify_all();
+    cv_space_.notify_all();
+    for (auto& t : threads_) t.join();
+    // run deleters on anything left
+    for (auto& kv : done_)
+      if (kv.second.del) kv.second.del(kv.second.ctx);
+    while (!work_.empty()) {
+      if (work_.front().del) work_.front().del(work_.front().ctx);
+      work_.pop_front();
+    }
+  }
+
+  // Blocks while in-flight >= capacity (back-pressure).
+  int64_t Submit(mxt_fn_t fn, mxt_del_t del, void* ctx) {
+    std::unique_lock<std::mutex> lk(mu_);
+    cv_space_.wait(lk, [&] { return stop_ || InFlight() < capacity_; });
+    if (stop_) return -1;
+    int64_t ticket = next_ticket_++;
+    work_.push_back({fn, del, ctx, ticket, 0});
+    cv_work_.notify_one();
+    return ticket;
+  }
+
+  // Pop the next completion in submission order. Returns ticket, fills
+  // status/ctx. Returns -1 if pipeline empty (nothing in flight).
+  int64_t Pop(int* status, void** ctx) {
+    std::unique_lock<std::mutex> lk(mu_);
+    if (InFlight() == 0 && done_.empty()) return -1;
+    cv_done_.wait(lk, [&] {
+      return stop_ || done_.count(next_pop_);
+    });
+    if (stop_ && !done_.count(next_pop_)) return -1;
+    Task t = done_[next_pop_];
+    done_.erase(next_pop_);
+    int64_t ticket = next_pop_++;
+    *status = t.status;
+    *ctx = t.ctx;
+    cv_space_.notify_one();
+    return ticket;
+  }
+
+ private:
+  struct Task {
+    mxt_fn_t fn;
+    mxt_del_t del;
+    void* ctx;
+    int64_t ticket;
+    int status;
+  };
+
+  int64_t InFlight() const {
+    return (next_ticket_ - next_pop_) - (int64_t)done_.size();
+  }
+
+  void Loop() {
+    for (;;) {
+      Task t;
+      {
+        std::unique_lock<std::mutex> lk(mu_);
+        cv_work_.wait(lk, [&] { return stop_ || !work_.empty(); });
+        if (stop_) return;
+        t = work_.front();
+        work_.pop_front();
+      }
+      char err[256];
+      int rc;
+      try {
+        rc = t.fn(t.ctx, err, sizeof(err));
+      } catch (...) {
+        rc = -1;
+      }
+      t.status = rc;
+      {
+        std::lock_guard<std::mutex> lk(mu_);
+        done_[t.ticket] = t;
+      }
+      cv_done_.notify_all();
+    }
+  }
+
+  int64_t capacity_;
+  std::mutex mu_;
+  std::condition_variable cv_work_, cv_done_, cv_space_;
+  std::deque<Task> work_;
+  std::unordered_map<int64_t, Task> done_;
+  int64_t next_ticket_ = 0, next_pop_ = 0;
+  bool stop_ = false;
+  std::vector<std::thread> threads_;
+};
+
+}  // namespace mxt
+
+MXT_API void* MXTPipelineCreate(int n_threads, int capacity) {
+  return new mxt::Pipeline(n_threads, capacity);
+}
+
+MXT_API int64_t MXTPipelineSubmit(void* h, mxt_fn_t fn, mxt_del_t del,
+                                  void* ctx) {
+  return ((mxt::Pipeline*)h)->Submit(fn, del, ctx);
+}
+
+MXT_API int64_t MXTPipelinePop(void* h, int* status, void** ctx) {
+  return ((mxt::Pipeline*)h)->Pop(status, ctx);
+}
+
+MXT_API void MXTPipelineFree(void* h) { delete (mxt::Pipeline*)h; }
+
+// ---------------------------------------------------------------------------
+// libinfo
+// ---------------------------------------------------------------------------
+MXT_API const char* MXTLibVersion() { return "mxtpu-runtime 0.1.0"; }

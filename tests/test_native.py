@@ -1,0 +1,230 @@
+"""Native runtime tests: C++ engine deps/versions/exceptions, ordered
+pipeline, pooled storage, RecordIO (reference test models:
+tests/cpp/engine/threaded_engine_test.cc, tests/python/unittest/
+test_engine.py, test_exc_handling.py, test_recordio.py)."""
+import struct
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from mxnet_tpu import _native, engine, recordio, storage
+
+pytestmark = pytest.mark.skipif(not _native.available(),
+                                reason="native lib unavailable")
+
+
+class TestEngine:
+    def test_serialized_writes(self):
+        eng = engine.native_engine()
+        v = eng.new_var()
+        out = []
+        for i in range(50):
+            eng.push(lambda i=i: out.append(i), mutable_vars=[v])
+        eng.wait_for_var(v)
+        assert out == list(range(50))
+
+    def test_version_bumps_on_write_only(self):
+        eng = engine.native_engine()
+        v = eng.new_var()
+        assert eng.var_version(v) == 0
+        for _ in range(3):
+            eng.push(lambda: None, mutable_vars=[v])
+        eng.push(lambda: None, const_vars=[v])
+        eng.wait_for_var(v)
+        assert eng.var_version(v) == 3
+
+    def test_parallel_reads_single_writer(self):
+        eng = engine.native_engine()
+        v = eng.new_var()
+        state = {"writers": 0, "max_readers": 0, "readers": 0}
+        lock = threading.Lock()
+
+        def read():
+            with lock:
+                state["readers"] += 1
+                state["max_readers"] = max(state["max_readers"],
+                                           state["readers"])
+                assert state["writers"] == 0
+            time.sleep(0.002)
+            with lock:
+                state["readers"] -= 1
+
+        def write():
+            with lock:
+                assert state["readers"] == 0
+                assert state["writers"] == 0
+                state["writers"] += 1
+            time.sleep(0.002)
+            with lock:
+                state["writers"] -= 1
+
+        for _ in range(5):
+            for _ in range(4):
+                eng.push(read, const_vars=[v])
+            eng.push(write, mutable_vars=[v])
+        eng.wait_for_var(v)
+
+    def test_read_after_write_sees_data(self):
+        eng = engine.native_engine()
+        v = eng.new_var()
+        box = {}
+        eng.push(lambda: box.setdefault("x", 41), mutable_vars=[v])
+        got = []
+        eng.push(lambda: got.append(box["x"] + 1), const_vars=[v])
+        eng.wait_all()
+        assert got == [42]
+
+    def test_exception_deferred_to_wait(self):
+        eng = engine.native_engine()
+        v = eng.new_var()
+
+        def boom():
+            raise ValueError("deliberate failure")
+
+        eng.push(boom, mutable_vars=[v])
+        with pytest.raises(RuntimeError, match="deliberate failure"):
+            eng.wait_for_var(v)
+
+    def test_waitall_raises_global_exception(self):
+        eng = engine.native_engine()
+        v = eng.new_var()
+        eng.push(lambda: (_ for _ in ()).throw(RuntimeError("async fail")),
+                 mutable_vars=[v])
+        with pytest.raises(RuntimeError, match="async fail"):
+            eng.wait_all()
+        eng.wait_all()  # exception consumed; engine still serviceable
+
+    def test_independent_vars_run_concurrently(self):
+        eng = engine.native_engine()
+        va, vb = eng.new_var(), eng.new_var()
+        barrier = threading.Barrier(2, timeout=5)
+        # two ops on independent vars must overlap (both reach the barrier)
+        eng.push(barrier.wait, mutable_vars=[va])
+        eng.push(barrier.wait, mutable_vars=[vb])
+        eng.wait_all()
+
+    def test_module_level_push_api(self):
+        out = []
+        v = engine.new_var()
+        engine.push(lambda: out.append(1), mutable_vars=[v])
+        engine.wait_for_var(v)
+        assert out == [1]
+
+
+class TestPipeline:
+    def test_ordered_results(self):
+        pipe = _native.NativePipeline(num_threads=4, capacity=8)
+        delays = [0.01, 0.0, 0.005, 0.0, 0.002, 0.0]
+        for i, d in enumerate(delays):
+            pipe.submit(lambda i=i, d=d: (time.sleep(d), i)[1])
+        got = [pipe.pop() for _ in delays]
+        assert got == list(range(len(delays)))
+        pipe.close()
+
+    def test_task_exception_raised_at_pop(self):
+        pipe = _native.NativePipeline(num_threads=2, capacity=4)
+        pipe.submit(lambda: 1)
+        pipe.submit(lambda: (_ for _ in ()).throw(KeyError("bad sample")))
+        assert pipe.pop() == 1
+        with pytest.raises(KeyError):
+            pipe.pop()
+        pipe.close()
+
+
+class TestStorage:
+    def test_alloc_free_reuse(self):
+        h1 = storage.alloc(1000)
+        p1 = h1.ptr
+        storage.free(h1)
+        h2 = storage.alloc(1000)  # same pow2 bucket -> reused
+        assert h2.ptr == p1
+        storage.free(h2)
+
+    def test_numpy_view_roundtrip(self):
+        h = storage.alloc(256 * 4)
+        arr = h.as_numpy(np.float32, (16, 16))
+        arr[:] = np.arange(256, dtype=np.float32).reshape(16, 16)
+        arr2 = h.as_numpy(np.float32, (16, 16))
+        np.testing.assert_array_equal(arr, arr2)
+        storage.direct_free(h)
+
+    def test_stats(self):
+        s0 = storage.stats()
+        h = storage.alloc(4096)
+        s1 = storage.stats()
+        assert s1["used_bytes"] >= s0["used_bytes"] + 4096
+        storage.free(h)
+
+    def test_empty_pinned(self):
+        arr, h = storage.empty_pinned((8, 8), np.float32)
+        arr[:] = 7.0
+        assert arr.sum() == 448.0
+        assert h.ptr % 64 == 0  # 64B aligned for fast DMA
+        storage.direct_free(h)
+
+
+class TestRecordIO:
+    def test_roundtrip_native(self, tmp_path):
+        path = str(tmp_path / "t.rec")
+        payloads = [bytes([i]) * (i * 7 + 1) for i in range(20)]
+        w = recordio.MXRecordIO(path, "w")
+        for p in payloads:
+            w.write(p)
+        w.close()
+        r = recordio.MXRecordIO(path, "r")
+        got = []
+        while True:
+            rec = r.read()
+            if rec is None:
+                break
+            got.append(rec)
+        r.close()
+        assert got == payloads
+
+    def test_wire_format_is_dmlc(self, tmp_path):
+        """The native writer must produce [magic][lrec][payload][pad]."""
+        path = str(tmp_path / "w.rec")
+        w = recordio.MXRecordIO(path, "w")
+        w.write(b"abcde")
+        w.close()
+        raw = open(path, "rb").read()
+        magic, lrec = struct.unpack("<II", raw[:8])
+        assert magic == 0xCED7230A
+        assert lrec & ((1 << 29) - 1) == 5
+        assert raw[8:13] == b"abcde"
+        assert len(raw) == 16  # padded to 4B
+
+    def test_indexed_random_access(self, tmp_path):
+        rec = str(tmp_path / "i.rec")
+        idx = str(tmp_path / "i.idx")
+        w = recordio.MXIndexedRecordIO(idx, rec, "w")
+        for i in range(10):
+            w.write_idx(i, f"payload-{i}".encode())
+        w.close()
+        r = recordio.MXIndexedRecordIO(idx, rec, "r")
+        assert r.read_idx(7) == b"payload-7"
+        assert r.read_idx(2) == b"payload-2"
+        r.close()
+
+    def test_pack_unpack_header(self):
+        hdr = recordio.IRHeader(0, 3.0, 42, 0)
+        s = recordio.pack(hdr, b"data")
+        hdr2, payload = recordio.unpack(s)
+        assert payload == b"data"
+        assert hdr2.label == 3.0 and hdr2.id == 42
+
+
+class TestDataLoaderNative:
+    def test_workers_use_native_pipeline(self):
+        from mxnet_tpu.gluon.data import ArrayDataset, DataLoader
+
+        x = np.arange(64, dtype=np.float32).reshape(32, 2)
+        y = np.arange(32, dtype=np.int32)
+        ds = ArrayDataset(x, y)
+        dl = DataLoader(ds, batch_size=4, num_workers=3)
+        seen = list(dl)
+        assert len(seen) == 8
+        xs = np.concatenate([np.asarray(b[0]) for b in seen])
+        np.testing.assert_array_equal(np.sort(xs.ravel()), x.ravel())
