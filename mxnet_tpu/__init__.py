@@ -55,6 +55,9 @@ from . import kvstore  # noqa: E402
 from . import io  # noqa: E402
 from . import recordio  # noqa: E402
 from . import gluon  # noqa: E402
+from . import symbol  # noqa: E402
+from . import symbol as sym  # noqa: E402
+from . import storage  # noqa: E402
 from . import util  # noqa: E402
 from . import runtime  # noqa: E402
 from . import profiler  # noqa: E402
@@ -94,6 +97,9 @@ __all__ = [
     "seed",
     "waitall",
     "engine",
+    "symbol",
+    "sym",
+    "storage",
     "device",
     "base",
     "util",

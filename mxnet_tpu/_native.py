@@ -14,6 +14,7 @@ from __future__ import annotations
 
 import atexit
 import ctypes
+import itertools
 import os
 import subprocess
 import sys
@@ -90,7 +91,8 @@ def _load():
     lib.MXTPipelineSubmit.restype = ctypes.c_int64
     lib.MXTPipelinePop.argtypes = [ctypes.c_void_p,
                                    ctypes.POINTER(ctypes.c_int),
-                                   ctypes.POINTER(ctypes.c_void_p)]
+                                   ctypes.POINTER(ctypes.c_void_p),
+                                   ctypes.c_int64]
     lib.MXTPipelinePop.restype = ctypes.c_int64
     lib.MXTPipelineFree.argtypes = [ctypes.c_void_p]
     return lib
@@ -116,13 +118,16 @@ if NATIVE is not None:
             pass
 
 
-# Live per-op fn callbacks, keyed by op id. The single module-level deleter
-# below frees them. Keeping ONE never-freed deleter CFUNCTYPE avoids a
+# Live per-op fn callbacks, keyed by a MODULE-GLOBAL op id (all
+# NativeEngine instances share the one C++ engine singleton, so ids must
+# not collide across instances). The single module-level deleter below
+# frees them. Keeping ONE never-freed deleter CFUNCTYPE avoids a
 # use-after-free: a per-op deleter closure would drop its own ffi trampoline
 # while the C++ worker thread is still executing it. Freeing the *fn*
 # callback from inside the deleter is safe — by deleter time fn has
 # returned (Engine::Execute runs fn, then Complete runs the deleter).
 _live_op_callbacks = {}
+_op_id_counter = itertools.count(1)  # 0 reserved: NULL ctx maps to it
 
 
 @_del_t
@@ -143,7 +148,6 @@ class NativeEngine:
         if NATIVE is None:
             raise RuntimeError("native runtime unavailable")
         self._lib = NATIVE
-        self._next_id = 1  # 0 is reserved: NULL ctx maps to it
 
     def new_var(self):
         return self._lib.MXTEngineNewVar()
@@ -156,8 +160,7 @@ class NativeEngine:
 
     def push(self, fn, const_vars=(), mutable_vars=(), priority=0, io=False):
         """Push async op. fn() runs on an engine worker thread."""
-        cid = self._next_id
-        self._next_id += 1
+        cid = next(_op_id_counter)
 
         def _run(_ctx, err_buf, err_len):
             try:
@@ -236,12 +239,17 @@ class NativePipeline:
             raise RuntimeError("pipeline closed")
         return ticket
 
-    def pop(self):
-        """Next result in submission order; raises task exceptions here."""
+    def pop(self, timeout=None):
+        """Next result in submission order; raises task exceptions here.
+        timeout (seconds) raises TimeoutError if no completion in time."""
         status = ctypes.c_int()
         ctx = ctypes.c_void_p()
         ticket = self._lib.MXTPipelinePop(
-            self._h, ctypes.byref(status), ctypes.byref(ctx))
+            self._h, ctypes.byref(status), ctypes.byref(ctx),
+            int(timeout * 1000) if timeout else 0)
+        if ticket == -3:
+            raise TimeoutError(
+                f"pipeline result not ready within {timeout}s")
         if ticket < 0:
             raise StopIteration
         self._callbacks.pop(ticket, None)

@@ -341,6 +341,14 @@ class HybridBlock(Block):
         super()._clear_cached()
 
     def __call__(self, *args, **kwargs):
+        if not kwargs and args and all(
+                isinstance(a, NDArray) for a in args) and not any(
+                isinstance(a._data, jax.core.Tracer) for a in args):
+            # remember input signature for export() (reference: CachedOp
+            # remembers bound shapes via SetForwardGraph)
+            object.__setattr__(
+                self, "_last_input_specs",
+                [(tuple(a.shape), a.dtype) for a in args])
         if self._active and not kwargs:
             tensor_args = all(isinstance(a, NDArray) for a in args)
             if tensor_args and not any(
@@ -486,35 +494,160 @@ class HybridBlock(Block):
     # -- export ------------------------------------------------------------
     def export(self, path, epoch=0, remove_amp_cast=True):  # noqa: ARG002
         """Export for deployment (reference: HybridBlock.export →
-        model-symbol.json + model-0000.params). Here: params npz + the
-        compiled program's StableHLO text — the AOT artifact XLA consumes."""
-        self.save_parameters(f"{path}-{epoch:04d}.params.npz")
+        model-symbol.json + model-0000.params, block.py:1480).
+
+        TPU-native artifact: params .npz + the inference program serialized
+        as portable StableHLO via jax.export — the AOT-compiled-graph role
+        model-symbol.json played. Round-trips through SymbolBlock.imports.
+        Requires one prior call (to know input shapes)."""
+        specs = getattr(self, "_last_input_specs", None)
+        if specs is None:
+            raise RuntimeError(
+                "export needs input shapes: call the block once first")
+        params_file = f"{path}-{epoch:04d}.params.npz"
+        self.save_parameters(params_file)
+        fn, param_data = self.as_pure_function(training=False)
+        key = jax.random.PRNGKey(0)
+
+        def infer_fn(pd, *xs):
+            out, _ = fn(pd, key, *xs)
+            return out
+
+        from jax import export as jax_export
+
+        exp = jax_export.export(jax.jit(infer_fn))(
+            {n: jax.ShapeDtypeStruct(a.shape, a.dtype)
+             for n, a in param_data.items()},
+            *[jax.ShapeDtypeStruct(s, d) for s, d in specs])
+        hlo_file = f"{path}-{epoch:04d}.stablehlo.bin"
+        with open(hlo_file, "wb") as f:
+            f.write(exp.serialize())
         meta = {
             "format": "mxnet_tpu-stablehlo",
             "class": type(self).__name__,
-            "params": f"{path}-{epoch:04d}.params.npz",
+            "params": params_file,
+            "stablehlo": hlo_file,
+            "inputs": [[list(s), str(_np.dtype(d))] for s, d in specs],
         }
-        variants = self._jit_variants
-        if variants:
-            jitted = next(iter(variants.values()))
-            try:
-                traced = getattr(jitted, "_cached_lowering", None)
-                meta["note"] = "lowered program available via jit.lower()"
-            except Exception:
-                pass
         with open(f"{path}-symbol.json", "w") as f:
             json.dump(meta, f, indent=2)
-        return f"{path}-symbol.json", f"{path}-{epoch:04d}.params.npz"
+        return f"{path}-symbol.json", params_file
 
 
 class SymbolBlock(HybridBlock):
-    """Placeholder for the reference's SymbolBlock (imports exported graphs).
+    """Run a graph artifact as a Block (reference: gluon/block.py:1654).
 
-    Graph import from the reference's JSON symbol format is not supported —
-    exported artifacts here are StableHLO + params (see HybridBlock.export).
+    Two artifact kinds:
+      * an mx.symbol DAG (``SymbolBlock(outputs, inputs, params=...)`` or a
+        saved symbol json) — evaluated through the symbol op table;
+      * a StableHLO bundle from HybridBlock.export — rehydrated with
+        jax.export.deserialize (inference only, like a deployed
+        model-symbol.json was).
     """
 
-    def __init__(self, *a, **k):  # noqa: ARG002
-        raise NotImplementedError(
-            "SymbolBlock (legacy JSON graph import) is not supported; "
-            "load parameters into a python-defined HybridBlock instead")
+    def __init__(self, outputs=None, inputs=None, params=None):
+        super().__init__()
+        object.__setattr__(self, "_exported", None)
+        object.__setattr__(self, "_symbol", None)
+        object.__setattr__(self, "_input_names", [])
+        object.__setattr__(self, "_arg_params", {})
+        if outputs is None:
+            return  # imports() fills in
+        from ..symbol.symbol import Symbol as Sym
+
+        if isinstance(outputs, (list, tuple)):
+            from ..symbol.symbol import Group
+
+            outputs = Group(list(outputs))
+        if not isinstance(outputs, Sym):
+            raise TypeError("outputs must be a Symbol")
+        if inputs is None:
+            raise ValueError("SymbolBlock needs the input symbols")
+        if not isinstance(inputs, (list, tuple)):
+            inputs = [inputs]
+        object.__setattr__(self, "_symbol", outputs)
+        object.__setattr__(
+            self, "_input_names", [s.name for s in inputs])
+        arg_names = [n for n in outputs.list_arguments()
+                     if n not in self._input_names]
+        params = params or {}
+        for n in arg_names:
+            p = Parameter(name=n, shape=None)
+            if n in params:
+                v = params[n]
+                arr = v.data() if isinstance(v, Parameter) else v
+                if isinstance(arr, NDArray):
+                    arr = arr._data
+                p.shape = tuple(arr.shape)
+                p.initialize(device=current_device())
+                p.set_data(NDArray(jnp.asarray(arr)))
+            self._arg_params[n] = p
+            self.register_parameter(n.replace(".", "_"), p)
+
+    @staticmethod
+    def imports(symbol_file, input_names=("data",), param_file=None,
+                ctx=None, device=None, allow_missing=False):  # noqa: ARG004
+        """Load an exported artifact (reference: SymbolBlock.imports)."""
+        import os
+
+        with open(symbol_file) as f:
+            head = f.read()
+        blk = SymbolBlock()
+        if isinstance(input_names, str):
+            input_names = [input_names]
+        try:
+            meta = json.loads(head)
+        except json.JSONDecodeError:
+            meta = None
+        if meta and meta.get("format") == "mxnet_tpu-stablehlo":
+            from jax import export as jax_export
+
+            base = os.path.dirname(os.path.abspath(symbol_file))
+
+            def _resolve(p):
+                return p if os.path.exists(p) else os.path.join(
+                    base, os.path.basename(p))
+
+            with open(_resolve(meta["stablehlo"]), "rb") as f:
+                exported = jax_export.deserialize(f.read())
+            loaded = _np.load(_resolve(param_file or meta["params"]),
+                              allow_pickle=False)
+            object.__setattr__(blk, "_exported", exported)
+            object.__setattr__(
+                blk, "_arg_params",
+                {n: jnp.asarray(loaded[n]) for n in loaded.files})
+            object.__setattr__(blk, "_input_names", list(input_names))
+            return blk
+        if meta and meta.get("format") == "mxnet_tpu-symbol":
+            from ..symbol.symbol import fromjson
+
+            sym = fromjson(head)
+            from ..symbol.symbol import var as sym_var
+
+            inputs = [sym_var(n) for n in input_names]
+            blk2 = SymbolBlock(sym, inputs)
+            if param_file:
+                blk2.load_parameters(param_file,
+                                     allow_missing=allow_missing)
+            return blk2
+        raise ValueError(f"unrecognized artifact {symbol_file}")
+
+    def forward(self, *args):
+        if self._exported is not None:
+            datas = [a._data if isinstance(a, NDArray) else jnp.asarray(a)
+                     for a in args]
+            out = self._exported.call(self._arg_params, *datas)
+            out = jax.tree_util.tree_map(NDArray, out)
+            if isinstance(out, (list, tuple)) and len(out) == 1:
+                return out[0]
+            return out
+        if self._symbol is None:
+            raise RuntimeError("empty SymbolBlock")
+        feed = {}
+        for n, a in zip(self._input_names, args):
+            feed[n] = a._data if isinstance(a, NDArray) else jnp.asarray(a)
+        for n, p in self._arg_params.items():
+            feed[n] = p.data()._data
+        outs = self._symbol._lower()(feed)
+        outs = [NDArray(o) for o in outs]
+        return outs[0] if len(outs) == 1 else outs

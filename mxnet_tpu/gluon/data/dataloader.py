@@ -79,7 +79,7 @@ class DataLoader:
                     indices = batches[submitted]
                     pipe.submit(lambda ix=indices: self._make_batch(ix))
                     submitted += 1
-                yield pipe.pop()
+                yield pipe.pop(timeout=self._timeout)
                 popped += 1
         finally:
             pipe.close()

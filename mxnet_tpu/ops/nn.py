@@ -20,12 +20,18 @@ from .registry import register_op
 
 
 @register_op("FullyConnected")
-def dense(x, weight, bias=None, flatten=True):
+def dense(x, weight, bias=None, flatten=True, num_hidden=None,
+          no_bias=None):  # noqa: ARG001 - reference-signature parity
     """y = x @ W^T + b (reference: src/operator/nn/fully_connected.cc).
 
     weight layout (out_units, in_units) matches the reference so checkpoints
     map 1:1. With flatten=True input is reshaped to (N, -1) first.
+    num_hidden is accepted for reference-call-signature parity; the
+    weight shape is authoritative. no_bias=True drops the bias even if
+    one is passed (reference semantics).
     """
+    if no_bias:
+        bias = None
     if flatten and x.ndim > 2:
         x = x.reshape(x.shape[0], -1)
     y = jnp.matmul(x, weight.T)

@@ -59,13 +59,19 @@ def alloc(size) -> Handle:
 
 def free(handle: Handle):
     """Return to pool (reference: Storage::Free — pooled, not released)."""
-    _lib().MXTStorageFree(handle.ptr)
+    if _lib().MXTStorageFree(handle.ptr) != 0:
+        raise ValueError(
+            f"invalid free of {handle.ptr!r}: "
+            + _lib().MXTGetLastError().decode(errors="replace"))
     handle.ptr = None
 
 
 def direct_free(handle: Handle):
     """Bypass the pool and release to the OS (Storage::DirectFree)."""
-    _lib().MXTStorageDirectFree(handle.ptr)
+    if _lib().MXTStorageDirectFree(handle.ptr) != 0:
+        raise ValueError(
+            f"invalid free of {handle.ptr!r}: "
+            + _lib().MXTGetLastError().decode(errors="replace"))
     handle.ptr = None
 
 

@@ -1,0 +1,18 @@
+"""mx.symbol — legacy lazy-graph API (reference: python/mxnet/symbol/
+symbol.py:54 `Symbol`, ~15.8k LoC).
+
+TPU re-design: a Symbol is a lightweight DAG node over the same pure-jax
+op implementations the imperative frontends use (mxnet_tpu/ops). There is
+no separate graph engine — `bind` lowers the DAG to one pure function and
+compiles it with jax.jit (the GraphExecutor ≙ XLA program), `infer_shape`
+is jax.eval_shape on that function (reference: infer_graph_attr_pass.cc),
+and Executor.backward is jax.vjp. tojson/save/load round-trip the DAG for
+model export (reference: model-symbol.json).
+"""
+from .symbol import (Executor, Group, Symbol, Variable, fromjson, load,
+                     load_json, var, zeros, ones)
+from . import op  # registers the op table; also exposes sym.op.* wrappers
+from .op import *  # noqa: F401,F403
+
+__all__ = ["Symbol", "Variable", "Group", "Executor", "var", "load",
+           "load_json", "fromjson", "zeros", "ones"] + op.__all__

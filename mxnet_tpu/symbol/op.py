@@ -1,0 +1,320 @@
+"""Symbol operator wrappers (reference: generated mx.sym.* from the op
+registry — symbol/register.py). Each op lowers to the same pure-jax
+implementations the imperative frontends use (mxnet_tpu/ops/nn.py, jnp),
+so symbolic and imperative results agree by construction (the
+check_consistency property the reference tested for).
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ..ops import nn as _nn
+from .symbol import Symbol, register_sym_op
+
+__all__ = [
+    "FullyConnected", "Convolution", "Deconvolution", "Activation",
+    "Pooling", "BatchNorm", "LayerNorm", "Dropout", "Flatten", "Concat",
+    "SoftmaxOutput", "softmax", "log_softmax", "exp", "log", "sqrt",
+    "square", "tanh", "sigmoid", "relu", "abs", "negative", "dot",
+    "batch_dot", "sum", "mean", "max", "min", "prod", "argmax", "argmin",
+    "transpose", "reshape", "expand_dims", "squeeze", "slice",
+    "slice_axis", "split", "stack", "where", "maximum", "minimum",
+    "broadcast_add", "broadcast_sub", "broadcast_mul", "broadcast_div",
+    "broadcast_to", "zeros_like", "ones_like", "clip", "norm", "power",
+    "elemwise_add", "elemwise_sub", "elemwise_mul", "elemwise_div",
+    "LeakyReLU", "Embedding", "take", "one_hot", "swapaxes",
+]
+
+
+def _reg(name, nin=None, nout=1):
+    """Register table entry + return a Symbol-building wrapper."""
+    def deco(fn):
+        register_sym_op(name, fn)
+
+        def wrapper(*inputs, name=None, **attrs):
+            return Symbol.create(name_, *inputs, name=name, nout=nout,
+                                 **attrs)
+
+        name_ = name
+        wrapper.__name__ = name
+        return wrapper
+
+    return deco
+
+
+# -- elementwise ------------------------------------------------------------
+elemwise_add = _reg("elemwise_add")(lambda ins, a: ins[0] + ins[1])
+elemwise_sub = _reg("elemwise_sub")(lambda ins, a: ins[0] - ins[1])
+elemwise_mul = _reg("elemwise_mul")(lambda ins, a: ins[0] * ins[1])
+elemwise_div = _reg("elemwise_div")(lambda ins, a: ins[0] / ins[1])
+broadcast_add = _reg("broadcast_add")(lambda ins, a: ins[0] + ins[1])
+broadcast_sub = _reg("broadcast_sub")(lambda ins, a: ins[0] - ins[1])
+broadcast_mul = _reg("broadcast_mul")(lambda ins, a: ins[0] * ins[1])
+broadcast_div = _reg("broadcast_div")(lambda ins, a: ins[0] / ins[1])
+power = _reg("power")(lambda ins, a: ins[0] ** ins[1])
+negative = _reg("negative")(lambda ins, a: -ins[0])
+exp = _reg("exp")(lambda ins, a: jnp.exp(ins[0]))
+log = _reg("log")(lambda ins, a: jnp.log(ins[0]))
+sqrt = _reg("sqrt")(lambda ins, a: jnp.sqrt(ins[0]))
+square = _reg("square")(lambda ins, a: jnp.square(ins[0]))
+tanh = _reg("tanh")(lambda ins, a: jnp.tanh(ins[0]))
+abs = _reg("abs")(lambda ins, a: jnp.abs(ins[0]))  # noqa: A001
+sigmoid = _reg("sigmoid")(
+    lambda ins, a: _nn.activation(ins[0], "sigmoid"))
+relu = _reg("relu")(lambda ins, a: _nn.activation(ins[0], "relu"))
+maximum = _reg("maximum")(lambda ins, a: jnp.maximum(ins[0], ins[1]))
+minimum = _reg("minimum")(lambda ins, a: jnp.minimum(ins[0], ins[1]))
+where = _reg("where")(
+    lambda ins, a: jnp.where(ins[0].astype(bool), ins[1], ins[2]))
+clip = _reg("clip")(
+    lambda ins, a: jnp.clip(ins[0], a.get("a_min"), a.get("a_max")))
+zeros_like = _reg("zeros_like")(lambda ins, a: jnp.zeros_like(ins[0]))
+ones_like = _reg("ones_like")(lambda ins, a: jnp.ones_like(ins[0]))
+
+# -- reduce -----------------------------------------------------------------
+
+
+def _axis(a):
+    ax = a.get("axis")
+    if isinstance(ax, list):
+        ax = tuple(ax)
+    return ax
+
+
+sum = _reg("sum")(  # noqa: A001
+    lambda ins, a: jnp.sum(ins[0], axis=_axis(a),
+                           keepdims=a.get("keepdims", False)))
+mean = _reg("mean")(
+    lambda ins, a: jnp.mean(ins[0], axis=_axis(a),
+                            keepdims=a.get("keepdims", False)))
+max = _reg("max")(  # noqa: A001
+    lambda ins, a: jnp.max(ins[0], axis=_axis(a),
+                           keepdims=a.get("keepdims", False)))
+min = _reg("min")(  # noqa: A001
+    lambda ins, a: jnp.min(ins[0], axis=_axis(a),
+                           keepdims=a.get("keepdims", False)))
+prod = _reg("prod")(
+    lambda ins, a: jnp.prod(ins[0], axis=_axis(a),
+                            keepdims=a.get("keepdims", False)))
+argmax = _reg("argmax")(
+    lambda ins, a: jnp.argmax(ins[0], axis=a.get("axis")).astype(
+        jnp.float32))
+argmin = _reg("argmin")(
+    lambda ins, a: jnp.argmin(ins[0], axis=a.get("axis")).astype(
+        jnp.float32))
+norm = _reg("norm")(
+    lambda ins, a: jnp.linalg.norm(ins[0], ord=a.get("ord", 2),
+                                   axis=_axis(a),
+                                   keepdims=a.get("keepdims", False)))
+
+# -- shape ------------------------------------------------------------------
+transpose = _reg("transpose")(
+    lambda ins, a: jnp.transpose(ins[0], a.get("axes")))
+reshape = _reg("reshape")(
+    lambda ins, a: jnp.reshape(ins[0], tuple(a["shape"])))
+expand_dims = _reg("expand_dims")(
+    lambda ins, a: jnp.expand_dims(ins[0], a["axis"]))
+squeeze = _reg("squeeze")(
+    lambda ins, a: jnp.squeeze(ins[0], _axis(a)))
+swapaxes = _reg("swapaxes")(
+    lambda ins, a: jnp.swapaxes(ins[0], a["dim1"], a["dim2"]))
+broadcast_to = _reg("broadcast_to")(
+    lambda ins, a: jnp.broadcast_to(ins[0], tuple(a["shape"])))
+Flatten = _reg("Flatten")(
+    lambda ins, a: jnp.reshape(ins[0], (ins[0].shape[0], -1)))
+
+
+def _slice_impl(ins, a):
+    import builtins
+
+    begin, end = a["begin"], a["end"]
+    step = a.get("step") or [None] * len(begin)
+    return ins[0][tuple(builtins.slice(b, e, s)
+                        for b, e, s in zip(begin, end, step))]
+
+
+slice = _reg("slice")(_slice_impl)  # noqa: A001
+
+
+def _slice_axis_impl(ins, a):
+    import builtins
+
+    sl = [builtins.slice(None)] * ins[0].ndim
+    sl[a["axis"]] = builtins.slice(a["begin"], a["end"])
+    return ins[0][tuple(sl)]
+
+
+slice_axis = _reg("slice_axis")(_slice_axis_impl)
+split = _reg("split")(
+    lambda ins, a: tuple(jnp.split(ins[0], a["num_outputs"],
+                                   axis=a.get("axis", 1))))
+
+
+def Concat(*inputs, dim=1, name=None, **kw):  # noqa: ARG001
+    return Symbol.create("Concat", *inputs, name=name, dim=dim)
+
+
+register_sym_op("Concat",
+                lambda ins, a: jnp.concatenate(ins, axis=a.get("dim", 1)))
+
+
+def stack(*inputs, axis=0, name=None):
+    return Symbol.create("stack", *inputs, name=name, axis=axis)
+
+
+register_sym_op("stack",
+                lambda ins, a: jnp.stack(ins, axis=a.get("axis", 0)))
+
+# -- linalg -----------------------------------------------------------------
+dot = _reg("dot")(lambda ins, a: jnp.dot(ins[0], ins[1]))
+batch_dot = _reg("batch_dot")(
+    lambda ins, a: jnp.einsum("bij,bjk->bik", ins[0], ins[1]))
+take = _reg("take")(
+    lambda ins, a: jnp.take(ins[0], ins[1].astype(jnp.int32),
+                            axis=a.get("axis", 0)))
+
+
+def Embedding(data, weight, input_dim=None, output_dim=None,
+              name=None, **kw):  # noqa: ARG001
+    return Symbol.create("Embedding", data, weight, name=name)
+
+
+register_sym_op(
+    "Embedding",
+    lambda ins, a: _nn.embedding(ins[0].astype(jnp.int32), ins[1]))
+one_hot = _reg("one_hot")(
+    lambda ins, a: _nn.one_hot(ins[0].astype(jnp.int32), a["depth"]))
+
+# -- NN layers --------------------------------------------------------------
+softmax = _reg("softmax")(
+    lambda ins, a: _nn.softmax(ins[0], axis=a.get("axis", -1)))
+log_softmax = _reg("log_softmax")(
+    lambda ins, a: _nn.log_softmax(ins[0], axis=a.get("axis", -1)))
+
+
+def FullyConnected(data, weight, bias=None, num_hidden=None, no_bias=False,
+                   flatten=True, name=None):  # noqa: ARG001
+    ins = (data, weight) if no_bias or bias is None else (data, weight, bias)
+    return Symbol.create("FullyConnected", *ins, name=name,
+                         no_bias=bool(no_bias or bias is None),
+                         flatten=flatten)
+
+
+register_sym_op(
+    "FullyConnected",
+    lambda ins, a: _nn.dense(ins[0], ins[1],
+                             None if a.get("no_bias") else ins[2],
+                             flatten=a.get("flatten", True)))
+
+
+def Convolution(data, weight, bias=None, kernel=None, num_filter=None,
+                stride=None, pad=None, dilate=None, num_group=1,
+                no_bias=False, name=None, **kw):  # noqa: ARG001
+    ins = (data, weight) if no_bias or bias is None else (data, weight, bias)
+    return Symbol.create("Convolution", *ins, name=name,
+                         no_bias=bool(no_bias or bias is None),
+                         stride=stride, pad=pad, dilate=dilate,
+                         num_group=num_group)
+
+
+register_sym_op(
+    "Convolution",
+    lambda ins, a: _nn.conv(ins[0], ins[1],
+                            None if a.get("no_bias") else ins[2],
+                            stride=a.get("stride"), pad=a.get("pad"),
+                            dilate=a.get("dilate"),
+                            groups=a.get("num_group", 1)))
+
+
+def Deconvolution(data, weight, bias=None, no_bias=False, stride=None,
+                  pad=None, name=None, **kw):  # noqa: ARG001
+    ins = (data, weight) if no_bias or bias is None else (data, weight, bias)
+    return Symbol.create("Deconvolution", *ins, name=name,
+                         no_bias=bool(no_bias or bias is None),
+                         stride=stride, pad=pad)
+
+
+register_sym_op(
+    "Deconvolution",
+    lambda ins, a: _nn.conv_transpose(
+        ins[0], ins[1], None if a.get("no_bias") else ins[2],
+        stride=a.get("stride"), pad=a.get("pad")))
+
+
+def Activation(data, act_type="relu", name=None):
+    return Symbol.create("Activation", data, name=name, act_type=act_type)
+
+
+register_sym_op("Activation",
+                lambda ins, a: _nn.activation(ins[0],
+                                              a.get("act_type", "relu")))
+
+
+def LeakyReLU(data, act_type="leaky", slope=0.25, name=None):
+    return Symbol.create("LeakyReLU", data, name=name, act_type=act_type,
+                         slope=slope)
+
+
+register_sym_op(
+    "LeakyReLU",
+    lambda ins, a: _nn.leaky_relu(ins[0], None,
+                                  act_type=a.get("act_type", "leaky"),
+                                  slope=a.get("slope", 0.25)))
+
+
+def Pooling(data, kernel=(2, 2), pool_type="max", stride=None, pad=None,
+            global_pool=False, name=None, **kw):  # noqa: ARG001
+    return Symbol.create("Pooling", data, name=name, kernel=kernel,
+                         pool_type=pool_type, stride=stride, pad=pad,
+                         global_pool=global_pool)
+
+
+register_sym_op(
+    "Pooling",
+    lambda ins, a: _nn.pool(ins[0], a.get("kernel", (2, 2)),
+                            pool_type=a.get("pool_type", "max"),
+                            stride=a.get("stride"), pad=a.get("pad"),
+                            global_pool=a.get("global_pool", False)))
+
+
+def BatchNorm(data, gamma, beta, moving_mean, moving_var, eps=1e-5,
+              momentum=0.9, fix_gamma=False, use_global_stats=True,
+              name=None, **kw):  # noqa: ARG001
+    """Inference-mode BN (symbolic graphs are deployment artifacts; train
+    BN lives in gluon.nn.BatchNorm)."""
+    return Symbol.create("BatchNorm", data, gamma, beta, moving_mean,
+                         moving_var, name=name, eps=eps)
+
+
+register_sym_op(
+    "BatchNorm",
+    lambda ins, a: _nn.batch_norm(ins[0], ins[1], ins[2], ins[3], ins[4],
+                                  eps=a.get("eps", 1e-5),
+                                  use_global_stats=True)[0])
+
+
+def LayerNorm(data, gamma, beta, axis=-1, eps=1e-5, name=None):
+    return Symbol.create("LayerNorm", data, gamma, beta, name=name,
+                         axis=axis, eps=eps)
+
+
+register_sym_op(
+    "LayerNorm",
+    lambda ins, a: _nn.layer_norm(ins[0], ins[1], ins[2],
+                                  axis=a.get("axis", -1),
+                                  eps=a.get("eps", 1e-5)))
+
+
+def Dropout(data, p=0.5, name=None, **kw):  # noqa: ARG001
+    """Identity in symbolic graphs (deployment = inference; reference
+    Dropout also no-ops outside training mode)."""
+    return Symbol.create("Dropout", data, name=name, p=p)
+
+
+register_sym_op("Dropout", lambda ins, a: ins[0])
+
+
+def SoftmaxOutput(data, label=None, name=None, **kw):  # noqa: ARG001
+    """Softmax for deployment (the loss part of the reference op applies
+    only in training graphs)."""
+    return Symbol.create("softmax", data, name=name or "softmax", axis=-1)

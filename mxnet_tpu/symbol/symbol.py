@@ -1,0 +1,413 @@
+"""Symbol core: DAG nodes, graph lowering, executor, (de)serialization.
+
+Reference: python/mxnet/symbol/symbol.py (Symbol:54, bind/simple_bind,
+list_arguments, infer_shape, tojson) + src/nnvm graph passes. Here the
+graph IS a pure jax function; every pass the reference hand-wrote
+(shape inference, memory planning, fusion, gradient) is delegated to
+jax.eval_shape / XLA / jax.vjp.
+"""
+from __future__ import annotations
+
+import json
+
+import jax
+import jax.numpy as jnp
+import numpy as _np
+
+_OP_TABLE = {}  # op name -> fn(list_of_arrays, attrs) -> array or tuple
+
+
+def register_sym_op(name, fn):
+    _OP_TABLE[name] = fn
+    return fn
+
+
+class Symbol:
+    """A node in the lazy graph. Immutable; identity = python object."""
+
+    __slots__ = ("_op", "_name", "_inputs", "_attrs", "_nout", "_out_index")
+
+    _auto_count = {}
+
+    def __init__(self, op, name, inputs, attrs=None, nout=1, out_index=None):
+        self._op = op            # None => variable (leaf)
+        self._name = name
+        self._inputs = list(inputs)
+        self._attrs = dict(attrs or {})
+        self._nout = nout
+        self._out_index = out_index  # set when slicing a multi-output node
+
+    # -- construction helpers ---------------------------------------------
+    @staticmethod
+    def _auto_name(op):
+        i = Symbol._auto_count.get(op, 0)
+        Symbol._auto_count[op] = i + 1
+        return f"{op.lower()}{i}"
+
+    @staticmethod
+    def create(op, *inputs, name=None, nout=1, **attrs):
+        if op not in _OP_TABLE:
+            raise ValueError(f"unknown symbol op {op!r}")
+        inputs = [s if isinstance(s, Symbol) else _const(s) for s in inputs]
+        return Symbol(op, name or Symbol._auto_name(op), inputs, attrs,
+                      nout=nout)
+
+    # -- python operators --------------------------------------------------
+    def __add__(self, o):
+        return Symbol.create("elemwise_add", self, o)
+
+    __radd__ = __add__
+
+    def __sub__(self, o):
+        return Symbol.create("elemwise_sub", self, o)
+
+    def __rsub__(self, o):
+        return Symbol.create("elemwise_sub", _const(o), self)
+
+    def __mul__(self, o):
+        return Symbol.create("elemwise_mul", self, o)
+
+    __rmul__ = __mul__
+
+    def __truediv__(self, o):
+        return Symbol.create("elemwise_div", self, o)
+
+    def __rtruediv__(self, o):
+        return Symbol.create("elemwise_div", _const(o), self)
+
+    def __pow__(self, o):
+        return Symbol.create("power", self, o)
+
+    def __neg__(self):
+        return Symbol.create("negative", self)
+
+    def __getitem__(self, idx):
+        if isinstance(idx, str):
+            for out, name in zip(self._flat_outputs(),
+                                 self.list_outputs()):
+                if name == idx:
+                    return out
+            raise KeyError(idx)
+        outs = self._flat_outputs()
+        return outs[idx]
+
+    def _flat_outputs(self):
+        if self._op == "_group":
+            return list(self._inputs)
+        if self._nout == 1:
+            return [self]
+        return [Symbol(self._op, self._name, self._inputs, self._attrs,
+                       nout=self._nout, out_index=i)
+                for i in range(self._nout)]
+
+    # -- introspection -----------------------------------------------------
+    @property
+    def name(self):
+        return self._name
+
+    def attr(self, key):
+        return self._attrs.get(key)
+
+    def _topo(self):
+        seen, order = set(), []
+
+        def visit(s):
+            key = (id(s._op), s._name, id(tuple(s._inputs)))  # noqa: F841
+            if id(s) in seen:
+                return
+            seen.add(id(s))
+            for i in s._inputs:
+                visit(i)
+            order.append(s)
+
+        visit(self)
+        return order
+
+    def list_arguments(self):
+        """Variable names in topo order (reference: list_arguments)."""
+        out, seen = [], set()
+        for s in self._topo():
+            if s._op is None and s._name not in seen:
+                seen.add(s._name)
+                out.append(s._name)
+        return out
+
+    def list_inputs(self):
+        return self.list_arguments()
+
+    def list_outputs(self):
+        if self._op == "_group":
+            names = []
+            for s in self._inputs:
+                names.extend(s.list_outputs())
+            return names
+        if self._nout == 1 or self._out_index is not None:
+            suffix = "" if self._out_index in (None, 0) else \
+                str(self._out_index)
+            return [f"{self._name}_output{suffix}"]
+        return [f"{self._name}_output{i}" for i in range(self._nout)]
+
+    def get_internals(self):
+        """All nodes as a multi-output group (reference: get_internals)."""
+        return Group([s for s in self._topo() if s._op != "_group"])
+
+    def __repr__(self):
+        return f"<Symbol {self._name}>"
+
+    # -- lowering to a pure function --------------------------------------
+    def _lower(self):
+        """Return fn(arg_dict) -> list of output arrays."""
+        order = self._topo()
+
+        def fn(arg_dict):
+            vals = {}
+            for s in order:
+                if s._op is None:
+                    if s._name not in arg_dict:
+                        raise KeyError(f"missing argument {s._name!r}")
+                    vals[id(s)] = arg_dict[s._name]
+                elif s._op == "_group":
+                    continue
+                elif s._op == "_const":
+                    vals[id(s)] = jnp.asarray(s._attrs["value"])
+                else:
+                    ins = [vals[id(i)] for i in s._inputs]
+                    out = _OP_TABLE[s._op](ins, s._attrs)
+                    if s._out_index is not None:
+                        out = out[s._out_index]
+                    vals[id(s)] = out
+            if self._op == "_group":
+                return [vals[id(s)] for s in self._inputs]
+            out = vals[id(self)]
+            if self._nout > 1 and self._out_index is None:
+                return list(out)
+            return [out]
+
+        return fn
+
+    # -- evaluation --------------------------------------------------------
+    def eval(self, ctx=None, **kwargs):  # noqa: ARG002
+        """Eager evaluation with named inputs (reference: Symbol.eval)."""
+        from ..ndarray.ndarray import NDArray
+
+        args = {k: v._data if isinstance(v, NDArray) else jnp.asarray(v)
+                for k, v in kwargs.items()}
+        outs = self._lower()(args)
+        return [NDArray(o) for o in outs]
+
+    def infer_shape(self, **kwargs):
+        """(arg_shapes, out_shapes, aux_shapes) from input shapes
+        (reference: Symbol.infer_shape — here jax.eval_shape)."""
+        names = self.list_arguments()
+        known = {}
+        for k, v in kwargs.items():
+            # pure metadata: never materialize arrays for shape queries
+            known[k] = jax.ShapeDtypeStruct(tuple(v), jnp.float32) \
+                if isinstance(v, (tuple, list)) \
+                else jax.ShapeDtypeStruct(v.shape, v.dtype)
+        missing = [n for n in names if n not in known]
+        if missing:
+            raise ValueError(f"infer_shape needs shapes for {missing}")
+        out_shapes = [o.shape for o in jax.eval_shape(
+            self._lower(), {n: known[n] for n in names})]
+        arg_shapes = [known[n].shape for n in names]
+        return arg_shapes, out_shapes, []
+
+    def infer_type(self, **kwargs):
+        names = self.list_arguments()
+        known = {n: jnp.zeros((1,), kwargs.get(n, _np.float32))
+                 for n in names}
+        outs = jax.eval_shape(
+            self._lower(), {n: jax.ShapeDtypeStruct(a.shape, a.dtype)
+                            for n, a in known.items()})
+        return ([known[n].dtype for n in names],
+                [o.dtype for o in outs], [])
+
+    def bind(self, ctx=None, args=None, args_grad=None, grad_req="write",
+             aux_states=None, **kwargs):  # noqa: ARG002
+        """Build an Executor (reference: Symbol.bind → GraphExecutor; here
+        the executor wraps a jitted function + jax.vjp)."""
+        return Executor(self, args or {}, args_grad, grad_req)
+
+    def simple_bind(self, ctx=None, grad_req="write", **shape_kwargs):
+        args = {}
+        for n in self.list_arguments():
+            if n not in shape_kwargs:
+                raise ValueError(f"simple_bind needs shape for {n}")
+            args[n] = jnp.zeros(shape_kwargs[n], jnp.float32)
+        return Executor(self, args, None, grad_req)
+
+    # -- serialization -----------------------------------------------------
+    def tojson(self):
+        """Serialize the DAG (reference: model-symbol.json; node schema is
+        ours — op/name/attrs/input ids — not nnvm's)."""
+        order = [s for s in self._topo()]
+        idx = {id(s): i for i, s in enumerate(order)}
+        nodes = []
+        for s in order:
+            nodes.append({
+                "op": s._op, "name": s._name,
+                "attrs": _json_attrs(s._attrs),
+                "inputs": [idx[id(i)] for i in s._inputs],
+                "nout": s._nout,
+                "out_index": s._out_index,
+            })
+        return json.dumps({"format": "mxnet_tpu-symbol", "version": 1,
+                           "nodes": nodes, "head": idx[id(self)]}, indent=2)
+
+    def save(self, fname):
+        with open(fname, "w") as f:
+            f.write(self.tojson())
+
+    # gradient symbol: not a graph pass here — executor.backward covers it
+    def grad(self, wrt):
+        raise NotImplementedError(
+            "symbolic grad graphs are subsumed by Executor.backward "
+            "(jax.vjp); bind() and call backward()")
+
+
+def _json_attrs(attrs):
+    out = {}
+    for k, v in attrs.items():
+        if isinstance(v, _np.ndarray):
+            out[k] = {"__ndarray__": v.tolist(), "dtype": str(v.dtype)}
+        else:
+            out[k] = v
+    return out
+
+
+def _unjson_attrs(attrs):
+    out = {}
+    for k, v in attrs.items():
+        if isinstance(v, dict) and "__ndarray__" in v:
+            out[k] = _np.asarray(v["__ndarray__"], dtype=v["dtype"])
+        elif isinstance(v, list):
+            out[k] = tuple(v)
+        else:
+            out[k] = v
+    return out
+
+
+def _const(value):
+    arr = _np.asarray(value)
+    return Symbol("_const", Symbol._auto_name("_const"), [],
+                  {"value": arr})
+
+
+register_sym_op("_const", lambda ins, attrs: jnp.asarray(attrs["value"]))
+register_sym_op("_group", lambda ins, attrs: tuple(ins))
+
+
+def var(name, shape=None, dtype=None, init=None, **kwargs):  # noqa: ARG001
+    """Create a variable (reference: symbol.var / Variable)."""
+    attrs = {}
+    if shape is not None:
+        attrs["__shape__"] = tuple(shape)
+    if dtype is not None:
+        attrs["__dtype__"] = str(_np.dtype(dtype))
+    return Symbol(None, name, [], attrs)
+
+
+Variable = var
+
+
+def Group(symbols):
+    """Multi-output symbol (reference: symbol.Group)."""
+    flat = []
+    for s in symbols:
+        flat.extend(s._flat_outputs())
+    return Symbol("_group", "group", flat)
+
+
+def zeros(shape, dtype=_np.float32, **kwargs):  # noqa: ARG001
+    return _const(_np.zeros(shape, dtype))
+
+
+def ones(shape, dtype=_np.float32, **kwargs):  # noqa: ARG001
+    return _const(_np.ones(shape, dtype))
+
+
+def fromjson(js):
+    data = json.loads(js)
+    if data.get("format") != "mxnet_tpu-symbol":
+        raise ValueError("not a mxnet_tpu symbol json")
+    nodes = []
+    for nd in data["nodes"]:
+        nodes.append(Symbol(nd["op"], nd["name"],
+                            [nodes[i] for i in nd["inputs"]],
+                            _unjson_attrs(nd["attrs"]), nout=nd["nout"],
+                            out_index=nd.get("out_index")))
+    return nodes[data["head"]]
+
+
+load_json = fromjson
+
+
+def load(fname):
+    with open(fname) as f:
+        return fromjson(f.read())
+
+
+class Executor:
+    """Bound graph (reference: executor.py over CachedOp). forward is the
+    jitted lowered function; backward is jax.vjp at the same boundary."""
+
+    def __init__(self, symbol, args, args_grad, grad_req):
+        from ..ndarray.ndarray import NDArray
+
+        self._symbol = symbol
+        self._names = symbol.list_arguments()
+        self.arg_dict = {}
+        for n in self._names:
+            if n not in args:
+                raise ValueError(f"bind missing argument {n}")
+            v = args[n]
+            self.arg_dict[n] = v if isinstance(v, NDArray) else \
+                NDArray(jnp.asarray(v))
+        self._grad_req = grad_req
+        self.grad_dict = {n: None for n in self._names}
+        if args_grad:
+            for n, g in args_grad.items():
+                self.grad_dict[n] = g
+        lowered = symbol._lower()
+        self._fn = jax.jit(lambda d: lowered(d))
+        self._vjp = None
+        self.outputs = []
+
+    def forward(self, is_train=False, **kwargs):
+        from ..ndarray.ndarray import NDArray
+
+        for n, v in kwargs.items():
+            self.arg_dict[n] = v if isinstance(v, NDArray) else \
+                NDArray(jnp.asarray(v))
+        data = {n: a._data for n, a in self.arg_dict.items()}
+        if is_train:
+            outs, self._vjp = jax.vjp(self._fn, data)
+        else:
+            outs = self._fn(data)
+            self._vjp = None
+        self.outputs = [NDArray(o) for o in outs]
+        return self.outputs
+
+    def backward(self, out_grads=None):
+        from ..ndarray.ndarray import NDArray
+
+        if self._vjp is None:
+            raise RuntimeError("call forward(is_train=True) first")
+        if out_grads is None:
+            cts = [jnp.ones_like(o._data) for o in self.outputs]
+        else:
+            if not isinstance(out_grads, (list, tuple)):
+                out_grads = [out_grads]
+            cts = [g._data if isinstance(g, NDArray) else jnp.asarray(g)
+                   for g in out_grads]
+        (grads,) = self._vjp(cts)
+        for n in self._names:
+            g = grads.get(n)
+            if g is None:
+                continue
+            if self._grad_req == "add" and self.grad_dict[n] is not None:
+                self.grad_dict[n] = NDArray(self.grad_dict[n]._data + g)
+            else:
+                self.grad_dict[n] = NDArray(g)
+        return self.grad_dict

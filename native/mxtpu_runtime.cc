@@ -19,6 +19,7 @@
 #include <algorithm>
 #include <atomic>
 #include <condition_variable>
+#include <chrono>
 #include <cstdint>
 #include <cstdio>
 #include <cstdlib>
@@ -790,13 +791,19 @@ class Pipeline {
   }
 
   // Pop the next completion in submission order. Returns ticket, fills
-  // status/ctx. Returns -1 if pipeline empty (nothing in flight).
-  int64_t Pop(int* status, void** ctx) {
+  // status/ctx. Returns -1 if pipeline empty (nothing in flight),
+  // -3 on timeout (timeout_ms > 0).
+  int64_t Pop(int* status, void** ctx, int64_t timeout_ms) {
     std::unique_lock<std::mutex> lk(mu_);
     if (InFlight() == 0 && done_.empty()) return -1;
-    cv_done_.wait(lk, [&] {
-      return stop_ || done_.count(next_pop_);
-    });
+    auto ready = [&] { return stop_ || done_.count(next_pop_); };
+    if (timeout_ms > 0) {
+      if (!cv_done_.wait_for(lk, std::chrono::milliseconds(timeout_ms),
+                             ready))
+        return -3;
+    } else {
+      cv_done_.wait(lk, ready);
+    }
     if (stop_ && !done_.count(next_pop_)) return -1;
     Task t = done_[next_pop_];
     done_.erase(next_pop_);
@@ -867,8 +874,9 @@ MXT_API int64_t MXTPipelineSubmit(void* h, mxt_fn_t fn, mxt_del_t del,
   return ((mxt::Pipeline*)h)->Submit(fn, del, ctx);
 }
 
-MXT_API int64_t MXTPipelinePop(void* h, int* status, void** ctx) {
-  return ((mxt::Pipeline*)h)->Pop(status, ctx);
+MXT_API int64_t MXTPipelinePop(void* h, int* status, void** ctx,
+                               int64_t timeout_ms) {
+  return ((mxt::Pipeline*)h)->Pop(status, ctx, timeout_ms);
 }
 
 MXT_API void MXTPipelineFree(void* h) { delete (mxt::Pipeline*)h; }

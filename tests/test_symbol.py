@@ -1,0 +1,157 @@
+"""mx.symbol + export/SymbolBlock tests (reference models:
+tests/python/unittest/test_symbol.py, test_gluon.py SymbolBlock cases)."""
+import numpy as onp
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import gluon, np
+from mxnet_tpu import symbol as sym
+
+
+class TestSymbolGraph:
+    def test_var_and_arithmetic(self):
+        a = sym.var("a")
+        b = sym.var("b")
+        c = (a + b) * 2 - b / a
+        assert set(c.list_arguments()) == {"a", "b"}
+        (out,) = c.eval(a=np.array([2.0]), b=np.array([4.0]))
+        assert float(out.asnumpy()[0]) == pytest.approx((2 + 4) * 2 - 4 / 2)
+
+    def test_list_arguments_topo_order(self):
+        x = sym.var("x")
+        w = sym.var("w")
+        b = sym.var("b")
+        y = sym.FullyConnected(x, w, b, num_hidden=3)
+        assert y.list_arguments() == ["x", "w", "b"]
+
+    def test_infer_shape(self):
+        x = sym.var("x")
+        w = sym.var("w")
+        y = sym.FullyConnected(x, w, no_bias=True, num_hidden=8)
+        args, outs, aux = y.infer_shape(x=(4, 16), w=(8, 16))
+        assert outs == [(4, 8)]
+        assert aux == []
+
+    def test_json_roundtrip(self):
+        x = sym.var("x")
+        w = sym.var("w")
+        y = sym.relu(sym.dot(x, w) + 1.0)
+        js = y.tojson()
+        y2 = sym.fromjson(js)
+        xa = onp.random.RandomState(0).rand(2, 3).astype("float32")
+        wa = onp.random.RandomState(1).rand(3, 4).astype("float32")
+        (o1,) = y.eval(x=np.array(xa), w=np.array(wa))
+        (o2,) = y2.eval(x=np.array(xa), w=np.array(wa))
+        onp.testing.assert_allclose(o1.asnumpy(), o2.asnumpy(), rtol=1e-6)
+
+    def test_save_load(self, tmp_path):
+        y = sym.exp(sym.var("x"))
+        f = str(tmp_path / "s.json")
+        y.save(f)
+        y2 = sym.load(f)
+        (o,) = y2.eval(x=np.array([0.0, 1.0]))
+        onp.testing.assert_allclose(o.asnumpy(), onp.exp([0.0, 1.0]),
+                                    rtol=1e-6)
+
+    def test_group_multi_output(self):
+        a = sym.var("a")
+        g = sym.Group([a + 1, a * 3])
+        assert len(g.list_outputs()) == 2
+        o1, o2 = g.eval(a=np.array([2.0]))
+        assert float(o1.asnumpy()[0]) == 3.0
+        assert float(o2.asnumpy()[0]) == 6.0
+
+    def test_executor_forward_backward(self):
+        x = sym.var("x")
+        w = sym.var("w")
+        loss = sym.sum(sym.square(sym.dot(x, w)))
+        ex = loss.simple_bind(x=(2, 3), w=(3, 1))
+        xa = onp.ones((2, 3), "float32")
+        wa = onp.full((3, 1), 2.0, "float32")
+        (out,) = ex.forward(is_train=True, x=xa, w=wa)
+        assert float(out.asnumpy()) == pytest.approx(2 * 36.0)
+        grads = ex.backward()
+        # d/dw sum((xw)^2) = 2 * x^T (xw)
+        expect = 2 * xa.T @ (xa @ wa)
+        onp.testing.assert_allclose(grads["w"].asnumpy(), expect, rtol=1e-5)
+
+    def test_conv_pool_graph(self):
+        x = sym.var("x")
+        w = sym.var("w")
+        y = sym.Pooling(sym.Convolution(x, w, no_bias=True, kernel=(3, 3)),
+                        kernel=(2, 2), pool_type="max", stride=(2, 2))
+        args, outs, _ = y.infer_shape(x=(1, 2, 8, 8), w=(4, 2, 3, 3))
+        assert outs[0][0] == 1 and outs[0][1] == 4
+
+    def test_slice_and_concat(self):
+        a = sym.var("a")
+        left = sym.slice_axis(a, axis=1, begin=0, end=2)
+        right = sym.slice_axis(a, axis=1, begin=2, end=4)
+        swapped = sym.Concat(right, left, dim=1)
+        (o,) = swapped.eval(a=np.array([[1.0, 2.0, 3.0, 4.0]]))
+        onp.testing.assert_allclose(o.asnumpy(), [[3, 4, 1, 2]])
+
+
+class TestSymbolBlock:
+    def test_symbolblock_from_symbol(self):
+        x = sym.var("data")
+        w = sym.var("w")
+        b = sym.var("b")
+        out = sym.relu(sym.FullyConnected(x, w, b, num_hidden=4))
+        net = gluon.SymbolBlock(out, [x], params={
+            "w": np.array(onp.random.RandomState(0).rand(4, 8),
+                          dtype="float32"),
+            "b": np.zeros((4,)),
+        })
+        y = net(np.ones((2, 8)))
+        assert y.shape == (2, 4)
+        assert float(y.asnumpy().min()) >= 0
+
+    def test_export_imports_roundtrip(self, tmp_path):
+        net = gluon.nn.HybridSequential()
+        net.add(gluon.nn.Dense(16, activation="relu"), gluon.nn.Dense(4))
+        net.initialize()
+        net.hybridize()
+        x = np.random.uniform(size=(3, 8))
+        y_ref = net(x).asnumpy()
+        path = str(tmp_path / "model")
+        sym_file, par_file = net.export(path)
+        blk = gluon.SymbolBlock.imports(sym_file, ["data"])
+        y2 = blk(x).asnumpy()
+        onp.testing.assert_allclose(y_ref, y2, rtol=1e-5, atol=1e-6)
+
+    def test_export_requires_prior_call(self, tmp_path):
+        net = gluon.nn.Dense(4)
+        net.initialize()
+        with pytest.raises(RuntimeError, match="call the block once"):
+            net.export(str(tmp_path / "m"))
+
+    def test_symbol_json_imports(self, tmp_path):
+        x = sym.var("data")
+        w = sym.var("w")
+        out = sym.dot(x, w)
+        f = str(tmp_path / "g-symbol.json")
+        out.save(f)
+        blk = gluon.SymbolBlock.imports(f, ["data"])
+        # params uninitialized; set directly
+        blk._arg_params["w"].shape = (3, 2)
+        blk._arg_params["w"].initialize()
+        y = blk(np.ones((1, 3)))
+        assert y.shape == (1, 2)
+
+    def test_consistency_symbolic_vs_imperative(self):
+        """Same op implementations must give identical results through both
+        frontends (reference: check_consistency oracle)."""
+        from mxnet_tpu import npx
+
+        xa = onp.random.RandomState(2).rand(2, 5).astype("float32")
+        wa = onp.random.RandomState(3).rand(7, 5).astype("float32")
+        ba = onp.random.RandomState(4).rand(7).astype("float32")
+        imperative = npx.fully_connected(
+            np.array(xa), np.array(wa), np.array(ba), num_hidden=7)
+        x = sym.var("x")
+        (symbolic,) = sym.FullyConnected(
+            x, sym.var("w"), sym.var("b"), num_hidden=7).eval(
+            x=np.array(xa), w=np.array(wa), b=np.array(ba))
+        onp.testing.assert_allclose(imperative.asnumpy(),
+                                    symbolic.asnumpy(), rtol=1e-6)
