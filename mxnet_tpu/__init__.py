@@ -58,6 +58,7 @@ from . import gluon  # noqa: E402
 from . import symbol  # noqa: E402
 from . import symbol as sym  # noqa: E402
 from . import storage  # noqa: E402
+from . import contrib  # noqa: E402
 from . import util  # noqa: E402
 from . import runtime  # noqa: E402
 from . import profiler  # noqa: E402
@@ -100,6 +101,7 @@ __all__ = [
     "symbol",
     "sym",
     "storage",
+    "contrib",
     "device",
     "base",
     "util",

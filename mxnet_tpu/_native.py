@@ -241,12 +241,13 @@ class NativePipeline:
 
     def pop(self, timeout=None):
         """Next result in submission order; raises task exceptions here.
-        timeout (seconds) raises TimeoutError if no completion in time."""
+        timeout (seconds) raises TimeoutError if no completion in time;
+        None blocks forever (0 still means an immediate-deadline poll)."""
         status = ctypes.c_int()
         ctx = ctypes.c_void_p()
+        timeout_ms = 0 if timeout is None else max(1, int(timeout * 1000))
         ticket = self._lib.MXTPipelinePop(
-            self._h, ctypes.byref(status), ctypes.byref(ctx),
-            int(timeout * 1000) if timeout else 0)
+            self._h, ctypes.byref(status), ctypes.byref(ctx), timeout_ms)
         if ticket == -3:
             raise TimeoutError(
                 f"pipeline result not ready within {timeout}s")
@@ -262,6 +263,13 @@ class NativePipeline:
         if self._h:
             self._lib.MXTPipelineFree(self._h)
             self._h = None
+
+    def abandon(self):
+        """Leak the native pipeline instead of closing it. Used after a
+        pop timeout: close() joins worker threads, and joining a thread
+        stuck in a hung task would deadlock the process — a leaked
+        pipeline is the lesser evil."""
+        self._h = None
 
     def __del__(self):
         try:

@@ -1,0 +1,3 @@
+"""mx.contrib — quantization, misc extensions (reference:
+python/mxnet/contrib/)."""
+from . import quantization  # noqa: F401

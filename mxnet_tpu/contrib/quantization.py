@@ -1,0 +1,409 @@
+"""INT8 quantization (reference: src/operator/quantization/ +
+python/mxnet/contrib/quantization.py).
+
+TPU re-design: the reference rewrites the nnvm graph, inserting
+quantize/dequantize nodes and swapping quantized op implementations
+(quantize_graph_pass.cc); calibration picks thresholds per layer with a
+min/max or KL-entropy pass (calibrate.cc). Here the graph rewrite is a
+*module* rewrite — Dense/Conv2D children of a HybridBlock are replaced by
+QuantizedDense/QuantizedConv2D blocks holding pre-quantized int8 weights —
+and the int8 compute path is XLA's native int8 matmul/conv
+(lax.dot_general / conv_general_dilated with preferred_element_type=int32,
+which the MXU executes at double int8 throughput). Calibration runs the
+same two modes as the reference: 'naive' (min/max over calib batches) and
+'entropy' (KL-optimal threshold over activation histograms).
+
+Ops provided for API parity: quantize, dequantize, requantize,
+quantize_v2; model API: quantize_net, calib_graph (threshold computation).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as _np
+
+from ..gluon import nn as _gnn
+from ..gluon.block import HybridBlock
+from ..ndarray.ndarray import NDArray, apply_op
+
+__all__ = ["quantize", "dequantize", "requantize", "quantize_v2",
+           "quantize_net", "QuantizedDense", "QuantizedConv2D",
+           "optimal_threshold"]
+
+INT8_MAX = 127.0
+
+
+# ---------------------------------------------------------------------------
+# ops (reference: quantize-inl.h, dequantize-inl.h, requantize-inl.h)
+# ---------------------------------------------------------------------------
+
+def _q(x, min_range, max_range):
+    scale = INT8_MAX / jnp.maximum(jnp.maximum(jnp.abs(min_range),
+                                               jnp.abs(max_range)), 1e-20)
+    return jnp.clip(jnp.round(x * scale), -127, 127).astype(jnp.int8), scale
+
+
+def quantize(data, min_range, max_range, out_type="int8"):
+    """fp32 -> int8 with symmetric scaling (reference: _contrib_quantize).
+
+    Returns (q_data, min_output, max_output) like the reference op."""
+    if out_type != "int8":
+        raise ValueError("TPU build quantizes to int8 only")
+
+    def pure(x, lo, hi):
+        qd, scale = _q(x, lo, hi)
+        amax = INT8_MAX / scale
+        return qd, -amax, amax
+
+    return apply_op(pure, *_as_nd(data, min_range, max_range),
+                    name="quantize")
+
+
+def quantize_v2(data, min_calib_range=None, max_calib_range=None,
+                out_type="int8"):
+    """Quantize with optional pre-computed calib range; computes min/max
+    on the fly otherwise (reference: _contrib_quantize_v2)."""
+    if out_type not in ("int8", "auto"):
+        raise ValueError("TPU build quantizes to int8 only")
+
+    if min_calib_range is not None:
+
+        def pure(x):
+            qd, scale = _q(x, jnp.float32(min_calib_range),
+                           jnp.float32(max_calib_range))
+            amax = INT8_MAX / scale
+            return qd, -amax, amax
+
+        return apply_op(pure, *_as_nd(data), name="quantize_v2")
+
+    def pure_dyn(x):
+        lo = jnp.min(x)
+        hi = jnp.max(x)
+        qd, scale = _q(x, lo, hi)
+        amax = INT8_MAX / scale
+        return qd, -amax, amax
+
+    return apply_op(pure_dyn, *_as_nd(data), name="quantize_v2")
+
+
+def dequantize(data, min_range, max_range, out_type="float32"):  # noqa: ARG001
+    """int8 -> fp32 (reference: _contrib_dequantize)."""
+
+    def pure(qd, lo, hi):
+        scale = jnp.maximum(jnp.abs(lo), jnp.abs(hi)) / INT8_MAX
+        return qd.astype(jnp.float32) * scale
+
+    return apply_op(pure, *_as_nd(data, min_range, max_range),
+                    name="dequantize")
+
+
+def requantize(data, min_range, max_range, min_calib_range=None,
+               max_calib_range=None):
+    """int32 accumulator -> int8 with new range (reference:
+    _contrib_requantize)."""
+
+    def pure(qd, lo, hi):
+        in_scale = jnp.maximum(jnp.abs(lo), jnp.abs(hi)) / (2.0 ** 31 - 1)
+        x = qd.astype(jnp.float32) * in_scale
+        if min_calib_range is not None:
+            nlo, nhi = jnp.float32(min_calib_range), \
+                jnp.float32(max_calib_range)
+        else:
+            nlo, nhi = jnp.min(x), jnp.max(x)
+        q2, scale = _q(x, nlo, nhi)
+        amax = INT8_MAX / scale
+        return q2, -amax, amax
+
+    return apply_op(pure, *_as_nd(data, min_range, max_range),
+                    name="requantize")
+
+
+def _as_nd(*vals):
+    out = []
+    for v in vals:
+        out.append(v if isinstance(v, NDArray) else NDArray(jnp.asarray(v)))
+    return out
+
+
+# ---------------------------------------------------------------------------
+# KL / entropy calibration (reference: calibrate.cc — the same algorithm
+# popularized by TensorRT: pick the clip threshold minimizing KL divergence
+# between the original distribution and its quantized projection)
+# ---------------------------------------------------------------------------
+
+def optimal_threshold(arr, num_bins=2048, num_quantized_bins=128):
+    """KL-optimal |threshold| for symmetric int8 quantization.
+
+    One-sided |x| histogram; for each candidate clip point, the reference
+    distribution p folds clipped outlier mass into its edge bin while the
+    candidate q is built from the *unclipped* bins only — so over-clipping
+    shows up as divergence at the edge (the calibrate.cc / TensorRT
+    formulation)."""
+    arr = _np.abs(_np.asarray(arr).ravel())
+    amax = float(arr.max()) if arr.size else 0.0
+    if amax == 0:
+        return 1e-8
+    if arr.size < 4 * num_quantized_bins:
+        # too few samples for a meaningful histogram — KL on a sparse
+        # histogram picks arbitrary clip points; use max (naive) instead
+        return amax
+    hist, edges = _np.histogram(arr, bins=num_bins, range=(0.0, amax))
+    hist = hist.astype(_np.float64)
+    width = edges[1] - edges[0]
+    best_kl, best_t = _np.inf, amax
+    eps = 1e-10
+    for i in range(num_quantized_bins, num_bins + 1):
+        p = hist[:i].copy()
+        p[i - 1] += hist[i:].sum()        # clipped mass -> edge bin
+        psum = p.sum()
+        if psum == 0:
+            continue
+        ref = hist[:i]                    # q comes from unclipped counts
+        num_merged = i // num_quantized_bins
+        q = _np.zeros(i)
+        for j in range(num_quantized_bins):
+            start = j * num_merged
+            stop = i if j == num_quantized_bins - 1 else start + num_merged
+            chunk = ref[start:stop]
+            nz = int((chunk > 0).sum())
+            if nz:
+                q[start:stop][chunk > 0] = chunk.sum() / nz
+        qsum = q.sum()
+        if qsum == 0:
+            continue
+        pn = p / psum
+        qn = q / qsum
+        mask = pn > 0
+        kl = float((pn[mask] * _np.log(
+            pn[mask] / _np.maximum(qn[mask], eps))).sum())
+        if kl < best_kl:
+            best_kl = kl
+            best_t = (i + 0.5) * width
+    return min(best_t, amax)
+
+
+class _LayerCollector:
+    """Collects per-layer output ranges during calibration forward passes
+    (reference: calibration collector in quantization.py)."""
+
+    def __init__(self, mode):
+        self.mode = mode
+        self.samples = {}   # layer id -> list of np arrays (entropy)
+        self.ranges = {}    # layer id -> (lo, hi)
+
+    def collect(self, key, arr):
+        a = _np.asarray(arr)
+        if self.mode == "entropy":
+            self.samples.setdefault(key, []).append(a.ravel())
+        lo, hi = float(a.min()), float(a.max())
+        if key in self.ranges:
+            plo, phi = self.ranges[key]
+            lo, hi = min(lo, plo), max(hi, phi)
+        self.ranges[key] = (lo, hi)
+
+    def threshold(self, key):
+        if self.mode == "entropy" and key in self.samples:
+            t = optimal_threshold(_np.concatenate(self.samples[key]))
+            return (-t, t)
+        lo, hi = self.ranges[key]
+        t = max(abs(lo), abs(hi))
+        return (-t, t)
+
+
+# ---------------------------------------------------------------------------
+# quantized layers (reference: quantized_fully_connected.cc,
+# quantized_conv.cc — int8 gemm/conv with int32 accumulation)
+# ---------------------------------------------------------------------------
+
+def _quantize_weight_per_channel(w):
+    """Per-output-channel symmetric int8 weights (the higher-accuracy
+    channel-wise mode of the reference)."""
+    axis = tuple(range(1, w.ndim))
+    amax = _np.maximum(_np.abs(_np.asarray(w)).max(axis=axis), 1e-20)
+    scale = INT8_MAX / amax
+    wq = _np.clip(_np.round(_np.asarray(w) * scale.reshape(
+        (-1,) + (1,) * (w.ndim - 1))), -127, 127).astype(_np.int8)
+    return wq, scale.astype(_np.float32)
+
+
+class QuantizedDense(HybridBlock):
+    """int8 x int8 -> int32 matmul + fp32 rescale (MXU int8 path;
+    reference: quantized_fully_connected.cc)."""
+
+    def __init__(self, dense, out_range=None):
+        super().__init__()
+        w = _np.asarray(dense.weight.data().asnumpy())
+        self._wq, self._wscale = _quantize_weight_per_channel(w)
+        self._bias = None if dense.bias is None else \
+            _np.asarray(dense.bias.data().asnumpy())
+        self._activation = getattr(dense, "_activation", None)
+        self._out_range = out_range
+        self._flatten = getattr(dense, "_flatten", True)
+
+    def forward(self, x):
+        wq = jnp.asarray(self._wq)
+        wscale = jnp.asarray(self._wscale)
+        bias = None if self._bias is None else jnp.asarray(self._bias)
+        act = self._activation
+        flatten = self._flatten
+        # activation quantized with the calibrated range when available,
+        # dynamic min/max otherwise (reference: calib vs online mode)
+        rng = self._out_range
+
+        def pure(xd):
+            if flatten and xd.ndim > 2:
+                xd = xd.reshape(xd.shape[0], -1)
+            if rng is not None:
+                lo, hi = jnp.float32(rng[0]), jnp.float32(rng[1])
+                xd = jnp.clip(xd, lo, hi)
+            else:
+                lo, hi = jnp.min(xd), jnp.max(xd)
+            xq, xscale = _q(xd, lo, hi)
+            acc = jax.lax.dot_general(
+                xq, wq.T, (((xq.ndim - 1,), (0,)), ((), ())),
+                preferred_element_type=jnp.int32)
+            y = acc.astype(jnp.float32) / (xscale * wscale[None, :])
+            if bias is not None:
+                y = y + bias
+            if act is not None:
+                from ..ops import nn as _nnops
+
+                y = _nnops.activation(y, act)
+            return y
+
+        return apply_op(pure, *_as_nd(x), name="quantized_dense")
+
+
+class QuantizedConv2D(HybridBlock):
+    """int8 conv with int32 accumulation (reference: quantized_conv.cc)."""
+
+    def __init__(self, conv, out_range=None):
+        super().__init__()
+        w = _np.asarray(conv.weight.data().asnumpy())
+        self._wq, self._wscale = _quantize_weight_per_channel(w)
+        self._bias = None if conv.bias is None else \
+            _np.asarray(conv.bias.data().asnumpy())
+        self._strides = tuple(conv._strides)
+        self._padding = tuple(conv._padding)
+        self._dilation = tuple(conv._dilation)
+        self._groups = conv._groups
+        self._activation = getattr(conv, "_activation", None)
+        self._out_range = out_range
+
+    def forward(self, x):
+        wq_j = jnp.asarray(self._wq)
+        ws_j = jnp.asarray(self._wscale)
+        b_j = None if self._bias is None else jnp.asarray(self._bias)
+        strides, padding = self._strides, self._padding
+        dilation, groups = self._dilation, self._groups
+        act = self._activation
+        rng = self._out_range
+
+        def pure(xd):
+            if rng is not None:
+                lo, hi = jnp.float32(rng[0]), jnp.float32(rng[1])
+                xd = jnp.clip(xd, lo, hi)
+            else:
+                lo, hi = jnp.min(xd), jnp.max(xd)
+            xq, xscale = _q(xd, lo, hi)
+            dims = jax.lax.conv_dimension_numbers(
+                xq.shape, wq_j.shape, ("NCHW", "OIHW", "NCHW"))
+            acc = jax.lax.conv_general_dilated(
+                xq, wq_j, window_strides=strides,
+                padding=[(p, p) for p in padding],
+                rhs_dilation=dilation,
+                dimension_numbers=dims,
+                feature_group_count=groups,
+                preferred_element_type=jnp.int32)
+            y = acc.astype(jnp.float32) / (
+                xscale * ws_j[None, :, None, None])
+            if b_j is not None:
+                y = y + b_j[None, :, None, None]
+            if act is not None:
+                from ..ops import nn as _nnops
+
+                y = _nnops.activation(y, act)
+            return y
+
+        return apply_op(pure, *_as_nd(x), name="quantized_conv")
+
+
+# ---------------------------------------------------------------------------
+# model conversion (reference: quantize_net / quantize_model)
+# ---------------------------------------------------------------------------
+
+def quantize_net(network, calib_data=None, calib_mode="naive",
+                 quantized_dtype="int8", exclude_layers=None,
+                 num_calib_batches=None, **kwargs):  # noqa: ARG001
+    """Post-training quantization of a HybridBlock (reference:
+    contrib.quantization.quantize_net).
+
+    Runs calibration batches through the fp32 net while collecting each
+    Dense/Conv2D output distribution, computes thresholds ('naive' min/max
+    or 'entropy' KL), then swaps those children for int8 blocks. Returns
+    the modified network (in place, like the reference returns a new
+    symbol-block — here module surgery is the graph pass).
+    """
+    if quantized_dtype != "int8":
+        raise ValueError("TPU build supports int8")
+    exclude = set(exclude_layers or ())
+
+    # find quantizable leaves
+    targets = []  # (parent, attr_name, child)
+
+    def walk(block, prefix):
+        for name, child in list(block._children.items()):
+            full = f"{prefix}.{name}" if prefix else name
+            if isinstance(child, (_gnn.Dense, _gnn.Conv2D)) \
+                    and full not in exclude and name not in exclude:
+                targets.append((block, name, full, child))
+            else:
+                walk(child, full)
+
+    walk(network, "")
+
+    collector = _LayerCollector(calib_mode)
+    if calib_data is not None and calib_mode != "none":
+        # calibration must run eagerly so hooks see concrete arrays
+        was_active = getattr(network, "_active", False)
+        if was_active:
+            network.hybridize(False)
+        hooks = []
+        for _, _, full, child in targets:
+            def mk(key):
+                def hook(blk, inputs, out):  # noqa: ARG001
+                    # calibrate the layer INPUT distribution — that's what
+                    # gets quantized to int8 (the reference inserts its
+                    # quantize node on the input edge)
+                    x = inputs[0] if isinstance(inputs, (list, tuple)) \
+                        else inputs
+                    collector.collect(key, x.asnumpy())
+                return hook
+
+            child.register_forward_hook(mk(full))
+            hooks.append(child)
+        n = 0
+        for batch in calib_data:
+            x = batch[0] if isinstance(batch, (list, tuple)) else batch
+            if not isinstance(x, NDArray):
+                x = NDArray(jnp.asarray(_np.asarray(x)))
+            network(x)
+            n += 1
+            if num_calib_batches and n >= num_calib_batches:
+                break
+        for child in hooks:
+            child._fwd_hooks.clear()
+        if was_active:
+            network.hybridize(True)
+
+    for parent, name, full, child in targets:
+        rng = collector.threshold(full) if collector.ranges.get(full) \
+            else None
+        if isinstance(child, _gnn.Dense):
+            q = QuantizedDense(child, rng)
+        else:
+            q = QuantizedConv2D(child, rng)
+        parent._children[name] = q
+        object.__setattr__(parent, name, q)
+    network._clear_cached()
+    return network

@@ -341,18 +341,17 @@ class HybridBlock(Block):
         super()._clear_cached()
 
     def __call__(self, *args, **kwargs):
-        if not kwargs and args and all(
-                isinstance(a, NDArray) for a in args) and not any(
-                isinstance(a._data, jax.core.Tracer) for a in args):
+        concrete_tensors = (
+            not kwargs and bool(args)
+            and all(isinstance(a, NDArray) for a in args)
+            and not any(isinstance(a._data, jax.core.Tracer) for a in args))
+        if concrete_tensors:
             # remember input signature for export() (reference: CachedOp
             # remembers bound shapes via SetForwardGraph)
             object.__setattr__(
                 self, "_last_input_specs",
                 [(tuple(a.shape), a.dtype) for a in args])
-        if self._active and not kwargs:
-            tensor_args = all(isinstance(a, NDArray) for a in args)
-            if tensor_args and not any(
-                    isinstance(a._data, jax.core.Tracer) for a in args):
+            if self._active:
                 return self._call_cached(*args)
         out = self.forward(*args, **kwargs)
         for hook in getattr(self, "_fwd_hooks", ()):
@@ -643,11 +642,17 @@ class SymbolBlock(HybridBlock):
             return out
         if self._symbol is None:
             raise RuntimeError("empty SymbolBlock")
+        # lower + jit once (Executor does the same); retraces only on
+        # shape/dtype change via jit's cache
+        jitted = getattr(self, "_sym_jit", None)
+        if jitted is None:
+            jitted = jax.jit(self._symbol._lower())
+            object.__setattr__(self, "_sym_jit", jitted)
         feed = {}
         for n, a in zip(self._input_names, args):
             feed[n] = a._data if isinstance(a, NDArray) else jnp.asarray(a)
         for n, p in self._arg_params.items():
             feed[n] = p.data()._data
-        outs = self._symbol._lower()(feed)
+        outs = jitted(feed)
         outs = [NDArray(o) for o in outs]
         return outs[0] if len(outs) == 1 else outs

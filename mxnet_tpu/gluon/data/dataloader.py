@@ -79,7 +79,12 @@ class DataLoader:
                     indices = batches[submitted]
                     pipe.submit(lambda ix=indices: self._make_batch(ix))
                     submitted += 1
-                yield pipe.pop(timeout=self._timeout)
+                try:
+                    yield pipe.pop(timeout=self._timeout)
+                except TimeoutError:
+                    # a hung worker can't be joined — abandon, not close
+                    pipe.abandon()
+                    raise
                 popped += 1
         finally:
             pipe.close()

@@ -230,13 +230,12 @@ class ImageRecordIter(DataIter):
         (C,H,W) — the iter_image_recordio_2.cc decode-resize stage."""
         c, h, w = self._shape
         if img.shape[2] != c:
-            if img.shape[2] > c:        # e.g. RGBA -> RGB: drop extras
-                img = img[:, :, :c]
-            elif c > 1:                  # gray -> RGB: replicate
-                img = img.repeat(c, axis=2) if img.shape[2] == 1 \
-                    else img[:, :, :1].repeat(c, axis=2)
-            else:                        # color -> gray
+            if c == 1:                   # color -> gray: luminance mean
                 img = img.mean(axis=2, keepdims=True)
+            elif img.shape[2] == 1:      # gray -> color: replicate
+                img = img.repeat(c, axis=2)
+            else:                        # e.g. RGBA -> RGB: drop extras
+                img = img[:, :, :c]
         if img.shape[:2] != (h, w):
             ri = (_np.arange(h) * img.shape[0] // h)
             ci = (_np.arange(w) * img.shape[1] // w)

@@ -145,9 +145,17 @@ def _slice_axis_impl(ins, a):
 
 
 slice_axis = _reg("slice_axis")(_slice_axis_impl)
-split = _reg("split")(
-    lambda ins, a: tuple(jnp.split(ins[0], a["num_outputs"],
-                                   axis=a.get("axis", 1))))
+
+register_sym_op("split",
+                lambda ins, a: tuple(jnp.split(ins[0], a["num_outputs"],
+                                               axis=a.get("axis", 1))))
+
+
+def split(data, num_outputs, axis=1, name=None, **kw):  # noqa: ARG001
+    """Multi-output split — the Symbol carries nout=num_outputs so
+    indexing/list_outputs see every piece."""
+    return Symbol.create("split", data, name=name, nout=num_outputs,
+                         num_outputs=num_outputs, axis=axis)
 
 
 def Concat(*inputs, dim=1, name=None, **kw):  # noqa: ARG001

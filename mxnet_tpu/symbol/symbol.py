@@ -112,7 +112,6 @@ class Symbol:
         seen, order = set(), []
 
         def visit(s):
-            key = (id(s._op), s._name, id(tuple(s._inputs)))  # noqa: F841
             if id(s) in seen:
                 return
             seen.add(id(s))

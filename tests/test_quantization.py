@@ -1,0 +1,115 @@
+"""INT8 quantization tests (reference model:
+tests/python/quantization/test_quantization.py — quantize/dequantize
+numerics, calibration, quantized net accuracy vs fp32)."""
+import numpy as onp
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import gluon, np
+from mxnet_tpu.contrib import quantization as qz
+
+
+class TestQuantizeOps:
+    def test_quantize_dequantize_roundtrip(self):
+        x = onp.linspace(-3, 5, 64).astype("float32").reshape(8, 8)
+        qd, lo, hi = qz.quantize(np.array(x), np.array(-3.0), np.array(5.0))
+        assert qd.asnumpy().dtype == onp.int8
+        back = qz.dequantize(qd, lo, hi)
+        # int8 symmetric: max error = scale/2 = amax/127/2
+        assert onp.abs(back.asnumpy() - x).max() <= 5.0 / 127
+        assert qd.asnumpy().max() == 127
+
+    def test_quantize_v2_dynamic_range(self):
+        x = onp.array([[-1.0, 0.5, 2.0]], dtype="float32")
+        qd, lo, hi = qz.quantize_v2(np.array(x))
+        assert float(hi.asnumpy()) == pytest.approx(2.0, rel=1e-5)
+        back = qz.dequantize(qd, lo, hi).asnumpy()
+        assert onp.abs(back - x).max() <= 2.0 / 127
+
+    def test_quantize_v2_calibrated(self):
+        x = onp.array([[-10.0, 0.5, 1.0]], dtype="float32")
+        qd, lo, hi = qz.quantize_v2(np.array(x), min_calib_range=-1.0,
+                                    max_calib_range=1.0)
+        # -10 clips to -127
+        assert qd.asnumpy()[0, 0] == -127
+
+    def test_requantize(self):
+        acc = onp.array([1 << 20, -(1 << 21)], dtype="int32")
+        q2, lo, hi = qz.requantize(np.array(acc), np.array(-100.0),
+                                   np.array(100.0))
+        assert q2.asnumpy().dtype == onp.int8
+
+    def test_optimal_threshold_clips_outliers(self):
+        rs = onp.random.RandomState(0)
+        arr = onp.concatenate([rs.normal(0, 1, 100000),
+                               [50.0]])  # one huge outlier
+        t = qz.optimal_threshold(arr)
+        assert t < 25.0  # KL threshold ignores the outlier
+        assert t > 1.0
+
+
+class TestQuantizeNet:
+    def _net(self):
+        mx.seed(0)
+        net = gluon.nn.HybridSequential()
+        net.add(gluon.nn.Conv2D(8, 3, padding=1, activation="relu"),
+                gluon.nn.GlobalAvgPool2D(),
+                gluon.nn.Flatten(),
+                gluon.nn.Dense(32, activation="relu"),
+                gluon.nn.Dense(10))
+        net.initialize()
+        return net
+
+    @pytest.mark.parametrize("calib_mode", ["naive", "entropy"])
+    def test_quantized_net_close_to_fp32(self, calib_mode):
+        net = self._net()
+        x = np.random.uniform(low=-1, high=1, size=(4, 3, 8, 8))
+        ref = net(x).asnumpy()
+        calib = [x]
+        qnet = qz.quantize_net(net, calib_data=calib, calib_mode=calib_mode)
+        out = qnet(x).asnumpy()
+        rel = onp.abs(out - ref).max() / (onp.abs(ref).max() + 1e-9)
+        if calib_mode == "naive":
+            # top-1 agreement (the reference's accuracy-parity criterion);
+            # entropy mode clips harder and random-init logits are near
+            # ties, so only the naive mode asserts argmax
+            assert (ref.argmax(1) == out.argmax(1)).all()
+            assert rel < 0.12, rel
+        else:
+            assert rel < 0.3, rel
+
+    def test_children_swapped(self):
+        net = self._net()
+        x = np.random.uniform(size=(2, 3, 8, 8))
+        net(x)
+        qz.quantize_net(net, calib_data=[x])
+        kinds = [type(c).__name__ for c in net._children.values()]
+        assert "QuantizedConv2D" in kinds
+        assert "QuantizedDense" in kinds
+        assert "Conv2D" not in kinds and "Dense" not in kinds
+
+    def test_exclude_layers(self):
+        net = self._net()
+        x = np.random.uniform(size=(2, 3, 8, 8))
+        net(x)
+        qz.quantize_net(net, calib_data=[x], exclude_layers=["4"])
+        assert type(net._children["4"]).__name__ == "Dense"
+
+    def test_quantized_net_hybridizes(self):
+        net = self._net()
+        x = np.random.uniform(size=(2, 3, 8, 8))
+        net(x)
+        qnet = qz.quantize_net(net, calib_data=[x])
+        qnet.hybridize()
+        y1 = qnet(x).asnumpy()
+        y2 = qnet(x).asnumpy()
+        onp.testing.assert_allclose(y1, y2, rtol=1e-6)
+
+    def test_int8_weights_stored(self):
+        net = self._net()
+        x = np.random.uniform(size=(2, 3, 8, 8))
+        net(x)
+        qz.quantize_net(net, calib_data=[x])
+        qd = net._children["3"]
+        assert qd._wq.dtype == onp.int8
+        assert qd._wscale.shape == (32,)  # per-channel scales

@@ -155,3 +155,11 @@ class TestSymbolBlock:
             x=np.array(xa), w=np.array(wa), b=np.array(ba))
         onp.testing.assert_allclose(imperative.asnumpy(),
                                     symbolic.asnumpy(), rtol=1e-6)
+
+
+class TestSymbolMultiOutput:
+    def test_split_indexing(self):
+        s = sym.split(sym.var("x"), num_outputs=2, axis=1)
+        assert len(s.list_outputs()) == 2
+        (o,) = (s[0] + s[1]).eval(x=np.array([[1.0, 2.0, 3.0, 4.0]]))
+        onp.testing.assert_allclose(o.asnumpy(), [[4.0, 6.0]])
