@@ -1,0 +1,20 @@
+"""mx.gluon.probability — distributions, transformations, stochastic blocks.
+
+Reference surface: python/mxnet/gluon/probability/ (distributions/,
+transformation/, block/). TPU re-design: all densities/samplers are pure
+jax.numpy + jax.random (XLA-fused, reparameterized where the reference is),
+with the framework's stateful-RNG facade supplying PRNG keys.
+"""
+from . import constraint  # noqa: F401
+from .constraint import *  # noqa: F401,F403
+from .continuous import *  # noqa: F401,F403
+from .discrete import *  # noqa: F401,F403
+from .distribution import Distribution, ExponentialFamily  # noqa: F401
+from .divergence import empirical_kl, kl_divergence, register_kl  # noqa: F401
+from .multivariate import *  # noqa: F401,F403
+from .stochastic_block import StochasticBlock, StochasticSequential  # noqa: F401
+from .transformation import *  # noqa: F401,F403
+from .transformed_distribution import (  # noqa: F401
+    Independent,
+    TransformedDistribution,
+)

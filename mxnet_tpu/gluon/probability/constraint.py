@@ -1,0 +1,183 @@
+"""Parameter/support constraints for distributions.
+
+Reference surface: python/mxnet/gluon/probability/distributions/constraint.py
+(Constraint.check raising on violation, interval/integer/simplex/cholesky
+variants). TPU note: `check` runs eagerly via a host sync — it is a
+validation aid, not a jit-path citizen; under tracing it becomes a no-op
+pass-through, matching how validate_args is meant for debugging.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from .utils import as_jax
+
+__all__ = [
+    "Constraint", "Real", "Boolean", "Interval", "OpenInterval",
+    "HalfOpenInterval", "IntegerInterval", "IntegerGreaterThan",
+    "IntegerGreaterThanEq", "GreaterThan", "GreaterThanEq", "LessThan",
+    "LessThanEq", "Positive", "NonNegative", "PositiveInteger",
+    "NonNegativeInteger", "UnitInterval", "Simplex", "LowerCholesky",
+    "PositiveDefinite", "dependent", "is_dependent",
+]
+
+
+def _eager(x):
+    """True when x is a concrete (non-traced) value we can validate."""
+    return not isinstance(x, jax.core.Tracer)
+
+
+class Constraint:
+    """Base class: `check(value)` returns value, raises ValueError on violation."""
+
+    def _cond(self, value):  # noqa: ARG002
+        raise NotImplementedError
+
+    def check(self, value):
+        data = jnp.asarray(as_jax(value))
+        if _eager(data):
+            ok = bool(jnp.all(self._cond(data)))
+            if not ok:
+                raise ValueError(
+                    f"Constraint violated: expected {type(self).__name__}")
+        return value
+
+    def __repr__(self):
+        return type(self).__name__
+
+
+class _Dependent(Constraint):
+    """Placeholder for constraints depending on other parameters
+    (e.g. Uniform.low < value < Uniform.high)."""
+
+    def check(self, value):
+        raise ValueError("Cannot determine validity of dependent constraint")
+
+
+dependent = _Dependent()
+
+
+def is_dependent(constraint):
+    return isinstance(constraint, _Dependent)
+
+
+class Real(Constraint):
+    def _cond(self, v):
+        return v == v  # not NaN
+
+
+class Boolean(Constraint):
+    def _cond(self, v):
+        return (v == 0) | (v == 1)
+
+
+class Interval(Constraint):
+    def __init__(self, lower, upper):
+        self.lower = lower
+        self.upper = upper
+
+    def _cond(self, v):
+        return (v >= self.lower) & (v <= self.upper)
+
+
+class OpenInterval(Interval):
+    def _cond(self, v):
+        return (v > self.lower) & (v < self.upper)
+
+
+class HalfOpenInterval(Interval):
+    def _cond(self, v):
+        return (v >= self.lower) & (v < self.upper)
+
+
+class UnitInterval(Interval):
+    def __init__(self):
+        super().__init__(0.0, 1.0)
+
+
+class GreaterThan(Constraint):
+    def __init__(self, lower):
+        self.lower = lower
+
+    def _cond(self, v):
+        return v > self.lower
+
+
+class GreaterThanEq(GreaterThan):
+    def _cond(self, v):
+        return v >= self.lower
+
+
+class LessThan(Constraint):
+    def __init__(self, upper):
+        self.upper = upper
+
+    def _cond(self, v):
+        return v < self.upper
+
+
+class LessThanEq(LessThan):
+    def _cond(self, v):
+        return v <= self.upper
+
+
+class Positive(GreaterThan):
+    def __init__(self):
+        super().__init__(0.0)
+
+
+class NonNegative(GreaterThanEq):
+    def __init__(self):
+        super().__init__(0.0)
+
+
+class _IntegerMixin:
+    def _int_cond(self, v):
+        return v == jnp.floor(v)
+
+
+class IntegerInterval(Interval, _IntegerMixin):
+    def _cond(self, v):
+        return super()._cond(v) & self._int_cond(v)
+
+
+class IntegerGreaterThan(GreaterThan, _IntegerMixin):
+    def _cond(self, v):
+        return super()._cond(v) & self._int_cond(v)
+
+
+class IntegerGreaterThanEq(GreaterThanEq, _IntegerMixin):
+    def _cond(self, v):
+        return super()._cond(v) & self._int_cond(v)
+
+
+class PositiveInteger(IntegerGreaterThan):
+    def __init__(self):
+        super().__init__(0)
+
+
+class NonNegativeInteger(IntegerGreaterThanEq):
+    def __init__(self):
+        super().__init__(0)
+
+
+class Simplex(Constraint):
+    def _cond(self, v):
+        return jnp.all(v >= 0, axis=-1) & (
+            jnp.abs(jnp.sum(v, axis=-1) - 1.0) < 1e-6)
+
+
+class LowerCholesky(Constraint):
+    def _cond(self, v):
+        tril = jnp.all(jnp.triu(v, k=1) == 0, axis=(-2, -1))
+        pos_diag = jnp.all(jnp.diagonal(v, axis1=-2, axis2=-1) > 0, axis=-1)
+        return tril & pos_diag
+
+
+class PositiveDefinite(Constraint):
+    def _cond(self, v):
+        sym = jnp.all(jnp.abs(v - jnp.swapaxes(v, -1, -2)) < 1e-6,
+                      axis=(-2, -1))
+        pos = jnp.linalg.eigvalsh(v)[..., 0] > 0
+        return sym & pos

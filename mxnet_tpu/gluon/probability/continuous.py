@@ -1,0 +1,746 @@
+"""Continuous univariate distributions.
+
+Reference surface (one file per distribution under
+python/mxnet/gluon/probability/distributions/): normal.py, laplace.py,
+cauchy.py, half_cauchy.py, half_normal.py, uniform.py, exponential.py,
+gamma.py, beta.py, chi2.py, fishersnedecor.py, studentT.py, gumbel.py,
+weibull.py, pareto.py. Parameterizations match the reference (e.g.
+Gamma(shape, scale), Weibull(concentration, scale), Pareto(alpha, scale),
+Exponential(scale)).
+
+TPU re-design: samplers use jax.random primitives (threefry counters, no
+per-device mutable RNG state); reparameterized (pathwise-grad) samplers are
+flagged has_grad=True.
+"""
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+from jax.scipy import special as jsp
+
+from . import constraint as C
+from .distribution import Distribution, ExponentialFamily
+from .utils import as_jax, wrap
+
+__all__ = ["Normal", "Laplace", "Cauchy", "HalfCauchy", "HalfNormal",
+           "Uniform", "Exponential", "Gamma", "Beta", "Chi2",
+           "FisherSnedecor", "StudentT", "Gumbel", "Weibull", "Pareto"]
+
+
+class _LocScale(Distribution):
+    """Shared machinery for two-parameter families broadcast to one batch
+    shape."""
+
+    _params = ("loc", "scale")
+
+    def __init__(self, p0, p1, validate_args=None):
+        a = jnp.asarray(as_jax(p0), jnp.float32)
+        b = jnp.asarray(as_jax(p1), jnp.float32)
+        shape = jnp.broadcast_shapes(a.shape, b.shape)
+        setattr(self, self._params[0], jnp.broadcast_to(a, shape))
+        setattr(self, self._params[1], jnp.broadcast_to(b, shape))
+        super().__init__(event_dim=0, validate_args=validate_args)
+
+    def _batch_shape(self):
+        return getattr(self, self._params[0]).shape
+
+    def _extended(self, size):
+        size = self._size(size)
+        return self._batch_shape() if size is None else size
+
+    def broadcast_to(self, batch_shape):
+        new = self.__new__(type(self))
+        batch_shape = tuple(batch_shape)
+        for p in self._params:
+            setattr(new, p, jnp.broadcast_to(getattr(self, p), batch_shape))
+        new.event_dim = self.event_dim
+        new._validate_args = self._validate_args
+        return new
+
+
+class Normal(_LocScale, ExponentialFamily):
+    r"""Gaussian with mean `loc`, standard deviation `scale`."""
+
+    has_grad = True
+    support = C.Real()
+    arg_constraints = {"loc": C.Real(), "scale": C.Positive()}
+
+    def __init__(self, loc=0.0, scale=1.0, validate_args=None):
+        super().__init__(loc, scale, validate_args)
+
+    def log_prob(self, value):
+        if self._validate_args:
+            self._validate_samples(value)
+        v = jnp.asarray(as_jax(value))
+        var = self.scale ** 2
+        return wrap(-((v - self.loc) ** 2) / (2 * var)
+                    - jnp.log(self.scale) - 0.5 * math.log(2 * math.pi))
+
+    def sample(self, size=None):
+        shape = self._extended(size)
+        eps = jax.random.normal(self._key(), shape)
+        return wrap(self.loc + eps * self.scale)
+
+    def cdf(self, value):
+        v = jnp.asarray(as_jax(value))
+        return wrap(0.5 * (1 + jsp.erf((v - self.loc)
+                                       / (self.scale * math.sqrt(2)))))
+
+    def icdf(self, value):
+        v = jnp.asarray(as_jax(value))
+        return wrap(self.loc + self.scale * jsp.ndtri(v))
+
+    @property
+    def mean(self):
+        return wrap(self.loc)
+
+    @property
+    def variance(self):
+        return wrap(self.scale ** 2)
+
+    def entropy(self):
+        return wrap(0.5 + 0.5 * math.log(2 * math.pi)
+                    + jnp.log(self.scale))
+
+    @property
+    def _natural_params(self):
+        return (self.loc / self.scale ** 2, -0.5 / self.scale ** 2)
+
+    def _log_normalizer(self, x, y):
+        return -0.25 * x ** 2 / y + 0.5 * jnp.log(-math.pi / y)
+
+    def _mean_carrier_measure(self):
+        return 0.0
+
+
+class Laplace(_LocScale):
+    has_grad = True
+    support = C.Real()
+    arg_constraints = {"loc": C.Real(), "scale": C.Positive()}
+
+    def __init__(self, loc=0.0, scale=1.0, validate_args=None):
+        super().__init__(loc, scale, validate_args)
+
+    def log_prob(self, value):
+        v = jnp.asarray(as_jax(value))
+        return wrap(-jnp.abs(v - self.loc) / self.scale
+                    - jnp.log(2 * self.scale))
+
+    def sample(self, size=None):
+        shape = self._extended(size)
+        u = jax.random.uniform(self._key(), shape, minval=-0.5 + 1e-7,
+                               maxval=0.5)
+        return wrap(self.loc - self.scale * jnp.sign(u)
+                    * jnp.log1p(-2 * jnp.abs(u)))
+
+    def cdf(self, value):
+        v = jnp.asarray(as_jax(value))
+        z = (v - self.loc) / self.scale
+        return wrap(0.5 - 0.5 * jnp.sign(z) * jnp.expm1(-jnp.abs(z)))
+
+    def icdf(self, value):
+        p = jnp.asarray(as_jax(value))
+        term = p - 0.5
+        return wrap(self.loc - self.scale * jnp.sign(term)
+                    * jnp.log1p(-2 * jnp.abs(term)))
+
+    @property
+    def mean(self):
+        return wrap(self.loc)
+
+    @property
+    def variance(self):
+        return wrap(2 * self.scale ** 2)
+
+    def entropy(self):
+        return wrap(1 + jnp.log(2 * self.scale))
+
+
+class Cauchy(_LocScale):
+    has_grad = True
+    support = C.Real()
+    arg_constraints = {"loc": C.Real(), "scale": C.Positive()}
+
+    def __init__(self, loc=0.0, scale=1.0, validate_args=None):
+        super().__init__(loc, scale, validate_args)
+
+    def log_prob(self, value):
+        v = jnp.asarray(as_jax(value))
+        return wrap(-math.log(math.pi) - jnp.log(self.scale)
+                    - jnp.log1p(((v - self.loc) / self.scale) ** 2))
+
+    def sample(self, size=None):
+        shape = self._extended(size)
+        u = jax.random.uniform(self._key(), shape, minval=1e-7,
+                               maxval=1.0 - 1e-7)
+        return wrap(self.loc + self.scale * jnp.tan(math.pi * (u - 0.5)))
+
+    def cdf(self, value):
+        v = jnp.asarray(as_jax(value))
+        return wrap(jnp.arctan((v - self.loc) / self.scale) / math.pi + 0.5)
+
+    def icdf(self, value):
+        p = jnp.asarray(as_jax(value))
+        return wrap(self.loc + self.scale * jnp.tan(math.pi * (p - 0.5)))
+
+    @property
+    def mean(self):
+        return wrap(jnp.full(self._batch_shape(), jnp.nan))
+
+    @property
+    def variance(self):
+        return wrap(jnp.full(self._batch_shape(), jnp.nan))
+
+    def entropy(self):
+        return wrap(math.log(4 * math.pi) + jnp.log(self.scale))
+
+
+class _HalfOf(Distribution):
+    """|X| for a symmetric zero-located base distribution."""
+
+    _base_cls = None
+    support = C.Positive()
+    arg_constraints = {"scale": C.Positive()}
+    has_grad = True
+
+    def __init__(self, scale=1.0, validate_args=None):
+        self.scale = jnp.asarray(as_jax(scale), jnp.float32)
+        self._base = self._base_cls(0.0, self.scale)
+        super().__init__(event_dim=0, validate_args=validate_args)
+
+    def _batch_shape(self):
+        return self._base._batch_shape()
+
+    def broadcast_to(self, batch_shape):
+        return type(self)(jnp.broadcast_to(self.scale, tuple(batch_shape)))
+
+    def sample(self, size=None):
+        return wrap(jnp.abs(as_jax(self._base.sample(size))))
+
+    def log_prob(self, value):
+        return wrap(as_jax(self._base.log_prob(value)) + math.log(2))
+
+    def cdf(self, value):
+        return wrap(2 * as_jax(self._base.cdf(value)) - 1)
+
+    def icdf(self, value):
+        p = jnp.asarray(as_jax(value))
+        return self._base.icdf((p + 1) / 2)
+
+
+class HalfCauchy(_HalfOf):
+    _base_cls = Cauchy
+
+    def entropy(self):
+        return wrap(as_jax(self._base.entropy()) - math.log(2))
+
+
+class HalfNormal(_HalfOf):
+    _base_cls = Normal
+
+    @property
+    def mean(self):
+        return wrap(self.scale * math.sqrt(2 / math.pi))
+
+    @property
+    def variance(self):
+        return wrap(self.scale ** 2 * (1 - 2 / math.pi))
+
+    def entropy(self):
+        return wrap(as_jax(self._base.entropy()) - math.log(2))
+
+
+class Uniform(_LocScale):
+    has_grad = True
+    _params = ("low", "high")
+    arg_constraints = {"low": C.dependent, "high": C.dependent}
+
+    def __init__(self, low=0.0, high=1.0, validate_args=None):
+        super().__init__(low, high, validate_args)
+
+    @property
+    def support(self):
+        return C.Interval(self.low, self.high)
+
+    def log_prob(self, value):
+        v = jnp.asarray(as_jax(value))
+        inside = (v >= self.low) & (v <= self.high)
+        lp = -jnp.log(self.high - self.low)
+        return wrap(jnp.where(inside, lp, -jnp.inf))
+
+    def sample(self, size=None):
+        shape = self._extended(size)
+        u = jax.random.uniform(self._key(), shape)
+        return wrap(self.low + u * (self.high - self.low))
+
+    def cdf(self, value):
+        v = jnp.asarray(as_jax(value))
+        return wrap(jnp.clip((v - self.low) / (self.high - self.low), 0, 1))
+
+    def icdf(self, value):
+        p = jnp.asarray(as_jax(value))
+        return wrap(self.low + p * (self.high - self.low))
+
+    @property
+    def mean(self):
+        return wrap((self.low + self.high) / 2)
+
+    @property
+    def variance(self):
+        return wrap((self.high - self.low) ** 2 / 12)
+
+    def entropy(self):
+        return wrap(jnp.log(self.high - self.low))
+
+
+class Exponential(ExponentialFamily):
+    r"""Exponential with **scale** parameter (mean), matching the reference
+    (distributions/exponential.py:43 `__init__(self, scale=1.0)`)."""
+
+    has_grad = True
+    support = C.Positive()
+    arg_constraints = {"scale": C.Positive()}
+
+    def __init__(self, scale=1.0, validate_args=None):
+        self.scale = jnp.asarray(as_jax(scale), jnp.float32)
+        super().__init__(event_dim=0, validate_args=validate_args)
+
+    def _batch_shape(self):
+        return self.scale.shape
+
+    def broadcast_to(self, batch_shape):
+        return Exponential(jnp.broadcast_to(self.scale, tuple(batch_shape)))
+
+    def log_prob(self, value):
+        v = jnp.asarray(as_jax(value))
+        return wrap(-v / self.scale - jnp.log(self.scale))
+
+    def sample(self, size=None):
+        size = self._size(size)
+        shape = self.scale.shape if size is None else size
+        e = jax.random.exponential(self._key(), shape)
+        return wrap(e * self.scale)
+
+    def sample_n(self, size):
+        n = self._size(size) or ()
+        return self.sample(tuple(n) + self.scale.shape)
+
+    def cdf(self, value):
+        v = jnp.asarray(as_jax(value))
+        return wrap(-jnp.expm1(-v / self.scale))
+
+    def icdf(self, value):
+        p = jnp.asarray(as_jax(value))
+        return wrap(-self.scale * jnp.log1p(-p))
+
+    @property
+    def mean(self):
+        return wrap(self.scale)
+
+    @property
+    def variance(self):
+        return wrap(self.scale ** 2)
+
+    def entropy(self):
+        return wrap(1 + jnp.log(self.scale))
+
+    @property
+    def _natural_params(self):
+        return (-1.0 / self.scale,)
+
+    def _log_normalizer(self, x):
+        return -jnp.log(-x)
+
+    def _mean_carrier_measure(self):
+        return 0.0
+
+
+class Gamma(Distribution):
+    r"""Gamma(shape=α, scale=θ) — reference parameterization
+    (distributions/gamma.py:48)."""
+
+    has_grad = True  # jax.random.gamma has implicit-reparam gradients
+    support = C.Positive()
+    arg_constraints = {"shape": C.Positive(), "scale": C.Positive()}
+
+    def __init__(self, shape, scale=1.0, validate_args=None):
+        a = jnp.asarray(as_jax(shape), jnp.float32)
+        s = jnp.asarray(as_jax(scale), jnp.float32)
+        bshape = jnp.broadcast_shapes(a.shape, s.shape)
+        self.shape = jnp.broadcast_to(a, bshape)
+        self.scale = jnp.broadcast_to(s, bshape)
+        super().__init__(event_dim=0, validate_args=validate_args)
+
+    def _batch_shape(self):
+        return self.shape.shape
+
+    def broadcast_to(self, batch_shape):
+        b = tuple(batch_shape)
+        return Gamma(jnp.broadcast_to(self.shape, b),
+                     jnp.broadcast_to(self.scale, b))
+
+    def log_prob(self, value):
+        v = jnp.asarray(as_jax(value))
+        a = self.shape
+        return wrap((a - 1) * jnp.log(v) - v / self.scale
+                    - jsp.gammaln(a) - a * jnp.log(self.scale))
+
+    def sample(self, size=None):
+        size = self._size(size)
+        shape = self._batch_shape() if size is None else size
+        g = jax.random.gamma(self._key(), self.shape, shape)
+        return wrap(g * self.scale)
+
+    @property
+    def mean(self):
+        return wrap(self.shape * self.scale)
+
+    @property
+    def variance(self):
+        return wrap(self.shape * self.scale ** 2)
+
+    def entropy(self):
+        a = self.shape
+        return wrap(a + jnp.log(self.scale) + jsp.gammaln(a)
+                    + (1 - a) * jsp.digamma(a))
+
+
+class Chi2(Gamma):
+    r"""Chi-squared(df) == Gamma(df/2, scale=2)."""
+
+    arg_constraints = {"df": C.Positive()}
+
+    def __init__(self, df, validate_args=None):
+        df = jnp.asarray(as_jax(df), jnp.float32)
+        super().__init__(df / 2, 2.0, validate_args)
+
+    @property
+    def df(self):
+        return wrap(self.shape * 2)
+
+    def broadcast_to(self, batch_shape):
+        return Chi2(jnp.broadcast_to(self.shape * 2, tuple(batch_shape)))
+
+
+class Beta(Distribution):
+    has_grad = True
+    support = C.UnitInterval()
+    arg_constraints = {"alpha": C.Positive(), "beta": C.Positive()}
+
+    def __init__(self, alpha, beta, validate_args=None):
+        a = jnp.asarray(as_jax(alpha), jnp.float32)
+        b = jnp.asarray(as_jax(beta), jnp.float32)
+        shape = jnp.broadcast_shapes(a.shape, b.shape)
+        self.alpha = jnp.broadcast_to(a, shape)
+        self.beta = jnp.broadcast_to(b, shape)
+        super().__init__(event_dim=0, validate_args=validate_args)
+
+    def _batch_shape(self):
+        return self.alpha.shape
+
+    def broadcast_to(self, batch_shape):
+        b = tuple(batch_shape)
+        return Beta(jnp.broadcast_to(self.alpha, b),
+                    jnp.broadcast_to(self.beta, b))
+
+    def log_prob(self, value):
+        v = jnp.asarray(as_jax(value))
+        return wrap(jsp.xlogy(self.alpha - 1, v)
+                    + jsp.xlogy(self.beta - 1, 1 - v)
+                    - jsp.betaln(self.alpha, self.beta))
+
+    def sample(self, size=None):
+        size = self._size(size)
+        shape = self._batch_shape() if size is None else size
+        return wrap(jax.random.beta(self._key(), self.alpha, self.beta,
+                                    shape))
+
+    @property
+    def mean(self):
+        return wrap(self.alpha / (self.alpha + self.beta))
+
+    @property
+    def variance(self):
+        t = self.alpha + self.beta
+        return wrap(self.alpha * self.beta / (t ** 2 * (t + 1)))
+
+    def entropy(self):
+        a, b = self.alpha, self.beta
+        return wrap(jsp.betaln(a, b) - (a - 1) * jsp.digamma(a)
+                    - (b - 1) * jsp.digamma(b)
+                    + (a + b - 2) * jsp.digamma(a + b))
+
+
+class FisherSnedecor(Distribution):
+    r"""F-distribution(df1, df2) — ratio of scaled chi-squares."""
+
+    support = C.Positive()
+    arg_constraints = {"df1": C.Positive(), "df2": C.Positive()}
+
+    def __init__(self, df1, df2, validate_args=None):
+        a = jnp.asarray(as_jax(df1), jnp.float32)
+        b = jnp.asarray(as_jax(df2), jnp.float32)
+        shape = jnp.broadcast_shapes(a.shape, b.shape)
+        self.df1 = jnp.broadcast_to(a, shape)
+        self.df2 = jnp.broadcast_to(b, shape)
+        super().__init__(event_dim=0, validate_args=validate_args)
+
+    def _batch_shape(self):
+        return self.df1.shape
+
+    def broadcast_to(self, batch_shape):
+        b = tuple(batch_shape)
+        return FisherSnedecor(jnp.broadcast_to(self.df1, b),
+                              jnp.broadcast_to(self.df2, b))
+
+    def sample(self, size=None):
+        size = self._size(size)
+        shape = self._batch_shape() if size is None else size
+        k1, k2 = jax.random.split(self._key())
+        g1 = jax.random.gamma(k1, self.df1 / 2, shape) / (self.df1 / 2)
+        g2 = jax.random.gamma(k2, self.df2 / 2, shape) / (self.df2 / 2)
+        return wrap(g1 / g2)
+
+    def log_prob(self, value):
+        v = jnp.asarray(as_jax(value))
+        d1, d2 = self.df1, self.df2
+        return wrap(0.5 * d1 * jnp.log(d1) + 0.5 * d2 * jnp.log(d2)
+                    + (0.5 * d1 - 1) * jnp.log(v)
+                    - 0.5 * (d1 + d2) * jnp.log(d2 + d1 * v)
+                    - jsp.betaln(0.5 * d1, 0.5 * d2))
+
+    @property
+    def mean(self):
+        m = self.df2 / (self.df2 - 2)
+        return wrap(jnp.where(self.df2 > 2, m, jnp.nan))
+
+    @property
+    def variance(self):
+        d1, d2 = self.df1, self.df2
+        v = 2 * d2 ** 2 * (d1 + d2 - 2) / (d1 * (d2 - 2) ** 2 * (d2 - 4))
+        return wrap(jnp.where(d2 > 4, v, jnp.nan))
+
+
+class StudentT(Distribution):
+    has_grad = True
+    support = C.Real()
+    arg_constraints = {"df": C.Positive(), "loc": C.Real(),
+                       "scale": C.Positive()}
+
+    def __init__(self, df, loc=0.0, scale=1.0, validate_args=None):
+        d = jnp.asarray(as_jax(df), jnp.float32)
+        l = jnp.asarray(as_jax(loc), jnp.float32)
+        s = jnp.asarray(as_jax(scale), jnp.float32)
+        shape = jnp.broadcast_shapes(d.shape, l.shape, s.shape)
+        self.df = jnp.broadcast_to(d, shape)
+        self.loc = jnp.broadcast_to(l, shape)
+        self.scale = jnp.broadcast_to(s, shape)
+        super().__init__(event_dim=0, validate_args=validate_args)
+
+    def _batch_shape(self):
+        return self.df.shape
+
+    def broadcast_to(self, batch_shape):
+        b = tuple(batch_shape)
+        return StudentT(jnp.broadcast_to(self.df, b),
+                        jnp.broadcast_to(self.loc, b),
+                        jnp.broadcast_to(self.scale, b))
+
+    def sample(self, size=None):
+        size = self._size(size)
+        shape = self._batch_shape() if size is None else size
+        t = jax.random.t(self._key(), self.df, shape)
+        return wrap(self.loc + self.scale * t)
+
+    def log_prob(self, value):
+        v = jnp.asarray(as_jax(value))
+        d = self.df
+        z = (v - self.loc) / self.scale
+        return wrap(jsp.gammaln((d + 1) / 2) - jsp.gammaln(d / 2)
+                    - 0.5 * jnp.log(d * math.pi) - jnp.log(self.scale)
+                    - (d + 1) / 2 * jnp.log1p(z ** 2 / d))
+
+    @property
+    def mean(self):
+        return wrap(jnp.where(self.df > 1, self.loc, jnp.nan))
+
+    @property
+    def variance(self):
+        d = self.df
+        v = self.scale ** 2 * d / (d - 2)
+        return wrap(jnp.where(d > 2, v,
+                              jnp.where(d > 1, jnp.inf, jnp.nan)))
+
+    def entropy(self):
+        d = self.df
+        return wrap((d + 1) / 2 * (jsp.digamma((d + 1) / 2)
+                                   - jsp.digamma(d / 2))
+                    + 0.5 * jnp.log(d) + jsp.betaln(d / 2, 0.5)
+                    + jnp.log(self.scale))
+
+
+_EULER = 0.57721566490153286060
+
+
+class Gumbel(_LocScale):
+    has_grad = True
+    support = C.Real()
+    arg_constraints = {"loc": C.Real(), "scale": C.Positive()}
+
+    def __init__(self, loc=0.0, scale=1.0, validate_args=None):
+        super().__init__(loc, scale, validate_args)
+
+    def log_prob(self, value):
+        v = jnp.asarray(as_jax(value))
+        z = (v - self.loc) / self.scale
+        return wrap(-(z + jnp.exp(-z)) - jnp.log(self.scale))
+
+    def sample(self, size=None):
+        shape = self._extended(size)
+        g = jax.random.gumbel(self._key(), shape)
+        return wrap(self.loc + self.scale * g)
+
+    def cdf(self, value):
+        v = jnp.asarray(as_jax(value))
+        return wrap(jnp.exp(-jnp.exp(-(v - self.loc) / self.scale)))
+
+    def icdf(self, value):
+        p = jnp.asarray(as_jax(value))
+        return wrap(self.loc - self.scale * jnp.log(-jnp.log(p)))
+
+    @property
+    def mean(self):
+        return wrap(self.loc + self.scale * _EULER)
+
+    @property
+    def variance(self):
+        return wrap(math.pi ** 2 / 6 * self.scale ** 2)
+
+    def entropy(self):
+        return wrap(jnp.log(self.scale) + 1 + _EULER)
+
+
+class Weibull(Distribution):
+    r"""Weibull(concentration=k, scale=λ) — reference parameterization
+    (distributions/weibull.py:49)."""
+
+    has_grad = True
+    support = C.Positive()
+    arg_constraints = {"concentration": C.Positive(), "scale": C.Positive()}
+
+    def __init__(self, concentration, scale=1.0, validate_args=None):
+        k = jnp.asarray(as_jax(concentration), jnp.float32)
+        s = jnp.asarray(as_jax(scale), jnp.float32)
+        shape = jnp.broadcast_shapes(k.shape, s.shape)
+        self.concentration = jnp.broadcast_to(k, shape)
+        self.scale = jnp.broadcast_to(s, shape)
+        super().__init__(event_dim=0, validate_args=validate_args)
+
+    def _batch_shape(self):
+        return self.concentration.shape
+
+    def broadcast_to(self, batch_shape):
+        b = tuple(batch_shape)
+        return Weibull(jnp.broadcast_to(self.concentration, b),
+                       jnp.broadcast_to(self.scale, b))
+
+    def log_prob(self, value):
+        v = jnp.asarray(as_jax(value))
+        k, s = self.concentration, self.scale
+        return wrap(jnp.log(k / s) + (k - 1) * jnp.log(v / s)
+                    - (v / s) ** k)
+
+    def sample(self, size=None):
+        size = self._size(size)
+        shape = self._batch_shape() if size is None else size
+        e = jax.random.exponential(self._key(), shape)
+        return wrap(self.scale * e ** (1 / self.concentration))
+
+    def cdf(self, value):
+        v = jnp.asarray(as_jax(value))
+        return wrap(-jnp.expm1(-(v / self.scale) ** self.concentration))
+
+    def icdf(self, value):
+        p = jnp.asarray(as_jax(value))
+        return wrap(self.scale
+                    * (-jnp.log1p(-p)) ** (1 / self.concentration))
+
+    @property
+    def mean(self):
+        k = self.concentration
+        return wrap(self.scale * jnp.exp(jsp.gammaln(1 + 1 / k)))
+
+    @property
+    def variance(self):
+        k = self.concentration
+        g1 = jnp.exp(jsp.gammaln(1 + 1 / k))
+        g2 = jnp.exp(jsp.gammaln(1 + 2 / k))
+        return wrap(self.scale ** 2 * (g2 - g1 ** 2))
+
+    def entropy(self):
+        k = self.concentration
+        return wrap(_EULER * (1 - 1 / k) + jnp.log(self.scale / k) + 1)
+
+
+class Pareto(Distribution):
+    r"""Pareto(alpha, scale) — reference parameterization
+    (distributions/pareto.py:47): support [scale, inf)."""
+
+    arg_constraints = {"alpha": C.Positive(), "scale": C.Positive()}
+
+    @property
+    def support(self):
+        return C.GreaterThanEq(self.scale)
+
+    def __init__(self, alpha, scale=1.0, validate_args=None):
+        a = jnp.asarray(as_jax(alpha), jnp.float32)
+        s = jnp.asarray(as_jax(scale), jnp.float32)
+        shape = jnp.broadcast_shapes(a.shape, s.shape)
+        self.alpha = jnp.broadcast_to(a, shape)
+        self.scale = jnp.broadcast_to(s, shape)
+        super().__init__(event_dim=0, validate_args=validate_args)
+
+    def _batch_shape(self):
+        return self.alpha.shape
+
+    def broadcast_to(self, batch_shape):
+        b = tuple(batch_shape)
+        return Pareto(jnp.broadcast_to(self.alpha, b),
+                      jnp.broadcast_to(self.scale, b))
+
+    def log_prob(self, value):
+        v = jnp.asarray(as_jax(value))
+        lp = (jnp.log(self.alpha) + self.alpha * jnp.log(self.scale)
+              - (self.alpha + 1) * jnp.log(v))
+        return wrap(jnp.where(v >= self.scale, lp, -jnp.inf))
+
+    def sample(self, size=None):
+        size = self._size(size)
+        shape = self._batch_shape() if size is None else size
+        e = jax.random.exponential(self._key(), shape)
+        return wrap(self.scale * jnp.exp(e / self.alpha))
+
+    def cdf(self, value):
+        v = jnp.asarray(as_jax(value))
+        return wrap(jnp.where(v >= self.scale,
+                              1 - (self.scale / v) ** self.alpha, 0.0))
+
+    def icdf(self, value):
+        p = jnp.asarray(as_jax(value))
+        return wrap(self.scale * (1 - p) ** (-1 / self.alpha))
+
+    @property
+    def mean(self):
+        m = self.alpha * self.scale / (self.alpha - 1)
+        return wrap(jnp.where(self.alpha > 1, m, jnp.inf))
+
+    @property
+    def variance(self):
+        a = self.alpha
+        v = self.scale ** 2 * a / ((a - 1) ** 2 * (a - 2))
+        return wrap(jnp.where(a > 2, v, jnp.inf))
+
+    def entropy(self):
+        return wrap(jnp.log(self.scale / self.alpha) + 1 + 1 / self.alpha)

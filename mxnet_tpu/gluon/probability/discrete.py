@@ -1,0 +1,562 @@
+"""Discrete distributions (plus continuous relaxations).
+
+Reference surface: distributions/{bernoulli,binomial,geometric,poisson,
+negative_binomial,categorical,one_hot_categorical,multinomial,
+relaxed_bernoulli,relaxed_one_hot_categorical}.py. Dual prob/logit
+parameterization preserved (exactly one must be given, as in e.g.
+bernoulli.py / categorical.py:47).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax.scipy import special as jsp
+
+from . import constraint as C
+from .distribution import Distribution, ExponentialFamily
+from .utils import as_jax, cached_property, prob2logit, wrap
+
+__all__ = ["Bernoulli", "Binomial", "Geometric", "Poisson",
+           "NegativeBinomial", "Categorical", "OneHotCategorical",
+           "Multinomial", "RelaxedBernoulli", "RelaxedOneHotCategorical"]
+
+
+class _ProbLogit(Distribution):
+    """Base handling the exactly-one-of(prob, logit) contract; the missing
+    parameterization is derived lazily (reference: utils.prob2logit)."""
+
+    _binary = True
+
+    def __init__(self, prob=None, logit=None, validate_args=None,
+                 event_dim=0):
+        if (prob is None) == (logit is None):
+            raise ValueError(
+                "Either `prob` or `logit` must be specified, but not both.")
+        if prob is not None:
+            self.prob = jnp.asarray(as_jax(prob), jnp.float32)
+        else:
+            self.logit = jnp.asarray(as_jax(logit), jnp.float32)
+        super().__init__(event_dim=event_dim, validate_args=validate_args)
+
+    @cached_property
+    def prob(self):
+        if self._binary:
+            return jax.nn.sigmoid(self.logit)
+        return jax.nn.softmax(self.logit, axis=-1)
+
+    @cached_property
+    def logit(self):
+        return prob2logit(self.prob, self._binary)
+
+    def _param_broadcast(self, batch_shape, cls, **extra):
+        new = self.__new__(cls)
+        if "prob" in self.__dict__:
+            new.prob = jnp.broadcast_to(self.prob, batch_shape)
+        else:
+            new.logit = jnp.broadcast_to(self.logit, batch_shape)
+        for k, v in extra.items():
+            setattr(new, k, v)
+        new.event_dim = self.event_dim
+        new._validate_args = self._validate_args
+        return new
+
+
+class Bernoulli(_ProbLogit, ExponentialFamily):
+    support = C.Boolean()
+    arg_constraints = {"prob": C.UnitInterval(), "logit": C.Real()}
+    has_enumerate_support = True
+
+    def _batch_shape(self):
+        return (self.prob if "prob" in self.__dict__ else self.logit).shape
+
+    def broadcast_to(self, batch_shape):
+        return self._param_broadcast(tuple(batch_shape), Bernoulli)
+
+    def log_prob(self, value):
+        if self._validate_args:
+            self._validate_samples(value)
+        v = jnp.asarray(as_jax(value))
+        # numerically stable BCE on logits
+        l = self.logit
+        return wrap(v * l - jnp.logaddexp(0.0, l))
+
+    def sample(self, size=None):
+        size = self._size(size)
+        shape = self._batch_shape() if size is None else size
+        return wrap(jax.random.bernoulli(
+            self._key(), self.prob, shape).astype(jnp.float32))
+
+    def sample_n(self, size):
+        n = self._size(size) or ()
+        return self.sample(tuple(n) + self._batch_shape())
+
+    @property
+    def mean(self):
+        return wrap(self.prob)
+
+    @property
+    def variance(self):
+        return wrap(self.prob * (1 - self.prob))
+
+    def entropy(self):
+        l = self.logit
+        return wrap(jnp.logaddexp(0.0, l) - self.prob * l)
+
+    def enumerate_support(self):
+        shape = (2,) + self._batch_shape()
+        vals = jnp.zeros(shape).at[1].set(1.0)
+        return wrap(vals)
+
+    @property
+    def _natural_params(self):
+        return (self.logit,)
+
+    def _log_normalizer(self, x):
+        return jnp.logaddexp(0.0, x)
+
+    def _mean_carrier_measure(self):
+        return 0.0
+
+
+class Geometric(_ProbLogit):
+    r"""Number of failures before first success; support {0, 1, 2, ...}."""
+
+    support = C.NonNegativeInteger()
+    arg_constraints = {"prob": C.UnitInterval(), "logit": C.Real()}
+
+    def _batch_shape(self):
+        return (self.prob if "prob" in self.__dict__ else self.logit).shape
+
+    def broadcast_to(self, batch_shape):
+        return self._param_broadcast(tuple(batch_shape), Geometric)
+
+    def log_prob(self, value):
+        v = jnp.asarray(as_jax(value))
+        p = jnp.clip(self.prob, 1e-7, 1 - 1e-7)
+        return wrap(v * jnp.log1p(-p) + jnp.log(p))
+
+    def sample(self, size=None):
+        size = self._size(size)
+        shape = self._batch_shape() if size is None else size
+        u = jax.random.uniform(self._key(), shape, minval=1e-7,
+                               maxval=1.0 - 1e-7)
+        p = jnp.clip(self.prob, 1e-7, 1 - 1e-7)
+        return wrap(jnp.floor(jnp.log(u) / jnp.log1p(-p)))
+
+    @property
+    def mean(self):
+        return wrap((1 - self.prob) / self.prob)
+
+    @property
+    def variance(self):
+        return wrap((1 - self.prob) / self.prob ** 2)
+
+    def entropy(self):
+        p = jnp.clip(self.prob, 1e-7, 1 - 1e-7)
+        return wrap(-(p * jnp.log(p) + (1 - p) * jnp.log1p(-p)) / p)
+
+
+class Binomial(_ProbLogit):
+    r"""Binomial(n, prob|logit); n is a python int (static under jit)."""
+
+    arg_constraints = {"prob": C.UnitInterval(), "logit": C.Real()}
+
+    def __init__(self, n=1, prob=None, logit=None, validate_args=None):
+        self.n = int(n)
+        super().__init__(prob, logit, validate_args)
+
+    @property
+    def support(self):
+        return C.IntegerInterval(0, self.n)
+
+    def _batch_shape(self):
+        return (self.prob if "prob" in self.__dict__ else self.logit).shape
+
+    def broadcast_to(self, batch_shape):
+        return self._param_broadcast(tuple(batch_shape), Binomial, n=self.n)
+
+    def log_prob(self, value):
+        v = jnp.asarray(as_jax(value))
+        p = jnp.clip(self.prob, 1e-7, 1 - 1e-7)
+        log_comb = (jsp.gammaln(self.n + 1.0) - jsp.gammaln(v + 1.0)
+                    - jsp.gammaln(self.n - v + 1.0))
+        return wrap(log_comb + v * jnp.log(p)
+                    + (self.n - v) * jnp.log1p(-p))
+
+    def sample(self, size=None):
+        size = self._size(size)
+        shape = self._batch_shape() if size is None else size
+        draws = jax.random.bernoulli(
+            self._key(), self.prob, (self.n,) + tuple(shape))
+        return wrap(jnp.sum(draws.astype(jnp.float32), axis=0))
+
+    def sample_n(self, size):
+        n = self._size(size) or ()
+        return self.sample(tuple(n) + self._batch_shape())
+
+    @property
+    def mean(self):
+        return wrap(self.n * self.prob)
+
+    @property
+    def variance(self):
+        return wrap(self.n * self.prob * (1 - self.prob))
+
+
+class Poisson(ExponentialFamily):
+    support = C.NonNegativeInteger()
+    arg_constraints = {"rate": C.Positive()}
+
+    def __init__(self, rate=1.0, validate_args=None):
+        self.rate = jnp.asarray(as_jax(rate), jnp.float32)
+        super().__init__(event_dim=0, validate_args=validate_args)
+
+    def _batch_shape(self):
+        return self.rate.shape
+
+    def broadcast_to(self, batch_shape):
+        return Poisson(jnp.broadcast_to(self.rate, tuple(batch_shape)))
+
+    def log_prob(self, value):
+        v = jnp.asarray(as_jax(value))
+        return wrap(jsp.xlogy(v, self.rate) - self.rate
+                    - jsp.gammaln(v + 1))
+
+    def sample(self, size=None):
+        size = self._size(size)
+        shape = self.rate.shape if size is None else size
+        return wrap(jax.random.poisson(self._key(), self.rate,
+                                       shape).astype(jnp.float32))
+
+    def sample_n(self, size):
+        n = self._size(size) or ()
+        return self.sample(tuple(n) + self.rate.shape)
+
+    @property
+    def mean(self):
+        return wrap(self.rate)
+
+    @property
+    def variance(self):
+        return wrap(self.rate)
+
+    @property
+    def _natural_params(self):
+        return (jnp.log(self.rate),)
+
+    def _log_normalizer(self, x):
+        return jnp.exp(x)
+
+    def _mean_carrier_measure(self):
+        # E[log(x!)] has no closed form; reference also omits Poisson entropy
+        raise NotImplementedError
+
+
+class NegativeBinomial(_ProbLogit):
+    r"""NegativeBinomial(n, prob|logit): number of failures until n
+    successes, `prob` = success probability
+    (reference: negative_binomial.py:51)."""
+
+    support = C.NonNegativeInteger()
+    arg_constraints = {"prob": C.UnitInterval(), "logit": C.Real()}
+
+    def __init__(self, n, prob=None, logit=None, validate_args=None):
+        self.n = jnp.asarray(as_jax(n), jnp.float32)
+        super().__init__(prob, logit, validate_args)
+
+    def _batch_shape(self):
+        p = self.prob if "prob" in self.__dict__ else self.logit
+        return jnp.broadcast_shapes(self.n.shape, p.shape)
+
+    def broadcast_to(self, batch_shape):
+        b = tuple(batch_shape)
+        return self._param_broadcast(
+            b, NegativeBinomial, n=jnp.broadcast_to(self.n, b))
+
+    def log_prob(self, value):
+        v = jnp.asarray(as_jax(value))
+        p = jnp.clip(self.prob, 1e-7, 1 - 1e-7)
+        log_comb = (jsp.gammaln(v + self.n) - jsp.gammaln(v + 1)
+                    - jsp.gammaln(self.n))
+        return wrap(log_comb + self.n * jnp.log(p) + v * jnp.log1p(-p))
+
+    def sample(self, size=None):
+        # gamma-poisson mixture: lam ~ Gamma(n, (1-p)/p); x ~ Poisson(lam)
+        size = self._size(size)
+        shape = self._batch_shape() if size is None else size
+        k1, k2 = jax.random.split(self._key())
+        p = jnp.clip(self.prob, 1e-7, 1 - 1e-7)
+        lam = jax.random.gamma(k1, self.n, shape) * (1 - p) / p
+        return wrap(jax.random.poisson(k2, lam).astype(jnp.float32))
+
+    @property
+    def mean(self):
+        return wrap(self.n * (1 - self.prob) / self.prob)
+
+    @property
+    def variance(self):
+        return wrap(self.n * (1 - self.prob) / self.prob ** 2)
+
+
+class Categorical(_ProbLogit):
+    r"""Categorical over {0..num_events-1}; prob/logit shaped
+    (..., num_events) (reference: categorical.py:47)."""
+
+    _binary = False
+    has_enumerate_support = True
+
+    def __init__(self, num_events, prob=None, logit=None,
+                 validate_args=None):
+        self.num_events = int(num_events)
+        super().__init__(prob, logit, validate_args)
+
+    @property
+    def support(self):
+        return C.IntegerInterval(0, self.num_events - 1)
+
+    def _batch_shape(self):
+        p = self.prob if "prob" in self.__dict__ else self.logit
+        return p.shape[:-1]
+
+    def broadcast_to(self, batch_shape):
+        b = tuple(batch_shape) + (self.num_events,)
+        return self._param_broadcast(b, Categorical,
+                                     num_events=self.num_events)
+
+    @property
+    def _normalized_logit(self):
+        return self.logit - jsp.logsumexp(self.logit, axis=-1,
+                                          keepdims=True)
+
+    def log_prob(self, value):
+        v = jnp.asarray(as_jax(value)).astype(jnp.int32)
+        logp = self._normalized_logit
+        v_b = jnp.broadcast_to(v, jnp.broadcast_shapes(
+            v.shape, logp.shape[:-1]))
+        logp_b = jnp.broadcast_to(logp, v_b.shape + (self.num_events,))
+        return wrap(jnp.take_along_axis(
+            logp_b, v_b[..., None], axis=-1).squeeze(-1))
+
+    def sample(self, size=None):
+        size = self._size(size)
+        shape = self._batch_shape() if size is None else size
+        return wrap(jax.random.categorical(
+            self._key(), self._normalized_logit,
+            shape=shape).astype(jnp.float32))
+
+    def sample_n(self, size):
+        n = self._size(size) or ()
+        return self.sample(tuple(n) + self._batch_shape())
+
+    @property
+    def mean(self):
+        raise NotImplementedError  # undefined for categorical labels
+
+    def entropy(self):
+        logp = self._normalized_logit
+        return wrap(-jnp.sum(jnp.exp(logp) * logp, axis=-1))
+
+    def enumerate_support(self):
+        vals = jnp.arange(self.num_events, dtype=jnp.float32)
+        shape = (self.num_events,) + tuple(1 for _ in self._batch_shape())
+        return wrap(jnp.broadcast_to(
+            vals.reshape(shape), (self.num_events,) + self._batch_shape()))
+
+
+class OneHotCategorical(Categorical):
+    r"""Categorical emitting one-hot vectors; event_dim=1."""
+
+    def __init__(self, num_events, prob=None, logit=None,
+                 validate_args=None):
+        super().__init__(num_events, prob, logit, validate_args)
+        self.event_dim = 1
+
+    def broadcast_to(self, batch_shape):
+        b = tuple(batch_shape) + (self.num_events,)
+        return self._param_broadcast(b, OneHotCategorical,
+                                     num_events=self.num_events)
+
+    def log_prob(self, value):
+        v = jnp.asarray(as_jax(value))
+        return wrap(jnp.sum(v * self._normalized_logit, axis=-1))
+
+    def sample(self, size=None):
+        size = self._size(size)
+        shape = self._batch_shape() if size is None else size
+        idx = jax.random.categorical(self._key(), self._normalized_logit,
+                                     shape=shape)
+        return wrap(jax.nn.one_hot(idx, self.num_events))
+
+    @property
+    def mean(self):
+        return wrap(self.prob)
+
+    @property
+    def variance(self):
+        return wrap(self.prob * (1 - self.prob))
+
+    def enumerate_support(self):
+        eye = jnp.eye(self.num_events)
+        shape = ((self.num_events,)
+                 + tuple(1 for _ in self._batch_shape())
+                 + (self.num_events,))
+        return wrap(jnp.broadcast_to(
+            eye.reshape(shape),
+            (self.num_events,) + self._batch_shape()
+            + (self.num_events,)))
+
+
+class Multinomial(Distribution):
+    r"""Multinomial(num_events, prob|logit, total_count) — counts over
+    categories; sampling sums total_count one-hot draws
+    (reference: multinomial.py:48-99)."""
+
+    arg_constraints = {"prob": C.Simplex(), "logit": C.Real()}
+
+    def __init__(self, num_events, prob=None, logit=None, total_count=1,
+                 validate_args=None):
+        self.total_count = int(total_count)
+        self.num_events = int(num_events)
+        self._categorical = OneHotCategorical(num_events, prob, logit)
+        super().__init__(event_dim=1, validate_args=validate_args)
+
+    @property
+    def prob(self):
+        return self._categorical.prob
+
+    @property
+    def logit(self):
+        return self._categorical.logit
+
+    def _batch_shape(self):
+        return self._categorical._batch_shape()
+
+    def broadcast_to(self, batch_shape):
+        new = self.__new__(Multinomial)
+        new.total_count = self.total_count
+        new.num_events = self.num_events
+        new._categorical = self._categorical.broadcast_to(batch_shape)
+        new.event_dim = self.event_dim
+        new._validate_args = self._validate_args
+        return new
+
+    def log_prob(self, value):
+        v = jnp.asarray(as_jax(value))
+        logp = self._categorical._normalized_logit
+        log_factorial = (jsp.gammaln(jnp.sum(v, axis=-1) + 1)
+                         - jnp.sum(jsp.gammaln(v + 1), axis=-1))
+        return wrap(log_factorial + jnp.sum(v * logp, axis=-1))
+
+    def sample(self, size=None):
+        size = self._size(size)
+        base = self._categorical if size is None else \
+            self._categorical.broadcast_to(size)
+        onehots = base.sample_n((self.total_count,))
+        return wrap(jnp.sum(as_jax(onehots), axis=0))
+
+    @property
+    def mean(self):
+        return wrap(self.total_count * self.prob)
+
+    @property
+    def variance(self):
+        return wrap(self.total_count * self.prob * (1 - self.prob))
+
+
+class RelaxedBernoulli(Distribution):
+    r"""Concrete/Gumbel-sigmoid relaxation with pathwise gradients."""
+
+    has_grad = True
+    support = C.UnitInterval()
+    arg_constraints = {"prob": C.UnitInterval(), "logit": C.Real()}
+
+    def __init__(self, T=1.0, prob=None, logit=None, validate_args=None):
+        if (prob is None) == (logit is None):
+            raise ValueError(
+                "Either `prob` or `logit` must be specified, but not both.")
+        self.T = jnp.asarray(as_jax(T), jnp.float32)
+        if prob is not None:
+            self.prob = jnp.asarray(as_jax(prob), jnp.float32)
+            self.logit = prob2logit(self.prob, binary=True)
+        else:
+            self.logit = jnp.asarray(as_jax(logit), jnp.float32)
+            self.prob = jax.nn.sigmoid(self.logit)
+        super().__init__(event_dim=0, validate_args=validate_args)
+
+    def _batch_shape(self):
+        return self.logit.shape
+
+    def broadcast_to(self, batch_shape):
+        b = tuple(batch_shape)
+        return RelaxedBernoulli(self.T,
+                                logit=jnp.broadcast_to(self.logit, b))
+
+    def sample(self, size=None):
+        size = self._size(size)
+        shape = self._batch_shape() if size is None else size
+        l = jax.random.logistic(self._key(), shape)
+        return wrap(jax.nn.sigmoid((self.logit + l) / self.T))
+
+    def log_prob(self, value):
+        # BinConcrete density (Maddison et al. 2017, eq. C.7):
+        # p(v) = T a v^{-T-1} (1-v)^{-T-1} / (a v^{-T} + (1-v)^{-T})^2
+        v = jnp.clip(jnp.asarray(as_jax(value)), 1e-6, 1 - 1e-6)
+        logit_v = jnp.log(v) - jnp.log1p(-v)
+        diff = self.logit - self.T * logit_v
+        return wrap(jnp.log(self.T) + self.logit
+                    - (self.T + 1) * jnp.log(v)
+                    + (self.T - 1) * jnp.log1p(-v)
+                    - 2 * jnp.logaddexp(0.0, diff))
+
+
+class RelaxedOneHotCategorical(Distribution):
+    r"""Gumbel-softmax relaxation of OneHotCategorical."""
+
+    has_grad = True
+    support = C.Simplex()
+    arg_constraints = {"prob": C.Simplex(), "logit": C.Real()}
+
+    def __init__(self, T=1.0, num_events=None, prob=None, logit=None,
+                 validate_args=None):
+        if (prob is None) == (logit is None):
+            raise ValueError(
+                "Either `prob` or `logit` must be specified, but not both.")
+        self.T = jnp.asarray(as_jax(T), jnp.float32)
+        if prob is not None:
+            self.prob = jnp.asarray(as_jax(prob), jnp.float32)
+            self.logit = jnp.log(jnp.clip(self.prob, 1e-30, None))
+        else:
+            self.logit = jnp.asarray(as_jax(logit), jnp.float32)
+            self.prob = jax.nn.softmax(self.logit, axis=-1)
+        self.num_events = (int(num_events) if num_events is not None
+                           else self.logit.shape[-1])
+        super().__init__(event_dim=1, validate_args=validate_args)
+
+    def _batch_shape(self):
+        return self.logit.shape[:-1]
+
+    def broadcast_to(self, batch_shape):
+        b = tuple(batch_shape) + (self.num_events,)
+        return RelaxedOneHotCategorical(
+            self.T, self.num_events, logit=jnp.broadcast_to(self.logit, b))
+
+    def sample(self, size=None):
+        size = self._size(size)
+        shape = (self._batch_shape() if size is None else size) \
+            + (self.num_events,)
+        g = jax.random.gumbel(self._key(), shape)
+        return wrap(jax.nn.softmax((self.logit + g) / self.T, axis=-1))
+
+    def log_prob(self, value):
+        # Concrete density on the simplex (Maddison et al. 2017, eq. 10):
+        # p(x) = (K-1)! T^{K-1} prod_k(p_k x_k^{-T-1})
+        #        / (sum_k p_k x_k^{-T})^K
+        v = jnp.clip(jnp.asarray(as_jax(value)), 1e-30, None)
+        k = self.num_events
+        logp = self.logit - jsp.logsumexp(self.logit, axis=-1,
+                                          keepdims=True)
+        score = jnp.sum(logp - (self.T + 1) * jnp.log(v), axis=-1) \
+            - k * jsp.logsumexp(logp - self.T * jnp.log(v), axis=-1)
+        log_norm = (jsp.gammaln(jnp.asarray(float(k)))
+                    + (k - 1) * jnp.log(self.T))
+        return wrap(score + log_norm)

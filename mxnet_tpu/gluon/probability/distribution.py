@@ -1,0 +1,156 @@
+"""Distribution base classes.
+
+Reference surface: python/mxnet/gluon/probability/distributions/
+distribution.py (Distribution: log_prob/pdf/cdf/icdf/sample/sample_n/
+broadcast_to/mean/variance/entropy/perplexity) and exp_family.py
+(ExponentialFamily: entropy via Bregman divergence of the log normalizer).
+
+TPU re-design: sampling draws jax PRNG keys from the global stateful RNG
+(mxnet_tpu._random), so `d.sample()` is reproducible under mx.seed and
+trace-safe inside HybridBlock via the key-provider stack; log_prob math is
+pure jax.numpy, fused by XLA.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ... import _random
+from .utils import as_jax, wrap
+
+__all__ = ["Distribution", "ExponentialFamily"]
+
+
+class Distribution:
+    """Base class for probability distributions."""
+
+    has_grad = False
+    has_enumerate_support = False
+    support = None
+    arg_constraints = {}
+    _validate_args = False
+
+    @staticmethod
+    def set_default_validate_args(value):
+        if value not in (True, False):
+            raise ValueError("validate_args must be True or False")
+        Distribution._validate_args = value
+
+    def __init__(self, event_dim=None, validate_args=None):
+        self.event_dim = event_dim
+        if validate_args is not None:
+            self._validate_args = validate_args
+        if self._validate_args:
+            from .constraint import is_dependent
+
+            for param, constraint in self.arg_constraints.items():
+                if is_dependent(constraint):
+                    continue
+                if param not in self.__dict__ and isinstance(
+                        getattr(type(self), param, None), property):
+                    continue
+                val = getattr(self, param, None)
+                if val is not None:
+                    constraint.check(val)
+
+    # -- shape helpers -------------------------------------------------
+    def _size(self, size):
+        if size is None:
+            return None
+        if isinstance(size, int):
+            return (size,)
+        return tuple(size)
+
+    def _key(self):
+        return _random.next_key()
+
+    # -- core API ------------------------------------------------------
+    def log_prob(self, value):
+        raise NotImplementedError
+
+    def prob(self, value):
+        return wrap(jnp.exp(as_jax(self.log_prob(value))))
+
+    pdf = prob
+
+    def cdf(self, value):
+        raise NotImplementedError
+
+    def icdf(self, value):
+        raise NotImplementedError
+
+    def sample(self, size=None):
+        raise NotImplementedError
+
+    def sample_n(self, size):
+        """Draw (n,) + batch_shape samples (reference: sample_n)."""
+        n = self._size(size) or ()
+        return self.sample(tuple(n) + tuple(self._batch_shape()))
+
+    def _batch_shape(self):
+        raise NotImplementedError
+
+    def broadcast_to(self, batch_shape):
+        raise NotImplementedError
+
+    def enumerate_support(self):
+        raise NotImplementedError
+
+    def _validate_samples(self, value):
+        if self.support is not None:
+            self.support.check(value)
+
+    # -- moments -------------------------------------------------------
+    @property
+    def mean(self):
+        raise NotImplementedError
+
+    @property
+    def variance(self):
+        raise NotImplementedError
+
+    @property
+    def stddev(self):
+        return wrap(jnp.sqrt(as_jax(self.variance)))
+
+    def entropy(self):
+        raise NotImplementedError
+
+    def perplexity(self):
+        return wrap(jnp.exp(as_jax(self.entropy())))
+
+    def __repr__(self):
+        args = ", ".join(
+            f"{k}" for k in self.arg_constraints if k in self.__dict__)
+        return f"{type(self).__name__}({args})"
+
+
+class ExponentialFamily(Distribution):
+    r"""Distributions of form  p(x|θ) = h(x) exp(η(θ)·T(x) − A(η)).
+
+    `entropy()` is computed from the log-normalizer's Bregman divergence:
+    H = A(η) − η·∇A(η) + E[−log h(x)] via jax autodiff on _log_normalizer
+    (the reference differentiates through its autograd tape the same way).
+    """
+
+    @property
+    def _natural_params(self):
+        raise NotImplementedError
+
+    def _log_normalizer(self, *natural_params):
+        raise NotImplementedError
+
+    def _mean_carrier_measure(self):
+        # E[-log h(x)]; zero for Normal/Exponential etc.
+        raise NotImplementedError
+
+    def entropy(self):
+        nparams = [jnp.asarray(as_jax(p)) for p in self._natural_params]
+        lg_normal = self._log_normalizer(*nparams)
+        gradients = jax.grad(
+            lambda *ps: jnp.sum(self._log_normalizer(*ps)),
+            argnums=tuple(range(len(nparams))))(*nparams)
+        result = lg_normal + self._mean_carrier_measure()
+        for np_, g in zip(nparams, gradients):
+            result = result - np_ * g
+        return wrap(result)

@@ -1,0 +1,235 @@
+"""KL divergence registry.
+
+Reference surface: distributions/divergence.py — `kl_divergence(p, q)`
+dispatching on (type(p), type(q)) with MRO fallback, `register_kl`
+decorator for user pairs, `empirical_kl` Monte-Carlo fallback.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+from jax.scipy import special as jsp
+
+from .continuous import (Beta, Cauchy, Exponential, Gamma, Gumbel,
+                         HalfNormal, Laplace, Normal, Pareto, Uniform)
+from .discrete import (Bernoulli, Binomial, Categorical, Geometric,
+                       Multinomial, NegativeBinomial, OneHotCategorical,
+                       Poisson)
+from .multivariate import Dirichlet, MultivariateNormal
+from .utils import as_jax, wrap
+
+__all__ = ["register_kl", "kl_divergence", "empirical_kl"]
+
+_KL_REGISTRY = {}
+
+
+def register_kl(typeP, typeQ):
+    """Decorator registering a KL(p||q) implementation for a type pair."""
+
+    def decorator(func):
+        _KL_REGISTRY[(typeP, typeQ)] = func
+        return func
+
+    return decorator
+
+
+def _dispatch_kl(type_p, type_q):
+    matches = [(p, q) for (p, q) in _KL_REGISTRY
+               if issubclass(type_p, p) and issubclass(type_q, q)]
+    if not matches:
+        raise NotImplementedError(
+            f"KL divergence between {type_p.__name__} and "
+            f"{type_q.__name__} is not implemented; consider empirical_kl.")
+    # most-derived match first
+    matches.sort(key=lambda pq: (len(type_p.__mro__)
+                                 - type_p.__mro__.index(pq[0]),
+                                 len(type_q.__mro__)
+                                 - type_q.__mro__.index(pq[1])),
+                 reverse=True)
+    return _KL_REGISTRY[matches[0]]
+
+
+def kl_divergence(p, q):
+    r"""KL(p || q) = E_p[log p(x) - log q(x)], closed form via registry."""
+    func = _dispatch_kl(type(p), type(q))
+    return func(p, q)
+
+
+def empirical_kl(p, q, n_samples=1):
+    """Monte-Carlo estimate of KL(p||q) from n_samples draws of p."""
+    samples = p.sample_n((n_samples,))
+    lp = as_jax(p.log_prob(samples))
+    lq = as_jax(q.log_prob(samples))
+    return wrap(jnp.mean(lp - lq, axis=0))
+
+
+@register_kl(Normal, Normal)
+def _kl_normal_normal(p, q):
+    var_ratio = (p.scale / q.scale) ** 2
+    t1 = ((p.loc - q.loc) / q.scale) ** 2
+    return wrap(0.5 * (var_ratio + t1 - 1 - jnp.log(var_ratio)))
+
+
+@register_kl(Bernoulli, Bernoulli)
+def _kl_bernoulli_bernoulli(p, q):
+    pp = jnp.clip(p.prob, 1e-7, 1 - 1e-7)
+    qp = jnp.clip(q.prob, 1e-7, 1 - 1e-7)
+    return wrap(pp * (jnp.log(pp) - jnp.log(qp))
+                + (1 - pp) * (jnp.log1p(-pp) - jnp.log1p(-qp)))
+
+
+@register_kl(Categorical, Categorical)
+def _kl_categorical_categorical(p, q):
+    lp = p._normalized_logit
+    lq = q._normalized_logit
+    return wrap(jnp.sum(jnp.exp(lp) * (lp - lq), axis=-1))
+
+
+@register_kl(OneHotCategorical, OneHotCategorical)
+def _kl_onehot_onehot(p, q):
+    return _kl_categorical_categorical(p, q)
+
+
+@register_kl(Uniform, Uniform)
+def _kl_uniform_uniform(p, q):
+    result = jnp.log((q.high - q.low) / (p.high - p.low))
+    outside = (q.low > p.low) | (q.high < p.high)
+    return wrap(jnp.where(outside, jnp.inf, result))
+
+
+@register_kl(Exponential, Exponential)
+def _kl_exponential_exponential(p, q):
+    # rate = 1/scale
+    ratio = q.scale / p.scale  # rate_p / rate_q
+    return wrap(jnp.log(ratio) + 1.0 / ratio - 1)
+
+
+@register_kl(Poisson, Poisson)
+def _kl_poisson_poisson(p, q):
+    return wrap(p.rate * (jnp.log(p.rate) - jnp.log(q.rate))
+                - p.rate + q.rate)
+
+
+@register_kl(Gamma, Gamma)
+def _kl_gamma_gamma(p, q):
+    # shape/scale parameterization
+    a_p, b_p = p.shape, 1.0 / p.scale
+    a_q, b_q = q.shape, 1.0 / q.scale
+    t1 = a_q * (jnp.log(b_p) - jnp.log(b_q))
+    t2 = jsp.gammaln(a_q) - jsp.gammaln(a_p)
+    t3 = (a_p - a_q) * jsp.digamma(a_p)
+    t4 = (b_q - b_p) * (a_p / b_p)
+    return wrap(t1 + t2 + t3 + t4)
+
+
+@register_kl(Beta, Beta)
+def _kl_beta_beta(p, q):
+    sum_p = p.alpha + p.beta
+    t1 = jsp.betaln(q.alpha, q.beta) - jsp.betaln(p.alpha, p.beta)
+    t2 = (p.alpha - q.alpha) * jsp.digamma(p.alpha)
+    t3 = (p.beta - q.beta) * jsp.digamma(p.beta)
+    t4 = (q.alpha - p.alpha + q.beta - p.beta) * jsp.digamma(sum_p)
+    return wrap(t1 + t2 + t3 + t4)
+
+
+@register_kl(Dirichlet, Dirichlet)
+def _kl_dirichlet_dirichlet(p, q):
+    a_p, a_q = p.alpha, q.alpha
+    sum_p = jnp.sum(a_p, axis=-1)
+    t1 = jsp.gammaln(sum_p) - jnp.sum(jsp.gammaln(a_p), axis=-1)
+    t2 = (jnp.sum(jsp.gammaln(a_q), axis=-1)
+          - jsp.gammaln(jnp.sum(a_q, axis=-1)))
+    t3 = jnp.sum((a_p - a_q) * (jsp.digamma(a_p)
+                                - jsp.digamma(sum_p)[..., None]), axis=-1)
+    return wrap(t1 + t2 + t3)
+
+
+@register_kl(Laplace, Laplace)
+def _kl_laplace_laplace(p, q):
+    scale_ratio = p.scale / q.scale
+    loc_abs_diff = jnp.abs(p.loc - q.loc)
+    t1 = -jnp.log(scale_ratio)
+    t2 = loc_abs_diff / q.scale
+    t3 = scale_ratio * jnp.exp(-loc_abs_diff / p.scale)
+    return wrap(t1 + t2 + t3 - 1)
+
+
+@register_kl(Gumbel, Gumbel)
+def _kl_gumbel_gumbel(p, q):
+    from .continuous import _EULER
+    ratio = p.scale / q.scale
+    t1 = jnp.log(q.scale / p.scale)
+    t2 = _EULER * (ratio - 1)
+    t3 = jnp.exp((q.loc - p.loc) / q.scale
+                 + jsp.gammaln(1 + ratio)) - 1
+    t4 = (p.loc - q.loc) / q.scale
+    return wrap(t1 + t2 + t3 + t4)
+
+
+@register_kl(Geometric, Geometric)
+def _kl_geometric_geometric(p, q):
+    pp = jnp.clip(p.prob, 1e-7, 1 - 1e-7)
+    qp = jnp.clip(q.prob, 1e-7, 1 - 1e-7)
+    return wrap(-as_jax(p.entropy()) - jnp.log(qp)
+                - (1 - pp) / pp * jnp.log1p(-qp))
+
+
+@register_kl(Pareto, Pareto)
+def _kl_pareto_pareto(p, q):
+    scale_ratio = p.scale / q.scale
+    alpha_ratio = q.alpha / p.alpha
+    t1 = q.alpha * jnp.log(scale_ratio)
+    t2 = -jnp.log(alpha_ratio)
+    result = t1 + t2 + alpha_ratio - 1
+    return wrap(jnp.where(p.scale >= q.scale, result, jnp.inf))
+
+
+@register_kl(HalfNormal, HalfNormal)
+def _kl_halfnormal_halfnormal(p, q):
+    var_ratio = (p.scale / q.scale) ** 2
+    return wrap(0.5 * (var_ratio - 1 - jnp.log(var_ratio)))
+
+
+@register_kl(Cauchy, Cauchy)
+def _kl_cauchy_cauchy(p, q):
+    # closed form (Chyzak & Nielsen 2019)
+    num = (p.scale + q.scale) ** 2 + (p.loc - q.loc) ** 2
+    den = 4 * p.scale * q.scale
+    return wrap(jnp.log(num / den))
+
+
+@register_kl(MultivariateNormal, MultivariateNormal)
+def _kl_mvn_mvn(p, q):
+    d = p.loc.shape[-1]
+    half_ld_p = p._half_log_det()
+    half_ld_q = q._half_log_det()
+    q_cov_inv = jnp.linalg.inv(q.cov)
+    trace_term = jnp.trace(q_cov_inv @ p.cov, axis1=-2, axis2=-1)
+    diff = q.loc - p.loc
+    maha = jnp.einsum("...i,...ij,...j->...", diff, q_cov_inv, diff)
+    return wrap(half_ld_q - half_ld_p
+                + 0.5 * (trace_term + maha - d))
+
+
+@register_kl(Binomial, Binomial)
+def _kl_binomial_binomial(p, q):
+    if p.n != q.n:
+        raise ValueError("KL between Binomials requires equal n")
+    pp = jnp.clip(p.prob, 1e-7, 1 - 1e-7)
+    qp = jnp.clip(q.prob, 1e-7, 1 - 1e-7)
+    return wrap(p.n * (pp * (jnp.log(pp) - jnp.log(qp))
+                       + (1 - pp) * (jnp.log1p(-pp) - jnp.log1p(-qp))))
+
+
+@register_kl(Multinomial, Multinomial)
+def _kl_multinomial_multinomial(p, q):
+    if p.total_count != q.total_count:
+        raise ValueError("KL between Multinomials requires equal "
+                         "total_count")
+    kl_cat = as_jax(_kl_categorical_categorical(p._categorical,
+                                                q._categorical))
+    return wrap(p.total_count * kl_cat)
+
+
+@register_kl(NegativeBinomial, NegativeBinomial)
+def _kl_negbin_negbin(p, q):
+    return empirical_kl(p, q, n_samples=32)

@@ -1,0 +1,159 @@
+"""Multivariate distributions: Dirichlet, MultivariateNormal.
+
+Reference surface: distributions/dirichlet.py and
+multivariate_normal.py (loc + exactly one of cov/precision/scale_tril).
+TPU note: MVN math runs through Cholesky + triangular solve
+(jax.scipy.linalg), which XLA lowers to the MXU-friendly blocked kernels.
+"""
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+from jax.scipy import linalg as jla
+from jax.scipy import special as jsp
+
+from . import constraint as C
+from .distribution import Distribution
+from .utils import as_jax, wrap
+
+__all__ = ["Dirichlet", "MultivariateNormal"]
+
+
+class Dirichlet(Distribution):
+    has_grad = True
+    support = C.Simplex()
+    arg_constraints = {"alpha": C.Positive()}
+
+    def __init__(self, alpha, validate_args=None):
+        self.alpha = jnp.asarray(as_jax(alpha), jnp.float32)
+        super().__init__(event_dim=1, validate_args=validate_args)
+
+    def _batch_shape(self):
+        return self.alpha.shape[:-1]
+
+    def broadcast_to(self, batch_shape):
+        b = tuple(batch_shape) + (self.alpha.shape[-1],)
+        return Dirichlet(jnp.broadcast_to(self.alpha, b))
+
+    def log_prob(self, value):
+        v = jnp.asarray(as_jax(value))
+        a = self.alpha
+        return wrap(jnp.sum(jsp.xlogy(a - 1, v), axis=-1)
+                    + jsp.gammaln(jnp.sum(a, axis=-1))
+                    - jnp.sum(jsp.gammaln(a), axis=-1))
+
+    def sample(self, size=None):
+        size = self._size(size)
+        shape = (self._batch_shape() if size is None else size)
+        return wrap(jax.random.dirichlet(self._key(), self.alpha,
+                                         shape))
+
+    def sample_n(self, size):
+        n = self._size(size) or ()
+        return self.sample(tuple(n) + self._batch_shape())
+
+    @property
+    def mean(self):
+        return wrap(self.alpha / jnp.sum(self.alpha, axis=-1,
+                                         keepdims=True))
+
+    @property
+    def variance(self):
+        a0 = jnp.sum(self.alpha, axis=-1, keepdims=True)
+        m = self.alpha / a0
+        return wrap(m * (1 - m) / (a0 + 1))
+
+    def entropy(self):
+        a = self.alpha
+        k = a.shape[-1]
+        a0 = jnp.sum(a, axis=-1)
+        return wrap(jnp.sum(jsp.gammaln(a), axis=-1) - jsp.gammaln(a0)
+                    + (a0 - k) * jsp.digamma(a0)
+                    - jnp.sum((a - 1) * jsp.digamma(a), axis=-1))
+
+
+class MultivariateNormal(Distribution):
+    r"""MVN parameterized by loc and exactly one of cov / precision /
+    scale_tril (reference: multivariate_normal.py)."""
+
+    has_grad = True
+    support = C.Real()
+    arg_constraints = {"loc": C.Real(), "cov": C.PositiveDefinite(),
+                       "precision": C.PositiveDefinite(),
+                       "scale_tril": C.LowerCholesky()}
+
+    def __init__(self, loc, cov=None, precision=None, scale_tril=None,
+                 validate_args=None):
+        given = sum(p is not None for p in (cov, precision, scale_tril))
+        if given != 1:
+            raise ValueError("Exactly one of cov, precision, or scale_tril "
+                             "must be specified.")
+        self.loc = jnp.asarray(as_jax(loc), jnp.float32)
+        if cov is not None:
+            self.cov = jnp.asarray(as_jax(cov), jnp.float32)
+            self.scale_tril = jnp.linalg.cholesky(self.cov)
+        elif precision is not None:
+            self.precision = jnp.asarray(as_jax(precision), jnp.float32)
+            self.cov = jnp.linalg.inv(self.precision)
+            self.scale_tril = jnp.linalg.cholesky(self.cov)
+        else:
+            self.scale_tril = jnp.asarray(as_jax(scale_tril), jnp.float32)
+            self.cov = self.scale_tril @ jnp.swapaxes(self.scale_tril,
+                                                      -1, -2)
+        super().__init__(event_dim=1, validate_args=validate_args)
+
+    def _batch_shape(self):
+        return jnp.broadcast_shapes(self.loc.shape[:-1],
+                                    self.scale_tril.shape[:-2])
+
+    def broadcast_to(self, batch_shape):
+        b = tuple(batch_shape)
+        d = self.loc.shape[-1]
+        return MultivariateNormal(
+            jnp.broadcast_to(self.loc, b + (d,)),
+            scale_tril=jnp.broadcast_to(self.scale_tril, b + (d, d)))
+
+    def _half_log_det(self):
+        return jnp.sum(jnp.log(jnp.diagonal(self.scale_tril, axis1=-2,
+                                            axis2=-1)), axis=-1)
+
+    def log_prob(self, value):
+        v = jnp.asarray(as_jax(value))
+        d = self.loc.shape[-1]
+        diff = v - self.loc
+        # solve L z = diff  →  z = L^{-1} diff; Mahalanobis = |z|^2
+        bshape = jnp.broadcast_shapes(diff.shape[:-1],
+                                      self.scale_tril.shape[:-2])
+        diff_b = jnp.broadcast_to(diff, bshape + (d,))
+        tril_b = jnp.broadcast_to(self.scale_tril, bshape + (d, d))
+        z = jla.solve_triangular(tril_b, diff_b[..., None], lower=True)
+        maha = jnp.sum(z[..., 0] ** 2, axis=-1)
+        return wrap(-0.5 * (d * math.log(2 * math.pi) + maha)
+                    - self._half_log_det())
+
+    def sample(self, size=None):
+        size = self._size(size)
+        bshape = self._batch_shape() if size is None else size
+        d = self.loc.shape[-1]
+        eps = jax.random.normal(self._key(), tuple(bshape) + (d,))
+        return wrap(self.loc + jnp.einsum("...ij,...j->...i",
+                                          self.scale_tril, eps))
+
+    def sample_n(self, size):
+        n = self._size(size) or ()
+        return self.sample(tuple(n) + self._batch_shape())
+
+    @property
+    def mean(self):
+        return wrap(self.loc)
+
+    @property
+    def variance(self):
+        return wrap(jnp.diagonal(self.cov, axis1=-2, axis2=-1))
+
+    def entropy(self):
+        d = self.loc.shape[-1]
+        return wrap(0.5 * d * (1 + math.log(2 * math.pi))
+                    + self._half_log_det())

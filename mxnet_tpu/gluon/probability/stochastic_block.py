@@ -1,0 +1,93 @@
+"""StochasticBlock / StochasticSequential.
+
+Reference surface: python/mxnet/gluon/probability/block/
+stochastic_block.py — HybridBlocks that accumulate auxiliary losses
+(e.g. KL terms in a VAE) during forward via the `collectLoss` decorator
+and expose them through `.losses`.
+"""
+from __future__ import annotations
+
+from functools import wraps
+
+from ..block import HybridBlock
+
+__all__ = ["StochasticBlock", "StochasticSequential"]
+
+
+class StochasticBlock(HybridBlock):
+    """HybridBlock whose forward can stash loss tensors with
+    `self.add_loss(...)`; forward must be decorated with
+    `@StochasticBlock.collectLoss`."""
+
+    def __init__(self, **kwargs):
+        super().__init__(**kwargs)
+        self._losses = []
+        self._losscache = []
+        self._flag = False
+
+    def add_loss(self, loss):
+        self._losscache.append(loss)
+
+    @staticmethod
+    def collectLoss(func):  # noqa: N802 - reference API name
+        @wraps(func)
+        def inner(self, *args, **kwargs):
+            func_out = func(self, *args, **kwargs)
+            collected_loss = self._losscache
+            self._losscache = []
+            self._flag = True
+            return (func_out, collected_loss)
+
+        return inner
+
+    def __call__(self, *args, **kwargs):
+        self._flag = False
+        out = super().__call__(*args, **kwargs)
+        if not self._flag:
+            raise ValueError("The forward function should be decorated by "
+                             "StochasticBlock.collectLoss")
+        self._losses = out[1]
+        return out[0]
+
+    @property
+    def losses(self):
+        return self._losses
+
+
+class StochasticSequential(StochasticBlock):
+    """Sequential stack of blocks whose losses are concatenated."""
+
+    def __init__(self, **kwargs):
+        super().__init__(**kwargs)
+        self._layers = []
+
+    def add(self, *blocks):
+        for block in blocks:
+            self._layers.append(block)
+            self.register_child(block)
+
+    @StochasticBlock.collectLoss
+    def forward(self, x, *args):
+        for block in self._children.values():
+            x = block(x, *args)
+            args = []
+            if isinstance(x, (tuple, list)):
+                args = x[1:]
+                x = x[0]
+        if args:
+            x = tuple([x] + list(args))
+        for block in self._layers:
+            if hasattr(block, "_losses"):
+                self.add_loss(block._losses)
+        return x
+
+    def __getitem__(self, key):
+        layers = list(self._children.values())[key]
+        if isinstance(layers, list):
+            net = type(self)()
+            net.add(*layers)
+            return net
+        return layers
+
+    def __len__(self):
+        return len(self._children)

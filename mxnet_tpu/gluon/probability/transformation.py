@@ -1,0 +1,312 @@
+"""Invertible transformations for TransformedDistribution and domain maps.
+
+Reference surface: python/mxnet/gluon/probability/transformation/
+transformation.py (Transformation, ComposeTransform, Exp/Affine/Power/
+Sigmoid/Softmax/Abs transforms, TransformBlock) and domain_map.py
+(biject_to / transform_to constraint→transformation registries).
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+from jax.scipy import special as jsp
+
+from ..block import HybridBlock
+from . import constraint as C
+from .utils import as_jax, sum_right_most, wrap
+
+__all__ = ["Transformation", "TransformBlock", "ComposeTransform",
+           "ExpTransform", "AffineTransform", "PowerTransform",
+           "SigmoidTransform", "SoftmaxTransform", "AbsTransform",
+           "biject_to", "transform_to", "domain_map"]
+
+
+class Transformation:
+    r"""Invertible transformation with computable log-det-Jacobian."""
+
+    bijective = False
+    event_dim = 0
+
+    def __init__(self):
+        self._inv = None
+
+    @property
+    def sign(self):
+        """Sign of the Jacobian determinant (+1/-1 for monotone maps)."""
+        raise NotImplementedError
+
+    @property
+    def inv(self):
+        inv = self._inv
+        if inv is None:
+            inv = _InverseTransformation(self)
+            self._inv = inv
+        return inv
+
+    def __call__(self, x):
+        return wrap(self._forward_compute(jnp.asarray(as_jax(x))))
+
+    def _inv_call(self, y):
+        return wrap(self._inverse_compute(jnp.asarray(as_jax(y))))
+
+    def _forward_compute(self, x):
+        raise NotImplementedError
+
+    def _inverse_compute(self, y):
+        raise NotImplementedError
+
+    def log_det_jacobian(self, x, y):
+        r"""log |dy/dx| evaluated at (x, y=f(x))."""
+        raise NotImplementedError
+
+
+class _InverseTransformation(Transformation):
+    def __init__(self, forward_transformation):
+        super().__init__()
+        self._forward = forward_transformation
+
+    @property
+    def inv(self):
+        return self._forward
+
+    @property
+    def sign(self):
+        return self._forward.sign
+
+    @property
+    def event_dim(self):
+        return self._forward.event_dim
+
+    def __call__(self, x):
+        return self._forward._inv_call(x)
+
+    def _inv_call(self, y):
+        return self._forward(y)
+
+    def log_det_jacobian(self, x, y):
+        return wrap(-as_jax(self._forward.log_det_jacobian(y, x)))
+
+
+class TransformBlock(Transformation, HybridBlock):
+    """Transformation that is also a HybridBlock, so it can carry
+    learnable parameters (normalizing-flow layers)."""
+
+    def __init__(self, *args, **kwargs):
+        Transformation.__init__(self)
+        HybridBlock.__init__(self, *args, **kwargs)
+
+
+class ComposeTransform(Transformation):
+    def __init__(self, parts):
+        super().__init__()
+        self._parts = list(parts)
+
+    def _forward_compute(self, x):
+        for t in self._parts:
+            x = as_jax(t(x))
+        return x
+
+    def _inverse_compute(self, y):
+        for t in reversed(self._parts):
+            y = as_jax(t._inv_call(y))
+        return y
+
+    @property
+    def sign(self):
+        s = 1
+        for t in self._parts:
+            s = s * t.sign
+        return s
+
+    @property
+    def event_dim(self):
+        return max(t.event_dim for t in self._parts) if self._parts else 0
+
+    @property
+    def inv(self):
+        inv = self._inv
+        if inv is None:
+            inv = ComposeTransform([t.inv for t in reversed(self._parts)])
+            inv._inv = self
+            self._inv = inv
+        return inv
+
+    def log_det_jacobian(self, x, y):  # noqa: ARG002
+        x = jnp.asarray(as_jax(x))
+        result = 0.0
+        event_dim = self.event_dim
+        for t in self._parts:
+            y_t = as_jax(t(x))
+            ldj = as_jax(t.log_det_jacobian(x, y_t))
+            result = result + sum_right_most(ldj,
+                                             event_dim - t.event_dim)
+            x = y_t
+        return wrap(result)
+
+
+class ExpTransform(Transformation):
+    bijective = True
+    sign = 1
+
+    def _forward_compute(self, x):
+        return jnp.exp(x)
+
+    def _inverse_compute(self, y):
+        return jnp.log(y)
+
+    def log_det_jacobian(self, x, y):  # noqa: ARG002
+        return wrap(jnp.asarray(as_jax(x)))
+
+
+class AffineTransform(Transformation):
+    bijective = True
+
+    def __init__(self, loc, scale, event_dim=0):
+        super().__init__()
+        self.loc = jnp.asarray(as_jax(loc), jnp.float32)
+        self.scale = jnp.asarray(as_jax(scale), jnp.float32)
+        self.event_dim = event_dim
+
+    def _forward_compute(self, x):
+        return self.loc + self.scale * x
+
+    def _inverse_compute(self, y):
+        return (y - self.loc) / self.scale
+
+    def log_det_jacobian(self, x, y):  # noqa: ARG002
+        x = jnp.asarray(as_jax(x))
+        ldj = jnp.broadcast_to(jnp.log(jnp.abs(self.scale)), x.shape)
+        return wrap(sum_right_most(ldj, self.event_dim))
+
+    @property
+    def sign(self):
+        return jnp.sign(self.scale)
+
+
+class PowerTransform(Transformation):
+    bijective = True
+    sign = 1
+
+    def __init__(self, exponent):
+        super().__init__()
+        self.exponent = jnp.asarray(as_jax(exponent), jnp.float32)
+
+    def _forward_compute(self, x):
+        return jnp.power(x, self.exponent)
+
+    def _inverse_compute(self, y):
+        return jnp.power(y, 1.0 / self.exponent)
+
+    def log_det_jacobian(self, x, y):
+        x = jnp.asarray(as_jax(x))
+        y = jnp.asarray(as_jax(y))
+        return wrap(jnp.log(jnp.abs(self.exponent * y / x)))
+
+
+class SigmoidTransform(Transformation):
+    bijective = True
+    sign = 1
+
+    def _forward_compute(self, x):
+        return 1.0 / (1.0 + jnp.exp(-x))
+
+    def _inverse_compute(self, y):
+        return jnp.log(y) - jnp.log1p(-y)
+
+    def log_det_jacobian(self, x, y):  # noqa: ARG002
+        x = jnp.asarray(as_jax(x))
+        return wrap(-jnp.logaddexp(0.0, x) - jnp.logaddexp(0.0, -x))
+
+
+class SoftmaxTransform(Transformation):
+    """Coordinate-wise exp then normalize — not bijective; log-det
+    undefined (matches reference SoftmaxTransform)."""
+
+    event_dim = 1
+
+    def _forward_compute(self, x):
+        z = x - jnp.max(x, axis=-1, keepdims=True)
+        e = jnp.exp(z)
+        return e / jnp.sum(e, axis=-1, keepdims=True)
+
+    def _inverse_compute(self, y):
+        return jnp.log(y)
+
+
+class AbsTransform(Transformation):
+    def _forward_compute(self, x):
+        return jnp.abs(x)
+
+    def _inverse_compute(self, y):
+        return y
+
+
+class _StickBreakingTransform(Transformation):
+    """Real^{K-1} → simplex^K, used by transform_to(Simplex)."""
+
+    bijective = True
+    event_dim = 1
+
+    def _forward_compute(self, x):
+        offset = x.shape[-1] - jnp.arange(x.shape[-1], dtype=x.dtype)
+        z = 1.0 / (1.0 + jnp.exp(-(x - jnp.log(offset))))
+        z_cumprod = jnp.cumprod(1 - z, axis=-1)
+        pad_z = jnp.pad(z, [(0, 0)] * (z.ndim - 1) + [(0, 1)],
+                        constant_values=1.0)
+        pad_cum = jnp.pad(z_cumprod, [(0, 0)] * (z.ndim - 1) + [(1, 0)],
+                          constant_values=1.0)
+        return pad_z * pad_cum
+
+    def _inverse_compute(self, y):
+        y_crop = y[..., :-1]
+        offset = y.shape[-1] - 1 - jnp.arange(y_crop.shape[-1],
+                                              dtype=y.dtype)
+        rest = 1 - jnp.cumsum(y_crop, axis=-1)
+        prev_rest = jnp.pad(rest[..., :-1],
+                            [(0, 0)] * (y.ndim - 1) + [(1, 0)],
+                            constant_values=1.0)
+        z = y_crop / prev_rest
+        return jnp.log(z / (1 - z)) + jnp.log(offset)
+
+
+# -- domain maps (reference: transformation/domain_map.py) ---------------
+
+def domain_map(constraint):
+    """Return a Transformation mapping unconstrained reals onto the
+    support described by `constraint`."""
+    if isinstance(constraint, C.Real):
+        class _Identity(Transformation):
+            bijective = True
+            sign = 1
+
+            def _forward_compute(self, x):
+                return x
+
+            def _inverse_compute(self, y):
+                return y
+
+            def log_det_jacobian(self, x, y):  # noqa: ARG002
+                return wrap(jnp.zeros_like(jnp.asarray(as_jax(x))))
+        return _Identity()
+    if isinstance(constraint, (C.Positive, C.NonNegative)):
+        return ExpTransform()
+    if isinstance(constraint, C.GreaterThan):
+        return ComposeTransform(
+            [ExpTransform(), AffineTransform(constraint.lower, 1.0)])
+    if isinstance(constraint, C.LessThan):
+        return ComposeTransform(
+            [ExpTransform(), AffineTransform(constraint.upper, -1.0)])
+    if isinstance(constraint, C.UnitInterval):
+        return SigmoidTransform()
+    if isinstance(constraint, C.Interval):
+        return ComposeTransform(
+            [SigmoidTransform(),
+             AffineTransform(constraint.lower,
+                             constraint.upper - constraint.lower)])
+    if isinstance(constraint, C.Simplex):
+        return _StickBreakingTransform()
+    raise NotImplementedError(
+        f"No domain map registered for {type(constraint).__name__}")
+
+
+biject_to = domain_map
+transform_to = domain_map

@@ -1,0 +1,131 @@
+"""TransformedDistribution and Independent.
+
+Reference surface: distributions/transformed_distribution.py (log_prob via
+inverse transforms + log-det-Jacobian chain; sample pushes base samples
+through the transforms) and independent.py (reinterpret rightmost batch
+dims as event dims).
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from .distribution import Distribution
+from .transformation import Transformation
+from .utils import as_jax, sum_right_most, wrap
+
+__all__ = ["TransformedDistribution", "Independent"]
+
+
+class TransformedDistribution(Distribution):
+    def __init__(self, base_dist, transforms, validate_args=None):
+        self.base_dist = base_dist
+        if isinstance(transforms, Transformation):
+            transforms = [transforms]
+        self.transforms = list(transforms)
+        event_dim = max([base_dist.event_dim or 0]
+                        + [t.event_dim for t in self.transforms])
+        super().__init__(event_dim=event_dim, validate_args=validate_args)
+
+    @property
+    def has_grad(self):
+        return self.base_dist.has_grad
+
+    def _batch_shape(self):
+        return self.base_dist._batch_shape()
+
+    def sample(self, size=None):
+        x = self.base_dist.sample(size)
+        for t in self.transforms:
+            x = t(x)
+        return x
+
+    def sample_n(self, size):
+        x = self.base_dist.sample_n(size)
+        for t in self.transforms:
+            x = t(x)
+        return x
+
+    def log_prob(self, value):
+        log_prob = 0.0
+        y = jnp.asarray(as_jax(value))
+        event_dim = self.event_dim
+        # walk the transform chain backwards, accumulating -log|J|
+        for t in reversed(self.transforms):
+            x = as_jax(t._inv_call(y))
+            ldj = as_jax(t.log_det_jacobian(x, y))
+            log_prob = log_prob - sum_right_most(ldj,
+                                                 event_dim - t.event_dim)
+            y = x
+        base_lp = as_jax(self.base_dist.log_prob(wrap(y)))
+        log_prob = log_prob + sum_right_most(
+            base_lp, event_dim - (self.base_dist.event_dim or 0))
+        return wrap(log_prob)
+
+    def cdf(self, value):
+        y = jnp.asarray(as_jax(value))
+        sign = 1
+        for t in reversed(self.transforms):
+            y = as_jax(t._inv_call(y))
+            sign = sign * t.sign
+        base_cdf = as_jax(self.base_dist.cdf(wrap(y)))
+        return wrap(sign * (base_cdf - 0.5) + 0.5)
+
+    def icdf(self, value):
+        p = jnp.asarray(as_jax(value))
+        sign = 1
+        for t in self.transforms:
+            sign = sign * t.sign
+        p = sign * (p - 0.5) + 0.5
+        x = self.base_dist.icdf(wrap(p))
+        for t in self.transforms:
+            x = t(x)
+        return x
+
+
+class Independent(Distribution):
+    r"""Reinterpret the rightmost `reinterpreted_batch_ndims` batch dims of
+    `base` as event dims: log_prob sums over them."""
+
+    def __init__(self, base, reinterpreted_batch_ndims,
+                 validate_args=None):
+        self.base_dist = base
+        self.reinterpreted_batch_ndims = int(reinterpreted_batch_ndims)
+        event_dim = (base.event_dim or 0) + self.reinterpreted_batch_ndims
+        super().__init__(event_dim=event_dim, validate_args=validate_args)
+
+    @property
+    def has_grad(self):
+        return self.base_dist.has_grad
+
+    def _batch_shape(self):
+        b = self.base_dist._batch_shape()
+        return b[:len(b) - self.reinterpreted_batch_ndims]
+
+    def log_prob(self, value):
+        lp = as_jax(self.base_dist.log_prob(value))
+        return wrap(sum_right_most(lp, self.reinterpreted_batch_ndims))
+
+    def sample(self, size=None):
+        if size is None:
+            return self.base_dist.sample(None)
+        size = self._size(size)
+        tail = self.base_dist._batch_shape()[
+            len(self.base_dist._batch_shape())
+            - self.reinterpreted_batch_ndims:]
+        return self.base_dist.sample(tuple(size) + tuple(tail))
+
+    def sample_n(self, size):
+        n = self._size(size) or ()
+        return self.base_dist.sample_n(n)
+
+    @property
+    def mean(self):
+        return self.base_dist.mean
+
+    @property
+    def variance(self):
+        return self.base_dist.variance
+
+    def entropy(self):
+        ent = as_jax(self.base_dist.entropy())
+        return wrap(sum_right_most(ent, self.reinterpreted_batch_ndims))

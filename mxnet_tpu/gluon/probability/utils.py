@@ -1,0 +1,71 @@
+"""Shared helpers for gluon.probability.
+
+Reference surface: python/mxnet/gluon/probability/distributions/utils.py
+(prob2logit/logit2prob/getF/cached_property). TPU re-design: distributions
+compute directly on jax arrays (XLA fuses the elementwise math); the
+NDArray wrapper is applied at the public API boundary.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ...ndarray.ndarray import NDArray
+
+__all__ = ["prob2logit", "logit2prob", "cached_property", "as_jax", "wrap",
+           "sum_right_most"]
+
+
+def as_jax(x):
+    """Unwrap NDArray / python scalar to a jax value."""
+    if isinstance(x, NDArray):
+        return x._data
+    return x
+
+
+def wrap(x):
+    """Wrap a jax array as the framework NDArray."""
+    return NDArray(jnp.asarray(x))
+
+
+def prob2logit(prob, binary=True):
+    """Convert probability to logit (log-odds for binary, log-prob otherwise)."""
+    prob = jnp.asarray(as_jax(prob))
+    eps = jnp.finfo(jnp.result_type(prob, jnp.float32)).tiny
+    prob = jnp.clip(prob, eps, 1.0 - eps if binary else 1.0)
+    if binary:
+        return jnp.log(prob) - jnp.log1p(-prob)
+    return jnp.log(prob)
+
+
+def logit2prob(logit, binary=True):
+    """Convert logit back to probability."""
+    logit = jnp.asarray(as_jax(logit))
+    if binary:
+        return 1.0 / (1.0 + jnp.exp(-logit))
+    return jnp.exp(logit - jnp.max(logit, axis=-1, keepdims=True)) / jnp.sum(
+        jnp.exp(logit - jnp.max(logit, axis=-1, keepdims=True)), axis=-1,
+        keepdims=True)
+
+
+def sum_right_most(x, ndim):
+    """Sum over the rightmost `ndim` axes (event-dim reduction)."""
+    if ndim == 0:
+        return x
+    return jnp.sum(x, axis=tuple(range(-ndim, 0)))
+
+
+class cached_property:
+    """Descriptor caching a derived parameter on first access
+    (reference: distributions/utils.py cached_property)."""
+
+    def __init__(self, func):
+        self._func = func
+        self.__doc__ = getattr(func, "__doc__", None)
+        self._name = func.__name__
+
+    def __get__(self, obj, objtype=None):
+        if obj is None:
+            return self
+        value = self._func(obj)
+        obj.__dict__[self._name] = value
+        return value

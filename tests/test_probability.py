@@ -1,0 +1,405 @@
+"""gluon.probability tests.
+
+Mirrors the reference's tests/python/unittest/test_gluon_probability_v2.py
+strategy: log_prob checked against scipy.stats as the numeric oracle,
+sampling shapes, moment formulas, KL identities (KL(p||p)=0, closed form
+vs Monte-Carlo), transformed distributions, StochasticBlock loss capture.
+"""
+import numpy as onp
+import pytest
+import scipy.stats as ss
+
+import mxnet_tpu as mx
+from mxnet_tpu.gluon import probability as mgp
+
+
+@pytest.fixture(autouse=True)
+def _seed():
+    mx.seed(7)
+
+
+def _np(x):
+    return onp.asarray(x.asnumpy() if hasattr(x, "asnumpy") else x)
+
+
+SCIPY_ORACLES = [
+    # (dist factory, scipy logpdf fn, sample domain transform)
+    (lambda: mgp.Normal(1.5, 2.0),
+     lambda v: ss.norm.logpdf(v, 1.5, 2.0), lambda u: u * 4 - 2),
+    (lambda: mgp.Laplace(0.5, 1.5),
+     lambda v: ss.laplace.logpdf(v, 0.5, 1.5), lambda u: u * 4 - 2),
+    (lambda: mgp.Cauchy(0.0, 2.0),
+     lambda v: ss.cauchy.logpdf(v, 0.0, 2.0), lambda u: u * 4 - 2),
+    (lambda: mgp.Exponential(2.0),
+     lambda v: ss.expon.logpdf(v, scale=2.0), lambda u: u * 3 + 0.1),
+    (lambda: mgp.Gamma(3.0, 2.0),
+     lambda v: ss.gamma.logpdf(v, 3.0, scale=2.0), lambda u: u * 3 + 0.1),
+    (lambda: mgp.Beta(2.0, 3.0),
+     lambda v: ss.beta.logpdf(v, 2.0, 3.0), lambda u: u * 0.98 + 0.01),
+    (lambda: mgp.Chi2(4.0),
+     lambda v: ss.chi2.logpdf(v, 4.0), lambda u: u * 3 + 0.1),
+    (lambda: mgp.StudentT(5.0, 0.5, 2.0),
+     lambda v: ss.t.logpdf(v, 5.0, 0.5, 2.0), lambda u: u * 4 - 2),
+    (lambda: mgp.Gumbel(0.5, 2.0),
+     lambda v: ss.gumbel_r.logpdf(v, 0.5, 2.0), lambda u: u * 4 - 2),
+    (lambda: mgp.Weibull(2.0, 1.5),
+     lambda v: ss.weibull_min.logpdf(v, 2.0, scale=1.5),
+     lambda u: u * 3 + 0.1),
+    (lambda: mgp.Pareto(3.0, 1.0),
+     lambda v: ss.pareto.logpdf(v, 3.0, scale=1.0),
+     lambda u: u * 3 + 1.01),
+    (lambda: mgp.HalfNormal(2.0),
+     lambda v: ss.halfnorm.logpdf(v, scale=2.0), lambda u: u * 3 + 0.1),
+    (lambda: mgp.HalfCauchy(2.0),
+     lambda v: ss.halfcauchy.logpdf(v, scale=2.0), lambda u: u * 3 + 0.1),
+    (lambda: mgp.Uniform(-1.0, 3.0),
+     lambda v: ss.uniform.logpdf(v, -1.0, 4.0), lambda u: u * 3.8 - 0.9),
+    (lambda: mgp.FisherSnedecor(6.0, 8.0),
+     lambda v: ss.f.logpdf(v, 6.0, 8.0), lambda u: u * 3 + 0.1),
+]
+
+
+@pytest.mark.parametrize("factory,oracle,domain", SCIPY_ORACLES,
+                         ids=[f[0]().__class__.__name__
+                              for f in SCIPY_ORACLES])
+def test_continuous_log_prob_oracle(factory, oracle, domain):
+    d = factory()
+    u = onp.linspace(0.01, 0.99, 13)
+    v = domain(u)
+    got = _np(d.log_prob(mx.np.array(v)))
+    want = oracle(v)
+    onp.testing.assert_allclose(got, want, rtol=2e-4, atol=2e-5)
+
+
+@pytest.mark.parametrize("factory,mean,var", [
+    (lambda: mgp.Normal(1.0, 2.0), 1.0, 4.0),
+    (lambda: mgp.Exponential(0.5), 0.5, 0.25),
+    (lambda: mgp.Gamma(3.0, 2.0), 6.0, 12.0),
+    (lambda: mgp.Bernoulli(prob=0.3), 0.3, 0.21),
+    (lambda: mgp.Poisson(4.0), 4.0, 4.0),
+    (lambda: mgp.Uniform(0.0, 2.0), 1.0, 1.0 / 3),
+    (lambda: mgp.Geometric(prob=0.25), 3.0, 12.0),
+])
+def test_moments(factory, mean, var):
+    d = factory()
+    onp.testing.assert_allclose(_np(d.mean), mean, rtol=1e-5)
+    onp.testing.assert_allclose(_np(d.variance), var, rtol=1e-5)
+
+
+def test_sampling_shapes_and_law():
+    d = mgp.Normal(mx.np.zeros((3,)), mx.np.ones((3,)))
+    assert d.sample().shape == (3,)
+    assert d.sample((500, 3)).shape == (500, 3)
+    assert d.sample_n((500,)).shape == (500, 3)
+    s = _np(d.sample((4000, 3)))
+    assert abs(s.mean()) < 0.1
+    assert abs(s.std() - 1.0) < 0.1
+
+
+def test_discrete_log_prob_oracle():
+    k = onp.arange(0, 10).astype(onp.float64)
+    pairs = [
+        (mgp.Poisson(3.5), ss.poisson.logpmf(k, 3.5)),
+        (mgp.Geometric(prob=0.3), ss.geom.logpmf(k + 1, 0.3)),
+        (mgp.Binomial(9, prob=0.4), ss.binom.logpmf(k, 9, 0.4)),
+        (mgp.NegativeBinomial(5.0, prob=0.6), ss.nbinom.logpmf(k, 5, 0.6)),
+    ]
+    for d, want in pairs:
+        got = _np(d.log_prob(mx.np.array(k)))
+        onp.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-5)
+
+
+def test_bernoulli_logit_prob_duality():
+    logit = onp.array([-2.0, 0.0, 1.5])
+    d1 = mgp.Bernoulli(logit=logit)
+    d2 = mgp.Bernoulli(prob=1 / (1 + onp.exp(-logit)))
+    v = onp.array([1.0, 0.0, 1.0])
+    onp.testing.assert_allclose(_np(d1.log_prob(mx.np.array(v))),
+                                _np(d2.log_prob(mx.np.array(v))),
+                                rtol=1e-5)
+    with pytest.raises(ValueError):
+        mgp.Bernoulli(prob=0.5, logit=0.0)
+
+
+def test_categorical():
+    probs = onp.array([0.1, 0.2, 0.3, 0.4])
+    d = mgp.Categorical(4, prob=mx.np.array(probs))
+    lp = _np(d.log_prob(mx.np.array([0.0, 3.0])))
+    onp.testing.assert_allclose(lp, onp.log(probs[[0, 3]]), rtol=1e-5)
+    s = _np(d.sample((8000,)))
+    freq = onp.bincount(s.astype(int), minlength=4) / 8000
+    onp.testing.assert_allclose(freq, probs, atol=0.03)
+    ent = _np(d.entropy())
+    onp.testing.assert_allclose(ent, -(probs * onp.log(probs)).sum(),
+                                rtol=1e-5)
+    assert _np(d.enumerate_support()).tolist() == [0.0, 1.0, 2.0, 3.0]
+
+
+def test_one_hot_and_multinomial():
+    d = mgp.OneHotCategorical(3, prob=mx.np.array([0.2, 0.3, 0.5]))
+    s = _np(d.sample((100,)))
+    assert s.shape == (100, 3)
+    onp.testing.assert_allclose(s.sum(-1), onp.ones(100))
+
+    m = mgp.Multinomial(3, prob=mx.np.array([0.2, 0.3, 0.5]),
+                        total_count=10)
+    sm = _np(m.sample())
+    assert sm.shape == (3,)
+    assert sm.sum() == 10
+    v = onp.array([2.0, 3.0, 5.0])
+    want = ss.multinomial.logpmf(v, 10, [0.2, 0.3, 0.5])
+    onp.testing.assert_allclose(_np(m.log_prob(mx.np.array(v))), want,
+                                rtol=1e-5)
+
+
+def test_mvn():
+    cov = onp.array([[2.0, 0.5], [0.5, 1.0]])
+    loc = onp.array([1.0, -1.0])
+    d = mgp.MultivariateNormal(mx.np.array(loc), cov=mx.np.array(cov))
+    v = onp.array([0.3, 0.7])
+    want = ss.multivariate_normal.logpdf(v, loc, cov)
+    onp.testing.assert_allclose(_np(d.log_prob(mx.np.array(v))), want,
+                                rtol=1e-4)
+    onp.testing.assert_allclose(_np(d.entropy()),
+                                ss.multivariate_normal(loc, cov).entropy(),
+                                rtol=1e-5)
+    s = _np(d.sample((5000,)))
+    assert s.shape == (5000, 2)
+    onp.testing.assert_allclose(s.mean(0), loc, atol=0.1)
+    onp.testing.assert_allclose(onp.cov(s.T), cov, atol=0.15)
+    # scale_tril / precision parameterizations agree
+    d2 = mgp.MultivariateNormal(mx.np.array(loc),
+                                scale_tril=mx.np.array(
+                                    onp.linalg.cholesky(cov)))
+    d3 = mgp.MultivariateNormal(mx.np.array(loc),
+                                precision=mx.np.array(
+                                    onp.linalg.inv(cov)))
+    for alt in (d2, d3):
+        onp.testing.assert_allclose(_np(alt.log_prob(mx.np.array(v))),
+                                    want, rtol=1e-4)
+
+
+def test_dirichlet():
+    alpha = onp.array([2.0, 3.0, 5.0])
+    d = mgp.Dirichlet(mx.np.array(alpha))
+    v = onp.array([0.2, 0.3, 0.5])
+    onp.testing.assert_allclose(_np(d.log_prob(mx.np.array(v))),
+                                ss.dirichlet.logpdf(v, alpha), rtol=1e-4)
+    s = _np(d.sample((1000,)))
+    onp.testing.assert_allclose(s.sum(-1), onp.ones(1000), rtol=1e-5)
+    onp.testing.assert_allclose(s.mean(0), alpha / alpha.sum(), atol=0.05)
+
+
+def test_entropy_matches_scipy():
+    checks = [
+        (mgp.Normal(0.0, 2.0), ss.norm.entropy(0.0, 2.0)),
+        (mgp.Exponential(2.0), ss.expon.entropy(scale=2.0)),
+        (mgp.Gamma(3.0, 2.0), ss.gamma.entropy(3.0, scale=2.0)),
+        (mgp.Beta(2.0, 3.0), ss.beta.entropy(2.0, 3.0)),
+        (mgp.Gumbel(0.0, 2.0), ss.gumbel_r.entropy(0.0, 2.0)),
+        (mgp.Uniform(0.0, 4.0), ss.uniform.entropy(0.0, 4.0)),
+    ]
+    for d, want in checks:
+        onp.testing.assert_allclose(_np(d.entropy()), want, rtol=1e-4)
+
+
+def test_exponential_family_entropy_via_bregman():
+    # ExponentialFamily.entropy (autodiff of the log-normalizer) must agree
+    # with the closed form for Normal
+    d = mgp.Normal(1.0, 3.0)
+    closed = _np(d.entropy())
+    bregman = _np(mgp.ExponentialFamily.entropy(d))
+    onp.testing.assert_allclose(bregman, closed, rtol=1e-5)
+
+
+def test_kl_divergence():
+    p = mgp.Normal(0.0, 1.0)
+    q = mgp.Normal(1.0, 2.0)
+    onp.testing.assert_allclose(_np(mgp.kl_divergence(p, p)), 0.0,
+                                atol=1e-6)
+    want = onp.log(2.0) + (1 + 1) / (2 * 4) - 0.5
+    onp.testing.assert_allclose(_np(mgp.kl_divergence(p, q)), want,
+                                rtol=1e-5)
+    # closed form vs Monte-Carlo
+    mc = _np(mgp.empirical_kl(p, q, n_samples=30000))
+    onp.testing.assert_allclose(mc, want, atol=0.05)
+    # a few more registered pairs sanity: KL(p||p) == 0
+    for d in [mgp.Gamma(3.0, 2.0), mgp.Beta(2.0, 3.0),
+              mgp.Poisson(4.0), mgp.Laplace(0.0, 1.0),
+              mgp.Dirichlet(mx.np.array([1.0, 2.0, 3.0])),
+              mgp.Bernoulli(prob=0.3),
+              mgp.Categorical(3, prob=mx.np.array([0.2, 0.3, 0.5]))]:
+        onp.testing.assert_allclose(_np(mgp.kl_divergence(d, d)), 0.0,
+                                    atol=1e-5)
+    with pytest.raises(NotImplementedError):
+        mgp.kl_divergence(p, mgp.Gamma(1.0, 1.0))
+
+
+def test_register_kl_custom():
+    class MyNormal(mgp.Normal):
+        pass
+
+    # subclass dispatch falls back to the Normal-Normal registration
+    out = mgp.kl_divergence(MyNormal(0.0, 1.0), mgp.Normal(0.0, 1.0))
+    onp.testing.assert_allclose(_np(out), 0.0, atol=1e-6)
+
+
+def test_transformed_distribution_lognormal():
+    base = mgp.Normal(0.5, 0.8)
+    d = mgp.TransformedDistribution(base, mgp.ExpTransform())
+    v = onp.array([0.5, 1.0, 2.5])
+    want = ss.lognorm.logpdf(v, 0.8, scale=onp.exp(0.5))
+    onp.testing.assert_allclose(_np(d.log_prob(mx.np.array(v))), want,
+                                rtol=1e-4)
+    s = _np(d.sample((2000,)))
+    assert (s > 0).all()
+    # cdf through the chain
+    onp.testing.assert_allclose(_np(d.cdf(mx.np.array(v))),
+                                ss.lognorm.cdf(v, 0.8, scale=onp.exp(0.5)),
+                                rtol=1e-4)
+
+
+def test_affine_and_compose_transform():
+    base = mgp.Normal(0.0, 1.0)
+    t = mgp.ComposeTransform([mgp.AffineTransform(1.0, 2.0)])
+    d = mgp.TransformedDistribution(base, t)
+    v = onp.array([-1.0, 0.0, 2.0])
+    onp.testing.assert_allclose(_np(d.log_prob(mx.np.array(v))),
+                                ss.norm.logpdf(v, 1.0, 2.0), rtol=1e-4)
+    # inverse round-trip
+    x = mx.np.array([0.3, 0.9])
+    y = t(x)
+    onp.testing.assert_allclose(_np(t.inv(y)), _np(x), rtol=1e-5)
+
+
+def test_domain_map():
+    tr = mgp.biject_to(mgp.constraint.Positive())
+    x = mx.np.array([-2.0, 0.0, 3.0])
+    assert (_np(tr(x)) > 0).all()
+    tr2 = mgp.biject_to(mgp.constraint.Interval(2.0, 5.0))
+    y = _np(tr2(x))
+    assert ((y > 2.0) & (y < 5.0)).all()
+    tr3 = mgp.biject_to(mgp.constraint.Simplex())
+    z = _np(tr3(mx.np.array([[0.5, -0.3]])))
+    onp.testing.assert_allclose(z.sum(-1), 1.0, rtol=1e-5)
+    assert z.shape == (1, 3)
+
+
+def test_independent():
+    base = mgp.Normal(mx.np.zeros((4, 3)), mx.np.ones((4, 3)))
+    d = mgp.Independent(base, 1)
+    v = mx.np.zeros((4, 3))
+    lp = _np(d.log_prob(v))
+    assert lp.shape == (4,)
+    onp.testing.assert_allclose(lp, _np(base.log_prob(v)).sum(-1),
+                                rtol=1e-5)
+
+
+def test_broadcast_to():
+    d = mgp.Normal(0.0, 1.0).broadcast_to((3, 2))
+    assert d.sample().shape == (3, 2)
+    d2 = mgp.Bernoulli(prob=0.5).broadcast_to((4,))
+    assert d2.sample().shape == (4,)
+
+
+def test_constraint_validation():
+    with pytest.raises(ValueError):
+        mgp.Normal(0.0, -1.0, validate_args=True)
+    d = mgp.Bernoulli(prob=0.5, validate_args=True)
+    with pytest.raises(ValueError):
+        d.log_prob(mx.np.array([0.5]))  # not in {0,1}
+    # valid value passes
+    _ = d.log_prob(mx.np.array([1.0]))
+
+
+def test_relaxed_distributions():
+    d = mgp.RelaxedBernoulli(T=0.5, logit=mx.np.array([2.0, -1.0]))
+    s = _np(d.sample((100, 2)))
+    assert ((s > 0) & (s < 1)).all()
+    d2 = mgp.RelaxedOneHotCategorical(
+        T=0.5, logit=mx.np.array([1.0, 0.0, -1.0]))
+    s2 = _np(d2.sample((50,)))
+    onp.testing.assert_allclose(s2.sum(-1), onp.ones(50), rtol=1e-4)
+
+
+def test_relaxed_bernoulli_density():
+    # At T=1, logit=0 the BinConcrete density is Uniform(0,1): log p = 0
+    d = mgp.RelaxedBernoulli(T=1.0, logit=0.0)
+    onp.testing.assert_allclose(_np(d.log_prob(mx.np.array(0.5))), 0.0,
+                                atol=1e-5)
+    # density integrates to 1 (trapezoid over (0,1))
+    d2 = mgp.RelaxedBernoulli(T=0.7, logit=0.8)
+    v = onp.linspace(1e-4, 1 - 1e-4, 4001)
+    pdf = onp.exp(_np(d2.log_prob(mx.np.array(v))))
+    onp.testing.assert_allclose(onp.trapezoid(pdf, v), 1.0, atol=1e-2)
+
+
+def test_relaxed_onehot_density():
+    # At T=1, uniform logits over K=2, the Concrete density at the simplex
+    # midpoint is (K-1)! * prod p_k / (sum p_k x_k^{-1})^K * ... == 1
+    d = mgp.RelaxedOneHotCategorical(
+        T=1.0, logit=mx.np.array([0.0, 0.0]))
+    onp.testing.assert_allclose(
+        _np(d.log_prob(mx.np.array([0.5, 0.5]))), 0.0, atol=1e-5)
+    # K=2 Concrete on (x, 1-x) ≡ BinConcrete: densities must agree
+    db = mgp.RelaxedBernoulli(T=0.6, logit=0.9)
+    dc = mgp.RelaxedOneHotCategorical(
+        T=0.6, logit=mx.np.array([0.9, 0.0]))
+    x = onp.linspace(0.05, 0.95, 7)
+    lb = _np(db.log_prob(mx.np.array(x)))
+    lc = _np(dc.log_prob(mx.np.array(onp.stack([x, 1 - x], -1))))
+    onp.testing.assert_allclose(lb, lc, rtol=1e-4, atol=1e-5)
+
+
+def test_uniform_validate_args():
+    d = mgp.Uniform(0.0, 2.0, validate_args=True)  # must not raise
+    onp.testing.assert_allclose(_np(d.log_prob(mx.np.array(1.0))),
+                                -onp.log(2.0), rtol=1e-6)
+
+
+def test_pareto_out_of_support():
+    d = mgp.Pareto(3.0, 2.0)
+    assert _np(d.log_prob(mx.np.array(1.0))) == -onp.inf
+    assert _np(d.cdf(mx.np.array(1.0))) == 0.0
+
+
+def test_stochastic_block_vae_style():
+    np = mx.np
+
+    class Encoder(mgp.StochasticBlock):
+        @mgp.StochasticBlock.collectLoss
+        def forward(self, loc, scale):
+            qz = mgp.Normal(loc, scale)
+            pz = mgp.Normal(np.zeros(loc.shape), np.ones(scale.shape))
+            self.add_loss(mgp.kl_divergence(qz, pz))
+            return qz.sample()
+
+    enc = Encoder()
+    out = enc(np.zeros((2, 4)), np.ones((2, 4)))
+    assert out.shape == (2, 4)
+    assert len(enc.losses) == 1
+    onp.testing.assert_allclose(_np(enc.losses[0]), 0.0, atol=1e-6)
+
+    # undecorated forward raises
+    class Bad(mgp.StochasticBlock):
+        def forward(self, x):
+            return x
+
+    with pytest.raises(ValueError):
+        Bad()(np.ones((1,)))
+
+
+def test_stochastic_sequential():
+    np = mx.np
+
+    class AddKL(mgp.StochasticBlock):
+        @mgp.StochasticBlock.collectLoss
+        def forward(self, x):
+            self.add_loss(x.sum())
+            return x + 1
+
+    seq = mgp.StochasticSequential()
+    seq.add(AddKL(), AddKL())
+    out = seq(np.zeros((2,)))
+    onp.testing.assert_allclose(_np(out), [2.0, 2.0])
+    assert len(seq.losses) == 2
