@@ -237,18 +237,18 @@ class LayerNorm(HybridBlock):
 
     def __init__(self, axis=-1, epsilon=1e-5, center=True, scale=True,
                  beta_initializer="zeros", gamma_initializer="ones",
-                 in_channels=0):
+                 in_channels=0, dtype="float32"):
         super().__init__()
         self._axis = axis
         self._epsilon = epsilon
         self._center = center
         self._scale = scale
         self.gamma = Parameter("gamma", shape=(in_channels,),
-                               init=gamma_initializer,
+                               init=gamma_initializer, dtype=dtype,
                                allow_deferred_init=True,
                                differentiable=scale)
         self.beta = Parameter("beta", shape=(in_channels,),
-                              init=beta_initializer,
+                              init=beta_initializer, dtype=dtype,
                               allow_deferred_init=True,
                               differentiable=center)
 

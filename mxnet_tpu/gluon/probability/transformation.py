@@ -91,8 +91,10 @@ class TransformBlock(Transformation, HybridBlock):
     learnable parameters (normalizing-flow layers)."""
 
     def __init__(self, *args, **kwargs):
-        Transformation.__init__(self)
+        # HybridBlock must init first: Block.__setattr__ needs _children
+        # to exist before Transformation sets self._inv
         HybridBlock.__init__(self, *args, **kwargs)
+        Transformation.__init__(self)
 
 
 class ComposeTransform(Transformation):
@@ -266,6 +268,19 @@ class _StickBreakingTransform(Transformation):
                             constant_values=1.0)
         z = y_crop / prev_rest
         return jnp.log(z / (1 - z)) + jnp.log(offset)
+
+    def log_det_jacobian(self, x, y):  # noqa: ARG002
+        # dy_k/dx_k = z_k (1-z_k) prod_{j<k}(1-z_j), triangular Jacobian:
+        # log|det| = sum_k [log z_k + log(1-z_k) + sum_{j<k} log(1-z_j)]
+        x = jnp.asarray(as_jax(x))
+        offset = x.shape[-1] - jnp.arange(x.shape[-1], dtype=x.dtype)
+        t = x - jnp.log(offset)
+        # log z = -softplus(-t); log(1-z) = -softplus(t)
+        log_z = -jnp.logaddexp(0.0, -t)
+        log_1mz = -jnp.logaddexp(0.0, t)
+        prev_cum = jnp.pad(jnp.cumsum(log_1mz[..., :-1], axis=-1),
+                           [(0, 0)] * (x.ndim - 1) + [(1, 0)])
+        return wrap(jnp.sum(log_z + log_1mz + prev_cum, axis=-1))
 
 
 # -- domain maps (reference: transformation/domain_map.py) ---------------

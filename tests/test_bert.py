@@ -1,0 +1,137 @@
+"""BERT family tests (driver config #4 surface).
+
+Covers: forward shapes, attention masking semantics, hybridize parity,
+bf16 construction, tied MLM decoder, and a SQuAD-style fine-tune step
+that must reduce the span loss.
+"""
+import numpy as onp
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import autograd, gluon, np
+from mxnet_tpu.gluon.model_zoo.bert import (
+    BERTClassifier,
+    BERTForQA,
+    MultiHeadAttention,
+    get_bert_model,
+)
+
+
+@pytest.fixture(autouse=True)
+def _seed():
+    mx.seed(11)
+
+
+def _tiny_bert(**kw):
+    cfg = dict(num_layers=2, units=32, hidden_size=64, num_heads=4,
+               vocab_size=97, max_length=16, dropout=0.0)
+    cfg.update(kw)
+    return get_bert_model(**cfg)
+
+
+def test_shapes_and_pooler():
+    bert = _tiny_bert()
+    bert.initialize()
+    tok = np.random.randint(0, 97, (3, 10))
+    seq, pooled = bert(tok)
+    assert seq.shape == (3, 10, 32)
+    assert pooled.shape == (3, 32)
+
+
+def test_masking_ignores_padding():
+    bert = _tiny_bert()
+    bert.initialize()
+    tok = np.random.randint(0, 97, (1, 8))
+    vl = np.array([5])
+    seq1, _ = bert(tok, valid_length=vl)
+    # mutate the padded tail — valid positions must not change
+    tok2 = np.concatenate([tok[:, :5],
+                           np.random.randint(0, 97, (1, 3))], axis=1)
+    seq2, _ = bert(tok2, valid_length=vl)
+    onp.testing.assert_allclose(seq1[:, :5].asnumpy(),
+                                seq2[:, :5].asnumpy(), atol=1e-5)
+
+
+def test_hybridize_parity():
+    bert = _tiny_bert()
+    bert.initialize()
+    tok = np.random.randint(0, 97, (2, 8))
+    vl = np.array([8, 6])
+    seq_eager, pooled_eager = bert(tok, valid_length=vl)
+    bert.hybridize()
+    seq_jit, pooled_jit = bert(tok, valid_length=vl)
+    onp.testing.assert_allclose(seq_eager.asnumpy(), seq_jit.asnumpy(),
+                                rtol=1e-4, atol=1e-5)
+    onp.testing.assert_allclose(pooled_eager.asnumpy(),
+                                pooled_jit.asnumpy(), rtol=1e-4,
+                                atol=1e-5)
+
+
+def test_mlm_decoder_tied():
+    bert = _tiny_bert()
+    bert.initialize()
+    tok = np.random.randint(0, 97, (2, 8))
+    mp = np.array([[0, 3], [1, 2]])
+    _, _, mlm = bert(tok, masked_positions=mp)
+    assert mlm.shape == (2, 2, 97)
+    # decoder weight is tied: no separate (vocab, units) matrix
+    names = list(bert.collect_params())
+    vocab_mats = [n for n in names
+                  if bert.collect_params()[n].shape == (97, 32)]
+    assert len(vocab_mats) == 1  # word_embed only
+
+
+def test_bfloat16_forward():
+    bert = _tiny_bert(dtype="bfloat16")
+    bert.initialize()
+    tok = np.random.randint(0, 97, (2, 8))
+    seq, pooled = bert(tok)
+    assert "bfloat16" in str(seq.dtype)
+
+
+def test_multihead_attention_mask_shapes():
+    att = MultiHeadAttention(16, 4)
+    att.initialize()
+    x = np.random.uniform(size=(2, 6, 16))
+    assert att(x).shape == (2, 6, 16)
+    assert att(x, np.ones((2, 6))).shape == (2, 6, 16)
+
+
+def test_qa_finetune_step_learns():
+    bert = _tiny_bert()
+    qa = BERTForQA(bert)
+    qa.initialize()
+    loss_fn = gluon.loss.SoftmaxCrossEntropyLoss()
+    trainer = gluon.Trainer(qa.collect_params(), "adam",
+                            {"learning_rate": 5e-3})
+    tok = np.random.randint(0, 97, (4, 12))
+    start_y = np.array([1, 2, 3, 4])
+    end_y = np.array([5, 6, 7, 8])
+    first = None
+    for _ in range(8):
+        with autograd.record():
+            s_logits, e_logits = qa(tok)
+            loss = loss_fn(s_logits, start_y) + loss_fn(e_logits, end_y)
+        loss.backward()
+        trainer.step(4)
+        cur = float(loss.mean())
+        if first is None:
+            first = cur
+    assert cur < first * 0.7, (first, cur)
+
+
+def test_classifier_shapes():
+    bert = _tiny_bert()
+    cls = BERTClassifier(bert, num_classes=5)
+    cls.initialize()
+    tok = np.random.randint(0, 97, (3, 9))
+    assert cls(tok).shape == (3, 5)
+
+
+def test_bert_base_config():
+    m = get_bert_model("bert_12_768_12", vocab_size=1000, max_length=32,
+                       num_layers=1)  # override depth to keep test fast
+    m.initialize()
+    tok = np.random.randint(0, 1000, (1, 4))
+    seq, pooled = m(tok)
+    assert seq.shape == (1, 4, 768)

@@ -389,6 +389,49 @@ def test_stochastic_block_vae_style():
         Bad()(np.ones((1,)))
 
 
+def test_stochastic_block_hybridize():
+    np = mx.np
+
+    class Scaled(mgp.StochasticBlock):
+        @mgp.StochasticBlock.collectLoss
+        def forward(self, x):
+            self.add_loss((x ** 2).sum())
+            return x * 2
+
+    b = Scaled()
+    b.hybridize()
+    x = np.ones((3,))
+    for _ in range(3):  # second+ calls hit the jit cache
+        out = b(x)
+        onp.testing.assert_allclose(_np(out), [2.0, 2.0, 2.0])
+        assert len(b.losses) == 1
+        onp.testing.assert_allclose(_np(b.losses[0]), 3.0)
+
+
+def test_transform_block_instantiable():
+    tb = mgp.TransformBlock()
+    assert isinstance(tb, mgp.Transformation)
+
+
+def test_stick_breaking_log_det():
+    import jax
+    import jax.numpy as jnp
+
+    tr = mgp.biject_to(mgp.constraint.Simplex())
+    x = onp.array([0.3, -0.4, 0.8])
+    got = float(_np(tr.log_det_jacobian(mx.np.array(x), tr(mx.np.array(x)))))
+    # oracle: det of the (K-1)x(K-1) Jacobian of the first K-1 outputs
+    jac = jax.jacobian(lambda v: tr._forward_compute(v)[:-1])(jnp.asarray(x))
+    want = float(jnp.log(jnp.abs(jnp.linalg.det(jac))))
+    onp.testing.assert_allclose(got, want, rtol=1e-5)
+    # TransformedDistribution density on the simplex normalizes against
+    # Dirichlet(1,1,1) == uniform: log p of base pushforward is finite
+    base = mgp.Normal(mx.np.zeros((2,)), mx.np.ones((2,)))
+    d = mgp.TransformedDistribution(mgp.Independent(base, 1), tr)
+    lp = _np(d.log_prob(mx.np.array([0.2, 0.3, 0.5])))
+    assert onp.isfinite(lp)
+
+
 def test_stochastic_sequential():
     np = mx.np
 
