@@ -52,7 +52,7 @@ class MultiHeadAttention(HybridBlock):
         self.dropout = Dropout(dropout)
 
     def forward(self, x, mask=None):
-        # x: (B, S, D); mask: (B, S) 1=valid or (B, S, S) additive-ready
+        # x: (B, S, D); mask: (B, S) or (B, S, S), both 1=valid/0=masked
         b, s, _ = x.shape
         h, d = self._num_heads, self._head_dim
         qkv = self.qkv(x).reshape((b, s, 3, h, d))

@@ -47,8 +47,10 @@ class StochasticBlock(HybridBlock):
             # Under hybridize() a jit cache hit skips the Python forward,
             # so the decorator flag is not set; the compiled program still
             # returns the (output, losses) structure recorded at trace
-            # time, which is the real contract to check.
-            structured = (isinstance(out, (tuple, list)) and len(out) == 2
+            # time, which is the real contract to check. Eager calls always
+            # run the decorator, so an unset flag there means it's missing.
+            structured = (getattr(self, "_active", False)
+                          and isinstance(out, (tuple, list)) and len(out) == 2
                           and isinstance(out[1], (list, tuple)))
             if not structured:
                 raise ValueError(

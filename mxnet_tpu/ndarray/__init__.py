@@ -17,6 +17,7 @@ from ..numpy import (  # noqa: F401
     zeros_like,
 )
 from .ndarray import NDArray, apply_op, from_jax, waitall  # noqa: F401
+from .utils import load, save, savez  # noqa: F401
 
 concat = concatenate
 

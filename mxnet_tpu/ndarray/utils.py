@@ -1,0 +1,68 @@
+"""NDArray serialization: save/load of arrays, lists, and name→array dicts.
+
+Reference: python/mxnet/ndarray/utils.py:149-222 (`mx.nd.save/load` over the
+legacy binary format) and src/serialization/cnpy.cc (.npy/.npz zero-copy).
+TPU re-design: the container format IS .npz (numpy's zip-of-npy) — portable,
+inspectable, and loadable by plain numpy; single arrays round-trip as .npy.
+"""
+from __future__ import annotations
+
+import numpy as _np
+
+from .ndarray import NDArray, array
+
+__all__ = ["save", "load", "savez"]
+
+_LIST_PREFIX = "__mx_list__:"
+
+
+def _to_np(a):
+    if isinstance(a, NDArray):
+        return a.asnumpy()
+    return _np.asarray(a)
+
+
+def save(fname, data):
+    """Save an NDArray, a list of NDArrays, or a dict of str→NDArray.
+
+    Lists are stored with positional keys so load() restores a list.
+    """
+    if isinstance(data, NDArray):
+        if fname.endswith(".npy"):
+            _np.save(fname, _to_np(data))
+            return
+        data = [data]
+    if isinstance(data, (list, tuple)):
+        payload = {f"{_LIST_PREFIX}{i}": _to_np(a) for i, a in enumerate(data)}
+    elif isinstance(data, dict):
+        payload = {k: _to_np(v) for k, v in data.items()}
+    else:
+        raise ValueError(
+            "save expects NDArray, list of NDArray, or dict of str->NDArray,"
+            f" got {type(data)}")
+    with open(fname, "wb") as f:  # honor the exact path (savez would append .npz)
+        _np.savez(f, **payload)
+
+
+def savez(fname, *args, **kwargs):
+    """npx.savez parity: positional arrays stored as arr_0.. like numpy
+    (and like numpy, appends .npz when the name has no extension)."""
+    payload = {f"arr_{i}": _to_np(a) for i, a in enumerate(args)}
+    payload.update({k: _to_np(v) for k, v in kwargs.items()})
+    _np.savez(fname, **payload)
+
+
+def load(fname):
+    """Load what save() wrote: returns NDArray, list, or dict to match."""
+    if fname.endswith(".npy"):
+        return array(_np.load(fname))
+    import os
+
+    if not os.path.exists(fname) and os.path.exists(fname + ".npz"):
+        fname = fname + ".npz"  # np.savez appends .npz when missing
+    with _np.load(fname) as z:
+        keys = list(z.keys())
+        if keys and all(k.startswith(_LIST_PREFIX) for k in keys):
+            items = sorted(keys, key=lambda k: int(k[len(_LIST_PREFIX):]))
+            return [array(z[k]) for k in items]
+        return {k: array(z[k]) for k in keys}

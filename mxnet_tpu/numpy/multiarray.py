@@ -81,6 +81,7 @@ bitwise_not cbrt ceil conj conjugate cos cosh degrees exp exp2 expm1 fabs
 floor invert isfinite isinf isnan isneginf isposinf log log10 log1p log2
 logical_not negative positive radians reciprocal rint sign signbit sin sinh
 sqrt square tan tanh trunc angle real imag i0 sinc nan_to_num
+acos acosh asin asinh atan atanh deg2rad rad2deg
 """.split()
 
 _BINARY = """
@@ -89,6 +90,7 @@ float_power floor_divide fmax fmin fmod gcd greater greater_equal heaviside
 hypot lcm ldexp left_shift less less_equal logaddexp logaddexp2 logical_and
 logical_or logical_xor maximum minimum mod multiply not_equal power remainder
 right_shift subtract true_divide divmod pow
+atan2 bitwise_left_shift bitwise_right_shift nextafter vecdot
 """.split()
 
 _REDUCE = """
@@ -125,6 +127,8 @@ nanquantile nanpercentile
 insert delete append resize trim_zeros
 fill_diagonal
 select piecewise
+permute_dims matrix_transpose unique_all unique_counts unique_inverse
+unique_values
 """.split()
 
 __all__ = ["ndarray", "array", "zeros", "ones", "empty", "full", "arange",
@@ -358,3 +362,71 @@ def may_share_memory(a, b, max_work=None):  # noqa: ARG001
 
 
 shares_memory = may_share_memory
+
+
+# --- aliases & misc (array-api names, legacy spellings) --------------------
+
+NAN = NaN = nan
+NINF = -_np.inf
+PINF = _np.inf
+NZERO = -0.0
+PZERO = 0.0
+
+round_ = _g.get("round")
+row_stack = _g.get("vstack")
+fix = _g.get("trunc")  # same semantics: round toward zero
+__all__ += ["fix"]
+_g["bool"] = _np.bool_
+
+
+def blackman(M, dtype=None, **kwargs):
+    return array(_np.blackman(M), dtype=dtype or _np.float32, **kwargs)
+
+
+def hamming(M, dtype=None, **kwargs):
+    return array(_np.hamming(M), dtype=dtype or _np.float32, **kwargs)
+
+
+def hanning(M, dtype=None, **kwargs):
+    return array(_np.hanning(M), dtype=dtype or _np.float32, **kwargs)
+
+
+def from_dlpack(x):
+    return NDArray(jnp.from_dlpack(x))
+
+
+def genfromtxt(*args, **kwargs):
+    return array(_np.genfromtxt(*args, **kwargs))
+
+
+def set_printoptions(*args, **kwargs):
+    _np.set_printoptions(*args, **kwargs)
+
+
+def diag_indices_from(arr):
+    x = arr._data if isinstance(arr, NDArray) else arr
+    return tuple(NDArray(i) for i in jnp.diag_indices_from(x))
+
+
+def tril_indices_from(arr, k=0):
+    x = arr._data if isinstance(arr, NDArray) else arr
+    return tuple(NDArray(i) for i in jnp.tril_indices_from(x, k))
+
+
+def triu_indices_from(arr, k=0):
+    x = arr._data if isinstance(arr, NDArray) else arr
+    return tuple(NDArray(i) for i in jnp.triu_indices_from(x, k))
+
+
+boolean_dtypes = (_np.bool_,)
+integer_dtypes = (_np.int8, _np.int16, _np.int32, _np.int64,
+                  _np.uint8, _np.uint16, _np.uint32, _np.uint64)
+floating_dtypes = (_np.float16, _np.float32, _np.float64)
+numeric_dtypes = integer_dtypes + floating_dtypes
+
+__all__ += ["boolean_dtypes", "integer_dtypes", "floating_dtypes",
+            "numeric_dtypes"]
+__all__ += ["NAN", "NaN", "NINF", "PINF", "NZERO", "PZERO", "round_",
+            "row_stack", "bool", "blackman", "hamming", "hanning",
+            "from_dlpack", "genfromtxt", "set_printoptions", "concat",
+            "diag_indices_from", "tril_indices_from", "triu_indices_from"]

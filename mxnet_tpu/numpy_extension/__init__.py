@@ -154,3 +154,236 @@ def is_np_shape():
 def use_np(func):
     """Decorator parity with npx.use_np — identity here."""
     return func
+
+
+# --- npx op extras (reference _npx_* ops beyond the NN nucleus) ------------
+import jax as _jax  # noqa: E402
+import jax.numpy as _jnp  # noqa: E402
+import numpy as _onp  # noqa: E402
+
+from ..ndarray.utils import load, save, savez  # noqa: F401,E402
+
+__all__ += [
+    "arange_like", "batch_dot", "bernoulli", "broadcast_like", "from_dlpack",
+    "from_numpy", "load", "save", "savez", "masked_softmax",
+    "masked_log_softmax", "normal_n", "uniform_n", "rnn", "seed",
+    "to_dlpack_for_read", "to_dlpack_for_write", "gelu",
+]
+
+
+def seed(s, ctx="all"):
+    from .. import seed as _seed
+
+    _seed(s, ctx)
+
+
+def from_numpy(ndarray_, zero_copy=True):  # noqa: ARG001
+    return NDArray(_jnp.asarray(_onp.asarray(ndarray_)))
+
+
+def from_dlpack(x):
+    return NDArray(_jnp.from_dlpack(x))
+
+
+def to_dlpack_for_read(x):
+    return x._data.__dlpack__()
+
+
+to_dlpack_for_write = to_dlpack_for_read
+
+
+def arange_like(data, start=0.0, step=1.0, repeat=1, axis=None):
+    """Reference: contrib arange_like — arange shaped like `data`."""
+
+    def pure(x):
+        if axis is None:
+            n = x.size
+            out = start + step * (_jnp.arange(n, dtype=x.dtype) // repeat
+                                  if repeat != 1 else _jnp.arange(n, dtype=x.dtype))
+            return out.reshape(x.shape)
+        n = x.shape[axis]
+        idx = _jnp.arange(n, dtype=x.dtype)
+        if repeat != 1:
+            idx = idx // repeat
+        return start + step * idx
+
+    return apply_op(pure, data, name="arange_like")
+
+
+def batch_dot(a, b, transpose_a=False, transpose_b=False):
+    """Batched matmul over leading batch dim (reference: batch_dot op)."""
+
+    def pure(x, y):
+        if transpose_a:
+            x = _jnp.swapaxes(x, -1, -2)
+        if transpose_b:
+            y = _jnp.swapaxes(y, -1, -2)
+        return _jnp.matmul(x, y)
+
+    return apply_op(pure, a, b, name="batch_dot")
+
+
+def broadcast_like(lhs, rhs, lhs_axes=None, rhs_axes=None):
+    def pure(x, y):
+        if lhs_axes is None:
+            return _jnp.broadcast_to(x, y.shape)
+        shape = list(x.shape)
+        for la, ra in zip(lhs_axes, rhs_axes):
+            shape[la] = y.shape[ra]
+        return _jnp.broadcast_to(x, tuple(shape))
+
+    return apply_op(pure, lhs, rhs, name="broadcast_like")
+
+
+def masked_softmax(data, mask, axis=-1, temperature=1.0):
+    def pure(x, m):
+        neg = _jnp.finfo(x.dtype).min
+        logits = _jnp.where(m.astype(bool), x / temperature, neg)
+        out = _jax.nn.softmax(logits, axis=axis)
+        return _jnp.where(m.astype(bool), out, 0.0).astype(x.dtype)
+
+    return apply_op(pure, data, mask, name="masked_softmax")
+
+
+def masked_log_softmax(data, mask, axis=-1, temperature=1.0):
+    def pure(x, m):
+        neg = _jnp.finfo(x.dtype).min
+        logits = _jnp.where(m.astype(bool), x / temperature, neg)
+        out = _jax.nn.log_softmax(logits, axis=axis)
+        return _jnp.where(m.astype(bool), out, neg).astype(x.dtype)
+
+    return apply_op(pure, data, mask, name="masked_log_softmax")
+
+
+def gelu(x, approximate=True):
+    return apply_op(lambda v: _jax.nn.gelu(v, approximate=approximate), x,
+                    name="gelu")
+
+
+def bernoulli(prob=None, logit=None, size=None, dtype=None):
+    if (prob is None) == (logit is None):
+        raise ValueError("pass exactly one of prob/logit")
+    key = _random.next_key()
+    p = prob if prob is not None else None
+
+    def pure(v):
+        pv = v if p is not None else _jax.nn.sigmoid(v)
+        shape = size if size is not None else pv.shape
+        draw = _jax.random.bernoulli(key, pv, shape=shape)
+        return draw.astype(dtype or "float32")
+
+    x = p if p is not None else logit
+    if isinstance(x, NDArray):
+        return apply_op(pure, x, name="bernoulli")
+    return NDArray(pure(_jnp.asarray(x)))
+
+
+def _sample_n(dist):
+    def fn(*params, shape=None, dtype="float32"):
+        key = _random.next_key()
+
+        def pure(*xs):
+            it = iter(xs)
+            ps = [next(it) if isinstance(p, NDArray) else _jnp.asarray(p)
+                  for p in params]
+            base = _jnp.broadcast_arrays(*ps)[0].shape
+            full = tuple(shape or ()) + base
+            if dist == "normal":
+                loc, scale = ps
+                return (loc + scale * _jax.random.normal(key, full)).astype(dtype)
+            low, high = ps
+            return _jax.random.uniform(
+                key, full, minval=low, maxval=high).astype(dtype)
+
+        nd = [p for p in params if isinstance(p, NDArray)]
+        if nd:
+            return apply_op(pure, *nd, name=f"{dist}_n")
+        return NDArray(pure())
+
+    return fn
+
+
+def normal_n(loc=0.0, scale=1.0, shape=None, dtype="float32"):
+    return _sample_n("normal")(loc, scale, shape=shape, dtype=dtype)
+
+
+def uniform_n(low=0.0, high=1.0, shape=None, dtype="float32"):
+    return _sample_n("uniform")(low, high, shape=shape, dtype=dtype)
+
+
+def rnn(data=None, parameters=None, state=None, state_cell=None, mode="lstm",
+        state_size=None, num_layers=1, bidirectional=False, p=0.0,
+        state_outputs=False, **kwargs):  # noqa: ARG001
+    """Fused multi-layer RNN on a packed parameter vector.
+
+    Reference: src/operator/rnn.cc / rnn-inl.h — one flat `parameters` vector
+    holding (all i2h/h2h weights, layer-major, direction-minor) then (all
+    biases, same order). TPU re-design: the time loop is a lax.scan per
+    layer/direction; the per-step gemms batch onto the MXU.
+    data: (T, N, I); state: (L*D, N, H); returns out (T, N, H*D)
+    (+ state outputs when state_outputs=True).
+    """
+    from ..gluon.rnn.rnn_layer import _rnn_step
+
+    H = int(state_size)
+    D = 2 if bidirectional else 1
+    G = {"lstm": 4, "gru": 3}.get(mode, 1)
+    step = _rnn_step(mode if mode != "rnn" else "rnn_tanh")
+    has_cell = mode == "lstm"
+    train_drop = p > 0 and is_training()
+    drop_key = _random.next_key() if train_drop else None
+
+    def pure(x, w, h0, *maybe_c):
+        c0 = maybe_c[0] if maybe_c else None
+        T, N, in_size = x.shape
+        # slice the packed vector: weights (layer-major), then biases
+        off = 0
+        wi_l, wh_l, bi_l, bh_l = [], [], [], []
+        for layer in range(num_layers):
+            isz = in_size if layer == 0 else H * D
+            for _ in range(D):
+                wi_l.append(w[off:off + G * H * isz].reshape(G * H, isz))
+                off += G * H * isz
+                wh_l.append(w[off:off + G * H * H].reshape(G * H, H))
+                off += G * H * H
+        for _ in range(num_layers * D):
+            bi_l.append(w[off:off + G * H])
+            off += G * H
+            bh_l.append(w[off:off + G * H])
+            off += G * H
+
+        def run_dir(seq, idx, reverse):
+            hc = (h0[idx],) if not has_cell else (h0[idx], c0[idx])
+            wi, wh, bi, bh = wi_l[idx], wh_l[idx], bi_l[idx], bh_l[idx]
+            xs = seq[::-1] if reverse else seq
+            carry, ys = _jax.lax.scan(
+                lambda c, xt: step(c, xt, wi, wh, bi, bh), hc, xs)
+            return carry, (ys[::-1] if reverse else ys)
+
+        seq = x
+        h_fin, c_fin = [], []
+        for layer in range(num_layers):
+            outs = []
+            for d in range(D):
+                idx = layer * D + d
+                carry, ys = run_dir(seq, idx, reverse=(d == 1))
+                outs.append(ys)
+                h_fin.append(carry[0])
+                if has_cell:
+                    c_fin.append(carry[1])
+            seq = outs[0] if D == 1 else _jnp.concatenate(outs, axis=-1)
+            if train_drop and layer < num_layers - 1:
+                keep = 1.0 - p
+                mask = _jax.random.bernoulli(
+                    _jax.random.fold_in(drop_key, layer), keep, seq.shape)
+                seq = _jnp.where(mask, seq / keep, 0.0).astype(seq.dtype)
+        outs = [seq, _jnp.stack(h_fin)]
+        if has_cell:
+            outs.append(_jnp.stack(c_fin))
+        return tuple(outs)
+
+    args = [data, parameters, state] + ([state_cell] if has_cell else [])
+    res = apply_op(pure, *args, name="rnn")
+    if state_outputs:
+        return res
+    return res[0]

@@ -1,0 +1,115 @@
+"""Extended np/npx surface: aliases, save/load, npx extras, fused rnn.
+
+Reference coverage model: tests/python/unittest/test_numpy_op.py and
+test_operator.py (rnn); numeric oracle is plain numpy.
+"""
+import os
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import autograd, npx
+
+
+def test_np_aliases_and_extras():
+    assert mx.np.acos(mx.np.array([1.0])).asnumpy()[0] == 0
+    assert np.allclose(mx.np.fix(mx.np.array([1.7, -1.7])).asnumpy(), [1, -1])
+    assert mx.np.vecdot(mx.np.array([1.0, 2.0]),
+                        mx.np.array([3.0, 4.0])).asnumpy() == 11
+    assert mx.np.hamming(5).shape == (5,)
+    assert mx.np.round_ is not None and mx.np.row_stack is not None
+    assert getattr(mx.np, "bool") is np.bool_
+    assert np.float32 in mx.np.floating_dtypes
+
+
+def test_nd_save_load_dict_and_list(tmp_path):
+    f = os.path.join(tmp_path, "t.npz")
+    mx.nd.save(f, {"a": mx.np.ones((2, 3)), "b": mx.np.zeros((4,))})
+    out = mx.nd.load(f)
+    assert set(out) == {"a", "b"}
+    assert out["a"].shape == (2, 3)
+    f2 = os.path.join(tmp_path, "l.npz")
+    mx.nd.save(f2, [mx.np.ones((2,)), mx.np.zeros((3,))])
+    lst = mx.nd.load(f2)
+    assert isinstance(lst, list) and lst[1].shape == (3,)
+    f3 = os.path.join(tmp_path, "z")
+    npx.savez(f3, mx.np.ones((2,)), named=mx.np.zeros((3,)))
+    z = mx.nd.load(f3 + ".npz")
+    assert z["arr_0"].shape == (2,) and z["named"].shape == (3,)
+
+
+def test_npx_batch_dot_masked_softmax():
+    a = mx.np.random.uniform(size=(2, 3, 4))
+    b = mx.np.random.uniform(size=(2, 4, 5))
+    out = npx.batch_dot(a, b)
+    assert out.shape == (2, 3, 5)
+    assert np.allclose(out.asnumpy(), a.asnumpy() @ b.asnumpy(), atol=1e-5)
+    outT = npx.batch_dot(a, mx.np.random.uniform(size=(2, 5, 4)),
+                         transpose_b=True)
+    assert outT.shape == (2, 3, 5)
+
+    m = mx.np.array([[1, 1, 0], [1, 0, 0]], dtype="float32")
+    x = mx.np.random.uniform(size=(2, 3))
+    s = npx.masked_softmax(x, m).asnumpy()
+    assert np.allclose(s.sum(-1), 1, atol=1e-5)
+    assert s[1, 2] == 0 and s[1, 1] == 0
+    ls = npx.masked_log_softmax(x, m).asnumpy()
+    assert np.allclose(np.exp(ls[0, :2]).sum(), 1, atol=1e-4)
+
+
+def test_npx_broadcast_arange_like_bernoulli():
+    assert npx.broadcast_like(mx.np.ones((1, 3)), mx.np.ones((5, 3))).shape \
+        == (5, 3)
+    assert npx.arange_like(mx.np.ones((2, 3)), axis=1).shape == (3,)
+    assert npx.arange_like(mx.np.ones((2, 3))).shape == (2, 3)
+    draws = npx.bernoulli(prob=mx.np.full((1000,), 0.7)).asnumpy()
+    assert 0.6 < draws.mean() < 0.8
+    assert npx.normal_n(mx.np.zeros((3,)), 1.0, shape=(5,)).shape == (5, 3)
+    assert npx.uniform_n(0.0, 1.0, shape=(4,)).shape == (4,)
+
+
+@pytest.mark.parametrize("mode,gates", [("rnn_tanh", 1), ("gru", 3),
+                                        ("lstm", 4)])
+def test_npx_fused_rnn_shapes_and_grad(mode, gates):
+    T, N, I, H, L = 4, 2, 3, 5, 2
+    G = gates
+    sizes = []
+    for layer in range(L):
+        isz = I if layer == 0 else H
+        sizes += [G * H * isz, G * H * H]
+    total = sum(sizes) + L * 2 * G * H
+    p = mx.np.random.normal(0, 0.1, size=(total,))
+    p.attach_grad()
+    x = mx.np.random.normal(0, 1, size=(T, N, I))
+    h0 = mx.np.zeros((L, N, H))
+    kw = dict(mode="lstm" if mode == "lstm" else mode,
+              state_size=H, num_layers=L)
+    if mode == "lstm":
+        kw["state_cell"] = mx.np.zeros((L, N, H))
+    if mode == "rnn_tanh":
+        kw["mode"] = "rnn_tanh"
+    with autograd.record():
+        out = npx.rnn(data=x, parameters=p, state=h0, **kw)
+        out.sum().backward()
+    assert out.shape == (T, N, H)
+    assert np.abs(p.grad.asnumpy()).sum() > 0
+
+
+def test_npx_fused_rnn_bidirectional():
+    T, N, I, H, L, G = 4, 2, 3, 5, 2, 4
+    sizes = []
+    for layer in range(L):
+        isz = I if layer == 0 else 2 * H
+        for _ in range(2):
+            sizes += [G * H * isz, G * H * H]
+    total = sum(sizes) + L * 2 * 2 * G * H
+    p = mx.np.random.normal(0, 0.1, size=(total,))
+    x = mx.np.random.normal(0, 1, size=(T, N, I))
+    h0 = mx.np.zeros((2 * L, N, H))
+    c0 = mx.np.zeros((2 * L, N, H))
+    out, hT, cT = npx.rnn(data=x, parameters=p, state=h0, state_cell=c0,
+                          mode="lstm", state_size=H, num_layers=L,
+                          bidirectional=True, state_outputs=True)
+    assert out.shape == (T, N, 2 * H)
+    assert hT.shape == (2 * L, N, H) and cT.shape == (2 * L, N, H)
