@@ -48,6 +48,11 @@ class Trainer:
             self._kvstore = kv_create(kvstore)
         else:
             self._kvstore = None
+        if compression_params is not None:
+            if self._kvstore is None:
+                raise ValueError(
+                    "compression_params requires a kvstore")
+            self._kvstore.set_gradient_compression(compression_params)
         self._update_on_kvstore = bool(update_on_kvstore) and \
             self._kvstore is not None
         if self._update_on_kvstore:

@@ -47,6 +47,29 @@ class KVStoreBase:
     def set_optimizer(self, optimizer):
         raise NotImplementedError
 
+    # -- gradient compression (shared by local + tpu_dist stores) ----------
+    _compression = None
+
+    def set_gradient_compression(self, compression_params):
+        """Enable 1-bit/2-bit gradient compression with error feedback
+        (reference: KVStore::SetGradientCompression,
+        src/kvstore/gradient_compression.cc)."""
+        from .gradient_compression import GradientCompression
+
+        params = dict(compression_params)
+        self._compression = GradientCompression(
+            type=params.pop("type", "2bit"), **params)
+
+    def _compress_vals(self, key, vals):
+        """Run each pushed value through quantize→dequantize with a
+        per-(key, slot) residual; identity when compression is off."""
+        if self._compression is None:
+            return vals
+        from ..ndarray.ndarray import NDArray
+
+        return [NDArray(self._compression.compress_pipeline(
+            f"{key}:{i}", v._data), v.device) for i, v in enumerate(vals)]
+
     def save_optimizer_states(self, fname, dump_optimizer=False):
         raise NotImplementedError
 

@@ -1,0 +1,120 @@
+"""Gradient compression: 1-bit / 2-bit quantization with error feedback.
+
+Reference: src/kvstore/gradient_compression.{h,cc,cu} (CompressionType at
+gradient_compression.h:37) — workers quantize gradients against a threshold
+before pushing to the parameter server, keeping the quantization error in a
+local residual that is added to the next gradient (error feedback), and the
+receiving side dequantizes.
+
+TPU re-design: one jitted pipeline per (shape, dtype) — residual add,
+threshold quantize, bit-pack into uint8 lanes (4×2-bit or 8×1-bit per byte),
+and the mirrored unpack+dequantize. The packed uint8 tensor is what crosses
+the wire (DCN, across hosts); XLA fuses the whole pipeline into a few
+elementwise kernels. Within one host/slice there is nothing to win — ICI
+moves bf16 faster than quantization costs — matching the reference, which
+also only compresses the worker→server hop.
+"""
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+__all__ = ["GradientCompression"]
+
+
+@functools.partial(jax.jit, static_argnames=("threshold",))
+def _compress_2bit(grad, residual, threshold):
+    g = grad + residual
+    q = jnp.where(g > threshold, jnp.int8(1),
+                  jnp.where(g < -threshold, jnp.int8(-1), jnp.int8(0)))
+    deq = q.astype(grad.dtype) * threshold
+    new_residual = g - deq
+    # pack 4 2-bit codes per uint8: map {-1,0,1} -> {2,0,1}
+    codes = jnp.where(q < 0, jnp.uint8(2), q.astype(jnp.uint8))
+    flat = codes.ravel()
+    pad = (-flat.shape[0]) % 4
+    flat = jnp.pad(flat, (0, pad))
+    lanes = flat.reshape(-1, 4)
+    packed = (lanes[:, 0] | (lanes[:, 1] << 2) | (lanes[:, 2] << 4)
+              | (lanes[:, 3] << 6))
+    return packed, new_residual
+
+
+@functools.partial(jax.jit, static_argnames=("threshold", "shape", "dtype"))
+def _decompress_2bit(packed, threshold, shape, dtype):
+    lanes = jnp.stack([(packed >> s) & 3 for s in (0, 2, 4, 6)], axis=1)
+    flat = lanes.ravel()
+    n = 1
+    for s in shape:
+        n *= s
+    codes = flat[:n].reshape(shape)
+    q = jnp.where(codes == 2, -1, codes.astype(jnp.int8)).astype(dtype)
+    return q * threshold
+
+
+@functools.partial(jax.jit, static_argnames=("threshold",))
+def _compress_1bit(grad, residual, threshold):
+    g = grad + residual
+    q = jnp.where(g >= 0, jnp.uint8(1), jnp.uint8(0))
+    deq = jnp.where(q == 1, threshold, -threshold).astype(grad.dtype)
+    new_residual = g - deq
+    flat = q.ravel()
+    pad = (-flat.shape[0]) % 8
+    flat = jnp.pad(flat, (0, pad))
+    lanes = flat.reshape(-1, 8)
+    packed = lanes[:, 0]
+    for i in range(1, 8):
+        packed = packed | (lanes[:, i] << i)
+    return packed, new_residual
+
+
+@functools.partial(jax.jit, static_argnames=("threshold", "shape", "dtype"))
+def _decompress_1bit(packed, threshold, shape, dtype):
+    lanes = jnp.stack([(packed >> i) & 1 for i in range(8)], axis=1)
+    flat = lanes.ravel()
+    n = 1
+    for s in shape:
+        n *= s
+    bits = flat[:n].reshape(shape)
+    return jnp.where(bits == 1, threshold, -threshold).astype(dtype)
+
+
+class GradientCompression:
+    """Stateful compressor: per-key error-feedback residuals.
+
+    compress(key, grad) -> packed uint8 payload (1/16 or 1/32 the fp32
+    bytes); decompress(key-agnostic) mirrors it. compress_pipeline() does
+    quantize→dequantize in one step for stores that aggregate locally.
+    """
+
+    def __init__(self, type="2bit", threshold=0.5):  # noqa: A002
+        if type not in ("1bit", "2bit"):
+            raise ValueError(f"compression type {type!r} not in 1bit/2bit")
+        self.type = type
+        self.threshold = float(threshold)
+        self._residuals = {}
+
+    def get_compression_factor(self):
+        return 16 if self.type == "2bit" else 32
+
+    def compress(self, key, grad):
+        """Quantize+pack `grad` (a jax array); updates the residual."""
+        res = self._residuals.get(key)
+        if res is None or res.shape != grad.shape:
+            res = jnp.zeros_like(grad)
+        fn = _compress_2bit if self.type == "2bit" else _compress_1bit
+        packed, new_res = fn(grad, res, threshold=self.threshold)
+        self._residuals[key] = new_res
+        return packed
+
+    def decompress(self, packed, shape, dtype):
+        fn = _decompress_2bit if self.type == "2bit" else _decompress_1bit
+        return fn(packed, threshold=self.threshold, shape=tuple(shape),
+                  dtype=jnp.dtype(dtype).name)
+
+    def compress_pipeline(self, key, grad):
+        """quantize→dequantize in one call (local aggregation path)."""
+        packed = self.compress(key, grad)
+        return self.decompress(packed, grad.shape, grad.dtype)

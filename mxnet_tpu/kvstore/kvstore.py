@@ -13,6 +13,10 @@ from __future__ import annotations
 
 import pickle
 
+import jax.numpy as jnp
+import numpy as _np
+
+from ..ndarray import sparse as _sparse
 from ..ndarray.ndarray import NDArray
 from .base import KVStoreBase
 
@@ -63,6 +67,10 @@ class KVStoreLocal(KVStoreBase):
             grouped = dict(zip(keys, (_aslist(v) for v in value)))
         for k, vals in grouped.items():
             k = str(k)
+            if any(isinstance(v, _sparse.BaseSparseNDArray) for v in vals):
+                self._push_sparse(k, vals)
+                continue
+            vals = self._compress_vals(k, vals)
             agg = vals[0]
             for v in vals[1:]:
                 agg = agg + v.as_in_ctx(agg.device)
@@ -75,6 +83,59 @@ class KVStoreLocal(KVStoreBase):
                                        self._updater_states[k])
             else:
                 self._store[k] = self._store.get(k, 0) + agg
+
+    def _push_sparse(self, k, vals):
+        """Aggregate row-sparse gradient pushes (reference: kvstore sparse
+        push over kRowSparseStorage — only touched embedding rows move)."""
+        agg = vals[0]
+        for v in vals[1:]:
+            agg = _sparse.add(agg, v)
+        if self._optimizer is not None:
+            w = self._store[k]
+            if k not in self._updater_states:
+                self._updater_states[k] = self._optimizer.create_state(
+                    _key_int(k), w)
+            grad = agg.todense() if isinstance(
+                agg, _sparse.BaseSparseNDArray) else agg
+            self._optimizer.update(_key_int(k), w, grad.as_in_ctx(w.device),
+                                   self._updater_states[k])
+        else:
+            stored = self._store.get(k)
+            self._store[k] = agg if stored is None else _sparse.add(
+                stored, agg)
+
+    def row_sparse_pull(self, key, out=None, priority=0, row_ids=None):  # noqa: ARG002
+        """Pull only the requested rows as a RowSparseNDArray
+        (reference: KVStore::PullRowSparse). The gather stays on device —
+        only the requested rows ever move."""
+        if row_ids is None:
+            raise ValueError("row_sparse_pull requires row_ids")
+        stored = self._store[str(key)]
+        ids = _np.unique(row_ids.asnumpy().astype("int64") if isinstance(
+            row_ids, NDArray) else _np.asarray(row_ids))
+        if isinstance(stored, _sparse.RowSparseNDArray):
+            # match requested ids against stored indices host-side (both
+            # small), then gather the data rows on device; missing ids → 0
+            stored_idx = _np.asarray(stored.indices)
+            order = _np.argsort(stored_idx)
+            pos = _np.searchsorted(stored_idx[order], ids)
+            pos = _np.clip(pos, 0, max(len(stored_idx) - 1, 0))
+            found = stored_idx[order][pos] == ids if len(stored_idx) else \
+                _np.zeros(len(ids), bool)
+            gathered = stored.data[order[pos]] if len(stored_idx) else \
+                jnp.zeros((len(ids),) + stored.data.shape[1:], stored.dtype)
+            rows = jnp.where(
+                jnp.asarray(found).reshape((-1,) + (1,) * (gathered.ndim - 1)),
+                gathered, 0)
+        elif isinstance(stored, _sparse.BaseSparseNDArray):
+            rows = stored.todense()._data[ids]
+        else:
+            rows = stored._data[ids]
+        rsp = _sparse.RowSparseNDArray(rows, ids, stored.shape, stored.dtype)
+        if out is not None:
+            for dest in _aslist(out):
+                dest.data, dest.indices = rsp.data, rsp.indices
+        return rsp
 
     def pull(self, key, out=None, priority=0, ignore_sparse=True):  # noqa: ARG002
         keys = _aslist(key)
@@ -93,6 +154,18 @@ class KVStoreLocal(KVStoreBase):
                               priority)
             return
         vals = _aslist(value)
+        if any(isinstance(v, _sparse.BaseSparseNDArray) for v in vals):
+            agg = vals[0]
+            for v in vals[1:]:
+                agg = _sparse.add(agg, v)
+            self._store[str(keys[0])] = agg
+            if out is not None:
+                dense = agg.todense() if isinstance(
+                    agg, _sparse.BaseSparseNDArray) else agg
+                for dest in _aslist(out):
+                    dense.copyto(dest)
+            return
+        vals = self._compress_vals(str(keys[0]), vals)
         agg = vals[0]
         for v in vals[1:]:
             agg = agg + v.as_in_ctx(agg.device)

@@ -15,8 +15,10 @@ Design (SURVEY.md §5 "Distributed communication backend"):
     jitted collective spans the whole slice (ICI) or crosses slices (DCN).
 
 Gradient compression (1-bit/2-bit with error feedback,
-src/kvstore/gradient_compression.cc) is intentionally not replicated:
-bf16 gradients + ICI bandwidth make it a net loss on TPU; hook kept.
+src/kvstore/gradient_compression.cc) is available via
+set_gradient_compression — the packed uint8 payload is what would cross
+DCN between hosts; within a slice ICI moves bf16 faster than quantization
+costs, so it is opt-in exactly like the reference.
 """
 from __future__ import annotations
 
@@ -90,6 +92,7 @@ class TPUDist(KVStoreBase):
                 self.pushpull(k, v, o, priority)
             return
         vals = _aslist(value)
+        vals = self._compress_vals(str(keys[0]), vals)
         if len(vals) == 1:
             total_data = vals[0]._data
         else:

@@ -16,6 +16,7 @@ from ..numpy import (  # noqa: F401
     zeros,
     zeros_like,
 )
+from . import sparse  # noqa: F401
 from .ndarray import NDArray, apply_op, from_jax, waitall  # noqa: F401
 from .utils import load, save, savez  # noqa: F401
 

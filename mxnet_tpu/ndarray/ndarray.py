@@ -17,10 +17,10 @@ src/ndarray/ndarray.cc) for the XLA/PJRT world:
   * `wait_to_read` / `asnumpy` are the sync points, as in the reference
     (ndarray.h:394; NDArray::SyncCopyToCPU).
 
-Sparse storage types (row_sparse/CSR) are intentionally NOT carried over:
-XLA has no sparse buffers; embedding-gradient style sparsity is handled by
-dense scatter-adds which XLA fuses. This is a documented capability decision,
-not an omission (SURVEY.md §7 hard part (c)).
+Sparse storage types (row_sparse/CSR) live in ndarray/sparse.py as a
+storage + communication format (construction/cast/retain eager; sparse·dense
+dot via XLA gather/segment_sum/scatter-add; kvstore row_sparse push/pull) —
+see that module's docstring for the TPU design rationale.
 """
 from __future__ import annotations
 
@@ -105,7 +105,16 @@ class NDArray:
 
     @property
     def stype(self):
-        return "default"  # sparse storage types not supported (see module doc)
+        return "default"
+
+    def tostype(self, stype):
+        """Cast to a storage type ('default'/'csr'/'row_sparse');
+        see ndarray/sparse.py for the TPU sparse design."""
+        if stype == "default":
+            return self
+        from .sparse import cast_storage
+
+        return cast_storage(self, stype)
 
     @property
     def grad(self):

@@ -1,0 +1,358 @@
+"""Sparse NDArrays: CSR and row-sparse storage.
+
+Reference: python/mxnet/ndarray/sparse.py (CSRNDArray:301,
+RowSparseNDArray:575, ops add/subtract/multiply/divide:1210-1524) over
+kCSRStorage/kRowSparseStorage chunks (include/mxnet/ndarray.h:60-64) with
+FComputeEx sparse kernels.
+
+TPU re-design: TPUs have no sparse hardware, and XLA wants static shapes —
+so sparse here is a *storage + communication* format, not a kernel zoo:
+
+- structure manipulation (construction, cast_storage, retain, elemwise with
+  index merging) runs eagerly on host-side logic with device arrays;
+- the compute that matters (sparse·dense dot) lowers to XLA gather /
+  segment_sum / scatter-add, which map onto the TPU's vector units and keep
+  nnz static inside any jitted caller;
+- row_sparse's real role — pushing only touched embedding rows through the
+  kvstore — is preserved: kvstore accepts RowSparseNDArray and merges via
+  scatter-add (see kvstore row_sparse support).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as _np
+
+from .ndarray import NDArray, apply_op
+
+__all__ = ["BaseSparseNDArray", "CSRNDArray", "RowSparseNDArray",
+           "csr_matrix", "row_sparse_array", "zeros", "empty", "array",
+           "add", "subtract", "multiply", "divide", "dot", "retain",
+           "cast_storage"]
+
+
+class BaseSparseNDArray:
+    """Common surface shared by CSR/row-sparse arrays.
+
+    Not an engine-tracked NDArray: sparse arrays are value containers whose
+    dense views enter the autograd tape / jit traces.
+    """
+
+    stype = None
+
+    def __init__(self, shape, dtype):
+        self._shape = tuple(int(s) for s in shape)
+        self._dtype = _np.dtype(dtype)
+
+    @property
+    def shape(self):
+        return self._shape
+
+    @property
+    def dtype(self):
+        return self._dtype
+
+    @property
+    def ndim(self):
+        return len(self._shape)
+
+    @property
+    def size(self):
+        out = 1
+        for s in self._shape:
+            out *= s
+        return out
+
+    def asnumpy(self):
+        return self.todense().asnumpy()
+
+    def astype(self, dtype):
+        raise NotImplementedError
+
+    def todense(self) -> NDArray:
+        raise NotImplementedError
+
+    def tostype(self, stype):
+        if stype == self.stype:
+            return self
+        if stype == "default":
+            return self.todense()
+        return cast_storage(self.todense(), stype)
+
+    def copyto(self, other):
+        if isinstance(other, NDArray):
+            other._assign_from(self.todense())
+            return other
+        raise TypeError(f"copyto target {type(other)}")
+
+    def wait_to_read(self):
+        return self
+
+    def __repr__(self):
+        return (f"\n<{type(self).__name__} {self._shape} "
+                f"dtype={self._dtype.name}>")
+
+
+class CSRNDArray(BaseSparseNDArray):
+    """Compressed-sparse-row matrix (reference: sparse.py:301).
+
+    data (nnz,), indices (nnz,) column ids, indptr (rows+1,).
+    """
+
+    stype = "csr"
+
+    def __init__(self, data, indices, indptr, shape, dtype=None):
+        data = jnp.asarray(data)
+        super().__init__(shape, dtype or data.dtype)
+        self.data = data.astype(self._dtype)
+        self.indices = jnp.asarray(indices, jnp.int32)
+        self.indptr = jnp.asarray(indptr, jnp.int32)
+
+    def astype(self, dtype):
+        return CSRNDArray(self.data, self.indices, self.indptr, self._shape,
+                          dtype)
+
+    def todense(self):
+        n_rows, n_cols = self._shape
+        nnz = self.data.shape[0]
+        row_ids = jnp.repeat(
+            jnp.arange(n_rows, dtype=jnp.int32), jnp.diff(self.indptr),
+            total_repeat_length=nnz)
+        dense = jnp.zeros(self._shape, self._dtype).at[
+            row_ids, self.indices].add(self.data)
+        return NDArray(dense)
+
+    def _row_ids(self):
+        return jnp.repeat(
+            jnp.arange(self._shape[0], dtype=jnp.int32),
+            jnp.diff(self.indptr), total_repeat_length=self.data.shape[0])
+
+    def slice(self, start, end):
+        """Row slice (reference: CSRNDArray.__getitem__ row ranges)."""
+        sub = self.todense().asnumpy()[start:end]
+        return cast_storage(NDArray(jnp.asarray(sub)), "csr")
+
+    def __getitem__(self, key):
+        if isinstance(key, slice):
+            return self.slice(key.start or 0, key.stop)
+        raise TypeError("CSR supports row-slice indexing only")
+
+
+class RowSparseNDArray(BaseSparseNDArray):
+    """Row-sparse tensor (reference: sparse.py:575): a subset of rows is
+    stored; all other rows are zero. data (k, *row_shape), indices (k,)."""
+
+    stype = "row_sparse"
+
+    def __init__(self, data, indices, shape, dtype=None):
+        data = jnp.asarray(data)
+        super().__init__(shape, dtype or data.dtype)
+        self.data = data.astype(self._dtype)
+        self.indices = jnp.asarray(indices, jnp.int32)
+
+    def astype(self, dtype):
+        return RowSparseNDArray(self.data, self.indices, self._shape, dtype)
+
+    def todense(self):
+        dense = jnp.zeros(self._shape, self._dtype).at[self.indices].add(
+            self.data)
+        return NDArray(dense)
+
+    def retain(self, indices):
+        return retain(self, indices)
+
+
+# --- construction ----------------------------------------------------------
+
+def csr_matrix(arg1, shape=None, ctx=None, dtype=None):  # noqa: ARG001
+    """Build a CSRNDArray from (data, indices, indptr), a dense array, or
+    another CSR (reference: sparse.py:839)."""
+    if isinstance(arg1, CSRNDArray):
+        return arg1 if dtype is None else arg1.astype(dtype)
+    if isinstance(arg1, tuple) and len(arg1) == 3:
+        data, indices, indptr = arg1
+        if shape is None:
+            raise ValueError("shape required with (data, indices, indptr)")
+        return CSRNDArray(data, indices, indptr, shape, dtype)
+    dense = arg1.asnumpy() if isinstance(arg1, NDArray) else _np.asarray(arg1)
+    return _dense_to_csr(dense, dtype)
+
+
+def row_sparse_array(arg1, shape=None, ctx=None, dtype=None):  # noqa: ARG001
+    """Build a RowSparseNDArray from (data, indices), dense, or another RSP
+    (reference: sparse.py:1037)."""
+    if isinstance(arg1, RowSparseNDArray):
+        return arg1 if dtype is None else arg1.astype(dtype)
+    if isinstance(arg1, tuple) and len(arg1) == 2:
+        data, indices = arg1
+        if shape is None:
+            raise ValueError("shape required with (data, indices)")
+        return RowSparseNDArray(data, indices, shape, dtype)
+    dense = arg1.asnumpy() if isinstance(arg1, NDArray) else _np.asarray(arg1)
+    return _dense_to_rsp(dense, dtype)
+
+
+def _dense_to_csr(dense, dtype=None):
+    dense = _np.asarray(dense)
+    if dense.ndim != 2:
+        raise ValueError("csr requires 2-D")
+    rows, cols = _np.nonzero(dense)
+    data = dense[rows, cols]
+    indptr = _np.zeros(dense.shape[0] + 1, _np.int64)
+    _np.add.at(indptr[1:], rows, 1)
+    indptr = _np.cumsum(indptr)
+    return CSRNDArray(data, cols, indptr, dense.shape, dtype or dense.dtype)
+
+
+def _dense_to_rsp(dense, dtype=None):
+    dense = _np.asarray(dense)
+    nz_rows = _np.nonzero(dense.reshape(dense.shape[0], -1).any(axis=1))[0]
+    return RowSparseNDArray(dense[nz_rows], nz_rows, dense.shape,
+                            dtype or dense.dtype)
+
+
+def zeros(stype, shape, ctx=None, dtype=None, **kwargs):  # noqa: ARG001
+    dtype = dtype or _np.float32
+    if stype == "csr":
+        return CSRNDArray(jnp.zeros((0,), dtype), jnp.zeros((0,), jnp.int32),
+                          jnp.zeros((shape[0] + 1,), jnp.int32), shape, dtype)
+    if stype == "row_sparse":
+        return RowSparseNDArray(jnp.zeros((0,) + tuple(shape[1:]), dtype),
+                                jnp.zeros((0,), jnp.int32), shape, dtype)
+    if stype == "default":
+        return NDArray(jnp.zeros(shape, dtype))
+    raise ValueError(f"unknown stype {stype}")
+
+
+empty = zeros
+
+
+def array(source_array, ctx=None, dtype=None):  # noqa: ARG001
+    """Sparse-aware array(): preserves the source's storage type."""
+    if isinstance(source_array, BaseSparseNDArray):
+        return source_array if dtype is None else source_array.astype(dtype)
+    try:
+        import scipy.sparse as sps
+
+        if sps.issparse(source_array):
+            csr = source_array.tocsr()
+            return CSRNDArray(csr.data, csr.indices, csr.indptr, csr.shape,
+                              dtype)
+    except ImportError:
+        pass
+    return NDArray(jnp.asarray(_np.asarray(source_array), dtype))
+
+
+def cast_storage(arr, stype):
+    """reference: src/operator/tensor/cast_storage.cc."""
+    if isinstance(arr, BaseSparseNDArray):
+        return arr.tostype(stype)
+    if stype == "default":
+        return arr
+    if stype == "csr":
+        return _dense_to_csr(arr.asnumpy())
+    if stype == "row_sparse":
+        return _dense_to_rsp(arr.asnumpy())
+    raise ValueError(f"unknown stype {stype}")
+
+
+# --- compute ---------------------------------------------------------------
+
+def dot(lhs, rhs, transpose_a=False, transpose_b=False):
+    """Sparse·dense matmul.
+
+    csr·dense and csr^T·dense lower to gather + segment_sum/scatter-add
+    (XLA-native); rsp·dense gathers stored rows through the MXU then
+    scatter-adds. Dense·dense falls through to jnp.dot.
+    """
+    if isinstance(lhs, CSRNDArray):
+        if transpose_b:
+            rhs = rhs.transpose() if isinstance(rhs, NDArray) else rhs.T
+        n_rows, n_cols = lhs.shape
+        row_ids = lhs._row_ids()
+        data, indices = lhs.data, lhs.indices
+
+        def pure(d):
+            if not transpose_a:
+                gathered = data[:, None] * d[indices]           # (nnz, D)
+                return jax.ops.segment_sum(gathered, row_ids,
+                                           num_segments=n_rows)
+            gathered = data[:, None] * d[row_ids]               # (nnz, D)
+            return jnp.zeros((n_cols, d.shape[1]), gathered.dtype).at[
+                indices].add(gathered)
+
+        return apply_op(pure, rhs, name="sparse_dot") if isinstance(
+            rhs, NDArray) else NDArray(pure(jnp.asarray(rhs)))
+    if isinstance(lhs, RowSparseNDArray):
+        if transpose_a:
+            raise ValueError("transpose_a unsupported for row_sparse lhs "
+                             "(reference parity: dot(rsp, dense) only)")
+        n_rows = lhs.shape[0]
+        data, indices = lhs.data, lhs.indices
+
+        def pure_rsp(d):
+            if transpose_b:
+                d = d.T
+            partial = data @ d                                   # (k, D)
+            return jnp.zeros((n_rows, d.shape[1]), partial.dtype).at[
+                indices].add(partial)
+
+        return apply_op(pure_rsp, rhs, name="sparse_dot") if isinstance(
+            rhs, NDArray) else NDArray(pure_rsp(jnp.asarray(rhs)))
+    # dense lhs
+    from ..numpy import dot as _dense_dot
+
+    a = lhs.transpose() if transpose_a else lhs
+    b = rhs.todense() if isinstance(rhs, BaseSparseNDArray) else rhs
+    if transpose_b:
+        b = b.transpose()
+    return _dense_dot(a, b)
+
+
+def retain(rsp, indices):
+    """Keep only the given rows of a row-sparse array
+    (reference: _retain sparse op)."""
+    if not isinstance(rsp, RowSparseNDArray):
+        raise TypeError("retain expects RowSparseNDArray")
+    keep = _np.asarray(indices, _np.int64)
+    stored = _np.asarray(rsp.indices)
+    mask = _np.isin(stored, keep)
+    return RowSparseNDArray(_np.asarray(rsp.data)[mask], stored[mask],
+                            rsp.shape, rsp.dtype)
+
+
+def _rsp_elemwise(op, lhs, rhs):
+    """Merge-indexed elementwise on two row-sparse arrays → row-sparse."""
+    li, ri = _np.asarray(lhs.indices), _np.asarray(rhs.indices)
+    ld, rd = _np.asarray(lhs.data), _np.asarray(rhs.data)
+    all_idx = _np.union1d(li, ri)
+    pos = {int(v): i for i, v in enumerate(all_idx)}
+    shape = (len(all_idx),) + lhs.data.shape[1:]
+    a = _np.zeros(shape, lhs.dtype)
+    b = _np.zeros(shape, rhs.dtype)
+    if len(li):
+        a[[pos[int(v)] for v in li]] = ld
+    if len(ri):
+        b[[pos[int(v)] for v in ri]] = rd
+    return RowSparseNDArray(op(a, b), all_idx, lhs.shape)
+
+
+def _binary(op, name):
+    def fn(lhs, rhs):
+        if isinstance(lhs, RowSparseNDArray) and isinstance(
+                rhs, RowSparseNDArray) and name in ("add", "subtract"):
+            return _rsp_elemwise(op, lhs, rhs)
+        a = lhs.todense() if isinstance(lhs, BaseSparseNDArray) else lhs
+        b = rhs.todense() if isinstance(rhs, BaseSparseNDArray) else rhs
+        from .. import numpy as mxnp
+
+        return getattr(mxnp, name)(a, b)
+
+    fn.__name__ = name
+    return fn
+
+
+add = _binary(_np.add, "add")
+subtract = _binary(_np.subtract, "subtract")
+multiply = _binary(_np.multiply, "multiply")
+divide = _binary(_np.divide, "divide")

@@ -1,0 +1,98 @@
+"""Gradient compression: quantization, packing, error feedback, kvstore hook.
+
+Reference coverage model: tests/nightly/dist_sync_kvstore.py compression
+checks + gradient_compression.cc unit semantics.
+"""
+import numpy as np
+
+import mxnet_tpu as mx
+from mxnet_tpu.kvstore.gradient_compression import GradientCompression
+
+
+def test_2bit_quantize_roundtrip():
+    gc = GradientCompression("2bit", threshold=0.5)
+    g = np.array([0.7, -0.9, 0.1, -0.2, 0.51], dtype="float32")
+    packed = gc.compress("k", mx.np.array(g)._data)
+    assert packed.dtype == np.uint8
+    assert packed.shape[0] == (len(g) + 3) // 4  # 4 codes per byte
+    deq = np.asarray(gc.decompress(packed, g.shape, "float32"))
+    assert np.allclose(deq, [0.5, -0.5, 0, 0, 0.5])
+
+
+def test_1bit_quantize_roundtrip():
+    gc = GradientCompression("1bit", threshold=0.25)
+    g = np.array([0.7, -0.9, 0.1, -0.2], dtype="float32")
+    packed = gc.compress("k", mx.np.array(g)._data)
+    assert packed.shape[0] == 1  # 8 bits per byte
+    deq = np.asarray(gc.decompress(packed, g.shape, "float32"))
+    assert np.allclose(deq, [0.25, -0.25, 0.25, -0.25])
+
+
+def test_error_feedback_converges():
+    """Residual carries the quantization error: the running mean of
+    dequantized pushes approaches the true gradient."""
+    gc = GradientCompression("2bit", threshold=0.5)
+    true = np.array([0.3, -0.2, 0.05], dtype="float32")
+    total = np.zeros_like(true)
+    n = 40
+    for _ in range(n):
+        total += np.asarray(gc.compress_pipeline("k", mx.np.array(true)._data))
+    assert np.allclose(total / n, true, atol=0.05)
+
+
+def test_compression_factor():
+    assert GradientCompression("2bit").get_compression_factor() == 16
+    assert GradientCompression("1bit").get_compression_factor() == 32
+
+
+def test_kvstore_local_compression_hook():
+    kv = mx.kv.create("local")
+    kv.set_gradient_compression({"type": "2bit", "threshold": 0.5})
+    kv.init("w", mx.np.zeros((4,)))
+    g = mx.np.array([1.0, -1.0, 0.1, 0.0])
+    kv.push("w", g)
+    out = mx.np.zeros((4,))
+    kv.pull("w", out=out)
+    # first push: large entries clip to +-threshold, small go to residual
+    assert np.allclose(out.asnumpy(), [0.5, -0.5, 0.0, 0.0])
+
+
+def test_tpu_dist_compression_hook():
+    kv = mx.kv.create("tpu_dist")
+    kv.set_gradient_compression({"type": "2bit", "threshold": 0.5})
+    vals = [mx.np.array([0.8, -0.8]), mx.np.array([0.8, -0.8])]
+    out = mx.np.zeros((2,))
+    kv.pushpull("g", vals, out=out)
+    assert np.allclose(out.asnumpy(), [1.0, -1.0])
+
+
+def test_kvstore_local_pushpull_compression():
+    """The Trainer path is pushpull, not push — compression must apply."""
+    kv = mx.kv.create("local")
+    kv.set_gradient_compression({"type": "2bit", "threshold": 0.5})
+    out = mx.np.zeros((3,))
+    kv.pushpull("g", [mx.np.array([0.8, -0.8, 0.1]),
+                      mx.np.array([0.8, -0.8, 0.1])], out=out)
+    assert np.allclose(out.asnumpy(), [1.0, -1.0, 0.0])
+
+
+def test_trainer_compression_params_wires_kvstore():
+    from mxnet_tpu import gluon
+
+    net = gluon.nn.Dense(4, in_units=3)
+    net.initialize()
+    tr = gluon.Trainer(net.collect_params(), "sgd", {"learning_rate": 0.1},
+                       kvstore="local",
+                       compression_params={"type": "2bit", "threshold": 0.5})
+    assert tr._kvstore._compression is not None
+    assert tr._kvstore._compression.type == "2bit"
+
+
+def test_large_tensor_pack_shape():
+    gc = GradientCompression("2bit", threshold=0.1)
+    g = mx.np.random.normal(0, 1, size=(37, 13))._data  # non-multiple of 4
+    packed = gc.compress("k", g)
+    deq = np.asarray(gc.decompress(packed, (37, 13), "float32"))
+    assert deq.shape == (37, 13)
+    a = np.abs(deq)
+    assert np.all((a < 1e-6) | (np.abs(a - 0.1) < 1e-6))

@@ -1,0 +1,178 @@
+"""Sparse storage: CSR / row_sparse construction, dot, kvstore path.
+
+Reference coverage model: tests/python/unittest/test_sparse_ndarray.py and
+test_sparse_operator.py; numeric oracle is dense numpy.
+"""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu.ndarray import sparse
+
+
+def _rand_dense(shape, density=0.3):
+    d = np.random.uniform(-1, 1, size=shape).astype("float32")
+    mask = np.random.uniform(size=shape) < density
+    return d * mask
+
+
+def test_csr_roundtrip():
+    dense = _rand_dense((6, 5))
+    csr = sparse.csr_matrix(dense)
+    assert csr.stype == "csr"
+    assert csr.shape == (6, 5)
+    assert np.allclose(csr.asnumpy(), dense)
+    back = csr.tostype("default")
+    assert back.stype == "default"
+    assert np.allclose(back.asnumpy(), dense)
+
+
+def test_csr_from_definition():
+    data = [1.0, 2.0, 3.0]
+    indices = [0, 2, 1]
+    indptr = [0, 2, 2, 3]
+    csr = sparse.csr_matrix((data, indices, indptr), shape=(3, 3))
+    expect = np.array([[1, 0, 2], [0, 0, 0], [0, 3, 0]], dtype="float32")
+    assert np.allclose(csr.asnumpy(), expect)
+
+
+def test_row_sparse_roundtrip():
+    dense = np.zeros((8, 4), "float32")
+    dense[2] = 1.0
+    dense[5] = -2.0
+    rsp = sparse.row_sparse_array(dense)
+    assert rsp.stype == "row_sparse"
+    assert list(np.asarray(rsp.indices)) == [2, 5]
+    assert np.allclose(rsp.asnumpy(), dense)
+
+
+def test_cast_storage_and_tostype():
+    dense = mx.np.array(_rand_dense((4, 6)))
+    csr = dense.tostype("csr")
+    rsp = dense.tostype("row_sparse")
+    assert np.allclose(csr.asnumpy(), dense.asnumpy())
+    assert np.allclose(rsp.asnumpy(), dense.asnumpy())
+    assert sparse.cast_storage(csr, "row_sparse").stype == "row_sparse"
+    assert dense.tostype("default") is dense
+
+
+def test_csr_dot_dense():
+    a = _rand_dense((5, 7))
+    b = np.random.uniform(size=(7, 3)).astype("float32")
+    csr = sparse.csr_matrix(a)
+    out = sparse.dot(csr, mx.np.array(b))
+    assert np.allclose(out.asnumpy(), a @ b, atol=1e-5)
+    # transpose_a: (7,5)·? -> csr^T (7x5)... dot(csr^T, dense(5,3))
+    c = np.random.uniform(size=(5, 3)).astype("float32")
+    outT = sparse.dot(csr, mx.np.array(c), transpose_a=True)
+    assert np.allclose(outT.asnumpy(), a.T @ c, atol=1e-5)
+
+
+def test_rsp_dot_dense():
+    a = _rand_dense((6, 4))
+    rsp = sparse.row_sparse_array(a)
+    b = np.random.uniform(size=(4, 3)).astype("float32")
+    out = sparse.dot(rsp, mx.np.array(b))
+    assert np.allclose(out.asnumpy(), a @ b, atol=1e-5)
+
+
+def test_retain():
+    dense = np.zeros((8, 2), "float32")
+    dense[[1, 3, 6]] = [[1, 1], [3, 3], [6, 6]]
+    rsp = sparse.row_sparse_array(dense)
+    kept = sparse.retain(rsp, [3, 6])
+    expect = dense.copy()
+    expect[1] = 0
+    assert np.allclose(kept.asnumpy(), expect)
+
+
+def test_rsp_elemwise_add_merges_indices():
+    d1 = np.zeros((6, 2), "float32")
+    d1[1] = 1
+    d2 = np.zeros((6, 2), "float32")
+    d2[1] = 2
+    d2[4] = 4
+    r = sparse.add(sparse.row_sparse_array(d1), sparse.row_sparse_array(d2))
+    assert r.stype == "row_sparse"
+    assert np.allclose(r.asnumpy(), d1 + d2)
+    s = sparse.subtract(sparse.row_sparse_array(d1),
+                        sparse.row_sparse_array(d2))
+    assert np.allclose(s.asnumpy(), d1 - d2)
+
+
+def test_sparse_zeros_and_mixed_ops():
+    z = sparse.zeros("csr", (3, 4))
+    assert z.asnumpy().sum() == 0
+    zr = sparse.zeros("row_sparse", (3, 4))
+    assert zr.asnumpy().shape == (3, 4)
+    dense = mx.np.ones((3, 4))
+    out = sparse.multiply(z, dense)  # mixed densifies
+    assert np.allclose(out.asnumpy(), 0)
+
+
+def test_kvstore_row_sparse_push_pull():
+    kv = mx.kv.create("local")
+    shape = (10, 3)
+    kv.init("emb", mx.np.zeros(shape))
+    g1 = np.zeros(shape, "float32")
+    g1[2] = 1.0
+    g2 = np.zeros(shape, "float32")
+    g2[2] = 1.0
+    g2[7] = 2.0
+    kv.push("emb", [sparse.row_sparse_array(g1), sparse.row_sparse_array(g2)])
+    pulled = kv.row_sparse_pull("emb", row_ids=mx.np.array([2, 7]))
+    assert pulled.stype == "row_sparse"
+    got = pulled.asnumpy()
+    assert np.allclose(got[2], 2.0)
+    assert np.allclose(got[7], 2.0)
+    assert np.allclose(got[0], 0.0)
+
+
+def test_kvstore_sparse_with_optimizer():
+    from mxnet_tpu import optimizer as opt
+
+    kv = mx.kv.create("local")
+    shape = (6, 2)
+    w0 = np.ones(shape, "float32")
+    kv.init("w", mx.np.array(w0))
+    kv.set_optimizer(opt.SGD(learning_rate=0.5))
+    g = np.zeros(shape, "float32")
+    g[3] = 2.0
+    kv.push("w", sparse.row_sparse_array(g))
+    out = mx.np.zeros(shape)
+    kv.pull("w", out=out)
+    expect = w0 - 0.5 * g
+    assert np.allclose(out.asnumpy(), expect, atol=1e-5)
+
+
+def test_row_sparse_pull_from_rsp_store():
+    """Pulling from an rsp-stored value gathers rows without densifying."""
+    kv = mx.kv.create("local")
+    g = np.zeros((10, 2), "float32")
+    g[2] = 2.0
+    g[7] = 7.0
+    kv.push("emb", sparse.row_sparse_array(g))  # no init: stored as rsp
+    pulled = kv.row_sparse_pull("emb", row_ids=mx.np.array([2, 5]))
+    got = pulled.asnumpy()
+    assert np.allclose(got[2], 2.0)
+    assert np.allclose(got[5], 0.0)   # requested but not stored -> zero
+    assert np.allclose(got[7], 0.0)   # stored but not requested -> omitted
+
+
+def test_kvstore_sparse_pushpull():
+    kv = mx.kv.create("local")
+    g1 = np.zeros((6, 2), "float32")
+    g1[1] = 1.0
+    g2 = np.zeros((6, 2), "float32")
+    g2[4] = 4.0
+    out = mx.np.zeros((6, 2))
+    kv.pushpull("e", [sparse.row_sparse_array(g1),
+                      sparse.row_sparse_array(g2)], out=out)
+    assert np.allclose(out.asnumpy(), g1 + g2)
+
+
+def test_scipy_interop():
+    scipy = pytest.importorskip("scipy.sparse")
+    m = scipy.random(5, 6, density=0.4, format="csr", dtype="float32")
+    csr = sparse.array(m)
+    assert np.allclose(csr.asnumpy(), m.toarray())
