@@ -53,6 +53,10 @@ from . import lr_scheduler  # noqa: E402
 from . import kvstore as kv  # noqa: E402
 from . import kvstore  # noqa: E402
 from . import io  # noqa: E402
+from . import image  # noqa: E402
+from . import library  # noqa: E402
+from . import operator  # noqa: E402
+from .operator import Custom  # noqa: E402
 from . import recordio  # noqa: E402
 from . import gluon  # noqa: E402
 from . import symbol  # noqa: E402

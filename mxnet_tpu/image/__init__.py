@@ -1,0 +1,4 @@
+"""mx.image — image IO + augmentation (reference: python/mxnet/image/)."""
+from . import detection  # noqa: F401
+from .detection import *  # noqa: F401,F403
+from .image import *  # noqa: F401,F403

@@ -1,0 +1,240 @@
+"""Detection augmenters (reference: python/mxnet/image/detection.py).
+
+Labels are (N, 5+) arrays: [class, xmin, ymin, xmax, ymax, ...] with
+coordinates normalized to [0, 1]. Augmenters transform image + label
+together; the host-side design rationale is in image.py.
+"""
+from __future__ import annotations
+
+import random as _pyrandom
+
+import numpy as _np
+
+from ..ndarray.ndarray import NDArray
+from .image import (
+    Augmenter,
+    CastAug,
+    ColorJitterAug,
+    HueJitterAug,
+    LightingAug,
+    RandomGrayAug,
+    _as_np,
+    fixed_crop,
+    imresize,
+)
+
+__all__ = ["DetAugmenter", "DetBorrowAug", "DetHorizontalFlipAug",
+           "DetRandomCropAug", "DetRandomPadAug", "DetForceResizeAug",
+           "CreateDetAugmenter"]
+
+
+class DetAugmenter:
+    """Detection augmenter base (reference: detection.py:41)."""
+
+    def __init__(self, **kwargs):
+        self._kwargs = kwargs
+
+    def dumps(self):
+        import json
+
+        return json.dumps([type(self).__name__, self._kwargs])
+
+    def __call__(self, src, label):
+        raise NotImplementedError
+
+
+class DetBorrowAug(DetAugmenter):
+    """Wrap an image-only Augmenter (reference: detection.py:72)."""
+
+    def __init__(self, augmenter):
+        super().__init__()
+        self.augmenter = augmenter
+
+    def __call__(self, src, label):
+        return self.augmenter(src), label
+
+
+class DetHorizontalFlipAug(DetAugmenter):
+    def __init__(self, p):
+        super().__init__(p=p)
+        self.p = p
+
+    def __call__(self, src, label):
+        if _pyrandom.random() < self.p:
+            src = NDArray(_as_np(src)[:, ::-1].copy())
+            label = _np.array(label, copy=True)
+            xmin = 1.0 - label[:, 3]
+            xmax = 1.0 - label[:, 1]
+            label[:, 1], label[:, 3] = xmin, xmax
+        return src, label
+
+
+class DetRandomCropAug(DetAugmenter):
+    """Random crop constrained by object coverage (reference:
+    detection.py:118): a crop is accepted only when at least one box keeps
+    >= min_object_covered of its area; boxes falling below
+    min_eject_coverage are dropped from the label."""
+
+    def __init__(self, min_object_covered=0.5, min_crop_size=0.5,
+                 max_crop_size=1.0, min_eject_coverage=0.3, max_attempts=50):
+        super().__init__(min_object_covered=min_object_covered)
+        self.min_object_covered = min_object_covered
+        self.min_crop_size = min_crop_size
+        self.max_crop_size = max_crop_size
+        self.min_eject_coverage = min_eject_coverage
+        self.max_attempts = max_attempts
+
+    def __call__(self, src, label):
+        arr = _as_np(src)
+        h, w = arr.shape[:2]
+        label = _np.asarray(label)
+        for _ in range(self.max_attempts):
+            scale = _pyrandom.uniform(self.min_crop_size, self.max_crop_size)
+            cw, ch = int(w * scale), int(h * scale)
+            x0 = _pyrandom.randint(0, w - cw)
+            y0 = _pyrandom.randint(0, h - ch)
+            crop = (x0 / w, y0 / h, (x0 + cw) / w, (y0 + ch) / h)
+            cov = _coverage(label, crop)
+            if len(cov) == 0 or cov.max() < self.min_object_covered:
+                continue
+            new_label = _crop_boxes(label, crop, self.min_eject_coverage)
+            if len(new_label):
+                out = fixed_crop(NDArray(arr), x0, y0, cw, ch)
+                return out, new_label
+        return src, label
+
+
+class DetRandomPadAug(DetAugmenter):
+    """Pad to a random larger canvas with random aspect ratio
+    (reference: detection.py:472). Per-channel fill values honored."""
+
+    def __init__(self, aspect_ratio_range=(0.75, 1.33),
+                 area_range=(1.0, 3.0), max_attempts=50, pad_val=(127,)):
+        super().__init__()
+        self.aspect_ratio_range = aspect_ratio_range
+        self.area_range = area_range
+        self.max_attempts = max_attempts
+        self.pad_val = pad_val
+
+    def __call__(self, src, label):
+        arr = _as_np(src)
+        h, w = arr.shape[:2]
+        nh, nw = h, w
+        for _ in range(self.max_attempts):
+            area = _pyrandom.uniform(max(1.0, self.area_range[0]),
+                                     self.area_range[1]) * h * w
+            ratio = _pyrandom.uniform(*self.aspect_ratio_range)
+            cand_w = int(round((area * ratio) ** 0.5))
+            cand_h = int(round((area / ratio) ** 0.5))
+            if cand_w >= w and cand_h >= h:
+                nh, nw = cand_h, cand_w
+                break
+        y0 = _pyrandom.randint(0, nh - h) if nh > h else 0
+        x0 = _pyrandom.randint(0, nw - w) if nw > w else 0
+        fill = _np.asarray(self.pad_val, arr.dtype)
+        if fill.size == 1:
+            fill = _np.full((arr.shape[2],), fill.ravel()[0], arr.dtype)
+        out = _np.broadcast_to(
+            fill[:arr.shape[2]], (nh, nw, arr.shape[2])).copy()
+        out[y0:y0 + h, x0:x0 + w] = arr
+        label = _np.array(label, copy=True)
+        label[:, 1] = (label[:, 1] * w + x0) / nw
+        label[:, 3] = (label[:, 3] * w + x0) / nw
+        label[:, 2] = (label[:, 2] * h + y0) / nh
+        label[:, 4] = (label[:, 4] * h + y0) / nh
+        return NDArray(out), label
+
+
+class DetForceResizeAug(DetAugmenter):
+    def __init__(self, size, interp=2):
+        super().__init__(size=size)
+        self.size, self.interp = size, interp
+
+    def __call__(self, src, label):
+        return imresize(src, self.size[0], self.size[1], self.interp), label
+
+
+def _coverage(label, crop):
+    """Fraction of each box's area retained by the crop region."""
+    cx0, cy0, cx1, cy1 = crop
+    covs = []
+    for row in label:
+        x0, y0, x1, y1 = row[1:5]
+        area = max(x1 - x0, 0) * max(y1 - y0, 0)
+        ix = max(min(x1, cx1) - max(x0, cx0), 0)
+        iy = max(min(y1, cy1) - max(y0, cy0), 0)
+        covs.append((ix * iy) / area if area > 0 else 0.0)
+    return _np.asarray(covs)
+
+
+def _crop_boxes(label, crop, min_eject_coverage=0.0):
+    """Clip normalized boxes to `crop`, renormalize; drop boxes whose
+    retained area fraction falls below min_eject_coverage."""
+    cx0, cy0, cx1, cy1 = crop
+    cov = _coverage(label, crop)
+    out = []
+    for row, c in zip(label, cov):
+        if c <= 0 or c < min_eject_coverage:
+            continue
+        x0, y0, x1, y1 = row[1:5]
+        nx0, ny0 = max(x0, cx0), max(y0, cy0)
+        nx1, ny1 = min(x1, cx1), min(y1, cy1)
+        if nx1 <= nx0 or ny1 <= ny0:
+            continue
+        new = _np.array(row, copy=True)
+        new[1] = (nx0 - cx0) / (cx1 - cx0)
+        new[3] = (nx1 - cx0) / (cx1 - cx0)
+        new[2] = (ny0 - cy0) / (cy1 - cy0)
+        new[4] = (ny1 - cy0) / (cy1 - cy0)
+        out.append(new)
+    return _np.asarray(out) if out else _np.zeros((0, label.shape[1]))
+
+
+def CreateDetAugmenter(data_shape, resize=0, rand_crop=0, rand_pad=0,  # noqa: N802
+                       rand_gray=0, rand_mirror=False, mean=None, std=None,
+                       brightness=0, contrast=0, saturation=0, pca_noise=0,
+                       hue=0, inter_method=2, min_object_covered=0.1,
+                       aspect_ratio_range=(0.75, 1.33), area_range=(0.05, 3.0),
+                       min_eject_coverage=0.3, max_attempts=50,
+                       pad_val=(127, 127, 127)):
+    """Build the standard detection aug list (reference: detection.py:788)."""
+    auglist = []
+    if resize > 0:
+        from .image import ResizeAug
+
+        auglist.append(DetBorrowAug(ResizeAug(resize, inter_method)))
+    if rand_crop > 0:
+        auglist.append(DetRandomCropAug(min_object_covered,
+                                        min_eject_coverage=min_eject_coverage,
+                                        max_attempts=max_attempts))
+    if rand_pad > 0:
+        auglist.append(DetRandomPadAug(aspect_ratio_range,
+                                       (1.0, max(1.0, area_range[1])),
+                                       max_attempts, pad_val))
+    if rand_mirror:
+        auglist.append(DetHorizontalFlipAug(0.5))
+    auglist.append(DetForceResizeAug((data_shape[2], data_shape[1]),
+                                     inter_method))
+    auglist.append(DetBorrowAug(CastAug()))
+    if brightness or contrast or saturation:
+        auglist.append(DetBorrowAug(
+            ColorJitterAug(brightness, contrast, saturation)))
+    if hue:
+        auglist.append(DetBorrowAug(HueJitterAug(hue)))
+    if pca_noise > 0:
+        eigval = _np.array([55.46, 4.794, 1.148])
+        eigvec = _np.array([[-0.5675, 0.7192, 0.4009],
+                            [-0.5808, -0.0045, -0.8140],
+                            [-0.5836, -0.6948, 0.4203]])
+        auglist.append(DetBorrowAug(LightingAug(pca_noise, eigval, eigvec)))
+    if rand_gray > 0:
+        auglist.append(DetBorrowAug(RandomGrayAug(rand_gray)))
+    if mean is not None:
+        from .image import ColorNormalizeAug
+
+        if mean is True:
+            mean = _np.array([123.68, 116.28, 103.53])
+        if std is True:
+            std = _np.array([58.395, 57.12, 57.375])
+        auglist.append(DetBorrowAug(ColorNormalizeAug(mean, std)))
+    return auglist

@@ -1,0 +1,566 @@
+"""mx.image — host-side image IO + augmentation pipeline.
+
+Reference: python/mxnet/image/image.py (2,649 LoC over OpenCV). TPU
+re-design: decoding/augmentation stays on host (same as the reference — this
+is the CPU side of the input pipeline; the TPU sees only batched tensors),
+but the backend is PIL + numpy instead of OpenCV, and resize can ride
+jax.image.resize when arrays are already device-resident. All functions
+take/return NDArray (HWC, uint8 or float32), matching the reference API.
+"""
+from __future__ import annotations
+
+import io as _io
+import random as _pyrandom
+
+import numpy as _np
+
+from ..ndarray.ndarray import NDArray
+
+try:
+    from PIL import Image as _PILImage
+
+    _HAS_PIL = True
+except ImportError:  # pragma: no cover
+    _HAS_PIL = False
+
+__all__ = [
+    "imread", "imdecode", "imresize", "imrotate", "scale_down",
+    "resize_short", "copyMakeBorder", "fixed_crop", "random_crop",
+    "center_crop", "random_size_crop", "color_normalize", "random_rotate",
+    "Augmenter", "SequentialAug", "ResizeAug", "ForceResizeAug",
+    "RandomCropAug", "RandomSizedCropAug", "CenterCropAug", "RandomOrderAug",
+    "BrightnessJitterAug", "ContrastJitterAug", "SaturationJitterAug",
+    "HueJitterAug", "ColorJitterAug", "LightingAug", "ColorNormalizeAug",
+    "RandomGrayAug", "HorizontalFlipAug", "CastAug", "CreateAugmenter",
+    "ImageIter",
+]
+
+
+def _require_pil():
+    if not _HAS_PIL:
+        raise RuntimeError("mx.image requires Pillow for decode/resize")
+
+
+def _as_np(img):
+    if isinstance(img, NDArray):
+        return img.asnumpy()
+    return _np.asarray(img)
+
+
+def _interp_pil(interp):
+    """Map the reference's cv2 interp codes (0-4) to PIL resamplers."""
+    _require_pil()
+    table = {
+        0: _PILImage.NEAREST, 1: _PILImage.BILINEAR, 2: _PILImage.BICUBIC,
+        3: _PILImage.NEAREST, 4: _PILImage.LANCZOS,
+    }
+    return table.get(interp, _PILImage.BILINEAR)
+
+
+def imread(filename, flag=1, to_rgb=True, **kwargs):  # noqa: ARG001
+    """Read an image file → NDArray (H, W, C) uint8
+    (reference: image.py:51 over cv2.imread)."""
+    _require_pil()
+    img = _PILImage.open(filename)
+    img = img.convert("RGB" if flag else "L")
+    arr = _np.asarray(img, _np.uint8)
+    if not flag:
+        arr = arr[:, :, None]
+    if flag and not to_rgb:
+        arr = arr[:, :, ::-1]  # BGR like cv2 default
+    return NDArray(arr)
+
+
+def imdecode(buf, flag=1, to_rgb=True, **kwargs):  # noqa: ARG001
+    """Decode a jpeg/png byte buffer (reference: image.py:154)."""
+    _require_pil()
+    if isinstance(buf, NDArray):
+        buf = buf.asnumpy().tobytes()
+    img = _PILImage.open(_io.BytesIO(bytes(buf)))
+    img = img.convert("RGB" if flag else "L")
+    arr = _np.asarray(img, _np.uint8)
+    if not flag:
+        arr = arr[:, :, None]
+    if flag and not to_rgb:
+        arr = arr[:, :, ::-1]
+    return NDArray(arr)
+
+
+def imresize(src, w, h, interp=1):
+    """Resize to (h, w) (reference: image.py:96)."""
+    _require_pil()
+    arr = _as_np(src)
+    squeeze = arr.shape[-1] == 1
+    pil = _PILImage.fromarray(arr.squeeze(-1) if squeeze else arr)
+    out = _np.asarray(pil.resize((w, h), _interp_pil(interp)))
+    if squeeze:
+        out = out[:, :, None]
+    return NDArray(out)
+
+
+def scale_down(src_size, size):
+    """Scale requested crop down to fit the source (reference: image.py:214)."""
+    w, h = size
+    sw, sh = src_size
+    if sh < h:
+        w, h = float(w * sh) / h, sh
+    if sw < w:
+        w, h = sw, float(h * sw) / w
+    return int(w), int(h)
+
+
+def resize_short(src, size, interp=2):
+    """Resize so the shorter edge equals `size` (reference: image.py:357)."""
+    arr = _as_np(src)
+    h, w = arr.shape[:2]
+    if h > w:
+        new_w, new_h = size, size * h // w
+    else:
+        new_w, new_h = size * w // h, size
+    return imresize(src, new_w, new_h, interp)
+
+
+def copyMakeBorder(src, top, bot, left, right, type=0, values=0):  # noqa: A002,N802,ARG001
+    """Pad borders (reference: image.py:249 over cv2.copyMakeBorder)."""
+    arr = _as_np(src)
+    out = _np.pad(arr, ((top, bot), (left, right), (0, 0)),
+                  mode="constant", constant_values=values)
+    return NDArray(out)
+
+
+def fixed_crop(src, x0, y0, w, h, size=None, interp=2):
+    arr = _as_np(src)
+    out = arr[y0:y0 + h, x0:x0 + w]
+    if size is not None and (w, h) != size:
+        return imresize(NDArray(out), size[0], size[1], interp)
+    return NDArray(out)
+
+
+def random_crop(src, size, interp=2):
+    arr = _as_np(src)
+    h, w = arr.shape[:2]
+    new_w, new_h = scale_down((w, h), size)
+    x0 = _pyrandom.randint(0, w - new_w)
+    y0 = _pyrandom.randint(0, h - new_h)
+    out = fixed_crop(src, x0, y0, new_w, new_h, size, interp)
+    return out, (x0, y0, new_w, new_h)
+
+
+def center_crop(src, size, interp=2):
+    arr = _as_np(src)
+    h, w = arr.shape[:2]
+    new_w, new_h = scale_down((w, h), size)
+    x0 = (w - new_w) // 2
+    y0 = (h - new_h) // 2
+    out = fixed_crop(src, x0, y0, new_w, new_h, size, interp)
+    return out, (x0, y0, new_w, new_h)
+
+
+def random_size_crop(src, size, area, ratio, interp=2, **kwargs):  # noqa: ARG001
+    """Random area/aspect crop, ImageNet-style (reference: image.py:563)."""
+    arr = _as_np(src)
+    h, w = arr.shape[:2]
+    src_area = h * w
+    if isinstance(area, (int, float)):
+        area = (area, 1.0)
+    for _ in range(10):
+        target_area = _pyrandom.uniform(*area) * src_area
+        log_ratio = (_np.log(ratio[0]), _np.log(ratio[1]))
+        aspect = _np.exp(_pyrandom.uniform(*log_ratio))
+        new_w = int(round((target_area * aspect) ** 0.5))
+        new_h = int(round((target_area / aspect) ** 0.5))
+        if new_w <= w and new_h <= h:
+            x0 = _pyrandom.randint(0, w - new_w)
+            y0 = _pyrandom.randint(0, h - new_h)
+            out = fixed_crop(src, x0, y0, new_w, new_h, size, interp)
+            return out, (x0, y0, new_w, new_h)
+    return center_crop(src, size, interp)
+
+
+def color_normalize(src, mean, std=None):
+    arr = _as_np(src).astype(_np.float32)
+    mean = _as_np(mean) if isinstance(mean, NDArray) else _np.asarray(mean)
+    arr = arr - mean
+    if std is not None:
+        std = _as_np(std) if isinstance(std, NDArray) else _np.asarray(std)
+        arr = arr / std
+    return NDArray(arr.astype(_np.float32))
+
+
+def imrotate(src, rotation_degrees, zoom_in=False, zoom_out=False):
+    """Rotate about the center (reference: image.py:618)."""
+    _require_pil()
+    if zoom_in and zoom_out:
+        raise ValueError("zoom_in and zoom_out are exclusive")
+    arr = _as_np(src)
+    h, w = arr.shape[:2]
+    pil = _PILImage.fromarray(arr.squeeze(-1) if arr.shape[-1] == 1 else arr)
+    if zoom_out:
+        # rotate with expand so nothing is clipped, then shrink back
+        out = _np.asarray(pil.rotate(rotation_degrees, _PILImage.BILINEAR,
+                                     expand=True))
+        out = _np.asarray(_PILImage.fromarray(out).resize(
+            (w, h), _PILImage.BILINEAR))
+    else:
+        out = _np.asarray(pil.rotate(rotation_degrees, _PILImage.BILINEAR,
+                                     expand=False))
+    if out.ndim == 2:
+        out = out[:, :, None]
+    if zoom_in:
+        rad = _np.deg2rad(abs(rotation_degrees) % 90)
+        zoom = abs(_np.cos(rad)) + abs(_np.sin(rad))
+        ch, cw = int(h / zoom), int(w / zoom)
+        y0, x0 = (h - ch) // 2, (w - cw) // 2
+        out = _np.asarray(_PILImage.fromarray(
+            out[y0:y0 + ch, x0:x0 + cw].squeeze(-1)
+            if out.shape[-1] == 1 else out[y0:y0 + ch, x0:x0 + cw]
+        ).resize((w, h), _PILImage.BILINEAR))
+        if out.ndim == 2:
+            out = out[:, :, None]
+    return NDArray(out)
+
+
+def random_rotate(src, angle_limits, zoom_in=False, zoom_out=False):
+    angle = _pyrandom.uniform(*angle_limits)
+    return imrotate(src, angle, zoom_in, zoom_out)
+
+
+# --- augmenters ------------------------------------------------------------
+
+class Augmenter:
+    """Image augmenter base (reference: image.py:761)."""
+
+    def __init__(self, **kwargs):
+        self._kwargs = kwargs
+
+    def dumps(self):
+        import json
+
+        return json.dumps([type(self).__name__, self._kwargs])
+
+    def __call__(self, src):
+        raise NotImplementedError
+
+
+class SequentialAug(Augmenter):
+    def __init__(self, ts):
+        super().__init__()
+        self.ts = ts
+
+    def __call__(self, src):
+        for t in self.ts:
+            src = t(src)
+        return src
+
+
+class ResizeAug(Augmenter):
+    def __init__(self, size, interp=2):
+        super().__init__(size=size, interp=interp)
+        self.size, self.interp = size, interp
+
+    def __call__(self, src):
+        return resize_short(src, self.size, self.interp)
+
+
+class ForceResizeAug(Augmenter):
+    def __init__(self, size, interp=2):
+        super().__init__(size=size, interp=interp)
+        self.size, self.interp = size, interp
+
+    def __call__(self, src):
+        return imresize(src, self.size[0], self.size[1], self.interp)
+
+
+class RandomCropAug(Augmenter):
+    def __init__(self, size, interp=2):
+        super().__init__(size=size, interp=interp)
+        self.size, self.interp = size, interp
+
+    def __call__(self, src):
+        return random_crop(src, self.size, self.interp)[0]
+
+
+class RandomSizedCropAug(Augmenter):
+    def __init__(self, size, area, ratio, interp=2):
+        super().__init__(size=size, area=area, ratio=ratio, interp=interp)
+        self.size, self.area, self.ratio, self.interp = size, area, ratio, interp
+
+    def __call__(self, src):
+        return random_size_crop(src, self.size, self.area, self.ratio,
+                                self.interp)[0]
+
+
+class CenterCropAug(Augmenter):
+    def __init__(self, size, interp=2):
+        super().__init__(size=size, interp=interp)
+        self.size, self.interp = size, interp
+
+    def __call__(self, src):
+        return center_crop(src, self.size, self.interp)[0]
+
+
+class RandomOrderAug(Augmenter):
+    def __init__(self, ts):
+        super().__init__()
+        self.ts = ts
+
+    def __call__(self, src):
+        ts = list(self.ts)
+        _pyrandom.shuffle(ts)
+        for t in ts:
+            src = t(src)
+        return src
+
+
+class BrightnessJitterAug(Augmenter):
+    def __init__(self, brightness):
+        super().__init__(brightness=brightness)
+        self.brightness = brightness
+
+    def __call__(self, src):
+        alpha = 1.0 + _pyrandom.uniform(-self.brightness, self.brightness)
+        return NDArray((_as_np(src).astype(_np.float32) * alpha))
+
+
+class ContrastJitterAug(Augmenter):
+    _coef = _np.array([0.299, 0.587, 0.114], _np.float32)
+
+    def __init__(self, contrast):
+        super().__init__(contrast=contrast)
+        self.contrast = contrast
+
+    def __call__(self, src):
+        arr = _as_np(src).astype(_np.float32)
+        alpha = 1.0 + _pyrandom.uniform(-self.contrast, self.contrast)
+        gray = (arr * self._coef).sum(-1, keepdims=True)
+        mean = gray.mean()
+        return NDArray(arr * alpha + mean * (1 - alpha))
+
+
+class SaturationJitterAug(Augmenter):
+    _coef = _np.array([0.299, 0.587, 0.114], _np.float32)
+
+    def __init__(self, saturation):
+        super().__init__(saturation=saturation)
+        self.saturation = saturation
+
+    def __call__(self, src):
+        arr = _as_np(src).astype(_np.float32)
+        alpha = 1.0 + _pyrandom.uniform(-self.saturation, self.saturation)
+        gray = (arr * self._coef).sum(-1, keepdims=True)
+        return NDArray(arr * alpha + gray * (1 - alpha))
+
+
+class HueJitterAug(Augmenter):
+    def __init__(self, hue):
+        super().__init__(hue=hue)
+        self.hue = hue
+        self.tyiq = _np.array([[0.299, 0.587, 0.114],
+                               [0.596, -0.274, -0.321],
+                               [0.211, -0.523, 0.311]], _np.float32)
+        self.ityiq = _np.array([[1.0, 0.956, 0.621],
+                                [1.0, -0.272, -0.647],
+                                [1.0, -1.107, 1.705]], _np.float32)
+
+    def __call__(self, src):
+        arr = _as_np(src).astype(_np.float32)
+        alpha = _pyrandom.uniform(-self.hue, self.hue)
+        u, w = _np.cos(alpha * _np.pi), _np.sin(alpha * _np.pi)
+        bt = _np.array([[1.0, 0.0, 0.0], [0.0, u, -w], [0.0, w, u]],
+                       _np.float32)
+        t = self.ityiq @ bt @ self.tyiq
+        return NDArray(arr @ t.T)
+
+
+class ColorJitterAug(RandomOrderAug):
+    def __init__(self, brightness, contrast, saturation):
+        ts = []
+        if brightness > 0:
+            ts.append(BrightnessJitterAug(brightness))
+        if contrast > 0:
+            ts.append(ContrastJitterAug(contrast))
+        if saturation > 0:
+            ts.append(SaturationJitterAug(saturation))
+        super().__init__(ts)
+
+
+class LightingAug(Augmenter):
+    """PCA-based lighting jitter (reference: image.py:1072)."""
+
+    def __init__(self, alphastd, eigval, eigvec):
+        super().__init__(alphastd=alphastd)
+        self.alphastd = alphastd
+        self.eigval = _np.asarray(eigval, _np.float32)
+        self.eigvec = _np.asarray(eigvec, _np.float32)
+
+    def __call__(self, src):
+        alpha = _np.random.normal(0, self.alphastd, size=(3,))
+        rgb = (self.eigvec * alpha * self.eigval).sum(axis=1)
+        return NDArray(_as_np(src).astype(_np.float32) + rgb)
+
+
+class ColorNormalizeAug(Augmenter):
+    def __init__(self, mean, std):
+        super().__init__()
+        self.mean, self.std = mean, std
+
+    def __call__(self, src):
+        return color_normalize(src, self.mean, self.std)
+
+
+class RandomGrayAug(Augmenter):
+    _mat = _np.array([[0.21, 0.21, 0.21],
+                      [0.72, 0.72, 0.72],
+                      [0.07, 0.07, 0.07]], _np.float32)
+
+    def __init__(self, p):
+        super().__init__(p=p)
+        self.p = p
+
+    def __call__(self, src):
+        if _pyrandom.random() < self.p:
+            return NDArray(_as_np(src).astype(_np.float32) @ self._mat)
+        return src
+
+
+class HorizontalFlipAug(Augmenter):
+    def __init__(self, p):
+        super().__init__(p=p)
+        self.p = p
+
+    def __call__(self, src):
+        if _pyrandom.random() < self.p:
+            return NDArray(_as_np(src)[:, ::-1].copy())
+        return src
+
+
+class CastAug(Augmenter):
+    def __init__(self, typ="float32"):
+        super().__init__(type=typ)
+        self.typ = typ
+
+    def __call__(self, src):
+        return NDArray(_as_np(src).astype(self.typ))
+
+
+def CreateAugmenter(data_shape, resize=0, rand_crop=False, rand_resize=False,  # noqa: N802
+                    rand_mirror=False, mean=None, std=None, brightness=0,
+                    contrast=0, saturation=0, hue=0, pca_noise=0,
+                    rand_gray=0, inter_method=2):
+    """Build the standard augmentation list (reference: image.py:1171)."""
+    auglist = []
+    if resize > 0:
+        auglist.append(ResizeAug(resize, inter_method))
+    crop_size = (data_shape[2], data_shape[1])
+    if rand_resize:
+        auglist.append(RandomSizedCropAug(crop_size, (0.08, 1.0),
+                                          (3 / 4.0, 4 / 3.0), inter_method))
+    elif rand_crop:
+        auglist.append(RandomCropAug(crop_size, inter_method))
+    else:
+        auglist.append(CenterCropAug(crop_size, inter_method))
+    if rand_mirror:
+        auglist.append(HorizontalFlipAug(0.5))
+    auglist.append(CastAug())
+    if brightness or contrast or saturation:
+        auglist.append(ColorJitterAug(brightness, contrast, saturation))
+    if hue:
+        auglist.append(HueJitterAug(hue))
+    if pca_noise > 0:
+        eigval = _np.array([55.46, 4.794, 1.148])
+        eigvec = _np.array([[-0.5675, 0.7192, 0.4009],
+                            [-0.5808, -0.0045, -0.8140],
+                            [-0.5836, -0.6948, 0.4203]])
+        auglist.append(LightingAug(pca_noise, eigval, eigvec))
+    if rand_gray > 0:
+        auglist.append(RandomGrayAug(rand_gray))
+    if mean is True:
+        mean = _np.array([123.68, 116.28, 103.53])
+    if std is True:
+        std = _np.array([58.395, 57.12, 57.375])
+    if mean is not None:
+        auglist.append(ColorNormalizeAug(mean, std))
+    return auglist
+
+
+class ImageIter:
+    """Image iterator over an .lst/.rec source with augmenters
+    (reference: image.py:1285)."""
+
+    def __init__(self, batch_size, data_shape, path_imgrec=None,
+                 path_imglist=None, path_root="", aug_list=None,
+                 shuffle=False, label_width=1, **kwargs):  # noqa: ARG001
+        from ..io import DataBatch, DataDesc  # noqa: F401
+
+        self.batch_size = batch_size
+        self.data_shape = tuple(data_shape)
+        self.auglist = aug_list if aug_list is not None else \
+            CreateAugmenter(data_shape)
+        self.label_width = label_width
+        self._shuffle = shuffle
+        self._items = []
+        if path_imgrec:
+            from ..recordio import IndexedRecordIO, unpack_img
+
+            self._rec = IndexedRecordIO(path_imgrec)
+            self._unpack = unpack_img
+            self._items = list(range(len(self._rec)))
+            self._mode = "rec"
+        elif path_imglist:
+            import os
+
+            with open(path_imglist) as f:
+                for line in f:
+                    parts = line.strip().split("\t")
+                    idx, labels, fname = parts[0], parts[1:-1], parts[-1]
+                    self._items.append(
+                        (float(labels[0]) if labels else 0.0,
+                         os.path.join(path_root, fname)))
+            self._mode = "list"
+        else:
+            raise ValueError("need path_imgrec or path_imglist")
+        self._cursor = 0
+        self.reset()
+
+    def reset(self):
+        if self._shuffle:
+            _pyrandom.shuffle(self._items)
+        self._cursor = 0
+
+    def __iter__(self):
+        return self
+
+    def _read_one(self, item):
+        if self._mode == "rec":
+            header, img = self._unpack(self._rec.read_idx(item))
+            label = header.label
+            arr = imdecode(img)
+        else:
+            label, fname = item
+            arr = imread(fname)
+        for aug in self.auglist:
+            arr = aug(arr)
+        return arr, float(_np.asarray(label).ravel()[0])
+
+    def __next__(self):
+        from .. import numpy as mxnp
+        from ..io import DataBatch
+
+        if self._cursor >= len(self._items):
+            raise StopIteration
+        datas, labels = [], []
+        while len(datas) < self.batch_size:
+            if self._cursor >= len(self._items):
+                break
+            arr, label = self._read_one(self._items[self._cursor])
+            self._cursor += 1
+            datas.append(_as_np(arr).transpose(2, 0, 1))  # HWC -> CHW
+            labels.append(label)
+        pad = self.batch_size - len(datas)
+        while len(datas) < self.batch_size:
+            datas.append(datas[-1])
+            labels.append(labels[-1])
+        return DataBatch(data=[mxnp.array(_np.stack(datas))],
+                         label=[mxnp.array(_np.asarray(labels))], pad=pad)
+
+    next = __next__

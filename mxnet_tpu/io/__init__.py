@@ -18,7 +18,8 @@ from ..ndarray.ndarray import NDArray
 from .. import numpy as mnp
 
 __all__ = ["DataDesc", "DataBatch", "DataIter", "NDArrayIter", "CSVIter",
-           "MNISTIter", "ImageRecordIter", "ResizeIter", "PrefetchingIter"]
+           "LibSVMIter", "MNISTIter", "ImageRecordIter", "ResizeIter",
+           "PrefetchingIter"]
 
 
 class DataDesc(namedtuple("DataDesc", ["name", "shape"])):
@@ -174,6 +175,74 @@ class CSVIter(NDArrayIter):
             label = _np.loadtxt(label_csv, delimiter=",", dtype=_np.float32)
             label = label.reshape((-1,) + tuple(label_shape))
         super().__init__(data, label, batch_size, **kwargs)
+
+
+class LibSVMIter(DataIter):
+    """LibSVM sparse-format iterator (reference: src/io/iter_libsvm.cc).
+
+    Yields CSR batches: `label idx:val idx:val ...` lines → CSRNDArray data
+    (densified per batch by consumers that need dense; the sparse dot path
+    takes CSR directly).
+    """
+
+    def __init__(self, data_libsvm, data_shape, label_shape=None,
+                 batch_size=1, **kwargs):  # noqa: ARG002
+        super().__init__(batch_size)
+        self._num_features = int(data_shape[-1] if hasattr(
+            data_shape, "__len__") else data_shape)
+        labels, rows = [], []
+        with open(data_libsvm) as f:
+            for line in f:
+                parts = line.strip().split()
+                if not parts:
+                    continue
+                labels.append(float(parts[0]))
+                rows.append([(int(kv.split(":")[0]),
+                              float(kv.split(":")[1])) for kv in parts[1:]])
+        self._labels = _np.asarray(labels, _np.float32)
+        self._rows = rows
+        self._cursor = 0
+
+    def reset(self):
+        self._cursor = 0
+
+    @property
+    def provide_data(self):
+        return [DataDesc("data", (self.batch_size, self._num_features))]
+
+    @property
+    def provide_label(self):
+        return [DataDesc("softmax_label", (self.batch_size,))]
+
+    def __next__(self):
+        from ..ndarray import sparse as _sp
+
+        if self._cursor >= len(self._rows):
+            raise StopIteration
+        stop = min(self._cursor + self.batch_size, len(self._rows))
+        batch_rows = self._rows[self._cursor:stop]
+        labels = self._labels[self._cursor:stop]
+        pad = self.batch_size - len(batch_rows)
+        data, indices, indptr = [], [], [0]
+        for r in batch_rows + [batch_rows[-1]] * pad:
+            for idx, val in r:
+                indices.append(idx)
+                data.append(val)
+            indptr.append(len(data))
+        if pad:
+            labels = _np.concatenate([labels, [labels[-1]] * pad])
+        csr = _sp.CSRNDArray(
+            _np.asarray(data, _np.float32), _np.asarray(indices, _np.int64),
+            _np.asarray(indptr, _np.int64),
+            (self.batch_size, self._num_features))
+        self._cursor = stop
+        from .. import numpy as mxnp
+
+        return DataBatch(data=[csr], label=[mxnp.array(labels)], pad=pad,
+                         provide_data=self.provide_data,
+                         provide_label=self.provide_label)
+
+    next = __next__
 
 
 class MNISTIter(NDArrayIter):

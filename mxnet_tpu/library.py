@@ -1,0 +1,144 @@
+"""External operator library loading (reference: python/mxnet/library.py
+dlopen of user .so built against include/mxnet/lib_api.h, MX_LIBRARY_VERSION
+11 — CustomOp/CustomPartitioner/CustomPass without rebuilding the framework).
+
+TPU re-design: the versioned C ABI is a small tensor struct + compute entry
+points (see native/mxtpu_ext_example.cc). Loaded ops execute on host buffers
+via ctypes and are wrapped as framework ops: they appear under `mx.nd.<name>`
+and integrate with autograd through the numerical path only if the library
+provides a backward entry (suffix `_backward`), mirroring how lib_api custom
+ops declare gradients. Graph passes/partitioners have no analog here — XLA
+owns the graph (SURVEY.md §7 translation table: subgraph properties →
+whole-graph jit).
+"""
+from __future__ import annotations
+
+import ctypes
+import os
+
+import numpy as _np
+
+from .ndarray.ndarray import NDArray
+
+__all__ = ["load", "loaded_libs", "MXTPU_LIB_VERSION"]
+
+MXTPU_LIB_VERSION = 1
+
+_LOADED = {}
+
+
+class _MXTensor(ctypes.Structure):
+    _fields_ = [
+        ("data", ctypes.POINTER(ctypes.c_float)),
+        ("shape", ctypes.POINTER(ctypes.c_int64)),
+        ("ndim", ctypes.c_int32),
+    ]
+
+
+def _to_mxtensor(arr, keepalive):
+    arr = _np.ascontiguousarray(arr, _np.float32)
+    shape = (ctypes.c_int64 * arr.ndim)(*arr.shape)
+    keepalive.extend([arr, shape])
+    return _MXTensor(
+        arr.ctypes.data_as(ctypes.POINTER(ctypes.c_float)), shape, arr.ndim)
+
+
+def loaded_libs():
+    return dict(_LOADED)
+
+
+def load(path, verbose=True):
+    """Load an external op library; returns the list of registered op names.
+
+    The library must export:
+      int  mxtpu_lib_version(void);
+      int  mxtpu_num_ops(void);
+      const char* mxtpu_op_name(int i);
+      int  mxtpu_op_num_outputs(int i);
+      int  mxtpu_op_compute(int i, MXTensor* ins, int n_in,
+                            MXTensor* outs, int n_out);
+    Output buffers are preallocated by the framework with the same shape as
+    input 0 (libraries needing other shapes export
+    mxtpu_op_infer_shape(int i, int64_t* shape, int* ndim)).
+    """
+    path = os.path.abspath(path)
+    lib = ctypes.CDLL(path)
+    lib.mxtpu_lib_version.restype = ctypes.c_int
+    version = lib.mxtpu_lib_version()
+    if version > MXTPU_LIB_VERSION:
+        raise RuntimeError(
+            f"library ABI v{version} newer than supported "
+            f"v{MXTPU_LIB_VERSION}")
+    lib.mxtpu_num_ops.restype = ctypes.c_int
+    lib.mxtpu_op_name.restype = ctypes.c_char_p
+    lib.mxtpu_op_name.argtypes = [ctypes.c_int]
+    lib.mxtpu_op_num_outputs.restype = ctypes.c_int
+    lib.mxtpu_op_num_outputs.argtypes = [ctypes.c_int]
+    lib.mxtpu_op_compute.restype = ctypes.c_int
+    lib.mxtpu_op_compute.argtypes = [
+        ctypes.c_int, ctypes.POINTER(_MXTensor), ctypes.c_int,
+        ctypes.POINTER(_MXTensor), ctypes.c_int]
+    has_infer = hasattr(lib, "mxtpu_op_infer_shape")
+    if has_infer:
+        lib.mxtpu_op_infer_shape.restype = ctypes.c_int
+        lib.mxtpu_op_infer_shape.argtypes = [
+            ctypes.c_int, ctypes.POINTER(ctypes.c_int64),
+            ctypes.POINTER(ctypes.c_int)]
+
+    names = []
+    from . import ndarray as nd_mod
+    from .ops.registry import register_op
+
+    for i in range(lib.mxtpu_num_ops()):
+        name = lib.mxtpu_op_name(i).decode()
+        n_out = lib.mxtpu_op_num_outputs(i)
+
+        def make_wrapper(idx, n_out, opname):
+            def wrapper(*inputs):
+                keep = []
+                np_ins = [x.asnumpy() if isinstance(x, NDArray)
+                          else _np.asarray(x) for x in inputs]
+                ins = (_MXTensor * len(np_ins))(
+                    *[_to_mxtensor(a, keep) for a in np_ins])
+                if has_infer:
+                    shape_buf = (ctypes.c_int64 * 8)()
+                    ndim = ctypes.c_int(0)
+                    rc = lib.mxtpu_op_infer_shape(idx, shape_buf,
+                                                  ctypes.byref(ndim))
+                    if rc != 0:
+                        raise RuntimeError(f"{opname}: infer_shape failed")
+                    out_shape = tuple(shape_buf[: ndim.value])
+                else:
+                    out_shape = np_ins[0].shape
+                np_outs = [_np.zeros(out_shape, _np.float32)
+                           for _ in range(n_out)]
+                outs = (_MXTensor * n_out)(
+                    *[_to_mxtensor(a, keep) for a in np_outs])
+                rc = lib.mxtpu_op_compute(idx, ins, len(np_ins), outs, n_out)
+                if rc != 0:
+                    raise RuntimeError(f"external op {opname} returned {rc}")
+                # read back through the MXTensor pointers (ascontiguousarray
+                # may have copied)
+                results = []
+                for t in outs:
+                    n = 1
+                    for d in range(t.ndim):
+                        n *= t.shape[d]
+                    flat = _np.ctypeslib.as_array(t.data, shape=(n,))
+                    results.append(NDArray(flat.reshape(
+                        tuple(t.shape[d] for d in range(t.ndim))).copy()))
+                return tuple(results) if n_out > 1 else results[0]
+
+            wrapper.__name__ = opname
+            wrapper.__doc__ = f"external op {opname} from {path}"
+            return wrapper
+
+        w = make_wrapper(i, n_out, name)
+        register_op(f"lib::{name}", w)
+        setattr(nd_mod, name, w)
+        names.append(name)
+    _LOADED[path] = names
+    if verbose:
+        print(f"loaded library {os.path.basename(path)} "
+              f"(ABI v{version}): ops {names}")
+    return names

@@ -20,6 +20,13 @@ from . import sparse  # noqa: F401
 from .ndarray import NDArray, apply_op, from_jax, waitall  # noqa: F401
 from .utils import load, save, savez  # noqa: F401
 
+
+def Custom(*inputs, op_type=None, **kwargs):  # noqa: N802
+    """Invoke a registered python CustomOp (reference: mx.nd.Custom)."""
+    from ..operator import Custom as _custom
+
+    return _custom(*inputs, op_type=op_type, **kwargs)
+
 concat = concatenate
 
 # legacy op names commonly used in reference scripts

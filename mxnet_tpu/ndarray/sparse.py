@@ -273,13 +273,18 @@ def dot(lhs, rhs, transpose_a=False, transpose_b=False):
         data, indices = lhs.data, lhs.indices
 
         def pure(d):
+            vec = d.ndim == 1
+            if vec:
+                d = d[:, None]
             if not transpose_a:
                 gathered = data[:, None] * d[indices]           # (nnz, D)
-                return jax.ops.segment_sum(gathered, row_ids,
-                                           num_segments=n_rows)
-            gathered = data[:, None] * d[row_ids]               # (nnz, D)
-            return jnp.zeros((n_cols, d.shape[1]), gathered.dtype).at[
-                indices].add(gathered)
+                out = jax.ops.segment_sum(gathered, row_ids,
+                                          num_segments=n_rows)
+            else:
+                gathered = data[:, None] * d[row_ids]           # (nnz, D)
+                out = jnp.zeros((n_cols, d.shape[1]), gathered.dtype).at[
+                    indices].add(gathered)
+            return out[:, 0] if vec else out
 
         return apply_op(pure, rhs, name="sparse_dot") if isinstance(
             rhs, NDArray) else NDArray(pure(jnp.asarray(rhs)))
@@ -293,9 +298,13 @@ def dot(lhs, rhs, transpose_a=False, transpose_b=False):
         def pure_rsp(d):
             if transpose_b:
                 d = d.T
+            vec = d.ndim == 1
+            if vec:
+                d = d[:, None]
             partial = data @ d                                   # (k, D)
-            return jnp.zeros((n_rows, d.shape[1]), partial.dtype).at[
+            out = jnp.zeros((n_rows, d.shape[1]), partial.dtype).at[
                 indices].add(partial)
+            return out[:, 0] if vec else out
 
         return apply_op(pure_rsp, rhs, name="sparse_dot") if isinstance(
             rhs, NDArray) else NDArray(pure_rsp(jnp.asarray(rhs)))

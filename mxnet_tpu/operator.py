@@ -1,0 +1,163 @@
+"""Python CustomOp API (reference: python/mxnet/operator.py:434,487,710 —
+CustomOp/CustomOpProp/register and the C-side async CustomOperator worker,
+src/operator/custom/custom-inl.h:51).
+
+TPU re-design: a custom op is an eager Python callable whose forward/backward
+run on NDArrays (device arrays under the hood) and whose autograd integration
+rides the tape's Function node — no separate worker queue is needed because
+JAX dispatch is already async. Ops registered here are invokable as
+`mx.nd.Custom(*data, op_type=name)` exactly like the reference.
+"""
+from __future__ import annotations
+
+from . import autograd as ag
+from .ndarray.ndarray import NDArray
+
+__all__ = ["CustomOp", "CustomOpProp", "register", "Custom", "get_all_registered"]
+
+_REGISTRY = {}
+
+
+class CustomOp:
+    """Base class for custom operators (reference: operator.py:434)."""
+
+    def forward(self, is_train, req, in_data, out_data, aux):
+        raise NotImplementedError
+
+    def backward(self, req, out_grad, in_data, out_data, in_grad, aux):
+        raise NotImplementedError
+
+    @staticmethod
+    def assign(dst, req, src):
+        """Write `src` into `dst` honoring the grad_req
+        (reference: operator.py:463)."""
+        if req in ("null", None):
+            return
+        if req in ("write", "inplace"):
+            dst[:] = src
+        elif req == "add":
+            dst[:] = dst + src
+        else:
+            raise ValueError(f"unknown req {req!r}")
+
+
+class CustomOpProp:
+    """Operator properties: names, shapes, types, factory
+    (reference: operator.py:487)."""
+
+    def __init__(self, need_top_grad=True):
+        self.need_top_grad_ = need_top_grad
+
+    def list_arguments(self):
+        return ["data"]
+
+    def list_outputs(self):
+        return ["output"]
+
+    def list_auxiliary_states(self):
+        return []
+
+    def infer_shape(self, in_shape):
+        return in_shape, [in_shape[0]] * len(self.list_outputs()), []
+
+    def infer_type(self, in_type):
+        return (in_type, [in_type[0]] * len(self.list_outputs()),
+                [in_type[0]] * len(self.list_auxiliary_states()))
+
+    def infer_storage_type(self, in_stype):
+        return (in_stype, ["default"] * len(self.list_outputs()),
+                ["default"] * len(self.list_auxiliary_states()))
+
+    def declare_backward_dependency(self, out_grad, in_data, out_data):
+        deps = []
+        if self.need_top_grad_:
+            deps.extend(out_grad)
+        deps.extend(in_data)
+        deps.extend(out_data)
+        return deps
+
+    def create_operator(self, ctx, in_shapes, in_dtypes):
+        raise NotImplementedError
+
+
+def register(reg_name):
+    """Class decorator registering a CustomOpProp under `reg_name`
+    (reference: operator.py:710)."""
+
+    def _do(prop_cls):
+        if not issubclass(prop_cls, CustomOpProp):
+            raise TypeError("register expects a CustomOpProp subclass")
+        _REGISTRY[reg_name] = prop_cls
+        return prop_cls
+
+    return _do
+
+
+def get_all_registered():
+    return sorted(_REGISTRY)
+
+
+class _CustomFunction(ag.Function):
+    """Bridges CustomOp.forward/backward onto the autograd tape."""
+
+    def __init__(self, op, prop, n_out):
+        super().__init__()
+        self._op = op
+        self._prop = prop
+        self._n_out = n_out
+
+    def forward(self, *inputs):
+        from . import numpy as mxnp
+
+        in_shapes = [list(i.shape) for i in inputs]
+        _, out_shapes, _ = self._prop.infer_shape(in_shapes)
+        in_types = [i.dtype for i in inputs]
+        _, out_types, _ = self._prop.infer_type(in_types)
+        outs = [mxnp.zeros(tuple(s), dtype=t)
+                for s, t in zip(out_shapes, out_types)]
+        self._op.forward(is_train=ag.is_training(),
+                         req=["write"] * len(outs),
+                         in_data=list(inputs), out_data=outs, aux=[])
+        self._inputs = list(inputs)
+        self._outputs = outs
+        return tuple(outs) if len(outs) > 1 else outs[0]
+
+    def backward(self, *output_grads):
+        from . import numpy as mxnp
+
+        in_grads = [mxnp.zeros(i.shape, dtype=i.dtype) for i in self._inputs]
+        self._op.backward(req=["write"] * len(in_grads),
+                          out_grad=list(output_grads),
+                          in_data=self._inputs, out_data=self._outputs,
+                          in_grad=in_grads, aux=[])
+        return tuple(in_grads) if len(in_grads) > 1 else in_grads[0]
+
+
+def Custom(*inputs, op_type=None, **kwargs):  # noqa: N802
+    """Invoke a registered custom op: `mx.nd.Custom(x, op_type='my_op')`."""
+    if op_type is None:
+        raise ValueError("Custom requires op_type=")
+    prop_cls = _REGISTRY.get(op_type)
+    if prop_cls is None:
+        raise KeyError(f"custom op {op_type!r} not registered "
+                       f"(have: {get_all_registered()})")
+    import inspect
+
+    sig = inspect.signature(prop_cls.__init__)
+    has_var_kw = any(p.kind is inspect.Parameter.VAR_KEYWORD
+                     for p in sig.parameters.values())
+    if not has_var_kw:
+        unknown = [k for k in kwargs if k not in sig.parameters]
+        if unknown:
+            raise TypeError(
+                f"custom op {op_type!r} got unexpected parameter(s) "
+                f"{unknown}; {prop_cls.__name__}.__init__ accepts "
+                f"{[p for p in sig.parameters if p != 'self']}")
+    prop = prop_cls(**kwargs)
+    nd_inputs = [i for i in inputs if isinstance(i, NDArray)]
+    in_shapes = [list(i.shape) for i in nd_inputs]
+    in_types = [i.dtype for i in nd_inputs]
+    dev = nd_inputs[0].device if nd_inputs else None
+    op = prop.create_operator(dev, in_shapes, in_types)
+    fn = _CustomFunction(op, prop, len(prop.list_outputs()))
+    return fn(*nd_inputs)

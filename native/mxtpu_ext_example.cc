@@ -1,0 +1,63 @@
+// Example external operator library for mxnet_tpu/library.py.
+//
+// Reference analog: example/extensions/lib_custom_op/gemm_lib.cc built
+// against include/mxnet/lib_api.h (MX_LIBRARY_VERSION). This is the
+// TPU-framework's versioned C ABI: a flat tensor struct + compute entry
+// points, loaded via ctypes without rebuilding the framework.
+//
+// Build: g++ -O2 -std=c++17 -fPIC -shared -o libmxtpu_ext_example.so \
+//            mxtpu_ext_example.cc
+#include <cmath>
+#include <cstdint>
+
+extern "C" {
+
+struct MXTensor {
+  float* data;
+  int64_t* shape;
+  int32_t ndim;
+};
+
+int mxtpu_lib_version() { return 1; }
+
+// ops: 0 = my_relu (1 in, 1 out), 1 = my_square_and_double (1 in, 2 out)
+int mxtpu_num_ops() { return 2; }
+
+const char* mxtpu_op_name(int i) {
+  switch (i) {
+    case 0: return "my_relu";
+    case 1: return "my_square_and_double";
+    default: return "";
+  }
+}
+
+int mxtpu_op_num_outputs(int i) { return i == 1 ? 2 : 1; }
+
+static int64_t numel(const MXTensor& t) {
+  int64_t n = 1;
+  for (int d = 0; d < t.ndim; ++d) n *= t.shape[d];
+  return n;
+}
+
+int mxtpu_op_compute(int i, MXTensor* ins, int n_in, MXTensor* outs,
+                     int n_out) {
+  if (n_in < 1 || n_out < 1) return 1;
+  const int64_t n = numel(ins[0]);
+  switch (i) {
+    case 0:
+      for (int64_t k = 0; k < n; ++k)
+        outs[0].data[k] = ins[0].data[k] > 0 ? ins[0].data[k] : 0.f;
+      return 0;
+    case 1:
+      if (n_out != 2) return 1;
+      for (int64_t k = 0; k < n; ++k) {
+        outs[0].data[k] = ins[0].data[k] * ins[0].data[k];
+        outs[1].data[k] = 2.f * ins[0].data[k];
+      }
+      return 0;
+    default:
+      return 2;
+  }
+}
+
+}  // extern "C"

@@ -1,0 +1,149 @@
+"""Python CustomOp API + external op library loading.
+
+Reference coverage model: tests/python/unittest/test_operator.py custom-op
+section and example/extensions/lib_custom_op tests.
+"""
+import os
+import subprocess
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import autograd
+from mxnet_tpu.operator import CustomOp, CustomOpProp, register
+
+
+@register("scaled_square")
+class ScaledSquareProp(CustomOpProp):
+    def __init__(self, scale=2.0):
+        super().__init__(need_top_grad=True)
+        self.scale = float(scale)
+
+    def list_arguments(self):
+        return ["data"]
+
+    def list_outputs(self):
+        return ["output"]
+
+    def create_operator(self, ctx, in_shapes, in_dtypes):
+        return ScaledSquare(self.scale)
+
+
+class ScaledSquare(CustomOp):
+    def __init__(self, scale):
+        self.scale = scale
+
+    def forward(self, is_train, req, in_data, out_data, aux):
+        x = in_data[0]
+        self.assign(out_data[0], req[0], x * x * self.scale)
+
+    def backward(self, req, out_grad, in_data, out_data, in_grad, aux):
+        x = in_data[0]
+        self.assign(in_grad[0], req[0], out_grad[0] * 2 * x * self.scale)
+
+
+@register("two_out")
+class TwoOutProp(CustomOpProp):
+    def list_outputs(self):
+        return ["sq", "neg"]
+
+    def infer_shape(self, in_shape):
+        return in_shape, [in_shape[0], in_shape[0]], []
+
+    def create_operator(self, ctx, in_shapes, in_dtypes):
+        return TwoOut()
+
+
+class TwoOut(CustomOp):
+    def forward(self, is_train, req, in_data, out_data, aux):
+        self.assign(out_data[0], req[0], in_data[0] * in_data[0])
+        self.assign(out_data[1], req[1], -in_data[0])
+
+    def backward(self, req, out_grad, in_data, out_data, in_grad, aux):
+        self.assign(in_grad[0], req[0],
+                    out_grad[0] * 2 * in_data[0] - out_grad[1])
+
+
+def test_custom_forward():
+    x = mx.np.array([1.0, -2.0, 3.0])
+    y = mx.nd.Custom(x, op_type="scaled_square", scale=3.0)
+    assert np.allclose(y.asnumpy(), [3.0, 12.0, 27.0])
+
+
+def test_custom_backward():
+    x = mx.np.array([1.0, -2.0, 3.0])
+    x.attach_grad()
+    with autograd.record():
+        y = mx.Custom(x, op_type="scaled_square")  # default scale=2
+        y.backward(mx.np.ones((3,)))
+    assert np.allclose(x.grad.asnumpy(), [4.0, -8.0, 12.0])
+
+
+def test_custom_multi_output():
+    x = mx.np.array([1.0, 2.0])
+    x.attach_grad()
+    with autograd.record():
+        sq, neg = mx.nd.Custom(x, op_type="two_out")
+        loss = (sq + neg).sum()
+    loss.backward()
+    assert np.allclose(sq.asnumpy(), [1.0, 4.0])
+    assert np.allclose(neg.asnumpy(), [-1.0, -2.0])
+    assert np.allclose(x.grad.asnumpy(), 2 * x.asnumpy() - 1)
+
+
+def test_custom_unknown_raises():
+    with pytest.raises(KeyError):
+        mx.nd.Custom(mx.np.ones((2,)), op_type="nope")
+
+
+def test_custom_typod_kwarg_raises():
+    with pytest.raises(TypeError):
+        mx.nd.Custom(mx.np.ones((2,)), op_type="scaled_square", scal=3.0)
+
+
+def test_custom_var_kwargs_prop_receives_params():
+    @register("kw_op")
+    class KwProp(CustomOpProp):
+        def __init__(self, **kwargs):
+            super().__init__()
+            self.alpha = float(kwargs.get("alpha", 1.0))
+
+        def create_operator(self, ctx, in_shapes, in_dtypes):
+            alpha = self.alpha
+
+            class Op(CustomOp):
+                def forward(self, is_train, req, in_data, out_data, aux):
+                    self.assign(out_data[0], req[0], in_data[0] * alpha)
+
+            return Op()
+
+    out = mx.nd.Custom(mx.np.ones((2,)), op_type="kw_op", alpha=5.0)
+    assert np.allclose(out.asnumpy(), 5.0)
+
+
+def test_registry_listing():
+    names = mx.operator.get_all_registered()
+    assert "scaled_square" in names and "two_out" in names
+
+
+@pytest.fixture(scope="module")
+def ext_lib(tmp_path_factory):
+    src = os.path.join(os.path.dirname(__file__), "..", "native",
+                       "mxtpu_ext_example.cc")
+    out = str(tmp_path_factory.mktemp("ext") / "libmxtpu_ext_example.so")
+    subprocess.run(["g++", "-O2", "-std=c++17", "-fPIC", "-shared",
+                    "-o", out, src], check=True)
+    return out
+
+
+def test_library_load_and_run(ext_lib):
+    names = mx.library.load(ext_lib, verbose=False)
+    assert set(names) == {"my_relu", "my_square_and_double"}
+    x = mx.np.array([[-1.0, 2.0], [3.0, -4.0]])
+    y = mx.nd.my_relu(x)
+    assert np.allclose(y.asnumpy(), [[0, 2], [3, 0]])
+    sq, dbl = mx.nd.my_square_and_double(x)
+    assert np.allclose(sq.asnumpy(), x.asnumpy() ** 2)
+    assert np.allclose(dbl.asnumpy(), 2 * x.asnumpy())
+    assert ext_lib in mx.library.loaded_libs()

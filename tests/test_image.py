@@ -1,0 +1,179 @@
+"""mx.image augmenters + ImageIter + LibSVMIter.
+
+Reference coverage model: tests/python/unittest/test_image.py.
+"""
+import os
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import image as mi
+
+PIL = pytest.importorskip("PIL")
+from PIL import Image  # noqa: E402
+
+
+@pytest.fixture()
+def img_file(tmp_path):
+    arr = (np.random.uniform(0, 255, size=(48, 64, 3))).astype("uint8")
+    p = os.path.join(tmp_path, "t.jpg")
+    Image.fromarray(arr).save(p)
+    return p, arr
+
+
+def test_imread_imresize(img_file):
+    p, _ = img_file
+    img = mi.imread(p)
+    assert img.shape == (48, 64, 3)
+    assert img.dtype == np.uint8
+    small = mi.imresize(img, 32, 24)
+    assert small.shape == (24, 32, 3)
+
+
+def test_imdecode(img_file):
+    p, _ = img_file
+    with open(p, "rb") as f:
+        buf = f.read()
+    img = mi.imdecode(buf)
+    assert img.shape == (48, 64, 3)
+    gray = mi.imdecode(buf, flag=0)
+    assert gray.shape == (48, 64, 1)
+
+
+def test_resize_short_and_crops(img_file):
+    p, _ = img_file
+    img = mi.imread(p)
+    r = mi.resize_short(img, 32)
+    assert min(r.shape[:2]) == 32
+    c, rect = mi.center_crop(img, (32, 24))
+    assert c.shape == (24, 32, 3)
+    assert rect[2] == 32 and rect[3] == 24
+    rc, _ = mi.random_crop(img, (20, 20))
+    assert rc.shape == (20, 20, 3)
+    rsc, _ = mi.random_size_crop(img, (20, 20), (0.3, 1.0), (0.75, 1.33))
+    assert rsc.shape == (20, 20, 3)
+
+
+def test_color_ops(img_file):
+    p, _ = img_file
+    img = mi.imread(p)
+    n = mi.color_normalize(img, mean=[123.0, 117.0, 104.0],
+                           std=[58.0, 57.0, 57.0])
+    assert n.dtype == np.float32
+    for aug in (mi.BrightnessJitterAug(0.3), mi.ContrastJitterAug(0.3),
+                mi.SaturationJitterAug(0.3), mi.HueJitterAug(0.1),
+                mi.RandomGrayAug(1.0), mi.LightingAug(
+                    0.1, np.ones(3), np.eye(3))):
+        out = aug(img)
+        assert out.shape == img.shape
+
+
+def test_flip_and_pad(img_file):
+    p, arr = img_file
+    img = mi.imread(p)
+    flipped = mi.HorizontalFlipAug(1.0)(img)
+    assert np.allclose(flipped.asnumpy(), img.asnumpy()[:, ::-1])
+    padded = mi.copyMakeBorder(img, 2, 3, 4, 5)
+    assert padded.shape == (48 + 5, 64 + 9, 3)
+
+
+def test_imrotate(img_file):
+    p, _ = img_file
+    img = mi.imread(p)
+    rot = mi.imrotate(img, 30)
+    assert rot.shape == img.shape
+    rr = mi.random_rotate(img, (-10, 10))
+    assert rr.shape == img.shape
+    zo = mi.imrotate(img, 45, zoom_out=True)
+    assert zo.shape == img.shape
+    # zoom_out shrinks content: corners that plain rotation clips to 0 are
+    # preserved, so the two outputs must differ
+    assert not np.allclose(zo.asnumpy(), rot.asnumpy())
+    with pytest.raises(ValueError):
+        mi.imrotate(img, 10, zoom_in=True, zoom_out=True)
+
+
+def test_det_crop_enforces_coverage():
+    from mxnet_tpu.image.detection import _coverage, _crop_boxes
+
+    label = np.array([[0, 0.4, 0.4, 0.9, 0.9]])
+    crop = (0.0, 0.0, 0.45, 0.45)
+    cov = _coverage(label, crop)
+    assert cov[0] < 0.01  # sliver only
+    kept = _crop_boxes(label, crop, min_eject_coverage=0.3)
+    assert len(kept) == 0  # sliver ejected
+
+
+def test_create_augmenter_pipeline(img_file):
+    p, _ = img_file
+    img = mi.imread(p)
+    augs = mi.CreateAugmenter((3, 24, 24), resize=32, rand_crop=True,
+                              rand_mirror=True, mean=True, std=True,
+                              brightness=0.1, contrast=0.1, saturation=0.1,
+                              hue=0.05, pca_noise=0.05, rand_gray=0.1)
+    out = img
+    for a in augs:
+        out = a(out)
+    assert out.shape == (24, 24, 3)
+    assert out.dtype == np.float32
+    assert all(a.dumps() for a in augs)
+
+
+def test_image_iter_from_list(tmp_path):
+    paths = []
+    for i in range(5):
+        arr = np.full((40, 40, 3), i * 40, "uint8")
+        pth = os.path.join(tmp_path, f"i{i}.jpg")
+        Image.fromarray(arr).save(pth)
+        paths.append(pth)
+    lst = os.path.join(tmp_path, "data.lst")
+    with open(lst, "w") as f:
+        for i, pth in enumerate(paths):
+            f.write(f"{i}\t{i % 2}\t{pth}\n")
+    it = mi.ImageIter(batch_size=2, data_shape=(3, 24, 24),
+                      path_imglist=lst,
+                      aug_list=[mi.ForceResizeAug((24, 24)), mi.CastAug()])
+    batches = list(it)
+    assert len(batches) == 3
+    assert batches[0].data[0].shape == (2, 3, 24, 24)
+    assert batches[-1].pad == 1
+    it.reset()
+    assert next(it).data[0].shape == (2, 3, 24, 24)
+
+
+def test_det_augmenters(img_file):
+    p, _ = img_file
+    from mxnet_tpu.image import detection as det
+
+    img = mi.imread(p)
+    label = np.array([[0, 0.2, 0.2, 0.6, 0.6], [1, 0.5, 0.5, 0.9, 0.9]])
+    out, lbl = det.DetHorizontalFlipAug(1.0)(img, label)
+    assert np.allclose(lbl[0, 1], 1 - 0.6) and np.allclose(lbl[0, 3], 1 - 0.2)
+    out, lbl = det.DetForceResizeAug((32, 32))(img, label)
+    assert out.shape == (32, 32, 3)
+    augs = det.CreateDetAugmenter((3, 32, 32), rand_crop=0.5, rand_pad=0.5,
+                                  rand_mirror=True, mean=True, std=True)
+    o, l2 = img, label
+    for a in augs:
+        o, l2 = a(o, l2)
+    assert o.shape == (32, 32, 3)
+    assert l2.shape[1] == 5
+
+
+def test_libsvm_iter(tmp_path):
+    f = os.path.join(tmp_path, "d.libsvm")
+    with open(f, "w") as fh:
+        fh.write("1 0:1.5 3:2.0\n")
+        fh.write("0 1:0.5\n")
+        fh.write("1 2:3.0 4:1.0\n")
+    it = mx.io.LibSVMIter(data_libsvm=f, data_shape=(5,), batch_size=2)
+    b1 = next(it)
+    assert b1.data[0].stype == "csr"
+    dense = b1.data[0].asnumpy()
+    assert dense.shape == (2, 5)
+    assert dense[0, 0] == 1.5 and dense[0, 3] == 2.0 and dense[1, 1] == 0.5
+    b2 = next(it)
+    assert b2.pad == 1
+    with pytest.raises(StopIteration):
+        next(it)

@@ -68,6 +68,17 @@ def test_csr_dot_dense():
     assert np.allclose(outT.asnumpy(), a.T @ c, atol=1e-5)
 
 
+def test_csr_dot_vector():
+    a = _rand_dense((5, 7))
+    v = np.random.uniform(size=(7,)).astype("float32")
+    out = sparse.dot(sparse.csr_matrix(a), mx.np.array(v))
+    assert out.shape == (5,)
+    assert np.allclose(out.asnumpy(), a @ v, atol=1e-5)
+    rsp_out = sparse.dot(sparse.row_sparse_array(a), mx.np.array(v))
+    assert rsp_out.shape == (5,)
+    assert np.allclose(rsp_out.asnumpy(), a @ v, atol=1e-5)
+
+
 def test_rsp_dot_dense():
     a = _rand_dense((6, 4))
     rsp = sparse.row_sparse_array(a)
