@@ -55,6 +55,7 @@ from . import kvstore  # noqa: E402
 from . import io  # noqa: E402
 from . import image  # noqa: E402
 from . import library  # noqa: E402
+from . import onnx  # noqa: E402
 from . import operator  # noqa: E402
 from .operator import Custom  # noqa: E402
 from . import recordio  # noqa: E402

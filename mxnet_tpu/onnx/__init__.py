@@ -1,0 +1,3 @@
+"""mx.onnx — ONNX export (reference: python/mxnet/onnx/)."""
+from . import _proto  # noqa: F401
+from .mx2onnx import export_model  # noqa: F401

@@ -1,0 +1,318 @@
+"""Minimal ONNX protobuf wire-format encoder/decoder (no onnx dependency).
+
+The environment has no `onnx` package, so the exporter emits the protobuf
+wire format directly (field numbers from the stable onnx.proto schema) and
+the decoder here doubles as the structural checker the reference got from
+onnx.checker. Wire format: tag = (field_num << 3) | wire_type; wire types:
+0 = varint, 1 = fixed64, 2 = length-delimited, 5 = fixed32.
+"""
+from __future__ import annotations
+
+import struct
+
+import numpy as _np
+
+# TensorProto.DataType
+DTYPE = {
+    "float32": 1, "uint8": 2, "int8": 3, "uint16": 4, "int16": 5,
+    "int32": 6, "int64": 7, "bool": 9, "float16": 10, "float64": 11,
+    "uint32": 12, "uint64": 13, "bfloat16": 16,
+}
+DTYPE_REV = {v: k for k, v in DTYPE.items()}
+
+# AttributeProto.AttributeType
+ATTR_FLOAT, ATTR_INT, ATTR_STRING, ATTR_TENSOR = 1, 2, 3, 4
+ATTR_FLOATS, ATTR_INTS, ATTR_STRINGS = 6, 7, 8
+
+
+def _varint(n):
+    n &= (1 << 64) - 1
+    out = bytearray()
+    while True:
+        b = n & 0x7F
+        n >>= 7
+        if n:
+            out.append(b | 0x80)
+        else:
+            out.append(b)
+            return bytes(out)
+
+
+def _tag(field, wt):
+    return _varint((field << 3) | wt)
+
+
+def f_int(field, v):
+    return _tag(field, 0) + _varint(int(v))
+
+
+def f_bytes(field, b):
+    return _tag(field, 2) + _varint(len(b)) + bytes(b)
+
+
+def f_str(field, s):
+    return f_bytes(field, s.encode())
+
+
+f_msg = f_bytes
+
+
+def f_float(field, v):
+    return _tag(field, 5) + struct.pack("<f", v)
+
+
+def f_rep_int(field, vals):
+    return b"".join(f_int(field, v) for v in vals)
+
+
+# --- ONNX message builders -------------------------------------------------
+
+def tensor(name, arr):
+    """TensorProto: dims=1, data_type=2, name=8, raw_data=9."""
+    arr = _np.asarray(arr)
+    dt = DTYPE[str(arr.dtype)]
+    body = f_rep_int(1, arr.shape)
+    body += f_int(2, dt)
+    body += f_str(8, name)
+    body += f_bytes(9, arr.astype(arr.dtype, order="C").tobytes())
+    return body
+
+
+def value_info(name, shape, elem_type=1):
+    """ValueInfoProto: name=1, type=2{tensor_type=1{elem_type=1, shape=2}}."""
+    dims = b"".join(
+        f_msg(1, f_str(2, d) if isinstance(d, str) else f_int(1, d))
+        for d in shape)
+    ttype = f_int(1, elem_type) + f_msg(2, dims)
+    return f_str(1, name) + f_msg(2, f_msg(1, ttype))
+
+
+def attr(name, value):
+    """AttributeProto with type tagging."""
+    body = f_str(1, name)
+    if isinstance(value, bool):
+        body += f_int(3, int(value)) + f_int(20, ATTR_INT)
+    elif isinstance(value, int):
+        body += f_int(3, value) + f_int(20, ATTR_INT)
+    elif isinstance(value, float):
+        body += f_float(2, value) + f_int(20, ATTR_FLOAT)
+    elif isinstance(value, str):
+        body += f_bytes(4, value.encode()) + f_int(20, ATTR_STRING)
+    elif isinstance(value, bytes):
+        body += f_bytes(4, value) + f_int(20, ATTR_STRING)
+    elif isinstance(value, _np.ndarray):
+        body += f_msg(5, tensor(name + "_t", value)) + f_int(20, ATTR_TENSOR)
+    elif isinstance(value, (list, tuple)):
+        if value and isinstance(value[0], float):
+            body += b"".join(f_float(7, v) for v in value)
+            body += f_int(20, ATTR_FLOATS)
+        else:
+            body += b"".join(f_int(8, int(v)) for v in value)
+            body += f_int(20, ATTR_INTS)
+    else:
+        raise TypeError(f"attr {name}: unsupported {type(value)}")
+    return body
+
+
+def node(op_type, inputs, outputs, name="", attrs=None):
+    """NodeProto: input=1, output=2, name=3, op_type=4, attribute=5."""
+    body = b"".join(f_str(1, i) for i in inputs)
+    body += b"".join(f_str(2, o) for o in outputs)
+    if name:
+        body += f_str(3, name)
+    body += f_str(4, op_type)
+    for k, v in (attrs or {}).items():
+        body += f_msg(5, attr(k, v))
+    return body
+
+
+def graph(nodes, name, initializers, inputs, outputs):
+    """GraphProto: node=1, name=2, initializer=5, input=11, output=12."""
+    body = b"".join(f_msg(1, n) for n in nodes)
+    body += f_str(2, name)
+    body += b"".join(f_msg(5, t) for t in initializers)
+    body += b"".join(f_msg(11, v) for v in inputs)
+    body += b"".join(f_msg(12, v) for v in outputs)
+    return body
+
+
+def model(graph_bytes, opset=11, producer="mxnet_tpu"):
+    """ModelProto: ir_version=1, producer_name=2, graph=7, opset_import=8."""
+    body = f_int(1, 7)  # IR version 7 pairs with opset 11
+    body += f_str(2, producer)
+    body += f_msg(7, graph_bytes)
+    body += f_msg(8, f_str(1, "") + f_int(2, opset))
+    return body
+
+
+# --- decoder (structural checker) ------------------------------------------
+
+def _read_varint(buf, pos):
+    out = shift = 0
+    while True:
+        b = buf[pos]
+        pos += 1
+        out |= (b & 0x7F) << shift
+        if not b & 0x80:
+            return out, pos
+        shift += 7
+
+
+def _fields(buf):
+    """Yield (field_num, wire_type, value) over a message buffer."""
+    pos = 0
+    while pos < len(buf):
+        tag, pos = _read_varint(buf, pos)
+        field, wt = tag >> 3, tag & 7
+        if wt == 0:
+            v, pos = _read_varint(buf, pos)
+            if v >= 1 << 63:  # two's-complement int64 (e.g. axis=-1)
+                v -= 1 << 64
+        elif wt == 2:
+            ln, pos = _read_varint(buf, pos)
+            v = buf[pos:pos + ln]
+            pos += ln
+        elif wt == 5:
+            v = struct.unpack("<f", buf[pos:pos + 4])[0]
+            pos += 4
+        elif wt == 1:
+            v = struct.unpack("<d", buf[pos:pos + 8])[0]
+            pos += 8
+        else:
+            raise ValueError(f"bad wire type {wt}")
+        yield field, wt, v
+
+
+def parse_tensor(buf):
+    out = {"dims": [], "name": None, "data_type": None, "raw": None}
+    for field, _, v in _fields(buf):
+        if field == 1:
+            out["dims"].append(v)
+        elif field == 2:
+            out["data_type"] = v
+        elif field == 8:
+            out["name"] = v.decode()
+        elif field == 9:
+            out["raw"] = v
+    if out["raw"] is not None and out["data_type"] in DTYPE_REV:
+        out["array"] = _np.frombuffer(
+            out["raw"], DTYPE_REV[out["data_type"]]).reshape(out["dims"])
+    return out
+
+
+def parse_node(buf):
+    out = {"input": [], "output": [], "op_type": None, "name": "",
+           "attrs": {}}
+    for field, _, v in _fields(buf):
+        if field == 1:
+            out["input"].append(v.decode())
+        elif field == 2:
+            out["output"].append(v.decode())
+        elif field == 3:
+            out["name"] = v.decode()
+        elif field == 4:
+            out["op_type"] = v.decode()
+        elif field == 5:
+            a = _parse_attr(v)
+            out["attrs"][a[0]] = a[1]
+    return out
+
+
+def _parse_attr(buf):
+    name, val, ints, floats = None, None, [], []
+    for field, wt, v in _fields(buf):
+        if field == 1:
+            name = v.decode()
+        elif field == 2:
+            val = v
+        elif field == 3:
+            val = v
+        elif field == 4:
+            val = v.decode() if isinstance(v, (bytes, bytearray)) else v
+        elif field == 5:
+            val = parse_tensor(v)
+        elif field == 7:
+            floats.append(v)
+        elif field == 8:
+            ints.append(v)
+    if ints:
+        val = ints
+    elif floats:
+        val = floats
+    return name, val
+
+
+def parse_graph(buf):
+    out = {"nodes": [], "name": None, "initializers": [], "inputs": [],
+           "outputs": []}
+    for field, _, v in _fields(buf):
+        if field == 1:
+            out["nodes"].append(parse_node(v))
+        elif field == 2:
+            out["name"] = v.decode()
+        elif field == 5:
+            out["initializers"].append(parse_tensor(v))
+        elif field == 11:
+            out["inputs"].append(_parse_vi(v))
+        elif field == 12:
+            out["outputs"].append(_parse_vi(v))
+    return out
+
+
+def _parse_vi(buf):
+    out = {"name": None, "shape": None, "elem_type": None}
+    for field, _, v in _fields(buf):
+        if field == 1:
+            out["name"] = v.decode()
+        elif field == 2:
+            for f2, _, tt in _fields(v):
+                if f2 == 1:  # tensor_type
+                    for f3, _, x in _fields(tt):
+                        if f3 == 1:
+                            out["elem_type"] = x
+                        elif f3 == 2:
+                            dims = []
+                            for f4, _, d in _fields(x):
+                                if f4 == 1:
+                                    for f5, _, dv in _fields(d):
+                                        if f5 == 1:
+                                            dims.append(dv)
+                                        elif f5 == 2:
+                                            dims.append(dv.decode())
+                            out["shape"] = dims
+    return out
+
+
+def parse_model(buf):
+    out = {"ir_version": None, "producer": None, "graph": None, "opset": None}
+    for field, _, v in _fields(buf):
+        if field == 1:
+            out["ir_version"] = v
+        elif field == 2:
+            out["producer"] = v.decode()
+        elif field == 7:
+            out["graph"] = parse_graph(v)
+        elif field == 8:
+            for f2, _, x in _fields(v):
+                if f2 == 2:
+                    out["opset"] = x
+    return out
+
+
+def check_model(buf):
+    """Structural sanity (the onnx.checker stand-in): every node input must
+    be a graph input, initializer, or earlier node output."""
+    m = parse_model(buf)
+    g = m["graph"]
+    known = {vi["name"] for vi in g["inputs"]}
+    known |= {t["name"] for t in g["initializers"]}
+    for n in g["nodes"]:
+        for i in n["input"]:
+            if i and i not in known:
+                raise ValueError(
+                    f"node {n['name']}({n['op_type']}): undefined input {i!r}")
+        known |= set(n["output"])
+    for o in g["outputs"]:
+        if o["name"] not in known:
+            raise ValueError(f"graph output {o['name']!r} undefined")
+    return m

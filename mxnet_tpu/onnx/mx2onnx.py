@@ -1,0 +1,619 @@
+"""mx → ONNX exporter (reference: python/mxnet/onnx/mx2onnx/, 8,149 LoC of
+op translation tables over the symbol graph).
+
+TPU re-design notes: the exporter walks the mx.symbol DAG (the deployment
+artifact, same as the reference), infers every intermediate shape with
+jax.eval_shape (replacing the reference's mxnet shape inference), and emits
+opset-11 ONNX via the dependency-free wire encoder in _proto.py. Training
+graphs are exported in inference form (Dropout → ratio-annotated node,
+BatchNorm → inference BN), matching reference behavior.
+"""
+from __future__ import annotations
+
+import jax
+import numpy as _np
+
+from ..symbol.symbol import _OP_TABLE, Symbol
+from . import _proto as P
+
+__all__ = ["export_model"]
+
+
+class _Ctx:
+    def __init__(self):
+        self.nodes = []        # encoded NodeProtos
+        self.initializers = []
+        self._counter = 0
+
+    def fresh(self, base):
+        self._counter += 1
+        return f"{base}__{self._counter}"
+
+    def add_node(self, op_type, inputs, outputs, name="", attrs=None):
+        self.nodes.append(P.node(op_type, inputs, outputs, name, attrs))
+
+    def add_init(self, name, arr):
+        self.initializers.append(P.tensor(name, _np.asarray(arr)))
+        return name
+
+    def const_i64(self, base, vals):
+        return self.add_init(self.fresh(base),
+                             _np.asarray(vals, _np.int64))
+
+
+# Each converter: fn(ctx, sym, in_names, out_names, in_shapes) -> None
+_CONVERTERS = {}
+
+
+def _conv(name):
+    def deco(fn):
+        _CONVERTERS[name] = fn
+        return fn
+
+    return deco
+
+
+def _simple(onnx_op, **fixed):
+    def fn(ctx, s, ins, outs, shapes):  # noqa: ARG001
+        ctx.add_node(onnx_op, ins, outs, s.name, dict(fixed))
+
+    return fn
+
+
+for _mx, _onnx in [
+    ("elemwise_add", "Add"), ("broadcast_add", "Add"),
+    ("elemwise_sub", "Sub"), ("broadcast_sub", "Sub"),
+    ("elemwise_mul", "Mul"), ("broadcast_mul", "Mul"),
+    ("elemwise_div", "Div"), ("broadcast_div", "Div"),
+    ("power", "Pow"), ("negative", "Neg"), ("exp", "Exp"), ("log", "Log"),
+    ("sqrt", "Sqrt"), ("tanh", "Tanh"), ("abs", "Abs"),
+    ("sigmoid", "Sigmoid"), ("relu", "Relu"),
+    ("maximum", "Max"), ("minimum", "Min"),
+]:
+    _CONVERTERS[_mx] = _simple(_onnx)
+
+
+@_conv("square")
+def _square(ctx, s, ins, outs, shapes):  # noqa: ARG001
+    ctx.add_node("Mul", [ins[0], ins[0]], outs, s.name)
+
+
+@_conv("where")
+def _where(ctx, s, ins, outs, shapes):  # noqa: ARG001
+    cond = ctx.fresh(s.name + "_cond")
+    ctx.add_node("Cast", [ins[0]], [cond], attrs={"to": 9})  # bool
+    ctx.add_node("Where", [cond, ins[1], ins[2]], outs, s.name)
+
+
+@_conv("clip")
+def _clip(ctx, s, ins, outs, shapes):  # noqa: ARG001
+    lo = ctx.add_init(ctx.fresh(s.name + "_min"),
+                      _np.float32(s.attr("a_min")))
+    hi = ctx.add_init(ctx.fresh(s.name + "_max"),
+                      _np.float32(s.attr("a_max")))
+    ctx.add_node("Clip", [ins[0], lo, hi], outs, s.name)
+
+
+def _reduce(onnx_op):
+    def fn(ctx, s, ins, outs, shapes):  # noqa: ARG001
+        attrs = {"keepdims": int(bool(s.attr("keepdims")))}
+        ax = s.attr("axis")
+        if ax is not None:
+            attrs["axes"] = [ax] if isinstance(ax, int) else list(ax)
+        ctx.add_node(onnx_op, ins, outs, s.name, attrs)
+
+    return fn
+
+
+_CONVERTERS["sum"] = _reduce("ReduceSum")
+_CONVERTERS["mean"] = _reduce("ReduceMean")
+_CONVERTERS["max"] = _reduce("ReduceMax")
+_CONVERTERS["min"] = _reduce("ReduceMin")
+_CONVERTERS["prod"] = _reduce("ReduceProd")
+
+
+@_conv("norm")
+def _norm(ctx, s, ins, outs, shapes):  # noqa: ARG001
+    order = s.attr("ord")
+    order = 2 if order is None else order
+    if order == 2:
+        op = "ReduceL2"
+    elif order == 1:
+        op = "ReduceL1"
+    else:
+        raise NotImplementedError(
+            f"norm ord={order!r} not exportable (ReduceL1/L2 only)")
+    _reduce(op)(ctx, s, ins, outs, shapes)
+
+
+def _arg(onnx_op):
+    def fn(ctx, s, ins, outs, shapes):
+        ax = s.attr("axis")
+        raw = ctx.fresh(s.name + "_i64")
+        data = ins[0]
+        if ax is None:
+            # jnp.argmax(axis=None) reduces the flattened array to a scalar
+            flat = ctx.fresh(s.name + "_flat")
+            shp = ctx.const_i64(s.name + "_m1", [-1])
+            ctx.add_node("Reshape", [ins[0], shp], [flat])
+            data, ax = flat, 0
+        ctx.add_node(onnx_op, [data], [raw], s.name,
+                     {"axis": int(ax), "keepdims": 0})
+        ctx.add_node("Cast", [raw], outs, attrs={"to": 1})  # float32 parity
+
+    return fn
+
+
+_CONVERTERS["argmax"] = _arg("ArgMax")
+_CONVERTERS["argmin"] = _arg("ArgMin")
+
+
+@_conv("transpose")
+def _transpose(ctx, s, ins, outs, shapes):
+    axes = s.attr("axes")
+    if axes is None:
+        axes = list(range(len(shapes[0])))[::-1]
+    ctx.add_node("Transpose", ins, outs, s.name, {"perm": list(axes)})
+
+
+@_conv("swapaxes")
+def _swapaxes(ctx, s, ins, outs, shapes):
+    rank = len(shapes[0])
+    perm = list(range(rank))
+    d1, d2 = s.attr("dim1") % rank, s.attr("dim2") % rank
+    perm[d1], perm[d2] = perm[d2], perm[d1]
+    ctx.add_node("Transpose", ins, outs, s.name, {"perm": perm})
+
+
+@_conv("reshape")
+def _reshape(ctx, s, ins, outs, shapes):  # noqa: ARG001
+    shp = ctx.const_i64(s.name + "_shape", list(s.attr("shape")))
+    ctx.add_node("Reshape", [ins[0], shp], outs, s.name)
+
+
+@_conv("Flatten")
+def _flatten(ctx, s, ins, outs, shapes):  # noqa: ARG001
+    ctx.add_node("Flatten", ins, outs, s.name, {"axis": 1})
+
+
+@_conv("expand_dims")
+def _expand_dims(ctx, s, ins, outs, shapes):  # noqa: ARG001
+    ctx.add_node("Unsqueeze", ins, outs, s.name, {"axes": [s.attr("axis")]})
+
+
+@_conv("squeeze")
+def _squeeze(ctx, s, ins, outs, shapes):  # noqa: ARG001
+    ax = s.attr("axis")
+    attrs = {}
+    if ax is not None:
+        attrs["axes"] = [ax] if isinstance(ax, int) else list(ax)
+    ctx.add_node("Squeeze", ins, outs, s.name, attrs)
+
+
+@_conv("broadcast_to")
+def _broadcast_to(ctx, s, ins, outs, shapes):  # noqa: ARG001
+    shp = ctx.const_i64(s.name + "_shape", list(s.attr("shape")))
+    ctx.add_node("Expand", [ins[0], shp], outs, s.name)
+
+
+@_conv("zeros_like")
+def _zeros_like(ctx, s, ins, outs, shapes):  # noqa: ARG001
+    shp = ctx.fresh(s.name + "_shape")
+    ctx.add_node("Shape", ins, [shp])
+    ctx.add_node("ConstantOfShape", [shp], outs, s.name,
+                 {"value": _np.zeros(1, _np.float32)})
+
+
+@_conv("ones_like")
+def _ones_like(ctx, s, ins, outs, shapes):  # noqa: ARG001
+    shp = ctx.fresh(s.name + "_shape")
+    ctx.add_node("Shape", ins, [shp])
+    ctx.add_node("ConstantOfShape", [shp], outs, s.name,
+                 {"value": _np.ones(1, _np.float32)})
+
+
+@_conv("slice")
+def _slice(ctx, s, ins, outs, shapes):
+    begin, end = list(s.attr("begin")), list(s.attr("end"))
+    begin = [0 if b is None else b for b in begin]
+    end = [shapes[0][i] if e is None else e for i, e in enumerate(end)]
+    starts = ctx.const_i64(s.name + "_starts", begin)
+    ends = ctx.const_i64(s.name + "_ends", end)
+    axes = ctx.const_i64(s.name + "_axes", list(range(len(begin))))
+    slice_ins = [ins[0], starts, ends, axes]
+    step = s.attr("step")
+    if step is not None and any(st not in (None, 1) for st in step):
+        steps = ctx.const_i64(
+            s.name + "_steps", [1 if st is None else st for st in step])
+        slice_ins.append(steps)
+    ctx.add_node("Slice", slice_ins, outs, s.name)
+
+
+@_conv("slice_axis")
+def _slice_axis(ctx, s, ins, outs, shapes):
+    ax = s.attr("axis")
+    begin = s.attr("begin") or 0
+    end = s.attr("end")
+    if end is None:
+        end = shapes[0][ax]
+    starts = ctx.const_i64(s.name + "_starts", [begin])
+    ends = ctx.const_i64(s.name + "_ends", [end])
+    axes = ctx.const_i64(s.name + "_axes", [ax])
+    ctx.add_node("Slice", [ins[0], starts, ends, axes], outs, s.name)
+
+
+@_conv("split")
+def _split(ctx, s, ins, outs, shapes):
+    ax = s.attr("axis") if s.attr("axis") is not None else 1
+    n = len(outs)
+    size = shapes[0][ax] // n
+    ctx.add_node("Split", ins, outs, s.name,
+                 {"axis": ax, "split": [size] * n})
+
+
+@_conv("Concat")
+def _concat(ctx, s, ins, outs, shapes):  # noqa: ARG001
+    ctx.add_node("Concat", ins, outs, s.name,
+                 {"axis": s.attr("dim") if s.attr("dim") is not None else 1})
+
+
+@_conv("stack")
+def _stack(ctx, s, ins, outs, shapes):  # noqa: ARG001
+    ax = s.attr("axis") or 0
+    unsq = []
+    for i in ins:
+        u = ctx.fresh(i + "_unsq")
+        ctx.add_node("Unsqueeze", [i], [u], attrs={"axes": [ax]})
+        unsq.append(u)
+    ctx.add_node("Concat", unsq, outs, s.name, {"axis": ax})
+
+
+@_conv("dot")
+def _dot(ctx, s, ins, outs, shapes):
+    if len(shapes[0]) >= 2 and len(shapes[1]) >= 3:
+        raise NotImplementedError(
+            "dot with rank>=3 rhs follows np.dot outer-stacking semantics, "
+            "which ONNX MatMul (batched) does not match; use batch_dot")
+    ctx.add_node("MatMul", ins, outs, s.name)
+
+
+@_conv("batch_dot")
+def _batch_dot(ctx, s, ins, outs, shapes):  # noqa: ARG001
+    ctx.add_node("MatMul", ins, outs, s.name)
+
+
+@_conv("FullyConnected")
+def _fc(ctx, s, ins, outs, shapes):
+    data = ins[0]
+    rank = len(shapes[0])
+    if rank != 2 and s.attr("flatten") in (None, True):
+        flat = ctx.fresh(s.name + "_flat")
+        ctx.add_node("Flatten", [ins[0]], [flat], attrs={"axis": 1})
+        data, rank = flat, 2
+    if rank != 2:
+        # flatten=False on rank>2: batched projection — Gemm requires 2-D,
+        # so emit MatMul(x, W^T) (+ Add bias)
+        wt = ctx.fresh(s.name + "_wT")
+        ctx.add_node("Transpose", [ins[1]], [wt], attrs={"perm": [1, 0]})
+        if len(ins) > 2:
+            mm = ctx.fresh(s.name + "_mm")
+            ctx.add_node("MatMul", [data, wt], [mm])
+            ctx.add_node("Add", [mm, ins[2]], outs, s.name)
+        else:
+            ctx.add_node("MatMul", [data, wt], outs, s.name)
+        return
+    if len(ins) > 2:
+        ctx.add_node("Gemm", [data, ins[1], ins[2]], outs, s.name,
+                     {"transB": 1})
+    else:
+        ctx.add_node("Gemm", [data, ins[1]], outs, s.name, {"transB": 1})
+
+
+@_conv("Convolution")
+def _convolution(ctx, s, ins, outs, shapes):
+    kshape = list(shapes[1][2:])  # weight (O, I/g, kh, kw)
+    nd = len(kshape)
+    stride = list(s.attr("stride") or (1,) * nd)
+    dilate = list(s.attr("dilate") or (1,) * nd)
+    pad = list(s.attr("pad") or (0,) * nd)
+    ctx.add_node("Conv", ins, outs, s.name, {
+        "kernel_shape": kshape, "strides": stride, "dilations": dilate,
+        "pads": pad + pad, "group": int(s.attr("num_group") or 1)})
+
+
+@_conv("Deconvolution")
+def _deconvolution(ctx, s, ins, outs, shapes):
+    kshape = list(shapes[1][2:])
+    nd = len(kshape)
+    stride = list(s.attr("stride") or (1,) * nd)
+    pad = list(s.attr("pad") or (0,) * nd)
+    ctx.add_node("ConvTranspose", ins, outs, s.name, {
+        "kernel_shape": kshape, "strides": stride, "pads": pad + pad})
+
+
+@_conv("Activation")
+def _activation(ctx, s, ins, outs, shapes):  # noqa: ARG001
+    table = {"relu": "Relu", "sigmoid": "Sigmoid", "tanh": "Tanh",
+             "softrelu": "Softplus", "softsign": "Softsign"}
+    act = s.attr("act_type") or "relu"
+    ctx.add_node(table[act], ins, outs, s.name)
+
+
+@_conv("LeakyReLU")
+def _leaky(ctx, s, ins, outs, shapes):  # noqa: ARG001
+    act = s.attr("act_type") or "leaky"
+    slope = float(s.attr("slope") if s.attr("slope") is not None else 0.25)
+    if act == "leaky":
+        ctx.add_node("LeakyRelu", ins, outs, s.name, {"alpha": slope})
+    elif act == "elu":
+        ctx.add_node("Elu", ins, outs, s.name, {"alpha": slope})
+    elif act == "prelu":
+        ctx.add_node("PRelu", ins, outs, s.name)
+    elif act == "gelu":
+        # opset-11 decomposition: x * 0.5 * (1 + erf(x / sqrt(2)))
+        invsqrt2 = ctx.add_init(ctx.fresh(s.name + "_c"),
+                                _np.float32(1 / _np.sqrt(2.0)))
+        half = ctx.add_init(ctx.fresh(s.name + "_h"), _np.float32(0.5))
+        one = ctx.add_init(ctx.fresh(s.name + "_1"), _np.float32(1.0))
+        t1 = ctx.fresh(s.name + "_t1")
+        ctx.add_node("Mul", [ins[0], invsqrt2], [t1])
+        t2 = ctx.fresh(s.name + "_t2")
+        ctx.add_node("Erf", [t1], [t2])
+        t3 = ctx.fresh(s.name + "_t3")
+        ctx.add_node("Add", [t2, one], [t3])
+        t4 = ctx.fresh(s.name + "_t4")
+        ctx.add_node("Mul", [ins[0], t3], [t4])
+        ctx.add_node("Mul", [t4, half], outs, s.name)
+    else:
+        raise ValueError(f"LeakyReLU act_type {act!r} not exportable")
+
+
+@_conv("Pooling")
+def _pooling(ctx, s, ins, outs, shapes):  # noqa: ARG001
+    ptype = s.attr("pool_type") or "max"
+    if s.attr("global_pool"):
+        op = "GlobalMaxPool" if ptype == "max" else "GlobalAveragePool"
+        ctx.add_node(op, ins, outs, s.name)
+        return
+    kernel = list(s.attr("kernel") or (2, 2))
+    nd = len(kernel)
+    stride = list(s.attr("stride") or kernel)
+    pad = list(s.attr("pad") or (0,) * nd)
+    op = "MaxPool" if ptype == "max" else "AveragePool"
+    ctx.add_node(op, ins, outs, s.name, {
+        "kernel_shape": kernel, "strides": stride, "pads": pad + pad})
+
+
+@_conv("BatchNorm")
+def _batchnorm(ctx, s, ins, outs, shapes):  # noqa: ARG001
+    ctx.add_node("BatchNormalization", ins, outs, s.name,
+                 {"epsilon": float(s.attr("eps") or 1e-5)})
+
+
+@_conv("LayerNorm")
+def _layernorm(ctx, s, ins, outs, shapes):
+    """Opset-11 decomposition (LayerNormalization needs opset 17)."""
+    ax = s.attr("axis")
+    ax = -1 if ax is None else ax
+    rank = len(shapes[0])
+    ax = ax % rank
+    eps = ctx.add_init(ctx.fresh(s.name + "_eps"),
+                       _np.float32(s.attr("eps") or 1e-5))
+    mean = ctx.fresh(s.name + "_mean")
+    ctx.add_node("ReduceMean", [ins[0]], [mean],
+                 attrs={"axes": [ax], "keepdims": 1})
+    cent = ctx.fresh(s.name + "_cent")
+    ctx.add_node("Sub", [ins[0], mean], [cent])
+    sq = ctx.fresh(s.name + "_sq")
+    ctx.add_node("Mul", [cent, cent], [sq])
+    var = ctx.fresh(s.name + "_var")
+    ctx.add_node("ReduceMean", [sq], [var], attrs={"axes": [ax],
+                                                   "keepdims": 1})
+    veps = ctx.fresh(s.name + "_veps")
+    ctx.add_node("Add", [var, eps], [veps])
+    std = ctx.fresh(s.name + "_std")
+    ctx.add_node("Sqrt", [veps], [std])
+    normed = ctx.fresh(s.name + "_normed")
+    ctx.add_node("Div", [cent, std], [normed])
+    scaled = ctx.fresh(s.name + "_scaled")
+    ctx.add_node("Mul", [normed, ins[1]], [scaled])
+    ctx.add_node("Add", [scaled, ins[2]], outs, s.name)
+
+
+@_conv("Dropout")
+def _dropout(ctx, s, ins, outs, shapes):  # noqa: ARG001
+    ctx.add_node("Dropout", ins, outs, s.name,
+                 {"ratio": float(s.attr("p") if s.attr("p") is not None
+                                 else 0.5)})
+
+
+def _softmax_like(onnx_op):
+    def fn(ctx, s, ins, outs, shapes):
+        """Opset-11 Softmax flattens ALL trailing dims from `axis`; that
+        only matches per-axis softmax when the axis is last. For any other
+        axis, transpose it to last, apply, transpose back."""
+        rank = len(shapes[0])
+        ax = s.attr("axis")
+        ax = (rank - 1) if ax is None else int(ax) % rank
+        if ax == rank - 1:
+            ctx.add_node(onnx_op, ins, outs, s.name, {"axis": rank - 1})
+            return
+        perm = [i for i in range(rank) if i != ax] + [ax]
+        inv = [perm.index(i) for i in range(rank)]
+        t1 = ctx.fresh(s.name + "_t")
+        ctx.add_node("Transpose", ins, [t1], attrs={"perm": perm})
+        sm = ctx.fresh(s.name + "_sm")
+        ctx.add_node(onnx_op, [t1], [sm], attrs={"axis": rank - 1})
+        ctx.add_node("Transpose", [sm], outs, s.name, {"perm": inv})
+
+    return fn
+
+
+_CONVERTERS["softmax"] = _softmax_like("Softmax")
+_CONVERTERS["log_softmax"] = _softmax_like("LogSoftmax")
+
+
+@_conv("Embedding")
+def _embedding(ctx, s, ins, outs, shapes):  # noqa: ARG001
+    idx = ctx.fresh(s.name + "_idx")
+    ctx.add_node("Cast", [ins[0]], [idx], attrs={"to": 7})  # int64
+    ctx.add_node("Gather", [ins[1], idx], outs, s.name, {"axis": 0})
+
+
+@_conv("take")
+def _take(ctx, s, ins, outs, shapes):  # noqa: ARG001
+    idx = ctx.fresh(s.name + "_idx")
+    ctx.add_node("Cast", [ins[1]], [idx], attrs={"to": 7})
+    ctx.add_node("Gather", [ins[0], idx], outs, s.name,
+                 {"axis": int(s.attr("axis") or 0)})
+
+
+@_conv("one_hot")
+def _one_hot(ctx, s, ins, outs, shapes):  # noqa: ARG001
+    idx = ctx.fresh(s.name + "_idx")
+    ctx.add_node("Cast", [ins[0]], [idx], attrs={"to": 7})
+    depth = ctx.const_i64(s.name + "_depth", [s.attr("depth")])
+    values = ctx.add_init(ctx.fresh(s.name + "_vals"),
+                          _np.asarray([0.0, 1.0], _np.float32))
+    ctx.add_node("OneHot", [idx, depth, values], outs, s.name, {"axis": -1})
+
+
+# --- shape inference over the symbol DAG -----------------------------------
+
+def _infer_all_shapes(order, input_structs):
+    """Per-node output ShapeDtypeStructs via jax.eval_shape, one op at a
+    time (the reference ran nnvm InferShape over the whole graph)."""
+    shapes = {}
+    for s in order:
+        if s._op is None:
+            shapes[id(s)] = input_structs[s._name]
+        elif s._op == "_const":
+            v = _np.asarray(s._attrs["value"])
+            shapes[id(s)] = jax.ShapeDtypeStruct(v.shape, v.dtype)
+        elif s._op == "_group":
+            continue
+        else:
+            ins = [shapes[id(i)] for i in s._inputs]
+            fn = _OP_TABLE[s._op]
+            out = jax.eval_shape(lambda *xs, _fn=fn, _a=s._attrs: _fn(
+                list(xs), _a), *ins)
+            shapes[id(s)] = out
+    return shapes
+
+
+def export_model(sym, params, in_shapes=None, in_types=_np.float32,
+                 onnx_file_path="model.onnx", verbose=False, dynamic=False,
+                 dynamic_input_shapes=None):  # noqa: ARG001
+    """Export a symbol + params to an ONNX file
+    (reference: mx.onnx.export_model, mx2onnx/_export_model.py).
+
+    sym: Symbol or path to a saved symbol json; params: dict name→NDArray
+    (or path to a saved params file); in_shapes: list of shapes for the
+    data inputs (arguments not found in params), in graph order.
+    Returns onnx_file_path.
+    """
+    from ..ndarray.ndarray import NDArray
+
+    if isinstance(sym, str):
+        from ..symbol.symbol import load as _load_sym
+
+        sym = _load_sym(sym)
+    if isinstance(params, str):
+        from ..ndarray.utils import load as _load_params
+
+        params = _load_params(params)
+    params = {k.split(":", 1)[-1]: v for k, v in (params or {}).items()}
+
+    args = sym.list_arguments()
+    data_inputs = [a for a in args if a not in params]
+    if in_shapes is None or len(in_shapes) != len(data_inputs):
+        raise ValueError(
+            f"in_shapes must give shapes for data inputs {data_inputs}")
+    if not isinstance(in_types, (list, tuple)):
+        in_types = [in_types] * len(data_inputs)
+
+    np_params = {n: (v.asnumpy() if isinstance(v, NDArray)
+                     else _np.asarray(v))
+                 for n, v in params.items() if n in args}
+    input_structs = {}
+    for n, shp, dt in zip(data_inputs, in_shapes, in_types):
+        input_structs[n] = jax.ShapeDtypeStruct(tuple(shp), _np.dtype(dt))
+    for n, arr in np_params.items():
+        input_structs[n] = jax.ShapeDtypeStruct(arr.shape, arr.dtype)
+
+    order = [s for s in sym._topo() if s._op != "_group"]
+    shapes = _infer_all_shapes(order, input_structs)
+
+    ctx = _Ctx()
+    tensor_names = {}  # id(sym-node) -> list of output tensor names
+    converted = {}     # node name -> output tensor names (dedups the
+    #                    out_index clones _flat_outputs creates)
+    shape_by_name = {}
+
+    for n, arr in np_params.items():
+        ctx.add_init(n, arr)
+
+    def _in_shape(i, pick):
+        st = shapes[id(i)]
+        if isinstance(st, (tuple, list)):
+            st = st[pick]
+        return tuple(st.shape)
+
+    for s in order:
+        shape_by_name.setdefault(s._name, shapes.get(id(s)))
+        if s._op is None:
+            tensor_names[id(s)] = [s._name]
+            converted[s._name] = [s._name]
+            continue
+        if s._op == "_const":
+            if s._name not in converted:
+                cname = ctx.fresh(s._name)
+                ctx.add_init(cname, _np.asarray(s._attrs["value"]))
+                converted[s._name] = [cname]
+            tensor_names[id(s)] = converted[s._name]
+            continue
+        if s._name in converted:  # out_index clone of an emitted node
+            tensor_names[id(s)] = converted[s._name]
+            continue
+        outs = ([f"{s._name}_output{i}" for i in range(s._nout)]
+                if s._nout > 1 else [f"{s._name}_output"])
+        conv = _CONVERTERS.get(s._op)
+        if conv is None:
+            raise NotImplementedError(
+                f"op {s._op!r} has no ONNX converter "
+                f"(node {s._name!r}); supported: {sorted(_CONVERTERS)}")
+        in_names, in_shapes_list = [], []
+        for i in s._inputs:
+            names = tensor_names[id(i)]
+            pick = i._out_index or 0
+            in_names.append(names[pick] if len(names) > 1 else names[0])
+            in_shapes_list.append(_in_shape(i, pick))
+        conv(ctx, s, in_names, outs, in_shapes_list)
+        converted[s._name] = outs
+        tensor_names[id(s)] = outs
+
+    # graph outputs
+    out_infos = []
+    for h in sym._flat_outputs():
+        names = converted[h._name]
+        pick = h._out_index or 0
+        oname = names[pick] if len(names) > 1 else names[0]
+        st = shape_by_name[h._name]
+        if isinstance(st, (tuple, list)):
+            st = st[pick]
+        out_infos.append(P.value_info(
+            oname, list(st.shape), P.DTYPE.get(str(st.dtype), 1)))
+
+    in_infos = [P.value_info(n, list(input_structs[n].shape),
+                             P.DTYPE.get(str(input_structs[n].dtype), 1))
+                for n in data_inputs]
+
+    g = P.graph(ctx.nodes, "mxnet_tpu_graph", ctx.initializers, in_infos,
+                out_infos)
+    buf = P.model(g)
+    P.check_model(buf)
+    with open(onnx_file_path, "wb") as f:
+        f.write(buf)
+    if verbose:
+        print(f"exported {len(ctx.nodes)} nodes to {onnx_file_path}")
+    return onnx_file_path
