@@ -54,6 +54,7 @@ from . import kvstore as kv  # noqa: E402
 from . import kvstore  # noqa: E402
 from . import io  # noqa: E402
 from . import image  # noqa: E402
+from . import contrib  # noqa: E402
 from . import library  # noqa: E402
 from . import onnx  # noqa: E402
 from . import operator  # noqa: E402

@@ -1,3 +1,5 @@
-"""mx.contrib — quantization, misc extensions (reference:
+"""mx.contrib — quantization, contrib ops, misc extensions (reference:
 python/mxnet/contrib/)."""
+from . import ops  # noqa: F401
+from . import ops as nd  # noqa: F401  (reference spelling: mx.contrib.nd)
 from . import quantization  # noqa: F401

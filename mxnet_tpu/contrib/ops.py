@@ -1,0 +1,452 @@
+"""Contrib operator corpus (reference: src/operator/contrib/, 115 files —
+ROIAlign, bounding-box ops, MultiBox SSD ops, boolean_mask, index ops,
+hawkes_ll, count_sketch, quadratic, allclose).
+
+TPU design split:
+  * static-shape compute (roi_align, multibox_prior/target, box_iou,
+    hawkes_ll, count_sketch, quadratic) is pure jnp — vmapped gathers and
+    segment ops that XLA maps to the VPU/MXU and that can live inside jit;
+  * dynamic-output ops (boolean_mask, box_nms selection) run eagerly — the
+    result size depends on values, which XLA cannot trace; this matches the
+    reference, where these were FComputeEx CPU/GPU kernels outside any
+    graph executor.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as _np
+
+from ..ndarray.ndarray import NDArray, apply_op
+
+__all__ = ["roi_align", "box_iou", "box_nms", "bipartite_matching",
+           "multibox_prior", "multibox_target", "multibox_detection",
+           "boolean_mask", "index_array", "index_copy", "allclose",
+           "quadratic", "hawkes_ll", "count_sketch", "getnnz"]
+
+
+# --- ROIAlign --------------------------------------------------------------
+
+def roi_align(data, rois, pooled_size, spatial_scale=1.0, sample_ratio=-1):
+    """ROIAlign (reference: src/operator/contrib/roi_align.cc): bilinear
+    sampling on a regular grid inside each RoI bin, averaged per bin.
+
+    data: (N, C, H, W); rois: (R, 5) [batch_idx, x1, y1, x2, y2] in image
+    coordinates. Returns (R, C, ph, pw).
+    """
+    ph, pw = pooled_size
+    s = sample_ratio if sample_ratio > 0 else 2
+
+    def pure(feat, boxes):
+        H, W = feat.shape[-2:]
+
+        def one(roi):
+            bidx = roi[0].astype(jnp.int32)
+            x1, y1, x2, y2 = roi[1:] * spatial_scale
+            roi_w = jnp.maximum(x2 - x1, 1.0)
+            roi_h = jnp.maximum(y2 - y1, 1.0)
+            # sample grid: (ph*s, pw*s) points
+            ys = y1 + (jnp.arange(ph * s) + 0.5) * roi_h / (ph * s)
+            xs = x1 + (jnp.arange(pw * s) + 0.5) * roi_w / (pw * s)
+            yy, xx = jnp.meshgrid(ys, xs, indexing="ij")
+            img = feat[bidx]                                   # (C, H, W)
+            y0 = jnp.clip(jnp.floor(yy).astype(jnp.int32), 0, H - 1)
+            x0 = jnp.clip(jnp.floor(xx).astype(jnp.int32), 0, W - 1)
+            y1i = jnp.clip(y0 + 1, 0, H - 1)
+            x1i = jnp.clip(x0 + 1, 0, W - 1)
+            wy = jnp.clip(yy - y0, 0.0, 1.0)
+            wx = jnp.clip(xx - x0, 0.0, 1.0)
+            v = (img[:, y0, x0] * (1 - wy) * (1 - wx)
+                 + img[:, y1i, x0] * wy * (1 - wx)
+                 + img[:, y0, x1i] * (1 - wy) * wx
+                 + img[:, y1i, x1i] * wy * wx)   # (C, ph*s, pw*s)
+            c = v.shape[0]
+            v = v.reshape(c, ph, s, pw, s)
+            return v.mean(axis=(2, 4))                         # (C, ph, pw)
+
+        return jax.vmap(one)(boxes)
+
+    return apply_op(pure, data, rois, name="roi_align")
+
+
+# --- bounding boxes --------------------------------------------------------
+
+def _iou_matrix(a, b, fmt="corner"):
+    if fmt == "center":
+        def c2c(x):
+            cx, cy, w, h = x[..., 0], x[..., 1], x[..., 2], x[..., 3]
+            return jnp.stack([cx - w / 2, cy - h / 2, cx + w / 2,
+                              cy + h / 2], axis=-1)
+
+        a, b = c2c(a), c2c(b)
+    ax1, ay1, ax2, ay2 = (a[..., i] for i in range(4))
+    bx1, by1, bx2, by2 = (b[..., i] for i in range(4))
+    ix1 = jnp.maximum(ax1[:, None], bx1[None, :])
+    iy1 = jnp.maximum(ay1[:, None], by1[None, :])
+    ix2 = jnp.minimum(ax2[:, None], bx2[None, :])
+    iy2 = jnp.minimum(ay2[:, None], by2[None, :])
+    inter = jnp.clip(ix2 - ix1, 0) * jnp.clip(iy2 - iy1, 0)
+    area_a = jnp.clip(ax2 - ax1, 0) * jnp.clip(ay2 - ay1, 0)
+    area_b = jnp.clip(bx2 - bx1, 0) * jnp.clip(by2 - by1, 0)
+    union = area_a[:, None] + area_b[None, :] - inter
+    return jnp.where(union > 0, inter / union, 0.0)
+
+
+def box_iou(lhs, rhs, format="corner"):  # noqa: A002
+    """Pairwise IoU (reference: contrib bounding_box.cc _contrib_box_iou)."""
+    return apply_op(lambda a, b: _iou_matrix(a, b, format), lhs, rhs,
+                    name="box_iou")
+
+
+def _np_iou_row(box, rest):
+    """IoU of one corner-format box against (M, 4) boxes — plain numpy, the
+    NMS loop is host-side."""
+    ix1 = _np.maximum(box[0], rest[:, 0])
+    iy1 = _np.maximum(box[1], rest[:, 1])
+    ix2 = _np.minimum(box[2], rest[:, 2])
+    iy2 = _np.minimum(box[3], rest[:, 3])
+    inter = _np.clip(ix2 - ix1, 0, None) * _np.clip(iy2 - iy1, 0, None)
+    area = _np.clip(box[2] - box[0], 0, None) * \
+        _np.clip(box[3] - box[1], 0, None)
+    areas = _np.clip(rest[:, 2] - rest[:, 0], 0, None) * \
+        _np.clip(rest[:, 3] - rest[:, 1], 0, None)
+    union = area + areas - inter
+    return _np.where(union > 0, inter / union, 0.0)
+
+
+def _center_to_corner_np(c):
+    out = c.copy()
+    out[:, 0] = c[:, 0] - c[:, 2] / 2
+    out[:, 1] = c[:, 1] - c[:, 3] / 2
+    out[:, 2] = c[:, 0] + c[:, 2] / 2
+    out[:, 3] = c[:, 1] + c[:, 3] / 2
+    return out
+
+
+def _corner_to_center_np(c):
+    out = c.copy()
+    out[:, 0] = (c[:, 0] + c[:, 2]) / 2
+    out[:, 1] = (c[:, 1] + c[:, 3]) / 2
+    out[:, 2] = c[:, 2] - c[:, 0]
+    out[:, 3] = c[:, 3] - c[:, 1]
+    return out
+
+
+def box_nms(data, overlap_thresh=0.5, valid_thresh=0, topk=-1, coord_start=2,
+            score_index=1, id_index=-1, force_suppress=False,
+            in_format="corner", out_format="corner"):
+    """Greedy non-max suppression (reference: _contrib_box_nms). Eager —
+    output is value-dependent; suppressed rows are filled with -1 like the
+    reference."""
+    arr = data.asnumpy() if isinstance(data, NDArray) else _np.asarray(data)
+    orig_shape = arr.shape
+    boxes2d = arr.reshape(-1, orig_shape[-1]) if arr.ndim == 2 else \
+        arr.reshape(arr.shape[0], -1, orig_shape[-1])
+    if arr.ndim == 2:
+        boxes2d = boxes2d[None]
+    out = _np.full_like(boxes2d, -1.0)
+    cs = coord_start
+    for b in range(boxes2d.shape[0]):
+        rows = boxes2d[b].copy()
+        if in_format == "center":
+            rows[:, cs:cs + 4] = _center_to_corner_np(rows[:, cs:cs + 4])
+        scores = rows[:, score_index]
+        valid = scores > valid_thresh
+        order = _np.argsort(-scores[valid])
+        idxs = _np.nonzero(valid)[0][order]
+        if topk > 0:
+            idxs = idxs[:topk]
+        keep = []
+        while len(idxs):
+            i = idxs[0]
+            keep.append(i)
+            if len(idxs) == 1:
+                break
+            ious = _np_iou_row(rows[i, cs:cs + 4], rows[idxs[1:], cs:cs + 4])
+            same_class = _np.ones(len(idxs) - 1, bool)
+            if not force_suppress and id_index >= 0:
+                same_class = rows[idxs[1:], id_index] == rows[i, id_index]
+            idxs = idxs[1:][~((ious > overlap_thresh) & same_class)]
+        kept = rows[keep]
+        if out_format == "center":
+            kept[:, cs:cs + 4] = _corner_to_center_np(kept[:, cs:cs + 4])
+        out[b, :len(keep)] = kept
+    out = out.reshape(orig_shape)
+    return NDArray(jnp.asarray(out))
+
+
+def bipartite_matching(data, threshold=1e-12, is_ascend=False, topk=-1):
+    """Greedy bipartite matching over a score matrix
+    (reference: _contrib_bipartite_matching)."""
+    scores = data.asnumpy() if isinstance(data, NDArray) else \
+        _np.asarray(data)
+    n, m = scores.shape
+    row_match = _np.full(n, -1.0, _np.float32)
+    col_match = _np.full(m, -1.0, _np.float32)
+    flat = [(-s if not is_ascend else s, i, j)
+            for i in range(n) for j in range(m) for s in (scores[i, j],)]
+    flat.sort()
+    used = 0
+    for key, i, j in flat:
+        s = scores[i, j]
+        if (not is_ascend and s < threshold) or \
+           (is_ascend and s > threshold):
+            continue
+        if row_match[i] < 0 and col_match[j] < 0:
+            row_match[i] = j
+            col_match[j] = i
+            used += 1
+            if 0 < topk <= used:
+                break
+    return NDArray(jnp.asarray(row_match)), NDArray(jnp.asarray(col_match))
+
+
+# --- MultiBox (SSD) --------------------------------------------------------
+
+def multibox_prior(data, sizes=(1.0,), ratios=(1.0,), clip=False,
+                   steps=(-1.0, -1.0), offsets=(0.5, 0.5)):
+    """Anchor-box generation (reference: contrib/multibox_prior.cc).
+    data: (N, C, H, W) → (1, H*W*(len(sizes)+len(ratios)-1), 4) normalized
+    corner boxes."""
+    sizes, ratios = list(sizes), list(ratios)
+
+    def pure(x):
+        H, W = x.shape[-2:]
+        step_y = steps[0] if steps[0] > 0 else 1.0 / H
+        step_x = steps[1] if steps[1] > 0 else 1.0 / W
+        cy = (jnp.arange(H) + offsets[0]) * step_y
+        cx = (jnp.arange(W) + offsets[1]) * step_x
+        cyy, cxx = jnp.meshgrid(cy, cx, indexing="ij")       # (H, W)
+        whs = [(sizes[0] * _np.sqrt(r), sizes[0] / _np.sqrt(r))
+               for r in ratios]
+        whs += [(s, s) for s in sizes[1:]]
+        boxes = []
+        for w, h in whs:
+            boxes.append(jnp.stack([cxx - w / 2, cyy - h / 2,
+                                    cxx + w / 2, cyy + h / 2], axis=-1))
+        out = jnp.stack(boxes, axis=2).reshape(-1, 4)  # (H*W*K, 4)
+        if clip:
+            out = jnp.clip(out, 0.0, 1.0)
+        return out[None]
+
+    return apply_op(pure, data, name="multibox_prior")
+
+
+def multibox_target(anchors, labels, cls_preds, overlap_threshold=0.5,
+                    ignore_label=-1, negative_mining_ratio=-1,
+                    variances=(0.1, 0.1, 0.2, 0.2), **kwargs):  # noqa: ARG001
+    """Anchor matching + box-target encoding
+    (reference: contrib/multibox_target.cc).
+
+    anchors (1, A, 4) corner; labels (N, M, 5) [cls, x1, y1, x2, y2] with
+    -1 rows padding; cls_preds (N, num_cls+1, A).
+    Returns (box_target (N, A*4), box_mask (N, A*4), cls_target (N, A)).
+    """
+    anc = anchors.asnumpy()[0] if isinstance(anchors, NDArray) else \
+        _np.asarray(anchors)[0]
+    lab = labels.asnumpy() if isinstance(labels, NDArray) else \
+        _np.asarray(labels)
+    N, A = lab.shape[0], anc.shape[0]
+    box_t = _np.zeros((N, A * 4), _np.float32)
+    box_m = _np.zeros((N, A * 4), _np.float32)
+    cls_t = _np.zeros((N, A), _np.float32)
+    aw = anc[:, 2] - anc[:, 0]
+    ah = anc[:, 3] - anc[:, 1]
+    acx = (anc[:, 0] + anc[:, 2]) / 2
+    acy = (anc[:, 1] + anc[:, 3]) / 2
+    for n in range(N):
+        gt = lab[n][lab[n, :, 0] >= 0]
+        if len(gt) == 0:
+            continue
+        ious = _np.asarray(_iou_matrix(jnp.asarray(anc),
+                                       jnp.asarray(gt[:, 1:5])))
+        best_gt = ious.argmax(axis=1)
+        best_iou = ious.max(axis=1)
+        pos = best_iou >= overlap_threshold
+        # ensure every gt owns its best anchor
+        best_anchor = ious.argmax(axis=0)
+        pos[best_anchor] = True
+        best_gt[best_anchor] = _np.arange(len(gt))
+        g = gt[best_gt]
+        gcx = (g[:, 1] + g[:, 3]) / 2
+        gcy = (g[:, 2] + g[:, 4]) / 2
+        gw = _np.maximum(g[:, 3] - g[:, 1], 1e-8)
+        gh = _np.maximum(g[:, 4] - g[:, 2], 1e-8)
+        tx = (gcx - acx) / _np.maximum(aw, 1e-8) / variances[0]
+        ty = (gcy - acy) / _np.maximum(ah, 1e-8) / variances[1]
+        tw = _np.log(gw / _np.maximum(aw, 1e-8)) / variances[2]
+        th = _np.log(gh / _np.maximum(ah, 1e-8)) / variances[3]
+        t = _np.stack([tx, ty, tw, th], axis=1)
+        box_t[n] = _np.where(pos[:, None], t, 0).ravel()
+        box_m[n] = _np.repeat(pos.astype(_np.float32), 4)
+        cls_t[n] = _np.where(pos, g[:, 0] + 1, 0)
+    return (NDArray(jnp.asarray(box_t)), NDArray(jnp.asarray(box_m)),
+            NDArray(jnp.asarray(cls_t)))
+
+
+def multibox_detection(cls_prob, loc_pred, anchors, clip=True, threshold=0.01,
+                       nms_threshold=0.5, force_suppress=False,
+                       variances=(0.1, 0.1, 0.2, 0.2), nms_topk=-1,
+                       **kwargs):  # noqa: ARG001
+    """Decode predictions + per-class NMS
+    (reference: contrib/multibox_detection.cc).
+    cls_prob (N, num_cls+1, A), loc_pred (N, A*4), anchors (1, A, 4) →
+    (N, A, 6) rows [cls_id, score, x1, y1, x2, y2], suppressed = -1."""
+    cp = cls_prob.asnumpy() if isinstance(cls_prob, NDArray) else \
+        _np.asarray(cls_prob)
+    lp = loc_pred.asnumpy() if isinstance(loc_pred, NDArray) else \
+        _np.asarray(loc_pred)
+    anc = anchors.asnumpy()[0] if isinstance(anchors, NDArray) else \
+        _np.asarray(anchors)[0]
+    N, _, A = cp.shape
+    aw = anc[:, 2] - anc[:, 0]
+    ah = anc[:, 3] - anc[:, 1]
+    acx = (anc[:, 0] + anc[:, 2]) / 2
+    acy = (anc[:, 1] + anc[:, 3]) / 2
+    outs = []
+    for n in range(N):
+        loc = lp[n].reshape(A, 4)
+        cx = loc[:, 0] * variances[0] * aw + acx
+        cy = loc[:, 1] * variances[1] * ah + acy
+        w = _np.exp(loc[:, 2] * variances[2]) * aw
+        h = _np.exp(loc[:, 3] * variances[3]) * ah
+        boxes = _np.stack([cx - w / 2, cy - h / 2, cx + w / 2, cy + h / 2],
+                          axis=1)
+        if clip:
+            boxes = _np.clip(boxes, 0.0, 1.0)
+        cls_id = cp[n, 1:].argmax(axis=0)          # best non-background
+        score = cp[n, 1:].max(axis=0)
+        rows = _np.concatenate([cls_id[:, None].astype(_np.float32),
+                                score[:, None], boxes], axis=1)
+        rows[score < threshold, 0] = -1
+        det = box_nms(NDArray(jnp.asarray(rows)),
+                      overlap_thresh=nms_threshold, valid_thresh=threshold,
+                      topk=nms_topk, coord_start=2, score_index=1,
+                      id_index=0, force_suppress=force_suppress)
+        outs.append(det.asnumpy())
+    return NDArray(jnp.asarray(_np.stack(outs)))
+
+
+# --- misc ------------------------------------------------------------------
+
+def boolean_mask(data, index, axis=0):
+    """Select rows where index != 0 (reference: contrib/boolean_mask.cc).
+    Eager: output length is value-dependent."""
+    arr = data.asnumpy() if isinstance(data, NDArray) else _np.asarray(data)
+    idx = index.asnumpy() if isinstance(index, NDArray) else \
+        _np.asarray(index)
+    take = _np.nonzero(idx.astype(bool))[0]
+    return NDArray(jnp.asarray(_np.take(arr, take, axis=axis)))
+
+
+def index_array(data, axes=None):
+    """Per-element N-d indices (reference: contrib/index_array.cc)."""
+
+    def pure(x):
+        idx = jnp.stack(jnp.meshgrid(
+            *[jnp.arange(s) for s in x.shape], indexing="ij"), axis=-1)
+        if axes is not None:
+            idx = idx[..., list(axes)]
+        return idx.astype(jnp.int32)
+
+    return apply_op(pure, data, name="index_array")
+
+
+def index_copy(old_tensor, index_vector, new_tensor):
+    """Copy rows of new_tensor into old at index_vector
+    (reference: contrib/index_copy.cc)."""
+
+    def pure(old, idx, new):
+        return old.at[idx.astype(jnp.int32)].set(new)
+
+    return apply_op(pure, old_tensor, index_vector, new_tensor,
+                    name="index_copy")
+
+
+def allclose(a, b, rtol=1e-5, atol=1e-8, equal_nan=False):
+    """reference: contrib/allclose_op.cc — returns a 0/1 scalar array."""
+
+    def pure(x, y):
+        return jnp.allclose(x, y, rtol=rtol, atol=atol,
+                            equal_nan=equal_nan).astype(jnp.float32)
+
+    return apply_op(pure, a, b, name="allclose")
+
+
+def quadratic(data, a=0.0, b=0.0, c=0.0):
+    """a*x^2 + b*x + c — the reference's custom-op tutorial op
+    (contrib/quadratic_op.cc)."""
+    return apply_op(lambda x: a * x * x + b * x + c, data, name="quadratic")
+
+
+def hawkes_ll(lda, alpha, beta, state, lags, marks, valid_length, max_time):
+    """Log-likelihood of a marked self-exciting Hawkes process
+    (reference: contrib/hawkes_ll.cc). The time loop is a lax.scan.
+
+    lda (N, K) background intensity; alpha (K,), beta (K,) excitation;
+    state (N, K) initial excitation; lags/marks (N, T); valid_length (N,);
+    max_time (N,). Returns (loglik (N,), new_state (N, K)).
+    """
+
+    def pure(mu, a, b, st0, lg, mk, vl, mt):
+        N, T = lg.shape
+        K = mu.shape[1]
+
+        def step(carry, t):
+            ll, st, last_t = carry
+            dt = lg[:, t]
+            k = mk[:, t].astype(jnp.int32)
+            valid = (t < vl).astype(mu.dtype)
+            decay = jnp.exp(-b[None, :] * dt[:, None])
+            st_new = st * decay
+            lam = mu + st_new                                 # (N, K)
+            lam_k = jnp.take_along_axis(lam, k[:, None], 1)[:, 0]
+            ll_t = jnp.log(jnp.maximum(lam_k, 1e-20)) * valid
+            # compensator increment for the interval
+            comp = ((mu * dt[:, None])
+                    + (st / b[None, :]) * (1 - decay)).sum(-1) * valid
+            st_upd = st_new + jax.nn.one_hot(k, K) * a[None, :]
+            # padded steps must not decay or excite the carried state
+            st_upd = jnp.where(valid[:, None] > 0, st_upd, st)
+            return (ll + ll_t - comp, st_upd, last_t + dt * valid), None
+
+        (ll, st, elapsed), _ = jax.lax.scan(
+            step, (jnp.zeros(mu.shape[0]), st0, jnp.zeros(mu.shape[0])),
+            jnp.arange(T))
+        # tail compensator to max_time
+        tail = jnp.maximum(mt - elapsed, 0.0)
+        decay_tail = 1 - jnp.exp(-b[None, :] * tail[:, None])
+        comp_tail = (mu * tail[:, None]).sum(-1) + \
+            ((st / b[None, :]) * decay_tail).sum(-1)
+        return ll - comp_tail, st * jnp.exp(-b[None, :] * tail[:, None])
+
+    return apply_op(pure, lda, alpha, beta, state, lags, marks, valid_length,
+                    max_time, name="hawkes_ll")
+
+
+def count_sketch(data, h, s, out_dim):
+    """Count-sketch projection (reference: contrib/count_sketch.cc):
+    out[:, h[j]] += s[j] * data[:, j] — a scatter-add, XLA-native."""
+
+    def pure(x, hh, ss):
+        hh = hh.astype(jnp.int32) % out_dim
+        proj = x * ss[None, :]
+        out = jnp.zeros((x.shape[0], out_dim), x.dtype)
+        return out.at[:, hh].add(proj)
+
+    return apply_op(pure, data, h, s, name="count_sketch")
+
+
+def getnnz(data, axis=None):
+    """Number of stored values (reference: contrib nnz op for CSR)."""
+    from ..ndarray.sparse import CSRNDArray
+
+    if isinstance(data, CSRNDArray):
+        if axis is None:
+            return NDArray(jnp.asarray(data.data.shape[0], jnp.int32))
+        if axis in (0, -2):  # per-column counts (scipy semantics)
+            return NDArray(jnp.bincount(
+                data.indices, length=data.shape[1]).astype(jnp.int32))
+        return NDArray(jnp.diff(data.indptr).astype(jnp.int32))
+    arr = data.asnumpy() if isinstance(data, NDArray) else _np.asarray(data)
+    return NDArray(jnp.asarray((arr != 0).sum(axis), jnp.int32))

@@ -215,17 +215,25 @@ def _ones_like(ctx, s, ins, outs, shapes):  # noqa: ARG001
 @_conv("slice")
 def _slice(ctx, s, ins, outs, shapes):
     begin, end = list(s.attr("begin")), list(s.attr("end"))
-    begin = [0 if b is None else b for b in begin]
-    end = [shapes[0][i] if e is None else e for i, e in enumerate(end)]
-    starts = ctx.const_i64(s.name + "_starts", begin)
-    ends = ctx.const_i64(s.name + "_ends", end)
+    step = list(s.attr("step") or [1] * len(begin))
+    step = [1 if st is None else st for st in step]
+    INT_MIN = -(2 ** 31)
+    b_res, e_res = [], []
+    for i, (b, e) in enumerate(zip(begin, end)):
+        if step[i] < 0:
+            # python slice(None, None, -st) == start at last elem, run past 0;
+            # ONNX needs an out-of-range sentinel for "include index 0"
+            b_res.append(shapes[0][i] - 1 if b is None else b)
+            e_res.append(INT_MIN if e is None else e)
+        else:
+            b_res.append(0 if b is None else b)
+            e_res.append(shapes[0][i] if e is None else e)
+    starts = ctx.const_i64(s.name + "_starts", b_res)
+    ends = ctx.const_i64(s.name + "_ends", e_res)
     axes = ctx.const_i64(s.name + "_axes", list(range(len(begin))))
     slice_ins = [ins[0], starts, ends, axes]
-    step = s.attr("step")
-    if step is not None and any(st not in (None, 1) for st in step):
-        steps = ctx.const_i64(
-            s.name + "_steps", [1 if st is None else st for st in step])
-        slice_ins.append(steps)
+    if any(st != 1 for st in step):
+        slice_ins.append(ctx.const_i64(s.name + "_steps", step))
     ctx.add_node("Slice", slice_ins, outs, s.name)
 
 

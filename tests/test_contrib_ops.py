@@ -1,0 +1,219 @@
+"""Contrib ops: roi_align, bbox/multibox, boolean_mask, misc.
+
+Reference coverage model: tests/python/unittest/test_contrib_operator.py.
+"""
+import numpy as np
+
+import mxnet_tpu as mx
+from mxnet_tpu.contrib import ops as C
+
+
+def test_roi_align_constant_and_ramp():
+    # constant feature map -> every pooled bin returns the constant
+    feat = np.full((1, 1, 8, 8), 5.0, "float32")
+    rois = mx.np.array([[0, 2.0, 2.0, 6.0, 6.0]])
+    out = C.roi_align(mx.np.array(feat), rois, (2, 2), spatial_scale=1.0)
+    assert out.shape == (1, 1, 2, 2)
+    assert np.allclose(out.asnumpy(), 5.0, atol=1e-5)
+    # linear ramp f(y,x)=y -> bin averages equal the bin-center y coords
+    ramp = np.tile(np.arange(8, dtype="float32")[:, None],
+                   (1, 8))[None, None]
+    out2 = C.roi_align(mx.np.array(ramp), rois, (2, 2)).asnumpy()[0, 0]
+    assert np.allclose(out2[:, 0], [3.0, 5.0], atol=1e-5)
+
+
+def test_roi_align_grad_flows():
+    from mxnet_tpu import autograd
+
+    x = mx.np.random.uniform(size=(1, 2, 6, 6))
+    x.attach_grad()
+    rois = mx.np.array([[0, 1.0, 1.0, 5.0, 5.0]])
+    with autograd.record():
+        out = C.roi_align(x, rois, (2, 2))
+        out.sum().backward()
+    assert np.abs(x.grad.asnumpy()).sum() > 0
+
+
+def test_box_iou():
+    a = mx.np.array([[0, 0, 2, 2]], dtype="float32")
+    b = mx.np.array([[1, 1, 3, 3], [0, 0, 2, 2], [5, 5, 6, 6]],
+                    dtype="float32")
+    iou = C.box_iou(a, b).asnumpy()
+    assert np.allclose(iou[0], [1 / 7, 1.0, 0.0], atol=1e-5)
+
+
+def test_box_nms_suppresses_overlaps():
+    rows = mx.np.array([
+        [0, 0.9, 0, 0, 2, 2],
+        [0, 0.8, 0.1, 0.1, 2.1, 2.1],  # overlaps the first
+        [0, 0.7, 5, 5, 7, 7],
+    ], dtype="float32")
+    out = C.box_nms(rows, overlap_thresh=0.5, coord_start=2, score_index=1,
+                    id_index=0).asnumpy()
+    assert out[0, 1] == np.float32(0.9)
+    assert out[1, 1] == np.float32(0.7)   # third box kept, reordered
+    assert np.all(out[2] == -1)           # suppressed slot
+
+
+def test_box_nms_center_format():
+    # same geometry as the corner test, expressed as (cx, cy, w, h)
+    rows = mx.np.array([
+        [0, 0.9, 1.0, 1.0, 2, 2],
+        [0, 0.8, 1.1, 1.1, 2, 2],
+        [0, 0.7, 6.0, 6.0, 2, 2],
+    ], dtype="float32")
+    out = C.box_nms(rows, overlap_thresh=0.5, coord_start=2, score_index=1,
+                    id_index=0, in_format="center",
+                    out_format="center").asnumpy()
+    assert out[0, 1] == np.float32(0.9)
+    assert out[1, 1] == np.float32(0.7)
+    assert np.all(out[2] == -1)
+    assert np.allclose(out[0, 2:], [1.0, 1.0, 2.0, 2.0])  # center preserved
+
+
+def test_hawkes_ll_padding_invariance():
+    """Padded steps must not change the result vs the unpadded sequence."""
+    K = 2
+    lda = mx.np.full((1, K), 0.5)
+    alpha = mx.np.full((K,), 0.2)
+    beta = mx.np.full((K,), 1.0)
+    state = mx.np.zeros((1, K))
+    lags_short = mx.np.array([[0.5, 0.7]])
+    marks_short = mx.np.array([[0.0, 1.0]])
+    ll_a, st_a = C.hawkes_ll(lda, alpha, beta, state, lags_short,
+                             marks_short, mx.np.array([2.0]),
+                             mx.np.array([5.0]))
+    lags_pad = mx.np.array([[0.5, 0.7, 100.0, 99.0]])
+    marks_pad = mx.np.array([[0.0, 1.0, 0.0, 1.0]])
+    ll_b, st_b = C.hawkes_ll(lda, alpha, beta, state, lags_pad, marks_pad,
+                             mx.np.array([2.0]), mx.np.array([5.0]))
+    assert np.allclose(ll_a.asnumpy(), ll_b.asnumpy(), atol=1e-5)
+    assert np.allclose(st_a.asnumpy(), st_b.asnumpy(), atol=1e-5)
+
+
+def test_getnnz_axis0_per_column():
+    from mxnet_tpu.ndarray import sparse
+
+    d = np.array([[1, 0, 2], [3, 0, 0]], "float32")
+    csr = sparse.csr_matrix(d)
+    assert list(C.getnnz(csr, axis=0).asnumpy()) == [2, 0, 1]
+
+
+def test_bipartite_matching():
+    scores = mx.np.array([[0.5, 0.9], [0.8, 0.2]])
+    row, col = C.bipartite_matching(scores)
+    assert list(row.asnumpy()) == [1.0, 0.0]
+    assert list(col.asnumpy()) == [1.0, 0.0]
+
+
+def test_multibox_prior_shapes_and_centers():
+    x = mx.np.zeros((1, 3, 4, 4))
+    anchors = C.multibox_prior(x, sizes=(0.5, 0.25), ratios=(1, 2))
+    K = 2 + 2 - 1
+    assert anchors.shape == (1, 4 * 4 * K, 4)
+    a = anchors.asnumpy()[0].reshape(4, 4, K, 4)
+    # first cell center at ~ (0.125, 0.125)
+    cx = (a[0, 0, 0, 0] + a[0, 0, 0, 2]) / 2
+    cy = (a[0, 0, 0, 1] + a[0, 0, 0, 3]) / 2
+    assert abs(cx - 0.125) < 1e-5 and abs(cy - 0.125) < 1e-5
+
+
+def test_multibox_target_and_detection_roundtrip():
+    anchors = C.multibox_prior(mx.np.zeros((1, 1, 4, 4)), sizes=(0.4,),
+                               ratios=(1,))
+    A = anchors.shape[1]
+    labels = mx.np.array([[[1, 0.1, 0.1, 0.4, 0.4]]])  # one gt box
+    cls_preds = mx.np.zeros((1, 3, A))
+    bt, bm, ct = C.multibox_target(anchors, labels, cls_preds)
+    assert bt.shape == (1, A * 4) and bm.shape == (1, A * 4)
+    assert ct.shape == (1, A)
+    assert (ct.asnumpy() == 2).any()  # gt class 1 -> target 2
+    assert bm.asnumpy().sum() >= 4    # at least one positive anchor
+
+    # detection: make the matched anchor strongly predict class 1 with the
+    # encoded offsets -> decode should recover ~the gt box
+    pos = int(np.nonzero(ct.asnumpy()[0])[0][0])
+    cp = np.zeros((1, 3, A), "float32")
+    cp[0, 0] = 0.9
+    cp[0, 2, pos] = 0.95
+    lp = bt.asnumpy().copy()
+    det = C.multibox_detection(mx.np.array(cp), mx.np.array(lp), anchors,
+                               threshold=0.5)
+    d = det.asnumpy()[0]
+    best = d[d[:, 0] >= 0]
+    assert len(best) >= 1
+    assert best[0, 0] == 1.0  # class id restored (target-1)
+    assert np.allclose(best[0, 2:], [0.1, 0.1, 0.4, 0.4], atol=0.05)
+
+
+def test_boolean_mask():
+    x = mx.np.array([[1, 2], [3, 4], [5, 6]], dtype="float32")
+    m = mx.np.array([1, 0, 1])
+    out = C.boolean_mask(x, m)
+    assert out.shape == (2, 2)
+    assert np.allclose(out.asnumpy(), [[1, 2], [5, 6]])
+
+
+def test_index_array_and_copy():
+    x = mx.np.zeros((2, 3))
+    idx = C.index_array(x)
+    assert idx.shape == (2, 3, 2)
+    assert idx.asnumpy()[1, 2].tolist() == [1, 2]
+    ax = C.index_array(x, axes=(1,))
+    assert ax.shape == (2, 3, 1)
+
+    old = mx.np.zeros((4, 2))
+    new = mx.np.ones((2, 2))
+    out = C.index_copy(old, mx.np.array([1, 3]), new)
+    got = out.asnumpy()
+    assert got[1].tolist() == [1, 1] and got[3].tolist() == [1, 1]
+    assert got[0].tolist() == [0, 0]
+
+
+def test_allclose_quadratic():
+    a = mx.np.ones((3,))
+    assert float(C.allclose(a, a).asnumpy()) == 1.0
+    assert float(C.allclose(a, a + 1).asnumpy()) == 0.0
+    q = C.quadratic(mx.np.array([1.0, 2.0]), a=1, b=2, c=3)
+    assert np.allclose(q.asnumpy(), [6.0, 11.0])
+
+
+def test_count_sketch():
+    x = mx.np.array([[1.0, 2.0, 3.0]])
+    h = mx.np.array([0, 1, 0])
+    s = mx.np.array([1.0, -1.0, 1.0])
+    out = C.count_sketch(x, h, s, out_dim=2)
+    assert np.allclose(out.asnumpy(), [[4.0, -2.0]])
+
+
+def test_getnnz():
+    from mxnet_tpu.ndarray import sparse
+
+    d = np.array([[1, 0, 2], [0, 0, 0]], "float32")
+    csr = sparse.csr_matrix(d)
+    assert int(C.getnnz(csr).asnumpy()) == 2
+    assert list(C.getnnz(csr, axis=1).asnumpy()) == [2, 0]
+    assert int(C.getnnz(mx.np.array(d)).asnumpy()) == 2
+
+
+def test_hawkes_ll_runs_and_differentiates():
+    from mxnet_tpu import autograd
+
+    N, T, K = 2, 5, 3
+    lda = mx.np.full((N, K), 0.5)
+    lda.attach_grad()
+    alpha = mx.np.full((K,), 0.2)
+    beta = mx.np.full((K,), 1.0)
+    state = mx.np.zeros((N, K))
+    lags = mx.np.array(np.random.exponential(1, (N, T)).astype("float32"))
+    marks = mx.np.array(np.random.randint(0, K, (N, T)).astype("float32"))
+    vl = mx.np.array([5.0, 3.0])
+    mt = mx.np.array([10.0, 8.0])
+    with autograd.record():
+        ll, new_state = C.hawkes_ll(lda, alpha, beta, state, lags, marks,
+                                    vl, mt)
+        ll.sum().backward()
+    assert ll.shape == (N,)
+    assert new_state.shape == (N, K)
+    assert np.isfinite(ll.asnumpy()).all()
+    assert np.abs(lda.grad.asnumpy()).sum() > 0

@@ -145,6 +145,21 @@ def test_export_unknown_op_raises(tmp_path):
                              onnx_file_path=str(tmp_path / "x.onnx"))
 
 
+def test_slice_negative_step_reversal(tmp_path):
+    x = sym.var("x")
+    out = sym.op.slice(x, begin=(None,), end=(None,), step=(-1,))
+    path = str(tmp_path / "rev.onnx")
+    mx.onnx.export_model(out, {}, in_shapes=[(5,)], onnx_file_path=path)
+    g = _roundtrip(path)["graph"]
+    sl = [n for n in g["nodes"] if n["op_type"] == "Slice"][0]
+    init = {t["name"]: t["array"] for t in g["initializers"]}
+    starts, ends, _, steps = [init[i] for i in sl["input"][1:]]
+    assert starts[0] == 4              # last element
+    assert ends[0] == -(2 ** 31)       # out-of-range sentinel includes idx 0
+    assert steps[0] == -1
+    assert g["outputs"][0]["shape"] == [5]
+
+
 def test_negative_int_attr_roundtrip():
     n = P.parse_node(P.node("Softmax", ["x"], ["y"], "s", {"axis": -1}))
     assert n["attrs"]["axis"] == -1

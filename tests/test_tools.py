@@ -1,0 +1,106 @@
+"""Tools: im2rec pack/read round-trip, launch.py local mode, bandwidth,
+opperf harness (reference: tools/im2rec, tools/launch.py,
+tools/bandwidth/measure.py, benchmark/opperf/).
+"""
+import json
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+REPO = os.path.join(os.path.dirname(__file__), "..")
+ENV = dict(os.environ, JAX_PLATFORMS="cpu", PYTHONPATH=REPO)
+
+PIL = pytest.importorskip("PIL")
+from PIL import Image  # noqa: E402
+
+
+@pytest.fixture()
+def img_root(tmp_path):
+    for cls in ("cat", "dog"):
+        d = tmp_path / "imgs" / cls
+        d.mkdir(parents=True)
+        for i in range(3):
+            arr = np.random.randint(0, 255, (32, 32, 3)).astype("uint8")
+            Image.fromarray(arr).save(str(d / f"{cls}{i}.jpg"))
+    return str(tmp_path / "imgs")
+
+
+def test_im2rec_list_and_pack(img_root, tmp_path):
+    sys.path.insert(0, os.path.join(REPO, "tools"))
+    import im2rec
+
+    prefix = str(tmp_path / "data")
+    lists = im2rec.make_list(prefix, img_root, shuffle=False)
+    assert os.path.exists(lists[0])
+    lines = open(lists[0]).read().strip().split("\n")
+    assert len(lines) == 6
+    labels = {line.split("\t")[1] for line in lines}
+    assert labels == {"0", "1"}
+
+    n = im2rec.pack_list(prefix, img_root)
+    assert n == 6
+    assert os.path.exists(prefix + ".rec")
+
+    # read back through ImageRecordIter
+    import mxnet_tpu as mx
+
+    it = mx.io.ImageRecordIter(path_imgrec=prefix + ".rec",
+                               data_shape=(3, 32, 32), batch_size=3)
+    batch = next(it)
+    assert batch.data[0].shape == (3, 3, 32, 32)
+    assert batch.label[0].shape == (3,)
+
+
+def test_im2rec_cli(img_root, tmp_path):
+    prefix = str(tmp_path / "cli")
+    rc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "im2rec.py"),
+         prefix, img_root, "--no-shuffle"],
+        env=ENV, capture_output=True, text=True)
+    assert rc.returncode == 0, rc.stderr
+    assert os.path.exists(prefix + ".rec")
+
+
+def test_launch_local_spawns_ranked_workers(tmp_path):
+    marker = str(tmp_path / "rank")
+    script = str(tmp_path / "worker.py")
+    with open(script, "w") as f:
+        f.write(
+            "import os\n"
+            f"open({marker!r} + os.environ['MXTPU_WORKER_RANK'], 'w')"
+            ".write(os.environ['MXTPU_NUM_WORKERS'])\n")
+    rc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "launch.py"),
+         "-n", "3", sys.executable, script],
+        env=ENV, capture_output=True, text=True)
+    assert rc.returncode == 0, rc.stderr
+    for r in range(3):
+        assert open(marker + str(r)).read() == "3"
+
+
+def test_bandwidth_harness():
+    rc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "bandwidth.py"),
+         "--sizes-mb", "0.25", "--iters", "2"],
+        env=dict(ENV, XLA_FLAGS="--xla_force_host_platform_device_count=4"),
+        capture_output=True, text=True, timeout=300)
+    assert rc.returncode == 0, rc.stderr
+    row = json.loads(rc.stdout.strip().split("\n")[-1])
+    assert row["n_devices"] == 4
+    assert row["algo_bw_gbps"] > 0
+
+
+def test_opperf_harness():
+    rc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "benchmark", "opperf.py"),
+         "--size", "64", "--iters", "2", "--ops", "add,dot,conv2d"],
+        env=ENV, capture_output=True, text=True, timeout=300)
+    assert rc.returncode == 0, rc.stderr
+    rows = [json.loads(x) for x in rc.stdout.strip().split("\n")]
+    ops = {r["op"] for r in rows}
+    assert ops == {"add", "dot", "conv2d"}
+    assert all(r["fwd_ms"] > 0 for r in rows)
+    assert all(r["fwd_bwd_ms"] > 0 for r in rows)

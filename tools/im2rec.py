@@ -1,0 +1,104 @@
+#!/usr/bin/env python
+"""im2rec — pack an image folder (or .lst file) into RecordIO .rec/.idx.
+
+Reference: tools/im2rec.py + tools/im2rec.cc. Two modes, like the reference:
+  --list  : walk an image root, write a train .lst (index\tlabel\tpath)
+  (default): read a .lst and pack each image into prefix.rec + prefix.idx
+
+Usage:
+  python tools/im2rec.py --list prefix image_root
+  python tools/im2rec.py prefix image_root [--resize N] [--quality Q]
+"""
+from __future__ import annotations
+
+import argparse
+import os
+import random
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+EXTS = (".jpg", ".jpeg", ".png")
+
+
+def make_list(prefix, root, shuffle=True, train_ratio=1.0):
+    """Walk `root`; one class per subdirectory, labels by sorted dir name."""
+    classes = sorted(
+        d for d in os.listdir(root) if os.path.isdir(os.path.join(root, d)))
+    label_of = {c: i for i, c in enumerate(classes)}
+    items = []
+    if classes:
+        for c in classes:
+            for fn in sorted(os.listdir(os.path.join(root, c))):
+                if fn.lower().endswith(EXTS):
+                    items.append((label_of[c], os.path.join(c, fn)))
+    else:  # flat dir: label 0
+        for fn in sorted(os.listdir(root)):
+            if fn.lower().endswith(EXTS):
+                items.append((0, fn))
+    if shuffle:
+        random.shuffle(items)
+    n_train = int(len(items) * train_ratio)
+    splits = [(prefix + ".lst", items[:n_train])]
+    if train_ratio < 1.0:
+        splits.append((prefix + "_val.lst", items[n_train:]))
+    for fname, part in splits:
+        with open(fname, "w") as f:
+            for i, (label, rel) in enumerate(part):
+                f.write(f"{i}\t{label}\t{rel}\n")
+        print(f"wrote {len(part)} entries to {fname}")
+    return [s[0] for s in splits]
+
+
+def pack_list(prefix, root, lst_path=None, resize=0, quality=95,
+              img_fmt=".jpg"):
+    """Pack every .lst entry into prefix.rec/.idx."""
+    from mxnet_tpu import image as mi
+    from mxnet_tpu.recordio import IRHeader, MXIndexedRecordIO, pack_img
+
+    lst_path = lst_path or prefix + ".lst"
+    rec = MXIndexedRecordIO(prefix + ".idx", prefix + ".rec", "w")
+    count = 0
+    with open(lst_path) as f:
+        for line in f:
+            parts = line.strip().split("\t")
+            if len(parts) < 3:
+                continue
+            idx, label, rel = int(parts[0]), float(parts[1]), parts[-1]
+            img = mi.imread(os.path.join(root, rel))
+            if resize:
+                img = mi.resize_short(img, resize)
+            header = IRHeader(0, label, idx, 0)
+            rec.write_idx(idx, pack_img(header, img.asnumpy(),
+                                        quality=quality, img_fmt=img_fmt))
+            count += 1
+    rec.close()
+    print(f"packed {count} images into {prefix}.rec")
+    return count
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("prefix")
+    p.add_argument("root")
+    p.add_argument("--list", action="store_true", dest="make_list")
+    p.add_argument("--lst", default=None, help="explicit .lst path to pack")
+    p.add_argument("--resize", type=int, default=0)
+    p.add_argument("--quality", type=int, default=95)
+    p.add_argument("--train-ratio", type=float, default=1.0)
+    p.add_argument("--no-shuffle", action="store_true")
+    p.add_argument("--img-format", default=".jpg")
+    args = p.parse_args(argv)
+    if args.make_list:
+        make_list(args.prefix, args.root, shuffle=not args.no_shuffle,
+                  train_ratio=args.train_ratio)
+    else:
+        if not os.path.exists(args.lst or args.prefix + ".lst"):
+            make_list(args.prefix, args.root, shuffle=not args.no_shuffle)
+        pack_list(args.prefix, args.root, lst_path=args.lst,
+                  resize=args.resize, quality=args.quality,
+                  img_fmt=args.img_format)
+
+
+if __name__ == "__main__":
+    main()

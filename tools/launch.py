@@ -1,0 +1,72 @@
+#!/usr/bin/env python
+"""Distributed launcher (reference: tools/launch.py → dmlc-tracker).
+
+TPU re-design: there is no scheduler/server topology — every process is a
+peer in a jax.distributed job. This launcher spawns N local worker
+processes (the dmlc `--launcher local` analog) with the coordinator env
+set so `jax.distributed.initialize()` (or `mxnet_tpu.kvstore` multi-host
+stores) wires them into one slice-wide job:
+
+  python tools/launch.py -n 4 python train.py --kv-store tpu_dist
+
+Each worker gets:
+  MXTPU_NUM_WORKERS / MXTPU_WORKER_RANK      (framework-level rank info)
+  JAX_COORDINATOR_ADDRESS / JAX_NUM_PROCESSES / JAX_PROCESS_ID
+On a real multi-host pod, one process per host runs with the same env
+provided by the cluster scheduler instead (GKE/Borg set these for you);
+this local mode exists for development and the distributed test suite,
+exactly like the reference's localhost tracker.
+"""
+from __future__ import annotations
+
+import argparse
+import os
+import socket
+import subprocess
+import sys
+
+
+def _free_port():
+    with socket.socket() as s:
+        s.bind(("", 0))
+        return s.getsockname()[1]
+
+
+def launch(n, cmd, env_extra=None):
+    port = _free_port()
+    procs = []
+    for rank in range(n):
+        env = dict(os.environ)
+        env.update(env_extra or {})
+        env.update({
+            "MXTPU_NUM_WORKERS": str(n),
+            "MXTPU_WORKER_RANK": str(rank),
+            "JAX_COORDINATOR_ADDRESS": f"127.0.0.1:{port}",
+            "JAX_NUM_PROCESSES": str(n),
+            "JAX_PROCESS_ID": str(rank),
+            # reference-compat spellings (DMLC_* envs, distributed_training.md)
+            "DMLC_NUM_WORKER": str(n),
+            "DMLC_WORKER_ID": str(rank),
+        })
+        procs.append(subprocess.Popen(cmd, env=env))
+    rc = 0
+    for p in procs:
+        rc |= p.wait()
+    return rc
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("-n", "--num-workers", type=int, required=True)
+    p.add_argument("--launcher", default="local", choices=["local"],
+                   help="only local mode; multi-host uses the cluster "
+                        "scheduler's env")
+    p.add_argument("command", nargs=argparse.REMAINDER)
+    args = p.parse_args(argv)
+    if not args.command:
+        p.error("no command given")
+    sys.exit(launch(args.num_workers, args.command))
+
+
+if __name__ == "__main__":
+    main()
