@@ -1,0 +1,229 @@
+// mxtpu.hpp — C++ frontend over the mxnet_tpu native runtime.
+//
+// Reference analog: cpp-package/include/mxnet-cpp/ (the C++ API generated
+// over the C API). TPU re-design: the compute path (ops, autograd, jit)
+// lives in XLA behind the Python frontend, so the C++ surface wraps what is
+// genuinely native here — the dependency engine, pooled storage, RecordIO,
+// and the prefetch pipeline (native/mxtpu_runtime.cc) — giving C++ data
+// pipelines and schedulers first-class access to the same runtime the
+// Python frontend uses.
+//
+// Link against build/libmxtpu.so (built by native/Makefile).
+#pragma once
+
+#include <cstdint>
+#include <cstdio>
+#include <cstring>
+#include <functional>
+#include <stdexcept>
+#include <string>
+#include <vector>
+
+extern "C" {
+// op callback: return 0 on success, nonzero + message in err on failure
+typedef int (*mxt_fn_t)(void* ctx, char* err, size_t err_len);
+typedef void (*mxt_del_t)(void*);
+
+const char* MXTGetLastError();
+const char* MXTLibVersion();
+
+void* MXTEngineNewVar();
+void MXTEngineDeleteVar(void* v);
+int MXTEnginePushAsync(mxt_fn_t fn, mxt_del_t del, void* ctx,
+                       void** const_vars, int n_const, void** mutable_vars,
+                       int n_mutable, int priority, int prop);
+int MXTEngineWaitForVar(void* v);
+int MXTEngineWaitAll();
+uint64_t MXTEngineVarVersion(void* v);
+int64_t MXTEnginePending();
+void MXTEngineShutdown();
+
+void* MXTStorageAlloc(int64_t size);
+int MXTStorageFree(void* p);
+int MXTStorageDirectFree(void* p);
+void MXTStorageReleaseAll();
+void MXTStorageStats(int64_t* used, int64_t* pooled, int64_t* hits,
+                     int64_t* misses);
+
+void* MXTRecordIOWriterCreate(const char* path);
+int MXTRecordIOWriterWrite(void* h, const void* data, int64_t len);
+int64_t MXTRecordIOWriterTell(void* h);
+void MXTRecordIOWriterFree(void* h);
+void* MXTRecordIOReaderCreate(const char* path);
+int64_t MXTRecordIOReaderRead(void* h, const void** data);
+void MXTRecordIOReaderSeek(void* h, int64_t pos);
+int64_t MXTRecordIOReaderTell(void* h);
+void MXTRecordIOReaderFree(void* h);
+
+void* MXTPipelineCreate(int n_threads, int capacity);
+int64_t MXTPipelineSubmit(void* h, mxt_fn_t fn, mxt_del_t del, void* ctx);
+int64_t MXTPipelinePop(void* h, int* status, void** ctx, int64_t timeout_ms);
+void MXTPipelineFree(void* h);
+}
+
+namespace mxtpu {
+
+namespace detail {
+// Adapts std::function<void()> to the runtime's (ctx, err, len) callback,
+// translating C++ exceptions into the engine's deferred-error channel.
+inline int InvokeFn(void* c, char* err, size_t err_len) {
+  try {
+    (*static_cast<std::function<void()>*>(c))();
+    return 0;
+  } catch (const std::exception& e) {
+    std::snprintf(err, err_len, "%s", e.what());
+    return -1;
+  } catch (...) {
+    std::snprintf(err, err_len, "unknown C++ exception");
+    return -1;
+  }
+}
+
+inline void DeleteFn(void* c) { delete static_cast<std::function<void()>*>(c); }
+}  // namespace detail
+
+inline std::string LibVersion() { return MXTLibVersion(); }
+
+inline void Check(int rc, const char* what) {
+  if (rc != 0) {
+    throw std::runtime_error(std::string(what) + ": " + MXTGetLastError());
+  }
+}
+
+// Engine variable with RAII lifetime (reference: mxnet::Engine::Var).
+class Var {
+ public:
+  Var() : handle_(MXTEngineNewVar()) {}
+  ~Var() {
+    if (handle_) MXTEngineDeleteVar(handle_);
+  }
+  Var(const Var&) = delete;
+  Var& operator=(const Var&) = delete;
+  Var(Var&& o) noexcept : handle_(o.handle_) { o.handle_ = nullptr; }
+
+  void* handle() const { return handle_; }
+  uint64_t version() const { return MXTEngineVarVersion(handle_); }
+  void WaitToRead() const { Check(MXTEngineWaitForVar(handle_), "wait"); }
+
+ private:
+  void* handle_;
+};
+
+// Async dependency engine (reference: Engine::Get()->PushAsync).
+class Engine {
+ public:
+  using Fn = std::function<void()>;
+
+  static void Push(Fn fn, const std::vector<const Var*>& const_vars,
+                   const std::vector<const Var*>& mutable_vars,
+                   int priority = 0, int prop = 0) {
+    auto* ctx = new Fn(std::move(fn));
+    std::vector<void*> cv, mv;
+    for (auto* v : const_vars) cv.push_back(v->handle());
+    for (auto* v : mutable_vars) mv.push_back(v->handle());
+    Check(MXTEnginePushAsync(
+              detail::InvokeFn, detail::DeleteFn, ctx,
+              cv.empty() ? nullptr : cv.data(), (int)cv.size(),
+              mv.empty() ? nullptr : mv.data(), (int)mv.size(), priority,
+              prop),
+          "push");
+  }
+
+  static void WaitAll() { Check(MXTEngineWaitAll(), "waitall"); }
+  static int64_t Pending() { return MXTEnginePending(); }
+};
+
+// Pooled storage allocation (reference: Storage::Get()->Alloc).
+class Storage {
+ public:
+  struct Stats {
+    int64_t used, pooled, hits, misses;
+  };
+
+  static void* Alloc(int64_t size) {
+    void* p = MXTStorageAlloc(size);
+    if (!p) throw std::runtime_error(MXTGetLastError());
+    return p;
+  }
+  static void Free(void* p) { Check(MXTStorageFree(p), "free"); }
+  static void DirectFree(void* p) {
+    Check(MXTStorageDirectFree(p), "direct_free");
+  }
+  static Stats GetStats() {
+    Stats s{};
+    MXTStorageStats(&s.used, &s.pooled, &s.hits, &s.misses);
+    return s;
+  }
+};
+
+// RecordIO (reference: dmlc::RecordIOWriter/Reader; tools/im2rec.cc).
+class RecordWriter {
+ public:
+  explicit RecordWriter(const std::string& path)
+      : h_(MXTRecordIOWriterCreate(path.c_str())) {
+    if (!h_) throw std::runtime_error(MXTGetLastError());
+  }
+  ~RecordWriter() {
+    if (h_) MXTRecordIOWriterFree(h_);
+  }
+  void Write(const void* data, int64_t len) {
+    Check(MXTRecordIOWriterWrite(h_, data, len), "rec write");
+  }
+  void Write(const std::string& s) { Write(s.data(), (int64_t)s.size()); }
+  int64_t Tell() const { return MXTRecordIOWriterTell(h_); }
+
+ private:
+  void* h_;
+};
+
+class RecordReader {
+ public:
+  explicit RecordReader(const std::string& path)
+      : h_(MXTRecordIOReaderCreate(path.c_str())) {
+    if (!h_) throw std::runtime_error(MXTGetLastError());
+  }
+  ~RecordReader() {
+    if (h_) MXTRecordIOReaderFree(h_);
+  }
+  // Returns false at EOF; record stays valid until the next Read.
+  bool Read(std::string* out) {
+    const void* data = nullptr;
+    int64_t n = MXTRecordIOReaderRead(h_, &data);
+    if (n < 0) return false;
+    out->assign(static_cast<const char*>(data), (size_t)n);
+    return true;
+  }
+  void Seek(int64_t pos) { MXTRecordIOReaderSeek(h_, pos); }
+  int64_t Tell() const { return MXTRecordIOReaderTell(h_); }
+
+ private:
+  void* h_;
+};
+
+// Ordered prefetch pipeline (reference: iter_prefetcher.h threads).
+class Pipeline {
+ public:
+  using Fn = std::function<void()>;
+
+  explicit Pipeline(int n_threads, int capacity = 64)
+      : h_(MXTPipelineCreate(n_threads, capacity)) {
+    if (!h_) throw std::runtime_error(MXTGetLastError());
+  }
+  ~Pipeline() {
+    if (h_) MXTPipelineFree(h_);
+  }
+  int64_t Submit(Fn fn) {
+    auto* ctx = new Fn(std::move(fn));
+    return MXTPipelineSubmit(h_, detail::InvokeFn, detail::DeleteFn, ctx);
+  }
+  // Returns ticket id (ordered), status 0 = ok; -1 when drained/empty.
+  int64_t Pop(int* status, int64_t timeout_ms = -1) {
+    void* ctx = nullptr;
+    return MXTPipelinePop(h_, status, &ctx, timeout_ms);
+  }
+
+ private:
+  void* h_;
+};
+
+}  // namespace mxtpu

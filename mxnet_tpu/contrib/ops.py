@@ -114,6 +114,21 @@ def _np_iou_row(box, rest):
     return _np.where(union > 0, inter / union, 0.0)
 
 
+def _np_iou_matrix(a, b):
+    """(N,4) x (M,4) corner-format IoU in plain numpy (eager host paths)."""
+    ix1 = _np.maximum(a[:, None, 0], b[None, :, 0])
+    iy1 = _np.maximum(a[:, None, 1], b[None, :, 1])
+    ix2 = _np.minimum(a[:, None, 2], b[None, :, 2])
+    iy2 = _np.minimum(a[:, None, 3], b[None, :, 3])
+    inter = _np.clip(ix2 - ix1, 0, None) * _np.clip(iy2 - iy1, 0, None)
+    area_a = _np.clip(a[:, 2] - a[:, 0], 0, None) * \
+        _np.clip(a[:, 3] - a[:, 1], 0, None)
+    area_b = _np.clip(b[:, 2] - b[:, 0], 0, None) * \
+        _np.clip(b[:, 3] - b[:, 1], 0, None)
+    union = area_a[:, None] + area_b[None, :] - inter
+    return _np.where(union > 0, inter / union, 0.0)
+
+
 def _center_to_corner_np(c):
     out = c.copy()
     out[:, 0] = c[:, 0] - c[:, 2] / 2
@@ -140,10 +155,9 @@ def box_nms(data, overlap_thresh=0.5, valid_thresh=0, topk=-1, coord_start=2,
     reference."""
     arr = data.asnumpy() if isinstance(data, NDArray) else _np.asarray(data)
     orig_shape = arr.shape
-    boxes2d = arr.reshape(-1, orig_shape[-1]) if arr.ndim == 2 else \
-        arr.reshape(arr.shape[0], -1, orig_shape[-1])
-    if arr.ndim == 2:
-        boxes2d = boxes2d[None]
+    # batch = product of ALL leading dims; boxes = second-to-last dim
+    boxes2d = arr.reshape(-1, orig_shape[-2], orig_shape[-1]) \
+        if arr.ndim >= 3 else arr[None]
     out = _np.full_like(boxes2d, -1.0)
     cs = coord_start
     for b in range(boxes2d.shape[0]):
@@ -217,9 +231,12 @@ def multibox_prior(data, sizes=(1.0,), ratios=(1.0,), clip=False,
         cy = (jnp.arange(H) + offsets[0]) * step_y
         cx = (jnp.arange(W) + offsets[1]) * step_x
         cyy, cxx = jnp.meshgrid(cy, cx, indexing="ij")       # (H, W)
-        whs = [(sizes[0] * _np.sqrt(r), sizes[0] / _np.sqrt(r))
-               for r in ratios]
-        whs += [(s, s) for s in sizes[1:]]
+        # reference order (multibox_prior.cc): all sizes at ratios[0]
+        # first, then sizes[0] at each remaining ratio
+        r0 = ratios[0]
+        whs = [(s * _np.sqrt(r0), s / _np.sqrt(r0)) for s in sizes]
+        whs += [(sizes[0] * _np.sqrt(r), sizes[0] / _np.sqrt(r))
+                for r in ratios[1:]]
         boxes = []
         for w, h in whs:
             boxes.append(jnp.stack([cxx - w / 2, cyy - h / 2,
@@ -254,12 +271,13 @@ def multibox_target(anchors, labels, cls_preds, overlap_threshold=0.5,
     ah = anc[:, 3] - anc[:, 1]
     acx = (anc[:, 0] + anc[:, 2]) / 2
     acy = (anc[:, 1] + anc[:, 3]) / 2
+    cp_np = cls_preds.asnumpy() if isinstance(cls_preds, NDArray) else \
+        _np.asarray(cls_preds)
     for n in range(N):
         gt = lab[n][lab[n, :, 0] >= 0]
         if len(gt) == 0:
             continue
-        ious = _np.asarray(_iou_matrix(jnp.asarray(anc),
-                                       jnp.asarray(gt[:, 1:5])))
+        ious = _np_iou_matrix(anc, gt[:, 1:5])
         best_gt = ious.argmax(axis=1)
         best_iou = ious.max(axis=1)
         pos = best_iou >= overlap_threshold
@@ -280,6 +298,17 @@ def multibox_target(anchors, labels, cls_preds, overlap_threshold=0.5,
         box_t[n] = _np.where(pos[:, None], t, 0).ravel()
         box_m[n] = _np.repeat(pos.astype(_np.float32), 4)
         cls_t[n] = _np.where(pos, g[:, 0] + 1, 0)
+        if negative_mining_ratio > 0:
+            # hard-negative mining (reference: multibox_target.cc): keep the
+            # most object-confident negatives at ratio * npos; the rest are
+            # marked ignore_label so the loss skips them
+            neg = ~pos
+            n_keep = int(negative_mining_ratio * pos.sum())
+            neg_idx = _np.nonzero(neg)[0]
+            if len(neg_idx) > n_keep:
+                conf = cp_np[n, 1:, :].max(axis=0)  # objectness per anchor
+                drop = neg_idx[_np.argsort(-conf[neg_idx])[n_keep:]]
+                cls_t[n][drop] = ignore_label
     return (NDArray(jnp.asarray(box_t)), NDArray(jnp.asarray(box_m)),
             NDArray(jnp.asarray(cls_t)))
 

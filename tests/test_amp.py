@@ -1,0 +1,92 @@
+"""AMP: bf16 conversion, cast lists, loss scaler (reference coverage
+model: tests/python/gpu/test_amp.py + amp init tests)."""
+import numpy as np
+
+import mxnet_tpu as mx
+from mxnet_tpu import amp, autograd, gluon
+
+
+def _mlp_with_norm():
+    net = gluon.nn.Sequential()
+    net.add(gluon.nn.Dense(8, in_units=4), gluon.nn.BatchNorm(),
+            gluon.nn.Activation("relu"), gluon.nn.Dense(2))
+    net.initialize()
+    net(mx.np.ones((2, 4)))  # materialize
+    return net
+
+
+def test_init_and_lists():
+    amp.init("bfloat16")
+    assert "fully_connected" in amp.list_lp16_ops()
+    assert "softmax" in amp.list_fp32_ops()
+    amp.init("float16")  # fp16 requests map to bf16 on TPU
+    assert amp._target_dtype == "bfloat16"
+
+
+def test_convert_hybrid_block_casts_params_not_norms():
+    net = _mlp_with_norm()
+    amp.convert_hybrid_block(net, cast_params_offline=True)
+    import ml_dtypes
+
+    params = net.collect_params()
+    for name, p in params.items():
+        d = p.data()
+        lname = name.lower()
+        if any(k in lname for k in ("gamma", "beta", "running", "moving")):
+            assert d.dtype == np.float32, f"{name} should stay fp32"
+        else:
+            assert d.dtype == ml_dtypes.bfloat16, f"{name} should be bf16"
+    # forward still works and returns bf16
+    out = net(mx.np.ones((2, 4)))
+    assert out.dtype == ml_dtypes.bfloat16
+    assert np.isfinite(out.asnumpy().astype("float32")).all()
+
+
+def test_converted_block_trains():
+    net = _mlp_with_norm()
+    amp.convert_hybrid_block(net)
+    tr = gluon.Trainer(net.collect_params(), "sgd", {"learning_rate": 0.1})
+    amp.init_trainer(tr)
+    lf = gluon.loss.SoftmaxCrossEntropyLoss()
+    x = mx.np.random.uniform(size=(8, 4))
+    y = mx.np.array(np.random.randint(0, 2, (8,)))
+    for _ in range(3):
+        with autograd.record():
+            with amp.scale_loss(lf(net(x), y), tr) as L:
+                L.backward()
+        amp.unscale(tr)
+        tr.step(8)
+    for p in net.collect_params().values():
+        assert np.isfinite(p.data().asnumpy().astype("float32")).all()
+
+
+def test_loss_scaler_dynamics():
+    scaler = amp.LossScaler(init_scale=8.0, scale_factor=2.0, scale_window=2)
+    scaler.loss_scale = 8.0
+    scaler.update_scale(overflow=True)
+    assert scaler.loss_scale == 4.0
+    scaler.update_scale(False)
+    scaler.update_scale(False)  # window reached -> grow
+    assert scaler.loss_scale == 8.0
+
+
+def test_loss_scaler_overflow_detection():
+    p = gluon.Parameter("w", shape=(2,))
+    p.initialize()
+    p.data()._grad = mx.np.array([1.0, np.inf])
+    p._grad_map = {d: p.data()._grad for d in p._data_map}
+
+    class FakeParam:
+        grad_req = "write"
+
+        def grad(self):
+            return mx.np.array([1.0, np.inf])
+
+    scaler = amp.LossScaler()
+    assert scaler.has_overflow([FakeParam()])
+
+    class FiniteParam(FakeParam):
+        def grad(self):
+            return mx.np.array([1.0, 2.0])
+
+    assert not scaler.has_overflow([FiniteParam()])

@@ -71,6 +71,45 @@ def test_box_nms_center_format():
     assert np.allclose(out[0, 2:], [1.0, 1.0, 2.0, 2.0])  # center preserved
 
 
+def test_box_nms_batch_independence():
+    """Boxes in different (possibly nested) batches must not suppress
+    each other."""
+    b0 = [[0, 0.9, 0, 0, 2, 2]]
+    b1 = [[0, 0.8, 0.1, 0.1, 2.1, 2.1]]  # overlaps b0's box, other batch
+    rows = mx.np.array([[b0, b1]], dtype="float32")  # shape (1, 2, 1, 6)
+    out = C.box_nms(rows, overlap_thresh=0.5, coord_start=2, score_index=1,
+                    id_index=0).asnumpy()
+    assert out.shape == (1, 2, 1, 6)
+    assert out[0, 0, 0, 1] == np.float32(0.9)
+    assert out[0, 1, 0, 1] == np.float32(0.8)  # survived: separate batch
+
+
+def test_multibox_prior_sizes_first_order():
+    anchors = C.multibox_prior(mx.np.zeros((1, 1, 1, 1)),
+                               sizes=(0.5, 0.25), ratios=(1.0, 4.0))
+    a = anchors.asnumpy()[0]  # 3 anchors for one cell
+    w = a[:, 2] - a[:, 0]
+    # order: s1@r1 (w=0.5), s2@r1 (w=0.25), s1@r2 (w=0.5*2=1.0)
+    assert np.allclose(w, [0.5, 0.25, 1.0], atol=1e-5)
+
+
+def test_multibox_target_negative_mining():
+    anchors = C.multibox_prior(mx.np.zeros((1, 1, 4, 4)), sizes=(0.4,),
+                               ratios=(1,))
+    A = anchors.shape[1]
+    labels = mx.np.array([[[1, 0.1, 0.1, 0.4, 0.4]]])
+    cls_preds = mx.np.array(
+        np.random.uniform(0, 1, (1, 3, A)).astype("float32"))
+    _, _, ct = C.multibox_target(anchors, labels, cls_preds,
+                                 negative_mining_ratio=1.0)
+    vals = ct.asnumpy()[0]
+    n_pos = (vals > 0).sum()
+    n_neg = (vals == 0).sum()
+    n_ignored = (vals == -1).sum()
+    assert n_neg <= n_pos          # mined down to ratio * npos
+    assert n_ignored == A - n_pos - n_neg > 0
+
+
 def test_hawkes_ll_padding_invariance():
     """Padded steps must not change the result vs the unpadded sequence."""
     K = 2

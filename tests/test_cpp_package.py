@@ -1,0 +1,34 @@
+"""cpp-package: compile + run the C++ frontend demo against libmxtpu.so
+(reference coverage model: cpp-package CI example builds)."""
+import os
+import subprocess
+import sys
+
+import pytest
+
+REPO = os.path.abspath(os.path.join(os.path.dirname(__file__), ".."))
+
+
+@pytest.fixture(scope="module")
+def libmxtpu():
+    so = os.path.join(REPO, "native", "build", "libmxtpu.so")
+    if not os.path.exists(so):
+        subprocess.run(["make", "-C", os.path.join(REPO, "native")],
+                       check=True, capture_output=True)
+    return so
+
+
+def test_cpp_frontend_demo(libmxtpu, tmp_path):
+    exe = str(tmp_path / "runtime_demo")
+    build = subprocess.run(
+        ["g++", "-O2", "-std=c++17",
+         "-I" + os.path.join(REPO, "cpp-package", "include"),
+         os.path.join(REPO, "cpp-package", "example", "runtime_demo.cc"),
+         "-L" + os.path.dirname(libmxtpu), "-lmxtpu",
+         "-Wl,-rpath," + os.path.dirname(libmxtpu),
+         "-o", exe, "-pthread"],
+        capture_output=True, text=True)
+    assert build.returncode == 0, build.stderr
+    run = subprocess.run([exe], capture_output=True, text=True, timeout=120)
+    assert run.returncode == 0, run.stderr + run.stdout
+    assert "all checks passed" in run.stdout

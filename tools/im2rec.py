@@ -93,9 +93,12 @@ def main(argv=None):
         make_list(args.prefix, args.root, shuffle=not args.no_shuffle,
                   train_ratio=args.train_ratio)
     else:
-        if not os.path.exists(args.lst or args.prefix + ".lst"):
+        lst = args.lst or args.prefix + ".lst"
+        if not os.path.exists(lst):
+            if args.lst:
+                p.error(f"--lst file {args.lst} does not exist")
             make_list(args.prefix, args.root, shuffle=not args.no_shuffle)
-        pack_list(args.prefix, args.root, lst_path=args.lst,
+        pack_list(args.prefix, args.root, lst_path=lst,
                   resize=args.resize, quality=args.quality,
                   img_fmt=args.img_format)
 

@@ -51,7 +51,9 @@ def launch(n, cmd, env_extra=None):
         procs.append(subprocess.Popen(cmd, env=env))
     rc = 0
     for p in procs:
-        rc |= p.wait()
+        code = p.wait()
+        if rc == 0 and code != 0:
+            rc = code if code > 0 else 1  # first failure wins; signals -> 1
     return rc
 
 
