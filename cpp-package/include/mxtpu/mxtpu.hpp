@@ -219,7 +219,10 @@ class Pipeline {
   // Returns ticket id (ordered), status 0 = ok; -1 when drained/empty.
   int64_t Pop(int* status, int64_t timeout_ms = -1) {
     void* ctx = nullptr;
-    return MXTPipelinePop(h_, status, &ctx, timeout_ms);
+    int64_t t = MXTPipelinePop(h_, status, &ctx, timeout_ms);
+    // pop transfers ctx ownership to the caller; release the task closure
+    if (ctx) detail::DeleteFn(ctx);
+    return t;
   }
 
  private:

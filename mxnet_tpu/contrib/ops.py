@@ -271,8 +271,10 @@ def multibox_target(anchors, labels, cls_preds, overlap_threshold=0.5,
     ah = anc[:, 3] - anc[:, 1]
     acx = (anc[:, 0] + anc[:, 2]) / 2
     acy = (anc[:, 1] + anc[:, 3]) / 2
-    cp_np = cls_preds.asnumpy() if isinstance(cls_preds, NDArray) else \
-        _np.asarray(cls_preds)
+    cp_np = None
+    if negative_mining_ratio > 0:  # only mining reads the predictions
+        cp_np = cls_preds.asnumpy() if isinstance(cls_preds, NDArray) else \
+            _np.asarray(cls_preds)
     for n in range(N):
         gt = lab[n][lab[n, :, 0] >= 0]
         if len(gt) == 0:

@@ -11,10 +11,13 @@ from .optimizer import (  # noqa: F401
     AdaDelta,
     AdaGrad,
     Adam,
+    Adamax,
     AdamW,
     DCASGD,
+    FTML,
     Ftrl,
     LAMB,
+    LANS,
     LARS,
     NAG,
     Nadam,

@@ -413,6 +413,50 @@ class AdaBelief(Adam):
 
 
 @register
+class Adamax(Adam):
+    """Adamax — Adam with the infinity norm (reference: optimizer/adamax.py)."""
+
+    @staticmethod
+    def _rule(w, g, state, lr, wd, hyper):
+        m, u = state
+        b1, b2, t = hyper["beta1"], hyper["beta2"], hyper["t"]
+        g = g + wd * w
+        m = b1 * m + (1 - b1) * g
+        u = jnp.maximum(b2 * u, jnp.abs(g))
+        return w - (lr / (1 - b1 ** t)) * m / (u + hyper["eps"]), (m, u)
+
+
+@register
+class FTML(Optimizer):
+    """Follow the Moving Leader (reference: optimizer/ftml.py)."""
+
+    def __init__(self, learning_rate=0.0025, beta1=0.6, beta2=0.999,
+                 epsilon=1e-8, **kwargs):
+        super().__init__(learning_rate=learning_rate, **kwargs)
+        self.beta1, self.beta2, self.epsilon = beta1, beta2, epsilon
+
+    def create_state(self, index, weight):
+        # d_prev, v, z
+        return (_zeros_like(weight), _zeros_like(weight),
+                _zeros_like(weight))
+
+    def _hyper(self):
+        return {"beta1": self.beta1, "beta2": self.beta2, "eps": self.epsilon}
+
+    @staticmethod
+    def _rule(w, g, state, lr, wd, hyper):
+        d_prev, v, z = state
+        b1, b2, t = hyper["beta1"], hyper["beta2"], hyper["t"]
+        g = g + wd * w
+        v = b2 * v + (1 - b2) * g * g
+        d = (1 - b1 ** t) / lr * (
+            jnp.sqrt(v / (1 - b2 ** t)) + hyper["eps"])
+        sigma = d - b1 * d_prev
+        z = b1 * z + (1 - b1) * g - sigma * w
+        return -z / d, (d, v, z)
+
+
+@register
 class RMSProp(Optimizer):
     """RMSProp, optionally centered (reference: optimizer/rmsprop.py)."""
 
@@ -564,6 +608,37 @@ class LAMB(Optimizer):
 
 
 @register
+class LANS(LAMB):
+    """LAMB with Nesterov momentum and per-part gradient normalization
+    (reference: optimizer/lans.py)."""
+
+    @staticmethod
+    def _rule(w, g, state, lr, wd, hyper):
+        m, v = state
+        b1, b2, t = hyper["beta1"], hyper["beta2"], hyper["t"]
+        g = g / jnp.maximum(jnp.linalg.norm(g), 1e-12)  # normalized grad
+        m = b1 * m + (1 - b1) * g
+        v = b2 * v + (1 - b2) * g * g
+        mhat = m / (1 - b1 ** t)
+        vhat = v / (1 - b2 ** t)
+        denom = jnp.sqrt(vhat) + hyper["eps"]
+        r1 = mhat / denom + wd * w            # momentum part
+        r2 = g / denom + wd * w               # gradient (Nesterov) part
+        w_norm = jnp.linalg.norm(w)
+
+        def trust(r):
+            rn = jnp.linalg.norm(r)
+            ratio = jnp.where((w_norm > 0) & (rn > 0), w_norm / rn, 1.0)
+            ratio = jnp.maximum(ratio, hyper["lower"])
+            return jnp.where(hyper["upper"] > 0,
+                             jnp.minimum(ratio, jnp.abs(hyper["upper"])),
+                             ratio)
+
+        upd = b1 * trust(r1) * r1 + (1 - b1) * trust(r2) * r2
+        return w - lr * upd, (m, v)
+
+
+@register
 class LARS(Optimizer):
     """Layer-wise adaptive rate scaling (reference: optimizer/lars.py)."""
 
@@ -609,3 +684,6 @@ _REG.register(Signum, "signum")
 _REG.register(SGLD, "sgld")
 _REG.register(DCASGD, "dcasgd")
 _REG.register(AdaBelief, "adabelief")
+_REG.register(Adamax, "adamax")
+_REG.register(FTML, "ftml")
+_REG.register(LANS, "lans")

@@ -10,8 +10,11 @@ Modules:
   mesh        — mesh construction helpers (dp/tp/sp axes, multi-host aware)
   collectives — psum/all_gather/reduce_scatter/ppermute wrappers
   data_parallel — sharded training step builder (grad psum over 'dp')
+  ring_attention — K/V-streaming sequence parallelism (ICI ring)
+  ulysses     — all-to-all head↔sequence parallelism (DeepSpeed-Ulysses)
 """
-from . import collectives, mesh, ring_attention  # noqa: F401
+from . import collectives, mesh, ring_attention, ulysses  # noqa: F401
 from .data_parallel import make_data_parallel_step  # noqa: F401
 from .mesh import make_mesh  # noqa: F401
 from .ring_attention import ring_attention_sharded  # noqa: F401
+from .ulysses import ulysses_attention_sharded  # noqa: F401

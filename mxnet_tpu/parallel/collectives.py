@@ -12,7 +12,7 @@ from functools import partial
 import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
-from jax.experimental.shard_map import shard_map
+from jax import shard_map
 
 __all__ = ["psum_tree", "allreduce_mean", "all_gather", "reduce_scatter",
            "ring_permute"]
@@ -49,7 +49,7 @@ def all_gather(x, mesh, axis="dp", tiled=True):
     """All-gather along a mesh axis (reference analog: broadcast fan-out)."""
 
     @partial(shard_map, mesh=mesh, in_specs=P(axis), out_specs=P(),
-             check_rep=False)
+             check_vma=False)
     def _ag(v):
         return jax.lax.all_gather(v, axis, tiled=tiled)
 
@@ -64,7 +64,7 @@ def reduce_scatter(x, mesh, axis="dp"):
     """
 
     @partial(shard_map, mesh=mesh, in_specs=P(), out_specs=P(axis),
-             check_rep=False)
+             check_vma=False)
     def _rs(v):
         return jax.lax.psum_scatter(v, axis, tiled=True)
 

@@ -57,9 +57,9 @@ def make_data_parallel_step(loss_fn, update_fn, mesh, axis="dp",
 
 def make_shard_map_step(loss_fn, update_fn, mesh, axis="dp"):
     """Explicit-collective variant: per-device bodies + lax.psum on grads."""
-    from jax.experimental.shard_map import shard_map
+    from jax import shard_map
 
-    # check_rep=False: jax's replication checker rewrites grads of
+    # check_vma=False: jax's replication checker rewrites grads of
     # replicated (P()) inputs with an extra psum, inflating them by the
     # axis size; with it off we own the collectives (explicit pmean).
     @partial(
@@ -67,7 +67,7 @@ def make_shard_map_step(loss_fn, update_fn, mesh, axis="dp"):
         mesh=mesh,
         in_specs=(P(), P(), P(axis), P()),
         out_specs=(P(), P(), P()),
-        check_rep=False,
+        check_vma=False,
     )
     def body(params, opt_state, batch, lr):
         loss, grads = jax.value_and_grad(loss_fn)(params, batch)

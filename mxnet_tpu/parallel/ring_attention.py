@@ -95,7 +95,7 @@ def ring_attention_sharded(q, k, v, mesh, axis="sp", causal=False,
     over `axis` and run ring attention as one jitted shard_map program.
     The jitted program is cached per (mesh, axis, causal, scale) so training
     loops hit the compile cache."""
-    from jax.experimental.shard_map import shard_map
+    from jax import shard_map
 
     key = (mesh, axis, causal, scale)
     run = _jit_cache.get(key)
@@ -103,7 +103,7 @@ def ring_attention_sharded(q, k, v, mesh, axis="sp", causal=False,
         spec = P(None, None, axis, None)
 
         @partial(shard_map, mesh=mesh, in_specs=(spec, spec, spec),
-                 out_specs=spec, check_rep=False)
+                 out_specs=spec, check_vma=False)
         def body(ql, kl, vl):
             return ring_attention(ql, kl, vl, axis, causal=causal,
                                   scale=scale)

@@ -1,22 +1,54 @@
-"""Profiler over jax.profiler / XPlane.
+"""Profiler: XLA/XPlane device traces + host-side chrome-trace events.
 
-Reference: src/profiler/ (Chrome-trace JSON dump of engine ops) +
-python/mxnet/profiler.py. The TPU analog is the XLA profiler: traces capture
-device compute, HBM transfers, and collectives, viewable in TensorBoard or
-Perfetto. The op-name scoping mechanism (ProfilerScope, profiler.h:1339) maps
-to jax.named_scope, which annotates HLO and shows up in the trace.
+Reference: src/profiler/ (typed stats in per-device buffers dumped as Chrome
+chrome://tracing JSON + aggregate summaries, python/mxnet/profiler.py).
+
+TPU re-design: two complementary layers —
+  * device time: jax.profiler traces (XPlane) capture XLA compute, HBM
+    transfers, and collectives for TensorBoard/Perfetto, replacing the
+    engine-op timeline (set_state('run'/'stop'));
+  * host time: Task/Event/Frame/Counter and `scope()` record host-side
+    spans into an in-memory buffer that dump() writes as the same Chrome
+    trace-event JSON the reference emitted (profiler.dump → profile.json,
+    viewable at chrome://tracing), and dumps() aggregates like
+    aggregate_stats (count/total/min/max per name).
+`scope()` additionally enters jax.named_scope, so the same name shows up
+attached to HLO ops inside the device trace.
 """
 from __future__ import annotations
 
 import contextlib
+import json
 import os
+import threading
 import time
 
 import jax
 
-_config = {"filename": "profile.json", "profile_all": False}
+_config = {"filename": "profile.json", "profile_all": False,
+           "aggregate_stats": True}
 _running = False
+_paused = False
 _trace_dir = None
+
+_events = []  # chrome trace events: dicts with name/ph/ts/dur/pid/tid
+_events_lock = threading.Lock()
+_t_origin = time.perf_counter()
+
+
+def _now_us():
+    return (time.perf_counter() - _t_origin) * 1e6
+
+
+def _record(name, t0_us, dur_us, cat="host"):
+    if _paused:
+        return
+    with _events_lock:
+        _events.append({
+            "name": name, "cat": cat, "ph": "X", "ts": t0_us,
+            "dur": dur_us, "pid": os.getpid(),
+            "tid": threading.get_ident() % 100000,
+        })
 
 
 def set_config(**kwargs):
@@ -47,24 +79,62 @@ def stop():
 
 
 def dump(finished=True, profile_process="worker"):  # noqa: ARG001
-    """Trace data is written by stop_trace; kept for API parity."""
+    """Write host-side events as Chrome trace JSON to `filename`
+    (reference: MXDumpProfile → chrome://tracing file); stops any live
+    device trace first."""
     if _running:
         stop()
+    with _events_lock:
+        events = list(_events)
+    with open(_config["filename"], "w") as f:
+        json.dump({"traceEvents": events, "displayTimeUnit": "ms"}, f)
+    return _config["filename"]
 
 
-def dumps(reset=False):  # noqa: ARG001
-    return f"trace dir: {_trace_dir}" if _trace_dir else "profiler not run"
+def dumps(reset=False):
+    """Aggregate summary table (reference: aggregate_stats dumps)."""
+    with _events_lock:
+        events = list(_events)
+        if reset:
+            _events.clear()
+    agg = {}
+    for e in events:
+        if e.get("ph") != "X":  # counters carry no duration
+            continue
+        a = agg.setdefault(e["name"], [0, 0.0, float("inf"), 0.0])
+        a[0] += 1
+        a[1] += e["dur"]
+        a[2] = min(a[2], e["dur"])
+        a[3] = max(a[3], e["dur"])
+    lines = [f"{'Name':<32}{'Count':>8}{'Total(ms)':>12}{'Min(ms)':>10}"
+             f"{'Max(ms)':>10}"]
+    for name, (cnt, tot, mn, mx) in sorted(agg.items()):
+        lines.append(f"{name:<32}{cnt:>8}{tot / 1e3:>12.3f}"
+                     f"{mn / 1e3:>10.3f}{mx / 1e3:>10.3f}")
+    if _trace_dir:
+        lines.append(f"device trace dir: {_trace_dir}")
+    return "\n".join(lines)
 
 
 @contextlib.contextmanager
 def scope(name="<unk>"):
-    """Name scope annotating HLO ops (reference: profiler.Scope)."""
-    with jax.named_scope(name):
-        yield
+    """Name scope: annotates HLO (device trace) and records a host span
+    (reference: profiler.Scope / ProfilerScope, profiler.h:1339)."""
+    t0 = _now_us()
+    try:
+        with jax.named_scope(name):
+            yield
+    finally:
+        # record even when the body raises — the failing region is exactly
+        # the one worth seeing in the trace
+        _record(f"scope::{name}", t0, _now_us() - t0)
 
 
 class Task:
-    """Named task timing (reference: profiler.Task) — host-side wall timing."""
+    """Named task timing (reference: profiler.Task) — host wall timing,
+    recorded into the chrome trace on each stop."""
+
+    _kind = "task"
 
     def __init__(self, name, domain=None):  # noqa: ARG002
         self.name = name
@@ -73,35 +143,64 @@ class Task:
 
     def start(self):
         self._t0 = time.perf_counter()
+        self._ts_us = _now_us()
 
     def stop(self):
         if self._t0 is not None:
-            self.elapsed += time.perf_counter() - self._t0
+            dur = time.perf_counter() - self._t0
+            self.elapsed += dur
+            _record(f"{self._kind}::{self.name}", self._ts_us, dur * 1e6)
             self._t0 = None
 
+    def __enter__(self):
+        self.start()
+        return self
 
-Frame = Task
-Event = Task
+    def __exit__(self, *exc):
+        self.stop()
+
+
+class Frame(Task):
+    _kind = "frame"
+
+
+class Event(Task):
+    _kind = "event"
 
 
 class Counter:
+    """Named counter (reference: profiler.Counter); value changes are
+    recorded as chrome counter events."""
+
     def __init__(self, name, domain=None, value=0):  # noqa: ARG002
         self.name = name
         self.value = value
 
+    def _emit(self):
+        if not _paused:
+            with _events_lock:
+                _events.append({"name": f"counter::{self.name}", "ph": "C",
+                                "ts": _now_us(), "pid": os.getpid(),
+                                "args": {"value": self.value}})
+
     def set_value(self, v):
         self.value = v
+        self._emit()
 
     def increment(self, delta=1):
         self.value += delta
+        self._emit()
 
     def decrement(self, delta=1):
         self.value -= delta
+        self._emit()
 
 
 def pause(profile_process="worker"):  # noqa: ARG001
-    pass
+    global _paused
+    _paused = True
 
 
 def resume(profile_process="worker"):  # noqa: ARG001
-    pass
+    global _paused
+    _paused = False

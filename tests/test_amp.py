@@ -71,11 +71,6 @@ def test_loss_scaler_dynamics():
 
 
 def test_loss_scaler_overflow_detection():
-    p = gluon.Parameter("w", shape=(2,))
-    p.initialize()
-    p.data()._grad = mx.np.array([1.0, np.inf])
-    p._grad_map = {d: p.data()._grad for d in p._data_map}
-
     class FakeParam:
         grad_req = "write"
 

@@ -51,7 +51,8 @@ def test_adam_converges_quadratic():
 
 @pytest.mark.parametrize("name", ["sgd", "nag", "adam", "adamw", "nadam",
                                   "rmsprop", "adagrad", "adadelta", "ftrl",
-                                  "lamb", "lars", "signum", "adabelief"])
+                                  "lamb", "lars", "signum", "adabelief",
+                                  "adamax", "ftml", "lans"])
 def test_all_optimizers_decrease_loss(name):
     mx.seed(1)
     net = nn.Dense(1, in_units=4, use_bias=False)

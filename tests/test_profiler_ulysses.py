@@ -1,0 +1,94 @@
+"""Profiler chrome-trace emission + Ulysses sequence parallelism.
+
+Reference coverage model: tests/python/profiling/ + (green-field) SP
+numerics vs full attention oracle.
+"""
+import json
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import profiler
+from mxnet_tpu.parallel import make_mesh, ring_attention_sharded, \
+    ulysses_attention_sharded
+
+
+def test_profiler_task_records_and_dumps(tmp_path):
+    profiler.set_config(filename=str(tmp_path / "profile.json"))
+    t = profiler.Task("myop")
+    t.start()
+    sum(range(1000))
+    t.stop()
+    with profiler.Frame("frame1"):
+        pass
+    c = profiler.Counter("mem")
+    c.set_value(10)
+    c.increment(5)
+    path = profiler.dump()
+    with open(path) as f:
+        trace = json.load(f)
+    names = [e["name"] for e in trace["traceEvents"]]
+    assert "task::myop" in names
+    assert "frame::frame1" in names
+    assert "counter::mem" in names
+    counter_events = [e for e in trace["traceEvents"]
+                      if e["name"] == "counter::mem"]
+    assert counter_events[-1]["args"]["value"] == 15
+    summary = profiler.dumps()
+    assert "task::myop" in summary and "Count" in summary
+
+
+def test_profiler_scope_and_pause():
+    profiler.resume()
+    with profiler.scope("layer1"):
+        pass
+    assert "scope::layer1" in profiler.dumps()
+    before = profiler.dumps(reset=True)  # clear
+    profiler.pause()
+    with profiler.scope("hidden"):
+        pass
+    assert "scope::hidden" not in profiler.dumps()
+    profiler.resume()
+
+
+def _ref_attn(q, k, v, causal=False):
+    d = q.shape[-1]
+    s = jnp.einsum("bhqd,bhkd->bhqk", q, k) * d ** -0.5
+    if causal:
+        i = jnp.arange(q.shape[2])
+        s = jnp.where(i[None, None, :, None] >= i[None, None, None, :],
+                      s, -jnp.inf)
+    return jnp.einsum("bhqk,bhkd->bhqd", jax.nn.softmax(s, -1), v)
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_ulysses_matches_full_attention(causal):
+    mesh = make_mesh({"sp": 8})
+    b, h, S, d = 2, 8, 32, 8
+    key = jax.random.PRNGKey(0)
+    q, k, v = (jax.random.normal(jax.random.fold_in(key, i), (b, h, S, d),
+                                 jnp.float32) for i in range(3))
+    out = ulysses_attention_sharded(q, k, v, mesh, causal=causal)
+    ref = _ref_attn(q, k, v, causal)
+    assert float(jnp.abs(out - ref).max()) < 1e-4
+
+
+def test_ulysses_and_ring_agree():
+    mesh = make_mesh({"sp": 8})
+    b, h, S, d = 1, 8, 64, 16
+    key = jax.random.PRNGKey(1)
+    q, k, v = (jax.random.normal(jax.random.fold_in(key, i), (b, h, S, d),
+                                 jnp.float32) for i in range(3))
+    u = ulysses_attention_sharded(q, k, v, mesh, causal=True)
+    r = ring_attention_sharded(q, k, v, mesh, causal=True)
+    assert float(jnp.abs(u - r).max()) < 1e-4
+
+
+def test_ulysses_head_divisibility_check():
+    mesh = make_mesh({"sp": 8})
+    q = jnp.ones((1, 4, 32, 8))  # 4 heads < 8 devices
+    with pytest.raises(Exception):
+        ulysses_attention_sharded(q, q, q, mesh)
