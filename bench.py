@@ -14,13 +14,14 @@ chip is unreachable so the driver always gets a JSON line.
 from __future__ import annotations
 
 import json
+import math
 import sys
 import time
 
 BASELINE_IMG_S = 363.69  # V100 fp32 b=128 training (perf.md:243-253)
 BATCH = 128
 WARMUP = 3
-ITERS = 10
+ITERS = 30  # enough steps to amortize the tunnel's ~70ms sync round-trip
 
 
 def _probe_accelerator(timeout=90):
@@ -105,13 +106,17 @@ def main():
     key = jax.random.PRNGKey(2)
     for _ in range(warmup):
         params, momenta, loss = step(params, momenta, x, y, key)
-    loss.block_until_ready()
+    # NB: block_until_ready() is a no-op over the axon TPU tunnel — only a
+    # host fetch truly synchronizes. Fetch the scalar loss (4 bytes).
+    float(loss)
 
     t0 = time.perf_counter()
     for _ in range(iters):
         params, momenta, loss = step(params, momenta, x, y, key)
-    loss.block_until_ready()
+    final_loss = float(loss)  # scalar host fetch = true barrier
     dt = time.perf_counter() - t0
+    if not math.isfinite(final_loss):
+        raise SystemExit(f"non-finite loss {final_loss}")
 
     img_s = batch * iters / dt
     print(json.dumps({

@@ -54,9 +54,19 @@ from . import kvstore as kv  # noqa: E402
 from . import kvstore  # noqa: E402
 from . import io  # noqa: E402
 from . import image  # noqa: E402
+from . import attribute  # noqa: E402
+from . import callback  # noqa: E402
 from . import contrib  # noqa: E402
 from . import library  # noqa: E402
+from . import model  # noqa: E402
+from . import monitor  # noqa: E402
+from . import name  # noqa: E402
 from . import onnx  # noqa: E402
+from . import visualization  # noqa: E402
+from .attribute import AttrScope  # noqa: E402
+from .monitor import Monitor  # noqa: E402
+from .name import NameManager  # noqa: E402
+from .visualization import plot_network, print_summary  # noqa: E402
 from . import operator  # noqa: E402
 from .operator import Custom  # noqa: E402
 from . import recordio  # noqa: E402

@@ -1,0 +1,112 @@
+"""Training callbacks (reference: python/mxnet/callback.py).
+
+Used with the estimator/fit loops: epoch-end checkpointing, periodic metric
+logging, throughput reporting. Callbacks receive a BatchEndParam-style
+namedtuple (epoch, nbatch, eval_metric, locals)."""
+from __future__ import annotations
+
+import logging
+import time
+from collections import namedtuple
+
+__all__ = ["BatchEndParam", "do_checkpoint", "log_train_metric",
+           "Speedometer", "ProgressBar", "LogValidationMetricsCallback"]
+
+BatchEndParam = namedtuple("BatchEndParam",
+                           ["epoch", "nbatch", "eval_metric", "locals"])
+
+
+def do_checkpoint(prefix, period=1):
+    """Epoch-end callback saving `net` parameters every `period` epochs
+    (reference: callback.py:26 — saved symbol+params; here Gluon
+    save_parameters)."""
+    period = int(max(1, period))
+
+    def _callback(epoch, net=None, **kwargs):  # noqa: ARG001
+        if (epoch + 1) % period == 0 and net is not None:
+            fname = f"{prefix}-{epoch + 1:04d}.params"
+            net.save_parameters(fname)
+            logging.info("Saved checkpoint to \"%s\"", fname)
+
+    return _callback
+
+
+def log_train_metric(period, auto_reset=False):
+    """Batch-end callback logging the metric every `period` batches
+    (reference: callback.py:64)."""
+
+    def _callback(param):
+        if param.nbatch % period == 0 and param.eval_metric is not None:
+            name_value = param.eval_metric.get_name_value()
+            for name, value in name_value:
+                logging.info("Iter[%d] Batch[%d] Train-%s=%f",
+                             param.epoch, param.nbatch, name, value)
+            if auto_reset:
+                param.eval_metric.reset()
+
+    return _callback
+
+
+class Speedometer:
+    """Logs samples/sec every `frequent` batches (reference:
+    callback.py:91)."""
+
+    def __init__(self, batch_size, frequent=50, auto_reset=True):
+        self.batch_size = batch_size
+        self.frequent = frequent
+        self.auto_reset = auto_reset
+        self.init = False
+        self.tic = 0
+        self.last_count = 0
+
+    def __call__(self, param):
+        count = param.nbatch
+        if self.last_count > count:
+            self.init = False
+        self.last_count = count
+        if self.init:
+            if count % self.frequent == 0:
+                speed = self.frequent * self.batch_size / (
+                    time.time() - self.tic)
+                if param.eval_metric is not None:
+                    name_value = param.eval_metric.get_name_value()
+                    if self.auto_reset:
+                        param.eval_metric.reset()
+                    msg = " ".join(f"{n}={v:.6f}" for n, v in name_value)
+                    logging.info("Epoch[%d] Batch [%d] Speed: %.2f "
+                                 "samples/sec %s", param.epoch, count,
+                                 speed, msg)
+                else:
+                    logging.info("Iter[%d] Batch [%d] Speed: %.2f "
+                                 "samples/sec", param.epoch, count, speed)
+                self.tic = time.time()
+        else:
+            self.init = True
+            self.tic = time.time()
+
+
+class ProgressBar:
+    """Text progress bar per epoch (reference: callback.py:155)."""
+
+    def __init__(self, total, length=80):
+        self.bar_len = length
+        self.total = total
+
+    def __call__(self, param):
+        count = param.nbatch
+        filled_len = int(round(self.bar_len * count / float(self.total)))
+        percents = int(round(100.0 * count / float(self.total)))
+        prog_bar = "=" * filled_len + "-" * (self.bar_len - filled_len)
+        logging.info("[%s] %s%s", prog_bar, percents, "%")
+
+
+class LogValidationMetricsCallback:
+    """Epoch-end callback logging validation metrics (reference:
+    callback.py:185)."""
+
+    def __call__(self, param):
+        if param.eval_metric is None:
+            return
+        for name, value in param.eval_metric.get_name_value():
+            logging.info("Epoch[%d] Validation-%s=%f", param.epoch, name,
+                         value)

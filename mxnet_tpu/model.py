@@ -1,0 +1,40 @@
+"""Legacy model checkpoint helpers (reference: python/mxnet/model.py:189
+save_checkpoint / :238 load_checkpoint over symbol json + params files)."""
+from __future__ import annotations
+
+from .ndarray.utils import load as _nd_load
+from .ndarray.utils import save as _nd_save
+
+__all__ = ["save_checkpoint", "load_checkpoint"]
+
+
+def save_checkpoint(prefix, epoch, symbol, arg_params, aux_params=None,
+                    remove_amp_cast=True):  # noqa: ARG001
+    """Write prefix-symbol.json + prefix-{epoch:04d}.params
+    (reference: model.py:189). arg/aux params are name→NDArray dicts,
+    stored with the reference's arg:/aux: key prefixes."""
+    if symbol is not None:
+        symbol.save(f"{prefix}-symbol.json")
+    payload = {f"arg:{k}": v for k, v in (arg_params or {}).items()}
+    payload.update({f"aux:{k}": v for k, v in (aux_params or {}).items()})
+    _nd_save(f"{prefix}-{epoch:04d}.params", payload)
+
+
+def load_checkpoint(prefix, epoch):
+    """Return (symbol, arg_params, aux_params) (reference: model.py:238)."""
+    import os
+
+    from .symbol.symbol import load as _sym_load
+
+    sym_file = f"{prefix}-symbol.json"
+    symbol = _sym_load(sym_file) if os.path.exists(sym_file) else None
+    data = _nd_load(f"{prefix}-{epoch:04d}.params")
+    arg_params, aux_params = {}, {}
+    for k, v in data.items():
+        if k.startswith("arg:"):
+            arg_params[k[4:]] = v
+        elif k.startswith("aux:"):
+            aux_params[k[4:]] = v
+        else:
+            arg_params[k] = v
+    return symbol, arg_params, aux_params

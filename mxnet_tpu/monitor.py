@@ -1,0 +1,93 @@
+"""Monitor — per-op output inspection during training
+(reference: python/mxnet/monitor.py: installs output callbacks on the
+executor and prints stat summaries per batch).
+
+TPU re-design: rides Gluon's register_op_hook (the CachedOp::RegisterOpHook
+analog): Monitor.install(net) attaches a forward hook to every child block
+recording `stat_func` of each output; tic()/toc() bracket a batch and
+return the collected (name, stat) rows like the reference's toc_print.
+"""
+from __future__ import annotations
+
+import logging
+import re
+
+import jax.numpy as jnp
+
+__all__ = ["Monitor"]
+
+
+class Monitor:
+    def __init__(self, interval=1, stat_func=None, pattern=".*", sort=False):
+        self.interval = int(interval)
+        self.stat_func = stat_func or (
+            lambda x: jnp.abs(x).mean())  # reference default: mean(|x|)
+        self.re_pattern = re.compile(pattern)
+        self.sort = sort
+        self.queue = []
+        self.step = 0
+        self.activated = False
+        self._handles = []
+
+    # -- installation ------------------------------------------------------
+    def install(self, net, monitor_all=False):  # noqa: ARG002
+        """Attach to every block in `net` (reference: install_executor)."""
+
+        def hook(block, inputs, outputs):  # noqa: ARG001
+            if not self.activated:
+                return
+            name = type(block).__name__
+            if not self.re_pattern.match(name):
+                return
+            outs = outputs if isinstance(outputs, (list, tuple)) else \
+                [outputs]
+            for i, o in enumerate(outs):
+                data = getattr(o, "_data", o)
+                try:
+                    self.queue.append(
+                        (self.step, f"{name}_output{i}",
+                         self.stat_func(jnp.asarray(data))))
+                except TypeError:
+                    pass
+
+        if self._handles:
+            self.uninstall()  # re-install must not double-count
+
+        def walk(block):
+            block.register_forward_hook(hook)
+            self._handles.append((block, hook))
+            for child in block._children.values():
+                walk(child)
+
+        walk(net)
+        return self
+
+    def uninstall(self):
+        """Remove every hook this monitor installed."""
+        for block, hook in self._handles:
+            hooks = getattr(block, "_fwd_hooks", [])
+            if hook in hooks:
+                hooks.remove(hook)
+        self._handles = []
+
+    # -- batch bracketing --------------------------------------------------
+    def tic(self):
+        if self.step % self.interval == 0:
+            self.activated = True
+            self.queue = []
+
+    def toc(self):
+        if not self.activated:
+            self.step += 1
+            return []
+        self.activated = False
+        res = [(s, name, float(val)) for s, name, val in self.queue]
+        if self.sort:
+            res.sort(key=lambda r: r[1])
+        self.queue = []
+        self.step += 1
+        return res
+
+    def toc_print(self):
+        for step, name, value in self.toc():
+            logging.info("Batch: %7d %30s %s", step, name, value)

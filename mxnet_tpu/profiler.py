@@ -40,8 +40,14 @@ def _now_us():
     return (time.perf_counter() - _t_origin) * 1e6
 
 
+def _host_recording():
+    """Host events record only while the profiler runs (reference: nothing
+    is recorded before set_state('run')) or with profile_all set."""
+    return (_running or _config.get("profile_all")) and not _paused
+
+
 def _record(name, t0_us, dur_us, cat="host"):
-    if _paused:
+    if not _host_recording():
         return
     with _events_lock:
         _events.append({
@@ -86,6 +92,7 @@ def dump(finished=True, profile_process="worker"):  # noqa: ARG001
         stop()
     with _events_lock:
         events = list(_events)
+        _events.clear()  # dumped events are consumed (bounded memory)
     with open(_config["filename"], "w") as f:
         json.dump({"traceEvents": events, "displayTimeUnit": "ms"}, f)
     return _config["filename"]
@@ -177,7 +184,7 @@ class Counter:
         self.value = value
 
     def _emit(self):
-        if not _paused:
+        if _host_recording():
             with _events_lock:
                 _events.append({"name": f"counter::{self.name}", "ph": "C",
                                 "ts": _now_us(), "pid": os.getpid(),

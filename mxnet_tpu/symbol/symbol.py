@@ -49,8 +49,14 @@ class Symbol:
         if op not in _OP_TABLE:
             raise ValueError(f"unknown symbol op {op!r}")
         inputs = [s if isinstance(s, Symbol) else _const(s) for s in inputs]
-        return Symbol(op, name or Symbol._auto_name(op), inputs, attrs,
-                      nout=nout)
+        # honor the ambient NameManager/Prefix and AttrScope
+        # (reference: symbol creation consults both scopes)
+        from .. import attribute as _attr_mod
+        from .. import name as _name_mod
+
+        final_name = _name_mod.current().get(name, op.lower())
+        merged = _attr_mod.current().get(attrs)
+        return Symbol(op, final_name, inputs, merged, nout=nout)
 
     # -- python operators --------------------------------------------------
     def __add__(self, o):

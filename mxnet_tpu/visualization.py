@@ -1,0 +1,119 @@
+"""Network visualization (reference: python/mxnet/visualization.py —
+print_summary table and graphviz plot_network over symbol graphs)."""
+from __future__ import annotations
+
+import numpy as _np
+
+__all__ = ["print_summary", "plot_network"]
+
+
+def print_summary(symbol, shape=None, line_length=120,
+                  positions=(.44, .64, .74, 1.)):
+    """Per-node summary table of a symbol graph (reference:
+    visualization.py:46). `shape` maps input names to shapes so output
+    shapes can be inferred."""
+    out_shapes = {}
+    if shape is not None:
+        order = [s for s in symbol._topo() if s._op != "_group"]
+        from .symbol.symbol import _OP_TABLE
+
+        import jax
+
+        structs = {}
+        for s in order:
+            if s._op is None:
+                if s._name not in shape:
+                    raise ValueError(f"shape for input {s._name} required")
+                structs[id(s)] = jax.ShapeDtypeStruct(
+                    tuple(shape[s._name]), _np.float32)
+            elif s._op == "_const":
+                v = _np.asarray(s._attrs["value"])
+                structs[id(s)] = jax.ShapeDtypeStruct(v.shape, v.dtype)
+            else:
+                ins = [structs[id(i)] for i in s._inputs]
+                structs[id(s)] = jax.eval_shape(
+                    lambda *xs, _f=_OP_TABLE[s._op], _a=s._attrs:
+                    _f(list(xs), _a), *ins)
+            st = structs[id(s)]
+            out_shapes[s._name] = getattr(st, "shape", None) if not \
+                isinstance(st, (tuple, list)) else [x.shape for x in st]
+
+    positions = [int(line_length * p) for p in positions]
+    fields = ["Layer (type)", "Output Shape", "Param #", "Previous Layer"]
+    lines = ["_" * line_length]
+    row = ""
+    for f, p in zip(fields, positions):
+        row += f
+        row = row[:p].ljust(p)
+    lines.append(row)
+    lines.append("=" * line_length)
+    total_params = 0
+    for s in symbol._topo():
+        if s._op in ("_group",):
+            continue
+        prev = ",".join(i._name for i in s._inputs[:2])
+        oshape = out_shapes.get(s._name, "")
+        # param count: size of the op's variable inputs that look like
+        # learnable params (reference heuristic: weight/bias/gamma/beta)
+        nparams = 0
+        if s._op is not None:
+            for i in s._inputs:
+                if i._op is None and any(
+                        k in i._name for k in ("weight", "bias", "gamma",
+                                               "beta", "_w")) \
+                        and i._name in out_shapes:
+                    shp = out_shapes[i._name]
+                    if shp:
+                        nparams += int(_np.prod(shp))
+        row = ""
+        vals = [f"{s._name} ({s._op or 'Variable'})", str(oshape),
+                str(nparams), prev]
+        for v, p in zip(vals, positions):
+            row += v
+            row = row[:p].ljust(p)
+        lines.append(row)
+        total_params += nparams
+        lines.append("_" * line_length)
+    lines.append(f"Total params: {total_params}")
+    lines.append(f"Total nodes: {len(symbol._topo())}")
+    out = "\n".join(lines)
+    print(out)
+    return out
+
+
+def plot_network(symbol, title="plot", save_format="pdf", shape=None,
+                 dtype=None, node_attrs=None, hide_weights=True):  # noqa: ARG001
+    """Graphviz dot source for the symbol DAG (reference:
+    visualization.py:210). Returns the dot source string; rendering needs
+    graphviz, which is optional."""
+    lines = [f'digraph "{title}" {{', "  rankdir=BT;"]
+    order = [s for s in symbol._topo() if s._op != "_group"]
+    idx = {id(s): i for i, s in enumerate(order)}
+
+    def _hidden(s):
+        return s._op is None and hide_weights and any(
+            k in s._name for k in ("weight", "bias", "gamma", "beta",
+                                   "mean", "var"))
+
+    emitted = {i for i, s in enumerate(order) if not _hidden(s)}
+    for i, s in enumerate(order):
+        if i not in emitted:
+            continue
+        label = s._name if s._op is None else f"{s._name}\\n{s._op}"
+        shape_attr = "ellipse" if s._op is None else "box"
+        lines.append(f'  n{i} [label="{label}" shape={shape_attr}];')
+    for i, s in enumerate(order):
+        if i not in emitted:
+            continue
+        for inp in s._inputs:
+            j = idx[id(inp)]
+            if j in emitted:
+                lines.append(f"  n{j} -> n{i};")
+    lines.append("}")
+    src = "\n".join(lines)
+    try:
+        import graphviz
+
+        return graphviz.Source(src)
+    except ImportError:
+        return src

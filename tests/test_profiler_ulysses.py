@@ -17,7 +17,8 @@ from mxnet_tpu.parallel import make_mesh, ring_attention_sharded, \
 
 
 def test_profiler_task_records_and_dumps(tmp_path):
-    profiler.set_config(filename=str(tmp_path / "profile.json"))
+    profiler.set_config(filename=str(tmp_path / "profile.json"),
+                        profile_all=True)
     t = profiler.Task("myop")
     t.start()
     sum(range(1000))
@@ -27,7 +28,9 @@ def test_profiler_task_records_and_dumps(tmp_path):
     c = profiler.Counter("mem")
     c.set_value(10)
     c.increment(5)
-    path = profiler.dump()
+    summary = profiler.dumps()
+    assert "task::myop" in summary and "Count" in summary
+    path = profiler.dump()  # consumes the events
     with open(path) as f:
         trace = json.load(f)
     names = [e["name"] for e in trace["traceEvents"]]
@@ -37,21 +40,33 @@ def test_profiler_task_records_and_dumps(tmp_path):
     counter_events = [e for e in trace["traceEvents"]
                       if e["name"] == "counter::mem"]
     assert counter_events[-1]["args"]["value"] == 15
-    summary = profiler.dumps()
-    assert "task::myop" in summary and "Count" in summary
+    assert "task::myop" not in profiler.dumps()  # drained by dump()
 
 
 def test_profiler_scope_and_pause():
+    profiler.set_config(profile_all=True)
     profiler.resume()
     with profiler.scope("layer1"):
         pass
     assert "scope::layer1" in profiler.dumps()
-    before = profiler.dumps(reset=True)  # clear
+    profiler.dumps(reset=True)  # clear
     profiler.pause()
     with profiler.scope("hidden"):
         pass
     assert "scope::hidden" not in profiler.dumps()
     profiler.resume()
+
+
+def test_profiler_off_by_default():
+    profiler.set_config(profile_all=False)
+    profiler.dumps(reset=True)
+    with profiler.scope("silent"):
+        pass
+    t = profiler.Task("silent_task")
+    t.start()
+    t.stop()
+    assert "silent" not in profiler.dumps()
+    profiler.set_config(profile_all=True)  # restore for other tests
 
 
 def _ref_attn(q, k, v, causal=False):
