@@ -1,0 +1,119 @@
+"""Ablate the ResNet-50 train step to locate the time sinks (real chip).
+
+Rows: fwd-only inference, fwd-only train-mode, full step at b=128/256,
+full step with frozen BN stats (use_global_stats).
+"""
+import time
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+import mxnet_tpu as mx
+from mxnet_tpu import amp
+from mxnet_tpu.gluon.model_zoo.vision import resnet50_v1
+
+INNER = 10
+
+
+def timeit(fn, *args):
+    out = fn(*args)
+    jax.tree.map(lambda a: a.block_until_ready(), out)
+    _ = float(jnp.sum(jax.tree.leaves(out)[0].astype(jnp.float32)))
+    t0 = time.perf_counter()
+    out = fn(*args)
+    _ = float(jnp.sum(jax.tree.leaves(out)[0].astype(jnp.float32)))
+    return (time.perf_counter() - t0) / INNER
+
+
+def main():
+    print("platform:", jax.devices()[0].platform)
+    mx.seed(0)
+    net = resnet50_v1(classes=1000)
+    net.initialize()
+    amp.convert_hybrid_block(net, target_dtype="bfloat16")
+    net(mx.np.ones((2, 3, 224, 224), dtype="bfloat16"))
+    fwd_train, params = net.as_pure_function(training=True)
+    fwd_eval, _ = net.as_pure_function(training=False)
+    trainable = set(net.trainable_param_names())
+    key = jax.random.PRNGKey(2)
+
+    for batch in (128, 256):
+        x = jax.random.normal(jax.random.PRNGKey(0), (batch, 3, 224, 224),
+                              jnp.bfloat16)
+        y = jax.random.randint(jax.random.PRNGKey(1), (batch,), 0, 1000)
+
+        @jax.jit
+        def infer(p, x):
+            def body(i, acc):
+                out, _ = fwd_eval(p, key, x)
+                return acc + jnp.sum(out.astype(jnp.float32))
+            return lax.fori_loop(0, INNER, body, jnp.float32(0))
+
+        dt = timeit(infer, params, x)
+        print(f"b={batch} fwd eval : {dt*1e3:6.1f} ms  {batch/dt:7.0f} img/s")
+
+        @jax.jit
+        def fwd_t(p, x):
+            def body(i, acc):
+                out, _ = fwd_train(p, jax.random.fold_in(key, i), x)
+                return acc + jnp.sum(out.astype(jnp.float32))
+            return lax.fori_loop(0, INNER, body, jnp.float32(0))
+
+        dt = timeit(fwd_t, params, x)
+        print(f"b={batch} fwd train: {dt*1e3:6.1f} ms  {batch/dt:7.0f} img/s")
+
+        def make_step(fwd):
+            def train_step(p, mom, x, y, k):
+                def loss_fn(pd):
+                    out, new_pd = fwd(pd, k, x)
+                    logp = jax.nn.log_softmax(out.astype(jnp.float32), -1)
+                    return -jnp.take_along_axis(
+                        logp, y[:, None], axis=-1).mean(), new_pd
+                (loss, new_pd), grads = jax.value_and_grad(
+                    loss_fn, has_aux=True)(p)
+                newp, newm = {}, {}
+                for n, v in p.items():
+                    if n in mom:
+                        g = grads[n].astype(jnp.float32)
+                        m2 = 0.9 * mom[n].astype(jnp.float32) - 0.1 * g
+                        newm[n] = m2.astype(mom[n].dtype)
+                        newp[n] = (v.astype(jnp.float32) + m2).astype(v.dtype)
+                    else:
+                        newp[n] = new_pd[n]
+                return newp, newm, loss
+            return train_step
+
+        momenta = {n: jnp.zeros_like(a) for n, a in params.items()
+                   if n in trainable}
+        step = make_step(fwd_train)
+
+        @jax.jit
+        def many(p, mom, x, y):
+            def body(i, pml):
+                p, mom, _ = pml
+                return step(p, mom, x, y, jax.random.fold_in(key, i))
+            return lax.fori_loop(0, INNER, body,
+                                 (p, mom, jnp.float32(0)))
+
+        dt = timeit(many, params, momenta, x, y)
+        print(f"b={batch} full step: {dt*1e3:6.1f} ms  {batch/dt:7.0f} img/s")
+
+        # frozen BN stats: eval-mode BN inside a grad step (isolates the
+        # batch-stat reductions)
+        stepf = make_step(fwd_eval)
+
+        @jax.jit
+        def manyf(p, mom, x, y):
+            def body(i, pml):
+                p, mom, _ = pml
+                return stepf(p, mom, x, y, jax.random.fold_in(key, i))
+            return lax.fori_loop(0, INNER, body,
+                                 (p, mom, jnp.float32(0)))
+
+        dt = timeit(manyf, params, momenta, x, y)
+        print(f"b={batch} frozenBN : {dt*1e3:6.1f} ms  {batch/dt:7.0f} img/s")
+
+
+if __name__ == "__main__":
+    main()

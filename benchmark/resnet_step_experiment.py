@@ -1,0 +1,101 @@
+"""Find the ResNet-50 train-step time sinks on the real chip.
+
+Variants measured (bf16, b=128, same model as bench.py):
+  A. per-call jit step (bench.py as-is today)
+  B. K steps chained inside one jit via lax.fori_loop (kills dispatch overhead)
+  C. B + fresh dropout/BN key folded per inner step (realism check)
+"""
+import time
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+import mxnet_tpu as mx
+from mxnet_tpu import amp
+from mxnet_tpu.gluon.model_zoo.vision import resnet50_v1
+
+BATCH = 128
+INNER = 10
+OUTER = 4
+
+
+def main():
+    print("platform:", jax.devices()[0].platform)
+    mx.seed(0)
+    net = resnet50_v1(classes=1000)
+    net.initialize()
+    amp.convert_hybrid_block(net, target_dtype="bfloat16")
+    net(mx.np.ones((2, 3, 224, 224), dtype="bfloat16"))
+    fwd, params = net.as_pure_function(training=True)
+    trainable = set(net.trainable_param_names())
+
+    rng = jax.random.PRNGKey(0)
+    x = jax.random.normal(rng, (BATCH, 3, 224, 224), jnp.bfloat16)
+    y = jax.random.randint(jax.random.PRNGKey(1), (BATCH,), 0, 1000)
+    momenta = {n: jnp.zeros_like(a) for n, a in params.items()
+               if n in trainable}
+
+    def train_step(params, momenta, x, y, key):
+        def loss_fn(pd):
+            out, new_pd = fwd(pd, key, x)
+            logp = jax.nn.log_softmax(out.astype(jnp.float32), axis=-1)
+            nll = -jnp.take_along_axis(logp, y[:, None], axis=-1).mean()
+            return nll, new_pd
+
+        (loss, new_pd), grads = jax.value_and_grad(
+            loss_fn, has_aux=True)(params)
+        new_params = {}
+        new_mom = {}
+        for n, p in params.items():
+            if n in momenta:
+                g = grads[n].astype(jnp.float32)
+                m = 0.9 * momenta[n].astype(jnp.float32) - 0.1 * g
+                new_mom[n] = m.astype(momenta[n].dtype)
+                new_params[n] = (p.astype(jnp.float32) + m).astype(p.dtype)
+            else:
+                new_params[n] = new_pd[n]
+        return new_params, new_mom, loss
+
+    key = jax.random.PRNGKey(2)
+
+    def fresh():
+        return ({n: jnp.copy(a) for n, a in params.items()},
+                {n: jnp.copy(a) for n, a in momenta.items()})
+
+    # A: per-call jit
+    stepA = jax.jit(train_step, donate_argnums=(0, 1))
+    p, m = fresh()
+    for _ in range(3):
+        p, m, loss = stepA(p, m, x, y, key)
+    float(loss)
+    t0 = time.perf_counter()
+    n = 30
+    for _ in range(n):
+        p, m, loss = stepA(p, m, x, y, key)
+    float(loss)
+    dtA = (time.perf_counter() - t0) / n
+    print(f"A per-call: {dtA*1e3:.1f} ms/step = {BATCH/dtA:.0f} img/s")
+
+    # B: K steps in one jit
+    @jax.jit
+    def stepB(params, momenta, x, y, key):
+        def body(i, pm):
+            p, m, _ = pm
+            return train_step(p, m, x, y, jax.random.fold_in(key, i))
+        return lax.fori_loop(0, INNER, body,
+                             (params, momenta, jnp.float32(0)))
+
+    p, m = fresh()
+    p, m, loss = stepB(p, m, x, y, key)
+    float(loss)
+    t0 = time.perf_counter()
+    for _ in range(OUTER):
+        p, m, loss = stepB(p, m, x, y, key)
+    float(loss)
+    dtB = (time.perf_counter() - t0) / (OUTER * INNER)
+    print(f"B fori_loop({INNER}): {dtB*1e3:.1f} ms/step = {BATCH/dtB:.0f} img/s")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,9 +1,14 @@
 """mx.nd — the legacy imperative NDArray namespace.
 
-Reference: python/mxnet/ndarray/ (24k LoC of generated wrappers). In this
-framework `mx.np` is the primary frontend; `mx.nd` re-exports the same NDArray
-plus the common creation/math functions under their legacy names so
-reference-era scripts keep working.
+Reference: python/mxnet/ndarray/ (24k LoC of *generated* wrappers over the
+NNVM registry — python/mxnet/ndarray/register.py). Same design here: the
+namespace is populated at import time from the pure-op registry
+(mxnet_tpu/ops/), so every registered op — elemwise/broadcast families,
+reductions, ordering, indexing, matrix ops, the `linalg_*` la_op family, the
+legacy vision ops (BilinearSampler, SpatialTransformer, ROIPooling,
+Correlation, DeformableConvolution, GridGenerator), CamelCase v1 NN ops and
+the loss-output ops — resolves as `mx.nd.<name>` with reference call
+signatures, eager async execution, and autograd taping.
 """
 from ..numpy import (  # noqa: F401
     arange,
@@ -16,8 +21,11 @@ from ..numpy import (  # noqa: F401
     zeros,
     zeros_like,
 )
+from . import linalg  # noqa: F401
+from . import random  # noqa: F401
 from . import sparse  # noqa: F401
 from .ndarray import NDArray, apply_op, from_jax, waitall  # noqa: F401
+from .register import make_eager, populate
 from .utils import load, save, savez  # noqa: F401
 
 
@@ -27,32 +35,109 @@ def Custom(*inputs, op_type=None, **kwargs):  # noqa: N802
 
     return _custom(*inputs, op_type=op_type, **kwargs)
 
-concat = concatenate
 
-# legacy op names commonly used in reference scripts
+# numpy-frontend functions shared into the legacy namespace (NB: `concat` is
+# NOT aliased to numpy concatenate — the registry installs the legacy
+# concat(*data, dim=1) signature below)
 from ..numpy import (  # noqa: F401,E402
-    abs,  # noqa: A004
-    add,
-    argmax,
-    argmin,
-    broadcast_to,
-    clip,
-    dot,
-    exp,
-    log,
     maximum,
-    mean,
     minimum,
-    multiply,
     power,
-    sqrt,
-    square,
-    stack,
-    subtract,
-    sum,  # noqa: A004
-    tanh,
-    transpose,
-    where,
 )
-from ..numpy.random import normal as random_normal  # noqa: E402
-from ..numpy.random import uniform as random_uniform  # noqa: E402
+
+
+# ---------------------------------------------------------------------------
+# stateful ops that need RNG keys or mutation — hand-written, win over the
+# generated wrappers below
+# ---------------------------------------------------------------------------
+def Dropout(data, p=0.5, mode="training", axes=None, **kwargs):  # noqa: ARG001, N802
+    from ..numpy_extension import dropout as _npx_dropout
+
+    return _npx_dropout(data, p=p, axes=axes, mode=mode)
+
+
+def BatchNorm(data, gamma, beta, moving_mean, moving_var, eps=1e-3,  # noqa: N802
+              momentum=0.9, fix_gamma=True, use_global_stats=False,
+              output_mean_var=False, axis=1, **kwargs):  # noqa: ARG001
+    """Stateful nd.BatchNorm: updates the moving aux arrays in place when
+    training, like the reference's mutable aux inputs (nn/batch_norm.cc)."""
+    from ..numpy_extension import batch_norm as _bn
+
+    return _bn(data, gamma, beta, moving_mean, moving_var, eps=eps,
+               momentum=momentum, fix_gamma=fix_gamma,
+               use_global_stats=use_global_stats,
+               output_mean_var=output_mean_var, axis=axis)
+
+
+def shuffle(data, **kwargs):  # noqa: ARG001
+    from ..numpy.random import permutation
+
+    return permutation(data)
+
+
+# legacy top-level random_* names (reference: nd.random_uniform etc.) —
+# aliases of the nd.random adapters; only exponential differs (the legacy op
+# is parameterized by lam = 1/scale, sample_op.cc)
+random_uniform = random.uniform
+random_normal = random.normal
+random_gamma = random.gamma
+random_poisson = random.poisson
+random_negative_binomial = random.negative_binomial
+random_generalized_negative_binomial = random.generalized_negative_binomial
+random_randint = random.randint
+
+
+def random_exponential(lam=1.0, shape=None, dtype=None, ctx=None, out=None,
+                       **kwargs):  # noqa: ARG001
+    return random.exponential(1.0 / lam, shape=shape, dtype=dtype, out=out)
+
+
+# sample_* variants (per-element distribution params, reference
+# multisample_op.cc): params are arrays; shape extends on the right
+def _sample(fn):
+    def wrapped(*params, shape=None, dtype=None, **kwargs):  # noqa: ARG001
+        base = tuple(params[0].shape) if hasattr(params[0], "shape") else ()
+        extra = () if shape is None else (
+            (shape,) if isinstance(shape, int) else tuple(shape))
+        if extra:  # params broadcast against the appended sample dims
+            params = [p.reshape(base + (1,) * len(extra))
+                      if hasattr(p, "reshape") else p for p in params]
+        return fn(*params, size=base + extra, dtype=dtype)
+    return wrapped
+
+
+from ..numpy import random as _npr  # noqa: E402
+
+sample_uniform = _sample(_npr.uniform)
+sample_normal = _sample(lambda mu, sigma, size=None, dtype=None:
+                        _npr.normal(mu, sigma, size=size, dtype=dtype))
+sample_gamma = _sample(lambda alpha, beta, size=None, dtype=None:
+                       _npr.gamma(alpha, beta, size=size, dtype=dtype))
+sample_exponential = _sample(lambda lam, size=None, dtype=None:
+                             _npr.exponential(1.0 / lam, size=size,
+                                              dtype=dtype))
+sample_poisson = _sample(lambda lam, size=None, dtype=None:
+                         _npr.poisson(lam, size=size, dtype=dtype))
+sample_multinomial = random.multinomial  # legacy categorical sampler
+
+
+def dropout(data, p=0.5, mode="training", axes=None, **kwargs):  # noqa: ARG001
+    """Stateful lowercase alias — the registry's pure `dropout` needs an
+    explicit key; this injects one like the reference's eager op."""
+    return Dropout(data, p=p, mode=mode, axes=axes)
+
+# ---------------------------------------------------------------------------
+# generated corpus: every registry op as an eager wrapper (legacy semantics —
+# e.g. reductions take `exclude`, argmax returns float indices, reshape
+# understands the 0/-1/-2/-3/-4 codes)
+# ---------------------------------------------------------------------------
+populate(globals())
+
+# numpy names the legacy frontend also exposed that the registry doesn't cover
+from ..numpy import (  # noqa: F401,E402
+    add,
+    multiply,
+    subtract,
+)
+
+ElementWiseSum = globals()["add_n"]  # noqa: N816

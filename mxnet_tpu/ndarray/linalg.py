@@ -1,0 +1,9 @@
+"""mx.nd.linalg — the la_op family under its submodule names
+(reference: python/mxnet/ndarray/linalg.py — potrf/gemm/trsm/... without the
+`linalg_` prefix)."""
+from __future__ import annotations
+
+from .register import populate
+
+populate(globals(), predicate=lambda n: n.startswith("linalg_"),
+         rename=lambda n: n[len("linalg_"):])

@@ -1,0 +1,101 @@
+"""mx.nd.random — legacy random namespace (reference:
+python/mxnet/ndarray/random.py). Thin adapters over mx.np.random (threefry
+key plumbing lives there); `shape` kwarg maps to numpy's `size`."""
+from __future__ import annotations
+
+import jax as _jax
+import jax.numpy as _jnp
+
+from .. import _random as _rng
+from ..ndarray.ndarray import NDArray, apply_op
+from ..numpy import random as _npr
+
+
+def _legacy(fn, **renames):
+    def wrapped(*args, shape=None, ctx=None, dtype=None, out=None, **kwargs):  # noqa: ARG001
+        for old, new in renames.items():
+            if old in kwargs:
+                kwargs[new] = kwargs.pop(old)
+        res = fn(*args, size=shape, dtype=dtype, **kwargs)
+        if out is not None:
+            out._assign_from(res)
+            return out
+        return res
+    wrapped.__name__ = fn.__name__
+    return wrapped
+
+
+uniform = _legacy(_npr.uniform)
+normal = _legacy(_npr.normal, mu="loc", sigma="scale")
+randn = _npr.randn
+gamma = _legacy(_npr.gamma, alpha="shape", beta="scale")
+# reference nd.random.exponential's parameter IS the scale (mean), matching
+# numpy — no renaming/inversion (the legacy op nd.random_exponential takes
+# lam = 1/scale; that inversion happens at its wrapper)
+exponential = _legacy(_npr.exponential)
+poisson = _legacy(_npr.poisson)
+negative_binomial = _legacy(_npr.negative_binomial, k="n")
+
+
+def generalized_negative_binomial(mu=1.0, alpha=1.0, shape=None, dtype=None,
+                                  ctx=None, out=None):  # noqa: ARG001
+    """NB with mean mu and dispersion alpha (reference: sample_op.cc
+    _random_generalized_negative_binomial): r = 1/alpha, p = 1/(1+mu*alpha)."""
+    r = 1.0 / alpha
+    p = 1.0 / (1.0 + mu * alpha)
+    res = _npr.negative_binomial(n=r, p=p, size=shape, dtype=dtype)
+    if out is not None:
+        out._assign_from(res)
+        return out
+    return res
+
+
+randint = _legacy(_npr.randint)
+
+
+def multinomial(data, shape=(), get_prob=False, dtype="int32"):
+    """Legacy categorical sampler (reference: nd.random.multinomial /
+    sample_multinomial op): `data` holds probabilities over the last axis;
+    returns sampled indices with `shape` appended to the batch dims."""
+    extra = (shape,) if isinstance(shape, int) else tuple(shape)
+    key = _rng.next_key()
+
+    def pure(p):
+        logits = _jnp.log(_jnp.maximum(p, 1e-38))
+        batch = p.shape[:-1]
+        n = 1
+        for d in batch:
+            n *= d
+        m = 1
+        for d in extra:
+            m *= d
+        flat = logits.reshape((n, p.shape[-1]))
+        draws = _jax.random.categorical(key, flat[:, None, :], shape=(n, m))
+        return draws.reshape(batch + extra).astype(dtype)
+
+    samples = apply_op(pure, data, name="multinomial") \
+        if isinstance(data, NDArray) else NDArray(pure(_jnp.asarray(data)))
+    if get_prob:
+        def prob_pure(p, s):
+            logits = _jnp.log(_jnp.maximum(p, 1e-38))
+            if extra:
+                logits = logits.reshape(
+                    p.shape[:-1] + (1,) * len(extra) + (p.shape[-1],))
+                logits = _jnp.broadcast_to(logits, s.shape + (p.shape[-1],))
+            picked = _jnp.take_along_axis(
+                logits, s[..., None].astype(_jnp.int32), axis=-1)
+            return picked[..., 0]
+
+        logp = apply_op(prob_pure, data, samples, name="multinomial_prob")
+        return samples, logp
+    return samples
+
+
+def shuffle(data, **kwargs):  # noqa: ARG001
+    """Legacy nd.random.shuffle RETURNS the shuffled array (first-axis
+    permutation), unlike numpy's in-place version."""
+    return _npr.permutation(data)
+
+
+def seed(seed_state, ctx="all"):
+    _npr.seed(seed_state)

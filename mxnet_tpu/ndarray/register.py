@@ -1,0 +1,66 @@
+"""Generate eager NDArray wrappers from the op registry.
+
+The reference generates its 24k-LoC `mx.nd` namespace from the NNVM registry
+at import time (python/mxnet/ndarray/register.py _init_op_module). Here the
+same idea over the pure-jax registry: every registered op gets an eager
+wrapper that routes NDArray inputs through `apply_op` (taped when autograd
+records), so `nd.Convolution`, `nd.linalg_potrf`, `nd.broadcast_add`, ...
+all resolve with reference semantics.
+"""
+from __future__ import annotations
+
+import functools
+
+from ..ops.registry import _OPS
+from .ndarray import NDArray, apply_op
+
+
+def make_eager(name, fn):
+    """Wrap a pure registry op into an eager NDArray function.
+
+    NDArray instances anywhere in args/kwargs are routed through apply_op
+    (async dispatch + autograd taping); everything else passes through as
+    static parameters.
+    """
+
+    @functools.wraps(fn)
+    def wrapped(*args, **kwargs):
+        out = kwargs.pop("out", None)
+        arr_pos = [i for i, a in enumerate(args) if isinstance(a, NDArray)]
+        arr_keys = [k for k, v in kwargs.items() if isinstance(v, NDArray)]
+        nd_args = [args[i] for i in arr_pos] + [kwargs[k] for k in arr_keys]
+
+        def pure(*xs):
+            it = iter(xs)
+            call = list(args)
+            for i in arr_pos:
+                call[i] = next(it)
+            kw = dict(kwargs)
+            for k in arr_keys:
+                kw[k] = next(it)
+            return fn(*call, **kw)
+
+        res = apply_op(pure, *nd_args, name=name)
+        if out is not None:
+            out._assign_from(res if isinstance(res, NDArray) else res[0])
+            return out
+        return res
+
+    wrapped.__name__ = name
+    wrapped.__qualname__ = name
+    return wrapped
+
+
+def populate(namespace, predicate=None, rename=None):
+    """Install eager wrappers for every registered op into `namespace`
+    (a module __dict__). Returns the installed names."""
+    installed = []
+    for opname, fn in sorted(_OPS.items()):
+        if predicate is not None and not predicate(opname):
+            continue
+        name = rename(opname) if rename else opname
+        if name in namespace:
+            continue  # hand-written wrappers win (e.g. stateful dropout)
+        namespace[name] = make_eager(opname, fn)
+        installed.append(name)
+    return installed

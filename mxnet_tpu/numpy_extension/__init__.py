@@ -387,3 +387,121 @@ def rnn(data=None, parameters=None, state=None, state_cell=None, mode="lstm",
     if state_outputs:
         return res
     return res[0]
+
+
+# ---------------------------------------------------------------------------
+# generated corpus: expose every registry op under npx as well (reference
+# npx carries the full `_npx_*` surface — topk/pick/gather_nd/reshape_like/
+# the linalg family/legacy vision ops...). Hand-written wrappers above win,
+# so define the stateful CamelCase spellings BEFORE populate (the registry's
+# pure `Dropout`/`BatchNorm` would otherwise be silent no-op traps).
+# ---------------------------------------------------------------------------
+
+
+def Dropout(data, p=0.5, mode="training", axes=None, **kwargs):  # noqa: ARG001, N802
+    return dropout(data, p=p, axes=axes, mode=mode)
+
+
+def BatchNorm(data, gamma, beta, moving_mean, moving_var, **kwargs):  # noqa: N802
+    return batch_norm(data, gamma, beta, moving_mean, moving_var, **kwargs)
+
+
+def npx_reshape_shape(src, target):
+    """Resolve the _npx_reshape code table (reference:
+    src/operator/numpy/np_matrix_op.cc NumpyXReshapeInferShape): -1 infer,
+    -2 copy-dim, -3 skip size-1 dim, -4 copy-all-remaining, -5 merge-two,
+    -6 split (next two entries, either may be -1)."""
+    src = list(src)
+    target = list(target)
+    if all(t >= 0 for t in target):
+        return tuple(target)
+    out = []
+    i = 0  # src index
+    j = 0
+    infer_at = -1
+    known = 1
+    while j < len(target):
+        t = target[j]
+        if t == -1:
+            infer_at = len(out)
+            out.append(-1)
+            i += 1
+        elif t == -2:
+            out.append(src[i])
+            known *= src[i]
+            i += 1
+        elif t == -3:
+            if src[i] != 1:
+                raise ValueError("-3 may only skip a size-1 dim")
+            i += 1
+        elif t == -4:
+            while i < len(src):
+                out.append(src[i])
+                known *= src[i]
+                i += 1
+        elif t == -5:
+            merged = src[i] * src[i + 1]
+            out.append(merged)
+            known *= merged
+            i += 2
+        elif t == -6:
+            # operands are read from the (possibly reversed) target, exactly
+            # like the reference's NumpyXReshapeInferShape(rev_newshape)
+            if j + 2 >= len(target):
+                raise ValueError(
+                    "-6 needs two following entries in the (possibly "
+                    f"reversed) target shape, got {target[j:]}")
+            d0 = src[i]
+            d1, d2 = target[j + 1], target[j + 2]
+            if d1 == -1:
+                d1 = d0 // d2
+            elif d2 == -1:
+                d2 = d0 // d1
+            if d1 * d2 != d0:
+                raise ValueError(
+                    f"split dims ({d1}, {d2}) do not divide source dim {d0}")
+            out.extend([d1, d2])
+            known *= d1 * d2
+            i += 1
+            j += 2
+        else:
+            out.append(t)
+            known *= t
+            i += 1
+        j += 1
+    if infer_at >= 0:
+        total = 1
+        for d in src:
+            total *= d
+        out[infer_at] = total // known
+    return tuple(out)
+
+
+def reshape(a, newshape, reverse=False, order="C"):  # noqa: ARG001
+    """npx.reshape with the _npx_* code table (NOT the legacy nd.reshape
+    codes — those live on nd.reshape)."""
+    from ..ndarray.ndarray import apply_op as _apply
+
+    def pure(v):
+        shape = list(newshape) if not isinstance(newshape, int) else [newshape]
+        src = list(v.shape)
+        if reverse:
+            out = npx_reshape_shape(src[::-1], shape[::-1])[::-1]
+        else:
+            out = npx_reshape_shape(src, shape)
+        return v.reshape(out)
+
+    return _apply(pure, a, name="reshape")
+
+
+def batch_flatten(x):
+    """Reference: npx.batch_flatten — collapse all but the batch axis."""
+    from ..ndarray.ndarray import apply_op as _apply
+
+    return _apply(lambda v: v.reshape(v.shape[0], -1), x,
+                  name="batch_flatten")
+
+
+from ..ndarray.register import populate as _populate  # noqa: E402
+
+_populate(globals())

@@ -11,4 +11,8 @@ default conv/pool layout so model code ports unchanged. XLA transposes
 internally to its preferred layout at negligible cost on TPU.
 """
 from . import nn  # noqa: F401
+from . import tensor  # noqa: F401
+from . import linalg  # noqa: F401
+from . import vision  # noqa: F401
+from . import legacy  # noqa: F401
 from .registry import list_ops, register_op, get_op  # noqa: F401

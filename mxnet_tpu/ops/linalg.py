@@ -1,0 +1,261 @@
+"""linalg op family (reference: src/operator/tensor/la_op.cc — the `_linalg_*`
+NNVM names: gemm/gemm2/potrf/potri/trmm/trsm/syrk/gelqf/syevd/
+sumlogdiag/extractdiag/makediag/extracttrian/maketrian/inverse/det/slogdet).
+
+All ops batch over leading dimensions like the reference (la_op.h
+LaOpCaller). XLA lowers cholesky/qr/eigh/triangular_solve natively on TPU;
+gradients ride jax's built-in rules.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+from jax.scipy.linalg import solve_triangular
+
+from .registry import register_op
+
+
+@register_op("linalg_gemm")
+def gemm(A, B, C, transpose_a=False, transpose_b=False, alpha=1.0, beta=1.0,
+         axis=-2):  # noqa: ARG001 - axis parity (batch axis position)
+    """C' = alpha * op(A) @ op(B) + beta * C (la_op.cc linalg_gemm)."""
+    a = jnp.swapaxes(A, -1, -2) if transpose_a else A
+    b = jnp.swapaxes(B, -1, -2) if transpose_b else B
+    return alpha * jnp.matmul(a, b) + beta * C
+
+
+@register_op("linalg_gemm2")
+def gemm2(A, B, transpose_a=False, transpose_b=False, alpha=1.0):
+    a = jnp.swapaxes(A, -1, -2) if transpose_a else A
+    b = jnp.swapaxes(B, -1, -2) if transpose_b else B
+    return alpha * jnp.matmul(a, b)
+
+
+@register_op("linalg_potrf")
+def potrf(A):
+    """Cholesky factor L with A = L L^T (la_op.cc linalg_potrf)."""
+    return jnp.linalg.cholesky(A)
+
+
+@register_op("linalg_potri")
+def potri(A):
+    """Inverse from the Cholesky factor: given L, compute (L L^T)^-1."""
+    eye = jnp.broadcast_to(jnp.eye(A.shape[-1], dtype=A.dtype), A.shape)
+    linv = solve_triangular(A, eye, lower=True)
+    return jnp.matmul(jnp.swapaxes(linv, -1, -2), linv)
+
+
+@register_op("linalg_trmm")
+def trmm(A, B, transpose=False, rightside=False, lower=True, alpha=1.0):
+    """Triangular matrix multiply (la_op.cc linalg_trmm)."""
+    tri = jnp.tril(A) if lower else jnp.triu(A)
+    if transpose:
+        tri = jnp.swapaxes(tri, -1, -2)
+    out = jnp.matmul(B, tri) if rightside else jnp.matmul(tri, B)
+    return alpha * out
+
+
+@register_op("linalg_trsm")
+def trsm(A, B, transpose=False, rightside=False, lower=True, alpha=1.0):
+    """Solve op(A) X = alpha B (or X op(A) = alpha B) for triangular A."""
+    if rightside:
+        # X op(A) = alpha B  <=>  op(A)^T X^T = alpha B^T
+        xt = solve_triangular(
+            jnp.swapaxes(A, -1, -2), jnp.swapaxes(alpha * B, -1, -2),
+            lower=not lower, trans=1 if transpose else 0)
+        return jnp.swapaxes(xt, -1, -2)
+    return solve_triangular(A, alpha * B, lower=lower,
+                            trans=1 if transpose else 0)
+
+
+@register_op("linalg_syrk")
+def syrk(A, transpose=False, alpha=1.0):
+    """alpha * A A^T (or A^T A when transpose) — la_op.cc linalg_syrk."""
+    at = jnp.swapaxes(A, -1, -2)
+    return alpha * (jnp.matmul(at, A) if transpose else jnp.matmul(A, at))
+
+
+@register_op("linalg_gelqf")
+def gelqf(A):
+    """LQ factorization A = L Q with Q orthonormal rows (la_op.cc gelqf).
+
+    Computed via QR of A^T: A^T = Q' R'  =>  A = R'^T Q'^T.
+    """
+    q, r = jnp.linalg.qr(jnp.swapaxes(A, -1, -2), mode="reduced")
+    # sign-normalize so diag(L) >= 0, matching LAPACK gelqf convention loosely
+    return jnp.swapaxes(r, -1, -2), jnp.swapaxes(q, -1, -2)
+
+
+@register_op("linalg_syevd")
+def syevd(A):
+    """Symmetric eigendecomposition: returns (U, L) with A = U^T diag(L) U."""
+    w, v = jnp.linalg.eigh(A)
+    return jnp.swapaxes(v, -1, -2), w
+
+
+@register_op("linalg_sumlogdiag")
+def sumlogdiag(A):
+    diag = jnp.diagonal(A, axis1=-2, axis2=-1)
+    return jnp.sum(jnp.log(diag), axis=-1)
+
+
+@register_op("linalg_extractdiag")
+def extractdiag(A, offset=0):
+    return jnp.diagonal(A, offset=offset, axis1=-2, axis2=-1)
+
+
+@register_op("linalg_makediag")
+def makediag(A, offset=0):
+    n = A.shape[-1] + abs(offset)
+    out = jnp.zeros(A.shape[:-1] + (n, n), A.dtype)
+    idx = jnp.arange(A.shape[-1])
+    r = idx + (-offset if offset < 0 else 0)
+    c = idx + (offset if offset > 0 else 0)
+    return out.at[..., r, c].set(A)
+
+
+@register_op("linalg_extracttrian")
+def extracttrian(A, offset=0, lower=True):
+    """Extract (packed) triangle incl. the offset diagonal (la_op.cc)."""
+    n = A.shape[-1]
+    rows, cols = jnp.tril_indices(n, k=offset) if lower \
+        else jnp.triu_indices(n, k=offset)
+    return A[..., rows, cols]
+
+
+@register_op("linalg_maketrian")
+def maketrian(A, offset=0, lower=True):
+    """Unpack a packed triangle back into an (n, n) matrix (la_op.cc).
+
+    n is recovered in closed form from the packed length m: with
+    o = offset for lower (o = -offset for upper, by tril/triu symmetry),
+      o <= 0:  m = (n+o)(n+o+1)/2          =>  n = tri_root(m) - o
+      o  > 0:  m = n(n+1)/2 + o*n - o(o+1)/2  (quadratic in n)
+    """
+    import math
+
+    m = A.shape[-1]
+    o = offset if lower else -offset
+    if o <= 0:
+        t = int((math.isqrt(8 * m + 1) - 1) // 2)
+        n = t - o
+    else:
+        disc = (1 + 2 * o) ** 2 + 4 * (o * o + o + 2 * m)
+        n = int((math.isqrt(disc) - (1 + 2 * o)) // 2)
+    rows, cols = (jnp.tril_indices(n, k=offset) if lower
+                  else jnp.triu_indices(n, k=offset))
+    if int(rows.shape[0]) != m:
+        raise ValueError(
+            f"packed length {m} does not form a triangle with offset "
+            f"{offset}")
+    out = jnp.zeros(A.shape[:-1] + (n, n), A.dtype)
+    return out.at[..., rows, cols].set(A)
+
+
+@register_op("linalg_inverse")
+def inverse(A):
+    return jnp.linalg.inv(A)
+
+
+@register_op("linalg_det")
+def det(A):
+    return jnp.linalg.det(A)
+
+
+@register_op("linalg_slogdet")
+def slogdet(A):
+    sign, logabs = jnp.linalg.slogdet(A)
+    return sign, logabs
+
+
+@register_op("linalg_svd")
+def svd(A):
+    """Reference: gesvd — returns (UT, L, V) with A = U diag(L) V."""
+    u, s, vh = jnp.linalg.svd(A, full_matrices=False)
+    return jnp.swapaxes(u, -1, -2), s, vh
+
+
+@register_op("linalg_matrix_rank")
+def matrix_rank(A, tol=None):
+    return jnp.linalg.matrix_rank(A, tol=tol)
+
+
+@register_op("linalg_norm")
+def matrix_norm(A, ord=None, axis=None, keepdims=False):  # noqa: A002
+    return jnp.linalg.norm(A, ord=ord, axis=axis, keepdims=keepdims)
+
+
+@register_op("linalg_solve")
+def solve(A, B):
+    return jnp.linalg.solve(A, B)
+
+
+@register_op("linalg_tensorinv")
+def tensorinv(A, ind=2):
+    return jnp.linalg.tensorinv(A, ind=ind)
+
+
+@register_op("linalg_tensorsolve")
+def tensorsolve(A, B, axes=None):
+    return jnp.linalg.tensorsolve(A, B, axes=axes)
+
+
+@register_op("linalg_cholesky")
+def cholesky(A, lower=True):
+    L = jnp.linalg.cholesky(A)
+    return L if lower else jnp.swapaxes(L, -1, -2)
+
+
+@register_op("linalg_qr")
+def qr(A):
+    return jnp.linalg.qr(A, mode="reduced")
+
+
+@register_op("linalg_eig")
+def eig(A):
+    # general eig is CPU-only in XLA; documented limitation
+    return jnp.linalg.eig(A)
+
+
+@register_op("linalg_eigh")
+def eigh(A, upper=False):
+    return jnp.linalg.eigh(A, UPLO="U" if upper else "L")
+
+
+@register_op("linalg_eigvals")
+def eigvals(A):
+    return jnp.linalg.eigvals(A)
+
+
+@register_op("linalg_eigvalsh")
+def eigvalsh(A):
+    return jnp.linalg.eigvalsh(A)
+
+
+@register_op("linalg_lstsq")
+def lstsq(A, B, rcond=None):
+    return jnp.linalg.lstsq(A, B, rcond=rcond)
+
+
+@register_op("linalg_pinv")
+def pinv(A, rcond=None):
+    return jnp.linalg.pinv(A, rcond=rcond)
+
+
+@register_op("linalg_multi_dot")
+def multi_dot(*arrays):
+    return jnp.linalg.multi_dot(arrays)
+
+
+@register_op("linalg_matrix_power")
+def matrix_power(A, n):
+    return jnp.linalg.matrix_power(A, n)
+
+
+@register_op("linalg_kron")
+def kron(a, b):
+    return jnp.kron(a, b)
+
+
+@register_op("linalg_matmul")
+def matmul(a, b):
+    return jnp.matmul(a, b)

@@ -19,7 +19,7 @@ from .registry import register_op
 # ---------------------------------------------------------------------------
 
 
-@register_op("FullyConnected")
+@register_op("fully_connected")
 def dense(x, weight, bias=None, flatten=True, num_hidden=None,
           no_bias=None):  # noqa: ARG001 - reference-signature parity
     """y = x @ W^T + b (reference: src/operator/nn/fully_connected.cc).
@@ -50,7 +50,7 @@ def _spec(ndim):
     return ("NC" + sp, "OI" + sp, "NC" + sp)
 
 
-@register_op("Convolution")
+@register_op("convolution")
 def conv(x, weight, bias=None, stride=None, pad=None, dilate=None, groups=1):
     """N-d convolution, NC+spatial layout, weight (O, I/g, *k).
 
@@ -83,7 +83,7 @@ def conv(x, weight, bias=None, stride=None, pad=None, dilate=None, groups=1):
     return y
 
 
-@register_op("Deconvolution")
+@register_op("deconvolution")
 def conv_transpose(x, weight, bias=None, stride=None, pad=None, dilate=None,
                    output_padding=None, groups=1):
     """Transposed convolution (reference: src/operator/nn/deconvolution.cc).
@@ -130,7 +130,7 @@ def conv_transpose(x, weight, bias=None, stride=None, pad=None, dilate=None,
 # ---------------------------------------------------------------------------
 
 
-@register_op("Pooling")
+@register_op("pooling")
 def pool(x, kernel, pool_type="max", stride=None, pad=None, global_pool=False,
          count_include_pad=True):
     """Max/avg/lp pooling via reduce_window (reference: nn/pooling.cc)."""
@@ -179,7 +179,7 @@ def pool(x, kernel, pool_type="max", stride=None, pad=None, global_pool=False,
 # ---------------------------------------------------------------------------
 
 
-@register_op("BatchNorm")
+@register_op("batch_norm")
 def batch_norm(x, gamma, beta, moving_mean, moving_var, eps=1e-5,
                momentum=0.9, training=True, use_global_stats=False, axis=1):
     """Batch normalization (reference: nn/batch_norm.cc).
@@ -204,7 +204,7 @@ def batch_norm(x, gamma, beta, moving_mean, moving_var, eps=1e-5,
     return out, new_mean, new_var
 
 
-@register_op("LayerNorm")
+@register_op("layer_norm")
 def layer_norm(x, gamma, beta, axis=-1, eps=1e-5):
     """Layer normalization (reference: nn/layer_norm.cc)."""
     mean = jnp.mean(x, axis=axis, keepdims=True)
@@ -219,7 +219,7 @@ def layer_norm(x, gamma, beta, axis=-1, eps=1e-5):
     return out
 
 
-@register_op("GroupNorm")
+@register_op("group_norm")
 def group_norm(x, gamma, beta, num_groups, eps=1e-5):
     """Group normalization over NC+spatial (reference: nn/group_norm.cc)."""
     n, c = x.shape[:2]
@@ -238,13 +238,13 @@ def group_norm(x, gamma, beta, num_groups, eps=1e-5):
     return out
 
 
-@register_op("InstanceNorm")
+@register_op("instance_norm")
 def instance_norm(x, gamma, beta, eps=1e-5):
     """Instance norm = group norm with one group per channel."""
     return group_norm(x, gamma, beta, num_groups=x.shape[1], eps=eps)
 
 
-@register_op("RMSNorm")
+@register_op("rms_norm")
 def rms_norm(x, gamma, axis=-1, eps=1e-6):
     """RMSNorm — modern-transformer extension beyond the reference set."""
     ms = jnp.mean(jnp.square(x.astype(jnp.float32)), axis=axis, keepdims=True)
@@ -254,7 +254,7 @@ def rms_norm(x, gamma, axis=-1, eps=1e-6):
     return out
 
 
-@register_op("LRN")
+@register_op("lrn")
 def lrn(x, nsize=5, alpha=1e-4, beta=0.75, knorm=2.0):
     """Local response normalization (reference: nn/lrn.cc)."""
     sq = jnp.square(x)
@@ -322,7 +322,7 @@ _ACTS = {
 }
 
 
-@register_op("Activation")
+@register_op("activation")
 def activation(x, act_type="relu"):
     """Activation dispatch (reference: nn/activation.cc act_type enum)."""
     try:
@@ -331,7 +331,7 @@ def activation(x, act_type="relu"):
         raise ValueError(f"unknown act_type '{act_type}'") from None
 
 
-@register_op("LeakyReLU")
+@register_op("leaky_relu")
 def leaky_relu(x, gamma=None, act_type="leaky", slope=0.25):
     """LeakyReLU family (reference: leaky_relu.cc: leaky/prelu/elu/selu/gelu)."""
     if act_type == "leaky":
@@ -357,7 +357,7 @@ def leaky_relu(x, gamma=None, act_type="leaky", slope=0.25):
 # ---------------------------------------------------------------------------
 
 
-@register_op("Dropout")
+@register_op("dropout")
 def dropout(x, key, p=0.5, training=True, axes=None):
     """Inverted dropout (reference: nn/dropout.cc). Key is explicit — the
     stateful facade supplies it (mx._random.next_key / trace provider)."""
@@ -379,7 +379,7 @@ def dropout(x, key, p=0.5, training=True, axes=None):
 # ---------------------------------------------------------------------------
 
 
-@register_op("Embedding")
+@register_op("embedding")
 def embedding(indices, weight):
     """Embedding lookup (reference: tensor/indexing_op.cc Embedding).
 
@@ -489,7 +489,7 @@ def l2_normalization(x, eps=1e-10, mode="instance"):
     return x / norm
 
 
-@register_op("UpSampling")
+@register_op("upsampling")
 def upsample(x, scale=2, sample_type="nearest"):
     """Spatial upsampling (reference: nn/upsampling.cc)."""
     n, c, h, w = x.shape

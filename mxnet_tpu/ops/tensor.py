@@ -1,0 +1,755 @@
+"""Tensor-op corpus: the reference's `src/operator/tensor/` family as pure
+jax functions with legacy MXNet semantics.
+
+Covers elemwise unary/binary (elemwise_unary_op_basic.cc,
+elemwise_binary_op_basic.cc), broadcast_* (elemwise_binary_broadcast_op_*.cc),
+reductions with `exclude` (broadcast_reduce_op_value.cc), ordering
+(ordering_op.cc), indexing (indexing_op.cc, ravel.cc), matrix/shape
+manipulation incl. legacy reshape codes 0/-1/-2/-3/-4
+(matrix_op.cc:Reshape), dot/batch_dot (dot.cc), and the loss-output ops with
+their reference gradient quirks (SoftmaxOutput's out-label backward,
+MakeLoss, BlockGrad — src/operator/softmax_output.cc, make_loss.cc).
+
+Everything here is shape-static and jit-safe; gradients come from jax.vjp
+except where the reference defines a *different* backward (custom_vjp).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from .registry import register_op
+
+# ---------------------------------------------------------------------------
+# unary elemwise (reference: elemwise_unary_op_basic.cc, *_trig.cc, *_pow.cc)
+# ---------------------------------------------------------------------------
+
+_UNARY = {
+    "abs": jnp.abs,
+    "sign": jnp.sign,
+    "rint": jnp.rint,
+    "round": jnp.round,
+    "ceil": jnp.ceil,
+    "floor": jnp.floor,
+    "trunc": jnp.trunc,
+    "fix": jnp.trunc,
+    "square": jnp.square,
+    "sqrt": jnp.sqrt,
+    "rsqrt": lambda x: lax.rsqrt(x),
+    "cbrt": jnp.cbrt,
+    "rcbrt": lambda x: 1.0 / jnp.cbrt(x),
+    "exp": jnp.exp,
+    "log": jnp.log,
+    "log10": jnp.log10,
+    "log2": jnp.log2,
+    "log1p": jnp.log1p,
+    "expm1": jnp.expm1,
+    "reciprocal": lambda x: 1.0 / x,
+    "negative": jnp.negative,
+    "logical_not": lambda x: (x == 0).astype(x.dtype),
+    "sin": jnp.sin,
+    "cos": jnp.cos,
+    "tan": jnp.tan,
+    "arcsin": jnp.arcsin,
+    "arccos": jnp.arccos,
+    "arctan": jnp.arctan,
+    "degrees": jnp.degrees,
+    "radians": jnp.radians,
+    "sinh": jnp.sinh,
+    "cosh": jnp.cosh,
+    "tanh": jnp.tanh,
+    "arcsinh": jnp.arcsinh,
+    "arccosh": jnp.arccosh,
+    "arctanh": jnp.arctanh,
+    "gamma": lambda x: jnp.exp(jax.scipy.special.gammaln(x)),
+    "gammaln": lambda x: jax.scipy.special.gammaln(x),
+    "digamma": lambda x: jax.scipy.special.digamma(x),
+    "erf": lambda x: jax.scipy.special.erf(x),
+    "erfinv": lambda x: jax.scipy.special.erfinv(x),
+    "sigmoid": jax.nn.sigmoid,
+    "log_sigmoid": jax.nn.log_sigmoid,
+    "softsign": jax.nn.soft_sign,
+    "relu": jax.nn.relu,
+    "identity": lambda x: x,
+}
+
+for _name, _fn in _UNARY.items():
+    register_op(_name, _fn)
+globals().update(_UNARY)
+
+
+@register_op("hard_sigmoid")
+def hard_sigmoid(x, alpha=0.2, beta=0.5):
+    """Reference: elemwise_unary_op_basic.cc hard_sigmoid (alpha*x+beta clipped)."""
+    return jnp.clip(alpha * x + beta, 0.0, 1.0)
+
+
+# ---------------------------------------------------------------------------
+# binary elemwise + broadcast_* (reference: elemwise_binary_broadcast_op_*.cc)
+# ---------------------------------------------------------------------------
+
+_BINARY = {
+    "elemwise_add": jnp.add,
+    "elemwise_sub": jnp.subtract,
+    "elemwise_mul": jnp.multiply,
+    "elemwise_div": jnp.divide,
+    "broadcast_add": jnp.add,
+    "broadcast_plus": jnp.add,
+    "broadcast_sub": jnp.subtract,
+    "broadcast_minus": jnp.subtract,
+    "broadcast_mul": jnp.multiply,
+    "broadcast_div": jnp.divide,
+    "broadcast_mod": jnp.mod,
+    "broadcast_power": jnp.power,
+    "broadcast_maximum": jnp.maximum,
+    "broadcast_minimum": jnp.minimum,
+    "broadcast_hypot": jnp.hypot,
+    "hypot": jnp.hypot,
+}
+
+_BINARY_CMP = {
+    "broadcast_equal": jnp.equal,
+    "broadcast_not_equal": jnp.not_equal,
+    "broadcast_greater": jnp.greater,
+    "broadcast_greater_equal": jnp.greater_equal,
+    "broadcast_lesser": jnp.less,
+    "broadcast_lesser_equal": jnp.less_equal,
+    "broadcast_logical_and": jnp.logical_and,
+    "broadcast_logical_or": jnp.logical_or,
+    "broadcast_logical_xor": jnp.logical_xor,
+}
+
+
+def _cmp(fn):
+    # reference comparison ops return the lhs dtype (0/1 valued), not bool
+    def wrapped(lhs, rhs):
+        return fn(lhs, rhs).astype(getattr(lhs, "dtype", jnp.float32))
+    wrapped.__name__ = fn.__name__
+    return wrapped
+
+
+for _name, _fn in _BINARY.items():
+    register_op(_name, _fn)
+    globals()[_name] = _fn
+for _name, _fn in _BINARY_CMP.items():
+    globals()[_name] = register_op(_name, _cmp(_fn))
+
+
+@register_op("add_n")
+def add_n(*args):
+    """Sum of n arrays (reference: elemwise_sum.cc add_n)."""
+    out = args[0]
+    for a in args[1:]:
+        out = out + a
+    return out
+
+
+@register_op("smooth_l1")
+def smooth_l1(data, scalar=1.0):
+    """Reference: elemwise_unary_op_basic.cc smooth_l1 with sigma=scalar."""
+    s2 = scalar * scalar
+    a = jnp.abs(data)
+    return jnp.where(a < 1.0 / s2, 0.5 * s2 * jnp.square(data), a - 0.5 / s2)
+
+
+# ---------------------------------------------------------------------------
+# reductions (reference: broadcast_reduce_op_value.cc — axis/keepdims/exclude)
+# ---------------------------------------------------------------------------
+
+
+def _norm_axis(axis, ndim, exclude=False):
+    if axis is None:
+        return None
+    if isinstance(axis, int):
+        axis = (axis,)
+    axis = tuple(a % ndim for a in axis)
+    if exclude:
+        axis = tuple(i for i in range(ndim) if i not in axis)
+    return axis
+
+
+def _reduce(jfn, name):
+    def fn(data, axis=None, keepdims=False, exclude=False):
+        ax = _norm_axis(axis, data.ndim, exclude)
+        return jfn(data, axis=ax, keepdims=keepdims)
+    fn.__name__ = name
+    return register_op(name, fn)
+
+
+sum = _reduce(jnp.sum, "sum")  # noqa: A001
+nansum = _reduce(jnp.nansum, "nansum")
+prod = _reduce(jnp.prod, "prod")
+nanprod = _reduce(jnp.nanprod, "nanprod")
+mean = _reduce(jnp.mean, "mean")
+max = _reduce(jnp.max, "max")  # noqa: A001
+min = _reduce(jnp.min, "min")  # noqa: A001
+sum_axis = register_op("sum_axis", sum)
+max_axis = register_op("max_axis", max)
+min_axis = register_op("min_axis", min)
+
+
+@register_op("norm")
+def norm(data, ord=2, axis=None, keepdims=False):  # noqa: A002
+    """Reference: broadcast_reduce_norm_value.cc (L1/L2 over axis or all)."""
+    if ord == 1:
+        return jnp.sum(jnp.abs(data), axis=axis, keepdims=keepdims)
+    return jnp.sqrt(jnp.sum(jnp.square(data), axis=axis, keepdims=keepdims))
+
+
+@register_op("argmax")
+def argmax(data, axis=None, keepdims=False):
+    out = jnp.argmax(data, axis=axis, keepdims=keepdims)
+    return out.astype(jnp.float32)  # reference returns float indices
+
+
+@register_op("argmin")
+def argmin(data, axis=None, keepdims=False):
+    return jnp.argmin(data, axis=axis, keepdims=keepdims).astype(jnp.float32)
+
+
+@register_op("argmax_channel")
+def argmax_channel(data):
+    """Reference: broadcast_reduce_op_index.cc — argmax over axis 1."""
+    return jnp.argmax(data, axis=1).astype(jnp.float32)
+
+
+# ---------------------------------------------------------------------------
+# ordering (reference: ordering_op.cc)
+# ---------------------------------------------------------------------------
+
+
+@register_op("sort")
+def sort(data, axis=-1, is_ascend=True):
+    out = jnp.sort(data, axis=axis)
+    return out if is_ascend else jnp.flip(out, axis=axis)
+
+
+@register_op("argsort")
+def argsort(data, axis=-1, is_ascend=True, dtype=jnp.float32):
+    idx = jnp.argsort(data, axis=axis)
+    if not is_ascend:
+        idx = jnp.flip(idx, axis=axis)
+    return idx.astype(dtype)
+
+
+# ---------------------------------------------------------------------------
+# indexing (reference: indexing_op.cc, ravel.cc, init_op.cc)
+# ---------------------------------------------------------------------------
+
+
+@register_op("take")
+def take(a, indices, axis=0, mode="clip"):
+    idx = indices.astype(jnp.int32)
+    n = a.shape[axis]
+    if mode == "clip":
+        idx = jnp.clip(idx, 0, n - 1)
+    else:  # wrap
+        idx = idx % n
+    return jnp.take(a, idx, axis=axis)
+
+
+@register_op("batch_take")
+def batch_take(a, indices):
+    """Per-row gather (reference: indexing_op.cc batch_take): out[i] = a[i, idx[i]]."""
+    idx = jnp.clip(indices.astype(jnp.int32), 0, a.shape[1] - 1)
+    return jnp.take_along_axis(a, idx[:, None], axis=1)[:, 0]
+
+
+@register_op("gather_nd")
+def gather_nd(data, indices):
+    """Reference: indexing_op.cc gather_nd. indices (M, ...) selects along the
+    first M dims of data."""
+    idx = indices.astype(jnp.int32)
+    m = idx.shape[0]
+    return data[tuple(idx[i] for i in range(m))]
+
+
+@register_op("scatter_nd")
+def scatter_nd(data, indices, shape):
+    """Reference: indexing_op.cc scatter_nd (last write wins; here add-free set)."""
+    idx = indices.astype(jnp.int32)
+    m = idx.shape[0]
+    out = jnp.zeros(shape, data.dtype)
+    return out.at[tuple(idx[i] for i in range(m))].set(data)
+
+
+@register_op("ravel_multi_index")
+def ravel_multi_index(data, shape):
+    """Reference: ravel.cc. data (ndim, n) of coords -> flat indices (n,)."""
+    idx = data.astype(jnp.int32)
+    out = jnp.zeros(idx.shape[1:], jnp.int32)
+    for i, s in enumerate(shape):
+        out = out * s + idx[i]
+    return out.astype(jnp.float32)
+
+
+@register_op("unravel_index")
+def unravel_index(data, shape):
+    idx = data.astype(jnp.int32)
+    coords = []
+    for s in reversed(shape):
+        coords.append(idx % s)
+        idx = idx // s
+    return jnp.stack(coords[::-1]).astype(jnp.float32)
+
+
+@register_op("diag")
+def diag(data, k=0, axis1=0, axis2=1):
+    if data.ndim == 1:
+        return jnp.diag(data, k=k)
+    return jnp.diagonal(data, offset=k, axis1=axis1, axis2=axis2)
+
+
+@register_op("where")
+def where(condition, x, y):
+    return jnp.where(condition.astype(bool), x, y)
+
+
+# ---------------------------------------------------------------------------
+# shape manipulation (reference: matrix_op.cc)
+# ---------------------------------------------------------------------------
+
+
+def legacy_reshape_shape(src, target, reverse=False):
+    """Resolve MXNet Reshape special codes (matrix_op-inl.h InferReshapeShape):
+    0 copy-dim, -1 infer, -2 copy-rest, -3 merge-two, -4 split (a,b)."""
+    src = list(src)
+    target = list(target)
+    if reverse:
+        src = src[::-1]
+        target = target[::-1]
+    out = []
+    i = 0  # position in src
+    j = 0
+    while j < len(target):
+        t = target[j]
+        if t == 0:
+            out.append(src[i])
+            i += 1
+        elif t == -1:
+            out.append(-1)
+            i += 1
+        elif t == -2:
+            out.extend(src[i:])
+            i = len(src)
+        elif t == -3:
+            out.append(src[i] * src[i + 1])
+            i += 2
+        elif t == -4:
+            # NB: under reverse=True the operands are read from the REVERSED
+            # target, exactly like the reference (matrix_op-inl.h
+            # InferReshapeShape reverses param_shape_vec then reads ++i).
+            if j + 2 >= len(target):
+                raise ValueError(
+                    "-4 needs two following entries in the (possibly "
+                    f"reversed) target shape, got {target[j:]}")
+            a, b = target[j + 1], target[j + 2]
+            if a == -1:
+                a = src[i] // b
+            if b == -1:
+                b = src[i] // a
+            if a * b != src[i]:
+                raise ValueError(
+                    f"split dims ({a}, {b}) do not divide source dim "
+                    f"{src[i]}")
+            out.extend([a, b])
+            i += 1
+            j += 2
+        else:
+            out.append(t)
+            i += 1
+        j += 1
+    if -1 in out:
+        known = 1
+        for d in out:
+            if d != -1:
+                known *= d
+        total = 1
+        for d in src:
+            total *= d
+        out[out.index(-1)] = total // known
+    if reverse:
+        out = out[::-1]
+    return tuple(out)
+
+
+@register_op("reshape")
+def reshape(data, shape=None, reverse=False):
+    return jnp.reshape(data, legacy_reshape_shape(data.shape, shape, reverse))
+
+
+@register_op("reshape_like")
+def reshape_like(lhs, rhs, lhs_begin=None, lhs_end=None, rhs_begin=None,
+                 rhs_end=None):
+    if lhs_begin is None and rhs_begin is None:
+        return jnp.reshape(lhs, rhs.shape)
+    lb = lhs_begin or 0
+    le = lhs_end if lhs_end is not None else lhs.ndim
+    rb = rhs_begin or 0
+    re = rhs_end if rhs_end is not None else rhs.ndim
+    new = lhs.shape[:lb] + rhs.shape[rb:re] + lhs.shape[le:]
+    return jnp.reshape(lhs, new)
+
+
+@register_op("flatten")
+def flatten(data):
+    return jnp.reshape(data, (data.shape[0], -1))
+
+
+@register_op("transpose")
+def transpose(data, axes=None):
+    return jnp.transpose(data, axes=axes or None)
+
+
+@register_op("expand_dims")
+def expand_dims(data, axis):
+    return jnp.expand_dims(data, axis)
+
+
+@register_op("squeeze")
+def squeeze(data, axis=None):
+    return jnp.squeeze(data, axis=axis)
+
+
+@register_op("slice")
+def slice(data, begin, end, step=None):  # noqa: A001
+    """Reference: matrix_op.cc slice — None entries mean full range."""
+    import builtins
+    step = step or (None,) * len(begin)
+    idx = tuple(builtins.slice(b, e, s) for b, e, s in zip(begin, end, step))
+    idx = idx + (builtins.slice(None),) * (data.ndim - len(idx))
+    return data[idx]
+
+
+@register_op("slice_axis")
+def slice_axis(data, axis, begin, end):
+    import builtins
+    if end is None:
+        end = data.shape[axis]
+    idx = [builtins.slice(None)] * data.ndim
+    idx[axis] = builtins.slice(begin, end)
+    return data[tuple(idx)]
+
+
+@register_op("slice_like")
+def slice_like(data, shape_like, axes=None):
+    import builtins
+    idx = [builtins.slice(None)] * data.ndim
+    axes = axes if axes else range(builtins.min(data.ndim, shape_like.ndim))
+    for ax in axes:
+        idx[ax] = builtins.slice(0, shape_like.shape[ax])
+    return data[tuple(idx)]
+
+
+@register_op("clip")
+def clip(data, a_min=None, a_max=None):
+    return jnp.clip(data, a_min, a_max)
+
+
+@register_op("repeat")
+def repeat(data, repeats, axis=None):
+    return jnp.repeat(data, repeats, axis=axis)
+
+
+@register_op("tile")
+def tile(data, reps):
+    return jnp.tile(data, reps)
+
+
+@register_op("reverse")
+def reverse(data, axis=0):
+    return jnp.flip(data, axis=axis)
+
+
+flip = register_op("flip", reverse)
+
+
+@register_op("shape_array")
+def shape_array(data):
+    return jnp.asarray(data.shape, jnp.int32)
+
+
+@register_op("size_array")
+def size_array(data):
+    return jnp.asarray([data.size], jnp.int32)
+
+
+@register_op("cast")
+def cast(data, dtype):
+    return data.astype(dtype)
+
+
+@register_op("swapaxes")
+def swapaxes(data, dim1=0, dim2=1):
+    return jnp.swapaxes(data, dim1, dim2)
+
+
+@register_op("depth_to_space")
+def depth_to_space(data, block_size):
+    """Reference: depth_to_space in matrix_op.cc (DCR mode, NCHW)."""
+    n, c, h, w = data.shape
+    b = block_size
+    x = data.reshape(n, b, b, c // (b * b), h, w)
+    x = x.transpose(0, 3, 4, 1, 5, 2)
+    return x.reshape(n, c // (b * b), h * b, w * b)
+
+
+@register_op("space_to_depth")
+def space_to_depth(data, block_size):
+    n, c, h, w = data.shape
+    b = block_size
+    x = data.reshape(n, c, h // b, b, w // b, b)
+    x = x.transpose(0, 3, 5, 1, 2, 4)
+    return x.reshape(n, c * b * b, h // b, w // b)
+
+
+@register_op("stack")
+def stack(*data, axis=0):
+    return jnp.stack(data, axis=axis)
+
+
+@register_op("concat")
+def concat(*data, dim=1):
+    return jnp.concatenate(data, axis=dim)
+
+
+@register_op("split")
+def split(data, num_outputs, axis=1, squeeze_axis=False):
+    """Reference: SliceChannel (slice_channel.cc)."""
+    outs = jnp.split(data, num_outputs, axis=axis)
+    if squeeze_axis:
+        outs = [jnp.squeeze(o, axis=axis) for o in outs]
+    return tuple(outs)
+
+
+@register_op("pad")
+def pad(data, mode="constant", pad_width=None, constant_value=0.0):
+    """Reference: pad.cc — pad_width is the flat (before, after) per-dim list."""
+    pw = [(pad_width[2 * i], pad_width[2 * i + 1])
+          for i in range(len(pad_width) // 2)]
+    if mode == "constant":
+        return jnp.pad(data, pw, constant_values=constant_value)
+    if mode == "reflect":
+        return jnp.pad(data, pw, mode="reflect")
+    return jnp.pad(data, pw, mode="edge")
+
+
+@register_op("broadcast_to")
+def broadcast_to(data, shape):
+    shape = tuple(s if s != 0 else d for s, d in zip(shape, data.shape))
+    return jnp.broadcast_to(data, shape)
+
+
+@register_op("broadcast_axis")
+def broadcast_axis(data, axis=None, size=None):
+    axis = (axis,) if isinstance(axis, int) else tuple(axis)
+    size = (size,) if isinstance(size, int) else tuple(size)
+    shape = list(data.shape)
+    for a, s in zip(axis, size):
+        shape[a] = s
+    return jnp.broadcast_to(data, tuple(shape))
+
+
+broadcast_axes = register_op("broadcast_axes", broadcast_axis)
+
+
+# ---------------------------------------------------------------------------
+# dot family (reference: dot.cc, la_op gemm lives in ops/linalg.py)
+# ---------------------------------------------------------------------------
+
+
+@register_op("dot")
+def dot(lhs, rhs, transpose_a=False, transpose_b=False):
+    if transpose_a:
+        lhs = jnp.swapaxes(lhs, -1, -2) if lhs.ndim > 1 else lhs
+    if transpose_b:
+        rhs = jnp.swapaxes(rhs, -1, -2) if rhs.ndim > 1 else rhs
+    return jnp.dot(lhs, rhs)
+
+
+@register_op("batch_dot")
+def batch_dot(lhs, rhs, transpose_a=False, transpose_b=False):
+    if transpose_a:
+        lhs = jnp.swapaxes(lhs, -1, -2)
+    if transpose_b:
+        rhs = jnp.swapaxes(rhs, -1, -2)
+    return jnp.matmul(lhs, rhs)
+
+
+@register_op("khatri_rao")
+def khatri_rao(*args):
+    """Column-wise Kronecker product (reference: contrib krprod.cc)."""
+    out = args[0]
+    for b in args[1:]:
+        out = jnp.einsum("ik,jk->ijk", out, b).reshape(-1, out.shape[1])
+    return out
+
+
+# ---------------------------------------------------------------------------
+# cumulative / windowed
+# ---------------------------------------------------------------------------
+
+
+@register_op("cumsum")
+def cumsum(a, axis=None, dtype=None):
+    return jnp.cumsum(a, axis=axis, dtype=dtype)
+
+
+# ---------------------------------------------------------------------------
+# loss-output ops with reference gradient semantics (custom_vjp)
+# ---------------------------------------------------------------------------
+
+
+@register_op("BlockGrad")
+def stop_gradient(data):
+    """Reference: elemwise_unary_op_basic.cc BlockGrad/stop_gradient."""
+    return lax.stop_gradient(data)
+
+
+register_op("stop_gradient", stop_gradient)
+
+
+@jax.custom_vjp
+def _make_loss(data, grad_scale):
+    return data
+
+
+def _make_loss_fwd(data, grad_scale):
+    return data, (data, grad_scale)
+
+
+def _make_loss_bwd(res, g):  # noqa: ARG001
+    data, grad_scale = res
+    return jnp.full_like(data, grad_scale), None
+
+
+_make_loss.defvjp(_make_loss_fwd, _make_loss_bwd)
+
+
+@register_op("make_loss")
+def make_loss(data, grad_scale=1.0, valid_thresh=0.0, normalization="null"):  # noqa: ARG001
+    """Reference: make_loss.cc — forward identity, backward = grad_scale
+    (independent of upstream gradient)."""
+    return _make_loss(data, grad_scale)
+
+
+register_op("MakeLoss", make_loss)
+
+
+@jax.custom_vjp
+def _softmax_output(data, label, grad_scale, ignore_label, use_ignore):
+    return jax.nn.softmax(data, axis=1)
+
+
+def _softmax_output_fwd(data, label, grad_scale, ignore_label, use_ignore):
+    out = jax.nn.softmax(data, axis=1)
+    return out, (out, label, grad_scale, ignore_label, use_ignore)
+
+
+def _softmax_output_bwd(res, g):  # noqa: ARG001
+    out, label, grad_scale, ignore_label, use_ignore = res
+    lab = label.astype(jnp.int32)
+    onehot = jax.nn.one_hot(lab, out.shape[1], dtype=out.dtype)
+    if out.ndim > 2:  # (N, C, ...) — move class axis
+        onehot = jnp.moveaxis(onehot, -1, 1)
+    grad = out - onehot
+    if use_ignore:
+        keep = (lab != int(ignore_label)).astype(out.dtype)
+        keep = keep.reshape((out.shape[0],) + (1,) * (out.ndim - 1)) \
+            if out.ndim == 2 else jnp.expand_dims(keep, 1)
+        grad = grad * keep
+    return grad * grad_scale, None, None, None, None
+
+
+_softmax_output.defvjp(_softmax_output_fwd, _softmax_output_bwd)
+
+
+@register_op("SoftmaxOutput")
+def softmax_output(data, label, grad_scale=1.0, ignore_label=-1,
+                   use_ignore=False, multi_output=False,  # noqa: ARG001
+                   normalization="null", **kwargs):  # noqa: ARG001
+    """Reference: softmax_output.cc — forward softmax, backward (p - onehot)
+    regardless of upstream gradient (it IS the loss layer)."""
+    return _softmax_output(data, label, grad_scale, ignore_label, use_ignore)
+
+
+register_op("softmax_output", softmax_output)
+
+
+def _regression_op(fwd_fn, grad_fn):
+    """Reference: regression_output.cc — the output IS the loss layer, so the
+    backward is grad_fn(pred, label) * grad_scale, ignoring upstream grads."""
+
+    @jax.custom_vjp
+    def op(data, label, grad_scale):
+        return fwd_fn(data)
+
+    def fwd(data, label, grad_scale):
+        out = fwd_fn(data)
+        return out, (out, label, grad_scale)
+
+    def bwd(res, g):  # noqa: ARG001
+        out, label, grad_scale = res
+        return grad_fn(out, label) * grad_scale, None, None
+
+    op.defvjp(fwd, bwd)
+    return op
+
+
+_linear_reg = _regression_op(lambda x: x, lambda p, y: p - y)
+_logistic_reg = _regression_op(jax.nn.sigmoid, lambda p, y: p - y)
+_mae_reg = _regression_op(lambda x: x, lambda p, y: jnp.sign(p - y))
+
+
+@register_op("LinearRegressionOutput")
+def linear_regression_output(data, label, grad_scale=1.0):
+    return _linear_reg(data, label, grad_scale)
+
+
+@register_op("LogisticRegressionOutput")
+def logistic_regression_output(data, label, grad_scale=1.0):
+    return _logistic_reg(data, label, grad_scale)
+
+
+@register_op("MAERegressionOutput")
+def mae_regression_output(data, label, grad_scale=1.0):
+    return _mae_reg(data, label, grad_scale)
+
+
+@register_op("SVMOutput")
+def svm_output(data, label, margin=1.0, regularization_coefficient=1.0,
+               use_linear=False):
+    """Reference: svm_output.cc — forward identity; backward hinge-loss grad."""
+
+    @jax.custom_vjp
+    def _svm(data, label):
+        return data
+
+    def _fwd(data, label):
+        return data, (data, label)
+
+    def _bwd(res, g):  # noqa: ARG001
+        x, lab = res
+        onehot = jax.nn.one_hot(lab.astype(jnp.int32), x.shape[1],
+                                dtype=x.dtype)
+        y = 2.0 * onehot - 1.0  # +1 for true class, -1 otherwise
+        viol = (margin - y * x) > 0
+        if use_linear:
+            grad = jnp.where(viol, -y * regularization_coefficient, 0.0)
+        else:
+            grad = jnp.where(viol, -2.0 * regularization_coefficient
+                             * (margin - y * x) * y, 0.0)
+        return grad.astype(x.dtype), None
+
+    _svm.defvjp(_fwd, _bwd)
+    return _svm(data, label)
+
+
+@register_op("softmax_cross_entropy")
+def softmax_cross_entropy(data, label):
+    """Reference: loss_binary_op.cc — scalar summed CE."""
+    logp = jax.nn.log_softmax(data, axis=-1)
+    picked = jnp.take_along_axis(
+        logp, label.astype(jnp.int32)[:, None], axis=-1)
+    return -jnp.sum(picked)

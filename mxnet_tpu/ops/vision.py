@@ -1,0 +1,269 @@
+"""Legacy standalone vision ops (reference: src/operator/bilinear_sampler.cc,
+grid_generator.cc, spatial_transformer.cc, roi_pooling.cc, correlation.cc,
+contrib/deformable_convolution.cc, crop.cc).
+
+TPU re-design notes: all of these are gather/sample ops. Instead of the
+reference's hand-rolled CPU/CUDA loops they are expressed as vectorized
+jnp gathers with *static* kernel-position loops (unrolled at trace time), so
+XLA fuses each into a handful of HLOs; gradients come from jax.vjp of the
+same expressions (the reference hand-writes each backward).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from .registry import register_op
+
+# ---------------------------------------------------------------------------
+# bilinear sampling core (shared by BilinearSampler / SpatialTransformer /
+# DeformableConvolution) — matches bilinear_sampler.cc: out-of-bounds corner
+# samples contribute 0 (`between` checks), coords map (g+1)*(size-1)/2.
+# ---------------------------------------------------------------------------
+
+
+def _bilinear_gather(data, x_real, y_real):
+    """Sample data (N,C,H,W) at real-valued pixel coords x_real/y_real
+    (N,*spatial), zero outside [-1, size]. Returns (N,C,*spatial)."""
+    n, c, h, w = data.shape
+    sp = x_real.shape[1:]
+    x0 = jnp.floor(x_real).astype(jnp.int32)
+    y0 = jnp.floor(y_real).astype(jnp.int32)
+    wx1 = x_real - x0  # weight of right sample
+    wy1 = y_real - y0  # weight of bottom sample
+
+    def corner(yc, xc, wgt):
+        valid = (yc >= 0) & (yc < h) & (xc >= 0) & (xc < w)
+        ycl = jnp.clip(yc, 0, h - 1)
+        xcl = jnp.clip(xc, 0, w - 1)
+        # gather per batch: data (N,C,H,W) indexed at (n, :, ycl[n], xcl[n])
+        flat = ycl.reshape(n, -1) * w + xcl.reshape(n, -1)  # (N, S)
+        g = jnp.take_along_axis(
+            data.reshape(n, c, h * w), flat[:, None, :], axis=2)
+        g = g.reshape((n, c) + sp)
+        wgt = jnp.where(valid, wgt, 0.0)
+        return g * wgt[:, None].astype(data.dtype)
+
+    out = corner(y0, x0, (1 - wy1) * (1 - wx1))
+    out = out + corner(y0, x0 + 1, (1 - wy1) * wx1)
+    out = out + corner(y0 + 1, x0, wy1 * (1 - wx1))
+    out = out + corner(y0 + 1, x0 + 1, wy1 * wx1)
+    return out
+
+
+@register_op("BilinearSampler")
+def bilinear_sampler(data, grid, cudnn_off=None):  # noqa: ARG001
+    """Reference: bilinear_sampler.cc. grid (N,2,Ho,Wo) in [-1,1]:
+    channel 0 = x, channel 1 = y; coord = (g+1)*(size-1)/2."""
+    _, _, h, w = data.shape
+    x_real = (grid[:, 0] + 1) * (w - 1) / 2
+    y_real = (grid[:, 1] + 1) * (h - 1) / 2
+    return _bilinear_gather(data, x_real, y_real)
+
+
+@register_op("GridGenerator")
+def grid_generator(data, transform_type="affine", target_shape=None):
+    """Reference: grid_generator.cc. affine: data (N,6) -> sampling grid
+    (N,2,H,W) in [-1,1]. warp: data = flow (N,2,H,W) added to the identity
+    pixel grid, then normalized to [-1,1]."""
+    if transform_type == "affine":
+        h, w = target_shape
+        theta = data.reshape(-1, 2, 3)
+        ys, xs = jnp.meshgrid(
+            jnp.linspace(-1.0, 1.0, h), jnp.linspace(-1.0, 1.0, w),
+            indexing="ij")
+        ones = jnp.ones_like(xs)
+        coords = jnp.stack([xs, ys, ones]).reshape(3, -1)  # (3, H*W)
+        out = jnp.einsum("nij,jk->nik", theta, coords.astype(data.dtype))
+        return out.reshape(-1, 2, h, w)
+    # warp
+    n, _, h, w = data.shape
+    ys, xs = jnp.meshgrid(jnp.arange(h), jnp.arange(w), indexing="ij")
+    x_new = data[:, 0] + xs.astype(data.dtype)
+    y_new = data[:, 1] + ys.astype(data.dtype)
+    gx = 2 * x_new / (w - 1) - 1
+    gy = 2 * y_new / (h - 1) - 1
+    return jnp.stack([gx, gy], axis=1)
+
+
+@register_op("SpatialTransformer")
+def spatial_transformer(data, loc, target_shape=None,
+                        transform_type="affine", sampler_type="bilinear",
+                        cudnn_off=None):  # noqa: ARG001
+    """Reference: spatial_transformer.cc — affine grid from loc (N,6) then
+    bilinear sampling."""
+    grid = grid_generator(loc, "affine", target_shape)
+    return bilinear_sampler(data, grid)
+
+
+@register_op("ROIPooling")
+def roi_pooling(data, rois, pooled_size, spatial_scale):
+    """Reference: roi_pooling.cc. rois (R,5) = [batch_idx, x1, y1, x2, y2]
+    in image coords; max-pool each of pooled_size bins; empty bins -> 0.
+
+    Bin edges follow the reference exactly: rounded roi corners, bin
+    [floor(p*bin), ceil((p+1)*bin)) clipped to the feature map. Masked
+    separable max keeps the broadcast at (R,C,H,PW,W) rather than
+    materializing a 6-d corner tensor.
+    """
+    _, c, h, w = data.shape
+    ph, pw = pooled_size
+    batch_ind = rois[:, 0].astype(jnp.int32)
+    roi_start_w = jnp.round(rois[:, 1] * spatial_scale).astype(jnp.int32)
+    roi_start_h = jnp.round(rois[:, 2] * spatial_scale).astype(jnp.int32)
+    roi_end_w = jnp.round(rois[:, 3] * spatial_scale).astype(jnp.int32)
+    roi_end_h = jnp.round(rois[:, 4] * spatial_scale).astype(jnp.int32)
+    roi_h = jnp.maximum(roi_end_h - roi_start_h + 1, 1).astype(jnp.float32)
+    roi_w = jnp.maximum(roi_end_w - roi_start_w + 1, 1).astype(jnp.float32)
+    bin_h = roi_h / ph  # (R,)
+    bin_w = roi_w / pw
+
+    pidx_h = jnp.arange(ph, dtype=jnp.float32)
+    pidx_w = jnp.arange(pw, dtype=jnp.float32)
+    hstart = jnp.floor(pidx_h[None] * bin_h[:, None]).astype(jnp.int32) \
+        + roi_start_h[:, None]                      # (R, PH)
+    hend = jnp.ceil((pidx_h[None] + 1) * bin_h[:, None]).astype(jnp.int32) \
+        + roi_start_h[:, None]
+    wstart = jnp.floor(pidx_w[None] * bin_w[:, None]).astype(jnp.int32) \
+        + roi_start_w[:, None]
+    wend = jnp.ceil((pidx_w[None] + 1) * bin_w[:, None]).astype(jnp.int32) \
+        + roi_start_w[:, None]
+    hstart = jnp.clip(hstart, 0, h)
+    hend = jnp.clip(hend, 0, h)
+    wstart = jnp.clip(wstart, 0, w)
+    wend = jnp.clip(wend, 0, w)
+
+    hs = jnp.arange(h)
+    ws = jnp.arange(w)
+    mask_h = (hs[None, None] >= hstart[..., None]) \
+        & (hs[None, None] < hend[..., None])        # (R, PH, H)
+    mask_w = (ws[None, None] >= wstart[..., None]) \
+        & (ws[None, None] < wend[..., None])        # (R, PW, W)
+
+    gathered = data[batch_ind]                      # (R, C, H, W)
+    neg = jnp.asarray(-jnp.inf, jnp.float32)
+    gf = gathered.astype(jnp.float32)
+    # reduce W:   (R,C,H,PW)
+    tw = jnp.max(jnp.where(mask_w[:, None, None], gf[:, :, :, None, :], neg),
+                 axis=-1)
+    # reduce H:   (R,C,PH,PW)
+    out = jnp.max(jnp.where(mask_h[:, None, :, :, None],
+                            tw[:, :, None], neg), axis=-2)
+    out = jnp.where(jnp.isfinite(out), out, 0.0)
+    return out.astype(data.dtype)
+
+
+@register_op("Correlation")
+def correlation(data1, data2, kernel_size=1, max_displacement=1, stride1=1,
+                stride2=1, pad_size=0, is_multiply=True):
+    """Reference: correlation.cc. FlowNet-style patch correlation; output
+    channel = displacement index over a (2r+1)^2 grid, r =
+    max_displacement//stride2; each value averages over kernel window and
+    input channels (sumelems = k*k*C)."""
+    n, c, h, w = data1.shape
+    k = kernel_size
+    kr = (k - 1) // 2
+    border = max_displacement + kr
+    ph_, pw_ = h + 2 * pad_size, w + 2 * pad_size
+    top_h = -(-(ph_ - 2 * border) // stride1)
+    top_w = -(-(pw_ - 2 * border) // stride1)
+    r = max_displacement // stride2
+    gw = 2 * r + 1
+
+    p1 = jnp.pad(data1, ((0, 0), (0, 0), (pad_size, pad_size),
+                         (pad_size, pad_size)))
+    p2 = jnp.pad(data2, ((0, 0), (0, 0), (pad_size, pad_size),
+                         (pad_size, pad_size)))
+    sumelems = k * k * c
+    outs = []
+    for di in range(-r, r + 1):
+        for dj in range(-r, r + 1):
+            s2p, s2o = di * stride2, dj * stride2
+            acc = 0.0
+            for hh in range(-kr, kr + 1):
+                for ww in range(-kr, kr + 1):
+                    a = jax.lax.dynamic_slice(
+                        p1, (0, 0, max_displacement + hh + kr,
+                             max_displacement + ww + kr),
+                        (n, c, ph_ - 2 * border, pw_ - 2 * border))
+                    b = jax.lax.dynamic_slice(
+                        p2, (0, 0, max_displacement + hh + kr + s2p,
+                             max_displacement + ww + kr + s2o),
+                        (n, c, ph_ - 2 * border, pw_ - 2 * border))
+                    acc = acc + (a * b if is_multiply else jnp.abs(a - b))
+            acc = jnp.sum(acc, axis=1) / sumelems  # (N, H', W')
+            outs.append(acc[:, ::stride1, ::stride1][:, :top_h, :top_w])
+    return jnp.stack(outs, axis=1)
+
+
+@register_op("DeformableConvolution")
+def deformable_convolution(data, offset, weight, bias=None, kernel=(3, 3),
+                           stride=(1, 1), pad=(0, 0), dilate=(1, 1),
+                           num_deformable_group=1, groups=1):
+    """Reference: contrib/deformable_convolution.cc (DCNv1).
+
+    offset (N, 2*k*k*G, Ho, Wo) gives per-output-position (dy, dx) for each
+    kernel tap. Implemented as k*k bilinear gathers (static unroll) + one
+    einsum contraction onto the MXU — no im2col buffer.
+    """
+    n, c, h, w = data.shape
+    kh, kw = kernel
+    sh, sw = stride
+    ph, pw = pad
+    dh, dw = dilate
+    ho = (h + 2 * ph - dh * (kh - 1) - 1) // sh + 1
+    wo = (w + 2 * pw - dw * (kw - 1) - 1) // sw + 1
+    g = num_deformable_group
+    cg = c // g
+
+    ys = jnp.arange(ho) * sh - ph
+    xs = jnp.arange(wo) * sw - pw
+    base_y, base_x = jnp.meshgrid(ys, xs, indexing="ij")  # (Ho, Wo)
+
+    cols = []  # per kernel tap: (N, C, Ho, Wo)
+    for ki in range(kh):
+        for kj in range(kw):
+            tap = ki * kw + kj
+            dy = offset[:, 2 * tap::2 * kh * kw]        # (N, G, Ho, Wo)
+            dx = offset[:, 2 * tap + 1::2 * kh * kw]
+            samples = []
+            for gi in range(g):
+                y_real = base_y[None] + ki * dh + dy[:, gi]
+                x_real = base_x[None] + kj * dw + dx[:, gi]
+                sub = data[:, gi * cg:(gi + 1) * cg]
+                samples.append(_bilinear_gather(
+                    sub, x_real.astype(jnp.float32),
+                    y_real.astype(jnp.float32)))
+            cols.append(jnp.concatenate(samples, axis=1))
+    col = jnp.stack(cols, axis=2)  # (N, C, k*k, Ho, Wo)
+    wmat = weight.reshape(weight.shape[0], weight.shape[1], kh * kw)
+    if groups == 1:
+        out = jnp.einsum("nckhw,ock->nohw", col, wmat)
+    else:
+        og = weight.shape[0] // groups
+        outs = []
+        for gi in range(groups):
+            outs.append(jnp.einsum(
+                "nckhw,ock->nohw",
+                col[:, gi * (c // groups):(gi + 1) * (c // groups)],
+                wmat[gi * og:(gi + 1) * og]))
+        out = jnp.concatenate(outs, axis=1)
+    if bias is not None:
+        out = out + bias.reshape(1, -1, 1, 1)
+    return out
+
+
+@register_op("Crop")
+def crop(data, crop_like=None, offset=(0, 0), h_w=(0, 0), center_crop=False):
+    """Reference: crop.cc (v1 op). Crop H/W either to `h_w` or to match
+    `crop_like`'s spatial shape; offset or center anchoring."""
+    _, _, h, w = data.shape
+    if crop_like is not None:
+        th, tw = crop_like.shape[2], crop_like.shape[3]
+    else:
+        th, tw = h_w
+    if center_crop:
+        oy, ox = (h - th) // 2, (w - tw) // 2
+    else:
+        oy, ox = offset
+    return data[:, :, oy:oy + th, ox:ox + tw]

@@ -1,0 +1,444 @@
+"""Numeric tests for the generated named-op corpus (VERDICT r1 #3).
+
+Samples every family: elemwise/broadcast, reductions with exclude, ordering,
+indexing (gather_nd/scatter_nd/ravel), legacy reshape codes, la_op linalg
+(potrf/gelqf/syrk/trsm/...), legacy vision ops (BilinearSampler,
+SpatialTransformer, GridGenerator, ROIPooling, Correlation,
+DeformableConvolution), loss-output ops with their reference backward
+quirks, and the CamelCase v1 surface. Reference behaviors cited per test.
+"""
+import numpy as onp
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd
+
+# an alias that matches reference test style
+np = onp
+
+
+def A(x, dtype="float32"):
+    return mx.np.array(onp.asarray(x, dtype=dtype))
+
+
+def test_registry_size():
+    from mxnet_tpu.ops.registry import list_ops
+
+    ops = list_ops()
+    assert len(ops) >= 200, len(ops)
+    # high-traffic names the VERDICT called out
+    for name in ["broadcast_add", "topk", "sort", "argsort", "take",
+                 "gather_nd", "scatter_nd", "linalg_potrf", "linalg_gelqf",
+                 "linalg_syrk", "linalg_trsm", "BilinearSampler",
+                 "SpatialTransformer", "ROIPooling", "DeformableConvolution",
+                 "GridGenerator", "Correlation", "sequence_mask",
+                 "Convolution", "FullyConnected", "SoftmaxOutput"]:
+        assert name in ops, name
+
+
+def test_nd_namespace_breadth():
+    names = [n for n in dir(nd) if not n.startswith("_")
+             and callable(getattr(nd, n))]
+    assert len(names) >= 250, len(names)
+    import mxnet_tpu.numpy_extension as npx
+
+    npx_names = [n for n in dir(npx) if not n.startswith("_")
+                 and callable(getattr(npx, n))]
+    assert len(set(names) | set(npx_names)) >= 300
+
+
+def test_unary_family():
+    x = A([[0.5, -1.5], [2.0, 0.25]])
+    onp.testing.assert_allclose(nd.rsqrt(A([4.0, 16.0])).asnumpy(),
+                                [0.5, 0.25], rtol=1e-6)
+    onp.testing.assert_allclose(nd.rcbrt(A([8.0])).asnumpy(), [0.5],
+                                rtol=1e-6)
+    onp.testing.assert_allclose(nd.reciprocal(x).asnumpy(),
+                                1.0 / x.asnumpy(), rtol=1e-6)
+    onp.testing.assert_allclose(
+        nd.gamma(A([4.0])).asnumpy(), [6.0], rtol=1e-5)
+    onp.testing.assert_allclose(
+        nd.logical_not(A([0.0, 2.0])).asnumpy(), [1.0, 0.0])
+    onp.testing.assert_allclose(
+        nd.hard_sigmoid(A([-10.0, 0.0, 10.0])).asnumpy(), [0.0, 0.5, 1.0])
+
+
+def test_broadcast_family():
+    a = A(onp.arange(6).reshape(2, 3))
+    b = A(onp.arange(3).reshape(1, 3) + 1.0)
+    onp.testing.assert_allclose(
+        nd.broadcast_add(a, b).asnumpy(), a.asnumpy() + b.asnumpy())
+    onp.testing.assert_allclose(
+        nd.broadcast_power(b, A([2.0])).asnumpy(), b.asnumpy() ** 2)
+    onp.testing.assert_allclose(
+        nd.broadcast_greater(a, A([[2.0, 2.0, 2.0]])).asnumpy(),
+        (a.asnumpy() > 2).astype("float32"))
+    onp.testing.assert_allclose(
+        nd.broadcast_hypot(A([3.0]), A([4.0])).asnumpy(), [5.0])
+    # comparison returns lhs dtype 0/1 values, not bool
+    assert nd.broadcast_equal(a, a).asnumpy().dtype == onp.float32
+
+
+def test_reduce_exclude():
+    # reference: broadcast_reduce_op exclude=True reduces the OTHER axes
+    x = A(onp.arange(24).reshape(2, 3, 4))
+    out = nd.sum(x, axis=1, exclude=True)
+    onp.testing.assert_allclose(out.asnumpy(),
+                                x.asnumpy().sum(axis=(0, 2)))
+    out = nd.max(x, axis=(0,), exclude=True, keepdims=True)
+    onp.testing.assert_allclose(out.asnumpy(),
+                                x.asnumpy().max(axis=(1, 2), keepdims=True))
+    # argmax returns float32 indices (reference quirk)
+    am = nd.argmax(A([[1.0, 3.0, 2.0]]), axis=1)
+    assert am.asnumpy().dtype == onp.float32
+    assert am.asnumpy()[0] == 1.0
+
+
+def test_ordering():
+    x = A([[3.0, 1.0, 2.0], [0.0, 5.0, 4.0]])
+    onp.testing.assert_allclose(nd.sort(x, axis=1).asnumpy(),
+                                onp.sort(x.asnumpy(), axis=1))
+    onp.testing.assert_allclose(
+        nd.sort(x, axis=1, is_ascend=False).asnumpy(),
+        -onp.sort(-x.asnumpy(), axis=1))
+    idx = nd.argsort(x, axis=1).asnumpy()
+    onp.testing.assert_allclose(idx, onp.argsort(x.asnumpy(), axis=1))
+    assert idx.dtype == onp.float32
+
+
+def test_indexing_family():
+    x = A(onp.arange(12).reshape(3, 4))
+    onp.testing.assert_allclose(
+        nd.take(x, A([0, 2], dtype="int32"), axis=0).asnumpy(),
+        x.asnumpy()[[0, 2]])
+    # clip mode clamps OOB indices (reference: indexing_op.cc)
+    onp.testing.assert_allclose(
+        nd.take(x, A([5], dtype="int32"), axis=0).asnumpy(),
+        x.asnumpy()[[2]])
+    onp.testing.assert_allclose(
+        nd.batch_take(x, A([1, 0, 3], dtype="int32")).asnumpy(),
+        [1.0, 4.0, 11.0])
+    # gather_nd / scatter_nd round trip
+    indices = A([[0, 1], [1, 2]], dtype="int32")  # (M=2, n=2) -> 2 picks
+    g = nd.gather_nd(x, indices)
+    onp.testing.assert_allclose(g.asnumpy(), [x.asnumpy()[0, 1],
+                                              x.asnumpy()[1, 2]])
+    s = nd.scatter_nd(g, indices, shape=(3, 4))
+    expect = onp.zeros((3, 4), "float32")
+    expect[0, 1] = x.asnumpy()[0, 1]
+    expect[1, 2] = x.asnumpy()[1, 2]
+    onp.testing.assert_allclose(s.asnumpy(), expect)
+    # ravel/unravel
+    r = nd.ravel_multi_index(A([[0, 1], [1, 2]], dtype="int64"),
+                             shape=(3, 4))
+    onp.testing.assert_allclose(r.asnumpy(), [1.0, 6.0])
+    u = nd.unravel_index(A([1, 6], dtype="int64"), shape=(3, 4))
+    onp.testing.assert_allclose(u.asnumpy(), [[0.0, 1.0], [1.0, 2.0]])
+
+
+def test_legacy_reshape_codes():
+    # reference: matrix_op-inl.h InferReshapeShape special codes
+    x = A(onp.arange(24).reshape(2, 3, 4))
+    assert nd.reshape(x, shape=(0, -1)).shape == (2, 12)
+    assert nd.reshape(x, shape=(0, -2)).shape == (2, 3, 4)
+    assert nd.reshape(x, shape=(-3, 0)).shape == (6, 4)
+    # doc example: (2,3,4) with (-4,1,2,-2) -> (1,2,3,4)
+    assert nd.reshape(x, shape=(-4, 1, 2, -2)).shape == (1, 2, 3, 4)
+    assert nd.reshape(x, shape=(-1,)).shape == (24,)
+
+
+def test_shape_family():
+    x = A(onp.arange(16).reshape(1, 4, 2, 2))
+    assert nd.depth_to_space(x, 2).shape == (1, 1, 4, 4)
+    onp.testing.assert_allclose(
+        nd.space_to_depth(nd.depth_to_space(x, 2), 2).asnumpy(), x.asnumpy())
+    assert nd.slice_axis(x, axis=1, begin=1, end=3).shape == (1, 2, 2, 2)
+    assert nd.slice(x, begin=(0, 1), end=(1, 3)).shape == (1, 2, 2, 2)
+    sliced = nd.slice_like(A(onp.ones((4, 4))), A(onp.ones((2, 3))))
+    assert sliced.shape == (2, 3)
+    assert nd.shape_array(x).asnumpy().tolist() == [1, 4, 2, 2]
+    assert nd.size_array(x).asnumpy().tolist() == [16]
+    p = nd.pad(A(onp.ones((1, 1, 2, 2))), mode="constant",
+               pad_width=(0, 0, 0, 0, 1, 1, 1, 1), constant_value=7.0)
+    assert p.shape == (1, 1, 4, 4)
+    assert p.asnumpy()[0, 0, 0, 0] == 7.0
+
+
+def test_linalg_family():
+    rng = onp.random.RandomState(0)
+    m = rng.randn(3, 3).astype("float32")
+    spd = m @ m.T + 3 * onp.eye(3, dtype="float32")
+    L = nd.linalg.potrf(A(spd))
+    onp.testing.assert_allclose(L.asnumpy() @ L.asnumpy().T, spd, rtol=1e-4,
+                                atol=1e-4)
+    # potri: inverse from the factor
+    inv = nd.linalg.potri(L)
+    onp.testing.assert_allclose(inv.asnumpy() @ spd, onp.eye(3), atol=1e-3)
+    # gemm: alpha*A@B + beta*C
+    a, b, c = rng.randn(2, 3), rng.randn(3, 4), rng.randn(2, 4)
+    out = nd.linalg.gemm(A(a), A(b), A(c), alpha=2.0, beta=0.5)
+    onp.testing.assert_allclose(out.asnumpy(), 2 * a @ b + 0.5 * c,
+                                rtol=1e-5)
+    out = nd.linalg.gemm2(A(a), A(b))
+    onp.testing.assert_allclose(out.asnumpy(), a @ b, rtol=1e-5)
+    # syrk
+    out = nd.linalg.syrk(A(a), alpha=1.5)
+    onp.testing.assert_allclose(out.asnumpy(), 1.5 * a @ a.T, rtol=1e-5)
+    # trsm solves op(A) X = alpha B
+    tri = onp.tril(spd)
+    x = rng.randn(3, 2).astype("float32")
+    bmat = tri @ x
+    out = nd.linalg.trsm(A(tri), A(bmat))
+    onp.testing.assert_allclose(out.asnumpy(), x, rtol=1e-3, atol=1e-3)
+    # trmm
+    out = nd.linalg.trmm(A(tri), A(x.T @ onp.eye(3, dtype="f")).T
+                         if False else A(onp.eye(3, dtype="f")), alpha=1.0)
+    onp.testing.assert_allclose(out.asnumpy(), tri, rtol=1e-5)
+    # gelqf: A = L Q, Q orthonormal rows
+    amat = rng.randn(2, 4).astype("float32")
+    Lq, Q = nd.linalg.gelqf(A(amat))
+    onp.testing.assert_allclose(Lq.asnumpy() @ Q.asnumpy(), amat, rtol=1e-4,
+                                atol=1e-4)
+    onp.testing.assert_allclose(Q.asnumpy() @ Q.asnumpy().T, onp.eye(2),
+                                atol=1e-5)
+    # sumlogdiag / extractdiag / makediag
+    onp.testing.assert_allclose(
+        nd.linalg.sumlogdiag(A(spd)).asnumpy(),
+        onp.sum(onp.log(onp.diag(spd))), rtol=1e-5)
+    d = nd.linalg.extractdiag(A(spd))
+    onp.testing.assert_allclose(d.asnumpy(), onp.diag(spd), rtol=1e-6)
+    md = nd.linalg.makediag(d)
+    onp.testing.assert_allclose(md.asnumpy(), onp.diag(onp.diag(spd)),
+                                rtol=1e-6)
+    # extracttrian / maketrian round trip
+    packed = nd.linalg.extracttrian(A(spd))
+    back = nd.linalg.maketrian(packed)
+    onp.testing.assert_allclose(back.asnumpy(), onp.tril(spd), rtol=1e-6)
+    # syevd
+    U, lam = nd.linalg.syevd(A(spd))
+    rec = U.asnumpy().T @ onp.diag(lam.asnumpy()) @ U.asnumpy()
+    onp.testing.assert_allclose(rec, spd, rtol=1e-3, atol=1e-3)
+
+
+def test_bilinear_sampler():
+    # identity grid reproduces the input (reference: bilinear_sampler.cc)
+    data = A(onp.random.RandomState(0).randn(2, 3, 5, 5))
+    ys, xs = onp.meshgrid(onp.linspace(-1, 1, 5), onp.linspace(-1, 1, 5),
+                          indexing="ij")
+    grid = onp.stack([xs, ys])[None].repeat(2, axis=0)
+    out = nd.BilinearSampler(data, A(grid))
+    onp.testing.assert_allclose(out.asnumpy(), data.asnumpy(), rtol=1e-5,
+                                atol=1e-5)
+    # grid entirely outside -> zeros
+    far = onp.full_like(grid, 5.0)
+    out = nd.BilinearSampler(data, A(far))
+    onp.testing.assert_allclose(out.asnumpy(), onp.zeros_like(data.asnumpy()))
+
+
+def test_grid_generator_and_spatial_transformer():
+    # identity affine = [1,0,0, 0,1,0]
+    theta = A([[1.0, 0, 0, 0, 1.0, 0]])
+    grid = nd.GridGenerator(theta, transform_type="affine",
+                            target_shape=(4, 6))
+    assert grid.shape == (1, 2, 4, 6)
+    onp.testing.assert_allclose(grid.asnumpy()[0, 0, 0],
+                                onp.linspace(-1, 1, 6), rtol=1e-5, atol=1e-6)
+    data = A(onp.random.RandomState(1).randn(1, 2, 4, 6))
+    out = nd.SpatialTransformer(data, theta, target_shape=(4, 6),
+                                transform_type="affine",
+                                sampler_type="bilinear")
+    onp.testing.assert_allclose(out.asnumpy(), data.asnumpy(), rtol=1e-4,
+                                atol=1e-5)
+    # warp mode: zero flow = identity grid in normalized coords
+    flow = A(onp.zeros((1, 2, 4, 6)))
+    wgrid = nd.GridGenerator(flow, transform_type="warp")
+    onp.testing.assert_allclose(wgrid.asnumpy()[0, 0, 0],
+                                onp.linspace(-1, 1, 6), rtol=1e-5, atol=1e-6)
+
+
+def test_roi_pooling():
+    # single ROI covering the full map with 1x1 bins = global max
+    data = A(onp.arange(16, dtype="float32").reshape(1, 1, 4, 4))
+    rois = A([[0, 0, 0, 3, 3]])
+    out = nd.ROIPooling(data, rois, pooled_size=(1, 1), spatial_scale=1.0)
+    assert out.shape == (1, 1, 1, 1)
+    assert out.asnumpy()[0, 0, 0, 0] == 15.0
+    # 2x2 bins over the 4x4 map: per-quadrant maxima
+    out = nd.ROIPooling(data, rois, pooled_size=(2, 2), spatial_scale=1.0)
+    onp.testing.assert_allclose(out.asnumpy()[0, 0], [[5.0, 7.0],
+                                                      [13.0, 15.0]])
+    # invalid batch index -> handled w/o crash (clipped gather)
+    out = nd.ROIPooling(data, A([[0, 2, 2, 1, 1]]), pooled_size=(2, 2),
+                        spatial_scale=1.0)
+    assert out.shape == (1, 1, 2, 2)
+
+
+def test_correlation():
+    # max_displacement=0, kernel=1: per-pixel dot over channels / C
+    rng = onp.random.RandomState(0)
+    a = rng.randn(1, 4, 6, 6).astype("float32")
+    b = rng.randn(1, 4, 6, 6).astype("float32")
+    out = nd.Correlation(A(a), A(b), kernel_size=1, max_displacement=0,
+                         stride1=1, stride2=1, pad_size=0, is_multiply=True)
+    assert out.shape == (1, 1, 6, 6)
+    onp.testing.assert_allclose(out.asnumpy()[0, 0],
+                                (a * b).mean(axis=1)[0], rtol=1e-5)
+    # with displacement the channel count is (2r+1)^2
+    out = nd.Correlation(A(a), A(b), kernel_size=1, max_displacement=1,
+                         stride1=1, stride2=1, pad_size=1, is_multiply=True)
+    assert out.shape[1] == 9
+
+
+def test_deformable_convolution():
+    # zero offsets reduce DCN to a standard convolution
+    rng = onp.random.RandomState(0)
+    x = rng.randn(1, 3, 5, 5).astype("float32")
+    w = rng.randn(4, 3, 3, 3).astype("float32")
+    off = onp.zeros((1, 18, 5, 5), "float32")
+    out = nd.DeformableConvolution(A(x), A(off), A(w), kernel=(3, 3),
+                                   pad=(1, 1))
+    ref = nd.Convolution(A(x), A(w), kernel=(3, 3), pad=(1, 1),
+                         num_filter=4, no_bias=True)
+    onp.testing.assert_allclose(out.asnumpy(), ref.asnumpy(), rtol=1e-3,
+                                atol=1e-3)
+
+
+def test_loss_output_backwards():
+    # SoftmaxOutput backward = (p - onehot) * grad_scale, ignoring upstream
+    from mxnet_tpu import autograd
+
+    x = A([[1.0, 2.0, 3.0], [1.0, 1.0, 1.0]])
+    x.attach_grad()
+    label = A([2, 0])
+    with autograd.record():
+        out = nd.SoftmaxOutput(x, label, grad_scale=2.0)
+    out.backward()
+    p = onp.exp(x.asnumpy()) / onp.exp(x.asnumpy()).sum(1, keepdims=True)
+    onehot = onp.eye(3, dtype="float32")[[2, 0]]
+    onp.testing.assert_allclose(x.grad.asnumpy(), 2.0 * (p - onehot),
+                                rtol=1e-4, atol=1e-5)
+
+    # MakeLoss backward = grad_scale everywhere
+    y = A([[1.0, -2.0]])
+    y.attach_grad()
+    with autograd.record():
+        out = nd.make_loss(y, grad_scale=3.0)
+    out.backward()
+    onp.testing.assert_allclose(y.grad.asnumpy(), [[3.0, 3.0]])
+
+    # BlockGrad kills the gradient
+    z = A([[1.0, 2.0]])
+    z.attach_grad()
+    with autograd.record():
+        out = (nd.BlockGrad(z) * z).sum()
+    out.backward()
+    onp.testing.assert_allclose(z.grad.asnumpy(), z.asnumpy())
+
+    # LinearRegressionOutput backward = (pred - label) * grad_scale
+    w = A([[1.0, 4.0]])
+    w.attach_grad()
+    lab = A([[0.0, 1.0]])
+    with autograd.record():
+        out = nd.LinearRegressionOutput(w, lab, grad_scale=1.0)
+    out.backward()
+    onp.testing.assert_allclose(w.grad.asnumpy(), [[1.0, 3.0]])
+
+    # MAERegression backward = sign(pred - label)
+    v = A([[1.0, -4.0]])
+    v.attach_grad()
+    with autograd.record():
+        out = nd.MAERegressionOutput(v, lab)
+    out.backward()
+    onp.testing.assert_allclose(v.grad.asnumpy(), [[1.0, -1.0]])
+
+
+def test_camelcase_v1_surface():
+    rng = onp.random.RandomState(0)
+    x = A(rng.randn(2, 3, 8, 8))
+    w = A(rng.randn(4, 3, 3, 3) * 0.1)
+    out = nd.Convolution(data=x, weight=w, kernel=(3, 3), num_filter=4,
+                         pad=(1, 1), no_bias=True)
+    assert out.shape == (2, 4, 8, 8)
+    out = nd.Pooling(out, kernel=(2, 2), pool_type="max", stride=(2, 2))
+    assert out.shape == (2, 4, 4, 4)
+    fc_w = A(rng.randn(10, 64) * 0.1)
+    out = nd.FullyConnected(out, fc_w, no_bias=True, num_hidden=10)
+    assert out.shape == (2, 10)
+    out = nd.SoftmaxActivation(out)
+    onp.testing.assert_allclose(out.asnumpy().sum(1), onp.ones(2), rtol=1e-5)
+    # SwapAxis/Flatten/Cast/SliceChannel
+    assert nd.SwapAxis(x, 1, 3).shape == (2, 8, 8, 3)
+    assert nd.Flatten(x).shape == (2, 192)
+    assert nd.Cast(x, "float16").asnumpy().dtype == onp.float16
+    parts = nd.SliceChannel(x, num_outputs=3, axis=1, squeeze_axis=True)
+    assert len(parts) == 3 and parts[0].shape == (2, 8, 8)
+    # Crop
+    assert nd.Crop(x, h_w=(4, 4), center_crop=True).shape == (2, 3, 4, 4)
+
+
+def test_sample_and_random_legacy():
+    out = nd.random_uniform(0.0, 1.0, shape=(3, 4))
+    assert out.shape == (3, 4)
+    assert (out.asnumpy() >= 0).all() and (out.asnumpy() < 1).all()
+    out = nd.random_normal(0.0, 1.0, shape=(100,))
+    assert abs(float(out.asnumpy().mean())) < 0.5
+    out = nd.sample_uniform(A([0.0, 10.0]), A([1.0, 11.0]), shape=3)
+    assert out.shape == (2, 3)
+    assert (out.asnumpy()[1] >= 10).all()
+    out = nd.random.generalized_negative_binomial(mu=2.0, alpha=0.5,
+                                                  shape=(50,))
+    assert out.shape == (50,)
+    assert (out.asnumpy() >= 0).all()
+    # exponential: nd.random.exponential takes SCALE; legacy op takes lam
+    big = nd.random.exponential(10.0, shape=(400,)).asnumpy().mean()
+    small = nd.random_exponential(10.0, shape=(400,)).asnumpy().mean()
+    assert big > 10 * small, (big, small)
+    # shuffle returns the permuted array
+    arr = A(onp.arange(10))
+    sh = nd.random.shuffle(arr)
+    assert sh is not None
+    assert sorted(sh.asnumpy().tolist()) == list(range(10))
+    # legacy categorical multinomial
+    probs = A([[0.0, 1.0, 0.0], [1.0, 0.0, 0.0]])
+    draws = nd.sample_multinomial(probs, shape=4)
+    assert draws.shape == (2, 4)
+    onp.testing.assert_allclose(draws.asnumpy()[0], onp.ones(4))
+    onp.testing.assert_allclose(draws.asnumpy()[1], onp.zeros(4))
+    d, logp = nd.random.multinomial(probs, shape=2, get_prob=True)
+    assert d.shape == (2, 2) and logp.shape == (2, 2)
+    onp.testing.assert_allclose(logp.asnumpy(), onp.zeros((2, 2)), atol=1e-5)
+    # legacy concat signature
+    c = nd.concat(A(onp.ones((2, 2))), A(onp.zeros((2, 2))), dim=1)
+    assert c.shape == (2, 4)
+
+
+def test_where_smooth_l1_khatri_rao():
+    cond = A([1.0, 0.0, 1.0])
+    onp.testing.assert_allclose(
+        nd.where(cond, A([1.0, 2.0, 3.0]), A([9.0, 9.0, 9.0])).asnumpy(),
+        [1.0, 9.0, 3.0])
+    # smooth_l1 with sigma=1: quadratic inside |x|<1
+    out = nd.smooth_l1(A([0.5, 2.0]), scalar=1.0)
+    onp.testing.assert_allclose(out.asnumpy(), [0.125, 1.5], rtol=1e-6)
+    a = A([[1.0, 2.0], [3.0, 4.0]])
+    b = A([[1.0, 1.0], [2.0, 0.0]])
+    kr = nd.khatri_rao(a, b)
+    assert kr.shape == (4, 2)
+    onp.testing.assert_allclose(kr.asnumpy()[0], [1.0, 2.0])
+
+
+def test_npx_extras():
+    import mxnet_tpu.numpy_extension as npx
+
+    x = mx.np.array(onp.arange(12, dtype="float32").reshape(3, 4))
+    assert npx.batch_flatten(x).shape == (3, 4)
+    y = mx.np.array(onp.arange(24, dtype="float32").reshape(2, 3, 4))
+    assert npx.batch_flatten(y).shape == (2, 12)
+    # npx code table (np_matrix_op.cc): -2 copy dim, -1 infer, -5 merge two
+    assert npx.reshape(y, (-2, -1)).shape == (2, 12)
+    assert npx.reshape(y, (-5, -2)).shape == (6, 4)
+    assert npx.reshape(y, (-6, 1, 2, -2, -2)).shape == (1, 2, 3, 4)
+    # registry ops reachable from npx
+    out = npx.topk(y, k=2, axis=-1, ret_typ="value")
+    assert out.shape == (2, 3, 2)
+    assert npx.gather_nd is not None and npx.linalg_potrf is not None
