@@ -1,0 +1,121 @@
+"""Data-parallel scaling-efficiency harness (VERDICT r1 #2).
+
+Real weak scaling needs N real chips; on a 1-core host the 8 virtual CPU
+devices SERIALIZE, so wall-clock "speedup" is meaningless (replicated
+optimizer updates alone are N-fold duplicated work run sequentially). The
+harness therefore reports the hardware-independent quantity XLA's cost
+model exposes for the partitioned SPMD module:
+
+    partition_efficiency = (flops_1dev / N) / flops_per_device_Ndev
+
+i.e. how close the GSPMD partitioner gets to ideal 1/N per-chip compute for
+the SAME global train step. On real chips weak-scaling efficiency =
+partition_efficiency x collective_overlap; the first factor is measured
+here, the second is bounded by the all-reduce bytes also reported
+(tools/bandwidth.py measures ICI rates on hardware).
+
+Emits one JSON line and writes SCALING.json at the repo root.
+"""
+from __future__ import annotations
+
+import json
+import os
+import pathlib
+import time
+
+N_DEV = int(os.environ.get("SCALING_DEVICES", "8"))
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+flags = os.environ.get("XLA_FLAGS", "")
+if "host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + f" --xla_force_host_platform_device_count={N_DEV}").strip()
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+import jax.numpy as jnp  # noqa: E402
+import numpy as np  # noqa: E402
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P  # noqa: E402
+
+BATCH = 1024
+HID = 1024
+STEPS = 3
+
+
+def make_step():
+    def loss_fn(params, x, y):
+        h = x
+        for w, b in params[:-1]:
+            h = jax.nn.relu(h @ w + b)
+        w, b = params[-1]
+        logits = h @ w + b
+        logp = jax.nn.log_softmax(logits)
+        return -jnp.take_along_axis(logp, y[:, None], axis=1).mean()
+
+    def step(params, x, y):
+        loss, grads = jax.value_and_grad(loss_fn)(params, x, y)
+        new = [(w - 0.1 * gw, b - 0.1 * gb)
+               for (w, b), (gw, gb) in zip(params, grads)]
+        return new, loss
+
+    return step
+
+
+def timed(compiled, params, x, y):
+    p, loss = compiled(params, x, y)
+    loss.block_until_ready()
+    t0 = time.perf_counter()
+    for _ in range(STEPS):
+        p, loss = compiled(p, x, y)
+    loss.block_until_ready()
+    return (time.perf_counter() - t0) / STEPS
+
+
+def main():
+    rng = np.random.RandomState(0)
+    dims = [(784, HID), (HID, HID), (HID, 10)]
+    params = [(jnp.asarray(rng.randn(i, o).astype("f") * 0.05),
+               jnp.zeros(o, "f")) for i, o in dims]
+    x = jnp.asarray(rng.rand(BATCH, 784).astype("f"))
+    y = jnp.asarray(rng.randint(0, 10, (BATCH,)))
+    step = make_step()
+
+    c1 = jax.jit(step).lower(params, x, y).compile()
+    flops1 = float(c1.cost_analysis()["flops"])
+
+    mesh = Mesh(np.array(jax.devices()[:N_DEV]), ("dp",))
+    repl = NamedSharding(mesh, P())
+    bsh = NamedSharding(mesh, P("dp"))
+    cn = jax.jit(step, in_shardings=(repl, bsh, bsh),
+                 out_shardings=(repl, repl)).lower(params, x, y).compile()
+    flops_n = float(cn.cost_analysis()["flops"])  # per-device SPMD module
+
+    eff = (flops1 / N_DEV) / flops_n
+    t1 = timed(c1, params, x, y)
+    pn = jax.device_put(params, repl)
+    tn = timed(cn, pn, jax.device_put(x, bsh), jax.device_put(y, bsh))
+
+    n_params = sum(int(np.prod(w.shape)) + int(np.prod(b.shape))
+                   for w, b in params)
+    result = {
+        "metric": f"gspmd_dp{N_DEV}_partition_efficiency",
+        "value": round(eff, 4),
+        "unit": "ratio",
+        "flops_1dev": flops1,
+        "flops_per_device_sharded": flops_n,
+        "allreduce_bytes_per_step": 4 * n_params,
+        "wallclock_1dev_ms": round(t1 * 1e3, 2),
+        "wallclock_sharded_ms_1core_serialized": round(tn * 1e3, 2),
+        "devices": N_DEV,
+        "note": "per-device FLOPs of the partitioned train step vs ideal "
+                "1/N (XLA cost model); wall-clock rows are informational "
+                "only — the N virtual devices share one physical core",
+    }
+    print(json.dumps(result))
+    out = pathlib.Path(__file__).resolve().parent.parent / "SCALING.json"
+    out.write_text(json.dumps(result, indent=1))
+
+
+if __name__ == "__main__":
+    main()

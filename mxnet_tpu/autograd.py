@@ -142,15 +142,24 @@ class TapeNode:
         "out_avals",
         "multi_out",
         "name",
+        "pure_fn",
+        "input_datas",
     )
 
-    def __init__(self, vjp_fn, inputs, input_entries, out_avals, multi_out, name):
+    def __init__(self, vjp_fn, inputs, input_entries, out_avals, multi_out,
+                 name, pure_fn=None, input_datas=None):
         self.vjp_fn = vjp_fn
         self.inputs = inputs
         self.input_entries = input_entries
         self.out_avals = out_avals  # list of (shape, dtype)
         self.multi_out = multi_out
         self.name = name
+        # for higher-order grad (create_graph): the pure jax function this
+        # node executed plus snapshots of its array inputs, so the tape can
+        # be replayed symbolically (jax arrays are immutable — these are
+        # references, not copies)
+        self.pure_fn = pure_fn
+        self.input_datas = input_datas
 
 
 def _zero_cotangent(shape, dtype):
@@ -290,19 +299,89 @@ def backward(heads, head_grads=None, retain_graph=False, train_mode=True):  # no
         gradbuf._version += 1
 
 
+def _tape_function(heads, variables):
+    """Lift the recorded tape into a pure function var_datas -> head_datas.
+
+    The functional analog of the reference building a backward NNVM graph
+    (src/nnvm/gradient.cc): every reachable TapeNode is replayed through its
+    stored pure_fn, with the requested `variables` promoted to function
+    arguments and every other leaf bound to its recorded snapshot.
+    """
+    var_ids = {id(v): k for k, v in enumerate(variables)}
+    head_entries = [h._tape_entry for h in heads]
+    for h, ent in zip(heads, head_entries):
+        if ent is None and id(h) not in var_ids:
+            raise ValueError("backward head was not recorded on the tape")
+    order = _collect_graph(head_entries)
+    for node in order:
+        if node.pure_fn is None:
+            raise NotImplementedError(
+                f"create_graph=True cannot replay tape node '{node.name}' "
+                "(custom Function / CachedOp nodes store no pure function); "
+                "run the forward un-hybridized for higher-order grad")
+
+    def replay(*var_datas):
+        env = {}
+        for node in order:
+            args = []
+            for pos, (var, ent) in enumerate(
+                    zip(node.inputs, node.input_entries)):
+                if ent is not None:
+                    pn, pi = ent
+                    args.append(env[id(pn)][pi])
+                elif var is not None and id(var) in var_ids:
+                    args.append(var_datas[var_ids[id(var)]])
+                else:
+                    args.append(node.input_datas[pos])
+            out = node.pure_fn(*args)
+            env[id(node)] = list(out) if node.multi_out else [out]
+        res = []
+        for h, ent in zip(heads, head_entries):
+            if ent is None:
+                res.append(var_datas[var_ids[id(h)]])
+            else:
+                n, i = ent
+                res.append(env[id(n)][i])
+        return tuple(res)
+
+    return replay
+
+
 def grad(heads, variables, head_grads=None, retain_graph=None, create_graph=False,
          train_mode=True):  # noqa: ARG001
     """Return gradients of heads w.r.t. variables instead of writing .grad.
 
-    Reference: python/mxnet/autograd.py:grad. create_graph (higher-order) is
-    not yet supported — documented limitation for this round.
+    Reference: python/mxnet/autograd.py:grad. With create_graph=True the
+    gradient computation itself is recorded, so grads of grads work: the
+    tape is replayed as a pure jax function and its jax.vjp runs through
+    apply_op like any other op.
     """
     from .ndarray.ndarray import NDArray
 
-    if create_graph:
-        raise NotImplementedError("create_graph=True (higher-order grad) TBD")
     if isinstance(variables, NDArray):
         variables = [variables]
+    if create_graph:
+        from .ndarray.ndarray import apply_op
+
+        if isinstance(heads, NDArray):
+            heads = [heads]
+        replay = _tape_function(heads, variables)
+        nv = len(variables)
+        if head_grads is None:
+            seeds = [h.ones_like() for h in heads]
+        elif isinstance(head_grads, NDArray):
+            seeds = [head_grads]
+        else:
+            seeds = list(head_grads)
+
+        def pure_grads(*args):
+            vd = args[:nv]
+            sd = args[nv:]
+            _, pull = jax.vjp(replay, *vd)
+            return pull(tuple(sd))
+
+        out = apply_op(pure_grads, *variables, *seeds, name="grad")
+        return list(out) if isinstance(out, (tuple, list)) else [out]
     saved = [(v._grad, v._grad_req) for v in variables]
     zeros = []
     for v in variables:

@@ -76,15 +76,19 @@ class Device:
         run), fall back to the default backend's devices so code written for
         TPU runs anywhere; warn once per platform.
         """
+        # NB: local_devices, not jax.devices() — under jax.distributed the
+        # global list spans all processes and devices of other ranks are
+        # non-addressable; mx.cpu(0)/mx.tpu(0) always mean THIS process's
+        # devices (the reference's per-worker ctx semantics).
         dt = self.device_type
         if dt in _ACCEL_TYPES:
             try:
-                devs = jax.devices(dt if dt == "tpu" else "tpu")
+                devs = jax.local_devices(backend="tpu")
             except RuntimeError:
                 devs = None
             if not devs:
                 try:
-                    devs = jax.devices("gpu")
+                    devs = jax.local_devices(backend="gpu")
                 except RuntimeError:
                     devs = None
             if not devs:
@@ -95,9 +99,9 @@ class Device:
                         f"default backend '{jax.default_backend()}'",
                         stacklevel=2,
                     )
-                devs = jax.devices()
+                devs = jax.local_devices()
         else:
-            devs = jax.devices(dt)
+            devs = jax.local_devices(backend=dt)
         return devs[self.device_id % len(devs)]
 
     # -- default-device stack --------------------------------------------

@@ -22,13 +22,39 @@ costs, so it is opt-in exactly like the reference.
 """
 from __future__ import annotations
 
+import os
+
 import jax
 import jax.numpy as jnp
 
 from ..ndarray.ndarray import NDArray, _wrap_out
 from .base import KVStoreBase
 
-__all__ = ["TPUDist"]
+__all__ = ["TPUDist", "init_distributed_from_env"]
+
+_dist_initialized = False
+
+
+def init_distributed_from_env():
+    """Wire this process into the jax.distributed job described by the
+    tools/launch.py env (JAX_COORDINATOR_ADDRESS / JAX_NUM_PROCESSES /
+    JAX_PROCESS_ID) — the analog of the reference workers connecting to the
+    dmlc tracker (tools/launch.py:72-117). No-op when not launched
+    distributed or already initialized."""
+    global _dist_initialized
+    if _dist_initialized:
+        return
+    n = int(os.environ.get("JAX_NUM_PROCESSES", "1"))
+    if n <= 1:
+        return
+    coordinator = os.environ.get("JAX_COORDINATOR_ADDRESS")
+    if not coordinator:
+        return
+    jax.distributed.initialize(
+        coordinator_address=coordinator,
+        num_processes=n,
+        process_id=int(os.environ["JAX_PROCESS_ID"]))
+    _dist_initialized = True
 
 
 def _aslist(x):
@@ -39,9 +65,15 @@ class TPUDist(KVStoreBase):
     """kvstore='tpu_dist': allreduce over every device in the process/slice."""
 
     def __init__(self, devices=None):
+        init_distributed_from_env()
         self._devices = devices  # optional explicit jax device list
         self._optimizer = None
         self._sum_cache = {}
+        if self.num_workers > 1:
+            # establish the cross-process collective context NOW, while rank
+            # skew is minimal — later pushpulls may be separated by long
+            # per-rank compiles that would trip gloo's init timeout
+            self._cross_process_sum(jnp.zeros((1,), jnp.float32))
 
     # -- topology ----------------------------------------------------------
     @property
@@ -78,11 +110,20 @@ class TPUDist(KVStoreBase):
         return fn
 
     def pushpull(self, key, value, out=None, priority=0):  # noqa: ARG002
-        """Sum `value` copies across devices, write result to `out` on each.
+        """Sum `value` copies across device copies AND processes, write the
+        result to `out` with each out's own sharding preserved.
 
-        Per-device NDArray copies in, reduced result broadcast back out —
-        the exact contract of KVStoreDist::PushPullImpl (kvstore_dist.h:218),
-        minus the server round-trip.
+        Three regimes, all behind the one KVStoreDist::PushPullImpl contract
+        (kvstore_dist.h:218):
+          * one global mesh-sharded jax.Array: eager SPMD already produced
+            the globally-reduced gradient (XLA inserted the psum during the
+            backward) — this is a sharding-preserving no-op;
+          * several per-device copies (legacy multi-copy layout): jitted
+            add-tree reduce, then broadcast back to each copy's sharding;
+          * multiple processes (after jax.distributed.initialize, the
+            tools/launch.py path): cross-process sum via process_allgather —
+            the eager-mode DCN staged reduce; inside jit the GSPMD step is
+            the fast path.
         """
         keys = _aslist(key)
         if len(keys) != 1:
@@ -100,19 +141,42 @@ class TPUDist(KVStoreBase):
             dev = next(iter(vals[0]._data.devices()))
             datas = [jax.device_put(v._data, dev) for v in vals]
             total_data = self._tree_sum(len(datas))(*datas)
+        if self.num_workers > 1:
+            total_data = self._cross_process_sum(total_data)
         if out is None:
             return
         outs = _aslist(out)
         for o in outs:
-            o._data = jax.device_put(total_data, next(iter(o._data.devices())))
+            o._data = self._put_like(total_data, o._data)
             o._version += 1
+
+    @staticmethod
+    def _put_like(data, like):
+        """Lay `data` out with `like`'s sharding (never collapses a mesh-
+        sharded array onto one device)."""
+        sh = getattr(like, "sharding", None)
+        if sh is not None and getattr(data, "sharding", None) == sh:
+            return data
+        return jax.device_put(data, sh) if sh is not None else data
+
+    def _cross_process_sum(self, x):
+        """Eager cross-process allreduce (multi-host eager mode only)."""
+        from jax.experimental import multihost_utils
+
+        gathered = multihost_utils.process_allgather(x)
+        return jnp.sum(jnp.asarray(gathered), axis=0)
 
     def broadcast(self, key, value, out, priority=0):  # noqa: ARG002
         vals = _aslist(value)
         outs = _aslist(out)
         src = vals[0]._data
+        if self.num_workers > 1:
+            from jax.experimental import multihost_utils
+
+            src = jnp.asarray(
+                multihost_utils.broadcast_one_to_all(src))
         for o in outs:
-            o._data = jax.device_put(src, next(iter(o._data.devices())))
+            o._data = self._put_like(src, o._data)
             o._version += 1
 
     # -- mesh-sharded fast path -------------------------------------------

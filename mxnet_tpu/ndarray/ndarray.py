@@ -583,6 +583,8 @@ def apply_op(fn, *args, name=None):
             [(tuple(o.shape), o.dtype) for o in outs],
             multi_out=multi,
             name=name or getattr(fn, "__name__", "op"),
+            pure_fn=pure,
+            input_datas=datas,
         )
         for idx, w in enumerate(wrapped):
             w._tape_entry = (node, idx)

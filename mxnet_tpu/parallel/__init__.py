@@ -15,6 +15,6 @@ Modules:
 """
 from . import collectives, mesh, ring_attention, ulysses  # noqa: F401
 from .data_parallel import make_data_parallel_step  # noqa: F401
-from .mesh import make_mesh  # noqa: F401
+from .mesh import make_mesh, shard_batch, shard_params  # noqa: F401
 from .ring_attention import ring_attention_sharded  # noqa: F401
 from .ulysses import ulysses_attention_sharded  # noqa: F401

@@ -56,3 +56,41 @@ def data_parallel_mesh(devices=None):
 def local_mesh(axes=None):
     """Mesh over this process's local devices only."""
     return make_mesh(axes or {"dp": -1}, jax.local_devices())
+
+
+def shard_params(params, mesh, spec_fn=None):
+    """Lay Gluon Parameters (dict name->Parameter) out on a device mesh.
+
+    Default: replicated (pure data parallelism). `spec_fn(name, shape)` may
+    return a PartitionSpec to tensor-shard individual params. Grad buffers
+    follow their parameter's sharding. This is the user-level mesh entry of
+    the kvstore='tpu_dist' path: after this, eager ops and CachedOp jits
+    compute with GSPMD semantics and XLA inserts the gradient all-reduce
+    during backward (subsuming the reference's push/pull round trip).
+    """
+    for name, p in params.items():
+        if p._data_map is None:
+            raise ValueError(f"parameter {name} is not initialized")
+        spec = spec_fn(name, p.shape) if spec_fn is not None else \
+            PartitionSpec()
+        sh = NamedSharding(mesh, spec)
+        for arr in p._data_map.values():
+            arr._data = jax.device_put(arr._data, sh)
+            arr._version += 1
+            if arr._grad is not None:
+                arr._grad._data = jax.device_put(arr._grad._data, sh)
+                arr._grad._version += 1
+
+
+def shard_batch(x, mesh, axis="dp"):
+    """Shard an input batch over a mesh axis (leading dim). Accepts NDArray
+    or raw array; returns the same kind."""
+    spec = PartitionSpec(axis)
+    sh = NamedSharding(mesh, spec)
+    from ..ndarray.ndarray import NDArray
+
+    if isinstance(x, NDArray):
+        x._data = jax.device_put(x._data, sh)
+        x._version += 1
+        return x
+    return jax.device_put(x, sh)

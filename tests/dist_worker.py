@@ -1,0 +1,72 @@
+"""Worker body for the multi-process distributed training test
+(reference pattern: tests/nightly/dist_sync_kvstore.py — each worker trains
+on its own shard, gradients allreduce through the kvstore, and the test
+asserts numeric agreement across ranks).
+
+Launched by tools/launch.py; writes this rank's final params to
+<outdir>/params_rank<r>.npz.
+"""
+import os
+import sys
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as onp  # noqa: E402
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+from mxnet_tpu.kvstore.tpu_dist import init_distributed_from_env  # noqa: E402
+
+init_distributed_from_env()  # must precede any XLA backend use
+
+import mxnet_tpu as mx  # noqa: E402
+from mxnet_tpu import autograd, gluon  # noqa: E402
+
+OUTDIR = sys.argv[1]
+GLOBAL_BATCH = 16
+STEPS = 3
+
+
+def main():
+    mx.seed(0)
+    net = gluon.nn.HybridSequential()
+    net.add(gluon.nn.Dense(32, activation="relu"),
+            gluon.nn.Dense(8))
+    net.initialize()
+    x_all = onp.random.RandomState(0).rand(GLOBAL_BATCH, 12).astype("f")
+    y_all = onp.random.RandomState(1).randint(0, 8, (GLOBAL_BATCH,))
+    net(mx.np.array(x_all[:2]))
+
+    trainer = gluon.Trainer(net.collect_params(), "sgd",
+                            {"learning_rate": 0.5},
+                            kvstore="tpu_dist")
+    kv = trainer._kvstore
+    rank, nw = kv.rank, kv.num_workers
+    shard = GLOBAL_BATCH // nw
+    x = mx.np.array(x_all[rank * shard:(rank + 1) * shard])
+    y = mx.np.array(y_all[rank * shard:(rank + 1) * shard])
+
+    lossfn = gluon.loss.SoftmaxCrossEntropyLoss()
+    for _ in range(STEPS):
+        with autograd.record():
+            loss = lossfn(net(x), y)
+        loss.backward()
+        # local grads are per-shard sums; pushpull sums them across workers,
+        # step(GLOBAL_BATCH) rescales by the global batch -> identical to
+        # one process training on the concatenated batch
+        trainer.step(GLOBAL_BATCH)
+
+    params = {n: p.data().asnumpy()
+              for n, p in net.collect_params().items()}
+    onp.savez(os.path.join(OUTDIR, f"params_rank{rank}.npz"), **params)
+    print(f"rank {rank}/{nw} done, loss={float(loss.mean().asnumpy()):.5f}",
+          flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -3,7 +3,7 @@ import numpy as onp
 import pytest
 
 import mxnet_tpu as mx
-from mxnet_tpu import autograd, np
+from mxnet_tpu import autograd, nd, np
 from mxnet_tpu.test_utils import assert_almost_equal, check_numeric_gradient
 
 
@@ -201,3 +201,64 @@ def test_grad_through_inplace_read():
     x += 100  # mutate after recording
     y.backward()
     assert_almost_equal(x.grad, onp.array([2.0, 2.0]))
+
+
+def test_higher_order_grad():
+    """create_graph=True: grads of grads (reference autograd.py grad)."""
+    import numpy as onp
+
+    x = mx.np.array(onp.array([1.0, 2.0, 3.0], "f"))
+    x.attach_grad()
+    with autograd.record():
+        y = x * x * x
+        gx = autograd.grad(y, x, create_graph=True)[0]
+        z = (gx * gx).sum()  # d/dx sum((3x^2)^2) = 36 x^3
+    z.backward()
+    onp.testing.assert_allclose(x.grad.asnumpy(),
+                                36 * onp.array([1.0, 8.0, 27.0]), rtol=1e-5)
+
+
+def test_second_derivative_matches_numeric():
+    import numpy as onp
+
+    def f(v):
+        return float((mx.np.array([v]) * mx.np.array([v])
+                      * mx.np.array([v])).asnumpy()[0])
+
+    x = mx.np.array(onp.array([1.7], "f"))
+    x.attach_grad()
+    with autograd.record():
+        y = x * x * x
+        g1 = autograd.grad(y, x, create_graph=True)[0]
+        g2 = autograd.grad(g1, x, create_graph=True)[0]
+    # numeric second derivative of x^3 at 1.7
+    eps = 1e-2
+    num = (f(1.7 + eps) - 2 * f(1.7) + f(1.7 - eps)) / eps**2
+    onp.testing.assert_allclose(g2.asnumpy()[0], num, rtol=1e-2)
+    onp.testing.assert_allclose(g2.asnumpy()[0], 6 * 1.7, rtol=1e-4)
+
+
+def test_higher_order_through_nd_ops():
+    """Gradient penalty pattern: ||d(loss)/dx||^2 trained via backward."""
+    import numpy as onp
+
+    w = mx.np.array(onp.array([[0.5, -0.3], [0.2, 0.1]], "f"))
+    w.attach_grad()
+    x = mx.np.array(onp.array([[1.0, 2.0]], "f"))
+    with autograd.record():
+        h = nd.dot(x, w)
+        loss = (h * h).sum()
+        gw = autograd.grad(loss, w, create_graph=True)[0]
+        penalty = (gw * gw).sum()
+    penalty.backward()
+    assert w.grad is not None
+    # analytic check via jax
+    import jax
+    import jax.numpy as jnp
+
+    def pen(wv):
+        g = jax.grad(lambda ww: jnp.sum(jnp.dot(x.asnumpy(), ww) ** 2))(wv)
+        return jnp.sum(g * g)
+
+    expect = jax.grad(pen)(w.asnumpy())
+    onp.testing.assert_allclose(w.grad.asnumpy(), expect, rtol=1e-4)

@@ -1,0 +1,62 @@
+"""Multi-process distributed training test (VERDICT r1 #4).
+
+tools/launch.py -n 2 spawns ranked workers; each calls
+jax.distributed.initialize() (via the kvstore env auto-init), trains on its
+own data shard with kvstore='tpu_dist', and saves final params. The test
+asserts (a) both ranks end bit-identical and (b) the result matches a
+single-process run over the full batch — the reference's numeric-assertion
+pattern from tests/nightly/dist_sync_kvstore.py.
+"""
+import os
+import subprocess
+import sys
+
+import numpy as onp
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+WORKER = os.path.join(REPO, "tests", "dist_worker.py")
+
+ENV = {k: v for k, v in os.environ.items()
+       if k not in ("JAX_NUM_PROCESSES", "JAX_PROCESS_ID",
+                    "JAX_COORDINATOR_ADDRESS")}
+ENV["PYTHONPATH"] = REPO + os.pathsep + ENV.get("PYTHONPATH", "")
+ENV["JAX_PLATFORMS"] = "cpu"
+# workers must not inherit the 8-virtual-device flag (1 device per proc)
+ENV["XLA_FLAGS"] = ""
+
+
+def _single_process_reference(tmp_path):
+    """Same training loop, one process, full batch."""
+    script = os.path.join(REPO, "tests", "dist_worker.py")
+    env = dict(ENV)
+    out = subprocess.run(
+        [sys.executable, script, str(tmp_path)],
+        env=env, capture_output=True, text=True, timeout=300)
+    assert out.returncode == 0, out.stderr[-2000:]
+    return dict(onp.load(os.path.join(tmp_path, "params_rank0.npz")))
+
+
+def test_two_process_training_matches_single(tmp_path):
+    two = tmp_path / "two"
+    one = tmp_path / "one"
+    two.mkdir()
+    one.mkdir()
+    rc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "launch.py"),
+         "-n", "2", sys.executable, WORKER, str(two)],
+        env=ENV, capture_output=True, text=True, timeout=600)
+    assert rc.returncode == 0, (rc.stdout[-2000:], rc.stderr[-2000:])
+
+    p0 = dict(onp.load(two / "params_rank0.npz"))
+    p1 = dict(onp.load(two / "params_rank1.npz"))
+    assert p0.keys() == p1.keys() and len(p0) >= 4
+    for k in p0:
+        onp.testing.assert_array_equal(
+            p0[k], p1[k],
+            err_msg=f"param {k} differs across ranks after allreduce")
+
+    ref = _single_process_reference(one)
+    for k in p0:
+        onp.testing.assert_allclose(
+            p0[k], ref[k], rtol=1e-5, atol=1e-6,
+            err_msg=f"2-worker result diverges from single-process for {k}")
