@@ -1,12 +1,20 @@
 """Headline benchmark: ResNet-50 training throughput (images/sec/chip).
 
 Prints ONE JSON line:
-  {"metric": ..., "value": N, "unit": "img/s", "vs_baseline": N}
+  {"metric": ..., "value": N, "unit": "img/s", "vs_baseline": N, "rows": [...]}
 
-Baseline: the reference's best published single-GPU training number —
-ResNet-50 fp32 b=128 at 363.69 img/s on 1x V100 (BASELINE.md,
-docs perf.md:243-253). We train in bf16 (TPU-native dtype, the AMP
-policy's default) with the same global batch on one chip.
+The headline metric is ResNet-50 bf16 training throughput; `rows` carries the
+remaining BASELINE.md configs (inference img/s, LeNet imperative, BERT-base
+bf16 fine-tune, INT8-vs-fp32 agreement) measured in the same run.
+
+Baselines (reference's best published single-GPU numbers, BASELINE.md /
+docs perf.md:173-253): training fp32 b=128 363.69 img/s; inference fp16
+b=128 2355.04 img/s on 1x V100. We train in bf16 (TPU-native dtype, the
+AMP policy's default).
+
+Layout: channels-last NHWC (C rides the MXU lane dim; measured faster than
+NCHW on v5e — see docs in gluon/nn/conv_layers.py). Override with
+MXTPU_BENCH_LAYOUT=NCHW / MXTPU_BENCH_BATCH=N for experiments.
 
 Run on the TPU chip by default; falls back to CPU (honest, slow) if the
 chip is unreachable so the driver always gets a JSON line.
@@ -15,11 +23,12 @@ from __future__ import annotations
 
 import json
 import math
+import os
 import sys
 import time
 
-BASELINE_IMG_S = 363.69  # V100 fp32 b=128 training (perf.md:243-253)
-BATCH = 128
+BASELINE_TRAIN_IMG_S = 363.69   # V100 fp32 b=128 training (perf.md:243-253)
+BASELINE_INFER_IMG_S = 2355.04  # V100 fp16 b=128 inference (perf.md:198-213)
 WARMUP = 3
 ITERS = 30  # enough steps to amortize the tunnel's ~70ms sync round-trip
 
@@ -41,41 +50,42 @@ def _probe_accelerator(timeout=90):
     return None
 
 
-def main():
+def _timeit(fn, sync, iters, warmup):
+    """Time fn() iters times; sync() must host-fetch to truly barrier
+    (block_until_ready is a no-op over the axon tunnel)."""
+    for _ in range(warmup):
+        out = fn()
+    sync(out)
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        out = fn()
+    sync(out)
+    return time.perf_counter() - t0, out
+
+
+def bench_resnet_train(platform, layout, batch, iters, warmup):
     import jax
     import jax.numpy as jnp
 
-    platform = _probe_accelerator()
-    if platform is None or platform == "cpu":
-        print("accelerator unreachable; falling back to CPU",
-              file=sys.stderr)
-        jax.config.update("jax_platforms", "cpu")
-        platform = "cpu"
-    dev = jax.devices()[0]
-
-    batch = BATCH if platform != "cpu" else 4
-    iters = ITERS if platform != "cpu" else 1
-    warmup = WARMUP if platform != "cpu" else 1
-
     import mxnet_tpu as mx
+    from mxnet_tpu import amp
     from mxnet_tpu.gluon.model_zoo.vision import resnet50_v1
 
     mx.seed(0)
-    net = resnet50_v1(classes=1000)
+    net = resnet50_v1(classes=1000, layout=layout)
     net.initialize()
-    # bf16 params via the AMP policy (norm params stay fp32)
-    from mxnet_tpu import amp
-
     amp.convert_hybrid_block(net, target_dtype="bfloat16")
 
-    # warm the deferred shapes with one tiny eager pass
-    net(mx.np.ones((2, 3, 224, 224), dtype="bfloat16"))
+    shape = ((2, 3, 224, 224) if layout == "NCHW" else (2, 224, 224, 3))
+    net(mx.np.ones(shape, dtype="bfloat16"))
 
     fwd, params = net.as_pure_function(training=True)
     trainable = set(net.trainable_param_names())
 
     rng = jax.random.PRNGKey(0)
-    x = jax.random.normal(rng, (batch, 3, 224, 224), jnp.bfloat16)
+    xshape = ((batch, 3, 224, 224) if layout == "NCHW"
+              else (batch, 224, 224, 3))
+    x = jax.random.normal(rng, xshape, jnp.bfloat16)
     y = jax.random.randint(jax.random.PRNGKey(1), (batch,), 0, 1000)
     momenta = {n: jnp.zeros_like(a) for n, a in params.items()
                if n in trainable}
@@ -103,28 +113,229 @@ def main():
 
     step = jax.jit(train_step, donate_argnums=(0, 1))
 
-    key = jax.random.PRNGKey(2)
-    for _ in range(warmup):
-        params, momenta, loss = step(params, momenta, x, y, key)
-    # NB: block_until_ready() is a no-op over the axon TPU tunnel — only a
-    # host fetch truly synchronizes. Fetch the scalar loss (4 bytes).
-    float(loss)
+    state = {"params": params, "momenta": momenta}
+    keys = [jax.random.PRNGKey(100 + i) for i in range(iters + warmup)]
+    ki = iter(keys)
 
-    t0 = time.perf_counter()
-    for _ in range(iters):
-        params, momenta, loss = step(params, momenta, x, y, key)
-    final_loss = float(loss)  # scalar host fetch = true barrier
-    dt = time.perf_counter() - t0
-    if not math.isfinite(final_loss):
-        raise SystemExit(f"non-finite loss {final_loss}")
+    def one():
+        state["params"], state["momenta"], loss = step(
+            state["params"], state["momenta"], x, y, next(ki))
+        return loss
 
-    img_s = batch * iters / dt
-    print(json.dumps({
-        "metric": f"resnet50_train_bf16_b{batch}_imgs_per_sec_per_chip"
-                  + ("" if platform != "cpu" else "_CPU_FALLBACK"),
-        "value": round(img_s, 2),
+    dt, loss = _timeit(one, lambda l: float(l), iters, warmup)
+    if not math.isfinite(float(loss)):
+        raise SystemExit(f"non-finite training loss {float(loss)}")
+    train_img_s = batch * iters / dt
+
+    # inference on the same net (predict-mode jit over the trained params —
+    # the originals were donated into the train step)
+    infer_batch = batch
+    xi = jax.random.normal(rng, xshape, jnp.bfloat16)
+    pfwd, _ = net.as_pure_function(training=False)
+    pparams = state["params"]
+
+    @jax.jit
+    def predict(p, x):
+        return jnp.argmax(pfwd(p, None, x)[0], axis=-1)
+
+    def one_inf():
+        return predict(pparams, xi)
+
+    dt_i, out = _timeit(lambda: one_inf(), lambda o: int(o[0]),
+                        iters, warmup)
+    infer_img_s = infer_batch * iters / dt_i
+    return train_img_s, infer_img_s
+
+
+def bench_lenet_imperative(platform, iters, warmup):
+    """LeNet-MNIST imperative (no jit of the user loop — the BASELINE
+    config #1 'imperative mode' row). Uses the framework's eager NDArray
+    path end to end."""
+    import mxnet_tpu as mx
+    from mxnet_tpu import autograd, gluon
+    from mxnet_tpu.gluon.model_zoo.vision import lenet
+
+    mx.seed(0)
+    net = lenet.lenet(classes=10)
+    net.initialize()
+    batch = 256
+    x = mx.np.array(__import__("numpy").random.rand(
+        batch, 1, 28, 28).astype("float32"))
+    y = mx.np.array(__import__("numpy").random.randint(
+        0, 10, (batch,)))
+    lossfn = gluon.loss.SoftmaxCrossEntropyLoss()
+    trainer = gluon.Trainer(net.collect_params(), "sgd",
+                            {"learning_rate": 0.05})
+
+    def one():
+        with autograd.record():
+            loss = lossfn(net(x), y)
+        loss.backward()
+        trainer.step(batch)
+        return loss
+
+    dt, loss = _timeit(one, lambda l: float(l.sum().asnumpy()),
+                       iters, warmup)
+    return batch * iters / dt
+
+
+def bench_bert_finetune(platform, iters, warmup):
+    """BERT-base bf16 fine-tune step throughput (BASELINE config #4:
+    SQuAD-style QA head, seq 384, bf16)."""
+    import jax
+    import jax.numpy as jnp
+
+    import mxnet_tpu as mx
+    from mxnet_tpu import amp
+    from mxnet_tpu.gluon.model_zoo.bert import BERTForQA, bert_12_768_12
+
+    mx.seed(0)
+    batch, seq = 8, 384
+    net = BERTForQA(bert_12_768_12(vocab_size=30522, dropout=0.1))
+    net.initialize()
+    amp.convert_hybrid_block(net, target_dtype="bfloat16")
+    import numpy as onp
+
+    tok = mx.np.array(onp.random.randint(0, 30000, (2, seq)))
+    seg = mx.np.zeros((2, seq), dtype="int32")
+    net(tok, seg)
+
+    fwd, params = net.as_pure_function(training=True)
+    trainable = set(net.trainable_param_names())
+    tokens = jnp.asarray(onp.random.randint(0, 30000, (batch, seq)))
+    segments = jnp.zeros((batch, seq), jnp.int32)
+    starts = jnp.asarray(onp.random.randint(0, seq, (batch,)))
+    ends = jnp.asarray(onp.random.randint(0, seq, (batch,)))
+
+    def step_fn(params, key):
+        def loss_fn(pd):
+            (s_logits, e_logits), new_pd = fwd(pd, key, tokens, segments)
+            s_logp = jax.nn.log_softmax(s_logits.astype(jnp.float32), -1)
+            e_logp = jax.nn.log_softmax(e_logits.astype(jnp.float32), -1)
+            nll = -(jnp.take_along_axis(s_logp, starts[:, None], 1).mean()
+                    + jnp.take_along_axis(e_logp, ends[:, None], 1).mean())
+            return nll, new_pd
+
+        (loss, _), grads = jax.value_and_grad(loss_fn, has_aux=True)(params)
+        new = {n: (p - 1e-5 * grads[n].astype(p.dtype)
+                   if n in trainable else p)
+               for n, p in params.items()}
+        return new, loss
+
+    step = jax.jit(step_fn, donate_argnums=(0,))
+    state = {"p": params}
+    keys = [jax.random.PRNGKey(i) for i in range(iters + warmup)]
+    ki = iter(keys)
+
+    def one():
+        state["p"], loss = step(state["p"], next(ki))
+        return loss
+
+    dt, loss = _timeit(one, lambda l: float(l), iters, warmup)
+    if not math.isfinite(float(loss)):
+        raise SystemExit("non-finite BERT loss")
+    return batch * iters / dt
+
+
+def bench_int8_agreement(platform):
+    """INT8-vs-fp32 top-1 agreement for quantized ResNet-18 on a fixed
+    synthetic eval set (no ImageNet in the image: agreement rate stands in
+    for the reference's accuracy-delta table,
+    example/quantization/README.md:113-121 — fp32 76.36 vs int8 76.04
+    top-1, i.e. ~99.6% relative)."""
+    import numpy as onp
+
+    import mxnet_tpu as mx
+    from mxnet_tpu.contrib import quantization as q
+    from mxnet_tpu.gluon.model_zoo.vision import resnet18_v1
+
+    mx.seed(0)
+    net = resnet18_v1(classes=100)
+    net.initialize()
+    rs = onp.random.RandomState(0)
+    calib = [mx.np.array(rs.rand(8, 3, 32, 32).astype("f"))
+             for _ in range(4)]
+    qnet = q.quantize_net(net, calib_data=calib, calib_mode="entropy")
+    agree = 0
+    total = 0
+    for _ in range(8):
+        x = mx.np.array(rs.rand(16, 3, 32, 32).astype("f"))
+        ref = net(x).asnumpy().argmax(-1)
+        got = qnet(x).asnumpy().argmax(-1)
+        agree += int((ref == got).sum())
+        total += ref.size
+    return agree / total
+
+
+def main():
+    import jax
+
+    platform = _probe_accelerator()
+    if platform is None or platform == "cpu":
+        print("accelerator unreachable; falling back to CPU",
+              file=sys.stderr)
+        jax.config.update("jax_platforms", "cpu")
+        platform = "cpu"
+
+    layout = os.environ.get("MXTPU_BENCH_LAYOUT", "NHWC")
+    batch = int(os.environ.get("MXTPU_BENCH_BATCH",
+                               "256" if platform != "cpu" else "4"))
+    iters = ITERS if platform != "cpu" else 1
+    warmup = WARMUP if platform != "cpu" else 1
+    suffix = "" if platform != "cpu" else "_CPU_FALLBACK"
+
+    train_img_s, infer_img_s = bench_resnet_train(
+        platform, layout, batch, iters, warmup)
+
+    rows = [{
+        "metric": f"resnet50_infer_bf16_b{batch}_imgs_per_sec_per_chip"
+                  + suffix,
+        "value": round(infer_img_s, 2),
         "unit": "img/s",
-        "vs_baseline": round(img_s / BASELINE_IMG_S, 4),
+        "vs_baseline": round(infer_img_s / BASELINE_INFER_IMG_S, 4),
+    }]
+    # secondary rows are full-size models — skip them on the CPU fallback
+    # so the driver always gets its JSON line quickly
+    if (os.environ.get("MXTPU_BENCH_HEADLINE_ONLY") != "1"
+            and platform != "cpu"):
+        try:
+            lenet_img_s = bench_lenet_imperative(
+                platform, iters if platform != "cpu" else 1, warmup)
+            rows.append({
+                "metric": "lenet_mnist_imperative_imgs_per_sec" + suffix,
+                "value": round(lenet_img_s, 2), "unit": "img/s"})
+        except Exception as e:  # keep the headline alive
+            rows.append({"metric": "lenet_mnist_imperative", "error": str(e)})
+        try:
+            bert_sps = bench_bert_finetune(
+                platform, iters if platform != "cpu" else 1, warmup)
+            rows.append({
+                "metric": "bert_base_sq384_bf16_finetune_samples_per_sec"
+                          + suffix,
+                "value": round(bert_sps, 2), "unit": "samples/s"})
+        except Exception as e:
+            rows.append({"metric": "bert_base_finetune", "error": str(e)})
+        try:
+            agreement = bench_int8_agreement(platform)
+            rows.append({
+                "metric": "int8_resnet18_top1_agreement_vs_fp32",
+                "value": round(agreement, 4), "unit": "ratio",
+                "note": "reference accuracy delta: 76.04 int8 vs 76.36 "
+                        "fp32 top-1 = 99.6% relative "
+                        "(example/quantization/README.md:113-121)"})
+        except Exception as e:
+            rows.append({"metric": "int8_agreement", "error": str(e)})
+
+    print(json.dumps({
+        "metric": f"resnet50_train_bf16_b{batch}_{layout.lower()}"
+                  "_imgs_per_sec_per_chip" + suffix,
+        "value": round(train_img_s, 2),
+        "unit": "img/s",
+        "vs_baseline": round(train_img_s / BASELINE_TRAIN_IMG_S, 4),
+        "baseline": "V100 fp32 b=128 training 363.69 img/s "
+                    "(reference perf.md:243-253; best published batch — "
+                    "throughput-vs-throughput comparison)",
+        "rows": rows,
     }))
 
 

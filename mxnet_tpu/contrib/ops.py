@@ -27,15 +27,25 @@ __all__ = ["roi_align", "box_iou", "box_nms", "bipartite_matching",
 
 # --- ROIAlign --------------------------------------------------------------
 
-def roi_align(data, rois, pooled_size, spatial_scale=1.0, sample_ratio=-1):
+def roi_align(data, rois, pooled_size, spatial_scale=1.0, sample_ratio=-1,
+              max_adaptive_samples=4):
     """ROIAlign (reference: src/operator/contrib/roi_align.cc): bilinear
     sampling on a regular grid inside each RoI bin, averaged per bin.
 
     data: (N, C, H, W); rois: (R, 5) [batch_idx, x1, y1, x2, y2] in image
     coordinates. Returns (R, C, ph, pw).
+
+    sample_ratio<=0 follows the reference's adaptive grid
+    (ceil(roi_h/ph) × ceil(roi_w/pw) per RoI) — realised statically by
+    sampling a fixed max_adaptive_samples² grid and masking samples past the
+    per-RoI count (XLA needs static shapes; the masked average equals the
+    reference's adaptive average for counts ≤ the cap). Sample points
+    outside [-1, H]/[-1, W] contribute 0, matching the reference
+    bilinear_interpolate.
     """
     ph, pw = pooled_size
-    s = sample_ratio if sample_ratio > 0 else 2
+    adaptive = sample_ratio <= 0
+    s = max_adaptive_samples if adaptive else sample_ratio
 
     def pure(feat, boxes):
         H, W = feat.shape[-2:]
@@ -45,24 +55,55 @@ def roi_align(data, rois, pooled_size, spatial_scale=1.0, sample_ratio=-1):
             x1, y1, x2, y2 = roi[1:] * spatial_scale
             roi_w = jnp.maximum(x2 - x1, 1.0)
             roi_h = jnp.maximum(y2 - y1, 1.0)
-            # sample grid: (ph*s, pw*s) points
-            ys = y1 + (jnp.arange(ph * s) + 0.5) * roi_h / (ph * s)
-            xs = x1 + (jnp.arange(pw * s) + 0.5) * roi_w / (pw * s)
-            yy, xx = jnp.meshgrid(ys, xs, indexing="ij")
-            img = feat[bidx]                                   # (C, H, W)
-            y0 = jnp.clip(jnp.floor(yy).astype(jnp.int32), 0, H - 1)
-            x0 = jnp.clip(jnp.floor(xx).astype(jnp.int32), 0, W - 1)
+            if adaptive:
+                # reference: roi_bin_grid = ceil(roi_h / pooled_h)
+                s_h = jnp.clip(jnp.ceil(roi_h / ph), 1, s).astype(jnp.int32)
+                s_w = jnp.clip(jnp.ceil(roi_w / pw), 1, s).astype(jnp.int32)
+            else:
+                s_h = s_w = jnp.int32(s)
+            # static (ph*s, pw*s) grid; sample i of bin b sits at position
+            # (i + .5)/s_h within the bin — samples with i >= s_h are masked
+            iy = jnp.arange(s)
+            ix = jnp.arange(s)
+            bin_h = roi_h / ph
+            bin_w = roi_w / pw
+            ys = (y1 + jnp.arange(ph)[:, None] * bin_h
+                  + (iy[None, :] + 0.5) * bin_h / s_h)     # (ph, s)
+            xs = (x1 + jnp.arange(pw)[:, None] * bin_w
+                  + (ix[None, :] + 0.5) * bin_w / s_w)     # (pw, s)
+            my = (iy < s_h)[None, :] | jnp.zeros((ph, 1), bool)  # (ph, s)
+            mx = (ix < s_w)[None, :] | jnp.zeros((pw, 1), bool)
+            yy = ys.reshape(-1)[:, None]                   # (ph*s, 1)
+            xx = xs.reshape(-1)[None, :]                   # (1, pw*s)
+            # reference bilinear_interpolate: OOB (< -1 or > H/W) → 0;
+            # [-1, 0] clamps to 0
+            oob = ((yy < -1.0) | (yy > H) | (xx < -1.0) | (xx > W))
+            yc = jnp.clip(yy, 0.0, None)
+            xc = jnp.clip(xx, 0.0, None)
+            img = feat[bidx]                               # (C, H, W)
+            y0 = jnp.clip(jnp.floor(yc).astype(jnp.int32), 0, H - 1)
+            x0 = jnp.clip(jnp.floor(xc).astype(jnp.int32), 0, W - 1)
             y1i = jnp.clip(y0 + 1, 0, H - 1)
             x1i = jnp.clip(x0 + 1, 0, W - 1)
-            wy = jnp.clip(yy - y0, 0.0, 1.0)
-            wx = jnp.clip(xx - x0, 0.0, 1.0)
-            v = (img[:, y0, x0] * (1 - wy) * (1 - wx)
-                 + img[:, y1i, x0] * wy * (1 - wx)
-                 + img[:, y0, x1i] * (1 - wy) * wx
-                 + img[:, y1i, x1i] * wy * wx)   # (C, ph*s, pw*s)
+            wy = jnp.clip(yc - y0, 0.0, 1.0)
+            wx = jnp.clip(xc - x0, 0.0, 1.0)
+            yy_b = jnp.broadcast_to(y0, (ph * s, pw * s))
+            xx_b = jnp.broadcast_to(x0, (ph * s, pw * s))
+            y1b = jnp.broadcast_to(y1i, (ph * s, pw * s))
+            x1b = jnp.broadcast_to(x1i, (ph * s, pw * s))
+            v = (img[:, yy_b, xx_b] * (1 - wy) * (1 - wx)
+                 + img[:, y1b, xx_b] * wy * (1 - wx)
+                 + img[:, yy_b, x1b] * (1 - wy) * wx
+                 + img[:, y1b, x1b] * wy * wx)             # (C, ph*s, pw*s)
+            grid = my.reshape(-1)[:, None] & mx.reshape(-1)[None, :]
+            v = jnp.where(grid & ~oob, v, 0.0)  # OOB contributes 0...
             c = v.shape[0]
             v = v.reshape(c, ph, s, pw, s)
-            return v.mean(axis=(2, 4))                         # (C, ph, pw)
+            # ...but the divisor stays the full bin grid (reference
+            # roi_align-inl.h: count = roi_bin_grid_h * roi_bin_grid_w)
+            cnt = (grid.reshape(ph, s, pw, s)
+                   .sum(axis=(1, 3)).astype(v.dtype))      # (ph, pw)
+            return v.sum(axis=(2, 4)) / jnp.maximum(cnt, 1.0)
 
         return jax.vmap(one)(boxes)
 
@@ -481,3 +522,18 @@ def getnnz(data, axis=None):
         return NDArray(jnp.diff(data.indptr).astype(jnp.int32))
     arr = data.asnumpy() if isinstance(data, NDArray) else _np.asarray(data)
     return NDArray(jnp.asarray((arr != 0).sum(axis), jnp.int32))
+
+
+# --- reference CamelCase spellings ----------------------------------------
+# The reference contrib NDArray namespace registers the SSD/ROI ops in
+# CamelCase (src/operator/contrib/: MultiBoxPrior, MultiBoxTarget,
+# MultiBoxDetection, ROIAlign, BipartiteMatching, AllClose); alias them so
+# code written against the reference resolves here too.
+MultiBoxPrior = multibox_prior
+MultiBoxTarget = multibox_target
+MultiBoxDetection = multibox_detection
+ROIAlign = roi_align
+BipartiteMatching = bipartite_matching
+AllClose = allclose
+__all__ += ["MultiBoxPrior", "MultiBoxTarget", "MultiBoxDetection",
+            "ROIAlign", "BipartiteMatching", "AllClose"]

@@ -1,5 +1,9 @@
 """Convolution, pooling and padding layers
-(reference: python/mxnet/gluon/nn/conv_layers.py). NCHW layouts."""
+(reference: python/mxnet/gluon/nn/conv_layers.py).
+
+Layouts: channels-first (NCW/NCHW/NCDHW, the reference default) and
+channels-last (NWC/NHWC/NDHWC — the TPU-preferred layout: C rides the lane
+dimension so convs feed the MXU without transposes)."""
 from __future__ import annotations
 
 import numpy as _np
@@ -26,7 +30,7 @@ class _Conv(HybridBlock):
     def __init__(self, channels, kernel_size, strides, padding, dilation,
                  groups, use_bias, in_channels, activation,
                  weight_initializer, bias_initializer, ndim, transpose=False,
-                 output_padding=0):
+                 output_padding=0, layout=None):
         super().__init__()
         self._channels = channels
         self._ndim = ndim
@@ -38,40 +42,46 @@ class _Conv(HybridBlock):
         self._activation = activation
         self._transpose = transpose
         self._output_padding = _tup(output_padding, ndim)
-        if transpose:
-            wshape = (in_channels, channels // groups) + self._kernel
-        else:
-            wshape = (channels, in_channels // groups if in_channels else 0) \
-                + self._kernel
-        self.weight = Parameter("weight", shape=wshape,
+        self._layout = layout
+        self._channels_last = layout is not None and layout[-1] == "C"
+        self.weight = Parameter("weight",
+                                shape=self._weight_shape(in_channels),
                                 init=weight_initializer,
                                 allow_deferred_init=True)
         self.bias = (Parameter("bias", shape=(channels,),
                                init=bias_initializer or "zeros")
                      if use_bias else None)
 
+    def _weight_shape(self, in_channels):
+        c_in = in_channels // self._groups if in_channels else 0
+        if self._transpose:
+            # reference deconvolution weight: (I, O/g, *k) chan-first,
+            # (I, *k, O/g) chan-last
+            o = self._channels // self._groups
+            if self._channels_last:
+                return (in_channels,) + self._kernel + (o,)
+            return (in_channels, o) + self._kernel
+        if self._channels_last:
+            return (self._channels,) + self._kernel + (c_in,)
+        return (self._channels, c_in) + self._kernel
+
     def forward(self, x):
-        c_in = x.shape[1]
+        c_in = x.shape[-1 if self._channels_last else 1]
         if self.weight._is_deferred:
-            if self._transpose:
-                self.weight._finish_deferred_init(
-                    (c_in, self._channels // self._groups) + self._kernel)
-            else:
-                self.weight._finish_deferred_init(
-                    (self._channels, c_in // self._groups) + self._kernel)
+            self.weight._finish_deferred_init(self._weight_shape(c_in))
         w = self.weight.data_for(x)
         b = self.bias.data_for(x) if self.bias is not None else None
+        args = (x, w) if b is None else (x, w, b)
         if self._transpose:
-            args = (x, w) if b is None else (x, w, b)
             out = npx.deconvolution(
                 *args, stride=self._strides, pad=self._padding,
                 dilate=self._dilation, output_padding=self._output_padding,
-                groups=self._groups)
+                groups=self._groups, layout=self._layout)
         else:
-            args = (x, w) if b is None else (x, w, b)
             out = npx.convolution(
                 *args, stride=self._strides, pad=self._padding,
-                dilate=self._dilation, groups=self._groups)
+                dilate=self._dilation, groups=self._groups,
+                layout=self._layout)
         if self._activation:
             out = npx.activation(out, self._activation)
         return out
@@ -86,10 +96,11 @@ class Conv1D(_Conv):
                  dilation=1, groups=1, layout="NCW", activation=None,
                  use_bias=True, weight_initializer=None,
                  bias_initializer="zeros", in_channels=0):
-        assert layout == "NCW", "only channels-first supported"
+        assert layout in ("NCW", "NWC"), layout
         super().__init__(channels, kernel_size, strides, padding, dilation,
                          groups, use_bias, in_channels, activation,
-                         weight_initializer, bias_initializer, 1)
+                         weight_initializer, bias_initializer, 1,
+                         layout=layout)
 
 
 class Conv2D(_Conv):
@@ -97,10 +108,11 @@ class Conv2D(_Conv):
                  dilation=(1, 1), groups=1, layout="NCHW", activation=None,
                  use_bias=True, weight_initializer=None,
                  bias_initializer="zeros", in_channels=0):
-        assert layout == "NCHW", "only channels-first supported"
+        assert layout in ("NCHW", "NHWC"), layout
         super().__init__(channels, kernel_size, strides, padding, dilation,
                          groups, use_bias, in_channels, activation,
-                         weight_initializer, bias_initializer, 2)
+                         weight_initializer, bias_initializer, 2,
+                         layout=layout)
 
 
 class Conv3D(_Conv):
@@ -109,10 +121,11 @@ class Conv3D(_Conv):
                  layout="NCDHW", activation=None, use_bias=True,
                  weight_initializer=None, bias_initializer="zeros",
                  in_channels=0):
-        assert layout == "NCDHW", "only channels-first supported"
+        assert layout in ("NCDHW", "NDHWC"), layout
         super().__init__(channels, kernel_size, strides, padding, dilation,
                          groups, use_bias, in_channels, activation,
-                         weight_initializer, bias_initializer, 3)
+                         weight_initializer, bias_initializer, 3,
+                         layout=layout)
 
 
 class Conv1DTranspose(_Conv):
@@ -120,11 +133,12 @@ class Conv1DTranspose(_Conv):
                  output_padding=0, dilation=1, groups=1, layout="NCW",
                  activation=None, use_bias=True, weight_initializer=None,
                  bias_initializer="zeros", in_channels=0):
-        assert layout == "NCW"
+        assert layout in ("NCW", "NWC"), layout
         super().__init__(channels, kernel_size, strides, padding, dilation,
                          groups, use_bias, in_channels, activation,
                          weight_initializer, bias_initializer, 1,
-                         transpose=True, output_padding=output_padding)
+                         transpose=True, output_padding=output_padding,
+                         layout=layout)
 
 
 class Conv2DTranspose(_Conv):
@@ -133,11 +147,12 @@ class Conv2DTranspose(_Conv):
                  layout="NCHW", activation=None, use_bias=True,
                  weight_initializer=None, bias_initializer="zeros",
                  in_channels=0):
-        assert layout == "NCHW"
+        assert layout in ("NCHW", "NHWC"), layout
         super().__init__(channels, kernel_size, strides, padding, dilation,
                          groups, use_bias, in_channels, activation,
                          weight_initializer, bias_initializer, 2,
-                         transpose=True, output_padding=output_padding)
+                         transpose=True, output_padding=output_padding,
+                         layout=layout)
 
 
 class Conv3DTranspose(_Conv):
@@ -146,16 +161,18 @@ class Conv3DTranspose(_Conv):
                  dilation=(1, 1, 1), groups=1, layout="NCDHW",
                  activation=None, use_bias=True, weight_initializer=None,
                  bias_initializer="zeros", in_channels=0):
-        assert layout == "NCDHW"
+        assert layout in ("NCDHW", "NDHWC"), layout
         super().__init__(channels, kernel_size, strides, padding, dilation,
                          groups, use_bias, in_channels, activation,
                          weight_initializer, bias_initializer, 3,
-                         transpose=True, output_padding=output_padding)
+                         transpose=True, output_padding=output_padding,
+                         layout=layout)
 
 
 class _Pool(HybridBlock):
     def __init__(self, pool_size, strides, padding, ndim, pool_type,
-                 global_pool=False, count_include_pad=True, ceil_mode=False):
+                 global_pool=False, count_include_pad=True, ceil_mode=False,
+                 layout=None):
         super().__init__()
         self._kernel = _tup(pool_size, ndim)
         self._strides = _tup(strides if strides is not None else pool_size,
@@ -164,6 +181,7 @@ class _Pool(HybridBlock):
         self._pool_type = pool_type
         self._global = global_pool
         self._count_include_pad = count_include_pad
+        self._layout = layout
         if ceil_mode:
             raise NotImplementedError("ceil_mode pooling not supported")
 
@@ -172,7 +190,8 @@ class _Pool(HybridBlock):
             x, kernel=self._kernel, pool_type=self._pool_type,
             stride=self._strides, pad=self._padding,
             global_pool=self._global,
-            count_include_pad=self._count_include_pad)
+            count_include_pad=self._count_include_pad,
+            layout=self._layout)
 
     def __repr__(self):
         return (f"{type(self).__name__}(size={self._kernel}, "
@@ -182,93 +201,94 @@ class _Pool(HybridBlock):
 class MaxPool1D(_Pool):
     def __init__(self, pool_size=2, strides=None, padding=0, layout="NCW",
                  ceil_mode=False):
-        assert layout == "NCW"
+        assert layout in ("NCW", "NWC"), layout
         super().__init__(pool_size, strides, padding, 1, "max",
-                         ceil_mode=ceil_mode)
+                         ceil_mode=ceil_mode, layout=layout)
 
 
 class MaxPool2D(_Pool):
     def __init__(self, pool_size=(2, 2), strides=None, padding=0,
                  layout="NCHW", ceil_mode=False):
-        assert layout == "NCHW"
+        assert layout in ("NCHW", "NHWC"), layout
         super().__init__(pool_size, strides, padding, 2, "max",
-                         ceil_mode=ceil_mode)
+                         ceil_mode=ceil_mode, layout=layout)
 
 
 class MaxPool3D(_Pool):
     def __init__(self, pool_size=(2, 2, 2), strides=None, padding=0,
                  layout="NCDHW", ceil_mode=False):
-        assert layout == "NCDHW"
+        assert layout in ("NCDHW", "NDHWC"), layout
         super().__init__(pool_size, strides, padding, 3, "max",
-                         ceil_mode=ceil_mode)
+                         ceil_mode=ceil_mode, layout=layout)
 
 
 class AvgPool1D(_Pool):
     def __init__(self, pool_size=2, strides=None, padding=0, layout="NCW",
                  ceil_mode=False, count_include_pad=True):
-        assert layout == "NCW"
+        assert layout in ("NCW", "NWC"), layout
         super().__init__(pool_size, strides, padding, 1, "avg",
                          count_include_pad=count_include_pad,
-                         ceil_mode=ceil_mode)
+                         ceil_mode=ceil_mode, layout=layout)
 
 
 class AvgPool2D(_Pool):
     def __init__(self, pool_size=(2, 2), strides=None, padding=0,
                  layout="NCHW", ceil_mode=False, count_include_pad=True):
-        assert layout == "NCHW"
+        assert layout in ("NCHW", "NHWC"), layout
         super().__init__(pool_size, strides, padding, 2, "avg",
                          count_include_pad=count_include_pad,
-                         ceil_mode=ceil_mode)
+                         ceil_mode=ceil_mode, layout=layout)
 
 
 class AvgPool3D(_Pool):
     def __init__(self, pool_size=(2, 2, 2), strides=None, padding=0,
                  layout="NCDHW", ceil_mode=False, count_include_pad=True):
-        assert layout == "NCDHW"
+        assert layout in ("NCDHW", "NDHWC"), layout
         super().__init__(pool_size, strides, padding, 3, "avg",
                          count_include_pad=count_include_pad,
-                         ceil_mode=ceil_mode)
+                         ceil_mode=ceil_mode, layout=layout)
 
 
 class _GlobalPool(_Pool):
-    def __init__(self, ndim, pool_type):
-        super().__init__(1, 1, 0, ndim, pool_type, global_pool=True)
+    def __init__(self, ndim, pool_type, layout=None):
+        super().__init__(1, 1, 0, ndim, pool_type, global_pool=True,
+                         layout=layout)
 
 
 class GlobalMaxPool1D(_GlobalPool):
     def __init__(self, layout="NCW"):
-        assert layout == "NCW"
-        super().__init__(1, "max")
+        assert layout in ("NCW", "NWC"), layout
+        super().__init__(1, "max", layout=layout)
 
 
 class GlobalMaxPool2D(_GlobalPool):
     def __init__(self, layout="NCHW"):
-        assert layout == "NCHW"
-        super().__init__(2, "max")
+        assert layout in ("NCHW", "NHWC"), layout
+        super().__init__(2, "max", layout=layout)
 
 
 class GlobalMaxPool3D(_GlobalPool):
     def __init__(self, layout="NCDHW"):
-        assert layout == "NCDHW"
-        super().__init__(3, "max")
+        assert layout in ("NCDHW", "NDHWC"), layout
+        super().__init__(3, "max", layout=layout)
 
 
 class GlobalAvgPool1D(_GlobalPool):
     def __init__(self, layout="NCW"):
-        assert layout == "NCW"
-        super().__init__(1, "avg")
+        assert layout in ("NCW", "NWC"), layout
+        super().__init__(1, "avg", layout=layout)
 
 
 class GlobalAvgPool2D(_GlobalPool):
     def __init__(self, layout="NCHW"):
-        assert layout == "NCHW"
-        super().__init__(2, "avg")
+        assert layout in ("NCHW", "NHWC"), layout
+        super().__init__(2, "avg", layout=layout)
 
 
 class GlobalAvgPool3D(_GlobalPool):
     def __init__(self, layout="NCDHW"):
-        assert layout == "NCDHW"
-        super().__init__(3, "avg")
+        assert layout in ("NCDHW", "NDHWC"), layout
+        super().__init__(3, "avg", layout=layout)
 
 
 class ReflectionPad2D(HybridBlock):

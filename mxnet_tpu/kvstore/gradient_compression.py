@@ -56,9 +56,11 @@ def _decompress_2bit(packed, threshold, shape, dtype):
 
 @functools.partial(jax.jit, static_argnames=("threshold",))
 def _compress_1bit(grad, residual, threshold):
+    # reference semantics (gradient_compression-inl.h quantize_1bit /
+    # dequantize_1bit): split at `threshold`, dequantize to ±1
     g = grad + residual
-    q = jnp.where(g >= 0, jnp.uint8(1), jnp.uint8(0))
-    deq = jnp.where(q == 1, threshold, -threshold).astype(grad.dtype)
+    q = jnp.where(g > threshold, jnp.uint8(1), jnp.uint8(0))
+    deq = jnp.where(q == 1, 1.0, -1.0).astype(grad.dtype)
     new_residual = g - deq
     flat = q.ravel()
     pad = (-flat.shape[0]) % 8
@@ -78,7 +80,8 @@ def _decompress_1bit(packed, threshold, shape, dtype):
     for s in shape:
         n *= s
     bits = flat[:n].reshape(shape)
-    return jnp.where(bits == 1, threshold, -threshold).astype(dtype)
+    del threshold  # 1-bit dequantizes to ±1 (reference dequantize_1bit)
+    return jnp.where(bits == 1, 1.0, -1.0).astype(dtype)
 
 
 class GradientCompression:

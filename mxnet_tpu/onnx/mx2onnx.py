@@ -24,6 +24,15 @@ class _Ctx:
         self.nodes = []        # encoded NodeProtos
         self.initializers = []
         self._counter = 0
+        self.structs = {}      # id(sym-node) -> ShapeDtypeStruct
+
+    def dtype_of(self, sym_node, default=_np.float32):
+        st = self.structs.get(id(sym_node))
+        if st is None:
+            return _np.dtype(default)
+        if isinstance(st, (tuple, list)):
+            st = st[0]
+        return _np.dtype(st.dtype)
 
     def fresh(self, base):
         self._counter += 1
@@ -200,16 +209,18 @@ def _broadcast_to(ctx, s, ins, outs, shapes):  # noqa: ARG001
 def _zeros_like(ctx, s, ins, outs, shapes):  # noqa: ARG001
     shp = ctx.fresh(s.name + "_shape")
     ctx.add_node("Shape", ins, [shp])
+    dt = ctx.dtype_of(s._inputs[0])  # emit in the source dtype
     ctx.add_node("ConstantOfShape", [shp], outs, s.name,
-                 {"value": _np.zeros(1, _np.float32)})
+                 {"value": _np.zeros(1, dt)})
 
 
 @_conv("ones_like")
 def _ones_like(ctx, s, ins, outs, shapes):  # noqa: ARG001
     shp = ctx.fresh(s.name + "_shape")
     ctx.add_node("Shape", ins, [shp])
+    dt = ctx.dtype_of(s._inputs[0])
     ctx.add_node("ConstantOfShape", [shp], outs, s.name,
-                 {"value": _np.ones(1, _np.float32)})
+                 {"value": _np.ones(1, dt)})
 
 
 @_conv("slice")
@@ -553,6 +564,7 @@ def export_model(sym, params, in_shapes=None, in_types=_np.float32,
     shapes = _infer_all_shapes(order, input_structs)
 
     ctx = _Ctx()
+    ctx.structs = shapes
     tensor_names = {}  # id(sym-node) -> list of output tensor names
     converted = {}     # node name -> output tensor names (dedups the
     #                    out_index clones _flat_outputs creates)

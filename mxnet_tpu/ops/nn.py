@@ -1,4 +1,4 @@
-"""Neural-net primitive ops as pure jax functions (NCHW layouts).
+"""Neural-net primitive ops as pure jax functions (NCHW default, NHWC fast path).
 
 TPU re-design of src/operator/nn/ (convolution, fully_connected, pooling,
 batch_norm, layer_norm, softmax, activation, dropout...): each op is a pure
@@ -50,14 +50,37 @@ def _spec(ndim):
     return ("NC" + sp, "OI" + sp, "NC" + sp)
 
 
-@register_op("convolution")
-def conv(x, weight, bias=None, stride=None, pad=None, dilate=None, groups=1):
-    """N-d convolution, NC+spatial layout, weight (O, I/g, *k).
+def _layout_spec(layout):
+    """Map a reference layout string (NCHW/NHWC/NCW/NWC/NCDHW/NDHWC) to
+    (lhs_spec, rhs_spec, ndim). Channels-last puts C in the lane dimension —
+    the MXU-preferred physical layout on TPU; kernel follows the reference
+    convention: O,I,*k channels-first, O,*k,I channels-last
+    (src/operator/nn/convolution-inl.h layout handling)."""
+    sp = layout.replace("N", "").replace("C", "")
+    nd = len(sp)
+    if layout[1] == "C":  # channels-first
+        return "NC" + sp, "OI" + sp, nd
+    return "N" + sp + "C", "O" + sp + "I", nd
 
-    Reference: src/operator/nn/convolution.cc. Lowers to a single XLA
-    conv_general_dilated → MXU.
+
+@register_op("convolution")
+def conv(x, weight, bias=None, stride=None, pad=None, dilate=None, groups=1,
+         layout=None):
+    """N-d convolution; layout NCHW (default) or NHWC family.
+
+    weight (O, I/g, *k) channels-first, (O, *k, I/g) channels-last — matching
+    the reference's per-layout weight shapes. Reference:
+    src/operator/nn/convolution.cc. Lowers to a single XLA
+    conv_general_dilated → MXU; channels-last keeps C in lanes.
     """
     nd = x.ndim - 2
+    if layout is None:
+        lhs_spec, rhs_spec = _spec(nd)[:2]
+        channels_last = False
+    else:
+        lhs_spec, rhs_spec, lnd = _layout_spec(layout)
+        assert lnd == nd, f"layout {layout} does not match input ndim {x.ndim}"
+        channels_last = layout[-1] == "C"
     stride = stride or (1,) * nd
     pad = pad or (0,) * nd
     dilate = dilate or (1,) * nd
@@ -67,7 +90,8 @@ def conv(x, weight, bias=None, stride=None, pad=None, dilate=None, groups=1):
         pad = (pad,) * nd
     if isinstance(dilate, int):
         dilate = (dilate,) * nd
-    dn = lax.conv_dimension_numbers(x.shape, weight.shape, _spec(nd))
+    dn = lax.conv_dimension_numbers(
+        x.shape, weight.shape, (lhs_spec, rhs_spec, lhs_spec))
     y = lax.conv_general_dilated(
         x,
         weight,
@@ -79,17 +103,19 @@ def conv(x, weight, bias=None, stride=None, pad=None, dilate=None, groups=1):
         preferred_element_type=None,
     )
     if bias is not None:
-        y = y + bias.reshape((1, -1) + (1,) * nd)
+        y = y + (bias if channels_last
+                 else bias.reshape((1, -1) + (1,) * nd))
     return y
 
 
 @register_op("deconvolution")
 def conv_transpose(x, weight, bias=None, stride=None, pad=None, dilate=None,
-                   output_padding=None, groups=1):
+                   output_padding=None, groups=1, layout=None):
     """Transposed convolution (reference: src/operator/nn/deconvolution.cc).
 
-    weight (I, O/g, *k) like the reference; implemented as the gradient of
-    conv via lax.conv_transpose with IO spatial kernel spec.
+    weight (I, O/g, *k) channels-first / (I, *k, O/g) channels-last like the
+    reference; implemented as the gradient of conv via conv_general_dilated
+    with an IO spatial kernel spec and lhs dilation.
     """
     nd = x.ndim - 2
     stride = stride or (1,) * nd
@@ -102,10 +128,15 @@ def conv_transpose(x, weight, bias=None, stride=None, pad=None, dilate=None,
     if isinstance(output_padding, int):
         output_padding = (output_padding,) * nd
     sp = "DHW"[-nd:]
+    channels_last = layout is not None and layout[-1] == "C"
+    if channels_last:
+        lhs_spec, rhs_spec = "N" + sp + "C", "I" + sp + "O"
+    else:
+        lhs_spec, rhs_spec = "NC" + sp, "IO" + sp
     dn = lax.conv_dimension_numbers(
-        x.shape, weight.shape, ("NC" + sp, "IO" + sp, "NC" + sp)
+        x.shape, weight.shape, (lhs_spec, rhs_spec, lhs_spec)
     )
-    k = weight.shape[2:]
+    k = weight.shape[1:-1] if channels_last else weight.shape[2:]
     # padding for transpose conv: k - 1 - p on both sides, + output_padding low
     padding = [
         (ki - 1 - pi, ki - 1 - pi + opi)
@@ -121,7 +152,8 @@ def conv_transpose(x, weight, bias=None, stride=None, pad=None, dilate=None,
         feature_group_count=groups,
     )
     if bias is not None:
-        y = y + bias.reshape((1, -1) + (1,) * nd)
+        y = y + (bias if channels_last
+                 else bias.reshape((1, -1) + (1,) * nd))
     return y
 
 
@@ -132,11 +164,16 @@ def conv_transpose(x, weight, bias=None, stride=None, pad=None, dilate=None,
 
 @register_op("pooling")
 def pool(x, kernel, pool_type="max", stride=None, pad=None, global_pool=False,
-         count_include_pad=True):
-    """Max/avg/lp pooling via reduce_window (reference: nn/pooling.cc)."""
+         count_include_pad=True, layout=None):
+    """Max/avg/lp pooling via reduce_window (reference: nn/pooling.cc).
+
+    layout: None/channels-first ("NCHW"...) pools x[2:]; channels-last
+    ("NHWC"...) pools x[1:-1]."""
     nd = x.ndim - 2
+    channels_last = layout is not None and layout[-1] == "C"
+    sp = slice(1, -1) if channels_last else slice(2, None)
     if global_pool:
-        kernel = x.shape[2:]
+        kernel = x.shape[sp]
         stride = (1,) * nd
         pad = (0,) * nd
     if isinstance(kernel, int):
@@ -147,9 +184,14 @@ def pool(x, kernel, pool_type="max", stride=None, pad=None, global_pool=False,
     pad = pad or (0,) * nd
     if isinstance(pad, int):
         pad = (pad,) * nd
-    window = (1, 1) + tuple(kernel)
-    strides = (1, 1) + tuple(stride)
-    padding = ((0, 0), (0, 0)) + tuple((p, p) for p in pad)
+    if channels_last:
+        window = (1,) + tuple(kernel) + (1,)
+        strides = (1,) + tuple(stride) + (1,)
+        padding = ((0, 0),) + tuple((p, p) for p in pad) + ((0, 0),)
+    else:
+        window = (1, 1) + tuple(kernel)
+        strides = (1, 1) + tuple(stride)
+        padding = ((0, 0), (0, 0)) + tuple((p, p) for p in pad)
     if pool_type == "max":
         init = -jnp.inf if jnp.issubdtype(x.dtype, jnp.floating) else jnp.iinfo(x.dtype).min
         return lax.reduce_window(x, init, lax.max, window, strides, padding)
@@ -163,7 +205,8 @@ def pool(x, kernel, pool_type="max", stride=None, pad=None, global_pool=False,
             for k in kernel:
                 denom *= k
             return s / denom
-        ones = jnp.ones(x.shape[2:], x.dtype)[None, None]
+        ones = jnp.ones(x.shape[sp], x.dtype)
+        ones = ones[None, ..., None] if channels_last else ones[None, None]
         counts = lax.reduce_window(ones, 0.0, lax.add, window, strides, padding)
         return s / counts
     if pool_type == "lp":
@@ -187,6 +230,7 @@ def batch_norm(x, gamma, beta, moving_mean, moving_var, eps=1e-5,
     Returns (out, new_mean, new_var). The stateful moving-stat update is done
     by the caller (BatchNorm layer / state sink), keeping this function pure.
     """
+    axis = axis % x.ndim  # normalize negative axis (-1 = channels-last)
     reduce_axes = tuple(i for i in range(x.ndim) if i != axis)
     bshape = [1] * x.ndim
     bshape[axis] = x.shape[axis]

@@ -25,7 +25,11 @@ def test_1bit_quantize_roundtrip():
     packed = gc.compress("k", mx.np.array(g)._data)
     assert packed.shape[0] == 1  # 8 bits per byte
     deq = np.asarray(gc.decompress(packed, g.shape, "float32"))
-    assert np.allclose(deq, [0.25, -0.25, 0.25, -0.25])
+    # reference semantics (gradient_compression-inl.h): bit = g > threshold,
+    # dequantize to +/-1
+    assert np.allclose(deq, [1.0, -1.0, -1.0, -1.0])
+    # error feedback keeps the quantization error in the residual
+    assert np.allclose(np.asarray(gc._residuals["k"]), g - deq)
 
 
 def test_error_feedback_converges():

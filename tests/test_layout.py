@@ -1,0 +1,152 @@
+"""Channels-last (NHWC family) layout support: numeric parity with the
+channels-first reference layouts across conv/pool/BN/model-zoo.
+
+Reference: src/operator/nn/convolution-inl.h layout handling (the reference
+supports NCHW and NHWC layouts on its ops); TPU motivation: channels-last
+keeps C in the lane dimension, feeding the MXU without transposes."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu.gluon import nn
+
+
+def _t(a):  # NCHW -> NHWC
+    return np.transpose(a, (0, 2, 3, 1))
+
+
+@pytest.fixture
+def x_nchw():
+    return np.random.RandomState(0).rand(2, 8, 10, 10).astype("float32")
+
+
+def test_conv2d_nhwc_matches_nchw(x_nchw):
+    c1 = nn.Conv2D(16, 3, 2, 1, use_bias=True, in_channels=8)
+    c1.initialize()
+    c2 = nn.Conv2D(16, 3, 2, 1, use_bias=True, in_channels=8, layout="NHWC")
+    c2.initialize()
+    # weight (O,I,H,W) -> (O,H,W,I)
+    c2.weight.set_data(mx.np.transpose(c1.weight.data(), (0, 2, 3, 1)))
+    c2.bias.set_data(c1.bias.data())
+    y1 = c1(mx.np.array(x_nchw)).asnumpy()
+    y2 = c2(mx.np.array(_t(x_nchw))).asnumpy()
+    np.testing.assert_allclose(y1, np.transpose(y2, (0, 3, 1, 2)),
+                               rtol=1e-5, atol=1e-5)
+
+
+def test_conv2d_nhwc_grouped(x_nchw):
+    c1 = nn.Conv2D(16, 3, 1, 1, groups=4, use_bias=False, in_channels=8)
+    c1.initialize()
+    c2 = nn.Conv2D(16, 3, 1, 1, groups=4, use_bias=False, in_channels=8,
+                   layout="NHWC")
+    c2.initialize()
+    c2.weight.set_data(mx.np.transpose(c1.weight.data(), (0, 2, 3, 1)))
+    y1 = c1(mx.np.array(x_nchw)).asnumpy()
+    y2 = c2(mx.np.array(_t(x_nchw))).asnumpy()
+    np.testing.assert_allclose(y1, np.transpose(y2, (0, 3, 1, 2)),
+                               rtol=1e-5, atol=1e-5)
+
+
+def test_conv_transpose_nhwc(x_nchw):
+    d1 = nn.Conv2DTranspose(6, 3, 2, 1, in_channels=8)
+    d1.initialize()
+    d2 = nn.Conv2DTranspose(6, 3, 2, 1, in_channels=8, layout="NHWC")
+    d2.initialize()
+    # weight (I,O,H,W) -> (I,H,W,O)
+    d2.weight.set_data(mx.np.transpose(d1.weight.data(), (0, 2, 3, 1)))
+    d2.bias.set_data(d1.bias.data())
+    y1 = d1(mx.np.array(x_nchw)).asnumpy()
+    y2 = d2(mx.np.array(_t(x_nchw))).asnumpy()
+    np.testing.assert_allclose(y1, np.transpose(y2, (0, 3, 1, 2)),
+                               rtol=1e-5, atol=1e-5)
+
+
+@pytest.mark.parametrize("cls,kw", [
+    (nn.MaxPool2D, {}),
+    (nn.AvgPool2D, {}),
+    (nn.AvgPool2D, {"count_include_pad": False}),
+])
+def test_pool2d_nhwc(x_nchw, cls, kw):
+    p1 = cls(3, 2, 1, **kw)
+    p2 = cls(3, 2, 1, layout="NHWC", **kw)
+    y1 = p1(mx.np.array(x_nchw)).asnumpy()
+    y2 = p2(mx.np.array(_t(x_nchw))).asnumpy()
+    np.testing.assert_allclose(y1, np.transpose(y2, (0, 3, 1, 2)),
+                               rtol=1e-6, atol=1e-6)
+
+
+def test_global_pool_nhwc(x_nchw):
+    g1 = nn.GlobalAvgPool2D()
+    g2 = nn.GlobalAvgPool2D(layout="NHWC")
+    y1 = g1(mx.np.array(x_nchw)).asnumpy()       # (N, C, 1, 1)
+    y2 = g2(mx.np.array(_t(x_nchw))).asnumpy()   # (N, 1, 1, C)
+    np.testing.assert_allclose(y1.squeeze(), y2.squeeze(),
+                               rtol=1e-6, atol=1e-6)
+
+
+def test_batchnorm_axis_last(x_nchw):
+    b1 = nn.BatchNorm(axis=1)
+    b1.initialize()
+    b2 = nn.BatchNorm(axis=-1)
+    b2.initialize()
+    with mx.autograd.record():  # training mode: batch stats
+        y1 = b1(mx.np.array(x_nchw)).asnumpy()
+        y2 = b2(mx.np.array(_t(x_nchw))).asnumpy()
+    np.testing.assert_allclose(y1, np.transpose(y2, (0, 3, 1, 2)),
+                               rtol=1e-4, atol=1e-4)
+
+
+def test_conv1d_nwc():
+    x = np.random.RandomState(1).rand(2, 4, 12).astype("float32")
+    c1 = nn.Conv1D(8, 3, 1, 1, in_channels=4)
+    c1.initialize()
+    c2 = nn.Conv1D(8, 3, 1, 1, in_channels=4, layout="NWC")
+    c2.initialize()
+    c2.weight.set_data(mx.np.transpose(c1.weight.data(), (0, 2, 1)))
+    c2.bias.set_data(c1.bias.data())
+    y1 = c1(mx.np.array(x)).asnumpy()
+    y2 = c2(mx.np.array(np.transpose(x, (0, 2, 1)))).asnumpy()
+    np.testing.assert_allclose(y1, np.transpose(y2, (0, 2, 1)),
+                               rtol=1e-5, atol=1e-5)
+
+
+def test_resnet_nhwc_forward_and_grad():
+    from mxnet_tpu.gluon.model_zoo.vision import resnet18_v1
+
+    net = resnet18_v1(classes=10, layout="NHWC")
+    net.initialize()
+    x = mx.np.array(np.random.RandomState(2).rand(2, 32, 32, 3)
+                    .astype("float32"))
+    with mx.autograd.record():
+        out = net(x)
+        loss = out.sum()
+    loss.backward()
+    assert out.shape == (2, 10)
+    g = net.collect_params()["features.0.weight"].grad()
+    assert g.shape[-1] == 3  # NHWC stem weight (O, H, W, I)
+    assert float(np.abs(g.asnumpy()).sum()) > 0
+
+
+def test_resnet_nhwc_matches_nchw_numerically():
+    from mxnet_tpu.gluon.model_zoo.vision import resnet18_v1
+
+    mx.seed(0)
+    n1 = resnet18_v1(classes=10)
+    n1.initialize()
+    n2 = resnet18_v1(classes=10, layout="NHWC")
+    n2.initialize()
+    # trigger deferred shape inference before copying
+    warm = np.zeros((1, 3, 32, 32), "float32")
+    n1(mx.np.array(warm))
+    n2(mx.np.array(_t(warm)))
+    # copy params: conv weights get transposed, everything else 1:1
+    p1, p2 = n1.collect_params(), n2.collect_params()
+    for name, p in p2.items():
+        src = p1[name].data()
+        if name.endswith("weight") and src.ndim == 4:
+            src = mx.np.transpose(src, (0, 2, 3, 1))
+        p.set_data(src)
+    x = np.random.RandomState(3).rand(2, 3, 32, 32).astype("float32")
+    y1 = n1(mx.np.array(x)).asnumpy()
+    y2 = n2(mx.np.array(_t(x))).asnumpy()
+    np.testing.assert_allclose(y1, y2, rtol=1e-3, atol=1e-3)
