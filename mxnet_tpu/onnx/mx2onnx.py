@@ -31,7 +31,7 @@ class _Ctx:
         if st is None:
             return _np.dtype(default)
         if isinstance(st, (tuple, list)):
-            st = st[0]
+            st = st[sym_node._out_index or 0]
         return _np.dtype(st.dtype)
 
     def fresh(self, base):
@@ -495,6 +495,389 @@ def _one_hot(ctx, s, ins, outs, shapes):  # noqa: ARG001
     values = ctx.add_init(ctx.fresh(s.name + "_vals"),
                           _np.asarray([0.0, 1.0], _np.float32))
     ctx.add_node("OneHot", [idx, depth, values], outs, s.name, {"axis": -1})
+
+
+# --- extended-table converters (symbol/op_extended.py vocabulary) ----------
+
+for _mx, _onnx in [
+    ("sin", "Sin"), ("cos", "Cos"), ("tan", "Tan"), ("arcsin", "Asin"),
+    ("arccos", "Acos"), ("arctan", "Atan"), ("sinh", "Sinh"),
+    ("cosh", "Cosh"), ("arcsinh", "Asinh"), ("arccosh", "Acosh"),
+    ("arctanh", "Atanh"), ("floor", "Floor"), ("ceil", "Ceil"),
+    ("round", "Round"), ("rint", "Round"), ("sign", "Sign"),
+    ("erf", "Erf"), ("reciprocal", "Reciprocal"), ("softsign", "Softsign"),
+    ("softplus", "Softplus"), ("identity", "Identity"),
+    ("BlockGrad", "Identity"), ("make_loss", "Identity"),
+    ("shape_array", "Shape"), ("gather_nd", "GatherND"),
+]:
+    _CONVERTERS[_mx] = _simple(_onnx)
+_CONVERTERS["space_to_depth"] = lambda ctx, s, ins, outs, shapes: \
+    ctx.add_node("SpaceToDepth", ins, outs, s.name,
+                 {"blocksize": int(s.attr("block_size"))})
+_CONVERTERS["depth_to_space"] = lambda ctx, s, ins, outs, shapes: \
+    ctx.add_node("DepthToSpace", ins, outs, s.name,
+                 {"blocksize": int(s.attr("block_size"))})
+
+
+@_conv("rsqrt")
+def _rsqrt(ctx, s, ins, outs, shapes):  # noqa: ARG001
+    r = ctx.fresh(s.name + "_sqrt")
+    ctx.add_node("Sqrt", ins, [r])
+    ctx.add_node("Reciprocal", [r], outs, s.name)
+
+
+@_conv("log1p")
+def _log1p(ctx, s, ins, outs, shapes):  # noqa: ARG001
+    one = ctx.add_init(ctx.fresh(s.name + "_one"), _np.float32(1.0))
+    t = ctx.fresh(s.name + "_xp1")
+    ctx.add_node("Add", [ins[0], one], [t])
+    ctx.add_node("Log", [t], outs, s.name)
+
+
+@_conv("expm1")
+def _expm1(ctx, s, ins, outs, shapes):  # noqa: ARG001
+    one = ctx.add_init(ctx.fresh(s.name + "_one"), _np.float32(1.0))
+    t = ctx.fresh(s.name + "_expx")
+    ctx.add_node("Exp", ins, [t])
+    ctx.add_node("Sub", [t, one], outs, s.name)
+
+
+def _log_base(base):
+    def fn(ctx, s, ins, outs, shapes):  # noqa: ARG001
+        ln = ctx.fresh(s.name + "_ln")
+        ctx.add_node("Log", ins, [ln])
+        k = ctx.add_init(ctx.fresh(s.name + "_k"),
+                         _np.float32(1.0 / _np.log(base)))
+        ctx.add_node("Mul", [ln, k], outs, s.name)
+
+    return fn
+
+
+_CONVERTERS["log2"] = _log_base(2.0)
+_CONVERTERS["log10"] = _log_base(10.0)
+
+
+@_conv("hard_sigmoid")
+def _hard_sigmoid(ctx, s, ins, outs, shapes):  # noqa: ARG001
+    ctx.add_node("HardSigmoid", ins, outs, s.name,
+                 {"alpha": float(s.attr("alpha") or 0.2),
+                  "beta": float(s.attr("beta") or 0.5)})
+
+
+def _compare(onnx_op, negate=False):
+    """mx comparisons return float 0/1; ONNX returns bool → Cast back."""
+    def fn(ctx, s, ins, outs, shapes):  # noqa: ARG001
+        b = ctx.fresh(s.name + "_bool")
+        ctx.add_node(onnx_op, ins, [b])
+        if negate:
+            nb = ctx.fresh(s.name + "_not")
+            ctx.add_node("Not", [b], [nb])
+            b = nb
+        ctx.add_node("Cast", [b], outs, s.name, {"to": 1})
+
+    return fn
+
+
+_CONVERTERS["broadcast_equal"] = _compare("Equal")
+_CONVERTERS["broadcast_not_equal"] = _compare("Equal", negate=True)
+_CONVERTERS["broadcast_greater"] = _compare("Greater")
+_CONVERTERS["broadcast_greater_equal"] = _compare("Less", negate=True)
+_CONVERTERS["broadcast_lesser"] = _compare("Less")
+_CONVERTERS["broadcast_lesser_equal"] = _compare("Greater", negate=True)
+
+
+def _logical(onnx_op):
+    def fn(ctx, s, ins, outs, shapes):  # noqa: ARG001
+        bs = []
+        for i, x in enumerate(ins):
+            b = ctx.fresh(f"{s.name}_b{i}")
+            ctx.add_node("Cast", [x], [b], attrs={"to": 9})
+            bs.append(b)
+        r = ctx.fresh(s.name + "_r")
+        ctx.add_node(onnx_op, bs, [r])
+        ctx.add_node("Cast", [r], outs, s.name, {"to": 1})
+
+    return fn
+
+
+_CONVERTERS["broadcast_logical_and"] = _logical("And")
+_CONVERTERS["broadcast_logical_or"] = _logical("Or")
+_CONVERTERS["broadcast_logical_xor"] = _logical("Xor")
+_CONVERTERS["logical_not"] = _logical("Not")
+_CONVERTERS["broadcast_maximum"] = _simple("Max")
+_CONVERTERS["broadcast_minimum"] = _simple("Min")
+_CONVERTERS["broadcast_power"] = _simple("Pow")
+
+
+@_conv("mod")
+def _mod(ctx, s, ins, outs, shapes):  # noqa: ARG001
+    # runtime is jnp.mod (floor modulo, sign follows divisor); ONNX Mod
+    # with fmod=1 is C fmod — compose x - floor(x/y)*y to match
+    q = ctx.fresh(s.name + "_q")
+    ctx.add_node("Div", ins, [q])
+    fq = ctx.fresh(s.name + "_fq")
+    ctx.add_node("Floor", [q], [fq])
+    prod = ctx.fresh(s.name + "_p")
+    ctx.add_node("Mul", [fq, ins[1]], [prod])
+    ctx.add_node("Sub", [ins[0], prod], outs, s.name)
+
+
+_CONVERTERS["broadcast_mod"] = _mod
+
+
+@_conv("broadcast_hypot")
+def _hypot(ctx, s, ins, outs, shapes):  # noqa: ARG001
+    sq = []
+    for i, x in enumerate(ins):
+        t = ctx.fresh(f"{s.name}_sq{i}")
+        ctx.add_node("Mul", [x, x], [t])
+        sq.append(t)
+    ssum = ctx.fresh(s.name + "_ss")
+    ctx.add_node("Add", sq, [ssum])
+    ctx.add_node("Sqrt", [ssum], outs, s.name)
+
+
+@_conv("Cast")
+def _cast(ctx, s, ins, outs, shapes):  # noqa: ARG001
+    ctx.add_node("Cast", ins, outs, s.name,
+                 {"to": P.DTYPE[str(_np.dtype(s.attr("dtype")))]})
+
+
+@_conv("tile")
+def _tile(ctx, s, ins, outs, shapes):  # noqa: ARG001
+    reps = ctx.const_i64(s.name + "_reps", list(s.attr("reps")))
+    ctx.add_node("Tile", [ins[0], reps], outs, s.name)
+
+
+@_conv("pad")
+def _pad(ctx, s, ins, outs, shapes):  # noqa: ARG001
+    pw = list(s.attr("pad_width"))
+    # mx interleaved (before0, after0, before1, ...) → onnx all-befores
+    # then all-afters
+    befores = pw[0::2]
+    afters = pw[1::2]
+    pads = ctx.const_i64(s.name + "_pads", befores + afters)
+    mode = s.attr("mode") or "constant"
+    cv = ctx.add_init(ctx.fresh(s.name + "_cv"),
+                      _np.float32(s.attr("constant_value") or 0.0))
+    ctx.add_node("Pad", [ins[0], pads, cv], outs, s.name,
+                 {"mode": {"constant": "constant", "reflect": "reflect",
+                           "edge": "edge"}[mode]})
+
+
+_CONVERTERS["Pad"] = _pad
+
+
+@_conv("topk")
+def _topk(ctx, s, ins, outs, shapes):  # noqa: ARG001
+    k = ctx.const_i64(s.name + "_k", [int(s.attr("k") or 1)])
+    ax = int(s.attr("axis") if s.attr("axis") is not None else -1)
+    vals = ctx.fresh(s.name + "_vals")
+    idx = ctx.fresh(s.name + "_idx")
+    ret = s.attr("ret_typ") or "indices"
+    largest = 0 if s.attr("is_ascend") in (True, 1) else 1
+    ctx.add_node("TopK", [ins[0], k], [vals, idx], s.name,
+                 {"axis": ax, "largest": largest, "sorted": 1})
+    if ret == "both":
+        ctx.add_node("Identity", [vals], [outs[0]])
+        ctx.add_node("Cast", [idx], [outs[1]], attrs={"to": 1})
+    elif ret == "value":
+        ctx.add_node("Identity", [vals], outs)
+    else:
+        ctx.add_node("Cast", [idx], outs, attrs={"to": 1})
+
+
+@_conv("sort")
+def _sort(ctx, s, ins, outs, shapes):
+    ax = int(s.attr("axis") if s.attr("axis") is not None else -1)
+    dim = shapes[0][ax]
+    k = ctx.const_i64(s.name + "_k", [dim])
+    idx = ctx.fresh(s.name + "_idx")
+    ascend = s.attr("is_ascend") not in (False, 0)  # sort defaults ascending
+    ctx.add_node("TopK", [ins[0], k], [outs[0], idx], s.name,
+                 {"axis": ax, "largest": 0 if ascend else 1, "sorted": 1})
+
+
+@_conv("argsort")
+def _argsort(ctx, s, ins, outs, shapes):
+    ax = int(s.attr("axis") if s.attr("axis") is not None else -1)
+    dim = shapes[0][ax]
+    k = ctx.const_i64(s.name + "_k", [dim])
+    vals = ctx.fresh(s.name + "_vals")
+    idx = ctx.fresh(s.name + "_idx")
+    ascend = s.attr("is_ascend") not in (False, 0)  # argsort defaults ascend
+    ctx.add_node("TopK", [ins[0], k], [vals, idx], s.name,
+                 {"axis": ax, "largest": 0 if ascend else 1, "sorted": 1})
+    ctx.add_node("Cast", [idx], outs, attrs={"to": 1})
+
+
+@_conv("pick")
+def _pick(ctx, s, ins, outs, shapes):  # noqa: ARG001
+    ax = int(s.attr("axis") if s.attr("axis") is not None else -1)
+    idx64 = ctx.fresh(s.name + "_idx64")
+    ctx.add_node("Cast", [ins[1]], [idx64], attrs={"to": 7})
+    idxu = ctx.fresh(s.name + "_idxu")
+    ax_t = ctx.const_i64(s.name + "_ax", [ax])
+    ctx.add_node("Unsqueeze", [idx64, ax_t], [idxu])
+    g = ctx.fresh(s.name + "_g")
+    ctx.add_node("GatherElements", [ins[0], idxu], [g], attrs={"axis": ax})
+    if s.attr("keepdims"):
+        ctx.add_node("Identity", [g], outs, s.name)
+    else:
+        ctx.add_node("Squeeze", [g, ax_t], outs, s.name)
+
+
+@_conv("batch_take")
+def _batch_take(ctx, s, ins, outs, shapes):  # noqa: ARG001
+    idx64 = ctx.fresh(s.name + "_idx64")
+    ctx.add_node("Cast", [ins[1]], [idx64], attrs={"to": 7})
+    one = ctx.const_i64(s.name + "_ax1", [1])
+    idxu = ctx.fresh(s.name + "_idxu")
+    ctx.add_node("Unsqueeze", [idx64, one], [idxu])
+    g = ctx.fresh(s.name + "_g")
+    ctx.add_node("GatherElements", [ins[0], idxu], [g], attrs={"axis": 1})
+    ctx.add_node("Squeeze", [g, one], outs, s.name)
+
+
+@_conv("flip")
+def _flip(ctx, s, ins, outs, shapes):
+    ax = s.attr("axis")
+    if ax is None:  # runtime jnp.flip(x, None) flips every axis
+        axes = list(range(len(shapes[0])))
+    else:
+        axes = [ax] if isinstance(ax, int) else list(ax)
+    starts = ctx.const_i64(s.name + "_st", [-1] * len(axes))
+    INT_MIN = -(2 ** 31)
+    ends = ctx.const_i64(s.name + "_en", [INT_MIN] * len(axes))
+    axs = ctx.const_i64(s.name + "_ax", axes)
+    steps = ctx.const_i64(s.name + "_sp", [-1] * len(axes))
+    ctx.add_node("Slice", [ins[0], starts, ends, axs, steps], outs, s.name)
+
+
+_CONVERTERS["reverse"] = _flip
+
+
+@_conv("logsumexp")
+def _logsumexp(ctx, s, ins, outs, shapes):  # noqa: ARG001
+    attrs = {"keepdims": int(bool(s.attr("keepdims")))}
+    ax = s.attr("axis")
+    if ax is not None:
+        attrs["axes"] = [ax] if isinstance(ax, int) else list(ax)
+    ctx.add_node("ReduceLogSumExp", ins, outs, s.name, attrs)
+
+
+@_conv("broadcast_axis")
+def _broadcast_axis(ctx, s, ins, outs, shapes):
+    axes = s.attr("axis")
+    sizes = s.attr("size")
+    if isinstance(axes, int):
+        axes, sizes = [axes], [sizes]
+    target = list(shapes[0])
+    for ax, sz in zip(axes, sizes):
+        target[ax] = sz
+    shp = ctx.const_i64(s.name + "_shape", target)
+    ctx.add_node("Expand", [ins[0], shp], outs, s.name)
+
+
+@_conv("broadcast_like")
+def _broadcast_like(ctx, s, ins, outs, shapes):  # noqa: ARG001
+    shp = ctx.fresh(s.name + "_shape")
+    ctx.add_node("Shape", [ins[1]], [shp])
+    ctx.add_node("Expand", [ins[0], shp], outs, s.name)
+
+
+@_conv("GELU")
+def _gelu(ctx, s, ins, outs, shapes):  # noqa: ARG001
+    # x * 0.5 * (1 + erf(x / sqrt(2)))
+    inv = ctx.add_init(ctx.fresh(s.name + "_is2"),
+                       _np.float32(1 / _np.sqrt(2.0)))
+    half = ctx.add_init(ctx.fresh(s.name + "_half"), _np.float32(0.5))
+    one = ctx.add_init(ctx.fresh(s.name + "_one"), _np.float32(1.0))
+    t = ctx.fresh(s.name + "_t")
+    ctx.add_node("Mul", [ins[0], inv], [t])
+    e = ctx.fresh(s.name + "_erf")
+    ctx.add_node("Erf", [t], [e])
+    e1 = ctx.fresh(s.name + "_e1")
+    ctx.add_node("Add", [e, one], [e1])
+    xh = ctx.fresh(s.name + "_xh")
+    ctx.add_node("Mul", [ins[0], half], [xh])
+    ctx.add_node("Mul", [xh, e1], outs, s.name)
+
+
+@_conv("masked_softmax")
+def _masked_softmax(ctx, s, ins, outs, shapes):  # noqa: ARG001
+    ax = int(s.attr("axis") if s.attr("axis") is not None else -1)
+    b = ctx.fresh(s.name + "_mask")
+    ctx.add_node("Cast", [ins[1]], [b], attrs={"to": 9})
+    neg = ctx.add_init(ctx.fresh(s.name + "_neg"), _np.float32(-1e30))
+    masked = ctx.fresh(s.name + "_m")
+    ctx.add_node("Where", [b, ins[0], neg], [masked])
+    temp = float(s.attr("temperature") or 1.0)
+    if temp != 1.0:
+        t = ctx.add_init(ctx.fresh(s.name + "_t"), _np.float32(temp))
+        scaled = ctx.fresh(s.name + "_sc")
+        ctx.add_node("Div", [masked, t], [scaled])
+        masked = scaled
+    sm = ctx.fresh(s.name + "_sm")
+    ctx.add_node("Softmax", [masked], [sm], attrs={"axis": ax})
+    zero = ctx.add_init(ctx.fresh(s.name + "_z"), _np.float32(0.0))
+    ctx.add_node("Where", [b, sm, zero], outs, s.name)
+
+
+@_conv("L2Normalization")
+def _l2norm(ctx, s, ins, outs, shapes):
+    # match runtime l2_normalization axes per mode (ops/nn.py:525):
+    # instance = all non-batch, channel = 1, spatial = 2..rank-1
+    mode = s.attr("mode") or "instance"
+    rank = len(shapes[0])
+    axes = {"instance": list(range(1, rank)), "channel": [1],
+            "spatial": list(range(2, rank))}[mode]
+    sq = ctx.fresh(s.name + "_sq")
+    ctx.add_node("Mul", [ins[0], ins[0]], [sq])
+    ss = ctx.fresh(s.name + "_ss")
+    ctx.add_node("ReduceSum", [sq], [ss], attrs={"axes": axes,
+                                                 "keepdims": 1})
+    eps = ctx.add_init(ctx.fresh(s.name + "_eps"),
+                       _np.float32(s.attr("eps") or 1e-10))
+    se = ctx.fresh(s.name + "_se")
+    ctx.add_node("Add", [ss, eps], [se])
+    nrm = ctx.fresh(s.name + "_n")
+    ctx.add_node("Sqrt", [se], [nrm])
+    ctx.add_node("Div", [ins[0], nrm], outs, s.name)
+
+
+@_conv("LRN")
+def _lrn(ctx, s, ins, outs, shapes):  # noqa: ARG001
+    ctx.add_node("LRN", ins, outs, s.name, {
+        "alpha": float(s.attr("alpha") or 1e-4),
+        "beta": float(s.attr("beta") or 0.75),
+        "bias": float(s.attr("knorm") or 2.0),
+        "size": int(s.attr("nsize") or 5)})
+
+
+@_conv("InstanceNorm")
+def _instance_norm(ctx, s, ins, outs, shapes):  # noqa: ARG001
+    ctx.add_node("InstanceNormalization", ins, outs, s.name,
+                 {"epsilon": float(s.attr("eps") or 1e-3)})
+
+
+@_conv("arange_like")
+def _arange_like(ctx, s, ins, outs, shapes):
+    ax = s.attr("axis")
+    n = shapes[0][int(ax) if ax is not None else 0]
+    start = float(s.attr("start") or 0.0)
+    step = float(s.attr("step") or 1.0)
+    ctx.add_node("Constant", [], outs, s.name,
+                 {"value": _np.arange(n, dtype=_np.float32) * step + start})
+
+
+@_conv("SliceChannel")
+def _slice_channel(ctx, s, ins, outs, shapes):
+    ax = int(s.attr("axis") if s.attr("axis") is not None else 1)
+    n = int(s.attr("num_outputs"))
+    size = shapes[0][ax] // n
+    splits = ctx.const_i64(s.name + "_splits", [size] * n)
+    ctx.add_node("Split", [ins[0], splits], outs, s.name, {"axis": ax})
 
 
 # --- shape inference over the symbol DAG -----------------------------------

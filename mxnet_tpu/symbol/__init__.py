@@ -13,6 +13,9 @@ from .symbol import (Executor, Group, Symbol, Variable, fromjson, load,
                      load_json, var, zeros, ones)
 from . import op  # registers the op table; also exposes sym.op.* wrappers
 from .op import *  # noqa: F401,F403
+from . import op_extended  # math tail, indexing, sequence, norms
+from .op_extended import *  # noqa: F401,F403
 
-__all__ = ["Symbol", "Variable", "Group", "Executor", "var", "load",
-           "load_json", "fromjson", "zeros", "ones"] + op.__all__
+__all__ = (["Symbol", "Variable", "Group", "Executor", "var", "load",
+            "load_json", "fromjson", "zeros", "ones"]
+           + op.__all__ + op_extended.__all__)

@@ -1,0 +1,333 @@
+"""Extended symbol operator table: math tail, comparisons, indexing,
+ordering, sequence ops, norms, shape utilities.
+
+Reference: the generated mx.sym.* corpus (symbol/register.py over the NNVM
+registry — 595 names). This module grows the symbol vocabulary to cover
+the reference's high-traffic graph ops so attention models (BERT) and the
+vision zoo can be expressed/round-tripped symbolically and exported to
+ONNX. Every op lowers to the same jnp implementations the imperative
+frontends use, so symbolic == imperative numerically by construction.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+from jax import lax
+
+from ..ops import nn as _nn
+from .symbol import Symbol, register_sym_op
+
+__all__ = []
+
+
+def _reg(name, **defaults):
+    """Register a lowering + return a Symbol-building wrapper (same
+    pattern as op.py, with default attrs)."""
+    def deco(fn):
+        register_sym_op(name, fn)
+
+        def wrapper(*inputs, name=None, **attrs):  # noqa: A002
+            merged = dict(defaults)
+            merged.update(attrs)
+            return Symbol.create(op_name, *inputs, name=name, **merged)
+
+        op_name = fn_name
+        wrapper.__name__ = name
+        __all__.append(name)
+        return wrapper
+
+    fn_name = name
+    return deco
+
+
+def _f(jfn):
+    return lambda ins, a: jfn(ins[0])
+
+
+# -- unary math tail --------------------------------------------------------
+sin = _reg("sin")(_f(jnp.sin))
+cos = _reg("cos")(_f(jnp.cos))
+tan = _reg("tan")(_f(jnp.tan))
+arcsin = _reg("arcsin")(_f(jnp.arcsin))
+arccos = _reg("arccos")(_f(jnp.arccos))
+arctan = _reg("arctan")(_f(jnp.arctan))
+sinh = _reg("sinh")(_f(jnp.sinh))
+cosh = _reg("cosh")(_f(jnp.cosh))
+arcsinh = _reg("arcsinh")(_f(jnp.arcsinh))
+arccosh = _reg("arccosh")(_f(jnp.arccosh))
+arctanh = _reg("arctanh")(_f(jnp.arctanh))
+degrees = _reg("degrees")(_f(jnp.degrees))
+radians = _reg("radians")(_f(jnp.radians))
+floor = _reg("floor")(_f(jnp.floor))
+ceil = _reg("ceil")(_f(jnp.ceil))
+round = _reg("round")(_f(jnp.round))  # noqa: A001
+rint = _reg("rint")(_f(jnp.rint))
+trunc = _reg("trunc")(_f(jnp.trunc))
+fix = _reg("fix")(_f(jnp.trunc))  # fix == trunc toward zero
+sign = _reg("sign")(_f(jnp.sign))
+reciprocal = _reg("reciprocal")(_f(lambda x: 1.0 / x))
+rsqrt = _reg("rsqrt")(_f(lax.rsqrt))
+cbrt = _reg("cbrt")(_f(jnp.cbrt))
+rcbrt = _reg("rcbrt")(_f(lambda x: 1.0 / jnp.cbrt(x)))
+expm1 = _reg("expm1")(_f(jnp.expm1))
+log1p = _reg("log1p")(_f(jnp.log1p))
+log2 = _reg("log2")(_f(jnp.log2))
+log10 = _reg("log10")(_f(jnp.log10))
+erf = _reg("erf")(_f(lax.erf))
+erfinv = _reg("erfinv")(_f(lax.erf_inv))
+gamma = _reg("gamma")(_f(lambda x: jnp.exp(lax.lgamma(x))))
+gammaln = _reg("gammaln")(_f(lax.lgamma))
+logical_not = _reg("logical_not")(
+    _f(lambda x: (~x.astype(bool)).astype(jnp.float32)))
+softsign = _reg("softsign")(_f(lambda x: x / (1 + jnp.abs(x))))
+hard_sigmoid = _reg("hard_sigmoid")(
+    lambda ins, a: jnp.clip(ins[0] * a.get("alpha", 0.2)
+                            + a.get("beta", 0.5), 0, 1))
+
+# -- binary / comparison (broadcast semantics: jnp broadcasts) --------------
+_b = {
+    "broadcast_maximum": jnp.maximum,
+    "broadcast_minimum": jnp.minimum,
+    "broadcast_power": jnp.power,
+    "broadcast_mod": jnp.mod,
+    "mod": jnp.mod,
+    "broadcast_hypot": jnp.hypot,
+    "broadcast_equal": lambda x, y: (x == y).astype(jnp.float32),
+    "broadcast_not_equal": lambda x, y: (x != y).astype(jnp.float32),
+    "broadcast_greater": lambda x, y: (x > y).astype(jnp.float32),
+    "broadcast_greater_equal": lambda x, y: (x >= y).astype(jnp.float32),
+    "broadcast_lesser": lambda x, y: (x < y).astype(jnp.float32),
+    "broadcast_lesser_equal": lambda x, y: (x <= y).astype(jnp.float32),
+    "broadcast_logical_and": lambda x, y: (
+        x.astype(bool) & y.astype(bool)).astype(jnp.float32),
+    "broadcast_logical_or": lambda x, y: (
+        x.astype(bool) | y.astype(bool)).astype(jnp.float32),
+    "broadcast_logical_xor": lambda x, y: (
+        x.astype(bool) ^ y.astype(bool)).astype(jnp.float32),
+}
+for _name, _jfn in _b.items():
+    globals()[_name] = _reg(_name)(
+        lambda ins, a, _j=_jfn: _j(ins[0], ins[1]))
+
+# -- reductions tail --------------------------------------------------------
+
+
+def _axis(a):
+    ax = a.get("axis")
+    return tuple(ax) if isinstance(ax, list) else ax
+
+
+nansum = _reg("nansum")(
+    lambda ins, a: jnp.nansum(ins[0], axis=_axis(a),
+                              keepdims=a.get("keepdims", False)))
+nanprod = _reg("nanprod")(
+    lambda ins, a: jnp.nanprod(ins[0], axis=_axis(a),
+                               keepdims=a.get("keepdims", False)))
+logsumexp = _reg("logsumexp")(
+    lambda ins, a: jax_logsumexp(ins[0], _axis(a),
+                                 a.get("keepdims", False)))
+
+
+def jax_logsumexp(x, axis, keepdims):
+    m = jnp.max(x, axis=axis, keepdims=True)
+    out = jnp.log(jnp.sum(jnp.exp(x - m), axis=axis, keepdims=True)) + m
+    return out if keepdims else jnp.squeeze(
+        out, axis if axis is not None else None)
+
+
+argmax_channel = _reg("argmax_channel")(
+    lambda ins, a: jnp.argmax(ins[0], axis=1).astype(jnp.float32))
+
+# -- dtype / shape utilities ------------------------------------------------
+cast = _reg("Cast")(lambda ins, a: ins[0].astype(a["dtype"]))
+Cast = cast
+__all__.append("Cast")
+shape_array = _reg("shape_array")(
+    lambda ins, a: jnp.asarray(ins[0].shape, jnp.int64))
+size_array = _reg("size_array")(
+    lambda ins, a: jnp.asarray([ins[0].size], jnp.int64))
+tile = _reg("tile")(lambda ins, a: jnp.tile(ins[0], tuple(a["reps"])))
+repeat = _reg("repeat")(
+    lambda ins, a: jnp.repeat(ins[0], a["repeats"], axis=a.get("axis")))
+flip = _reg("flip")(lambda ins, a: jnp.flip(ins[0], axis=a.get("axis")))
+reverse = _reg("reverse")(
+    lambda ins, a: jnp.flip(ins[0], axis=a.get("axis")))
+
+
+def _pad_impl(ins, a):
+    mode = a.get("mode", "constant")
+    pw = a["pad_width"]
+    pairs = [(pw[2 * i], pw[2 * i + 1]) for i in range(len(pw) // 2)]
+    if mode == "constant":
+        return jnp.pad(ins[0], pairs,
+                       constant_values=a.get("constant_value", 0.0))
+    return jnp.pad(ins[0], pairs, mode="reflect" if mode == "reflect"
+                   else "edge")
+
+
+pad = _reg("pad")(_pad_impl)
+Pad = pad
+register_sym_op("Pad", _pad_impl)
+__all__.append("Pad")
+
+
+def _space_to_depth(ins, a):
+    b = a["block_size"]
+    n, c, h, w = ins[0].shape
+    x = ins[0].reshape(n, c, h // b, b, w // b, b)
+    return x.transpose(0, 3, 5, 1, 2, 4).reshape(n, c * b * b, h // b,
+                                                 w // b)
+
+
+def _depth_to_space(ins, a):
+    b = a["block_size"]
+    n, c, h, w = ins[0].shape
+    x = ins[0].reshape(n, b, b, c // (b * b), h, w)
+    return x.transpose(0, 3, 4, 1, 5, 2).reshape(n, c // (b * b), h * b,
+                                                 w * b)
+
+
+space_to_depth = _reg("space_to_depth")(_space_to_depth)
+depth_to_space = _reg("depth_to_space")(_depth_to_space)
+
+
+def _broadcast_axis(ins, a):
+    axes = a["axis"]
+    sizes = a["size"]
+    if isinstance(axes, int):
+        axes, sizes = [axes], [sizes]
+    shape = list(ins[0].shape)
+    for ax, sz in zip(axes, sizes):
+        shape[ax] = sz
+    return jnp.broadcast_to(ins[0], tuple(shape))
+
+
+broadcast_axis = _reg("broadcast_axis")(_broadcast_axis)
+broadcast_like = _reg("broadcast_like")(
+    lambda ins, a: jnp.broadcast_to(ins[0], ins[1].shape))
+
+# -- indexing / ordering ----------------------------------------------------
+gather_nd = _reg("gather_nd")(
+    lambda ins, a: ins[0][tuple(ins[1].astype(jnp.int32))])
+batch_take = _reg("batch_take")(
+    lambda ins, a: jnp.take_along_axis(
+        ins[0], ins[1].astype(jnp.int32)[:, None], axis=1)[:, 0])
+pick = _reg("pick")(
+    lambda ins, a: _nn.pick(ins[0], ins[1], axis=a.get("axis", -1),
+                            keepdims=a.get("keepdims", False)))
+sort = _reg("sort")(
+    lambda ins, a: jnp.sort(ins[0], axis=a.get("axis", -1))
+    if not a.get("is_ascend") in (False, 0)
+    else -jnp.sort(-ins[0], axis=a.get("axis", -1)))
+argsort = _reg("argsort")(
+    lambda ins, a: (jnp.argsort(ins[0], axis=a.get("axis", -1))
+                    if a.get("is_ascend", True) not in (False, 0)
+                    else jnp.argsort(-ins[0], axis=a.get("axis", -1)))
+    .astype(a.get("dtype", jnp.float32)))
+
+
+def _topk_impl(ins, a):
+    return _nn.topk(ins[0], k=a.get("k", 1), axis=a.get("axis", -1),
+                    ret_typ=a.get("ret_typ", "indices"),
+                    is_ascend=a.get("is_ascend", False))
+
+
+register_sym_op("topk", _topk_impl)
+
+
+def topk(data, k=1, axis=-1, ret_typ="indices", is_ascend=False,
+         name=None):
+    nout = 2 if ret_typ == "both" else 1
+    return Symbol.create("topk", data, name=name, nout=nout, k=k, axis=axis,
+                         ret_typ=ret_typ, is_ascend=is_ascend)
+
+
+__all__ += ["topk"]
+
+take_axis = None  # (take lives in op.py)
+
+# -- sequence ops -----------------------------------------------------------
+SequenceMask = _reg("SequenceMask")(
+    lambda ins, a: _nn.sequence_mask(
+        ins[0], ins[1] if len(ins) > 1 else None,
+        use_sequence_length=a.get("use_sequence_length", False),
+        value=a.get("value", 0.0), axis=a.get("axis", 0)))
+SequenceLast = _reg("SequenceLast")(
+    lambda ins, a: _nn.sequence_last(
+        ins[0], ins[1] if len(ins) > 1 else None,
+        use_sequence_length=a.get("use_sequence_length", False),
+        axis=a.get("axis", 0)))
+SequenceReverse = _reg("SequenceReverse")(
+    lambda ins, a: _nn.sequence_reverse(
+        ins[0], ins[1] if len(ins) > 1 else None,
+        use_sequence_length=a.get("use_sequence_length", False)))
+
+# -- NN tail ----------------------------------------------------------------
+softmin = _reg("softmin")(
+    lambda ins, a: _nn.softmin(ins[0], axis=a.get("axis", -1)))
+masked_softmax = _reg("masked_softmax")(
+    lambda ins, a: jnp.where(
+        ins[1].astype(bool),
+        _nn.softmax(jnp.where(ins[1].astype(bool), ins[0], -1e30) /
+                    a.get("temperature", 1.0), axis=a.get("axis", -1)),
+        0.0))
+GroupNorm = _reg("GroupNorm")(
+    lambda ins, a: _nn.group_norm(ins[0], ins[1], ins[2],
+                                  num_groups=a.get("num_groups", 1),
+                                  eps=a.get("eps", 1e-5)))
+InstanceNorm = _reg("InstanceNorm")(
+    lambda ins, a: _nn.instance_norm(ins[0], ins[1], ins[2],
+                                     eps=a.get("eps", 1e-3)))
+RMSNorm = _reg("RMSNorm")(
+    lambda ins, a: _nn.rms_norm(ins[0], ins[1], axis=a.get("axis", -1),
+                                eps=a.get("eps", 1e-6)))
+L2Normalization = _reg("L2Normalization")(
+    lambda ins, a: _nn.l2_normalization(ins[0], mode=a.get("mode", "instance"),
+                                        eps=a.get("eps", 1e-10)))
+LRN = _reg("LRN")(
+    lambda ins, a: _nn.lrn(ins[0], alpha=a.get("alpha", 1e-4),
+                           beta=a.get("beta", 0.75), knorm=a.get("knorm", 2),
+                           nsize=a.get("nsize", 5)))
+UpSampling = _reg("UpSampling")(
+    lambda ins, a: _nn.upsample(ins[0], scale=a.get("scale", 2),
+                                sample_type=a.get("sample_type", "nearest")))
+SoftmaxActivation = _reg("SoftmaxActivation")(
+    lambda ins, a: _nn.softmax(
+        ins[0], axis=1 if a.get("mode") == "channel" else -1))
+GELU = _reg("GELU")(lambda ins, a: _nn.activation(ins[0], "erf_gelu"))
+# exact erf formulation — matches the reference GELU and the ONNX converter
+softplus = _reg("softplus")(
+    _f(lambda x: jnp.log1p(jnp.exp(-jnp.abs(x))) + jnp.maximum(x, 0)))
+log_sigmoid = _reg("log_sigmoid")(
+    _f(lambda x: -(jnp.log1p(jnp.exp(-jnp.abs(x))) + jnp.maximum(-x, 0))))
+mish = _reg("mish")(
+    _f(lambda x: x * jnp.tanh(
+        jnp.log1p(jnp.exp(-jnp.abs(x))) + jnp.maximum(x, 0))))
+
+# -- SliceChannel (legacy alias of split) -----------------------------------
+register_sym_op(
+    "SliceChannel",
+    lambda ins, a: tuple(jnp.split(ins[0], a["num_outputs"],
+                                   axis=a.get("axis", 1))))
+
+
+def SliceChannel(data, num_outputs, axis=1, squeeze_axis=False, name=None):
+    if squeeze_axis:
+        raise NotImplementedError("squeeze_axis=True not supported")
+    return Symbol.create("SliceChannel", data, name=name, nout=num_outputs,
+                         num_outputs=num_outputs, axis=axis)
+
+
+__all__.append("SliceChannel")
+
+# -- identity / blockgrad ---------------------------------------------------
+identity = _reg("identity")(lambda ins, a: ins[0])
+BlockGrad = _reg("BlockGrad")(lambda ins, a: lax.stop_gradient(ins[0]))
+stop_gradient = BlockGrad
+__all__.append("stop_gradient")
+make_loss = _reg("make_loss")(lambda ins, a: ins[0])
+
+# -- arange_like (positions for attention) ----------------------------------
+arange_like = _reg("arange_like")(
+    lambda ins, a: jnp.arange(
+        ins[0].shape[a.get("axis") if a.get("axis") is not None else 0],
+        dtype=jnp.float32) * a.get("step", 1.0) + a.get("start", 0.0))

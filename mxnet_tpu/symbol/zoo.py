@@ -1,0 +1,221 @@
+"""Symbolic model builders (reference: the mx.sym model definitions of
+example/image-classification/symbols/*.py and the gluon model_zoo
+architectures re-expressed as symbol graphs).
+
+These produce pure `mx.symbol` DAGs — the deployment/ONNX-export form.
+Each builder returns (sym, param_shapes): `param_shapes` maps every
+non-data argument to its shape so callers can materialize random or
+loaded weights for `bind`/`export_model`.
+"""
+from __future__ import annotations
+
+import math
+
+from . import op as _op
+from . import op_extended as _ext
+from .symbol import Symbol, var
+
+__all__ = ["lenet_symbol", "mlp_symbol", "resnet_symbol", "bert_symbol",
+           "get_symbol"]
+
+
+class _P:
+    """Collects parameter variables + shapes as they are declared."""
+
+    def __init__(self):
+        self.shapes = {}
+
+    def var(self, name, shape):
+        self.shapes[name] = tuple(shape)
+        return var(name)
+
+
+def mlp_symbol(num_classes=10, in_units=784, hidden=(128, 64)):
+    """Feed-forward classifier (reference: symbols/mlp.py shape)."""
+    p = _P()
+    x = var("data")
+    h = x
+    prev = in_units
+    for i, units in enumerate(hidden):
+        w = p.var(f"fc{i}_weight", (units, prev))
+        b = p.var(f"fc{i}_bias", (units,))
+        h = _op.Activation(_op.FullyConnected(h, w, b, num_hidden=units),
+                           "relu")
+        prev = units
+    w = p.var("out_weight", (num_classes, prev))
+    b = p.var("out_bias", (num_classes,))
+    out = _op.softmax(_op.FullyConnected(h, w, b, num_hidden=num_classes))
+    return out, p.shapes
+
+
+def lenet_symbol(num_classes=10):
+    """LeNet-5 graph (reference: symbols/lenet.py shape)."""
+    p = _P()
+    x = var("data")  # (N, 1, 28, 28)
+    c1 = _op.Convolution(x, p.var("conv0_weight", (6, 1, 5, 5)),
+                         p.var("conv0_bias", (6,)), kernel=(5, 5),
+                         num_filter=6, pad=(2, 2))
+    a1 = _op.Activation(c1, "tanh")
+    s1 = _op.Pooling(a1, kernel=(2, 2), pool_type="avg", stride=(2, 2))
+    c2 = _op.Convolution(s1, p.var("conv1_weight", (16, 6, 5, 5)),
+                         p.var("conv1_bias", (16,)), kernel=(5, 5),
+                         num_filter=16)
+    a2 = _op.Activation(c2, "tanh")
+    s2 = _op.Pooling(a2, kernel=(2, 2), pool_type="avg", stride=(2, 2))
+    f = _op.Flatten(s2)
+    h = _op.Activation(
+        _op.FullyConnected(f, p.var("fc0_weight", (120, 400)),
+                           p.var("fc0_bias", (120,)), num_hidden=120),
+        "tanh")
+    h = _op.Activation(
+        _op.FullyConnected(h, p.var("fc1_weight", (84, 120)),
+                           p.var("fc1_bias", (84,)), num_hidden=84),
+        "tanh")
+    out = _op.softmax(
+        _op.FullyConnected(h, p.var("fc2_weight", (num_classes, 84)),
+                           p.var("fc2_bias", (num_classes,)),
+                           num_hidden=num_classes))
+    return out, p.shapes
+
+
+def _conv_bn_relu(p, x, name, c_in, c_out, kernel, stride, pad, relu=True):
+    w = p.var(f"{name}_weight", (c_out, c_in) + kernel)
+    y = _op.Convolution(x, w, None, kernel=kernel, num_filter=c_out,
+                        stride=stride, pad=pad, no_bias=True, name=name)
+    g = p.var(f"{name}_bn_gamma", (c_out,))
+    b = p.var(f"{name}_bn_beta", (c_out,))
+    mm = p.var(f"{name}_bn_mean", (c_out,))
+    mv = p.var(f"{name}_bn_var", (c_out,))
+    y = _op.BatchNorm(y, g, b, mm, mv, name=f"{name}_bn")
+    if relu:
+        y = _op.Activation(y, "relu", name=f"{name}_relu")
+    return y
+
+
+def resnet_symbol(num_layers=18, num_classes=1000):
+    """ResNet-v1 basic/bottleneck graph (reference:
+    symbols/resnet.py / gluon model_zoo resnet architecture)."""
+    specs = {18: ([2, 2, 2, 2], [64, 64, 128, 256, 512], "basic"),
+             34: ([3, 4, 6, 3], [64, 64, 128, 256, 512], "basic"),
+             50: ([3, 4, 6, 3], [64, 256, 512, 1024, 2048], "bottleneck")}
+    layers, channels, kind = specs[num_layers]
+    p = _P()
+    x = var("data")  # (N, 3, H, W)
+    y = _conv_bn_relu(p, x, "stem", 3, channels[0], (7, 7), (2, 2), (3, 3))
+    y = _op.Pooling(y, kernel=(3, 3), pool_type="max", stride=(2, 2),
+                    pad=(1, 1))
+    c_in = channels[0]
+    for stage, (n, c_out) in enumerate(zip(layers, channels[1:])):
+        stride = (1, 1) if stage == 0 else (2, 2)
+        for blk in range(n):
+            nm = f"s{stage}b{blk}"
+            s = stride if blk == 0 else (1, 1)
+            if kind == "basic":
+                body = _conv_bn_relu(p, y, f"{nm}_c0", c_in, c_out, (3, 3),
+                                     s, (1, 1))
+                body = _conv_bn_relu(p, body, f"{nm}_c1", c_out, c_out,
+                                     (3, 3), (1, 1), (1, 1), relu=False)
+            else:
+                mid = c_out // 4
+                body = _conv_bn_relu(p, y, f"{nm}_c0", c_in, mid, (1, 1),
+                                     s, (0, 0))
+                body = _conv_bn_relu(p, body, f"{nm}_c1", mid, mid, (3, 3),
+                                     (1, 1), (1, 1))
+                body = _conv_bn_relu(p, body, f"{nm}_c2", mid, c_out,
+                                     (1, 1), (1, 1), (0, 0), relu=False)
+            if blk == 0 and (c_in != c_out or s != (1, 1)):
+                sc = _conv_bn_relu(p, y, f"{nm}_sc", c_in, c_out, (1, 1),
+                                   s, (0, 0), relu=False)
+            else:
+                sc = y
+            y = _op.Activation(body + sc, "relu", name=f"{nm}_out")
+            c_in = c_out
+    y = _op.Pooling(y, global_pool=True, pool_type="avg", kernel=(1, 1))
+    y = _op.Flatten(y)
+    out = _op.softmax(
+        _op.FullyConnected(y, p.var("fc_weight", (num_classes, c_in)),
+                           p.var("fc_bias", (num_classes,)),
+                           num_hidden=num_classes))
+    return out, p.shapes
+
+
+def bert_symbol(num_layers=2, units=64, num_heads=2, hidden_size=128,
+                vocab_size=1000, max_length=64, seq_len=16):
+    """BERT encoder + QA span head as a symbol graph (architecture:
+    gluon/model_zoo/bert.py; reference ONNX-export target per the
+    mx2onnx BERT coverage in _op_translations).
+
+    Returns logits (N, seq_len, 2) — start/end span scores.
+    """
+    assert units % num_heads == 0
+    d = units // num_heads
+    p = _P()
+    tokens = var("data0")     # (N, S) token ids
+    segments = var("data1")   # (N, S) segment ids
+
+    word_emb = _ext.cast(
+        _op.Embedding(tokens, p.var("word_embed_weight",
+                                    (vocab_size, units))), dtype="float32")
+    seg_emb = _ext.cast(
+        _op.Embedding(segments, p.var("token_type_embed_weight",
+                                      (2, units))), dtype="float32")
+    pos_full = p.var("position_weight", (max_length, units))
+    pos_emb = _op.slice(pos_full, begin=(0, 0), end=(seq_len, units))
+    x = _op.broadcast_add(word_emb + seg_emb,
+                          _op.expand_dims(pos_emb, axis=0))
+    x = _op.LayerNorm(x, p.var("embed_ln_gamma", (units,)),
+                      p.var("embed_ln_beta", (units,)))
+
+    for i in range(num_layers):
+        nm = f"layer{i}"
+        qkv_w = p.var(f"{nm}_qkv_weight", (3 * units, units))
+        qkv_b = p.var(f"{nm}_qkv_bias", (3 * units,))
+        qkv = _op.FullyConnected(x, qkv_w, qkv_b, num_hidden=3 * units,
+                                 flatten=False)          # (N, S, 3U)
+        qkv = _op.reshape(qkv, shape=(-1, seq_len, 3, num_heads, d))
+        qkv = _op.transpose(qkv, axes=(2, 0, 3, 1, 4))   # (3, N, H, S, d)
+        q = _op.reshape(_op.slice_axis(qkv, axis=0, begin=0, end=1),
+                        shape=(-1, seq_len, d))          # (N*H, S, d)
+        k = _op.reshape(_op.slice_axis(qkv, axis=0, begin=1, end=2),
+                        shape=(-1, seq_len, d))
+        v = _op.reshape(_op.slice_axis(qkv, axis=0, begin=2, end=3),
+                        shape=(-1, seq_len, d))
+        scores = _op.batch_dot(q, _op.transpose(k, axes=(0, 2, 1)))
+        att = _op.softmax(scores / math.sqrt(d))
+        ctxv = _op.batch_dot(att, v)                     # (N*H, S, d)
+        ctxv = _op.reshape(ctxv, shape=(-1, num_heads, seq_len, d))
+        ctxv = _op.transpose(ctxv, axes=(0, 2, 1, 3))
+        ctxv = _op.reshape(ctxv, shape=(-1, seq_len, units))
+        proj = _op.FullyConnected(
+            ctxv, p.var(f"{nm}_proj_weight", (units, units)),
+            p.var(f"{nm}_proj_bias", (units,)), num_hidden=units,
+            flatten=False)
+        x = _op.LayerNorm(x + proj, p.var(f"{nm}_ln0_gamma", (units,)),
+                          p.var(f"{nm}_ln0_beta", (units,)))
+        ffn = _ext.GELU(_op.FullyConnected(
+            x, p.var(f"{nm}_ffn0_weight", (hidden_size, units)),
+            p.var(f"{nm}_ffn0_bias", (hidden_size,)),
+            num_hidden=hidden_size, flatten=False))
+        ffn = _op.FullyConnected(
+            ffn, p.var(f"{nm}_ffn1_weight", (units, hidden_size)),
+            p.var(f"{nm}_ffn1_bias", (units,)), num_hidden=units,
+            flatten=False)
+        x = _op.LayerNorm(x + ffn, p.var(f"{nm}_ln1_gamma", (units,)),
+                          p.var(f"{nm}_ln1_beta", (units,)))
+
+    logits = _op.FullyConnected(
+        x, p.var("qa_weight", (2, units)), p.var("qa_bias", (2,)),
+        num_hidden=2, flatten=False)                     # (N, S, 2)
+    return logits, p.shapes
+
+
+_BUILDERS = {"mlp": mlp_symbol, "lenet": lenet_symbol,
+             "resnet": resnet_symbol, "bert": bert_symbol}
+
+
+def get_symbol(name, **kwargs):
+    """Build a named symbolic model: mlp | lenet | resnet | bert."""
+    if name not in _BUILDERS:
+        raise ValueError(f"unknown symbolic model {name!r}; "
+                         f"choose from {sorted(_BUILDERS)}")
+    return _BUILDERS[name](**kwargs)
