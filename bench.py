@@ -284,8 +284,17 @@ def main():
     warmup = WARMUP if platform != "cpu" else 1
     suffix = "" if platform != "cpu" else "_CPU_FALLBACK"
 
-    train_img_s, infer_img_s = bench_resnet_train(
-        platform, layout, batch, iters, warmup)
+    try:
+        train_img_s, infer_img_s = bench_resnet_train(
+            platform, layout, batch, iters, warmup)
+    except Exception as e:  # e.g. RESOURCE_EXHAUSTED at b=256 — retry half
+        if batch <= 32:
+            raise
+        print(f"batch {batch} failed ({type(e).__name__}); retrying "
+              f"b={batch // 2}", file=sys.stderr)
+        batch //= 2
+        train_img_s, infer_img_s = bench_resnet_train(
+            platform, layout, batch, iters, warmup)
 
     rows = [{
         "metric": f"resnet50_infer_bf16_b{batch}_imgs_per_sec_per_chip"

@@ -24,6 +24,7 @@ from __future__ import annotations
 __version__ = "0.1.0"
 
 from . import autograd, base, device, engine
+from . import env  # typed env-var registry (env_var.md analog)
 from . import _random
 from .base import MXNetError
 from .device import (

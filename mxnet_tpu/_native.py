@@ -40,7 +40,9 @@ def _build():
 
 
 def _load():
-    if os.environ.get("MXTPU_DISABLE_NATIVE"):
+    from . import env as _env
+
+    if _env.get("MXTPU_DISABLE_NATIVE"):
         return None
     if not os.path.exists(_SO_PATH) and not _build():
         return None

@@ -107,6 +107,11 @@ def convert_hybrid_block(net, target_dtype="bfloat16", target_dtype_ops=None,
     """Convert a HybridBlock to mixed precision (reference: amp.py:676
     convert_hybrid_block): params cast to bf16 except norm/scale params;
     the compiled program then runs matmuls/convs on the MXU in bf16.
+
+    For the reference's *graph-level* cast conversion
+    (low_precision_pass.cc — every op forced through the cast lists
+    regardless of how it was written), see
+    amp.graph_pass.convert_block_graph, which rewrites the traced jaxpr.
     """
     dtype = normalize_dtype("bfloat16" if target_dtype in (
         "float16", "fp16", "bfloat16", "bf16") else target_dtype)
@@ -161,3 +166,7 @@ class LossScaler:
             if self._unskipped >= self._window:
                 self.loss_scale *= self._factor
                 self._unskipped = 0
+
+
+from . import graph_pass  # noqa: E402
+from .graph_pass import convert_block_graph  # noqa: E402

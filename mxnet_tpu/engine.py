@@ -40,7 +40,9 @@ _live = weakref.WeakSet()
 
 # MXNET_ENGINE_TYPE parity: 'ThreadedEnginePerDevice' (default, async) or
 # 'NaiveEngine' (synchronous eager dispatch, for deterministic debugging).
-_engine_type = os.environ.get("MXNET_ENGINE_TYPE", "ThreadedEnginePerDevice")
+from . import env as _env
+
+_engine_type = _env.get("MXNET_ENGINE_TYPE")
 
 
 def engine_type():

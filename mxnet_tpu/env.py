@@ -1,0 +1,118 @@
+"""Typed environment-variable registry (reference: the ~85 documented
+MXNET_* vars read via dmlc::GetEnv at point of use + the env_var.md doc
+page; per-var typed, self-documenting fields like dmlc::Parameter).
+
+Every knob the framework reads from the environment is declared here with
+type, default, and documentation. `mx.env.doc()` renders the env_var.md
+analog; `mx.runtime.feature_list()` complements this with build/runtime
+features. Reference-era MXNET_* names that have a TPU-native counterpart
+are registered under BOTH spellings so ported launch scripts keep working.
+"""
+from __future__ import annotations
+
+import os
+
+__all__ = ["EnvVar", "register", "get", "all_vars", "doc"]
+
+_REGISTRY = {}
+
+
+class EnvVar:
+    def __init__(self, name, type_, default, help_, aliases=()):
+        self.name = name
+        self.type = type_
+        self.default = default
+        self.help = help_
+        self.aliases = tuple(aliases)
+
+    def read(self):
+        for n in (self.name, *self.aliases):
+            raw = os.environ.get(n)
+            if raw is not None:
+                if self.type is bool:
+                    return raw.lower() not in ("", "0", "false", "off")
+                return self.type(raw)
+        return self.default
+
+
+def register(name, type_, default, help_, aliases=()):
+    v = EnvVar(name, type_, default, help_, aliases)
+    _REGISTRY[name] = v
+    return v
+
+
+def get(name):
+    """Read an env var through its registry entry (typed, with default)."""
+    return _REGISTRY[name].read()
+
+
+def all_vars():
+    return dict(_REGISTRY)
+
+
+def doc():
+    """Render the env-var documentation (the env_var.md analog)."""
+    lines = ["# Environment variables", ""]
+    for v in sorted(_REGISTRY.values(), key=lambda v: v.name):
+        alias = f" (aliases: {', '.join(v.aliases)})" if v.aliases else ""
+        lines.append(f"* `{v.name}`{alias} — {v.help} "
+                     f"(type: {v.type.__name__}, default: {v.default!r})")
+    return "\n".join(lines)
+
+
+# ---------------------------------------------------------------------------
+# the knob corpus
+# ---------------------------------------------------------------------------
+
+register(
+    "MXNET_ENGINE_TYPE", str, "ThreadedEnginePerDevice",
+    "Dependency-engine implementation: ThreadedEnginePerDevice (async, the "
+    "default) or NaiveEngine (synchronous — deterministic repro/debugging; "
+    "reference: src/engine/engine.cc:32).")
+register(
+    "MXTPU_DISABLE_NATIVE", bool, False,
+    "Disable the native C++ runtime (engine/storage/RecordIO/pipeline) and "
+    "fall back to pure-python equivalents.")
+register(
+    "MXTPU_MP_START", str, "",
+    "DataLoader multiprocessing start method override: fork | spawn | "
+    "forkserver. Default: fork from a single-threaded parent, else spawn.")
+register(
+    "MXNET_CPU_WORKER_NTHREADS", int, 1,
+    "Default host worker-thread count hint for the native pipeline "
+    "(reference: threaded_engine_perdevice.cc:98).")
+register(
+    "MXNET_EXEC_BULK_EXEC_TRAIN", bool, True,
+    "Parity no-op: XLA fuses whole programs — bulking has no separate "
+    "switch (reference: bulking env family).")
+register(
+    "MXNET_ENFORCE_DETERMINISM", bool, False,
+    "Prefer deterministic lowering (maps to XLA deterministic reductions "
+    "where available; RNG is always counter-based/deterministic).")
+register(
+    "MXNET_SAFE_ACCUMULATION", bool, True,
+    "Accumulate bf16 reductions in fp32 (the framework always does this "
+    "on TPU; exposed for reference parity).")
+register(
+    "MXTPU_BENCH_LAYOUT", str, "NHWC",
+    "bench.py conv layout experiment knob: NHWC (channels-last, MXU lane "
+    "dim) or NCHW.")
+register(
+    "MXTPU_BENCH_BATCH", int, 256,
+    "bench.py per-chip batch size.")
+register(
+    "MXTPU_BENCH_HEADLINE_ONLY", bool, False,
+    "bench.py: skip the secondary rows (LeNet/BERT/INT8), emit only the "
+    "ResNet training+inference numbers.")
+register(
+    "SCALING_DEVICES", int, 8,
+    "benchmark/scaling.py virtual device count for the weak-scaling "
+    "partition-efficiency measurement.")
+register(
+    "MXNET_KVSTORE_BIGARRAY_BOUND", int, 1 << 20,
+    "Parity knob: arrays above this element count prefer sharded "
+    "(reduce-scatter) allreduce in tpu_dist.")
+register(
+    "MXNET_GPU_MEM_POOL_TYPE", str, "Naive",
+    "Parity no-op on TPU: device memory pooling is PJRT's "
+    "(reference: pooled_storage_manager.h buckets).")

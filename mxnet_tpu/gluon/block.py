@@ -137,8 +137,6 @@ class Block:
         """Initialize all parameters (reference: Block.initialize)."""
         device = device if device is not None else ctx
         for name, p in self.collect_params().items():
-            if not p._name or p._name in ("weight", "bias", "gamma", "beta"):
-                p._structure = name
             p.initialize(init=None, device=device,
                          default_init=init or _default_init(),
                          force_reinit=force_reinit)
@@ -318,6 +316,14 @@ class HybridBlock(Block):
         object.__setattr__(self, "_cached_param_list", None)
         object.__setattr__(self, "_state_params", {})
         object.__setattr__(self, "_flags", {})
+        # thread-safe CachedOp analog (reference:
+        # src/imperative/cached_op_threadsafe.cc): one lock guards variant
+        # build + aux-state swap so concurrent inference threads share the
+        # compiled executable safely. Executing the jitted fn itself is
+        # thread-safe (XLA executables are immutable).
+        import threading as _threading
+
+        object.__setattr__(self, "_cache_lock", _threading.RLock())
 
     def hybridize(self, active=True, backend=None, backend_opts=None,
                   **kwargs):  # noqa: ARG002
@@ -332,8 +338,26 @@ class HybridBlock(Block):
         for child in self._children.values():
             child.hybridize(False)
 
-    def optimize_for(self, x, *args, backend=None, **kwargs):  # noqa: ARG002
+    def optimize_for(self, x, *args, backend=None, backend_opts=None,
+                     **kwargs):  # noqa: ARG002
+        """Compile with an optional subgraph backend (reference:
+        HybridBlock.optimize_for, block.py:1281 → build_subgraph.cc).
+
+        With backend=None this is hybridize+run. With a registered
+        backend name (mxnet_tpu.subgraph.register_backend), the traced
+        jaxpr is partitioned: maximal regions matched by the backend are
+        replaced by its substituted implementations, and the partitioned
+        program becomes this block's compiled variant."""
         self.hybridize(True)
+        if backend is None:
+            return self(x, *args)
+        # record the backend; the variant is (re)built from it on demand —
+        # so cast()/load_parameters()/_clear_cached() cannot silently drop
+        # the partitioned program (reference: HybridBlock remembers its
+        # backend and re-partitions in _build_cache)
+        object.__setattr__(self, "_variant_builder", ("subgraph", backend))
+        object.__setattr__(self, "_subgraph_backend", backend)
+        self._jit_variants.clear()
         return self(x, *args)
 
     def _clear_cached(self):
@@ -377,9 +401,11 @@ class HybridBlock(Block):
             with ag.pause():
                 self.forward(*args)
 
-    def _build_jit(self, training):
+    def _make_cached_fn(self, training):
+        """The traceable whole-block function (shared by the plain jit
+        variant and the subgraph-partitioned variant)."""
         params = sorted(self.collect_params().items())
-        self._cached_param_list = params
+        object.__setattr__(self, "_cached_param_list", params)
         block = self
 
         def cached_fn(param_data, key, *input_datas):
@@ -390,15 +416,52 @@ class HybridBlock(Block):
             block._state_params[training] = list(sink.params)
             return out_datas, tuple(sink.values)
 
-        return jax.jit(cached_fn)
+        return cached_fn
+
+    def _build_jit(self, training):
+        return jax.jit(self._make_cached_fn(training))
+
+    def _build_variant(self, training, args):
+        """Build the compiled variant honoring any recorded graph rewrite
+        (subgraph backend / AMP graph pass)."""
+        builder = getattr(self, "_variant_builder", None)
+        if builder is None:
+            return self._build_jit(training)
+        kind, payload = builder
+        cached_fn = self._make_cached_fn(training)
+        pd = {n: p.data()._data for n, p in self._cached_param_list}
+        key = _random.next_key()
+        datas = [a._data for a in args]
+        if kind == "subgraph":
+            from .. import subgraph as _subgraph
+
+            part, n_sub = _subgraph.partition_call(
+                cached_fn, payload, pd, key, *datas)
+            object.__setattr__(self, "_subgraph_count", n_sub)
+            return jax.jit(part)
+        if kind == "amp_graph":
+            from ..amp.graph_pass import build_amp_variant
+
+            fn, stats = build_amp_variant(cached_fn, payload, pd, key,
+                                          datas)
+            object.__setattr__(self, "_amp_stats", stats)
+            return fn
+        raise ValueError(f"unknown variant builder {kind!r}")
 
     def _call_cached(self, *args):
-        self._ensure_initialized(args)
         training = bool(ag.is_training())
         jitted = self._jit_variants.get(training)
         if jitted is None:
-            jitted = self._build_jit(training)
-            self._jit_variants[training] = jitted
+            # one thread completes deferred init + builds; others reuse
+            # (reference: cached_op_threadsafe.cc serializes graph setup)
+            with self._cache_lock:
+                jitted = self._jit_variants.get(training)
+                if jitted is None:
+                    self._ensure_initialized(args)
+                    jitted = self._build_variant(training, args)
+                    self._jit_variants[training] = jitted
+        else:
+            self._ensure_initialized(args)
         params = self._cached_param_list
         names = [n for n, _ in params]
         param_nds = [p.data() for _, p in params]
@@ -421,12 +484,15 @@ class HybridBlock(Block):
         else:
             out_datas, state_vals = jitted(pd, key, *arr_datas)
 
-        # apply aux state updates (BN running stats)
+        # apply aux state updates (BN running stats) — serialized so
+        # concurrent threads cannot interleave half-written stats
         state_params = self._state_params.get(training) or ()
-        for p, v in zip(state_params, state_vals):
-            target = p.data() if isinstance(p, Parameter) else p
-            target._data = v
-            target._version += 1
+        if state_params:
+            with self._cache_lock:
+                for p, v in zip(state_params, state_vals):
+                    target = p.data() if isinstance(p, Parameter) else p
+                    target._data = v
+                    target._version += 1
 
         flat_out, treedef = jax.tree_util.tree_flatten(out_datas)
         wrapped_flat = [NDArray(o) for o in flat_out]

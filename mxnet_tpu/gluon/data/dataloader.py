@@ -1,14 +1,20 @@
 """DataLoader (reference: python/mxnet/gluon/data/dataloader.py:123-305).
 
-The reference's multiprocessing workers + shared-memory NDArray pickling are
-a CPU-side mechanism; the TPU-native pipeline keeps batches as host numpy
-until the last moment and lets `device_put` (async) overlap H2D with compute.
-num_workers>0 uses a thread pool (the GIL is released in numpy/decode work;
-TPU input pipelines are rarely Python-bound the way OpenCV-on-CPU was) and a
-prefetch queue mirroring iter_prefetcher.h.
+Worker modes (matching the reference's semantics):
+  * num_workers=0 — synchronous in the caller.
+  * num_workers>0 (default) — multiprocessing fork workers, like the
+    reference's _MultiWorkerIter: each worker loads + batchifies to plain
+    numpy in its own interpreter (PIL decode and augmenters hold the GIL,
+    so processes are the only way decode scales — measured in
+    benchmark/pipeline.py); the parent converts to device arrays so
+    children never touch jax/the TPU tunnel.
+  * num_workers>0, thread_pool=True — prefetching thread pool over the
+    native C++ pipeline (iter_prefetcher.h analog): right when samples
+    are already numpy (no GIL-bound decode) or datasets are unpicklable.
 """
 from __future__ import annotations
 
+import multiprocessing as _mp
 import queue
 import threading
 
@@ -16,6 +22,24 @@ import numpy as _np
 
 from .batchify import default_batchify_fn
 from .sampler import BatchSampler, RandomSampler, SequentialSampler
+
+
+# --- multiprocessing worker plumbing (reference: worker_loop,
+# dataloader.py:123-305; fork start method inherits the dataset copy-on-
+# write, so nothing is pickled per batch except indices out / batch back)
+_WORKER_DATASET = None
+
+
+def _mp_worker_init(dataset):
+    global _WORKER_DATASET
+    _WORKER_DATASET = dataset
+
+
+def _mp_worker_fn(indices):
+    """Load samples in the child; collation happens in the parent with the
+    user's batchify_fn (children never create device arrays — jax stays
+    un-initialized there)."""
+    return [_WORKER_DATASET[i] for i in indices]
 
 __all__ = ["DataLoader", "default_batchify_fn"]
 
@@ -40,6 +64,9 @@ class DataLoader:
                                          last_batch or "keep")
         self._batch_sampler = batch_sampler
         self._num_workers = max(0, num_workers)
+        self._thread_pool = bool(thread_pool)
+        self._mp_pool = None       # persistent worker pool (mp mode)
+        self._fork_safe_cache = None
         self._prefetch = max(0, prefetch if prefetch is not None
                              else 2 * self._num_workers)
         self._batchify_fn = batchify_fn or default_batchify_fn
@@ -53,11 +80,94 @@ class DataLoader:
             for indices in self._batch_sampler:
                 yield self._make_batch(indices)
             return
+        if not self._thread_pool and self._fork_safe():
+            yield from self._mp_iter()
+            return
         from ... import _native
         if _native.available():
             yield from self._native_iter()
         else:
             yield from self._threaded_iter()
+
+    def _fork_safe(self):
+        """Fork workers must never touch jax (initialized jax is not
+        fork-safe; over the TPU tunnel a forked child can wedge it).
+        Probe one sample in the parent: datasets yielding device arrays
+        fall back to the threaded/native path."""
+        from ...ndarray.ndarray import NDArray
+
+        def has_nd(x):
+            if isinstance(x, (tuple, list)):
+                return any(has_nd(i) for i in x)
+            return isinstance(x, NDArray)
+
+        if self._fork_safe_cache is None:
+            try:
+                self._fork_safe_cache = (len(self._dataset) == 0
+                                         or not has_nd(self._dataset[0]))
+            except Exception:
+                self._fork_safe_cache = False
+        return self._fork_safe_cache
+
+    def _mp_iter(self):
+        """Multiprocessing workers (the reference's default mode,
+        _MultiWorkerIter). Workers load samples; the parent collates with
+        the user batchify_fn and device-puts (async H2D overlaps compute).
+        Submission is windowed to `prefetch` outstanding batches
+        (back-pressure, like iter_prefetcher.h) with the loader timeout."""
+        import collections
+
+        batches = list(self._batch_sampler)
+        if not batches:
+            return
+        pool = self._ensure_pool()
+        window = max(self._prefetch, 1)
+        pending = collections.deque()
+        try:
+            submitted = 0
+            while pending or submitted < len(batches):
+                while submitted < len(batches) and len(pending) < window:
+                    pending.append(pool.apply_async(
+                        _mp_worker_fn, (batches[submitted],)))
+                    submitted += 1
+                samples = pending.popleft().get(timeout=self._timeout)
+                yield self._batchify_fn(samples)
+        except Exception:
+            self._shutdown_pool()  # hung/broken workers: don't reuse
+            raise
+
+    def _ensure_pool(self):
+        """Persistent worker pool, created on first epoch and reused for
+        the loader's lifetime (reference: _MultiWorkerIter keeps its
+        workers alive across epochs)."""
+        if self._mp_pool is not None:
+            return self._mp_pool
+        # fork is cheap (COW dataset) but risky from a multi-threaded
+        # parent (the reference accepted the same trade-off — its workers
+        # fork after MXNet init). Python-level threads force spawn; jax's
+        # internal threads only warn, since workers never call jax.
+        # MXTPU_MP_START=fork|spawn|forkserver overrides.
+        from ... import env as _env
+
+        start = _env.get("MXTPU_MP_START") or (
+            "fork" if threading.active_count() <= 1 else "spawn")
+        ctx = _mp.get_context(start)
+        self._mp_pool = ctx.Pool(self._num_workers,
+                                 initializer=_mp_worker_init,
+                                 initargs=(self._dataset,))
+        return self._mp_pool
+
+    def _shutdown_pool(self):
+        if self._mp_pool is not None:
+            self._mp_pool.terminate()
+            self._mp_pool.join()
+            self._mp_pool = None
+
+    def __del__(self):
+        try:
+            self._shutdown_pool()
+        except Exception:
+            pass
 
     def _native_iter(self):
         """Native ordered pipeline: batches decode on C++ worker threads

@@ -9,6 +9,8 @@ parameter temporarily exposes a jax tracer instead of its concrete buffer).
 """
 from __future__ import annotations
 
+import threading as _threading
+
 import jax.numpy as jnp
 import numpy as _np
 
@@ -43,8 +45,18 @@ class Parameter:
         self._grad_map = None
         self._ctx_list = None
         self._deferred = None  # (init, device_list, default_init)
-        self._traced_data = None  # tracer visible during CachedOp tracing
-        self._structure = None  # (prefix path) set by Block registration
+        # tracer visible during CachedOp tracing — THREAD-LOCAL so a trace
+        # in one thread cannot leak tracers into concurrent inference
+        # threads (reference: cached_op_threadsafe.cc isolation)
+        self._tls = _threading.local()
+
+    @property
+    def _traced_data(self):
+        return getattr(self._tls, "traced_data", None)
+
+    @_traced_data.setter
+    def _traced_data(self, value):
+        self._tls.traced_data = value
 
     # -- identity ----------------------------------------------------------
     @property

@@ -126,6 +126,59 @@ def dropout(data, p=0.5, mode="training", axes=None, **kwargs):  # noqa: ARG001
     explicit key; this injects one like the reference's eager op."""
     return Dropout(data, p=p, mode=mode, axes=axes)
 
+
+def RNN(data, parameters, state, state_cell=None, mode="lstm",  # noqa: N802
+        state_size=None, num_layers=1, bidirectional=False, p=0.0,
+        state_outputs=False, **kwargs):
+    """Fused RNN op (reference: src/operator/rnn.cc `RNN`) — packed
+    parameter vector, lax.scan time loop. Delegates to npx.rnn."""
+    from ..numpy_extension import rnn as _rnn
+
+    return _rnn(data=data, parameters=parameters, state=state,
+                state_cell=state_cell, mode=mode, state_size=state_size,
+                num_layers=num_layers, bidirectional=bidirectional, p=p,
+                state_outputs=state_outputs, **kwargs)
+
+# ---------------------------------------------------------------------------
+# stateful optimizer update ops: reference semantics mutate the state
+# tensors (mom/mean/var/...) in place and write the weight to `out`
+# (src/operator/optimizer_op.cc FMutateInputs). The registry versions are
+# pure (return tuples); these wrappers layer the in-place convention on
+# top so ported update loops behave identically.
+# ---------------------------------------------------------------------------
+def _stateful_update(op_name, n_state):
+    from ..ops.registry import get_op
+    from .register import make_eager
+
+    eager = make_eager(op_name, get_op(op_name))
+
+    def wrapped(weight, grad, *args, out=None, **kwargs):
+        states = list(args[:n_state])
+        rest = args[n_state:]
+        res = eager(weight, grad, *states, *rest, **kwargs)
+        new_w = res[0]
+        for st, new in zip(states, res[1:]):
+            st._data = new._data
+            st._version += 1
+        if out is not None:
+            out._data = new_w._data
+            out._version += 1
+            return out
+        return new_w
+
+    wrapped.__name__ = op_name
+    return wrapped
+
+
+for _opname, _nstate in [
+    ("sgd_mom_update", 1), ("nag_mom_update", 1), ("signum_update", 1),
+    ("adam_update", 2), ("adamw_update", 2), ("lamb_update_phase1", 2),
+    ("rmsprop_update", 1), ("rmspropalex_update", 3), ("ftrl_update", 2),
+    ("adagrad_update", 1), ("adadelta_update", 2),
+]:
+    globals()[_opname] = _stateful_update(_opname, _nstate)
+
+
 # ---------------------------------------------------------------------------
 # generated corpus: every registry op as an eager wrapper (legacy semantics —
 # e.g. reductions take `exclude`, argmax returns float indices, reshape

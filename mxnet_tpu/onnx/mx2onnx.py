@@ -713,30 +713,29 @@ def _argsort(ctx, s, ins, outs, shapes):
 
 @_conv("pick")
 def _pick(ctx, s, ins, outs, shapes):  # noqa: ARG001
+    # opset-11 forms: Unsqueeze/Squeeze carry axes as attributes
     ax = int(s.attr("axis") if s.attr("axis") is not None else -1)
     idx64 = ctx.fresh(s.name + "_idx64")
     ctx.add_node("Cast", [ins[1]], [idx64], attrs={"to": 7})
     idxu = ctx.fresh(s.name + "_idxu")
-    ax_t = ctx.const_i64(s.name + "_ax", [ax])
-    ctx.add_node("Unsqueeze", [idx64, ax_t], [idxu])
+    ctx.add_node("Unsqueeze", [idx64], [idxu], attrs={"axes": [ax]})
     g = ctx.fresh(s.name + "_g")
     ctx.add_node("GatherElements", [ins[0], idxu], [g], attrs={"axis": ax})
     if s.attr("keepdims"):
         ctx.add_node("Identity", [g], outs, s.name)
     else:
-        ctx.add_node("Squeeze", [g, ax_t], outs, s.name)
+        ctx.add_node("Squeeze", [g], outs, s.name, {"axes": [ax]})
 
 
 @_conv("batch_take")
 def _batch_take(ctx, s, ins, outs, shapes):  # noqa: ARG001
     idx64 = ctx.fresh(s.name + "_idx64")
     ctx.add_node("Cast", [ins[1]], [idx64], attrs={"to": 7})
-    one = ctx.const_i64(s.name + "_ax1", [1])
     idxu = ctx.fresh(s.name + "_idxu")
-    ctx.add_node("Unsqueeze", [idx64, one], [idxu])
+    ctx.add_node("Unsqueeze", [idx64], [idxu], attrs={"axes": [1]})
     g = ctx.fresh(s.name + "_g")
     ctx.add_node("GatherElements", [ins[0], idxu], [g], attrs={"axis": 1})
-    ctx.add_node("Squeeze", [g, one], outs, s.name)
+    ctx.add_node("Squeeze", [g], outs, s.name, {"axes": [1]})
 
 
 @_conv("flip")
@@ -864,11 +863,18 @@ def _instance_norm(ctx, s, ins, outs, shapes):  # noqa: ARG001
 @_conv("arange_like")
 def _arange_like(ctx, s, ins, outs, shapes):
     ax = s.attr("axis")
-    n = shapes[0][int(ax) if ax is not None else 0]
+    shape = shapes[0]
+    n = int(_np.prod(shape)) if ax is None else shape[int(ax)]
     start = float(s.attr("start") or 0.0)
     step = float(s.attr("step") or 1.0)
-    ctx.add_node("Constant", [], outs, s.name,
-                 {"value": _np.arange(n, dtype=_np.float32) * step + start})
+    repeat = int(s.attr("repeat") or 1)
+    count = -(-n // repeat) if repeat > 1 else n
+    vals = _np.arange(count, dtype=_np.float32) * step + start
+    if repeat > 1:
+        vals = _np.repeat(vals, repeat)[:n]
+    if ax is None:
+        vals = vals.reshape(shape)
+    ctx.add_node("Constant", [], outs, s.name, {"value": vals})
 
 
 @_conv("SliceChannel")
@@ -876,8 +882,9 @@ def _slice_channel(ctx, s, ins, outs, shapes):
     ax = int(s.attr("axis") if s.attr("axis") is not None else 1)
     n = int(s.attr("num_outputs"))
     size = shapes[0][ax] // n
-    splits = ctx.const_i64(s.name + "_splits", [size] * n)
-    ctx.add_node("Split", [ins[0], splits], outs, s.name, {"axis": ax})
+    # opset-11 Split: sizes via the `split` attribute
+    ctx.add_node("Split", [ins[0]], outs, s.name,
+                 {"axis": ax, "split": [size] * n})
 
 
 # --- shape inference over the symbol DAG -----------------------------------

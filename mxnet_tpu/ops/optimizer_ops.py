@@ -1,0 +1,200 @@
+"""Fused optimizer-update ops (reference: src/operator/optimizer_op.cc —
+sgd_update, sgd_mom_update, adam_update, lamb_update_phase1/2, ftrl_update,
+rmsprop_update, signsgd/signum, adagrad/adadelta, all_finite,
+multi_sum_sq; the reference registers optimizer math as engine ops so
+updates run fused on-device).
+
+TPU design: each update is one pure jitted function — XLA fuses the whole
+rescale→clip→wd→update chain into a single elementwise kernel. State
+(momenta etc.) is returned, not mutated; the mx.nd wrappers layer the
+reference's in-place-mutation convention on top (ndarray/__init__.py).
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from .registry import register_op
+
+__all__ = []
+
+
+def _prep(grad, rescale_grad, clip_gradient):
+    g = grad * rescale_grad
+    if clip_gradient is not None and clip_gradient > 0:
+        g = jnp.clip(g, -clip_gradient, clip_gradient)
+    return g
+
+
+@register_op("sgd_update")
+def sgd_update(weight, grad, lr, wd=0.0, rescale_grad=1.0,
+               clip_gradient=-1.0, lazy_update=False):  # noqa: ARG001
+    g = _prep(grad, rescale_grad, clip_gradient)
+    return weight - lr * (g + wd * weight)
+
+
+@register_op("sgd_mom_update")
+def sgd_mom_update(weight, grad, mom, lr, momentum=0.0, wd=0.0,
+                   rescale_grad=1.0, clip_gradient=-1.0,
+                   lazy_update=False):  # noqa: ARG001
+    g = _prep(grad, rescale_grad, clip_gradient)
+    new_mom = momentum * mom - lr * (g + wd * weight)
+    return weight + new_mom, new_mom
+
+
+@register_op("nag_mom_update")
+def nag_mom_update(weight, grad, mom, lr, momentum=0.0, wd=0.0,
+                   rescale_grad=1.0, clip_gradient=-1.0):
+    g = _prep(grad, rescale_grad, clip_gradient) + wd * weight
+    new_mom = momentum * mom + g
+    return weight - lr * (g + momentum * new_mom), new_mom
+
+
+@register_op("signsgd_update")
+def signsgd_update(weight, grad, lr, wd=0.0, rescale_grad=1.0,
+                   clip_gradient=-1.0):
+    g = _prep(grad, rescale_grad, clip_gradient)
+    return weight - lr * (jnp.sign(g) + wd * weight)
+
+
+@register_op("signum_update")
+def signum_update(weight, grad, mom, lr, momentum=0.0, wd=0.0,
+                  rescale_grad=1.0, clip_gradient=-1.0,
+                  wd_lh=0.0):
+    g = _prep(grad, rescale_grad, clip_gradient)
+    new_mom = momentum * mom - (1.0 - momentum) * (g + wd * weight)
+    return weight * (1 - lr * wd_lh) + lr * jnp.sign(new_mom), new_mom
+
+
+@register_op("adam_update")
+def adam_update(weight, grad, mean, var, lr, beta1=0.9, beta2=0.999,
+                epsilon=1e-8, wd=0.0, rescale_grad=1.0,
+                clip_gradient=-1.0, lazy_update=False):  # noqa: ARG001
+    g = _prep(grad, rescale_grad, clip_gradient) + wd * weight
+    new_mean = beta1 * mean + (1 - beta1) * g
+    new_var = beta2 * var + (1 - beta2) * jnp.square(g)
+    w = weight - lr * new_mean / (jnp.sqrt(new_var) + epsilon)
+    return w, new_mean, new_var
+
+
+@register_op("adamw_update")
+def adamw_update(weight, grad, mean, var, lr, beta1=0.9, beta2=0.999,
+                 epsilon=1e-8, wd=0.0, eta=1.0, rescale_grad=1.0,
+                 clip_gradient=-1.0):
+    """Decoupled weight decay (reference: contrib adamw.cc)."""
+    g = _prep(grad, rescale_grad, clip_gradient)
+    new_mean = beta1 * mean + (1 - beta1) * g
+    new_var = beta2 * var + (1 - beta2) * jnp.square(g)
+    w = weight - eta * (lr * new_mean / (jnp.sqrt(new_var) + epsilon)
+                        + wd * weight)
+    return w, new_mean, new_var
+
+
+@register_op("lamb_update_phase1")
+def lamb_update_phase1(weight, grad, mean, var, beta1=0.9, beta2=0.999,
+                       epsilon=1e-6, t=1, bias_correction=True, wd=0.0,
+                       rescale_grad=1.0, clip_gradient=-1.0):
+    g = _prep(grad, rescale_grad, clip_gradient)
+    new_mean = beta1 * mean + (1 - beta1) * g
+    new_var = beta2 * var + (1 - beta2) * jnp.square(g)
+    m, v = new_mean, new_var
+    if bias_correction:
+        m = m / (1 - beta1 ** t)
+        v = v / (1 - beta2 ** t)
+    update = m / (jnp.sqrt(v) + epsilon) + wd * weight
+    return update, new_mean, new_var
+
+
+@register_op("lamb_update_phase2")
+def lamb_update_phase2(weight, g, r1, r2, lr, lower_bound=-1.0,
+                       upper_bound=-1.0):
+    if lower_bound > 0:
+        r1 = jnp.maximum(r1, lower_bound)
+    if upper_bound > 0:
+        r1 = jnp.minimum(r1, upper_bound)
+    ratio = jnp.where(jnp.logical_and(r1 > 0, r2 > 0), r1 / r2, 1.0)
+    return weight - lr * ratio * g
+
+
+@register_op("rmsprop_update")
+def rmsprop_update(weight, grad, n, lr, gamma1=0.95, epsilon=1e-8, wd=0.0,
+                   rescale_grad=1.0, clip_gradient=-1.0,
+                   clip_weights=-1.0):
+    g = _prep(grad, rescale_grad, clip_gradient) + wd * weight
+    new_n = gamma1 * n + (1 - gamma1) * jnp.square(g)
+    w = weight - lr * g / jnp.sqrt(new_n + epsilon)
+    if clip_weights is not None and clip_weights > 0:
+        w = jnp.clip(w, -clip_weights, clip_weights)
+    return w, new_n
+
+
+@register_op("rmspropalex_update")
+def rmspropalex_update(weight, grad, n, g_avg, delta, lr, gamma1=0.95,
+                       gamma2=0.9, epsilon=1e-8, wd=0.0, rescale_grad=1.0,
+                       clip_gradient=-1.0, clip_weights=-1.0):
+    """Graves' RMSProp variant (reference: rmspropalex_update)."""
+    g = _prep(grad, rescale_grad, clip_gradient) + wd * weight
+    new_n = gamma1 * n + (1 - gamma1) * jnp.square(g)
+    new_g = gamma1 * g_avg + (1 - gamma1) * g
+    new_delta = gamma2 * delta - lr * g / jnp.sqrt(
+        new_n - jnp.square(new_g) + epsilon)
+    w = weight + new_delta
+    if clip_weights is not None and clip_weights > 0:
+        w = jnp.clip(w, -clip_weights, clip_weights)
+    return w, new_n, new_g, new_delta
+
+
+@register_op("ftrl_update")
+def ftrl_update(weight, grad, z, n, lr, lamda1=0.01, beta=1.0, wd=0.0,
+                rescale_grad=1.0, clip_gradient=-1.0):
+    g = _prep(grad, rescale_grad, clip_gradient)
+    new_n = n + jnp.square(g)
+    sigma = (jnp.sqrt(new_n) - jnp.sqrt(n)) / lr
+    new_z = z + g - sigma * weight
+    w = jnp.where(
+        jnp.abs(new_z) <= lamda1,
+        0.0,
+        -(new_z - jnp.sign(new_z) * lamda1)
+        / ((beta + jnp.sqrt(new_n)) / lr + wd))
+    return w, new_z, new_n
+
+
+@register_op("adagrad_update")
+def adagrad_update(weight, grad, history, lr, epsilon=1e-7, wd=0.0,
+                   rescale_grad=1.0, clip_gradient=-1.0):
+    g = _prep(grad, rescale_grad, clip_gradient)
+    new_hist = history + jnp.square(g)
+    w = weight - lr * (g / jnp.sqrt(new_hist + epsilon) + wd * weight)
+    return w, new_hist
+
+
+@register_op("adadelta_update")
+def adadelta_update(weight, grad, acc_g, acc_delta, rho=0.9, epsilon=1e-5,
+                    wd=0.0, rescale_grad=1.0, clip_gradient=-1.0):
+    g = _prep(grad, rescale_grad, clip_gradient)
+    new_acc_g = rho * acc_g + (1 - rho) * jnp.square(g)
+    delta = jnp.sqrt(acc_delta + epsilon) / jnp.sqrt(new_acc_g + epsilon) * g
+    new_acc_delta = rho * acc_delta + (1 - rho) * jnp.square(delta)
+    return weight - delta - wd * weight, new_acc_g, new_acc_delta
+
+
+@register_op("all_finite")
+def all_finite(data, init_output=True):  # noqa: ARG001
+    """1 if every element is finite (reference: all_finite op used by AMP
+    loss-scaler overflow checks)."""
+    return jnp.isfinite(data).all().astype(jnp.float32).reshape(1)
+
+
+@register_op("multi_all_finite")
+def multi_all_finite(*arrays, num_arrays=None,
+                     init_output=True):  # noqa: ARG001
+    ok = jnp.array(True)
+    for a in arrays:
+        ok = jnp.logical_and(ok, jnp.isfinite(a).all())
+    return ok.astype(jnp.float32).reshape(1)
+
+
+@register_op("multi_sum_sq")
+def multi_sum_sq(*arrays, num_arrays=None):  # noqa: ARG001
+    """Per-array sum of squares (reference: multi_sum_sq.cc — feeds LARS/
+    clip-by-global-norm)."""
+    return tuple(jnp.sum(jnp.square(a.astype(jnp.float32))) for a in arrays)

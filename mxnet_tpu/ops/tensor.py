@@ -753,3 +753,92 @@ def softmax_cross_entropy(data, label):
     picked = jnp.take_along_axis(
         logp, label.astype(jnp.int32)[:, None], axis=-1)
     return -jnp.sum(picked)
+
+
+@register_op("trace")
+def trace(data, offset=0, axis1=0, axis2=1):
+    """Reference: np_trace_op.cc."""
+    return jnp.trace(data, offset=offset, axis1=axis1, axis2=axis2)
+
+
+@register_op("broadcast_like")
+def broadcast_like(lhs, rhs):
+    """Reference: broadcast_reduce_op_value.cc broadcast_like."""
+    return jnp.broadcast_to(lhs, rhs.shape)
+
+
+@register_op("arange_like")
+def arange_like(data, start=0.0, step=1.0, repeat=1, axis=None):
+    """Reference: tensor/init_op.cc _contrib_arange_like — each value is
+    emitted `repeat` times before advancing by `step`."""
+    n = data.shape[axis] if axis is not None else data.size
+    count = -(-n // repeat) if repeat > 1 else n
+    out = jnp.arange(count, dtype=jnp.float32) * step + start
+    if repeat > 1:
+        out = jnp.repeat(out, repeat)[:n]
+    if axis is None:
+        return out.reshape(data.shape)
+    return out
+
+
+@register_op("relu6")
+def relu6(data):
+    return jnp.clip(data, 0.0, 6.0)
+
+
+
+@register_op("mish")
+def mish(data):
+    return data * jnp.tanh(jax.nn.softplus(data))
+
+
+@register_op("silu")
+def silu(data):
+    return jax.nn.silu(data)
+
+
+@register_op("im2col")
+def im2col(data, kernel, stride=None, dilate=None, pad=None):
+    """Sliding-window patch extraction (reference: src/operator/nn/im2col.h
+    semantics, registered as `im2col` in matrix ops): (N, C, H, W) ->
+    (N, C*prod(kernel), L) column matrix. Lowered via XLA's
+    conv_general_dilated_patches — MXU/VPU friendly, no gather loops."""
+    from jax import lax as _lax
+
+    nd = data.ndim - 2
+    if isinstance(kernel, int):
+        kernel = (kernel,) * nd
+    stride = stride or (1,) * nd
+    dilate = dilate or (1,) * nd
+    pad = pad or (0,) * nd
+    if isinstance(stride, int):
+        stride = (stride,) * nd
+    if isinstance(dilate, int):
+        dilate = (dilate,) * nd
+    if isinstance(pad, int):
+        pad = (pad,) * nd
+    patches = _lax.conv_general_dilated_patches(
+        data, filter_shape=tuple(kernel), window_strides=tuple(stride),
+        padding=[(p, p) for p in pad], rhs_dilation=tuple(dilate))
+    n = patches.shape[0]
+    return patches.reshape(n, patches.shape[1], -1)
+
+
+@register_op("col2im")
+def col2im(data, output_size, kernel, stride=None, dilate=None, pad=None):
+    """Inverse of im2col: scatter-add columns back onto the image
+    (reference: col2im in src/operator/nn/im2col.h). Implemented as the
+    vjp of im2col — exact adjoint by construction."""
+    import jax as _jax
+
+    nd = len(output_size)
+    if isinstance(kernel, int):
+        kernel = (kernel,) * nd
+    c = data.shape[1] // 1
+    for k in kernel:
+        c //= k
+    img_shape = (data.shape[0], c) + tuple(output_size)
+    _, vjp = _jax.vjp(
+        lambda img: im2col(img, kernel, stride=stride, dilate=dilate,
+                           pad=pad), jnp.zeros(img_shape, data.dtype))
+    return vjp(data)[0]

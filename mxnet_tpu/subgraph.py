@@ -1,0 +1,282 @@
+"""Subgraph partitioning / accelerator-backend extension API.
+
+Reference: src/operator/subgraph/subgraph_property.h (`SubgraphProperty`,
+`SubgraphSelector`) + build_subgraph.cc — third-party backends register an
+op-predicate, the partitioner carves maximal matched regions out of the
+graph and hands each to the backend, which substitutes its own fused
+implementation (oneDNN/TensorRT in the reference).
+
+TPU re-design: the graph IS the traced jaxpr. A backend here receives
+maximal runs of matched jaxpr equations as ClosedJaxprs and returns a
+replacement callable (a Pallas kernel, a hand-fused jnp function, an
+XLA custom-call...). `HybridBlock.optimize_for(x, backend=...)` traces the
+block, partitions the jaxpr, and installs the partitioned executable as
+the block's compiled variant; XLA then compiles the substituted program.
+The same registry backs the external-library surface (library.py): a
+loaded .so can register a backend exactly like the in-process test
+backend (lib_api.h CustomPartitioner parity).
+"""
+from __future__ import annotations
+
+import jax
+from jax.extend import core as jcore
+
+__all__ = ["SubgraphBackend", "register_backend", "get_backend",
+           "list_backends", "partition_jaxpr", "partition_call"]
+
+_BACKENDS = {}
+
+
+class SubgraphBackend:
+    """Base class for partitioner backends (reference:
+    SubgraphProperty, subgraph_property.h:614).
+
+    Subclasses override:
+      * match(eqn): True if this jaxpr equation belongs to the backend's
+        subgraphs (reference: SubgraphSelector::Select*).
+      * substitute(closed_jaxpr): given a maximal matched region as a
+        ClosedJaxpr, return a callable(*args) -> list-of-outputs that
+        replaces it, or None to keep the default lowering (reference:
+        SubgraphProperty::CreateSubgraphNode).
+    """
+
+    name = None
+
+    def match(self, eqn) -> bool:  # noqa: ARG002
+        return False
+
+    def substitute(self, closed_jaxpr):  # noqa: ARG002
+        return None
+
+
+def register_backend(name):
+    """Class decorator: register a SubgraphBackend under `name`
+    (reference: MXNET_REGISTER_SUBGRAPH_BACKEND / .._PROPERTY)."""
+    def deco(cls):
+        inst = cls()
+        inst.name = name
+        _BACKENDS[name] = inst
+        return cls
+
+    return deco
+
+
+def get_backend(name):
+    if name not in _BACKENDS:
+        raise ValueError(f"unknown subgraph backend {name!r}; "
+                         f"registered: {sorted(_BACKENDS)}")
+    return _BACKENDS[name]
+
+
+def list_backends():
+    return sorted(_BACKENDS)
+
+
+# ---------------------------------------------------------------------------
+# jaxpr partitioning
+# ---------------------------------------------------------------------------
+
+
+def _free_and_defined(eqns):
+    """Input vars (defined outside) and output vars of an eqn group."""
+    defined = set()
+    free = []
+    seen_free = set()
+    for eqn in eqns:
+        for v in eqn.invars:
+            if isinstance(v, jcore.Literal):
+                continue
+            if v not in defined and v not in seen_free:
+                seen_free.add(v)
+                free.append(v)
+        defined.update(eqn.outvars)
+    return free, defined
+
+
+def _group_eqns(eqns, backend):
+    """Split the eqn list into segments: ('sub', [eqns]) for maximal runs
+    of matched equations, ('raw', [eqns]) otherwise (reference:
+    build_subgraph.cc connected-region selection, simplified to
+    topological runs)."""
+    segments = []
+    cur_kind = None
+    cur = []
+    for eqn in eqns:
+        kind = "sub" if backend.match(eqn) else "raw"
+        if kind != cur_kind and cur:
+            segments.append((cur_kind, cur))
+            cur = []
+        cur_kind = kind
+        cur.append(eqn)
+    if cur:
+        segments.append((cur_kind, cur))
+    return segments
+
+
+def _make_sub_jaxpr(eqns, out_needed):
+    """Build a ClosedJaxpr for an eqn group. `out_needed` = vars from this
+    group consumed later (or returned)."""
+    invars, defined = _free_and_defined(eqns)
+    outvars = [v for v in dict.fromkeys(
+        ov for eqn in eqns for ov in eqn.outvars) if v in out_needed]
+    from jax._src.linear_util import DebugInfo as _DebugInfo
+
+    dbg = _DebugInfo("subgraph", "mxtpu subgraph partition",
+                     tuple(f"in{i}" for i in range(len(invars))),
+                     tuple(f"out{i}" for i in range(len(outvars))))
+    jaxpr = jcore.Jaxpr(constvars=(), invars=list(invars),
+                        outvars=list(outvars), eqns=list(eqns),
+                        debug_info=dbg)
+    return jcore.ClosedJaxpr(jaxpr, ()), invars, outvars
+
+
+def _eval_eqn(eqn, invals):
+    """Evaluate one jaxpr equation. Call-like primitives (pjit,
+    custom_jvp/vjp, remat) carry their body as a param and cannot be
+    re-`bind`-ed with plain values — inline their inner jaxpr instead."""
+    import jax.core as _core
+
+    name = eqn.primitive.name
+    if name == "pjit" or name == "closed_call":
+        inner = eqn.params["jaxpr"]
+        return _core.eval_jaxpr(inner.jaxpr, inner.consts, *invals)
+    if name in ("custom_jvp_call", "custom_vjp_call",
+                "custom_vjp_call_jaxpr"):
+        inner = (eqn.params.get("call_jaxpr")
+                 or eqn.params.get("fun_jaxpr"))
+        return _core.eval_jaxpr(inner.jaxpr, inner.consts, *invals)
+    if name in ("remat2", "checkpoint"):
+        inner = eqn.params["jaxpr"]
+        return _core.eval_jaxpr(inner, (), *invals)
+    out = eqn.primitive.bind(*invals, **eqn.params)
+    if eqn.primitive.multiple_results and not isinstance(out, (tuple, list)):
+        out = [out]
+    return out
+
+
+def partition_jaxpr(closed_jaxpr, backend):
+    """Partition a traced function: maximal matched regions become
+    backend-substituted calls. Returns callable(*flat_args) -> flat_outs
+    operating on the closed jaxpr's invars order."""
+    jaxpr = closed_jaxpr.jaxpr
+    consts = closed_jaxpr.consts
+
+    # vars needed downstream of each group = all invars of later eqns +
+    # jaxpr outvars (computed right-to-left below)
+    segments = _group_eqns(jaxpr.eqns, backend)
+    plans = []  # (kind, payload)
+    later_use = [set() for _ in segments]
+    acc = set(v for v in jaxpr.outvars if not isinstance(v, jcore.Literal))
+    for i in range(len(segments) - 1, -1, -1):
+        later_use[i] = set(acc)
+        for eqn in segments[i][1]:
+            for v in eqn.invars:
+                if not isinstance(v, jcore.Literal):
+                    acc.add(v)
+
+    for (kind, eqns), out_needed in zip(segments, later_use):
+        if kind == "raw":
+            plans.append(("raw", eqns))
+            continue
+        closed, invars, outvars = _make_sub_jaxpr(eqns, out_needed)
+        fn = backend.substitute(closed)
+        if fn is None:
+            plans.append(("raw", eqns))
+        else:
+            plans.append(("sub", (fn, invars, outvars, closed)))
+
+    def run(*args):
+        env = {}
+
+        def read(v):
+            if isinstance(v, jcore.Literal):
+                return v.val
+            return env[v]
+
+        def write(v, val):
+            env[v] = val
+
+        for v, c in zip(jaxpr.constvars, consts):
+            write(v, c)
+        for v, a in zip(jaxpr.invars, args):
+            write(v, a)
+
+        for kind, payload in plans:
+            if kind == "raw":
+                for eqn in payload:
+                    invals = [read(v) for v in eqn.invars]
+                    sub = _eval_eqn(eqn, invals)
+                    if isinstance(sub, (tuple, list)):
+                        for v, val in zip(eqn.outvars, sub):
+                            write(v, val)
+                    else:
+                        write(eqn.outvars[0], sub)
+            else:
+                fn, invars, outvars, closed = payload
+                outs = fn(*[read(v) for v in invars])
+                if not isinstance(outs, (tuple, list)):
+                    outs = (outs,)
+                assert len(outs) == len(outvars), (
+                    f"backend returned {len(outs)} outputs for a subgraph "
+                    f"with {len(outvars)}")
+                for v, val in zip(outvars, outs):
+                    write(v, val)
+        return [read(v) for v in jaxpr.outvars]
+
+    run._segments = [(k, (len(p[3].jaxpr.eqns) if k == "sub" else len(p)))
+                     for k, p in plans]
+    run._num_subgraphs = sum(1 for k, _ in plans if k == "sub")
+    return run
+
+
+def partition_call(fn, backend_name, *example_args):
+    """Trace `fn` on example args, partition with the named backend, and
+    return (partitioned_fn, num_subgraphs). The partitioned function is
+    jit-compatible (pure jax ops + backend substitutions)."""
+    backend = get_backend(backend_name)
+    closed = jax.make_jaxpr(fn)(*example_args)
+    run = partition_jaxpr(closed, backend)
+
+    out_shape = jax.eval_shape(fn, *example_args)
+    _, out_tree = jax.tree_util.tree_flatten(out_shape)
+
+    def wrapped(*args):
+        flat, _ = jax.tree_util.tree_flatten(args)
+        outs = run(*flat)
+        return jax.tree_util.tree_unflatten(out_tree, outs)
+
+    return wrapped, run._num_subgraphs
+
+
+class PrimitiveNameBackend(SubgraphBackend):
+    """Convenience backend: match jaxpr equations by primitive name and
+    substitute a user-supplied fused callable (reference: the
+    lib_api.h CustomPartitioner surface — supported-op list + fused
+    implementation; external libraries loaded via mxnet_tpu.library can
+    register one of these around their custom ops).
+
+    fuse_fn(closed_jaxpr) -> callable | None. When None (the default),
+    matched regions are only *marked* (executed with default lowering) —
+    useful for measuring what a backend would claim.
+    """
+
+    def __init__(self, primitive_names=(), fuse_fn=None):
+        self.primitive_names = frozenset(primitive_names)
+        self.fuse_fn = fuse_fn
+
+    def match(self, eqn):
+        return eqn.primitive.name in self.primitive_names
+
+    def substitute(self, closed_jaxpr):
+        if self.fuse_fn is None:
+            return None
+        return self.fuse_fn(closed_jaxpr)
+
+
+def register_primitive_backend(name, primitive_names, fuse_fn=None):
+    """Register a PrimitiveNameBackend under `name` (the one-call form of
+    the extension surface)."""
+    inst = PrimitiveNameBackend(primitive_names, fuse_fn)
+    inst.name = name
+    _BACKENDS[name] = inst
+    return inst

@@ -327,7 +327,24 @@ __all__.append("stop_gradient")
 make_loss = _reg("make_loss")(lambda ins, a: ins[0])
 
 # -- arange_like (positions for attention) ----------------------------------
-arange_like = _reg("arange_like")(
-    lambda ins, a: jnp.arange(
-        ins[0].shape[a.get("axis") if a.get("axis") is not None else 0],
-        dtype=jnp.float32) * a.get("step", 1.0) + a.get("start", 0.0))
+
+
+def _arange_like_impl(ins, a):
+    """Matches the imperative op (ops/tensor.py arange_like): axis=None
+    fills data.shape; `repeat` emits each value repeat times."""
+    axis = a.get("axis")
+    repeat = a.get("repeat", 1)
+    step = a.get("step", 1.0)
+    start = a.get("start", 0.0)
+    data = ins[0]
+    n = data.shape[axis] if axis is not None else data.size
+    count = -(-n // repeat) if repeat > 1 else n
+    out = jnp.arange(count, dtype=jnp.float32) * step + start
+    if repeat > 1:
+        out = jnp.repeat(out, repeat)[:n]
+    if axis is None:
+        return out.reshape(data.shape)
+    return out
+
+
+arange_like = _reg("arange_like")(_arange_like_impl)

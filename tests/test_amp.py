@@ -85,3 +85,52 @@ def test_loss_scaler_overflow_detection():
             return mx.np.array([1.0, 2.0])
 
     assert not scaler.has_overflow([FiniteParam()])
+
+
+class TestAmpGraphPass:
+    """AMP as a jaxpr rewrite (reference: low_precision_pass.cc)."""
+
+    def test_rewrite_casts_matmuls_and_pins_fp32(self):
+        import jax
+        import jax.numpy as jnp
+        import numpy as onp
+
+        from mxnet_tpu.amp.graph_pass import amp_rewrite
+
+        w = jnp.asarray(onp.random.RandomState(0).rand(8, 8), jnp.float32)
+
+        def f(x):
+            h = x @ w          # LP16
+            s = jnp.exp(h)     # FP32-pinned
+            return (s @ w).sum()
+
+        x = jnp.asarray(onp.random.RandomState(1).rand(4, 8), jnp.float32)
+        closed = jax.make_jaxpr(f)(x)
+        run = amp_rewrite(closed)
+        stats = run._amp_stats
+        assert stats.lp16_ops == 2       # both matmuls downcast
+        assert stats.fp32_pinned_ops >= 1  # exp and reduce pinned
+        out = run(x)[0]
+        assert out.dtype == jnp.float32  # output restored to original
+        ref = f(x)
+        onp.testing.assert_allclose(float(out), float(ref), rtol=3e-2)
+
+    def test_convert_block_graph(self):
+        import numpy as onp
+
+        import mxnet_tpu as mx
+        from mxnet_tpu import gluon
+        from mxnet_tpu.amp import convert_block_graph
+
+        mx.seed(0)
+        net = gluon.nn.HybridSequential()
+        net.add(gluon.nn.Dense(16, activation="relu"),
+                gluon.nn.BatchNorm(), gluon.nn.Dense(4))
+        net.initialize()
+        x = mx.np.array(onp.random.RandomState(2).rand(2, 8).astype("f"))
+        ref = net(x).asnumpy()
+        stats = convert_block_graph(net, (x,))
+        assert stats.lp16_ops >= 2
+        got = net(x).asnumpy()
+        assert got.dtype == onp.float32
+        onp.testing.assert_allclose(ref, got, rtol=5e-2, atol=5e-2)

@@ -165,3 +165,40 @@ def test_checkpoint_handler_best_not_rotated(tmp_path):
     for _ in range(5):
         h.epoch_end(est)
     assert os.path.exists(str(tmp_path / "model-best.params"))
+
+
+def test_dataloader_process_workers():
+    """Multiprocessing worker mode (reference default,
+    dataloader.py:123-305): fork workers batchify numpy; parent converts
+    to device arrays; order preserved."""
+    import numpy as onp
+
+    from mxnet_tpu.gluon.data import DataLoader
+
+    class NumpyDataset:
+        def __len__(self):
+            return 32
+
+        def __getitem__(self, i):
+            return (onp.full((3,), i, dtype="float32"),
+                    onp.int64(i % 4))
+
+    loader = DataLoader(NumpyDataset(), batch_size=8, num_workers=2)
+    seen = []
+    for x, y in loader:
+        assert x.shape == (8, 3)
+        seen.extend(x.asnumpy()[:, 0].astype(int).tolist())
+    assert seen == list(range(32))
+
+
+def test_dataloader_process_workers_ndarray_fallback():
+    """Datasets yielding device arrays must NOT fork (jax is not
+    fork-safe) — the loader silently falls back to the threaded path."""
+    import mxnet_tpu as mx
+    from mxnet_tpu.gluon.data import ArrayDataset, DataLoader
+
+    ds = ArrayDataset(mx.np.ones((16, 4)), mx.np.zeros((16,)))
+    loader = DataLoader(ds, batch_size=4, num_workers=2)
+    assert not loader._fork_safe()
+    batches = list(loader)
+    assert len(batches) == 4

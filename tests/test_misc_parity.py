@@ -138,3 +138,24 @@ def test_print_summary_and_plot(capsys):
     dot = mx.plot_network(out)
     src = dot if isinstance(dot, str) else dot.source
     assert "digraph" in src and "->" in src
+
+
+def test_env_var_registry():
+    """Typed env registry (reference: env_var.md + dmlc::GetEnv point
+    reads)."""
+    import mxnet_tpu as mx
+
+    assert mx.env.get("MXNET_ENGINE_TYPE") in (
+        "ThreadedEnginePerDevice", "NaiveEngine")
+    assert isinstance(mx.env.get("MXTPU_DISABLE_NATIVE"), bool)
+    assert mx.env.get("MXTPU_BENCH_BATCH") == 256
+    d = mx.env.doc()
+    assert "MXNET_ENGINE_TYPE" in d and "MXTPU_MP_START" in d
+    assert len(mx.env.all_vars()) >= 12
+    # typed override
+    import os
+    os.environ["MXTPU_BENCH_BATCH"] = "128"
+    try:
+        assert mx.env.get("MXTPU_BENCH_BATCH") == 128
+    finally:
+        del os.environ["MXTPU_BENCH_BATCH"]

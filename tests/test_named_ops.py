@@ -442,3 +442,128 @@ def test_npx_extras():
     out = npx.topk(y, k=2, axis=-1, ret_typ="value")
     assert out.shape == (2, 3, 2)
     assert npx.gather_nd is not None and npx.linalg_potrf is not None
+
+
+# --- optimizer update ops (reference: src/operator/optimizer_op.cc) --------
+
+def test_sgd_update_matches_formula():
+    w = A([1.0, 2.0]); g = A([0.5, -0.5])
+    out = nd.sgd_update(w, g, lr=0.1, wd=0.01, rescale_grad=2.0)
+    expect = onp.array([1.0, 2.0]) - 0.1 * (
+        onp.array([1.0, -1.0]) + 0.01 * onp.array([1.0, 2.0]))
+    onp.testing.assert_allclose(out.asnumpy(), expect, rtol=1e-6)
+
+
+def test_sgd_update_clip_gradient():
+    w = A([0.0]); g = A([10.0])
+    out = nd.sgd_update(w, g, lr=1.0, clip_gradient=1.0)
+    onp.testing.assert_allclose(out.asnumpy(), [-1.0], rtol=1e-6)
+
+
+def test_sgd_mom_update_mutates_state_in_place():
+    """nd follows the reference convention: state tensors update in place
+    (optimizer_op.cc FMutateInputs); the weight returns (or lands in out)."""
+    w = A([1.0]); g = A([1.0]); m = A([0.5])
+    new_w = nd.sgd_mom_update(w, g, m, lr=0.1, momentum=0.9)
+    onp.testing.assert_allclose(m.asnumpy(), [0.45 - 0.1], rtol=1e-6)
+    onp.testing.assert_allclose(new_w.asnumpy(), [1.0 + 0.35], rtol=1e-6)
+    # out= writes the weight into the given array
+    out = nd.sgd_mom_update(w, g, m, out=w, lr=0.1, momentum=0.9)
+    assert out is w
+
+
+def test_adam_update_converges_to_minimum():
+    """Drive x^2/2 toward 0 with the fused adam op (in-place mean/var)."""
+    w = A([5.0]); m = A([0.0]); v = A([0.0])
+    for _ in range(200):
+        g = w  # d/dw (w^2/2)
+        w = nd.adam_update(w, g, m, v, lr=0.1)
+    assert abs(float(w.asnumpy()[0])) < 0.5
+    assert float(v.asnumpy()[0]) > 0  # state advanced in place
+
+
+def test_ftrl_and_adagrad_update_shapes():
+    w = A([1.0, -1.0]); g = A([0.1, 0.2])
+    z = A([0.0, 0.0]); n = A([0.0, 0.0])
+    out = nd.ftrl_update(w, g, z, n, lr=0.1)
+    assert out.shape == (2,)
+    assert (n.asnumpy() > 0).all()  # state advanced in place
+    h = A([0.0, 0.0])
+    nd.adagrad_update(w, g, h, lr=0.1)
+    assert (h.asnumpy() > 0).all()
+
+
+def test_lamb_two_phase():
+    w = A([1.0, 1.0]); g = A([0.1, 0.1]); m = A([0.0, 0.0]); v = A([0.0, 0.0])
+    upd = nd.lamb_update_phase1(w, g, m, v, t=1, wd=0.01)
+    r1 = mx.np.array(onp.linalg.norm(w.asnumpy(), keepdims=False).reshape(()))
+    r2 = mx.np.array(onp.linalg.norm(upd.asnumpy(), keepdims=False).reshape(()))
+    w2 = nd.lamb_update_phase2(w, upd, r1, r2, lr=0.01)
+    assert w2.shape == (2,)
+    assert not onp.allclose(w2.asnumpy(), w.asnumpy())
+
+
+def test_signsgd_signum_rmsprop_adadelta():
+    w = A([1.0]); g = A([-3.0])
+    onp.testing.assert_allclose(
+        nd.signsgd_update(w, g, lr=0.1).asnumpy(), [1.1], rtol=1e-6)
+    m = A([0.0])
+    w2 = nd.signum_update(w, g, m, lr=0.1, momentum=0.9)
+    assert float(w2.asnumpy()[0]) > 1.0  # sign(-g) pushes up
+    n = A([0.0])
+    nd.rmsprop_update(w, g, n, lr=0.1)
+    assert n.asnumpy()[0] > 0
+    ag = A([0.0]); ad = A([0.0])
+    nd.adadelta_update(w, g, ag, ad)
+    assert ag.asnumpy()[0] > 0
+
+
+def test_all_finite_and_multi():
+    assert nd.all_finite(A([1.0, 2.0])).asnumpy()[0] == 1.0
+    assert nd.all_finite(A([1.0, onp.inf])).asnumpy()[0] == 0.0
+    out = nd.multi_all_finite(A([1.0]), A([onp.nan]))
+    assert out.asnumpy()[0] == 0.0
+    s = nd.multi_sum_sq(A([1.0, 2.0]), A([3.0]))
+    onp.testing.assert_allclose([float(x.asnumpy()) for x in s], [5.0, 9.0])
+
+
+# --- tensor tail -----------------------------------------------------------
+
+def test_trace_broadcast_like_arange_like():
+    x = A(onp.eye(3))
+    assert float(nd.trace(x).asnumpy()) == 3.0
+    small = A([[1.0], [2.0]])
+    big = A(onp.ones((2, 4)))
+    assert nd.broadcast_like(small, big).shape == (2, 4)
+    ref = A(onp.zeros((5, 3)))
+    al = nd.arange_like(ref, axis=0)
+    onp.testing.assert_allclose(al.asnumpy(), [0, 1, 2, 3, 4])
+
+
+def test_im2col_col2im_roundtrip():
+    x = A(onp.arange(36, dtype="float32").reshape(1, 1, 6, 6))
+    cols = nd.im2col(x, kernel=(2, 2), stride=(2, 2))
+    assert cols.shape == (1, 4, 9)
+    back = nd.col2im(cols, (6, 6), kernel=(2, 2), stride=(2, 2))
+    onp.testing.assert_allclose(back.asnumpy(), x.asnumpy())
+    # overlapping windows scatter-add
+    cols2 = nd.im2col(x, kernel=(3, 3), stride=(1, 1), pad=(1, 1))
+    assert cols2.shape == (1, 9, 36)
+
+
+def test_activation_tail():
+    x = A([-1.0, 0.5, 7.0])
+    onp.testing.assert_allclose(nd.relu6(x).asnumpy(), [0.0, 0.5, 6.0])
+    assert nd.silu(x).shape == (3,)
+    assert nd.mish(x).shape == (3,)
+    assert nd.log_sigmoid(x).asnumpy()[0] < 0
+
+
+def test_namespace_counts():
+    """VERDICT round-1 item 3: >=300 named ops on the legacy namespaces."""
+    import mxnet_tpu.numpy_extension as npx
+
+    nd_names = [n for n in dir(nd) if not n.startswith("_")]
+    npx_names = [n for n in dir(npx) if not n.startswith("_")]
+    assert len(nd_names) >= 300, len(nd_names)
+    assert len(npx_names) >= 290, len(npx_names)

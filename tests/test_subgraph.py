@@ -1,0 +1,150 @@
+"""Subgraph partitioner / optimize_for extension API.
+
+Reference: src/operator/subgraph/subgraph_property.h SubgraphProperty +
+build_subgraph.cc partitioner + tests/python/unittest/test_subgraph*.py
+(backend registration, fused substitution, numerics preserved)."""
+import jax.numpy as jnp
+import numpy as onp
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import gluon, subgraph
+
+
+@subgraph.register_backend("test_dense_relu")
+class DenseReluBackend(subgraph.SubgraphBackend):
+    """Fuses dot_general/add/max (Dense+ReLU) regions into one callable."""
+
+    MATCH = {"dot_general", "add", "max", "transpose", "reshape"}
+
+    def __init__(self):
+        self.substituted = 0
+
+    def match(self, eqn):
+        return eqn.primitive.name in self.MATCH
+
+    def substitute(self, closed_jaxpr):
+        self.substituted += 1
+        import jax
+
+        def fused(*args):
+            # default lowering of the region, wrapped so the test can see
+            # the substitution happened; a real backend would emit a
+            # Pallas kernel / custom call here
+            return jax.core.eval_jaxpr(closed_jaxpr.jaxpr,
+                                       closed_jaxpr.consts, *args)
+
+        return fused
+
+
+def test_registry():
+    assert "test_dense_relu" in subgraph.list_backends()
+    with pytest.raises(ValueError):
+        subgraph.get_backend("nope")
+
+
+def test_partition_call_fuses_dense_relu():
+    w = jnp.asarray(onp.random.RandomState(0).rand(4, 8).astype("f"))
+    b = jnp.zeros((4,), jnp.float32)
+
+    def f(x):
+        h = jnp.maximum(x @ w.T + b, 0.0)   # dense + relu -> one region
+        s = jnp.sin(h)                      # unmatched
+        return jnp.maximum(s @ jnp.ones((4, 2), jnp.float32), 0.0)
+
+    x = jnp.asarray(onp.random.RandomState(1).rand(3, 8).astype("f"))
+    backend = subgraph.get_backend("test_dense_relu")
+    before = backend.substituted
+    part, n_sub = subgraph.partition_call(f, "test_dense_relu", x)
+    assert n_sub >= 2                       # two dense+relu regions
+    assert backend.substituted - before == n_sub
+    onp.testing.assert_allclose(part(x), f(x), rtol=1e-6)
+
+
+def test_partitioned_fn_is_jittable():
+    import jax
+
+    def f(x):
+        return jnp.maximum(x @ jnp.eye(4, dtype=jnp.float32), 0.0) + 1.0
+
+    x = jnp.asarray(onp.random.RandomState(2).rand(2, 4).astype("f"))
+    part, n = subgraph.partition_call(f, "test_dense_relu", x)
+    jitted = jax.jit(part)
+    onp.testing.assert_allclose(jitted(x), f(x), rtol=1e-6)
+
+
+def test_substitute_changes_numerics_when_backend_does():
+    """A backend that really substitutes different math takes effect."""
+    calls = {"n": 0}
+
+    def fuse(closed):
+        def replacement(*args):
+            calls["n"] += 1
+            outs = __import__("jax").core.eval_jaxpr(
+                closed.jaxpr, closed.consts, *args)
+            return [o * 2.0 for o in outs]  # visible change
+
+        return replacement
+
+    subgraph.register_primitive_backend("test_doubler", {"sin"}, fuse)
+    x = jnp.asarray([0.5, 1.0], dtype=jnp.float32)
+
+    def f(x):
+        return jnp.sin(x) + 1.0
+
+    part, n = subgraph.partition_call(f, "test_doubler", x)
+    assert n == 1
+    onp.testing.assert_allclose(part(x), 2 * onp.sin(x.tolist()) + 1.0,
+                                rtol=1e-6)
+
+
+def test_optimize_for_hybrid_block():
+    """VERDICT item 8 'done' criterion: a test backend fuses Dense+ReLU
+    and optimize_for('test_backend') produces it, numerics unchanged."""
+    mx.seed(0)
+    net = gluon.nn.HybridSequential()
+    net.add(gluon.nn.Dense(16, activation="relu"),
+            gluon.nn.Dense(4))
+    net.initialize()
+    x = mx.np.array(onp.random.RandomState(3).rand(2, 8).astype("f"))
+    y_ref = net(x).asnumpy()
+
+    backend = subgraph.get_backend("test_dense_relu")
+    before = backend.substituted
+    y_opt = net.optimize_for(x, backend="test_dense_relu")
+    assert backend.substituted > before          # regions were substituted
+    assert net._subgraph_count >= 1
+    onp.testing.assert_allclose(y_ref, y_opt.asnumpy(), rtol=1e-5,
+                                atol=1e-5)
+    # subsequent calls run the partitioned compiled variant
+    y_again = net(x).asnumpy()
+    onp.testing.assert_allclose(y_ref, y_again, rtol=1e-5, atol=1e-5)
+
+
+def test_optimize_for_without_backend_still_works():
+    net = gluon.nn.Dense(3)
+    net.initialize()
+    x = mx.np.array(onp.ones((2, 5), "float32"))
+    out = net.optimize_for(x)
+    assert out.shape == (2, 3)
+
+
+def test_optimize_for_survives_cache_clear(tmp_path):
+    """cast()/load_parameters() clear compiled variants; the recorded
+    backend must re-partition on rebuild (reference: HybridBlock
+    remembers its backend across _build_cache)."""
+    mx.seed(0)
+    net = gluon.nn.HybridSequential()
+    net.add(gluon.nn.Dense(8, activation="relu"), gluon.nn.Dense(2))
+    net.initialize()
+    x = mx.np.array(onp.random.RandomState(5).rand(2, 4).astype("f"))
+    y1 = net.optimize_for(x, backend="test_dense_relu")
+    n_first = net._subgraph_count
+    assert n_first >= 1
+    path = str(tmp_path / "p.params")
+    net.save_parameters(path)
+    net.load_parameters(path)          # clears _jit_variants
+    assert not net._jit_variants
+    y2 = net(x)                        # rebuild must re-partition
+    assert net._subgraph_count >= 1
+    onp.testing.assert_allclose(y1.asnumpy(), y2.asnumpy(), rtol=1e-5)

@@ -1,0 +1,74 @@
+"""Thread-safe CachedOp analog: concurrent inference over one hybridized
+block (reference: src/imperative/cached_op_threadsafe.cc +
+tests/python/unittest/test_thread_local.py usage pattern)."""
+import threading
+
+import numpy as onp
+
+import mxnet_tpu as mx
+from mxnet_tpu import gluon
+
+
+def _make_net():
+    mx.seed(0)
+    net = gluon.nn.HybridSequential()
+    net.add(gluon.nn.Dense(32, activation="relu"),
+            gluon.nn.BatchNorm(),
+            gluon.nn.Dense(8))
+    net.initialize()
+    net.hybridize()
+    return net
+
+
+def test_concurrent_predict_matches_sequential():
+    net = _make_net()
+    rs = onp.random.RandomState(0)
+    inputs = [rs.rand(4, 16).astype("float32") for _ in range(16)]
+    # warm one trace, then reference outputs sequentially
+    expected = [net(mx.np.array(x)).asnumpy() for x in inputs]
+
+    results = [None] * len(inputs)
+    errors = []
+
+    def worker(i):
+        try:
+            results[i] = net(mx.np.array(inputs[i])).asnumpy()
+        except Exception as e:  # noqa: BLE001
+            errors.append(e)
+
+    threads = [threading.Thread(target=worker, args=(i,))
+               for i in range(len(inputs))]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert not errors, errors
+    for got, want in zip(results, expected):
+        onp.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-6)
+
+
+def test_concurrent_first_call_builds_once():
+    """All threads race the first trace; the lock makes exactly one build
+    win and everyone returns correct results."""
+    net = _make_net()
+    x = onp.ones((2, 16), "float32")
+    results = []
+    errors = []
+    barrier = threading.Barrier(8)
+
+    def worker():
+        try:
+            barrier.wait()
+            results.append(net(mx.np.array(x)).asnumpy())
+        except Exception as e:  # noqa: BLE001
+            errors.append(e)
+
+    threads = [threading.Thread(target=worker) for _ in range(8)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert not errors, errors
+    assert len(net._jit_variants) == 1
+    for r in results[1:]:
+        onp.testing.assert_allclose(r, results[0], rtol=1e-6)
