@@ -1,0 +1,258 @@
+// C++ test suite for the native runtime (reference analog:
+// tests/cpp/engine/threaded_engine_test.cc, storage/storage_test.cc —
+// gtest-style TEST cases; googletest itself is not vendored in this image,
+// so a minimal macro set provides the same check/report shape).
+//
+// Build + run: make -C native test
+#include <atomic>
+#include <chrono>
+#include <cstdio>
+#include <cstring>
+#include <string>
+#include <thread>
+#include <vector>
+
+extern "C" {
+typedef int (*mxt_fn_t)(void* ctx, char* err, size_t err_len);
+typedef void (*mxt_del_t)(void*);
+const char* MXTGetLastError();
+const char* MXTLibVersion();
+void* MXTEngineNewVar();
+void MXTEngineDeleteVar(void* v);
+int MXTEnginePushAsync(mxt_fn_t fn, mxt_del_t del, void* ctx,
+                       void** const_vars, int n_const, void** mutable_vars,
+                       int n_mutable, int priority, int prop);
+int MXTEngineWaitForVar(void* v);
+int MXTEngineWaitAll();
+uint64_t MXTEngineVarVersion(void* v);
+int64_t MXTEnginePending();
+int64_t MXTEngineLiveVars();
+void* MXTStorageAlloc(int64_t size);
+int MXTStorageFree(void* p);
+int MXTStorageDirectFree(void* p);
+void MXTStorageReleaseAll();
+void MXTStorageStats(int64_t* used, int64_t* pooled, int64_t* n_alloc);
+void* MXTRecordIOWriterCreate(const char* path);
+int MXTRecordIOWriterWrite(void* h, const void* data, int64_t len);
+void MXTRecordIOWriterFree(void* h);
+void* MXTRecordIOReaderCreate(const char* path);
+int64_t MXTRecordIOReaderRead(void* h, const void** data);
+void MXTRecordIOReaderFree(void* h);
+void* MXTPipelineCreate(int n_threads, int capacity);
+int64_t MXTPipelineSubmit(void* h, mxt_fn_t fn, mxt_del_t del, void* ctx);
+int64_t MXTPipelinePop(void* h, int* status, void** ctx,
+                       int64_t timeout_ms);
+void MXTPipelineFree(void* h);
+}
+
+static int g_failures = 0;
+static int g_checks = 0;
+
+#define CHECK_TRUE(cond)                                                   \
+  do {                                                                     \
+    ++g_checks;                                                            \
+    if (!(cond)) {                                                         \
+      ++g_failures;                                                        \
+      std::fprintf(stderr, "FAIL %s:%d: %s\n", __FILE__, __LINE__, #cond); \
+    }                                                                      \
+  } while (0)
+
+#define TEST(name) static void name()
+
+// ---------------------------------------------------------------------------
+// engine: ordering, versions, exception deferral
+// ---------------------------------------------------------------------------
+
+struct Counter {
+  std::atomic<int>* value;
+  int expect;  // serialized ordering check: observed value when running
+  bool fail = false;
+};
+
+static int counter_fn(void* ctx, char* err, size_t err_len) {
+  auto* c = static_cast<Counter*>(ctx);
+  if (c->fail) {
+    std::snprintf(err, err_len, "injected failure");
+    return 1;
+  }
+  int seen = c->value->fetch_add(1);
+  if (c->expect >= 0 && seen != c->expect) return 2;
+  std::this_thread::sleep_for(std::chrono::microseconds(200));
+  return 0;
+}
+static void counter_del(void* ctx) { delete static_cast<Counter*>(ctx); }
+
+TEST(test_engine_write_serialization) {
+  // N writers on one var run serialized in push order (ThreadedVar
+  // version chain semantics)
+  void* var = MXTEngineNewVar();
+  std::atomic<int> value{0};
+  const int N = 64;
+  for (int i = 0; i < N; ++i) {
+    auto* c = new Counter{&value, i};
+    void* mv[] = {var};
+    CHECK_TRUE(MXTEnginePushAsync(counter_fn, counter_del, c, nullptr, 0,
+                                  mv, 1, 0, 0) == 0);
+  }
+  CHECK_TRUE(MXTEngineWaitForVar(var) == 0);
+  CHECK_TRUE(value.load() == N);
+  CHECK_TRUE(MXTEngineVarVersion(var) == (uint64_t)N);
+  MXTEngineDeleteVar(var);
+}
+
+TEST(test_engine_readers_then_writer) {
+  // readers on a var proceed concurrently; a writer waits for them
+  void* var = MXTEngineNewVar();
+  std::atomic<int> value{0};
+  for (int i = 0; i < 8; ++i) {
+    auto* c = new Counter{&value, -1};
+    void* cv[] = {var};
+    CHECK_TRUE(MXTEnginePushAsync(counter_fn, counter_del, c, cv, 1,
+                                  nullptr, 0, 0, 0) == 0);
+  }
+  auto* w = new Counter{&value, 8};  // writer must observe all 8 reads
+  void* mv[] = {var};
+  CHECK_TRUE(MXTEnginePushAsync(counter_fn, counter_del, w, nullptr, 0, mv,
+                                1, 0, 0) == 0);
+  CHECK_TRUE(MXTEngineWaitForVar(var) == 0);
+  CHECK_TRUE(value.load() == 9);
+  MXTEngineDeleteVar(var);
+}
+
+TEST(test_engine_exception_deferred) {
+  // a failing op's error is captured and rethrown at WaitForVar
+  // (reference: threaded_engine.cc:440 deferred exception_ptr)
+  void* var = MXTEngineNewVar();
+  std::atomic<int> value{0};
+  auto* bad = new Counter{&value, -1};
+  bad->fail = true;
+  void* mv[] = {var};
+  CHECK_TRUE(MXTEnginePushAsync(counter_fn, counter_del, bad, nullptr, 0,
+                                mv, 1, 0, 0) == 0);
+  int rc = MXTEngineWaitForVar(var);
+  CHECK_TRUE(rc != 0);
+  CHECK_TRUE(std::strstr(MXTGetLastError(), "injected") != nullptr);
+  // the var is usable again after the error is consumed
+  auto* ok = new Counter{&value, -1};
+  CHECK_TRUE(MXTEnginePushAsync(counter_fn, counter_del, ok, nullptr, 0, mv,
+                                1, 0, 0) == 0);
+  CHECK_TRUE(MXTEngineWaitForVar(var) == 0);
+  MXTEngineDeleteVar(var);
+}
+
+TEST(test_engine_waitall_drains) {
+  std::atomic<int> value{0};
+  std::vector<void*> vars;
+  for (int i = 0; i < 16; ++i) {
+    void* v = MXTEngineNewVar();
+    vars.push_back(v);
+    auto* c = new Counter{&value, -1};
+    void* mv[] = {v};
+    MXTEnginePushAsync(counter_fn, counter_del, c, nullptr, 0, mv, 1, 0, 0);
+  }
+  CHECK_TRUE(MXTEngineWaitAll() == 0);
+  CHECK_TRUE(MXTEnginePending() == 0);
+  CHECK_TRUE(value.load() == 16);
+  for (void* v : vars) MXTEngineDeleteVar(v);
+}
+
+// ---------------------------------------------------------------------------
+// storage pool
+// ---------------------------------------------------------------------------
+
+TEST(test_storage_pool_reuse) {
+  MXTStorageReleaseAll();
+  void* a = MXTStorageAlloc(1 << 16);
+  CHECK_TRUE(a != nullptr);
+  std::memset(a, 0xAB, 1 << 16);
+  CHECK_TRUE(MXTStorageFree(a) == 0);  // back to pool
+  void* b = MXTStorageAlloc(1 << 16);  // bucket hit: same block returns
+  CHECK_TRUE(b == a);
+  int64_t used = 0, pooled = 0, n_alloc = 0;
+  MXTStorageStats(&used, &pooled, &n_alloc);
+  CHECK_TRUE(n_alloc >= 1);
+  CHECK_TRUE(used >= (1 << 16));
+  CHECK_TRUE(MXTStorageDirectFree(b) == 0);  // bypass pool
+  MXTStorageReleaseAll();
+}
+
+// ---------------------------------------------------------------------------
+// RecordIO round-trip
+// ---------------------------------------------------------------------------
+
+TEST(test_recordio_roundtrip) {
+  const char* path = "/tmp/mxtpu_cpp_test.rec";
+  void* w = MXTRecordIOWriterCreate(path);
+  CHECK_TRUE(w != nullptr);
+  for (int i = 0; i < 10; ++i) {
+    std::string rec = "record-" + std::to_string(i) +
+                      std::string(i * 7, 'x');
+    CHECK_TRUE(MXTRecordIOWriterWrite(w, rec.data(),
+                                      (int64_t)rec.size()) == 0);
+  }
+  MXTRecordIOWriterFree(w);
+  void* r = MXTRecordIOReaderCreate(path);
+  CHECK_TRUE(r != nullptr);
+  for (int i = 0; i < 10; ++i) {
+    const void* data = nullptr;
+    int64_t len = MXTRecordIOReaderRead(r, &data);
+    std::string expect = "record-" + std::to_string(i) +
+                         std::string(i * 7, 'x');
+    CHECK_TRUE(len == (int64_t)expect.size());
+    CHECK_TRUE(std::memcmp(data, expect.data(), expect.size()) == 0);
+  }
+  const void* data = nullptr;
+  CHECK_TRUE(MXTRecordIOReaderRead(r, &data) < 0);  // EOF
+  MXTRecordIOReaderFree(r);
+  std::remove(path);
+}
+
+// ---------------------------------------------------------------------------
+// pipeline: ordered pop with worker threads
+// ---------------------------------------------------------------------------
+
+struct Job {
+  int id;
+};
+static int job_fn(void* ctx, char*, size_t) {
+  // jitter so completion order differs from submit order
+  auto* j = static_cast<Job*>(ctx);
+  std::this_thread::sleep_for(std::chrono::microseconds(500 - j->id * 3));
+  return 0;
+}
+static void job_del(void* ctx) { delete static_cast<Job*>(ctx); }
+
+TEST(test_pipeline_ordered_pop) {
+  void* p = MXTPipelineCreate(4, 8);
+  CHECK_TRUE(p != nullptr);
+  const int N = 32;
+  int popped = 0, submitted = 0;
+  while (popped < N) {
+    while (submitted < N && submitted - popped < 8) {
+      CHECK_TRUE(MXTPipelineSubmit(p, job_fn, job_del,
+                                   new Job{submitted}) >= 0);
+      ++submitted;
+    }
+    int status = -1;
+    void* ctx = nullptr;
+    int64_t id = MXTPipelinePop(p, &status, &ctx, (int64_t)10000);
+    CHECK_TRUE(id == popped);  // strictly ordered despite jitter
+    CHECK_TRUE(status == 0);
+    if (ctx) job_del(ctx);
+    ++popped;
+  }
+  MXTPipelineFree(p);
+}
+
+int main() {
+  std::printf("libmxtpu: %s\n", MXTLibVersion());
+  test_engine_write_serialization();
+  test_engine_readers_then_writer();
+  test_engine_exception_deferred();
+  test_engine_waitall_drains();
+  test_storage_pool_reuse();
+  test_recordio_roundtrip();
+  test_pipeline_ordered_pop();
+  std::printf("%d checks, %d failures\n", g_checks, g_failures);
+  return g_failures == 0 ? 0 : 1;
+}

@@ -1,0 +1,18 @@
+"""Run the native C++ test binary (reference analog: tests/cpp/ gtest
+suites — engine semantics, storage, pipeline — built and run via
+native/Makefile `test`)."""
+import shutil
+import subprocess
+
+import pytest
+
+REPO = __file__.rsplit("/tests/", 1)[0]
+
+
+@pytest.mark.skipif(shutil.which("g++") is None, reason="no C++ toolchain")
+def test_native_cpp_suite():
+    out = subprocess.run(
+        ["make", "-s", "-C", f"{REPO}/native", "test"],
+        capture_output=True, text=True, timeout=300)
+    assert out.returncode == 0, out.stdout + out.stderr
+    assert "0 failures" in out.stdout, out.stdout
