@@ -113,6 +113,11 @@ register(
     "Parity knob: arrays above this element count prefer sharded "
     "(reduce-scatter) allreduce in tpu_dist.")
 register(
+    "MXTPU_FLASH_ATTENTION", bool, True,
+    "Use the Pallas flash-attention kernel inside MultiHeadAttention on "
+    "TPU (fused QK^T/softmax/PV, O(S) memory). Off-TPU the jnp reference "
+    "runs either way.")
+register(
     "MXNET_GPU_MEM_POOL_TYPE", str, "Naive",
     "Parity no-op on TPU: device memory pooling is PJRT's "
     "(reference: pooled_storage_manager.h buckets).")

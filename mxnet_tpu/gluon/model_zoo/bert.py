@@ -34,6 +34,18 @@ __all__ = ["MultiHeadAttention", "PositionwiseFFN", "TransformerEncoderCell",
            "bert_12_768_12", "bert_24_1024_16", "get_bert_model"]
 
 
+def _flash_enabled():
+    from ... import env as _env
+
+    return _env.get("MXTPU_FLASH_ATTENTION")
+
+
+def _is_training():
+    from ... import autograd as _ag
+
+    return bool(_ag.is_training())
+
+
 class MultiHeadAttention(HybridBlock):
     """Self-attention with fused QKV projection."""
 
@@ -59,6 +71,20 @@ class MultiHeadAttention(HybridBlock):
         q = qkv[:, :, 0].transpose((0, 2, 1, 3))  # (B, H, S, d)
         k = qkv[:, :, 1].transpose((0, 2, 1, 3))
         v = qkv[:, :, 2].transpose((0, 2, 1, 3))
+        drop_active = self.dropout._rate > 0 and _is_training()
+        if mask is None and not drop_active and _flash_enabled():
+            # (with attention-prob dropout active the reference path runs —
+            # the fused kernel has no dropout inside the softmax)
+            # fused Pallas path (ops/pallas_attention.py): O(S) memory,
+            # MXU-blocked QK^T/softmax/PV
+            from ...ndarray.ndarray import apply_op
+            from ...ops.pallas_attention import flash_attention
+
+            ctxv = apply_op(
+                lambda q_, k_, v_: flash_attention(q_, k_, v_),
+                q, k, v, name="flash_attention")
+            ctxv = ctxv.transpose((0, 2, 1, 3)).reshape((b, s, h * d))
+            return self.out_proj(ctxv)
         scores = np.einsum("bhqd,bhkd->bhqk", q, k) / math.sqrt(d)
         if mask is not None:
             if mask.ndim == 2:

@@ -16,4 +16,5 @@ from . import linalg  # noqa: F401
 from . import vision  # noqa: F401
 from . import legacy  # noqa: F401
 from . import optimizer_ops  # noqa: F401
+from . import pallas_attention  # noqa: F401
 from .registry import list_ops, register_op, get_op  # noqa: F401

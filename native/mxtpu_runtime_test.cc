@@ -76,7 +76,11 @@ static int counter_fn(void* ctx, char* err, size_t err_len) {
     return 1;
   }
   int seen = c->value->fetch_add(1);
-  if (c->expect >= 0 && seen != c->expect) return 2;
+  if (c->expect >= 0 && seen != c->expect) {
+    std::snprintf(err, err_len, "ordering violation: saw %d expected %d",
+                  seen, c->expect);
+    return 2;
+  }
   std::this_thread::sleep_for(std::chrono::microseconds(200));
   return 0;
 }
