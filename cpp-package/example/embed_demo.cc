@@ -1,0 +1,56 @@
+// C++ inference through the packed-function FFI: builds a tiny MLP
+// forward from registered ops (reference analog: cpp-package MLP example
+// over the generated op wrappers).
+//
+// Build (from repo root):
+//   g++ -O2 -std=c++17 cpp-package/example/embed_demo.cc \
+//       -Icpp-package/include $(python3-config --includes) \
+//       -L$(python3-config --prefix)/lib -lpython3.12 -o /tmp/embed_demo
+//   PYTHONPATH=. JAX_PLATFORMS=cpu /tmp/embed_demo
+#include <mxtpu/py_runtime.hpp>
+
+#include <cstdio>
+#include <cstring>
+#include <vector>
+
+static mxtpu::PackedTensor MakeF32(std::vector<long> shape,
+                                   const std::vector<float>& vals) {
+  mxtpu::PackedTensor t;
+  t.shape = std::move(shape);
+  t.dtype = "float32";
+  t.data.assign((const char*)vals.data(), vals.size() * sizeof(float));
+  return t;
+}
+
+int main() {
+  mxtpu::PyRuntime rt;
+  std::string ops = rt.ListOps();
+  std::printf("registered op list: %zu chars\n", ops.size());
+
+  // x: (2, 3); W: (4, 3); dense -> relu
+  auto x = MakeF32({2, 3}, {1, -2, 3, -4, 5, -6});
+  auto w = MakeF32({4, 3}, {0.1f, 0.2f, 0.3f, -0.1f, -0.2f, -0.3f,
+                            0.5f, 0.0f, 0.0f, 0.0f, 0.5f, 0.0f});
+  auto h = rt.invoke("fully_connected", {x, w},
+                     "{\"no_bias\": true}");
+  auto y = rt.invoke("relu", {h[0]});
+  const float* out = (const float*)y[0].data.data();
+  std::printf("relu(dense(x)) [%ld x %ld]:\n", y[0].shape[0], y[0].shape[1]);
+  for (long i = 0; i < y[0].shape[0]; ++i) {
+    for (long j = 0; j < y[0].shape[1]; ++j)
+      std::printf(" %7.3f", out[i * y[0].shape[1] + j]);
+    std::printf("\n");
+  }
+  // softmax over the last axis via attrs
+  auto p = rt.invoke("softmax", {y[0]}, "{\"axis\": -1}");
+  std::printf("softmax row sums: ");
+  const float* pp = (const float*)p[0].data.data();
+  for (long i = 0; i < p[0].shape[0]; ++i) {
+    float s = 0;
+    for (long j = 0; j < p[0].shape[1]; ++j)
+      s += pp[i * p[0].shape[1] + j];
+    std::printf("%6.3f ", s);
+  }
+  std::printf("\nembed_demo OK\n");
+  return 0;
+}

@@ -1,0 +1,147 @@
+// py_runtime.hpp — C++ access to the full operator corpus via the
+// packed-function FFI (reference analog: the TVM-style packed-function
+// registry, src/runtime/ + src/api/, reached from C++ through one
+// MXNetFuncCall symbol; here the one symbol is mxnet_tpu.capi.packed_invoke
+// reached through an embedded CPython).
+//
+// Usage:
+//   mxtpu::PyRuntime rt;                       // starts the interpreter
+//   mxtpu::PackedTensor x{{2, 3}, "float32", bytes};
+//   auto outs = rt.invoke("relu", {x});        // any registered op
+//
+// Build: g++ ... $(python3-config --includes) -lpython3.12
+// (see cpp-package/example/embed_demo.cc).
+#pragma once
+
+#include <Python.h>
+
+#include <cstring>
+#include <stdexcept>
+#include <string>
+#include <vector>
+
+namespace mxtpu {
+
+struct PackedTensor {
+  std::vector<long> shape;
+  std::string dtype;       // numpy dtype name, e.g. "float32"
+  std::string data;        // raw C-order bytes
+};
+
+class PyRuntime {
+ public:
+  PyRuntime() {
+    owned_ = !Py_IsInitialized();
+    if (owned_) Py_Initialize();
+    PyObject* mod = PyImport_ImportModule("mxnet_tpu.capi");
+    if (!mod) {
+      PyErr_Print();
+      throw std::runtime_error("cannot import mxnet_tpu.capi "
+                               "(is mxnet_tpu on PYTHONPATH?)");
+    }
+    invoke_ = PyObject_GetAttrString(mod, "packed_invoke");
+    list_ops_ = PyObject_GetAttrString(mod, "list_ops");
+    Py_DECREF(mod);
+    if (!invoke_ || !list_ops_)
+      throw std::runtime_error("mxnet_tpu.capi missing entry points");
+  }
+
+  ~PyRuntime() {
+    Py_XDECREF(invoke_);
+    Py_XDECREF(list_ops_);
+    if (owned_) Py_Finalize();
+  }
+
+  // JSON array of every registered operator name.
+  std::string ListOps() {
+    PyObject* r = PyObject_CallNoArgs(list_ops_);
+    if (!r) { PyErr_Print(); throw std::runtime_error("list_ops failed"); }
+    std::string out(PyUnicode_AsUTF8(r));
+    Py_DECREF(r);
+    return out;
+  }
+
+  // The one packed call: op name + tensors + JSON attrs -> output tensors.
+  std::vector<PackedTensor> invoke(const std::string& op,
+                                   const std::vector<PackedTensor>& args,
+                                   const std::string& attrs_json = "{}") {
+    std::string blob;
+    std::string meta = "{\"args\": [";
+    for (size_t i = 0; i < args.size(); ++i) {
+      if (i) meta += ", ";
+      meta += "{\"shape\": [";
+      for (size_t d = 0; d < args[i].shape.size(); ++d) {
+        if (d) meta += ", ";
+        meta += std::to_string(args[i].shape[d]);
+      }
+      meta += "], \"dtype\": \"" + args[i].dtype + "\"}";
+      blob += args[i].data;
+    }
+    meta += "], \"attrs\": " + attrs_json + "}";
+
+    PyObject* pyblob =
+        PyBytes_FromStringAndSize(blob.data(), (Py_ssize_t)blob.size());
+    PyObject* r = PyObject_CallFunction(invoke_, "sOs", op.c_str(), pyblob,
+                                        meta.c_str());
+    Py_DECREF(pyblob);
+    if (!r) {
+      PyErr_Print();
+      throw std::runtime_error("packed_invoke(" + op + ") failed");
+    }
+    PyObject* out_blob = PyTuple_GetItem(r, 0);
+    PyObject* out_meta = PyTuple_GetItem(r, 1);
+    const char* bytes;
+    Py_ssize_t n;
+    PyBytes_AsStringAndSize(out_blob, const_cast<char**>(&bytes), &n);
+    std::string all(bytes, (size_t)n);
+    std::string mj(PyUnicode_AsUTF8(out_meta));
+    Py_DECREF(r);
+    return Unpack(all, mj);
+  }
+
+ private:
+  static size_t DtypeSize(const std::string& dt) {
+    if (dt == "float64" || dt == "int64" || dt == "uint64") return 8;
+    if (dt == "float32" || dt == "int32" || dt == "uint32") return 4;
+    if (dt == "float16" || dt == "bfloat16" || dt == "int16") return 2;
+    return 1;
+  }
+
+  // minimal parse of {"outputs": [{"shape": [..], "dtype": ".."}, ..]}
+  static std::vector<PackedTensor> Unpack(const std::string& blob,
+                                          const std::string& meta) {
+    std::vector<PackedTensor> outs;
+    size_t pos = 0, off = 0;
+    while ((pos = meta.find("\"shape\":", pos)) != std::string::npos) {
+      PackedTensor t;
+      size_t lb = meta.find('[', pos), rb = meta.find(']', lb);
+      std::string dims = meta.substr(lb + 1, rb - lb - 1);
+      size_t start = 0;
+      while (start < dims.size()) {
+        size_t comma = dims.find(',', start);
+        if (comma == std::string::npos) comma = dims.size();
+        std::string d = dims.substr(start, comma - start);
+        if (d.find_first_not_of(" \t") != std::string::npos)
+          t.shape.push_back(std::stol(d));
+        start = comma + 1;
+      }
+      size_t dq = meta.find("\"dtype\":", rb);
+      size_t q1 = meta.find('"', dq + 8), q2 = meta.find('"', q1 + 1);
+      t.dtype = meta.substr(q1 + 1, q2 - q1 - 1);
+      size_t count = 1;
+      for (long d : t.shape) count *= (size_t)d;
+      size_t nbytes = count * DtypeSize(t.dtype);
+      t.data = blob.substr(off, nbytes);
+      off += nbytes;
+      outs.push_back(std::move(t));
+      pos = q2;
+    }
+    return outs;
+  }
+
+  PyObject* invoke_ = nullptr;
+  PyObject* list_ops_ = nullptr;
+  bool owned_ = false;
+};
+
+}  // namespace mxtpu

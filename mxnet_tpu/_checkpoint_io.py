@@ -1,0 +1,96 @@
+"""Checkpoint IO through the native dependency engine.
+
+The reference pushes save/load work through the engine so checkpoint
+writes overlap training and conflicting accesses serialize on vars
+(SURVEY §5 checkpoint/resume; reference NDArray::Save runs under
+WaitToRead + file IO off the compute path). Here: each checkpoint path
+owns an engine variable; writes are pushed as IO-property ops that
+mutate the path var, so
+  * training continues while the .npz serializes on an engine thread,
+  * two writes to the same path serialize in order,
+  * a load (or `mx.nd.waitall()`) blocks until pending writes to that
+    path land, and a failed write's exception is rethrown there
+    (deferred-exception semantics, threaded_engine.cc:440).
+Falls back to synchronous writes when the native engine is unavailable.
+"""
+from __future__ import annotations
+
+import os
+import threading
+
+import numpy as _np
+
+__all__ = ["async_save_npz", "wait_for_path"]
+
+_path_vars = {}
+_pending = {}    # key -> queued-but-unfinished write count
+_lock = threading.Lock()
+
+
+def _key(path):
+    # canonical key: save('ck') and load(abspath('ck')) must synchronize
+    return os.path.abspath(str(path))
+
+
+def async_save_npz(path, arrays):
+    """Write `arrays` (name -> numpy) to `path` as .npz via the engine.
+
+    Returns immediately; the write runs on an engine IO thread. Call
+    wait_for_path(path) (or engine.waitall()) to barrier."""
+    from . import engine
+
+    path = str(path)
+
+    def write():
+        with open(path, "wb") as f:
+            _np.savez(f, **arrays)
+
+    eng = engine.native_engine()
+    if eng is None or engine.is_naive():
+        write()  # synchronous fallback (no var allocated)
+        return
+    key = _key(path)
+
+    def write_and_count():
+        try:
+            write()
+        finally:
+            with _lock:
+                _pending[key] -= 1
+
+    # push under the lock so reclamation (wait_for_path) can never observe
+    # a var between lookup and push
+    with _lock:
+        var = _path_vars.get(key)
+        if var is None:
+            var = eng.new_var()
+            _path_vars[key] = var
+        _pending[key] = _pending.get(key, 0) + 1
+        engine.push(write_and_count, mutable_vars=(var,), io=True)
+
+
+def wait_for_path(path):
+    """Block until pending writes to `path` complete; rethrows a failed
+    write's deferred exception (reference: WaitForVar). The path's engine
+    var is reclaimed once drained (epoch-stamped checkpoint names would
+    otherwise leak one var per epoch)."""
+    from . import engine
+
+    eng = engine.native_engine()
+    if eng is None:
+        return
+    key = _key(path)
+    with _lock:
+        var = _path_vars.get(key)
+    if var is None:
+        return
+    engine.wait_for_var(var)  # concurrent waiters all block here
+    # reclaim only when provably idle: no queued writes (so no pending
+    # engine ops reference the var) and the mapping unchanged
+    with _lock:
+        if _pending.get(key, 0) == 0 and _path_vars.get(key) is var:
+            _path_vars.pop(key, None)
+            _pending.pop(key, None)
+            delete = getattr(eng, "delete_var", None)
+            if delete is not None:
+                delete(var)

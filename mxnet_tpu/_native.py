@@ -114,10 +114,23 @@ if NATIVE is not None:
     @atexit.register
     def _shutdown():  # pragma: no cover - process teardown
         try:
-            NATIVE.MXTEngineWaitAll()
+            rc = NATIVE.MXTEngineWaitAll()
+            if rc != 0:
+                # a deferred IO failure (e.g. the final checkpoint write)
+                # must not vanish into a 0 exit: report and fail the
+                # process so schedulers/CI see the loss
+                try:
+                    msg = NATIVE.MXTGetLastError().decode()
+                except Exception:
+                    msg = "<unavailable>"
+                print(f"[mxtpu] engine drain failed at exit "
+                      f"(lost async write?): {msg}", file=sys.stderr)
+                NATIVE.MXTEngineShutdown()
+                sys.stderr.flush()
+                os._exit(1)
             NATIVE.MXTEngineShutdown()
-        except Exception:
-            pass
+        except Exception as e:
+            print(f"[mxtpu] engine shutdown error: {e}", file=sys.stderr)
 
 
 # Live per-op fn callbacks, keyed by a MODULE-GLOBAL op id (all

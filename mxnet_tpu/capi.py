@@ -1,0 +1,62 @@
+"""Packed-function FFI entry point (reference: the TVM-style
+MXNET_REGISTER_API registry — src/api/ + src/runtime/, 188 entries with
+`MXNetValue` argument packing, consumed through ONE C symbol
+`MXNetFuncCall`).
+
+TPU re-design: the op corpus is pure-jax functions behind Python, so the
+non-Python FFI is ONE generic packed call: arguments arrive as a raw
+byte blob + a JSON manifest (shapes/dtypes/attrs), outputs return the
+same way. C++ callers embed CPython (cpp-package/include/mxtpu/
+py_runtime.hpp) and reach every registered operator — the reference's
+"C++ caller can invoke any NNVM op" property — without per-op glue code
+(the reference generated 188 wrappers; here the manifest is the
+packing).
+"""
+from __future__ import annotations
+
+import json
+
+import numpy as _np
+
+__all__ = ["packed_invoke", "list_ops"]
+
+
+def list_ops():
+    from .ops.registry import list_ops as _list
+
+    return json.dumps(_list())
+
+
+def packed_invoke(op_name, blob, meta_json):
+    """Invoke a registered op through the packed convention.
+
+    blob: concatenated C-order raw array bytes.
+    meta_json: {"args": [{"shape": [...], "dtype": "float32"}, ...],
+                "attrs": {...}}  — attrs pass as python kwargs.
+    Returns (out_blob, out_meta_json) with the same packing.
+    """
+    from .ops.registry import get_op
+
+    meta = json.loads(meta_json)
+    arrays = []
+    off = 0
+    for spec in meta.get("args", []):
+        shape = tuple(spec["shape"])
+        dtype = _np.dtype(spec["dtype"])
+        n = int(_np.prod(shape, dtype=_np.int64)) * dtype.itemsize
+        arrays.append(_np.frombuffer(
+            blob[off:off + n], dtype=dtype).reshape(shape))
+        off += n
+    attrs = meta.get("attrs", {})
+    # JSON lists -> tuples (op signatures expect hashable/static tuples)
+    attrs = {k: tuple(v) if isinstance(v, list) else v
+             for k, v in attrs.items()}
+
+    fn = get_op(op_name)
+    out = fn(*arrays, **attrs)
+    outs = list(out) if isinstance(out, (tuple, list)) else [out]
+    outs = [_np.asarray(o) for o in outs]
+    out_meta = {"outputs": [{"shape": list(o.shape), "dtype": str(o.dtype)}
+                            for o in outs]}
+    out_blob = b"".join(_np.ascontiguousarray(o).tobytes() for o in outs)
+    return out_blob, json.dumps(out_meta)

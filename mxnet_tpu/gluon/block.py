@@ -198,10 +198,13 @@ class Block:
             if p._data_map is None:
                 continue
             arrays[name] = _np.asarray(p.data().asnumpy())
-        # write to the exact filename given (np.savez on a path appends
-        # .npz; a file object preserves the 'model.params' idiom)
-        with open(filename, "wb") as f:
-            _np.savez(f, **arrays)
+        # the serialize+write runs on a native-engine IO thread so training
+        # continues while the checkpoint lands; loads (and waitall) barrier
+        # on the path's engine var (_checkpoint_io; reference: engine-pushed
+        # NDArray::Save)
+        from .._checkpoint_io import async_save_npz
+
+        async_save_npz(filename, arrays)
 
     def load_parameters(self, filename, device=None, allow_missing=False,
                         ignore_extra=False, cast_dtype=False,
@@ -209,6 +212,9 @@ class Block:
         """Load params saved by save_parameters (reference: block.py:379)."""
         import os
 
+        from .._checkpoint_io import wait_for_path
+
+        wait_for_path(str(filename))  # barrier on any in-flight async save
         device = device if device is not None else ctx
         path = str(filename)
         if not os.path.exists(path) and os.path.exists(path + ".npz"):
@@ -668,10 +674,17 @@ class SymbolBlock(HybridBlock):
             from jax import export as jax_export
 
             base = os.path.dirname(os.path.abspath(symbol_file))
+            from .._checkpoint_io import wait_for_path
 
             def _resolve(p):
-                return p if os.path.exists(p) else os.path.join(
-                    base, os.path.basename(p))
+                # barrier BEFORE the existence probe — an in-flight async
+                # save would otherwise redirect to the wrong path
+                wait_for_path(p)
+                if os.path.exists(p):
+                    return p
+                alt = os.path.join(base, os.path.basename(p))
+                wait_for_path(alt)
+                return alt
 
             with open(_resolve(meta["stablehlo"]), "rb") as f:
                 exported = jax_export.deserialize(f.read())

@@ -194,6 +194,9 @@ class CheckpointHandler(TrainBegin, BatchEnd, EpochEnd):
             self.saved.append(path)
             while len(self.saved) > self.max_checkpoints:
                 old = self.saved.pop(0)
+                from ...._checkpoint_io import wait_for_path
+
+                wait_for_path(old)  # the async write may still be queued
                 if os.path.exists(old):
                     os.remove(old)
         if estimator.trainer is not None:

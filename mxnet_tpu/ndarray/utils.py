@@ -18,8 +18,10 @@ _LIST_PREFIX = "__mx_list__:"
 
 def _to_np(a):
     if isinstance(a, NDArray):
-        return a.asnumpy()
-    return _np.asarray(a)
+        return a.asnumpy()  # already a host copy
+    # snapshot: save() writes asynchronously on an engine IO thread, so
+    # the payload must not alias caller-mutable numpy buffers
+    return _np.array(a)
 
 
 def save(fname, data):
@@ -40,8 +42,13 @@ def save(fname, data):
         raise ValueError(
             "save expects NDArray, list of NDArray, or dict of str->NDArray,"
             f" got {type(data)}")
-    with open(fname, "wb") as f:  # honor the exact path (savez would append .npz)
-        _np.savez(f, **payload)
+    # async write through the native engine (load/waitall barrier on the
+    # path var; _checkpoint_io) — honors the exact path, savez would
+    # append .npz. Snapshot aliasing numpy inputs: the write happens later
+    # on an IO thread and must not see post-save mutations.
+    from .._checkpoint_io import async_save_npz
+
+    async_save_npz(fname, payload)
 
 
 def savez(fname, *args, **kwargs):
@@ -54,12 +61,16 @@ def savez(fname, *args, **kwargs):
 
 def load(fname):
     """Load what save() wrote: returns NDArray, list, or dict to match."""
+    from .._checkpoint_io import wait_for_path
+
+    wait_for_path(fname)  # barrier on an in-flight async save
     if fname.endswith(".npy"):
         return array(_np.load(fname))
     import os
 
     if not os.path.exists(fname) and os.path.exists(fname + ".npz"):
         fname = fname + ".npz"  # np.savez appends .npz when missing
+        wait_for_path(fname)
     with _np.load(fname) as z:
         keys = list(z.keys())
         if keys and all(k.startswith(_LIST_PREFIX) for k in keys):

@@ -348,3 +348,51 @@ def _arange_like_impl(ins, a):
 
 
 arange_like = _reg("arange_like")(_arange_like_impl)
+
+
+# -- linalg family (la_op.cc parity at the symbol level) --------------------
+# lowerings reuse the registry's pure implementations so symbolic ==
+# imperative for the whole linalg_* corpus
+# multi-output members (reference la_op.cc: gelqf -> Q,L; syevd -> U,L;
+# plus the np-backed additions)
+_LINALG_NOUT = {"linalg_gelqf": 2, "linalg_syevd": 2, "linalg_svd": 3,
+                "linalg_qr": 2, "linalg_slogdet": 2, "linalg_eig": 2,
+                "linalg_eigh": 2, "linalg_lstsq": 4}
+
+
+def _register_linalg():
+    import inspect
+
+    from ..ops.registry import _OPS
+
+    added = []
+    for opname, fn in sorted(_OPS.items()):
+        if not opname.startswith("linalg_"):
+            continue
+        try:
+            params = set(inspect.signature(fn).parameters)
+        except (TypeError, ValueError):
+            params = None
+        nout = _LINALG_NOUT.get(opname, 1)
+
+        def lower(ins, a, _f=fn, _params=params):
+            # keep only kwargs the op accepts — AttrScope can inject
+            # bookkeeping attrs (ctx_group...) that must not reach the fn
+            kw = {k: v for k, v in a.items()
+                  if _params is None or k in _params}
+            return _f(*ins, **kw)
+
+        register_sym_op(opname, lower)
+
+        def wrapper(*inputs, name=None, _op=opname, _n=nout,  # noqa: A002
+                    **attrs):
+            return Symbol.create(_op, *inputs, name=name, nout=_n, **attrs)
+
+        wrapper.__name__ = opname
+        globals()[opname] = wrapper
+        __all__.append(opname)
+        added.append(opname)
+    return added
+
+
+_register_linalg()

@@ -194,3 +194,32 @@ class TestZooExportRoundtrip:
                                     rtol=1e-4, atol=1e-4)
         onp.testing.assert_allclose(e_ref.asnumpy(), e2.asnumpy(),
                                     rtol=1e-4, atol=1e-4)
+
+
+class TestSymbolLinalg:
+    """Symbol-level la_op family (reference: src/operator/tensor/la_op.cc
+    registered under mx.sym.linalg_*)."""
+
+    def test_table_includes_linalg(self):
+        names = [n for n in sym.__all__ if n.startswith("linalg_")]
+        assert len(names) >= 20, names
+
+    def test_potrf_trsm_roundtrip(self):
+        a = sym.var("a")
+        spd = onp.array([[4.0, 1.0], [1.0, 3.0]], "float32")
+        chol = sym.linalg_potrf(a).eval(a=mx.np.array(spd))[0].asnumpy()
+        onp.testing.assert_allclose(chol @ chol.T, spd, rtol=1e-5)
+        # solve L x = b with trsm
+        b = onp.array([[2.0], [1.0]], "float32")
+        x = sym.linalg_trsm(sym.var("l"), sym.var("b")).eval(
+            l=mx.np.array(chol), b=mx.np.array(b))[0].asnumpy()
+        onp.testing.assert_allclose(chol @ x, b, rtol=1e-4, atol=1e-5)
+
+    def test_sumlogdiag_det(self):
+        a = sym.var("a")
+        m = onp.array([[2.0, 0.0], [0.5, 3.0]], "float32")
+        out = sym.linalg_sumlogdiag(a).eval(a=mx.np.array(m))[0].asnumpy()
+        onp.testing.assert_allclose(out, onp.log(2.0) + onp.log(3.0),
+                                    rtol=1e-5)
+        d = sym.linalg_det(a).eval(a=mx.np.array(m))[0].asnumpy()
+        onp.testing.assert_allclose(d, 6.0, rtol=1e-5)
