@@ -28,11 +28,28 @@ struct PackedTensor {
   std::string data;        // raw C-order bytes
 };
 
+// Holds the GIL for a scope when the interpreter is shared with a host
+// app (PyRuntime embedded into an already-initialized interpreter).
+class GilGuard {
+ public:
+  explicit GilGuard(bool needed) : needed_(needed) {
+    if (needed_) state_ = PyGILState_Ensure();
+  }
+  ~GilGuard() {
+    if (needed_) PyGILState_Release(state_);
+  }
+
+ private:
+  bool needed_;
+  PyGILState_STATE state_{};
+};
+
 class PyRuntime {
  public:
   PyRuntime() {
     owned_ = !Py_IsInitialized();
     if (owned_) Py_Initialize();
+    GilGuard gil(!owned_);
     PyObject* mod = PyImport_ImportModule("mxnet_tpu.capi");
     if (!mod) {
       PyErr_Print();
@@ -47,13 +64,17 @@ class PyRuntime {
   }
 
   ~PyRuntime() {
-    Py_XDECREF(invoke_);
-    Py_XDECREF(list_ops_);
+    {
+      GilGuard gil(!owned_);
+      Py_XDECREF(invoke_);
+      Py_XDECREF(list_ops_);
+    }
     if (owned_) Py_Finalize();
   }
 
   // JSON array of every registered operator name.
   std::string ListOps() {
+    GilGuard gil(!owned_);
     PyObject* r = PyObject_CallNoArgs(list_ops_);
     if (!r) { PyErr_Print(); throw std::runtime_error("list_ops failed"); }
     std::string out(PyUnicode_AsUTF8(r));
@@ -79,6 +100,7 @@ class PyRuntime {
     }
     meta += "], \"attrs\": " + attrs_json + "}";
 
+    GilGuard gil(!owned_);
     PyObject* pyblob =
         PyBytes_FromStringAndSize(blob.data(), (Py_ssize_t)blob.size());
     PyObject* r = PyObject_CallFunction(invoke_, "sOs", op.c_str(), pyblob,
@@ -101,10 +123,16 @@ class PyRuntime {
 
  private:
   static size_t DtypeSize(const std::string& dt) {
-    if (dt == "float64" || dt == "int64" || dt == "uint64") return 8;
+    if (dt == "complex128") return 16;
+    if (dt == "float64" || dt == "int64" || dt == "uint64" ||
+        dt == "complex64")
+      return 8;
     if (dt == "float32" || dt == "int32" || dt == "uint32") return 4;
-    if (dt == "float16" || dt == "bfloat16" || dt == "int16") return 2;
-    return 1;
+    if (dt == "float16" || dt == "bfloat16" || dt == "int16" ||
+        dt == "uint16")
+      return 2;
+    if (dt == "int8" || dt == "uint8" || dt == "bool") return 1;
+    throw std::runtime_error("unknown dtype in packed output: " + dt);
   }
 
   // minimal parse of {"outputs": [{"shape": [..], "dtype": ".."}, ..]}

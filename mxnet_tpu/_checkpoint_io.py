@@ -39,7 +39,7 @@ def async_save_npz(path, arrays):
     wait_for_path(path) (or engine.waitall()) to barrier."""
     from . import engine
 
-    path = str(path)
+    path = _key(path)  # bind the directory at save time, not flush time
 
     def write():
         with open(path, "wb") as f:
@@ -71,9 +71,7 @@ def async_save_npz(path, arrays):
 
 def wait_for_path(path):
     """Block until pending writes to `path` complete; rethrows a failed
-    write's deferred exception (reference: WaitForVar). The path's engine
-    var is reclaimed once drained (epoch-stamped checkpoint names would
-    otherwise leak one var per epoch)."""
+    write's deferred exception (reference: WaitForVar)."""
     from . import engine
 
     eng = engine.native_engine()
@@ -85,12 +83,28 @@ def wait_for_path(path):
     if var is None:
         return
     engine.wait_for_var(var)  # concurrent waiters all block here
-    # reclaim only when provably idle: no queued writes (so no pending
-    # engine ops reference the var) and the mapping unchanged
+    _reap(key, var)
+
+
+def _reap(key, var):
+    """Drop the bookkeeping entry once the path is idle. The native var is
+    deliberately NOT delete_var'd: another waiter may still hold the raw
+    pointer (deleting here would be a use-after-free); a Var is ~100 bytes
+    and is reclaimed at engine shutdown, so the residual cost per distinct
+    checkpoint path is negligible against the UAF risk."""
     with _lock:
         if _pending.get(key, 0) == 0 and _path_vars.get(key) is var:
             _path_vars.pop(key, None)
             _pending.pop(key, None)
-            delete = getattr(eng, "delete_var", None)
-            if delete is not None:
-                delete(var)
+
+
+def reap_idle():
+    """Drop bookkeeping for every idle path — called from engine.waitall()
+    (global quiescence), so epoch-stamped saves that are never loaded
+    don't grow the maps unboundedly."""
+    with _lock:
+        idle = [k for k, v in _path_vars.items()
+                if _pending.get(k, 0) == 0]
+        for k in idle:
+            _path_vars.pop(k, None)
+            _pending.pop(k, None)

@@ -72,6 +72,9 @@ def waitall():
     eng = native_engine()
     if eng is not None:
         eng.wait_all()
+        from ._checkpoint_io import reap_idle
+
+        reap_idle()  # all IO drained: drop per-path bookkeeping
 
 
 def native_engine():

@@ -219,6 +219,7 @@ class Block:
         path = str(filename)
         if not os.path.exists(path) and os.path.exists(path + ".npz"):
             path = path + ".npz"
+            wait_for_path(path)  # the save may have keyed the .npz name
         loaded = _np.load(path, allow_pickle=False)
         params = self._collect_params_with_prefix()
         for name, p in params.items():
