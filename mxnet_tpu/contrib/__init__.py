@@ -1,5 +1,9 @@
-"""mx.contrib — quantization, contrib ops, misc extensions (reference:
-python/mxnet/contrib/)."""
+"""mx.contrib — quantization, contrib ops, text, tensorboard, io
+(reference: python/mxnet/contrib/)."""
+from . import io  # noqa: F401
 from . import ops  # noqa: F401
 from . import ops as nd  # noqa: F401  (reference spelling: mx.contrib.nd)
+from . import ops as symbol  # noqa: F401  (reference: contrib/symbol.py)
 from . import quantization  # noqa: F401
+from . import tensorboard  # noqa: F401
+from . import text  # noqa: F401

@@ -1,0 +1,46 @@
+"""Contrib data iterators (reference: python/mxnet/contrib/io.py).
+
+``DataLoaderIter`` adapts a ``gluon.data.DataLoader`` to the legacy
+``DataIter`` interface so loader-based pipelines can feed DataIter-era
+training loops.
+"""
+from __future__ import annotations
+
+from .. import numpy as _mxnp
+from ..io import DataBatch, DataDesc, DataIter
+
+__all__ = ["DataLoaderIter"]
+
+
+class DataLoaderIter(DataIter):
+    def __init__(self, loader, data_name="data",
+                 label_name="softmax_label", dtype="float32"):
+        super().__init__()
+        self._loader = loader
+        self._iter = iter(loader)
+        data, label = next(self._iter)
+        self.batch_size = int(data.shape[0])
+        self.dtype = dtype
+        self.provide_data = [DataDesc(data_name, tuple(data.shape), dtype)]
+        self.provide_label = [
+            DataDesc(label_name, tuple(label.shape), dtype)]
+        # keep the peeked batch and the partially-consumed iterator so
+        # batch 0 is served first even for one-shot iterables
+        self._first = (data, label)
+
+    def reset(self):
+        self._first = None
+        self._iter = iter(self._loader)
+
+    def next(self):
+        if self._first is not None:
+            data, label = self._first
+            self._first = None
+        else:
+            data, label = next(self._iter)
+        pad = self.batch_size - int(data.shape[0])
+        data = _mxnp.array(data, dtype=self.dtype)
+        label = _mxnp.array(label)
+        return DataBatch([data], [label], pad=pad,
+                         provide_data=self.provide_data,
+                         provide_label=self.provide_label)

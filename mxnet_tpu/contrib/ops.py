@@ -537,3 +537,437 @@ BipartiteMatching = bipartite_matching
 AllClose = allclose
 __all__ += ["MultiBoxPrior", "MultiBoxTarget", "MultiBoxDetection",
             "ROIAlign", "BipartiteMatching", "AllClose"]
+
+
+# --- adaptive / resize pooling (reference: adaptive_avg_pooling.cc,
+# bilinear_resize.cc) -------------------------------------------------------
+
+def adaptive_avg_pooling(data, output_size=1):
+    """AdaptiveAvgPooling2D: NCHW -> (N, C, oh, ow); bin i spans
+    [floor(i*H/oh), ceil((i+1)*H/oh)) like the reference kernel."""
+    if isinstance(output_size, int):
+        oh = ow = int(output_size)
+    else:
+        oh, ow = (int(output_size[0]), int(output_size[-1]))
+
+    def pure(x):
+        n, c, h, w = x.shape
+        rows = []
+        for i in range(oh):
+            h0, h1 = (i * h) // oh, -((-(i + 1) * h) // oh)
+            cols = []
+            for j in range(ow):
+                w0, w1 = (j * w) // ow, -((-(j + 1) * w) // ow)
+                cols.append(x[:, :, h0:h1, w0:w1].mean(axis=(2, 3)))
+            rows.append(jnp.stack(cols, axis=-1))
+        return jnp.stack(rows, axis=-2)
+
+    return apply_op(pure, data, name="adaptive_avg_pooling")
+
+
+def bilinear_resize_2d(data, height=None, width=None, scale_height=None,
+                       scale_width=None, mode="size"):  # noqa: ARG001
+    """BilinearResize2D (reference: bilinear_resize-inl.h). Uses the
+    reference's align-corners mapping src = dst*(in-1)/(out-1)."""
+    h, w = data.shape[2], data.shape[3]
+    if height is None:
+        height = int(round(h * (scale_height or 1.0)))
+    if width is None:
+        width = int(round(w * (scale_width or 1.0)))
+    height, width = int(height), int(width)
+
+    def pure(x):
+        def axis_coords(out_n, in_n):
+            if out_n == 1 or in_n == 1:
+                return jnp.zeros((out_n,), x.dtype)
+            return jnp.arange(out_n, dtype=x.dtype) * (
+                (in_n - 1) / (out_n - 1))
+
+        ys, xs = axis_coords(height, h), axis_coords(width, w)
+        y0 = jnp.clip(jnp.floor(ys).astype(jnp.int32), 0, h - 1)
+        x0 = jnp.clip(jnp.floor(xs).astype(jnp.int32), 0, w - 1)
+        y1, x1 = jnp.minimum(y0 + 1, h - 1), jnp.minimum(x0 + 1, w - 1)
+        wy = (ys - y0.astype(x.dtype))[None, None, :, None]
+        wx = (xs - x0.astype(x.dtype))[None, None, None, :]
+        g = lambda yy, xx: x[:, :, yy, :][:, :, :, xx]  # noqa: E731
+        return ((1 - wy) * (1 - wx) * g(y0, x0) + (1 - wy) * wx * g(y0, x1)
+                + wy * (1 - wx) * g(y1, x0) + wy * wx * g(y1, x1))
+
+    return apply_op(pure, data, name="bilinear_resize_2d")
+
+
+# --- FFT (reference: fft.cc / ifft.cc) -------------------------------------
+
+def fft(data, compute_size=128):  # noqa: ARG001
+    """contrib.fft: FFT along the last axis; output interleaves
+    real/imag as (..., 2*d) like the reference cuFFT wrapper."""
+    def pure(x):
+        y = jnp.fft.fft(x.astype(jnp.float32), axis=-1)
+        return jnp.stack([y.real, y.imag], axis=-1).reshape(
+            *x.shape[:-1], 2 * x.shape[-1]).astype(jnp.float32)
+
+    return apply_op(pure, data, name="fft")
+
+
+def ifft(data, compute_size=128):  # noqa: ARG001
+    """contrib.ifft: inverse of `fft` — input (..., 2*d) interleaved,
+    output (..., d). Matches the reference's unnormalized cuFFT inverse
+    (scaled by d relative to numpy's ifft)."""
+    def pure(x):
+        d = x.shape[-1] // 2
+        z = x.reshape(*x.shape[:-1], d, 2)
+        y = jnp.fft.ifft(
+            z[..., 0].astype(jnp.float32)
+            + 1j * z[..., 1].astype(jnp.float32), axis=-1) * d
+        return y.real.astype(jnp.float32)
+
+    return apply_op(pure, data, name="ifft")
+
+
+# --- straight-through / gradient-scaling ops (reference: stes_op.cc,
+# gradient_multiplier_op.cc) ------------------------------------------------
+
+@jax.custom_vjp
+def _round_ste_jx(x):
+    return jnp.round(x)
+
+
+_round_ste_jx.defvjp(lambda x: (jnp.round(x), None),
+                     lambda res, g: (g,))
+
+
+@jax.custom_vjp
+def _sign_ste_jx(x):
+    return jnp.sign(x)
+
+
+_sign_ste_jx.defvjp(lambda x: (jnp.sign(x), None),
+                    lambda res, g: (g,))
+
+
+def round_ste(data):
+    """Round with straight-through gradient (reference: stes_op.cc)."""
+    return apply_op(_round_ste_jx, data, name="round_ste")
+
+
+def sign_ste(data):
+    """Sign with straight-through gradient (reference: stes_op.cc)."""
+    return apply_op(_sign_ste_jx, data, name="sign_ste")
+
+
+def gradientmultiplier(data, scalar=1.0):
+    """Identity forward, gradient scaled by `scalar` on backward
+    (reference: gradient_multiplier_op.cc)."""
+    s = float(scalar)
+
+    @jax.custom_vjp
+    def f(x):
+        return x
+
+    f.defvjp(lambda x: (x, None), lambda res, g: (g * s,))
+    return apply_op(f, data, name="gradientmultiplier")
+
+
+def gradientreversal(data, scalar=1.0):
+    """Gradient reversal layer = gradientmultiplier with -scalar."""
+    return gradientmultiplier(data, -float(scalar))
+
+
+# --- transformer fused projections (reference: transformer.cc) -------------
+
+def div_sqrt_dim(data):
+    """data / sqrt(last_dim) (reference: transformer.cc _contrib_div_sqrt_dim)."""
+    return apply_op(
+        lambda x: x / jnp.sqrt(jnp.asarray(x.shape[-1], x.dtype)),
+        data, name="div_sqrt_dim")
+
+
+def interleaved_matmul_selfatt_qk(queries_keys_values, heads):
+    """(L, B, H*3*D) interleaved qkv -> attention scores (B*H, L, L)
+    scaled by 1/sqrt(D) (reference: transformer.cc
+    _contrib_interleaved_matmul_selfatt_qk)."""
+    def pure(x):
+        L, B, E = x.shape
+        D = E // (3 * heads)
+        qkv = x.reshape(L, B, heads, 3, D)
+        q = qkv[:, :, :, 0].transpose(1, 2, 0, 3).reshape(B * heads, L, D)
+        k = qkv[:, :, :, 1].transpose(1, 2, 0, 3).reshape(B * heads, L, D)
+        return jnp.einsum("bld,bmd->blm", q, k) / jnp.sqrt(
+            jnp.asarray(D, x.dtype))
+
+    return apply_op(pure, queries_keys_values,
+                    name="interleaved_matmul_selfatt_qk")
+
+
+def interleaved_matmul_selfatt_valatt(queries_keys_values, attention, heads):
+    """att (B*H, L, L) x interleaved values -> (L, B, H*D)
+    (reference: _contrib_interleaved_matmul_selfatt_valatt)."""
+    def pure(x, att):
+        L, B, E = x.shape
+        D = E // (3 * heads)
+        v = x.reshape(L, B, heads, 3, D)[:, :, :, 2]
+        v = v.transpose(1, 2, 0, 3).reshape(B * heads, L, D)
+        out = jnp.einsum("blm,bmd->bld", att, v)
+        out = out.reshape(B, heads, L, D).transpose(2, 0, 1, 3)
+        return out.reshape(L, B, heads * D)
+
+    return apply_op(pure, queries_keys_values, attention,
+                    name="interleaved_matmul_selfatt_valatt")
+
+
+def interleaved_matmul_encdec_qk(queries, keys_values, heads):
+    """q (Lq, B, H*D), interleaved kv (Lk, B, H*2*D) -> (B*H, Lq, Lk)
+    (reference: _contrib_interleaved_matmul_encdec_qk)."""
+    def pure(q, kv):
+        Lq, B, E = q.shape
+        D = E // heads
+        Lk = kv.shape[0]
+        qh = q.reshape(Lq, B, heads, D).transpose(1, 2, 0, 3) \
+            .reshape(B * heads, Lq, D)
+        kh = kv.reshape(Lk, B, heads, 2, D)[:, :, :, 0] \
+            .transpose(1, 2, 0, 3).reshape(B * heads, Lk, D)
+        return jnp.einsum("bld,bmd->blm", qh, kh) / jnp.sqrt(
+            jnp.asarray(D, q.dtype))
+
+    return apply_op(pure, queries, keys_values,
+                    name="interleaved_matmul_encdec_qk")
+
+
+def interleaved_matmul_encdec_valatt(keys_values, attention, heads):
+    """att (B*H, Lq, Lk) x interleaved kv values -> (Lq, B, H*D)
+    (reference: _contrib_interleaved_matmul_encdec_valatt)."""
+    def pure(kv, att):
+        Lk, B, E = kv.shape
+        D = E // (2 * heads)
+        v = kv.reshape(Lk, B, heads, 2, D)[:, :, :, 1] \
+            .transpose(1, 2, 0, 3).reshape(B * heads, Lk, D)
+        out = jnp.einsum("blm,bmd->bld", att, v)
+        Lq = att.shape[1]
+        out = out.reshape(B, heads, Lq, D).transpose(2, 0, 1, 3)
+        return out.reshape(Lq, B, heads * D)
+
+    return apply_op(pure, keys_values, attention,
+                    name="interleaved_matmul_encdec_valatt")
+
+
+# --- multi-tensor helpers (reference: multi_sum_sq.cc, reset_arrays.cc,
+# multi_lars.cc) ------------------------------------------------------------
+
+def multi_sum_sq(*arrays, num_arrays=None):
+    """Per-array sum of squares -> (num_arrays,) float32
+    (reference: multi_sum_sq.cc)."""
+    arrs = list(arrays)
+    if num_arrays is not None:
+        arrs = arrs[:int(num_arrays)]
+    vals = [jnp.sum(jnp.square(
+        a._data if isinstance(a, NDArray) else jnp.asarray(a)).astype(
+            jnp.float32)) for a in arrs]
+    return NDArray(jnp.stack(vals))
+
+
+def reset_arrays(*arrays, num_arrays=None):
+    """Zero every array in place (reference: reset_arrays.cc)."""
+    arrs = list(arrays)
+    if num_arrays is not None:
+        arrs = arrs[:int(num_arrays)]
+    for a in arrs:
+        a[...] = 0  # in-place write bumps the engine version
+
+
+def multi_lars(lrs, weights_sum_sq, grads_sum_sq, wds, eta=0.001,
+               eps=1e-8, rescale_grad=1.0):
+    """LARS layer-wise lr: lr * eta*||w|| / (||g||*rescale + wd*||w|| + eps)
+    when both norms are positive (reference: multi_lars.cc)."""
+    lr = lrs._data if isinstance(lrs, NDArray) else jnp.asarray(lrs)
+    w2 = (weights_sum_sq._data if isinstance(weights_sum_sq, NDArray)
+          else jnp.asarray(weights_sum_sq))
+    g2 = (grads_sum_sq._data if isinstance(grads_sum_sq, NDArray)
+          else jnp.asarray(grads_sum_sq))
+    wd = wds._data if isinstance(wds, NDArray) else jnp.asarray(wds)
+    wn, gn = jnp.sqrt(w2), jnp.sqrt(g2) * rescale_grad
+    ratio = eta * wn / (gn + wd * wn + eps)
+    return NDArray(jnp.where((wn > 0) & (gn > 0), lr * ratio, lr))
+
+
+# --- dynamic shape (reference: dynamic_shape_ops.cc) -----------------------
+
+def dynamic_reshape(data, shape_like):
+    """Reshape `data` to the values held in `shape_like` — inherently
+    eager (data-dependent output shape), like the reference FComputeEx."""
+    shp = [int(v) for v in (shape_like.asnumpy()
+                            if isinstance(shape_like, NDArray)
+                            else _np.asarray(shape_like))]
+    return apply_op(lambda x: x.reshape(shp), data,
+                    name="dynamic_reshape")
+
+
+# --- PSROIPooling (reference: psroi_pooling.cc) ----------------------------
+
+def psroi_pooling(data, rois, spatial_scale, output_dim, pooled_size,
+                  group_size=0):
+    """Position-sensitive ROI pooling: output channel c, bin (i,j)
+    averages input channel c*G^2 + gi*G + gj over the bin.
+
+    Bin sums are O(1) lookups into a 2-D integral image (one cumsum per
+    ROI's channel slice), not masked full-map reductions — P^2*G^2 bins
+    cost O(C*H*W + P^2*output_dim) per ROI.
+    """
+    G = int(group_size) or int(pooled_size)
+    P = int(pooled_size)
+
+    def pure(x, r):
+        n, c, h, w = x.shape
+        # integral image with a leading zero row/col: S[:, y, x] = sum of
+        # img[:, :y, :x]; bin sum = S[y1,x1]-S[y0,x1]-S[y1,x0]+S[y0,x0]
+        ii = jnp.cumsum(jnp.cumsum(x, axis=2), axis=3)
+        ii = jnp.pad(ii, ((0, 0), (0, 0), (1, 0), (1, 0)))
+
+        def one_roi(roi):
+            bidx = roi[0].astype(jnp.int32)
+            x1, y1, x2, y2 = (jnp.round(roi[1:5] * spatial_scale))
+            rh = jnp.maximum(y2 - y1, 0.1) / P
+            rw = jnp.maximum(x2 - x1, 0.1) / P
+            S = ii[bidx]
+            outs = []
+            for i in range(P):
+                for j in range(P):
+                    hs = jnp.clip(jnp.floor(y1 + i * rh), 0, h)
+                    he = jnp.clip(jnp.ceil(y1 + (i + 1) * rh), 0, h)
+                    ws = jnp.clip(jnp.floor(x1 + j * rw), 0, w)
+                    we = jnp.clip(jnp.ceil(x1 + (j + 1) * rw), 0, w)
+                    hs, he = hs.astype(jnp.int32), he.astype(jnp.int32)
+                    ws, we = ws.astype(jnp.int32), we.astype(jnp.int32)
+                    cnt = jnp.maximum((he - hs) * (we - ws), 1) \
+                        .astype(x.dtype)
+                    gi = min(i * G // P, G - 1)
+                    gj = min(j * G // P, G - 1)
+                    chans = jnp.arange(output_dim) * G * G + gi * G + gj
+                    Sb = S[chans]
+                    vals = (Sb[:, he, we] - Sb[:, hs, we]
+                            - Sb[:, he, ws] + Sb[:, hs, ws]) / cnt
+                    outs.append(vals)
+            return jnp.stack(outs, axis=-1).reshape(output_dim, P, P)
+
+        return jax.vmap(one_roi)(r.astype(x.dtype))
+
+    return apply_op(pure, data, rois, name="psroi_pooling")
+
+
+# --- RPN proposals (reference: proposal.cc / multi_proposal.cc) ------------
+
+def _generate_anchors(base_size, scales, ratios):
+    base = _np.array([0, 0, base_size - 1, base_size - 1], _np.float32)
+    wa, ha = base[2] - base[0] + 1, base[3] - base[1] + 1
+    cx, cy = base[0] + 0.5 * (wa - 1), base[1] + 0.5 * (ha - 1)
+    anchors = []
+    size = wa * ha
+    for r in ratios:
+        ws = _np.round(_np.sqrt(size / r))
+        hs = _np.round(ws * r)
+        for s in scales:
+            w_, h_ = ws * s, hs * s
+            anchors.append([cx - 0.5 * (w_ - 1), cy - 0.5 * (h_ - 1),
+                            cx + 0.5 * (w_ - 1), cy + 0.5 * (h_ - 1)])
+    return _np.array(anchors, _np.float32)
+
+
+def proposal(cls_prob, bbox_pred, im_info, rpn_pre_nms_top_n=6000,
+             rpn_post_nms_top_n=300, threshold=0.7, rpn_min_size=16,
+             scales=(4, 8, 16, 32), ratios=(0.5, 1, 2),
+             feature_stride=16, output_score=False, iou_loss=False):  # noqa: ARG001
+    """RPN Proposal op (reference: proposal.cc). Eager: the NMS keep-set
+    is value-dependent. Returns (post_nms_top_n, 5) [batch_idx, x1..y2]
+    per image, padded by repeating the top box like the reference."""
+    probs = (cls_prob.asnumpy() if isinstance(cls_prob, NDArray)
+             else _np.asarray(cls_prob))
+    deltas = (bbox_pred.asnumpy() if isinstance(bbox_pred, NDArray)
+              else _np.asarray(bbox_pred))
+    info = (im_info.asnumpy() if isinstance(im_info, NDArray)
+            else _np.asarray(im_info))
+    N, _, H, W = probs.shape
+    A = len(scales) * len(ratios)
+    base = _generate_anchors(feature_stride, scales, ratios)  # (A, 4)
+    sx, sy = _np.meshgrid(_np.arange(W) * feature_stride,
+                          _np.arange(H) * feature_stride)
+    shifts = _np.stack([sx.ravel(), sy.ravel(), sx.ravel(), sy.ravel()], 1)
+    anchors = (base[None] + shifts[:, None]).reshape(-1, 4)  # (H*W*A, 4)
+    out = _np.zeros((N, rpn_post_nms_top_n, 5), _np.float32)
+    out_score = _np.zeros((N, rpn_post_nms_top_n, 1), _np.float32)
+    for b in range(N):
+        score = probs[b, A:].transpose(1, 2, 0).reshape(-1)  # fg scores
+        d = deltas[b].transpose(1, 2, 0).reshape(-1, 4)
+        # bbox transform
+        wa = anchors[:, 2] - anchors[:, 0] + 1
+        ha = anchors[:, 3] - anchors[:, 1] + 1
+        cxa = anchors[:, 0] + 0.5 * (wa - 1)
+        cya = anchors[:, 1] + 0.5 * (ha - 1)
+        cx = d[:, 0] * wa + cxa
+        cy = d[:, 1] * ha + cya
+        w_ = _np.exp(_np.clip(d[:, 2], None, 30)) * wa
+        h_ = _np.exp(_np.clip(d[:, 3], None, 30)) * ha
+        boxes = _np.stack([cx - 0.5 * (w_ - 1), cy - 0.5 * (h_ - 1),
+                           cx + 0.5 * (w_ - 1), cy + 0.5 * (h_ - 1)], 1)
+        imh, imw, imscale = info[b, 0], info[b, 1], info[b, 2]
+        boxes[:, 0::2] = _np.clip(boxes[:, 0::2], 0, imw - 1)
+        boxes[:, 1::2] = _np.clip(boxes[:, 1::2], 0, imh - 1)
+        minsz = rpn_min_size * imscale
+        keep = ((boxes[:, 2] - boxes[:, 0] + 1 >= minsz)
+                & (boxes[:, 3] - boxes[:, 1] + 1 >= minsz))
+        score = _np.where(keep, score, -1.0)
+        order = _np.argsort(-score)[:rpn_pre_nms_top_n]
+        boxes, score = boxes[order], score[order]
+        # greedy NMS
+        sel = []
+        supp = _np.zeros(len(boxes), bool)
+        areas = ((boxes[:, 2] - boxes[:, 0] + 1)
+                 * (boxes[:, 3] - boxes[:, 1] + 1))
+        for i in range(len(boxes)):
+            if supp[i] or score[i] < 0:
+                continue
+            sel.append(i)
+            if len(sel) >= rpn_post_nms_top_n:
+                break
+            xx1 = _np.maximum(boxes[i, 0], boxes[i + 1:, 0])
+            yy1 = _np.maximum(boxes[i, 1], boxes[i + 1:, 1])
+            xx2 = _np.minimum(boxes[i, 2], boxes[i + 1:, 2])
+            yy2 = _np.minimum(boxes[i, 3], boxes[i + 1:, 3])
+            iw = _np.maximum(xx2 - xx1 + 1, 0)
+            ih = _np.maximum(yy2 - yy1 + 1, 0)
+            inter = iw * ih
+            iou = inter / (areas[i] + areas[i + 1:] - inter)
+            supp[i + 1:] |= iou > threshold
+        sel = _np.array(sel, _np.int64) if sel else _np.array([0], _np.int64)
+        picked = boxes[sel]
+        scr = score[sel]
+        # pad by repeating boxes round-robin (reference behavior)
+        reps = -(-rpn_post_nms_top_n // len(sel))
+        picked = _np.tile(picked, (reps, 1))[:rpn_post_nms_top_n]
+        scr = _np.tile(scr, reps)[:rpn_post_nms_top_n]
+        out[b, :, 0] = b
+        out[b, :, 1:] = picked
+        out_score[b, :, 0] = scr
+    if output_score:
+        return NDArray(jnp.asarray(out)), NDArray(jnp.asarray(out_score))
+    return NDArray(jnp.asarray(out))
+
+
+MultiProposal = proposal  # multi-batch variant shares the implementation
+
+
+__all__ += [
+    "adaptive_avg_pooling", "bilinear_resize_2d", "fft", "ifft",
+    "round_ste", "sign_ste", "gradientmultiplier", "gradientreversal",
+    "div_sqrt_dim", "interleaved_matmul_selfatt_qk",
+    "interleaved_matmul_selfatt_valatt", "interleaved_matmul_encdec_qk",
+    "interleaved_matmul_encdec_valatt", "multi_sum_sq", "reset_arrays",
+    "multi_lars", "dynamic_reshape", "psroi_pooling", "proposal",
+    "MultiProposal",
+]
+
+# reference CamelCase spellings for the new ops
+AdaptiveAvgPooling2D = adaptive_avg_pooling
+BilinearResize2D = bilinear_resize_2d
+PSROIPooling = psroi_pooling
+Proposal = proposal
+__all__ += ["AdaptiveAvgPooling2D", "BilinearResize2D", "PSROIPooling",
+            "Proposal"]

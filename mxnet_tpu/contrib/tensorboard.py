@@ -1,0 +1,103 @@
+"""TensorBoard logging (reference: python/mxnet/contrib/tensorboard.py).
+
+The reference delegates to the external ``mxboard`` package; here the
+event-file writer is self-contained: scalar summaries are encoded with
+the repo's dependency-free protobuf wire encoder (onnx/_proto.py
+helpers) and framed as TFRecords (length + masked-CRC32C), so
+``tensorboard --logdir`` can read the output with no extra packages.
+"""
+from __future__ import annotations
+
+import os
+import struct
+import time
+
+from ..onnx._proto import f_bytes, f_float, f_int, f_str
+
+__all__ = ["SummaryWriter", "LogMetricsCallback"]
+
+# -- CRC32C (Castagnoli), the TFRecord checksum ---------------------------
+_CRC_TABLE = []
+
+
+def _crc_table():
+    if not _CRC_TABLE:
+        poly = 0x82F63B78
+        for i in range(256):
+            c = i
+            for _ in range(8):
+                c = (c >> 1) ^ poly if c & 1 else c >> 1
+            _CRC_TABLE.append(c)
+    return _CRC_TABLE
+
+
+def _crc32c(data):
+    table = _crc_table()
+    crc = 0xFFFFFFFF
+    for b in data:
+        crc = table[(crc ^ b) & 0xFF] ^ (crc >> 8)
+    return crc ^ 0xFFFFFFFF
+
+
+def _masked_crc(data):
+    crc = _crc32c(data)
+    return ((crc >> 15 | crc << 17) + 0xA282EAD8) & 0xFFFFFFFF
+
+
+def _f_double(field, v):
+    from ..onnx._proto import _tag
+    return _tag(field, 1) + struct.pack("<d", float(v))
+
+
+def _scalar_event(tag, value, step, wall_time):
+    # Summary.Value: 1=tag, 2=simple_value
+    val = f_str(1, tag) + f_float(2, value)
+    summary = f_bytes(1, val)          # Summary: repeated Value=1
+    # Event: 1=wall_time(double), 2=step(int64), 5=summary
+    return _f_double(1, wall_time) + f_int(2, step) + f_bytes(5, summary)
+
+
+class SummaryWriter:
+    """Minimal TensorBoard event-file writer (scalars)."""
+
+    def __init__(self, logdir):
+        os.makedirs(logdir, exist_ok=True)
+        fname = f"events.out.tfevents.{int(time.time())}.mxtpu"
+        self._f = open(os.path.join(logdir, fname), "ab")
+        # file-version header event
+        self._write(_f_double(1, time.time()) + f_str(3, "brain.Event:2"))
+
+    def _write(self, event_bytes):
+        header = struct.pack("<Q", len(event_bytes))
+        self._f.write(header)
+        self._f.write(struct.pack("<I", _masked_crc(header)))
+        self._f.write(event_bytes)
+        self._f.write(struct.pack("<I", _masked_crc(event_bytes)))
+
+    def add_scalar(self, tag, value, global_step=0):
+        self._write(_scalar_event(tag, value, global_step, time.time()))
+
+    def flush(self):
+        self._f.flush()
+
+    def close(self):
+        self._f.close()
+
+
+class LogMetricsCallback:
+    """Batch/eval-end callback that logs an EvalMetric's values
+    (reference: contrib/tensorboard.py:23)."""
+
+    def __init__(self, logging_dir, prefix=None):
+        self.prefix = prefix
+        self.summary_writer = SummaryWriter(logging_dir)
+
+    def __call__(self, param):
+        if getattr(param, "eval_metric", None) is None:
+            return
+        for name, value in param.eval_metric.get_name_value():
+            if self.prefix is not None:
+                name = f"{self.prefix}-{name}"
+            self.summary_writer.add_scalar(
+                name, value, global_step=getattr(param, "epoch", 0))
+        self.summary_writer.flush()

@@ -1,0 +1,8 @@
+"""Text utilities: vocabulary and token embeddings.
+
+Reference: python/mxnet/contrib/text/ (vocab.py, embedding.py, utils.py).
+"""
+from . import embedding, utils, vocab
+from .vocab import Vocabulary
+
+__all__ = ["embedding", "utils", "vocab", "Vocabulary"]

@@ -1,0 +1,268 @@
+"""Token embeddings (reference: python/mxnet/contrib/text/embedding.py).
+
+``CustomEmbedding`` / ``CompositeEmbedding`` are fully functional from
+local files. ``GloVe`` / ``FastText`` carry the reference's pretrained
+catalogs but — in this zero-egress environment — require the file to
+already exist under ``embedding_root`` (no download is attempted; a
+clear error tells the user where to place the file).
+"""
+from __future__ import annotations
+
+import io
+import logging
+import os
+
+import numpy as onp
+
+from ... import numpy as _mxnp
+from . import vocab as _vocab
+
+__all__ = ["register", "create", "get_pretrained_file_names",
+           "TokenEmbedding", "GloVe", "FastText", "CustomEmbedding",
+           "CompositeEmbedding"]
+
+_REGISTRY = {}
+
+
+def register(embedding_cls):
+    """Register a TokenEmbedding subclass under its lowercase name
+    (reference: embedding.py:40)."""
+    _REGISTRY[embedding_cls.__name__.lower()] = embedding_cls
+    return embedding_cls
+
+
+def create(embedding_name, **kwargs):
+    """Instantiate a registered embedding, e.g.
+    ``create('glove', pretrained_file_name=...)`` (reference:
+    embedding.py:63)."""
+    name = embedding_name.lower()
+    if name not in _REGISTRY:
+        raise KeyError(
+            f"unknown embedding {embedding_name!r}; "
+            f"registered: {sorted(_REGISTRY)}")
+    return _REGISTRY[name](**kwargs)
+
+
+def get_pretrained_file_names(embedding_name=None):
+    """Catalog of known pretrained files (reference: embedding.py:90)."""
+    if embedding_name is not None:
+        return list(_REGISTRY[embedding_name.lower()]
+                    .pretrained_file_name_sha1)
+    return {n: list(c.pretrained_file_name_sha1)
+            for n, c in _REGISTRY.items()}
+
+
+class TokenEmbedding(_vocab.Vocabulary):
+    """Base: a vocabulary whose every index also has a vector
+    (reference: embedding.py:133 _TokenEmbedding)."""
+
+    pretrained_file_name_sha1 = {}
+
+    def __init__(self, **kwargs):
+        super().__init__(**kwargs)
+        self._vec_len = 0
+        self._idx_to_vec = None
+
+    # -- file loading ------------------------------------------------------
+    @classmethod
+    def _get_pretrained_file(cls, embedding_root, pretrained_file_name):
+        embedding_root = os.path.expanduser(embedding_root)
+        path = os.path.join(embedding_root, cls.__name__.lower(),
+                            pretrained_file_name)
+        if not os.path.isfile(path):
+            raise FileNotFoundError(
+                f"pretrained embedding file {path!r} not found. This "
+                "environment has no network access — place the file there "
+                "manually, or use CustomEmbedding with a local path.")
+        return path
+
+    def _load_embedding(self, pretrained_file_path, elem_delim,
+                        init_unknown_vec, encoding="utf8"):
+        """Parse a '<token><delim><v0><delim><v1>...' text file
+        (reference: embedding.py:232). Tolerates a fastText-style
+        header line and skips malformed lines with a warning."""
+        pretrained_file_path = os.path.expanduser(pretrained_file_path)
+        vecs = []
+        with io.open(pretrained_file_path, "r", encoding=encoding) as f:
+            lines = f.readlines()
+        for lineno, line in enumerate(lines):
+            row = line.rstrip().split(elem_delim)
+            if lineno == 0 and len(row) == 2 and all(
+                    f.isdigit() for f in row):
+                continue  # fastText "n dim" header: two bare integers
+            if len(row) < 2:
+                logging.warning("skipping malformed line %d in %s",
+                                lineno + 1, pretrained_file_path)
+                continue
+            token, elems = row[0], row[1:]
+            try:
+                vec = onp.asarray(elems, dtype=onp.float32)
+            except ValueError:
+                logging.warning("skipping unparseable line %d in %s",
+                                lineno + 1, pretrained_file_path)
+                continue
+            if self._vec_len == 0:
+                self._vec_len = len(vec)
+            elif len(vec) != self._vec_len:
+                logging.warning("skipping line %d: dim %d != %d",
+                                lineno + 1, len(vec), self._vec_len)
+                continue
+            if token in self._token_to_idx:
+                continue  # first occurrence wins, like the reference
+            self._token_to_idx[token] = len(self._idx_to_token)
+            self._idx_to_token.append(token)
+            vecs.append(vec)
+        if self._vec_len == 0:
+            raise ValueError(
+                f"no vectors parsed from {pretrained_file_path}")
+        mat = onp.zeros((len(self), self._vec_len), dtype=onp.float32)
+        n_special = len(self) - len(vecs)
+        if n_special:
+            mat[:n_special] = init_unknown_vec((n_special, self._vec_len)) \
+                if init_unknown_vec is not onp.zeros \
+                else 0.0
+        mat[n_special:] = onp.stack(vecs) if vecs else mat[n_special:]
+        self._idx_to_vec = _mxnp.array(mat)
+
+    # -- queries -----------------------------------------------------------
+    @property
+    def vec_len(self):
+        return self._vec_len
+
+    @property
+    def idx_to_vec(self):
+        return self._idx_to_vec
+
+    def get_vecs_by_tokens(self, tokens, lower_case_backup=False):
+        """Vectors for token(s); unknown tokens get the unknown vector
+        (reference: embedding.py:370)."""
+        single = isinstance(tokens, str)
+        toks = [tokens] if single else tokens
+        if lower_case_backup:
+            toks = [t if t in self._token_to_idx else t.lower()
+                    for t in toks]
+        idx = self.to_indices(toks)
+        vecs = self._idx_to_vec[_mxnp.array(idx, dtype="int32")]
+        return vecs[0] if single else vecs
+
+    def update_token_vectors(self, tokens, new_vectors):
+        """Overwrite vectors of existing tokens (reference:
+        embedding.py:415)."""
+        if self._idx_to_vec is None:
+            raise ValueError("no embedding matrix to update")
+        single = isinstance(tokens, str)
+        toks = [tokens] if single else list(tokens)
+        for t in toks:
+            if t not in self._token_to_idx:
+                raise ValueError(f"token {t!r} is unknown; only vectors of "
+                                 "indexed tokens can be updated")
+        new_vectors = _mxnp.array(new_vectors)
+        if single or new_vectors.ndim == 1:
+            new_vectors = new_vectors.reshape(1, -1)
+        mat = onp.array(self._idx_to_vec.asnumpy())
+        mat[[self._token_to_idx[t] for t in toks]] = new_vectors.asnumpy()
+        self._idx_to_vec = _mxnp.array(mat)
+
+    # -- vocabulary intersection ------------------------------------------
+    def _build_embedding_for_vocabulary(self, vocabulary):
+        """Restrict this embedding to `vocabulary`'s index space
+        (reference: embedding.py:349)."""
+        if vocabulary is None:
+            return
+        src_tok2idx = dict(self._token_to_idx)
+        src_vecs = self._idx_to_vec
+        self._token_to_idx = dict(vocabulary.token_to_idx)
+        self._idx_to_token = list(vocabulary.idx_to_token)
+        self._unknown_token = vocabulary.unknown_token
+        self._reserved_tokens = vocabulary.reserved_tokens
+        mat = onp.zeros((len(self), self._vec_len), dtype=onp.float32)
+        if src_vecs is not None:
+            src = src_vecs.asnumpy()
+            for tok, i in self._token_to_idx.items():
+                j = src_tok2idx.get(tok)
+                if j is not None:
+                    mat[i] = src[j]
+                elif self._unknown_token is not None:
+                    mat[i] = src[src_tok2idx[self._unknown_token]] \
+                        if self._unknown_token in src_tok2idx else 0.0
+        self._idx_to_vec = _mxnp.array(mat)
+
+
+@register
+class GloVe(TokenEmbedding):
+    """GloVe embeddings (reference: embedding.py:481). Requires the file
+    on disk under ``embedding_root/glove/`` — no download."""
+
+    pretrained_file_name_sha1 = {
+        f"glove.{tag}.txt": None for tag in (
+            "42B.300d", "6B.50d", "6B.100d", "6B.200d", "6B.300d",
+            "840B.300d", "twitter.27B.25d", "twitter.27B.50d",
+            "twitter.27B.100d", "twitter.27B.200d")}
+
+    def __init__(self, pretrained_file_name="glove.840B.300d.txt",
+                 embedding_root=os.path.join("~", ".mxnet", "embeddings"),
+                 init_unknown_vec=onp.zeros, vocabulary=None, **kwargs):
+        if pretrained_file_name not in self.pretrained_file_name_sha1:
+            raise KeyError(f"unknown GloVe file {pretrained_file_name!r}")
+        super().__init__(**kwargs)
+        path = self._get_pretrained_file(embedding_root,
+                                         pretrained_file_name)
+        self._load_embedding(path, " ", init_unknown_vec)
+        self._build_embedding_for_vocabulary(vocabulary)
+
+
+@register
+class FastText(TokenEmbedding):
+    """fastText embeddings (reference: embedding.py:553). Requires the
+    ``.vec`` file on disk under ``embedding_root/fasttext/``."""
+
+    pretrained_file_name_sha1 = {
+        f"wiki.{tag}.vec": None for tag in (
+            "en", "simple", "zh", "de", "fr", "es", "ru", "ja", "ar")}
+
+    def __init__(self, pretrained_file_name="wiki.simple.vec",
+                 embedding_root=os.path.join("~", ".mxnet", "embeddings"),
+                 init_unknown_vec=onp.zeros, vocabulary=None, **kwargs):
+        if pretrained_file_name not in self.pretrained_file_name_sha1:
+            raise KeyError(f"unknown fastText file "
+                           f"{pretrained_file_name!r}")
+        super().__init__(**kwargs)
+        path = self._get_pretrained_file(embedding_root,
+                                         pretrained_file_name)
+        self._load_embedding(path, " ", init_unknown_vec)
+        self._build_embedding_for_vocabulary(vocabulary)
+
+
+@register
+class CustomEmbedding(TokenEmbedding):
+    """Embedding from a user file '<token><delim><v0><delim>...'
+    (reference: embedding.py:635)."""
+
+    def __init__(self, pretrained_file_path, elem_delim=" ",
+                 encoding="utf8", init_unknown_vec=onp.zeros,
+                 vocabulary=None, **kwargs):
+        super().__init__(**kwargs)
+        self._load_embedding(pretrained_file_path, elem_delim,
+                             init_unknown_vec, encoding)
+        self._build_embedding_for_vocabulary(vocabulary)
+
+
+@register
+class CompositeEmbedding(TokenEmbedding):
+    """Concatenate several embeddings over one vocabulary
+    (reference: embedding.py:677)."""
+
+    def __init__(self, vocabulary, token_embeddings):
+        if not isinstance(token_embeddings, (list, tuple)):
+            token_embeddings = [token_embeddings]
+        super().__init__(unknown_token=vocabulary.unknown_token,
+                         reserved_tokens=vocabulary.reserved_tokens)
+        self._token_to_idx = dict(vocabulary.token_to_idx)
+        self._idx_to_token = list(vocabulary.idx_to_token)
+        parts = []
+        for emb in token_embeddings:
+            emb._build_embedding_for_vocabulary(vocabulary)
+            parts.append(emb.idx_to_vec.asnumpy())
+        mat = onp.concatenate(parts, axis=1)
+        self._vec_len = mat.shape[1]
+        self._idx_to_vec = _mxnp.array(mat)

@@ -1,0 +1,21 @@
+"""Token counting utilities (reference: python/mxnet/contrib/text/utils.py:26)."""
+from __future__ import annotations
+
+import re
+from collections import Counter
+
+__all__ = ["count_tokens_from_str"]
+
+
+def count_tokens_from_str(source_str, token_delim=" ", seq_delim="\n",
+                          to_lower=False, counter_to_update=None):
+    """Count tokens in `source_str`, splitting on `token_delim` and
+    `seq_delim`. Returns a `collections.Counter` (updates and returns
+    `counter_to_update` when given)."""
+    source_str = re.split(
+        re.escape(token_delim) + "|" + re.escape(seq_delim), source_str)
+    if to_lower:
+        source_str = [t.lower() for t in source_str]
+    counter = counter_to_update if counter_to_update is not None else Counter()
+    counter.update(t for t in source_str if t)
+    return counter

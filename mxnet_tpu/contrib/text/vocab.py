@@ -1,0 +1,94 @@
+"""Indexed vocabulary (reference: python/mxnet/contrib/text/vocab.py:28).
+
+Maps tokens <-> contiguous integer ids. Index 0 is the unknown token
+(when one is set); reserved tokens follow, then counter keys sorted by
+descending frequency (ties broken alphabetically), filtered by
+``most_freq_count`` / ``min_freq``.
+"""
+from __future__ import annotations
+
+__all__ = ["Vocabulary"]
+
+
+class Vocabulary:
+    def __init__(self, counter=None, most_freq_count=None, min_freq=1,
+                 unknown_token="<unk>", reserved_tokens=None):
+        if min_freq < 1:
+            raise ValueError("`min_freq` must be >= 1")
+        if reserved_tokens is not None:
+            rset = set(reserved_tokens)
+            if unknown_token in rset:
+                raise ValueError(
+                    "`reserved_tokens` must not contain the unknown token")
+            if len(rset) != len(reserved_tokens):
+                raise ValueError("`reserved_tokens` must be unique")
+        self._unknown_token = unknown_token
+        self._reserved_tokens = (list(reserved_tokens)
+                                 if reserved_tokens is not None else None)
+        self._idx_to_token = []
+        if unknown_token is not None:
+            self._idx_to_token.append(unknown_token)
+        if reserved_tokens is not None:
+            self._idx_to_token.extend(reserved_tokens)
+        self._token_to_idx = {t: i for i, t in enumerate(self._idx_to_token)}
+        if counter is not None:
+            self._index_counter_keys(counter, most_freq_count, min_freq)
+
+    def _index_counter_keys(self, counter, most_freq_count, min_freq):
+        # sort by frequency desc, then token asc — the reference's
+        # deterministic ordering (vocab.py:107)
+        pairs = sorted(counter.items(), key=lambda kv: (-kv[1], kv[0]))
+        taken = 0
+        for token, freq in pairs:
+            if freq < min_freq:
+                break
+            if most_freq_count is not None and taken >= most_freq_count:
+                break
+            if token not in self._token_to_idx:
+                self._token_to_idx[token] = len(self._idx_to_token)
+                self._idx_to_token.append(token)
+                taken += 1
+
+    def __len__(self):
+        return len(self._idx_to_token)
+
+    @property
+    def token_to_idx(self):
+        return self._token_to_idx
+
+    @property
+    def idx_to_token(self):
+        return self._idx_to_token
+
+    @property
+    def unknown_token(self):
+        return self._unknown_token
+
+    @property
+    def reserved_tokens(self):
+        return self._reserved_tokens
+
+    def to_indices(self, tokens):
+        """Token(s) -> index/indices; unknown tokens map to index 0 when
+        an unknown token is set, else raise KeyError."""
+        single = isinstance(tokens, str)
+        toks = [tokens] if single else tokens
+        out = []
+        for t in toks:
+            if t in self._token_to_idx:
+                out.append(self._token_to_idx[t])
+            elif self._unknown_token is not None:
+                out.append(self._token_to_idx[self._unknown_token])
+            else:
+                raise KeyError(f"token {t!r} not in vocabulary")
+        return out[0] if single else out
+
+    def to_tokens(self, indices):
+        single = isinstance(indices, int)
+        idxs = [indices] if single else indices
+        out = []
+        for i in idxs:
+            if not 0 <= i < len(self._idx_to_token):
+                raise ValueError(f"index {i} out of range")
+            out.append(self._idx_to_token[i])
+        return out[0] if single else out

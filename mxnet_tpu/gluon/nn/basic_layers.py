@@ -17,7 +17,7 @@ from ..block import Block, HybridBlock, current_state_sink
 from ..parameter import Parameter
 
 __all__ = ["Sequential", "HybridSequential", "Dense", "Dropout", "BatchNorm",
-           "SyncBatchNorm", "LayerNorm", "GroupNorm", "InstanceNorm",
+           "SyncBatchNorm", "BatchNormReLU", "LayerNorm", "GroupNorm", "InstanceNorm",
            "Embedding", "Flatten", "Lambda", "HybridLambda", "Concatenate",
            "HybridConcatenate", "Identity", "Activation", "HybridBlock"]
 
@@ -220,6 +220,16 @@ class BatchNorm(HybridBlock):
     def __repr__(self):
         return (f"BatchNorm(axis={self._axis}, momentum={self._momentum}, "
                 f"in_channels={self.gamma.shape[0]})")
+
+
+class BatchNormReLU(BatchNorm):
+    """Fused BatchNorm + ReLU (reference: nn.BatchNormReLU,
+    basic_layers.py:478; op contrib/batch_norm_relu.cc). On TPU the fusion
+    is XLA's job — the layer exists for API parity."""
+
+    def forward(self, x):
+        out = super().forward(x)
+        return apply_op(lambda v: jnp.maximum(v, 0), out, name="relu")
 
 
 class SyncBatchNorm(BatchNorm):

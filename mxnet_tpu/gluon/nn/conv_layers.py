@@ -305,3 +305,165 @@ class ReflectionPad2D(HybridBlock):
         return apply_op(
             lambda v: jnp.pad(
                 v, ((0, 0), (0, 0), (ph, ph), (pw, pw)), mode="reflect"), x)
+
+
+class _PixelShuffle(HybridBlock):
+    def __init__(self, factor, ndim):
+        super().__init__()
+        self._factor = _tup(factor, ndim)
+        self._ndim = ndim
+
+    def __repr__(self):
+        return f"{type(self).__name__}({self._factor})"
+
+
+class PixelShuffle1D(_PixelShuffle):
+    """(N, C*f, W) -> (N, C, W*f) sub-pixel upsample (reference:
+    nn.PixelShuffle1D, conv_layers.py:1707)."""
+
+    def __init__(self, factor):
+        super().__init__(factor, 1)
+
+    def forward(self, x):
+        (f,) = self._factor
+
+        def pure(v):
+            n, cf, w = v.shape
+            c = cf // f
+            return v.reshape(n, c, f, w).transpose(0, 1, 3, 2) \
+                .reshape(n, c, w * f)
+
+        return apply_op(pure, x)
+
+
+class PixelShuffle2D(_PixelShuffle):
+    """(N, C*fh*fw, H, W) -> (N, C, H*fh, W*fw) (reference:
+    nn.PixelShuffle2D, conv_layers.py:1755)."""
+
+    def __init__(self, factor):
+        super().__init__(factor, 2)
+
+    def forward(self, x):
+        fh, fw = self._factor
+
+        def pure(v):
+            n, cff, h, w = v.shape
+            c = cff // (fh * fw)
+            return v.reshape(n, c, fh, fw, h, w) \
+                .transpose(0, 1, 4, 2, 5, 3) \
+                .reshape(n, c, h * fh, w * fw)
+
+        return apply_op(pure, x)
+
+
+class PixelShuffle3D(_PixelShuffle):
+    """(N, C*f1*f2*f3, D, H, W) -> (N, C, D*f1, H*f2, W*f3) (reference:
+    nn.PixelShuffle3D, conv_layers.py:1818)."""
+
+    def __init__(self, factor):
+        super().__init__(factor, 3)
+
+    def forward(self, x):
+        f1, f2, f3 = self._factor
+
+        def pure(v):
+            n, cf, d, h, w = v.shape
+            c = cf // (f1 * f2 * f3)
+            return v.reshape(n, c, f1, f2, f3, d, h, w) \
+                .transpose(0, 1, 5, 2, 6, 3, 7, 4) \
+                .reshape(n, c, d * f1, h * f2, w * f3)
+
+        return apply_op(pure, x)
+
+
+class DeformableConvolution(HybridBlock):
+    """DCNv1 layer: a regular conv branch producing offsets + the
+    deformable conv itself (reference: nn.DeformableConvolution,
+    conv_layers.py:1277; op contrib/deformable_convolution.cc)."""
+
+    _use_mask = False
+
+    def __init__(self, channels, kernel_size=(1, 1), strides=(1, 1),
+                 padding=(0, 0), dilation=(1, 1), groups=1,
+                 num_deformable_group=1, layout="NCHW", use_bias=True,
+                 in_channels=0, activation=None, weight_initializer=None,
+                 bias_initializer="zeros",
+                 offset_weight_initializer="zeros",
+                 offset_bias_initializer="zeros", offset_use_bias=True):
+        super().__init__()
+        assert layout == "NCHW", "deformable conv is NCHW-only"
+        self._channels = channels
+        self._kernel = _tup(kernel_size, 2)
+        self._strides = _tup(strides, 2)
+        self._padding = _tup(padding, 2)
+        self._dilation = _tup(dilation, 2)
+        self._groups = groups
+        self._ndg = num_deformable_group
+        self._activation = activation
+        kh, kw = self._kernel
+        mult = 3 if self._use_mask else 2
+        self.offset_conv = Conv2D(
+            mult * num_deformable_group * kh * kw, self._kernel,
+            self._strides, self._padding, self._dilation,
+            use_bias=offset_use_bias, in_channels=in_channels,
+            weight_initializer=offset_weight_initializer,
+            bias_initializer=offset_bias_initializer)
+        self.weight = Parameter(
+            "weight",
+            shape=(channels, in_channels // groups if in_channels else 0,
+                   kh, kw),
+            init=weight_initializer, allow_deferred_init=True)
+        self.bias = (Parameter("bias", shape=(channels,),
+                               init=bias_initializer)
+                     if use_bias else None)
+
+    def forward(self, x):
+        from ...ops import vision as _vision
+
+        c_in = x.shape[1]
+        if self.weight._is_deferred:
+            kh, kw = self._kernel
+            self.weight._finish_deferred_init(
+                (self._channels, c_in // self._groups, kh, kw))
+        offs = self.offset_conv(x)
+        kh, kw = self._kernel
+        if self._use_mask:
+            n_off = 2 * self._ndg * kh * kw
+            offset, m = offs[:, :n_off], offs[:, n_off:]
+            import jax
+
+            m = apply_op(jax.nn.sigmoid, m)
+        else:
+            offset, m = offs, None
+        w = self.weight.data_for(x)
+        b = self.bias.data_for(x) if self.bias is not None else None
+
+        def pure(xv, ov, wv, *rest):
+            i = 0
+            bv = mv = None
+            if b is not None:
+                bv = rest[i]; i += 1
+            if m is not None:
+                mv = rest[i]; i += 1
+            return _vision.deformable_convolution(
+                xv, ov, wv, bias=bv, kernel=self._kernel,
+                stride=self._strides, pad=self._padding,
+                dilate=self._dilation, num_deformable_group=self._ndg,
+                groups=self._groups, mask=mv)
+
+        extra = [a for a in (b, m) if a is not None]
+        out = apply_op(pure, x, offset, w, *extra)
+        if self._activation:
+            out = npx.activation(out, self._activation)
+        return out
+
+
+class ModulatedDeformableConvolution(DeformableConvolution):
+    """DCNv2: offsets + sigmoid-modulated sample masks (reference:
+    nn.ModulatedDeformableConvolution, conv_layers.py:1501)."""
+
+    _use_mask = True
+
+
+__all__ += ["PixelShuffle1D", "PixelShuffle2D", "PixelShuffle3D",
+            "DeformableConvolution", "ModulatedDeformableConvolution"]

@@ -199,8 +199,10 @@ def correlation(data1, data2, kernel_size=1, max_displacement=1, stride1=1,
 @register_op("DeformableConvolution")
 def deformable_convolution(data, offset, weight, bias=None, kernel=(3, 3),
                            stride=(1, 1), pad=(0, 0), dilate=(1, 1),
-                           num_deformable_group=1, groups=1):
-    """Reference: contrib/deformable_convolution.cc (DCNv1).
+                           num_deformable_group=1, groups=1, mask=None):
+    """Reference: contrib/deformable_convolution.cc (DCNv1), and with
+    `mask` the modulated DCNv2 variant (contrib ModulatedDeformableConvolution):
+    mask (N, k*k*G, Ho, Wo) multiplies each tap's bilinear sample.
 
     offset (N, 2*k*k*G, Ho, Wo) gives per-output-position (dy, dx) for each
     kernel tap. Implemented as k*k bilinear gathers (static unroll) + one
@@ -226,14 +228,18 @@ def deformable_convolution(data, offset, weight, bias=None, kernel=(3, 3),
             tap = ki * kw + kj
             dy = offset[:, 2 * tap::2 * kh * kw]        # (N, G, Ho, Wo)
             dx = offset[:, 2 * tap + 1::2 * kh * kw]
+            m = mask[:, tap::kh * kw] if mask is not None else None
             samples = []
             for gi in range(g):
                 y_real = base_y[None] + ki * dh + dy[:, gi]
                 x_real = base_x[None] + kj * dw + dx[:, gi]
                 sub = data[:, gi * cg:(gi + 1) * cg]
-                samples.append(_bilinear_gather(
+                samp = _bilinear_gather(
                     sub, x_real.astype(jnp.float32),
-                    y_real.astype(jnp.float32)))
+                    y_real.astype(jnp.float32))
+                if m is not None:
+                    samp = samp * m[:, gi:gi + 1]
+                samples.append(samp)
             cols.append(jnp.concatenate(samples, axis=1))
     col = jnp.stack(cols, axis=2)  # (N, C, k*k, Ho, Wo)
     wmat = weight.reshape(weight.shape[0], weight.shape[1], kh * kw)
