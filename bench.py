@@ -270,6 +270,7 @@ def bench_int8_agreement(platform):
 def main():
     import jax
 
+    t_start = time.perf_counter()  # budget covers the WHOLE run
     platform = _probe_accelerator()
     if platform is None or platform == "cpu":
         print("accelerator unreachable; falling back to CPU",
@@ -304,9 +305,16 @@ def main():
         "vs_baseline": round(infer_img_s / BASELINE_INFER_IMG_S, 4),
     }]
     # secondary rows are full-size models — skip them on the CPU fallback
-    # so the driver always gets its JSON line quickly
+    # so the driver always gets its JSON line quickly, and stop adding
+    # rows once the wall-clock budget is spent (a slow tunnel must never
+    # starve the driver of the headline JSON line)
+    budget_s = float(os.environ.get("MXTPU_BENCH_BUDGET_S", "1200"))
+
+    def over_budget():
+        return time.perf_counter() - t_start > budget_s
+
     if (os.environ.get("MXTPU_BENCH_HEADLINE_ONLY") != "1"
-            and platform != "cpu"):
+            and platform != "cpu" and not over_budget()):
         try:
             lenet_img_s = bench_lenet_imperative(
                 platform, iters if platform != "cpu" else 1, warmup)
@@ -316,6 +324,8 @@ def main():
         except Exception as e:  # keep the headline alive
             rows.append({"metric": "lenet_mnist_imperative", "error": str(e)})
         try:
+            if over_budget():
+                raise TimeoutError("bench budget exhausted")
             bert_sps = bench_bert_finetune(
                 platform, iters if platform != "cpu" else 1, warmup)
             rows.append({
@@ -325,6 +335,8 @@ def main():
         except Exception as e:
             rows.append({"metric": "bert_base_finetune", "error": str(e)})
         try:
+            if over_budget():
+                raise TimeoutError("bench budget exhausted")
             agreement = bench_int8_agreement(platform)
             rows.append({
                 "metric": "int8_resnet18_top1_agreement_vs_fp32",

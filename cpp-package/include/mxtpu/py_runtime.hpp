@@ -58,6 +58,8 @@ class PyRuntime {
     }
     invoke_ = PyObject_GetAttrString(mod, "packed_invoke");
     list_ops_ = PyObject_GetAttrString(mod, "list_ops");
+    model_ = PyObject_GetAttrString(mod, "model_packed");
+    if (!model_) PyErr_Clear();  // optional entry point (older builds)
     Py_DECREF(mod);
     if (!invoke_ || !list_ops_)
       throw std::runtime_error("mxnet_tpu.capi missing entry points");
@@ -68,6 +70,7 @@ class PyRuntime {
       GilGuard gil(!owned_);
       Py_XDECREF(invoke_);
       Py_XDECREF(list_ops_);
+      Py_XDECREF(model_);
     }
     if (owned_) Py_Finalize();
   }
@@ -121,6 +124,50 @@ class PyRuntime {
     return Unpack(all, mj);
   }
 
+  // Packed model call (create/fit/predict/save/load/free) — the
+  // cpp-package training surface (reference analog: the generated C++
+  // frontend's FeedForward/fit loops). Returns (tensors, raw meta JSON).
+  std::pair<std::vector<PackedTensor>, std::string> CallModel(
+      const std::string& handle, const std::string& command,
+      const std::vector<PackedTensor>& args,
+      const std::string& attrs_json = "{}") {
+    if (!model_)
+      throw std::runtime_error("mxnet_tpu.capi.model_packed missing");
+    std::string blob;
+    std::string meta = "{\"args\": [";
+    for (size_t i = 0; i < args.size(); ++i) {
+      if (i) meta += ", ";
+      meta += "{\"shape\": [";
+      for (size_t d = 0; d < args[i].shape.size(); ++d) {
+        if (d) meta += ", ";
+        meta += std::to_string(args[i].shape[d]);
+      }
+      meta += "], \"dtype\": \"" + args[i].dtype + "\"}";
+      blob += args[i].data;
+    }
+    meta += "], \"attrs\": " + attrs_json + "}";
+    GilGuard gil(!owned_);
+    PyObject* pyblob =
+        PyBytes_FromStringAndSize(blob.data(), (Py_ssize_t)blob.size());
+    PyObject* r = PyObject_CallFunction(model_, "ssOs", handle.c_str(),
+                                        command.c_str(), pyblob,
+                                        meta.c_str());
+    Py_DECREF(pyblob);
+    if (!r) {
+      PyErr_Print();
+      throw std::runtime_error("model_packed(" + command + ") failed");
+    }
+    PyObject* out_blob = PyTuple_GetItem(r, 0);
+    PyObject* out_meta = PyTuple_GetItem(r, 1);
+    const char* bytes;
+    Py_ssize_t n;
+    PyBytes_AsStringAndSize(out_blob, const_cast<char**>(&bytes), &n);
+    std::string all(bytes, (size_t)n);
+    std::string mj(PyUnicode_AsUTF8(out_meta));
+    Py_DECREF(r);
+    return {Unpack(all, mj), mj};
+  }
+
  private:
   static size_t DtypeSize(const std::string& dt) {
     if (dt == "complex128") return 16;
@@ -169,7 +216,56 @@ class PyRuntime {
 
   PyObject* invoke_ = nullptr;
   PyObject* list_ops_ = nullptr;
+  PyObject* model_ = nullptr;
   bool owned_ = false;
+};
+
+// High-level C++ model: build/train/predict a gluon net from C++
+// (reference analog: cpp-package FeedForward / Executor-based training).
+class Model {
+ public:
+  // spec_json: {"mlp": [64, 32], "classes": 10} or
+  //            {"zoo": "resnet18_v1", "classes": 1000}
+  Model(PyRuntime& rt, const std::string& spec_json) : rt_(rt) {
+    auto r = rt_.CallModel("", "create", {},
+                           "{\"spec\": " + spec_json + "}");
+    const std::string& meta = r.second;
+    size_t h = meta.find("\"handle\":");
+    size_t q1 = meta.find('"', h + 9), q2 = meta.find('"', q1 + 1);
+    handle_ = meta.substr(q1 + 1, q2 - q1 - 1);
+  }
+  ~Model() {
+    try { rt_.CallModel(handle_, "free", {}); } catch (...) {}
+  }
+
+  // One full-batch fit call; returns the raw JSON with per-epoch losses.
+  std::string Fit(const PackedTensor& x, const PackedTensor& y,
+                  double lr, int epochs) {
+    auto r = rt_.CallModel(
+        handle_, "fit", {x, y},
+        "{\"lr\": " + std::to_string(lr) +
+            ", \"epochs\": " + std::to_string(epochs) + "}");
+    return r.second;
+  }
+
+  std::vector<PackedTensor> Predict(const PackedTensor& x) {
+    return rt_.CallModel(handle_, "predict", {x}).first;
+  }
+
+  void Save(const std::string& path) {
+    rt_.CallModel(handle_, "save", {},
+                  "{\"path\": \"" + path + "\"}");
+  }
+  void Load(const std::string& path, const PackedTensor& example) {
+    rt_.CallModel(handle_, "load", {example},
+                  "{\"path\": \"" + path + "\"}");
+  }
+
+  const std::string& handle() const { return handle_; }
+
+ private:
+  PyRuntime& rt_;
+  std::string handle_;
 };
 
 }  // namespace mxtpu

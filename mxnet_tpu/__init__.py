@@ -71,6 +71,8 @@ from .visualization import plot_network, print_summary  # noqa: E402
 from . import operator  # noqa: E402
 from .operator import Custom  # noqa: E402
 from . import recordio  # noqa: E402
+from . import resource  # noqa: E402
+from . import rtc  # noqa: E402
 from . import gluon  # noqa: E402
 from . import symbol  # noqa: E402
 from . import symbol as sym  # noqa: E402

@@ -18,7 +18,7 @@ import json
 
 import numpy as _np
 
-__all__ = ["packed_invoke", "list_ops"]
+__all__ = ["packed_invoke", "list_ops", "model_packed"]
 
 
 def list_ops():
@@ -60,3 +60,114 @@ def packed_invoke(op_name, blob, meta_json):
                             for o in outs]}
     out_blob = b"".join(_np.ascontiguousarray(o).tobytes() for o in outs)
     return out_blob, json.dumps(out_meta)
+
+
+# --- C++ training/inference surface ---------------------------------------
+# (reference analog: cpp-package's generated C++ frontend — FeedForward/
+# Executor training loops in C++. Here the C++ side drives full gluon
+# training through one packed entry point.)
+
+_MODELS = {}
+_NEXT_HANDLE = [1]
+
+
+def model_packed(handle, command, blob, meta_json):
+    """Packed model API for embedded C++ callers (cpp-package).
+
+    Commands (meta/attrs in meta_json, tensors in blob like packed_invoke):
+      create  — attrs {"spec": {...}}; returns {"handle": h}.
+                spec: {"mlp": [hidden...,] , "classes": N} or
+                      {"zoo": "<model_zoo name>", "classes": N}
+      fit     — args x, y; attrs {lr, epochs, optimizer}; returns
+                {"losses": [...]} (one mean loss per epoch).
+      predict — args x; returns output tensor blob.
+      save    — attrs {"path": p}: save_parameters.
+      load    — attrs {"path": p}: load_parameters.
+      free    — drop the handle.
+    """
+    import numpy as _onp
+
+    from . import numpy as mxnp
+    from .gluon import Trainer, loss as gloss, nn
+
+    meta = json.loads(meta_json)
+    attrs = meta.get("attrs", {})
+    arrays = []
+    off = 0
+    for spec in meta.get("args", []):
+        shape = tuple(spec["shape"])
+        dtype = _np.dtype(spec["dtype"])
+        n = int(_np.prod(shape, dtype=_np.int64)) * dtype.itemsize
+        arrays.append(_np.frombuffer(
+            blob[off:off + n], dtype=dtype).reshape(shape))
+        off += n
+
+    def pack(outs):
+        outs = [_onp.asarray(o) for o in outs]
+        out_meta = {"outputs": [{"shape": list(o.shape),
+                                 "dtype": str(o.dtype)} for o in outs]}
+        out_blob = b"".join(
+            _onp.ascontiguousarray(o).tobytes() for o in outs)
+        return out_blob, json.dumps(out_meta)
+
+    if command == "create":
+        spec = attrs["spec"]
+        if "zoo" in spec:
+            from .gluon.model_zoo import vision as zoo
+
+            net = zoo.get_model(spec["zoo"],
+                                classes=spec.get("classes", 1000))
+        else:
+            net = nn.HybridSequential()
+            for width in spec.get("mlp", []):
+                net.add(nn.Dense(int(width), activation="relu"))
+            net.add(nn.Dense(int(spec.get("classes", 10))))
+        net.initialize()
+        if spec.get("hybridize", True):
+            net.hybridize()
+        h = str(_NEXT_HANDLE[0])
+        _NEXT_HANDLE[0] += 1
+        _MODELS[h] = {"net": net, "trainer": None}
+        return b"", json.dumps({"handle": h})
+
+    m = _MODELS[str(handle)]
+    net = m["net"]
+    if command == "fit":
+        from . import autograd
+
+        x = mxnp.array(arrays[0])
+        y = mxnp.array(arrays[1])
+        lr = float(attrs.get("lr", 0.01))
+        epochs = int(attrs.get("epochs", 1))
+        if m["trainer"] is None:
+            net(x[:1])  # finish deferred init
+            m["trainer"] = Trainer(
+                net.collect_params(), attrs.get("optimizer", "sgd"),
+                {"learning_rate": lr})
+        trainer = m["trainer"]
+        trainer.set_learning_rate(lr)
+        lossfn = gloss.SoftmaxCrossEntropyLoss()
+        bs = x.shape[0]
+        losses = []
+        for _ in range(epochs):
+            with autograd.record():
+                loss = lossfn(net(x), y)
+            loss.backward()
+            trainer.step(bs)
+            losses.append(float(loss.mean().asnumpy()))
+        return b"", json.dumps({"losses": losses})
+    if command == "predict":
+        out = net(mxnp.array(arrays[0]))
+        return pack([out.asnumpy()])
+    if command == "save":
+        net.save_parameters(attrs["path"])
+        return b"", json.dumps({})
+    if command == "load":
+        if arrays:  # optional example input completes deferred init first
+            net(mxnp.array(arrays[0][:1]))
+        net.load_parameters(attrs["path"])
+        return b"", json.dumps({})
+    if command == "free":
+        _MODELS.pop(str(handle), None)
+        return b"", json.dumps({})
+    raise ValueError(f"unknown model command {command!r}")

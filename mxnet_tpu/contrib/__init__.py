@@ -1,5 +1,6 @@
 """mx.contrib — quantization, contrib ops, text, tensorboard, io
 (reference: python/mxnet/contrib/)."""
+from . import dgl  # noqa: F401
 from . import io  # noqa: F401
 from . import ops  # noqa: F401
 from . import ops as nd  # noqa: F401  (reference spelling: mx.contrib.nd)

@@ -1,0 +1,229 @@
+"""DGL graph operators over CSR graphs (reference:
+src/operator/contrib/dgl_graph.cc — edge_id, dgl_adjacency,
+dgl_csr_neighbor_{uniform,non_uniform}_sample, dgl_subgraph,
+dgl_graph_compact).
+
+These are host-side, value-dependent graph algorithms (the reference runs
+them as CPU-only FComputeEx outside any graph executor); they run eagerly
+on numpy and return framework arrays. The CSR's `data` holds edge ids.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as _np
+
+from ..ndarray.ndarray import NDArray
+from ..ndarray.sparse import CSRNDArray
+
+__all__ = ["edge_id", "dgl_adjacency", "dgl_csr_neighbor_uniform_sample",
+           "dgl_csr_neighbor_non_uniform_sample", "dgl_subgraph",
+           "dgl_graph_compact"]
+
+
+def _csr_parts(csr):
+    # CSRNDArray fields are raw jax arrays
+    return (_np.asarray(csr.data),
+            _np.asarray(csr.indices).astype(_np.int64),
+            _np.asarray(csr.indptr).astype(_np.int64),
+            tuple(csr.shape))
+
+
+def _as_np(x):
+    return (x.asnumpy() if isinstance(x, (NDArray, CSRNDArray))
+            else _np.asarray(x))
+
+
+def edge_id(data, u, v):
+    """output[i] = data[u[i], v[i]] if that edge exists else -1
+    (reference: dgl_graph.cc:1326 _contrib_edge_id)."""
+    vals, indices, indptr, _ = _csr_parts(data)
+    uu = _as_np(u).astype(_np.int64).ravel()
+    vv = _as_np(v).astype(_np.int64).ravel()
+    out = _np.full(uu.shape, -1.0, _np.float32)
+    for i, (a, b) in enumerate(zip(uu, vv)):
+        row = indices[indptr[a]:indptr[a + 1]]
+        hit = _np.nonzero(row == b)[0]
+        if hit.size:
+            out[i] = vals[indptr[a] + hit[0]]
+    return NDArray(jnp.asarray(out))
+
+
+def dgl_adjacency(data):
+    """CSR of edge ids -> CSR adjacency with float32 ones
+    (reference: dgl_graph.cc:1402)."""
+    vals, indices, indptr, shape = _csr_parts(data)
+    return CSRNDArray(jnp.ones((len(vals),), jnp.float32),
+                      jnp.asarray(indices), jnp.asarray(indptr), shape)
+
+
+def _neighbor_sample(csr, seeds, num_hops, num_neighbor, max_num_vertices,
+                     probability=None):
+    """BFS-sample up to `num_neighbor` in-edges per vertex per hop
+    (the reference samples over the vertex's CSR row)."""
+    vals, indices, indptr, shape = _csr_parts(csr)
+    rng = _np.random.default_rng(_np.random.randint(1 << 31))
+    seeds = _as_np(seeds).astype(_np.int64).ravel()
+    layer_of = {int(s): 0 for s in seeds}
+    frontier = list(layer_of)
+    # sampled edges as (src_vertex, col, edge_id)
+    edges = []
+    for hop in range(1, num_hops + 1):
+        nxt = []
+        for vtx in frontier:
+            row_cols = indices[indptr[vtx]:indptr[vtx + 1]]
+            row_vals = vals[indptr[vtx]:indptr[vtx + 1]]
+            if row_cols.size == 0:
+                continue
+            k = min(num_neighbor, row_cols.size)
+            if probability is not None:
+                p = probability[row_cols]
+                total = p.sum()
+                if total > 0:
+                    # can't draw more without-replacement samples than
+                    # there are positive-probability neighbors
+                    k = min(k, int((p > 0).sum()))
+                    pick = rng.choice(row_cols.size, size=k,
+                                      replace=False, p=p / total)
+                else:
+                    pick = rng.choice(row_cols.size, size=k,
+                                      replace=False)
+            else:
+                pick = rng.choice(row_cols.size, size=k, replace=False)
+            for j in pick:
+                col = int(row_cols[j])
+                edges.append((vtx, col, row_vals[j]))
+                if col not in layer_of and \
+                        len(layer_of) < max_num_vertices:
+                    layer_of[col] = hop
+                    nxt.append(col)
+        frontier = nxt
+    vertices = sorted(layer_of)[:max_num_vertices]
+    vset = {v: i for i, v in enumerate(vertices)}
+    # vertices output: length max_num_vertices+1, last element = count
+    vout = _np.zeros((max_num_vertices + 1,), _np.int64)
+    vout[:len(vertices)] = vertices
+    vout[-1] = len(vertices)
+    layers = _np.full((max_num_vertices,), -1, _np.int64)
+    for v, i in vset.items():
+        layers[i] = layer_of[v]
+    # sub-CSR in subgraph-local vertex ids: row/col i correspond to
+    # vertices[i] (DGL consumes subgraphs relabeled to local id space)
+    rows = [[] for _ in range(max_num_vertices)]
+    for src, col, eid in edges:
+        if src in vset and col in vset:
+            rows[vset[src]].append((vset[col], eid))
+    data_out, idx_out, ptr_out = [], [], [0]
+    for r in rows:
+        for col, eid in sorted(r):
+            idx_out.append(col)
+            data_out.append(eid)
+        ptr_out.append(len(idx_out))
+    sub = CSRNDArray(
+        jnp.asarray(_np.asarray(data_out, vals.dtype)),
+        jnp.asarray(_np.asarray(idx_out, _np.int64)),
+        jnp.asarray(_np.asarray(ptr_out, _np.int64)),
+        (max_num_vertices, max_num_vertices))
+    return NDArray(jnp.asarray(vout)), sub, NDArray(jnp.asarray(layers))
+
+
+def dgl_csr_neighbor_uniform_sample(csr_matrix, *seed_arrays, num_args=None,
+                                    num_hops=1, num_neighbor=2,
+                                    max_num_vertices=100):  # noqa: ARG001
+    """Uniform neighborhood sampling (reference: dgl_graph.cc:737).
+    Returns [vertices..., sub_csrs..., layers...] — 3 outputs per seed
+    array, grouped by kind like the reference."""
+    vs, gs, ls = [], [], []
+    for seeds in seed_arrays:
+        v, g, l = _neighbor_sample(csr_matrix, seeds, num_hops,
+                                   num_neighbor, max_num_vertices)
+        vs.append(v)
+        gs.append(g)
+        ls.append(l)
+    return (*vs, *gs, *ls)
+
+
+def dgl_csr_neighbor_non_uniform_sample(csr_matrix, probability,
+                                        *seed_arrays, num_args=None,
+                                        num_hops=1, num_neighbor=2,
+                                        max_num_vertices=100):  # noqa: ARG001
+    """Probability-weighted sampling (reference: dgl_graph.cc:841).
+    Adds a probabilities output per seed array."""
+    prob = _as_np(probability).astype(_np.float64).ravel()
+    vs, gs, ps, ls = [], [], [], []
+    for seeds in seed_arrays:
+        v, g, l = _neighbor_sample(csr_matrix, seeds, num_hops,
+                                   num_neighbor, max_num_vertices, prob)
+        cnt = int(v.asnumpy()[-1])
+        pr = _np.zeros((int(v.shape[0]) - 1,), _np.float32)
+        pr[:cnt] = prob[v.asnumpy()[:cnt]]
+        vs.append(v)
+        gs.append(g)
+        ps.append(NDArray(jnp.asarray(pr)))
+        ls.append(l)
+    return (*vs, *gs, *ps, *ls)
+
+
+def dgl_subgraph(graph, *vids, return_mapping=False, num_args=None):  # noqa: ARG001
+    """Induced subgraph on vertex ids (reference: dgl_graph.cc:1129).
+    Per vid array returns a sub-CSR (+ an edge-id mapping CSR when
+    return_mapping)."""
+    vals, indices, indptr, _ = _csr_parts(graph)
+    subs, maps = [], []
+    for vid in vids:
+        vv = _as_np(vid).astype(_np.int64).ravel()
+        vset = {int(v): i for i, v in enumerate(vv)}
+        data_out, idx_out, ptr_out = [], [], [0]
+        for v in vv:
+            row_cols = indices[indptr[v]:indptr[v + 1]]
+            row_vals = vals[indptr[v]:indptr[v + 1]]
+            ents = sorted(
+                (vset[int(c)], val) for c, val in zip(row_cols, row_vals)
+                if int(c) in vset)
+            for c, val in ents:
+                idx_out.append(c)
+                data_out.append(val)
+            ptr_out.append(len(idx_out))
+        n = len(vv)
+        # subgraph edges renumbered 1..E (reference numbers sub-edges);
+        # mapping CSR holds the parent edge ids at the same positions
+        sub = CSRNDArray(
+            jnp.arange(1, len(data_out) + 1, dtype=jnp.int64),
+            jnp.asarray(_np.asarray(idx_out, _np.int64)),
+            jnp.asarray(_np.asarray(ptr_out, _np.int64)), (n, n))
+        subs.append(sub)
+        if return_mapping:
+            maps.append(CSRNDArray(
+                jnp.asarray(_np.asarray(data_out, vals.dtype)),
+                jnp.asarray(_np.asarray(idx_out, _np.int64)),
+                jnp.asarray(_np.asarray(ptr_out, _np.int64)), (n, n)))
+    return (*subs, *maps) if return_mapping else \
+        (subs[0] if len(subs) == 1 else tuple(subs))
+
+
+def dgl_graph_compact(*graphs, graph_sizes=None, return_mapping=False,
+                      num_args=None):  # noqa: ARG001
+    """Trim padded sampled sub-CSRs to their real vertex counts
+    (reference: dgl_graph.cc:1577). graph_sizes: actual vertex count per
+    input graph. Compacted edges are renumbered 1..E; with
+    return_mapping=True a mapping CSR carrying the original (parent) edge
+    ids at the same positions follows the graphs, like dgl_subgraph."""
+    if graph_sizes is None:
+        raise ValueError("graph_sizes is required")
+    sizes = [int(s) for s in _np.asarray(
+        graph_sizes.asnumpy() if isinstance(graph_sizes, NDArray)
+        else graph_sizes).ravel()]
+    outs, maps = [], []
+    for g, n in zip(graphs, sizes):
+        vals, indices, indptr, _ = _csr_parts(g)
+        end = indptr[n]
+        idx = jnp.asarray(indices[:end])
+        ptr = jnp.asarray(indptr[:n + 1])
+        outs.append(CSRNDArray(
+            jnp.arange(1, int(end) + 1, dtype=jnp.int64), idx, ptr,
+            (n, n)))
+        if return_mapping:
+            maps.append(CSRNDArray(jnp.asarray(vals[:end]), idx, ptr,
+                                   (n, n)))
+    if return_mapping:
+        return (*outs, *maps)
+    return outs[0] if len(outs) == 1 else tuple(outs)

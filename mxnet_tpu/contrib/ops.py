@@ -971,3 +971,18 @@ PSROIPooling = psroi_pooling
 Proposal = proposal
 __all__ += ["AdaptiveAvgPooling2D", "BilinearResize2D", "PSROIPooling",
             "Proposal"]
+
+
+# --- DGL graph ops (reference: src/operator/contrib/dgl_graph.cc) ----------
+from .dgl import (  # noqa: E402,F401
+    dgl_adjacency,
+    dgl_csr_neighbor_non_uniform_sample,
+    dgl_csr_neighbor_uniform_sample,
+    dgl_graph_compact,
+    dgl_subgraph,
+    edge_id,
+)
+
+__all__ += ["edge_id", "dgl_adjacency", "dgl_csr_neighbor_uniform_sample",
+            "dgl_csr_neighbor_non_uniform_sample", "dgl_subgraph",
+            "dgl_graph_compact"]

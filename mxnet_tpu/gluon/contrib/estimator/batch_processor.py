@@ -1,0 +1,34 @@
+"""Batch processor — per-minibatch hooks for Estimator (reference:
+gluon/contrib/estimator/batch_processor.py:28). Subclass and override
+`fit_batch` / `evaluate_batch` to customize the inner loop (multi-output
+models, custom losses, adversarial steps) without rewriting `fit`."""
+from __future__ import annotations
+
+from .... import autograd
+
+__all__ = ["BatchProcessor"]
+
+
+class BatchProcessor:
+    def _get_data_and_label(self, batch, device, batch_axis=0):  # noqa: ARG002
+        data, label = batch[0], batch[1]
+        return data.as_in_ctx(device), label.as_in_ctx(device)
+
+    def evaluate_batch(self, estimator, val_batch, batch_axis=0):
+        """One validation step: returns (data, label, pred, loss)."""
+        data, label = self._get_data_and_label(
+            val_batch, estimator.device, batch_axis)
+        pred = estimator.net(data)
+        loss = estimator.loss(pred, label)
+        return data, label, pred, loss
+
+    def fit_batch(self, estimator, train_batch, batch_axis=0):
+        """One training step: forward under record, backward, and return
+        (data, label, pred, loss); the Estimator runs trainer.step."""
+        data, label = self._get_data_and_label(
+            train_batch, estimator.device, batch_axis)
+        with autograd.record():
+            pred = estimator.net(data)
+            loss = estimator.loss(pred, label)
+        loss.backward()
+        return data, label, pred, loss

@@ -24,7 +24,10 @@ class Estimator:
     def __init__(self, net, loss, train_metrics=None, val_metrics=None,
                  initializer=None, trainer=None, device=None, context=None,
                  evaluation_loss=None, val_net=None, val_loss=None,  # noqa: ARG002
-                 batch_processor=None):  # noqa: ARG002
+                 batch_processor=None):
+        from .batch_processor import BatchProcessor
+
+        self.batch_processor = batch_processor or BatchProcessor()
         self.net = net
         self.loss = loss
         self.device = device or context or current_device()
@@ -59,8 +62,12 @@ class Estimator:
         for m in self.val_metrics:
             m.reset()
         for batch in val_data:
-            data, label = (batch_fn or self._batch_fn)(batch)
-            pred = self.net(data)
+            if batch_fn is not None:
+                data, label = batch_fn(batch)
+                pred = self.net(data)
+            else:
+                _, label, pred, _ = self.batch_processor.evaluate_batch(
+                    self, batch)
             for m in self.val_metrics:
                 m.update(label, pred)
         return {m.get()[0]: m.get()[1] for m in self.val_metrics}
@@ -99,11 +106,15 @@ class Estimator:
             fire("epoch_begin")
             for batch in train_data:
                 fire("batch_begin")
-                data, label = (batch_fn or self._batch_fn)(batch)
-                with autograd.record():
-                    pred = self.net(data)
-                    loss = self.loss(pred, label)
-                loss.backward()
+                if batch_fn is not None:
+                    data, label = batch_fn(batch)
+                    with autograd.record():
+                        pred = self.net(data)
+                        loss = self.loss(pred, label)
+                    loss.backward()
+                else:
+                    data, label, pred, loss = \
+                        self.batch_processor.fit_batch(self, batch)
                 self.trainer.step(data.shape[0])
                 if fire("batch_end", pred=pred, label=label, loss=loss):
                     break

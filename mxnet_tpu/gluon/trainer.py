@@ -78,7 +78,14 @@ class Trainer:
 
     def allreduce_grads(self):
         """Aggregate gradients across device copies via the kvstore
-        (reference: trainer.py:402 _allreduce_grads)."""
+        (reference: trainer.py:402 _allreduce_grads).
+
+        Calls are issued in descending priority (priority=-i, so layer 0
+        first — its weights gate the next forward), the P3 dispatch-order
+        contract (src/kvstore/p3store_dist.h); each pushpull is async on
+        the device, so XLA's latency-hiding scheduler overlaps the
+        sequence the way P3 overlapped ps-lite sends.
+        """
         kv = self._kvstore
         if kv is None:
             return

@@ -10,7 +10,7 @@ can be registered from Python.
 """
 from .base import KVStoreBase  # noqa: F401
 from .kvstore import KVStore, KVStoreLocal  # noqa: F401
-from .tpu_dist import TPUDist  # noqa: F401
+from .tpu_dist import P3Store, TPUDist  # noqa: F401
 
 
 def create(name="local"):
@@ -25,8 +25,10 @@ def create(name="local"):
     if name_l in ("local", "device", "local_allreduce_cpu",
                   "local_allreduce_device"):
         return KVStoreLocal(name_l)
+    if name_l == "p3":
+        return P3Store()
     if name_l in ("tpu_dist", "dist_sync", "dist_async", "dist",
-                  "dist_sync_device", "dist_async_device", "nccl", "p3",
+                  "dist_sync_device", "dist_async_device", "nccl",
                   "horovod", "byteps"):
         return TPUDist()
     cls = KVStoreBase.find(name_l)

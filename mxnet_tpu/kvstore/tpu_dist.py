@@ -194,3 +194,75 @@ class TPUDist(KVStoreBase):
 
 # reference-parity alias so KVStoreBase.find('tpudist') works
 KVStoreBase.register(TPUDist)
+
+
+class P3Store(TPUDist):
+    """kvstore='p3' — priority-based propagation (reference:
+    src/kvstore/p3store_dist.h).
+
+    The reference sliced big tensors and scheduled ps-lite sends by layer
+    priority so late-layer comm overlapped early-layer backprop. On TPU
+    the transport is an XLA collective, so the two P3 mechanisms become:
+
+      * slicing: tensors larger than MXNET_KVSTORE_BIGARRAY_BOUND elements
+        are reduced in independent chunks — each chunk's reduce dispatches
+        asynchronously, letting XLA pipeline transfer/compute instead of
+        serializing one monolithic reduce;
+      * priority: dispatch order. `Trainer.allreduce_grads` issues calls
+        in descending priority; the list-of-keys form below re-sorts by
+        its per-key priorities.
+    """
+
+    def __init__(self, devices=None):
+        super().__init__(devices)
+        from .. import env as _env
+
+        if "MXNET_KVSTORE_BIGARRAY_BOUND" not in _env.all_vars():
+            _env.register(
+                "MXNET_KVSTORE_BIGARRAY_BOUND", int, 1 << 20,
+                "Element count above which kvstore='p3' slices a tensor "
+                "into independently-dispatched reduce chunks (reference: "
+                "P3 slicing, p3store_dist.h).")
+        self._bound = _env.get("MXNET_KVSTORE_BIGARRAY_BOUND")
+
+    def pushpull(self, key, value, out=None, priority=0):
+        keys = _aslist(key)
+        if len(keys) != 1:
+            # list form: dispatch in index order — the Trainer contract
+            # assigns priority -index, so this IS descending priority
+            vals = value
+            outs = out if out is not None else [None] * len(keys)
+            for i in range(len(keys)):
+                self.pushpull(keys[i], vals[i], outs[i], priority=-i)
+            return
+        vals = _aslist(value)
+        size = int(vals[0].size)
+        if size <= self._bound or len(vals) == 1:
+            return super().pushpull(key, value, out, priority)
+        # gradient compression applies before slicing, exactly as in the
+        # delegated small-tensor path
+        vals = self._compress_vals(str(keys[0]), vals)
+        # chunked reduce: flatten, split, reduce each chunk independently
+        n_chunks = -(-size // self._bound)
+        dev = next(iter(vals[0]._data.devices()))
+        flats = [jax.device_put(v._data, dev).reshape(-1) for v in vals]
+        bounds = [min((c + 1) * self._bound, size)
+                  for c in range(n_chunks)]
+        starts = [0] + bounds[:-1]
+        reduced = []
+        addn = self._tree_sum(len(flats))
+        for s, e in zip(starts, bounds):
+            chunk = addn(*[f[s:e] for f in flats])
+            if self.num_workers > 1:
+                chunk = self._cross_process_sum(chunk)
+            reduced.append(chunk)
+        total = jnp.concatenate(reduced).reshape(vals[0].shape)
+        if out is None:
+            return
+        for o in _aslist(out):
+            o._data = self._put_like(total, o._data)
+            o._version += 1
+
+
+KVStoreBase.register(P3Store)
+KVStoreBase.kv_registry["p3"] = P3Store  # reference spelling

@@ -1,0 +1,187 @@
+"""Resource manager: per-device shared resources ops can request
+(reference: include/mxnet/resource.h:38-50 ResourceRequest{kRandom,
+kTempSpace, kParallelRandom, kCuDNNDropoutDesc}, src/resource.cc:559
+ResourceManager).
+
+TPU translation:
+  * kRandom / kParallelRandom — the stateful facade over jax PRNG keys
+    (`_random.next_key`); under jit the trace-context key provider serves
+    the same request (the FResourceRequest analog).
+  * kTempSpace — scratch memory. DEVICE scratch comes straight from the
+    PJRT allocator (jax arrays are immutable, so a user-level device pool
+    cannot recycle buffers — PJRT's own best-fit pool already reuses
+    freed HBM, and inside jit XLA plans op workspaces itself; the
+    reference's pooled workspace has no useful TPU counterpart beyond
+    allocation). HOST scratch IS pooled: bytearray buckets
+    (power-of-2, like pooled_storage_manager.h RoundPower2) recycled for
+    CustomOp / image-pipeline staging, capped by
+    MXNET_RESOURCE_TEMP_SPACE_MB.
+  * kCuDNNDropoutDesc — n/a on TPU (dropout is a fused XLA op); requests
+    raise with a pointer to npx.dropout.
+"""
+from __future__ import annotations
+
+import threading
+
+import jax.numpy as jnp
+import numpy as _np
+
+from . import _random, env
+from .ndarray.ndarray import NDArray
+
+__all__ = ["ResourceRequest", "Resource", "ResourceManager", "request"]
+
+env.register(
+    "MXNET_RESOURCE_TEMP_SPACE_MB", int, 256,
+    "Cap (MB, per process) on pooled host temp-space buffers held by "
+    "the resource manager; largest buckets are evicted first when over.")
+
+
+class ResourceRequest:
+    """Resource type tags (reference: resource.h:38 enum)."""
+
+    kRandom = "random"
+    kTempSpace = "temp_space"
+    kParallelRandom = "parallel_random"
+    kCuDNNDropoutDesc = "cudnn_dropout_desc"
+
+
+def _round_pow2(n):
+    p = 1
+    while p < n:
+        p <<= 1
+    return p
+
+
+class HostSpace:
+    """Pooled host scratch: `data` is a numpy uint8 view over a recycled
+    bytearray (numpy views can't carry the pool token themselves)."""
+
+    __slots__ = ("data", "_token")
+
+    def __init__(self, data, token):
+        self.data = data
+        self._token = token
+
+
+class Resource:
+    """Handle returned by `request` (reference: resource.h Resource)."""
+
+    def __init__(self, manager, device, req_type):
+        self._mgr = manager
+        self.device = device
+        self.req = req_type
+
+    # -- kRandom -----------------------------------------------------------
+    def get_random(self, dtype=None):  # noqa: ARG002 - parity arg
+        """A fresh PRNG key (the reference handed back a sampler seeded
+        from the device RNG state; key-based jax sampling replaces it)."""
+        if self.req not in (ResourceRequest.kRandom,
+                            ResourceRequest.kParallelRandom):
+            raise ValueError(f"resource {self.req} is not a RNG")
+        return _random.next_key()
+
+    # -- kTempSpace --------------------------------------------------------
+    def get_space(self, shape, dtype="float32"):
+        """Device scratch NDArray of `shape` (zero-filled; allocation is
+        PJRT's, see module docstring)."""
+        if self.req != ResourceRequest.kTempSpace:
+            raise ValueError(f"resource {self.req} has no space")
+        return self._mgr._get_device_space(self.device, shape, dtype)
+
+    def get_host_space(self, nbytes):
+        """Host scratch (HostSpace with a numpy uint8 `data` view) from
+        the bucketed pool; return it with ResourceManager.release_host."""
+        if self.req != ResourceRequest.kTempSpace:
+            raise ValueError(f"resource {self.req} has no space")
+        return self._mgr._get_host_space(int(nbytes))
+
+
+class ResourceManager:
+    """Process-global resource provider (reference: resource.h:239)."""
+
+    _instance = None
+    _instance_lock = threading.Lock()
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._host_pool = {}    # bucket_bytes -> [bytearray]
+        self._held_bytes = 0
+        self._device_bytes_served = 0
+
+    @classmethod
+    def get(cls):
+        with cls._instance_lock:
+            if cls._instance is None:
+                cls._instance = ResourceManager()
+            return cls._instance
+
+    # -- request API -------------------------------------------------------
+    def request(self, device, req_type):
+        if req_type == ResourceRequest.kCuDNNDropoutDesc:
+            raise ValueError(
+                "cudnn_dropout_desc has no TPU counterpart; dropout is a "
+                "fused XLA op — use npx.dropout / nn.Dropout")
+        return Resource(self, device, req_type)
+
+    # -- temp space --------------------------------------------------------
+    def _cap_bytes(self):
+        return env.get("MXNET_RESOURCE_TEMP_SPACE_MB") * (1 << 20)
+
+    def _get_device_space(self, device, shape, dtype):  # noqa: ARG002
+        dtype = jnp.dtype(dtype)
+        n = int(_np.prod(shape) or 1)
+        with self._lock:
+            self._device_bytes_served += n * dtype.itemsize
+        return NDArray(jnp.zeros(tuple(shape), dtype))
+
+    def _get_host_space(self, nbytes):
+        bucket = _round_pow2(max(nbytes, 16))
+        with self._lock:
+            pool = self._host_pool.setdefault(bucket, [])
+            if pool:
+                buf = pool.pop()
+                self._held_bytes -= bucket
+            else:
+                buf = bytearray(bucket)
+        view = _np.frombuffer(buf, dtype=_np.uint8, count=nbytes)
+        return HostSpace(view, (bucket, buf))
+
+    def release_host(self, space):
+        token = getattr(space, "_token", None)
+        if token is None:
+            return
+        bucket, buf = token
+        with self._lock:
+            self._host_pool.setdefault(bucket, []).append(buf)
+            self._held_bytes += bucket
+            # evict largest buckets first when over cap
+            if self._held_bytes > self._cap_bytes():
+                for k in sorted(
+                        [k for k, v in self._host_pool.items() if v],
+                        key=lambda k: -k):
+                    while self._host_pool[k] and \
+                            self._held_bytes > self._cap_bytes():
+                        self._host_pool[k].pop()
+                        self._held_bytes -= k
+                    if self._held_bytes <= self._cap_bytes():
+                        break
+
+    # -- introspection -----------------------------------------------------
+    def stats(self):
+        with self._lock:
+            return {
+                "host_buckets": {k: len(v)
+                                 for k, v in self._host_pool.items()},
+                "held_bytes": self._held_bytes,
+                "device_bytes_served": self._device_bytes_served,
+            }
+
+
+def request(device=None, req_type=ResourceRequest.kTempSpace):
+    """Module-level convenience: `mx.resource.request(dev, 'temp_space')`
+    (reference: ResourceManager::Get()->Request)."""
+    from .device import current_device
+
+    return ResourceManager.get().request(
+        device if device is not None else current_device(), req_type)

@@ -430,3 +430,144 @@ def test_dataloader_iter_one_shot_iterable():
     batches = list(it)
     assert len(batches) == 3
     assert float(batches[0].data[0].asnumpy()[0, 0]) == 0.0  # batch 0 kept
+
+
+# --- DGL graph ops (reference: src/operator/contrib/dgl_graph.cc) ----------
+
+def _ref_graph():
+    from mxnet_tpu.ndarray import sparse
+
+    data = onp.arange(1, 21, dtype=onp.int64)
+    indices = onp.array([1, 2, 3, 4, 0, 2, 3, 4, 0, 1, 3, 4,
+                         0, 1, 2, 4, 0, 1, 2, 3], dtype=onp.int64)
+    indptr = onp.array([0, 4, 8, 12, 16, 20], dtype=onp.int64)
+    return sparse.csr_matrix((data, indices, indptr), shape=(5, 5))
+
+
+def test_edge_id():
+    from mxnet_tpu.contrib import dgl
+    from mxnet_tpu.ndarray import sparse
+
+    x = sparse.csr_matrix(
+        (onp.array([1, 2, 3], onp.int64), onp.array([0, 1, 2], onp.int64),
+         onp.array([0, 1, 2, 3], onp.int64)), shape=(3, 3))
+    out = dgl.edge_id(x, mx.np.array([0, 0, 1, 1, 2, 2]),
+                      mx.np.array([0, 1, 1, 2, 0, 2]))
+    onp.testing.assert_allclose(out.asnumpy(), [1, -1, 2, -1, -1, 3])
+
+
+def test_dgl_adjacency():
+    from mxnet_tpu.contrib import dgl
+
+    adj = dgl.dgl_adjacency(_ref_graph())
+    dense = adj.todense().asnumpy()
+    assert dense.dtype == onp.float32
+    assert set(onp.unique(dense)) <= {0.0, 1.0}
+    assert dense.sum() == 20  # every edge present as a 1
+
+
+def test_dgl_neighbor_sample():
+    from mxnet_tpu.contrib import dgl
+
+    a = _ref_graph()
+    seed = mx.np.array([0, 1, 2, 3, 4], dtype="int64")
+    v, sub, layers = dgl.dgl_csr_neighbor_uniform_sample(
+        a, seed, num_hops=1, num_neighbor=2, max_num_vertices=5)
+    vn = v.asnumpy()
+    assert vn.shape == (6,) and vn[-1] == 5  # all 5 vertices sampled
+    dense = sub.todense().asnumpy()
+    assert (dense > 0).sum() == 10  # 2 sampled edges per vertex
+    # sampled values are real parent edge ids
+    parent = a.todense().asnumpy()
+    nz = onp.nonzero(dense)
+    assert (dense[nz] == parent[nz]).all()
+    assert (layers.asnumpy() == 0).all()  # seeds are layer 0
+
+
+def test_dgl_neighbor_sample_non_uniform():
+    from mxnet_tpu.contrib import dgl
+
+    a = _ref_graph()
+    prob = mx.np.array([0.1, 0.4, 0.3, 0.1, 0.1])
+    seed = mx.np.array([0], dtype="int64")
+    out = dgl.dgl_csr_neighbor_non_uniform_sample(
+        a, prob, seed, num_hops=2, num_neighbor=2, max_num_vertices=5)
+    v, sub, probs, layers = out
+    cnt = int(v.asnumpy()[-1])
+    assert 1 <= cnt <= 5
+    assert probs.shape == (5,)
+
+
+def test_dgl_subgraph_and_compact():
+    from mxnet_tpu.contrib import dgl
+
+    a = _ref_graph()
+    sub, mapping = dgl.dgl_subgraph(
+        a, mx.np.array([0, 1, 2], dtype="int64"), return_mapping=True)
+    sd = sub.todense().asnumpy()
+    md = mapping.todense().asnumpy()
+    assert sd.shape == (3, 3)
+    # subgraph edge ids renumbered 1..E; mapping holds parent edge ids
+    assert sorted(sd[sd > 0]) == list(range(1, (sd > 0).sum() + 1))
+    parent = a.todense().asnumpy()[:3, :3]
+    assert ((md > 0) == (parent > 0)).all()
+    assert (md[md > 0] == parent[parent > 0]).all()
+
+    seed = mx.np.array([0, 1], dtype="int64")
+    v, g, _ = dgl.dgl_csr_neighbor_uniform_sample(
+        a, seed, num_hops=1, num_neighbor=2, max_num_vertices=4)
+    n = int(v.asnumpy()[-1])
+    comp = dgl.dgl_graph_compact(g, graph_sizes=mx.np.array([n]))
+    assert comp.shape == (n, n)
+
+
+# --- mx.rtc (reference: python/mxnet/rtc.py) -------------------------------
+
+def test_rtc_pallas_module():
+    import mxnet_tpu.rtc as rtc
+
+    with pytest.raises(NotImplementedError):
+        rtc.CudaModule("__global__ void k() {}")
+
+    def add_one(x_ref, o_ref):
+        o_ref[...] = x_ref[...] + 1.0
+
+    mod = rtc.PallasModule({"add_one": add_one})
+    k = mod.get_kernel("add_one")
+    x = mx.np.array(onp.arange(8, dtype="f").reshape(2, 4))
+    y = k.launch([x], out_shape=(2, 4))
+    onp.testing.assert_allclose(y.asnumpy(), x.asnumpy() + 1.0)
+    with pytest.raises(KeyError):
+        mod.get_kernel("missing")
+
+
+def test_dgl_non_uniform_sparse_probability():
+    """Review regression: fewer positive-prob neighbors than num_neighbor
+    must not crash rng.choice."""
+    from mxnet_tpu.contrib import dgl
+
+    a = _ref_graph()
+    prob = mx.np.array([0.0, 0.0, 0.9, 0.0, 0.0])
+    out = dgl.dgl_csr_neighbor_non_uniform_sample(
+        a, prob, mx.np.array([0], dtype="int64"), num_hops=1,
+        num_neighbor=3, max_num_vertices=5)
+    v = out[0].asnumpy()
+    assert v[-1] >= 1
+
+
+def test_dgl_graph_compact_return_mapping():
+    from mxnet_tpu.contrib import dgl
+
+    a = _ref_graph()
+    v, g, _ = dgl.dgl_csr_neighbor_uniform_sample(
+        a, mx.np.array([0, 1], dtype="int64"), num_hops=1,
+        num_neighbor=2, max_num_vertices=4)
+    n = int(v.asnumpy()[-1])
+    comp, mapping = dgl.dgl_graph_compact(
+        g, graph_sizes=mx.np.array([n]), return_mapping=True)
+    cd = comp.todense().asnumpy()
+    md = mapping.todense().asnumpy()
+    assert cd.shape == (n, n) and md.shape == (n, n)
+    # compacted graph renumbers edges 1..E; mapping holds parent edge ids
+    assert sorted(cd[cd > 0]) == list(range(1, (cd > 0).sum() + 1))
+    assert ((md > 0) == (cd > 0)).all()

@@ -95,3 +95,90 @@ def test_packed_function_ffi_cpp_embed(tmp_path):
                          env=env)
     assert run.returncode == 0, run.stdout + run.stderr
     assert "embed_demo OK" in run.stdout
+
+
+def test_model_packed_python_side(tmp_path):
+    """model_packed: the cpp-package training surface, driven from python
+    (the C++ demo exercises the same entry point through the embedded
+    interpreter)."""
+    import json
+
+    import numpy as onp
+
+    from mxnet_tpu import capi
+
+    _, meta = capi.model_packed(
+        "", "create", b"",
+        json.dumps({"args": [], "attrs": {"spec": {"mlp": [16],
+                                                   "classes": 3}}}))
+    h = json.loads(meta)["handle"]
+    rs = onp.random.RandomState(0)
+    x = rs.rand(24, 5).astype("f")
+    y = (rs.rand(24) * 3).astype("i")
+    blob = x.tobytes() + y.tobytes()
+    args = [{"shape": [24, 5], "dtype": "float32"},
+            {"shape": [24], "dtype": "int32"}]
+    _, fit_meta = capi.model_packed(
+        h, "fit", blob, json.dumps({"args": args,
+                                    "attrs": {"lr": 0.1, "epochs": 5}}))
+    losses = json.loads(fit_meta)["losses"]
+    assert len(losses) == 5 and losses[-1] < losses[0]
+    out_blob, out_meta = capi.model_packed(
+        h, "predict", x.tobytes(),
+        json.dumps({"args": [args[0]], "attrs": {}}))
+    shape = json.loads(out_meta)["outputs"][0]["shape"]
+    assert shape == [24, 3]
+    path = str(tmp_path / "m.npz")
+    capi.model_packed(h, "save", b"", json.dumps(
+        {"args": [], "attrs": {"path": path}}))
+    # new model, load, predictions match
+    _, meta2 = capi.model_packed(
+        "", "create", b"",
+        json.dumps({"args": [], "attrs": {"spec": {"mlp": [16],
+                                                   "classes": 3}}}))
+    h2 = json.loads(meta2)["handle"]
+    capi.model_packed(h2, "load", x.tobytes(), json.dumps(
+        {"args": [args[0]], "attrs": {"path": path}}))
+    out2, _ = capi.model_packed(
+        h2, "predict", x.tobytes(),
+        json.dumps({"args": [args[0]], "attrs": {}}))
+    onp.testing.assert_allclose(
+        onp.frombuffer(out_blob, "f"), onp.frombuffer(out2, "f"),
+        rtol=1e-5)
+    capi.model_packed(h, "free", b"", "{}")
+    capi.model_packed(h2, "free", b"", "{}")
+
+
+def test_cpp_training_demo(tmp_path):
+    """Build + run the C++ training demo: full gluon training driven from
+    C++ (reference analog: cpp-package FeedForward fit examples)."""
+    import os
+    import shutil
+    import subprocess
+    import sysconfig
+
+    import pytest
+
+    if shutil.which("g++") is None:
+        pytest.skip("no C++ toolchain")
+    repo = __file__.rsplit("/tests/", 1)[0]
+    inc = sysconfig.get_paths()["include"]
+    libdir = sysconfig.get_config_var("LIBDIR")
+    ver = sysconfig.get_config_var("LDVERSION")
+    if not libdir or not ver or not os.path.exists(
+            os.path.join(libdir, f"libpython{ver}.so")):
+        pytest.skip("no shared libpython to embed")
+    exe = str(tmp_path / "train_demo")
+    build = subprocess.run(
+        ["g++", "-O2", "-std=c++17",
+         f"{repo}/cpp-package/example/train_demo.cc",
+         f"-I{repo}/cpp-package/include", f"-I{inc}",
+         f"-L{libdir}", f"-lpython{ver}", "-ldl", "-lm", "-o", exe],
+        capture_output=True, text=True, timeout=180)
+    assert build.returncode == 0, build.stderr
+    env = dict(os.environ, PYTHONPATH=repo, JAX_PLATFORMS="cpu")
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+    run = subprocess.run([exe], capture_output=True, text=True,
+                         timeout=300, env=env)
+    assert run.returncode == 0, run.stdout + run.stderr
+    assert "train_demo OK" in run.stdout

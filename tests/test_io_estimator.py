@@ -164,6 +164,10 @@ def test_checkpoint_handler_best_not_rotated(tmp_path):
 
     for _ in range(5):
         h.epoch_end(est)
+    # saves are async through the engine; block like any reader would
+    from mxnet_tpu._checkpoint_io import wait_for_path
+
+    wait_for_path(str(tmp_path / "model-best.params"))
     assert os.path.exists(str(tmp_path / "model-best.params"))
 
 
@@ -202,3 +206,38 @@ def test_dataloader_process_workers_ndarray_fallback():
     assert not loader._fork_safe()
     batches = list(loader)
     assert len(batches) == 4
+
+
+def test_batch_processor_custom():
+    """Custom BatchProcessor drives the inner loop (reference:
+    estimator/batch_processor.py)."""
+    import numpy as onp
+
+    import mxnet_tpu as mx
+    from mxnet_tpu import gluon
+    from mxnet_tpu.gluon.contrib.estimator import Estimator
+    from mxnet_tpu.gluon.contrib.estimator.batch_processor import (
+        BatchProcessor,
+    )
+
+    calls = {"fit": 0, "eval": 0}
+
+    class Doubler(BatchProcessor):
+        def fit_batch(self, estimator, batch, batch_axis=0):
+            calls["fit"] += 1
+            return super().fit_batch(estimator, batch, batch_axis)
+
+        def evaluate_batch(self, estimator, batch, batch_axis=0):
+            calls["eval"] += 1
+            return super().evaluate_batch(estimator, batch, batch_axis)
+
+    net = gluon.nn.Dense(3)
+    net.initialize()
+    rs = onp.random.RandomState(0)
+    ds = gluon.data.ArrayDataset(rs.rand(12, 4).astype("f"),
+                                 (rs.rand(12) * 3).astype("i"))
+    loader = gluon.data.DataLoader(ds, batch_size=4)
+    est = Estimator(net, gluon.loss.SoftmaxCrossEntropyLoss(),
+                    batch_processor=Doubler())
+    est.fit(loader, val_data=loader, epochs=2)
+    assert calls["fit"] == 6 and calls["eval"] == 6

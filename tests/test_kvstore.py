@@ -103,3 +103,91 @@ def test_trainer_with_kvstore():
     y.backward()
     tr.step(1)
     assert_almost_equal(net.weight.data(), onp.array([[0.9, 0.8]]))
+
+
+# --- P3 priority store (reference: src/kvstore/p3store_dist.h) -------------
+
+def test_p3_chunked_pushpull_matches_tpu_dist(monkeypatch):
+    import numpy as onp
+
+    monkeypatch.setenv("MXNET_KVSTORE_BIGARRAY_BOUND", "1000")
+    kv = mx.kvstore.create("p3")
+    rs = onp.random.RandomState(3)
+    # 5000 elements > bound=1000 -> 5 chunks
+    vals = [mx.np.array(rs.rand(50, 100).astype("f")) for _ in range(3)]
+    outs = [mx.np.zeros((50, 100)) for _ in range(3)]
+    kv.pushpull(0, vals, out=outs, priority=0)
+    expect = sum(v.asnumpy() for v in vals)
+    for o in outs:
+        onp.testing.assert_allclose(o.asnumpy(), expect, rtol=1e-5)
+
+
+def test_p3_small_tensor_delegates(monkeypatch):
+    import numpy as onp
+
+    monkeypatch.setenv("MXNET_KVSTORE_BIGARRAY_BOUND", "1000000")
+    kv = mx.kvstore.create("p3")
+    vals = [mx.np.array(onp.ones((4, 4), "f")) for _ in range(2)]
+    outs = [mx.np.zeros((4, 4)) for _ in range(2)]
+    kv.pushpull(0, vals, out=outs)
+    onp.testing.assert_allclose(outs[0].asnumpy(), 2 * onp.ones((4, 4)))
+
+
+def test_trainer_issues_pushpull_in_priority_order():
+    """allreduce_grads must dispatch high-priority (low-index) params
+    first — the P3 dispatch-order contract."""
+    import numpy as onp
+
+    from mxnet_tpu import autograd, gluon
+    from mxnet_tpu.kvstore.base import KVStoreBase
+
+    order = []
+
+    class RecordingStore(KVStoreBase):
+        def broadcast(self, key, value, out, priority=0):
+            pass
+
+        def pushpull(self, key, value, out=None, priority=0):
+            order.append((key, priority))
+            if out is not None:
+                outs = out if isinstance(out, list) else [out]
+                vals = value if isinstance(value, list) else [value]
+                for o in outs:
+                    o._data = vals[0]._data
+
+        def is_capable(self, c):
+            return c == "pushpull"
+
+    net = gluon.nn.HybridSequential()
+    net.add(gluon.nn.Dense(4), gluon.nn.Dense(2))
+    net.initialize()
+    tr = gluon.Trainer(net.collect_params(), "sgd",
+                       {"learning_rate": 0.1}, kvstore=RecordingStore())
+    x = mx.np.array(onp.random.rand(2, 3).astype("f"))
+    with autograd.record():
+        loss = net(x).sum()
+    loss.backward()
+    tr.step(2)
+    priorities = [p for _, p in order]
+    assert priorities == sorted(priorities, reverse=True), order
+    assert len(order) == 4  # two dense layers x (weight, bias)
+
+
+def test_p3_chunked_applies_gradient_compression(monkeypatch):
+    """Review regression: the chunked path must compress exactly like the
+    delegated small-tensor path."""
+    import numpy as onp
+
+    monkeypatch.setenv("MXNET_KVSTORE_BIGARRAY_BOUND", "1000")
+    big = mx.kvstore.create("p3")
+    big.set_gradient_compression({"type": "2bit", "threshold": 0.5})
+    small = mx.kvstore.create("tpu_dist")
+    small.set_gradient_compression({"type": "2bit", "threshold": 0.5})
+    rs = onp.random.RandomState(0)
+    raw = [rs.randn(40, 50).astype("f") for _ in range(2)]  # 2000 > bound
+    outs_big = [mx.np.zeros((40, 50)) for _ in range(2)]
+    outs_small = [mx.np.zeros((40, 50)) for _ in range(2)]
+    big.pushpull(0, [mx.np.array(v) for v in raw], out=outs_big)
+    small.pushpull(0, [mx.np.array(v) for v in raw], out=outs_small)
+    onp.testing.assert_allclose(outs_big[0].asnumpy(),
+                                outs_small[0].asnumpy(), rtol=1e-5)
