@@ -313,8 +313,13 @@ def main():
     def over_budget():
         return time.perf_counter() - t_start > budget_s
 
-    if (os.environ.get("MXTPU_BENCH_HEADLINE_ONLY") != "1"
-            and platform != "cpu" and not over_budget()):
+    secondary_wanted = (os.environ.get("MXTPU_BENCH_HEADLINE_ONLY") != "1"
+                        and platform != "cpu")
+    if secondary_wanted and over_budget():
+        rows.append({"metric": "secondary_benches",
+                     "error": "bench budget exhausted before "
+                              "lenet/bert/int8 rows"})
+    if secondary_wanted and not over_budget():
         try:
             lenet_img_s = bench_lenet_imperative(
                 platform, iters if platform != "cpu" else 1, warmup)

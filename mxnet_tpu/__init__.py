@@ -90,6 +90,12 @@ def seed(s, ctx="all"):
     _random.seed(s, ctx)
 
 
+# Internal reference spellings (_npi_*, _contrib_*, _plus_scalar, ...)
+# resolve onto the same registry entries as the public names.
+from .ops.aliases import install_aliases as _install_aliases  # noqa: E402
+
+_install_aliases()
+
 __all__ = [
     "NDArray",
     "MXNetError",

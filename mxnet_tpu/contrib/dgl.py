@@ -61,7 +61,11 @@ def _neighbor_sample(csr, seeds, num_hops, num_neighbor, max_num_vertices,
     """BFS-sample up to `num_neighbor` in-edges per vertex per hop
     (the reference samples over the vertex's CSR row)."""
     vals, indices, indptr, shape = _csr_parts(csr)
-    rng = _np.random.default_rng(_np.random.randint(1 << 31))
+    # seed from the framework RNG so mx.seed() reproduces the sample
+    from .. import _random as _fwrng
+
+    seed_bits = int(_np.asarray(_fwrng.next_key())[-1]) & 0x7FFFFFFF
+    rng = _np.random.default_rng(seed_bits)
     seeds = _as_np(seeds).astype(_np.int64).ravel()
     layer_of = {int(s): 0 for s in seeds}
     frontier = list(layer_of)

@@ -986,3 +986,275 @@ from .dgl import (  # noqa: E402,F401
 __all__ += ["edge_id", "dgl_adjacency", "dgl_csr_neighbor_uniform_sample",
             "dgl_csr_neighbor_non_uniform_sample", "dgl_subgraph",
             "dgl_graph_compact"]
+
+
+# --- sliding-window (Longformer) attention (reference: transformer.cc
+# _contrib_sldwin_atten_score/_context/_mask_like) --------------------------
+
+def _sldwin_offsets(w, symmetric):
+    # symmetric: offsets -w..w (w_len = 2w+1); causal: -w..0 (w+1)
+    return list(range(-w, w + 1)) if symmetric else list(range(-w, 1))
+
+
+def sldwin_atten_score(query, key, dilation, w=2, symmetric=True):
+    """Banded QK^T: query/key (B, L, H, D), dilation (H,) ->
+    score (B, L, H, w_len); out-of-range key positions score 0."""
+    dil = [int(d) for d in (dilation.asnumpy()
+                            if isinstance(dilation, NDArray)
+                            else _np.asarray(dilation)).ravel()]
+    offs = _sldwin_offsets(int(w), symmetric)
+
+    def pure(q, k):
+        B, L, H, D = q.shape
+        pos = jnp.arange(L)
+        cols = []
+        for j, off in enumerate(offs):
+            head_cols = []
+            for h in range(H):
+                idx = pos + off * dil[h]
+                ok = (idx >= 0) & (idx < L)
+                idx_c = jnp.clip(idx, 0, L - 1)
+                kh = k[:, idx_c, h]                     # (B, L, D)
+                s = jnp.einsum("bld,bld->bl", q[:, :, h], kh)
+                head_cols.append(jnp.where(ok[None], s, 0.0))
+            cols.append(jnp.stack(head_cols, axis=-1))  # (B, L, H)
+        return jnp.stack(cols, axis=-1).astype(jnp.float32)
+
+    return apply_op(pure, query, key, name="sldwin_atten_score")
+
+
+def sldwin_atten_mask_like(score, dilation, valid_length, num_heads=None,
+                           w=2, symmetric=True):  # noqa: ARG001
+    """1/0 mask matching `score`'s banded layout: key position in
+    [0, valid_length[b]) and query position valid."""
+    dil = [int(d) for d in (dilation.asnumpy()
+                            if isinstance(dilation, NDArray)
+                            else _np.asarray(dilation)).ravel()]
+    offs = _sldwin_offsets(int(w), symmetric)
+
+    def pure(sc, vl):
+        B, L, H, W = sc.shape
+        pos = jnp.arange(L)
+        cols = []
+        for off in offs:
+            head_cols = []
+            for h in range(H):
+                idx = pos + off * dil[h]
+                ok = (idx >= 0) & (idx < L)
+                valid = (idx[None, :] < vl[:, None]) & \
+                    (pos[None, :] < vl[:, None]) & ok[None]
+                head_cols.append(valid)
+            cols.append(jnp.stack(head_cols, axis=-1))
+        return jnp.stack(cols, axis=-1).astype(jnp.float32)
+
+    return apply_op(pure, score, valid_length,
+                    name="sldwin_atten_mask_like")
+
+
+def sldwin_atten_context(score, value, dilation, w=2, symmetric=True):
+    """Weighted sum over the band: score (B, L, H, w_len), value
+    (B, L, H, D) -> context (B, L, H, D)."""
+    dil = [int(d) for d in (dilation.asnumpy()
+                            if isinstance(dilation, NDArray)
+                            else _np.asarray(dilation)).ravel()]
+    offs = _sldwin_offsets(int(w), symmetric)
+
+    def pure(sc, v):
+        B, L, H, W = sc.shape
+        D = v.shape[-1]
+        pos = jnp.arange(L)
+        out = jnp.zeros((B, L, H, D), v.dtype)
+        for j, off in enumerate(offs):
+            for h in range(H):
+                idx = pos + off * dil[h]
+                ok = (idx >= 0) & (idx < L)
+                idx_c = jnp.clip(idx, 0, L - 1)
+                vh = v[:, idx_c, h]                     # (B, L, D)
+                contrib = sc[:, :, h, j:j + 1] * vh * ok[None, :, None]
+                out = out.at[:, :, h].add(contrib)
+        return out
+
+    return apply_op(pure, score, value, name="sldwin_atten_context")
+
+
+# --- SSD box codec (reference: bounding_box.cc _contrib_box_decode /
+# _contrib_box_encode) ------------------------------------------------------
+
+def box_decode(data, anchors, std0=0.1, std1=0.1, std2=0.2, std3=0.2,
+               clip=-1.0, format="corner"):  # noqa: A002
+    """Decode deltas (B, N, 4) against anchors (1, N, 4) back to corner
+    boxes (reference: bounding_box.cc BoxDecode)."""
+    def pure(d, a):
+        if format == "corner":
+            aw = a[..., 2] - a[..., 0]
+            ah = a[..., 3] - a[..., 1]
+            acx = a[..., 0] + aw / 2
+            acy = a[..., 1] + ah / 2
+        else:
+            acx, acy, aw, ah = (a[..., i] for i in range(4))
+        cx = d[..., 0] * std0 * aw + acx
+        cy = d[..., 1] * std1 * ah + acy
+        w_ = jnp.exp(d[..., 2] * std2) * aw / 2
+        h_ = jnp.exp(d[..., 3] * std3) * ah / 2
+        out = jnp.stack([cx - w_, cy - h_, cx + w_, cy + h_], axis=-1)
+        if clip > 0:
+            out = jnp.clip(out, 0.0, clip)
+        return out
+
+    return apply_op(pure, data, anchors, name="box_decode")
+
+
+def box_encode(samples, matches, anchors, refs, means=(0., 0., 0., 0.),
+               stds=(0.1, 0.1, 0.2, 0.2)):
+    """Encode matched ground-truth boxes into regression targets
+    (reference: bounding_box.cc BoxEncode). samples (B, N) in {-1, 0, 1},
+    matches (B, N) gt indices, anchors (B, N, 4), refs (B, M, 4) corner.
+    Returns (targets (B, N, 4), masks (B, N, 4))."""
+    def pure(s, m, a, r):
+        g = jnp.take_along_axis(
+            r, m[..., None].astype(jnp.int32).clip(0), axis=1)  # (B,N,4)
+        aw = a[..., 2] - a[..., 0]
+        ah = a[..., 3] - a[..., 1]
+        acx = a[..., 0] + aw / 2
+        acy = a[..., 1] + ah / 2
+        gw = g[..., 2] - g[..., 0]
+        gh = g[..., 3] - g[..., 1]
+        gcx = g[..., 0] + gw / 2
+        gcy = g[..., 1] + gh / 2
+        t0 = ((gcx - acx) / jnp.maximum(aw, 1e-12) - means[0]) / stds[0]
+        t1 = ((gcy - acy) / jnp.maximum(ah, 1e-12) - means[1]) / stds[1]
+        t2 = (jnp.log(jnp.maximum(gw, 1e-12)
+                      / jnp.maximum(aw, 1e-12)) - means[2]) / stds[2]
+        t3 = (jnp.log(jnp.maximum(gh, 1e-12)
+                      / jnp.maximum(ah, 1e-12)) - means[3]) / stds[3]
+        targets = jnp.stack([t0, t1, t2, t3], axis=-1)
+        mask = (s > 0.5)[..., None].astype(targets.dtype) \
+            * jnp.ones_like(targets)
+        return targets * mask, mask
+
+    return apply_op(pure, samples, matches, anchors, refs,
+                    name="box_encode")
+
+
+__all__ += ["sldwin_atten_score", "sldwin_atten_mask_like",
+            "sldwin_atten_context", "box_decode", "box_encode"]
+
+
+# --- rotated ROI align (reference: rroi_align.cc) --------------------------
+
+def rroi_align(data, rois, pooled_size, spatial_scale=1.0,
+               sampling_ratio=2):
+    """RROIAlign: rois (R, 6) = [batch_idx, cx, cy, w, h, theta_deg];
+    bins are sampled on a grid rotated by theta around the ROI center,
+    bilinear-interpolated and averaged (reference: rroi_align.cc:161)."""
+    ph, pw = (pooled_size if isinstance(pooled_size, (tuple, list))
+              else (pooled_size, pooled_size))
+    s = max(int(sampling_ratio), 1)
+
+    def pure(feat, boxes):
+        N, C, H, W = feat.shape
+
+        def one(roi):
+            bidx = roi[0].astype(jnp.int32)
+            cx, cy = roi[1] * spatial_scale, roi[2] * spatial_scale
+            rw = jnp.maximum(roi[3] * spatial_scale, 1.0)
+            rh = jnp.maximum(roi[4] * spatial_scale, 1.0)
+            theta = roi[5] * jnp.pi / 180.0
+            cos_t, sin_t = jnp.cos(theta), jnp.sin(theta)
+            # unrotated local sample coords in [-w/2, w/2] x [-h/2, h/2]
+            xs = (jnp.arange(pw * s) + 0.5) / (pw * s) * rw - rw / 2
+            ys = (jnp.arange(ph * s) + 0.5) / (ph * s) * rh - rh / 2
+            lx = xs[None, :]
+            ly = ys[:, None]
+            # rotate by theta around the center and translate
+            gx = cx + lx * cos_t - ly * sin_t     # (ph*s, pw*s)
+            gy = cy + lx * sin_t + ly * cos_t
+            x0 = jnp.clip(jnp.floor(gx).astype(jnp.int32), 0, W - 1)
+            y0 = jnp.clip(jnp.floor(gy).astype(jnp.int32), 0, H - 1)
+            x1 = jnp.minimum(x0 + 1, W - 1)
+            y1 = jnp.minimum(y0 + 1, H - 1)
+            wx = jnp.clip(gx - x0, 0.0, 1.0)
+            wy = jnp.clip(gy - y0, 0.0, 1.0)
+            img = feat[bidx]
+            v = (img[:, y0, x0] * (1 - wy) * (1 - wx)
+                 + img[:, y1, x0] * wy * (1 - wx)
+                 + img[:, y0, x1] * (1 - wy) * wx
+                 + img[:, y1, x1] * wy * wx)
+            ok = ((gx >= -1.0) & (gx <= W) & (gy >= -1.0) & (gy <= H))
+            v = jnp.where(ok[None], v, 0.0)
+            v = v.reshape(C, ph, s, pw, s)
+            return v.mean(axis=(2, 4))
+
+        return jax.vmap(one)(boxes)
+
+    return apply_op(pure, data, rois, name="rroi_align")
+
+
+# --- Mask R-CNN mask targets (reference: mrcnn_mask_target-inl.h) ----------
+
+def mrcnn_mask_target(rois, gt_masks, matches, cls_targets, num_rois=None,
+                      num_classes=2, mask_size=(14, 14), sample_ratio=2,
+                      aligned=False):  # noqa: ARG001
+    """Crop each ROI's matched ground-truth mask to mask_size via ROI
+    align and scatter it into the class channel; returns (mask_targets,
+    mask_cls) of shape (B, N, num_classes, mh, mw).
+
+    rois (B, N, 4) corner boxes; gt_masks (B, M, H, W); matches (B, N)
+    gt indices; cls_targets (B, N) class ids (0 = background)."""
+    mh, mw = (mask_size if isinstance(mask_size, (tuple, list))
+              else (mask_size, mask_size))
+
+    def pure(r, gm, mt, ct):
+        B, N, _ = r.shape
+        M = gm.shape[1]
+
+        def per_image(rb, gmb, mtb, ctb):
+            # select each roi's matched mask: (N, H, W)
+            sel = gmb[mtb.astype(jnp.int32).clip(0, M - 1)]
+            # roi-align each mask crop to (mh, mw) with a unit batch
+            roi5 = jnp.concatenate(
+                [jnp.arange(N, dtype=rb.dtype)[:, None], rb], axis=1)
+            crop = _roi_align_pure(sel[:, None], roi5, (mh, mw))
+            crop = crop[:, 0]                        # (N, mh, mw)
+            cls = ctb.astype(jnp.int32).clip(0, num_classes - 1)
+            onehot = jax.nn.one_hot(cls, num_classes, dtype=crop.dtype)
+            targets = onehot[:, :, None, None] * crop[:, None]
+            weights = onehot[:, :, None, None] * jnp.ones_like(
+                crop[:, None]) * (ctb > 0)[:, None, None, None]
+            return targets, weights
+
+        return jax.vmap(per_image)(r, gm, mt, ct)
+
+    def _roi_align_pure(feat, boxes, pooled):
+        # feat (N, 1, H, W) with per-roi batch idx in boxes[:, 0]
+        H, W = feat.shape[-2:]
+        phh, pww = pooled
+
+        def one(roi):
+            bidx = roi[0].astype(jnp.int32)
+            x1, y1, x2, y2 = roi[1:]
+            bw = jnp.maximum(x2 - x1, 1.0) / pww
+            bh = jnp.maximum(y2 - y1, 1.0) / phh
+            ys = y1 + (jnp.arange(phh) + 0.5) * bh
+            xs = x1 + (jnp.arange(pww) + 0.5) * bw
+            y0 = jnp.clip(jnp.floor(ys).astype(jnp.int32), 0, H - 1)
+            x0 = jnp.clip(jnp.floor(xs).astype(jnp.int32), 0, W - 1)
+            y1i = jnp.minimum(y0 + 1, H - 1)
+            x1i = jnp.minimum(x0 + 1, W - 1)
+            wy = jnp.clip(ys - y0, 0.0, 1.0)[:, None]
+            wx = jnp.clip(xs - x0, 0.0, 1.0)[None, :]
+            img = feat[bidx]
+            v = (img[:, y0][:, :, x0] * (1 - wy) * (1 - wx)
+                 + img[:, y1i][:, :, x0] * wy * (1 - wx)
+                 + img[:, y0][:, :, x1i] * (1 - wy) * wx
+                 + img[:, y1i][:, :, x1i] * wy * wx)
+            return v
+
+        return jax.vmap(one)(boxes)
+
+    return apply_op(pure, rois, gt_masks, matches, cls_targets,
+                    name="mrcnn_mask_target")
+
+
+RROIAlign = rroi_align
+__all__ += ["rroi_align", "RROIAlign", "mrcnn_mask_target"]

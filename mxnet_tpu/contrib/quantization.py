@@ -407,3 +407,194 @@ def quantize_net(network, calib_data=None, calib_mode="naive",
         object.__setattr__(parent, name, q)
     network._clear_cached()
     return network
+
+
+# --- quantized compute ops (reference: src/operator/quantization/
+# quantized_*.cc). Each takes int8 data + (min, max) ranges, computes in
+# the dequantized domain, and re-quantizes — on TPU the int8 dot itself
+# rides the MXU via preferred_element_type (see QuantizedDense); the
+# elementwise members below are range-bookkeeping around XLA ops. --------
+
+def _deq(x, lo, hi):
+    scale = jnp.maximum(jnp.abs(lo), jnp.abs(hi)) / INT8_MAX
+    return x.astype(jnp.float32) * scale
+
+
+def _req(x):
+    lo, hi = jnp.min(x), jnp.max(x)
+    qd, scale = _q(x, lo, hi)
+    amax = INT8_MAX / scale
+    return qd, -amax, amax
+
+
+def _quantized_unary(name, fn):
+    def op(data, min_data, max_data, **kwargs):
+        def pure(x, lo, hi):
+            return _req(fn(_deq(x, lo, hi), **kwargs))
+
+        return apply_op(pure, *_as_nd(data, min_data, max_data),
+                        name=name)
+
+    op.__name__ = name
+    return op
+
+
+quantized_act = _quantized_unary(
+    "quantized_act", lambda x, act_type="relu": {
+        "relu": jnp.maximum(x, 0), "sigmoid": jax.nn.sigmoid(x),
+        "tanh": jnp.tanh(x), "softrelu": jnp.log1p(jnp.exp(x)),
+    }[act_type] if isinstance(act_type, str) else x)
+def quantized_flatten(data, min_data, max_data):
+    """Pure reshape — int8 codes and ranges pass through unchanged
+    (reference: quantized_flatten.cc forwards min/max untouched)."""
+    def pure(x, lo, hi):
+        return x.reshape(x.shape[0], -1), lo, hi
+
+    return apply_op(pure, *_as_nd(data, min_data, max_data),
+                    name="quantized_flatten")
+
+
+def quantized_pooling(data, min_data, max_data, kernel=(2, 2),
+                      pool_type="max", stride=None, pad=None,
+                      global_pool=False, **kwargs):  # noqa: ARG001
+    """int8 pooling (reference: quantized_pooling.cc)."""
+    from ..ops.registry import get_op
+
+    pool = get_op("pooling")
+
+    def pure(x, lo, hi):
+        out = pool(_deq(x, lo, hi), kernel=kernel, pool_type=pool_type,
+                   stride=stride, pad=pad, global_pool=global_pool)
+        return _req(out)
+
+    return apply_op(pure, *_as_nd(data, min_data, max_data),
+                    name="quantized_pooling")
+
+
+def quantized_elemwise_add(lhs, rhs, lhs_min, lhs_max, rhs_min, rhs_max):
+    """int8 add with range tracking (reference:
+    quantized_elemwise_add.cc)."""
+    def pure(a, b, alo, ahi, blo, bhi):
+        return _req(_deq(a, alo, ahi) + _deq(b, blo, bhi))
+
+    return apply_op(pure, *_as_nd(lhs, rhs, lhs_min, lhs_max, rhs_min,
+                                  rhs_max),
+                    name="quantized_elemwise_add")
+
+
+def quantized_elemwise_mul(lhs, rhs, lhs_min, lhs_max, rhs_min, rhs_max):
+    def pure(a, b, alo, ahi, blo, bhi):
+        return _req(_deq(a, alo, ahi) * _deq(b, blo, bhi))
+
+    return apply_op(pure, *_as_nd(lhs, rhs, lhs_min, lhs_max, rhs_min,
+                                  rhs_max),
+                    name="quantized_elemwise_mul")
+
+
+def quantized_concat(*args, dim=1, num_args=None):  # noqa: ARG001
+    """Concat n int8 inputs: args = [d0..dn-1, min0, max0, ... ] in the
+    reference's layout (data list then interleaved ranges)."""
+    n = len(args) // 3
+    datas, ranges = args[:n], args[n:]
+
+    def pure(*xs):
+        ds, rs = xs[:n], xs[n:]
+        outs = [_deq(d, rs[2 * i], rs[2 * i + 1])
+                for i, d in enumerate(ds)]
+        return _req(jnp.concatenate(outs, axis=dim))
+
+    return apply_op(pure, *_as_nd(*datas, *ranges),
+                    name="quantized_concat")
+
+
+def quantized_embedding(data, weight, min_weight, max_weight,
+                        input_dim=None, output_dim=None, **kwargs):  # noqa: ARG001
+    """int8 embedding lookup (reference: quantized_embedding.cc)."""
+    def pure(idx, w, lo, hi):
+        return _req(_deq(w, lo, hi)[idx.astype(jnp.int32)])
+
+    return apply_op(pure, *_as_nd(data, weight, min_weight, max_weight),
+                    name="quantized_embedding")
+
+
+def quantized_batch_norm(data, gamma, beta, moving_mean, moving_var,
+                         min_data, max_data, eps=1e-3, **kwargs):  # noqa: ARG001
+    """int8 inference BatchNorm (reference: quantized_batch_norm.cc)."""
+    def pure(x, g, b, mm, mv, lo, hi):
+        xf = _deq(x, lo, hi)
+        shape = (1, -1) + (1,) * (xf.ndim - 2)
+        out = (xf - mm.reshape(shape)) / jnp.sqrt(
+            mv.reshape(shape) + eps) * g.reshape(shape) \
+            + b.reshape(shape)
+        return _req(out)
+
+    return apply_op(pure, *_as_nd(data, gamma, beta, moving_mean,
+                                  moving_var, min_data, max_data),
+                    name="quantized_batch_norm")
+
+
+def quantized_conv(data, weight, bias, min_data, max_data, min_weight,
+                   max_weight, min_bias=None, max_bias=None,
+                   kernel=None, stride=(1, 1), pad=(0, 0), dilate=(1, 1),
+                   num_filter=0, num_group=1, no_bias=False, **kwargs):  # noqa: ARG001
+    """int8 convolution: int8 x int8 -> int32 accumulation on the MXU
+    (preferred_element_type), rescaled to the fp range product
+    (reference: quantized_conv.cc)."""
+    def pure(*xs):
+        x, w = xs[0], xs[1]
+        i = 2
+        b = None
+        if not no_bias:
+            b = xs[i]; i += 1
+        dlo, dhi, wlo, whi = xs[i:i + 4]
+        acc = jax.lax.conv_general_dilated(
+            x.astype(jnp.int8), w.astype(jnp.int8), stride,
+            [(p, p) for p in pad], rhs_dilation=dilate,
+            dimension_numbers=("NCHW", "OIHW", "NCHW"),
+            feature_group_count=num_group,
+            preferred_element_type=jnp.int32)
+        dscale = jnp.maximum(jnp.abs(dlo), jnp.abs(dhi)) / INT8_MAX
+        wscale = jnp.maximum(jnp.abs(wlo), jnp.abs(whi)) / INT8_MAX
+        out = acc.astype(jnp.float32) * (dscale * wscale)
+        if b is not None:
+            blo, bhi = xs[i + 4], xs[i + 5]
+            out = out + _deq(b, blo, bhi).reshape(1, -1, 1, 1)
+        return _req(out)
+
+    args = [data, weight] + ([] if no_bias else [bias]) + \
+        [min_data, max_data, min_weight, max_weight] + \
+        ([] if no_bias else [min_bias, max_bias])
+    return apply_op(pure, *_as_nd(*args), name="quantized_conv")
+
+
+def quantized_fully_connected(data, weight, bias, min_data, max_data,
+                              min_weight, max_weight, min_bias=None,
+                              max_bias=None, num_hidden=0, no_bias=False,
+                              flatten=True, **kwargs):  # noqa: ARG001
+    """int8 dense: int8 x int8 -> int32 on the MXU (reference:
+    quantized_fully_connected.cc)."""
+    def pure(*xs):
+        x, w = xs[0], xs[1]
+        i = 2
+        b = None
+        if not no_bias:
+            b = xs[i]; i += 1
+        dlo, dhi, wlo, whi = xs[i:i + 4]
+        xm = x.reshape(x.shape[0], -1) if flatten else x
+        acc = jax.lax.dot_general(
+            xm.astype(jnp.int8), w.astype(jnp.int8),
+            (((xm.ndim - 1,), (1,)), ((), ())),
+            preferred_element_type=jnp.int32)
+        dscale = jnp.maximum(jnp.abs(dlo), jnp.abs(dhi)) / INT8_MAX
+        wscale = jnp.maximum(jnp.abs(wlo), jnp.abs(whi)) / INT8_MAX
+        out = acc.astype(jnp.float32) * (dscale * wscale)
+        if b is not None:
+            blo, bhi = xs[i + 4], xs[i + 5]
+            out = out + _deq(b, blo, bhi)
+        return _req(out)
+
+    args = [data, weight] + ([] if no_bias else [bias]) + \
+        [min_data, max_data, min_weight, max_weight] + \
+        ([] if no_bias else [min_bias, max_bias])
+    return apply_op(pure, *_as_nd(*args),
+                    name="quantized_fully_connected")

@@ -1,2 +1,3 @@
 """Gluon contrib (reference: python/mxnet/gluon/contrib/)."""
+from . import data  # noqa: F401
 from . import estimator  # noqa: F401

@@ -1,0 +1,2 @@
+"""Contrib data utilities (reference: gluon/contrib/data/)."""
+from . import vision  # noqa: F401

@@ -1,0 +1,3 @@
+"""Contrib vision data utilities (reference: gluon/contrib/data/vision/)."""
+from . import transforms  # noqa: F401
+from .dataloader import ImageBboxDataLoader, ImageDataLoader  # noqa: F401

@@ -1,0 +1,201 @@
+"""Prebuilt image / detection DataLoaders (reference:
+gluon/contrib/data/vision/dataloader.py — create_image_augment,
+ImageDataLoader, create_bbox_augment, ImageBboxDataLoader).
+
+The reference wraps ImageRecord/list datasets with a C++-backed augment
+chain; here the augment chains compose the python transform Blocks (the
+decode stays in the dataset, the tensor work in XLA)."""
+from __future__ import annotations
+
+import numpy as _np
+
+from mxnet_tpu import numpy as _mxnp
+from mxnet_tpu.gluon.block import Block
+from mxnet_tpu.gluon.data.dataloader import DataLoader
+from mxnet_tpu.gluon.data.dataset import Dataset
+from mxnet_tpu.gluon.data.vision import transforms as T
+from .transforms.bbox import (
+    ImageBboxRandomCropWithConstraints,
+    ImageBboxRandomExpand,
+    ImageBboxRandomFlipLeftRight,
+    ImageBboxResize,
+)
+
+__all__ = ["create_image_augment", "ImageDataLoader",
+           "create_bbox_augment", "ImageBboxDataLoader"]
+
+
+def create_image_augment(data_shape, resize=0, rand_crop=False,
+                         rand_resize=False, rand_mirror=False, mean=None,
+                         std=None, brightness=0, contrast=0, saturation=0,
+                         hue=0, pca_noise=0, rand_gray=0, inter_method=2,
+                         dtype="float32"):  # noqa: ARG001
+    """Compose a classification augment chain (reference:
+    dataloader.py:34). Returns a transform Block for (H, W, C) uint8."""
+    chain = []
+    if resize > 0:
+        chain.append(T.Resize(resize))
+    crop_size = (data_shape[2], data_shape[1])
+    if rand_resize:
+        chain.append(T.RandomResizedCrop(crop_size))
+    elif rand_crop:
+        chain.append(T.RandomCrop(crop_size))
+    else:
+        chain.append(T.CenterCrop(crop_size))
+    if rand_mirror:
+        chain.append(T.RandomFlipLeftRight())
+    if brightness:
+        chain.append(T.RandomBrightness(brightness))
+    if contrast:
+        chain.append(T.RandomContrast(contrast))
+    if saturation:
+        chain.append(T.RandomSaturation(saturation))
+    if pca_noise:
+        chain.append(T.RandomLighting(pca_noise))
+    chain.append(T.ToTensor())
+    if mean is not None or std is not None:
+        chain.append(T.Normalize(
+            mean if mean is not None else 0.0,
+            std if std is not None else 1.0))
+    return T.Compose(chain)
+
+
+class _ListDataset(Dataset):
+    """(image, label) pairs from arrays/paths, with a transform applied
+    to the image."""
+
+    def __init__(self, samples, transform=None, pair_transform=None):
+        self._samples = samples
+        self._transform = transform
+        self._pair_transform = pair_transform
+
+    def __len__(self):
+        return len(self._samples)
+
+    def __getitem__(self, idx):
+        img, label = self._samples[idx]
+        if not hasattr(img, "asnumpy"):
+            img = _mxnp.array(_np.asarray(img))
+        if self._pair_transform is not None:
+            img, label = self._pair_transform(img, label)
+        if self._transform is not None:
+            img = self._transform(img)
+        return img, label
+
+
+class ImageDataLoader:
+    """Classification loader over an image dataset (reference:
+    dataloader.py:140). Accepts a Dataset of (image, label) or an
+    explicit `dataset=`; augment via `aug_list` or the create_image_
+    augment kwargs."""
+
+    def __init__(self, batch_size, data_shape, dataset=None, aug_list=None,
+                 shuffle=False, num_workers=0, last_batch="keep",
+                 **augment_kwargs):
+        if dataset is None:
+            raise ValueError("dataset is required (record-file datasets: "
+                             "use io.ImageRecordIter)")
+        if aug_list is None:
+            aug_list = create_image_augment(data_shape, **augment_kwargs)
+        elif isinstance(aug_list, (list, tuple)):
+            aug_list = T.Compose(list(aug_list))
+        ds = _ListDataset(dataset, transform=aug_list)
+        self._loader = DataLoader(ds, batch_size=batch_size,
+                                  shuffle=shuffle,
+                                  num_workers=num_workers,
+                                  last_batch=last_batch)
+
+    def __iter__(self):
+        return iter(self._loader)
+
+    def __len__(self):
+        return len(self._loader)
+
+
+def create_bbox_augment(data_shape, rand_crop=0, rand_pad=0, rand_gray=0,
+                        rand_mirror=False, mean=None, std=None,
+                        brightness=0, contrast=0, saturation=0,
+                        pca_noise=0, hue=0, inter_method=2,
+                        max_aspect_ratio=2, area_range=(0.3, 3.0),
+                        max_attempts=50, pad_val=(127, 127, 127)):  # noqa: ARG001
+    """Compose a detection augment chain operating on (img, bbox) pairs
+    (reference: dataloader.py:246)."""
+    pair = []
+    if rand_crop > 0:
+        pair.append(ImageBboxRandomCropWithConstraints(
+            p=rand_crop, min_scale=area_range[0],
+            max_scale=min(1.0, area_range[1]),
+            max_aspect_ratio=max_aspect_ratio, max_trial=max_attempts))
+    if rand_pad > 0:
+        pair.append(ImageBboxRandomExpand(
+            p=rand_pad, max_ratio=area_range[1],
+            fill=pad_val[0] if isinstance(pad_val, (tuple, list))
+            else pad_val))
+    if rand_mirror:
+        pair.append(ImageBboxRandomFlipLeftRight(0.5))
+    pair.append(ImageBboxResize(data_shape[2], data_shape[1]))
+
+    class _Chain(Block):
+        def forward(self, img, bbox):
+            for t in pair:
+                img, bbox = t(img, bbox)
+            return img, bbox
+
+    return _Chain()
+
+
+class ImageBboxDataLoader:
+    """Detection loader yielding (images, padded bboxes) (reference:
+    dataloader.py:364). `dataset`: sequence of (image, bbox (N, 4+))."""
+
+    def __init__(self, batch_size, data_shape, dataset=None, aug_list=None,
+                 shuffle=False, num_workers=0, last_batch="keep",
+                 coord_normalized=True, **augment_kwargs):
+        if dataset is None:
+            raise ValueError("dataset is required")
+        if aug_list is None:
+            aug_list = create_bbox_augment(data_shape, **augment_kwargs)
+        self._coord_normalized = coord_normalized
+        post = self._normalize if coord_normalized else None
+        ds = _ListDataset(dataset, pair_transform=aug_list)
+        self._loader = DataLoader(
+            ds, batch_size=batch_size, shuffle=shuffle,
+            num_workers=num_workers, last_batch=last_batch,
+            batchify_fn=self._batchify)
+        self._post = post
+
+    @staticmethod
+    def _normalize(img, bbox):
+        arr = bbox.asnumpy() if hasattr(bbox, "asnumpy") else \
+            _np.asarray(bbox)
+        h, w = (img.shape[0], img.shape[1])
+        arr = _np.array(arr, dtype=_np.float64, copy=True)
+        arr[:, 0] /= w
+        arr[:, 2] /= w
+        arr[:, 1] /= h
+        arr[:, 3] /= h
+        return arr
+
+    def _batchify(self, samples):
+        """Pad per-image bboxes to the batch max with -1 rows (the
+        reference's detection batchify)."""
+        imgs, bboxes = zip(*samples)
+        arrs = [b.asnumpy() if hasattr(b, "asnumpy") else _np.asarray(b)
+                for b in bboxes]
+        if self._coord_normalized:
+            arrs = [self._normalize(i, b) for i, b in zip(imgs, arrs)]
+        maxn = max(len(b) for b in arrs)
+        width = max(a.shape[1] for a in arrs)
+        padded = _np.full((len(arrs), maxn, width), -1.0, _np.float32)
+        for i, b in enumerate(arrs):
+            if len(b):
+                padded[i, :len(b), :b.shape[1]] = b
+        imgs = _np.stack([i.asnumpy() if hasattr(i, "asnumpy")
+                          else _np.asarray(i) for i in imgs])
+        return _mxnp.array(imgs), _mxnp.array(padded)
+
+    def __iter__(self):
+        return iter(self._loader)
+
+    def __len__(self):
+        return len(self._loader)

@@ -1,0 +1,2 @@
+"""Contrib vision transforms (reference: .../vision/transforms/)."""
+from . import bbox  # noqa: F401

@@ -1,0 +1,9 @@
+"""Image+bbox joint transforms (reference: .../transforms/bbox/)."""
+from . import utils  # noqa: F401
+from .bbox import (  # noqa: F401
+    ImageBboxCrop,
+    ImageBboxRandomCropWithConstraints,
+    ImageBboxRandomExpand,
+    ImageBboxRandomFlipLeftRight,
+    ImageBboxResize,
+)

@@ -1,0 +1,129 @@
+"""Joint image+bbox transform blocks (reference:
+gluon/contrib/data/vision/transforms/bbox/bbox.py). Each takes
+(image HWC, bbox (N, 4+)) and returns the transformed pair — the
+detection-pipeline analogs of the classification transforms."""
+from __future__ import annotations
+
+import random as _pyrandom
+
+import numpy as _np
+
+from mxnet_tpu import numpy as _mxnp
+from mxnet_tpu.gluon.block import Block
+from mxnet_tpu.image.image import imresize
+
+from . import utils
+
+__all__ = ["ImageBboxRandomFlipLeftRight", "ImageBboxCrop",
+           "ImageBboxRandomCropWithConstraints", "ImageBboxRandomExpand",
+           "ImageBboxResize"]
+
+
+def _img_np(img):
+    return img.asnumpy() if hasattr(img, "asnumpy") else _np.asarray(img)
+
+
+class ImageBboxRandomFlipLeftRight(Block):
+    """Flip image + boxes horizontally with probability p (reference:
+    bbox.py:34)."""
+
+    def __init__(self, p=0.5):
+        super().__init__()
+        self.p = p
+
+    def forward(self, img, bbox):
+        if _pyrandom.random() < self.p:
+            arr = _img_np(img)[:, ::-1]
+            bbox = utils.bbox_flip(bbox, (arr.shape[1], arr.shape[0]),
+                                   flip_x=True)
+            return _mxnp.array(arr.copy()), _mxnp.array(bbox)
+        return (img if hasattr(img, "asnumpy") else _mxnp.array(img),
+                _mxnp.array(utils._as_np(bbox)))
+
+
+class ImageBboxCrop(Block):
+    """Crop a fixed (x, y, w, h) region from image + boxes (reference:
+    bbox.py:90)."""
+
+    def __init__(self, crop_box, allow_outside_center=False):
+        super().__init__()
+        self._crop = crop_box
+        self._allow = allow_outside_center
+
+    def forward(self, img, bbox):
+        x, y, w, h = self._crop
+        arr = _img_np(img)[y:y + h, x:x + w]
+        new_bbox = utils.bbox_crop(bbox, self._crop, self._allow)
+        return _mxnp.array(arr.copy()), _mxnp.array(new_bbox)
+
+
+class ImageBboxRandomCropWithConstraints(Block):
+    """SSD random crop with min-IoU constraints (reference: bbox.py:146)."""
+
+    def __init__(self, p=0.5, min_scale=0.3, max_scale=1,
+                 max_aspect_ratio=2, constraints=None, max_trial=50):
+        super().__init__()
+        self.p = p
+        self._kwargs = dict(min_scale=min_scale, max_scale=max_scale,
+                            max_aspect_ratio=max_aspect_ratio,
+                            constraints=constraints, max_trial=max_trial)
+
+    def forward(self, img, bbox):
+        if _pyrandom.random() > self.p:
+            return (img if hasattr(img, "asnumpy") else _mxnp.array(img),
+                    _mxnp.array(utils._as_np(bbox)))
+        arr = _img_np(img)
+        h, w = arr.shape[:2]
+        new_bbox, crop = utils.bbox_random_crop_with_constraints(
+            bbox, (w, h), **self._kwargs)
+        x, y, cw, ch = (int(v) for v in crop)
+        return (_mxnp.array(arr[y:y + ch, x:x + cw].copy()),
+                _mxnp.array(new_bbox))
+
+
+class ImageBboxRandomExpand(Block):
+    """Place the image on a larger canvas (mean-filled) and translate the
+    boxes — the SSD zoom-out augmentation (reference: bbox.py:216)."""
+
+    def __init__(self, p=0.5, max_ratio=4, fill=0, keep_ratio=True):
+        super().__init__()
+        self.p = p
+        self._max_ratio = max_ratio
+        self._fill = fill
+        self._keep_ratio = keep_ratio
+
+    def forward(self, img, bbox):
+        if self._max_ratio <= 1 or _pyrandom.random() > self.p:
+            return (img if hasattr(img, "asnumpy") else _mxnp.array(img),
+                    _mxnp.array(utils._as_np(bbox)))
+        arr = _img_np(img)
+        h, w, c = arr.shape
+        rx = _pyrandom.uniform(1, self._max_ratio)
+        ry = rx if self._keep_ratio else _pyrandom.uniform(
+            1, self._max_ratio)
+        oh, ow = int(h * ry), int(w * rx)
+        off_y = _pyrandom.randrange(oh - h + 1)
+        off_x = _pyrandom.randrange(ow - w + 1)
+        canvas = _np.full((oh, ow, c), self._fill, arr.dtype)
+        canvas[off_y:off_y + h, off_x:off_x + w] = arr
+        new_bbox = utils.bbox_translate(bbox, off_x, off_y)
+        return _mxnp.array(canvas), _mxnp.array(new_bbox)
+
+
+class ImageBboxResize(Block):
+    """Resize the image to (width, height) and rescale boxes (reference:
+    bbox.py:297)."""
+
+    def __init__(self, width, height, interp=1):
+        super().__init__()
+        self._size = (int(width), int(height))
+        self._interp = interp
+
+    def forward(self, img, bbox):
+        arr = _img_np(img)
+        h, w = arr.shape[:2]
+        resized = imresize(
+            img if hasattr(img, "asnumpy") else _mxnp.array(img),
+            self._size[0], self._size[1], interp=self._interp)
+        new_bbox = utils.bbox_resize(bbox, (w, h), self._size)
+        return resized, _mxnp.array(new_bbox)

@@ -1,0 +1,194 @@
+"""Bounding-box geometry helpers (reference:
+gluon/contrib/data/vision/transforms/bbox/utils.py). Boxes are numpy
+(N, 4+) xyxy unless stated; extra columns (class ids) pass through."""
+from __future__ import annotations
+
+import random as _pyrandom
+
+import numpy as _np
+
+__all__ = ["bbox_crop", "bbox_flip", "bbox_resize", "bbox_translate",
+           "bbox_iou", "bbox_xywh_to_xyxy", "bbox_xyxy_to_xywh",
+           "bbox_clip_xyxy", "bbox_random_crop_with_constraints"]
+
+
+def _as_np(bbox):
+    arr = bbox.asnumpy() if hasattr(bbox, "asnumpy") else _np.asarray(bbox)
+    if arr.ndim != 2 or arr.shape[1] < 4:
+        raise ValueError(
+            f"bbox must be (N, >=4), got {arr.shape}")
+    return _np.array(arr, dtype=_np.float64, copy=True)
+
+
+def bbox_crop(bbox, crop_box=None, allow_outside_center=True):
+    """Crop boxes to `crop_box` (x, y, w, h); boxes fully outside (or with
+    center outside when disallowed) are dropped (reference: utils.py:30)."""
+    bbox = _as_np(bbox)
+    if crop_box is None:
+        return bbox
+    if sum(x is None for x in crop_box) == 4:
+        return bbox
+    l, t, w, h = (0 if v is None else float(v) for v in crop_box)
+    r = l + (w if w else _np.inf)
+    b = t + (h if h else _np.inf)
+    out = bbox.copy()
+    out[:, 0] = _np.clip(bbox[:, 0], l, r) - l
+    out[:, 1] = _np.clip(bbox[:, 1], t, b) - t
+    out[:, 2] = _np.clip(bbox[:, 2], l, r) - l
+    out[:, 3] = _np.clip(bbox[:, 3], t, b) - t
+    if allow_outside_center:
+        mask = _np.ones(len(out), bool)
+    else:
+        cx = (bbox[:, 0] + bbox[:, 2]) / 2
+        cy = (bbox[:, 1] + bbox[:, 3]) / 2
+        mask = (cx >= l) & (cx <= r) & (cy >= t) & (cy <= b)
+    mask &= (out[:, 2] > out[:, 0]) & (out[:, 3] > out[:, 1])
+    return out[mask]
+
+
+def bbox_flip(bbox, size, flip_x=False, flip_y=False):
+    """Flip boxes inside a (width, height) canvas (reference:
+    utils.py:85)."""
+    bbox = _as_np(bbox)
+    w, h = size
+    if flip_x:
+        x1 = w - bbox[:, 2]
+        x2 = w - bbox[:, 0]
+        bbox[:, 0], bbox[:, 2] = x1, x2
+    if flip_y:
+        y1 = h - bbox[:, 3]
+        y2 = h - bbox[:, 1]
+        bbox[:, 1], bbox[:, 3] = y1, y2
+    return bbox
+
+
+def bbox_resize(bbox, in_size, out_size):
+    """Rescale boxes from in_size (w, h) to out_size (reference:
+    utils.py:124)."""
+    bbox = _as_np(bbox)
+    sx = out_size[0] / in_size[0]
+    sy = out_size[1] / in_size[1]
+    bbox[:, 0] *= sx
+    bbox[:, 2] *= sx
+    bbox[:, 1] *= sy
+    bbox[:, 3] *= sy
+    return bbox
+
+
+def bbox_translate(bbox, x_offset=0, y_offset=0):
+    """Shift boxes (reference: utils.py:159)."""
+    bbox = _as_np(bbox)
+    bbox[:, 0] += x_offset
+    bbox[:, 2] += x_offset
+    bbox[:, 1] += y_offset
+    bbox[:, 3] += y_offset
+    return bbox
+
+
+def bbox_iou(bbox_a, bbox_b, offset=0):
+    """Pairwise IoU matrix (reference: utils.py:185)."""
+    a = _as_np(bbox_a)
+    b = _as_np(bbox_b)
+    tl = _np.maximum(a[:, None, :2], b[None, :, :2])
+    br = _np.minimum(a[:, None, 2:4], b[None, :, 2:4])
+    inter = _np.prod(_np.clip(br - tl + offset, 0, None), axis=2) * \
+        (tl < br).all(axis=2)
+    area_a = _np.prod(a[:, 2:4] - a[:, :2] + offset, axis=1)
+    area_b = _np.prod(b[:, 2:4] - b[:, :2] + offset, axis=1)
+    union = area_a[:, None] + area_b[None, :] - inter
+    return _np.where(union > 0, inter / union, 0.0)
+
+
+def bbox_xywh_to_xyxy(xywh):
+    """(x, y, w, h) -> (x1, y1, x2, y2); tuple in, tuple out
+    (reference: utils.py:218)."""
+    if isinstance(xywh, (tuple, list)):
+        if len(xywh) != 4:
+            raise IndexError(f"expected length 4, got {len(xywh)}")
+        x, y, w, h = xywh
+        return (x, y, x + _np.maximum(0, w - 1),
+                y + _np.maximum(0, h - 1))
+    arr = _np.array(xywh, dtype=_np.float64, copy=True)
+    arr[:, 2] = arr[:, 0] + _np.maximum(0, arr[:, 2] - 1)
+    arr[:, 3] = arr[:, 1] + _np.maximum(0, arr[:, 3] - 1)
+    return arr
+
+
+def bbox_xyxy_to_xywh(xyxy):
+    """(x1, y1, x2, y2) -> (x, y, w, h) (reference: utils.py:252)."""
+    if isinstance(xyxy, (tuple, list)):
+        if len(xyxy) != 4:
+            raise IndexError(f"expected length 4, got {len(xyxy)}")
+        x1, y1, x2, y2 = xyxy
+        return (x1, y1, x2 - x1 + 1, y2 - y1 + 1)
+    arr = _np.array(xyxy, dtype=_np.float64, copy=True)
+    arr[:, 2] = arr[:, 2] - arr[:, 0] + 1
+    arr[:, 3] = arr[:, 3] - arr[:, 1] + 1
+    return arr
+
+
+def bbox_clip_xyxy(xyxy, width, height):
+    """Clip boxes to image bounds (reference: utils.py:286)."""
+    if isinstance(xyxy, (tuple, list)):
+        if len(xyxy) != 4:
+            raise IndexError(f"expected length 4, got {len(xyxy)}")
+        x1 = _np.minimum(width - 1, _np.maximum(0, xyxy[0]))
+        y1 = _np.minimum(height - 1, _np.maximum(0, xyxy[1]))
+        x2 = _np.minimum(width - 1, _np.maximum(0, xyxy[2]))
+        y2 = _np.minimum(height - 1, _np.maximum(0, xyxy[3]))
+        return (x1, y1, x2, y2)
+    arr = _np.array(xyxy, dtype=_np.float64, copy=True)
+    arr[:, 0] = _np.clip(arr[:, 0], 0, width - 1)
+    arr[:, 1] = _np.clip(arr[:, 1], 0, height - 1)
+    arr[:, 2] = _np.clip(arr[:, 2], 0, width - 1)
+    arr[:, 3] = _np.clip(arr[:, 3], 0, height - 1)
+    return arr
+
+
+def bbox_random_crop_with_constraints(bbox, size, min_scale=0.3,
+                                      max_scale=1, max_aspect_ratio=2,
+                                      constraints=None, max_trial=50):
+    """SSD-style random crop: try crops until one satisfies a min-IoU
+    constraint (reference: utils.py:330). Returns (new_bbox,
+    (x, y, w, h))."""
+    if constraints is None:
+        constraints = ((0.1, None), (0.3, None), (0.5, None),
+                       (0.7, None), (0.9, None), (None, 1))
+    w, h = size
+    bbox = _as_np(bbox)
+    candidates = [(0, 0, w, h)]
+    for min_iou, max_iou in constraints:
+        lo = -_np.inf if min_iou is None else min_iou
+        hi = _np.inf if max_iou is None else max_iou
+        for _ in range(max_trial):
+            scale = _pyrandom.uniform(min_scale, max_scale)
+            aspect = _pyrandom.uniform(
+                max(1 / max_aspect_ratio, scale * scale),
+                min(max_aspect_ratio, 1 / (scale * scale)))
+            crop_h = int(h * scale / _np.sqrt(aspect))
+            crop_w = int(w * scale * _np.sqrt(aspect))
+            if crop_w > w or crop_h > h:
+                continue
+            crop_t = _pyrandom.randrange(h - crop_h + 1)
+            crop_l = _pyrandom.randrange(w - crop_w + 1)
+            crop_bb = _np.array((crop_l, crop_t, crop_l + crop_w,
+                                 crop_t + crop_h))
+            if len(bbox) == 0:
+                top, bottom = crop_t, crop_t + crop_h
+                left, right = crop_l, crop_l + crop_w
+                return bbox, (left, top, right - left, bottom - top)
+            iou = bbox_iou(bbox, crop_bb[None])
+            if lo <= iou.min() and iou.max() <= hi:
+                top, bottom = crop_t, crop_t + crop_h
+                left, right = crop_l, crop_l + crop_w
+                candidates.append((left, top, right - left,
+                                   bottom - top))
+                break
+    # pick a random candidate that keeps at least one box
+    while candidates:
+        crop = candidates.pop(_np.random.randint(0, len(candidates)))
+        new_bbox = bbox_crop(bbox, crop, allow_outside_center=False)
+        if len(new_bbox) < 1:
+            continue
+        return new_bbox, crop
+    return bbox, (0, 0, w, h)

@@ -505,3 +505,54 @@ def batch_flatten(x):
 from ..ndarray.register import populate as _populate  # noqa: E402
 
 _populate(globals())
+
+
+def index_update(data, indices, val):
+    """Functional scatter-set: data with data[indices] replaced by val
+    (reference: _npx_index_update, src/operator/numpy/np_indexing_op.cc).
+    Indices follow npx convention: an int array (N, ndim-prefix) of
+    coordinates, or a plain index array for axis 0."""
+    from ..ndarray.ndarray import apply_op
+
+    def pure(x, idx, v):
+        idx = _jnp.asarray(idx)
+        if idx.ndim == 2:  # coordinate rows
+            return x.at[tuple(idx.T)].set(v)
+        return x.at[idx].set(v)
+
+    return apply_op(pure, data, indices, val, name="index_update")
+
+
+def index_add(data, indices, val):
+    """Functional scatter-add (reference: _npx_index_add)."""
+    from ..ndarray.ndarray import apply_op
+
+    def pure(x, idx, v):
+        idx = _jnp.asarray(idx)
+        if idx.ndim == 2:
+            return x.at[tuple(idx.T)].add(v)
+        return x.at[idx].add(v)
+
+    return apply_op(pure, data, indices, val, name="index_add")
+
+
+def nonzero(data):
+    """Indices of nonzero elements as an (N, ndim) int64 array
+    (reference: _npx_nonzero). Eager: the output size is data-dependent."""
+    arr = data.asnumpy() if hasattr(data, "asnumpy") else _onp.asarray(data)
+    idx = _onp.stack(_onp.nonzero(arr), axis=-1) if arr.ndim else \
+        _onp.zeros((0, 0), _onp.int64)
+    return NDArray(_jnp.asarray(idx.astype(_onp.int64)))
+
+
+def constraint_check(condition, msg="Constraint violated"):
+    """Raise if any element is False, else return 1.0 (reference:
+    _npx_constraint_check — the probability-module validation op)."""
+    arr = condition.asnumpy() if hasattr(condition, "asnumpy") else \
+        _onp.asarray(condition)
+    if not bool(arr.all()):
+        raise ValueError(msg)
+    return NDArray(_jnp.ones((1,), _jnp.float32))
+
+
+__all__ += ["index_update", "index_add", "nonzero", "constraint_check"]

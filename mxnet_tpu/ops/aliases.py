@@ -1,0 +1,523 @@
+"""Reference-internal op-name aliases.
+
+The reference's generated frontends call ops by their NNVM-internal
+spellings — `_npi_add`, `_contrib_box_iou`, `_plus_scalar`, `_image_resize`,
+`mp_sgd_update` (python/mxnet/ndarray/register.py codegen over the 595-name
+registry). Users touch the public spellings, but reference-era extensions,
+exported symbol graphs, and the packed FFI resolve the internal ones; this
+module registers each internal name onto the SAME implementation the public
+spelling uses, so both vocabularies land in one registry.
+
+Skipped on purpose (backend-specific ops with no TPU meaning, not stubs):
+`_sg_onednn_*` (oneDNN subgraph fusions — XLA fuses instead), `_TensorRT`,
+`_FusedOp*` (NVRTC pointwise fusion), `_contrib_tvm_*`, `_contrib_intgemm_*`
+(CPU int8 gemm — XLA int8 dot path is contrib.quantization).
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from .registry import _OPS, register_op
+
+__all__ = ["install_aliases"]
+
+
+def _swap(fn):
+    return lambda a, b, **kw: fn(b, a, **kw)
+
+
+def _install_round1():
+    """Round 1: npi/npx/contrib/image/optimizer internals."""
+    if "_npi_add" in _OPS:
+        return
+
+    from .. import numpy as mxnp
+    from ..contrib import dgl as cdgl
+    from ..contrib import ops as cops
+    from . import nn as _nn  # noqa: F401 - ensures base ops registered
+    from .registry import get_op
+
+    def reg(name, fn):
+        if name not in _OPS and fn is not None:
+            register_op(name, fn)
+
+    def raw(fn):
+        """Unwrap a frontend function into a jax-level callable."""
+        return getattr(fn, "__wrapped__", fn)
+
+    # ---- legacy elemwise/scalar internals (src/operator/tensor/
+    # elemwise_binary_*scalar*.cc) -------------------------------------
+    j = jnp
+    scalar_map = {
+        "_plus_scalar": j.add, "_minus_scalar": j.subtract,
+        "_rminus_scalar": _swap(j.subtract), "_mul_scalar": j.multiply,
+        "_div_scalar": j.divide, "_rdiv_scalar": _swap(j.divide),
+        "_mod_scalar": j.mod, "_rmod_scalar": _swap(j.mod),
+        "_power_scalar": j.power, "_rpower_scalar": _swap(j.power),
+        "_maximum_scalar": j.maximum, "_minimum_scalar": j.minimum,
+        "_hypot_scalar": j.hypot,
+        "_equal_scalar": j.equal, "_not_equal_scalar": j.not_equal,
+        "_greater_scalar": j.greater,
+        "_greater_equal_scalar": j.greater_equal,
+        "_lesser_scalar": j.less, "_lesser_equal_scalar": j.less_equal,
+        "_logical_and_scalar": j.logical_and,
+        "_logical_or_scalar": j.logical_or,
+        "_logical_xor_scalar": j.logical_xor,
+        "_equal": j.equal, "_not_equal": j.not_equal,
+        "_greater": j.greater, "_greater_equal": j.greater_equal,
+        "_lesser": j.less, "_lesser_equal": j.less_equal,
+        "_logical_and": j.logical_and, "_logical_or": j.logical_or,
+        "_logical_xor": j.logical_xor,
+        "_mod": j.mod, "_copy": j.asarray, "_grad_add": j.add,
+        "_eye": j.eye, "_histogram": j.histogram,
+        "_zeros_without_dtype": j.zeros,
+        "_scatter_set_nd": None,  # covered by scatter_nd in registry
+        "_square_sum": lambda x, **kw: j.sum(j.square(x), **kw),
+        "_identity_with_attr_like_rhs": lambda lhs, rhs: lhs,
+        "_np_reshape": lambda x, newshape, **kw: j.reshape(x, newshape),
+        "_split_v2": j.split,
+    }
+    for name, fn in scalar_map.items():
+        reg(name, fn)
+
+    # ---- _npi_* numpy internals (src/operator/numpy/, 139 names) -----
+    npi_direct = [
+        "add", "subtract", "multiply", "true_divide", "mod", "power",
+        "floor_divide", "copysign", "arctan2", "hypot", "ldexp",
+        "bitwise_and", "bitwise_or", "bitwise_xor", "bitwise_not",
+        "bitwise_left_shift", "bitwise_right_shift", "gcd", "lcm",
+        "fmax", "fmin", "fmod", "logaddexp", "all", "any", "arange",
+        "argmax", "argmin", "around", "atleast_1d", "atleast_2d",
+        "atleast_3d", "average", "bincount", "blackman", "hamming",
+        "hanning", "broadcast_to", "column_stack", "copy", "cross",
+        "cumsum", "deg2rad", "rad2deg", "delete", "diag", "diagflat",
+        "diagonal", "diff", "dot", "dsplit", "dstack", "ediff1d",
+        "einsum", "eye", "flip", "full", "full_like", "hsplit",
+        "hstack", "identity", "indices", "interp", "kron", "linspace",
+        "logspace", "log", "matmul", "max", "mean", "min", "moveaxis",
+        "nan_to_num", "ones", "pad", "percentile", "polyval", "prod",
+        "repeat", "roll", "rollaxis", "rot90", "squeeze", "std", "sum",
+        "tensordot", "trace", "transpose", "tri", "tril", "triu",
+        "tril_indices", "unique", "var", "vstack", "where", "zeros",
+        "split",
+    ]
+    for nm in npi_direct:
+        fn = getattr(mxnp, nm, None) or getattr(jnp, nm, None)
+        reg(f"_npi_{nm}", raw(fn) if fn is not None else None)
+    # scalar/reversed-scalar spellings share the tensor implementation
+    for nm, fn in {
+        "add": j.add, "subtract": j.subtract, "multiply": j.multiply,
+        "true_divide": j.divide, "mod": j.mod, "power": j.power,
+        "floor_divide": j.floor_divide, "copysign": j.copysign,
+        "arctan2": j.arctan2, "ldexp": None, "gcd": j.gcd,
+        "lcm": j.lcm, "fmax": j.fmax, "fmin": j.fmin, "fmod": j.fmod,
+        "logaddexp": j.logaddexp, "bitwise_and": j.bitwise_and,
+        "bitwise_or": j.bitwise_or, "bitwise_xor": j.bitwise_xor,
+        "bitwise_left_shift": j.left_shift,
+        "bitwise_right_shift": j.right_shift,
+    }.items():
+        if fn is None:
+            continue
+        reg(f"_npi_{nm}_scalar", fn)
+        reg(f"_npi_r{nm}_scalar", _swap(fn))
+    reg("_npi_rtrue_divide_scalar", _swap(j.divide))
+    reg("_npi_rsubtract_scalar", _swap(j.subtract))
+    reg("_npi_rpower_scalar", _swap(j.power))
+    reg("_npi_rmod_scalar", _swap(j.mod))
+    reg("_npi_rfloor_divide_scalar", _swap(j.floor_divide))
+    reg("_npi_rfmod_scalar", _swap(j.fmod))
+    reg("_npi_rldexp_scalar", None)
+    reg("_npi_rarctan2_scalar", _swap(j.arctan2))
+    reg("_npi_rcopysign_scalar", _swap(j.copysign))
+
+    # linalg (src/operator/numpy/linalg/)
+    la = {
+        "cholesky": jnp.linalg.cholesky, "eig": jnp.linalg.eig,
+        "eigh": jnp.linalg.eigh, "eigvals": jnp.linalg.eigvals,
+        "eigvalsh": jnp.linalg.eigvalsh, "svd": jnp.linalg.svd,
+        "qr": jnp.linalg.qr, "solve": jnp.linalg.solve,
+        "pinv": jnp.linalg.pinv, "lstsq": jnp.linalg.lstsq,
+        "tensorinv": jnp.linalg.tensorinv,
+        "tensorsolve": jnp.linalg.tensorsolve,
+        "matrix_rank": jnp.linalg.matrix_rank, "norm": jnp.linalg.norm,
+    }
+    for nm, fn in la.items():
+        reg(f"_npi_{nm}", fn)
+    reg("_npi_pinv_scalar_rcond", jnp.linalg.pinv)
+    reg("_npi_matrix_rank_none_tol", jnp.linalg.matrix_rank)
+
+    # random (src/operator/numpy/random/): stateful frontend fns
+    rnd = mxnp.random
+    for nm in ("normal", "uniform", "gamma", "exponential", "laplace",
+               "gumbel", "logistic", "pareto", "rayleigh", "weibull",
+               "bernoulli", "choice", "multinomial"):
+        reg(f"_npi_{nm}", getattr(rnd, nm, None))
+    reg("_npi_normal_n", getattr(rnd, "normal", None))
+    reg("_npi_uniform_n", getattr(rnd, "uniform", None))
+    reg("_npi_powerd", getattr(rnd, "power", None))
+
+    # ---- _npx_* extensions -------------------------------------------
+    from .. import numpy_extension as npx
+
+    for nm in ("cond", "foreach", "while_loop", "reshape", "nonzero",
+               "index_add", "index_update", "constraint_check"):
+        reg(f"_npx_{nm}", raw(getattr(npx, nm, None)))
+
+    # ---- _contrib_* --------------------------------------------------
+    contrib_map = {
+        "AdaptiveAvgPooling2D": cops.adaptive_avg_pooling,
+        "BilinearResize2D": cops.bilinear_resize_2d,
+        "BatchNormWithReLU": None,  # layer-level: nn.BatchNormReLU
+        "MultiBoxPrior": cops.multibox_prior,
+        "MultiBoxTarget": cops.multibox_target,
+        "MultiBoxDetection": cops.multibox_detection,
+        "ROIAlign": cops.roi_align,
+        "SyncBatchNorm": None,      # layer-level: nn.SyncBatchNorm
+        "allclose": cops.allclose,
+        "bipartite_matching": cops.bipartite_matching,
+        "boolean_mask": cops.boolean_mask,
+        "box_iou": cops.box_iou, "box_nms": cops.box_nms,
+        "div_sqrt_dim": cops.div_sqrt_dim,
+        "dynamic_reshape": cops.dynamic_reshape,
+        "edge_id": cdgl.edge_id,
+        "dgl_adjacency": cdgl.dgl_adjacency,
+        "dgl_csr_neighbor_uniform_sample":
+            cdgl.dgl_csr_neighbor_uniform_sample,
+        "dgl_csr_neighbor_non_uniform_sample":
+            cdgl.dgl_csr_neighbor_non_uniform_sample,
+        "dgl_subgraph": cdgl.dgl_subgraph,
+        "dgl_graph_compact": cdgl.dgl_graph_compact,
+        "getnnz": cops.getnnz,
+        "gradientmultiplier": cops.gradientmultiplier,
+        "hawkesll": cops.hawkes_ll,
+        "index_array": cops.index_array,
+        "index_copy": cops.index_copy,
+        "interleaved_matmul_selfatt_qk":
+            cops.interleaved_matmul_selfatt_qk,
+        "interleaved_matmul_selfatt_valatt":
+            cops.interleaved_matmul_selfatt_valatt,
+        "interleaved_matmul_encdec_qk":
+            cops.interleaved_matmul_encdec_qk,
+        "interleaved_matmul_encdec_valatt":
+            cops.interleaved_matmul_encdec_valatt,
+        "quadratic": cops.quadratic,
+        "round_ste": cops.round_ste, "sign_ste": cops.sign_ste,
+    }
+    for nm, fn in contrib_map.items():
+        reg(f"_contrib_{nm}", fn)
+
+    # quantization internals (contrib/quantization.py jitted pieces)
+    from ..contrib import quantization as q
+
+    for nm, fn in {
+        "quantize": getattr(q, "quantize", None),
+        "quantize_v2": getattr(q, "quantize", None),
+        "dequantize": getattr(q, "dequantize", None),
+        "requantize": getattr(q, "requantize", None),
+        "calibrate_entropy": getattr(q, "_entropy_threshold", None),
+    }.items():
+        reg(f"_contrib_{nm}", fn)
+
+    # ---- _image_* (src/operator/image/) ------------------------------
+    from ..gluon.data.vision import transforms as T
+    from ..image import image as img
+
+    reg("_image_resize", raw(getattr(img, "imresize", None)))
+    image_map = {
+        "_image_crop": getattr(img, "fixed_crop", None),
+        "_image_to_tensor": lambda x: jnp.transpose(
+            jnp.asarray(x, jnp.float32) / 255.0, (2, 0, 1)),
+        "_image_normalize": lambda x, mean, std: (
+            (jnp.asarray(x) - jnp.asarray(mean)[:, None, None])
+            / jnp.asarray(std)[:, None, None]),
+        "_image_random_crop": getattr(img, "random_crop", None),
+        "_image_random_resized_crop": getattr(img, "random_size_crop",
+                                              None),
+    }
+    for nm, fn in image_map.items():
+        reg(nm, fn)
+    del T
+
+    # ---- optimizer update internals ----------------------------------
+    # mp_* (multi-precision: fp32 master weights) and multi_*/preloaded_*
+    # (multi-tensor batches) share the single-tensor rules; on TPU the
+    # batching win comes from jit fusing the update loop, so the batched
+    # spellings dispatch per-tensor to the same registered rule.
+    def _mp(name):
+        base = get_op(name) if name in _OPS else None
+        if base is None:
+            return None
+
+        def mp_update(weight, grad, *states_and_w32, **kw):
+            *states, weight32 = states_and_w32
+            out = base(weight32, grad, *states, **kw)
+            if isinstance(out, tuple):
+                new_w32 = out[0]
+                return (new_w32.astype(weight.dtype), *out[1:], new_w32)
+            return out.astype(weight.dtype), out
+
+        return mp_update
+
+    for nm in ("sgd_update", "sgd_mom_update", "nag_mom_update",
+               "adamw_update", "lamb_update_phase1", "adabelief_update"):
+        if nm in _OPS:
+            reg(f"mp_{nm}", _mp(nm))
+    reg("_mp_adamw_update", _OPS.get("mp_adamw_update"))
+    reg("_mp_adabelief_update", _OPS.get("mp_adabelief_update"))
+    reg("mp_lamb_update_phase1", _OPS.get("mp_lamb_update_phase1"))
+    reg("mp_lamb_update_phase2", _OPS.get("lamb_update_phase2"))
+    reg("_adabelief_update", _OPS.get("adabelief_update"))
+
+    def _multi(base_name, n_states, preloaded=False):
+        base = _OPS.get(base_name)
+        if base is None:
+            return None
+
+        def multi_update(*args, num_weights=None, lrs=None, wds=None,
+                         **kw):
+            args = list(args)
+            if preloaded:
+                # preloaded_* convention: lrs/wds are the two TRAILING
+                # tensor arguments (src/operator/contrib/
+                # preloaded_multi_sgd-inl.h)
+                wds = args.pop()
+                lrs = args.pop()
+            group = n_states + 2
+            n = int(num_weights) if num_weights else len(args) // group
+            outs = []
+            for i in range(n):
+                tensors = args[i * group:(i + 1) * group]
+                kwi = dict(kw)
+                if lrs is not None:
+                    kwi["lr"] = lrs[i] if hasattr(lrs, "__len__") else lrs
+                if wds is not None:
+                    kwi["wd"] = wds[i] if hasattr(wds, "__len__") else wds
+                outs.append(base(*tensors, **kwi))
+            return tuple(outs)
+
+        return multi_update
+
+    for base_name, n_states, spellings in (
+            ("sgd_update", 0, ["multi_sgd_update"]),
+            ("sgd_mom_update", 1, ["multi_sgd_mom_update"]),
+            ("mp_sgd_update", 1, ["multi_mp_sgd_update"]),
+            ("mp_sgd_mom_update", 2, ["multi_mp_sgd_mom_update"]),
+            ("adamw_update", 2, ["_multi_adamw_update"]),
+            ("mp_adamw_update", 3, ["_multi_mp_adamw_update"]),
+            ("adabelief_update", 2, ["_multi_adabelief_update"]),
+            ("mp_adabelief_update", 3, ["_multi_mp_adabelief_update"]),
+            ("lamb_update_phase1", 2, ["_multi_lamb_update"]),
+            ("mp_lamb_update_phase1", 3, ["_multi_mp_lamb_update"]),
+    ):
+        fn = _multi(base_name, n_states)
+        for sp in spellings:
+            reg(sp, fn)
+    for base_name, n_states, sp in (
+            ("sgd_update", 0, "preloaded_multi_sgd_update"),
+            ("sgd_mom_update", 1, "preloaded_multi_sgd_mom_update"),
+            ("mp_sgd_update", 1, "preloaded_multi_mp_sgd_update"),
+            ("mp_sgd_mom_update", 2,
+             "preloaded_multi_mp_sgd_mom_update"),
+    ):
+        reg(sp, _multi(base_name, n_states, preloaded=True))
+    reg("multi_lars", cops.multi_lars)
+    reg("reset_arrays", cops.reset_arrays)
+    reg("multi_sum_sq", cops.multi_sum_sq)
+
+    # remaining odds and ends
+    from ..ndarray import sparse as _sparse
+
+    reg("cast_storage", _sparse.cast_storage)
+    reg("_sparse_retain", getattr(_sparse, "retain", None))
+    reg("amp_cast", lambda x, dtype: jnp.asarray(x).astype(dtype))
+    reg("amp_multicast",
+        lambda *xs, num_outputs=None, cast_narrow=False: tuple(
+            jnp.asarray(x).astype(
+                jnp.result_type(*[jnp.asarray(v).dtype for v in xs]))
+            for x in xs))
+    reg("_rnn_param_concat",
+        lambda *xs, dim=0, **kw: jnp.concatenate(
+            [jnp.asarray(x).reshape(-1) for x in xs]))
+
+
+def _install_round2():
+    """Second alias round: sldwin attention, box codec, optimizer rules,
+    and the remaining _npi/_npx odds and ends."""
+    import jax.numpy as j
+
+    from .. import numpy as mxnp
+    from .. import numpy_extension as npx
+    from ..contrib import ops as cops
+    from ..gluon import loss as gloss
+
+    def reg(name, fn):
+        if name not in _OPS and fn is not None:
+            register_op(name, fn)
+
+    def raw(fn):
+        return getattr(fn, "__wrapped__", fn)
+
+    for nm in ("sldwin_atten_score", "sldwin_atten_mask_like",
+               "sldwin_atten_context", "box_decode", "box_encode"):
+        fn = getattr(cops, nm)
+        reg(f"_contrib_{nm}", fn)
+        reg(f"_npx_{nm}", fn)
+    reg("_contrib_arange_like", raw(npx.arange_like))
+    reg("_contrib_group_adagrad_update", _OPS.get("group_adagrad_update"))
+    reg("_sparse_adagrad_update", _OPS.get("adagrad_update"))
+    reg("_adabelief_update", _OPS.get("adabelief_update"))
+    reg("_mp_adabelief_update", _OPS.get("adabelief_update"))
+    reg("_multi_lans_update", _OPS.get("lans_update_phase1"))
+    reg("_multi_mp_lans_update", _OPS.get("lans_update_phase1"))
+
+    # CTCLoss op spelling over the loss implementation
+    def ctc_loss(data, label, data_lengths=None, label_lengths=None,
+                 use_data_lengths=False, use_label_lengths=False,
+                 blank_label="first"):  # noqa: ARG001
+        lossfn = gloss.CTCLoss(layout="TNC", label_layout="NT")
+        return lossfn(data, label, data_lengths, label_lengths)
+
+    reg("CTCLoss", ctc_loss)
+    reg("ctc_loss", ctc_loss)
+    reg("GroupNorm", _OPS.get("group_norm"))
+
+    # _npi odds and ends
+    reg("_npi_insert_scalar", raw(getattr(mxnp, "insert", None)))
+    reg("_npi_insert_slice", raw(getattr(mxnp, "insert", None)))
+    reg("_npi_insert_tensor", raw(getattr(mxnp, "insert", None)))
+    reg("_npi_ldexp_scalar", j.ldexp)
+    reg("_npi_rldexp_scalar", _swap(j.ldexp))
+    reg("_npi_where_lscalar", j.where)
+    reg("_npi_where_rscalar", j.where)
+    reg("_npi_where_scalar2", j.where)
+    def _fill_diagonal(a, val=0.0, wrap=False):  # noqa: ARG001
+        arr = j.asarray(a)
+        n = min(arr.shape[-2:]) if arr.ndim >= 2 else arr.shape[0]
+        idx = j.diag_indices(n, ndim=min(arr.ndim, 2))
+        return arr.at[idx].set(val)
+
+    reg("_npi_fill_diagonal", _fill_diagonal)
+    reg("_npi_diag_indices_from",
+        lambda a: j.stack(j.diag_indices_from(j.asarray(a))))
+    reg("_npi_share_memory", lambda a, b: j.zeros((1,), j.bool_))
+    reg("_npi_repeats", j.repeat)
+    reg("_npi_tensordot_int_axes", j.tensordot)
+    reg("_npi_advanced_indexing", lambda x, idx: j.asarray(x)[idx])
+    reg("_npi_advanced_indexing_multiple",
+        lambda x, *idx: j.asarray(x)[tuple(idx)])
+    reg("_npi_boolean_mask_assign_scalar",
+        lambda data, mask, value=0.0: j.where(
+            j.asarray(mask, bool), value, j.asarray(data)))
+    reg("_npi_boolean_mask_assign_tensor",
+        lambda data, mask, value: j.place(
+            j.asarray(data), j.asarray(mask, bool), j.asarray(value),
+            inplace=False)
+        if hasattr(j, "place") else j.where(
+            j.asarray(mask, bool), j.asarray(value), j.asarray(data)))
+    reg("_npx_index_add", raw(npx.index_add))
+    reg("_npx_index_update", raw(npx.index_update))
+    reg("_npx_nonzero", raw(npx.nonzero))
+    reg("_npx_constraint_check", raw(npx.constraint_check))
+
+    # negative-binomial sampling (src/operator/random/sample_op.cc)
+    rnd = mxnp.random
+
+    def sample_nb(k=1, p=0.5, shape=None, **kw):  # noqa: ARG001
+        fn = getattr(rnd, "negative_binomial", None)
+        return fn(k, p, size=shape) if fn is not None else None
+
+    def sample_gnb(mu=1.0, alpha=1.0, shape=None, **kw):  # noqa: ARG001
+        # gamma-poisson mixture (the reference's generalized NB)
+        import jax as _jax
+
+        from .. import _random as _rng
+
+        key1, key2 = _jax.random.split(_rng.next_key())
+        shp = shape if shape is not None else ()
+        r = 1.0 / alpha
+        g = _jax.random.gamma(key1, r, shp) * (alpha * mu)
+        return _jax.random.poisson(key2, g, shp)
+
+    reg("_sample_negative_binomial", sample_nb)
+    reg("_sample_generalized_negative_binomial", sample_gnb)
+
+    # functional slice-assign / scatter-set (the eager NDArray setitem
+    # internals, src/operator/tensor/matrix_op.cc _slice_assign)
+    def _slice_from(begin, end, step=None):
+        step = step or [None] * len(begin)
+        return tuple(slice(b, e, s)
+                     for b, e, s in zip(begin, end, step))
+
+    reg("_slice_assign",
+        lambda lhs, rhs, begin, end, step=None: j.asarray(lhs).at[
+            _slice_from(begin, end, step)].set(j.asarray(rhs)))
+    reg("_slice_assign_scalar",
+        lambda data, scalar=0.0, begin=(), end=(), step=None:
+        j.asarray(data).at[_slice_from(begin, end, step)].set(scalar))
+    reg("_scatter_set_nd",
+        lambda lhs, indices, shape=None: None)  # covered by index_update
+    _OPS.pop("_scatter_set_nd", None)
+    reg("_scatter_set_nd", raw(npx.index_update))
+
+
+
+
+
+def _install_round3():
+    """Third round: quantized int8 op spellings + the last contrib names."""
+    import jax.numpy as j
+
+    from ..contrib import quantization as q
+    from ..ops import vision as _vision
+
+    def reg(name, fn):
+        if name not in _OPS and fn is not None:
+            register_op(name, fn)
+
+    for nm in ("quantized_act", "quantized_flatten", "quantized_pooling",
+               "quantized_elemwise_add", "quantized_elemwise_mul",
+               "quantized_concat", "quantized_embedding",
+               "quantized_batch_norm", "quantized_conv",
+               "quantized_fully_connected"):
+        reg(f"_contrib_{nm}", getattr(q, nm, None))
+    reg("_contrib_calibrate_entropy",
+        getattr(q, "optimal_threshold", None))
+
+    # BatchNormWithReLU / SyncBatchNorm op spellings: the op-level math is
+    # batch_norm (+relu); cross-device sync is SPMD's job (layer docs)
+    bn = _OPS.get("batch_norm")
+    if bn is not None:
+        def bn_relu(*args, **kw):
+            out = bn(*args, **kw)
+            if isinstance(out, tuple):
+                return (j.maximum(out[0], 0), *out[1:])
+            return j.maximum(out, 0)
+
+        reg("_contrib_BatchNormWithReLU", bn_relu)
+        reg("_contrib_SyncBatchNorm", bn)
+
+
+
+
+
+def _install_round4():
+    from ..contrib import ops as cops
+
+    for name, fn in (("_contrib_RROIAlign", cops.rroi_align),
+                     ("_contrib_mrcnn_mask_target",
+                      cops.mrcnn_mask_target)):
+        if name not in _OPS:
+            register_op(name, fn)
+
+
+
+
+
+def install_aliases():
+    """Populate the registry with every internal spelling. Idempotent."""
+    if "_npi_add" in _OPS:
+        return
+    _install_round1()
+    _install_round2()
+    _install_round3()
+    _install_round4()

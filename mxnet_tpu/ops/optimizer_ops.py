@@ -198,3 +198,66 @@ def multi_sum_sq(*arrays, num_arrays=None):  # noqa: ARG001
     """Per-array sum of squares (reference: multi_sum_sq.cc — feeds LARS/
     clip-by-global-norm)."""
     return tuple(jnp.sum(jnp.square(a.astype(jnp.float32))) for a in arrays)
+
+
+@register_op("adabelief_update")
+def adabelief_update(weight, grad, mean, var, lr, beta1=0.9, beta2=0.999,
+                     epsilon=1e-8, wd=0.0, rescale_grad=1.0,
+                     clip_gradient=-1.0):
+    """AdaBelief (reference: contrib/adabelief.cc): variance of the
+    prediction error (g - m)^2 instead of g^2."""
+    g = _prep(grad, rescale_grad, clip_gradient) + wd * weight
+    m = beta1 * mean + (1 - beta1) * g
+    s = beta2 * var + (1 - beta2) * jnp.square(g - m) + epsilon
+    w = weight - lr * m / (jnp.sqrt(s) + epsilon)
+    return w, m, s
+
+
+@register_op("ftml_update")
+def ftml_update(weight, grad, d, v, z, lr, t, beta1=0.6, beta2=0.999,
+                epsilon=1e-8, wd=0.0, rescale_grad=1.0,
+                clip_grad=-1.0):
+    """FTML (reference: optimizer_op.cc FTMLUpdate)."""
+    g = _prep(grad, rescale_grad, clip_grad) + wd * weight
+    v_new = beta2 * v + (1 - beta2) * jnp.square(g)
+    d_new = (1 - beta1 ** t) / lr * (
+        jnp.sqrt(v_new / (1 - beta2 ** t)) + epsilon)
+    sigma = d_new - beta1 * d
+    z_new = beta1 * z + (1 - beta1) * g - sigma * weight
+    w = -z_new / d_new
+    return w, d_new, v_new, z_new
+
+
+@register_op("group_adagrad_update")
+def group_adagrad_update(weight, grad, history, lr, rescale_grad=1.0,
+                         clip_gradient=-1.0, epsilon=1e-5):
+    """Group (row-wise) AdaGrad (reference: contrib/optimizer_op.cc
+    _contrib_group_adagrad_update): one accumulator per output row."""
+    g = _prep(grad, rescale_grad, clip_gradient)
+    axes = tuple(range(1, g.ndim))
+    h = history + jnp.mean(jnp.square(g), axis=axes) if g.ndim > 1 \
+        else history + jnp.square(g)
+    scale = h if g.ndim == 1 else h.reshape(
+        (-1,) + (1,) * (g.ndim - 1))
+    w = weight - lr * g / (jnp.sqrt(scale) + epsilon)
+    return w, h
+
+
+@register_op("lans_update_phase1")
+def lans_update_phase1(weight, grad, mean, var, beta1=0.9, beta2=0.999,
+                       epsilon=1e-6, t=1, wd=0.0, rescale_grad=1.0,
+                       clip_gradient=-1.0):
+    """LANS phase 1 (reference: contrib/multi_lans.cc): like LAMB but the
+    gradient is L2-normalized before the moment updates."""
+    g = _prep(grad, rescale_grad, clip_gradient)
+    gnorm = jnp.sqrt(jnp.sum(jnp.square(g)))
+    g = g / jnp.maximum(gnorm, 1e-12)
+    m = beta1 * mean + (1 - beta1) * g
+    v = beta2 * var + (1 - beta2) * jnp.square(g)
+    mhat = m / (1 - beta1 ** t)
+    vhat = v / (1 - beta2 ** t)
+    update_m = mhat / (jnp.sqrt(vhat) + epsilon) + wd * weight
+    update_g = g / (jnp.sqrt(vhat) + epsilon) + wd * weight
+    return update_m, update_g, m, v
+
+

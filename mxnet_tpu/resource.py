@@ -151,6 +151,7 @@ class ResourceManager:
         token = getattr(space, "_token", None)
         if token is None:
             return
+        space._token = None  # double release must not alias the buffer
         bucket, buf = token
         with self._lock:
             self._host_pool.setdefault(bucket, []).append(buf)

@@ -1,0 +1,122 @@
+"""gluon.contrib.data tests: bbox utils + joint transforms + prebuilt
+loaders (reference: gluon/contrib/data/vision/)."""
+import numpy as onp
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu.gluon.contrib.data.vision import (
+    ImageBboxDataLoader,
+    ImageDataLoader,
+)
+from mxnet_tpu.gluon.contrib.data.vision.dataloader import (
+    create_bbox_augment,
+    create_image_augment,
+)
+from mxnet_tpu.gluon.contrib.data.vision.transforms.bbox import (
+    ImageBboxCrop,
+    ImageBboxRandomExpand,
+    ImageBboxRandomFlipLeftRight,
+    ImageBboxResize,
+    utils,
+)
+
+
+def test_bbox_flip_resize_translate():
+    bb = onp.array([[10, 20, 50, 60, 1]], "f")
+    flipped = utils.bbox_flip(bb, (100, 80), flip_x=True)
+    onp.testing.assert_allclose(flipped[0, :4], [50, 20, 90, 60])
+    flipped_y = utils.bbox_flip(bb, (100, 100), flip_y=True)
+    onp.testing.assert_allclose(flipped_y[0, :4], [10, 40, 50, 80])
+    resized = utils.bbox_resize(bb, (100, 80), (50, 40))
+    onp.testing.assert_allclose(resized[0, :4], [5, 10, 25, 30])
+    moved = utils.bbox_translate(bb, 5, -5)
+    onp.testing.assert_allclose(moved[0, :4], [15, 15, 55, 55])
+    assert flipped[0, 4] == 1  # class column untouched
+
+
+def test_bbox_crop_center_rule():
+    bb = onp.array([[10, 10, 30, 30], [50, 50, 70, 70]], "f")
+    out = utils.bbox_crop(bb, (0, 0, 40, 40), allow_outside_center=False)
+    assert len(out) == 1
+    onp.testing.assert_allclose(out[0], [10, 10, 30, 30])
+    out2 = utils.bbox_crop(bb, (0, 0, 60, 60), allow_outside_center=True)
+    assert len(out2) == 2
+    onp.testing.assert_allclose(out2[1], [50, 50, 60, 60])  # clipped
+
+
+def test_bbox_iou_and_conversions():
+    a = onp.array([[0, 0, 10, 10]], "f")
+    b = onp.array([[5, 5, 15, 15], [20, 20, 30, 30]], "f")
+    iou = utils.bbox_iou(a, b)
+    assert iou.shape == (1, 2)
+    onp.testing.assert_allclose(iou[0, 0], 25 / 175, rtol=1e-5)
+    assert iou[0, 1] == 0.0
+    assert utils.bbox_xywh_to_xyxy((5, 5, 10, 10)) == (5, 5, 14, 14)
+    assert utils.bbox_xyxy_to_xywh((5, 5, 14, 14)) == (5, 5, 10, 10)
+    assert utils.bbox_clip_xyxy((-1, -2, 200, 300), 100, 80) == \
+        (0, 0, 99, 79)
+
+
+def test_bbox_random_crop_with_constraints():
+    bb = onp.array([[20, 20, 60, 60]], "f")
+    new_bb, crop = utils.bbox_random_crop_with_constraints(
+        bb, (100, 100), max_trial=10)
+    assert len(new_bb) >= 1
+    x, y, w, h = crop
+    assert 0 <= x and 0 <= y and w <= 100 and h <= 100
+
+
+def test_joint_transforms():
+    rs = onp.random.RandomState(0)
+    img = mx.np.array(rs.randint(0, 255, (40, 60, 3)).astype("uint8"))
+    bb = onp.array([[5, 5, 30, 35, 0]], "f")
+    img2, bb2 = ImageBboxRandomFlipLeftRight(1.0)(img, bb)
+    onp.testing.assert_allclose(bb2.asnumpy()[0, :4], [30, 5, 55, 35])
+    img3, bb3 = ImageBboxCrop((5, 5, 40, 30))(img, bb)
+    assert img3.shape == (30, 40, 3)
+    img4, bb4 = ImageBboxResize(120, 80)(img, bb)
+    assert img4.shape == (80, 120, 3)
+    onp.testing.assert_allclose(bb4.asnumpy()[0, :4],
+                                [10, 10, 60, 70])
+    img5, bb5 = ImageBboxRandomExpand(p=1.0, max_ratio=2)(img, bb)
+    assert img5.shape[0] >= 40 and img5.shape[1] >= 60
+    # expanded boxes stay on the image
+    b5 = bb5.asnumpy()
+    assert (b5[0, :4] >= 0).all()
+    assert b5[0, 2] <= img5.shape[1] and b5[0, 3] <= img5.shape[0]
+
+
+def test_image_dataloader():
+    rs = onp.random.RandomState(0)
+    samples = [(rs.randint(0, 255, (40, 50, 3)).astype("uint8"), i % 3)
+               for i in range(10)]
+    dl = ImageDataLoader(4, (3, 32, 32), dataset=samples,
+                         rand_mirror=True, mean=(0.5, 0.5, 0.5),
+                         std=(0.2, 0.2, 0.2))
+    x, y = next(iter(dl))
+    assert x.shape == (4, 3, 32, 32)
+    assert len(dl) == 3
+    aug = create_image_augment((3, 28, 28), resize=32)
+    out = aug(mx.np.array(samples[0][0]))
+    assert out.shape == (3, 28, 28)
+
+
+def test_image_bbox_dataloader():
+    rs = onp.random.RandomState(0)
+    det = [(rs.randint(0, 255, (60, 80, 3)).astype("uint8"),
+            onp.array([[5, 5, 40, 50, 0], [10, 10, 70, 55, 1]],
+                      "f")[:rs.randint(1, 3)])
+           for _ in range(6)]
+    dl = ImageBboxDataLoader(3, (3, 32, 32), dataset=det,
+                             rand_mirror=True, rand_crop=0.5,
+                             rand_pad=0.5)
+    imgs, boxes = next(iter(dl))
+    assert imgs.shape[0] == 3 and imgs.shape[1:3] == (32, 32)
+    assert boxes.shape[0] == 3 and boxes.shape[2] == 5
+    b = boxes.asnumpy()
+    valid = b[b[:, :, 0] >= 0]
+    # normalized coords
+    assert (valid[:, :4] <= 1.0 + 1e-6).all()
+    aug = create_bbox_augment((3, 24, 24), rand_mirror=True)
+    i2, b2 = aug(mx.np.array(det[0][0]), det[0][1])
+    assert i2.shape == (24, 24, 3)

@@ -1,0 +1,312 @@
+"""Internal op-name alias layer + round-3 op families (reference: the
+595-name NNVM registry, python/mxnet/ndarray/register.py codegen;
+src/operator/contrib/transformer.cc sldwin ops; quantization/
+quantized_*.cc; contrib optimizer ops)."""
+import re
+import subprocess
+
+import jax
+import jax.numpy as jnp
+import numpy as onp
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu.contrib import ops as cops
+from mxnet_tpu.contrib import quantization as q
+from mxnet_tpu.ops.registry import _OPS, get_op, list_ops
+
+
+def test_registry_covers_reference_vocabulary():
+    """>=90% of the reference's forward op names must resolve through
+    the registry or public namespaces (VERDICT item 3)."""
+    out = subprocess.run(
+        ["grep", "-rhoE", r"NNVM_REGISTER_OP\(([A-Za-z0-9_]+)\)",
+         "/root/reference/src/operator/"],
+        capture_output=True, text=True).stdout
+    refs = sorted({r for r in re.findall(
+        r"NNVM_REGISTER_OP\(([A-Za-z0-9_]+)\)", out)
+        if not r.startswith("_backward")})
+    if not refs:
+        pytest.skip("reference not mounted")
+    resolvable = [
+        name for name in refs
+        if name in _OPS
+        or hasattr(mx.nd, name) or hasattr(mx.npx, name)
+        or hasattr(mx.contrib.nd, name)
+        or hasattr(mx.nd, name.lstrip("_"))
+        or hasattr(mx.npx, name.lstrip("_"))]
+    assert len(resolvable) / len(refs) >= 0.90, \
+        f"{len(resolvable)}/{len(refs)}"
+    assert len(list_ops()) >= 595  # the reference's registry size
+
+
+def test_internal_spellings_compute():
+    """Sampled internal names must be callable with correct numerics."""
+    a = jnp.asarray([[1.0, 2.0], [3.0, 4.0]])
+    onp.testing.assert_allclose(get_op("_plus_scalar")(a, 1.0),
+                                a + 1.0)
+    onp.testing.assert_allclose(get_op("_rminus_scalar")(a, 10.0),
+                                10.0 - a)
+    onp.testing.assert_allclose(get_op("_npi_add")(a, a), 2 * a)
+    onp.testing.assert_allclose(
+        get_op("_npi_rtrue_divide_scalar")(a, 8.0), 8.0 / a)
+    onp.testing.assert_allclose(
+        get_op("_npi_cholesky")(jnp.eye(3) * 4.0), jnp.eye(3) * 2.0)
+    assert get_op("_npi_tensordot_int_axes")(a, a, 1).shape == (2, 2)
+    w = get_op("_npi_where_lscalar")(a > 2, 1.0, a)
+    onp.testing.assert_allclose(w, jnp.where(a > 2, 1.0, a))
+    out = get_op("_slice_assign_scalar")(a, 9.0, (0, 0), (1, 2))
+    onp.testing.assert_allclose(out[0], [9.0, 9.0])
+    onp.testing.assert_allclose(out[1], a[1])
+    assert get_op("amp_cast")(a, "bfloat16").dtype == jnp.bfloat16
+
+
+def test_mp_and_multi_optimizer_spellings():
+    w = jnp.ones((3,))
+    g = jnp.full((3,), 0.1)
+    w32 = jnp.ones((3,), jnp.float32)
+    new_w, new_w32 = get_op("mp_sgd_update")(
+        w.astype(jnp.bfloat16), g, w32, lr=0.1)
+    assert new_w.dtype == jnp.bfloat16
+    onp.testing.assert_allclose(new_w32, w32 - 0.1 * 0.1, rtol=1e-6)
+    outs = get_op("multi_sgd_update")(w, g, w, g, num_weights=2,
+                                      lrs=[0.1, 0.2])
+    assert len(outs) == 2
+    onp.testing.assert_allclose(outs[1], w - 0.2 * 0.1, rtol=1e-6)
+
+
+def test_new_optimizer_ops():
+    w = onp.ones((4,), "f")
+    g = onp.full((4,), 0.5, "f")
+    nw, m, s = get_op("adabelief_update")(w, g, onp.zeros(4, "f"),
+                                          onp.zeros(4, "f"), lr=0.1)
+    assert (onp.asarray(nw) < w).all()
+    nw2, h = get_op("group_adagrad_update")(
+        onp.ones((4, 3), "f"), onp.full((4, 3), 0.5, "f"),
+        onp.zeros(4, "f"), 0.1)
+    assert h.shape == (4,) and (onp.asarray(h) > 0).all()
+    outs = get_op("ftml_update")(w, g, onp.zeros(4, "f"),
+                                 onp.zeros(4, "f"), onp.zeros(4, "f"),
+                                 0.1, 1)
+    assert len(outs) == 4
+    um, ug, m, v = get_op("lans_update_phase1")(w, g, onp.zeros(4, "f"),
+                                                onp.zeros(4, "f"))
+    assert onp.isfinite(onp.asarray(um)).all()
+
+
+def test_sldwin_attention_matches_dense_band():
+    B, L, H, D, w = 2, 6, 2, 4, 1
+    rs = onp.random.RandomState(0)
+    qq, kk, vv = (rs.rand(B, L, H, D).astype("f") for _ in range(3))
+    dil = mx.np.array([1, 1])
+    sc = cops.sldwin_atten_score(mx.np.array(qq), mx.np.array(kk), dil,
+                                 w=w, symmetric=True)
+    assert sc.shape == (B, L, H, 2 * w + 1)
+    ref = onp.zeros((B, L, H, 2 * w + 1), "f")
+    for b in range(B):
+        for i in range(L):
+            for h in range(H):
+                for j in range(2 * w + 1):
+                    t = i + j - w
+                    if 0 <= t < L:
+                        ref[b, i, h, j] = qq[b, i, h] @ kk[b, t, h]
+    onp.testing.assert_allclose(sc.asnumpy(), ref, rtol=1e-5, atol=1e-6)
+    ctx = cops.sldwin_atten_context(sc, mx.np.array(vv), dil, w=w,
+                                    symmetric=True)
+    assert ctx.shape == (B, L, H, D)
+    mask = cops.sldwin_atten_mask_like(sc, dil, mx.np.array([L, 4]),
+                                       w=w, symmetric=True)
+    # batch 1 rows past valid_length are fully masked
+    assert mask.asnumpy()[1, 4:].sum() == 0
+    # causal variant has w+1 columns
+    sc_c = cops.sldwin_atten_score(mx.np.array(qq), mx.np.array(kk),
+                                   dil, w=w, symmetric=False)
+    assert sc_c.shape == (B, L, H, w + 1)
+
+
+def test_sldwin_attention_gradients():
+    B, L, H, D, w = 1, 4, 1, 3, 1
+    rs = onp.random.RandomState(1)
+    qq = mx.np.array(rs.rand(B, L, H, D).astype("f"))
+    kk = mx.np.array(rs.rand(B, L, H, D).astype("f"))
+    vv = mx.np.array(rs.rand(B, L, H, D).astype("f"))
+    dil = mx.np.array([1])
+    qq.attach_grad()
+    from mxnet_tpu import autograd
+
+    with autograd.record():
+        sc = cops.sldwin_atten_score(qq, kk, dil, w=w)
+        out = cops.sldwin_atten_context(sc, vv, dil, w=w).sum()
+    out.backward()
+    assert (qq.grad.asnumpy() != 0).any()
+
+
+def test_box_codec_roundtrip():
+    rs = onp.random.RandomState(0)
+    anchors = onp.array([[[0, 0, 10, 10], [5, 5, 25, 35]]], "f")
+    gt = onp.array([[[2, 2, 12, 12]]], "f")
+    samples = onp.ones((1, 2), "f")
+    matches = onp.zeros((1, 2), "f")
+    targets, masks = cops.box_encode(
+        mx.np.array(samples), mx.np.array(matches),
+        mx.np.array(anchors), mx.np.array(gt))
+    # decoding the targets against the anchors recovers the gt boxes
+    dec = cops.box_decode(targets, mx.np.array(anchors))
+    onp.testing.assert_allclose(dec.asnumpy()[0, 0], gt[0, 0],
+                                rtol=1e-4, atol=1e-4)
+    onp.testing.assert_allclose(dec.asnumpy()[0, 1], gt[0, 0],
+                                rtol=1e-4, atol=1e-4)
+    assert masks.asnumpy().all()
+
+
+def test_quantized_ops_numerics():
+    rs = onp.random.RandomState(0)
+    x = rs.rand(2, 4, 6, 6).astype("f") * 2 - 1
+    qx, lo, hi = q.quantize_v2(mx.np.array(x))
+    # act
+    qa, alo, ahi = q.quantized_act(qx, lo, hi, act_type="relu")
+    deq = q.dequantize(qa, alo, ahi).asnumpy()
+    onp.testing.assert_allclose(deq, onp.maximum(x, 0), atol=0.02)
+    # pooling
+    qp, plo, phi = q.quantized_pooling(qx, lo, hi, kernel=(2, 2),
+                                       pool_type="max", stride=(2, 2))
+    assert qp.shape == (2, 4, 3, 3)
+    # conv vs float reference
+    w = rs.rand(3, 4, 3, 3).astype("f") * 0.4 - 0.2
+    qw, wlo, whi = q.quantize_v2(mx.np.array(w))
+    qo, olo, ohi = q.quantized_conv(qx, qw, None, lo, hi, wlo, whi,
+                                    kernel=(3, 3), pad=(1, 1),
+                                    no_bias=True, num_filter=3)
+    deq = q.dequantize(qo, olo, ohi).asnumpy()
+    ref = jax.lax.conv_general_dilated(
+        jnp.asarray(x), jnp.asarray(w), (1, 1), [(1, 1), (1, 1)],
+        dimension_numbers=("NCHW", "OIHW", "NCHW"))
+    rel = onp.abs(deq - onp.asarray(ref)).max() / \
+        onp.abs(onp.asarray(ref)).max()
+    assert rel < 0.05, rel
+    # elemwise add + concat + embedding + fc + bn registered and callable
+    for name in ("_contrib_quantized_elemwise_add",
+                 "_contrib_quantized_concat",
+                 "_contrib_quantized_fully_connected",
+                 "_contrib_quantized_batch_norm",
+                 "_contrib_quantized_embedding"):
+        assert name in _OPS
+
+
+def test_npx_round2_ops():
+    x = mx.np.array(onp.zeros((3, 3), "f"))
+    y = mx.npx.index_update(x, mx.np.array([[0, 1]]), 7.0)
+    assert float(y.asnumpy()[0, 1]) == 7.0
+    z = mx.npx.index_add(y, mx.np.array([0]), 1.0)
+    assert float(z.asnumpy()[0, 0]) == 1.0
+    nz = mx.npx.nonzero(y)
+    onp.testing.assert_array_equal(nz.asnumpy(), [[0, 1]])
+    with pytest.raises(ValueError):
+        mx.npx.constraint_check(mx.np.array([False]), "bad")
+
+
+def test_ctc_loss_op_spelling():
+    T, B, A = 5, 2, 4
+    rs = onp.random.RandomState(0)
+    data = mx.np.array(rs.rand(T, B, A).astype("f"))
+    label = mx.np.array(onp.array([[1, 2], [2, 3]], "f"))
+    out = get_op("CTCLoss")(data, label)
+    assert out.shape == (B,)
+    assert onp.isfinite(out.asnumpy()).all()
+
+
+def test_rroi_align():
+    rs = onp.random.RandomState(0)
+    x = mx.np.array(rs.rand(1, 2, 16, 16).astype("f"))
+    rois = mx.np.array(onp.array([[0, 8, 8, 8, 8, 0.0]], "f"))
+    out = cops.rroi_align(x, rois, (4, 4))
+    assert out.shape == (1, 2, 4, 4)
+    # theta=0 equals the mean over the axis-aligned sample grid;
+    # rotating by 90 degrees on a symmetric window transposes bins
+    rot = cops.rroi_align(
+        x, mx.np.array(onp.array([[0, 8, 8, 8, 8, 45.0]], "f")), (4, 4))
+    assert (onp.abs(out.asnumpy() - rot.asnumpy()) > 1e-5).any()
+    assert "_contrib_RROIAlign" in _OPS
+
+
+def test_mrcnn_mask_target():
+    rs = onp.random.RandomState(0)
+    rois = mx.np.array(rs.rand(2, 3, 4).astype("f") * 10)
+    gt = mx.np.array((rs.rand(2, 2, 20, 20) > 0.5).astype("f"))
+    matches = mx.np.array(onp.array([[0, 1, 0], [1, 0, 1]], "f"))
+    cls = mx.np.array(onp.array([[1, 2, 0], [2, 1, 1]], "f"))
+    t, w = cops.mrcnn_mask_target(rois, gt, matches, cls,
+                                  num_classes=3, mask_size=(7, 7))
+    assert t.shape == (2, 3, 3, 7, 7) and w.shape == t.shape
+    # class-0 (background) rois contribute zero weight
+    assert float(w.asnumpy()[0, 2].sum()) == 0.0
+    # positive rois put weight only in their class channel
+    assert float(w.asnumpy()[0, 0, 1].sum()) > 0
+    assert float(w.asnumpy()[0, 0, 2].sum()) == 0.0
+
+
+def test_preloaded_multi_sgd_trailing_lr_wd():
+    """Review regression: preloaded_* spellings take lrs/wds as trailing
+    tensors (reference: preloaded_multi_sgd-inl.h)."""
+    w0 = jnp.ones((3,))
+    g0 = jnp.full((3,), 0.1)
+    w1 = jnp.ones((2,)) * 2
+    g1 = jnp.full((2,), 0.2)
+    lrs = jnp.asarray([0.1, 0.5])
+    wds = jnp.asarray([0.0, 0.0])
+    out0, out1 = get_op("preloaded_multi_sgd_update")(
+        w0, g0, w1, g1, lrs, wds, num_weights=2)
+    onp.testing.assert_allclose(out0, w0 - 0.1 * 0.1, rtol=1e-6)
+    onp.testing.assert_allclose(out1, w1 - 0.5 * 0.2, rtol=1e-6)
+
+
+def test_fill_diagonal_rectangular():
+    out = get_op("_npi_fill_diagonal")(onp.zeros((3, 5), "f"), 1.0)
+    onp.testing.assert_allclose(onp.asarray(out).sum(), 3.0)
+
+
+def test_dgl_sampling_reproducible_with_seed():
+    from mxnet_tpu.contrib import dgl
+    from mxnet_tpu.ndarray import sparse
+
+    data = onp.arange(1, 21, dtype=onp.int64)
+    indices = onp.array([1, 2, 3, 4, 0, 2, 3, 4, 0, 1, 3, 4,
+                         0, 1, 2, 4, 0, 1, 2, 3], dtype=onp.int64)
+    indptr = onp.array([0, 4, 8, 12, 16, 20], dtype=onp.int64)
+    a = sparse.csr_matrix((data, indices, indptr), shape=(5, 5))
+    seeds = mx.np.array([0, 1], dtype="int64")
+    mx.seed(42)
+    _, g1, _ = dgl.dgl_csr_neighbor_uniform_sample(
+        a, seeds, num_hops=1, num_neighbor=2, max_num_vertices=5)
+    mx.seed(42)
+    _, g2, _ = dgl.dgl_csr_neighbor_uniform_sample(
+        a, seeds, num_hops=1, num_neighbor=2, max_num_vertices=5)
+    onp.testing.assert_array_equal(g1.todense().asnumpy(),
+                                   g2.todense().asnumpy())
+
+
+def test_host_space_double_release_no_alias():
+    from mxnet_tpu.resource import ResourceManager, ResourceRequest, request
+
+    mgr = ResourceManager.get()
+    res = request(mx.cpu(), ResourceRequest.kTempSpace)
+    s = res.get_host_space(64)
+    mgr.release_host(s)
+    mgr.release_host(s)  # second release must be a no-op
+    a = res.get_host_space(64)
+    b = res.get_host_space(64)
+    assert a._token[1] is not b._token[1]
+    mgr.release_host(a)
+    mgr.release_host(b)
+
+
+def test_quantized_flatten_passthrough():
+    rs = onp.random.RandomState(0)
+    x = rs.rand(2, 3, 4).astype("f")
+    qx, lo, hi = q.quantize_v2(mx.np.array(x))
+    qf, flo, fhi = q.quantized_flatten(qx, lo, hi)
+    assert qf.shape == (2, 12)
+    # int8 codes and ranges unchanged (reference forwards them)
+    onp.testing.assert_array_equal(qf.asnumpy().ravel(),
+                                   qx.asnumpy().ravel())
+    assert float(flo.asnumpy()) == float(lo.asnumpy())
