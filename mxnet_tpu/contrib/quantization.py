@@ -439,11 +439,19 @@ def _quantized_unary(name, fn):
     return op
 
 
-quantized_act = _quantized_unary(
-    "quantized_act", lambda x, act_type="relu": {
-        "relu": jnp.maximum(x, 0), "sigmoid": jax.nn.sigmoid(x),
-        "tanh": jnp.tanh(x), "softrelu": jnp.log1p(jnp.exp(x)),
-    }[act_type] if isinstance(act_type, str) else x)
+def _act_fn(x, act_type="relu"):
+    if act_type == "relu":
+        return jnp.maximum(x, 0)
+    if act_type == "sigmoid":
+        return jax.nn.sigmoid(x)
+    if act_type == "tanh":
+        return jnp.tanh(x)
+    if act_type == "softrelu":
+        return jnp.log1p(jnp.exp(x))
+    raise ValueError(f"unknown act_type {act_type!r}")
+
+
+quantized_act = _quantized_unary("quantized_act", _act_fn)
 def quantized_flatten(data, min_data, max_data):
     """Pure reshape — int8 codes and ranges pass through unchanged
     (reference: quantized_flatten.cc forwards min/max untouched)."""

@@ -1,3 +1,4 @@
 """Gluon contrib (reference: python/mxnet/gluon/contrib/)."""
 from . import data  # noqa: F401
 from . import estimator  # noqa: F401
+from . import nn  # noqa: F401

@@ -115,12 +115,49 @@ class ImageDataLoader:
 def create_bbox_augment(data_shape, rand_crop=0, rand_pad=0, rand_gray=0,
                         rand_mirror=False, mean=None, std=None,
                         brightness=0, contrast=0, saturation=0,
-                        pca_noise=0, hue=0, inter_method=2,
+                        pca_noise=0, hue=0, inter_method=2,  # noqa: ARG001
                         max_aspect_ratio=2, area_range=(0.3, 3.0),
-                        max_attempts=50, pad_val=(127, 127, 127)):  # noqa: ARG001
+                        max_attempts=50, pad_val=(127, 127, 127)):
     """Compose a detection augment chain operating on (img, bbox) pairs
-    (reference: dataloader.py:246)."""
+    (reference: dataloader.py:246). Color augmentations ride the image
+    module's augmenters (image/image.py), borrowed box-unchanged like the
+    reference's DetBorrowAug."""
+    from mxnet_tpu.image import image as _img
+
     pair = []
+
+    class _Borrow(Block):
+        """Apply an image-only augmenter, passing boxes through."""
+
+        def __init__(self, aug):
+            super().__init__()
+            self._aug = aug
+
+        def forward(self, img, bbox):
+            orig_uint8 = str(getattr(img, "dtype", "")).startswith("uint8")
+            out = self._aug(img)
+            if orig_uint8 and str(out.dtype) != "uint8":
+                # color augs work in float; the PIL-backed resize later
+                # in the chain needs uint8 back
+                out = _mxnp.clip(out, 0, 255).astype("uint8")
+            return out, bbox
+
+    color_augs = []
+    if brightness or contrast or saturation:
+        color_augs.append(_img.ColorJitterAug(brightness, contrast,
+                                              saturation))
+    if hue:
+        color_augs.append(_img.HueJitterAug(hue))
+    if pca_noise:
+        color_augs.append(_img.LightingAug(
+            pca_noise,
+            _np.asarray([55.46, 4.794, 1.148]),
+            _np.asarray([[-0.5675, 0.7192, 0.4009],
+                         [-0.5808, -0.0045, -0.8140],
+                         [-0.5836, -0.6948, 0.4203]])))
+    if rand_gray:
+        color_augs.append(_img.RandomGrayAug(rand_gray))
+    pair.extend(_Borrow(a) for a in color_augs)
     if rand_crop > 0:
         pair.append(ImageBboxRandomCropWithConstraints(
             p=rand_crop, min_scale=area_range[0],
@@ -156,13 +193,11 @@ class ImageBboxDataLoader:
         if aug_list is None:
             aug_list = create_bbox_augment(data_shape, **augment_kwargs)
         self._coord_normalized = coord_normalized
-        post = self._normalize if coord_normalized else None
         ds = _ListDataset(dataset, pair_transform=aug_list)
         self._loader = DataLoader(
             ds, batch_size=batch_size, shuffle=shuffle,
             num_workers=num_workers, last_batch=last_batch,
             batchify_fn=self._batchify)
-        self._post = post
 
     @staticmethod
     def _normalize(img, bbox):

@@ -15,11 +15,14 @@ class BatchProcessor:
         return data.as_in_ctx(device), label.as_in_ctx(device)
 
     def evaluate_batch(self, estimator, val_batch, batch_axis=0):
-        """One validation step: returns (data, label, pred, loss)."""
+        """One validation step: returns (data, label, pred, loss) using
+        the estimator's validation net/loss when configured."""
         data, label = self._get_data_and_label(
             val_batch, estimator.device, batch_axis)
-        pred = estimator.net(data)
-        loss = estimator.loss(pred, label)
+        net = getattr(estimator, "val_net", estimator.net)
+        lossfn = getattr(estimator, "val_loss", estimator.loss)
+        pred = net(data)
+        loss = lossfn(pred, label)
         return data, label, pred, loss
 
     def fit_batch(self, estimator, train_batch, batch_axis=0):

@@ -23,12 +23,18 @@ __all__ = ["Estimator"]
 class Estimator:
     def __init__(self, net, loss, train_metrics=None, val_metrics=None,
                  initializer=None, trainer=None, device=None, context=None,
-                 evaluation_loss=None, val_net=None, val_loss=None,  # noqa: ARG002
+                 evaluation_loss=None, val_net=None, val_loss=None,
                  batch_processor=None):
         from .batch_processor import BatchProcessor
 
         self.batch_processor = batch_processor or BatchProcessor()
         self.net = net
+        # validation may use a different head / loss sharing parameters
+        # (reference: estimator.py val_net/val_loss/evaluation_loss)
+        self.val_net = val_net if val_net is not None else net
+        self.val_loss = (val_loss if val_loss is not None
+                         else evaluation_loss if evaluation_loss is not None
+                         else loss)
         self.loss = loss
         self.device = device or context or current_device()
         if train_metrics is None:
@@ -64,7 +70,7 @@ class Estimator:
         for batch in val_data:
             if batch_fn is not None:
                 data, label = batch_fn(batch)
-                pred = self.net(data)
+                pred = self.val_net(data)
             else:
                 _, label, pred, _ = self.batch_processor.evaluate_batch(
                     self, batch)

@@ -1,0 +1,59 @@
+"""Contrib layers beyond the reference's surface.
+
+`MoEDense` — Mixture-of-Experts FFN (GShard-style top-k routing over
+`parallel/moe.py`). The reference has no MoE; this layer plus
+`parallel.moe_ffn_sharded` gives expert parallelism as a first-class
+capability (shard the expert dimension over an 'ep' mesh axis).
+"""
+from __future__ import annotations
+
+from ...ndarray.ndarray import apply_op
+from ..block import HybridBlock
+from ..parameter import Parameter
+
+__all__ = ["MoEDense"]
+
+
+class MoEDense(HybridBlock):
+    """MoE feed-forward: route each token to top_k of num_experts FFNs.
+
+    Input (..., in_units) -> (output (..., in_units), aux_loss). The
+    auxiliary load-balancing loss should be added to the training loss
+    (scaled by ~1e-2), per the Switch-Transformer recipe.
+    """
+
+    def __init__(self, in_units, hidden_units, num_experts, top_k=2,
+                 capacity_factor=1.25, weight_initializer=None):
+        super().__init__()
+        self._E = int(num_experts)
+        self._top_k = int(top_k)
+        self._cf = float(capacity_factor)
+        self.router = Parameter("router", shape=(in_units, num_experts),
+                                init=weight_initializer)
+        self.wi = Parameter("wi",
+                            shape=(num_experts, in_units, hidden_units),
+                            init=weight_initializer)
+        self.wo = Parameter("wo",
+                            shape=(num_experts, hidden_units, in_units),
+                            init=weight_initializer)
+
+    def forward(self, x):
+        from ...parallel import moe as _moe
+
+        router = self.router.data_for(x)
+        wi = self.wi.data_for(x)
+        wo = self.wo.data_for(x)
+
+        def pure(xv, r, a, b):
+            shape = xv.shape
+            tokens = xv.reshape(-1, shape[-1])
+            out, aux = _moe.moe_ffn(
+                {"router": r, "wi": a, "wo": b}, tokens,
+                capacity_factor=self._cf, top_k=self._top_k)
+            return out.reshape(shape), aux
+
+        return apply_op(pure, x, router, wi, wo, name="moe_dense")
+
+    def __repr__(self):
+        return (f"MoEDense(experts={self._E}, top_k={self._top_k}, "
+                f"capacity_factor={self._cf})")

@@ -10,6 +10,8 @@ can be registered from Python.
 """
 from .base import KVStoreBase  # noqa: F401
 from .kvstore import KVStore, KVStoreLocal  # noqa: F401
+from . import byteps as _byteps  # noqa: F401 - registers 'byteps'
+from . import horovod as _horovod  # noqa: F401 - registers 'horovod'
 from .tpu_dist import P3Store, TPUDist  # noqa: F401
 
 
@@ -27,9 +29,22 @@ def create(name="local"):
         return KVStoreLocal(name_l)
     if name_l == "p3":
         return P3Store()
+    if name_l in ("horovod", "byteps"):
+        # real adapter when the package exists (reference:
+        # kvstore/horovod.py, byteps.py); TPU deployments fall back to
+        # the XLA-collective store, which honors the same contract
+        try:
+            cls = KVStoreBase.find(name_l)
+            return cls()
+        except ImportError:
+            import logging
+
+            logging.getLogger(__name__).info(
+                "%s not installed; kvstore='%s' falling back to tpu_dist",
+                name_l, name_l)
+            return TPUDist()
     if name_l in ("tpu_dist", "dist_sync", "dist_async", "dist",
-                  "dist_sync_device", "dist_async_device", "nccl",
-                  "horovod", "byteps"):
+                  "dist_sync_device", "dist_async_device", "nccl"):
         return TPUDist()
     cls = KVStoreBase.find(name_l)
     if cls is not None:

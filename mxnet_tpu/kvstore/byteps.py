@@ -1,0 +1,65 @@
+"""BytePS kvstore adapter (reference: python/mxnet/kvstore/byteps.py:29).
+
+Parity shim following the same pattern as the horovod adapter: delegates
+to `byteps.mxnet` when importable, and points TPU users at `tpu_dist`
+otherwise (byteps is a GPU/RDMA parameter-server system).
+"""
+from __future__ import annotations
+
+from .base import KVStoreBase
+
+__all__ = ["BytePS"]
+
+
+@KVStoreBase.register
+class BytePS(KVStoreBase):
+    def __init__(self):
+        try:
+            import byteps.mxnet as bps  # noqa: PLC0415
+        except ImportError as e:
+            raise ImportError(
+                "kvstore='byteps' requires the byteps package, which has "
+                "no TPU backend; use kvstore='tpu_dist' — the XLA "
+                "collective store with the same pushpull contract") from e
+        self._bps = bps
+        bps.init()
+
+    @property
+    def rank(self):
+        return self._bps.rank()
+
+    @property
+    def num_workers(self):
+        return self._bps.size()
+
+    def is_capable(self, capability):
+        return capability in ("pushpull", "broadcast")
+
+    def broadcast(self, key, value, out, priority=0):
+        """Root rank's value lands in every rank's out — realised as the
+        reference adapter does: non-root ranks zero their copy, then one
+        push_pull sums to the root value (byteps.py:45-90)."""
+        vals = value if isinstance(value, (list, tuple)) else [value]
+        outs = out if isinstance(out, (list, tuple)) else [out]
+        buf = vals[0]
+        if self.rank != 0:
+            buf = buf * 0
+        self._bps.byteps_declare_tensor(str(key))
+        self._bps.byteps_push_pull(buf, name=str(key), priority=priority)
+        for o in outs:
+            o._data = buf._data
+            o._version += 1
+
+    def pushpull(self, key, value, out=None, priority=0):
+        vals = value if isinstance(value, (list, tuple)) else [value]
+        local = vals[0]
+        for v in vals[1:]:  # sum local copies like every other store
+            local = local + v
+        self._bps.byteps_push_pull(local, name=str(key),
+                                   priority=priority)
+        if out is None:
+            return
+        outs = out if isinstance(out, (list, tuple)) else [out]
+        for o in outs:
+            o._data = local._data
+            o._version += 1

@@ -330,11 +330,15 @@ def _install_round1():
     reg("cast_storage", _sparse.cast_storage)
     reg("_sparse_retain", getattr(_sparse, "retain", None))
     reg("amp_cast", lambda x, dtype: jnp.asarray(x).astype(dtype))
-    reg("amp_multicast",
-        lambda *xs, num_outputs=None, cast_narrow=False: tuple(
-            jnp.asarray(x).astype(
-                jnp.result_type(*[jnp.asarray(v).dtype for v in xs]))
-            for x in xs))
+    def _amp_multicast(*xs, num_outputs=None, cast_narrow=False):  # noqa: ARG001
+        dts = [jnp.asarray(v).dtype for v in xs]
+        if cast_narrow:
+            target = min(dts, key=lambda d: jnp.dtype(d).itemsize)
+        else:
+            target = jnp.result_type(*dts)
+        return tuple(jnp.asarray(x).astype(target) for x in xs)
+
+    reg("amp_multicast", _amp_multicast)
     reg("_rnn_param_concat",
         lambda *xs, dim=0, **kw: jnp.concatenate(
             [jnp.asarray(x).reshape(-1) for x in xs]))
@@ -387,9 +391,15 @@ def _install_round2():
     reg("_npi_insert_tensor", raw(getattr(mxnp, "insert", None)))
     reg("_npi_ldexp_scalar", j.ldexp)
     reg("_npi_rldexp_scalar", _swap(j.ldexp))
-    reg("_npi_where_lscalar", j.where)
-    reg("_npi_where_rscalar", j.where)
-    reg("_npi_where_scalar2", j.where)
+    # reference conventions (symbol/numpy/_symbol.py:7600-7612):
+    # lscalar: where(cond, scalar, y) called as (cond, y, scalar);
+    # rscalar: where(cond, x, scalar) called as (cond, x, scalar)
+    reg("_npi_where_lscalar",
+        lambda cond, y, scalar=0.0: j.where(cond, scalar, y))
+    reg("_npi_where_rscalar",
+        lambda cond, x, scalar=0.0: j.where(cond, x, scalar))
+    reg("_npi_where_scalar2",
+        lambda cond, x=0.0, y=0.0: j.where(cond, x, y))
     def _fill_diagonal(a, val=0.0, wrap=False):  # noqa: ARG001
         arr = j.asarray(a)
         n = min(arr.shape[-2:]) if arr.ndim >= 2 else arr.shape[0]
@@ -454,9 +464,6 @@ def _install_round2():
     reg("_slice_assign_scalar",
         lambda data, scalar=0.0, begin=(), end=(), step=None:
         j.asarray(data).at[_slice_from(begin, end, step)].set(scalar))
-    reg("_scatter_set_nd",
-        lambda lhs, indices, shape=None: None)  # covered by index_update
-    _OPS.pop("_scatter_set_nd", None)
     reg("_scatter_set_nd", raw(npx.index_update))
 
 

@@ -1,0 +1,114 @@
+"""Expert parallelism: Mixture-of-Experts routing over an 'ep' mesh axis.
+
+New capability beyond the reference (SURVEY §2.4: the reference has only
+data parallelism). GShard-style top-k token routing: a router scores
+tokens, dispatch/combine tensors route them to per-expert FFNs, and the
+expert dimension is sharded over the mesh's 'ep' axis — XLA lowers the
+dispatch einsums into all-to-alls over ICI.
+
+The math follows the public GShard/Switch formulation (top-k gating with
+capacity and auxiliary load-balancing loss); the implementation is dense
+einsum routing, the layout XLA maps best onto the MXU.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+__all__ = ["top_k_routing", "moe_ffn", "moe_ffn_sharded", "init_moe_params"]
+
+
+def top_k_routing(router_logits, num_experts, capacity, top_k=2):
+    """Compute dispatch/combine tensors from router logits.
+
+    router_logits: (T, E) for T tokens. Returns
+      dispatch (T, E, C) one-hot routing, combine (T, E, C) gate-weighted,
+      aux_loss (scalar load-balancing loss, Switch-style).
+    """
+    T = router_logits.shape[0]
+    probs = jax.nn.softmax(router_logits, axis=-1)           # (T, E)
+    # top-k expert choices per token
+    gate_vals, expert_idx = jax.lax.top_k(probs, top_k)      # (T, k)
+    # position of each token within its expert's capacity buffer:
+    # cumulative count of earlier tokens choosing the same expert
+    onehot = jax.nn.one_hot(expert_idx, num_experts,
+                            dtype=jnp.int32)                 # (T, k, E)
+    # order: iterate k slots major so primary choices claim slots first
+    flat = onehot.transpose(1, 0, 2).reshape(top_k * T, num_experts)
+    pos_flat = jnp.cumsum(flat, axis=0) - flat               # (k*T, E)
+    pos = pos_flat.reshape(top_k, T, num_experts).transpose(1, 0, 2)
+    slot = jnp.sum(pos * onehot, axis=-1)                    # (T, k)
+    keep = slot < capacity
+    gate_vals = gate_vals * keep
+    # renormalize kept gates per token
+    denom = jnp.maximum(gate_vals.sum(-1, keepdims=True), 1e-9)
+    gate_vals = gate_vals / denom
+    slot_oh = jax.nn.one_hot(jnp.where(keep, slot, capacity),
+                             capacity + 1,
+                             dtype=router_logits.dtype)[..., :capacity]
+    exp_oh = jax.nn.one_hot(expert_idx, num_experts,
+                            dtype=router_logits.dtype)       # (T, k, E)
+    dispatch = jnp.einsum("tke,tkc->tec", exp_oh,
+                          slot_oh * keep[..., None])
+    combine = jnp.einsum("tke,tkc->tec", exp_oh,
+                         slot_oh * gate_vals[..., None])
+    # Switch aux loss: E * sum_e fraction_tokens_e * mean_prob_e
+    primary = jax.nn.one_hot(expert_idx[:, 0], num_experts,
+                             dtype=probs.dtype)
+    frac = primary.mean(0)
+    aux = num_experts * jnp.sum(frac * probs.mean(0))
+    return dispatch, combine, aux
+
+
+def init_moe_params(key, d_model, d_hidden, num_experts, dtype=jnp.float32):
+    """Router + per-expert FFN weights (E stacked for ep sharding)."""
+    k1, k2, k3 = jax.random.split(key, 3)
+    scale_in = 1.0 / jnp.sqrt(d_model)
+    scale_out = 1.0 / jnp.sqrt(d_hidden)
+    return {
+        "router": jax.random.normal(k1, (d_model, num_experts),
+                                    dtype) * scale_in,
+        "wi": jax.random.normal(k2, (num_experts, d_model, d_hidden),
+                                dtype) * scale_in,
+        "wo": jax.random.normal(k3, (num_experts, d_hidden, d_model),
+                                dtype) * scale_out,
+    }
+
+
+def moe_ffn(params, x, capacity_factor=1.25, top_k=2):
+    """MoE FFN over tokens x (T, D). Returns (out (T, D), aux_loss)."""
+    T, D = x.shape
+    E = params["router"].shape[1]
+    capacity = max(1, int(capacity_factor * T * top_k / E))
+    logits = x @ params["router"]
+    dispatch, combine, aux = top_k_routing(logits, E, capacity, top_k)
+    expert_in = jnp.einsum("tec,td->ecd", dispatch, x)       # (E, C, D)
+    h = jax.nn.gelu(jnp.einsum("ecd,edh->ech", expert_in,
+                               params["wi"]))
+    expert_out = jnp.einsum("ech,ehd->ecd", h, params["wo"])
+    out = jnp.einsum("tec,ecd->td", combine, expert_out)
+    return out, aux
+
+
+def moe_ffn_sharded(params, x, mesh, axis="ep", capacity_factor=1.25,
+                    top_k=2):
+    """jit moe_ffn with the expert dimension sharded over `axis`; XLA
+    inserts the token all-to-alls around the expert matmuls."""
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    ep = NamedSharding(mesh, P(axis))
+    repl = NamedSharding(mesh, P())
+    params = {
+        "router": jax.device_put(params["router"], repl),
+        "wi": jax.device_put(params["wi"], ep),
+        "wo": jax.device_put(params["wo"], ep),
+    }
+    x = jax.device_put(x, repl)
+
+    @jax.jit
+    def run(p, xx):
+        out, aux = moe_ffn(p, xx, capacity_factor, top_k)
+        return out, aux
+
+    with mesh:
+        return run(params, x)

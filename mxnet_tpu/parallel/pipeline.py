@@ -1,0 +1,105 @@
+"""Pipeline parallelism: GPipe-style microbatched stage execution over a
+'pp' mesh axis.
+
+New capability beyond the reference (SURVEY §2.4: its closest artifact is
+a manual model-parallel LSTM recipe). Stage parameters are stacked on a
+leading stage dimension and sharded over 'pp'; inside `shard_map` each
+device runs its own stage and hands activations to the next stage with
+`ppermute` over ICI. The schedule is the classic GPipe fill-drain loop:
+`n_micro + n_stages - 1` ticks, bubble fraction (S-1)/(M+S-1).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+__all__ = ["pipeline_apply", "pipeline_apply_sharded"]
+
+
+def pipeline_apply(stage_fn, stacked_params, microbatches, axis_name):
+    """Run inside shard_map/pmap over `axis_name` (one device = one
+    stage).
+
+    stage_fn(params, x) -> y applies one stage; stacked_params has a
+    leading stage dim already sharded to size 1 per device (shard_map
+    gives the local slice WITH the dim). microbatches: (M, ...) —
+    replicated; every stage sees all microbatches, stage 0 consumes
+    them, later stages consume ppermuted activations. Returns (M, ...)
+    stage outputs valid on the LAST stage (zeros elsewhere).
+    """
+    n_stages = jax.lax.psum(1, axis_name)
+    stage_id = jax.lax.axis_index(axis_name)
+    n_micro = microbatches.shape[0]
+    local_params = jax.tree_util.tree_map(lambda p: p[0], stacked_params)
+
+    # probe output shape: activations between stages share the
+    # microbatch shape (standard GPipe homogeneous-stage contract)
+    out_shape = jax.eval_shape(stage_fn, local_params, microbatches[0])
+    carry = jnp.zeros(out_shape.shape, out_shape.dtype)
+    outputs = jnp.zeros((n_micro,) + out_shape.shape, out_shape.dtype)
+    perm = [(i, i + 1) for i in range(n_stages - 1)]
+
+    def tick(state, t):
+        carry, outputs = state
+        # stage 0 feeds microbatch t (when in range); others use carry
+        mb_idx = jnp.clip(t, 0, n_micro - 1)
+        x = jnp.where(stage_id == 0,
+                      microbatches[mb_idx], carry)
+        y = stage_fn(local_params, x)
+        # valid iff this stage is currently processing a real microbatch:
+        # stage s works on microbatch t - s
+        mb_of_stage = t - stage_id
+        valid = (mb_of_stage >= 0) & (mb_of_stage < n_micro)
+        y = jnp.where(valid, y, jnp.zeros_like(y))
+        # last stage records its finished microbatch
+        out_idx = jnp.clip(mb_of_stage, 0, n_micro - 1)
+        record = valid & (stage_id == n_stages - 1)
+        outputs = jax.lax.cond(
+            record,
+            lambda o: o.at[out_idx].set(y),
+            lambda o: o,
+            outputs)
+        # hand activations to the next stage
+        carry = jax.lax.ppermute(y, axis_name, perm)
+        return (carry, outputs), None
+
+    total = n_micro + n_stages - 1
+    # scan (not fori_loop) so the schedule is reverse-differentiable —
+    # pipelined BACKWARD falls out of jax.grad through the same loop
+    (_, outputs), _ = jax.lax.scan(tick, (carry, outputs),
+                                   jnp.arange(total))
+    # make the final outputs visible on every stage (callers usually
+    # need the loss everywhere); sum works since other stages hold zeros
+    return jax.lax.psum(outputs, axis_name)
+
+
+def pipeline_apply_sharded(stage_fn, stacked_params, microbatches, mesh,
+                           axis="pp"):
+    """Jit pipeline_apply under shard_map over `axis`.
+
+    stacked_params: pytree with leading dim n_stages == mesh.shape[axis].
+    microbatches: (M, ...) replicated across stages.
+    """
+    from jax.experimental.shard_map import shard_map
+
+    n_stages = mesh.shape[axis]
+    for leaf in jax.tree_util.tree_leaves(stacked_params):
+        assert leaf.shape[0] == n_stages, \
+            f"stage dim {leaf.shape[0]} != mesh axis size {n_stages}"
+
+    param_specs = jax.tree_util.tree_map(
+        lambda p: P(axis, *([None] * (p.ndim - 1))), stacked_params)
+    fn = shard_map(
+        lambda params, mb: pipeline_apply(stage_fn, params, mb, axis),
+        mesh=mesh,
+        in_specs=(param_specs, P()),
+        out_specs=P(),
+        check_rep=False,
+    )
+    stacked_params = jax.tree_util.tree_map(
+        lambda p, spec: jax.device_put(p, NamedSharding(mesh, spec)),
+        stacked_params, param_specs)
+    microbatches = jax.device_put(microbatches, NamedSharding(mesh, P()))
+    with mesh:
+        return jax.jit(fn)(stacked_params, microbatches)

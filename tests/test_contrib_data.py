@@ -120,3 +120,19 @@ def test_image_bbox_dataloader():
     aug = create_bbox_augment((3, 24, 24), rand_mirror=True)
     i2, b2 = aug(mx.np.array(det[0][0]), det[0][1])
     assert i2.shape == (24, 24, 3)
+
+
+def test_bbox_augment_applies_color_augs():
+    """Review regression: color-jitter args must actually change the
+    image (reference create_bbox_augment applies them)."""
+    rs = onp.random.RandomState(0)
+    img = mx.np.array(rs.randint(40, 200, (32, 32, 3)).astype("uint8"))
+    bb = onp.array([[2, 2, 20, 20, 0]], "f")
+    mx.seed(3)
+    aug = create_bbox_augment((3, 32, 32), brightness=0.9, contrast=0.9,
+                              saturation=0.9, rand_gray=1.0)
+    out_img, out_bb = aug(img, bb)
+    # gray conversion guarantees the channels equalize -> image changed
+    arr = out_img.asnumpy()
+    assert not onp.array_equal(arr, img.asnumpy())
+    assert onp.allclose(arr[..., 0], arr[..., 1], atol=2)  # grayscale

@@ -241,3 +241,34 @@ def test_batch_processor_custom():
                     batch_processor=Doubler())
     est.fit(loader, val_data=loader, epochs=2)
     assert calls["fit"] == 6 and calls["eval"] == 6
+
+
+def test_estimator_val_net_and_loss():
+    """Separate validation net/loss sharing parameters (reference:
+    estimator.py val_net/val_loss)."""
+    import numpy as onp
+
+    from mxnet_tpu.gluon.contrib.estimator import Estimator
+
+    net = gluon.nn.Dense(3)
+    net.initialize()
+    calls = {"val": 0}
+
+    class ValWrapper(gluon.nn.HybridBlock):
+        def __init__(self, inner):
+            super().__init__()
+            self.inner = inner
+
+        def forward(self, x):
+            calls["val"] += 1
+            return self.inner(x)
+
+    rs = onp.random.RandomState(0)
+    ds = gluon.data.ArrayDataset(rs.rand(8, 4).astype("f"),
+                                 (rs.rand(8) * 3).astype("i"))
+    loader = gluon.data.DataLoader(ds, batch_size=4)
+    est = Estimator(net, gluon.loss.SoftmaxCrossEntropyLoss(),
+                    val_net=ValWrapper(net),
+                    val_loss=gluon.loss.SoftmaxCrossEntropyLoss())
+    est.fit(loader, val_data=loader, epochs=1)
+    assert calls["val"] == 2  # val runs through the wrapper

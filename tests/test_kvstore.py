@@ -191,3 +191,16 @@ def test_p3_chunked_applies_gradient_compression(monkeypatch):
     small.pushpull(0, [mx.np.array(v) for v in raw], out=outs_small)
     onp.testing.assert_allclose(outs_big[0].asnumpy(),
                                 outs_small[0].asnumpy(), rtol=1e-5)
+
+
+def test_horovod_byteps_adapters_registered():
+    """Adapter classes exist (reference: kvstore/horovod.py, byteps.py);
+    without the packages, create() falls back to the XLA store."""
+    from mxnet_tpu.kvstore.base import KVStoreBase
+    from mxnet_tpu.kvstore.tpu_dist import TPUDist
+
+    assert KVStoreBase.find("horovod") is not None
+    assert KVStoreBase.find("byteps") is not None
+    # no horovod/byteps in this image -> tpu_dist fallback
+    assert isinstance(kvstore.create("horovod"), TPUDist)
+    assert isinstance(kvstore.create("byteps"), TPUDist)

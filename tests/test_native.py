@@ -2,6 +2,7 @@
 pipeline, pooled storage, RecordIO (reference test models:
 tests/cpp/engine/threaded_engine_test.cc, tests/python/unittest/
 test_engine.py, test_exc_handling.py, test_recordio.py)."""
+import os
 import struct
 import threading
 import time
@@ -10,6 +11,8 @@ import numpy as np
 import pytest
 
 from mxnet_tpu import _native, engine, recordio, storage
+
+REPO = os.path.abspath(os.path.join(os.path.dirname(__file__), ".."))
 
 pytestmark = pytest.mark.skipif(not _native.available(),
                                 reason="native lib unavailable")
@@ -286,3 +289,42 @@ def test_nd_save_load_async_barrier(tmp_path):
     save(path, data)           # async
     out = load(path)           # barriers on the path var
     onp.testing.assert_allclose(out["a"].asnumpy(), onp.ones(4))
+
+
+def test_priority_scheduling_order():
+    """Higher-priority ops run first when queued (reference:
+    ThreadedEnginePerDevice priority queues, threaded_engine_perdevice.cc).
+    Runs in a 1-worker subprocess so queue order is observable."""
+    import subprocess
+    import sys
+
+    script = r"""
+import jax; jax.config.update("jax_platforms", "cpu")
+import threading
+from mxnet_tpu import engine
+
+eng = engine.native_engine()
+assert eng is not None
+gate = threading.Event()
+order = []
+blocker_var = eng.new_var()
+# occupy the single worker so subsequent pushes stack in the queue
+eng.push(gate.wait, mutable_vars=[blocker_var])
+vars_ = [eng.new_var() for _ in range(4)]
+for i, prio in enumerate([0, 5, -3, 9]):
+    eng.push(lambda i=i: order.append(i), mutable_vars=[vars_[i]],
+             priority=prio)
+gate.set()
+engine.waitall()
+# expected: priority 9 (op 3), 5 (op 1), 0 (op 0), -3 (op 2)
+assert order == [3, 1, 0, 2], order
+print("PRIORITY OK", order)
+"""
+    env = dict(os.environ, MXTPU_CPU_WORKER_NTHREADS="1",
+               JAX_PLATFORMS="cpu", PYTHONPATH=REPO)
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+    run = subprocess.run([sys.executable, "-c", script],
+                         capture_output=True, text=True, timeout=120,
+                         env=env)
+    assert run.returncode == 0, run.stdout + run.stderr
+    assert "PRIORITY OK" in run.stdout

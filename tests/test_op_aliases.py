@@ -53,8 +53,12 @@ def test_internal_spellings_compute():
     onp.testing.assert_allclose(
         get_op("_npi_cholesky")(jnp.eye(3) * 4.0), jnp.eye(3) * 2.0)
     assert get_op("_npi_tensordot_int_axes")(a, a, 1).shape == (2, 2)
-    w = get_op("_npi_where_lscalar")(a > 2, 1.0, a)
+    # lscalar: called (cond, y_tensor, x_scalar), scalar is the TRUE
+    # branch (reference: symbol/numpy/_symbol.py:7606)
+    w = get_op("_npi_where_lscalar")(a > 2, a, 1.0)
     onp.testing.assert_allclose(w, jnp.where(a > 2, 1.0, a))
+    w2 = get_op("_npi_where_rscalar")(a > 2, a, 1.0)
+    onp.testing.assert_allclose(w2, jnp.where(a > 2, a, 1.0))
     out = get_op("_slice_assign_scalar")(a, 9.0, (0, 0), (1, 2))
     onp.testing.assert_allclose(out[0], [9.0, 9.0])
     onp.testing.assert_allclose(out[1], a[1])
