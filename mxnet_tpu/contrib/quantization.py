@@ -447,7 +447,7 @@ def _act_fn(x, act_type="relu"):
     if act_type == "tanh":
         return jnp.tanh(x)
     if act_type == "softrelu":
-        return jnp.log1p(jnp.exp(x))
+        return jax.nn.softplus(x)  # stable: log1p(exp) overflows fp32
     raise ValueError(f"unknown act_type {act_type!r}")
 
 

@@ -121,3 +121,13 @@ register(
     "MXNET_GPU_MEM_POOL_TYPE", str, "Naive",
     "Parity no-op on TPU: device memory pooling is PJRT's "
     "(reference: pooled_storage_manager.h buckets).")
+register(
+    "MXTPU_IO_WORKER_NTHREADS", int, 2,
+    "Native-runtime IO worker threads (checkpoint writes, RecordIO "
+    "prefetch; reference: the IO-priority pool of "
+    "threaded_engine_perdevice.cc).")
+register(
+    "MXTPU_BENCH_BUDGET_S", int, 1200,
+    "bench.py wall-clock budget (seconds); secondary rows are skipped "
+    "with an error row once exceeded so the driver always gets the "
+    "headline JSON quickly.")

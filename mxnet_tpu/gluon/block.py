@@ -149,7 +149,26 @@ class Block:
 
     # -- forward ----------------------------------------------------------
     def __call__(self, *args, **kwargs):
-        return self.forward(*args, **kwargs)
+        out = self.forward(*args, **kwargs)
+        self._fire_fwd_hooks(args, out)
+        return out
+
+    def _fire_fwd_hooks(self, args, out):
+        hooks = getattr(self, "_fwd_hooks", ())
+        if not hooks:
+            return
+        # never hand tracer-backed values to monitor callbacks: under jit
+        # tracing a value-reading hook would crash (and fire only once at
+        # trace time) — the reference's op hooks likewise observe only
+        # executed values, not graph construction
+        vals = list(args) + (list(out) if isinstance(out, (list, tuple))
+                             else [out])
+        for v in vals:
+            data = getattr(v, "_data", None)
+            if data is not None and isinstance(data, jax.core.Tracer):
+                return
+        for hook in hooks:
+            hook(self, args, out)
 
     def forward(self, *args):
         raise NotImplementedError
@@ -246,6 +265,38 @@ class Block:
         self._clear_cached()
 
     # misc parity helpers
+    def register_op_hook(self, callback, monitor_all=False):
+        """Install a monitor callback on every descendant block's forward
+        (reference: block.py:877 register_op_hook -> CachedOp::
+        RegisterOpHook). callback(block_name, tensor_name, tensor) fires
+        for each output (and each input when monitor_all=True).
+
+        Granularity note: ops fuse inside the jit boundary on TPU, so the
+        observable unit is the block forward — the analog of the
+        reference hiding per-op detail under bulked exec
+        (docs perf.md:293-296); hybridized blocks report at the jit
+        boundary. Use MXNET_EXEC_BULK_EXEC-style de-optimization by
+        calling .hybridize(active=False) for per-block detail."""
+        def make_hook(prefix):
+            def hook(block, inputs, output):
+                name = prefix or type(block).__name__
+                if monitor_all:
+                    for i, a in enumerate(inputs):
+                        callback(name, f"{name}_input{i}", a)
+                outs = (output if isinstance(output, (list, tuple))
+                        else [output])
+                for i, o in enumerate(outs):
+                    callback(name, f"{name}_output{i}", o)
+            return hook
+
+        def walk(block, prefix):
+            block.register_forward_hook(make_hook(prefix))
+            for cname, child in block._children.items():
+                walk(child, f"{prefix}.{cname}" if prefix else cname)
+
+        walk(self, "")
+        return self
+
     def register_forward_hook(self, hook):
         hooks = getattr(self, "_fwd_hooks", None)
         if hooks is None:
@@ -385,8 +436,7 @@ class HybridBlock(Block):
             if self._active:
                 return self._call_cached(*args)
         out = self.forward(*args, **kwargs)
-        for hook in getattr(self, "_fwd_hooks", ()):
-            hook(self, args, out)
+        self._fire_fwd_hooks(args, out)
         return out
 
     # -- deferred shape inference -----------------------------------------

@@ -150,11 +150,7 @@ def create_bbox_augment(data_shape, rand_crop=0, rand_pad=0, rand_gray=0,
         color_augs.append(_img.HueJitterAug(hue))
     if pca_noise:
         color_augs.append(_img.LightingAug(
-            pca_noise,
-            _np.asarray([55.46, 4.794, 1.148]),
-            _np.asarray([[-0.5675, 0.7192, 0.4009],
-                         [-0.5808, -0.0045, -0.8140],
-                         [-0.5836, -0.6948, 0.4203]])))
+            pca_noise, _img.PCA_EIGVAL, _img.PCA_EIGVEC))
     if rand_gray:
         color_augs.append(_img.RandomGrayAug(rand_gray))
     pair.extend(_Borrow(a) for a in color_augs)

@@ -351,6 +351,13 @@ class SaturationJitterAug(Augmenter):
         return NDArray(arr * alpha + gray * (1 - alpha))
 
 
+# ImageNet RGB PCA statistics (the AlexNet lighting-noise constants)
+PCA_EIGVAL = _np.array([55.46, 4.794, 1.148])
+PCA_EIGVEC = _np.array([[-0.5675, 0.7192, 0.4009],
+                        [-0.5808, -0.0045, -0.8140],
+                        [-0.5836, -0.6948, 0.4203]])
+
+
 class HueJitterAug(Augmenter):
     def __init__(self, hue):
         super().__init__(hue=hue)
@@ -467,11 +474,7 @@ def CreateAugmenter(data_shape, resize=0, rand_crop=False, rand_resize=False,  #
     if hue:
         auglist.append(HueJitterAug(hue))
     if pca_noise > 0:
-        eigval = _np.array([55.46, 4.794, 1.148])
-        eigvec = _np.array([[-0.5675, 0.7192, 0.4009],
-                            [-0.5808, -0.0045, -0.8140],
-                            [-0.5836, -0.6948, 0.4203]])
-        auglist.append(LightingAug(pca_noise, eigval, eigvec))
+        auglist.append(LightingAug(pca_noise, PCA_EIGVAL, PCA_EIGVEC))
     if rand_gray > 0:
         auglist.append(RandomGrayAug(rand_gray))
     if mean is True:

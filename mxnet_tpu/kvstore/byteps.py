@@ -14,15 +14,20 @@ __all__ = ["BytePS"]
 @KVStoreBase.register
 class BytePS(KVStoreBase):
     def __init__(self):
+        # byteps.mxnet, like horovod.mxnet, moves MXNet C-handle arrays;
+        # jax-backed tensors cannot cross that ABI, so construction
+        # raises either way and kvstore.create() falls back to tpu_dist.
         try:
-            import byteps.mxnet as bps  # noqa: PLC0415
+            import byteps.mxnet as bps  # noqa: PLC0415,F401
         except ImportError as e:
             raise ImportError(
-                "kvstore='byteps' requires the byteps package, which has "
-                "no TPU backend; use kvstore='tpu_dist' — the XLA "
-                "collective store with the same pushpull contract") from e
-        self._bps = bps
-        bps.init()
+                "kvstore='byteps' requires the byteps package; use "
+                "kvstore='tpu_dist' — the XLA collective store with the "
+                "same pushpull contract") from e
+        raise ImportError(
+            "byteps.mxnet drives MXNet C-handle arrays and has no "
+            "jax/TPU backend; use kvstore='tpu_dist' (kvstore.create "
+            "falls back automatically)")
 
     @property
     def rank(self):

@@ -16,15 +16,22 @@ __all__ = ["Horovod"]
 @KVStoreBase.register
 class Horovod(KVStoreBase):
     def __init__(self):
+        # horovod.mxnet operates on MXNet C-handle NDArrays; this
+        # framework's arrays are jax-backed, so even with horovod
+        # installed the adapter cannot hand tensors across. Raise
+        # ImportError either way — kvstore.create() falls back to
+        # tpu_dist, whose pushpull honors the same contract.
         try:
-            import horovod.mxnet as hvd  # noqa: PLC0415
+            import horovod.mxnet as hvd  # noqa: PLC0415,F401
         except ImportError as e:
             raise ImportError(
-                "kvstore='horovod' requires the horovod package, which "
-                "has no TPU backend; use kvstore='tpu_dist' — the XLA "
-                "collective store with the same pushpull contract") from e
-        self._hvd = hvd
-        hvd.init()
+                "kvstore='horovod' requires the horovod package; use "
+                "kvstore='tpu_dist' — the XLA collective store with the "
+                "same pushpull contract") from e
+        raise ImportError(
+            "horovod.mxnet drives MXNet C-handle arrays and has no "
+            "jax/TPU backend; use kvstore='tpu_dist' (kvstore.create "
+            "falls back automatically)")
 
     @property
     def rank(self):

@@ -255,3 +255,48 @@ def test_uninitialized_raises():
     net = nn.Dense(2, in_units=2)
     with pytest.raises(RuntimeError, match="initialize"):
         net(np.ones((1, 2)))
+
+
+def test_register_op_hook():
+    """Monitor callbacks fire per descendant forward (reference:
+    block.py:877 register_op_hook)."""
+    seen = []
+
+    net = gluon.nn.HybridSequential()
+    net.add(gluon.nn.Dense(4, activation="relu"), gluon.nn.Dense(2))
+    net.initialize()
+    net.register_op_hook(
+        lambda name, tname, arr: seen.append((tname, tuple(arr.shape))))
+    x = mx.np.ones((3, 5))
+    net(x)
+    names = [t for t, _ in seen]
+    assert any("0" in n for n in names) and any(
+        n.endswith("output0") for n in names)
+    # per-layer outputs observed with correct shapes
+    shapes = dict(seen)
+    assert (3, 2) in shapes.values()
+    # monitor_all also reports inputs
+    seen.clear()
+    net2 = gluon.nn.Dense(2, in_units=3)
+    net2.initialize()
+    net2.register_op_hook(
+        lambda name, tname, arr: seen.append(tname), monitor_all=True)
+    net2(mx.np.ones((1, 3)))
+    assert any("input" in n for n in seen)
+
+
+def test_register_op_hook_skips_tracing():
+    """Review regression: hooks must not fire on tracer values under
+    hybridize (value-reading callbacks would crash at trace time)."""
+    seen = []
+    net = gluon.nn.HybridSequential()
+    net.add(gluon.nn.Dense(4), gluon.nn.Dense(2))
+    net.initialize()
+    net.hybridize()
+    net.register_op_hook(
+        lambda name, t, arr: seen.append(float(abs(arr.asnumpy()).max())))
+    x = mx.np.ones((3, 5))
+    net(x)   # traces + runs: hook sees only the concrete jit-boundary out
+    net(x)   # cache hit: fires again (not once-at-trace)
+    assert len(seen) >= 2
+    assert all(isinstance(v, float) for v in seen)
