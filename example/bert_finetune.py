@@ -1,0 +1,54 @@
+"""BERT-base QA fine-tuning skeleton, bf16 (BASELINE config #4;
+reference: the SQuAD fine-tune scripts in the gluon-nlp era docs)."""
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--steps", type=int, default=3)
+    ap.add_argument("--batch-size", type=int, default=4)
+    ap.add_argument("--seq", type=int, default=128)
+    ap.add_argument("--layers", type=int, default=12)
+    ap.add_argument("--cpu", action="store_true")
+    args = ap.parse_args()
+    if args.cpu:
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
+
+    import numpy as onp
+
+    import mxnet_tpu as mx
+    from mxnet_tpu import amp, autograd, gluon
+    from mxnet_tpu.gluon.model_zoo.bert import BERTForQA, get_bert_model
+
+    mx.seed(0)
+    net = BERTForQA(get_bert_model(num_layers=args.layers, units=768,
+                                   hidden_size=3072, num_heads=12,
+                                   vocab_size=30522, dropout=0.1))
+    net.initialize()
+    amp.convert_hybrid_block(net, target_dtype="bfloat16")
+    trainer = gluon.Trainer(net.collect_params(), "adamw",
+                            {"learning_rate": 3e-5})
+    rs = onp.random.RandomState(0)
+    B, S = args.batch_size, args.seq
+    for step in range(args.steps):
+        toks = mx.np.array(rs.randint(0, 30000, (B, S)))
+        segs = mx.np.zeros((B, S), dtype="int32")
+        starts = mx.np.array(rs.randint(0, S, (B,)))
+        ends = mx.np.array(rs.randint(0, S, (B,)))
+        lossfn = gluon.loss.SoftmaxCrossEntropyLoss()
+        with autograd.record():
+            s_logits, e_logits = net(toks, segs)
+            loss = lossfn(s_logits, starts) + lossfn(e_logits, ends)
+        loss.backward()
+        trainer.step(B)
+        print(f"step {step}: loss {float(loss.mean()):.4f}")
+
+
+if __name__ == "__main__":
+    main()

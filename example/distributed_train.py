@@ -1,0 +1,49 @@
+"""Distributed data-parallel training: one process per host, XLA
+collectives for gradient exchange (reference:
+example/distributed_training/; launch with
+  python tools/launch.py -n 2 python example/distributed_train.py).
+"""
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+
+def main():
+    import numpy as onp
+
+    import mxnet_tpu as mx
+    from mxnet_tpu import autograd, gluon
+
+    # join the job BEFORE any jax computation (jax.distributed must
+    # initialize before the backend; see tools/launch.py env wiring)
+    kv = mx.kvstore.create("tpu_dist")
+    mx.seed(0)
+    rank, nworkers = kv.rank, kv.num_workers
+    print(f"[rank {rank}] joined job of {nworkers}")
+
+    net = gluon.nn.HybridSequential()
+    net.add(gluon.nn.Dense(64, activation="relu"), gluon.nn.Dense(10))
+    net.initialize()
+    net(mx.np.zeros((1, 20)))  # materialize deferred shapes
+    # every rank starts from rank 0's params
+    for i, p in enumerate(net.collect_params().values()):
+        kv.broadcast(i, p.data(), out=p.data())
+    trainer = gluon.Trainer(net.collect_params(), "sgd",
+                            {"learning_rate": 0.1}, kvstore=kv)
+    lossfn = gluon.loss.SoftmaxCrossEntropyLoss()
+    rs = onp.random.RandomState(100 + rank)  # rank-local shard
+    for step in range(5):
+        x = mx.np.array(rs.rand(32, 20).astype("f"))
+        y = mx.np.array(rs.randint(0, 10, (32,)))
+        with autograd.record():
+            loss = lossfn(net(x), y)
+        loss.backward()
+        trainer.step(32 * nworkers)
+        if rank == 0:
+            print(f"step {step}: loss {float(loss.mean()):.4f}")
+    print(f"[rank {rank}] done")
+
+
+if __name__ == "__main__":
+    main()

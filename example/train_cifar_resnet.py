@@ -1,0 +1,60 @@
+"""ResNet-18 on CIFAR-10, hybridized + bf16 AMP (BASELINE config #2
+style; reference: example/image-classification/train_cifar10.py)."""
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--epochs", type=int, default=1)
+    ap.add_argument("--batch-size", type=int, default=64)
+    ap.add_argument("--lr", type=float, default=0.05)
+    ap.add_argument("--limit", type=int, default=0)
+    ap.add_argument("--bf16", action="store_true")
+    ap.add_argument("--cpu", action="store_true")
+    args = ap.parse_args()
+    if args.cpu:
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
+
+    import mxnet_tpu as mx
+    from mxnet_tpu import amp, autograd, gluon
+
+    mx.seed(0)
+    train = gluon.data.vision.CIFAR10(train=True)
+    if args.limit:
+        train = gluon.data.SimpleDataset(
+            [train[i] for i in range(min(args.limit, len(train)))])
+    loader = gluon.data.DataLoader(train, batch_size=args.batch_size,
+                                   shuffle=True, last_batch="discard")
+
+    net = gluon.model_zoo.vision.resnet18_v1(classes=10)
+    net.initialize()
+    if args.bf16:
+        amp.convert_hybrid_block(net, target_dtype="bfloat16")
+    net.hybridize()
+    lossfn = gluon.loss.SoftmaxCrossEntropyLoss()
+    trainer = gluon.Trainer(net.collect_params(), "nag",
+                            {"learning_rate": args.lr, "momentum": 0.9,
+                             "wd": 1e-4})
+    metric = gluon.metric.Accuracy()
+    for epoch in range(args.epochs):
+        metric.reset()
+        for x, y in loader:
+            x = x.astype("bfloat16" if args.bf16 else "float32") / 255.0
+            x = x.transpose(0, 3, 1, 2)
+            with autograd.record():
+                out = net(x)
+                loss = lossfn(out, y)
+            loss.backward()
+            trainer.step(x.shape[0])
+            metric.update(y, out)
+        print(f"epoch {epoch}: {metric.get()[0]} = {metric.get()[1]:.4f}")
+
+
+if __name__ == "__main__":
+    main()

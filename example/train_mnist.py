@@ -1,0 +1,71 @@
+"""LeNet on MNIST, imperative mode (BASELINE config #1; reference:
+example/image-classification/train_mnist.py).
+
+Runs on the TPU chip when reachable, CPU otherwise. Use
+--epochs 1 --limit 512 for a smoke run.
+"""
+import argparse
+import os
+import sys
+
+# allow running straight from a repo checkout
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--epochs", type=int, default=2)
+    ap.add_argument("--batch-size", type=int, default=64)
+    ap.add_argument("--lr", type=float, default=0.05)
+    ap.add_argument("--limit", type=int, default=0,
+                    help="cap samples per epoch (0 = all)")
+    ap.add_argument("--cpu", action="store_true")
+    args = ap.parse_args()
+    if args.cpu:
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
+
+    import mxnet_tpu as mx
+    from mxnet_tpu import autograd, gluon
+
+    mx.seed(0)
+    train = gluon.data.vision.MNIST(train=True)  # synthetic fallback when files absent
+    if args.limit:
+        train = gluon.data.SimpleDataset(
+            [train[i] for i in range(min(args.limit, len(train)))])
+    loader = gluon.data.DataLoader(
+        train, batch_size=args.batch_size, shuffle=True,
+        last_batch="discard")
+
+    net = gluon.model_zoo.vision.get_model("lenet", classes=10)
+    net.initialize()
+    net.hybridize()
+    lossfn = gluon.loss.SoftmaxCrossEntropyLoss()
+    trainer = gluon.Trainer(net.collect_params(), "sgd",
+                            {"learning_rate": args.lr, "momentum": 0.9})
+    metric = gluon.metric.Accuracy()
+
+    for epoch in range(args.epochs):
+        metric.reset()
+        for x, y in loader:
+            x = x.astype("float32") / 255.0
+            if x.ndim == 3:
+                x = x.reshape(x.shape[0], 1, 28, 28)
+            elif x.shape[-1] == 1:
+                x = x.transpose(0, 3, 1, 2)
+            with autograd.record():
+                out = net(x)
+                loss = lossfn(out, y)
+            loss.backward()
+            trainer.step(x.shape[0])
+            metric.update(y, out)
+        print(f"epoch {epoch}: train {metric.get()[0]} ="
+              f" {metric.get()[1]:.4f}")
+    name, acc = metric.get()
+    print(f"final {name}: {acc:.4f}")
+    return acc
+
+
+if __name__ == "__main__":
+    main()

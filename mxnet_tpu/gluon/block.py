@@ -25,6 +25,7 @@ from __future__ import annotations
 
 import json
 import re
+import threading as _threading
 
 import jax
 import jax.numpy as jnp
@@ -77,6 +78,24 @@ class _push_sink:
 # ---------------------------------------------------------------------------
 # Block
 # ---------------------------------------------------------------------------
+
+_hook_suppress = _threading.local()
+
+
+def _hooks_suppressed():
+    return getattr(_hook_suppress, "depth", 0) > 0
+
+
+class _suppress_hooks:
+    """Forward hooks stay silent during shape-inference dry passes (the
+    deferred-init eager pass is plumbing, not a reportable forward)."""
+
+    def __enter__(self):
+        _hook_suppress.depth = getattr(_hook_suppress, "depth", 0) + 1
+
+    def __exit__(self, *exc):
+        _hook_suppress.depth -= 1
+
 
 class Block:
     """Base container (reference: gluon/block.py:202)."""
@@ -155,7 +174,7 @@ class Block:
 
     def _fire_fwd_hooks(self, args, out):
         hooks = getattr(self, "_fwd_hooks", ())
-        if not hooks:
+        if not hooks or _hooks_suppressed():
             return
         # never hand tracer-backed values to monitor callbacks: under jit
         # tracing a value-reading hook would crash (and fire only once at
@@ -443,7 +462,7 @@ class HybridBlock(Block):
     def infer_shape(self, *args):
         """Run a shape-only eager pass so deferred params materialize
         (reference: HybridBlock.infer_shape, block.py:1462)."""
-        with ag.pause():
+        with ag.pause(), _suppress_hooks():
             self.forward(*args)
 
     # -- the CachedOp ------------------------------------------------------
@@ -454,8 +473,9 @@ class HybridBlock(Block):
                     p._check_initialized()
             return
         except DeferredInitializationError:
-            # one eager pass completes deferred init (layers infer shapes)
-            with ag.pause():
+            # one eager pass completes deferred init (layers infer
+            # shapes); monitor hooks stay silent — it is plumbing
+            with ag.pause(), _suppress_hooks():
                 self.forward(*args)
 
     def _make_cached_fn(self, training):

@@ -30,18 +30,18 @@ def create(name="local"):
     if name_l == "p3":
         return P3Store()
     if name_l in ("horovod", "byteps"):
-        # real adapter when the package exists (reference:
-        # kvstore/horovod.py, byteps.py); TPU deployments fall back to
-        # the XLA-collective store, which honors the same contract
+        # the registered adapter raises ImportError (package missing, or
+        # present but jax-incompatible — see kvstore/horovod.py); fall
+        # back to the XLA-collective store, which honors the contract
         try:
             cls = KVStoreBase.find(name_l)
             return cls()
-        except Exception:  # unusable adapter -> the XLA store
+        except ImportError as e:
             import logging
 
             logging.getLogger(__name__).info(
-                "%s not installed; kvstore='%s' falling back to tpu_dist",
-                name_l, name_l)
+                "kvstore='%s' unavailable (%s); falling back to tpu_dist",
+                name_l, e)
             return TPUDist()
     if name_l in ("tpu_dist", "dist_sync", "dist_async", "dist",
                   "dist_sync_device", "dist_async_device", "nccl"):

@@ -1,8 +1,13 @@
-"""BytePS kvstore adapter (reference: python/mxnet/kvstore/byteps.py:29).
+"""BytePS kvstore adapter (reference: python/mxnet/kvstore/byteps.py).
 
-Parity shim following the same pattern as the horovod adapter: delegates
-to `byteps.mxnet` when importable, and points TPU users at `tpu_dist`
-otherwise (byteps is a GPU/RDMA parameter-server system).
+The reference adapter delegates broadcast/pushpull to `byteps.mxnet`, which
+moves MXNet C-handle NDArrays. This framework's arrays are jax-backed
+and cannot cross that ABI, and byteps has no TPU/jax backend — so the
+adapter's construction always raises ImportError with the porting
+guidance, and `kvstore.create('byteps')` falls back to `tpu_dist`,
+whose pushpull honors the same KVStoreBase contract over XLA
+collectives. The class stays registered so reference-era code that
+probes `KVStoreBase.find('byteps')` keeps working.
 """
 from __future__ import annotations
 
@@ -14,57 +19,14 @@ __all__ = ["BytePS"]
 @KVStoreBase.register
 class BytePS(KVStoreBase):
     def __init__(self):
-        # byteps.mxnet, like horovod.mxnet, moves MXNet C-handle arrays;
-        # jax-backed tensors cannot cross that ABI, so construction
-        # raises either way and kvstore.create() falls back to tpu_dist.
         try:
-            import byteps.mxnet as bps  # noqa: PLC0415,F401
+            import byteps.mxnet  # noqa: PLC0415,F401
         except ImportError as e:
             raise ImportError(
                 "kvstore='byteps' requires the byteps package; use "
                 "kvstore='tpu_dist' — the XLA collective store with the "
                 "same pushpull contract") from e
         raise ImportError(
-            "byteps.mxnet drives MXNet C-handle arrays and has no "
-            "jax/TPU backend; use kvstore='tpu_dist' (kvstore.create "
-            "falls back automatically)")
-
-    @property
-    def rank(self):
-        return self._bps.rank()
-
-    @property
-    def num_workers(self):
-        return self._bps.size()
-
-    def is_capable(self, capability):
-        return capability in ("pushpull", "broadcast")
-
-    def broadcast(self, key, value, out, priority=0):
-        """Root rank's value lands in every rank's out — realised as the
-        reference adapter does: non-root ranks zero their copy, then one
-        push_pull sums to the root value (byteps.py:45-90)."""
-        vals = value if isinstance(value, (list, tuple)) else [value]
-        outs = out if isinstance(out, (list, tuple)) else [out]
-        buf = vals[0]
-        if self.rank != 0:
-            buf = buf * 0
-        self._bps.byteps_declare_tensor(str(key))
-        self._bps.byteps_push_pull(buf, name=str(key), priority=priority)
-        for o in outs:
-            o._data = buf._data
-            o._version += 1
-
-    def pushpull(self, key, value, out=None, priority=0):
-        vals = value if isinstance(value, (list, tuple)) else [value]
-        local = vals[0]
-        for v in vals[1:]:  # sum local copies like every other store
-            local = local + v
-        self._bps.byteps_push_pull(local, name=str(key),
-                                   priority=priority)
-        if out is None:
-            return
-        outs = out if isinstance(out, (list, tuple)) else [out]
-        for o in outs:
-            o._data = local._data
-            o._version += 1
+            "byteps.mxnet drives MXNet C-handle arrays and has no jax/TPU "
+            "backend; use kvstore='tpu_dist' (kvstore.create falls back "
+            "automatically)")

@@ -1,10 +1,13 @@
-"""Horovod kvstore adapter (reference: python/mxnet/kvstore/horovod.py:27).
+"""Horovod kvstore adapter (reference: python/mxnet/kvstore/horovod.py).
 
-On TPU the native collective path is `tpu_dist` (XLA psum over ICI); this
-adapter exists for API parity with reference deployments that drive
-training through `kvstore='horovod'`. It delegates broadcast/pushpull to
-`horovod.mxnet` when that package is importable and raises a clear error
-otherwise (horovod has no TPU backend — the error points at tpu_dist).
+The reference adapter delegates broadcast/pushpull to `horovod.mxnet`, which
+moves MXNet C-handle NDArrays. This framework's arrays are jax-backed
+and cannot cross that ABI, and horovod has no TPU/jax backend — so the
+adapter's construction always raises ImportError with the porting
+guidance, and `kvstore.create('horovod')` falls back to `tpu_dist`,
+whose pushpull honors the same KVStoreBase contract over XLA
+collectives. The class stays registered so reference-era code that
+probes `KVStoreBase.find('horovod')` keeps working.
 """
 from __future__ import annotations
 
@@ -16,54 +19,14 @@ __all__ = ["Horovod"]
 @KVStoreBase.register
 class Horovod(KVStoreBase):
     def __init__(self):
-        # horovod.mxnet operates on MXNet C-handle NDArrays; this
-        # framework's arrays are jax-backed, so even with horovod
-        # installed the adapter cannot hand tensors across. Raise
-        # ImportError either way — kvstore.create() falls back to
-        # tpu_dist, whose pushpull honors the same contract.
         try:
-            import horovod.mxnet as hvd  # noqa: PLC0415,F401
+            import horovod.mxnet  # noqa: PLC0415,F401
         except ImportError as e:
             raise ImportError(
                 "kvstore='horovod' requires the horovod package; use "
                 "kvstore='tpu_dist' — the XLA collective store with the "
                 "same pushpull contract") from e
         raise ImportError(
-            "horovod.mxnet drives MXNet C-handle arrays and has no "
-            "jax/TPU backend; use kvstore='tpu_dist' (kvstore.create "
-            "falls back automatically)")
-
-    @property
-    def rank(self):
-        return self._hvd.rank()
-
-    @property
-    def num_workers(self):
-        return self._hvd.size()
-
-    def is_capable(self, capability):
-        return capability in ("pushpull", "broadcast")
-
-    def broadcast(self, key, value, out, priority=0):  # noqa: ARG002
-        vals = value if isinstance(value, (list, tuple)) else [value]
-        outs = out if isinstance(out, (list, tuple)) else [out]
-        root = self._hvd.broadcast_(vals[0], root_rank=0, name=str(key))
-        for o in outs:
-            o._data = root._data
-            o._version += 1
-
-    def pushpull(self, key, value, out=None, priority=0):  # noqa: ARG002
-        vals = value if isinstance(value, (list, tuple)) else [value]
-        # sum local per-device copies first (the KVStoreBase contract
-        # every store honors), then allreduce across workers
-        local = vals[0]
-        for v in vals[1:]:
-            local = local + v
-        reduced = self._hvd.allreduce_(local, average=False,
-                                       name=str(key))
-        if out is None:
-            return
-        outs = out if isinstance(out, (list, tuple)) else [out]
-        for o in outs:
-            o._data = reduced._data
-            o._version += 1
+            "horovod.mxnet drives MXNet C-handle arrays and has no jax/TPU "
+            "backend; use kvstore='tpu_dist' (kvstore.create falls back "
+            "automatically)")

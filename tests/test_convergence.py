@@ -153,14 +153,9 @@ def test_tiny_transformer_convergence():
             seq_out = net(toks, segs)
             seq_out = seq_out[0] if isinstance(seq_out, tuple) else seq_out
             logits = head(seq_out)  # (B, S, V)
-            picked = mx.npx.pick_along_axis(logits, pos) \
-                if hasattr(mx.npx, "pick_along_axis") else None
-            if picked is None:
-                idx = pos.asnumpy().astype(int)
-                rows = mx.np.stack(
-                    [logits[i, int(idx[i])] for i in range(batch)])
-            else:
-                rows = picked
+            rows = mx.np.take_along_axis(
+                logits, pos.reshape(-1, 1, 1).astype("int32"),
+                axis=1).reshape(batch, vocab)
             loss = lossfn(rows, target)
         loss.backward()
         trainer.step(batch)

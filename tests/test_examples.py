@@ -1,0 +1,48 @@
+"""Example scripts smoke-run end to end on CPU (reference coverage model:
+example/ CI smoke runs)."""
+import os
+import subprocess
+import sys
+
+REPO = os.path.abspath(os.path.join(os.path.dirname(__file__), ".."))
+
+
+def _run(script, *args, timeout=600):
+    env = dict(os.environ, JAX_PLATFORMS="cpu", PYTHONPATH=REPO)
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+    r = subprocess.run(
+        [sys.executable, os.path.join(REPO, "example", script), "--cpu",
+         *args],
+        capture_output=True, text=True, timeout=timeout, env=env)
+    assert r.returncode == 0, r.stdout + r.stderr
+    return r.stdout
+
+
+def test_train_mnist_example():
+    out = _run("train_mnist.py", "--epochs", "1", "--limit", "128",
+               "--batch-size", "32")
+    assert "final accuracy" in out
+
+
+def test_train_cifar_example():
+    out = _run("train_cifar_resnet.py", "--epochs", "1", "--limit", "64",
+               "--batch-size", "16")
+    assert "epoch 0" in out
+
+
+def test_bert_finetune_example():
+    out = _run("bert_finetune.py", "--steps", "1", "--layers", "2",
+               "--batch-size", "2", "--seq", "32", timeout=900)
+    assert "step 0: loss" in out
+
+
+def test_distributed_example_via_launcher():
+    env = dict(os.environ, JAX_PLATFORMS="cpu", PYTHONPATH=REPO)
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+    r = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "launch.py"),
+         "-n", "2", sys.executable,
+         os.path.join(REPO, "example", "distributed_train.py")],
+        capture_output=True, text=True, timeout=600, env=env)
+    assert r.returncode == 0, r.stdout + r.stderr
+    assert "[rank 0] done" in r.stdout + r.stderr

@@ -300,3 +300,24 @@ def test_register_op_hook_skips_tracing():
     net(x)   # cache hit: fires again (not once-at-trace)
     assert len(seen) >= 2
     assert all(isinstance(v, float) for v in seen)
+
+
+def test_register_op_hook_silent_during_deferred_init():
+    """Review regression: the deferred-init eager dry pass must not leak
+    one-off child hook events on a hybridized net."""
+    seen = []
+    net = gluon.nn.HybridSequential()
+    net.add(gluon.nn.Dense(4), gluon.nn.Dense(2))  # deferred in_units
+    net.initialize()
+    net.hybridize()
+    net.register_op_hook(lambda name, t, arr: seen.append(t))
+    x = mx.np.ones((3, 5))
+    net(x)
+    first = list(seen)
+    seen.clear()
+    net(x)
+    # same events on first (trace) call and steady-state calls: only the
+    # jit-boundary output, no one-off child rows from the dry pass
+    assert first == seen == [""] or first == seen
+    assert all("output" in t for t in first)
+    assert not any(t.startswith(("0_", "1_")) for t in first)
