@@ -9,12 +9,12 @@ in VMEM, k/v blocks stream through the grid's inner dimension, the MXU sees
 (block_q, d) x (d, block_k) matmuls, and the online-softmax running max /
 sum live in VMEM scratch across the inner grid steps.
 
-`flash_attention` is differentiable via custom_vjp: backward recomputes
-attention from the saved (q, k, v) and differentiates the reference math
-under XLA — forward gets the O(S)-memory fused kernel; backward currently
-materializes per-(B,H) score blocks like the reference (a block-streamed
-Pallas backward is the next step; sequence-parallel training additionally
-shards S via ring/Ulysses so per-device S stays small).
+`flash_attention` is differentiable via custom_vjp with a block-streamed
+Pallas backward (FlashAttention-2): the forward saves only (out, lse);
+backward recomputes P tiles per block from (q, k, lse), so training is
+O(S) memory end to end — dQ accumulates over streaming K/V blocks, dK/dV
+over streaming Q blocks, and delta = rowsum(dO*O) supplies the softmax
+correction.
 
 Falls back to the jnp reference implementation off-TPU; tests run the
 kernel in interpret mode for numerics.
@@ -46,7 +46,8 @@ def attention_reference(q, k, v, causal=False, scale=None):
     return jnp.einsum("bhqk,bhkd->bhqd", p.astype(q.dtype), v)
 
 
-def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, m_ref, l_ref, acc_ref, *,
+def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_ref, l_ref,
+                acc_ref, *,
                 scale, causal, block_q, block_k):
     import jax.experimental.pallas as pl
 
@@ -94,6 +95,10 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, m_ref, l_ref, acc_ref, *,
     def _finish():
         denom = jnp.maximum(l_ref[:], 1e-30)
         o_ref[0] = (acc_ref[:] / denom).astype(o_ref.dtype)
+        # logsumexp per row: m + log l (-inf for fully-masked rows)
+        lse = jnp.where(jnp.isfinite(m_ref[:]),
+                        m_ref[:] + jnp.log(denom), -jnp.inf)
+        lse_ref[0] = lse[:, 0]
 
 
 def _flash_fwd(q, k, v, causal, scale, block_q, block_k, interpret):
@@ -111,7 +116,7 @@ def _flash_fwd(q, k, v, causal, scale, block_q, block_k, interpret):
     kernel = functools.partial(
         _fwd_kernel, scale=scale, causal=causal, block_q=block_q,
         block_k=block_k)
-    out = pl.pallas_call(
+    out, lse = pl.pallas_call(
         kernel,
         grid=grid,
         in_specs=[
@@ -119,8 +124,14 @@ def _flash_fwd(q, k, v, causal, scale, block_q, block_k, interpret):
             pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, j, 0)),
             pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, j, 0)),
         ],
-        out_specs=pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
-        out_shape=jax.ShapeDtypeStruct((bh, s_len, d), q.dtype),
+        out_specs=[
+            pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, block_q), lambda b, i, j: (b, i)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((bh, s_len, d), q.dtype),
+            jax.ShapeDtypeStruct((bh, s_len), jnp.float32),
+        ],
         scratch_shapes=[
             _scratch((block_q, 1)),   # running max m
             _scratch((block_q, 1)),   # running sum l
@@ -128,7 +139,7 @@ def _flash_fwd(q, k, v, causal, scale, block_q, block_k, interpret):
         ],
         interpret=interpret,
     )(qr, kr, vr)
-    return out.reshape(b, h, s_len, d)
+    return out.reshape(b, h, s_len, d), lse
 
 
 def _scratch(shape):
@@ -137,24 +148,163 @@ def _scratch(shape):
     return pltpu.VMEM(shape, jnp.float32)
 
 
+def _recompute_p(q, k, lse_row, scale, causal, q_idx, kv_idx, block_q,
+                 block_k):
+    """exp(QK^T * scale - lse) for one (q block, k block) tile."""
+    import jax.experimental.pallas as pl  # noqa: F401
+
+    s = jax.lax.dot_general(
+        q, k, (((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32) * scale
+    if causal:
+        q_pos = q_idx * block_q + jax.lax.broadcasted_iota(
+            jnp.int32, s.shape, 0)
+        k_pos = kv_idx * block_k + jax.lax.broadcasted_iota(
+            jnp.int32, s.shape, 1)
+        s = jnp.where(q_pos >= k_pos, s, -jnp.inf)
+    lse = lse_row[:, None]
+    return jnp.where(jnp.isfinite(lse), jnp.exp(s - lse), 0.0)
+
+
+def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                   dq_ref, dq_acc, *, scale, causal, block_q, block_k):
+    import jax.experimental.pallas as pl
+
+    kv_idx = pl.program_id(2)
+
+    @pl.when(kv_idx == 0)
+    def _init():
+        dq_acc[:] = jnp.zeros_like(dq_acc)
+
+    p = _recompute_p(q_ref[0], k_ref[0], lse_ref[0], scale, causal,
+                     pl.program_id(1), kv_idx, block_q, block_k)
+    dp = jax.lax.dot_general(
+        do_ref[0], v_ref[0], (((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32)        # (bq, bk)
+    ds = p * (dp - delta_ref[0][:, None]) * scale
+    dq_acc[:] += jax.lax.dot_general(
+        ds.astype(k_ref.dtype), k_ref[0], (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32)
+
+    @pl.when(kv_idx == pl.num_programs(2) - 1)
+    def _finish():
+        dq_ref[0] = dq_acc[:].astype(dq_ref.dtype)
+
+
+def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                    dk_ref, dv_ref, dk_acc, dv_acc, *, scale, causal,
+                    block_q, block_k):
+    import jax.experimental.pallas as pl
+
+    q_idx = pl.program_id(2)       # q blocks stream in the inner axis
+
+    @pl.when(q_idx == 0)
+    def _init():
+        dk_acc[:] = jnp.zeros_like(dk_acc)
+        dv_acc[:] = jnp.zeros_like(dv_acc)
+
+    p = _recompute_p(q_ref[0], k_ref[0], lse_ref[0], scale, causal,
+                     q_idx, pl.program_id(1), block_q, block_k)
+    # dV += P^T dO
+    dv_acc[:] += jax.lax.dot_general(
+        p.astype(do_ref.dtype), do_ref[0], (((0,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32)
+    dp = jax.lax.dot_general(
+        do_ref[0], v_ref[0], (((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32)
+    ds = p * (dp - delta_ref[0][:, None]) * scale
+    # dK += dS^T Q
+    dk_acc[:] += jax.lax.dot_general(
+        ds.astype(q_ref.dtype), q_ref[0], (((0,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32)
+
+    @pl.when(q_idx == pl.num_programs(2) - 1)
+    def _finish():
+        dk_ref[0] = dk_acc[:].astype(dk_ref.dtype)
+        dv_ref[0] = dv_acc[:].astype(dv_ref.dtype)
+
+
+def _flash_bwd(q, k, v, out, lse, g, causal, scale, block_q, block_k,
+               interpret):
+    """Block-streamed FlashAttention-2 backward: O(S) memory, no (S, S)
+    residual — P tiles are recomputed from (q, k, lse) per block."""
+    import jax.experimental.pallas as pl
+
+    b, h, s_len, d = q.shape
+    bh = b * h
+    block_q = min(block_q, s_len)
+    block_k = min(block_k, s_len)
+    qr = q.reshape(bh, s_len, d)
+    kr = k.reshape(bh, s_len, d)
+    vr = v.reshape(bh, s_len, d)
+    do = g.reshape(bh, s_len, d)
+    orr = out.reshape(bh, s_len, d)
+    # delta = rowsum(dO * O) — the softmax-grad correction term
+    delta = jnp.sum(do.astype(jnp.float32) * orr.astype(jnp.float32),
+                    axis=-1)                        # (bh, s_len)
+
+    dq = pl.pallas_call(
+        functools.partial(_bwd_dq_kernel, scale=scale, causal=causal,
+                          block_q=block_q, block_k=block_k),
+        grid=(bh, s_len // block_q, s_len // block_k),
+        in_specs=[
+            pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, j, 0)),
+            pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, j, 0)),
+            pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, block_q), lambda b, i, j: (b, i)),
+            pl.BlockSpec((1, block_q), lambda b, i, j: (b, i)),
+        ],
+        out_specs=pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
+        out_shape=jax.ShapeDtypeStruct((bh, s_len, d), q.dtype),
+        scratch_shapes=[_scratch((block_q, d))],
+        interpret=interpret,
+    )(qr, kr, vr, do, lse, delta)
+
+    dk, dv = pl.pallas_call(
+        functools.partial(_bwd_dkv_kernel, scale=scale, causal=causal,
+                          block_q=block_q, block_k=block_k),
+        grid=(bh, s_len // block_k, s_len // block_q),
+        in_specs=[
+            pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, j, 0)),
+            pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, j, 0)),
+            pl.BlockSpec((1, block_q), lambda b, i, j: (b, j)),
+            pl.BlockSpec((1, block_q), lambda b, i, j: (b, j)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, i, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((bh, s_len, d), k.dtype),
+            jax.ShapeDtypeStruct((bh, s_len, d), v.dtype),
+        ],
+        scratch_shapes=[_scratch((block_k, d)), _scratch((block_k, d))],
+        interpret=interpret,
+    )(qr, kr, vr, do, lse, delta)
+    shape = (b, h, s_len, d)
+    return dq.reshape(shape), dk.reshape(shape), dv.reshape(shape)
+
+
 @functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
 def _flash(q, k, v, causal, scale, block_q, block_k, interpret):
-    return _flash_fwd(q, k, v, causal, scale, block_q, block_k, interpret)
+    out, _ = _flash_fwd(q, k, v, causal, scale, block_q, block_k,
+                        interpret)
+    return out
 
 
 def _flash_vjp_fwd(q, k, v, causal, scale, block_q, block_k, interpret):
-    out = _flash_fwd(q, k, v, causal, scale, block_q, block_k, interpret)
-    return out, (q, k, v)
+    out, lse = _flash_fwd(q, k, v, causal, scale, block_q, block_k,
+                          interpret)
+    return out, (q, k, v, out, lse)
 
 
 def _flash_vjp_bwd(causal, scale, block_q, block_k, interpret, res, g):
-    # standard flash backward via recompute — differentiate the reference
-    # math (XLA fuses the recompute; no (S,S) residual was saved)
-    q, k, v = res
-    _, vjp = jax.vjp(
-        lambda q_, k_, v_: attention_reference(q_, k_, v_, causal=causal,
-                                               scale=scale), q, k, v)
-    return vjp(g)
+    q, k, v, out, lse = res
+    return _flash_bwd(q, k, v, out, lse, g, causal, scale, block_q,
+                      block_k, interpret)
 
 
 _flash.defvjp(_flash_vjp_fwd, _flash_vjp_bwd)

@@ -16,7 +16,10 @@ Modules:
 from . import collectives, mesh, moe, pipeline, ring_attention, ulysses  # noqa: F401
 from .data_parallel import make_data_parallel_step  # noqa: F401
 from .mesh import make_mesh, shard_batch, shard_params  # noqa: F401
-from .ring_attention import ring_attention_sharded  # noqa: F401
+from .ring_attention import (  # noqa: F401
+    ring_attention_sharded,
+    ring_flash_attention_sharded,
+)
 from .moe import moe_ffn_sharded  # noqa: F401
 from .pipeline import pipeline_apply_sharded  # noqa: F401
 from .ulysses import ulysses_attention_sharded  # noqa: F401

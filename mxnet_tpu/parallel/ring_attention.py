@@ -21,7 +21,8 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import PartitionSpec as P
 
-__all__ = ["ring_attention", "ring_attention_sharded"]
+__all__ = ["ring_attention", "ring_attention_sharded",
+           "ring_flash_attention", "ring_flash_attention_sharded"]
 
 
 def _stable_block(q, k, v, o, m, l, scale, mask=None):
@@ -107,6 +108,117 @@ def ring_attention_sharded(q, k, v, mesh, axis="sp", causal=False,
         def body(ql, kl, vl):
             return ring_attention(ql, kl, vl, axis, causal=causal,
                                   scale=scale)
+
+        run = jax.jit(body)
+        _jit_cache[key] = run
+    return run(q, k, v)
+
+
+def ring_flash_attention(q, k, v, axis_name, causal=False, scale=None,
+                         interpret=None):
+    """Ring attention with the Pallas flash kernel as the per-hop block
+    compute. Each hop runs the O(S_local)-memory fused kernel on the
+    resident K/V block and merges normalized partials exactly via their
+    logsumexp:
+
+        lse = logaddexp(lse_a, lse_b)
+        out = exp(lse_a - lse) * out_a + exp(lse_b - lse) * out_b
+
+    Causal mode: hops from future devices contribute lse = -inf (skipped
+    by the merge); the diagonal hop runs the causal kernel under lax.cond.
+    Same contract as `ring_attention` (call inside shard_map; q/k/v are
+    (B, H, S_local, D) shards).
+    """
+    import jax as _jax
+
+    from ..ops.pallas_attention import _flash_fwd
+
+    n = jax.lax.axis_size(axis_name)
+    my = jax.lax.axis_index(axis_name)
+    if scale is None:
+        scale = q.shape[-1] ** -0.5
+    if interpret is None:
+        # axon is the tunneled TPU platform — kernel-capable, like
+        # ops/pallas_attention.flash_attention's check
+        interpret = jax.default_backend() not in ("tpu", "axon")
+    s_local = q.shape[2]
+    bq = min(128, s_local)
+    bk = min(128, s_local)
+    if s_local % bq or s_local % bk or bq % 8 or bk % 8 \
+            or q.shape[-1] % 8:
+        # ragged shapes: fall back to the jnp ring
+        return ring_attention(q, k, v, axis_name, causal=causal,
+                              scale=scale)
+
+    out = jnp.zeros(q.shape, jnp.float32)
+    lse = jnp.full(q.shape[:3], -jnp.inf, jnp.float32)
+    perm = [(i, (i + 1) % n) for i in range(n)]
+
+    def merge(out_a, lse_a, out_b, lse_b):
+        lse_new = jnp.logaddexp(lse_a, lse_b)
+        safe = jnp.where(jnp.isneginf(lse_new), 0.0, lse_new)
+        w_a = jnp.where(jnp.isneginf(lse_a), 0.0,
+                        jnp.exp(lse_a - safe))[..., None]
+        w_b = jnp.where(jnp.isneginf(lse_b), 0.0,
+                        jnp.exp(lse_b - safe))[..., None]
+        return w_a * out_a + w_b * out_b, lse_new
+
+    def hop(i, out, lse, k_blk, v_blk):
+        src = (my - i) % n
+        if causal:
+            def _skip():
+                # future keys: no kernel launch, zero contribution
+                return (jnp.zeros(q.shape, q.dtype),
+                        jnp.full((q.shape[0] * q.shape[1], q.shape[2]),
+                                 -jnp.inf, jnp.float32))
+
+            blk_out, blk_lse = _jax.lax.cond(
+                src > my,
+                _skip,
+                lambda: _jax.lax.cond(
+                    src == my,
+                    lambda: _flash_fwd(q, k_blk, v_blk, True, scale,
+                                       bq, bk, interpret),
+                    lambda: _flash_fwd(q, k_blk, v_blk, False, scale,
+                                       bq, bk, interpret)),
+            )
+        else:
+            blk_out, blk_lse = _flash_fwd(q, k_blk, v_blk, False, scale,
+                                          bq, bk, interpret)
+        blk_lse = blk_lse.reshape(q.shape[:3])
+        return merge(out, lse, blk_out.astype(jnp.float32), blk_lse)
+
+    def body(i, carry):
+        out, lse, k_blk, v_blk = carry
+        out, lse = hop(i, out, lse, k_blk, v_blk)
+        k_blk = jax.lax.ppermute(k_blk, axis_name, perm)
+        v_blk = jax.lax.ppermute(v_blk, axis_name, perm)
+        return out, lse, k_blk, v_blk
+
+    out, lse, k_last, v_last = jax.lax.fori_loop(
+        0, n - 1, body, (out, lse, k, v))
+    out, lse = hop(n - 1, out, lse, k_last, v_last)
+    return out.astype(q.dtype)
+
+
+def ring_flash_attention_sharded(q, k, v, mesh, axis="sp", causal=False,
+                                 scale=None, interpret=None):
+    """shard_map wrapper: sequence axis sharded over `axis`, flash kernel
+    per hop (the production long-context path on TPU). Jitted program
+    cached per (mesh, axis, causal, scale, interpret) like
+    ring_attention_sharded."""
+    from jax import shard_map
+
+    key = ("flash", mesh, axis, causal, scale, interpret)
+    run = _jit_cache.get(key)
+    if run is None:
+        spec = P(None, None, axis, None)
+
+        @partial(shard_map, mesh=mesh, in_specs=(spec, spec, spec),
+                 out_specs=spec, check_vma=False)
+        def body(ql, kl, vl):
+            return ring_flash_attention(ql, kl, vl, axis, causal=causal,
+                                        scale=scale, interpret=interpret)
 
         run = jax.jit(body)
         _jit_cache[key] = run

@@ -318,6 +318,7 @@ def test_register_op_hook_silent_during_deferred_init():
     net(x)
     # same events on first (trace) call and steady-state calls: only the
     # jit-boundary output, no one-off child rows from the dry pass
-    assert first == seen == [""] or first == seen
+    assert first, "hooks must fire on the jit-boundary output"
+    assert first == seen
     assert all("output" in t for t in first)
     assert not any(t.startswith(("0_", "1_")) for t in first)
