@@ -45,7 +45,7 @@ from . import numpy_extension as npx
 from .ndarray import NDArray
 
 # random: stateful global seed + legacy mx.random namespace
-from .numpy import random  # noqa: E402
+from . import random  # noqa: E402  (module == mx.random attr)
 
 # subpackages loaded lazily-ish but imported eagerly for API parity
 from . import initializer  # noqa: E402
@@ -73,6 +73,13 @@ from .operator import Custom  # noqa: E402
 from . import recordio  # noqa: E402
 from . import resource  # noqa: E402
 from . import rtc  # noqa: E402
+from . import context  # noqa: E402
+from . import dlpack  # noqa: E402
+from . import error  # noqa: E402
+from . import executor  # noqa: E402
+from . import libinfo  # noqa: E402
+from . import log  # noqa: E402
+from . import registry  # noqa: E402
 from . import gluon  # noqa: E402
 from . import symbol  # noqa: E402
 from . import symbol as sym  # noqa: E402

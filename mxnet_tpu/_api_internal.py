@@ -1,0 +1,21 @@
+"""Internal packed-API namespace (reference: mxnet._api_internal — the
+TVM-FFI module whose attributes are the `_npi.*` op entry points used by
+the generated frontends). Attribute access resolves through the op
+registry, so reference-era internals like `_api_internal.add(...)` or
+`_api_internal.where_lscalar(...)` land on the same implementations as
+the public names (ops/aliases.py)."""
+from __future__ import annotations
+
+from .ops.registry import _OPS
+
+
+def __getattr__(name):
+    for candidate in (name, f"_npi_{name}", f"_np_{name}", f"_{name}"):
+        fn = _OPS.get(candidate)
+        if fn is not None:
+            return fn
+    raise AttributeError(f"no registered op for _api_internal.{name}")
+
+
+def __dir__():
+    return sorted(_OPS)

@@ -1,0 +1,16 @@
+"""Legacy context module (reference: python/mxnet/context.py — kept as an
+alias layer over device.py in 2.x). `Context` is `Device`."""
+from .device import (  # noqa: F401
+    Device,
+    Device as Context,
+    cpu,
+    cpu_pinned,
+    current_device,
+    current_device as current_context,
+    gpu,
+    num_gpus,
+    tpu,
+)
+
+__all__ = ["Context", "Device", "cpu", "cpu_pinned", "gpu", "tpu",
+           "current_context", "current_device", "num_gpus"]

@@ -1,0 +1,9 @@
+"""DLPack interop (reference: python/mxnet/dlpack.py). Zero-copy
+exchange with other frameworks through the jax.Array DLPack protocol."""
+from .numpy_extension import (  # noqa: F401
+    from_dlpack,
+    to_dlpack_for_read,
+    to_dlpack_for_write,
+)
+
+__all__ = ["from_dlpack", "to_dlpack_for_read", "to_dlpack_for_write"]

@@ -1,0 +1,31 @@
+"""Typed framework errors (reference: python/mxnet/error.py). The
+reference maps C++ error kinds onto python exception classes; the TPU
+build raises python-native exceptions, so these classes exist for
+except-clause parity in ported code."""
+from .base import MXNetError
+
+__all__ = ["MXNetError", "InternalError", "ValueError", "TypeError",
+           "IndexError", "NotImplementedForSymbol", "register"]
+
+
+class InternalError(MXNetError):
+    """Framework-internal invariant violation."""
+
+
+class NotImplementedForSymbol(MXNetError):
+    def __init__(self, function, alias=None, *args):  # noqa: ARG002
+        super().__init__(f"{getattr(function, '__name__', function)} is "
+                         "not supported for Symbol")
+
+
+ValueError = type("ValueError", (MXNetError, ValueError), {})  # noqa: A001
+TypeError = type("TypeError", (MXNetError, TypeError), {})      # noqa: A001
+IndexError = type("IndexError", (MXNetError, IndexError), {})   # noqa: A001
+
+_ERR_REGISTRY = {}
+
+
+def register(cls):
+    """Register an error class by name (reference: error.py register)."""
+    _ERR_REGISTRY[cls.__name__] = cls
+    return cls

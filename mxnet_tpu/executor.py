@@ -1,0 +1,6 @@
+"""Executor module (reference: python/mxnet/executor.py — the 2.x
+Executor builds on CachedOp; here it wraps the symbol's jitted function,
+see symbol/symbol.py)."""
+from .symbol.symbol import Executor  # noqa: F401
+
+__all__ = ["Executor"]

@@ -1,0 +1,21 @@
+"""Library/build info (reference: python/mxnet/libinfo.py — find_lib_path
+and __version__). The native runtime is located the same way _native.py
+loads it."""
+import os
+
+from . import __version__  # noqa: F401
+
+__all__ = ["find_lib_path", "find_include_path", "__version__"]
+
+
+def find_lib_path(prefix="libmxtpu"):
+    """Path(s) to the native runtime shared library."""
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    cand = os.path.join(root, "native", "build", f"{prefix}.so")
+    return [cand] if os.path.exists(cand) else []
+
+
+def find_include_path():
+    """C++ header root (the cpp-package include tree)."""
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    return os.path.join(root, "cpp-package", "include")

@@ -186,7 +186,10 @@ def from_dlpack(x):
 
 
 def to_dlpack_for_read(x):
-    return x._data.__dlpack__()
+    """Return the underlying array as a DLPack-protocol object (modern
+    DLPack exchange passes the OBJECT, whose __dlpack__ the consumer
+    calls — jnp/np.from_dlpack no longer accept bare capsules)."""
+    return x._data
 
 
 to_dlpack_for_write = to_dlpack_for_read

@@ -176,15 +176,24 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
     def _init():
         dq_acc[:] = jnp.zeros_like(dq_acc)
 
-    p = _recompute_p(q_ref[0], k_ref[0], lse_ref[0], scale, causal,
-                     pl.program_id(1), kv_idx, block_q, block_k)
-    dp = jax.lax.dot_general(
-        do_ref[0], v_ref[0], (((1,), (1,)), ((), ())),
-        preferred_element_type=jnp.float32)        # (bq, bk)
-    ds = p * (dp - delta_ref[0][:, None]) * scale
-    dq_acc[:] += jax.lax.dot_general(
-        ds.astype(k_ref.dtype), k_ref[0], (((1,), (0,)), ((), ())),
-        preferred_element_type=jnp.float32)
+    q_idx = pl.program_id(1)
+    # causal: tiles strictly above the diagonal are all-zero P — skip
+    if causal:
+        live = kv_idx * block_k <= q_idx * block_q + block_q - 1
+    else:
+        live = kv_idx >= 0  # always true (traced predicate)
+
+    @pl.when(live)
+    def _accum():
+        p = _recompute_p(q_ref[0], k_ref[0], lse_ref[0], scale, causal,
+                         q_idx, kv_idx, block_q, block_k)
+        dp = jax.lax.dot_general(
+            do_ref[0], v_ref[0], (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)    # (bq, bk)
+        ds = p * (dp - delta_ref[0][:, None]) * scale
+        dq_acc[:] += jax.lax.dot_general(
+            ds.astype(k_ref.dtype), k_ref[0], (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
 
     @pl.when(kv_idx == pl.num_programs(2) - 1)
     def _finish():
@@ -203,20 +212,29 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         dk_acc[:] = jnp.zeros_like(dk_acc)
         dv_acc[:] = jnp.zeros_like(dv_acc)
 
-    p = _recompute_p(q_ref[0], k_ref[0], lse_ref[0], scale, causal,
-                     q_idx, pl.program_id(1), block_q, block_k)
-    # dV += P^T dO
-    dv_acc[:] += jax.lax.dot_general(
-        p.astype(do_ref.dtype), do_ref[0], (((0,), (0,)), ((), ())),
-        preferred_element_type=jnp.float32)
-    dp = jax.lax.dot_general(
-        do_ref[0], v_ref[0], (((1,), (1,)), ((), ())),
-        preferred_element_type=jnp.float32)
-    ds = p * (dp - delta_ref[0][:, None]) * scale
-    # dK += dS^T Q
-    dk_acc[:] += jax.lax.dot_general(
-        ds.astype(q_ref.dtype), q_ref[0], (((0,), (0,)), ((), ())),
-        preferred_element_type=jnp.float32)
+    kv_idx = pl.program_id(1)
+    if causal:
+        # q tiles strictly above this k tile's diagonal see zero P
+        live = kv_idx * block_k <= q_idx * block_q + block_q - 1
+    else:
+        live = q_idx >= 0  # always true (traced predicate)
+
+    @pl.when(live)
+    def _accum():
+        p = _recompute_p(q_ref[0], k_ref[0], lse_ref[0], scale, causal,
+                         q_idx, kv_idx, block_q, block_k)
+        # dV += P^T dO
+        dv_acc[:] += jax.lax.dot_general(
+            p.astype(do_ref.dtype), do_ref[0], (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        dp = jax.lax.dot_general(
+            do_ref[0], v_ref[0], (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        ds = p * (dp - delta_ref[0][:, None]) * scale
+        # dK += dS^T Q
+        dk_acc[:] += jax.lax.dot_general(
+            ds.astype(q_ref.dtype), q_ref[0], (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
 
     @pl.when(q_idx == pl.num_programs(2) - 1)
     def _finish():

@@ -114,8 +114,7 @@ def ring_attention_sharded(q, k, v, mesh, axis="sp", causal=False,
     return run(q, k, v)
 
 
-def ring_flash_attention(q, k, v, axis_name, causal=False, scale=None,
-                         interpret=None):
+def _ring_flash_fwd_impl(q, k, v, axis_name, causal, scale, interpret):
     """Ring attention with the Pallas flash kernel as the per-hop block
     compute. Each hop runs the O(S_local)-memory fused kernel on the
     resident K/V block and merges normalized partials exactly via their
@@ -135,20 +134,9 @@ def ring_flash_attention(q, k, v, axis_name, causal=False, scale=None,
 
     n = jax.lax.axis_size(axis_name)
     my = jax.lax.axis_index(axis_name)
-    if scale is None:
-        scale = q.shape[-1] ** -0.5
-    if interpret is None:
-        # axon is the tunneled TPU platform — kernel-capable, like
-        # ops/pallas_attention.flash_attention's check
-        interpret = jax.default_backend() not in ("tpu", "axon")
     s_local = q.shape[2]
     bq = min(128, s_local)
     bk = min(128, s_local)
-    if s_local % bq or s_local % bk or bq % 8 or bk % 8 \
-            or q.shape[-1] % 8:
-        # ragged shapes: fall back to the jnp ring
-        return ring_attention(q, k, v, axis_name, causal=causal,
-                              scale=scale)
 
     out = jnp.zeros(q.shape, jnp.float32)
     lse = jnp.full(q.shape[:3], -jnp.inf, jnp.float32)
@@ -198,7 +186,94 @@ def ring_flash_attention(q, k, v, axis_name, causal=False, scale=None,
     out, lse, k_last, v_last = jax.lax.fori_loop(
         0, n - 1, body, (out, lse, k, v))
     out, lse = hop(n - 1, out, lse, k_last, v_last)
-    return out.astype(q.dtype)
+    return out.astype(q.dtype), lse
+
+
+@partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
+def _ring_flash(q, k, v, axis_name, causal, scale, interpret):
+    out, _ = _ring_flash_fwd_impl(q, k, v, axis_name, causal, scale,
+                                  interpret)
+    return out
+
+
+def _ring_flash_vjp_fwd(q, k, v, axis_name, causal, scale, interpret):
+    out, lse = _ring_flash_fwd_impl(q, k, v, axis_name, causal, scale,
+                                    interpret)
+    return out, (q, k, v, out, lse)
+
+
+def _ring_flash_vjp_bwd(axis_name, causal, scale, interpret, res, g):
+    """Ring backward: one full rotation; each hop computes its block's
+    dK/dV (carried around the ring back to the owner) and accumulates dQ
+    using the saved global lse + delta = rowsum(dO * O) — the
+    FlashAttention-2 decomposition, blockwise under XLA."""
+    q, k, v, out, lse = res
+    n = jax.lax.axis_size(axis_name)
+    my = jax.lax.axis_index(axis_name)
+    s_local = q.shape[2]
+    gf = g.astype(jnp.float32)
+    delta = jnp.sum(gf * out.astype(jnp.float32), axis=-1)   # (B,H,S)
+    perm = [(i, (i + 1) % n) for i in range(n)]
+    qf = q.astype(jnp.float32)
+    pos_q = my * s_local + jnp.arange(s_local)
+
+    def body(i, carry):
+        dq, k_blk, v_blk, dk, dv = carry
+        src = (my - i) % n
+        kf = k_blk.astype(jnp.float32)
+        vf = v_blk.astype(jnp.float32)
+        sblk = jnp.einsum("bhqd,bhkd->bhqk", qf, kf) * scale
+        if causal:
+            pos_k = src * s_local + jnp.arange(s_local)
+            sblk = jnp.where(
+                pos_q[:, None] >= pos_k[None, :], sblk, -jnp.inf)
+        lse_e = lse[..., None]
+        p = jnp.where(jnp.isfinite(lse_e), jnp.exp(sblk - lse_e), 0.0)
+        p = jnp.where(jnp.isfinite(sblk), p, 0.0)
+        dv_blk = jnp.einsum("bhqk,bhqd->bhkd", p, gf)
+        dp = jnp.einsum("bhqd,bhkd->bhqk", gf, vf)
+        ds = p * (dp - delta[..., None]) * scale
+        dq = dq + jnp.einsum("bhqk,bhkd->bhqd", ds, kf)
+        dk_blk = jnp.einsum("bhqk,bhqd->bhkd", ds, qf)
+        # rotate the K/V blocks AND their accumulated grads together so
+        # every block's dK/dV arrives home after the full cycle
+        dk = jax.lax.ppermute(dk + dk_blk, axis_name, perm)
+        dv = jax.lax.ppermute(dv + dv_blk, axis_name, perm)
+        k_blk = jax.lax.ppermute(k_blk, axis_name, perm)
+        v_blk = jax.lax.ppermute(v_blk, axis_name, perm)
+        return dq, k_blk, v_blk, dk, dv
+
+    dq0 = jnp.zeros(q.shape, jnp.float32)
+    dq, _, _, dk, dv = jax.lax.fori_loop(
+        0, n, body, (dq0, k, v, jnp.zeros(k.shape, jnp.float32),
+                     jnp.zeros(v.shape, jnp.float32)))
+    return dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype)
+
+
+_ring_flash.defvjp(_ring_flash_vjp_fwd, _ring_flash_vjp_bwd)
+
+
+def ring_flash_attention(q, k, v, axis_name, causal=False, scale=None,
+                         interpret=None):
+    """Ring attention with the Pallas flash kernel per forward hop and a
+    blockwise ring backward (custom_vjp) — trainable end to end. See
+    _ring_flash_fwd_impl for the forward schedule and _ring_flash_vjp_bwd
+    for the gradient rotation."""
+    if scale is None:
+        scale = q.shape[-1] ** -0.5
+    if interpret is None:
+        # axon is the tunneled TPU platform — kernel-capable, like
+        # ops/pallas_attention.flash_attention's check
+        interpret = jax.default_backend() not in ("tpu", "axon")
+    s_local = q.shape[2]
+    bq = min(128, s_local)
+    bk = min(128, s_local)
+    if s_local % bq or s_local % bk or bq % 8 or bk % 8 \
+            or q.shape[-1] % 8:
+        # ragged shapes: fall back to the jnp ring
+        return ring_attention(q, k, v, axis_name, causal=causal,
+                              scale=scale)
+    return _ring_flash(q, k, v, axis_name, causal, scale, interpret)
 
 
 def ring_flash_attention_sharded(q, k, v, mesh, axis="sp", causal=False,

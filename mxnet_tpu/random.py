@@ -1,0 +1,12 @@
+"""Top-level mx.random module (reference: python/mxnet/random.py —
+seed + the sampling namespace). This module IS `mx.random` (bound in
+__init__.py), so `import mxnet_tpu.random` and the attribute agree; the
+sampling functions are the numpy-frontend implementations."""
+from .numpy.random import *  # noqa: F401,F403
+from .numpy.random import __all__ as _np_all
+
+__all__ = list(_np_all)
+if "seed" not in __all__:
+    from ._random import seed  # noqa: F401
+
+    __all__.append("seed")
