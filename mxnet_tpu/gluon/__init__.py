@@ -4,7 +4,7 @@ Reference: python/mxnet/gluon/ (27k LoC). Subpackages: nn (layers), rnn,
 loss, metric, data, model_zoo, contrib; core classes Block/HybridBlock,
 Parameter, Trainer.
 """
-from . import contrib, data, loss, metric, model_zoo, nn, probability, rnn  # noqa: F401
+from . import contrib, data, loss, metric, model_zoo, nn, probability, rnn, utils  # noqa: F401
 from .block import Block, HybridBlock, SymbolBlock  # noqa: F401
 from .parameter import Constant, Parameter  # noqa: F401
 from .trainer import Trainer  # noqa: F401

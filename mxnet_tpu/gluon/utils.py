@@ -1,0 +1,166 @@
+"""Gluon utilities (reference: python/mxnet/gluon/utils.py — split/load
+helpers, global-norm clipping, artifact download with checksum, hook
+handles)."""
+from __future__ import annotations
+
+import hashlib
+import math
+import os
+
+import numpy as _onp
+
+from .. import numpy as _mxnp
+from ..ndarray.ndarray import NDArray, apply_op
+
+__all__ = ["split_data", "split_and_load", "clip_global_norm",
+           "check_sha1", "download", "HookHandle", "shape_is_known"]
+
+
+def split_data(data, num_slice, batch_axis=0, even_split=True):
+    """Split `data` into `num_slice` chunks along `batch_axis`
+    (reference: utils.py:41). With even_split, the batch must divide
+    evenly; otherwise the last slice carries the remainder."""
+    size = data.shape[batch_axis]
+    if even_split and size % num_slice != 0:
+        raise ValueError(
+            f"data with shape {tuple(data.shape)} cannot be evenly split "
+            f"into {num_slice} slices along axis {batch_axis}; set "
+            "even_split=False or adjust the batch size")
+    if num_slice == 1:
+        return [data]
+    # floor step; the LAST slice absorbs the remainder — always exactly
+    # num_slice slices (the reference contract, so split_and_load maps
+    # one slice per device)
+    step = size // num_slice
+    if step == 0:
+        raise ValueError(
+            f"batch of {size} cannot feed {num_slice} slices")
+    slices = []
+    for i in range(num_slice):
+        start = i * step
+        stop = size if i == num_slice - 1 else (i + 1) * step
+        idx = [slice(None)] * data.ndim
+        idx[batch_axis] = slice(start, stop)
+        slices.append(data[tuple(idx)])
+    return slices
+
+
+def split_and_load(data, ctx_list, batch_axis=0, even_split=True):
+    """Split along the batch axis and place one slice per device
+    (reference: utils.py:87)."""
+    if not isinstance(data, NDArray):
+        data = _mxnp.array(data)
+    if len(ctx_list) == 1:
+        return [data.as_in_ctx(ctx_list[0])]
+    slices = split_data(data, len(ctx_list), batch_axis, even_split)
+    return [s.as_in_ctx(ctx) for s, ctx in zip(slices, ctx_list)]
+
+
+def clip_global_norm(arrays, max_norm, check_isfinite=True):
+    """Rescale `arrays` in place so their joint L2 norm is at most
+    `max_norm`; returns the pre-clip global norm (reference:
+    utils.py:117).
+
+    check_isfinite=True host-syncs and raises on a non-finite norm;
+    False keeps the whole operation on-device and async (returns the
+    norm as an NDArray) — a NaN norm then propagates NaN into the
+    arrays, surfacing at the next host read, the documented trade."""
+    if not arrays:
+        raise ValueError("arrays is empty")
+    import jax.numpy as jnp
+
+    total = apply_op(
+        lambda *xs: jnp.sqrt(sum(
+            jnp.sum(jnp.square(x.astype(jnp.float32))) for x in xs)),
+        *arrays, name="global_norm")
+    # device-side scale: min(1, max_norm / norm) — no host sync needed
+    scale = apply_op(
+        lambda t: jnp.minimum(1.0, max_norm / (t + 1e-8)), total,
+        name="clip_scale")
+    if check_isfinite:
+        norm = float(total.asnumpy())
+        if not math.isfinite(norm):
+            raise ValueError(
+                f"global norm is {norm}; gradients diverged "
+                "(check_isfinite=False keeps this async)")
+        if norm > max_norm:
+            for a in arrays:
+                a *= scale
+        return norm
+    for a in arrays:
+        a *= scale  # multiply by 1.0 when under the limit
+    return total
+
+
+def check_sha1(filename, sha1_hash):
+    """True iff the file's sha1 matches (reference: utils.py:182)."""
+    sha1 = hashlib.sha1()
+    with open(filename, "rb") as f:
+        while True:
+            chunk = f.read(1 << 20)
+            if not chunk:
+                break
+            sha1.update(chunk)
+    return sha1.hexdigest() == sha1_hash
+
+
+def download(url, path=None, overwrite=False, sha1_hash=None, retries=5,
+             verify_ssl=True):  # noqa: ARG001
+    """Download `url` to `path` (reference: utils.py:274). This image has
+    no network egress: if the target file already exists (pre-seeded) it
+    is verified and returned; otherwise a clear error explains how to
+    provide the file."""
+    fname = path if path and not os.path.isdir(path or "") else \
+        os.path.join(path or ".", url.split("/")[-1])
+    if os.path.exists(fname) and not overwrite:
+        if sha1_hash and not check_sha1(fname, sha1_hash):
+            raise OSError(f"{fname} exists but sha1 mismatch")
+        return fname
+    raise OSError(
+        f"cannot download {url}: this environment has no network access. "
+        f"Place the file at {fname} manually (sha1="
+        f"{sha1_hash or 'unchecked'}).")
+
+
+_hook_counter = [0]
+
+
+class HookHandle:
+    """Removable handle for registered hooks (reference: utils.py:398).
+    Keys are a global counter, so the same callable can register under
+    several handles without collision."""
+
+    def __init__(self):
+        self._hooks_dict = None
+        self._id = None
+
+    def attach(self, hooks_dict, hook):
+        assert not self._hooks_dict, "already attached"
+        _hook_counter[0] += 1
+        self._id = _hook_counter[0]
+        hooks_dict[self._id] = hook
+        self._hooks_dict = hooks_dict
+
+    def detach(self):
+        if self._hooks_dict and self._id in self._hooks_dict:
+            del self._hooks_dict[self._id]
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.detach()
+
+
+def shape_is_known(shape):
+    """True iff no dimension is unknown (reference: utils.py:433)."""
+    if shape is None:
+        return False
+    for d in shape:
+        if d is None or d < 0:
+            return False
+    return True
+
+
+def _as_list(obj):
+    return obj if isinstance(obj, (list, tuple)) else [obj]

@@ -1,0 +1,16 @@
+"""Internal op namespace (reference: mxnet/ndarray/_internal.py — the
+codegen target for `_`-prefixed ops). Attribute access resolves through
+the op registry, same as _api_internal."""
+from ..ops.registry import _OPS
+
+
+def __getattr__(name):
+    for cand in (name, f"_{name}", f"_npi_{name}"):
+        fn = _OPS.get(cand)
+        if fn is not None:
+            return fn
+    raise AttributeError(f"no registered internal op {name!r}")
+
+
+def __dir__():
+    return sorted(n for n in _OPS if n.startswith("_"))

@@ -1,0 +1,4 @@
+"""`mx.nd.contrib` namespace (reference: mxnet/ndarray/contrib.py).
+The contrib op corpus under its legacy spelling."""
+from ..contrib.ops import *  # noqa: F401,F403
+from ..contrib.ops import __all__  # noqa: F401

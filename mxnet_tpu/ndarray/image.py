@@ -1,0 +1,18 @@
+"""`mx.nd.image` namespace (reference: mxnet/ndarray/image.py — the
+_image_* op family under short names)."""
+from ..ops.registry import _OPS
+
+__all__ = ["resize", "crop", "to_tensor", "normalize", "random_crop",
+           "random_resized_crop"]
+
+
+def __getattr__(name):
+    fn = _OPS.get(f"_image_{name}")
+    if fn is not None:
+        return fn
+    raise AttributeError(f"mx.nd.image has no op {name!r}")
+
+
+def __dir__():
+    return sorted(n[len("_image_"):] for n in _OPS
+                  if n.startswith("_image_"))

@@ -1,0 +1,17 @@
+"""`mx.nd.op` namespace (reference: mxnet/ndarray/op.py — every
+registered op exposed flat). Mirrors the populated mx.nd surface."""
+
+
+def __getattr__(name):
+    from .. import ndarray as nd
+
+    try:
+        return getattr(nd, name)
+    except AttributeError:
+        raise AttributeError(f"mx.nd.op has no op {name!r}") from None
+
+
+def __dir__():
+    from .. import ndarray as nd
+
+    return dir(nd)

@@ -1,0 +1,3 @@
+"""`mx.npx.image` namespace (reference: mxnet/numpy_extension/image.py)
+— one surface with mx.nd.image (see ndarray/image.py)."""
+from ..ndarray.image import __all__, __dir__, __getattr__  # noqa: F401

@@ -1,0 +1,5 @@
+"""Alias module (reference: mxnet/optimizer/adabelief.py); the
+implementation lives in optimizer/optimizer.py."""
+from .optimizer import AdaBelief  # noqa: F401
+
+__all__ = ['AdaBelief']

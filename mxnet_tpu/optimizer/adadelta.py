@@ -1,0 +1,5 @@
+"""Alias module (reference: mxnet/optimizer/adadelta.py); the
+implementation lives in optimizer/optimizer.py."""
+from .optimizer import AdaDelta  # noqa: F401
+
+__all__ = ['AdaDelta']

@@ -1,0 +1,5 @@
+"""Alias module (reference: mxnet/optimizer/adagrad.py); the
+implementation lives in optimizer/optimizer.py."""
+from .optimizer import AdaGrad  # noqa: F401
+
+__all__ = ['AdaGrad']

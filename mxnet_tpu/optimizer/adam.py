@@ -1,0 +1,5 @@
+"""Alias module (reference: mxnet/optimizer/adam.py); the
+implementation lives in optimizer/optimizer.py."""
+from .optimizer import Adam  # noqa: F401
+
+__all__ = ['Adam']

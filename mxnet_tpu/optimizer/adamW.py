@@ -1,0 +1,5 @@
+"""Alias module (reference: mxnet/optimizer/adamW.py); the
+implementation lives in optimizer/optimizer.py."""
+from .optimizer import AdamW  # noqa: F401
+
+__all__ = ['AdamW']

@@ -1,0 +1,5 @@
+"""Alias module (reference: mxnet/optimizer/adamax.py); the
+implementation lives in optimizer/optimizer.py."""
+from .optimizer import Adamax  # noqa: F401
+
+__all__ = ['Adamax']

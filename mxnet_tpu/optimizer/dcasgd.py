@@ -1,0 +1,5 @@
+"""Alias module (reference: mxnet/optimizer/dcasgd.py); the
+implementation lives in optimizer/optimizer.py."""
+from .optimizer import DCASGD  # noqa: F401
+
+__all__ = ['DCASGD']

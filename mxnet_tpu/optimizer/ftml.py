@@ -1,0 +1,5 @@
+"""Alias module (reference: mxnet/optimizer/ftml.py); the
+implementation lives in optimizer/optimizer.py."""
+from .optimizer import FTML  # noqa: F401
+
+__all__ = ['FTML']

@@ -1,0 +1,5 @@
+"""Alias module (reference: mxnet/optimizer/ftrl.py); the
+implementation lives in optimizer/optimizer.py."""
+from .optimizer import Ftrl  # noqa: F401
+
+__all__ = ['Ftrl']

@@ -1,0 +1,5 @@
+"""Alias module (reference: mxnet/optimizer/lamb.py); the
+implementation lives in optimizer/optimizer.py."""
+from .optimizer import LAMB  # noqa: F401
+
+__all__ = ['LAMB']

@@ -1,0 +1,5 @@
+"""Alias module (reference: mxnet/optimizer/lans.py); the
+implementation lives in optimizer/optimizer.py."""
+from .optimizer import LANS  # noqa: F401
+
+__all__ = ['LANS']

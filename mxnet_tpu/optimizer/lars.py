@@ -1,0 +1,5 @@
+"""Alias module (reference: mxnet/optimizer/lars.py); the
+implementation lives in optimizer/optimizer.py."""
+from .optimizer import LARS  # noqa: F401
+
+__all__ = ['LARS']

@@ -1,0 +1,5 @@
+"""Alias module (reference: mxnet/optimizer/nadam.py); the
+implementation lives in optimizer/optimizer.py."""
+from .optimizer import Nadam  # noqa: F401
+
+__all__ = ['Nadam']

@@ -1,0 +1,5 @@
+"""Alias module (reference: mxnet/optimizer/nag.py); the
+implementation lives in optimizer/optimizer.py."""
+from .optimizer import NAG  # noqa: F401
+
+__all__ = ['NAG']

@@ -1,0 +1,5 @@
+"""Alias module (reference: mxnet/optimizer/rmsprop.py); the
+implementation lives in optimizer/optimizer.py."""
+from .optimizer import RMSProp  # noqa: F401
+
+__all__ = ['RMSProp']

@@ -1,0 +1,5 @@
+"""Alias module (reference: mxnet/optimizer/sgd.py); the
+implementation lives in optimizer/optimizer.py."""
+from .optimizer import SGD  # noqa: F401
+
+__all__ = ['SGD']

@@ -1,0 +1,5 @@
+"""Alias module (reference: mxnet/optimizer/sgld.py); the
+implementation lives in optimizer/optimizer.py."""
+from .optimizer import SGLD  # noqa: F401
+
+__all__ = ['SGLD']

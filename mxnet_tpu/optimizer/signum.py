@@ -1,0 +1,5 @@
+"""Alias module (reference: mxnet/optimizer/signum.py); the
+implementation lives in optimizer/optimizer.py."""
+from .optimizer import Signum  # noqa: F401
+
+__all__ = ['Signum']

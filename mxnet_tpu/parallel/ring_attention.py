@@ -203,42 +203,57 @@ def _ring_flash_vjp_fwd(q, k, v, axis_name, causal, scale, interpret):
 
 
 def _ring_flash_vjp_bwd(axis_name, causal, scale, interpret, res, g):
-    """Ring backward: one full rotation; each hop computes its block's
-    dK/dV (carried around the ring back to the owner) and accumulates dQ
-    using the saved global lse + delta = rowsum(dO * O) — the
-    FlashAttention-2 decomposition, blockwise under XLA."""
+    """Ring backward: one full rotation; each hop runs the block-streamed
+    Pallas flash backward (_flash_bwd) between the local Q and the
+    resident K/V block using the saved GLOBAL lse, so memory stays
+    O(S_local) — no (S_local, S_local) score matrix. Each hop's dK/dV is
+    carried around the ring back to the block's owner; dQ accumulates
+    locally. Cross-hop causal structure maps onto the kernel's flag:
+    past hops run it un-causal, the diagonal hop causal, future hops are
+    skipped entirely."""
+    from ..ops.pallas_attention import _flash_bwd
+
     q, k, v, out, lse = res
     n = jax.lax.axis_size(axis_name)
     my = jax.lax.axis_index(axis_name)
     s_local = q.shape[2]
-    gf = g.astype(jnp.float32)
-    delta = jnp.sum(gf * out.astype(jnp.float32), axis=-1)   # (B,H,S)
+    bq = min(128, s_local)
+    bk = min(128, s_local)
     perm = [(i, (i + 1) % n) for i in range(n)]
-    qf = q.astype(jnp.float32)
-    pos_q = my * s_local + jnp.arange(s_local)
+
+    b, h = q.shape[0], q.shape[1]
+    lse_flat = lse.reshape(b * h, s_local)  # _flash_bwd's (bh, S) layout
+
+    def grads_for(k_blk, v_blk, is_causal):
+        return _flash_bwd(q, k_blk, v_blk, out, lse_flat, g, is_causal,
+                          scale, bq, bk, interpret)
 
     def body(i, carry):
         dq, k_blk, v_blk, dk, dv = carry
         src = (my - i) % n
-        kf = k_blk.astype(jnp.float32)
-        vf = v_blk.astype(jnp.float32)
-        sblk = jnp.einsum("bhqd,bhkd->bhqk", qf, kf) * scale
         if causal:
-            pos_k = src * s_local + jnp.arange(s_local)
-            sblk = jnp.where(
-                pos_q[:, None] >= pos_k[None, :], sblk, -jnp.inf)
-        lse_e = lse[..., None]
-        p = jnp.where(jnp.isfinite(lse_e), jnp.exp(sblk - lse_e), 0.0)
-        p = jnp.where(jnp.isfinite(sblk), p, 0.0)
-        dv_blk = jnp.einsum("bhqk,bhqd->bhkd", p, gf)
-        dp = jnp.einsum("bhqd,bhkd->bhqk", gf, vf)
-        ds = p * (dp - delta[..., None]) * scale
-        dq = dq + jnp.einsum("bhqk,bhkd->bhqd", ds, kf)
-        dk_blk = jnp.einsum("bhqk,bhqd->bhkd", ds, qf)
+            def _skip():
+                return (jnp.zeros(q.shape, q.dtype),
+                        jnp.zeros(k.shape, k.dtype),
+                        jnp.zeros(v.shape, v.dtype))
+
+            dq_h, dk_blk, dv_blk = jax.lax.cond(
+                src > my,
+                _skip,
+                lambda: jax.lax.cond(
+                    src == my,
+                    lambda: grads_for(k_blk, v_blk, True),
+                    lambda: grads_for(k_blk, v_blk, False)),
+            )
+        else:
+            dq_h, dk_blk, dv_blk = grads_for(k_blk, v_blk, False)
+        dq = dq + dq_h.astype(jnp.float32)
         # rotate the K/V blocks AND their accumulated grads together so
         # every block's dK/dV arrives home after the full cycle
-        dk = jax.lax.ppermute(dk + dk_blk, axis_name, perm)
-        dv = jax.lax.ppermute(dv + dv_blk, axis_name, perm)
+        dk = jax.lax.ppermute(dk + dk_blk.astype(jnp.float32),
+                              axis_name, perm)
+        dv = jax.lax.ppermute(dv + dv_blk.astype(jnp.float32),
+                              axis_name, perm)
         k_blk = jax.lax.ppermute(k_blk, axis_name, perm)
         v_blk = jax.lax.ppermute(v_blk, axis_name, perm)
         return dq, k_blk, v_blk, dk, dv

@@ -1,0 +1,12 @@
+"""Internal symbol-op namespace (reference: mxnet/symbol/_internal.py).
+Resolves through the symbol op table."""
+
+
+def __getattr__(name):
+    from . import op as _sop
+
+    for cand in (name, name.lstrip("_")):
+        fn = getattr(_sop, cand, None)
+        if fn is not None:
+            return fn
+    raise AttributeError(f"no symbol op {name!r}")

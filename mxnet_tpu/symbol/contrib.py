@@ -1,0 +1,5 @@
+"""`mx.sym.contrib` namespace (reference: mxnet/symbol/contrib.py).
+Eager contrib implementations double as symbol-graph builders through the
+generic symbol op mechanism where registered; unregistered names raise."""
+from ..contrib.ops import *  # noqa: F401,F403
+from ..contrib.ops import __all__  # noqa: F401

@@ -322,3 +322,44 @@ def test_register_op_hook_silent_during_deferred_init():
     assert first == seen
     assert all("output" in t for t in first)
     assert not any(t.startswith(("0_", "1_")) for t in first)
+
+
+def test_gluon_utils():
+    """split_data / split_and_load / clip_global_norm / HookHandle
+    (reference: gluon/utils.py)."""
+    import numpy as onp
+
+    from mxnet_tpu.gluon import utils as gutils
+
+    x = mx.np.arange(24).reshape(8, 3)
+    parts = gutils.split_data(x, 4)
+    assert len(parts) == 4 and parts[0].shape == (2, 3)
+    with pytest.raises(ValueError, match="evenly"):
+        gutils.split_data(x, 3)
+    parts = gutils.split_data(x, 3, even_split=False)
+    assert sum(p.shape[0] for p in parts) == 8
+    loaded = gutils.split_and_load(x, [mx.cpu(), mx.cpu()])
+    assert len(loaded) == 2
+    # clip_global_norm scales in place
+    a = mx.np.array(onp.full((4,), 3.0, "f"))
+    b = mx.np.array(onp.full((3,), 4.0, "f"))
+    norm = gutils.clip_global_norm([a, b], max_norm=1.0)
+    expected = (4 * 9 + 3 * 16) ** 0.5
+    assert abs(norm - expected) < 1e-4
+    new_norm = float(((a.asnumpy() ** 2).sum()
+                      + (b.asnumpy() ** 2).sum()) ** 0.5)
+    assert abs(new_norm - 1.0) < 1e-4
+    # no-op when under the limit
+    norm2 = gutils.clip_global_norm([a, b], max_norm=10.0)
+    assert abs(norm2 - 1.0) < 1e-4
+    # hooks
+    hooks = {}
+    h = gutils.HookHandle()
+    h.attach(hooks, lambda: None)
+    assert len(hooks) == 1
+    h.detach()
+    assert not hooks
+    assert gutils.shape_is_known((2, 3))
+    assert not gutils.shape_is_known((2, -1))
+    with pytest.raises(OSError, match="no network"):
+        gutils.download("http://example.com/x.bin", path="/tmp/defnotexist")

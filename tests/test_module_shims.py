@@ -121,3 +121,35 @@ def test_random_module_identity():
     import mxnet_tpu.random as r
 
     assert mx.random is r
+
+
+def test_deep_import_aliases():
+    """Reference-era deep imports resolve (mxnet/optimizer/sgd.py,
+    ndarray/_internal.py, ndarray/op.py, ndarray/image.py,
+    ndarray/contrib.py, symbol/_internal.py)."""
+    from mxnet_tpu.optimizer.adamW import AdamW
+    from mxnet_tpu.optimizer.sgd import SGD
+
+    assert SGD is mx.optimizer.SGD and AdamW is mx.optimizer.AdamW
+
+    from mxnet_tpu.ndarray import _internal as ndi
+
+    out = ndi._plus_scalar(onp.ones((2,)), 5.0)
+    onp.testing.assert_array_equal(onp.asarray(out), [6.0, 6.0])
+
+    import mxnet_tpu.ndarray.contrib as ndc
+    import mxnet_tpu.ndarray.image as ndimg
+    import mxnet_tpu.ndarray.op as ndop
+
+    r = ndop.relu(mx.np.array([-1.0, 2.0]))
+    onp.testing.assert_array_equal(r.asnumpy(), [0.0, 2.0])
+    t = ndimg.to_tensor(onp.random.randint(
+        0, 255, (4, 6, 3)).astype("uint8"))
+    assert tuple(onp.asarray(t).shape) == (3, 4, 6)
+    assert hasattr(ndc, "box_iou") and hasattr(ndc, "ROIAlign")
+    with pytest.raises(AttributeError):
+        ndimg.not_an_image_op
+
+    from mxnet_tpu.symbol import _internal as symi
+
+    assert symi.relu is not None
