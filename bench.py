@@ -352,7 +352,16 @@ def main():
         except Exception as e:
             rows.append({"metric": "int8_agreement", "error": str(e)})
 
+    result_extra = {}
+    if platform == "cpu":
+        result_extra["note"] = (
+            "accelerator tunnel unreachable (PJRT plugin dials "
+            "PALLAS_AXON_POOL_IPS with no listener) — this row is the "
+            "honest 1-core CPU fallback, not a TPU measurement; see "
+            "BENCH_r01.json for the last on-chip number (2507.6 img/s "
+            "NCHW, before the NHWC layout work)")
     print(json.dumps({
+        **result_extra,
         "metric": f"resnet50_train_bf16_b{batch}_{layout.lower()}"
                   "_imgs_per_sec_per_chip" + suffix,
         "value": round(train_img_s, 2),
