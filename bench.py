@@ -354,12 +354,23 @@ def main():
 
     result_extra = {}
     if platform == "cpu":
-        result_extra["note"] = (
-            "accelerator tunnel unreachable (PJRT plugin dials "
-            "PALLAS_AXON_POOL_IPS with no listener) — this row is the "
-            "honest 1-core CPU fallback, not a TPU measurement; see "
-            "BENCH_r01.json for the last on-chip number (2507.6 img/s "
-            "NCHW, before the NHWC layout work)")
+        note = ("CPU run — not a TPU measurement; see BENCH_r01.json "
+                "for the last on-chip number (2507.6 img/s NCHW, before "
+                "the NHWC layout work)")
+        pool_ip = os.environ.get("PALLAS_AXON_POOL_IPS", "").split(",")[0]
+        if pool_ip:
+            import socket
+
+            s_ = socket.socket()
+            s_.settimeout(2)
+            try:
+                s_.connect((pool_ip.strip(), 8471))
+                s_.close()
+            except OSError:
+                note = ("accelerator tunnel unreachable (PJRT plugin "
+                        "dials PALLAS_AXON_POOL_IPS=" + pool_ip
+                        + " with no listener) — " + note)
+        result_extra["note"] = note
     print(json.dumps({
         **result_extra,
         "metric": f"resnet50_train_bf16_b{batch}_{layout.lower()}"

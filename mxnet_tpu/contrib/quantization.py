@@ -464,15 +464,20 @@ def quantized_flatten(data, min_data, max_data):
 
 def quantized_pooling(data, min_data, max_data, kernel=(2, 2),
                       pool_type="max", stride=None, pad=None,
-                      global_pool=False, **kwargs):  # noqa: ARG001
-    """int8 pooling (reference: quantized_pooling.cc)."""
+                      global_pool=False, ceil_mode=False,
+                      pooling_convention=None, layout=None, **kwargs):  # noqa: ARG001
+    """int8 pooling (reference: quantized_pooling.cc) — honors the same
+    pooling conventions as the fp op so int8 and fp32 graphs agree on
+    shapes."""
     from ..ops.registry import get_op
 
     pool = get_op("pooling")
 
     def pure(x, lo, hi):
         out = pool(_deq(x, lo, hi), kernel=kernel, pool_type=pool_type,
-                   stride=stride, pad=pad, global_pool=global_pool)
+                   stride=stride, pad=pad, global_pool=global_pool,
+                   ceil_mode=ceil_mode,
+                   pooling_convention=pooling_convention, layout=layout)
         return _req(out)
 
     return apply_op(pure, *_as_nd(data, min_data, max_data),

@@ -182,8 +182,7 @@ class _Pool(HybridBlock):
         self._global = global_pool
         self._count_include_pad = count_include_pad
         self._layout = layout
-        if ceil_mode:
-            raise NotImplementedError("ceil_mode pooling not supported")
+        self._ceil_mode = bool(ceil_mode)
 
     def forward(self, x):
         return npx.pooling(
@@ -191,7 +190,7 @@ class _Pool(HybridBlock):
             stride=self._strides, pad=self._padding,
             global_pool=self._global,
             count_include_pad=self._count_include_pad,
-            layout=self._layout)
+            layout=self._layout, ceil_mode=self._ceil_mode)
 
     def __repr__(self):
         return (f"{type(self).__name__}(size={self._kernel}, "

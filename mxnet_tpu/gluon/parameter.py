@@ -9,6 +9,8 @@ parameter temporarily exposes a jax tracer instead of its concrete buffer).
 """
 from __future__ import annotations
 
+import uuid
+
 import threading as _threading
 
 import jax.numpy as jnp
@@ -274,8 +276,19 @@ class Parameter:
 
     # misc
     def var(self):
-        raise NotImplementedError(
-            "symbol API not supported; use HybridBlock tracing")
+        """Symbol variable for this parameter (reference: parameter.py
+        var). The variable name is namespaced per parameter object (the
+        reference uses a UUID) so two blocks' 'weight' params never
+        alias in one graph; known shape is attached for inference."""
+        from ..symbol.symbol import var as _sym_var
+
+        if not hasattr(self, "_var_name") or self._var_name is None:
+            try:
+                self._var_name = f"{self.name}_{uuid.uuid4().hex[:8]}"
+            except AttributeError:  # __slots__ without the field
+                return _sym_var(f"{self.name}_{id(self):x}",
+                                shape=self.shape)
+        return _sym_var(self._var_name, shape=self.shape)
 
 
 class Constant(Parameter):

@@ -164,11 +164,24 @@ def conv_transpose(x, weight, bias=None, stride=None, pad=None, dilate=None,
 
 @register_op("pooling")
 def pool(x, kernel, pool_type="max", stride=None, pad=None, global_pool=False,
-         count_include_pad=True, layout=None):
+         count_include_pad=True, layout=None, ceil_mode=False,
+         pooling_convention=None):
     """Max/avg/lp pooling via reduce_window (reference: nn/pooling.cc).
 
     layout: None/channels-first ("NCHW"...) pools x[2:]; channels-last
-    ("NHWC"...) pools x[1:-1]."""
+    ("NHWC"...) pools x[1:-1]. ceil_mode (the reference's
+    pooling_convention='full') rounds output sizes UP by padding extra
+    rows/cols on the high side of each spatial dim."""
+    same_mode = False
+    if pooling_convention is not None:
+        if pooling_convention == "full":
+            ceil_mode = True
+        elif pooling_convention == "same":
+            same_mode = True
+        elif pooling_convention != "valid":
+            raise ValueError(
+                f"unknown pooling_convention {pooling_convention!r}; "
+                "expected valid/full/same")
     nd = x.ndim - 2
     channels_last = layout is not None and layout[-1] == "C"
     sp = slice(1, -1) if channels_last else slice(2, None)
@@ -184,14 +197,31 @@ def pool(x, kernel, pool_type="max", stride=None, pad=None, global_pool=False,
     pad = pad or (0,) * nd
     if isinstance(pad, int):
         pad = (pad,) * nd
+    pad_pairs = [(p, p) for p in pad]
+    if same_mode and not global_pool:
+        # output = ceil(n / stride); pad split low/high like the
+        # reference's same convention
+        spatial = x.shape[sp]
+        for i, (n, k, st) in enumerate(zip(spatial, kernel, stride)):
+            out_same = -(-n // st)
+            total = max((out_same - 1) * st + k - n, 0)
+            pad_pairs[i] = (total // 2, total - total // 2)
+    if ceil_mode and not global_pool:
+        spatial = x.shape[sp]
+        for i, (n, k, st, p) in enumerate(
+                zip(spatial, kernel, stride, pad)):
+            span = n + 2 * p - k
+            out_full = -(-span // st) + 1          # ceil
+            extra = (out_full - 1) * st + k - (n + 2 * p)
+            pad_pairs[i] = (p, p + max(0, extra))
     if channels_last:
         window = (1,) + tuple(kernel) + (1,)
         strides = (1,) + tuple(stride) + (1,)
-        padding = ((0, 0),) + tuple((p, p) for p in pad) + ((0, 0),)
+        padding = ((0, 0),) + tuple(pad_pairs) + ((0, 0),)
     else:
         window = (1, 1) + tuple(kernel)
         strides = (1, 1) + tuple(stride)
-        padding = ((0, 0), (0, 0)) + tuple((p, p) for p in pad)
+        padding = ((0, 0), (0, 0)) + tuple(pad_pairs)
     if pool_type == "max":
         init = -jnp.inf if jnp.issubdtype(x.dtype, jnp.floating) else jnp.iinfo(x.dtype).min
         return lax.reduce_window(x, init, lax.max, window, strides, padding)

@@ -363,3 +363,52 @@ def test_gluon_utils():
     assert not gutils.shape_is_known((2, -1))
     with pytest.raises(OSError, match="no network"):
         gutils.download("http://example.com/x.bin", path="/tmp/defnotexist")
+
+
+def test_ceil_mode_pooling_matches_torch():
+    """pooling_convention='full' (ceil_mode) rounds output sizes up
+    (reference: nn/pooling.cc full convention)."""
+    torch = pytest.importorskip("torch")
+    import torch.nn.functional as F
+
+    # 6x6/k3/s2: floor -> 2x2, ceil -> 3x3 (the paths really differ)
+    x = onp.random.RandomState(0).rand(1, 2, 6, 6).astype("f")
+    out = nn.MaxPool2D(3, strides=2, ceil_mode=True)(mx.np.array(x))
+    ref = F.max_pool2d(torch.tensor(x), 3, 2, ceil_mode=True).numpy()
+    assert out.shape == (1, 2, 3, 3)
+    onp.testing.assert_allclose(out.asnumpy(), ref)
+    out2 = nn.AvgPool2D(3, strides=2, ceil_mode=True,
+                        count_include_pad=False)(mx.np.array(x))
+    ref2 = F.avg_pool2d(torch.tensor(x), 3, 2, ceil_mode=True,
+                        count_include_pad=False).numpy()
+    onp.testing.assert_allclose(out2.asnumpy(), ref2, rtol=1e-6)
+    # floor default unchanged
+    out3 = nn.MaxPool2D(3, strides=2)(mx.np.array(x))
+    assert out3.shape == (1, 2, 2, 2)
+    # op-level spellings
+    from mxnet_tpu.ops.registry import get_op
+
+    o = get_op("pooling")(x, kernel=(3, 3), stride=(2, 2),
+                          pooling_convention="full")
+    assert o.shape == (1, 2, 3, 3)
+    o2 = get_op("pooling")(x, kernel=(3, 3), stride=(2, 2),
+                           pooling_convention="same")
+    assert o2.shape == (1, 2, 3, 3)  # ceil(6/2) = 3
+    with pytest.raises(ValueError, match="pooling_convention"):
+        get_op("pooling")(x, kernel=(3, 3), pooling_convention="bogus")
+
+
+def test_parameter_var_returns_symbol():
+    from mxnet_tpu.symbol.symbol import Symbol
+
+    d = nn.Dense(2, in_units=3)
+    d.initialize()
+    v = d.weight.var()
+    assert isinstance(v, Symbol)
+    # distinct parameters never alias in a graph (review regression)
+    d2 = nn.Dense(2, in_units=3)
+    d2.initialize()
+    assert str(d.weight.var()) != str(d2.weight.var()) or \
+        d.weight.var()._name != d2.weight.var()._name
+    # stable per parameter
+    assert d.weight.var()._name == d.weight.var()._name
