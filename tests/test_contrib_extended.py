@@ -571,3 +571,43 @@ def test_dgl_graph_compact_return_mapping():
     # compacted graph renumbers edges 1..E; mapping holds parent edge ids
     assert sorted(cd[cd > 0]) == list(range(1, (cd > 0).sum() + 1))
     assert ((md > 0) == (cd > 0)).all()
+
+
+# --- finite-difference gradient checks for the round-2 differentiable
+# ops (reference test strategy: check_numeric_gradient oracle) --------------
+
+def test_numeric_gradients_round2_ops():
+    from mxnet_tpu.test_utils import check_numeric_gradient
+
+    rs = onp.random.RandomState(0)
+    x = rs.rand(1, 2, 4, 4).astype("f")
+    check_numeric_gradient(
+        lambda a: cops.adaptive_avg_pooling(a, 2), [x])
+    check_numeric_gradient(
+        lambda a: cops.bilinear_resize_2d(a, 6, 6), [x])
+    check_numeric_gradient(lambda a: cops.div_sqrt_dim(a), [x])
+    qkv = rs.rand(3, 1, 2 * 3 * 2).astype("f") * 0.5
+    check_numeric_gradient(
+        lambda a: cops.interleaved_matmul_selfatt_qk(a, 2), [qkv])
+
+
+def test_numeric_gradient_sldwin():
+    from mxnet_tpu.test_utils import check_numeric_gradient
+
+    rs = onp.random.RandomState(1)
+    B, L, H, D, w = 1, 4, 1, 3, 1
+    q = rs.rand(B, L, H, D).astype("f") * 0.5
+    k = rs.rand(B, L, H, D).astype("f") * 0.5
+    dil = mx.np.array([1])
+    check_numeric_gradient(
+        lambda a, b: cops.sldwin_atten_score(a, b, dil, w=w), [q, k])
+
+
+def test_numeric_gradient_psroi():
+    from mxnet_tpu.test_utils import check_numeric_gradient
+
+    rs = onp.random.RandomState(2)
+    x = rs.rand(1, 4, 4, 4).astype("f")
+    rois = mx.np.array(onp.array([[0, 0, 0, 3, 3]], "f"))
+    check_numeric_gradient(
+        lambda a: cops.psroi_pooling(a, rois, 1.0, 1, 2), [x])
