@@ -5,7 +5,8 @@ import numpy as _np
 
 from ...ndarray.ndarray import NDArray
 
-__all__ = ["Stack", "Pad", "Group", "default_batchify_fn"]
+__all__ = ["Stack", "Pad", "Group", "Append", "AsList",
+           "default_batchify_fn"]
 
 
 def _stack_arrs(arrs):
@@ -67,3 +68,32 @@ class Group:
         assert len(data[0]) == len(self._fns)
         return tuple(fn([d[i] for d in data])
                      for i, fn in enumerate(self._fns))
+
+
+class Append:
+    """Keep samples as separate arrays, optionally expanded with a unit
+    batch dim (reference: batchify.Append — for variable-shape data that
+    must not be stacked or padded)."""
+
+    def __init__(self, expand=True, batch_axis=0):
+        self._expand = expand
+        self._batch_axis = batch_axis
+
+    def __call__(self, data):
+        from ... import numpy as mnp
+
+        out = []
+        for d in data:
+            arr = _np.asarray(d)
+            if self._expand:
+                arr = _np.expand_dims(arr, self._batch_axis)
+            out.append(mnp.array(arr))
+        return out
+
+
+class AsList:
+    """Return the batch as a plain python list, untouched (reference:
+    batchify.AsList — for non-tensor fields like strings)."""
+
+    def __call__(self, data):
+        return list(data)

@@ -46,3 +46,15 @@ def test_distributed_example_via_launcher():
         capture_output=True, text=True, timeout=600, env=env)
     assert r.returncode == 0, r.stdout + r.stderr
     assert "[rank 0] done" in r.stdout + r.stderr
+
+
+def test_long_context_moe_example():
+    env = dict(os.environ, JAX_PLATFORMS="cpu", PYTHONPATH=REPO,
+               XLA_FLAGS="--xla_force_host_platform_device_count=8")
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+    r = subprocess.run(
+        [sys.executable, os.path.join(REPO, "example",
+                                      "long_context_moe.py")],
+        capture_output=True, text=True, timeout=900, env=env)
+    assert r.returncode == 0, r.stdout + r.stderr
+    assert "long_context_moe OK" in r.stdout

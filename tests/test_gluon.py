@@ -412,3 +412,23 @@ def test_parameter_var_returns_symbol():
         d.weight.var()._name != d2.weight.var()._name
     # stable per parameter
     assert d.weight.var()._name == d.weight.var()._name
+
+
+def test_batchify_append_aslist():
+    """Append keeps ragged samples separate; AsList passes through
+    (reference: gluon/data/batchify.py Append/AsList)."""
+    from mxnet_tpu.gluon.data import batchify
+
+    ragged = [onp.ones((2, 3), "f"), onp.ones((4, 3), "f")]
+    out = batchify.Append()(ragged)
+    assert len(out) == 2
+    assert out[0].shape == (1, 2, 3) and out[1].shape == (1, 4, 3)
+    flat = batchify.Append(expand=False)(ragged)
+    assert flat[0].shape == (2, 3)
+    strs = batchify.AsList()(["a", "b", "c"])
+    assert strs == ["a", "b", "c"]
+    # Group composes them per field
+    data = [(onp.ones((2,), "f"), "x"), (onp.ones((3,), "f"), "y")]
+    arrs, labels = batchify.Group(batchify.Append(), batchify.AsList())(
+        data)
+    assert len(arrs) == 2 and labels == ["x", "y"]
