@@ -1,0 +1,33 @@
+"""`mx.sym.linalg` namespace (reference: mxnet/symbol/linalg.py — the
+la_op family as symbol builders). Short names map onto the registered
+`linalg_*` table entries, so `mx.sym.linalg.potrf(A)` builds the same
+graph node `mx.sym.linalg_...` lowering uses."""
+from __future__ import annotations
+
+from .op_extended import _LINALG_NOUT
+from .symbol import _OP_TABLE, Symbol
+
+__all__ = []  # populated below
+
+
+def _make(short, full):
+    nout = _LINALG_NOUT.get(full, 1)
+
+    def wrapper(*inputs, name=None, **attrs):
+        return Symbol.create(full, *inputs, name=name, nout=nout, **attrs)
+
+    wrapper.__name__ = short
+    wrapper.__doc__ = f"Symbol builder for {full} (reference: la_op.cc)."
+    return wrapper
+
+
+def _populate():
+    g = globals()
+    for opname in sorted(_OP_TABLE):
+        if opname.startswith("linalg_"):
+            short = opname[len("linalg_"):]
+            g[short] = _make(short, opname)
+            __all__.append(short)
+
+
+_populate()

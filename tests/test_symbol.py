@@ -163,3 +163,21 @@ class TestSymbolMultiOutput:
         assert len(s.list_outputs()) == 2
         (o,) = (s[0] + s[1]).eval(x=np.array([[1.0, 2.0, 3.0, 4.0]]))
         onp.testing.assert_allclose(o.asnumpy(), [[4.0, 6.0]])
+
+
+def test_symbol_linalg_namespace():
+    """mx.sym.linalg.* short names build the linalg_* graph nodes
+    (reference: mxnet/symbol/linalg.py over la_op.cc)."""
+    import numpy as onp
+
+    assert len(mx.sym.linalg.__all__) >= 20
+    A = mx.sym.var("A")
+    L = mx.sym.linalg.potrf(A)
+    spd = onp.array([[4.0, 1.0], [1.0, 3.0]], "f")
+    out = L.bind(args={"A": spd}).forward()[0].asnumpy()
+    onp.testing.assert_allclose(out, onp.linalg.cholesky(spd),
+                                rtol=1e-5)
+    # multi-output member
+    Q = mx.sym.linalg.gelqf(A)
+    outs = Q.bind(args={"A": onp.eye(2, dtype="f")}).forward()
+    assert len(outs) == 2
