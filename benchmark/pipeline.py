@@ -71,6 +71,24 @@ def run(n, batch, num_workers, thread_pool):
 
 
 def main():
+    # a wedged accelerator tunnel hangs the first device init; probe in
+    # a subprocess and force CPU if unreachable (bench.py pattern)
+    import subprocess
+
+    try:
+        probe = subprocess.run(
+            [sys.executable, "-c",
+             "import jax; print(jax.devices()[0].platform)"],
+            capture_output=True, timeout=90, text=True)
+        ok = probe.returncode == 0
+    except subprocess.TimeoutExpired:
+        ok = False
+    if not ok:
+        import jax
+
+        print("accelerator unreachable; pipeline bench on CPU",
+              file=sys.stderr)
+        jax.config.update("jax_platforms", "cpu")
     ap = argparse.ArgumentParser()
     ap.add_argument("--n", type=int, default=2048)
     ap.add_argument("--batch", type=int, default=128)
