@@ -14,6 +14,7 @@ from .symbol import (Executor, Group, Symbol, Variable, fromjson, load,
 from . import op  # registers the op table; also exposes sym.op.* wrappers
 from .op import *  # noqa: F401,F403
 from . import linalg  # noqa: F401
+from . import random  # noqa: F401
 from . import op_extended  # math tail, indexing, sequence, norms
 from .op_extended import *  # noqa: F401,F403
 

@@ -1,0 +1,59 @@
+"""`mx.sym.random` namespace (reference: mxnet/symbol/random.py).
+
+Symbol graphs here are deterministic lowerings (export/SymbolBlock), so
+random nodes carry an explicit integer `seed` attr: the node is a pure
+function of (shape, seed) — reproducible across executions and faithful
+under graph serialization. Stateful per-call randomness belongs to the
+imperative frontend (mx.np.random / mx.random)."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from .symbol import Symbol, register_sym_op
+
+__all__ = ["uniform", "normal", "randint", "gamma", "exponential"]
+
+
+def _key(attrs):
+    return jax.random.PRNGKey(int(attrs.get("seed", 0)))
+
+
+def _shape(attrs):
+    s = attrs.get("shape", (1,))
+    if isinstance(s, (int, float)):
+        s = (int(s),)
+    return tuple(int(d) for d in s)
+
+
+register_sym_op("random_uniform", lambda ins, a: jax.random.uniform(
+    _key(a), _shape(a), jnp.float32, float(a.get("low", 0.0)),
+    float(a.get("high", 1.0))))
+register_sym_op("random_normal", lambda ins, a: (
+    float(a.get("loc", 0.0)) + float(a.get("scale", 1.0))
+    * jax.random.normal(_key(a), _shape(a), jnp.float32)))
+register_sym_op("random_randint", lambda ins, a: jax.random.randint(
+    _key(a), _shape(a), int(a.get("low", 0)), int(a.get("high", 2))))
+register_sym_op("random_gamma", lambda ins, a: jax.random.gamma(
+    _key(a), float(a.get("alpha", 1.0)), _shape(a)) *
+    float(a.get("beta", 1.0)))
+register_sym_op("random_exponential", lambda ins, a: jax.random.exponential(
+    _key(a), _shape(a)) / float(a.get("lam", 1.0)))
+
+
+def _make(short, full):
+    def wrapper(shape=(1,), seed=0, name=None, **attrs):
+        return Symbol.create(full, shape=tuple(shape), seed=int(seed),
+                             name=name, **attrs)
+
+    wrapper.__name__ = short
+    wrapper.__doc__ = (f"Symbol builder for {full}; pure function of "
+                       "(shape, seed) — see module docstring.")
+    return wrapper
+
+
+uniform = _make("uniform", "random_uniform")
+normal = _make("normal", "random_normal")
+randint = _make("randint", "random_randint")
+gamma = _make("gamma", "random_gamma")
+exponential = _make("exponential", "random_exponential")

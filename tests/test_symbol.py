@@ -181,3 +181,27 @@ def test_symbol_linalg_namespace():
     Q = mx.sym.linalg.gelqf(A)
     outs = Q.bind(args={"A": onp.eye(2, dtype="f")}).forward()
     assert len(outs) == 2
+
+
+def test_symbol_random_namespace():
+    """mx.sym.random.* nodes are pure functions of (shape, seed) —
+    reproducible and export-safe (reference: mxnet/symbol/random.py;
+    deterministic-seed redesign documented in symbol/random.py)."""
+    import numpy as onp
+
+    u = mx.sym.random.uniform(shape=(4,), seed=7, low=-1, high=1)
+    a = u.bind(args={}).forward()[0].asnumpy()
+    b = u.bind(args={}).forward()[0].asnumpy()
+    onp.testing.assert_array_equal(a, b)  # same seed -> same draw
+    assert (a >= -1).all() and (a <= 1).all()
+    u2 = mx.sym.random.uniform(shape=(4,), seed=8)
+    c = u2.bind(args={}).forward()[0].asnumpy()
+    assert not onp.array_equal(a, c)
+    n = mx.sym.random.normal(shape=(1000,), seed=0, loc=2.0, scale=0.5)
+    vals = n.bind(args={}).forward()[0].asnumpy()
+    assert abs(vals.mean() - 2.0) < 0.1 and abs(vals.std() - 0.5) < 0.1
+    # composes into graphs and serializes
+    g = u + mx.sym.random.normal(shape=(4,), seed=1)
+    out = g.bind(args={}).forward()[0]
+    assert out.shape == (4,)
+    assert "random_uniform" in g.tojson()
