@@ -63,7 +63,14 @@ def _timeit(fn, sync, iters, warmup):
     return time.perf_counter() - t0, out
 
 
-def bench_resnet_train(platform, layout, batch, iters, warmup):
+def build_resnet_train(layout, batch, donate=True):
+    """Build the ResNet-50 bf16 train step exactly as the bench times it.
+
+    Returns (step, state, x, y) where step(params, momenta, x, y, key) ->
+    (new_params, new_momenta, loss). Shared with tools/bench_estimate.py so
+    the cost-model artifact analyses the SAME compiled computation the
+    on-chip bench runs.
+    """
     import jax
     import jax.numpy as jnp
 
@@ -111,7 +118,17 @@ def bench_resnet_train(platform, layout, batch, iters, warmup):
                 new_params[n] = new_pd[n]
         return new_params, new_mom, loss
 
-    step = jax.jit(train_step, donate_argnums=(0, 1))
+    step = jax.jit(train_step, donate_argnums=(0, 1) if donate else ())
+    return net, step, params, momenta, x, y
+
+
+def bench_resnet_train(platform, layout, batch, iters, warmup):
+    import jax
+    import jax.numpy as jnp
+
+    net, step, params, momenta, x, y = build_resnet_train(layout, batch)
+    rng = jax.random.PRNGKey(0)
+    xshape = x.shape
 
     state = {"params": params, "momenta": momenta}
     keys = [jax.random.PRNGKey(100 + i) for i in range(iters + warmup)]
@@ -179,9 +196,9 @@ def bench_lenet_imperative(platform, iters, warmup):
     return batch * iters / dt
 
 
-def bench_bert_finetune(platform, iters, warmup):
-    """BERT-base bf16 fine-tune step throughput (BASELINE config #4:
-    SQuAD-style QA head, seq 384, bf16)."""
+def build_bert_finetune(batch=8, seq=384, donate=True):
+    """Build the BERT-base bf16 fine-tune step exactly as the bench times
+    it (SQuAD-style QA head). Shared with tools/bench_estimate.py."""
     import jax
     import jax.numpy as jnp
 
@@ -190,7 +207,6 @@ def bench_bert_finetune(platform, iters, warmup):
     from mxnet_tpu.gluon.model_zoo.bert import BERTForQA, bert_12_768_12
 
     mx.seed(0)
-    batch, seq = 8, 384
     net = BERTForQA(bert_12_768_12(vocab_size=30522, dropout=0.1))
     net.initialize()
     amp.convert_hybrid_block(net, target_dtype="bfloat16")
@@ -222,7 +238,17 @@ def bench_bert_finetune(platform, iters, warmup):
                for n, p in params.items()}
         return new, loss
 
-    step = jax.jit(step_fn, donate_argnums=(0,))
+    step = jax.jit(step_fn, donate_argnums=(0,) if donate else ())
+    return step, params
+
+
+def bench_bert_finetune(platform, iters, warmup):
+    """BERT-base bf16 fine-tune step throughput (BASELINE config #4:
+    SQuAD-style QA head, seq 384, bf16)."""
+    import jax
+
+    batch = 8
+    step, params = build_bert_finetune(batch=batch)
     state = {"p": params}
     keys = [jax.random.PRNGKey(i) for i in range(iters + warmup)]
     ki = iter(keys)

@@ -228,12 +228,23 @@ class P3Store(TPUDist):
     def pushpull(self, key, value, out=None, priority=0):
         keys = _aslist(key)
         if len(keys) != 1:
-            # list form: dispatch in index order — the Trainer contract
-            # assigns priority -index, so this IS descending priority
+            # list form: Trainer passes priority=0 and relies on the P3
+            # contract of descending -index dispatch; an explicit caller
+            # priority (scalar or per-key list) takes precedence.
             vals = value
             outs = out if out is not None else [None] * len(keys)
-            for i in range(len(keys)):
-                self.pushpull(keys[i], vals[i], outs[i], priority=-i)
+            if isinstance(priority, (list, tuple)):
+                prios = list(priority)
+                if len(prios) != len(keys):
+                    raise ValueError(
+                        f"priority list length {len(prios)} != {len(keys)}")
+            elif priority:
+                prios = [priority] * len(keys)
+            else:
+                prios = [-i for i in range(len(keys))]
+            order = sorted(range(len(keys)), key=lambda i: -prios[i])
+            for i in order:
+                self.pushpull(keys[i], vals[i], outs[i], priority=prios[i])
             return
         vals = _aslist(value)
         size = int(vals[0].size)

@@ -15,6 +15,8 @@ from __future__ import annotations
 import jax
 import jax.numpy as jnp
 
+_jit_cache = {}
+
 __all__ = ["top_k_routing", "moe_ffn", "moe_ffn_sharded", "init_moe_params"]
 
 
@@ -105,10 +107,15 @@ def moe_ffn_sharded(params, x, mesh, axis="ep", capacity_factor=1.25,
     }
     x = jax.device_put(x, repl)
 
-    @jax.jit
-    def run(p, xx):
-        out, aux = moe_ffn(p, xx, capacity_factor, top_k)
-        return out, aux
+    key = (mesh, axis, capacity_factor, top_k)
+    run = _jit_cache.get(key)
+    if run is None:
+        @jax.jit
+        def run(p, xx):
+            out, aux = moe_ffn(p, xx, capacity_factor, top_k)
+            return out, aux
+
+        _jit_cache[key] = run
 
     with mesh:
         return run(params, x)

@@ -81,7 +81,7 @@ def pipeline_apply_sharded(stage_fn, stacked_params, microbatches, mesh,
     stacked_params: pytree with leading dim n_stages == mesh.shape[axis].
     microbatches: (M, ...) replicated across stages.
     """
-    from jax.experimental.shard_map import shard_map
+    from jax import shard_map
 
     n_stages = mesh.shape[axis]
     for leaf in jax.tree_util.tree_leaves(stacked_params):
@@ -95,7 +95,7 @@ def pipeline_apply_sharded(stage_fn, stacked_params, microbatches, mesh,
         mesh=mesh,
         in_specs=(param_specs, P()),
         out_specs=P(),
-        check_rep=False,
+        check_vma=False,
     )
     stacked_params = jax.tree_util.tree_map(
         lambda p, spec: jax.device_put(p, NamedSharding(mesh, spec)),
