@@ -262,76 +262,6 @@ class MNISTIter(NDArrayIter):
                          shuffle=shuffle)
 
 
-class ImageRecordIter(DataIter):
-    """RecordIO image iterator (reference: src/io/iter_image_recordio_2.cc).
-
-    Streams packed images from a .rec file written by im2rec/pack_img.
-    """
-
-    def __init__(self, path_imgrec, data_shape, batch_size=1,
-                 shuffle=False, label_width=1, **kwargs):  # noqa: ARG002
-        super().__init__(batch_size)
-        from ..recordio import IndexedRecordIO, unpack_img
-
-        self._rec = IndexedRecordIO(path_imgrec)
-        self._unpack = unpack_img
-        self._shape = tuple(data_shape)
-        self._shuffle = shuffle
-        self._order = _np.arange(len(self._rec))
-        self._cursor = 0
-        self.reset()
-
-    def reset(self):
-        if self._shuffle:
-            _np.random.shuffle(self._order)
-        self._cursor = 0
-
-    @property
-    def provide_data(self):
-        return [DataDesc("data", (self.batch_size,) + self._shape)]
-
-    @property
-    def provide_label(self):
-        return [DataDesc("softmax_label", (self.batch_size,))]
-
-    def _fit_shape(self, img):
-        """Resize (nearest) + channel-fix decoded HWC image to data_shape
-        (C,H,W) — the iter_image_recordio_2.cc decode-resize stage."""
-        c, h, w = self._shape
-        if img.shape[2] != c:
-            if c == 1:                   # color -> gray: luminance mean
-                img = img.mean(axis=2, keepdims=True)
-            elif img.shape[2] == 1:      # gray -> color: replicate
-                img = img.repeat(c, axis=2)
-            else:                        # e.g. RGBA -> RGB: drop extras
-                img = img[:, :, :c]
-        if img.shape[:2] != (h, w):
-            ri = (_np.arange(h) * img.shape[0] // h)
-            ci = (_np.arange(w) * img.shape[1] // w)
-            img = img[ri[:, None], ci[None, :]]
-        return img
-
-    def next(self):
-        if self._cursor + self.batch_size > len(self._order):
-            raise StopIteration
-        imgs, labels = [], []
-        for i in self._order[self._cursor : self._cursor + self.batch_size]:
-            header, img = self._unpack(self._rec.read_idx(int(i)))
-            if img.ndim == 2:
-                img = img[:, :, None]
-            img = self._fit_shape(img)
-            imgs.append(img.transpose(2, 0, 1).astype(_np.float32))
-            labels.append(_np.float32(header.label)
-                          if _np.isscalar(header.label) or
-                          getattr(header.label, "ndim", 0) == 0
-                          else header.label)
-        self._cursor += self.batch_size
-        return DataBatch([mnp.array(_np.stack(imgs))],
-                         [mnp.array(_np.stack(labels))],
-                         provide_data=self.provide_data,
-                         provide_label=self.provide_label)
-
-
 class ResizeIter(DataIter):
     """Resize an iterator to a fixed number of batches (reference:
     io.ResizeIter)."""
@@ -364,24 +294,31 @@ class PrefetchingIter(DataIter):
     io.PrefetchingIter over iter_prefetcher.h)."""
 
     def __init__(self, iters, rename_data=None, rename_label=None):  # noqa: ARG002
-        import queue
-        import threading
-
         if not isinstance(iters, (list, tuple)):
             iters = [iters]
         super().__init__(iters[0].batch_size)
         self._iters = iters
+        self._start_worker()
+
+    def _start_worker(self):
+        import queue
+        import threading
+
         self._queue = queue.Queue(maxsize=4)
         self._stop = threading.Event()
+        stop, q = self._stop, self._queue
 
         def worker():
-            while not self._stop.is_set():
+            while not stop.is_set():
                 try:
                     batches = [it.next() for it in self._iters]
                 except StopIteration:
-                    self._queue.put(None)
+                    q.put(None)
                     return
-                self._queue.put(batches)
+                except Exception as e:  # surface at the consumer's next()
+                    q.put(e)
+                    return
+                q.put(batches)
 
         self._thread = threading.Thread(target=worker, daemon=True)
         self._thread.start()
@@ -393,7 +330,26 @@ class PrefetchingIter(DataIter):
         if item is None:
             self._stop.set()
             raise StopIteration
+        if isinstance(item, Exception):
+            self._stop.set()
+            raise item
         return item[0] if len(item) == 1 else item
 
     def reset(self):
-        raise NotImplementedError("recreate PrefetchingIter to reset")
+        """Stop the producer, reset the wrapped iterators, restart
+        (multi-epoch training over the legacy prefetcher — the round-2
+        NotImplementedError is gone)."""
+        self._stop.set()
+        # unblock a producer stuck on a full queue, then wait for it
+        while self._thread.is_alive():
+            try:
+                self._queue.get_nowait()
+            except Exception:
+                pass
+            self._thread.join(timeout=0.05)
+        for it in self._iters:
+            it.reset()
+        self._start_worker()
+
+
+from .image_record import ImageRecordIter  # noqa: E402  (needs DataIter above)
