@@ -52,7 +52,8 @@ def _interp_pil(interp):
     _require_pil()
     table = {
         0: _PILImage.NEAREST, 1: _PILImage.BILINEAR, 2: _PILImage.BICUBIC,
-        3: _PILImage.NEAREST, 4: _PILImage.LANCZOS,
+        3: _PILImage.BOX,   # cv2 INTER_AREA ≈ PIL box filter
+        4: _PILImage.LANCZOS,
     }
     return table.get(interp, _PILImage.BILINEAR)
 

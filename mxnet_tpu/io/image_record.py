@@ -30,14 +30,14 @@ __all__ = ["ImageRecordIter"]
 
 
 def _interp_pil(inter_method, rs=None):
-    """Map reference inter_method codes (cv2 numbering) to PIL resample."""
-    from PIL import Image
+    """Reference inter_method codes (cv2 numbering) to PIL resample —
+    shares mx.image's table (one mapping to keep in sync) and adds the
+    iterator-only code 10 = random interp per image."""
+    from ..image.image import _interp_pil as _base
 
-    table = {0: Image.NEAREST, 1: Image.BILINEAR, 2: Image.BICUBIC,
-             3: Image.BOX, 4: Image.LANCZOS}
-    if inter_method == 10 and rs is not None:   # rand interp
-        return table[int(rs.randint(0, 5))]
-    return table.get(int(inter_method), Image.BILINEAR)
+    if inter_method == 10 and rs is not None:
+        return _base(int(rs.randint(0, 5)))
+    return _base(int(inter_method))
 
 
 def _resize(img, w, h, resample):
@@ -127,11 +127,10 @@ class ImageRecordIter(DataIter):
                 "semantics. Supported args mirror "
                 "src/io/image_aug_default.cc; see the class docstring.")
         super().__init__(batch_size)
-        from ..recordio import IndexedRecordIO, unpack_img
+        from ..recordio import IndexedRecordIO
 
         self._rec = (IndexedRecordIO(path_imgidx, path_imgrec)
                      if path_imgidx else IndexedRecordIO(path_imgrec))
-        self._unpack = unpack_img
         self._shape = tuple(data_shape)          # (C, H, W)
         if len(self._shape) != 3:
             raise ValueError(f"data_shape must be (C,H,W), got {data_shape}")
@@ -143,6 +142,11 @@ class ImageRecordIter(DataIter):
         self._verbose = verbose
         if layout not in ("NCHW", "NHWC"):
             raise ValueError(f"layout must be NCHW or NHWC, got {layout}")
+        if min_aspect_ratio is not None and max_aspect_ratio <= 0:
+            raise ValueError(
+                "min_aspect_ratio requires max_aspect_ratio > 0 "
+                "(the sampled range is [min_aspect_ratio, "
+                "max_aspect_ratio])")
         # NHWC ships batches channels-last: skips the host-side transpose
         # and matches the TPU-native layout the flagship models train in
         # (data_shape stays (C,H,W) for reference-script compatibility)
@@ -294,7 +298,11 @@ class ImageRecordIter(DataIter):
                 raise
         else:
             raws, rng_seed = self._inline.pop(0)
-            data, labels = self._make_batch(raws, rng_seed)
+            try:
+                data, labels = self._make_batch(raws, rng_seed)
+            except Exception:
+                self._submit_one()   # keep the lookahead buffer full
+                raise
         self._submit_one()
         pad = self._last_pad if bi == len(self._batches) - 1 else 0
         return DataBatch([mnp.array(data)], [mnp.array(labels)], pad=pad,
@@ -349,14 +357,14 @@ class ImageRecordIter(DataIter):
 
         im = Image.open(_io.BytesIO(payload))
         target = self._aug["resize"]
-        if target <= 0:
-            target = max(self._shape[1], self._shape[2])
-        if im.format == "JPEG" and not (
+        if target > 0 and im.format == "JPEG" and not (
                 self._aug["rand_resized_crop"]
                 or self._aug["max_crop_size"] > 0):
             # draft never shrinks below the requested bounding size, so the
-            # exact shorter-edge resize downstream is unaffected; skip it
-            # for area-based crops whose statistics depend on full size
+            # exact shorter-edge resize to `resize` downstream is
+            # unaffected. Skipped when resize is unset (crops must come
+            # from the full-resolution image, as in the reference) and for
+            # area-based crops whose statistics depend on full size.
             im.draft(im.mode, (target, target))
         return header, _np.asarray(im)
 

@@ -17,7 +17,20 @@ from . import linalg  # noqa: F401
 from . import random  # noqa: F401
 from . import op_extended  # math tail, indexing, sequence, norms
 from .op_extended import *  # noqa: F401,F403
+from . import register as _register  # generated builders for the full
+#                                      registry (reference: symbol/register.py)
 
 __all__ = (["Symbol", "Variable", "Group", "Executor", "var", "load",
             "load_json", "fromjson", "zeros", "ones"]
            + op.__all__ + op_extended.__all__)
+
+
+def __getattr__(name):
+    """Resolve any registered op as mx.sym.<name> (curated wrappers above
+    take normal attribute priority; this fallback covers the rest of the
+    ~610-op registry, like the reference's generated namespace)."""
+    builder = _register.get_builder(name)
+    if builder is not None:
+        return builder
+    raise AttributeError(f"module 'mxnet_tpu.symbol' has no attribute "
+                         f"{name!r}")

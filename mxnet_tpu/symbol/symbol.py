@@ -22,6 +22,20 @@ def register_sym_op(name, fn):
     return fn
 
 
+def _op_fn(name):
+    """Op lowering by name; resyncs the generated adapters if the registry
+    grew since import (deserialized graphs may reference late-registered
+    ops)."""
+    if name not in _OP_TABLE:
+        from . import register as _register
+
+        _register._generate()
+    if name not in _OP_TABLE:
+        raise ValueError(f"unknown symbol op {name!r} (not in the op "
+                         "registry — stale or foreign graph json?)")
+    return _OP_TABLE[name]
+
+
 class Symbol:
     """A node in the lazy graph. Immutable; identity = python object."""
 
@@ -46,6 +60,12 @@ class Symbol:
 
     @staticmethod
     def create(op, *inputs, name=None, nout=1, **attrs):
+        if op not in _OP_TABLE:
+            # the registry grows as modules import (contrib, custom ops);
+            # resync the generated adapters before giving up
+            from . import register as _register
+
+            _register._generate()
         if op not in _OP_TABLE:
             raise ValueError(f"unknown symbol op {op!r}")
         inputs = [s if isinstance(s, Symbol) else _const(s) for s in inputs]
@@ -177,7 +197,7 @@ class Symbol:
                     vals[id(s)] = jnp.asarray(s._attrs["value"])
                 else:
                     ins = [vals[id(i)] for i in s._inputs]
-                    out = _OP_TABLE[s._op](ins, s._attrs)
+                    out = _op_fn(s._op)(ins, s._attrs)
                     if s._out_index is not None:
                         out = out[s._out_index]
                     vals[id(s)] = out
