@@ -330,23 +330,27 @@ class InstanceNorm(HybridBlock):
 class Embedding(HybridBlock):
     """Embedding lookup (reference: nn.Embedding).
 
-    Gradient w.r.t. weight is a dense scatter-add (the reference's
-    row_sparse grad option is deliberately dense on TPU)."""
+    sparse_grad=True: the tape's grad accumulation stays a dense XLA
+    scatter-add (the efficient TPU form), but the forward records the
+    touched row ids on the Parameter, so the Trainer hands the optimizer a
+    RowSparseNDArray and the lazy_update path touches ONLY those rows
+    (reference: nn.Embedding sparse_grad + optimizer/sgd.py:36-95)."""
 
     def __init__(self, input_dim, output_dim, dtype="float32",
                  weight_initializer=None, sparse_grad=False):
         super().__init__()
-        if sparse_grad:
-            import warnings
-
-            warnings.warn("sparse_grad is ignored on TPU (dense scatter)",
-                          stacklevel=2)
         self._input_dim = input_dim
         self._output_dim = output_dim
-        self.weight = Parameter("weight", shape=(input_dim, output_dim),
-                                dtype=dtype, init=weight_initializer)
+        self._sparse_grad = sparse_grad
+        self.weight = Parameter(
+            "weight", shape=(input_dim, output_dim), dtype=dtype,
+            init=weight_initializer,
+            grad_stype="row_sparse" if sparse_grad else "default")
 
     def forward(self, x):
+        if self._sparse_grad:
+            data = x._data if hasattr(x, "_data") else x
+            self.weight._record_sparse_rows(data)
         return npx.embedding(x, self.weight.data_for(x))
 
     def __repr__(self):

@@ -35,6 +35,16 @@ class Parameter:
                  dtype=_np.float32, lr_mult=1.0, wd_mult=1.0, init=None,
                  allow_deferred_init=False, differentiable=True,
                  stype="default", grad_stype="default"):  # noqa: ARG002
+        if grad_stype not in ("default", "row_sparse"):
+            raise ValueError(f"grad_stype must be 'default' or "
+                             f"'row_sparse', got {grad_stype!r}")
+        # row_sparse grads: the tape still accumulates densely (XLA
+        # scatter-add is the efficient TPU path), but the Trainer hands the
+        # optimizer a RowSparseNDArray sliced to the rows the forward
+        # touched (see _as_row_sparse_grad), so lazy_update semantics match
+        # the reference (optimizer/sgd.py:36-95) without a host sync.
+        self.grad_stype = grad_stype
+        self._sparse_row_hints = []   # index arrays recorded by Embedding
         self._name = name
         self._shape = tuple(shape) if shape is not None else None
         self.dtype = normalize_dtype(dtype)
@@ -245,6 +255,42 @@ class Parameter:
             for g in self._grad_map.values():
                 g._data = jnp.zeros_like(g._data)
                 g._version += 1
+        self._sparse_row_hints = []
+
+    def _record_sparse_rows(self, ids):
+        """Called by sparse_grad layers during forward with the (concrete)
+        row ids the lookup touched. Tracers are skipped — the hybridized
+        path falls back to a dense update."""
+        if self.grad_stype != "row_sparse" or self.grad_req == "null":
+            return
+        from .. import autograd as _ag
+
+        if not _ag.is_recording():
+            return   # eval/inference forwards must not skew the lazy rows
+        import jax.core as _core
+
+        if isinstance(ids, _core.Tracer):
+            return
+        self._sparse_row_hints.append(jnp.ravel(jnp.asarray(ids)))
+
+    def _as_row_sparse_grad(self, g):
+        """Dense grad buffer -> RowSparseNDArray over the rows touched
+        since the last update. Fully on-device: fixed-size jnp.unique pads
+        with the out-of-range index shape[0], which the optimizer's
+        scatter drops (reference: row_sparse grad of Embedding,
+        sparse.py:575). Returns the dense grad unchanged if no rows were
+        recorded (e.g. hybridized forward)."""
+        if not self._sparse_row_hints:
+            return g
+        from ..ndarray.sparse import RowSparseNDArray
+
+        ids = (self._sparse_row_hints[0] if len(self._sparse_row_hints) == 1
+               else jnp.concatenate(self._sparse_row_hints))
+        self._sparse_row_hints = []
+        n = g.shape[0]
+        k = min(int(ids.size), int(n))
+        uids = jnp.unique(ids.astype(jnp.int32), size=k, fill_value=n)
+        return RowSparseNDArray(g._data[uids], uids, g.shape)
 
     def reset_ctx(self, ctx=None, device=None):
         device = device if device is not None else ctx

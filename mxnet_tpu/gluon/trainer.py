@@ -124,8 +124,14 @@ class Trainer:
                 fresh = self._grad_versions.get(i) != g._version
                 if not ignore_stale_grad or fresh:
                     self._ensure_states(i, w)
+                    if getattr(p, "grad_stype", "default") == "row_sparse":
+                        # hand the optimizer only the touched rows
+                        # (lazy_update semantics; Parameter docs)
+                        g_upd = p._as_row_sparse_grad(g)
+                    else:
+                        g_upd = g
                     self._optimizer.update_multi_precision(
-                        i, w, g, self._states[i])
+                        i, w, g_upd, self._states[i])
                     self._grad_versions[i] = g._version
                 break  # update primary; replicate below
             if len(p.list_ctx()) > 1:

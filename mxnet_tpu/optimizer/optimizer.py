@@ -46,7 +46,13 @@ class Optimizer:
     def __init__(self, rescale_grad=1.0, param_idx2name=None, wd=0.0,
                  clip_gradient=None, learning_rate=None, lr_scheduler=None,
                  multi_precision=False, param_dict=None, aggregate_num=None,
-                 use_fused_step=True, **kwargs):  # noqa: ARG002
+                 use_fused_step=True, lazy_update=True,
+                 **kwargs):  # noqa: ARG002
+        # lazy_update (reference: optimizer/sgd.py:36-95): with a
+        # row_sparse gradient, update ONLY the rows present in the grad
+        # (weight decay / state decay on untouched rows is deferred).
+        # False densifies the grad and applies the rule to every row.
+        self.lazy_update = lazy_update
         self.rescale_grad = rescale_grad
         self.lr = learning_rate if learning_rate is not None else 0.01
         self.lr_scheduler = lr_scheduler
@@ -153,12 +159,83 @@ class Optimizer:
             Optimizer._jit_cache[key] = fn
         return fn
 
+    def _sparse_jitted(self):
+        """Row-sparse lazy update: gather the touched rows, run the SAME
+        rule, scatter the deltas back (reference: the row_sparse kernels
+        in src/operator/optimizer_op.cc). Out-of-range indices (the
+        fixed-size-unique padding) are clamped on gather and DROPPED on
+        scatter by XLA, so padded slots are no-ops; index arrays are
+        padded to power-of-two buckets to bound recompiles."""
+        cls = type(self)
+        key = (cls, self.clip_gradient, "row_sparse")
+        fn = Optimizer._jit_cache.get(key)
+        if fn is None:
+            clip = self.clip_gradient
+
+            def step(w, gvals, idx, state, lr, wd, hyper):
+                g = gvals * hyper["rescale_grad"]
+                if clip is not None:
+                    g = jnp.clip(g, -clip, clip)
+                w_rows = w[idx]
+                s_rows = jax.tree_util.tree_map(lambda s: s[idx], state)
+                nw_rows, ns_rows = cls._rule(w_rows, g, s_rows, lr, wd,
+                                             hyper)
+                new_w = w.at[idx].add((nw_rows - w_rows).astype(w.dtype))
+                new_state = jax.tree_util.tree_map(
+                    lambda s, ns: s.at[idx].add((ns - s[idx]).astype(
+                        s.dtype)), state, ns_rows)
+                return new_w, new_state
+
+            fn = jax.jit(step)
+            Optimizer._jit_cache[key] = fn
+        return fn
+
+    # rules whose update couples rows (layer-wise norms) cannot run on a
+    # gathered row subset — they densify instead of silently mis-scaling
+    _row_local = True
+
+    def _update_row_sparse(self, index, weight, grad, state):
+        from ..ndarray.sparse import RowSparseNDArray
+
+        assert isinstance(grad, RowSparseNDArray)
+        if not self.lazy_update or not type(self)._row_local:
+            self.update(index, weight, grad.todense(), state)
+            return
+        self._update_count(index)
+        lr = self._get_lr(index)
+        wd = self._get_wd(index)
+        hyper = dict(self._hyper())
+        hyper["rescale_grad"] = self.rescale_grad
+        hyper["t"] = self._index_update_count[index]
+        idx = grad.indices
+        vals = grad.data.astype(weight._data.dtype)
+        k = idx.shape[0]
+        bucket = 1 << max(0, int(k - 1).bit_length())
+        if bucket > k:   # pad with out-of-range rows (dropped on scatter)
+            pad = bucket - k
+            idx = jnp.concatenate(
+                [idx, jnp.full((pad,), weight.shape[0], idx.dtype)])
+            vals = jnp.concatenate(
+                [vals, jnp.zeros((pad,) + vals.shape[1:], vals.dtype)])
+        state_data = jax.tree_util.tree_map(
+            _unwrap, state, is_leaf=lambda x: isinstance(x, NDArray))
+        new_w, new_state = self._sparse_jitted()(
+            weight._data, vals, idx, state_data, lr, wd, hyper)
+        weight._data = new_w
+        weight._version += 1
+        _write_state(state, new_state)
+
     # -- public update ----------------------------------------------------
     def update(self, index, weight, grad, state):
         """Single-param update; index/weight/grad may be lists (fused loop)."""
         if isinstance(index, (list, tuple)):
             for i, w, g, s in zip(index, weight, grad, state):
                 self.update(i, w, g, s)
+            return
+        from ..ndarray.sparse import RowSparseNDArray
+
+        if isinstance(grad, RowSparseNDArray):
+            self._update_row_sparse(index, weight, grad, state)
             return
         self._update_count(index)
         lr = self._get_lr(index)
@@ -190,7 +267,13 @@ class Optimizer:
             self.update(index, weight, grad, state)
             return
         master, inner = state
-        grad32 = _wrap_out(grad._data.astype(jnp.float32))
+        from ..ndarray.sparse import RowSparseNDArray
+
+        if isinstance(grad, RowSparseNDArray):
+            grad32 = RowSparseNDArray(grad.data.astype(jnp.float32),
+                                      grad.indices, grad.shape)
+        else:
+            grad32 = _wrap_out(grad._data.astype(jnp.float32))
         self.update(index, master, grad32, inner)
         weight._data = master._data.astype(weight._data.dtype)
         weight._version += 1
@@ -224,9 +307,10 @@ def _zeros_like(weight, dtype=None):
 class SGD(Optimizer):
     """SGD with momentum (reference: optimizer/sgd.py; op sgd_mom_update)."""
 
-    def __init__(self, learning_rate=0.01, momentum=0.0, lazy_update=False,
-                 **kwargs):  # noqa: ARG002 - lazy_update is a sparse-only knob
-        super().__init__(learning_rate=learning_rate, **kwargs)
+    def __init__(self, learning_rate=0.01, momentum=0.0, lazy_update=True,
+                 **kwargs):
+        super().__init__(learning_rate=learning_rate,
+                         lazy_update=lazy_update, **kwargs)
         self.momentum = momentum
 
     def create_state(self, index, weight):
@@ -295,7 +379,10 @@ class SGLD(Optimizer):
 
     def update(self, index, weight, grad, state):
         from .. import _random
+        from ..ndarray.sparse import RowSparseNDArray
 
+        if isinstance(grad, RowSparseNDArray):
+            grad = grad.todense()   # Langevin noise hits every row anyway
         self._update_count(index)
         lr = self._get_lr(index)
         wd = self._get_wd(index)
@@ -569,6 +656,7 @@ class Ftrl(Optimizer):
 
 @register
 class LAMB(Optimizer):
+    _row_local = False  # layer-wise trust ratio needs the full tensor
     """Layer-wise adaptive moments for batch training (reference: lamb.py)."""
 
     def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
@@ -640,6 +728,7 @@ class LANS(LAMB):
 
 @register
 class LARS(Optimizer):
+    _row_local = False  # layer-wise norms need the full tensor
     """Layer-wise adaptive rate scaling (reference: optimizer/lars.py)."""
 
     def __init__(self, learning_rate=0.1, momentum=0.9, eta=0.001,
