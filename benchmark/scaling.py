@@ -138,11 +138,19 @@ def main():
     moe_flops_n = float(cmn.cost_analysis()["flops"])
     moe_eff = (moe_flops1 / N_DEV) / max(moe_flops_n, 1.0)
 
-    # ---- pp: GPipe bubble efficiency (analytic M/(M+S-1) x measured
-    # per-stage partition) --------------------------------------------
+    # ---- pp: schedule efficiency (analytic bound of the implemented
+    # schedule x measured per-stage partition). Interleaved virtual
+    # stages (pipeline_apply num_virtual=v) shrink the fill/drain bubble
+    # to (S-1)/v: efficiency M*v/(M*v + S - 1). v=1 reproduces the old
+    # GPipe bound 0.8205 at S=8, M=32. The 1F1B step
+    # (pipeline_step_1f1b) shares the v=1 bubble but holds O(S)
+    # activations instead of O(M) — verified numerically in
+    # tests/test_pipeline_1f1b.py.
     S = N_DEV
     M = 4 * S
-    bubble_eff = M / (M + S - 1)
+    V_CHUNKS = 4
+    bubble_eff = (M * V_CHUNKS) / (M * V_CHUNKS + S - 1)
+    bubble_eff_v1 = M / (M + S - 1)
 
     result["rows"] = [
         {"metric": f"moe_ep{N_DEV}_partition_efficiency",
@@ -153,10 +161,14 @@ def main():
                  "dispatch einsums replicate, expert matmuls shard"},
         {"metric": f"pipeline_pp{S}_m{M}_schedule_efficiency",
          "value": round(bubble_eff, 4), "unit": "ratio",
-         "note": "GPipe fill-drain bound M/(M+S-1) for the "
-                 "parallel/pipeline.py schedule; per-stage compute "
-                 "partitions exactly 1/S by construction "
-                 "(stage dim sharded over pp)"},
+         "v_chunks": V_CHUNKS,
+         "gpipe_v1_bound": round(bubble_eff_v1, 4),
+         "note": "interleaved-virtual-stage bound M*v/(M*v+S-1) for the "
+                 "parallel/pipeline.py schedule (v=4 chunks/device; "
+                 "numerics vs sequential oracle in "
+                 "tests/test_pipeline_1f1b.py); per-stage compute "
+                 "partitions exactly 1/S by construction. 1F1B training "
+                 "step holds O(S) activations vs GPipe's O(M)"},
     ]
     print(json.dumps(result))
     out = pathlib.Path(__file__).resolve().parent.parent / "SCALING.json"

@@ -180,10 +180,20 @@ class Optimizer:
                 s_rows = jax.tree_util.tree_map(lambda s: s[idx], state)
                 nw_rows, ns_rows = cls._rule(w_rows, g, s_rows, lr, wd,
                                              hyper)
-                new_w = w.at[idx].add((nw_rows - w_rows).astype(w.dtype))
+                # rows whose grad is exactly zero are no-ops: a stale
+                # forward-recorded hint (e.g. a recorded probe forward
+                # that was never backpropagated) must not decay rows the
+                # backward never touched
+                live = jnp.any(g != 0, axis=tuple(range(1, g.ndim)))
+                mrow = live.reshape((-1,) + (1,) * (w_rows.ndim - 1))
+                new_w = w.at[idx].add(
+                    jnp.where(mrow, nw_rows - w_rows, 0).astype(w.dtype))
                 new_state = jax.tree_util.tree_map(
-                    lambda s, ns: s.at[idx].add((ns - s[idx]).astype(
-                        s.dtype)), state, ns_rows)
+                    lambda s, ns: s.at[idx].add(
+                        jnp.where(live.reshape(
+                            (-1,) + (1,) * (s[idx].ndim - 1)),
+                            ns - s[idx], 0).astype(s.dtype)),
+                    state, ns_rows)
                 return new_w, new_state
 
             fn = jax.jit(step)

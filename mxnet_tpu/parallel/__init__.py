@@ -21,5 +21,6 @@ from .ring_attention import (  # noqa: F401
     ring_flash_attention_sharded,
 )
 from .moe import moe_ffn_sharded  # noqa: F401
-from .pipeline import pipeline_apply_sharded  # noqa: F401
+from .pipeline import (interleave_stages, pipeline_apply_sharded,  # noqa: F401
+                       pipeline_step_1f1b_sharded)
 from .ulysses import ulysses_attention_sharded  # noqa: F401

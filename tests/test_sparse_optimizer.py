@@ -185,6 +185,26 @@ def test_non_row_local_and_custom_update_optimizers_densify():
         assert not onp.allclose(w.asnumpy(), before)
 
 
+def test_stale_hint_rows_with_zero_grad_are_inert():
+    """A recorded probe forward that is never backpropagated leaves row
+    hints with exactly-zero grads — those rows must not decay or bump
+    optimizer state."""
+    net = gluon.nn.Embedding(12, 4, sparse_grad=True)
+    net.initialize()
+    with record():
+        net(mx.np.array(onp.array([10, 11], "i")))   # probe, discarded
+    with record():
+        loss = (net(mx.np.array(onp.array([2], "i"))) ** 2).sum()
+    loss.backward()
+    tr = gluon.Trainer(net.collect_params(), "sgd",
+                       {"learning_rate": 0.1, "wd": 0.9, "momentum": 0.9})
+    w0 = net.weight.data().asnumpy().copy()
+    tr.step(1)
+    w1 = net.weight.data().asnumpy()
+    onp.testing.assert_allclose(w1[[10, 11]], w0[[10, 11]])
+    assert not onp.allclose(w1[2], w0[2])
+
+
 def test_multi_precision_sparse():
     o = opt.create("sgd", learning_rate=0.1, momentum=0.9,
                    multi_precision=True)
