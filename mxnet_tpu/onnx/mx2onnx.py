@@ -13,7 +13,7 @@ from __future__ import annotations
 import jax
 import numpy as _np
 
-from ..symbol.symbol import _OP_TABLE, Symbol
+from ..symbol.symbol import _OP_TABLE, Symbol, _op_fn
 from . import _proto as P
 
 __all__ = ["export_model"]
@@ -399,8 +399,13 @@ def _pooling(ctx, s, ins, outs, shapes):  # noqa: ARG001
     stride = list(s.attr("stride") or kernel)
     pad = list(s.attr("pad") or (0,) * nd)
     op = "MaxPool" if ptype == "max" else "AveragePool"
-    ctx.add_node(op, ins, outs, s.name, {
-        "kernel_shape": kernel, "strides": stride, "pads": pad + pad})
+    attrs = {"kernel_shape": kernel, "strides": stride, "pads": pad + pad}
+    if ptype != "max":
+        # ops/nn.py:167 pooling defaults count_include_pad=True; honor an
+        # explicit False from the symbol attrs
+        cip = s.attr("count_include_pad")
+        attrs["count_include_pad"] = 0 if cip in (False, 0, "False") else 1
+    ctx.add_node(op, ins, outs, s.name, attrs)
 
 
 @_conv("BatchNorm")
@@ -902,12 +907,251 @@ def _infer_all_shapes(order, input_structs):
         elif s._op == "_group":
             continue
         else:
-            ins = [shapes[id(i)] for i in s._inputs]
-            fn = _OP_TABLE[s._op]
+            # slice multi-output producers at the consumer edge (the
+            # stored struct stays the full tuple so graph outputs and
+            # dtype_of can pick any slot)
+            ins = []
+            for i in s._inputs:
+                st = shapes[id(i)]
+                if isinstance(st, (tuple, list)) and \
+                        i._out_index is not None:
+                    st = st[i._out_index]
+                ins.append(st)
+            fn = _op_fn(s._op)
             out = jax.eval_shape(lambda *xs, _fn=fn, _a=s._attrs: _fn(
                 list(xs), _a), *ins)
             shapes[id(s)] = out
     return shapes
+
+
+# --- quantized op family -> ONNX QDQ form ---------------------------------
+# The reference exported its INT8 graphs as QDQ (QuantizeLinear /
+# DequantizeLinear pairs around float ops — the form onnxruntime fuses back
+# into int8 kernels). Our quantized ops are deq -> float op -> requantize
+# with symmetric int8 scaling (contrib/quantization.py), which maps exactly.
+
+_INT8_MAX = 127.0
+
+
+def _qdq_scale(ctx, base, lo, hi, denom=_INT8_MAX):
+    """Emit scale = max(|lo|, |hi|, 1e-20) / denom; returns (scale, amax).
+
+    Ranges that are exported parameter initializers constant-fold into
+    scale initializers — onnxruntime's QDQ fusion requires constant Q/DQ
+    scales to rebuild int8 kernels, and the runtime subgraph would defeat
+    the point of the QDQ form."""
+    pv = getattr(ctx, "param_values", {})
+    if lo in pv and hi in pv:
+        amax_v = max(abs(float(pv[lo])), abs(float(pv[hi])), 1e-20)
+        sc = ctx.add_init(ctx.fresh(base + "_scale"),
+                          _np.asarray(amax_v / denom, _np.float32))
+        amax = ctx.add_init(ctx.fresh(base + "_amax"),
+                            _np.asarray(amax_v, _np.float32))
+        return sc, amax
+    alo = ctx.fresh(base + "_alo")
+    ctx.add_node("Abs", [lo], [alo])
+    ahi = ctx.fresh(base + "_ahi")
+    ctx.add_node("Abs", [hi], [ahi])
+    raw = ctx.fresh(base + "_raw")
+    ctx.add_node("Max", [alo, ahi], [raw])
+    eps = ctx.add_init(ctx.fresh(base + "_eps"),
+                       _np.asarray(1e-20, _np.float32))
+    amax = ctx.fresh(base + "_amax")
+    ctx.add_node("Max", [raw, eps], [amax])  # all-zero tensor: scale!=0
+    den = ctx.add_init(ctx.fresh(base + "_den"),
+                       _np.asarray(denom, _np.float32))
+    sc = ctx.fresh(base + "_scale")
+    ctx.add_node("Div", [amax, den], [sc])
+    return sc, amax
+
+
+def _qdq_zp(ctx, base, dtype=_np.int8):
+    return ctx.add_init(ctx.fresh(base + "_zp"), _np.zeros((), dtype))
+
+
+def _emit_deq(ctx, base, q, lo, hi, denom=_INT8_MAX):
+    sc, _ = _qdq_scale(ctx, base, lo, hi, denom)
+    out = ctx.fresh(base + "_deq")
+    ctx.add_node("DequantizeLinear", [q, sc, _qdq_zp(ctx, base)], [out])
+    return out
+
+
+def _emit_req(ctx, base, y, outs):
+    """Dynamic requantize: lo/hi measured from y (quantization._req)."""
+    lo = ctx.fresh(base + "_lo")
+    ctx.add_node("ReduceMin", [y], [lo], attrs={"keepdims": 0})
+    hi = ctx.fresh(base + "_hi")
+    ctx.add_node("ReduceMax", [y], [hi], attrs={"keepdims": 0})
+    sc, amax = _qdq_scale(ctx, base, lo, hi)
+    ctx.add_node("QuantizeLinear", [y, sc, _qdq_zp(ctx, base)], [outs[0]])
+    ctx.add_node("Neg", [amax], [outs[1]])
+    ctx.add_node("Identity", [amax], [outs[2]])
+
+
+@_conv("_contrib_quantize_v2")
+def _c_quantize_v2(ctx, s, ins, outs, shapes):  # noqa: ARG001
+    lo = s.attr("min_calib_range")
+    if lo is not None:
+        hi = s.attr("max_calib_range")
+        amax = max(abs(float(lo)), abs(float(hi)))
+        sc = ctx.add_init(ctx.fresh(s.name + "_scale"),
+                          _np.asarray(amax / _INT8_MAX, _np.float32))
+        ctx.add_node("QuantizeLinear", [ins[0], sc, _qdq_zp(ctx, s.name)],
+                     [outs[0]], s.name)
+        for o, v in ((outs[1], -amax), (outs[2], amax)):
+            c = ctx.add_init(ctx.fresh(s.name + "_r"),
+                             _np.asarray(v, _np.float32))
+            ctx.add_node("Identity", [c], [o])
+        return
+    _emit_req(ctx, s.name, ins[0], outs)
+
+
+@_conv("_contrib_quantize")
+def _c_quantize(ctx, s, ins, outs, shapes):  # noqa: ARG001
+    # quantize with the CALLER-SUPPLIED range (quantize.cc), unlike
+    # quantize_v2's dynamic/calibrated forms
+    sc, amax = _qdq_scale(ctx, s.name, ins[1], ins[2])
+    ctx.add_node("QuantizeLinear", [ins[0], sc, _qdq_zp(ctx, s.name)],
+                 [outs[0]], s.name)
+    ctx.add_node("Neg", [amax], [outs[1]])
+    ctx.add_node("Identity", [amax], [outs[2]])
+
+
+@_conv("_contrib_dequantize")
+def _c_dequantize(ctx, s, ins, outs, shapes):  # noqa: ARG001
+    sc, _ = _qdq_scale(ctx, s.name, ins[1], ins[2])
+    ctx.add_node("DequantizeLinear", [ins[0], sc, _qdq_zp(ctx, s.name)],
+                 outs[:1], s.name)
+
+
+@_conv("_contrib_requantize")
+def _c_requantize(ctx, s, ins, outs, shapes):  # noqa: ARG001
+    # int32 accumulator input scaled against 2^31-1
+    f = _emit_deq(ctx, s.name + "_in", ins[0], ins[1], ins[2],
+                  denom=2.0 ** 31 - 1)
+    lo = s.attr("min_calib_range")
+    if lo is not None:
+        # calibrated: fixed scale, out-of-range values saturate at +-127
+        # (quantization.py requantize calib branch)
+        hi = s.attr("max_calib_range")
+        amax = max(abs(float(lo)), abs(float(hi)), 1e-20)
+        sc = ctx.add_init(ctx.fresh(s.name + "_scale"),
+                          _np.asarray(amax / _INT8_MAX, _np.float32))
+        ctx.add_node("QuantizeLinear", [f, sc, _qdq_zp(ctx, s.name)],
+                     [outs[0]], s.name)
+        for o, v in ((outs[1], -amax), (outs[2], amax)):
+            c = ctx.add_init(ctx.fresh(s.name + "_r"),
+                             _np.asarray(v, _np.float32))
+            ctx.add_node("Identity", [c], [o])
+        return
+    _emit_req(ctx, s.name, f, outs)
+
+
+@_conv("_contrib_quantized_conv")
+def _c_quantized_conv(ctx, s, ins, outs, shapes):  # noqa: ARG001
+    no_bias = s.attr("no_bias") in (True, 1, "True", "1")
+    i = 2 if no_bias else 3
+    data = _emit_deq(ctx, s.name + "_d", ins[0], ins[i], ins[i + 1])
+    weight = _emit_deq(ctx, s.name + "_w", ins[1], ins[i + 2], ins[i + 3])
+    conv_ins = [data, weight]
+    if not no_bias:
+        conv_ins.append(_emit_deq(ctx, s.name + "_b", ins[2], ins[i + 4],
+                                  ins[i + 5]))
+    kernel = list(s.attr("kernel"))
+    nd = len(kernel)
+    pad = list(s.attr("pad") or (0,) * nd)
+    y = ctx.fresh(s.name + "_f")
+    ctx.add_node("Conv", conv_ins, [y], s.name, {
+        "kernel_shape": kernel,
+        "strides": list(s.attr("stride") or (1,) * nd),
+        "pads": pad + pad,
+        "dilations": list(s.attr("dilate") or (1,) * nd),
+        "group": int(s.attr("num_group") or 1)})
+    _emit_req(ctx, s.name, y, outs)
+
+
+@_conv("_contrib_quantized_fully_connected")
+def _c_quantized_fc(ctx, s, ins, outs, shapes):
+    no_bias = s.attr("no_bias") in (True, 1, "True", "1")
+    i = 2 if no_bias else 3
+    data = _emit_deq(ctx, s.name + "_d", ins[0], ins[i], ins[i + 1])
+    weight = _emit_deq(ctx, s.name + "_w", ins[1], ins[i + 2], ins[i + 3])
+    if len(shapes[0]) > 2:   # flatten=True default
+        flat = ctx.fresh(s.name + "_flat")
+        ctx.add_node("Flatten", [data], [flat], attrs={"axis": 1})
+        data = flat
+    y = ctx.fresh(s.name + "_f")
+    gemm_ins = [data, weight]
+    if not no_bias:
+        gemm_ins.append(_emit_deq(ctx, s.name + "_b", ins[2], ins[i + 4],
+                                  ins[i + 5]))
+    ctx.add_node("Gemm", gemm_ins, [y], s.name, {"transB": 1})
+    _emit_req(ctx, s.name, y, outs)
+
+
+@_conv("_contrib_quantized_pooling")
+def _c_quantized_pool(ctx, s, ins, outs, shapes):  # noqa: ARG001
+    data = _emit_deq(ctx, s.name + "_d", ins[0], ins[1], ins[2])
+    ptype = s.attr("pool_type") or "max"
+    y = ctx.fresh(s.name + "_f")
+    if s.attr("global_pool"):
+        ctx.add_node("GlobalMaxPool" if ptype == "max"
+                     else "GlobalAveragePool", [data], [y], s.name)
+    else:
+        kernel = list(s.attr("kernel") or (2, 2))
+        nd = len(kernel)
+        pad = list(s.attr("pad") or (0,) * nd)
+        attrs = {"kernel_shape": kernel,
+                 "strides": list(s.attr("stride") or kernel),
+                 "pads": pad + pad}
+        if ptype != "max":
+            # ops/nn.py pooling averages WITH padded zeros in the count
+            attrs["count_include_pad"] = 1
+        ctx.add_node("MaxPool" if ptype == "max" else "AveragePool",
+                     [data], [y], s.name, attrs)
+    _emit_req(ctx, s.name, y, outs)
+
+
+@_conv("_contrib_quantized_act")
+def _c_quantized_act(ctx, s, ins, outs, shapes):  # noqa: ARG001
+    data = _emit_deq(ctx, s.name + "_d", ins[0], ins[1], ins[2])
+    table = {"relu": "Relu", "sigmoid": "Sigmoid", "tanh": "Tanh",
+             "softrelu": "Softplus"}
+    act = s.attr("act_type") or "relu"
+    if act not in table:
+        raise NotImplementedError(
+            f"quantized_act act_type={act!r} not exportable "
+            f"(supported: {sorted(table)})")
+    y = ctx.fresh(s.name + "_f")
+    ctx.add_node(table[act], [data], [y], s.name)
+    _emit_req(ctx, s.name, y, outs)
+
+
+@_conv("_contrib_quantized_flatten")
+def _c_quantized_flatten(ctx, s, ins, outs, shapes):  # noqa: ARG001
+    # int8 codes and ranges pass through unchanged (quantized_flatten.cc)
+    ctx.add_node("Flatten", [ins[0]], outs[:1], s.name, {"axis": 1})
+    ctx.add_node("Identity", [ins[1]], [outs[1]])
+    ctx.add_node("Identity", [ins[2]], [outs[2]])
+
+
+@_conv("_contrib_quantized_elemwise_add")
+def _c_quantized_eadd(ctx, s, ins, outs, shapes):  # noqa: ARG001
+    a = _emit_deq(ctx, s.name + "_a", ins[0], ins[2], ins[3])
+    b = _emit_deq(ctx, s.name + "_b", ins[1], ins[4], ins[5])
+    y = ctx.fresh(s.name + "_f")
+    ctx.add_node("Add", [a, b], [y], s.name)
+    _emit_req(ctx, s.name, y, outs)
+
+
+@_conv("_contrib_quantized_batch_norm")
+def _c_quantized_bn(ctx, s, ins, outs, shapes):  # noqa: ARG001
+    data = _emit_deq(ctx, s.name + "_d", ins[0], ins[5], ins[6])
+    y = ctx.fresh(s.name + "_f")
+    ctx.add_node("BatchNormalization",
+                 [data, ins[1], ins[2], ins[3], ins[4]], [y], s.name,
+                 {"epsilon": float(s.attr("eps") or 1e-3)})
+    _emit_req(ctx, s.name, y, outs)
 
 
 def export_model(sym, params, in_shapes=None, in_types=_np.float32,
@@ -955,6 +1199,8 @@ def export_model(sym, params, in_shapes=None, in_types=_np.float32,
 
     ctx = _Ctx()
     ctx.structs = shapes
+    # scalar params (quantization ranges) fold into constant QDQ scales
+    ctx.param_values = {n: a for n, a in np_params.items() if a.ndim == 0}
     tensor_names = {}  # id(sym-node) -> list of output tensor names
     converted = {}     # node name -> output tensor names (dedups the
     #                    out_index clones _flat_outputs creates)

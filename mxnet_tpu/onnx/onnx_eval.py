@@ -1,0 +1,451 @@
+"""Minimal ONNX graph evaluator over the dependency-free wire decoder.
+
+The image ships neither `onnx` nor `onnxruntime`, so the numeric
+round-trip verification the reference ran through onnxruntime
+(reference: tests/python-pytest/onnx/test_operators.py) runs here against
+this evaluator instead: export -> parse_model -> evaluate(jnp) -> compare
+with the original symbol's outputs. Covers exactly the op set
+mx2onnx.py emits (opset 11 semantics); unknown ops raise.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as _np
+from jax import lax
+
+from . import _proto as P
+
+__all__ = ["evaluate", "run_model"]
+
+
+def _pool(x, kernel, strides, pads, kind, count_include_pad=False):
+    nd = len(kernel)
+    window = (1, 1) + tuple(kernel)
+    strides_ = (1, 1) + tuple(strides)
+    # ONNX pads: [b1..bn, e1..en]
+    pad_cfg = [(0, 0), (0, 0)] + [(int(pads[i]), int(pads[i + nd]))
+                                  for i in range(nd)]
+    if kind == "max":
+        init = -jnp.inf
+        out = lax.reduce_window(x, init, lax.max, window, strides_, pad_cfg)
+        return out
+    s = lax.reduce_window(x, 0.0, lax.add, window, strides_, pad_cfg)
+    if count_include_pad:
+        return s / _np.prod(kernel)
+    ones = jnp.ones_like(x)
+    cnt = lax.reduce_window(ones, 0.0, lax.add, window, strides_, pad_cfg)
+    return s / cnt
+
+
+def _conv(x, w, b, attrs):
+    group = int(attrs.get("group", 1))
+    nd = w.ndim - 2
+    strides = tuple(attrs.get("strides", [1] * nd))
+    dil = tuple(attrs.get("dilations", [1] * nd))
+    pads = attrs.get("pads", [0] * (2 * nd))
+    pad_cfg = [(int(pads[i]), int(pads[i + nd])) for i in range(nd)]
+    dn = lax.conv_dimension_numbers(x.shape, w.shape,
+                                    ("NCHW", "OIHW", "NCHW") if nd == 2
+                                    else ("NCH", "OIH", "NCH"))
+    out = lax.conv_general_dilated(x, w, strides, pad_cfg,
+                                   rhs_dilation=dil, dimension_numbers=dn,
+                                   feature_group_count=group)
+    if b is not None:
+        out = out + b.reshape((1, -1) + (1,) * nd)
+    return out
+
+
+def _conv_transpose(x, w, b, attrs):
+    # ONNX ConvTranspose weight layout: (Cin, Cout/group, kH, kW)
+    group = int(attrs.get("group", 1))
+    nd = w.ndim - 2
+    strides = tuple(attrs.get("strides", [1] * nd))
+    pads = attrs.get("pads", [0] * (2 * nd))
+    if group != 1:
+        raise NotImplementedError("grouped ConvTranspose")
+    # equivalent direct form: dilate the input by stride, convolve with the
+    # spatially-flipped kernel transposed to OIHW, pad by k-1-p
+    wt = jnp.swapaxes(w, 0, 1)            # (Cout, Cin, ...)
+    wt = jnp.flip(wt, axis=tuple(range(2, 2 + nd)))
+    k = w.shape[2:]
+    pad_cfg = [(k[i] - 1 - int(pads[i]), k[i] - 1 - int(pads[i + nd]))
+               for i in range(nd)]
+    dn = lax.conv_dimension_numbers(x.shape, wt.shape,
+                                    ("NCHW", "OIHW", "NCHW") if nd == 2
+                                    else ("NCH", "OIH", "NCH"))
+    out = lax.conv_general_dilated(x, wt, (1,) * nd, pad_cfg,
+                                   lhs_dilation=strides,
+                                   dimension_numbers=dn)
+    if b is not None:
+        out = out + b.reshape((1, -1) + (1,) * nd)
+    return out
+
+
+def _softmax_block(x, axis):
+    """Opset-11 semantics: flatten [axis:] and softmax over the block."""
+    axis = axis % x.ndim
+    shp = x.shape
+    flat = x.reshape(shp[:axis] + (-1,))
+    out = jax.nn.softmax(flat, axis=-1)
+    return out.reshape(shp)
+
+
+def _lrn(x, attrs):
+    size = int(attrs["size"])
+    alpha = float(attrs.get("alpha", 1e-4))
+    beta = float(attrs.get("beta", 0.75))
+    bias = float(attrs.get("bias", 1.0))
+    half = (size - 1) // 2
+    sq = x * x
+    pad = [(0, 0), (half, size - 1 - half)] + [(0, 0)] * (x.ndim - 2)
+    acc = jnp.pad(sq, pad)
+    window = sum(acc[:, i:i + x.shape[1]] for i in range(size))
+    return x / (bias + alpha / size * window) ** beta
+
+
+def _topk(x, k, attrs):
+    axis = int(attrs.get("axis", -1))
+    largest = int(attrs.get("largest", 1))
+    k = int(k)
+    if largest:
+        idx = jnp.argsort(-x, axis=axis)
+    else:
+        idx = jnp.argsort(x, axis=axis)
+    idx = lax.slice_in_dim(idx, 0, k, axis=axis % x.ndim)
+    vals = jnp.take_along_axis(x, idx, axis=axis)
+    return vals, idx.astype(jnp.int64)
+
+
+def _slice_op(data, starts, ends, axes=None, steps=None):
+    starts = _np.asarray(starts).tolist()
+    ends = _np.asarray(ends).tolist()
+    axes = (_np.asarray(axes).tolist() if axes is not None
+            else list(range(len(starts))))
+    steps = (_np.asarray(steps).tolist() if steps is not None
+             else [1] * len(starts))
+    sl = [slice(None)] * data.ndim
+    for st, en, ax, sp in zip(starts, ends, axes, steps):
+        n = data.shape[ax]
+        if sp < 0:
+            st = min(st, n - 1) if st >= 0 else st + n
+            en = None if en <= -(2 ** 31) + n else (en if en >= 0
+                                                   else en + n)
+            sl[ax] = slice(st, en, sp)
+        else:
+            sl[ax] = slice(st, min(en, n) if en >= 0 else en, sp)
+    return data[tuple(sl)]
+
+
+def _reshape(data, shape):
+    shape = [int(v) for v in _np.asarray(shape).tolist()]
+    out = []
+    for i, d in enumerate(shape):
+        out.append(data.shape[i] if d == 0 else d)
+    return data.reshape(out)
+
+
+def _gemm(a, b, c, attrs):
+    alpha = float(attrs.get("alpha", 1.0))
+    beta = float(attrs.get("beta", 1.0))
+    if int(attrs.get("transA", 0)):
+        a = a.T
+    if int(attrs.get("transB", 0)):
+        b = b.T
+    y = alpha * (a @ b)
+    if c is not None:
+        y = y + beta * c
+    return y
+
+
+def _onehot(indices, depth, values, attrs):
+    axis = int(attrs.get("axis", -1))
+    depth = int(_np.asarray(depth).reshape(()))
+    off, on = _np.asarray(values).tolist()
+    oh = jax.nn.one_hot(jnp.asarray(indices).astype(jnp.int32), depth,
+                        axis=axis)
+    return oh * (on - off) + off
+
+
+def _pad_op(data, attrs, pads=None, value=None):
+    pads = attrs.get("pads", pads)
+    pads = _np.asarray(pads).tolist()
+    nd = data.ndim
+    cfg = [(int(pads[i]), int(pads[i + nd])) for i in range(nd)]
+    mode = attrs.get("mode", "constant")
+    if isinstance(mode, bytes):
+        mode = mode.decode()
+    cval = float(attrs.get("value", 0.0) if value is None
+                 else _np.asarray(value).reshape(()))
+    if mode == "constant":
+        return jnp.pad(data, cfg, constant_values=cval)
+    return jnp.pad(data, cfg, mode={"reflect": "reflect",
+                                    "edge": "edge"}[mode])
+
+
+def _depth_space(x, attrs, to_depth):
+    bs = int(attrs["blocksize"])
+    n, c, h, w = x.shape
+    if to_depth:
+        x = x.reshape(n, c, h // bs, bs, w // bs, bs)
+        x = x.transpose(0, 3, 5, 1, 2, 4)
+        return x.reshape(n, c * bs * bs, h // bs, w // bs)
+    x = x.reshape(n, bs, bs, c // (bs * bs), h, w)
+    x = x.transpose(0, 3, 4, 1, 5, 2)
+    return x.reshape(n, c // (bs * bs), h * bs, w * bs)
+
+
+def _axes(attrs, default=None):
+    ax = attrs.get("axes", default)
+    if ax is None:
+        return None
+    return tuple(int(a) for a in (ax if isinstance(ax, (list, tuple))
+                                  else [ax]))
+
+
+def _reduce(fn):
+    def run(x, attrs):
+        ax = _axes(attrs)
+        keep = bool(attrs.get("keepdims", 1))
+        return fn(x, axis=ax, keepdims=keep)
+
+    return run
+
+
+_ELEM = {
+    "Add": jnp.add, "Sub": jnp.subtract, "Mul": jnp.multiply,
+    "Div": jnp.divide, "Pow": jnp.power, "Max": jnp.maximum,
+    "Min": jnp.minimum, "And": jnp.logical_and, "Or": jnp.logical_or,
+    "Xor": jnp.logical_xor,
+}
+_UNARY = {
+    "Neg": jnp.negative, "Exp": jnp.exp, "Log": jnp.log, "Sqrt": jnp.sqrt,
+    "Tanh": jnp.tanh, "Abs": jnp.abs, "Sigmoid": jax.nn.sigmoid,
+    "Relu": jax.nn.relu, "Erf": jax.scipy.special.erf, "Floor": jnp.floor,
+    "Reciprocal": lambda x: 1.0 / x, "Not": jnp.logical_not,
+    "Identity": lambda x: x, "Softplus": jax.nn.softplus,
+}
+_REDUCE = {
+    "ReduceSum": _reduce(jnp.sum), "ReduceMean": _reduce(jnp.mean),
+    "ReduceMax": _reduce(jnp.max), "ReduceMin": _reduce(jnp.min),
+    "ReduceProd": _reduce(jnp.prod),
+    "ReduceLogSumExp": _reduce(
+        lambda x, axis, keepdims: jax.scipy.special.logsumexp(
+            x, axis=axis, keepdims=keepdims)),
+}
+
+
+def _eval_node(op, ins, attrs):
+    """ins: list of jnp arrays (None for absent optional inputs).
+    Returns a tuple of outputs."""
+    a = attrs
+    if op in _ELEM:
+        return (_ELEM[op](ins[0], ins[1]),)
+    if op in _UNARY:
+        return (_UNARY[op](ins[0]),)
+    if op in _REDUCE:
+        return (_REDUCE[op](ins[0], a),)
+    if op == "MatMul":
+        return (jnp.matmul(ins[0], ins[1]),)
+    if op == "Gemm":
+        return (_gemm(ins[0], ins[1], ins[2] if len(ins) > 2 else None, a),)
+    if op == "Conv":
+        return (_conv(ins[0], ins[1],
+                      ins[2] if len(ins) > 2 else None, a),)
+    if op == "ConvTranspose":
+        return (_conv_transpose(ins[0], ins[1],
+                                ins[2] if len(ins) > 2 else None, a),)
+    if op in ("MaxPool", "AveragePool"):
+        kernel = a["kernel_shape"]
+        nd = len(kernel)
+        return (_pool(ins[0], kernel, a.get("strides", [1] * nd),
+                      a.get("pads", [0] * 2 * nd),
+                      "max" if op == "MaxPool" else "avg",
+                      bool(a.get("count_include_pad", 0))),)
+    if op == "GlobalAveragePool":
+        return (jnp.mean(ins[0], axis=tuple(range(2, ins[0].ndim)),
+                         keepdims=True),)
+    if op == "GlobalMaxPool":
+        return (jnp.max(ins[0], axis=tuple(range(2, ins[0].ndim)),
+                        keepdims=True),)
+    if op == "BatchNormalization":
+        x, scale, b, mean, var = ins[:5]
+        eps = float(a.get("epsilon", 1e-5))
+        shape = (1, -1) + (1,) * (x.ndim - 2)
+        return ((x - mean.reshape(shape))
+                / jnp.sqrt(var.reshape(shape) + eps)
+                * scale.reshape(shape) + b.reshape(shape),)
+    if op == "InstanceNormalization":
+        x, scale, b = ins
+        eps = float(a.get("epsilon", 1e-5))
+        ax = tuple(range(2, x.ndim))
+        mu = x.mean(ax, keepdims=True)
+        var = x.var(ax, keepdims=True)
+        shape = (1, -1) + (1,) * (x.ndim - 2)
+        return ((x - mu) / jnp.sqrt(var + eps) * scale.reshape(shape)
+                + b.reshape(shape),)
+    if op == "LRN":
+        return (_lrn(ins[0], a),)
+    if op == "Softmax":
+        return (_softmax_block(ins[0], int(a.get("axis", 1))),)
+    if op == "LogSoftmax":
+        return (jnp.log(_softmax_block(ins[0], int(a.get("axis", 1)))
+                        + 1e-38),)
+    if op == "LeakyRelu":
+        al = float(a.get("alpha", 0.01))
+        return (jnp.where(ins[0] > 0, ins[0], al * ins[0]),)
+    if op == "Elu":
+        al = float(a.get("alpha", 1.0))
+        return (jnp.where(ins[0] > 0, ins[0],
+                          al * (jnp.exp(ins[0]) - 1.0)),)
+    if op == "PRelu":
+        return (jnp.where(ins[0] > 0, ins[0], ins[1] * ins[0]),)
+    if op == "HardSigmoid":
+        al = float(a.get("alpha", 0.2))
+        be = float(a.get("beta", 0.5))
+        return (jnp.clip(al * ins[0] + be, 0.0, 1.0),)
+    if op == "Clip":
+        lo = ins[1] if len(ins) > 1 and ins[1] is not None else -jnp.inf
+        hi = ins[2] if len(ins) > 2 and ins[2] is not None else jnp.inf
+        return (jnp.clip(ins[0], lo, hi),)
+    if op == "Where":
+        return (jnp.where(ins[0].astype(bool), ins[1], ins[2]),)
+    if op == "Equal":
+        return (jnp.equal(ins[0], ins[1]),)
+    if op == "Greater":
+        return (jnp.greater(ins[0], ins[1]),)
+    if op == "Less":
+        return (jnp.less(ins[0], ins[1]),)
+    if op == "Mod":
+        if int(a.get("fmod", 0)):
+            return (jnp.fmod(ins[0], ins[1]),)
+        return (jnp.mod(ins[0], ins[1]),)
+    if op == "Cast":
+        return (ins[0].astype(P.DTYPE_REV[int(a["to"])]),)
+    if op == "Concat":
+        return (jnp.concatenate([i for i in ins], axis=int(a["axis"])),)
+    if op == "Split":
+        ax = int(a.get("axis", 0))
+        sizes = a.get("split")
+        if sizes:
+            cuts = _np.cumsum(sizes)[:-1].tolist()
+            return tuple(jnp.split(ins[0], cuts, axis=ax))
+        return tuple(jnp.split(ins[0], 2, axis=ax))
+    if op == "Transpose":
+        perm = a.get("perm")
+        return (jnp.transpose(ins[0], perm),)
+    if op == "Reshape":
+        return (_reshape(ins[0], ins[1]),)
+    if op == "Flatten":
+        ax = int(a.get("axis", 1))
+        return (ins[0].reshape((int(_np.prod(ins[0].shape[:ax]) or 1),
+                                -1)),)
+    if op == "Squeeze":
+        return (jnp.squeeze(ins[0], axis=_axes(a)),)
+    if op == "Unsqueeze":
+        out = ins[0]
+        for ax in sorted(_axes(a)):
+            out = jnp.expand_dims(out, ax)
+        return (out,)
+    if op == "Expand":
+        shape = [int(v) for v in _np.asarray(ins[1]).tolist()]
+        return (jnp.broadcast_to(
+            ins[0], _np.broadcast_shapes(tuple(ins[0].shape),
+                                         tuple(shape))),)
+    if op == "Tile":
+        return (jnp.tile(ins[0],
+                         [int(v) for v in _np.asarray(ins[1]).tolist()]),)
+    if op == "Shape":
+        return (jnp.asarray(ins[0].shape, jnp.int64),)
+    if op == "Slice":
+        return (_slice_op(ins[0], ins[1], ins[2],
+                          ins[3] if len(ins) > 3 else None,
+                          ins[4] if len(ins) > 4 else None),)
+    if op == "Gather":
+        ax = int(a.get("axis", 0))
+        return (jnp.take(ins[0], ins[1].astype(jnp.int32), axis=ax),)
+    if op == "GatherElements":
+        ax = int(a.get("axis", 0))
+        return (jnp.take_along_axis(ins[0], ins[1].astype(jnp.int32),
+                                    axis=ax),)
+    if op == "OneHot":
+        return (_onehot(ins[0], ins[1], ins[2], a),)
+    if op == "TopK":
+        return _topk(ins[0], _np.asarray(ins[1]).reshape(()), a)
+    if op == "ArgMax":
+        ax = int(a.get("axis", 0))
+        keep = bool(a.get("keepdims", 1))
+        out = jnp.argmax(ins[0], axis=ax)
+        return (jnp.expand_dims(out, ax).astype(jnp.int64) if keep
+                else out.astype(jnp.int64),)
+    if op == "ArgMin":
+        ax = int(a.get("axis", 0))
+        keep = bool(a.get("keepdims", 1))
+        out = jnp.argmin(ins[0], axis=ax)
+        return (jnp.expand_dims(out, ax).astype(jnp.int64) if keep
+                else out.astype(jnp.int64),)
+    if op == "Pad":
+        return (_pad_op(ins[0], a,
+                        pads=_np.asarray(ins[1]).tolist()
+                        if len(ins) > 1 else None,
+                        value=ins[2] if len(ins) > 2 else None),)
+    if op == "SpaceToDepth":
+        return (_depth_space(ins[0], a, True),)
+    if op == "DepthToSpace":
+        return (_depth_space(ins[0], a, False),)
+    if op == "Dropout":
+        return (ins[0],)
+    if op == "Constant":
+        t = a["value"]
+        return (jnp.asarray(t["array"]),)
+    if op == "ConstantOfShape":
+        shape = [int(v) for v in _np.asarray(ins[0]).tolist()]
+        t = a.get("value")
+        if t is None:
+            return (jnp.zeros(shape, jnp.float32),)
+        fill = _np.asarray(t["array"]).reshape(())
+        return (jnp.full(shape, fill, fill.dtype),)
+    if op == "QuantizeLinear":
+        scale, zp = ins[1], ins[2]
+        info = _np.iinfo(_np.asarray(zp).dtype)
+        q = jnp.round(ins[0] / scale) + jnp.asarray(zp, jnp.float32)
+        return (jnp.clip(q, info.min, info.max).astype(
+            _np.asarray(zp).dtype),)
+    if op == "DequantizeLinear":
+        scale, zp = ins[1], ins[2]
+        return ((ins[0].astype(jnp.float32)
+                 - jnp.asarray(zp, jnp.float32)) * scale,)
+    raise NotImplementedError(f"onnx_eval: unsupported op {op!r}")
+
+
+def evaluate(graph, feeds):
+    """Evaluate a parsed GraphProto dict with `feeds` (name -> array).
+    Returns {output_name: np.ndarray}."""
+    env = {}
+    for t in graph["initializers"]:
+        env[t["name"]] = jnp.asarray(t["array"])
+    for vi in graph["inputs"]:
+        if vi["name"] in feeds:
+            env[vi["name"]] = jnp.asarray(feeds[vi["name"]])
+    missing = [vi["name"] for vi in graph["inputs"]
+               if vi["name"] not in env]
+    if missing:
+        raise ValueError(f"missing feeds for {missing}")
+    for n in graph["nodes"]:
+        ins = [env[i] if i else None for i in n["input"]]
+        outs = _eval_node(n["op_type"], ins, n["attrs"])
+        for name, val in zip(n["output"], outs):
+            if name:
+                env[name] = val
+    return {o["name"]: _np.asarray(env[o["name"]])
+            for o in graph["outputs"]}
+
+
+def run_model(path_or_bytes, feeds):
+    """Parse + evaluate an ONNX file (the onnxruntime stand-in)."""
+    buf = path_or_bytes
+    if isinstance(buf, str):
+        with open(buf, "rb") as f:
+            buf = f.read()
+    m = P.check_model(buf)
+    return evaluate(m["graph"], feeds)

@@ -211,7 +211,7 @@ def _install_round1():
 
     for nm, fn in {
         "quantize": getattr(q, "quantize", None),
-        "quantize_v2": getattr(q, "quantize", None),
+        "quantize_v2": getattr(q, "quantize_v2", None),
         "dequantize": getattr(q, "dequantize", None),
         "requantize": getattr(q, "requantize", None),
         "calibrate_entropy": getattr(q, "_entropy_threshold", None),

@@ -27,7 +27,24 @@ from .symbol import _OP_TABLE, Symbol, register_sym_op
 
 # ops whose output count depends on attrs (generic adapters default to 1;
 # these need Symbol.nout to match so __getitem__/list_outputs work)
+def _three(a):  # noqa: ARG001 - quantized ops return (out, min, max)
+    return 3
+
+
 _MULTI_OUT = {
+    "_contrib_quantize": _three,
+    "_contrib_quantize_v2": _three,
+    "_contrib_requantize": _three,
+    "_contrib_quantized_conv": _three,
+    "_contrib_quantized_fully_connected": _three,
+    "_contrib_quantized_pooling": _three,
+    "_contrib_quantized_act": _three,
+    "_contrib_quantized_flatten": _three,
+    "_contrib_quantized_batch_norm": _three,
+    "_contrib_quantized_elemwise_add": _three,
+    "_contrib_quantized_elemwise_mul": _three,
+    "_contrib_quantized_concat": _three,
+    "_contrib_quantized_embedding": _three,
     "_contrib_bipartite_matching": lambda a: 2,
     "_contrib_box_encode": lambda a: 2,
     "_contrib_MultiBoxTarget": lambda a: 3,
@@ -52,16 +69,54 @@ def _tensor_param_names(fn):
                           inspect.Parameter.POSITIONAL_OR_KEYWORD)]
 
 
+def _unwrap_tree(x):
+    """Some registry entries are imperative apply_op wrappers that return
+    NDArrays (e.g. the quantized family) — lowering must hand raw jax
+    arrays back to the graph so jax.eval_shape/jit can trace them."""
+    from ..ndarray.ndarray import NDArray
+
+    if isinstance(x, NDArray):
+        return x._data
+    if isinstance(x, (tuple, list)):
+        return type(x)(_unwrap_tree(v) for v in x)
+    return x
+
+
 def _make_lowering(fn):
     def lower(ins, attrs):
-        return fn(*ins, **attrs)
+        return _unwrap_tree(fn(*ins, **attrs))
 
     return lower
 
 
+def _quantized_no_bias_lowering(fn):
+    """quantized conv/FC take (data, weight, bias, ranges...) positionally;
+    a no_bias graph has no bias INPUT, so re-bind with bias=None."""
+    def lower(ins, attrs):
+        if attrs.get("no_bias") in (True, 1, "True", "1") and len(ins) == 6:
+            d, w, dlo, dhi, wlo, whi = ins
+            return _unwrap_tree(fn(d, w, None, dlo, dhi, wlo, whi,
+                                   **attrs))
+        return _unwrap_tree(fn(*ins, **attrs))
+
+    return lower
+
+
+_SPECIAL_LOWERING = {
+    "_contrib_quantized_conv": _quantized_no_bias_lowering,
+    "_contrib_quantized_fully_connected": _quantized_no_bias_lowering,
+}
+
+
 def _make_builder(op_name, pos_names):
     def builder(*inputs, name=None, **kwargs):
-        inputs = list(inputs)
+        # a None tensor slot means "input absent" (reference convention:
+        # e.g. bias with no_bias=True) — drop it rather than making an
+        # object-dtype constant
+        inputs = [i for i in inputs if i is not None]
+        for k in [k for k, v in kwargs.items()
+                  if v is None and k in pos_names]:
+            kwargs.pop(k)
         # named tensor inputs (data=x, weight=w) go to their signature
         # slots, in signature order after any positional inputs
         named = [(k, v) for k, v in kwargs.items() if isinstance(v, Symbol)]
@@ -89,7 +144,8 @@ def _generate():
     for op_name in _registry.list_ops():
         fn = _registry.get_op(op_name)
         if op_name not in _OP_TABLE:
-            register_sym_op(op_name, _make_lowering(fn))
+            make = _SPECIAL_LOWERING.get(op_name, _make_lowering)
+            register_sym_op(op_name, make(fn))
         if op_name not in _GENERATED:
             _GENERATED[op_name] = _make_builder(
                 op_name, _tensor_param_names(fn))

@@ -1,0 +1,156 @@
+"""Numeric ONNX round-trip: export -> wire-decode -> evaluate -> compare
+with the original symbol (VERDICT r2 missing #7 / next #6; the reference
+verified its exporter against onnxruntime — the image has no
+onnx/onnxruntime, so mxnet_tpu.onnx.onnx_eval is the stand-in)."""
+import numpy as onp
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu.onnx import onnx_eval
+from mxnet_tpu.symbol import zoo
+
+
+def _materialize(shapes, seed=0):
+    rs = onp.random.RandomState(seed)
+    out = {}
+    for n, s in shapes.items():
+        if n.endswith("_var"):
+            out[n] = mx.np.array(onp.abs(rs.normal(1, 0.05, s)).astype("f"))
+        else:
+            out[n] = mx.np.array(rs.normal(0, 0.05, s).astype("f"))
+    return out
+
+
+@pytest.mark.parametrize("name,kw,dshapes,dtypes", [
+    ("mlp", {}, [(2, 784)], ["float32"]),
+    ("lenet", {}, [(2, 1, 28, 28)], ["float32"]),
+    ("resnet", {"num_layers": 18, "num_classes": 10},
+     [(1, 3, 32, 32)], ["float32"]),
+    ("bert", {}, [(2, 16), (2, 16)], ["int32", "int32"]),
+])
+def test_zoo_numeric_round_trip(tmp_path, name, kw, dshapes, dtypes):
+    s, shapes = zoo.get_symbol(name, **kw)
+    params = _materialize(shapes)
+    args = dict(params)
+    rs = onp.random.RandomState(1)
+    datas = [n for n in s.list_arguments() if n not in params]
+    feeds = {}
+    for i, (dn, shp, dt) in enumerate(zip(datas, dshapes, dtypes)):
+        arr = (rs.randint(0, 50 if i == 0 else 2, shp).astype("int32")
+               if dt == "int32" else rs.rand(*shp).astype("f"))
+        feeds[dn] = arr
+        args[dn] = mx.np.array(arr)
+    want = s.bind(None, args).forward()[0].asnumpy()
+
+    path = str(tmp_path / f"{name}.onnx")
+    mx.onnx.export_model(s, params, in_shapes=dshapes,
+                         in_types=[onp.dtype(d) for d in dtypes],
+                         onnx_file_path=path)
+    outs = onnx_eval.run_model(path, feeds)
+    got = next(iter(outs.values()))
+    onp.testing.assert_allclose(got, want, rtol=2e-4, atol=2e-5)
+
+
+OPS_CASES = [
+    # (builder, feeds) exercising evaluator families beyond the zoo
+    (lambda v: mx.sym.Pooling(v, kernel=(2, 2), stride=(2, 2),
+                              pool_type="avg"),
+     {"x": onp.random.RandomState(0).rand(1, 2, 6, 6).astype("f")}),
+    (lambda v: mx.sym.topk(v, k=3, axis=-1, ret_typ="value"),
+     {"x": onp.random.RandomState(1).rand(2, 8).astype("f")}),
+    (lambda v: mx.sym.LeakyReLU(v, act_type="elu", slope=0.7),
+     {"x": onp.random.RandomState(2).randn(3, 4).astype("f")}),
+    (lambda v: mx.sym.pad(v, mode="constant", constant_value=1.5,
+                          pad_width=(0, 0, 0, 0, 1, 2, 2, 1)),
+     {"x": onp.random.RandomState(3).rand(1, 1, 3, 3).astype("f")}),
+    (lambda v: mx.sym.slice(v, begin=(None, 3), end=(None, 0),
+                            step=(1, -1)),
+     {"x": onp.random.RandomState(4).rand(2, 5).astype("f")}),
+    (lambda v: mx.sym.depth_to_space(v, block_size=2),
+     {"x": onp.random.RandomState(5).rand(1, 8, 2, 2).astype("f")}),
+    (lambda v: mx.sym.LRN(v, nsize=3, alpha=1e-3, beta=0.7, knorm=1.2),
+     {"x": onp.random.RandomState(6).rand(1, 6, 4, 4).astype("f")}),
+    (lambda v: mx.sym.L2Normalization(v),
+     {"x": onp.random.RandomState(7).rand(2, 5).astype("f")}),
+    (lambda v: mx.sym.logsumexp(v, axis=1),
+     {"x": onp.random.RandomState(8).rand(3, 4).astype("f")}),
+    (lambda v: mx.sym.InstanceNorm(
+        v, mx.sym.var("g"), mx.sym.var("b"), eps=1e-4),
+     {"x": onp.random.RandomState(9).rand(2, 3, 5).astype("f"),
+      "g": onp.random.RandomState(10).rand(3).astype("f"),
+      "b": onp.random.RandomState(11).rand(3).astype("f")}),
+]
+
+
+def _qparam(name, arr):
+    """Offline-quantize a param: (symbols for codes/min/max, feed dict)."""
+    amax = float(onp.abs(arr).max())
+    codes = onp.clip(onp.round(arr * (127.0 / amax)),
+                     -127, 127).astype(onp.int8)
+    sym = mx.sym
+    return (sym.var(name), sym.var(name + "_min"), sym.var(name + "_max"),
+            {name: codes, name + "_min": onp.float32(-amax),
+             name + "_max": onp.float32(amax)})
+
+
+def test_int8_qdq_round_trip(tmp_path):
+    """Symbolic INT8 graph (quantize_v2 -> quantized_conv -> quantized
+    residual add -> quantized_pooling -> quantized_fc -> dequantize, the
+    ResNet block pattern) exports as ONNX QDQ and agrees numerically
+    (reference: the INT8 export path of mx2onnx + quantization.cc)."""
+    sym = mx.sym
+    rs = onp.random.RandomState(0)
+    feeds = {"data": (rs.rand(2, 3, 8, 8) * 2 - 1).astype("f")}
+
+    data = sym.var("data")
+    q = sym._contrib_quantize_v2(data, min_calib_range=-2.0,
+                                 max_calib_range=2.0)
+    w1s, w1lo, w1hi, f = _qparam("w1", rs.randn(4, 3, 3, 3).astype("f")
+                                 * 0.3)
+    feeds.update(f)
+    b1s, b1lo, b1hi, f = _qparam("b1", rs.randn(4).astype("f") * 0.1)
+    feeds.update(f)
+    conv = sym._contrib_quantized_conv(
+        q[0], w1s, b1s, q[1], q[2], w1lo, w1hi, b1lo, b1hi,
+        kernel=(3, 3), stride=(1, 1), pad=(1, 1), num_filter=4)
+    added = sym._contrib_quantized_elemwise_add(
+        conv[0], conv[0], conv[1], conv[2], conv[1], conv[2])
+    pool = sym._contrib_quantized_pooling(
+        added[0], added[1], added[2], kernel=(2, 2), stride=(2, 2),
+        pool_type="max")
+    wfs, wflo, wfhi, f = _qparam("wf", rs.randn(5, 64).astype("f") * 0.2)
+    feeds.update(f)
+    bfs, bflo, bfhi, f = _qparam("bf", rs.randn(5).astype("f") * 0.1)
+    feeds.update(f)
+    fc = sym._contrib_quantized_fully_connected(
+        pool[0], wfs, bfs, pool[1], pool[2], wflo, wfhi, bflo, bfhi,
+        num_hidden=5)
+    out = sym._contrib_dequantize(fc[0], fc[1], fc[2])
+
+    want = out.eval(**feeds)[0].asnumpy()
+    path = str(tmp_path / "int8.onnx")
+    param_arrays = {k: mx.np.array(v) for k, v in feeds.items()
+                    if k != "data"}
+    mx.onnx.export_model(out, param_arrays, in_shapes=[(2, 3, 8, 8)],
+                         in_types=[onp.float32], onnx_file_path=path)
+    got = next(iter(onnx_eval.run_model(
+        path, {"data": feeds["data"]}).values()))
+    assert got.shape == want.shape == (2, 5)
+    onp.testing.assert_allclose(got, want, rtol=5e-3, atol=5e-3)
+
+
+@pytest.mark.parametrize("case", range(len(OPS_CASES)))
+def test_op_numeric_round_trip(tmp_path, case):
+    build, feeds = OPS_CASES[case]
+    node = build(mx.sym.var("x"))
+    want = node.eval(**feeds)[0].asnumpy()
+    path = str(tmp_path / f"op{case}.onnx")
+    names = node.list_arguments()
+    data_names = [n for n in names]
+    mx.onnx.export_model(node, {}, in_shapes=[feeds[n].shape
+                                              for n in data_names],
+                         in_types=[feeds[n].dtype for n in data_names],
+                         onnx_file_path=path)
+    outs = onnx_eval.run_model(path, feeds)
+    got = next(iter(outs.values()))
+    onp.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-5)
