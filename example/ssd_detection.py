@@ -1,0 +1,157 @@
+"""End-to-end SSD-style detection (VERDICT r2 next #7).
+
+Reference flow being re-created (not copied): example/ssd/train.py —
+ImageDetIter over a detection .rec, MultiBoxPrior anchors, MultiBoxTarget
+training targets, SmoothL1 + softmax losses, MultiBoxDetection decode at
+inference. The backbone is a small conv net; anchors come from one
+feature map (a single-scale SSD head keeps the example readable — the
+multibox ops handle multi-scale by concatenating anchors/preds).
+
+Synthetic data: colored rectangles on noise, one or two objects per
+image, packed into a .rec by this script (tools/im2rec det layout:
+label = [header_width, obj_width, ...objects]).
+
+Run: python example/ssd_detection.py [--steps 30]
+"""
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+import tempfile
+
+import numpy as onp
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+
+def make_synthetic_rec(path_prefix, n=64, size=64, seed=0):
+    """Images with 1-2 axis-aligned bright rectangles; labels in the
+    packed det layout."""
+    from mxnet_tpu import recordio
+
+    rs = onp.random.RandomState(seed)
+    rec = recordio.MXIndexedRecordIO(path_prefix + ".idx",
+                                     path_prefix + ".rec", "w")
+    for i in range(n):
+        img = rs.randint(0, 60, (size, size, 3), dtype=onp.uint8)
+        objs = []
+        for _ in range(rs.randint(1, 3)):
+            cls = rs.randint(0, 2)
+            w = rs.randint(size // 4, size // 2)
+            h = rs.randint(size // 4, size // 2)
+            x0 = rs.randint(0, size - w)
+            y0 = rs.randint(0, size - h)
+            color = (200, 60) if cls == 0 else (60, 200)
+            img[y0:y0 + h, x0:x0 + w, 0] = color[0]
+            img[y0:y0 + h, x0:x0 + w, 1] = color[1]
+            objs.append([cls, x0 / size, y0 / size,
+                         (x0 + w) / size, (y0 + h) / size])
+        label = onp.asarray([2, 5] + [v for o in objs for v in o],
+                            onp.float32)
+        header = recordio.IRHeader(len(label), label, i, 0)
+        rec.write_idx(i, recordio.pack_img(header, img, quality=95))
+    rec.close()
+    return path_prefix + ".rec"
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--steps", type=int, default=30)
+    ap.add_argument("--batch", type=int, default=8)
+    ap.add_argument("--cpu", action="store_true",
+                    help="accepted for CI symmetry; the example always "
+                         "forces the CPU backend")
+    args = ap.parse_args()
+
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    import mxnet_tpu as mx
+    from mxnet_tpu import autograd, gluon
+    from mxnet_tpu.contrib import ops as cops
+    from mxnet_tpu.image import ImageDetIter
+
+    mx.seed(0)
+    rec = make_synthetic_rec(os.path.join(tempfile.mkdtemp(), "det"))
+    it = ImageDetIter(batch_size=args.batch, data_shape=(3, 64, 64),
+                      path_imgrec=rec, shuffle=True, rand_mirror=True,
+                      mean=True, std=True)
+
+    num_cls = 2
+    sizes, ratios = (0.35, 0.55), (1.0, 2.0, 0.5)
+    k = len(sizes) + len(ratios) - 1
+
+    class SSD(gluon.nn.HybridBlock):
+        def __init__(self):
+            super().__init__()
+            self.backbone = gluon.nn.Sequential()
+            for ch in (16, 32, 64):
+                self.backbone.add(
+                    gluon.nn.Conv2D(ch, 3, padding=1),
+                    gluon.nn.BatchNorm(), gluon.nn.Activation("relu"),
+                    gluon.nn.MaxPool2D(2))
+            self.cls_head = gluon.nn.Conv2D(k * (num_cls + 1), 3,
+                                            padding=1)
+            self.box_head = gluon.nn.Conv2D(k * 4, 3, padding=1)
+
+        def forward(self, x):
+            feat = self.backbone(x)
+            cp = self.cls_head(feat)      # (N, k*(C+1), H, W)
+            bp = self.box_head(feat)      # (N, k*4, H, W)
+            n = cp.shape[0]
+            cp = cp.transpose((0, 2, 3, 1)).reshape((n, -1, num_cls + 1))
+            bp = bp.transpose((0, 2, 3, 1)).reshape((n, -1))
+            return feat, cp.transpose((0, 2, 1)), bp
+
+        def anchors(self, feat):
+            return cops.multibox_prior(feat, sizes=sizes, ratios=ratios)
+
+    net = SSD()
+    net.initialize()
+    cls_loss = gluon.loss.SoftmaxCrossEntropyLoss(axis=1)
+    trainer = gluon.Trainer(net.collect_params(), "adam",
+                            {"learning_rate": 2e-3})
+
+    step = 0
+    first = last = None
+    while step < args.steps:
+        try:
+            batch = it.next()
+        except StopIteration:
+            it.reset()
+            continue
+        x, y = batch.data[0], batch.label[0]
+        with autograd.record():
+            feat, cls_preds, box_preds = net(x)
+            anchors = net.anchors(feat)
+            bt, bm, ct = cops.multibox_target(anchors, y, cls_preds)
+            l_cls = cls_loss(cls_preds, ct)
+            l_box = mx.np.abs((box_preds - bt) * bm).mean(axis=-1)
+            loss = l_cls + l_box
+        loss.backward()
+        trainer.step(args.batch)
+        v = float(loss.mean())
+        first = first if first is not None else v
+        last = v
+        step += 1
+    print(f"ssd train: loss {first:.4f} -> {last:.4f} over {step} steps")
+
+    # inference: decode + NMS on one batch
+    it.reset()
+    batch = it.next()
+    feat, cls_preds, box_preds = net(batch.data[0])
+    anchors = net.anchors(feat)
+    prob = mx.npx.softmax(cls_preds, axis=1)
+    dets = cops.multibox_detection(prob, box_preds, anchors,
+                                   nms_threshold=0.45, threshold=0.01)
+    d0 = dets.asnumpy()[0]
+    kept = d0[d0[:, 0] >= 0]
+    print(f"detections on image 0: {len(kept)} boxes, "
+          f"best score {kept[:, 1].max() if len(kept) else 0:.3f}")
+    assert last < first, "loss did not decrease"
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -25,7 +25,7 @@ from .image import (
 
 __all__ = ["DetAugmenter", "DetBorrowAug", "DetHorizontalFlipAug",
            "DetRandomCropAug", "DetRandomPadAug", "DetForceResizeAug",
-           "CreateDetAugmenter"]
+           "CreateDetAugmenter", "ImageDetIter"]
 
 
 class DetAugmenter:
@@ -238,3 +238,245 @@ def CreateDetAugmenter(data_shape, resize=0, rand_crop=0, rand_pad=0,  # noqa: N
             std = _np.array([58.395, 57.12, 57.375])
         auglist.append(DetBorrowAug(ColorNormalizeAug(mean, std)))
     return auglist
+
+
+class ImageDetIter:
+    """Detection data iterator (reference: image/detection.py:625-1008
+    ImageDetIter over iter_image_det_recordio.cc).
+
+    Reads a .rec written with detection labels (header label layout:
+    ``[header_width, obj_width, <extra header...>, obj0..., obj1...]``,
+    obj = ``[class, xmin, ymin, xmax, ymax, ...]`` normalized to [0,1]) or
+    a .lst via ``path_imglist``. Labels ride through the Det augmenter
+    chain with the image and come out padded to a fixed
+    ``(batch, max_objects, obj_width)`` block with ``label_pad_val``
+    rows, so the batch shape is static for jit (TPU contract: no dynamic
+    shapes reach the device).
+    """
+
+    def __init__(self, batch_size, data_shape, path_imgrec=None,
+                 path_imglist=None, path_root="", path_imgidx=None,
+                 shuffle=False, aug_list=None, imglist=None,
+                 dtype="float32", label_pad_width=-1, label_pad_val=-1.0,
+                 num_parts=1, part_index=0, seed=0, **kwargs):
+        from ..io import DataDesc
+
+        self.batch_size = batch_size
+        self._shape = tuple(data_shape)
+        self._dtype = _np.dtype(dtype)
+        self._pad_val = float(label_pad_val)
+        self._shuffle = shuffle
+        self._seed = int(seed)
+        self._epoch = -1
+        self.auglist = (aug_list if aug_list is not None
+                        else CreateDetAugmenter(data_shape, **kwargs))
+
+        self._items = []           # (kind, payload) per image
+        if path_imgrec:
+            from ..recordio import IndexedRecordIO
+
+            self._rec = (IndexedRecordIO(path_imgidx, path_imgrec)
+                         if path_imgidx else IndexedRecordIO(path_imgrec))
+            idx = _np.arange(len(self._rec))
+            if num_parts > 1:
+                idx = _np.array_split(idx, num_parts)[part_index]
+            self._items = [("rec", int(i)) for i in idx]
+        elif path_imglist or imglist is not None:
+            import os
+
+            rows = []
+            if path_imglist:
+                with open(path_imglist) as f:
+                    for line in f:
+                        parts = line.strip().split("\t")
+                        if len(parts) < 3:
+                            continue
+                        rows.append((
+                            [float(v) for v in parts[1:-1]],
+                            os.path.join(path_root, parts[-1])))
+            else:
+                for entry in imglist:
+                    rows.append(([float(v) for v in entry[:-1]]
+                                 if not isinstance(entry[0], (list, tuple))
+                                 else list(entry[0]), entry[-1]))
+            self._items = [("file", r) for r in rows]
+        else:
+            raise ValueError("need path_imgrec, path_imglist or imglist")
+        if not self._items:
+            raise ValueError(
+                "ImageDetIter found no records — for .rec sources the "
+                ".idx sidecar must exist (pass path_imgidx or write with "
+                "MXIndexedRecordIO/im2rec)")
+
+        # scan labels once for the fixed label block shape (reference:
+        # ImageDetIter estimates label_shape from the data)
+        max_obj, obj_w = 1, 5
+        for it in self._items:
+            lab = self._read_label(it)
+            max_obj = max(max_obj, lab.shape[0])
+            obj_w = max(obj_w, lab.shape[1])
+        if label_pad_width > 0:
+            if label_pad_width < max_obj:
+                raise ValueError(
+                    f"label_pad_width {label_pad_width} < max objects "
+                    f"{max_obj} in the dataset")
+            max_obj = label_pad_width
+        self._label_shape = (max_obj, obj_w)
+
+        c, h, w = self._shape
+        self.provide_data = [DataDesc("data", (batch_size, c, h, w),
+                                      self._dtype)]
+        self.provide_label = [DataDesc("label",
+                                       (batch_size,) + self._label_shape)]
+        self.reset()
+
+    # -- label plumbing -------------------------------------------------
+    @staticmethod
+    def _parse_label(raw):
+        """Flat packed label -> (N, obj_width) array of valid objects
+        (reference: ImageDetIter._parse_label)."""
+        raw = _np.asarray(raw, _np.float32).ravel()
+        if raw.size < 2:
+            raise ValueError("det label needs [header_width, obj_width]")
+        header_width = int(raw[0])
+        obj_width = int(raw[1])
+        if obj_width < 5:
+            raise ValueError(f"object width {obj_width} < 5")
+        if (raw.size - header_width) % obj_width:
+            raise ValueError(
+                f"label length {raw.size} does not match header "
+                f"{header_width} + k*{obj_width}")
+        out = raw[header_width:].reshape(-1, obj_width)
+        return out[out[:, 0] > -0.5]   # class < 0 rows are padding
+
+    def _read_label(self, item):
+        kind, payload = item
+        if kind == "rec":
+            from ..recordio import unpack
+
+            header, _ = unpack(self._rec.read_idx(payload))
+            return self._parse_label(header.label)
+        return self._parse_label(payload[0])
+
+    def _read_sample(self, item):
+        """One record read -> (image HWC, label (N, w)) — image and label
+        come from the same unpack, one seek per sample."""
+        kind, payload = item
+        if kind == "rec":
+            from ..recordio import unpack_img
+
+            header, img = unpack_img(self._rec.read_idx(payload))
+            label = self._parse_label(header.label)
+        else:
+            from .image import imread
+
+            img = _as_np(imread(payload[1]))
+            label = self._parse_label(payload[0])
+        if img.ndim == 2:
+            img = img[:, :, None]
+        return img, label
+
+    # -- iteration ------------------------------------------------------
+    def reset(self):
+        self._epoch += 1
+        order = _np.arange(len(self._items))
+        if self._shuffle:
+            _np.random.RandomState(self._seed + self._epoch).shuffle(order)
+        self._order = order
+        self._cursor = 0
+
+    def __iter__(self):
+        return self
+
+    def next(self):
+        from .. import numpy as mnp
+        from ..io import DataBatch
+
+        if self._cursor >= len(self._order):
+            raise StopIteration
+        idxs = self._order[self._cursor:self._cursor + self.batch_size]
+        pad = self.batch_size - len(idxs)
+        if pad:   # wrap to the start (last_batch_handle='pad'); modulo so
+            # datasets smaller than one batch still fill every row
+            fill = self._order[_np.arange(pad) % len(self._order)]
+            idxs = _np.concatenate([idxs, fill])
+        self._cursor += self.batch_size
+
+        c, h, w = self._shape
+        datas = _np.empty((self.batch_size, c, h, w), self._dtype)
+        labels = _np.full((self.batch_size,) + self._label_shape,
+                          self._pad_val, _np.float32)
+        for j, i in enumerate(idxs):
+            item = self._items[int(i)]
+            img, label = self._read_sample(item)
+            src = NDArray(img)
+            for aug in self.auglist:
+                src, label = aug(src, label)
+            arr = _as_np(src)
+            if arr.shape[:2] != (h, w):
+                # custom aug lists need not end in a force-resize; the
+                # batch block must still be static (labels are
+                # normalized, so a pure resize leaves them untouched)
+                arr = _as_np(imresize(NDArray(arr), w, h))
+            datas[j] = arr.transpose(2, 0, 1).astype(self._dtype)
+            n = min(label.shape[0], self._label_shape[0])
+            labels[j, :n, :label.shape[1]] = label[:n]
+        return DataBatch([mnp.array(datas)], [mnp.array(labels)], pad=pad,
+                         provide_data=self.provide_data,
+                         provide_label=self.provide_label)
+
+    __next__ = next
+
+    # -- reference utility surface --------------------------------------
+    def reshape(self, data_shape=None, label_shape=None):
+        from ..io import DataDesc
+
+        if data_shape is not None:
+            self._shape = tuple(data_shape)
+            c, h, w = self._shape
+            self.provide_data = [DataDesc(
+                "data", (self.batch_size, c, h, w), self._dtype)]
+        if label_shape is not None:
+            self._label_shape = tuple(label_shape)
+            self.provide_label = [DataDesc(
+                "label", (self.batch_size,) + self._label_shape)]
+
+    def sync_label_shape(self, it, verbose=False):
+        """Synchronize label padding with another ImageDetIter
+        (reference: train/val iterators must agree)."""
+        if not isinstance(it, ImageDetIter):
+            raise TypeError("sync_label_shape needs an ImageDetIter")
+        target = (max(self._label_shape[0], it._label_shape[0]),
+                  max(self._label_shape[1], it._label_shape[1]))
+        if verbose and target != self._label_shape:
+            print(f"label shape synced to {target}")
+        self.reshape(label_shape=target)
+        it.reshape(label_shape=target)
+        return it
+
+    def draw_next(self, color=255, thickness=2, waitKey=None,  # noqa: N803,ARG002
+                  window_name=None):  # noqa: ARG002
+        """Yield images with ground-truth boxes burned in (reference:
+        ImageDetIter.draw_next; numpy drawing instead of cv2)."""
+        try:
+            batch = self.next()
+        except StopIteration:
+            return
+        imgs = _np.asarray(batch.data[0].asnumpy())
+        labs = _np.asarray(batch.label[0].asnumpy())
+        for img, lab in zip(imgs, labs):
+            canvas = img.transpose(1, 2, 0).copy()
+            hh, ww = canvas.shape[:2]
+            for obj in lab:
+                if obj[0] < -0.5:
+                    continue
+                x1 = int(_np.clip(obj[1] * ww, 0, ww - 1))
+                y1 = int(_np.clip(obj[2] * hh, 0, hh - 1))
+                x2 = int(_np.clip(obj[3] * ww, 0, ww - 1))
+                y2 = int(_np.clip(obj[4] * hh, 0, hh - 1))
+                t = thickness
+                canvas[y1:y1 + t, x1:x2] = color
+                canvas[max(0, y2 - t):y2, x1:x2] = color
+                canvas[y1:y2, x1:x1 + t] = color
+                canvas[y1:y2, max(0, x2 - t):x2] = color
+            yield canvas

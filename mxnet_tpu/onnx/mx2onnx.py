@@ -969,6 +969,25 @@ def _qdq_zp(ctx, base, dtype=_np.int8):
     return ctx.add_init(ctx.fresh(base + "_zp"), _np.zeros((), dtype))
 
 
+def _clip_to_range(ctx, base, x, amax):
+    """Clip the float tensor to [-amax, amax] BEFORE QuantizeLinear: the
+    imperative _q clamps codes to [-127, 127], while QuantizeLinear
+    saturates at -128 — pre-clipping makes round(+-amax/scale) = +-127
+    exactly. `amax` may be a tensor name or a python float."""
+    if isinstance(amax, str):
+        neg = ctx.fresh(base + "_neg")
+        ctx.add_node("Neg", [amax], [neg])
+        lo, hi = neg, amax
+    else:
+        lo = ctx.add_init(ctx.fresh(base + "_lo"),
+                          _np.asarray(-amax, _np.float32))
+        hi = ctx.add_init(ctx.fresh(base + "_hi"),
+                          _np.asarray(amax, _np.float32))
+    out = ctx.fresh(base + "_clip")
+    ctx.add_node("Clip", [x, lo, hi], [out])
+    return out
+
+
 def _emit_deq(ctx, base, q, lo, hi, denom=_INT8_MAX):
     sc, _ = _qdq_scale(ctx, base, lo, hi, denom)
     out = ctx.fresh(base + "_deq")
@@ -996,7 +1015,8 @@ def _c_quantize_v2(ctx, s, ins, outs, shapes):  # noqa: ARG001
         amax = max(abs(float(lo)), abs(float(hi)))
         sc = ctx.add_init(ctx.fresh(s.name + "_scale"),
                           _np.asarray(amax / _INT8_MAX, _np.float32))
-        ctx.add_node("QuantizeLinear", [ins[0], sc, _qdq_zp(ctx, s.name)],
+        clipped = _clip_to_range(ctx, s.name, ins[0], amax)
+        ctx.add_node("QuantizeLinear", [clipped, sc, _qdq_zp(ctx, s.name)],
                      [outs[0]], s.name)
         for o, v in ((outs[1], -amax), (outs[2], amax)):
             c = ctx.add_init(ctx.fresh(s.name + "_r"),
@@ -1011,7 +1031,8 @@ def _c_quantize(ctx, s, ins, outs, shapes):  # noqa: ARG001
     # quantize with the CALLER-SUPPLIED range (quantize.cc), unlike
     # quantize_v2's dynamic/calibrated forms
     sc, amax = _qdq_scale(ctx, s.name, ins[1], ins[2])
-    ctx.add_node("QuantizeLinear", [ins[0], sc, _qdq_zp(ctx, s.name)],
+    clipped = _clip_to_range(ctx, s.name, ins[0], amax)
+    ctx.add_node("QuantizeLinear", [clipped, sc, _qdq_zp(ctx, s.name)],
                  [outs[0]], s.name)
     ctx.add_node("Neg", [amax], [outs[1]])
     ctx.add_node("Identity", [amax], [outs[2]])
@@ -1037,7 +1058,8 @@ def _c_requantize(ctx, s, ins, outs, shapes):  # noqa: ARG001
         amax = max(abs(float(lo)), abs(float(hi)), 1e-20)
         sc = ctx.add_init(ctx.fresh(s.name + "_scale"),
                           _np.asarray(amax / _INT8_MAX, _np.float32))
-        ctx.add_node("QuantizeLinear", [f, sc, _qdq_zp(ctx, s.name)],
+        clipped = _clip_to_range(ctx, s.name, f, amax)
+        ctx.add_node("QuantizeLinear", [clipped, sc, _qdq_zp(ctx, s.name)],
                      [outs[0]], s.name)
         for o, v in ((outs[1], -amax), (outs[2], amax)):
             c = ctx.add_init(ctx.fresh(s.name + "_r"),

@@ -18,6 +18,11 @@ def _run(script, *args, timeout=600):
     return r.stdout
 
 
+def test_ssd_detection_example():
+    out = _run("ssd_detection.py", "--steps", "6", "--batch", "4")
+    assert "ssd train: loss" in out and "detections on image 0" in out
+
+
 def test_train_mnist_example():
     out = _run("train_mnist.py", "--epochs", "1", "--limit", "128",
                "--batch-size", "32")

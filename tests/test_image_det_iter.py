@@ -1,0 +1,144 @@
+"""ImageDetIter (reference: python/mxnet/image/detection.py:625-1008) —
+label parse/round-trip, augmenter interaction, shape sync, drawing."""
+import numpy as onp
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import recordio
+from mxnet_tpu.image import ImageDetIter
+
+
+def _make_rec(tmp, n=10, size=48, max_obj=3, seed=0):
+    rs = onp.random.RandomState(seed)
+    prefix = str(tmp / "det")
+    rec = recordio.MXIndexedRecordIO(prefix + ".idx", prefix + ".rec", "w")
+    truth = []
+    for i in range(n):
+        img = rs.randint(0, 255, (size, size, 3), dtype=onp.uint8)
+        objs = []
+        for _ in range(rs.randint(1, max_obj + 1)):
+            x0, y0 = rs.uniform(0, 0.5, 2)
+            w, h = rs.uniform(0.2, 0.45, 2)
+            objs.append([float(rs.randint(0, 4)), x0, y0,
+                         min(x0 + w, 1.0), min(y0 + h, 1.0)])
+        truth.append(onp.asarray(objs, onp.float32))
+        label = onp.asarray([2, 5] + [v for o in objs for v in o],
+                            onp.float32)
+        rec.write_idx(i, recordio.pack_img(
+            recordio.IRHeader(len(label), label, i, 0), img, quality=95))
+    rec.close()
+    return prefix + ".rec", truth
+
+
+def test_label_round_trip_no_aug(tmp_path):
+    rec, truth = _make_rec(tmp_path)
+    it = ImageDetIter(batch_size=2, data_shape=(3, 48, 48),
+                      path_imgrec=rec, aug_list=[])
+    seen = 0
+    for batch in it:
+        labs = onp.asarray(batch.label[0].asnumpy())
+        assert batch.data[0].shape == (2, 3, 48, 48)
+        for j in range(2 - batch.pad):
+            want = truth[seen]
+            got = labs[j]
+            valid = got[got[:, 0] > -0.5]
+            onp.testing.assert_allclose(valid, want, rtol=1e-5, atol=1e-6)
+            seen += 1
+    assert seen == 10
+
+
+def test_parse_label_validation():
+    with pytest.raises(ValueError, match="does not match"):
+        ImageDetIter._parse_label(onp.asarray([2, 5, 1.0, 0.1], "f"))
+    out = ImageDetIter._parse_label(
+        onp.asarray([4, 5, 9, 9, 1, .1, .1, .5, .5, -1, 0, 0, 0, 0], "f"))
+    assert out.shape == (1, 5)          # padding row (-1 class) dropped
+
+
+def test_augmenters_keep_boxes_in_range(tmp_path):
+    rec, _ = _make_rec(tmp_path, n=6)
+    it = ImageDetIter(batch_size=3, data_shape=(3, 32, 32),
+                      path_imgrec=rec, shuffle=True, rand_mirror=True,
+                      rand_crop=1, rand_pad=1, mean=True, std=True)
+    batch = it.next()
+    labs = onp.asarray(batch.label[0].asnumpy())
+    valid = labs[labs[:, :, 0] > -0.5]
+    assert len(valid)
+    assert (valid[:, 1:5] >= -1e-6).all() and (valid[:, 1:5] <= 1 + 1e-6).all()
+    assert (valid[:, 3] > valid[:, 1]).all()
+    assert (valid[:, 4] > valid[:, 2]).all()
+
+
+def test_mirror_flips_coordinates(tmp_path):
+    rec, truth = _make_rec(tmp_path, n=4)
+    from mxnet_tpu.image.detection import DetHorizontalFlipAug
+
+    it = ImageDetIter(batch_size=4, data_shape=(3, 48, 48),
+                      path_imgrec=rec,
+                      aug_list=[DetHorizontalFlipAug(1.0)])
+    labs = onp.asarray(it.next().label[0].asnumpy())
+    for j, want in enumerate(truth[:4]):
+        got = labs[j]
+        got = got[got[:, 0] > -0.5]
+        onp.testing.assert_allclose(got[:, 1], 1.0 - want[:, 3], rtol=1e-5)
+        onp.testing.assert_allclose(got[:, 3], 1.0 - want[:, 1], rtol=1e-5)
+
+
+def test_sync_label_shape_and_reshape(tmp_path):
+    rec1, _ = _make_rec(tmp_path, n=4, max_obj=2, seed=1)
+    it1 = ImageDetIter(batch_size=2, data_shape=(3, 32, 32),
+                       path_imgrec=rec1, aug_list=[])
+    it1.reshape(label_shape=(7, 5))
+    rec2 = tmp_path / "b"
+    rec2.mkdir()
+    recb, _ = _make_rec(rec2, n=4, max_obj=1, seed=2)
+    it2 = ImageDetIter(batch_size=2, data_shape=(3, 32, 32),
+                       path_imgrec=recb, aug_list=[])
+    it1.sync_label_shape(it2)
+    assert it1.provide_label[0].shape == it2.provide_label[0].shape
+    assert onp.asarray(it2.next().label[0].asnumpy()).shape == (2, 7, 5)
+
+
+def test_draw_next(tmp_path):
+    rec, _ = _make_rec(tmp_path, n=2)
+    it = ImageDetIter(batch_size=2, data_shape=(3, 48, 48),
+                      path_imgrec=rec, aug_list=[])
+    frames = list(it.draw_next(color=255))
+    assert len(frames) == 2 and frames[0].shape == (48, 48, 3)
+    assert (frames[0] == 255).any()     # some box pixels burned in
+
+
+def test_dataset_smaller_than_batch(tmp_path):
+    rec, truth = _make_rec(tmp_path, n=3)
+    it = ImageDetIter(batch_size=8, data_shape=(3, 48, 48),
+                      path_imgrec=rec, aug_list=[])
+    b = it.next()
+    assert b.pad == 5
+    labs = onp.asarray(b.label[0].asnumpy())
+    # wrapped rows repeat the dataset — row 3 == row 0, finite everywhere
+    assert onp.isfinite(onp.asarray(b.data[0].asnumpy())).all()
+    onp.testing.assert_allclose(labs[3], labs[0])
+
+
+def test_missing_idx_raises(tmp_path):
+    (tmp_path / "orphan.rec").write_bytes(b"")
+    with pytest.raises(ValueError, match="idx"):
+        ImageDetIter(batch_size=2, data_shape=(3, 32, 32),
+                     path_imgrec=str(tmp_path / "orphan.rec"),
+                     aug_list=[])
+
+
+def test_imglist_source(tmp_path):
+    from PIL import Image
+
+    rs = onp.random.RandomState(0)
+    img_path = str(tmp_path / "img0.png")
+    Image.fromarray(rs.randint(0, 255, (40, 40, 3), dtype=onp.uint8)
+                    ).save(img_path)
+    lst = tmp_path / "det.lst"
+    lst.write_text(f"0\t2\t5\t1\t0.1\t0.2\t0.6\t0.7\t{img_path}\n")
+    it = ImageDetIter(batch_size=1, data_shape=(3, 40, 40),
+                      path_imglist=str(lst), aug_list=[])
+    lab = onp.asarray(it.next().label[0].asnumpy())[0]
+    onp.testing.assert_allclose(lab[0], [1, 0.1, 0.2, 0.6, 0.7],
+                                rtol=1e-5)

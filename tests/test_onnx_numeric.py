@@ -139,6 +139,23 @@ def test_int8_qdq_round_trip(tmp_path):
     onp.testing.assert_allclose(got, want, rtol=5e-3, atol=5e-3)
 
 
+def test_calibrated_quantize_out_of_range_saturates_like_native(tmp_path):
+    """Inputs OUTSIDE the calib range: native clamps codes to +-127;
+    exported QDQ must pre-clip so QuantizeLinear cannot hit -128."""
+    sym = mx.sym
+    q = sym._contrib_quantize_v2(sym.var("data"), min_calib_range=-1.0,
+                                 max_calib_range=1.0)
+    out = sym._contrib_dequantize(q[0], q[1], q[2])
+    x = onp.asarray([[-2.0, -1.0, 0.5, 3.0]], "f")
+    want = out.eval(data=x)[0].asnumpy()
+    path = str(tmp_path / "satq.onnx")
+    mx.onnx.export_model(out, {}, in_shapes=[(1, 4)],
+                         in_types=[onp.float32], onnx_file_path=path)
+    got = next(iter(onnx_eval.run_model(path, {"data": x}).values()))
+    onp.testing.assert_allclose(got, want, rtol=1e-6, atol=1e-6)
+    onp.testing.assert_allclose(want[0, 0], -1.0, rtol=1e-6)  # saturated
+
+
 @pytest.mark.parametrize("case", range(len(OPS_CASES)))
 def test_op_numeric_round_trip(tmp_path, case):
     build, feeds = OPS_CASES[case]
