@@ -299,6 +299,10 @@ class ImageDetIter:
                     rows.append(([float(v) for v in entry[:-1]]
                                  if not isinstance(entry[0], (list, tuple))
                                  else list(entry[0]), entry[-1]))
+            if num_parts > 1:   # same contiguous sharding as the rec path
+                keep = _np.array_split(_np.arange(len(rows)),
+                                       num_parts)[part_index]
+                rows = [rows[int(j)] for j in keep]
             self._items = [("file", r) for r in rows]
         else:
             raise ValueError("need path_imgrec, path_imglist or imglist")
@@ -321,6 +325,7 @@ class ImageDetIter:
                     f"label_pad_width {label_pad_width} < max objects "
                     f"{max_obj} in the dataset")
             max_obj = label_pad_width
+        self._data_label_shape = (max_obj, obj_w)  # dataset floor
         self._label_shape = (max_obj, obj_w)
 
         c, h, w = self._shape
@@ -437,7 +442,14 @@ class ImageDetIter:
             self.provide_data = [DataDesc(
                 "data", (self.batch_size, c, h, w), self._dtype)]
         if label_shape is not None:
-            self._label_shape = tuple(label_shape)
+            label_shape = tuple(label_shape)
+            floor = getattr(self, "_data_label_shape", (1, 5))
+            if label_shape[0] < floor[0] or label_shape[1] < floor[1]:
+                raise ValueError(
+                    f"label_shape {label_shape} smaller than the "
+                    f"dataset's {floor} — boxes would be silently "
+                    "dropped/truncated")
+            self._label_shape = label_shape
             self.provide_label = [DataDesc(
                 "label", (self.batch_size,) + self._label_shape)]
 

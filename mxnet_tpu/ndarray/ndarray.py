@@ -184,6 +184,83 @@ class NDArray:
         a = self.asnumpy()
         return a.astype(dtype) if dtype is not None else a
 
+    # NEP-13/NEP-18 dispatch (reference:
+    # python/mxnet/numpy_dispatch_protocol.py:1-334): `onp.mean(mx_arr)`
+    # runs the mx.np implementation ON DEVICE and returns an NDArray
+    # instead of silently copying to host through __array__.
+    _NOOP_KWARGS = ("out", "where", "casting", "order", "subok",
+                    "signature")
+
+    @staticmethod
+    def _np_impl(name):
+        from .. import numpy as _mxnp
+
+        fn = getattr(_mxnp, name, None)
+        if fn is None and hasattr(_mxnp, "linalg"):
+            fn = getattr(_mxnp.linalg, name, None)
+        return fn
+
+    @staticmethod
+    def _write_out(result, out):
+        """Land `result` in a caller-supplied out buffer with numpy's
+        shape/dtype contract (no silent reshapes)."""
+        target = out[0] if isinstance(out, tuple) else out
+        rdata = result._data if isinstance(result, NDArray) else result
+        if tuple(rdata.shape) != tuple(target.shape):
+            raise ValueError(
+                f"non-broadcastable output operand with shape "
+                f"{tuple(target.shape)} doesn't match the result shape "
+                f"{tuple(rdata.shape)}")
+        if isinstance(target, NDArray):
+            target._data = rdata.astype(target._data.dtype)
+            target._version += 1
+            return target
+        # plain numpy out: copy device result to host (legacy behavior)
+        _np.copyto(target, _np.asarray(rdata).astype(target.dtype))
+        return target
+
+    def __array_ufunc__(self, ufunc, method, *inputs, **kwargs):
+        if method != "__call__":
+            return NotImplemented
+        out = kwargs.pop("out", None)
+        if out is not None:
+            target = out[0] if isinstance(out, tuple) else out
+            if not isinstance(target, (NDArray, _np.ndarray)):
+                return NotImplemented
+        for k in NDArray._NOOP_KWARGS:
+            if kwargs.get(k) is None:
+                kwargs.pop(k, None)
+        if kwargs and set(kwargs) - {"axis", "dtype"}:
+            return NotImplemented
+        fn = NDArray._np_impl(ufunc.__name__)
+        if fn is None:
+            return NotImplemented
+        result = fn(*inputs, **kwargs)
+        if out is not None:
+            return NDArray._write_out(result, out)
+        return result
+
+    def __array_function__(self, func, types, args, kwargs):
+        if not all(issubclass(t, (NDArray, _np.ndarray)) or
+                   t in (int, float, bool, list, tuple) for t in types):
+            return NotImplemented
+        fn = NDArray._np_impl(func.__name__)
+        if fn is None:
+            return NotImplemented
+        kwargs = dict(kwargs)
+        out = kwargs.pop("out", None)
+        if out is not None and not isinstance(
+                out[0] if isinstance(out, tuple) else out,
+                (NDArray, _np.ndarray)):
+            return NotImplemented
+        for k in NDArray._NOOP_KWARGS:
+            if kwargs.get(k) is None:
+                kwargs.pop(k, None)
+        result = fn(*args, **kwargs)
+        if out is not None:
+            return NDArray._write_out(result, out)
+        return result
+
     def __dlpack__(self, **kwargs):
         return self._data.__dlpack__(**kwargs)
 

@@ -1,0 +1,129 @@
+"""NEP-13/NEP-18 dispatch: numpy functions called on mx arrays run the
+mx.np implementation on device and return NDArrays (reference:
+python/mxnet/numpy_dispatch_protocol.py + its op list test,
+tests/python/unittest/test_numpy_interoperability.py — sampled port)."""
+import numpy as onp
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu.ndarray.ndarray import NDArray
+
+RS = onp.random.RandomState(0)
+
+
+def _arr(*shape):
+    return mx.np.array(RS.rand(*shape).astype("f"))
+
+
+# sampled from the reference's _NUMPY_ARRAY_FUNCTION_LIST
+FUNCTION_CASES = [
+    (onp.mean, lambda a, b: (a,), {}),
+    (onp.mean, lambda a, b: (a,), {"axis": 1}),
+    (onp.sum, lambda a, b: (a,), {"axis": 0}),
+    (onp.std, lambda a, b: (a,), {}),
+    (onp.var, lambda a, b: (a,), {}),
+    (onp.argmax, lambda a, b: (a,), {"axis": 1}),
+    (onp.argmin, lambda a, b: (a,), {}),
+    (onp.concatenate, lambda a, b: ([a, b],), {"axis": 0}),
+    (onp.stack, lambda a, b: ([a, b],), {"axis": 1}),
+    (onp.transpose, lambda a, b: (a,), {}),
+    (onp.reshape, lambda a, b: (a, (-1,)), {}),
+    (onp.clip, lambda a, b: (a, 0.2, 0.8), {}),
+    (onp.dot, lambda a, b: (a, b.T if hasattr(b, "T") else b), {}),
+    (onp.broadcast_to, lambda a, b: (a, (2, 3, 4)), {}),
+    (onp.expand_dims, lambda a, b: (a, 0), {}),
+    (onp.squeeze, lambda a, b: (a[None],), {"axis": 0}),
+    (onp.where, lambda a, b: (a > 0.5, a, b), {}),
+    (onp.maximum, lambda a, b: (a, b), {}),
+    (onp.cumsum, lambda a, b: (a,), {"axis": 1}),
+    (onp.split, lambda a, b: (a, 2), {"axis": 1}),
+    (onp.tile, lambda a, b: (a, (2, 1)), {}),
+    (onp.flip, lambda a, b: (a,), {"axis": 1}),
+]
+
+
+@pytest.mark.parametrize(
+    "func,build,kw", FUNCTION_CASES,
+    ids=[f"{c[0].__name__}-{i}" for i, c in enumerate(FUNCTION_CASES)])
+def test_array_function_dispatch(func, build, kw):
+    a, b = _arr(3, 4), _arr(3, 4)
+    got = func(*build(a, b), **kw)
+    want = func(*build(a.asnumpy(), b.asnumpy()), **kw)
+    if isinstance(got, (list, tuple)):
+        assert all(isinstance(g, NDArray) for g in got)
+        for g, w in zip(got, want):
+            onp.testing.assert_allclose(g.asnumpy(), w, rtol=1e-5,
+                                        atol=1e-6)
+    else:
+        assert isinstance(got, NDArray), type(got)
+        onp.testing.assert_allclose(onp.asarray(got.asnumpy()),
+                                    want, rtol=1e-5, atol=1e-6)
+
+
+UFUNC_CASES = [onp.add, onp.subtract, onp.multiply, onp.divide,
+               onp.negative, onp.exp, onp.log, onp.sqrt, onp.tanh,
+               onp.abs, onp.power, onp.greater, onp.less_equal]
+
+
+@pytest.mark.parametrize("uf", UFUNC_CASES, ids=[u.__name__
+                                                 for u in UFUNC_CASES])
+def test_array_ufunc_dispatch(uf):
+    a = mx.np.array(RS.rand(2, 3).astype("f") + 0.1)
+    b = mx.np.array(RS.rand(2, 3).astype("f") + 0.1)
+    args = (a,) if uf.nin == 1 else (a, b)
+    got = uf(*args)
+    assert isinstance(got, NDArray), type(got)
+    want = uf(*(x.asnumpy() for x in args))
+    onp.testing.assert_allclose(onp.asarray(got.asnumpy()), want,
+                                rtol=1e-5, atol=1e-6)
+
+
+def test_mixed_onp_mx_operands_dispatch():
+    a = _arr(2, 3)
+    b = RS.rand(2, 3).astype("f")
+    got = onp.add(a, b)                     # onp array + mx array
+    assert isinstance(got, NDArray)
+    got2 = onp.add(b, a)
+    assert isinstance(got2, NDArray)
+    onp.testing.assert_allclose(got.asnumpy(), got2.asnumpy())
+
+
+def test_ufunc_out_writes_in_place():
+    a, b = _arr(2, 2), _arr(2, 2)
+    dest = mx.np.zeros((2, 2))
+    v0 = dest._version
+    r = onp.add(a, b, out=dest)
+    assert r is dest and dest._version > v0
+    onp.testing.assert_allclose(dest.asnumpy(),
+                                a.asnumpy() + b.asnumpy(), rtol=1e-6)
+
+
+def test_out_shape_mismatch_raises():
+    a, b = _arr(2, 2), _arr(2, 2)
+    with pytest.raises(ValueError, match="output operand"):
+        onp.add(a, b, out=mx.np.zeros((3, 3)))
+
+
+def test_out_numpy_array_still_works():
+    a, b = _arr(2, 2), _arr(2, 2)
+    dest = onp.empty((2, 2), "f")
+    r = onp.add(a, b, out=dest)
+    assert r is dest
+    onp.testing.assert_allclose(dest, a.asnumpy() + b.asnumpy(),
+                                rtol=1e-6)
+
+
+def test_array_function_out_kwarg():
+    a = _arr(2, 2)
+    dest = mx.np.zeros((2, 2))
+    r = onp.clip(a, 0.2, 0.8, out=dest)
+    assert r is dest
+    onp.testing.assert_allclose(dest.asnumpy(),
+                                onp.clip(a.asnumpy(), 0.2, 0.8),
+                                rtol=1e-6)
+
+
+def test_unsupported_function_falls_back_cleanly():
+    a = _arr(4)
+    with pytest.raises(TypeError):
+        onp.busday_count(a, a)              # no mx.np implementation
