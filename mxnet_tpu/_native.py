@@ -143,6 +143,12 @@ if NATIVE is not None:
 # returned (Engine::Execute runs fn, then Complete runs the deleter).
 _live_op_callbacks = {}
 _op_id_counter = itertools.count(1)  # 0 reserved: NULL ctx maps to it
+# formatted msg -> (exception type, args). Types+args, NOT live exception
+# objects: a live exception pins its traceback frames (and any device
+# arrays the failed op closed over) until eviction. Entries are read
+# without popping so every concurrent waiter on the same failed var
+# rethrows the same type (reference: per-var exception_ptr is shared).
+_py_exc_by_msg = {}
 
 
 @_del_t
@@ -184,6 +190,17 @@ class NativeEngine:
             except Exception as e:  # propagate into engine error path
                 msg = f"{type(e).__name__}: {e}".encode()[:err_len - 1]
                 ctypes.memmove(err_buf, msg + b"\x00", len(msg) + 1)
+                # keep the ORIGINAL python exception type so the wait
+                # point can rethrow the real type, not a stringly
+                # RuntimeError (reference: per-var exception_ptr rethrow)
+                key = msg.decode(errors="replace")
+                _py_exc_by_msg[key] = (type(e), e.args)
+                while len(_py_exc_by_msg) > 64:
+                    try:
+                        _py_exc_by_msg.pop(next(iter(_py_exc_by_msg)),
+                                           None)
+                    except (StopIteration, RuntimeError):
+                        break   # racing eviction on another worker
                 return -1
 
         cb = _fn_t(_run)
@@ -195,14 +212,27 @@ class NativeEngine:
         self._lib.MXTEnginePushAsync(cb, _GLOBAL_OP_DONE, cid, cv, ncv,
                                      mv, nmv, int(priority), 1 if io else 0)
 
+    @staticmethod
+    def _rethrow(msg):
+        entry = _py_exc_by_msg.get(msg)   # no pop: all waiters see it
+        if entry is not None:
+            exc_type, args = entry
+            try:   # construct FIRST: a failed ctor (exotic signature)
+                exc = exc_type(*args)   # must not eat a real TypeError
+            except Exception:
+                exc = None
+            if exc is not None:
+                raise exc
+        raise RuntimeError(msg)
+
     def wait_for_var(self, var):
         if self._lib.MXTEngineWaitForVar(var) != 0:
-            raise RuntimeError(
+            self._rethrow(
                 self._lib.MXTGetLastError().decode(errors="replace"))
 
     def wait_all(self):
         if self._lib.MXTEngineWaitAll() != 0:
-            raise RuntimeError(
+            self._rethrow(
                 self._lib.MXTGetLastError().decode(errors="replace"))
 
     def pending(self):

@@ -277,10 +277,8 @@ class ImageDetIter:
 
             self._rec = (IndexedRecordIO(path_imgidx, path_imgrec)
                          if path_imgidx else IndexedRecordIO(path_imgrec))
-            idx = _np.arange(len(self._rec))
-            if num_parts > 1:
-                idx = _np.array_split(idx, num_parts)[part_index]
-            self._items = [("rec", int(i)) for i in idx]
+            self._items = [("rec", int(i))
+                           for i in _np.arange(len(self._rec))]
         elif path_imglist or imglist is not None:
             import os
 
@@ -299,10 +297,6 @@ class ImageDetIter:
                     rows.append(([float(v) for v in entry[:-1]]
                                  if not isinstance(entry[0], (list, tuple))
                                  else list(entry[0]), entry[-1]))
-            if num_parts > 1:   # same contiguous sharding as the rec path
-                keep = _np.array_split(_np.arange(len(rows)),
-                                       num_parts)[part_index]
-                rows = [rows[int(j)] for j in keep]
             self._items = [("file", r) for r in rows]
         else:
             raise ValueError("need path_imgrec, path_imglist or imglist")
@@ -312,13 +306,23 @@ class ImageDetIter:
                 ".idx sidecar must exist (pass path_imgidx or write with "
                 "MXIndexedRecordIO/im2rec)")
 
-        # scan labels once for the fixed label block shape (reference:
-        # ImageDetIter estimates label_shape from the data)
+        # scan labels over the FULL dataset for the fixed label block
+        # shape, BEFORE sharding — every num_parts worker must build the
+        # same provide_label or distributed collectives mismatch
+        # (reference: ImageDetIter estimates label_shape from the data)
         max_obj, obj_w = 1, 5
         for it in self._items:
             lab = self._read_label(it)
             max_obj = max(max_obj, lab.shape[0])
             obj_w = max(obj_w, lab.shape[1])
+        if num_parts > 1:
+            keep = _np.array_split(_np.arange(len(self._items)),
+                                   num_parts)[part_index]
+            self._items = [self._items[int(j)] for j in keep]
+            if not self._items:
+                raise ValueError(
+                    f"part {part_index}/{num_parts} of a "
+                    "dataset this small is empty")
         if label_pad_width > 0:
             if label_pad_width < max_obj:
                 raise ValueError(

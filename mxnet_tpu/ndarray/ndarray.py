@@ -214,6 +214,10 @@ class NDArray:
         if isinstance(target, NDArray):
             target._data = rdata.astype(target._data.dtype)
             target._version += 1
+            # an out= write must stay on the autograd tape exactly like
+            # the expression it landed (cf. _assign_from)
+            target._tape_entry = result._tape_entry \
+                if isinstance(result, NDArray) else None
             return target
         # plain numpy out: copy device result to host (legacy behavior)
         _np.copyto(target, _np.asarray(rdata).astype(target.dtype))
@@ -230,12 +234,15 @@ class NDArray:
         for k in NDArray._NOOP_KWARGS:
             if kwargs.get(k) is None:
                 kwargs.pop(k, None)
-        if kwargs and set(kwargs) - {"axis", "dtype"}:
+        dtype = kwargs.pop("dtype", None)
+        if kwargs and set(kwargs) - {"axis"}:
             return NotImplemented
         fn = NDArray._np_impl(ufunc.__name__)
         if fn is None:
             return NotImplemented
         result = fn(*inputs, **kwargs)
+        if dtype is not None and isinstance(result, NDArray):
+            result = result.astype(dtype)   # jnp ufuncs take no dtype=
         if out is not None:
             return NDArray._write_out(result, out)
         return result

@@ -110,9 +110,12 @@ class _CustomFunction(ag.Function):
         from . import numpy as mxnp
 
         in_shapes = [list(i.shape) for i in inputs]
-        _, out_shapes, _ = self._prop.infer_shape(in_shapes)
+        ret = self._prop.infer_shape(in_shapes)
+        out_shapes = ret[1]          # (in, out[, aux]) — aux optional,
+        #                              matching the reference's 2-or-3 form
         in_types = [i.dtype for i in inputs]
-        _, out_types, _ = self._prop.infer_type(in_types)
+        rett = self._prop.infer_type(in_types)
+        out_types = rett[1]
         outs = [mxnp.zeros(tuple(s), dtype=t)
                 for s, t in zip(out_shapes, out_types)]
         self._op.forward(is_train=ag.is_training(),

@@ -22,6 +22,30 @@ jax.config.update("jax_platforms", "cpu")
 import pytest  # noqa: E402
 
 
+# Quick-smoke subset (reference: pytest.ini marker families). The modules
+# below together run in well under 3 minutes on the 1-core CPU box:
+#   python -m pytest tests/ -m smoke -q
+_SMOKE_MODULES = {
+    "test_ndarray", "test_autograd", "test_native", "test_exc_handling",
+    "test_np_dispatch", "test_image_record", "test_image_det_iter",
+    "test_sparse_optimizer", "test_symbol", "test_symbol_register",
+    "test_io_estimator", "test_custom_op", "test_resource",
+    "test_op_aliases",
+}
+
+
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers", "smoke: fast subset (<3 min) for iteration — "
+                   "see conftest._SMOKE_MODULES")
+
+
+def pytest_collection_modifyitems(config, items):  # noqa: ARG001
+    for item in items:
+        if item.module.__name__.rsplit(".", 1)[-1] in _SMOKE_MODULES:
+            item.add_marker(pytest.mark.smoke)
+
+
 @pytest.fixture(autouse=True)
 def seed_rng():
     """Seed all framework RNGs per test (reference: module_scope_seed)."""

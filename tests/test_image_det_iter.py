@@ -128,6 +128,19 @@ def test_missing_idx_raises(tmp_path):
                      aug_list=[])
 
 
+def test_label_shape_consistent_across_parts(tmp_path):
+    """Workers sharding one dataset must agree on provide_label even when
+    the busiest image lands in only one shard."""
+    rec, _ = _make_rec(tmp_path, n=8, max_obj=3)
+    shapes = set()
+    for part in range(2):
+        it = ImageDetIter(batch_size=2, data_shape=(3, 32, 32),
+                          path_imgrec=rec, aug_list=[], num_parts=2,
+                          part_index=part)
+        shapes.add(it.provide_label[0].shape)
+    assert len(shapes) == 1
+
+
 def test_imglist_source(tmp_path):
     from PIL import Image
 

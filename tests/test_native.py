@@ -87,7 +87,7 @@ class TestEngine:
             raise ValueError("deliberate failure")
 
         eng.push(boom, mutable_vars=[v])
-        with pytest.raises(RuntimeError, match="deliberate failure"):
+        with pytest.raises(ValueError, match="deliberate failure"):
             eng.wait_for_var(v)
 
     def test_waitall_raises_global_exception(self):

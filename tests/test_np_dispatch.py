@@ -123,6 +123,28 @@ def test_array_function_out_kwarg():
                                 rtol=1e-6)
 
 
+def test_out_result_stays_on_autograd_tape():
+    from mxnet_tpu import autograd
+
+    x = mx.np.array(onp.array([1.0, 2.0, 3.0], "f"))
+    x.attach_grad()
+    dest = mx.np.zeros((3,))
+    with autograd.record():
+        onp.multiply(x, x, out=dest)
+        loss = dest.sum()
+    loss.backward()
+    onp.testing.assert_allclose(x.grad.asnumpy(), [2.0, 4.0, 6.0],
+                                rtol=1e-6)
+
+
+def test_ufunc_dtype_kwarg():
+    a = mx.np.array(onp.array([1.5, 2.5], "f"))
+    r = onp.add(a, a, dtype=onp.float64)
+    # jax may truncate float64 to float32 without x64 mode; the call must
+    # not crash and values must be right
+    onp.testing.assert_allclose(onp.asarray(r.asnumpy(), "f"), [3.0, 5.0])
+
+
 def test_unsupported_function_falls_back_cleanly():
     a = _arr(4)
     with pytest.raises(TypeError):
