@@ -1,0 +1,288 @@
+"""Generate typed C++ wrappers for every registered operator.
+
+Reference analog: cpp-package/scripts/OpWrapperGenerator.py, which walked
+the NNVM registry and emitted mxnet-cpp/op.h. Here the source of truth is
+mxnet_tpu.ops.registry and the transport is the packed-function FFI
+(py_runtime.hpp PyRuntime::invoke) — each generated function marshals its
+tensor inputs and a JSON attr dict through the ONE packed entry point.
+
+Signature mapping (from inspect.signature of the registered pure fn):
+  no default                      -> const PackedTensor&         (input)
+  default None, known tensor name -> const PackedTensor* = nullptr
+  default None, otherwise         -> const char* <name>_json = nullptr
+                                     (raw JSON escape hatch: "3", "[2,2]")
+  bool / int / float / str        -> bool / long long / double / string
+  tuple/list of ints (floats)     -> std::vector<long long> (double)
+  *args                           -> const std::vector<PackedTensor>&
+  **kwargs                        -> const std::string& extra_attrs = ""
+
+Run:  python cpp-package/scripts/op_wrapper_generator.py
+Emits cpp-package/include/mxtpu/op.h (checked in, like the reference's
+generated header; regenerate when the registry grows).
+"""
+from __future__ import annotations
+
+import inspect
+import keyword
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                "..", ".."))
+
+CPP_KEYWORDS = {
+    "and", "or", "not", "xor", "bitand", "bitor", "compl", "new", "delete",
+    "this", "class", "struct", "template", "typename", "operator", "union",
+    "register", "default", "switch", "case", "int", "float", "double",
+    "bool", "char", "short", "long", "signed", "unsigned", "void", "const",
+    "true", "false", "auto", "namespace", "using", "export", "inline",
+}
+
+# None-default params that are OPTIONAL TENSORS, not attrs
+TENSOR_NAMES = {
+    "bias", "gamma", "beta", "moving_mean", "moving_var", "label", "grid",
+    "rois", "min_bias", "max_bias", "state", "state_cell", "aux_states",
+    "weight", "mean", "var", "mhs",
+}
+
+
+def _ident(name):
+    if name in CPP_KEYWORDS or keyword.iskeyword(name):
+        return name + "_"
+    return name
+
+
+def _cpp_default(v):
+    if isinstance(v, bool):
+        return "true" if v else "false"
+    if isinstance(v, int):
+        return str(v)
+    if isinstance(v, float):
+        r = repr(v)
+        return r if ("." in r or "e" in r or "inf" in r) else r + ".0"
+    if isinstance(v, str):
+        return '"' + v.replace('"', '\\"') + '"'
+    if isinstance(v, (tuple, list)):
+        return "{" + ", ".join(_cpp_default(x) for x in v) + "}"
+    raise TypeError(str(type(v)))
+
+
+def classify(op_name, fn):
+    try:
+        params = list(inspect.signature(fn).parameters.values())
+    except (ValueError, TypeError):
+        return None
+    tensors, opt_tensors, attrs = [], [], []
+    varargs = False
+    kwargs = False
+    for p in params:
+        if p.kind == inspect.Parameter.VAR_POSITIONAL:
+            varargs = True
+        elif p.kind == inspect.Parameter.VAR_KEYWORD:
+            kwargs = True
+        elif p.default is inspect.Parameter.empty:
+            tensors.append(p.name)
+        elif p.default is None:
+            if p.name in TENSOR_NAMES:
+                opt_tensors.append(p.name)
+            else:
+                attrs.append((p.name, "json", None))
+        elif isinstance(p.default, bool):
+            attrs.append((p.name, "bool", p.default))
+        elif isinstance(p.default, int):
+            attrs.append((p.name, "int", p.default))
+        elif isinstance(p.default, float):
+            attrs.append((p.name, "float", p.default))
+        elif isinstance(p.default, str):
+            attrs.append((p.name, "str", p.default))
+        elif isinstance(p.default, (tuple, list)):
+            if all(isinstance(x, (int, bool)) for x in p.default):
+                attrs.append((p.name, "ivec", tuple(p.default)))
+            elif all(isinstance(x, (int, float)) for x in p.default):
+                attrs.append((p.name, "fvec", tuple(p.default)))
+            else:
+                attrs.append((p.name, "json", None))
+        else:
+            attrs.append((p.name, "json", None))
+    return dict(op=op_name, tensors=tensors, opt_tensors=opt_tensors,
+                attrs=attrs, varargs=varargs, kwargs=kwargs)
+
+
+_CPP_TYPES = {
+    "bool": "bool", "int": "long long", "float": "double",
+    "str": "const std::string&", "ivec": "const std::vector<long long>&",
+    "fvec": "const std::vector<double>&",
+    "json": "const char*",
+}
+
+
+def emit_fn(spec):
+    name = _ident(spec["op"])
+    args = ["PyRuntime& rt"]
+    if spec["varargs"]:
+        args.append("const std::vector<PackedTensor>& inputs")
+    args += [f"const PackedTensor& {_ident(t)}" for t in spec["tensors"]]
+    args += [f"const PackedTensor* {_ident(t)} = nullptr"
+             for t in spec["opt_tensors"]]
+    for aname, kind, default in spec["attrs"]:
+        if kind == "json":
+            args.append(f"const char* {_ident(aname)}_json = nullptr")
+        else:
+            args.append(f"{_CPP_TYPES[kind]} {_ident(aname)} = "
+                        f"{_cpp_default(default)}")
+    if spec["kwargs"]:
+        args.append('const std::string& extra_attrs = ""')
+
+    body = []
+    if spec["varargs"]:
+        body.append("  std::vector<PackedTensor> ins_(inputs);")
+    else:
+        body.append("  std::vector<PackedTensor> ins_;")
+    for t in spec["tensors"]:
+        body.append(f"  ins_.push_back({_ident(t)});")
+    for t in spec["opt_tensors"]:
+        body.append(f"  if ({_ident(t)}) ins_.push_back(*{_ident(t)});")
+    body.append("  detail::JsonBuilder a_;")
+    for aname, kind, _ in spec["attrs"]:
+        ident = _ident(aname)
+        if kind == "json":
+            body.append(f"  if ({ident}_json) a_.raw(\"{aname}\", "
+                        f"{ident}_json);")
+        elif kind == "bool":
+            body.append(f"  a_.put_bool(\"{aname}\", {ident});")
+        elif kind == "int":
+            body.append(f"  a_.put_int(\"{aname}\", {ident});")
+        elif kind == "float":
+            body.append(f"  a_.put_num(\"{aname}\", {ident});")
+        elif kind == "str":
+            body.append(f"  a_.put_str(\"{aname}\", {ident});")
+        elif kind == "ivec":
+            body.append(f"  a_.put_ivec(\"{aname}\", {ident});")
+        elif kind == "fvec":
+            body.append(f"  a_.put_fvec(\"{aname}\", {ident});")
+    tail = "a_.str()"
+    if spec["kwargs"]:
+        tail = "detail::merge(a_.str(), extra_attrs)"
+    body.append(f"  return rt.invoke(\"{spec['op']}\", ins_, {tail});")
+
+    return (f"inline std::vector<PackedTensor> {name}(\n    "
+            + ",\n    ".join(args) + ") {\n" + "\n".join(body) + "\n}\n")
+
+
+PROLOGUE = r"""// op.h — GENERATED per-op C++ wrappers over the packed FFI.
+// Regenerate: python cpp-package/scripts/op_wrapper_generator.py
+// (reference analog: cpp-package/scripts/OpWrapperGenerator.py ->
+//  mxnet-cpp/op.h). Do not edit by hand.
+#pragma once
+
+#include <sstream>
+#include <string>
+#include <vector>
+
+#include "py_runtime.hpp"
+
+namespace mxtpu {
+namespace op {
+namespace detail {
+
+class JsonBuilder {
+ public:
+  void put_bool(const std::string& k, bool v) {
+    add(k, v ? "true" : "false");
+  }
+  void put_int(const std::string& k, long long v) {
+    add(k, std::to_string(v));
+  }
+  void put_num(const std::string& k, double v) {
+    std::ostringstream os;
+    os.precision(17);
+    os << v;
+    add(k, os.str());
+  }
+  void put_str(const std::string& k, const std::string& v) {
+    std::string e;
+    for (char c : v) {
+      if (c == '"' || c == '\\') e += '\\';
+      e += c;
+    }
+    add(k, "\"" + e + "\"");
+  }
+  void put_ivec(const std::string& k, const std::vector<long long>& v) {
+    std::string s = "[";
+    for (size_t i = 0; i < v.size(); ++i) {
+      if (i) s += ", ";
+      s += std::to_string(v[i]);
+    }
+    add(k, s + "]");
+  }
+  void put_fvec(const std::string& k, const std::vector<double>& v) {
+    std::string s = "[";
+    for (size_t i = 0; i < v.size(); ++i) {
+      if (i) s += ", ";
+      std::ostringstream os;
+      os.precision(17);
+      os << v[i];
+      s += os.str();
+    }
+    add(k, s + "]");
+  }
+  void raw(const std::string& k, const std::string& json) { add(k, json); }
+  std::string str() const { return "{" + body_ + "}"; }
+
+ private:
+  void add(const std::string& k, const std::string& v) {
+    if (!body_.empty()) body_ += ", ";
+    body_ += "\"" + k + "\": " + v;
+  }
+  std::string body_;
+};
+
+inline std::string merge(const std::string& a, const std::string& b) {
+  // shallow-merge two JSON objects emitted by JsonBuilder
+  if (b.empty() || b == "{}") return a;
+  if (a == "{}") return b;
+  return a.substr(0, a.size() - 1) + ", " + b.substr(1);
+}
+
+}  // namespace detail
+
+"""
+
+EPILOGUE = """
+}  // namespace op
+}  // namespace mxtpu
+"""
+
+
+def main():
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    import mxnet_tpu  # noqa: F401 — populates the registry
+    from mxnet_tpu.ops import registry
+    from mxnet_tpu.symbol import register as symreg
+
+    symreg._generate()   # pull in late-registered families
+
+    out = [PROLOGUE]
+    emitted = skipped = 0
+    seen_cpp = set()
+    for op_name in registry.list_ops():
+        spec = classify(op_name, registry.get_op(op_name))
+        cpp_name = _ident(op_name)
+        if spec is None or cpp_name in seen_cpp:
+            skipped += 1
+            continue
+        seen_cpp.add(cpp_name)
+        out.append(emit_fn(spec))
+        emitted += 1
+    out.append(EPILOGUE)
+    path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        "..", "include", "mxtpu", "op.h")
+    with open(path, "w") as f:
+        f.write("\n".join(out))
+    print(f"emitted {emitted} wrappers ({skipped} skipped) -> {path}")
+
+
+if __name__ == "__main__":
+    main()

@@ -146,8 +146,10 @@ _op_id_counter = itertools.count(1)  # 0 reserved: NULL ctx maps to it
 # formatted msg -> (exception type, args). Types+args, NOT live exception
 # objects: a live exception pins its traceback frames (and any device
 # arrays the failed op closed over) until eviction. Entries are read
-# without popping so every concurrent waiter on the same failed var
-# rethrows the same type (reference: per-var exception_ptr is shared).
+# without popping so repeated failures with the same message keep mapping
+# to the right type. NOTE: the native var clears its exception when the
+# first wait consumes it (mxtpu_runtime.cc WaitForVar), so exactly one
+# waiter observes a given failure — the reference's consume-on-throw.
 _py_exc_by_msg = {}
 
 

@@ -306,12 +306,16 @@ class ImageDetIter:
                 ".idx sidecar must exist (pass path_imgidx or write with "
                 "MXIndexedRecordIO/im2rec)")
 
-        # scan labels over the FULL dataset for the fixed label block
-        # shape, BEFORE sharding — every num_parts worker must build the
-        # same provide_label or distributed collectives mismatch
-        # (reference: ImageDetIter estimates label_shape from the data)
+        # label block shape, decided BEFORE sharding — every num_parts
+        # worker must build the same provide_label or distributed
+        # collectives mismatch. With label_pad_width the contract is
+        # explicit and only the first record is probed for obj width;
+        # otherwise a full scan is required (reference: ImageDetIter
+        # estimates label_shape from the data). For multi-worker jobs on
+        # large .rec files, pass label_pad_width to skip the scan.
         max_obj, obj_w = 1, 5
-        for it in self._items:
+        scan = (self._items[:1] if label_pad_width > 0 else self._items)
+        for it in scan:
             lab = self._read_label(it)
             max_obj = max(max_obj, lab.shape[0])
             obj_w = max(obj_w, lab.shape[1])
@@ -428,7 +432,13 @@ class ImageDetIter:
                 # normalized, so a pure resize leaves them untouched)
                 arr = _as_np(imresize(NDArray(arr), w, h))
             datas[j] = arr.transpose(2, 0, 1).astype(self._dtype)
-            n = min(label.shape[0], self._label_shape[0])
+            if label.shape[0] > self._label_shape[0]:
+                raise ValueError(
+                    f"record has {label.shape[0]} objects but the label "
+                    f"block holds {self._label_shape[0]} — raise "
+                    "label_pad_width (boxes must never be silently "
+                    "dropped)")
+            n = label.shape[0]
             labels[j, :n, :label.shape[1]] = label[:n]
         return DataBatch([mnp.array(datas)], [mnp.array(labels)], pad=pad,
                          provide_data=self.provide_data,

@@ -212,7 +212,14 @@ class NDArray:
                 f"{tuple(target.shape)} doesn't match the result shape "
                 f"{tuple(rdata.shape)}")
         if isinstance(target, NDArray):
-            target._data = rdata.astype(target._data.dtype)
+            if isinstance(result, NDArray) and \
+                    result.dtype != target.dtype:
+                # cast THROUGH the tape so the stored data and the taped
+                # vjp node agree on dtype (else backward's cotangent
+                # dtype mismatches)
+                result = result.astype(target.dtype)
+            target._data = result._data if isinstance(result, NDArray) \
+                else rdata.astype(target._data.dtype)
             target._version += 1
             # an out= write must stay on the autograd tape exactly like
             # the expression it landed (cf. _assign_from)
