@@ -66,21 +66,27 @@ class _RNNLayer(HybridBlock):
                  dropout=0, bidirectional=False, input_size=0,
                  i2h_weight_initializer=None, h2h_weight_initializer=None,
                  i2h_bias_initializer="zeros", h2h_bias_initializer="zeros",
+                 projection_size=None, h2r_weight_initializer=None,
                  **kwargs):  # noqa: ARG002
         super().__init__()
         assert layout in ("TNC", "NTC")
+        if projection_size and mode != "lstm":
+            raise ValueError("projection_size is LSTM-only (LSTMP, "
+                             "reference: rnn_layer.py projection_size)")
         self._mode = mode
         self._hidden = hidden_size
         self._layers = num_layers
         self._layout = layout
         self._dropout = dropout
         self._dir = 2 if bidirectional else 1
+        self._proj = projection_size or 0
         self._gates = {"lstm": 4, "gru": 3}.get(mode, 1)
         ng, nh = self._gates, hidden_size
+        nr = self._proj or nh          # recurrent (projected) width
         for layer in range(num_layers):
             for d in range(self._dir):
                 sfx = f"l{layer}" + ("_r" if d else "")
-                in_size = input_size if layer == 0 else nh * self._dir
+                in_size = input_size if layer == 0 else nr * self._dir
                 self.register_parameter(
                     f"{sfx}_i2h_weight",
                     Parameter(f"{sfx}_i2h_weight", shape=(ng * nh, in_size),
@@ -88,9 +94,17 @@ class _RNNLayer(HybridBlock):
                               allow_deferred_init=True))
                 self.register_parameter(
                     f"{sfx}_h2h_weight",
-                    Parameter(f"{sfx}_h2h_weight", shape=(ng * nh, nh),
+                    Parameter(f"{sfx}_h2h_weight", shape=(ng * nh, nr),
                               init=h2h_weight_initializer,
                               allow_deferred_init=True))
+                if self._proj:
+                    # LSTMP recurrent projection (reference:
+                    # src/operator/rnn.cc projection_size / cuDNN LSTMP)
+                    self.register_parameter(
+                        f"{sfx}_h2r_weight",
+                        Parameter(f"{sfx}_h2r_weight",
+                                  shape=(self._proj, nh),
+                                  init=h2r_weight_initializer))
                 self.register_parameter(
                     f"{sfx}_i2h_bias",
                     Parameter(f"{sfx}_i2h_bias", shape=(ng * nh,),
@@ -103,7 +117,7 @@ class _RNNLayer(HybridBlock):
     def _defer(self, in_size):
         ng, nh = self._gates, self._hidden
         for layer in range(self._layers):
-            lin = in_size if layer == 0 else nh * self._dir
+            lin = in_size if layer == 0 else (self._proj or nh) * self._dir
             for d in range(self._dir):
                 sfx = f"l{layer}" + ("_r" if d else "")
                 p = self._reg_params[f"{sfx}_i2h_weight"]
@@ -111,17 +125,18 @@ class _RNNLayer(HybridBlock):
                     p._finish_deferred_init((ng * nh, lin))
 
     def state_info(self, batch_size=0):
-        shape = (self._layers * self._dir, batch_size, self._hidden)
+        h_shape = (self._layers * self._dir, batch_size,
+                   self._proj or self._hidden)
         if self._mode == "lstm":
-            return [{"shape": shape}, {"shape": shape}]
-        return [{"shape": shape}]
+            c_shape = (self._layers * self._dir, batch_size, self._hidden)
+            return [{"shape": h_shape}, {"shape": c_shape}]
+        return [{"shape": h_shape}]
 
     def begin_state(self, batch_size=0, func=None, **kwargs):  # noqa: ARG002
         from ... import numpy as mnp
 
-        n = 2 if self._mode == "lstm" else 1
-        return [mnp.zeros((self._layers * self._dir, batch_size,
-                           self._hidden)) for _ in range(n)]
+        return [mnp.zeros(info["shape"])
+                for info in self.state_info(batch_size)]
 
     def forward(self, x, states=None):
         self._defer(x.shape[-1])
@@ -137,6 +152,8 @@ class _RNNLayer(HybridBlock):
         layout, dropout = self._layout, self._dropout
         step = _rnn_step(mode)
         params = []
+        nproj = self._proj
+        per = 5 if nproj else 4
         for layer in range(layers):
             for d in range(ndir):
                 sfx = f"l{layer}" + ("_r" if d else "")
@@ -146,6 +163,9 @@ class _RNNLayer(HybridBlock):
                     self._reg_params[f"{sfx}_i2h_bias"].data_for(x),
                     self._reg_params[f"{sfx}_h2h_bias"].data_for(x),
                 ])
+                if nproj:
+                    params.append(
+                        self._reg_params[f"{sfx}_h2r_weight"].data_for(x))
 
         def fused(x_, *flat):
         # flat: states (1 or 2) then params
@@ -160,13 +180,23 @@ class _RNNLayer(HybridBlock):
                 outs = []
                 for d in range(ndir):
                     wi, wh, bi, bh = ps[idx : idx + 4]
-                    idx += 4
+                    wr = ps[idx + 4] if per == 5 else None
+                    idx += per
                     sl = layer * ndir + d
                     carry = tuple(s[sl] for s in st)
                     xs = inp if d == 0 else jnp.flip(inp, 0)
 
-                    def f(c, xt, wi=wi, wh=wh, bi=bi, bh=bh):
-                        return step(c, xt, wi, wh, bi, bh)
+                    if wr is None:
+                        def f(c, xt, wi=wi, wh=wh, bi=bi, bh=bh):
+                            return step(c, xt, wi, wh, bi, bh)
+                    else:
+                        # LSTMP: project the hidden state before it
+                        # recurs (h carries size P, c stays size H)
+                        def f(c, xt, wi=wi, wh=wh, bi=bi, bh=bh, wr=wr):
+                            (h_new, c_new), _ = step(c, xt, wi, wh, bi,
+                                                     bh)
+                            h_p = h_new @ wr.T
+                            return (h_p, c_new), h_p
 
                     final, ys = jax.lax.scan(f, carry, xs)
                     if d == 1:

@@ -1,0 +1,158 @@
+"""Fused RNN/LSTM/GRU layers (reference: gluon/rnn/rnn_layer.py over
+src/operator/rnn.cc) — shapes, numeric oracle, bidirectional, layouts,
+state round-trip, gradients, and LSTM projection (LSTMP)."""
+import numpy as onp
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import autograd, gluon
+from mxnet_tpu.gluon import rnn
+
+T, N, I, H = 5, 3, 4, 6
+
+
+def _x(layout="TNC", seed=0):
+    rs = onp.random.RandomState(seed)
+    shape = (T, N, I) if layout == "TNC" else (N, T, I)
+    return mx.np.array(rs.randn(*shape).astype("f") * 0.5)
+
+
+@pytest.mark.parametrize("cls,n_states", [(rnn.RNN, 1), (rnn.LSTM, 2),
+                                          (rnn.GRU, 1)])
+def test_forward_shapes_and_states(cls, n_states):
+    net = cls(H, num_layers=2)
+    net.initialize()
+    x = _x()
+    out = net(x)
+    assert out.shape == (T, N, H)
+    states = net.begin_state(batch_size=N)
+    out2, new_states = net(x, states)
+    assert out2.shape == (T, N, H)
+    new_states = new_states if isinstance(new_states, list) else [new_states]
+    assert len(new_states) == n_states
+    assert new_states[0].shape == (2, N, H)
+
+
+def test_lstm_numeric_oracle():
+    """Single-layer LSTM vs a hand-rolled numpy step loop using the
+    reference [i, f, g, o] gate layout."""
+    net = rnn.LSTM(H)
+    net.initialize()
+    x = _x(seed=1)
+    out = net(x).asnumpy()
+
+    p = {k: v.data().asnumpy() for k, v in net.collect_params().items()}
+    wi, wh = p["l0_i2h_weight"], p["l0_h2h_weight"]
+    bi, bh = p["l0_i2h_bias"], p["l0_h2h_bias"]
+    h = onp.zeros((N, H), "f")
+    c = onp.zeros((N, H), "f")
+    xs = x.asnumpy()
+
+    def sig(v):
+        return 1.0 / (1.0 + onp.exp(-v))
+
+    want = []
+    for t in range(T):
+        g = xs[t] @ wi.T + bi + h @ wh.T + bh
+        i_, f_, g_, o_ = onp.split(g, 4, axis=-1)
+        c = sig(f_) * c + sig(i_) * onp.tanh(g_)
+        h = sig(o_) * onp.tanh(c)
+        want.append(h)
+    onp.testing.assert_allclose(out, onp.stack(want), rtol=1e-4,
+                                atol=1e-5)
+
+
+def test_bidirectional_concat():
+    net = rnn.GRU(H, bidirectional=True)
+    net.initialize()
+    out = net(_x())
+    assert out.shape == (T, N, 2 * H)
+
+
+def test_ntc_layout():
+    net = rnn.LSTM(H, layout="NTC")
+    net.initialize()
+    out = net(_x("NTC"))
+    assert out.shape == (N, T, H)
+
+
+def test_gradients_flow():
+    net = rnn.LSTM(H, num_layers=2, bidirectional=True)
+    net.initialize()
+    x = _x()
+    with autograd.record():
+        loss = (net(x) ** 2).sum()
+    loss.backward()
+    g = net.collect_params()["l1_r_i2h_weight"].grad()
+    assert float(onp.abs(g.asnumpy()).sum()) > 0
+
+
+def test_lstmp_projection_shapes_and_recurrence():
+    """LSTMP (projection_size): h recurs at size P, c stays H, output is
+    P-wide (reference: rnn.cc projection_size / cuDNN LSTMP)."""
+    P = 3
+    net = rnn.LSTM(H, num_layers=2, projection_size=P)
+    net.initialize()
+    x = _x(seed=2)
+    out = net(x)
+    assert out.shape == (T, N, P)
+    h0, c0 = net.begin_state(batch_size=N)
+    assert h0.shape == (2, N, P) and c0.shape == (2, N, H)
+    out2, (h1, c1) = net(x, [h0, c0])
+    assert h1.shape == (2, N, P) and c1.shape == (2, N, H)
+    # weights: h2h consumes the projected width, h2r projects H -> P
+    params = net.collect_params()
+    assert params["l0_h2h_weight"].shape == (4 * H, P)
+    assert params["l0_h2r_weight"].shape == (P, H)
+
+
+def test_lstmp_numeric_oracle():
+    P = 3
+    net = rnn.LSTM(H, projection_size=P)
+    net.initialize()
+    x = _x(seed=3)
+    out = net(x).asnumpy()
+    p = {k: v.data().asnumpy() for k, v in net.collect_params().items()}
+    wi, wh = p["l0_i2h_weight"], p["l0_h2h_weight"]
+    bi, bh = p["l0_i2h_bias"], p["l0_h2h_bias"]
+    wr = p["l0_h2r_weight"]
+    h = onp.zeros((N, P), "f")
+    c = onp.zeros((N, H), "f")
+    xs = x.asnumpy()
+
+    def sig(v):
+        return 1.0 / (1.0 + onp.exp(-v))
+
+    want = []
+    for t in range(T):
+        g = xs[t] @ wi.T + bi + h @ wh.T + bh
+        i_, f_, g_, o_ = onp.split(g, 4, axis=-1)
+        c = sig(f_) * c + sig(i_) * onp.tanh(g_)
+        h = (sig(o_) * onp.tanh(c)) @ wr.T
+        want.append(h)
+    onp.testing.assert_allclose(out, onp.stack(want), rtol=1e-4,
+                                atol=1e-5)
+
+
+def test_projection_rejected_for_non_lstm():
+    with pytest.raises(ValueError, match="LSTM-only"):
+        rnn.GRU(H, projection_size=3)
+
+
+def test_lstmp_trains():
+    net = gluon.nn.Sequential()
+    net.add(rnn.LSTM(H, projection_size=3), gluon.nn.Dense(2))
+    net.initialize()
+    tr = gluon.Trainer(net.collect_params(), "adam",
+                       {"learning_rate": 0.01})
+    lf = gluon.loss.L2Loss()
+    x = _x(seed=4)
+    y = mx.np.array(onp.random.RandomState(5).randn(T, 2).astype("f"))
+    losses = []
+    for _ in range(12):
+        with autograd.record():
+            loss = lf(net(x), y)
+        loss.backward()
+        tr.step(N)
+        losses.append(float(loss.mean()))
+    assert losses[-1] < losses[0]
