@@ -154,6 +154,16 @@ class _RNNLayer(HybridBlock):
         params = []
         nproj = self._proj
         per = 5 if nproj else 4
+        # inter-layer dropout (reference: rnn.cc dropout between stacked
+        # layers, train-mode only); keys generated per call so each step
+        # draws fresh masks
+        from ... import _random
+        from ...autograd import is_training
+
+        drop_keys = []
+        if dropout and layers > 1 and is_training():
+            drop_keys = [_random.next_key() for _ in range(layers - 1)]
+        n_params = layers * ndir * per
         for layer in range(layers):
             for d in range(ndir):
                 sfx = f"l{layer}" + ("_r" if d else "")
@@ -168,10 +178,11 @@ class _RNNLayer(HybridBlock):
                         self._reg_params[f"{sfx}_h2r_weight"].data_for(x))
 
         def fused(x_, *flat):
-        # flat: states (1 or 2) then params
+            # flat: states (1 or 2), params, then dropout keys
             n_states = 2 if mode == "lstm" else 1
             st = flat[:n_states]
-            ps = flat[n_states:]
+            ps = flat[n_states:n_states + n_params]
+            keys = flat[n_states + n_params:]
             seq = x_ if layout == "TNC" else jnp.swapaxes(x_, 0, 1)
             out_states = []
             inp = seq
@@ -204,15 +215,17 @@ class _RNNLayer(HybridBlock):
                     outs.append(ys)
                     out_states.append(final)
                 inp = outs[0] if ndir == 1 else jnp.concatenate(outs, -1)
-                if dropout and layer != layers - 1:
-                    pass  # dropout between layers is applied by caller design
+                if keys and layer != layers - 1:
+                    keep = jax.random.bernoulli(keys[layer], 1.0 - dropout,
+                                                inp.shape)
+                    inp = jnp.where(keep, inp / (1.0 - dropout), 0.0)
             out = inp if layout == "TNC" else jnp.swapaxes(inp, 0, 1)
             new_states = []
             for si in range(n_states):
                 new_states.append(jnp.stack([s[si] for s in out_states]))
             return (out, *new_states)
 
-        result = apply_op(fused, x, *states, *params,
+        result = apply_op(fused, x, *states, *params, *drop_keys,
                           name=f"RNN({mode})")
         out, new_states = result[0], list(result[1:])
         if return_states:

@@ -423,6 +423,14 @@ class NDArray:
     def prod(self, axis=None, keepdims=False):
         return apply_op(lambda x: jnp.prod(x, axis=axis, keepdims=keepdims), self)
 
+    def any(self, axis=None, keepdims=False):
+        return apply_op(lambda x: jnp.any(x, axis=axis, keepdims=keepdims),
+                        self)
+
+    def all(self, axis=None, keepdims=False):
+        return apply_op(lambda x: jnp.all(x, axis=axis, keepdims=keepdims),
+                        self)
+
     def argmax(self, axis=None):
         return apply_op(lambda x: jnp.argmax(x, axis=axis), self)
 

@@ -38,6 +38,8 @@ def pytest_configure(config):
     config.addinivalue_line(
         "markers", "smoke: fast subset (<3 min) for iteration — "
                    "see conftest._SMOKE_MODULES")
+    config.addinivalue_line(
+        "markers", "slow: heavyweight tests (large-tensor sweeps)")
 
 
 def pytest_collection_modifyitems(config, items):  # noqa: ARG001

@@ -1,0 +1,130 @@
+"""Array-API conformance sample (reference: tests/python/array-api/ —
+the reference ran the array-api-tests suite against mx.np; this is a
+sampled port of the properties it exercised most)."""
+import numpy as onp
+import pytest
+
+import mxnet_tpu as mx
+
+np = mx.np
+
+
+class TestCreation:
+    def test_basic_constructors(self):
+        assert np.zeros((2, 3)).shape == (2, 3)
+        assert np.ones((2,), dtype="int32").dtype == onp.int32
+        assert np.full((2, 2), 7.0).asnumpy().tolist() == [[7, 7], [7, 7]]
+        assert np.arange(2, 10, 2).asnumpy().tolist() == [2, 4, 6, 8]
+        lin = np.linspace(0, 1, 5).asnumpy()
+        onp.testing.assert_allclose(lin, [0, .25, .5, .75, 1])
+        assert np.eye(3).asnumpy().trace() == 3.0
+
+    def test_like_constructors(self):
+        a = np.ones((2, 3), dtype="float32")
+        assert np.zeros_like(a).shape == (2, 3)
+        assert np.ones_like(a).dtype == onp.float32
+
+
+class TestDtypes:
+    @pytest.mark.parametrize("dt", ["float32", "float16", "int32", "int8",
+                                    "uint8", "bool"])
+    def test_astype_round_trip(self, dt):
+        a = np.array(onp.array([0, 1, 2], "f"))
+        b = a.astype(dt)
+        assert str(b.dtype) == dt
+        want = [0, 1, 1] if dt == "bool" else [0, 1, 2]  # bool saturates
+        assert b.astype("float32").asnumpy().tolist() == want
+
+    def test_promotion(self):
+        i = np.array(onp.array([1, 2], "int32"))
+        f = np.array(onp.array([0.5, 0.5], "float32"))
+        assert (i + f).dtype == onp.float32
+
+    def test_bool_reductions(self):
+        a = np.array(onp.array([True, False, True]))
+        assert bool(a.any()) and not bool(a.all())
+
+
+class TestIndexing:
+    def test_basic_slicing(self):
+        a = np.array(onp.arange(24.0, dtype="f").reshape(2, 3, 4))
+        assert a[1].shape == (3, 4)
+        assert a[:, 1:3].shape == (2, 2, 4)
+        assert a[..., -1].shape == (2, 3)
+        assert a[::-1].asnumpy()[0, 0, 0] == 12.0
+
+    def test_integer_array_indexing(self):
+        a = np.array(onp.arange(10.0, dtype="f"))
+        idx = np.array(onp.array([1, 3, 5]))
+        onp.testing.assert_allclose(a[idx].asnumpy(), [1, 3, 5])
+
+    def test_boolean_mask(self):
+        a = np.array(onp.array([1.0, -2.0, 3.0], "f"))
+        out = np.where(a > 0, a, np.zeros_like(a))
+        onp.testing.assert_allclose(out.asnumpy(), [1, 0, 3])
+
+    def test_setitem(self):
+        a = np.zeros((3, 3))
+        a[1] = 5.0
+        a[0, 2] = 1.0
+        w = a.asnumpy()
+        assert w[1].tolist() == [5, 5, 5] and w[0, 2] == 1
+
+
+class TestBroadcastingAndElementwise:
+    def test_broadcasting_rules(self):
+        a = np.ones((3, 1, 4))
+        b = np.ones((2, 4))
+        assert (a + b).shape == (3, 2, 4)
+        with pytest.raises(Exception):
+            _ = np.ones((3,)) + np.ones((4,))
+
+    def test_scalar_ops_both_sides(self):
+        a = np.array(onp.array([2.0], "f"))
+        assert float((1.0 - a).asnumpy()[0]) == -1.0
+        assert float((3.0 / a).asnumpy()[0]) == 1.5
+        assert float((a ** 2).asnumpy()[0]) == 4.0
+
+    def test_special_values(self):
+        a = np.array(onp.array([onp.inf, -onp.inf, onp.nan, 0.0], "f"))
+        isnan = np.isnan(a).asnumpy()
+        isinf = np.isinf(a).asnumpy()
+        assert isnan.tolist() == [False, False, True, False]
+        assert isinf.tolist() == [True, True, False, False]
+
+
+class TestManipulation:
+    def test_reshape_transpose_concat(self):
+        a = np.array(onp.arange(6.0, dtype="f"))
+        b = a.reshape(2, 3).T
+        assert b.shape == (3, 2)
+        c = np.concatenate([b, b], axis=1)
+        assert c.shape == (3, 4)
+        s = np.stack([a, a])
+        assert s.shape == (2, 6)
+
+    def test_split_roll_flip(self):
+        a = np.array(onp.arange(8.0, dtype="f"))
+        parts = np.split(a, 4)
+        assert len(parts) == 4 and parts[0].shape == (2,)
+        onp.testing.assert_allclose(np.roll(a, 2).asnumpy()[:2], [6, 7])
+        onp.testing.assert_allclose(np.flip(a, 0).asnumpy()[0], 7)
+
+
+class TestStatistics:
+    def test_reductions_axis_keepdims(self):
+        a = np.array(onp.arange(12.0, dtype="f").reshape(3, 4))
+        assert a.sum().shape == ()
+        assert a.mean(axis=0).shape == (4,)
+        assert a.max(axis=1, keepdims=True).shape == (3, 1)
+        onp.testing.assert_allclose(np.var(a).asnumpy(),
+                                    onp.var(onp.arange(12.0)))
+        onp.testing.assert_allclose(np.std(a, axis=0).asnumpy(),
+                                    onp.std(onp.arange(12.0).reshape(3, 4),
+                                            axis=0))
+
+    def test_sorting_searching(self):
+        a = np.array(onp.array([3.0, 1.0, 2.0], "f"))
+        onp.testing.assert_allclose(np.sort(a).asnumpy(), [1, 2, 3])
+        assert int(np.argmin(a).asnumpy()) == 1
+        onp.testing.assert_allclose(np.argsort(a).asnumpy(), [1, 2, 0])

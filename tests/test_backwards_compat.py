@@ -1,0 +1,47 @@
+"""Serialization backwards-compat (reference: the model_backwards_compat
+nightly — checkpoints saved by older versions must load forever).
+tests/golden/ holds artifacts saved by round 3; these tests must keep
+passing in every future round WITHOUT regenerating the artifacts."""
+import os
+
+import numpy as onp
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import gluon
+
+GOLDEN = os.path.join(os.path.dirname(__file__), "golden")
+
+
+def test_gluon_params_checkpoint_loads_and_reproduces():
+    net = gluon.nn.Sequential()
+    net.add(gluon.nn.Dense(8, activation="relu"), gluon.nn.Dense(3))
+    net.load_parameters(os.path.join(GOLDEN, "mlp_v1.params"))
+    x = mx.np.array(onp.load(os.path.join(GOLDEN, "mlp_v1_input.npy")))
+    want = onp.load(os.path.join(GOLDEN, "mlp_v1_output.npy"))
+    onp.testing.assert_allclose(onp.asarray(net(x).asnumpy()), want,
+                                rtol=1e-6, atol=1e-7)
+
+
+def test_symbol_json_loads_and_reproduces():
+    s = mx.sym.load(os.path.join(GOLDEN, "graph_v1.json"))
+    assert s.list_arguments() == ["data", "w"]
+    x = onp.load(os.path.join(GOLDEN, "mlp_v1_input.npy"))
+    w = onp.load(os.path.join(GOLDEN, "graph_v1_w.npy"))
+    want = onp.load(os.path.join(GOLDEN, "graph_v1_output.npy"))
+    got = s.eval(data=x, w=w)[0].asnumpy()
+    onp.testing.assert_allclose(onp.asarray(got), want, rtol=1e-6,
+                                atol=1e-7)
+
+
+def test_onnx_artifact_parses_and_evaluates():
+    from mxnet_tpu.onnx import _proto as P
+    from mxnet_tpu.onnx import onnx_eval
+
+    buf = open(os.path.join(GOLDEN, "graph_v1.onnx"), "rb").read()
+    m = P.check_model(buf)
+    assert m["opset"] == 11
+    x = onp.load(os.path.join(GOLDEN, "mlp_v1_input.npy"))
+    want = onp.load(os.path.join(GOLDEN, "graph_v1_output.npy"))
+    got = next(iter(onnx_eval.run_model(buf, {"data": x}).values()))
+    onp.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-6)

