@@ -33,11 +33,13 @@ WARMUP = 3
 ITERS = 30  # enough steps to amortize the tunnel's ~70ms sync round-trip
 
 
-def _probe_accelerator(timeout=90):
+def _probe_accelerator(timeout=None):
     """Check device init in a subprocess — a wedged TPU tunnel HANGS
     rather than raising, so an in-process try/except can't catch it."""
     import subprocess
 
+    if timeout is None:
+        timeout = float(os.environ.get("MXTPU_BENCH_PROBE_TIMEOUT_S", "90"))
     try:
         out = subprocess.run(
             [sys.executable, "-c",

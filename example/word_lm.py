@@ -104,8 +104,8 @@ def main():
         x = np.array(data[:, pos:pos + args.bptt].T)          # (T, N)
         y = np.array(data[:, pos + 1:pos + args.bptt + 1].T)  # next word
         pos += args.bptt
-        # truncated BPTT: detach the carried state
-        state = [np.array(s.asnumpy()) for s in state]
+        # truncated BPTT: detach the carried state (on-device, no sync)
+        state = [s.detach() for s in state]
         with autograd.record():
             logits, state = model(x, state)
             loss = lf(logits.reshape(-1, VOCAB), y.reshape(-1))

@@ -520,6 +520,110 @@ def _install_round4():
 
 
 
+def _install_round5():
+    """Round 3 of the build: the last legacy NNVM spellings — fused RNN,
+    per-row `_sample_*` + `_random_pdf_*` families, `_linalg_*` twins of the
+    la_op table, legacy init ops, control-flow entries over lax, the opencv
+    `_cv*` image internals and the `Custom` dispatcher. After this round
+    every non-backward `NNVM_REGISTER_OP` name in the reference resolves
+    except the backend-specific skips listed in the module docstring plus
+    graph-executor internals (`_CachedOp`, `_FusedOp*`, `__name$`)."""
+    from . import rnn as _rnn_mod  # noqa: F401 - registers "RNN"
+    from .random_legacy import install_legacy_random
+
+    install_legacy_random()
+
+    def reg(name, fn):
+        if name not in _OPS and fn is not None:
+            register_op(name, fn)
+
+    # ---- _linalg_* twins (src/operator/tensor/la_op.cc registers both
+    # the public linalg_* and internal _linalg_* spellings) ---------------
+    for key in list(_OPS):
+        if key.startswith("linalg_"):
+            reg("_" + key, _OPS[key])
+
+    # ---- legacy init ops (src/operator/tensor/init_op.cc) ---------------
+    def _dt(dtype):
+        return "float32" if dtype in (None, "None") else dtype
+
+    reg("_zeros", lambda shape, dtype=None, **kw:
+        jnp.zeros(shape, _dt(dtype)))
+    reg("_ones", lambda shape, dtype=None, **kw:
+        jnp.ones(shape, _dt(dtype)))
+    reg("_full", lambda shape, value=0.0, dtype=None, **kw:
+        jnp.full(shape, value, _dt(dtype)))
+    reg("_linspace", lambda start=0.0, stop=1.0, num=50, endpoint=True,
+        dtype=None, **kw:
+        jnp.linspace(start, stop, int(num), endpoint=bool(endpoint),
+                     dtype=_dt(dtype)))
+
+    def _legacy_arange(start=0.0, stop=None, step=1.0, repeat=1,
+                       dtype=None, **kw):  # noqa: ARG001
+        base = jnp.arange(start, stop, step, dtype=_dt(dtype))
+        return jnp.repeat(base, int(repeat)) if int(repeat) > 1 else base
+
+    reg("_arange", _legacy_arange)
+
+    # ---- legacy binary broadcasts + misc elemwise -----------------------
+    reg("_maximum", jnp.maximum)
+    reg("_minimum", jnp.minimum)
+    reg("_power", jnp.power)
+    reg("_hypot", jnp.hypot)
+    reg("_copyto", lambda x, **kw: jnp.asarray(x))
+
+    import jax as _jax
+
+    reg("_NoGradient", lambda x, **kw: _jax.lax.stop_gradient(x))
+
+    # ---- masked softmax family (src/operator/nn/softmax.cc
+    # masked_softmax / masked_log_softmax) --------------------------------
+    def _masked(log):
+        def fn(data, mask, axis=-1, temperature=1.0, **kw):  # noqa: ARG001
+            t = temperature if temperature else 1.0
+            m = jnp.asarray(mask).astype(bool)
+            x = jnp.where(m, jnp.asarray(data) / t, -jnp.inf)
+            if log:
+                return _jax.nn.log_softmax(x, axis=axis)
+            y = _jax.nn.softmax(x, axis=axis)
+            return jnp.where(m, y, 0.0)
+
+        return fn
+
+    reg("masked_softmax", _masked(log=False))
+    reg("masked_log_softmax", _masked(log=True))
+
+    # ---- control flow (src/operator/control_flow.cc _foreach/_while_loop/
+    # _cond -> the npx lax-backed versions) -------------------------------
+    from ..numpy_extension import control_flow as _cf
+
+    reg("_foreach", _cf.foreach)
+    reg("_while_loop", _cf.while_loop)
+    reg("_cond", _cf.cond)
+
+    # ---- opencv internals (src/io/image_io.cc _cvimread/_cvimdecode/
+    # _cvimresize/_cvcopyMakeBorder) --------------------------------------
+    from ..image import image as _img
+
+    reg("_cvimread", _img.imread)
+    reg("_cvimdecode", _img.imdecode)
+    reg("_cvimresize", _img.imresize)
+    reg("_cvcopyMakeBorder", _img.copyMakeBorder)
+
+    # ---- Custom op dispatcher (src/operator/custom/custom.cc) -----------
+    from ..operator import Custom as _custom
+
+    reg("Custom", _custom)
+
+    # ---- misc remaining spellings ---------------------------------------
+    reg("_ravel_multi_index", _OPS.get("ravel_multi_index"))
+    reg("_unravel_index", _OPS.get("unravel_index"))
+    reg("_adamw_update", _OPS.get("adamw_update"))
+    reg("_npi_logical_and", _OPS.get("broadcast_logical_and"))
+    reg("_npi_logical_or", _OPS.get("broadcast_logical_or"))
+    reg("_npi_logical_xor", _OPS.get("broadcast_logical_xor"))
+
+
 def install_aliases():
     """Populate the registry with every internal spelling. Idempotent."""
     if "_npi_add" in _OPS:
@@ -528,3 +632,4 @@ def install_aliases():
     _install_round2()
     _install_round3()
     _install_round4()
+    _install_round5()

@@ -1,0 +1,236 @@
+"""Legacy per-row sampling (`_sample_*`) and density (`_random_pdf_*`) op
+families (reference: src/operator/random/multisample_op.cc — each row of the
+parameter tensors gets `shape` samples drawn with its own parameters — and
+src/operator/random/pdf_op.cc — elementwise densities of samples under
+per-row parameters, with an `is_log` switch).
+
+TPU re-design: every sampler is a jax.random transform under the framework's
+stateful key provider (_random.next_key, the Resource-kRandom analog); the
+count distributions (poisson / negative binomial families) use the standard
+gamma-Poisson mixture constructions so everything stays vectorized on
+device. Densities are closed-form jnp math.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from .. import _random
+from .registry import register_op
+
+__all__ = ["install_legacy_random"]
+
+
+def _unwrap(x):
+    data = getattr(x, "_data", None)
+    return jnp.asarray(data if data is not None else x)
+
+
+def _shape_tuple(shape):
+    if shape is None:
+        return ()
+    if isinstance(shape, int):
+        return (shape,)
+    return tuple(int(s) for s in shape)
+
+
+def _expand(p, extra):
+    """Broadcast per-row params against trailing sample dims."""
+    return p.reshape(p.shape + (1,) * extra) if extra else p
+
+
+def _sampler(name, draw):
+    """draw(key, out_shape, *expanded_params) -> samples."""
+
+    def fn(*params, shape=None, dtype=None, **kw):  # noqa: ARG001
+        from ..ndarray.ndarray import NDArray
+
+        ps = [_unwrap(p) for p in params]
+        S = _shape_tuple(shape)
+        out_shape = tuple(ps[0].shape) + S
+        ps = [_expand(p, len(S)) for p in ps]
+        out = draw(_random.next_key(), out_shape, *ps)
+        if dtype is not None and str(dtype) != "None":
+            out = out.astype(dtype)
+        return NDArray(out)
+
+    fn.__name__ = name
+    return fn
+
+
+def _draw_uniform(key, shape, low, high):
+    return low + jax.random.uniform(key, shape, jnp.float32) * (high - low)
+
+
+def _draw_normal(key, shape, mu, sigma):
+    return mu + sigma * jax.random.normal(key, shape, jnp.float32)
+
+
+def _draw_exponential(key, shape, lam):
+    # rate parameterization (reference sample_op.h ExponentialSampler)
+    return jax.random.exponential(key, shape, jnp.float32) / lam
+
+
+def _draw_gamma(key, shape, alpha, beta):
+    # alpha = shape, beta = scale (reference GammaSampler)
+    return jax.random.gamma(key, jnp.broadcast_to(alpha, shape),
+                            dtype=jnp.float32) * beta
+
+
+def _draw_poisson(key, shape, lam):
+    return jax.random.poisson(
+        key, jnp.broadcast_to(lam, shape)).astype(jnp.float32)
+
+
+def _draw_negative_binomial(key, shape, k, p):
+    # NB(k, p) = Poisson(Gamma(k, (1-p)/p)) (reference NegativeBinomialSampler)
+    kg, kp = jax.random.split(key)
+    lam = jax.random.gamma(kg, jnp.broadcast_to(k, shape),
+                           dtype=jnp.float32) * (1.0 - p) / p
+    return jax.random.poisson(kp, lam).astype(jnp.float32)
+
+
+def _draw_generalized_negative_binomial(key, shape, mu, alpha):
+    # GNB(mu, alpha) = Poisson(Gamma(1/alpha, alpha*mu))
+    kg, kp = jax.random.split(key)
+    a = jnp.broadcast_to(1.0 / jnp.maximum(alpha, 1e-12), shape)
+    lam = jax.random.gamma(kg, a, dtype=jnp.float32) * alpha * mu
+    return jax.random.poisson(kp, lam).astype(jnp.float32)
+
+
+def _sample_multinomial(data, shape=None, get_prob=False, dtype="int32",
+                        **kw):  # noqa: ARG001
+    """_sample_multinomial: rows of probabilities (..., k) -> indices
+    (..., *shape) by inverse-CDF (reference sample_multinomial_op.h)."""
+    from ..ndarray.ndarray import NDArray
+
+    from .rnn import _battr
+
+    get_prob = _battr(get_prob)
+    p = _unwrap(data)
+    S = _shape_tuple(shape)
+    batch = p.shape[:-1]
+    k = p.shape[-1]
+    cdf = jnp.cumsum(p, axis=-1)
+    cdf = cdf / cdf[..., -1:]                        # tolerate unnormalized
+    cdf_e = cdf.reshape(batch + (1,) * len(S) + (k,))
+    u = jax.random.uniform(_random.next_key(), batch + S, jnp.float32)
+    idx = jnp.sum(u[..., None] >= cdf_e, axis=-1).clip(0, k - 1)
+    idx = idx.astype(dtype)
+    if not get_prob:
+        return NDArray(idx)
+    logp = jnp.log(jnp.maximum(p, 1e-30)).reshape(
+        batch + (1,) * len(S) + (k,))
+    lp = jnp.take_along_axis(
+        jnp.broadcast_to(logp, batch + S + (k,)), idx[..., None].astype(
+            jnp.int32), axis=-1)[..., 0]
+    return NDArray(idx), NDArray(lp)
+
+
+def _shuffle(data, **kw):  # noqa: ARG001
+    """_shuffle: permute along the first axis (reference shuffle_op.cc)."""
+    from ..ndarray.ndarray import NDArray
+
+    x = _unwrap(data)
+    return NDArray(jax.random.permutation(_random.next_key(), x, axis=0,
+                                          independent=False))
+
+
+# ---- densities (reference src/operator/random/pdf_op.cc) -----------------
+
+def _pdf(name, logpdf, nparams, consumes_last=False):
+    def fn(sample, *params, is_log=False, **kw):  # noqa: ARG001
+        from ..ndarray.ndarray import NDArray
+
+        s = _unwrap(sample)
+        ps = [_unwrap(p) for p in params[:nparams]]
+        rank = s.ndim - (1 if consumes_last else 0)
+        extra = rank - ps[0].ndim
+        ps = [_expand(p, extra) for p in ps]
+        ll = logpdf(s, *ps)
+        return NDArray(ll if is_log else jnp.exp(ll))
+
+    fn.__name__ = name
+    return fn
+
+
+def _lp_uniform(x, low, high):
+    inside = (x >= low) & (x <= high)
+    return jnp.where(inside, -jnp.log(high - low), -jnp.inf)
+
+
+def _lp_normal(x, mu, sigma):
+    z = (x - mu) / sigma
+    return -0.5 * z * z - jnp.log(sigma) - 0.5 * jnp.log(2 * jnp.pi)
+
+
+def _lp_gamma(x, alpha, beta):
+    # shape/scale (matches the sampler above)
+    return ((alpha - 1) * jnp.log(x) - x / beta
+            - jax.scipy.special.gammaln(alpha) - alpha * jnp.log(beta))
+
+
+def _lp_exponential(x, lam):
+    return jnp.log(lam) - lam * x
+
+
+def _lp_poisson(x, lam):
+    return x * jnp.log(lam) - lam - jax.scipy.special.gammaln(x + 1)
+
+
+def _lp_negative_binomial(x, k, p):
+    return (jax.scipy.special.gammaln(x + k)
+            - jax.scipy.special.gammaln(k)
+            - jax.scipy.special.gammaln(x + 1)
+            + k * jnp.log(p) + x * jnp.log1p(-p))
+
+
+def _lp_generalized_negative_binomial(x, mu, alpha):
+    r = 1.0 / jnp.maximum(alpha, 1e-12)
+    p = r / (r + mu)
+    return _lp_negative_binomial(x, r, p)
+
+
+def _lp_dirichlet(x, alpha):
+    # x (..., k) consumed; alpha broadcast over the batch dims
+    return (jnp.sum((alpha - 1) * jnp.log(x), axis=-1)
+            + jax.scipy.special.gammaln(jnp.sum(alpha, axis=-1))
+            - jnp.sum(jax.scipy.special.gammaln(alpha), axis=-1))
+
+
+def install_legacy_random():
+    """Register the `_sample_*` / `_random_pdf_*` spellings. Idempotent."""
+    from .registry import _OPS
+
+    entries = {
+        "_sample_uniform": _sampler("_sample_uniform", _draw_uniform),
+        "_sample_normal": _sampler("_sample_normal", _draw_normal),
+        "_sample_exponential":
+            _sampler("_sample_exponential", _draw_exponential),
+        "_sample_gamma": _sampler("_sample_gamma", _draw_gamma),
+        "_sample_poisson": _sampler("_sample_poisson", _draw_poisson),
+        "_sample_negative_binomial":
+            _sampler("_sample_negative_binomial", _draw_negative_binomial),
+        "_sample_generalized_negative_binomial":
+            _sampler("_sample_generalized_negative_binomial",
+                     _draw_generalized_negative_binomial),
+        "_sample_multinomial": _sample_multinomial,
+        "_shuffle": _shuffle,
+        "_random_pdf_uniform": _pdf("_random_pdf_uniform", _lp_uniform, 2),
+        "_random_pdf_normal": _pdf("_random_pdf_normal", _lp_normal, 2),
+        "_random_pdf_gamma": _pdf("_random_pdf_gamma", _lp_gamma, 2),
+        "_random_pdf_exponential":
+            _pdf("_random_pdf_exponential", _lp_exponential, 1),
+        "_random_pdf_poisson": _pdf("_random_pdf_poisson", _lp_poisson, 1),
+        "_random_pdf_negative_binomial":
+            _pdf("_random_pdf_negative_binomial", _lp_negative_binomial, 2),
+        "_random_pdf_generalized_negative_binomial":
+            _pdf("_random_pdf_generalized_negative_binomial",
+                 _lp_generalized_negative_binomial, 2),
+        "_random_pdf_dirichlet":
+            _pdf("_random_pdf_dirichlet", _lp_dirichlet, 1,
+                 consumes_last=True),
+    }
+    for name, fn in entries.items():
+        if name not in _OPS:
+            register_op(name, fn)

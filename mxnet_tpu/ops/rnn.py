@@ -1,0 +1,205 @@
+"""Fused multi-layer RNN operator over the cuDNN-canonical flat parameter
+blob (reference: src/operator/rnn.cc NNVM_REGISTER_OP(RNN), rnn-inl.h
+GetRnnParamSize:176 / GetRnnBiasSize:208, rnn_impl.h
+LstmForwardInferenceSingleLayer — wx then wh per layer/direction, all
+biases bx,bh packed after every weight).
+
+TPU re-design: each (layer, direction) is a `lax.scan` over time — the
+per-step x@W dot is hoisted out of the scan (one big (T*N, I)x(I, G*H)
+matmul on the MXU, like the reference's single pre-GEMM), leaving only the
+recurrent h@R dot inside the scan body.  Gate order matches the reference
+(LSTM [i, f, g, o], GRU [r, z, n]) so parameter blobs translate directly.
+
+The op computes inference-mode semantics (`p` dropout between layers is a
+training-time concern handled by gluon.rnn's layers); outputs mirror the
+reference: `out` or (out, state_h[, state_cell]) when state_outputs=True.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from .registry import register_op
+
+__all__ = ["rnn_fused", "rnn_param_size", "slice_rnn_params"]
+
+_GATES = {"rnn_relu": 1, "rnn_tanh": 1, "lstm": 4, "gru": 3}
+
+
+def _battr(v):
+    """Parse a boolean attr that may arrive as a serialized string (symbol
+    JSON round-trips attrs as text; must agree with the nout lambdas in
+    symbol/register.py)."""
+    if isinstance(v, str):
+        return v not in ("False", "0", "None", "false", "")
+    return bool(v)
+
+
+def rnn_param_size(num_layers, input_size, state_size, bidirectional=False,
+                   mode="lstm", projection_size=None):
+    """Total flat parameter count (reference rnn-inl.h GetRnnParamSize)."""
+    D = 2 if bidirectional else 1
+    G = _GATES[mode]
+    size = G * state_size * D
+    P = projection_size
+    if P:
+        size1 = (input_size + P + 2) * size
+        size2 = (P * D + P + 2) * size
+        total = size1 + (num_layers - 1) * size2
+        total += P * state_size * num_layers * D
+    else:
+        size1 = (input_size + state_size + 2) * size
+        size2 = (state_size * D + state_size + 2) * size
+        total = size1 + (num_layers - 1) * size2
+    return int(total)
+
+
+def slice_rnn_params(w, mode, num_layers, input_size, state_size,
+                     bidirectional=False, projection_size=None):
+    """Split the flat blob into per-(layer, direction) weight dicts.
+
+    Layout (reference rnn-inl.h / rnn_impl.h): for each layer, for each
+    direction: wx (G*H, in_l), wh (G*H, P or H)[, whr (P, H)]; then, for
+    each layer/direction again: bx (G*H,), bh (G*H,).
+    """
+    D = 2 if bidirectional else 1
+    G = _GATES[mode]
+    H = state_size
+    P = projection_size or 0
+    R = P or H                      # recurrent width
+    out = []
+    off = 0
+
+    def take(n, shape):
+        nonlocal off
+        v = w[off:off + n].reshape(shape)
+        off += n
+        return v
+
+    for layer in range(num_layers):
+        in_l = input_size if layer == 0 else R * D
+        for _d in range(D):
+            blk = {"wx": take(G * H * in_l, (G * H, in_l)),
+                   "wh": take(G * H * R, (G * H, R))}
+            if P:
+                blk["whr"] = take(P * H, (P, H))
+            out.append(blk)
+    for i in range(num_layers * D):
+        out[i]["bx"] = take(G * H, (G * H,))
+        out[i]["bh"] = take(G * H, (G * H,))
+    return out
+
+
+def _cell_step(mode, clip=None):
+    def step_rnn_relu(h, c, pre_x, pre_h):  # noqa: ARG001
+        h_new = jax.nn.relu(pre_x + pre_h)
+        return h_new, c
+
+    def step_rnn_tanh(h, c, pre_x, pre_h):  # noqa: ARG001
+        h_new = jnp.tanh(pre_x + pre_h)
+        return h_new, c
+
+    def step_lstm(h, c, pre_x, pre_h):  # noqa: ARG001
+        i, f, g, o = jnp.split(pre_x + pre_h, 4, axis=-1)
+        i, f, o = (jax.nn.sigmoid(v) for v in (i, f, o))
+        c_new = f * c + i * jnp.tanh(g)
+        if clip is not None:
+            # cuDNN-style cell clipping: c is clipped every step, BEFORE
+            # h is computed from it (reference rnn-inl.h state_clip)
+            c_new = jnp.clip(c_new, clip[0], clip[1])
+        return o * jnp.tanh(c_new), c_new
+
+    def step_gru(h, c, pre_x, pre_h):  # noqa: ARG001
+        ir, iz, in_ = jnp.split(pre_x, 3, axis=-1)
+        hr, hz, hn = jnp.split(pre_h, 3, axis=-1)
+        r = jax.nn.sigmoid(ir + hr)
+        z = jax.nn.sigmoid(iz + hz)
+        n = jnp.tanh(in_ + r * hn)
+        return (1 - z) * n + z * h, c
+
+    return {"rnn_relu": step_rnn_relu, "rnn_tanh": step_rnn_tanh,
+            "lstm": step_lstm, "gru": step_gru}[mode]
+
+
+def _run_direction(x, h0, c0, blk, mode, reverse, clip=None):
+    """One (layer, direction): x (T, N, in) -> (y (T, N, R), h_T, c_T)."""
+    step = _cell_step(mode, clip)
+    # hoist the input projection out of the scan: one big MXU matmul
+    pre_x = jnp.einsum("tni,gi->tng", x, blk["wx"]) + blk["bx"]
+    if reverse:
+        pre_x = pre_x[::-1]
+    whr = blk.get("whr")
+
+    def body(carry, px):
+        h, c = carry
+        pre_h = h @ blk["wh"].T + blk["bh"]
+        h_new, c_new = step(h, c, px, pre_h)
+        if whr is not None:                       # LSTMP projection
+            h_new = h_new @ whr.T
+        return (h_new, c_new), h_new
+
+    (h_t, c_t), ys = jax.lax.scan(body, (h0, c0), pre_x)
+    if reverse:
+        ys = ys[::-1]
+    return ys, h_t, c_t
+
+
+def rnn_fused(data, parameters, state, state_cell=None, *, state_size,
+              num_layers, mode="lstm", bidirectional=False, p=0.0,
+              state_outputs=False, projection_size=None,
+              lstm_state_clip_min=None, lstm_state_clip_max=None,
+              **ignored):  # noqa: ARG001
+    """RNN op: data (T, N, I), parameters flat (S,), state (L*D, N, R)
+    [, state_cell (L*D, N, H) for lstm] -> out (T, N, D*R)
+    [+ (state_h, state_cell) when state_outputs].
+
+    State index layout matches the reference: idx = layer * D + direction.
+    """
+    mode = str(mode)
+    if mode not in _GATES:
+        raise ValueError(f"unknown RNN mode {mode!r}")
+    state_outputs = _battr(state_outputs)
+    bidirectional = _battr(bidirectional)
+    x = jnp.asarray(data)
+    w = jnp.asarray(parameters).reshape(-1)
+    hx = jnp.asarray(state)
+    D = 2 if bidirectional else 1
+    L = int(num_layers)
+    H = int(state_size)
+    P = int(projection_size) if projection_size else 0
+    T, N, I = x.shape
+    blks = slice_rnn_params(w, mode, L, I, H, bidirectional, P or None)
+
+    if mode == "lstm":
+        if state_cell is None:
+            raise ValueError("lstm mode needs state_cell")
+        cx = jnp.asarray(state_cell)
+    else:
+        cx = jnp.zeros((L * D, N, H), x.dtype)
+
+    clip = None
+    if mode == "lstm" and lstm_state_clip_min is not None:
+        clip = (float(lstm_state_clip_min), float(lstm_state_clip_max))
+    hy, cy = [], []
+    for layer in range(L):
+        ys = []
+        for d in range(D):
+            idx = layer * D + d
+            y, h_t, c_t = _run_direction(
+                x, hx[idx], cx[idx], blks[idx], mode, reverse=bool(d),
+                clip=clip)
+            ys.append(y)
+            hy.append(h_t)
+            cy.append(c_t)
+        x = ys[0] if D == 1 else jnp.concatenate(ys, axis=-1)
+
+    out = x
+    if not state_outputs:
+        return out
+    state_h = jnp.stack(hy)
+    if mode == "lstm":
+        return out, state_h, jnp.stack(cy)
+    return out, state_h
+
+
+register_op("RNN", rnn_fused)

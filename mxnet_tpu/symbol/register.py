@@ -23,6 +23,7 @@ from __future__ import annotations
 import inspect
 
 from ..ops import registry as _registry
+from ..ops.rnn import _battr
 from .symbol import _OP_TABLE, Symbol, register_sym_op
 
 # ops whose output count depends on attrs (generic adapters default to 1;
@@ -53,6 +54,14 @@ _MULTI_OUT = {
         len(a["indices_or_sections"]) + 1
         if isinstance(a.get("indices_or_sections"), (tuple, list))
         else int(a.get("indices_or_sections", 1))),
+    # fused RNN: out [+ state_h [+ state_cell for lstm]] (rnn.cc);
+    # boolean parsing MUST match ops.rnn._battr or nout lies about the
+    # lowered tuple arity
+    "RNN": lambda a: (
+        (3 if str(a.get("mode", "lstm")) == "lstm" else 2)
+        if _battr(a.get("state_outputs", False)) else 1),
+    "_sample_multinomial": lambda a: (
+        2 if _battr(a.get("get_prob", False)) else 1),
 }
 
 

@@ -1,0 +1,246 @@
+"""Fused RNN op + legacy random/pdf op families (round-3 registry
+completion; reference: src/operator/rnn.cc, rnn-inl.h param packing,
+src/operator/random/multisample_op.cc, pdf_op.cc, shuffle_op.cc)."""
+import numpy as onp
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import gluon
+from mxnet_tpu.ops import rnn as R
+from mxnet_tpu.ops.registry import _OPS, get_op
+
+import jax.numpy as jnp
+
+
+def _flat_params(net, layers, dirs, proj=False):
+    """Pack gluon per-parameter weights into the reference flat blob:
+    all (wx, wh[, whr]) per layer/direction, then all (bx, bh)."""
+    p = {k: v.data().asnumpy() for k, v in net.collect_params().items()}
+    chunks, biases = [], []
+    for layer in range(layers):
+        for d in range(dirs):
+            sfx = f"l{layer}" + ("_r" if d else "")
+            chunks += [p[f"{sfx}_i2h_weight"].ravel(),
+                       p[f"{sfx}_h2h_weight"].ravel()]
+            if proj:
+                chunks.append(p[f"{sfx}_h2r_weight"].ravel())
+            biases += [p[f"{sfx}_i2h_bias"].ravel(),
+                       p[f"{sfx}_h2h_bias"].ravel()]
+    return onp.concatenate(chunks + biases)
+
+
+@pytest.mark.parametrize("mode,cls", [
+    ("lstm", gluon.rnn.LSTM), ("gru", gluon.rnn.GRU)])
+def test_fused_matches_gluon_unidirectional(mode, cls):
+    T, N, I, H, L = 4, 3, 6, 5, 2
+    net = cls(H, num_layers=L, input_size=I)
+    net.initialize()
+    rs = onp.random.RandomState(0)
+    x = rs.randn(T, N, I).astype("f")
+    want = net(mx.np.array(x)).asnumpy()
+    w = _flat_params(net, L, 1)
+    assert w.size == R.rnn_param_size(L, I, H, False, mode)
+    cell = jnp.zeros((L, N, H)) if mode == "lstm" else None
+    got = R.rnn_fused(jnp.asarray(x), jnp.asarray(w),
+                      jnp.zeros((L, N, H)), cell,
+                      state_size=H, num_layers=L, mode=mode)
+    onp.testing.assert_allclose(onp.asarray(got), want,
+                                rtol=1e-5, atol=1e-5)
+
+
+def test_fused_matches_gluon_bidirectional_states():
+    T, N, I, H, L = 5, 2, 3, 4, 2
+    net = gluon.rnn.LSTM(H, num_layers=L, bidirectional=True, input_size=I)
+    net.initialize()
+    rs = onp.random.RandomState(1)
+    x = rs.randn(T, N, I).astype("f")
+    h0 = onp.zeros((L * 2, N, H), "f")
+    c0 = onp.zeros((L * 2, N, H), "f")
+    want, (wh, wc) = net(mx.np.array(x),
+                         [mx.np.array(h0), mx.np.array(c0)])
+    w = _flat_params(net, L, 2)
+    assert w.size == R.rnn_param_size(L, I, H, True, "lstm")
+    out, hy, cy = R.rnn_fused(
+        jnp.asarray(x), jnp.asarray(w), jnp.asarray(h0), jnp.asarray(c0),
+        state_size=H, num_layers=L, mode="lstm", bidirectional=True,
+        state_outputs=True)
+    onp.testing.assert_allclose(onp.asarray(out), want.asnumpy(),
+                                rtol=1e-5, atol=1e-5)
+    onp.testing.assert_allclose(onp.asarray(hy), wh.asnumpy(),
+                                rtol=1e-5, atol=1e-5)
+    onp.testing.assert_allclose(onp.asarray(cy), wc.asnumpy(),
+                                rtol=1e-5, atol=1e-5)
+
+
+def test_fused_rnn_relu_and_registry():
+    T, N, I, H = 3, 2, 4, 5
+    net = gluon.rnn.RNN(H, num_layers=1, activation="relu", input_size=I)
+    net.initialize()
+    rs = onp.random.RandomState(2)
+    x = rs.randn(T, N, I).astype("f")
+    want = net(mx.np.array(x)).asnumpy()
+    w = _flat_params(net, 1, 1)
+    got = get_op("RNN")(jnp.asarray(x), jnp.asarray(w),
+                        jnp.zeros((1, N, H)), None,
+                        state_size=H, num_layers=1, mode="rnn_relu")
+    onp.testing.assert_allclose(onp.asarray(got), want,
+                                rtol=1e-5, atol=1e-5)
+
+
+def test_fused_lstmp_projection():
+    T, N, I, H, P = 3, 2, 4, 6, 3
+    net = gluon.rnn.LSTM(H, num_layers=1, projection_size=P, input_size=I)
+    net.initialize()
+    rs = onp.random.RandomState(3)
+    x = rs.randn(T, N, I).astype("f")
+    want = net(mx.np.array(x)).asnumpy()
+    w = _flat_params(net, 1, 1, proj=True)
+    assert w.size == R.rnn_param_size(1, I, H, False, "lstm",
+                                      projection_size=P)
+    got = R.rnn_fused(jnp.asarray(x), jnp.asarray(w),
+                      jnp.zeros((1, N, P)), jnp.zeros((1, N, H)),
+                      state_size=H, num_layers=1, mode="lstm",
+                      projection_size=P)
+    onp.testing.assert_allclose(onp.asarray(got), want,
+                                rtol=1e-5, atol=1e-5)
+
+
+def test_symbol_rnn_builds_and_runs():
+    T, N, I, H = 4, 2, 3, 5
+    data = mx.sym.var("data")
+    w = mx.sym.var("w")
+    h0 = mx.sym.var("h0")
+    c0 = mx.sym.var("c0")
+    s = mx.sym.RNN(data, w, h0, c0, state_size=H, num_layers=1,
+                   mode="lstm", state_outputs=True)
+    assert len(s.list_outputs()) == 3
+    rs = onp.random.RandomState(4)
+    args = {"data": mx.np.array(rs.randn(T, N, I).astype("f")),
+            "w": mx.np.array(
+                rs.randn(R.rnn_param_size(1, I, H, False, "lstm"))
+                .astype("f") * 0.1),
+            "h0": mx.np.zeros((1, N, H)), "c0": mx.np.zeros((1, N, H))}
+    outs = s.bind(None, args).forward()
+    assert outs[0].shape == (T, N, H)
+    assert outs[1].shape == (1, N, H) and outs[2].shape == (1, N, H)
+    want = R.rnn_fused(args["data"].asnumpy(), args["w"].asnumpy(),
+                       args["h0"].asnumpy(), args["c0"].asnumpy(),
+                       state_size=H, num_layers=1, mode="lstm",
+                       state_outputs=True)
+    onp.testing.assert_allclose(outs[0].asnumpy(), onp.asarray(want[0]),
+                                rtol=1e-5, atol=1e-6)
+
+
+# ---- legacy sample/pdf families ------------------------------------------
+
+def test_sample_family_per_row_statistics():
+    mx.seed(7)
+    low = mx.np.array([0.0, 10.0])
+    high = mx.np.array([1.0, 20.0])
+    s = get_op("_sample_uniform")(low, high, shape=(4000,)).asnumpy()
+    assert s.shape == (2, 4000)
+    onp.testing.assert_allclose(s[0].mean(), 0.5, atol=0.05)
+    onp.testing.assert_allclose(s[1].mean(), 15.0, atol=0.5)
+    g = get_op("_sample_gamma")(mx.np.array([2.0]), mx.np.array([3.0]),
+                                shape=(6000,)).asnumpy()
+    onp.testing.assert_allclose(g.mean(), 6.0, rtol=0.1)  # E = alpha*beta
+    nb = get_op("_sample_negative_binomial")(
+        mx.np.array([4.0]), mx.np.array([0.5]), shape=(6000,)).asnumpy()
+    onp.testing.assert_allclose(nb.mean(), 4.0, rtol=0.15)  # k(1-p)/p
+
+
+def test_sample_multinomial_and_get_prob():
+    mx.seed(11)
+    p = mx.np.array([[0.1, 0.9], [0.8, 0.2]])
+    idx = get_op("_sample_multinomial")(p, shape=(3000,)).asnumpy()
+    assert idx.shape == (2, 3000) and idx.dtype == onp.int32
+    onp.testing.assert_allclose(idx[0].mean(), 0.9, atol=0.05)
+    onp.testing.assert_allclose(idx[1].mean(), 0.2, atol=0.05)
+    idx2, lp = get_op("_sample_multinomial")(p, shape=(5,), get_prob=True)
+    picked = onp.take_along_axis(onp.log(p.asnumpy()),
+                                 idx2.asnumpy().astype("i8"), axis=-1)
+    onp.testing.assert_allclose(lp.asnumpy(), picked, rtol=1e-5)
+
+
+def test_pdf_family_closed_forms():
+    x = mx.np.array([[0.5, 1.5]])
+    pdf = get_op("_random_pdf_normal")(
+        x, mx.np.array([0.0]), mx.np.array([1.0])).asnumpy()
+    want = onp.exp(-0.5 * onp.array([[0.5, 1.5]]) ** 2) / onp.sqrt(
+        2 * onp.pi)
+    onp.testing.assert_allclose(pdf, want, rtol=1e-5)
+    lam = mx.np.array([2.0])
+    pe = get_op("_random_pdf_exponential")(x, lam, is_log=True).asnumpy()
+    onp.testing.assert_allclose(
+        pe, onp.log(2.0) - 2.0 * x.asnumpy(), rtol=1e-5)
+    kp = get_op("_random_pdf_poisson")(
+        mx.np.array([[0.0, 1.0, 2.0]]), mx.np.array([1.5])).asnumpy()
+    fact = onp.array([1.0, 1.0, 2.0])
+    want = onp.exp(-1.5) * 1.5 ** onp.array([0.0, 1, 2]) / fact
+    onp.testing.assert_allclose(kp[0], want, rtol=1e-5)
+    d = get_op("_random_pdf_dirichlet")(
+        mx.np.array([[0.3, 0.7]]), mx.np.array([[1.0, 1.0]])).asnumpy()
+    onp.testing.assert_allclose(d, [1.0], rtol=1e-5)  # uniform simplex
+
+
+def test_shuffle_is_permutation():
+    mx.seed(3)
+    x = mx.np.array(onp.arange(24.0).reshape(8, 3))
+    y = get_op("_shuffle")(x).asnumpy()
+    assert not onp.array_equal(y, x.asnumpy()) or True  # may no-op rarely
+    onp.testing.assert_allclose(onp.sort(y[:, 0]), x.asnumpy()[:, 0])
+    # rows stay intact
+    for row in y:
+        assert row[1] == row[0] + 1 and row[2] == row[0] + 2
+
+
+def test_round5_spellings_present_and_compute():
+    for name in ("_linalg_gemm2", "_linalg_potrf", "_maximum", "_hypot",
+                 "_copyto", "_zeros", "_arange", "_linspace", "_full",
+                 "masked_softmax", "_foreach", "_while_loop", "_cond",
+                 "_cvimresize", "_cvcopyMakeBorder", "Custom",
+                 "_NoGradient", "_sample_poisson", "_random_pdf_gamma"):
+        assert name in _OPS, name
+    a = jnp.asarray([[1.0, 2.0], [3.0, 4.0]])
+    b = jnp.asarray([[0.5, 1.0], [2.0, 0.1]])
+    onp.testing.assert_allclose(
+        get_op("_linalg_gemm2")(a, b), onp.asarray(a) @ onp.asarray(b),
+        rtol=1e-5)
+    onp.testing.assert_allclose(get_op("_hypot")(a, b),
+                                onp.hypot(onp.asarray(a), onp.asarray(b)))
+    z = get_op("_zeros")(shape=(2, 3), dtype="float32")
+    assert onp.asarray(z).shape == (2, 3)
+    ar = get_op("_arange")(start=1.0, stop=4.0, step=1.0, repeat=2)
+    onp.testing.assert_allclose(onp.asarray(ar), [1, 1, 2, 2, 3, 3])
+
+
+def test_rnn_string_bool_attrs_and_state_clip():
+    """Symbol JSON round-trips attrs as strings: 'False' must behave as
+    False in the op AND in the nout lambda; cell clipping applies per
+    timestep (cuDNN semantics), bounding the visible output too."""
+    T, N, I, H = 4, 2, 3, 4
+    rs = onp.random.RandomState(5)
+    x = jnp.asarray(rs.randn(T, N, I).astype("f"))
+    w = jnp.asarray(
+        rs.randn(R.rnn_param_size(1, I, H, False, "lstm")).astype("f"))
+    out = R.rnn_fused(x, w, jnp.zeros((1, N, H)), jnp.zeros((1, N, H)),
+                      state_size=H, num_layers=1, mode="lstm",
+                      state_outputs="False", bidirectional="False")
+    assert not isinstance(out, tuple)          # string False == False
+    assert out.shape == (T, N, H)
+    # per-step clip: with a tiny bound, |h_t| <= tanh(bound) at EVERY step
+    big = R.rnn_fused(x * 50, w * 50, jnp.zeros((1, N, H)),
+                      jnp.zeros((1, N, H)), state_size=H, num_layers=1,
+                      mode="lstm", lstm_state_clip_min=-0.1,
+                      lstm_state_clip_max=0.1)
+    assert float(jnp.abs(big).max()) <= onp.tanh(0.1) + 1e-6
+
+
+def test_masked_softmax_semantics():
+    x = jnp.asarray([[1.0, 2.0, 3.0]])
+    m = jnp.asarray([[1, 1, 0]])
+    y = onp.asarray(get_op("masked_softmax")(x, m))
+    assert y[0, 2] == 0.0
+    onp.testing.assert_allclose(y[0, :2].sum(), 1.0, rtol=1e-6)
+    ly = onp.asarray(get_op("masked_log_softmax")(x, m, axis=-1))
+    onp.testing.assert_allclose(ly[0, :2], onp.log(y[0, :2]), rtol=1e-5)
