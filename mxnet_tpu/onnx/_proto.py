@@ -106,6 +106,9 @@ def attr(name, value):
         if value and isinstance(value[0], float):
             body += b"".join(f_float(7, v) for v in value)
             body += f_int(20, ATTR_FLOATS)
+        elif value and isinstance(value[0], str):
+            body += b"".join(f_bytes(9, v.encode()) for v in value)
+            body += f_int(20, ATTR_STRINGS)
         else:
             body += b"".join(f_int(8, int(v)) for v in value)
             body += f_int(20, ATTR_INTS)
@@ -219,7 +222,7 @@ def parse_node(buf):
 
 
 def _parse_attr(buf):
-    name, val, ints, floats = None, None, [], []
+    name, val, ints, floats, strings = None, None, [], [], []
     for field, wt, v in _fields(buf):
         if field == 1:
             name = v.decode()
@@ -235,10 +238,14 @@ def _parse_attr(buf):
             floats.append(v)
         elif field == 8:
             ints.append(v)
+        elif field == 9:
+            strings.append(v.decode())
     if ints:
         val = ints
     elif floats:
         val = floats
+    elif strings:
+        val = strings
     return name, val
 
 

@@ -20,11 +20,13 @@ __all__ = ["export_model"]
 
 
 class _Ctx:
-    def __init__(self):
+    def __init__(self, opset=11):
         self.nodes = []        # encoded NodeProtos
         self.initializers = []
         self._counter = 0
         self.structs = {}      # id(sym-node) -> ShapeDtypeStruct
+        self.opset = opset     # 11 (default) or 13 — see export_model
+        self.param_arrays = {}  # full static param values (RNN packing)
 
     def dtype_of(self, sym_node, default=_np.float32):
         st = self.structs.get(id(sym_node))
@@ -48,6 +50,47 @@ class _Ctx:
     def const_i64(self, base, vals):
         return self.add_init(self.fresh(base),
                              _np.asarray(vals, _np.int64))
+
+    # opset-sensitive emissions: opset 13 moved `axes`/`split` from
+    # attributes to inputs for Squeeze/Unsqueeze/ReduceSum/Split
+    # (reference keeps twin tables _op_translations_opset12/13.py;
+    # here one emission helper switches on ctx.opset)
+    def squeeze(self, ins, outs, axes, name=""):
+        if axes is None:
+            self.add_node("Squeeze", ins, outs, name)
+        elif self.opset >= 13:
+            ax = self.const_i64((name or outs[0]) + "_axes", list(axes))
+            self.add_node("Squeeze", [ins[0], ax], outs, name)
+        else:
+            self.add_node("Squeeze", ins, outs, name,
+                          {"axes": list(axes)})
+
+    def unsqueeze(self, ins, outs, axes, name=""):
+        if self.opset >= 13:
+            ax = self.const_i64((name or outs[0]) + "_axes", list(axes))
+            self.add_node("Unsqueeze", [ins[0], ax], outs, name)
+        else:
+            self.add_node("Unsqueeze", ins, outs, name,
+                          {"axes": list(axes)})
+
+    def reduce_sum(self, ins, outs, axes, keepdims, name=""):
+        attrs = {"keepdims": int(keepdims)}
+        if axes is not None and self.opset >= 13:
+            ax = self.const_i64((name or outs[0]) + "_axes", list(axes))
+            self.add_node("ReduceSum", [ins[0], ax], outs, name, attrs)
+        else:
+            if axes is not None:
+                attrs["axes"] = list(axes)
+            self.add_node("ReduceSum", ins, outs, name, attrs)
+
+    def split(self, ins, outs, axis, sizes, name=""):
+        if self.opset >= 13:
+            sp = self.const_i64((name or outs[0]) + "_split", list(sizes))
+            self.add_node("Split", [ins[0], sp], outs, name,
+                          {"axis": int(axis)})
+        else:
+            self.add_node("Split", [ins[0]], outs, name,
+                          {"axis": int(axis), "split": list(sizes)})
 
 
 # Each converter: fn(ctx, sym, in_names, out_names, in_shapes) -> None
@@ -105,10 +148,16 @@ def _clip(ctx, s, ins, outs, shapes):  # noqa: ARG001
 
 def _reduce(onnx_op):
     def fn(ctx, s, ins, outs, shapes):  # noqa: ARG001
-        attrs = {"keepdims": int(bool(s.attr("keepdims")))}
+        keep = int(bool(s.attr("keepdims")))
         ax = s.attr("axis")
         if ax is not None:
-            attrs["axes"] = [ax] if isinstance(ax, int) else list(ax)
+            ax = [ax] if isinstance(ax, int) else list(ax)
+        if onnx_op == "ReduceSum":   # axes moved to an input in opset 13
+            ctx.reduce_sum(ins, outs, ax, keep, s.name)
+            return
+        attrs = {"keepdims": keep}
+        if ax is not None:
+            attrs["axes"] = ax
         ctx.add_node(onnx_op, ins, outs, s.name, attrs)
 
     return fn
@@ -187,16 +236,15 @@ def _flatten(ctx, s, ins, outs, shapes):  # noqa: ARG001
 
 @_conv("expand_dims")
 def _expand_dims(ctx, s, ins, outs, shapes):  # noqa: ARG001
-    ctx.add_node("Unsqueeze", ins, outs, s.name, {"axes": [s.attr("axis")]})
+    ctx.unsqueeze(ins, outs, [s.attr("axis")], s.name)
 
 
 @_conv("squeeze")
 def _squeeze(ctx, s, ins, outs, shapes):  # noqa: ARG001
     ax = s.attr("axis")
-    attrs = {}
     if ax is not None:
-        attrs["axes"] = [ax] if isinstance(ax, int) else list(ax)
-    ctx.add_node("Squeeze", ins, outs, s.name, attrs)
+        ax = [ax] if isinstance(ax, int) else list(ax)
+    ctx.squeeze(ins, outs, ax, s.name)
 
 
 @_conv("broadcast_to")
@@ -266,8 +314,7 @@ def _split(ctx, s, ins, outs, shapes):
     ax = s.attr("axis") if s.attr("axis") is not None else 1
     n = len(outs)
     size = shapes[0][ax] // n
-    ctx.add_node("Split", ins, outs, s.name,
-                 {"axis": ax, "split": [size] * n})
+    ctx.split(ins, outs, ax, [size] * n, s.name)
 
 
 @_conv("Concat")
@@ -282,7 +329,7 @@ def _stack(ctx, s, ins, outs, shapes):  # noqa: ARG001
     unsq = []
     for i in ins:
         u = ctx.fresh(i + "_unsq")
-        ctx.add_node("Unsqueeze", [i], [u], attrs={"axes": [ax]})
+        ctx.unsqueeze([i], [u], [ax])
         unsq.append(u)
     ctx.add_node("Concat", unsq, outs, s.name, {"axis": ax})
 
@@ -729,7 +776,7 @@ def _pick(ctx, s, ins, outs, shapes):  # noqa: ARG001
     if s.attr("keepdims"):
         ctx.add_node("Identity", [g], outs, s.name)
     else:
-        ctx.add_node("Squeeze", [g], outs, s.name, {"axes": [ax]})
+        ctx.squeeze([g], outs, [ax], s.name)
 
 
 @_conv("batch_take")
@@ -737,10 +784,10 @@ def _batch_take(ctx, s, ins, outs, shapes):  # noqa: ARG001
     idx64 = ctx.fresh(s.name + "_idx64")
     ctx.add_node("Cast", [ins[1]], [idx64], attrs={"to": 7})
     idxu = ctx.fresh(s.name + "_idxu")
-    ctx.add_node("Unsqueeze", [idx64], [idxu], attrs={"axes": [1]})
+    ctx.unsqueeze([idx64], [idxu], [1])
     g = ctx.fresh(s.name + "_g")
     ctx.add_node("GatherElements", [ins[0], idxu], [g], attrs={"axis": 1})
-    ctx.add_node("Squeeze", [g], outs, s.name, {"axes": [1]})
+    ctx.squeeze([g], outs, [1], s.name)
 
 
 @_conv("flip")
@@ -839,8 +886,7 @@ def _l2norm(ctx, s, ins, outs, shapes):
     sq = ctx.fresh(s.name + "_sq")
     ctx.add_node("Mul", [ins[0], ins[0]], [sq])
     ss = ctx.fresh(s.name + "_ss")
-    ctx.add_node("ReduceSum", [sq], [ss], attrs={"axes": axes,
-                                                 "keepdims": 1})
+    ctx.reduce_sum([sq], [ss], axes, keepdims=1)
     eps = ctx.add_init(ctx.fresh(s.name + "_eps"),
                        _np.float32(s.attr("eps") or 1e-10))
     se = ctx.fresh(s.name + "_se")
@@ -1176,17 +1222,602 @@ def _c_quantized_bn(ctx, s, ins, outs, shapes):  # noqa: ARG001
     _emit_req(ctx, s.name, y, outs)
 
 
+# ---------------------------------------------------------------------------
+# Round-3 breadth: the remaining names of the reference's registered
+# converter table (python/mxnet/onnx/mx2onnx/_op_translations/
+# _op_translations_opset12.py + _op_translations_opset13.py, 170 names).
+# ---------------------------------------------------------------------------
+
+def _out_struct(ctx, s):
+    st = ctx.structs.get(id(s))
+    if isinstance(st, (tuple, list)):
+        st = st[s._out_index or 0]
+    return st
+
+
+def _scalar_bin(onnx_op, reverse=False):
+    """Legacy `<op>_scalar` spellings: the scalar attr folds to a const
+    initializer cast to the tensor dtype (reference _op_translations:
+    scalar ops)."""
+    def fn(ctx, s, ins, outs, shapes):  # noqa: ARG001
+        dt = ctx.dtype_of(s._inputs[0])
+        c = ctx.add_init(ctx.fresh(s.name + "_scalar"),
+                         _np.asarray(s.attr("scalar"), dt))
+        pair = [c, ins[0]] if reverse else [ins[0], c]
+        ctx.add_node(onnx_op, pair, outs, s.name)
+
+    return fn
+
+
+def _scalar_cmp(onnx_op, reverse=False, negate=False):
+    def fn(ctx, s, ins, outs, shapes):  # noqa: ARG001
+        dt = ctx.dtype_of(s._inputs[0])
+        c = ctx.add_init(ctx.fresh(s.name + "_scalar"),
+                         _np.asarray(s.attr("scalar"), dt))
+        b = ctx.fresh(s.name + "_bool")
+        pair = [c, ins[0]] if reverse else [ins[0], c]
+        ctx.add_node(onnx_op, pair, [b], s.name)
+        if negate:
+            nb = ctx.fresh(s.name + "_not")
+            ctx.add_node("Not", [b], [nb])
+            b = nb
+        ctx.add_node("Cast", [b], outs,
+                     attrs={"to": P.DTYPE.get(str(dt), 1)})
+
+    return fn
+
+
+for _name, _op, _rev in [
+    ("_plus_scalar", "Add", False), ("_npi_add_scalar", "Add", False),
+    ("_minus_scalar", "Sub", False),
+    ("_npi_subtract_scalar", "Sub", False),
+    ("_rminus_scalar", "Sub", True),
+    ("_npi_rsubtract_scalar", "Sub", True),
+    ("_mul_scalar", "Mul", False), ("_npi_multiply_scalar", "Mul", False),
+    ("_div_scalar", "Div", False),
+    ("_npi_true_divide_scalar", "Div", False),
+    ("_rdiv_scalar", "Div", True),
+    ("_npi_rtrue_divide_scalar", "Div", True),
+    ("_power_scalar", "Pow", False), ("_npi_power_scalar", "Pow", False),
+    ("_rpower_scalar", "Pow", True),
+    ("_maximum_scalar", "Max", False), ("_minimum_scalar", "Min", False),
+]:
+    _CONVERTERS.setdefault(_name, _scalar_bin(_op, _rev))
+
+for _name, _op, _rev, _neg in [
+    ("_equal_scalar", "Equal", False, False),
+    ("_not_equal_scalar", "Equal", False, True),
+    ("_greater_scalar", "Greater", False, False),
+    ("_greater_equal_scalar", "Less", False, True),
+    ("_lesser_scalar", "Less", False, False),
+    ("_lesser_equal_scalar", "Greater", False, True),
+]:
+    _CONVERTERS.setdefault(_name, _scalar_cmp(_op, _rev, _neg))
+
+
+def _static_reshape(ctx, s, ins, outs, shapes):  # noqa: ARG001
+    """Any reshape-flavored op with a statically-known output shape
+    (legacy `Reshape` special codes 0/-1/-2/-3/-4, `_npx_reshape`,
+    `reshape_like`): the inferred struct already has the answer."""
+    st = _out_struct(ctx, s)
+    shp = ctx.const_i64(s.name + "_shape", list(st.shape))
+    ctx.add_node("Reshape", [ins[0], shp], outs, s.name)
+
+
+_CONVERTERS.setdefault("Reshape", _static_reshape)
+_CONVERTERS.setdefault("_npx_reshape", _static_reshape)
+_CONVERTERS.setdefault("reshape_like", _static_reshape)
+
+
+@_conv("size_array")
+def _size_array(ctx, s, ins, outs, shapes):  # noqa: ARG001
+    c = ctx.add_init(ctx.fresh(s.name + "_size"),
+                     _np.asarray([int(_np.prod(shapes[0]))], _np.int64))
+    ctx.add_node("Identity", [c], outs, s.name)
+
+
+def _static_fill(fill):
+    def fn(ctx, s, ins, outs, shapes):  # noqa: ARG001
+        st = _out_struct(ctx, s)
+        v = s.attr("value") if fill is None else fill
+        c = ctx.add_init(ctx.fresh(s.name + "_c"),
+                         _np.full(st.shape, v, _np.dtype(st.dtype)))
+        ctx.add_node("Identity", [c], outs, s.name)
+
+    return fn
+
+
+for _name, _fill in [("_zeros", 0), ("_npi_zeros", 0), ("_ones", 1),
+                     ("_npi_ones", 1), ("_full", None)]:
+    _CONVERTERS.setdefault(_name, _static_fill(_fill))
+
+
+def _static_arange(ctx, s, ins, outs, shapes):  # noqa: ARG001
+    st = _out_struct(ctx, s)
+    start = float(s.attr("start") or 0.0)
+    step = float(s.attr("step") if s.attr("step") is not None else 1.0)
+    repeat = int(s.attr("repeat") or 1)
+    n = int(_np.prod(st.shape))
+    base = start + step * _np.arange(-(-n // repeat))
+    vals = (_np.repeat(base, repeat)[:n] if repeat > 1 else base[:n])
+    c = ctx.add_init(ctx.fresh(s.name + "_ar"),
+                     vals.reshape(st.shape).astype(st.dtype))
+    ctx.add_node("Identity", [c], outs, s.name)
+
+
+_CONVERTERS.setdefault("_arange", _static_arange)
+_CONVERTERS.setdefault("_npi_arange", _static_arange)
+_CONVERTERS.setdefault("_contrib_arange_like",
+                       _CONVERTERS.get("arange_like"))
+
+_CONVERTERS.setdefault("_copy", _simple("Identity"))
+_CONVERTERS.setdefault("MakeLoss", _simple("Identity"))
+_CONVERTERS.setdefault("add_n", _simple("Sum"))
+
+
+@_conv("SoftmaxOutput")
+def _softmax_output(ctx, s, ins, outs, shapes):  # noqa: ARG001
+    # inference export: plain class-axis softmax; the label input and grad
+    # scaling are training-only (reference opset13 convert_softmax_output)
+    ctx.add_node("Softmax", [ins[0]], outs[:1], s.name, {"axis": 1})
+
+
+@_conv("LogisticRegressionOutput")
+def _logistic_output(ctx, s, ins, outs, shapes):  # noqa: ARG001
+    ctx.add_node("Sigmoid", [ins[0]], outs[:1], s.name)
+
+
+@_conv("SequenceMask")
+def _sequence_mask(ctx, s, ins, outs, shapes):
+    use_sl = str(s.attr("use_sequence_length")) not in (
+        "None", "False", "0", "false", "")
+    if not use_sl or len(ins) < 2:
+        ctx.add_node("Identity", [ins[0]], outs, s.name)
+        return
+    ax = int(s.attr("axis") or 0)          # time axis: 0 (TN...) or 1 (NT...)
+    value = float(s.attr("value") or 0.0)
+    rank = len(shapes[0])
+    T = shapes[0][ax]
+    pos_shape = (T, 1) if ax == 0 else (1, T)
+    pos = ctx.add_init(ctx.fresh(s.name + "_pos"),
+                       _np.arange(T, dtype=_np.float32).reshape(pos_shape))
+    sl = ctx.fresh(s.name + "_slf")
+    ctx.add_node("Cast", [ins[1]], [sl], attrs={"to": 1})
+    slr = ctx.fresh(s.name + "_slr")
+    shp = ctx.const_i64(s.name + "_slshape",
+                        [1, -1] if ax == 0 else [-1, 1])
+    ctx.add_node("Reshape", [sl, shp], [slr])
+    mask = ctx.fresh(s.name + "_mask")
+    ctx.add_node("Less", [pos, slr], [mask])       # (T,N) / (N,T) bool
+    cur = mask
+    if rank > 2:
+        u = ctx.fresh(s.name + "_masku")
+        ctx.unsqueeze([cur], [u], list(range(2, rank)))
+        cur = u
+    vc = ctx.add_init(ctx.fresh(s.name + "_val"),
+                      _np.asarray(value, ctx.dtype_of(s._inputs[0])))
+    ctx.add_node("Where", [cur, ins[0], vc], outs, s.name)
+
+
+@_conv("ROIPooling")
+def _roi_pooling(ctx, s, ins, outs, shapes):  # noqa: ARG001
+    pooled = s.attr("pooled_size")
+    ctx.add_node("MaxRoiPool", ins, outs, s.name,
+                 {"pooled_shape": [int(p) for p in pooled],
+                  "spatial_scale": float(s.attr("spatial_scale") or 1.0)})
+
+
+def _maybe_transpose_last2(ctx, name, x, rank, do):
+    if not do:
+        return x
+    perm = list(range(rank))
+    perm[-1], perm[-2] = perm[-2], perm[-1]
+    t = ctx.fresh(name + "_T")
+    ctx.add_node("Transpose", [x], [t], attrs={"perm": perm})
+    return t
+
+
+def _gemm2(ctx, s, ins, outs, shapes):
+    from ..ops.rnn import _battr
+
+    ta = s.attr("transpose_a") is not None and _battr(s.attr("transpose_a"))
+    tb = s.attr("transpose_b") is not None and _battr(s.attr("transpose_b"))
+    alpha = float(s.attr("alpha") if s.attr("alpha") is not None else 1.0)
+    a = _maybe_transpose_last2(ctx, s.name + "_a", ins[0],
+                               len(shapes[0]), ta)
+    b = _maybe_transpose_last2(ctx, s.name + "_b", ins[1],
+                               len(shapes[1]), tb)
+    if alpha == 1.0:
+        ctx.add_node("MatMul", [a, b], outs, s.name)
+        return
+    mm = ctx.fresh(s.name + "_mm")
+    ctx.add_node("MatMul", [a, b], [mm], s.name)
+    al = ctx.add_init(ctx.fresh(s.name + "_alpha"),
+                      _np.asarray(alpha, ctx.dtype_of(s._inputs[0])))
+    ctx.add_node("Mul", [mm, al], outs)
+
+
+_CONVERTERS.setdefault("linalg_gemm2", _gemm2)
+_CONVERTERS.setdefault("_linalg_gemm2", _gemm2)
+
+
+def _selfatt_split_head(ctx, name, qkv, L, B, heads, D, which):
+    """Interleaved (L, B, H*3*D) -> (B*heads, L, D) for q/k/v slot
+    `which` (reference transformer.cc interleaved layout)."""
+    r5 = ctx.fresh(name + "_r5")
+    shp = ctx.const_i64(name + "_s5", [L, B, heads, 3, D])
+    ctx.add_node("Reshape", [qkv, shp], [r5])
+    sl = ctx.fresh(name + f"_slot{which}")
+    starts = ctx.const_i64(name + "_st", [which])
+    ends = ctx.const_i64(name + "_en", [which + 1])
+    axes = ctx.const_i64(name + "_ax", [3])
+    ctx.add_node("Slice", [r5, starts, ends, axes], [sl])
+    sq = ctx.fresh(name + "_sq")
+    ctx.squeeze([sl], [sq], [3])
+    tr = ctx.fresh(name + "_tr")
+    ctx.add_node("Transpose", [sq], [tr], attrs={"perm": [1, 2, 0, 3]})
+    out = ctx.fresh(name + "_bh")
+    shp2 = ctx.const_i64(name + "_s3", [B * heads, L, D])
+    ctx.add_node("Reshape", [tr, shp2], [out])
+    return out
+
+
+@_conv("_contrib_interleaved_matmul_selfatt_qk")
+def _c_selfatt_qk(ctx, s, ins, outs, shapes):
+    heads = int(s.attr("heads"))
+    L, B, E = shapes[0]
+    D = E // (3 * heads)
+    q = _selfatt_split_head(ctx, s.name + "_q", ins[0], L, B, heads, D, 0)
+    k = _selfatt_split_head(ctx, s.name + "_k", ins[0], L, B, heads, D, 1)
+    kt = ctx.fresh(s.name + "_kT")
+    ctx.add_node("Transpose", [k], [kt], attrs={"perm": [0, 2, 1]})
+    mm = ctx.fresh(s.name + "_mm")
+    ctx.add_node("MatMul", [q, kt], [mm], s.name)
+    scale = ctx.add_init(ctx.fresh(s.name + "_scale"),
+                         _np.asarray(1.0 / _np.sqrt(D),
+                                     ctx.dtype_of(s._inputs[0])))
+    ctx.add_node("Mul", [mm, scale], outs)
+
+
+@_conv("_contrib_interleaved_matmul_selfatt_valatt")
+def _c_selfatt_valatt(ctx, s, ins, outs, shapes):
+    heads = int(s.attr("heads"))
+    L, B, E = shapes[0]
+    D = E // (3 * heads)
+    v = _selfatt_split_head(ctx, s.name + "_v", ins[0], L, B, heads, D, 2)
+    mm = ctx.fresh(s.name + "_mm")
+    ctx.add_node("MatMul", [ins[1], v], [mm], s.name)
+    r4 = ctx.fresh(s.name + "_r4")
+    shp = ctx.const_i64(s.name + "_s4", [B, heads, L, D])
+    ctx.add_node("Reshape", [mm, shp], [r4])
+    tr = ctx.fresh(s.name + "_tr")
+    ctx.add_node("Transpose", [r4], [tr], attrs={"perm": [2, 0, 1, 3]})
+    shp2 = ctx.const_i64(s.name + "_s3", [L, B, heads * D])
+    ctx.add_node("Reshape", [tr, shp2], outs, s.name)
+
+
+@_conv("_contrib_box_decode")
+def _c_box_decode(ctx, s, ins, outs, shapes):  # noqa: ARG001
+    """Decode center/size deltas against anchors (bounding_box.cc
+    BoxDecode) as a Slice/Mul/Exp/Concat chain."""
+    stds = [float(s.attr(f"std{i}") if s.attr(f"std{i}") is not None
+                  else d) for i, d in enumerate((0.1, 0.1, 0.2, 0.2))]
+    fmt = str(s.attr("format") or "corner")
+    clip = float(s.attr("clip") if s.attr("clip") is not None else -1.0)
+    dt = ctx.dtype_of(s._inputs[0])
+
+    def chan(base, src, i):
+        st = ctx.const_i64(base + "_st", [i])
+        en = ctx.const_i64(base + "_en", [i + 1])
+        ax = ctx.const_i64(base + "_ax", [2])
+        out = ctx.fresh(base)
+        ctx.add_node("Slice", [src, st, en, ax], [out])
+        return out
+
+    d = [chan(s.name + f"_d{i}", ins[0], i) for i in range(4)]
+    a = [chan(s.name + f"_a{i}", ins[1], i) for i in range(4)]
+
+    def binop(op, x, y, base):
+        out = ctx.fresh(base)
+        ctx.add_node(op, [x, y], [out])
+        return out
+
+    def constf(v, base):
+        return ctx.add_init(ctx.fresh(base), _np.asarray(v, dt))
+
+    if fmt == "corner":
+        aw = binop("Sub", a[2], a[0], s.name + "_aw")
+        ah = binop("Sub", a[3], a[1], s.name + "_ah")
+        half = constf(0.5, s.name + "_half")
+        acx = binop("Add", a[0],
+                    binop("Mul", aw, half, s.name + "_awh"),
+                    s.name + "_acx")
+        acy = binop("Add", a[1],
+                    binop("Mul", ah, half, s.name + "_ahh"),
+                    s.name + "_acy")
+    else:
+        acx, acy, aw, ah = a
+    cx = binop("Add", binop("Mul", binop(
+        "Mul", d[0], constf(stds[0], s.name + "_s0"), s.name + "_ds0"),
+        aw, s.name + "_dw"), acx, s.name + "_cx")
+    cy = binop("Add", binop("Mul", binop(
+        "Mul", d[1], constf(stds[1], s.name + "_s1"), s.name + "_ds1"),
+        ah, s.name + "_dh"), acy, s.name + "_cy")
+    ew = ctx.fresh(s.name + "_ew")
+    ctx.add_node("Exp", [binop("Mul", d[2], constf(
+        stds[2], s.name + "_s2"), s.name + "_ds2")], [ew])
+    eh = ctx.fresh(s.name + "_eh")
+    ctx.add_node("Exp", [binop("Mul", d[3], constf(
+        stds[3], s.name + "_s3c"), s.name + "_ds3")], [eh])
+    halfc = constf(0.5, s.name + "_halfc")
+    w2 = binop("Mul", binop("Mul", ew, aw, s.name + "_w"), halfc,
+               s.name + "_w2")
+    h2 = binop("Mul", binop("Mul", eh, ah, s.name + "_h"), halfc,
+               s.name + "_h2")
+    parts = [binop("Sub", cx, w2, s.name + "_x0"),
+             binop("Sub", cy, h2, s.name + "_y0"),
+             binop("Add", cx, w2, s.name + "_x1"),
+             binop("Add", cy, h2, s.name + "_y1")]
+    if clip > 0:
+        cat = ctx.fresh(s.name + "_cat")
+        ctx.add_node("Concat", parts, [cat], attrs={"axis": 2})
+        lo = constf(0.0, s.name + "_lo")
+        hi = constf(clip, s.name + "_hi")
+        ctx.add_node("Clip", [cat, lo, hi], outs, s.name)
+    else:
+        ctx.add_node("Concat", parts, outs, s.name, {"axis": 2})
+
+
+@_conv("_contrib_AdaptiveAvgPooling2D")
+def _c_adaptive_avg_pool(ctx, s, ins, outs, shapes):
+    osz = s.attr("output_size") or 1
+    oh, ow = ((int(osz), int(osz)) if isinstance(osz, int)
+              else (int(osz[0]), int(osz[-1])))
+    h, w = shapes[0][2], shapes[0][3]
+    if (oh, ow) == (1, 1):
+        ctx.add_node("GlobalAveragePool", ins, outs, s.name)
+        return
+    if h % oh or w % ow:
+        raise NotImplementedError(
+            f"AdaptiveAvgPooling2D {h}x{w}->{oh}x{ow}: non-divisible "
+            "bins have data-dependent windows ONNX AveragePool can't "
+            "express")
+    ctx.add_node("AveragePool", ins, outs, s.name,
+                 {"kernel_shape": [h // oh, w // ow],
+                  "strides": [h // oh, w // ow]})
+
+
+@_conv("_contrib_BilinearResize2D")
+def _c_bilinear_resize(ctx, s, ins, outs, shapes):  # noqa: ARG001
+    st = _out_struct(ctx, s)
+    roi = ctx.add_init(ctx.fresh(s.name + "_roi"),
+                       _np.zeros((0,), _np.float32))
+    scales = ctx.add_init(ctx.fresh(s.name + "_scales"),
+                          _np.zeros((0,), _np.float32))
+    sizes = ctx.const_i64(s.name + "_sizes", list(st.shape))
+    ctx.add_node("Resize", [ins[0], roi, scales, sizes], outs, s.name,
+                 {"mode": "linear",
+                  "coordinate_transformation_mode": "align_corners"})
+
+
+def _random_node(onnx_op):
+    def fn(ctx, s, ins, outs, shapes):  # noqa: ARG001
+        st = _out_struct(ctx, s)
+        attrs = {"shape": list(st.shape),
+                 "dtype": P.DTYPE.get(str(st.dtype), 1)}
+        def first_set(*keys, default):
+            for k in keys:
+                v = s.attr(k)
+                if v is not None:
+                    return float(v)
+            return float(default)
+
+        if onnx_op == "RandomNormal":
+            attrs["mean"] = first_set("loc", "mu", default=0.0)
+            attrs["scale"] = first_set("scale", "sigma", default=1.0)
+        else:
+            attrs["low"] = first_set("low", default=0.0)
+            attrs["high"] = first_set("high", default=1.0)
+        ctx.add_node(onnx_op, [], outs, s.name, attrs)
+
+    return fn
+
+
+for _name, _op in [("_random_normal", "RandomNormal"),
+                   ("_npi_normal", "RandomNormal"),
+                   ("_random_uniform", "RandomUniform"),
+                   ("_npi_uniform", "RandomUniform")]:
+    _CONVERTERS.setdefault(_name, _random_node(_op))
+
+
+@_conv("_sample_multinomial")
+def _c_sample_multinomial(ctx, s, ins, outs, shapes):  # noqa: ARG001
+    st = ctx.structs.get(id(s))
+    if isinstance(st, (tuple, list)):
+        idx_st = st[0]
+    else:
+        idx_st = st
+    n = int(_np.prod(idx_st.shape[len(shapes[0]) - 1:])) if len(
+        idx_st.shape) >= len(shapes[0]) else 1
+    lg = ctx.fresh(s.name + "_log")
+    ctx.add_node("Log", [ins[0]], [lg])
+    mn = ctx.fresh(s.name + "_mn")
+    ctx.add_node("Multinomial", [lg], [mn], s.name,
+                 {"sample_size": max(n, 1), "dtype": 6})
+    shp = ctx.const_i64(s.name + "_shape", list(idx_st.shape))
+    ctx.add_node("Reshape", [mn, shp], outs[:1])
+    if len(outs) > 1:
+        # get_prob=True: gather each drawn index's log-probability
+        k = shapes[0][-1]
+        batch = list(shapes[0][:-1])
+        S = list(idx_st.shape[len(batch):])
+        lge = ctx.fresh(s.name + "_lge")
+        rshp = ctx.const_i64(s.name + "_lgshape",
+                             batch + [1] * len(S) + [k])
+        ctx.add_node("Reshape", [lg, rshp], [lge])
+        lgb = ctx.fresh(s.name + "_lgb")
+        tgt = ctx.const_i64(s.name + "_lgtarget",
+                            batch + S + [k])
+        ctx.add_node("Expand", [lge, tgt], [lgb])
+        idx64 = ctx.fresh(s.name + "_idx64")
+        ctx.add_node("Cast", [outs[0]], [idx64], attrs={"to": 7})
+        idxu = ctx.fresh(s.name + "_idxu")
+        ctx.unsqueeze([idx64], [idxu], [len(batch) + len(S)])
+        g = ctx.fresh(s.name + "_g")
+        ctx.add_node("GatherElements", [lgb, idxu], [g],
+                     attrs={"axis": len(batch) + len(S)})
+        ctx.squeeze([g], [outs[1]], [len(batch) + len(S)])
+
+
+# ---- fused RNN (reference opset13 convert_RNN) ---------------------------
+
+_ONNX_GATE_PERM = {"lstm": [0, 3, 1, 2],   # mx [i,f,g,o] -> onnx [i,o,f,c]
+                   "gru": [1, 0, 2]}       # mx [r,z,n]   -> onnx [z,r,h]
+
+
+@_conv("RNN")
+def _rnn(ctx, s, ins, outs, shapes):
+    """Fused RNN -> ONNX LSTM/GRU/RNN node chain. Parameters must be a
+    static initializer (they always are for exported models); the flat
+    cuDNN blob is sliced host-side with ops.rnn.slice_rnn_params and
+    re-packed into ONNX W/R/B with the gate-order permutation."""
+    from ..ops.rnn import _GATES, slice_rnn_params
+
+    mode = str(s.attr("mode") or "lstm")
+    H = int(s.attr("state_size"))
+    L = int(s.attr("num_layers") or 1)
+    bi = str(s.attr("bidirectional")) not in ("None", "False", "0",
+                                              "false", "")
+    state_out = str(s.attr("state_outputs")) not in ("None", "False", "0",
+                                                     "false", "")
+    if s.attr("projection_size"):
+        raise NotImplementedError("LSTMP projection has no ONNX RNN form")
+    D = 2 if bi else 1
+    G = _GATES[mode]
+    T, N, I = shapes[0]
+    w_name = s._inputs[1]._name
+    w = ctx.param_arrays.get(w_name)
+    if w is None:
+        raise NotImplementedError(
+            f"RNN export needs static parameters ({w_name!r} is a "
+            "runtime input)")
+    blks = slice_rnn_params(_np.asarray(w, _np.float32).ravel(), mode, L,
+                            I, H, bi)
+    perm = _ONNX_GATE_PERM.get(mode)
+
+    def gate_perm(mat):
+        if perm is None:
+            return mat
+        return mat.reshape((G, H) + mat.shape[1:])[perm].reshape(mat.shape)
+
+    onnx_op = {"lstm": "LSTM", "gru": "GRU",
+               "rnn_relu": "RNN", "rnn_tanh": "RNN"}[mode]
+    x = ins[0]
+    hs, cs = [], []
+    for layer in range(L):
+        base = f"{s.name}_l{layer}"
+        bl = [blks[layer * D + d] for d in range(D)]
+        W = _np.stack([gate_perm(b["wx"]) for b in bl])
+        R = _np.stack([gate_perm(b["wh"]) for b in bl])
+        B = _np.stack([_np.concatenate([gate_perm(b["bx"]),
+                                        gate_perm(b["bh"])]) for b in bl])
+        wn = ctx.add_init(ctx.fresh(base + "_W"), W.astype(_np.float32))
+        rn = ctx.add_init(ctx.fresh(base + "_R"), R.astype(_np.float32))
+        bn = ctx.add_init(ctx.fresh(base + "_B"), B.astype(_np.float32))
+        # initial states: rows [layer*D, (layer+1)*D) of the state input
+        def state_slice(src, tag):
+            st = ctx.const_i64(base + f"_{tag}st", [layer * D])
+            en = ctx.const_i64(base + f"_{tag}en", [(layer + 1) * D])
+            ax = ctx.const_i64(base + f"_{tag}ax", [0])
+            out = ctx.fresh(base + f"_{tag}")
+            ctx.add_node("Slice", [src, st, en, ax], [out])
+            return out
+
+        h0 = state_slice(ins[2], "h0")
+        node_ins = [x, wn, rn, bn, "", h0]
+        if mode == "lstm":
+            node_ins.append(state_slice(ins[3], "c0"))
+        attrs = {"hidden_size": H,
+                 "direction": "bidirectional" if bi else "forward"}
+        if mode == "gru":
+            attrs["linear_before_reset"] = 1   # cuDNN/mx candidate form
+        elif mode == "rnn_relu":
+            attrs["activations"] = ["Relu"] * D
+        elif mode == "rnn_tanh":
+            attrs["activations"] = ["Tanh"] * D
+        y = ctx.fresh(base + "_Y")
+        yh = ctx.fresh(base + "_Yh")
+        node_outs = [y, yh]
+        if mode == "lstm":
+            node_outs.append(ctx.fresh(base + "_Yc"))
+        ctx.add_node(onnx_op, node_ins, node_outs, base, attrs)
+        hs.append(yh)
+        if mode == "lstm":
+            cs.append(node_outs[2])
+        # Y (T, D, N, H) -> (T, N, D*H) for the next layer / output
+        tr = ctx.fresh(base + "_Ytr")
+        ctx.add_node("Transpose", [y], [tr], attrs={"perm": [0, 2, 1, 3]})
+        nxt = ctx.fresh(base + "_Yr")
+        shp = ctx.const_i64(base + "_Yshape", [T, N, D * H])
+        ctx.add_node("Reshape", [tr, shp], [nxt])
+        x = nxt
+    ctx.add_node("Identity", [x], outs[:1], s.name)
+    if state_out and len(outs) > 1:
+        if L == 1:
+            ctx.add_node("Identity", [hs[0]], [outs[1]])
+        else:
+            ctx.add_node("Concat", hs, [outs[1]], attrs={"axis": 0})
+        if mode == "lstm" and len(outs) > 2:
+            if L == 1:
+                ctx.add_node("Identity", [cs[0]], [outs[2]])
+            else:
+                ctx.add_node("Concat", cs, [outs[2]], attrs={"axis": 0})
+
+
+# ---- alias spellings onto existing emission logic ------------------------
+
+_ALIAS_TABLE = {
+    "_npi_add": "broadcast_add", "_npi_subtract": "broadcast_sub",
+    "_npi_multiply": "broadcast_mul", "_npi_true_divide": "broadcast_div",
+    "_npi_power": "power", "_npi_absolute": "abs", "_npi_negative":
+    "negative", "_npi_exp": "exp", "_npi_log": "log", "_npi_sqrt": "sqrt",
+    "_npi_square": "square", "_npi_tanh": "tanh", "_npi_sin": "sin",
+    "_npi_cos": "cos", "_npi_tan": "tan", "_npi_arcsin": "arcsin",
+    "_npi_arccos": "arccos", "_npi_arctan": "arctan",
+    "_npi_ceil": "ceil", "_npi_floor": "floor",
+    "_npi_reciprocal": "reciprocal",
+    "_npi_logical_and": "broadcast_logical_and",
+    "_npi_logical_or": "broadcast_logical_or",
+    "_npi_logical_xor": "broadcast_logical_xor",
+    "_npi_logical_not": "logical_not",
+    "_npi_sum": "sum", "_npi_mean": "mean", "_npi_max": "max",
+    "_npi_min": "min", "_npi_prod": "prod",
+    "_npi_squeeze": "squeeze", "_npi_broadcast_to": "broadcast_to",
+    "_npx_relu": "relu", "_npx_sigmoid": "sigmoid",
+    "_maximum": "maximum", "_minimum": "minimum", "_power": "power",
+    "sum_axis": "sum", "BlockGrad": "identity",
+}
+for _alias, _target in _ALIAS_TABLE.items():
+    if _target in _CONVERTERS:
+        _CONVERTERS.setdefault(_alias, _CONVERTERS[_target])
+
+
 def export_model(sym, params, in_shapes=None, in_types=_np.float32,
                  onnx_file_path="model.onnx", verbose=False, dynamic=False,
-                 dynamic_input_shapes=None):  # noqa: ARG001
+                 dynamic_input_shapes=None, opset_version=11):  # noqa: ARG001
     """Export a symbol + params to an ONNX file
     (reference: mx.onnx.export_model, mx2onnx/_export_model.py).
 
     sym: Symbol or path to a saved symbol json; params: dict name→NDArray
     (or path to a saved params file); in_shapes: list of shapes for the
     data inputs (arguments not found in params), in graph order.
-    Returns onnx_file_path.
+    opset_version: 11 (default, attr-form Squeeze/Unsqueeze/ReduceSum/
+    Split) or 12/13 (reference supports both via twin tables; 13 moves
+    those ops' axes/split to inputs). Returns onnx_file_path.
     """
+    if int(opset_version) not in (11, 12, 13):
+        raise ValueError(f"opset_version {opset_version} unsupported "
+                         "(11, 12, 13)")
     from ..ndarray.ndarray import NDArray
 
     if isinstance(sym, str):
@@ -1219,10 +1850,11 @@ def export_model(sym, params, in_shapes=None, in_types=_np.float32,
     order = [s for s in sym._topo() if s._op != "_group"]
     shapes = _infer_all_shapes(order, input_structs)
 
-    ctx = _Ctx()
+    ctx = _Ctx(opset=int(opset_version))
     ctx.structs = shapes
     # scalar params (quantization ranges) fold into constant QDQ scales
     ctx.param_values = {n: a for n, a in np_params.items() if a.ndim == 0}
+    ctx.param_arrays = np_params  # full static values (RNN blob slicing)
     tensor_names = {}  # id(sym-node) -> list of output tensor names
     converted = {}     # node name -> output tensor names (dedups the
     #                    out_index clones _flat_outputs creates)
@@ -1288,7 +1920,7 @@ def export_model(sym, params, in_shapes=None, in_types=_np.float32,
 
     g = P.graph(ctx.nodes, "mxnet_tpu_graph", ctx.initializers, in_infos,
                 out_infos)
-    buf = P.model(g)
+    buf = P.model(g, opset=ctx.opset)
     P.check_model(buf)
     with open(onnx_file_path, "wb") as f:
         f.write(buf)

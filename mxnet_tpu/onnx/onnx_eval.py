@@ -235,10 +235,146 @@ _REDUCE = {
 }
 
 
+def _max_roi_pool(x, rois, attrs):
+    """MaxRoiPool: rois (R, 5) = [batch_idx, x1, y1, x2, y2] (matches the
+    mx ROIPooling layout)."""
+    ph, pw = (int(v) for v in attrs["pooled_shape"])
+    scale = float(attrs.get("spatial_scale", 1.0))
+    x = _np.asarray(x)
+    out = []
+    for roi in _np.asarray(rois):
+        b = int(roi[0])
+        x1, y1, x2, y2 = [int(round(float(v) * scale)) for v in roi[1:]]
+        h = max(y2 - y1 + 1, 1)
+        w = max(x2 - x1 + 1, 1)
+        pooled = _np.full((x.shape[1], ph, pw), -_np.inf, x.dtype)
+        for i in range(ph):
+            hs = y1 + (i * h) // ph
+            he = y1 + max(-((-(i + 1) * h) // ph), (i * h) // ph + 1)
+            for j in range(pw):
+                ws = x1 + (j * w) // pw
+                we = x1 + max(-((-(j + 1) * w) // pw),
+                              (j * w) // pw + 1)
+                hs_c = min(max(hs, 0), x.shape[2])
+                he_c = min(max(he, 0), x.shape[2])
+                ws_c = min(max(ws, 0), x.shape[3])
+                we_c = min(max(we, 0), x.shape[3])
+                if he_c > hs_c and we_c > ws_c:
+                    pooled[:, i, j] = x[b, :, hs_c:he_c,
+                                        ws_c:we_c].max((1, 2))
+        out.append(pooled)
+    return jnp.asarray(_np.stack(out))
+
+
+def _resize(x, sizes, attrs):
+    """Resize, linear + align_corners (the form the BilinearResize2D
+    converter emits)."""
+    mode = attrs.get("mode", "nearest")
+    tr = attrs.get("coordinate_transformation_mode", "half_pixel")
+    x = _np.asarray(x)
+    oh, ow = (int(sizes[-2]), int(sizes[-1]))
+    h, w = x.shape[-2], x.shape[-1]
+    if mode != "linear" or tr != "align_corners":
+        raise NotImplementedError(
+            f"Resize mode={mode}/{tr} (only linear+align_corners)")
+
+    def coords(out_n, in_n):
+        if out_n == 1 or in_n == 1:
+            return _np.zeros(out_n)
+        return _np.arange(out_n) * ((in_n - 1) / (out_n - 1))
+
+    ys, xs = coords(oh, h), coords(ow, w)
+    y0 = _np.clip(_np.floor(ys).astype(int), 0, h - 1)
+    x0 = _np.clip(_np.floor(xs).astype(int), 0, w - 1)
+    y1 = _np.minimum(y0 + 1, h - 1)
+    x1 = _np.minimum(x0 + 1, w - 1)
+    wy = (ys - y0)[None, None, :, None]
+    wx = (xs - x0)[None, None, None, :]
+
+    def g(yy, xx):
+        return x[..., yy, :][..., :, xx]
+
+    out = ((1 - wy) * (1 - wx) * g(y0, x0) + (1 - wy) * wx * g(y0, x1)
+           + wy * (1 - wx) * g(y1, x0) + wy * wx * g(y1, x1))
+    return jnp.asarray(out.astype(x.dtype))
+
+
+def _rnn_eval(op, ins, attrs):
+    """LSTM/GRU/RNN per the ONNX spec: gate order LSTM [i,o,f,c],
+    GRU [z,r,h] (linear_before_reset honored), X (T,N,I),
+    W (D,G*H,I), R (D,G*H,H), B (D,2*G*H). Outputs
+    (Y (T,D,N,H), Y_h (D,N,H)[, Y_c])."""
+    x = _np.asarray(ins[0], _np.float64)
+    W = _np.asarray(ins[1], _np.float64)
+    R = _np.asarray(ins[2], _np.float64)
+    T, N, _I = x.shape
+    D, GH, _ = W.shape
+    H = int(attrs["hidden_size"])
+    G = GH // H
+    B = (_np.asarray(ins[3], _np.float64) if len(ins) > 3
+         and ins[3] is not None else _np.zeros((D, 2 * G * H)))
+    h0 = (_np.asarray(ins[5], _np.float64) if len(ins) > 5
+          and ins[5] is not None else _np.zeros((D, N, H)))
+    c0 = (_np.asarray(ins[6], _np.float64) if len(ins) > 6
+          and ins[6] is not None else _np.zeros((D, N, H)))
+    acts = attrs.get("activations")
+    sig = lambda v: 1.0 / (1.0 + _np.exp(-v))  # noqa: E731
+    Y = _np.zeros((T, D, N, H))
+    Yh = _np.zeros((D, N, H))
+    Yc = _np.zeros((D, N, H))
+    lbr = int(attrs.get("linear_before_reset", 0))
+    for d in range(D):
+        Wb, Rb = B[d, :G * H], B[d, G * H:]
+        h, c = h0[d], c0[d]
+        order = range(T) if d == 0 else range(T - 1, -1, -1)
+        for t in order:
+            gx = x[t] @ W[d].T + Wb
+            if op == "LSTM":
+                gates = (gx + h @ R[d].T + Rb).reshape(N, 4, H)
+                i, o, f = sig(gates[:, 0]), sig(gates[:, 1]), \
+                    sig(gates[:, 2])
+                c = f * c + i * _np.tanh(gates[:, 3])
+                h = o * _np.tanh(c)
+            elif op == "GRU":
+                xz, xr, xh = (gx.reshape(N, 3, H)[:, k] for k in range(3))
+                gh = (h @ R[d].T + Rb).reshape(N, 3, H)
+                z = sig(xz + gh[:, 0])
+                r = sig(xr + gh[:, 1])
+                if lbr:
+                    hcand = _np.tanh(xh + r * gh[:, 2])
+                else:
+                    Rh = R[d][2 * H:3 * H]
+                    hcand = _np.tanh(xh + (r * h) @ Rh.T
+                                     + Rb[2 * H:3 * H])
+                h = (1 - z) * hcand + z * h
+            else:  # RNN
+                act = (acts[d] if acts else "Tanh")
+                fact = _np.tanh if act == "Tanh" else (
+                    lambda v: _np.maximum(v, 0.0))
+                h = fact(gx + h @ R[d].T + Rb)
+            Y[t, d] = h
+        Yh[d], Yc[d] = h, c
+    outs = (jnp.asarray(Y.astype(_np.float32)),
+            jnp.asarray(Yh.astype(_np.float32)))
+    if op == "LSTM":
+        outs = outs + (jnp.asarray(Yc.astype(_np.float32)),)
+    return outs
+
+
 def _eval_node(op, ins, attrs):
     """ins: list of jnp arrays (None for absent optional inputs).
     Returns a tuple of outputs."""
     a = attrs
+    if op == "Sum":                       # variadic elementwise sum
+        out = ins[0]
+        for x in ins[1:]:
+            out = out + x
+        return (out,)
+    if op == "ReduceSum" and len(ins) > 1 and ins[1] is not None:
+        # opset-13 form: axes arrive as an input tensor
+        ax = tuple(int(v) for v in _np.asarray(ins[1]).tolist())
+        return (jnp.sum(ins[0], axis=ax or None,
+                        keepdims=bool(a.get("keepdims", 1))),)
     if op in _ELEM:
         return (_ELEM[op](ins[0], ins[1]),)
     if op in _UNARY:
@@ -327,6 +463,8 @@ def _eval_node(op, ins, attrs):
     if op == "Split":
         ax = int(a.get("axis", 0))
         sizes = a.get("split")
+        if sizes is None and len(ins) > 1 and ins[1] is not None:
+            sizes = _np.asarray(ins[1]).tolist()   # opset-13 input form
         if sizes:
             cuts = _np.cumsum(sizes)[:-1].tolist()
             return tuple(jnp.split(ins[0], cuts, axis=ax))
@@ -341,11 +479,17 @@ def _eval_node(op, ins, attrs):
         return (ins[0].reshape((int(_np.prod(ins[0].shape[:ax]) or 1),
                                 -1)),)
     if op == "Squeeze":
-        return (jnp.squeeze(ins[0], axis=_axes(a)),)
+        ax = _axes(a)
+        if ax is None and len(ins) > 1 and ins[1] is not None:
+            ax = tuple(int(v) for v in _np.asarray(ins[1]).tolist())
+        return (jnp.squeeze(ins[0], axis=ax),)
     if op == "Unsqueeze":
+        ax = _axes(a)
+        if ax is None and len(ins) > 1 and ins[1] is not None:
+            ax = tuple(int(v) for v in _np.asarray(ins[1]).tolist())
         out = ins[0]
-        for ax in sorted(_axes(a)):
-            out = jnp.expand_dims(out, ax)
+        for x in sorted(ax):
+            out = jnp.expand_dims(out, x)
         return (out,)
     if op == "Expand":
         shape = [int(v) for v in _np.asarray(ins[1]).tolist()]
@@ -405,6 +549,35 @@ def _eval_node(op, ins, attrs):
             return (jnp.zeros(shape, jnp.float32),)
         fill = _np.asarray(t["array"]).reshape(())
         return (jnp.full(shape, fill, fill.dtype),)
+    if op == "MaxRoiPool":
+        return (_max_roi_pool(ins[0], ins[1], a),)
+    if op == "Resize":
+        sizes = ins[3] if len(ins) > 3 and ins[3] is not None else None
+        return (_resize(ins[0], sizes, a),)
+    if op in ("LSTM", "GRU", "RNN"):
+        return _rnn_eval(op, ins, a)
+    if op == "RandomNormal":
+        shape = [int(v) for v in a["shape"]]
+        dt = P.DTYPE_REV[int(a.get("dtype", 1))]
+        out = _np.random.normal(float(a.get("mean", 0.0)),
+                                float(a.get("scale", 1.0)), shape)
+        return (jnp.asarray(out.astype(dt)),)
+    if op == "RandomUniform":
+        shape = [int(v) for v in a["shape"]]
+        dt = P.DTYPE_REV[int(a.get("dtype", 1))]
+        out = _np.random.uniform(float(a.get("low", 0.0)),
+                                 float(a.get("high", 1.0)), shape)
+        return (jnp.asarray(out.astype(dt)),)
+    if op == "Multinomial":
+        logits = _np.asarray(ins[0], _np.float64)
+        n = int(a.get("sample_size", 1))
+        p = _np.exp(logits - logits.max(-1, keepdims=True))
+        p = p / p.sum(-1, keepdims=True)
+        out = _np.stack([_np.random.choice(p.shape[-1], size=n, p=row)
+                         for row in p.reshape(-1, p.shape[-1])])
+        dt = P.DTYPE_REV[int(a.get("dtype", 6))]
+        return (jnp.asarray(
+            out.reshape(logits.shape[:-1] + (n,)).astype(dt)),)
     if op == "QuantizeLinear":
         scale, zp = ins[1], ins[2]
         info = _np.iinfo(_np.asarray(zp).dtype)

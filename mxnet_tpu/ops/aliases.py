@@ -623,6 +623,55 @@ def _install_round5():
     reg("_npi_logical_or", _OPS.get("broadcast_logical_or"))
     reg("_npi_logical_xor", _OPS.get("broadcast_logical_xor"))
 
+    # ---- _npi_/_npx_ unary spellings the macro-generated reference
+    # table covers but round 1's explicit list missed ---------------------
+    for nm, fn in [
+        ("_npi_sqrt", jnp.sqrt), ("_npi_cbrt", jnp.cbrt),
+        ("_npi_exp", jnp.exp), ("_npi_expm1", jnp.expm1),
+        ("_npi_log1p", jnp.log1p), ("_npi_log2", jnp.log2),
+        ("_npi_log10", jnp.log10), ("_npi_tanh", jnp.tanh),
+        ("_npi_sinh", jnp.sinh), ("_npi_cosh", jnp.cosh),
+        ("_npi_square", jnp.square), ("_npi_absolute", jnp.abs),
+        ("_npi_negative", jnp.negative), ("_npi_sign", jnp.sign),
+        ("_npi_sin", jnp.sin), ("_npi_cos", jnp.cos),
+        ("_npi_tan", jnp.tan), ("_npi_arcsin", jnp.arcsin),
+        ("_npi_arccos", jnp.arccos), ("_npi_arctan", jnp.arctan),
+        ("_npi_arcsinh", jnp.arcsinh), ("_npi_arccosh", jnp.arccosh),
+        ("_npi_arctanh", jnp.arctanh), ("_npi_ceil", jnp.ceil),
+        ("_npi_floor", jnp.floor), ("_npi_trunc", jnp.trunc),
+        ("_npi_rint", jnp.rint), ("_npi_fix", jnp.fix),
+        ("_npi_reciprocal", lambda x, **kw: 1.0 / x),
+        ("_npi_maximum", jnp.maximum), ("_npi_minimum", jnp.minimum),
+        ("_npi_exponential", _OPS.get("_npi_exponential")),
+        ("_npi_degrees", jnp.degrees), ("_npi_radians", jnp.radians),
+        ("_npi_logical_not", jnp.logical_not),
+    ]:
+        reg(nm, fn)
+
+    import jax.nn as _jnn
+
+    reg("_npx_relu", lambda x, **kw: _jnn.relu(x))
+    reg("_npx_sigmoid", lambda x, **kw: _jnn.sigmoid(x))
+
+    # ---- NNVM attr spelling for scalar ops ------------------------------
+    # Symbol graphs carry the scalar operand as an attr (`scalar=3.0`,
+    # reference elemwise_binary_scalar_op: DMLC_DECLARE_FIELD(scalar));
+    # round 1 registered plain positional jnp binaries. Wrap every
+    # `*_scalar` entry to accept both spellings.
+    def _scalar_kwarg(fn):
+        def wrapped(data, *pos, scalar=None, is_int=None, **kw):  # noqa: ARG001
+            if pos:
+                return fn(data, *pos)
+            return fn(data, scalar)
+
+        wrapped.__wrapped_scalar__ = True
+        return wrapped
+
+    for nm in [k for k in _OPS if k.endswith("_scalar")]:
+        f = _OPS[nm]
+        if f is not None and not getattr(f, "__wrapped_scalar__", False):
+            _OPS[nm] = _scalar_kwarg(f)
+
 
 def install_aliases():
     """Populate the registry with every internal spelling. Idempotent."""

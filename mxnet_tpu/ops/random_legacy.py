@@ -144,9 +144,14 @@ def _pdf(name, logpdf, nparams, consumes_last=False):
 
         s = _unwrap(sample)
         ps = [_unwrap(p) for p in params[:nparams]]
-        rank = s.ndim - (1 if consumes_last else 0)
-        extra = rank - ps[0].ndim
-        ps = [_expand(p, extra) for p in ps]
+        extra = s.ndim - ps[0].ndim
+        if consumes_last:
+            # params carry the event axis last (dirichlet alpha (n, k)):
+            # sample-dim singletons go BEFORE it, not after
+            ps = [p.reshape(p.shape[:-1] + (1,) * extra + p.shape[-1:])
+                  if extra else p for p in ps]
+        else:
+            ps = [_expand(p, extra) for p in ps]
         ll = logpdf(s, *ps)
         return NDArray(ll if is_log else jnp.exp(ll))
 

@@ -1,0 +1,310 @@
+"""ONNX converter breadth + opset-13 emission (round 3; reference:
+python/mxnet/onnx/mx2onnx/_op_translations/_op_translations_opset12.py and
+_op_translations_opset13.py — the full 170-name registration table)."""
+import numpy as onp
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu.onnx import _proto as P
+from mxnet_tpu.onnx import onnx_eval
+from mxnet_tpu.ops.rnn import rnn_fused, rnn_param_size
+from mxnet_tpu.symbol import zoo
+
+
+def _round_trip(tmp_path, s, params, feeds, in_shapes, in_types=None,
+                opset=11):
+    """Export -> wire-decode -> evaluate; also bind+forward the symbol.
+    Returns (onnx outputs dict, symbol outputs list)."""
+    args = {k: mx.np.array(v) for k, v in params.items()}
+    for k, v in feeds.items():
+        args[k] = mx.np.array(v)
+    want = [o.asnumpy() for o in s.bind(None, args).forward()]
+    path = str(tmp_path / "m.onnx")
+    in_types = in_types or [onp.float32] * len(in_shapes)
+    mx.onnx.export_model(s, {k: mx.np.array(v) for k, v in params.items()},
+                         in_shapes=in_shapes, in_types=in_types,
+                         onnx_file_path=path, opset_version=opset)
+    got = onnx_eval.run_model(path, feeds)
+    return got, want
+
+
+def test_reference_converter_table_closed():
+    """Every name the reference registers (minus `null`, which is the
+    variable node handled structurally by the graph walker) must have a
+    converter."""
+    import re
+    import subprocess
+
+    from mxnet_tpu.onnx.mx2onnx import _CONVERTERS
+
+    out = subprocess.run(
+        ["grep", "-rhoP", r'mx_op\.register\("[^"]+"',
+         "/root/reference/python/mxnet/onnx/mx2onnx/_op_translations/"],
+        capture_output=True, text=True).stdout
+    refnames = set(re.findall(r'register\("([^"]+)"', out))
+    if not refnames:
+        pytest.skip("reference not mounted")
+    missing = sorted(refnames - set(_CONVERTERS) - {"null"})
+    assert not missing, missing
+
+
+@pytest.mark.parametrize("mode,bi,L", [
+    ("lstm", False, 1), ("lstm", True, 2), ("gru", False, 2),
+    ("rnn_tanh", True, 1), ("rnn_relu", False, 1)])
+def test_rnn_export_round_trip(tmp_path, mode, bi, L):
+    T, N, I, H = 5, 2, 3, 4
+    D = 2 if bi else 1
+    rs = onp.random.RandomState(0)
+    w = (rs.randn(rnn_param_size(L, I, H, bi, mode)) * 0.3).astype("f")
+    x = rs.randn(T, N, I).astype("f")
+    kw = dict(mode=mode, state_size=H, num_layers=L, bidirectional=bi,
+              state_outputs=True)
+    sym_ins = [mx.sym.var("data"), mx.sym.var("w"), mx.sym.var("h0")]
+    params = {"w": w, "h0": onp.zeros((L * D, N, H), "f")}
+    if mode == "lstm":
+        sym_ins.append(mx.sym.var("c0"))
+        params["c0"] = onp.zeros((L * D, N, H), "f")
+    s = mx.sym.RNN(*sym_ins, **kw)
+    path = str(tmp_path / "rnn.onnx")
+    mx.onnx.export_model(s, {k: mx.np.array(v) for k, v in params.items()},
+                         in_shapes=[(T, N, I)], onnx_file_path=path)
+    got = list(onnx_eval.run_model(path, {"data": x}).values())
+    want = rnn_fused(x, w, params["h0"],
+                     params.get("c0"), **kw)
+    for g, wv in zip(got, [onp.asarray(v) for v in want]):
+        onp.testing.assert_allclose(g, wv, rtol=2e-4, atol=1e-5)
+
+
+def test_opset13_zoo_round_trip(tmp_path):
+    """lenet exercises Conv/Pool/Gemm + (via flatten/squeeze paths) the
+    opset-13 input-form rewrites; numerics must match opset-11."""
+    s, shapes = zoo.get_symbol("lenet")
+    rs = onp.random.RandomState(0)
+    params = {n: rs.normal(0, 0.05, shp).astype("f")
+              for n, shp in shapes.items()}
+    x = rs.rand(2, 1, 28, 28).astype("f")
+    got13, want = _round_trip(tmp_path, s, params, {"data": x},
+                              [(2, 1, 28, 28)], opset=13)
+    onp.testing.assert_allclose(next(iter(got13.values())), want[0],
+                                rtol=2e-4, atol=2e-5)
+
+
+def test_opset13_moves_axes_to_inputs(tmp_path):
+    v = mx.sym.var("x")
+    s = mx.sym.sum(mx.sym.expand_dims(v, axis=1), axis=(2,),
+                   keepdims=False)
+    x = onp.random.RandomState(1).rand(3, 4).astype("f")
+    for opset in (11, 13):
+        path = str(tmp_path / f"m{opset}.onnx")
+        mx.onnx.export_model(s, {}, in_shapes=[(3, 4)],
+                             onnx_file_path=path, opset_version=opset)
+        m = P.check_model(open(path, "rb").read())
+        assert m["opset"] == opset
+        nodes = {n["op_type"]: n for n in m["graph"]["nodes"]}
+        if opset == 13:
+            assert len(nodes["Unsqueeze"]["input"]) == 2  # axes input
+            assert len(nodes["ReduceSum"]["input"]) == 2
+            assert "axes" not in nodes["ReduceSum"]["attrs"]
+        else:
+            assert len(nodes["Unsqueeze"]["input"]) == 1
+            assert nodes["ReduceSum"]["attrs"]["axes"] == [2]
+        got = onnx_eval.run_model(path, {"x": x})
+        onp.testing.assert_allclose(next(iter(got.values())),
+                                    x.sum(-1)[:, None], rtol=1e-5)
+
+
+def test_scalar_op_spellings(tmp_path):
+    v = mx.sym.var("x")
+    s = mx.sym._rdiv_scalar(
+        mx.sym._plus_scalar(mx.sym._mul_scalar(v, scalar=3.0),
+                            scalar=1.0), scalar=12.0)
+    x = onp.array([[1.0, 2.0], [3.0, 5.0]], "f")
+    got, want = _round_trip(tmp_path, s, {}, {"x": x}, [(2, 2)])
+    onp.testing.assert_allclose(next(iter(got.values())),
+                                12.0 / (x * 3.0 + 1.0), rtol=1e-6)
+    onp.testing.assert_allclose(next(iter(got.values())), want[0],
+                                rtol=1e-6)
+    cmp_s = mx.sym._greater_scalar(v, scalar=2.5)
+    got, want = _round_trip(tmp_path, cmp_s, {}, {"x": x}, [(2, 2)])
+    onp.testing.assert_allclose(next(iter(got.values())), want[0])
+
+
+def test_sequence_mask_export(tmp_path):
+    d = mx.sym.var("data")
+    sl = mx.sym.var("len")
+    s = mx.sym.SequenceMask(d, sl, use_sequence_length=True, value=-1.0,
+                            axis=0)
+    rs = onp.random.RandomState(2)
+    x = rs.rand(5, 3, 2).astype("f")
+    ln = onp.array([2.0, 5.0, 3.0], "f")
+    got, want = _round_trip(tmp_path, s, {}, {"data": x, "len": ln},
+                            [(5, 3, 2), (3,)],
+                            in_types=[onp.float32, onp.float32])
+    onp.testing.assert_allclose(next(iter(got.values())), want[0],
+                                rtol=1e-6)
+    assert (next(iter(got.values()))[3, 0] == -1.0).all()  # masked tail
+
+
+def test_roi_pooling_export(tmp_path):
+    d = mx.sym.var("data")
+    r = mx.sym.var("rois")
+    s = mx.sym.ROIPooling(d, r, pooled_size=(2, 2), spatial_scale=1.0)
+    rs = onp.random.RandomState(3)
+    x = rs.rand(1, 2, 8, 8).astype("f")
+    rois = onp.array([[0, 0, 0, 3, 3], [0, 2, 2, 7, 7]], "f")
+    got, want = _round_trip(tmp_path, s, {}, {"data": x, "rois": rois},
+                            [(1, 2, 8, 8), (2, 5)],
+                            in_types=[onp.float32, onp.float32])
+    onp.testing.assert_allclose(next(iter(got.values())), want[0],
+                                rtol=1e-5)
+
+
+def test_selfatt_interleaved_export(tmp_path):
+    L, B, heads, D = 4, 2, 2, 3
+    qkv = mx.sym.var("qkv")
+    qk = mx.sym._contrib_interleaved_matmul_selfatt_qk(qkv, heads=heads)
+    out = mx.sym._contrib_interleaved_matmul_selfatt_valatt(
+        qkv, mx.sym.softmax(qk, axis=-1), heads=heads)
+    x = onp.random.RandomState(4).randn(L, B, heads * 3 * D).astype("f")
+    got, want = _round_trip(tmp_path, out, {}, {"qkv": x},
+                            [(L, B, heads * 3 * D)])
+    onp.testing.assert_allclose(next(iter(got.values())), want[0],
+                                rtol=2e-4, atol=1e-5)
+
+
+def test_box_decode_export(tmp_path):
+    d = mx.sym.var("data")
+    a = mx.sym.var("anchors")
+    s = mx.sym._contrib_box_decode(d, a, clip=1.5)
+    rs = onp.random.RandomState(5)
+    deltas = (rs.randn(2, 6, 4) * 0.2).astype("f")
+    anchors = onp.abs(rs.rand(1, 6, 4)).astype("f")
+    anchors[..., 2:] += anchors[..., :2]  # valid corners
+    got, want = _round_trip(tmp_path, s, {},
+                            {"data": deltas, "anchors": anchors},
+                            [(2, 6, 4), (1, 6, 4)],
+                            in_types=[onp.float32, onp.float32])
+    onp.testing.assert_allclose(next(iter(got.values())), want[0],
+                                rtol=2e-4, atol=1e-5)
+
+
+def test_bilinear_resize_and_adaptive_pool_export(tmp_path):
+    d = mx.sym.var("x")
+    s = mx.sym._contrib_BilinearResize2D(d, height=7, width=9)
+    x = onp.random.RandomState(6).rand(1, 2, 4, 5).astype("f")
+    got, want = _round_trip(tmp_path, s, {}, {"x": x}, [(1, 2, 4, 5)])
+    onp.testing.assert_allclose(next(iter(got.values())), want[0],
+                                rtol=1e-4, atol=1e-5)
+    s2 = mx.sym._contrib_AdaptiveAvgPooling2D(d, output_size=2)
+    x2 = onp.random.RandomState(7).rand(1, 2, 6, 6).astype("f")
+    got, want = _round_trip(tmp_path, s2, {}, {"x": x2}, [(1, 2, 6, 6)])
+    onp.testing.assert_allclose(next(iter(got.values())), want[0],
+                                rtol=1e-5)
+
+
+def test_output_heads_and_misc(tmp_path):
+    v = mx.sym.var("x")
+    x = onp.random.RandomState(8).randn(3, 5).astype("f")
+    for s, ref in [
+        (mx.sym.SoftmaxOutput(v, mx.sym.var("label")), None),
+        (mx.sym.LogisticRegressionOutput(v, mx.sym.var("label")), None),
+        (mx.sym.MakeLoss(mx.sym._mul_scalar(v, scalar=2.0)), 2 * x),
+    ]:
+        feeds = {"x": x}
+        args = {"x": mx.np.array(x)}
+        if "label" in s.list_arguments():
+            args["label"] = mx.np.zeros((3,))
+        want = s.bind(None, args).forward()[0].asnumpy()
+        path = str(tmp_path / "h.onnx")
+        mx.onnx.export_model(s, {"label": mx.np.zeros((3,))}
+                             if "label" in s.list_arguments() else {},
+                             in_shapes=[(3, 5)], onnx_file_path=path)
+        got = next(iter(onnx_eval.run_model(path, feeds).values()))
+        onp.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-6)
+        if ref is not None:
+            onp.testing.assert_allclose(got, ref, rtol=1e-5)
+
+
+def test_static_shape_ops_export(tmp_path):
+    v = mx.sym.var("x")
+    x = onp.random.RandomState(9).rand(2, 3, 4).astype("f")
+    cases = [
+        (mx.sym.Reshape(v, shape=(-1, 4)), x.reshape(-1, 4)),
+        (mx.sym._npx_reshape(v, newshape=(6, 4)), x.reshape(6, 4)),
+        (mx.sym.reshape_like(v, mx.sym.var("y")), None),
+        (mx.sym.size_array(v), onp.array([24], "i8")),
+        (mx.sym.add_n(v, v, v), 3 * x),
+        (mx.sym._linalg_gemm2(mx.sym.var("a"), mx.sym.var("b"),
+                              transpose_b=True, alpha=0.5), None),
+    ]
+    for s, ref in cases:
+        arg_names = s.list_arguments()
+        feeds = {"x": x} if "x" in arg_names else {}
+        shapes = [(2, 3, 4)] if "x" in arg_names else []
+        if "y" in arg_names:
+            feeds["y"] = onp.zeros((4, 6), "f")
+            shapes.append((4, 6))
+        if "a" in arg_names:
+            rs = onp.random.RandomState(10)
+            feeds = {"a": rs.rand(3, 4).astype("f"),
+                     "b": rs.rand(5, 4).astype("f")}
+            shapes = [(3, 4), (5, 4)]
+        got, want = _round_trip(tmp_path, s, {}, feeds, shapes,
+                                in_types=[onp.float32] * len(shapes))
+        g = next(iter(got.values()))
+        onp.testing.assert_allclose(g, want[0], rtol=1e-5, atol=1e-6)
+        if ref is not None:
+            onp.testing.assert_allclose(
+                g.astype(onp.float64), onp.asarray(ref, onp.float64),
+                rtol=1e-5)
+
+
+def test_constant_producers_and_random_shapes(tmp_path):
+    v = mx.sym.var("x")
+    x = onp.ones((2, 3), "f")
+    s = mx.sym.broadcast_add(
+        v, mx.sym._arange(start=0.0, stop=3.0, step=1.0))
+    got, want = _round_trip(tmp_path, s, {}, {"x": x}, [(2, 3)])
+    onp.testing.assert_allclose(next(iter(got.values())), want[0])
+    s2 = mx.sym.broadcast_add(v, mx.sym._zeros(shape=(2, 3)))
+    got, _ = _round_trip(tmp_path, s2, {}, {"x": x}, [(2, 3)])
+    onp.testing.assert_allclose(next(iter(got.values())), x)
+    # random nodes: shape/dtype contract only (nondeterministic values)
+    s3 = mx.sym._npi_uniform(low=0.0, high=1.0, size=(4, 5))
+    path = str(tmp_path / "r.onnx")
+    mx.onnx.export_model(s3, {}, in_shapes=[], onnx_file_path=path)
+    got = next(iter(onnx_eval.run_model(path, {}).values()))
+    assert got.shape == (4, 5)
+    assert (got >= 0).all() and (got <= 1).all()
+
+
+def test_sample_multinomial_get_prob_export(tmp_path):
+    """get_prob=True must export BOTH outputs: indices and the gathered
+    per-draw log-probabilities."""
+    p = onp.array([[0.25, 0.75], [0.6, 0.4]], "f")
+    v = mx.sym.var("p")
+    s = mx.sym._sample_multinomial(v, shape=(7,), get_prob=True)
+    path = str(tmp_path / "mn.onnx")
+    mx.onnx.export_model(s, {}, in_shapes=[(2, 2)], onnx_file_path=path)
+    outs = onnx_eval.run_model(path, {"p": p})
+    assert len(outs) == 2
+    idx, lp = list(outs.values())
+    assert idx.shape == (2, 7) and lp.shape == (2, 7)
+    want = onp.take_along_axis(onp.log(p), idx.astype("i8"), axis=-1)
+    onp.testing.assert_allclose(lp, want, rtol=1e-5)
+
+
+def test_npi_alias_spellings(tmp_path):
+    from mxnet_tpu.symbol.symbol import Symbol
+
+    v = mx.sym.var("x")
+    x = onp.random.RandomState(11).rand(2, 3).astype("f") + 0.5
+    s = Symbol.create("_npi_sqrt",
+                      Symbol.create("_npi_multiply", v, v))
+    got, want = _round_trip(tmp_path, s, {}, {"x": x}, [(2, 3)])
+    onp.testing.assert_allclose(next(iter(got.values())), x, rtol=1e-5)
+    s2 = Symbol.create("_npi_sum", v, axis=(1,), keepdims=True)
+    got, want = _round_trip(tmp_path, s2, {}, {"x": x}, [(2, 3)],
+                            opset=13)
+    onp.testing.assert_allclose(next(iter(got.values())),
+                                x.sum(1, keepdims=True), rtol=1e-5)
