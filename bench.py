@@ -175,7 +175,7 @@ def bench_lenet_imperative(platform, iters, warmup):
     from mxnet_tpu.gluon.model_zoo.vision import lenet
 
     mx.seed(0)
-    net = lenet.lenet(classes=10)
+    net = lenet(classes=10)
     net.initialize()
     batch = 256
     x = mx.np.array(__import__("numpy").random.rand(

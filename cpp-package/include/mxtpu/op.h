@@ -263,6 +263,17 @@ inline std::vector<PackedTensor> Crop(
   return rt.invoke("Crop", ins_, a_.str());
 }
 
+inline std::vector<PackedTensor> Custom(
+    PyRuntime& rt,
+    const std::vector<PackedTensor>& inputs,
+    const char* op_type_json = nullptr,
+    const std::string& extra_attrs = "") {
+  std::vector<PackedTensor> ins_(inputs);
+  detail::JsonBuilder a_;
+  if (op_type_json) a_.raw("op_type", op_type_json);
+  return rt.invoke("Custom", ins_, detail::merge(a_.str(), extra_attrs));
+}
+
 inline std::vector<PackedTensor> Deconvolution(
     PyRuntime& rt,
     const PackedTensor& data,
@@ -623,6 +634,40 @@ inline std::vector<PackedTensor> Pooling(
   return rt.invoke("Pooling", ins_, a_.str());
 }
 
+inline std::vector<PackedTensor> RNN(
+    PyRuntime& rt,
+    const PackedTensor& data,
+    const PackedTensor& parameters,
+    const PackedTensor& state,
+    const PackedTensor& state_size,
+    const PackedTensor& num_layers,
+    const PackedTensor* state_cell = nullptr,
+    const std::string& mode = "lstm",
+    bool bidirectional = false,
+    double p = 0.0,
+    bool state_outputs = false,
+    const char* projection_size_json = nullptr,
+    const char* lstm_state_clip_min_json = nullptr,
+    const char* lstm_state_clip_max_json = nullptr,
+    const std::string& extra_attrs = "") {
+  std::vector<PackedTensor> ins_;
+  ins_.push_back(data);
+  ins_.push_back(parameters);
+  ins_.push_back(state);
+  ins_.push_back(state_size);
+  ins_.push_back(num_layers);
+  if (state_cell) ins_.push_back(*state_cell);
+  detail::JsonBuilder a_;
+  a_.put_str("mode", mode);
+  a_.put_bool("bidirectional", bidirectional);
+  a_.put_num("p", p);
+  a_.put_bool("state_outputs", state_outputs);
+  if (projection_size_json) a_.raw("projection_size", projection_size_json);
+  if (lstm_state_clip_min_json) a_.raw("lstm_state_clip_min", lstm_state_clip_min_json);
+  if (lstm_state_clip_max_json) a_.raw("lstm_state_clip_max", lstm_state_clip_max_json);
+  return rt.invoke("RNN", ins_, detail::merge(a_.str(), extra_attrs));
+}
+
 inline std::vector<PackedTensor> ROIPooling(
     PyRuntime& rt,
     const PackedTensor& data,
@@ -819,6 +864,16 @@ inline std::vector<PackedTensor> UpSampling(
   return rt.invoke("UpSampling", ins_, a_.str());
 }
 
+inline std::vector<PackedTensor> _NoGradient(
+    PyRuntime& rt,
+    const PackedTensor& x,
+    const std::string& extra_attrs = "") {
+  std::vector<PackedTensor> ins_;
+  ins_.push_back(x);
+  detail::JsonBuilder a_;
+  return rt.invoke("_NoGradient", ins_, detail::merge(a_.str(), extra_attrs));
+}
+
 inline std::vector<PackedTensor> _adabelief_update(
     PyRuntime& rt,
     const PackedTensor& weight,
@@ -846,6 +901,70 @@ inline std::vector<PackedTensor> _adabelief_update(
   a_.put_num("rescale_grad", rescale_grad);
   a_.put_num("clip_gradient", clip_gradient);
   return rt.invoke("_adabelief_update", ins_, a_.str());
+}
+
+inline std::vector<PackedTensor> _adamw_update(
+    PyRuntime& rt,
+    const PackedTensor& weight,
+    const PackedTensor& grad,
+    const PackedTensor& mean,
+    const PackedTensor& var,
+    const PackedTensor& lr,
+    double beta1 = 0.9,
+    double beta2 = 0.999,
+    double epsilon = 1e-08,
+    double wd = 0.0,
+    double eta = 1.0,
+    double rescale_grad = 1.0,
+    double clip_gradient = -1.0) {
+  std::vector<PackedTensor> ins_;
+  ins_.push_back(weight);
+  ins_.push_back(grad);
+  ins_.push_back(mean);
+  ins_.push_back(var);
+  ins_.push_back(lr);
+  detail::JsonBuilder a_;
+  a_.put_num("beta1", beta1);
+  a_.put_num("beta2", beta2);
+  a_.put_num("epsilon", epsilon);
+  a_.put_num("wd", wd);
+  a_.put_num("eta", eta);
+  a_.put_num("rescale_grad", rescale_grad);
+  a_.put_num("clip_gradient", clip_gradient);
+  return rt.invoke("_adamw_update", ins_, a_.str());
+}
+
+inline std::vector<PackedTensor> _arange(
+    PyRuntime& rt,
+    double start = 0.0,
+    const char* stop_json = nullptr,
+    double step = 1.0,
+    long long repeat = 1,
+    const char* dtype_json = nullptr,
+    const std::string& extra_attrs = "") {
+  std::vector<PackedTensor> ins_;
+  detail::JsonBuilder a_;
+  a_.put_num("start", start);
+  if (stop_json) a_.raw("stop", stop_json);
+  a_.put_num("step", step);
+  a_.put_int("repeat", repeat);
+  if (dtype_json) a_.raw("dtype", dtype_json);
+  return rt.invoke("_arange", ins_, detail::merge(a_.str(), extra_attrs));
+}
+
+inline std::vector<PackedTensor> _cond(
+    PyRuntime& rt,
+    const PackedTensor& pred,
+    const PackedTensor& then_func,
+    const PackedTensor& else_func,
+    const std::vector<long long>& inputs = {}) {
+  std::vector<PackedTensor> ins_;
+  ins_.push_back(pred);
+  ins_.push_back(then_func);
+  ins_.push_back(else_func);
+  detail::JsonBuilder a_;
+  a_.put_ivec("inputs", inputs);
+  return rt.invoke("_cond", ins_, a_.str());
 }
 
 inline std::vector<PackedTensor> _contrib_AdaptiveAvgPooling2D(
@@ -1837,15 +1956,93 @@ inline std::vector<PackedTensor> _copy(
   return rt.invoke("_copy", ins_, a_.str());
 }
 
+inline std::vector<PackedTensor> _copyto(
+    PyRuntime& rt,
+    const PackedTensor& x,
+    const std::string& extra_attrs = "") {
+  std::vector<PackedTensor> ins_;
+  ins_.push_back(x);
+  detail::JsonBuilder a_;
+  return rt.invoke("_copyto", ins_, detail::merge(a_.str(), extra_attrs));
+}
+
+inline std::vector<PackedTensor> _cvcopyMakeBorder(
+    PyRuntime& rt,
+    const PackedTensor& src,
+    const PackedTensor& top,
+    const PackedTensor& bot,
+    const PackedTensor& left,
+    const PackedTensor& right,
+    long long type = 0,
+    long long values = 0) {
+  std::vector<PackedTensor> ins_;
+  ins_.push_back(src);
+  ins_.push_back(top);
+  ins_.push_back(bot);
+  ins_.push_back(left);
+  ins_.push_back(right);
+  detail::JsonBuilder a_;
+  a_.put_int("type", type);
+  a_.put_int("values", values);
+  return rt.invoke("_cvcopyMakeBorder", ins_, a_.str());
+}
+
+inline std::vector<PackedTensor> _cvimdecode(
+    PyRuntime& rt,
+    const PackedTensor& buf,
+    long long flag = 1,
+    bool to_rgb = true,
+    const std::string& extra_attrs = "") {
+  std::vector<PackedTensor> ins_;
+  ins_.push_back(buf);
+  detail::JsonBuilder a_;
+  a_.put_int("flag", flag);
+  a_.put_bool("to_rgb", to_rgb);
+  return rt.invoke("_cvimdecode", ins_, detail::merge(a_.str(), extra_attrs));
+}
+
+inline std::vector<PackedTensor> _cvimread(
+    PyRuntime& rt,
+    const PackedTensor& filename,
+    long long flag = 1,
+    bool to_rgb = true,
+    const std::string& extra_attrs = "") {
+  std::vector<PackedTensor> ins_;
+  ins_.push_back(filename);
+  detail::JsonBuilder a_;
+  a_.put_int("flag", flag);
+  a_.put_bool("to_rgb", to_rgb);
+  return rt.invoke("_cvimread", ins_, detail::merge(a_.str(), extra_attrs));
+}
+
+inline std::vector<PackedTensor> _cvimresize(
+    PyRuntime& rt,
+    const PackedTensor& src,
+    const PackedTensor& w,
+    const PackedTensor& h,
+    long long interp = 1) {
+  std::vector<PackedTensor> ins_;
+  ins_.push_back(src);
+  ins_.push_back(w);
+  ins_.push_back(h);
+  detail::JsonBuilder a_;
+  a_.put_int("interp", interp);
+  return rt.invoke("_cvimresize", ins_, a_.str());
+}
+
 inline std::vector<PackedTensor> _div_scalar(
     PyRuntime& rt,
-    const PackedTensor& x1,
-    const PackedTensor& x2) {
-  std::vector<PackedTensor> ins_;
-  ins_.push_back(x1);
-  ins_.push_back(x2);
+    const std::vector<PackedTensor>& inputs,
+    const PackedTensor& data,
+    const char* scalar_json = nullptr,
+    const char* is_int_json = nullptr,
+    const std::string& extra_attrs = "") {
+  std::vector<PackedTensor> ins_(inputs);
+  ins_.push_back(data);
   detail::JsonBuilder a_;
-  return rt.invoke("_div_scalar", ins_, a_.str());
+  if (scalar_json) a_.raw("scalar", scalar_json);
+  if (is_int_json) a_.raw("is_int", is_int_json);
+  return rt.invoke("_div_scalar", ins_, detail::merge(a_.str(), extra_attrs));
 }
 
 inline std::vector<PackedTensor> _equal(
@@ -1861,13 +2058,17 @@ inline std::vector<PackedTensor> _equal(
 
 inline std::vector<PackedTensor> _equal_scalar(
     PyRuntime& rt,
-    const PackedTensor& x,
-    const PackedTensor& y) {
-  std::vector<PackedTensor> ins_;
-  ins_.push_back(x);
-  ins_.push_back(y);
+    const std::vector<PackedTensor>& inputs,
+    const PackedTensor& data,
+    const char* scalar_json = nullptr,
+    const char* is_int_json = nullptr,
+    const std::string& extra_attrs = "") {
+  std::vector<PackedTensor> ins_(inputs);
+  ins_.push_back(data);
   detail::JsonBuilder a_;
-  return rt.invoke("_equal_scalar", ins_, a_.str());
+  if (scalar_json) a_.raw("scalar", scalar_json);
+  if (is_int_json) a_.raw("is_int", is_int_json);
+  return rt.invoke("_equal_scalar", ins_, detail::merge(a_.str(), extra_attrs));
 }
 
 inline std::vector<PackedTensor> _eye(
@@ -1885,6 +2086,33 @@ inline std::vector<PackedTensor> _eye(
   if (dtype_json) a_.raw("dtype", dtype_json);
   if (device_json) a_.raw("device", device_json);
   return rt.invoke("_eye", ins_, a_.str());
+}
+
+inline std::vector<PackedTensor> _foreach(
+    PyRuntime& rt,
+    const PackedTensor& body,
+    const PackedTensor& data,
+    const PackedTensor& init_states) {
+  std::vector<PackedTensor> ins_;
+  ins_.push_back(body);
+  ins_.push_back(data);
+  ins_.push_back(init_states);
+  detail::JsonBuilder a_;
+  return rt.invoke("_foreach", ins_, a_.str());
+}
+
+inline std::vector<PackedTensor> _full(
+    PyRuntime& rt,
+    const PackedTensor& shape,
+    double value = 0.0,
+    const char* dtype_json = nullptr,
+    const std::string& extra_attrs = "") {
+  std::vector<PackedTensor> ins_;
+  ins_.push_back(shape);
+  detail::JsonBuilder a_;
+  a_.put_num("value", value);
+  if (dtype_json) a_.raw("dtype", dtype_json);
+  return rt.invoke("_full", ins_, detail::merge(a_.str(), extra_attrs));
 }
 
 inline std::vector<PackedTensor> _grad_add(
@@ -1923,24 +2151,32 @@ inline std::vector<PackedTensor> _greater_equal(
 
 inline std::vector<PackedTensor> _greater_equal_scalar(
     PyRuntime& rt,
-    const PackedTensor& x,
-    const PackedTensor& y) {
-  std::vector<PackedTensor> ins_;
-  ins_.push_back(x);
-  ins_.push_back(y);
+    const std::vector<PackedTensor>& inputs,
+    const PackedTensor& data,
+    const char* scalar_json = nullptr,
+    const char* is_int_json = nullptr,
+    const std::string& extra_attrs = "") {
+  std::vector<PackedTensor> ins_(inputs);
+  ins_.push_back(data);
   detail::JsonBuilder a_;
-  return rt.invoke("_greater_equal_scalar", ins_, a_.str());
+  if (scalar_json) a_.raw("scalar", scalar_json);
+  if (is_int_json) a_.raw("is_int", is_int_json);
+  return rt.invoke("_greater_equal_scalar", ins_, detail::merge(a_.str(), extra_attrs));
 }
 
 inline std::vector<PackedTensor> _greater_scalar(
     PyRuntime& rt,
-    const PackedTensor& x,
-    const PackedTensor& y) {
-  std::vector<PackedTensor> ins_;
-  ins_.push_back(x);
-  ins_.push_back(y);
+    const std::vector<PackedTensor>& inputs,
+    const PackedTensor& data,
+    const char* scalar_json = nullptr,
+    const char* is_int_json = nullptr,
+    const std::string& extra_attrs = "") {
+  std::vector<PackedTensor> ins_(inputs);
+  ins_.push_back(data);
   detail::JsonBuilder a_;
-  return rt.invoke("_greater_scalar", ins_, a_.str());
+  if (scalar_json) a_.raw("scalar", scalar_json);
+  if (is_int_json) a_.raw("is_int", is_int_json);
+  return rt.invoke("_greater_scalar", ins_, detail::merge(a_.str(), extra_attrs));
 }
 
 inline std::vector<PackedTensor> _histogram(
@@ -1960,7 +2196,7 @@ inline std::vector<PackedTensor> _histogram(
   return rt.invoke("_histogram", ins_, a_.str());
 }
 
-inline std::vector<PackedTensor> _hypot_scalar(
+inline std::vector<PackedTensor> _hypot(
     PyRuntime& rt,
     const PackedTensor& x1,
     const PackedTensor& x2) {
@@ -1968,7 +2204,22 @@ inline std::vector<PackedTensor> _hypot_scalar(
   ins_.push_back(x1);
   ins_.push_back(x2);
   detail::JsonBuilder a_;
-  return rt.invoke("_hypot_scalar", ins_, a_.str());
+  return rt.invoke("_hypot", ins_, a_.str());
+}
+
+inline std::vector<PackedTensor> _hypot_scalar(
+    PyRuntime& rt,
+    const std::vector<PackedTensor>& inputs,
+    const PackedTensor& data,
+    const char* scalar_json = nullptr,
+    const char* is_int_json = nullptr,
+    const std::string& extra_attrs = "") {
+  std::vector<PackedTensor> ins_(inputs);
+  ins_.push_back(data);
+  detail::JsonBuilder a_;
+  if (scalar_json) a_.raw("scalar", scalar_json);
+  if (is_int_json) a_.raw("is_int", is_int_json);
+  return rt.invoke("_hypot_scalar", ins_, detail::merge(a_.str(), extra_attrs));
 }
 
 inline std::vector<PackedTensor> _identity_with_attr_like_rhs(
@@ -2095,24 +2346,454 @@ inline std::vector<PackedTensor> _lesser_equal(
 
 inline std::vector<PackedTensor> _lesser_equal_scalar(
     PyRuntime& rt,
-    const PackedTensor& x,
-    const PackedTensor& y) {
-  std::vector<PackedTensor> ins_;
-  ins_.push_back(x);
-  ins_.push_back(y);
+    const std::vector<PackedTensor>& inputs,
+    const PackedTensor& data,
+    const char* scalar_json = nullptr,
+    const char* is_int_json = nullptr,
+    const std::string& extra_attrs = "") {
+  std::vector<PackedTensor> ins_(inputs);
+  ins_.push_back(data);
   detail::JsonBuilder a_;
-  return rt.invoke("_lesser_equal_scalar", ins_, a_.str());
+  if (scalar_json) a_.raw("scalar", scalar_json);
+  if (is_int_json) a_.raw("is_int", is_int_json);
+  return rt.invoke("_lesser_equal_scalar", ins_, detail::merge(a_.str(), extra_attrs));
 }
 
 inline std::vector<PackedTensor> _lesser_scalar(
     PyRuntime& rt,
-    const PackedTensor& x,
-    const PackedTensor& y) {
-  std::vector<PackedTensor> ins_;
-  ins_.push_back(x);
-  ins_.push_back(y);
+    const std::vector<PackedTensor>& inputs,
+    const PackedTensor& data,
+    const char* scalar_json = nullptr,
+    const char* is_int_json = nullptr,
+    const std::string& extra_attrs = "") {
+  std::vector<PackedTensor> ins_(inputs);
+  ins_.push_back(data);
   detail::JsonBuilder a_;
-  return rt.invoke("_lesser_scalar", ins_, a_.str());
+  if (scalar_json) a_.raw("scalar", scalar_json);
+  if (is_int_json) a_.raw("is_int", is_int_json);
+  return rt.invoke("_lesser_scalar", ins_, detail::merge(a_.str(), extra_attrs));
+}
+
+inline std::vector<PackedTensor> _linalg_cholesky(
+    PyRuntime& rt,
+    const PackedTensor& A,
+    bool lower = true) {
+  std::vector<PackedTensor> ins_;
+  ins_.push_back(A);
+  detail::JsonBuilder a_;
+  a_.put_bool("lower", lower);
+  return rt.invoke("_linalg_cholesky", ins_, a_.str());
+}
+
+inline std::vector<PackedTensor> _linalg_det(
+    PyRuntime& rt,
+    const PackedTensor& A) {
+  std::vector<PackedTensor> ins_;
+  ins_.push_back(A);
+  detail::JsonBuilder a_;
+  return rt.invoke("_linalg_det", ins_, a_.str());
+}
+
+inline std::vector<PackedTensor> _linalg_eig(
+    PyRuntime& rt,
+    const PackedTensor& A) {
+  std::vector<PackedTensor> ins_;
+  ins_.push_back(A);
+  detail::JsonBuilder a_;
+  return rt.invoke("_linalg_eig", ins_, a_.str());
+}
+
+inline std::vector<PackedTensor> _linalg_eigh(
+    PyRuntime& rt,
+    const PackedTensor& A,
+    bool upper = false) {
+  std::vector<PackedTensor> ins_;
+  ins_.push_back(A);
+  detail::JsonBuilder a_;
+  a_.put_bool("upper", upper);
+  return rt.invoke("_linalg_eigh", ins_, a_.str());
+}
+
+inline std::vector<PackedTensor> _linalg_eigvals(
+    PyRuntime& rt,
+    const PackedTensor& A) {
+  std::vector<PackedTensor> ins_;
+  ins_.push_back(A);
+  detail::JsonBuilder a_;
+  return rt.invoke("_linalg_eigvals", ins_, a_.str());
+}
+
+inline std::vector<PackedTensor> _linalg_eigvalsh(
+    PyRuntime& rt,
+    const PackedTensor& A) {
+  std::vector<PackedTensor> ins_;
+  ins_.push_back(A);
+  detail::JsonBuilder a_;
+  return rt.invoke("_linalg_eigvalsh", ins_, a_.str());
+}
+
+inline std::vector<PackedTensor> _linalg_extractdiag(
+    PyRuntime& rt,
+    const PackedTensor& A,
+    long long offset = 0) {
+  std::vector<PackedTensor> ins_;
+  ins_.push_back(A);
+  detail::JsonBuilder a_;
+  a_.put_int("offset", offset);
+  return rt.invoke("_linalg_extractdiag", ins_, a_.str());
+}
+
+inline std::vector<PackedTensor> _linalg_extracttrian(
+    PyRuntime& rt,
+    const PackedTensor& A,
+    long long offset = 0,
+    bool lower = true) {
+  std::vector<PackedTensor> ins_;
+  ins_.push_back(A);
+  detail::JsonBuilder a_;
+  a_.put_int("offset", offset);
+  a_.put_bool("lower", lower);
+  return rt.invoke("_linalg_extracttrian", ins_, a_.str());
+}
+
+inline std::vector<PackedTensor> _linalg_gelqf(
+    PyRuntime& rt,
+    const PackedTensor& A) {
+  std::vector<PackedTensor> ins_;
+  ins_.push_back(A);
+  detail::JsonBuilder a_;
+  return rt.invoke("_linalg_gelqf", ins_, a_.str());
+}
+
+inline std::vector<PackedTensor> _linalg_gemm(
+    PyRuntime& rt,
+    const PackedTensor& A,
+    const PackedTensor& B,
+    const PackedTensor& C,
+    bool transpose_a = false,
+    bool transpose_b = false,
+    double alpha = 1.0,
+    double beta = 1.0,
+    long long axis = -2) {
+  std::vector<PackedTensor> ins_;
+  ins_.push_back(A);
+  ins_.push_back(B);
+  ins_.push_back(C);
+  detail::JsonBuilder a_;
+  a_.put_bool("transpose_a", transpose_a);
+  a_.put_bool("transpose_b", transpose_b);
+  a_.put_num("alpha", alpha);
+  a_.put_num("beta", beta);
+  a_.put_int("axis", axis);
+  return rt.invoke("_linalg_gemm", ins_, a_.str());
+}
+
+inline std::vector<PackedTensor> _linalg_gemm2(
+    PyRuntime& rt,
+    const PackedTensor& A,
+    const PackedTensor& B,
+    bool transpose_a = false,
+    bool transpose_b = false,
+    double alpha = 1.0) {
+  std::vector<PackedTensor> ins_;
+  ins_.push_back(A);
+  ins_.push_back(B);
+  detail::JsonBuilder a_;
+  a_.put_bool("transpose_a", transpose_a);
+  a_.put_bool("transpose_b", transpose_b);
+  a_.put_num("alpha", alpha);
+  return rt.invoke("_linalg_gemm2", ins_, a_.str());
+}
+
+inline std::vector<PackedTensor> _linalg_inverse(
+    PyRuntime& rt,
+    const PackedTensor& A) {
+  std::vector<PackedTensor> ins_;
+  ins_.push_back(A);
+  detail::JsonBuilder a_;
+  return rt.invoke("_linalg_inverse", ins_, a_.str());
+}
+
+inline std::vector<PackedTensor> _linalg_kron(
+    PyRuntime& rt,
+    const PackedTensor& a,
+    const PackedTensor& b) {
+  std::vector<PackedTensor> ins_;
+  ins_.push_back(a);
+  ins_.push_back(b);
+  detail::JsonBuilder a_;
+  return rt.invoke("_linalg_kron", ins_, a_.str());
+}
+
+inline std::vector<PackedTensor> _linalg_lstsq(
+    PyRuntime& rt,
+    const PackedTensor& A,
+    const PackedTensor& B,
+    const char* rcond_json = nullptr) {
+  std::vector<PackedTensor> ins_;
+  ins_.push_back(A);
+  ins_.push_back(B);
+  detail::JsonBuilder a_;
+  if (rcond_json) a_.raw("rcond", rcond_json);
+  return rt.invoke("_linalg_lstsq", ins_, a_.str());
+}
+
+inline std::vector<PackedTensor> _linalg_makediag(
+    PyRuntime& rt,
+    const PackedTensor& A,
+    long long offset = 0) {
+  std::vector<PackedTensor> ins_;
+  ins_.push_back(A);
+  detail::JsonBuilder a_;
+  a_.put_int("offset", offset);
+  return rt.invoke("_linalg_makediag", ins_, a_.str());
+}
+
+inline std::vector<PackedTensor> _linalg_maketrian(
+    PyRuntime& rt,
+    const PackedTensor& A,
+    long long offset = 0,
+    bool lower = true) {
+  std::vector<PackedTensor> ins_;
+  ins_.push_back(A);
+  detail::JsonBuilder a_;
+  a_.put_int("offset", offset);
+  a_.put_bool("lower", lower);
+  return rt.invoke("_linalg_maketrian", ins_, a_.str());
+}
+
+inline std::vector<PackedTensor> _linalg_matmul(
+    PyRuntime& rt,
+    const PackedTensor& a,
+    const PackedTensor& b) {
+  std::vector<PackedTensor> ins_;
+  ins_.push_back(a);
+  ins_.push_back(b);
+  detail::JsonBuilder a_;
+  return rt.invoke("_linalg_matmul", ins_, a_.str());
+}
+
+inline std::vector<PackedTensor> _linalg_matrix_power(
+    PyRuntime& rt,
+    const PackedTensor& A,
+    const PackedTensor& n) {
+  std::vector<PackedTensor> ins_;
+  ins_.push_back(A);
+  ins_.push_back(n);
+  detail::JsonBuilder a_;
+  return rt.invoke("_linalg_matrix_power", ins_, a_.str());
+}
+
+inline std::vector<PackedTensor> _linalg_matrix_rank(
+    PyRuntime& rt,
+    const PackedTensor& A,
+    const char* tol_json = nullptr) {
+  std::vector<PackedTensor> ins_;
+  ins_.push_back(A);
+  detail::JsonBuilder a_;
+  if (tol_json) a_.raw("tol", tol_json);
+  return rt.invoke("_linalg_matrix_rank", ins_, a_.str());
+}
+
+inline std::vector<PackedTensor> _linalg_multi_dot(
+    PyRuntime& rt,
+    const std::vector<PackedTensor>& inputs) {
+  std::vector<PackedTensor> ins_(inputs);
+  detail::JsonBuilder a_;
+  return rt.invoke("_linalg_multi_dot", ins_, a_.str());
+}
+
+inline std::vector<PackedTensor> _linalg_norm(
+    PyRuntime& rt,
+    const PackedTensor& A,
+    const char* ord_json = nullptr,
+    const char* axis_json = nullptr,
+    bool keepdims = false) {
+  std::vector<PackedTensor> ins_;
+  ins_.push_back(A);
+  detail::JsonBuilder a_;
+  if (ord_json) a_.raw("ord", ord_json);
+  if (axis_json) a_.raw("axis", axis_json);
+  a_.put_bool("keepdims", keepdims);
+  return rt.invoke("_linalg_norm", ins_, a_.str());
+}
+
+inline std::vector<PackedTensor> _linalg_pinv(
+    PyRuntime& rt,
+    const PackedTensor& A,
+    const char* rcond_json = nullptr) {
+  std::vector<PackedTensor> ins_;
+  ins_.push_back(A);
+  detail::JsonBuilder a_;
+  if (rcond_json) a_.raw("rcond", rcond_json);
+  return rt.invoke("_linalg_pinv", ins_, a_.str());
+}
+
+inline std::vector<PackedTensor> _linalg_potrf(
+    PyRuntime& rt,
+    const PackedTensor& A) {
+  std::vector<PackedTensor> ins_;
+  ins_.push_back(A);
+  detail::JsonBuilder a_;
+  return rt.invoke("_linalg_potrf", ins_, a_.str());
+}
+
+inline std::vector<PackedTensor> _linalg_potri(
+    PyRuntime& rt,
+    const PackedTensor& A) {
+  std::vector<PackedTensor> ins_;
+  ins_.push_back(A);
+  detail::JsonBuilder a_;
+  return rt.invoke("_linalg_potri", ins_, a_.str());
+}
+
+inline std::vector<PackedTensor> _linalg_qr(
+    PyRuntime& rt,
+    const PackedTensor& A) {
+  std::vector<PackedTensor> ins_;
+  ins_.push_back(A);
+  detail::JsonBuilder a_;
+  return rt.invoke("_linalg_qr", ins_, a_.str());
+}
+
+inline std::vector<PackedTensor> _linalg_slogdet(
+    PyRuntime& rt,
+    const PackedTensor& A) {
+  std::vector<PackedTensor> ins_;
+  ins_.push_back(A);
+  detail::JsonBuilder a_;
+  return rt.invoke("_linalg_slogdet", ins_, a_.str());
+}
+
+inline std::vector<PackedTensor> _linalg_solve(
+    PyRuntime& rt,
+    const PackedTensor& A,
+    const PackedTensor& B) {
+  std::vector<PackedTensor> ins_;
+  ins_.push_back(A);
+  ins_.push_back(B);
+  detail::JsonBuilder a_;
+  return rt.invoke("_linalg_solve", ins_, a_.str());
+}
+
+inline std::vector<PackedTensor> _linalg_sumlogdiag(
+    PyRuntime& rt,
+    const PackedTensor& A) {
+  std::vector<PackedTensor> ins_;
+  ins_.push_back(A);
+  detail::JsonBuilder a_;
+  return rt.invoke("_linalg_sumlogdiag", ins_, a_.str());
+}
+
+inline std::vector<PackedTensor> _linalg_svd(
+    PyRuntime& rt,
+    const PackedTensor& A) {
+  std::vector<PackedTensor> ins_;
+  ins_.push_back(A);
+  detail::JsonBuilder a_;
+  return rt.invoke("_linalg_svd", ins_, a_.str());
+}
+
+inline std::vector<PackedTensor> _linalg_syevd(
+    PyRuntime& rt,
+    const PackedTensor& A) {
+  std::vector<PackedTensor> ins_;
+  ins_.push_back(A);
+  detail::JsonBuilder a_;
+  return rt.invoke("_linalg_syevd", ins_, a_.str());
+}
+
+inline std::vector<PackedTensor> _linalg_syrk(
+    PyRuntime& rt,
+    const PackedTensor& A,
+    bool transpose = false,
+    double alpha = 1.0) {
+  std::vector<PackedTensor> ins_;
+  ins_.push_back(A);
+  detail::JsonBuilder a_;
+  a_.put_bool("transpose", transpose);
+  a_.put_num("alpha", alpha);
+  return rt.invoke("_linalg_syrk", ins_, a_.str());
+}
+
+inline std::vector<PackedTensor> _linalg_tensorinv(
+    PyRuntime& rt,
+    const PackedTensor& A,
+    long long ind = 2) {
+  std::vector<PackedTensor> ins_;
+  ins_.push_back(A);
+  detail::JsonBuilder a_;
+  a_.put_int("ind", ind);
+  return rt.invoke("_linalg_tensorinv", ins_, a_.str());
+}
+
+inline std::vector<PackedTensor> _linalg_tensorsolve(
+    PyRuntime& rt,
+    const PackedTensor& A,
+    const PackedTensor& B,
+    const char* axes_json = nullptr) {
+  std::vector<PackedTensor> ins_;
+  ins_.push_back(A);
+  ins_.push_back(B);
+  detail::JsonBuilder a_;
+  if (axes_json) a_.raw("axes", axes_json);
+  return rt.invoke("_linalg_tensorsolve", ins_, a_.str());
+}
+
+inline std::vector<PackedTensor> _linalg_trmm(
+    PyRuntime& rt,
+    const PackedTensor& A,
+    const PackedTensor& B,
+    bool transpose = false,
+    bool rightside = false,
+    bool lower = true,
+    double alpha = 1.0) {
+  std::vector<PackedTensor> ins_;
+  ins_.push_back(A);
+  ins_.push_back(B);
+  detail::JsonBuilder a_;
+  a_.put_bool("transpose", transpose);
+  a_.put_bool("rightside", rightside);
+  a_.put_bool("lower", lower);
+  a_.put_num("alpha", alpha);
+  return rt.invoke("_linalg_trmm", ins_, a_.str());
+}
+
+inline std::vector<PackedTensor> _linalg_trsm(
+    PyRuntime& rt,
+    const PackedTensor& A,
+    const PackedTensor& B,
+    bool transpose = false,
+    bool rightside = false,
+    bool lower = true,
+    double alpha = 1.0) {
+  std::vector<PackedTensor> ins_;
+  ins_.push_back(A);
+  ins_.push_back(B);
+  detail::JsonBuilder a_;
+  a_.put_bool("transpose", transpose);
+  a_.put_bool("rightside", rightside);
+  a_.put_bool("lower", lower);
+  a_.put_num("alpha", alpha);
+  return rt.invoke("_linalg_trsm", ins_, a_.str());
+}
+
+inline std::vector<PackedTensor> _linspace(
+    PyRuntime& rt,
+    double start = 0.0,
+    double stop = 1.0,
+    long long num = 50,
+    bool endpoint = true,
+    const char* dtype_json = nullptr,
+    const std::string& extra_attrs = "") {
+  std::vector<PackedTensor> ins_;
+  detail::JsonBuilder a_;
+  a_.put_num("start", start);
+  a_.put_num("stop", stop);
+  a_.put_int("num", num);
+  a_.put_bool("endpoint", endpoint);
+  if (dtype_json) a_.raw("dtype", dtype_json);
+  return rt.invoke("_linspace", ins_, detail::merge(a_.str(), extra_attrs));
 }
 
 inline std::vector<PackedTensor> _logical_and(
@@ -2130,13 +2811,16 @@ inline std::vector<PackedTensor> _logical_and(
 inline std::vector<PackedTensor> _logical_and_scalar(
     PyRuntime& rt,
     const std::vector<PackedTensor>& inputs,
-    const char* out_json = nullptr,
-    const char* where_json = nullptr) {
+    const PackedTensor& data,
+    const char* scalar_json = nullptr,
+    const char* is_int_json = nullptr,
+    const std::string& extra_attrs = "") {
   std::vector<PackedTensor> ins_(inputs);
+  ins_.push_back(data);
   detail::JsonBuilder a_;
-  if (out_json) a_.raw("out", out_json);
-  if (where_json) a_.raw("where", where_json);
-  return rt.invoke("_logical_and_scalar", ins_, a_.str());
+  if (scalar_json) a_.raw("scalar", scalar_json);
+  if (is_int_json) a_.raw("is_int", is_int_json);
+  return rt.invoke("_logical_and_scalar", ins_, detail::merge(a_.str(), extra_attrs));
 }
 
 inline std::vector<PackedTensor> _logical_or(
@@ -2154,13 +2838,16 @@ inline std::vector<PackedTensor> _logical_or(
 inline std::vector<PackedTensor> _logical_or_scalar(
     PyRuntime& rt,
     const std::vector<PackedTensor>& inputs,
-    const char* out_json = nullptr,
-    const char* where_json = nullptr) {
+    const PackedTensor& data,
+    const char* scalar_json = nullptr,
+    const char* is_int_json = nullptr,
+    const std::string& extra_attrs = "") {
   std::vector<PackedTensor> ins_(inputs);
+  ins_.push_back(data);
   detail::JsonBuilder a_;
-  if (out_json) a_.raw("out", out_json);
-  if (where_json) a_.raw("where", where_json);
-  return rt.invoke("_logical_or_scalar", ins_, a_.str());
+  if (scalar_json) a_.raw("scalar", scalar_json);
+  if (is_int_json) a_.raw("is_int", is_int_json);
+  return rt.invoke("_logical_or_scalar", ins_, detail::merge(a_.str(), extra_attrs));
 }
 
 inline std::vector<PackedTensor> _logical_xor(
@@ -2178,49 +2865,85 @@ inline std::vector<PackedTensor> _logical_xor(
 inline std::vector<PackedTensor> _logical_xor_scalar(
     PyRuntime& rt,
     const std::vector<PackedTensor>& inputs,
+    const PackedTensor& data,
+    const char* scalar_json = nullptr,
+    const char* is_int_json = nullptr,
+    const std::string& extra_attrs = "") {
+  std::vector<PackedTensor> ins_(inputs);
+  ins_.push_back(data);
+  detail::JsonBuilder a_;
+  if (scalar_json) a_.raw("scalar", scalar_json);
+  if (is_int_json) a_.raw("is_int", is_int_json);
+  return rt.invoke("_logical_xor_scalar", ins_, detail::merge(a_.str(), extra_attrs));
+}
+
+inline std::vector<PackedTensor> _maximum(
+    PyRuntime& rt,
+    const std::vector<PackedTensor>& inputs,
     const char* out_json = nullptr,
     const char* where_json = nullptr) {
   std::vector<PackedTensor> ins_(inputs);
   detail::JsonBuilder a_;
   if (out_json) a_.raw("out", out_json);
   if (where_json) a_.raw("where", where_json);
-  return rt.invoke("_logical_xor_scalar", ins_, a_.str());
+  return rt.invoke("_maximum", ins_, a_.str());
 }
 
 inline std::vector<PackedTensor> _maximum_scalar(
     PyRuntime& rt,
     const std::vector<PackedTensor>& inputs,
+    const PackedTensor& data,
+    const char* scalar_json = nullptr,
+    const char* is_int_json = nullptr,
+    const std::string& extra_attrs = "") {
+  std::vector<PackedTensor> ins_(inputs);
+  ins_.push_back(data);
+  detail::JsonBuilder a_;
+  if (scalar_json) a_.raw("scalar", scalar_json);
+  if (is_int_json) a_.raw("is_int", is_int_json);
+  return rt.invoke("_maximum_scalar", ins_, detail::merge(a_.str(), extra_attrs));
+}
+
+inline std::vector<PackedTensor> _minimum(
+    PyRuntime& rt,
+    const std::vector<PackedTensor>& inputs,
     const char* out_json = nullptr,
     const char* where_json = nullptr) {
   std::vector<PackedTensor> ins_(inputs);
   detail::JsonBuilder a_;
   if (out_json) a_.raw("out", out_json);
   if (where_json) a_.raw("where", where_json);
-  return rt.invoke("_maximum_scalar", ins_, a_.str());
+  return rt.invoke("_minimum", ins_, a_.str());
 }
 
 inline std::vector<PackedTensor> _minimum_scalar(
     PyRuntime& rt,
     const std::vector<PackedTensor>& inputs,
-    const char* out_json = nullptr,
-    const char* where_json = nullptr) {
+    const PackedTensor& data,
+    const char* scalar_json = nullptr,
+    const char* is_int_json = nullptr,
+    const std::string& extra_attrs = "") {
   std::vector<PackedTensor> ins_(inputs);
+  ins_.push_back(data);
   detail::JsonBuilder a_;
-  if (out_json) a_.raw("out", out_json);
-  if (where_json) a_.raw("where", where_json);
-  return rt.invoke("_minimum_scalar", ins_, a_.str());
+  if (scalar_json) a_.raw("scalar", scalar_json);
+  if (is_int_json) a_.raw("is_int", is_int_json);
+  return rt.invoke("_minimum_scalar", ins_, detail::merge(a_.str(), extra_attrs));
 }
 
 inline std::vector<PackedTensor> _minus_scalar(
     PyRuntime& rt,
     const std::vector<PackedTensor>& inputs,
-    const char* out_json = nullptr,
-    const char* where_json = nullptr) {
+    const PackedTensor& data,
+    const char* scalar_json = nullptr,
+    const char* is_int_json = nullptr,
+    const std::string& extra_attrs = "") {
   std::vector<PackedTensor> ins_(inputs);
+  ins_.push_back(data);
   detail::JsonBuilder a_;
-  if (out_json) a_.raw("out", out_json);
-  if (where_json) a_.raw("where", where_json);
-  return rt.invoke("_minus_scalar", ins_, a_.str());
+  if (scalar_json) a_.raw("scalar", scalar_json);
+  if (is_int_json) a_.raw("is_int", is_int_json);
+  return rt.invoke("_minus_scalar", ins_, detail::merge(a_.str(), extra_attrs));
 }
 
 inline std::vector<PackedTensor> _mod(
@@ -2236,13 +2959,17 @@ inline std::vector<PackedTensor> _mod(
 
 inline std::vector<PackedTensor> _mod_scalar(
     PyRuntime& rt,
-    const PackedTensor& x1,
-    const PackedTensor& x2) {
-  std::vector<PackedTensor> ins_;
-  ins_.push_back(x1);
-  ins_.push_back(x2);
+    const std::vector<PackedTensor>& inputs,
+    const PackedTensor& data,
+    const char* scalar_json = nullptr,
+    const char* is_int_json = nullptr,
+    const std::string& extra_attrs = "") {
+  std::vector<PackedTensor> ins_(inputs);
+  ins_.push_back(data);
   detail::JsonBuilder a_;
-  return rt.invoke("_mod_scalar", ins_, a_.str());
+  if (scalar_json) a_.raw("scalar", scalar_json);
+  if (is_int_json) a_.raw("is_int", is_int_json);
+  return rt.invoke("_mod_scalar", ins_, detail::merge(a_.str(), extra_attrs));
 }
 
 inline std::vector<PackedTensor> _mp_adabelief_update(
@@ -2274,13 +3001,16 @@ inline std::vector<PackedTensor> _mp_adamw_update(
 inline std::vector<PackedTensor> _mul_scalar(
     PyRuntime& rt,
     const std::vector<PackedTensor>& inputs,
-    const char* out_json = nullptr,
-    const char* where_json = nullptr) {
+    const PackedTensor& data,
+    const char* scalar_json = nullptr,
+    const char* is_int_json = nullptr,
+    const std::string& extra_attrs = "") {
   std::vector<PackedTensor> ins_(inputs);
+  ins_.push_back(data);
   detail::JsonBuilder a_;
-  if (out_json) a_.raw("out", out_json);
-  if (where_json) a_.raw("where", where_json);
-  return rt.invoke("_mul_scalar", ins_, a_.str());
+  if (scalar_json) a_.raw("scalar", scalar_json);
+  if (is_int_json) a_.raw("is_int", is_int_json);
+  return rt.invoke("_mul_scalar", ins_, detail::merge(a_.str(), extra_attrs));
 }
 
 inline std::vector<PackedTensor> _multi_adabelief_update(
@@ -2444,13 +3174,17 @@ inline std::vector<PackedTensor> _not_equal(
 
 inline std::vector<PackedTensor> _not_equal_scalar(
     PyRuntime& rt,
-    const PackedTensor& x,
-    const PackedTensor& y) {
-  std::vector<PackedTensor> ins_;
-  ins_.push_back(x);
-  ins_.push_back(y);
+    const std::vector<PackedTensor>& inputs,
+    const PackedTensor& data,
+    const char* scalar_json = nullptr,
+    const char* is_int_json = nullptr,
+    const std::string& extra_attrs = "") {
+  std::vector<PackedTensor> ins_(inputs);
+  ins_.push_back(data);
   detail::JsonBuilder a_;
-  return rt.invoke("_not_equal_scalar", ins_, a_.str());
+  if (scalar_json) a_.raw("scalar", scalar_json);
+  if (is_int_json) a_.raw("is_int", is_int_json);
+  return rt.invoke("_not_equal_scalar", ins_, detail::merge(a_.str(), extra_attrs));
 }
 
 inline std::vector<PackedTensor> _np_reshape(
@@ -2463,6 +3197,15 @@ inline std::vector<PackedTensor> _np_reshape(
   ins_.push_back(newshape);
   detail::JsonBuilder a_;
   return rt.invoke("_np_reshape", ins_, detail::merge(a_.str(), extra_attrs));
+}
+
+inline std::vector<PackedTensor> _npi_absolute(
+    PyRuntime& rt,
+    const PackedTensor& x) {
+  std::vector<PackedTensor> ins_;
+  ins_.push_back(x);
+  detail::JsonBuilder a_;
+  return rt.invoke("_npi_absolute", ins_, a_.str());
 }
 
 inline std::vector<PackedTensor> _npi_add(
@@ -2480,13 +3223,16 @@ inline std::vector<PackedTensor> _npi_add(
 inline std::vector<PackedTensor> _npi_add_scalar(
     PyRuntime& rt,
     const std::vector<PackedTensor>& inputs,
-    const char* out_json = nullptr,
-    const char* where_json = nullptr) {
+    const PackedTensor& data,
+    const char* scalar_json = nullptr,
+    const char* is_int_json = nullptr,
+    const std::string& extra_attrs = "") {
   std::vector<PackedTensor> ins_(inputs);
+  ins_.push_back(data);
   detail::JsonBuilder a_;
-  if (out_json) a_.raw("out", out_json);
-  if (where_json) a_.raw("where", where_json);
-  return rt.invoke("_npi_add_scalar", ins_, a_.str());
+  if (scalar_json) a_.raw("scalar", scalar_json);
+  if (is_int_json) a_.raw("is_int", is_int_json);
+  return rt.invoke("_npi_add_scalar", ins_, detail::merge(a_.str(), extra_attrs));
 }
 
 inline std::vector<PackedTensor> _npi_advanced_indexing(
@@ -2560,6 +3306,51 @@ inline std::vector<PackedTensor> _npi_arange(
   return rt.invoke("_npi_arange", ins_, detail::merge(a_.str(), extra_attrs));
 }
 
+inline std::vector<PackedTensor> _npi_arccos(
+    PyRuntime& rt,
+    const PackedTensor& x) {
+  std::vector<PackedTensor> ins_;
+  ins_.push_back(x);
+  detail::JsonBuilder a_;
+  return rt.invoke("_npi_arccos", ins_, a_.str());
+}
+
+inline std::vector<PackedTensor> _npi_arccosh(
+    PyRuntime& rt,
+    const PackedTensor& x) {
+  std::vector<PackedTensor> ins_;
+  ins_.push_back(x);
+  detail::JsonBuilder a_;
+  return rt.invoke("_npi_arccosh", ins_, a_.str());
+}
+
+inline std::vector<PackedTensor> _npi_arcsin(
+    PyRuntime& rt,
+    const PackedTensor& x) {
+  std::vector<PackedTensor> ins_;
+  ins_.push_back(x);
+  detail::JsonBuilder a_;
+  return rt.invoke("_npi_arcsin", ins_, a_.str());
+}
+
+inline std::vector<PackedTensor> _npi_arcsinh(
+    PyRuntime& rt,
+    const PackedTensor& x) {
+  std::vector<PackedTensor> ins_;
+  ins_.push_back(x);
+  detail::JsonBuilder a_;
+  return rt.invoke("_npi_arcsinh", ins_, a_.str());
+}
+
+inline std::vector<PackedTensor> _npi_arctan(
+    PyRuntime& rt,
+    const PackedTensor& x) {
+  std::vector<PackedTensor> ins_;
+  ins_.push_back(x);
+  detail::JsonBuilder a_;
+  return rt.invoke("_npi_arctan", ins_, a_.str());
+}
+
 inline std::vector<PackedTensor> _npi_arctan2(
     PyRuntime& rt,
     const PackedTensor& x1,
@@ -2573,13 +3364,26 @@ inline std::vector<PackedTensor> _npi_arctan2(
 
 inline std::vector<PackedTensor> _npi_arctan2_scalar(
     PyRuntime& rt,
-    const PackedTensor& x1,
-    const PackedTensor& x2) {
-  std::vector<PackedTensor> ins_;
-  ins_.push_back(x1);
-  ins_.push_back(x2);
+    const std::vector<PackedTensor>& inputs,
+    const PackedTensor& data,
+    const char* scalar_json = nullptr,
+    const char* is_int_json = nullptr,
+    const std::string& extra_attrs = "") {
+  std::vector<PackedTensor> ins_(inputs);
+  ins_.push_back(data);
   detail::JsonBuilder a_;
-  return rt.invoke("_npi_arctan2_scalar", ins_, a_.str());
+  if (scalar_json) a_.raw("scalar", scalar_json);
+  if (is_int_json) a_.raw("is_int", is_int_json);
+  return rt.invoke("_npi_arctan2_scalar", ins_, detail::merge(a_.str(), extra_attrs));
+}
+
+inline std::vector<PackedTensor> _npi_arctanh(
+    PyRuntime& rt,
+    const PackedTensor& x) {
+  std::vector<PackedTensor> ins_;
+  ins_.push_back(x);
+  detail::JsonBuilder a_;
+  return rt.invoke("_npi_arctanh", ins_, a_.str());
 }
 
 inline std::vector<PackedTensor> _npi_argmax(
@@ -2711,13 +3515,16 @@ inline std::vector<PackedTensor> _npi_bitwise_and(
 inline std::vector<PackedTensor> _npi_bitwise_and_scalar(
     PyRuntime& rt,
     const std::vector<PackedTensor>& inputs,
-    const char* out_json = nullptr,
-    const char* where_json = nullptr) {
+    const PackedTensor& data,
+    const char* scalar_json = nullptr,
+    const char* is_int_json = nullptr,
+    const std::string& extra_attrs = "") {
   std::vector<PackedTensor> ins_(inputs);
+  ins_.push_back(data);
   detail::JsonBuilder a_;
-  if (out_json) a_.raw("out", out_json);
-  if (where_json) a_.raw("where", where_json);
-  return rt.invoke("_npi_bitwise_and_scalar", ins_, a_.str());
+  if (scalar_json) a_.raw("scalar", scalar_json);
+  if (is_int_json) a_.raw("is_int", is_int_json);
+  return rt.invoke("_npi_bitwise_and_scalar", ins_, detail::merge(a_.str(), extra_attrs));
 }
 
 inline std::vector<PackedTensor> _npi_bitwise_left_shift(
@@ -2733,13 +3540,17 @@ inline std::vector<PackedTensor> _npi_bitwise_left_shift(
 
 inline std::vector<PackedTensor> _npi_bitwise_left_shift_scalar(
     PyRuntime& rt,
-    const PackedTensor& x,
-    const PackedTensor& y) {
-  std::vector<PackedTensor> ins_;
-  ins_.push_back(x);
-  ins_.push_back(y);
+    const std::vector<PackedTensor>& inputs,
+    const PackedTensor& data,
+    const char* scalar_json = nullptr,
+    const char* is_int_json = nullptr,
+    const std::string& extra_attrs = "") {
+  std::vector<PackedTensor> ins_(inputs);
+  ins_.push_back(data);
   detail::JsonBuilder a_;
-  return rt.invoke("_npi_bitwise_left_shift_scalar", ins_, a_.str());
+  if (scalar_json) a_.raw("scalar", scalar_json);
+  if (is_int_json) a_.raw("is_int", is_int_json);
+  return rt.invoke("_npi_bitwise_left_shift_scalar", ins_, detail::merge(a_.str(), extra_attrs));
 }
 
 inline std::vector<PackedTensor> _npi_bitwise_not(
@@ -2766,13 +3577,16 @@ inline std::vector<PackedTensor> _npi_bitwise_or(
 inline std::vector<PackedTensor> _npi_bitwise_or_scalar(
     PyRuntime& rt,
     const std::vector<PackedTensor>& inputs,
-    const char* out_json = nullptr,
-    const char* where_json = nullptr) {
+    const PackedTensor& data,
+    const char* scalar_json = nullptr,
+    const char* is_int_json = nullptr,
+    const std::string& extra_attrs = "") {
   std::vector<PackedTensor> ins_(inputs);
+  ins_.push_back(data);
   detail::JsonBuilder a_;
-  if (out_json) a_.raw("out", out_json);
-  if (where_json) a_.raw("where", where_json);
-  return rt.invoke("_npi_bitwise_or_scalar", ins_, a_.str());
+  if (scalar_json) a_.raw("scalar", scalar_json);
+  if (is_int_json) a_.raw("is_int", is_int_json);
+  return rt.invoke("_npi_bitwise_or_scalar", ins_, detail::merge(a_.str(), extra_attrs));
 }
 
 inline std::vector<PackedTensor> _npi_bitwise_right_shift(
@@ -2788,13 +3602,17 @@ inline std::vector<PackedTensor> _npi_bitwise_right_shift(
 
 inline std::vector<PackedTensor> _npi_bitwise_right_shift_scalar(
     PyRuntime& rt,
-    const PackedTensor& x1,
-    const PackedTensor& x2) {
-  std::vector<PackedTensor> ins_;
-  ins_.push_back(x1);
-  ins_.push_back(x2);
+    const std::vector<PackedTensor>& inputs,
+    const PackedTensor& data,
+    const char* scalar_json = nullptr,
+    const char* is_int_json = nullptr,
+    const std::string& extra_attrs = "") {
+  std::vector<PackedTensor> ins_(inputs);
+  ins_.push_back(data);
   detail::JsonBuilder a_;
-  return rt.invoke("_npi_bitwise_right_shift_scalar", ins_, a_.str());
+  if (scalar_json) a_.raw("scalar", scalar_json);
+  if (is_int_json) a_.raw("is_int", is_int_json);
+  return rt.invoke("_npi_bitwise_right_shift_scalar", ins_, detail::merge(a_.str(), extra_attrs));
 }
 
 inline std::vector<PackedTensor> _npi_bitwise_xor(
@@ -2812,13 +3630,16 @@ inline std::vector<PackedTensor> _npi_bitwise_xor(
 inline std::vector<PackedTensor> _npi_bitwise_xor_scalar(
     PyRuntime& rt,
     const std::vector<PackedTensor>& inputs,
-    const char* out_json = nullptr,
-    const char* where_json = nullptr) {
+    const PackedTensor& data,
+    const char* scalar_json = nullptr,
+    const char* is_int_json = nullptr,
+    const std::string& extra_attrs = "") {
   std::vector<PackedTensor> ins_(inputs);
+  ins_.push_back(data);
   detail::JsonBuilder a_;
-  if (out_json) a_.raw("out", out_json);
-  if (where_json) a_.raw("where", where_json);
-  return rt.invoke("_npi_bitwise_xor_scalar", ins_, a_.str());
+  if (scalar_json) a_.raw("scalar", scalar_json);
+  if (is_int_json) a_.raw("is_int", is_int_json);
+  return rt.invoke("_npi_bitwise_xor_scalar", ins_, detail::merge(a_.str(), extra_attrs));
 }
 
 inline std::vector<PackedTensor> _npi_blackman(
@@ -2835,15 +3656,17 @@ inline std::vector<PackedTensor> _npi_blackman(
 
 inline std::vector<PackedTensor> _npi_boolean_mask_assign_scalar(
     PyRuntime& rt,
+    const std::vector<PackedTensor>& inputs,
     const PackedTensor& data,
-    const PackedTensor& mask,
-    double value = 0.0) {
-  std::vector<PackedTensor> ins_;
+    const char* scalar_json = nullptr,
+    const char* is_int_json = nullptr,
+    const std::string& extra_attrs = "") {
+  std::vector<PackedTensor> ins_(inputs);
   ins_.push_back(data);
-  ins_.push_back(mask);
   detail::JsonBuilder a_;
-  a_.put_num("value", value);
-  return rt.invoke("_npi_boolean_mask_assign_scalar", ins_, a_.str());
+  if (scalar_json) a_.raw("scalar", scalar_json);
+  if (is_int_json) a_.raw("is_int", is_int_json);
+  return rt.invoke("_npi_boolean_mask_assign_scalar", ins_, detail::merge(a_.str(), extra_attrs));
 }
 
 inline std::vector<PackedTensor> _npi_boolean_mask_assign_tensor(
@@ -2870,6 +3693,24 @@ inline std::vector<PackedTensor> _npi_broadcast_to(
   detail::JsonBuilder a_;
   if (out_sharding_json) a_.raw("out_sharding", out_sharding_json);
   return rt.invoke("_npi_broadcast_to", ins_, a_.str());
+}
+
+inline std::vector<PackedTensor> _npi_cbrt(
+    PyRuntime& rt,
+    const PackedTensor& x) {
+  std::vector<PackedTensor> ins_;
+  ins_.push_back(x);
+  detail::JsonBuilder a_;
+  return rt.invoke("_npi_cbrt", ins_, a_.str());
+}
+
+inline std::vector<PackedTensor> _npi_ceil(
+    PyRuntime& rt,
+    const PackedTensor& x) {
+  std::vector<PackedTensor> ins_;
+  ins_.push_back(x);
+  detail::JsonBuilder a_;
+  return rt.invoke("_npi_ceil", ins_, a_.str());
 }
 
 inline std::vector<PackedTensor> _npi_choice(
@@ -2932,13 +3773,35 @@ inline std::vector<PackedTensor> _npi_copysign(
 
 inline std::vector<PackedTensor> _npi_copysign_scalar(
     PyRuntime& rt,
-    const PackedTensor& x1,
-    const PackedTensor& x2) {
-  std::vector<PackedTensor> ins_;
-  ins_.push_back(x1);
-  ins_.push_back(x2);
+    const std::vector<PackedTensor>& inputs,
+    const PackedTensor& data,
+    const char* scalar_json = nullptr,
+    const char* is_int_json = nullptr,
+    const std::string& extra_attrs = "") {
+  std::vector<PackedTensor> ins_(inputs);
+  ins_.push_back(data);
   detail::JsonBuilder a_;
-  return rt.invoke("_npi_copysign_scalar", ins_, a_.str());
+  if (scalar_json) a_.raw("scalar", scalar_json);
+  if (is_int_json) a_.raw("is_int", is_int_json);
+  return rt.invoke("_npi_copysign_scalar", ins_, detail::merge(a_.str(), extra_attrs));
+}
+
+inline std::vector<PackedTensor> _npi_cos(
+    PyRuntime& rt,
+    const PackedTensor& x) {
+  std::vector<PackedTensor> ins_;
+  ins_.push_back(x);
+  detail::JsonBuilder a_;
+  return rt.invoke("_npi_cos", ins_, a_.str());
+}
+
+inline std::vector<PackedTensor> _npi_cosh(
+    PyRuntime& rt,
+    const PackedTensor& x) {
+  std::vector<PackedTensor> ins_;
+  ins_.push_back(x);
+  detail::JsonBuilder a_;
+  return rt.invoke("_npi_cosh", ins_, a_.str());
 }
 
 inline std::vector<PackedTensor> _npi_cross(
@@ -2982,6 +3845,15 @@ inline std::vector<PackedTensor> _npi_deg2rad(
   ins_.push_back(x);
   detail::JsonBuilder a_;
   return rt.invoke("_npi_deg2rad", ins_, a_.str());
+}
+
+inline std::vector<PackedTensor> _npi_degrees(
+    PyRuntime& rt,
+    const PackedTensor& x) {
+  std::vector<PackedTensor> ins_;
+  ins_.push_back(x);
+  detail::JsonBuilder a_;
+  return rt.invoke("_npi_degrees", ins_, a_.str());
 }
 
 inline std::vector<PackedTensor> _npi_delete(
@@ -3169,6 +4041,24 @@ inline std::vector<PackedTensor> _npi_einsum(
   return rt.invoke("_npi_einsum", ins_, detail::merge(a_.str(), extra_attrs));
 }
 
+inline std::vector<PackedTensor> _npi_exp(
+    PyRuntime& rt,
+    const PackedTensor& x) {
+  std::vector<PackedTensor> ins_;
+  ins_.push_back(x);
+  detail::JsonBuilder a_;
+  return rt.invoke("_npi_exp", ins_, a_.str());
+}
+
+inline std::vector<PackedTensor> _npi_expm1(
+    PyRuntime& rt,
+    const PackedTensor& x) {
+  std::vector<PackedTensor> ins_;
+  ins_.push_back(x);
+  detail::JsonBuilder a_;
+  return rt.invoke("_npi_expm1", ins_, a_.str());
+}
+
 inline std::vector<PackedTensor> _npi_exponential(
     PyRuntime& rt,
     double scale = 1.0,
@@ -3212,6 +4102,15 @@ inline std::vector<PackedTensor> _npi_fill_diagonal(
   return rt.invoke("_npi_fill_diagonal", ins_, a_.str());
 }
 
+inline std::vector<PackedTensor> _npi_fix(
+    PyRuntime& rt,
+    const PackedTensor& x) {
+  std::vector<PackedTensor> ins_;
+  ins_.push_back(x);
+  detail::JsonBuilder a_;
+  return rt.invoke("_npi_fix", ins_, a_.str());
+}
+
 inline std::vector<PackedTensor> _npi_flip(
     PyRuntime& rt,
     const PackedTensor& m,
@@ -3221,6 +4120,15 @@ inline std::vector<PackedTensor> _npi_flip(
   detail::JsonBuilder a_;
   if (axis_json) a_.raw("axis", axis_json);
   return rt.invoke("_npi_flip", ins_, a_.str());
+}
+
+inline std::vector<PackedTensor> _npi_floor(
+    PyRuntime& rt,
+    const PackedTensor& x) {
+  std::vector<PackedTensor> ins_;
+  ins_.push_back(x);
+  detail::JsonBuilder a_;
+  return rt.invoke("_npi_floor", ins_, a_.str());
 }
 
 inline std::vector<PackedTensor> _npi_floor_divide(
@@ -3236,13 +4144,17 @@ inline std::vector<PackedTensor> _npi_floor_divide(
 
 inline std::vector<PackedTensor> _npi_floor_divide_scalar(
     PyRuntime& rt,
-    const PackedTensor& x1,
-    const PackedTensor& x2) {
-  std::vector<PackedTensor> ins_;
-  ins_.push_back(x1);
-  ins_.push_back(x2);
+    const std::vector<PackedTensor>& inputs,
+    const PackedTensor& data,
+    const char* scalar_json = nullptr,
+    const char* is_int_json = nullptr,
+    const std::string& extra_attrs = "") {
+  std::vector<PackedTensor> ins_(inputs);
+  ins_.push_back(data);
   detail::JsonBuilder a_;
-  return rt.invoke("_npi_floor_divide_scalar", ins_, a_.str());
+  if (scalar_json) a_.raw("scalar", scalar_json);
+  if (is_int_json) a_.raw("is_int", is_int_json);
+  return rt.invoke("_npi_floor_divide_scalar", ins_, detail::merge(a_.str(), extra_attrs));
 }
 
 inline std::vector<PackedTensor> _npi_fmax(
@@ -3258,13 +4170,17 @@ inline std::vector<PackedTensor> _npi_fmax(
 
 inline std::vector<PackedTensor> _npi_fmax_scalar(
     PyRuntime& rt,
-    const PackedTensor& x1,
-    const PackedTensor& x2) {
-  std::vector<PackedTensor> ins_;
-  ins_.push_back(x1);
-  ins_.push_back(x2);
+    const std::vector<PackedTensor>& inputs,
+    const PackedTensor& data,
+    const char* scalar_json = nullptr,
+    const char* is_int_json = nullptr,
+    const std::string& extra_attrs = "") {
+  std::vector<PackedTensor> ins_(inputs);
+  ins_.push_back(data);
   detail::JsonBuilder a_;
-  return rt.invoke("_npi_fmax_scalar", ins_, a_.str());
+  if (scalar_json) a_.raw("scalar", scalar_json);
+  if (is_int_json) a_.raw("is_int", is_int_json);
+  return rt.invoke("_npi_fmax_scalar", ins_, detail::merge(a_.str(), extra_attrs));
 }
 
 inline std::vector<PackedTensor> _npi_fmin(
@@ -3280,13 +4196,17 @@ inline std::vector<PackedTensor> _npi_fmin(
 
 inline std::vector<PackedTensor> _npi_fmin_scalar(
     PyRuntime& rt,
-    const PackedTensor& x1,
-    const PackedTensor& x2) {
-  std::vector<PackedTensor> ins_;
-  ins_.push_back(x1);
-  ins_.push_back(x2);
+    const std::vector<PackedTensor>& inputs,
+    const PackedTensor& data,
+    const char* scalar_json = nullptr,
+    const char* is_int_json = nullptr,
+    const std::string& extra_attrs = "") {
+  std::vector<PackedTensor> ins_(inputs);
+  ins_.push_back(data);
   detail::JsonBuilder a_;
-  return rt.invoke("_npi_fmin_scalar", ins_, a_.str());
+  if (scalar_json) a_.raw("scalar", scalar_json);
+  if (is_int_json) a_.raw("is_int", is_int_json);
+  return rt.invoke("_npi_fmin_scalar", ins_, detail::merge(a_.str(), extra_attrs));
 }
 
 inline std::vector<PackedTensor> _npi_fmod(
@@ -3302,13 +4222,17 @@ inline std::vector<PackedTensor> _npi_fmod(
 
 inline std::vector<PackedTensor> _npi_fmod_scalar(
     PyRuntime& rt,
-    const PackedTensor& x1,
-    const PackedTensor& x2) {
-  std::vector<PackedTensor> ins_;
-  ins_.push_back(x1);
-  ins_.push_back(x2);
+    const std::vector<PackedTensor>& inputs,
+    const PackedTensor& data,
+    const char* scalar_json = nullptr,
+    const char* is_int_json = nullptr,
+    const std::string& extra_attrs = "") {
+  std::vector<PackedTensor> ins_(inputs);
+  ins_.push_back(data);
   detail::JsonBuilder a_;
-  return rt.invoke("_npi_fmod_scalar", ins_, a_.str());
+  if (scalar_json) a_.raw("scalar", scalar_json);
+  if (is_int_json) a_.raw("is_int", is_int_json);
+  return rt.invoke("_npi_fmod_scalar", ins_, detail::merge(a_.str(), extra_attrs));
 }
 
 inline std::vector<PackedTensor> _npi_full(
@@ -3370,13 +4294,17 @@ inline std::vector<PackedTensor> _npi_gcd(
 
 inline std::vector<PackedTensor> _npi_gcd_scalar(
     PyRuntime& rt,
-    const PackedTensor& x1,
-    const PackedTensor& x2) {
-  std::vector<PackedTensor> ins_;
-  ins_.push_back(x1);
-  ins_.push_back(x2);
+    const std::vector<PackedTensor>& inputs,
+    const PackedTensor& data,
+    const char* scalar_json = nullptr,
+    const char* is_int_json = nullptr,
+    const std::string& extra_attrs = "") {
+  std::vector<PackedTensor> ins_(inputs);
+  ins_.push_back(data);
   detail::JsonBuilder a_;
-  return rt.invoke("_npi_gcd_scalar", ins_, a_.str());
+  if (scalar_json) a_.raw("scalar", scalar_json);
+  if (is_int_json) a_.raw("is_int", is_int_json);
+  return rt.invoke("_npi_gcd_scalar", ins_, detail::merge(a_.str(), extra_attrs));
 }
 
 inline std::vector<PackedTensor> _npi_gumbel(
@@ -3477,17 +4405,17 @@ inline std::vector<PackedTensor> _npi_indices(
 
 inline std::vector<PackedTensor> _npi_insert_scalar(
     PyRuntime& rt,
-    const PackedTensor& arr,
-    const PackedTensor& obj,
-    const PackedTensor& values,
-    const char* axis_json = nullptr) {
-  std::vector<PackedTensor> ins_;
-  ins_.push_back(arr);
-  ins_.push_back(obj);
-  ins_.push_back(values);
+    const std::vector<PackedTensor>& inputs,
+    const PackedTensor& data,
+    const char* scalar_json = nullptr,
+    const char* is_int_json = nullptr,
+    const std::string& extra_attrs = "") {
+  std::vector<PackedTensor> ins_(inputs);
+  ins_.push_back(data);
   detail::JsonBuilder a_;
-  if (axis_json) a_.raw("axis", axis_json);
-  return rt.invoke("_npi_insert_scalar", ins_, a_.str());
+  if (scalar_json) a_.raw("scalar", scalar_json);
+  if (is_int_json) a_.raw("is_int", is_int_json);
+  return rt.invoke("_npi_insert_scalar", ins_, detail::merge(a_.str(), extra_attrs));
 }
 
 inline std::vector<PackedTensor> _npi_insert_slice(
@@ -3578,13 +4506,17 @@ inline std::vector<PackedTensor> _npi_lcm(
 
 inline std::vector<PackedTensor> _npi_lcm_scalar(
     PyRuntime& rt,
-    const PackedTensor& x1,
-    const PackedTensor& x2) {
-  std::vector<PackedTensor> ins_;
-  ins_.push_back(x1);
-  ins_.push_back(x2);
+    const std::vector<PackedTensor>& inputs,
+    const PackedTensor& data,
+    const char* scalar_json = nullptr,
+    const char* is_int_json = nullptr,
+    const std::string& extra_attrs = "") {
+  std::vector<PackedTensor> ins_(inputs);
+  ins_.push_back(data);
   detail::JsonBuilder a_;
-  return rt.invoke("_npi_lcm_scalar", ins_, a_.str());
+  if (scalar_json) a_.raw("scalar", scalar_json);
+  if (is_int_json) a_.raw("is_int", is_int_json);
+  return rt.invoke("_npi_lcm_scalar", ins_, detail::merge(a_.str(), extra_attrs));
 }
 
 inline std::vector<PackedTensor> _npi_ldexp(
@@ -3600,13 +4532,17 @@ inline std::vector<PackedTensor> _npi_ldexp(
 
 inline std::vector<PackedTensor> _npi_ldexp_scalar(
     PyRuntime& rt,
-    const PackedTensor& x1,
-    const PackedTensor& x2) {
-  std::vector<PackedTensor> ins_;
-  ins_.push_back(x1);
-  ins_.push_back(x2);
+    const std::vector<PackedTensor>& inputs,
+    const PackedTensor& data,
+    const char* scalar_json = nullptr,
+    const char* is_int_json = nullptr,
+    const std::string& extra_attrs = "") {
+  std::vector<PackedTensor> ins_(inputs);
+  ins_.push_back(data);
   detail::JsonBuilder a_;
-  return rt.invoke("_npi_ldexp_scalar", ins_, a_.str());
+  if (scalar_json) a_.raw("scalar", scalar_json);
+  if (is_int_json) a_.raw("is_int", is_int_json);
+  return rt.invoke("_npi_ldexp_scalar", ins_, detail::merge(a_.str(), extra_attrs));
 }
 
 inline std::vector<PackedTensor> _npi_linspace(
@@ -3640,6 +4576,33 @@ inline std::vector<PackedTensor> _npi_log(
   return rt.invoke("_npi_log", ins_, a_.str());
 }
 
+inline std::vector<PackedTensor> _npi_log10(
+    PyRuntime& rt,
+    const PackedTensor& x) {
+  std::vector<PackedTensor> ins_;
+  ins_.push_back(x);
+  detail::JsonBuilder a_;
+  return rt.invoke("_npi_log10", ins_, a_.str());
+}
+
+inline std::vector<PackedTensor> _npi_log1p(
+    PyRuntime& rt,
+    const PackedTensor& x) {
+  std::vector<PackedTensor> ins_;
+  ins_.push_back(x);
+  detail::JsonBuilder a_;
+  return rt.invoke("_npi_log1p", ins_, a_.str());
+}
+
+inline std::vector<PackedTensor> _npi_log2(
+    PyRuntime& rt,
+    const PackedTensor& x) {
+  std::vector<PackedTensor> ins_;
+  ins_.push_back(x);
+  detail::JsonBuilder a_;
+  return rt.invoke("_npi_log2", ins_, a_.str());
+}
+
 inline std::vector<PackedTensor> _npi_logaddexp(
     PyRuntime& rt,
     const std::vector<PackedTensor>& inputs,
@@ -3655,13 +4618,58 @@ inline std::vector<PackedTensor> _npi_logaddexp(
 inline std::vector<PackedTensor> _npi_logaddexp_scalar(
     PyRuntime& rt,
     const std::vector<PackedTensor>& inputs,
-    const char* out_json = nullptr,
-    const char* where_json = nullptr) {
+    const PackedTensor& data,
+    const char* scalar_json = nullptr,
+    const char* is_int_json = nullptr,
+    const std::string& extra_attrs = "") {
   std::vector<PackedTensor> ins_(inputs);
+  ins_.push_back(data);
   detail::JsonBuilder a_;
-  if (out_json) a_.raw("out", out_json);
-  if (where_json) a_.raw("where", where_json);
-  return rt.invoke("_npi_logaddexp_scalar", ins_, a_.str());
+  if (scalar_json) a_.raw("scalar", scalar_json);
+  if (is_int_json) a_.raw("is_int", is_int_json);
+  return rt.invoke("_npi_logaddexp_scalar", ins_, detail::merge(a_.str(), extra_attrs));
+}
+
+inline std::vector<PackedTensor> _npi_logical_and(
+    PyRuntime& rt,
+    const PackedTensor& lhs,
+    const PackedTensor& rhs) {
+  std::vector<PackedTensor> ins_;
+  ins_.push_back(lhs);
+  ins_.push_back(rhs);
+  detail::JsonBuilder a_;
+  return rt.invoke("_npi_logical_and", ins_, a_.str());
+}
+
+inline std::vector<PackedTensor> _npi_logical_not(
+    PyRuntime& rt,
+    const PackedTensor& x) {
+  std::vector<PackedTensor> ins_;
+  ins_.push_back(x);
+  detail::JsonBuilder a_;
+  return rt.invoke("_npi_logical_not", ins_, a_.str());
+}
+
+inline std::vector<PackedTensor> _npi_logical_or(
+    PyRuntime& rt,
+    const PackedTensor& lhs,
+    const PackedTensor& rhs) {
+  std::vector<PackedTensor> ins_;
+  ins_.push_back(lhs);
+  ins_.push_back(rhs);
+  detail::JsonBuilder a_;
+  return rt.invoke("_npi_logical_or", ins_, a_.str());
+}
+
+inline std::vector<PackedTensor> _npi_logical_xor(
+    PyRuntime& rt,
+    const PackedTensor& lhs,
+    const PackedTensor& rhs) {
+  std::vector<PackedTensor> ins_;
+  ins_.push_back(lhs);
+  ins_.push_back(rhs);
+  detail::JsonBuilder a_;
+  return rt.invoke("_npi_logical_xor", ins_, a_.str());
 }
 
 inline std::vector<PackedTensor> _npi_logistic(
@@ -3778,6 +4786,18 @@ inline std::vector<PackedTensor> _npi_max(
   return rt.invoke("_npi_max", ins_, a_.str());
 }
 
+inline std::vector<PackedTensor> _npi_maximum(
+    PyRuntime& rt,
+    const std::vector<PackedTensor>& inputs,
+    const char* out_json = nullptr,
+    const char* where_json = nullptr) {
+  std::vector<PackedTensor> ins_(inputs);
+  detail::JsonBuilder a_;
+  if (out_json) a_.raw("out", out_json);
+  if (where_json) a_.raw("where", where_json);
+  return rt.invoke("_npi_maximum", ins_, a_.str());
+}
+
 inline std::vector<PackedTensor> _npi_mean(
     PyRuntime& rt,
     const PackedTensor& a,
@@ -3816,6 +4836,18 @@ inline std::vector<PackedTensor> _npi_min(
   return rt.invoke("_npi_min", ins_, a_.str());
 }
 
+inline std::vector<PackedTensor> _npi_minimum(
+    PyRuntime& rt,
+    const std::vector<PackedTensor>& inputs,
+    const char* out_json = nullptr,
+    const char* where_json = nullptr) {
+  std::vector<PackedTensor> ins_(inputs);
+  detail::JsonBuilder a_;
+  if (out_json) a_.raw("out", out_json);
+  if (where_json) a_.raw("where", where_json);
+  return rt.invoke("_npi_minimum", ins_, a_.str());
+}
+
 inline std::vector<PackedTensor> _npi_mod(
     PyRuntime& rt,
     const PackedTensor& x1,
@@ -3829,13 +4861,17 @@ inline std::vector<PackedTensor> _npi_mod(
 
 inline std::vector<PackedTensor> _npi_mod_scalar(
     PyRuntime& rt,
-    const PackedTensor& x1,
-    const PackedTensor& x2) {
-  std::vector<PackedTensor> ins_;
-  ins_.push_back(x1);
-  ins_.push_back(x2);
+    const std::vector<PackedTensor>& inputs,
+    const PackedTensor& data,
+    const char* scalar_json = nullptr,
+    const char* is_int_json = nullptr,
+    const std::string& extra_attrs = "") {
+  std::vector<PackedTensor> ins_(inputs);
+  ins_.push_back(data);
   detail::JsonBuilder a_;
-  return rt.invoke("_npi_mod_scalar", ins_, a_.str());
+  if (scalar_json) a_.raw("scalar", scalar_json);
+  if (is_int_json) a_.raw("is_int", is_int_json);
+  return rt.invoke("_npi_mod_scalar", ins_, detail::merge(a_.str(), extra_attrs));
 }
 
 inline std::vector<PackedTensor> _npi_moveaxis(
@@ -3879,13 +4915,16 @@ inline std::vector<PackedTensor> _npi_multiply(
 inline std::vector<PackedTensor> _npi_multiply_scalar(
     PyRuntime& rt,
     const std::vector<PackedTensor>& inputs,
-    const char* out_json = nullptr,
-    const char* where_json = nullptr) {
+    const PackedTensor& data,
+    const char* scalar_json = nullptr,
+    const char* is_int_json = nullptr,
+    const std::string& extra_attrs = "") {
   std::vector<PackedTensor> ins_(inputs);
+  ins_.push_back(data);
   detail::JsonBuilder a_;
-  if (out_json) a_.raw("out", out_json);
-  if (where_json) a_.raw("where", where_json);
-  return rt.invoke("_npi_multiply_scalar", ins_, a_.str());
+  if (scalar_json) a_.raw("scalar", scalar_json);
+  if (is_int_json) a_.raw("is_int", is_int_json);
+  return rt.invoke("_npi_multiply_scalar", ins_, detail::merge(a_.str(), extra_attrs));
 }
 
 inline std::vector<PackedTensor> _npi_nan_to_num(
@@ -3903,6 +4942,18 @@ inline std::vector<PackedTensor> _npi_nan_to_num(
   if (posinf_json) a_.raw("posinf", posinf_json);
   if (neginf_json) a_.raw("neginf", neginf_json);
   return rt.invoke("_npi_nan_to_num", ins_, a_.str());
+}
+
+inline std::vector<PackedTensor> _npi_negative(
+    PyRuntime& rt,
+    const std::vector<PackedTensor>& inputs,
+    const char* out_json = nullptr,
+    const char* where_json = nullptr) {
+  std::vector<PackedTensor> ins_(inputs);
+  detail::JsonBuilder a_;
+  if (out_json) a_.raw("out", out_json);
+  if (where_json) a_.raw("where", where_json);
+  return rt.invoke("_npi_negative", ins_, a_.str());
 }
 
 inline std::vector<PackedTensor> _npi_norm(
@@ -4070,13 +5121,17 @@ inline std::vector<PackedTensor> _npi_power(
 
 inline std::vector<PackedTensor> _npi_power_scalar(
     PyRuntime& rt,
-    const PackedTensor& x1,
-    const PackedTensor& x2) {
-  std::vector<PackedTensor> ins_;
-  ins_.push_back(x1);
-  ins_.push_back(x2);
+    const std::vector<PackedTensor>& inputs,
+    const PackedTensor& data,
+    const char* scalar_json = nullptr,
+    const char* is_int_json = nullptr,
+    const std::string& extra_attrs = "") {
+  std::vector<PackedTensor> ins_(inputs);
+  ins_.push_back(data);
   detail::JsonBuilder a_;
-  return rt.invoke("_npi_power_scalar", ins_, a_.str());
+  if (scalar_json) a_.raw("scalar", scalar_json);
+  if (is_int_json) a_.raw("is_int", is_int_json);
+  return rt.invoke("_npi_power_scalar", ins_, detail::merge(a_.str(), extra_attrs));
 }
 
 inline std::vector<PackedTensor> _npi_powerd(
@@ -4137,25 +5192,40 @@ inline std::vector<PackedTensor> _npi_rad2deg(
 
 inline std::vector<PackedTensor> _npi_radd_scalar(
     PyRuntime& rt,
-    const PackedTensor& a,
-    const PackedTensor& b,
+    const std::vector<PackedTensor>& inputs,
+    const PackedTensor& data,
+    const char* scalar_json = nullptr,
+    const char* is_int_json = nullptr,
     const std::string& extra_attrs = "") {
-  std::vector<PackedTensor> ins_;
-  ins_.push_back(a);
-  ins_.push_back(b);
+  std::vector<PackedTensor> ins_(inputs);
+  ins_.push_back(data);
   detail::JsonBuilder a_;
+  if (scalar_json) a_.raw("scalar", scalar_json);
+  if (is_int_json) a_.raw("is_int", is_int_json);
   return rt.invoke("_npi_radd_scalar", ins_, detail::merge(a_.str(), extra_attrs));
+}
+
+inline std::vector<PackedTensor> _npi_radians(
+    PyRuntime& rt,
+    const PackedTensor& x) {
+  std::vector<PackedTensor> ins_;
+  ins_.push_back(x);
+  detail::JsonBuilder a_;
+  return rt.invoke("_npi_radians", ins_, a_.str());
 }
 
 inline std::vector<PackedTensor> _npi_rarctan2_scalar(
     PyRuntime& rt,
-    const PackedTensor& a,
-    const PackedTensor& b,
+    const std::vector<PackedTensor>& inputs,
+    const PackedTensor& data,
+    const char* scalar_json = nullptr,
+    const char* is_int_json = nullptr,
     const std::string& extra_attrs = "") {
-  std::vector<PackedTensor> ins_;
-  ins_.push_back(a);
-  ins_.push_back(b);
+  std::vector<PackedTensor> ins_(inputs);
+  ins_.push_back(data);
   detail::JsonBuilder a_;
+  if (scalar_json) a_.raw("scalar", scalar_json);
+  if (is_int_json) a_.raw("is_int", is_int_json);
   return rt.invoke("_npi_rarctan2_scalar", ins_, detail::merge(a_.str(), extra_attrs));
 }
 
@@ -4174,74 +5244,102 @@ inline std::vector<PackedTensor> _npi_rayleigh(
 
 inline std::vector<PackedTensor> _npi_rbitwise_and_scalar(
     PyRuntime& rt,
-    const PackedTensor& a,
-    const PackedTensor& b,
+    const std::vector<PackedTensor>& inputs,
+    const PackedTensor& data,
+    const char* scalar_json = nullptr,
+    const char* is_int_json = nullptr,
     const std::string& extra_attrs = "") {
-  std::vector<PackedTensor> ins_;
-  ins_.push_back(a);
-  ins_.push_back(b);
+  std::vector<PackedTensor> ins_(inputs);
+  ins_.push_back(data);
   detail::JsonBuilder a_;
+  if (scalar_json) a_.raw("scalar", scalar_json);
+  if (is_int_json) a_.raw("is_int", is_int_json);
   return rt.invoke("_npi_rbitwise_and_scalar", ins_, detail::merge(a_.str(), extra_attrs));
 }
 
 inline std::vector<PackedTensor> _npi_rbitwise_left_shift_scalar(
     PyRuntime& rt,
-    const PackedTensor& a,
-    const PackedTensor& b,
+    const std::vector<PackedTensor>& inputs,
+    const PackedTensor& data,
+    const char* scalar_json = nullptr,
+    const char* is_int_json = nullptr,
     const std::string& extra_attrs = "") {
-  std::vector<PackedTensor> ins_;
-  ins_.push_back(a);
-  ins_.push_back(b);
+  std::vector<PackedTensor> ins_(inputs);
+  ins_.push_back(data);
   detail::JsonBuilder a_;
+  if (scalar_json) a_.raw("scalar", scalar_json);
+  if (is_int_json) a_.raw("is_int", is_int_json);
   return rt.invoke("_npi_rbitwise_left_shift_scalar", ins_, detail::merge(a_.str(), extra_attrs));
 }
 
 inline std::vector<PackedTensor> _npi_rbitwise_or_scalar(
     PyRuntime& rt,
-    const PackedTensor& a,
-    const PackedTensor& b,
+    const std::vector<PackedTensor>& inputs,
+    const PackedTensor& data,
+    const char* scalar_json = nullptr,
+    const char* is_int_json = nullptr,
     const std::string& extra_attrs = "") {
-  std::vector<PackedTensor> ins_;
-  ins_.push_back(a);
-  ins_.push_back(b);
+  std::vector<PackedTensor> ins_(inputs);
+  ins_.push_back(data);
   detail::JsonBuilder a_;
+  if (scalar_json) a_.raw("scalar", scalar_json);
+  if (is_int_json) a_.raw("is_int", is_int_json);
   return rt.invoke("_npi_rbitwise_or_scalar", ins_, detail::merge(a_.str(), extra_attrs));
 }
 
 inline std::vector<PackedTensor> _npi_rbitwise_right_shift_scalar(
     PyRuntime& rt,
-    const PackedTensor& a,
-    const PackedTensor& b,
+    const std::vector<PackedTensor>& inputs,
+    const PackedTensor& data,
+    const char* scalar_json = nullptr,
+    const char* is_int_json = nullptr,
     const std::string& extra_attrs = "") {
-  std::vector<PackedTensor> ins_;
-  ins_.push_back(a);
-  ins_.push_back(b);
+  std::vector<PackedTensor> ins_(inputs);
+  ins_.push_back(data);
   detail::JsonBuilder a_;
+  if (scalar_json) a_.raw("scalar", scalar_json);
+  if (is_int_json) a_.raw("is_int", is_int_json);
   return rt.invoke("_npi_rbitwise_right_shift_scalar", ins_, detail::merge(a_.str(), extra_attrs));
 }
 
 inline std::vector<PackedTensor> _npi_rbitwise_xor_scalar(
     PyRuntime& rt,
-    const PackedTensor& a,
-    const PackedTensor& b,
+    const std::vector<PackedTensor>& inputs,
+    const PackedTensor& data,
+    const char* scalar_json = nullptr,
+    const char* is_int_json = nullptr,
     const std::string& extra_attrs = "") {
-  std::vector<PackedTensor> ins_;
-  ins_.push_back(a);
-  ins_.push_back(b);
+  std::vector<PackedTensor> ins_(inputs);
+  ins_.push_back(data);
   detail::JsonBuilder a_;
+  if (scalar_json) a_.raw("scalar", scalar_json);
+  if (is_int_json) a_.raw("is_int", is_int_json);
   return rt.invoke("_npi_rbitwise_xor_scalar", ins_, detail::merge(a_.str(), extra_attrs));
 }
 
 inline std::vector<PackedTensor> _npi_rcopysign_scalar(
     PyRuntime& rt,
-    const PackedTensor& a,
-    const PackedTensor& b,
+    const std::vector<PackedTensor>& inputs,
+    const PackedTensor& data,
+    const char* scalar_json = nullptr,
+    const char* is_int_json = nullptr,
+    const std::string& extra_attrs = "") {
+  std::vector<PackedTensor> ins_(inputs);
+  ins_.push_back(data);
+  detail::JsonBuilder a_;
+  if (scalar_json) a_.raw("scalar", scalar_json);
+  if (is_int_json) a_.raw("is_int", is_int_json);
+  return rt.invoke("_npi_rcopysign_scalar", ins_, detail::merge(a_.str(), extra_attrs));
+}
+
+inline std::vector<PackedTensor> _npi_reciprocal(
+    PyRuntime& rt,
+    const PackedTensor& x,
     const std::string& extra_attrs = "") {
   std::vector<PackedTensor> ins_;
-  ins_.push_back(a);
-  ins_.push_back(b);
+  ins_.push_back(x);
   detail::JsonBuilder a_;
-  return rt.invoke("_npi_rcopysign_scalar", ins_, detail::merge(a_.str(), extra_attrs));
+  return rt.invoke("_npi_reciprocal", ins_, detail::merge(a_.str(), extra_attrs));
 }
 
 inline std::vector<PackedTensor> _npi_repeat(
@@ -4280,121 +5378,160 @@ inline std::vector<PackedTensor> _npi_repeats(
 
 inline std::vector<PackedTensor> _npi_rfloor_divide_scalar(
     PyRuntime& rt,
-    const PackedTensor& a,
-    const PackedTensor& b,
+    const std::vector<PackedTensor>& inputs,
+    const PackedTensor& data,
+    const char* scalar_json = nullptr,
+    const char* is_int_json = nullptr,
     const std::string& extra_attrs = "") {
-  std::vector<PackedTensor> ins_;
-  ins_.push_back(a);
-  ins_.push_back(b);
+  std::vector<PackedTensor> ins_(inputs);
+  ins_.push_back(data);
   detail::JsonBuilder a_;
+  if (scalar_json) a_.raw("scalar", scalar_json);
+  if (is_int_json) a_.raw("is_int", is_int_json);
   return rt.invoke("_npi_rfloor_divide_scalar", ins_, detail::merge(a_.str(), extra_attrs));
 }
 
 inline std::vector<PackedTensor> _npi_rfmax_scalar(
     PyRuntime& rt,
-    const PackedTensor& a,
-    const PackedTensor& b,
+    const std::vector<PackedTensor>& inputs,
+    const PackedTensor& data,
+    const char* scalar_json = nullptr,
+    const char* is_int_json = nullptr,
     const std::string& extra_attrs = "") {
-  std::vector<PackedTensor> ins_;
-  ins_.push_back(a);
-  ins_.push_back(b);
+  std::vector<PackedTensor> ins_(inputs);
+  ins_.push_back(data);
   detail::JsonBuilder a_;
+  if (scalar_json) a_.raw("scalar", scalar_json);
+  if (is_int_json) a_.raw("is_int", is_int_json);
   return rt.invoke("_npi_rfmax_scalar", ins_, detail::merge(a_.str(), extra_attrs));
 }
 
 inline std::vector<PackedTensor> _npi_rfmin_scalar(
     PyRuntime& rt,
-    const PackedTensor& a,
-    const PackedTensor& b,
+    const std::vector<PackedTensor>& inputs,
+    const PackedTensor& data,
+    const char* scalar_json = nullptr,
+    const char* is_int_json = nullptr,
     const std::string& extra_attrs = "") {
-  std::vector<PackedTensor> ins_;
-  ins_.push_back(a);
-  ins_.push_back(b);
+  std::vector<PackedTensor> ins_(inputs);
+  ins_.push_back(data);
   detail::JsonBuilder a_;
+  if (scalar_json) a_.raw("scalar", scalar_json);
+  if (is_int_json) a_.raw("is_int", is_int_json);
   return rt.invoke("_npi_rfmin_scalar", ins_, detail::merge(a_.str(), extra_attrs));
 }
 
 inline std::vector<PackedTensor> _npi_rfmod_scalar(
     PyRuntime& rt,
-    const PackedTensor& a,
-    const PackedTensor& b,
+    const std::vector<PackedTensor>& inputs,
+    const PackedTensor& data,
+    const char* scalar_json = nullptr,
+    const char* is_int_json = nullptr,
     const std::string& extra_attrs = "") {
-  std::vector<PackedTensor> ins_;
-  ins_.push_back(a);
-  ins_.push_back(b);
+  std::vector<PackedTensor> ins_(inputs);
+  ins_.push_back(data);
   detail::JsonBuilder a_;
+  if (scalar_json) a_.raw("scalar", scalar_json);
+  if (is_int_json) a_.raw("is_int", is_int_json);
   return rt.invoke("_npi_rfmod_scalar", ins_, detail::merge(a_.str(), extra_attrs));
 }
 
 inline std::vector<PackedTensor> _npi_rgcd_scalar(
     PyRuntime& rt,
-    const PackedTensor& a,
-    const PackedTensor& b,
+    const std::vector<PackedTensor>& inputs,
+    const PackedTensor& data,
+    const char* scalar_json = nullptr,
+    const char* is_int_json = nullptr,
     const std::string& extra_attrs = "") {
-  std::vector<PackedTensor> ins_;
-  ins_.push_back(a);
-  ins_.push_back(b);
+  std::vector<PackedTensor> ins_(inputs);
+  ins_.push_back(data);
   detail::JsonBuilder a_;
+  if (scalar_json) a_.raw("scalar", scalar_json);
+  if (is_int_json) a_.raw("is_int", is_int_json);
   return rt.invoke("_npi_rgcd_scalar", ins_, detail::merge(a_.str(), extra_attrs));
+}
+
+inline std::vector<PackedTensor> _npi_rint(
+    PyRuntime& rt,
+    const PackedTensor& x) {
+  std::vector<PackedTensor> ins_;
+  ins_.push_back(x);
+  detail::JsonBuilder a_;
+  return rt.invoke("_npi_rint", ins_, a_.str());
 }
 
 inline std::vector<PackedTensor> _npi_rlcm_scalar(
     PyRuntime& rt,
-    const PackedTensor& a,
-    const PackedTensor& b,
+    const std::vector<PackedTensor>& inputs,
+    const PackedTensor& data,
+    const char* scalar_json = nullptr,
+    const char* is_int_json = nullptr,
     const std::string& extra_attrs = "") {
-  std::vector<PackedTensor> ins_;
-  ins_.push_back(a);
-  ins_.push_back(b);
+  std::vector<PackedTensor> ins_(inputs);
+  ins_.push_back(data);
   detail::JsonBuilder a_;
+  if (scalar_json) a_.raw("scalar", scalar_json);
+  if (is_int_json) a_.raw("is_int", is_int_json);
   return rt.invoke("_npi_rlcm_scalar", ins_, detail::merge(a_.str(), extra_attrs));
 }
 
 inline std::vector<PackedTensor> _npi_rldexp_scalar(
     PyRuntime& rt,
-    const PackedTensor& a,
-    const PackedTensor& b,
+    const std::vector<PackedTensor>& inputs,
+    const PackedTensor& data,
+    const char* scalar_json = nullptr,
+    const char* is_int_json = nullptr,
     const std::string& extra_attrs = "") {
-  std::vector<PackedTensor> ins_;
-  ins_.push_back(a);
-  ins_.push_back(b);
+  std::vector<PackedTensor> ins_(inputs);
+  ins_.push_back(data);
   detail::JsonBuilder a_;
+  if (scalar_json) a_.raw("scalar", scalar_json);
+  if (is_int_json) a_.raw("is_int", is_int_json);
   return rt.invoke("_npi_rldexp_scalar", ins_, detail::merge(a_.str(), extra_attrs));
 }
 
 inline std::vector<PackedTensor> _npi_rlogaddexp_scalar(
     PyRuntime& rt,
-    const PackedTensor& a,
-    const PackedTensor& b,
+    const std::vector<PackedTensor>& inputs,
+    const PackedTensor& data,
+    const char* scalar_json = nullptr,
+    const char* is_int_json = nullptr,
     const std::string& extra_attrs = "") {
-  std::vector<PackedTensor> ins_;
-  ins_.push_back(a);
-  ins_.push_back(b);
+  std::vector<PackedTensor> ins_(inputs);
+  ins_.push_back(data);
   detail::JsonBuilder a_;
+  if (scalar_json) a_.raw("scalar", scalar_json);
+  if (is_int_json) a_.raw("is_int", is_int_json);
   return rt.invoke("_npi_rlogaddexp_scalar", ins_, detail::merge(a_.str(), extra_attrs));
 }
 
 inline std::vector<PackedTensor> _npi_rmod_scalar(
     PyRuntime& rt,
-    const PackedTensor& a,
-    const PackedTensor& b,
+    const std::vector<PackedTensor>& inputs,
+    const PackedTensor& data,
+    const char* scalar_json = nullptr,
+    const char* is_int_json = nullptr,
     const std::string& extra_attrs = "") {
-  std::vector<PackedTensor> ins_;
-  ins_.push_back(a);
-  ins_.push_back(b);
+  std::vector<PackedTensor> ins_(inputs);
+  ins_.push_back(data);
   detail::JsonBuilder a_;
+  if (scalar_json) a_.raw("scalar", scalar_json);
+  if (is_int_json) a_.raw("is_int", is_int_json);
   return rt.invoke("_npi_rmod_scalar", ins_, detail::merge(a_.str(), extra_attrs));
 }
 
 inline std::vector<PackedTensor> _npi_rmultiply_scalar(
     PyRuntime& rt,
-    const PackedTensor& a,
-    const PackedTensor& b,
+    const std::vector<PackedTensor>& inputs,
+    const PackedTensor& data,
+    const char* scalar_json = nullptr,
+    const char* is_int_json = nullptr,
     const std::string& extra_attrs = "") {
-  std::vector<PackedTensor> ins_;
-  ins_.push_back(a);
-  ins_.push_back(b);
+  std::vector<PackedTensor> ins_(inputs);
+  ins_.push_back(data);
   detail::JsonBuilder a_;
+  if (scalar_json) a_.raw("scalar", scalar_json);
+  if (is_int_json) a_.raw("is_int", is_int_json);
   return rt.invoke("_npi_rmultiply_scalar", ins_, detail::merge(a_.str(), extra_attrs));
 }
 
@@ -4439,37 +5576,46 @@ inline std::vector<PackedTensor> _npi_rot90(
 
 inline std::vector<PackedTensor> _npi_rpower_scalar(
     PyRuntime& rt,
-    const PackedTensor& a,
-    const PackedTensor& b,
+    const std::vector<PackedTensor>& inputs,
+    const PackedTensor& data,
+    const char* scalar_json = nullptr,
+    const char* is_int_json = nullptr,
     const std::string& extra_attrs = "") {
-  std::vector<PackedTensor> ins_;
-  ins_.push_back(a);
-  ins_.push_back(b);
+  std::vector<PackedTensor> ins_(inputs);
+  ins_.push_back(data);
   detail::JsonBuilder a_;
+  if (scalar_json) a_.raw("scalar", scalar_json);
+  if (is_int_json) a_.raw("is_int", is_int_json);
   return rt.invoke("_npi_rpower_scalar", ins_, detail::merge(a_.str(), extra_attrs));
 }
 
 inline std::vector<PackedTensor> _npi_rsubtract_scalar(
     PyRuntime& rt,
-    const PackedTensor& a,
-    const PackedTensor& b,
+    const std::vector<PackedTensor>& inputs,
+    const PackedTensor& data,
+    const char* scalar_json = nullptr,
+    const char* is_int_json = nullptr,
     const std::string& extra_attrs = "") {
-  std::vector<PackedTensor> ins_;
-  ins_.push_back(a);
-  ins_.push_back(b);
+  std::vector<PackedTensor> ins_(inputs);
+  ins_.push_back(data);
   detail::JsonBuilder a_;
+  if (scalar_json) a_.raw("scalar", scalar_json);
+  if (is_int_json) a_.raw("is_int", is_int_json);
   return rt.invoke("_npi_rsubtract_scalar", ins_, detail::merge(a_.str(), extra_attrs));
 }
 
 inline std::vector<PackedTensor> _npi_rtrue_divide_scalar(
     PyRuntime& rt,
-    const PackedTensor& a,
-    const PackedTensor& b,
+    const std::vector<PackedTensor>& inputs,
+    const PackedTensor& data,
+    const char* scalar_json = nullptr,
+    const char* is_int_json = nullptr,
     const std::string& extra_attrs = "") {
-  std::vector<PackedTensor> ins_;
-  ins_.push_back(a);
-  ins_.push_back(b);
+  std::vector<PackedTensor> ins_(inputs);
+  ins_.push_back(data);
   detail::JsonBuilder a_;
+  if (scalar_json) a_.raw("scalar", scalar_json);
+  if (is_int_json) a_.raw("is_int", is_int_json);
   return rt.invoke("_npi_rtrue_divide_scalar", ins_, detail::merge(a_.str(), extra_attrs));
 }
 
@@ -4482,6 +5628,33 @@ inline std::vector<PackedTensor> _npi_share_memory(
   ins_.push_back(b);
   detail::JsonBuilder a_;
   return rt.invoke("_npi_share_memory", ins_, a_.str());
+}
+
+inline std::vector<PackedTensor> _npi_sign(
+    PyRuntime& rt,
+    const PackedTensor& x) {
+  std::vector<PackedTensor> ins_;
+  ins_.push_back(x);
+  detail::JsonBuilder a_;
+  return rt.invoke("_npi_sign", ins_, a_.str());
+}
+
+inline std::vector<PackedTensor> _npi_sin(
+    PyRuntime& rt,
+    const PackedTensor& x) {
+  std::vector<PackedTensor> ins_;
+  ins_.push_back(x);
+  detail::JsonBuilder a_;
+  return rt.invoke("_npi_sin", ins_, a_.str());
+}
+
+inline std::vector<PackedTensor> _npi_sinh(
+    PyRuntime& rt,
+    const PackedTensor& x) {
+  std::vector<PackedTensor> ins_;
+  ins_.push_back(x);
+  detail::JsonBuilder a_;
+  return rt.invoke("_npi_sinh", ins_, a_.str());
 }
 
 inline std::vector<PackedTensor> _npi_solve(
@@ -4506,6 +5679,24 @@ inline std::vector<PackedTensor> _npi_split(
   detail::JsonBuilder a_;
   a_.put_int("axis", axis);
   return rt.invoke("_npi_split", ins_, a_.str());
+}
+
+inline std::vector<PackedTensor> _npi_sqrt(
+    PyRuntime& rt,
+    const PackedTensor& x) {
+  std::vector<PackedTensor> ins_;
+  ins_.push_back(x);
+  detail::JsonBuilder a_;
+  return rt.invoke("_npi_sqrt", ins_, a_.str());
+}
+
+inline std::vector<PackedTensor> _npi_square(
+    PyRuntime& rt,
+    const PackedTensor& x) {
+  std::vector<PackedTensor> ins_;
+  ins_.push_back(x);
+  detail::JsonBuilder a_;
+  return rt.invoke("_npi_square", ins_, a_.str());
 }
 
 inline std::vector<PackedTensor> _npi_squeeze(
@@ -4559,13 +5750,16 @@ inline std::vector<PackedTensor> _npi_subtract(
 inline std::vector<PackedTensor> _npi_subtract_scalar(
     PyRuntime& rt,
     const std::vector<PackedTensor>& inputs,
-    const char* out_json = nullptr,
-    const char* where_json = nullptr) {
+    const PackedTensor& data,
+    const char* scalar_json = nullptr,
+    const char* is_int_json = nullptr,
+    const std::string& extra_attrs = "") {
   std::vector<PackedTensor> ins_(inputs);
+  ins_.push_back(data);
   detail::JsonBuilder a_;
-  if (out_json) a_.raw("out", out_json);
-  if (where_json) a_.raw("where", where_json);
-  return rt.invoke("_npi_subtract_scalar", ins_, a_.str());
+  if (scalar_json) a_.raw("scalar", scalar_json);
+  if (is_int_json) a_.raw("is_int", is_int_json);
+  return rt.invoke("_npi_subtract_scalar", ins_, detail::merge(a_.str(), extra_attrs));
 }
 
 inline std::vector<PackedTensor> _npi_sum(
@@ -4606,6 +5800,24 @@ inline std::vector<PackedTensor> _npi_svd(
   a_.put_bool("hermitian", hermitian);
   if (subset_by_index_json) a_.raw("subset_by_index", subset_by_index_json);
   return rt.invoke("_npi_svd", ins_, a_.str());
+}
+
+inline std::vector<PackedTensor> _npi_tan(
+    PyRuntime& rt,
+    const PackedTensor& x) {
+  std::vector<PackedTensor> ins_;
+  ins_.push_back(x);
+  detail::JsonBuilder a_;
+  return rt.invoke("_npi_tan", ins_, a_.str());
+}
+
+inline std::vector<PackedTensor> _npi_tanh(
+    PyRuntime& rt,
+    const PackedTensor& x) {
+  std::vector<PackedTensor> ins_;
+  ins_.push_back(x);
+  detail::JsonBuilder a_;
+  return rt.invoke("_npi_tanh", ins_, a_.str());
 }
 
 inline std::vector<PackedTensor> _npi_tensordot(
@@ -4763,13 +5975,26 @@ inline std::vector<PackedTensor> _npi_true_divide(
 
 inline std::vector<PackedTensor> _npi_true_divide_scalar(
     PyRuntime& rt,
-    const PackedTensor& x1,
-    const PackedTensor& x2) {
-  std::vector<PackedTensor> ins_;
-  ins_.push_back(x1);
-  ins_.push_back(x2);
+    const std::vector<PackedTensor>& inputs,
+    const PackedTensor& data,
+    const char* scalar_json = nullptr,
+    const char* is_int_json = nullptr,
+    const std::string& extra_attrs = "") {
+  std::vector<PackedTensor> ins_(inputs);
+  ins_.push_back(data);
   detail::JsonBuilder a_;
-  return rt.invoke("_npi_true_divide_scalar", ins_, a_.str());
+  if (scalar_json) a_.raw("scalar", scalar_json);
+  if (is_int_json) a_.raw("is_int", is_int_json);
+  return rt.invoke("_npi_true_divide_scalar", ins_, detail::merge(a_.str(), extra_attrs));
+}
+
+inline std::vector<PackedTensor> _npi_trunc(
+    PyRuntime& rt,
+    const PackedTensor& x) {
+  std::vector<PackedTensor> ins_;
+  ins_.push_back(x);
+  detail::JsonBuilder a_;
+  return rt.invoke("_npi_trunc", ins_, a_.str());
 }
 
 inline std::vector<PackedTensor> _npi_uniform(
@@ -5064,6 +6289,16 @@ inline std::vector<PackedTensor> _npx_nonzero(
   return rt.invoke("_npx_nonzero", ins_, a_.str());
 }
 
+inline std::vector<PackedTensor> _npx_relu(
+    PyRuntime& rt,
+    const PackedTensor& x,
+    const std::string& extra_attrs = "") {
+  std::vector<PackedTensor> ins_;
+  ins_.push_back(x);
+  detail::JsonBuilder a_;
+  return rt.invoke("_npx_relu", ins_, detail::merge(a_.str(), extra_attrs));
+}
+
 inline std::vector<PackedTensor> _npx_reshape(
     PyRuntime& rt,
     const PackedTensor& a,
@@ -5077,6 +6312,16 @@ inline std::vector<PackedTensor> _npx_reshape(
   a_.put_bool("reverse", reverse);
   a_.put_str("order", order);
   return rt.invoke("_npx_reshape", ins_, a_.str());
+}
+
+inline std::vector<PackedTensor> _npx_sigmoid(
+    PyRuntime& rt,
+    const PackedTensor& x,
+    const std::string& extra_attrs = "") {
+  std::vector<PackedTensor> ins_;
+  ins_.push_back(x);
+  detail::JsonBuilder a_;
+  return rt.invoke("_npx_sigmoid", ins_, detail::merge(a_.str(), extra_attrs));
 }
 
 inline std::vector<PackedTensor> _npx_sldwin_atten_context(
@@ -5147,19 +6392,34 @@ inline std::vector<PackedTensor> _npx_while_loop(
   return rt.invoke("_npx_while_loop", ins_, a_.str());
 }
 
+inline std::vector<PackedTensor> _ones(
+    PyRuntime& rt,
+    const PackedTensor& shape,
+    const char* dtype_json = nullptr,
+    const std::string& extra_attrs = "") {
+  std::vector<PackedTensor> ins_;
+  ins_.push_back(shape);
+  detail::JsonBuilder a_;
+  if (dtype_json) a_.raw("dtype", dtype_json);
+  return rt.invoke("_ones", ins_, detail::merge(a_.str(), extra_attrs));
+}
+
 inline std::vector<PackedTensor> _plus_scalar(
     PyRuntime& rt,
     const std::vector<PackedTensor>& inputs,
-    const char* out_json = nullptr,
-    const char* where_json = nullptr) {
+    const PackedTensor& data,
+    const char* scalar_json = nullptr,
+    const char* is_int_json = nullptr,
+    const std::string& extra_attrs = "") {
   std::vector<PackedTensor> ins_(inputs);
+  ins_.push_back(data);
   detail::JsonBuilder a_;
-  if (out_json) a_.raw("out", out_json);
-  if (where_json) a_.raw("where", where_json);
-  return rt.invoke("_plus_scalar", ins_, a_.str());
+  if (scalar_json) a_.raw("scalar", scalar_json);
+  if (is_int_json) a_.raw("is_int", is_int_json);
+  return rt.invoke("_plus_scalar", ins_, detail::merge(a_.str(), extra_attrs));
 }
 
-inline std::vector<PackedTensor> _power_scalar(
+inline std::vector<PackedTensor> _power(
     PyRuntime& rt,
     const PackedTensor& x1,
     const PackedTensor& x2) {
@@ -5167,42 +6427,181 @@ inline std::vector<PackedTensor> _power_scalar(
   ins_.push_back(x1);
   ins_.push_back(x2);
   detail::JsonBuilder a_;
-  return rt.invoke("_power_scalar", ins_, a_.str());
+  return rt.invoke("_power", ins_, a_.str());
+}
+
+inline std::vector<PackedTensor> _power_scalar(
+    PyRuntime& rt,
+    const std::vector<PackedTensor>& inputs,
+    const PackedTensor& data,
+    const char* scalar_json = nullptr,
+    const char* is_int_json = nullptr,
+    const std::string& extra_attrs = "") {
+  std::vector<PackedTensor> ins_(inputs);
+  ins_.push_back(data);
+  detail::JsonBuilder a_;
+  if (scalar_json) a_.raw("scalar", scalar_json);
+  if (is_int_json) a_.raw("is_int", is_int_json);
+  return rt.invoke("_power_scalar", ins_, detail::merge(a_.str(), extra_attrs));
+}
+
+inline std::vector<PackedTensor> _random_pdf_dirichlet(
+    PyRuntime& rt,
+    const std::vector<PackedTensor>& inputs,
+    const PackedTensor& sample,
+    bool is_log = false,
+    const std::string& extra_attrs = "") {
+  std::vector<PackedTensor> ins_(inputs);
+  ins_.push_back(sample);
+  detail::JsonBuilder a_;
+  a_.put_bool("is_log", is_log);
+  return rt.invoke("_random_pdf_dirichlet", ins_, detail::merge(a_.str(), extra_attrs));
+}
+
+inline std::vector<PackedTensor> _random_pdf_exponential(
+    PyRuntime& rt,
+    const std::vector<PackedTensor>& inputs,
+    const PackedTensor& sample,
+    bool is_log = false,
+    const std::string& extra_attrs = "") {
+  std::vector<PackedTensor> ins_(inputs);
+  ins_.push_back(sample);
+  detail::JsonBuilder a_;
+  a_.put_bool("is_log", is_log);
+  return rt.invoke("_random_pdf_exponential", ins_, detail::merge(a_.str(), extra_attrs));
+}
+
+inline std::vector<PackedTensor> _random_pdf_gamma(
+    PyRuntime& rt,
+    const std::vector<PackedTensor>& inputs,
+    const PackedTensor& sample,
+    bool is_log = false,
+    const std::string& extra_attrs = "") {
+  std::vector<PackedTensor> ins_(inputs);
+  ins_.push_back(sample);
+  detail::JsonBuilder a_;
+  a_.put_bool("is_log", is_log);
+  return rt.invoke("_random_pdf_gamma", ins_, detail::merge(a_.str(), extra_attrs));
+}
+
+inline std::vector<PackedTensor> _random_pdf_generalized_negative_binomial(
+    PyRuntime& rt,
+    const std::vector<PackedTensor>& inputs,
+    const PackedTensor& sample,
+    bool is_log = false,
+    const std::string& extra_attrs = "") {
+  std::vector<PackedTensor> ins_(inputs);
+  ins_.push_back(sample);
+  detail::JsonBuilder a_;
+  a_.put_bool("is_log", is_log);
+  return rt.invoke("_random_pdf_generalized_negative_binomial", ins_, detail::merge(a_.str(), extra_attrs));
+}
+
+inline std::vector<PackedTensor> _random_pdf_negative_binomial(
+    PyRuntime& rt,
+    const std::vector<PackedTensor>& inputs,
+    const PackedTensor& sample,
+    bool is_log = false,
+    const std::string& extra_attrs = "") {
+  std::vector<PackedTensor> ins_(inputs);
+  ins_.push_back(sample);
+  detail::JsonBuilder a_;
+  a_.put_bool("is_log", is_log);
+  return rt.invoke("_random_pdf_negative_binomial", ins_, detail::merge(a_.str(), extra_attrs));
+}
+
+inline std::vector<PackedTensor> _random_pdf_normal(
+    PyRuntime& rt,
+    const std::vector<PackedTensor>& inputs,
+    const PackedTensor& sample,
+    bool is_log = false,
+    const std::string& extra_attrs = "") {
+  std::vector<PackedTensor> ins_(inputs);
+  ins_.push_back(sample);
+  detail::JsonBuilder a_;
+  a_.put_bool("is_log", is_log);
+  return rt.invoke("_random_pdf_normal", ins_, detail::merge(a_.str(), extra_attrs));
+}
+
+inline std::vector<PackedTensor> _random_pdf_poisson(
+    PyRuntime& rt,
+    const std::vector<PackedTensor>& inputs,
+    const PackedTensor& sample,
+    bool is_log = false,
+    const std::string& extra_attrs = "") {
+  std::vector<PackedTensor> ins_(inputs);
+  ins_.push_back(sample);
+  detail::JsonBuilder a_;
+  a_.put_bool("is_log", is_log);
+  return rt.invoke("_random_pdf_poisson", ins_, detail::merge(a_.str(), extra_attrs));
+}
+
+inline std::vector<PackedTensor> _random_pdf_uniform(
+    PyRuntime& rt,
+    const std::vector<PackedTensor>& inputs,
+    const PackedTensor& sample,
+    bool is_log = false,
+    const std::string& extra_attrs = "") {
+  std::vector<PackedTensor> ins_(inputs);
+  ins_.push_back(sample);
+  detail::JsonBuilder a_;
+  a_.put_bool("is_log", is_log);
+  return rt.invoke("_random_pdf_uniform", ins_, detail::merge(a_.str(), extra_attrs));
+}
+
+inline std::vector<PackedTensor> _ravel_multi_index(
+    PyRuntime& rt,
+    const PackedTensor& data,
+    const PackedTensor& shape) {
+  std::vector<PackedTensor> ins_;
+  ins_.push_back(data);
+  ins_.push_back(shape);
+  detail::JsonBuilder a_;
+  return rt.invoke("_ravel_multi_index", ins_, a_.str());
 }
 
 inline std::vector<PackedTensor> _rdiv_scalar(
     PyRuntime& rt,
-    const PackedTensor& a,
-    const PackedTensor& b,
+    const std::vector<PackedTensor>& inputs,
+    const PackedTensor& data,
+    const char* scalar_json = nullptr,
+    const char* is_int_json = nullptr,
     const std::string& extra_attrs = "") {
-  std::vector<PackedTensor> ins_;
-  ins_.push_back(a);
-  ins_.push_back(b);
+  std::vector<PackedTensor> ins_(inputs);
+  ins_.push_back(data);
   detail::JsonBuilder a_;
+  if (scalar_json) a_.raw("scalar", scalar_json);
+  if (is_int_json) a_.raw("is_int", is_int_json);
   return rt.invoke("_rdiv_scalar", ins_, detail::merge(a_.str(), extra_attrs));
 }
 
 inline std::vector<PackedTensor> _rminus_scalar(
     PyRuntime& rt,
-    const PackedTensor& a,
-    const PackedTensor& b,
+    const std::vector<PackedTensor>& inputs,
+    const PackedTensor& data,
+    const char* scalar_json = nullptr,
+    const char* is_int_json = nullptr,
     const std::string& extra_attrs = "") {
-  std::vector<PackedTensor> ins_;
-  ins_.push_back(a);
-  ins_.push_back(b);
+  std::vector<PackedTensor> ins_(inputs);
+  ins_.push_back(data);
   detail::JsonBuilder a_;
+  if (scalar_json) a_.raw("scalar", scalar_json);
+  if (is_int_json) a_.raw("is_int", is_int_json);
   return rt.invoke("_rminus_scalar", ins_, detail::merge(a_.str(), extra_attrs));
 }
 
 inline std::vector<PackedTensor> _rmod_scalar(
     PyRuntime& rt,
-    const PackedTensor& a,
-    const PackedTensor& b,
+    const std::vector<PackedTensor>& inputs,
+    const PackedTensor& data,
+    const char* scalar_json = nullptr,
+    const char* is_int_json = nullptr,
     const std::string& extra_attrs = "") {
-  std::vector<PackedTensor> ins_;
-  ins_.push_back(a);
-  ins_.push_back(b);
+  std::vector<PackedTensor> ins_(inputs);
+  ins_.push_back(data);
   detail::JsonBuilder a_;
+  if (scalar_json) a_.raw("scalar", scalar_json);
+  if (is_int_json) a_.raw("is_int", is_int_json);
   return rt.invoke("_rmod_scalar", ins_, detail::merge(a_.str(), extra_attrs));
 }
 
@@ -5219,14 +6618,43 @@ inline std::vector<PackedTensor> _rnn_param_concat(
 
 inline std::vector<PackedTensor> _rpower_scalar(
     PyRuntime& rt,
-    const PackedTensor& a,
-    const PackedTensor& b,
+    const std::vector<PackedTensor>& inputs,
+    const PackedTensor& data,
+    const char* scalar_json = nullptr,
+    const char* is_int_json = nullptr,
     const std::string& extra_attrs = "") {
-  std::vector<PackedTensor> ins_;
-  ins_.push_back(a);
-  ins_.push_back(b);
+  std::vector<PackedTensor> ins_(inputs);
+  ins_.push_back(data);
   detail::JsonBuilder a_;
+  if (scalar_json) a_.raw("scalar", scalar_json);
+  if (is_int_json) a_.raw("is_int", is_int_json);
   return rt.invoke("_rpower_scalar", ins_, detail::merge(a_.str(), extra_attrs));
+}
+
+inline std::vector<PackedTensor> _sample_exponential(
+    PyRuntime& rt,
+    const std::vector<PackedTensor>& inputs,
+    const char* shape_json = nullptr,
+    const char* dtype_json = nullptr,
+    const std::string& extra_attrs = "") {
+  std::vector<PackedTensor> ins_(inputs);
+  detail::JsonBuilder a_;
+  if (shape_json) a_.raw("shape", shape_json);
+  if (dtype_json) a_.raw("dtype", dtype_json);
+  return rt.invoke("_sample_exponential", ins_, detail::merge(a_.str(), extra_attrs));
+}
+
+inline std::vector<PackedTensor> _sample_gamma(
+    PyRuntime& rt,
+    const std::vector<PackedTensor>& inputs,
+    const char* shape_json = nullptr,
+    const char* dtype_json = nullptr,
+    const std::string& extra_attrs = "") {
+  std::vector<PackedTensor> ins_(inputs);
+  detail::JsonBuilder a_;
+  if (shape_json) a_.raw("shape", shape_json);
+  if (dtype_json) a_.raw("dtype", dtype_json);
+  return rt.invoke("_sample_gamma", ins_, detail::merge(a_.str(), extra_attrs));
 }
 
 inline std::vector<PackedTensor> _sample_generalized_negative_binomial(
@@ -5243,6 +6671,22 @@ inline std::vector<PackedTensor> _sample_generalized_negative_binomial(
   return rt.invoke("_sample_generalized_negative_binomial", ins_, detail::merge(a_.str(), extra_attrs));
 }
 
+inline std::vector<PackedTensor> _sample_multinomial(
+    PyRuntime& rt,
+    const PackedTensor& data,
+    const char* shape_json = nullptr,
+    bool get_prob = false,
+    const std::string& dtype = "int32",
+    const std::string& extra_attrs = "") {
+  std::vector<PackedTensor> ins_;
+  ins_.push_back(data);
+  detail::JsonBuilder a_;
+  if (shape_json) a_.raw("shape", shape_json);
+  a_.put_bool("get_prob", get_prob);
+  a_.put_str("dtype", dtype);
+  return rt.invoke("_sample_multinomial", ins_, detail::merge(a_.str(), extra_attrs));
+}
+
 inline std::vector<PackedTensor> _sample_negative_binomial(
     PyRuntime& rt,
     long long k = 1,
@@ -5257,6 +6701,45 @@ inline std::vector<PackedTensor> _sample_negative_binomial(
   return rt.invoke("_sample_negative_binomial", ins_, detail::merge(a_.str(), extra_attrs));
 }
 
+inline std::vector<PackedTensor> _sample_normal(
+    PyRuntime& rt,
+    const std::vector<PackedTensor>& inputs,
+    const char* shape_json = nullptr,
+    const char* dtype_json = nullptr,
+    const std::string& extra_attrs = "") {
+  std::vector<PackedTensor> ins_(inputs);
+  detail::JsonBuilder a_;
+  if (shape_json) a_.raw("shape", shape_json);
+  if (dtype_json) a_.raw("dtype", dtype_json);
+  return rt.invoke("_sample_normal", ins_, detail::merge(a_.str(), extra_attrs));
+}
+
+inline std::vector<PackedTensor> _sample_poisson(
+    PyRuntime& rt,
+    const std::vector<PackedTensor>& inputs,
+    const char* shape_json = nullptr,
+    const char* dtype_json = nullptr,
+    const std::string& extra_attrs = "") {
+  std::vector<PackedTensor> ins_(inputs);
+  detail::JsonBuilder a_;
+  if (shape_json) a_.raw("shape", shape_json);
+  if (dtype_json) a_.raw("dtype", dtype_json);
+  return rt.invoke("_sample_poisson", ins_, detail::merge(a_.str(), extra_attrs));
+}
+
+inline std::vector<PackedTensor> _sample_uniform(
+    PyRuntime& rt,
+    const std::vector<PackedTensor>& inputs,
+    const char* shape_json = nullptr,
+    const char* dtype_json = nullptr,
+    const std::string& extra_attrs = "") {
+  std::vector<PackedTensor> ins_(inputs);
+  detail::JsonBuilder a_;
+  if (shape_json) a_.raw("shape", shape_json);
+  if (dtype_json) a_.raw("dtype", dtype_json);
+  return rt.invoke("_sample_uniform", ins_, detail::merge(a_.str(), extra_attrs));
+}
+
 inline std::vector<PackedTensor> _scatter_set_nd(
     PyRuntime& rt,
     const PackedTensor& data,
@@ -5268,6 +6751,16 @@ inline std::vector<PackedTensor> _scatter_set_nd(
   ins_.push_back(val);
   detail::JsonBuilder a_;
   return rt.invoke("_scatter_set_nd", ins_, a_.str());
+}
+
+inline std::vector<PackedTensor> _shuffle(
+    PyRuntime& rt,
+    const PackedTensor& data,
+    const std::string& extra_attrs = "") {
+  std::vector<PackedTensor> ins_;
+  ins_.push_back(data);
+  detail::JsonBuilder a_;
+  return rt.invoke("_shuffle", ins_, detail::merge(a_.str(), extra_attrs));
 }
 
 inline std::vector<PackedTensor> _slice_assign(
@@ -5289,19 +6782,17 @@ inline std::vector<PackedTensor> _slice_assign(
 
 inline std::vector<PackedTensor> _slice_assign_scalar(
     PyRuntime& rt,
+    const std::vector<PackedTensor>& inputs,
     const PackedTensor& data,
-    double scalar = 0.0,
-    const std::vector<long long>& begin = {},
-    const std::vector<long long>& end = {},
-    const char* step_json = nullptr) {
-  std::vector<PackedTensor> ins_;
+    const char* scalar_json = nullptr,
+    const char* is_int_json = nullptr,
+    const std::string& extra_attrs = "") {
+  std::vector<PackedTensor> ins_(inputs);
   ins_.push_back(data);
   detail::JsonBuilder a_;
-  a_.put_num("scalar", scalar);
-  a_.put_ivec("begin", begin);
-  a_.put_ivec("end", end);
-  if (step_json) a_.raw("step", step_json);
-  return rt.invoke("_slice_assign_scalar", ins_, a_.str());
+  if (scalar_json) a_.raw("scalar", scalar_json);
+  if (is_int_json) a_.raw("is_int", is_int_json);
+  return rt.invoke("_slice_assign_scalar", ins_, detail::merge(a_.str(), extra_attrs));
 }
 
 inline std::vector<PackedTensor> _sparse_adagrad_update(
@@ -5359,6 +6850,44 @@ inline std::vector<PackedTensor> _square_sum(
   ins_.push_back(x);
   detail::JsonBuilder a_;
   return rt.invoke("_square_sum", ins_, detail::merge(a_.str(), extra_attrs));
+}
+
+inline std::vector<PackedTensor> _unravel_index(
+    PyRuntime& rt,
+    const PackedTensor& data,
+    const PackedTensor& shape) {
+  std::vector<PackedTensor> ins_;
+  ins_.push_back(data);
+  ins_.push_back(shape);
+  detail::JsonBuilder a_;
+  return rt.invoke("_unravel_index", ins_, a_.str());
+}
+
+inline std::vector<PackedTensor> _while_loop(
+    PyRuntime& rt,
+    const PackedTensor& cond,
+    const PackedTensor& func,
+    const PackedTensor& loop_vars,
+    const char* max_iterations_json = nullptr) {
+  std::vector<PackedTensor> ins_;
+  ins_.push_back(cond);
+  ins_.push_back(func);
+  ins_.push_back(loop_vars);
+  detail::JsonBuilder a_;
+  if (max_iterations_json) a_.raw("max_iterations", max_iterations_json);
+  return rt.invoke("_while_loop", ins_, a_.str());
+}
+
+inline std::vector<PackedTensor> _zeros(
+    PyRuntime& rt,
+    const PackedTensor& shape,
+    const char* dtype_json = nullptr,
+    const std::string& extra_attrs = "") {
+  std::vector<PackedTensor> ins_;
+  ins_.push_back(shape);
+  detail::JsonBuilder a_;
+  if (dtype_json) a_.raw("dtype", dtype_json);
+  return rt.invoke("_zeros", ins_, detail::merge(a_.str(), extra_attrs));
 }
 
 inline std::vector<PackedTensor> _zeros_without_dtype(
@@ -7296,6 +8825,38 @@ inline std::vector<PackedTensor> make_loss(
   a_.put_num("valid_thresh", valid_thresh);
   a_.put_str("normalization", normalization);
   return rt.invoke("make_loss", ins_, a_.str());
+}
+
+inline std::vector<PackedTensor> masked_log_softmax(
+    PyRuntime& rt,
+    const PackedTensor& data,
+    const PackedTensor& mask,
+    long long axis = -1,
+    double temperature = 1.0,
+    const std::string& extra_attrs = "") {
+  std::vector<PackedTensor> ins_;
+  ins_.push_back(data);
+  ins_.push_back(mask);
+  detail::JsonBuilder a_;
+  a_.put_int("axis", axis);
+  a_.put_num("temperature", temperature);
+  return rt.invoke("masked_log_softmax", ins_, detail::merge(a_.str(), extra_attrs));
+}
+
+inline std::vector<PackedTensor> masked_softmax(
+    PyRuntime& rt,
+    const PackedTensor& data,
+    const PackedTensor& mask,
+    long long axis = -1,
+    double temperature = 1.0,
+    const std::string& extra_attrs = "") {
+  std::vector<PackedTensor> ins_;
+  ins_.push_back(data);
+  ins_.push_back(mask);
+  detail::JsonBuilder a_;
+  a_.put_int("axis", axis);
+  a_.put_num("temperature", temperature);
+  return rt.invoke("masked_softmax", ins_, detail::merge(a_.str(), extra_attrs));
 }
 
 inline std::vector<PackedTensor> max(

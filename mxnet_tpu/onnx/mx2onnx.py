@@ -1369,8 +1369,9 @@ def _logistic_output(ctx, s, ins, outs, shapes):  # noqa: ARG001
 
 @_conv("SequenceMask")
 def _sequence_mask(ctx, s, ins, outs, shapes):
-    use_sl = str(s.attr("use_sequence_length")) not in (
-        "None", "False", "0", "false", "")
+    from ..ops.rnn import _battr
+
+    use_sl = _battr(str(s.attr("use_sequence_length")))
     if not use_sl or len(ins) < 2:
         ctx.add_node("Identity", [ins[0]], outs, s.name)
         return
@@ -1641,8 +1642,14 @@ def _c_sample_multinomial(ctx, s, ins, outs, shapes):  # noqa: ARG001
         idx_st.shape) >= len(shapes[0]) else 1
     lg = ctx.fresh(s.name + "_log")
     ctx.add_node("Log", [ins[0]], [lg])
+    # ONNX Multinomial requires 2-D [batch, class] input; mx accepts any
+    # leading batch rank (incl. a bare 1-D pvals vector)
+    lg2 = ctx.fresh(s.name + "_log2d")
+    k2 = int(shapes[0][-1])
+    flat = ctx.const_i64(s.name + "_log2dshape", [-1, k2])
+    ctx.add_node("Reshape", [lg, flat], [lg2])
     mn = ctx.fresh(s.name + "_mn")
-    ctx.add_node("Multinomial", [lg], [mn], s.name,
+    ctx.add_node("Multinomial", [lg2], [mn], s.name,
                  {"sample_size": max(n, 1), "dtype": 6})
     shp = ctx.const_i64(s.name + "_shape", list(idx_st.shape))
     ctx.add_node("Reshape", [mn, shp], outs[:1])
@@ -1681,15 +1688,13 @@ def _rnn(ctx, s, ins, outs, shapes):
     static initializer (they always are for exported models); the flat
     cuDNN blob is sliced host-side with ops.rnn.slice_rnn_params and
     re-packed into ONNX W/R/B with the gate-order permutation."""
-    from ..ops.rnn import _GATES, slice_rnn_params
+    from ..ops.rnn import _GATES, _battr, slice_rnn_params
 
     mode = str(s.attr("mode") or "lstm")
     H = int(s.attr("state_size"))
     L = int(s.attr("num_layers") or 1)
-    bi = str(s.attr("bidirectional")) not in ("None", "False", "0",
-                                              "false", "")
-    state_out = str(s.attr("state_outputs")) not in ("None", "False", "0",
-                                                     "false", "")
+    bi = _battr(str(s.attr("bidirectional")))
+    state_out = _battr(str(s.attr("state_outputs")))
     if s.attr("projection_size"):
         raise NotImplementedError("LSTMP projection has no ONNX RNN form")
     D = 2 if bi else 1

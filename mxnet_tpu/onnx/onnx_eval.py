@@ -247,7 +247,9 @@ def _max_roi_pool(x, rois, attrs):
         x1, y1, x2, y2 = [int(round(float(v) * scale)) for v in roi[1:]]
         h = max(y2 - y1 + 1, 1)
         w = max(x2 - x1 + 1, 1)
-        pooled = _np.full((x.shape[1], ph, pw), -_np.inf, x.dtype)
+        # 0 (not -inf) for empty bins — matches mx ROIPooling's behavior
+        # for boxes falling outside the feature map
+        pooled = _np.zeros((x.shape[1], ph, pw), x.dtype)
         for i in range(ph):
             hs = y1 + (i * h) // ph
             he = y1 + max(-((-(i + 1) * h) // ph), (i * h) // ph + 1)

@@ -639,10 +639,11 @@ def _install_round5():
         ("_npi_arcsinh", jnp.arcsinh), ("_npi_arccosh", jnp.arccosh),
         ("_npi_arctanh", jnp.arctanh), ("_npi_ceil", jnp.ceil),
         ("_npi_floor", jnp.floor), ("_npi_trunc", jnp.trunc),
-        ("_npi_rint", jnp.rint), ("_npi_fix", jnp.fix),
+        ("_npi_rint", jnp.rint),
+        # fix(x) == trunc(x) (jnp.fix is deprecated, removal in v0.10)
+        ("_npi_fix", jnp.trunc),
         ("_npi_reciprocal", lambda x, **kw: 1.0 / x),
         ("_npi_maximum", jnp.maximum), ("_npi_minimum", jnp.minimum),
-        ("_npi_exponential", _OPS.get("_npi_exponential")),
         ("_npi_degrees", jnp.degrees), ("_npi_radians", jnp.radians),
         ("_npi_logical_not", jnp.logical_not),
     ]:
@@ -660,9 +661,10 @@ def _install_round5():
     # `*_scalar` entry to accept both spellings.
     def _scalar_kwarg(fn):
         def wrapped(data, *pos, scalar=None, is_int=None, **kw):  # noqa: ARG001
-            if pos:
-                return fn(data, *pos)
-            return fn(data, scalar)
+            if scalar is not None:
+                # attr spelling: scalar slots in after the tensor operands
+                return fn(data, *pos, scalar, **kw)
+            return fn(data, *pos, **kw)
 
         wrapped.__wrapped_scalar__ = True
         return wrapped

@@ -48,7 +48,7 @@ def attention_reference(q, k, v, causal=False, scale=None):
 
 def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_ref, l_ref,
                 acc_ref, *,
-                scale, causal, block_q, block_k):
+                scale, causal, block_q, block_k, valid_len=None):
     import jax.experimental.pallas as pl
 
     kv_idx = pl.program_id(2)
@@ -66,13 +66,19 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_ref, l_ref,
         q, k, (((1,), (1,)), ((), ())),
         preferred_element_type=jnp.float32) * scale  # (block_q, block_k)
 
-    if causal:
-        q_idx = pl.program_id(1)
-        q_pos = q_idx * block_q + jax.lax.broadcasted_iota(
-            jnp.int32, s.shape, 0)
+    if causal or valid_len is not None:
         k_pos = kv_idx * block_k + jax.lax.broadcasted_iota(
             jnp.int32, s.shape, 1)
-        s = jnp.where(q_pos >= k_pos, s, -jnp.inf)
+        keep = jnp.ones(s.shape, bool)
+        if causal:
+            q_idx = pl.program_id(1)
+            q_pos = q_idx * block_q + jax.lax.broadcasted_iota(
+                jnp.int32, s.shape, 0)
+            keep &= q_pos >= k_pos
+        if valid_len is not None:
+            # S was padded up to a tile multiple; padded keys are dead
+            keep &= k_pos < valid_len
+        s = jnp.where(keep, s, -jnp.inf)
 
     m_prev = m_ref[:]                                # (block_q, 1)
     l_prev = l_ref[:]
@@ -95,13 +101,17 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_ref, l_ref,
     def _finish():
         denom = jnp.maximum(l_ref[:], 1e-30)
         o_ref[0] = (acc_ref[:] / denom).astype(o_ref.dtype)
-        # logsumexp per row: m + log l (-inf for fully-masked rows)
-        lse = jnp.where(jnp.isfinite(m_ref[:]),
-                        m_ref[:] + jnp.log(denom), -jnp.inf)
-        lse_ref[0] = lse[:, 0]
+        # logsumexp per row: m + log l (-inf for fully-masked rows).
+        # Stored as a (block_q, 1) column — the trailing singleton keeps
+        # the block's last two dims (block_q, 1) legal for Mosaic tiling
+        # (block_q % 8 == 0; 1 == array dim), where a 2-D (1, block_q)
+        # block is not (sublane dim 1 is neither 8-aligned nor full).
+        lse_ref[0] = jnp.where(jnp.isfinite(m_ref[:]),
+                               m_ref[:] + jnp.log(denom), -jnp.inf)
 
 
-def _flash_fwd(q, k, v, causal, scale, block_q, block_k, interpret):
+def _flash_fwd(q, k, v, causal, scale, block_q, block_k, interpret,
+               valid_len=None):
     import jax.experimental.pallas as pl
 
     b, h, s_len, d = q.shape
@@ -115,7 +125,7 @@ def _flash_fwd(q, k, v, causal, scale, block_q, block_k, interpret):
 
     kernel = functools.partial(
         _fwd_kernel, scale=scale, causal=causal, block_q=block_q,
-        block_k=block_k)
+        block_k=block_k, valid_len=valid_len)
     out, lse = pl.pallas_call(
         kernel,
         grid=grid,
@@ -126,11 +136,11 @@ def _flash_fwd(q, k, v, causal, scale, block_q, block_k, interpret):
         ],
         out_specs=[
             pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
-            pl.BlockSpec((1, block_q), lambda b, i, j: (b, i)),
+            pl.BlockSpec((1, block_q, 1), lambda b, i, j: (b, i, 0)),
         ],
         out_shape=[
             jax.ShapeDtypeStruct((bh, s_len, d), q.dtype),
-            jax.ShapeDtypeStruct((bh, s_len), jnp.float32),
+            jax.ShapeDtypeStruct((bh, s_len, 1), jnp.float32),
         ],
         scratch_shapes=[
             _scratch((block_q, 1)),   # running max m
@@ -139,7 +149,7 @@ def _flash_fwd(q, k, v, causal, scale, block_q, block_k, interpret):
         ],
         interpret=interpret,
     )(qr, kr, vr)
-    return out.reshape(b, h, s_len, d), lse
+    return out.reshape(b, h, s_len, d), lse[..., 0]
 
 
 def _scratch(shape):
@@ -148,26 +158,32 @@ def _scratch(shape):
     return pltpu.VMEM(shape, jnp.float32)
 
 
-def _recompute_p(q, k, lse_row, scale, causal, q_idx, kv_idx, block_q,
-                 block_k):
-    """exp(QK^T * scale - lse) for one (q block, k block) tile."""
+def _recompute_p(q, k, lse_col, scale, causal, q_idx, kv_idx, block_q,
+                 block_k, valid_len=None):
+    """exp(QK^T * scale - lse) for one (q block, k block) tile.
+    lse_col: (block_q, 1) column (see _finish in _fwd_kernel)."""
     import jax.experimental.pallas as pl  # noqa: F401
 
     s = jax.lax.dot_general(
         q, k, (((1,), (1,)), ((), ())),
         preferred_element_type=jnp.float32) * scale
-    if causal:
-        q_pos = q_idx * block_q + jax.lax.broadcasted_iota(
-            jnp.int32, s.shape, 0)
+    if causal or valid_len is not None:
         k_pos = kv_idx * block_k + jax.lax.broadcasted_iota(
             jnp.int32, s.shape, 1)
-        s = jnp.where(q_pos >= k_pos, s, -jnp.inf)
-    lse = lse_row[:, None]
-    return jnp.where(jnp.isfinite(lse), jnp.exp(s - lse), 0.0)
+        keep = jnp.ones(s.shape, bool)
+        if causal:
+            q_pos = q_idx * block_q + jax.lax.broadcasted_iota(
+                jnp.int32, s.shape, 0)
+            keep &= q_pos >= k_pos
+        if valid_len is not None:
+            keep &= k_pos < valid_len
+        s = jnp.where(keep, s, -jnp.inf)
+    return jnp.where(jnp.isfinite(lse_col), jnp.exp(s - lse_col), 0.0)
 
 
 def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
-                   dq_ref, dq_acc, *, scale, causal, block_q, block_k):
+                   dq_ref, dq_acc, *, scale, causal, block_q, block_k,
+                   valid_len=None):
     import jax.experimental.pallas as pl
 
     kv_idx = pl.program_id(2)
@@ -182,15 +198,18 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         live = kv_idx * block_k <= q_idx * block_q + block_q - 1
     else:
         live = kv_idx >= 0  # always true (traced predicate)
+    if valid_len is not None:
+        # k tiles entirely inside the padding are all-zero P — skip
+        live &= kv_idx * block_k < valid_len
 
     @pl.when(live)
     def _accum():
         p = _recompute_p(q_ref[0], k_ref[0], lse_ref[0], scale, causal,
-                         q_idx, kv_idx, block_q, block_k)
+                         q_idx, kv_idx, block_q, block_k, valid_len)
         dp = jax.lax.dot_general(
             do_ref[0], v_ref[0], (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32)    # (bq, bk)
-        ds = p * (dp - delta_ref[0][:, None]) * scale
+        ds = p * (dp - delta_ref[0]) * scale
         dq_acc[:] += jax.lax.dot_general(
             ds.astype(k_ref.dtype), k_ref[0], (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
@@ -202,7 +221,7 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
 
 def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
                     dk_ref, dv_ref, dk_acc, dv_acc, *, scale, causal,
-                    block_q, block_k):
+                    block_q, block_k, valid_len=None):
     import jax.experimental.pallas as pl
 
     q_idx = pl.program_id(2)       # q blocks stream in the inner axis
@@ -218,11 +237,13 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         live = kv_idx * block_k <= q_idx * block_q + block_q - 1
     else:
         live = q_idx >= 0  # always true (traced predicate)
+    if valid_len is not None:
+        live &= kv_idx * block_k < valid_len
 
     @pl.when(live)
     def _accum():
         p = _recompute_p(q_ref[0], k_ref[0], lse_ref[0], scale, causal,
-                         q_idx, kv_idx, block_q, block_k)
+                         q_idx, kv_idx, block_q, block_k, valid_len)
         # dV += P^T dO
         dv_acc[:] += jax.lax.dot_general(
             p.astype(do_ref.dtype), do_ref[0], (((0,), (0,)), ((), ())),
@@ -230,7 +251,7 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         dp = jax.lax.dot_general(
             do_ref[0], v_ref[0], (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32)
-        ds = p * (dp - delta_ref[0][:, None]) * scale
+        ds = p * (dp - delta_ref[0]) * scale
         # dK += dS^T Q
         dk_acc[:] += jax.lax.dot_general(
             ds.astype(q_ref.dtype), q_ref[0], (((0,), (0,)), ((), ())),
@@ -243,7 +264,7 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
 
 
 def _flash_bwd(q, k, v, out, lse, g, causal, scale, block_q, block_k,
-               interpret):
+               interpret, valid_len=None):
     """Block-streamed FlashAttention-2 backward: O(S) memory, no (S, S)
     residual — P tiles are recomputed from (q, k, lse) per block."""
     import jax.experimental.pallas as pl
@@ -257,21 +278,25 @@ def _flash_bwd(q, k, v, out, lse, g, causal, scale, block_q, block_k,
     vr = v.reshape(bh, s_len, d)
     do = g.reshape(bh, s_len, d)
     orr = out.reshape(bh, s_len, d)
-    # delta = rowsum(dO * O) — the softmax-grad correction term
+    # delta = rowsum(dO * O) — the softmax-grad correction term.
+    # lse/delta ride as (bh, s_len, 1) columns so their (block_q, 1)
+    # blocks satisfy Mosaic's last-two-dims tiling rule.
     delta = jnp.sum(do.astype(jnp.float32) * orr.astype(jnp.float32),
-                    axis=-1)                        # (bh, s_len)
+                    axis=-1)[..., None]             # (bh, s_len, 1)
+    lse = lse[..., None]                            # (bh, s_len, 1)
 
     dq = pl.pallas_call(
         functools.partial(_bwd_dq_kernel, scale=scale, causal=causal,
-                          block_q=block_q, block_k=block_k),
+                          block_q=block_q, block_k=block_k,
+                          valid_len=valid_len),
         grid=(bh, s_len // block_q, s_len // block_k),
         in_specs=[
             pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
             pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, j, 0)),
             pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, j, 0)),
             pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
-            pl.BlockSpec((1, block_q), lambda b, i, j: (b, i)),
-            pl.BlockSpec((1, block_q), lambda b, i, j: (b, i)),
+            pl.BlockSpec((1, block_q, 1), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, block_q, 1), lambda b, i, j: (b, i, 0)),
         ],
         out_specs=pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
         out_shape=jax.ShapeDtypeStruct((bh, s_len, d), q.dtype),
@@ -281,15 +306,16 @@ def _flash_bwd(q, k, v, out, lse, g, causal, scale, block_q, block_k,
 
     dk, dv = pl.pallas_call(
         functools.partial(_bwd_dkv_kernel, scale=scale, causal=causal,
-                          block_q=block_q, block_k=block_k),
+                          block_q=block_q, block_k=block_k,
+                          valid_len=valid_len),
         grid=(bh, s_len // block_k, s_len // block_q),
         in_specs=[
             pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, j, 0)),
             pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, i, 0)),
             pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, i, 0)),
             pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, j, 0)),
-            pl.BlockSpec((1, block_q), lambda b, i, j: (b, j)),
-            pl.BlockSpec((1, block_q), lambda b, i, j: (b, j)),
+            pl.BlockSpec((1, block_q, 1), lambda b, i, j: (b, j, 0)),
+            pl.BlockSpec((1, block_q, 1), lambda b, i, j: (b, j, 0)),
         ],
         out_specs=[
             pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, i, 0)),
@@ -306,23 +332,26 @@ def _flash_bwd(q, k, v, out, lse, g, causal, scale, block_q, block_k,
     return dq.reshape(shape), dk.reshape(shape), dv.reshape(shape)
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
-def _flash(q, k, v, causal, scale, block_q, block_k, interpret):
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7, 8))
+def _flash(q, k, v, causal, scale, block_q, block_k, interpret,
+           valid_len=None):
     out, _ = _flash_fwd(q, k, v, causal, scale, block_q, block_k,
-                        interpret)
+                        interpret, valid_len)
     return out
 
 
-def _flash_vjp_fwd(q, k, v, causal, scale, block_q, block_k, interpret):
+def _flash_vjp_fwd(q, k, v, causal, scale, block_q, block_k, interpret,
+                   valid_len=None):
     out, lse = _flash_fwd(q, k, v, causal, scale, block_q, block_k,
-                          interpret)
+                          interpret, valid_len)
     return out, (q, k, v, out, lse)
 
 
-def _flash_vjp_bwd(causal, scale, block_q, block_k, interpret, res, g):
+def _flash_vjp_bwd(causal, scale, block_q, block_k, interpret, valid_len,
+                   res, g):
     q, k, v, out, lse = res
     return _flash_bwd(q, k, v, out, lse, g, causal, scale, block_q,
-                      block_k, interpret)
+                      block_k, interpret, valid_len)
 
 
 _flash.defvjp(_flash_vjp_fwd, _flash_vjp_bwd)
@@ -333,10 +362,10 @@ def flash_attention(q, k, v, causal=False, scale=None, block_q=128,
                     block_k=128, interpret=None):
     """Fused multi-head attention: softmax(QK^T * scale) V.
 
-    q/k/v: (B, H, S, D); S must be a multiple of the block size (pad
-    upstream — standard flash contract). Runs the Pallas kernel on TPU
-    (or anywhere with interpret=True); falls back to the jnp reference
-    otherwise.
+    q/k/v: (B, H, S, D). Runs the Pallas kernel on TPU (or anywhere with
+    interpret=True); falls back to the jnp reference otherwise. Ragged S
+    is tile-padded and the kernel masks the padded keys (static
+    `valid_len`) — only a ragged head dim D takes the reference path.
     """
     d = q.shape[-1]
     scale = scale if scale is not None else 1.0 / math.sqrt(d)
@@ -345,12 +374,27 @@ def flash_attention(q, k, v, causal=False, scale=None, block_q=128,
         platform = jax.devices()[0].platform
         if platform not in ("tpu", "axon"):
             return attention_reference(q, k, v, causal=causal, scale=scale)
-    s_len = q.shape[2]
-    bq = min(block_q, s_len)
-    bk = min(block_k, s_len)
-    # kernel eligibility: blocks must tile S exactly AND stay sublane-
-    # aligned (Mosaic: second-to-last dim multiple of 8); anything ragged
-    # takes the reference path
-    if (s_len % bq or s_len % bk or bq % 8 or bk % 8 or d % 8):
+    if d % 8:
+        # ragged head dim: blocks can't stay lane-aligned
         return attention_reference(q, k, v, causal=causal, scale=scale)
-    return _flash(q, k, v, causal, scale, bq, bk, interpret)
+    s_len = q.shape[2]
+    s_pad = _tile_pad_len(s_len, block_q)
+    bq = min(block_q, s_pad)
+    bk = min(block_k, s_pad)
+    if s_pad % bq or s_pad % bk or bq % 8 or bk % 8:
+        # non-dividing custom block sizes: reference path
+        return attention_reference(q, k, v, causal=causal, scale=scale)
+    if s_pad == s_len:
+        return _flash(q, k, v, causal, scale, bq, bk, interpret)
+    pad = [(0, 0), (0, 0), (0, s_pad - s_len), (0, 0)]
+    out = _flash(jnp.pad(q, pad), jnp.pad(k, pad), jnp.pad(v, pad),
+                 causal, scale, bq, bk, interpret, s_len)
+    return out[:, :, :s_len]
+
+
+def _tile_pad_len(s_len, block):
+    """Smallest padded length that tiles: multiple of 8 below one block,
+    multiple of the block size above."""
+    if s_len >= block:
+        return -(-s_len // block) * block
+    return -(-s_len // 8) * 8

@@ -114,7 +114,8 @@ def ring_attention_sharded(q, k, v, mesh, axis="sp", causal=False,
     return run(q, k, v)
 
 
-def _ring_flash_fwd_impl(q, k, v, axis_name, causal, scale, interpret):
+def _ring_flash_fwd_impl(q, k, v, axis_name, causal, scale, interpret,
+                         valid_len=None):
     """Ring attention with the Pallas flash kernel as the per-hop block
     compute. Each hop runs the O(S_local)-memory fused kernel on the
     resident K/V block and merges normalized partials exactly via their
@@ -166,13 +167,13 @@ def _ring_flash_fwd_impl(q, k, v, axis_name, causal, scale, interpret):
                 lambda: _jax.lax.cond(
                     src == my,
                     lambda: _flash_fwd(q, k_blk, v_blk, True, scale,
-                                       bq, bk, interpret),
+                                       bq, bk, interpret, valid_len),
                     lambda: _flash_fwd(q, k_blk, v_blk, False, scale,
-                                       bq, bk, interpret)),
+                                       bq, bk, interpret, valid_len)),
             )
         else:
             blk_out, blk_lse = _flash_fwd(q, k_blk, v_blk, False, scale,
-                                          bq, bk, interpret)
+                                          bq, bk, interpret, valid_len)
         blk_lse = blk_lse.reshape(q.shape[:3])
         return merge(out, lse, blk_out.astype(jnp.float32), blk_lse)
 
@@ -189,20 +190,23 @@ def _ring_flash_fwd_impl(q, k, v, axis_name, causal, scale, interpret):
     return out.astype(q.dtype), lse
 
 
-@partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
-def _ring_flash(q, k, v, axis_name, causal, scale, interpret):
+@partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
+def _ring_flash(q, k, v, axis_name, causal, scale, interpret,
+                valid_len=None):
     out, _ = _ring_flash_fwd_impl(q, k, v, axis_name, causal, scale,
-                                  interpret)
+                                  interpret, valid_len)
     return out
 
 
-def _ring_flash_vjp_fwd(q, k, v, axis_name, causal, scale, interpret):
+def _ring_flash_vjp_fwd(q, k, v, axis_name, causal, scale, interpret,
+                        valid_len=None):
     out, lse = _ring_flash_fwd_impl(q, k, v, axis_name, causal, scale,
-                                    interpret)
+                                    interpret, valid_len)
     return out, (q, k, v, out, lse)
 
 
-def _ring_flash_vjp_bwd(axis_name, causal, scale, interpret, res, g):
+def _ring_flash_vjp_bwd(axis_name, causal, scale, interpret, valid_len,
+                        res, g):
     """Ring backward: one full rotation; each hop runs the block-streamed
     Pallas flash backward (_flash_bwd) between the local Q and the
     resident K/V block using the saved GLOBAL lse, so memory stays
@@ -226,7 +230,7 @@ def _ring_flash_vjp_bwd(axis_name, causal, scale, interpret, res, g):
 
     def grads_for(k_blk, v_blk, is_causal):
         return _flash_bwd(q, k_blk, v_blk, out, lse_flat, g, is_causal,
-                          scale, bq, bk, interpret)
+                          scale, bq, bk, interpret, valid_len)
 
     def body(i, carry):
         dq, k_blk, v_blk, dk, dv = carry
@@ -280,15 +284,23 @@ def ring_flash_attention(q, k, v, axis_name, causal=False, scale=None,
         # axon is the tunneled TPU platform — kernel-capable, like
         # ops/pallas_attention.flash_attention's check
         interpret = jax.default_backend() not in ("tpu", "axon")
-    s_local = q.shape[2]
-    bq = min(128, s_local)
-    bk = min(128, s_local)
-    if s_local % bq or s_local % bk or bq % 8 or bk % 8 \
-            or q.shape[-1] % 8:
-        # ragged shapes: fall back to the jnp ring
+    if q.shape[-1] % 8:
+        # ragged head dim: blocks can't stay lane-aligned
         return ring_attention(q, k, v, axis_name, causal=causal,
                               scale=scale)
-    return _ring_flash(q, k, v, axis_name, causal, scale, interpret)
+    from ..ops.pallas_attention import _tile_pad_len
+
+    s_local = q.shape[2]
+    s_pad = _tile_pad_len(s_local, 128)
+    if s_pad == s_local:
+        return _ring_flash(q, k, v, axis_name, causal, scale, interpret)
+    # Ragged local shard: tile-pad; the kernel masks padded keys of every
+    # hop's resident block via the static valid_len (padding sits at the
+    # tail of each device's block, so hop-granular causality is unchanged).
+    pad = [(0, 0), (0, 0), (0, s_pad - s_local), (0, 0)]
+    out = _ring_flash(jnp.pad(q, pad), jnp.pad(k, pad), jnp.pad(v, pad),
+                      axis_name, causal, scale, interpret, s_local)
+    return out[:, :, :s_local]
 
 
 def ring_flash_attention_sharded(q, k, v, mesh, axis="sp", causal=False,

@@ -69,12 +69,29 @@ class TestFlashKernel:
         onp.testing.assert_allclose(out.astype("f"), ref.astype("f"),
                                     rtol=5e-2, atol=5e-2)
 
-    def test_ragged_length_falls_back(self):
-        # non-multiple S uses the reference path, still correct
+    def test_ragged_length_tile_padded(self):
+        # non-multiple S is padded to a tile boundary; the kernel masks
+        # the padded keys via its static valid_len
         q, k, v = _qkv(s=100, d=16)
         out = flash_attention(q, k, v, interpret=True)
         ref = attention_reference(q, k, v)
         onp.testing.assert_allclose(out, ref, rtol=1e-5, atol=1e-6)
+
+    def test_ragged_length_causal_grads(self):
+        # padded keys must be invisible to the backward kernels too
+        q, k, v = _qkv(s=52, d=16)
+
+        def loss(q, k, v):
+            return jnp.sum(flash_attention(
+                q, k, v, causal=True, interpret=True) ** 2)
+
+        def loss_ref(q, k, v):
+            return jnp.sum(attention_reference(q, k, v, causal=True) ** 2)
+
+        g1 = jax.grad(loss, argnums=(0, 1, 2))(q, k, v)
+        g2 = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+        for a, b in zip(g1, g2):
+            onp.testing.assert_allclose(a, b, rtol=1e-4, atol=1e-5)
 
 
 class TestBertIntegration:

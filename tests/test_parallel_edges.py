@@ -31,10 +31,11 @@ def _ref_attention(q, k, v, causal=False):
 
 
 @pytest.mark.parametrize("s_total,d", [(20, 16), (36, 24)])
-def test_ring_flash_ragged_falls_back_correctly(s_total, d):
+def test_ring_flash_ragged_tile_padded(s_total, d):
     """Sequence lengths whose per-device shard is not a multiple of the
-    flash block must still produce EXACT attention via the jnp-ring
-    fallback (ring_attention.py ragged guard)."""
+    flash block are tile-padded; the per-hop kernels mask the padded
+    tail of every resident block (static valid_len) and must still
+    produce EXACT attention."""
     assert (s_total // N_DEV) % 8 != 0      # genuinely ragged shards
     rs = onp.random.RandomState(0)
     q = jnp.asarray(rs.randn(1, 2, s_total, d).astype("f") * 0.3)
@@ -44,6 +45,31 @@ def test_ring_flash_ragged_falls_back_correctly(s_total, d):
     want = _ref_attention(q, k, v)
     onp.testing.assert_allclose(onp.asarray(out), onp.asarray(want),
                                 rtol=2e-4, atol=2e-4)
+
+
+def test_ring_flash_ragged_causal_grads():
+    """Backward through the padded ring-flash path: the masked tail of
+    every hop's block must contribute zero gradient."""
+    rs = onp.random.RandomState(3)
+    s_total, d = 20, 16                     # 5 per shard — ragged
+    assert (s_total // N_DEV) % 8 != 0
+    q = jnp.asarray(rs.randn(1, 2, s_total, d).astype("f") * 0.3)
+    k = jnp.asarray(rs.randn(1, 2, s_total, d).astype("f") * 0.3)
+    v = jnp.asarray(rs.randn(1, 2, s_total, d).astype("f") * 0.3)
+    mesh = _mesh("sp")
+
+    def loss(q, k, v):
+        return jnp.sum(ring_flash_attention_sharded(
+            q, k, v, mesh, axis="sp", causal=True) ** 2)
+
+    def loss_ref(q, k, v):
+        return jnp.sum(_ref_attention(q, k, v, causal=True) ** 2)
+
+    g1 = jax.grad(loss, argnums=(0, 1, 2))(q, k, v)
+    g2 = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(g1, g2):
+        onp.testing.assert_allclose(onp.asarray(a), onp.asarray(b),
+                                    rtol=2e-3, atol=2e-3)
 
 
 def test_ring_attention_bf16_drift_vs_fp32():
