@@ -8,6 +8,8 @@ unnecessary.
 """
 from __future__ import annotations
 
+import functools
+
 import jax
 import jax.numpy as jnp
 from jax import lax
@@ -252,6 +254,77 @@ def pool(x, kernel, pool_type="max", stride=None, pad=None, global_pool=False,
 # ---------------------------------------------------------------------------
 
 
+def _bn_shapes(x, axis):
+    reduce_axes = tuple(i for i in range(x.ndim) if i != axis)
+    bshape = [1] * x.ndim
+    bshape[axis] = x.shape[axis]
+    n = x.size // x.shape[axis]
+    return reduce_axes, tuple(bshape), n
+
+
+def _bn_train_impl(x, gamma, beta, shift, eps, axis):
+    """One reduction pass (sum + sum-of-squares multi-output-fused by XLA,
+    reading the activation once) + one fused elementwise normalize.
+
+    The sums are taken over (x - shift) with shift = the moving mean — a
+    per-channel constant that costs nothing (it fuses into the same pass)
+    but removes the catastrophic cancellation of the textbook
+    E[x²]−E[x]² form once the running mean tracks the data scale
+    (var is shift-invariant mathematically)."""
+    reduce_axes, bshape, n = _bn_shapes(x, axis)
+    s = lax.stop_gradient(shift.astype(jnp.float32)).reshape(bshape)
+    xf = x.astype(jnp.float32) - s
+    s1 = jnp.sum(xf, reduce_axes)
+    s2 = jnp.sum(xf * xf, reduce_axes)
+    mean_c = s1 / n
+    var = jnp.maximum(s2 / n - mean_c * mean_c, 0.0)
+    mean = mean_c + s.reshape(s1.shape)
+    inv = lax.rsqrt(var + eps)
+    scale = (gamma.astype(jnp.float32) * inv).reshape(bshape)
+    # xf is already centered on s, so normalize against the centered mean
+    offset = (beta.astype(jnp.float32)
+              - mean_c * gamma.astype(jnp.float32) * inv).reshape(bshape)
+    out = (xf * scale + offset).astype(x.dtype)
+    return out, mean, var, inv
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5))
+def _bn_train(x, gamma, beta, shift, eps, axis):
+    out, mean, var, _ = _bn_train_impl(x, gamma, beta, shift, eps, axis)
+    return out, mean, var
+
+
+def _bn_train_fwd(x, gamma, beta, shift, eps, axis):
+    out, mean, var, inv = _bn_train_impl(x, gamma, beta, shift, eps, axis)
+    return (out, mean, var), (x, gamma, beta, shift, mean, inv)
+
+
+def _bn_train_bwd(eps, axis, res, cts):
+    """Closed-form BN backward: ONE pass producing both reductions
+    (dbeta, dgamma multi-output-fused) + one fused elementwise pass for dx —
+    instead of autodiff's per-stat reduction chains through mean/var."""
+    dy, dmean_ct, dvar_ct = cts
+    x, gamma, beta, shift, mean, inv = res
+    reduce_axes, bshape, n = _bn_shapes(x, axis)
+    dyf = dy.astype(jnp.float32)
+    xf = x.astype(jnp.float32)
+    xhat = (xf - mean.reshape(bshape)) * inv.reshape(bshape)
+    dbeta = jnp.sum(dyf, reduce_axes)
+    dgamma = jnp.sum(dyf * xhat, reduce_axes)
+    g32 = gamma.astype(jnp.float32)
+    dx = (g32 * inv).reshape(bshape) * (
+        dyf - (dbeta.reshape(bshape) + xhat * dgamma.reshape(bshape)) / n)
+    # cotangents of the batch-stat outputs (aux moving-stat path; usually
+    # zero) — cheap broadcast terms that fuse into the dx pass
+    dx = dx + (dmean_ct.reshape(bshape) / n
+               + dvar_ct.reshape(bshape) * 2.0 * (xf - mean.reshape(bshape)) / n)
+    return (dx.astype(x.dtype), dgamma.astype(gamma.dtype),
+            dbeta.astype(beta.dtype), jnp.zeros_like(shift))
+
+
+_bn_train.defvjp(_bn_train_fwd, _bn_train_bwd)
+
+
 @register_op("batch_norm")
 def batch_norm(x, gamma, beta, moving_mean, moving_var, eps=1e-5,
                momentum=0.9, training=True, use_global_stats=False, axis=1):
@@ -259,38 +332,97 @@ def batch_norm(x, gamma, beta, moving_mean, moving_var, eps=1e-5,
 
     Returns (out, new_mean, new_var). The stateful moving-stat update is done
     by the caller (BatchNorm layer / state sink), keeping this function pure.
+    Training mode uses a custom_vjp so fwd reads the activation once (fused
+    sum/sum² stats) and bwd is the closed-form two-pass kernel.
     """
     axis = axis % x.ndim  # normalize negative axis (-1 = channels-last)
-    reduce_axes = tuple(i for i in range(x.ndim) if i != axis)
+    if training and not use_global_stats:
+        out, mean, var = _bn_train(x, gamma, beta, moving_mean,
+                                   float(eps), axis)
+        new_mean = moving_mean * momentum + mean.astype(moving_mean.dtype) * (1 - momentum)
+        new_var = moving_var * momentum + var.astype(moving_var.dtype) * (1 - momentum)
+        return out, new_mean, new_var
+    _, bshape, _ = _bn_shapes(x, axis)
+    mean, var = moving_mean, moving_var
+    inv = lax.rsqrt(var.astype(jnp.float32) + eps)
+    scale = (gamma.astype(jnp.float32) * inv).reshape(bshape)
+    shift = (beta.astype(jnp.float32)
+             - mean.astype(jnp.float32) * gamma.astype(jnp.float32)
+             * inv).reshape(bshape)
+    out = (x.astype(jnp.float32) * scale + shift).astype(x.dtype)
+    return out, moving_mean, moving_var
+
+
+def _ln_impl(x, gamma, beta, eps, axis):
+    """Single-pass stats (sum/sum² multi-output-fused): shifted
+    var = E[(x−x₀)²]−E[x−x₀]² with x₀ = the row's first element, which is
+    on the data's scale and so removes the cancellation of the raw
+    E[x²]−E[x]² form (variance is shift-invariant mathematically)."""
+    xf = x.astype(jnp.float32)
+    n = x.shape[axis]
+    x0 = lax.stop_gradient(
+        lax.slice_in_dim(xf, 0, 1, axis=axis % x.ndim))
+    xc = xf - x0
+    s1 = jnp.sum(xc, axis=axis, keepdims=True)
+    s2 = jnp.sum(xc * xc, axis=axis, keepdims=True)
+    mean_c = s1 / n
+    var = jnp.maximum(s2 / n - mean_c * mean_c, 0.0)
+    mean = mean_c + x0
+    inv = lax.rsqrt(var + eps)
+    xhat = (xf - mean) * inv
+    out = xhat
     bshape = [1] * x.ndim
     bshape[axis] = x.shape[axis]
-    if training and not use_global_stats:
-        mean = jnp.mean(x, axis=reduce_axes)
-        var = jnp.var(x, axis=reduce_axes)
-        new_mean = moving_mean * momentum + mean * (1 - momentum)
-        new_var = moving_var * momentum + var * (1 - momentum)
-    else:
-        mean, var = moving_mean, moving_var
-        new_mean, new_var = moving_mean, moving_var
-    inv = lax.rsqrt(var.astype(jnp.float32) + eps).astype(x.dtype)
-    out = (x - mean.reshape(bshape).astype(x.dtype)) * inv.reshape(bshape)
-    out = out * gamma.reshape(bshape).astype(x.dtype) + beta.reshape(bshape).astype(x.dtype)
-    return out, new_mean, new_var
+    if gamma is not None:
+        out = out * gamma.astype(jnp.float32).reshape(bshape)
+    if beta is not None:
+        out = out + beta.astype(jnp.float32).reshape(bshape)
+    return out.astype(x.dtype), mean, inv
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4))
+def _ln(x, gamma, beta, eps, axis):
+    return _ln_impl(x, gamma, beta, eps, axis)[0]
+
+
+def _ln_fwd(x, gamma, beta, eps, axis):
+    out, mean, inv = _ln_impl(x, gamma, beta, eps, axis)
+    return out, (x, gamma, beta, mean, inv)
+
+
+def _ln_bwd(eps, axis, res, dy):
+    """Closed-form LN backward: one fused pass per tensor instead of
+    autodiff's reduction chains through mean/var."""
+    x, gamma, beta, mean, inv = res
+    bshape = [1] * x.ndim
+    bshape[axis] = x.shape[axis]
+    n = x.shape[axis]
+    xf = x.astype(jnp.float32)
+    dyf = dy.astype(jnp.float32)
+    xhat = (xf - mean) * inv
+    a = (dyf * gamma.astype(jnp.float32).reshape(bshape)
+         if gamma is not None else dyf)
+    m1 = jnp.sum(a, axis=axis, keepdims=True) / n
+    m2 = jnp.sum(a * xhat, axis=axis, keepdims=True) / n
+    dx = (inv * (a - m1 - xhat * m2)).astype(x.dtype)
+    param_axes = tuple(i for i in range(x.ndim) if i != axis % x.ndim)
+    dgamma = (jnp.sum(dyf * xhat, axis=param_axes).astype(gamma.dtype)
+              if gamma is not None else None)
+    dbeta = (jnp.sum(dyf, axis=param_axes).astype(beta.dtype)
+             if beta is not None else None)
+    return dx, dgamma, dbeta
+
+
+_ln.defvjp(_ln_fwd, _ln_bwd)
 
 
 @register_op("layer_norm")
 def layer_norm(x, gamma, beta, axis=-1, eps=1e-5):
-    """Layer normalization (reference: nn/layer_norm.cc)."""
-    mean = jnp.mean(x, axis=axis, keepdims=True)
-    var = jnp.var(x, axis=axis, keepdims=True)
-    out = (x - mean) * lax.rsqrt(var + eps)
-    if gamma is not None:
-        bshape = [1] * x.ndim
-        bshape[axis] = x.shape[axis]
-        out = out * gamma.reshape(bshape)
-        if beta is not None:
-            out = out + beta.reshape(bshape)
-    return out
+    """Layer normalization (reference: nn/layer_norm.cc).
+
+    custom_vjp: fwd reads x once (fused sum/sum² stats); bwd is the
+    closed-form kernel (dx in one fused pass, dgamma/dbeta multi-output)."""
+    return _ln(x, gamma, beta, float(eps), axis)
 
 
 @register_op("group_norm")

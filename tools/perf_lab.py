@@ -1,0 +1,104 @@
+"""Perf lab: on-chip timing breakdown for the headline ResNet-50 bench.
+
+Usage:  python tools/perf_lab.py [layout] [batch] [mode]
+  mode: step (default) | fwd | fwdbwd | profile
+
+Prints one JSON line with measured time/step, img/s, and the XLA
+cost-analysis FLOPs of the timed computation so MFU is computed against
+the same flop counting everywhere.
+"""
+from __future__ import annotations
+
+import json
+import sys
+import time
+
+import jax
+import jax.numpy as jnp
+
+sys.path.insert(0, ".")
+import bench  # noqa: E402
+
+PEAK_BF16 = 197e12  # v5e-class peak
+
+
+def main():
+    layout = sys.argv[1] if len(sys.argv) > 1 else "NHWC"
+    batch = int(sys.argv[2]) if len(sys.argv) > 2 else 256
+    mode = sys.argv[3] if len(sys.argv) > 3 else "step"
+    iters, warmup = 20, 3
+
+    net, step, params, momenta, x, y = bench.build_resnet_train(
+        layout, batch, donate=(mode == "step"))
+    key = jax.random.PRNGKey(7)
+
+    if mode in ("fwd", "fwdbwd"):
+        fwd, _ = net.as_pure_function(training=True)
+
+        if mode == "fwd":
+            @jax.jit
+            def run(p, k, x):
+                out, _ = fwd(p, k, x)
+                return out.astype(jnp.float32).sum()
+        else:
+            def loss_fn(p, k, x, y):
+                out, _ = fwd(p, k, x)
+                logp = jax.nn.log_softmax(out.astype(jnp.float32), -1)
+                return -jnp.take_along_axis(logp, y[:, None], -1).mean()
+
+            @jax.jit
+            def run(p, k, x):
+                l, g = jax.value_and_grad(loss_fn)(p, k, x, y)
+                return l + sum(jnp.sum(v.astype(jnp.float32) ** 2)
+                               for v in g.values())
+
+        lowered = run.lower(params, key, x)
+        cost = lowered.compile().cost_analysis()
+        fl = cost.get("flops", 0.0) if cost else 0.0
+
+        def one():
+            return run(params, key, x)
+
+        dt, _ = bench._timeit(one, lambda o: float(o), iters, warmup)
+    elif mode == "profile":
+        state = {"p": params, "m": momenta}
+
+        def one():
+            state["p"], state["m"], loss = step(state["p"], state["m"],
+                                                x, y, key)
+            return loss
+
+        for _ in range(3):
+            out = one()
+        float(out)
+        with jax.profiler.trace("/tmp/xplane"):
+            for _ in range(10):
+                out = one()
+            float(out)
+        print(json.dumps({"profile": "/tmp/xplane"}))
+        return
+    else:
+        lowered = step.lower(params, momenta, x, y, key)
+        cost = lowered.compile().cost_analysis()
+        fl = cost.get("flops", 0.0) if cost else 0.0
+        state = {"p": params, "m": momenta}
+
+        def one():
+            state["p"], state["m"], loss = step(state["p"], state["m"],
+                                                x, y, key)
+            return loss
+
+        dt, _ = bench._timeit(one, lambda o: float(o), iters, warmup)
+
+    step_ms = dt / iters * 1e3
+    print(json.dumps({
+        "mode": mode, "layout": layout, "batch": batch,
+        "step_ms": round(step_ms, 2),
+        "img_s": round(batch * iters / dt, 1),
+        "xla_gflops_per_step": round(fl / 1e9, 2),
+        "mfu_vs_197T": round(fl / (dt / iters) / PEAK_BF16, 4),
+    }))
+
+
+if __name__ == "__main__":
+    main()
