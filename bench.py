@@ -81,7 +81,9 @@ def build_resnet_train(layout, batch, donate=True):
     from mxnet_tpu.gluon.model_zoo.vision import resnet50_v1
 
     mx.seed(0)
-    net = resnet50_v1(classes=1000, layout=layout)
+    stem_s2d = (os.environ.get("MXTPU_BENCH_S2D", "1") == "1"
+                and layout[-1] == "C")
+    net = resnet50_v1(classes=1000, layout=layout, stem_s2d=stem_s2d)
     net.initialize()
     amp.convert_hybrid_block(net, target_dtype="bfloat16")
 

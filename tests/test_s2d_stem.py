@@ -1,0 +1,84 @@
+"""Space-to-depth ResNet stem: exact equivalence with the 7×7/s2 conv.
+
+The MLPerf-style TPU trick (SpaceToDepthStem in model_zoo/vision/resnet.py)
+must be a pure re-association of the same arithmetic: identical outputs,
+identical parameter names/shapes (checkpoints cross-load between the plain
+and s2d variants).
+"""
+import jax
+import jax.numpy as jnp
+import numpy as onp
+from jax import lax
+
+import mxnet_tpu as mx
+from mxnet_tpu.gluon.model_zoo.vision.resnet import get_resnet
+
+
+def _ref_conv(x, w):
+    dn = lax.conv_dimension_numbers(x.shape, w.shape,
+                                    ("NHWC", "OHWI", "NHWC"))
+    return lax.conv_general_dilated(x, w, (2, 2), [(3, 3), (3, 3)],
+                                    dimension_numbers=dn)
+
+
+def _s2d_conv(x, w):
+    n, h, wd, c = x.shape
+    o = w.shape[0]
+    xs = x.reshape(n, h // 2, 2, wd // 2, 2, c)
+    xs = xs.transpose(0, 1, 3, 2, 4, 5).reshape(n, h // 2, wd // 2, 4 * c)
+    wp = jnp.pad(w, ((0, 0), (1, 0), (1, 0), (0, 0)))
+    wp = wp.reshape(o, 4, 2, 4, 2, c)
+    wp = wp.transpose(0, 1, 3, 2, 4, 5).reshape(o, 4, 4, 4 * c)
+    dn = lax.conv_dimension_numbers(xs.shape, wp.shape,
+                                    ("NHWC", "OHWI", "NHWC"))
+    return lax.conv_general_dilated(xs, wp, (1, 1), ((2, 1), (2, 1)),
+                                    dimension_numbers=dn)
+
+
+def test_s2d_conv_matches_7x7_stride2_fwd_and_grad():
+    rs = onp.random.RandomState(0)
+    x = jnp.asarray(rs.randn(2, 32, 32, 3).astype("f"))
+    w = jnp.asarray(rs.randn(16, 7, 7, 3).astype("f") * 0.1)
+    ref = _ref_conv(x, w)
+    got = _s2d_conv(x, w)
+    assert ref.shape == got.shape
+    onp.testing.assert_allclose(got, ref, rtol=1e-4, atol=1e-5)
+
+    g1 = jax.grad(lambda x, w: jnp.sin(_s2d_conv(x, w)).sum(), (0, 1))(x, w)
+    g2 = jax.grad(lambda x, w: jnp.sin(_ref_conv(x, w)).sum(), (0, 1))(x, w)
+    for u, v in zip(g1, g2):
+        onp.testing.assert_allclose(u, v, rtol=1e-4, atol=1e-4)
+
+
+def test_s2d_resnet_matches_plain_and_cross_loads():
+    rs = onp.random.RandomState(1)
+    mx.seed(0)
+    xb = mx.np.array(rs.rand(2, 64, 64, 3).astype("f"))
+    net_a = get_resnet(1, 18, layout="NHWC")
+    net_a.initialize()
+    ya = net_a(xb).asnumpy()
+
+    net_b = get_resnet(1, 18, layout="NHWC", stem_s2d=True)
+    net_b.initialize()
+    net_b(xb)
+    pa, pb = net_a.collect_params(), net_b.collect_params()
+    assert set(pa) == set(pb)  # same names: checkpoints are interchangeable
+    for k in pa:
+        assert tuple(pa[k].shape) == tuple(pb[k].shape)
+        pb[k].set_data(pa[k].data())
+    yb = net_b(xb).asnumpy()
+    onp.testing.assert_allclose(yb, ya, rtol=1e-4, atol=1e-4)
+
+    net_a.save_parameters("/tmp/_s2d_cross.params")
+    net_c = get_resnet(1, 18, layout="NHWC", stem_s2d=True)
+    net_c.load_parameters("/tmp/_s2d_cross.params")
+    onp.testing.assert_allclose(net_c(xb).asnumpy(), ya,
+                                rtol=1e-4, atol=1e-4)
+
+
+def test_s2d_requires_channels_last():
+    import pytest
+
+    from mxnet_tpu.gluon.model_zoo.vision.resnet import SpaceToDepthStem
+    with pytest.raises(ValueError):
+        SpaceToDepthStem(64, layout="NCHW")

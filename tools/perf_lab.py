@@ -10,13 +10,14 @@ the same flop counting everywhere.
 from __future__ import annotations
 
 import json
+import os
 import sys
 import time
 
 import jax
 import jax.numpy as jnp
 
-sys.path.insert(0, ".")
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 import bench  # noqa: E402
 
 PEAK_BF16 = 197e12  # v5e-class peak
@@ -52,12 +53,12 @@ def main():
                 return l + sum(jnp.sum(v.astype(jnp.float32) ** 2)
                                for v in g.values())
 
-        lowered = run.lower(params, key, x)
-        cost = lowered.compile().cost_analysis()
+        compiled = run.lower(params, key, x).compile()
+        cost = compiled.cost_analysis()
         fl = cost.get("flops", 0.0) if cost else 0.0
 
         def one():
-            return run(params, key, x)
+            return compiled(params, key, x)
 
         dt, _ = bench._timeit(one, lambda o: float(o), iters, warmup)
     elif mode == "profile":
@@ -78,14 +79,14 @@ def main():
         print(json.dumps({"profile": "/tmp/xplane"}))
         return
     else:
-        lowered = step.lower(params, momenta, x, y, key)
-        cost = lowered.compile().cost_analysis()
+        compiled = step.lower(params, momenta, x, y, key).compile()
+        cost = compiled.cost_analysis()
         fl = cost.get("flops", 0.0) if cost else 0.0
         state = {"p": params, "m": momenta}
 
         def one():
-            state["p"], state["m"], loss = step(state["p"], state["m"],
-                                                x, y, key)
+            state["p"], state["m"], loss = compiled(state["p"], state["m"],
+                                                    x, y, key)
             return loss
 
         dt, _ = bench._timeit(one, lambda o: float(o), iters, warmup)
