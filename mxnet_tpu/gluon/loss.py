@@ -76,20 +76,30 @@ class SigmoidBinaryCrossEntropyLoss(Loss):
     def forward(self, pred, label, sample_weight=None, pos_weight=None):
         w, ba, fs = self._weight, self._batch_axis, self._from_sigmoid
 
-        def fn(p, l, sw=None):  # noqa: E741
+        def fn(p, l, sw=None, pw=None):  # noqa: E741
             l2 = l.reshape(p.shape)
             if not fs:
-                mx = jnp.maximum(p, 0)
-                loss = mx - p * l2 + jnp.log1p(jnp.exp(-jnp.abs(p)))
+                if pw is None:
+                    loss = (jnp.maximum(p, 0) - p * l2
+                            + jnp.log1p(jnp.exp(-jnp.abs(p))))
+                else:
+                    # reference loss.py:268-272: log_weight = 1+(pw-1)*y;
+                    # loss = x - x*y + log_weight*(softplus(-|x|)+relu(-x))
+                    log_weight = 1 + (pw - 1) * l2
+                    loss = (p - p * l2
+                            + log_weight * (jnp.log1p(jnp.exp(-jnp.abs(p)))
+                                            + jnp.maximum(-p, 0)))
             else:
                 eps = 1e-12
-                loss = -(l2 * jnp.log(p + eps)
-                         + (1 - l2) * jnp.log(1 - p + eps))
+                pos = l2 * jnp.log(p + eps)
+                if pw is not None:
+                    pos = pos * pw
+                loss = -(pos + (1 - l2) * jnp.log(1 - p + eps))
             return _reduce(loss, w, sw, ba)
 
-        if sample_weight is not None:
-            return apply_op(fn, pred, label, sample_weight)
-        return apply_op(fn, pred, label)
+        # apply_op forwards None args untouched, so one call covers all
+        # sample_weight/pos_weight combinations
+        return apply_op(fn, pred, label, sample_weight, pos_weight)
 
 
 SigmoidBCELoss = SigmoidBinaryCrossEntropyLoss

@@ -544,6 +544,15 @@ def _eval_node(op, ins, attrs):
     if op == "Constant":
         t = a["value"]
         return (jnp.asarray(t["array"]),)
+    if op == "ScatterElements":
+        data, indices, updates = ins[0], ins[1], ins[2]
+        axis = int(a.get("axis", 0))
+        idx = jnp.asarray(indices).astype(jnp.int32)
+        axis = axis % data.ndim
+        grids = jnp.indices(idx.shape)
+        full_idx = tuple(grids[i] if i != axis else idx
+                         for i in range(data.ndim))
+        return (jnp.asarray(data).at[full_idx].set(jnp.asarray(updates)),)
     if op == "ConstantOfShape":
         shape = [int(v) for v in _np.asarray(ins[0]).tolist()]
         t = a.get("value")

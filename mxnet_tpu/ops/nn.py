@@ -633,6 +633,13 @@ def topk(x, k=1, axis=-1, ret_typ="indices", is_ascend=False):
         return vals
     if ret_typ == "both":
         return vals, idx
+    if ret_typ == "mask":
+        # 0/1 mask of the selected cells in the input's shape
+        # (reference ordering_op.cc ReturnType kReturnMask)
+        lastax_idx = jnp.moveaxis(idx, axis, -1)  # (..., k) over xm
+        mask = jax.nn.one_hot(lastax_idx, xm.shape[-1],
+                              dtype=x.dtype).sum(-2)
+        return jnp.moveaxis(mask, -1, axis)
     raise ValueError(f"unknown ret_typ {ret_typ}")
 
 

@@ -58,6 +58,8 @@ OPS_CASES = [
      {"x": onp.random.RandomState(0).rand(1, 2, 6, 6).astype("f")}),
     (lambda v: mx.sym.topk(v, k=3, axis=-1, ret_typ="value"),
      {"x": onp.random.RandomState(1).rand(2, 8).astype("f")}),
+    (lambda v: mx.sym.topk(v, k=2, axis=-1, ret_typ="mask"),
+     {"x": onp.random.RandomState(11).rand(3, 6).astype("f")}),
     (lambda v: mx.sym.LeakyReLU(v, act_type="elu", slope=0.7),
      {"x": onp.random.RandomState(2).randn(3, 4).astype("f")}),
     (lambda v: mx.sym.pad(v, mode="constant", constant_value=1.5,

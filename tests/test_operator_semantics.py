@@ -1,0 +1,262 @@
+"""Ported reference operator edge-case semantics (VERDICT missing #8).
+
+Each test re-states a behavior pinned by the reference's
+tests/python/unittest/test_operator.py (cited per test) against a numpy
+oracle, through the user-facing nd namespace.
+"""
+import numpy as onp
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd
+
+
+def A(x, dtype="float32"):
+    return mx.np.array(onp.asarray(x, dtype=dtype))
+
+
+def _np_softmax(x, axis=-1, temperature=1.0):
+    x = x - x.max(axis=axis, keepdims=True)
+    e = onp.exp(x / temperature)
+    return e / e.sum(axis=axis, keepdims=True)
+
+
+# ---------------------------------------------------------------------------
+# softmax family (reference test_operator.py:4891-5050)
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("temp", [1.0, 0.1, 2.0, 10.0])
+def test_softmax_with_temperature(temp):
+    """test_operator.py:4891 — softmax(axis=0, temperature=t)."""
+    rs = onp.random.RandomState(0)
+    data = rs.uniform(-2, 2, (3, 4)).astype("f")
+    out = nd.softmax(A(data), axis=0, temperature=temp).asnumpy()
+    onp.testing.assert_allclose(out, _np_softmax(data, 0, temp),
+                                rtol=1e-5, atol=1e-6)
+
+
+def test_softmax_with_large_inputs():
+    """test_operator.py:4913 — no overflow at ±1e4 magnitudes."""
+    x = A([[1e4, -1e4, 0.0]])
+    out = nd.softmax(x).asnumpy()
+    assert onp.isfinite(out).all()
+    onp.testing.assert_allclose(out[0, 0], 1.0, rtol=1e-5)
+    out = nd.log_softmax(x).asnumpy()
+    assert onp.isfinite(out).all()
+
+
+def test_softmax_with_length():
+    """test_operator.py:4965 — masked positions get exactly 0 probability,
+    valid positions renormalize over the prefix."""
+    rs = onp.random.RandomState(1)
+    data = rs.uniform(-1, 1, (2, 5)).astype("f")
+    length = onp.array([3, 5])
+    out = nd.softmax(A(data), axis=-1,
+                     length=A(length, "int32")).asnumpy()
+    want = onp.zeros_like(data)
+    for i, ln in enumerate(length):
+        want[i, :ln] = _np_softmax(data[i, :ln])
+    onp.testing.assert_allclose(out, want, rtol=1e-5, atol=1e-6)
+
+
+def test_softmin_is_softmax_of_negated():
+    rs = onp.random.RandomState(2)
+    data = rs.uniform(-1, 1, (3, 4)).astype("f")
+    out = nd.softmin(A(data)).asnumpy()
+    onp.testing.assert_allclose(out, _np_softmax(-data), rtol=1e-5,
+                                atol=1e-6)
+
+
+# ---------------------------------------------------------------------------
+# sequence ops (reference test_operator.py test_sequence_{mask,last,reverse})
+# ---------------------------------------------------------------------------
+
+
+def test_sequence_mask_value_and_axes():
+    rs = onp.random.RandomState(3)
+    x = rs.randn(4, 3, 2).astype("f")  # (T, N, F)
+    lens = onp.array([2, 4, 1])
+    out = nd.SequenceMask(A(x), sequence_length=A(lens, "int32"),
+                          use_sequence_length=True, value=-7.0).asnumpy()
+    want = x.copy()
+    for n, ln in enumerate(lens):
+        want[ln:, n, :] = -7.0
+    onp.testing.assert_allclose(out, want)
+    # without the flag: identity (reference default)
+    out = nd.SequenceMask(A(x)).asnumpy()
+    onp.testing.assert_allclose(out, x)
+
+
+def test_sequence_last():
+    rs = onp.random.RandomState(4)
+    x = rs.randn(5, 3, 2).astype("f")
+    lens = onp.array([1, 5, 3])
+    out = nd.SequenceLast(A(x), sequence_length=A(lens, "int32"),
+                          use_sequence_length=True).asnumpy()
+    want = onp.stack([x[lens[n] - 1, n] for n in range(3)])
+    onp.testing.assert_allclose(out, want)
+    # default: plain last step
+    onp.testing.assert_allclose(nd.SequenceLast(A(x)).asnumpy(), x[-1])
+
+
+def test_sequence_reverse():
+    rs = onp.random.RandomState(5)
+    x = rs.randn(4, 2, 3).astype("f")
+    lens = onp.array([2, 4])
+    out = nd.SequenceReverse(A(x), sequence_length=A(lens, "int32"),
+                             use_sequence_length=True).asnumpy()
+    want = x.copy()
+    for n, ln in enumerate(lens):
+        want[:ln, n] = x[:ln, n][::-1]
+    onp.testing.assert_allclose(out, want)
+    onp.testing.assert_allclose(nd.SequenceReverse(A(x)).asnumpy(),
+                                x[::-1])
+
+
+# ---------------------------------------------------------------------------
+# indexing (reference test_operator.py test_take / test_pick / gather_nd)
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("mode", ["clip", "wrap"])
+def test_take_out_of_range_modes(mode):
+    """test_operator.py test_take — clip saturates, wrap is modular."""
+    a = onp.arange(12, dtype="f").reshape(4, 3)
+    idx = onp.array([[-2, 1], [5, 3]])
+    out = nd.take(A(a), A(idx, "int32"), axis=0, mode=mode).asnumpy()
+    want = onp.take(a, (onp.clip(idx, 0, 3) if mode == "clip"
+                        else idx % 4), axis=0)
+    onp.testing.assert_allclose(out, want)
+
+
+def test_take_axis1():
+    a = onp.arange(12, dtype="f").reshape(4, 3)
+    idx = onp.array([2, 0])
+    out = nd.take(A(a), A(idx, "int32"), axis=1).asnumpy()
+    onp.testing.assert_allclose(out, a[:, idx])
+
+
+@pytest.mark.parametrize("keepdims", [False, True])
+def test_pick_modes(keepdims):
+    rs = onp.random.RandomState(6)
+    x = rs.randn(3, 4).astype("f")
+    idx = onp.array([0, 5, 2])  # 5 out of range -> clip to 3
+    out = nd.pick(A(x), A(idx, "int32"), axis=1,
+                  keepdims=keepdims).asnumpy()
+    want = x[onp.arange(3), onp.clip(idx, 0, 3)]
+    if keepdims:
+        want = want[:, None]
+    onp.testing.assert_allclose(out, want)
+
+
+def test_gather_scatter_nd_roundtrip():
+    """scatter_nd(gather_nd(x, i), i) restores gathered cells; duplicate
+    indices in scatter_nd are last-write-wins/non-deterministic per the
+    reference docs (the accumulating variant is _backward_gather_nd)."""
+    x = onp.arange(6, dtype="f").reshape(2, 3)
+    idx = onp.array([[0, 1], [1, 2]])  # rows of coords, transposed layout
+    g = nd.gather_nd(A(x), A(idx, "int32")).asnumpy()
+    onp.testing.assert_allclose(g, [x[0, 1], x[1, 2]])
+    s = nd.scatter_nd(A(g), A(idx, "int32"), shape=(2, 3)).asnumpy()
+    want = onp.zeros((2, 3), "f")
+    want[0, 1], want[1, 2] = g
+    onp.testing.assert_allclose(s, want)
+    # duplicates: one of the written values survives (reference:
+    # "the result is non-deterministic" — indexing_op.cc scatter_nd doc)
+    idx2 = onp.array([[0, 0], [1, 1]])
+    s2 = nd.scatter_nd(A([2.0, 3.0]), A(idx2, "int32"),
+                       shape=(2, 3)).asnumpy()
+    assert s2[0, 1] in (2.0, 3.0)
+
+
+# ---------------------------------------------------------------------------
+# ordering (reference test_operator.py test_order)
+# ---------------------------------------------------------------------------
+
+
+def test_topk_ret_typs():
+    x = onp.array([[3.0, 1.0, 2.0], [0.0, 5.0, 4.0]], "f")
+    v = nd.topk(A(x), k=2, ret_typ="value").asnumpy()
+    onp.testing.assert_allclose(v, [[3.0, 2.0], [5.0, 4.0]])
+    i = nd.topk(A(x), k=2, ret_typ="indices").asnumpy()
+    onp.testing.assert_allclose(i, [[0, 2], [1, 2]])
+    m = nd.topk(A(x), k=2, ret_typ="mask").asnumpy()
+    onp.testing.assert_allclose(m, [[1, 0, 1], [0, 1, 1]])
+    # ascending = bottom-k
+    v = nd.topk(A(x), k=1, is_ascend=True, ret_typ="value").asnumpy()
+    onp.testing.assert_allclose(v, [[1.0], [0.0]])
+
+
+def test_sort_and_argsort_axis0():
+    rs = onp.random.RandomState(7)
+    x = rs.randn(4, 3).astype("f")
+    onp.testing.assert_allclose(nd.sort(A(x), axis=0).asnumpy(),
+                                onp.sort(x, 0), rtol=1e-6)
+    onp.testing.assert_allclose(nd.argsort(A(x), axis=0).asnumpy(),
+                                onp.argsort(x, 0, kind="stable"))
+
+
+# ---------------------------------------------------------------------------
+# slicing / broadcasting (reference test_operator.py test_slice_* /
+# test_broadcast_*)
+# ---------------------------------------------------------------------------
+
+
+def test_slice_negative_and_step():
+    x = onp.arange(24, dtype="f").reshape(4, 6)
+    out = nd.slice(A(x), begin=(1, -5), end=(4, None),
+                   step=(2, 2)).asnumpy()
+    onp.testing.assert_allclose(out, x[1:4:2, -5::2])
+
+
+def test_slice_axis_and_like():
+    x = onp.arange(24, dtype="f").reshape(4, 6)
+    out = nd.slice_axis(A(x), axis=1, begin=-3, end=None).asnumpy()
+    onp.testing.assert_allclose(out, x[:, -3:])
+    ref = onp.zeros((2, 3))
+    out = nd.slice_like(A(x), A(ref)).asnumpy()
+    onp.testing.assert_allclose(out, x[:2, :3])
+    out = nd.slice_like(A(x), A(ref), axes=(1,)).asnumpy()
+    onp.testing.assert_allclose(out, x[:, :3])
+
+
+def test_broadcast_axis_and_like():
+    x = onp.arange(3, dtype="f").reshape(1, 3, 1)
+    out = nd.broadcast_axis(A(x), axis=(0, 2), size=(2, 4)).asnumpy()
+    assert out.shape == (2, 3, 4)
+    onp.testing.assert_allclose(out, onp.broadcast_to(x, (2, 3, 4)))
+    like = onp.zeros((2, 3, 5), "f")
+    out = nd.broadcast_like(A(x), A(like)).asnumpy()
+    onp.testing.assert_allclose(out, onp.broadcast_to(x, (2, 3, 5)))
+
+
+def test_broadcast_binary_with_zero_size_dim():
+    """Zero-size dims broadcast like numpy (reference numpy-semantics
+    suites) — shape survives, no crash."""
+    a = onp.zeros((2, 0, 3), "f")
+    b = onp.ones((1, 1, 3), "f")
+    out = (mx.np.array(a) + mx.np.array(b)).asnumpy()
+    assert out.shape == (2, 0, 3)
+
+
+# ---------------------------------------------------------------------------
+# layout helpers (reference test_operator.py test_depthtospace etc.)
+# ---------------------------------------------------------------------------
+
+
+def test_depth_space_roundtrip():
+    rs = onp.random.RandomState(8)
+    x = rs.randn(2, 8, 3, 3).astype("f")
+    d = nd.depth_to_space(A(x), 2)
+    assert d.shape == (2, 2, 6, 6)
+    back = nd.space_to_depth(d, 2).asnumpy()
+    onp.testing.assert_allclose(back, x)
+
+
+def test_where_broadcast():
+    cond = onp.array([[1, 0], [0, 1]], "f")
+    a = onp.full((2, 2), 5.0, "f")
+    b = onp.zeros((2, 2), "f")
+    out = nd.where(A(cond), A(a), A(b)).asnumpy()
+    onp.testing.assert_allclose(out, onp.where(cond, a, b))
