@@ -1,0 +1,138 @@
+"""Actor-critic on CartPole (reference: example/gluon/actor_critic.py).
+
+The classic CartPole-v0 dynamics are implemented inline (the image has
+no gym and no network egress): state (x, x', θ, θ'), force ±10N, episode
+ends past ±12° / ±2.4m / 500 steps. One network with a shared body and
+two heads (policy logits, value); REINFORCE with the value baseline.
+Smoke: --episodes 40.
+"""
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+
+class CartPole:
+    """Euler-integrated cart-pole, constants per the classic control task."""
+
+    G, MC, MP, L, F, DT = 9.8, 1.0, 0.1, 0.5, 10.0, 0.02
+
+    def __init__(self, rs):
+        self.rs = rs
+
+    def reset(self):
+        self.s = self.rs.uniform(-0.05, 0.05, 4)
+        self.t = 0
+        return self.s.copy()
+
+    def step(self, action):
+        import math
+
+        x, xd, th, thd = self.s
+        f = self.F if action == 1 else -self.F
+        ct, st = math.cos(th), math.sin(th)
+        total = self.MC + self.MP
+        pm = self.MP * self.L
+        tmp = (f + pm * thd ** 2 * st) / total
+        thacc = (self.G * st - ct * tmp) / (
+            self.L * (4.0 / 3.0 - self.MP * ct ** 2 / total))
+        xacc = tmp - pm * thacc * ct / total
+        x, xd = x + self.DT * xd, xd + self.DT * xacc
+        th, thd = th + self.DT * thd, thd + self.DT * thacc
+        self.s = __import__("numpy").array([x, xd, th, thd])
+        self.t += 1
+        done = (abs(x) > 2.4 or abs(th) > 0.2095 or self.t >= 500)
+        return self.s.copy(), 1.0, done
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--episodes", type=int, default=300)
+    ap.add_argument("--gamma", type=float, default=0.99)
+    ap.add_argument("--lr", type=float, default=3e-2)
+    ap.add_argument("--cpu", action="store_true")
+    args = ap.parse_args()
+    if args.cpu:
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
+
+    import numpy as onp
+
+    import mxnet_tpu as mx
+    from mxnet_tpu import autograd, gluon, np
+
+    mx.seed(0)
+    rs = onp.random.RandomState(0)
+    env = CartPole(rs)
+
+    class Net(gluon.nn.HybridBlock):
+        def __init__(self):
+            super().__init__()
+            self.body = gluon.nn.Dense(128, activation="relu")
+            self.policy = gluon.nn.Dense(2)
+            self.value = gluon.nn.Dense(1)
+
+        def forward(self, x):
+            h = self.body(x)
+            return self.policy(h), self.value(h)
+
+    net = Net()
+    net.initialize()
+    trainer = gluon.Trainer(net.collect_params(), "adam",
+                            {"learning_rate": args.lr})
+
+    running, first_running = None, None
+    for ep in range(args.episodes):
+        states, actions, rewards = [], [], []
+        s = env.reset()
+        done = False
+        while not done:
+            logits, _ = net(np.array(s[None].astype("f")))
+            p = onp.asarray(mx.npx.softmax(logits).asnumpy())[0]
+            a = int(rs.choice(2, p=p / p.sum()))
+            states.append(s.astype("f"))
+            actions.append(a)
+            s, r, done = env.step(a)
+            rewards.append(r)
+
+        # discounted returns, normalized
+        R, rets = 0.0, []
+        for r in reversed(rewards):
+            R = r + args.gamma * R
+            rets.append(R)
+        rets = onp.asarray(rets[::-1], "f")
+        rets = (rets - rets.mean()) / (rets.std() + 1e-6)
+
+        xb = np.array(onp.stack(states))
+        ab = np.array(onp.asarray(actions))
+        rb = np.array(rets)
+        with autograd.record():
+            logits, values = net(xb)
+            logp = mx.npx.log_softmax(logits)
+            chosen = mx.npx.pick(logp, ab, axis=1)
+            adv = rb - values.reshape((-1,))
+            ploss = -(chosen * adv.detach()).sum()
+            vloss = (adv * adv).sum()
+            loss = ploss + 0.5 * vloss
+        loss.backward()
+        trainer.step(len(rewards))
+
+        ep_len = len(rewards)
+        running = ep_len if running is None else (
+            0.95 * running + 0.05 * ep_len)
+        if first_running is None:
+            first_running = running
+        if ep % 50 == 0 or ep == args.episodes - 1:
+            print(f"episode {ep}: length {ep_len} running {running:.1f}")
+
+    assert onp.isfinite(running)
+    print(f"final running length {running:.1f} (start {first_running:.1f})")
+    if args.episodes >= 200:
+        assert running > first_running + 10, "policy did not improve"
+    print("actor-critic example OK")
+
+
+if __name__ == "__main__":
+    main()

@@ -127,9 +127,12 @@ class Parameter:
         self._finish_init(init, devices, default_init)
 
     def _finish_init(self, init, devices, default_init):
-        initializer = init_mod.create(init) if init is not None else (
-            init_mod.create(self.init) if self.init is not None
-            else default_init)
+        # create() resolves registry-name strings and passes Initializer
+        # instances through, so one call covers every spec form
+        # (net.initialize(init="normal") included)
+        initializer = init_mod.create(
+            init if init is not None
+            else self.init if self.init is not None else default_init)
         master = initializer.init_array(self._name, self._shape, self.dtype)
         self._ctx_list = list(devices)
         self._data_map = {}

@@ -51,10 +51,6 @@ class _Ctx:
         return self.add_init(self.fresh(base),
                              _np.asarray(vals, _np.int64))
 
-    def const_f32(self, base, vals):
-        return self.add_init(self.fresh(base),
-                             _np.asarray(vals, _np.float32))
-
     # opset-sensitive emissions: opset 13 moved `axes`/`split` from
     # attributes to inputs for Squeeze/Unsqueeze/ReduceSum/Split
     # (reference keeps twin tables _op_translations_opset12/13.py;
@@ -740,22 +736,21 @@ def _topk(ctx, s, ins, outs, shapes):  # noqa: ARG001
     elif ret == "value":
         ctx.add_node("Identity", [vals], outs)
     elif ret == "mask":
-        # input-shaped 0/1 mask: ScatterElements of ones at the topk
-        # indices along `axis` into zeros shaped like the input
+        # input-shaped 0/1 mask (in the input's dtype, matching the
+        # native op): ScatterElements of ones at the topk indices along
+        # `axis` into zeros shaped like the input
+        dt = ctx.dtype_of(s._inputs[0])
         zeros = ctx.fresh(s.name + "_zeros")
         shape_of = ctx.fresh(s.name + "_shapeof")
         ctx.add_node("Shape", [ins[0]], [shape_of])
-        ctx.add_node("ConstantOfShape", [shape_of], [zeros], s.name + "_z")
-        # ones shaped like idx: Cast(idx)*0 + 1
-        idx_f = ctx.fresh(s.name + "_idxf")
-        ctx.add_node("Cast", [idx], [idx_f], attrs={"to": 1})
-        zero_f = ctx.fresh(s.name + "_zerof")
-        ctx.add_node("Mul", [idx_f, ctx.const_f32(s.name + "_c0", [0.0])],
-                     [zero_f])
-        ones_f = ctx.fresh(s.name + "_onesf")
-        ctx.add_node("Add", [zero_f, ctx.const_f32(s.name + "_c1", [1.0])],
-                     [ones_f])
-        ctx.add_node("ScatterElements", [zeros, idx, ones_f], outs,
+        ctx.add_node("ConstantOfShape", [shape_of], [zeros], s.name + "_z",
+                     {"value": _np.zeros(1, dt)})
+        ones = ctx.fresh(s.name + "_ones")
+        idx_shape = ctx.fresh(s.name + "_idxshape")
+        ctx.add_node("Shape", [idx], [idx_shape])
+        ctx.add_node("ConstantOfShape", [idx_shape], [ones], s.name + "_o",
+                     {"value": _np.ones(1, dt)})
+        ctx.add_node("ScatterElements", [zeros, idx, ones], outs,
                      s.name + "_scatter", {"axis": ax})
     else:
         ctx.add_node("Cast", [idx], outs, attrs={"to": 1})

@@ -18,6 +18,21 @@ def _run(script, *args, timeout=600):
     return r.stdout
 
 
+def test_dcgan_example():
+    out = _run("dcgan.py", "--iters", "20")
+    assert "DCGAN example OK" in out
+
+
+def test_bi_lstm_sort_example():
+    out = _run("bi_lstm_sort.py", "--steps", "60")
+    assert "bi-LSTM sort example OK" in out
+
+
+def test_actor_critic_example():
+    out = _run("actor_critic.py", "--episodes", "25")
+    assert "actor-critic example OK" in out
+
+
 def test_ssd_detection_example():
     out = _run("ssd_detection.py", "--steps", "6", "--batch", "4")
     assert "ssd train: loss" in out and "detections on image 0" in out
