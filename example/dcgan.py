@@ -24,7 +24,7 @@ def synthetic_blobs(rs, n, size=32):
     return (img * 2 - 1).astype("f")[:, None]  # NCHW in [-1, 1]
 
 
-def build_nets(gluon, nz):
+def build_nets(gluon):
     G = gluon.nn.HybridSequential()
     G.add(gluon.nn.Dense(128 * 4 * 4), gluon.nn.Activation("relu"),
           gluon.nn.HybridLambda(lambda x: x.reshape((-1, 128, 4, 4))),
@@ -64,7 +64,7 @@ def main():
 
     mx.seed(0)
     rs = onp.random.RandomState(0)
-    G, D = build_nets(gluon, args.nz)
+    G, D = build_nets(gluon)
     G.initialize(init="normal")
     D.initialize(init="normal")
     G.hybridize()

@@ -1,10 +1,15 @@
 """Recurrent layers (reference: python/mxnet/gluon/rnn/)."""
 from .rnn_cell import (  # noqa: F401
+    BidirectionalCell,
+    DropoutCell,
     GRUCell,
     HybridSequentialRNNCell,
     LSTMCell,
+    ModifierCell,
     RecurrentCell,
+    ResidualCell,
     RNNCell,
     SequentialRNNCell,
+    ZoneoutCell,
 )
 from .rnn_layer import GRU, LSTM, RNN  # noqa: F401

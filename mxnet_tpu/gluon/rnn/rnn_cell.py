@@ -8,7 +8,9 @@ from ..block import HybridBlock
 from ..parameter import Parameter
 
 __all__ = ["RecurrentCell", "RNNCell", "LSTMCell", "GRUCell",
-           "SequentialRNNCell", "HybridSequentialRNNCell"]
+           "SequentialRNNCell", "HybridSequentialRNNCell",
+           "DropoutCell", "ModifierCell", "ZoneoutCell", "ResidualCell",
+           "BidirectionalCell"]
 
 
 class RecurrentCell(HybridBlock):
@@ -16,6 +18,15 @@ class RecurrentCell(HybridBlock):
 
     def state_info(self, batch_size=0):
         raise NotImplementedError
+
+    def reset(self):
+        """Clear per-sequence step state, recursing into children
+        (reference: RecurrentCell.reset). Called at the start of every
+        unroll so e.g. ZoneoutCell's previous-output memory never leaks
+        across sequences."""
+        for child in self._children.values():
+            if isinstance(child, RecurrentCell):
+                child.reset()
 
     def begin_state(self, batch_size=0, func=None, **kwargs):  # noqa: ARG002
         return [mnp.zeros(info["shape"])
@@ -28,6 +39,7 @@ class RecurrentCell(HybridBlock):
         Under hybridize the whole unroll is traced into one XLA program —
         the compiler pipelines the steps (no python overhead at run time).
         """
+        self.reset()
         axis = 1 if layout == "NTC" else 0
         batch = inputs.shape[0 if layout == "NTC" else 1]
         states = begin_state if begin_state is not None \
@@ -200,3 +212,144 @@ class SequentialRNNCell(RecurrentCell):
 
 
 HybridSequentialRNNCell = SequentialRNNCell
+
+
+class DropoutCell(RecurrentCell):
+    """Applies dropout on input, passes states through (reference:
+    rnn_cell.py:838 DropoutCell). Active only under autograd.record."""
+
+    def __init__(self, rate, axes=()):
+        super().__init__()
+        self._rate = rate
+        self._axes = axes
+
+    def state_info(self, batch_size=0):  # noqa: ARG002
+        return []
+
+    def forward(self, inputs, states):
+        if self._rate > 0:
+            inputs = npx.dropout(inputs, p=self._rate,
+                                 axes=self._axes or None)
+        return inputs, states
+
+    def __repr__(self):
+        return f"DropoutCell(rate={self._rate}, axes={self._axes})"
+
+
+class ModifierCell(RecurrentCell):
+    """Base for cells wrapping another cell (reference: rnn_cell.py:893).
+
+    Parameters belong to the base cell; the modifier only changes the
+    step computation."""
+
+    def __init__(self, base_cell):
+        super().__init__()
+        self.base_cell = base_cell
+
+    def state_info(self, batch_size=0):
+        return self.base_cell.state_info(batch_size)
+
+    def begin_state(self, batch_size=0, func=None, **kwargs):
+        return self.base_cell.begin_state(batch_size, func, **kwargs)
+
+    def __repr__(self):
+        return f"{type(self).__name__}({self.base_cell!r})"
+
+
+class ZoneoutCell(ModifierCell):
+    """Zoneout (Krueger et al. 2016): each step keeps the previous
+    output/state elementwise with probability p (reference:
+    rnn_cell.py:935). Inactive outside autograd.record — the dropout
+    masks collapse to ones and the base cell passes through."""
+
+    def __init__(self, base_cell, zoneout_outputs=0.0, zoneout_states=0.0):
+        if isinstance(base_cell, BidirectionalCell):
+            raise ValueError(
+                "BidirectionalCell doesn't support zoneout — wrap the "
+                "cells underneath instead")
+        super().__init__(base_cell)
+        self.zoneout_outputs = zoneout_outputs
+        self.zoneout_states = zoneout_states
+        self._prev_output = None
+
+    def reset(self):
+        super().reset()  # recurse into base_cell (nested zoneouts)
+        self._prev_output = None
+
+    def forward(self, inputs, states):
+        p_out, p_st = self.zoneout_outputs, self.zoneout_states
+        next_output, next_states = self.base_cell(inputs, states)
+
+        def mask(p, like):
+            # nonzero where the NEW value is taken (reference formula)
+            return npx.dropout(mnp.ones(like.shape), p=p)
+
+        prev = self._prev_output
+        if prev is None:
+            prev = mnp.zeros(next_output.shape)
+        output = (mnp.where(mask(p_out, next_output), next_output, prev)
+                  if p_out != 0.0 else next_output)
+        next_states = ([mnp.where(mask(p_st, ns), ns, os)
+                        for ns, os in zip(next_states, states)]
+                       if p_st != 0.0 else next_states)
+        self._prev_output = output
+        return output, next_states
+
+
+class ResidualCell(ModifierCell):
+    """Output = base cell output + input (GNMT residual recipe,
+    reference: rnn_cell.py:984)."""
+
+    def forward(self, inputs, states):
+        output, states = self.base_cell(inputs, states)
+        return output + inputs, states
+
+
+class BidirectionalCell(RecurrentCell):
+    """Unroll a forward and a backward cell over the sequence and concat
+    their step outputs on the feature axis (reference: rnn_cell.py:1029).
+    Cannot be single-stepped — use unroll()."""
+
+    def __init__(self, l_cell, r_cell):
+        super().__init__()
+        self.l_cell = l_cell
+        self.r_cell = r_cell
+
+    def state_info(self, batch_size=0):
+        return (self.l_cell.state_info(batch_size)
+                + self.r_cell.state_info(batch_size))
+
+    def begin_state(self, batch_size=0, func=None, **kwargs):
+        return (self.l_cell.begin_state(batch_size, func, **kwargs)
+                + self.r_cell.begin_state(batch_size, func, **kwargs))
+
+    def forward(self, inputs, states):  # noqa: ARG002
+        raise NotImplementedError(
+            "Bidirectional cannot be stepped. Please use unroll")
+
+    def unroll(self, length, inputs, begin_state=None, layout="NTC",
+               merge_outputs=None, valid_length=None):
+        if valid_length is not None:
+            raise NotImplementedError(
+                "valid_length is not supported by BidirectionalCell yet")
+        self.reset()
+        axis = 1 if layout == "NTC" else 0
+        batch = inputs.shape[0 if layout == "NTC" else 1]
+        states = begin_state if begin_state is not None \
+            else self.begin_state(batch)
+        n_l = len(self.l_cell.state_info(batch))
+        rev = mnp.flip(inputs, axis=axis)
+        l_out, l_states = self.l_cell.unroll(
+            length, inputs, begin_state=states[:n_l], layout=layout)
+        r_out, r_states = self.r_cell.unroll(
+            length, rev, begin_state=states[n_l:], layout=layout)
+        out = mnp.concatenate([l_out, mnp.flip(r_out, axis=axis)], axis=-1)
+        if merge_outputs is False:
+            outs = [out[:, t] if axis == 1 else out[t]
+                    for t in range(length)]
+            return outs, l_states + r_states
+        return out, l_states + r_states
+
+    def __repr__(self):
+        return (f"BidirectionalCell(forward={self.l_cell!r}, "
+                f"backward={self.r_cell!r})")

@@ -1,0 +1,180 @@
+"""Modifier RNN cells (reference: gluon/rnn/rnn_cell.py:838-1100 —
+DropoutCell, ModifierCell, ZoneoutCell, ResidualCell, BidirectionalCell)."""
+import numpy as onp
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import autograd, gluon
+from mxnet_tpu import np as mnp
+
+rnn = gluon.rnn
+
+
+def _x(rs, shape):
+    return mnp.array(rs.randn(*shape).astype("f"))
+
+
+def test_dropout_cell_eval_identity_train_drops():
+    rs = onp.random.RandomState(0)
+    cell = rnn.DropoutCell(0.5)
+    x = _x(rs, (4, 8))
+    out, states = cell(x, [])
+    onp.testing.assert_allclose(out.asnumpy(), x.asnumpy())  # inference
+    assert states == []
+    mx.seed(3)
+    x2 = _x(rs, (64, 64))
+    x2.attach_grad()
+    with autograd.record():
+        out, _ = cell(x2, [])
+    o = out.asnumpy()
+    frac_zero = (o == 0).mean()
+    assert 0.3 < frac_zero < 0.7  # really dropping at train time
+    kept = o[o != 0]
+    onp.testing.assert_allclose(
+        kept, (x2.asnumpy() * 2.0)[o != 0], rtol=1e-5)  # inverted scaling
+
+
+def test_residual_cell_adds_input():
+    rs = onp.random.RandomState(1)
+    mx.seed(0)
+    base = rnn.RNNCell(8, input_size=8)
+    cell = rnn.ResidualCell(base)
+    cell.initialize()
+    x = _x(rs, (2, 8))
+    s = cell.begin_state(2)
+    out, _ = cell(x, s)
+    base_out, _ = base(x, base.begin_state(2))
+    onp.testing.assert_allclose(out.asnumpy(),
+                                base_out.asnumpy() + x.asnumpy(),
+                                rtol=1e-5)
+
+
+def test_zoneout_eval_passthrough_train_mixes():
+    rs = onp.random.RandomState(2)
+    mx.seed(0)
+    base = rnn.RNNCell(16, input_size=16)
+    cell = rnn.ZoneoutCell(base, zoneout_outputs=0.5)
+    cell.initialize()
+    x = _x(rs, (4, 16))
+    s = cell.begin_state(4)
+    out, _ = cell(x, s)
+    base_out, _ = base(x, base.begin_state(4))
+    onp.testing.assert_allclose(out.asnumpy(), base_out.asnumpy(),
+                                rtol=1e-5)  # inference: no zoneout
+
+    cell.reset()
+    x.attach_grad()
+    with autograd.record():
+        out1, st1 = cell(x, cell.begin_state(4))
+        out2, _ = cell(x, st1)
+    o1, o2 = out1.asnumpy(), out2.asnumpy()
+    # step 1: each element is base output or 0 (prev starts at zero)
+    b1, _ = base(x, base.begin_state(4))
+    b1 = b1.asnumpy()
+    is_new = onp.isclose(o1, b1, rtol=1e-4)
+    is_prev = o1 == 0.0
+    assert (is_new | is_prev).all()
+    assert is_new.any() and is_prev.any()
+    # step 2: prev is step-1's output
+    with autograd.record():
+        b2, _ = base(x, st1)
+    b2 = b2.asnumpy()
+    assert (onp.isclose(o2, b2, rtol=1e-4) | onp.isclose(o2, o1,
+                                                         rtol=1e-4)).all()
+
+
+def test_zoneout_rejects_bidirectional():
+    with pytest.raises(ValueError):
+        rnn.ZoneoutCell(rnn.BidirectionalCell(rnn.RNNCell(4),
+                                              rnn.RNNCell(4)))
+
+
+def test_bidirectional_cell_unroll_matches_manual():
+    rs = onp.random.RandomState(3)
+    mx.seed(0)
+    l_cell, r_cell = rnn.LSTMCell(8, input_size=4), rnn.LSTMCell(8, input_size=4)
+    bi = rnn.BidirectionalCell(l_cell, r_cell)
+    bi.initialize()
+    x = _x(rs, (2, 5, 4))  # NTC
+    out, states = bi.unroll(5, x)
+    assert out.shape == (2, 5, 16)
+    assert len(states) == 4  # l (h,c) + r (h,c)
+
+    l_out, _ = l_cell.unroll(5, x)
+    rev = mnp.flip(x, axis=1)
+    r_out, _ = r_cell.unroll(5, rev)
+    want = onp.concatenate(
+        [l_out.asnumpy(), r_out.asnumpy()[:, ::-1]], axis=-1)
+    onp.testing.assert_allclose(out.asnumpy(), want, rtol=1e-5)
+
+
+def test_bidirectional_cell_cannot_step():
+    bi = rnn.BidirectionalCell(rnn.RNNCell(4), rnn.RNNCell(4))
+    with pytest.raises(NotImplementedError):
+        bi(mnp.zeros((1, 4)), bi.begin_state(1))
+
+
+def test_zoneout_resets_between_unrolls():
+    """unroll() must clear zoneout's previous-output memory: a second
+    unroll with a DIFFERENT batch size used to broadcast-crash (and with
+    the same batch size, silently zoned the previous sequence's output
+    into the new one)."""
+    rs = onp.random.RandomState(5)
+    mx.seed(0)
+    cell = rnn.ZoneoutCell(rnn.RNNCell(8, input_size=8),
+                           zoneout_outputs=0.5)
+    cell.initialize()
+    x4 = _x(rs, (4, 3, 8))
+    x2 = _x(rs, (2, 3, 8))
+    x2.attach_grad()
+    with autograd.record():
+        cell.unroll(3, x4)
+        out, _ = cell.unroll(3, x2)  # used to raise broadcast ValueError
+    assert out.shape == (2, 3, 8)
+
+
+def test_container_reset_recurses():
+    """reset() exists on every cell and recurses through containers and
+    modifier chains (reference RecurrentCell.reset)."""
+    mx.seed(0)
+    inner = rnn.ZoneoutCell(rnn.LSTMCell(4, input_size=4),
+                            zoneout_outputs=0.3)
+    stack = rnn.SequentialRNNCell()
+    stack.add(inner)
+    stack.add(rnn.ResidualCell(rnn.ZoneoutCell(
+        rnn.LSTMCell(4, input_size=4), zoneout_outputs=0.3)))
+    stack.initialize()
+    x = _x(onp.random.RandomState(6), (2, 4))
+    with autograd.record():
+        _, st = stack(x, stack.begin_state(2))
+        stack(x, st)
+    assert inner._prev_output is not None
+    stack.reset()
+    assert inner._prev_output is None
+    nested = stack._children["1"].base_cell
+    assert nested._prev_output is None
+
+
+def test_modifier_stack_in_sequential_trains():
+    """Dropout + Zoneout + Residual stacked in a SequentialRNNCell:
+    gradient flows and the unroll trains a step."""
+    rs = onp.random.RandomState(4)
+    mx.seed(0)
+    stack = rnn.SequentialRNNCell()
+    stack.add(rnn.LSTMCell(12, input_size=12))
+    stack.add(rnn.ResidualCell(rnn.LSTMCell(12, input_size=12)))
+    stack.add(rnn.DropoutCell(0.3))
+    net = stack
+    net.initialize()
+    tr = gluon.Trainer(net.collect_params(), "sgd",
+                       {"learning_rate": 0.1})
+    x = _x(rs, (3, 6, 12))
+    y = mnp.array(rs.randn(3, 6, 12).astype("f"))
+    with autograd.record():
+        out, _ = net.unroll(6, x)
+        loss = ((out - y) ** 2).mean()
+    loss.backward()
+    tr.step(3)
+    g = net._children["0"].i2h_weight.grad()
+    assert onp.isfinite(g.asnumpy()).all()
+    assert (g.asnumpy() != 0).any()
