@@ -12,4 +12,15 @@ from .rnn_cell import (  # noqa: F401
     SequentialRNNCell,
     ZoneoutCell,
 )
+from .conv_rnn_cell import (  # noqa: F401
+    Conv1DGRUCell,
+    Conv1DLSTMCell,
+    Conv1DRNNCell,
+    Conv2DGRUCell,
+    Conv2DLSTMCell,
+    Conv2DRNNCell,
+    Conv3DGRUCell,
+    Conv3DLSTMCell,
+    Conv3DRNNCell,
+)
 from .rnn_layer import GRU, LSTM, RNN  # noqa: F401

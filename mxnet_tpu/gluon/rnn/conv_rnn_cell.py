@@ -1,0 +1,177 @@
+"""Convolutional RNN cells (reference: gluon/rnn/conv_rnn_cell.py).
+
+ConvRNN/ConvLSTM ("Convolutional LSTM Network", Xingjian et al.,
+NIPS 2015)/ConvGRU over 1/2/3 spatial dims: i2h and h2h are
+convolutions instead of dense maps, state keeps the spatial grid.
+h2h padding is derived (dilate·(k−1)/2, odd kernels only) so the
+hidden grid size is step-invariant.
+"""
+from __future__ import annotations
+
+from ... import numpy as mnp
+from ... import numpy_extension as npx
+from ..parameter import Parameter
+from .rnn_cell import RecurrentCell
+
+__all__ = ["Conv1DRNNCell", "Conv2DRNNCell", "Conv3DRNNCell",
+           "Conv1DLSTMCell", "Conv2DLSTMCell", "Conv3DLSTMCell",
+           "Conv1DGRUCell", "Conv2DGRUCell", "Conv3DGRUCell"]
+
+
+def _tup(v, n):
+    return (v,) * n if isinstance(v, int) else tuple(v)
+
+
+def _conv_out(sizes, kernel, pad, dilate):
+    return tuple((s + 2 * p - d * (k - 1) - 1) + 1
+                 for s, k, p, d in zip(sizes, kernel, pad, dilate))
+
+
+class _BaseConvRNNCell(RecurrentCell):
+    _gate_names = ("",)
+
+    def __init__(self, input_shape, hidden_channels, i2h_kernel, h2h_kernel,
+                 i2h_pad, i2h_dilate, h2h_dilate,
+                 i2h_weight_initializer, h2h_weight_initializer,
+                 i2h_bias_initializer, h2h_bias_initializer,
+                 dims, conv_layout, activation):
+        super().__init__()
+        self._hidden_channels = hidden_channels
+        self._input_shape = tuple(input_shape)
+        self._conv_layout = conv_layout
+        self._activation = activation
+        self._dims = dims
+        self._i2h_kernel = _tup(i2h_kernel, dims)
+        self._i2h_pad = _tup(i2h_pad, dims)
+        self._i2h_dilate = _tup(i2h_dilate, dims)
+        self._h2h_kernel = _tup(h2h_kernel, dims)
+        if any(k % 2 == 0 for k in self._h2h_kernel):
+            raise ValueError(
+                f"h2h_kernel must be odd so the state grid is "
+                f"step-invariant, got {h2h_kernel}")
+        self._h2h_dilate = _tup(h2h_dilate, dims)
+        self._h2h_pad = tuple(d * (k - 1) // 2 for d, k in
+                              zip(self._h2h_dilate, self._h2h_kernel))
+
+        self._channel_axis = conv_layout.find("C")
+        channels_last = self._channel_axis != 1
+        in_c = self._input_shape[-1 if channels_last else 0]
+        spatial = (self._input_shape[:-1] if channels_last
+                   else self._input_shape[1:])
+        out_spatial = _conv_out(spatial, self._i2h_kernel, self._i2h_pad,
+                                self._i2h_dilate)
+        total = hidden_channels * len(self._gate_names)
+        if channels_last:
+            i2h_shape = (total,) + self._i2h_kernel + (in_c,)
+            h2h_shape = (total,) + self._h2h_kernel + (hidden_channels,)
+            self._state_shape = out_spatial + (hidden_channels,)
+        else:
+            i2h_shape = (total, in_c) + self._i2h_kernel
+            h2h_shape = (total, hidden_channels) + self._h2h_kernel
+            self._state_shape = (hidden_channels,) + out_spatial
+
+        self.i2h_weight = Parameter("i2h_weight", shape=i2h_shape,
+                                    init=i2h_weight_initializer,
+                                    allow_deferred_init=True)
+        self.h2h_weight = Parameter("h2h_weight", shape=h2h_shape,
+                                    init=h2h_weight_initializer)
+        self.i2h_bias = Parameter("i2h_bias", shape=(total,),
+                                  init=i2h_bias_initializer)
+        self.h2h_bias = Parameter("h2h_bias", shape=(total,),
+                                  init=h2h_bias_initializer)
+
+    def _conv_forward(self, x, states):
+        i2h = npx.convolution(x, self.i2h_weight.data_for(x),
+                              self.i2h_bias.data_for(x),
+                              stride=(1,) * self._dims,
+                              pad=self._i2h_pad, dilate=self._i2h_dilate,
+                              layout=self._conv_layout)
+        h2h = npx.convolution(states[0], self.h2h_weight.data_for(x),
+                              self.h2h_bias.data_for(x),
+                              stride=(1,) * self._dims,
+                              pad=self._h2h_pad, dilate=self._h2h_dilate,
+                              layout=self._conv_layout)
+        return i2h, h2h
+
+    def _act(self, x):
+        return npx.activation(x, self._activation)
+
+    def _split_gates(self, x, n):
+        return mnp.split(x, n, axis=self._channel_axis)
+
+    def state_info(self, batch_size=0):
+        return [{"shape": (batch_size,) + self._state_shape,
+                 "__layout__": self._conv_layout}
+                for _ in range(self._num_states)]
+
+    def __repr__(self):
+        return (f"{type(self).__name__}({self._input_shape} -> "
+                f"{self._hidden_channels}, {self._conv_layout})")
+
+
+class _ConvRNNMixin:
+    _gate_names = ("",)
+    _num_states = 1
+
+    def forward(self, x, states):
+        i2h, h2h = self._conv_forward(x, states)
+        out = self._act(i2h + h2h)
+        return out, [out]
+
+
+class _ConvLSTMMixin:
+    _gate_names = ("_i", "_f", "_c", "_o")
+    _num_states = 2
+
+    def forward(self, x, states):
+        i2h, h2h = self._conv_forward(x, states)
+        gates = i2h + h2h
+        gi, gf, gc, go = self._split_gates(gates, 4)
+        i = npx.sigmoid(gi)
+        f = npx.sigmoid(gf)
+        o = npx.sigmoid(go)
+        c = f * states[1] + i * self._act(gc)
+        h = o * self._act(c)
+        return h, [h, c]
+
+
+class _ConvGRUMixin:
+    _gate_names = ("_r", "_z", "_o")
+    _num_states = 1
+
+    def forward(self, x, states):
+        i2h, h2h = self._conv_forward(x, states)
+        i2h_r, i2h_z, i2h_o = self._split_gates(i2h, 3)
+        h2h_r, h2h_z, h2h_o = self._split_gates(h2h, 3)
+        r = npx.sigmoid(i2h_r + h2h_r)
+        z = npx.sigmoid(i2h_z + h2h_z)
+        cand = self._act(i2h_o + r * h2h_o)
+        h = (1.0 - z) * cand + z * states[0]
+        return h, [h]
+
+
+def _make(name, mixin, dims, default_layout):
+    def __init__(self, input_shape, hidden_channels, i2h_kernel, h2h_kernel,
+                 i2h_pad=0, i2h_dilate=1, h2h_dilate=1,
+                 i2h_weight_initializer=None, h2h_weight_initializer=None,
+                 i2h_bias_initializer="zeros", h2h_bias_initializer="zeros",
+                 conv_layout=default_layout, activation="tanh"):
+        _BaseConvRNNCell.__init__(
+            self, input_shape, hidden_channels, i2h_kernel, h2h_kernel,
+            i2h_pad, i2h_dilate, h2h_dilate,
+            i2h_weight_initializer, h2h_weight_initializer,
+            i2h_bias_initializer, h2h_bias_initializer,
+            dims, conv_layout, activation)
+
+    return type(name, (mixin, _BaseConvRNNCell), {"__init__": __init__})
+
+
+Conv1DRNNCell = _make("Conv1DRNNCell", _ConvRNNMixin, 1, "NCW")
+Conv2DRNNCell = _make("Conv2DRNNCell", _ConvRNNMixin, 2, "NCHW")
+Conv3DRNNCell = _make("Conv3DRNNCell", _ConvRNNMixin, 3, "NCDHW")
+Conv1DLSTMCell = _make("Conv1DLSTMCell", _ConvLSTMMixin, 1, "NCW")
+Conv2DLSTMCell = _make("Conv2DLSTMCell", _ConvLSTMMixin, 2, "NCHW")
+Conv3DLSTMCell = _make("Conv3DLSTMCell", _ConvLSTMMixin, 3, "NCDHW")
+Conv1DGRUCell = _make("Conv1DGRUCell", _ConvGRUMixin, 1, "NCW")
+Conv2DGRUCell = _make("Conv2DGRUCell", _ConvGRUMixin, 2, "NCHW")
+Conv3DGRUCell = _make("Conv3DGRUCell", _ConvGRUMixin, 3, "NCDHW")

@@ -41,6 +41,11 @@ class RecurrentCell(HybridBlock):
         """
         self.reset()
         axis = 1 if layout == "NTC" else 0
+        if inputs.shape[axis] != length:
+            raise ValueError(
+                f"unroll length {length} != inputs time dim "
+                f"{inputs.shape[axis]} (reference _format_sequence "
+                "asserts the same)")
         batch = inputs.shape[0 if layout == "NTC" else 1]
         states = begin_state if begin_state is not None \
             else self.begin_state(batch)
@@ -210,6 +215,32 @@ class SequentialRNNCell(RecurrentCell):
             next_states.extend(new)
         return x, next_states
 
+    def unroll(self, length, inputs, begin_state=None, layout="NTC",
+               merge_outputs=None, valid_length=None):
+        """Cell-by-cell unroll (reference SequentialRNNCell.unroll): each
+        child consumes the previous child's full output sequence — so
+        un-steppable children (BidirectionalCell) work inside a stack."""
+        self.reset()
+        batch = inputs.shape[0 if layout == "NTC" else 1]
+        states = begin_state if begin_state is not None \
+            else self.begin_state(batch)
+        p = 0
+        next_states = []
+        for cell in self._children.values():
+            n = len(cell.state_info(batch))
+            inputs, new = cell.unroll(
+                length, inputs, begin_state=states[p : p + n],
+                layout=layout, merge_outputs=True,
+                valid_length=valid_length)
+            p += n
+            next_states.extend(new)
+        if merge_outputs is False:
+            axis = 1 if layout == "NTC" else 0
+            outs = [inputs[:, t] if axis == 1 else inputs[t]
+                    for t in range(length)]
+            return outs, next_states
+        return inputs, next_states
+
 
 HybridSequentialRNNCell = SequentialRNNCell
 
@@ -276,9 +307,23 @@ class ZoneoutCell(ModifierCell):
         super().reset()  # recurse into base_cell (nested zoneouts)
         self._prev_output = None
 
+    def hybridize(self, active=True, **kwargs):
+        # The previous-output memory is a Python attribute: caching this
+        # cell's OWN stepped program would freeze step-1's zeros branch
+        # and silently disable zoneout. Keep the zoneout step eager and
+        # let the base cell (a pure step) hybridize underneath.
+        self.base_cell.hybridize(active, **kwargs)
+        return self
+
     def forward(self, inputs, states):
-        p_out, p_st = self.zoneout_outputs, self.zoneout_states
+        from ... import autograd as ag
+
         next_output, next_states = self.base_cell(inputs, states)
+        p_out, p_st = self.zoneout_outputs, self.zoneout_states
+        if not ag.is_training():
+            # dropout masks are identity outside training — skip the
+            # ones/where work entirely on the inference hot path
+            return next_output, next_states
 
         def mask(p, like):
             # nonzero where the NEW value is taken (reference formula)
@@ -334,6 +379,11 @@ class BidirectionalCell(RecurrentCell):
                 "valid_length is not supported by BidirectionalCell yet")
         self.reset()
         axis = 1 if layout == "NTC" else 0
+        if inputs.shape[axis] != length:
+            raise ValueError(
+                f"unroll length {length} != inputs time dim "
+                f"{inputs.shape[axis]} — the flipped backward window "
+                "would silently misalign")
         batch = inputs.shape[0 if layout == "NTC" else 1]
         states = begin_state if begin_state is not None \
             else self.begin_state(batch)

@@ -652,13 +652,19 @@ def apply_op(fn, *args, name=None):
     datas = [args[i]._data for i in nd_pos]
 
     if len(nd_pos) == len(args):
-        pure = fn
+        base = fn
     else:
-        def pure(*xs):
+        def base(*xs):
             call = list(args)
             for i, x in zip(nd_pos, xs):
                 call[i] = x
             return fn(*call)
+
+    def pure(*xs):
+        r = base(*xs)
+        # normalize list outputs (e.g. jnp.split) to tuples so the tape's
+        # tuple cotangents match the vjp's recorded output pytree
+        return tuple(r) if isinstance(r, list) else r
 
     record = ag.taping_active() and any(
         args[i]._requires_grad_entry for i in nd_pos

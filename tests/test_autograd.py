@@ -262,3 +262,22 @@ def test_higher_order_through_nd_ops():
 
     expect = jax.grad(pen)(w.asnumpy())
     onp.testing.assert_allclose(w.grad.asnumpy(), expect, rtol=1e-4)
+
+
+def test_backward_through_list_output_op():
+    """Ops whose jax body returns a LIST (jnp.split) must backprop: the
+    tape replays tuple cotangents, so apply_op normalizes the primal
+    container (regression: ConvLSTM gate-split crashed jax.vjp)."""
+    import numpy as onp
+
+    from mxnet_tpu import np as mnp
+
+    x = mnp.array(onp.arange(12, dtype="f").reshape(2, 6))
+    x.attach_grad()
+    with autograd.record():
+        a, b, c = mnp.split(x, 3, axis=1)
+        loss = (a * 1.0).sum() + (b * 2.0).sum() + (c * 3.0).sum()
+    loss.backward()
+    want = onp.repeat(onp.array([[1.0, 2.0, 3.0]]), 2, 0)
+    want = onp.repeat(want, 2, 1).reshape(2, 6)
+    onp.testing.assert_allclose(x.grad.asnumpy(), want)

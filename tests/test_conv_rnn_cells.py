@@ -1,0 +1,110 @@
+"""Convolutional RNN cells (reference: gluon/rnn/conv_rnn_cell.py —
+ConvRNN / ConvLSTM (Xingjian et al. 2015) / ConvGRU over 1/2/3 dims)."""
+import numpy as onp
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import autograd, gluon
+from mxnet_tpu import np as mnp
+
+rnn = gluon.rnn
+rs = onp.random.RandomState(0)
+
+
+def _x(shape):
+    return mnp.array(rs.randn(*shape).astype("f"))
+
+
+@pytest.mark.parametrize("cls,dims,states", [
+    (rnn.Conv1DRNNCell, 1, 1), (rnn.Conv2DRNNCell, 2, 1),
+    (rnn.Conv3DRNNCell, 3, 1), (rnn.Conv1DLSTMCell, 1, 2),
+    (rnn.Conv2DLSTMCell, 2, 2), (rnn.Conv3DLSTMCell, 3, 2),
+    (rnn.Conv1DGRUCell, 1, 1), (rnn.Conv2DGRUCell, 2, 1),
+    (rnn.Conv3DGRUCell, 3, 1),
+])
+def test_conv_cell_shapes_and_step(cls, dims, states):
+    mx.seed(0)
+    spatial = (8,) * dims
+    cell = cls(input_shape=(4,) + spatial, hidden_channels=6,
+               i2h_kernel=3, h2h_kernel=3, i2h_pad=1)
+    cell.initialize()
+    x = _x((2, 4) + spatial)
+    s = cell.begin_state(2)
+    assert len(s) == states
+    out, new_s = cell(x, s)
+    assert out.shape == (2, 6) + spatial
+    for ns in new_s:
+        assert ns.shape == (2, 6) + spatial
+    # step again: state grid must be step-invariant (derived h2h pad)
+    out2, _ = cell(x, new_s)
+    assert out2.shape == out.shape
+
+
+def test_conv_rnn_matches_manual_formula():
+    """h_t = tanh(conv_i(x) + conv_h(h) + biases) — checked against an
+    explicit jax conv composition."""
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+
+    mx.seed(1)
+    cell = rnn.Conv2DRNNCell(input_shape=(3, 5, 5), hidden_channels=4,
+                             i2h_kernel=3, h2h_kernel=3, i2h_pad=1)
+    cell.initialize()
+    x = _x((2, 3, 5, 5))
+    h0 = _x((2, 4, 5, 5))
+    out, _ = cell(x, [h0])
+
+    wi = jnp.asarray(cell.i2h_weight.data().asnumpy())
+    wh = jnp.asarray(cell.h2h_weight.data().asnumpy())
+    bi = jnp.asarray(cell.i2h_bias.data().asnumpy())
+    bh = jnp.asarray(cell.h2h_bias.data().asnumpy())
+    dn = lax.conv_dimension_numbers((2, 3, 5, 5), wi.shape,
+                                    ("NCHW", "OIHW", "NCHW"))
+    i2h = lax.conv_general_dilated(jnp.asarray(x.asnumpy()), wi, (1, 1),
+                                   [(1, 1), (1, 1)], dimension_numbers=dn)
+    dn2 = lax.conv_dimension_numbers((2, 4, 5, 5), wh.shape,
+                                     ("NCHW", "OIHW", "NCHW"))
+    h2h = lax.conv_general_dilated(jnp.asarray(h0.asnumpy()), wh, (1, 1),
+                                   [(1, 1), (1, 1)], dimension_numbers=dn2)
+    want = jnp.tanh(i2h + bi[None, :, None, None]
+                    + h2h + bh[None, :, None, None])
+    onp.testing.assert_allclose(out.asnumpy(), want, rtol=1e-4, atol=1e-5)
+
+
+def test_conv_lstm_unroll_trains():
+    """ConvLSTM unrolls over a movie and a gradient step runs (the
+    precipitation-nowcasting use case, downsized)."""
+    mx.seed(2)
+    cell = rnn.Conv2DLSTMCell(input_shape=(2, 6, 6), hidden_channels=4,
+                              i2h_kernel=3, h2h_kernel=3, i2h_pad=1)
+    cell.initialize()
+    tr = gluon.Trainer(cell.collect_params(), "adam",
+                       {"learning_rate": 1e-2})
+    x = _x((3, 5, 2, 6, 6))  # NTC...: (B, T, C, H, W)
+    y = _x((3, 5, 4, 6, 6))
+    with autograd.record():
+        out, _ = cell.unroll(5, x)
+        loss = ((out - y) ** 2).mean()
+    loss.backward()
+    tr.step(3)
+    g = cell.i2h_weight.grad().asnumpy()
+    assert onp.isfinite(g).all() and (g != 0).any()
+
+
+def test_conv_cell_even_h2h_kernel_rejected():
+    with pytest.raises(ValueError):
+        rnn.Conv2DRNNCell(input_shape=(3, 5, 5), hidden_channels=4,
+                          i2h_kernel=3, h2h_kernel=2)
+
+
+def test_conv_cell_channels_last_layout():
+    mx.seed(3)
+    cell = rnn.Conv2DLSTMCell(input_shape=(5, 5, 3), hidden_channels=4,
+                              i2h_kernel=3, h2h_kernel=3, i2h_pad=1,
+                              conv_layout="NHWC")
+    cell.initialize()
+    x = _x((2, 5, 5, 3))
+    out, states = cell(x, cell.begin_state(2))
+    assert out.shape == (2, 5, 5, 4)
+    assert states[1].shape == (2, 5, 5, 4)

@@ -155,6 +155,54 @@ def test_container_reset_recurses():
     assert nested._prev_output is None
 
 
+def test_bidirectional_inside_sequential_stack():
+    """SequentialRNNCell.unroll goes cell-by-cell (reference semantics),
+    so an un-steppable BidirectionalCell works inside a stack."""
+    mx.seed(0)
+    stack = rnn.SequentialRNNCell()
+    stack.add(rnn.BidirectionalCell(rnn.LSTMCell(8, input_size=4),
+                                    rnn.LSTMCell(8, input_size=4)))
+    stack.add(rnn.LSTMCell(8, input_size=16))
+    stack.initialize()
+    x = _x(onp.random.RandomState(7), (2, 5, 4))
+    out, states = stack.unroll(5, x)
+    assert out.shape == (2, 5, 8)
+    assert len(states) == 6  # bi (2+2) + lstm (2)
+
+
+def test_unroll_length_mismatch_raises():
+    cell = rnn.RNNCell(4, input_size=4)
+    cell.initialize()
+    x = _x(onp.random.RandomState(8), (2, 10, 4))
+    with pytest.raises(ValueError):
+        cell.unroll(5, x)
+    bi = rnn.BidirectionalCell(rnn.RNNCell(4, input_size=4),
+                               rnn.RNNCell(4, input_size=4))
+    bi.initialize()
+    with pytest.raises(ValueError):
+        bi.unroll(5, x)
+
+
+def test_zoneout_hybridize_keeps_memory_semantics():
+    """hybridize() must not cache the zoneout step itself (Python-attr
+    previous-output memory); the base cell hybridizes underneath and
+    two training steps still chain prev correctly."""
+    rs2 = onp.random.RandomState(9)
+    mx.seed(0)
+    cell = rnn.ZoneoutCell(rnn.RNNCell(8, input_size=8),
+                           zoneout_outputs=0.5)
+    cell.initialize()
+    cell.hybridize()
+    x = _x(rs2, (4, 8))
+    with autograd.record():
+        o1, st = cell(x, cell.begin_state(4))
+        o2, _ = cell(x, st)
+    b2, _ = cell.base_cell(x, st)
+    o1, o2, b2 = o1.asnumpy(), o2.asnumpy(), b2.asnumpy()
+    ok = onp.isclose(o2, b2, rtol=1e-4) | onp.isclose(o2, o1, rtol=1e-4)
+    assert ok.all()  # step-2 prev is step-1's output, not stale zeros
+
+
 def test_modifier_stack_in_sequential_trains():
     """Dropout + Zoneout + Residual stacked in a SequentialRNNCell:
     gradient flows and the unroll trains a step."""
