@@ -72,17 +72,30 @@ class MultiHeadAttention(HybridBlock):
         k = qkv[:, :, 1].transpose((0, 2, 1, 3))
         v = qkv[:, :, 2].transpose((0, 2, 1, 3))
         drop_active = self.dropout._rate > 0 and _is_training()
-        if mask is None and not drop_active and _flash_enabled():
-            # (with attention-prob dropout active the reference path runs —
-            # the fused kernel has no dropout inside the softmax)
+        if mask is None and _flash_enabled():
             # fused Pallas path (ops/pallas_attention.py): O(S) memory,
-            # MXU-blocked QK^T/softmax/PV
+            # MXU-blocked QK^T/softmax/PV. Attention-prob dropout runs
+            # INSIDE the kernel (counter-hash mask, regenerated in the
+            # backward kernels), so training keeps the fast path.
+            from ... import _random
             from ...ndarray.ndarray import apply_op
             from ...ops.pallas_attention import flash_attention
 
-            ctxv = apply_op(
-                lambda q_, k_, v_: flash_attention(q_, k_, v_),
-                q, k, v, name="flash_attention")
+            if drop_active:
+                import jax
+                import jax.numpy as jnp
+
+                rate = self.dropout._rate
+                seed = jax.random.randint(_random.next_key(), (1,), 0,
+                                          2 ** 31 - 1, dtype=jnp.int32)
+                ctxv = apply_op(
+                    lambda q_, k_, v_: flash_attention(
+                        q_, k_, v_, dropout_p=rate, dropout_seed=seed),
+                    q, k, v, name="flash_attention_dropout")
+            else:
+                ctxv = apply_op(
+                    lambda q_, k_, v_: flash_attention(q_, k_, v_),
+                    q, k, v, name="flash_attention")
             ctxv = ctxv.transpose((0, 2, 1, 3)).reshape((b, s, h * d))
             return self.out_proj(ctxv)
         scores = np.einsum("bhqd,bhkd->bhqk", q, k) / math.sqrt(d)

@@ -322,7 +322,9 @@ class ZoneoutCell(ModifierCell):
         p_out, p_st = self.zoneout_outputs, self.zoneout_states
         if not ag.is_training():
             # dropout masks are identity outside training — skip the
-            # ones/where work entirely on the inference hot path
+            # ones/where work, but still record prev like the reference
+            # (a training step may continue this sequence)
+            self._prev_output = next_output
             return next_output, next_states
 
         def mask(p, like):

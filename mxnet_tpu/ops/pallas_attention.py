@@ -32,23 +32,60 @@ from .registry import register_op
 __all__ = ["flash_attention", "attention_reference"]
 
 
-def attention_reference(q, k, v, causal=False, scale=None):
+_H1 = 0x9E3779B1
+_H2 = 0x85EBCA6B
+_H3 = 0xC2B2AE35
+
+
+def _dropout_keep(seed, bh, q_pos, k_pos, dropout_p):
+    """Deterministic per-element keep mask: murmur3-finalizer counter
+    hash of (seed, batch·head, global q position, global k position).
+
+    Pure uint32 jnp arithmetic, so the SAME mask materializes inside
+    Pallas kernel tiles (fwd and both bwd passes), in interpret mode,
+    and on the full matrix of the jnp reference path — dropout is
+    exactly reproducible across all of them."""
+    h = (q_pos.astype(jnp.uint32) * jnp.uint32(_H1)
+         + k_pos.astype(jnp.uint32) * jnp.uint32(_H2)
+         + jnp.asarray(seed).astype(jnp.uint32)
+         + jnp.asarray(bh).astype(jnp.uint32) * jnp.uint32(_H3))
+    h = h ^ (h >> 16)
+    h = h * jnp.uint32(_H2)
+    h = h ^ (h >> 13)
+    h = h * jnp.uint32(_H3)
+    h = h ^ (h >> 16)
+    thresh = jnp.uint32(max(int((1.0 - dropout_p) * 4294967296.0) - 1, 0))
+    return h <= thresh
+
+
+def attention_reference(q, k, v, causal=False, scale=None,
+                        dropout_p=0.0, dropout_seed=None):
     """Plain jnp attention (the numeric oracle + off-TPU fallback).
-    q/k/v: (B, H, S, D)."""
-    d = q.shape[-1]
+    q/k/v: (B, H, S, D). dropout uses the same counter-hash mask as the
+    Pallas kernel, applied to the normalized probabilities (numerator
+    only, inverted scaling) — bit-identical semantics to the kernel."""
+    b, h, s, d = q.shape
     scale = scale if scale is not None else 1.0 / math.sqrt(d)
     scores = jnp.einsum("bhqd,bhkd->bhqk", q, k.astype(q.dtype)) * scale
     if causal:
-        s = q.shape[2]
         mask = jnp.tril(jnp.ones((s, s), bool))
         scores = jnp.where(mask, scores, -jnp.inf)
     p = jax.nn.softmax(scores.astype(jnp.float32), axis=-1)
+    if dropout_p > 0.0 and dropout_seed is None:
+        raise ValueError("dropout_p > 0 requires dropout_seed")
+    if dropout_p > 0.0:
+        bh = jnp.arange(b * h, dtype=jnp.int32).reshape(b, h, 1, 1)
+        q_pos = jnp.arange(s, dtype=jnp.int32).reshape(1, 1, s, 1)
+        k_pos = jnp.arange(s, dtype=jnp.int32).reshape(1, 1, 1, s)
+        keep = _dropout_keep(dropout_seed, bh, q_pos, k_pos, dropout_p)
+        p = jnp.where(keep, p, 0.0) / (1.0 - dropout_p)
     return jnp.einsum("bhqk,bhkd->bhqd", p.astype(q.dtype), v)
 
 
-def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_ref, l_ref,
-                acc_ref, *,
-                scale, causal, block_q, block_k, valid_len=None):
+def _fwd_kernel(seed_ref, q_ref, k_ref, v_ref, o_ref, lse_ref, m_ref,
+                l_ref, acc_ref, *,
+                scale, causal, block_q, block_k, valid_len=None,
+                dropout_p=0.0):
     import jax.experimental.pallas as pl
 
     kv_idx = pl.program_id(2)
@@ -90,8 +127,20 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_ref, l_ref,
     p = jnp.where(jnp.isfinite(m_new), p, 0.0)
     alpha = jnp.where(jnp.isfinite(m_prev), jnp.exp(m_prev - m_safe), 0.0)
     l_new = alpha * l_prev + jnp.sum(p, axis=-1, keepdims=True)
+    # dropout masks the numerator only (the softmax denominator l stays
+    # un-dropped): out = Σ M·p·v / (l·(1−p)) — FlashAttention dropout
+    p_v = p
+    if dropout_p > 0.0:
+        q_idx = pl.program_id(1)
+        q_pos = q_idx * block_q + jax.lax.broadcasted_iota(
+            jnp.int32, p.shape, 0)
+        k_pos = kv_idx * block_k + jax.lax.broadcasted_iota(
+            jnp.int32, p.shape, 1)
+        keep = _dropout_keep(seed_ref[0], pl.program_id(0), q_pos, k_pos,
+                             dropout_p)
+        p_v = jnp.where(keep, p, 0.0)
     acc = acc_ref[:] * alpha + jax.lax.dot_general(
-        p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
+        p_v.astype(v.dtype), v, (((1,), (0,)), ((), ())),
         preferred_element_type=jnp.float32)
     m_ref[:] = m_new
     l_ref[:] = l_new
@@ -100,7 +149,11 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_ref, l_ref,
     @pl.when(kv_idx == pl.num_programs(2) - 1)
     def _finish():
         denom = jnp.maximum(l_ref[:], 1e-30)
-        o_ref[0] = (acc_ref[:] / denom).astype(o_ref.dtype)
+        # lse records the TRUE softmax normalizer (backward recomputes
+        # p̂ from it); only the output division carries the inverted
+        # dropout scale
+        o_denom = denom * (1.0 - dropout_p) if dropout_p > 0.0 else denom
+        o_ref[0] = (acc_ref[:] / o_denom).astype(o_ref.dtype)
         # logsumexp per row: m + log l (-inf for fully-masked rows).
         # Stored as a (block_q, 1) column — the trailing singleton keeps
         # the block's last two dims (block_q, 1) legal for Mosaic tiling
@@ -110,8 +163,21 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_ref, l_ref,
                                m_ref[:] + jnp.log(denom), -jnp.inf)
 
 
+def _seed_arr(dropout_seed):
+    if dropout_seed is None:
+        return jnp.zeros((1,), jnp.int32)
+    return jnp.asarray(dropout_seed, jnp.int32).reshape((1,))
+
+
+def _smem_spec():
+    import jax.experimental.pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    return pl.BlockSpec(memory_space=pltpu.SMEM)
+
+
 def _flash_fwd(q, k, v, causal, scale, block_q, block_k, interpret,
-               valid_len=None):
+               valid_len=None, dropout_p=0.0, dropout_seed=None):
     import jax.experimental.pallas as pl
 
     b, h, s_len, d = q.shape
@@ -125,11 +191,12 @@ def _flash_fwd(q, k, v, causal, scale, block_q, block_k, interpret,
 
     kernel = functools.partial(
         _fwd_kernel, scale=scale, causal=causal, block_q=block_q,
-        block_k=block_k, valid_len=valid_len)
+        block_k=block_k, valid_len=valid_len, dropout_p=dropout_p)
     out, lse = pl.pallas_call(
         kernel,
         grid=grid,
         in_specs=[
+            _smem_spec(),
             pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
             pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, j, 0)),
             pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, j, 0)),
@@ -148,7 +215,7 @@ def _flash_fwd(q, k, v, causal, scale, block_q, block_k, interpret,
             _scratch((block_q, d)),   # output accumulator
         ],
         interpret=interpret,
-    )(qr, kr, vr)
+    )(_seed_arr(dropout_seed), qr, kr, vr)
     return out.reshape(b, h, s_len, d), lse[..., 0]
 
 
@@ -181,9 +248,17 @@ def _recompute_p(q, k, lse_col, scale, causal, q_idx, kv_idx, block_q,
     return jnp.where(jnp.isfinite(lse_col), jnp.exp(s - lse_col), 0.0)
 
 
-def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
-                   dq_ref, dq_acc, *, scale, causal, block_q, block_k,
-                   valid_len=None):
+def _tile_keep(seed_ref, bh, q_idx, kv_idx, block_q, block_k, shape,
+               dropout_p):
+    """Regenerate the forward pass's keep mask for one tile."""
+    q_pos = q_idx * block_q + jax.lax.broadcasted_iota(jnp.int32, shape, 0)
+    k_pos = kv_idx * block_k + jax.lax.broadcasted_iota(jnp.int32, shape, 1)
+    return _dropout_keep(seed_ref[0], bh, q_pos, k_pos, dropout_p)
+
+
+def _bwd_dq_kernel(seed_ref, q_ref, k_ref, v_ref, do_ref, lse_ref,
+                   delta_ref, dq_ref, dq_acc, *, scale, causal, block_q,
+                   block_k, valid_len=None, dropout_p=0.0):
     import jax.experimental.pallas as pl
 
     kv_idx = pl.program_id(2)
@@ -193,6 +268,8 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         dq_acc[:] = jnp.zeros_like(dq_acc)
 
     q_idx = pl.program_id(1)
+    bh_idx = pl.program_id(0)  # hoisted: program_id inside pl.when
+    # bodies breaks interpret mode
     # causal: tiles strictly above the diagonal are all-zero P — skip
     if causal:
         live = kv_idx * block_k <= q_idx * block_q + block_q - 1
@@ -209,6 +286,12 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         dp = jax.lax.dot_general(
             do_ref[0], v_ref[0], (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32)    # (bq, bk)
+        if dropout_p > 0.0:
+            # dP̂ = M/(1−p)·(dO V^T); delta already equals
+            # rowsum(P̂∘dP̂) because delta = rowsum(dO∘O)
+            keep = _tile_keep(seed_ref, bh_idx, q_idx, kv_idx,
+                              block_q, block_k, p.shape, dropout_p)
+            dp = jnp.where(keep, dp, 0.0) / (1.0 - dropout_p)
         ds = p * (dp - delta_ref[0]) * scale
         dq_acc[:] += jax.lax.dot_general(
             ds.astype(k_ref.dtype), k_ref[0], (((1,), (0,)), ((), ())),
@@ -219,9 +302,10 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         dq_ref[0] = dq_acc[:].astype(dq_ref.dtype)
 
 
-def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
-                    dk_ref, dv_ref, dk_acc, dv_acc, *, scale, causal,
-                    block_q, block_k, valid_len=None):
+def _bwd_dkv_kernel(seed_ref, q_ref, k_ref, v_ref, do_ref, lse_ref,
+                    delta_ref, dk_ref, dv_ref, dk_acc, dv_acc, *, scale,
+                    causal, block_q, block_k, valid_len=None,
+                    dropout_p=0.0):
     import jax.experimental.pallas as pl
 
     q_idx = pl.program_id(2)       # q blocks stream in the inner axis
@@ -232,6 +316,8 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         dv_acc[:] = jnp.zeros_like(dv_acc)
 
     kv_idx = pl.program_id(1)
+    bh_idx = pl.program_id(0)  # hoisted: program_id inside pl.when
+    # bodies breaks interpret mode
     if causal:
         # q tiles strictly above this k tile's diagonal see zero P
         live = kv_idx * block_k <= q_idx * block_q + block_q - 1
@@ -244,13 +330,22 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
     def _accum():
         p = _recompute_p(q_ref[0], k_ref[0], lse_ref[0], scale, causal,
                          q_idx, kv_idx, block_q, block_k, valid_len)
-        # dV += P^T dO
+        if dropout_p > 0.0:
+            keep = _tile_keep(seed_ref, bh_idx, q_idx, kv_idx,
+                              block_q, block_k, p.shape, dropout_p)
+            p_d = jnp.where(keep, p, 0.0) / (1.0 - dropout_p)
+        else:
+            keep = None
+            p_d = p
+        # dV += P_d^T dO (P_d = dropped+rescaled probs, what fwd used)
         dv_acc[:] += jax.lax.dot_general(
-            p.astype(do_ref.dtype), do_ref[0], (((0,), (0,)), ((), ())),
+            p_d.astype(do_ref.dtype), do_ref[0], (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
         dp = jax.lax.dot_general(
             do_ref[0], v_ref[0], (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32)
+        if dropout_p > 0.0:
+            dp = jnp.where(keep, dp, 0.0) / (1.0 - dropout_p)
         ds = p * (dp - delta_ref[0]) * scale
         # dK += dS^T Q
         dk_acc[:] += jax.lax.dot_general(
@@ -264,9 +359,11 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
 
 
 def _flash_bwd(q, k, v, out, lse, g, causal, scale, block_q, block_k,
-               interpret, valid_len=None):
+               interpret, valid_len=None, dropout_p=0.0,
+               dropout_seed=None):
     """Block-streamed FlashAttention-2 backward: O(S) memory, no (S, S)
-    residual — P tiles are recomputed from (q, k, lse) per block."""
+    residual — P tiles are recomputed from (q, k, lse) per block (and
+    the dropout keep mask from its counter hash)."""
     import jax.experimental.pallas as pl
 
     b, h, s_len, d = q.shape
@@ -278,19 +375,22 @@ def _flash_bwd(q, k, v, out, lse, g, causal, scale, block_q, block_k,
     vr = v.reshape(bh, s_len, d)
     do = g.reshape(bh, s_len, d)
     orr = out.reshape(bh, s_len, d)
-    # delta = rowsum(dO * O) — the softmax-grad correction term.
+    # delta = rowsum(dO * O) — the softmax-grad correction term (with
+    # dropout it still equals rowsum(P̂∘dP̂) since O = P_d V).
     # lse/delta ride as (bh, s_len, 1) columns so their (block_q, 1)
     # blocks satisfy Mosaic's last-two-dims tiling rule.
     delta = jnp.sum(do.astype(jnp.float32) * orr.astype(jnp.float32),
                     axis=-1)[..., None]             # (bh, s_len, 1)
     lse = lse[..., None]                            # (bh, s_len, 1)
+    seed = _seed_arr(dropout_seed)
 
     dq = pl.pallas_call(
         functools.partial(_bwd_dq_kernel, scale=scale, causal=causal,
                           block_q=block_q, block_k=block_k,
-                          valid_len=valid_len),
+                          valid_len=valid_len, dropout_p=dropout_p),
         grid=(bh, s_len // block_q, s_len // block_k),
         in_specs=[
+            _smem_spec(),
             pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
             pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, j, 0)),
             pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, j, 0)),
@@ -302,14 +402,15 @@ def _flash_bwd(q, k, v, out, lse, g, causal, scale, block_q, block_k,
         out_shape=jax.ShapeDtypeStruct((bh, s_len, d), q.dtype),
         scratch_shapes=[_scratch((block_q, d))],
         interpret=interpret,
-    )(qr, kr, vr, do, lse, delta)
+    )(seed, qr, kr, vr, do, lse, delta)
 
     dk, dv = pl.pallas_call(
         functools.partial(_bwd_dkv_kernel, scale=scale, causal=causal,
                           block_q=block_q, block_k=block_k,
-                          valid_len=valid_len),
+                          valid_len=valid_len, dropout_p=dropout_p),
         grid=(bh, s_len // block_k, s_len // block_q),
         in_specs=[
+            _smem_spec(),
             pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, j, 0)),
             pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, i, 0)),
             pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, i, 0)),
@@ -327,31 +428,36 @@ def _flash_bwd(q, k, v, out, lse, g, causal, scale, block_q, block_k,
         ],
         scratch_shapes=[_scratch((block_k, d)), _scratch((block_k, d))],
         interpret=interpret,
-    )(qr, kr, vr, do, lse, delta)
+    )(seed, qr, kr, vr, do, lse, delta)
     shape = (b, h, s_len, d)
     return dq.reshape(shape), dk.reshape(shape), dv.reshape(shape)
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7, 8))
-def _flash(q, k, v, causal, scale, block_q, block_k, interpret,
-           valid_len=None):
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7, 8, 9, 10))
+def _flash(q, k, v, seed, causal, scale, block_q, block_k, interpret,
+           dropout_p=0.0, valid_len=None):
     out, _ = _flash_fwd(q, k, v, causal, scale, block_q, block_k,
-                        interpret, valid_len)
+                        interpret, valid_len, dropout_p, seed)
     return out
 
 
-def _flash_vjp_fwd(q, k, v, causal, scale, block_q, block_k, interpret,
-                   valid_len=None):
+def _flash_vjp_fwd(q, k, v, seed, causal, scale, block_q, block_k,
+                   interpret, dropout_p=0.0, valid_len=None):
     out, lse = _flash_fwd(q, k, v, causal, scale, block_q, block_k,
-                          interpret, valid_len)
-    return out, (q, k, v, out, lse)
+                          interpret, valid_len, dropout_p, seed)
+    return out, (q, k, v, seed, out, lse)
 
 
-def _flash_vjp_bwd(causal, scale, block_q, block_k, interpret, valid_len,
-                   res, g):
-    q, k, v, out, lse = res
-    return _flash_bwd(q, k, v, out, lse, g, causal, scale, block_q,
-                      block_k, interpret, valid_len)
+def _flash_vjp_bwd(causal, scale, block_q, block_k, interpret, dropout_p,
+                   valid_len, res, g):
+    import numpy as _onp
+
+    q, k, v, seed, out, lse = res
+    dq, dk, dv = _flash_bwd(q, k, v, out, lse, g, causal, scale, block_q,
+                            block_k, interpret, valid_len, dropout_p,
+                            seed)
+    # integer seed takes a float0 cotangent
+    return dq, dk, dv, _onp.zeros(seed.shape, jax.dtypes.float0)
 
 
 _flash.defvjp(_flash_vjp_fwd, _flash_vjp_bwd)
@@ -359,36 +465,53 @@ _flash.defvjp(_flash_vjp_fwd, _flash_vjp_bwd)
 
 @register_op("flash_attention")
 def flash_attention(q, k, v, causal=False, scale=None, block_q=128,
-                    block_k=128, interpret=None):
+                    block_k=128, interpret=None, dropout_p=0.0,
+                    dropout_seed=None):
     """Fused multi-head attention: softmax(QK^T * scale) V.
 
     q/k/v: (B, H, S, D). Runs the Pallas kernel on TPU (or anywhere with
     interpret=True); falls back to the jnp reference otherwise. Ragged S
     is tile-padded and the kernel masks the padded keys (static
     `valid_len`) — only a ragged head dim D takes the reference path.
+
+    dropout_p > 0 with an int32 `dropout_seed` applies attention-prob
+    dropout inside the kernel (numerator-masked, inverted scaling; the
+    counter-hash mask regenerates identically in the backward kernels
+    and the reference path — see _dropout_keep).
     """
     d = q.shape[-1]
     scale = scale if scale is not None else 1.0 / math.sqrt(d)
+    if dropout_p > 0.0 and dropout_seed is None:
+        raise ValueError("dropout_p > 0 requires dropout_seed")
+    dropout_p = float(dropout_p)
+
+    def _fallback(qq, kk, vv):
+        return attention_reference(qq, kk, vv, causal=causal, scale=scale,
+                                   dropout_p=dropout_p,
+                                   dropout_seed=dropout_seed)
+
     if interpret is None:
         interpret = False
         platform = jax.devices()[0].platform
         if platform not in ("tpu", "axon"):
-            return attention_reference(q, k, v, causal=causal, scale=scale)
+            return _fallback(q, k, v)
     if d % 8:
         # ragged head dim: blocks can't stay lane-aligned
-        return attention_reference(q, k, v, causal=causal, scale=scale)
+        return _fallback(q, k, v)
     s_len = q.shape[2]
     s_pad = _tile_pad_len(s_len, block_q)
     bq = min(block_q, s_pad)
     bk = min(block_k, s_pad)
     if s_pad % bq or s_pad % bk or bq % 8 or bk % 8:
         # non-dividing custom block sizes: reference path
-        return attention_reference(q, k, v, causal=causal, scale=scale)
+        return _fallback(q, k, v)
+    seed = _seed_arr(dropout_seed)
     if s_pad == s_len:
-        return _flash(q, k, v, causal, scale, bq, bk, interpret)
+        return _flash(q, k, v, seed, causal, scale, bq, bk, interpret,
+                      dropout_p)
     pad = [(0, 0), (0, 0), (0, s_pad - s_len), (0, 0)]
     out = _flash(jnp.pad(q, pad), jnp.pad(k, pad), jnp.pad(v, pad),
-                 causal, scale, bq, bk, interpret, s_len)
+                 seed, causal, scale, bq, bk, interpret, dropout_p, s_len)
     return out[:, :, :s_len]
 
 

@@ -115,10 +115,10 @@ class TestBertIntegration:
         onp.testing.assert_allclose(outs["1"], outs["0"], rtol=1e-4,
                                     atol=1e-5)
 
-    def test_flash_skipped_when_attention_dropout_active(self, monkeypatch):
-        """With attention-prob dropout active in training, the reference
-        path (which applies dropout) must run — toggling the flag cannot
-        change regularization."""
+    def test_attention_dropout_still_random_per_call(self, monkeypatch):
+        """With attention-prob dropout active in training, the flash path
+        applies dropout IN-KERNEL with a fresh seed per call — two
+        training calls must still differ (regularization preserved)."""
         from mxnet_tpu import autograd
         from mxnet_tpu.gluon.model_zoo.bert import MultiHeadAttention
 
@@ -182,3 +182,105 @@ def test_flash_forward_emits_lse():
     ref_lse = jax.scipy.special.logsumexp(scores, axis=-1).reshape(-1, S)
     onp.testing.assert_allclose(onp.asarray(lse), onp.asarray(ref_lse),
                                 rtol=1e-5, atol=1e-5)
+
+
+class TestFlashDropout:
+    """In-kernel attention-prob dropout: the counter-hash keep mask
+    (_dropout_keep) regenerates identically in the fwd kernel, both bwd
+    kernels, and the jnp reference path — so kernel vs reference is an
+    EXACT comparison, not a statistical one."""
+
+    def _qkv(self, S=256, D=64):
+        import jax.numpy as jnp
+
+        rs = onp.random.RandomState(0)
+        q = jnp.asarray(rs.randn(2, 3, S, D).astype("f")) * 0.3
+        k = jnp.asarray(rs.randn(2, 3, S, D).astype("f")) * 0.3
+        v = jnp.asarray(rs.randn(2, 3, S, D).astype("f"))
+        return q, k, v
+
+    def test_kernel_matches_reference_same_seed(self):
+        import jax.numpy as jnp
+
+        from mxnet_tpu.ops import pallas_attention as fa
+
+        q, k, v = self._qkv()
+        o_k = fa.flash_attention(q, k, v, interpret=True, dropout_p=0.1,
+                                 dropout_seed=1234)
+        o_r = fa.attention_reference(q, k, v, dropout_p=0.1,
+                                     dropout_seed=1234)
+        onp.testing.assert_allclose(onp.asarray(o_k), onp.asarray(o_r),
+                                    rtol=1e-5, atol=2e-5)
+        # and it actually regularizes (differs from the p=0 output)
+        o_p0 = fa.attention_reference(q, k, v)
+        assert float(jnp.abs(o_k - o_p0).max()) > 1e-3
+
+    def test_causal_dropout(self):
+        from mxnet_tpu.ops import pallas_attention as fa
+
+        q, k, v = self._qkv()
+        o_k = fa.flash_attention(q, k, v, causal=True, interpret=True,
+                                 dropout_p=0.2, dropout_seed=7)
+        o_r = fa.attention_reference(q, k, v, causal=True, dropout_p=0.2,
+                                     dropout_seed=7)
+        onp.testing.assert_allclose(onp.asarray(o_k), onp.asarray(o_r),
+                                    rtol=1e-5, atol=2e-5)
+
+    def test_ragged_dropout(self):
+        from mxnet_tpu.ops import pallas_attention as fa
+
+        q, k, v = self._qkv(S=200)
+        o_k = fa.flash_attention(q, k, v, interpret=True, dropout_p=0.1,
+                                 dropout_seed=5)
+        o_r = fa.attention_reference(q, k, v, dropout_p=0.1,
+                                     dropout_seed=5)
+        onp.testing.assert_allclose(onp.asarray(o_k), onp.asarray(o_r),
+                                    rtol=1e-5, atol=2e-5)
+
+    def test_dropout_grads_match_reference_autodiff(self):
+        """The hand bwd kernels must equal jax autodiff of the identical
+        reference function (same mask): exact gradient check, all three
+        inputs."""
+        import jax.numpy as jnp
+
+        from mxnet_tpu.ops import pallas_attention as fa
+
+        q, k, v = self._qkv()
+        w = jnp.sin(jnp.arange(q.shape[-1]))
+
+        def f_kernel(q, k, v):
+            return (fa.flash_attention(q, k, v, interpret=True,
+                                       dropout_p=0.15, dropout_seed=99)
+                    * w).sum()
+
+        def f_ref(q, k, v):
+            return (fa.attention_reference(q, k, v, dropout_p=0.15,
+                                           dropout_seed=99) * w).sum()
+
+        g1 = jax.grad(f_kernel, (0, 1, 2))(q, k, v)
+        g2 = jax.grad(f_ref, (0, 1, 2))(q, k, v)
+        for u, w2 in zip(g1, g2):
+            onp.testing.assert_allclose(onp.asarray(u), onp.asarray(w2),
+                                        rtol=1e-3, atol=1e-5)
+
+    def test_keep_rate_statistics(self):
+        """The hash mask drops ~p of the elements."""
+        import jax.numpy as jnp
+
+        from mxnet_tpu.ops.pallas_attention import _dropout_keep
+
+        q_pos = jnp.arange(512, dtype=jnp.int32).reshape(-1, 1)
+        k_pos = jnp.arange(512, dtype=jnp.int32).reshape(1, -1)
+        for p in (0.1, 0.5):
+            keep = _dropout_keep(42, 3, q_pos, k_pos, p)
+            rate = float(jnp.mean(keep.astype(jnp.float32)))
+            assert abs(rate - (1.0 - p)) < 0.01, (p, rate)
+
+    def test_seed_requirement(self):
+        import pytest
+
+        from mxnet_tpu.ops import pallas_attention as fa
+
+        q, k, v = self._qkv(S=32, D=8)
+        with pytest.raises(ValueError):
+            fa.flash_attention(q, k, v, dropout_p=0.1)
