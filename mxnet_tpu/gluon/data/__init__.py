@@ -9,6 +9,8 @@ from .dataset import (  # noqa: F401
 )
 from .sampler import (  # noqa: F401
     BatchSampler,
+    FilterSampler,
+    IntervalSampler,
     RandomSampler,
     Sampler,
     SequentialSampler,

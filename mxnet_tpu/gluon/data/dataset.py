@@ -26,6 +26,15 @@ class Dataset:
     def take(self, count):
         return _SubsetDataset(self, list(range(min(count, len(self)))))
 
+    def sample(self, sampler):
+        """Dataset reordered/subset by a Sampler's indices (reference:
+        Dataset.sample, dataset.py:120)."""
+        from .sampler import Sampler
+
+        if not isinstance(sampler, Sampler):
+            raise TypeError(f"expected Sampler, got {type(sampler)}")
+        return _SubsetDataset(self, list(sampler))
+
     def transform(self, fn, lazy=True):
         trans = _LazyTransformDataset(self, fn)
         if lazy:
@@ -115,12 +124,22 @@ class RecordFileDataset(Dataset):
     dmlc RecordIO; here over mxnet_tpu.recordio.RecordFile)."""
 
     def __init__(self, filename):
+        import os
+
         from ...recordio import IndexedRecordIO
 
+        idx_path = os.path.splitext(filename)[0] + ".idx"
+        if not os.path.exists(idx_path):
+            # a missing sidecar would otherwise read as an EMPTY dataset
+            raise FileNotFoundError(
+                f"RecordFileDataset requires the index sidecar "
+                f"{idx_path!r} (build it with tools/im2rec.py)")
         self._record = IndexedRecordIO(filename)
 
     def __getitem__(self, idx):
-        return self._record.read_idx(idx)
+        # positional indexing: record KEYS need not be 0-based (im2rec
+        # keeps .lst keys), so map position -> key like the reference
+        return self._record.read_idx(self._record.keys[idx])
 
     def __len__(self):
         return len(self._record)

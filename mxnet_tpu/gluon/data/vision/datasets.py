@@ -13,9 +13,10 @@ import struct
 
 import numpy as _np
 
-from ..dataset import Dataset
+from ..dataset import Dataset, RecordFileDataset
 
 __all__ = ["MNIST", "FashionMNIST", "CIFAR10", "CIFAR100",
+           "ImageRecordDataset", "ImageListDataset",
            "ImageFolderDataset"]
 
 
@@ -148,10 +149,28 @@ class CIFAR100(CIFAR10):
         super().__init__(root, train, transform, synthetic)
 
 
+def _load_image(path, flag):
+    """One loader for every file-backed image dataset, matching
+    image.imdecode's channel semantics: flag=1 → (H, W, 3) RGB via PIL
+    convert('RGB'); flag=0 → (H, W, 1) via convert('L') (ITU-R
+    luminosity, NOT a channel mean). .npy files load as stored."""
+    if path.endswith(".npy"):
+        return _np.load(path)
+    try:
+        from PIL import Image
+    except ImportError as e:
+        raise RuntimeError(
+            "image decoding requires pillow; use .npy files") from e
+    img = Image.open(path)
+    if flag == 0:
+        return _np.asarray(img.convert("L"))[..., None]
+    return _np.asarray(img.convert("RGB"))
+
+
 class ImageFolderDataset(Dataset):
     """Folder-per-class image dataset (reference: ImageFolderDataset).
 
-    Requires pillow or imageio for decoding; .npy files load natively.
+    Requires pillow for decoding; .npy files load natively.
     """
 
     def __init__(self, root, flag=1, transform=None):
@@ -172,18 +191,7 @@ class ImageFolderDataset(Dataset):
                     self.items.append((os.path.join(path, fname), label))
 
     def _load(self, path):
-        if path.endswith(".npy"):
-            return _np.load(path)
-        try:
-            from PIL import Image
-
-            img = _np.asarray(Image.open(path))
-            if self._flag == 0 and img.ndim == 3:
-                img = img.mean(axis=-1, keepdims=True).astype(_np.uint8)
-            return img
-        except ImportError as e:
-            raise RuntimeError(
-                "image decoding requires pillow; use .npy files") from e
+        return _load_image(path, self._flag)
 
     def __getitem__(self, idx):
         path, label = self.items[idx]
@@ -191,6 +199,67 @@ class ImageFolderDataset(Dataset):
         if self._transform is not None:
             return self._transform(img, label)
         return img, label
+
+    def __len__(self):
+        return len(self.items)
+
+
+class ImageRecordDataset(RecordFileDataset):
+    """Images + labels from a RecordIO file (reference:
+    vision/datasets.py:238 ImageRecordDataset — each record is a packed
+    (header, encoded image))."""
+
+    def __init__(self, filename, flag=1, transform=None):
+        super().__init__(filename)
+        self._flag = flag
+        self._transform = transform
+
+    def __getitem__(self, idx):
+        from ....image import image as _image
+        from ....recordio import unpack
+
+        record = super().__getitem__(idx)
+        header, img = unpack(record)
+        data = _image.imdecode(img, self._flag)
+        label = header.label
+        if self._transform is not None:
+            return self._transform(data, label)
+        return data, label
+
+
+class ImageListDataset(Dataset):
+    """Images given by a .lst file or an in-memory list (reference:
+    vision/datasets.py:365 ImageListDataset; .lst format matches
+    tools/im2rec.py: idx\\tlabel...\\tpath)."""
+
+    def __init__(self, root=".", imglist=None, flag=1):
+        self._root = os.path.expanduser(root)
+        self._flag = flag
+        self.items = []
+        if isinstance(imglist, str):
+            with open(os.path.join(self._root, imglist)) as fin:
+                for line in fin:
+                    parts = line.strip().split("\t")
+                    if not parts or parts[0] == "":
+                        continue
+                    label = _np.asarray([float(v) for v in parts[1:-1]])
+                    self.items.append(
+                        (os.path.join(self._root, parts[-1]), label))
+        elif imglist is not None:
+            for entry in imglist:
+                label, path = entry[0], entry[-1]
+                label = _np.asarray(label, dtype=_np.float64).reshape(-1)
+                self.items.append((os.path.join(self._root, path), label))
+        else:
+            raise ValueError("imglist (file name or list) is required")
+
+    def _load(self, path):
+        return _load_image(path, self._flag)
+
+    def __getitem__(self, idx):
+        path, label = self.items[idx]
+        label = label[0] if label.size == 1 else label
+        return self._load(path), label
 
     def __len__(self):
         return len(self.items)

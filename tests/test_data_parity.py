@@ -1,0 +1,132 @@
+"""gluon.data parity additions (reference: data/sampler.py,
+data/dataset.py:120 sample, vision/datasets.py ImageRecord/ImageList)."""
+import os
+
+import numpy as onp
+
+import mxnet_tpu as mx
+from mxnet_tpu import gluon
+from mxnet_tpu.gluon import data
+
+
+def test_interval_sampler_reference_examples():
+    """The docstring examples from the reference (sampler.py:165)."""
+    assert list(data.IntervalSampler(13, interval=3)) == \
+        [0, 3, 6, 9, 12, 1, 4, 7, 10, 2, 5, 8, 11]
+    assert list(data.IntervalSampler(13, interval=3, rollover=False)) == \
+        [0, 3, 6, 9, 12]
+
+
+def test_filter_sampler():
+    ds = data.SimpleDataset(list(range(10)))
+    fs = data.FilterSampler(lambda s: s % 2 == 0, ds)
+    assert list(fs) == [0, 2, 4, 6, 8]
+    assert len(fs) == 5
+
+
+def test_dataset_sample():
+    ds = data.SimpleDataset([10 * i for i in range(8)])
+    sub = ds.sample(data.IntervalSampler(8, 4))
+    assert [sub[i] for i in range(len(sub))] == [0, 40, 10, 50, 20, 60,
+                                                30, 70]
+    import pytest
+    with pytest.raises(TypeError):
+        ds.sample([0, 1, 2])
+
+
+def test_image_record_dataset_roundtrip(tmp_path):
+    """Pack images into a .rec via recordio, read them back as
+    (image, label) samples."""
+    from mxnet_tpu import recordio
+
+    rec_path = str(tmp_path / "data.rec")
+    idx_path = str(tmp_path / "data.idx")
+    rec = recordio.IndexedRecordIO(idx_path, rec_path, "w")
+    rs = onp.random.RandomState(0)
+    imgs = []
+    for i in range(4):
+        img = rs.randint(0, 255, (8, 8, 3)).astype(onp.uint8)
+        imgs.append(img)
+        header = recordio.IRHeader(0, float(i), i, 0)
+        rec.write_idx(i, recordio.pack_img(header, img, img_fmt=".png"))
+    rec.close()
+
+    ds = gluon.data.vision.ImageRecordDataset(rec_path)
+    assert len(ds) == 4
+    img, label = ds[2]
+    assert int(label) == 2
+    got = onp.asarray(img.asnumpy() if hasattr(img, "asnumpy") else img)
+    assert got.shape == (8, 8, 3)
+    onp.testing.assert_allclose(got, imgs[2])
+
+
+def test_image_list_dataset(tmp_path):
+    rs = onp.random.RandomState(1)
+    paths = []
+    for i in range(3):
+        p = tmp_path / f"img{i}.npy"
+        onp.save(p, rs.randint(0, 255, (4, 4, 3)).astype(onp.uint8))
+        paths.append(p.name)
+    lst = tmp_path / "data.lst"
+    lst.write_text("".join(f"{i}\t{float(i)}\t{p}\n"
+                           for i, p in enumerate(paths)))
+    ds = gluon.data.vision.ImageListDataset(root=str(tmp_path),
+                                            imglist="data.lst")
+    assert len(ds) == 3
+    img, label = ds[1]
+    assert float(label) == 1.0
+    assert img.shape == (4, 4, 3)
+    # in-memory list form
+    ds2 = gluon.data.vision.ImageListDataset(
+        root=str(tmp_path), imglist=[[0.0, paths[0]], [1.0, paths[1]]])
+    assert len(ds2) == 2
+    assert float(ds2[1][1]) == 1.0
+
+
+def test_image_record_dataset_non_zero_based_keys(tmp_path):
+    """im2rec keeps .lst keys, which may start at 1 — positional
+    indexing must still reach every record exactly once."""
+    from mxnet_tpu import recordio
+
+    rec_path = str(tmp_path / "k.rec")
+    idx_path = str(tmp_path / "k.idx")
+    rec = recordio.IndexedRecordIO(idx_path, rec_path, "w")
+    rs = onp.random.RandomState(2)
+    for key in (1, 2, 3):  # 1-based keys
+        img = rs.randint(0, 255, (4, 4, 3)).astype(onp.uint8)
+        rec.write_idx(key, recordio.pack_img(
+            recordio.IRHeader(0, float(key), key, 0), img, img_fmt=".png"))
+    rec.close()
+    ds = gluon.data.vision.ImageRecordDataset(rec_path)
+    labels = [float(ds[i][1]) for i in range(len(ds))]
+    assert labels == [1.0, 2.0, 3.0]
+
+
+def test_record_dataset_missing_idx_raises(tmp_path):
+    import pytest
+
+    rec_path = tmp_path / "noidx.rec"
+    rec_path.write_bytes(b"")
+    with pytest.raises(FileNotFoundError):
+        gluon.data.RecordFileDataset(str(rec_path))
+
+
+def test_image_list_dataset_channel_consistency(tmp_path):
+    """Mixed grayscale/color sources must batch: flag=1 always (H,W,3),
+    flag=0 always (H,W,1) — image.imdecode channel semantics."""
+    from PIL import Image
+
+    rs = onp.random.RandomState(3)
+    gray = Image.fromarray(rs.randint(0, 255, (4, 4)).astype(onp.uint8),
+                           mode="L")
+    color = Image.fromarray(
+        rs.randint(0, 255, (4, 4, 3)).astype(onp.uint8))
+    gray.save(tmp_path / "g.png")
+    color.save(tmp_path / "c.png")
+    lst = tmp_path / "m.lst"
+    lst.write_text("0\t0.0\tg.png\n1\t1.0\tc.png\n")
+    for flag, ch in ((1, 3), (0, 1)):
+        ds = gluon.data.vision.ImageListDataset(
+            root=str(tmp_path), imglist="m.lst", flag=flag)
+        shapes = {ds[i][0].shape for i in range(2)}
+        assert shapes == {(4, 4, ch)}, (flag, shapes)
