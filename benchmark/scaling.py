@@ -152,7 +152,68 @@ def main():
     bubble_eff = (M * V_CHUNKS) / (M * V_CHUNKS + S - 1)
     bubble_eff_v1 = M / (M + S - 1)
 
+    # ---- sp: ring-attention partition efficiency (sequence sharded) ----
+    from mxnet_tpu.parallel import ring_attention as _ra
+
+    S_SEQ, HEADS, DH = 1024, 4, 64
+    qkv = [jnp.asarray(rng.rand(2, HEADS, S_SEQ, DH).astype("f") - 0.5)
+           for _ in range(3)]
+
+    from mxnet_tpu.ops.pallas_attention import attention_reference
+
+    def attn_full(q, k, v):
+        return attention_reference(q, k, v).sum()
+
+    ca1 = jax.jit(attn_full).lower(*qkv).compile()
+    sp_flops1 = float(ca1.cost_analysis()["flops"])
+    sp_mesh = Mesh(np.array(jax.devices()[:N_DEV]), ("sp",))
+
+    from functools import partial as _partial
+
+    from jax import shard_map as _shard_map
+
+    sp_spec = P(None, None, "sp", None)
+
+    @_partial(_shard_map, mesh=sp_mesh, in_specs=(sp_spec,) * 3,
+              out_specs=sp_spec, check_vma=False)
+    def _ring_body(ql, kl, vl):
+        # measurement-only unrolled ring (same math as
+        # _ra.ring_attention, whose fori_loop body the XLA cost model
+        # would count once instead of n-1 times)
+        n = jax.lax.axis_size("sp")
+        scale = ql.shape[-1] ** -0.5
+        o = jnp.zeros_like(ql, dtype=jnp.float32)
+        m = jnp.full(ql.shape[:3] + (1,), -jnp.inf, jnp.float32)
+        l = jnp.zeros(ql.shape[:3] + (1,), jnp.float32)  # noqa: E741
+        qf = ql.astype(jnp.float32)
+        perm = [(i, (i + 1) % n) for i in range(n)]
+        k_blk, v_blk = kl, vl
+        for i in range(N_DEV):
+            o, m, l = _ra._stable_block(  # noqa: E741
+                qf, k_blk.astype(jnp.float32), v_blk.astype(jnp.float32),
+                o, m, l, scale, None)
+            if i != N_DEV - 1:
+                k_blk = jax.lax.ppermute(k_blk, "sp", perm)
+                v_blk = jax.lax.ppermute(v_blk, "sp", perm)
+        return (o / jnp.where(l == 0, 1.0, l)).astype(ql.dtype)
+
+    qs = [jax.device_put(x, NamedSharding(sp_mesh, sp_spec))
+          for x in qkv]
+    can = jax.jit(lambda q, k, v: _ring_body(q, k, v).sum()).lower(
+        *qs).compile()
+    sp_flops_n = float(can.cost_analysis()["flops"])
+    sp_eff = (sp_flops1 / N_DEV) / max(sp_flops_n, 1.0)
+
     result["rows"] = [
+        {"metric": f"ring_attention_sp{N_DEV}_partition_efficiency",
+         "value": round(sp_eff, 4), "unit": "ratio",
+         "flops_1dev": sp_flops1,
+         "flops_per_device_sharded": sp_flops_n,
+         "seq_len": S_SEQ,
+         "note": "sequence-sharded ring attention vs ideal 1/N: each "
+                 "device holds S/N queries and streams K/V blocks over "
+                 "the ring (N ppermute hops); comm per step = "
+                 "2*S/N*d*bytes per hop riding ICI"},
         {"metric": f"moe_ep{N_DEV}_partition_efficiency",
          "value": round(moe_eff, 4), "unit": "ratio",
          "flops_1dev": moe_flops1,
