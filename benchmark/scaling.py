@@ -212,8 +212,9 @@ def main():
          "seq_len": S_SEQ,
          "note": "sequence-sharded ring attention vs ideal 1/N: each "
                  "device holds S/N queries and streams K/V blocks over "
-                 "the ring (N ppermute hops); comm per step = "
-                 "2*S/N*d*bytes per hop riding ICI"},
+                 "the ring (N-1 ppermute hops — the last block "
+                 "accumulates without a wasted final permute); comm per "
+                 "step = 2*S/N*d*bytes per hop riding ICI"},
         {"metric": f"moe_ep{N_DEV}_partition_efficiency",
          "value": round(moe_eff, 4), "unit": "ratio",
          "flops_1dev": moe_flops1,

@@ -8,9 +8,11 @@ from ..ndarray.ndarray import NDArray
 
 _REG = registry("metric")
 
-__all__ = ["EvalMetric", "Accuracy", "TopKAccuracy", "F1", "MCC", "MAE",
-           "MSE", "RMSE", "CrossEntropy", "Perplexity", "PearsonCorrelation",
-           "Loss", "CompositeEvalMetric", "CustomMetric", "create", "np"]
+__all__ = ["EvalMetric", "Accuracy", "TopKAccuracy", "F1", "Fbeta",
+           "BinaryAccuracy", "MCC", "PCC", "MAE", "MSE", "RMSE",
+           "CrossEntropy", "Perplexity", "PearsonCorrelation",
+           "MeanCosineSimilarity", "MeanPairwiseDistance", "Loss",
+           "Torch", "CompositeEvalMetric", "CustomMetric", "create", "np"]
 
 
 def _to_np(x):
@@ -336,3 +338,143 @@ np = _np  # parity: reference metric module exposes numpy as .np
 _REG.register(Accuracy, "acc")
 _REG.register(CrossEntropy, "ce")
 _REG.register(TopKAccuracy, "top_k_acc")
+
+
+@_register
+class Fbeta(F1):
+    """Fbeta = (1+β²)·precision·recall / (β²·precision + recall)
+    (reference: metric.py:816)."""
+
+    def __init__(self, name="fbeta", beta=1.0, **kwargs):
+        super().__init__(name, **kwargs)
+        self.beta = beta
+
+    def get(self):
+        prec = self._tp / max(self._tp + self._fp, 1e-12)
+        rec = self._tp / max(self._tp + self._fn, 1e-12)
+        b2 = self.beta ** 2
+        fbeta = ((1 + b2) * prec * rec / max(b2 * prec + rec, 1e-12))
+        return self.name, fbeta if self.num_inst else float("nan")
+
+
+@_register
+class BinaryAccuracy(EvalMetric):
+    """Elementwise accuracy of binary/multilabel predictions against a
+    decision threshold (reference: metric.py:877)."""
+
+    def __init__(self, name="binary_accuracy", threshold=0.5, **kwargs):
+        super().__init__(name, **kwargs)
+        self.threshold = threshold
+
+    def update(self, labels, preds):
+        labels = labels if isinstance(labels, (list, tuple)) else [labels]
+        preds = preds if isinstance(preds, (list, tuple)) else [preds]
+        for label, pred in zip(labels, preds):
+            label = _to_np(label).reshape(-1)
+            pred = (_to_np(pred).reshape(-1) > self.threshold)
+            hit = (pred == (label > 0.5))
+            self.sum_metric += float(hit.sum())
+            self.num_inst += hit.size
+
+
+@_register
+class MeanCosineSimilarity(EvalMetric):
+    """Mean per-sample cosine similarity along the last axis
+    (reference: metric.py:1260)."""
+
+    def __init__(self, name="cos_sim", eps=1e-8, **kwargs):
+        super().__init__(name, **kwargs)
+        self.eps = eps
+
+    def update(self, labels, preds):
+        labels = labels if isinstance(labels, (list, tuple)) else [labels]
+        preds = preds if isinstance(preds, (list, tuple)) else [preds]
+        for label, pred in zip(labels, preds):
+            label = _to_np(label)
+            pred = _to_np(pred)
+            if label.ndim == 1:
+                label, pred = label[None], pred[None]
+            num = (label * pred).sum(-1)
+            den = (_np.linalg.norm(label, axis=-1)
+                   * _np.linalg.norm(pred, axis=-1))
+            sim = num / _np.maximum(den, self.eps)
+            self.sum_metric += float(sim.sum())
+            self.num_inst += sim.size
+
+
+@_register
+class MeanPairwiseDistance(EvalMetric):
+    """Mean per-sample L_p distance along the last axis
+    (reference: metric.py:1199)."""
+
+    def __init__(self, name="mpd", p=2, **kwargs):
+        super().__init__(name, **kwargs)
+        self.p = p
+
+    def update(self, labels, preds):
+        labels = labels if isinstance(labels, (list, tuple)) else [labels]
+        preds = preds if isinstance(preds, (list, tuple)) else [preds]
+        for label, pred in zip(labels, preds):
+            label = _to_np(label)
+            pred = _to_np(pred)
+            if label.ndim == 1:
+                label, pred = label[None], pred[None]
+            dist = (_np.abs(pred - label) ** self.p).sum(-1) ** (1 / self.p)
+            self.sum_metric += float(dist.sum())
+            self.num_inst += dist.size
+
+
+@_register
+class PCC(EvalMetric):
+    """Multiclass Pearson correlation from a running confusion matrix —
+    the discrete MCC generalization (reference: metric.py:1595). Equals
+    MCC for binary problems."""
+
+    def __init__(self, name="pcc", **kwargs):
+        self._cm = _np.zeros((0, 0), dtype=_np.float64)
+        super().__init__(name, **kwargs)
+
+    def reset(self):
+        super().reset()
+        self._cm = _np.zeros((0, 0), dtype=_np.float64)
+
+    def _grow(self, k):
+        if k > self._cm.shape[0]:
+            cm = _np.zeros((k, k), dtype=_np.float64)
+            n = self._cm.shape[0]
+            cm[:n, :n] = self._cm
+            self._cm = cm
+
+    def update(self, labels, preds):
+        labels = labels if isinstance(labels, (list, tuple)) else [labels]
+        preds = preds if isinstance(preds, (list, tuple)) else [preds]
+        for label, pred in zip(labels, preds):
+            label = _to_np(label).reshape(-1).astype(_np.int64)
+            pred = _to_np(pred)
+            if pred.ndim > 1 and pred.shape[-1] > 1:
+                pred = pred.argmax(-1).reshape(-1).astype(_np.int64)
+            else:
+                # 1-D probabilities: threshold like F1/MCC so binary
+                # PCC == MCC holds for sigmoid outputs too
+                pred = (pred.reshape(-1) > 0.5).astype(_np.int64)
+            k = int(max(label.max(initial=0), pred.max(initial=0))) + 1
+            self._grow(k)
+            _np.add.at(self._cm, (pred, label), 1)
+            self.num_inst += len(label)
+
+    def get(self):
+        if self.num_inst == 0:
+            return self.name, float("nan")
+        c = self._cm
+        n = c.sum()
+        x = c.sum(axis=1)  # predicted counts
+        y = c.sum(axis=0)  # true counts
+        cov_xy = n * _np.trace(c) - (x * y).sum()
+        cov_xx = n * n - (x * x).sum()
+        cov_yy = n * n - (y * y).sum()
+        den = _np.sqrt(cov_xx * cov_yy)
+        return self.name, float(cov_xy / den) if den else float("nan")
+
+
+Torch = Loss  # reference keeps the legacy Torch criterion name as Loss
+_REG.register(Loss, "torch")

@@ -1,0 +1,85 @@
+"""Metric parity additions vs the reference docstring oracles
+(reference: gluon/metric.py BinaryAccuracy:895, Fbeta, MeanCosine:1296,
+MeanPairwiseDistance:1231, PCC:1595)."""
+import numpy as onp
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu.gluon import metric
+
+
+def test_binary_accuracy_reference_example():
+    bacc = metric.BinaryAccuracy(threshold=0.6)
+    bacc.update([mx.np.array([0.7, 1, 0.55])],
+                [mx.np.array([0.0, 1.0, 0.0])])
+    # careful: update(labels, preds) — reference example feeds
+    # preds=[0.7,1,0.55], labels=[0,1,0] -> 2/3
+    bacc.reset()
+    bacc.update([mx.np.array([0.0, 1.0, 0.0])],
+                [mx.np.array([0.7, 1, 0.55])])
+    assert abs(bacc.get()[1] - 2 / 3) < 1e-9
+
+
+def test_fbeta_reduces_to_f1_and_weighs_recall():
+    f1 = metric.F1()
+    fb1 = metric.Fbeta(beta=1.0)
+    fb2 = metric.Fbeta(beta=2.0)
+    labels = [mx.np.array([1, 0, 1, 1, 0])]
+    preds = [mx.np.array([0.9, 0.1, 0.2, 0.7, 0.1])]  # p=1 > r=2/3
+    for m in (f1, fb1, fb2):
+        m.update(labels, preds)
+    assert abs(f1.get()[1] - fb1.get()[1]) < 1e-12
+    # recall < precision here, so beta=2 (recall-weighted) is lower
+    assert fb2.get()[1] < fb1.get()[1]
+
+
+def test_mean_cosine_similarity_reference_example():
+    mcs = metric.MeanCosineSimilarity()
+    mcs.update(labels=[mx.np.array([[3.0, 4.0], [2.0, 2.0]])],
+               preds=[mx.np.array([[1.0, 0.0], [1.0, 1.0]])])
+    assert abs(mcs.get()[1] - 0.8) < 1e-6
+
+
+def test_mean_pairwise_distance_reference_example():
+    mpd = metric.MeanPairwiseDistance()
+    mpd.update(labels=[mx.np.array([[1.0, 2.0], [3.0, 4.0]])],
+               preds=[mx.np.array([[1.0, 0.0], [4.0, 2.0]])])
+    # distances: 2 and sqrt(1+4)=2.2360 -> mean 2.1180
+    assert abs(mpd.get()[1] - 2.1180339) < 1e-4
+
+
+@pytest.mark.parametrize("pred_form", ["probs_2d", "probs_1d"])
+def test_pcc_equals_mcc_binary(pred_form):
+    rs = onp.random.RandomState(0)
+    labels = rs.randint(0, 2, (50,))
+    if pred_form == "probs_2d":
+        preds = rs.rand(50, 2).astype("f")
+    else:
+        preds = rs.rand(50).astype("f")  # sigmoid outputs, thresholded
+    mcc = metric.MCC()
+    pcc = metric.PCC()
+    mcc.update([mx.np.array(labels)], [mx.np.array(preds)])
+    pcc.update([mx.np.array(labels)], [mx.np.array(preds)])
+    assert abs(mcc.get()[1] - pcc.get()[1]) < 1e-9
+
+
+def test_mpd_3d_mean_over_all_rows():
+    mpd = metric.MeanPairwiseDistance()
+    mpd.update(labels=[mx.np.array(onp.ones((2, 3, 4), "f"))],
+               preds=[mx.np.array(onp.zeros((2, 3, 4), "f"))])
+    assert abs(mpd.get()[1] - 2.0) < 1e-12  # 6 rows of distance 2
+
+
+def test_pcc_multiclass_perfect_and_chance():
+    pcc = metric.PCC()
+    labels = onp.array([0, 1, 2, 0, 1, 2])
+    onehot = onp.eye(3, dtype="f")[labels]
+    pcc.update([mx.np.array(labels)], [mx.np.array(onehot)])
+    assert abs(pcc.get()[1] - 1.0) < 1e-12
+
+
+def test_torch_alias_and_registry():
+    assert metric.Torch is metric.Loss
+    m = metric.create("fbeta", beta=0.5)
+    assert isinstance(m, metric.Fbeta)
+    assert isinstance(metric.create("pcc"), metric.PCC)
