@@ -7,6 +7,7 @@ from .event_handler import (  # noqa: F401
     EarlyStoppingHandler,
     EpochBegin,
     EpochEnd,
+    GradientUpdateHandler,
     LoggingHandler,
     MetricHandler,
     StoppingHandler,

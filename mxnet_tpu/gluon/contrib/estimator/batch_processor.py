@@ -27,7 +27,7 @@ class BatchProcessor:
 
     def fit_batch(self, estimator, train_batch, batch_axis=0):
         """One training step: forward under record, backward, and return
-        (data, label, pred, loss); the Estimator runs trainer.step."""
+        (data, label, pred, loss); GradientUpdateHandler runs trainer.step sized from the per-sample loss vector (0-d losses step with 1)."""
         data, label = self._get_data_and_label(
             train_batch, estimator.device, batch_axis)
         with autograd.record():

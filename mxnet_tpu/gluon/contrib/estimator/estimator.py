@@ -10,6 +10,7 @@ from .event_handler import (
     BatchEnd,
     EpochBegin,
     EpochEnd,
+    GradientUpdateHandler,
     LoggingHandler,
     MetricHandler,
     StoppingHandler,
@@ -87,6 +88,8 @@ class Estimator:
         handlers = list(event_handlers or [])
         stopper = StoppingHandler(epochs, batches)
         handlers.append(stopper)
+        if not any(isinstance(h, GradientUpdateHandler) for h in handlers):
+            handlers.append(GradientUpdateHandler())
         if not any(isinstance(h, MetricHandler) for h in handlers):
             handlers.append(MetricHandler(self.train_metrics))
         if not any(isinstance(h, LoggingHandler) for h in handlers):
@@ -121,7 +124,6 @@ class Estimator:
                 else:
                     data, label, pred, loss = \
                         self.batch_processor.fit_batch(self, batch)
-                self.trainer.step(data.shape[0])
                 if fire("batch_end", pred=pred, label=label, loss=loss):
                     break
             if val_data is not None:

@@ -7,7 +7,8 @@ import time
 
 __all__ = ["TrainBegin", "TrainEnd", "EpochBegin", "EpochEnd", "BatchBegin",
            "BatchEnd", "StoppingHandler", "MetricHandler", "LoggingHandler",
-           "ValidationHandler", "CheckpointHandler", "EarlyStoppingHandler"]
+           "ValidationHandler", "CheckpointHandler", "EarlyStoppingHandler",
+           "GradientUpdateHandler"]
 
 
 class TrainBegin:
@@ -258,3 +259,25 @@ class EarlyStoppingHandler(TrainBegin, EpochEnd, TrainEnd):
         if self.stopped_epoch > 0:
             logging.getLogger("mxnet_tpu.estimator").info(
                 "Early stop at epoch %d", self.stopped_epoch)
+
+
+class GradientUpdateHandler(BatchEnd):
+    """Applies the optimizer step at the end of each batch (reference:
+    event_handler.py:722). Runs FIRST among batch_end handlers
+    (priority -2000) so metric/logging handlers see updated state.
+    Batch size comes from the per-sample loss vector like the
+    reference; a pre-reduced 0-d loss steps with batch_size=1 (its
+    gradients already carry the 1/batch scale)."""
+
+    def __init__(self, priority=-2000):
+        self.priority = priority
+
+    def batch_end(self, estimator, *args, **kwargs):
+        loss = kwargs.get("loss")
+        losses = loss if isinstance(loss, (list, tuple)) else [loss]
+        # per-sample loss vectors step with their row count (grads get
+        # rescaled by 1/batch); an already-reduced 0-d loss steps with 1
+        # (its grads are already mean-scaled)
+        batch_size = sum(l.shape[0] if getattr(l, "ndim", 0) else 1
+                         for l in losses)
+        estimator.trainer.step(batch_size)

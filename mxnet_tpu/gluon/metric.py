@@ -15,6 +15,14 @@ __all__ = ["EvalMetric", "Accuracy", "TopKAccuracy", "F1", "Fbeta",
            "Torch", "CompositeEvalMetric", "CustomMetric", "create", "np"]
 
 
+def _fbeta_score(tp, fp, fn, beta):
+    """Shared F-score kernel: F1 is the beta=1 case."""
+    prec = tp / max(tp + fp, 1e-12)
+    rec = tp / max(tp + fn, 1e-12)
+    b2 = beta ** 2
+    return (1 + b2) * prec * rec / max(b2 * prec + rec, 1e-12)
+
+
 def _to_np(x):
     if isinstance(x, NDArray):
         return x.asnumpy()
@@ -141,10 +149,8 @@ class F1(EvalMetric):
             self.num_inst += len(label)
 
     def get(self):
-        prec = self._tp / max(self._tp + self._fp, 1e-12)
-        rec = self._tp / max(self._tp + self._fn, 1e-12)
-        f1 = 2 * prec * rec / max(prec + rec, 1e-12)
-        return self.name, f1 if self.num_inst else float("nan")
+        score = _fbeta_score(self._tp, self._fp, self._fn, 1.0)
+        return self.name, score if self.num_inst else float("nan")
 
 
 @_register
@@ -350,11 +356,8 @@ class Fbeta(F1):
         self.beta = beta
 
     def get(self):
-        prec = self._tp / max(self._tp + self._fp, 1e-12)
-        rec = self._tp / max(self._tp + self._fn, 1e-12)
-        b2 = self.beta ** 2
-        fbeta = ((1 + b2) * prec * rec / max(b2 * prec + rec, 1e-12))
-        return self.name, fbeta if self.num_inst else float("nan")
+        score = _fbeta_score(self._tp, self._fp, self._fn, self.beta)
+        return self.name, score if self.num_inst else float("nan")
 
 
 @_register

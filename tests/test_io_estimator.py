@@ -272,3 +272,47 @@ def test_estimator_val_net_and_loss():
                     val_loss=gluon.loss.SoftmaxCrossEntropyLoss())
     est.fit(loader, val_data=loader, epochs=1)
     assert calls["val"] == 2  # val runs through the wrapper
+
+
+def test_gradient_update_handler_owns_the_step():
+    """The optimizer step runs through GradientUpdateHandler (reference:
+    event_handler.py:722, default-added by fit) — a custom replacement
+    with a different priority can reorder or suppress updates."""
+    import numpy as onp
+
+    from mxnet_tpu import gluon
+    from mxnet_tpu.gluon.contrib.estimator import (Estimator,
+                                                   GradientUpdateHandler)
+
+    mx.seed(0)
+    net = gluon.nn.HybridSequential()
+    net.add(gluon.nn.Dense(4, in_units=6), gluon.nn.Dense(2))
+    net.initialize()
+    loss = gluon.loss.SoftmaxCrossEntropyLoss()
+    trainer = gluon.Trainer(net.collect_params(), "sgd",
+                            {"learning_rate": 0.1})
+    est = Estimator(net, loss=loss,
+                    train_metrics=gluon.metric.Accuracy(),
+                    trainer=trainer)
+    rs = onp.random.RandomState(0)
+    ds = gluon.data.ArrayDataset(
+        mx.np.array(rs.rand(32, 6).astype("f")),
+        mx.np.array(rs.randint(0, 2, (32,))))
+    loader = gluon.data.DataLoader(ds, batch_size=8)
+
+    w0 = net[0].weight.data().asnumpy().copy()
+    est.fit(loader, epochs=1)
+    w1 = net[0].weight.data().asnumpy()
+    assert onp.abs(w1 - w0).max() > 0  # default handler stepped
+
+    class NoStep(GradientUpdateHandler):
+        def batch_end(self, estimator, *args, **kwargs):
+            return None  # suppress updates entirely
+
+    est2 = Estimator(net, loss=loss,
+                     train_metrics=gluon.metric.Accuracy(),
+                     trainer=trainer)
+    w1c = net[0].weight.data().asnumpy().copy()
+    est2.fit(loader, epochs=1, event_handlers=[NoStep()])
+    w2 = net[0].weight.data().asnumpy()
+    onp.testing.assert_allclose(w2, w1c)  # custom handler suppressed step
