@@ -88,6 +88,7 @@ from . import contrib  # noqa: E402
 from . import util  # noqa: E402
 from . import runtime  # noqa: E402
 from . import profiler  # noqa: E402
+from . import test_utils  # noqa: E402  (mx.test_utils like the reference)
 
 waitall = engine.waitall
 
