@@ -42,34 +42,28 @@ def _fix_kwargs(kwargs):
     return kwargs
 
 
-def _wrap_jnp(jnp_fn, n_array_args):
+def _wrap_jnp(jnp_fn):
     """Make an mx.np function from a jnp function.
 
-    The first `n_array_args` positional args are treated as (potential)
-    arrays and routed through apply_op; everything else is closed over.
-    """
+    Every NDArray — positional OR keyword — routes through apply_op, so
+    gradients flow the same whether an array argument is spelled
+    positionally or as a keyword (np.average(x, weights=w) tapes w)."""
 
     @functools.wraps(jnp_fn)
     def wrapped(*args, **kwargs):
         kwargs = _fix_kwargs(dict(kwargs))
-        arr_args = args[:n_array_args]
-        rest = args[n_array_args:]
-        nd_args = [a for a in arr_args if isinstance(a, NDArray)]
-        if not nd_args:
-            # pure python/numpy inputs: still produce an NDArray
-            out = jnp_fn(*args, **kwargs)
-        else:
-            def fn(*xs):
-                it = iter(xs)
-                call = [
-                    next(it) if isinstance(a, NDArray) else a for a in arr_args
-                ]
-                return jnp_fn(*call, *rest, **kwargs)
+        kw_names = [k for k, v in kwargs.items() if isinstance(v, NDArray)]
+        n_pos = len(args)
 
-            return apply_op(fn, *nd_args, name=jnp_fn.__name__)
-        if isinstance(out, (tuple, list)):
-            return tuple(NDArray(o) for o in out)
-        return NDArray(out)
+        def fn(*call):
+            kw = dict(kwargs)
+            for k, v in zip(kw_names, call[n_pos:]):
+                kw[k] = v
+            out = jnp_fn(*call[:n_pos], **kw)
+            return tuple(out) if isinstance(out, list) else out
+
+        return apply_op(fn, *args, *[kwargs[k] for k in kw_names],
+                        name=jnp_fn.__name__)
 
     return wrapped
 
@@ -144,21 +138,21 @@ __all__ = ["ndarray", "array", "zeros", "ones", "empty", "full", "arange",
 _g = globals()
 for _name in set(_UNARY):
     if hasattr(jnp, _name):
-        _g[_name] = _wrap_jnp(getattr(jnp, _name), 1)
+        _g[_name] = _wrap_jnp(getattr(jnp, _name))
         __all__.append(_name)
 for _name in set(_BINARY):
     if hasattr(jnp, _name):
-        _g[_name] = _wrap_jnp(getattr(jnp, _name), 2)
+        _g[_name] = _wrap_jnp(getattr(jnp, _name))
         __all__.append(_name)
 for _name in set(_REDUCE):
     if hasattr(jnp, _name):
-        _g[_name] = _wrap_jnp(getattr(jnp, _name), 1)
+        _g[_name] = _wrap_jnp(getattr(jnp, _name))
         __all__.append(_name)
 for _name in set(_OTHER):
     if _name in _g:
         continue
     if hasattr(jnp, _name):
-        _g[_name] = _wrap_jnp(getattr(jnp, _name), 4)
+        _g[_name] = _wrap_jnp(getattr(jnp, _name))
         __all__.append(_name)
 
 
@@ -184,9 +178,38 @@ def _seq_wrap(jnp_fn):
 
 
 for _name in ("concatenate", "stack", "vstack", "hstack", "dstack",
-              "column_stack", "meshgrid", "broadcast_arrays", "block"):
+              "column_stack", "block"):
     if hasattr(jnp, _name):
         _g[_name] = _seq_wrap(getattr(jnp, _name))
+        if _name not in __all__:
+            __all__.append(_name)
+
+
+# meshgrid/broadcast_arrays take arrays as *varargs*, which the general
+# _wrap_jnp (registered via _OTHER) handles; they must NOT get _seq_wrap,
+# which would iterate the first array as if it were the argument list.
+
+def _percentile_family(jnp_fn):
+    """percentile/quantile: the reference spells jnp's `method` kwarg
+    `interpolation` (numpy<1.22 name) — accept both."""
+
+    base = _wrap_jnp(jnp_fn)
+
+    @functools.wraps(jnp_fn)
+    def wrapped(*args, **kwargs):
+        if "interpolation" in kwargs:
+            if "method" in kwargs:
+                raise TypeError(
+                    "pass either method= or interpolation=, not both")
+            kwargs["method"] = kwargs.pop("interpolation")
+        return base(*args, **kwargs)
+
+    return wrapped
+
+
+for _name in ("percentile", "quantile", "nanpercentile", "nanquantile"):
+    if hasattr(jnp, _name):
+        _g[_name] = _percentile_family(getattr(jnp, _name))
         if _name not in __all__:
             __all__.append(_name)
 

@@ -1,0 +1,210 @@
+"""Tricky numpy-frontend semantics vs the onp oracle (second pass of
+VERDICT missing #8 — reference: tests/python/unittest/test_numpy_op.py
+behaviors that bite when porting scripts)."""
+import numpy as onp
+import pytest
+
+import mxnet_tpu as mx
+
+np = mx.np
+rs = onp.random.RandomState(0)
+
+
+def A(x):
+    return np.array(onp.asarray(x))
+
+
+def _chk(got, want, **kw):
+    got = got.asnumpy() if hasattr(got, "asnumpy") else onp.asarray(got)
+    onp.testing.assert_allclose(got, want, **kw)
+
+
+@pytest.mark.parametrize("interp", ["linear", "lower", "higher",
+                                    "nearest", "midpoint"])
+def test_percentile_interpolation_modes(interp):
+    x = rs.rand(37).astype("f")
+    got = np.percentile(A(x), 30.0, interpolation=interp)
+    want = onp.percentile(x, 30.0, method=interp)
+    _chk(got, want, rtol=1e-6)
+
+
+def test_quantile_multiple_qs_and_axis():
+    x = rs.rand(4, 9).astype("f")
+    got = np.quantile(A(x), A([0.1, 0.5, 0.9]), axis=1)
+    want = onp.quantile(x, [0.1, 0.5, 0.9], axis=1)
+    _chk(got, want, rtol=1e-5)
+
+
+def test_einsum_multi_operand_and_ellipsis():
+    a = rs.rand(3, 4, 5).astype("f")
+    b = rs.rand(5, 6).astype("f")
+    c = rs.rand(6, 4).astype("f")
+    got = np.einsum("...ij,jk,ki->...i", A(a), A(b), A(c))
+    want = onp.einsum("...ij,jk,ki->...i", a, b, c)
+    _chk(got, want, rtol=1e-4)
+    # implicit output (no ->)
+    got = np.einsum("ij,jk", A(a[0]), A(b))
+    _chk(got, onp.einsum("ij,jk", a[0], b), rtol=1e-4)
+
+
+def test_unique_all_outputs():
+    x = onp.array([3, 1, 2, 3, 1, 7], "f")
+    vals, idx, inv, cnt = np.unique(A(x), return_index=True,
+                                    return_inverse=True,
+                                    return_counts=True)
+    wv, wi, wn, wc = onp.unique(x, return_index=True, return_inverse=True,
+                                return_counts=True)
+    _chk(vals, wv)
+    _chk(idx, wi)
+    _chk(inv.reshape(-1), wn.reshape(-1))
+    _chk(cnt, wc)
+
+
+def test_histogram_with_bins_and_range():
+    x = rs.rand(100).astype("f") * 10
+    hist, edges = np.histogram(A(x), bins=7, range=(0.0, 10.0))
+    wh, we = onp.histogram(x, bins=7, range=(0.0, 10.0))
+    _chk(hist, wh)
+    _chk(edges, we, rtol=1e-6)
+
+
+def test_interp_basic_and_clamped_ends():
+    xp = onp.array([0.0, 1.0, 2.0], "f")
+    fp = onp.array([0.0, 10.0, 5.0], "f")
+    x = onp.array([-1.0, 0.5, 1.5, 3.0], "f")
+    got = np.interp(A(x), A(xp), A(fp))
+    _chk(got, onp.interp(x, xp, fp), rtol=1e-6)
+
+
+def test_gradient_nonunit_spacing():
+    x = rs.rand(16).astype("f")
+    got = np.gradient(A(x), 0.5)
+    _chk(got, onp.gradient(x, 0.5), rtol=1e-5)
+
+
+def test_searchsorted_and_digitize():
+    a = onp.sort(rs.rand(10).astype("f"))
+    v = rs.rand(5).astype("f")
+    _chk(np.searchsorted(A(a), A(v), side="right"),
+         onp.searchsorted(a, v, side="right"))
+    bins = onp.array([0.2, 0.5, 0.8], "f")
+    _chk(np.digitize(A(v), A(bins)), onp.digitize(v, bins))
+
+
+def test_average_with_weights():
+    x = rs.rand(3, 4).astype("f")
+    w = rs.rand(4).astype("f")
+    got = np.average(A(x), axis=1, weights=A(w))
+    _chk(got, onp.average(x, axis=1, weights=w), rtol=1e-5)
+
+
+def test_cov_corrcoef():
+    x = rs.rand(3, 20).astype("f")
+    _chk(np.cov(A(x)), onp.cov(x), rtol=1e-4)
+    _chk(np.corrcoef(A(x)), onp.corrcoef(x), rtol=1e-4)
+
+
+def test_nan_family():
+    x = onp.array([[1.0, onp.nan, 3.0], [onp.nan, 5.0, 6.0]], "f")
+    _chk(np.nanmean(A(x), axis=0), onp.nanmean(x, axis=0), rtol=1e-6)
+    _chk(np.nansum(A(x)), onp.nansum(x), rtol=1e-6)
+    _chk(np.nan_to_num(A(x), nan=-1.0), onp.nan_to_num(x, nan=-1.0))
+
+
+def test_pad_modes():
+    x = rs.rand(3, 4).astype("f")
+    for mode in ("constant", "edge", "reflect", "symmetric"):
+        got = np.pad(A(x), ((1, 2), (0, 1)), mode=mode)
+        _chk(got, onp.pad(x, ((1, 2), (0, 1)), mode=mode), rtol=1e-6)
+
+
+def test_roll_rot90_kron_outer():
+    x = rs.rand(3, 4).astype("f")
+    _chk(np.roll(A(x), 2, axis=1), onp.roll(x, 2, axis=1))
+    _chk(np.rot90(A(x)), onp.rot90(x))
+    y = rs.rand(2, 2).astype("f")
+    _chk(np.kron(A(x), A(y)), onp.kron(x, y), rtol=1e-5)
+    _chk(np.outer(A(x[0]), A(y[0])), onp.outer(x[0], y[0]), rtol=1e-6)
+
+
+def test_boolean_mask_indexing_and_setitem():
+    x = rs.rand(4, 5).astype("f")
+    m = x > 0.5
+    got = A(x)[A(m)]
+    _chk(got, x[m])
+    a = A(x.copy())
+    a[A(m)] = 0.0
+    w = x.copy()
+    w[m] = 0.0
+    _chk(a, w)
+
+
+def test_argwhere_nonzero_empty():
+    x = onp.zeros((2, 3), "f")
+    assert np.argwhere(A(x)).shape == (0, 2)
+    nz = np.nonzero(A(x))
+    assert all(z.shape == (0,) for z in nz)
+
+
+def test_meshgrid_ij_and_xy():
+    a = onp.arange(3, dtype="f")
+    b = onp.arange(4, dtype="f")
+    for indexing in ("xy", "ij"):
+        got = np.meshgrid(A(a), A(b), indexing=indexing)
+        want = onp.meshgrid(a, b, indexing=indexing)
+        for g, w in zip(got, want):
+            _chk(g, w)
+
+
+def test_lexsort_and_unravel():
+    keys = onp.array([[1, 0, 1, 0], [3, 3, 2, 2]], "f")
+    _chk(np.lexsort(A(keys)), onp.lexsort(keys))
+    _chk(np.unravel_index(A([7, 11]), (3, 4))[0],
+         onp.unravel_index([7, 11], (3, 4))[0])
+
+
+def test_diff_ediff1d_bincount():
+    x = onp.array([1, 3, 6, 10], "f")
+    _chk(np.diff(A(x), n=2), onp.diff(x, n=2))
+    _chk(np.ediff1d(A(x)), onp.ediff1d(x))
+    ints = onp.array([0, 1, 1, 3, 2, 1])
+    _chk(np.bincount(A(ints), minlength=6),
+         onp.bincount(ints, minlength=6))
+
+
+def test_median_even_length():
+    x = rs.rand(6, 4).astype("f")
+    _chk(np.median(A(x), axis=0), onp.median(x, axis=0), rtol=1e-6)
+
+
+def test_cross_2d_and_3d():
+    a = rs.rand(4, 3).astype("f")
+    b = rs.rand(4, 3).astype("f")
+    _chk(np.cross(A(a), A(b)), onp.cross(a, b), rtol=1e-5, atol=1e-6)
+
+
+def test_polyval_vander():
+    c = onp.array([2.0, 0.0, -1.0], "f")
+    x = rs.rand(5).astype("f")
+    _chk(np.polyval(A(c), A(x)), onp.polyval(c, x), rtol=1e-5)
+    _chk(np.vander(A(x), 4), onp.vander(x, 4), rtol=1e-4)
+
+
+def test_kwarg_arrays_are_taped():
+    """Array args spelled as keywords must backprop like positional ones
+    (np.average(x, weights=w) -> w.grad)."""
+    from mxnet_tpu import autograd
+
+    x = A(rs.rand(6, 4).astype("f"))
+    w = A(rs.rand(4).astype("f") + 0.1)
+    w.attach_grad()
+    with autograd.record():
+        y = np.average(x, axis=1, weights=w).sum()
+    y.backward()
+    assert (w.grad.asnumpy() != 0).all()
+
+
+def test_percentile_conflicting_kwargs_raise():
+    x = A(rs.rand(8).astype("f"))
+    with pytest.raises(TypeError):
+        np.percentile(x, 50.0, method="nearest", interpolation="linear")
