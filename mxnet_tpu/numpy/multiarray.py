@@ -59,8 +59,7 @@ def _wrap_jnp(jnp_fn):
             kw = dict(kwargs)
             for k, v in zip(kw_names, call[n_pos:]):
                 kw[k] = v
-            out = jnp_fn(*call[:n_pos], **kw)
-            return tuple(out) if isinstance(out, list) else out
+            return jnp_fn(*call[:n_pos], **kw)
 
         return apply_op(fn, *args, *[kwargs[k] for k in kw_names],
                         name=jnp_fn.__name__)
@@ -93,8 +92,8 @@ nanargmin nancumprod nancumsum nanmax nanmean nanmedian nanmin nanprod nanstd
 nansum nanvar prod ptp std sum var count_nonzero average quantile percentile
 """.split()
 
-# functions whose first arg is an array; extra args may be arrays too but the
-# common case is handled: we scan the first 4 positional args for NDArrays.
+# functions whose arrays may appear in any positional or keyword slot —
+# _wrap_jnp tapes them all.
 _OTHER = """
 reshape ravel transpose swapaxes moveaxis rollaxis squeeze expand_dims
 broadcast_to broadcast_arrays flip fliplr flipud rot90 roll

@@ -1,0 +1,154 @@
+"""One-step optimizer update rules vs hand-coded reference formulas
+(reference: python/mxnet/optimizer/*.py step() bodies; VERDICT missing
+#8 depth — the update ops ARE reference API surface)."""
+import numpy as onp
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import optimizer
+from mxnet_tpu import np as mnp
+
+rs = onp.random.RandomState(0)
+
+
+def _step(opt, w0, g0, steps=1):
+    w = mnp.array(w0.copy())
+    state = opt.create_state(0, w)
+    for _ in range(steps):
+        g = mnp.array(g0.copy())
+        opt.update(0, w, g, state)
+    return w.asnumpy()
+
+
+W0 = rs.randn(6).astype("f")
+G0 = rs.randn(6).astype("f")
+
+
+def test_sgd_wd_formula():
+    """sgd.py:583 — w -= lr*(grad + wd*w)."""
+    opt = optimizer.SGD(learning_rate=0.1, wd=0.01)
+    got = _step(opt, W0, G0)
+    onp.testing.assert_allclose(got, W0 - 0.1 * (G0 + 0.01 * W0),
+                                rtol=1e-6)
+
+
+def test_nag_formula_two_steps():
+    """nag.py:100-109 — mom = μ·mom − lr·g; w += μ·mom − lr·g."""
+    opt = optimizer.NAG(learning_rate=0.1, momentum=0.9)
+    got = _step(opt, W0, G0, steps=2)
+    w, mom = W0.copy(), onp.zeros_like(W0)
+    for _ in range(2):
+        mom = 0.9 * mom - 0.1 * G0
+        w = w + 0.9 * mom - 0.1 * G0
+    onp.testing.assert_allclose(got, w, rtol=1e-5)
+
+
+def test_rmsprop_plain():
+    """rmsprop.py:124-132 — var = ρ·var + (1−ρ)g²; w -= lr·g/(√var+ε)."""
+    opt = optimizer.RMSProp(learning_rate=0.1, rho=0.9, epsilon=1e-8)
+    got = _step(opt, W0, G0)
+    var = 0.1 * G0 ** 2
+    want = W0 - 0.1 * G0 / (onp.sqrt(var) + 1e-8)
+    onp.testing.assert_allclose(got, want, rtol=1e-5)
+
+
+def test_rmsprop_centered():
+    """rmsprop.py:134-147 centered variant keeps (mean, var, mom)."""
+    opt = optimizer.RMSProp(learning_rate=0.1, rho=0.9, momentum=0.9,
+                            epsilon=1e-8, centered=True)
+    got = _step(opt, W0, G0)
+    mean = 0.1 * G0
+    var = 0.1 * G0 ** 2
+    mom = -0.1 * G0 / onp.sqrt(var - mean ** 2 + 1e-8)
+    want = W0 + mom
+    onp.testing.assert_allclose(got, want, rtol=1e-4)
+
+
+def test_adam_bias_correction():
+    """adam.py — m̂/v̂ bias correction on the FIRST step makes the update
+    ≈ −lr·sign-scaled grad regardless of β warmup."""
+    opt = optimizer.Adam(learning_rate=0.1, beta1=0.9, beta2=0.999,
+                         epsilon=1e-8)
+    got = _step(opt, W0, G0)
+    m = 0.1 * G0
+    v = 0.001 * G0 ** 2
+    mhat = m / (1 - 0.9)
+    vhat = v / (1 - 0.999)
+    want = W0 - 0.1 * mhat / (onp.sqrt(vhat) + 1e-8)
+    onp.testing.assert_allclose(got, want, rtol=1e-4)
+
+
+def test_adamw_decoupled_wd():
+    """adamW.py — wd applies to the WEIGHT directly (decoupled), not
+    through the gradient moments."""
+    opt_w = optimizer.AdamW(learning_rate=0.1, wd=0.1)
+    opt_0 = optimizer.AdamW(learning_rate=0.1, wd=0.0)
+    got_w = _step(opt_w, W0, G0)
+    got_0 = _step(opt_0, W0, G0)
+    # difference is exactly the decoupled decay term −lr·wd·w
+    onp.testing.assert_allclose(got_w - got_0, -0.1 * 0.1 * W0,
+                                rtol=1e-4, atol=1e-7)
+
+
+def test_adagrad_accumulator():
+    """adagrad.py — h += g²; w -= lr·g/(√h+ε)."""
+    opt = optimizer.AdaGrad(learning_rate=0.1, epsilon=1e-7)
+    got = _step(opt, W0, G0, steps=2)
+    h = onp.zeros_like(W0)
+    w = W0.copy()
+    for _ in range(2):
+        h = h + G0 ** 2
+        w = w - 0.1 * G0 / (onp.sqrt(h) + 1e-7)
+    onp.testing.assert_allclose(got, w, rtol=1e-5)
+
+
+def test_ftrl_sparsity():
+    """ftrl.py:122-137 — z/n accumulators; |z| ≤ λ1 rows clamp to 0."""
+    opt = optimizer.Ftrl(learning_rate=0.1, lamda1=1.0, beta=1.0)
+    w0 = onp.zeros(4, "f")
+    g0 = onp.array([0.01, -0.02, 3.0, -4.0], "f")
+    got = _step(opt, w0, g0)
+    # tiny grads: |z| < λ1 -> weight exactly 0 (sparsity); big grads move
+    assert got[0] == 0.0 and got[1] == 0.0
+    assert got[2] < 0 and got[3] > 0
+
+
+def test_signum_sign_update():
+    """sgd.py Signum — w = (1−lr·wd_lh)·w − lr·sign(mom)."""
+    opt = optimizer.Signum(learning_rate=0.1, momentum=0.0, wd_lh=0.0)
+    got = _step(opt, W0, G0)
+    onp.testing.assert_allclose(got, W0 - 0.1 * onp.sign(G0), rtol=1e-6)
+
+
+def test_rescale_and_clip_composition():
+    """optimizer.py step preamble — grad = clip(rescale·g, ±c) BEFORE wd
+    is added (order matters)."""
+    opt = optimizer.SGD(learning_rate=1.0, rescale_grad=0.5,
+                        clip_gradient=0.4, wd=0.0)
+    g0 = onp.array([2.0, -2.0, 0.2], "f")
+    w0 = onp.zeros(3, "f")
+    got = _step(opt, w0, g0)
+    want = -onp.clip(0.5 * g0, -0.4, 0.4)
+    onp.testing.assert_allclose(got, want, rtol=1e-6)
+
+
+def test_adadelta_no_lr_dependence():
+    """adadelta.py — update uses RMS ratios; acc_g/acc_delta states."""
+    opt = optimizer.AdaDelta(rho=0.9, epsilon=1e-5)
+    got = _step(opt, W0, G0)
+    acc_g = 0.1 * G0 ** 2
+    delta = -onp.sqrt(1e-5) / onp.sqrt(acc_g + 1e-5) * G0
+    want = W0 + delta
+    onp.testing.assert_allclose(got, want, rtol=1e-4)
+
+
+@pytest.mark.parametrize("name", ["sgd", "nag", "adam", "adamw", "rmsprop",
+                                  "adagrad", "adadelta", "ftrl", "signum",
+                                  "lamb", "lars", "lans", "ftml",
+                                  "adabelief", "nadam", "adamax", "dcasgd",
+                                  "sgld"])
+def test_every_optimizer_moves_weights(name):
+    opt = optimizer.create(name, learning_rate=0.01)
+    got = _step(opt, W0, G0)
+    assert onp.isfinite(got).all()
+    assert (got != W0).any()
