@@ -133,7 +133,13 @@ class Parameter:
         initializer = init_mod.create(
             init if init is not None
             else self.init if self.init is not None else default_init)
-        master = initializer.init_array(self._name, self._shape, self.dtype)
+        # the Gluon Parameter path ALWAYS applies the chosen
+        # initializer's _init_weight (reference initializer.py:140 —
+        # desc.attrs['__init__'] bypasses the suffix table; biases end
+        # up zero because every layer DECLARES bias_initializer='zeros',
+        # not because of the name)
+        master = initializer.init_array(self._name, self._shape, self.dtype,
+                                        explicit=True)
         self._ctx_list = list(devices)
         self._data_map = {}
         self._grad_map = {}

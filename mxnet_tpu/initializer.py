@@ -50,15 +50,25 @@ class Initializer:
     def dumps(self):
         return json.dumps([type(self).__name__.lower(), self._kwargs])
 
-    def __call__(self, name, arr=None):
-        """Initialize `arr` in place based on the parameter name's suffix,
-        mirroring reference dispatch (weight/bias/gamma/beta/...)."""
+    def __call__(self, name, arr=None, explicit=False):
+        """Initialize `arr` in place.
+
+        Default initializers dispatch on the parameter name's suffix
+        (bias/beta/moving stats → 0, gamma/moving var → 1, else
+        _init_weight), mirroring the reference's suffix table. An
+        EXPLICITLY chosen initializer (Parameter(init=...) /
+        bias_initializer=...) applies its _init_weight regardless of the
+        suffix — reference initializer.py:140
+        `create(init)._init_weight(desc, arr)` — so e.g.
+        LSTMBias/Constant on a bias actually take effect."""
         if arr is None:
             name, arr = getattr(name, "name", str(name)), name
             name = str(name)
         shape, dtype = arr.shape, arr.dtype
         lname = name.lower()
-        if lname.endswith("bias") or lname.endswith("beta") or \
+        if explicit:
+            data = self._init_weight(name, shape, dtype)
+        elif lname.endswith("bias") or lname.endswith("beta") or \
                 lname.endswith("running_mean") or lname.endswith("moving_mean"):
             data = jnp.zeros(shape, dtype)
         elif lname.endswith("gamma") or lname.endswith("running_var") or \
@@ -71,9 +81,9 @@ class Initializer:
             arr._version += 1
         return arr
 
-    def init_array(self, name, shape, dtype):
+    def init_array(self, name, shape, dtype, explicit=False):
         out = NDArray(jnp.zeros(shape, dtype))
-        self(name, out)
+        self(name, out, explicit=explicit)
         return out
 
     def _init_weight(self, name, shape, dtype):

@@ -1,0 +1,186 @@
+"""LR-scheduler curves and initializer statistics vs the reference
+contracts (reference: python/mxnet/lr_scheduler.py, initializer.py)."""
+import math
+
+import numpy as onp
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import initializer, lr_scheduler
+
+
+# ---------------------------------------------------------------------------
+# schedulers (reference lr_scheduler.py formulas)
+# ---------------------------------------------------------------------------
+
+
+def test_factor_scheduler_decay_and_floor():
+    s = lr_scheduler.FactorScheduler(step=10, factor=0.5, base_lr=1.0,
+                                     stop_factor_lr=0.2)
+    assert s(0) == 1.0
+    assert s(10) == 0.5
+    assert s(20) == 0.25
+    assert s(30) == 0.2  # clamped at stop_factor_lr (0.125 < 0.2)
+
+
+def test_multifactor_scheduler():
+    s = lr_scheduler.MultiFactorScheduler(step=[5, 15], factor=0.1,
+                                          base_lr=1.0)
+    assert s(4) == 1.0
+    assert abs(s(5) - 0.1) < 1e-12
+    assert abs(s(14) - 0.1) < 1e-12
+    assert abs(s(15) - 0.01) < 1e-12
+
+
+def test_poly_scheduler_endpoints():
+    s = lr_scheduler.PolyScheduler(max_update=100, base_lr=1.0, pwr=2,
+                                   final_lr=0.0)
+    assert s(0) == 1.0
+    assert abs(s(50) - 0.25) < 1e-6  # (1 - 0.5)^2
+    assert s(100) == 0.0
+    assert s(200) == 0.0  # stays at final
+
+
+def test_cosine_scheduler_curve():
+    s = lr_scheduler.CosineScheduler(max_update=100, base_lr=1.0,
+                                     final_lr=0.0)
+    assert abs(s(0) - 1.0) < 1e-9
+    assert abs(s(50) - 0.5) < 1e-6
+    assert abs(s(100) - 0.0) < 1e-9
+
+
+def test_warmup_ramp():
+    """Reference LRScheduler warmup: linear ramp to base_lr over
+    warmup_steps before the schedule takes over."""
+    s = lr_scheduler.FactorScheduler(step=1000, factor=1.0, base_lr=1.0,
+                                     warmup_steps=10, warmup_begin_lr=0.0)
+    assert s(0) < s(5) < s(10)
+    assert abs(s(10) - 1.0) < 1e-6
+
+
+def test_trainer_uses_scheduler():
+    from mxnet_tpu import autograd, gluon
+    from mxnet_tpu import np as mnp
+
+    mx.seed(0)
+    net = gluon.nn.Dense(1, in_units=2)
+    net.initialize()
+    sched = lr_scheduler.FactorScheduler(step=2, factor=0.1, base_lr=0.5)
+    tr = gluon.Trainer(net.collect_params(), "sgd",
+                       {"learning_rate": 0.5, "lr_scheduler": sched})
+    x = mnp.array(onp.ones((1, 2), "f"))
+    for _ in range(3):
+        with autograd.record():
+            y = net(x).sum()
+        y.backward()
+        tr.step(1)
+    assert abs(tr.learning_rate - 0.05) < 1e-9  # decayed once at step 2
+
+
+# ---------------------------------------------------------------------------
+# initializers (reference initializer.py magnitude contracts)
+# ---------------------------------------------------------------------------
+
+
+def _stats(init, shape=(256, 128), name="weight", explicit=False):
+    mx.seed(0)
+    arr = init.init_array(name, shape, onp.float32, explicit=explicit)
+    a = arr.asnumpy() if hasattr(arr, "asnumpy") else onp.asarray(arr)
+    return a
+
+
+def test_xavier_uniform_magnitude():
+    """Xavier 'uniform'/'avg': bound = sqrt(6/(fan_in+fan_out))
+    (reference initializer.py Xavier)."""
+    a = _stats(initializer.Xavier(rnd_type="uniform", factor_type="avg",
+                                  magnitude=3))
+    bound = math.sqrt(6.0 / (256 + 128))
+    assert abs(a.max()) <= bound + 1e-6
+    assert abs(a.min()) >= -bound - 1e-6
+    # roughly uniform: std ~ bound/sqrt(3)
+    assert abs(a.std() - bound / math.sqrt(3)) < 0.01
+
+
+def test_xavier_gaussian_fan_in():
+    a = _stats(initializer.Xavier(rnd_type="gaussian", factor_type="in",
+                                  magnitude=2))
+    want_std = math.sqrt(2.0 / 128)  # fan_in = prod(shape[1:])
+    assert abs(a.std() - want_std) < 0.01
+
+
+def test_msra_prelu_std():
+    """MSRAPrelu: gaussian with var = 2/((1+slope^2)·fan_in)."""
+    a = _stats(initializer.MSRAPrelu(factor_type="in", slope=0.25))
+    want_std = math.sqrt(2.0 / ((1 + 0.25 ** 2) * 128))
+    assert abs(a.std() - want_std) < 0.01
+
+
+def test_orthogonal_is_orthogonal():
+    a = _stats(initializer.Orthogonal(scale=1.0), shape=(64, 64))
+    eye = a @ a.T
+    onp.testing.assert_allclose(eye, onp.eye(64), atol=1e-4)
+
+
+def test_constant_zero_one():
+    assert (_stats(initializer.Zero(), (4, 4)) == 0).all()
+    assert (_stats(initializer.One(), (4, 4)) == 1).all()
+    assert (_stats(initializer.Constant(2.5), (4, 4)) == 2.5).all()
+
+
+def test_bilinear_upsampling_kernel():
+    """Bilinear: the classic deconv upsampling kernel — symmetric, rows
+    sum to the upsample ratio pattern (reference initializer.py
+    Bilinear)."""
+    a = _stats(initializer.Bilinear(), shape=(1, 1, 4, 4))
+    k = a[0, 0]
+    onp.testing.assert_allclose(k, k[::-1, ::-1], rtol=1e-6)  # symmetric
+    assert k.max() == k[1, 1] or k.max() == k[2, 2]
+
+
+def test_lstm_bias_forget_gate():
+    """LSTMBias sets the forget-gate quarter to 1.0, everything else 0
+    (reference initializer.py LSTMBias)."""
+    a = _stats(initializer.LSTMBias(forget_bias=1.0), shape=(32,),
+               name="h2h_bias", explicit=True)
+    assert (a[8:16] == 1.0).all()
+    assert (a[:8] == 0).all() and (a[16:] == 0).all()
+
+
+def test_mixed_initializer_by_pattern():
+    """initializer.Mixed routes by name regex (reference Mixed)."""
+    if not hasattr(initializer, "Mixed"):
+        pytest.skip("Mixed not implemented")
+    init = initializer.Mixed([".*bias", ".*"],
+                             [initializer.Zero(), initializer.One()])
+    b = init.init_array("fc1_bias", (4,), onp.float32)
+    w = init.init_array("fc1_weight", (4,), onp.float32)
+    b = b.asnumpy() if hasattr(b, "asnumpy") else onp.asarray(b)
+    w = w.asnumpy() if hasattr(w, "asnumpy") else onp.asarray(w)
+    assert (b == 0).all() and (w == 1).all()
+
+
+def test_explicit_bias_initializer_takes_effect():
+    """Parameter(init=Constant) on a *_bias name must NOT be zeroed by
+    the suffix dispatch (reference: explicit init -> _init_weight)."""
+    from mxnet_tpu.gluon.parameter import Parameter
+
+    p = Parameter("out_bias", shape=(4,), init=initializer.Constant(2.5))
+    p.initialize()
+    assert (p.data().asnumpy() == 2.5).all()
+    # a bare Parameter takes the default initializer VERBATIM, even on a
+    # *_bias name (reference Gluon: layers get zero biases because they
+    # declare bias_initializer='zeros', not via name dispatch)
+    q = Parameter("out_bias", shape=(4,))
+    q.initialize(default_init=initializer.One())
+    assert (q.data().asnumpy() == 1).all()
+
+
+def test_lstm_cell_forget_bias_initializer_end_to_end():
+    from mxnet_tpu import gluon
+
+    cell = gluon.rnn.LSTMCell(8, input_size=4,
+                              i2h_bias_initializer=initializer.LSTMBias(1.0))
+    cell.initialize()
+    b = cell.i2h_bias.data().asnumpy()
+    assert (b[8:16] == 1.0).all()
+    assert (b[:8] == 0).all() and (b[16:] == 0).all()
