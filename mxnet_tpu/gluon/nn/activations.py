@@ -53,7 +53,7 @@ class GELU(HybridBlock):
         self._approx = approximation
 
     def forward(self, x):
-        act = "gelu" if self._approx == "erf" else "gelu"
+        act = "gelu" if self._approx == "erf" else "gelu_tanh"
         return npx.activation(x, act)
 
 

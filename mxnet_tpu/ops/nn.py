@@ -516,8 +516,11 @@ _ACTS = {
     "softsign": jax.nn.soft_sign,
     "softmax": jax.nn.softmax,
     "log_softmax": jax.nn.log_softmax,
-    "gelu": jax.nn.gelu,
+    # reference gelu (mshadow_op.h) is the exact erf form; the tanh
+    # approximation is opt-in under its own name
+    "gelu": lambda x: jax.nn.gelu(x, approximate=False),
     "erf_gelu": lambda x: jax.nn.gelu(x, approximate=False),
+    "gelu_tanh": lambda x: jax.nn.gelu(x, approximate=True),
     "silu": jax.nn.silu,
     "swish": jax.nn.silu,
     "mish": lambda x: x * jnp.tanh(jax.nn.softplus(x)),

@@ -260,3 +260,34 @@ def test_where_broadcast():
     b = onp.zeros((2, 2), "f")
     out = nd.where(A(cond), A(a), A(b)).asnumpy()
     onp.testing.assert_allclose(out, onp.where(cond, a, b))
+
+
+def test_gelu_is_erf_form():
+    """Reference gelu (mshadow_op.h) = x/2·(1+erf(x/√2)) exactly — NOT
+    the tanh approximation; gelu_tanh is the opt-in approximation."""
+    x = onp.linspace(-3, 3, 41).astype("f")
+    got = nd.Activation(A(x), act_type="gelu").asnumpy()
+    try:
+        from scipy.special import erf as _erf
+        want = 0.5 * x * (1 + _erf(x / onp.sqrt(2.0)))
+    except ImportError:
+        import math
+        want = onp.array([0.5 * v * (1 + math.erf(v / math.sqrt(2)))
+                          for v in x], "f")
+    onp.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-6)
+    # LeakyReLU(act_type='gelu') — the reference op spelling — matches
+    got2 = nd.LeakyReLU(A(x), act_type="gelu").asnumpy()
+    onp.testing.assert_allclose(got2, want, rtol=1e-5, atol=1e-6)
+
+
+def test_gelu_layer_approximation_switch():
+    """nn.GELU('tanh') must use the tanh approximation, 'erf' the exact
+    form — they differ measurably around |x|≈2."""
+    from mxnet_tpu import gluon
+
+    x = A(onp.linspace(-3, 3, 31).astype("f"))
+    erf_out = gluon.nn.GELU("erf")(x).asnumpy()
+    tanh_out = gluon.nn.GELU("tanh")(x).asnumpy()
+    assert onp.abs(erf_out - tanh_out).max() > 1e-4
+    onp.testing.assert_allclose(
+        erf_out, nd.Activation(x, act_type="gelu").asnumpy(), rtol=1e-6)
