@@ -1,0 +1,98 @@
+"""CachedOp retrace policy (reference: cached_op.cc SetForwardGraph —
+shape/dtype changes re-setup the graph, same signature hits the cache).
+"""
+import numpy as onp
+
+import mxnet_tpu as mx
+from mxnet_tpu import autograd, gluon
+from mxnet_tpu import np as mnp
+
+rs = onp.random.RandomState(0)
+
+
+def _net():
+    mx.seed(0)
+    net = gluon.nn.HybridSequential()
+    net.add(gluon.nn.Dense(8, flatten=False, in_units=4),
+            gluon.nn.Activation("relu"),
+            gluon.nn.Dense(2, flatten=False, in_units=8))
+    net.initialize()
+    net.hybridize()
+    return net
+
+
+def test_shape_change_retraces_correctly():
+    net = _net()
+    outs = {}
+    for b in (2, 5, 2, 7):  # revisit 2: cache must still be correct
+        x = mnp.array(rs.rand(b, 4).astype("f"))
+        y = net(x)
+        assert y.shape == (b, 2)
+        outs[b] = (x, y.asnumpy())
+    # eager oracle for each shape
+    net2 = _net()
+    net2.hybridize(active=False)
+    for b, (x, want) in outs.items():
+        onp.testing.assert_allclose(net2(x).asnumpy(), want, rtol=1e-5,
+                                    atol=1e-6)
+
+
+def test_dtype_change_retraces():
+    """A bf16 input after an f32 trace must retrace (dtype is part of
+    the cache signature) and still compute correctly."""
+    net = _net()
+    x32 = mnp.array(rs.rand(3, 4).astype("f"))
+    y32 = net(x32).asnumpy()
+    x16 = x32.astype("bfloat16")
+    y16 = net(x16)  # f32 params x bf16 input: new signature
+    assert onp.isfinite(y16.asnumpy().astype("f")).all()
+    onp.testing.assert_allclose(y16.asnumpy().astype("f"), y32,
+                                rtol=5e-2, atol=5e-2)
+    # original f32 signature still serves from the cache
+    onp.testing.assert_allclose(net(x32).asnumpy(), y32, rtol=1e-6)
+
+
+def test_trailing_dims_and_3d_inputs():
+    net = _net()
+    x3 = mnp.array(rs.rand(2, 6, 4).astype("f"))  # extra leading time dim
+    y = net(x3)
+    assert y.shape == (2, 6, 2)
+
+
+def test_hybridize_off_reverts_to_eager():
+    net = _net()
+    x = mnp.array(rs.rand(2, 4).astype("f"))
+    y_jit = net(x).asnumpy()
+    net.hybridize(active=False)
+    y_eager = net(x).asnumpy()
+    onp.testing.assert_allclose(y_eager, y_jit, rtol=1e-5, atol=1e-6)
+
+
+def test_retrace_under_autograd_keeps_gradients():
+    net = _net()
+    for b in (2, 4):
+        x = mnp.array(rs.rand(b, 4).astype("f"))
+        with autograd.record():
+            loss = (net(x) ** 2).sum()
+        loss.backward()
+        g = net[0].weight.grad().asnumpy()
+        assert onp.isfinite(g).all() and (g != 0).any()
+        net[0].weight.zero_grad()
+
+
+def test_bn_running_stats_update_across_retraces():
+    """Aux-state sink must keep mutating moving stats when the cache
+    holds multiple signatures."""
+    mx.seed(0)
+    net = gluon.nn.HybridSequential()
+    net.add(gluon.nn.Dense(4, flatten=False, in_units=3),
+            gluon.nn.BatchNorm(axis=-1))
+    net.initialize()
+    net.hybridize()
+    net(mnp.array(rs.rand(2, 3).astype("f")))
+    rm0 = net[1].running_mean.data().asnumpy().copy()
+    with autograd.record():
+        net(mnp.array(rs.rand(2, 3).astype("f")))
+        net(mnp.array(rs.rand(6, 3).astype("f")))  # second signature
+    rm1 = net[1].running_mean.data().asnumpy()
+    assert onp.abs(rm1 - rm0).max() > 1e-8
