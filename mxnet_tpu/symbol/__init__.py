@@ -28,7 +28,7 @@ __all__ = (["Symbol", "Variable", "Group", "Executor", "var", "load",
 def __getattr__(name):
     """Resolve any registered op as mx.sym.<name> (curated wrappers above
     take normal attribute priority; this fallback covers the rest of the
-    ~610-op registry, like the reference's generated namespace)."""
+    700+-op registry, like the reference's generated namespace)."""
     builder = _register.get_builder(name)
     if builder is not None:
         return builder
