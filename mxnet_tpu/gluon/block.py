@@ -156,6 +156,7 @@ class Block:
         """Initialize all parameters (reference: Block.initialize)."""
         device = device if device is not None else ctx
         for name, p in self.collect_params().items():
+            p._structured_name = name  # full path for Load/Mixed routing
             p.initialize(init=None, device=device,
                          default_init=init or _default_init(),
                          force_reinit=force_reinit)

@@ -130,16 +130,20 @@ class Parameter:
         # create() resolves registry-name strings and passes Initializer
         # instances through, so one call covers every spec form
         # (net.initialize(init="normal") included)
-        initializer = init_mod.create(
-            init if init is not None
-            else self.init if self.init is not None else default_init)
-        # the Gluon Parameter path ALWAYS applies the chosen
-        # initializer's _init_weight (reference initializer.py:140 —
-        # desc.attrs['__init__'] bypasses the suffix table; biases end
-        # up zero because every layer DECLARES bias_initializer='zeros',
-        # not because of the name)
-        master = initializer.init_array(self._name, self._shape, self.dtype,
-                                        explicit=True)
+        # Reference protocol (gluon/parameter.py:365): the GLOBAL
+        # initializer's __call__ drives, with the parameter's declared
+        # init riding in InitDesc.attrs['__init__']. Standard globals
+        # defer to the declared init (biases stay zero because layers
+        # declare 'zeros'); Load/Mixed override __call__ and so win —
+        # net.initialize(init=Load(...)) warm-starts EVERY parameter.
+        declared = init if init is not None else self.init
+        global_init = init_mod.create(default_init)
+        init_name = getattr(self, "_structured_name", None) or self._name
+        desc = init_mod.InitDesc(
+            init_name,
+            {"__init__": declared} if declared is not None else {})
+        master = global_init.init_array(desc, self._shape, self.dtype,
+                                        explicit=declared is None)
         self._ctx_list = list(devices)
         self._data_map = {}
         self._grad_map = {}

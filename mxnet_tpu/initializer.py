@@ -18,9 +18,9 @@ from .ndarray.ndarray import NDArray
 
 _REG = registry("initializer")
 
-__all__ = ["Initializer", "Zero", "One", "Constant", "Uniform", "Normal",
-           "Orthogonal", "Xavier", "MSRAPrelu", "Bilinear", "LSTMBias",
-           "register", "create"]
+__all__ = ["Initializer", "InitDesc", "Zero", "One", "Constant", "Uniform",
+           "Normal", "Orthogonal", "Xavier", "MSRAPrelu", "Bilinear",
+           "LSTMBias", "Mixed", "Load", "RNNFused", "register", "create"]
 
 
 def register(klass):
@@ -53,20 +53,29 @@ class Initializer:
     def __call__(self, name, arr=None, explicit=False):
         """Initialize `arr` in place.
 
-        Default initializers dispatch on the parameter name's suffix
+        Mirrors the reference's dispatch protocol (initializer.py:140):
+        if `name` is an InitDesc carrying a declared init in
+        attrs['__init__'] (the Gluon Parameter path), that declared
+        initializer's _init_weight applies regardless of the name
+        suffix. `explicit=True` forces THIS initializer's _init_weight
+        the same way. Otherwise the legacy suffix table runs
         (bias/beta/moving stats → 0, gamma/moving var → 1, else
-        _init_weight), mirroring the reference's suffix table. An
-        EXPLICITLY chosen initializer (Parameter(init=...) /
-        bias_initializer=...) applies its _init_weight regardless of the
-        suffix — reference initializer.py:140
-        `create(init)._init_weight(desc, arr)` — so e.g.
-        LSTMBias/Constant on a bias actually take effect."""
+        _init_weight). Global initializers with a custom __call__
+        (Load, Mixed) never consult the declared init — they drive,
+        exactly like the reference."""
         if arr is None:
             name, arr = getattr(name, "name", str(name)), name
             name = str(name)
+        declared = None
+        attrs = getattr(name, "attrs", None)
+        if attrs:
+            declared = attrs.get("__init__")
+        name = str(name)
         shape, dtype = arr.shape, arr.dtype
         lname = name.lower()
-        if explicit:
+        if declared is not None:
+            data = create(declared)._init_weight(name, shape, dtype)
+        elif explicit:
             data = self._init_weight(name, shape, dtype)
         elif lname.endswith("bias") or lname.endswith("beta") or \
                 lname.endswith("running_mean") or lname.endswith("moving_mean"):
@@ -233,6 +242,168 @@ class LSTMBias(Initializer):
         b = jnp.zeros(shape, dtype)
         n = shape[0] // 4
         return b.at[n : 2 * n].set(self.forget_bias)
+
+
+class InitDesc(str):
+    """Parameter-name descriptor carrying init attrs (reference:
+    initializer.py InitDesc — a str subclass so it drops into every
+    name-taking API)."""
+
+    def __new__(cls, name, attrs=None, global_init=None):
+        ret = super().__new__(cls, name)
+        ret.attrs = attrs or {}
+        ret.global_init = global_init
+        return ret
+
+
+class Mixed(Initializer):
+    """Route parameters to initializers by name-regex patterns
+    (reference: initializer.py Mixed)."""
+
+    def __init__(self, patterns, initializers):
+        import re
+
+        super().__init__()
+        if len(patterns) != len(initializers):
+            raise ValueError("patterns and initializers must pair up")
+        self.map = [(re.compile(p), create(i)) for p, i in
+                    zip(patterns, initializers)]
+
+    def __call__(self, name, arr=None, explicit=False):  # noqa: ARG002
+        if arr is None:
+            name, arr = getattr(name, "name", str(name)), name
+        name = str(name)  # the matched pattern drives, not declared inits
+        for prog, init in self.map:
+            if prog.match(name):
+                return init(name, arr, explicit=True)
+        raise ValueError(
+            f"Parameter name {name} did not match any pattern; consider "
+            "adding a '.*' pattern at the end with a default initializer")
+
+
+class Load(Initializer):
+    """Initialize from a saved name→array dict / .npz path, falling back
+    to `default_init` for missing names (reference: initializer.py Load)."""
+
+    def __init__(self, param, default_init=None, verbose=False):
+        super().__init__()
+        if isinstance(param, str):
+            from .ndarray.utils import load as _load
+
+            param = _load(param)
+        self.param = {}
+        for name, arr in dict(param).items():
+            key = name[4:] if name.startswith(("arg:", "aux:")) else name
+            self.param[key] = arr
+        self.default_init = default_init
+        self.verbose = verbose
+
+    def __call__(self, name, arr=None, explicit=False):  # noqa: ARG002
+        if arr is None:
+            name, arr = getattr(name, "name", str(name)), name
+        name = str(name)
+        if name in self.param:
+            src = self.param[name]
+            src_np = src.asnumpy() if hasattr(src, "asnumpy") else src
+            if tuple(arr.shape) != tuple(src_np.shape):
+                raise ValueError(
+                    f"Parameter {name} cannot be initialized from "
+                    f"loading: shape mismatch, target {tuple(arr.shape)} "
+                    f"vs loaded {tuple(src_np.shape)}")
+            arr._data = jnp.asarray(src_np, arr.dtype)
+            arr._version += 1
+            return arr
+        if self.default_init is None:
+            raise ValueError(
+                f"Cannot initialize {name}: not in the loaded params and "
+                "no default initializer was provided")
+        # the caller chose this fallback — apply it verbatim
+        return create(self.default_init)(name, arr, explicit=True)
+
+
+@register
+class RNNFused(Initializer):
+    """Initialize a fused-RNN flat parameter blob: weight segments from
+    the (optional) per-segment initializers or Uniform(scale), bias
+    segments zero (reference: initializer.py RNNFused; layout per
+    ops/rnn.py slice_rnn_params / reference rnn-inl.h)."""
+
+    def __init__(self, mode, num_layers, state_size, bidirectional=False,
+                 projection_size=None, scale=0.07,
+                 i2h_weight_initializer=None, h2h_weight_initializer=None,
+                 i2h_bias_initializer=None, h2h_bias_initializer=None,
+                 h2r_weight_initializer=None):
+        super().__init__(mode=mode, num_layers=num_layers,
+                         state_size=state_size, bidirectional=bidirectional,
+                         projection_size=projection_size, scale=scale,
+                         i2h_weight_initializer=i2h_weight_initializer,
+                         h2h_weight_initializer=h2h_weight_initializer,
+                         i2h_bias_initializer=i2h_bias_initializer,
+                         h2h_bias_initializer=h2h_bias_initializer,
+                         h2r_weight_initializer=h2r_weight_initializer)
+        from .ops.rnn import _GATES
+
+        self.gates = _GATES[mode]
+        self.num_layers = num_layers
+        self.state_size = state_size
+        self.dirs = 2 if bidirectional else 1
+        self.projection_size = projection_size
+        self.scale = scale
+        mk = lambda i, d: create(i) if i is not None else d  # noqa: E731
+        default_w = Uniform(scale)
+        self._i2h_w = mk(i2h_weight_initializer, default_w)
+        self._h2h_w = mk(h2h_weight_initializer, default_w)
+        self._i2h_b = mk(i2h_bias_initializer, Zero())
+        self._h2h_b = mk(h2h_bias_initializer, Zero())
+        self._h2r_w = mk(h2r_weight_initializer, default_w)
+
+    def _input_size(self, total):
+        """Invert ops/rnn.py rnn_param_size for the input width."""
+        L, D, G, H = (self.num_layers, self.dirs, self.gates,
+                      self.state_size)
+        P = self.projection_size
+        ghd = G * H * D
+        if P:
+            rest = (L - 1) * (P * D + P + 2) * ghd + P * H * L * D
+            return (total - rest) // ghd - P - 2
+        rest = (L - 1) * (H * D + H + 2) * ghd
+        return (total - rest) // ghd - H - 2
+
+    def _init_weight(self, name, shape, dtype):
+        from .ops.rnn import rnn_param_size
+
+        total = int(shape[0])
+        in_size = int(self._input_size(total))
+        want = rnn_param_size(self.num_layers, in_size, self.state_size,
+                              self.dirs == 2, self._kwargs["mode"],
+                              self.projection_size)
+        if in_size <= 0 or want != total:
+            raise ValueError(
+                f"RNNFused: flat size {total} inconsistent with "
+                f"mode={self._kwargs['mode']} layers={self.num_layers} "
+                f"state={self.state_size}")
+        L, D, G, H = (self.num_layers, self.dirs, self.gates,
+                      self.state_size)
+        P = self.projection_size or 0
+        R = P or H
+        segs = []
+
+        def seg(init, n, sub):
+            segs.append(jnp.ravel(jnp.asarray(
+                init.init_array(f"{name}_{sub}", (n,), dtype,
+                                explicit=True)._data)))
+
+        for layer in range(L):
+            in_l = in_size if layer == 0 else R * D
+            for _d in range(D):
+                seg(self._i2h_w, G * H * in_l, "i2h_weight")
+                seg(self._h2h_w, G * H * R, "h2h_weight")
+                if P:
+                    seg(self._h2r_w, P * H, "h2r_weight")
+        for _ in range(L * D):
+            seg(self._i2h_b, G * H, "i2h_bias")
+            seg(self._h2h_b, G * H, "h2h_bias")
+        return jnp.concatenate(segs).astype(dtype)
 
 
 # friendly aliases matching the reference registry

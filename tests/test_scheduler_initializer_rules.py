@@ -148,8 +148,6 @@ def test_lstm_bias_forget_gate():
 
 def test_mixed_initializer_by_pattern():
     """initializer.Mixed routes by name regex (reference Mixed)."""
-    if not hasattr(initializer, "Mixed"):
-        pytest.skip("Mixed not implemented")
     init = initializer.Mixed([".*bias", ".*"],
                              [initializer.Zero(), initializer.One()])
     b = init.init_array("fc1_bias", (4,), onp.float32)
@@ -184,3 +182,77 @@ def test_lstm_cell_forget_bias_initializer_end_to_end():
     b = cell.i2h_bias.data().asnumpy()
     assert (b[8:16] == 1.0).all()
     assert (b[:8] == 0).all() and (b[16:] == 0).all()
+
+
+def test_load_initializer_roundtrip(tmp_path):
+    """initializer.Load fills params from a saved dict, falls back to
+    default_init, and rejects shape mismatches (reference Load)."""
+    import mxnet_tpu as mx
+
+    saved = {"arg:fc_weight": mx.np.array(onp.full((2, 3), 4.0, "f"))}
+    ld = initializer.Load(saved, default_init=initializer.Zero())
+    w = mx.np.zeros((2, 3))
+    ld("fc_weight", w)
+    assert (w.asnumpy() == 4.0).all()
+    other = mx.np.ones((5,))
+    ld("not_saved", other)
+    assert (other.asnumpy() == 0).all()
+    with pytest.raises(ValueError):
+        ld("fc_weight", mx.np.zeros((9,)))
+
+
+def test_rnn_fused_initializer_layout():
+    """RNNFused zeroes the bias tail and uniform-fills the weight head of
+    the cuDNN-layout flat blob (reference RNNFused; ops/rnn.py layout)."""
+    import mxnet_tpu as mx
+    from mxnet_tpu.ops.rnn import rnn_param_size
+
+    mx.seed(0)
+    total = rnn_param_size(2, 8, 16, True, "lstm")
+    r = initializer.RNNFused("lstm", 2, 16, bidirectional=True)
+    flat = r.init_array("rnn_param", (total,), onp.float32,
+                        explicit=True).asnumpy()
+    n_bias = 2 * 2 * 2 * 4 * 16  # layers*dirs*2(bx,bh)*gates*hidden
+    assert (flat[-n_bias:] == 0).all()
+    w = flat[:-n_bias]
+    assert abs(w).max() <= 0.07 + 1e-6 and (w != 0).mean() > 0.9
+    with pytest.raises(ValueError):
+        r.init_array("rnn_param", (total + 1,), onp.float32,
+                     explicit=True)
+
+
+def test_load_initializer_warm_starts_a_net():
+    """net.initialize(init=Load(collect_params snapshot)) restores EVERY
+    parameter by its structured path name — including biases, whose
+    declared 'zeros' init must NOT shadow the global Load (reference:
+    Load overrides __call__, so it wins over InitDesc attrs)."""
+    from mxnet_tpu import gluon
+
+    mx.seed(0)
+    net = gluon.nn.HybridSequential()
+    net.add(gluon.nn.Dense(4, in_units=3), gluon.nn.Dense(2, in_units=4))
+    net.initialize()
+    # make biases nonzero so a silent re-zeroing would be caught
+    for k, v in net.collect_params().items():
+        if k.endswith("bias"):
+            v.set_data(mx.np.array(onp.full(v.shape, 0.75, "f")))
+    saved = {k: v.data() for k, v in net.collect_params().items()}
+    net2 = gluon.nn.HybridSequential()
+    net2.add(gluon.nn.Dense(4, in_units=3), gluon.nn.Dense(2, in_units=4))
+    net2.initialize(init=initializer.Load(
+        dict(saved), default_init=initializer.Zero()), force_reinit=True)
+    for k, v in net2.collect_params().items():
+        onp.testing.assert_allclose(v.data().asnumpy(),
+                                    saved[k].asnumpy())
+    assert (net2[0].bias.data().asnumpy() == 0.75).all()
+
+
+def test_load_fallback_applies_default_verbatim():
+    """A param missing from the Load dict takes the caller's default
+    initializer verbatim (no suffix-table override)."""
+    ld = initializer.Load({}, default_init=initializer.One())
+    import mxnet_tpu as mx
+
+    arr = mx.np.zeros((3,))
+    ld("x_bias", arr)
+    assert (arr.asnumpy() == 1.0).all()
