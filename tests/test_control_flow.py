@@ -1,0 +1,185 @@
+"""Control-flow op semantics: npx.foreach / while_loop / cond
+(reference: src/operator/control_flow.cc + python contrib control-flow
+contracts; here lowered to lax.scan / lax.while_loop / lax.cond)."""
+import numpy as onp
+
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import autograd, npx
+from mxnet_tpu import np as mnp
+from mxnet_tpu import np
+
+from mxnet_tpu.test_utils import assert_almost_equal
+
+rs = onp.random.RandomState(0)
+
+
+# --- consolidated from the original test_io_estimator.py block ----------
+
+
+def test_foreach():
+    out, fin = npx.foreach(lambda x, s: (x + s, x + s),
+                           np.arange(5).astype("float32"), np.array(0.0))
+    assert_almost_equal(out, onp.array([0.0, 1, 3, 6, 10]))
+    assert float(fin) == 10.0
+
+
+def test_foreach_grad():
+    x = np.arange(4).astype("float32")
+    x.attach_grad()
+    with mx.autograd.record():
+        out, fin = npx.foreach(lambda xt, s: (xt * s, s + xt), x,
+                               np.array(1.0))
+        L = fin.sum()
+    L.backward()
+    assert_almost_equal(x.grad, onp.ones(4))
+
+
+def test_while_loop_contract():
+    # reference contract: func -> (step_output, new_loop_vars)
+    out, fin = npx.while_loop(
+        cond=lambda i, s: i < 4,
+        func=lambda i, s: (s, (i + 1, s + i)),
+        loop_vars=(np.array(0), np.array(0)),
+        max_iterations=6)
+    # outputs padded to max_iterations
+    assert out.shape == (6,)
+    assert_almost_equal(out.asnumpy()[:4], onp.array([0, 0, 1, 3]))
+    assert int(fin[0]) == 4 and int(fin[1]) == 6
+
+
+def test_while_loop_requires_max_iterations():
+    with pytest.raises(ValueError, match="max_iterations"):
+        npx.while_loop(lambda i: i < 2, lambda i: (i, (i,)),
+                       (np.array(0),))
+
+
+def test_cond():
+    assert float(npx.cond(np.array(True), lambda x: x * 2, lambda x: x * 3,
+                          np.array(4.0))) == 8.0
+    assert float(npx.cond(np.array(False), lambda x: x * 2, lambda x: x * 3,
+                          np.array(4.0))) == 12.0
+
+
+
+def test_foreach_cumsum_states_and_outputs():
+    data = mnp.array(onp.arange(6, dtype="f").reshape(6, 1))
+
+    def body(x, state):
+        new = state + x
+        return new * 2.0, new  # out_t, new_state
+
+    outs, final = npx.foreach(body, data, mnp.zeros((1,)))
+    csum = onp.cumsum(onp.arange(6, dtype="f"))[:, None]
+    onp.testing.assert_allclose(outs.asnumpy(), csum * 2.0)
+    onp.testing.assert_allclose(final.asnumpy(), [15.0])
+
+
+def test_foreach_multi_data_multi_state():
+    a = mnp.array(rs.rand(4, 3).astype("f"))
+    b = mnp.array(rs.rand(4, 3).astype("f"))
+
+    def body(xs, states):
+        xa, xb = xs
+        s1, s2 = states
+        return [xa + s1, xb * 2.0], [s1 + xa, s2 + xb]
+
+    (o1, o2), (f1, f2) = npx.foreach(body, [a, b],
+                                     [mnp.zeros((3,)), mnp.zeros((3,))])
+    an, bn = a.asnumpy(), b.asnumpy()
+    prefix = onp.concatenate([onp.zeros((1, 3), "f"),
+                              onp.cumsum(an, 0)[:-1]])
+    onp.testing.assert_allclose(o1.asnumpy(), an + prefix, rtol=1e-6)
+    onp.testing.assert_allclose(o2.asnumpy(), bn * 2.0, rtol=1e-6)
+    onp.testing.assert_allclose(f1.asnumpy(), an.sum(0), rtol=1e-5)
+    onp.testing.assert_allclose(f2.asnumpy(), bn.sum(0), rtol=1e-5)
+
+
+def test_foreach_gradient_flows():
+    data = mnp.array(rs.rand(5, 2).astype("f"))
+    data.attach_grad()
+
+    def body(x, state):
+        new = state + x * x
+        return new, new
+
+    with autograd.record():
+        outs, final = npx.foreach(body, data, mnp.zeros((2,)))
+        loss = final.sum()
+    loss.backward()
+    # d(sum x^2)/dx = 2x
+    onp.testing.assert_allclose(data.grad.asnumpy(),
+                                2 * data.asnumpy(), rtol=1e-5)
+
+
+def test_while_loop_collatz_style():
+    def cond(i, v):  # noqa: A002
+        return (v < 100.0).reshape(())
+
+    def func(i, v):
+        return (v, (i + 1, v * 2.0))  # output current v, then double
+
+    outs, (it_final, v_final) = npx.while_loop(
+        cond, func, (mnp.zeros(()), mnp.array(3.0)), max_iterations=10)
+    # 3 -> 6 -> 12 -> 24 -> 48 -> 96 -> 192 (stops when v >= 100)
+    assert float(v_final.asnumpy()) == 192.0
+    assert int(it_final.asnumpy()) == 6
+    o = outs.asnumpy()
+    onp.testing.assert_allclose(o[:6], [3, 6, 12, 24, 48, 96])
+    onp.testing.assert_allclose(o[6:], 0.0)  # padding rows stay zero
+
+
+def test_while_loop_hits_max_iterations():
+    def cond(v):  # noqa: A002
+        return (v > -1.0).reshape(())  # never false
+
+    def func(v):
+        return (v, v + 1.0)
+
+    outs, final = npx.while_loop(cond, func, mnp.array(0.0),
+                                 max_iterations=4)
+    assert float(final.asnumpy()) == 4.0
+    onp.testing.assert_allclose(outs.asnumpy(), [0, 1, 2, 3])
+
+
+def test_cond_branches_and_gradient():
+    x = mnp.array(onp.array([2.0, -3.0], "f"))
+    x.attach_grad()
+
+    def then_fn(v):
+        return v * v
+
+    def else_fn(v):
+        return v * 3.0
+
+    with autograd.record():
+        y_then = npx.cond(mnp.array(1.0), then_fn, else_fn, (x,))
+        y_else = npx.cond(mnp.array(0.0), then_fn, else_fn, (x,))
+        loss = y_then.sum() + y_else.sum()
+    loss.backward()
+    onp.testing.assert_allclose(y_then.asnumpy(), [4.0, 9.0])
+    onp.testing.assert_allclose(y_else.asnumpy(), [6.0, -9.0])
+    # d/dx (x^2 + 3x) = 2x + 3
+    onp.testing.assert_allclose(x.grad.asnumpy(),
+                                2 * x.asnumpy() + 3.0, rtol=1e-6)
+
+
+def test_foreach_inside_hybridized_block():
+    """foreach must trace cleanly under hybridize (one scan inside the
+    compiled program)."""
+    from mxnet_tpu import gluon
+
+    class Cum(gluon.nn.HybridBlock):
+        def forward(self, x):
+            outs, _ = npx.foreach(
+                lambda xt, s: (s + xt, s + xt), x,
+                mnp.zeros(x.shape[1:]))
+            return outs
+
+    net = Cum()
+    net.hybridize()
+    x = mnp.array(rs.rand(3, 1, 4).astype("f"))
+    got = net(x).asnumpy()
+    want = onp.cumsum(x.asnumpy(), axis=0)
+    onp.testing.assert_allclose(got, want, rtol=1e-6)

@@ -76,49 +76,6 @@ def test_indexed_recordio_and_pack(tmp_path):
     assert payload == b"payload2"
 
 
-def test_foreach():
-    out, fin = npx.foreach(lambda x, s: (x + s, x + s),
-                           np.arange(5).astype("float32"), np.array(0.0))
-    assert_almost_equal(out, onp.array([0.0, 1, 3, 6, 10]))
-    assert float(fin) == 10.0
-
-
-def test_foreach_grad():
-    x = np.arange(4).astype("float32")
-    x.attach_grad()
-    with mx.autograd.record():
-        out, fin = npx.foreach(lambda xt, s: (xt * s, s + xt), x,
-                               np.array(1.0))
-        L = fin.sum()
-    L.backward()
-    assert_almost_equal(x.grad, onp.ones(4))
-
-
-def test_while_loop_contract():
-    # reference contract: func -> (step_output, new_loop_vars)
-    out, fin = npx.while_loop(
-        cond=lambda i, s: i < 4,
-        func=lambda i, s: (s, (i + 1, s + i)),
-        loop_vars=(np.array(0), np.array(0)),
-        max_iterations=6)
-    # outputs padded to max_iterations
-    assert out.shape == (6,)
-    assert_almost_equal(out.asnumpy()[:4], onp.array([0, 0, 1, 3]))
-    assert int(fin[0]) == 4 and int(fin[1]) == 6
-
-
-def test_while_loop_requires_max_iterations():
-    with pytest.raises(ValueError, match="max_iterations"):
-        npx.while_loop(lambda i: i < 2, lambda i: (i, (i,)),
-                       (np.array(0),))
-
-
-def test_cond():
-    assert float(npx.cond(np.array(True), lambda x: x * 2, lambda x: x * 3,
-                          np.array(4.0))) == 8.0
-    assert float(npx.cond(np.array(False), lambda x: x * 2, lambda x: x * 3,
-                          np.array(4.0))) == 12.0
-
 
 def test_estimator_fit_and_validate(tmp_path):
     mx.seed(0)
