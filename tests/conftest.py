@@ -30,7 +30,7 @@ _SMOKE_MODULES = {
     "test_np_dispatch", "test_image_record", "test_image_det_iter",
     "test_sparse_optimizer", "test_symbol", "test_symbol_register",
     "test_io_estimator", "test_custom_op", "test_resource",
-    "test_op_aliases",
+    "test_op_aliases", "test_control_flow",
 }
 
 
