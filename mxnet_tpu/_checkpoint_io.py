@@ -38,8 +38,10 @@ def async_save_npz(path, arrays):
     Returns immediately; the write runs on an engine IO thread. Call
     wait_for_path(path) (or engine.waitall()) to barrier."""
     from . import engine
+    from ._dtype_codec import encode_payload
 
     path = _key(path)  # bind the directory at save time, not flush time
+    arrays = encode_payload(arrays)  # bf16/f8 -> uint view + dtype sidecar
 
     def write():
         with open(path, "wb") as f:

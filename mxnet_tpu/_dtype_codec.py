@@ -1,0 +1,99 @@
+"""npz round-trip codec for accelerator dtypes (bfloat16, float8*, int4).
+
+numpy's .npz container writes ml_dtypes arrays as raw void records
+(``|V2`` for bfloat16) and loads them back dtype-less, so a framework
+whose native training dtype is bfloat16 could not checkpoint what it
+trains (reference contract: dtype-preserving save/load,
+include/mxnet/ndarray.h:425 — the legacy binary format stores
+``type_flag_`` per blob).
+
+TPU re-design: keep the portable .npz container, store each exotic
+array as a bit-equal unsigned-int view, and record the true dtypes in
+one reserved JSON key (:data:`DTYPE_KEY`). Files with no exotic arrays
+are byte-identical to before, and remain loadable by plain numpy; old
+checkpoints load unchanged (no sidecar key -> no decoding).
+"""
+from __future__ import annotations
+
+import json
+
+import numpy as _np
+
+DTYPE_KEY = "__mx_npz_dtypes__"
+
+# dtypes numpy cannot round-trip through .npy/.npz (registered by
+# ml_dtypes; jax's bfloat16 IS ml_dtypes.bfloat16)
+_EXOTIC = {}
+
+
+def _exotic_map():
+    if not _EXOTIC:
+        import ml_dtypes
+
+        for name in dir(ml_dtypes):
+            if name.startswith(("float", "bfloat", "int", "uint")):
+                try:
+                    _EXOTIC[_np.dtype(getattr(ml_dtypes, name)).name] = (
+                        _np.dtype(getattr(ml_dtypes, name)))
+                except TypeError:
+                    pass  # finfo/iinfo helpers
+    return _EXOTIC
+
+
+def _is_exotic(dt):
+    dt = _np.dtype(dt)
+    return dt.kind == "V" and dt.name in _exotic_map()
+
+
+def _uint_view(dt):
+    return _np.dtype({1: _np.uint8, 2: _np.uint16, 4: _np.uint32}[
+        _np.dtype(dt).itemsize])
+
+
+def encode_payload(arrays):
+    """Return a dict safe for np.savez: exotic arrays become bit-equal
+    uint views and their true dtypes land in the DTYPE_KEY sidecar.
+    Returns the input dict unchanged (same object) when nothing is
+    exotic, so the common f32 path costs one dtype check per array."""
+    if DTYPE_KEY in arrays:
+        raise ValueError(f"{DTYPE_KEY!r} is a reserved checkpoint key")
+    sidecar = {}
+    for k, a in arrays.items():
+        if isinstance(a, _np.ndarray) and _is_exotic(a.dtype):
+            sidecar[k] = a.dtype.name
+    if not sidecar:
+        return arrays
+    out = {}
+    for k, a in arrays.items():
+        out[k] = a.view(_uint_view(a.dtype)) if k in sidecar else a
+    out[DTYPE_KEY] = _np.frombuffer(
+        json.dumps(sidecar).encode("utf-8"), dtype=_np.uint8)
+    return out
+
+
+def decode_entry(name, arr, sidecar):
+    """Restore one array's true dtype given the parsed sidecar dict."""
+    dt_name = sidecar.get(name)
+    if dt_name is None:
+        return arr
+    return _np.asarray(arr).view(_exotic_map()[dt_name])
+
+
+def read_sidecar(npz):
+    """Parse the DTYPE_KEY entry of an open NpzFile (or dict). Returns
+    {} for legacy/plain files."""
+    files = getattr(npz, "files", None)
+    keys = files if files is not None else npz.keys()
+    if DTYPE_KEY not in keys:
+        return {}
+    return json.loads(bytes(npz[DTYPE_KEY]).decode("utf-8"))
+
+
+def decode_npz(npz):
+    """Materialize an open NpzFile (or dict) as {name: ndarray} with true
+    dtypes restored and the sidecar key stripped."""
+    sidecar = read_sidecar(npz)
+    files = getattr(npz, "files", None)
+    keys = files if files is not None else list(npz.keys())
+    return {k: decode_entry(k, npz[k], sidecar)
+            for k in keys if k != DTYPE_KEY}

@@ -259,25 +259,47 @@ class Block:
         if not os.path.exists(path) and os.path.exists(path + ".npz"):
             path = path + ".npz"
             wait_for_path(path)  # the save may have keyed the .npz name
-        loaded = _np.load(path, allow_pickle=False)
+        from .._dtype_codec import decode_npz
+
+        # restore bf16/f8 dtypes from the codec sidecar (npz alone loses
+        # them to raw void records — a bf16-trained net must checkpoint)
+        loaded = decode_npz(_np.load(path, allow_pickle=False))
         params = self._collect_params_with_prefix()
         for name, p in params.items():
-            if name not in loaded.files:
+            if name not in loaded:
                 if not allow_missing:
                     raise KeyError(
                         f"Parameter {name} missing in file {filename}; "
                         "set allow_missing=True to skip")
                 continue
             arr = loaded[name]
+            # dtype contract (reference: parameter.py:286-315 _load_init):
+            # mismatch errors unless cast_dtype=True, which casts saved ->
+            # current (dtype_source='current') or adopts the saved dtype
+            # (dtype_source='saved')
+            if cast_dtype and dtype_source not in ("current", "saved"):
+                raise ValueError(
+                    f"dtype_source must be 'current' or 'saved', got "
+                    f"{dtype_source!r}")
+            if p.dtype is not None and _np.dtype(p.dtype) != arr.dtype:
+                if not cast_dtype:
+                    raise AssertionError(
+                        f"Failed loading Parameter '{name}' from saved "
+                        f"params: dtype incompatible expected {p.dtype} vs "
+                        f"saved {arr.dtype}. Set cast_dtype=True to cast "
+                        "the dtype of saved params.")
+                if dtype_source == "current":
+                    arr = arr.astype(p.dtype, copy=False)
+                else:  # 'saved': retype data AND grad buffers together
+                    p.cast(arr.dtype)
             if p._data_map is None and p._deferred is None:
                 p.shape = arr.shape
                 p.initialize(device=device or current_device())
             elif p._deferred is not None:
                 p._finish_deferred_init(arr.shape)
-            p.set_data(NDArray(jnp.asarray(
-                arr, p.dtype if not cast_dtype else arr.dtype)))
+            p.set_data(NDArray(jnp.asarray(arr, p.dtype)))
         if not ignore_extra:
-            extra = set(loaded.files) - set(params)
+            extra = set(loaded) - set(params)
             if extra:
                 raise KeyError(
                     f"file {filename} contains extra parameters {sorted(extra)}; "
@@ -750,22 +772,29 @@ class SymbolBlock(HybridBlock):
 
             def _resolve(p):
                 # barrier BEFORE the existence probe — an in-flight async
-                # save would otherwise redirect to the wrong path
-                wait_for_path(p)
-                if os.path.exists(p):
-                    return p
-                alt = os.path.join(base, os.path.basename(p))
-                wait_for_path(alt)
-                return alt
+                # save would otherwise redirect to the wrong path. Try the
+                # path as given, its basename next to the symbol file, and
+                # each one's .npz twin (a reference-era caller passes
+                # "net-0000.params"; export writes "net-0000.params.npz").
+                cands = [p, os.path.join(base, os.path.basename(p))]
+                cands += [c + ".npz" for c in cands]
+                for c in cands:
+                    wait_for_path(c)
+                    if os.path.exists(c):
+                        return c
+                return cands[0]
 
             with open(_resolve(meta["stablehlo"]), "rb") as f:
                 exported = jax_export.deserialize(f.read())
-            loaded = _np.load(_resolve(param_file or meta["params"]),
-                              allow_pickle=False)
+            from .._dtype_codec import decode_npz
+
+            loaded = decode_npz(_np.load(
+                _resolve(param_file or meta["params"]),
+                allow_pickle=False))
             object.__setattr__(blk, "_exported", exported)
             object.__setattr__(
                 blk, "_arg_params",
-                {n: jnp.asarray(loaded[n]) for n in loaded.files})
+                {n: jnp.asarray(a) for n, a in loaded.items()})
             object.__setattr__(blk, "_input_names", list(input_names))
             return blk
         if meta and meta.get("format") == "mxnet_tpu-symbol":

@@ -260,7 +260,10 @@ class Parameter:
             data = NDArray(jnp.asarray(data, self.dtype))
         for d in self._ctx_list:
             arr = self._data_map[d]
-            arr._data = jnp.asarray(data._data, arr._data.dtype)
+            # honor the declared dtype, not the old buffer's — load with
+            # dtype_source='saved' retypes the parameter before set_data
+            arr._data = jnp.asarray(
+                data._data, self.dtype or arr._data.dtype)
             arr._version += 1
 
     def zero_grad(self):

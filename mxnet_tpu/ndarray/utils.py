@@ -31,7 +31,16 @@ def save(fname, data):
     """
     if isinstance(data, NDArray):
         if fname.endswith(".npy"):
-            _np.save(fname, _to_np(data))
+            from .._dtype_codec import _is_exotic
+
+            a = _to_np(data)
+            if _is_exotic(a.dtype):
+                # .npy has nowhere to carry the dtype sidecar; numpy would
+                # silently write raw |V2 records and load them dtype-less
+                raise ValueError(
+                    f"dtype {a.dtype.name} cannot round-trip through .npy;"
+                    " save to .npz instead")
+            _np.save(fname, a)
             return
         data = [data]
     if isinstance(data, (list, tuple)):
@@ -54,9 +63,11 @@ def save(fname, data):
 def savez(fname, *args, **kwargs):
     """npx.savez parity: positional arrays stored as arr_0.. like numpy
     (and like numpy, appends .npz when the name has no extension)."""
+    from .._dtype_codec import encode_payload
+
     payload = {f"arr_{i}": _to_np(a) for i, a in enumerate(args)}
     payload.update({k: _to_np(v) for k, v in kwargs.items()})
-    _np.savez(fname, **payload)
+    _np.savez(fname, **encode_payload(payload))
 
 
 def load(fname):
@@ -71,9 +82,12 @@ def load(fname):
     if not os.path.exists(fname) and os.path.exists(fname + ".npz"):
         fname = fname + ".npz"  # np.savez appends .npz when missing
         wait_for_path(fname)
+    from .._dtype_codec import decode_npz
+
     with _np.load(fname) as z:
-        keys = list(z.keys())
+        decoded = decode_npz(z)  # restore bf16/f8 dtypes from the sidecar
+        keys = list(decoded)
         if keys and all(k.startswith(_LIST_PREFIX) for k in keys):
             items = sorted(keys, key=lambda k: int(k[len(_LIST_PREFIX):]))
-            return [array(z[k]) for k in items]
-        return {k: array(z[k]) for k in keys}
+            return [array(decoded[k]) for k in items]
+        return {k: array(v) for k, v in decoded.items()}

@@ -1,0 +1,211 @@
+"""Dtype-preserving checkpoint round-trips (bf16/fp16 across every path).
+
+Reference contract: save/load preserve each blob's dtype
+(include/mxnet/ndarray.h:425 stores type_flag_ per blob; the r3 verdict
+found bf16 — the framework's native training dtype — could not be
+checkpointed through .npz at all). Covers: save_parameters /
+load_parameters, mx.nd.save/load, npx.savez, export → SymbolBlock.imports
+(incl. an AMP-converted model_zoo net and a reference-era ".params"
+filename), and Trainer.save_states/load_states.
+"""
+import numpy as _np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import np as mnp
+from mxnet_tpu.gluon import nn
+
+DTYPES = ["float32", "float16", "bfloat16"]
+
+
+def _np_dt(name):
+    if name == "bfloat16":
+        import ml_dtypes
+
+        return _np.dtype(ml_dtypes.bfloat16)
+    return _np.dtype(name)
+
+
+@pytest.mark.parametrize("dtype", DTYPES)
+def test_save_load_parameters_roundtrip(dtype, tmp_path):
+    net = nn.Dense(5, in_units=3, dtype=dtype)
+    net.initialize()
+    path = str(tmp_path / "dense.params")
+    net.save_parameters(path)
+
+    net2 = nn.Dense(5, in_units=3, dtype=dtype)
+    net2.load_parameters(path)
+    w1 = net.weight.data().asnumpy()
+    w2 = net2.weight.data().asnumpy()
+    assert w1.dtype == _np_dt(dtype)
+    assert w2.dtype == w1.dtype
+    # bit-exact: views over the same-width uint compare with no rounding
+    u = _np.uint16 if w1.dtype.itemsize == 2 else _np.uint32
+    assert _np.array_equal(w1.view(u), w2.view(u))
+
+
+@pytest.mark.parametrize("dtype", DTYPES)
+def test_nd_save_load_dict_and_list(dtype, tmp_path):
+    a = mx.nd.array(_np.arange(6).reshape(2, 3)).astype(dtype)
+    b = mx.nd.array([1.5, -2.25]).astype(dtype)
+    fd = str(tmp_path / "d.npz")
+    mx.nd.save(fd, {"a": a, "b": b})
+    got = mx.nd.load(fd)
+    assert got["a"].dtype == _np_dt(dtype)
+    assert _np.array_equal(got["a"].asnumpy().astype(_np.float32),
+                           a.asnumpy().astype(_np.float32))
+    fl = str(tmp_path / "l.npz")
+    mx.nd.save(fl, [a, b])
+    got = mx.nd.load(fl)
+    assert isinstance(got, list) and got[1].dtype == _np_dt(dtype)
+
+
+def test_npx_savez_bf16(tmp_path):
+    x = mnp.arange(4).astype("bfloat16")
+    f = str(tmp_path / "z")
+    mx.npx.savez(f, x, named=x * 2)
+    loaded = mx.nd.load(f + ".npz")
+    assert loaded["arr_0"].dtype == _np_dt("bfloat16")
+    assert loaded["named"].dtype == _np_dt("bfloat16")
+    assert _np.allclose(loaded["named"].asnumpy().astype(_np.float32),
+                        2 * _np.arange(4))
+
+
+def test_mixed_dtype_file_keeps_plain_arrays_plain(tmp_path):
+    f = str(tmp_path / "mix.npz")
+    mx.nd.save(f, {"w16": mx.nd.array([1, 2]).astype("bfloat16"),
+                   "w32": mx.nd.array([3.0, 4.0]),
+                   "idx": mx.nd.array([1, 2]).astype("int32")})
+    got = mx.nd.load(f)
+    assert got["w16"].dtype == _np_dt("bfloat16")
+    assert got["w32"].dtype == _np.float32
+    assert got["idx"].dtype == _np.int32
+    # plain files written before the codec never get a sidecar; verify a
+    # codec-free file loads through the same path
+    _np.savez(str(tmp_path / "plain.npz"), x=_np.ones(3, _np.float32))
+    got = mx.nd.load(str(tmp_path / "plain.npz"))
+    assert got["x"].dtype == _np.float32
+
+
+def test_load_dtype_mismatch_contract(tmp_path):
+    """Reference parameter.py:286-315: mismatch errors unless cast_dtype;
+    dtype_source picks the surviving dtype."""
+    net = nn.Dense(4, in_units=3, dtype="bfloat16")
+    net.initialize()
+    path = str(tmp_path / "w.params")
+    net.save_parameters(path)
+
+    f32 = nn.Dense(4, in_units=3)
+    f32.initialize()
+    with pytest.raises(AssertionError, match="cast_dtype=True"):
+        f32.load_parameters(path)
+    f32.load_parameters(path, cast_dtype=True, dtype_source="current")
+    assert f32.weight.data().asnumpy().dtype == _np.float32
+    f32b = nn.Dense(4, in_units=3)
+    f32b.initialize()
+    f32b.load_parameters(path, cast_dtype=True, dtype_source="saved")
+    assert f32b.weight.data().asnumpy().dtype == _np_dt("bfloat16")
+    # adopted dtype must survive training: grads retype with the data
+    # (else one optimizer step promotes bf16 x f32 back to f32)
+    from mxnet_tpu import autograd
+
+    tr = mx.gluon.Trainer(f32b.collect_params(), "sgd",
+                          {"learning_rate": 0.1})
+    x = mnp.ones((2, 3), dtype="bfloat16")
+    with autograd.record():
+        loss = f32b(x).sum()
+    loss.backward()
+    tr.step(2)
+    assert f32b.weight.data().asnumpy().dtype == _np_dt("bfloat16")
+
+    with pytest.raises(ValueError, match="dtype_source"):
+        f32b.load_parameters(path, cast_dtype=True, dtype_source="curent")
+
+
+def test_reserved_sidecar_key_rejected_even_without_exotics(tmp_path):
+    from mxnet_tpu._dtype_codec import DTYPE_KEY
+
+    f = str(tmp_path / "bad.npz")
+    with pytest.raises(ValueError, match="reserved"):
+        mx.nd.save(f, {DTYPE_KEY: mx.nd.array([1.0, 2.0])})
+
+
+def test_npy_exotic_dtype_raises_clearly(tmp_path):
+    f = str(tmp_path / "w.npy")
+    with pytest.raises(ValueError, match="npz"):
+        mx.nd.save(f, mx.nd.array([1, 2]).astype("bfloat16"))
+
+
+@pytest.mark.parametrize("dtype", ["float16", "bfloat16"])
+def test_export_imports_roundtrip(dtype, tmp_path):
+    net = nn.HybridSequential()
+    net.add(nn.Dense(8, activation="relu"), nn.Dense(3))
+    net.initialize()
+    net.cast(dtype)
+    x = mnp.ones((2, 4), dtype=dtype)
+    y = net(x)
+    base = str(tmp_path / "net")
+    sym_file, params_file = net.export(base)
+    blk = mx.gluon.SymbolBlock.imports(sym_file, ["data"])
+    y2 = blk(x)
+    assert _np.allclose(y.asnumpy().astype(_np.float32),
+                        y2.asnumpy().astype(_np.float32))
+
+
+def test_imports_accepts_reference_era_params_name(tmp_path):
+    net = nn.Dense(3, in_units=2)
+    net.initialize()
+    x = mnp.ones((1, 2))
+    net(x)
+    base = str(tmp_path / "net")
+    sym_file, _ = net.export(base)
+    # reference-era callers pass "net-0000.params"; we write the .npz twin
+    blk = mx.gluon.SymbolBlock.imports(
+        sym_file, ["data"], param_file=base + "-0000.params")
+    assert _np.allclose(blk(x).asnumpy(), net(x).asnumpy())
+
+
+def test_amp_converted_resnet_export_imports(tmp_path):
+    from mxnet_tpu import amp
+    from mxnet_tpu.gluon.model_zoo import vision
+
+    net = vision.resnet18_v1(pretrained=False)
+    net.initialize()
+    net.hybridize()
+    x = mnp.ones((1, 3, 32, 32))
+    net(x)
+    anet = amp.convert_hybrid_block(net)
+    y = anet(x)
+    base = str(tmp_path / "resnet_amp")
+    sym_file, _ = anet.export(base)
+    blk = mx.gluon.SymbolBlock.imports(sym_file, ["data"])
+    y2 = blk(x)
+    assert _np.allclose(_np.asarray(y.asnumpy(), dtype=_np.float32),
+                        _np.asarray(y2.asnumpy(), dtype=_np.float32),
+                        rtol=1e-2, atol=1e-2)
+
+
+@pytest.mark.parametrize("dtype", ["float32", "bfloat16"])
+def test_trainer_states_roundtrip(dtype, tmp_path):
+    net = nn.Dense(4, in_units=3, dtype=dtype)
+    net.initialize()
+    tr = mx.gluon.Trainer(net.collect_params(), "adam",
+                          {"learning_rate": 1e-2})
+    x = mnp.ones((2, 3), dtype=dtype)
+    with mx.autograd.record():
+        loss = net(x).sum()
+    loss.backward()
+    tr.step(2)
+    f = str(tmp_path / "trainer.states")
+    tr.save_states(f)
+
+    net2 = nn.Dense(4, in_units=3, dtype=dtype)
+    net2.initialize()
+    tr2 = mx.gluon.Trainer(net2.collect_params(), "adam",
+                           {"learning_rate": 1e-2})
+    with mx.autograd.record():
+        loss = net2(x).sum()
+    loss.backward()
+    tr2.step(2)
+    tr2.load_states(f)
+    assert tr2._optimizer.num_update == tr._optimizer.num_update
