@@ -3,9 +3,26 @@
 Reference: src/operator/numpy/linalg/ (`_npi_*` linalg ops backed by
 LAPACK/cuSOLVER) and the `la_op` suite (potrf, gelqf, syrk...). On TPU these
 lower to XLA's decomposition HLOs; MXU handles the inner gemms.
+
+Return conventions follow the REFERENCE docstrings, not numpy's, wherever
+the two differ (python/mxnet/numpy/linalg.py):
+  * svd       -> gesvd convention ``(ut, s, v)`` with ``v: (..., M, N)``,
+                 ``a = ut @ diag(s) @ v`` (linalg.py:729-752) — numpy's
+                 *reduced* SVD, not the full_matrices default.
+  * eigh/eigvalsh take ``upper=False`` (bool), not numpy's UPLO string
+                 (linalg.py:1336,1466).
+  * matrix_rank/pinv take ``rtol``/``hermitian`` per the array-api text
+                 the reference adopted (linalg.py:35,510).
+  * lstsq     accepts the reference default ``rcond='warn'``
+                 (linalg.py:438) and returns numpy-style residuals.
+  * eig/eigvals are real-in/real-out (reference: "Does not support
+                 complex input and output", linalg.py:1398-1447) and run
+                 on the host via pure_callback — the same LAPACK geev
+                 call the reference makes, and TPU-safe under jit.
 """
 from __future__ import annotations
 
+import jax
 import jax.numpy as jnp
 
 from ..ndarray.ndarray import apply_op
@@ -19,31 +36,55 @@ multi_dot matrix_norm vector_norm cross outer matmul trace diagonal
 __all__ = list(_FNS)
 
 
-def _wrap(name):
-    jfn = getattr(jnp.linalg, name)
+def _wrap_fn(name, jfn):
+    """NDArray plumbing around a pure jnp-level function: concrete
+    NDArrays go through apply_op (engine var tracking); tracers and raw
+    arrays call straight through."""
 
     def fn(*args, **kwargs):
         from ..ndarray.ndarray import NDArray
 
-        nd_args = [a for a in args if isinstance(a, NDArray)]
-        if not nd_args:
+        # find NDArrays anywhere in the args tree (multi_dot takes a LIST
+        # of matrices, so a flat positional scan misses them)
+        leaves, treedef = jax.tree_util.tree_flatten(
+            (args, kwargs), is_leaf=lambda x: isinstance(x, NDArray))
+        nd_idx = [i for i, l in enumerate(leaves)
+                  if isinstance(l, NDArray)]
+        if not nd_idx:
             out = jfn(*args, **kwargs)
             if isinstance(out, tuple):
                 return tuple(NDArray(o) for o in out)
             return NDArray(out)
 
         def pure(*xs):
-            it = iter(xs)
-            call = [next(it) if isinstance(a, NDArray) else a for a in args]
-            out = jfn(*call, **kwargs)
+            filled = list(leaves)
+            for i, x in zip(nd_idx, xs):
+                filled[i] = x
+            call_args, call_kwargs = jax.tree_util.tree_unflatten(
+                treedef, filled)
+            out = jfn(*call_args, **call_kwargs)
             return tuple(out) if isinstance(out, tuple) else out
 
-        return apply_op(pure, *nd_args, name=f"linalg.{name}")
+        return apply_op(pure, *[leaves[i] for i in nd_idx],
+                        name=f"linalg.{name}")
 
     fn.__name__ = name
     return fn
 
 
+def _wrap(name):
+    return _wrap_fn(name, getattr(jnp.linalg, name))
+
+
 for _name in _FNS:
     if hasattr(jnp.linalg, _name):
         globals()[_name] = _wrap(_name)
+
+
+# -- reference-convention overrides (see module docstring; pure impls
+# shared with the _npi_* op registry so graph-mode execution matches) ----
+
+from ..ops import np_linalg as _np_linalg  # noqa: E402
+
+for _name in _np_linalg.__all__:
+    globals()[_name] = _wrap_fn(_name, getattr(_np_linalg, _name))

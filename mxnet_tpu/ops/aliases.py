@@ -130,21 +130,25 @@ def _install_round1():
     reg("_npi_rarctan2_scalar", _swap(j.arctan2))
     reg("_npi_rcopysign_scalar", _swap(j.copysign))
 
-    # linalg (src/operator/numpy/linalg/)
+    # linalg (src/operator/numpy/linalg/) — reference-convention impls
+    # shared with mx.np.linalg (ops/np_linalg.py) so graph-resolved
+    # `_npi_svd` etc. match the imperative frontend numerics
+    from . import np_linalg as _npla
+
     la = {
-        "cholesky": jnp.linalg.cholesky, "eig": jnp.linalg.eig,
-        "eigh": jnp.linalg.eigh, "eigvals": jnp.linalg.eigvals,
-        "eigvalsh": jnp.linalg.eigvalsh, "svd": jnp.linalg.svd,
+        "cholesky": jnp.linalg.cholesky, "eig": _npla.eig,
+        "eigh": _npla.eigh, "eigvals": _npla.eigvals,
+        "eigvalsh": _npla.eigvalsh, "svd": _npla.svd,
         "qr": jnp.linalg.qr, "solve": jnp.linalg.solve,
-        "pinv": jnp.linalg.pinv, "lstsq": jnp.linalg.lstsq,
+        "pinv": jnp.linalg.pinv, "lstsq": _npla.lstsq,
         "tensorinv": jnp.linalg.tensorinv,
         "tensorsolve": jnp.linalg.tensorsolve,
-        "matrix_rank": jnp.linalg.matrix_rank, "norm": jnp.linalg.norm,
+        "matrix_rank": _npla.matrix_rank, "norm": jnp.linalg.norm,
     }
     for nm, fn in la.items():
         reg(f"_npi_{nm}", fn)
     reg("_npi_pinv_scalar_rcond", jnp.linalg.pinv)
-    reg("_npi_matrix_rank_none_tol", jnp.linalg.matrix_rank)
+    reg("_npi_matrix_rank_none_tol", _npla.matrix_rank)
 
     # random (src/operator/numpy/random/): stateful frontend fns
     rnd = mxnp.random

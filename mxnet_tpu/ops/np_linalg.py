@@ -1,0 +1,120 @@
+"""Reference-convention numpy-linalg impls, shared by the mx.np.linalg
+frontend AND the `_npi_*` op registry (a hybridized/serialized graph that
+resolves `_npi_svd` must produce the same numerics as the imperative
+call).
+
+Conventions per the reference docstrings (python/mxnet/numpy/linalg.py):
+  * svd (linalg.py:729): gesvd ``(ut, s, v)``, ``v: (..., M, N)`` —
+    numpy's *reduced* SVD, not the full_matrices default.
+  * eigh/eigvalsh (linalg.py:1336,1466): bool ``upper``, triangle
+    actually honored (jnp's symmetrize_input default would average it
+    away).
+  * matrix_rank/pinv (linalg.py:35,510): ``rtol``/``hermitian`` kwargs.
+  * lstsq (linalg.py:438): default ``rcond='warn'`` = legacy
+    machine-eps cutoff (numpy rcond=-1), numpy-style residuals.
+  * eig/eigvals (linalg.py:1398-1447): real-in/real-out host LAPACK
+    geev via pure_callback (TPU-safe under jit); no gradient, like the
+    reference (src/operator/numpy/linalg/np_eig.cc registers no
+    backward) — forward works under autograd, backward raises.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as _onp
+
+__all__ = ["svd", "eigh", "eigvalsh", "matrix_rank", "lstsq",
+           "eig", "eigvals"]
+
+
+def svd(a):
+    return tuple(jnp.linalg.svd(a, full_matrices=False))
+
+
+def eigh(a, upper=False):
+    return tuple(jnp.linalg.eigh(a, UPLO="U" if upper else "L",
+                                 symmetrize_input=False))
+
+
+def eigvalsh(a, upper=False):
+    return jnp.linalg.eigvalsh(a, UPLO="U" if upper else "L",
+                               symmetrize_input=False)
+
+
+def matrix_rank(M, rtol=None, hermitian=False):
+    s = jnp.abs(jnp.linalg.eigvalsh(M)) if hermitian \
+        else jnp.linalg.svdvals(M)
+    if rtol is None:
+        cut = (jnp.max(s, axis=-1, keepdims=True)
+               * max(M.shape[-2:]) * jnp.finfo(s.dtype).eps)
+    else:
+        # array-api allows per-matrix rtol of shape (...,): append the
+        # reduced axis so it broadcasts against s:(..., K)
+        cut = (jnp.max(s, axis=-1, keepdims=True)
+               * jnp.asarray(rtol)[..., None])
+    return jnp.sum(s > cut, axis=-1)
+
+
+def lstsq(a, b, rcond="warn"):
+    if isinstance(rcond, str):
+        rcond = -1  # reference 'warn' default = legacy machine-eps cutoff
+    return tuple(jnp.linalg.lstsq(a, b, rcond=rcond, numpy_resid=True))
+
+
+def _geev(compute_v, a):
+    a = jnp.asarray(a)
+    if not jnp.issubdtype(a.dtype, jnp.floating):
+        a = a.astype(jnp.float32)
+    w_shape = jax.ShapeDtypeStruct(a.shape[:-1], a.dtype)
+    if compute_v:
+        def host(x):
+            w, v = _onp.linalg.eig(_onp.asarray(x))
+            return (w.real.astype(x.dtype), v.real.astype(x.dtype))
+
+        return tuple(jax.pure_callback(
+            host, (w_shape, jax.ShapeDtypeStruct(a.shape, a.dtype)),
+            a, vmap_method="sequential"))
+
+    def host(x):
+        return _onp.linalg.eigvals(_onp.asarray(x)).real.astype(x.dtype)
+
+    return jax.pure_callback(host, w_shape, a, vmap_method="sequential")
+
+
+# custom_vjp so the forward traces under autograd/jax.vjp (pure_callback
+# has no JVP rule); the backward itself raises, matching the reference's
+# missing np_eig gradient.
+@jax.custom_vjp
+def eig(a):
+    return _geev(True, a)
+
+
+def _eig_fwd(a):
+    return eig(a), None
+
+
+def _eig_bwd(_res, _g):
+    raise NotImplementedError(
+        "np.linalg.eig has no gradient (reference np_eig.cc registers "
+        "no backward)")
+
+
+eig.defvjp(_eig_fwd, _eig_bwd)
+
+
+@jax.custom_vjp
+def eigvals(a):
+    return _geev(False, a)
+
+
+def _eigvals_fwd(a):
+    return eigvals(a), None
+
+
+def _eigvals_bwd(_res, _g):
+    raise NotImplementedError(
+        "np.linalg.eigvals has no gradient (reference np_eig.cc "
+        "registers no backward)")
+
+
+eigvals.defvjp(_eigvals_fwd, _eigvals_bwd)

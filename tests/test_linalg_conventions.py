@@ -1,0 +1,271 @@
+"""Return-convention oracles for every mx.np.linalg entry.
+
+The r3 verdict found the blanket jnp delegation silently diverging from
+the reference contract (svd returned numpy's full-matrices (u, s, vh)
+instead of the documented gesvd (ut, s, v) with v:(M, N) — reference
+python/mxnet/numpy/linalg.py:729-752). These tests pin SHAPES and
+conventions, not just values, for all _FNS entries.
+"""
+import numpy as onp
+import pytest
+
+import mxnet_tpu as mx
+
+np = mx.np
+rs = onp.random.RandomState(7)
+
+
+def A(x):
+    return np.array(onp.asarray(x))
+
+
+def N(x):
+    return x.asnumpy() if hasattr(x, "asnumpy") else onp.asarray(x)
+
+
+def _close(a, b, tol=1e-4):
+    onp.testing.assert_allclose(N(a), onp.asarray(b), rtol=tol, atol=tol)
+
+
+# -- svd: the gesvd convention (reference linalg.py:729) ------------------
+
+def test_svd_gesvd_convention_2d():
+    a = rs.rand(6, 9).astype("f")
+    ut, s, v = np.linalg.svd(A(a))
+    assert ut.shape == (6, 6)
+    assert s.shape == (6,)
+    assert v.shape == (6, 9)          # NOT numpy's (9, 9) vh
+    _close(N(ut) @ onp.diag(N(s)) @ N(v), a)
+    # orthonormality: rows of v, columns of ut
+    _close(N(v) @ N(v).T, onp.eye(6), tol=1e-4)
+    _close(N(ut).T @ N(ut), onp.eye(6), tol=1e-4)
+
+
+def test_svd_stacked_mode():
+    a = rs.rand(3, 2, 4, 5).astype("f")
+    ut, s, v = np.linalg.svd(A(a))
+    assert ut.shape == (3, 2, 4, 4)
+    assert s.shape == (3, 2, 4)
+    assert v.shape == (3, 2, 4, 5)
+    _close(N(ut) @ (N(s)[..., None] * N(v)), a)
+
+
+def test_svdvals_descending():
+    a = rs.rand(4, 6).astype("f")
+    s = np.linalg.svdvals(A(a))
+    sn = N(s)
+    assert s.shape == (4,)
+    assert (sn[:-1] >= sn[1:] - 1e-6).all()
+
+
+# -- eigh family: bool `upper`, not numpy's UPLO (linalg.py:1336,1466) ----
+
+def test_eigh_upper_flag():
+    full = rs.rand(5, 5).astype("f")
+    sym = full + full.T
+    lower = onp.tril(sym)
+    upper = onp.triu(sym)
+    w_l, v_l = np.linalg.eigh(A(lower), upper=False)
+    w_u, v_u = np.linalg.eigh(A(upper), upper=True)
+    assert v_l.shape == (5, 5)
+    _close(w_l, onp.linalg.eigvalsh(sym), tol=1e-4)
+    _close(w_u, onp.linalg.eigvalsh(sym), tol=1e-4)
+    # v columns are eigenvectors: sym @ v = v @ diag(w)
+    _close(sym @ N(v_l), N(v_l) * N(w_l)[None, :], tol=1e-3)
+
+
+def test_eigvalsh_upper_flag():
+    full = rs.rand(4, 4).astype("f")
+    sym = full + full.T
+    w = np.linalg.eigvalsh(A(onp.triu(sym)), upper=True)
+    _close(w, onp.linalg.eigvalsh(sym), tol=1e-4)
+
+
+def test_eig_real_in_real_out():
+    """Reference contract: no complex output (linalg.py:1447)."""
+    a = rs.rand(4, 4).astype("f")
+    a = a @ a.T  # real eigenvalues
+    w, v = np.linalg.eig(A(a))
+    assert N(w).dtype == onp.float32 and N(v).dtype == onp.float32
+    assert w.shape == (4,) and v.shape == (4, 4)
+    _close(a @ N(v), N(v) * N(w)[None, :], tol=1e-3)
+
+
+def test_eigvals_real_in_real_out():
+    a = rs.rand(3, 3).astype("f")
+    a = a @ a.T
+    w = np.linalg.eigvals(A(a))
+    assert N(w).dtype == onp.float32
+    _close(onp.sort(N(w)), onp.sort(onp.linalg.eigvalsh(a)), tol=1e-3)
+
+
+# -- lstsq: reference default rcond='warn' (linalg.py:438) ---------------
+
+def test_lstsq_warn_default_and_residuals():
+    a = onp.array([[1.0, 1], [1, 2], [1, 3], [1, 4]], dtype="f")
+    b = onp.array([6.0, 5, 7, 10], dtype="f")
+    x, res, rank, sv = np.linalg.lstsq(A(a), A(b))  # default 'warn'
+    xo, reso, ranko, svo = onp.linalg.lstsq(a, b, rcond=None)
+    _close(x, xo)
+    _close(res, reso)
+    assert int(N(rank)) == ranko
+    assert sv.shape == (2,)
+    # rcond=-1 spelling accepted too
+    x2, *_ = np.linalg.lstsq(A(a), A(b), rcond=-1)
+    _close(x2, xo)
+
+
+def test_lstsq_warn_is_legacy_eps_cutoff():
+    """'warn' = numpy legacy rcond=-1 (machine eps), NOT eps*max(M,N):
+    a singular value between the two cutoffs must survive."""
+    m, n = 60, 50
+    u = onp.linalg.qr(rs.rand(m, m).astype("f"))[0]
+    vt = onp.linalg.qr(rs.rand(n, n).astype("f"))[0]
+    s = onp.linspace(1.0, 0.1, n).astype("f")
+    s[-1] = 3e-7  # > eps*smax but < max(M,N)*eps*smax
+    a = (u[:, :n] * s) @ vt
+    b = rs.rand(m).astype("f")
+    _, _, rank, _ = np.linalg.lstsq(A(a), A(b))
+    assert int(N(rank)) == n  # eps*max(M,N) cutoff would report n-1
+
+
+def test_eig_forward_under_record_backward_raises():
+    """pure_callback has no JVP rule; the custom_vjp wrapper must let the
+    FORWARD trace under autograd (reference runs eig fine under record)
+    and only error if backward reaches it."""
+    from mxnet_tpu import autograd
+
+    a = np.array((rs.rand(3, 3) @ onp.eye(3)).astype("f"))
+    a.attach_grad()
+    with autograd.record():
+        w, v = np.linalg.eig(a)  # must not raise
+        loss = (w * w).sum()
+    with pytest.raises(Exception, match="no gradient|not.*support"):
+        loss.backward()
+
+
+def test_registry_npi_matches_frontend_conventions():
+    """Graph-resolved _npi_* spellings must share the fixed impls."""
+    from mxnet_tpu.ops.registry import get_op
+
+    a = rs.rand(3, 5).astype("f")
+    ut, s, v = get_op("_npi_svd")(A(a)._data)
+    assert ut.shape == (3, 3) and v.shape == (3, 5)
+    full = rs.rand(4, 4).astype("f")
+    sym = full + full.T
+    w = get_op("_npi_eigvalsh")(onp.triu(sym), upper=True)
+    onp.testing.assert_allclose(onp.asarray(w), onp.linalg.eigvalsh(sym),
+                                rtol=1e-4, atol=1e-4)
+
+
+def test_matrix_rank_batched_rtol():
+    mats = onp.stack([onp.eye(4, dtype="f"),
+                      onp.diag(onp.array([1, 1, 1e-8, 1e-8], dtype="f"))])
+    r = np.linalg.matrix_rank(A(mats), rtol=onp.array([1e-6, 1e-6],
+                                                      dtype="f"))
+    assert r.shape == (2,)
+    assert list(N(r)) == [4, 2]
+
+
+def test_lstsq_empty_residuals_when_underdetermined():
+    a = rs.rand(2, 4).astype("f")  # M <= N -> residuals empty
+    b = rs.rand(2).astype("f")
+    _, res, _, _ = np.linalg.lstsq(A(a), A(b))
+    assert res.shape == (0,)
+
+
+# -- matrix_rank / pinv: rtol + hermitian kwargs (linalg.py:35,510) ------
+
+def test_matrix_rank_kwargs():
+    a = rs.rand(5, 3).astype("f")
+    assert int(N(np.linalg.matrix_rank(A(a)))) == 3
+    low = a @ onp.array([[1, 0, 1], [0, 1, 1], [0, 0, 0]], dtype="f")
+    assert int(N(np.linalg.matrix_rank(A(low[:, :2] @ low[:2, :2])))) == 2
+    sym = a.T @ a
+    assert int(N(np.linalg.matrix_rank(A(sym), hermitian=True))) == 3
+    assert int(N(np.linalg.matrix_rank(A(sym), rtol=1e9))) == 0
+
+
+def test_pinv_kwargs_and_shape():
+    a = rs.rand(6, 4).astype("f")
+    p = np.linalg.pinv(A(a), rtol=1e-6)
+    assert p.shape == (4, 6)
+    _close(N(p) @ a @ N(p), N(p), tol=1e-3)
+    sym = a.T @ a
+    _close(np.linalg.pinv(A(sym), hermitian=True), onp.linalg.pinv(sym),
+           tol=1e-3)
+
+
+# -- remaining _FNS: shape/value spot oracles -----------------------------
+
+def test_cholesky_upper():
+    a = rs.rand(4, 4).astype("f")
+    spd = a @ a.T + 4 * onp.eye(4, dtype="f")
+    lo = np.linalg.cholesky(A(spd))
+    _close(N(lo) @ N(lo).T, spd, tol=1e-3)
+    assert onp.allclose(N(lo), onp.tril(N(lo)))
+    up = np.linalg.cholesky(A(spd), upper=True)
+    assert onp.allclose(N(up), onp.triu(N(up)))
+    _close(N(up).T @ N(up), spd, tol=1e-3)
+
+
+def test_qr_reduced():
+    a = rs.rand(6, 4).astype("f")
+    q, r = np.linalg.qr(A(a))
+    assert q.shape == (6, 4) and r.shape == (4, 4)
+    _close(N(q) @ N(r), a, tol=1e-3)
+    assert onp.allclose(N(r), onp.triu(N(r)), atol=1e-5)
+
+
+def test_det_slogdet_inv_solve():
+    a = rs.rand(3, 3).astype("f") + 2 * onp.eye(3, dtype="f")
+    _close(np.linalg.det(A(a)), onp.linalg.det(a), tol=1e-3)
+    sign, logdet = np.linalg.slogdet(A(a))
+    so, lo = onp.linalg.slogdet(a)
+    _close(sign, so)
+    _close(logdet, lo, tol=1e-4)
+    _close(np.linalg.inv(A(a)), onp.linalg.inv(a), tol=1e-3)
+    b = rs.rand(3).astype("f")
+    _close(np.linalg.solve(A(a), A(b)), onp.linalg.solve(a, b), tol=1e-3)
+
+
+def test_norm_family():
+    a = rs.rand(3, 4).astype("f")
+    _close(np.linalg.norm(A(a)), onp.linalg.norm(a))
+    _close(np.linalg.norm(A(a), axis=1), onp.linalg.norm(a, axis=1))
+    _close(np.linalg.matrix_norm(A(a)), onp.linalg.norm(a, "fro"))
+    v = rs.rand(5).astype("f")
+    _close(np.linalg.vector_norm(A(v), ord=1),
+           onp.linalg.norm(v, 1))
+    _close(np.linalg.cond(A(a[:3, :3] + 2 * onp.eye(3, dtype="f"))),
+           onp.linalg.cond(a[:3, :3] + 2 * onp.eye(3)), tol=1e-3)
+
+
+def test_tensorinv_tensorsolve_matrix_power_multidot():
+    a = rs.rand(4, 6, 8, 3).astype("f")
+    ainv = np.linalg.tensorinv(A(a.reshape(24, 24).reshape(4, 6, 8, 3)))
+    assert ainv.shape == (8, 3, 4, 6)
+    b = rs.rand(2, 3, 6).astype("f").reshape(6, 6) + 3 * onp.eye(6, dtype="f")
+    _close(np.linalg.matrix_power(A(b), 3),
+           onp.linalg.matrix_power(b, 3), tol=1e-2)
+    at = rs.rand(2, 2, 2, 2).astype("f") + onp.eye(4, dtype="f").reshape(2, 2, 2, 2)
+    bt = rs.rand(2, 2).astype("f")
+    _close(np.linalg.tensorsolve(A(at), A(bt)),
+           onp.linalg.tensorsolve(at, bt), tol=1e-2)
+    ms = [rs.rand(3, 4).astype("f"), rs.rand(4, 5).astype("f"),
+          rs.rand(5, 2).astype("f")]
+    _close(np.linalg.multi_dot([A(m) for m in ms]),
+           onp.linalg.multi_dot(ms), tol=1e-3)
+
+
+def test_cross_outer_matmul_trace_diagonal():
+    u = rs.rand(3).astype("f")
+    v = rs.rand(3).astype("f")
+    _close(np.linalg.cross(A(u), A(v)), onp.cross(u, v))
+    _close(np.linalg.outer(A(u), A(v)), onp.outer(u, v))
+    a = rs.rand(3, 4).astype("f")
+    b = rs.rand(4, 2).astype("f")
+    _close(np.linalg.matmul(A(a), A(b)), a @ b, tol=1e-4)
+    sq = rs.rand(4, 4).astype("f")
+    _close(np.linalg.trace(A(sq)), onp.trace(sq), tol=1e-4)
+    _close(np.linalg.diagonal(A(sq)), onp.diagonal(sq))
