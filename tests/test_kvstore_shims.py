@@ -1,0 +1,47 @@
+"""Guard contracts for the horovod/byteps adapter shims.
+
+Reference ships working adapters (python/mxnet/kvstore/horovod.py,
+byteps.py) that drive C-handle arrays; neither package has a jax/TPU
+backend, so here the registered classes must ALWAYS raise ImportError
+with porting guidance, and `create()` must fall back to the
+XLA-collective store (kvstore/__init__.py:31-43). These tests pin that
+contract so the shims can never silently become load-bearing.
+"""
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu.kvstore import create
+from mxnet_tpu.kvstore.base import KVStoreBase
+from mxnet_tpu.kvstore.tpu_dist import TPUDist
+
+
+@pytest.mark.parametrize("name", ["horovod", "byteps"])
+def test_adapter_class_always_raises_importerror(name):
+    cls = KVStoreBase.find(name)
+    assert cls is not None, f"{name} must stay registered for find()"
+    with pytest.raises(ImportError, match="tpu_dist"):
+        cls()
+
+
+@pytest.mark.parametrize("name", ["horovod", "byteps", "Horovod"])
+def test_create_falls_back_to_tpu_dist(name, caplog):
+    import logging
+
+    with caplog.at_level(logging.INFO, logger="mxnet_tpu.kvstore"):
+        kv = create(name)
+    assert isinstance(kv, TPUDist)
+
+
+def test_fallback_store_honors_pushpull_contract():
+    kv = create("horovod")
+    a = mx.nd.array([1.0, 2.0, 3.0])
+    out = mx.nd.zeros(3)
+    kv.pushpull("w0", a, out=out)
+    assert out.asnumpy().tolist() == [1.0, 2.0, 3.0]
+
+
+@pytest.mark.parametrize("name", ["dist_async", "dist_async_device"])
+def test_dist_async_maps_to_sync_collective_store(name):
+    """docs/distributed_training.md: async PS is deliberately subsumed by
+    the synchronous XLA-collective store."""
+    assert isinstance(create(name), TPUDist)
