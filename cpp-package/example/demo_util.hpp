@@ -1,0 +1,32 @@
+// Shared helpers for the cpp-package example programs.
+#pragma once
+
+#include <string>
+
+namespace mxtpu_demo {
+
+// Parse first/last entries of {"losses": [...]} out of Model::Fit's raw
+// JSON reply (the examples avoid a JSON dependency on purpose).
+inline double FirstLoss(const std::string& meta) {
+  size_t lb = meta.find('[', meta.find("\"losses\""));
+  return std::stod(meta.substr(lb + 1));
+}
+
+inline double LastLoss(const std::string& meta) {
+  size_t lb = meta.find('[', meta.find("\"losses\""));
+  size_t rb = meta.find(']', lb);
+  size_t comma = meta.rfind(',', rb);
+  if (comma == std::string::npos || comma < lb) comma = lb;
+  return std::stod(meta.substr(comma + 1));
+}
+
+// Checkpoint path for a demo: argv[1] if given (tests pass a tmp dir),
+// else /tmp with a pid suffix so concurrent runs never collide.
+inline std::string ParamsPath(int argc, char** argv,
+                              const std::string& stem) {
+  if (argc > 1) return std::string(argv[1]);
+  return "/tmp/" + stem + "." + std::to_string((long)getpid()) +
+         ".params";
+}
+
+}  // namespace mxtpu_demo

@@ -9,6 +9,7 @@
 //       -L$(python3-config --prefix)/lib -lpython3.12 -o /tmp/train_demo
 //   PYTHONPATH=. JAX_PLATFORMS=cpu /tmp/train_demo
 #include <mxtpu/py_runtime.hpp>
+#include <unistd.h>
 
 #include <cmath>
 #include <cstdio>
@@ -16,20 +17,9 @@
 #include <string>
 #include <vector>
 
-static double FirstLoss(const std::string& meta) {
-  size_t lb = meta.find('[', meta.find("\"losses\""));
-  return std::stod(meta.substr(lb + 1));
-}
+#include "demo_util.hpp"
 
-static double LastLoss(const std::string& meta) {
-  size_t lb = meta.find('[', meta.find("\"losses\""));
-  size_t rb = meta.find(']', lb);
-  size_t comma = meta.rfind(',', rb);
-  if (comma == std::string::npos || comma < lb) comma = lb;
-  return std::stod(meta.substr(comma + 1));
-}
-
-int main() {
+int main(int argc, char** argv) {
   mxtpu::PyRuntime rt;
   mxtpu::Model model(rt, "{\"mlp\": [32], \"classes\": 2}");
 
@@ -53,7 +43,7 @@ int main() {
   y.data.assign((const char*)ys.data(), ys.size() * sizeof(int));
 
   std::string fit1 = model.Fit(x, y, 0.1, 10);
-  double l0 = FirstLoss(fit1), l1 = LastLoss(fit1);
+  double l0 = mxtpu_demo::FirstLoss(fit1), l1 = mxtpu_demo::LastLoss(fit1);
   std::printf("loss %.4f -> %.4f over 10 epochs\n", l0, l1);
   if (!(l1 < l0)) {
     std::printf("FAIL: loss did not decrease\n");
@@ -72,9 +62,11 @@ int main() {
   }
 
   // save / load round trip preserves predictions
-  model.Save("/tmp/mxtpu_cpp_model.npz");
+  std::string params =
+      mxtpu_demo::ParamsPath(argc, argv, "mxtpu_cpp_model");
+  model.Save(params);
   mxtpu::Model loaded(rt, "{\"mlp\": [32], \"classes\": 2}");
-  loaded.Load("/tmp/mxtpu_cpp_model.npz", x);
+  loaded.Load(params, x);
   auto out2 = loaded.Predict(x);
   const float* logits2 = (const float*)out2[0].data.data();
   for (int i = 0; i < n * 2; ++i) {

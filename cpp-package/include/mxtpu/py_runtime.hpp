@@ -224,7 +224,8 @@ class PyRuntime {
 // (reference analog: cpp-package FeedForward / Executor-based training).
 class Model {
  public:
-  // spec_json: {"mlp": [64, 32], "classes": 10} or
+  // spec_json: {"mlp": [64, 32], "classes": 10},
+  //            {"arch": "lenet", "classes": 10} (conv LeNet), or
   //            {"zoo": "resnet18_v1", "classes": 1000}
   Model(PyRuntime& rt, const std::string& spec_json) : rt_(rt) {
     auto r = rt_.CallModel("", "create", {},

@@ -76,7 +76,9 @@ def model_packed(handle, command, blob, meta_json):
 
     Commands (meta/attrs in meta_json, tensors in blob like packed_invoke):
       create  — attrs {"spec": {...}}; returns {"handle": h}.
-                spec: {"mlp": [hidden...,] , "classes": N} or
+                spec: {"mlp": [hidden...,] , "classes": N},
+                      {"arch": "lenet", "classes": N} (the cpp-package
+                      LeNet, reference cpp-package/example/lenet.cpp), or
                       {"zoo": "<model_zoo name>", "classes": N}
       fit     — args x, y; attrs {lr, epochs, optimizer}; returns
                 {"losses": [...]} (one mean loss per epoch).
@@ -117,6 +119,18 @@ def model_packed(handle, command, blob, meta_json):
 
             net = zoo.get_model(spec["zoo"],
                                 classes=spec.get("classes", 1000))
+        elif spec.get("arch") == "lenet":
+            # the cpp-package LeNet (reference cpp-package/example/
+            # lenet.cpp:51-77: conv20-5x5/tanh/pool2, conv50-5x5/tanh/
+            # pool2, fc500/tanh, fc-classes)
+            net = nn.HybridSequential()
+            net.add(nn.Conv2D(20, kernel_size=5, activation="tanh"),
+                    nn.MaxPool2D(pool_size=2, strides=2),
+                    nn.Conv2D(50, kernel_size=5, activation="tanh"),
+                    nn.MaxPool2D(pool_size=2, strides=2),
+                    nn.Flatten(),
+                    nn.Dense(500, activation="tanh"),
+                    nn.Dense(int(spec.get("classes", 10))))
         else:
             net = nn.HybridSequential()
             for width in spec.get("mlp", []):

@@ -9,6 +9,40 @@ import pytest
 REPO = os.path.abspath(os.path.join(os.path.dirname(__file__), ".."))
 
 
+def _build_and_run_embedded(tmp_path, src_name, ok_string,
+                            build_timeout=180, run_timeout=300,
+                            argv=()):
+    """Compile a cpp-package example that embeds CPython and assert its
+    OK marker — the one build recipe all embedded demos share."""
+    import shutil
+    import sysconfig
+
+    if shutil.which("g++") is None:
+        pytest.skip("no C++ toolchain")
+    repo = REPO
+    inc = sysconfig.get_paths()["include"]
+    libdir = sysconfig.get_config_var("LIBDIR")
+    ver = sysconfig.get_config_var("LDVERSION")
+    if not libdir or not ver or not os.path.exists(
+            os.path.join(libdir, f"libpython{ver}.so")):
+        pytest.skip("no shared libpython to embed")
+    exe = str(tmp_path / src_name.replace(".cc", ""))
+    build = subprocess.run(
+        ["g++", "-O2", "-std=c++17",
+         f"{repo}/cpp-package/example/{src_name}",
+         f"-I{repo}/cpp-package/include", f"-I{inc}",
+         f"-L{libdir}", f"-lpython{ver}", "-ldl", "-lm", "-o", exe],
+        capture_output=True, text=True, timeout=build_timeout)
+    assert build.returncode == 0, build.stderr
+    env = dict(os.environ, PYTHONPATH=repo, JAX_PLATFORMS="cpu")
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+    run = subprocess.run([exe, *argv], capture_output=True, text=True,
+                         timeout=run_timeout, env=env)
+    assert run.returncode == 0, run.stdout + run.stderr
+    assert ok_string in run.stdout
+    return run
+
+
 @pytest.fixture(scope="module")
 def libmxtpu():
     so = os.path.join(REPO, "native", "build", "libmxtpu.so")
@@ -72,29 +106,8 @@ def test_packed_function_ffi_cpp_embed(tmp_path):
 
     import pytest
 
-    if shutil.which("g++") is None:
-        pytest.skip("no C++ toolchain")
-    repo = __file__.rsplit("/tests/", 1)[0]
-    inc = sysconfig.get_paths()["include"]
-    libdir = sysconfig.get_config_var("LIBDIR")
-    ver = sysconfig.get_config_var("LDVERSION")
-    if not libdir or not ver or not os.path.exists(
-            os.path.join(libdir, f"libpython{ver}.so")):
-        pytest.skip("no shared libpython to embed")
-    exe = str(tmp_path / "embed_demo")
-    build = subprocess.run(
-        ["g++", "-O2", "-std=c++17",
-         f"{repo}/cpp-package/example/embed_demo.cc",
-         f"-I{repo}/cpp-package/include", f"-I{inc}",
-         f"-L{libdir}", f"-lpython{ver}", "-ldl", "-lm", "-o", exe],
-        capture_output=True, text=True, timeout=180)
-    assert build.returncode == 0, build.stderr
-    env = dict(os.environ, PYTHONPATH=repo, JAX_PLATFORMS="cpu")
-    env.pop("PALLAS_AXON_POOL_IPS", None)
-    run = subprocess.run([exe], capture_output=True, text=True, timeout=180,
-                         env=env)
-    assert run.returncode == 0, run.stdout + run.stderr
-    assert "embed_demo OK" in run.stdout
+    _build_and_run_embedded(tmp_path, "embed_demo.cc", "embed_demo OK",
+                            run_timeout=180)
 
 
 def test_generated_op_header_covers_registry():
@@ -123,29 +136,8 @@ def test_lenet_via_generated_wrappers(tmp_path):
 
     import pytest
 
-    if shutil.which("g++") is None:
-        pytest.skip("no C++ toolchain")
-    repo = __file__.rsplit("/tests/", 1)[0]
-    inc = sysconfig.get_paths()["include"]
-    libdir = sysconfig.get_config_var("LIBDIR")
-    ver = sysconfig.get_config_var("LDVERSION")
-    if not libdir or not ver or not os.path.exists(
-            os.path.join(libdir, f"libpython{ver}.so")):
-        pytest.skip("no shared libpython to embed")
-    exe = str(tmp_path / "lenet_demo")
-    build = subprocess.run(
-        ["g++", "-O2", "-std=c++17",
-         f"{repo}/cpp-package/example/lenet_generated_demo.cc",
-         f"-I{repo}/cpp-package/include", f"-I{inc}",
-         f"-L{libdir}", f"-lpython{ver}", "-ldl", "-lm", "-o", exe],
-        capture_output=True, text=True, timeout=300)
-    assert build.returncode == 0, build.stderr
-    env = dict(os.environ, PYTHONPATH=repo, JAX_PLATFORMS="cpu")
-    env.pop("PALLAS_AXON_POOL_IPS", None)
-    run = subprocess.run([exe], capture_output=True, text=True,
-                         timeout=300, env=env)
-    assert run.returncode == 0, run.stdout + run.stderr
-    assert "all checks passed" in run.stdout
+    _build_and_run_embedded(tmp_path, "lenet_generated_demo.cc",
+                            "all checks passed", build_timeout=300)
 
 
 def test_model_packed_python_side(tmp_path):
@@ -203,33 +195,13 @@ def test_model_packed_python_side(tmp_path):
 def test_cpp_training_demo(tmp_path):
     """Build + run the C++ training demo: full gluon training driven from
     C++ (reference analog: cpp-package FeedForward fit examples)."""
-    import os
-    import shutil
-    import subprocess
-    import sysconfig
+    _build_and_run_embedded(tmp_path, "train_demo.cc", "train_demo OK")
 
-    import pytest
 
-    if shutil.which("g++") is None:
-        pytest.skip("no C++ toolchain")
-    repo = __file__.rsplit("/tests/", 1)[0]
-    inc = sysconfig.get_paths()["include"]
-    libdir = sysconfig.get_config_var("LIBDIR")
-    ver = sysconfig.get_config_var("LDVERSION")
-    if not libdir or not ver or not os.path.exists(
-            os.path.join(libdir, f"libpython{ver}.so")):
-        pytest.skip("no shared libpython to embed")
-    exe = str(tmp_path / "train_demo")
-    build = subprocess.run(
-        ["g++", "-O2", "-std=c++17",
-         f"{repo}/cpp-package/example/train_demo.cc",
-         f"-I{repo}/cpp-package/include", f"-I{inc}",
-         f"-L{libdir}", f"-lpython{ver}", "-ldl", "-lm", "-o", exe],
-        capture_output=True, text=True, timeout=180)
-    assert build.returncode == 0, build.stderr
-    env = dict(os.environ, PYTHONPATH=repo, JAX_PLATFORMS="cpu")
-    env.pop("PALLAS_AXON_POOL_IPS", None)
-    run = subprocess.run([exe], capture_output=True, text=True,
-                         timeout=300, env=env)
-    assert run.returncode == 0, run.stdout + run.stderr
-    assert "train_demo OK" in run.stdout
+def test_cpp_lenet_training_demo(tmp_path):
+    """Build + run the standalone C++ LeNet training example (reference
+    analog: cpp-package/example/lenet.cpp) — conv net trained from C++
+    to loss-decrease, holdout accuracy, save/load round-trip."""
+    _build_and_run_embedded(tmp_path, "lenet_train_demo.cc",
+                            "lenet_train_demo OK", run_timeout=600,
+                            argv=[str(tmp_path / "ckpt.params")])
