@@ -28,8 +28,34 @@ import mxnet_tpu as mx  # noqa: E402
 from mxnet_tpu import autograd, gluon  # noqa: E402
 
 OUTDIR = sys.argv[1]
+MODE = sys.argv[2] if len(sys.argv) > 2 else "train"
 GLOBAL_BATCH = 16
 STEPS = 3
+
+
+def kv_compress_main():
+    """Raw pushpull with 2-bit gradient compression on the cross-process
+    path (reference numeric-aggregate pattern:
+    tests/nightly/dist_sync_kvstore.py test_compressed_kvstore) — two
+    rounds so the error-feedback residual is exercised. The test
+    recomputes the expected aggregate with a local GradientCompression."""
+    from mxnet_tpu import kvstore
+
+    kv = kvstore.create("tpu_dist")
+    kv.set_gradient_compression({"type": "2bit", "threshold": 0.5})
+    rank, nw = kv.rank, kv.num_workers
+    shape = (6, 5)
+    rs = onp.random.RandomState(100 + rank)
+    g1 = rs.uniform(-1.2, 1.2, shape).astype("f")
+    g2 = rs.uniform(-1.2, 1.2, shape).astype("f")
+    out = mx.nd.zeros(shape)
+    kv.pushpull("w", mx.nd.array(g1), out=out)
+    r1 = out.asnumpy().copy()
+    kv.pushpull("w", mx.nd.array(g2), out=out)
+    r2 = out.asnumpy().copy()
+    onp.savez(os.path.join(OUTDIR, f"kv_rank{rank}.npz"),
+              round1=r1, round2=r2, nw=onp.int32(nw))
+    print(f"rank {rank}/{nw} kvcompress done", flush=True)
 
 
 def main():
@@ -69,4 +95,7 @@ def main():
 
 
 if __name__ == "__main__":
-    main()
+    if MODE == "kvcompress":
+        kv_compress_main()
+    else:
+        main()
