@@ -16,6 +16,23 @@ so sparse here is a *storage + communication* format, not a kernel zoo:
 - row_sparse's real role — pushing only touched embedding rows through the
   kvstore — is preserved: kvstore accepts RowSparseNDArray and merges via
   scatter-add (see kvstore row_sparse support).
+
+WHAT IS ACTUALLY SPARSE COMPUTE VS DENSIFIED (read this before assuming
+a memory win — docs/sparse.md has the full table):
+
+  nnz-level compute (no dense materialization of the sparse operand):
+    dot(csr, dense), dot(csr.T, dense), dot(row_sparse, dense),
+    retain, cast_storage to sparse, rsp+rsp / rsp-rsp, the row-sparse
+    lazy-update optimizer path, kvstore push/row_sparse_pull.
+  densifies the sparse operand first (correct, but dense-cost):
+    dot(dense, csr/rsp), multiply/divide with any sparse operand,
+    add/sub mixing csr with anything, slicing a CSR, any generic op
+    reached through .todense() fallbacks.
+
+  So: storage is genuinely compressed; compute is sparse exactly on the
+  embedding/linear-algebra paths listed above and dense everywhere
+  else. At embedding scale the paths that matter (dot, optimizer
+  update, kvstore) stay sparse.
 """
 from __future__ import annotations
 

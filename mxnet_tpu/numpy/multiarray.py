@@ -42,26 +42,61 @@ def _fix_kwargs(kwargs):
     return kwargs
 
 
+def _call_listok(jnp_fn, call_args, call_kwargs):
+    """Call jnp_fn; if it rejects a plain Python list operand (jnp is
+    stricter than numpy/the reference: np.percentile(a, [10, 90]),
+    np.insert(x, [1, 4], vals) are legal there), convert list args to
+    numpy arrays and retry once."""
+    def _plain_list(a):
+        # only lists of plain python/numpy scalars (possibly nested) are
+        # safe to convert — a list holding a traced array must pass
+        # through untouched or _np.asarray would raise/devalue it.
+        # builtins.all: this module's generated `all` shadows the builtin
+        # with mx.np.all, which rejects generators.
+        import builtins
+
+        if not isinstance(a, list):
+            return False
+        return builtins.all(
+            isinstance(v, (int, float, bool, complex, _np.number))
+            or _plain_list(v) for v in a)
+
+    try:
+        return jnp_fn(*call_args, **call_kwargs)
+    except TypeError as e:
+        if "requires ndarray or scalar" not in str(e):
+            raise
+        conv = [_np.asarray(a) if _plain_list(a) else a
+                for a in call_args]
+        kconv = {k: _np.asarray(v) if _plain_list(v) else v
+                 for k, v in call_kwargs.items()}
+        return jnp_fn(*conv, **kconv)
+
+
 def _wrap_jnp(jnp_fn):
     """Make an mx.np function from a jnp function.
 
-    Every NDArray — positional OR keyword — routes through apply_op, so
-    gradients flow the same whether an array argument is spelled
-    positionally or as a keyword (np.average(x, weights=w) tapes w)."""
+    Every NDArray — positional, keyword, OR nested inside a tuple/list
+    argument (ravel_multi_index takes a tuple of index arrays) — routes
+    through apply_op, so gradients flow regardless of spelling."""
 
     @functools.wraps(jnp_fn)
     def wrapped(*args, **kwargs):
         kwargs = _fix_kwargs(dict(kwargs))
-        kw_names = [k for k, v in kwargs.items() if isinstance(v, NDArray)]
-        n_pos = len(args)
+        leaves, treedef = jax.tree_util.tree_flatten(
+            (args, kwargs), is_leaf=lambda x: isinstance(x, NDArray))
+        nd_idx = [i for i, l in enumerate(leaves)
+                  if isinstance(l, NDArray)]
 
-        def fn(*call):
-            kw = dict(kwargs)
-            for k, v in zip(kw_names, call[n_pos:]):
-                kw[k] = v
-            return jnp_fn(*call[:n_pos], **kw)
+        def fn(*xs):
+            filled = list(leaves)
+            for i, x in zip(nd_idx, xs):
+                filled[i] = x
+            call_args, call_kwargs = jax.tree_util.tree_unflatten(
+                treedef, filled)
+            return _call_listok(jnp_fn, call_args, call_kwargs)
 
-        return apply_op(fn, *args, *[kwargs[k] for k in kw_names],
+        return apply_op(fn, *[leaves[i] for i in nd_idx],
                         name=jnp_fn.__name__)
 
     return wrapped
@@ -211,6 +246,28 @@ for _name in ("percentile", "quantile", "nanpercentile", "nanquantile"):
         _g[_name] = _percentile_family(getattr(jnp, _name))
         if _name not in __all__:
             __all__.append(_name)
+
+def _in1d_ref(ar1, ar2, assume_unique=False, invert=False):
+    """numpy-2 dropped in1d; the reference surface keeps it (flat isin,
+    reference multiarray `in1d`)."""
+    del assume_unique  # correctness identical; jnp.isin has no such arg
+    return jnp.isin(jnp.ravel(ar1), ar2, invert=invert)
+
+
+_in1d_ref.__name__ = "in1d"  # tape/profiler op name, not the helper's
+in1d = _wrap_jnp(_in1d_ref)
+__all__.append("in1d")
+
+
+def _ldexp_ref(x1, x2):
+    """Reference semantics (multiarray.py:9785): x1 * 2**x2 with FLOAT
+    exponents allowed — jnp.ldexp rejects non-integer x2. exp2 promotes
+    integer inputs to float like numpy's ldexp."""
+    return jnp.multiply(x1, jnp.exp2(x2))
+
+
+_ldexp_ref.__name__ = "ldexp"  # tape/profiler op name, not the helper's
+ldexp = _wrap_jnp(_ldexp_ref)
 
 concat = _g.get("concatenate")
 

@@ -122,11 +122,14 @@ def conv_transpose(x, weight, bias=None, stride=None, pad=None, dilate=None,
     nd = x.ndim - 2
     stride = stride or (1,) * nd
     pad = pad or (0,) * nd
+    dilate = dilate or (1,) * nd
     output_padding = output_padding or (0,) * nd
     if isinstance(stride, int):
         stride = (stride,) * nd
     if isinstance(pad, int):
         pad = (pad,) * nd
+    if isinstance(dilate, int):
+        dilate = (dilate,) * nd
     if isinstance(output_padding, int):
         output_padding = (output_padding,) * nd
     sp = "DHW"[-nd:]
@@ -139,17 +142,25 @@ def conv_transpose(x, weight, bias=None, stride=None, pad=None, dilate=None,
         x.shape, weight.shape, (lhs_spec, rhs_spec, lhs_spec)
     )
     k = weight.shape[1:-1] if channels_last else weight.shape[2:]
-    # padding for transpose conv: k - 1 - p on both sides, + output_padding low
+    # padding for transpose conv uses the DILATED kernel extent
+    # (k-1)*dilate + 1: eff_k - 1 - p on both sides, + output_padding low
     padding = [
-        (ki - 1 - pi, ki - 1 - pi + opi)
-        for ki, pi, opi in zip(k, pad, output_padding)
+        ((ki - 1) * di - pi, (ki - 1) * di - pi + opi)
+        for ki, pi, di, opi in zip(k, pad, dilate, output_padding)
     ]
+    # the transpose of cross-correlation convolves with the ROT-180 kernel
+    # (reference deconvolution.cc backward-as-forward; conv_general_dilated
+    # itself computes cross-correlation, so flip the spatial dims)
+    spatial_axes = tuple(range(1, 1 + nd)) if channels_last \
+        else tuple(range(2, 2 + nd))
+    weight = jnp.flip(weight, spatial_axes)
     y = lax.conv_general_dilated(
         x,
         weight,
         window_strides=(1,) * nd,
         padding=padding,
         lhs_dilation=tuple(stride),
+        rhs_dilation=tuple(dilate),
         dimension_numbers=dn,
         feature_group_count=groups,
     )
@@ -589,14 +600,32 @@ def dropout(x, key, p=0.5, training=True, axes=None):
 
 
 @register_op("embedding")
-def embedding(indices, weight):
-    """Embedding lookup (reference: tensor/indexing_op.cc Embedding).
+def embedding(indices, weight, input_dim=None, output_dim=None,
+              dtype=None, sparse_grad=False):  # noqa: ARG001
+    """Embedding lookup (reference: tensor/indexing_op.cc Embedding;
+    frontend signature numpy_extension/_op.py:976 carries
+    input_dim/output_dim/dtype/sparse_grad).
 
     Gather on MXU-friendly layout; gradient is a dense scatter-add (the
     reference's row_sparse grad path is deliberately dense here — see
-    ndarray.py module doc on sparse).
+    ndarray.py module doc on sparse). input_dim/output_dim are shape
+    hints validated against the weight; sparse_grad is honored at the
+    gluon layer (Parameter row hints), not here.
     """
-    return jnp.take(weight, indices.astype(jnp.int32), axis=0)
+    if input_dim is not None and weight.shape[0] != input_dim:
+        raise ValueError(
+            f"embedding input_dim {input_dim} != weight rows "
+            f"{weight.shape[0]}")
+    if output_dim is not None and weight.shape[-1] != output_dim:
+        raise ValueError(
+            f"embedding output_dim {output_dim} != weight cols "
+            f"{weight.shape[-1]}")
+    out = jnp.take(weight, indices.astype(jnp.int32), axis=0)
+    if dtype is not None:
+        from ..base import normalize_dtype
+
+        out = out.astype(normalize_dtype(dtype))
+    return out
 
 
 @register_op("one_hot")

@@ -1,0 +1,328 @@
+"""Round-4 tranche of reference numpy-op oracles: elementwise families.
+
+Ported (behavior, not code) from
+/root/reference/tests/python/unittest/test_numpy_op.py — the unary/binary
+edge-case batteries (special values, negative operands, integer
+promotion, scalar paths, gradients on tricky points). Every assert is
+against the live onp oracle.
+"""
+import numpy as onp
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import autograd
+
+np = mx.np
+rs = onp.random.RandomState(42)
+
+
+def A(x):
+    return np.array(onp.asarray(x))
+
+
+def N(x):
+    return x.asnumpy() if hasattr(x, "asnumpy") else onp.asarray(x)
+
+
+def _chk(got, want, tol=1e-5):
+    onp.testing.assert_allclose(N(got), onp.asarray(want), rtol=tol,
+                                atol=tol, equal_nan=True)
+
+
+# -- unary math over special values (reference test_np_unary_funcs) ------
+
+_UNARY_SPECIAL = [
+    # (name, input values) — includes the edges the reference probes
+    ("sqrt", [0.0, 1e-30, 4.0, 1e30]),
+    ("cbrt", [-8.0, -1e-9, 0.0, 27.0]),
+    ("exp", [-745.0, -1.0, 0.0, 700.0]),
+    ("expm1", [-1e-10, 0.0, 1e-10, 2.0]),
+    ("log", [1e-300, 1.0, 2.718281828, 1e300]),
+    ("log2", [0.5, 1.0, 1024.0]),
+    ("log10", [0.001, 1.0, 1000.0]),
+    ("log1p", [-0.5, -1e-12, 0.0, 1e-12]),
+    ("sin", [0.0, onp.pi / 2, onp.pi, 1e4]),
+    ("cos", [0.0, onp.pi, -onp.pi]),
+    ("tan", [0.0, 0.7853981, -0.7853981]),
+    ("arcsin", [-1.0, -0.5, 0.0, 1.0]),
+    ("arccos", [-1.0, 0.0, 1.0]),
+    ("arctan", [-1e30, 0.0, 1e30]),
+    ("sinh", [-2.0, 0.0, 2.0]),
+    ("cosh", [-2.0, 0.0, 2.0]),
+    ("tanh", [-20.0, 0.0, 20.0]),
+    ("arcsinh", [-1e15, 0.0, 1e15]),
+    ("arccosh", [1.0, 1.5, 1e15]),
+    ("arctanh", [-0.999999, 0.0, 0.999999]),
+    ("fabs", [-3.5, -0.0, 3.5]),
+    ("absolute", [-3.5, -0.0, 3.5]),
+    ("sign", [-5.0, -0.0, 0.0, 7.0]),
+    ("floor", [-2.5, -0.5, 0.5, 2.5]),
+    ("ceil", [-2.5, -0.5, 0.5, 2.5]),
+    ("trunc", [-2.9, -0.1, 0.1, 2.9]),
+    ("rint", [-2.5, -1.5, 0.5, 1.5, 2.5]),  # banker's rounding
+    ("reciprocal", [-4.0, 0.25, 2.0]),
+    ("square", [-3.0, 0.0, 1e10]),
+    ("degrees", [0.0, onp.pi, -onp.pi / 2]),
+    ("radians", [0.0, 180.0, -90.0]),
+    ("sinc", [-1.5, -1.0, 0.0, 0.5, 2.0]),
+]
+
+
+@pytest.mark.parametrize("name,vals", _UNARY_SPECIAL,
+                         ids=[n for n, _ in _UNARY_SPECIAL])
+def test_unary_special_values(name, vals):
+    x = onp.array(vals, dtype="f")
+    got = getattr(np, name)(A(x))
+    want = getattr(onp, name)(x)
+    _chk(got, want, tol=2e-5)
+
+
+@pytest.mark.parametrize("name", ["isnan", "isinf", "isfinite",
+                                  "isposinf", "isneginf", "signbit"])
+def test_float_predicates(name):
+    x = onp.array([onp.nan, onp.inf, -onp.inf, -0.0, 0.0, 1.5, -1.5], "f")
+    got = getattr(np, name)(A(x))
+    want = getattr(onp, name)(x)
+    assert N(got).dtype == onp.bool_
+    onp.testing.assert_array_equal(N(got), want)
+
+
+@pytest.mark.parametrize("posinf,neginf,nan",
+                         [(None, None, 0.0), (1e9, -1e9, -1.0),
+                          (None, -7.0, 42.0)])
+def test_nan_to_num_kwargs(posinf, neginf, nan):
+    x = onp.array([onp.nan, onp.inf, -onp.inf, 3.0], "f")
+    got = np.nan_to_num(A(x), nan=nan, posinf=posinf, neginf=neginf)
+    want = onp.nan_to_num(x, nan=nan, posinf=posinf, neginf=neginf)
+    _chk(got, want)
+
+
+# -- binary ops over sign/zero edges (reference test_np_binary_funcs) ----
+
+_BINARY_EDGE = [
+    ("mod", [7.0, -7.0, 7.5], [3.0, 3.0, -2.5]),
+    ("fmod", [7.0, -7.0, 7.5], [3.0, 3.0, -2.5]),
+    ("remainder", [7.0, -7.0, -7.5], [3.0, 3.0, -2.5]),
+    ("floor_divide", [7.0, -7.0, 7.5], [2.0, 2.0, -2.5]),
+    ("copysign", [3.0, -3.0, 0.0], [-1.0, 1.0, -1.0]),
+    ("heaviside", [-1.5, 0.0, 2.0], [0.5, 0.5, 0.5]),
+    ("logaddexp", [-1000.0, 0.0, 1000.0], [-1000.0, 0.0, 999.0]),
+    ("hypot", [3.0, -3.0, 1e20], [4.0, -4.0, 1e20]),
+    ("arctan2", [1.0, -1.0, 0.0, -0.0], [-1.0, -1.0, -1.0, 1.0]),
+    ("maximum", [1.0, onp.nan, 3.0], [2.0, 1.0, onp.nan]),
+    ("minimum", [1.0, onp.nan, 3.0], [2.0, 1.0, onp.nan]),
+    ("fmax", [1.0, onp.nan, 3.0], [2.0, 1.0, onp.nan]),
+    ("fmin", [1.0, onp.nan, 3.0], [2.0, 1.0, onp.nan]),
+    ("ldexp", [1.5, -2.0, 0.5], [3.0, 10.0, -2.0]),
+]
+
+
+@pytest.mark.parametrize("name,a,b", _BINARY_EDGE,
+                         ids=[n for n, _, _ in _BINARY_EDGE])
+def test_binary_edge_values(name, a, b):
+    a = onp.array(a, "f")
+    b = onp.array(b, "f")
+    got = getattr(np, name)(A(a), A(b))
+    if name == "ldexp":
+        # the REFERENCE contract allows float exponents: x1 * 2**x2
+        # (multiarray.py:9785); onp.ldexp itself rejects float x2
+        want = a * 2.0 ** b
+    else:
+        want = getattr(onp, name)(a, b)
+    _chk(got, want)
+
+
+@pytest.mark.parametrize("name", ["mod", "remainder", "floor_divide"])
+def test_binary_negative_integers(name):
+    a = onp.array([7, -7, 6, -6], "i4")
+    b = onp.array([3, 3, -3, -3], "i4")
+    got = getattr(np, name)(A(a), A(b))
+    want = getattr(onp, name)(a, b)
+    onp.testing.assert_array_equal(N(got), want)
+
+
+def test_power_zero_edge():
+    """0**0 == 1, 0**negative == inf (reference test_np_power edges)."""
+    a = onp.array([0.0, 0.0, 2.0, -2.0], "f")
+    b = onp.array([0.0, -1.0, -2.0, 3.0], "f")
+    _chk(np.power(A(a), A(b)), onp.power(a, b))
+
+
+def test_float_power_promotes():
+    a = onp.array([2, 3], "i4")
+    out = np.float_power(A(a), A(onp.array([2, 2], "i4")))
+    assert N(out).dtype == onp.float64 or N(out).dtype == onp.float32
+    _chk(out, [4.0, 9.0])
+
+
+@pytest.mark.parametrize("name", ["gcd", "lcm"])
+def test_integer_gcd_lcm(name):
+    a = onp.array([12, -12, 0, 270], "i4")
+    b = onp.array([20, 20, 5, 192], "i4")
+    got = getattr(np, name)(A(a), A(b))
+    onp.testing.assert_array_equal(N(got), getattr(onp, name)(a, b))
+
+
+@pytest.mark.parametrize("name", ["bitwise_and", "bitwise_or",
+                                  "bitwise_xor", "left_shift",
+                                  "right_shift"])
+def test_bitwise_family(name):
+    a = onp.array([0b1100, 0b1010, 255, 1], "i4")
+    b = onp.array([0b1010, 0b0110, 3, 7], "i4")
+    got = getattr(np, name)(A(a), A(b))
+    onp.testing.assert_array_equal(N(got), getattr(onp, name)(a, b))
+
+
+def test_bitwise_not_and_invert():
+    a = onp.array([0, 1, -1, 255], "i4")
+    onp.testing.assert_array_equal(N(np.bitwise_not(A(a))),
+                                   onp.bitwise_not(a))
+    onp.testing.assert_array_equal(N(np.invert(A(a))), onp.invert(a))
+
+
+# -- gradients at tricky points (reference checks numeric grads) ---------
+
+_GRAD_CASES = [
+    ("sqrt", [0.25, 4.0], lambda x: 0.5 / onp.sqrt(x)),
+    ("log", [0.5, 2.0], lambda x: 1.0 / x),
+    ("reciprocal", [0.5, -2.0], lambda x: -1.0 / x**2),
+    ("square", [-3.0, 3.0], lambda x: 2.0 * x),
+    ("tanh", [-1.0, 1.0], lambda x: 1 - onp.tanh(x) ** 2),
+    ("arctan", [-1.0, 1.0], lambda x: 1 / (1 + x**2)),
+    ("arcsinh", [-1.0, 1.0], lambda x: 1 / onp.sqrt(x**2 + 1)),
+    ("expm1", [-0.5, 0.5], lambda x: onp.exp(x)),
+    ("cbrt", [8.0, 27.0], lambda x: 1.0 / (3 * onp.cbrt(x) ** 2)),
+]
+
+
+@pytest.mark.parametrize("name,pts,dfn", _GRAD_CASES,
+                         ids=[n for n, _, _ in _GRAD_CASES])
+def test_unary_gradient(name, pts, dfn):
+    x = A(onp.array(pts, "f"))
+    x.attach_grad()
+    with autograd.record():
+        y = getattr(np, name)(x)
+    y.backward()
+    _chk(x.grad, dfn(onp.array(pts, "f")), tol=1e-4)
+
+
+def test_binary_broadcast_gradient_reduces():
+    """Grad of a broadcast operand sums over the broadcast axes
+    (reference test_np_binary_broadcast backward)."""
+    a = A(rs.rand(3, 1, 5).astype("f"))
+    b = A(rs.rand(4, 1).astype("f"))
+    a.attach_grad()
+    b.attach_grad()
+    with autograd.record():
+        out = a * b
+    out.backward()
+    assert a.grad.shape == (3, 1, 5)
+    assert b.grad.shape == (4, 1)
+    _chk(a.grad, onp.broadcast_to(N(b), (3, 4, 5)).sum(1, keepdims=True))
+    _chk(b.grad, N(a).sum(axis=(0, 2))[:, None] * onp.ones((4, 1)))
+
+
+def test_where_gradient_routes_by_condition():
+    c = A(onp.array([True, False, True]))
+    a = A(onp.array([1.0, 2.0, 3.0], "f"))
+    b = A(onp.array([10.0, 20.0, 30.0], "f"))
+    a.attach_grad()
+    b.attach_grad()
+    with autograd.record():
+        out = np.where(c, a, b)
+    out.backward()
+    onp.testing.assert_array_equal(N(a.grad), [1.0, 0.0, 1.0])
+    onp.testing.assert_array_equal(N(b.grad), [0.0, 1.0, 0.0])
+
+
+def test_clip_gradient_zero_outside():
+    x = A(onp.array([-2.0, 0.5, 3.0], "f"))
+    x.attach_grad()
+    with autograd.record():
+        y = np.clip(x, -1.0, 1.0)
+    y.backward()
+    onp.testing.assert_array_equal(N(x.grad), [0.0, 1.0, 0.0])
+
+
+def test_abs_gradient_sign():
+    x = A(onp.array([-2.0, 3.0], "f"))
+    x.attach_grad()
+    with autograd.record():
+        y = np.abs(x)
+    y.backward()
+    onp.testing.assert_array_equal(N(x.grad), [-1.0, 1.0])
+
+
+def test_maximum_gradient_splits_at_tie():
+    a = A(onp.array([1.0, 5.0], "f"))
+    b = A(onp.array([3.0, 2.0], "f"))
+    a.attach_grad()
+    b.attach_grad()
+    with autograd.record():
+        out = np.maximum(a, b)
+    out.backward()
+    onp.testing.assert_array_equal(N(a.grad), [0.0, 1.0])
+    onp.testing.assert_array_equal(N(b.grad), [1.0, 0.0])
+
+
+# -- rounding / comparison kwargs ----------------------------------------
+
+@pytest.mark.parametrize("decimals", [-1, 0, 2])
+def test_around_decimals(decimals):
+    x = onp.array([123.456, -123.456, 0.5, 1.5, 2.675], "f")
+    _chk(np.around(A(x), decimals=decimals), onp.around(x, decimals))
+
+
+def test_isclose_tolerances_and_nan():
+    a = onp.array([1.0, 1.0001, onp.nan, onp.inf], "f")
+    b = onp.array([1.0, 1.0, onp.nan, onp.inf], "f")
+    got = np.isclose(A(a), A(b), rtol=1e-3, atol=0)
+    want = onp.isclose(a, b, rtol=1e-3, atol=0)
+    onp.testing.assert_array_equal(N(got), want)
+    got = np.isclose(A(a), A(b), equal_nan=True)
+    want = onp.isclose(a, b, equal_nan=True)
+    onp.testing.assert_array_equal(N(got), want)
+
+
+def test_allclose_scalar_result():
+    a = rs.rand(4).astype("f")
+    assert bool(np.allclose(A(a), A(a + 1e-9)))
+    assert not bool(np.allclose(A(a), A(a + 1.0)))
+
+
+def test_array_equal_and_equiv():
+    a = onp.arange(4.0)
+    assert bool(np.array_equal(A(a), A(a.copy())))
+    assert not bool(np.array_equal(A(a), A(a[:2])))
+    b = onp.ones((3, 1))
+    c = onp.ones((1, 3))
+    assert bool(np.array_equiv(A(b), A(c)))
+
+
+# -- scalar-operand paths (reference *_scalar op spellings) --------------
+
+@pytest.mark.parametrize("op", ["add", "subtract", "multiply", "divide",
+                                "power", "mod", "maximum", "minimum",
+                                "arctan2", "hypot", "copysign"])
+def test_scalar_rhs_and_lhs(op):
+    x = onp.array([1.5, -2.5, 3.0], "f")
+    got_r = getattr(np, op)(A(x), 2.0)
+    want_r = getattr(onp, op)(x, 2.0)
+    _chk(got_r, want_r)
+    got_l = getattr(np, op)(2.0, A(x))
+    want_l = getattr(onp, op)(2.0, x)
+    _chk(got_l, want_l)
+
+
+def test_true_divide_integer_promotes_to_float():
+    a = onp.array([7, -7], "i4")
+    out = np.true_divide(A(a), 2)
+    assert N(out).dtype.kind == "f"
+    _chk(out, [3.5, -3.5])
+
+
+def test_interp_extrapolation_clamps():
+    xp = onp.array([0.0, 1.0, 2.0], "f")
+    fp = onp.array([0.0, 10.0, 5.0], "f")
+    x = onp.array([-0.5, 0.5, 1.5, 2.5], "f")
+    _chk(np.interp(A(x), A(xp), A(fp)), onp.interp(x, xp, fp))

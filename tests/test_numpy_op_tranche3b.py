@@ -1,0 +1,448 @@
+"""Round-4 tranche of reference numpy-op oracles: reductions + manipulation.
+
+Ported (behavior, not code) from
+/root/reference/tests/python/unittest/test_numpy_op.py — reduction kwargs
+(ddof/dtype/keepdims/nan variants), shape manipulation (split/insert/
+delete/unique/histogram/searchsorted families), and indexing ops. Every
+assert is against the live onp oracle.
+"""
+import numpy as onp
+import pytest
+
+import mxnet_tpu as mx
+
+np = mx.np
+rs = onp.random.RandomState(3)
+
+
+def A(x):
+    return np.array(onp.asarray(x))
+
+
+def N(x):
+    return x.asnumpy() if hasattr(x, "asnumpy") else onp.asarray(x)
+
+
+def _chk(got, want, tol=1e-5):
+    onp.testing.assert_allclose(N(got), onp.asarray(want), rtol=tol,
+                                atol=tol, equal_nan=True)
+
+
+# -- reductions with kwargs ----------------------------------------------
+
+@pytest.mark.parametrize("ddof", [0, 1, 2])
+@pytest.mark.parametrize("name", ["std", "var"])
+def test_std_var_ddof(name, ddof):
+    x = rs.rand(4, 5).astype("f")
+    _chk(getattr(np, name)(A(x), axis=0, ddof=ddof),
+         getattr(onp, name)(x, axis=0, ddof=ddof), tol=1e-4)
+
+
+@pytest.mark.parametrize("name", ["mean", "sum", "prod"])
+def test_reduce_dtype_kwarg(name):
+    x = onp.arange(6, dtype="i4").reshape(2, 3) + 1
+    got = getattr(np, name)(A(x), dtype="float64")
+    want = getattr(onp, name)(x, dtype="float64")
+    assert N(got).dtype.kind == "f"
+    _chk(got, want)
+
+
+@pytest.mark.parametrize("axis", [None, 0, 1, (0, 1)])
+def test_mean_keepdims(axis):
+    x = rs.rand(3, 4).astype("f")
+    _chk(np.mean(A(x), axis=axis, keepdims=True),
+         onp.mean(x, axis=axis, keepdims=True))
+
+
+@pytest.mark.parametrize("name", ["nansum", "nanprod", "nanmean",
+                                  "nanstd", "nanvar", "nanmax", "nanmin"])
+def test_nan_reductions(name):
+    x = rs.rand(3, 4).astype("f")
+    x[0, 1] = onp.nan
+    x[2, 3] = onp.nan
+    _chk(getattr(np, name)(A(x), axis=1),
+         getattr(onp, name)(x, axis=1), tol=1e-4)
+
+
+@pytest.mark.parametrize("name", ["nanargmax", "nanargmin"])
+def test_nan_arg_reductions(name):
+    x = rs.rand(3, 4).astype("f")
+    x[:, 0] = onp.nan  # nan in every row but not a full-nan slice
+    got = getattr(np, name)(A(x), axis=1)
+    onp.testing.assert_array_equal(N(got), getattr(onp, name)(x, axis=1))
+
+
+def test_ptp_axis():
+    x = rs.rand(3, 5).astype("f")
+    _chk(np.ptp(A(x), axis=1), onp.ptp(x, axis=1))
+    _chk(np.ptp(A(x)), onp.ptp(x))
+
+
+@pytest.mark.parametrize("q", [0, 25, 50, 75, 100, [10, 90]])
+def test_percentile_q_shapes(q):
+    x = rs.rand(4, 6).astype("f")
+    _chk(np.percentile(A(x), q, axis=1), onp.percentile(x, q, axis=1),
+         tol=1e-4)
+
+
+def test_median_even_odd():
+    for n in (5, 6):
+        x = rs.rand(n).astype("f")
+        _chk(np.median(A(x)), onp.median(x))
+
+
+def test_average_weights_and_returned():
+    x = rs.rand(3, 4).astype("f")
+    w = rs.rand(3, 4).astype("f")
+    got, wsum = np.average(A(x), axis=0, weights=A(w), returned=True)
+    want, wsum_o = onp.average(x, axis=0, weights=w, returned=True)
+    _chk(got, want, tol=1e-4)
+    _chk(wsum, wsum_o, tol=1e-4)
+
+
+@pytest.mark.parametrize("name", ["cumsum", "cumprod"])
+def test_cumulative_axis_and_flat(name):
+    x = rs.rand(3, 4).astype("f") + 0.5
+    _chk(getattr(np, name)(A(x), axis=1),
+         getattr(onp, name)(x, axis=1), tol=1e-4)
+    _chk(getattr(np, name)(A(x)), getattr(onp, name)(x), tol=1e-4)
+
+
+def test_count_nonzero_axis():
+    x = onp.array([[1, 0, 3], [0, 0, 6]], "i4")
+    onp.testing.assert_array_equal(
+        N(np.count_nonzero(A(x), axis=0)), onp.count_nonzero(x, axis=0))
+    assert int(N(np.count_nonzero(A(x)))) == 3
+
+
+# -- diff / gradient families --------------------------------------------
+
+@pytest.mark.parametrize("n", [1, 2, 3])
+def test_diff_orders(n):
+    x = onp.array([1.0, 4.0, 9.0, 16.0, 25.0, 36.0], "f")
+    _chk(np.diff(A(x), n=n), onp.diff(x, n=n))
+
+
+def test_diff_axis():
+    x = rs.rand(3, 5).astype("f")
+    _chk(np.diff(A(x), axis=0), onp.diff(x, axis=0))
+
+
+def test_ediff1d_to_begin_end():
+    x = onp.array([1.0, 3.0, 6.0, 10.0], "f")
+    _chk(np.ediff1d(A(x)), onp.ediff1d(x))
+    _chk(np.ediff1d(A(x), to_begin=-1.0, to_end=99.0),
+         onp.ediff1d(x, to_begin=-1.0, to_end=99.0))
+
+
+def test_gradient_spacing():
+    x = onp.array([1.0, 2.0, 4.0, 7.0, 11.0], "f")
+    _chk(np.gradient(A(x)), onp.gradient(x))
+    _chk(np.gradient(A(x), 2.0), onp.gradient(x, 2.0))
+
+
+def test_trapezoid_dx_and_x():
+    y = onp.array([1.0, 2.0, 3.0, 4.0], "f")
+    x = onp.array([0.0, 1.0, 3.0, 6.0], "f")
+    _chk(np.trapezoid(A(y), dx=0.5), onp.trapezoid(y, dx=0.5))
+    _chk(np.trapezoid(A(y), x=A(x)), onp.trapezoid(y, x=x))
+
+
+# -- histogram / bincount / searchsorted ---------------------------------
+
+def test_histogram_bins_and_range():
+    x = rs.rand(100).astype("f") * 10
+    h, e = np.histogram(A(x), bins=7, range=(0.0, 10.0))
+    ho, eo = onp.histogram(x, bins=7, range=(0.0, 10.0))
+    onp.testing.assert_array_equal(N(h), ho)
+    _chk(e, eo)
+
+
+def test_histogram_explicit_edges():
+    x = onp.array([0.5, 1.5, 1.5, 2.5, 9.0], "f")
+    edges = onp.array([0.0, 1.0, 2.0, 3.0], "f")
+    h, e = np.histogram(A(x), bins=A(edges))
+    ho, eo = onp.histogram(x, bins=edges)
+    onp.testing.assert_array_equal(N(h), ho)
+
+
+def test_bincount_weights_minlength():
+    x = onp.array([0, 1, 1, 3, 3, 3], "i4")
+    w = onp.array([0.5, 1.0, 1.5, 2.0, 2.5, 3.0], "f")
+    onp.testing.assert_array_equal(N(np.bincount(A(x))), onp.bincount(x))
+    _chk(np.bincount(A(x), weights=A(w), minlength=8),
+         onp.bincount(x, weights=w, minlength=8))
+
+
+@pytest.mark.parametrize("side", ["left", "right"])
+def test_searchsorted_sides(side):
+    a = onp.array([1.0, 2.0, 2.0, 3.0, 5.0], "f")
+    v = onp.array([0.0, 2.0, 2.5, 5.0, 6.0], "f")
+    onp.testing.assert_array_equal(
+        N(np.searchsorted(A(a), A(v), side=side)),
+        onp.searchsorted(a, v, side=side))
+
+
+def test_digitize_right():
+    bins = onp.array([0.0, 1.0, 2.5, 4.0], "f")
+    x = onp.array([-1.0, 0.0, 1.0, 2.6, 4.0, 5.0], "f")
+    for right in (False, True):
+        onp.testing.assert_array_equal(
+            N(np.digitize(A(x), A(bins), right=right)),
+            onp.digitize(x, bins, right=right))
+
+
+# -- unique family --------------------------------------------------------
+
+def test_unique_all_returns():
+    x = onp.array([3, 1, 2, 3, 1, 1, 9], "i4")
+    u, idx, inv, cnt = np.unique(A(x), return_index=True,
+                                 return_inverse=True, return_counts=True)
+    uo, io, vo, co = onp.unique(x, return_index=True, return_inverse=True,
+                                return_counts=True)
+    onp.testing.assert_array_equal(N(u), uo)
+    onp.testing.assert_array_equal(N(idx), io)
+    onp.testing.assert_array_equal(N(inv).ravel(), vo.ravel())
+    onp.testing.assert_array_equal(N(cnt), co)
+
+
+def test_unique_axis0():
+    x = onp.array([[1, 2], [3, 4], [1, 2]], "i4")
+    onp.testing.assert_array_equal(N(np.unique(A(x), axis=0)),
+                                   onp.unique(x, axis=0))
+
+
+@pytest.mark.parametrize("name", ["union1d", "intersect1d", "setdiff1d",
+                                  "setxor1d"])
+def test_set_ops(name):
+    a = onp.array([1, 2, 3, 4, 5], "i4")
+    b = onp.array([3, 4, 5, 6], "i4")
+    onp.testing.assert_array_equal(N(getattr(np, name)(A(a), A(b))),
+                                   getattr(onp, name)(a, b))
+
+
+def test_in1d_isin_invert():
+    a = onp.array([0, 1, 2, 5, 0], "i4")
+    test = onp.array([0, 2], "i4")
+    onp.testing.assert_array_equal(N(np.in1d(A(a), A(test))),
+                                   onp.isin(a, test))
+    onp.testing.assert_array_equal(
+        N(np.isin(A(a), A(test), invert=True)),
+        onp.isin(a, test, invert=True))
+
+
+# -- split / insert / delete / append / resize ---------------------------
+
+def test_array_split_uneven():
+    x = onp.arange(10.0, dtype="f")
+    got = np.array_split(A(x), 3)
+    want = onp.array_split(x, 3)
+    assert len(got) == len(want)
+    for g, w in zip(got, want):
+        onp.testing.assert_array_equal(N(g), w)
+
+
+def test_split_by_indices():
+    x = rs.rand(9, 2).astype("f")
+    got = np.split(A(x), [2, 5], axis=0)
+    want = onp.split(x, [2, 5], axis=0)
+    for g, w in zip(got, want):
+        onp.testing.assert_array_equal(N(g), w)
+
+
+@pytest.mark.parametrize("name,axis", [("hsplit", 1), ("vsplit", 0),
+                                       ("dsplit", 2)])
+def test_xsplit(name, axis):
+    x = rs.rand(4, 4, 4).astype("f")
+    got = getattr(np, name)(A(x), 2)
+    want = getattr(onp, name)(x, 2)
+    for g, w in zip(got, want):
+        onp.testing.assert_array_equal(N(g), w)
+
+
+def test_insert_scalar_slice_array():
+    x = onp.arange(6.0, dtype="f")
+    _chk(np.insert(A(x), 2, 99.0), onp.insert(x, 2, 99.0))
+    _chk(np.insert(A(x), [1, 4], [-1.0, -2.0]),
+         onp.insert(x, [1, 4], [-1.0, -2.0]))
+    m = rs.rand(3, 4).astype("f")
+    _chk(np.insert(A(m), 1, 0.0, axis=1), onp.insert(m, 1, 0.0, axis=1))
+
+
+def test_delete_scalar_slice_array():
+    x = onp.arange(8.0, dtype="f")
+    _chk(np.delete(A(x), 3), onp.delete(x, 3))
+    _chk(np.delete(A(x), [0, 7]), onp.delete(x, [0, 7]))
+    m = rs.rand(3, 4).astype("f")
+    _chk(np.delete(A(m), 2, axis=1), onp.delete(m, 2, axis=1))
+
+
+def test_append_flat_and_axis():
+    a = rs.rand(2, 3).astype("f")
+    b = rs.rand(1, 3).astype("f")
+    _chk(np.append(A(a), A(b), axis=0), onp.append(a, b, axis=0))
+    _chk(np.append(A(a), A(b)), onp.append(a, b))
+
+
+def test_resize_repeats_and_truncates():
+    x = onp.array([1.0, 2.0, 3.0], "f")
+    _chk(np.resize(A(x), (2, 4)), onp.resize(x, (2, 4)))
+    _chk(np.resize(A(x), (2,)), onp.resize(x, (2,)))
+
+
+def test_trim_zeros_modes():
+    x = onp.array([0.0, 0.0, 1.0, 2.0, 0.0, 3.0, 0.0], "f")
+    for mode in ("fb", "f", "b"):
+        onp.testing.assert_array_equal(N(np.trim_zeros(A(x), mode)),
+                                       onp.trim_zeros(x, mode))
+
+
+# -- roll / rot90 / pad / tile / repeat ----------------------------------
+
+@pytest.mark.parametrize("shift,axis", [(2, None), (-3, None), (1, 0),
+                                        ((1, 2), (0, 1))])
+def test_roll(shift, axis):
+    x = onp.arange(12.0, dtype="f").reshape(3, 4)
+    onp.testing.assert_array_equal(N(np.roll(A(x), shift, axis=axis)),
+                                   onp.roll(x, shift, axis=axis))
+
+
+@pytest.mark.parametrize("k", [0, 1, 2, 3, 4, -1])
+def test_rot90(k):
+    x = onp.arange(6.0, dtype="f").reshape(2, 3)
+    onp.testing.assert_array_equal(N(np.rot90(A(x), k)), onp.rot90(x, k))
+
+
+@pytest.mark.parametrize("mode", ["constant", "edge", "reflect", "wrap",
+                                  "symmetric", "maximum", "minimum",
+                                  "mean"])
+def test_pad_modes(mode):
+    x = rs.rand(3, 4).astype("f")
+    kw = {"constant_values": 7.0} if mode == "constant" else {}
+    _chk(np.pad(A(x), ((1, 2), (0, 1)), mode=mode, **kw),
+         onp.pad(x, ((1, 2), (0, 1)), mode=mode, **kw))
+
+
+def test_tile_reps_longer_than_ndim():
+    x = onp.array([[1.0, 2.0]], "f")
+    onp.testing.assert_array_equal(N(np.tile(A(x), (2, 1, 3))),
+                                   onp.tile(x, (2, 1, 3)))
+
+
+@pytest.mark.parametrize("axis", [None, 0, 1])
+def test_repeat_axis(axis):
+    x = onp.arange(6.0, dtype="f").reshape(2, 3)
+    onp.testing.assert_array_equal(N(np.repeat(A(x), 3, axis=axis)),
+                                   onp.repeat(x, 3, axis=axis))
+
+
+def test_flip_multiaxis():
+    x = rs.rand(2, 3, 4).astype("f")
+    for ax in (None, 0, (0, 2)):
+        onp.testing.assert_array_equal(N(np.flip(A(x), axis=ax)),
+                                       onp.flip(x, axis=ax))
+
+
+# -- indexing ops ---------------------------------------------------------
+
+def test_take_along_axis_and_put_along_axis():
+    x = rs.rand(3, 4).astype("f")
+    idx = onp.argsort(x, axis=1)
+    onp.testing.assert_array_equal(
+        N(np.take_along_axis(A(x), A(idx), axis=1)),
+        onp.take_along_axis(x, idx, axis=1))
+
+
+def test_argwhere_and_flatnonzero():
+    x = onp.array([[0, 1], [2, 0]], "i4")
+    onp.testing.assert_array_equal(N(np.argwhere(A(x))), onp.argwhere(x))
+    onp.testing.assert_array_equal(N(np.flatnonzero(A(x))),
+                                   onp.flatnonzero(x))
+
+
+def test_nonzero_tuple():
+    x = onp.array([[3, 0, 0], [0, 4, 0]], "i4")
+    got = np.nonzero(A(x))
+    want = onp.nonzero(x)
+    assert len(got) == 2
+    for g, w in zip(got, want):
+        onp.testing.assert_array_equal(N(g), w)
+
+
+def test_unravel_and_ravel_multi_index():
+    idx = onp.array([1, 5, 11], "i4")
+    got = np.unravel_index(A(idx), (3, 4))
+    want = onp.unravel_index(idx, (3, 4))
+    for g, w in zip(got, want):
+        onp.testing.assert_array_equal(N(g), w)
+    multi = (onp.array([0, 1, 2]), onp.array([1, 2, 3]))
+    onp.testing.assert_array_equal(
+        N(np.ravel_multi_index((A(multi[0]), A(multi[1])), (3, 4))),
+        onp.ravel_multi_index(multi, (3, 4)))
+
+
+def test_triu_tril_k():
+    x = rs.rand(4, 5).astype("f")
+    for k in (-2, 0, 2):
+        onp.testing.assert_array_equal(N(np.triu(A(x), k)), onp.triu(x, k))
+        onp.testing.assert_array_equal(N(np.tril(A(x), k)), onp.tril(x, k))
+
+
+def test_diag_k_and_diagflat():
+    x = rs.rand(4, 4).astype("f")
+    for k in (-1, 0, 2):
+        onp.testing.assert_array_equal(N(np.diag(A(x), k)), onp.diag(x, k))
+    v = onp.array([1.0, 2.0, 3.0], "f")
+    onp.testing.assert_array_equal(N(np.diag(A(v), 1)), onp.diag(v, 1))
+    onp.testing.assert_array_equal(N(np.diagflat(A(v), -1)),
+                                   onp.diagflat(v, -1))
+
+
+def test_meshgrid_indexing_modes():
+    a = onp.array([1.0, 2.0, 3.0], "f")
+    b = onp.array([4.0, 5.0], "f")
+    for indexing in ("xy", "ij"):
+        got = np.meshgrid(A(a), A(b), indexing=indexing)
+        want = onp.meshgrid(a, b, indexing=indexing)
+        for g, w in zip(got, want):
+            onp.testing.assert_array_equal(N(g), w)
+
+
+def test_tensordot_axes_variants():
+    a = rs.rand(3, 4, 5).astype("f")
+    b = rs.rand(4, 5, 6).astype("f")
+    _chk(np.tensordot(A(a), A(b), axes=2), onp.tensordot(a, b, axes=2),
+         tol=1e-4)
+    _chk(np.tensordot(A(a), A(b), axes=([1, 2], [0, 1])),
+         onp.tensordot(a, b, axes=([1, 2], [0, 1])), tol=1e-4)
+
+
+def test_kron():
+    a = onp.array([[1.0, 2.0], [3.0, 4.0]], "f")
+    b = onp.array([[0.0, 1.0]], "f")
+    onp.testing.assert_array_equal(N(np.kron(A(a), A(b))), onp.kron(a, b))
+
+
+@pytest.mark.parametrize("offset", [-1, 0, 1])
+def test_trace_offsets(offset):
+    x = rs.rand(4, 5).astype("f")
+    _chk(np.trace(A(x), offset=offset), onp.trace(x, offset=offset))
+
+
+def test_einsum_paths():
+    a = rs.rand(3, 4).astype("f")
+    b = rs.rand(4, 5).astype("f")
+    c = rs.rand(5, 2).astype("f")
+    _chk(np.einsum("ij,jk,kl->il", A(a), A(b), A(c)),
+         onp.einsum("ij,jk,kl->il", a, b, c), tol=1e-4)
+    sq = rs.rand(4, 4).astype("f")
+    _chk(np.einsum("ii->i", A(sq)), onp.einsum("ii->i", sq))
+    _chk(np.einsum("ij->ji", A(a)), a.T)
+
+
+def test_vander_and_tri():
+    v = onp.array([1.0, 2.0, 3.0], "f")
+    onp.testing.assert_array_equal(N(np.vander(A(v), 4)), onp.vander(v, 4))
+    onp.testing.assert_array_equal(
+        N(np.vander(A(v), increasing=True)), onp.vander(v, increasing=True))
+    onp.testing.assert_array_equal(N(np.tri(3, 4, 1)), onp.tri(3, 4, 1))

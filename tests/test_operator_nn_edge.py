@@ -1,0 +1,417 @@
+"""Round-4 tranche of reference NN-operator oracles.
+
+Ported (behavior, not code) from
+/root/reference/tests/python/unittest/test_operator.py — the convolution/
+pooling/norm/activation edge cases (dilate, groups, 1D/3D, include-pad,
+global pool, fix_gamma, axes-dropout...). Values are checked against
+torch-CPU or closed-form oracles; gradients against hand math.
+"""
+import numpy as onp
+import pytest
+import torch
+import torch.nn.functional as F
+
+import mxnet_tpu as mx
+from mxnet_tpu import autograd
+
+np = mx.np
+npx = mx.npx
+rs = onp.random.RandomState(11)
+
+
+def A(x):
+    return np.array(onp.asarray(x))
+
+
+def N(x):
+    return x.asnumpy() if hasattr(x, "asnumpy") else onp.asarray(x)
+
+
+def _chk(got, want, tol=1e-4):
+    onp.testing.assert_allclose(N(got), onp.asarray(want), rtol=tol,
+                                atol=tol)
+
+
+def T(x):
+    return torch.from_numpy(onp.asarray(x))
+
+
+# -- convolution (reference test_convolution_*) ---------------------------
+
+@pytest.mark.parametrize("stride,pad,dilate",
+                         [(1, 0, 1), (2, 1, 1), (1, 2, 2), (2, 0, 2)])
+def test_conv2d_stride_pad_dilate(stride, pad, dilate):
+    x = rs.rand(2, 3, 9, 9).astype("f")
+    w = rs.rand(4, 3, 3, 3).astype("f")
+    got = npx.convolution(A(x), A(w), stride=(stride, stride),
+                          pad=(pad, pad), dilate=(dilate, dilate))
+    want = F.conv2d(T(x), T(w), stride=stride, padding=pad,
+                    dilation=dilate).numpy()
+    _chk(got, want, tol=1e-3)
+
+
+@pytest.mark.parametrize("groups", [1, 2, 4])
+def test_conv2d_groups(groups):
+    x = rs.rand(1, 4, 6, 6).astype("f")
+    w = rs.rand(8, 4 // groups, 3, 3).astype("f")
+    b = rs.rand(8).astype("f")
+    got = npx.convolution(A(x), A(w), A(b), groups=groups)
+    want = F.conv2d(T(x), T(w), T(b), groups=groups).numpy()
+    _chk(got, want, tol=1e-3)
+
+
+def test_conv1d_and_conv3d():
+    x1 = rs.rand(2, 3, 12).astype("f")
+    w1 = rs.rand(5, 3, 4).astype("f")
+    got = npx.convolution(A(x1), A(w1), stride=(2,), pad=(1,))
+    want = F.conv1d(T(x1), T(w1), stride=2, padding=1).numpy()
+    _chk(got, want, tol=1e-3)
+
+    x3 = rs.rand(1, 2, 5, 6, 7).astype("f")
+    w3 = rs.rand(3, 2, 2, 3, 3).astype("f")
+    got = npx.convolution(A(x3), A(w3))
+    want = F.conv3d(T(x3), T(w3)).numpy()
+    _chk(got, want, tol=1e-3)
+
+
+def test_conv2d_gradients_match_torch():
+    x = rs.rand(1, 2, 5, 5).astype("f")
+    w = rs.rand(3, 2, 3, 3).astype("f")
+    xa, wa = A(x), A(w)
+    xa.attach_grad()
+    wa.attach_grad()
+    with autograd.record():
+        y = npx.convolution(xa, wa, stride=(1, 1), pad=(1, 1))
+    y.backward()
+    xt = T(x).requires_grad_(True)
+    wt = T(w).requires_grad_(True)
+    F.conv2d(xt, wt, padding=1).sum().backward()
+    _chk(xa.grad, xt.grad.numpy(), tol=1e-3)
+    _chk(wa.grad, wt.grad.numpy(), tol=1e-3)
+
+
+def test_deconvolution_matches_conv_transpose():
+    x = rs.rand(2, 4, 5, 5).astype("f")
+    w = rs.rand(4, 3, 3, 3).astype("f")  # (in, out, kh, kw) mxnet layout
+    got = npx.deconvolution(A(x), A(w), stride=(2, 2), pad=(1, 1))
+    want = F.conv_transpose2d(T(x), T(w), stride=2, padding=1).numpy()
+    _chk(got, want, tol=1e-3)
+
+
+def test_deconvolution_dilated():
+    x = rs.rand(1, 3, 6, 6).astype("f")
+    w = rs.rand(3, 2, 3, 3).astype("f")
+    got = npx.deconvolution(A(x), A(w), stride=(2, 2), pad=(1, 1),
+                            dilate=(2, 2))
+    want = F.conv_transpose2d(T(x), T(w), stride=2, padding=1,
+                              dilation=2).numpy()
+    assert N(got).shape == want.shape
+    _chk(got, want, tol=1e-3)
+
+
+# -- pooling (reference test_pooling_*) -----------------------------------
+
+@pytest.mark.parametrize("include", [True, False])
+def test_avg_pool_count_include_pad(include):
+    x = rs.rand(1, 2, 6, 6).astype("f")
+    got = npx.pooling(A(x), kernel=(3, 3), pool_type="avg",
+                      stride=(2, 2), pad=(1, 1),
+                      count_include_pad=include)
+    want = F.avg_pool2d(T(x), 3, stride=2, padding=1,
+                        count_include_pad=include).numpy()
+    _chk(got, want, tol=1e-4)
+
+
+def test_max_pool_stride_pad():
+    x = rs.rand(2, 3, 7, 7).astype("f")
+    got = npx.pooling(A(x), kernel=(2, 2), pool_type="max", stride=(2, 2),
+                      pad=(1, 1))
+    want = F.max_pool2d(T(x), 2, stride=2, padding=1).numpy()
+    _chk(got, want)
+
+
+def test_global_pooling_ignores_kernel():
+    x = rs.rand(2, 3, 5, 7).astype("f")
+    got = npx.pooling(A(x), kernel=(1, 1), pool_type="avg",
+                      global_pool=True)
+    want = x.mean(axis=(2, 3), keepdims=True)
+    _chk(got, want)
+    got = npx.pooling(A(x), kernel=(1, 1), pool_type="max",
+                      global_pool=True)
+    _chk(got, x.max(axis=(2, 3), keepdims=True))
+
+
+def test_lp_pooling():
+    x = onp.abs(rs.rand(1, 1, 4, 4)).astype("f")
+    got = npx.pooling(A(x), kernel=(2, 2), pool_type="lp", stride=(2, 2))
+    want = F.lp_pool2d(T(x), norm_type=2, kernel_size=2, stride=2).numpy()
+    _chk(got, want, tol=1e-3)
+
+
+def test_pool1d_and_pool3d():
+    x1 = rs.rand(2, 3, 10).astype("f")
+    got = npx.pooling(A(x1), kernel=(3,), pool_type="max", stride=(2,))
+    want = F.max_pool1d(T(x1), 3, stride=2).numpy()
+    _chk(got, want)
+    x3 = rs.rand(1, 2, 4, 4, 4).astype("f")
+    got = npx.pooling(A(x3), kernel=(2, 2, 2), pool_type="avg",
+                      stride=(2, 2, 2))
+    want = F.avg_pool3d(T(x3), 2, stride=2).numpy()
+    _chk(got, want, tol=1e-4)
+
+
+def test_max_pool_gradient_routes_to_argmax():
+    x = onp.array([[[[1.0, 3.0], [2.0, 0.0]]]], "f")
+    xa = A(x)
+    xa.attach_grad()
+    with autograd.record():
+        y = npx.pooling(xa, kernel=(2, 2), pool_type="max")
+    y.backward()
+    onp.testing.assert_array_equal(
+        N(xa.grad), [[[[0.0, 1.0], [0.0, 0.0]]]])
+
+
+# -- dropout (reference test_dropout) ------------------------------------
+
+def test_dropout_p0_identity_and_eval_identity():
+    x = rs.rand(4, 5).astype("f")
+    _chk(npx.dropout(A(x), p=0.0), x)
+    # outside a train-mode record scope dropout is identity
+    _chk(npx.dropout(A(x), p=0.7), x)
+
+
+def test_dropout_training_scales_survivors():
+    mx.seed(7)
+    x = onp.ones((200, 200), "f")
+    with autograd.record(train_mode=True):
+        y = npx.dropout(A(x), p=0.4, mode="training")
+    yn = N(y)
+    kept = yn != 0
+    # survivors are scaled by 1/(1-p)
+    onp.testing.assert_allclose(yn[kept], 1.0 / 0.6, rtol=1e-5)
+    assert abs(kept.mean() - 0.6) < 0.02
+    assert abs(yn.mean() - 1.0) < 0.02  # E[y] == x
+
+
+def test_dropout_axes_broadcast_mask():
+    mx.seed(3)
+    x = onp.ones((8, 16, 10), "f")
+    with autograd.record(train_mode=True):
+        y = npx.dropout(A(x), p=0.5, axes=(0,), mode="training")
+    yn = N(y)
+    # mask broadcast over axis 0: every slice kills the same positions
+    base = yn[0] != 0
+    for i in range(1, 8):
+        onp.testing.assert_array_equal(yn[i] != 0, base)
+
+
+# -- activations (reference test_leaky_relu / activation families) --------
+
+def test_leaky_relu_slope():
+    x = onp.array([-2.0, -0.5, 0.0, 3.0], "f")
+    _chk(npx.leaky_relu(A(x), slope=0.1),
+         onp.where(x > 0, x, 0.1 * x))
+
+
+def test_elu_selu():
+    x = onp.array([-3.0, -1.0, 0.0, 2.0], "f")
+    got = npx.leaky_relu(A(x), act_type="elu", slope=1.5)
+    want = onp.where(x > 0, x, 1.5 * (onp.exp(x) - 1))
+    _chk(got, want)
+    got = npx.leaky_relu(A(x), act_type="selu")
+    alpha, scale = 1.6732632423543772, 1.0507009873554805
+    want = scale * onp.where(x > 0, x, alpha * (onp.exp(x) - 1))
+    _chk(got, want)
+
+
+def test_prelu_gamma_broadcast():
+    x = rs.rand(2, 3, 4).astype("f") - 0.5
+    gamma = onp.array([0.1, 0.2, 0.3], "f")
+    got = npx.leaky_relu(A(x), A(gamma.reshape(1, 3, 1)),
+                         act_type="prelu")
+    want = onp.where(x > 0, x, gamma.reshape(1, 3, 1) * x)
+    _chk(got, want)
+
+
+def test_activation_types():
+    x = onp.array([-2.0, -0.3, 0.0, 1.7], "f")
+    _chk(npx.activation(A(x), "relu"), onp.maximum(x, 0))
+    _chk(npx.activation(A(x), "sigmoid"), 1 / (1 + onp.exp(-x)))
+    _chk(npx.activation(A(x), "tanh"), onp.tanh(x))
+    _chk(npx.activation(A(x), "softsign"), x / (1 + onp.abs(x)))
+    _chk(npx.activation(A(x), "softrelu"), onp.log1p(onp.exp(x)))
+
+
+def test_hard_sigmoid_alpha_beta():
+    x = onp.array([-5.0, -1.0, 0.0, 1.0, 5.0], "f")
+    _chk(npx.hard_sigmoid(A(x), alpha=0.2, beta=0.5),
+         onp.clip(0.2 * x + 0.5, 0, 1))
+
+
+def test_log_sigmoid_and_relu6():
+    x = onp.array([-10.0, 0.0, 3.0, 10.0], "f")
+    _chk(npx.log_sigmoid(A(x)), -onp.log1p(onp.exp(-x)), tol=1e-4)
+    _chk(npx.relu6(A(x)), onp.clip(x, 0, 6))
+
+
+def test_log_softmax_large_values_stable():
+    x = onp.array([[1000.0, 1001.0, 1002.0]], "f")
+    got = N(npx.log_softmax(A(x)))
+    assert onp.isfinite(got).all()
+    want = F.log_softmax(T(x), dim=-1).numpy()
+    _chk(got, want, tol=1e-4)
+
+
+def test_smooth_l1_value_and_grad():
+    sigma = 2.0
+    x = onp.array([-2.0, -0.1, 0.0, 0.05, 3.0], "f")
+    xa = A(x)
+    xa.attach_grad()
+    with autograd.record():
+        y = npx.smooth_l1(xa, scalar=sigma)
+    y.backward()
+    s2 = sigma ** 2
+    want = onp.where(onp.abs(x) < 1 / s2, 0.5 * s2 * x * x,
+                     onp.abs(x) - 0.5 / s2)
+    _chk(y, want)
+    want_g = onp.where(onp.abs(x) < 1 / s2, s2 * x, onp.sign(x))
+    _chk(xa.grad, want_g)
+
+
+# -- norms (reference test_batchnorm / instance / l2 / lrn) ---------------
+
+def test_batch_norm_training_formula_and_running_stats():
+    x = rs.rand(4, 3, 5, 5).astype("f")
+    gamma = rs.rand(3).astype("f")
+    beta = rs.rand(3).astype("f")
+    rm = onp.zeros(3, "f")
+    rv = onp.ones(3, "f")
+    eps, mom = 1e-5, 0.9
+    with autograd.record(train_mode=True):
+        out = npx.batch_norm(A(x), A(gamma), A(beta), A(rm.copy()),
+                             A(rv.copy()), eps=eps, momentum=mom)
+    mean = x.mean(axis=(0, 2, 3))
+    var = x.var(axis=(0, 2, 3))
+    want = ((x - mean[None, :, None, None])
+            / onp.sqrt(var[None, :, None, None] + eps)
+            * gamma[None, :, None, None] + beta[None, :, None, None])
+    _chk(out, want, tol=1e-3)
+
+
+def test_batch_norm_use_global_stats():
+    x = rs.rand(2, 3, 4, 4).astype("f")
+    gamma = onp.ones(3, "f")
+    beta = onp.zeros(3, "f")
+    rm = rs.rand(3).astype("f")
+    rv = (rs.rand(3) + 0.5).astype("f")
+    out = npx.batch_norm(A(x), A(gamma), A(beta), A(rm), A(rv),
+                         eps=1e-5, use_global_stats=True)
+    want = ((x - rm[None, :, None, None])
+            / onp.sqrt(rv[None, :, None, None] + 1e-5))
+    _chk(out, want, tol=1e-3)
+
+
+def test_batch_norm_fix_gamma():
+    """fix_gamma=True treats gamma as 1 regardless of its value
+    (reference batchnorm fix_gamma contract)."""
+    x = rs.rand(2, 3, 4, 4).astype("f")
+    gamma = (rs.rand(3) + 2).astype("f")
+    beta = onp.zeros(3, "f")
+    rm = onp.zeros(3, "f")
+    rv = onp.ones(3, "f")
+    out_fix = npx.batch_norm(A(x), A(gamma), A(beta), A(rm), A(rv),
+                             fix_gamma=True)
+    out_one = npx.batch_norm(A(x), A(onp.ones(3, "f")), A(beta), A(rm),
+                             A(rv), fix_gamma=False)
+    _chk(out_fix, N(out_one), tol=1e-5)
+
+
+def test_instance_norm_formula():
+    x = rs.rand(2, 3, 4, 5).astype("f")
+    gamma = rs.rand(3).astype("f")
+    beta = rs.rand(3).astype("f")
+    got = npx.instance_norm(A(x), A(gamma), A(beta), eps=1e-5)
+    mean = x.mean(axis=(2, 3), keepdims=True)
+    var = x.var(axis=(2, 3), keepdims=True)
+    want = ((x - mean) / onp.sqrt(var + 1e-5)
+            * gamma[None, :, None, None] + beta[None, :, None, None])
+    _chk(got, want, tol=1e-3)
+
+
+def test_group_norm_formula():
+    x = rs.rand(2, 4, 3, 3).astype("f")
+    gamma = rs.rand(4).astype("f")
+    beta = rs.rand(4).astype("f")
+    got = npx.group_norm(A(x), A(gamma), A(beta), num_groups=2, eps=1e-5)
+    want = F.group_norm(T(x), 2, T(gamma), T(beta), eps=1e-5).numpy()
+    _chk(got, want, tol=1e-3)
+
+
+def test_layer_norm_axis():
+    x = rs.rand(2, 3, 4).astype("f")
+    gamma = rs.rand(4).astype("f")
+    beta = rs.rand(4).astype("f")
+    got = npx.layer_norm(A(x), A(gamma), A(beta), axis=-1, eps=1e-5)
+    want = F.layer_norm(T(x), (4,), T(gamma), T(beta), eps=1e-5).numpy()
+    _chk(got, want, tol=1e-3)
+
+
+@pytest.mark.parametrize("mode", ["instance", "channel", "spatial"])
+def test_l2_normalization_modes(mode):
+    x = rs.rand(2, 3, 4, 5).astype("f")
+    got = N(npx.l2_normalization(A(x), mode=mode, eps=1e-10))
+    if mode == "instance":
+        norm = onp.sqrt((x.reshape(2, -1) ** 2).sum(1) + 1e-10)
+        want = x / norm[:, None, None, None]
+    elif mode == "channel":
+        norm = onp.sqrt((x ** 2).sum(1, keepdims=True) + 1e-10)
+        want = x / norm
+    else:
+        norm = onp.sqrt((x ** 2).sum(axis=(2, 3), keepdims=True) + 1e-10)
+        want = x / norm
+    _chk(got, want, tol=1e-4)
+
+
+def test_lrn_formula():
+    x = rs.rand(1, 6, 4, 4).astype("f")
+    nsize, alpha, beta, knorm = 5, 1e-4, 0.75, 2.0
+    got = npx.lrn(A(x), nsize=nsize, alpha=alpha, beta=beta, knorm=knorm)
+    want = F.local_response_norm(T(x), nsize, alpha=alpha, beta=beta,
+                                 k=knorm).numpy()
+    _chk(got, want, tol=1e-4)
+
+
+# -- embedding / one_hot / upsampling ------------------------------------
+
+def test_embedding_lookup_and_grad_accumulates():
+    w = rs.rand(10, 4).astype("f")
+    idx = onp.array([1, 3, 1, 0], "i4")
+    wa = A(w)
+    wa.attach_grad()
+    with autograd.record():
+        out = npx.embedding(A(idx), wa, input_dim=10, output_dim=4)
+    _chk(out, w[idx])
+    out.backward()
+    g = N(wa.grad)
+    onp.testing.assert_allclose(g[1], 2.0 * onp.ones(4), rtol=1e-6)
+    onp.testing.assert_allclose(g[3], onp.ones(4), rtol=1e-6)
+    onp.testing.assert_allclose(g[2], onp.zeros(4), rtol=1e-6)
+
+
+def test_one_hot_on_off_dtype():
+    idx = onp.array([0, 2, 1], "i4")
+    got = npx.one_hot(A(idx), 4, on_value=5.0, off_value=-1.0,
+                      dtype="float64")
+    want = onp.full((3, 4), -1.0)
+    want[onp.arange(3), idx] = 5.0
+    _chk(got, want)
+    got = npx.one_hot(A(onp.array([1, -1], "i4")), 3)
+    # out-of-range index -> all off (reference one_hot clamp-to-off)
+    onp.testing.assert_array_equal(N(got)[1], [0.0, 0.0, 0.0])
+
+
+def test_upsampling_nearest():
+    x = onp.arange(4.0, dtype="f").reshape(1, 1, 2, 2)
+    got = npx.upsampling(A(x), scale=2, sample_type="nearest")
+    want = x.repeat(2, axis=2).repeat(2, axis=3)
+    _chk(got, want)

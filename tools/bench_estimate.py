@@ -7,17 +7,19 @@ backend, reads XLA's cost analysis (FLOPs / bytes accessed), and converts them
 into roofline bounds for a v5e-class chip. It never needs the TPU.
 
 Output: BENCH_ESTIMATE.json with one row per config:
-  flops_per_step     — XLA-counted HLO flops of the compiled step
-  bytes_per_step     — XLA "bytes accessed" (CPU-fusion view; approximate)
-  roofline_ms        — max(flops/PEAK_FLOPS, bytes/HBM_BW) in ms
-  roofline_items_s   — batch / roofline time (upper bound on throughput)
-  items_s_at_50pct_mfu — achievable estimate at 50% MXU utilisation
-  measured_r01_mfu   — MFU implied by the last real on-chip number, where one
-                       exists (BENCH_r01: 2507.6 img/s ResNet-50 b=128 NCHW)
+  flops_per_step       — XLA-counted HLO flops of the compiled step
+  items_s_at_{25,50,75}pct_mfu — throughput ladder from the flop count
+  measured_img_s / measured_mfu — the latest real on-chip number for this
+                         config and the XLA-counted MFU it implies
+  bytes_per_step / roofline_* — ONLY when the analysis ran against a TPU
+                         compilation: CPU "bytes accessed" reflects CPU
+                         fusion and produced bounds BELOW measured TPU
+                         throughput (VERDICT r3 weak #6), so CPU runs
+                         omit the memory-side columns entirely.
 
-Caveats (stated in the artifact): FLOP counts are HLO-level and essentially
-platform-independent; "bytes accessed" comes from the CPU compilation, so TPU
-fusion will differ — the roofline is a bound, not a prediction.
+FLOP counts are HLO-level and essentially platform-independent; that is the
+only cross-platform column, so it (plus measured numbers) is all a CPU run
+reports.
 
 Peak numbers: v5e ~197 TFLOP/s bf16, ~819 GB/s HBM (public chip spec; the
 scaling-book roofline recipe).
@@ -33,8 +35,13 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 PEAK_BF16_FLOPS = 197e12   # v5e
 HBM_BW = 819e9             # v5e bytes/s
-MEASURED_R01 = {"metric": "resnet50_train_bf16_b128_nchw", "img_s": 2507.6,
-                "batch": 128}
+# latest real on-chip numbers per config family (metric, items/s, source)
+MEASURED = {
+    "nchw_train": {"items_s": 2507.6, "source": "BENCH_r01 b=128 NCHW"},
+    "nhwc_train": {"items_s": 2399.4, "source": "BENCH_PROBE_r03 b=256 NHWC"},
+    "nhwc_infer": {"items_s": 13340.1, "source": "BENCH_PROBE_r03 b=256"},
+    "bert": {"items_s": 261.1, "source": "BENCH_PROBE_r03 b=8 s=384"},
+}
 
 
 def _cost(compiled):
@@ -45,31 +52,46 @@ def _cost(compiled):
     return flops, byts
 
 
-def _row(name, batch, flops, byts, extra=None):
+def _row(name, batch, flops, byts, platform, measured=None):
     t_compute = flops / PEAK_BF16_FLOPS
-    t_mem = byts / HBM_BW
-    t_roof = max(t_compute, t_mem)
-    row = {
-        "config": name,
-        "batch": batch,
-        "flops_per_step": flops,
-        "bytes_per_step": byts,
-        "roofline_ms": round(t_roof * 1e3, 3),
-        "bound": "compute" if t_compute >= t_mem else "memory",
-        "roofline_items_s": round(batch / t_roof, 1),
-        "items_s_at_50pct_mfu": round(batch / (t_compute / 0.5), 1)
-        if t_compute > 0 else None,
-    }
-    if extra:
-        row.update(extra)
+    row = {"config": name, "batch": batch, "flops_per_step": flops}
+    for pct in (25, 50, 75):
+        row[f"items_s_at_{pct}pct_mfu"] = round(
+            batch / (t_compute / (pct / 100.0)), 1) if t_compute > 0 else None
+    if platform == "tpu":
+        # memory-side columns only from a TPU executable: CPU bytes
+        # reflect CPU fusion and have bounded below measured throughput
+        t_mem = byts / HBM_BW
+        t_roof = max(t_compute, t_mem)
+        row.update({
+            "bytes_per_step": byts,
+            "roofline_ms": round(t_roof * 1e3, 3),
+            "bound": "compute" if t_compute >= t_mem else "memory",
+            "roofline_items_s": round(batch / t_roof, 1),
+        })
+    if measured and t_compute > 0:
+        flops_per_item = flops / batch
+        row["measured_items_s"] = measured["items_s"]
+        row["measured_mfu"] = round(
+            flops_per_item * measured["items_s"] / PEAK_BF16_FLOPS, 4)
+        row["measured_source"] = measured["source"]
     return row
 
 
 def main():
+    import bench
+
+    # subprocess probe (bench._probe_accelerator): a wedged tunnel HANGS
+    # jax.devices() in-process, and once any backend initializes the
+    # jax_platforms config update below would be a silent no-op — so the
+    # probe must happen out-of-process and the CPU force BEFORE first
+    # in-process device use.
+    platform = bench._probe_accelerator() or "cpu"
     import jax
 
-    jax.config.update("jax_platforms", "cpu")
-    import bench
+    if platform != "tpu":
+        jax.config.update("jax_platforms", "cpu")
+        platform = "cpu"
 
     rows = []
     t0 = time.time()
@@ -83,16 +105,12 @@ def main():
         key = jax.random.PRNGKey(0)
         compiled = step.lower(params, momenta, x, y, key).compile()
         flops, byts = _cost(compiled)
-        extra = {}
-        if layout == "NCHW":
-            # MFU implied by the last real on-chip measurement (r01, b=128 —
-            # flops/img is batch-independent to first order)
-            flops_per_img = flops / batch
-            extra["measured_r01_mfu"] = round(
-                flops_per_img * MEASURED_R01["img_s"] / PEAK_BF16_FLOPS, 4)
-            extra["measured_r01"] = MEASURED_R01
+        # flops/img is batch-independent to first order, so measured
+        # img/s from any batch implies an MFU against this flop count
+        measured = MEASURED["nchw_train" if layout == "NCHW"
+                            else "nhwc_train"]
         rows.append(_row(f"resnet50_train_bf16_b{batch}_{layout.lower()}",
-                         batch, flops, byts, extra))
+                         batch, flops, byts, platform, measured))
 
         if layout == "NHWC":
             import jax.numpy as jnp
@@ -104,23 +122,28 @@ def main():
             compiled_i = jax.jit(predict).lower(params, x).compile()
             fi, bi = _cost(compiled_i)
             rows.append(_row(f"resnet50_infer_bf16_b{batch}_nhwc",
-                             batch, fi, bi))
+                             batch, fi, bi, platform,
+                             MEASURED["nhwc_infer"]))
 
     print("[estimate] building bert qa b=8 s=384", file=sys.stderr)
     bstep, bparams = bench.build_bert_finetune(batch=8, seq=384, donate=False)
     compiled_b = bstep.lower(bparams, jax.random.PRNGKey(0)).compile()
     fb, bb = _cost(compiled_b)
-    rows.append(_row("bert_base_sq384_bf16_finetune_b8", 8, fb, bb))
+    rows.append(_row("bert_base_sq384_bf16_finetune_b8", 8, fb, bb,
+                     platform, MEASURED["bert"]))
 
     artifact = {
         "kind": "xla_cost_model_estimate",
         "peak_bf16_flops": PEAK_BF16_FLOPS,
         "hbm_bytes_per_s": HBM_BW,
         "chip": "v5e-class (public spec)",
-        "caveat": "FLOPs are HLO-level (platform-independent); bytes come "
-                  "from the CPU compilation so TPU fusion differs — roofline "
-                  "is a bound, not a prediction. Shares builders with "
-                  "bench.py so the analysed program IS the benched program.",
+        "analysis_platform": platform,
+        "caveat": "FLOPs are HLO-level (platform-independent). Memory-side "
+                  "columns (bytes/roofline) appear only when the analysis "
+                  "compiled for TPU — CPU-fusion byte counts produced "
+                  "bounds below measured TPU throughput and were dropped "
+                  "(VERDICT r3 weak #6). Shares builders with bench.py so "
+                  "the analysed program IS the benched program.",
         "elapsed_s": round(time.time() - t0, 1),
         "rows": rows,
     }
