@@ -489,6 +489,10 @@ class NDArray:
             return key._data
         if isinstance(key, tuple):
             return tuple(k._data if isinstance(k, NDArray) else k for k in key)
+        if isinstance(key, list):
+            # numpy/reference semantics: a[[0, 2, 3]] is fancy indexing;
+            # jnp rejects raw list indices
+            return _np.asarray(key)
         return key
 
     def __getitem__(self, key):

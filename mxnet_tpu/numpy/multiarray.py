@@ -160,7 +160,7 @@ unique_values
 """.split()
 
 __all__ = ["ndarray", "array", "zeros", "ones", "empty", "full", "arange",
-           "linspace", "logspace", "zeros_like", "ones_like", "full_like",
+           "linspace", "logspace", "geomspace", "zeros_like", "ones_like", "full_like",
            "empty_like", "asarray", "ascontiguousarray", "frombuffer",
            "copy", "may_share_memory", "shares_memory", "astype", "abs",
            "shape", "ndim", "size", "result_type", "can_cast", "promote_types",
@@ -409,6 +409,14 @@ def logspace(start, stop, num=50, endpoint=True, base=10.0, dtype=None,
     dev = _device_of(kwargs)
     out = jnp.logspace(start, stop, num, endpoint=endpoint, base=base,
                        dtype=normalize_dtype(dtype), axis=axis)
+    return NDArray(jax.device_put(out, dev.jax_device), dev)
+
+
+def geomspace(start, stop, num=50, endpoint=True, dtype=None, axis=0,
+              **kwargs):
+    dev = _device_of(kwargs)
+    out = jnp.geomspace(start, stop, num, endpoint=endpoint,
+                        dtype=normalize_dtype(dtype), axis=axis)
     return NDArray(jax.device_put(out, dev.jax_device), dev)
 
 

@@ -15,7 +15,7 @@ from ..ndarray.ndarray import NDArray
 
 __all__ = ["seed", "uniform", "normal", "randn", "rand", "randint", "choice",
            "shuffle", "permutation", "gamma", "beta", "exponential", "poisson",
-           "bernoulli", "binomial", "negative_binomial", "multinomial",
+           "bernoulli", "binomial", "negative_binomial", "multinomial", "dirichlet",
            "multivariate_normal", "laplace", "logistic", "gumbel", "pareto",
            "power", "rayleigh", "weibull", "lognormal", "chisquare", "f",
            "standard_normal", "standard_cauchy", "standard_exponential"]
@@ -154,6 +154,16 @@ def negative_binomial(n, p, size=None, dtype=None):  # noqa: ARG001
     sz = _shape(size)
     lam = jax.random.gamma(key1, n_, sz) * ((1.0 - p_) / p_)
     return NDArray(jax.random.poisson(key2, lam))
+
+
+def dirichlet(alpha, size=None, dtype=None):
+    key = _random.next_key()
+    a = jnp.asarray(_unwrap(alpha))
+    sz = _shape(size)
+    # jax's shape param is the BATCH shape; the event dim is appended
+    out = jax.random.dirichlet(key, a, sz if sz else None)
+    d = normalize_dtype(dtype)
+    return NDArray(out if d is None else out.astype(d))
 
 
 def multinomial(n, pvals, size=None):

@@ -559,3 +559,5 @@ def constraint_check(condition, msg="Constraint violated"):
 
 
 __all__ += ["index_update", "index_add", "nonzero", "constraint_check"]
+
+from . import random  # noqa: F401,E402 - mx.npx.random namespace (last: needs bernoulli et al defined)

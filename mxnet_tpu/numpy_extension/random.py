@@ -1,0 +1,13 @@
+"""mx.npx.random — the numpy_extension random namespace.
+
+Reference: python/mxnet/numpy_extension/random.py:25
+(__all__ = seed, bernoulli, normal_n, uniform_n). The implementations
+live in the npx top level; this module is the reference-spelled
+namespace so `mx.npx.random.bernoulli(...)` scripts port verbatim.
+"""
+from __future__ import annotations
+
+from . import bernoulli, normal_n, uniform_n
+from .._random import seed  # top-level mx.seed wraps this same entry
+
+__all__ = ["seed", "bernoulli", "normal_n", "uniform_n"]

@@ -93,7 +93,7 @@ def _install_round1():
         "cumsum", "deg2rad", "rad2deg", "delete", "diag", "diagflat",
         "diagonal", "diff", "dot", "dsplit", "dstack", "ediff1d",
         "einsum", "eye", "flip", "full", "full_like", "hsplit",
-        "hstack", "identity", "indices", "interp", "kron", "linspace",
+        "hstack", "identity", "indices", "interp", "kron", "linspace", "geomspace",
         "logspace", "log", "matmul", "max", "mean", "min", "moveaxis",
         "nan_to_num", "ones", "pad", "percentile", "polyval", "prod",
         "repeat", "roll", "rollaxis", "rot90", "squeeze", "std", "sum",
@@ -393,8 +393,11 @@ def _install_round2():
     reg("_npi_insert_scalar", raw(getattr(mxnp, "insert", None)))
     reg("_npi_insert_slice", raw(getattr(mxnp, "insert", None)))
     reg("_npi_insert_tensor", raw(getattr(mxnp, "insert", None)))
-    reg("_npi_ldexp_scalar", j.ldexp)
-    reg("_npi_rldexp_scalar", _swap(j.ldexp))
+    # reference ldexp allows FLOAT exponents (x1 * 2**x2) — share the
+    # mx.np impl, not jnp.ldexp which rejects them
+    _ldexp = getattr(mxnp, "ldexp")
+    reg("_npi_ldexp_scalar", raw(_ldexp))
+    reg("_npi_rldexp_scalar", _swap(raw(_ldexp)))
     # reference conventions (symbol/numpy/_symbol.py:7600-7612):
     # lscalar: where(cond, scalar, y) called as (cond, y, scalar);
     # rscalar: where(cond, x, scalar) called as (cond, x, scalar)

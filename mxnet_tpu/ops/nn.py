@@ -14,6 +14,7 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
+from ..base import normalize_dtype
 from .registry import register_op
 
 # ---------------------------------------------------------------------------
@@ -622,8 +623,6 @@ def embedding(indices, weight, input_dim=None, output_dim=None,
             f"{weight.shape[-1]}")
     out = jnp.take(weight, indices.astype(jnp.int32), axis=0)
     if dtype is not None:
-        from ..base import normalize_dtype
-
         out = out.astype(normalize_dtype(dtype))
     return out
 
@@ -649,8 +648,11 @@ def pick(x, index, axis=-1, keepdims=False, mode="clip"):
 
 
 @register_op("topk")
-def topk(x, k=1, axis=-1, ret_typ="indices", is_ascend=False):
-    """Top-k (reference: tensor/ordering_op.cc). Uses lax.top_k on last axis."""
+def topk(x, k=1, axis=-1, ret_typ="indices", is_ascend=False,
+         dtype="float32"):
+    """Top-k (reference: tensor/ordering_op.cc; `dtype` controls the
+    INDEX dtype like the reference frontend). Uses lax.top_k on last
+    axis."""
     xm = jnp.moveaxis(x, axis, -1)
     if is_ascend:
         vals, idx = lax.top_k(-xm, k)
@@ -658,7 +660,7 @@ def topk(x, k=1, axis=-1, ret_typ="indices", is_ascend=False):
     else:
         vals, idx = lax.top_k(xm, k)
     vals = jnp.moveaxis(vals, -1, axis)
-    idx = jnp.moveaxis(idx, -1, axis)
+    idx = jnp.moveaxis(idx, -1, axis).astype(normalize_dtype(dtype))
     if ret_typ == "indices":
         return idx
     if ret_typ == "value":

@@ -9,7 +9,7 @@ into roofline bounds for a v5e-class chip. It never needs the TPU.
 Output: BENCH_ESTIMATE.json with one row per config:
   flops_per_step       — XLA-counted HLO flops of the compiled step
   items_s_at_{25,50,75}pct_mfu — throughput ladder from the flop count
-  measured_img_s / measured_mfu — the latest real on-chip number for this
+  measured_items_s / measured_mfu — the latest real on-chip number for this
                          config and the XLA-counted MFU it implies
   bytes_per_step / roofline_* — ONLY when the analysis ran against a TPU
                          compilation: CPU "bytes accessed" reflects CPU
