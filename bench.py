@@ -98,8 +98,15 @@ def build_resnet_train(layout, batch, donate=True):
               else (batch, 224, 224, 3))
     x = jax.random.normal(rng, xshape, jnp.bfloat16)
     y = jax.random.randint(jax.random.PRNGKey(1), (batch,), 0, 1000)
-    momenta = {n: jnp.zeros_like(a) for n, a in params.items()
-               if n in trainable}
+    # MXTPU_BENCH_MP=1 (default): momentum kept in f32 — the reference's
+    # mp_sgd master-state semantics (r4 HLO audit patch A). bf16 momentum
+    # storage loses ~8 mantissa bits per step AND adds two casts per
+    # param; f32 adds 50 MB of state on a 25M-param net. =0 reverts for
+    # an on-chip A/B.
+    mp = os.environ.get("MXTPU_BENCH_MP", "1") == "1"
+    mom_dtype = jnp.float32 if mp else None
+    momenta = {n: jnp.zeros_like(a, dtype=mom_dtype)
+               for n, a in params.items() if n in trainable}
 
     def train_step(params, momenta, x, y, key):
         def loss_fn(pd):

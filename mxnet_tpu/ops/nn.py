@@ -9,6 +9,7 @@ unnecessary.
 from __future__ import annotations
 
 import functools
+import os
 
 import jax
 import jax.numpy as jnp
@@ -274,6 +275,19 @@ def _bn_shapes(x, axis):
     return reduce_axes, tuple(bshape), n
 
 
+def _bn_ew_dtype(x):
+    """Element-wise dtype for the O(N·H·W·C) BN tensors. Default: f32
+    (today's measured-correct config). MXTPU_BN_COMPUTE=bf16 keeps the
+    big elementwise chains in the activation dtype and promotes only the
+    REDUCTION accumulators to f32 (jnp.sum dtype=) — the r4 HLO audit's
+    staged experiment: the program hands XLA ~2.9k f32 elementwise ops
+    whose only f32-ness is stat math; if any fail to fuse on TPU they
+    double HBM traffic. A/B on chip before changing the default."""
+    if os.environ.get("MXTPU_BN_COMPUTE") == "bf16":
+        return x.dtype
+    return jnp.float32
+
+
 def _bn_train_impl(x, gamma, beta, shift, eps, axis):
     """One reduction pass (sum + sum-of-squares multi-output-fused by XLA,
     reading the activation once) + one fused elementwise normalize.
@@ -284,19 +298,22 @@ def _bn_train_impl(x, gamma, beta, shift, eps, axis):
     E[x²]−E[x]² form once the running mean tracks the data scale
     (var is shift-invariant mathematically)."""
     reduce_axes, bshape, n = _bn_shapes(x, axis)
-    s = lax.stop_gradient(shift.astype(jnp.float32)).reshape(bshape)
-    xf = x.astype(jnp.float32) - s
-    s1 = jnp.sum(xf, reduce_axes)
-    s2 = jnp.sum(xf * xf, reduce_axes)
+    ew = _bn_ew_dtype(x)
+    s = lax.stop_gradient(shift.astype(ew)).reshape(bshape)
+    xf = x.astype(ew) - s
+    # accumulate in f32 regardless of the elementwise dtype
+    xf32 = xf.astype(jnp.float32)
+    s1 = jnp.sum(xf, reduce_axes, dtype=jnp.float32)
+    s2 = jnp.sum(xf32 * xf32, reduce_axes, dtype=jnp.float32)
     mean_c = s1 / n
     var = jnp.maximum(s2 / n - mean_c * mean_c, 0.0)
-    mean = mean_c + s.reshape(s1.shape)
+    mean = mean_c + s.astype(jnp.float32).reshape(s1.shape)
     inv = lax.rsqrt(var + eps)
     scale = (gamma.astype(jnp.float32) * inv).reshape(bshape)
     # xf is already centered on s, so normalize against the centered mean
     offset = (beta.astype(jnp.float32)
               - mean_c * gamma.astype(jnp.float32) * inv).reshape(bshape)
-    out = (xf * scale + offset).astype(x.dtype)
+    out = (xf * scale.astype(ew) + offset.astype(ew)).astype(x.dtype)
     return out, mean, var, inv
 
 
@@ -318,18 +335,30 @@ def _bn_train_bwd(eps, axis, res, cts):
     dy, dmean_ct, dvar_ct = cts
     x, gamma, beta, shift, mean, inv = res
     reduce_axes, bshape, n = _bn_shapes(x, axis)
-    dyf = dy.astype(jnp.float32)
-    xf = x.astype(jnp.float32)
-    xhat = (xf - mean.reshape(bshape)) * inv.reshape(bshape)
-    dbeta = jnp.sum(dyf, reduce_axes)
-    dgamma = jnp.sum(dyf * xhat, reduce_axes)
+    ew = _bn_ew_dtype(x)
+    dyf = dy.astype(ew)
+    # center on the saved shift BEFORE any low-precision subtraction,
+    # like the forward: in bf16 mode, mean.astype(bf16) has granularity
+    # ~mean/256, so (x - mean) directly would wreck xhat for
+    # large-mean activations; (x - shift) - (mean - shift) keeps both
+    # operands on the data's centered scale (mean - shift is computed
+    # in f32 and is small once the moving mean tracks the data)
+    s = lax.stop_gradient(shift.astype(jnp.float32)).reshape(bshape)
+    xf = x.astype(ew) - s.astype(ew)
+    mean_c = (mean.reshape(bshape) - s).astype(ew)
+    xhat = (xf - mean_c) * inv.astype(ew).reshape(bshape)
+    # reductions always accumulate f32 (dtype=), whatever the elementwise
+    dbeta = jnp.sum(dyf, reduce_axes, dtype=jnp.float32)
+    dgamma = jnp.sum(dyf * xhat, reduce_axes, dtype=jnp.float32)
     g32 = gamma.astype(jnp.float32)
-    dx = (g32 * inv).reshape(bshape) * (
-        dyf - (dbeta.reshape(bshape) + xhat * dgamma.reshape(bshape)) / n)
+    dx = (g32 * inv).astype(ew).reshape(bshape) * (
+        dyf - (dbeta.astype(ew).reshape(bshape)
+               + xhat * dgamma.astype(ew).reshape(bshape)) / n)
     # cotangents of the batch-stat outputs (aux moving-stat path; usually
     # zero) — cheap broadcast terms that fuse into the dx pass
-    dx = dx + (dmean_ct.reshape(bshape) / n
-               + dvar_ct.reshape(bshape) * 2.0 * (xf - mean.reshape(bshape)) / n)
+    dx = dx + (dmean_ct.astype(ew).reshape(bshape) / n
+               + dvar_ct.astype(ew).reshape(bshape) * 2.0
+               * (xf - mean_c) / n)
     return (dx.astype(x.dtype), dgamma.astype(gamma.dtype),
             dbeta.astype(beta.dtype), jnp.zeros_like(shift))
 
@@ -660,7 +689,9 @@ def topk(x, k=1, axis=-1, ret_typ="indices", is_ascend=False,
     else:
         vals, idx = lax.top_k(xm, k)
     vals = jnp.moveaxis(vals, -1, axis)
-    idx = jnp.moveaxis(idx, -1, axis).astype(normalize_dtype(dtype))
+    idx = jnp.moveaxis(idx, -1, axis)
+    if dtype is not None:  # None = keep native int32 indices
+        idx = idx.astype(normalize_dtype(dtype))
     if ret_typ == "indices":
         return idx
     if ret_typ == "value":

@@ -228,7 +228,8 @@ argsort = _reg("argsort")(
 def _topk_impl(ins, a):
     return _nn.topk(ins[0], k=a.get("k", 1), axis=a.get("axis", -1),
                     ret_typ=a.get("ret_typ", "indices"),
-                    is_ascend=a.get("is_ascend", False))
+                    is_ascend=a.get("is_ascend", False),
+                    dtype=a.get("dtype", "float32"))
 
 
 register_sym_op("topk", _topk_impl)

@@ -158,3 +158,45 @@ def test_batch_norm_bf16_stats_are_fp32():
                             axis=1, training=True, momentum=0.0)
     want = big.astype("f").mean(axis=(0, 2, 3))
     onp.testing.assert_allclose(onp.asarray(nm), want, rtol=1e-2)
+
+
+def test_bn_bf16_mode_backward_large_mean(monkeypatch):
+    """MXTPU_BN_COMPUTE=bf16 must keep gradients accurate for
+    large-mean activations: the backward centers on the saved shift
+    before any bf16 subtraction (mean.astype(bf16) alone has
+    granularity ~mean/256)."""
+    import numpy as onp
+
+    monkeypatch.setenv("MXTPU_BN_COMPUTE", "bf16")
+    import jax
+    import jax.numpy as jnp
+
+    from mxnet_tpu.ops.nn import batch_norm
+
+    rs = onp.random.RandomState(0)
+    x = (300.0 + rs.randn(8, 16, 4, 4)).astype(onp.float32)
+    gamma = rs.rand(16).astype(onp.float32) + 0.5
+    beta = rs.rand(16).astype(onp.float32)
+    # moving mean tracks the data scale (the shift the fwd/bwd center on)
+    mm = onp.full(16, 300.0, onp.float32)
+    mv = onp.ones(16, onp.float32)
+
+    def loss(xx, g, b):
+        out, _, _ = batch_norm(xx, g, b, jnp.asarray(mm), jnp.asarray(mv),
+                               training=True, axis=1)
+        return jnp.sum(out * out)
+
+    # bf16 activations through the bf16-elementwise path
+    gb = jax.grad(loss, argnums=(0, 1, 2))(
+        jnp.asarray(x, jnp.bfloat16), jnp.asarray(gamma),
+        jnp.asarray(beta))
+    monkeypatch.delenv("MXTPU_BN_COMPUTE")
+    gf = jax.grad(loss, argnums=(0, 1, 2))(
+        jnp.asarray(x), jnp.asarray(gamma), jnp.asarray(beta))
+    # dgamma/dbeta: reduction outputs, must agree to bf16-ish tolerance
+    onp.testing.assert_allclose(
+        onp.asarray(gb[1], onp.float32), onp.asarray(gf[1]),
+        rtol=0.05, atol=0.5)
+    onp.testing.assert_allclose(
+        onp.asarray(gb[2], onp.float32), onp.asarray(gf[2]),
+        rtol=0.05, atol=0.5)
