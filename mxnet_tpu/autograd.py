@@ -299,15 +299,29 @@ def backward(heads, head_grads=None, retain_graph=False, train_mode=True):  # no
         gradbuf._version += 1
 
 
-def _tape_function(heads, variables):
+def _tape_function(heads, variables, promote_leaves=False):
     """Lift the recorded tape into a pure function var_datas -> head_datas.
 
     The functional analog of the reference building a backward NNVM graph
     (src/nnvm/gradient.cc): every reachable TapeNode is replayed through its
     stored pure_fn, with the requested `variables` promoted to function
     arguments and every other leaf bound to its recorded snapshot.
+
+    promote_leaves=True additionally promotes every OTHER grad-requiring,
+    un-mutated leaf to an argument (appended to `variables`; the extended
+    list is returned) — the returned grads algebraically depend on those
+    leaves (d/dx of xW depends on W), and baking them in as constants
+    would silently zero mixed second derivatives. A leaf mutated since
+    recording (its _data no longer IS the snapshot) keeps the snapshot
+    binding — the recorded value is the differentiation point.
+
+    Returns (replay, extended_variables, var_slots) where var_slots maps
+    id(var) -> argument slot (first occurrence wins for duplicates).
     """
-    var_ids = {id(v): k for k, v in enumerate(variables)}
+    variables = list(variables)
+    var_ids = {}
+    for k, v in enumerate(variables):
+        var_ids.setdefault(id(v), k)  # duplicates share the first slot
     head_entries = [h._tape_entry for h in heads]
     for h, ent in zip(heads, head_entries):
         if ent is None and id(h) not in var_ids:
@@ -319,6 +333,16 @@ def _tape_function(heads, variables):
                 f"create_graph=True cannot replay tape node '{node.name}' "
                 "(custom Function / CachedOp nodes store no pure function); "
                 "run the forward un-hybridized for higher-order grad")
+    if promote_leaves:
+        for node in order:
+            for pos, (var, ent) in enumerate(
+                    zip(node.inputs, node.input_entries)):
+                if (ent is None and var is not None
+                        and id(var) not in var_ids
+                        and var._requires_grad_entry
+                        and var._data is node.input_datas[pos]):
+                    var_ids[id(var)] = len(variables)
+                    variables.append(var)
 
     def replay(*var_datas):
         env = {}
@@ -344,7 +368,7 @@ def _tape_function(heads, variables):
                 res.append(env[id(n)][i])
         return tuple(res)
 
-    return replay
+    return replay, variables, var_ids
 
 
 def grad(heads, variables, head_grads=None, retain_graph=None, create_graph=False,
@@ -365,22 +389,33 @@ def grad(heads, variables, head_grads=None, retain_graph=None, create_graph=Fals
 
         if isinstance(heads, NDArray):
             heads = [heads]
-        replay = _tape_function(heads, variables)
         nv = len(variables)
+        # promote_leaves: see _tape_function — keeps mixed second
+        # derivatives (WGAN-GP: grad wrt x, then backward into W) taped
+        replay, extended, var_slots = _tape_function(
+            heads, variables, promote_leaves=True)
+        # duplicates in `variables` share one replay slot; map each
+        # requested position back to its slot so every duplicate gets
+        # the full gradient (matching the create_graph=False path)
+        slot_of = [var_slots[id(v)] for v in variables]
         if head_grads is None:
             seeds = [h.ones_like() for h in heads]
         elif isinstance(head_grads, NDArray):
             seeds = [head_grads]
         else:
-            seeds = list(head_grads)
+            # per-head None means ones_like, as backward() treats it
+            seeds = [h.ones_like() if hg is None else hg
+                     for h, hg in zip(heads, head_grads)]
+        n_ext = len(extended)
 
         def pure_grads(*args):
-            vd = args[:nv]
-            sd = args[nv:]
+            vd = args[:n_ext]
+            sd = args[n_ext:]
             _, pull = jax.vjp(replay, *vd)
-            return pull(tuple(sd))
+            all_grads = pull(tuple(sd))
+            return tuple(all_grads[s] for s in slot_of)
 
-        out = apply_op(pure_grads, *variables, *seeds, name="grad")
+        out = apply_op(pure_grads, *extended, *seeds, name="grad")
         return list(out) if isinstance(out, (tuple, list)) else [out]
     saved = [(v._grad, v._grad_req) for v in variables]
     zeros = []

@@ -1,0 +1,163 @@
+"""Higher-order gradient oracles (reference:
+tests/python/unittest/test_higher_order_grad.py — d²/dx² batteries for
+the unary corpus, checked against analytic forms).
+"""
+import numpy as onp
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import autograd
+
+np = mx.np
+
+
+def A(x):
+    return np.array(onp.asarray(x))
+
+
+def _second(fn, pts):
+    """d²/dx² of elementwise fn at pts via nested record/backward."""
+    x = A(onp.asarray(pts, "f"))
+    x.attach_grad()
+    with autograd.record():
+        y = fn(x)
+        g1 = autograd.grad(y.sum(), x, create_graph=True)[0]
+        z = g1.sum()  # heads must be built inside the record scope
+    z.backward()
+    return x.grad.asnumpy()
+
+
+_CASES = [
+    ("sin", [0.3, 1.1], lambda x: -onp.sin(x)),
+    ("cos", [0.3, 1.1], lambda x: -onp.cos(x)),
+    ("tan", [0.2, 0.6], lambda x: 2 * onp.tan(x) / onp.cos(x) ** 2),
+    ("exp", [-0.5, 0.7], lambda x: onp.exp(x)),
+    ("log", [0.4, 2.5], lambda x: -1.0 / x**2),
+    ("log2", [0.4, 2.5], lambda x: -1.0 / (x**2 * onp.log(2))),
+    ("log10", [0.4, 2.5], lambda x: -1.0 / (x**2 * onp.log(10))),
+    ("sqrt", [0.5, 2.0], lambda x: -0.25 * x ** (-1.5)),
+    ("cbrt", [0.5, 2.0], lambda x: -(2.0 / 9.0) * x ** (-5.0 / 3.0)),
+    ("square", [0.5, -1.5], lambda x: 2.0 * onp.ones_like(x)),
+    ("reciprocal", [0.5, 2.0], lambda x: 2.0 / x**3),
+    ("sigmoid", [-1.0, 0.5],
+     lambda x: (s := 1 / (1 + onp.exp(-x))) * (1 - s) * (1 - 2 * s)),
+    ("tanh", [-0.8, 0.4],
+     lambda x: -2 * onp.tanh(x) * (1 - onp.tanh(x) ** 2)),
+    ("arcsin", [-0.5, 0.5], lambda x: x / (1 - x**2) ** 1.5),
+    ("arccos", [-0.5, 0.5], lambda x: -x / (1 - x**2) ** 1.5),
+    ("arctan", [-0.7, 0.7], lambda x: -2 * x / (1 + x**2) ** 2),
+    ("sinh", [-0.6, 0.6], lambda x: onp.sinh(x)),
+    ("cosh", [-0.6, 0.6], lambda x: onp.cosh(x)),
+    ("arcsinh", [-0.6, 0.6], lambda x: -x / (x**2 + 1) ** 1.5),
+    ("arctanh", [-0.4, 0.4], lambda x: 2 * x / (1 - x**2) ** 2),
+    ("expm1", [-0.5, 0.5], lambda x: onp.exp(x)),
+    ("log1p", [0.2, 1.5], lambda x: -1.0 / (1 + x) ** 2),
+    ("radians", [10.0, 90.0], lambda x: onp.zeros_like(x)),
+    ("degrees", [0.2, 1.0], lambda x: onp.zeros_like(x)),
+]
+
+
+@pytest.mark.parametrize("name,pts,d2", _CASES,
+                         ids=[c[0] for c in _CASES])
+def test_second_derivative(name, pts, d2):
+    fn = getattr(np, name, None)
+    if fn is None:
+        from mxnet_tpu import npx
+
+        fn = getattr(npx, name)
+    got = _second(fn, pts)
+    onp.testing.assert_allclose(got, d2(onp.asarray(pts, "f")),
+                                rtol=2e-3, atol=2e-4)
+
+
+def test_third_derivative_of_cube():
+    x = A(onp.array([1.7], "f"))
+    x.attach_grad()
+    with autograd.record():
+        y = (x ** 3).sum()
+        g1 = autograd.grad(y, x, create_graph=True)[0]
+        g2 = autograd.grad(g1.sum(), x, create_graph=True)[0]
+        z = g2.sum()
+    z.backward()
+    onp.testing.assert_allclose(x.grad.asnumpy(), [6.0], rtol=1e-5)
+
+
+def test_second_derivative_through_matmul():
+    """d²/dW² of sum((xW)²) = 2 xᵀx broadcast — mixes linear + nonlinear."""
+    rs = onp.random.RandomState(0)
+    xv = rs.rand(3, 2).astype("f")
+    w = A(rs.rand(2, 2).astype("f"))
+    w.attach_grad()
+    x = A(xv)
+    with autograd.record():
+        y = (np.dot(x, w) ** 2).sum()
+        g1 = autograd.grad(y, w, create_graph=True)[0]
+        z = g1.sum()
+    z.backward()
+    want = 2 * (xv.T @ xv) @ onp.ones((2, 2), "f")
+    onp.testing.assert_allclose(w.grad.asnumpy(), want, rtol=1e-4)
+
+
+def test_grad_of_grad_norm_penalty():
+    """The gradient-penalty idiom (WGAN-GP style): backward through a
+    gradient's norm must itself be differentiable."""
+    rs = onp.random.RandomState(1)
+    x = A(rs.rand(4, 3).astype("f"))
+    w = A(rs.rand(3, 1).astype("f"))
+    w.attach_grad()
+    x.attach_grad()
+    with autograd.record():
+        out = np.tanh(np.dot(x, w)).sum()
+        gx = autograd.grad(out, x, create_graph=True)[0]
+        penalty = (gx ** 2).sum()
+    penalty.backward()
+    assert onp.isfinite(w.grad.asnumpy()).all()
+    assert float(onp.abs(w.grad.asnumpy()).sum()) > 0
+    # analytic oracle via jax: d/dw sum_x (d/dx sum tanh(xw))^2
+    import jax
+    import jax.numpy as jnp
+
+    xv = x.asnumpy()
+
+    def pen(wv):
+        g = jax.grad(lambda xx: jnp.sum(jnp.tanh(jnp.dot(xx, wv))))(xv)
+        return jnp.sum(g * g)
+
+    expect = jax.grad(pen)(w.asnumpy())
+    onp.testing.assert_allclose(w.grad.asnumpy(), expect, rtol=1e-4)
+
+
+def test_create_graph_mutated_leaf_uses_snapshot():
+    """A leaf mutated after recording keeps its record-time value as the
+    differentiation point (the recorded snapshot is the math's truth)."""
+    x = A(onp.array([5.0], "f"))
+    x.attach_grad()
+    w = A(onp.array([5.0], "f"))
+    w.attach_grad()
+    with autograd.record():
+        y = x * w
+        w[:] = 100.0
+        g = autograd.grad(y, x, create_graph=True)[0]
+    assert float(g.asnumpy()[0]) == 5.0
+
+
+def test_create_graph_duplicate_variables():
+    """Duplicates in `variables` each get the FULL gradient, matching
+    the create_graph=False path."""
+    x = A(onp.array([2.0], "f"))
+    x.attach_grad()
+    with autograd.record():
+        y = x * x
+        gs = autograd.grad(y, [x, x], create_graph=True)
+    assert [float(g.asnumpy()[0]) for g in gs] == [4.0, 4.0]
+
+
+def test_create_graph_none_in_head_grads_list():
+    """Per-head None in head_grads means ones_like, as backward() does."""
+    x = A(onp.array([3.0], "f"))
+    x.attach_grad()
+    with autograd.record():
+        y = x * x
+        g = autograd.grad([y], [x], head_grads=[None],
+                          create_graph=True)[0]
+    assert float(g.asnumpy()[0]) == 6.0
