@@ -3998,13 +3998,11 @@ inline std::vector<PackedTensor> _npi_eig(
 inline std::vector<PackedTensor> _npi_eigh(
     PyRuntime& rt,
     const PackedTensor& a,
-    const char* UPLO_json = nullptr,
-    bool symmetrize_input = true) {
+    bool upper = false) {
   std::vector<PackedTensor> ins_;
   ins_.push_back(a);
   detail::JsonBuilder a_;
-  if (UPLO_json) a_.raw("UPLO", UPLO_json);
-  a_.put_bool("symmetrize_input", symmetrize_input);
+  a_.put_bool("upper", upper);
   return rt.invoke("_npi_eigh", ins_, a_.str());
 }
 
@@ -4020,13 +4018,11 @@ inline std::vector<PackedTensor> _npi_eigvals(
 inline std::vector<PackedTensor> _npi_eigvalsh(
     PyRuntime& rt,
     const PackedTensor& a,
-    const std::string& UPLO = "L",
-    bool symmetrize_input = true) {
+    bool upper = false) {
   std::vector<PackedTensor> ins_;
   ins_.push_back(a);
   detail::JsonBuilder a_;
-  a_.put_str("UPLO", UPLO);
-  a_.put_bool("symmetrize_input", symmetrize_input);
+  a_.put_bool("upper", upper);
   return rt.invoke("_npi_eigvalsh", ins_, a_.str());
 }
 
@@ -4305,6 +4301,26 @@ inline std::vector<PackedTensor> _npi_gcd_scalar(
   if (scalar_json) a_.raw("scalar", scalar_json);
   if (is_int_json) a_.raw("is_int", is_int_json);
   return rt.invoke("_npi_gcd_scalar", ins_, detail::merge(a_.str(), extra_attrs));
+}
+
+inline std::vector<PackedTensor> _npi_geomspace(
+    PyRuntime& rt,
+    const PackedTensor& start,
+    const PackedTensor& stop,
+    long long num = 50,
+    bool endpoint = true,
+    const char* dtype_json = nullptr,
+    long long axis = 0,
+    const std::string& extra_attrs = "") {
+  std::vector<PackedTensor> ins_;
+  ins_.push_back(start);
+  ins_.push_back(stop);
+  detail::JsonBuilder a_;
+  a_.put_int("num", num);
+  a_.put_bool("endpoint", endpoint);
+  if (dtype_json) a_.raw("dtype", dtype_json);
+  a_.put_int("axis", axis);
+  return rt.invoke("_npi_geomspace", ins_, detail::merge(a_.str(), extra_attrs));
 }
 
 inline std::vector<PackedTensor> _npi_gumbel(
@@ -4713,14 +4729,12 @@ inline std::vector<PackedTensor> _npi_lstsq(
     PyRuntime& rt,
     const PackedTensor& a,
     const PackedTensor& b,
-    const char* rcond_json = nullptr,
-    bool numpy_resid = false) {
+    const std::string& rcond = "warn") {
   std::vector<PackedTensor> ins_;
   ins_.push_back(a);
   ins_.push_back(b);
   detail::JsonBuilder a_;
-  if (rcond_json) a_.raw("rcond", rcond_json);
-  a_.put_bool("numpy_resid", numpy_resid);
+  a_.put_str("rcond", rcond);
   return rt.invoke("_npi_lstsq", ins_, a_.str());
 }
 
@@ -4745,12 +4759,12 @@ inline std::vector<PackedTensor> _npi_matrix_rank(
     PyRuntime& rt,
     const PackedTensor& M,
     const char* rtol_json = nullptr,
-    const char* tol_json = nullptr) {
+    bool hermitian = false) {
   std::vector<PackedTensor> ins_;
   ins_.push_back(M);
   detail::JsonBuilder a_;
   if (rtol_json) a_.raw("rtol", rtol_json);
-  if (tol_json) a_.raw("tol", tol_json);
+  a_.put_bool("hermitian", hermitian);
   return rt.invoke("_npi_matrix_rank", ins_, a_.str());
 }
 
@@ -4758,12 +4772,12 @@ inline std::vector<PackedTensor> _npi_matrix_rank_none_tol(
     PyRuntime& rt,
     const PackedTensor& M,
     const char* rtol_json = nullptr,
-    const char* tol_json = nullptr) {
+    bool hermitian = false) {
   std::vector<PackedTensor> ins_;
   ins_.push_back(M);
   detail::JsonBuilder a_;
   if (rtol_json) a_.raw("rtol", rtol_json);
-  if (tol_json) a_.raw("tol", tol_json);
+  a_.put_bool("hermitian", hermitian);
   return rt.invoke("_npi_matrix_rank_none_tol", ins_, a_.str());
 }
 
@@ -5787,18 +5801,10 @@ inline std::vector<PackedTensor> _npi_sum(
 
 inline std::vector<PackedTensor> _npi_svd(
     PyRuntime& rt,
-    const PackedTensor& a,
-    bool full_matrices = true,
-    bool compute_uv = true,
-    bool hermitian = false,
-    const char* subset_by_index_json = nullptr) {
+    const PackedTensor& a) {
   std::vector<PackedTensor> ins_;
   ins_.push_back(a);
   detail::JsonBuilder a_;
-  a_.put_bool("full_matrices", full_matrices);
-  a_.put_bool("compute_uv", compute_uv);
-  a_.put_bool("hermitian", hermitian);
-  if (subset_by_index_json) a_.raw("subset_by_index", subset_by_index_json);
   return rt.invoke("_npi_svd", ins_, a_.str());
 }
 
@@ -7863,11 +7869,19 @@ inline std::vector<PackedTensor> elemwise_sub(
 inline std::vector<PackedTensor> embedding(
     PyRuntime& rt,
     const PackedTensor& indices,
-    const PackedTensor& weight) {
+    const PackedTensor& weight,
+    const char* input_dim_json = nullptr,
+    const char* output_dim_json = nullptr,
+    const char* dtype_json = nullptr,
+    bool sparse_grad = false) {
   std::vector<PackedTensor> ins_;
   ins_.push_back(indices);
   ins_.push_back(weight);
   detail::JsonBuilder a_;
+  if (input_dim_json) a_.raw("input_dim", input_dim_json);
+  if (output_dim_json) a_.raw("output_dim", output_dim_json);
+  if (dtype_json) a_.raw("dtype", dtype_json);
+  a_.put_bool("sparse_grad", sparse_grad);
   return rt.invoke("embedding", ins_, a_.str());
 }
 
@@ -7936,7 +7950,9 @@ inline std::vector<PackedTensor> flash_attention(
     const char* scale_json = nullptr,
     long long block_q = 128,
     long long block_k = 128,
-    const char* interpret_json = nullptr) {
+    const char* interpret_json = nullptr,
+    double dropout_p = 0.0,
+    const char* dropout_seed_json = nullptr) {
   std::vector<PackedTensor> ins_;
   ins_.push_back(q);
   ins_.push_back(k);
@@ -7947,6 +7963,8 @@ inline std::vector<PackedTensor> flash_attention(
   a_.put_int("block_q", block_q);
   a_.put_int("block_k", block_k);
   if (interpret_json) a_.raw("interpret", interpret_json);
+  a_.put_num("dropout_p", dropout_p);
+  if (dropout_seed_json) a_.raw("dropout_seed", dropout_seed_json);
   return rt.invoke("flash_attention", ins_, a_.str());
 }
 
@@ -10132,7 +10150,8 @@ inline std::vector<PackedTensor> topk(
     long long k = 1,
     long long axis = -1,
     const std::string& ret_typ = "indices",
-    bool is_ascend = false) {
+    bool is_ascend = false,
+    const std::string& dtype = "float32") {
   std::vector<PackedTensor> ins_;
   ins_.push_back(x);
   detail::JsonBuilder a_;
@@ -10140,6 +10159,7 @@ inline std::vector<PackedTensor> topk(
   a_.put_int("axis", axis);
   a_.put_str("ret_typ", ret_typ);
   a_.put_bool("is_ascend", is_ascend);
+  a_.put_str("dtype", dtype);
   return rt.invoke("topk", ins_, a_.str());
 }
 

@@ -1,0 +1,95 @@
+"""Matrix-factorization recommender with sparse-gradient embeddings
+(reference: example/recommenders/ + example/sparse/matrix_factorization).
+
+Demonstrates the row-sparse training path end to end: two
+`sparse_grad=True` embedding tables, the Trainer's lazy_update rule that
+touches only the rows each batch looked up, and RMSE improving on a
+synthetic low-rank ratings matrix. Runs on the TPU chip when reachable,
+CPU otherwise.
+"""
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--users", type=int, default=200)
+    ap.add_argument("--items", type=int, default=300)
+    ap.add_argument("--rank", type=int, default=8)
+    ap.add_argument("--steps", type=int, default=400)
+    ap.add_argument("--batch-size", type=int, default=256)
+    ap.add_argument("--lr", type=float, default=0.05)
+    ap.add_argument("--cpu", action="store_true")
+    args = ap.parse_args()
+    if args.cpu:
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
+
+    import numpy as onp
+
+    import mxnet_tpu as mx
+    from mxnet_tpu import autograd, gluon, np
+
+    mx.seed(0)
+    rs = onp.random.RandomState(0)
+
+    # synthetic low-rank ground truth with noise
+    u_true = rs.randn(args.users, args.rank).astype("f") / args.rank**0.5
+    i_true = rs.randn(args.items, args.rank).astype("f") / args.rank**0.5
+    noise = 0.05 * rs.randn(args.users, args.items).astype("f")
+    ratings = u_true @ i_true.T + noise
+
+    class MF(gluon.nn.HybridBlock):
+        def __init__(self):
+            super().__init__()
+            # sparse_grad: the backward records the touched rows so the
+            # optimizer updates ONLY those rows (lazy_update)
+            self.user = gluon.nn.Embedding(args.users, args.rank,
+                                           sparse_grad=True)
+            self.item = gluon.nn.Embedding(args.items, args.rank,
+                                           sparse_grad=True)
+
+        def forward(self, uid, iid):
+            return (self.user(uid) * self.item(iid)).sum(axis=-1)
+
+    net = MF()
+    # factor-scaled init: the default tiny embedding init makes the
+    # product u·v (and so the gradients) vanishingly small
+    net.initialize(mx.initializer.Normal(1.0 / args.rank ** 0.5))
+    trainer = gluon.Trainer(net.collect_params(), "adam",
+                            {"learning_rate": args.lr,
+                             "lazy_update": True})
+    lossfn = gluon.loss.L2Loss()
+
+    def rmse():
+        uid = np.array(onp.arange(args.users).repeat(4) % args.users)
+        iid = np.array((onp.arange(args.users * 4) * 7) % args.items)
+        pred = net(uid, iid).asnumpy()
+        truth = ratings[uid.asnumpy(), iid.asnumpy()]
+        return float(onp.sqrt(onp.mean((pred - truth) ** 2)))
+
+    first = None
+    for step in range(args.steps):
+        uid = rs.randint(0, args.users, args.batch_size)
+        iid = rs.randint(0, args.items, args.batch_size)
+        y = np.array(ratings[uid, iid])
+        ub, ib = np.array(uid), np.array(iid)
+        with autograd.record():
+            loss = lossfn(net(ub, ib), y)
+        loss.backward()
+        trainer.step(args.batch_size)
+        if step == 0:
+            first = rmse()
+    final = rmse()
+    print(f"rmse {first:.4f} -> {final:.4f} over {args.steps} steps")
+    if not final < first * 0.8:
+        raise SystemExit("FAIL: rmse did not improve")
+    print("matrix factorization example OK")
+
+
+if __name__ == "__main__":
+    main()

@@ -83,3 +83,13 @@ def test_long_context_moe_example():
         capture_output=True, text=True, timeout=900, env=env)
     assert r.returncode == 0, r.stdout + r.stderr
     assert "long_context_moe OK" in r.stdout
+
+
+def test_matrix_factorization_example():
+    out = _run("matrix_factorization.py", "--steps", "400")
+    assert "matrix factorization example OK" in out
+
+
+def test_quantize_int8_example():
+    out = _run("quantize_int8.py", "--iters", "120")
+    assert "int8 quantization example OK" in out
