@@ -1,6 +1,8 @@
 // Shared helpers for the cpp-package example programs.
 #pragma once
 
+#include <unistd.h>
+
 #include <string>
 
 namespace mxtpu_demo {

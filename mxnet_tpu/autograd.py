@@ -389,7 +389,6 @@ def grad(heads, variables, head_grads=None, retain_graph=None, create_graph=Fals
 
         if isinstance(heads, NDArray):
             heads = [heads]
-        nv = len(variables)
         # promote_leaves: see _tape_function — keeps mixed second
         # derivatives (WGAN-GP: grad wrt x, then backward into W) taped
         replay, extended, var_slots = _tape_function(

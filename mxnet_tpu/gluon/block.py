@@ -259,11 +259,15 @@ class Block:
         if not os.path.exists(path) and os.path.exists(path + ".npz"):
             path = path + ".npz"
             wait_for_path(path)  # the save may have keyed the .npz name
-        from .._dtype_codec import decode_npz
+        from .._dtype_codec import DTYPE_KEY, decode_entry, read_sidecar
 
         # restore bf16/f8 dtypes from the codec sidecar (npz alone loses
-        # them to raw void records — a bf16-trained net must checkpoint)
-        loaded = decode_npz(_np.load(path, allow_pickle=False))
+        # them to raw void records — a bf16-trained net must checkpoint).
+        # Entries decode lazily: NpzFile decompresses per access, so a
+        # partial load of a large checkpoint reads only what it needs.
+        npz = _np.load(path, allow_pickle=False)
+        sidecar = read_sidecar(npz)
+        loaded = set(npz.files) - {DTYPE_KEY}
         params = self._collect_params_with_prefix()
         for name, p in params.items():
             if name not in loaded:
@@ -272,7 +276,7 @@ class Block:
                         f"Parameter {name} missing in file {filename}; "
                         "set allow_missing=True to skip")
                 continue
-            arr = loaded[name]
+            arr = decode_entry(name, npz[name], sidecar)
             # dtype contract (reference: parameter.py:286-315 _load_init):
             # mismatch errors unless cast_dtype=True, which casts saved ->
             # current (dtype_source='current') or adopts the saved dtype

@@ -57,7 +57,17 @@ def matrix_rank(M, rtol=None, hermitian=False):
 
 def lstsq(a, b, rcond="warn"):
     if isinstance(rcond, str):
-        rcond = -1  # reference 'warn' default = legacy machine-eps cutoff
+        if rcond == "warn":
+            rcond = -1  # reference default = legacy machine-eps cutoff
+        else:
+            # the packed FFI ships attrs as strings — a numeric string
+            # is a real tolerance, not the legacy sentinel
+            try:
+                rcond = float(rcond)
+            except ValueError:
+                raise ValueError(
+                    f"rcond must be a number, None, or 'warn'; got "
+                    f"{rcond!r}") from None
     return tuple(jnp.linalg.lstsq(a, b, rcond=rcond, numpy_resid=True))
 
 

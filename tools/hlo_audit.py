@@ -126,8 +126,10 @@ def main():
     layout = sys.argv[1] if len(sys.argv) > 1 else "NHWC"
     batch = int(sys.argv[2]) if len(sys.argv) > 2 else 256
     rep = audit(layout, batch)
+    suffix = "" if layout.upper() == "NHWC" else f"_{layout.lower()}"
     out = os.path.join(os.path.dirname(os.path.dirname(
-        os.path.abspath(__file__))), "docs", "perf_audit_r4_data.json")
+        os.path.abspath(__file__))), "docs",
+        f"perf_audit_r4_data{suffix}.json")
     with open(out, "w") as f:
         json.dump(rep, f, indent=1)
     print(json.dumps(rep, indent=1)[:4000])
