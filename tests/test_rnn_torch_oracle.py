@@ -1,0 +1,113 @@
+"""Cross-framework RNN oracles: gluon LSTM/GRU/RNN vs torch with COPIED
+weights (reference coverage model: test_gluon_rnn.py checks against
+cuDNN; the in-repo fused-vs-cell tests are self-consistency only, which
+cannot catch a gate-order or bias convention shared by both paths).
+
+Both frameworks use gate order [i, f, g, o] (LSTM) / [r, z, n] (GRU)
+and apply the reset gate to the h2h product including its bias, so
+parameters map 1:1: weight_ih_l{k} -> l{k}_i2h_weight etc.
+"""
+import numpy as onp
+import pytest
+import torch
+
+import mxnet_tpu as mx
+from mxnet_tpu import gluon
+
+rs = onp.random.RandomState(9)
+torch.manual_seed(9)  # weight draws must be reproducible like the inputs
+
+
+def _copy_torch_to_gluon(tnet, gnet, layers, bidir):
+    params = gnet.collect_params()
+    for lk in range(layers):
+        for d in range(2 if bidir else 1):
+            tsuf = f"_l{lk}" + ("_reverse" if d else "")
+            # gluon names: l0_i2h_weight fwd / l0_r_i2h_weight reverse
+            pre = f"l{lk}_r" if d else f"l{lk}"
+            for tname, gname in [
+                    (f"weight_ih{tsuf}", f"{pre}_i2h_weight"),
+                    (f"weight_hh{tsuf}", f"{pre}_h2h_weight"),
+                    (f"bias_ih{tsuf}", f"{pre}_i2h_bias"),
+                    (f"bias_hh{tsuf}", f"{pre}_h2h_bias")]:
+                val = getattr(tnet, tname).detach().numpy()
+                params[gname].set_data(mx.np.array(val))
+
+
+@pytest.mark.parametrize("bidir", [False, True])
+@pytest.mark.parametrize("mode", ["lstm", "gru", "rnn_tanh", "rnn_relu"])
+def test_rnn_matches_torch(mode, bidir):
+    T, N, I, H, L = 5, 3, 6, 4, 2
+    x = rs.randn(T, N, I).astype("f")
+
+    if mode == "lstm":
+        tnet = torch.nn.LSTM(I, H, L, bidirectional=bidir)
+        gnet = gluon.rnn.LSTM(H, num_layers=L, input_size=I,
+                              bidirectional=bidir)
+    elif mode == "gru":
+        tnet = torch.nn.GRU(I, H, L, bidirectional=bidir)
+        gnet = gluon.rnn.GRU(H, num_layers=L, input_size=I,
+                             bidirectional=bidir)
+    else:
+        act = mode.split("_")[1]
+        tnet = torch.nn.RNN(I, H, L, nonlinearity=act,
+                            bidirectional=bidir)
+        gnet = gluon.rnn.RNN(H, num_layers=L, input_size=I,
+                             activation=act, bidirectional=bidir)
+    gnet.initialize()
+    gnet(mx.np.array(x))  # materialize params
+    _copy_torch_to_gluon(tnet, gnet, L, bidir)
+
+    got = gnet(mx.np.array(x)).asnumpy()
+    want, _ = tnet(torch.from_numpy(x))
+    onp.testing.assert_allclose(got, want.detach().numpy(),
+                                rtol=2e-5, atol=2e-5)
+
+
+def test_lstm_states_match_torch():
+    T, N, I, H, L = 4, 2, 5, 3, 1
+    x = rs.randn(T, N, I).astype("f")
+    tnet = torch.nn.LSTM(I, H, L)
+    gnet = gluon.rnn.LSTM(H, num_layers=L, input_size=I)
+    gnet.initialize()
+    gnet(mx.np.array(x))
+    _copy_torch_to_gluon(tnet, gnet, L, False)
+
+    h0 = mx.np.zeros((L, N, H))
+    c0 = mx.np.zeros((L, N, H))
+    out, (hy, cy) = gnet(mx.np.array(x), [h0, c0])
+    tout, (thy, tcy) = tnet(torch.from_numpy(x))
+    onp.testing.assert_allclose(out.asnumpy(), tout.detach().numpy(),
+                                rtol=2e-5, atol=2e-5)
+    onp.testing.assert_allclose(hy.asnumpy(), thy.detach().numpy(),
+                                rtol=2e-5, atol=2e-5)
+    onp.testing.assert_allclose(cy.asnumpy(), tcy.detach().numpy(),
+                                rtol=2e-5, atol=2e-5)
+
+
+def test_lstm_gradients_match_torch():
+    T, N, I, H = 3, 2, 4, 3
+    x = rs.randn(T, N, I).astype("f")
+    tnet = torch.nn.LSTM(I, H, 1)
+    gnet = gluon.rnn.LSTM(H, num_layers=1, input_size=I)
+    gnet.initialize()
+    gnet(mx.np.array(x))
+    _copy_torch_to_gluon(tnet, gnet, 1, False)
+
+    from mxnet_tpu import autograd
+
+    xa = mx.np.array(x)
+    xa.attach_grad()
+    with autograd.record():
+        out = gnet(xa)
+        loss = (out ** 2).sum()
+    loss.backward()
+
+    xt = torch.from_numpy(x).requires_grad_(True)
+    (tnet(xt)[0] ** 2).sum().backward()
+    onp.testing.assert_allclose(xa.grad.asnumpy(), xt.grad.numpy(),
+                                rtol=1e-4, atol=1e-4)
+    # weight grads too
+    g_i2h = gnet.collect_params()["l0_i2h_weight"].grad().asnumpy()
+    onp.testing.assert_allclose(g_i2h, tnet.weight_ih_l0.grad.numpy(),
+                                rtol=1e-3, atol=1e-4)
