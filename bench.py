@@ -391,9 +391,12 @@ def main():
 
     result_extra = {}
     if platform == "cpu":
-        note = ("CPU run — not a TPU measurement; see BENCH_r01.json "
-                "for the last on-chip number (2507.6 img/s NCHW, before "
-                "the NHWC layout work)")
+        note = ("CPU run — not a TPU measurement; last on-chip numbers: "
+                "BENCH_PROBE_r03.json (2399.4 img/s train NHWC b=256, "
+                "13340 infer, BERT 261 samples/s — r3 round start, before "
+                "the custom-VJP norms) and BENCH_r01.json (2507.6 img/s "
+                "NCHW). The r3/r4 perf work is staged but unmeasured; "
+                "docs/perf_audit_r4.md has the revival checklist")
         pool_ip = os.environ.get("PALLAS_AXON_POOL_IPS", "").split(",")[0]
         if pool_ip:
             import socket
