@@ -105,6 +105,12 @@ class BaseSparseNDArray:
     def wait_to_read(self):
         return self
 
+    def copy(self):
+        """Value copy preserving the storage type (kvstore init/push
+        snapshot arrays); subclasses override — jax arrays are
+        immutable so structure sharing is safe."""
+        raise NotImplementedError
+
     def __repr__(self):
         return (f"\n<{type(self).__name__} {self._shape} "
                 f"dtype={self._dtype.name}>")
@@ -128,6 +134,10 @@ class CSRNDArray(BaseSparseNDArray):
     def astype(self, dtype):
         return CSRNDArray(self.data, self.indices, self.indptr, self._shape,
                           dtype)
+
+    def copy(self):
+        return CSRNDArray(self.data, self.indices, self.indptr,
+                          self._shape, self._dtype)
 
     def todense(self):
         n_rows, n_cols = self._shape
@@ -162,6 +172,10 @@ class RowSparseNDArray(BaseSparseNDArray):
     stype = "row_sparse"
 
     def __init__(self, data, indices, shape, dtype=None):
+        # trusts ascending indices (kRowSparseStorage invariant,
+        # include/mxnet/ndarray.h:60) — every internal constructor
+        # (unique/nonzero/union1d outputs) satisfies it already; the
+        # user entry point row_sparse_array() sorts untrusted input
         data = jnp.asarray(data)
         super().__init__(shape, dtype or data.dtype)
         self.data = data.astype(self._dtype)
@@ -169,6 +183,10 @@ class RowSparseNDArray(BaseSparseNDArray):
 
     def astype(self, dtype):
         return RowSparseNDArray(self.data, self.indices, self._shape, dtype)
+
+    def copy(self):
+        return RowSparseNDArray(self.data, self.indices, self._shape,
+                                self._dtype)
 
     def todense(self):
         dense = jnp.zeros(self._shape, self._dtype).at[self.indices].add(
@@ -204,7 +222,14 @@ def row_sparse_array(arg1, shape=None, ctx=None, dtype=None):  # noqa: ARG001
         data, indices = arg1
         if shape is None:
             raise ValueError("shape required with (data, indices)")
-        return RowSparseNDArray(data, indices, shape, dtype)
+        idx = _np.asarray(indices)
+        if idx.ndim > 0 and idx.shape[0] > 1 and (_np.diff(idx) < 0).any():
+            # untrusted caller input: restore the ascending-row-id
+            # invariant here, keeping the ctor free of per-step sorts
+            order = _np.argsort(idx)
+            idx = idx[order]
+            data = jnp.asarray(data)[_np.asarray(order)]
+        return RowSparseNDArray(data, idx, shape, dtype)
     dense = arg1.asnumpy() if isinstance(arg1, NDArray) else _np.asarray(arg1)
     return _dense_to_rsp(dense, dtype)
 

@@ -1,0 +1,176 @@
+"""Sparse edge-case oracles (reference:
+tests/python/unittest/test_sparse_operator.py / test_sparse_ndarray.py —
+transpose combos, empty structures, duplicate/unsorted indices,
+slicing, dtype preservation). Dense numpy is the oracle throughout.
+"""
+import numpy as onp
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu.ndarray import sparse
+
+np = mx.np
+rs = onp.random.RandomState(13)
+
+
+def _rand_csr(m, n, density=0.3):
+    dense = rs.rand(m, n).astype("f")
+    dense[rs.rand(m, n) > density] = 0.0
+    return dense, sparse.csr_matrix(np.array(dense))
+
+
+def _chk(got, want, tol=1e-5):
+    g = got.asnumpy() if hasattr(got, "asnumpy") else onp.asarray(got)
+    onp.testing.assert_allclose(g, want, rtol=tol, atol=tol)
+
+
+# -- dot transpose combinations ------------------------------------------
+
+def test_csr_dot_transpose_a():
+    dense, a = _rand_csr(5, 7)
+    b = rs.rand(5, 3).astype("f")
+    got = sparse.dot(a, np.array(b), transpose_a=True)
+    _chk(got, dense.T @ b, tol=1e-4)
+
+
+def test_csr_dot_transpose_b():
+    dense, a = _rand_csr(4, 6)
+    b = rs.rand(2, 6).astype("f")
+    got = sparse.dot(a, np.array(b), transpose_b=True)
+    _chk(got, dense @ b.T, tol=1e-4)
+
+
+def test_rsp_dot_transpose_b():
+    dense = onp.zeros((6, 4), "f")
+    dense[[1, 4]] = rs.rand(2, 4).astype("f")
+    r = sparse.row_sparse_array(
+        (dense[[1, 4]], onp.array([1, 4])), shape=(6, 4))
+    b = rs.rand(5, 4).astype("f")
+    got = sparse.dot(r, np.array(b), transpose_b=True)
+    _chk(got, dense @ b.T, tol=1e-4)
+
+
+def test_dense_dot_sparse_rhs_densifies_correctly():
+    dense, a = _rand_csr(4, 5)
+    lhs = rs.rand(3, 4).astype("f")
+    got = sparse.dot(np.array(lhs), a)
+    _chk(got, lhs @ dense, tol=1e-4)
+
+
+# -- empty structures -----------------------------------------------------
+
+def test_all_zero_csr():
+    z = sparse.csr_matrix(np.zeros((3, 4)))
+    assert z.data.shape[0] == 0
+    _chk(z.todense(), onp.zeros((3, 4)))
+    out = sparse.dot(z, np.array(rs.rand(4, 2).astype("f")))
+    _chk(out, onp.zeros((3, 2)))
+
+
+def test_empty_row_sparse_and_retain_to_empty():
+    r = sparse.row_sparse_array(
+        (onp.zeros((0, 3), "f"), onp.zeros((0,), "i8")), shape=(5, 3))
+    _chk(r.todense(), onp.zeros((5, 3)))
+    dense = onp.zeros((5, 3), "f")
+    dense[2] = 1.0
+    r2 = sparse.row_sparse_array((dense[[2]], onp.array([2])),
+                                 shape=(5, 3))
+    kept = sparse.retain(r2, onp.array([0, 1]))  # keeps nothing
+    assert kept.indices.shape[0] == 0
+    _chk(kept.todense(), onp.zeros((5, 3)))
+
+
+# -- structure invariants -------------------------------------------------
+
+def test_csr_indptr_monotone_and_matches_nnz():
+    dense, a = _rand_csr(6, 8, density=0.4)
+    indptr = onp.asarray(a.indptr)
+    assert indptr[0] == 0
+    assert (onp.diff(indptr) >= 0).all()
+    assert indptr[-1] == a.data.shape[0]
+    # per-row counts match the dense nonzero pattern
+    onp.testing.assert_array_equal(onp.diff(indptr),
+                                   (dense != 0).sum(axis=1))
+
+
+def test_rsp_elemwise_subtract_disjoint_and_overlap():
+    d1 = onp.zeros((6, 2), "f")
+    d2 = onp.zeros((6, 2), "f")
+    d1[[0, 3]] = rs.rand(2, 2)
+    d2[[3, 5]] = rs.rand(2, 2)
+    r1 = sparse.row_sparse_array((d1[[0, 3]], onp.array([0, 3])),
+                                 shape=(6, 2))
+    r2 = sparse.row_sparse_array((d2[[3, 5]], onp.array([3, 5])),
+                                 shape=(6, 2))
+    out = sparse.subtract(r1, r2)
+    assert out.stype == "row_sparse"
+    _chk(out.todense(), d1 - d2)
+    onp.testing.assert_array_equal(onp.asarray(out.indices), [0, 3, 5])
+
+
+def test_cast_storage_threshold_roundtrip_dtypes():
+    for dt in ("float32", "float16"):
+        dense = rs.rand(4, 4).astype(dt)
+        dense[dense < 0.5] = 0
+        c = sparse.cast_storage(np.array(dense), "csr")
+        assert c.dtype == onp.dtype(dt)
+        back = c.tostype("default")
+        _chk(back, dense, tol=1e-3)
+        r = sparse.cast_storage(np.array(dense), "row_sparse")
+        assert r.dtype == onp.dtype(dt)
+        _chk(r.todense(), dense, tol=1e-3)
+
+
+def test_csr_slice_matches_dense():
+    dense, a = _rand_csr(8, 5)
+    _chk(a[2:6], dense[2:6], tol=1e-6)
+    _chk(a[0:1], dense[0:1], tol=1e-6)
+
+
+def test_rsp_unsorted_indices_construction():
+    """Reference accepts unsorted row ids and sorts them internally."""
+    data = rs.rand(3, 2).astype("f")
+    r = sparse.row_sparse_array((data, onp.array([4, 0, 2])), shape=(6, 2))
+    dense = onp.zeros((6, 2), "f")
+    dense[[4, 0, 2]] = data
+    _chk(r.todense(), dense)
+    idx = onp.asarray(r.indices)
+    assert (onp.diff(idx) > 0).all(), f"indices not sorted: {idx}"
+
+
+def test_sparse_grad_embedding_rows_limited():
+    """End-to-end: only looked-up rows receive updates under
+    lazy_update (reference sgd row_sparse kernel semantics)."""
+    from mxnet_tpu import autograd, gluon
+
+    emb = gluon.nn.Embedding(12, 3, sparse_grad=True)
+    emb.initialize()
+    tr = gluon.Trainer(emb.collect_params(), "sgd",
+                       {"learning_rate": 1.0, "lazy_update": True,
+                        "wd": 0.1})
+    w0 = emb.weight.data().asnumpy().copy()
+    x = np.array(onp.array([2, 7], "i4"))
+    with autograd.record():
+        loss = (emb(x) ** 2).sum()
+    loss.backward()
+    tr.step(1)
+    w1 = emb.weight.data().asnumpy()
+    touched = onp.abs(w1 - w0).sum(axis=1) > 0
+    onp.testing.assert_array_equal(
+        onp.where(touched)[0], [2, 7])  # wd must NOT decay other rows
+
+
+def test_kvstore_rsp_pull_subset_rows():
+    from mxnet_tpu import kvstore
+
+    kv = kvstore.create("local")
+    dense = onp.zeros((8, 2), "f")
+    dense[[1, 5, 6]] = rs.rand(3, 2)
+    r = sparse.row_sparse_array((dense[[1, 5, 6]], onp.array([1, 5, 6])),
+                                shape=(8, 2))
+    kv.init("emb", r)
+    out = sparse.zeros("row_sparse", (8, 2))
+    kv.row_sparse_pull("emb", out=out, row_ids=mx.nd.array([5, 3]))
+    got = out.todense().asnumpy()
+    _chk(got[5], dense[5])
+    _chk(got[3], onp.zeros(2))
