@@ -88,12 +88,23 @@ class Resize(Block):
     def __init__(self, size, keep_ratio=False, interpolation=1):  # noqa: ARG002
         super().__init__()
         self._size = size
+        self._keep = keep_ratio
 
     def forward(self, x):
         x = _as_np(x)
         if x.ndim == 2:
             x = x[:, :, None]
-        return _resize_np(x, self._size)
+        size = self._size
+        if self._keep and isinstance(size, int):
+            # reference semantics (image.py:413-415 resize_short): int
+            # size + keep_ratio scales the SHORT side to `size` with
+            # FLOOR division for the long side
+            h, w = x.shape[:2]
+            if h < w:
+                size = (max(1, size * w // h), size)  # (w, h)
+            else:
+                size = (size, max(1, size * h // w))
+        return _resize_np(x, size)
 
 
 class CenterCrop(Block):

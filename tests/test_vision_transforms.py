@@ -1,0 +1,158 @@
+"""gluon.data.vision.transforms oracles (reference:
+tests/python/unittest/test_gluon_data_vision.py — ToTensor/Normalize
+formulas, crop geometry, jitter bounds, pipeline composition).
+"""
+import numpy as onp
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import gluon
+from mxnet_tpu.gluon.data.vision import transforms as T
+
+np = mx.np
+rs = onp.random.RandomState(17)
+
+
+def _img(h=8, w=10, c=3):
+    return rs.randint(0, 256, (h, w, c)).astype("uint8")
+
+
+def N(x):
+    return x.asnumpy() if hasattr(x, "asnumpy") else onp.asarray(x)
+
+
+def test_to_tensor_layout_and_scale():
+    img = _img()
+    out = N(T.ToTensor()(np.array(img)))
+    assert out.shape == (3, 8, 10)
+    assert out.dtype == onp.float32
+    onp.testing.assert_allclose(out, img.transpose(2, 0, 1) / 255.0,
+                                rtol=1e-6)
+
+
+def test_normalize_broadcasts_per_channel():
+    x = rs.rand(3, 4, 5).astype("f")
+    mean = (0.485, 0.456, 0.406)
+    std = (0.229, 0.224, 0.225)
+    out = N(T.Normalize(mean, std)(np.array(x)))
+    want = (x - onp.array(mean).reshape(3, 1, 1)) \
+        / onp.array(std).reshape(3, 1, 1)
+    onp.testing.assert_allclose(out, want, rtol=1e-5, atol=1e-5)
+    # scalar spelling
+    out = N(T.Normalize(0.5, 0.5)(np.array(x)))
+    onp.testing.assert_allclose(out, (x - 0.5) / 0.5, rtol=1e-5)
+
+
+def test_cast():
+    img = _img()
+    out = N(T.Cast("float32")(np.array(img)))
+    assert out.dtype == onp.float32
+    onp.testing.assert_array_equal(out, img.astype("f"))
+
+
+def test_resize_shape_and_corner_values():
+    img = _img(8, 8)
+    out = N(T.Resize(4)(np.array(img)))
+    assert out.shape == (4, 4, 3)
+    out = N(T.Resize((6, 3))(np.array(img)))  # (w, h) reference order
+    assert out.shape == (3, 6, 3)
+
+
+def test_resize_keep_ratio():
+    img = _img(4, 8)
+    out = N(T.Resize(2, keep_ratio=True)(np.array(img)))
+    # short side -> 2, aspect 2:1 preserved
+    assert out.shape == (2, 4, 3)
+    # FLOOR division for the long side (reference image.py:413-415:
+    # size * w // h), not rounding
+    out = N(T.Resize(2, keep_ratio=True)(np.array(_img(3, 4))))
+    assert out.shape == (2, 2, 3)
+
+
+def test_center_crop_exact_region():
+    img = _img(8, 10)
+    out = N(T.CenterCrop((4, 4))(np.array(img)))  # (w, h)
+    onp.testing.assert_array_equal(out, img[2:6, 3:7])
+
+
+def test_random_crop_bounds_and_padding():
+    onp.random.seed(3)
+    img = _img(6, 6)
+    out = N(T.RandomCrop((4, 4))(np.array(img)))
+    assert out.shape == (4, 4, 3)
+    # the crop must be an actual subwindow
+    found = any(
+        onp.array_equal(out, img[i:i + 4, j:j + 4])
+        for i in range(3) for j in range(3))
+    assert found
+    padded = N(T.RandomCrop((6, 6), pad=2)(np.array(img)))
+    assert padded.shape == (6, 6, 3)
+
+
+def test_random_resized_crop_shape_and_range():
+    onp.random.seed(4)
+    img = _img(16, 16)
+    out = N(T.RandomResizedCrop(8)(np.array(img)))
+    assert out.shape == (8, 8, 3)
+    assert out.min() >= 0 and out.max() <= 255
+
+
+def test_flips_are_exact_mirrors_when_applied():
+    img = _img(5, 7)
+    onp.random.seed(0)
+    seen = set()
+    for _ in range(20):
+        out = N(T.RandomFlipLeftRight()(np.array(img)))
+        if onp.array_equal(out, img):
+            seen.add("id")
+        elif onp.array_equal(out, img[:, ::-1]):
+            seen.add("flip")
+        else:
+            raise AssertionError("output is neither identity nor mirror")
+    assert seen == {"id", "flip"}
+
+
+@pytest.mark.parametrize("cls,amount", [(T.RandomBrightness, 0.3),
+                                        (T.RandomContrast, 0.3),
+                                        (T.RandomSaturation, 0.3)])
+def test_jitter_stays_in_range_and_near_identity_at_zero(cls, amount):
+    img = _img()
+    onp.random.seed(1)
+    out = N(cls(amount)(np.array(img)))
+    assert out.min() >= 0 and out.max() <= 255
+    out0 = N(cls(0.0)(np.array(img)))
+    onp.testing.assert_allclose(out0, img.astype("f"), atol=1e-3)
+
+
+def test_random_lighting_zero_alpha_is_identity():
+    img = _img()
+    onp.random.seed(2)
+    out = N(T.RandomLighting(0.0)(np.array(img)))
+    onp.testing.assert_allclose(out, img.astype("f"), atol=1e-3)
+
+
+def test_compose_pipeline_end_to_end():
+    aug = T.Compose([
+        T.Resize(6),
+        T.CenterCrop((4, 4)),
+        T.ToTensor(),
+        T.Normalize(0.5, 0.25),
+    ])
+    out = N(aug(np.array(_img(12, 12))))
+    assert out.shape == (3, 4, 4)
+    assert out.dtype == onp.float32
+    # Normalize((x/255)-0.5)/0.25 range check
+    assert out.min() >= -2.001 and out.max() <= 2.001
+
+
+def test_transform_first_in_dataloader():
+    data = [( _img(), i % 3) for i in range(12)]
+    ds = gluon.data.SimpleDataset(data)
+    aug = T.Compose([T.ToTensor()])
+    loader = gluon.data.DataLoader(ds.transform_first(aug),
+                                   batch_size=4)
+    batches = list(loader)
+    assert len(batches) == 3
+    xb, yb = batches[0]
+    assert tuple(xb.shape) == (4, 3, 8, 10)
+    assert N(xb).max() <= 1.0
