@@ -1,0 +1,139 @@
+"""Property-based invariants over the core array surface (hypothesis;
+derandomized + capped so the suite stays fast and reproducible).
+
+These complement the example-based oracles: instead of checking chosen
+points, they assert ALGEBRAIC properties — round-trips, gradient-shape
+laws, serialization identity — over generated shapes/dtypes/values.
+"""
+import numpy as onp
+from hypothesis import given, settings, strategies as st
+
+import mxnet_tpu as mx
+from mxnet_tpu import autograd
+
+np = mx.np
+
+SETTINGS = settings(max_examples=25, deadline=None, derandomize=True)
+
+shapes = st.lists(st.integers(1, 5), min_size=1, max_size=4).map(tuple)
+float_dtypes = st.sampled_from(["float32", "float16", "bfloat16"])
+
+
+def arr(shape, seed, dtype="float32"):
+    rs = onp.random.RandomState(seed)
+    return np.array(rs.uniform(-2, 2, shape).astype("f")).astype(dtype)
+
+
+@SETTINGS
+@given(shape=shapes, seed=st.integers(0, 99))
+def test_reshape_transpose_roundtrip(shape, seed):
+    a = arr(shape, seed)
+    flat = np.reshape(a, (-1,))
+    back = np.reshape(flat, shape)
+    onp.testing.assert_array_equal(back.asnumpy(), a.asnumpy())
+    perm = tuple(reversed(range(len(shape))))
+    onp.testing.assert_array_equal(
+        np.transpose(np.transpose(a, perm), perm).asnumpy(), a.asnumpy())
+
+
+@SETTINGS
+@given(shape=shapes, seed=st.integers(0, 99), dtype=float_dtypes)
+def test_save_load_identity_every_dtype(shape, seed, dtype):
+    import tempfile
+
+    a = arr(shape, seed, dtype)
+    with tempfile.TemporaryDirectory() as d:
+        mx.nd.save(f"{d}/x.npz", {"a": a})
+        back = mx.nd.load(f"{d}/x.npz")["a"]
+    assert back.dtype == a.dtype
+    u = onp.uint16 if onp.dtype(back.dtype).itemsize == 2 else onp.uint32
+    onp.testing.assert_array_equal(back.asnumpy().view(u),
+                                   a.asnumpy().view(u))
+
+
+@SETTINGS
+@given(m=st.integers(1, 6), k=st.integers(1, 6), n=st.integers(1, 6),
+       seed=st.integers(0, 99))
+def test_matmul_associates_with_identity_and_einsum(m, k, n, seed):
+    a = arr((m, k), seed)
+    b = arr((k, n), seed + 1)
+    ab = np.matmul(a, b)
+    onp.testing.assert_allclose(
+        np.matmul(ab, np.array(onp.eye(n, dtype="f"))).asnumpy(),
+        ab.asnumpy(), rtol=1e-5)
+    onp.testing.assert_allclose(
+        np.einsum("ik,kn->in", a, b).asnumpy(), ab.asnumpy(), rtol=1e-5)
+
+
+@SETTINGS
+@given(shape=shapes, seed=st.integers(0, 99))
+def test_grad_shape_matches_input_always(shape, seed):
+    a = arr(shape, seed)
+    a.attach_grad()
+    with autograd.record():
+        y = (np.tanh(a) * a).sum()
+    y.backward()
+    assert a.grad.shape == a.shape
+    assert onp.isfinite(a.grad.asnumpy()).all()
+
+
+@SETTINGS
+@given(shape=st.lists(st.integers(1, 4), min_size=2, max_size=3).map(tuple),
+       seed=st.integers(0, 99))
+def test_broadcast_grad_reduces_to_operand_shape(shape, seed):
+    a = arr(shape, seed)
+    b = arr(shape[-1:], seed + 1)  # broadcastable trailing shape
+    a.attach_grad()
+    b.attach_grad()
+    with autograd.record():
+        out = (a * b).sum()
+    out.backward()
+    assert a.grad.shape == a.shape
+    assert b.grad.shape == b.shape
+    # broadcast grad law: db = sum over broadcast axes of a
+    onp.testing.assert_allclose(
+        b.grad.asnumpy(),
+        a.asnumpy().reshape(-1, shape[-1]).sum(0), rtol=1e-4)
+
+
+@SETTINGS
+@given(shape=shapes, seed=st.integers(0, 99))
+def test_sort_is_idempotent_and_permutation(shape, seed):
+    a = arr(shape, seed)
+    s1 = np.sort(a, axis=-1)
+    s2 = np.sort(s1, axis=-1)
+    onp.testing.assert_array_equal(s1.asnumpy(), s2.asnumpy())
+    onp.testing.assert_allclose(onp.sort(a.asnumpy(), axis=-1),
+                                s1.asnumpy(), rtol=0)
+
+
+@SETTINGS
+@given(shape=shapes, seed=st.integers(0, 99),
+       k=st.integers(-3, 3))
+def test_roll_inverts(shape, seed, k):
+    a = arr(shape, seed)
+    rolled = np.roll(np.roll(a, k, axis=0), -k, axis=0)
+    onp.testing.assert_array_equal(rolled.asnumpy(), a.asnumpy())
+
+
+@SETTINGS
+@given(shape=st.lists(st.integers(1, 4), min_size=2, max_size=3).map(tuple),
+       seed=st.integers(0, 99))
+def test_cumsum_diff_inverse(shape, seed):
+    a = arr(shape, seed)
+    c = np.cumsum(a, axis=0)
+    d = np.diff(c, axis=0)
+    onp.testing.assert_allclose(d.asnumpy(), a.asnumpy()[1:], rtol=1e-4,
+                                atol=1e-5)
+
+
+@SETTINGS
+@given(seed=st.integers(0, 99), shape=shapes)
+def test_softmax_rows_sum_to_one(seed, shape):
+    from mxnet_tpu import npx
+
+    a = arr(shape, seed)
+    s = npx.softmax(a, axis=-1).asnumpy()
+    onp.testing.assert_allclose(s.sum(-1), onp.ones(shape[:-1]),
+                                rtol=1e-5)
+    assert (s >= 0).all()
