@@ -259,6 +259,28 @@ in1d = _wrap_jnp(_in1d_ref)
 __all__.append("in1d")
 
 
+def put_along_axis(arr, indices, values, axis):
+    """numpy semantics: mutates `arr` in place. jnp only offers the
+    functional form, so compute it and swap the NDArray's handle (the
+    framework's in-place convention: new buffer + version bump).
+    `values` routes through apply_op like __setitem__'s value does, so
+    gradients flow into a scattered NDArray."""
+    if not isinstance(arr, NDArray):
+        return _np.put_along_axis(arr, indices, values, axis)
+    idx = indices._data if isinstance(indices, NDArray) else indices
+    if isinstance(values, NDArray):
+        out = apply_op(
+            lambda a, v: jnp.put_along_axis(a, idx, v, axis,
+                                            inplace=False),
+            arr, values, name="put_along_axis")
+    else:
+        out = apply_op(
+            lambda a: jnp.put_along_axis(a, idx, values, axis,
+                                         inplace=False),
+            arr, name="put_along_axis")
+    arr._assign_from(out)
+
+
 def _ldexp_ref(x1, x2):
     """Reference semantics (multiarray.py:9785): x1 * 2**x2 with FLOAT
     exponents allowed — jnp.ldexp rejects non-integer x2. exp2 promotes

@@ -446,3 +446,81 @@ def test_vander_and_tri():
     onp.testing.assert_array_equal(
         N(np.vander(A(v), increasing=True)), onp.vander(v, increasing=True))
     onp.testing.assert_array_equal(N(np.tri(3, 4, 1)), onp.tri(3, 4, 1))
+
+
+# -- selection / partition surfaces (previously untested wrappers) --------
+
+def test_partition_and_argpartition():
+    x = rs.rand(9).astype("f")
+    k = 4
+    got = N(np.partition(A(x), k))
+    want = onp.partition(x, k)
+    # partial order law: kth element exact, halves correct
+    assert got[k] == want[k]
+    assert (got[:k] <= got[k]).all() and (got[k:] >= got[k]).all()
+    gidx = N(np.argpartition(A(x), k))
+    assert x[gidx[k]] == want[k]
+    assert (x[gidx[:k]] <= want[k]).all()
+
+
+def test_compress_extract_choose():
+    m = rs.rand(3, 4).astype("f")
+    cond = onp.array([True, False, True])
+    onp.testing.assert_array_equal(
+        N(np.compress(A(cond), A(m), axis=0)),
+        onp.compress(cond, m, axis=0))
+    onp.testing.assert_array_equal(
+        N(np.extract(A(m > 0.5), A(m))), onp.extract(m > 0.5, m))
+    idx = onp.array([0, 1, 0, 1])
+    choices = [onp.arange(4.0, dtype="f"), onp.arange(4.0, dtype="f") * 10]
+    onp.testing.assert_array_equal(
+        N(np.choose(A(idx), [A(c) for c in choices])),
+        onp.choose(idx, choices))
+
+
+def test_lexsort_key_priority():
+    last = onp.array([1.0, 1.0, 0.0], "f")   # primary key (last!)
+    first = onp.array([3.0, 1.0, 2.0], "f")  # secondary
+    onp.testing.assert_array_equal(
+        N(np.lexsort((A(first), A(last)))), onp.lexsort((first, last)))
+
+
+def test_select_and_piecewise():
+    x = rs.rand(8).astype("f")
+    got = N(np.select([A(x < 0.3), A(x < 0.7)],
+                      [A(x), A(x * 2)], default=-1.0))
+    want = onp.select([x < 0.3, x < 0.7], [x, x * 2], default=-1.0)
+    _chk(got, want)
+    got = N(np.piecewise(A(x), [A(x < 0.5), A(x >= 0.5)], [0.0, 1.0]))
+    want = onp.piecewise(x, [x < 0.5, x >= 0.5], [0.0, 1.0])
+    onp.testing.assert_array_equal(got, want)
+
+
+def test_put_along_axis_writes():
+    m = rs.rand(3, 4).astype("f")
+    idx = onp.argmax(m, axis=1)[:, None]
+    got = A(m.copy())
+    np.put_along_axis(got, A(idx), -1.0, axis=1)
+    want = m.copy()
+    onp.put_along_axis(want, idx, -1.0, axis=1)
+    onp.testing.assert_allclose(N(got), want, rtol=1e-6)
+
+
+def test_apply_along_axis_reduction():
+    m = rs.rand(3, 5).astype("f")
+    got = N(np.apply_along_axis(lambda r: r.sum(), 1, A(m)))
+    _chk(got, m.sum(axis=1), tol=1e-5)
+
+
+def test_put_along_axis_gradient_flows_into_values():
+    from mxnet_tpu import autograd
+
+    a = A(onp.zeros((2, 3), "f"))
+    v = A(onp.array([[5.0], [7.0]], "f"))
+    idx = A(onp.array([[1], [2]], "i4"))
+    v.attach_grad()
+    with autograd.record():
+        np.put_along_axis(a, idx, v, axis=1)
+        loss = (a * a).sum()
+    loss.backward()
+    onp.testing.assert_allclose(N(v.grad), [[10.0], [14.0]], rtol=1e-6)
