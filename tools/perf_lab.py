@@ -28,6 +28,9 @@ def main():
     batch = int(sys.argv[2]) if len(sys.argv) > 2 else 256
     mode = sys.argv[3] if len(sys.argv) > 3 else "step"
     iters, warmup = 20, 3
+    # stamp the platform so a silent CPU fallback can never be mistaken
+    # for an on-chip measurement (bench.py's _CPU_FALLBACK analog)
+    platform = jax.devices()[0].platform
 
     net, step, params, momenta, x, y = bench.build_resnet_train(
         layout, batch, donate=(mode == "step"))
@@ -72,11 +75,13 @@ def main():
         for _ in range(3):
             out = one()
         float(out)
-        with jax.profiler.trace("/tmp/xplane"):
+        trace_dir = os.environ.get("MXTPU_PERFLAB_TRACE_DIR",
+                                   "/tmp/xplane")
+        with jax.profiler.trace(trace_dir):
             for _ in range(10):
                 out = one()
             float(out)
-        print(json.dumps({"profile": "/tmp/xplane"}))
+        print(json.dumps({"profile": trace_dir, "platform": platform}))
         return
     else:
         compiled = step.lower(params, momenta, x, y, key).compile()
@@ -94,6 +99,7 @@ def main():
     step_ms = dt / iters * 1e3
     print(json.dumps({
         "mode": mode, "layout": layout, "batch": batch,
+        "platform": platform,
         "step_ms": round(step_ms, 2),
         "img_s": round(batch * iters / dt, 1),
         "xla_gflops_per_step": round(fl / 1e9, 2),

@@ -11,10 +11,9 @@ OUT=${OUT:-/root/repo/BENCH_ONCHIP_r04.json}
 ABDIR=${ABDIR:-/root/repo/bench_ab_r04}
 LOG=/root/repo/tunnel_watch.log
 
-alive() {  # tunnel answering right now?
-    p=$(timeout 90 python -c \
-        "import jax; print(jax.devices()[0].platform)" 2>/dev/null | tail -1)
-    [ -n "$p" ] && [ "$p" != "cpu" ]
+probe_platform() {  # prints the live platform, or nothing on a wedge
+    timeout 90 python -c \
+        "import jax; print(jax.devices()[0].platform)" 2>/dev/null | tail -1
 }
 
 bench_once() {  # $1 = output file; knob env comes from the caller
@@ -30,31 +29,41 @@ bench_once() {  # $1 = output file; knob env comes from the caller
     return 1
 }
 
-perf_lab_once() {  # $1 = mode (step|profile); guarded: perf_lab never
-    out="$ABDIR/perf_lab_$1.txt"  # self-probes, so check the tunnel first
+perf_lab_once() {  # $1 = mode (step|profile); perf_lab stamps "platform"
+    out="$ABDIR/perf_lab_$1.txt"   # in its JSON — reject cpu captures
     [ -s "$out" ] && return 0
-    if alive && timeout 2400 python tools/perf_lab.py NHWC 256 "$1" \
-            > "$out.tmp" 2>&1; then
+    if MXTPU_PERFLAB_TRACE_DIR="$ABDIR/xplane" \
+            timeout 2400 python tools/perf_lab.py NHWC 256 "$1" \
+            > "$out.tmp" 2>&1 \
+            && grep -q '"platform"' "$out.tmp" \
+            && ! grep -q '"platform": "cpu"' "$out.tmp"; then
         mv "$out.tmp" "$out"
         echo "$(date -u +%FT%TZ) captured $out" >> "$LOG"
         return 0
     fi
     rm -f "$out.tmp"
-    echo "$(date -u +%FT%TZ) FAILED cell $out" >> "$LOG"
+    echo "$(date -u +%FT%TZ) FAILED cell $out (cpu fallback or timeout)" >> "$LOG"
     return 1
 }
 
 while true; do
     ts=$(date -u +%FT%TZ)
-    if alive; then
-        echo "$ts tunnel ALIVE; running revival checklist" >> "$LOG"
+    plat=$(probe_platform)
+    if [ -n "$plat" ] && [ "$plat" != "cpu" ]; then
+        echo "$ts probe -> '$plat'; running revival checklist" >> "$LOG"
         ok=1
         mkdir -p "$ABDIR"
         bench_once "$OUT" || ok=0
-        MXTPU_BN_COMPUTE=bf16 bench_once "$ABDIR/bn_bf16.json" || ok=0
-        MXTPU_BENCH_MP=0 bench_once "$ABDIR/mp0.json" || ok=0
-        MXTPU_BENCH_S2D=0 bench_once "$ABDIR/s2d0.json" || ok=0
-        MXTPU_BENCH_LAYOUT=NCHW bench_once "$ABDIR/nchw.json" || ok=0
+        # knob cells only need the ResNet headline row — keep flap
+        # exposure minimal
+        MXTPU_BENCH_HEADLINE_ONLY=1 MXTPU_BN_COMPUTE=bf16 \
+            bench_once "$ABDIR/bn_bf16.json" || ok=0
+        MXTPU_BENCH_HEADLINE_ONLY=1 MXTPU_BENCH_MP=0 \
+            bench_once "$ABDIR/mp0.json" || ok=0
+        MXTPU_BENCH_HEADLINE_ONLY=1 MXTPU_BENCH_S2D=0 \
+            bench_once "$ABDIR/s2d0.json" || ok=0
+        MXTPU_BENCH_HEADLINE_ONLY=1 MXTPU_BENCH_LAYOUT=NCHW \
+            bench_once "$ABDIR/nchw.json" || ok=0
         perf_lab_once step || ok=0
         perf_lab_once profile || ok=0
         if [ "$ok" = 1 ]; then
@@ -63,7 +72,7 @@ while true; do
         fi
         echo "$ts checklist incomplete; will retry missing cells" >> "$LOG"
     else
-        echo "$ts probe -> 'timeout'" >> "$LOG"
+        echo "$ts probe -> '${plat:-timeout}'" >> "$LOG"
     fi
     sleep "$POLL_S"
 done
