@@ -11,7 +11,7 @@ __all__ = ["Loss", "L2Loss", "L1Loss", "SigmoidBinaryCrossEntropyLoss",
            "SigmoidBCELoss", "SoftmaxCrossEntropyLoss", "SoftmaxCELoss",
            "KLDivLoss", "HuberLoss", "HingeLoss", "SquaredHingeLoss",
            "LogisticLoss", "TripletLoss", "CosineEmbeddingLoss",
-           "PoissonNLLLoss", "CTCLoss"]
+           "PoissonNLLLoss", "CTCLoss", "SDMLLoss"]
 
 
 def _reduce(x, weight, sample_weight, batch_axis):
@@ -328,3 +328,40 @@ class CTCLoss(Loss):
             return loss
 
         return apply_op(fn, pred, label, pred_lengths, label_lengths)
+
+
+class SDMLLoss(Loss):
+    """Batchwise Smoothed Deep Metric Learning loss (reference:
+    loss.py:902, arXiv:1905.12786): every off-diagonal item in the
+    aligned minibatch pair (x1[i], x2[i]) acts as a negative; the KL
+    between log-softmax of negative distances and a label-smoothed
+    identity trains similarity. Returns per-row losses."""
+
+    def __init__(self, smoothing_parameter=0.3, weight=1.0, batch_axis=0):
+        super().__init__(weight, batch_axis)
+        self._smooth = smoothing_parameter
+
+    def forward(self, x1, x2):
+        smooth = self._smooth
+        if x1.shape[0] < 2:
+            raise ValueError(
+                "SDMLLoss needs batch_size >= 2 (off-diagonal rows are "
+                "the negatives; a 1-row batch has none and the label "
+                "smoothing divides by n-1)")
+
+        def fn(a, b):
+            n = a.shape[0]
+            d = jnp.sum((a[:, None, :] - b[None, :, :]) ** 2, axis=2)
+            logp = jax.nn.log_softmax(-d, axis=1)
+            eye = jnp.eye(n, dtype=a.dtype)
+            labels = eye * (1 - smooth) + (1 - eye) * smooth / (n - 1)
+            # KLDivLoss(from_logits=True) semantics: mean over classes of
+            # label * (log label - logp). No batch_size rescale: the
+            # reference dropped it in PR#18423 (loss.py:1006-1008).
+            kl = labels * (jnp.log(jnp.maximum(labels, 1e-12)) - logp)
+            loss = jnp.mean(kl, axis=1)
+            if self._weight is not None:
+                loss = loss * self._weight
+            return loss
+
+        return apply_op(fn, x1, x2, name="sdml_loss")

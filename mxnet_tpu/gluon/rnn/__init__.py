@@ -3,13 +3,16 @@ from .rnn_cell import (  # noqa: F401
     BidirectionalCell,
     DropoutCell,
     GRUCell,
+    HybridRecurrentCell,
     HybridSequentialRNNCell,
     LSTMCell,
+    LSTMPCell,
     ModifierCell,
     RecurrentCell,
     ResidualCell,
     RNNCell,
     SequentialRNNCell,
+    VariationalDropoutCell,
     ZoneoutCell,
 )
 from .conv_rnn_cell import (  # noqa: F401

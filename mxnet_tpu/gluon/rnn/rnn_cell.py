@@ -405,3 +405,141 @@ class BidirectionalCell(RecurrentCell):
     def __repr__(self):
         return (f"BidirectionalCell(forward={self.l_cell!r}, "
                 f"backward={self.r_cell!r})")
+
+
+# reference rnn_cell.py defines HybridRecurrentCell as the hybridizable
+# base; here every cell is a HybridBlock already, so they are one class
+HybridRecurrentCell = RecurrentCell
+
+
+class LSTMPCell(RecurrentCell):
+    """LSTM with a recurrent projection (reference: rnn_cell.py:1284
+    LSTMPCell, arXiv:1402.1128): gates read the PROJECTED recurrence
+    r_{t-1} (size P), the cell state keeps full hidden size H, and the
+    output is r_t = h_t @ W_hr^T. States: [r (B, P), c (B, H)]."""
+
+    def __init__(self, hidden_size, projection_size, input_size=0,
+                 i2h_weight_initializer=None, h2h_weight_initializer=None,
+                 h2r_weight_initializer=None,
+                 i2h_bias_initializer="zeros", h2h_bias_initializer="zeros"):
+        super().__init__()
+        self._hidden = hidden_size
+        self._proj = projection_size
+        self.i2h_weight = Parameter("i2h_weight",
+                                    shape=(4 * hidden_size, input_size),
+                                    init=i2h_weight_initializer,
+                                    allow_deferred_init=True)
+        self.h2h_weight = Parameter("h2h_weight",
+                                    shape=(4 * hidden_size,
+                                           projection_size),
+                                    init=h2h_weight_initializer)
+        self.h2r_weight = Parameter("h2r_weight",
+                                    shape=(projection_size, hidden_size),
+                                    init=h2r_weight_initializer)
+        self.i2h_bias = Parameter("i2h_bias", shape=(4 * hidden_size,),
+                                  init=i2h_bias_initializer)
+        self.h2h_bias = Parameter("h2h_bias", shape=(4 * hidden_size,),
+                                  init=h2h_bias_initializer)
+
+    def state_info(self, batch_size=0):
+        return [{"shape": (batch_size, self._proj)},
+                {"shape": (batch_size, self._hidden)}]
+
+    def forward(self, x, states):
+        import jax
+        import jax.numpy as jnp
+
+        if self.i2h_weight._is_deferred:
+            self.i2h_weight._finish_deferred_init(
+                (4 * self._hidden, x.shape[-1]))
+
+        def fn(x_, r, c, wi, wh, wr, bi, bh):
+            gates = x_ @ wi.T + bi + r @ wh.T + bh
+            i, f, g, o = jnp.split(gates, 4, axis=-1)
+            c_new = jax.nn.sigmoid(f) * c + jax.nn.sigmoid(i) * jnp.tanh(g)
+            h_new = jax.nn.sigmoid(o) * jnp.tanh(c_new)
+            r_new = h_new @ wr.T
+            return r_new, c_new
+
+        r, c = apply_op(fn, x, states[0], states[1],
+                        self.i2h_weight.data_for(x),
+                        self.h2h_weight.data_for(x),
+                        self.h2r_weight.data_for(x),
+                        self.i2h_bias.data_for(x),
+                        self.h2h_bias.data_for(x), name="LSTMPCell")
+        return r, [r, c]
+
+
+class VariationalDropoutCell(ModifierCell):
+    """Variational dropout (reference: rnn_cell.py:1110,
+    arXiv:1512.05287): ONE dropout mask per sequence for each of
+    inputs / outputs / first-state, drawn at the first step and reused
+    until reset(). Active only while autograd records in train mode."""
+
+    def __init__(self, base_cell, drop_inputs=0.0, drop_states=0.0,
+                 drop_outputs=0.0):
+        if drop_states and isinstance(base_cell, BidirectionalCell):
+            raise ValueError(
+                "BidirectionalCell doesn't support state dropout "
+                "(reference assertion)")
+        super().__init__(base_cell)
+        self._di, self._ds, self._do = drop_inputs, drop_states, drop_outputs
+        self._masks = {}
+
+    def hybridize(self, active=True, **kwargs):
+        # the per-sequence masks live in a Python attribute: tracing this
+        # cell's own step would leak tracers into self._masks (same
+        # guard as ZoneoutCell above). Hybridize only the base cell.
+        self.base_cell.hybridize(active, **kwargs)
+        return self
+
+    def reset(self):
+        super().reset()
+        self._masks = {}
+
+    def _mask(self, kind, rate, like):
+        from ... import _random
+        from ...autograd import is_training
+
+        if not rate or not is_training():
+            return None
+        m = self._masks.get(kind)
+        if m is None or m.shape != like.shape:
+            import jax
+
+            key = _random.next_key()
+            keep = jax.random.bernoulli(key, 1.0 - rate, like.shape)
+            # mask dtype follows the tensor it scales (bf16 under AMP)
+            m = (keep / (1.0 - rate)).astype(like.dtype)
+            self._masks[kind] = m
+        return m
+
+    def forward(self, inputs, states):
+        mi = self._mask("i", self._di, inputs)
+        if mi is not None:
+            inputs = apply_op(lambda x, m: x * m, inputs,
+                              _wrap(mi), name="vardrop_in")
+        ms = self._mask("s", self._ds, states[0])
+        if ms is not None:
+            states = [apply_op(lambda s, m: s * m, states[0],
+                               _wrap(ms), name="vardrop_state")] \
+                + list(states[1:])
+        out, new_states = self.base_cell(inputs, states)
+        mo = self._mask("o", self._do, out)
+        if mo is not None:
+            out = apply_op(lambda y, m: y * m, out,
+                           _wrap(mo), name="vardrop_out")
+        return out, new_states
+
+    def __repr__(self):
+        return (f"VariationalDropoutCell({self.base_cell!r}, "
+                f"i={self._di}, s={self._ds}, o={self._do})")
+
+
+def _wrap(jarr):
+    from ...ndarray.ndarray import NDArray
+
+    return NDArray(jarr)
+
+
+__all__ += ["HybridRecurrentCell", "LSTMPCell", "VariationalDropoutCell"]
