@@ -11,6 +11,16 @@ from .continuous import *  # noqa: F401,F403
 from .discrete import *  # noqa: F401,F403
 from .distribution import Distribution, ExponentialFamily  # noqa: F401
 from .divergence import empirical_kl, kl_divergence, register_kl  # noqa: F401
+from .utils import (  # noqa: F401
+    cached_property,
+    constraint_check,
+    digamma,
+    erf,
+    erfinv,
+    gammaln,
+    logit2prob,
+    prob2logit,
+)
 from .multivariate import *  # noqa: F401,F403
 from .stochastic_block import StochasticBlock, StochasticSequential  # noqa: F401
 from .transformation import *  # noqa: F401,F403

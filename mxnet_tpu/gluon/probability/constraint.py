@@ -11,7 +11,7 @@ from __future__ import annotations
 import jax
 import jax.numpy as jnp
 
-from .utils import as_jax
+from .utils import as_jax, wrap
 
 __all__ = [
     "Constraint", "Real", "Boolean", "Interval", "OpenInterval",
@@ -181,3 +181,91 @@ class PositiveDefinite(Constraint):
                       axis=(-2, -1))
         pos = jnp.linalg.eigvalsh(v)[..., 0] > 0
         return sym & pos
+
+
+class IntegerOpenInterval(OpenInterval, _IntegerMixin):
+    def _cond(self, v):
+        return super()._cond(v) & self._int_cond(v)
+
+
+class IntegerHalfOpenInterval(HalfOpenInterval, _IntegerMixin):
+    def _cond(self, v):
+        return super()._cond(v) & self._int_cond(v)
+
+
+class IntegerLessThan(LessThan, _IntegerMixin):
+    def _cond(self, v):
+        return super()._cond(v) & self._int_cond(v)
+
+
+class IntegerLessThanEq(LessThanEq, _IntegerMixin):
+    def _cond(self, v):
+        return super()._cond(v) & self._int_cond(v)
+
+
+class LowerTriangular(Constraint):
+    """Square lower-triangular matrices (reference: constraint.py:426)."""
+
+    def _cond(self, v):
+        return jnp.all(jnp.tril(v) == v, axis=(-2, -1))
+
+
+class Cat(Constraint):
+    """Apply a sequence of constraints to consecutive slices along
+    `axis`, concatenate-style (reference: constraint.py:470)."""
+
+    def __init__(self, constraint_seq, axis=0, lengths=None):
+        if not all(isinstance(c, Constraint) for c in constraint_seq):
+            raise TypeError("constraint_seq must contain Constraints")
+        self._seq = list(constraint_seq)
+        self._lengths = list(lengths) if lengths is not None \
+            else [1] * len(self._seq)
+        if len(self._lengths) != len(self._seq):
+            raise ValueError(
+                f"number of lengths {len(self._lengths)} != number of "
+                f"constraints {len(self._seq)}")
+        self._axis = axis
+
+    def check(self, value):
+        data = jnp.asarray(as_jax(value))
+        total = sum(self._lengths)
+        if data.shape[self._axis] != total:
+            raise ValueError(
+                f"Cat lengths sum to {total} but axis {self._axis} has "
+                f"size {data.shape[self._axis]}")
+        start = 0
+        pieces = []
+        for c, length in zip(self._seq, self._lengths):
+            sl = jnp.take(data, jnp.arange(start, start + length),
+                          axis=self._axis)
+            pieces.append(jnp.asarray(as_jax(c.check(sl))))
+            start += length
+        return wrap(jnp.concatenate(pieces, self._axis))
+
+
+class Stack(Constraint):
+    """Apply one constraint per index along `axis`, stack-style
+    (reference: constraint.py:501; imperative mode only there too)."""
+
+    def __init__(self, constraint_seq, axis=0):
+        if not all(isinstance(c, Constraint) for c in constraint_seq):
+            raise TypeError("constraint_seq must contain Constraints")
+        self._seq = list(constraint_seq)
+        self._axis = axis
+
+    def check(self, value):
+        data = jnp.asarray(as_jax(value))
+        if data.shape[self._axis] != len(self._seq):
+            raise ValueError(
+                f"Stack has {len(self._seq)} constraints but axis "
+                f"{self._axis} has size {data.shape[self._axis]}")
+        parts = jnp.split(data, data.shape[self._axis], axis=self._axis)
+        checked = [
+            jnp.asarray(as_jax(c.check(jnp.squeeze(p, self._axis))))
+            for p, c in zip(parts, self._seq)]
+        return wrap(jnp.stack(checked, self._axis))
+
+
+__all__ += ["IntegerOpenInterval", "IntegerHalfOpenInterval",
+            "IntegerLessThan", "IntegerLessThanEq", "LowerTriangular",
+            "Cat", "Stack"]

@@ -12,7 +12,8 @@ import jax.numpy as jnp
 from ...ndarray.ndarray import NDArray
 
 __all__ = ["prob2logit", "logit2prob", "cached_property", "as_jax", "wrap",
-           "sum_right_most"]
+           "sum_right_most", "constraint_check", "digamma", "gammaln",
+           "erf", "erfinv"]
 
 
 def as_jax(x):
@@ -69,3 +70,43 @@ class cached_property:
         value = self._func(obj)
         obj.__dict__[self._name] = value
         return value
+
+
+# -- reference op getters (distributions/utils.py:34-99: each returns a
+# callable usable on scalars AND tensors) --------------------------------
+
+def constraint_check():
+    from ... import npx
+
+    def _check(condition, err_msg):
+        if isinstance(condition, bool):
+            if not condition:
+                raise ValueError(err_msg)
+            return 1.0
+        return npx.constraint_check(condition, err_msg)
+
+    return _check
+
+
+def _special(jsp_name):
+    def getter():
+        import jax.scipy.special as jsp
+
+        fn = getattr(jsp, jsp_name)
+
+        def compute(value):
+            from numbers import Number
+
+            if isinstance(value, Number):
+                return float(fn(value))
+            return wrap(fn(jnp.asarray(as_jax(value))))
+
+        return compute
+
+    return getter
+
+
+digamma = _special("digamma")
+gammaln = _special("gammaln")
+erf = _special("erf")
+erfinv = _special("erfinv")

@@ -10,9 +10,28 @@ can be registered from Python.
 """
 from .base import KVStoreBase  # noqa: F401
 from .kvstore import KVStore, KVStoreLocal  # noqa: F401
-from . import byteps as _byteps  # noqa: F401 - registers 'byteps'
-from . import horovod as _horovod  # noqa: F401 - registers 'horovod'
+from .byteps import BytePS  # noqa: F401 - registers 'byteps'
+from .horovod import Horovod  # noqa: F401 - registers 'horovod'
 from .tpu_dist import P3Store, TPUDist  # noqa: F401
+
+
+class KVStoreServer:
+    """ps-lite server-role shim (reference: kvstore/kvstore_server.py).
+
+    The reference launches this loop in scheduler/server processes; on
+    TPU the synchronous XLA-collective store has no server role (see
+    docs/distributed_training.md "Why there is no dist_async"), so
+    construction succeeds for import parity and run() explains itself
+    instead of blocking forever."""
+
+    def __init__(self, kvstore):
+        self.kvstore = kvstore
+
+    def run(self):
+        raise RuntimeError(
+            "KVStoreServer has no role on TPU: kvstore='tpu_dist' is "
+            "serverless (XLA collectives over ICI/DCN). Launch workers "
+            "only — see tools/launch.py and docs/distributed_training.md")
 
 
 def create(name="local"):

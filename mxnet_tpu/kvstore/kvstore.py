@@ -34,7 +34,7 @@ class KVStoreLocal(KVStoreBase):
         self._name = name
         self._store = {}
         self._optimizer = None
-        self._updater_states = {}
+        self._updater = None
 
     @property
     def type(self):
@@ -74,13 +74,9 @@ class KVStoreLocal(KVStoreBase):
             agg = vals[0]
             for v in vals[1:]:
                 agg = agg + v.as_in_ctx(agg.device)
-            if self._optimizer is not None:
+            if self._updater is not None:
                 w = self._store[k]
-                if k not in self._updater_states:
-                    self._updater_states[k] = self._optimizer.create_state(
-                        _key_int(k), w)
-                self._optimizer.update(_key_int(k), w, agg.as_in_ctx(w.device),
-                                       self._updater_states[k])
+                self._updater(_key_int(k), agg.as_in_ctx(w.device), w)
             else:
                 self._store[k] = self._store.get(k, 0) + agg
 
@@ -90,15 +86,11 @@ class KVStoreLocal(KVStoreBase):
         agg = vals[0]
         for v in vals[1:]:
             agg = _sparse.add(agg, v)
-        if self._optimizer is not None:
+        if self._updater is not None:
             w = self._store[k]
-            if k not in self._updater_states:
-                self._updater_states[k] = self._optimizer.create_state(
-                    _key_int(k), w)
             grad = agg.todense() if isinstance(
                 agg, _sparse.BaseSparseNDArray) else agg
-            self._optimizer.update(_key_int(k), w, grad.as_in_ctx(w.device),
-                                   self._updater_states[k])
+            self._updater(_key_int(k), grad.as_in_ctx(w.device), w)
         else:
             stored = self._store.get(k)
             self._store[k] = agg if stored is None else _sparse.add(
@@ -180,7 +172,16 @@ class KVStoreLocal(KVStoreBase):
 
     # -- server-side optimizer --------------------------------------------
     def set_optimizer(self, optimizer):
+        from ..optimizer import get_updater
+
         self._optimizer = optimizer
+        # one per-key state/update path shared with the reference's
+        # get_updater contract (multi-precision aware)
+        self._updater = get_updater(optimizer)
+
+    @property
+    def _updater_states(self):
+        return self._updater.states if self._updater is not None else {}
 
     def save_optimizer_states(self, fname, dump_optimizer=False):
         states = {

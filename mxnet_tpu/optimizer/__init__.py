@@ -16,6 +16,7 @@ from .optimizer import (  # noqa: F401
     DCASGD,
     FTML,
     Ftrl,
+    GroupAdaGrad,
     LAMB,
     LANS,
     LARS,
@@ -26,7 +27,9 @@ from .optimizer import (  # noqa: F401
     SGD,
     SGLD,
     Signum,
+    Updater,
     create,
+    get_updater,
     register,
 )
 

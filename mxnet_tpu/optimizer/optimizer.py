@@ -786,3 +786,78 @@ _REG.register(AdaBelief, "adabelief")
 _REG.register(Adamax, "adamax")
 _REG.register(FTML, "ftml")
 _REG.register(LANS, "lans")
+
+
+@register
+class GroupAdaGrad(Optimizer):
+    """AdaGrad with ONE accumulator per row (reference:
+    optimizer/contrib.py:26 GroupAdaGrad): history += mean(g², axis=1,
+    keepdims); w -= lr * g / (sqrt(history) + eps). Weight decay is not
+    supported, matching the reference."""
+
+    def __init__(self, learning_rate=0.01, epsilon=1e-6, **kwargs):
+        super().__init__(learning_rate=learning_rate, **kwargs)
+        if self.wd != 0.0:
+            raise ValueError(
+                "GroupAdaGrad does not support weight decay (reference "
+                "contrib.py:46)")
+        self.epsilon = epsilon
+
+    def create_state(self, index, weight):
+        if len(weight.shape) < 2:
+            raise ValueError(
+                "GroupAdaGrad needs >= 2-d weights (row-wise history)")
+        return _wrap_out(jnp.zeros(
+            (weight.shape[0], 1), weight._data.dtype))
+
+    def _hyper(self):
+        return {"eps": self.epsilon}
+
+    @staticmethod
+    def _rule(w, g, state, lr, wd, hyper):  # noqa: ARG004 - wd unused
+        axes = tuple(range(1, g.ndim))
+        hist = state + jnp.mean(g * g, axis=axes, keepdims=True)
+        return w - lr * g / (jnp.sqrt(hist) + hyper["eps"]), hist
+
+
+class Updater:
+    """kvstore-side updater (reference: optimizer/updater.py:31): the
+    callable a server registers via kv.set_optimizer — keeps one
+    optimizer state per key and applies update(key, grad, weight)."""
+
+    def __init__(self, optimizer):
+        self.optimizer = optimizer
+        self.states = {}
+
+    def __call__(self, index, grad, weight):
+        if not isinstance(index, (list, tuple)):
+            index, grad, weight = [index], [grad], [weight]
+        for i, g, w in zip(index, grad, weight):
+            if isinstance(i, bytes):
+                i = i.decode()
+            if i not in self.states:
+                self.states[i] = \
+                    self.optimizer.create_state_multi_precision(i, w)
+            self.optimizer.update_multi_precision(i, w, g, self.states[i])
+
+    def set_states(self, states):
+        import pickle
+
+        payload = pickle.loads(states)
+        if isinstance(payload, dict) and "optimizer" in payload:
+            self.optimizer = payload["optimizer"]
+            payload = payload["states"]
+        self.states = payload
+
+    def get_states(self, dump_optimizer=False):
+        import pickle
+
+        if dump_optimizer:
+            return pickle.dumps({"states": self.states,
+                                 "optimizer": self.optimizer})
+        return pickle.dumps(self.states)
+
+
+def get_updater(optimizer):
+    """Reference optimizer/updater.py:get_updater."""
+    return Updater(optimizer)

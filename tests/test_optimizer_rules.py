@@ -152,3 +152,45 @@ def test_every_optimizer_moves_weights(name):
     got = _step(opt, W0, G0)
     assert onp.isfinite(got).all()
     assert (got != W0).any()
+
+
+def test_group_adagrad_row_wise_history():
+    """Reference contrib.py:26: one accumulator per ROW; wd rejected."""
+    import numpy as onp
+    import pytest as _pytest
+
+    import mxnet_tpu as mx
+    from mxnet_tpu import optimizer as opt
+
+    o = opt.GroupAdaGrad(learning_rate=0.5, epsilon=1e-6)
+    w = mx.nd.array(onp.ones((3, 4), "f"))
+    g = mx.nd.array(onp.arange(12, dtype="f").reshape(3, 4) / 10)
+    state = o.create_state(0, w)
+    assert state.shape == (3, 1)
+    o.update(0, w, g, state)
+    gref = onp.arange(12, dtype="f").reshape(3, 4) / 10
+    hist = (gref ** 2).mean(axis=1, keepdims=True)
+    want = 1.0 - 0.5 * gref / (onp.sqrt(hist) + 1e-6)
+    onp.testing.assert_allclose(w.asnumpy(), want, rtol=1e-5)
+    onp.testing.assert_allclose(state.asnumpy(), hist, rtol=1e-5)
+    with _pytest.raises(ValueError):
+        opt.GroupAdaGrad(wd=0.1)
+
+
+def test_updater_kvstore_callable():
+    """Reference optimizer/updater.py: updater(key, grad, weight) keeps
+    per-key state and applies the optimizer; get/set_states round-trip."""
+    import numpy as onp
+
+    import mxnet_tpu as mx
+    from mxnet_tpu import optimizer as opt
+
+    upd = opt.get_updater(opt.SGD(learning_rate=1.0))
+    w = mx.nd.array(onp.ones(4, "f"))
+    g = mx.nd.array(onp.full(4, 0.25, "f"))
+    upd("w0", g, w)
+    onp.testing.assert_allclose(w.asnumpy(), onp.full(4, 0.75), rtol=1e-6)
+    blob = upd.get_states()
+    upd2 = opt.get_updater(opt.SGD(learning_rate=1.0))
+    upd2.set_states(blob)
+    assert set(upd2.states) == {"w0"}

@@ -446,3 +446,50 @@ def test_stochastic_sequential():
     out = seq(np.zeros((2,)))
     onp.testing.assert_allclose(_np(out), [2.0, 2.0])
     assert len(seq.losses) == 2
+
+
+def test_constraint_surface_parity():
+    """Reference distributions/constraint.py full class list: the
+    integer interval/lessthan family, LowerTriangular, and the Cat/Stack
+    combinators (constraint.py:184-520)."""
+    import numpy as onp
+    import pytest as _pytest
+
+    from mxnet_tpu.gluon import probability as P
+
+    P.IntegerOpenInterval(0, 5).check(mx.np.array([1.0, 4.0]))
+    with _pytest.raises(ValueError):
+        P.IntegerOpenInterval(0, 5).check(mx.np.array([0.0]))  # open edge
+    with _pytest.raises(ValueError):
+        P.IntegerHalfOpenInterval(0, 5).check(mx.np.array([2.5]))  # non-int
+    P.IntegerLessThan(3).check(mx.np.array([2.0, -1.0]))
+    with _pytest.raises(ValueError):
+        P.IntegerLessThanEq(3).check(mx.np.array([4.0]))
+    P.LowerTriangular().check(mx.np.array(onp.tril(onp.ones((3, 3), "f"))))
+    with _pytest.raises(ValueError):
+        P.LowerTriangular().check(mx.np.array(onp.ones((3, 3), "f")))
+    # Cat: per-slice constraints; a violation in any slice raises
+    cat = P.Cat([P.Positive(), P.Real()], axis=0, lengths=[2, 1])
+    out = cat.check(mx.np.array([1.0, 2.0, -5.0]))
+    assert out.shape == (3,)
+    with _pytest.raises(ValueError):
+        cat.check(mx.np.array([-1.0, 2.0, 0.0]))
+    # Stack: one constraint per index along axis
+    st = P.Stack([P.Positive(), P.Real()], axis=0)
+    st.check(mx.np.array([[1.0], [-2.0]]))
+    with _pytest.raises(ValueError):
+        st.check(mx.np.array([[-1.0], [0.0]]))
+
+
+def test_utils_special_getters_match_scipy_forms():
+    import numpy as onp
+
+    from mxnet_tpu.gluon import probability as P
+
+    # scalar path and tensor path agree
+    onp.testing.assert_allclose(P.digamma()(2.0), 0.4227843, rtol=1e-5)
+    onp.testing.assert_allclose(
+        P.digamma()(mx.np.array([2.0])).asnumpy(), [0.4227843], rtol=1e-5)
+    onp.testing.assert_allclose(P.gammaln()(3.0), onp.log(2.0), rtol=1e-5)
+    onp.testing.assert_allclose(P.erfinv()(0.5), 0.4769363, rtol=1e-4)
+    assert P.constraint_check()(True, "msg") == 1.0
