@@ -194,3 +194,64 @@ from ..numpy import (  # noqa: F401,E402
 )
 
 ElementWiseSum = globals()["add_n"]  # noqa: N816
+
+
+# sparse classes at the package level (reference: from mxnet.ndarray
+# import CSRNDArray — ndarray/__init__ re-exports sparse.*)
+from .sparse import (  # noqa: F401,E402
+    BaseSparseNDArray,
+    CSRNDArray,
+    RowSparseNDArray,
+)
+
+
+class CachedOp:
+    """Callable compiled graph over a Symbol (reference:
+    _ctypes/cached_op.py CachedOp — the imperative-invoke handle the
+    frontends build from a symbol). TPU-native: the symbol lowers to a
+    pure jax function jitted once; positional args bind to
+    list_arguments() order, like the reference's C handle."""
+
+    def __init__(self, sym, flags=(), thread_safe=False):  # noqa: ARG002
+        import jax
+
+        self._sym = sym
+        self._arg_names = sym.list_arguments()
+        self._jitted = jax.jit(sym._lower())
+
+    def get_optimized_symbol(self):
+        """The reference returns the pass-optimized symbol; XLA does the
+        optimization below this API, so the original symbol IS the
+        optimized graph handle."""
+        return self._sym
+
+    def __call__(self, *args, out=None, **kwargs):
+        if kwargs:
+            raise TypeError(
+                f"CachedOp got unexpected keyword argument(s) "
+                f"{sorted(kwargs)}; inputs are positional "
+                f"({self._arg_names}) and only out= is accepted")
+        if len(args) != len(self._arg_names):
+            raise ValueError(
+                f"CachedOp expects {len(self._arg_names)} inputs "
+                f"({self._arg_names}), got {len(args)}")
+        names = self._arg_names
+        jitted = self._jitted
+
+        def pure(*datas):
+            return jitted(dict(zip(names, datas)))
+
+        # apply_op: outputs join the autograd tape, so backward through
+        # a CachedOp result works like any other op
+        res = apply_op(pure, *args, name="CachedOp")
+        outs = list(res) if isinstance(res, (list, tuple)) else [res]
+        if out is not None:
+            outs_l = out if isinstance(out, (list, tuple)) else [out]
+            if len(outs_l) != len(outs):
+                raise ValueError(
+                    f"CachedOp produced {len(outs)} outputs but out= "
+                    f"has {len(outs_l)} destinations")
+            for o, r in zip(outs_l, outs):
+                r.copyto(o)
+            return out
+        return outs if len(outs) > 1 else outs[0]
