@@ -415,3 +415,47 @@ def test_upsampling_nearest():
     got = npx.upsampling(A(x), scale=2, sample_type="nearest")
     want = x.repeat(2, axis=2).repeat(2, axis=3)
     _chk(got, want)
+
+
+def test_batch_norm_running_stat_momentum_convention():
+    """Reference batch_norm.cc:270-273: new = OLD*momentum +
+    batch*(1-momentum) — the REVERSE of torch's convention. A ported
+    checkpoint's running stats drift wrong if this flips."""
+    x = rs.rand(8, 3, 4, 4).astype("f")
+    gamma = onp.ones(3, "f")
+    beta = onp.zeros(3, "f")
+    rm = onp.full(3, 10.0, "f")
+    rv = onp.full(3, 4.0, "f")
+    # ops-level op returns the stat triple (the npx wrapper routes the
+    # updates through the gluon state sink instead)
+    import jax.numpy as jnp
+
+    from mxnet_tpu.ops.nn import batch_norm as bn_op
+
+    _, new_m, new_v = bn_op(jnp.asarray(x), jnp.asarray(gamma),
+                            jnp.asarray(beta), jnp.asarray(rm),
+                            jnp.asarray(rv), momentum=0.9, training=True)
+    bm = x.mean(axis=(0, 2, 3))
+    bv = x.var(axis=(0, 2, 3))
+    _chk(new_m, rm * 0.9 + bm * 0.1, tol=1e-4)
+    _chk(new_v, rv * 0.9 + bv * 0.1, tol=1e-3)
+
+
+def test_batch_norm_layer_updates_running_stats_through_training():
+    """The gluon BatchNorm layer must push the per-batch stat updates
+    back into its aux params across hybridized steps."""
+    from mxnet_tpu import gluon
+
+    net = gluon.nn.BatchNorm(in_channels=3)
+    net.initialize()
+    net.hybridize()
+    m0 = net.running_mean.data().asnumpy().copy()
+    x = A(rs.rand(16, 3, 5, 5).astype("f") + 2.0)
+    for _ in range(3):
+        with autograd.record(train_mode=True):
+            y = net(x)
+        y.backward()
+    m1 = net.running_mean.data().asnumpy()
+    assert not onp.allclose(m0, m1), "running mean never moved"
+    # converging toward the batch mean (~2.5), from init 0
+    assert (m1 > 0.4).all() and (m1 < 3.0).all()
