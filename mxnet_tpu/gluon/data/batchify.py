@@ -20,10 +20,15 @@ def _stack_arrs(arrs):
 
 def default_batchify_fn(data):
     """Stack samples; tuples are batchified per-field (reference:
-    dataloader.py default_batchify_fn)."""
+    dataloader.py default_batchify_fn). Dict samples batch per key — an
+    extension beyond the reference (which errors on dicts), matching
+    the dataset idioms modern pipelines use."""
     if isinstance(data[0], (tuple, list)):
         return tuple(default_batchify_fn([d[i] for d in data])
                      for i in range(len(data[0])))
+    if isinstance(data[0], dict):
+        return {k: default_batchify_fn([d[k] for d in data])
+                for k in data[0]}
     return _stack_arrs(data)
 
 

@@ -62,6 +62,13 @@ class DataLoader:
                 raise ValueError("shuffle and sampler are exclusive")
             batch_sampler = BatchSampler(sampler, batch_size,
                                          last_batch or "keep")
+        elif batch_size is not None or shuffle or sampler is not None \
+                or last_batch is not None:
+            # reference dataloader.py: batch_sampler owns the batching —
+            # a conflicting spec is an error, not silently ignored
+            raise ValueError(
+                "batch_size, shuffle, sampler and last_batch must not "
+                "be specified if batch_sampler is specified")
         self._batch_sampler = batch_sampler
         self._num_workers = max(0, num_workers)
         self._thread_pool = bool(thread_pool)
@@ -99,6 +106,8 @@ class DataLoader:
         def has_nd(x):
             if isinstance(x, (tuple, list)):
                 return any(has_nd(i) for i in x)
+            if isinstance(x, dict):  # dict samples batch per key now
+                return any(has_nd(v) for v in x.values())
             return isinstance(x, NDArray)
 
         if self._fork_safe_cache is None:
