@@ -89,6 +89,7 @@ from . import util  # noqa: E402
 from . import runtime  # noqa: E402
 from . import profiler  # noqa: E402
 from . import test_utils  # noqa: E402  (mx.test_utils like the reference)
+from . import amp  # noqa: E402  (mx.amp — reference: python/mxnet/amp/)
 
 waitall = engine.waitall
 

@@ -16,28 +16,102 @@ import numpy as _np
 from ..base import normalize_dtype
 from ..ndarray.ndarray import NDArray
 
+from . import lists  # noqa: E402  (reference: amp/lists/ cast tables)
+
 __all__ = ["init", "init_trainer", "scale_loss", "unscale",
-           "convert_hybrid_block", "convert_model", "LossScaler",
-           "list_lp16_ops", "list_fp32_ops"]
+           "convert_hybrid_block", "convert_model", "convert_symbol",
+           "LossScaler", "lists", "warn_if_model_exists",
+           "list_lp16_ops", "list_fp32_ops", "list_lp16_fp32_ops",
+           "list_conditional_fp32_ops", "list_widest_type_cast",
+           "list_loss_output_functions", "list_lp16_use_fp32_params"]
 
 _initialized = False
 _target_dtype = "bfloat16"
 
-# op classes that stay fp32 under AMP (the reference's FP32_FUNCS analog):
-# softmax/log/exp/norms accumulate in fp32 inside their implementations.
-_FP32_OPS = ["softmax", "log_softmax", "batch_norm", "layer_norm",
-             "group_norm", "instance_norm", "rms_norm", "norm", "mean",
-             "sum", "exp", "log"]
-_LP16_OPS = ["convolution", "deconvolution", "fully_connected", "matmul",
-             "dot", "einsum", "rnn"]
+# back-compat aliases of the canonical tables in lists/symbol_bf16.py
+_FP32_OPS = lists.symbol_bf16.FP32_FUNCS
+_LP16_OPS = lists.symbol_bf16.BF16_FUNCS
 
 
 def list_lp16_ops(target_dtype="bfloat16"):  # noqa: ARG001
+    """Reference: amp/amp.py:769 — both fp16 and bf16 answer the TPU
+    (bf16) table; see lists/symbol_fp16.py."""
     return list(_LP16_OPS)
 
 
 def list_fp32_ops(target_dtype="bfloat16"):  # noqa: ARG001
     return list(_FP32_OPS)
+
+
+def list_lp16_fp32_ops(target_dtype="bfloat16"):  # noqa: ARG001
+    """Ops that run in either precision (reference: amp/amp.py:787)."""
+    return list(lists.symbol_bf16.BF16_FP32_FUNCS)
+
+
+def list_conditional_fp32_ops(target_dtype="bfloat16"):  # noqa: ARG001
+    return list(lists.symbol_bf16.CONDITIONAL_FP32_FUNCS)
+
+
+def list_widest_type_cast(target_dtype="bfloat16"):  # noqa: ARG001
+    return list(lists.symbol_bf16.WIDEST_TYPE_CASTS)
+
+
+def list_loss_output_functions(target_dtype="bfloat16"):  # noqa: ARG001
+    return list(lists.symbol_bf16.LOSS_OUTPUT_FUNCTIONS)
+
+
+def list_lp16_use_fp32_params(target_dtype="bfloat16"):  # noqa: ARG001
+    """Reference: amp/amp.py:823 — None for fp16; the param-restrict map
+    for bf16."""
+    if target_dtype in ("float16", "fp16", _np.float16):
+        return None
+    return dict(lists.symbol_bf16.BF16_USE_FP32_PARAMS)
+
+
+def warn_if_model_exists():
+    """Warn about Blocks created before amp.init (reference:
+    amp/amp.py:301 — walks the caller stack for Block locals)."""
+    import inspect
+    import logging
+
+    from ..gluon.block import Block
+
+    for f in inspect.stack():
+        for k, v in f.frame.f_locals.items():
+            if isinstance(v, Block):
+                logging.warning("Block %s created in [%s:%d] before "
+                                "AMP init.", k, f.filename, f.lineno)
+                return
+
+
+def convert_symbol(sym, target_dtype="bfloat16", target_dtype_ops=None,
+                   fp32_ops=None, conditional_fp32_ops=None,
+                   excluded_sym_names=None, data_names=None,
+                   cast_optional_params=False):  # noqa: ARG001
+    """Convert a Symbol to mixed precision (reference: amp/amp.py:430
+    low_precision_pass over the nnvm graph). TPU-native: wraps the DAG in
+    one `_amp_graph` node whose lowering traces the original graph to a
+    jaxpr and rewrites it under the cast lists (amp.graph_pass.
+    amp_rewrite) — outputs keep their original dtypes, matmuls/convs run
+    bf16 on the MXU."""
+    from ..symbol.symbol import Symbol
+
+    if not isinstance(sym, Symbol):
+        raise TypeError(f"convert_symbol expects a Symbol, got {type(sym)}")
+    dt = "bfloat16" if target_dtype in ("float16", "fp16", "bfloat16",
+                                        "bf16", _np.float16) \
+        else str(target_dtype)
+    leaves = {}
+    for s in sym._topo():
+        if s._op is None and s._name not in leaves:
+            leaves[s._name] = s
+    import json as _json
+    return Symbol.create(
+        "_amp_graph", *leaves.values(), name=f"amp_{sym.name}",
+        nout=len(sym.list_outputs()),
+        subgraph=sym.tojson(),
+        in_names=_json.dumps(list(leaves)),
+        target_dtype=dt)
 
 
 def init(target_dtype="bfloat16", target_precision_ops=None,
@@ -170,3 +244,33 @@ class LossScaler:
 
 from . import graph_pass  # noqa: E402
 from .graph_pass import convert_block_graph  # noqa: E402
+
+
+def _amp_graph_lower(ins, attrs):
+    """Symbol-op lowering for convert_symbol's `_amp_graph` node: rebuild
+    the wrapped DAG, trace it to a jaxpr at the incoming shapes, and run
+    it under the AMP cast lists."""
+    import json as _json
+
+    import jax
+
+    from ..symbol.symbol import fromjson
+    from .graph_pass import amp_rewrite
+
+    subfn = fromjson(attrs["subgraph"])._lower()
+    names = _json.loads(attrs["in_names"])
+    dt = jnp.bfloat16 if attrs["target_dtype"] in ("bfloat16", "bf16") \
+        else jnp.dtype(attrs["target_dtype"])
+    closed = jax.make_jaxpr(
+        lambda *xs: tuple(subfn(dict(zip(names, xs)))))(*ins)
+    outs = amp_rewrite(closed, dt)(*ins)
+    return tuple(outs) if len(outs) > 1 else outs[0]
+
+
+def _register_amp_sym_op():
+    from ..symbol.symbol import register_sym_op
+
+    register_sym_op("_amp_graph", _amp_graph_lower)
+
+
+_register_amp_sym_op()

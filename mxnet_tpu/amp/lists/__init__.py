@@ -1,0 +1,2 @@
+"""AMP op cast lists (reference: python/mxnet/amp/lists/__init__.py)."""
+from . import symbol_bf16, symbol_fp16  # noqa: F401

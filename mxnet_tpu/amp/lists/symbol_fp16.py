@@ -1,0 +1,15 @@
+"""fp16 AMP cast lists (reference: amp/lists/symbol_fp16.py).
+
+TPU note: the MXU computes in bf16; float16 is supported for storage/API
+compatibility, and its cast policy is the bf16 policy (same op classes,
+same accumulation-sensitivity analysis) — kept as a distinct module so
+reference spellings (`amp.lists.symbol_fp16.FP16_FUNCS`) resolve.
+"""
+from .symbol_bf16 import (
+    BF16_FP32_FUNCS as FP16_FP32_FUNCS,  # noqa: F401
+    BF16_FUNCS as FP16_FUNCS,  # noqa: F401
+    CONDITIONAL_FP32_FUNCS,  # noqa: F401
+    FP32_FUNCS,  # noqa: F401
+    LOSS_OUTPUT_FUNCTIONS,  # noqa: F401
+    WIDEST_TYPE_CASTS,  # noqa: F401
+)

@@ -4,7 +4,10 @@ from . import dgl  # noqa: F401
 from . import io  # noqa: F401
 from . import ops  # noqa: F401
 from . import ops as nd  # noqa: F401  (reference spelling: mx.contrib.nd)
+from . import ops as ndarray  # noqa: F401  (reference: contrib/ndarray.py)
 from . import ops as symbol  # noqa: F401  (reference: contrib/symbol.py)
+from .. import onnx  # noqa: F401  (reference: contrib/onnx/ — export_model
+#                      moved to the top-level onnx package upstream too)
 from . import quantization  # noqa: F401
 from . import tensorboard  # noqa: F401
 from . import text  # noqa: F401

@@ -1,4 +1,4 @@
 """Model zoo (reference: python/mxnet/gluon/model_zoo/)."""
-from . import bert, vision  # noqa: F401
+from . import bert, model_store, vision  # noqa: F401
 from .bert import bert_12_768_12, bert_24_1024_16, get_bert_model  # noqa: F401
 from .vision import get_model  # noqa: F401

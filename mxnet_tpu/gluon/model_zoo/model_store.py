@@ -1,0 +1,63 @@
+"""Pretrained-weight store (reference: gluon/model_zoo/model_store.py —
+short_hash / get_model_file / purge over an S3-backed cache).
+
+TPU re-design note: this environment has no network egress, so the store
+resolves ONLY against the local cache root (MXNET_HOME, default
+~/.mxnet/models) — same directory layout and filename convention
+(`<name>-<8-char-hash>.params`) as the reference, so caches populated by
+reference tooling are picked up directly.
+"""
+import os
+
+__all__ = ["get_model_file", "purge"]
+
+# model name -> 8-char content hash prefix (reference: _model_sha1).
+# Entries appear here when golden checkpoints ship in the local cache;
+# unknown models still resolve by filename glob below.
+_model_sha1 = {}
+
+
+def short_hash(name):
+    """8-char hash prefix for a registered model name (reference:
+    model_store.py short_hash)."""
+    if name not in _model_sha1:
+        raise ValueError(
+            f"Pretrained model for {name} is not available.")
+    return _model_sha1[name][:8]
+
+
+def _root():
+    return os.path.expanduser(
+        os.environ.get("MXNET_HOME", os.path.join("~", ".mxnet", "models")))
+
+
+def get_model_file(name, root=None):
+    """Locate `<name>-<hash>.params` in the local cache (reference:
+    model_store.py get_model_file; download is not available here —
+    zero-egress environment — so a missing file raises with the path the
+    user should place weights at)."""
+    root = os.path.expanduser(root or _root())
+    if name in _model_sha1:
+        path = os.path.join(root, f"{name}-{short_hash(name)}.params")
+        if os.path.exists(path):
+            return path
+    if os.path.isdir(root):
+        import glob
+
+        hits = sorted(glob.glob(os.path.join(root, f"{name}-????????.params")))
+        if hits:
+            return hits[-1]
+    raise FileNotFoundError(
+        f"no cached weights for {name!r} under {root}; this environment "
+        f"has no network egress — place <name>-<hash>.params there "
+        f"manually (reference layout)")
+
+
+def purge(root=None):
+    """Remove all cached model files (reference: model_store.py purge)."""
+    root = os.path.expanduser(root or _root())
+    if not os.path.isdir(root):
+        return
+    for f in os.listdir(root):
+        if f.endswith(".params"):
+            os.remove(os.path.join(root, f))

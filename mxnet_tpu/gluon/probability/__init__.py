@@ -6,6 +6,9 @@ jax.numpy + jax.random (XLA-fused, reparameterized where the reference is),
 with the framework's stateful-RNG facade supplying PRNG keys.
 """
 from . import constraint  # noqa: F401
+from . import stochastic_block as block  # noqa: F401  (reference path:
+#                      gluon/probability/block/stochastic_block.py)
+from . import distributions  # noqa: F401  (reference subpackage spelling)
 from .constraint import *  # noqa: F401,F403
 from .continuous import *  # noqa: F401,F403
 from .discrete import *  # noqa: F401,F403

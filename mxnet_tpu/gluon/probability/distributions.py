@@ -1,0 +1,11 @@
+"""`mx.gluon.probability.distributions` (reference path:
+gluon/probability/distributions/ — one file per distribution). This
+package keeps distributions in family modules (continuous/discrete/
+multivariate/transformed); this module re-exports them under the
+reference's subpackage spelling."""
+from .continuous import *  # noqa: F401,F403
+from .discrete import *  # noqa: F401,F403
+from .distribution import Distribution, ExponentialFamily  # noqa: F401
+from .divergence import kl_divergence, register_kl  # noqa: F401
+from .multivariate import *  # noqa: F401,F403
+from .transformed_distribution import TransformedDistribution  # noqa: F401

@@ -353,3 +353,5 @@ class PrefetchingIter(DataIter):
 
 
 from .image_record import ImageRecordIter  # noqa: E402  (needs DataIter above)
+
+from . import io  # noqa: F401,E402  (reference spelling: mx.io.io.*)

@@ -1,0 +1,19 @@
+"""`mx.io.io` — the reference keeps the iterator classes in io/io.py and
+re-exports them from the package (`from .io import *`); mirror that
+spelling for scripts that import the inner module directly."""
+from . import (  # noqa: F401
+    CSVIter,
+    DataBatch,
+    DataDesc,
+    DataIter,
+    ImageRecordIter,
+    LibSVMIter,
+    MNISTIter,
+    NDArrayIter,
+    PrefetchingIter,
+    ResizeIter,
+)
+
+__all__ = ["DataDesc", "DataBatch", "DataIter", "NDArrayIter", "CSVIter",
+           "LibSVMIter", "MNISTIter", "ImageRecordIter", "ResizeIter",
+           "PrefetchingIter"]

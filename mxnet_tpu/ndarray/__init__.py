@@ -24,8 +24,20 @@ from ..numpy import (  # noqa: F401
 from . import linalg  # noqa: F401
 from . import random  # noqa: F401
 from . import sparse  # noqa: F401
+from . import _internal  # noqa: F401
+from . import image  # noqa: F401
+from . import op  # noqa: F401
 from .ndarray import NDArray, apply_op, from_jax, waitall  # noqa: F401
+from . import contrib  # noqa: F401  (after .ndarray: contrib ops use apply_op)
 from .register import make_eager, populate
+
+# numpy-flavored submodules under the legacy package (reference:
+# ndarray/__init__.py:20 imports .numpy / .numpy_extension; here the
+# numpy frontend is one shared package, not re-generated per frontend)
+from .. import numpy  # noqa: F401,E402
+from .. import numpy as np  # noqa: F401,E402
+from .. import numpy_extension  # noqa: F401,E402
+from .. import numpy_extension as npx  # noqa: F401,E402
 from .utils import load, save, savez  # noqa: F401
 
 

@@ -1,14 +1,21 @@
 """Internal op namespace (reference: mxnet/ndarray/_internal.py — the
 codegen target for `_`-prefixed ops). Attribute access resolves through
-the op registry, same as _api_internal."""
+the op registry, same as _api_internal, wrapped eager (async dispatch +
+autograd taping)."""
 from ..ops.registry import _OPS
+from .register import make_eager
+
+_CACHE = {}
 
 
 def __getattr__(name):
+    if name in _CACHE:
+        return _CACHE[name]
     for cand in (name, f"_{name}", f"_npi_{name}"):
         fn = _OPS.get(cand)
         if fn is not None:
-            return fn
+            eager = _CACHE[name] = make_eager(cand, fn)
+            return eager
     raise AttributeError(f"no registered internal op {name!r}")
 
 

@@ -1,4 +1,76 @@
 """`mx.nd.contrib` namespace (reference: mxnet/ndarray/contrib.py).
-The contrib op corpus under its legacy spelling."""
+
+Two populations, same as the reference file: the contrib op corpus under
+its legacy spelling (generated there, registry-driven here), and the
+hand-written helpers the reference defines directly in
+ndarray/contrib.py — control flow (foreach:139, while_loop:233, cond:401),
+the float-test trio (isinf:467, isfinite:493, isnan:522), and
+rand_zipfian:39.
+"""
+import math
+
 from ..contrib.ops import *  # noqa: F401,F403
-from ..contrib.ops import __all__  # noqa: F401
+from ..contrib.ops import __all__ as _ops_all
+
+# control flow: eager versions lower to lax.scan/while_loop
+# (reference routes these through a CachedOp over a cut subgraph;
+# numpy_extension.control_flow is the shared TPU-native implementation)
+from ..numpy_extension.control_flow import (  # noqa: F401
+    foreach,
+    while_loop,
+)
+
+
+def cond(pred, then_func, else_func):
+    """Eager if-then-else (reference: ndarray/contrib.py:401): `pred` is a
+    scalar NDArray; then/else take NO arguments and close over their
+    operands; only the taken branch executes (and is taped)."""
+    import numpy as _onp
+
+    branch = bool(_onp.asarray(
+        pred.asnumpy() if hasattr(pred, "asnumpy") else pred).reshape(()))
+    return then_func() if branch else else_func()
+
+__all__ = list(_ops_all) + [
+    "foreach", "while_loop", "cond",
+    "isinf", "isfinite", "isnan", "rand_zipfian",
+]
+
+
+def isinf(data):
+    """1.0 where the element is +/-inf, else 0.0 (reference:
+    ndarray/contrib.py:467 — returns float, not bool)."""
+    return (abs(data) == float("inf")).astype(data.dtype)
+
+
+def isfinite(data):
+    """1.0 where the element is finite (reference: ndarray/contrib.py:493)."""
+    not_nan = data == data
+    not_inf = abs(data) != float("inf")
+    return (not_inf * not_nan).astype(data.dtype)
+
+
+def isnan(data):
+    """1.0 where the element is NaN (reference: ndarray/contrib.py:522)."""
+    return (data != data).astype(data.dtype)
+
+
+def rand_zipfian(true_classes, num_sampled, range_max, ctx=None):  # noqa: ARG001
+    """Log-uniform (Zipfian) candidate sampler (reference:
+    ndarray/contrib.py:39): P(class) = (log(class+2) - log(class+1)) /
+    log(range_max+1). Returns (samples int, expected_count_true,
+    expected_count_sampled)."""
+    from ..numpy import random as _random
+
+    log_range = math.log(range_max + 1)
+    rand = _random.uniform(0, log_range, size=(num_sampled,))
+    sampled_classes = (rand.exp() - 1).astype("int64") % range_max
+
+    true_cls = true_classes.astype("float64")
+    expected_count_true = (
+        ((true_cls + 2.0) / (true_cls + 1.0)).log() / log_range * num_sampled)
+    sampled_f = sampled_classes.astype("float64")
+    expected_prob_sampled = (
+        ((sampled_f + 2.0) / (sampled_f + 1.0)).log() / log_range)
+    return sampled_classes, expected_count_true, \
+        expected_prob_sampled * num_sampled

@@ -1,15 +1,43 @@
 """`mx.nd.image` namespace (reference: mxnet/ndarray/image.py — the
-_image_* op family under short names)."""
+_image_* op family under short names), wrapped eager."""
 from ..ops.registry import _OPS
+from .register import make_eager
 
 __all__ = ["resize", "crop", "to_tensor", "normalize", "random_crop",
            "random_resized_crop"]
 
+_CACHE = {}
+
+
+def resize(src, size=None, keep_ratio=False, interp=1):
+    """Reference signature (image/resize.cc): `size` is int or (w, h);
+    int + keep_ratio scales the SHORT side with floor division for the
+    long side (image.py:413 resize_short semantics). Maps onto the
+    registry's `_image_resize(src, w, h, interp)`."""
+    if size is None:
+        raise ValueError("resize requires size")
+    if isinstance(size, int):
+        if keep_ratio:
+            h, w = int(src.shape[-3]), int(src.shape[-2])
+            size = (max(1, size * w // h), size) if h < w \
+                else (size, max(1, size * h // w))
+        else:
+            size = (size, size)
+    w, h = size
+    fn = _CACHE.get("_resize_eager")
+    if fn is None:
+        fn = _CACHE["_resize_eager"] = make_eager("_image_resize",
+                                                  _OPS["_image_resize"])
+    return fn(src, w, h, interp=interp)
+
 
 def __getattr__(name):
+    if name in _CACHE:
+        return _CACHE[name]
     fn = _OPS.get(f"_image_{name}")
     if fn is not None:
-        return fn
+        eager = _CACHE[name] = make_eager(f"_image_{name}", fn)
+        return eager
     raise AttributeError(f"mx.nd.image has no op {name!r}")
 
 

@@ -348,9 +348,31 @@ class NDArray:
     # ------------------------------------------------------------------
     # shape manipulation (differentiable, taped via apply_op)
     # ------------------------------------------------------------------
-    def reshape(self, *shape, **kwargs):  # noqa: ARG002
+    def reshape(self, *shape, **kwargs):
+        """Reshape supporting the reference's special codes on the METHOD
+        (reference: ndarray/ndarray.py:1446-1501 — 0 copy-dim, -1 infer,
+        -2 copy-rest, -3 merge-two, -4 split, `reverse=1` right-to-left).
+
+        One class serves both frontends here, so dispatch is by content:
+        plain dims and -1 are numpy-identical; -2/-3/-4, `reverse`, and a
+        0 against a non-empty array (numpy would error) take the legacy
+        path. A 0 with an empty array keeps numpy semantics."""
+        reverse = bool(kwargs.pop("reverse", False))
+        if not shape and "shape" in kwargs:
+            shape = (kwargs.pop("shape"),)  # a.reshape(shape=(m, n))
+        kwargs.pop("order", None)  # numpy-style kwarg; only 'C' layouts here
+        if kwargs:
+            raise TypeError(f"reshape got unexpected kwargs {sorted(kwargs)}")
         if len(shape) == 1 and isinstance(shape[0], (tuple, list)):
             shape = tuple(shape[0])
+        shape = tuple(int(d) for d in shape)
+        legacy = reverse or any(d in (-2, -3, -4) for d in shape) \
+            or (0 in shape and self.size != 0)
+        if legacy:
+            from ..ops.tensor import legacy_reshape_shape
+
+            new_shape = legacy_reshape_shape(self.shape, shape, reverse)
+            return apply_op(lambda x: jnp.reshape(x, new_shape), self)
         return apply_op(lambda x: jnp.reshape(x, shape), self)
 
     def transpose(self, *axes):

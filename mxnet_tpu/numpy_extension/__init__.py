@@ -15,6 +15,8 @@ from ..ndarray.ndarray import NDArray, apply_op
 from ..ops import nn as _nn
 
 from .control_flow import cond, foreach, while_loop  # noqa: F401
+from . import image  # noqa: F401  (mx.npx.image — reference:
+#                      numpy_extension/image.py op-family namespace)
 
 __all__ = [
     "cond", "foreach", "while_loop",

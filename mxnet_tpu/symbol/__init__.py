@@ -19,6 +19,17 @@ from . import op_extended  # math tail, indexing, sequence, norms
 from .op_extended import *  # noqa: F401,F403
 from . import register as _register  # generated builders for the full
 #                                      registry (reference: symbol/register.py)
+from . import contrib  # noqa: F401  (symbolic control flow + contrib ops)
+from . import sparse  # noqa: F401
+from . import image  # noqa: F401
+from . import _internal  # noqa: F401
+
+# numpy-flavored submodules (reference: symbol/__init__.py imports
+# .numpy / .numpy_extension; shared frontend here — see ndarray/__init__)
+from .. import numpy  # noqa: F401
+from .. import numpy as np  # noqa: F401
+from .. import numpy_extension  # noqa: F401
+from .. import numpy_extension as npx  # noqa: F401
 
 __all__ = (["Symbol", "Variable", "Group", "Executor", "var", "load",
             "load_json", "fromjson", "zeros", "ones"]

@@ -1,5 +1,230 @@
 """`mx.sym.contrib` namespace (reference: mxnet/symbol/contrib.py).
-Eager contrib implementations double as symbol-graph builders through the
-generic symbol op mechanism where registered; unregistered names raise."""
+
+Two populations, same as the reference file: the contrib op corpus (the
+generic symbol-op mechanism covers every registered contrib op), and the
+hand-written *symbolic control flow* — foreach:212, while_loop:375,
+cond:598.
+
+TPU re-design of control flow: the reference cuts the body into an nnvm
+subgraph and ships it to a specialized C++ op (control_flow.cc). Here the
+body is traced into a sub-Symbol whose JSON is stored as a node attr, and
+the node's lowering rebuilds the subgraph and wraps it in lax.scan /
+lax.while_loop / lax.cond — so a serialized graph (tojson/save) carries
+its loops, and XLA compiles them as native control-flow HLOs.
+"""
+import json as _json
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
 from ..contrib.ops import *  # noqa: F401,F403
-from ..contrib.ops import __all__  # noqa: F401
+from ..contrib.ops import __all__ as _ops_all
+from .symbol import Group, Symbol, fromjson, register_sym_op, var
+
+__all__ = list(_ops_all) + ["foreach", "while_loop", "cond"]
+
+_SUBGRAPH_CACHE = {}  # json string -> lowered fn (avoid re-parse per trace)
+
+
+def _lowered(js):
+    fn = _SUBGRAPH_CACHE.get(js)
+    if fn is None:
+        fn = _SUBGRAPH_CACHE[js] = fromjson(js)._lower()
+    return fn
+
+
+def _as_list(x):
+    return list(x) if isinstance(x, (list, tuple)) else [x]
+
+
+def _capture_leaves(sub, bound_names):
+    """Free variables of a subgraph besides the bound loop inputs.
+
+    When the body closes over outer symbols, their whole subtrees are part
+    of the traced sub-DAG (shared Symbol identity); the loop node must
+    take those subtrees' leaf variables as its own graph inputs. Loop-
+    invariant recomputation inside the body is fine: XLA hoists invariant
+    computations out of scan/while bodies.
+    """
+    caps, names = [], []
+    for s in sub._topo():
+        if s._op is None and s._name not in bound_names \
+                and s._name not in names:
+            caps.append(s)
+            names.append(s._name)
+    return caps, names
+
+
+def foreach(body, data, init_states, name="foreach"):
+    """Symbolic scan (reference: symbol/contrib.py:212). `body(data_slice,
+    states) -> (step_output, new_states)` traced once into a subgraph;
+    lowers to ONE lax.scan."""
+    multi_data = isinstance(data, (list, tuple))
+    datas = _as_list(data)
+    multi_state = isinstance(init_states, (list, tuple))
+    states = _as_list(init_states)
+
+    # bound names must be unique per CALL, not per user-visible name —
+    # nested loops with the default name would otherwise collide inside
+    # one subgraph and silently shadow captured outer values
+    uniq = Symbol._auto_name(f"__{name}")
+    data_vars = [var(f"{uniq}_data{i}") for i in range(len(datas))]
+    state_vars = [var(f"{uniq}_state{i}") for i in range(len(states))]
+    out, new_states = body(data_vars if multi_data else data_vars[0],
+                           state_vars if multi_state else state_vars[0])
+    outs = _as_list(out)
+    nss = _as_list(new_states)
+    if len(nss) != len(states):
+        raise ValueError(
+            f"body returned {len(nss)} states, expected {len(states)}")
+    sub = Group(outs + nss)
+    bound = [v._name for v in data_vars + state_vars]
+    caps, cap_names = _capture_leaves(sub, set(bound))
+    node = Symbol.create(
+        "_foreach", *(datas + states + caps), name=name,
+        nout=len(outs) + len(nss),
+        subgraph=sub.tojson(),
+        in_names=_json.dumps(bound + cap_names),
+        num_data=len(datas), num_states=len(states),
+        num_outputs=len(outs))
+    flat = node._flat_outputs()
+    o, f = flat[:len(outs)], flat[len(outs):]
+    return (o if len(o) > 1 else o[0],
+            f if multi_state else f[0])
+
+
+def _foreach_lower(ins, attrs):
+    subfn = _lowered(attrs["subgraph"])
+    names = _json.loads(attrs["in_names"])
+    n_d, n_s = attrs["num_data"], attrs["num_states"]
+    n_o = attrs["num_outputs"]
+    xs = tuple(ins[:n_d])
+    carry0 = tuple(ins[n_d:n_d + n_s])
+    cap = dict(zip(names[n_d + n_s:], ins[n_d + n_s:]))
+
+    def step(carry, x):
+        d = dict(zip(names[:n_d], x))
+        d.update(zip(names[n_d:n_d + n_s], carry))
+        d.update(cap)
+        res = subfn(d)
+        return tuple(res[n_o:]), tuple(res[:n_o])
+
+    final, stacked = lax.scan(step, carry0, xs)
+    out = tuple(stacked) + tuple(final)
+    return out if len(out) > 1 else out[0]
+
+
+register_sym_op("_foreach", _foreach_lower)
+
+
+def while_loop(cond, func, loop_vars, max_iterations=None,
+               name="while_loop"):
+    """Symbolic while (reference: symbol/contrib.py:375). Outputs are
+    stacked into `max_iterations` rows (rows past the real step count keep
+    zeros — the reference leaves them uninitialized); forward-only, like
+    the reference."""
+    if max_iterations is None:
+        raise ValueError("max_iterations is required")
+    multi = isinstance(loop_vars, (list, tuple))
+    lvs = _as_list(loop_vars)
+    uniq = Symbol._auto_name(f"__{name}")
+    lv_vars = [var(f"{uniq}_var{i}") for i in range(len(lvs))]
+
+    cond_sym = cond(*lv_vars)
+    step_out, new_vars = func(*lv_vars)
+    outs = _as_list(step_out) if step_out is not None else []
+    nvs = _as_list(new_vars)
+    if len(nvs) != len(lvs):
+        raise ValueError("func must return one new var per loop var")
+    sub = Group([cond_sym] + outs + nvs)
+    bound = [v._name for v in lv_vars]
+    caps, cap_names = _capture_leaves(sub, set(bound))
+    node = Symbol.create(
+        "_while_loop", *(lvs + caps), name=name,
+        nout=len(outs) + len(nvs),
+        subgraph=sub.tojson(),
+        in_names=_json.dumps(bound + cap_names),
+        num_vars=len(lvs), num_outputs=len(outs),
+        max_iterations=int(max_iterations))
+    flat = node._flat_outputs()
+    o, f = flat[:len(outs)], flat[len(outs):]
+    return (o if len(o) != 1 else o[0], f if multi else f[0])
+
+
+def _while_lower(ins, attrs):
+    subfn = _lowered(attrs["subgraph"])
+    names = _json.loads(attrs["in_names"])
+    n_v, n_o = attrs["num_vars"], attrs["num_outputs"]
+    max_it = attrs["max_iterations"]
+    vars0 = tuple(ins[:n_v])
+    cap = dict(zip(names[n_v:], ins[n_v:]))
+
+    def run(vars_):
+        d = dict(zip(names[:n_v], vars_))
+        d.update(cap)
+        res = subfn(d)
+        pred = jnp.reshape(res[0], ()).astype(bool)
+        return pred, tuple(res[1:1 + n_o]), tuple(res[1 + n_o:])
+
+    out_shapes = jax.eval_shape(lambda vs: run(vs)[1], vars0)
+    bufs0 = tuple(jnp.zeros((max_it,) + s.shape, s.dtype)
+                  for s in out_shapes)
+
+    def cond_fn(carry):
+        i, vars_, _ = carry
+        return jnp.logical_and(i < max_it, run(vars_)[0])
+
+    def body_fn(carry):
+        i, vars_, bufs = carry
+        _, outs, new_vars = run(vars_)
+        bufs = tuple(lax.dynamic_update_index_in_dim(b, o, i, 0)
+                     for b, o in zip(bufs, outs))
+        return i + 1, new_vars, bufs
+
+    _, final, bufs = lax.while_loop(
+        cond_fn, body_fn, (jnp.int32(0), vars0, bufs0))
+    out = tuple(bufs) + tuple(final)
+    return out if len(out) > 1 else out[0]
+
+
+register_sym_op("_while_loop", _while_lower)
+
+
+def cond(pred, then_func, else_func, name="cond"):
+    """Symbolic conditional (reference: symbol/contrib.py:598). then/else
+    take no arguments (they close over outer symbols); both branches trace
+    into subgraphs; lowers to lax.cond — XLA picks at run time."""
+    then_sym = Group(_as_list(then_func()))
+    else_sym = Group(_as_list(else_func()))
+    n_then = len(then_sym._inputs)
+    n_else = len(else_sym._inputs)
+    if n_then != n_else:
+        raise ValueError(
+            f"then ({n_then}) and else ({n_else}) output counts differ")
+    t_caps, t_names = _capture_leaves(then_sym, set())
+    e_caps, e_names = _capture_leaves(else_sym, set())
+    node = Symbol.create(
+        "_cond", pred, *(t_caps + e_caps), name=name, nout=n_then,
+        then_graph=then_sym.tojson(), else_graph=else_sym.tojson(),
+        then_names=_json.dumps(t_names), else_names=_json.dumps(e_names))
+    flat = node._flat_outputs()
+    return flat if len(flat) > 1 else flat[0]
+
+
+def _cond_lower(ins, attrs):
+    then_fn = _lowered(attrs["then_graph"])
+    else_fn = _lowered(attrs["else_graph"])
+    t_names = _json.loads(attrs["then_names"])
+    e_names = _json.loads(attrs["else_names"])
+    pred = jnp.reshape(ins[0], ()).astype(bool)
+    t_ins = dict(zip(t_names, ins[1:1 + len(t_names)]))
+    e_ins = dict(zip(e_names, ins[1 + len(t_names):]))
+    out = lax.cond(pred,
+                   lambda d: tuple(then_fn(d[0])),
+                   lambda d: tuple(else_fn(d[1])),
+                   (t_ins, e_ins))
+    return out if len(out) > 1 else out[0]
+
+
+register_sym_op("_cond", _cond_lower)

@@ -1,0 +1,34 @@
+"""`mx.sym.image` namespace (reference: mxnet/symbol/image.py — the
+`_image_*` op family under short names, `gen_image`)."""
+from . import register as _register
+
+__all__ = ["resize", "crop", "to_tensor", "normalize", "random_crop",
+           "random_resized_crop"]
+
+
+def resize(src, size=None, keep_ratio=False, interp=1):
+    """Symbolic resize with the reference signature (size int/(w,h));
+    keep_ratio needs the input extent, which a lazy graph doesn't know, so
+    it requires an explicit (w, h) — same restriction as the reference's
+    symbolic path for data-dependent sizes."""
+    if size is None:
+        raise ValueError("resize requires size")
+    if isinstance(size, int):
+        if keep_ratio:
+            raise ValueError("symbolic resize with keep_ratio needs an "
+                             "explicit (w, h) size (input extent is not "
+                             "known at graph-build time)")
+        size = (size, size)
+    w, h = size
+    return _register.get_builder("_image_resize")(src, w, h, interp=interp)
+
+
+def __getattr__(name):
+    builder = _register.get_builder(f"_image_{name}")
+    if builder is not None:
+        return builder
+    raise AttributeError(f"mx.sym.image has no op {name!r}")
+
+
+def __dir__():
+    return sorted(__all__)

@@ -1,0 +1,26 @@
+"""`mx.sym.sparse` namespace (reference: mxnet/symbol/sparse.py — the
+generated sparse op family, `gen_sparse`).
+
+TPU re-design note: symbolic graphs lower to dense XLA programs (sparse
+storage is an imperative-frontend concept here — see docs/sparse.md), so
+the sparse symbol ops are the same registry builders under the
+reference's sparse spellings; `cast_storage`/`retain` keep their
+reference call signatures and dense-equivalent numerics.
+"""
+from . import register as _register
+
+__all__ = ["dot", "retain", "cast_storage", "zeros_like", "elemwise_add",
+           "elemwise_sub", "elemwise_mul", "add_n", "where", "LinearRegressionOutput"]
+
+_ALIAS = {"retain": "_sparse_retain"}
+
+
+def __getattr__(name):
+    builder = _register.get_builder(_ALIAS.get(name, name))
+    if builder is not None:
+        return builder
+    raise AttributeError(f"mx.sym.sparse has no op {name!r}")
+
+
+def __dir__():
+    return sorted(__all__)

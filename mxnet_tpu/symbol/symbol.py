@@ -107,6 +107,47 @@ class Symbol:
     def __neg__(self):
         return Symbol.create("negative", self)
 
+    def __mod__(self, o):
+        return Symbol.create("broadcast_mod", self, o)
+
+    def __rmod__(self, o):
+        return Symbol.create("broadcast_mod", _const(o), self)
+
+    def __abs__(self):
+        return Symbol.create("abs", self)
+
+    # elementwise comparisons (reference: symbol.py:333-404 — Symbol
+    # identity stays object-based: __hash__ below, id()-keyed graph walks)
+    def __eq__(self, o):
+        if o is None:
+            return False
+        return Symbol.create("broadcast_equal", self, o)
+
+    def __ne__(self, o):
+        if o is None:
+            return True
+        return Symbol.create("broadcast_not_equal", self, o)
+
+    def __lt__(self, o):
+        return Symbol.create("broadcast_lesser", self, o)
+
+    def __le__(self, o):
+        return Symbol.create("broadcast_lesser_equal", self, o)
+
+    def __gt__(self, o):
+        return Symbol.create("broadcast_greater", self, o)
+
+    def __ge__(self, o):
+        return Symbol.create("broadcast_greater_equal", self, o)
+
+    __hash__ = object.__hash__
+
+    def __bool__(self):
+        # reference: symbol.py:125 NotImplementedForSymbol — a lazy node
+        # has no truth value; failing loudly beats silently-true
+        raise TypeError("Symbol has no truth value (graphs are lazy); "
+                        "compare inside the graph instead")
+
     def __getitem__(self, idx):
         if isinstance(idx, str):
             for out, name in zip(self._flat_outputs(),
@@ -120,7 +161,7 @@ class Symbol:
     def _flat_outputs(self):
         if self._op == "_group":
             return list(self._inputs)
-        if self._nout == 1:
+        if self._nout == 1 or self._out_index is not None:
             return [self]
         return [Symbol(self._op, self._name, self._inputs, self._attrs,
                        nout=self._nout, out_index=i)

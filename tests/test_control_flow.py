@@ -183,3 +183,143 @@ def test_foreach_inside_hybridized_block():
     got = net(x).asnumpy()
     want = onp.cumsum(x.asnumpy(), axis=0)
     onp.testing.assert_allclose(got, want, rtol=1e-6)
+
+
+# -- symbolic control flow (mx.sym.contrib — reference symbol/contrib.py) --
+class TestSymbolicControlFlow:
+    def test_sym_foreach_with_capture_and_json_roundtrip(self):
+        data = mx.sym.var("data")
+        w = mx.sym.var("w")
+        out, fin = mx.sym.contrib.foreach(
+            lambda x, s: (x * w + s, x * w + s), data, mx.sym.zeros(()))
+        args = {"data": mx.nd.array([1.0, 2.0, 3.0]), "w": mx.nd.array(2.0)}
+        r = out.bind(args=args).forward()[0]
+        assert r.asnumpy().tolist() == [2.0, 6.0, 12.0]
+        # serialization carries the loop subgraph (tojson attr)
+        r2 = mx.sym.fromjson(out.tojson()).bind(args=args).forward()[0]
+        assert r2.asnumpy().tolist() == [2.0, 6.0, 12.0]
+
+    def test_sym_foreach_backward_through_scan(self):
+        data = mx.sym.var("data")
+        w = mx.sym.var("w")
+        out, _ = mx.sym.contrib.foreach(
+            lambda x, s: (x * w + s, x * w + s), data, mx.sym.zeros(()))
+        ex = out.bind(args={"data": mx.nd.array([1.0, 2.0, 3.0]),
+                            "w": mx.nd.array(2.0)})
+        ex.forward(is_train=True)
+        grads = ex.backward()
+        # d/dw sum_t cumsum(w*x)_t = 1*3 + 2*2 + 3*1 = 10
+        assert float(grads["w"].asnumpy()) == pytest.approx(10.0)
+
+    def test_sym_foreach_multi_state(self):
+        data = mx.sym.var("data")
+        out, fins = mx.sym.contrib.foreach(
+            lambda x, states: (x + states[0], [states[0] + x, states[1] * 2]),
+            data, [mx.sym.zeros(()), mx.sym.ones(())])
+        g = mx.sym.Group([out, fins[0], fins[1]])
+        res = g.bind(args={"data": mx.nd.array([1.0, 2.0])}).forward()
+        assert res[0].asnumpy().tolist() == [1.0, 3.0]
+        assert float(res[1].asnumpy()) == 3.0
+        assert float(res[2].asnumpy()) == 4.0
+
+    def test_sym_while_loop(self):
+        i = mx.sym.var("i")
+        s = mx.sym.var("s")
+        outs, finals = mx.sym.contrib.while_loop(
+            cond=lambda i, s: i < 3,
+            func=lambda i, s: (i * 10, (i + 1, s + i)),
+            loop_vars=(i, s), max_iterations=5)
+        g = mx.sym.Group([outs, finals[0], finals[1]])
+        res = g.bind(args={"i": mx.nd.array(0.0),
+                           "s": mx.nd.array(0.0)}).forward()
+        assert res[0].asnumpy().tolist() == [0.0, 10.0, 20.0, 0.0, 0.0]
+        assert float(res[1].asnumpy()) == 3.0
+        assert float(res[2].asnumpy()) == 3.0  # 0+1+2
+
+    def test_sym_cond_reference_example(self):
+        a = mx.sym.var("a")
+        b = mx.sym.var("b")
+        p = mx.sym.var("p")
+        c = mx.sym.contrib.cond(p, lambda: (a + 5) * (b + 5),
+                                lambda: (a - 5) * (b - 5))
+        args = {"a": mx.nd.array([1.0]), "b": mx.nd.array([2.0])}
+        taken = c.bind(args={**args, "p": mx.nd.array(1.0)}).forward()[0]
+        not_taken = c.bind(args={**args, "p": mx.nd.array(0.0)}).forward()[0]
+        assert taken.asnumpy().tolist() == [42.0]
+        assert not_taken.asnumpy().tolist() == [12.0]
+
+    def test_symbol_comparison_operators(self):
+        a = mx.sym.var("a")
+        out = mx.sym.Group([a < 2, a <= 1, a > 0, a >= 2, a == 1, a != 1])
+        res = out.bind(args={"a": mx.nd.array([1.0])}).forward()
+        assert [float(r.asnumpy()) for r in res] == [1, 1, 1, 0, 1, 0]
+
+    def test_symbol_bool_raises(self):
+        with pytest.raises(TypeError):
+            bool(mx.sym.var("a"))
+
+
+def test_nd_contrib_cond_taken_branch_only():
+    # reference ndarray/contrib.py:401 — eager cond takes no-arg funcs
+    a, b = mx.nd.array([1]), mx.nd.array([2])
+    out = mx.nd.contrib.cond(a * b < 5,
+                             lambda: (a + 5) * (b + 5),
+                             lambda: (a - 5) * (b - 5))
+    assert out.asnumpy().tolist() == [42]
+
+
+def test_nd_contrib_float_tests_and_zipfian():
+    import numpy as np
+
+    d = mx.nd.array([np.inf, -np.inf, 1.0])
+    assert mx.nd.contrib.isinf(d).asnumpy().tolist() == [1.0, 1.0, 0.0]
+    assert mx.nd.contrib.isfinite(d).asnumpy().tolist() == [0.0, 0.0, 1.0]
+    assert mx.nd.contrib.isnan(
+        mx.nd.array([np.nan, -1.0])).asnumpy().tolist() == [1.0, 0.0]
+    s, ect, ecs = mx.nd.contrib.rand_zipfian(mx.nd.array([3]), 4, 5)
+    assert s.shape == (4,) and ecs.shape == (4,)
+    # P(class=3) * num_sampled = (log(5)-log(4))/log(6) * 4
+    import math
+
+    expect = (math.log(5) - math.log(4)) / math.log(6) * 4
+    assert float(ect.asnumpy()[0]) == pytest.approx(expect, rel=1e-5)
+
+
+def test_sym_nested_foreach():
+    # regression: sliced multi-output symbols must stay sliced in Group,
+    # and bound names must be unique per foreach call (nested loops)
+    data = mx.sym.var("data")  # (3, 2) — outer scans rows, inner scans cols
+
+    def outer_body(row, s):
+        inner_out, inner_fin = mx.sym.contrib.foreach(
+            lambda x, t: (x + t, x + t), row, mx.sym.zeros(()))
+        return inner_fin, s + inner_fin
+
+    out, fin = mx.sym.contrib.foreach(outer_body, data, mx.sym.zeros(()))
+    res = mx.sym.Group([out, fin]).bind(
+        args={"data": mx.nd.array([[1.0, 2.0], [3.0, 4.0], [5.0, 6.0]])}
+    ).forward()
+    # inner: cumsum over each row's 2 entries -> row sums [3, 7, 11]
+    assert res[0].asnumpy().tolist() == [3.0, 7.0, 11.0]
+    assert float(res[1].asnumpy()) == 21.0
+
+
+def test_sym_group_over_sliced_loop_outputs():
+    i = mx.sym.var("i")
+    s = mx.sym.var("s")
+    outs, finals = mx.sym.contrib.while_loop(
+        cond=lambda i, s: i < 2,
+        func=lambda i, s: (i, (i + 1, s + i)),
+        loop_vars=(i, s), max_iterations=3)
+    g = mx.sym.Group([outs, finals[0], finals[1]])
+    res = g.bind(args={"i": mx.nd.array(0.0), "s": mx.nd.array(0.0)}).forward()
+    assert len(res) == 3  # NOT re-expanded to 9
+    assert res[0].asnumpy().tolist() == [0.0, 1.0, 0.0]
+
+
+def test_reshape_method_shape_kwarg():
+    a = mx.nd.ones((2, 3))
+    assert a.reshape(shape=(3, 2)).shape == (3, 2)
+    assert a.reshape(shape=(0, -1)).shape == (2, 3)
+    with pytest.raises(TypeError):
+        a.reshape((3, 2), bogus=1)
