@@ -3,23 +3,41 @@
 
 #include <unistd.h>
 
+#include <cmath>
 #include <string>
 
 namespace mxtpu_demo {
 
 // Parse first/last entries of {"losses": [...]} out of Model::Fit's raw
-// JSON reply (the examples avoid a JSON dependency on purpose).
+// JSON reply (the examples avoid a JSON dependency on purpose). An error
+// reply without a "losses" key yields NaN so demos fail cleanly instead
+// of throwing from substr(npos + 1).
 inline double FirstLoss(const std::string& meta) {
-  size_t lb = meta.find('[', meta.find("\"losses\""));
-  return std::stod(meta.substr(lb + 1));
+  size_t key = meta.find("\"losses\"");
+  if (key == std::string::npos) return std::nan("");
+  size_t lb = meta.find('[', key);
+  if (lb == std::string::npos) return std::nan("");
+  try {
+    return std::stod(meta.substr(lb + 1));  // throws on "[]" (no epochs)
+  } catch (const std::exception&) {
+    return std::nan("");
+  }
 }
 
 inline double LastLoss(const std::string& meta) {
-  size_t lb = meta.find('[', meta.find("\"losses\""));
+  size_t key = meta.find("\"losses\"");
+  if (key == std::string::npos) return std::nan("");
+  size_t lb = meta.find('[', key);
+  if (lb == std::string::npos) return std::nan("");
   size_t rb = meta.find(']', lb);
+  if (rb == std::string::npos) return std::nan("");
   size_t comma = meta.rfind(',', rb);
   if (comma == std::string::npos || comma < lb) comma = lb;
-  return std::stod(meta.substr(comma + 1));
+  try {
+    return std::stod(meta.substr(comma + 1));
+  } catch (const std::exception&) {
+    return std::nan("");
+  }
 }
 
 // Checkpoint path for a demo: argv[1] if given (tests pass a tmp dir),

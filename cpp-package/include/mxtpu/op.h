@@ -7601,6 +7601,17 @@ inline std::vector<PackedTensor> ceil(
   return rt.invoke("ceil", ins_, a_.str());
 }
 
+inline std::vector<PackedTensor> choose_element_0index(
+    PyRuntime& rt,
+    const PackedTensor& lhs,
+    const PackedTensor& rhs) {
+  std::vector<PackedTensor> ins_;
+  ins_.push_back(lhs);
+  ins_.push_back(rhs);
+  detail::JsonBuilder a_;
+  return rt.invoke("choose_element_0index", ins_, a_.str());
+}
+
 inline std::vector<PackedTensor> clip(
     PyRuntime& rt,
     const PackedTensor& data,
@@ -7930,6 +7941,19 @@ inline std::vector<PackedTensor> expm1(
   ins_.push_back(x);
   detail::JsonBuilder a_;
   return rt.invoke("expm1", ins_, a_.str());
+}
+
+inline std::vector<PackedTensor> fill_element_0index(
+    PyRuntime& rt,
+    const PackedTensor& lhs,
+    const PackedTensor& mhs,
+    const PackedTensor& rhs) {
+  std::vector<PackedTensor> ins_;
+  ins_.push_back(lhs);
+  ins_.push_back(mhs);
+  ins_.push_back(rhs);
+  detail::JsonBuilder a_;
+  return rt.invoke("fill_element_0index", ins_, a_.str());
 }
 
 inline std::vector<PackedTensor> fix(

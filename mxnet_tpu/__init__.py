@@ -23,6 +23,20 @@ from __future__ import annotations
 
 __version__ = "0.1.0"
 
+# 64-bit dtype contract (reference: mshadow DType dispatch supports real
+# float64/int64 compute; shape_array returns int64 —
+# src/operator/tensor/matrix_op.cc). Explicit 64-bit requests are honored;
+# every creation default in this package stays float32/int32 like the
+# reference's. fp64 is emulated (slow) on TPU — fine for CPU parity work,
+# documented in docs/migration.md.
+import jax as _jax
+
+_jax.config.update("jax_enable_x64", True)
+
+from . import _jax_defaults as _jax_defaults_mod
+
+_jax_defaults_mod.install()  # 32-bit defaults on dtype-less jax.random
+
 from . import autograd, base, device, engine
 from . import env  # typed env-var registry (env_var.md analog)
 from . import _random

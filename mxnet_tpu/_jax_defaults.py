@@ -1,0 +1,64 @@
+"""32-bit default dtypes on the public jax.random samplers.
+
+The 64-bit contract (docs/migration.md) is: explicit float64/int64
+honored (jax_enable_x64 on), creation DEFAULTS stay 32-bit. x64 flips
+jax.random's dtype-less defaults to float64/int64, and those samplers
+are called from ~50 sites across the frontends (probability,
+initializers, legacy random ops). Rather than threading dtype= through
+every call site — and silently regressing whenever a new one lands —
+wrap the public samplers once: a call WITHOUT an explicit dtype gets the
+32-bit default; an explicit dtype (including 64-bit) passes through
+untouched. jax's internals import from jax._src and never see these
+wrappers.
+"""
+from __future__ import annotations
+
+import functools
+import inspect
+
+import jax
+import jax.numpy as jnp
+
+_FLOAT_SAMPLERS = [
+    "normal", "uniform", "truncated_normal", "laplace", "cauchy",
+    "exponential", "logistic", "gamma", "beta", "dirichlet", "gumbel",
+    "pareto", "t", "chisquare", "f", "generalized_normal", "ball",
+    "maxwell", "rayleigh", "wald", "weibull_min", "lognormal",
+    "loggamma", "triangular",
+]
+_INT_SAMPLERS = ["randint", "poisson", "geometric", "binomial"]
+
+_applied = False
+
+
+def _wrap(fn, default_dtype):
+    params = inspect.signature(fn).parameters
+    if "dtype" not in params:
+        return fn
+    dtype_pos = list(params).index("dtype")
+
+    @functools.wraps(fn)
+    def wrapped(*args, **kwargs):
+        if "dtype" not in kwargs and len(args) <= dtype_pos:
+            kwargs["dtype"] = default_dtype
+        return fn(*args, **kwargs)
+
+    wrapped.__wrapped_32bit_default__ = True
+    return wrapped
+
+
+def install():
+    global _applied
+    if _applied:
+        return
+    _applied = True
+    for name in _FLOAT_SAMPLERS:
+        fn = getattr(jax.random, name, None)
+        if fn is not None and not getattr(fn, "__wrapped_32bit_default__",
+                                          False):
+            setattr(jax.random, name, _wrap(fn, jnp.float32))
+    for name in _INT_SAMPLERS:
+        fn = getattr(jax.random, name, None)
+        if fn is not None and not getattr(fn, "__wrapped_32bit_default__",
+                                          False):
+            setattr(jax.random, name, _wrap(fn, jnp.int32))

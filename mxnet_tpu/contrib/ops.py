@@ -269,15 +269,18 @@ def multibox_prior(data, sizes=(1.0,), ratios=(1.0,), clip=False,
         H, W = x.shape[-2:]
         step_y = steps[0] if steps[0] > 0 else 1.0 / H
         step_x = steps[1] if steps[1] > 0 else 1.0 / W
-        cy = (jnp.arange(H) + offsets[0]) * step_y
-        cx = (jnp.arange(W) + offsets[1]) * step_x
+        cy = (jnp.arange(H, dtype=x.dtype) + offsets[0]) * step_y
+        cx = (jnp.arange(W, dtype=x.dtype) + offsets[1]) * step_x
         cyy, cxx = jnp.meshgrid(cy, cx, indexing="ij")       # (H, W)
         # reference order (multibox_prior.cc): all sizes at ratios[0]
         # first, then sizes[0] at each remaining ratio
         r0 = ratios[0]
-        whs = [(s * _np.sqrt(r0), s / _np.sqrt(r0)) for s in sizes]
-        whs += [(sizes[0] * _np.sqrt(r), sizes[0] / _np.sqrt(r))
-                for r in ratios[1:]]
+        # python floats (weak-typed): numpy f64 scalars would promote the
+        # f32 grids to f64 under x64
+        whs = [(float(s * _np.sqrt(r0)), float(s / _np.sqrt(r0)))
+               for s in sizes]
+        whs += [(float(sizes[0] * _np.sqrt(r)),
+                 float(sizes[0] / _np.sqrt(r))) for r in ratios[1:]]
         boxes = []
         for w, h in whs:
             boxes.append(jnp.stack([cxx - w / 2, cyy - h / 2,
@@ -477,7 +480,7 @@ def hawkes_ll(lda, alpha, beta, state, lags, marks, valid_length, max_time):
             # compensator increment for the interval
             comp = ((mu * dt[:, None])
                     + (st / b[None, :]) * (1 - decay)).sum(-1) * valid
-            st_upd = st_new + jax.nn.one_hot(k, K) * a[None, :]
+            st_upd = st_new + jax.nn.one_hot(k, K, dtype=st.dtype) * a[None, :]
             # padded steps must not decay or excite the carried state
             st_upd = jnp.where(valid[:, None] > 0, st_upd, st)
             return (ll + ll_t - comp, st_upd, last_t + dt * valid), None

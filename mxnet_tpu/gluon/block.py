@@ -231,7 +231,15 @@ class Block:
     def save_parameters(self, filename, deduplicate=False):  # noqa: ARG002
         """Save params as .npz keyed by structured names (reference:
         Block.save_parameters, gluon/block.py:340; format here is the
-        cnpy/.npz path of src/serialization/cnpy.cc)."""
+        cnpy/.npz path of src/serialization/cnpy.cc).
+
+        ASYNC CONTRACT (deliberate divergence from the reference, which
+        blocks on return): the write overlaps training on a native-engine
+        IO thread. In-framework readers (load_parameters, nd.load) and
+        mx.waitall() barrier correctly; an EXTERNAL consumer (shell cp, a
+        second process, an upload hook) must call mx.waitall() first.
+        `mx.nd.save` is synchronous-on-return like the reference if you
+        need stat-after-save semantics. See docs/migration.md."""
         arrays = {}
         for name, p in self._collect_params_with_prefix().items():
             if p._data_map is None:

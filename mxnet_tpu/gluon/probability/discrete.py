@@ -385,7 +385,8 @@ class OneHotCategorical(Categorical):
         shape = self._batch_shape() if size is None else size
         idx = jax.random.categorical(self._key(), self._normalized_logit,
                                      shape=shape)
-        return wrap(jax.nn.one_hot(idx, self.num_events))
+        return wrap(jax.nn.one_hot(idx, self.num_events,
+                                   dtype=jnp.float32))
 
     @property
     def mean(self):

@@ -178,6 +178,9 @@ class KVStoreLocal(KVStoreBase):
         # one per-key state/update path shared with the reference's
         # get_updater contract (multi-precision aware)
         self._updater = get_updater(optimizer)
+        if getattr(self, "_loaded_states", None):
+            # load_optimizer_states ran before set_optimizer
+            self._consume_loaded_states()
 
     @property
     def _updater_states(self):
@@ -200,8 +203,33 @@ class KVStoreLocal(KVStoreBase):
             payload = pickle.load(f)
         if "optimizer" in payload:
             self._optimizer = payload["optimizer"]
-        # states are re-materialized lazily on next update
         self._loaded_states = payload["states"]
+        if self._updater is None:
+            # updater not set yet: set_optimizer consumes _loaded_states
+            return
+        self._consume_loaded_states()
+
+    def _consume_loaded_states(self):
+        """Route loaded states into the updater (ADVICE r4 #1 — the
+        payload used to be stored and never consulted). Keys whose state
+        already exists are grafted NOW (structure known); unseen keys
+        graft lazily on their first update."""
+        from ..optimizer.optimizer import _graft_state
+
+        loaded = self._loaded_states or {}
+        for k, flat in loaded.items():
+            hit = None
+            for cand in (k, str(k)):
+                if cand in self._updater.states:
+                    hit = cand
+                    break
+            if hit is not None:
+                self._updater.states[hit] = _graft_state(
+                    self._updater.states[hit], list(flat))
+            else:
+                self._updater.pending_loaded[k] = flat
+        self._loaded_states = None  # consumed: never re-applied to a
+        #                             later set_optimizer's fresh updater
 
 
 def _key_int(k):

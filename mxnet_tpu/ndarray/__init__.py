@@ -41,6 +41,34 @@ from .. import numpy_extension as npx  # noqa: F401,E402
 from .utils import load, save, savez  # noqa: F401
 
 
+def arange(start, stop=None, step=1.0, repeat=1, infer_range=None,  # noqa: A001
+           ctx=None, dtype="float32", **kwargs):  # noqa: ARG001
+    """Legacy arange (reference: ndarray/ndarray.py:3510): default dtype
+    is float32 (mx_real_t) even for int args; `repeat` tiles each element
+    consecutively — arange(2,6,step=2,repeat=3) -> [2,2,2,4,4,4]."""
+    from ..numpy import arange as _np_arange
+
+    out = _np_arange(start, stop, step, dtype=dtype)
+    if repeat != 1:
+        out = out.repeat(repeat)
+    return out
+
+
+def split_v2(ary, indices_or_sections, axis=0, squeeze_axis=False):
+    """Reference nd.split_v2 (matrix_op.cc SplitV2): int = n equal
+    sections, sequence = cut points; squeeze_axis drops the split axis
+    when each section has extent 1."""
+    from ..ops.registry import _OPS
+    from .register import make_eager
+
+    fn = make_eager("_split_v2", _OPS["_split_v2"])
+    out = fn(ary, indices_or_sections=indices_or_sections, axis=axis)
+    outs = list(out) if isinstance(out, (list, tuple)) else [out]
+    if squeeze_axis:
+        outs = [o.squeeze(axis=axis) for o in outs]
+    return outs
+
+
 def Custom(*inputs, op_type=None, **kwargs):  # noqa: N802
     """Invoke a registered python CustomOp (reference: mx.nd.Custom)."""
     from ..operator import Custom as _custom

@@ -375,7 +375,12 @@ class NDArray:
             return apply_op(lambda x: jnp.reshape(x, new_shape), self)
         return apply_op(lambda x: jnp.reshape(x, shape), self)
 
-    def transpose(self, *axes):
+    def transpose(self, *axes, **kwargs):
+        if not axes and "axes" in kwargs:  # legacy kwarg spelling
+            axes = (kwargs.pop("axes"),)
+        if kwargs:
+            raise TypeError(
+                f"transpose got unexpected kwargs {sorted(kwargs)}")
         if len(axes) == 1 and isinstance(axes[0], (tuple, list)):
             axes = tuple(axes[0])
         ax = axes if axes else None
@@ -403,7 +408,29 @@ class NDArray:
     def broadcast_to(self, shape):
         return apply_op(lambda x: jnp.broadcast_to(x, shape), self)
 
-    def split(self, indices_or_sections, axis=0):
+    def split(self, indices_or_sections=None, axis=None, num_outputs=None,
+              squeeze_axis=False):
+        if num_outputs is not None:
+            # legacy spelling (reference nd.split: num_outputs/squeeze_axis,
+            # default axis=1 — slice_channel in matrix_op.cc)
+            from .. import ndarray as _nd_ns
+
+            return _nd_ns.split(self, num_outputs=num_outputs,
+                                axis=1 if axis is None else axis,
+                                squeeze_axis=squeeze_axis)
+        if squeeze_axis:
+            # loud: the legacy kwarg only applies with num_outputs= —
+            # silently splitting on numpy's axis-0 default instead would
+            # hand back wrongly-shaped sections
+            raise TypeError(
+                "split: squeeze_axis requires the legacy num_outputs= "
+                "spelling (a.split(num_outputs=2, squeeze_axis=True)); "
+                "positional arg means numpy indices_or_sections here — "
+                "see docs/migration.md")
+        return self._split_np(indices_or_sections,
+                              0 if axis is None else axis)
+
+    def _split_np(self, indices_or_sections, axis=0):
         return apply_op(
             lambda x: tuple(jnp.split(x, indices_or_sections, axis)), self
         )
@@ -658,6 +685,24 @@ class NDArray:
     def __imod__(self, o):
         return self._inplace(o, jnp.mod)
 
+    # fluent method surface (reference: ndarray.py hand-writes one method
+    # per op — `a.topk(...)` == `mx.nd.topk(a, ...)`, test_ndarray.py:1286
+    # test_ndarray_fluent). Here any registered op resolves as a method
+    # through the eager nd namespace; explicit methods above keep
+    # priority (normal attribute lookup wins over __getattr__).
+    def __getattr__(self, name):
+        if name.startswith("_"):  # never intercept protocol/dunder probes
+            raise AttributeError(name)
+        from .. import ndarray as _nd_ns
+
+        fn = getattr(_nd_ns, name, None)
+        if callable(fn):
+            import functools
+
+            return functools.partial(fn, self)
+        raise AttributeError(
+            f"'NDArray' object has no attribute {name!r}")
+
 
 # ---------------------------------------------------------------------------
 # op application (the Imperative::Invoke analog)
@@ -749,6 +794,8 @@ def array(source, dtype=None, device=None, ctx=None):
     arr = _np.asarray(source)
     if dtype is None and arr.dtype == _np.float64:
         dtype = _np.dtype(_np.float32)  # reference default dtype is float32
+    elif dtype is None and arr.dtype == _np.int64:
+        dtype = _np.dtype(_np.int32)  # 32-bit creation default (x64 on)
     if dtype is not None:
         arr = arr.astype(dtype)
     return NDArray(jax.device_put(arr, device.jax_device), device)

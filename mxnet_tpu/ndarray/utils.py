@@ -51,13 +51,16 @@ def save(fname, data):
         raise ValueError(
             "save expects NDArray, list of NDArray, or dict of str->NDArray,"
             f" got {type(data)}")
-    # async write through the native engine (load/waitall barrier on the
-    # path var; _checkpoint_io) — honors the exact path, savez would
-    # append .npz. Snapshot aliasing numpy inputs: the write happens later
-    # on an IO thread and must not see post-save mutations.
-    from .._checkpoint_io import async_save_npz
+    # write through the native engine's IO path (_checkpoint_io), then
+    # barrier: the reference's MXNDArraySave is synchronous-on-return
+    # (c_api.cc) — an external consumer (shell cp, another process) may
+    # stat the file the moment save() returns. Framework-internal
+    # checkpoint hooks that want overlap call async_save_npz directly
+    # and barrier at waitall.
+    from .._checkpoint_io import async_save_npz, wait_for_path
 
     async_save_npz(fname, payload)
+    wait_for_path(fname)
 
 
 def savez(fname, *args, **kwargs):
@@ -76,7 +79,8 @@ def load(fname):
 
     wait_for_path(fname)  # barrier on an in-flight async save
     if fname.endswith(".npy"):
-        return array(_np.load(fname))
+        raw = _np.load(fname)
+        return array(raw, dtype=raw.dtype)  # keep stored dtype (incl. f64)
     import os
 
     if not os.path.exists(fname) and os.path.exists(fname + ".npz"):
@@ -89,5 +93,8 @@ def load(fname):
         keys = list(decoded)
         if keys and all(k.startswith(_LIST_PREFIX) for k in keys):
             items = sorted(keys, key=lambda k: int(k[len(_LIST_PREFIX):]))
-            return [array(decoded[k]) for k in items]
-        return {k: array(v) for k, v in decoded.items()}
+            return [array(decoded[k], dtype=decoded[k].dtype)
+                    for k in items]
+        # dtype passed explicitly: the stored dtype is the contract
+        # (array()'s float64 default-downcast must not apply here)
+        return {k: array(v, dtype=v.dtype) for k, v in decoded.items()}

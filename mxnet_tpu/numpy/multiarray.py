@@ -63,13 +63,20 @@ def _call_listok(jnp_fn, call_args, call_kwargs):
 
     try:
         return jnp_fn(*call_args, **call_kwargs)
-    except TypeError as e:
-        if "requires ndarray or scalar" not in str(e):
-            raise
+    except TypeError:
+        # retry with list operands converted whenever any are present —
+        # matching on jax's exact message ("requires ndarray or scalar")
+        # would silently disable list support if a jax upgrade rewords it
+        import builtins  # `all` is shadowed by the generated mx.np.all
+
         conv = [_np.asarray(a) if _plain_list(a) else a
                 for a in call_args]
         kconv = {k: _np.asarray(v) if _plain_list(v) else v
                  for k, v in call_kwargs.items()}
+        if builtins.all(c is a for c, a in zip(conv, call_args)) \
+                and builtins.all(kconv[k] is call_kwargs[k]
+                                 for k in kconv):
+            raise  # nothing convertible: the TypeError is genuine
         return jnp_fn(*conv, **kconv)
 
 
@@ -386,6 +393,10 @@ def full(shape, fill_value, dtype=None, order="C", **kwargs):  # noqa: ARG001
     if isinstance(fill_value, NDArray):
         fill_value = fill_value._data
     data = jnp.full(shape, fill_value, normalize_dtype(dtype))
+    if dtype is None and data.dtype in (jnp.float64, jnp.int64):
+        # python-scalar fill under x64: keep the 32-bit creation default
+        data = data.astype(jnp.float32 if data.dtype == jnp.float64
+                           else jnp.int32)
     return NDArray(jax.device_put(data, dev.jax_device), dev)
 
 
@@ -409,10 +420,17 @@ def empty_like(a, dtype=None, **kwargs):
 
 
 def arange(start, stop=None, step=1, dtype=None, **kwargs):
+    """Reference contract (numpy/multiarray.py:6980): default dtype is
+    float32 — even for int arguments — unless npx.set_np(dtype=True)
+    switched creation defaults to official numpy (then int64/float64)."""
     dev = _device_of(kwargs)
-    data = jnp.arange(start, stop, step, normalize_dtype(dtype))
-    if data.dtype == jnp.float64:
-        data = data.astype(jnp.float32)
+    if dtype is None:
+        from ..numpy_extension import is_np_default_dtype
+
+        data = jnp.arange(start, stop, step) if is_np_default_dtype() \
+            else jnp.arange(start, stop, step, jnp.float32)
+    else:
+        data = jnp.arange(start, stop, step, normalize_dtype(dtype))
     return NDArray(jax.device_put(data, dev.jax_device), dev)
 
 

@@ -26,8 +26,8 @@ __all__ = [
     "lrn", "dropout", "embedding", "one_hot", "pick", "topk", "sequence_mask",
     "sequence_last", "sequence_reverse", "l2_normalization", "upsampling",
     "moments", "gamma", "erf", "erfinv", "set_np", "reset_np", "is_np_array",
-    "is_np_shape", "use_np", "cpu", "gpu", "tpu", "num_gpus", "current_device",
-    "waitall",
+    "is_np_shape", "is_np_default_dtype", "use_np", "cpu", "gpu", "tpu",
+    "num_gpus", "current_device", "waitall",
 ]
 
 
@@ -133,12 +133,16 @@ from ..device import cpu, current_device, gpu, num_gpus, tpu  # noqa: E402
 from ..engine import waitall  # noqa: E402
 
 _np_active = True
+_np_default_dtype = False
 
 
 def set_np(shape=True, array=True, dtype=False):  # noqa: ARG001
-    """Parity no-op: this framework is numpy-semantics native."""
-    global _np_active
+    """shape/array are parity no-ops (numpy-semantics native); `dtype`
+    switches creation defaults to official-numpy (float64/int64) like the
+    reference (numpy/multiarray.py:7004 arange docs)."""
+    global _np_active, _np_default_dtype
     _np_active = True
+    _np_default_dtype = bool(dtype)
 
 
 def reset_np():
@@ -151,6 +155,12 @@ def is_np_array():
 
 def is_np_shape():
     return _np_active
+
+
+def is_np_default_dtype():
+    """True when creation defaults follow official numpy (float64/int64);
+    False (default) keeps the reference's float32/int32 defaults."""
+    return _np_default_dtype
 
 
 def use_np(func):

@@ -690,14 +690,19 @@ def topk(x, k=1, axis=-1, ret_typ="indices", is_ascend=False,
         vals, idx = lax.top_k(xm, k)
     vals = jnp.moveaxis(vals, -1, axis)
     idx = jnp.moveaxis(idx, -1, axis)
-    if dtype is not None:  # None = keep native int32 indices
-        idx = idx.astype(normalize_dtype(dtype))
+
+    def cast_idx(i):
+        # `dtype` applies only to RETURNED indices (None = native int32);
+        # mask/value paths keep exact int indices — a float32 index is
+        # only exact below 2^24 and the cast is wasted work there
+        return i if dtype is None else i.astype(normalize_dtype(dtype))
+
     if ret_typ == "indices":
-        return idx
+        return cast_idx(idx)
     if ret_typ == "value":
         return vals
     if ret_typ == "both":
-        return vals, idx
+        return vals, cast_idx(idx)
     if ret_typ == "mask":
         # 0/1 mask of the selected cells in the input's shape
         # (reference ordering_op.cc ReturnType kReturnMask)

@@ -465,14 +465,32 @@ def reverse(data, axis=0):
 flip = register_op("flip", reverse)
 
 
+@register_op("choose_element_0index")
+def choose_element_0index(lhs, rhs):
+    """out[i] = lhs[i, rhs[i]] — row-wise pick with (float) indices
+    (reference: src/operator/tensor/broadcast_reduce_op_index.cc legacy
+    op used by RL/ranking examples)."""
+    idx = rhs.astype(jnp.int32)
+    return jnp.take_along_axis(lhs, idx[:, None], axis=1)[:, 0]
+
+
+@register_op("fill_element_0index")
+def fill_element_0index(lhs, mhs, rhs):
+    """Functional lhs[i, rhs[i]] = mhs[i] (reference: the mutating
+    legacy op; XLA scatter here)."""
+    idx = rhs.astype(jnp.int32)
+    return lhs.at[jnp.arange(lhs.shape[0]), idx].set(mhs)
+
+
 @register_op("shape_array")
 def shape_array(data):
-    return jnp.asarray(data.shape, jnp.int32)
+    # int64 is the reference contract (matrix_op.cc shape_array)
+    return jnp.asarray(data.shape, jnp.int64)
 
 
 @register_op("size_array")
 def size_array(data):
-    return jnp.asarray([data.size], jnp.int32)
+    return jnp.asarray([data.size], jnp.int64)
 
 
 @register_op("cast")

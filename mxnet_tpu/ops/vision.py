@@ -70,7 +70,8 @@ def grid_generator(data, transform_type="affine", target_shape=None):
         h, w = target_shape
         theta = data.reshape(-1, 2, 3)
         ys, xs = jnp.meshgrid(
-            jnp.linspace(-1.0, 1.0, h), jnp.linspace(-1.0, 1.0, w),
+            jnp.linspace(-1.0, 1.0, h, dtype=data.dtype),
+            jnp.linspace(-1.0, 1.0, w, dtype=data.dtype),
             indexing="ij")
         ones = jnp.ones_like(xs)
         coords = jnp.stack([xs, ys, ones]).reshape(3, -1)  # (3, H*W)

@@ -828,6 +828,10 @@ class Updater:
     def __init__(self, optimizer):
         self.optimizer = optimizer
         self.states = {}
+        # key -> flat leaf list from load_optimizer_states, grafted into
+        # the freshly created state on the key's first update (the nested
+        # structure is only known once create_state runs against a weight)
+        self.pending_loaded = {}
 
     def __call__(self, index, grad, weight):
         if not isinstance(index, (list, tuple)):
@@ -836,8 +840,13 @@ class Updater:
             if isinstance(i, bytes):
                 i = i.decode()
             if i not in self.states:
-                self.states[i] = \
-                    self.optimizer.create_state_multi_precision(i, w)
+                st = self.optimizer.create_state_multi_precision(i, w)
+                flat = self.pending_loaded.pop(i, None)
+                if flat is None:
+                    flat = self.pending_loaded.pop(str(i), None)
+                if flat is not None:
+                    st = _graft_state(st, list(flat))
+                self.states[i] = st
             self.optimizer.update_multi_precision(i, w, g, self.states[i])
 
     def set_states(self, states):
@@ -856,6 +865,29 @@ class Updater:
             return pickle.dumps({"states": self.states,
                                  "optimizer": self.optimizer})
         return pickle.dumps(self.states)
+
+
+def _graft_state(state, flat):
+    """Rebuild a freshly created optimizer state with loaded leaf values
+    (in flatten order), preserving the state's nested structure and leaf
+    dtypes."""
+    from ..ndarray.ndarray import NDArray
+
+    def walk(s):
+        if s is None:
+            return None
+        if isinstance(s, NDArray):
+            import jax.numpy as jnp
+
+            leaf = flat.pop(0)
+            val = leaf._data if isinstance(leaf, NDArray) else \
+                jnp.asarray(leaf)
+            return NDArray(val.astype(s.dtype))
+        if isinstance(s, (list, tuple)):
+            return type(s)(walk(x) for x in s)
+        return s
+
+    return walk(state)
 
 
 def get_updater(optimizer):

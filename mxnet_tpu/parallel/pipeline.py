@@ -112,7 +112,7 @@ def pipeline_apply(stage_fn, stacked_params, microbatches, axis_name,
     # scan (not fori_loop) so the schedule is reverse-differentiable —
     # pipelined BACKWARD falls out of jax.grad through the same loop
     (_, outputs), _ = jax.lax.scan(tick, (carry, outputs),
-                                   jnp.arange(total))
+                                   jnp.arange(total, dtype=jnp.int32))
     # make the final outputs visible on every stage (callers usually
     # need the loss everywhere); sum works since other stages hold zeros
     return jax.lax.psum(outputs, axis_name)
@@ -265,7 +265,7 @@ def pipeline_step_1f1b(stage_fn, loss_fn, stacked_params, microbatches,
     state0 = (in_buf0, rcv_buf0, zeros_act(), grads0, jnp.float32(0.0),
               (zeros_act(), jnp.int32(0), jnp.bool_(False)))
     (_, _, _, grads, loss_sum, _), _ = jax.lax.scan(
-        tick, state0, jnp.arange(total))
+        tick, state0, jnp.arange(total, dtype=jnp.int32))
     loss = jax.lax.psum(loss_sum, axis_name)  # only last stage added
     return loss, grads
 

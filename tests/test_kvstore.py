@@ -204,3 +204,104 @@ def test_horovod_byteps_adapters_registered():
     # no horovod/byteps in this image -> tpu_dist fallback
     assert isinstance(kvstore.create("horovod"), TPUDist)
     assert isinstance(kvstore.create("byteps"), TPUDist)
+
+
+def test_load_optimizer_states_resumes_momentum(tmp_path):
+    """ADVICE r4: loaded optimizer states must be consulted by the Updater
+    push path — a resumed store continues bit-identically, not with fresh
+    (zero) momentum."""
+    import numpy as onp
+
+    def make():
+        kv = mx.kv.create("local")
+        kv.set_optimizer(mx.optimizer.SGD(learning_rate=0.1, momentum=0.9))
+        return kv
+
+    def step(kv, w, n):
+        for _ in range(n):
+            kv.push("3", mx.nd.ones((4,)) * 0.5)
+            kv.pull("3", out=w)
+
+    kv1 = make()
+    w1 = mx.nd.ones((4,))
+    kv1.init("3", w1)
+    step(kv1, w1, 3)
+    fname = str(tmp_path / "opt.states")
+    kv1.save_optimizer_states(fname)
+    w_saved = w1.asnumpy().copy()
+    step(kv1, w1, 2)  # oracle: momentum carried through
+
+    # resume in a fresh store from the checkpointed weight + states
+    kv2 = make()
+    w2 = mx.nd.array(w_saved)
+    kv2.init("3", w2)
+    kv2.load_optimizer_states(fname)
+    step(kv2, w2, 2)
+    assert onp.allclose(w2.asnumpy(), w1.asnumpy(), atol=1e-7), \
+        (w2.asnumpy(), w1.asnumpy())
+
+    # load BEFORE set_optimizer also works (reference call order varies)
+    kv3 = mx.kv.create("local")
+    w3 = mx.nd.array(w_saved)
+    kv3.init("3", w3)
+    kv3.load_optimizer_states(fname)
+    kv3.set_optimizer(mx.optimizer.SGD(learning_rate=0.1, momentum=0.9))
+    step(kv3, w3, 2)
+    assert onp.allclose(w3.asnumpy(), w1.asnumpy(), atol=1e-7)
+
+
+def test_load_optimizer_states_after_warm_start(tmp_path):
+    """code-review r5: loading into a store whose keys ALREADY have
+    materialized state must overwrite that state, not silently keep it."""
+    import numpy as onp
+
+    def make():
+        kv = mx.kv.create("local")
+        kv.set_optimizer(mx.optimizer.SGD(learning_rate=0.1, momentum=0.9))
+        return kv
+
+    def step(kv, w, n):
+        for _ in range(n):
+            kv.push("9", mx.nd.ones((4,)) * 0.5)
+            kv.pull("9", out=w)
+
+    kv1 = make()
+    w1 = mx.nd.ones((4,))
+    kv1.init("9", w1)
+    step(kv1, w1, 3)
+    fname = str(tmp_path / "opt.states")
+    kv1.save_optimizer_states(fname)
+    w_saved = w1.asnumpy().copy()
+    step(kv1, w1, 2)  # oracle
+
+    kv2 = make()
+    w2 = mx.nd.array(w_saved)
+    kv2.init("9", w2)
+    step(kv2, w2, 1)  # WARM: key 9's state now exists (and is wrong)
+    before = [s.asnumpy().copy()
+              for s in _flatten(kv2._updater.states[9])]
+    kv2.load_optimizer_states(fname)  # must overwrite the warm state
+    after = [s.asnumpy() for s in _flatten(kv2._updater.states[9])]
+    import pickle
+
+    with open(fname, "rb") as f:
+        saved_flat = pickle.load(f)["states"][9]
+    # checkpointed leaves land verbatim, replacing the warm state
+    for a, s in zip(after, saved_flat):
+        onp.testing.assert_allclose(a, onp.asarray(s), atol=1e-7)
+    assert not all(
+        onp.allclose(b, onp.asarray(s))
+        for b, s in zip(before, saved_flat))  # warm state truly differed
+
+
+def _flatten(state):
+    from mxnet_tpu.ndarray.ndarray import NDArray
+
+    if state is None:
+        return []
+    if isinstance(state, NDArray):
+        return [state]
+    out = []
+    for s in state:
+        out.extend(_flatten(s))
+    return out

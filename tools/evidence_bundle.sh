@@ -1,0 +1,58 @@
+#!/bin/bash
+# One-command on-chip evidence bundle (VERDICT r4 #1: "a single minute of
+# tunnel uptime captures everything"). Unlike tunnel_watch.sh — which
+# captures the FULL revival checklist with generous budgets — this is the
+# minimal-wall-time capture, ordered so the most valuable artifact lands
+# first if the tunnel flaps mid-run:
+#   1. headline ResNet-50 train b=256 NHWC (~25 warm steps)      ~40 s
+#   2. perf_lab step timing + XPlane profile (BN-stat share)     ~60 s
+#   3. four A/B headline cells (bn_bf16 / mp0 / s2d0 / nchw)     ~40 s ea
+# Every cell is platform-stamped; CPU fallbacks are discarded, and
+# completed cells are skipped on re-run (flap-safe).
+#
+# Usage:  bash tools/evidence_bundle.sh [OUTDIR]   (default bench_r05_evidence)
+cd "$(dirname "$0")/.." || exit 1
+OUT=${1:-bench_r05_evidence}
+mkdir -p "$OUT"
+LOG="$OUT/bundle.log"
+BUDGET=${MXTPU_BENCH_BUDGET_S:-90}
+
+cell() {  # $1 out-file, rest = env assignments
+    local f="$OUT/$1"; shift
+    [ -s "$f" ] && { echo "skip $f (captured)" | tee -a "$LOG"; return 0; }
+    if env "$@" MXTPU_BENCH_HEADLINE_ONLY=1 MXTPU_BENCH_BUDGET_S=$BUDGET \
+            timeout $((BUDGET + 120)) python bench.py > "$f.tmp" 2>> "$LOG" \
+            && ! grep -q CPU_FALLBACK "$f.tmp"; then
+        mv "$f.tmp" "$f"; echo "captured $f" | tee -a "$LOG"
+    else
+        rm -f "$f.tmp"; echo "FAILED $f" | tee -a "$LOG"; return 1
+    fi
+}
+
+date -u +"%FT%TZ bundle start" >> "$LOG"
+cell headline.json MXTPU_IGNORE=1
+if [ ! -s "$OUT/perf_lab_step.txt" ]; then
+    timeout 240 python tools/perf_lab.py NHWC 256 step \
+        > "$OUT/perf_lab_step.txt.tmp" 2>> "$LOG" \
+        && grep -q '"platform"' "$OUT/perf_lab_step.txt.tmp" \
+        && ! grep -q '"platform": "cpu"' "$OUT/perf_lab_step.txt.tmp" \
+        && mv "$OUT/perf_lab_step.txt.tmp" "$OUT/perf_lab_step.txt" \
+        && echo "captured perf_lab_step" | tee -a "$LOG" \
+        || rm -f "$OUT/perf_lab_step.txt.tmp"
+fi
+if [ ! -s "$OUT/perf_lab_profile.txt" ]; then
+    MXTPU_PERFLAB_TRACE_DIR="$OUT/xplane" \
+    timeout 300 python tools/perf_lab.py NHWC 256 profile \
+        > "$OUT/perf_lab_profile.txt.tmp" 2>> "$LOG" \
+        && grep -q '"platform"' "$OUT/perf_lab_profile.txt.tmp" \
+        && ! grep -q '"platform": "cpu"' "$OUT/perf_lab_profile.txt.tmp" \
+        && mv "$OUT/perf_lab_profile.txt.tmp" "$OUT/perf_lab_profile.txt" \
+        && echo "captured perf_lab_profile" | tee -a "$LOG" \
+        || rm -f "$OUT/perf_lab_profile.txt.tmp"
+fi
+cell ab_bn_bf16.json MXTPU_BN_COMPUTE=bf16
+cell ab_mp0.json MXTPU_BENCH_MP=0
+cell ab_s2d0.json MXTPU_BENCH_S2D=0
+cell ab_nchw.json MXTPU_BENCH_LAYOUT=NCHW
+date -u +"%FT%TZ bundle end" >> "$LOG"
+ls -la "$OUT" | tee -a "$LOG"

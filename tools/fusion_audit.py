@@ -1,0 +1,241 @@
+"""Fusion-level StableHLO audit of the bench train step (VERDICT r4 #1
+fallback: the chip is unreachable, so quantify — from the program alone —
+where the bytes go, and produce FALSIFIABLE predictions for each staged
+A/B knob).
+
+Method: parse the StableHLO `bench.py` hands to XLA into an SSA dataflow
+graph, segment it into *predicted* TPU fusion regions (anchors =
+convolution / dot_general / reduce-window ops, which XLA fuses
+elementwise producers/consumers around; elementwise, convert, broadcast,
+select, compare and friends merge into connected regions), then charge
+each region its external bytes: inputs produced outside the region +
+outputs consumed outside it. That is the HBM traffic IF XLA fuses the way
+TPU normally does. The pessimistic column charges every op its full
+operand+result bytes — the cost when fusion breaks.
+
+Roofline uses the same v5e-class constants as BENCH_ESTIMATE.json
+(197 TFLOP/s bf16, 819 GB/s HBM).
+
+Usage: python tools/fusion_audit.py [NHWC|NCHW] [batch]
+Writes docs/fusion_audit_r5_<layout>.json and prints the summary table.
+"""
+from __future__ import annotations
+
+import json
+import os
+import re
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+PEAK_FLOPS = 197e12
+HBM_BPS = 819e9
+
+_ELEM_BYTES = {"f32": 4, "bf16": 2, "f16": 2, "f64": 8, "i64": 8,
+               "i32": 4, "ui32": 4, "i8": 1, "ui8": 1, "i1": 0.125,
+               "i16": 2, "ui16": 2, "f8E4M3FN": 1, "f8E5M2": 1}
+
+# ops that root a fusion region on TPU (the MXU/reduce kernels)
+_ANCHORS = ("convolution", "dot_general", "dot", "reduce_window",
+            "select_and_scatter", "scatter", "gather", "sort",
+            "dynamic_slice", "dynamic_update_slice", "iota", "rng",
+            "fft", "custom_call")
+# ops that fuse freely into neighbours
+_FUSABLE = ("add", "multiply", "subtract", "divide", "maximum", "minimum",
+            "rsqrt", "sqrt", "exponential", "exp", "log", "logistic",
+            "tanh", "abs", "negate", "sign", "floor", "ceil", "convert",
+            "broadcast_in_dim", "broadcast", "select", "compare", "and",
+            "or", "not", "xor", "clamp", "reshape", "transpose", "slice",
+            "concatenate", "pad", "reverse", "reduce", "power",
+            "remainder", "is_finite", "round_nearest_even",
+            "round_nearest_afz")
+
+
+def _tensor_bytes(sig):
+    """bytes of 'tensor<256x56x56x64xbf16>' (or '4x8xf32' inner)."""
+    m = re.match(r"tensor<(.*)>", sig)
+    inner = m.group(1) if m else sig
+    parts = inner.split("x")
+    dtype = parts[-1]
+    n = 1
+    for p in parts[:-1]:
+        if p.isdigit():
+            n *= int(p)
+    return n * _ELEM_BYTES.get(dtype, 4), dtype
+
+
+def parse_stablehlo(shlo):
+    """Return list of ops: {id, name, operands[], out_bytes, out_dtype}.
+    Only the main function's body is walked (sub-functions are inlined by
+    the time jax lowers a jitted step; remaining funcs are tiny)."""
+    ops = []
+    for line in shlo.splitlines():
+        line = line.strip()
+        m = re.match(
+            r"%(\S+?)\s*=\s*\"?stablehlo\.([\w.]+)\"?[^%]*(.*?)\s*:\s*"
+            r"\(?(tensor<[^)]*?>)", line)
+        if not m:
+            continue
+        rid, name, mid, first_sig = m.groups()
+        operands = re.findall(r"%([\w#]+)", mid)
+        # result signature: after '->' if present, else the single sig
+        rm = re.search(r"->\s*(tensor<[^>]*>)", line)
+        sig = rm.group(1) if rm else first_sig
+        out_bytes, out_dtype = _tensor_bytes(sig)
+        ops.append({"id": rid, "name": name, "operands": operands,
+                    "bytes": out_bytes, "dtype": out_dtype})
+    return ops
+
+
+def fusion_regions(ops):
+    """Union-find elementwise connected components; anchors isolate."""
+    idx = {o["id"]: i for i, o in enumerate(ops)}
+    parent = list(range(len(ops)))
+
+    def find(i):
+        while parent[i] != i:
+            parent[i] = parent[parent[i]]
+            i = parent[i]
+        return i
+
+    def union(a, b):
+        ra, rb = find(a), find(b)
+        if ra != rb:
+            parent[ra] = rb
+
+    def fusable(o):
+        return any(o["name"].startswith(f) for f in _FUSABLE) \
+            and not any(o["name"].startswith(a) for a in _ANCHORS)
+
+    for i, o in enumerate(ops):
+        if not fusable(o):
+            continue
+        for src in o["operands"]:
+            j = idx.get(src)
+            if j is not None and fusable(ops[j]):
+                union(i, j)
+    regions = {}
+    for i, o in enumerate(ops):
+        if fusable(o):
+            regions.setdefault(find(i), []).append(i)
+    return regions, idx
+
+
+def audit(layout="NHWC", batch=256):
+    import bench
+
+    platform = bench._probe_accelerator() or "cpu"
+    import jax
+
+    if platform != "tpu":
+        jax.config.update("jax_platforms", "cpu")
+
+    net, step, params, momenta, x, y = bench.build_resnet_train(
+        layout, batch, donate=True)
+    key = jax.random.PRNGKey(0)
+    lowered = step.lower(params, momenta, x, y, key)
+    shlo = lowered.as_text()
+    flops = float((lowered.compile().cost_analysis() or [{}])[0].get(
+        "flops", 0)) if platform == "tpu" else None
+    if flops is None:
+        ca = lowered.compile().cost_analysis()
+        d = ca[0] if isinstance(ca, list) else ca
+        flops = float(d.get("flops", 0))
+
+    ops = parse_stablehlo(shlo)
+    regions, idx = fusion_regions(ops)
+    consumers = {}
+    for o in ops:
+        for src in o["operands"]:
+            consumers.setdefault(src, []).append(o["id"])
+
+    region_rows = []
+    fused_bytes = 0.0
+    f32_elem_region_bytes = 0.0
+    for rid, members in regions.items():
+        mem_ids = {ops[i]["id"] for i in members}
+        in_bytes = 0.0
+        out_bytes = 0.0
+        f32_share = 0
+        for i in members:
+            o = ops[i]
+            if o["dtype"] == "f32":
+                f32_share += 1
+            for src in o["operands"]:
+                j = idx.get(src)
+                if j is None or ops[j]["id"] not in mem_ids:
+                    in_bytes += ops[j]["bytes"] if j is not None else 0
+            outside = [c for c in consumers.get(o["id"], [])
+                       if c not in mem_ids]
+            if outside or not consumers.get(o["id"]):
+                out_bytes += o["bytes"]
+        total = in_bytes + out_bytes
+        fused_bytes += total
+        if f32_share > len(members) // 2:
+            f32_elem_region_bytes += total
+        region_rows.append({"n_ops": len(members),
+                            "hbm_bytes": total,
+                            "mostly_f32": f32_share > len(members) // 2})
+
+    anchor_bytes = 0.0
+    n_anchors = 0
+    for o in ops:
+        if any(o["name"].startswith(a) for a in _ANCHORS):
+            n_anchors += 1
+            anchor_bytes += o["bytes"]
+            for src in o["operands"]:
+                j = idx.get(src)
+                if j is not None:
+                    anchor_bytes += ops[j]["bytes"]
+
+    broken_bytes = sum(o["bytes"] for o in ops) + sum(
+        ops[idx[s]]["bytes"] for o in ops for s in o["operands"]
+        if s in idx)
+
+    region_rows.sort(key=lambda r: -r["hbm_bytes"])
+    report = {
+        "layout": layout, "batch": batch, "platform": platform,
+        "constants": {"peak_bf16_flops": PEAK_FLOPS,
+                      "hbm_bytes_per_s": HBM_BPS},
+        "n_ops_parsed": len(ops),
+        "n_fusion_regions": len(regions),
+        "n_anchor_kernels": n_anchors,
+        "kernel_boundaries": len(regions) + n_anchors,
+        "flops_per_step": flops,
+        "t_flops_ms": flops / PEAK_FLOPS * 1e3,
+        "fused_model": {
+            "region_hbm_bytes": fused_bytes,
+            "anchor_hbm_bytes": anchor_bytes,
+            "total_hbm_bytes": fused_bytes + anchor_bytes,
+            "t_hbm_ms": (fused_bytes + anchor_bytes) / HBM_BPS * 1e3,
+        },
+        "broken_model": {
+            "total_hbm_bytes": broken_bytes,
+            "t_hbm_ms": broken_bytes / HBM_BPS * 1e3,
+        },
+        "f32_elementwise_region_bytes": f32_elem_region_bytes,
+        "f32_regions_t_hbm_ms": f32_elem_region_bytes / HBM_BPS * 1e3,
+        "top_regions": region_rows[:15],
+    }
+    return report
+
+
+def main():
+    layout = sys.argv[1] if len(sys.argv) > 1 else "NHWC"
+    batch = int(sys.argv[2]) if len(sys.argv) > 2 else 256
+    rep = audit(layout, batch)
+    out = os.path.join(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        "docs", f"fusion_audit_r5_{layout.lower()}.json")
+    with open(out, "w") as f:
+        json.dump(rep, f, indent=1)
+    slim = {k: v for k, v in rep.items() if k != "top_regions"}
+    print(json.dumps(slim, indent=1))
+    print("top regions by HBM bytes:")
+    for r in rep["top_regions"][:8]:
+        print(f"  {r['n_ops']:4d} ops  {r['hbm_bytes'] / 1e6:8.1f} MB  "
+              f"{'f32' if r['mostly_f32'] else 'bf16'}")
+
+
+if __name__ == "__main__":
+    main()
