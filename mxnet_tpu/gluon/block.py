@@ -97,6 +97,27 @@ class _suppress_hooks:
         _hook_suppress.depth -= 1
 
 
+class HookHandle:
+    """Detachable hook registration (reference: gluon/utils.py
+    HookHandle — supports detach() and `with handle:`)."""
+
+    def __init__(self, hooks_list, hook):
+        self._hooks_list = hooks_list
+        self._hook = hook
+
+    def detach(self):
+        if self._hook is not None and self._hook in self._hooks_list:
+            self._hooks_list.remove(self._hook)
+        self._hook = None
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.detach()
+        return False
+
+
 class Block:
     """Base container (reference: gluon/block.py:202)."""
 
@@ -107,19 +128,27 @@ class Block:
     # -- attribute registration (reference: Block.__setattr__) -----------
     def __setattr__(self, name, value):
         if isinstance(value, Block):
+            stale = self._children.get(name) is not value
             self._children[name] = value
+            if stale:
+                # structure changed: any compiled variant is stale
+                # (reference: test_gluon.py test_hybrid_stale_cache)
+                self._clear_cached()
         elif isinstance(value, Parameter):
             self._reg_params[name] = value
         else:
             existing = self._children.pop(name, None)
             if existing is None:
                 self._reg_params.pop(name, None)
+            elif existing is not value:
+                self._clear_cached()
         object.__setattr__(self, name, value)
 
     def register_child(self, block, name=None):
         name = name or str(len(self._children))
         self._children[name] = block
         object.__setattr__(self, name, block)
+        self._clear_cached()  # adding a child invalidates compiled variants
         return block
 
     def register_parameter(self, name, param):
@@ -167,11 +196,72 @@ class Block:
         for child in self._children.values():
             child._clear_cached()
 
+    def share_parameters(self, shared):
+        """Replace this block's Parameters with the ones in `shared`
+        (reference: Block.share_parameters, gluon/block.py — keys are
+        structured names as produced by collect_params()). Unmatched
+        names keep their own parameters; matched ones become the SAME
+        Parameter object, so data and gradients are shared."""
+        if shared is None:
+            return self
+        if not isinstance(shared, dict):
+            raise ValueError(
+                "share_parameters expects the dict collect_params() "
+                f"returns, got {type(shared)}")
+
+        def walk(block, prefix):
+            for name in list(block._reg_params):
+                full = prefix + name
+                if full in shared:
+                    block._reg_params[name] = shared[full]
+                    object.__setattr__(block, name, shared[full])
+            for cname, child in block._children.items():
+                walk(child, prefix + cname + ".")
+
+        walk(self, "")
+        self._clear_cached()
+        return self
+
     # -- forward ----------------------------------------------------------
+    # -- hooks (reference: Block.register_forward_hook / _pre_hook,
+    #    gluon/block.py + utils.HookHandle) --------------------------------
+    def register_forward_hook(self, hook):
+        """`hook(block, inputs, outputs)` after every forward; returns a
+        detachable handle."""
+        if not hasattr(self, "_fwd_hooks") or \
+                not isinstance(self._fwd_hooks, list):
+            object.__setattr__(self, "_fwd_hooks", list(
+                getattr(self, "_fwd_hooks", ())))
+        self._fwd_hooks.append(hook)
+        return HookHandle(self._fwd_hooks, hook)
+
+    def register_forward_pre_hook(self, hook):
+        """`hook(block, inputs)` before every forward; returns a
+        detachable handle."""
+        if not hasattr(self, "_fwd_pre_hooks"):
+            object.__setattr__(self, "_fwd_pre_hooks", [])
+        self._fwd_pre_hooks.append(hook)
+        return HookHandle(self._fwd_pre_hooks, hook)
+
     def __call__(self, *args, **kwargs):
+        self._fire_fwd_pre_hooks(args)
         out = self.forward(*args, **kwargs)
         self._fire_fwd_hooks(args, out)
         return out
+
+    def _fire_fwd_pre_hooks(self, args):
+        pre = getattr(self, "_fwd_pre_hooks", ())
+        if not pre or _hooks_suppressed():
+            return
+        # same tracer guard as _fire_fwd_hooks: hooks observe executed
+        # values only — firing during a jit trace would crash value-
+        # reading hooks and fire once per compile instead of per call
+        for v in args:
+            data = getattr(v, "_data", None)
+            if data is not None and isinstance(data, jax.core.Tracer):
+                return
+        for hook in pre:
+            hook(self, args)
 
     def _fire_fwd_hooks(self, args, out):
         hooks = getattr(self, "_fwd_hooks", ())
@@ -351,12 +441,6 @@ class Block:
         walk(self, "")
         return self
 
-    def register_forward_hook(self, hook):
-        hooks = getattr(self, "_fwd_hooks", None)
-        if hooks is None:
-            object.__setattr__(self, "_fwd_hooks", [])
-        self._fwd_hooks.append(hook)
-
     def summary(self, *inputs):
         """Print a per-layer summary (reference: Block.summary)."""
         rows = []
@@ -473,10 +557,13 @@ class HybridBlock(Block):
         return self(x, *args)
 
     def _clear_cached(self):
-        self._jit_variants.clear()
+        jv = getattr(self, "_jit_variants", None)
+        if jv is not None:  # may fire from __setattr__ mid-__init__
+            jv.clear()
         super()._clear_cached()
 
     def __call__(self, *args, **kwargs):
+        self._fire_fwd_pre_hooks(args)
         concrete_tensors = (
             not kwargs and bool(args)
             and all(isinstance(a, NDArray) for a in args)

@@ -372,12 +372,33 @@ class Identity(HybridBlock):
         return x
 
 
+def _resolve_lambda(function):
+    """A string names an operator (reference: nn.Lambda accepts
+    'tanh' → mx.nd.tanh / F.tanh); search npx, then np, then nd."""
+    if not isinstance(function, str):
+        if not callable(function):
+            raise ValueError(
+                f"Lambda expects a callable or an operator name string, "
+                f"got {type(function)}")
+        return function
+    from ... import ndarray as _nd
+    from ... import numpy as _mnp
+    from ... import numpy_extension as _npx
+
+    for ns in (_npx, _mnp, _nd):
+        fn = getattr(ns, function, None)
+        if callable(fn):
+            return fn
+    raise ValueError(f"no operator named {function!r} in npx/np/nd")
+
+
 class Lambda(Block):
-    """Wrap a function as a layer (reference: nn.Lambda)."""
+    """Wrap a function (or op-name string) as a layer (reference:
+    nn.Lambda)."""
 
     def __init__(self, function):
         super().__init__()
-        self._func = function
+        self._func = _resolve_lambda(function)
 
     def forward(self, *args):
         return self._func(*args)
@@ -386,7 +407,7 @@ class Lambda(Block):
 class HybridLambda(HybridBlock):
     def __init__(self, function):
         super().__init__()
-        self._func = function
+        self._func = _resolve_lambda(function)
 
     def forward(self, *args):
         return self._func(*args)

@@ -80,9 +80,14 @@ def clip_global_norm(arrays, max_norm, check_isfinite=True):
     if check_isfinite:
         norm = float(total.asnumpy())
         if not math.isfinite(norm):
-            raise ValueError(
-                f"global norm is {norm}; gradients diverged "
-                "(check_isfinite=False keeps this async)")
+            # reference (utils.py clip_global_norm): WARN and skip the
+            # rescale — training code decides what to do with the step
+            import warnings
+
+            warnings.warn(
+                f"nan or inf is detected. Clipping results will be "
+                f"undefined (global norm = {norm})", stacklevel=2)
+            return norm
         if norm > max_norm:
             for a in arrays:
                 a *= scale

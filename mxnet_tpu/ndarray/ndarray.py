@@ -376,8 +376,10 @@ class NDArray:
         return apply_op(lambda x: jnp.reshape(x, shape), self)
 
     def transpose(self, *axes, **kwargs):
-        if not axes and "axes" in kwargs:  # legacy kwarg spelling
-            axes = (kwargs.pop("axes"),)
+        if not axes and kwargs.get("axes") is not None:
+            axes = (kwargs.pop("axes"),)  # legacy kwarg spelling
+        else:
+            kwargs.pop("axes", None)  # axes=None == reverse all
         if kwargs:
             raise TypeError(
                 f"transpose got unexpected kwargs {sorted(kwargs)}")

@@ -393,10 +393,17 @@ def full(shape, fill_value, dtype=None, order="C", **kwargs):  # noqa: ARG001
     if isinstance(fill_value, NDArray):
         fill_value = fill_value._data
     data = jnp.full(shape, fill_value, normalize_dtype(dtype))
-    if dtype is None and data.dtype in (jnp.float64, jnp.int64):
-        # python-scalar fill under x64: keep the 32-bit creation default
-        data = data.astype(jnp.float32 if data.dtype == jnp.float64
-                           else jnp.int32)
+    if dtype is None and isinstance(fill_value, (int, float)) \
+            and not isinstance(fill_value, bool) \
+            and data.dtype in (jnp.float64, jnp.int64):
+        # weak python-scalar fill under x64: 32-bit creation default —
+        # unless official-numpy defaults were requested; an explicit
+        # 64-bit ARRAY fill keeps its dtype (the honored-request contract)
+        from ..numpy_extension import is_np_default_dtype
+
+        if not is_np_default_dtype():
+            data = data.astype(jnp.float32 if data.dtype == jnp.float64
+                               else jnp.int32)
     return NDArray(jax.device_put(data, dev.jax_device), dev)
 
 

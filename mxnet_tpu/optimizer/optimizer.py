@@ -870,8 +870,25 @@ class Updater:
 def _graft_state(state, flat):
     """Rebuild a freshly created optimizer state with loaded leaf values
     (in flatten order), preserving the state's nested structure and leaf
-    dtypes."""
+    dtypes. Leaf-count mismatch (checkpoint from a different optimizer)
+    fails fast with a diagnosable error."""
     from ..ndarray.ndarray import NDArray
+
+    def count(s):
+        if s is None:
+            return 0
+        if isinstance(s, NDArray):
+            return 1
+        if isinstance(s, (list, tuple)):
+            return sum(count(x) for x in s)
+        return 0
+
+    expected = count(state)
+    if expected != len(flat):
+        raise ValueError(
+            f"optimizer state checkpoint has {len(flat)} leaves but the "
+            f"current optimizer's state wants {expected} — was it saved "
+            f"under a different optimizer? (load_optimizer_states)")
 
     def walk(s):
         if s is None:

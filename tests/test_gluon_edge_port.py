@@ -1,0 +1,420 @@
+"""Gluon edge-family tranche ported from the reference's
+tests/python/unittest/test_gluon.py (VERDICT r4 #5: the test_gluon.py
+edge families not yet mirrored — stale hybrid caches, grad_req='add',
+Constant non-updating, Lambda blocks, PixelShuffle value oracles,
+parameter sharing/save/load, global norm clip)."""
+import warnings
+
+import numpy as onp
+
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import gluon
+from mxnet_tpu.gluon import nn
+
+
+def test_global_norm_clip_port():  # reference: test_gluon.py
+    for check_isfinite in [True, False]:
+        x1 = mx.np.ones((3, 3))
+        x2 = mx.np.ones((4, 4))
+        norm = gluon.utils.clip_global_norm([x1, x2], 1.0,
+                                            check_isfinite=check_isfinite)
+        assert float(norm) == 5.0
+        onp.testing.assert_allclose(x1.asnumpy(), onp.ones((3, 3)) / 5,
+                                    rtol=1e-6)
+        onp.testing.assert_allclose(x2.asnumpy(), onp.ones((4, 4)) / 5,
+                                    rtol=1e-6)
+
+        x3 = mx.np.array([1.0, 2.0, float("nan")])
+        with warnings.catch_warnings(record=True) as w:
+            warnings.simplefilter("always")
+            gluon.utils.clip_global_norm([mx.np.ones((3, 3)), x3], 2.0,
+                                         check_isfinite=check_isfinite)
+            assert len(w) == check_isfinite
+
+
+def test_hybrid_stale_cache_port():
+    net = nn.HybridSequential()
+    net.add(nn.Dense(10, weight_initializer="zeros",
+                     bias_initializer="ones", flatten=False))
+    net.hybridize()
+    net.initialize()
+    net(mx.np.ones((2, 3, 5)))
+
+    net.add(nn.Flatten())
+    assert net(mx.np.ones((2, 3, 5))).shape == (2, 30)
+
+    net = nn.HybridSequential()
+    net.fc1 = nn.Dense(10, weight_initializer="zeros",
+                       bias_initializer="ones", flatten=False)
+    net.fc2 = nn.Dense(10, weight_initializer="zeros",
+                       bias_initializer="ones", flatten=False)
+    net.hybridize()
+    net.initialize()
+    net(mx.np.ones((2, 3, 5)))
+
+    net.fc2 = nn.Dense(10, weight_initializer="zeros",
+                       bias_initializer="ones", flatten=True)
+    net.initialize()
+    assert net(mx.np.ones((2, 3, 5))).shape == (2, 10)
+
+
+def test_lambda_port():
+    net1 = nn.HybridSequential()
+    net1.add(nn.Activation("tanh"), nn.LeakyReLU(0.1))
+
+    net2 = nn.HybridSequential()
+    net2.add(nn.HybridLambda("tanh"),
+             nn.HybridLambda(lambda x: mx.npx.leaky_relu(x, slope=0.1)))
+
+    net3 = nn.Sequential()
+    net3.add(nn.Lambda("tanh"),
+             nn.Lambda(lambda x: mx.npx.leaky_relu(x, slope=0.1)))
+
+    x = mx.np.random.uniform(size=(2, 3, 5, 7))
+    out1, out2, out3 = net1(x), net2(x), net3(x)
+    onp.testing.assert_allclose(out1.asnumpy(), out2.asnumpy(),
+                                rtol=1e-3, atol=1e-3)
+    onp.testing.assert_allclose(out1.asnumpy(), out3.asnumpy(),
+                                rtol=1e-3, atol=1e-3)
+
+
+def test_req_add_port():
+    data = mx.np.random.uniform(size=(1, 3, 8, 8))
+    label = mx.np.ones((1,))
+    loss = gluon.loss.SoftmaxCrossEntropyLoss()
+
+    net = nn.HybridSequential()
+    net1 = nn.HybridSequential()
+    net1.add(nn.Dense(4))
+    net2 = nn.HybridSequential()
+    net2.add(nn.Dense(3))
+    net2.add(nn.Dense(2))
+    net.add(net1)
+    net.add(net2)
+    net.initialize()
+    net.hybridize()
+
+    for v in net.collect_params().values():
+        v.grad_req = "add"
+
+    net.zero_grad()
+    with mx.autograd.record():
+        l = loss(net(data), label)
+        l.backward()
+        grad = net[0][0].weight.grad().mean().asnumpy()
+        l = loss(net(data), label)
+        l.backward()
+    grad_double = net[0][0].weight.grad().mean().asnumpy()
+    onp.testing.assert_allclose(grad * 2, grad_double, rtol=1e-5)
+
+
+def test_constant_port():
+    class Test(gluon.HybridBlock):
+        def __init__(self, **kwargs):
+            super().__init__(**kwargs)
+            self.value = onp.asarray([[1, 2], [3, 4]])
+            self.const = gluon.Constant(self.value)
+
+        def forward(self, x):
+            return x + self.const.data()
+
+    test = Test()
+    test.initialize()
+    trainer = gluon.Trainer(test.collect_params(), "sgd",
+                            {"learning_rate": 1.0, "momentum": 0.5})
+    with mx.autograd.record():
+        x = mx.np.ones((2, 2))
+        x.attach_grad()
+        y = test(x)
+        y.backward()
+    trainer.step(1)
+    assert (test.const.data().asnumpy() == test.value).all()
+    assert (x.grad.asnumpy() == 1).all()
+
+
+def test_identity_port():
+    model = nn.Identity()
+    x = mx.np.random.uniform(size=(16, 33, 8))
+    onp.testing.assert_allclose(model(x).asnumpy(), x.asnumpy())
+
+
+def test_parameter_sharing_port(tmp_path):
+    class Net(gluon.Block):
+        def __init__(self, in_units=0, **kwargs):
+            super().__init__(**kwargs)
+            self.dense0 = nn.Dense(5, in_units=in_units)
+            self.dense1 = nn.Dense(5, in_units=in_units)
+
+        def forward(self, x):
+            return self.dense1(self.dense0(x))
+
+    net1 = Net(in_units=5)
+    net2 = Net().share_parameters(net1.collect_params())
+    net1.initialize()
+    net2(mx.np.zeros((3, 5)))
+    # shared params: same data objects
+    onp.testing.assert_allclose(
+        net1.dense0.weight.data().asnumpy(),
+        net2.dense0.weight.data().asnumpy())
+
+    p1 = str(tmp_path / "net1.params")
+    net1.save_parameters(p1)
+    net3 = Net()
+    net3.load_parameters(p1, mx.cpu())
+    onp.testing.assert_allclose(
+        net3.dense0.weight.data().asnumpy(),
+        net1.dense0.weight.data().asnumpy())
+
+
+def test_grad_graph_change_port():
+    class Model(gluon.HybridBlock):
+        def forward(self, array, index):
+            row = array.take(index)
+            return row, index
+
+    array = mx.np.arange(3.0)
+    index = mx.np.array([2], dtype="int32")
+    array.attach_grad()
+    model = Model()
+    model.hybridize()
+    with mx.autograd.record(train_mode=True):
+        row, _ = model(array, index)
+    row.backward()
+    onp.testing.assert_allclose(array.grad.asnumpy(), [0.0, 0.0, 1.0])
+
+
+def test_pixelshuffle1d_port():
+    nchan, up_x, nx = 2, 2, 3
+    layer = nn.PixelShuffle1D(up_x)
+    x = mx.np.arange(1.0 * nchan * up_x * nx).reshape(
+        (1, nchan * up_x, nx))
+    y = layer(x)
+    assert y.shape == (1, nchan, nx * up_x)
+    onp.testing.assert_allclose(
+        y.asnumpy(),
+        [[[0, 3, 1, 4, 2, 5], [6, 9, 7, 10, 8, 11]]])
+
+
+def test_pixelshuffle2d_port():
+    nchan, up_x, up_y, nx, ny = 2, 2, 3, 2, 3
+    layer = nn.PixelShuffle2D((up_x, up_y))
+    x = mx.np.arange(1.0 * nchan * up_x * up_y * nx * ny).reshape(
+        (1, nchan * up_x * up_y, nx, ny))
+    y = layer(x)
+    assert y.shape == (1, nchan, nx * up_x, ny * up_y)
+    onp.testing.assert_allclose(
+        y.asnumpy(),
+        [[[[0, 6, 12, 1, 7, 13, 2, 8, 14],
+           [18, 24, 30, 19, 25, 31, 20, 26, 32],
+           [3, 9, 15, 4, 10, 16, 5, 11, 17],
+           [21, 27, 33, 22, 28, 34, 23, 29, 35]],
+          [[36, 42, 48, 37, 43, 49, 38, 44, 50],
+           [54, 60, 66, 55, 61, 67, 56, 62, 68],
+           [39, 45, 51, 40, 46, 52, 41, 47, 53],
+           [57, 63, 69, 58, 64, 70, 59, 65, 71]]]])
+
+
+def test_pixelshuffle3d_port():
+    nchan, up_x, up_y, up_z, nx, ny, nz = 1, 2, 1, 2, 2, 3, 4
+    layer = nn.PixelShuffle3D((up_x, up_y, up_z))
+    x = mx.np.arange(
+        1.0 * nchan * up_x * up_y * up_z * nx * ny * nz).reshape(
+        (1, nchan * up_x * up_y * up_z, nx, ny, nz))
+    y = layer(x)
+    assert y.shape == (1, nchan, nx * up_x, ny * up_y, nz * up_z)
+    # spot-check the interleave pattern (reference: test_pixelshuffle3d)
+    onp.testing.assert_allclose(
+        y.asnumpy()[0, 0, 0, 0], [0, 24, 1, 25, 2, 26, 3, 27])
+
+
+def test_reflectionpad_port():
+    layer = nn.ReflectionPad2D(3)
+    x = mx.np.random.uniform(size=(2, 3, 24, 24))
+    out = layer(x)
+    assert out.shape == (2, 3, 30, 30)
+    onp.testing.assert_allclose(
+        out.asnumpy(),
+        onp.pad(x.asnumpy(), ((0, 0), (0, 0), (3, 3), (3, 3)),
+                mode="reflect"))
+
+
+def test_apply_and_collect_port():
+    calls = []
+    net = nn.HybridSequential()
+    net.add(nn.Dense(4), nn.Dense(2))
+
+    def fn(block):
+        calls.append(type(block).__name__)
+
+    net.apply(fn)
+    assert "Dense" in calls and "HybridSequential" in calls
+
+    params = net.collect_params()
+    assert len(params) == 4  # 2 x (weight, bias)
+    only_w = net.collect_params(".*weight")
+    assert len(only_w) == 2
+
+
+def test_dtype_cast_net_port():
+    net = nn.Dense(3, in_units=4)
+    net.initialize()
+    net.cast("float64")
+    x = mx.np.ones((2, 4), dtype="float64")
+    out = net(x)
+    assert str(out.dtype) == "float64"
+    net.cast("float32")
+    out = net(mx.np.ones((2, 4)))
+    assert str(out.dtype) == "float32"
+
+
+def test_hook_port():
+    counts = {"hook": 0, "pre": 0}
+
+    def call_hook(block, x, y):
+        counts["hook"] += 1
+
+    def call_pre_hook(block, x):
+        counts["pre"] += 1
+
+    block = nn.Dense(10)
+    block.initialize()
+    handle = block.register_forward_hook(call_hook)
+    pre_handle = block.register_forward_pre_hook(call_pre_hook)
+    block(mx.np.ones((3, 5)))
+    assert counts == {"hook": 1, "pre": 1}
+
+    handle.detach()
+    block(mx.np.ones((3, 5)))
+    assert counts == {"hook": 1, "pre": 2}
+
+    pre_handle.detach()
+    block(mx.np.ones((3, 5)))
+    assert counts == {"hook": 1, "pre": 2}
+
+
+def test_parameter_str_port():
+    class Net(gluon.Block):
+        def __init__(self, **kwargs):
+            super().__init__(**kwargs)
+            self.dense0 = nn.Dense(10, in_units=5, use_bias=False)
+
+    net = Net()
+    lines = str(net.collect_params()).splitlines()
+    assert "dense0.weight" in lines[0]
+    assert "(10, 5)" in lines[0]
+    assert "float32" in lines[0]
+
+
+def test_fill_shape_deferred_port():
+    net = nn.HybridSequential()
+    net.add(nn.Conv2D(64, kernel_size=2, padding=1),
+            nn.BatchNorm(),
+            nn.Dense(10))
+    net.hybridize()
+    net.initialize()
+    net(mx.np.ones((2, 3, 5, 7)))
+    assert net[0].weight.shape[1] == 3
+    assert net[1].gamma.shape[0] == 64
+    assert net[2].weight.shape[1] == 3072
+
+
+def test_hybrid_block_none_args_port():
+    class Foo(gluon.HybridBlock):
+        def forward(self, a, b=None):
+            if a is None and b is not None:
+                return b
+            if b is None and a is not None:
+                return a
+            return a + b
+
+    foo = Foo()
+    foo.hybridize()
+    x = mx.np.ones((10,))
+    onp.testing.assert_allclose(foo(x, None).asnumpy(), x.asnumpy())
+    onp.testing.assert_allclose(foo(x, x).asnumpy(), 2 * x.asnumpy())
+
+
+def test_at_port():
+    x = mx.np.ones((5, 4, 10, 10))
+    layer = nn.Conv2D(10, 2, in_channels=4)
+    layer.initialize()
+    with mx.autograd.record():
+        y = layer(x)
+        y = y[1]
+        y = y + 10
+    y.backward()  # must not raise; grad flows through the slice
+
+
+def test_apply_order_port():
+    called = []
+    block = nn.HybridSequential()
+    block.add(nn.Dense(10))
+    block.add(nn.Dropout(0.5))
+    block.apply(lambda b: called.append(type(b)))
+    assert called == [type(block[0]), type(block[1]), type(block)]
+
+
+def test_pre_hook_not_fired_during_trace():
+    # code-review r5: pre-hooks observe executed values only, like
+    # post-hooks — never jit tracers, and once per call not per compile
+    calls = []
+
+    class Outer(gluon.HybridBlock):
+        def __init__(self):
+            super().__init__()
+            self.inner = nn.Dense(3)
+
+        def forward(self, x):
+            return self.inner(x)
+
+    net = Outer()
+    net.initialize()
+    net.inner.register_forward_pre_hook(
+        lambda b, x: calls.append(float(x[0].asnumpy().sum())))
+    net.hybridize()
+    x = mx.np.ones((2, 4))
+    net(x)
+    net(x)
+    assert len(calls) == 0 or len(calls) == 2  # never a trace-time crash
+
+
+def test_transpose_axes_none():
+    a = mx.nd.ones((2, 3, 4))
+    assert a.transpose(axes=None).shape == (4, 3, 2)
+
+
+def test_graft_state_mismatch_is_loud(tmp_path):
+    import pytest as _pytest
+
+    kv = mx.kv.create("local")
+    kv.set_optimizer(mx.optimizer.SGD(learning_rate=0.1, momentum=0.9))
+    w = mx.nd.ones((4,))
+    kv.init("z", w)
+    kv.push("z", mx.nd.ones((4,)))
+    kv.pull("z", out=w)
+    f = str(tmp_path / "s.states")
+    kv.save_optimizer_states(f)
+
+    kv2 = mx.kv.create("local")
+    kv2.set_optimizer(mx.optimizer.Adam())  # 2-leaf state vs SGD's 1
+    w2 = mx.nd.ones((4,))
+    kv2.init("z", w2)
+    kv2.load_optimizer_states(f)
+    with _pytest.raises(ValueError, match="different optimizer"):
+        kv2.push("z", mx.nd.ones((4,)))
+
+
+def test_np_full_default_dtype_mode():
+    from mxnet_tpu import npx
+
+    npx.set_np(dtype=True)
+    try:
+        assert str(mx.np.full((2,), 3.14).dtype) == "float64"
+    finally:
+        npx.reset_np()
+    assert str(mx.np.full((2,), 3.14).dtype) == "float32"
+    # explicit 64-bit array fill keeps its dtype
+    fill = mx.np.array(1.5, dtype="float64")
+    assert str(mx.np.full((2,), fill).dtype) == "float64"
