@@ -8180,6 +8180,21 @@ inline std::vector<PackedTensor> hard_sigmoid(
   return rt.invoke("hard_sigmoid", ins_, a_.str());
 }
 
+inline std::vector<PackedTensor> histogram(
+    PyRuntime& rt,
+    const PackedTensor& data,
+    const char* bins_json = nullptr,
+    const char* bin_cnt_json = nullptr,
+    const char* range_json = nullptr) {
+  std::vector<PackedTensor> ins_;
+  ins_.push_back(data);
+  detail::JsonBuilder a_;
+  if (bins_json) a_.raw("bins", bins_json);
+  if (bin_cnt_json) a_.raw("bin_cnt", bin_cnt_json);
+  if (range_json) a_.raw("range", range_json);
+  return rt.invoke("histogram", ins_, a_.str());
+}
+
 inline std::vector<PackedTensor> hypot(
     PyRuntime& rt,
     const PackedTensor& x1,

@@ -144,6 +144,7 @@ class TapeNode:
         "name",
         "pure_fn",
         "input_datas",
+        "retained",
     )
 
     def __init__(self, vjp_fn, inputs, input_entries, out_avals, multi_out,
@@ -160,6 +161,11 @@ class TapeNode:
         # references, not copies)
         self.pure_fn = pure_fn
         self.input_datas = input_datas
+        # (weakref(NDArray), out_idx) pairs registered by attach_grad on
+        # an already-recorded array: backward lands the out-cotangent in
+        # their .grad (reference retain-grad — test_autograd.py
+        # test_retain_grad_drop_grad)
+        self.retained = None
 
 
 def _zero_cotangent(shape, dtype):
@@ -269,8 +275,20 @@ def backward(heads, head_grads=None, retain_graph=False, train_mode=True):  # no
         full = []
         for s, (shape, dtype) in zip(slots, node.out_avals):
             full.append(s if s is not None else _zero_cotangent(shape, dtype))
+        if node.retained:
+            # retain-grad: land this node's output cotangents in the
+            # .grad of arrays that attach_grad'd mid-graph
+            for ref, ridx in node.retained:
+                var = ref()
+                if var is not None and var._grad is not None:
+                    _acc_var(var, full[ridx])
         out_ct = tuple(full) if node.multi_out else full[0]
         if node.vjp_fn is None:
+            if node.retained:
+                # the arriving cotangents were landed into the retained
+                # arrays above; the producer graph is consumed, so they
+                # act as leaves — stop here instead of raising
+                continue
             raise RuntimeError(
                 "tape already freed; call backward(retain_graph=True) to "
                 "backprop through the same graph twice"
@@ -414,7 +432,10 @@ def grad(heads, variables, head_grads=None, retain_graph=None, create_graph=Fals
             all_grads = pull(tuple(sd))
             return tuple(all_grads[s] for s in slot_of)
 
-        out = apply_op(pure_grads, *extended, *seeds, name="grad")
+        # create_graph FORCES recording (reference: the gradient pass is
+        # itself recorded so dx.backward() works outside any record scope)
+        with record(train_mode=train_mode):
+            out = apply_op(pure_grads, *extended, *seeds, name="grad")
         return list(out) if isinstance(out, (tuple, list)) else [out]
     saved = [(v._grad, v._grad_req) for v in variables]
     zeros = []

@@ -301,16 +301,22 @@ class CTCLoss(Loss):
     """CTC loss (reference: loss.py:CTCLoss over src/operator/nn/ctc_loss.cc
     / warp-ctc). Implemented over optax.ctc_loss (XLA-lowered)."""
 
-    def __init__(self, layout="NTC", label_layout="NT", weight=None):
+    def __init__(self, layout="NTC", label_layout="NT", weight=None,
+                 padding_value=-1, blank_id=0):
         super().__init__(weight, 0)
         assert layout in ("NTC", "TNC")
         self._layout = layout
         self._label_layout = label_layout
+        # gluon contract: labels padded with -1 (reference loss.py:497);
+        # the nd.ctc_loss op overrides to 0 for blank_label='first'
+        self._padding_value = padding_value
+        self._blank_id = blank_id
 
     def forward(self, pred, label, pred_lengths=None, label_lengths=None):
         import optax
 
         layout, w = self._layout, self._weight
+        pad_val, blank = self._padding_value, self._blank_id
 
         def fn(p, l, pl=None, ll=None):  # noqa: E741
             if layout == "TNC":
@@ -319,10 +325,16 @@ class CTCLoss(Loss):
             logitpad = jnp.zeros((n, t)) if pl is None else (
                 jnp.arange(t)[None, :] >= pl[:, None]).astype(p.dtype)
             lt = l.shape[1]
-            labelpad = jnp.zeros((n, lt)) if ll is None else (
-                jnp.arange(lt)[None, :] >= ll[:, None]).astype(p.dtype)
-            loss = optax.ctc_loss(p, logitpad, l.astype(jnp.int32), labelpad,
-                                  blank_id=0)
+            if ll is None:
+                # infer lengths: cut at the first padding value
+                # (reference ctc_loss.cc LabelTensorToPackedVector)
+                is_pad = l == pad_val
+                ll = jnp.where(is_pad.any(axis=1),
+                               is_pad.argmax(axis=1), lt)
+            labelpad = (jnp.arange(lt)[None, :]
+                        >= ll[:, None]).astype(p.dtype)
+            loss = optax.ctc_loss(p, logitpad, l.astype(jnp.int32),
+                                  labelpad, blank_id=blank)
             if w is not None:
                 loss = loss * w
             return loss

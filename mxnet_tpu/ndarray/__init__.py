@@ -295,3 +295,18 @@ class CachedOp:
                 r.copyto(o)
             return out
         return outs if len(outs) > 1 else outs[0]
+
+
+def __getattr__(name):
+    """Resolve ops registered AFTER populate() ran (late module imports
+    add registry entries — ctc_loss, amp_multicast; the symbol package
+    has the same resync in its __getattr__)."""
+    from ..ops.registry import _OPS
+
+    fn = _OPS.get(name)
+    if fn is not None:
+        eager = make_eager(name, fn)
+        globals()[name] = eager  # cache for next lookup
+        return eager
+    raise AttributeError(f"module 'mxnet_tpu.ndarray' has no attribute "
+                         f"{name!r}")

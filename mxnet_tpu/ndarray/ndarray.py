@@ -286,10 +286,30 @@ class NDArray:
     # ------------------------------------------------------------------
     def attach_grad(self, grad_req="write", stype=None):  # noqa: ARG002
         """Attach a zero-initialized gradient buffer (reference:
-        python/mxnet/ndarray/ndarray.py attach_grad)."""
+        python/mxnet/ndarray/ndarray.py attach_grad). On an array that is
+        already part of a recorded graph this RETAINS the mid-graph
+        gradient: backward lands the array's output cotangent in .grad
+        while still flowing through it (reference retain-grad
+        semantics)."""
         self._grad = _wrap_out(jnp.zeros_like(self._data))
         self._grad_req = grad_req
-        self._tape_entry = None
+        if self._tape_entry is not None:
+            import weakref
+
+            node, idx = self._tape_entry
+            if node.vjp_fn is None:
+                # producer tape already consumed: nothing can flow
+                # through — this array becomes a fresh leaf (the old
+                # detach semantics)
+                self._tape_entry = None
+                return self
+            if node.retained is None:
+                node.retained = []
+            # re-attach replaces, never duplicates (each entry lands the
+            # cotangent once)
+            node.retained = [(r, i) for r, i in node.retained
+                             if r() is not None and r() is not self]
+            node.retained.append((weakref.ref(self), idx))
         return self
 
     def drop_grad(self):

@@ -378,12 +378,20 @@ def _install_round2():
     reg("_multi_lans_update", _OPS.get("lans_update_phase1"))
     reg("_multi_mp_lans_update", _OPS.get("lans_update_phase1"))
 
-    # CTCLoss op spelling over the loss implementation
+    # CTCLoss op spelling over the loss implementation. Padding value is
+    # 0 for blank_label='first', -1 for 'last' (ctc_loss-inl.h:346); the
+    # blank class is 0 or alphabet_size-1 respectively (:370).
     def ctc_loss(data, label, data_lengths=None, label_lengths=None,
                  use_data_lengths=False, use_label_lengths=False,
                  blank_label="first"):  # noqa: ARG001
-        lossfn = gloss.CTCLoss(layout="TNC", label_layout="NT")
-        return lossfn(data, label, data_lengths, label_lengths)
+        first = blank_label == "first"
+        alphabet = data.shape[-1]  # NDArray or jax array alike
+        lossfn = gloss.CTCLoss(layout="TNC", label_layout="NT",
+                               padding_value=0 if first else -1,
+                               blank_id=0 if first else alphabet - 1)
+        return lossfn(data, label,
+                      data_lengths if use_data_lengths else None,
+                      label_lengths if use_label_lengths else None)
 
     reg("CTCLoss", ctc_loss)
     reg("ctc_loss", ctc_loss)

@@ -76,13 +76,15 @@ def syrk(A, transpose=False, alpha=1.0):
 
 @register_op("linalg_gelqf")
 def gelqf(A):
-    """LQ factorization A = L Q with Q orthonormal rows (la_op.cc gelqf).
+    """LQ factorization A = L Q with Q orthonormal rows (la_op.cc:821
+    gelqf). Output order is **(Q, L)** — the reference's documented
+    `Q, L = gelqf(A)` (la_op.cc examples); r5 fixed a swapped order that
+    an identity-only test had encoded.
 
     Computed via QR of A^T: A^T = Q' R'  =>  A = R'^T Q'^T.
     """
     q, r = jnp.linalg.qr(jnp.swapaxes(A, -1, -2), mode="reduced")
-    # sign-normalize so diag(L) >= 0, matching LAPACK gelqf convention loosely
-    return jnp.swapaxes(r, -1, -2), jnp.swapaxes(q, -1, -2)
+    return jnp.swapaxes(q, -1, -2), jnp.swapaxes(r, -1, -2)
 
 
 @register_op("linalg_syevd")

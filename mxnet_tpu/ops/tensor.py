@@ -465,6 +465,25 @@ def reverse(data, axis=0):
 flip = register_op("flip", reverse)
 
 
+@register_op("histogram")
+def histogram(data, bins=None, bin_cnt=None, range=None):  # noqa: A002
+    """Reference histogram op (tensor/histogram.cc): int bin count needs
+    an explicit range; an array `bins` gives the edges. Returns
+    (counts int64, bin_edges)."""
+    import numbers
+
+    if bins is not None and not isinstance(bins, numbers.Integral):
+        cnt, edges = jnp.histogram(data, bins=bins)
+    else:
+        n = bin_cnt if bin_cnt is not None else (bins or 10)
+        if range is None:
+            raise ValueError(
+                "histogram with an integer bin count requires range= "
+                "(reference histogram.cc contract)")
+        cnt, edges = jnp.histogram(data, bins=int(n), range=range)
+    return cnt.astype(jnp.int64), edges
+
+
 @register_op("choose_element_0index")
 def choose_element_0index(lhs, rhs):
     """out[i] = lhs[i, rhs[i]] — row-wise pick with (float) indices

@@ -281,3 +281,173 @@ def test_backward_through_list_output_op():
     want = onp.repeat(onp.array([[1.0, 2.0, 3.0]]), 2, 0)
     want = onp.repeat(want, 2, 1).reshape(2, 6)
     onp.testing.assert_allclose(x.grad.asnumpy(), want)
+
+
+# --- r5 tranche: reference test_autograd.py families not yet mirrored ---
+
+def test_retain_grad_drop_grad_port():
+    x = mx.nd.array([1.0, 2, 3, 4])
+    x.attach_grad()
+    y = mx.nd.array([5.0, 6, 7, 8])
+    y.attach_grad()
+
+    with mx.autograd.record():
+        u = x * y
+        z = u * x
+
+    u.attach_grad()
+    z.attach_grad()
+    out_grad = mx.nd.array([10.0, 10, 10, 10])
+    z.backward(out_grad, retain_graph=True)
+
+    assert (u.grad.asnumpy() == (out_grad * x).asnumpy()).all()
+    assert (z.grad.asnumpy() == out_grad.asnumpy()).all()
+    assert (x.grad.asnumpy() == (out_grad * 2 * x * y).asnumpy()).all()
+    assert (y.grad.asnumpy() == (out_grad * x * x).asnumpy()).all()
+
+    u.drop_grad()
+    z.drop_grad()
+    y.drop_grad()
+    out_grad = mx.nd.array([0.1, 0.1, 0.1, 0.1])
+    z.backward(out_grad)
+    assert u.grad is None and z.grad is None and y.grad is None
+    onp.testing.assert_allclose(
+        x.grad.asnumpy(), (out_grad * 2 * x * y).asnumpy(), rtol=1e-6)
+
+
+def test_out_grads_port():
+    x = mx.nd.ones((3, 5))
+    x.attach_grad()
+    db = mx.nd.array([1.0, 2, 3, 4, 5])
+    dc = mx.nd.array([5.0, 4, 3, 2, 1])
+    with mx.autograd.record():
+        a, b, c = mx.nd.split(x, axis=0, num_outputs=3, squeeze_axis=True)
+        mx.autograd.backward([a, b, c], [None, db, dc])
+    onp.testing.assert_array_equal(
+        x.grad.asnumpy(),
+        onp.array([[1, 1, 1, 1, 1], [1, 2, 3, 4, 5], [5, 4, 3, 2, 1]],
+                  dtype="f"))
+
+
+def test_detach_updated_grad_port():
+    x = mx.nd.ones((2, 2))
+    x.attach_grad()
+    y = mx.nd.ones((2, 2))
+    y.attach_grad()
+    with mx.autograd.record():
+        x2 = x + 2
+        y2 = x2 + y
+        y2.backward()
+    assert (x.grad.asnumpy() == 1).all()
+
+    x.grad[:] = 0
+    with mx.autograd.record():
+        x2 = x + 2
+        x2 = x2.detach()
+        y2 = x2 + y
+        y2.backward()
+    assert (x.grad.asnumpy() == 0).all()
+    assert (y.grad.asnumpy() == 1).all()
+
+
+def test_function_port():
+    from mxnet_tpu.autograd import Function
+
+    class func(Function):
+        def forward(self, x, y):
+            m = x / y
+            n = x * y
+            self.save_for_backward(x, y)
+            return m, n
+
+        def backward(self, dm, dn):
+            x, y = self.saved_tensors
+            dx = dm / y + dn * y
+            dy = dn * x - dm * x / y / y
+            return dx, dy
+
+    mx.seed(630179191)
+    f = func()
+    x = mx.nd.random.uniform(shape=(10,))
+    x.attach_grad()
+    y = mx.nd.random.uniform(shape=(10,))
+    y.attach_grad()
+    with mx.autograd.record():
+        m, n = f(x, y)
+        mx.autograd.backward([m, n])
+    dx1, dy1 = x.grad.asnumpy(), y.grad.asnumpy()
+
+    with mx.autograd.record():
+        mx.autograd.backward([x / y, x * y])
+    onp.testing.assert_allclose(x.grad.asnumpy(), dx1, atol=1e-6)
+    onp.testing.assert_allclose(y.grad.asnumpy(), dy1, atol=1e-6)
+
+
+def test_gradient_create_graph_port():
+    x = mx.nd.ones((1,))
+    x.attach_grad()
+    with mx.autograd.record():
+        z = mx.nd.elemwise_add(mx.nd.exp(x), x)
+    (dx,) = mx.autograd.grad(z, [x], create_graph=True)
+    assert abs(dx.asnumpy().item() - 3.71828175) < 1e-6
+    dx.backward()
+    assert abs(x.grad.asnumpy().item() - 2.71828175) < 1e-6
+
+
+def test_is_train_dropout_modes_port():
+    mx.seed(0)
+    x = mx.nd.ones((10, 10))
+    x.attach_grad()
+    with mx.autograd.record(train_mode=True):
+        assert mx.autograd.is_recording()
+        assert mx.autograd.is_training()
+        y = mx.nd.Dropout(x, p=0.5)
+        yn = y.asnumpy()
+        assert yn.max() == 2 and yn.min() == 0
+        with mx.autograd.predict_mode():
+            assert mx.autograd.is_recording()
+            assert not mx.autograd.is_training()
+            y2 = mx.nd.Dropout(x, p=0.5)
+            assert (y2.asnumpy() == x.asnumpy()).all()
+
+    with mx.autograd.record(train_mode=False):
+        assert not mx.autograd.is_training()
+        y = mx.nd.Dropout(x, p=0.5)
+        assert (y.asnumpy() == x.asnumpy()).all()
+        with mx.autograd.train_mode():
+            assert mx.autograd.is_training()
+            y = mx.nd.Dropout(x, p=0.5)
+            yn = y.asnumpy()
+            assert yn.max() == 2 and yn.min() == 0
+
+    assert not mx.autograd.is_recording()
+    assert not mx.autograd.is_training()
+    y = mx.nd.Dropout(x, p=0.5)
+    assert (y.asnumpy() == x.asnumpy()).all()
+
+
+def test_reattach_grad_no_duplicate_landing():
+    # code-review r5: re-attaching must replace the retained entry
+    x = mx.nd.array([1.0, 2.0, 3.0])
+    x.attach_grad()
+    with mx.autograd.record():
+        u = x * x
+        z = u * 2
+    u.attach_grad()
+    u.attach_grad()  # re-attach: must NOT double the landed gradient
+    z.backward(mx.nd.ones((3,)))
+    onp.testing.assert_allclose(u.grad.asnumpy(), [2.0, 2.0, 2.0])
+
+
+def test_attach_grad_after_consumed_tape_is_leaf():
+    # code-review r5: producer tape freed -> attach_grad makes a leaf
+    x = mx.nd.array([1.0, 2.0])
+    x.attach_grad()
+    with mx.autograd.record():
+        u = x * x
+    u.backward()  # consumes the tape
+    u.attach_grad()
+    with mx.autograd.record():
+        z = u * 2
+    z.backward()  # must not raise 'tape already freed'
+    onp.testing.assert_allclose(u.grad.asnumpy(), [2.0, 2.0])

@@ -93,3 +93,13 @@ def test_matrix_factorization_example():
 def test_quantize_int8_example():
     out = _run("quantize_int8.py", "--iters", "120")
     assert "int8 quantization example OK" in out
+
+
+def test_ocr_ctc_example():
+    out = _run("ocr_ctc.py", "--iters", "60", timeout=900)
+    assert "OCR CTC example OK" in out
+
+
+def test_vae_example():
+    out = _run("vae.py", "--iters", "120")
+    assert "VAE example OK" in out

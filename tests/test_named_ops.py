@@ -194,9 +194,9 @@ def test_linalg_family():
     out = nd.linalg.trmm(A(tri), A(x.T @ onp.eye(3, dtype="f")).T
                          if False else A(onp.eye(3, dtype="f")), alpha=1.0)
     onp.testing.assert_allclose(out.asnumpy(), tri, rtol=1e-5)
-    # gelqf: A = L Q, Q orthonormal rows
+    # gelqf: A = L Q, Q orthonormal rows; outputs (Q, L) per la_op.cc
     amat = rng.randn(2, 4).astype("float32")
-    Lq, Q = nd.linalg.gelqf(A(amat))
+    Q, Lq = nd.linalg.gelqf(A(amat))
     onp.testing.assert_allclose(Lq.asnumpy() @ Q.asnumpy(), amat, rtol=1e-4,
                                 atol=1e-4)
     onp.testing.assert_allclose(Q.asnumpy() @ Q.asnumpy().T, onp.eye(2),

@@ -1,0 +1,290 @@
+"""Operator value-oracle tranche r5, ported from the reference's
+tests/python/unittest/test_operator.py families without a repo analog:
+ctc_loss (torch oracle), im2col/col2im, histogram, batch_take/index2d,
+gather_nd bounds, adaptive avg pool + bilinear resize (torch oracles),
+gelu, hard_sigmoid, all_finite/amp_multicast, dilated-conv impulse
+response, grad accumulation on duplicate inputs."""
+import numpy as onp
+
+import pytest
+
+import mxnet_tpu as mx
+
+
+def test_ctc_loss_torch_oracle():  # reference: test_operator.py test_ctc_loss
+    import torch
+
+    rs = onp.random.RandomState(0)
+    T, N, C = 10, 3, 5  # time, batch, classes (0 = blank, reference conv)
+    acts = rs.randn(T, N, C).astype("float32")
+    labels = onp.array([[1, 2, 3, 0], [2, 4, 0, 0], [1, 1, 2, 0]],
+                       dtype="float32")  # 0-padded, blank=0
+
+    out = mx.nd.ctc_loss(mx.nd.array(acts), mx.nd.array(labels))
+
+    log_probs = torch.log_softmax(torch.tensor(acts), dim=-1)
+    tgt = [[1, 2, 3], [2, 4], [1, 1, 2]]
+    tlens = torch.tensor([len(t) for t in tgt])
+    flat = torch.tensor([x for t in tgt for x in t])
+    ref = torch.nn.functional.ctc_loss(
+        log_probs, flat, torch.full((N,), T), tlens,
+        blank=0, reduction="none", zero_infinity=False)
+    onp.testing.assert_allclose(out.asnumpy(), ref.numpy(), rtol=1e-4,
+                                atol=1e-4)
+
+
+def test_im2col_col2im_roundtrip():  # reference: test_im2col_col2im
+    rs = onp.random.RandomState(1)
+    x = rs.randn(2, 3, 8, 8).astype("float32")
+    cols = mx.nd.im2col(mx.nd.array(x), kernel=(3, 3), stride=(1, 1),
+                        pad=(1, 1))
+    # each output spatial site contributes k*k patches
+    assert cols.shape == (2, 3 * 9, 64)
+    back = mx.nd.col2im(cols, output_size=(8, 8), kernel=(3, 3),
+                        stride=(1, 1), pad=(1, 1))
+    # col2im sums overlapping patches: interior pixels counted 9x,
+    # matching conv_transpose(ones) weighting
+    ones = mx.nd.im2col(mx.nd.ones((2, 3, 8, 8)), kernel=(3, 3),
+                        stride=(1, 1), pad=(1, 1))
+    weight = mx.nd.col2im(ones, output_size=(8, 8), kernel=(3, 3),
+                          stride=(1, 1), pad=(1, 1))
+    onp.testing.assert_allclose(back.asnumpy(),
+                                x * weight.asnumpy(), rtol=1e-4)
+
+
+def test_histogram_port():  # reference: test_histogram
+    rs = onp.random.RandomState(2)
+    x = rs.uniform(0, 10, size=1000).astype("float32")
+    cnt, bins = mx.nd.histogram(mx.nd.array(x), bin_cnt=10,
+                                range=(0.0, 10.0))
+    ref_cnt, ref_bins = onp.histogram(x, bins=10, range=(0.0, 10.0))
+    onp.testing.assert_array_equal(cnt.asnumpy(), ref_cnt)
+    onp.testing.assert_allclose(bins.asnumpy(), ref_bins, rtol=1e-6)
+
+
+def test_batch_take_index2d_port():  # reference: test_index2d
+    rs = onp.random.RandomState(3)
+    for _ in range(5):
+        data = rs.rand(6, 7).astype("float32")
+        idx = rs.randint(0, 7, size=6).astype("int32")
+        out = mx.nd.batch_take(mx.nd.array(data), mx.nd.array(idx))
+        onp.testing.assert_allclose(
+            out.asnumpy(), data[onp.arange(6), idx])
+
+
+def test_gather_nd_and_scatter_nd_port():
+    data = mx.nd.array(onp.arange(24).reshape(2, 3, 4).astype("f"))
+    indices = mx.nd.array([[0, 1, 1], [1, 2, 0]], dtype="int32")
+    out = mx.nd.gather_nd(data, indices)
+    # reference convention (indexing_op.h): indices (M, N) — M leading
+    # dims indexed, N result entries; here M=2, N=3
+    np_data = onp.arange(24).reshape(2, 3, 4)
+    onp.testing.assert_allclose(
+        out.asnumpy(), [np_data[0, 1], np_data[1, 2], np_data[1, 0]])
+
+
+def test_adaptive_avg_pool_torch_oracle():
+    import torch
+
+    rs = onp.random.RandomState(4)
+    x = rs.randn(2, 3, 9, 9).astype("float32")
+    for out_sz in [1, 3, 5]:
+        got = mx.nd.contrib.AdaptiveAvgPooling2D(
+            mx.nd.array(x), output_size=out_sz)
+        ref = torch.nn.functional.adaptive_avg_pool2d(
+            torch.tensor(x), out_sz).numpy()
+        onp.testing.assert_allclose(got.asnumpy(), ref, rtol=1e-4,
+                                    atol=1e-5)
+
+
+def test_bilinear_resize_torch_oracle():
+    import torch
+
+    rs = onp.random.RandomState(5)
+    x = rs.randn(2, 3, 6, 6).astype("float32")
+    got = mx.nd.contrib.BilinearResize2D(mx.nd.array(x), height=12,
+                                         width=12)
+    ref = torch.nn.functional.interpolate(
+        torch.tensor(x), size=(12, 12), mode="bilinear",
+        align_corners=True).numpy()
+    onp.testing.assert_allclose(got.asnumpy(), ref, rtol=1e-4, atol=1e-5)
+
+
+def test_gelu_leakyrelu_port():  # reference: test_gelu
+    import torch
+
+    rs = onp.random.RandomState(6)
+    x = rs.randn(4, 5).astype("float32")
+    got = mx.nd.LeakyReLU(mx.nd.array(x), act_type="gelu")
+    ref = torch.nn.functional.gelu(torch.tensor(x))  # erf form
+    onp.testing.assert_allclose(got.asnumpy(), ref.numpy(), rtol=1e-3,
+                                atol=1e-4)
+
+
+def test_hard_sigmoid_port():  # reference: test_hard_sigmoid
+    x = onp.array([-4.0, -1.0, 0.0, 1.0, 4.0], dtype="float32")
+    got = mx.nd.hard_sigmoid(mx.nd.array(x))
+    ref = onp.clip(0.2 * x + 0.5, 0, 1)
+    onp.testing.assert_allclose(got.asnumpy(), ref, rtol=1e-6)
+
+
+def test_all_finite_port():  # reference: test_all_finite
+    assert int(mx.nd.all_finite(
+        mx.nd.array([1.0, 2.0])).asnumpy()) == 1
+    assert int(mx.nd.all_finite(
+        mx.nd.array([1.0, onp.nan])).asnumpy()) == 0
+    assert int(mx.nd.all_finite(
+        mx.nd.array([onp.inf, 2.0])).asnumpy()) == 0
+    outs = mx.nd.multi_all_finite(mx.nd.array([1.0]),
+                                  mx.nd.array([onp.inf]))
+    assert int((outs if not isinstance(outs, (list, tuple))
+                else outs[0]).asnumpy()) == 0
+
+
+def test_amp_multicast_port():  # reference: test_amp_multicast
+    a = mx.nd.ones((2,), dtype="float16")
+    b = mx.nd.ones((2,), dtype="float32")
+    outs = mx.nd.amp_multicast(a, b, num_outputs=2)
+    # widest type wins: both come back float32
+    assert all(str(o.dtype) == "float32" for o in outs)
+
+
+def test_convolution_dilated_impulse_response():
+    # reference: test_convolution_dilated_impulse_response — a unit
+    # impulse through a dilated conv lands taps exactly `dilate` apart
+    x = onp.zeros((1, 1, 9, 9), dtype="float32")
+    x[0, 0, 4, 4] = 1.0
+    w = onp.ones((1, 1, 3, 3), dtype="float32")
+    out = mx.nd.Convolution(mx.nd.array(x), mx.nd.array(w),
+                            kernel=(3, 3), num_filter=1, dilate=(2, 2),
+                            pad=(2, 2), no_bias=True)
+    got = out.asnumpy()[0, 0]
+    expect = onp.zeros((9, 9), dtype="float32")
+    for dy in (-2, 0, 2):
+        for dx in (-2, 0, 2):
+            expect[4 + dy, 4 + dx] = 1.0
+    onp.testing.assert_allclose(got, expect)
+
+
+def test_depthwise_convolution_torch_oracle():
+    import torch
+
+    rs = onp.random.RandomState(7)
+    x = rs.randn(2, 4, 8, 8).astype("float32")
+    w = rs.randn(4, 1, 3, 3).astype("float32")
+    out = mx.nd.Convolution(mx.nd.array(x), mx.nd.array(w), kernel=(3, 3),
+                            num_filter=4, num_group=4, no_bias=True)
+    ref = torch.nn.functional.conv2d(
+        torch.tensor(x), torch.tensor(w), groups=4).numpy()
+    onp.testing.assert_allclose(out.asnumpy(), ref, rtol=1e-4, atol=1e-4)
+
+
+def test_binary_op_duplicate_input_grad():
+    # reference: test_binary_op_duplicate_input — d(x*x)/dx = 2x with the
+    # SAME NDArray as both operands
+    data = mx.nd.array(onp.random.rand(3, 4).astype("f"))
+    data.attach_grad()
+    with mx.autograd.record():
+        out = data * data
+    out.backward()
+    onp.testing.assert_allclose(data.grad.asnumpy(),
+                                2 * data.asnumpy(), rtol=1e-5)
+
+
+def test_elemwise_sum_gradient_accumulation():
+    # reference: test_elemwise_sum_for_gradient_accumulation
+    for nrepeat in range(1, 5):
+        stored = mx.nd.zeros((1,))
+        stored.attach_grad(grad_req="add")
+        with mx.autograd.record():
+            for _ in range(nrepeat):
+                (stored * 2).backward()
+        assert float(stored.grad.asnumpy()) == 2 * nrepeat
+
+
+def test_blockgrad_port():  # reference: test_blockgrad
+    x = mx.nd.array(onp.random.rand(2, 3).astype("f"))
+    x.attach_grad()
+    with mx.autograd.record():
+        y = mx.nd.BlockGrad(x) * 2 + x
+        y.backward()
+    # gradient flows only through the un-blocked path
+    onp.testing.assert_allclose(x.grad.asnumpy(), onp.ones((2, 3)))
+
+
+class TestLaopIdentities:
+    """reference test_operator.py test_laop/_2/_3 — mathematical-identity
+    oracles over the linalg_* la_op family."""
+
+    def _spd(self, rs, n=4):
+        a = rs.randn(n, n).astype("float32")
+        return a @ a.T + n * onp.eye(n, dtype="float32")
+
+    def test_potrf_potri(self):
+        rs = onp.random.RandomState(8)
+        A = self._spd(rs)
+        L = mx.nd.linalg_potrf(mx.nd.array(A))
+        onp.testing.assert_allclose(L.asnumpy() @ L.asnumpy().T, A,
+                                    rtol=1e-4, atol=1e-4)
+        Ainv = mx.nd.linalg_potri(L)
+        onp.testing.assert_allclose(Ainv.asnumpy() @ A,
+                                    onp.eye(4), rtol=1e-3, atol=1e-3)
+
+    def test_trmm_trsm_inverse_pair(self):
+        rs = onp.random.RandomState(9)
+        L = onp.tril(rs.rand(4, 4).astype("float32") + 1.0)
+        B = rs.randn(4, 3).astype("float32")
+        # trsm solves L X = alpha B; trmm applies L: round trip = alpha B
+        X = mx.nd.linalg_trsm(mx.nd.array(L), mx.nd.array(B), alpha=2.0)
+        back = mx.nd.linalg_trmm(mx.nd.array(L), X)
+        onp.testing.assert_allclose(back.asnumpy(), 2.0 * B, rtol=1e-4,
+                                    atol=1e-4)
+
+    def test_gemm_alpha_beta(self):
+        rs = onp.random.RandomState(10)
+        A = rs.randn(3, 4).astype("float32")
+        B = rs.randn(4, 5).astype("float32")
+        C = rs.randn(3, 5).astype("float32")
+        out = mx.nd.linalg_gemm(mx.nd.array(A), mx.nd.array(B),
+                                mx.nd.array(C), alpha=2.0, beta=3.0)
+        onp.testing.assert_allclose(out.asnumpy(), 2 * A @ B + 3 * C,
+                                    rtol=1e-4, atol=1e-4)
+        out2 = mx.nd.linalg_gemm2(mx.nd.array(A), mx.nd.array(B),
+                                  alpha=0.5)
+        onp.testing.assert_allclose(out2.asnumpy(), 0.5 * A @ B,
+                                    rtol=1e-4, atol=1e-4)
+
+    def test_syrk(self):
+        rs = onp.random.RandomState(11)
+        A = rs.randn(3, 5).astype("float32")
+        out = mx.nd.linalg_syrk(mx.nd.array(A), alpha=1.5)
+        onp.testing.assert_allclose(out.asnumpy(), 1.5 * A @ A.T,
+                                    rtol=1e-4, atol=1e-4)
+        outT = mx.nd.linalg_syrk(mx.nd.array(A), transpose=True)
+        onp.testing.assert_allclose(outT.asnumpy(), A.T @ A, rtol=1e-4,
+                                    atol=1e-4)
+
+    def test_gelqf_orthogonal(self):
+        rs = onp.random.RandomState(12)
+        A = rs.randn(3, 5).astype("float32")
+        q, l = mx.nd.linalg_gelqf(mx.nd.array(A))  # (Q, L) order
+        onp.testing.assert_allclose(q.asnumpy() @ q.asnumpy().T,
+                                    onp.eye(3), rtol=1e-3, atol=1e-4)
+        onp.testing.assert_allclose(l.asnumpy() @ q.asnumpy(), A,
+                                    rtol=1e-3, atol=1e-4)
+        assert onp.allclose(onp.triu(l.asnumpy(), 1), 0)
+
+    def test_sumlogdiag(self):
+        rs = onp.random.RandomState(13)
+        A = self._spd(rs)
+        L = mx.nd.linalg_potrf(mx.nd.array(A))
+        got = float(mx.nd.linalg_sumlogdiag(L).asnumpy())
+        # 2 * sumlogdiag(chol(A)) = logdet(A)
+        assert abs(2 * got - onp.linalg.slogdet(A)[1]) < 1e-3
+
+    def test_maketrian_extracttrian_roundtrip(self):
+        rs = onp.random.RandomState(14)
+        A = onp.tril(rs.rand(4, 4).astype("float32"))
+        vec = mx.nd.linalg_extracttrian(mx.nd.array(A))
+        back = mx.nd.linalg_maketrian(vec)
+        onp.testing.assert_allclose(back.asnumpy(), A, rtol=1e-6)
