@@ -396,7 +396,7 @@ def main():
                 "13340 infer, BERT 261 samples/s — r3 round start, before "
                 "the custom-VJP norms) and BENCH_r01.json (2507.6 img/s "
                 "NCHW). The r3/r4 perf work is staged but unmeasured; "
-                "docs/perf_audit_r4.md has the revival checklist")
+                "docs/perf_audit_r5.md has the falsifiable A/B predictions and tools/evidence_bundle.sh captures everything in one command")
         pool_ip = os.environ.get("PALLAS_AXON_POOL_IPS", "").split(",")[0]
         if pool_ip:
             import socket

@@ -31,7 +31,7 @@ _INT_SAMPLERS = ["randint", "poisson", "geometric", "binomial"]
 _applied = False
 
 
-def _wrap(fn, default_dtype):
+def _wrap(fn, kind):
     params = inspect.signature(fn).parameters
     if "dtype" not in params:
         return fn
@@ -40,7 +40,13 @@ def _wrap(fn, default_dtype):
     @functools.wraps(fn)
     def wrapped(*args, **kwargs):
         if "dtype" not in kwargs and len(args) <= dtype_pos:
-            kwargs["dtype"] = default_dtype
+            # consult the mode lazily: npx.set_np(dtype=True) switches
+            # the creation defaults to official-numpy 64-bit
+            from .numpy_extension import default_float_dtype, \
+                default_int_dtype
+
+            kwargs["dtype"] = (default_float_dtype() if kind == "float"
+                               else default_int_dtype())
         return fn(*args, **kwargs)
 
     wrapped.__wrapped_32bit_default__ = True
@@ -56,9 +62,9 @@ def install():
         fn = getattr(jax.random, name, None)
         if fn is not None and not getattr(fn, "__wrapped_32bit_default__",
                                           False):
-            setattr(jax.random, name, _wrap(fn, jnp.float32))
+            setattr(jax.random, name, _wrap(fn, "float"))
     for name in _INT_SAMPLERS:
         fn = getattr(jax.random, name, None)
         if fn is not None and not getattr(fn, "__wrapped_32bit_default__",
                                           False):
-            setattr(jax.random, name, _wrap(fn, jnp.int32))
+            setattr(jax.random, name, _wrap(fn, "int"))

@@ -458,9 +458,17 @@ class NDArray:
         )
 
     def take(self, indices, axis=None, mode="clip"):
-        return apply_op(
-            lambda x, i: jnp.take(x, i, axis=axis, mode=mode), self, indices
-        )
+        # float indices cast (both reference classes tolerate them —
+        # legacy arrays default to float32, indexing_op.h casts);
+        # python ints/lists pass through jnp.asarray first
+        def pure(x, i):
+            i = jnp.asarray(i)
+            if not (jnp.issubdtype(i.dtype, jnp.integer)
+                    or i.dtype == jnp.bool_):
+                i = i.astype(jnp.int32)
+            return jnp.take(x, i, axis=axis, mode=mode)
+
+        return apply_op(pure, self, indices)
 
     def clip(self, a_min=None, a_max=None):
         return apply_op(lambda x: jnp.clip(x, a_min, a_max), self)
@@ -555,11 +563,23 @@ class NDArray:
     # ------------------------------------------------------------------
     # indexing
     # ------------------------------------------------------------------
+    @staticmethod
+    def _int_key(k):
+        """Float index arrays cast to int32 here, ONCE for every indexing
+        consumer (reference indexing_op.h casts; legacy index arrays
+        default to float32). Bool masks pass through."""
+        if hasattr(k, "dtype") and not (
+                _np.issubdtype(k.dtype, _np.integer)
+                or k.dtype == bool or str(k.dtype) == "bool"):
+            return k.astype(jnp.int32)
+        return k
+
     def _index(self, key):
         if isinstance(key, NDArray):
-            return key._data
+            return self._int_key(key._data)
         if isinstance(key, tuple):
-            return tuple(k._data if isinstance(k, NDArray) else k for k in key)
+            return tuple(self._int_key(k._data) if isinstance(k, NDArray)
+                         else k for k in key)
         if isinstance(key, list):
             # numpy/reference semantics: a[[0, 2, 3]] is fancy indexing;
             # jnp rejects raw list indices
@@ -813,11 +833,21 @@ def array(source, dtype=None, device=None, ctx=None):
         if dtype is not None and data.dtype != dtype:
             data = data.astype(dtype)
         return NDArray(jax.device_put(data, device.jax_device), device)
+    from_numpy = isinstance(source, _np.ndarray)
     arr = _np.asarray(source)
-    if dtype is None and arr.dtype == _np.float64:
-        dtype = _np.dtype(_np.float32)  # reference default dtype is float32
-    elif dtype is None and arr.dtype == _np.int64:
-        dtype = _np.dtype(_np.int32)  # 32-bit creation default (x64 on)
+    if dtype is None:
+        if not from_numpy and arr.dtype.kind in "iuf":
+            # python lists/scalars default to the float dtype (reference:
+            # ndarray.py array — 'float32 otherwise'; f64 under
+            # npx.set_np(dtype=True), test_numpy_default_dtype.py).
+            # bool/complex inputs keep their kind.
+            from ..numpy_extension import default_float_dtype
+
+            dtype = _np.dtype(default_float_dtype())
+        elif arr.dtype == _np.float64:
+            dtype = _np.dtype(_np.float32)  # documented 32-bit default
+        elif arr.dtype == _np.int64:
+            dtype = _np.dtype(_np.int32)  # 32-bit creation default
     if dtype is not None:
         arr = arr.astype(dtype)
     return NDArray(jax.device_put(arr, device.jax_device), device)

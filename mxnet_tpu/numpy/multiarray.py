@@ -376,8 +376,12 @@ def frombuffer(buffer, dtype=float, **kwargs):
 def _creation(jnp_fn):
     def fn(shape, dtype=None, order="C", **kwargs):  # noqa: ARG001
         dev = _device_of(kwargs)
-        dtype = normalize_dtype(dtype) or _np.float32
-        data = jax.device_put(jnp_fn(shape, dtype), dev.jax_device)
+        if dtype is None:
+            from ..numpy_extension import default_float_dtype
+
+            dtype = default_float_dtype()
+        data = jax.device_put(jnp_fn(shape, normalize_dtype(dtype)),
+                              dev.jax_device)
         return NDArray(data, dev)
 
     return fn
@@ -564,3 +568,39 @@ __all__ += ["NAN", "NaN", "NINF", "PINF", "NZERO", "PZERO", "round_",
             "row_stack", "bool", "blackman", "hamming", "hanning",
             "from_dlpack", "genfromtxt", "set_printoptions", "concat",
             "diag_indices_from", "tril_indices_from", "triu_indices_from"]
+
+
+# --- creation default-dtype policy (reference:
+# tests/python/unittest/test_numpy_default_dtype.py) ------------------------
+# Float-creation functions answer float32 by default and float64 under
+# npx.set_np(dtype=True); x64 being enabled would otherwise leak jnp's
+# float64 defaults through the dtype-less spellings.
+def _float_default_wrap(fn):
+    import functools
+    import inspect
+
+    try:
+        params = list(inspect.signature(fn).parameters)
+        dtype_pos = params.index("dtype") if "dtype" in params else None
+    except (TypeError, ValueError):
+        dtype_pos = None
+
+    @functools.wraps(fn)
+    def wrapped(*args, **kwargs):
+        # only inject when dtype arrived neither as kwarg nor positionally
+        # (np.tri(3, 3, 0, 'int32') is legal numpy spelling)
+        if "dtype" not in kwargs and (dtype_pos is None
+                                      or len(args) <= dtype_pos):
+            from ..numpy_extension import default_float_dtype
+
+            kwargs["dtype"] = default_float_dtype()
+        return fn(*args, **kwargs)
+
+    return wrapped
+
+
+for _name in ("eye", "identity", "linspace", "logspace", "geomspace",
+              "tri", "hanning", "hamming", "blackman"):
+    if _name in _g:
+        _g[_name] = _float_default_wrap(_g[_name])
+del _name

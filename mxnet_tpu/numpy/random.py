@@ -33,7 +33,11 @@ def _shape(size):
 
 def _f32(dtype):
     d = normalize_dtype(dtype)
-    return _np.dtype(_np.float32) if d is None else d
+    if d is None:
+        from ..numpy_extension import default_float_dtype
+
+        return _np.dtype(default_float_dtype())
+    return d
 
 
 def _unwrap(x):

@@ -163,6 +163,21 @@ def is_np_default_dtype():
     return _np_default_dtype
 
 
+def default_float_dtype():
+    """THE creation-default float dtype (one definition — every creation
+    path consults this): float64 under npx.set_np(dtype=True), float32
+    otherwise."""
+    import numpy as _np
+
+    return _np.float64 if _np_default_dtype else _np.float32
+
+
+def default_int_dtype():
+    import numpy as _np
+
+    return _np.int64 if _np_default_dtype else _np.int32
+
+
 def use_np(func):
     """Decorator parity with npx.use_np — identity here."""
     return func
@@ -531,7 +546,10 @@ def index_update(data, indices, val):
 
     def pure(x, idx, v):
         idx = _jnp.asarray(idx)
-        if idx.ndim == 2:  # coordinate rows
+        if not (_jnp.issubdtype(idx.dtype, _jnp.integer)
+                or idx.dtype == _jnp.bool_):  # bool masks pass through
+            idx = idx.astype(_jnp.int32)  # f32 default-dtype indices
+        if idx.ndim == 2 and idx.dtype != _jnp.bool_:  # coordinate rows
             return x.at[tuple(idx.T)].set(v)
         return x.at[idx].set(v)
 
@@ -544,7 +562,10 @@ def index_add(data, indices, val):
 
     def pure(x, idx, v):
         idx = _jnp.asarray(idx)
-        if idx.ndim == 2:
+        if not (_jnp.issubdtype(idx.dtype, _jnp.integer)
+                or idx.dtype == _jnp.bool_):  # bool masks pass through
+            idx = idx.astype(_jnp.int32)  # f32 default-dtype indices
+        if idx.ndim == 2 and idx.dtype != _jnp.bool_:
             return x.at[tuple(idx.T)].add(v)
         return x.at[idx].add(v)
 

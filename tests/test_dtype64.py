@@ -147,8 +147,12 @@ def test_creation_32bit_defaults_more():
     assert str(mx.np.full((2, 2), 3.14).dtype) == "float32"
     assert str(mx.np.full((2, 2), 7).dtype) == "int32"
     assert str(mx.np.full((2, 2), 3.14, dtype="float64").dtype) == "float64"
-    assert str(mx.nd.array([0, 1, 2]).dtype) == "int32"
+    # python int lists default to FLOAT32 (reference ndarray.py array:
+    # 'float32 otherwise'; test_numpy_default_dtype.py pins it)
+    assert str(mx.nd.array([0, 1, 2]).dtype) == "float32"
+    assert str(mx.np.array([1, 2, 3]).dtype) == "float32"
     assert str(mx.nd.array([0, 1, 2], dtype="int64").dtype) == "int64"
+    assert str(mx.nd.array([0, 1, 2], dtype="int32").dtype) == "int32"
     import numpy as onp
 
     # explicit 64-bit numpy input + explicit dtype keeps 64-bit
@@ -163,3 +167,67 @@ def test_creation_32bit_defaults_more():
     x = mx.nd.zeros((1, 3, 8, 8))
     anchors = mx.nd.contrib.MultiBoxPrior(x, sizes=[0.5], ratios=[1.0])
     assert str(anchors.dtype) == "float32"
+
+
+def test_np_default_dtype_mode_port():
+    # reference: tests/python/unittest/test_numpy_default_dtype.py —
+    # deep-np default f32, np-default mode f64, for the creation corpus
+    from mxnet_tpu import npx
+
+    fns = {
+        "array": lambda: mx.np.array([1, 2, 3]),
+        "ones": lambda: mx.np.ones((5,)),
+        "zeros": lambda: mx.np.zeros(5),
+        "eye": lambda: mx.np.eye(3),
+        "identity": lambda: mx.np.identity(3),
+        "linspace": lambda: mx.np.linspace(0, 1, 5),
+        "logspace": lambda: mx.np.logspace(0, 1, 5),
+        "hanning": lambda: mx.np.hanning(5),
+        "hamming": lambda: mx.np.hamming(5),
+        "blackman": lambda: mx.np.blackman(5),
+        "random.uniform": lambda: mx.np.random.uniform(size=(3,)),
+        "random.normal": lambda: mx.np.random.normal(size=(3,)),
+        "random.gamma": lambda: mx.np.random.gamma(1.0, 1.0, size=(3,)),
+        "mean": lambda: mx.np.mean(mx.np.ones((3,))),
+        "true_divide": lambda: mx.np.true_divide(
+            mx.np.array([1, 2]), mx.np.array([2, 2])),
+    }
+    for name, fn in fns.items():
+        assert str(fn().dtype) == "float32", (name, fn().dtype)
+    npx.set_np(dtype=True)
+    try:
+        for name in ("array", "ones", "zeros", "eye", "identity",
+                     "linspace", "logspace", "hanning",
+                     "random.uniform", "random.normal", "random.gamma"):
+            assert str(fns[name]().dtype) == "float64", name
+        # indices is int64 in BOTH modes (reference)
+        assert str(mx.np.indices((3,)).dtype) == "int64"
+        assert str(mx.np.arange(3, 7, 2).dtype) == "int64"
+    finally:
+        npx.reset_np()
+    assert str(mx.np.indices((3,)).dtype) == "int64"
+    assert str(mx.np.arange(3, 7, 2).dtype) == "float32"
+
+
+def test_float_index_arrays_work_everywhere():
+    # code-review r5: default-created (float32) index arrays must index
+    # like the reference (indexing_op.h casts); bool masks unaffected
+    x = mx.nd.array([[1.0, 2.0], [3.0, 4.0]])
+    idx = mx.nd.array([0, 1])  # float32 now
+    assert x[idx].shape == (2, 2)
+    x[idx] = 0.0
+    assert float(x.asnumpy().sum()) == 0.0
+    # method keeps numpy semantics: axis=None flattens (crash-free is
+    # the contract here — lists/ints must not hit the dtype guard)
+    assert x.take([0, 1]).shape == (2,)
+    assert x.take(1).shape == ()
+    assert x.take([0, 1], axis=0).shape == (2, 2)
+    mask = mx.np.array([True, False, True])
+    got = mx.npx.index_update(mx.np.array([1.0, 2.0, 3.0]), mask, 9.0)
+    assert got.asnumpy().tolist() == [9.0, 2.0, 9.0]
+
+
+def test_tri_positional_dtype():
+    # np.tri(3, 3, 0, 'int32') is legal numpy spelling
+    assert str(mx.np.tri(3, 3, 0, "int32").dtype) == "int32"
+    assert str(mx.np.tri(3).dtype) == "float32"
