@@ -32,32 +32,74 @@ class RecurrentCell(HybridBlock):
         return [mnp.zeros(info["shape"])
                 for info in self.state_info(batch_size)]
 
+    @staticmethod
+    def _format_sequence(length, inputs, layout, merge_outputs):
+        """Normalize unroll inputs (reference rnn_cell.py
+        _format_sequence): accepts one merged tensor or a list of
+        per-step tensors; merge_outputs=None mirrors the input format.
+        Returns (merged_tensor, resolved_merge_outputs, batch, axis)."""
+        axis = 1 if layout == "NTC" else 0
+        if isinstance(inputs, (list, tuple)):
+            if len(inputs) != length:
+                raise ValueError(
+                    f"unroll length {length} != len(inputs) {len(inputs)}")
+            if merge_outputs is None:
+                merge_outputs = False
+            inputs = mnp.stack(list(inputs), axis=axis)
+        else:
+            if inputs.shape[axis] != length:
+                raise ValueError(
+                    f"unroll length {length} != inputs time dim "
+                    f"{inputs.shape[axis]} (reference _format_sequence "
+                    "asserts the same)")
+            if merge_outputs is None:
+                merge_outputs = True
+        batch = inputs.shape[0 if layout == "NTC" else 1]
+        return inputs, merge_outputs, batch, axis
+
+    @staticmethod
+    def _unmerge(outputs, length, axis):
+        return [outputs[:, t] if axis == 1 else outputs[t]
+                for t in range(length)]
+
     def unroll(self, length, inputs, begin_state=None, layout="NTC",
-               merge_outputs=None, valid_length=None):  # noqa: ARG002
-        """Python unroll over time steps (reference: RecurrentCell.unroll).
+               merge_outputs=None, valid_length=None):
+        """Python unroll over time steps (reference: RecurrentCell.unroll
+        + _format_sequence: inputs may be one merged tensor OR a list of
+        per-step (N, C) tensors; merge_outputs=None mirrors the input
+        format. valid_length masks outputs past each row's length and
+        freezes the carried states there (reference uses SequenceMask +
+        masked state updates).
 
         Under hybridize the whole unroll is traced into one XLA program —
         the compiler pipelines the steps (no python overhead at run time).
         """
         self.reset()
-        axis = 1 if layout == "NTC" else 0
-        if inputs.shape[axis] != length:
-            raise ValueError(
-                f"unroll length {length} != inputs time dim "
-                f"{inputs.shape[axis]} (reference _format_sequence "
-                "asserts the same)")
-        batch = inputs.shape[0 if layout == "NTC" else 1]
+        inputs, merge_outputs, batch, axis = self._format_sequence(
+            length, inputs, layout, merge_outputs)
         states = begin_state if begin_state is not None \
             else self.begin_state(batch)
         outputs = []
         for t in range(length):
             x_t = inputs[:, t] if axis == 1 else inputs[t]
-            out, states = self(x_t, states)
+            out, new_states = self(x_t, states)
+            if valid_length is not None:
+                alive = (valid_length > t)
+                m_out = alive.reshape(
+                    (-1,) + (1,) * (out.ndim - 1)).astype(out.dtype)
+                out = out * m_out
+                frozen = []
+                for ns, s in zip(new_states, states):
+                    m = alive.reshape(
+                        (-1,) + (1,) * (ns.ndim - 1)).astype(ns.dtype)
+                    frozen.append(ns * m + s * (1 - m))
+                states = frozen
+            else:
+                states = new_states
             outputs.append(out)
-        if merge_outputs is False:
+        if not merge_outputs:
             return outputs, states
-        stacked = mnp.stack(outputs, axis=axis)
-        return stacked, states
+        return mnp.stack(outputs, axis=axis), states
 
 
 class RNNCell(RecurrentCell):
@@ -221,7 +263,8 @@ class SequentialRNNCell(RecurrentCell):
         child consumes the previous child's full output sequence — so
         un-steppable children (BidirectionalCell) work inside a stack."""
         self.reset()
-        batch = inputs.shape[0 if layout == "NTC" else 1]
+        inputs, merge_outputs, batch, axis = self._format_sequence(
+            length, inputs, layout, merge_outputs)
         states = begin_state if begin_state is not None \
             else self.begin_state(batch)
         p = 0
@@ -234,11 +277,8 @@ class SequentialRNNCell(RecurrentCell):
                 valid_length=valid_length)
             p += n
             next_states.extend(new)
-        if merge_outputs is False:
-            axis = 1 if layout == "NTC" else 0
-            outs = [inputs[:, t] if axis == 1 else inputs[t]
-                    for t in range(length)]
-            return outs, next_states
+        if not merge_outputs:
+            return self._unmerge(inputs, length, axis), next_states
         return inputs, next_states
 
 
@@ -380,13 +420,8 @@ class BidirectionalCell(RecurrentCell):
             raise NotImplementedError(
                 "valid_length is not supported by BidirectionalCell yet")
         self.reset()
-        axis = 1 if layout == "NTC" else 0
-        if inputs.shape[axis] != length:
-            raise ValueError(
-                f"unroll length {length} != inputs time dim "
-                f"{inputs.shape[axis]} — the flipped backward window "
-                "would silently misalign")
-        batch = inputs.shape[0 if layout == "NTC" else 1]
+        inputs, merge_outputs, batch, axis = self._format_sequence(
+            length, inputs, layout, merge_outputs)
         states = begin_state if begin_state is not None \
             else self.begin_state(batch)
         n_l = len(self.l_cell.state_info(batch))
@@ -396,10 +431,8 @@ class BidirectionalCell(RecurrentCell):
         r_out, r_states = self.r_cell.unroll(
             length, rev, begin_state=states[n_l:], layout=layout)
         out = mnp.concatenate([l_out, mnp.flip(r_out, axis=axis)], axis=-1)
-        if merge_outputs is False:
-            outs = [out[:, t] if axis == 1 else out[t]
-                    for t in range(length)]
-            return outs, l_states + r_states
+        if not merge_outputs:
+            return self._unmerge(out, length, axis), l_states + r_states
         return out, l_states + r_states
 
     def __repr__(self):

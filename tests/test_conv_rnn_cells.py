@@ -108,3 +108,109 @@ def test_conv_cell_channels_last_layout():
     out, states = cell(x, cell.begin_state(2))
     assert out.shape == (2, 5, 5, 4)
     assert states[1].shape == (2, 5, 5, 4)
+
+
+# --- r5 tranche: reference test_gluon_rnn.py structural cells -----------
+
+def test_residual_cell_port():
+    from mxnet_tpu import gluon
+
+    cell = gluon.rnn.ResidualCell(gluon.rnn.GRUCell(50))
+    inputs = [mx.np.ones((10, 50)) for _ in range(2)]
+    cell.initialize()
+    outputs, _ = cell.unroll(2, inputs)
+    assert [o.shape for o in outputs] == [(10, 50), (10, 50)]
+    # residual: out = base(out) + input — with zeroed base weights the
+    # output equals the input
+    for p in cell.collect_params().values():
+        p.set_data(mx.np.zeros(p.shape))
+    outputs, _ = cell.unroll(2, inputs)
+    onp.testing.assert_allclose(outputs[0].asnumpy(),
+                                inputs[0].asnumpy(), atol=1e-6)
+
+
+def test_bidirectional_cell_port():
+    from mxnet_tpu import gluon
+
+    cell = gluon.rnn.BidirectionalCell(gluon.rnn.LSTMCell(100),
+                                       gluon.rnn.LSTMCell(100))
+    inputs = [mx.np.ones((10, 50)) for _ in range(3)]
+    cell.initialize()
+    outputs, _ = cell.unroll(3, inputs)
+    assert [o.shape for o in outputs] == [(10, 200)] * 3
+
+
+def test_sequential_rnn_cells_port():
+    from mxnet_tpu import autograd, gluon
+
+    net = gluon.rnn.SequentialRNNCell()
+    net.add(gluon.rnn.LSTMCell(10, input_size=5))
+    net.add(gluon.rnn.RNNCell(10, input_size=10))
+    net.add(gluon.rnn.GRUCell(10, input_size=10))
+    net.initialize()
+    x = mx.np.random.uniform(size=(4, 3, 5))
+    for p in net.collect_params().values():
+        p.grad_req = "write"
+    with autograd.record():
+        outs, _ = net.unroll(3, x, layout="NTC", merge_outputs=True)
+        loss = outs.sum()
+    loss.backward()
+    assert outs.shape == (4, 3, 10)
+    g = net.collect_params()
+    assert any(float(abs(p.grad()).sum()) > 0 for p in g.values())
+
+
+def test_unroll_layout_port():
+    from mxnet_tpu import gluon
+
+    cell = gluon.rnn.HybridSequentialRNNCell()
+    for i in range(3):
+        if i == 1:
+            cell.add(gluon.rnn.ResidualCell(gluon.rnn.LSTMCell(100)))
+        else:
+            cell.add(gluon.rnn.LSTMCell(100))
+    inputs = [mx.np.random.uniform(size=(10, 50)) for _ in range(3)]
+    cell.initialize()
+    for layout in ("TNC", "NTC"):
+        outputs, _ = cell.unroll(3, inputs, layout=layout)
+        assert all(o.shape == (10, 100) for o in outputs)
+
+
+def test_unroll_valid_length_port():
+    # reference test_rnn_unroll_variant_length (imperative core): states
+    # freeze past each row's valid_length and outputs zero there... the
+    # reference contract is outputs are MASKED to zero past valid_length
+    from mxnet_tpu import gluon
+
+    cell = gluon.rnn.LSTMCell(20)
+    cell.initialize()
+    data = mx.np.random.normal(0, 1, size=(4, 10, 20))
+    vl = mx.np.array([3.0, 10.0, 5.0, 6.0])
+    outs, states = cell.unroll(10, data, layout="NTC",
+                               merge_outputs=True, valid_length=vl)
+    o = outs.asnumpy()
+    assert o.shape == (4, 10, 20)
+    # masked beyond valid length
+    assert abs(o[0, 3:]).max() == 0.0
+    assert abs(o[2, 5:]).max() == 0.0
+    assert abs(o[1]).max() > 0.0
+
+
+def test_unroll_valid_length_freezes_states():
+    # code-review r5: the returned states must be the states AT each
+    # row's valid_length, not the last step's
+    from mxnet_tpu import gluon
+
+    mx.seed(5)
+    cell = gluon.rnn.LSTMCell(8)
+    cell.initialize()
+    data = mx.np.random.normal(0, 1, size=(2, 6, 8))
+    vl = mx.np.array([3.0, 6.0])
+    _, states = cell.unroll(6, data, layout="NTC",
+                            merge_outputs=True, valid_length=vl)
+    # oracle: unroll row 0 for exactly 3 steps
+    _, states3 = cell.unroll(3, data[0:1, :3], layout="NTC",
+                             merge_outputs=True)
+    for s, s3 in zip(states, states3):
+        onp.testing.assert_allclose(s.asnumpy()[0], s3.asnumpy()[0],
+                                    rtol=1e-5, atol=1e-6)

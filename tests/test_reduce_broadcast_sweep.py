@@ -1,0 +1,150 @@
+"""Randomized reduce/broadcast/sort sweeps ported from the reference's
+tests/python/unittest/test_ndarray.py (test_reduce:612, test_broadcast:688,
+test_broadcast_binary:751, test_order:892) — seeded, smaller sample counts
+sized for the 1-core CPU box, full numpy value oracles including NaN/inf
+payloads."""
+import numpy as onp
+
+import pytest
+
+import mxnet_tpu as mx
+
+rs = onp.random.RandomState(2024)
+
+
+def _rand_axes(ndim, multi):
+    if not multi:
+        return int(rs.randint(0, ndim))
+    flags = rs.randint(0, 2, size=ndim)
+    axes = tuple(i for i, f in enumerate(flags) if f)
+    return axes if axes else tuple(range(ndim))
+
+
+def _with_specials(dat):
+    if rs.randint(0, 2) and dat.size > 10:
+        n = rs.randint(0, dat.size // 10 + 1)
+        dat.ravel()[rs.choice(dat.size, n, replace=False)] = onp.nan
+    if rs.randint(0, 2) and dat.size > 20:
+        n = rs.randint(0, dat.size // 20 + 1)
+        dat.ravel()[rs.choice(dat.size, n, replace=False)] = onp.inf
+    return dat
+
+
+@pytest.mark.parametrize("np_fn,nd_name,multi,almost", [
+    (onp.sum, "sum", True, True),
+    (onp.max, "max", True, False),
+    (onp.min, "min", True, False),
+    (onp.argmax, "argmax", False, False),
+    (onp.argmin, "argmin", False, False),
+    (onp.prod, "prod", True, True),
+    (onp.mean, "mean", True, True),
+])
+def test_reduce_sweep(np_fn, nd_name, multi, almost):
+    for _ in range(40):
+        ndim = rs.randint(1, 6)
+        shape = tuple(rs.randint(1, 8, size=ndim))
+        dat = (rs.rand(*shape) - 0.5).astype("float32")
+        if nd_name in ("max", "min", "sum"):
+            dat = _with_specials(dat)
+        keepdims = bool(rs.randint(0, 2))
+        axes = _rand_axes(ndim, multi)
+        want = np_fn(dat, axis=axes, keepdims=keepdims)
+        got = getattr(mx.nd, nd_name)(
+            mx.nd.array(dat, dtype="float32"), axis=axes,
+            keepdims=keepdims).asnumpy()
+        assert got.shape == want.shape or (got.shape == (1,)
+                                           and want.shape == ())
+        if almost:
+            onp.testing.assert_allclose(got.reshape(want.shape), want,
+                                        rtol=2e-4, atol=1e-5)
+        else:
+            onp.testing.assert_array_equal(got.reshape(want.shape), want)
+
+
+def test_broadcast_to_sweep():  # reference: test_broadcast:688
+    for _ in range(120):
+        ndim = rs.randint(1, 6)
+        target = rs.randint(1, 8, size=ndim)
+        shape = target.copy()
+        for ax in range(ndim):
+            if rs.randint(0, 2):
+                shape[ax] = 1
+        dat = (rs.rand(*shape) - 0.5).astype("float32")
+        want = onp.broadcast_to(dat, target)
+        got = mx.nd.broadcast_to(
+            mx.nd.array(dat), shape=tuple(int(t) for t in target))
+        onp.testing.assert_array_equal(got.asnumpy(), want)
+        # broadcast_axes spelling over the size-1 axes
+        axes = tuple(i for i in range(ndim) if shape[i] == 1
+                     and target[i] != 1)
+        if axes:
+            got2 = mx.nd.broadcast_axes(
+                mx.nd.array(dat), axis=axes,
+                size=tuple(int(target[i]) for i in axes))
+            onp.testing.assert_array_equal(got2.asnumpy(), want)
+
+
+@pytest.mark.parametrize("np_op,nd_name", [
+    (onp.add, "broadcast_add"),
+    (onp.subtract, "broadcast_sub"),
+    (onp.multiply, "broadcast_mul"),
+    (onp.maximum, "broadcast_maximum"),
+    (onp.minimum, "broadcast_minimum"),
+    (onp.not_equal, "broadcast_not_equal"),
+    (onp.greater, "broadcast_greater"),
+])
+def test_broadcast_binary_sweep(np_op, nd_name):
+    # reference: test_broadcast_binary:751 — random compatible shapes
+    for _ in range(40):
+        ndim = rs.randint(1, 5)
+        base = rs.randint(1, 8, size=ndim)
+        lshape = base.copy()
+        rshape = base.copy()
+        for ax in range(ndim):
+            r = rs.randint(0, 3)
+            if r == 1:
+                lshape[ax] = 1
+            elif r == 2:
+                rshape[ax] = 1
+        l = (rs.rand(*lshape) - 0.5).astype("float32")
+        r_ = (rs.rand(*rshape) - 0.5).astype("float32")
+        want = np_op(l, r_)
+        got = getattr(mx.nd, nd_name)(mx.nd.array(l),
+                                      mx.nd.array(r_)).asnumpy()
+        onp.testing.assert_allclose(got.astype(want.dtype), want,
+                                    rtol=1e-5, atol=1e-6)
+
+
+def test_order_sweep():  # reference: test_order:892 (core families)
+    for _ in range(25):
+        ndim = rs.randint(1, 4)
+        shape = tuple(rs.randint(2, 8, size=ndim))
+        dat = rs.rand(*shape).astype("float32")
+        # unique values so ordering comparisons are deterministic
+        dat = onp.unique(dat.ravel())[: onp.prod(shape)]
+        if dat.size < onp.prod(shape):
+            continue
+        dat = dat.reshape(shape)
+        rs.shuffle(dat.ravel())
+        axis = int(rs.randint(0, ndim))
+        k = int(rs.randint(1, shape[axis] + 1))
+        a = mx.nd.array(dat)
+
+        onp.testing.assert_array_equal(
+            mx.nd.sort(a, axis=axis).asnumpy(), onp.sort(dat, axis=axis))
+        onp.testing.assert_array_equal(
+            mx.nd.argsort(a, axis=axis).asnumpy().astype("int64"),
+            onp.argsort(dat, axis=axis, kind="stable"))
+        # topk indices == last k of argsort, descending
+        idx = mx.nd.topk(a, k=k, axis=axis,
+                         is_ascend=False).asnumpy().astype("int64")
+        full = onp.argsort(dat, axis=axis, kind="stable")
+        want_idx = onp.flip(onp.take(full, onp.arange(
+            shape[axis] - k, shape[axis]), axis=axis), axis=axis)
+        onp.testing.assert_array_equal(idx, want_idx)
+        # ret_typ='value' matches gathering those indices
+        vals = mx.nd.topk(a, k=k, axis=axis, ret_typ="value",
+                          is_ascend=True).asnumpy()
+        want_vals = onp.take(onp.sort(dat, axis=axis),
+                             onp.arange(k), axis=axis)
+        onp.testing.assert_allclose(vals, want_vals, rtol=1e-6)
