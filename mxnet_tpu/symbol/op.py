@@ -184,7 +184,8 @@ take = _reg("take")(
 
 def Embedding(data, weight, input_dim=None, output_dim=None,
               name=None, **kw):  # noqa: ARG001
-    return Symbol.create("Embedding", data, weight, name=name)
+    return Symbol.create("Embedding", data, weight, name=name,
+                         input_dim=input_dim, output_dim=output_dim)
 
 
 register_sym_op(
@@ -205,7 +206,7 @@ def FullyConnected(data, weight, bias=None, num_hidden=None, no_bias=False,
     ins = (data, weight) if no_bias or bias is None else (data, weight, bias)
     return Symbol.create("FullyConnected", *ins, name=name,
                          no_bias=bool(no_bias or bias is None),
-                         flatten=flatten)
+                         num_hidden=num_hidden, flatten=flatten)
 
 
 register_sym_op(
@@ -221,6 +222,7 @@ def Convolution(data, weight, bias=None, kernel=None, num_filter=None,
     ins = (data, weight) if no_bias or bias is None else (data, weight, bias)
     return Symbol.create("Convolution", *ins, name=name,
                          no_bias=bool(no_bias or bias is None),
+                         kernel=kernel, num_filter=num_filter,
                          stride=stride, pad=pad, dilate=dilate,
                          num_group=num_group)
 
@@ -239,6 +241,9 @@ def Deconvolution(data, weight, bias=None, no_bias=False, stride=None,
     ins = (data, weight) if no_bias or bias is None else (data, weight, bias)
     return Symbol.create("Deconvolution", *ins, name=name,
                          no_bias=bool(no_bias or bias is None),
+                         kernel=kw.get("kernel"),
+                         num_filter=kw.get("num_filter"),
+                         num_group=kw.get("num_group", 1),
                          stride=stride, pad=pad)
 
 

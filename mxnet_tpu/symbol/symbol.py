@@ -262,21 +262,23 @@ class Symbol:
         return [NDArray(o) for o in outs]
 
     def infer_shape(self, **kwargs):
-        """(arg_shapes, out_shapes, aux_shapes) from input shapes
-        (reference: Symbol.infer_shape — here jax.eval_shape)."""
-        names = self.list_arguments()
-        known = {}
-        for k, v in kwargs.items():
-            # pure metadata: never materialize arrays for shape queries
-            known[k] = jax.ShapeDtypeStruct(tuple(v), jnp.float32) \
-                if isinstance(v, (tuple, list)) \
-                else jax.ShapeDtypeStruct(v.shape, v.dtype)
-        missing = [n for n in names if n not in known]
-        if missing:
-            raise ValueError(f"infer_shape needs shapes for {missing}")
-        out_shapes = [o.shape for o in jax.eval_shape(
-            self._lower(), {n: known[n] for n in names})]
-        arg_shapes = [known[n].shape for n in names]
+        """(arg_shapes, out_shapes, aux_shapes) from input shapes.
+
+        Reference: Symbol.infer_shape over nnvm InferShape
+        (infer_graph_attr_pass.cc) — unknown ARG shapes are DEDUCED, not
+        required: parameter shapes of the NN ops (FullyConnected weight/
+        bias, Convolution, BatchNorm, Embedding) follow from the data
+        shape, elementwise/broadcast operands unify dim-by-dim (0 = the
+        reference's unknown-dim marker), and inconsistencies raise
+        MXNetError. Fully-known subgraphs resolve through jax.eval_shape.
+        """
+        arg_shapes, out_shapes = _infer_shapes(self, kwargs, partial=False)
+        return arg_shapes, out_shapes, []
+
+    def infer_shape_partial(self, **kwargs):
+        """Like infer_shape but unresolved entries come back as None
+        instead of raising (reference: infer_shape_partial)."""
+        arg_shapes, out_shapes = _infer_shapes(self, kwargs, partial=True)
         return arg_shapes, out_shapes, []
 
     def infer_type(self, **kwargs):
@@ -477,3 +479,220 @@ class Executor:
             else:
                 self.grad_dict[n] = NDArray(g)
         return self.grad_dict
+
+
+# ---------------------------------------------------------------------------
+# shape inference (reference: nnvm InferShape, infer_graph_attr_pass.cc)
+# ---------------------------------------------------------------------------
+
+# equal-shape contract ops only (reference ElemwiseShape); broadcast_*
+# ops accept dim-1/rank-promoted operands and must NOT dim-unify
+_ELEMWISE_UNIFY = {
+    "elemwise_add", "elemwise_sub", "elemwise_mul", "elemwise_div",
+    "_identity_with_attr_like_rhs",
+}
+
+
+def _unify_dims(a, b, what):
+    """Merge two shapes dim-by-dim; 0 means unknown (reference shape
+    convention). Conflict -> MXNetError."""
+    from ..base import MXNetError
+
+    if a is None:
+        return tuple(b) if b is not None else None
+    if b is None:
+        return tuple(a)
+    if len(a) != len(b):
+        raise MXNetError(
+            f"infer_shape: rank mismatch at {what}: {a} vs {b}")
+    out = []
+    for da, db in zip(a, b):
+        if da == 0:
+            out.append(db)
+        elif db == 0 or da == db:
+            out.append(da)
+        else:
+            raise MXNetError(
+                f"infer_shape: inconsistent shapes at {what}: {a} vs {b}")
+    return tuple(out)
+
+
+def _shape_known(s):
+    return s is not None and all(d != 0 for d in s)
+
+
+def _deduce_params(node, shapes, record):
+    """Parameter-shape deduction for the curated NN ops: given the data
+    shape, fill in unknown weight/bias/stat leaf shapes (reference: each
+    op's InferShape filling in_shape backward)."""
+    op = node._op
+    ins = node._inputs
+    data_shape = shapes.get(id(ins[0]))
+    if data_shape is None or not _shape_known(data_shape):
+        return
+    a = node._attrs
+
+    def put(sym, shape, what):
+        shapes[id(sym)] = _unify_dims(shapes.get(id(sym)), shape, what)
+        record(sym)
+
+    if op == "FullyConnected" and a.get("num_hidden"):
+        nh = int(a["num_hidden"])
+        in_units = data_shape[-1] if not a.get("flatten", True) else \
+            int(_np.prod(data_shape[1:]))
+        put(ins[1], (nh, in_units), f"{node._name}.weight")
+        if len(ins) > 2:
+            put(ins[2], (nh,), f"{node._name}.bias")
+    elif op in ("Convolution", "Deconvolution") and a.get("num_filter") \
+            and a.get("kernel"):
+        nf = int(a["num_filter"])
+        kern = tuple(int(k) for k in a["kernel"])
+        grp = int(a.get("num_group", 1) or 1)
+        c = data_shape[1]
+        if op == "Convolution":
+            w_shape = (nf, c // grp) + kern
+        else:  # Deconvolution: weight is (C_in, num_filter/group, *k)
+            w_shape = (c, nf // grp) + kern
+        put(ins[1], w_shape, f"{node._name}.weight")
+        if len(ins) > 2:
+            put(ins[2], (nf,), f"{node._name}.bias")
+    elif op in ("BatchNorm", "LayerNorm", "InstanceNorm", "GroupNorm"):
+        # runtime ops: BatchNorm/InstanceNorm/GroupNorm scale the channel
+        # axis (1); LayerNorm normalizes the last axis (ops/nn.py)
+        axis = int(a.get("axis", -1 if op == "LayerNorm" else 1))
+        c = data_shape[axis]
+        for i in range(1, len(ins)):
+            put(ins[i], (c,), f"{node._name}.param{i}")
+    elif op == "Embedding" and a.get("input_dim") and a.get("output_dim"):
+        put(ins[1], (int(a["input_dim"]), int(a["output_dim"])),
+            f"{node._name}.weight")
+
+
+def _infer_shapes(sym, kwargs, partial):
+    from ..base import MXNetError
+
+    order = sym._topo()
+    shapes = {}  # id(node) -> tuple (0 = unknown dim) or None
+    leaves = {}
+    for s in order:
+        if s._op is None:
+            leaves.setdefault(s._name, []).append(s)
+            declared = s._attrs.get("__shape__")
+            if declared is not None:
+                shapes[id(s)] = tuple(declared)
+        elif s._op == "_const":
+            shapes[id(s)] = tuple(_np.asarray(s._attrs["value"]).shape)
+    for k, v in kwargs.items():
+        shp = tuple(v) if isinstance(v, (tuple, list)) else tuple(v.shape)
+        for leaf in leaves.get(k, ()):
+            shapes[id(leaf)] = _unify_dims(shapes.get(id(leaf)), shp, k)
+
+    def record(sym_):  # same-named leaves share their deduction
+        if sym_._op is None:
+            for twin in leaves.get(sym_._name, ()):
+                shapes[id(twin)] = _unify_dims(
+                    shapes.get(id(twin)), shapes[id(sym_)], sym_._name)
+
+    # iterate to a fixpoint: deduction on one node may complete the
+    # inputs of another (two passes suffice for feed-forward DAGs; loop
+    # until stable for safety)
+    for _ in range(len(order)):
+        changed = False
+        for s in order:
+            if s._op in (None, "_const", "_group"):
+                continue
+            before = shapes.get(id(s))
+            _deduce_params(s, shapes, record)
+            if s._op in _ELEMWISE_UNIFY and len(s._inputs) >= 2:
+                # unify only same-rank operands; a scalar _const riding a
+                # broadcast (x * 2) participates in VALUE lowering but
+                # not in the equal-shape contract
+                known = [shapes.get(id(i)) for i in s._inputs]
+                ranks = {len(k) for k in known if k is not None}
+                uni = None
+                if len(ranks) == 1:
+                    for si in known:
+                        if si is not None:
+                            uni = _unify_dims(uni, si, s._name)
+                if uni is not None:
+                    for i in s._inputs:
+                        if i._op is None:  # write back to variables only
+                            shapes[id(i)] = _unify_dims(
+                                shapes.get(id(i)), uni, s._name)
+                            record(i)
+                    if _shape_known(uni):
+                        shapes[id(s)] = uni
+            if shapes.get(id(s)) is None \
+                    and (id(s), "multi") not in shapes and all(
+                    _shape_known(shapes.get(id(i))) for i in s._inputs):
+                # fully-known inputs: one-op abstract eval
+                ins_sds = [jax.ShapeDtypeStruct(shapes[id(i)], jnp.float32)
+                           for i in s._inputs]
+                try:
+                    out = jax.eval_shape(
+                        lambda *xs, _s=s: _op_fn(_s._op)(list(xs),
+                                                         _s._attrs),
+                        *ins_sds)
+                except Exception as e:  # shape-invalid graph
+                    raise MXNetError(
+                        f"infer_shape failed at {s._name} ({s._op}): {e}"
+                    ) from e
+                if s._out_index is not None:
+                    out = out[s._out_index]
+                shapes[id(s)] = tuple(out.shape) if hasattr(out, "shape") \
+                    else tuple(out[0].shape)  # multi-out: first's shape
+                if s._nout > 1 and s._out_index is None:
+                    shapes[id(s)] = None  # handled via sliced wrappers
+                    shapes[(id(s), "multi")] = [tuple(o.shape)
+                                                for o in out]
+            if shapes.get(id(s)) != before:
+                changed = True
+        if not changed:
+            break
+
+    names = sym.list_arguments()
+    arg_shapes = []
+    for n in names:
+        leaf = leaves[n][0]
+        shp = shapes.get(id(leaf))
+        if not _shape_known(shp):
+            if not partial:
+                raise MXNetError(
+                    f"infer_shape could not resolve argument {n!r} "
+                    f"(got {shp}); provide its shape or use "
+                    "infer_shape_partial")
+            shp = None
+        arg_shapes.append(shp)
+
+    if sym._op == "_group":
+        outs = sym._flat_outputs()
+    elif sym._nout > 1 and sym._out_index is None:
+        # bare multi-output head: one shape per output, from the node's
+        # 'multi' record (the fresh _flat_outputs wrappers have new ids)
+        ms = shapes.get((id(sym), "multi"))
+        outs = list(range(sym._nout))
+        out_shapes = []
+        for i in outs:
+            shp = ms[i] if ms is not None else None
+            if not _shape_known(shp):
+                if not partial:
+                    raise MXNetError(
+                        f"infer_shape could not resolve output {i} of "
+                        f"{sym._name}")
+                shp = None
+            out_shapes.append(shp)
+        return arg_shapes, out_shapes
+    else:
+        outs = [sym]
+    out_shapes = []
+    for o in outs:
+        shp = shapes.get(id(o))
+        if shp is None and (id(o), "multi") in shapes:
+            shp = shapes[(id(o), "multi")][o._out_index or 0]
+        if not _shape_known(shp):
+            if not partial:
+                raise MXNetError(
+                    f"infer_shape could not resolve output of {o._name}")
+            shp = None
+        out_shapes.append(shp)
+    return arg_shapes, out_shapes

@@ -1,0 +1,149 @@
+"""Symbol shape inference ported from the reference's
+tests/python/unittest/test_infer_shape.py — unknown parameter shapes are
+DEDUCED from the data shape (nnvm InferShape semantics), partial dims
+(0 = unknown) unify through elementwise ops, inconsistencies raise
+MXNetError, and infer_shape_partial returns None for unresolved."""
+import pytest
+
+import mxnet_tpu as mx
+
+
+def _mlp2():
+    data = mx.sym.Variable("data")
+    fc1 = mx.sym.FullyConnected(data, mx.sym.var("fc1_weight"),
+                                mx.sym.var("fc1_bias"), num_hidden=1000,
+                                name="fc1")
+    act = mx.sym.Activation(fc1, act_type="relu")
+    return mx.sym.FullyConnected(act, mx.sym.var("fc2_weight"),
+                                 mx.sym.var("fc2_bias"), num_hidden=10,
+                                 name="fc2")
+
+
+def test_mlp2_infer_shape():  # reference: test_infer_shape.py:25
+    out = _mlp2()
+    arg_shapes, out_shapes, aux_shapes = out.infer_shape(data=(100, 100))
+    d = dict(zip(out.list_arguments(), arg_shapes))
+    assert len(out_shapes) == 1
+    assert out_shapes[0] == (100, 10)
+    for k, v in {"fc2_bias": (10,), "fc2_weight": (10, 1000),
+                 "fc1_bias": (1000,), "fc1_weight": (1000, 100)}.items():
+        assert d[k] == v, (k, d[k], v)
+
+
+def test_mlp2_infer_error():  # reference: test_infer_shape.py:41
+    out = _mlp2()
+    with pytest.raises(mx.MXNetError):
+        out.infer_shape(data=(100, 100), fc1_weight=(1, 100))
+
+
+def test_incomplete_infer_elewise():  # reference: test_infer_shape.py:67
+    a = mx.sym.var("a", shape=(0, 10))
+    b = mx.sym.var("b", shape=(12, 0))
+    c = a + b
+    arg_shapes, out_shapes, _ = c.infer_shape()
+    d = dict(zip(c.list_arguments(), arg_shapes))
+    assert out_shapes[0] == (12, 10)
+    assert d["a"] == (12, 10)
+    assert d["b"] == (12, 10)
+
+
+def test_incomplete_infer_mlp():  # reference: test_infer_shape.py:78
+    a = mx.sym.var("a", shape=(64, 0))
+    b = mx.sym.var("b")
+    out = mx.sym.FullyConnected(a, b, num_hidden=30, no_bias=True,
+                                name="fc")
+    arg_shapes, out_shapes, _ = out.infer_shape(a=(64, 100))
+    d = dict(zip(out.list_arguments(), arg_shapes))
+    assert out_shapes[0] == (64, 30)
+    assert d["b"] == (30, 100)
+
+
+def test_conv_deduction():
+    data = mx.sym.var("data")
+    conv = mx.sym.Convolution(data, mx.sym.var("cw"), mx.sym.var("cb"),
+                              kernel=(3, 3), num_filter=8, pad=(1, 1),
+                              num_group=1, name="c1")
+    arg_shapes, out_shapes, _ = conv.infer_shape(data=(2, 3, 16, 16))
+    d = dict(zip(conv.list_arguments(), arg_shapes))
+    assert d["cw"] == (8, 3, 3, 3)
+    assert d["cb"] == (8,)
+    assert out_shapes[0] == (2, 8, 16, 16)
+
+
+def test_batchnorm_deduction():
+    data = mx.sym.var("data")
+    bn = mx.sym.BatchNorm(data, mx.sym.var("g"), mx.sym.var("be"),
+                          mx.sym.var("mm"), mx.sym.var("mv"), name="bn0")
+    arg_shapes, _, _ = bn.infer_shape(data=(2, 7, 4, 4))
+    d = dict(zip(bn.list_arguments(), arg_shapes))
+    assert d["g"] == (7,) and d["be"] == (7,)
+    assert d["mm"] == (7,) and d["mv"] == (7,)
+
+
+def test_infer_shape_partial_returns_none():
+    out = _mlp2()
+    arg_shapes, out_shapes, _ = out.infer_shape_partial()
+    d = dict(zip(out.list_arguments(), arg_shapes))
+    assert d["data"] is None
+    assert out_shapes[0] is None
+
+
+def test_fc_infer_type():  # reference: test_infer_shape.py:134
+    data = mx.sym.Variable("data")
+    out = mx.sym.FullyConnected(data, mx.sym.var("fc1_weight"),
+                                mx.sym.var("fc1_bias"), num_hidden=4,
+                                name="fc1")
+    import numpy as onp
+
+    arg_types, out_types, _ = out.infer_type(
+        data=onp.float32, fc1_weight=onp.float32, fc1_bias=onp.float32)
+    assert all(t == onp.float32 for t in arg_types)
+
+
+def test_scalar_arith_and_broadcast_graphs():
+    # code-review r5: scalar _const operands and broadcast ops must not
+    # trip the equal-shape contract
+    x = mx.sym.var("x")
+    args, outs, _ = (x * 2).infer_shape(x=(2, 3))
+    assert outs[0] == (2, 3)
+    args, outs, _ = (1 - x).infer_shape(x=(4,))
+    assert outs[0] == (4,)
+    a = mx.sym.var("a")
+    b = mx.sym.var("b")
+    out = mx.sym.broadcast_add(a, b)
+    args, outs, _ = out.infer_shape(a=(2, 3), b=(1, 3))
+    assert outs[0] == (2, 3)
+    args, outs, _ = out.infer_shape(a=(2, 3), b=(3,))
+    assert outs[0] == (2, 3)
+
+
+def test_multi_output_head_shapes():
+    x = mx.sym.var("x")
+    s = mx.sym.split(x, num_outputs=2, axis=1)
+    args, outs, _ = s.infer_shape(x=(4, 6))
+    assert outs == [(4, 3), (4, 3)]
+    assert len(outs) == len(s.list_outputs())
+
+
+def test_norm_family_deduction_axes():
+    d = mx.sym.var("d")
+    inn = mx.sym.InstanceNorm(d, mx.sym.var("ig"), mx.sym.var("ib"),
+                              name="in0")
+    args, _, _ = inn.infer_shape(d=(2, 7, 4, 4))
+    dd = dict(zip(inn.list_arguments(), args))
+    assert dd["ig"] == (7,) and dd["ib"] == (7,)
+    ln = mx.sym.LayerNorm(d, mx.sym.var("lg"), mx.sym.var("lb"),
+                          name="ln0")
+    args, _, _ = ln.infer_shape(d=(2, 7, 5))
+    dd = dict(zip(ln.list_arguments(), args))
+    assert dd["lg"] == (5,) and dd["lb"] == (5,)
+
+
+def test_embedding_deduction():
+    d = mx.sym.var("d")
+    emb = mx.sym.Embedding(d, mx.sym.var("w"), input_dim=50,
+                           output_dim=8, name="emb0")
+    args, outs, _ = emb.infer_shape(d=(4,))
+    dd = dict(zip(emb.list_arguments(), args))
+    assert dd["w"] == (50, 8)
+    assert outs[0] == (4, 8)
