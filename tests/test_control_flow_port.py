@@ -1,0 +1,164 @@
+"""Symbolic-vs-imperative control-flow oracles ported from the
+reference's tests/python/unittest/test_contrib_control_flow.py
+(test_foreach:941 verify_foreach pattern): for each step function, the
+symbolic sym.contrib.foreach graph — bound, forward, backward with
+explicit out_grads, tojson round-tripped — must match a hand-unrolled
+imperative loop under autograd, values AND input gradients."""
+import numpy as onp
+
+import pytest
+
+import mxnet_tpu as mx
+
+
+def _as_list(x):
+    return list(x) if isinstance(x, (list, tuple)) else [x]
+
+
+def _verify_foreach(step, n_in, n_state, n_free, shape=(3, 2)):
+    rs = onp.random.RandomState(99)  # per-call: order-independent repro
+    T = shape[0]
+    in_arrs = [rs.rand(*shape).astype("f") for _ in range(n_in)]
+    states = [rs.rand(*shape[1:]).astype("f") for _ in range(n_state)]
+    frees = [rs.rand(*shape[1:]).astype("f") for _ in range(n_free)]
+
+    # --- symbolic ---------------------------------------------------
+    in_syms = [mx.sym.var(f"v{i}") for i in range(n_in)]
+    st_syms = [mx.sym.var(f"v{n_in + i}") for i in range(n_state)]
+    fr_syms = [mx.sym.var(f"v{n_in + n_state + i}")
+               for i in range(n_free)]
+
+    def step_sym(x, s):
+        return step(_as_list(x), _as_list(s), fr_syms)
+
+    res, out_states = mx.sym.contrib.foreach(
+        step_sym, in_syms if n_in > 1 else in_syms[0],
+        st_syms if n_state > 1 else st_syms[0])
+    outs = [o * 2 for o in _as_list(res)] + _as_list(out_states)
+    g = mx.sym.Group(outs)
+    js1 = g.tojson()
+    g = mx.sym.fromjson(js1)
+    assert g.tojson() == js1  # stable serialization round-trip
+
+    arg_dict = {}
+    for i, a in enumerate(in_arrs + states + frees):
+        arg_dict[f"v{i}"] = mx.nd.array(a)
+    ex = g.bind(args=arg_dict)
+    sym_outs = ex.forward(is_train=True)
+    out_grads = [onp.random.RandomState(7 + i).rand(
+        *o.shape).astype("f") for i, o in enumerate(sym_outs)]
+    grads = ex.backward([mx.nd.array(og) for og in out_grads])
+
+    # --- imperative oracle ------------------------------------------
+    nd_ins = [mx.nd.array(a) for a in in_arrs]
+    nd_sts = [mx.nd.array(a) for a in states]
+    nd_frs = [mx.nd.array(a) for a in frees]
+    for a in nd_ins + nd_sts + nd_frs:
+        a.attach_grad()
+    with mx.autograd.record():
+        cur = list(nd_sts)
+        step_outs = None
+        for t in range(T):
+            xs = [a[t] for a in nd_ins]
+            o, ns = step(xs, cur, nd_frs)
+            cur = _as_list(ns)
+            o = _as_list(o)
+            if step_outs is None:
+                step_outs = [[] for _ in o]
+            for j, oj in enumerate(o):
+                step_outs[j].append(oj)
+        imp_outs = [mx.np.stack(col, axis=0) * 2 for col in step_outs]
+        imp_outs += cur
+        heads = imp_outs
+        mx.autograd.backward(
+            heads, [mx.nd.array(og) for og in out_grads])
+
+    for s, i in zip(sym_outs, imp_outs):
+        onp.testing.assert_allclose(s.asnumpy(), i.asnumpy(), rtol=1e-4,
+                                    atol=1e-5)
+    for i, a in enumerate(nd_ins + nd_sts + nd_frs):
+        gsym = grads[f"v{i}"]
+        onp.testing.assert_allclose(gsym.asnumpy(), a.grad.asnumpy(),
+                                    rtol=1e-4, atol=1e-5,
+                                    err_msg=f"grad v{i}")
+
+
+def test_foreach_simple_accumulate():
+    _verify_foreach(lambda xs, ss, fs: (xs[0] + ss[0], [xs[0] + ss[0]]),
+                    1, 1, 0)
+
+
+def test_foreach_with_free_variable():
+    _verify_foreach(
+        lambda xs, ss, fs: (xs[0] * fs[0] + ss[0],
+                            [xs[0] * fs[0] + ss[0]]),
+        1, 1, 1)
+
+
+def test_foreach_multi_input_state():
+    def step(xs, ss, fs):
+        o1 = xs[0] + xs[1] * ss[0]
+        o2 = xs[0] - ss[1]
+        return [o1, o2], [o1, ss[0] + ss[1]]
+
+    _verify_foreach(step, 2, 2, 0)
+
+
+def test_foreach_free_only_output():
+    # output depends on state + free, new state mixes input
+    def step(xs, ss, fs):
+        return fs[0] * ss[0], [ss[0] + xs[0]]
+
+    _verify_foreach(step, 1, 1, 1)
+
+
+def test_while_loop_nested_port():  # reference: test_while_loop_nested:676
+    # count in base-2: outer loop runs inner while fully each iteration
+    i = mx.sym.var("i")
+    total = mx.sym.var("total")
+
+    def outer_func(i, total):
+        _, (j_fin, inner_sum) = mx.sym.contrib.while_loop(
+            cond=lambda j, acc: j < 3,
+            func=lambda j, acc: (None, (j + 1, acc + j)),
+            loop_vars=(i * 0, total * 0), max_iterations=3)
+        return None, (i + 1, total + inner_sum)
+
+    _, finals = mx.sym.contrib.while_loop(
+        cond=lambda i, total: i < 2,
+        func=outer_func,
+        loop_vars=(i, total), max_iterations=2)
+    res = mx.sym.Group(list(finals)).bind(
+        args={"i": mx.nd.array(0.0), "total": mx.nd.array(0.0)}).forward()
+    assert float(res[0].asnumpy()) == 2.0
+    assert float(res[1].asnumpy()) == 6.0  # 2 outer x (0+1+2)
+
+
+def test_output_format_foreach_port():  # reference: test_output_format
+    data = mx.sym.var("data")
+    # single out, single state -> scalars not lists
+    out, fin = mx.sym.contrib.foreach(
+        lambda x, s: (x, s), data, mx.sym.zeros(()))
+    assert not isinstance(out, (list, tuple))
+    assert not isinstance(fin, (list, tuple))
+    # multi out, multi state -> lists
+    outs, fins = mx.sym.contrib.foreach(
+        lambda x, s: ([x, x * 2], [s[0], s[1]]), data,
+        [mx.sym.zeros(()), mx.sym.ones(())])
+    assert isinstance(outs, list) and len(outs) == 2
+    assert isinstance(fins, list) and len(fins) == 2
+
+
+def test_uniq_name_port():  # reference: test_gluon_control_flow
+    # two default-named loops in ONE graph must not collide
+    data = mx.sym.var("data")
+    o1, _ = mx.sym.contrib.foreach(lambda x, s: (x + s, x + s), data,
+                                   mx.sym.zeros(()))
+    o2, _ = mx.sym.contrib.foreach(lambda x, s: (x * s + x, x * s + x),
+                                   data, mx.sym.ones(()))
+    both = mx.sym.Group([o1, o2])
+    arr = mx.nd.array([1.0, 2.0, 3.0])
+    r = both.bind(args={"data": arr}).forward()
+    onp.testing.assert_allclose(r[0].asnumpy(), [1.0, 3.0, 6.0])
+    # o2: s0=1; o_t = x*s + x; s_t = o_t -> [2, 6, 21]
+    onp.testing.assert_allclose(r[1].asnumpy(), [2.0, 6.0, 21.0])
