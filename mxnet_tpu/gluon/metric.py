@@ -91,10 +91,18 @@ class Accuracy(EvalMetric):
         for label, pred in zip(labels, preds):
             label = _to_np(label)
             pred = _to_np(pred)
-            if pred.ndim > label.ndim:
+            if pred.ndim > 1 and pred.shape != label.shape:
+                # class-probability rows argmax to labels whenever the
+                # shapes differ — labels may arrive 2-D from custom
+                # iterators (reference Accuracy.update, test_metric.py:71)
                 pred = pred.argmax(self.axis)
             pred = pred.astype(_np.int64).reshape(-1)
             label = label.astype(_np.int64).reshape(-1)
+            if len(pred) != len(label):
+                # reference check_label_shapes: loud, never broadcast
+                raise ValueError(
+                    f"Accuracy: {len(pred)} predictions vs "
+                    f"{len(label)} labels")
             self.sum_metric += float((pred == label).sum())
             self.num_inst += len(label)
 
@@ -127,6 +135,7 @@ class F1(EvalMetric):
 
     def reset_stats(self):
         self._tp = self._fp = self._fn = 0.0
+        self._batch_scores = []  # per-update F1s for average='macro'
 
     def reset(self):
         super().reset()
@@ -136,6 +145,7 @@ class F1(EvalMetric):
     def update(self, labels, preds):
         labels = labels if isinstance(labels, (list, tuple)) else [labels]
         preds = preds if isinstance(preds, (list, tuple)) else [preds]
+        up_tp = up_fp = up_fn = 0.0
         for label, pred in zip(labels, preds):
             label = _to_np(label).reshape(-1).astype(_np.int64)
             pred = _to_np(pred)
@@ -143,14 +153,23 @@ class F1(EvalMetric):
                 pred = pred.argmax(-1).reshape(-1)
             else:
                 pred = (pred.reshape(-1) > self.threshold).astype(_np.int64)
-            self._tp += float(((pred == 1) & (label == 1)).sum())
-            self._fp += float(((pred == 1) & (label == 0)).sum())
-            self._fn += float(((pred == 0) & (label == 1)).sum())
+            up_tp += float(((pred == 1) & (label == 1)).sum())
+            up_fp += float(((pred == 1) & (label == 0)).sum())
+            up_fn += float(((pred == 0) & (label == 1)).sum())
             self.num_inst += len(label)
+        self._tp += up_tp
+        self._fp += up_fp
+        self._fn += up_fn
+        # macro: mean of per-UPDATE F1 scores (reference F1 'macro'
+        # averages across batches; 'micro' pools the counts)
+        self._batch_scores.append(_fbeta_score(up_tp, up_fp, up_fn, 1.0))
 
     def get(self):
-        score = _fbeta_score(self._tp, self._fp, self._fn, 1.0)
-        return self.name, score if self.num_inst else float("nan")
+        if not self.num_inst:
+            return self.name, float("nan")
+        if self.average == "macro" and self._batch_scores:
+            return self.name, float(_np.mean(self._batch_scores))
+        return self.name, _fbeta_score(self._tp, self._fp, self._fn, 1.0)
 
 
 @_register

@@ -83,3 +83,98 @@ def test_torch_alias_and_registry():
     m = metric.create("fbeta", beta=0.5)
     assert isinstance(m, metric.Fbeta)
     assert isinstance(metric.create("pcc"), metric.PCC)
+
+
+# --- r5 tranche: reference test_metric.py value families ---------------
+
+def test_binary_f1_port():  # reference: test_metric.py:93
+    microF1 = mx.gluon.metric.create("f1", average="micro")
+    macroF1 = mx.gluon.metric.F1(average="macro")
+    assert onp.isnan(macroF1.get()[1])
+    assert onp.isnan(microF1.get()[1])
+
+    pred = mx.np.array([[0.9, 0.1], [0.8, 0.2]])
+    label = mx.np.array([0, 0])
+    macroF1.update([label], [pred])
+    microF1.update([label], [pred])
+    assert macroF1.get()[1] == 0.0  # no positives: divide-by-zero guard
+    assert microF1.get()[1] == 0.0
+    macroF1.reset()
+    microF1.reset()
+
+    pred11 = mx.np.array([[0.1, 0.9], [0.5, 0.5]])
+    label11 = mx.np.array([1, 0])
+    pred12 = mx.np.array([[0.85, 0.15], [1.0, 0.0]])
+    label12 = mx.np.array([1, 0])
+    microF1.update([label11, label12], [pred11, pred12])
+    macroF1.update([label11, label12], [pred11, pred12])
+    assert microF1.num_inst == 4
+    fscore1 = 2.0 * 1 / (2 * 1 + 1 + 0)
+    onp.testing.assert_almost_equal(microF1.get()[1], fscore1)
+    onp.testing.assert_almost_equal(macroF1.get()[1], fscore1)
+
+    microF1.update([mx.np.array([0]), mx.np.array([1])],
+                   [mx.np.array([[0.6, 0.4]]), mx.np.array([[0.2, 0.8]])])
+    macroF1.update([mx.np.array([0]), mx.np.array([1])],
+                   [mx.np.array([[0.6, 0.4]]), mx.np.array([[0.2, 0.8]])])
+    assert microF1.num_inst == 6
+    fscore_total = 2.0 * 2 / (2 * 2 + 1 + 0)
+    onp.testing.assert_almost_equal(microF1.get()[1], fscore_total)
+    # macro = mean of per-update F1s (reference: test_metric.py:93 tail)
+    fscore2 = 2.0 * 1 / (2 * 1 + 0 + 0)
+    onp.testing.assert_almost_equal(macroF1.get()[1],
+                                    onp.mean([fscore1, fscore2]))
+
+
+def test_accuracy_length_mismatch_is_loud():
+    m = mx.gluon.metric.create("acc")
+    import pytest as _pytest
+
+    with _pytest.raises(ValueError):
+        m.update([mx.np.array([[1.0], [0.0], [1.0], [0.0]])],
+                 [mx.np.array([1.0, 0.0, 1.0])])
+
+
+def test_mcc_port():  # reference: test_metric.py:214
+    mcc = mx.gluon.metric.create("mcc")
+    assert onp.isnan(mcc.get()[1])
+    mcc.update([mx.np.array([0, 0])],
+               [mx.np.array([[0.9, 0.1], [0.8, 0.2]])])
+    assert mcc.get()[1] == 0.0
+    mcc.reset()
+
+    mcc.update([mx.np.array([1, 0]), mx.np.array([1, 0])],
+               [mx.np.array([[0.1, 0.9], [0.5, 0.5]]),
+                mx.np.array([[0.85, 0.15], [1.0, 0.0]])])
+    assert mcc.num_inst == 4
+    tp, fp, fn, tn = 1, 0, 1, 2
+    want = (tp * tn - fp * fn) / onp.sqrt(
+        (tp + fp) * (tp + fn) * (tn + fp) * (tn + fn))
+    onp.testing.assert_almost_equal(mcc.get()[1], want)
+
+
+def test_perplexity_port():  # reference: test_metric.py:251
+    pred = mx.np.array([[0.8, 0.2], [0.2, 0.8], [0.0, 1.0]])
+    label = mx.np.array([0, 1, 1])
+    p = pred.asnumpy()[onp.arange(3), label.asnumpy().astype("int32")]
+    want = onp.exp(-onp.log(p).sum() / 3)
+    metric = mx.gluon.metric.create("perplexity", axis=-1)
+    metric.update([label], [pred])
+    onp.testing.assert_almost_equal(metric.get()[1], want, decimal=5)
+
+
+def test_acc_2d_label_port():  # reference: test_metric.py:71
+    pred = mx.np.array([[0.3, 0.7], [0, 1.0], [0.4, 0.6], [0.8, 0.2],
+                        [0.3, 0.5], [0.6, 0.4]])
+    label = mx.np.array([[0, 1, 1], [1, 0, 1]])
+    metric = mx.gluon.metric.create("acc")
+    metric.update([label], [pred])
+    want = (onp.argmax(pred.asnumpy(), axis=1)
+            == label.asnumpy().ravel()).sum() / 6.0
+    onp.testing.assert_almost_equal(metric.get()[1], want)
+
+
+def test_loss_update_port():  # reference: test_metric.py:82
+    m = mx.gluon.metric.Loss()
+    m.update(None, [mx.np.array([2.0, 3.0])])
+    assert m.get()[1] == 2.5
