@@ -38,10 +38,13 @@ class Stack:
 
 
 class Pad:
-    """Pad variable-length samples to the batch max (reference: Pad)."""
+    """Pad variable-length samples to the batch max shape (reference:
+    batchify.Pad — its C++ handle pads EVERY ragged dim to the per-dim
+    max, which the reference's own test pins; `axis` is accepted for
+    signature compatibility and recorded, but padding is max-shape)."""
 
     def __init__(self, axis=0, val=0, dtype=None):
-        self._axis = axis
+        self._axis = axis  # compat only: handle semantics pad all dims
         self._val = val
         self._dtype = dtype
 
@@ -49,11 +52,15 @@ class Pad:
         from ... import numpy as mnp
 
         arrs = [_np.asarray(d) for d in data]
-        max_len = max(a.shape[self._axis] for a in arrs)
+        # pad EVERY dim to the batch max (reference Pad handle pads to
+        # the max shape; test_gluon_data.py test_batchify_pad expects
+        # (2,4)/(1,3)/(1,2) -> (3,2,4))
+        ndim = arrs[0].ndim
+        max_shape = [max(a.shape[d] for a in arrs) for d in range(ndim)]
         padded = []
         for a in arrs:
-            pad_width = [(0, 0)] * a.ndim
-            pad_width[self._axis] = (0, max_len - a.shape[self._axis])
+            pad_width = [(0, max_shape[d] - a.shape[d])
+                         for d in range(ndim)]
             padded.append(_np.pad(a, pad_width, constant_values=self._val))
         out = _np.stack(padded)
         if self._dtype:

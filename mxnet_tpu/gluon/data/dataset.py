@@ -24,7 +24,9 @@ class Dataset:
         return _SubsetDataset(self, items)
 
     def take(self, count):
-        return _SubsetDataset(self, list(range(min(count, len(self)))))
+        # None = take everything (reference: Dataset.take)
+        n = len(self) if count is None else min(count, len(self))
+        return _SubsetDataset(self, list(range(n)))
 
     def sample(self, sampler):
         """Dataset reordered/subset by a Sampler's indices (reference:

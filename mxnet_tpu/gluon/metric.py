@@ -133,9 +133,11 @@ class F1(EvalMetric):
         self.threshold = threshold
         self.reset_stats()
 
+    _beta = 1.0  # Fbeta overrides; one macro/micro get() serves both
+
     def reset_stats(self):
         self._tp = self._fp = self._fn = 0.0
-        self._batch_scores = []  # per-update F1s for average='macro'
+        self._batch_counts = []  # per-update (tp, fp, fn) for 'macro'
 
     def reset(self):
         super().reset()
@@ -160,16 +162,20 @@ class F1(EvalMetric):
         self._tp += up_tp
         self._fp += up_fp
         self._fn += up_fn
-        # macro: mean of per-UPDATE F1 scores (reference F1 'macro'
-        # averages across batches; 'micro' pools the counts)
-        self._batch_scores.append(_fbeta_score(up_tp, up_fp, up_fn, 1.0))
+        if self.average == "macro":
+            # macro: mean of per-UPDATE F scores (reference 'macro'
+            # averages across batches; 'micro' pools the counts)
+            self._batch_counts.append((up_tp, up_fp, up_fn))
 
     def get(self):
         if not self.num_inst:
             return self.name, float("nan")
-        if self.average == "macro" and self._batch_scores:
-            return self.name, float(_np.mean(self._batch_scores))
-        return self.name, _fbeta_score(self._tp, self._fp, self._fn, 1.0)
+        if self.average == "macro" and self._batch_counts:
+            return self.name, float(_np.mean(
+                [_fbeta_score(tp, fp, fn, self._beta)
+                 for tp, fp, fn in self._batch_counts]))
+        return self.name, _fbeta_score(self._tp, self._fp, self._fn,
+                                       self._beta)
 
 
 @_register
@@ -373,10 +379,7 @@ class Fbeta(F1):
     def __init__(self, name="fbeta", beta=1.0, **kwargs):
         super().__init__(name, **kwargs)
         self.beta = beta
-
-    def get(self):
-        score = _fbeta_score(self._tp, self._fp, self._fn, self.beta)
-        return self.name, score if self.num_inst else float("nan")
+        self._beta = beta  # F1.get() computes macro/micro with this
 
 
 @_register

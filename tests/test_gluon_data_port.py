@@ -1,0 +1,103 @@
+"""Gluon data tranche ported from the reference's
+tests/python/unittest/test_gluon_data.py — samplers, dataset
+filter/shard/take combinators (with transform composition), ArrayDataset
+through DataLoader, and the batchify Pad/Stack value oracles."""
+import numpy as onp
+
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import gluon
+
+
+def test_sampler_port():  # reference: test_gluon_data.py test_sampler
+    seq_sampler = gluon.data.SequentialSampler(10)
+    assert list(seq_sampler) == list(range(10))
+    rand_sampler = gluon.data.RandomSampler(10)
+    assert sorted(rand_sampler) == list(range(10))
+    seq_batch_keep = gluon.data.BatchSampler(seq_sampler, 3, "keep")
+    assert sum(list(seq_batch_keep), []) == list(range(10))
+    seq_batch_discard = gluon.data.BatchSampler(seq_sampler, 3, "discard")
+    assert sum(list(seq_batch_discard), []) == list(range(9))
+    rand_batch_keep = gluon.data.BatchSampler(rand_sampler, 3, "keep")
+    assert sorted(sum(list(rand_batch_keep), [])) == list(range(10))
+
+
+def test_dataset_filter_port():
+    a = gluon.data.SimpleDataset(list(range(100)))
+    a_filtered = a.filter(lambda x: x % 10 == 0)
+    assert len(a_filtered) == 10
+    for sample in a_filtered:
+        assert sample % 10 == 0
+    a_xform_filtered = a.transform(lambda x: x + 1).filter(
+        lambda x: x % 10 == 0)
+    assert len(a_xform_filtered) == 10
+    for sample in a_xform_filtered:
+        assert sample % 10 == 0  # filter sees TRANSFORMED values
+
+
+def test_dataset_shard_port():
+    a = gluon.data.SimpleDataset(list(range(9)))
+    shards = [a.shard(4, i) for i in range(4)]
+    assert [len(s) for s in shards] == [3, 2, 2, 2]
+    assert sum(len(s) for s in shards) == 9
+    total = sum(sample for s in shards for sample in s)
+    assert total == sum(range(9))
+
+
+def test_dataset_take_port():
+    a = gluon.data.SimpleDataset(list(range(100)))
+    assert len(a.take(1000)) == 100
+    assert len(a.take(None)) == 100
+    a10 = a.take(10)
+    assert len(a10) == 10
+    assert sum(a10) == sum(range(10))
+    ax10 = a.transform(lambda x: x * 10).take(10)
+    assert sum(ax10) == sum(i * 10 for i in range(10))
+
+
+def test_array_dataset_port():
+    rs = onp.random.RandomState(1)
+    X = rs.uniform(size=(10, 20)).astype("f")
+    Y = rs.uniform(size=(10,)).astype("f")
+    dataset = gluon.data.ArrayDataset(X, Y)
+    loader = gluon.data.DataLoader(dataset, 2)
+    for i, (x, y) in enumerate(loader):
+        onp.testing.assert_allclose(x.asnumpy(),
+                                    X[i * 2:(i + 1) * 2], rtol=1e-6)
+        onp.testing.assert_allclose(y.asnumpy(),
+                                    Y[i * 2:(i + 1) * 2], rtol=1e-6)
+    loader = gluon.data.DataLoader(gluon.data.ArrayDataset(X), 2)
+    for i, x in enumerate(loader):
+        onp.testing.assert_allclose(x.asnumpy(),
+                                    X[i * 2:(i + 1) * 2], rtol=1e-6)
+
+
+def test_batchify_pad_port():  # reference: test_batchify_pad
+    a = onp.array([[1, 2, 3, 4], [11, 12, 13, 14]], dtype="f")
+    b = onp.array([[4, 5, 6]], dtype="f")
+    c = onp.array([[9, 10]], dtype="f")
+    bf = gluon.data.batchify.Pad(val=-1)
+    d = bf([a, b, c])
+    expected = onp.array(
+        [[[1, 2, 3, 4], [11, 12, 13, 14]],
+         [[4, 5, 6, -1], [-1, -1, -1, -1]],
+         [[9, 10, -1, -1], [-1, -1, -1, -1]]], dtype="f")
+    onp.testing.assert_allclose(d.asnumpy(), expected)
+
+
+def test_batchify_stack_port():
+    rs = onp.random.RandomState(2)
+    arrs = [rs.rand(3, 4).astype("f") for _ in range(5)]
+    out = gluon.data.batchify.Stack()(arrs)
+    onp.testing.assert_allclose(out.asnumpy(), onp.stack(arrs), rtol=1e-6)
+
+
+def test_batchify_group_port():
+    rs = onp.random.RandomState(3)
+    pairs = [(rs.rand(2).astype("f"), onp.float32(i)) for i in range(4)]
+    bf = gluon.data.batchify.Group(gluon.data.batchify.Stack(),
+                                   gluon.data.batchify.Stack())
+    xs, ys = bf(pairs)
+    assert xs.shape == (4, 2)
+    assert ys.shape == (4,)

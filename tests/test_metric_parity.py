@@ -178,3 +178,20 @@ def test_loss_update_port():  # reference: test_metric.py:82
     m = mx.gluon.metric.Loss()
     m.update(None, [mx.np.array([2.0, 3.0])])
     assert m.get()[1] == 2.5
+
+
+def test_fbeta_macro_matches_f1():
+    # code-review r5: Fbeta(beta=1) must agree with F1 in both averages
+    def feed(m):
+        m.update([mx.np.array([1, 0]), mx.np.array([1, 0])],
+                 [mx.np.array([[0.1, 0.9], [0.5, 0.5]]),
+                  mx.np.array([[0.85, 0.15], [1.0, 0.0]])])
+        m.update([mx.np.array([0]), mx.np.array([1])],
+                 [mx.np.array([[0.6, 0.4]]), mx.np.array([[0.2, 0.8]])])
+
+    for avg in ("macro", "micro"):
+        f1 = mx.gluon.metric.F1(average=avg)
+        fb = mx.gluon.metric.Fbeta(beta=1.0, average=avg)
+        feed(f1)
+        feed(fb)
+        onp.testing.assert_almost_equal(f1.get()[1], fb.get()[1])
