@@ -161,3 +161,47 @@ def test_create_graph_none_in_head_grads_list():
         g = autograd.grad([y], [x], head_grads=[None],
                           create_graph=True)[0]
     assert float(g.asnumpy()[0]) == 6.0
+
+
+# --- r5 tranche: unary second-derivative sweep (reference
+# test_higher_order_grad.py — each op's d2y/dx2 against the closed form)
+
+_SECOND_DERIVS = {
+    "sin": lambda x: -onp.sin(x),
+    "cos": lambda x: -onp.cos(x),
+    "tan": lambda x: 2 * onp.tan(x) / onp.cos(x) ** 2,
+    "sinh": onp.sinh,
+    "cosh": onp.cosh,
+    "tanh": lambda x: -2 * onp.tanh(x) / onp.cosh(x) ** 2,
+    "arcsin": lambda x: x / (1 - x ** 2) ** 1.5,
+    "arccos": lambda x: -x / (1 - x ** 2) ** 1.5,
+    "arctan": lambda x: -2 * x / (1 + x ** 2) ** 2,
+    "arcsinh": lambda x: -x / (1 + x ** 2) ** 1.5,
+    "arctanh": lambda x: 2 * x / (1 - x ** 2) ** 2,
+    "radians": lambda x: onp.zeros_like(x),
+    "log": lambda x: -1.0 / x ** 2,
+    "log2": lambda x: -1.0 / (x ** 2 * onp.log(2)),
+    "log10": lambda x: -1.0 / (x ** 2 * onp.log(10)),
+    "square": lambda x: 2.0 * onp.ones_like(x),
+    "expm1": onp.exp,
+    "log1p": lambda x: -1.0 / (1 + x) ** 2,
+    "reciprocal": lambda x: 2.0 / x ** 3,
+    "sigmoid": lambda x: (s := 1 / (1 + onp.exp(-x)))
+    * (1 - s) * (1 - 2 * s),
+}
+
+
+@pytest.mark.parametrize("name", sorted(_SECOND_DERIVS))
+def test_unary_second_derivative(name):
+    rs = onp.random.RandomState(hash(name) % 2 ** 31)
+    x_np = rs.uniform(0.2, 0.8, size=(5,)).astype("float64")
+    x = mx.np.array(x_np, dtype="float64")  # f64: clean numeric truth
+    x.attach_grad()
+    fn = getattr(mx.np, name, None) or getattr(mx.npx, name)
+    with mx.autograd.record():
+        y = fn(x)
+    (dy,) = mx.autograd.grad(y, [x], create_graph=True)
+    dy.backward()
+    want = _SECOND_DERIVS[name](x_np)
+    onp.testing.assert_allclose(x.grad.asnumpy(), want, rtol=1e-5,
+                                atol=1e-7, err_msg=name)
