@@ -177,3 +177,42 @@ def test_libsvm_iter(tmp_path):
     assert b2.pad == 1
     with pytest.raises(StopIteration):
         next(it)
+
+
+# --- r5 tranche: reference test_image.py value families -----------------
+
+def test_scale_down_port():  # reference: test_image.py:170
+    assert mx.image.scale_down((640, 480), (720, 120)) == (640, 106)
+    assert mx.image.scale_down((360, 1000), (480, 500)) == (360, 375)
+    assert mx.image.scale_down((300, 400), (0, 0)) == (0, 0)
+
+
+def test_color_normalize_port():  # reference: test_image.py:214
+    rs = np.random.RandomState(0)
+    for _ in range(5):
+        mean = rs.rand(3) * 255
+        std = rs.rand(3) + 1
+        h, w = rs.randint(50, 120), rs.randint(50, 120)
+        src = rs.rand(h, w, 3) * 255.0
+        got = mx.image.color_normalize(
+            mx.nd.array(src.astype("f")),
+            mx.nd.array(mean.astype("f")),
+            mx.nd.array(std.astype("f")))
+        np.testing.assert_allclose(got.asnumpy(),
+                               (src - mean) / std, atol=1e-2)
+
+
+def test_imdecode_invalid_image_port():  # reference: test_image.py:166
+    with pytest.raises(Exception):
+        mx.image.imdecode(b"clearly not an image")
+
+
+def test_copy_make_border_port(img_file):  # reference: test_image.py:254
+    p, _ = img_file
+    img = mx.image.imread(p)
+    h, w = img.shape[0], img.shape[1]
+    out = mx.image.copyMakeBorder(img, 3, 2, 4, 1)
+    assert out.shape == (h + 5, w + 5, 3)
+    # interior pixels preserved
+    np.testing.assert_array_equal(
+        out.asnumpy()[3:3 + h, 4:4 + w], img.asnumpy())
