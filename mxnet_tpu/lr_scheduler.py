@@ -21,7 +21,12 @@ class LRScheduler:
             inc = (self.warmup_final_lr - self.warmup_begin_lr) * \
                 num_update / self.warmup_steps
             return self.warmup_begin_lr + inc
-        return self.warmup_final_lr * (num_update / self.warmup_steps) ** 2
+        if self.warmup_mode == "constant":
+            # reference lr_scheduler.py: hold warmup_begin_lr throughout
+            return self.warmup_begin_lr
+        raise ValueError(
+            f"Invalid warmup mode {self.warmup_mode!r} "
+            "(expected 'linear' or 'constant')")
 
     def __call__(self, num_update):
         raise NotImplementedError
@@ -40,7 +45,9 @@ class FactorScheduler(LRScheduler):
     def __call__(self, num_update):
         if num_update < self.warmup_steps:
             return self.get_warmup_lr(num_update)
-        exp = num_update // self.step
+        # reference counts with STRICT >: the drop lands after step+1
+        # updates (lr_scheduler.py FactorScheduler while-loop)
+        exp = max(0, (num_update - 1) // self.step)
         lr = self.base_lr * (self.factor ** exp)
         return max(lr, self.stop_factor_lr)
 
@@ -56,7 +63,7 @@ class MultiFactorScheduler(LRScheduler):
             return self.get_warmup_lr(num_update)
         lr = self.base_lr
         for s in self.step:
-            if num_update >= s:
+            if num_update > s:  # strict: no drop at exactly the step
                 lr *= self.factor
         return lr
 

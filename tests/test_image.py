@@ -203,7 +203,9 @@ def test_color_normalize_port():  # reference: test_image.py:214
 
 
 def test_imdecode_invalid_image_port():  # reference: test_image.py:166
-    with pytest.raises(Exception):
+    import PIL
+
+    with pytest.raises(PIL.UnidentifiedImageError):
         mx.image.imdecode(b"clearly not an image")
 
 

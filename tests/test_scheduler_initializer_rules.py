@@ -18,18 +18,22 @@ def test_factor_scheduler_decay_and_floor():
     s = lr_scheduler.FactorScheduler(step=10, factor=0.5, base_lr=1.0,
                                      stop_factor_lr=0.2)
     assert s(0) == 1.0
-    assert s(10) == 0.5
-    assert s(20) == 0.25
-    assert s(30) == 0.2  # clamped at stop_factor_lr (0.125 < 0.2)
+    # reference boundary convention: the drop lands AFTER step updates
+    # (strict >), i.e. at num_update=11, not 10
+    assert s(10) == 1.0
+    assert s(11) == 0.5
+    assert s(21) == 0.25
+    assert s(31) == 0.2  # clamped at stop_factor_lr (0.125 < 0.2)
 
 
 def test_multifactor_scheduler():
     s = lr_scheduler.MultiFactorScheduler(step=[5, 15], factor=0.1,
                                           base_lr=1.0)
     assert s(4) == 1.0
-    assert abs(s(5) - 0.1) < 1e-12
-    assert abs(s(14) - 0.1) < 1e-12
-    assert abs(s(15) - 0.01) < 1e-12
+    assert s(5) == 1.0  # strict >: no drop at exactly the step
+    assert abs(s(6) - 0.1) < 1e-12
+    assert abs(s(15) - 0.1) < 1e-12  # strict >: second drop after 15
+    assert abs(s(16) - 0.01) < 1e-12
 
 
 def test_poly_scheduler_endpoints():
@@ -256,3 +260,54 @@ def test_load_fallback_applies_default_verbatim():
     arr = mx.np.zeros((3,))
     ld("x_bias", arr)
     assert (arr.asnumpy() == 1.0).all()
+
+
+# --- r5 tranche: reference test_optimizer.py scheduler contracts --------
+
+def test_cosine_scheduler_port():  # reference: test_optimizer.py
+    sched = mx.lr_scheduler.CosineScheduler(1000, base_lr=3,
+                                            final_lr=0.1)
+    onp.testing.assert_almost_equal(sched(0), 3.0)
+    onp.testing.assert_almost_equal(sched(1000), 0.1)
+    assert sched(500) > 1.5
+
+
+def test_factor_scheduler_port():
+    sched = mx.lr_scheduler.FactorScheduler(
+        100, 0.1, stop_factor_lr=1e-4, base_lr=1,
+        warmup_steps=20, warmup_begin_lr=0.1, warmup_mode="constant")
+    assert sched(0) == 0.1
+    onp.testing.assert_almost_equal(sched(10), 0.1)
+    assert sched(21) == 1
+    onp.testing.assert_almost_equal(sched(101), 0.1)
+    onp.testing.assert_almost_equal(sched(201), 0.01)
+    onp.testing.assert_almost_equal(sched(1000), 1e-4)
+
+
+def test_multifactor_scheduler_port():
+    sched = mx.lr_scheduler.MultiFactorScheduler(
+        [15, 25], 0.1, base_lr=0.1,
+        warmup_steps=10, warmup_begin_lr=0.05, warmup_mode="linear")
+    assert sched(0) == 0.05
+    onp.testing.assert_almost_equal(sched(5), 0.05 + (0.1 - 0.05) / 10 * 5)
+    assert sched(10) == 0.1
+    assert sched(15) == 0.1
+    onp.testing.assert_almost_equal(sched(16), 0.01)
+    onp.testing.assert_almost_equal(sched(20), 0.01)
+    onp.testing.assert_almost_equal(sched(26), 0.001)
+
+
+def test_poly_scheduler_port():
+    sched = mx.lr_scheduler.PolyScheduler(
+        1000, base_lr=3, final_lr=0.1, pwr=2)
+    onp.testing.assert_almost_equal(sched(0), 3.0)
+    onp.testing.assert_almost_equal(sched(1000), 0.1)
+    assert sched(500) < 3.0 and sched(500) > 0.1
+
+
+def test_invalid_warmup_mode_is_loud():
+    s = lr_scheduler.FactorScheduler(step=100, base_lr=1.0,
+                                     warmup_steps=20,
+                                     warmup_mode="liner")  # typo
+    with pytest.raises(ValueError, match="warmup mode"):
+        s(5)
