@@ -119,12 +119,67 @@ def _sample_multinomial(data, shape=None, get_prob=False, dtype="int32",
     idx = idx.astype(dtype)
     if not get_prob:
         return NDArray(idx)
-    logp = jnp.log(jnp.maximum(p, 1e-30)).reshape(
-        batch + (1,) * len(S) + (k,))
+    pos = p > 0
+    # log of the NORMALIZED probability (indices are drawn from p/sum(p)),
+    # with the normalizer's gradient stopped: the reference VJP is exactly
+    # one-hot/p_raw (sample_multinomial_op.h), no -1/sum term. The
+    # double-where keeps the VJP exactly 0 at p==0 classes.
+    logz = jax.lax.stop_gradient(
+        jnp.log(jnp.sum(p, axis=-1, keepdims=True)))
+    logp = (jnp.where(pos, jnp.log(jnp.where(pos, p, 1.0)), -69.0)
+            - logz).reshape(batch + (1,) * len(S) + (k,))
     lp = jnp.take_along_axis(
         jnp.broadcast_to(logp, batch + S + (k,)), idx[..., None].astype(
             jnp.int32), axis=-1)[..., 0]
     return NDArray(idx), NDArray(lp)
+
+
+def _sample_unique_zipfian(range_max, shape=None, **kw):  # noqa: ARG001
+    """_sample_unique_zipfian: draw `shape[-1]` UNIQUE classes per batch
+    row from the log-uniform (Zipfian) distribution
+    P(k) = (log(k+2)-log(k+1)) / log(range_max+1), counting how many raw
+    draws each row needed (reference: sampler.h UniqueSampler +
+    random/unique_sample_op.cc — a CPU-only op there too; this sampler
+    is host-side numpy by design). Returns (classes, num_trials)."""
+    import math
+
+    import numpy as onp
+
+    from ..ndarray.ndarray import NDArray
+
+    S = _shape_tuple(shape)
+    if len(S) == 1:
+        S = (1,) + S
+    batch, num_sampled = S
+    if num_sampled > range_max:
+        raise ValueError(
+            f"cannot draw {num_sampled} unique classes from range_max="
+            f"{range_max}")
+    seed = int(jax.random.randint(_random.next_key(), (), 0, 2**31 - 1))
+    rs = onp.random.RandomState(seed)
+    log_range = math.log(range_max + 1)
+    classes = onp.empty((batch, num_sampled), onp.int64)
+    trials = onp.empty((batch,), onp.int64)
+    for i in range(batch):
+        draws = onp.empty((0,), onp.int64)
+        chunk = max(4 * num_sampled, 1024)
+        while True:
+            new = onp.exp(
+                rs.random_sample(chunk) * log_range).astype(onp.int64) - 1
+            draws = onp.concatenate(
+                [draws, onp.clip(new, 0, range_max - 1)])
+            uniq, first = onp.unique(draws, return_index=True)
+            if uniq.size >= num_sampled:
+                # trial count = position of the draw completing the set
+                order = onp.sort(first)
+                cut = order[num_sampled - 1]
+                trials[i] = cut + 1
+                keep = first <= cut
+                vals, idxs = uniq[keep], first[keep]
+                classes[i] = vals[onp.argsort(idxs)]
+                break
+            chunk *= 2
+    return NDArray(jnp.asarray(classes)), NDArray(jnp.asarray(trials))
 
 
 def _shuffle(data, **kw):  # noqa: ARG001
@@ -220,6 +275,7 @@ def install_legacy_random():
             _sampler("_sample_generalized_negative_binomial",
                      _draw_generalized_negative_binomial),
         "_sample_multinomial": _sample_multinomial,
+        "_sample_unique_zipfian": _sample_unique_zipfian,
         "_shuffle": _shuffle,
         "_random_pdf_uniform": _pdf("_random_pdf_uniform", _lp_uniform, 2),
         "_random_pdf_normal": _pdf("_random_pdf_normal", _lp_normal, 2),

@@ -228,3 +228,38 @@ def _cond_lower(ins, attrs):
 
 
 register_sym_op("_cond", _cond_lower)
+
+
+_zipfian_node_counter = [0]
+
+
+def rand_zipfian(true_classes, num_sampled, range_max, seed=None):
+    """Zipfian (log-uniform) candidate sampler, symbol form (reference:
+    python/mxnet/symbol/contrib.py:35 — a python composite over symbol
+    primitives there as well). P(class) = (log(class+2) - log(class+1))
+    / log(range_max+1). Returns (sampled int64 symbol,
+    expected_count_true, expected_count_sampled).
+
+    Symbol random nodes are pure functions of (shape, seed) — see
+    symbol/random.py. With seed=None each rand_zipfian call gets a fresh
+    construction-time seed, so two sampled-softmax heads in one graph
+    draw different candidate sets; pass an explicit seed to pin it."""
+    import math as _math
+
+    from .. import symbol as _S  # fully initialized at call time
+
+    if seed is None:
+        seed = _zipfian_node_counter[0]
+        _zipfian_node_counter[0] += 1
+    log_range = _math.log(range_max + 1)
+    rand = _S.random.uniform(low=0.0, high=log_range, shape=(num_sampled,),
+                             dtype="float64", seed=seed)
+    sampled = _S.cast(_S.exp(rand) - 1.0, dtype="int64") % range_max
+
+    true_f = _S.cast(true_classes, dtype="float64")
+    cnt_true = _S.log((true_f + 2.0) / (true_f + 1.0)) \
+        / log_range * num_sampled
+    sampled_f = _S.cast(sampled, dtype="float64")
+    cnt_sampled = _S.log((sampled_f + 2.0) / (sampled_f + 1.0)) \
+        / log_range * num_sampled
+    return sampled, cnt_true, cnt_sampled

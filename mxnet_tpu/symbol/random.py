@@ -26,12 +26,16 @@ def _shape(attrs):
     return tuple(int(d) for d in s)
 
 
+def _dt(attrs):
+    return jnp.dtype(str(attrs.get("dtype", "float32")))
+
+
 register_sym_op("random_uniform", lambda ins, a: jax.random.uniform(
-    _key(a), _shape(a), jnp.float32, float(a.get("low", 0.0)),
+    _key(a), _shape(a), _dt(a), float(a.get("low", 0.0)),
     float(a.get("high", 1.0))))
 register_sym_op("random_normal", lambda ins, a: (
     float(a.get("loc", 0.0)) + float(a.get("scale", 1.0))
-    * jax.random.normal(_key(a), _shape(a), jnp.float32)))
+    * jax.random.normal(_key(a), _shape(a), _dt(a))))
 register_sym_op("random_randint", lambda ins, a: jax.random.randint(
     _key(a), _shape(a), int(a.get("low", 0)), int(a.get("high", 2))))
 register_sym_op("random_gamma", lambda ins, a: jax.random.gamma(

@@ -297,6 +297,10 @@ class Symbol:
         the executor wraps a jitted function + jax.vjp)."""
         return Executor(self, args or {}, args_grad, grad_req)
 
+    # reference 2.x renamed bind -> _bind (symbol.py _bind); tests and
+    # migration guides use the underscore spelling
+    _bind = bind
+
     def simple_bind(self, ctx=None, grad_req="write", **shape_kwargs):
         args = {}
         for n in self.list_arguments():

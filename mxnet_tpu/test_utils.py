@@ -151,3 +151,63 @@ def check_consistency(fn, inputs, devices=None, rtol=None, atol=None):
     for r in results[1:]:
         assert_almost_equal(results[0], r, rtol=rtol, atol=atol)
     return results[0]
+
+
+def gen_buckets_probs_with_ppf(ppf, nbuckets):
+    """Split a distribution into `nbuckets` equal-probability buckets via
+    its quantile function (reference: test_utils.py:1976). Returns
+    ([(lo, hi), ...], [1/nbuckets, ...])."""
+    edges = [ppf(i / nbuckets) for i in range(nbuckets + 1)]
+    return (list(zip(edges[:-1], edges[1:])),
+            [1.0 / nbuckets] * nbuckets)
+
+
+def chi_square_check(generator, buckets, probs, nsamples=1000000):
+    """Chi-square goodness-of-fit of `generator(n)` samples against
+    bucket probabilities (reference: test_utils.py:2108). Continuous
+    buckets are (lo, hi) tuples; discrete buckets are the support values
+    themselves. Returns (p_value, observed, expected)."""
+    import scipy.stats as ss
+
+    samples = _np.asarray(generator(nsamples)).ravel()
+    if isinstance(buckets[0], (list, tuple)):
+        edges = _np.array([e for pair in buckets for e in pair],
+                          dtype=_np.float64)
+        ids = _np.searchsorted(edges, samples, side="right")
+        obs = _np.array([((ids == 2 * i + 1)).sum()
+                         for i in range(len(buckets))], dtype=_np.float64)
+    else:
+        obs = _np.array([(samples == b).sum() for b in buckets],
+                        dtype=_np.float64)
+    exp = _np.asarray(probs, dtype=_np.float64) * nsamples
+    # samples outside every bucket are a failure in their own right (a
+    # generator emitting out-of-support mass must not pass by having
+    # that mass silently dropped); tiny boundary leakage is tolerated
+    outside = nsamples - obs.sum()
+    if outside > max(nsamples * 1e-3, 3):
+        raise AssertionError(
+            f"{outside}/{nsamples} samples fell outside every bucket "
+            f"{buckets[:3]}...; observed in-bucket counts {obs}")
+    # rescale expected to the in-bucket total: scipy requires matched sums
+    exp = exp * (obs.sum() / exp.sum())
+    _, p = ss.chisquare(f_obs=obs, f_exp=exp)
+    return p, obs, exp
+
+
+def verify_generator(generator, buckets, probs, nsamples=1000000,
+                     nrepeat=5, success_rate=0.2, alpha=0.05):
+    """Repeat the chi-square check `nrepeat` times; at least
+    `success_rate` of the runs must clear p > alpha (reference:
+    test_utils.py:2186 — the statistical harness behind every
+    test_random.py generator test)."""
+    pvals = []
+    for _ in range(nrepeat):
+        p, obs, exp = chi_square_check(generator, buckets, probs,
+                                       nsamples=nsamples)
+        pvals.append(p)
+    successes = sum(p > alpha for p in pvals)
+    if successes < nrepeat * success_rate:
+        raise AssertionError(
+            f"generator failed the chi-square harness: p-values {pvals}, "
+            f"last observed {obs}, expected {exp}")
+    return pvals

@@ -1,19 +1,33 @@
 """TPU-vs-CPU numeric oracle (reference: test_utils.check_consistency —
 the CPU<->GPU comparison harness run by tests/python/gpu/test_operator_gpu.py).
 
-These tests execute real cross-backend comparisons when a TPU chip is
-reachable; on CPU-only CI they self-skip (the devices would alias). The
-driver's bench host has the chip, so this suite is the runnable oracle the
-round-1 verdict asked for."""
+The check bodies live in tests/_consistency_checks.py and are executed in
+a SUBPROCESS with the environment's real platform stack: the conftest
+pins this pytest process to CPU for hermeticity, under which `tpu(0)`
+would fall back to the host and the "cross-backend" comparison would
+silently alias to CPU-vs-CPU. The subprocess sees the axon/TPU plugin,
+so `tpu(0)` is the chip and the oracle is real. On CPU-only CI the probe
+fails and the suite self-skips."""
+import json
+import os
 import subprocess
 import sys
 
-import numpy as onp
 import pytest
 
-import mxnet_tpu as mx
-from mxnet_tpu import test_utils
-from mxnet_tpu.device import cpu, tpu
+_HERE = os.path.dirname(os.path.abspath(__file__))
+
+
+def _clean_env():
+    env = {k: v for k, v in os.environ.items() if k != "JAX_PLATFORMS"}
+    # drop the CPU-mesh flag too: the subprocess should look like the
+    # driver's bench environment, not the test harness
+    flags = env.get("XLA_FLAGS", "")
+    if "host_platform_device_count" in flags:
+        env["XLA_FLAGS"] = " ".join(
+            f for f in flags.split()
+            if "host_platform_device_count" not in f)
+    return env
 
 
 def _tpu_reachable():
@@ -22,9 +36,7 @@ def _tpu_reachable():
         out = subprocess.run(
             [sys.executable, "-c",
              "import jax; print(jax.devices()[0].platform)"],
-            capture_output=True, timeout=60, text=True,
-            env={k: v for k, v in __import__("os").environ.items()
-                 if k != "JAX_PLATFORMS"})
+            capture_output=True, timeout=60, text=True, env=_clean_env())
         return out.returncode == 0 and "cpu" not in out.stdout
     except subprocess.TimeoutExpired:
         return False
@@ -34,51 +46,39 @@ HAS_TPU = _tpu_reachable()
 requires_tpu = pytest.mark.skipif(
     not HAS_TPU, reason="no reachable TPU: cross-backend oracle skipped")
 
+_CACHE = {}
+
+
+def _results():
+    """Run every check once in one subprocess (each spawn pays the tunnel
+    import+compile cost); cache for the session."""
+    if "r" not in _CACHE:
+        out = subprocess.run(
+            [sys.executable, os.path.join(_HERE, "_consistency_checks.py")],
+            capture_output=True, timeout=900, text=True, env=_clean_env())
+        assert out.returncode == 0, (
+            f"consistency subprocess died: {out.stderr[-2000:]}")
+        line = out.stdout.strip().splitlines()[-1]
+        _CACHE["r"] = json.loads(line)
+    return _CACHE["r"]
+
 
 @requires_tpu
 class TestTpuCpuConsistency:
+    def test_backends_genuinely_distinct(self):
+        r = _results()
+        assert r["platform"] != "cpu", r
+        assert r["devices_distinct"], (
+            "tpu(0) aliased to the host — oracle would be vacuous")
+
     def test_matmul(self):
-        rs = onp.random.RandomState(0)
-        a = rs.rand(32, 64).astype("float32")
-        b = rs.rand(64, 16).astype("float32")
-        test_utils.check_consistency(
-            lambda x, y: mx.np.matmul(x, y), [a, b],
-            devices=[cpu(0), tpu(0)], rtol=1e-4, atol=1e-4)
+        assert _results()["matmul"] == "ok", _results()
 
     def test_conv_bn_relu(self):
-        from mxnet_tpu import numpy_extension as npx
-
-        rs = onp.random.RandomState(1)
-        x = rs.rand(2, 8, 16, 16).astype("float32")
-        w = rs.rand(4, 8, 3, 3).astype("float32")
-
-        def f(xd, wd):
-            y = npx.convolution(xd, wd, stride=(1, 1), pad=(1, 1))
-            return npx.activation(y, "relu")
-
-        test_utils.check_consistency(f, [x, w], devices=[cpu(0), tpu(0)],
-                                     rtol=1e-3, atol=1e-3)
+        assert _results()["conv_bn_relu"] == "ok", _results()
 
     def test_softmax_reduce(self):
-        rs = onp.random.RandomState(2)
-        x = rs.rand(8, 100).astype("float32") * 10
-
-        def f(xd):
-            from mxnet_tpu import numpy_extension as npx
-
-            return npx.softmax(xd, axis=-1).sum(axis=0)
-
-        test_utils.check_consistency(f, [x], devices=[cpu(0), tpu(0)],
-                                     rtol=1e-4, atol=1e-5)
+        assert _results()["softmax_reduce"] == "ok", _results()
 
     def test_bf16_matmul_tolerance(self):
-        """bf16-on-TPU vs f32-on-CPU within bf16 tolerance (the dtype
-        dimension of the reference oracle)."""
-        rs = onp.random.RandomState(3)
-        a = rs.rand(16, 32).astype("float32")
-        b = rs.rand(32, 8).astype("float32")
-        ref = a @ b
-        xa = mx.np.array(a, device=tpu(0)).astype("bfloat16")
-        xb = mx.np.array(b, device=tpu(0)).astype("bfloat16")
-        got = mx.np.matmul(xa, xb).astype("float32").asnumpy()
-        onp.testing.assert_allclose(got, ref, rtol=5e-2, atol=5e-2)
+        assert _results()["bf16_matmul_tolerance"] == "ok", _results()

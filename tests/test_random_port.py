@@ -1,0 +1,413 @@
+"""Random-sampling oracle tranche (reference:
+tests/python/unittest/test_random.py — the generator chi-square harness,
+seed determinism, multinomial REINFORCE gradients, shuffle permutation
+laws, zipfian candidate samplers, and zero-size contracts)."""
+import numpy as np
+import pytest
+import scipy.stats as ss
+
+import mxnet_tpu as mx
+from mxnet_tpu.test_utils import (
+    gen_buckets_probs_with_ppf,
+    verify_generator,
+)
+
+# the reference runs 1e6-sample chi-square cells; 2e5 keeps the same
+# statistical teeth (p-values are n-independent under H0) at CPU-suite
+# speed
+NSAMPLES = 200000
+NREPEAT = 3
+
+
+def setup_function(_f):
+    mx.random.seed(42)
+
+
+# ---- seed determinism (reference test_random.py:420) ---------------------
+
+def _set_seed_variously(init_seed, num_init_seeds, final_seed):
+    end_seed = init_seed + num_init_seeds
+    for seed in range(init_seed, end_seed):
+        mx.random.seed(seed)
+    mx.random.seed(final_seed)
+    return end_seed
+
+
+def test_random_seed_setting():
+    probs = [0.125, 0.25, 0.25, 0.0625, 0.125, 0.1875]
+    num_samples = 10000
+    seed = _set_seed_variously(1, 25, 1234)
+    samples1 = mx.nd.random.multinomial(
+        data=mx.nd.array(probs), shape=num_samples)
+    seed = _set_seed_variously(seed, 25, 1234)
+    samples2 = mx.nd.random.multinomial(
+        data=mx.nd.array(probs), shape=num_samples)
+    s1 = samples1.asnumpy()
+    _set_seed_variously(seed, 25, 1235)
+    s2 = samples2.asnumpy()
+    assert (s1 == s2).all()
+    # a different seed must give a different draw
+    mx.random.seed(99)
+    s3 = mx.nd.random.multinomial(
+        data=mx.nd.array(probs), shape=num_samples).asnumpy()
+    assert not (s1 == s3).all()
+
+
+def test_seed_ctx_kwarg_parity():
+    # reference seeds per-device with ctx=...; API accepted here (one
+    # logical device namespace under jax threefry keys)
+    mx.random.seed(7, ctx="all")
+    a = mx.nd.random.uniform(shape=(5,)).asnumpy()
+    mx.random.seed(7, ctx="all")
+    b = mx.nd.random.uniform(shape=(5,)).asnumpy()
+    assert (a == b).all()
+
+
+def test_uniform_normal_seed_determinism():
+    mx.random.seed(1234)
+    u1 = mx.nd.random.uniform(shape=(100,)).asnumpy()
+    n1 = mx.nd.random.normal(shape=(100,)).asnumpy()
+    mx.random.seed(1234)
+    u2 = mx.nd.random.uniform(shape=(100,)).asnumpy()
+    n2 = mx.nd.random.normal(shape=(100,)).asnumpy()
+    assert (u1 == u2).all() and (n1 == n2).all()
+
+
+# ---- sample_multinomial (reference test_random.py:569) -------------------
+
+@pytest.mark.parametrize("dtype", ["int32", "float32"])
+@pytest.mark.parametrize(
+    "x", [[[0, 1, 2, 3, 4], [4, 3, 2, 1, 0]], [0, 1, 2, 3, 4]])
+def test_sample_multinomial(dtype, x):
+    x = mx.nd.array(x) / 10.0
+    dx = mx.nd.ones_like(x)
+    mx.autograd.mark_variables([x], [dx])
+    samples = 10000
+    with mx.autograd.record():
+        y, prob = mx.nd.random.multinomial(
+            x, shape=samples, get_prob=True, dtype=dtype)
+        r = prob * 5
+        r.backward()
+
+    assert np.dtype(dtype) == y.dtype
+    y = y.asnumpy()
+    xn = x.asnumpy()
+    dxn = dx.asnumpy()
+    probn = prob.asnumpy()
+    if xn.ndim == 1:
+        xn, dxn = xn[None], dxn[None]
+        y, probn = y[None], probn[None]
+    for i in range(xn.shape[0]):
+        freq = (np.bincount(y[i].astype("int32"), minlength=5)
+                / np.float32(samples) * xn[i].sum())
+        np.testing.assert_allclose(freq, xn[i], rtol=0.20, atol=1e-1)
+        rprob = xn[i][y[i].astype("int32")] / xn[i].sum()
+        np.testing.assert_allclose(np.log(rprob), probn[i], atol=1e-5)
+        real_dx = np.zeros((5,))
+        for j in range(samples):
+            real_dx[int(y[i][j])] += 5.0 / rprob[j]
+        np.testing.assert_allclose(real_dx, dxn[i], rtol=1e-3, atol=1e-5)
+
+
+def test_sample_multinomial_num_outputs():
+    # reference test_random.py:1025
+    probs = mx.nd.array([[0.125, 0.25, 0.25, 0.0625, 0.125, 0.1875]])
+    out = mx.nd.random.multinomial(data=probs, shape=10000, get_prob=False)
+    assert isinstance(out, mx.nd.NDArray)
+    out = mx.nd.random.multinomial(data=probs, shape=10000, get_prob=True)
+    assert isinstance(out, (list, tuple)) and len(out) == 2
+
+
+# ---- generator chi-square cells (reference test_random.py:602-760) -------
+
+def test_normal_generator():
+    for mu, sigma in [(0.0, 1.0), (1.0, 5.0)]:
+        buckets, probs = gen_buckets_probs_with_ppf(
+            lambda x: ss.norm.ppf(x, mu, sigma), 5)
+        verify_generator(
+            lambda n: mx.nd.random.normal(mu, sigma, shape=n).asnumpy(),
+            buckets, probs, nsamples=NSAMPLES, nrepeat=NREPEAT)
+
+
+def test_uniform_generator():
+    for low, high in [(-1.0, 1.0), (1.0, 3.0)]:
+        scale = high - low
+        buckets, probs = gen_buckets_probs_with_ppf(
+            lambda x: ss.uniform.ppf(x, loc=low, scale=scale), 5)
+        verify_generator(
+            lambda n: mx.nd.random.uniform(low, high, shape=n).asnumpy(),
+            buckets, probs, nsamples=NSAMPLES, nrepeat=NREPEAT)
+
+
+def test_gamma_generator():
+    for kappa, theta in [(0.5, 1.0), (1.0, 5.0)]:
+        buckets, probs = gen_buckets_probs_with_ppf(
+            lambda x: ss.gamma.ppf(x, a=kappa, loc=0, scale=theta), 5)
+        verify_generator(
+            lambda n: mx.nd.random.gamma(kappa, theta, shape=n).asnumpy(),
+            buckets, probs, nsamples=NSAMPLES, nrepeat=NREPEAT,
+            success_rate=0.05)
+
+
+def test_exponential_generator():
+    for scale in [0.1, 1.0]:
+        buckets, probs = gen_buckets_probs_with_ppf(
+            lambda x: ss.expon.ppf(x, loc=0, scale=scale), 5)
+        verify_generator(
+            lambda n: mx.nd.random.exponential(scale, shape=n).asnumpy(),
+            buckets, probs, nsamples=NSAMPLES, nrepeat=NREPEAT,
+            success_rate=0.20)
+
+
+def test_poisson_generator():
+    for lam in [1, 10]:
+        buckets = [(-1.0, lam - 0.5), (lam - 0.5, 2 * lam + 0.5),
+                   (2 * lam + 0.5, np.inf)]
+        probs = [ss.poisson.cdf(b[1], lam) - ss.poisson.cdf(b[0], lam)
+                 for b in buckets]
+        verify_generator(
+            lambda n: mx.nd.random.poisson(lam, shape=n).asnumpy(),
+            buckets, probs, nsamples=NSAMPLES, nrepeat=NREPEAT)
+
+
+def test_negative_binomial_generator():
+    k, p = 2, 0.2
+    buckets = [(-1.0, 2.5), (2.5, 5.5), (5.5, 8.5), (8.5, np.inf)]
+    probs = [ss.nbinom.cdf(b[1], k, p) - ss.nbinom.cdf(b[0], k, p)
+             for b in buckets]
+    verify_generator(
+        lambda n: mx.nd.random.negative_binomial(k, p, shape=n).asnumpy(),
+        buckets, probs, nsamples=NSAMPLES, nrepeat=NREPEAT)
+
+
+def test_generalized_negative_binomial_moments():
+    mu, alpha = 2.0, 0.3
+    s = mx.nd.random.generalized_negative_binomial(
+        mu=mu, alpha=alpha, shape=(NSAMPLES,)).asnumpy()
+    np.testing.assert_allclose(s.mean(), mu, rtol=0.05)
+    np.testing.assert_allclose(s.var(), mu + alpha * mu * mu, rtol=0.10)
+
+
+def test_multinomial_generator():
+    probs = [0.1, 0.2, 0.25, 0.25, 0.2]
+    buckets = list(range(5))
+    verify_generator(
+        lambda n: mx.nd.random.multinomial(
+            mx.nd.array(probs), shape=n).asnumpy(),
+        buckets, probs, nsamples=NSAMPLES, nrepeat=NREPEAT)
+
+
+# ---- shuffle (reference test_random.py:897) ------------------------------
+
+def _check_first_axis_shuffle(arr):
+    stride = int(arr.size / arr.shape[0])
+    column0 = arr.reshape((arr.size,))[::stride]
+    seq = mx.nd.arange(0, arr.size - stride + 1, stride)
+    assert (column0.sort() == seq).prod() == 1
+    if stride > 1:
+        ascending_seq = mx.nd.arange(0, stride)
+        equalized_columns = arr.reshape((arr.shape[0], stride)) \
+            - ascending_seq
+        column0_2d = column0.reshape((arr.shape[0], 1))
+        assert (column0_2d == equalized_columns).prod() == 1
+
+
+def test_shuffle_first_axis():
+    for shape in [(10,), (5, 4), (3, 2, 2)]:
+        data = mx.nd.arange(0, np.prod(shape)).reshape(shape)
+        for _ in range(5):
+            _check_first_axis_shuffle(mx.nd.random.shuffle(data))
+
+
+def test_shuffle_uniformity():
+    # all 3! = 6 permutations of a 3-row array should appear with
+    # roughly equal frequency (reference testSmall)
+    data = mx.nd.arange(0, 3)
+    repeat = 1200
+    counts = {}
+    for _ in range(repeat):
+        out = tuple(mx.nd.random.shuffle(data).asnumpy().astype(int))
+        counts[out] = counts.get(out, 0) + 1
+    assert len(counts) == 6, counts
+    for perm, c in counts.items():
+        assert abs(c / repeat - 1 / 6) < 0.07, counts
+
+
+# ---- randint (reference test_random.py:976-1024) -------------------------
+
+def test_randint():
+    for dtype in ["int32", "int64"]:
+        s = mx.nd.random.randint(-10, 10, shape=(10000,), dtype=dtype)
+        assert str(s.dtype).endswith(dtype)
+        a = s.asnumpy()
+        assert a.min() >= -10 and a.max() < 10
+        # both endpoints of the half-open range get hit
+        assert (a == -10).any() and (a == 9).any()
+
+
+def test_randint_extremes():
+    # reference test_random.py:994 draws near the int64 extremes
+    s = mx.nd.random.randint(
+        2 ** 40, 2 ** 40 + 4, shape=(100,), dtype="int64").asnumpy()
+    assert s.min() >= 2 ** 40 and s.max() < 2 ** 40 + 4
+
+
+def test_randint_without_dtype():
+    # reference test_random.py:1019 — default index dtype is int32
+    s = mx.nd.random.randint(0, 100, shape=(5,))
+    assert str(s.dtype).endswith("int32")
+
+
+def test_randint_generator():
+    low, high = -100, 100
+    n_bins = 10
+    step = (high - low) // n_bins
+    buckets = [(low + i * step - 0.5, low + (i + 1) * step - 0.5)
+               for i in range(n_bins)]
+    probs = [1.0 / n_bins] * n_bins
+    verify_generator(
+        lambda n: mx.nd.random.randint(
+            low, high, shape=n).asnumpy().astype(np.float64),
+        buckets, probs, nsamples=NSAMPLES, nrepeat=NREPEAT)
+
+
+# ---- dirichlet + zero-size contracts (reference :374, :1036, :1064) ------
+
+def test_dirichlet():
+    alpha = np.array([3.0, 4.0, 5.0])
+    s = mx.np.random.dirichlet(tuple(alpha), size=(NSAMPLES // 40,))
+    sn = s.asnumpy()
+    assert sn.shape == (NSAMPLES // 40, 3)
+    np.testing.assert_allclose(sn.sum(-1), 1.0, rtol=1e-5)
+    np.testing.assert_allclose(sn.mean(0), alpha / alpha.sum(), atol=5e-3)
+
+
+def test_dirichlet_zero_size_dim():
+    assert mx.np.random.dirichlet((1.0, 2.0), size=(0,)).shape == (0, 2)
+    assert mx.np.random.dirichlet((1.0, 2.0),
+                                  size=(0, 3)).shape == (0, 3, 2)
+
+
+def test_poisson_zero_size_dim():
+    assert mx.nd.random.poisson(1.0, shape=(0,)).shape == (0,)
+    assert mx.nd.random.poisson(1.0, shape=(0, 5)).shape == (0, 5)
+
+
+# ---- zipfian candidate samplers (reference :848, :865) -------------------
+
+def test_unique_zipfian_generator():
+    num_sampled = 8192
+    range_max = 793472
+    batch_size = 4
+    classes, num_trials = mx.nd._internal._sample_unique_zipfian(
+        range_max, shape=(batch_size, num_sampled))
+    for i in range(batch_size):
+        assert np.unique(classes[i].asnumpy()).size == num_sampled
+        t = num_trials[i].asscalar()
+        # reference band, obtained from the pytorch implementation
+        assert 14500 < t < 17000, t
+
+
+def _zipfian_expected_counts(range_max, num_sampled):
+    classes = np.arange(0, range_max)
+    return (np.log((classes + 2) / (classes + 1))
+            / np.log(range_max + 1)) * num_sampled
+
+
+def test_zipfian_generator_nd():
+    num_true, num_sampled, range_max = 5, 1000, 20
+    exp_cnt = _zipfian_expected_counts(range_max, num_sampled)
+    true_classes = mx.nd.random.uniform(
+        0, range_max, shape=(num_true,)).astype("int32")
+    sampled, cnt_true, cnt_sampled = mx.nd.contrib.rand_zipfian(
+        true_classes, num_sampled, range_max)
+    np.testing.assert_allclose(
+        cnt_sampled.asnumpy(), exp_cnt[sampled.asnumpy()],
+        rtol=1e-1, atol=1e-2)
+    np.testing.assert_allclose(
+        cnt_true.asnumpy(), exp_cnt[true_classes.asnumpy()],
+        rtol=1e-1, atol=1e-2)
+    # samples live in [0, range_max)
+    assert sampled.asnumpy().min() >= 0
+    assert sampled.asnumpy().max() < range_max
+
+
+def test_zipfian_generator_sym():
+    num_true, num_sampled, range_max = 5, 1000, 20
+    exp_cnt = _zipfian_expected_counts(range_max, num_sampled)
+    true_classes = mx.nd.random.uniform(
+        0, range_max, shape=(num_true,)).astype("int32")
+    tc_var = mx.sym.var("true_classes")
+    outputs = mx.sym.Group(
+        list(mx.sym.contrib.rand_zipfian(tc_var, num_sampled, range_max)))
+    executor = outputs._bind(mx.cpu(), {"true_classes": true_classes})
+    executor.forward()
+    sampled, cnt_true, cnt_sampled = executor.outputs
+    np.testing.assert_allclose(
+        cnt_sampled.asnumpy(), exp_cnt[sampled.asnumpy()],
+        rtol=1e-1, atol=1e-2)
+    np.testing.assert_allclose(
+        cnt_true.asnumpy(), exp_cnt[true_classes.asnumpy()],
+        rtol=1e-1, atol=1e-2)
+
+
+# ---- review-hardening regressions ----------------------------------------
+
+def test_multinomial_unnormalized_logp():
+    # indices are drawn from p/sum(p); the returned log-prob must be of
+    # the NORMALIZED distribution while the VJP stays one-hot/p_raw
+    # (reference sample_multinomial_op.h backward)
+    x = mx.nd.array([[2.0, 2.0]])
+    dx = mx.nd.zeros_like(x)
+    mx.autograd.mark_variables([x], [dx])
+    with mx.autograd.record():
+        y, prob = mx.nd.random.multinomial(x, shape=1000, get_prob=True)
+        prob.backward()
+    np.testing.assert_allclose(prob.asnumpy(), np.log(0.5), atol=1e-6)
+    cnt = np.bincount(y.asnumpy()[0], minlength=2)
+    np.testing.assert_allclose(dx.asnumpy()[0], cnt / 2.0, rtol=1e-5)
+    _, p2 = mx.nd._internal._sample_multinomial(
+        mx.nd.array([[2.0, 2.0]]), shape=(50,), get_prob=True)
+    np.testing.assert_allclose(p2.asnumpy(), np.log(0.5), atol=1e-6)
+
+
+def test_sym_random_dtype_honored():
+    u = mx.sym.random.uniform(low=0.0, high=1.0, shape=(4,),
+                              dtype="float64")
+    ex = u._bind(mx.cpu(), {})
+    ex.forward()
+    assert str(ex.outputs[0].dtype).endswith("float64")
+
+
+def test_zipfian_heads_draw_distinct_candidates():
+    # two sampled-softmax heads in one graph must not share candidates;
+    # an explicit seed pins the draw
+    t = mx.nd.array([1]).astype("int32")
+
+    def run(sym):
+        ex = mx.sym.Group([sym])._bind(mx.cpu(), {"t": t})
+        ex.forward()
+        return ex.outputs[0].asnumpy()
+
+    a = run(mx.sym.contrib.rand_zipfian(mx.sym.var("t"), 100, 1000)[0])
+    b = run(mx.sym.contrib.rand_zipfian(mx.sym.var("t"), 100, 1000)[0])
+    assert not (a == b).all()
+    c = run(mx.sym.contrib.rand_zipfian(mx.sym.var("t"), 100, 1000,
+                                        seed=5)[0])
+    d = run(mx.sym.contrib.rand_zipfian(mx.sym.var("t"), 100, 1000,
+                                        seed=5)[0])
+    assert (c == d).all()
+
+
+def test_chi_square_check_rejects_out_of_support_mass():
+    from mxnet_tpu.test_utils import verify_generator as vg
+
+    def broken(n):
+        s = np.random.RandomState(0).uniform(-1, 1, n)
+        s[: n // 3] = 5.0  # 33% of mass outside every bucket
+        return s
+
+    buckets = [(-1.0, -0.5), (-0.5, 0.0), (0.0, 0.5), (0.5, 1.0)]
+    with pytest.raises(AssertionError):
+        vg(broken, buckets, [0.25] * 4, nsamples=10000, nrepeat=1,
+           success_rate=1.0)

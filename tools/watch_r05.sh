@@ -1,0 +1,55 @@
+#!/bin/bash
+# Round-5 revival watcher: probe the tunnel; the moment it answers,
+# capture everything still missing from the round-5 evidence set —
+# evidence_bundle cells (headline + A/B matrix + perf_lab step/profile),
+# fwd/fwdbwd attribution timings, and the cross-backend consistency
+# oracles. Flap-safe: completed cells are skipped on the next revival.
+cd "$(dirname "$0")/.." || exit 1
+OUT=${1:-bench_r05_evidence}
+LOG="$OUT/watch.log"
+POLL_S=${POLL_S:-120}
+mkdir -p "$OUT"
+
+all_done() {
+    for f in headline.json perf_lab_step.txt perf_lab_fwd.txt \
+             perf_lab_fwdbwd.txt ab_bn_bf16.json ab_mp0.json \
+             ab_s2d0.json ab_nchw.json consistency.json; do
+        [ -s "$OUT/$f" ] || return 1
+    done
+    return 0
+}
+
+while ! all_done; do
+    p=$(timeout 90 python -c \
+        "import jax; print(jax.devices()[0].platform)" 2>/dev/null | tail -1)
+    if [ "$p" != "tpu" ]; then
+        echo "$(date -u +%FT%TZ) dark" >> "$LOG"
+        sleep "$POLL_S"
+        continue
+    fi
+    echo "$(date -u +%FT%TZ) ALIVE — capturing missing cells" >> "$LOG"
+    bash tools/evidence_bundle.sh "$OUT" >> "$LOG" 2>&1
+    for m in fwd fwdbwd; do
+        f="$OUT/perf_lab_$m.txt"
+        [ -s "$f" ] && continue
+        if timeout 300 python tools/perf_lab.py NHWC 256 "$m" \
+                > "$f.tmp" 2>> "$LOG" \
+                && grep -q '"platform": "tpu"' "$f.tmp"; then
+            mv "$f.tmp" "$f"; echo "captured $f" >> "$LOG"
+        else
+            rm -f "$f.tmp"; echo "FAILED $f" >> "$LOG"
+        fi
+    done
+    if [ ! -s "$OUT/consistency.json" ]; then
+        env -u JAX_PLATFORMS timeout 900 \
+            python tests/_consistency_checks.py \
+            > "$OUT/consistency.json.tmp" 2>> "$LOG" \
+            && grep -q '"platform"' "$OUT/consistency.json.tmp" \
+            && ! grep -q '"platform": "cpu"' "$OUT/consistency.json.tmp" \
+            && mv "$OUT/consistency.json.tmp" "$OUT/consistency.json" \
+            && echo "captured consistency" >> "$LOG" \
+            || rm -f "$OUT/consistency.json.tmp"
+    fi
+    sleep 5
+done
+echo "$(date -u +%FT%TZ) ALL CELLS CAPTURED" >> "$LOG"
