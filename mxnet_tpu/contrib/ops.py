@@ -856,6 +856,123 @@ def psroi_pooling(data, rois, spatial_scale, output_dim, pooled_size,
     return apply_op(pure, data, rois, name="psroi_pooling")
 
 
+def deformable_psroi_pooling(data, rois, trans=None, spatial_scale=1.0,
+                             output_dim=1, group_size=1, pooled_size=1,
+                             part_size=0, sample_per_part=1, trans_std=0.0,
+                             no_trans=False):
+    """Deformable position-sensitive ROI pooling (reference:
+    deformable_psroi_pooling.cc/.cu DeformablePSROIPoolForwardKernel —
+    Deformable ConvNets). Each pooled bin averages `sample_per_part`^2
+    bilinear samples whose window is shifted by the learned `trans`
+    offsets (scaled by trans_std and the ROI extent); channels map
+    position-sensitively exactly as in psroi_pooling. Differentiable in
+    both `data` and `trans`."""
+    P = int(pooled_size)
+    G = int(group_size)
+    spp = int(sample_per_part)
+    part = int(part_size) or P
+    no_trans = bool(no_trans) or trans is None
+
+    def pure(x, r, *maybe_t):
+        t = maybe_t[0] if maybe_t else None
+        n, c, h, w = x.shape
+        if c != output_dim * G * G:
+            # the reference fails shape inference here; jax clamp-mode
+            # gather would silently return wrong activations instead
+            raise ValueError(
+                f"deformable_psroi_pooling: data has {c} channels but "
+                f"output_dim*group_size^2 = {output_dim * G * G}")
+        num_classes = 1 if no_trans else t.shape[1] // 2
+        if not no_trans and (
+                t.ndim != 4 or t.shape[0] != r.shape[0]
+                or t.shape[1] % 2 or t.shape[2:] != (part, part)):
+            raise ValueError(
+                f"deformable_psroi_pooling: trans must be "
+                f"(num_rois, 2*num_classes, {part}, {part}); got {t.shape}")
+        ch_each = max(output_dim // num_classes, 1)
+
+        def bilinear(img2d, hh, ww):
+            # img2d (H,W); hh/ww scalars already clipped into the image
+            h0 = jnp.floor(hh)
+            w0 = jnp.floor(ww)
+            ah = hh - h0
+            aw = ww - w0
+            h0 = h0.astype(jnp.int32)
+            w0 = w0.astype(jnp.int32)
+            h1 = jnp.minimum(h0 + 1, h - 1)
+            w1 = jnp.minimum(w0 + 1, w - 1)
+            return (img2d[h0, w0] * (1 - ah) * (1 - aw)
+                    + img2d[h0, w1] * (1 - ah) * aw
+                    + img2d[h1, w0] * ah * (1 - aw)
+                    + img2d[h1, w1] * ah * aw)
+
+        def one_roi(roi, t_roi):
+            bidx = roi[0].astype(jnp.int32)
+            # reference rounds the ROI to pixels, then widens by 1 and
+            # recenters by 0.5 (deformable_psroi_pooling.cu:71-76)
+            x1 = jnp.round(roi[1]) * spatial_scale - 0.5
+            y1 = jnp.round(roi[2]) * spatial_scale - 0.5
+            x2 = (jnp.round(roi[3]) + 1.0) * spatial_scale - 0.5
+            y2 = (jnp.round(roi[4]) + 1.0) * spatial_scale - 0.5
+            rw = jnp.maximum(x2 - x1, 0.1)
+            rh = jnp.maximum(y2 - y1, 0.1)
+            bin_h, bin_w = rh / P, rw / P
+            sub_h, sub_w = bin_h / spp, bin_w / spp
+            img = x[bidx]
+            ctop = jnp.arange(output_dim)
+            cls = ctop // ch_each
+            rows = []
+            for ph in range(P):
+                row = []
+                for pw in range(P):
+                    part_h = min(ph * part // P, part - 1)
+                    part_w = min(pw * part // P, part - 1)
+                    if no_trans:
+                        tx = jnp.zeros((output_dim,), x.dtype)
+                        ty = jnp.zeros((output_dim,), x.dtype)
+                    else:
+                        tx = t_roi[cls * 2, part_h, part_w] * trans_std
+                        ty = t_roi[cls * 2 + 1, part_h, part_w] * trans_std
+                    wstart = pw * bin_w + x1 + tx * rw
+                    hstart = ph * bin_h + y1 + ty * rh
+                    gh = min(ph * G // P, G - 1)
+                    gw = min(pw * G // P, G - 1)
+                    chans = (ctop * G + gh) * G + gw
+                    acc = jnp.zeros((output_dim,), x.dtype)
+                    cnt = jnp.zeros((output_dim,), x.dtype)
+                    for ih in range(spp):
+                        for iw in range(spp):
+                            ww = wstart + iw * sub_w
+                            hh = hstart + ih * sub_h
+                            ok = ((ww >= -0.5) & (ww <= w - 0.5)
+                                  & (hh >= -0.5) & (hh <= h - 0.5))
+                            wc = jnp.clip(ww, 0.0, w - 1.0)
+                            hc = jnp.clip(hh, 0.0, h - 1.0)
+                            val = jax.vmap(
+                                lambda ci, hi, wi: bilinear(
+                                    img[ci], hi, wi)
+                            )(chans, hc, wc)
+                            acc = acc + jnp.where(ok, val, 0.0)
+                            cnt = cnt + ok.astype(x.dtype)
+                    row.append(acc / jnp.maximum(cnt, 1.0))
+                rows.append(jnp.stack(row, axis=-1))
+            return jnp.stack(rows, axis=-2)  # (output_dim, P, P)
+
+        if no_trans:
+            tz = jnp.zeros((r.shape[0], 2, part, part), x.dtype)
+            return jax.vmap(lambda roi, tr: one_roi(roi, tr))(
+                r.astype(x.dtype), tz)
+        return jax.vmap(one_roi)(r.astype(x.dtype), t)
+
+    if no_trans:
+        return apply_op(pure, data, rois, name="deformable_psroi_pooling")
+    return apply_op(pure, data, rois, trans,
+                    name="deformable_psroi_pooling")
+
+
+DeformablePSROIPooling = deformable_psroi_pooling
+
+
 # --- RPN proposals (reference: proposal.cc / multi_proposal.cc) ------------
 
 def _generate_anchors(base_size, scales, ratios):
@@ -973,7 +1090,7 @@ BilinearResize2D = bilinear_resize_2d
 PSROIPooling = psroi_pooling
 Proposal = proposal
 __all__ += ["AdaptiveAvgPooling2D", "BilinearResize2D", "PSROIPooling",
-            "Proposal"]
+            "Proposal", "deformable_psroi_pooling", "DeformablePSROIPooling"]
 
 
 # --- DGL graph ops (reference: src/operator/contrib/dgl_graph.cc) ----------

@@ -77,19 +77,11 @@ def multinomial(data, shape=(), get_prob=False, dtype="int32"):
         if isinstance(data, NDArray) else NDArray(pure(_jnp.asarray(data)))
     if get_prob:
         def prob_pure(p, s):
-            # double-where safe log: the REINFORCE VJP must be exactly 0
-            # at p==0 classes (never sampled; reference accumulates
-            # 1/p_y only at sampled indices — test_random.py:569), and a
-            # plain maximum(p, tiny) floor NaNs there (tiny flushes to 0
-            # subnormal on TPU, giving grad-of-log-at-0). The normalizer
-            # (sampling draws from p/sum via categorical softmax) is
-            # stop_gradient-ed: the reference VJP is one-hot/p_raw with
-            # no -1/sum term (sample_multinomial_op.h).
-            pos = p > 0
-            logz = _jax.lax.stop_gradient(
-                _jnp.log(_jnp.sum(p, axis=-1, keepdims=True)))
-            logits = _jnp.where(pos, _jnp.log(_jnp.where(pos, p, 1.0)),
-                                -87.0) - logz
+            # shared kernel: true log-prob forward, reference one-hot/p
+            # VJP with zero gradient at p==0 classes
+            from ..ops.random_legacy import multinomial_logp
+
+            logits = multinomial_logp(p)
             if extra:
                 logits = logits.reshape(
                     p.shape[:-1] + (1,) * len(extra) + (p.shape[-1],))

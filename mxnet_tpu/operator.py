@@ -10,10 +10,15 @@ JAX dispatch is already async. Ops registered here are invokable as
 """
 from __future__ import annotations
 
+import collections as _collections
+
 from . import autograd as ag
 from .ndarray.ndarray import NDArray
 
-__all__ = ["CustomOp", "CustomOpProp", "register", "Custom", "get_all_registered"]
+__all__ = ["CustomOp", "CustomOpProp", "register", "Custom",
+           "get_all_registered", "get_all_registered_operators",
+           "get_all_registered_operators_grouped", "get_operator_arguments",
+           "OperatorArguments"]
 
 _REGISTRY = {}
 
@@ -164,3 +169,63 @@ def Custom(*inputs, op_type=None, **kwargs):  # noqa: N802
     op = prop.create_operator(dev, in_shapes, in_types)
     fn = _CustomFunction(op, prop, len(prop.list_outputs()))
     return fn(*nd_inputs)
+
+
+# ---- operator introspection (reference: operator.py:1129-1201 — the
+# MXListAllOpNames / NNGetOpHandle C-API walk; here the op registry IS the
+# python-side table, so introspection reads it directly) -------------------
+
+def get_all_registered_operators():
+    """All registered operator names (reference: operator.py:1129)."""
+    from .ops.registry import _OPS
+
+    return sorted(_OPS)
+
+
+def get_all_registered_operators_grouped():
+    """Operator names grouped by implementation: alias spellings that
+    resolve to the same callable are listed together (reference:
+    operator.py:1146 groups by the op handle)."""
+    from .ops.registry import _OPS
+
+    groups = {}
+    for name, fn in _OPS.items():
+        groups.setdefault(id(fn), []).append(name)
+    out = {}
+    for names in groups.values():
+        names.sort()
+        out[names[0]] = names
+    return out
+
+
+OperatorArguments = _collections.namedtuple(
+    "OperatorArguments", ["narg", "names", "types"])
+OperatorArguments.__doc__ = ("Arity + argument names/types of an operator "
+                             "(reference: operator.py:1164).")
+
+
+def get_operator_arguments(op_name):
+    """Fetch an operator's argument names and annotated types from its
+    python signature (reference: operator.py:1175 reads the same data
+    from the C op registry)."""
+    import inspect
+
+    from .ops.registry import _OPS
+
+    fn = _OPS.get(op_name)
+    if fn is None:
+        raise ValueError(f"operator {op_name!r} is not registered")
+    sig = inspect.signature(fn)
+    names, types = [], []
+    for pname, p in sig.parameters.items():
+        if p.kind in (inspect.Parameter.VAR_POSITIONAL,
+                      inspect.Parameter.VAR_KEYWORD):
+            continue
+        names.append(pname)
+        if p.annotation is not inspect.Parameter.empty:
+            types.append(str(p.annotation))
+        elif p.default is not inspect.Parameter.empty:
+            types.append(type(p.default).__name__)
+        else:
+            types.append("NDArray-or-Symbol")
+    return OperatorArguments(len(names), names, types)

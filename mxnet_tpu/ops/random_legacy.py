@@ -98,6 +98,22 @@ def _draw_generalized_negative_binomial(key, shape, mu, alpha):
     return jax.random.poisson(kp, lam).astype(jnp.float32)
 
 
+def multinomial_logp(p):
+    """log of the NORMALIZED probability row `p` (one shared kernel for
+    both multinomial entry points — the semantics are delicate): the
+    sampler draws from p/sum(p), so the forward value is the true
+    log-probability even for unnormalized input, while the VJP matches
+    the reference exactly — one-hot/p_raw at sampled classes
+    (sample_multinomial_op.h), NO -1/sum term (normalizer gradient
+    stopped), and exactly 0 at p==0 classes (double-where safe log; a
+    maximum(p, tiny) floor NaNs there because tiny flushes to a 0
+    subnormal on TPU)."""
+    pos = p > 0
+    logz = jax.lax.stop_gradient(
+        jnp.log(jnp.sum(p, axis=-1, keepdims=True)))
+    return jnp.where(pos, jnp.log(jnp.where(pos, p, 1.0)), -87.0) - logz
+
+
 def _sample_multinomial(data, shape=None, get_prob=False, dtype="int32",
                         **kw):  # noqa: ARG001
     """_sample_multinomial: rows of probabilities (..., k) -> indices
@@ -119,15 +135,7 @@ def _sample_multinomial(data, shape=None, get_prob=False, dtype="int32",
     idx = idx.astype(dtype)
     if not get_prob:
         return NDArray(idx)
-    pos = p > 0
-    # log of the NORMALIZED probability (indices are drawn from p/sum(p)),
-    # with the normalizer's gradient stopped: the reference VJP is exactly
-    # one-hot/p_raw (sample_multinomial_op.h), no -1/sum term. The
-    # double-where keeps the VJP exactly 0 at p==0 classes.
-    logz = jax.lax.stop_gradient(
-        jnp.log(jnp.sum(p, axis=-1, keepdims=True)))
-    logp = (jnp.where(pos, jnp.log(jnp.where(pos, p, 1.0)), -69.0)
-            - logz).reshape(batch + (1,) * len(S) + (k,))
+    logp = multinomial_logp(p).reshape(batch + (1,) * len(S) + (k,))
     lp = jnp.take_along_axis(
         jnp.broadcast_to(logp, batch + S + (k,)), idx[..., None].astype(
             jnp.int32), axis=-1)[..., 0]

@@ -472,15 +472,22 @@ def histogram(data, bins=None, bin_cnt=None, range=None):  # noqa: A002
     (counts int64, bin_edges)."""
     import numbers
 
+    import numpy as onp
+
     if bins is not None and not isinstance(bins, numbers.Integral):
         cnt, edges = jnp.histogram(data, bins=bins)
-    else:
-        n = bin_cnt if bin_cnt is not None else (bins or 10)
-        if range is None:
-            raise ValueError(
-                "histogram with an integer bin count requires range= "
-                "(reference histogram.cc contract)")
-        cnt, edges = jnp.histogram(data, bins=int(n), range=range)
+        return cnt.astype(jnp.int64), edges
+    n = bin_cnt if bin_cnt is not None else (bins or 10)
+    if range is None:
+        raise ValueError(
+            "histogram with an integer bin count requires range= "
+            "(reference histogram.cc contract)")
+    # edges from static (n, range) at float64 on the host so they match
+    # numpy's bit-for-bit, then cast to the input dtype (histogram.cc
+    # computes edges at the input's precision)
+    edges = jnp.asarray(
+        onp.linspace(range[0], range[1], int(n) + 1), data.dtype)
+    cnt, _ = jnp.histogram(data, bins=edges)
     return cnt.astype(jnp.int64), edges
 
 

@@ -62,6 +62,7 @@ _MULTI_OUT = {
         if _battr(a.get("state_outputs", False)) else 1),
     "_sample_multinomial": lambda a: (
         2 if _battr(a.get("get_prob", False)) else 1),
+    "histogram": lambda a: 2,
 }
 
 

@@ -1,0 +1,213 @@
+"""Operator-corpus ports: histogram oracles, DeformablePSROIPooling vs an
+independent numpy kernel, and the operator-introspection APIs
+(reference: tests/python/unittest/test_operator.py test_histogram /
+test_deformable_psroipooling / test_get_all_registered_operators /
+test_get_operator_arguments)."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+
+
+# ---- histogram (reference test_operator.py test_histogram) ---------------
+
+@pytest.mark.parametrize("ndim", [1, 2, 3, 4])
+def test_histogram(ndim):
+    rs = np.random.RandomState(ndim)
+    shape = tuple(rs.randint(2, 6, size=ndim))
+    x = mx.nd.array(rs.uniform(-4, 4, size=shape).astype("float64"))
+    mx_bins = mx.nd.array([-1.0, 0.5, 2.0, 4.5, 50.0], dtype="float64")
+    bin_cnt = int(rs.randint(2, 10))
+    bin_range = (-2.5, 2.5)
+
+    h1, b1 = mx.nd.histogram(x, bins=bin_cnt, range=bin_range)
+    nh1, nb1 = np.histogram(x.asnumpy(), bin_cnt, range=bin_range)
+    np.testing.assert_allclose(b1.asnumpy(), nb1)
+    np.testing.assert_allclose(h1.asnumpy(), nh1, rtol=1e-3, atol=1e-5)
+
+    h2, b2 = mx.nd.histogram(x, bins=mx_bins)
+    nh2, nb2 = np.histogram(x.asnumpy(), mx_bins.asnumpy())
+    np.testing.assert_allclose(h2.asnumpy(), nh2, rtol=1e-3, atol=1e-5)
+    np.testing.assert_allclose(b2.asnumpy(), nb2, rtol=1e-3, atol=1e-5)
+
+
+def test_histogram_sym():
+    rs = np.random.RandomState(0)
+    x = mx.nd.array(rs.uniform(-4, 4, size=(3, 5)).astype("float64"))
+    data = mx.sym.Variable("data")
+    histo = mx.sym.histogram(a=data, bins=5, range=(-2.5, 2.5))
+    ex = histo._bind(mx.cpu(), {"data": x})
+    ex.forward()
+    nh, _ = np.histogram(x.asnumpy(), 5, range=(-2.5, 2.5))
+    np.testing.assert_allclose(ex.outputs[0].asnumpy(), nh)
+
+
+# ---- DeformablePSROIPooling (reference test_operator.py:
+# test_deformable_psroipooling; kernel semantics from
+# deformable_psroi_pooling.cu DeformablePSROIPoolForwardKernel) ------------
+
+def _np_deformable_psroi(data, rois, trans, spatial_scale, output_dim,
+                         group_size, pooled_size, part_size,
+                         sample_per_part, trans_std, no_trans):
+    n, c, height, width = data.shape
+    P, G, spp = pooled_size, group_size, sample_per_part
+    part = part_size or P
+    num_classes = 1 if no_trans else trans.shape[1] // 2
+    ch_each = max(output_dim // num_classes, 1)
+    out = np.zeros((rois.shape[0], output_dim, P, P), dtype=np.float64)
+
+    def bil(img, hh, ww):
+        h0, w0 = int(np.floor(hh)), int(np.floor(ww))
+        ah, aw = hh - h0, ww - w0
+        h1, w1 = min(h0 + 1, height - 1), min(w0 + 1, width - 1)
+        return (img[h0, w0] * (1 - ah) * (1 - aw)
+                + img[h0, w1] * (1 - ah) * aw
+                + img[h1, w0] * ah * (1 - aw)
+                + img[h1, w1] * ah * aw)
+
+    for ri, roi in enumerate(rois):
+        b = int(roi[0])
+        x1 = round(roi[1]) * spatial_scale - 0.5
+        y1 = round(roi[2]) * spatial_scale - 0.5
+        x2 = (round(roi[3]) + 1.0) * spatial_scale - 0.5
+        y2 = (round(roi[4]) + 1.0) * spatial_scale - 0.5
+        rw, rh = max(x2 - x1, 0.1), max(y2 - y1, 0.1)
+        bin_h, bin_w = rh / P, rw / P
+        sub_h, sub_w = bin_h / spp, bin_w / spp
+        for ctop in range(output_dim):
+            cls = ctop // ch_each
+            for ph in range(P):
+                for pw in range(P):
+                    part_h = min(ph * part // P, part - 1)
+                    part_w = min(pw * part // P, part - 1)
+                    if no_trans:
+                        tx = ty = 0.0
+                    else:
+                        tx = trans[ri, cls * 2, part_h, part_w] * trans_std
+                        ty = trans[ri, cls * 2 + 1, part_h, part_w] \
+                            * trans_std
+                    wstart = pw * bin_w + x1 + tx * rw
+                    hstart = ph * bin_h + y1 + ty * rh
+                    gh = min(ph * G // P, G - 1)
+                    gw = min(pw * G // P, G - 1)
+                    chan = (ctop * G + gh) * G + gw
+                    acc, cnt = 0.0, 0
+                    for ih in range(spp):
+                        for iw in range(spp):
+                            ww = wstart + iw * sub_w
+                            hh = hstart + ih * sub_h
+                            if (ww < -0.5 or ww > width - 0.5
+                                    or hh < -0.5 or hh > height - 0.5):
+                                continue
+                            wc = min(max(ww, 0.0), width - 1.0)
+                            hc = min(max(hh, 0.0), height - 1.0)
+                            acc += bil(data[b, chan], hc, wc)
+                            cnt += 1
+                    out[ri, ctop, ph, pw] = acc / cnt if cnt else 0.0
+    return out
+
+
+@pytest.mark.parametrize("num_classes,num_group", [(2, 2), (3, 2), (2, 3)])
+def test_deformable_psroipooling_forward(num_classes, num_group):
+    rs = np.random.RandomState(num_classes * 10 + num_group)
+    spatial_scale = 0.0625
+    stride = int(1 / spatial_scale)
+    image_h = image_w = 160
+    fh, fw = int(image_h * spatial_scale), int(image_w * spatial_scale)
+    num_rois = 2
+    data = rs.rand(1, num_classes * num_group * num_group, fh, fw)
+    rois = np.zeros((num_rois, 5))
+    rois[:, [1, 3]] = np.sort(
+        rs.rand(num_rois, 2) * (image_w - 1 - 2 * stride), axis=1) + stride
+    rois[:, [2, 4]] = np.sort(
+        rs.rand(num_rois, 2) * (image_h - 1 - 2 * stride), axis=1) + stride
+    trans = rs.rand(num_rois, 2 * num_classes, num_group, num_group)
+
+    got = mx.nd.contrib.DeformablePSROIPooling(
+        mx.nd.array(data), mx.nd.array(rois), mx.nd.array(trans),
+        spatial_scale=spatial_scale, output_dim=num_classes,
+        group_size=num_group, pooled_size=num_group,
+        sample_per_part=4, trans_std=0.1, no_trans=False).asnumpy()
+    want = _np_deformable_psroi(
+        data, rois, trans, spatial_scale, num_classes, num_group,
+        num_group, 0, 4, 0.1, False)
+    np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-5)
+
+
+def test_deformable_psroipooling_no_trans_matches_psroi_style():
+    # with no_trans the op reduces to sampled position-sensitive pooling
+    rs = np.random.RandomState(7)
+    data = rs.rand(1, 2 * 2 * 2, 12, 12)
+    rois = np.array([[0, 16.0, 16.0, 128.0, 128.0]])
+    got = mx.nd.contrib.DeformablePSROIPooling(
+        mx.nd.array(data), mx.nd.array(rois),
+        spatial_scale=0.0625, output_dim=2, group_size=2, pooled_size=2,
+        sample_per_part=4, no_trans=True).asnumpy()
+    want = _np_deformable_psroi(
+        data, rois, None, 0.0625, 2, 2, 2, 0, 4, 0.0, True)
+    np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-5)
+
+
+def test_deformable_psroipooling_grads_flow():
+    rs = np.random.RandomState(3)
+    # float64 end-to-end: the finite difference below is ~1e-6 of the
+    # output sum, invisible at float32 resolution
+    data = mx.nd.array(rs.rand(1, 8, 10, 10), dtype="float64")
+    rois = mx.nd.array([[0, 16.0, 16.0, 128.0, 128.0]], dtype="float64")
+    trans = mx.nd.array(rs.rand(1, 4, 2, 2) * 0.2, dtype="float64")
+    gd = mx.nd.zeros_like(data)
+    gt = mx.nd.zeros_like(trans)
+    mx.autograd.mark_variables([data, trans], [gd, gt])
+    with mx.autograd.record():
+        out = mx.nd.contrib.DeformablePSROIPooling(
+            data, rois, trans, spatial_scale=0.0625, output_dim=2,
+            group_size=2, pooled_size=2, sample_per_part=4, trans_std=0.1,
+            no_trans=False)
+        out.sum().backward()
+    assert float(abs(gd.asnumpy()).sum()) > 0
+    assert float(abs(gt.asnumpy()).sum()) > 0
+    # finite-difference spot check on a trans coordinate
+    eps = 1e-4
+    tn = trans.asnumpy()
+
+    def fwd(tv):
+        return float(mx.nd.contrib.DeformablePSROIPooling(
+            data, rois, mx.nd.array(tv, dtype="float64"),
+            spatial_scale=0.0625, output_dim=2, group_size=2,
+            pooled_size=2, sample_per_part=4,
+            trans_std=0.1, no_trans=False).sum().asnumpy())
+
+    tp = tn.copy()
+    tp[0, 0, 0, 0] += eps
+    tm = tn.copy()
+    tm[0, 0, 0, 0] -= eps
+    num = (fwd(tp) - fwd(tm)) / (2 * eps)
+    np.testing.assert_allclose(gt.asnumpy()[0, 0, 0, 0], num,
+                               rtol=1e-2, atol=1e-4)
+
+
+# ---- operator introspection (reference test_operator.py:
+# test_get_all_registered_operators / test_get_operator_arguments) ---------
+
+def test_get_all_registered_operators():
+    ops = mx.operator.get_all_registered_operators()
+    assert isinstance(ops, list) and len(ops) > 300
+    for must in ["Convolution", "BatchNorm", "FullyConnected", "dot"]:
+        assert must in ops, must
+
+
+def test_get_all_registered_operators_grouped():
+    groups = mx.operator.get_all_registered_operators_grouped()
+    assert isinstance(groups, dict)
+    flat = [n for names in groups.values() for n in names]
+    assert len(flat) == len(mx.operator.get_all_registered_operators())
+    # alias families group together (CamelCase + snake_case spellings)
+    assert any(len(v) > 1 for v in groups.values())
+
+
+def test_get_operator_arguments():
+    args = mx.operator.get_operator_arguments("Convolution")
+    assert args.narg == len(args.names) == len(args.types)
+    assert "data" in args.names and "kernel" in args.names
+    with pytest.raises(ValueError):
+        mx.operator.get_operator_arguments("NoSuchOperator")

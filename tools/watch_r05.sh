@@ -20,7 +20,9 @@ all_done() {
 }
 
 while ! all_done; do
-    p=$(timeout 90 python -c \
+    # env -u: probe with the same platform stack the capture steps use —
+    # an exported JAX_PLATFORMS=cpu would otherwise report dark forever
+    p=$(env -u JAX_PLATFORMS timeout 90 python -c \
         "import jax; print(jax.devices()[0].platform)" 2>/dev/null | tail -1)
     if [ "$p" != "tpu" ]; then
         echo "$(date -u +%FT%TZ) dark" >> "$LOG"
