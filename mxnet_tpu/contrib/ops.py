@@ -882,13 +882,13 @@ def deformable_psroi_pooling(data, rois, trans=None, spatial_scale=1.0,
             raise ValueError(
                 f"deformable_psroi_pooling: data has {c} channels but "
                 f"output_dim*group_size^2 = {output_dim * G * G}")
-        num_classes = 1 if no_trans else t.shape[1] // 2
         if not no_trans and (
                 t.ndim != 4 or t.shape[0] != r.shape[0]
                 or t.shape[1] % 2 or t.shape[2:] != (part, part)):
             raise ValueError(
                 f"deformable_psroi_pooling: trans must be "
                 f"(num_rois, 2*num_classes, {part}, {part}); got {t.shape}")
+        num_classes = 1 if no_trans else t.shape[1] // 2
         ch_each = max(output_dim // num_classes, 1)
 
         def bilinear(img2d, hh, ww):

@@ -74,3 +74,20 @@ def rand_zipfian(true_classes, num_sampled, range_max, ctx=None):  # noqa: ARG00
         ((sampled_f + 2.0) / (sampled_f + 1.0)).log() / log_range)
     return sampled_classes, expected_count_true, \
         expected_prob_sampled * num_sampled
+
+
+def SparseEmbedding(data, weight, input_dim=None, output_dim=None,  # noqa: N802
+                    dtype=None, deterministic=False, **kwargs):  # noqa: ARG001
+    """Deprecated reference spelling (indexing_op.cc
+    _contrib_SparseEmbedding): Embedding whose weight gradient is row
+    sparse; `nn.Embedding(..., sparse_grad=True)` is the modern path —
+    this alias delegates to the same kernel."""
+    from ..ops.nn import embedding
+
+    from .ndarray import apply_op
+
+    return apply_op(
+        lambda d, w: embedding(d, w, input_dim=input_dim,
+                               output_dim=output_dim, dtype=dtype,
+                               sparse_grad=True),
+        data, weight, name="SparseEmbedding")

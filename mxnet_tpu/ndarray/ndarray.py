@@ -754,6 +754,21 @@ def _wrap_out(data, device=None):
     return NDArray(data, device)
 
 
+def _is_sparse(a):
+    return getattr(a, "stype", None) in ("csr", "row_sparse")
+
+
+def densify_sparse_args(args):
+    """Storage fallback (reference FComputeExFallback): sparse operands
+    of ops without a sparse kernel densify at the eager boundary, so
+    nd.sum(csr) / nd.where(csr, ...) value-match the reference with a
+    dense result. Shared by apply_op and make_eager — keep the
+    semantics in ONE place."""
+    if any(_is_sparse(a) for a in args):
+        return tuple(a.todense() if _is_sparse(a) else a for a in args)
+    return args
+
+
 def apply_op(fn, *args, name=None):
     """Run pure jax function `fn` over NDArray/raw args; tape when recording.
 
@@ -761,6 +776,7 @@ def apply_op(fn, *args, name=None):
     other args go through untouched. Returns NDArray or tuple of NDArrays,
     mirroring fn's output structure.
     """
+    args = densify_sparse_args(args)
     nd_pos = [i for i, a in enumerate(args) if isinstance(a, NDArray)]
     datas = [args[i]._data for i in nd_pos]
 

@@ -12,7 +12,7 @@ from __future__ import annotations
 import functools
 
 from ..ops.registry import _OPS
-from .ndarray import NDArray, apply_op
+from .ndarray import NDArray, _is_sparse, apply_op, densify_sparse_args
 
 
 def make_eager(name, fn):
@@ -26,6 +26,18 @@ def make_eager(name, fn):
     @functools.wraps(fn)
     def wrapped(*args, **kwargs):
         out = kwargs.pop("out", None)
+        # sparse-aware ops keep their nnz-level kernels (docs/sparse.md:
+        # dot with a sparse LEFT operand is genuinely sparse — a blanket
+        # densify would materialize huge matrices); everything else takes
+        # the storage fallback below
+        if name == "dot" and args and _is_sparse(args[0]):
+            from . import sparse as _sparse
+
+            return _sparse.dot(*args, **kwargs)
+        args = densify_sparse_args(args)
+        if any(_is_sparse(v) for v in kwargs.values()):
+            kwargs = {k: v.todense() if _is_sparse(v) else v
+                      for k, v in kwargs.items()}
         arr_pos = [i for i, a in enumerate(args) if isinstance(a, NDArray)]
         arr_keys = [k for k, v in kwargs.items() if isinstance(v, NDArray)]
         nd_args = [args[i] for i in arr_pos] + [kwargs[k] for k in arr_keys]

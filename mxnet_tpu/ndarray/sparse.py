@@ -115,6 +115,30 @@ class BaseSparseNDArray:
         return (f"\n<{type(self).__name__} {self._shape} "
                 f"dtype={self._dtype.name}>")
 
+    # tape-stateful members must NOT silently act on a throwaway dense
+    # copy (rsp.attach_grad() would train with no gradient); they raise
+    # loudly instead
+    _FLUENT_DENY = frozenset(
+        {"attach_grad", "grad", "backward", "detach", "as_in_context",
+         "as_in_ctx"})
+
+    def __getattr__(self, name):
+        # storage fallback for the fluent surface (reference: every op
+        # without a sparse FCompute densifies its inputs and runs the
+        # dense kernel — FComputeExFallback; docs/sparse.md blunt
+        # table): rsp.sum(), csr.sqrt(), ... delegate to the dense view.
+        # Guards: underscore names stay AttributeError (pickling /
+        # protocol probes), unknown names fail WITHOUT densifying (the
+        # NDArray class check is free), and stateful members are denied.
+        if (name.startswith("_") or name in BaseSparseNDArray._FLUENT_DENY
+                or not hasattr(NDArray, name)):
+            raise AttributeError(
+                f"{type(self).__name__} has no attribute {name!r}"
+                + (f" ({name} would act on a temporary dense copy; "
+                   f"convert with .todense() first)"
+                   if name in BaseSparseNDArray._FLUENT_DENY else ""))
+        return getattr(self.todense(), name)
+
 
 class CSRNDArray(BaseSparseNDArray):
     """Compressed-sparse-row matrix (reference: sparse.py:301).

@@ -218,14 +218,21 @@ def get_operator_arguments(op_name):
     sig = inspect.signature(fn)
     names, types = [], []
     for pname, p in sig.parameters.items():
-        if p.kind in (inspect.Parameter.VAR_POSITIONAL,
-                      inspect.Parameter.VAR_KEYWORD):
+        if p.kind is inspect.Parameter.VAR_KEYWORD:
+            continue
+        if p.kind is inspect.Parameter.VAR_POSITIONAL:
+            # variadic-input ops (add_n, khatri_rao, ...) report one
+            # list-typed slot, like the reference's "NDArray-or-Symbol[]"
+            names.append(pname)
+            types.append("NDArray-or-Symbol[]")
             continue
         names.append(pname)
         if p.annotation is not inspect.Parameter.empty:
             types.append(str(p.annotation))
-        elif p.default is not inspect.Parameter.empty:
-            types.append(type(p.default).__name__)
-        else:
+        elif p.default is None or p.default is inspect.Parameter.empty:
+            # None-default optional tensors/attrs carry no type info;
+            # the tensor-slot fallback is the faithful description
             types.append("NDArray-or-Symbol")
+        else:
+            types.append(type(p.default).__name__)
     return OperatorArguments(len(names), names, types)

@@ -222,3 +222,87 @@ def test_array_function_returns_ndarray():
     assert isinstance(out, NDArray)
     out = onp.concatenate([a, a])
     assert isinstance(out, NDArray)
+
+
+# --- r5b tranche: the remaining _NUMPY_ARRAY_FUNCTION_LIST families -------
+# (reference numpy_dispatch_protocol.py:84; same value-vs-official-numpy
+# contract as the sweep above)
+
+_R5B_CASES = [
+    ("all", lambda a, b: (a > 0.01,), {}),
+    ("any", lambda a, b: (a > 0.99,), {}),
+    ("argsort", lambda a, b: (a,), {"axis": 1}),
+    ("sort", lambda a, b: (a,), {"axis": 1}),
+    ("append", lambda a, b: (a, b), {"axis": 0}),
+    ("around", lambda a, b: (a * 10,), {}),
+    ("copy", lambda a, b: (a,), {}),
+    ("diag", lambda a, b: (a[0],), {}),
+    ("diagonal", lambda a, b: (a,), {}),
+    ("diagflat", lambda a, b: (a[0, :2],), {}),
+    ("fix", lambda a, b: (a * 10 - 5,), {}),
+    ("nonzero", lambda a, b: ((a > 0.5).astype("int32"),), {}),
+    ("ones_like", lambda a, b: (a,), {}),
+    ("zeros_like", lambda a, b: (a,), {}),
+    ("full_like", lambda a, b: (a, 2.5), {}),
+    ("atleast_1d", lambda a, b: (a[0, 0],), {}),
+    ("atleast_2d", lambda a, b: (a[0],), {}),
+    ("atleast_3d", lambda a, b: (a,), {}),
+    ("array_split", lambda a, b: (a, 3), {"axis": 1}),
+    ("hsplit", lambda a, b: (a, 2), {}),
+    ("vsplit", lambda a, b: (a, 2), {}),
+    ("dsplit", lambda a, b: (a[None],), {"indices_or_sections": 2}),
+    ("take", lambda a, b: (a, onp.array([0, 2])), {"axis": 1}),
+    ("tensordot", lambda a, b: (a, b.T), {"axes": 1}),
+    ("unravel_index", lambda a, b: (onp.array([1, 5]), (4, 6)), {}),
+    ("flatnonzero", lambda a, b: (a > 0.7,), {}),
+    ("delete", lambda a, b: (a, 1), {"axis": 0}),
+    ("vdot", lambda a, b: (a, b), {}),
+    ("inner", lambda a, b: (a, b), {}),
+    ("column_stack", lambda a, b: ([a, b],), {}),
+    ("dstack", lambda a, b: ([a, b],), {}),
+    ("meshgrid", lambda a, b: (a[0], b[0]), {}),
+    ("kron", lambda a, b: (a[:2, :2], b[:2, :2]), {}),
+    ("polyval", lambda a, b: (a[0, :3], b[0]), {}),
+    ("percentile", lambda a, b: (a, 40), {}),
+    ("ediff1d", lambda a, b: (a,), {}),
+    ("bincount", lambda a, b: ((a.reshape(-1) * 5).astype("int32"),), {}),
+    ("nan_to_num", lambda a, b: (a,), {}),
+    ("isnan", lambda a, b: (a,), {}),
+    ("isinf", lambda a, b: (a,), {}),
+    ("isfinite", lambda a, b: (a,), {}),
+    ("isposinf", lambda a, b: (a,), {}),
+    ("isneginf", lambda a, b: (a,), {}),
+    ("cross", lambda a, b: (a[:, :3], b[:, :3]), {"axis": 1}),
+    ("interp", lambda a, b: (a[0], onp.sort(b[0].asnumpy()),
+                             onp.arange(6.0)), {}),
+    ("pad", lambda a, b: (a, ((1, 1), (0, 2))), {}),
+    ("resize", lambda a, b: (a, (2, 12)), {}),
+    ("shape", lambda a, b: (a,), {}),
+    ("shares_memory", lambda a, b: (a, b), {}),
+    ("may_share_memory", lambda a, b: (a, b), {}),
+]
+
+
+@pytest.mark.parametrize("name,args_fn,kwargs",
+                         _R5B_CASES, ids=[c[0] for c in _R5B_CASES])
+def test_array_function_sweep_r5b(name, args_fn, kwargs):
+    fn = getattr(onp, name)
+    a, b = _arr(4, 6), _arr(4, 6)
+    args = args_fn(a, b)
+
+    def to_np(x):
+        if isinstance(x, NDArray):
+            return x.asnumpy()
+        if isinstance(x, (list, tuple)):
+            return type(x)(to_np(v) for v in x)
+        return x
+
+    want = fn(*to_np(args), **kwargs)
+    got = fn(*args, **kwargs)
+    gots = got if isinstance(got, (list, tuple)) else [got]
+    wants = want if isinstance(want, (list, tuple)) else [want]
+    for g, w in zip(gots, wants):
+        if isinstance(g, NDArray):
+            g = g.asnumpy()
+        onp.testing.assert_allclose(onp.asarray(g), onp.asarray(w),
+                                    rtol=1e-5, atol=1e-6)

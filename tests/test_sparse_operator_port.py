@@ -1,0 +1,255 @@
+"""Sparse operator value oracles (reference:
+tests/python/unittest/test_sparse_operator.py — square_sum, the
+mathematical core, same-zero-pattern elemwise, dot determinism,
+storage fallback, elementwise_sum, where, axis reductions,
+SparseEmbedding). Value parity is asserted against dense oracles; the
+storage-semantics boundary follows docs/sparse.md's blunt table
+(sparse-in, dense-out is the documented contract on fallback paths)."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd
+
+RS = np.random.RandomState(42)
+
+
+def _rand_rsp(shape, density):
+    """Random row_sparse with ~density fraction of stored rows."""
+    dns = np.zeros(shape, dtype="float32")
+    nrows = max(int(round(shape[0] * density)), 0)
+    rows = np.sort(RS.choice(shape[0], size=nrows, replace=False))
+    for r in rows:
+        dns[r] = RS.uniform(-1, 1, shape[1:])
+    rsp = nd.sparse.row_sparse_array(
+        (dns[rows], rows.astype("int64")), shape=shape) if nrows else \
+        nd.sparse.row_sparse_array(
+            (np.zeros((0,) + shape[1:], "float32"),
+             np.zeros((0,), "int64")), shape=shape)
+    return rsp, dns
+
+
+def _rand_csr(shape, density):
+    dns = (RS.uniform(0, 1, shape) < density) \
+        * RS.uniform(-1, 1, shape).astype("float32")
+    dns = dns.astype("float32")
+    return nd.sparse.cast_storage(nd.array(dns), "csr"), dns
+
+
+# ---- square_sum (reference test_sparse_square_sum) -----------------------
+
+@pytest.mark.parametrize("density", [0.0, 0.2, 0.5, 1.0])
+@pytest.mark.parametrize("axis", [0, 1])
+@pytest.mark.parametrize("keepdims", [False, True])
+def test_sparse_square_sum(density, axis, keepdims):
+    rsp, dns = _rand_rsp((13, 9), density)
+    ret = nd._internal._square_sum(rsp, axis=axis, keepdims=keepdims)
+    want = (dns * dns).sum(axis=axis, keepdims=keepdims)
+    np.testing.assert_allclose(ret.asnumpy(), want, rtol=1e-5, atol=1e-6)
+
+
+# ---- mathematical core (reference test_sparse_mathematical_core) ---------
+
+_UNARY = [
+    ("sqrt", np.sqrt, True), ("abs", np.abs, False),
+    ("sign", np.sign, False), ("square", np.square, False),
+    ("floor", np.floor, False), ("ceil", np.ceil, False),
+    ("trunc", np.trunc, False), ("rint", np.rint, False),
+    ("arcsin", np.arcsin, False), ("arctan", np.arctan, False),
+    ("tanh", np.tanh, False), ("sinh", np.sinh, False),
+    ("expm1", np.expm1, False), ("log1p", lambda x: np.log1p(x), True),
+]
+
+
+@pytest.mark.parametrize("name,ref,nonneg", _UNARY,
+                         ids=[u[0] for u in _UNARY])
+@pytest.mark.parametrize("stype", ["row_sparse", "csr"])
+def test_sparse_mathematical_core(name, ref, nonneg, stype):
+    # zero-preserving unary math applied to sparse inputs must value-match
+    # the dense oracle (reference exercises the same families)
+    if stype == "row_sparse":
+        sp, dns = _rand_rsp((11, 5), 0.4)
+    else:
+        sp, dns = _rand_csr((11, 5), 0.3)
+    if nonneg:
+        dns = np.abs(dns)
+        sp = nd.sparse.cast_storage(nd.array(dns),
+                                    "csr" if stype == "csr"
+                                    else "row_sparse")
+    fn = getattr(nd, name)
+    got = fn(sp)
+    np.testing.assert_allclose(got.asnumpy(), ref(dns),
+                               rtol=1e-5, atol=1e-6)
+
+
+# ---- same zero pattern elemwise (reference test_elemwise_csr_same_zeros) -
+
+def test_elemwise_csr_same_zeros():
+    csr_a, dns_a = _rand_csr((8, 6), 0.3)
+    # same sparsity pattern, different values
+    dns_b = dns_a * 2.5
+    csr_b = nd.sparse.cast_storage(nd.array(dns_b), "csr")
+    got = nd.sparse.add(csr_a, csr_b)
+    np.testing.assert_allclose(got.asnumpy(), dns_a + dns_b, rtol=1e-6)
+
+
+# ---- dot determinism (reference test_sparse_dot_determinism) -------------
+
+def test_sparse_dot_determinism():
+    csr, _ = _rand_csr((32, 24), 0.2)
+    rhs = nd.array(RS.uniform(-1, 1, (24, 16)).astype("float32"))
+    first = nd.sparse.dot(csr, rhs).asnumpy()
+    for _ in range(3):
+        again = nd.sparse.dot(csr, rhs).asnumpy()
+        assert (first == again).all(), "dot(csr, dense) must be bitwise \
+deterministic"
+    t_first = nd.sparse.dot(csr, rhs, transpose_a=True).asnumpy() \
+        if "transpose_a" in nd.sparse.dot.__code__.co_varnames else None
+    if t_first is not None:
+        t_again = nd.sparse.dot(csr, rhs, transpose_a=True).asnumpy()
+        assert (t_first == t_again).all()
+
+
+# ---- zeros_like / zeros stypes (reference test_sparse_nd_zeros*) ---------
+
+def test_sparse_nd_zeros_and_zeros_like():
+    z = nd.sparse.zeros("row_sparse", (5, 3))
+    assert z.stype == "row_sparse" and z.asnumpy().sum() == 0
+    z2 = nd.sparse.zeros("csr", (5, 3))
+    assert z2.stype == "csr" and z2.asnumpy().sum() == 0
+    rsp, _ = _rand_rsp((5, 3), 0.5)
+    zl = nd.zeros_like(rsp)
+    assert zl.shape == (5, 3) and zl.asnumpy().sum() == 0
+
+
+# ---- broadcast add/sub/mul/div (reference test_sparse_broadcast_*) -------
+
+@pytest.mark.parametrize("op,ref", [
+    (nd.broadcast_add, np.add), (nd.broadcast_sub, np.subtract),
+    (nd.broadcast_mul, np.multiply), (nd.broadcast_div, np.divide)])
+def test_sparse_broadcast_binary(op, ref):
+    csr, dns = _rand_csr((7, 5), 0.4)
+    dns = dns + (ref is np.divide) * 0.0  # keep zeros: op densifies anyway
+    row = RS.uniform(1, 2, (1, 5)).astype("float32")
+    got = op(csr, nd.array(row))
+    np.testing.assert_allclose(got.asnumpy(), ref(dns, row),
+                               rtol=1e-5, atol=1e-6)
+
+
+# ---- elementwise_sum (reference test_sparse_elementwise_sum) -------------
+
+def test_sparse_elementwise_sum():
+    arrays, denses = [], []
+    for _ in range(4):
+        rsp, dns = _rand_rsp((9, 4), 0.4)
+        arrays.append(rsp)
+        denses.append(dns)
+    got = nd.add_n(*arrays)
+    np.testing.assert_allclose(got.asnumpy(), sum(denses),
+                               rtol=1e-5, atol=1e-6)
+
+
+# ---- where (reference test_sparse_nd_where) ------------------------------
+
+def test_sparse_nd_where():
+    csr, dns = _rand_csr((6, 4), 0.5)
+    x = RS.uniform(-1, 1, (6, 4)).astype("float32")
+    y = RS.uniform(-1, 1, (6, 4)).astype("float32")
+    got = nd.where(csr, nd.array(x), nd.array(y))
+    np.testing.assert_allclose(got.asnumpy(),
+                               np.where(dns != 0, x, y), rtol=1e-6)
+
+
+# ---- axis reductions (reference test_sparse_axis_operations) -------------
+
+@pytest.mark.parametrize("axis", [0, 1, None])
+def test_sparse_axis_sum(axis):
+    csr, dns = _rand_csr((10, 7), 0.3)
+    got = nd.sum(csr, axis=axis)
+    np.testing.assert_allclose(got.asnumpy(), dns.sum(axis=axis),
+                               rtol=1e-5, atol=1e-5)
+
+
+# ---- storage fallback (reference test_sparse_storage_fallback) -----------
+
+def test_sparse_storage_fallback():
+    # ops without sparse kernels fall back to dense compute with correct
+    # values and a dense result (docs/sparse.md blunt table)
+    csr, dns = _rand_csr((6, 8), 0.4)
+    got = nd.softmax(csr)
+    from scipy.special import softmax as sp_softmax
+
+    np.testing.assert_allclose(got.asnumpy(), sp_softmax(dns, axis=-1),
+                               rtol=1e-5, atol=1e-6)
+    assert getattr(got, "stype", "default") == "default"
+    rsp, rdns = _rand_rsp((8, 5), 0.4)
+    lhs = RS.uniform(-1, 1, (6, 8)).astype("float32")
+    got2 = nd.dot(nd.array(lhs), rsp)  # dense @ sparse densifies
+    np.testing.assert_allclose(got2.asnumpy(), lhs @ rdns,
+                               rtol=1e-4, atol=1e-5)
+
+
+# ---- SparseEmbedding (reference test_sparse_embedding) -------------------
+
+def test_sparse_embedding():
+    vocab, dim = 12, 5
+    w = nd.array(RS.uniform(-1, 1, (vocab, dim)).astype("float32"))
+    idx = nd.array([0, 3, 3, 7])
+    out = nd.contrib.SparseEmbedding(idx, w, input_dim=vocab,
+                                     output_dim=dim)
+    np.testing.assert_allclose(
+        out.asnumpy(), w.asnumpy()[[0, 3, 3, 7]], rtol=1e-6)
+    # gradient accumulates over duplicate indices like the reference's
+    # row-sparse backward
+    gw = nd.zeros_like(w)
+    mx.autograd.mark_variables([w], [gw])
+    with mx.autograd.record():
+        o = nd.contrib.SparseEmbedding(idx, w, input_dim=vocab,
+                                       output_dim=dim)
+        o.sum().backward()
+    expect = np.zeros((vocab, dim), "float32")
+    for i in [0, 3, 3, 7]:
+        expect[i] += 1.0
+    np.testing.assert_allclose(gw.asnumpy(), expect, rtol=1e-6)
+
+
+# ---- retain value families (reference test_sparse_retain; sparse stays
+# out of the autograd tape by design — docs/sparse.md) ---------------------
+
+def test_sparse_retain_value_families():
+    rsp, dns = _rand_rsp((8, 3), 0.6)
+    for keep in ([1, 4, 6], [0], list(range(8)), []):
+        out = nd.sparse.retain(rsp, nd.array(keep).astype("int64"))
+        expect = np.zeros((8, 3), "float32")
+        if keep:
+            expect[keep] = dns[keep]
+        np.testing.assert_allclose(out.asnumpy(), expect, rtol=1e-6)
+        assert out.stype == "row_sparse"
+
+
+# ---- review-hardening regressions ----------------------------------------
+
+def test_nd_dot_sparse_lhs_keeps_sparse_kernel():
+    # the plain mx.nd.dot spelling with a sparse LEFT operand must route
+    # to the nnz-level kernel (docs/sparse.md), not the densify fallback
+    csr, dns = _rand_csr((6, 4), 0.4)
+    rhs = nd.array(RS.uniform(-1, 1, (4, 3)).astype("float32"))
+    np.testing.assert_allclose(nd.dot(csr, rhs).asnumpy(),
+                               dns @ rhs.asnumpy(), rtol=1e-5, atol=1e-6)
+    rsp, rdns = _rand_rsp((6, 4), 0.5)
+    np.testing.assert_allclose(nd.dot(rsp, rhs).asnumpy(),
+                               rdns @ rhs.asnumpy(), rtol=1e-5, atol=1e-6)
+
+
+def test_sparse_stateful_members_denied_loudly():
+    rsp, _ = _rand_rsp((4, 3), 0.5)
+    for name in ("attach_grad", "grad", "backward", "detach"):
+        with pytest.raises(AttributeError, match="dense copy"):
+            getattr(rsp, name)
+    with pytest.raises(AttributeError):
+        rsp.definitely_not_an_attribute
+
+
+def test_variadic_op_introspection():
+    args = mx.operator.get_operator_arguments("add_n")
+    assert args.narg == 1 and args.types == ["NDArray-or-Symbol[]"]
