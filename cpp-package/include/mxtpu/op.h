@@ -6746,6 +6746,18 @@ inline std::vector<PackedTensor> _sample_uniform(
   return rt.invoke("_sample_uniform", ins_, detail::merge(a_.str(), extra_attrs));
 }
 
+inline std::vector<PackedTensor> _sample_unique_zipfian(
+    PyRuntime& rt,
+    const PackedTensor& range_max,
+    const char* shape_json = nullptr,
+    const std::string& extra_attrs = "") {
+  std::vector<PackedTensor> ins_;
+  ins_.push_back(range_max);
+  detail::JsonBuilder a_;
+  if (shape_json) a_.raw("shape", shape_json);
+  return rt.invoke("_sample_unique_zipfian", ins_, detail::merge(a_.str(), extra_attrs));
+}
+
 inline std::vector<PackedTensor> _scatter_set_nd(
     PyRuntime& rt,
     const PackedTensor& data,

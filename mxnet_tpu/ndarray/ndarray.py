@@ -763,7 +763,13 @@ def densify_sparse_args(args):
     of ops without a sparse kernel densify at the eager boundary, so
     nd.sum(csr) / nd.where(csr, ...) value-match the reference with a
     dense result. Shared by apply_op and make_eager — keep the
-    semantics in ONE place."""
+    semantics in ONE place. Accepts a tuple/list of positionals or a
+    dict of keywords."""
+    if isinstance(args, dict):
+        if any(_is_sparse(v) for v in args.values()):
+            return {k: v.todense() if _is_sparse(v) else v
+                    for k, v in args.items()}
+        return args
     if any(_is_sparse(a) for a in args):
         return tuple(a.todense() if _is_sparse(a) else a for a in args)
     return args

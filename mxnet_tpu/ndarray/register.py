@@ -33,11 +33,13 @@ def make_eager(name, fn):
         if name == "dot" and args and _is_sparse(args[0]):
             from . import sparse as _sparse
 
-            return _sparse.dot(*args, **kwargs)
+            res = _sparse.dot(*args, **kwargs)
+            if out is not None:
+                out._assign_from(res)
+                return out
+            return res
         args = densify_sparse_args(args)
-        if any(_is_sparse(v) for v in kwargs.values()):
-            kwargs = {k: v.todense() if _is_sparse(v) else v
-                      for k, v in kwargs.items()}
+        kwargs = densify_sparse_args(kwargs)
         arr_pos = [i for i, a in enumerate(args) if isinstance(a, NDArray)]
         arr_keys = [k for k, v in kwargs.items() if isinstance(v, NDArray)]
         nd_args = [args[i] for i in arr_pos] + [kwargs[k] for k in arr_keys]

@@ -126,17 +126,24 @@ class BaseSparseNDArray:
         # storage fallback for the fluent surface (reference: every op
         # without a sparse FCompute densifies its inputs and runs the
         # dense kernel — FComputeExFallback; docs/sparse.md blunt
-        # table): rsp.sum(), csr.sqrt(), ... delegate to the dense view.
-        # Guards: underscore names stay AttributeError (pickling /
-        # protocol probes), unknown names fail WITHOUT densifying (the
-        # NDArray class check is free), and stateful members are denied.
-        if (name.startswith("_") or name in BaseSparseNDArray._FLUENT_DENY
-                or not hasattr(NDArray, name)):
+        # table): rsp.sum(), csr.softmax(), ... delegate to the dense
+        # view. Guards: underscore names stay AttributeError (pickling /
+        # protocol probes), unknown names fail WITHOUT densifying, and
+        # stateful members are denied. Resolution mirrors NDArray's own
+        # fluent __getattr__ — hand-written methods on the class PLUS
+        # any registered op in the eager nd namespace.
+        if name.startswith("_") or name in BaseSparseNDArray._FLUENT_DENY:
             raise AttributeError(
                 f"{type(self).__name__} has no attribute {name!r}"
                 + (f" ({name} would act on a temporary dense copy; "
                    f"convert with .todense() first)"
                    if name in BaseSparseNDArray._FLUENT_DENY else ""))
+        if not hasattr(NDArray, name):
+            from .. import ndarray as _nd_ns
+
+            if not callable(getattr(_nd_ns, name, None)):
+                raise AttributeError(
+                    f"{type(self).__name__} has no attribute {name!r}")
         return getattr(self.todense(), name)
 
 

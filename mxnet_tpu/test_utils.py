@@ -121,11 +121,13 @@ def check_numeric_gradient(fn, inputs, eps=1e-3, rtol=1e-2, atol=1e-3):
         for i in range(flat.size):
             orig = flat[i]
             flat[i] = orig + eps
-            xp = array(base.reshape(base.shape).astype(x.dtype))
+            # dtype= explicitly: the default-dtype policy would downcast
+            # float64 probes to float32 and destroy the FD resolution
+            xp = array(base.reshape(base.shape), dtype=x.dtype)
             args = [inputs[j] if j != idx else xp for j in range(len(inputs))]
             fp = float(fn(*args).sum().item())
             flat[i] = orig - eps
-            xm = array(base.reshape(base.shape).astype(x.dtype))
+            xm = array(base.reshape(base.shape), dtype=x.dtype)
             args = [inputs[j] if j != idx else xm for j in range(len(inputs))]
             fm = float(fn(*args).sum().item())
             flat[i] = orig

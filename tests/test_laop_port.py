@@ -1,0 +1,218 @@
+"""Linear-algebra operator tranche (reference:
+tests/python/unittest/test_operator.py test_laop / test_laop_2 ..
+test_laop_5 — la_op_inter.cc semantics): value oracles over the full
+attribute surface (transpose / rightside / lower / alpha / beta /
+offset) and numeric-gradient checks at float64."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd
+from mxnet_tpu.test_utils import check_numeric_gradient
+
+RS = np.random.RandomState(7)
+la = nd.linalg
+
+
+def _spd(n):
+    a = RS.rand(n, n)
+    return (a @ a.T + n * np.eye(n)).astype("float64")
+
+
+def _f64(x):
+    return nd.array(np.asarray(x), dtype="float64")
+
+
+# ---- gemm (reference test_laop; la_op.cc gemm/gemm2) ---------------------
+
+@pytest.mark.parametrize("ta", [False, True])
+@pytest.mark.parametrize("tb", [False, True])
+def test_gemm_transpose_alpha_beta(ta, tb):
+    A = RS.rand(3, 4)
+    B = RS.rand(4, 5)
+    An = A.T if ta else A
+    Bn = B.T if tb else B
+    C = RS.rand(3, 5)
+    alpha, beta = 2.5, -0.5
+    got = la.gemm(_f64(An), _f64(Bn), _f64(C), transpose_a=ta,
+                  transpose_b=tb, alpha=alpha, beta=beta)
+    np.testing.assert_allclose(got.asnumpy(), alpha * (A @ B) + beta * C,
+                               rtol=1e-10)
+    got2 = la.gemm2(_f64(An), _f64(Bn), transpose_a=ta, transpose_b=tb,
+                    alpha=alpha)
+    np.testing.assert_allclose(got2.asnumpy(), alpha * (A @ B), rtol=1e-10)
+
+
+def test_gemm_gradients():
+    A, B, C = RS.rand(2, 3), RS.rand(3, 2), RS.rand(2, 2)
+    check_numeric_gradient(
+        lambda a, b, c: la.gemm(a, b, c, alpha=1.5, beta=0.5),
+        [_f64(A), _f64(B), _f64(C)], eps=1e-5, rtol=1e-4, atol=1e-6)
+
+
+# ---- potrf / potri (reference test_laop_2) -------------------------------
+
+def test_potrf_potri_values():
+    A = _spd(4)
+    L = la.potrf(_f64(A)).asnumpy()
+    np.testing.assert_allclose(L @ L.T, A, rtol=1e-9)
+    assert np.allclose(L, np.tril(L)), "potrf must return the lower factor"
+    # potri consumes the CHOLESKY FACTOR, producing inv(L L^T)
+    # (la_op.cc potri contract)
+    Ainv = la.potri(_f64(L)).asnumpy()
+    np.testing.assert_allclose(Ainv, np.linalg.inv(A), rtol=1e-8)
+
+
+def test_potrf_gradient():
+    A = _spd(3)
+    check_numeric_gradient(lambda a: la.potrf(a), [_f64(A)],
+                           eps=1e-5, rtol=1e-3, atol=1e-5)
+
+
+def test_potrf_batched():
+    As = np.stack([_spd(3), _spd(3)])
+    Ls = la.potrf(_f64(As)).asnumpy()
+    for i in range(2):
+        np.testing.assert_allclose(Ls[i] @ Ls[i].T, As[i], rtol=1e-9)
+
+
+# ---- trmm / trsm attribute surface (reference test_laop_2) ---------------
+
+@pytest.mark.parametrize("transpose", [False, True])
+@pytest.mark.parametrize("rightside", [False, True])
+def test_trmm(transpose, rightside):
+    L = np.tril(RS.rand(3, 3) + np.eye(3))
+    B = RS.rand(3, 3)
+    alpha = 1.7
+    Lop = L.T if transpose else L
+    want = alpha * (B @ Lop) if rightside else alpha * (Lop @ B)
+    got = la.trmm(_f64(L), _f64(B), transpose=transpose,
+                  rightside=rightside, alpha=alpha)
+    np.testing.assert_allclose(got.asnumpy(), want, rtol=1e-10)
+
+
+@pytest.mark.parametrize("transpose", [False, True])
+@pytest.mark.parametrize("rightside", [False, True])
+def test_trsm(transpose, rightside):
+    L = np.tril(RS.rand(3, 3)) + 3 * np.eye(3)
+    B = RS.rand(3, 3)
+    alpha = 0.8
+    Lop = L.T if transpose else L
+    # trsm solves op(L) X = alpha B (or X op(L) = alpha B rightside)
+    got = la.trsm(_f64(L), _f64(B), transpose=transpose,
+                  rightside=rightside, alpha=alpha).asnumpy()
+    if rightside:
+        np.testing.assert_allclose(got @ Lop, alpha * B, rtol=1e-9)
+    else:
+        np.testing.assert_allclose(Lop @ got, alpha * B, rtol=1e-9)
+
+
+def test_trmm_trsm_inverse_roundtrip():
+    # trsm undoes trmm at matching attributes (reference checks the same
+    # composition law)
+    L = np.tril(RS.rand(4, 4)) + 2 * np.eye(4)
+    B = RS.rand(4, 4)
+    y = la.trmm(_f64(L), _f64(B), alpha=2.0)
+    back = la.trsm(_f64(L), y, alpha=0.5)
+    np.testing.assert_allclose(back.asnumpy(), B, rtol=1e-9)
+
+
+def test_trsm_gradient():
+    L = np.tril(RS.rand(3, 3)) + 2 * np.eye(3)
+    B = RS.rand(3, 3)
+    check_numeric_gradient(
+        lambda a, b: la.trsm(a, b), [_f64(L), _f64(B)],
+        eps=1e-5, rtol=1e-3, atol=1e-5)
+
+
+# ---- syrk (reference test_laop_3) ----------------------------------------
+
+@pytest.mark.parametrize("transpose", [False, True])
+def test_syrk(transpose):
+    A = RS.rand(3, 5)
+    alpha = 1.3
+    want = alpha * (A.T @ A if transpose else A @ A.T)
+    got = la.syrk(_f64(A), transpose=transpose, alpha=alpha)
+    np.testing.assert_allclose(got.asnumpy(), want, rtol=1e-10)
+
+
+# ---- gelqf (reference test_laop_3: A = L Q, Q orthonormal rows) ----------
+
+def test_gelqf_factorization_law():
+    A = RS.rand(3, 5)
+    Q, L = la.gelqf(_f64(A))
+    Qn, Ln = Q.asnumpy(), L.asnumpy()
+    np.testing.assert_allclose(Ln @ Qn, A, rtol=1e-9)
+    np.testing.assert_allclose(Qn @ Qn.T, np.eye(3), atol=1e-10)
+    assert np.allclose(Ln, np.tril(Ln))
+
+
+# ---- syevd (reference test_laop_4: A = U^T diag(w) U) --------------------
+
+def test_syevd_factorization_law():
+    A = _spd(4)
+    U, w = la.syevd(_f64(A))
+    Un, wn = U.asnumpy(), w.asnumpy()
+    np.testing.assert_allclose(Un.T @ np.diag(wn) @ Un, A, rtol=1e-9)
+    np.testing.assert_allclose(np.sort(wn), np.linalg.eigvalsh(A),
+                               rtol=1e-9)
+
+
+# ---- sumlogdiag (reference test_laop) ------------------------------------
+
+def test_sumlogdiag():
+    A = _spd(4)
+    got = la.sumlogdiag(_f64(A))
+    np.testing.assert_allclose(got.asnumpy(),
+                               np.log(np.diag(A)).sum(), rtol=1e-10)
+    check_numeric_gradient(lambda a: la.sumlogdiag(a), [_f64(A)],
+                           eps=1e-5, rtol=1e-4, atol=1e-6)
+
+
+def test_cholesky_logdet_pipeline():
+    # the reference's canonical laop use: logdet via potrf + sumlogdiag,
+    # gradient flows end to end
+    A = _spd(3)
+
+    def logdet(a):
+        return 2.0 * la.sumlogdiag(la.potrf(a))
+
+    got = float(logdet(_f64(A)).asnumpy())
+    np.testing.assert_allclose(got, np.linalg.slogdet(A)[1], rtol=1e-9)
+    check_numeric_gradient(logdet, [_f64(A)], eps=1e-5, rtol=1e-3,
+                           atol=1e-5)
+
+
+# ---- makediag / maketrian / extract* offsets (reference test_laop_5) -----
+
+@pytest.mark.parametrize("offset", [0, 1, -1])
+def test_makediag_extractdiag_roundtrip(offset):
+    v = RS.rand(3)
+    D = la.makediag(_f64(v), offset=offset).asnumpy()
+    np.testing.assert_allclose(D, np.diag(v, k=offset), rtol=1e-12)
+    back = la.extractdiag(_f64(D), offset=offset).asnumpy()
+    np.testing.assert_allclose(back, v, rtol=1e-12)
+
+
+@pytest.mark.parametrize("lower", [True, False])
+@pytest.mark.parametrize("offset", [0, 1])
+def test_maketrian_extracttrian_roundtrip(lower, offset):
+    if lower and offset > 0:
+        pytest.skip("reference: offset>0 only meaningful for upper")
+    n = 3
+    size = n * (n + 1) // 2 if offset == 0 else (n * (n - 1)) // 2
+    v = RS.rand(size)
+    off = offset if not lower else -offset
+    T = la.maketrian(_f64(v), offset=off, lower=lower).asnumpy()
+    # all mass lands in the requested triangle
+    tri = np.tril(T, k=off) if lower else np.triu(T, k=off)
+    np.testing.assert_allclose(T, tri, rtol=1e-12)
+    back = la.extracttrian(_f64(T), offset=off, lower=lower).asnumpy()
+    np.testing.assert_allclose(back, v, rtol=1e-12)
+
+
+def test_potri_gradient_via_trace():
+    L = np.linalg.cholesky(_spd(3))
+    check_numeric_gradient(
+        lambda a: la.potri(a).sum(), [_f64(L)],
+        eps=1e-5, rtol=1e-3, atol=1e-4)

@@ -209,6 +209,7 @@ def test_array_function_sweep(name, args_fn, kwargs):
     got = fn(*args, **kwargs)
     gots = got if isinstance(got, (list, tuple)) else [got]
     wants = want if isinstance(want, (list, tuple)) else [want]
+    assert len(gots) == len(wants), (len(gots), len(wants))
     for g, w in zip(gots, wants):
         if isinstance(g, NDArray):
             g = g.asnumpy()
@@ -301,6 +302,7 @@ def test_array_function_sweep_r5b(name, args_fn, kwargs):
     got = fn(*args, **kwargs)
     gots = got if isinstance(got, (list, tuple)) else [got]
     wants = want if isinstance(want, (list, tuple)) else [want]
+    assert len(gots) == len(wants), (len(gots), len(wants))
     for g, w in zip(gots, wants):
         if isinstance(g, NDArray):
             g = g.asnumpy()

@@ -253,3 +253,25 @@ def test_sparse_stateful_members_denied_loudly():
 def test_variadic_op_introspection():
     args = mx.operator.get_operator_arguments("add_n")
     assert args.narg == 1 and args.types == ["NDArray-or-Symbol[]"]
+
+
+def test_sparse_fluent_registry_ops():
+    # fluent surface includes REGISTRY-resolved ops, not just the
+    # hand-written NDArray methods (csr.softmax vs csr.sum)
+    from scipy.special import softmax as sp_softmax
+
+    csr, dns = _rand_csr((5, 4), 0.5)
+    np.testing.assert_allclose(csr.softmax().asnumpy(),
+                               sp_softmax(dns, axis=-1), rtol=1e-5)
+    np.testing.assert_allclose(csr.square().asnumpy(), dns * dns,
+                               rtol=1e-6)
+
+
+def test_sparse_dot_out_kwarg():
+    csr, dns = _rand_csr((4, 3), 0.5)
+    rhs = nd.array(RS.uniform(-1, 1, (3, 2)).astype("float32"))
+    z = nd.zeros((4, 2))
+    r = nd.dot(csr, rhs, out=z)
+    assert r is z
+    np.testing.assert_allclose(z.asnumpy(), dns @ rhs.asnumpy(),
+                               rtol=1e-5, atol=1e-6)
