@@ -26,6 +26,13 @@ def do_checkpoint(prefix, period=1):
         if (epoch + 1) % period == 0 and net is not None:
             fname = f"{prefix}-{epoch + 1:04d}.params"
             net.save_parameters(fname)
+            # checkpoint files are read by external consumers (upload
+            # hooks, eval jobs) — barrier so the file exists when the
+            # callback returns, like the reference's synchronous save
+            # (save_parameters itself stays async; docs/migration.md)
+            from .engine import waitall
+
+            waitall()
             logging.info("Saved checkpoint to \"%s\"", fname)
 
     return _callback

@@ -406,12 +406,18 @@ def multibox_detection(cls_prob, loc_pred, anchors, clip=True, threshold=0.01,
 
 def boolean_mask(data, index, axis=0):
     """Select rows where index != 0 (reference: contrib/boolean_mask.cc).
-    Eager: output length is value-dependent."""
-    arr = data.asnumpy() if isinstance(data, NDArray) else _np.asarray(data)
+
+    Dynamic-OUTPUT op: the row count is value-dependent, so the kept
+    indices are snapshotted eagerly (host nonzero) and the gather runs
+    through the tape — gradient scatters into the kept rows exactly
+    like the reference backward; `index` gets no gradient there either."""
     idx = index.asnumpy() if isinstance(index, NDArray) else \
         _np.asarray(index)
-    take = _np.nonzero(idx.astype(bool))[0]
-    return NDArray(jnp.asarray(_np.take(arr, take, axis=axis)))
+    take = jnp.asarray(_np.nonzero(idx.astype(bool))[0])
+    if isinstance(data, NDArray):
+        return apply_op(lambda x: jnp.take(x, take, axis=axis),
+                        data, name="boolean_mask")
+    return NDArray(jnp.take(jnp.asarray(data), take, axis=axis))
 
 
 def index_array(data, axes=None):

@@ -574,8 +574,25 @@ class HybridBlock(Block):
             object.__setattr__(
                 self, "_last_input_specs",
                 [(tuple(a.shape), a.dtype) for a in args])
-            if self._active:
-                return self._call_cached(*args)
+            if self._active and not getattr(self, "_dynamic_graph", False):
+                try:
+                    return self._call_cached(*args)
+                except (jax.errors.TracerArrayConversionError,
+                        jax.errors.ConcretizationTypeError):
+                    # the forward contains a dynamic-OUTPUT op
+                    # (boolean_mask, box_nms selection — value-dependent
+                    # shapes XLA cannot trace). Reference CachedOp flips
+                    # to dynamic-shape execution (imperative per-op) for
+                    # such graphs; we do the same: run this block eagerly
+                    # from now on, keeping hybridize() a no-op for it.
+                    import warnings
+
+                    warnings.warn(
+                        f"{type(self).__name__}.forward contains a "
+                        "dynamic-output op; running imperatively "
+                        "(reference CachedOp dynamic-shape mode)",
+                        stacklevel=2)
+                    object.__setattr__(self, "_dynamic_graph", True)
         out = self.forward(*args, **kwargs)
         self._fire_fwd_hooks(args, out)
         return out

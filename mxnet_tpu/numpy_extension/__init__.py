@@ -532,6 +532,16 @@ def batch_flatten(x):
                   name="batch_flatten")
 
 
+def boolean_mask(data, index, axis=0):
+    """Dynamic-output row selection (reference: _npi.boolean_mask,
+    contrib/boolean_mask.cc — the dynamic-shape exemplar op). Eager
+    index snapshot + differentiable gather; hybridized blocks
+    containing it drop to imperative mode (CachedOp dynamic-shape)."""
+    from ..contrib.ops import boolean_mask as _bm
+
+    return _bm(data, index, axis=axis)
+
+
 from ..ndarray.register import populate as _populate  # noqa: E402
 
 _populate(globals())

@@ -182,9 +182,13 @@ take = _reg("take")(
                             axis=a.get("axis", 0)))
 
 
-def Embedding(data, weight, input_dim=None, output_dim=None,
-              name=None, **kw):  # noqa: ARG001
-    return Symbol.create("Embedding", data, weight, name=name,
+def Embedding(data, weight=None, input_dim=None, output_dim=None,
+              name=None, attr=None, **kw):
+    attr = _annot_kwargs(attr, kw)
+    name = _resolve_name(name, "embedding")
+    if weight is None:
+        weight = _auto_param(name, "weight", attr)
+    return Symbol.create("Embedding", data, weight, name=name, attr=attr,
                          input_dim=input_dim, output_dim=output_dim)
 
 
@@ -201,11 +205,59 @@ log_softmax = _reg("log_softmax")(
     lambda ins, a: _nn.log_softmax(ins[0], axis=a.get("axis", -1)))
 
 
-def FullyConnected(data, weight, bias=None, num_hidden=None, no_bias=False,
-                   flatten=True, name=None):  # noqa: ARG001
-    ins = (data, weight) if no_bias or bias is None else (data, weight, bias)
-    return Symbol.create("FullyConnected", *ins, name=name,
-                         no_bias=bool(no_bias or bias is None),
+
+def _resolve_name(name, hint):
+    from .. import name as _name_mod
+
+    return _name_mod.current().get(name, hint)
+
+
+# GPU-only knobs reference call sites pass freely; meaningless on TPU
+_IGNORED_KWARGS = frozenset({"cudnn_off", "cudnn_tune", "workspace"})
+
+
+def _annot_kwargs(attr, kw):
+    """Move lr_mult-style annotation kwargs from a builder's **kw into
+    the attr dict (the reference accepts them on any symbol call), and
+    warn on anything else unrecognized — silently swallowing a
+    misspelled kwarg (num_hiden=...) hides the bug until bind time."""
+    import warnings
+
+    from .symbol import Symbol
+
+    attr = dict(attr or {})
+    for k in [k for k in kw if k in Symbol._MIRROR_KEYS]:
+        attr[k] = kw.pop(k)
+    unknown = [k for k in kw if k not in _IGNORED_KWARGS]
+    if unknown:
+        warnings.warn(f"ignored symbol kwargs {unknown}", stacklevel=3)
+    return attr
+
+
+def _auto_param(final_name, slot, attr):
+    """Reference nnvm composition: an omitted parameter input becomes a
+    variable named {opname}_{slot}, inheriting the op's __dunder__
+    annotation attrs (test_attr.py:72 conv_weight['__mood__'])."""
+    from .symbol import Symbol, var
+
+    v = var(f"{final_name}_{slot}")
+    dunder = {k: val for k, val in Symbol._normalize_user_attrs(
+        dict(attr or {})).items() if k.startswith("__")}
+    v._uattrs.update(dunder)
+    return v
+
+def FullyConnected(data, weight=None, bias=None, num_hidden=None,
+                   no_bias=False, flatten=True, name=None, attr=None,
+                   **kw):
+    attr = _annot_kwargs(attr, kw)
+    name = _resolve_name(name, "fullyconnected")
+    if weight is None:
+        weight = _auto_param(name, "weight", attr)
+    if bias is None and not no_bias:
+        bias = _auto_param(name, "bias", attr)
+    ins = (data, weight) if no_bias else (data, weight, bias)
+    return Symbol.create("FullyConnected", *ins, name=name, attr=attr,
+                         no_bias=bool(no_bias),
                          num_hidden=num_hidden, flatten=flatten)
 
 
@@ -216,12 +268,18 @@ register_sym_op(
                              flatten=a.get("flatten", True)))
 
 
-def Convolution(data, weight, bias=None, kernel=None, num_filter=None,
+def Convolution(data, weight=None, bias=None, kernel=None, num_filter=None,
                 stride=None, pad=None, dilate=None, num_group=1,
-                no_bias=False, name=None, **kw):  # noqa: ARG001
-    ins = (data, weight) if no_bias or bias is None else (data, weight, bias)
-    return Symbol.create("Convolution", *ins, name=name,
-                         no_bias=bool(no_bias or bias is None),
+                no_bias=False, name=None, attr=None, **kw):  # noqa: ARG001
+    attr = _annot_kwargs(attr, kw)
+    name = _resolve_name(name, "convolution")
+    if weight is None:
+        weight = _auto_param(name, "weight", attr)
+    if bias is None and not no_bias:
+        bias = _auto_param(name, "bias", attr)
+    ins = (data, weight) if no_bias else (data, weight, bias)
+    return Symbol.create("Convolution", *ins, name=name, attr=attr,
+                         no_bias=bool(no_bias),
                          kernel=kernel, num_filter=num_filter,
                          stride=stride, pad=pad, dilate=dilate,
                          num_group=num_group)
@@ -236,11 +294,17 @@ register_sym_op(
                             groups=a.get("num_group", 1)))
 
 
-def Deconvolution(data, weight, bias=None, no_bias=False, stride=None,
-                  pad=None, name=None, **kw):  # noqa: ARG001
-    ins = (data, weight) if no_bias or bias is None else (data, weight, bias)
-    return Symbol.create("Deconvolution", *ins, name=name,
-                         no_bias=bool(no_bias or bias is None),
+def Deconvolution(data, weight=None, bias=None, no_bias=False, stride=None,
+                  pad=None, name=None, attr=None, **kw):  # noqa: ARG001
+    attr = _annot_kwargs(attr, kw)
+    name = _resolve_name(name, "deconvolution")
+    if weight is None:
+        weight = _auto_param(name, "weight", attr)
+    if bias is None and not no_bias:
+        bias = _auto_param(name, "bias", attr)
+    ins = (data, weight) if no_bias else (data, weight, bias)
+    return Symbol.create("Deconvolution", *ins, name=name, attr=attr,
+                         no_bias=bool(no_bias),
                          kernel=kw.get("kernel"),
                          num_filter=kw.get("num_filter"),
                          num_group=kw.get("num_group", 1),
@@ -290,13 +354,21 @@ register_sym_op(
                             global_pool=a.get("global_pool", False)))
 
 
-def BatchNorm(data, gamma, beta, moving_mean, moving_var, eps=1e-5,
-              momentum=0.9, fix_gamma=False, use_global_stats=True,
-              name=None, **kw):  # noqa: ARG001
+def BatchNorm(data, gamma=None, beta=None, moving_mean=None,
+              moving_var=None, eps=1e-5, momentum=0.9, fix_gamma=False,
+              use_global_stats=True, name=None, attr=None, **kw):
     """Inference-mode BN (symbolic graphs are deployment artifacts; train
     BN lives in gluon.nn.BatchNorm)."""
+    attr = _annot_kwargs(attr, kw)
+    name = _resolve_name(name, "batchnorm")
+    gamma = gamma if gamma is not None else _auto_param(name, "gamma", attr)
+    beta = beta if beta is not None else _auto_param(name, "beta", attr)
+    moving_mean = moving_mean if moving_mean is not None \
+        else _auto_param(name, "moving_mean", attr)
+    moving_var = moving_var if moving_var is not None \
+        else _auto_param(name, "moving_var", attr)
     return Symbol.create("BatchNorm", data, gamma, beta, moving_mean,
-                         moving_var, name=name, eps=eps)
+                         moving_var, name=name, attr=attr, eps=eps)
 
 
 register_sym_op(

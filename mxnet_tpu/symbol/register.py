@@ -69,14 +69,18 @@ _MULTI_OUT = {
 def _tensor_param_names(fn):
     """Positional parameter names of the registered pure function — the
     op's tensor-input slots, in order (attrs are keyword-only or trailing
-    defaults)."""
+    defaults) — plus the set of REQUIRED (no-default) names, which is
+    what gates nnvm-style auto-param creation."""
     try:
-        params = inspect.signature(fn).parameters.values()
+        params = [p for p in inspect.signature(fn).parameters.values()
+                  if p.kind in (inspect.Parameter.POSITIONAL_ONLY,
+                                inspect.Parameter.POSITIONAL_OR_KEYWORD)]
     except (ValueError, TypeError):
-        return []
-    return [p.name for p in params
-            if p.kind in (inspect.Parameter.POSITIONAL_ONLY,
-                          inspect.Parameter.POSITIONAL_OR_KEYWORD)]
+        return [], frozenset()
+    names = [p.name for p in params]
+    required = frozenset(p.name for p in params
+                         if p.default is inspect.Parameter.empty)
+    return names, required
 
 
 def _unwrap_tree(x):
@@ -118,7 +122,31 @@ _SPECIAL_LOWERING = {
 }
 
 
-def _make_builder(op_name, pos_names):
+# parameter slots nnvm auto-creates as variables when a symbol op is
+# called without them (reference: symbol composition names them
+# {opname}_{slot} — mx.sym.Convolution(data=d, ...) materializes
+# conv_weight/conv_bias; test_attr.py expects the __dunder__ annotation
+# attrs to propagate onto them)
+_AUTO_PARAM_SLOTS = frozenset(
+    {"weight", "bias", "gamma", "beta", "moving_mean", "moving_var",
+     "parameters", "state", "state_cell"})
+
+
+def _make_builder(op_name, pos_names, required=frozenset()):
+    def _auto_allowed(slot, kwargs):
+        """nnvm-style composition creates a variable for a missing slot
+        only when the op genuinely consumes it: the slot is a
+        parameter-style name, REQUIRED by the signature (optional slots
+        like prelu's gamma stay absent), and not disabled by an attr."""
+        if slot not in _AUTO_PARAM_SLOTS or slot not in required:
+            return False
+        if slot == "bias" and _battr(kwargs.get("no_bias", False)):
+            return False
+        if slot == "state_cell" \
+                and str(kwargs.get("mode", "lstm")) != "lstm":
+            return False  # RNN: cell state is an input only for lstm
+        return True
+
     def builder(*inputs, name=None, **kwargs):
         # a None tensor slot means "input absent" (reference convention:
         # e.g. bias with no_bias=True) — drop it rather than making an
@@ -127,14 +155,61 @@ def _make_builder(op_name, pos_names):
         for k in [k for k, v in kwargs.items()
                   if v is None and k in pos_names]:
             kwargs.pop(k)
-        # named tensor inputs (data=x, weight=w) go to their signature
-        # slots, in signature order after any positional inputs
-        named = [(k, v) for k, v in kwargs.items() if isinstance(v, Symbol)]
-        for k, _ in named:
-            kwargs.pop(k)
-        named.sort(key=lambda kv: pos_names.index(kv[0])
-                   if kv[0] in pos_names else len(pos_names))
-        inputs.extend(v for _, v in named)
+        # place operands into their signature slots: positionals fill a
+        # prefix, named tensor kwargs land at their named slot (gaps in
+        # between auto-create, so batch_norm(d, beta=b) keeps beta in
+        # the beta slot instead of silently occupying gamma)
+        slots = {}
+        for i, v in enumerate(inputs):
+            if i < len(pos_names):
+                slots[pos_names[i]] = v
+            else:
+                slots[f"#extra{i}"] = v  # varargs ops (add_n)
+        extra_named = []
+        for k in [k for k, v in kwargs.items() if isinstance(v, Symbol)]:
+            if k in pos_names:
+                slots[k] = kwargs.pop(k)
+            else:
+                # reference spellings sometimes differ from our signature
+                # names (sym.histogram(a=...)); unknown-named symbol
+                # operands fill remaining slots in call order
+                extra_named.append(kwargs.pop(k))
+        filled_idx = [pos_names.index(k) for k in slots if k in pos_names]
+        last = max(filled_idx, default=-1)
+        ordered, auto_needed = [], []
+        for i, slot in enumerate(pos_names):
+            if slot in slots:
+                ordered.append(slots[slot])
+                continue
+            if i < last:
+                if not _auto_allowed(slot, kwargs):
+                    raise ValueError(
+                        f"{op_name}: input {slot!r} missing but a later "
+                        f"slot was provided; pass {slot!r} explicitly")
+                auto_needed.append((len(ordered), slot))
+                ordered.append(None)
+            elif _auto_allowed(slot, kwargs):
+                auto_needed.append((len(ordered), slot))
+                ordered.append(None)
+            else:
+                break
+        ordered.extend(v for k, v in slots.items()
+                       if k.startswith("#extra"))
+        ordered.extend(extra_named)
+        if auto_needed:
+            from .. import name as _name_mod
+            from .symbol import var
+
+            final_name = _name_mod.current().get(name, op_name.lower())
+            name = final_name
+            dunder = {k: v for k, v in Symbol._normalize_user_attrs(
+                dict(kwargs.get("attr", None) or {})).items()
+                if k.startswith("__")}
+            for pos, slot in auto_needed:
+                v = var(f"{final_name}_{slot}")
+                v._uattrs.update(dunder)
+                ordered[pos] = v
+        inputs = [v for v in ordered if v is not None]
         nout = _MULTI_OUT.get(op_name, lambda a: 1)(kwargs)
         return Symbol.create(op_name, *inputs, name=name, nout=nout,
                              **kwargs)
@@ -157,8 +232,8 @@ def _generate():
             make = _SPECIAL_LOWERING.get(op_name, _make_lowering)
             register_sym_op(op_name, make(fn))
         if op_name not in _GENERATED:
-            _GENERATED[op_name] = _make_builder(
-                op_name, _tensor_param_names(fn))
+            _names, _req = _tensor_param_names(fn)
+            _GENERATED[op_name] = _make_builder(op_name, _names, _req)
 
 
 _generate()

@@ -39,17 +39,39 @@ def _op_fn(name):
 class Symbol:
     """A node in the lazy graph. Immutable; identity = python object."""
 
-    __slots__ = ("_op", "_name", "_inputs", "_attrs", "_nout", "_out_index")
+    __slots__ = ("_op", "_name", "_inputs", "_attrs", "_nout",
+                 "_out_index", "_uattrs")
 
     _auto_count = {}
 
-    def __init__(self, op, name, inputs, attrs=None, nout=1, out_index=None):
+    # Variable kwargs the reference mirrors into __dunder__ hidden attrs
+    # (python/mxnet/symbol/symbol.py var(): lr_mult -> __lr_mult__ ...)
+    # NB: no real op kwarg (dtype, axis, ...) may appear here — create()
+    # pops these out of the op's attrs
+    _MIRROR_KEYS = frozenset(
+        {"lr_mult", "wd_mult", "force_mirroring", "profiler_scope"})
+
+    def __init__(self, op, name, inputs, attrs=None, nout=1, out_index=None,
+                 uattrs=None):
         self._op = op            # None => variable (leaf)
         self._name = name
         self._inputs = list(inputs)
         self._attrs = dict(attrs or {})
         self._nout = nout
         self._out_index = out_index  # set when slicing a multi-output node
+        # annotation attrs (AttrScope / attr= / lr_mult-style kwargs) —
+        # kept apart from _attrs, which doubles as the op's kwargs
+        self._uattrs = dict(uattrs or {})
+
+    @staticmethod
+    def _normalize_user_attrs(d):
+        out = {}
+        for k, v in (d or {}).items():
+            v = v if isinstance(v, str) else str(v)
+            out[k] = v
+            if not k.startswith("__") and k in Symbol._MIRROR_KEYS:
+                out[f"__{k}__"] = v
+        return out
 
     # -- construction helpers ---------------------------------------------
     @staticmethod
@@ -74,9 +96,17 @@ class Symbol:
         from .. import attribute as _attr_mod
         from .. import name as _name_mod
 
+        # annotation attrs (attr= dict, lr_mult-style kwargs, AttrScope)
+        # ride _uattrs; _attrs stays the op's real kwargs for lowering
+        user = dict(attrs.pop("attr", None) or {})
+        for k in [k for k in attrs if k in Symbol._MIRROR_KEYS]:
+            user[k] = attrs.pop(k)
+        uattrs = _attr_mod.current().get(
+            Symbol._normalize_user_attrs(user))
+
         final_name = _name_mod.current().get(name, op.lower())
-        merged = _attr_mod.current().get(attrs)
-        return Symbol(op, final_name, inputs, merged, nout=nout)
+        return Symbol(op, final_name, inputs, attrs, nout=nout,
+                      uattrs=uattrs)
 
     # -- python operators --------------------------------------------------
     def __add__(self, o):
@@ -164,7 +194,8 @@ class Symbol:
         if self._nout == 1 or self._out_index is not None:
             return [self]
         return [Symbol(self._op, self._name, self._inputs, self._attrs,
-                       nout=self._nout, out_index=i)
+                       nout=self._nout, out_index=i,
+                       uattrs=self._uattrs)
                 for i in range(self._nout)]
 
     # -- introspection -----------------------------------------------------
@@ -173,7 +204,33 @@ class Symbol:
         return self._name
 
     def attr(self, key):
+        """Annotation attrs (strings — AttrScope / attr= / lr_mult-style
+        kwargs) take priority; op kwargs come back raw for internal
+        consumers (the onnx exporter reads tuples/ints through here)."""
+        if key in self._uattrs:
+            return self._uattrs[key]
         return self._attrs.get(key)
+
+    def list_attr(self):
+        """This node's annotation attrs (reference: Symbol.list_attr —
+        shallow, strings)."""
+        return dict(self._uattrs)
+
+    def attr_dict(self):
+        """node name -> merged {op kwargs (stringified) + annotation
+        attrs} for every node in the graph (reference: Symbol.attr_dict;
+        test_attr.py:72 expects conv params AND propagated __dunder__
+        attrs)."""
+        out = {}
+        for s in self._topo():
+            entry = {k: v if isinstance(v, str) else str(v)
+                     for k, v in s._attrs.items()
+                     if not k.startswith("__")}
+            entry.update(s._uattrs)
+            if entry:
+                merged = out.setdefault(s._name, {})
+                merged.update(entry)
+        return out
 
     def _topo(self):
         seen, order = set(), []
@@ -207,6 +264,10 @@ class Symbol:
             for s in self._inputs:
                 names.extend(s.list_outputs())
             return names
+        if self._op is None:
+            # variables keep their bare name (reference: internals
+            # lookup spells sym.get_internals()['fc2_weight'])
+            return [self._name]
         if self._nout == 1 or self._out_index is not None:
             suffix = "" if self._out_index in (None, 0) else \
                 str(self._out_index)
@@ -281,15 +342,31 @@ class Symbol:
         arg_shapes, out_shapes = _infer_shapes(self, kwargs, partial=True)
         return arg_shapes, out_shapes, []
 
-    def infer_type(self, **kwargs):
+    def infer_type(self, *args, partial=False, **kwargs):
+        """(arg_types, out_types, aux_types). Unspecified arguments take
+        the common dtype of the specified ones (reference InferType
+        propagates types through the graph: a float64 input makes the
+        peer operand float64, infer_type_pass.cc), falling back to
+        float32."""
         names = self.list_arguments()
-        known = {n: jnp.zeros((1,), kwargs.get(n, _np.float32))
+        for n, t in zip(names, args):
+            if t is not None:
+                kwargs.setdefault(n, t)
+        spec = {n: _np.dtype(kwargs[n]) for n in names
+                if kwargs.get(n) is not None}
+        uniq = set(spec.values())
+        default = uniq.pop() if len(uniq) == 1 else _np.dtype(_np.float32)
+        known = {n: jax.ShapeDtypeStruct((1,), spec.get(n, default))
                  for n in names}
-        outs = jax.eval_shape(
-            self._lower(), {n: jax.ShapeDtypeStruct(a.shape, a.dtype)
-                            for n, a in known.items()})
-        return ([known[n].dtype for n in names],
-                [o.dtype for o in outs], [])
+        outs = jax.eval_shape(self._lower(), known)
+        arg_types = [known[n].dtype if (n in spec or not partial) else None
+                     for n in names]
+        return (arg_types, [o.dtype for o in outs], [])
+
+    def infer_type_partial(self, *args, **kwargs):
+        """Like infer_type but unspecified arguments come back as None
+        instead of a propagated guess (reference: infer_type_partial)."""
+        return self.infer_type(*args, partial=True, **kwargs)
 
     def bind(self, ctx=None, args=None, args_grad=None, grad_req="write",
              aux_states=None, **kwargs):  # noqa: ARG002
@@ -302,11 +379,21 @@ class Symbol:
     _bind = bind
 
     def simple_bind(self, ctx=None, grad_req="write", **shape_kwargs):
-        args = {}
-        for n in self.list_arguments():
-            if n not in shape_kwargs:
-                raise ValueError(f"simple_bind needs shape for {n}")
-            args[n] = jnp.zeros(shape_kwargs[n], jnp.float32)
+        names = self.list_arguments()
+        missing = [n for n in names if n not in shape_kwargs]
+        if missing:
+            # deduce parameter shapes from the given inputs (reference:
+            # simple_bind runs InferShape and allocates every argument —
+            # auto-created conv/fc params need no explicit shape)
+            arg_shapes, _, _ = self.infer_shape_partial(**shape_kwargs)
+            deduced = dict(zip(names, arg_shapes))
+            for n in missing:
+                if deduced.get(n) is None:
+                    raise ValueError(
+                        f"simple_bind needs shape for {n} "
+                        "(not deducible from the given inputs)")
+                shape_kwargs[n] = deduced[n]
+        args = {n: jnp.zeros(shape_kwargs[n], jnp.float32) for n in names}
         return Executor(self, args, None, grad_req)
 
     # -- serialization -----------------------------------------------------
@@ -317,13 +404,16 @@ class Symbol:
         idx = {id(s): i for i, s in enumerate(order)}
         nodes = []
         for s in order:
-            nodes.append({
+            node = {
                 "op": s._op, "name": s._name,
                 "attrs": _json_attrs(s._attrs),
                 "inputs": [idx[id(i)] for i in s._inputs],
                 "nout": s._nout,
                 "out_index": s._out_index,
-            })
+            }
+            if s._uattrs:
+                node["uattrs"] = dict(s._uattrs)
+            nodes.append(node)
         return json.dumps({"format": "mxnet_tpu-symbol", "version": 1,
                            "nodes": nodes, "head": idx[id(self)]}, indent=2)
 
@@ -370,14 +460,36 @@ register_sym_op("_const", lambda ins, attrs: jnp.asarray(attrs["value"]))
 register_sym_op("_group", lambda ins, attrs: tuple(ins))
 
 
-def var(name, shape=None, dtype=None, init=None, **kwargs):  # noqa: ARG001
-    """Create a variable (reference: symbol.var / Variable)."""
+def var(name, shape=None, dtype=None, init=None, attr=None, **kwargs):
+    """Create a variable (reference: symbol.var / Variable). `attr` and
+    lr_mult-style kwargs become string annotation attrs (mirrored to
+    __dunder__ spellings); the ambient AttrScope fills defaults
+    (test_attr.py:23)."""
+    from .. import attribute as _attr_mod
+
     attrs = {}
     if shape is not None:
         attrs["__shape__"] = tuple(shape)
     if dtype is not None:
         attrs["__dtype__"] = str(_np.dtype(dtype))
-    return Symbol(None, name, [], attrs)
+    user = dict(attr or {})
+    user.update(kwargs)
+    if init is not None:
+        user.setdefault("__init__", str(init))
+    # Variable KWARGS (lr_mult=..., reference var() specials) and
+    # force_mirroring mirror to __dunder__; arbitrary attr= entries do
+    # NOT grow phantom dunders (they would shadow the real
+    # __dtype__/__shape__ channels and leak onto auto-created params)
+    mirrored = {}
+    for k, v in user.items():
+        v = v if isinstance(v, str) else str(v)
+        mirrored[k] = v
+        if not k.startswith("__") and (k in Symbol._MIRROR_KEYS
+                                       or k in kwargs
+                                       or k == "force_mirroring"):
+            mirrored[f"__{k}__"] = v
+    uattrs = _attr_mod.current().get(mirrored)
+    return Symbol(None, name, [], attrs, uattrs=uattrs)
 
 
 Variable = var
@@ -408,7 +520,8 @@ def fromjson(js):
         nodes.append(Symbol(nd["op"], nd["name"],
                             [nodes[i] for i in nd["inputs"]],
                             _unjson_attrs(nd["attrs"]), nout=nd["nout"],
-                            out_index=nd.get("out_index")))
+                            out_index=nd.get("out_index"),
+                            uattrs=nd.get("uattrs")))
     return nodes[data["head"]]
 
 

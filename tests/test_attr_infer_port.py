@@ -1,0 +1,167 @@
+"""Symbol attribute + type-inference families (reference:
+tests/python/unittest/test_attr.py and test_infer_type.py — annotation
+attrs with AttrScope/__dunder__ propagation onto nnvm-style
+auto-created parameter variables, and dtype propagation through
+multi-output ops)."""
+import pickle as pkl
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+
+
+def _contain(x, y):
+    for k, v in x.items():
+        if k not in y:
+            return False
+        if isinstance(y[k], dict):
+            if not isinstance(v, dict) or not _contain(v, y[k]):
+                return False
+        elif y[k] != v:
+            return False
+    return True
+
+
+# ---- test_attr.py ports --------------------------------------------------
+
+def test_attr_basic():
+    with mx.AttrScope(group="4", data="great"):
+        data = mx.sym.Variable(
+            "data", attr={"dtype": "data", "group": "1",
+                          "force_mirroring": "True"}, lr_mult=1)
+        gdata = mx.sym.Variable("data2")
+    assert gdata.attr("group") == "4"
+    assert data.attr("group") == "1"
+    assert data.attr("lr_mult") == "1"
+    assert data.attr("__lr_mult__") == "1"
+    assert data.attr("force_mirroring") == "True"
+    assert data.attr("__force_mirroring__") == "True"
+    data2 = pkl.loads(pkl.dumps(data))
+    assert data.attr("dtype") == data2.attr("dtype")
+
+
+def test_operator_attr_scopes():
+    d0 = mx.sym.Variable("d0")
+    with mx.AttrScope(__group__="4", __data__="great"):
+        fc1 = mx.sym.Activation(d0, act_type="relu")
+        with mx.AttrScope(__init_bias__="0.0"):
+            fc2 = mx.sym.FullyConnected(fc1, num_hidden=10, name="fc2")
+    assert fc1.attr("__data__") == "great"
+    assert fc2.attr("__data__") == "great"
+    assert fc2.attr("__init_bias__") == "0.0"
+    fc2copy = pkl.loads(pkl.dumps(fc2))
+    assert fc2copy.tojson() == fc2.tojson()
+    assert fc2.get_internals()["fc2_weight"].name == "fc2_weight"
+
+
+def test_list_attr():
+    data = mx.sym.Variable("data", attr={"mood": "angry"})
+    op = mx.sym.Convolution(
+        data=data, name="conv", kernel=(1, 1), num_filter=1,
+        attr={"__mood__": "so so", "wd_mult": "x"})
+    assert _contain({"__mood__": "so so", "wd_mult": "x",
+                     "__wd_mult__": "x"}, op.list_attr())
+
+
+def test_attr_dict():
+    data = mx.sym.Variable("data", attr={"mood": "angry"})
+    op = mx.sym.Convolution(
+        data=data, name="conv", kernel=(1, 1), num_filter=1,
+        attr={"__mood__": "so so"}, lr_mult=1)
+    assert _contain({
+        "data": {"mood": "angry"},
+        "conv_weight": {"__mood__": "so so"},
+        "conv": {"kernel": "(1, 1)", "__mood__": "so so",
+                 "num_filter": "1", "lr_mult": "1", "__lr_mult__": "1"},
+        "conv_bias": {"__mood__": "so so"}}, op.attr_dict())
+
+
+# ---- nnvm-style auto-created parameters ----------------------------------
+
+def test_auto_created_params_compose_and_run():
+    data = mx.sym.Variable("data")
+    conv = mx.sym.Convolution(data=data, kernel=(3, 3), num_filter=4,
+                              pad=(1, 1), name="c1")
+    bn = mx.sym.BatchNorm(conv, name="bn1")
+    fc = mx.sym.FullyConnected(bn, num_hidden=3, name="f1")
+    args = fc.list_arguments()
+    for expect in ["data", "c1_weight", "c1_bias", "bn1_gamma", "bn1_beta",
+                   "bn1_moving_mean", "bn1_moving_var", "f1_weight",
+                   "f1_bias"]:
+        assert expect in args, (expect, args)
+    ex = fc.simple_bind(mx.cpu(), data=(2, 3, 8, 8))
+    out = ex.forward()
+    assert out[0].shape == (2, 3)
+
+
+def test_auto_param_no_bias_skipped():
+    data = mx.sym.Variable("data")
+    conv = mx.sym.Convolution(data=data, kernel=(1, 1), num_filter=2,
+                              no_bias=True, name="c")
+    assert conv.list_arguments() == ["data", "c_weight"]
+
+
+def test_generated_builder_auto_params():
+    # registry-generated builders (snake_case spellings) share the same
+    # composition rule
+    data = mx.sym.Variable("data")
+    emb = mx.sym.Embedding(data, input_dim=10, output_dim=4, name="e")
+    assert "e_weight" in emb.list_arguments()
+
+
+# ---- test_infer_type.py ports --------------------------------------------
+
+def test_infer_multiout_op():
+    data = mx.nd.arange(16, dtype=np.float64).reshape((4, 4))
+    data.attach_grad()
+    with mx.autograd.record():
+        y = mx.nd.split(data, axis=0, num_outputs=2)
+    y[0].backward()
+    assert data.grad.dtype == np.float64
+
+
+def test_infer_multiout_op2():
+    def test_func(a):
+        q, l = mx.nd.linalg.gelqf(a)
+        return mx.nd.sum(l)
+
+    data32 = mx.nd.random.normal(shape=(2, 3), dtype=np.float32)
+    data32.attach_grad()
+    with mx.autograd.record():
+        test32 = test_func(data32)
+        test32.backward()
+    data64 = mx.nd.Cast(data32, dtype=np.float64)
+    data64.attach_grad()
+    with mx.autograd.record():
+        test64 = test_func(data64)
+        test64.backward()
+    np.testing.assert_allclose(data64.grad.asnumpy(),
+                               data32.grad.asnumpy(),
+                               atol=1e-5, rtol=1e-5)
+
+
+def test_infer_type_propagates():
+    a = mx.sym.var("a")
+    b = mx.sym.var("b")
+    c = a + b
+    arg_types, out_types, _ = c.infer_type(a="float64")
+    assert arg_types == [np.dtype("float64"), np.dtype("float64")]
+    assert out_types == [np.dtype("float64")]
+
+
+def test_infer_type_partial():
+    a = mx.sym.var("a")
+    b = mx.sym.var("b")
+    c = a + b
+    arg_types, out_types, _ = c.infer_type_partial(a="float32")
+    assert arg_types[0] == np.dtype("float32")
+    assert arg_types[1] is None
+
+
+def test_variable_outputs_keep_bare_names():
+    x = mx.sym.var("x")
+    y = mx.sym.Activation(x, act_type="relu", name="act")
+    internals = y.get_internals()
+    names = internals.list_outputs()
+    assert "x" in names and "act_output" in names

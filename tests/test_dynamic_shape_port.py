@@ -1,0 +1,142 @@
+"""Dynamic-shape op family (reference:
+tests/python/unittest/test_dynamic_shape.py — boolean_mask is the
+dynamic-OUTPUT exemplar; the reference CachedOp flips to dynamic-shape
+execution for such graphs, and hybridized blocks here drop to
+imperative mode the same way, with a one-time warning)."""
+import warnings
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import gluon
+from mxnet_tpu import numpy_extension as npx
+
+
+def _mask_block():
+    class _TestBlock(gluon.HybridBlock):
+        def forward(self, data, index):
+            return npx.boolean_mask(data, index)
+
+    return _TestBlock()
+
+
+def _sum_block():
+    class _TestBlock(gluon.HybridBlock):
+        def forward(self, data, index):
+            return mx.np.sum(npx.boolean_mask(data, index)) - 5
+
+    return _TestBlock()
+
+
+DATA = [[1, 2, 3], [4, 5, 6], [7, 8, 9]]
+
+
+def test_dynamic_shape():
+    block = _mask_block()
+    block.hybridize()
+    data = mx.np.array(DATA, dtype="float32")
+    index = mx.np.array([0, 1, 1])
+    data.attach_grad()
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        with mx.autograd.record():
+            result = block(data, index)
+        result.backward()
+    np.testing.assert_allclose(result.asnumpy(), [[4, 5, 6], [7, 8, 9]])
+    np.testing.assert_allclose(
+        data.grad.asnumpy(), [[0, 0, 0], [1, 1, 1], [1, 1, 1.0]])
+
+
+def test_dynamic_shape_with_reshape():
+    class _TestBlock(gluon.HybridBlock):
+        def forward(self, data, index):
+            return npx.boolean_mask(data, index).reshape((-1,))
+
+    block = _TestBlock()
+    block.hybridize()
+    data = mx.np.array(DATA, dtype="float32")
+    index = mx.np.array([0, 1, 1])
+    data.attach_grad()
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        with mx.autograd.record():
+            result = block(data, index)
+        result.backward()
+    np.testing.assert_allclose(result.asnumpy(), [4, 5, 6, 7, 8, 9.0])
+    np.testing.assert_allclose(
+        data.grad.asnumpy(), [[0, 0, 0], [1, 1, 1], [1, 1, 1.0]])
+
+
+def test_dynamic_shape_multiple_hybridize():
+    block = _sum_block()
+    data = mx.np.array(DATA, dtype="float32")
+    index = mx.np.array([0, 1, 0])
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        block.hybridize()
+        np.testing.assert_allclose(block(data, index).asnumpy(), 10.0)
+        block.hybridize(static_alloc=True)
+        np.testing.assert_allclose(block(data, index).asnumpy(), 10.0)
+        block.hybridize(static_alloc=True, static_shape=True)
+        np.testing.assert_allclose(block(data, index).asnumpy(), 10.0)
+
+
+def test_dynamic_shape_switch_hybridize():
+    block = _sum_block()
+    data = mx.np.array(DATA, dtype="float32")
+    index = mx.np.array([0, 1, 0])
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        block.hybridize()
+        np.testing.assert_allclose(block(data, index).asnumpy(), 10.0)
+        block.hybridize(active=False)
+        np.testing.assert_allclose(block(data, index).asnumpy(), 10.0)
+        block.hybridize(static_alloc=True, static_shape=True)
+        np.testing.assert_allclose(block(data, index).asnumpy(), 10.0)
+
+
+@pytest.mark.parametrize("static_alloc", [True, False])
+def test_dynamic_shape_backward(static_alloc):
+    block = _sum_block()
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        block.hybridize(static_alloc=static_alloc)
+        data = mx.np.array(DATA, dtype="float32")
+        index = mx.np.array([0, 1, 0])
+        data.attach_grad()
+        with mx.autograd.record():
+            result = block(data, index)
+        result.backward()
+    np.testing.assert_allclose(result.asnumpy(), 10.0)
+    np.testing.assert_allclose(
+        data.grad.asnumpy(), [[0, 0, 0], [1, 1, 1], [0, 0, 0.0]])
+
+
+def test_dynamic_graph_warns_once_then_stays_imperative():
+    block = _mask_block()
+    block.hybridize()
+    data = mx.np.array(DATA, dtype="float32")
+    index = mx.np.array([1, 0, 1])
+    with warnings.catch_warnings(record=True) as w:
+        warnings.simplefilter("always")
+        block(data, index)
+        block(data, index)
+    dynamic_warnings = [x for x in w if "dynamic-output" in str(x.message)]
+    assert len(dynamic_warnings) == 1
+    # a varying mask keeps working (no stale cached shapes)
+    out = block(data, mx.np.array([0, 0, 1]))
+    np.testing.assert_allclose(out.asnumpy(), [[7, 8, 9.0]])
+
+
+def test_boolean_mask_eager_api_families():
+    # nd.contrib spelling, axis kwarg, all-zero mask
+    data = mx.nd.array(DATA)
+    out = mx.nd.contrib.boolean_mask(data, mx.nd.array([1, 0, 1]))
+    np.testing.assert_allclose(out.asnumpy(), [[1, 2, 3], [7, 8, 9.0]])
+    out_ax1 = mx.nd.contrib.boolean_mask(
+        data, mx.nd.array([0, 1, 1]), axis=1)
+    np.testing.assert_allclose(out_ax1.asnumpy(),
+                               np.array(DATA, "float32")[:, 1:])
+    empty = mx.nd.contrib.boolean_mask(data, mx.nd.array([0, 0, 0]))
+    assert empty.shape == (0, 3)
