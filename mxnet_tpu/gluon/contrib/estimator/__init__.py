@@ -1,4 +1,5 @@
 """Estimator fit loop (reference: gluon/contrib/estimator/)."""
+from .batch_processor import BatchProcessor  # noqa: F401
 from .estimator import Estimator  # noqa: F401
 from .event_handler import (  # noqa: F401
     BatchBegin,

@@ -85,6 +85,19 @@ class Estimator:
             raise ValueError(
                 "fit() needs exactly one of epochs / batches "
                 "(reference: estimator.py fit)")
+        # reference contract (estimator.py _check_data): only gluon
+        # DataLoader is accepted without a custom batch_fn — raw arrays
+        # or legacy DataIters would mis-unpack into (data, label)
+        from ...data.dataloader import DataLoader
+
+        if batch_fn is None:
+            for name, d in (("train_data", train_data),
+                            ("val_data", val_data)):
+                if d is not None and not isinstance(d, DataLoader):
+                    raise ValueError(
+                        f"Estimator only supports gluon DataLoader for "
+                        f"{name} (got {type(d).__name__}); pass batch_fn "
+                        f"to adapt other iterators")
         handlers = list(event_handlers or [])
         stopper = StoppingHandler(epochs, batches)
         handlers.append(stopper)

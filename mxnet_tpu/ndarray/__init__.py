@@ -41,6 +41,12 @@ from .. import numpy_extension as npx  # noqa: F401,E402
 from .utils import load, save, savez  # noqa: F401
 
 
+def empty(shape, ctx=None, dtype=None):  # noqa: ARG001
+    """Allocate without defined contents (reference: nd.empty —
+    grad/output buffers; zero-filled here, jax arrays are immutable)."""
+    return zeros(shape, dtype=dtype)
+
+
 def arange(start, stop=None, step=1.0, repeat=1, infer_range=None,  # noqa: A001
            ctx=None, dtype="float32", **kwargs):  # noqa: ARG001
     """Legacy arange (reference: ndarray/ndarray.py:3510): default dtype
@@ -265,12 +271,17 @@ class CachedOp:
         optimized graph handle."""
         return self._sym
 
-    def __call__(self, *args, out=None, **kwargs):
+    def __call__(self, *args, out=None, default_device=None,
+                 default_ctx=None, **kwargs):  # noqa: ARG002
+        # default_device/default_ctx: placement hint for 0-input graphs
+        # (reference cached_op.py accepts it; placement is jax-managed)
         if kwargs:
             raise TypeError(
                 f"CachedOp got unexpected keyword argument(s) "
                 f"{sorted(kwargs)}; inputs are positional "
                 f"({self._arg_names}) and only out= is accepted")
+        if len(args) == 1 and args[0] is None and not self._arg_names:
+            args = ()  # reference spelling: exe(None, default_device=...)
         if len(args) != len(self._arg_names):
             raise ValueError(
                 f"CachedOp expects {len(self._arg_names)} inputs "

@@ -296,6 +296,11 @@ register_sym_op(
 
 def Deconvolution(data, weight=None, bias=None, no_bias=False, stride=None,
                   pad=None, name=None, attr=None, **kw):  # noqa: ARG001
+    # op params arrive through **kw here — pull them out BEFORE the
+    # annotation sweep or every call warns they were "ignored"
+    kernel = kw.pop("kernel", None)
+    num_filter = kw.pop("num_filter", None)
+    num_group = kw.pop("num_group", 1)
     attr = _annot_kwargs(attr, kw)
     name = _resolve_name(name, "deconvolution")
     if weight is None:
@@ -305,9 +310,8 @@ def Deconvolution(data, weight=None, bias=None, no_bias=False, stride=None,
     ins = (data, weight) if no_bias else (data, weight, bias)
     return Symbol.create("Deconvolution", *ins, name=name, attr=attr,
                          no_bias=bool(no_bias),
-                         kernel=kw.get("kernel"),
-                         num_filter=kw.get("num_filter"),
-                         num_group=kw.get("num_group", 1),
+                         kernel=kernel, num_filter=num_filter,
+                         num_group=num_group,
                          stride=stride, pad=pad)
 
 

@@ -197,18 +197,18 @@ def _make_builder(op_name, pos_names, required=frozenset()):
                        if k.startswith("#extra"))
         ordered.extend(extra_named)
         if auto_needed:
-            from .. import name as _name_mod
-            from .symbol import var
+            # one shared composition helper with the CamelCase builders
+            # (op.py) — annotation source includes lr_mult-style kwargs,
+            # not just the attr= dict
+            from . import op as _op_mod
 
-            final_name = _name_mod.current().get(name, op_name.lower())
+            final_name = _op_mod._resolve_name(name, op_name.lower())
             name = final_name
-            dunder = {k: v for k, v in Symbol._normalize_user_attrs(
-                dict(kwargs.get("attr", None) or {})).items()
-                if k.startswith("__")}
+            user = dict(kwargs.get("attr", None) or {})
+            user.update({k: kwargs[k] for k in kwargs
+                         if k in Symbol._MIRROR_KEYS})
             for pos, slot in auto_needed:
-                v = var(f"{final_name}_{slot}")
-                v._uattrs.update(dunder)
-                ordered[pos] = v
+                ordered[pos] = _op_mod._auto_param(final_name, slot, user)
         inputs = [v for v in ordered if v is not None]
         nout = _MULTI_OUT.get(op_name, lambda a: 1)(kwargs)
         return Symbol.create(op_name, *inputs, name=name, nout=nout,

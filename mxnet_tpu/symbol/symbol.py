@@ -396,6 +396,9 @@ class Symbol:
         args = {n: jnp.zeros(shape_kwargs[n], jnp.float32) for n in names}
         return Executor(self, args, None, grad_req)
 
+    # reference 2.x internal spelling (executor tests use it)
+    _simple_bind = simple_bind
+
     # -- serialization -----------------------------------------------------
     def tojson(self):
         """Serialize the DAG (reference: model-symbol.json; node schema is
@@ -542,6 +545,12 @@ class Executor:
 
         self._symbol = symbol
         self._names = symbol.list_arguments()
+        # reference bind accepts args/args_grad as a list (positional in
+        # list_arguments order) or a dict (executor.py Bind)
+        if isinstance(args, (list, tuple)):
+            args = dict(zip(self._names, args))
+        if isinstance(args_grad, (list, tuple)):
+            args_grad = dict(zip(self._names, args_grad))
         self.arg_dict = {}
         for n in self._names:
             if n not in args:
@@ -558,6 +567,16 @@ class Executor:
         self._fn = jax.jit(lambda d: lowered(d))
         self._vjp = None
         self.outputs = []
+
+    @property
+    def arg_arrays(self):
+        """Bound argument arrays in list_arguments order (reference:
+        executor.py arg_arrays)."""
+        return [self.arg_dict[n] for n in self._names]
+
+    @property
+    def grad_arrays(self):
+        return [self.grad_dict[n] for n in self._names]
 
     def forward(self, is_train=False, **kwargs):
         from ..ndarray.ndarray import NDArray
@@ -578,7 +597,12 @@ class Executor:
         from ..ndarray.ndarray import NDArray
 
         if self._vjp is None:
-            raise RuntimeError("call forward(is_train=True) first")
+            # reference permits backward after a plain forward() — the
+            # gradient pass re-linearizes at the current bindings
+            if not self.outputs:
+                raise RuntimeError("call forward() first")
+            data = {n: a._data for n, a in self.arg_dict.items()}
+            _, self._vjp = jax.vjp(self._fn, data)
         if out_grads is None:
             cts = [jnp.ones_like(o._data) for o in self.outputs]
         else:
@@ -589,10 +613,15 @@ class Executor:
         (grads,) = self._vjp(cts)
         for n in self._names:
             g = grads.get(n)
-            if g is None:
+            if g is None or self._grad_req == "null":
                 continue
-            if self._grad_req == "add" and self.grad_dict[n] is not None:
-                self.grad_dict[n] = NDArray(self.grad_dict[n]._data + g)
+            buf = self.grad_dict[n]
+            if self._grad_req == "add" and buf is not None:
+                buf._assign_from(NDArray(buf._data + g))
+            elif buf is not None:
+                # gradients land IN the caller's bound grad arrays
+                # (reference: args_grad buffers are written in place)
+                buf._assign_from(NDArray(g))
             else:
                 self.grad_dict[n] = NDArray(g)
         return self.grad_dict

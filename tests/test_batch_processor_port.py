@@ -1,0 +1,123 @@
+"""Gluon BatchProcessor family (reference:
+tests/python/unittest/test_gluon_batch_processor.py — the pluggable
+fit/evaluate batch hook on Estimator) plus custom-KVStore surface ports
+(test_kvstore_custom.py broadcast/pushpull spellings)."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import gluon
+from mxnet_tpu.gluon import nn
+from mxnet_tpu.gluon.contrib.estimator import BatchProcessor, Estimator
+
+
+def _get_test_network():
+    net = nn.Sequential()
+    net.add(nn.Dense(4, activation="relu", flatten=False))
+    return net
+
+
+def _get_test_data():
+    in_data = mx.np.random.uniform(size=(10, 3))
+    out_data = mx.np.random.uniform(size=(10, 4))
+    dataset = gluon.data.dataset.ArrayDataset(in_data, out_data)
+    return gluon.data.DataLoader(dataset, batch_size=4)
+
+
+def test_batch_processor_fit():
+    net = _get_test_network()
+    dataloader = _get_test_data()
+    loss = gluon.loss.L2Loss()
+    acc = gluon.metric.Accuracy()
+    net.initialize()
+    processor = BatchProcessor()
+    trainer = gluon.Trainer(net.collect_params(), "sgd",
+                            {"learning_rate": 0.001})
+    est = Estimator(net=net, loss=loss, train_metrics=acc,
+                    trainer=trainer, batch_processor=processor)
+    est.fit(train_data=dataloader, epochs=1)
+    # non-DataLoader inputs are rejected loudly (reference contract)
+    with pytest.raises(ValueError):
+        est.fit(train_data=[mx.nd.ones(shape=(10, 3))], epochs=1)
+
+
+def test_batch_processor_validation():
+    net = _get_test_network()
+    dataloader = _get_test_data()
+    loss = gluon.loss.L2Loss()
+    acc = gluon.metric.Accuracy()
+    net.initialize()
+    processor = BatchProcessor()
+    trainer = gluon.Trainer(net.collect_params(), "sgd",
+                            {"learning_rate": 0.001})
+    est = Estimator(net=net, loss=loss, train_metrics=acc,
+                    trainer=trainer, batch_processor=processor)
+    est.fit(train_data=dataloader, val_data=dataloader, epochs=1)
+
+
+def test_custom_batch_processor_hooks_called():
+    calls = []
+
+    class Custom(BatchProcessor):
+        def fit_batch(self, estimator, train_batch, batch_axis=0):
+            calls.append("fit")
+            return super().fit_batch(estimator, train_batch, batch_axis)
+
+        def evaluate_batch(self, estimator, val_batch, batch_axis=0):
+            calls.append("eval")
+            return super().evaluate_batch(estimator, val_batch,
+                                          batch_axis)
+
+    net = _get_test_network()
+    dataloader = _get_test_data()
+    net.initialize()
+    trainer = gluon.Trainer(net.collect_params(), "sgd",
+                            {"learning_rate": 0.001})
+    est = Estimator(net=net, loss=gluon.loss.L2Loss(),
+                    train_metrics=gluon.metric.Accuracy(),
+                    trainer=trainer, batch_processor=Custom())
+    est.fit(train_data=dataloader, val_data=dataloader, epochs=1)
+    assert "fit" in calls and "eval" in calls
+
+
+# ---- custom kvstore spellings (reference test_kvstore_custom.py) ---------
+
+def test_broadcast_single_kv_pair():
+    kv = mx.kv.create("local")
+    out = mx.nd.zeros((3,))
+    kv.broadcast("k", mx.nd.ones((3,)), out=out)
+    np.testing.assert_allclose(out.asnumpy(), np.ones(3))
+
+
+def test_broadcast_list_kv_pair():
+    kv = mx.kv.create("local")
+    outs = [mx.nd.zeros((3,)), mx.nd.zeros((3,))]
+    kv.broadcast(["a", "b"], [mx.nd.ones((3,)), mx.nd.ones((3,)) * 2],
+                 out=outs)
+    np.testing.assert_allclose(outs[0].asnumpy(), np.ones(3))
+    np.testing.assert_allclose(outs[1].asnumpy(), 2 * np.ones(3))
+
+
+def test_pushpull_single_kv_pair():
+    kv = mx.kv.create("local")
+    kv.init("x", mx.nd.zeros((4,)))
+    out = mx.nd.zeros((4,))
+    kv.pushpull("x", mx.nd.ones((4,)), out=out)
+    np.testing.assert_allclose(out.asnumpy(), np.ones(4))
+
+
+def test_pushpull_list_kv_pair():
+    kv = mx.kv.create("local")
+    kv.init(["p", "q"], [mx.nd.zeros((2,)), mx.nd.zeros((2,))])
+    outs = [mx.nd.zeros((2,)), mx.nd.zeros((2,))]
+    kv.pushpull(["p", "q"],
+                [mx.nd.ones((2,)), mx.nd.ones((2,)) * 3], out=outs)
+    np.testing.assert_allclose(outs[0].asnumpy(), np.ones(2))
+    np.testing.assert_allclose(outs[1].asnumpy(), 3 * np.ones(2))
+
+
+def test_get_type_device():
+    kv = mx.kv.create("local")
+    assert kv.type == "local"
+    # reference probes rank/num_workers on custom stores
+    assert kv.rank == 0 and kv.num_workers == 1

@@ -1,0 +1,136 @@
+"""Executor + engine family ports (reference:
+tests/python/unittest/test_executor.py and test_engine.py — list/dict
+bind forms, in-place args_grad buffers, backward after plain forward,
+shared simple_bind buffers, CachedOp init, engine bulking)."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+
+
+def _check_bind_with_uniform(uf, gf, dim, sf=None, lshape=None,
+                             rshape=None, rs=np.random.RandomState(3)):
+    shape = tuple(rs.randint(1, max(int(1000 ** (1.0 / dim)), 2),
+                             size=dim))
+    lhs = mx.sym.Variable("lhs")
+    rhs = mx.sym.Variable("rhs")
+    ret = sf(lhs, rhs) if sf is not None else uf(lhs, rhs)
+    assert ret.list_arguments() == ["lhs", "rhs"]
+    lshape = shape if lshape is None else lshape
+    rshape = shape if rshape is None else rshape
+
+    lhs_arr = mx.nd.array(rs.uniform(-1, 1, lshape))
+    rhs_arr = mx.nd.array(rs.uniform(-1, 1, rshape))
+    lhs_grad = mx.nd.empty(lshape)
+    rhs_grad = mx.nd.empty(rshape)
+    executor = ret._bind(mx.cpu(), args=[lhs_arr, rhs_arr],
+                         args_grad=[lhs_grad, rhs_grad])
+    exec3 = ret._bind(mx.cpu(), args=[lhs_arr, rhs_arr])
+    exec4 = ret._bind(mx.cpu(),
+                      args={"rhs": rhs_arr, "lhs": lhs_arr},
+                      args_grad={"lhs": lhs_grad, "rhs": rhs_grad})
+    executor.forward()
+    exec3.forward()
+    exec4.forward()
+    out1 = uf(lhs_arr.asnumpy(), rhs_arr.asnumpy())
+    for ex in (executor, exec3, exec4):
+        np.testing.assert_allclose(out1, ex.outputs[0].asnumpy(),
+                                   rtol=1e-5, atol=1e-5)
+    out_grad = mx.nd.array(np.ones(out1.shape, "float32"))
+    lhs_grad2, rhs_grad2 = gf(out_grad.asnumpy(), lhs_arr.asnumpy(),
+                              rhs_arr.asnumpy())
+    executor.backward([out_grad])
+    np.testing.assert_allclose(lhs_grad.asnumpy(), lhs_grad2,
+                               rtol=1e-5, atol=1e-5)
+    np.testing.assert_allclose(rhs_grad.asnumpy(), rhs_grad2,
+                               rtol=1e-5, atol=1e-5)
+
+
+@pytest.mark.parametrize("dim", [1, 2, 3])
+def test_bind(dim):
+    _check_bind_with_uniform(lambda x, y: x + y,
+                             lambda g, x, y: (g, g), dim)
+    _check_bind_with_uniform(lambda x, y: x - y,
+                             lambda g, x, y: (g, -g), dim)
+    _check_bind_with_uniform(lambda x, y: x * y,
+                             lambda g, x, y: (y * g, x * g), dim)
+    _check_bind_with_uniform(lambda x, y: x / y,
+                             lambda g, x, y: (g / y, -x * g / (y ** 2)),
+                             dim)
+    _check_bind_with_uniform(lambda x, y: np.maximum(x, y),
+                             lambda g, x, y: (g * (x >= y), g * (y > x)),
+                             dim, sf=mx.sym.maximum)
+    _check_bind_with_uniform(lambda x, y: np.minimum(x, y),
+                             lambda g, x, y: (g * (x <= y), g * (y < x)),
+                             dim, sf=mx.sym.minimum)
+
+
+def test_dot():
+    rs = np.random.RandomState(5)
+    s = tuple(rs.randint(1, 50, size=3))
+    _check_bind_with_uniform(
+        lambda x, y: np.dot(x, y),
+        lambda g, x, y: (np.dot(g, y.T), np.dot(x.T, g)), 2,
+        lshape=(s[0], s[1]), rshape=(s[1], s[2]), sf=mx.sym.dot, rs=rs)
+    # 1-D . 1-D
+    n = int(rs.randint(1, 50))
+    _check_bind_with_uniform(
+        lambda x, y: np.dot(x, y),
+        lambda g, x, y: (g * y, g * x), 1,
+        lshape=(n,), rshape=(n,), sf=mx.sym.dot, rs=rs)
+
+
+def test_simple_bind_shared_and_isolated_buffers():
+    # reference test_reshape's buffer-semantics core: writes through
+    # arg_arrays are visible to forward, and outputs follow
+    x = mx.sym.Variable("x")
+    y = mx.sym.FullyConnected(x, num_hidden=4, name="fc")
+    exe = y._simple_bind(mx.cpu(), x=(5, 4), grad_req="null")
+    exe.arg_arrays[0][:] = 1
+    exe.arg_arrays[1][:] = mx.nd.ones((4, 4))
+    exe.arg_arrays[2][:] = 0
+    exe.forward(is_train=False)
+    assert np.all(exe.outputs[0].asnumpy() == 4)
+    exe.forward(is_train=False)
+    assert np.all(exe.outputs[0].asnumpy() == 4)
+    exe.arg_arrays[2][:] = 1
+    exe.forward()
+    assert np.all(exe.outputs[0].asnumpy() == 5)
+
+
+def test_cached_op_init():
+    for static_alloc in (False, True):
+        for static_shape in (False, True):
+            out = mx.sym.zeros((3, 3))
+            flags = [("static_alloc", static_alloc),
+                     ("static_shape", static_shape)]
+            exe = mx.nd.CachedOp(out, flags)
+            z = exe(None, default_device=mx.cpu())
+            assert np.all(z.asnumpy() == 0)
+
+
+def test_elemwise_add_grad():
+    # reference test_executor.py test_elemwise_add_grad: grad_req mix
+    lhs = mx.sym.Variable("lhs")
+    rhs = mx.sym.Variable("rhs")
+    out = lhs + rhs
+    la = mx.nd.array([1.0, 2.0])
+    ra = mx.nd.array([3.0, 4.0])
+    lg = mx.nd.empty((2,))
+    ex = out._bind(mx.cpu(), args=[la, ra], args_grad={"lhs": lg})
+    ex.forward()
+    ex.backward([mx.nd.array([1.0, 1.0])])
+    np.testing.assert_allclose(lg.asnumpy(), [1.0, 1.0])
+
+
+def test_engine_bulk():
+    with mx.engine.bulk(10):
+        x = mx.nd.ones((10,))
+        x *= 2
+        x += 1
+        x.wait_to_read()
+        x += 1
+        assert (x.asnumpy() == 4).all()
+        for _ in range(100):
+            x += 1
+    assert (x.asnumpy() == 104).all()

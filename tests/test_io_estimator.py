@@ -85,7 +85,10 @@ def test_estimator_fit_and_validate(tmp_path):
         net, gluon.loss.SoftmaxCrossEntropyLoss(),
         trainer=gluon.Trainer(net.collect_params(), "sgd",
                               {"learning_rate": 0.1}))
-    data = [(np.random.uniform(size=(4, 3)), np.array([0, 1, 0, 1]))]
+    ds = gluon.data.dataset.ArrayDataset(
+        mx.np.array(np.random.uniform(size=(4, 3)).astype("float32")),
+        mx.np.array([0, 1, 0, 1]))
+    data = gluon.data.DataLoader(ds, batch_size=4)
     est.fit(data, val_data=data, epochs=2)
     result = est.evaluate(data)
     assert "val_accuracy" in result
