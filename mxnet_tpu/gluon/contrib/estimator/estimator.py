@@ -63,9 +63,24 @@ class Estimator:
         data, label = batch[0], batch[1]
         return (data.as_in_ctx(self.device), label.as_in_ctx(self.device))
 
+    @staticmethod
+    def _check_data(name, d, batch_fn):
+        """Reference estimator.py _check_data: only gluon DataLoader is
+        accepted without a custom batch_fn — raw arrays or legacy
+        DataIters would mis-unpack into (data, label)."""
+        from ...data.dataloader import DataLoader
+
+        if batch_fn is None and d is not None \
+                and not isinstance(d, DataLoader):
+            raise ValueError(
+                f"Estimator only supports gluon DataLoader for {name} "
+                f"(got {type(d).__name__}); pass batch_fn to adapt "
+                f"other iterators")
+
     def evaluate(self, val_data, batch_fn=None):
         """Run validation using the dedicated val metrics — train metric
         objects are left untouched (reference keeps the two sets separate)."""
+        self._check_data("val_data", val_data, batch_fn)
         for m in self.val_metrics:
             m.reset()
         for batch in val_data:
@@ -85,19 +100,8 @@ class Estimator:
             raise ValueError(
                 "fit() needs exactly one of epochs / batches "
                 "(reference: estimator.py fit)")
-        # reference contract (estimator.py _check_data): only gluon
-        # DataLoader is accepted without a custom batch_fn — raw arrays
-        # or legacy DataIters would mis-unpack into (data, label)
-        from ...data.dataloader import DataLoader
-
-        if batch_fn is None:
-            for name, d in (("train_data", train_data),
-                            ("val_data", val_data)):
-                if d is not None and not isinstance(d, DataLoader):
-                    raise ValueError(
-                        f"Estimator only supports gluon DataLoader for "
-                        f"{name} (got {type(d).__name__}); pass batch_fn "
-                        f"to adapt other iterators")
+        self._check_data("train_data", train_data, batch_fn)
+        self._check_data("val_data", val_data, batch_fn)
         handlers = list(event_handlers or [])
         stopper = StoppingHandler(epochs, batches)
         handlers.append(stopper)

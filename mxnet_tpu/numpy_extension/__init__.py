@@ -132,16 +132,19 @@ def erfinv(x):
 from ..device import cpu, current_device, gpu, num_gpus, tpu  # noqa: E402
 from ..engine import waitall  # noqa: E402
 
-_np_active = True
+_np_active = True          # array-semantics flag (is_np_array)
+_np_shape_active = True    # shape-semantics flag — independent, like the
+#                            reference's two MXNET_NPX state bits
 _np_default_dtype = False
 
 
 def set_np(shape=True, array=True, dtype=False):  # noqa: ARG001
-    """shape/array are parity no-ops (numpy-semantics native); `dtype`
-    switches creation defaults to official-numpy (float64/int64) like the
-    reference (numpy/multiarray.py:7004 arange docs)."""
-    global _np_active, _np_default_dtype
+    """shape/array restore numpy semantics (native here, so True is the
+    resting state); `dtype` switches creation defaults to official-numpy
+    (float64/int64) like the reference (numpy/multiarray.py:7004)."""
+    global _np_active, _np_shape_active, _np_default_dtype
     _np_active = True
+    _np_shape_active = True
     _np_default_dtype = bool(dtype)
 
 
@@ -154,7 +157,7 @@ def is_np_array():
 
 
 def is_np_shape():
-    return _np_active
+    return _np_shape_active
 
 
 def is_np_default_dtype():

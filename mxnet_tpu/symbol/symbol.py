@@ -548,8 +548,17 @@ class Executor:
         # reference bind accepts args/args_grad as a list (positional in
         # list_arguments order) or a dict (executor.py Bind)
         if isinstance(args, (list, tuple)):
+            if len(args) != len(self._names):
+                raise ValueError(
+                    f"bind: {len(self._names)} arguments "
+                    f"({self._names}) but {len(args)} arrays given")
             args = dict(zip(self._names, args))
         if isinstance(args_grad, (list, tuple)):
+            if len(args_grad) != len(self._names):
+                raise ValueError(
+                    f"bind: list-form args_grad must cover all "
+                    f"{len(self._names)} arguments (got "
+                    f"{len(args_grad)}); use a dict for a subset")
             args_grad = dict(zip(self._names, args_grad))
         self.arg_dict = {}
         for n in self._names:

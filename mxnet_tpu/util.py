@@ -42,3 +42,53 @@ def get_gpu_memory(dev_id=0):  # noqa: ARG001
         return stats.get("bytes_in_use", 0), stats.get("bytes_limit", 0)
     except Exception:  # pragma: no cover
         return 0, 0
+
+
+def is_np_array():
+    """Whether the np-array semantics scope is active (reference:
+    util.py is_np_array — delegates to the shared npx flag here)."""
+    from . import numpy_extension as _npx
+
+    return _npx.is_np_array()
+
+
+def is_np_shape():
+    """Whether np-shape (zero-size dim) semantics are active (reference:
+    util.py is_np_shape)."""
+    from . import numpy_extension as _npx
+
+    return _npx.is_np_shape()
+
+
+class _NpSemanticsScope:
+    """Context manager toggling ONE np-semantics flag (reference:
+    util.py np_shape/np_array keep the two MXNET_NPX state bits
+    independent — entering np_array must not change is_np_shape)."""
+
+    def __init__(self, flag_name, active):
+        self._flag = flag_name
+        self._active = bool(active)
+        self._prev = None
+
+    def __enter__(self):
+        from . import numpy_extension as _npx
+
+        self._prev = getattr(_npx, self._flag)
+        setattr(_npx, self._flag, self._active)
+        return self
+
+    def __exit__(self, *exc):
+        from . import numpy_extension as _npx
+
+        setattr(_npx, self._flag, self._prev)
+        return False
+
+
+def np_array(active=True):
+    """Scope for np-array semantics (reference: util.py np_array)."""
+    return _NpSemanticsScope("_np_active", active)
+
+
+def np_shape(active=True):
+    """Scope for np-shape semantics (reference: util.py np_shape)."""
+    return _NpSemanticsScope("_np_shape_active", active)

