@@ -392,11 +392,14 @@ def main():
     result_extra = {}
     if platform == "cpu":
         note = ("CPU run — not a TPU measurement; last on-chip numbers: "
-                "BENCH_PROBE_r03.json (2399.4 img/s train NHWC b=256, "
-                "13340 infer, BERT 261 samples/s — r3 round start, before "
-                "the custom-VJP norms) and BENCH_r01.json (2507.6 img/s "
-                "NCHW). The r3/r4 perf work is staged but unmeasured; "
-                "docs/perf_audit_r5.md has the falsifiable A/B predictions and tools/evidence_bundle.sh captures everything in one command")
+                "bench_r05_evidence/headline.json (2631.4 img/s train "
+                "b=256 NHWC bf16, 12463 infer — r5 mid-round capture, "
+                "+9.7% over r3's 2399.4 with the custom-VJP norms "
+                "measured for the first time; perf_lab_step.txt: 97.55 "
+                "ms/step, 30.1% MFU). The A/B matrix + profile cells "
+                "were lost to a tunnel flap; docs/perf_audit_r5.md has "
+                "the falsifiable predictions and tools/watch_r05.sh "
+                "re-captures on revival")
         pool_ip = os.environ.get("PALLAS_AXON_POOL_IPS", "").split(",")[0]
         if pool_ip:
             import socket

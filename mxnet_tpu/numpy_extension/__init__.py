@@ -132,32 +132,48 @@ def erfinv(x):
 from ..device import cpu, current_device, gpu, num_gpus, tpu  # noqa: E402
 from ..engine import waitall  # noqa: E402
 
-_np_active = True          # array-semantics flag (is_np_array)
-_np_shape_active = True    # shape-semantics flag — independent, like the
-#                            reference's two MXNET_NPX state bits
+import threading as _threading
+
+# np-semantics state: process-wide defaults set by set_np, with
+# THREAD-LOCAL overrides from the util.np_shape/np_array scopes (the
+# reference's MXNET_NPX bits are per-thread; a DataLoader worker must
+# not see another thread's scope)
+_np_defaults = {"array": True, "shape": True}
+_np_tls = _threading.local()
 _np_default_dtype = False
 
 
-def set_np(shape=True, array=True, dtype=False):  # noqa: ARG001
-    """shape/array restore numpy semantics (native here, so True is the
-    resting state); `dtype` switches creation defaults to official-numpy
-    (float64/int64) like the reference (numpy/multiarray.py:7004)."""
-    global _np_active, _np_shape_active, _np_default_dtype
-    _np_active = True
-    _np_shape_active = True
+def _np_flag(key):
+    over = getattr(_np_tls, key, None)
+    return _np_defaults[key] if over is None else over
+
+
+def set_np(shape=True, array=True, dtype=False):
+    """Set the process-wide np-semantics defaults (reference:
+    util.py set_np — array semantics require shape semantics); `dtype`
+    switches creation defaults to official-numpy (float64/int64)
+    (numpy/multiarray.py:7004)."""
+    global _np_default_dtype
+    if array and not shape:
+        raise ValueError("set_np: array semantics require shape "
+                         "semantics (reference util.py set_np contract)")
+    _np_defaults["array"] = bool(array)
+    _np_defaults["shape"] = bool(shape)
     _np_default_dtype = bool(dtype)
 
 
 def reset_np():
+    """Restore numpy semantics and reference dtype defaults (this
+    framework is np-native, so the resting state is all-on)."""
     set_np()
 
 
 def is_np_array():
-    return _np_active
+    return _np_flag("array")
 
 
 def is_np_shape():
-    return _np_shape_active
+    return _np_flag("shape")
 
 
 def is_np_default_dtype():

@@ -61,34 +61,35 @@ def is_np_shape():
 
 
 class _NpSemanticsScope:
-    """Context manager toggling ONE np-semantics flag (reference:
-    util.py np_shape/np_array keep the two MXNET_NPX state bits
-    independent — entering np_array must not change is_np_shape)."""
+    """Context manager toggling ONE np-semantics flag, THREAD-LOCALLY
+    (reference: util.py np_shape/np_array — the two MXNET_NPX state
+    bits are independent and per-thread; a scope here must not change
+    what other threads observe)."""
 
-    def __init__(self, flag_name, active):
-        self._flag = flag_name
+    def __init__(self, key, active):
+        self._key = key
         self._active = bool(active)
         self._prev = None
 
     def __enter__(self):
-        from . import numpy_extension as _npx
+        from .numpy_extension import _np_tls
 
-        self._prev = getattr(_npx, self._flag)
-        setattr(_npx, self._flag, self._active)
+        self._prev = getattr(_np_tls, self._key, None)
+        setattr(_np_tls, self._key, self._active)
         return self
 
     def __exit__(self, *exc):
-        from . import numpy_extension as _npx
+        from .numpy_extension import _np_tls
 
-        setattr(_npx, self._flag, self._prev)
+        setattr(_np_tls, self._key, self._prev)
         return False
 
 
 def np_array(active=True):
     """Scope for np-array semantics (reference: util.py np_array)."""
-    return _NpSemanticsScope("_np_active", active)
+    return _NpSemanticsScope("array", active)
 
 
 def np_shape(active=True):
     """Scope for np-shape semantics (reference: util.py np_shape)."""
-    return _NpSemanticsScope("_np_shape_active", active)
+    return _NpSemanticsScope("shape", active)

@@ -254,3 +254,28 @@ def test_operator_corpus_resolves():
             missing.append(n)
     assert not missing, (
         f"{len(missing)} reference operators unresolvable: {missing[:15]}")
+
+
+def test_npi_corpus_resolves():
+    """Every _npi_* registration (the reference's generated mx.np
+    frontend) resolves through mx.np / mx.npx / mx.np.random /
+    mx.nd._internal."""
+    if not os.path.isdir(REF):
+        pytest.skip("reference tree unavailable")
+    import subprocess
+
+    out = subprocess.run(
+        ["grep", "-rhoP", r"NNVM_REGISTER_OP\(_npi_\K\w+",
+         "/root/reference/src/operator/"],
+        capture_output=True, text=True)
+    names = sorted({n for n in out.stdout.split()
+                    if "backward" not in n and "##" not in n
+                    and not n.endswith("_")})  # macro artifacts
+    if len(names) < 50:
+        pytest.skip("npi grep empty; src tree unavailable?")
+    spaces = [mx.np, mx.npx, mx.np.random, mx.nd._internal]
+    missing = [n for n in names
+               if not any(getattr(ns, n, None) is not None
+                          for ns in spaces)]
+    assert not missing, (
+        f"{len(missing)} _npi ops unresolvable: {missing[:15]}")

@@ -336,3 +336,55 @@ def test_moe_dense_numeric_gradient():
         wm = wi0.copy(); wm[idx] -= eps
         fd = (loss_val(wp) - loss_val(wm)) / (2 * eps)
         onp.testing.assert_allclose(g[idx], fd, rtol=5e-2, atol=1e-3)
+
+
+def test_sync_batchnorm_global_stats_under_dp():
+    """gluon SyncBatchNorm under a GSPMD dp-sharded train step must
+    match single-device WHOLE-batch training parameter-for-parameter:
+    the batch-stat reductions become cross-device collectives under
+    SPMD, so per-shard stats never appear (reference: contrib
+    SyncBatchNorm's ndev-wide mean/var)."""
+    import jax.numpy as jnp
+
+    import mxnet_tpu as mx
+    from mxnet_tpu import gluon
+
+    mx.random.seed(0)
+    net = gluon.nn.HybridSequential()
+    net.add(gluon.nn.Conv2D(4, kernel_size=3, padding=1),
+            gluon.nn.SyncBatchNorm(),
+            gluon.nn.Activation("relu"),
+            gluon.nn.Dense(3))
+    net.initialize()
+    x = onp.random.RandomState(1).rand(8, 2, 6, 6).astype("float32")
+    y = onp.random.RandomState(2).randint(0, 3, (8,))
+    net(mx.np.array(x))  # materialize deferred shapes
+
+    fwd, _ = net.as_pure_function(training=True)
+    params = {k: v.data()._data for k, v in
+              sorted(net.collect_params().items())}
+    key = jax.random.PRNGKey(0)
+    yj = jnp.asarray(y)
+
+    def loss_fn(p, batch):
+        xb, yb = batch
+        out, newp = fwd(p, key, xb)
+        logp = jax.nn.log_softmax(out, -1)
+        return -jnp.take_along_axis(logp, yb[:, None], -1).mean()
+
+    def sgd(p, g, state, lr):
+        return jax.tree_util.tree_map(lambda a, b: a - lr * b, p, g), state
+
+    mesh = make_mesh({"dp": -1})
+    step = make_data_parallel_step(loss_fn, sgd, mesh, donate=False)
+    p_sharded, _, loss_sharded = step(params, None, (jnp.asarray(x), yj),
+                                      0.1)
+
+    loss_ref, grads = jax.value_and_grad(loss_fn)(params, (jnp.asarray(x),
+                                                           yj))
+    p_ref, _ = sgd(params, grads, None, 0.1)
+    assert_almost_equal(float(loss_sharded), float(loss_ref), rtol=1e-5,
+                        atol=1e-6)
+    for k in p_ref:
+        assert_almost_equal(onp.asarray(p_sharded[k]),
+                            onp.asarray(p_ref[k]), rtol=1e-4, atol=1e-5)

@@ -24,8 +24,8 @@ def test_device_scope_thread_isolated():
         t.start()
         t.join()
         assert mx.device.current_device() == mx.cpu(1)
-    assert seen[0] == mx.device.current_device().__class__("cpu", 0) \
-        or seen[0].device_id != 1 or True  # default scope, any device 0
+    # the worker started from the DEFAULT scope — cpu(0), NOT our cpu(1)
+    assert seen[0] == mx.cpu(0), seen
     assert seen[1] == mx.cpu(3)
 
 
@@ -110,6 +110,40 @@ def test_block_creation_across_threads():
     t.start()
     t.join()
     assert status[0]
+
+
+def test_np_scopes_thread_isolated():
+    # a scope in one thread must not leak into another (reference:
+    # per-thread MXNET_NPX bits)
+    e1, e2 = threading.Event(), threading.Event()
+    observed = {}
+
+    def g():
+        e1.wait()
+        observed["shape"] = mx.util.is_np_shape()
+        e2.set()
+
+    t = threading.Thread(target=g)
+    t.start()
+    with mx.util.np_shape(False):
+        e1.set()
+        e2.wait()
+    t.join()
+    assert observed["shape"] is True
+
+
+def test_set_np_honors_arguments():
+    import pytest as _pytest
+
+    mx.npx.set_np(shape=False, array=False)
+    try:
+        assert not mx.npx.is_np_shape()
+        assert not mx.npx.is_np_array()
+    finally:
+        mx.npx.reset_np()
+    assert mx.npx.is_np_shape() and mx.npx.is_np_array()
+    with _pytest.raises(ValueError):
+        mx.npx.set_np(shape=False, array=True)
 
 
 def test_np_semantics_scope():
