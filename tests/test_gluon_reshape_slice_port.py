@@ -1,0 +1,102 @@
+"""Hybrid reshape/slice composition sweep (reference: test_gluon.py
+test_reshape_conv / test_slice_dense / test_reshape_batchnorm_slice_
+batchnorm ... — tensor-shape surgery BETWEEN layers must trace, run,
+and differentiate identically hybridized and eager)."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import autograd, gluon
+from mxnet_tpu.gluon import nn
+
+
+class _Surgery(gluon.HybridBlock):
+    """t1 -> layer -> t2 applied in forward (reference test pattern)."""
+
+    def __init__(self, layer, t1, t2):
+        super().__init__()
+        self.layer = layer
+        self._t1, self._t2 = t1, t2
+
+    def forward(self, x):
+        x = self._t1(x)
+        x = self.layer(x)
+        return self._t2(x)
+
+
+def _ident(x):
+    return x
+
+
+def _reshape_to(shape):
+    return lambda x: x.reshape(shape)
+
+
+def _slice_rows(x):
+    return x[1:3]
+
+
+CASES = [
+    # (case id, layer factory, input shape, t1, t2)
+    ("reshape_conv", lambda: nn.Conv2D(4, (3, 3)), (4, 2, 8, 9),
+     _reshape_to((4, 2, 9, 8)), _ident),
+    ("reshape_conv_slice_conv", lambda: nn.Conv2D(4, (3, 3)),
+     (4, 2, 8, 9), _reshape_to((4, 2, 9, 8)), _slice_rows),
+    ("slice_dense", lambda: nn.Dense(5), (6, 7), _slice_rows, _ident),
+    ("reshape_dense", lambda: nn.Dense(5), (4, 6),
+     _reshape_to((8, 3)), _ident),
+    ("reshape_dense_reshape_dense", lambda: nn.Dense(6), (4, 6),
+     _reshape_to((8, 3)), _reshape_to((4, 12))),
+    ("reshape_batchnorm", lambda: nn.BatchNorm(), (4, 2, 6, 6),
+     _reshape_to((4, 4, 3, 6)), _ident),
+    ("slice_batchnorm", lambda: nn.BatchNorm(), (6, 3, 4, 4),
+     _slice_rows, _ident),
+    ("reshape_pooling2d", lambda: nn.MaxPool2D((2, 2)), (4, 2, 8, 8),
+     _reshape_to((4, 4, 4, 8)), _ident),
+    ("reshape_activation", lambda: nn.Activation("relu"), (4, 6),
+     _reshape_to((8, 3)), _reshape_to((2, 12))),
+    ("reshape_deconv", lambda: nn.Conv2DTranspose(3, (3, 3)),
+     (4, 2, 6, 6), _reshape_to((4, 2, 6, 6)), _ident),
+    ("slice_dense_slice_dense", lambda: nn.Dense(7), (6, 5),
+     _slice_rows, lambda x: x[0:1]),
+]
+
+
+@pytest.mark.parametrize("cid,layer_fn,shape,t1,t2", CASES,
+                         ids=[c[0] for c in CASES])
+def test_hybrid_shape_surgery(cid, layer_fn, shape, t1, t2):
+    rs = np.random.RandomState(hash(cid) % 2 ** 31)
+    x_np = rs.uniform(-1, 1, shape).astype("float32")
+
+    # eager oracle
+    mx.random.seed(7)
+    net_e = _Surgery(layer_fn(), t1, t2)
+    net_e.initialize()
+    xe = mx.np.array(x_np)
+    xe.attach_grad()
+    with autograd.record():
+        out_e = net_e(xe)
+        loss_e = (out_e ** 2).sum()
+    loss_e.backward()
+
+    # hybridized twin with identical params
+    mx.random.seed(7)
+    net_h = _Surgery(layer_fn(), t1, t2)
+    net_h.initialize()
+    net_h(mx.np.array(x_np))  # materialize, then share weights
+    for (ka, pa), (kb, pb) in zip(
+            sorted(net_e.collect_params().items()),
+            sorted(net_h.collect_params().items())):
+        pb.set_data(pa.data())
+    net_h.hybridize()
+    xh = mx.np.array(x_np)
+    xh.attach_grad()
+    with autograd.record():
+        out_h = net_h(xh)
+        loss_h = (out_h ** 2).sum()
+    loss_h.backward()
+
+    np.testing.assert_allclose(out_h.asnumpy(), out_e.asnumpy(),
+                               rtol=1e-4, atol=1e-5)
+    np.testing.assert_allclose(xh.grad.asnumpy(), xe.grad.asnumpy(),
+                               rtol=1e-4, atol=1e-5)

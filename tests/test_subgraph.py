@@ -184,3 +184,52 @@ class TestBuiltinXlaBackend:
         x = mnp.random.uniform(size=(2, 8))
         with pytest.raises(ValueError, match="xla"):
             net.optimize_for(x, backend="definitely_not_registered")
+
+
+# ---- reference test_subgraph_op.py exe sweep -----------------------------
+# (build_subgraph.cc: partitioned graphs must be numerically identical
+# to the unpartitioned run across a zoo of symbol programs and both
+# executor paths)
+
+def _zoo_symbols():
+    data = mx.sym.Variable("data")
+    out1 = mx.sym.exp(data + 1.0) * mx.sym.sqrt(mx.sym.abs(data) + 0.5)
+    mlp = mx.sym.FullyConnected(
+        mx.sym.Activation(
+            mx.sym.FullyConnected(data, num_hidden=8, name="f1"),
+            act_type="relu"),
+        num_hidden=3, name="f2")
+    multi = mx.sym.Group([data * 2.0, data + 3.0])
+    return [("elemwise_chain", out1, (4, 5)),
+            ("mlp", mlp, (4, 5)),
+            ("multi_output", multi, (4, 5))]
+
+
+@pytest.mark.parametrize("name,sym_,shape", _zoo_symbols(),
+                         ids=[c[0] for c in _zoo_symbols()])
+def test_subgraph_exe_sweep(name, sym_, shape):
+    rs = onp.random.RandomState(0)
+    names = sym_.list_arguments()
+    # deduce every argument shape from the data shape (InferShape)
+    arg_shapes, _, _ = sym_.infer_shape(data=shape)
+    args = {n: mx.nd.array(rs.uniform(-1, 1, s_).astype("float32"))
+            for n, s_ in zip(names, arg_shapes)}
+
+    plain = sym_._bind(mx.cpu(), args=dict(args))
+    plain.forward()
+    want = [o.asnumpy() for o in plain.outputs]
+
+    datas = [args[n]._data for n in names]
+    lowered = sym_._lower()
+
+    def fn(*xs):
+        return tuple(lowered(dict(zip(names, xs))))
+
+    part, nsub = subgraph.partition_call(fn, "xla", *datas)
+    assert nsub >= 1
+    got = part(*datas)
+    got = got if isinstance(got, (list, tuple)) else [got]
+    for g, w in zip(got, want):
+        onp.testing.assert_allclose(onp.asarray(g), w, rtol=1e-5,
+                                    atol=1e-6)
+    assert len(got) == len(want)
