@@ -202,6 +202,14 @@ class Counter:
         self.value -= delta
         self._emit()
 
+    def __iadd__(self, delta):
+        self.increment(delta)
+        return self
+
+    def __isub__(self, delta):
+        self.decrement(delta)
+        return self
+
 
 def pause(profile_process="worker"):  # noqa: ARG001
     global _paused
@@ -211,3 +219,46 @@ def pause(profile_process="worker"):  # noqa: ARG001
 def resume(profile_process="worker"):  # noqa: ARG001
     global _paused
     _paused = False
+
+
+class Marker:
+    """Instant marker (reference: profiler.Marker — mark() drops an
+    instant event into the trace)."""
+
+    def __init__(self, name, domain=None):  # noqa: ARG002
+        self.name = name
+
+    def mark(self, scope="process"):
+        if _host_recording():
+            with _events_lock:
+                _events.append({"name": f"marker::{self.name}", "ph": "i",
+                                "ts": _now_us(), "pid": os.getpid(),
+                                "s": {"process": "p", "thread": "t",
+                                      "global": "g"}.get(scope, "p")})
+
+
+class Domain:
+    """Named grouping for profiler objects (reference: profiler.Domain —
+    a factory whose name prefixes everything created under it)."""
+
+    def __init__(self, name):
+        self.name = name
+
+    def __str__(self):
+        return self.name
+
+    def new_counter(self, name, value=0):
+        return Counter(f"{self.name}::{name}", self, value)
+
+    def new_task(self, name):
+        return Task(f"{self.name}::{name}", self)
+
+    def new_frame(self, name):
+        return Frame(f"{self.name}::{name}", self)
+
+    def new_event(self, name):
+        return Event(f"{self.name}::{name}", self)
+
+    def new_marker(self, name):
+        return Marker(f"{self.name}::{name}", self)
+

@@ -56,7 +56,7 @@ CASES = [
     ("reshape_activation", lambda: nn.Activation("relu"), (4, 6),
      _reshape_to((8, 3)), _reshape_to((2, 12))),
     ("reshape_deconv", lambda: nn.Conv2DTranspose(3, (3, 3)),
-     (4, 2, 6, 6), _reshape_to((4, 2, 6, 6)), _ident),
+     (4, 2, 6, 6), _reshape_to((2, 4, 6, 6)), _ident),
     ("slice_dense_slice_dense", lambda: nn.Dense(7), (6, 5),
      _slice_rows, lambda x: x[0:1]),
 ]
@@ -65,7 +65,9 @@ CASES = [
 @pytest.mark.parametrize("cid,layer_fn,shape,t1,t2", CASES,
                          ids=[c[0] for c in CASES])
 def test_hybrid_shape_surgery(cid, layer_fn, shape, t1, t2):
-    rs = np.random.RandomState(hash(cid) % 2 ** 31)
+    import zlib
+
+    rs = np.random.RandomState(zlib.crc32(cid.encode()) % 2 ** 31)
     x_np = rs.uniform(-1, 1, shape).astype("float32")
 
     # eager oracle

@@ -1,0 +1,112 @@
+"""Profiler object-API family (reference:
+tests/python/unittest/test_profiler.py — Domain factories, Task/Frame/
+Event timing, Counter arithmetic, instant markers, pause/resume, and
+aggregate dumps as parseable output)."""
+import json
+import os
+
+import mxnet_tpu as mx
+from mxnet_tpu import profiler
+
+
+def _enable(tmp_path, name):
+    profiler.set_config(profile_all=True,
+                        filename=str(tmp_path / name))
+    profiler.set_state("run")
+
+
+def test_profile_create_domain():
+    d = profiler.Domain("PythonDomain::test")
+    assert str(d) == "PythonDomain::test"
+    # domains are cheap and independent (reference test makes many)
+    for i in range(10):
+        profiler.Domain(f"d{i}")
+
+
+def test_profile_task(tmp_path):
+    _enable(tmp_path, "task.json")
+    d = profiler.Domain("PythonDomain::task")
+    task = d.new_task("operation")
+    task.start()
+    sum(range(10000))
+    task.stop()
+    profiler.dump()
+    trace = json.load(open(tmp_path / "task.json"))
+    names = [e.get("name", "") for e in trace["traceEvents"]]
+    assert any("operation" in n for n in names)
+
+
+def test_profile_frame_and_event(tmp_path):
+    _enable(tmp_path, "fe.json")
+    d = profiler.Domain("PythonDomain::fe")
+    with d.new_frame("frame0"):
+        with d.new_event("event0"):
+            sum(range(1000))
+    profiler.dump()
+    names = [e.get("name", "")
+             for e in json.load(open(tmp_path / "fe.json"))["traceEvents"]]
+    assert any("frame0" in n for n in names)
+    assert any("event0" in n for n in names)
+
+
+def test_profile_counter(tmp_path):
+    _enable(tmp_path, "counter.json")
+    d = profiler.Domain("PythonDomain::counter")
+    counter = d.new_counter("mycounter", 0)
+    for i in range(100):
+        if i <= 50:
+            counter += 1
+        else:
+            counter -= 1
+    assert counter.value == 51 - 49
+    counter.set_value(7)
+    assert counter.value == 7
+    profiler.dump()
+    events = json.load(open(tmp_path / "counter.json"))["traceEvents"]
+    cvals = [e["args"]["value"] for e in events
+             if e.get("ph") == "C" and "mycounter" in e.get("name", "")]
+    assert cvals and cvals[-1] == 7
+
+
+def test_continuous_profile_and_instant_marker(tmp_path):
+    _enable(tmp_path, "marker.json")
+    d = profiler.Domain("PythonDomain::marker")
+    m = d.new_marker("checkpoint")
+    m.mark("global")
+    m.mark("process")
+    profiler.dump()
+    events = json.load(open(tmp_path / "marker.json"))["traceEvents"]
+    marks = [e for e in events if e.get("ph") == "i"
+             and "checkpoint" in e.get("name", "")]
+    assert len(marks) == 2
+    assert {m_["s"] for m_ in marks} == {"g", "p"}
+
+
+def test_profile_tune_pause_resume(tmp_path):
+    _enable(tmp_path, "pause.json")
+    d = profiler.Domain("PythonDomain::pause")
+    t1 = d.new_task("before_pause")
+    t1.start(); t1.stop()
+    profiler.pause()
+    t2 = d.new_task("during_pause")
+    t2.start(); t2.stop()
+    profiler.resume()
+    t3 = d.new_task("after_resume")
+    t3.start(); t3.stop()
+    profiler.dump()
+    names = [e.get("name", "") for e in
+             json.load(open(tmp_path / "pause.json"))["traceEvents"]]
+    assert any("before_pause" in n for n in names)
+    assert not any("during_pause" in n for n in names)
+    assert any("after_resume" in n for n in names)
+
+
+def test_aggregate_stats_valid_return(tmp_path):
+    _enable(tmp_path, "agg.json")
+    d = profiler.Domain("PythonDomain::agg")
+    for _ in range(3):
+        with d.new_task("repeated"):
+            sum(range(1000))
+    out = profiler.dumps(reset=False)
+    assert isinstance(out, str) and "repeated" in out
+    profiler.dump()  # drain the shared buffer — no cross-test leakage
