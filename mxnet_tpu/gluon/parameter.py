@@ -52,6 +52,7 @@ class Parameter:
         self.wd_mult = wd_mult
         self.init = init
         self.allow_deferred_init = allow_deferred_init
+        self._differentiable = bool(differentiable)
         self.grad_req = grad_req if differentiable else "null"
         self._data_map = None  # {Device: NDArray}
         self._grad_map = None
@@ -145,16 +146,57 @@ class Parameter:
         master = global_init.init_array(desc, self._shape, self.dtype,
                                         explicit=declared is None)
         self._ctx_list = list(devices)
-        self._data_map = {}
+        self._data_map = {d: master.copyto(d) for d in devices}
         self._grad_map = {}
-        for d in devices:
-            self._data_map[d] = master.copyto(d)
-            if self.grad_req != "null":
-                g = _wrap_out(jnp.zeros(self._shape, self.dtype))
-                self._grad_map[d] = g.copyto(d)
-                self._data_map[d]._grad = self._grad_map[d]
-                self._data_map[d]._grad_req = self.grad_req
+        if self.grad_req != "null":
+            self._init_grad_buffers()
         self._deferred = None
+
+    def _init_grad_buffers(self):
+        """(Re)allocate fresh zero grad buffers on every device and wire
+        them to the data arrays — the ONE copy of this logic
+        (reference parameter.py _init_grad). Fresh zeros on every
+        grad_req change: reused buffers would feed stale gradients into
+        an 'add' accumulation."""
+        self._grad_map = {}
+        for d, arr in self._data_map.items():
+            g = _wrap_out(jnp.zeros(self._shape, self.dtype)).copyto(d)
+            self._grad_map[d] = g
+            arr._grad = g
+            arr._grad_req = self._grad_req
+
+    @property
+    def grad_req(self):
+        return self._grad_req
+
+    @grad_req.setter
+    def grad_req(self, req):
+        """Validated + live: changing grad_req after initialize rewires
+        the per-device arrays (reference parameter.py grad_req setter —
+        'add' starts accumulating into FRESH zeros, 'null' drops the
+        buffers, non-differentiable parameters coerce to 'null')."""
+        if req not in ("write", "add", "null"):
+            raise ValueError(
+                f"grad_req must be 'write', 'add' or 'null', got {req!r}")
+        if not getattr(self, "_differentiable", True) and req != "null":
+            import warnings
+
+            warnings.warn(
+                f"parameter {getattr(self, '_name', '?')!r} is not "
+                f"differentiable; ignoring grad_req={req!r}",
+                stacklevel=2)
+            req = "null"
+        self._grad_req = req
+        data_map = getattr(self, "_data_map", None)
+        if not data_map:
+            return
+        if req == "null":
+            for arr in data_map.values():
+                arr._grad = None
+                arr._grad_req = req
+            self._grad_map = {}
+            return
+        self._init_grad_buffers()
 
     def _finish_deferred_init(self, shape=None):
         """Complete deferred init once the full shape is known."""
@@ -317,12 +359,7 @@ class Parameter:
         self._ctx_list = devices
         self._data_map = {d: master.copyto(d) for d in devices}
         if self.grad_req != "null":
-            self._grad_map = {}
-            for d in devices:
-                g = _wrap_out(jnp.zeros(self._shape, self.dtype)).copyto(d)
-                self._grad_map[d] = g
-                self._data_map[d]._grad = g
-                self._data_map[d]._grad_req = self.grad_req
+            self._init_grad_buffers()
 
     reset_device = reset_ctx
 
@@ -360,7 +397,7 @@ class Constant(Parameter):
         if not isinstance(value, NDArray):
             value = NDArray(jnp.asarray(value))
         super().__init__(name=name, grad_req="null", shape=value.shape,
-                         dtype=value.dtype,
+                         dtype=value.dtype, differentiable=False,
                          init=init_mod.Constant(0.0))
         self._value = value
 

@@ -1,0 +1,189 @@
+"""Parameter-semantics family (reference: test_gluon.py test_req /
+test_reqs_switching_training_inference / test_parameter /
+test_parameter_str / test_gluon_param_load_dtype_source /
+test_fill_shape_deferred / test_grad_graph_change / test_constant)."""
+import tempfile
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import autograd, gluon
+from mxnet_tpu.gluon import nn
+
+
+def test_req_null_and_add():
+    # reference test_req: grad_req='null' skips, 'add' accumulates and
+    # zero_grad resets
+    net = nn.Dense(2, in_units=3)
+    net.initialize()
+    net.setattr("grad_req", "add")
+    x = mx.np.ones((1, 3))
+    for _ in range(3):
+        with autograd.record():
+            net(x).sum().backward()
+    g3 = net.weight.grad().asnumpy()
+    net.zero_grad()
+    with autograd.record():
+        net(x).sum().backward()
+    g1 = net.weight.grad().asnumpy()
+    np.testing.assert_allclose(g3, 3 * g1, rtol=1e-5)
+
+    # null on ONE parameter: the rest keep training, the null one is
+    # frozen (reference test_req exercises per-parameter reqs)
+    net.setattr("grad_req", "write")
+    net.weight.grad_req = "null"
+    net.zero_grad()
+    with autograd.record():
+        net(x).sum().backward()
+    assert float(np.abs(net.bias.grad().asnumpy()).sum()) > 0
+    with pytest.raises(RuntimeError):
+        net.weight.grad()  # grad buffer gone under grad_req='null'
+
+
+def test_reqs_switching_training_inference():
+    # reference: switching between recording and inference must not
+    # leave stale gradients or fail re-entry
+    net = nn.Dense(2, in_units=3)
+    net.initialize()
+    x = mx.np.ones((4, 3))
+    with autograd.record():
+        net(x).sum().backward()
+    g_first = net.weight.grad().asnumpy().copy()
+    _ = net(x)          # inference pass
+    with autograd.record():
+        net(x).sum().backward()
+    np.testing.assert_allclose(net.weight.grad().asnumpy(), g_first,
+                               rtol=1e-6)
+
+
+def test_parameter_basic_and_str():
+    p = gluon.Parameter("weight", shape=(10, 10))
+    p.initialize(init="xavier")
+    assert p.shape == (10, 10)
+    assert "weight" in str(p) and "10" in str(p)
+    assert p.grad_req == "write"
+    with pytest.raises(Exception):
+        gluon.Parameter("w", shape=(2,), grad_req="bogus").initialize()
+
+
+def test_parameter_invalid_access():
+    p = gluon.Parameter("weight", shape=(2, 2))
+    with pytest.raises(Exception):
+        p.data()  # not initialized yet
+
+
+def test_constant_is_not_trained():
+    # reference test_constant: Constants take no gradient steps
+    class Net(gluon.HybridBlock):
+        def __init__(self):
+            super().__init__()
+            self.const = gluon.Constant(np.ones((2, 2), "float32") * 3)
+            self.dense = nn.Dense(2, in_units=2)
+
+        def forward(self, x):
+            return self.dense(x) + self.const.data()
+
+    net = Net()
+    net.initialize()
+    tr = gluon.Trainer(net.collect_params(), "sgd",
+                       {"learning_rate": 1.0})
+    x = mx.np.ones((2, 2))
+    with autograd.record():
+        loss = (net(x) ** 2).sum()
+    loss.backward()
+    tr.step(1)
+    np.testing.assert_allclose(net.const.data().asnumpy(),
+                               3 * np.ones((2, 2)))
+
+
+def test_gluon_param_load_dtype_source():
+    f = tempfile.mktemp(suffix=".params")
+    net = nn.Dense(2, in_units=3)
+    net.initialize()
+    net.save_parameters(f)
+    mx.waitall()
+    # dtype_source='current': cast the loaded arrays to the net's dtype
+    net16 = nn.Dense(2, in_units=3)
+    net16.cast("float16")
+    net16.load_parameters(f, cast_dtype=True, dtype_source="current")
+    assert str(net16.weight.data().dtype) == "float16"
+    # dtype_source='saved': the net takes the file's dtype
+    net_s = nn.Dense(2, in_units=3)
+    net_s.cast("float16")
+    net_s.load_parameters(f, cast_dtype=True, dtype_source="saved")
+    assert str(net_s.weight.data().dtype) == "float32"
+
+
+def test_fill_shape_deferred_and_load():
+    # deferred in_channels materialize on first forward...
+    net = nn.Conv2D(4, (3, 3))
+    net.initialize()
+    net(mx.np.ones((1, 5, 8, 8)))
+    assert net.weight.shape[1] == 5
+    # ...and a net loaded from those params starts with known shapes
+    f = tempfile.mktemp(suffix=".params")
+    net.save_parameters(f)
+    mx.waitall()
+    net2 = nn.Conv2D(4, (3, 3))
+    net2.load_parameters(f)
+    assert net2.weight.shape[1] == 5
+    out = net2(mx.np.ones((1, 5, 8, 8)))
+    np.testing.assert_allclose(out.asnumpy(),
+                               net(mx.np.ones((1, 5, 8, 8))).asnumpy(),
+                               rtol=1e-6)
+
+
+def test_grad_graph_change():
+    # reference test_grad_graph_change: the recorded graph may differ
+    # call-to-call (data-dependent python branch); each backward sees
+    # its own graph
+    net = nn.Dense(1, in_units=2)
+    net.initialize()
+    x = mx.np.ones((1, 2))
+    for scale in (1.0, 2.0, 3.0):
+        with autograd.record():
+            out = net(x)
+            out = out * scale if scale > 1.5 else out
+            out.sum().backward()
+        g = net.weight.grad().asnumpy()
+        np.testing.assert_allclose(g, scale * np.ones((1, 2)), rtol=1e-6)
+
+
+def test_block_setattr_lr_mult_reaches_trainer():
+    # reference: model.setattr('lr_mult', 0.0) freezes parameters
+    net = nn.Dense(2, in_units=3)
+    net.initialize()
+    net.setattr("lr_mult", 0.0)
+    before = net.weight.data().asnumpy().copy()
+    tr = gluon.Trainer(net.collect_params(), "sgd",
+                       {"learning_rate": 1.0})
+    with autograd.record():
+        net(mx.np.ones((1, 3))).sum().backward()
+    tr.step(1)
+    np.testing.assert_allclose(net.weight.data().asnumpy(), before)
+
+
+def test_grad_req_change_starts_from_fresh_zeros():
+    # write -> add must not accumulate onto the stale write-mode grad
+    net = nn.Dense(1, in_units=2)
+    net.initialize()
+    x = mx.np.ones((1, 2))
+    with autograd.record():
+        net(x).sum().backward()
+    g_write = net.weight.grad().asnumpy().copy()
+    net.weight.grad_req = "add"
+    with autograd.record():
+        net(x).sum().backward()
+    np.testing.assert_allclose(net.weight.grad().asnumpy(), g_write)
+
+
+def test_constant_grad_req_coerced_with_warning():
+    import warnings
+
+    c = gluon.Constant(np.ones((2, 2), "float32"))
+    with warnings.catch_warnings(record=True) as w:
+        warnings.simplefilter("always")
+        c.grad_req = "write"
+    assert c.grad_req == "null"
+    assert any("not differentiable" in str(x.message) for x in w)
