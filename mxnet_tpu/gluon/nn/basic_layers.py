@@ -180,10 +180,12 @@ class BatchNorm(HybridBlock):
         self.running_mean = Parameter("running_mean", shape=sh,
                                       init=running_mean_initializer,
                                       grad_req="null",
+                                      differentiable=False,
                                       allow_deferred_init=True)
         self.running_var = Parameter("running_var", shape=sh,
                                      init=running_variance_initializer,
                                      grad_req="null",
+                                     differentiable=False,
                                      allow_deferred_init=True)
 
     def _defer(self, x):

@@ -53,7 +53,9 @@ class Parameter:
         self.init = init
         self.allow_deferred_init = allow_deferred_init
         self._differentiable = bool(differentiable)
-        self.grad_req = grad_req if differentiable else "null"
+        # validate FIRST (the setter), then the setter's own coercion
+        # downgrades non-differentiable params to 'null'
+        self.grad_req = grad_req
         self._data_map = None  # {Device: NDArray}
         self._grad_map = None
         self._ctx_list = None
@@ -186,6 +188,11 @@ class Parameter:
                 f"differentiable; ignoring grad_req={req!r}",
                 stacklevel=2)
             req = "null"
+        if req == getattr(self, "_grad_req", None):
+            # same-value reassignment keeps accumulated gradients
+            # (reference setter early-returns; Block.setattr loops every
+            # parameter unconditionally)
+            return
         self._grad_req = req
         data_map = getattr(self, "_data_map", None)
         if not data_map:

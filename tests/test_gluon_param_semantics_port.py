@@ -187,3 +187,39 @@ def test_constant_grad_req_coerced_with_warning():
         c.grad_req = "write"
     assert c.grad_req == "null"
     assert any("not differentiable" in str(x.message) for x in w)
+
+
+def test_same_value_grad_req_keeps_accumulation():
+    # Block.setattr loops every parameter unconditionally; re-applying
+    # the current grad_req must not clear accumulated gradients
+    net = nn.Dense(1, in_units=2)
+    net.initialize()
+    net.setattr("grad_req", "add")
+    x = mx.np.ones((1, 2))
+    with autograd.record():
+        net(x).sum().backward()
+    g1 = net.weight.grad().asnumpy().copy()
+    net.setattr("grad_req", "add")
+    with autograd.record():
+        net(x).sum().backward()
+    np.testing.assert_allclose(net.weight.grad().asnumpy(), 2 * g1)
+
+
+def test_bn_running_stats_never_trainable():
+    import warnings
+
+    bn = nn.BatchNorm()
+    bn.initialize()
+    bn(mx.np.ones((2, 3, 4, 4)))
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        bn.setattr("grad_req", "write")
+    assert bn.running_mean.grad_req == "null"
+    assert bn.running_var.grad_req == "null"
+    assert bn.gamma.grad_req == "write"
+
+
+def test_grad_req_validates_before_coercion():
+    with pytest.raises(ValueError):
+        gluon.Parameter("w", shape=(2,), grad_req="bogus",
+                        differentiable=False)

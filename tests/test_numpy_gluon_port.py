@@ -1,0 +1,128 @@
+"""numpy-interface gluon families (reference:
+tests/python/unittest/test_numpy_gluon.py — activation layers against
+closed forms, PixelShuffle all ranks, boolean-dtype hybridize, np
+Constants, symbolic save/load of np blocks)."""
+import numpy as np
+import pytest
+import scipy.special as sps
+
+import mxnet_tpu as mx
+from mxnet_tpu import autograd, gluon
+from mxnet_tpu.gluon import nn
+
+X = np.array([[-3.0, -1.0, -0.1, 0.0, 0.5, 2.0]], dtype="float32")
+
+
+_ACT_CASES = [
+    ("LeakyReLU", lambda: nn.LeakyReLU(0.1),
+     lambda x: np.where(x >= 0, x, 0.1 * x)),
+    ("ELU", lambda: nn.ELU(1.0),
+     lambda x: np.where(x >= 0, x, np.expm1(x))),
+    ("SELU", lambda: nn.SELU(),
+     lambda x: 1.0507009873554805 * np.where(
+         x >= 0, x, 1.6732632423543772 * np.expm1(x))),
+    ("GELU", lambda: nn.GELU(),
+     lambda x: 0.5 * x * (1 + sps.erf(x / np.sqrt(2)))),
+    ("Swish", lambda: nn.Swish(),
+     lambda x: x * sps.expit(x)),
+    ("SiLU", lambda: nn.SiLU(),
+     lambda x: x * sps.expit(x)),
+]
+
+
+@pytest.mark.parametrize("name,layer_fn,ref", _ACT_CASES,
+                         ids=[c[0] for c in _ACT_CASES])
+def test_activation_layer_values(name, layer_fn, ref):
+    layer = layer_fn()
+    layer.initialize()
+    got = layer(mx.np.array(X)).asnumpy()
+    np.testing.assert_allclose(got, ref(X), rtol=1e-4, atol=1e-5)
+
+
+def test_prelu_learned_slope():
+    layer = nn.PReLU(alpha_initializer=mx.initializer.Constant(0.25))
+    layer.initialize()
+    got = layer(mx.np.array(X)).asnumpy()
+    np.testing.assert_allclose(got, np.where(X >= 0, X, 0.25 * X),
+                               rtol=1e-5)
+    # alpha receives gradient
+    x = mx.np.array(X)
+    with autograd.record():
+        layer(x).sum().backward()
+    for p in layer.collect_params().values():
+        assert float(np.abs(p.grad().asnumpy()).sum()) > 0
+
+
+@pytest.mark.parametrize("rank,shape,factor", [
+    (1, (1, 4, 6), 2), (2, (1, 4, 3, 3), 2), (3, (1, 8, 2, 2, 2), 2)])
+def test_pixelshuffle_ranks(rank, shape, factor):
+    cls = {1: nn.PixelShuffle1D, 2: nn.PixelShuffle2D,
+           3: nn.PixelShuffle3D}[rank]
+    layer = cls(factor)
+    x = np.arange(np.prod(shape), dtype="float32").reshape(shape)
+    out = layer(mx.np.array(x)).asnumpy()
+    assert out.shape[1] == shape[1] // factor ** rank
+    for i in range(2, 2 + rank):
+        assert out.shape[i] == shape[i] * factor
+    # content preserved (pixel shuffle is a permutation)
+    np.testing.assert_allclose(np.sort(out.ravel()),
+                               np.sort(x.ravel()))
+
+
+def test_identity_passthrough_and_grad():
+    layer = nn.Identity()
+    x = mx.np.array(X)
+    x.attach_grad()
+    with autograd.record():
+        layer(x).sum().backward()
+    np.testing.assert_allclose(x.grad.asnumpy(), np.ones_like(X))
+
+
+def test_hybridize_boolean_dtype():
+    class B(gluon.HybridBlock):
+        def forward(self, x):
+            return x == x
+
+    b = B()
+    b.hybridize()
+    out = b(mx.np.ones((3,)))
+    assert str(out.dtype) == "bool"
+    assert out.asnumpy().all()
+
+
+def test_np_get_constant_in_hybrid_graph():
+    class B(gluon.HybridBlock):
+        def __init__(self):
+            super().__init__()
+            self.c = gluon.Constant(np.full((2, 2), 5.0, "float32"))
+
+        def forward(self, x):
+            return x + self.c.data()
+
+    b = B()
+    b.initialize()
+    b.hybridize()
+    out = b(mx.np.ones((2, 2)))
+    np.testing.assert_allclose(out.asnumpy(), 6 * np.ones((2, 2)))
+
+
+def test_np_loss_ndarray():
+    # reference test_np_loss_ndarray: losses over np arrays
+    loss = gluon.loss.L1Loss()
+    pred = mx.np.array([[1.0, 2, 3]])
+    label = mx.np.array([[0.0, 2, 5]])
+    np.testing.assert_allclose(
+        float(loss(pred, label).asnumpy()), (1 + 0 + 2) / 3, rtol=1e-6)
+    sce = gluon.loss.SoftmaxCrossEntropyLoss()
+    out = sce(mx.np.array([[10.0, -10.0]]), mx.np.array([0]))
+    assert float(out.asnumpy()) < 1e-3
+
+
+def test_parameters_zero_grad_np():
+    net = nn.Dense(3, in_units=2)
+    net.initialize()
+    with autograd.record():
+        net(mx.np.ones((1, 2))).sum().backward()
+    assert float(np.abs(net.weight.grad().asnumpy()).sum()) > 0
+    net.zero_grad()
+    assert float(np.abs(net.weight.grad().asnumpy()).sum()) == 0
