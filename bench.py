@@ -390,6 +390,16 @@ def main():
             rows.append({"metric": "int8_agreement", "error": str(e)})
 
     result_extra = {}
+    try:
+        # compile counts / transfer+collective bytes / step metrics ride
+        # along with the throughput numbers, so a BENCH_*.json regression
+        # can be read against what the runtime actually did
+        # (docs/telemetry.md)
+        from mxnet_tpu import telemetry
+
+        result_extra["telemetry"] = telemetry.dump()
+    except Exception as e:  # never let observability sink the headline
+        result_extra["telemetry"] = {"error": str(e)}
     if platform == "cpu":
         note = ("CPU run — not a TPU measurement; last on-chip numbers: "
                 "bench_r05_evidence/headline.json (2631.4 img/s train "

@@ -102,6 +102,7 @@ from . import contrib  # noqa: E402
 from . import util  # noqa: E402
 from . import runtime  # noqa: E402
 from . import profiler  # noqa: E402
+from . import telemetry  # noqa: E402  (runtime metrics; docs/telemetry.md)
 from . import test_utils  # noqa: E402  (mx.test_utils like the reference)
 from . import amp  # noqa: E402  (mx.amp — reference: python/mxnet/amp/)
 
@@ -155,4 +156,5 @@ __all__ = [
     "util",
     "runtime",
     "profiler",
+    "telemetry",
 ]

@@ -26,9 +26,12 @@ What remains for this layer to provide, and does:
 from __future__ import annotations
 
 import os
+import time
 import weakref
 
 import jax
+
+from .telemetry import instruments as _telemetry
 
 __all__ = ["waitall", "wait_to_read", "set_bulk_size", "bulk", "engine_type",
            "push", "new_var", "wait_for_var", "native_engine"]
@@ -65,6 +68,7 @@ def waitall():
     the reference's WaitForAll exception rethrow semantics. Also drains the
     native host engine (engine-pushed IO/compute tasks).
     """
+    t0 = time.perf_counter()
     for arr in list(_live):
         data = getattr(arr, "_data", None)
         if data is not None and hasattr(data, "block_until_ready"):
@@ -75,6 +79,7 @@ def waitall():
         from ._checkpoint_io import reap_idle
 
         reap_idle()  # all IO drained: drop per-path bookkeeping
+    _telemetry.record_sync("waitall", time.perf_counter() - t0)
 
 
 def native_engine():
@@ -127,7 +132,9 @@ def wait_for_var(var):
 def wait_to_read(arr):
     data = getattr(arr, "_data", arr)
     if hasattr(data, "block_until_ready"):
+        t0 = time.perf_counter()
         data.block_until_ready()
+        _telemetry.record_sync("wait_to_read", time.perf_counter() - t0)
 
 
 _bulk_size = 15

@@ -26,6 +26,7 @@ from __future__ import annotations
 import json
 import re
 import threading as _threading
+import time
 
 import jax
 import jax.numpy as jnp
@@ -33,6 +34,7 @@ import numpy as _np
 
 from .. import _random
 from .. import autograd as ag
+from ..telemetry import instruments as _telemetry
 from ..base import DeferredInitializationError, normalize_dtype
 from ..device import Device, current_device
 from ..ndarray.ndarray import NDArray
@@ -578,19 +580,24 @@ class HybridBlock(Block):
                 try:
                     return self._call_cached(*args)
                 except (jax.errors.TracerArrayConversionError,
-                        jax.errors.ConcretizationTypeError):
+                        jax.errors.ConcretizationTypeError) as e:
                     # the forward contains a dynamic-OUTPUT op
                     # (boolean_mask, box_nms selection — value-dependent
                     # shapes XLA cannot trace). Reference CachedOp flips
                     # to dynamic-shape execution (imperative per-op) for
                     # such graphs; we do the same: run this block eagerly
                     # from now on, keeping hybridize() a no-op for it.
+                    # The original exception text rides along so a genuine
+                    # tracing bug in user control flow is distinguishable
+                    # from expected dynamic-shape fallback (ADVICE.md).
                     import warnings
 
+                    _telemetry.record_fallback(type(self).__name__)
                     warnings.warn(
                         f"{type(self).__name__}.forward contains a "
                         "dynamic-output op; running imperatively "
-                        "(reference CachedOp dynamic-shape mode)",
+                        "(reference CachedOp dynamic-shape mode). "
+                        f"Original error: {type(e).__name__}: {e}",
                         stacklevel=2)
                     object.__setattr__(self, "_dynamic_graph", True)
         out = self.forward(*args, **kwargs)
@@ -666,6 +673,7 @@ class HybridBlock(Block):
 
     def _call_cached(self, *args):
         training = bool(ag.is_training())
+        compile_t0 = None  # set on cache miss: this call traces + compiles
         jitted = self._jit_variants.get(training)
         if jitted is None:
             # one thread completes deferred init + builds; others reuse
@@ -674,6 +682,7 @@ class HybridBlock(Block):
                 jitted = self._jit_variants.get(training)
                 if jitted is None:
                     self._ensure_initialized(args)
+                    compile_t0 = time.perf_counter()
                     jitted = self._build_variant(training, args)
                     self._jit_variants[training] = jitted
         else:
@@ -699,6 +708,14 @@ class HybridBlock(Block):
                 fn, pd, *arr_datas, has_aux=True)
         else:
             out_datas, state_vals = jitted(pd, key, *arr_datas)
+
+        if compile_t0 is not None:
+            # the whole cache-miss call is the compile cost users feel:
+            # trace + XLA compile + first dispatch (async — the device run
+            # itself isn't awaited here)
+            _telemetry.record_compile(
+                type(self).__name__, "train" if training else "predict",
+                time.perf_counter() - compile_t0)
 
         # apply aux state updates (BN running stats) — serialized so
         # concurrent threads cannot interleave half-written stats

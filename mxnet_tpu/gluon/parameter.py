@@ -54,7 +54,13 @@ class Parameter:
         self.allow_deferred_init = allow_deferred_init
         self._differentiable = bool(differentiable)
         # validate FIRST (the setter), then the setter's own coercion
-        # downgrades non-differentiable params to 'null'
+        # downgrades non-differentiable params to 'null'. The ctor default
+        # grad_req='write' on a differentiable=False parameter coerces
+        # SILENTLY (Constant, BN running stats — nothing the caller chose);
+        # the setter warns only on an explicit non-default request or a
+        # post-construction reassignment.
+        if not self._differentiable and grad_req == "write":
+            grad_req = "null"
         self.grad_req = grad_req
         self._data_map = None  # {Device: NDArray}
         self._grad_map = None

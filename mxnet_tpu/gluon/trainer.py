@@ -10,8 +10,10 @@ store (the dist server analog).
 from __future__ import annotations
 
 import pickle
+import time
 
 from .. import optimizer as opt_mod
+from ..telemetry import instruments as _telemetry
 from ..kvstore import KVStoreBase, create as kv_create
 from ..ndarray.ndarray import NDArray
 from .parameter import Parameter
@@ -58,6 +60,7 @@ class Trainer:
         if self._update_on_kvstore:
             self._kvstore.set_optimizer(self._optimizer)
         self._kv_initialized = False
+        self._last_step_end = None  # telemetry: previous step() finish
 
     @property
     def optimizer(self):
@@ -105,6 +108,16 @@ class Trainer:
         self._optimizer.rescale_grad = self._scale / batch_size
         self.allreduce_grads()
         self.update(batch_size, ignore_stale_grad, _skip_rescale=True)
+        # step-time = interval between consecutive step() completions, so
+        # the histogram sees the FULL iteration (data + fwd + bwd + update
+        # dispatch); the first step is counted but not timed. The MFU
+        # gauge follows when telemetry.set_flop_budget() declared a
+        # per-step FLOP cost (docs/telemetry.md).
+        now = time.perf_counter()
+        last = self._last_step_end
+        self._last_step_end = now
+        _telemetry.observe_step(
+            None if last is None else now - last, examples=batch_size)
 
     def update(self, batch_size, ignore_stale_grad=False,
                _skip_rescale=False):

@@ -23,11 +23,13 @@ costs, so it is opt-in exactly like the reference.
 from __future__ import annotations
 
 import os
+import time
 
 import jax
 import jax.numpy as jnp
 
 from ..ndarray.ndarray import NDArray, _wrap_out
+from ..telemetry import instruments as _telemetry
 from .base import KVStoreBase
 
 __all__ = ["TPUDist", "init_distributed_from_env"]
@@ -132,6 +134,7 @@ class TPUDist(KVStoreBase):
             for k, v, o in zip(keys, vals, outs):
                 self.pushpull(k, v, o, priority)
             return
+        t0 = time.perf_counter()
         vals = _aslist(value)
         vals = self._compress_vals(str(keys[0]), vals)
         if len(vals) == 1:
@@ -143,6 +146,10 @@ class TPUDist(KVStoreBase):
             total_data = self._tree_sum(len(datas))(*datas)
         if self.num_workers > 1:
             total_data = self._cross_process_sum(total_data)
+        _telemetry.record_collective(
+            "pushpull",
+            sum(_telemetry.nbytes_of(v._data) for v in vals),
+            time.perf_counter() - t0)
         if out is None:
             return
         outs = _aslist(out)
@@ -167,6 +174,7 @@ class TPUDist(KVStoreBase):
         return jnp.sum(jnp.asarray(gathered), axis=0)
 
     def broadcast(self, key, value, out, priority=0):  # noqa: ARG002
+        t0 = time.perf_counter()
         vals = _aslist(value)
         outs = _aslist(out)
         src = vals[0]._data
@@ -178,6 +186,9 @@ class TPUDist(KVStoreBase):
         for o in outs:
             o._data = self._put_like(src, o._data)
             o._version += 1
+        _telemetry.record_collective(
+            "broadcast", _telemetry.nbytes_of(src),
+            time.perf_counter() - t0)
 
     # -- mesh-sharded fast path -------------------------------------------
     def allreduce_sharded(self, arrays, mesh=None, axis="dp"):
@@ -250,6 +261,7 @@ class P3Store(TPUDist):
         size = int(vals[0].size)
         if size <= self._bound or len(vals) == 1:
             return super().pushpull(key, value, out, priority)
+        t0 = time.perf_counter()
         # gradient compression applies before slicing, exactly as in the
         # delegated small-tensor path
         vals = self._compress_vals(str(keys[0]), vals)
@@ -268,6 +280,10 @@ class P3Store(TPUDist):
                 chunk = self._cross_process_sum(chunk)
             reduced.append(chunk)
         total = jnp.concatenate(reduced).reshape(vals[0].shape)
+        _telemetry.record_collective(
+            "pushpull",
+            sum(_telemetry.nbytes_of(v._data) for v in vals),
+            time.perf_counter() - t0)
         if out is None:
             return
         for o in _aslist(out):

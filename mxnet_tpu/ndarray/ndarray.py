@@ -32,6 +32,7 @@ from .. import autograd as ag
 from .. import engine
 from ..base import MXNetError, normalize_dtype
 from ..device import Device, current_device, from_jax_device
+from ..telemetry import instruments as _telemetry
 
 __all__ = ["NDArray", "apply_op", "array", "from_jax", "waitall"]
 
@@ -140,6 +141,8 @@ class NDArray:
 
     def asnumpy(self):
         """Blocking copy to host numpy (reference: NDArray::SyncCopyToCPU)."""
+        if _is_concrete(self._data):
+            _telemetry.record_transfer("d2h", _telemetry.nbytes_of(self._data))
         return _np.asarray(self._data)
 
     def item(self):
@@ -341,6 +344,8 @@ class NDArray:
     def copyto(self, other):
         """Copy to a device or into another NDArray (reference: CopyFromTo,
         src/ndarray/ndarray.cc:1370)."""
+        if isinstance(other, (Device, NDArray)) and _is_concrete(self._data):
+            _telemetry.record_transfer("d2d", _telemetry.nbytes_of(self._data))
         if isinstance(other, Device):
             data = jax.device_put(self._data, other.jax_device)
             return NDArray(data, other)
@@ -872,6 +877,7 @@ def array(source, dtype=None, device=None, ctx=None):
             dtype = _np.dtype(_np.int32)  # 32-bit creation default
     if dtype is not None:
         arr = arr.astype(dtype)
+    _telemetry.record_transfer("h2d", arr.nbytes)
     return NDArray(jax.device_put(arr, device.jax_device), device)
 
 

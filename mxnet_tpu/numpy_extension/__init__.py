@@ -163,8 +163,23 @@ def set_np(shape=True, array=True, dtype=False):
 
 
 def reset_np():
-    """Restore numpy semantics and reference dtype defaults (this
-    framework is np-native, so the resting state is all-on)."""
+    """Restore this framework's resting np-semantics: ALL-ON.
+
+    Deliberate divergence from the reference, whose ``reset_np()`` is
+    ``set_np(shape=False, array=False, dtype=False)`` (np semantics OFF):
+    this framework is np-native — every frontend array IS an mx.np array
+    and zero-dim/zero-size shapes are always representable — so the
+    resting state keeps ``array``/``shape`` semantics on and only the
+    dtype default reverts (float32/int32 creation defaults, reference
+    behavior). Porting guidance: code that called reference
+    ``reset_np()`` to get legacy-1.x semantics back should not expect
+    legacy behavior here; see docs/migration.md.
+
+    Consequently :func:`is_np_array` / :func:`is_np_shape` are ADVISORY
+    flags for ported code paths (scope managers util.np_shape/np_array
+    flip them thread-locally) — they do not switch the underlying array
+    implementation, which is always np-native.
+    """
     set_np()
 
 

@@ -4,18 +4,49 @@ These replace the reference's entire comm layer: CommCPU/CommDevice reduce
 (src/kvstore/comm.h), tree allreduce (comm_tree.h), NCCL (kvstore_nccl.h) and
 ps-lite push/pull — all become XLA collectives that ride ICI within a slice
 and DCN across slices, scheduled asynchronously by the compiler.
+
+Every wrapper records call count / input bytes / dispatch wall-time into
+the telemetry registry (`collective_*` counters labeled by op — see
+docs/telemetry.md). Dispatch time, not completion: the returned arrays are
+async like everything else on the device stream.
 """
 from __future__ import annotations
 
+import time
 from functools import partial
 
 import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
-from jax import shard_map
+
+try:  # jax >= 0.5 exports shard_map at top level
+    from jax import shard_map
+except ImportError:  # older jax: experimental spelling, check_rep kwarg
+    from jax.experimental.shard_map import shard_map as _exp_shard_map
+
+    def shard_map(f, *, mesh, in_specs, out_specs, check_vma=True, **kw):
+        return _exp_shard_map(f, mesh=mesh, in_specs=in_specs,
+                              out_specs=out_specs, check_rep=check_vma,
+                              **kw)
+
+from ..telemetry import instruments as _telemetry
 
 __all__ = ["psum_tree", "allreduce_mean", "all_gather", "reduce_scatter",
-           "ring_permute"]
+           "ring_permute", "axis_size"]
+
+
+def axis_size(axis_name):
+    """Static size of a named mesh axis inside shard_map (version-compat:
+    jax.lax.axis_size where available, else the psum(1, axis) identity)."""
+    fn = getattr(jax.lax, "axis_size", None)
+    if fn is not None:
+        return fn(axis_name)
+    return jax.lax.psum(1, axis_name)
+
+
+def _tree_bytes(tree):
+    return sum(_telemetry.nbytes_of(x)
+               for x in jax.tree_util.tree_leaves(tree))
 
 
 def psum_tree(tree, mesh, axis="dp"):
@@ -36,7 +67,11 @@ def psum_tree(tree, mesh, axis="dp"):
     def _reduce(t):
         return jax.tree_util.tree_map(lambda x: jax.lax.psum(x, axis), t)
 
-    return jax.jit(_reduce)(tree)
+    t0 = time.perf_counter()
+    out = jax.jit(_reduce)(tree)
+    _telemetry.record_collective("psum", _tree_bytes(tree),
+                                 time.perf_counter() - t0)
+    return out
 
 
 def allreduce_mean(tree, mesh, axis="dp"):
@@ -53,7 +88,11 @@ def all_gather(x, mesh, axis="dp", tiled=True):
     def _ag(v):
         return jax.lax.all_gather(v, axis, tiled=tiled)
 
-    return jax.jit(_ag)(x)
+    t0 = time.perf_counter()
+    out = jax.jit(_ag)(x)
+    _telemetry.record_collective("all_gather", _tree_bytes(x),
+                                 time.perf_counter() - t0)
+    return out
 
 
 def reduce_scatter(x, mesh, axis="dp"):
@@ -68,7 +107,11 @@ def reduce_scatter(x, mesh, axis="dp"):
     def _rs(v):
         return jax.lax.psum_scatter(v, axis, tiled=True)
 
-    return jax.jit(_rs)(x)
+    t0 = time.perf_counter()
+    out = jax.jit(_rs)(x)
+    _telemetry.record_collective("reduce_scatter", _tree_bytes(x),
+                                 time.perf_counter() - t0)
+    return out
 
 
 def ring_permute(x, mesh, axis="sp", shift=1):
@@ -81,4 +124,8 @@ def ring_permute(x, mesh, axis="sp", shift=1):
     def _pp(v):
         return jax.lax.ppermute(v, axis, perm)
 
-    return jax.jit(_pp)(x)
+    t0 = time.perf_counter()
+    out = jax.jit(_pp)(x)
+    _telemetry.record_collective("ppermute", _tree_bytes(x),
+                                 time.perf_counter() - t0)
+    return out

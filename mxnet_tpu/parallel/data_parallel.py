@@ -57,7 +57,7 @@ def make_data_parallel_step(loss_fn, update_fn, mesh, axis="dp",
 
 def make_shard_map_step(loss_fn, update_fn, mesh, axis="dp"):
     """Explicit-collective variant: per-device bodies + lax.psum on grads."""
-    from jax import shard_map
+    from .collectives import shard_map  # version-compat wrapper
 
     # check_vma=False: jax's replication checker rewrites grads of
     # replicated (P()) inputs with an extra psum, inflating them by the

@@ -126,7 +126,7 @@ def pipeline_apply_sharded(stage_fn, stacked_params, microbatches, mesh,
     see `interleave_stages`). microbatches: (M, ...) replicated across
     stages; with num_virtual > 1, M must be a multiple of S.
     """
-    from jax import shard_map
+    from .collectives import shard_map  # version-compat wrapper
 
     n_stages = mesh.shape[axis]
     for leaf in jax.tree_util.tree_leaves(stacked_params):
@@ -274,7 +274,7 @@ def pipeline_step_1f1b_sharded(stage_fn, loss_fn, stacked_params,
                                microbatches, labels, mesh, axis="pp"):
     """Jit pipeline_step_1f1b over `axis`; returns (loss, stacked_grads)
     with grads sharded like the params."""
-    from jax import shard_map
+    from .collectives import shard_map  # version-compat wrapper
 
     n_stages = mesh.shape[axis]
     for leaf in jax.tree_util.tree_leaves(stacked_params):

@@ -21,6 +21,8 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import PartitionSpec as P
 
+from . import collectives as _collectives
+
 __all__ = ["ring_attention", "ring_attention_sharded",
            "ring_flash_attention", "ring_flash_attention_sharded"]
 
@@ -46,7 +48,7 @@ def ring_attention(q, k, v, axis_name, causal=False, scale=None):
     """Per-device body: full attention over a sequence sharded on
     `axis_name`. Call inside shard_map/pjit; q,k,v are local shards
     (batch, heads, seq_local, head_dim)."""
-    n = jax.lax.axis_size(axis_name)
+    n = _collectives.axis_size(axis_name)
     my = jax.lax.axis_index(axis_name)
     s_local = q.shape[2]
     if scale is None:
@@ -96,7 +98,7 @@ def ring_attention_sharded(q, k, v, mesh, axis="sp", causal=False,
     over `axis` and run ring attention as one jitted shard_map program.
     The jitted program is cached per (mesh, axis, causal, scale) so training
     loops hit the compile cache."""
-    from jax import shard_map
+    from .collectives import shard_map  # version-compat wrapper
 
     key = (mesh, axis, causal, scale)
     run = _jit_cache.get(key)
@@ -133,7 +135,7 @@ def _ring_flash_fwd_impl(q, k, v, axis_name, causal, scale, interpret,
 
     from ..ops.pallas_attention import _flash_fwd
 
-    n = jax.lax.axis_size(axis_name)
+    n = _collectives.axis_size(axis_name)
     my = jax.lax.axis_index(axis_name)
     s_local = q.shape[2]
     bq = min(128, s_local)
@@ -218,7 +220,7 @@ def _ring_flash_vjp_bwd(axis_name, causal, scale, interpret, valid_len,
     from ..ops.pallas_attention import _flash_bwd
 
     q, k, v, out, lse = res
-    n = jax.lax.axis_size(axis_name)
+    n = _collectives.axis_size(axis_name)
     my = jax.lax.axis_index(axis_name)
     s_local = q.shape[2]
     bq = min(128, s_local)
@@ -309,7 +311,7 @@ def ring_flash_attention_sharded(q, k, v, mesh, axis="sp", causal=False,
     per hop (the production long-context path on TPU). Jitted program
     cached per (mesh, axis, causal, scale, interpret) like
     ring_attention_sharded."""
-    from jax import shard_map
+    from .collectives import shard_map  # version-compat wrapper
 
     key = ("flash", mesh, axis, causal, scale, interpret)
     run = _jit_cache.get(key)

@@ -57,6 +57,19 @@ def _record(name, t0_us, dur_us, cat="host"):
         })
 
 
+def record_counter_event(name, value, cat="telemetry"):
+    """Append a chrome counter event (`"ph": "C"`) to the host buffer —
+    the telemetry bridge's entry point (telemetry/chrome.py), gated like
+    every host event. Returns 1 if recorded, 0 if not recording."""
+    if not _host_recording():
+        return 0
+    with _events_lock:
+        _events.append({"name": name, "cat": cat, "ph": "C",
+                        "ts": _now_us(), "pid": os.getpid(),
+                        "args": {"value": float(value)}})
+    return 1
+
+
 def set_config(**kwargs):
     """Accepts reference kwargs (filename, profile_all, aggregate_stats...)."""
     _config.update(kwargs)

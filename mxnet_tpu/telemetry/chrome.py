@@ -1,0 +1,45 @@
+"""Chrome-trace bridge: metrics as counter events on the profiler timeline.
+
+`emit_chrome_counters()` snapshots the registry and appends one chrome
+counter event (`"ph": "C"`) per series into profiler.py's host event
+buffer, so a subsequent `profiler.dump()` shows metric values on the SAME
+chrome://tracing timeline as the host spans (scope/Task/Frame). Call it at
+any timeline points worth marking — e.g. once per logging interval or at
+epoch boundaries; each call drops one sample per series at the current
+trace timestamp.
+
+Series are named `name{label="v",...}`; histograms surface as two
+counters, `name_count` and `name_sum` (chrome counters plot scalars, not
+distributions).
+
+The profiler import is deferred to call time: telemetry stays importable
+everywhere (profiler pulls in jax).
+"""
+from __future__ import annotations
+
+from .exporters import _label_str
+from .registry import REGISTRY
+
+__all__ = ["emit_chrome_counters"]
+
+
+def emit_chrome_counters(registry=None):
+    """Emit one chrome counter event per series; returns how many were
+    recorded (0 when the profiler is not recording — same gating as every
+    other host event)."""
+    from .. import profiler
+
+    registry = registry or REGISTRY
+    emitted = 0
+    for m in registry.collect():
+        for labelvalues, child in m.series():
+            ls = _label_str(m, labelvalues)
+            if m.typ == "histogram":
+                emitted += profiler.record_counter_event(
+                    f"{m.name}_count{ls}", child.count)
+                emitted += profiler.record_counter_event(
+                    f"{m.name}_sum{ls}", child.sum)
+            else:
+                emitted += profiler.record_counter_event(
+                    f"{m.name}{ls}", child.value)
+    return emitted

@@ -1,0 +1,178 @@
+"""The framework's metric catalog + the record_* helpers hot paths call.
+
+Every instrumentation touchpoint in the framework goes through ONE helper
+here (record_compile / record_fallback / record_transfer / record_sync /
+record_collective / observe_step), so:
+
+  * the catalog below is the single source of metric names, labels, and
+    buckets (docs/telemetry.md mirrors it);
+  * call sites stay one line;
+  * the disabled path is a single `REGISTRY.enabled` check before any
+    lock, float math, or label resolution.
+
+Metric names follow Prometheus conventions (`_total` counters, `_seconds`
+base units), unprefixed — one process, one framework.
+"""
+from __future__ import annotations
+
+from .registry import REGISTRY, counter, gauge, histogram
+
+__all__ = [
+    "jit_compile_total", "jit_compile_seconds", "hybridize_fallback_total",
+    "transfer_total", "transfer_bytes_total",
+    "sync_total", "sync_blocked_seconds_total",
+    "collective_total", "collective_bytes_total",
+    "collective_seconds_total",
+    "step_total", "step_time_seconds", "examples_per_second",
+    "mfu_ratio", "flops_per_step", "peak_flops",
+    "record_compile", "record_fallback", "record_transfer", "record_sync",
+    "record_collective", "observe_step", "set_flop_budget", "nbytes_of",
+]
+
+# v5e-class bf16 peak, the default MFU denominator (tools/perf_lab.py's
+# PEAK_BF16); override with set_flop_budget(..., peak_flops=...).
+DEFAULT_PEAK_FLOPS = 197e12
+
+_COMPILE_BUCKETS = (.01, .05, .1, .25, .5, 1.0, 2.5, 5.0, 10.0, 30.0,
+                    60.0, 120.0, 300.0)
+_STEP_BUCKETS = (.001, .0025, .005, .01, .025, .05, .1, .25, .5,
+                 1.0, 2.5, 5.0, 10.0, 30.0, 60.0)
+_SYNC_BUCKETS = (.0001, .001, .01, .1, 1.0, 10.0)  # noqa: F841 (doc aid)
+
+# -- compiles ---------------------------------------------------------------
+jit_compile_total = counter(
+    "jit_compile_total",
+    "CachedOp variant builds: trace + XLA compile + first run "
+    "(gluon/block.py _call_cached cache miss)", ["block", "variant"])
+jit_compile_seconds = histogram(
+    "jit_compile_seconds",
+    "Wall time of each CachedOp variant build (trace+compile+first run)",
+    ["block", "variant"], buckets=_COMPILE_BUCKETS)
+hybridize_fallback_total = counter(
+    "hybridize_fallback_total",
+    "Hybridized blocks that fell back to imperative execution on a "
+    "dynamic-output op (gluon/block.py)", ["block"])
+
+# -- host<->device transfers ------------------------------------------------
+transfer_total = counter(
+    "transfer_total", "Explicit array transfers by direction "
+    "(h2d: mx.np.array/creation, d2h: asnumpy, d2d: copyto)",
+    ["direction"])
+transfer_bytes_total = counter(
+    "transfer_bytes_total", "Bytes moved by explicit array transfers",
+    ["direction"])
+
+# -- sync points ------------------------------------------------------------
+sync_total = counter(
+    "sync_total", "Blocking sync points by site (engine.waitall / "
+    "engine.wait_to_read)", ["site"])
+sync_blocked_seconds_total = counter(
+    "sync_blocked_seconds_total",
+    "Host wall time spent blocked in sync points", ["site"])
+
+# -- collectives ------------------------------------------------------------
+collective_total = counter(
+    "collective_total", "Collective dispatches by op (kvstore pushpull/"
+    "broadcast, parallel.collectives psum/all_gather/...)", ["op"])
+collective_bytes_total = counter(
+    "collective_bytes_total", "Input bytes handed to each collective",
+    ["op"])
+collective_seconds_total = counter(
+    "collective_seconds_total",
+    "Host wall time in collective dispatch (async: excludes on-device "
+    "completion unless the call itself syncs)", ["op"])
+
+# -- training steps ---------------------------------------------------------
+step_total = counter(
+    "step_total", "Trainer.step calls (optimizer updates dispatched)")
+step_time_seconds = histogram(
+    "step_time_seconds",
+    "Interval between consecutive Trainer.step completions (full "
+    "iteration: data + forward + backward + update dispatch)",
+    buckets=_STEP_BUCKETS)
+examples_per_second = gauge(
+    "examples_per_second",
+    "batch_size / last step interval (Trainer.step batch_size)")
+mfu_ratio = gauge(
+    "mfu_ratio", "Model FLOP utilization: declared flops_per_step / "
+    "step interval / peak_flops (set_flop_budget)")
+flops_per_step = gauge(
+    "flops_per_step", "Declared per-step FLOP budget (set_flop_budget)")
+peak_flops = gauge(
+    "peak_flops", "Declared accelerator peak FLOP/s (set_flop_budget)")
+
+
+# -- helpers ----------------------------------------------------------------
+
+def nbytes_of(x):
+    """Byte size of an array-ish (jax.Array / numpy / NDArray _data)."""
+    nb = getattr(x, "nbytes", None)
+    if nb is not None:
+        return int(nb)
+    size = getattr(x, "size", None)
+    itemsize = getattr(getattr(x, "dtype", None), "itemsize", None)
+    if size is not None and itemsize is not None:
+        return int(size) * int(itemsize)
+    return 0
+
+
+def record_compile(block, variant, seconds):
+    if not REGISTRY.enabled:
+        return
+    jit_compile_total.labels(block, variant).inc()
+    jit_compile_seconds.labels(block, variant).observe(seconds)
+
+
+def record_fallback(block):
+    if not REGISTRY.enabled:
+        return
+    hybridize_fallback_total.labels(block).inc()
+
+
+def record_transfer(direction, nbytes):
+    if not REGISTRY.enabled:
+        return
+    transfer_total.labels(direction).inc()
+    transfer_bytes_total.labels(direction).inc(nbytes)
+
+
+def record_sync(site, seconds):
+    if not REGISTRY.enabled:
+        return
+    sync_total.labels(site).inc()
+    sync_blocked_seconds_total.labels(site).inc(seconds)
+
+
+def record_collective(op, nbytes, seconds):
+    if not REGISTRY.enabled:
+        return
+    collective_total.labels(op).inc()
+    collective_bytes_total.labels(op).inc(nbytes)
+    collective_seconds_total.labels(op).inc(seconds)
+
+
+def set_flop_budget(flops, peak=None):
+    """Declare the per-step FLOP budget (and optionally the accelerator
+    peak) so observe_step can keep the MFU gauge live. `flops` is the
+    cost of ONE optimizer step (fwd+bwd+update), e.g. from XLA
+    cost_analysis as tools/perf_lab.py measures it."""
+    flops_per_step.set(flops)
+    peak_flops.set(peak if peak is not None else DEFAULT_PEAK_FLOPS)
+
+
+def observe_step(seconds=None, examples=None):
+    """Record one training step. `seconds` is the interval since the
+    previous step's completion (None on the first step — counted, not
+    timed); `examples` is the global batch size."""
+    if not REGISTRY.enabled:
+        return
+    step_total.inc()
+    if seconds is None or seconds <= 0:
+        return
+    step_time_seconds.observe(seconds)
+    if examples:
+        examples_per_second.set(examples / seconds)
+    budget = flops_per_step.value
+    peak = peak_flops.value
+    if budget > 0 and peak > 0:
+        mfu_ratio.set(budget / seconds / peak)

@@ -26,6 +26,8 @@ def measure(sizes_mb, iters=10, warmup=2):
     import numpy as np
     from jax.sharding import Mesh, NamedSharding, PartitionSpec as Pspec
 
+    from mxnet_tpu.parallel.collectives import shard_map  # version compat
+
     devs = jax.devices()
     n = len(devs)
     mesh = Mesh(np.array(devs).reshape(n), ("dp",))
@@ -38,7 +40,7 @@ def measure(sizes_mb, iters=10, warmup=2):
 
         @jax.jit
         def allreduce(v):
-            return jax.shard_map(
+            return shard_map(
                 lambda s: jax.lax.psum(s, "dp"), mesh=mesh,
                 in_specs=Pspec("dp", None), out_specs=Pspec(None, None),
             )(v)

@@ -19,6 +19,7 @@ import jax.numpy as jnp
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 import bench  # noqa: E402
+from mxnet_tpu import telemetry  # noqa: E402
 
 PEAK_BF16 = 197e12  # v5e-class peak
 
@@ -97,13 +98,22 @@ def main():
         dt, _ = bench._timeit(one, lambda o: float(o), iters, warmup)
 
     step_ms = dt / iters * 1e3
+    # MFU comes FROM the telemetry gauge, not a local recomputation: the
+    # measured XLA flop count is declared as the per-step budget and the
+    # measured step time observed, so every consumer (this JSON line,
+    # prometheus_text scrapes, bench snapshots) reads the same number
+    # (docs/telemetry.md).
+    telemetry.set_flop_budget(fl, peak=PEAK_BF16)
+    telemetry.observe_step(dt / iters, examples=batch)
+    mfu = (telemetry.instruments.mfu_ratio.value if telemetry.enabled()
+           else fl / (dt / iters) / PEAK_BF16)  # MXTPU_TELEMETRY=0 runs
     print(json.dumps({
         "mode": mode, "layout": layout, "batch": batch,
         "platform": platform,
         "step_ms": round(step_ms, 2),
         "img_s": round(batch * iters / dt, 1),
         "xla_gflops_per_step": round(fl / 1e9, 2),
-        "mfu_vs_197T": round(fl / (dt / iters) / PEAK_BF16, 4),
+        "mfu_vs_197T": round(mfu, 4),
     }))
 
 
