@@ -33,6 +33,71 @@ WARMUP = 3
 ITERS = 30  # enough steps to amortize the tunnel's ~70ms sync round-trip
 
 
+def _latest_bench_snapshot(repo_dir=None):
+    """(path, parsed) of the highest-round BENCH_r*.json the driver left
+    in the repo root, or (None, None). `parsed` is the prior run's result
+    object ({"metric", "value", "rows", ...})."""
+    import glob
+    import re
+
+    repo_dir = repo_dir or os.path.dirname(os.path.abspath(__file__))
+    best, best_round = None, -1
+    for path in glob.glob(os.path.join(repo_dir, "BENCH_r*.json")):
+        m = re.search(r"BENCH_r(\d+)\.json$", path)
+        if m and int(m.group(1)) > best_round:
+            best, best_round = path, int(m.group(1))
+    if best is None:
+        return None, None
+    try:
+        with open(best) as f:
+            snap = json.load(f)
+    except (OSError, ValueError):
+        return None, None
+    parsed = snap.get("parsed") if isinstance(snap, dict) else None
+    return best, parsed if isinstance(parsed, dict) else None
+
+
+def _check_regressions(current, threshold=0.03):
+    """Compare this run's metrics against the latest BENCH_r*.json; any
+    same-named throughput metric that dropped more than `threshold`
+    (default 3%) gets a WARNING on stderr and a row in the returned list
+    (the r3→r5 inference regression went unflagged; never again). Metric
+    names embed batch/layout/CPU_FALLBACK, so only like-for-like configs
+    compare."""
+    path, prior = _latest_bench_snapshot()
+    if prior is None:
+        return []
+
+    def flatten(result):
+        out = {}
+        if result.get("metric") and isinstance(
+                result.get("value"), (int, float)):
+            out[result["metric"]] = float(result["value"])
+        for row in result.get("rows") or []:
+            if row.get("metric") and isinstance(
+                    row.get("value"), (int, float)):
+                out[row["metric"]] = float(row["value"])
+        return out
+
+    prior_vals, cur_vals = flatten(prior), flatten(current)
+    regressions = []
+    for name, prev in prior_vals.items():
+        cur = cur_vals.get(name)
+        if cur is None or prev <= 0 or "agreement" in name:
+            continue  # ratios aren't throughput; missing = not comparable
+        drop = (prev - cur) / prev
+        if drop > threshold:
+            regressions.append({
+                "metric": name, "previous": prev, "current": cur,
+                "drop_pct": round(drop * 100, 2),
+                "baseline_file": os.path.basename(path),
+            })
+            print(f"WARNING: {name} regressed {drop * 100:.1f}% "
+                  f"({prev} -> {cur}) vs {os.path.basename(path)}",
+                  file=sys.stderr)
+    return regressions
+
+
 def _probe_accelerator(timeout=None):
     """Check device init in a subprocess — a wedged TPU tunnel HANGS
     rather than raising, so an in-process try/except can't catch it."""
@@ -424,7 +489,7 @@ def main():
                         "dials PALLAS_AXON_POOL_IPS=" + pool_ip
                         + " with no listener) — " + note)
         result_extra["note"] = note
-    print(json.dumps({
+    result = {
         **result_extra,
         "metric": f"resnet50_train_bf16_b{batch}_{layout.lower()}"
                   "_imgs_per_sec_per_chip" + suffix,
@@ -435,7 +500,14 @@ def main():
                     "(reference perf.md:243-253; best published batch — "
                     "throughput-vs-throughput comparison)",
         "rows": rows,
-    }))
+    }
+    try:
+        regressions = _check_regressions(result)
+    except Exception as e:  # the comparison must never sink the headline
+        regressions = [{"error": str(e)}]
+    if regressions:
+        result["regressions"] = regressions
+    print(json.dumps(result))
 
 
 if __name__ == "__main__":

@@ -222,8 +222,14 @@ def backward(heads, head_grads=None, retain_graph=False, train_mode=True):  # no
     """
     import jax.numpy as jnp
 
+    from .diagnostics import spans as _spans
     from .ndarray.ndarray import NDArray
 
+    with _spans.span("backward", cat="bwd"):
+        return _backward_impl(heads, head_grads, retain_graph, jnp, NDArray)
+
+
+def _backward_impl(heads, head_grads, retain_graph, jnp, NDArray):
     if isinstance(heads, NDArray):
         heads = [heads]
     if head_grads is None:

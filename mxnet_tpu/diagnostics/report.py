@@ -1,0 +1,85 @@
+"""diagnostics.report(): one text page answering the round-5 questions —
+where did each step's time go, what did XLA compile, and how much memory
+is each device holding."""
+from __future__ import annotations
+
+from . import introspect, spans, watchdog
+
+__all__ = ["report"]
+
+
+def _rule(title):
+    return f"\n== {title} " + "=" * max(0, 68 - len(title)) + "\n"
+
+
+def report(steps=None):
+    """Render the full diagnostics state as text.
+
+    ``steps``: keep only the last N steps in the phase table (None = all
+    steps currently in the ring).
+    """
+    out = []
+    out.append("mxnet_tpu diagnostics report")
+    out.append(f"(spans {'enabled' if spans.enabled() else 'DISABLED'}, "
+               f"ring {len(spans.records())}/{spans.ring_capacity()}, "
+               f"step counter {spans.current_step()})")
+
+    out.append(_rule("per-step phase breakdown (ms)"))
+    recs = spans.records()
+    if steps is not None:
+        keep = sorted({r["step"] for r in recs})[-steps:]
+        recs = [r for r in recs if r["step"] in keep]
+    out.append(spans.format_step_table(recs))
+
+    out.append(_rule("compile registry (per block/variant)"))
+    out.append(introspect.format_compile_table())
+
+    out.append(_rule("device memory"))
+    for dm in introspect.device_memory():
+        stats = dm["stats"]
+        if stats:
+            out.append(
+                f"{dm['device']} [{dm['platform']}]: "
+                f"in_use={stats.get('bytes_in_use', 0) / 1e6:.2f}MB "
+                f"peak={stats.get('peak_bytes_in_use', 0) / 1e6:.2f}MB "
+                f"limit={stats.get('bytes_limit', 0) / 1e6:.2f}MB")
+        else:
+            out.append(f"{dm['device']} [{dm['platform']}]: "
+                       "memory_stats unavailable on this backend")
+
+    out.append(_rule("sync & collectives (telemetry)"))
+    out.append(_telemetry_section())
+
+    out.append(_rule("watchdog"))
+    if watchdog.enabled():
+        out.append(f"armed, timeout {watchdog.timeout_s():g}s")
+    else:
+        out.append("disarmed (set MXTPU_WATCHDOG=1 to arm)")
+    if watchdog.last_dump() is not None:
+        out.append("A STALL DUMP WAS CAPTURED — see "
+                   "watchdog.last_dump() / the crash file.")
+
+    return "\n".join(out) + "\n"
+
+
+def _telemetry_section():
+    try:
+        from .. import telemetry
+        if not telemetry.REGISTRY.enabled:
+            return "(telemetry disabled — MXTPU_TELEMETRY=1 to enable)"
+        dumped = telemetry.dump()
+    except Exception as e:
+        return f"(telemetry unavailable: {e!r})"
+    lines = []
+    for name in sorted(dumped):
+        if not ("sync" in name or "collective" in name):
+            continue
+        m = dumped[name]
+        for s in m["samples"]:
+            lbl = ",".join(f"{k}={v}" for k, v in s["labels"].items())
+            if "value" in s:
+                lines.append(f"{name}{{{lbl}}} {s['value']}")
+            else:
+                lines.append(f"{name}{{{lbl}}} count={s['count']} "
+                             f"sum={s['sum']:.6f}")
+    return "\n".join(lines) if lines else "(no sync/collective series yet)"

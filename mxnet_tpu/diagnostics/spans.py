@@ -1,0 +1,239 @@
+"""Span-based step tracer: nested, thread-safe host spans in a ring buffer.
+
+The telemetry registry (ISSUE 1) answers "how many / how much"; spans
+answer "WHERE did this step's milliseconds go". Every instrumented layer
+wraps its hot region in ``diagnostics.span(name, cat=phase)``:
+
+  * gluon/trainer.py     step / collective(allreduce) / optimizer phases
+  * gluon/block.py       the CachedOp call path (``fwd`` phase, compile)
+  * autograd.backward    the ``bwd`` phase
+  * engine.py            waitall / wait_to_read (``sync`` phase)
+  * kvstore + parallel   collective dispatch (``collective`` phase)
+  * gluon/data loader    batch fetch (``data`` phase)
+
+Records land in a bounded ring (``MXTPU_DIAG_RING_CAPACITY``, default
+4096 — old spans fall off, memory stays bounded on infinite loops), each
+tagged with the training-step index live at the time, so
+:func:`step_table` can pivot the ring into a per-step phase breakdown and
+:func:`emit_chrome_spans` can replay it as chrome-trace "X" events on the
+profiler.py timeline (same clock origin — spans and profiler scopes
+align in chrome://tracing / Perfetto).
+
+``MXTPU_DIAGNOSTICS=0`` disables collection at import; every helper
+early-outs on one bool check, so instrumented hot paths cost one branch
+when off.
+"""
+from __future__ import annotations
+
+import collections
+import contextlib
+import os
+import threading
+import time
+
+__all__ = [
+    "span", "enabled", "enable", "disable", "reset",
+    "records", "set_ring_capacity", "ring_capacity",
+    "current_stack", "all_stacks",
+    "mark_step", "current_step",
+    "step_table", "format_step_table", "emit_chrome_spans",
+    "PHASES",
+]
+
+# the phase vocabulary step_table pivots on (free-form cats still record;
+# they land in the 'other' column)
+PHASES = ("data", "fwd", "bwd", "collective", "optimizer", "sync",
+          "compile")
+
+_enabled = os.environ.get("MXTPU_DIAGNOSTICS", "1") != "0"
+
+_DEFAULT_CAPACITY = int(os.environ.get("MXTPU_DIAG_RING_CAPACITY", "4096"))
+_ring = collections.deque(maxlen=max(1, _DEFAULT_CAPACITY))
+_ring_lock = threading.Lock()
+
+_tls = threading.local()
+
+# tid -> the thread's live span stack (shared view for the watchdog dump;
+# entries are (name, cat, t0). The list object is the SAME one _tls holds,
+# so reads here see pushes/pops without cross-thread bookkeeping.)
+_open_stacks = {}
+_open_lock = threading.Lock()
+
+_step = [0]  # training-step index, bumped by Trainer.step via mark_step()
+
+
+def enabled():
+    return _enabled
+
+
+def enable():
+    global _enabled
+    _enabled = True
+
+
+def disable():
+    global _enabled
+    _enabled = False
+
+
+def set_ring_capacity(n):
+    """Rebound the ring (existing records are kept up to the new cap);
+    returns the previous capacity."""
+    global _ring
+    n = max(1, int(n))
+    with _ring_lock:
+        prev = _ring.maxlen
+        _ring = collections.deque(_ring, maxlen=n)
+    return prev
+
+
+def ring_capacity():
+    return _ring.maxlen
+
+
+def _stack():
+    st = getattr(_tls, "stack", None)
+    if st is None:
+        st = _tls.stack = []
+        with _open_lock:
+            # prune stacks of dead threads while we hold the lock anyway
+            live = {t.ident for t in threading.enumerate()}
+            for tid in [t for t in _open_stacks if t not in live]:
+                del _open_stacks[tid]
+            _open_stacks[threading.get_ident()] = st
+    return st
+
+
+@contextlib.contextmanager
+def span(name, cat="host"):
+    """Record a nested host span. Thread-safe; zero-ish cost when
+    disabled. The record keeps wall times from ``time.perf_counter()``
+    (the profiler clock), the nesting depth, and the current step index."""
+    if not _enabled:
+        yield
+        return
+    st = _stack()
+    t0 = time.perf_counter()
+    st.append((name, cat, t0))
+    try:
+        yield
+    finally:
+        # record even when the body raises — the failing region is
+        # exactly the one worth seeing (profiler.scope does the same)
+        t1 = time.perf_counter()
+        st.pop()
+        rec = {
+            "name": name, "cat": cat,
+            "t0": t0, "dur": t1 - t0,
+            "tid": threading.get_ident(),
+            "depth": len(st),
+            "step": _step[0],
+        }
+        with _ring_lock:
+            _ring.append(rec)
+
+
+def records():
+    """Snapshot of the ring, oldest first."""
+    with _ring_lock:
+        return list(_ring)
+
+
+def reset():
+    """Drop recorded spans and rewind the step counter (open spans on
+    other threads keep running and will record on exit)."""
+    with _ring_lock:
+        _ring.clear()
+    _step[0] = 0
+
+
+def mark_step():
+    """Advance the training-step index (Trainer.step calls this on
+    completion; spans recorded before the Nth call belong to step N)."""
+    _step[0] += 1
+    return _step[0]
+
+
+def current_step():
+    return _step[0]
+
+
+def current_stack():
+    """Names of the calling thread's open spans, outermost first."""
+    return [name for name, _cat, _t0 in getattr(_tls, "stack", ())]
+
+
+def all_stacks():
+    """{thread_ident: [open span names]} across ALL threads — the
+    watchdog's view of what everyone was inside when a hang fired."""
+    with _open_lock:
+        return {tid: [name for name, _c, _t in list(st)]
+                for tid, st in _open_stacks.items() if st}
+
+
+# ---------------------------------------------------------------------------
+# per-step phase breakdown
+# ---------------------------------------------------------------------------
+
+def step_table(recs=None):
+    """Pivot span records into {step: {phase: seconds}}.
+
+    Only depth-0 spans of each category are summed (a ``fwd`` span nested
+    under another ``fwd`` span would double-count its parent's time).
+    Categories outside PHASES accumulate under ``other``.
+    """
+    recs = records() if recs is None else recs
+    # innermost-per-category: keep a span unless an enclosing span of the
+    # SAME category covers it (nested fwd under fwd); cheap approximation:
+    # group by (step, cat) over minimum depth seen for that pair
+    min_depth = {}
+    for r in recs:
+        key = (r["step"], r["cat"], r["tid"])
+        d = min_depth.get(key)
+        if d is None or r["depth"] < d:
+            min_depth[key] = r["depth"]
+    table = {}
+    for r in recs:
+        if r["depth"] != min_depth[(r["step"], r["cat"], r["tid"])]:
+            continue
+        phase = r["cat"] if r["cat"] in PHASES else "other"
+        row = table.setdefault(r["step"], {})
+        row[phase] = row.get(phase, 0.0) + r["dur"]
+    return table
+
+
+def format_step_table(recs=None):
+    """The per-step breakdown as a fixed-width text table (milliseconds)."""
+    table = step_table(recs)
+    cols = list(PHASES) + ["other"]
+    lines = [f"{'step':>6}" + "".join(f"{c:>12}" for c in cols)
+             + f"{'total':>12}"]
+    for step in sorted(table):
+        row = table[step]
+        total = sum(row.values())
+        lines.append(
+            f"{step:>6}"
+            + "".join(f"{row.get(c, 0.0) * 1e3:>12.3f}" for c in cols)
+            + f"{total * 1e3:>12.3f}")
+    if len(lines) == 1:
+        lines.append("  (no spans recorded)")
+    return "\n".join(lines)
+
+
+def emit_chrome_spans():
+    """Replay the ring into profiler.py's host buffer as chrome-trace "X"
+    events (cat = the span's phase), so ``profiler.dump()`` shows the
+    diagnostics timeline alongside profiler scopes/tasks. Gated like every
+    host event: returns 0 when the profiler is not recording."""
+    from .. import profiler
+
+    emitted = 0
+    for r in records():
+        emitted += profiler.record_host_event(
+            f"span::{r['name']}",
+            profiler.perf_counter_to_trace_us(r["t0"]),
+            r["dur"] * 1e6,
+            cat=f"diag.{r['cat']}",
+            args={"step": r["step"], "depth": r["depth"]},
+        )
+    return emitted

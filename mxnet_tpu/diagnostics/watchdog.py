@@ -1,0 +1,243 @@
+"""Hang watchdog: stack + span + telemetry dump when a sync point stalls.
+
+Distributed TPU jobs die silently: one host misses a collective and every
+other host parks inside ``waitall`` forever, with nothing on stderr. The
+watchdog is an opt-in daemon thread armed around the blocking sites —
+``engine.waitall`` / ``wait_to_read``, kvstore ``pushpull``/``broadcast``,
+and the parallel collectives — via ``watchdog.guard("waitall")``. A guard
+that stays open past the deadline triggers a dump of:
+
+  * every Python thread's stack (``sys._current_frames``),
+  * the live diagnostics span stack per thread (what phase each thread
+    was inside),
+  * pending-collective telemetry (the mxtpu_collective_* series),
+  * live device memory stats,
+
+to stderr AND a crash file, then optionally interrupts the main thread.
+
+Env knobs (all read live, so tests and notebooks can flip them):
+
+  MXTPU_WATCHDOG=1            arm (default off — production opt-in)
+  MXTPU_WATCHDOG_TIMEOUT_S=180  stall deadline per guarded site
+  MXTPU_WATCHDOG_FILE=path    crash-file destination
+                              (default ./mxtpu_watchdog_dump.txt)
+  MXTPU_WATCHDOG_RAISE=1      after dumping, KeyboardInterrupt the main
+                              thread (default: dump and keep waiting —
+                              the process survives, the evidence doesn't
+                              depend on it dying)
+
+Each guarded site dumps at most once per stall (re-arming on exit), so a
+hung job produces one report per site, not a stderr flood.
+"""
+from __future__ import annotations
+
+import contextlib
+import io
+import os
+import sys
+import threading
+import time
+import traceback
+
+__all__ = ["guard", "enabled", "configure", "dump_now", "last_dump",
+           "reset"]
+
+_overrides = {}  # programmatic configure() beats the environment
+_lock = threading.Lock()
+_guards = {}  # id -> {"site": str, "deadline": float, "tid": int, "fired": bool}
+_next_id = [0]
+_scanner = None
+_dump_count = [0]
+_last_dump = [None]
+
+
+def _opt(key, default):
+    if key in _overrides:
+        return _overrides[key]
+    return os.environ.get(key, default)
+
+
+def enabled():
+    return str(_opt("MXTPU_WATCHDOG", "0")) not in ("0", "", "false")
+
+
+def timeout_s():
+    try:
+        return float(_opt("MXTPU_WATCHDOG_TIMEOUT_S", "180"))
+    except (TypeError, ValueError):
+        return 180.0
+
+
+def configure(**kwargs):
+    """Programmatic overrides for the MXTPU_WATCHDOG* knobs
+    (``configure(MXTPU_WATCHDOG=1, MXTPU_WATCHDOG_TIMEOUT_S=0.2)``);
+    pass ``None`` to drop an override back to the environment."""
+    for k, v in kwargs.items():
+        if v is None:
+            _overrides.pop(k, None)
+        else:
+            _overrides[k] = v
+
+
+def reset():
+    """Drop overrides, open guards, and dump history (test hygiene)."""
+    _overrides.clear()
+    with _lock:
+        _guards.clear()
+    _dump_count[0] = 0
+    _last_dump[0] = None
+
+
+def last_dump():
+    """The most recent dump text (None if the watchdog never fired)."""
+    return _last_dump[0]
+
+
+@contextlib.contextmanager
+def guard(site):
+    """Arm the watchdog around a blocking region. No-op (one dict read)
+    when the watchdog is off."""
+    if not enabled():
+        yield
+        return
+    _ensure_scanner()
+    with _lock:
+        gid = _next_id[0]
+        _next_id[0] += 1
+        _guards[gid] = {"site": site,
+                        "deadline": time.monotonic() + timeout_s(),
+                        "tid": threading.get_ident(), "fired": False}
+    try:
+        yield
+    finally:
+        with _lock:
+            _guards.pop(gid, None)
+
+
+def _ensure_scanner():
+    global _scanner
+    if _scanner is not None and _scanner.is_alive():
+        return
+    with _lock:
+        if _scanner is not None and _scanner.is_alive():
+            return
+        _scanner = threading.Thread(
+            target=_scan_loop, name="mxtpu-watchdog", daemon=True)
+        _scanner.start()
+
+
+def _scan_loop():
+    while True:
+        # poll fast relative to the shortest plausible deadline so tests
+        # with sub-second timeouts fire promptly
+        time.sleep(min(0.05, max(0.01, timeout_s() / 10.0)))
+        now = time.monotonic()
+        expired = []
+        with _lock:
+            for g in _guards.values():
+                if not g["fired"] and now >= g["deadline"]:
+                    g["fired"] = True
+                    expired.append(dict(g))
+        for g in expired:
+            _fire(g)
+
+
+def _fire(g):
+    text = _render_dump(g)
+    _last_dump[0] = text
+    _dump_count[0] += 1
+    try:
+        sys.stderr.write(text)
+        sys.stderr.flush()
+    except Exception:
+        pass
+    path = str(_opt("MXTPU_WATCHDOG_FILE", "mxtpu_watchdog_dump.txt"))
+    try:
+        with open(path, "a") as f:
+            f.write(text)
+    except OSError:
+        pass
+    if str(_opt("MXTPU_WATCHDOG_RAISE", "0")) not in ("0", "", "false"):
+        import _thread
+        _thread.interrupt_main()
+
+
+def dump_now(site="manual"):
+    """Produce (and record) a dump immediately — same content as a fired
+    guard; handy from a debugger or signal handler."""
+    g = {"site": site, "deadline": time.monotonic(),
+         "tid": threading.get_ident(), "fired": True}
+    _fire(g)
+    return _last_dump[0]
+
+
+def _render_dump(g):
+    from . import spans
+    from .introspect import device_memory
+
+    buf = io.StringIO()
+    w = buf.write
+    names = {t.ident: t.name for t in threading.enumerate()}
+    w("\n" + "=" * 72 + "\n")
+    w(f"MXTPU WATCHDOG: site '{g['site']}' stalled "
+      f"> {timeout_s():g}s (thread {names.get(g['tid'], '?')}"
+      f"/{g['tid']}, step {spans.current_step()})\n")
+    w("=" * 72 + "\n")
+
+    w("\n-- python thread stacks --\n")
+    for tid, frame in sys._current_frames().items():
+        w(f"\nThread {names.get(tid, '?')} ({tid})"
+          f"{'  <- stalled guard' if tid == g['tid'] else ''}:\n")
+        w("".join(traceback.format_stack(frame)))
+
+    w("\n-- live span stacks --\n")
+    stacks = spans.all_stacks()
+    if stacks:
+        for tid, stack in stacks.items():
+            w(f"Thread {names.get(tid, '?')} ({tid}): "
+              + " > ".join(stack) + "\n")
+    else:
+        w("(no open spans)\n")
+
+    w("\n-- open watchdog guards --\n")
+    now = time.monotonic()
+    with _lock:
+        for og in _guards.values():
+            w(f"site={og['site']} thread={og['tid']} "
+              f"remaining={og['deadline'] - now:+.1f}s"
+              f"{' FIRED' if og['fired'] else ''}\n")
+
+    w("\n-- collective telemetry --\n")
+    try:
+        from .. import telemetry
+        dumped = telemetry.dump()
+        coll = {k: v for k, v in dumped.items() if "collective" in k
+                or "sync" in k}
+        if coll:
+            for name, m in sorted(coll.items()):
+                for s in m["samples"]:
+                    lbl = ",".join(f"{k}={v}"
+                                   for k, v in s["labels"].items())
+                    val = s.get("value", s.get("count"))
+                    w(f"{name}{{{lbl}}} {val}\n")
+        else:
+            w("(no collective/sync series recorded)\n")
+    except Exception as e:
+        w(f"(telemetry unavailable: {e!r})\n")
+
+    w("\n-- device memory --\n")
+    try:
+        for dm in device_memory():
+            w(f"{dm['device']} [{dm['platform']}]: ")
+            stats = dm["stats"]
+            if stats:
+                w(f"in_use={stats.get('bytes_in_use')} "
+                  f"peak={stats.get('peak_bytes_in_use')} "
+                  f"limit={stats.get('bytes_limit')}\n")
+            else:
+                w("memory_stats unavailable on this backend\n")
+    except Exception as e:
+        w(f"(device query failed: {e!r})\n")
+
+    w("=" * 72 + "\n")
+    return buf.getvalue()

@@ -31,6 +31,8 @@ import weakref
 
 import jax
 
+from .diagnostics import spans as _spans
+from .diagnostics import watchdog as _watchdog
 from .telemetry import instruments as _telemetry
 
 __all__ = ["waitall", "wait_to_read", "set_bulk_size", "bulk", "engine_type",
@@ -69,16 +71,17 @@ def waitall():
     native host engine (engine-pushed IO/compute tasks).
     """
     t0 = time.perf_counter()
-    for arr in list(_live):
-        data = getattr(arr, "_data", None)
-        if data is not None and hasattr(data, "block_until_ready"):
-            data.block_until_ready()
-    eng = native_engine()
-    if eng is not None:
-        eng.wait_all()
-        from ._checkpoint_io import reap_idle
+    with _spans.span("waitall", cat="sync"), _watchdog.guard("waitall"):
+        for arr in list(_live):
+            data = getattr(arr, "_data", None)
+            if data is not None and hasattr(data, "block_until_ready"):
+                data.block_until_ready()
+        eng = native_engine()
+        if eng is not None:
+            eng.wait_all()
+            from ._checkpoint_io import reap_idle
 
-        reap_idle()  # all IO drained: drop per-path bookkeeping
+            reap_idle()  # all IO drained: drop per-path bookkeeping
     _telemetry.record_sync("waitall", time.perf_counter() - t0)
 
 
@@ -133,7 +136,9 @@ def wait_to_read(arr):
     data = getattr(arr, "_data", arr)
     if hasattr(data, "block_until_ready"):
         t0 = time.perf_counter()
-        data.block_until_ready()
+        with _spans.span("wait_to_read", cat="sync"), \
+                _watchdog.guard("wait_to_read"):
+            data.block_until_ready()
         _telemetry.record_sync("wait_to_read", time.perf_counter() - t0)
 
 

@@ -34,6 +34,8 @@ import numpy as _np
 
 from .. import _random
 from .. import autograd as ag
+from ..diagnostics import introspect as _introspect
+from ..diagnostics import spans as _spans
 from ..telemetry import instruments as _telemetry
 from ..base import DeferredInitializationError, normalize_dtype
 from ..device import Device, current_device
@@ -683,7 +685,8 @@ class HybridBlock(Block):
                 if jitted is None:
                     self._ensure_initialized(args)
                     compile_t0 = time.perf_counter()
-                    jitted = self._build_variant(training, args)
+                    with _spans.span(type(self).__name__, cat="compile"):
+                        jitted = self._build_variant(training, args)
                     self._jit_variants[training] = jitted
         else:
             self._ensure_initialized(args)
@@ -699,23 +702,32 @@ class HybridBlock(Block):
             or any(a._requires_grad_entry for a in args)
         )
 
-        if taping:
-            def fn(pd_, *xs):
-                out, state = jitted(pd_, key, *xs)
-                return out, state
+        with _spans.span(type(self).__name__, cat="fwd"):
+            if taping:
+                def fn(pd_, *xs):
+                    out, state = jitted(pd_, key, *xs)
+                    return out, state
 
-            out_datas, vjp_fn, state_vals = jax.vjp(
-                fn, pd, *arr_datas, has_aux=True)
-        else:
-            out_datas, state_vals = jitted(pd, key, *arr_datas)
+                out_datas, vjp_fn, state_vals = jax.vjp(
+                    fn, pd, *arr_datas, has_aux=True)
+            else:
+                out_datas, state_vals = jitted(pd, key, *arr_datas)
 
         if compile_t0 is not None:
             # the whole cache-miss call is the compile cost users feel:
             # trace + XLA compile + first dispatch (async — the device run
             # itself isn't awaited here)
+            variant = "train" if training else "predict"
+            compile_seconds = time.perf_counter() - compile_t0
             _telemetry.record_compile(
-                type(self).__name__, "train" if training else "predict",
-                time.perf_counter() - compile_t0)
+                type(self).__name__, variant, compile_seconds)
+            # AOT-introspect what XLA built for this signature: flops,
+            # bytes accessed, arg/out/temp sizes → the compile registry
+            # (diagnostics.report / tools/diagnose.py). Costs one extra
+            # compile per variant; MXTPU_DIAG_COMPILE=0 skips.
+            _introspect.capture_compile(
+                type(self).__name__, variant, jitted,
+                (pd, key, *arr_datas), compile_seconds=compile_seconds)
 
         # apply aux state updates (BN running stats) — serialized so
         # concurrent threads cannot interleave half-written stats

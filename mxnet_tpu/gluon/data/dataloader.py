@@ -20,6 +20,7 @@ import threading
 
 import numpy as _np
 
+from ...diagnostics import spans as _spans
 from .batchify import default_batchify_fn
 from .sampler import BatchSampler, RandomSampler, SequentialSampler
 
@@ -83,6 +84,19 @@ class DataLoader:
         return self._batchify_fn(samples)
 
     def __iter__(self):
+        # span-wrap each fetch so the diagnostics step table shows the
+        # 'data' phase: time the training loop spends waiting on a batch
+        # (pipeline-starved steps show up here, whatever the worker mode)
+        it = self._iter_impl()
+        while True:
+            with _spans.span("dataloader_next", cat="data"):
+                try:
+                    batch = next(it)
+                except StopIteration:
+                    return
+            yield batch
+
+    def _iter_impl(self):
         if self._num_workers == 0:
             for indices in self._batch_sampler:
                 yield self._make_batch(indices)

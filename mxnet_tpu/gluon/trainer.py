@@ -13,6 +13,7 @@ import pickle
 import time
 
 from .. import optimizer as opt_mod
+from ..diagnostics import spans as _spans
 from ..telemetry import instruments as _telemetry
 from ..kvstore import KVStoreBase, create as kv_create
 from ..ndarray.ndarray import NDArray
@@ -106,8 +107,13 @@ class Trainer:
         """allreduce + optimizer update, scaling grads by 1/batch_size
         (reference: trainer.py:341)."""
         self._optimizer.rescale_grad = self._scale / batch_size
-        self.allreduce_grads()
-        self.update(batch_size, ignore_stale_grad, _skip_rescale=True)
+        with _spans.span("allreduce_grads", cat="collective"):
+            self.allreduce_grads()
+        with _spans.span("optimizer_update", cat="optimizer"):
+            self.update(batch_size, ignore_stale_grad, _skip_rescale=True)
+        # close this iteration's step bucket: fwd/bwd spans recorded since
+        # the previous step() and the two phases above all share one index
+        _spans.mark_step()
         # step-time = interval between consecutive step() completions, so
         # the histogram sees the FULL iteration (data + fwd + bwd + update
         # dispatch); the first step is counted but not timed. The MFU

@@ -28,6 +28,8 @@ import time
 import jax
 import jax.numpy as jnp
 
+from ..diagnostics import spans as _spans
+from ..diagnostics import watchdog as _watchdog
 from ..ndarray.ndarray import NDArray, _wrap_out
 from ..telemetry import instruments as _telemetry
 from .base import KVStoreBase
@@ -136,16 +138,19 @@ class TPUDist(KVStoreBase):
             return
         t0 = time.perf_counter()
         vals = _aslist(value)
-        vals = self._compress_vals(str(keys[0]), vals)
-        if len(vals) == 1:
-            total_data = vals[0]._data
-        else:
-            # reduce on the first value's device; XLA moves operands over ICI
-            dev = next(iter(vals[0]._data.devices()))
-            datas = [jax.device_put(v._data, dev) for v in vals]
-            total_data = self._tree_sum(len(datas))(*datas)
-        if self.num_workers > 1:
-            total_data = self._cross_process_sum(total_data)
+        with _spans.span("kv.pushpull", cat="collective"), \
+                _watchdog.guard("kv.pushpull"):
+            vals = self._compress_vals(str(keys[0]), vals)
+            if len(vals) == 1:
+                total_data = vals[0]._data
+            else:
+                # reduce on the first value's device; XLA moves operands
+                # over ICI
+                dev = next(iter(vals[0]._data.devices()))
+                datas = [jax.device_put(v._data, dev) for v in vals]
+                total_data = self._tree_sum(len(datas))(*datas)
+            if self.num_workers > 1:
+                total_data = self._cross_process_sum(total_data)
         _telemetry.record_collective(
             "pushpull",
             sum(_telemetry.nbytes_of(v._data) for v in vals),
@@ -177,15 +182,17 @@ class TPUDist(KVStoreBase):
         t0 = time.perf_counter()
         vals = _aslist(value)
         outs = _aslist(out)
-        src = vals[0]._data
-        if self.num_workers > 1:
-            from jax.experimental import multihost_utils
+        with _spans.span("kv.broadcast", cat="collective"), \
+                _watchdog.guard("kv.broadcast"):
+            src = vals[0]._data
+            if self.num_workers > 1:
+                from jax.experimental import multihost_utils
 
-            src = jnp.asarray(
-                multihost_utils.broadcast_one_to_all(src))
-        for o in outs:
-            o._data = self._put_like(src, o._data)
-            o._version += 1
+                src = jnp.asarray(
+                    multihost_utils.broadcast_one_to_all(src))
+            for o in outs:
+                o._data = self._put_like(src, o._data)
+                o._version += 1
         _telemetry.record_collective(
             "broadcast", _telemetry.nbytes_of(src),
             time.perf_counter() - t0)

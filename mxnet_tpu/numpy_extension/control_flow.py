@@ -96,7 +96,12 @@ def while_loop(cond, func, loop_vars, max_iterations=None):
 
     def run_func(vars_):
         step_out, new_vars = func(*[NDArray(c) for c in vars_])
-        outs = step_out if isinstance(step_out, (list, tuple)) else [step_out]
+        if step_out is None:
+            outs = []  # state-only loop (reference allows None outputs)
+        elif isinstance(step_out, (list, tuple)):
+            outs = list(step_out)
+        else:
+            outs = [step_out]
         nv = new_vars if isinstance(new_vars, (list, tuple)) else [new_vars]
         return (
             tuple(o._data if isinstance(o, NDArray) else jnp.asarray(o)
@@ -125,7 +130,8 @@ def while_loop(cond, func, loop_vars, max_iterations=None):
         cond_fn, body_fn, (jnp.int32(0), datas, buffers))
     outputs = [NDArray(b) for b in bufs]
     finals = [NDArray(f) for f in final_vars]
-    out = outputs if len(outputs) > 1 else outputs[0]
+    # empty outputs stay a list, like the symbolic path (contrib.py)
+    out = outputs if len(outputs) != 1 else outputs[0]
     fin = finals if multi else finals[0]
     return out, fin
 

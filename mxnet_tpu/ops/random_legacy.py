@@ -142,15 +142,31 @@ def _sample_multinomial(data, shape=None, get_prob=False, dtype="int32",
     return NDArray(idx), NDArray(lp)
 
 
-def _sample_unique_zipfian(range_max, shape=None, **kw):  # noqa: ARG001
-    """_sample_unique_zipfian: draw `shape[-1]` UNIQUE classes per batch
-    row from the log-uniform (Zipfian) distribution
-    P(k) = (log(k+2)-log(k+1)) / log(range_max+1), counting how many raw
-    draws each row needed (reference: sampler.h UniqueSampler +
-    random/unique_sample_op.cc — a CPU-only op there too; this sampler
-    is host-side numpy by design). Returns (classes, num_trials)."""
+def _zipfian_draws(u, range_max):
+    """Uniform [0,1) draws -> log-uniform classes in [0, range_max), the
+    reference kernel exactly: ``lround(exp(u * log(range_max))) - 1``
+    (sampler.h LogUniformSampler::Sample — lround is round-half-away-from-
+    zero, which for positive values is floor(x + 0.5), NOT numpy's
+    banker's rounding)."""
     import math
 
+    import numpy as onp
+
+    raw = onp.floor(
+        onp.exp(u * math.log(range_max)) + 0.5).astype(onp.int64) - 1
+    # exp() can land exactly on range_max before the -1; clamp like the
+    # reference's `% range_max` guard without the wraparound-to-0 bias
+    return onp.clip(raw, 0, range_max - 1)
+
+
+def _sample_unique_zipfian(range_max, shape=None, **kw):  # noqa: ARG001
+    """_sample_unique_zipfian: draw `shape[-1]` UNIQUE classes per batch
+    row from the log-uniform (Zipfian) distribution — the reference draw
+    kernel `lround(exp(u * log(range_max))) - 1` (see _zipfian_draws) —
+    counting how many raw draws each row needed (reference: sampler.h
+    UniqueSampler +
+    random/unique_sample_op.cc — a CPU-only op there too; this sampler
+    is host-side numpy by design). Returns (classes, num_trials)."""
     import numpy as onp
 
     from ..ndarray.ndarray import NDArray
@@ -165,17 +181,14 @@ def _sample_unique_zipfian(range_max, shape=None, **kw):  # noqa: ARG001
             f"{range_max}")
     seed = int(jax.random.randint(_random.next_key(), (), 0, 2**31 - 1))
     rs = onp.random.RandomState(seed)
-    log_range = math.log(range_max + 1)
     classes = onp.empty((batch, num_sampled), onp.int64)
     trials = onp.empty((batch,), onp.int64)
     for i in range(batch):
         draws = onp.empty((0,), onp.int64)
         chunk = max(4 * num_sampled, 1024)
         while True:
-            new = onp.exp(
-                rs.random_sample(chunk) * log_range).astype(onp.int64) - 1
-            draws = onp.concatenate(
-                [draws, onp.clip(new, 0, range_max - 1)])
+            new = _zipfian_draws(rs.random_sample(chunk), range_max)
+            draws = onp.concatenate([draws, new])
             uniq, first = onp.unique(draws, return_index=True)
             if uniq.size >= num_sampled:
                 # trial count = position of the draw completing the set

@@ -29,6 +29,8 @@ except ImportError:  # older jax: experimental spelling, check_rep kwarg
                               out_specs=out_specs, check_rep=check_vma,
                               **kw)
 
+from ..diagnostics import spans as _spans
+from ..diagnostics import watchdog as _watchdog
 from ..telemetry import instruments as _telemetry
 
 __all__ = ["psum_tree", "allreduce_mean", "all_gather", "reduce_scatter",
@@ -68,7 +70,8 @@ def psum_tree(tree, mesh, axis="dp"):
         return jax.tree_util.tree_map(lambda x: jax.lax.psum(x, axis), t)
 
     t0 = time.perf_counter()
-    out = jax.jit(_reduce)(tree)
+    with _spans.span("psum", cat="collective"), _watchdog.guard("psum"):
+        out = jax.jit(_reduce)(tree)
     _telemetry.record_collective("psum", _tree_bytes(tree),
                                  time.perf_counter() - t0)
     return out
@@ -89,7 +92,9 @@ def all_gather(x, mesh, axis="dp", tiled=True):
         return jax.lax.all_gather(v, axis, tiled=tiled)
 
     t0 = time.perf_counter()
-    out = jax.jit(_ag)(x)
+    with _spans.span("all_gather", cat="collective"), \
+            _watchdog.guard("all_gather"):
+        out = jax.jit(_ag)(x)
     _telemetry.record_collective("all_gather", _tree_bytes(x),
                                  time.perf_counter() - t0)
     return out
@@ -108,7 +113,9 @@ def reduce_scatter(x, mesh, axis="dp"):
         return jax.lax.psum_scatter(v, axis, tiled=True)
 
     t0 = time.perf_counter()
-    out = jax.jit(_rs)(x)
+    with _spans.span("reduce_scatter", cat="collective"), \
+            _watchdog.guard("reduce_scatter"):
+        out = jax.jit(_rs)(x)
     _telemetry.record_collective("reduce_scatter", _tree_bytes(x),
                                  time.perf_counter() - t0)
     return out
@@ -125,7 +132,9 @@ def ring_permute(x, mesh, axis="sp", shift=1):
         return jax.lax.ppermute(v, axis, perm)
 
     t0 = time.perf_counter()
-    out = jax.jit(_pp)(x)
+    with _spans.span("ppermute", cat="collective"), \
+            _watchdog.guard("ppermute"):
+        out = jax.jit(_pp)(x)
     _telemetry.record_collective("ppermute", _tree_bytes(x),
                                  time.perf_counter() - t0)
     return out

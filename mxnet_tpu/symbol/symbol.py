@@ -213,19 +213,24 @@ class Symbol:
 
     def list_attr(self):
         """This node's annotation attrs (reference: Symbol.list_attr —
-        shallow, strings)."""
-        return dict(self._uattrs)
+        shallow, strings). Variable annotations living in the op-kwarg
+        store (`__shape__`/`__dtype__` from var(shape=..., dtype=...))
+        are visible here like the reference, stringified."""
+        out = {k: v if isinstance(v, str) else str(v)
+               for k, v in self._attrs.items() if k.startswith("__")}
+        out.update(self._uattrs)
+        return out
 
     def attr_dict(self):
         """node name -> merged {op kwargs (stringified) + annotation
         attrs} for every node in the graph (reference: Symbol.attr_dict;
         test_attr.py:72 expects conv params AND propagated __dunder__
-        attrs)."""
+        attrs, and var shape/dtype/init annotations stay visible as
+        `__shape__`/`__dtype__`/`__init__`)."""
         out = {}
         for s in self._topo():
             entry = {k: v if isinstance(v, str) else str(v)
-                     for k, v in s._attrs.items()
-                     if not k.startswith("__")}
+                     for k, v in s._attrs.items()}
             entry.update(s._uattrs)
             if entry:
                 merged = out.setdefault(s._name, {})
@@ -368,11 +373,22 @@ class Symbol:
         instead of a propagated guess (reference: infer_type_partial)."""
         return self.infer_type(*args, partial=True, **kwargs)
 
+    def list_auxiliary_states(self):
+        """Aux-state names (reference: list_auxiliary_states — BN running
+        stats). This port keeps aux states as ordinary leaf arguments
+        (they appear in list_arguments too, unlike the reference); this
+        lists the subset by the canonical reference suffixes."""
+        return [n for n in self.list_arguments()
+                if n.endswith("_moving_mean") or n.endswith("_moving_var")]
+
     def bind(self, ctx=None, args=None, args_grad=None, grad_req="write",
              aux_states=None, **kwargs):  # noqa: ARG002
         """Build an Executor (reference: Symbol.bind → GraphExecutor; here
-        the executor wraps a jitted function + jax.vjp)."""
-        return Executor(self, args or {}, args_grad, grad_req)
+        the executor wraps a jitted function + jax.vjp). `aux_states`
+        (list in list_auxiliary_states order, or dict) binds the BN
+        running-stat leaves and is exposed as Executor.aux_dict."""
+        return Executor(self, args or {}, args_grad, grad_req,
+                        aux_states=aux_states)
 
     # reference 2.x renamed bind -> _bind (symbol.py _bind); tests and
     # migration guides use the underscore spelling
@@ -540,11 +556,12 @@ class Executor:
     """Bound graph (reference: executor.py over CachedOp). forward is the
     jitted lowered function; backward is jax.vjp at the same boundary."""
 
-    def __init__(self, symbol, args, args_grad, grad_req):
+    def __init__(self, symbol, args, args_grad, grad_req, aux_states=None):
         from ..ndarray.ndarray import NDArray
 
         self._symbol = symbol
         self._names = symbol.list_arguments()
+        self._aux_names = symbol.list_auxiliary_states()
         # reference bind accepts args/args_grad as a list (positional in
         # list_arguments order) or a dict (executor.py Bind)
         if isinstance(args, (list, tuple)):
@@ -560,6 +577,24 @@ class Executor:
                     f"{len(self._names)} arguments (got "
                     f"{len(args_grad)}); use a dict for a subset")
             args_grad = dict(zip(self._names, args_grad))
+        # aux_states (reference: bind's fourth array set) bind the BN
+        # running-stat leaves; since this port keeps aux states in the
+        # argument list, they merge into args (aux wins on conflict,
+        # matching the reference where aux arrays are a separate store)
+        if aux_states is not None:
+            if isinstance(aux_states, (list, tuple)):
+                if len(aux_states) != len(self._aux_names):
+                    raise ValueError(
+                        f"bind: {len(self._aux_names)} auxiliary states "
+                        f"({self._aux_names}) but {len(aux_states)} "
+                        "arrays given")
+                aux_states = dict(zip(self._aux_names, aux_states))
+            unknown = set(aux_states) - set(self._aux_names)
+            if unknown:
+                raise ValueError(
+                    f"bind: unknown auxiliary states {sorted(unknown)}")
+            args = dict(args)
+            args.update(aux_states)
         self.arg_dict = {}
         for n in self._names:
             if n not in args:
@@ -567,6 +602,9 @@ class Executor:
             v = args[n]
             self.arg_dict[n] = v if isinstance(v, NDArray) else \
                 NDArray(jnp.asarray(v))
+        # aliases the same NDArrays as arg_dict: updates through either
+        # view hit the same buffers
+        self.aux_dict = {n: self.arg_dict[n] for n in self._aux_names}
         self._grad_req = grad_req
         self.grad_dict = {n: None for n in self._names}
         if args_grad:
@@ -587,12 +625,20 @@ class Executor:
     def grad_arrays(self):
         return [self.grad_dict[n] for n in self._names]
 
+    @property
+    def aux_arrays(self):
+        """Bound auxiliary-state arrays in list_auxiliary_states order
+        (reference: executor.py aux_arrays)."""
+        return [self.aux_dict[n] for n in self._aux_names]
+
     def forward(self, is_train=False, **kwargs):
         from ..ndarray.ndarray import NDArray
 
         for n, v in kwargs.items():
             self.arg_dict[n] = v if isinstance(v, NDArray) else \
                 NDArray(jnp.asarray(v))
+            if n in self._aux_names:
+                self.aux_dict[n] = self.arg_dict[n]
         data = {n: a._data for n, a in self.arg_dict.items()}
         if is_train:
             outs, self._vjp = jax.vjp(self._fn, data)

@@ -25,6 +25,7 @@ __all__ = [
     "collective_seconds_total",
     "step_total", "step_time_seconds", "examples_per_second",
     "mfu_ratio", "flops_per_step", "peak_flops",
+    "compile_flops", "compile_peak_hbm_bytes", "device_memory_bytes",
     "record_compile", "record_fallback", "record_transfer", "record_sync",
     "record_collective", "observe_step", "set_flop_budget", "nbytes_of",
 ]
@@ -52,6 +53,15 @@ hybridize_fallback_total = counter(
     "hybridize_fallback_total",
     "Hybridized blocks that fell back to imperative execution on a "
     "dynamic-output op (gluon/block.py)", ["block"])
+compile_flops = gauge(
+    "compile_flops",
+    "XLA cost_analysis flops of the latest executable per block variant "
+    "(diagnostics.introspect)", ["block", "variant"])
+compile_peak_hbm_bytes = gauge(
+    "compile_peak_hbm_bytes",
+    "Approx peak HBM of the latest executable per block variant: "
+    "arg+output+temp+code bytes from memory_analysis "
+    "(diagnostics.introspect)", ["block", "variant"])
 
 # -- host<->device transfers ------------------------------------------------
 transfer_total = counter(
@@ -61,6 +71,10 @@ transfer_total = counter(
 transfer_bytes_total = counter(
     "transfer_bytes_total", "Bytes moved by explicit array transfers",
     ["direction"])
+device_memory_bytes = gauge(
+    "device_memory_bytes",
+    "Live bytes_in_use per device from memory_stats() — None-reporting "
+    "backends (CPU) never set this (diagnostics.introspect)", ["device"])
 
 # -- sync points ------------------------------------------------------------
 sync_total = counter(

@@ -15,9 +15,25 @@ def print_summary(symbol, shape=None, line_length=120,
     out_shapes = {}
     if shape is not None:
         order = [s for s in symbol._topo() if s._op != "_group"]
-        from .symbol.symbol import _OP_TABLE
+        from .symbol.symbol import _OP_TABLE, _infer_shapes
 
         import jax
+
+        # deduce shapes of leaves the caller didn't specify (conv/fc
+        # params, BN stats...) the same way simple_bind does — the
+        # reference runs full InferShape here, so only genuinely
+        # undeducible inputs should error
+        arg_names = symbol.list_arguments()
+        missing = [n for n in arg_names if n not in shape]
+        if missing:
+            shape = dict(shape)
+            arg_shapes, _ = _infer_shapes(
+                symbol, {n: shape[n] for n in arg_names if n in shape},
+                partial=True)
+            deduced = dict(zip(arg_names, arg_shapes))
+            for n in missing:
+                if deduced.get(n) is not None:
+                    shape[n] = deduced[n]
 
         structs = {}
         for s in order:

@@ -20,8 +20,28 @@ import jax.numpy as jnp
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 import bench  # noqa: E402
 from mxnet_tpu import telemetry  # noqa: E402
+from mxnet_tpu.diagnostics import introspect  # noqa: E402
 
 PEAK_BF16 = 197e12  # v5e-class peak
+
+
+def _analyze(compiled):
+    """(flops, peak_hbm_bytes) of an AOT-compiled executable; version-safe
+    (cost_analysis is a dict or a 1-list of dicts depending on jax)."""
+    cost = introspect._first_dict(compiled.cost_analysis())
+    fl = float(cost.get("flops", 0.0) or 0.0)
+    peak = 0
+    try:
+        mem = compiled.memory_analysis()
+    except Exception:
+        mem = None
+    if mem is not None:
+        peak = (int(getattr(mem, "argument_size_in_bytes", 0) or 0)
+                + int(getattr(mem, "output_size_in_bytes", 0) or 0)
+                + int(getattr(mem, "temp_size_in_bytes", 0) or 0)
+                + int(getattr(mem, "generated_code_size_in_bytes", 0) or 0)
+                - int(getattr(mem, "alias_size_in_bytes", 0) or 0))
+    return fl, max(0, peak)
 
 
 def main():
@@ -58,8 +78,7 @@ def main():
                                for v in g.values())
 
         compiled = run.lower(params, key, x).compile()
-        cost = compiled.cost_analysis()
-        fl = cost.get("flops", 0.0) if cost else 0.0
+        fl, peak_hbm = _analyze(compiled)
 
         def one():
             return compiled(params, key, x)
@@ -86,8 +105,7 @@ def main():
         return
     else:
         compiled = step.lower(params, momenta, x, y, key).compile()
-        cost = compiled.cost_analysis()
-        fl = cost.get("flops", 0.0) if cost else 0.0
+        fl, peak_hbm = _analyze(compiled)
         state = {"p": params, "m": momenta}
 
         def one():
@@ -113,6 +131,7 @@ def main():
         "step_ms": round(step_ms, 2),
         "img_s": round(batch * iters / dt, 1),
         "xla_gflops_per_step": round(fl / 1e9, 2),
+        "peak_hbm_mb": round(peak_hbm / 1e6, 2),
         "mfu_vs_197T": round(mfu, 4),
     }))
 
