@@ -369,6 +369,61 @@ def bench_int8_agreement(platform):
     return agree / total
 
 
+def bench_serving_qps(platform, clients=8, requests=40):
+    """Serving-engine round-trip QPS: `clients` threads hammering one
+    dynamically-batching InferenceEngine through warmup()ed buckets
+    (docs/serving.md). A small MLP keeps the row cheap enough to measure
+    on the CPU fallback too — the number tracks the engine's
+    queue/batch/dispatch overhead and cache-hit dispatch, not model
+    FLOPs. Raises if any served shape recompiled after warmup."""
+    import threading
+
+    import numpy as onp
+
+    import mxnet_tpu as mx
+    from mxnet_tpu import serving
+    from mxnet_tpu.gluon import nn
+
+    mx.seed(0)
+    net = nn.HybridSequential()
+    net.add(nn.Dense(256, activation="relu"), nn.Dense(64))
+    net.initialize()
+    net.hybridize()
+    eng = serving.InferenceEngine(
+        net, name="bench_mlp", max_batch_size=16, max_wait_ms=1.0,
+        timeout_ms=30_000.0)
+    eng.warmup(mx.np.zeros((1, 128)))
+    rs = onp.random.RandomState(0)
+    xs = [onp.asarray(rs.rand(1, 128), onp.float32) for _ in range(8)]
+    errs = []
+
+    def client(i):
+        try:
+            for k in range(requests):
+                eng.predict(xs[(i + k) % len(xs)])
+        except Exception as e:  # noqa: BLE001 — surfaced via errs below
+            errs.append(e)
+
+    with eng:
+        eng.predict(xs[0])  # absorb first-dispatch overheads
+        t0 = time.perf_counter()
+        threads = [threading.Thread(target=client, args=(i,))
+                   for i in range(clients)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        dt = time.perf_counter() - t0
+    if errs:
+        raise errs[0]
+    recompiles = eng.recompiles_since_warmup()
+    if recompiles:
+        raise RuntimeError(
+            f"{recompiles} recompile(s) after warmup — serving bench "
+            "measured compile time, not serving throughput")
+    return clients * requests / dt
+
+
 def main():
     import jax
 
@@ -402,6 +457,14 @@ def main():
     rows = [{
         "metric": f"resnet50_infer_bf16_b{batch}_imgs_per_sec_per_chip"
                   + suffix,
+        "value": round(infer_img_s, 2),
+        "unit": "img/s",
+        "vs_baseline": round(infer_img_s / BASELINE_INFER_IMG_S, 4),
+    }, {
+        # stable alias of the row above: the name doesn't embed batch or
+        # layout, so _check_regressions compares it across runs even when
+        # those knobs change
+        "metric": "inference_img_s" + suffix,
         "value": round(infer_img_s, 2),
         "unit": "img/s",
         "vs_baseline": round(infer_img_s / BASELINE_INFER_IMG_S, 4),
@@ -453,6 +516,21 @@ def main():
                         "(example/quantization/README.md:113-121)"})
         except Exception as e:
             rows.append({"metric": "int8_agreement", "error": str(e)})
+
+    # serving-engine QPS runs on every platform (cheap MLP — the row
+    # measures the batching/dispatch path, which exists on CPU too)
+    try:
+        if over_budget():
+            raise TimeoutError("bench budget exhausted")
+        qps = bench_serving_qps(platform)
+        rows.append({
+            "metric": "inference_qps" + suffix,
+            "value": round(qps, 2), "unit": "req/s",
+            "note": "serving.InferenceEngine round-trip: 8 client "
+                    "threads, dynamic batching through warmed buckets "
+                    "(docs/serving.md)"})
+    except Exception as e:
+        rows.append({"metric": "inference_qps", "error": str(e)})
 
     result_extra = {}
     try:

@@ -41,9 +41,10 @@ __all__ = [
 ]
 
 # the phase vocabulary step_table pivots on (free-form cats still record;
-# they land in the 'other' column)
+# they land in the 'other' column). "serve" is the serving engine's
+# batch-execution phase (serving/engine.py; docs/serving.md).
 PHASES = ("data", "fwd", "bwd", "collective", "optimizer", "sync",
-          "compile")
+          "compile", "serve")
 
 _enabled = os.environ.get("MXTPU_DIAGNOSTICS", "1") != "0"
 

@@ -127,6 +127,22 @@ register(
     "prefetch; reference: the IO-priority pool of "
     "threaded_engine_perdevice.cc).")
 register(
+    "MXTPU_SERVE_MAX_BATCH", int, 32,
+    "serving.InferenceEngine default max micro-batch size (top of the "
+    "bucket ladder; docs/serving.md).")
+register(
+    "MXTPU_SERVE_QUEUE", int, 256,
+    "serving.InferenceEngine default admission-queue bound; submits "
+    "beyond it shed deterministically with serving.Overloaded.")
+register(
+    "MXTPU_SERVE_MAX_WAIT_MS", float, 2.0,
+    "serving.InferenceEngine default batching deadline: a partial batch "
+    "launches once its oldest request has waited this long.")
+register(
+    "MXTPU_SERVE_TIMEOUT_MS", float, 1000.0,
+    "serving.InferenceEngine default per-request deadline; requests "
+    "not completed in time fail with serving.RequestTimeout.")
+register(
     "MXTPU_BENCH_BUDGET_S", int, 1200,
     "bench.py wall-clock budget (seconds); secondary rows are skipped "
     "with an error row once exceeded so the driver always gets the "

@@ -506,6 +506,33 @@ def _traced_forward(block, params, training, param_data, key, input_datas):
 # HybridBlock
 # ---------------------------------------------------------------------------
 
+# The ops allowed to trip the dynamic-graph fallback: every op whose
+# OUTPUT shape is value-dependent snapshots its inputs eagerly on the
+# host (contrib/ops.py), which raises a concretization error under jit
+# tracing by design. A concretization error from anywhere else is a user
+# tracing bug and must propagate (ADVICE.md block.py:581).
+_DYNAMIC_OUTPUT_OPS = frozenset({
+    "boolean_mask", "box_nms", "bipartite_matching", "multibox_target",
+    "multibox_detection", "dynamic_reshape", "getnnz", "proposal",
+})
+
+
+def _dynamic_output_origin(exc):
+    """Name of the known dynamic-output op the concretization error was
+    raised under, walking its traceback; None when the error came from
+    user control flow (or any frame outside the framework's op table)."""
+    import os
+
+    tb = exc.__traceback__
+    while tb is not None:
+        code = tb.tb_frame.f_code
+        if code.co_name in _DYNAMIC_OUTPUT_OPS and \
+                os.sep + "mxnet_tpu" + os.sep in code.co_filename:
+            return code.co_name
+        tb = tb.tb_next
+    return None
+
+
 class HybridBlock(Block):
     """Block that can compile its forward as one XLA program."""
 
@@ -516,6 +543,12 @@ class HybridBlock(Block):
         object.__setattr__(self, "_cached_param_list", None)
         object.__setattr__(self, "_state_params", {})
         object.__setattr__(self, "_flags", {})
+        # per-variant retrace counter: cached_fn bumps it once per jit
+        # trace (= one XLA compile, including shape-signature misses
+        # AFTER the variant was first built — which _jit_variants alone
+        # can't see). serving.InferenceEngine.warmup() reads it to prove
+        # every bucket is pre-compiled.
+        object.__setattr__(self, "_trace_counts", {})
         # thread-safe CachedOp analog (reference:
         # src/imperative/cached_op_threadsafe.cc): one lock guards variant
         # build + aux-state swap so concurrent inference threads share the
@@ -583,21 +616,28 @@ class HybridBlock(Block):
                     return self._call_cached(*args)
                 except (jax.errors.TracerArrayConversionError,
                         jax.errors.ConcretizationTypeError) as e:
-                    # the forward contains a dynamic-OUTPUT op
+                    # Concretization during trace has two causes with
+                    # opposite remedies. (1) A known dynamic-OUTPUT op
                     # (boolean_mask, box_nms selection — value-dependent
-                    # shapes XLA cannot trace). Reference CachedOp flips
-                    # to dynamic-shape execution (imperative per-op) for
-                    # such graphs; we do the same: run this block eagerly
-                    # from now on, keeping hybridize() a no-op for it.
-                    # The original exception text rides along so a genuine
-                    # tracing bug in user control flow is distinguishable
-                    # from expected dynamic-shape fallback (ADVICE.md).
+                    # shapes XLA cannot trace): the reference CachedOp
+                    # flips to dynamic-shape execution (imperative
+                    # per-op) for such graphs, and we do the same — run
+                    # this block eagerly from now on, hybridize() a
+                    # no-op for it. (2) A genuine tracing bug in user
+                    # control flow (`if x > 0:` on a traced value):
+                    # falling back would permanently mask the bug AND
+                    # silently lose compiled performance (ADVICE.md
+                    # block.py:581), so anything NOT raised from inside
+                    # a known dynamic-output op re-raises.
+                    op = _dynamic_output_origin(e)
+                    if op is None:
+                        raise
                     import warnings
 
                     _telemetry.record_fallback(type(self).__name__)
                     warnings.warn(
-                        f"{type(self).__name__}.forward contains a "
-                        "dynamic-output op; running imperatively "
+                        f"{type(self).__name__}.forward contains the "
+                        f"dynamic-output op '{op}'; running imperatively "
                         "(reference CachedOp dynamic-shape mode). "
                         f"Original error: {type(e).__name__}: {e}",
                         stacklevel=2)
@@ -634,6 +674,10 @@ class HybridBlock(Block):
         block = self
 
         def cached_fn(param_data, key, *input_datas):
+            # host side effect: this body runs once per jit trace (new
+            # shape/dtype signature -> one XLA compile), never on cache
+            # hits — the retrace signal jit_trace_count() exposes
+            block._bump_trace(training)
             out_datas, sink = _traced_forward(
                 block, params, training, param_data, key, input_datas)
             # trace-time side effect: remember which params get aux updates
@@ -642,6 +686,70 @@ class HybridBlock(Block):
             return out_datas, tuple(sink.values)
 
         return cached_fn
+
+    def _bump_trace(self, training):
+        with self._cache_lock:
+            self._trace_counts[training] = \
+                self._trace_counts.get(training, 0) + 1
+        _telemetry.record_trace(
+            type(self).__name__, "train" if training else "predict")
+
+    def jit_trace_count(self, training=False):
+        """How many times the train/predict variant has been traced —
+        each trace is one XLA compile (first build plus every
+        shape/dtype-signature cache miss since). Monotonic across
+        hybridize()/_clear_cached(); the serving warmup's zero-miss
+        proof snapshots it before and after driving every bucket."""
+        return self._trace_counts.get(bool(training), 0)
+
+    def call_cached_graph(self, *args):
+        """Thread-safe entry into the compiled predict-mode graph — the
+        serving hot path (serving/engine.py, docs/serving.md).
+
+        Forces predict mode and no taping regardless of the calling
+        thread's autograd state, and never falls back to eager: a block
+        that already dropped to dynamic-graph execution (or was never
+        hybridized) cannot honor the bucketed-compile-cache contract, so
+        this raises instead of silently serving uncompiled. Safe to call
+        from many threads at once — variant build is serialized by the
+        cache lock, and executing the jitted function is reentrant (XLA
+        executables are immutable)."""
+        if not self._active:
+            raise RuntimeError(
+                f"{type(self).__name__}.call_cached_graph requires "
+                "hybridize() — the serving engine only runs compiled "
+                "graphs")
+        if getattr(self, "_dynamic_graph", False):
+            raise RuntimeError(
+                f"{type(self).__name__} fell back to dynamic-graph "
+                "(imperative) execution; it cannot be served through "
+                "the bucketed jit cache")
+        with ag.pause():
+            return self._call_cached(*args)
+
+    def aot_introspect(self, variant, *args, label=None):
+        """AOT-lower the predict-mode graph at ``args``' exact signature
+        and record XLA's cost/memory analysis in the diagnostics compile
+        registry under ``(label or class name, variant)``.
+
+        serving.InferenceEngine.warmup() calls this once per batch
+        bucket, so the registry proves which shapes are pre-compiled
+        (and what each costs) — the per-bucket analog of the cache-miss
+        capture in _call_cached. Costs one extra XLA compile per call;
+        gated by MXTPU_DIAG_COMPILE like every introspection. Returns
+        the registry entry dict or None."""
+        with ag.pause():
+            if self._jit_variants.get(False) is None:
+                self._call_cached(*args)  # builds the predict variant
+            jitted = self._jit_variants.get(False)
+            if jitted is None:
+                return None
+            pd = {n: p.data()._data for n, p in self._cached_param_list}
+            key = _random.next_key()
+            datas = [a._data for a in args]
+            return _introspect.capture_compile(
+                label or type(self).__name__, variant, jitted,
+                (pd, key, *datas))
 
     def _build_jit(self, training):
         return jax.jit(self._make_cached_fn(training))

@@ -163,24 +163,24 @@ def set_np(shape=True, array=True, dtype=False):
 
 
 def reset_np():
-    """Restore this framework's resting np-semantics: ALL-ON.
+    """``set_np(shape=False, array=False, dtype=False)`` — turn every
+    np-semantics flag OFF, exactly like the reference's ``reset_np()``
+    (util.py).
 
-    Deliberate divergence from the reference, whose ``reset_np()`` is
-    ``set_np(shape=False, array=False, dtype=False)`` (np semantics OFF):
-    this framework is np-native — every frontend array IS an mx.np array
-    and zero-dim/zero-size shapes are always representable — so the
-    resting state keeps ``array``/``shape`` semantics on and only the
-    dtype default reverts (float32/int32 creation defaults, reference
-    behavior). Porting guidance: code that called reference
-    ``reset_np()`` to get legacy-1.x semantics back should not expect
-    legacy behavior here; see docs/migration.md.
-
-    Consequently :func:`is_np_array` / :func:`is_np_shape` are ADVISORY
-    flags for ported code paths (scope managers util.np_shape/np_array
-    flip them thread-locally) — they do not switch the underlying array
-    implementation, which is always np-native.
+    On this framework the ``array``/``shape`` flags are ADVISORY: every
+    frontend array IS an mx.np array and zero-dim/zero-size shapes are
+    always representable, so flipping them does not switch the
+    underlying array implementation — it only changes what
+    :func:`is_np_array` / :func:`is_np_shape` report to ported code
+    paths (and the scope managers util.np_shape/np_array still override
+    them thread-locally). The ``dtype`` flag is real either way: after
+    ``reset_np()`` creation defaults are float32/int32 again. Code that
+    wants the flags back on calls ``set_np()``; see docs/migration.md.
     """
-    set_np()
+    global _np_default_dtype
+    _np_defaults["array"] = False
+    _np_defaults["shape"] = False
+    _np_default_dtype = False
 
 
 def is_np_array():

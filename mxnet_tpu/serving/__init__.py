@@ -1,0 +1,31 @@
+"""In-process inference serving: dynamic micro-batching over the jit
+cache (ISSUE 3 tentpole; docs/serving.md).
+
+    engine.py    InferenceEngine — bounded queue, batcher thread,
+                 bucket padding, warmup() zero-recompile proof,
+                 admission control, per-request deadlines
+    buckets.py   the batch-bucket ladder (compile-shape vocabulary)
+    registry.py  ModelRegistry — multi-model process, REGISTRY default
+    errors.py    Overloaded / RequestTimeout / EngineStopped
+
+Quick start::
+
+    from mxnet_tpu import serving
+    eng = serving.InferenceEngine(net, name="resnet")
+    eng.warmup(example_batch)
+    with eng:                       # start()/stop()
+        y = eng.predict(x)
+"""
+from __future__ import annotations
+
+from .buckets import assemble_batch, bucket_ladder, pad_rows, pick_bucket
+from .engine import InferenceEngine, ServeRequest
+from .errors import EngineStopped, Overloaded, RequestTimeout, ServingError
+from .registry import REGISTRY, ModelRegistry
+
+__all__ = [
+    "InferenceEngine", "ServeRequest",
+    "ModelRegistry", "REGISTRY",
+    "ServingError", "Overloaded", "RequestTimeout", "EngineStopped",
+    "bucket_ladder", "pick_bucket", "pad_rows", "assemble_batch",
+]

@@ -1,0 +1,91 @@
+"""Batch-bucket ladder: the serving engine's compile-shape vocabulary.
+
+A TPU serves from a jit cache keyed by exact shapes — a stray batch size
+on the hot path means an online XLA compile (seconds) in front of a
+millisecond request. So the micro-batcher never launches a raw batch:
+every batch is padded UP to the nearest rung of a fixed ladder
+(1/2/4/.../max by default), all rungs are pre-compiled by
+``InferenceEngine.warmup()``, and steady state touches only cached
+executables. Doubling rungs bound the padding waste at <2x worst case
+while keeping the compile count at O(log max_batch) — the bucketing
+trade the TPU cost model motivates (PAPERS.md "A Learned Performance
+Model for Tensor Processing Units").
+
+Stdlib + numpy only: batch assembly is host-side; the single
+device transfer happens in engine.py after padding.
+"""
+from __future__ import annotations
+
+import numpy as _np
+
+__all__ = ["bucket_ladder", "pick_bucket", "pad_rows", "assemble_batch"]
+
+
+def bucket_ladder(max_batch, buckets=None):
+    """The sorted tuple of batch buckets to pre-compile.
+
+    Default: powers of two up to ``max_batch``, with ``max_batch`` itself
+    always the top rung (so a full batch never pads). An explicit
+    ``buckets`` iterable is validated, deduplicated, sorted, and capped
+    at ``max_batch``.
+    """
+    max_batch = int(max_batch)
+    if max_batch < 1:
+        raise ValueError(f"max_batch must be >= 1, got {max_batch}")
+    if buckets is None:
+        ladder, b = [], 1
+        while b < max_batch:
+            ladder.append(b)
+            b *= 2
+        ladder.append(max_batch)
+        return tuple(sorted(set(ladder)))
+    ladder = sorted({int(b) for b in buckets})
+    if not ladder or ladder[0] < 1:
+        raise ValueError(f"buckets must be positive ints, got {buckets}")
+    if ladder[-1] > max_batch:
+        raise ValueError(
+            f"bucket {ladder[-1]} exceeds max_batch {max_batch}")
+    if ladder[-1] != max_batch:
+        ladder.append(max_batch)
+    return tuple(ladder)
+
+
+def pick_bucket(ladder, rows):
+    """Smallest rung >= rows, or None when rows exceeds the top rung
+    (the batcher never assembles past the top; submit() rejects
+    single requests that big)."""
+    for b in ladder:
+        if rows <= b:
+            return b
+    return None
+
+
+def pad_rows(arr, bucket):
+    """Pad a host batch up to ``bucket`` rows by repeating the last row.
+
+    Repetition (not zeros) keeps padding inside the input distribution —
+    zeros can NaN through normalization layers — and the pad rows are
+    sliced off before any result leaves the engine, so their values are
+    unobservable.
+    """
+    pad = int(bucket) - arr.shape[0]
+    if pad < 0:
+        raise ValueError(
+            f"batch of {arr.shape[0]} rows does not fit bucket {bucket}")
+    if pad == 0:
+        return arr
+    return _np.concatenate([arr, _np.repeat(arr[-1:], pad, axis=0)])
+
+
+def assemble_batch(request_inputs, bucket):
+    """Concatenate per-request host inputs and pad to ``bucket``.
+
+    ``request_inputs`` is a list over requests, each a tuple of numpy
+    arrays (one per model input, sharing the request's row count).
+    Returns a list over model inputs of padded ``(bucket, ...)`` arrays.
+    """
+    n_inputs = len(request_inputs[0])
+    return [
+        pad_rows(_np.concatenate([r[j] for r in request_inputs]), bucket)
+        for j in range(n_inputs)
+    ]

@@ -1,0 +1,467 @@
+"""Dynamic micro-batching inference engine over a hybridized block.
+
+The in-process serving half of the stack (ISSUE 3 tentpole; design
+anchors: TensorFlow Serving's request batching — PAPERS.md "TensorFlow:
+A system for large-scale machine learning" §serving — and bucketed
+compile caching per the TPU cost model, "A Learned Performance Model for
+Tensor Processing Units"):
+
+  * client threads ``submit()`` single- or multi-row requests into ONE
+    bounded queue; a dedicated batcher thread coalesces them up to
+    ``max_batch_size`` rows or until the oldest request has waited
+    ``max_wait_ms`` (TF-Serving's batch deadline), whichever first;
+  * every batch is padded to a rung of the pre-compiled bucket ladder
+    (buckets.py), so steady state NEVER sees an online XLA compile —
+    ``warmup()`` compiles all rungs up front and proves it (zero
+    retraces re-driving every bucket, per-bucket entries in the
+    diagnostics compile registry);
+  * admission control is a hard queue bound: submits beyond it fail
+    FAST with :class:`~mxnet_tpu.serving.errors.Overloaded` (typed,
+    deterministic — never a blocked client, never a deadlock), and each
+    request carries a deadline enforced on both sides of the queue
+    (:class:`~mxnet_tpu.serving.errors.RequestTimeout`);
+  * everything is observable: request-latency histogram (p50/p99),
+    queue-depth and in-flight gauges, shed/timeout/batch-size counters
+    (telemetry/instruments.py ``serve_*``), and a ``serve`` span per
+    executed batch (diagnostics/spans.py).
+
+The compiled hot path is ``HybridBlock.call_cached_graph`` — predict
+mode, no taping, thread-safe, and never an eager fallback.
+
+Defaults come from the typed env registry: MXTPU_SERVE_MAX_BATCH,
+MXTPU_SERVE_QUEUE, MXTPU_SERVE_MAX_WAIT_MS, MXTPU_SERVE_TIMEOUT_MS.
+See docs/serving.md.
+"""
+from __future__ import annotations
+
+import collections
+import threading
+import time
+
+import jax.numpy as jnp
+import numpy as _np
+
+from .. import env as _env
+from ..diagnostics import spans as _spans
+from ..ndarray.ndarray import NDArray
+from ..telemetry import instruments as _instr
+from .buckets import assemble_batch, bucket_ladder, pad_rows, pick_bucket
+from .errors import EngineStopped, Overloaded, RequestTimeout
+
+__all__ = ["InferenceEngine", "ServeRequest"]
+
+
+def _to_host(a):
+    """Request input -> host numpy (one device transfer per BATCH, not
+    per request, so assembly stays on the host)."""
+    if isinstance(a, NDArray):
+        return a.asnumpy()
+    return _np.asarray(a)
+
+
+class ServeRequest:
+    """One in-flight request: inputs, deadline, and a settable outcome.
+
+    The outcome transition is atomic (first of {batcher result, batcher
+    error, timeout, shed} wins), so the client and the batcher can race
+    on a deadline without double-counting or half-set results.
+    """
+
+    __slots__ = ("inputs", "rows", "signature", "t_submit", "deadline",
+                 "_event", "_lock", "outcome", "_result", "_error")
+
+    def __init__(self, inputs, rows, signature, deadline):
+        self.inputs = inputs
+        self.rows = rows
+        self.signature = signature
+        self.t_submit = time.monotonic()
+        self.deadline = deadline  # absolute monotonic seconds, or None
+        self._event = threading.Event()
+        self._lock = threading.Lock()
+        self.outcome = None  # ok | timeout | error (claimed once)
+        self._result = None
+        self._error = None
+
+    def _finish(self, outcome, result=None, error=None):
+        """Claim the outcome; True iff this call won the claim."""
+        with self._lock:
+            if self.outcome is not None:
+                return False
+            self.outcome = outcome
+            self._result = result
+            self._error = error
+        self._event.set()
+        return True
+
+    @property
+    def done(self):
+        return self.outcome is not None
+
+    def result(self, timeout=None):
+        """Block until the outcome; return the model output (NDArray, or
+        a tuple for multi-output models) or raise the typed failure.
+
+        ``timeout`` (seconds) overrides the request deadline for this
+        wait; by default the wait extends to the deadline (forever when
+        the request has none).
+        """
+        if timeout is None and self.deadline is not None:
+            timeout = max(0.0, self.deadline - time.monotonic())
+        self._event.wait(timeout)
+        if not self.done:
+            # nothing finished us in time — claim the timeout ourselves
+            # (the batcher skips claimed requests when it reaches them)
+            self._finish("timeout",
+                         error=RequestTimeout(
+                             f"request not served within "
+                             f"{timeout if timeout is not None else 0:.3f}s"))
+        if self.outcome == "ok":
+            return self._result
+        raise self._error
+
+
+class InferenceEngine:
+    """Thread-safe dynamic-batching server around one hybridized block.
+
+    ::
+
+        net = ...HybridBlock...; net.initialize(); net.hybridize()
+        eng = serving.InferenceEngine(net, name="resnet", max_batch_size=16)
+        eng.warmup(mx.np.zeros((1, 224, 224, 3)))   # compile every bucket
+        eng.start()
+        out = eng.predict(x)                        # from any thread
+        eng.stop()
+
+    Lifecycle: construct -> (optional) warmup -> start -> serve -> stop.
+    ``submit()`` works before ``start()`` (requests queue; admission
+    control still applies) — convenient for tests and staged bring-up.
+    """
+
+    def __init__(self, block, name="model", max_batch_size=None,
+                 max_queue=None, max_wait_ms=None, timeout_ms=None,
+                 buckets=None):
+        if not hasattr(block, "call_cached_graph"):
+            raise TypeError(
+                f"InferenceEngine needs a HybridBlock, got {type(block)}")
+        self._block = block
+        self.name = str(name)
+        self.max_batch_size = int(
+            max_batch_size if max_batch_size is not None
+            else _env.get("MXTPU_SERVE_MAX_BATCH"))
+        self.max_queue = int(
+            max_queue if max_queue is not None
+            else _env.get("MXTPU_SERVE_QUEUE"))
+        self.max_wait_s = float(
+            max_wait_ms if max_wait_ms is not None
+            else _env.get("MXTPU_SERVE_MAX_WAIT_MS")) / 1e3
+        self.timeout_s = float(
+            timeout_ms if timeout_ms is not None
+            else _env.get("MXTPU_SERVE_TIMEOUT_MS")) / 1e3
+        self.buckets = bucket_ladder(self.max_batch_size, buckets)
+        self._cond = threading.Condition()
+        self._queue = collections.deque()
+        self._stopping = False
+        self._thread = None
+        self._warm_traces = None
+        # cached label children: the hot path mutates gauges without
+        # re-resolving labels (each child still honors enable/disable)
+        self._g_queue = _instr.serve_queue_depth.labels(self.name)
+        self._g_inflight = _instr.serve_in_flight.labels(self.name)
+
+    # -- lifecycle ---------------------------------------------------------
+    @property
+    def started(self):
+        return self._thread is not None and self._thread.is_alive()
+
+    def start(self):
+        """Start the batcher thread (idempotent)."""
+        with self._cond:
+            if self._stopping:
+                raise EngineStopped(f"engine {self.name!r} was stopped")
+            if self._thread is None or not self._thread.is_alive():
+                self._thread = threading.Thread(
+                    target=self._loop, name=f"mxtpu-serve-{self.name}",
+                    daemon=True)
+                self._thread.start()
+        return self
+
+    def stop(self, drain=True):
+        """Stop accepting work; by default drain queued requests first.
+        With ``drain=False`` pending requests fail with EngineStopped."""
+        with self._cond:
+            self._stopping = True
+            if not drain:
+                dropped, self._queue = list(self._queue), \
+                    collections.deque()
+                self._g_queue.set(0)
+            else:
+                dropped = []
+            self._cond.notify_all()
+        for r in dropped:
+            if r._finish("error",
+                         error=EngineStopped(
+                             f"engine {self.name!r} stopped")):
+                _instr.record_serve_request(self.name, "error")
+        if self._thread is not None:
+            self._thread.join(timeout=30)
+        return self
+
+    def __enter__(self):
+        return self.start()
+
+    def __exit__(self, *exc):
+        self.stop()
+        return False
+
+    # -- warmup ------------------------------------------------------------
+    def warmup(self, *example_inputs, introspect=True):
+        """Pre-compile EVERY bucket, then prove the cache is sealed.
+
+        ``example_inputs`` is one example request (each array with a
+        leading row dim; trailing dims and dtypes fix the served
+        signature). For each ladder rung the example is tiled/padded to
+        the rung's row count and pushed through the compiled graph; with
+        ``introspect=True`` each rung also lands in the diagnostics
+        compile registry under ``(name, "b<rows>")`` with XLA's
+        cost/memory analysis (HybridBlock.aot_introspect).
+
+        The proof: after compiling, every rung is driven AGAIN and the
+        predict-variant retrace counter must not move — a moving counter
+        means some served shape misses the jit cache, and warmup raises
+        rather than let an online compile hide on the hot path. Returns
+        a summary dict.
+        """
+        ex = [_to_host(a) for a in example_inputs]
+        if not ex or any(a.ndim < 1 for a in ex):
+            raise ValueError(
+                "warmup needs one example request: arrays with a "
+                "leading row dimension")
+        rows = ex[0].shape[0]
+        if any(a.shape[0] != rows for a in ex):
+            raise ValueError("example inputs disagree on row count")
+        t0 = time.perf_counter()
+
+        def rung_inputs(b):
+            return [NDArray(jnp.asarray(pad_rows(a[:min(rows, b)], b)))
+                    for a in ex]
+
+        for b in self.buckets:
+            nds = rung_inputs(b)
+            self._block.call_cached_graph(*nds)
+            if introspect:
+                self._block.aot_introspect(f"b{b}", *nds, label=self.name)
+        traces = self._block.jit_trace_count(False)
+        for b in self.buckets:  # re-drive: everything must cache-hit now
+            self._block.call_cached_graph(*rung_inputs(b))
+        added = self._block.jit_trace_count(False) - traces
+        if added:
+            raise RuntimeError(
+                f"warmup failed to seal the jit cache: {added} "
+                f"recompile(s) re-driving buckets {self.buckets} — "
+                "served shapes would compile online")
+        self._warm_traces = self._block.jit_trace_count(False)
+        self._example_trailing = [
+            (tuple(a.shape[1:]), _np.dtype(a.dtype)) for a in ex]
+        return {
+            "model": self.name,
+            "buckets": list(self.buckets),
+            "compile_traces": self._warm_traces,
+            "seconds": round(time.perf_counter() - t0, 4),
+        }
+
+    def recompiles_since_warmup(self):
+        """Predict-variant retraces since warmup() sealed the cache —
+        0 is the steady-state invariant; None before warmup."""
+        if self._warm_traces is None:
+            return None
+        return self._block.jit_trace_count(False) - self._warm_traces
+
+    # -- client side -------------------------------------------------------
+    def submit(self, *inputs, timeout_ms=None):
+        """Enqueue one request; returns a :class:`ServeRequest` handle.
+
+        Each input must carry a leading row dimension (1 <= rows <=
+        ``max_batch_size``). Never blocks: a full queue sheds with
+        :class:`Overloaded`, a stopped engine raises
+        :class:`EngineStopped`. ``timeout_ms`` overrides the engine's
+        per-request deadline (0 disables it).
+        """
+        arrays = [_to_host(a) for a in inputs]
+        if not arrays or any(a.ndim < 1 for a in arrays):
+            raise ValueError(
+                "submit needs arrays with a leading row dimension")
+        rows = arrays[0].shape[0]
+        if any(a.shape[0] != rows for a in arrays):
+            raise ValueError("request inputs disagree on row count")
+        if rows < 1 or rows > self.max_batch_size:
+            raise ValueError(
+                f"request rows {rows} outside 1..{self.max_batch_size} "
+                "(split oversized requests client-side)")
+        signature = tuple(
+            (tuple(a.shape[1:]), str(a.dtype)) for a in arrays)
+        tmo = self.timeout_s if timeout_ms is None else float(
+            timeout_ms) / 1e3
+        deadline = (time.monotonic() + tmo) if tmo > 0 else None
+        req = ServeRequest(tuple(arrays), rows, signature, deadline)
+        with self._cond:
+            if self._stopping:
+                raise EngineStopped(f"engine {self.name!r} is stopped")
+            if len(self._queue) >= self.max_queue:
+                _instr.record_serve_request(self.name, "shed")
+                raise Overloaded(
+                    f"engine {self.name!r} queue at bound "
+                    f"{self.max_queue}; request shed")
+            self._queue.append(req)
+            self._g_queue.set(len(self._queue))
+            self._cond.notify()
+        return req
+
+    def predict(self, *inputs, timeout_ms=None):
+        """Synchronous round-trip: submit + wait. Raises Overloaded /
+        RequestTimeout / EngineStopped like submit()/result()."""
+        req = self.submit(*inputs, timeout_ms=timeout_ms)
+        try:
+            return req.result()
+        except RequestTimeout:
+            _instr.record_serve_request(self.name, "timeout")
+            raise
+
+    # -- batcher side ------------------------------------------------------
+    def _expire_locked(self):
+        """Drop finished (client-claimed) and past-deadline requests from
+        the queue; called with the condition held."""
+        now = time.monotonic()
+        keep = collections.deque()
+        for r in self._queue:
+            if r.done:
+                continue  # client already claimed (timeout) — drop
+            if r.deadline is not None and now >= r.deadline:
+                if r._finish("timeout", error=RequestTimeout(
+                        "deadline elapsed while queued")):
+                    _instr.record_serve_request(
+                        self.name, "timeout", now - r.t_submit)
+                continue
+            keep.append(r)
+        if len(keep) != len(self._queue):
+            self._queue = keep
+            self._g_queue.set(len(keep))
+
+    def _collect(self):
+        """Pop the next micro-batch: same-signature requests up to
+        ``max_batch_size`` rows, or whatever arrived by the time the
+        oldest one has waited ``max_wait_ms``. None = stopped + drained."""
+        with self._cond:
+            while True:
+                self._expire_locked()
+                if self._queue:
+                    break
+                if self._stopping:
+                    return None
+                self._cond.wait(0.05)
+            head = self._queue.popleft()
+            batch, rows = [head], head.rows
+            launch_at = head.t_submit + self.max_wait_s
+            while rows < self.max_batch_size:
+                if self._queue:
+                    nxt = self._queue[0]
+                    if nxt.done or (
+                            nxt.deadline is not None
+                            and time.monotonic() >= nxt.deadline):
+                        self._expire_locked()
+                        continue
+                    if nxt.signature != head.signature or \
+                            rows + nxt.rows > self.max_batch_size:
+                        break  # different shape family / no room: next batch
+                    self._queue.popleft()
+                    batch.append(nxt)
+                    rows += nxt.rows
+                    continue
+                remaining = launch_at - time.monotonic()
+                if remaining <= 0 or self._stopping:
+                    break
+                self._cond.wait(min(remaining, 0.05))
+            self._g_queue.set(len(self._queue))
+        return batch
+
+    def _run_batch(self, batch):
+        rows = sum(r.rows for r in batch)
+        bucket = pick_bucket(self.buckets, rows)
+        self._g_inflight.set(rows)
+        try:
+            padded = assemble_batch([r.inputs for r in batch], bucket)
+            nds = [NDArray(jnp.asarray(a)) for a in padded]
+            with _spans.span(self.name, cat="serve"):
+                out = self._block.call_cached_graph(*nds)
+            outs = out if isinstance(out, (list, tuple)) else (out,)
+            datas = [o._data for o in outs]
+            _instr.record_serve_batch(self.name, rows, bucket)
+            off, now = 0, time.monotonic()
+            for r in batch:
+                # slice off exactly this request's rows — bucket padding
+                # never reaches a client
+                sl = [NDArray(d[off:off + r.rows]) for d in datas]
+                res = sl[0] if len(sl) == 1 else tuple(sl)
+                if r._finish("ok", result=res):
+                    _instr.record_serve_request(
+                        self.name, "ok", now - r.t_submit)
+                off += r.rows
+        except Exception as e:  # noqa: BLE001 — batch failure -> per-request
+            now = time.monotonic()
+            for r in batch:
+                if r._finish("error", error=e):
+                    _instr.record_serve_request(
+                        self.name, "error", now - r.t_submit)
+        finally:
+            self._g_inflight.set(0)
+
+    def _loop(self):
+        while True:
+            batch = self._collect()
+            if batch is None:
+                return
+            self._run_batch(batch)
+
+    # -- observability -----------------------------------------------------
+    def _latency_quantile_ms(self, q):
+        """Approximate latency quantile (ms) from the telemetry histogram
+        (upper bound of the covering bucket); None when no samples or
+        telemetry is disabled."""
+        child = _instr.serve_request_latency_seconds.labels(self.name)
+        count = child.count
+        if not count:
+            return None
+        target = q * count
+        cum = child.cumulative()
+        for bound, acc in cum:
+            if acc >= target:
+                if bound == float("inf"):
+                    bound = cum[-2][0] if len(cum) > 1 else 0.0
+                return round(float(bound) * 1e3, 3)
+        return None
+
+    def stats(self):
+        """Live snapshot: queue/in-flight, outcome counters, batch shape,
+        latency p50/p99, and the zero-recompile invariant."""
+        outcomes = {
+            lv[1]: c.value
+            for lv, c in _instr.serve_request_total.series()
+            if lv[0] == self.name}
+        batches = _instr.serve_batch_total.labels(self.name).value
+        bs = _instr.serve_batch_size.labels(self.name)
+        return {
+            "model": self.name,
+            "started": self.started,
+            "buckets": list(self.buckets),
+            "queue_depth": len(self._queue),
+            "max_queue": self.max_queue,
+            "in_flight": _instr.serve_in_flight.labels(self.name).value,
+            "requests": outcomes,
+            "batches": batches,
+            "avg_batch_rows": round(bs.sum / bs.count, 3) if bs.count
+            else None,
+            "padded_rows":
+                _instr.serve_padded_rows_total.labels(self.name).value,
+            "p50_ms": self._latency_quantile_ms(0.50),
+            "p99_ms": self._latency_quantile_ms(0.99),
+            "recompiles_since_warmup": self.recompiles_since_warmup(),
+        }

@@ -1,0 +1,34 @@
+"""Typed serving rejections (serving/engine.py admission + deadlines).
+
+Deterministic failure is part of the serving contract: an overloaded
+engine REJECTS with :class:`Overloaded` at submit time (TensorFlow
+Serving's batch-queue bound — PAPERS.md "TensorFlow: A system for
+large-scale machine learning", §serving), it never blocks the client or
+deadlocks; a request that misses its deadline fails with
+:class:`RequestTimeout`. Both subclass :class:`~mxnet_tpu.base.MXNetError`
+so existing framework-error handling catches them.
+"""
+from __future__ import annotations
+
+from ..base import MXNetError
+
+__all__ = ["ServingError", "Overloaded", "RequestTimeout", "EngineStopped"]
+
+
+class ServingError(MXNetError):
+    """Base class of every serving-engine rejection."""
+
+
+class Overloaded(ServingError):
+    """Admission control rejected the request: the bounded queue is at
+    capacity. Clients should back off / retry against another replica —
+    the engine sheds load instead of queueing unboundedly."""
+
+
+class RequestTimeout(ServingError):
+    """The request's deadline elapsed before a result was ready (still
+    queued, or its batch had not finished)."""
+
+
+class EngineStopped(ServingError):
+    """The engine is stopped (or stopping) and accepts no new work."""

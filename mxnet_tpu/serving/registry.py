@@ -1,0 +1,95 @@
+"""Multi-model registry: named InferenceEngines under one roof.
+
+A process that serves several models (the TF-Serving "model server"
+shape) needs one place to register, look up, and tear down engines —
+and one call that snapshots every engine's stats for an ops endpoint.
+Engines stay fully independent (own queue, own batcher thread, own
+telemetry label series); the registry only owns the name -> engine map.
+"""
+from __future__ import annotations
+
+import threading
+
+from .engine import InferenceEngine
+
+__all__ = ["ModelRegistry", "REGISTRY"]
+
+
+class ModelRegistry:
+    """Thread-safe name -> :class:`InferenceEngine` map."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._engines = {}
+
+    def register(self, name, block_or_engine, start=True, **engine_kwargs):
+        """Register a model and return its engine.
+
+        ``block_or_engine`` is either a ready :class:`InferenceEngine`
+        (adopted as-is; ``engine_kwargs`` must be empty) or a hybridized
+        block wrapped in a new engine built with ``engine_kwargs``.
+        Duplicate names raise ValueError — replacing a live model is an
+        explicit unregister + register, never a silent swap.
+        """
+        name = str(name)
+        if isinstance(block_or_engine, InferenceEngine):
+            if engine_kwargs:
+                raise ValueError(
+                    "engine_kwargs only apply when registering a block, "
+                    f"got a ready engine plus {sorted(engine_kwargs)}")
+            engine = block_or_engine
+        else:
+            engine = InferenceEngine(block_or_engine, name=name,
+                                     **engine_kwargs)
+        with self._lock:
+            if name in self._engines:
+                raise ValueError(f"model {name!r} already registered")
+            self._engines[name] = engine
+        if start and not engine.started:
+            engine.start()
+        return engine
+
+    def get(self, name):
+        with self._lock:
+            try:
+                return self._engines[name]
+            except KeyError:
+                raise KeyError(
+                    f"no model {name!r}; registered: "
+                    f"{sorted(self._engines)}") from None
+
+    def __contains__(self, name):
+        with self._lock:
+            return name in self._engines
+
+    def names(self):
+        with self._lock:
+            return sorted(self._engines)
+
+    def unregister(self, name, stop=True):
+        """Remove a model; by default also stop (drain) its engine."""
+        with self._lock:
+            engine = self._engines.pop(name, None)
+        if engine is None:
+            raise KeyError(f"no model {name!r}")
+        if stop:
+            engine.stop()
+        return engine
+
+    def stats(self):
+        """{name: engine.stats()} for every registered model."""
+        with self._lock:
+            engines = dict(self._engines)
+        return {n: e.stats() for n, e in sorted(engines.items())}
+
+    def stop_all(self):
+        """Unregister and drain every engine (process shutdown hook)."""
+        with self._lock:
+            engines, self._engines = dict(self._engines), {}
+        for e in engines.values():
+            e.stop()
+
+
+# The process-wide default registry (mirrors telemetry.REGISTRY /
+# diagnostics' module-level registries).
+REGISTRY = ModelRegistry()

@@ -55,7 +55,10 @@ from .instruments import (  # noqa: F401
     record_collective,
     record_compile,
     record_fallback,
+    record_serve_batch,
+    record_serve_request,
     record_sync,
+    record_trace,
     record_transfer,
     set_flop_budget,
 )
@@ -68,5 +71,6 @@ __all__ = [
     "dump", "prometheus_text", "write_prometheus", "emit_chrome_counters",
     "instruments",
     "nbytes_of", "observe_step", "record_collective", "record_compile",
-    "record_fallback", "record_sync", "record_transfer", "set_flop_budget",
+    "record_fallback", "record_serve_batch", "record_serve_request",
+    "record_sync", "record_trace", "record_transfer", "set_flop_budget",
 ]

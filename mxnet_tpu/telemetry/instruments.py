@@ -18,7 +18,8 @@ from __future__ import annotations
 from .registry import REGISTRY, counter, gauge, histogram
 
 __all__ = [
-    "jit_compile_total", "jit_compile_seconds", "hybridize_fallback_total",
+    "jit_compile_total", "jit_compile_seconds", "jit_trace_total",
+    "hybridize_fallback_total",
     "transfer_total", "transfer_bytes_total",
     "sync_total", "sync_blocked_seconds_total",
     "collective_total", "collective_bytes_total",
@@ -26,8 +27,13 @@ __all__ = [
     "step_total", "step_time_seconds", "examples_per_second",
     "mfu_ratio", "flops_per_step", "peak_flops",
     "compile_flops", "compile_peak_hbm_bytes", "device_memory_bytes",
-    "record_compile", "record_fallback", "record_transfer", "record_sync",
-    "record_collective", "observe_step", "set_flop_budget", "nbytes_of",
+    "serve_request_total", "serve_request_latency_seconds",
+    "serve_queue_depth", "serve_in_flight",
+    "serve_batch_total", "serve_batch_size", "serve_padded_rows_total",
+    "serve_shed_total", "serve_timeout_total",
+    "record_compile", "record_trace", "record_fallback", "record_transfer",
+    "record_sync", "record_collective", "observe_step", "set_flop_budget",
+    "record_serve_request", "record_serve_batch", "nbytes_of",
 ]
 
 # v5e-class bf16 peak, the default MFU denominator (tools/perf_lab.py's
@@ -39,6 +45,9 @@ _COMPILE_BUCKETS = (.01, .05, .1, .25, .5, 1.0, 2.5, 5.0, 10.0, 30.0,
 _STEP_BUCKETS = (.001, .0025, .005, .01, .025, .05, .1, .25, .5,
                  1.0, 2.5, 5.0, 10.0, 30.0, 60.0)
 _SYNC_BUCKETS = (.0001, .001, .01, .1, 1.0, 10.0)  # noqa: F841 (doc aid)
+_SERVE_LATENCY_BUCKETS = (.0005, .001, .0025, .005, .01, .025, .05, .1,
+                          .25, .5, 1.0, 2.5, 5.0, 10.0)
+_SERVE_BATCH_BUCKETS = (1, 2, 4, 8, 16, 32, 64, 128, 256, 512)
 
 # -- compiles ---------------------------------------------------------------
 jit_compile_total = counter(
@@ -49,6 +58,12 @@ jit_compile_seconds = histogram(
     "jit_compile_seconds",
     "Wall time of each CachedOp variant build (trace+compile+first run)",
     ["block", "variant"], buckets=_COMPILE_BUCKETS)
+jit_trace_total = counter(
+    "jit_trace_total",
+    "jit retraces per block variant: one per new input signature — each "
+    "is one XLA compile, including shape-cache misses AFTER the variant "
+    "was first built (gluon/block.py cached_fn; the serving warmup "
+    "zero-miss proof reads the per-block counterpart)", ["block", "variant"])
 hybridize_fallback_total = counter(
     "hybridize_fallback_total",
     "Hybridized blocks that fell back to imperative execution on a "
@@ -116,6 +131,42 @@ peak_flops = gauge(
     "peak_flops", "Declared accelerator peak FLOP/s (set_flop_budget)")
 
 
+# -- serving (serving/engine.py; docs/serving.md) ---------------------------
+serve_request_total = counter(
+    "serve_request_total",
+    "Serving requests by final outcome (ok / shed / timeout / error)",
+    ["model", "outcome"])
+serve_request_latency_seconds = histogram(
+    "serve_request_latency_seconds",
+    "End-to-end request latency: submit -> result ready (queue wait + "
+    "batch assembly + compiled forward); p50/p99 derive from the buckets",
+    ["model"], buckets=_SERVE_LATENCY_BUCKETS)
+serve_queue_depth = gauge(
+    "serve_queue_depth",
+    "Requests waiting in the admission queue right now", ["model"])
+serve_in_flight = gauge(
+    "serve_in_flight",
+    "Requests inside the batch currently executing", ["model"])
+serve_batch_total = counter(
+    "serve_batch_total", "Micro-batches executed", ["model"])
+serve_batch_size = histogram(
+    "serve_batch_size",
+    "Real rows per executed micro-batch, BEFORE padding to the bucket "
+    "(bucket fill)", ["model"], buckets=_SERVE_BATCH_BUCKETS)
+serve_padded_rows_total = counter(
+    "serve_padded_rows_total",
+    "Padding rows added to round batches up to their compile bucket",
+    ["model"])
+serve_shed_total = counter(
+    "serve_shed_total",
+    "Requests rejected at admission — queue bound exceeded -> Overloaded",
+    ["model"])
+serve_timeout_total = counter(
+    "serve_timeout_total",
+    "Requests that hit their deadline before a result was ready",
+    ["model"])
+
+
 # -- helpers ----------------------------------------------------------------
 
 def nbytes_of(x):
@@ -135,6 +186,38 @@ def record_compile(block, variant, seconds):
         return
     jit_compile_total.labels(block, variant).inc()
     jit_compile_seconds.labels(block, variant).observe(seconds)
+
+
+def record_trace(block, variant):
+    if not REGISTRY.enabled:
+        return
+    jit_trace_total.labels(block, variant).inc()
+
+
+def record_serve_request(model, outcome, seconds=None):
+    """One finished serving request. `outcome` is ok / shed / timeout /
+    error; `seconds` (when the request made it far enough to have a
+    latency) lands in the latency histogram. Shed and timeout also bump
+    their dedicated counters so overload is visible at a glance."""
+    if not REGISTRY.enabled:
+        return
+    serve_request_total.labels(model, outcome).inc()
+    if outcome == "shed":
+        serve_shed_total.labels(model).inc()
+    elif outcome == "timeout":
+        serve_timeout_total.labels(model).inc()
+    if seconds is not None:
+        serve_request_latency_seconds.labels(model).observe(seconds)
+
+
+def record_serve_batch(model, rows, bucket):
+    """One executed micro-batch: `rows` real rows padded up to `bucket`."""
+    if not REGISTRY.enabled:
+        return
+    serve_batch_total.labels(model).inc()
+    serve_batch_size.labels(model).observe(rows)
+    if bucket > rows:
+        serve_padded_rows_total.labels(model).inc(bucket - rows)
 
 
 def record_fallback(block):

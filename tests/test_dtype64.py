@@ -74,7 +74,7 @@ def test_set_np_dtype_switches_defaults():
         assert npx.is_np_default_dtype()
         assert str(mx.np.arange(3).dtype) == "int64"
     finally:
-        npx.reset_np()
+        npx.set_np()
     assert not npx.is_np_default_dtype()
     assert str(mx.np.arange(3).dtype) == "float32"
 
@@ -204,7 +204,7 @@ def test_np_default_dtype_mode_port():
         assert str(mx.np.indices((3,)).dtype) == "int64"
         assert str(mx.np.arange(3, 7, 2).dtype) == "int64"
     finally:
-        npx.reset_np()
+        npx.set_np()
     assert str(mx.np.indices((3,)).dtype) == "int64"
     assert str(mx.np.arange(3, 7, 2).dtype) == "float32"
 

@@ -413,7 +413,7 @@ def test_np_full_default_dtype_mode():
     try:
         assert str(mx.np.full((2,), 3.14).dtype) == "float64"
     finally:
-        npx.reset_np()
+        npx.set_np()
     assert str(mx.np.full((2,), 3.14).dtype) == "float32"
     # explicit 64-bit array fill keeps its dtype
     fill = mx.np.array(1.5, dtype="float64")

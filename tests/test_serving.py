@@ -1,0 +1,331 @@
+"""Serving subsystem: bucket ladder, dynamic batching correctness under
+concurrency, warmup zero-recompile proof, admission control/shedding,
+deadlines, model registry, telemetry (mxnet_tpu/serving/; docs/serving.md).
+"""
+import threading
+import time
+
+import numpy as onp
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import serving, telemetry
+from mxnet_tpu.diagnostics import introspect
+from mxnet_tpu.gluon import HybridBlock, nn
+from mxnet_tpu.serving import (EngineStopped, Overloaded, RequestTimeout,
+                               assemble_batch, bucket_ladder, pad_rows,
+                               pick_bucket)
+
+
+def make_mlp(features=10, hidden=16, classes=4):
+    mx.seed(0)
+    net = nn.HybridSequential()
+    net.add(nn.Dense(hidden, activation="relu"), nn.Dense(classes))
+    net.initialize()
+    net.hybridize()
+    net(mx.np.zeros((1, features)))  # materialize params
+    return net
+
+
+# --- bucket ladder ----------------------------------------------------------
+
+def test_bucket_ladder_defaults():
+    assert bucket_ladder(1) == (1,)
+    assert bucket_ladder(8) == (1, 2, 4, 8)
+    # non-power-of-two max is always the top rung
+    assert bucket_ladder(12) == (1, 2, 4, 8, 12)
+    assert bucket_ladder(32) == (1, 2, 4, 8, 16, 32)
+
+
+def test_bucket_ladder_explicit_and_invalid():
+    assert bucket_ladder(16, buckets=[4, 8]) == (4, 8, 16)
+    assert bucket_ladder(16, buckets=[16, 4, 4]) == (4, 16)
+    with pytest.raises(ValueError):
+        bucket_ladder(0)
+    with pytest.raises(ValueError):
+        bucket_ladder(8, buckets=[0, 4])
+    with pytest.raises(ValueError):
+        bucket_ladder(8, buckets=[32])
+
+
+def test_pick_bucket():
+    ladder = (1, 2, 4, 8)
+    assert pick_bucket(ladder, 1) == 1
+    assert pick_bucket(ladder, 3) == 4
+    assert pick_bucket(ladder, 8) == 8
+    assert pick_bucket(ladder, 9) is None
+
+
+def test_pad_rows_repeats_last_row():
+    a = onp.arange(6, dtype=onp.float32).reshape(3, 2)
+    p = pad_rows(a, 4)
+    assert p.shape == (4, 2)
+    assert (p[3] == a[2]).all()  # last-row repetition, not zeros
+    assert pad_rows(a, 3) is a  # exact fit: no copy
+    with pytest.raises(ValueError):
+        pad_rows(a, 2)
+
+
+def test_assemble_batch_concats_then_pads():
+    r1 = (onp.ones((2, 3), onp.float32),)
+    r2 = (onp.full((1, 3), 5.0, onp.float32),)
+    (out,) = assemble_batch([r1, r2], 4)
+    assert out.shape == (4, 3)
+    assert (out[0:2] == 1.0).all() and (out[2] == 5.0).all()
+    assert (out[3] == 5.0).all()  # pad repeats the final row
+
+
+# --- warmup: the zero-recompile proof ---------------------------------------
+
+def test_warmup_seals_jit_cache_with_introspection():
+    net = make_mlp()
+    eng = serving.InferenceEngine(net, name="warm", max_batch_size=8)
+    info = eng.warmup(mx.np.zeros((1, 10)))
+    assert info["buckets"] == [1, 2, 4, 8]
+    assert eng.recompiles_since_warmup() == 0
+    # each bucket landed in the diagnostics compile registry
+    keys = {k for k in introspect.compile_registry() if k[0] == "warm"}
+    assert keys == {("warm", f"b{b}") for b in (1, 2, 4, 8)}
+    # re-driving every bucket through the engine adds no traces
+    eng.start()
+    try:
+        for rows in (1, 2, 3, 4, 5, 8):
+            out = eng.predict(onp.zeros((rows, 10), onp.float32))
+            assert out.shape == (rows, 4)
+        assert eng.recompiles_since_warmup() == 0
+    finally:
+        eng.stop()
+
+
+def test_warmup_validates_example():
+    eng = serving.InferenceEngine(make_mlp(), name="warmbad",
+                                  max_batch_size=4)
+    with pytest.raises(ValueError):
+        eng.warmup()
+    with pytest.raises(ValueError):
+        eng.warmup(onp.float32(3.0))  # no row dimension
+
+
+# --- batching correctness under concurrency ---------------------------------
+
+def test_concurrent_clients_bucket_padding_correctness():
+    net = make_mlp()
+    eng = serving.InferenceEngine(net, name="conc", max_batch_size=8,
+                                  max_wait_ms=2.0, timeout_ms=30_000.0)
+    eng.warmup(mx.np.zeros((1, 10)))
+    rng = onp.random.default_rng(0)
+    results, errs = [], []
+
+    def client(i):
+        try:
+            for _ in range(6):
+                rows = int(rng.integers(1, 4))
+                x = onp.asarray(rng.standard_normal((rows, 10)),
+                                dtype=onp.float32)
+                results.append((x, eng.predict(x).asnumpy()))
+        except Exception as e:  # noqa: BLE001 — re-raised via errs
+            errs.append(e)
+
+    with eng:
+        threads = [threading.Thread(target=client, args=(i,))
+                   for i in range(8)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+    assert not errs, errs
+    assert len(results) == 48
+    # the acceptance invariant: 8 concurrent clients, zero XLA cache
+    # misses after warmup (checked BEFORE oracle calls — odd-shaped
+    # oracle forwards through net() would themselves retrace)
+    assert eng.recompiles_since_warmup() == 0
+    for x, got in results:
+        want = net(mx.np.array(x)).asnumpy()
+        assert got.shape == want.shape
+        onp.testing.assert_allclose(got, want, atol=1e-5)
+    st = eng.stats()
+    assert st["requests"].get("ok", 0) >= 48
+    assert st["batches"] >= 1
+
+
+def test_deadline_launches_partial_batch():
+    # one lone 3-row request must be served at the max-wait deadline,
+    # padded into bucket 4 — not wait for a full batch of 8
+    net = make_mlp()
+    eng = serving.InferenceEngine(net, name="partial", max_batch_size=8,
+                                  max_wait_ms=5.0, timeout_ms=5_000.0)
+    eng.warmup(mx.np.zeros((1, 10)))
+    with eng:
+        t0 = time.perf_counter()
+        out = eng.predict(onp.zeros((3, 10), onp.float32))
+        dt = time.perf_counter() - t0
+    assert out.shape == (3, 4)
+    assert dt < 2.0  # served at the ~5ms deadline, not a timeout
+    padded = telemetry.instruments.serve_padded_rows_total.labels(
+        "partial").value
+    assert padded >= 1  # 3 rows into bucket 4 = at least one pad row
+
+
+def test_mixed_signatures_never_share_a_batch():
+    # shape-polymorphic block: requests with different trailing shapes
+    # must land in different batches (concatenating them would throw)
+    class Doubler(HybridBlock):
+        def forward(self, x):
+            return x * 2.0
+
+    net = Doubler()
+    net.initialize()
+    net.hybridize()
+    eng = serving.InferenceEngine(net, name="mixed", max_batch_size=8,
+                                  max_wait_ms=20.0, timeout_ms=10_000.0)
+    r_a = eng.submit(onp.ones((2, 5), onp.float32))
+    r_b = eng.submit(onp.ones((2, 3), onp.float32))
+    r_c = eng.submit(onp.full((1, 5), 4.0, onp.float32))
+    with eng:  # start after queueing so the batcher sees all three
+        out_a, out_b, out_c = r_a.result(), r_b.result(), r_c.result()
+    assert out_a.shape == (2, 5) and (out_a.asnumpy() == 2.0).all()
+    assert out_b.shape == (2, 3) and (out_b.asnumpy() == 2.0).all()
+    assert out_c.shape == (1, 5) and (out_c.asnumpy() == 8.0).all()
+
+
+def test_submit_validates_rows():
+    eng = serving.InferenceEngine(make_mlp(), name="val", max_batch_size=4)
+    with pytest.raises(ValueError):
+        eng.submit(onp.zeros((5, 10), onp.float32))  # > max_batch_size
+    with pytest.raises(ValueError):
+        eng.submit()
+    with pytest.raises(ValueError):
+        eng.submit(onp.zeros((2, 10), onp.float32),
+                   onp.zeros((3, 10), onp.float32))  # row mismatch
+
+
+# --- admission control / deadlines ------------------------------------------
+
+def test_load_shedding_is_deterministic():
+    eng = serving.InferenceEngine(make_mlp(), name="shed",
+                                  max_batch_size=8, max_queue=2,
+                                  timeout_ms=10_000.0)
+    x = onp.zeros((1, 10), onp.float32)
+    r1, r2 = eng.submit(x), eng.submit(x)
+    before = telemetry.instruments.serve_shed_total.labels("shed").value
+    for _ in range(3):  # every submit past the bound sheds, none block
+        with pytest.raises(Overloaded):
+            eng.submit(x)
+    after = telemetry.instruments.serve_shed_total.labels("shed").value
+    assert after - before == 3
+    # start() drains the admitted two; new submits are accepted again
+    with eng:
+        assert r1.result().shape == (1, 4)
+        assert r2.result().shape == (1, 4)
+        assert eng.predict(x).shape == (1, 4)
+
+
+def test_request_timeout():
+    # engine deliberately NOT started: the request can never be served
+    eng = serving.InferenceEngine(make_mlp(), name="tmo", max_batch_size=4)
+    before = telemetry.instruments.serve_timeout_total.labels("tmo").value
+    t0 = time.perf_counter()
+    with pytest.raises(RequestTimeout):
+        eng.predict(onp.zeros((1, 10), onp.float32), timeout_ms=60)
+    assert time.perf_counter() - t0 < 5.0
+    after = telemetry.instruments.serve_timeout_total.labels("tmo").value
+    assert after - before == 1
+
+
+def test_queued_requests_expire_at_their_deadline():
+    # a request that expires while QUEUED is dropped by the batcher and
+    # never executed
+    eng = serving.InferenceEngine(make_mlp(), name="expire",
+                                  max_batch_size=4)
+    req = eng.submit(onp.zeros((1, 10), onp.float32), timeout_ms=30)
+    time.sleep(0.1)  # expire before the batcher ever runs
+    with eng:
+        with pytest.raises(RequestTimeout):
+            req.result()
+        assert req.outcome == "timeout"
+
+
+def test_stopped_engine_rejects_and_drain_false_fails_pending():
+    eng = serving.InferenceEngine(make_mlp(), name="stopped",
+                                  max_batch_size=4, timeout_ms=10_000.0)
+    x = onp.zeros((1, 10), onp.float32)
+    req = eng.submit(x)
+    eng.stop(drain=False)
+    with pytest.raises(EngineStopped):
+        req.result()
+    with pytest.raises(EngineStopped):
+        eng.submit(x)
+    with pytest.raises(EngineStopped):
+        eng.start()  # stop is terminal
+
+
+# --- observability ----------------------------------------------------------
+
+def test_serving_metrics_in_telemetry_dump():
+    net = make_mlp()
+    eng = serving.InferenceEngine(net, name="obs", max_batch_size=4,
+                                  timeout_ms=10_000.0)
+    eng.warmup(mx.np.zeros((1, 10)))
+    with eng:
+        for _ in range(3):
+            eng.predict(onp.zeros((2, 10), onp.float32))
+    d = telemetry.dump()
+    assert "serve_request_latency_seconds" in d
+    assert "serve_queue_depth" in d
+    assert "serve_batch_size" in d
+    st = eng.stats()
+    assert st["requests"]["ok"] >= 3
+    assert st["p50_ms"] is not None and st["p99_ms"] >= st["p50_ms"]
+    assert st["queue_depth"] == 0
+
+
+def test_serve_span_emitted(tmp_path):
+    from mxnet_tpu.diagnostics import spans
+
+    net = make_mlp()
+    eng = serving.InferenceEngine(net, name="spanned", max_batch_size=4,
+                                  timeout_ms=10_000.0)
+    spans.enable()
+    try:
+        with eng:
+            eng.predict(onp.zeros((1, 10), onp.float32))
+        cats = {s["cat"] for s in spans.records()}
+    finally:
+        spans.disable()
+        spans.reset()
+    assert "serve" in cats
+
+
+# --- model registry ---------------------------------------------------------
+
+def test_model_registry_lifecycle():
+    reg = serving.ModelRegistry()
+    net = make_mlp()
+    eng = reg.register("m1", net, start=False, max_batch_size=4)
+    assert "m1" in reg
+    assert reg.get("m1") is eng
+    assert reg.names() == ["m1"]
+    with pytest.raises(ValueError):
+        reg.register("m1", net)  # duplicates are explicit errors
+    assert "m1" in reg.stats()
+    assert reg.unregister("m1") is eng
+    assert "m1" not in reg
+    with pytest.raises(KeyError):
+        reg.get("m1")
+    with pytest.raises(KeyError):
+        reg.unregister("m1")
+
+
+def test_model_registry_adopts_ready_engine_and_stop_all():
+    reg = serving.ModelRegistry()
+    eng = serving.InferenceEngine(make_mlp(), name="adopted",
+                                  max_batch_size=4, timeout_ms=10_000.0)
+    with pytest.raises(ValueError):
+        reg.register("adopted", eng, max_batch_size=8)  # kwargs + engine
+    reg.register("adopted", eng)
+    assert eng.started
+    out = reg.get("adopted").predict(onp.zeros((1, 10), onp.float32))
+    assert out.shape == (1, 4)
+    reg.stop_all()
+    assert reg.names() == []
+    assert not eng.started

@@ -140,10 +140,26 @@ def test_set_np_honors_arguments():
         assert not mx.npx.is_np_shape()
         assert not mx.npx.is_np_array()
     finally:
-        mx.npx.reset_np()
+        mx.npx.set_np()
     assert mx.npx.is_np_shape() and mx.npx.is_np_array()
     with _pytest.raises(ValueError):
         mx.npx.set_np(shape=False, array=True)
+
+
+def test_reset_np_matches_reference():
+    # reference semantics: reset_np() == set_np(shape=False, array=False,
+    # dtype=False) — every flag off (the advisory array/shape flags AND
+    # the real dtype default)
+    mx.npx.set_np(dtype=True)
+    try:
+        mx.npx.reset_np()
+        assert not mx.npx.is_np_shape()
+        assert not mx.npx.is_np_array()
+        assert not mx.npx.is_np_default_dtype()
+        assert str(mx.np.arange(3).dtype) == "float32"
+    finally:
+        mx.npx.set_np()
+    assert mx.npx.is_np_shape() and mx.npx.is_np_array()
 
 
 def test_np_semantics_scope():

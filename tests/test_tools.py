@@ -93,6 +93,23 @@ def test_bandwidth_harness():
     assert row["algo_bw_gbps"] > 0
 
 
+def test_serve_bench_smoke():
+    rc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "serve_bench.py"),
+         "--clients", "4", "--requests", "5", "--max-batch", "8"],
+        env=ENV, capture_output=True, text=True, timeout=300)
+    assert rc.returncode == 0, rc.stderr
+    row = json.loads(rc.stdout.strip().split("\n")[-1])
+    assert row["metric"] == "inference_qps"
+    assert row["value"] > 0
+    assert row["completed"] == 4 * 5
+    assert row["shed"] == 0 and row["timeout"] == 0
+    assert row["recompiles_since_warmup"] == 0
+    assert row["warmup"]["buckets"] == [1, 2, 4, 8]
+    assert row["engine"]["requests"]["ok"] >= 20
+    assert row["p50_ms"] is not None and row["p99_ms"] >= row["p50_ms"]
+
+
 def test_opperf_harness():
     rc = subprocess.run(
         [sys.executable, os.path.join(REPO, "benchmark", "opperf.py"),
