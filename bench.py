@@ -59,11 +59,13 @@ def _latest_bench_snapshot(repo_dir=None):
 
 def _check_regressions(current, threshold=0.03):
     """Compare this run's metrics against the latest BENCH_r*.json; any
-    same-named throughput metric that dropped more than `threshold`
-    (default 3%) gets a WARNING on stderr and a row in the returned list
-    (the r3→r5 inference regression went unflagged; never again). Metric
-    names embed batch/layout/CPU_FALLBACK, so only like-for-like configs
-    compare."""
+    same-named metric that regressed more than `threshold` (default 3%)
+    gets a WARNING on stderr and a row in the returned list (the r3→r5
+    inference regression went unflagged; never again). Throughput metrics
+    regress by DROPPING; latency metrics (name containing `_ms`, e.g.
+    trainer_update_ms) regress by RISING — the comparison flips
+    accordingly. Metric names embed batch/layout/CPU_FALLBACK, so only
+    like-for-like configs compare."""
     path, prior = _latest_bench_snapshot()
     if prior is None:
         return []
@@ -85,14 +87,18 @@ def _check_regressions(current, threshold=0.03):
         cur = cur_vals.get(name)
         if cur is None or prev <= 0 or "agreement" in name:
             continue  # ratios aren't throughput; missing = not comparable
-        drop = (prev - cur) / prev
-        if drop > threshold:
+        lower_is_better = name.endswith("_ms") or "_ms_" in name
+        if lower_is_better:
+            change = (cur - prev) / prev   # latency rising = regression
+        else:
+            change = (prev - cur) / prev   # throughput dropping = regression
+        if change > threshold:
             regressions.append({
                 "metric": name, "previous": prev, "current": cur,
-                "drop_pct": round(drop * 100, 2),
+                "drop_pct": round(change * 100, 2),
                 "baseline_file": os.path.basename(path),
             })
-            print(f"WARNING: {name} regressed {drop * 100:.1f}% "
+            print(f"WARNING: {name} regressed {change * 100:.1f}% "
                   f"({prev} -> {cur}) vs {os.path.basename(path)}",
                   file=sys.stderr)
     return regressions
@@ -369,6 +375,63 @@ def bench_int8_agreement(platform):
     return agree / total
 
 
+def _resnet50_param_shapes():
+    """Conv/BN/FC tensor shapes of ResNet-50 v1 (161 tensors, ~25.6M
+    params) — synthesized so the update bench measures ONLY the trainer's
+    fused optimizer dispatch, not model build/compile time."""
+    shapes = [(64, 7, 7, 3), (64,), (64,)]
+    in_c = 64
+    for blocks, width in [(3, 64), (4, 128), (6, 256), (3, 512)]:
+        for b in range(blocks):
+            out_c = width * 4
+            shapes += [(width, 1, 1, in_c), (width,), (width,)]
+            shapes += [(width, 3, 3, width), (width,), (width,)]
+            shapes += [(out_c, 1, 1, width), (out_c,), (out_c,)]
+            if b == 0:
+                shapes += [(out_c, 1, 1, in_c), (out_c,), (out_c,)]
+            in_c = out_c
+    shapes += [(1000, 2048), (1000,)]
+    return shapes
+
+
+def bench_trainer_update_ms(platform, steps=50):
+    """Milliseconds per fused Trainer.update over a ResNet-50-shaped
+    param set (161 tensors, SGD momentum): the dispatch-tax row the
+    fused multi-tensor path exists to shrink (docs/performance.md).
+    One bucket → one donated jit dispatch per step; the legacy loop
+    would pay ~161."""
+    import numpy as onp
+
+    import mxnet_tpu as mx
+    from mxnet_tpu import gluon
+
+    mx.seed(0)
+    rs = onp.random.RandomState(0)
+    params = []
+    for k, shape in enumerate(_resnet50_param_shapes()):
+        p = gluon.Parameter(f"p{k}", shape=shape)
+        p.initialize()
+        params.append(p)
+    trainer = gluon.Trainer(params, "sgd",
+                            {"learning_rate": 0.01, "momentum": 0.9})
+    for p in params:
+        g = p.grad()
+        g._data = mx.np.array(
+            rs.standard_normal(p.shape).astype("f"))._data
+        g._version += 1
+
+    def sync():
+        params[0].data().asnumpy()
+
+    trainer.update(1)   # absorb trace + compile
+    sync()
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        trainer.update(1)
+    sync()
+    return (time.perf_counter() - t0) / steps * 1000.0
+
+
 def bench_serving_qps(platform, clients=8, requests=40):
     """Serving-engine round-trip QPS: `clients` threads hammering one
     dynamically-batching InferenceEngine through warmup()ed buckets
@@ -516,6 +579,22 @@ def main():
                         "(example/quantization/README.md:113-121)"})
         except Exception as e:
             rows.append({"metric": "int8_agreement", "error": str(e)})
+
+    # fused-update dispatch latency runs on every platform (no model
+    # compile — the row times the optimizer dispatch path itself, which
+    # exists on CPU too); >3% RISE trips the regression gate above
+    try:
+        if over_budget():
+            raise TimeoutError("bench budget exhausted")
+        upd_ms = bench_trainer_update_ms(platform)
+        rows.append({
+            "metric": "trainer_update_ms" + suffix,
+            "value": round(upd_ms, 3), "unit": "ms",
+            "note": "mean of 50 fused Trainer.update steps over a "
+                    "ResNet-50-shaped param set (161 tensors, SGD "
+                    "momentum, one donated dispatch per step)"})
+    except Exception as e:
+        rows.append({"metric": "trainer_update_ms", "error": str(e)})
 
     # serving-engine QPS runs on every platform (cheap MLP — the row
     # measures the batching/dispatch path, which exists on CPU too)

@@ -143,6 +143,25 @@ register(
     "serving.InferenceEngine default per-request deadline; requests "
     "not completed in time fail with serving.RequestTimeout.")
 register(
+    "MXTPU_FUSED_UPDATE", bool, True,
+    "Fused multi-tensor optimizer update: bucket the parameter tree by "
+    "(rule, weight dtype, multi-precision) and run ONE donated jit "
+    "dispatch per bucket per step, plus the bucketed flat-buffer "
+    "allreduce in Trainer.allreduce_grads — collapses O(params) "
+    "dispatches to O(buckets). 0 restores the legacy per-parameter "
+    "path (docs/performance.md).")
+register(
+    "MXTPU_FUSED_BUCKET_MB", int, 25,
+    "Target flat-buffer size (MB) for the bucketed DDP-style allreduce "
+    "in Trainer.allreduce_grads: gradients are concatenated into flat "
+    "buffers of roughly this size, one collective dispatch per buffer.")
+register(
+    "MXTPU_DONATE_UPDATE", bool, True,
+    "Donate weight/optimizer-state buffers into optimizer update "
+    "dispatches so XLA reuses them in place instead of allocating fresh "
+    "HBM. Skipped automatically for any single call where donation "
+    "would alias another argument's buffer.")
+register(
     "MXTPU_BENCH_BUDGET_S", int, 1200,
     "bench.py wall-clock budget (seconds); secondary rows are skipped "
     "with an error row once exceeded so the driver always gets the "

@@ -62,6 +62,10 @@ class Trainer:
             self._kvstore.set_optimizer(self._optimizer)
         self._kv_initialized = False
         self._last_step_end = None  # telemetry: previous step() finish
+        # param index -> grad buffer version seen at its last update;
+        # a matching version means the grad is STALE (nothing backprop'd
+        # into it since) — see update()/allreduce_grads()
+        self._grad_versions = {}
 
     @property
     def optimizer(self):
@@ -80,35 +84,58 @@ class Trainer:
                 i, weight)
             self._states_created[i] = True
 
-    def allreduce_grads(self):
+    def allreduce_grads(self, ignore_stale_grad=False):
         """Aggregate gradients across device copies via the kvstore
         (reference: trainer.py:402 _allreduce_grads).
 
-        Calls are issued in descending priority (priority=-i, so layer 0
-        first — its weights gate the next forward), the P3 dispatch-order
-        contract (src/kvstore/p3store_dist.h); each pushpull is async on
-        the device, so XLA's latency-hiding scheduler overlaps the
-        sequence the way P3 overlapped ps-lite sends.
+        With the fused path on (MXTPU_FUSED_UPDATE, default) all params
+        go to the store in ONE list-form pushpull, which tpu_dist turns
+        into a bucketed flat allreduce — one reduce dispatch per ~25 MB
+        dtype-homogeneous buffer instead of one per param. Otherwise
+        calls are issued per param in descending priority (priority=-i,
+        so layer 0 first — its weights gate the next forward), the P3
+        dispatch-order contract (src/kvstore/p3store_dist.h).
+
+        `ignore_stale_grad` skips params whose grad buffer is STALE
+        (untouched since their last update): reducing one would both sum
+        garbage into live gradients and bump the buffer's version, making
+        update() mistake it for fresh.
         """
         kv = self._kvstore
         if kv is None:
             return
         distributed = getattr(kv, "num_workers", 1) > 1 or \
             kv.is_capable("pushpull")
+        from .. import env as _env
+
+        fused = _env.get("MXTPU_FUSED_UPDATE")
+        keys, vals = [], []
         for i, p in enumerate(self._params):
             if p.grad_req == "null":
                 continue
             grads = p.list_grad()
             if len(grads) == 1 and not distributed:
                 continue  # single copy, local store: nothing to reduce
-            kv.pushpull(i, grads, out=grads, priority=-i)
+            if ignore_stale_grad and \
+                    self._grad_versions.get(i) == grads[0]._version:
+                continue
+            if fused:
+                keys.append(i)
+                vals.append(grads)
+            else:
+                kv.pushpull(i, grads, out=grads, priority=-i)
+        if fused and keys:
+            if len(keys) == 1:
+                kv.pushpull(keys[0], vals[0], out=vals[0], priority=0)
+            else:
+                kv.pushpull(keys, vals, out=vals, priority=0)
 
     def step(self, batch_size, ignore_stale_grad=False):
         """allreduce + optimizer update, scaling grads by 1/batch_size
         (reference: trainer.py:341)."""
         self._optimizer.rescale_grad = self._scale / batch_size
         with _spans.span("allreduce_grads", cat="collective"):
-            self.allreduce_grads()
+            self.allreduce_grads(ignore_stale_grad)
         with _spans.span("optimizer_update", cat="optimizer"):
             self.update(batch_size, ignore_stale_grad, _skip_rescale=True)
         # close this iteration's step bucket: fwd/bwd spans recorded since
@@ -129,13 +156,22 @@ class Trainer:
                _skip_rescale=False):
         if not _skip_rescale:
             self._optimizer.rescale_grad = self._scale / batch_size
-        if not hasattr(self, "_grad_versions"):
-            self._grad_versions = {}
+        from .. import env as _env
+
+        # fused multi-tensor path (default): single-device dense params
+        # are collected into ONE list-form update_multi_precision call —
+        # the optimizer buckets them by (dtype, multi-precision) and runs
+        # one donated jit dispatch per bucket. Sparse grads and params
+        # replicated across devices stay on the legacy per-param loop.
+        fuse = _env.get("MXTPU_FUSED_UPDATE") and \
+            self._optimizer._supports_fused()
+        f_idx, f_w, f_g, f_s = [], [], [], []
         for i, p in enumerate(self._params):
             if p.grad_req == "null":
                 continue
             p._check_initialized()
-            for dev in p.list_ctx():
+            devs = p.list_ctx()
+            for dev in devs:
                 w = p.data(dev)
                 g = p.grad(dev)
                 # stale = grad buffer untouched since the last update
@@ -146,17 +182,26 @@ class Trainer:
                     if getattr(p, "grad_stype", "default") == "row_sparse":
                         # hand the optimizer only the touched rows
                         # (lazy_update semantics; Parameter docs)
-                        g_upd = p._as_row_sparse_grad(g)
+                        self._optimizer.update_multi_precision(
+                            i, w, p._as_row_sparse_grad(g),
+                            self._states[i])
+                    elif fuse and len(devs) == 1:
+                        f_idx.append(i)
+                        f_w.append(w)
+                        f_g.append(g)
+                        f_s.append(self._states[i])
                     else:
-                        g_upd = g
-                    self._optimizer.update_multi_precision(
-                        i, w, g_upd, self._states[i])
+                        self._optimizer.update_multi_precision(
+                            i, w, g, self._states[i])
                     self._grad_versions[i] = g._version
                 break  # update primary; replicate below
             if len(p.list_ctx()) > 1:
                 primary = p.data(p.list_ctx()[0])
                 for dev in p.list_ctx()[1:]:
                     primary.copyto(p.data(dev))
+        if f_idx:
+            self._optimizer.update_fused(f_idx, f_w, f_g, f_s,
+                                         multi_precision=True)
 
     def zero_grad(self):
         for p in self._params:

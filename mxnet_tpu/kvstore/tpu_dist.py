@@ -133,6 +133,8 @@ class TPUDist(KVStoreBase):
         if len(keys) != 1:
             vals = value
             outs = out if out is not None else [None] * len(keys)
+            if self._pushpull_fused(keys, vals, outs):
+                return
             for k, v, o in zip(keys, vals, outs):
                 self.pushpull(k, v, o, priority)
             return
@@ -161,6 +163,91 @@ class TPUDist(KVStoreBase):
         for o in outs:
             o._data = self._put_like(total_data, o._data)
             o._version += 1
+
+    def _pushpull_fused(self, keys, values, outs, priority=0):  # noqa: ARG002
+        """Bucketed flat allreduce for a list-form pushpull (the DDP
+        multi-tensor path, docs/performance.md): per-key device copies are
+        flattened, concatenated into dtype-homogeneous buffers of
+        ~MXTPU_FUSED_BUCKET_MB MB, and each buffer is reduced in ONE
+        jitted dispatch (concat + add-tree + split traced together) —
+        O(buckets) launches instead of O(keys). Returns False when the
+        call shape can't take the fused path (no outs, compression on,
+        multi-process, ragged copy counts) so the caller falls back to
+        the per-key loop."""
+        from .. import env as _env
+
+        if (not _env.get("MXTPU_FUSED_UPDATE") or outs is None
+                or any(o is None for o in outs)
+                or self._compression is not None
+                or self.num_workers > 1):
+            return False
+        vals_lists = [_aslist(v) for v in values]
+        outs_lists = [_aslist(o) for o in outs]
+        ncopies = len(vals_lists[0])
+        if any(len(v) != ncopies for v in vals_lists):
+            return False
+        from ..parallel.collectives import _flat_buckets
+
+        t0 = time.perf_counter()
+        primaries = [v[0]._data for v in vals_lists]
+        cap = int(_env.get("MXTPU_FUSED_BUCKET_MB")) << 20
+        buckets = _flat_buckets(primaries, cap)
+        with _spans.span("kv.pushpull", cat="collective"), \
+                _watchdog.guard("kv.pushpull"):
+            for bucket in buckets:
+                if ncopies == 1:
+                    # single copy, nothing to sum: honor the write-back
+                    # contract (out gets the value, version bump) with
+                    # zero device dispatches
+                    reduced = [vals_lists[j][0]._data for j in bucket]
+                else:
+                    dev = next(iter(
+                        vals_lists[bucket[0]][0]._data.devices()))
+                    parts = [
+                        [jax.device_put(vals_lists[j][d]._data, dev)
+                         for j in bucket]
+                        for d in range(ncopies)]
+                    fn = self._fused_reduce_fn(
+                        ncopies,
+                        tuple((p.shape, str(p.dtype))
+                              for p in parts[0]))
+                    reduced = fn(parts)
+                for j, red in zip(bucket, reduced):
+                    for o in outs_lists[j]:
+                        o._data = self._put_like(red, o._data)
+                        o._version += 1
+                _telemetry.record_fused_bucket("allreduce", len(bucket))
+        _telemetry.record_collective(
+            "pushpull",
+            sum(_telemetry.nbytes_of(v._data)
+                for vl in vals_lists for v in vl),
+            time.perf_counter() - t0)
+        return True
+
+    def _fused_reduce_fn(self, ncopies, sig):
+        """Jitted flat reduce for one bucket: concat each copy's members
+        into a flat buffer, add the copies, split back to member shapes —
+        one XLA program per (ncopies, member shapes) signature."""
+        key = ("fused_reduce", ncopies, sig)
+        fn = self._sum_cache.get(key)
+        if fn is None:
+            def reduce(parts):
+                flats = [
+                    copy[0].reshape(-1) if len(copy) == 1
+                    else jnp.concatenate([p.reshape(-1) for p in copy])
+                    for copy in parts]
+                total = flats[0]
+                for f in flats[1:]:
+                    total = total + f
+                red, off = [], 0
+                for p in parts[0]:
+                    red.append(total[off:off + p.size].reshape(p.shape))
+                    off += p.size
+                return red
+
+            fn = jax.jit(reduce)
+            self._sum_cache[key] = fn
+        return fn
 
     @staticmethod
     def _put_like(data, like):
@@ -204,9 +291,15 @@ class TPUDist(KVStoreBase):
         This is the path the sharded Trainer/train-step uses: gradients come
         out of a shard_map-ped backward already device-local; one psum over
         the 'dp' axis completes data parallelism. Returns reduced arrays.
+        With the fused-update path on (MXTPU_FUSED_UPDATE, the default) the
+        tree rides the bucketed flat allreduce — one collective per ~25 MB
+        flat buffer instead of one per leaf.
         """
+        from .. import env as _env
         from ..parallel import collectives
 
+        if _env.get("MXTPU_FUSED_UPDATE"):
+            return collectives.psum_tree_flat(arrays, mesh=mesh, axis=axis)
         return collectives.psum_tree(arrays, mesh=mesh, axis=axis)
 
 

@@ -8,6 +8,14 @@ update_multi_precision (fp32 master weights for bf16/fp16 params).
 Each algorithm implements `_rule(w, g, state, lr, wd, hyper) -> (new_w,
 new_state)` as a pure jax function; `update()` runs it through a per-class
 jit cache and swaps the weight handle in place (engine version bump).
+
+List inputs take the FUSED multi-tensor path (docs/performance.md): params
+are bucketed by (weight dtype, multi-precision) and each bucket runs ONE
+donated jit dispatch doing rescale → global-norm clip → per-element clip →
+`_rule` for every member — O(buckets) dispatches instead of O(params), with
+weight/state buffers donated so XLA updates them in place. Per-param lr/wd/
+update-counts enter as weak-typed scalars, so schedule changes never
+retrace. MXTPU_FUSED_UPDATE=0 restores the per-param loop.
 """
 from __future__ import annotations
 
@@ -16,7 +24,10 @@ import jax.numpy as jnp
 import numpy as _np
 
 from ..base import registry
+from ..diagnostics import spans as _spans
+from ..diagnostics import watchdog as _watchdog
 from ..ndarray.ndarray import NDArray, _wrap_out
+from ..telemetry import instruments as _telemetry
 
 _REG = registry("optimizer")
 
@@ -38,13 +49,64 @@ def _unwrap(x):
     return x._data if isinstance(x, NDArray) else x
 
 
+def _cache_size(fn):
+    """Trace-cache entry count of a jitted fn (None when the jax version
+    doesn't expose it) — comparing before/after a dispatch detects
+    retraces for the compile registry."""
+    get = getattr(fn, "_cache_size", None)
+    try:
+        return get() if get is not None else None
+    except Exception:
+        return None
+
+
+def _donate_enabled():
+    from .. import env as _env
+
+    return _env.get("MXTPU_DONATE_UPDATE")
+
+
+def _leaf_ids(*trees):
+    out = []
+    for t in trees:
+        out.extend(id(x) for x in jax.tree_util.tree_leaves(t))
+    return out
+
+
+def _donation_safe(donated, protected=()):
+    """True when every would-be-donated buffer is unique and none aliases
+    a non-donated argument. Donating a buffer that appears twice in the
+    call (weight tying, a test passing the grad as its own weight) makes
+    XLA read a dead input — INVALID_ARGUMENT at dispatch — so such calls
+    fall back to the copying variant."""
+    ids = _leaf_ids(*donated)
+    seen = set(ids)
+    if len(ids) != len(seen):
+        return False
+    return not any(pid in seen for pid in _leaf_ids(*protected))
+
+
+def _specs(tree):
+    """Shape/dtype skeleton of an argument tree — what capture_compile
+    lowers against AFTER the live buffers were donated into the step."""
+    return jax.tree_util.tree_map(
+        lambda x: jax.ShapeDtypeStruct(x.shape, x.dtype)
+        if hasattr(x, "shape") else x, tree)
+
+
+def _donated_bytes(*trees):
+    return sum(_telemetry.nbytes_of(x)
+               for t in trees for x in jax.tree_util.tree_leaves(t))
+
+
 class Optimizer:
     """Base optimizer (reference: optimizer.py:Optimizer)."""
 
     _jit_cache = {}
 
     def __init__(self, rescale_grad=1.0, param_idx2name=None, wd=0.0,
-                 clip_gradient=None, learning_rate=None, lr_scheduler=None,
+                 clip_gradient=None, clip_global_norm=None,
+                 learning_rate=None, lr_scheduler=None,
                  multi_precision=False, param_dict=None, aggregate_num=None,
                  use_fused_step=True, lazy_update=True,
                  **kwargs):  # noqa: ARG002
@@ -60,6 +122,10 @@ class Optimizer:
             self.lr_scheduler.base_lr = learning_rate
         self.wd = wd
         self.clip_gradient = clip_gradient
+        # clip_global_norm: scale the WHOLE gradient set so its joint L2
+        # norm stays under this bound (fused path only; per-bucket sqnorm
+        # pre-pass, host-combined). None = off.
+        self.clip_global_norm = clip_global_norm
         self.multi_precision = multi_precision
         self.num_update = 0
         self._index_update_count = {}
@@ -142,9 +208,9 @@ class Optimizer:
             g = jnp.clip(g, -self.clip_gradient, self.clip_gradient)
         return g
 
-    def _jitted(self):
+    def _jitted(self, donate=False):
         cls = type(self)
-        key = (cls, self.clip_gradient)
+        key = (cls, self.clip_gradient, donate)
         fn = Optimizer._jit_cache.get(key)
         if fn is None:
             clip = self.clip_gradient
@@ -155,11 +221,86 @@ class Optimizer:
                     g = jnp.clip(g, -clip, clip)
                 return cls._rule(w, g, state, lr, wd, hyper)
 
-            fn = jax.jit(step)
+            fn = jax.jit(step, donate_argnums=(0, 2) if donate else ())
             Optimizer._jit_cache[key] = fn
         return fn
 
-    def _sparse_jitted(self):
+    def _supports_fused(self):
+        """The fused bucketed step runs the class `_rule` under a shared
+        rescale/clip prologue — optimizers that override the imperative
+        `update`/`update_multi_precision` entry points (SGLD's Langevin
+        noise) or never define `_rule` must take the legacy loop."""
+        cls = type(self)
+        return (cls.update is Optimizer.update
+                and cls.update_multi_precision
+                is Optimizer.update_multi_precision
+                and cls._rule is not Optimizer._rule)
+
+    def _fused_jitted(self, n, mp, donate):
+        """One jit for a whole bucket of n same-dtype params: the python
+        loop unrolls at trace time into a single XLA program (the
+        multi-tensor-apply analog), weights+states donated so outputs
+        reuse their HBM. lr/wd/t arrive as tuples of python scalars —
+        weak-typed leaves whose VALUES never retrace (only a length or
+        dtype change does), which also preserves the legacy dtype
+        promotion (bf16 math stays bf16)."""
+        cls = type(self)
+        gn = self.clip_global_norm is not None
+        key = (cls, self.clip_gradient, "fused", n, mp, gn, donate)
+        fn = Optimizer._jit_cache.get(key)
+        if fn is None:
+            clip = self.clip_gradient
+
+            def step(ws, states, gs, lrs, wds, ts, scale, hyper):
+                new_ws, new_states = [], []
+                for w, st, g, lr, wd, t in zip(ws, states, gs, lrs, wds,
+                                               ts):
+                    h = dict(hyper)
+                    h["t"] = t
+                    if mp:
+                        # legacy update_multi_precision order: cast the
+                        # low-precision grad to f32 FIRST, then rescale/
+                        # clip on the f32 master
+                        master, inner = st
+                        g = g.astype(jnp.float32)
+                    g = g * h["rescale_grad"]
+                    if gn:
+                        g = g * scale
+                    if clip is not None:
+                        g = jnp.clip(g, -clip, clip)
+                    if mp:
+                        nm, ni = cls._rule(master, g, inner, lr, wd, h)
+                        new_ws.append(nm.astype(w.dtype))
+                        new_states.append((nm, ni))
+                    else:
+                        nw, ns = cls._rule(w, g, st, lr, wd, h)
+                        new_ws.append(nw)
+                        new_states.append(ns)
+                return new_ws, new_states
+
+            fn = jax.jit(step, donate_argnums=(0, 1) if donate else ())
+            Optimizer._jit_cache[key] = fn
+        return fn
+
+    @staticmethod
+    def _fused_norm_jitted(n):
+        """Per-bucket Σg² pre-pass for clip_global_norm (f32 accumulate);
+        buckets' partial sums combine on host into the one global scale."""
+        key = ("fused_norm", n)
+        fn = Optimizer._jit_cache.get(key)
+        if fn is None:
+            def sqnorm(gs, rescale):
+                total = jnp.zeros((), jnp.float32)
+                for g in gs:
+                    g32 = g.astype(jnp.float32) * rescale
+                    total = total + jnp.sum(g32 * g32)
+                return total
+
+            fn = jax.jit(sqnorm)
+            Optimizer._jit_cache[key] = fn
+        return fn
+
+    def _sparse_jitted(self, donate=False):
         """Row-sparse lazy update: gather the touched rows, run the SAME
         rule, scatter the deltas back (reference: the row_sparse kernels
         in src/operator/optimizer_op.cc). Out-of-range indices (the
@@ -167,7 +308,7 @@ class Optimizer:
         scatter by XLA, so padded slots are no-ops; index arrays are
         padded to power-of-two buckets to bound recompiles."""
         cls = type(self)
-        key = (cls, self.clip_gradient, "row_sparse")
+        key = (cls, self.clip_gradient, "row_sparse", donate)
         fn = Optimizer._jit_cache.get(key)
         if fn is None:
             clip = self.clip_gradient
@@ -196,7 +337,7 @@ class Optimizer:
                     state, ns_rows)
                 return new_w, new_state
 
-            fn = jax.jit(step)
+            fn = jax.jit(step, donate_argnums=(0, 3) if donate else ())
             Optimizer._jit_cache[key] = fn
         return fn
 
@@ -229,18 +370,24 @@ class Optimizer:
                 [vals, jnp.zeros((pad,) + vals.shape[1:], vals.dtype)])
         state_data = jax.tree_util.tree_map(
             _unwrap, state, is_leaf=lambda x: isinstance(x, NDArray))
-        new_w, new_state = self._sparse_jitted()(
+        donate = _donate_enabled() and _donation_safe(
+            (weight._data, state_data), (vals, idx))
+        new_w, new_state = self._sparse_jitted(donate)(
             weight._data, vals, idx, state_data, lr, wd, hyper)
+        _telemetry.record_update_dispatch(
+            "sparse",
+            _donated_bytes(weight._data, state_data) if donate else 0)
         weight._data = new_w
         weight._version += 1
         _write_state(state, new_state)
 
     # -- public update ----------------------------------------------------
     def update(self, index, weight, grad, state):
-        """Single-param update; index/weight/grad may be lists (fused loop)."""
+        """Single-param update; list inputs take the fused bucketed step
+        (one donated dispatch per dtype bucket — docs/performance.md)."""
         if isinstance(index, (list, tuple)):
-            for i, w, g, s in zip(index, weight, grad, state):
-                self.update(i, w, g, s)
+            self._update_list(index, weight, grad, state,
+                              multi_precision=False)
             return
         from ..ndarray.sparse import RowSparseNDArray
 
@@ -255,16 +402,120 @@ class Optimizer:
         hyper["t"] = self._index_update_count[index]
         state_data = jax.tree_util.tree_map(
             _unwrap, state, is_leaf=lambda x: isinstance(x, NDArray))
-        new_w, new_state = self._jitted()(
+        donate = _donate_enabled() and _donation_safe(
+            (weight._data, state_data), (grad._data,))
+        new_w, new_state = self._jitted(donate)(
             weight._data, grad._data, state_data, lr, wd, hyper)
+        _telemetry.record_update_dispatch(
+            "per_param",
+            _donated_bytes(weight._data, state_data) if donate else 0)
         weight._data = new_w
         weight._version += 1
         _write_state(state, new_state)
 
+    def _update_list(self, index, weight, grad, state, multi_precision):
+        from .. import env as _env
+
+        if _env.get("MXTPU_FUSED_UPDATE") and self._supports_fused():
+            self.update_fused(index, weight, grad, state,
+                              multi_precision=multi_precision)
+            return
+        for i, w, g, s in zip(index, weight, grad, state):
+            if multi_precision:
+                self.update_multi_precision(i, w, g, s)
+            else:
+                self.update(i, w, g, s)
+
+    def update_fused(self, index, weight, grad, state,
+                     multi_precision=False):
+        """Fused multi-tensor update: ONE donated jit dispatch per
+        (weight dtype, multi-precision) bucket covering the whole list —
+        rescale → global-norm clip → per-element clip → `_rule` — with
+        per-param lr/wd/t as weak scalars so an LR schedule never
+        retraces. Sparse grads peel off to the legacy per-param path;
+        numerics match the per-param loop bitwise (same op order, same
+        dtype promotion)."""
+        from ..ndarray.sparse import RowSparseNDArray
+
+        dense = []
+        for i, w, g, s in zip(index, weight, grad, state):
+            if isinstance(g, RowSparseNDArray):
+                if multi_precision:
+                    self.update_multi_precision(i, w, g, s)
+                else:
+                    self.update(i, w, g, s)
+                continue
+            dense.append((i, w, g, s))
+        # resolve hyperparams in list order so num_update-driven
+        # schedules see exactly the legacy per-param sequence
+        buckets = {}
+        for i, w, g, s in dense:
+            self._update_count(i)
+            lr, wd = self._get_lr(i), self._get_wd(i)
+            t = self._index_update_count[i]
+            use_mp = (multi_precision
+                      and isinstance(s, tuple) and len(s) == 2
+                      and isinstance(s[0], NDArray)
+                      and s[0].dtype == _np.float32
+                      and w.dtype != _np.float32)
+            buckets.setdefault((str(w.dtype), use_mp), []).append(
+                (i, w, g, s, lr, wd, t))
+        if not buckets:
+            return
+        hyper = dict(self._hyper())
+        hyper["rescale_grad"] = self.rescale_grad
+        scale = 1.0
+        if self.clip_global_norm is not None:
+            sq = 0.0
+            for items in buckets.values():
+                nfn = self._fused_norm_jitted(len(items))
+                sq += float(nfn([it[2]._data for it in items],
+                                self.rescale_grad))
+                _telemetry.record_update_dispatch("fused_norm")
+            gnorm = sq ** 0.5
+            if gnorm > self.clip_global_norm:
+                scale = self.clip_global_norm / gnorm
+        donate_env = _donate_enabled()
+        for (dtype_s, use_mp), items in buckets.items():
+            ws = [it[1]._data for it in items]
+            gs = [it[2]._data for it in items]
+            sts = [jax.tree_util.tree_map(
+                _unwrap, it[3], is_leaf=lambda x: isinstance(x, NDArray))
+                for it in items]
+            lrs = tuple(it[4] for it in items)
+            wds = tuple(it[5] for it in items)
+            ts = tuple(it[6] for it in items)
+            donate = donate_env and _donation_safe((ws, sts), (gs,))
+            fn = self._fused_jitted(len(items), use_mp, donate)
+            before = _cache_size(fn)
+            with _spans.span("fused_update", cat="optimizer"), \
+                    _watchdog.guard("fused_update"):
+                new_ws, new_sts = fn(ws, sts, gs, lrs, wds, ts, scale,
+                                     hyper)
+            _telemetry.record_update_dispatch(
+                "fused", _donated_bytes(ws, sts) if donate else 0)
+            _telemetry.record_fused_bucket("update", len(items))
+            after = _cache_size(fn)
+            if after is not None and after != before:
+                variant = (f"{type(self).__name__.lower()}-n{len(items)}"
+                           f"-{dtype_s}-mp{int(use_mp)}")
+                _telemetry.record_trace("fused_update", variant)
+                from ..diagnostics import introspect as _introspect
+
+                _introspect.capture_compile(
+                    "fused_update", variant, fn,
+                    (_specs(ws), _specs(sts), _specs(gs), lrs, wds, ts,
+                     scale, hyper))
+            for it, nw, ns in zip(items, new_ws, new_sts):
+                w, s = it[1], it[3]
+                w._data = nw
+                w._version += 1
+                _write_state(s, ns)
+
     def update_multi_precision(self, index, weight, grad, state):
         if isinstance(index, (list, tuple)):
-            for i, w, g, s in zip(index, weight, grad, state):
-                self.update_multi_precision(i, w, g, s)
+            self._update_list(index, weight, grad, state,
+                              multi_precision=True)
             return
         use_mp = (
             isinstance(state, tuple)

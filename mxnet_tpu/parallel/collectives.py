@@ -33,8 +33,8 @@ from ..diagnostics import spans as _spans
 from ..diagnostics import watchdog as _watchdog
 from ..telemetry import instruments as _telemetry
 
-__all__ = ["psum_tree", "allreduce_mean", "all_gather", "reduce_scatter",
-           "ring_permute", "axis_size"]
+__all__ = ["psum_tree", "psum_tree_flat", "allreduce_mean", "all_gather",
+           "reduce_scatter", "ring_permute", "axis_size"]
 
 
 def axis_size(axis_name):
@@ -75,6 +75,77 @@ def psum_tree(tree, mesh, axis="dp"):
     _telemetry.record_collective("psum", _tree_bytes(tree),
                                  time.perf_counter() - t0)
     return out
+
+
+def _flat_buckets(leaves, cap_bytes):
+    """Partition leaf indices into dtype-homogeneous buckets of roughly
+    `cap_bytes` each (order-preserving within a dtype). A leaf larger
+    than the cap gets its own bucket — never split, never dropped."""
+    buckets, open_by_dtype = [], {}
+    for i, leaf in enumerate(leaves):
+        nb = _telemetry.nbytes_of(leaf)
+        cur = open_by_dtype.get(leaf.dtype)
+        if cur is not None and cur[1] + nb <= cap_bytes:
+            cur[0].append(i)
+            open_by_dtype[leaf.dtype] = (cur[0], cur[1] + nb)
+        else:
+            fresh = [i]
+            buckets.append(fresh)
+            open_by_dtype[leaf.dtype] = (fresh, nb)
+    return buckets
+
+
+_flat_jit_cache = {}
+
+
+def psum_tree_flat(tree, mesh, axis="dp", bucket_mb=None):
+    """Bucketed flat allreduce of a pytree (the DDP-style multi-tensor
+    path): leaves are flattened and concatenated into dtype-homogeneous
+    buffers of ~`bucket_mb` MB, ONE ``lax.psum`` launches per buffer, and
+    the buffer is split back to the original leaf shapes inside the SAME
+    jitted shard_map — so a whole gradient tree costs O(buckets)
+    collectives (typically 1-3) instead of O(leaves), with no extra
+    dispatch for pack/unpack. Semantics match :func:`psum_tree`.
+    `bucket_mb` defaults to ``MXTPU_FUSED_BUCKET_MB``.
+    """
+    leaves, treedef = jax.tree_util.tree_flatten(tree)
+    if not leaves:
+        return tree
+    if bucket_mb is None:
+        from .. import env as _env
+
+        bucket_mb = _env.get("MXTPU_FUSED_BUCKET_MB")
+    buckets = _flat_buckets(leaves, int(bucket_mb) << 20)
+    sig = (id(mesh), tuple(mesh.shape.items()), axis, int(bucket_mb),
+           treedef, tuple((x.shape, str(x.dtype)) for x in leaves))
+    fn = _flat_jit_cache.get(sig)
+    if fn is None:
+        @partial(shard_map, mesh=mesh, in_specs=(P(axis),), out_specs=P())
+        def _reduce(ls):
+            outs = [None] * len(ls)
+            for bucket in buckets:
+                flat = (ls[bucket[0]].reshape(-1) if len(bucket) == 1
+                        else jnp.concatenate(
+                            [ls[i].reshape(-1) for i in bucket]))
+                red = jax.lax.psum(flat, axis)
+                off = 0
+                for i in bucket:
+                    n = ls[i].size
+                    outs[i] = red[off:off + n].reshape(ls[i].shape)
+                    off += n
+            return outs
+
+        fn = jax.jit(_reduce)
+        _flat_jit_cache[sig] = fn
+    t0 = time.perf_counter()
+    with _spans.span("psum_flat", cat="collective"), \
+            _watchdog.guard("psum_flat"):
+        outs = fn(leaves)
+    _telemetry.record_collective("psum_flat", _tree_bytes(leaves),
+                                 time.perf_counter() - t0)
+    for bucket in buckets:
+        _telemetry.record_fused_bucket("allreduce", len(bucket))
+    return jax.tree_util.tree_unflatten(treedef, outs)
 
 
 def allreduce_mean(tree, mesh, axis="dp"):

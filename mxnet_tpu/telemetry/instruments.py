@@ -26,6 +26,8 @@ __all__ = [
     "collective_seconds_total",
     "step_total", "step_time_seconds", "examples_per_second",
     "mfu_ratio", "flops_per_step", "peak_flops",
+    "update_dispatch_total", "fused_bucket_size", "update_donated_bytes",
+    "record_update_dispatch", "record_fused_bucket",
     "compile_flops", "compile_peak_hbm_bytes", "device_memory_bytes",
     "serve_request_total", "serve_request_latency_seconds",
     "serve_queue_depth", "serve_in_flight",
@@ -48,6 +50,7 @@ _SYNC_BUCKETS = (.0001, .001, .01, .1, 1.0, 10.0)  # noqa: F841 (doc aid)
 _SERVE_LATENCY_BUCKETS = (.0005, .001, .0025, .005, .01, .025, .05, .1,
                           .25, .5, 1.0, 2.5, 5.0, 10.0)
 _SERVE_BATCH_BUCKETS = (1, 2, 4, 8, 16, 32, 64, 128, 256, 512)
+_FUSED_BUCKETS = (1, 2, 4, 8, 16, 32, 64, 128, 256, 512, 1024)
 
 # -- compiles ---------------------------------------------------------------
 jit_compile_total = counter(
@@ -129,6 +132,23 @@ flops_per_step = gauge(
     "flops_per_step", "Declared per-step FLOP budget (set_flop_budget)")
 peak_flops = gauge(
     "peak_flops", "Declared accelerator peak FLOP/s (set_flop_budget)")
+
+# -- optimizer update dispatch (optimizer/optimizer.py; gluon/trainer.py) ---
+update_dispatch_total = counter(
+    "update_dispatch_total",
+    "Optimizer update jit dispatches by path: fused (one per bucket per "
+    "step), fused_norm (global-norm pre-pass), per_param (legacy "
+    "fallback), sparse (row_sparse lazy update)", ["path"])
+fused_bucket_size = histogram(
+    "fused_bucket_size",
+    "Parameters packed into each fused dispatch bucket, by site "
+    "(update = fused optimizer step, allreduce = flat-buffer collective)",
+    ["site"], buckets=_FUSED_BUCKETS)
+update_donated_bytes = counter(
+    "update_donated_bytes",
+    "Bytes of weight/optimizer-state buffers donated into update "
+    "dispatches — XLA reuses them in place instead of allocating fresh "
+    "HBM for the outputs")
 
 
 # -- serving (serving/engine.py; docs/serving.md) ---------------------------
@@ -255,6 +275,24 @@ def set_flop_budget(flops, peak=None):
     cost_analysis as tools/perf_lab.py measures it."""
     flops_per_step.set(flops)
     peak_flops.set(peak if peak is not None else DEFAULT_PEAK_FLOPS)
+
+
+def record_update_dispatch(path, donated_bytes=0):
+    """One optimizer-update jit dispatch on `path` (fused / fused_norm /
+    per_param / sparse); `donated_bytes` counts the weight/state buffers
+    handed to XLA for in-place reuse."""
+    if not REGISTRY.enabled:
+        return
+    update_dispatch_total.labels(path).inc()
+    if donated_bytes:
+        update_donated_bytes.inc(donated_bytes)
+
+
+def record_fused_bucket(site, params):
+    """One fused bucket dispatched at `site` holding `params` parameters."""
+    if not REGISTRY.enabled:
+        return
+    fused_bucket_size.labels(site).observe(params)
 
 
 def observe_step(seconds=None, examples=None):

@@ -133,14 +133,17 @@ def test_p3_small_tensor_delegates(monkeypatch):
     onp.testing.assert_allclose(outs[0].asnumpy(), 2 * onp.ones((4, 4)))
 
 
-def test_trainer_issues_pushpull_in_priority_order():
-    """allreduce_grads must dispatch high-priority (low-index) params
-    first — the P3 dispatch-order contract."""
+def test_trainer_issues_pushpull_in_priority_order(monkeypatch):
+    """With the fused path opted out, allreduce_grads must dispatch
+    high-priority (low-index) params first — the P3 dispatch-order
+    contract. (The fused default batches all params into one list-form
+    pushpull instead; see test_fused_update.py.)"""
     import numpy as onp
 
     from mxnet_tpu import autograd, gluon
     from mxnet_tpu.kvstore.base import KVStoreBase
 
+    monkeypatch.setenv("MXTPU_FUSED_UPDATE", "0")
     order = []
 
     class RecordingStore(KVStoreBase):

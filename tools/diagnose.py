@@ -55,6 +55,53 @@ def _train(steps, batch, hidden):
     return net
 
 
+def _fused_buckets():
+    """Fused-update bucket composition from the compile registry: each
+    `fused_update` entry's variant encodes `{opt}-n{params}-{dtype}-mp{
+    0|1}` (optimizer/optimizer.py update_fused), so the registry doubles
+    as a record of how the parameter tree was bucketed."""
+    from mxnet_tpu import diagnostics
+
+    out = []
+    for (block, variant), e in sorted(diagnostics.compile_registry()
+                                      .items()):
+        if block != "fused_update":
+            continue
+        info = {"variant": variant}
+        parts = variant.split("-")
+        try:
+            info.update(optimizer=parts[0], params=int(parts[1][1:]),
+                        dtype=parts[2],
+                        multi_precision=parts[3] == "mp1")
+        except (IndexError, ValueError):
+            pass
+        for k in ("flops", "bytes_accessed", "peak_bytes"):
+            if isinstance(e, dict) and e.get(k) is not None:
+                info[k] = e[k]
+        out.append(info)
+    return out
+
+
+def _fused_report_lines(buckets):
+    lines = ["", "== fused update buckets =="]
+    if not buckets:
+        lines.append("  (none captured — legacy per-param path, or "
+                     "MXTPU_DIAG_COMPILE=0)")
+        return lines
+    for b in buckets:
+        desc = f"  {b['variant']}:"
+        if "params" in b:
+            desc += f" {b['params']} params"
+        if "dtype" in b:
+            desc += f", {b['dtype']}"
+        if b.get("multi_precision"):
+            desc += ", multi-precision"
+        if "flops" in b:
+            desc += f", {b['flops']:.3g} flops"
+        lines.append(desc)
+    return lines
+
+
 def main(argv=None):
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     ap.add_argument("--steps", type=int, default=3)
@@ -94,11 +141,13 @@ def main(argv=None):
             "step_table": {str(k): v
                            for k, v in diagnostics.step_table().items()},
             "compile_registry": reg,
+            "fused_buckets": _fused_buckets(),
             "device_memory": diagnostics.device_memory(),
             "telemetry": telemetry.dump(),
         }, default=str))
     else:
         print(diagnostics.report())
+        print("\n".join(_fused_report_lines(_fused_buckets())))
 
 
 if __name__ == "__main__":
