@@ -432,6 +432,52 @@ def bench_trainer_update_ms(platform, steps=50):
     return (time.perf_counter() - t0) / steps * 1000.0
 
 
+def bench_ckpt_save_ms(platform, saves=3):
+    """Milliseconds per committed checkpoint of ResNet-50-sized training
+    state (161 param tensors + SGD-momentum state, ~205 MB of f32)
+    through the async engine path: CheckpointManager.save() + flush(),
+    capture through fsync'd rename (docs/checkpointing.md). Lower is
+    better; the >3% regression gate applies via the _ms suffix."""
+    import shutil
+    import tempfile
+
+    import numpy as onp
+
+    import mxnet_tpu as mx
+    from mxnet_tpu import gluon
+
+    mx.seed(0)
+    rs = onp.random.RandomState(0)
+    params = []
+    for k, shape in enumerate(_resnet50_param_shapes()):
+        p = gluon.Parameter(f"p{k}", shape=shape)
+        p.initialize()
+        params.append(p)
+    trainer = gluon.Trainer(params, "sgd",
+                            {"learning_rate": 0.01, "momentum": 0.9})
+    for p in params:
+        g = p.grad()
+        g._data = mx.np.array(
+            rs.standard_normal(p.shape).astype("f"))._data
+        g._version += 1
+    trainer.update(1)   # materialize momentum state
+    params[0].data().asnumpy()
+
+    ckdir = tempfile.mkdtemp(prefix="bench-ckpt-")
+    try:
+        mgr = mx.checkpoint.CheckpointManager(
+            ckdir, trainer, keep_last=1, async_save=True)
+        mgr.save(step=0)
+        mgr.flush()     # warm: page cache, npz codepaths
+        t0 = time.perf_counter()
+        for s in range(1, saves + 1):
+            mgr.save(step=s)
+            mgr.flush()
+        return (time.perf_counter() - t0) / saves * 1000.0
+    finally:
+        shutil.rmtree(ckdir, ignore_errors=True)
+
+
 def bench_serving_qps(platform, clients=8, requests=40):
     """Serving-engine round-trip QPS: `clients` threads hammering one
     dynamically-batching InferenceEngine through warmup()ed buckets
@@ -610,6 +656,22 @@ def main():
                     "(docs/serving.md)"})
     except Exception as e:
         rows.append({"metric": "inference_qps", "error": str(e)})
+
+    # checkpoint commit latency runs on every platform (host-side work:
+    # capture + npz + fsync + rename); _ms suffix → lower-is-better gate
+    try:
+        if over_budget():
+            raise TimeoutError("bench budget exhausted")
+        ck_ms = bench_ckpt_save_ms(platform)
+        rows.append({
+            "metric": "ckpt_save_ms" + suffix,
+            "value": round(ck_ms, 3), "unit": "ms",
+            "note": "mean of 3 committed CheckpointManager saves of "
+                    "ResNet-50-sized state (161 tensors + momentum, "
+                    "async engine path, save+flush through fsync'd "
+                    "rename; docs/checkpointing.md)"})
+    except Exception as e:
+        rows.append({"metric": "ckpt_save_ms", "error": str(e)})
 
     result_extra = {}
     try:

@@ -107,6 +107,7 @@ from . import diagnostics  # noqa: E402  (spans/compile introspection/watchdog)
 from . import test_utils  # noqa: E402  (mx.test_utils like the reference)
 from . import amp  # noqa: E402  (mx.amp — reference: python/mxnet/amp/)
 from . import serving  # noqa: E402  (batching inference engine; docs/serving.md)
+from . import checkpoint  # noqa: E402  (atomic snapshots; docs/checkpointing.md)
 
 waitall = engine.waitall
 

@@ -12,6 +12,13 @@ mutate the path var, so
     path land, and a failed write's exception is rethrown there
     (deferred-exception semantics, threaded_engine.cc:440).
 Falls back to synchronous writes when the native engine is unavailable.
+
+`async_run` generalizes the same path-serialized IO contract to any
+callable — checkpoint/manager.py chains payload-write then manifest+
+rename commit ops on one var so the commit can never overtake the write.
+Failed ops keep their ORIGINAL exception object (traceback intact);
+`wait_for_path`/`flush_all` re-raise it, with the engine's stringly
+reconstruction attached as ``__context__``.
 """
 from __future__ import annotations
 
@@ -20,10 +27,12 @@ import threading
 
 import numpy as _np
 
-__all__ = ["async_save_npz", "wait_for_path"]
+__all__ = ["async_save_npz", "async_run", "wait_for_path", "flush_all",
+           "pending_error"]
 
 _path_vars = {}
-_pending = {}    # key -> queued-but-unfinished write count
+_pending = {}    # key -> queued-but-unfinished op count
+_errors = {}     # key -> first failed op's ORIGINAL exception (tb attached)
 _lock = threading.Lock()
 
 
@@ -32,30 +41,29 @@ def _key(path):
     return os.path.abspath(str(path))
 
 
-def async_save_npz(path, arrays):
-    """Write `arrays` (name -> numpy) to `path` as .npz via the engine.
-
-    Returns immediately; the write runs on an engine IO thread. Call
-    wait_for_path(path) (or engine.waitall()) to barrier."""
+def async_run(path, fn):
+    """Run `fn()` on an engine IO thread, serialized with every other op
+    queued on `path` (same engine var -> same order as queued). Returns
+    immediately; `wait_for_path(path)` barriers and rethrows a failed
+    op's original exception. Runs synchronously (exceptions raise
+    inline) when the native engine is unavailable or naive."""
     from . import engine
-    from ._dtype_codec import encode_payload
 
-    path = _key(path)  # bind the directory at save time, not flush time
-    arrays = encode_payload(arrays)  # bf16/f8 -> uint view + dtype sidecar
-
-    def write():
-        with open(path, "wb") as f:
-            _np.savez(f, **arrays)
-
+    key = _key(path)
     eng = engine.native_engine()
     if eng is None or engine.is_naive():
-        write()  # synchronous fallback (no var allocated)
+        fn()  # synchronous fallback (no var allocated)
         return
-    key = _key(path)
 
-    def write_and_count():
+    def run_and_count():
         try:
-            write()
+            fn()
+        except BaseException as e:
+            with _lock:
+                # keep the FIRST failure per path; a later success does
+                # not unrecord it (the op sequence is already broken)
+                _errors.setdefault(key, e)
+            raise
         finally:
             with _lock:
                 _pending[key] -= 1
@@ -68,24 +76,90 @@ def async_save_npz(path, arrays):
             var = eng.new_var()
             _path_vars[key] = var
         _pending[key] = _pending.get(key, 0) + 1
-        engine.push(write_and_count, mutable_vars=(var,), io=True)
+        engine.push(run_and_count, mutable_vars=(var,), io=True)
+
+
+def async_save_npz(path, arrays):
+    """Write `arrays` (name -> numpy) to `path` as .npz via the engine.
+
+    Returns immediately; the write runs on an engine IO thread. Call
+    wait_for_path(path) (or engine.waitall()) to barrier."""
+    from ._dtype_codec import encode_payload
+
+    path = _key(path)  # bind the directory at save time, not flush time
+    arrays = encode_payload(arrays)  # bf16/f8 -> uint view + dtype sidecar
+
+    def write():
+        with open(path, "wb") as f:
+            _np.savez(f, **arrays)
+
+    async_run(path, write)
+
+
+def pending_error(path):
+    """The first recorded failure for `path`'s op chain (or None). Does
+    not consume the record — checkpoint commit ops peek at this to skip
+    committing on top of a failed payload write."""
+    with _lock:
+        return _errors.get(_key(path))
+
+
+def _take_error(key):
+    with _lock:
+        return _errors.pop(key, None)
 
 
 def wait_for_path(path):
-    """Block until pending writes to `path` complete; rethrows a failed
-    write's deferred exception (reference: WaitForVar)."""
+    """Block until pending ops on `path` complete; rethrows a failed
+    op's exception (reference: WaitForVar) — the ORIGINAL exception
+    object, so the IO thread's traceback survives and `except <Type>`
+    clauses see the real type, with the engine's reconstructed error
+    chained as context."""
     from . import engine
 
+    key = _key(path)
     eng = engine.native_engine()
     if eng is None:
+        err = _take_error(key)
+        if err is not None:
+            raise err
         return
-    key = _key(path)
     with _lock:
         var = _path_vars.get(key)
     if var is None:
+        err = _take_error(key)
+        if err is not None:
+            raise err
         return
-    engine.wait_for_var(var)  # concurrent waiters all block here
+    try:
+        engine.wait_for_var(var)  # concurrent waiters all block here
+    except Exception as native_exc:
+        err = _take_error(key)
+        if err is not None:
+            raise err from native_exc
+        raise
+    err = _take_error(key)
+    if err is not None:
+        raise err
     _reap(key, var)
+
+
+def flush_all():
+    """Barrier EVERY path with pending ops (the preemption handler's
+    pre-exit fence: an emergency snapshot must not exit before earlier
+    epoch-stamped saves land). Waits all paths even when one fails;
+    re-raises the first failure afterwards."""
+    with _lock:
+        keys = list(_path_vars)
+    first = None
+    for k in keys:
+        try:
+            wait_for_path(k)
+        except Exception as e:  # noqa: PERF203 — keep draining the rest
+            if first is None:
+                first = e
+    if first is not None:
+        raise first
 
 
 def _reap(key, var):

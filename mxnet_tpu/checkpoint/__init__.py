@@ -1,0 +1,24 @@
+"""Fault-tolerant checkpointing: atomic async snapshots, retention,
+preemption handling, and exact training resume (docs/checkpointing.md).
+
+    mgr = mx.checkpoint.CheckpointManager("ckpts", trainer, keep_last=5)
+    mx.checkpoint.install_preemption_handler(mgr)
+    for step in range(...):
+        ...
+        if step % 100 == 0:
+            mgr.save(step, user_state={"epoch": epoch, "batch": batch})
+    # after a crash / preemption:
+    result = mgr.restore()          # latest committed, checksum-verified
+    start = result.step + 1
+"""
+from __future__ import annotations
+
+from .errors import CheckpointCorrupt, CheckpointError, CheckpointNotFound
+from .manager import CheckpointManager, RestoreResult, verify_checkpoint
+from .preemption import PreemptionHandler, install_preemption_handler
+
+__all__ = [
+    "CheckpointManager", "RestoreResult", "verify_checkpoint",
+    "PreemptionHandler", "install_preemption_handler",
+    "CheckpointError", "CheckpointCorrupt", "CheckpointNotFound",
+]

@@ -1,0 +1,26 @@
+"""Typed checkpoint errors.
+
+Callers branch on these: NotFound means "cold start, begin at step 0";
+Corrupt means "this checkpoint is damaged" — restore() treats the two
+very differently (a corrupt *latest* falls back to the previous
+committed step; an explicitly requested step does not silently
+substitute another).
+"""
+from __future__ import annotations
+
+from ..base import MXNetError
+
+__all__ = ["CheckpointError", "CheckpointNotFound", "CheckpointCorrupt"]
+
+
+class CheckpointError(MXNetError):
+    """Base for checkpoint subsystem failures."""
+
+
+class CheckpointNotFound(CheckpointError):
+    """No committed checkpoint exists (at the requested step, or at all)."""
+
+
+class CheckpointCorrupt(CheckpointError):
+    """A committed checkpoint failed validation (missing files, manifest
+    mismatch, or per-array checksum failure)."""

@@ -42,9 +42,11 @@ __all__ = [
 
 # the phase vocabulary step_table pivots on (free-form cats still record;
 # they land in the 'other' column). "serve" is the serving engine's
-# batch-execution phase (serving/engine.py; docs/serving.md).
+# batch-execution phase (serving/engine.py; docs/serving.md);
+# "checkpoint" covers snapshot capture/restore and preemption saves
+# (checkpoint/manager.py; docs/checkpointing.md).
 PHASES = ("data", "fwd", "bwd", "collective", "optimizer", "sync",
-          "compile", "serve")
+          "compile", "checkpoint", "serve")
 
 _enabled = os.environ.get("MXTPU_DIAGNOSTICS", "1") != "0"
 

@@ -160,12 +160,24 @@ class ValidationHandler(TrainBegin, BatchEnd, EpochEnd):
 
 
 class CheckpointHandler(TrainBegin, BatchEnd, EpochEnd):
-    """Periodic / best-only checkpointing (reference: CheckpointHandler:336)."""
+    """Periodic / best-only checkpointing (reference: CheckpointHandler:336).
+
+    Two backends:
+      * legacy (default): `net.save_parameters` + `trainer.save_states`
+        file pairs with simple rotation — the reference's behavior;
+      * `manager=`: a `mx.checkpoint.CheckpointManager` — every periodic
+        save becomes an atomic manifest checkpoint (params + optimizer +
+        RNG + epoch/batch cursor in user_state), retention moves to the
+        manager, and `resume_from_checkpoint=True` actually resumes:
+        train_begin restores the latest committed checkpoint and fast-
+        forwards the epoch/batch counters (a cold directory is not an
+        error — training just starts fresh).
+    """
 
     def __init__(self, model_dir, model_prefix="model", monitor=None,
                  verbose=0, save_best=False, mode="auto", epoch_period=1,
                  batch_period=None, max_checkpoints=5,
-                 resume_from_checkpoint=False):  # noqa: ARG002
+                 resume_from_checkpoint=False, manager=None):
         self.model_dir = model_dir
         self.model_prefix = model_prefix
         self.monitor = monitor
@@ -174,6 +186,8 @@ class CheckpointHandler(TrainBegin, BatchEnd, EpochEnd):
         self.batch_period = batch_period
         self.max_checkpoints = max_checkpoints
         self.verbose = verbose
+        self.resume_from_checkpoint = resume_from_checkpoint
+        self.manager = manager
         self.current_epoch = 0
         self.current_batch = 0
         self.best = None
@@ -185,7 +199,36 @@ class CheckpointHandler(TrainBegin, BatchEnd, EpochEnd):
         self.saved = []
         os.makedirs(model_dir, exist_ok=True)
 
+    def train_begin(self, estimator, *args, **kwargs):
+        if self.manager is None or not self.resume_from_checkpoint:
+            return
+        from ....checkpoint import CheckpointNotFound
+
+        self.manager.bind(estimator.trainer)
+        try:
+            result = self.manager.restore()
+        except CheckpointNotFound:
+            return  # cold start: nothing committed yet
+        cursor = result.user_state or {}
+        self.current_epoch = int(cursor.get("epoch", self.current_epoch))
+        self.current_batch = int(cursor.get("batch", self.current_batch))
+        logging.getLogger("mxnet_tpu.estimator").info(
+            "Resumed from checkpoint step %d (epoch %d, batch %d)",
+            result.step, self.current_epoch, self.current_batch)
+
     def _save(self, estimator, tag, rotate=True):
+        if self.manager is not None:
+            # manager path: one atomic checkpoint carries params + states
+            # + RNG + cursor; retention/rotation is the manager's job.
+            # 'best' still goes through the legacy file pair below so it
+            # can never be rotated away by keep_last.
+            if rotate:
+                self.manager.bind(estimator.trainer)
+                self.manager.save(
+                    step=self.current_batch,
+                    user_state={"epoch": self.current_epoch,
+                                "batch": self.current_batch, "tag": tag})
+                return
         path = os.path.join(self.model_dir,
                             f"{self.model_prefix}-{tag}.params")
         estimator.net.save_parameters(path)

@@ -209,8 +209,30 @@ class Trainer:
                 p.zero_grad()
 
     # -- checkpoint --------------------------------------------------------
+    # (For complete atomic checkpoints — params + states + RNG + resume —
+    # use mx.checkpoint.CheckpointManager; these two round-trip ONLY the
+    # optimizer side, the reference save_states/load_states contract.)
+    def _stale_indices(self):
+        """Param indices whose grad buffer is currently STALE (untouched
+        since its last update) — the portable form of _grad_versions,
+        whose raw buffer versions are process-local."""
+        stale = []
+        for i, p in enumerate(self._params):
+            if p.grad_req == "null" or p._data_map is None:
+                continue
+            grads = p.list_grad()
+            if grads and self._grad_versions.get(i) == grads[0]._version:
+                stale.append(i)
+        return stale
+
     def save_states(self, fname):
-        """Serialize optimizer states (reference: trainer.py:489)."""
+        """Serialize optimizer states (reference: trainer.py:489).
+
+        Format 2 additionally round-trips the fused/legacy-shared state
+        bookkeeping (per-param update counts `t`), stale-grad tracking,
+        loss scale, and per-param (name, dtype) so load_states can
+        reject a payload from a different model instead of mis-zipping.
+        """
         def to_np(s):
             if s is None:
                 return None
@@ -219,18 +241,44 @@ class Trainer:
             return [to_np(x) for x in s]
 
         payload = {
+            "format": 2,
             "states": [to_np(s) for s in self._states],
             "created": list(self._states_created),
-            "num_update": self._optimizer.num_update,
+            "num_update": self._optimizer.num_update,  # format-1 readers
+            "optimizer": self._optimizer.bookkeeping_state(),
+            "param_meta": [
+                (p.name, str(p.dtype) if p.dtype is not None else None)
+                for p in self._params],
+            "stale": self._stale_indices(),
+            "scale": self._scale,
         }
         with open(fname, "wb") as f:
             pickle.dump(payload, f)
 
     def load_states(self, fname):
+        """Inverse of save_states. Raises ValueError (clear message, no
+        state touched) when the payload's param count or dtypes don't
+        match this trainer. Format-1 payloads still load."""
         import jax.numpy as jnp
 
         with open(fname, "rb") as f:
             payload = pickle.load(f)
+
+        states = payload["states"]
+        if len(states) != len(self._params):
+            raise ValueError(
+                f"optimizer-state payload {fname!r} holds "
+                f"{len(states)} parameter states but this trainer has "
+                f"{len(self._params)} parameters — wrong model or "
+                f"stale checkpoint")
+        for i, (name, dt) in enumerate(payload.get("param_meta") or []):
+            p = self._params[i]
+            have = str(p.dtype) if p.dtype is not None else None
+            if dt is not None and have is not None and dt != have:
+                raise ValueError(
+                    f"optimizer-state payload {fname!r}: param {i} "
+                    f"({name!r}) was saved with dtype {dt}, trainer "
+                    f"param {p.name!r} declares {have}")
 
         def from_np(s):
             if s is None:
@@ -239,6 +287,21 @@ class Trainer:
                 return tuple(from_np(x) for x in s)
             return NDArray(jnp.asarray(s))
 
-        self._states = [from_np(s) for s in payload["states"]]
+        self._states = [from_np(s) for s in states]
         self._states_created = list(payload["created"])
-        self._optimizer.num_update = payload["num_update"]
+        opt_state = payload.get("optimizer")
+        if opt_state is not None:
+            self._optimizer.load_bookkeeping_state(opt_state)
+        else:
+            self._optimizer.num_update = payload["num_update"]
+        if "scale" in payload:
+            self._scale = float(payload["scale"])
+        if "stale" in payload:
+            # re-mark stale grads against THIS process's buffer versions
+            self._grad_versions = {}
+            for i in payload["stale"]:
+                p = self._params[i]
+                if p.grad_req != "null" and p._data_map is not None:
+                    grads = p.list_grad()
+                    if grads:
+                        self._grad_versions[i] = grads[0]._version

@@ -265,6 +265,21 @@ class TPUDist(KVStoreBase):
         gathered = multihost_utils.process_allgather(x)
         return jnp.sum(jnp.asarray(gathered), axis=0)
 
+    def barrier(self):
+        """Block until every worker reaches this point (a trivial
+        collective — process_allgather completes only once all
+        processes contribute). Single-process: no-op. Used by the
+        checkpoint manager to fence rank-0 commits (docs/checkpointing
+        .md); must run on the main thread like any collective."""
+        if self.num_workers <= 1:
+            return
+        t0 = time.perf_counter()
+        with _spans.span("kv.barrier", cat="collective"), \
+                _watchdog.guard("kv.barrier"):
+            self._cross_process_sum(jnp.zeros((1,), jnp.float32))
+        _telemetry.record_collective(
+            "barrier", 4, time.perf_counter() - t0)
+
     def broadcast(self, key, value, out, priority=0):  # noqa: ARG002
         t0 = time.perf_counter()
         vals = _aslist(value)

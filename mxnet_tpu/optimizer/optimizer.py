@@ -159,6 +159,28 @@ class Optimizer:
         self.num_update = max(self.num_update,
                               self._index_update_count[index])
 
+    # -- checkpoint bookkeeping ------------------------------------------
+    def bookkeeping_state(self):
+        """JSON-able schedule state: `num_update` drives lr_scheduler and
+        the per-param counts are each param's `t` (Adam bias correction).
+        Omitting these from a checkpoint silently restarts schedules —
+        resume would NOT be bitwise-identical."""
+        return {
+            "num_update": int(self.num_update),
+            "index_update_count": {
+                int(k): int(v) for k, v in self._index_update_count.items()
+            },
+        }
+
+    def load_bookkeeping_state(self, state):
+        """Inverse of bookkeeping_state (keys arrive as str after a JSON
+        round-trip)."""
+        self.num_update = int(state.get("num_update", 0))
+        self._index_update_count = {
+            int(k): int(v)
+            for k, v in (state.get("index_update_count") or {}).items()
+        }
+
     def _get_lr(self, index):
         lr = self.learning_rate
         param = self.param_dict.get(index)

@@ -29,6 +29,8 @@ __all__ = [
     "update_dispatch_total", "fused_bucket_size", "update_donated_bytes",
     "record_update_dispatch", "record_fused_bucket",
     "compile_flops", "compile_peak_hbm_bytes", "device_memory_bytes",
+    "ckpt_save_total", "ckpt_save_ms", "ckpt_bytes_total",
+    "ckpt_restore_total", "record_ckpt_save", "record_ckpt_restore",
     "serve_request_total", "serve_request_latency_seconds",
     "serve_queue_depth", "serve_in_flight",
     "serve_batch_total", "serve_batch_size", "serve_padded_rows_total",
@@ -51,6 +53,8 @@ _SERVE_LATENCY_BUCKETS = (.0005, .001, .0025, .005, .01, .025, .05, .1,
                           .25, .5, 1.0, 2.5, 5.0, 10.0)
 _SERVE_BATCH_BUCKETS = (1, 2, 4, 8, 16, 32, 64, 128, 256, 512)
 _FUSED_BUCKETS = (1, 2, 4, 8, 16, 32, 64, 128, 256, 512, 1024)
+_CKPT_MS_BUCKETS = (1.0, 5.0, 10.0, 25.0, 50.0, 100.0, 250.0, 500.0,
+                    1000.0, 2500.0, 5000.0, 10000.0, 30000.0)
 
 # -- compiles ---------------------------------------------------------------
 jit_compile_total = counter(
@@ -151,6 +155,27 @@ update_donated_bytes = counter(
     "HBM for the outputs")
 
 
+# -- checkpointing (checkpoint/manager.py; docs/checkpointing.md) -----------
+ckpt_save_total = counter(
+    "ckpt_save_total",
+    "Checkpoint saves by mode (replicated / sharded) and outcome "
+    "(ok / error)", ["mode", "outcome"])
+ckpt_save_ms = histogram(
+    "ckpt_save_ms",
+    "Checkpoint save wall time in ms: snapshot capture through commit "
+    "rename (async saves: measured on the IO thread at commit, so this "
+    "is total latency, NOT time the training loop was blocked)",
+    buckets=_CKPT_MS_BUCKETS)
+ckpt_bytes_total = counter(
+    "ckpt_bytes_total",
+    "Bytes of training state committed to checkpoints (this rank's "
+    "share in sharded mode)")
+ckpt_restore_total = counter(
+    "ckpt_restore_total",
+    "Checkpoint restore attempts by outcome (ok / corrupt / not_found / "
+    "error)", ["outcome"])
+
+
 # -- serving (serving/engine.py; docs/serving.md) ---------------------------
 serve_request_total = counter(
     "serve_request_total",
@@ -238,6 +263,24 @@ def record_serve_batch(model, rows, bucket):
     serve_batch_size.labels(model).observe(rows)
     if bucket > rows:
         serve_padded_rows_total.labels(model).inc(bucket - rows)
+
+
+def record_ckpt_save(mode, ms, nbytes, outcome="ok"):
+    """One finished checkpoint save: `ms` capture->commit wall ms,
+    `nbytes` of committed array payload (this rank's share)."""
+    if not REGISTRY.enabled:
+        return
+    ckpt_save_total.labels(mode, outcome).inc()
+    if outcome == "ok":
+        ckpt_save_ms.observe(ms)
+        ckpt_bytes_total.inc(nbytes)
+
+
+def record_ckpt_restore(outcome):
+    """One restore attempt: ok / corrupt / not_found / error."""
+    if not REGISTRY.enabled:
+        return
+    ckpt_restore_total.labels(outcome).inc()
 
 
 def record_fallback(block):

@@ -1,0 +1,148 @@
+"""Worker body for the checkpoint crash/resume subprocess tests
+(pattern: tests/dist_worker.py). A deterministic training loop whose
+data is a pure function of the step index, so a restored process can
+regenerate exactly the batches an uninterrupted run would have seen —
+the precondition for asserting bitwise-identical resume.
+
+Modes (argv[1]):
+  baseline <outdir>          train steps 1..TOTAL, record every loss +
+                             final params
+  kill <outdir> <ckdir>      commit a checkpoint at step CKPT_STEP, train
+                             on, start an ASYNC save wedged open by the
+                             write-begin hook, touch <outdir>/write_started,
+                             then sleep — the parent SIGKILLs mid-write
+  resume <outdir> <ckdir>    restore (expect step CKPT_STEP), train the
+                             remaining steps, record losses + final params
+  preempt <outdir> <ckdir>   install the PreemptionHandler, touch
+                             <outdir>/ready, spin — the parent sends
+                             SIGTERM and expects a clean exit + a
+                             committed 'preempt' checkpoint
+"""
+import os
+import sys
+import time
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as onp  # noqa: E402
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+import mxnet_tpu as mx  # noqa: E402
+from mxnet_tpu import autograd, gluon  # noqa: E402
+
+MODE = sys.argv[1]
+OUTDIR = sys.argv[2]
+CKDIR = sys.argv[3] if len(sys.argv) > 3 else None
+
+TOTAL = 10        # steps in the uninterrupted run
+CKPT_STEP = 4     # last committed step before the crash
+BATCH = 8
+FEATS = 6
+SEED = 42
+
+
+def build():
+    mx.random.seed(SEED)
+    net = gluon.nn.Sequential()
+    net.add(gluon.nn.Dense(16, activation="relu"))
+    net.add(gluon.nn.Dense(1))
+    net.initialize()
+    # adam: stateful (mean+var) AND schedule-dependent (per-param t in
+    # the bias correction) — resume is only bitwise if BOTH survive
+    trainer = gluon.Trainer(net.collect_params(), "adam",
+                            {"learning_rate": 1e-2})
+    return net, trainer
+
+
+def batch_for(step):
+    """The batch for `step`, derived ONLY from the step index."""
+    rs = onp.random.RandomState(1000 + step)
+    x = rs.standard_normal((BATCH, FEATS)).astype("float32")
+    y = rs.standard_normal((BATCH, 1)).astype("float32")
+    return mx.np.array(x), mx.np.array(y)
+
+
+def train_one(net, trainer, step):
+    x, y = batch_for(step)
+    loss_fn = gluon.loss.L2Loss()
+    with autograd.record():
+        loss = loss_fn(net(x), y)
+    loss.backward()
+    trainer.step(BATCH)
+    return onp.float32(loss.asnumpy().sum())
+
+
+def dump(net, losses, steps_done):
+    arrays = {f"loss/{s}": v for s, v in losses.items()}
+    for i, p in enumerate(net.collect_params().values()):
+        arrays[f"param/{i}"] = p.data().asnumpy()
+    arrays["steps_done"] = onp.asarray(steps_done, "int64")
+    onp.savez(os.path.join(OUTDIR, f"{MODE}.npz"), **arrays)
+
+
+def main():
+    net, trainer = build()
+    losses = {}
+
+    if MODE == "baseline":
+        for step in range(1, TOTAL + 1):
+            losses[step] = train_one(net, trainer, step)
+        dump(net, losses, TOTAL)
+        return 0
+
+    if MODE == "kill":
+        from mxnet_tpu.checkpoint import manager as mgr_mod
+
+        mgr = mx.checkpoint.CheckpointManager(CKDIR, trainer, keep_last=5)
+        for step in range(1, CKPT_STEP + 1):
+            losses[step] = train_one(net, trainer, step)
+        mgr.save(step=CKPT_STEP)
+        mgr.flush()                      # committed: the resume target
+        for step in range(CKPT_STEP + 1, CKPT_STEP + 3):
+            losses[step] = train_one(net, trainer, step)
+
+        def wedge(path):                 # runs on the engine IO thread
+            with open(os.path.join(OUTDIR, "write_started"), "w") as f:
+                f.write(path)
+            time.sleep(60)               # parent SIGKILLs us long before
+
+        mgr_mod._WRITE_BEGIN_HOOK = wedge
+        mgr.save(step=CKPT_STEP + 2, sync=False)  # wedged mid-write
+        time.sleep(120)                  # killed here
+        return 1                         # unreachable
+
+    if MODE == "resume":
+        mgr = mx.checkpoint.CheckpointManager(CKDIR, trainer, keep_last=5)
+        result = mgr.restore()
+        assert result.step == CKPT_STEP, \
+            f"resumed from step {result.step}, expected {CKPT_STEP}"
+        for step in range(result.step + 1, TOTAL + 1):
+            losses[step] = train_one(net, trainer, step)
+        dump(net, losses, TOTAL)
+        return 0
+
+    if MODE == "preempt":
+        mgr = mx.checkpoint.CheckpointManager(CKDIR, trainer, keep_last=5)
+        for step in range(1, CKPT_STEP + 1):
+            losses[step] = train_one(net, trainer, step)
+        handler = mx.checkpoint.install_preemption_handler(
+            mgr, user_state_fn=lambda: {"next_step": CKPT_STEP + 1})
+        with open(os.path.join(OUTDIR, "ready"), "w") as f:
+            f.write("armed")
+        deadline = time.time() + 120     # SIGTERM arrives long before
+        while time.time() < deadline:    # handler sys.exit()s out of here
+            time.sleep(0.05)
+        del handler
+        return 3                         # signal never came
+
+    raise SystemExit(f"unknown mode {MODE!r}")
+
+
+if __name__ == "__main__":
+    sys.exit(main())

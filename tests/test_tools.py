@@ -121,3 +121,52 @@ def test_opperf_harness():
     assert ops == {"add", "dot", "conv2d"}
     assert all(r["fwd_ms"] > 0 for r in rows)
     assert all(r["fwd_bwd_ms"] > 0 for r in rows)
+
+
+def test_ckpt_cli_verify_smoke(tmp_path):
+    """tools/ckpt.py verify: exit 0 on a good checkpoint, 1 on a
+    corrupted payload, 2 when nothing is committed — the pre-resume
+    guard contract (docs/checkpointing.md)."""
+    ckdir = str(tmp_path / "ck")
+    seed = ("import mxnet_tpu as mx, numpy as onp\n"
+            "from mxnet_tpu import autograd, gluon\n"
+            "net = gluon.nn.Dense(4); net.initialize()\n"
+            "tr = gluon.Trainer(net.collect_params(), 'sgd',\n"
+            "                   {'learning_rate': 0.1, 'momentum': 0.9})\n"
+            "x = mx.np.array(onp.ones((2, 3), 'float32'))\n"
+            "with autograd.record():\n"
+            "    loss = gluon.loss.L2Loss()(net(x), mx.np.zeros((2, 4)))\n"
+            "loss.backward(); tr.step(2)\n"
+            f"mgr = mx.checkpoint.CheckpointManager({ckdir!r}, tr)\n"
+            "mgr.save(step=7); mgr.flush()\n")
+    rc = subprocess.run([sys.executable, "-c", seed], env=ENV,
+                        capture_output=True, text=True, timeout=300)
+    assert rc.returncode == 0, rc.stderr[-2000:]
+
+    cli = [sys.executable, os.path.join(REPO, "tools", "ckpt.py")]
+    ok = subprocess.run([*cli, "verify", ckdir, "--json"], env=ENV,
+                        capture_output=True, text=True, timeout=300)
+    assert ok.returncode == 0, ok.stderr[-2000:]
+    report = json.loads(ok.stdout)
+    assert report["ok"] and report["step"] == 7 and report["arrays"] >= 3
+
+    listing = subprocess.run([*cli, "list", ckdir], env=ENV,
+                             capture_output=True, text=True, timeout=300)
+    assert listing.returncode == 0 and "7" in listing.stdout
+
+    # corrupt a payload stretch (wide enough to guarantee it hits array
+    # data, not zip alignment padding): verify must fail with exit code 1
+    npz = os.path.join(ckdir, "step-00000007", "arrays.npz")
+    with open(npz, "r+b") as f:
+        f.seek(os.path.getsize(npz) // 2)
+        chunk = bytearray(f.read(256))
+        f.seek(-len(chunk), os.SEEK_CUR)
+        f.write(bytes(b ^ 0xFF for b in chunk))
+    bad = subprocess.run([*cli, "verify", ckdir, "--step", "7"], env=ENV,
+                         capture_output=True, text=True, timeout=300)
+    assert bad.returncode == 1, (bad.stdout, bad.stderr)
+
+    empty = subprocess.run([*cli, "verify", str(tmp_path / "none")],
+                           env=ENV, capture_output=True, text=True,
+                           timeout=300)
+    assert empty.returncode == 2

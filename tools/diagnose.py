@@ -51,6 +51,18 @@ def _train(steps, batch, hidden):
             loss = (out * out).sum()
         loss.backward()
         trainer.step(batch_size=batch)
+    # one checkpoint save so the report's `checkpoint` phase column is
+    # exercised (capture span + async commit through the engine IO path)
+    import shutil
+    import tempfile
+
+    ckdir = tempfile.mkdtemp(prefix="diagnose-ckpt-")
+    try:
+        mgr = mx.checkpoint.CheckpointManager(ckdir, trainer, keep_last=1)
+        mgr.save(step=steps)
+        mgr.flush()
+    finally:
+        shutil.rmtree(ckdir, ignore_errors=True)
     mx.waitall()
     return net
 
