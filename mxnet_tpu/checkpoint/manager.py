@@ -18,13 +18,16 @@ the final directory, so the commit can never overtake (or run despite)
 a failed payload write, training overlaps the serialization, and
 `flush()`/`restore()`/`flush_all()` barrier on exactly the right var.
 
-Distributed (kvstore='tpu_dist'): `replicated` mode has rank 0 write
-while every rank barriers around the commit; `sharded` mode has each
-rank persist `shard-NNNNN.npz` + a fragment manifest into the shared
-tmp dir, with rank 0 merging fragments into the final MANIFEST.json
-before the rename. Multi-worker saves are forced synchronous — the
-barrier is a collective and must run on the main thread, not an engine
-IO thread.
+Distributed (kvstore='tpu_dist'): EVERY rank — writer or not — runs the
+identical three-fence sequence (post-mkdir, pre-commit, post-commit);
+barrier() is a collective, so a rank skipping any fence would deadlock
+the rest. `replicated` mode has rank 0 write while the other ranks meet
+the fences with no-op write/commit; `sharded` mode has each rank persist
+`shard-NNNNN.npz` + its fragment manifest into the shared tmp dir
+BEFORE the pre-commit fence, so rank 0's merge into the final
+MANIFEST.json never reads a missing or partial fragment. Multi-worker
+saves are forced synchronous — the barrier is a collective and must run
+on the main thread, not an engine IO thread.
 """
 from __future__ import annotations
 
@@ -208,16 +211,18 @@ class CheckpointManager:
         sync = (not self.async_save) if sync is None else bool(sync)
         if world > 1:
             sync = True  # commit barrier is a collective: main thread only
-        if self.mode == "replicated" and rank != 0:
-            # non-writers still checksum nothing and just meet the barrier
-            self._barrier()
-            return step
         final = self.step_dir(step)
         tmp = os.path.join(self.directory, _TMP_FMT.format(step))
-        if rank == 0:
-            shutil.rmtree(tmp, ignore_errors=True)
-            os.makedirs(tmp)
+        # which ranks write a payload file into tmp (non-writers still run
+        # the exact same barrier sequence below — barrier() is a collective,
+        # so EVERY rank must meet EVERY fence or the writers deadlock)
+        writer = self.mode == "sharded" or rank == 0
         if world > 1:
+            # multi-worker saves are always sync, so no queued async op can
+            # still be writing into tmp — main-thread reset is safe here
+            if rank == 0:
+                shutil.rmtree(tmp, ignore_errors=True)
+                os.makedirs(tmp)
             self._barrier()  # writers must not race rank 0's mkdir
 
         entries = {}      # manifest "arrays" section (this rank's share)
@@ -228,7 +233,7 @@ class CheckpointManager:
             my_names = [n for i, n in enumerate(names) if i % world == rank]
         else:
             fname = "arrays.npz"
-            my_names = sorted(arrays)
+            my_names = sorted(arrays) if writer else []
         for n in my_names:
             a = np.asarray(arrays[n])
             my_arrays[n] = a
@@ -251,6 +256,14 @@ class CheckpointManager:
         }
 
         def write_op():
+            if world == 1:
+                # tmp reset runs on the serialized IO chain, so a queued
+                # async write for a re-save of the same step can never have
+                # its directory pulled out from under it
+                shutil.rmtree(tmp, ignore_errors=True)
+                os.makedirs(tmp)
+            if not writer:
+                return
             hook = _WRITE_BEGIN_HOOK
             if hook is not None:
                 hook(payload_path)
@@ -259,22 +272,44 @@ class CheckpointManager:
                 np.savez(f, **payload)
                 f.flush()
                 os.fsync(f.fileno())
+            if self.mode == "sharded" and world > 1:
+                # the fragment manifest must be durable BEFORE the
+                # pre-commit barrier — rank 0's merge reads every fragment
+                _write_json(
+                    os.path.join(tmp, f"MANIFEST.shard-{rank:05d}.json"),
+                    manifest)
 
         def commit_op():
             if _checkpoint_io.pending_error(final) is not None:
-                return  # payload write failed: never commit on top of it
-            self._commit(tmp, final, manifest, rank, world)
-            _telemetry.record_ckpt_save(
-                self.mode, (time.perf_counter() - t0) * 1e3, nbytes, "ok")
+                # payload write failed: never commit on top of it — but a
+                # failed save must still show up in metrics
+                if writer:
+                    _telemetry.record_ckpt_save(
+                        self.mode, (time.perf_counter() - t0) * 1e3,
+                        nbytes, "error")
+                return
+            if rank == 0:
+                self._commit(tmp, final, manifest, world)
+            if writer:
+                _telemetry.record_ckpt_save(
+                    self.mode, (time.perf_counter() - t0) * 1e3, nbytes,
+                    "ok")
 
-        if sync:
+        if sync and world > 1:
+            # ops run inline: the fences are collectives and must
+            # interleave with the writes on the main thread. Every rank —
+            # writer or not — executes this identical barrier sequence.
             write_op()
-            if world > 1:
-                self._barrier()  # all shards on disk before anyone commits
+            self._barrier()  # payloads + fragment manifests all on disk
             commit_op()
             _checkpoint_io.wait_for_path(final)  # surface fallback errors
-            if world > 1:
-                self._barrier()  # nobody proceeds before the rename landed
+            self._barrier()  # nobody proceeds before the rename landed
+        elif sync:
+            # push through the path var so this save serializes with any
+            # still-pending async save of the same step, then barrier
+            _checkpoint_io.async_run(final, write_op)
+            _checkpoint_io.async_run(final, commit_op)
+            _checkpoint_io.wait_for_path(final)
         else:
             _checkpoint_io.async_run(final, write_op)
             _checkpoint_io.async_run(final, commit_op)
@@ -283,15 +318,12 @@ class CheckpointManager:
                     self._pending.append(final)
         return step
 
-    def _commit(self, tmp, final, manifest, rank, world):
+    def _commit(self, tmp, final, manifest, world):
         """Manifest + fsync + rename. Runs on the IO thread (async) or
-        inline (sync). In sharded multi-worker mode every rank writes a
-        fragment manifest; rank 0 merges and renames."""
+        inline (sync); in multi-worker mode only rank 0 gets here. Sharded
+        fragment manifests are already on disk (each rank's write_op wrote
+        its own before the pre-commit barrier) — merge them here."""
         if self.mode == "sharded" and world > 1:
-            frag = os.path.join(tmp, f"MANIFEST.shard-{rank:05d}.json")
-            _write_json(frag, manifest)
-            if rank != 0:
-                return
             merged = dict(manifest)
             merged["arrays"] = {}
             for r in range(world):

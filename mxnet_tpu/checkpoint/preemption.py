@@ -6,7 +6,9 @@ turns that notice into a SYNCHRONOUS snapshot (async would race the
 kill), fences every still-pending earlier save with
 `_checkpoint_io.flush_all()`, then exits with a configurable code —
 zero by default so supervisors see a clean, resumable shutdown rather
-than a crash loop.
+than a crash loop. If the emergency snapshot itself FAILS the process
+exits 1 regardless of the configured code: the state was not saved, and
+reporting it as resumable would be a lie.
 
 Signal handlers must be installed from the main thread (CPython rule)
 and the handler body itself runs on the main thread, which is exactly
@@ -94,6 +96,7 @@ class PreemptionHandler:
         if not self._once.acquire(blocking=False):
             return  # second delivery while the snapshot runs: ignore
         self.preempted = True
+        saved = False
         try:
             from .. import _checkpoint_io
             from ..diagnostics import spans as _spans
@@ -104,10 +107,23 @@ class PreemptionHandler:
                 self.manager.save(sync=True, reason="preempt",
                                   user_state=user_state)
                 _checkpoint_io.flush_all()  # earlier async saves too
-        finally:
-            if self.exit:
-                sys.exit(self.exit_code)
-            self._once.release()  # stay armed for a later re-delivery
+            saved = True
+        except BaseException:
+            # a FAILED emergency snapshot must not masquerade as a clean,
+            # resumable shutdown: the supervisor would believe the latest
+            # state was saved when it was not
+            if not self.exit:
+                self._once.release()  # stay armed for a retry
+                raise
+            import traceback
+
+            traceback.print_exc(file=sys.stderr)
+            print("mxnet_tpu.checkpoint: emergency preemption snapshot "
+                  "FAILED; exiting 1 (latest state NOT saved)",
+                  file=sys.stderr)
+        if self.exit:
+            sys.exit(self.exit_code if saved else 1)
+        self._once.release()  # stay armed for a later re-delivery
 
 
 def install_preemption_handler(manager, **kwargs):

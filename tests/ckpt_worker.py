@@ -17,6 +17,11 @@ Modes (argv[1]):
                              <outdir>/ready, spin — the parent sends
                              SIGTERM and expects a clean exit + a
                              committed 'preempt' checkpoint
+  preempt_fail <outdir> <ckdir>
+                             like preempt but the manager has NO trainer
+                             bound, so the emergency save raises — the
+                             parent expects exit code 1 (NOT the
+                             configured clean code)
 """
 import os
 import sys
@@ -139,6 +144,17 @@ def main():
         while time.time() < deadline:    # handler sys.exit()s out of here
             time.sleep(0.05)
         del handler
+        return 3                         # signal never came
+
+    if MODE == "preempt_fail":
+        # no trainer bound: the emergency snapshot raises CheckpointError
+        mgr = mx.checkpoint.CheckpointManager(CKDIR)
+        mx.checkpoint.install_preemption_handler(mgr)
+        with open(os.path.join(OUTDIR, "ready"), "w") as f:
+            f.write("armed")
+        deadline = time.time() + 120     # SIGTERM arrives long before
+        while time.time() < deadline:    # handler sys.exit(1)s out of here
+            time.sleep(0.05)
         return 3                         # signal never came
 
     raise SystemExit(f"unknown mode {MODE!r}")

@@ -254,6 +254,127 @@ def test_async_save_overlaps_training(tmp_path):
     assert verify_checkpoint(str(tmp_path), step=1)["ok"]
 
 
+def test_async_resave_same_step_serializes_tmp_reset(tmp_path):
+    """Re-saving a step while its previous async write is still in
+    flight must not pull the tmp dir out from under the IO thread: the
+    reset runs on the serialized chain, so write/commit pairs execute
+    in order and the step still commits cleanly."""
+    if engine.native_engine() is None or engine.is_naive():
+        pytest.skip("async path needs the native engine")
+    net, trainer = _build()
+    _train_one(net, trainer, 1)
+    mgr = CheckpointManager(tmp_path, trainer, async_save=True)
+    started, release = threading.Event(), threading.Event()
+    calls = []
+
+    def wedge(path):  # noqa: ARG001 — runs on the engine IO thread
+        calls.append(path)
+        if len(calls) == 1:
+            started.set()
+            release.wait(30)
+
+    mgr_mod._WRITE_BEGIN_HOOK = wedge
+    try:
+        mgr.save(step=1)
+        assert started.wait(10), "first write op never started"
+        mgr.save(step=1)        # re-save while the first write is wedged
+        release.set()
+        mgr.flush()
+    finally:
+        mgr_mod._WRITE_BEGIN_HOOK = None
+    assert len(calls) == 2      # both writes ran, in order
+    assert mgr.steps() == [1]
+    assert verify_checkpoint(str(tmp_path), step=1)["ok"]
+
+
+# -- emulated multi-worker (threads + a real collective barrier) -------------
+
+class _FakeKV:
+    """Two-'worker' kvstore stand-in: a real threading.Barrier plays the
+    collective, so a rank that skips (or adds) a fence deadlocks exactly
+    like TPUDist.barrier() would — surfaced as BrokenBarrierError by the
+    timeout instead of hanging the suite."""
+
+    def __init__(self, rank, world, gate):
+        self.rank = rank
+        self.num_workers = world
+        self._gate = gate
+        self.barrier_calls = 0
+
+    def barrier(self):
+        self.barrier_calls += 1
+        self._gate.wait(timeout=60)
+
+
+def _run_ranks(fn, world=2):
+    errs = []
+
+    def body(rank):
+        try:
+            fn(rank)
+        except Exception as e:  # noqa: BLE001 — reported via errs
+            errs.append((rank, e))
+
+    threads = [threading.Thread(target=body, args=(r,))
+               for r in range(world)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(120)
+    return errs
+
+
+def test_replicated_multiworker_barrier_counts_match(tmp_path):
+    """Regression: in replicated mode every rank must execute the SAME
+    fence sequence. Rank!=0 early-returning after one barrier used to
+    deadlock rank 0 at its second (pre-commit) fence on every
+    distributed save."""
+    net, trainer = _build()
+    _train_one(net, trainer, 1)
+    gate = threading.Barrier(2)
+    kvs = [_FakeKV(r, 2, gate) for r in range(2)]
+    mgrs = [CheckpointManager(tmp_path, trainer, kvstore=kvs[r])
+            for r in range(2)]
+
+    errs = _run_ranks(lambda r: mgrs[r].save(step=1))
+    assert not errs, errs
+    assert kvs[0].barrier_calls == kvs[1].barrier_calls == 3
+    assert verify_checkpoint(str(tmp_path), step=1)["ok"]
+    # rank 1 is a pure no-op writer: one payload + one manifest, nothing else
+    assert sorted(os.listdir(mgrs[0].step_dir(1))) == \
+        ["MANIFEST.json", "arrays.npz"]
+
+
+def test_sharded_multiworker_fragments_merge_before_commit(tmp_path):
+    """Regression: rank 0's manifest merge must only run once every
+    rank's fragment manifest is durably on disk (fragments are written
+    by write_op, before the pre-commit fence — not inside commit where
+    the merge could race them)."""
+    net, trainer = _build()
+    _train_one(net, trainer, 1)
+    gate = threading.Barrier(2)
+    kvs = [_FakeKV(r, 2, gate) for r in range(2)]
+    mgrs = [CheckpointManager(tmp_path, trainer, mode="sharded",
+                              kvstore=kvs[r]) for r in range(2)]
+
+    errs = _run_ranks(lambda r: mgrs[r].save(step=1))
+    assert not errs, errs
+    assert kvs[0].barrier_calls == kvs[1].barrier_calls == 3
+    rep = verify_checkpoint(str(tmp_path), step=1)
+    assert rep["ok"], rep
+    d = mgrs[0].step_dir(1)
+    assert os.path.isfile(os.path.join(d, "shard-00000.npz"))
+    assert os.path.isfile(os.path.join(d, "shard-00001.npz"))
+    # the merged manifest covers BOTH ranks' shares: a fresh single-worker
+    # manager restores the full state from it
+    want = _params_of(trainer)
+    for p in trainer._params:
+        p.set_data(onp.zeros(p.shape, "float32"))
+    assert CheckpointManager(tmp_path, trainer).restore(step=1).step == 1
+    for got, w in zip(_params_of(trainer), want):
+        onp.testing.assert_array_equal(got, w)
+
+
 # -- kill -9 mid-write (subprocess) ------------------------------------------
 
 @pytest.fixture(scope="module")
@@ -342,6 +463,29 @@ def test_sigterm_preemption_snapshot_and_clean_exit(tmp_path):
 
     _, trainer = _build()
     assert CheckpointManager(ckdir, trainer).restore().step == rep["step"]
+
+
+def test_preemption_failed_snapshot_exits_nonzero(tmp_path):
+    """A FAILED emergency snapshot must not exit with the configured
+    'clean, resumable' code (default 0) — the supervisor would believe
+    the latest state was saved when it was not. Expect exit 1 + a
+    FAILED notice on stderr."""
+    outdir, ckdir = tmp_path / "out", tmp_path / "ck"
+    outdir.mkdir()
+    proc = subprocess.Popen(
+        [sys.executable, WORKER, "preempt_fail", str(outdir), str(ckdir)],
+        env=ENV, stdout=subprocess.PIPE, stderr=subprocess.PIPE)
+    ready = outdir / "ready"
+    deadline = time.time() + 120
+    while not ready.exists():
+        assert proc.poll() is None, \
+            (b"" if proc.stderr is None else proc.stderr.read())[-2000:]
+        assert time.time() < deadline, "worker never armed the handler"
+        time.sleep(0.02)
+    proc.send_signal(signal.SIGTERM)
+    _, err = proc.communicate(timeout=120)
+    assert proc.returncode == 1, (proc.returncode, err[-2000:])
+    assert b"FAILED" in err, err[-2000:]
 
 
 # -- trainer save/load_states satellites -------------------------------------
@@ -526,6 +670,39 @@ def test_ckpt_telemetry_counters(tmp_path):
         assert ti.ckpt_save_total.labels("replicated", "ok").value == \
             base_saves + 1
         assert ti.ckpt_restore_total.labels("ok").value == base_restores + 1
+    finally:
+        if not was_enabled:
+            telemetry.disable()
+
+
+def test_ckpt_telemetry_error_outcome_on_failed_save(tmp_path):
+    """A failed async payload write must be visible in metrics as
+    ckpt_save_total{outcome="error"}, not silently absent."""
+    if engine.native_engine() is None or engine.is_naive():
+        pytest.skip("async failure path needs the native engine")
+    from mxnet_tpu import telemetry
+    from mxnet_tpu.telemetry import instruments as ti
+
+    was_enabled = telemetry.enabled()
+    telemetry.enable()
+    try:
+        base = ti.ckpt_save_total.labels("replicated", "error").value
+        net, trainer = _build()
+        _train_one(net, trainer, 1)
+        mgr = CheckpointManager(tmp_path, trainer, async_save=True)
+
+        def explode(path):  # noqa: ARG001
+            raise OSError("disk on fire")
+
+        mgr_mod._WRITE_BEGIN_HOOK = explode
+        try:
+            mgr.save(step=1)
+            with pytest.raises(OSError, match="disk on fire"):
+                mgr.flush()
+        finally:
+            mgr_mod._WRITE_BEGIN_HOOK = None
+        assert ti.ckpt_save_total.labels("replicated", "error").value == \
+            base + 1
     finally:
         if not was_enabled:
             telemetry.disable()
