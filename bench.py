@@ -432,6 +432,80 @@ def bench_trainer_update_ms(platform, steps=50):
     return (time.perf_counter() - t0) / steps * 1000.0
 
 
+def bench_whole_step(platform, iters, warmup):
+    """A/B of the one-dispatch whole-step path vs the legacy three-phase
+    sequence on the SAME model/loss/optimizer: gluon.TrainStep (forward +
+    backward + fused update in ONE donated jit dispatch) against
+    record/backward/Trainer.step. Returns (whole_ms, phased_ms, img_s).
+    ResNet-50 on an accelerator; a Dense stack on the CPU fallback so the
+    row stays cheap (the dispatch-count delta it measures exists on CPU
+    too). Lower _ms is better — the >3% regression gate inverts."""
+    import numpy as onp
+
+    import mxnet_tpu as mx
+    from mxnet_tpu import autograd, gluon
+    from mxnet_tpu.gluon import nn
+
+    if platform != "cpu":
+        from mxnet_tpu.gluon.model_zoo.vision import resnet50_v1
+
+        batch = int(os.environ.get("MXTPU_BENCH_BATCH", "64"))
+        xshape, classes = (batch, 224, 224, 3), 1000
+
+        def build_net():
+            return resnet50_v1(classes=classes, layout="NHWC")
+    else:
+        batch = 32
+        xshape, classes = (batch, 128), 10
+
+        def build_net():
+            net = nn.HybridSequential()
+            net.add(nn.Dense(256, activation="relu"), nn.Dense(64),
+                    nn.Dense(classes))
+            return net
+
+    rs = onp.random.RandomState(0)
+    x = mx.np.array(rs.rand(*xshape).astype("f"))
+    y = mx.np.array(rs.randint(0, classes, (batch,)))
+    lossfn = gluon.loss.SoftmaxCrossEntropyLoss()
+
+    def build():
+        mx.seed(0)
+        net = build_net()
+        net.initialize()
+        net.hybridize()
+        trainer = gluon.Trainer(net.collect_params(), "sgd",
+                                {"learning_rate": 0.05, "momentum": 0.9})
+        return net, trainer
+
+    # A: whole-step (one donated dispatch per step)
+    net, trainer = build()
+    step = gluon.TrainStep(net, lossfn, trainer)
+    dt_w, loss = _timeit(lambda: step(x, y),
+                         lambda l: float(l.sum().asnumpy()),
+                         iters, warmup)
+    if step.last_path != "whole_step":
+        raise RuntimeError("whole-step path fell back to phased: "
+                           f"{step.ineligible_reason()}")
+    if not math.isfinite(float(loss.sum().asnumpy())):
+        raise SystemExit("non-finite whole-step loss")
+
+    # B: legacy three-phase sequence, same everything
+    net, trainer = build()
+
+    def phased():
+        with autograd.record():
+            loss = lossfn(net(x), y)
+        loss.backward()
+        trainer.step(batch)
+        return loss
+
+    dt_p, _ = _timeit(phased, lambda l: float(l.sum().asnumpy()),
+                      iters, warmup)
+    return (dt_w / iters * 1000.0, dt_p / iters * 1000.0,
+            batch * iters / dt_w)
+
+
 def bench_ckpt_save_ms(platform, saves=3):
     """Milliseconds per committed checkpoint of ResNet-50-sized training
     state (161 param tensors + SGD-momentum state, ~205 MB of f32)
@@ -641,6 +715,30 @@ def main():
                     "momentum, one donated dispatch per step)"})
     except Exception as e:
         rows.append({"metric": "trainer_update_ms", "error": str(e)})
+
+    # whole-step vs phased A/B runs on every platform (on CPU a small
+    # Dense stack keeps it cheap); _ms rows → lower-is-better gate
+    try:
+        if over_budget():
+            raise TimeoutError("bench budget exhausted")
+        ws_iters = iters if platform != "cpu" else 5
+        whole_ms, phased_ms, ws_img_s = bench_whole_step(
+            platform, ws_iters, warmup)
+        ab_note = ("gluon.TrainStep one-dispatch step vs legacy "
+                   "record/backward/Trainer.step on the same "
+                   "model+optimizer (docs/performance.md)")
+        rows.append({
+            "metric": "train_step_ms_wholestep" + suffix,
+            "value": round(whole_ms, 3), "unit": "ms", "note": ab_note})
+        rows.append({
+            "metric": "train_step_ms_phased" + suffix,
+            "value": round(phased_ms, 3), "unit": "ms", "note": ab_note})
+        rows.append({
+            "metric": "train_img_s_wholestep" + suffix,
+            "value": round(ws_img_s, 2), "unit": "img/s",
+            "note": ab_note})
+    except Exception as e:
+        rows.append({"metric": "train_step_wholestep_ab", "error": str(e)})
 
     # serving-engine QPS runs on every platform (cheap MLP — the row
     # measures the batching/dispatch path, which exists on CPU too)

@@ -162,6 +162,22 @@ register(
     "HBM. Skipped automatically for any single call where donation "
     "would alias another argument's buffer.")
 register(
+    "MXTPU_WHOLE_STEP", bool, True,
+    "gluon.TrainStep compiled whole-step path: forward + backward + "
+    "gradient allreduce + fused optimizer update captured in ONE donated "
+    "jit dispatch per training step (params/optimizer state donated, "
+    "per-param lr/wd/t as weak scalars — LR schedules never retrace). "
+    "0 forces the legacy three-phase record/backward/Trainer.step "
+    "sequence; sparse grads, overriding optimizers, clip_global_norm and "
+    "multi-copy params fall back automatically (docs/performance.md).")
+register(
+    "MXTPU_DEVICE_PREFETCH", int, 0,
+    "Default DataLoader device_prefetch depth: keep up to N batches "
+    "ahead of the consumer already jax.device_put to the accelerator, so "
+    "the next batch's host->device transfer overlaps the current step's "
+    "compute (double-buffered input pipeline). 0 disables; the "
+    "DataLoader(device_prefetch=...) argument overrides per loader.")
+register(
     "MXTPU_CKPT_ASYNC", bool, True,
     "CheckpointManager default: write+commit checkpoints on an engine IO "
     "thread so saves overlap training (snapshot capture still happens "

@@ -7,6 +7,7 @@ Parameter, Trainer.
 from . import contrib, data, loss, metric, model_zoo, nn, probability, rnn, utils  # noqa: F401
 from .block import Block, HybridBlock, SymbolBlock  # noqa: F401
 from .parameter import Constant, Parameter  # noqa: F401
+from .train_step import TrainStep  # noqa: F401
 from .trainer import Trainer  # noqa: F401
 from ..base import DeferredInitializationError  # noqa: F401
 

@@ -21,6 +21,7 @@ import threading
 import numpy as _np
 
 from ...diagnostics import spans as _spans
+from ...telemetry import instruments as _telemetry
 from .batchify import default_batchify_fn
 from .sampler import BatchSampler, RandomSampler, SequentialSampler
 
@@ -49,7 +50,8 @@ class DataLoader:
     def __init__(self, dataset, batch_size=None, shuffle=False, sampler=None,
                  last_batch=None, batch_sampler=None, batchify_fn=None,
                  num_workers=0, pin_memory=False, prefetch=None,
-                 thread_pool=False, timeout=120, try_nopython=None):  # noqa: ARG002
+                 thread_pool=False, timeout=120, try_nopython=None,  # noqa: ARG002
+                 device_prefetch=None):
         self._dataset = dataset
         self._pin_memory = pin_memory
         self._timeout = timeout
@@ -77,6 +79,12 @@ class DataLoader:
         self._fork_safe_cache = None
         self._prefetch = max(0, prefetch if prefetch is not None
                              else 2 * self._num_workers)
+        # device_prefetch: keep up to N batches BEYOND the one being
+        # consumed already jax.device_put to the accelerator, so the next
+        # batch's host->device transfer rides the async dispatch stream
+        # UNDER the current step's compute (double-buffered input
+        # pipeline; docs/data.md). None defers to MXTPU_DEVICE_PREFETCH.
+        self._device_prefetch = device_prefetch
         self._batchify_fn = batchify_fn or default_batchify_fn
 
     def _make_batch(self, indices):
@@ -88,12 +96,78 @@ class DataLoader:
         # 'data' phase: time the training loop spends waiting on a batch
         # (pipeline-starved steps show up here, whatever the worker mode)
         it = self._iter_impl()
+        depth = self._device_prefetch
+        if depth is None:
+            from ... import env as _env
+
+            depth = _env.get("MXTPU_DEVICE_PREFETCH")
+        if depth and depth > 0:
+            it = self._device_prefetch_iter(it, int(depth))
         while True:
             with _spans.span("dataloader_next", cat="data"):
                 try:
                     batch = next(it)
                 except StopIteration:
                     return
+            yield batch
+
+    @staticmethod
+    def _to_device(batch):
+        """Start the batch's host->device transfer (async device_put):
+        NDArray leaves re-wrap their device array, numpy leaves become
+        NDArrays on device (the h2d bytes telemetry counts). Containers
+        keep their shape, so delivered batches only differ from the
+        un-prefetched loader by already living on the accelerator."""
+        import jax
+
+        from ...ndarray.ndarray import NDArray
+
+        def put(x):
+            if isinstance(x, NDArray):
+                return NDArray(jax.device_put(x._data))
+            if isinstance(x, _np.ndarray):
+                _telemetry.record_transfer("h2d", x.nbytes)
+                return NDArray(jax.device_put(x))
+            return x
+
+        def walk(x):
+            if isinstance(x, tuple):
+                return tuple(walk(v) for v in x)
+            if isinstance(x, list):
+                return [walk(v) for v in x]
+            if isinstance(x, dict):
+                return {k: walk(v) for k, v in x.items()}
+            return put(x)
+
+        return walk(batch)
+
+    def _device_prefetch_iter(self, it, depth):
+        """Double-buffered device prefetch: hold the next `depth` batches
+        with their device_put already ISSUED while the consumer runs the
+        current step — device_put is async, so the copies overlap the
+        step's compute and next(loader) returns transferred arrays
+        instead of starting a transfer (docs/data.md, docs/telemetry.md:
+        data_prefetch_total / data_prefetch_depth)."""
+        import collections
+
+        pending = collections.deque()
+
+        def top_up():
+            while len(pending) <= depth:
+                try:
+                    nxt = next(it)
+                except StopIteration:
+                    return
+                with _spans.span("device_prefetch", cat="data"):
+                    pending.append(self._to_device(nxt))
+                _telemetry.record_device_prefetch(len(pending))
+
+        top_up()
+        while pending:
+            batch = pending.popleft()
+            # issue the NEXT transfers before handing this batch out —
+            # they run on the async stream while the consumer computes
+            top_up()
             yield batch
 
     def _iter_impl(self):

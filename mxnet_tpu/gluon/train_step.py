@@ -1,0 +1,479 @@
+"""Whole-step compiled training: ONE donated jit dispatch per step.
+
+The legacy loop costs three dispatch families per iteration — the
+CachedOp forward, its vjp backward, and the fused optimizer buckets
+(plus an allreduce per bucket under tpu_dist). `TrainStep` captures the
+entire iteration — loss forward, autograd backward, gradient allreduce,
+and the PR-4 fused optimizer update — into a single `jax.jit` program:
+
+  * parameter weights and optimizer state are DONATED, so XLA updates
+    them in place (no second copy of the model in HBM);
+  * per-param lr/wd/update-count enter as weak-typed python scalars —
+    the same trick as `Optimizer.update_fused` — so LR schedules change
+    values, never signatures: zero retraces after the first step;
+  * the forward runs through the exact `_traced_forward` body the
+    CachedOp jit uses, the backward is `jax.vjp` seeded with ones (the
+    `loss.backward()` contract), and the update unrolls
+    `Optimizer._fused_step_body` per (dtype, multi-precision) bucket —
+    so the result is BITWISE identical to the three-phase sequence;
+  * with a device mesh, forward+backward run under `shard_map` with the
+    batch sharded over the data-parallel axis and gradients reduced
+    in-program via the kvstore's `traced_allreduce`
+    (`collectives.psum_tree_flat_traced`) — reduce and update compile
+    into the same XLA program, zero extra collective dispatches.
+
+`MXTPU_WHOLE_STEP=0` (or any ineligibility: sparse grads, an optimizer
+overriding `update`, `clip_global_norm`, multi-copy params, gradient
+compression, a multi-worker store without a mesh) falls back to the
+legacy three-phase path — `TrainStep` remains a drop-in way to run a
+step either way. Telemetry: `step_dispatch_total{path}` counts
+whole_step vs phased executions, `step_donated_bytes` the in-place
+buffer reuse; the compile registry gains a `whole_step` entry with the
+program's flops and peak-HBM estimate (docs/performance.md).
+"""
+from __future__ import annotations
+
+import time
+
+import jax
+import jax.numpy as jnp
+
+from .. import _random
+from .. import autograd as ag
+from ..diagnostics import introspect as _introspect
+from ..diagnostics import spans as _spans
+from ..diagnostics import watchdog as _watchdog
+from ..ndarray.ndarray import NDArray
+from ..optimizer.optimizer import (Optimizer, _cache_size, _donate_enabled,
+                                   _donated_bytes, _donation_safe, _specs,
+                                   _unwrap, _write_state)
+from ..telemetry import instruments as _telemetry
+from .block import HybridBlock, _traced_forward
+from .parameter import Parameter
+
+__all__ = ["TrainStep"]
+
+
+def _wrap_tree(datas):
+    """Raw-array pytree -> NDArray pytree (what a loss_fn expects)."""
+    return jax.tree_util.tree_map(NDArray, datas)
+
+
+class TrainStep:
+    """One training iteration as a single compiled, donated dispatch.
+
+    ``step = TrainStep(net, loss_fn, trainer)`` then per batch
+    ``loss = step(x, y)`` replaces::
+
+        with autograd.record():
+            loss = loss_fn(net(x), y)
+        loss.backward()
+        trainer.step(batch_size)
+
+    `net` is a HybridBlock; `loss_fn(out, *labels)` maps the network
+    output and the remaining batch elements to a loss NDArray (None
+    means the net's output IS the loss). The first `n_data` positional
+    batch elements feed the net, the rest feed the loss. `batch_size`
+    defaults to the first input's `batch_axis` extent and drives the
+    legacy `rescale_grad = scale / batch_size` contract.
+
+    With `mesh=`/`axis=`, forward+backward run under shard_map with the
+    batch sharded over `axis` and params replicated; the loss must keep
+    its batch dimension (per-sample losses, the gluon convention) so
+    shards concatenate back to the global loss. Gradients are summed
+    across shards in-program (`kvstore.traced_allreduce` when the
+    trainer has a capable store, else the collectives helper directly),
+    matching the single-device sum over the full batch.
+    """
+
+    def __init__(self, net, loss_fn, trainer, *, n_data=1, batch_axis=0,
+                 mesh=None, axis="dp"):
+        self._net = net
+        self._loss = loss_fn
+        self._trainer = trainer
+        self._n_data = int(n_data)
+        self._batch_axis = int(batch_axis)
+        self._mesh = mesh
+        self._axis = axis
+        self._built = False
+        self._jit_variants = {}     # donate(bool) -> jitted step
+        self._traces = 0            # whole-step jit traces (= compiles)
+        self._sink_params = []      # aux-updated params, set at trace time
+        self._introspecting = False
+        self._ineligible = None     # cached reason string, None = eligible
+        self._eligibility_checked = False
+        self._variant = None
+
+    # -- introspection ----------------------------------------------------
+    @property
+    def last_path(self):
+        """'whole_step' or 'phased' — how the most recent call executed."""
+        return getattr(self, "_last_path", None)
+
+    def jit_trace_count(self):
+        """Whole-step compiles so far — the zero-retrace proof counter
+        (mirrors HybridBlock.jit_trace_count)."""
+        return self._traces
+
+    def ineligible_reason(self):
+        """Why this step permanently runs phased (None when eligible)."""
+        return self._ineligible
+
+    # -- eligibility -------------------------------------------------------
+    def _check_eligibility(self):
+        tr = self._trainer
+        opt = tr._optimizer
+        if not isinstance(self._net, HybridBlock):
+            return "net is not a HybridBlock"
+        if getattr(self._net, "_dynamic_graph", False):
+            return "net fell back to dynamic-graph execution"
+        if not opt._supports_fused():
+            return (f"{type(opt).__name__} overrides update/"
+                    "update_multi_precision or lacks _rule")
+        if opt.clip_global_norm is not None:
+            return "clip_global_norm needs the host-combined norm pre-pass"
+        if tr._update_on_kvstore:
+            return "update_on_kvstore runs the optimizer inside the store"
+        kv = tr._kvstore
+        if kv is not None:
+            if getattr(kv, "_compression", None) is not None:
+                return "gradient compression is eager-only"
+            distributed = getattr(kv, "num_workers", 1) > 1
+            if distributed and self._mesh is None:
+                return "multi-worker kvstore without a mesh"
+            if self._mesh is not None and \
+                    not hasattr(kv, "traced_allreduce") and \
+                    kv.is_capable("pushpull"):
+                return f"kvstore {type(kv).__name__} has no traced reduce"
+        block_params = {id(p): n
+                        for n, p in self._net.collect_params().items()}
+        seen = set()
+        for p in tr._params:
+            if p.grad_req == "null":
+                continue
+            if p.grad_req != "write":
+                return (f"param {p.name}: grad_req={p.grad_req!r} "
+                        "(grad accumulation is eager-only)")
+            if getattr(p, "grad_stype", "default") != "default":
+                return f"param {p.name}: sparse gradient"
+            if id(p) not in block_params:
+                return f"param {p.name} is not owned by the net"
+            if id(p) in seen:
+                return f"param {p.name} appears twice in the trainer"
+            seen.add(id(p))
+            if p._data_map is not None and len(p.list_ctx()) > 1:
+                return f"param {p.name} is replicated across devices"
+        return None
+
+    def _eligible(self):
+        if not self._eligibility_checked:
+            self._ineligible = self._check_eligibility()
+            self._eligibility_checked = True
+        return self._ineligible is None
+
+    # -- build -------------------------------------------------------------
+    def _build(self):
+        tr = self._trainer
+        net = self._net
+        params = sorted(net.collect_params().items())
+        self._block_params = params
+        name_of = {id(p): n for n, p in params}
+        items = []  # (trainer index, block param name, Parameter)
+        for i, p in enumerate(tr._params):
+            if p.grad_req == "null":
+                continue
+            p._check_initialized()
+            tr._ensure_states(i, p.data())
+            items.append((i, name_of[id(p)], p))
+        self._train_items = items
+        # bucket by (weight dtype, multi-precision) in trainer order —
+        # the exact bucketing update_fused(multi_precision=True) builds,
+        # so the unrolled update is the same program member-for-member
+        import numpy as _np
+
+        buckets = {}
+        for i, n, p in items:
+            s = tr._states[i]
+            w = p.data()
+            use_mp = (isinstance(s, tuple) and len(s) == 2
+                      and isinstance(s[0], NDArray)
+                      and s[0].dtype == _np.float32
+                      and w.dtype != _np.float32)
+            buckets.setdefault((str(w.dtype), use_mp), []).append(n)
+        self._buckets = [(k, names) for k, names in buckets.items()]
+        opt = tr._optimizer
+        self._variant = (f"{type(opt).__name__.lower()}"
+                         f"-p{len(items)}-b{len(self._buckets)}"
+                         f"-{'mesh' if self._mesh is not None else 'local'}")
+        self._step_fn = self._make_step_fn()
+        self._built = True
+
+    def _make_step_fn(self):
+        tstep = self
+        net = self._net
+        loss_fn = self._loss
+        n_data = self._n_data
+        params = self._block_params
+        tr = self._trainer
+        opt = tr._optimizer
+        cls = type(opt)
+        clip = opt.clip_gradient
+        wdtype = {n: p.data().dtype for _i, n, p in self._train_items}
+        bucket_specs = self._buckets
+        mesh, axis = self._mesh, self._axis
+        kv = tr._kvstore
+        if mesh is not None:
+            reduce_tree = (kv.traced_allreduce
+                           if kv is not None
+                           and hasattr(kv, "traced_allreduce")
+                           else None)
+            n_shards = mesh.shape[axis]
+
+        def fwd_bwd(tws, frozen, key, inputs):
+            def block_of(t):
+                pd = dict(frozen)
+                pd.update(t)
+                out_datas, sink = _traced_forward(
+                    net, params, True, pd, key, inputs[:n_data])
+                # trace-time side effect: which params get aux updates
+                tstep._sink_params = list(sink.params)
+                return out_datas, tuple(sink.values)
+
+            def loss_of(out_datas):
+                out = _wrap_tree(out_datas)
+                labels = [NDArray(x) for x in inputs[n_data:]]
+                loss = loss_fn(out, *labels) if loss_fn is not None \
+                    else out
+                if not isinstance(loss, NDArray):
+                    raise TypeError(
+                        "loss_fn must return a single NDArray, got "
+                        f"{type(loss).__name__}")
+                return loss._data
+
+            # the tape differentiates the COMPILED block as one vjp node
+            # and the loss ops outside it; splitting the vjp here mirrors
+            # that, and the optimization barriers pin the same program
+            # boundaries so XLA's excess-precision pass cannot skip the
+            # low-precision rounding the eager path performs at each
+            # boundary — that elision is where bf16 runs lose bitwise
+            # parity with the three-phase path (fp32 is unaffected: the
+            # barriers only forbid cross-boundary fusion of two cheap
+            # edge tensors, not the matmul fusion inside each segment)
+            out_datas, block_vjp, aux = jax.vjp(
+                block_of, tws, has_aux=True)
+            out_datas = jax.lax.optimization_barrier(out_datas)
+            # loss.backward() contract: seed the cotangent with ones of
+            # the loss's own shape/dtype (sum-over-elements gradient)
+            loss_data, loss_vjp = jax.vjp(loss_of, out_datas)
+            (dout,) = loss_vjp(jnp.ones_like(loss_data))
+            (gd,) = block_vjp(jax.lax.optimization_barrier(dout))
+            # parity: backward lands cotangents in grad buffers of the
+            # PARAM dtype before the optimizer sees them — barrier so the
+            # multi-precision update's f32 cast cannot fold back into the
+            # grad matmuls and skip this rounding
+            gd = jax.lax.optimization_barrier(
+                {n: g.astype(wdtype[n]) for n, g in gd.items()})
+            return loss_data, gd, aux
+
+        def step(tws, frozen, states, key, lrs, wds, ts, hyper, *inputs):
+            # host side effect: runs once per jit trace (one XLA
+            # compile), never on cache hits — except AOT introspection
+            # re-lowers, which must not count as a user-visible retrace
+            if not tstep._introspecting:
+                tstep._bump_trace()
+            if mesh is None:
+                # single copy per param: the tpu_dist pushpull of one
+                # replica is an identity sum — nothing to reduce
+                loss_data, gd, aux = fwd_bwd(tws, frozen, key, inputs)
+            else:
+                from jax.sharding import PartitionSpec as P
+
+                from ..parallel.collectives import (psum_tree_flat_traced,
+                                                    shard_map)
+
+                def sharded(tws_, frozen_, key_, *ins):
+                    loss_d, gd_, aux_ = fwd_bwd(tws_, frozen_, key_, ins)
+                    if loss_d.ndim == 0:
+                        raise ValueError(
+                            "TrainStep with a mesh needs a per-sample "
+                            "loss (batch dim kept) so shards concatenate "
+                            "back to the global loss; got a scalar")
+                    # grads: per-shard sums over local samples — one
+                    # flat-bucketed psum completes the global batch sum
+                    # inside the SAME program
+                    if reduce_tree is not None:
+                        gd_ = reduce_tree(gd_, axis)
+                    else:
+                        gd_ = psum_tree_flat_traced(gd_, axis)
+                    # aux (BN running stats): cross-replica mean, the
+                    # sync-BN convention for data-parallel stats
+                    aux_ = jax.tree_util.tree_map(
+                        lambda v: jax.lax.psum(v, axis) / n_shards, aux_)
+                    return loss_d, gd_, aux_
+
+                sm = shard_map(
+                    sharded, mesh=mesh,
+                    in_specs=(P(), P(), P(),
+                              *([P(axis)] * len(inputs))),
+                    out_specs=(P(axis), P(), P()))
+                loss_data, gd, aux = sm(tws, frozen, key, *inputs)
+            # fused optimizer update, unrolled per bucket — the exact
+            # _fused_jitted math (shared body), fused into this program
+            new_ws, new_states = {}, {}
+            for (_dtype_s, use_mp), names in bucket_specs:
+                nws, nsts = Optimizer._fused_step_body(
+                    cls, clip, False, use_mp,
+                    [tws[n] for n in names],
+                    [states[n] for n in names],
+                    [gd[n] for n in names],
+                    [lrs[n] for n in names],
+                    [wds[n] for n in names],
+                    [ts[n] for n in names],
+                    1.0, hyper)
+                for n, nw, ns in zip(names, nws, nsts):
+                    new_ws[n] = nw
+                    new_states[n] = ns
+            return loss_data, new_ws, new_states, aux
+
+        return step
+
+    def _bump_trace(self):
+        self._traces += 1
+        _telemetry.record_trace("whole_step", self._variant)
+
+    def _jitted(self, donate):
+        fn = self._jit_variants.get(donate)
+        if fn is None:
+            fn = jax.jit(self._step_fn,
+                         donate_argnums=(0, 2) if donate else ())
+            self._jit_variants[donate] = fn
+        return fn
+
+    # -- execution ---------------------------------------------------------
+    def __call__(self, *batch, batch_size=None):
+        for a in batch:
+            if not isinstance(a, NDArray):
+                raise TypeError(
+                    f"TrainStep expects NDArray batch elements, got "
+                    f"{type(a).__name__}")
+        if batch_size is None:
+            batch_size = batch[0].shape[self._batch_axis]
+        from .. import env as _env
+
+        if not _env.get("MXTPU_WHOLE_STEP"):
+            return self._phased(batch, batch_size)
+        if not self._built:
+            # complete deferred init BEFORE the (cached) eligibility
+            # check — it inspects dtypes and device placement
+            self._net._ensure_initialized(batch[:self._n_data])
+        if not self._eligible():
+            return self._phased(batch, batch_size)
+        if not self._built:
+            self._build()
+        return self._whole(batch, batch_size)
+
+    def _phased(self, batch, batch_size):
+        """The legacy three-phase sequence (record/forward+loss,
+        backward, Trainer.step) — the fallback contract AND the
+        reference semantics the whole-step path is proven against."""
+        self._last_path = "phased"
+        with ag.record():
+            out = self._net(*batch[:self._n_data])
+            loss = self._loss(out, *batch[self._n_data:]) \
+                if self._loss is not None else out
+        loss.backward()
+        self._trainer.step(batch_size)
+        _telemetry.record_step_dispatch("phased")
+        return loss
+
+    def _whole(self, batch, batch_size):
+        self._last_path = "whole_step"
+        tr = self._trainer
+        opt = tr._optimizer
+        # the legacy Trainer.step prologue: grads scale by scale/batch
+        opt.rescale_grad = tr._scale / batch_size
+        # resolve counts/lr/wd in trainer order — the exact sequence
+        # update_fused drives, so schedules and Adam's t match bitwise
+        lrs, wds, ts = {}, {}, {}
+        for i, n, _p in self._train_items:
+            opt._update_count(i)
+            lrs[n] = opt._get_lr(i)
+            wds[n] = opt._get_wd(i)
+            ts[n] = opt._index_update_count[i]
+        hyper = dict(opt._hyper())
+        hyper["rescale_grad"] = opt.rescale_grad
+        tws, states = {}, {}
+        for i, n, p in self._train_items:
+            tws[n] = p.data()._data
+            states[n] = jax.tree_util.tree_map(
+                _unwrap, tr._states[i],
+                is_leaf=lambda x: isinstance(x, NDArray))
+        frozen = {n: p.data()._data for n, p in self._block_params
+                  if n not in tws}
+        key = _random.next_key()
+        inputs = [a._data for a in batch]
+        if self._mesh is not None:
+            from jax.sharding import NamedSharding
+            from jax.sharding import PartitionSpec as P
+
+            # place operands for the shard_map program — params, state
+            # and key replicated, batch split along the data axis; jit
+            # refuses arrays committed to a single device otherwise.
+            # Replicated-to-replicated puts are no-ops after step one
+            # (the program's outputs come back replicated).
+            rep = NamedSharding(self._mesh, P())
+            shd = NamedSharding(self._mesh, P(self._axis))
+
+            def _rep(v):
+                return jax.device_put(v, rep)
+
+            tws = jax.tree_util.tree_map(_rep, tws)
+            states = jax.tree_util.tree_map(_rep, states)
+            frozen = jax.tree_util.tree_map(_rep, frozen)
+            key = _rep(key)
+            inputs = [jax.device_put(x, shd) for x in inputs]
+        donate = _donate_enabled() and _donation_safe(
+            (tws, states), (frozen, inputs, key))
+        fn = self._jitted(donate)
+        before = _cache_size(fn)
+        t0 = time.perf_counter()
+        with _spans.span("whole_step", cat="fwd"), \
+                _watchdog.guard("whole_step"):
+            loss_data, new_ws, new_states, aux = fn(
+                tws, frozen, states, key, lrs, wds, ts, hyper, *inputs)
+        _telemetry.record_step_dispatch(
+            "whole_step", _donated_bytes(tws, states) if donate else 0)
+        after = _cache_size(fn)
+        if after is not None and after != before:
+            compile_seconds = time.perf_counter() - t0
+            _telemetry.record_compile("whole_step", self._variant,
+                                      compile_seconds)
+            # AOT cost/memory analysis of the one-dispatch program for
+            # the compile registry (tools/diagnose.py whole-step report);
+            # lower against specs — the live buffers were just donated
+            self._introspecting = True
+            try:
+                _introspect.capture_compile(
+                    "whole_step", self._variant, fn,
+                    (_specs(tws), _specs(frozen), _specs(states),
+                     _specs(key), lrs, wds, ts, hyper,
+                     *[_specs(x) for x in inputs]),
+                    compile_seconds=compile_seconds)
+            finally:
+                self._introspecting = False
+        # write results back into the live containers (the donated
+        # buffers are dead; these are the fresh in-place outputs)
+        for i, n, p in self._train_items:
+            w = p.data()
+            w._data = new_ws[n]
+            w._version += 1
+            _write_state(tr._states[i], new_states[n])
+            # grads were consumed in-program: mark the (untouched) grad
+            # buffers stale exactly like the legacy update bookkeeping
+            tr._grad_versions[i] = p.grad()._version
+        for p, v in zip(self._sink_params, aux):
+            target = p.data() if isinstance(p, Parameter) else p
+            target._data = v
+            target._version += 1
+        tr._record_step_complete(batch_size)
+        return NDArray(loss_data)

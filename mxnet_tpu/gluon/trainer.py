@@ -138,8 +138,14 @@ class Trainer:
             self.allreduce_grads(ignore_stale_grad)
         with _spans.span("optimizer_update", cat="optimizer"):
             self.update(batch_size, ignore_stale_grad, _skip_rescale=True)
+        self._record_step_complete(batch_size)
+
+    def _record_step_complete(self, batch_size):
+        """Per-iteration bookkeeping shared by step() and the whole-step
+        compiled path (gluon.TrainStep): close the span bucket, time the
+        step interval."""
         # close this iteration's step bucket: fwd/bwd spans recorded since
-        # the previous step() and the two phases above all share one index
+        # the previous step() and the update phases all share one index
         _spans.mark_step()
         # step-time = interval between consecutive step() completions, so
         # the histogram sees the FULL iteration (data + fwd + bwd + update

@@ -317,6 +317,17 @@ class TPUDist(KVStoreBase):
             return collectives.psum_tree_flat(arrays, mesh=mesh, axis=axis)
         return collectives.psum_tree(arrays, mesh=mesh, axis=axis)
 
+    def traced_allreduce(self, tree, axis="dp", bucket_mb=None):
+        """In-program gradient allreduce for the whole-step compiled path
+        (gluon/train_step.py): called from INSIDE an already-running
+        shard_map trace, so the reduce compiles into the same XLA program
+        as forward/backward/update — zero extra dispatches. Rides the
+        same dtype-homogeneous flat buckets as the eager
+        `allreduce_sharded` path (collectives.psum_tree_flat)."""
+        from ..parallel import collectives
+
+        return collectives.psum_tree_flat_traced(tree, axis, bucket_mb)
+
 
 # reference-parity alias so KVStoreBase.find('tpudist') works
 KVStoreBase.register(TPUDist)

@@ -258,6 +258,39 @@ class Optimizer:
                 is Optimizer.update_multi_precision
                 and cls._rule is not Optimizer._rule)
 
+    @staticmethod
+    def _fused_step_body(cls, clip, gn, mp, ws, states, gs, lrs, wds, ts,
+                         scale, hyper):
+        """Traced body of one fused bucket: rescale → global-norm scale →
+        per-element clip → `cls._rule`, unrolled over the bucket at trace
+        time. Shared verbatim by `_fused_jitted` and the whole-step
+        compiled path (gluon/train_step.py) so both produce bitwise-equal
+        numerics — same op order, same dtype promotion."""
+        new_ws, new_states = [], []
+        for w, st, g, lr, wd, t in zip(ws, states, gs, lrs, wds, ts):
+            h = dict(hyper)
+            h["t"] = t
+            if mp:
+                # legacy update_multi_precision order: cast the
+                # low-precision grad to f32 FIRST, then rescale/
+                # clip on the f32 master
+                master, inner = st
+                g = g.astype(jnp.float32)
+            g = g * h["rescale_grad"]
+            if gn:
+                g = g * scale
+            if clip is not None:
+                g = jnp.clip(g, -clip, clip)
+            if mp:
+                nm, ni = cls._rule(master, g, inner, lr, wd, h)
+                new_ws.append(nm.astype(w.dtype))
+                new_states.append((nm, ni))
+            else:
+                nw, ns = cls._rule(w, g, st, lr, wd, h)
+                new_ws.append(nw)
+                new_states.append(ns)
+        return new_ws, new_states
+
     def _fused_jitted(self, n, mp, donate):
         """One jit for a whole bucket of n same-dtype params: the python
         loop unrolls at trace time into a single XLA program (the
@@ -274,31 +307,9 @@ class Optimizer:
             clip = self.clip_gradient
 
             def step(ws, states, gs, lrs, wds, ts, scale, hyper):
-                new_ws, new_states = [], []
-                for w, st, g, lr, wd, t in zip(ws, states, gs, lrs, wds,
-                                               ts):
-                    h = dict(hyper)
-                    h["t"] = t
-                    if mp:
-                        # legacy update_multi_precision order: cast the
-                        # low-precision grad to f32 FIRST, then rescale/
-                        # clip on the f32 master
-                        master, inner = st
-                        g = g.astype(jnp.float32)
-                    g = g * h["rescale_grad"]
-                    if gn:
-                        g = g * scale
-                    if clip is not None:
-                        g = jnp.clip(g, -clip, clip)
-                    if mp:
-                        nm, ni = cls._rule(master, g, inner, lr, wd, h)
-                        new_ws.append(nm.astype(w.dtype))
-                        new_states.append((nm, ni))
-                    else:
-                        nw, ns = cls._rule(w, g, st, lr, wd, h)
-                        new_ws.append(nw)
-                        new_states.append(ns)
-                return new_ws, new_states
+                return Optimizer._fused_step_body(
+                    cls, clip, gn, mp, ws, states, gs, lrs, wds, ts,
+                    scale, hyper)
 
             fn = jax.jit(step, donate_argnums=(0, 1) if donate else ())
             Optimizer._jit_cache[key] = fn

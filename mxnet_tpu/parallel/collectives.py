@@ -33,8 +33,9 @@ from ..diagnostics import spans as _spans
 from ..diagnostics import watchdog as _watchdog
 from ..telemetry import instruments as _telemetry
 
-__all__ = ["psum_tree", "psum_tree_flat", "allreduce_mean", "all_gather",
-           "reduce_scatter", "ring_permute", "axis_size"]
+__all__ = ["psum_tree", "psum_tree_flat", "psum_tree_flat_traced",
+           "allreduce_mean", "all_gather", "reduce_scatter",
+           "ring_permute", "axis_size"]
 
 
 def axis_size(axis_name):
@@ -95,6 +96,42 @@ def _flat_buckets(leaves, cap_bytes):
     return buckets
 
 
+def _resolve_bucket_mb(bucket_mb):
+    if bucket_mb is not None:
+        return int(bucket_mb)
+    from .. import env as _env
+
+    return int(_env.get("MXTPU_FUSED_BUCKET_MB"))
+
+
+def psum_tree_flat_traced(tree, axis, bucket_mb=None):
+    """TRACED bucketed flat allreduce — the inside-the-program form of
+    :func:`psum_tree_flat`, callable from code already running under
+    ``shard_map`` (the whole-step compiled path threads its gradient
+    allreduce through this, so reduce + optimizer update share one XLA
+    program and one dispatch). Leaves are concatenated into
+    dtype-homogeneous ~`bucket_mb` MB buffers, ONE ``lax.psum`` per
+    buffer, split back to the original shapes in the same trace. No
+    dispatch/telemetry bookkeeping here — the enclosing dispatch owns
+    that; bucket sizes come from the (static) aval shapes."""
+    leaves, treedef = jax.tree_util.tree_flatten(tree)
+    if not leaves:
+        return tree
+    buckets = _flat_buckets(leaves, _resolve_bucket_mb(bucket_mb) << 20)
+    outs = [None] * len(leaves)
+    for bucket in buckets:
+        flat = (leaves[bucket[0]].reshape(-1) if len(bucket) == 1
+                else jnp.concatenate(
+                    [leaves[i].reshape(-1) for i in bucket]))
+        red = jax.lax.psum(flat, axis)
+        off = 0
+        for i in bucket:
+            n = leaves[i].size
+            outs[i] = red[off:off + n].reshape(leaves[i].shape)
+            off += n
+    return jax.tree_util.tree_unflatten(treedef, outs)
+
+
 _flat_jit_cache = {}
 
 
@@ -111,29 +148,15 @@ def psum_tree_flat(tree, mesh, axis="dp", bucket_mb=None):
     leaves, treedef = jax.tree_util.tree_flatten(tree)
     if not leaves:
         return tree
-    if bucket_mb is None:
-        from .. import env as _env
-
-        bucket_mb = _env.get("MXTPU_FUSED_BUCKET_MB")
-    buckets = _flat_buckets(leaves, int(bucket_mb) << 20)
-    sig = (id(mesh), tuple(mesh.shape.items()), axis, int(bucket_mb),
+    bucket_mb = _resolve_bucket_mb(bucket_mb)
+    buckets = _flat_buckets(leaves, bucket_mb << 20)
+    sig = (id(mesh), tuple(mesh.shape.items()), axis, bucket_mb,
            treedef, tuple((x.shape, str(x.dtype)) for x in leaves))
     fn = _flat_jit_cache.get(sig)
     if fn is None:
         @partial(shard_map, mesh=mesh, in_specs=(P(axis),), out_specs=P())
         def _reduce(ls):
-            outs = [None] * len(ls)
-            for bucket in buckets:
-                flat = (ls[bucket[0]].reshape(-1) if len(bucket) == 1
-                        else jnp.concatenate(
-                            [ls[i].reshape(-1) for i in bucket]))
-                red = jax.lax.psum(flat, axis)
-                off = 0
-                for i in bucket:
-                    n = ls[i].size
-                    outs[i] = red[off:off + n].reshape(ls[i].shape)
-                    off += n
-            return outs
+            return psum_tree_flat_traced(ls, axis, bucket_mb)
 
         fn = jax.jit(_reduce)
         _flat_jit_cache[sig] = fn

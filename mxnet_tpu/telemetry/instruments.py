@@ -28,6 +28,9 @@ __all__ = [
     "mfu_ratio", "flops_per_step", "peak_flops",
     "update_dispatch_total", "fused_bucket_size", "update_donated_bytes",
     "record_update_dispatch", "record_fused_bucket",
+    "step_dispatch_total", "step_donated_bytes",
+    "data_prefetch_total", "data_prefetch_depth",
+    "record_step_dispatch", "record_device_prefetch",
     "compile_flops", "compile_peak_hbm_bytes", "device_memory_bytes",
     "ckpt_save_total", "ckpt_save_ms", "ckpt_bytes_total",
     "ckpt_restore_total", "record_ckpt_save", "record_ckpt_restore",
@@ -153,6 +156,29 @@ update_donated_bytes = counter(
     "Bytes of weight/optimizer-state buffers donated into update "
     "dispatches — XLA reuses them in place instead of allocating fresh "
     "HBM for the outputs")
+
+# -- whole-step compiled path (gluon/train_step.py; docs/performance.md) ----
+step_dispatch_total = counter(
+    "step_dispatch_total",
+    "Training-step executions by path: whole_step (ONE donated jit "
+    "dispatch covering forward + backward + allreduce + fused update — "
+    "gluon.TrainStep) or phased (the legacy record/backward/Trainer.step "
+    "three-phase sequence)", ["path"])
+step_donated_bytes = counter(
+    "step_donated_bytes",
+    "Bytes of parameter + optimizer-state buffers donated into "
+    "whole-step dispatches so the weights update in place (HBM reuse "
+    "instead of a second copy of the model)")
+
+# -- input pipeline (gluon/data/dataloader.py device_prefetch) --------------
+data_prefetch_total = counter(
+    "data_prefetch_total",
+    "Batches pushed through the DataLoader device-prefetch stage "
+    "(async jax.device_put issued ahead of the consuming step)")
+data_prefetch_depth = gauge(
+    "data_prefetch_depth",
+    "Batches currently resident in the DataLoader device-prefetch "
+    "buffer (transferred or in flight, not yet consumed)")
 
 
 # -- checkpointing (checkpoint/manager.py; docs/checkpointing.md) -----------
@@ -329,6 +355,26 @@ def record_update_dispatch(path, donated_bytes=0):
     update_dispatch_total.labels(path).inc()
     if donated_bytes:
         update_donated_bytes.inc(donated_bytes)
+
+
+def record_step_dispatch(path, donated_bytes=0):
+    """One executed training step on `path` (whole_step / phased);
+    `donated_bytes` counts the param+state buffers handed to XLA for
+    in-place reuse by the whole-step dispatch."""
+    if not REGISTRY.enabled:
+        return
+    step_dispatch_total.labels(path).inc()
+    if donated_bytes:
+        step_donated_bytes.inc(donated_bytes)
+
+
+def record_device_prefetch(depth):
+    """One batch entered the DataLoader device-prefetch buffer, which now
+    holds `depth` batches ahead of the consumer."""
+    if not REGISTRY.enabled:
+        return
+    data_prefetch_total.inc()
+    data_prefetch_depth.set(depth)
 
 
 def record_fused_bucket(site, params):

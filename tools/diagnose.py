@@ -35,8 +35,7 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 def _train(steps, batch, hidden):
     import mxnet_tpu as mx
-    from mxnet_tpu import autograd as ag
-    from mxnet_tpu.gluon import Trainer, nn
+    from mxnet_tpu.gluon import Trainer, TrainStep, nn
 
     net = nn.HybridSequential()
     net.add(nn.Dense(hidden, activation="relu"), nn.Dense(hidden // 2))
@@ -44,13 +43,14 @@ def _train(steps, batch, hidden):
     net.hybridize()
     trainer = Trainer(net.collect_params(), "sgd",
                       {"learning_rate": 0.05})
+    # drive steps through TrainStep: with MXTPU_WHOLE_STEP=1 (default)
+    # the whole iteration is ONE donated dispatch and the report's
+    # whole-step section fills; MXTPU_WHOLE_STEP=0 shows the phased
+    # three-dispatch breakdown instead
+    step = TrainStep(net, lambda out: (out * out).sum(axis=-1), trainer)
     x = mx.np.ones((batch, hidden))
     for _ in range(steps):
-        with ag.record():
-            out = net(x)
-            loss = (out * out).sum()
-        loss.backward()
-        trainer.step(batch_size=batch)
+        step(x, batch_size=batch)
     # one checkpoint save so the report's `checkpoint` phase column is
     # exercised (capture span + async commit through the engine IO path)
     import shutil
@@ -114,6 +114,60 @@ def _fused_report_lines(buckets):
     return lines
 
 
+def _whole_step_report():
+    """Per-step dispatch accounting + the whole-step program's compile
+    cost/memory, next to the fused-bucket report: how many training
+    steps ran as ONE donated dispatch (path=whole_step) vs the legacy
+    three-phase sequence (path=phased), and what XLA built for the
+    one-dispatch program (flops / peak HBM from the compile registry)."""
+    from mxnet_tpu import diagnostics
+    from mxnet_tpu.telemetry import instruments as ti
+
+    dispatches = {labels[0]: c.value
+                  for labels, c in ti.step_dispatch_total.series()}
+    programs = []
+    for (block, variant), e in sorted(diagnostics.compile_registry()
+                                      .items()):
+        if block != "whole_step":
+            continue
+        info = {"variant": variant}
+        for k in ("flops", "bytes_accessed", "peak_bytes",
+                  "compile_seconds"):
+            if isinstance(e, dict) and e.get(k) is not None:
+                info[k] = e[k]
+        programs.append(info)
+    return {
+        "step_dispatches": dispatches,
+        "donated_bytes": ti.step_donated_bytes.value,
+        "programs": programs,
+    }
+
+
+def _whole_step_report_lines(ws):
+    lines = ["", "== whole-step dispatches =="]
+    d = ws["step_dispatches"]
+    if not d:
+        lines.append("  (no steps recorded)")
+        return lines
+    for path, n in sorted(d.items()):
+        per = "1 dispatch/step" if path == "whole_step" \
+            else "fwd + bwd + update dispatches"
+        lines.append(f"  {path}: {int(n)} steps ({per})")
+    if ws["donated_bytes"]:
+        lines.append(f"  donated in place: {int(ws['donated_bytes'])} "
+                     "bytes (params + optimizer state, cumulative)")
+    for p in ws["programs"]:
+        desc = f"  program {p['variant']}:"
+        if "flops" in p:
+            desc += f" {p['flops']:.3g} flops"
+        if "peak_bytes" in p:
+            desc += f", peak HBM {int(p['peak_bytes'])} bytes"
+        if "compile_seconds" in p:
+            desc += f", compiled in {p['compile_seconds']:.2f}s"
+        lines.append(desc)
+    return lines
+
+
 def main(argv=None):
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     ap.add_argument("--steps", type=int, default=3)
@@ -154,12 +208,14 @@ def main(argv=None):
                            for k, v in diagnostics.step_table().items()},
             "compile_registry": reg,
             "fused_buckets": _fused_buckets(),
+            "whole_step": _whole_step_report(),
             "device_memory": diagnostics.device_memory(),
             "telemetry": telemetry.dump(),
         }, default=str))
     else:
         print(diagnostics.report())
         print("\n".join(_fused_report_lines(_fused_buckets())))
+        print("\n".join(_whole_step_report_lines(_whole_step_report())))
 
 
 if __name__ == "__main__":
