@@ -87,7 +87,8 @@ def _check_regressions(current, threshold=0.03):
         cur = cur_vals.get(name)
         if cur is None or prev <= 0 or "agreement" in name:
             continue  # ratios aren't throughput; missing = not comparable
-        lower_is_better = name.endswith("_ms") or "_ms_" in name
+        lower_is_better = (name.endswith("_ms") or "_ms_" in name
+                           or name.endswith("_mb") or "_mb_" in name)
         if lower_is_better:
             change = (cur - prev) / prev   # latency rising = regression
         else:
@@ -607,6 +608,48 @@ def bench_serving_qps(platform, clients=8, requests=40):
     return clients * requests / dt
 
 
+def bench_passes_compile_ms(platform):
+    """Wall-ms of one pipeline build (trace + AMP pass + dedup hashing +
+    XLA compile) of a small MLP through the graph-pass seam
+    (docs/passes.md). Lower is better via the _ms suffix: a pass-manager
+    overhead regression shows up here before it taxes every rebuild."""
+    import numpy as onp
+
+    import mxnet_tpu as mx
+    from mxnet_tpu import amp
+    from mxnet_tpu.gluon import nn
+
+    os.environ["MXTPU_GRAPH_DEDUP"] = "1"
+    mx.seed(0)
+    net = nn.HybridSequential()
+    net.add(nn.Dense(256, activation="relu"), nn.Dense(64))
+    net.initialize()
+    net.hybridize()
+    amp.convert_hybrid_block(net, graph_pass=True)
+    x = mx.np.array(onp.random.RandomState(0).rand(8, 128).astype("f"))
+    t0 = time.perf_counter()
+    net(x).asnumpy()
+    return (time.perf_counter() - t0) * 1000.0
+
+
+def bench_peak_hbm_mb(platform):
+    """Largest reported program footprint (MB) across the compile
+    registry after this run's benches: prefers the backend-independent
+    liveness peak (peak_live_bytes, passes/memory.py), falls back to
+    XLA's memory_analysis sum. A >3% RISE trips the regression gate via
+    the _mb suffix — this is the row the remat pass exists to bend."""
+    from mxnet_tpu import diagnostics
+
+    best = 0
+    for e in diagnostics.compile_registry().values():
+        v = e.get("peak_live_bytes") or e.get("peak_hbm_bytes") or 0
+        best = max(best, int(v))
+    if not best:
+        raise RuntimeError("no compile-registry entries with memory "
+                           "info (MXTPU_DIAG_COMPILE=0?)")
+    return best / (1 << 20)
+
+
 def main():
     import jax
 
@@ -617,6 +660,11 @@ def main():
               file=sys.stderr)
         jax.config.update("jax_platforms", "cpu")
         platform = "cpu"
+
+    # liveness peaks in the compile registry are opt-in; the
+    # peak_hbm_mb row prefers them over XLA's temp-sum (see
+    # bench_peak_hbm_mb), so turn them on for the whole run
+    os.environ.setdefault("MXTPU_DIAG_MEMORY", "1")
 
     layout = os.environ.get("MXTPU_BENCH_LAYOUT", "NHWC")
     batch = int(os.environ.get("MXTPU_BENCH_BATCH",
@@ -770,6 +818,34 @@ def main():
                     "rename; docs/checkpointing.md)"})
     except Exception as e:
         rows.append({"metric": "ckpt_save_ms", "error": str(e)})
+
+    # graph-pass pipeline build latency + peak program footprint run on
+    # every platform (cheap MLP / registry read); both lower-is-better
+    try:
+        if over_budget():
+            raise TimeoutError("bench budget exhausted")
+        pc_ms = bench_passes_compile_ms(platform)
+        rows.append({
+            "metric": "compile_ms_passes" + suffix,
+            "value": round(pc_ms, 3), "unit": "ms",
+            "note": "first-call build of a small MLP through the "
+                    "graph-pass pipeline: trace + AMP rewrite + dedup "
+                    "hashing + XLA compile (docs/passes.md)"})
+    except Exception as e:
+        rows.append({"metric": "compile_ms_passes", "error": str(e)})
+    try:
+        if over_budget():
+            raise TimeoutError("bench budget exhausted")
+        hbm_mb = bench_peak_hbm_mb(platform)
+        rows.append({
+            "metric": "peak_hbm_mb" + suffix,
+            "value": round(hbm_mb, 3), "unit": "MB",
+            "note": "largest program footprint in this run's compile "
+                    "registry (liveness peak when available, else XLA "
+                    "memory_analysis; the remat pass bends this row — "
+                    "docs/passes.md)"})
+    except Exception as e:
+        rows.append({"metric": "peak_hbm_mb", "error": str(e)})
 
     result_extra = {}
     try:

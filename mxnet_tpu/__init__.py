@@ -103,6 +103,7 @@ from . import util  # noqa: E402
 from . import runtime  # noqa: E402
 from . import profiler  # noqa: E402
 from . import telemetry  # noqa: E402  (runtime metrics; docs/telemetry.md)
+from . import passes  # noqa: E402  (graph-pass pipeline; docs/passes.md)
 from . import diagnostics  # noqa: E402  (spans/compile introspection/watchdog)
 from . import test_utils  # noqa: E402  (mx.test_utils like the reference)
 from . import amp  # noqa: E402  (mx.amp — reference: python/mxnet/amp/)

@@ -177,18 +177,35 @@ def _cast_param(p, dtype, keep_fp32=False):
 def convert_hybrid_block(net, target_dtype="bfloat16", target_dtype_ops=None,
                          fp32_ops=None, conditional_fp32_ops=None,
                          excluded_sym_names=None, device=None,
-                         cast_params_offline=True):  # noqa: ARG001
+                         cast_params_offline=True, graph_pass=False,
+                         example_inputs=None):  # noqa: ARG001
     """Convert a HybridBlock to mixed precision (reference: amp.py:676
     convert_hybrid_block): params cast to bf16 except norm/scale params;
     the compiled program then runs matmuls/convs on the MXU in bf16.
 
-    For the reference's *graph-level* cast conversion
+    ``graph_pass=True`` is the reference's *graph-level* cast conversion
     (low_precision_pass.cc — every op forced through the cast lists
-    regardless of how it was written), see
-    amp.graph_pass.convert_block_graph, which rewrites the traced jaxpr.
+    regardless of how it was written): instead of casting params, the
+    block's pass pipeline (docs/passes.md) gains passes.AmpPass, so
+    every compiled variant — block jit, export, symbol lowering, the
+    whole-step train program's forward — is rewritten under the cast
+    lists.  Pass ``example_inputs`` (a tuple) to build the first
+    variant eagerly and fill ``net._amp_stats`` before returning.
     """
     dtype = normalize_dtype("bfloat16" if target_dtype in (
         "float16", "fp16", "bfloat16", "bf16") else target_dtype)
+    if graph_pass:
+        from .graph_pass import convert_block_graph
+
+        if example_inputs is not None:
+            convert_block_graph(net, tuple(example_inputs), dtype)
+        else:
+            from .. import passes as _passes
+
+            net.hybridize(True)
+            net.pass_pipeline().register(_passes.AmpPass(dtype))
+            net._jit_variants.clear()
+        return net
     for p in net.collect_params().values():
         if p._data_map is not None or p.shape is not None:
             _cast_param(p, dtype)

@@ -149,31 +149,32 @@ def amp_rewrite(closed_jaxpr, target_dtype=jnp.bfloat16, stats=None):
 
 def build_amp_variant(cached_fn, target_dtype, pd, key, datas):
     """Trace + AMP-rewrite one compiled variant. Returns (jitted, stats).
-    Called by HybridBlock._build_variant so the rewrite survives cache
-    clears (cast/load_parameters) and rebuilds automatically."""
-    closed = jax.make_jaxpr(cached_fn)(pd, key, *datas)
+    Legacy one-off builder, now a thin veneer over the pass pipeline
+    (passes.AmpPass via apply_pipeline) so jit construction for
+    captured bodies lives in ONE place; the eval_shape builds the
+    pipeline entry eagerly (abstract — no compute) so stats are filled
+    on return, as before."""
+    from .. import passes as _passes
+
     stats = AmpStats()
-    rewritten = amp_rewrite(closed, target_dtype, stats)
-
-    out_shape = jax.eval_shape(cached_fn, pd, key, *datas)
-    _, out_tree = jax.tree_util.tree_flatten(out_shape)
-
-    def wrapped(*args):
-        flat, _ = jax.tree_util.tree_flatten(args)
-        outs = rewritten(*flat)
-        return jax.tree_util.tree_unflatten(out_tree, outs)
-
-    return jax.jit(wrapped), stats
+    ctx = _passes.PassContext(label="amp_variant", kind="block")
+    jitted = _passes.apply_pipeline(
+        cached_fn, [_passes.AmpPass(target_dtype, stats=stats)], ctx)
+    jax.eval_shape(jitted, pd, key, *datas)
+    return jitted, stats
 
 
 def convert_block_graph(block, example_inputs, target_dtype=jnp.bfloat16):
-    """Enable the AMP graph pass on a HybridBlock: the traced jaxpr is
-    rewritten under the cast lists for every compiled variant, now and on
-    every rebuild. Returns the AmpStats of the eagerly-built variant.
-    (The graph-pass mode of amp.convert_hybrid_block.)"""
+    """Enable the AMP graph pass on a HybridBlock: registers
+    passes.AmpPass on the block's pass pipeline, so the traced jaxpr is
+    rewritten under the cast lists for EVERY compiled variant — block
+    jit, export, symbol lowering — now and on every rebuild.  Returns
+    the AmpStats of the eagerly-built variant.  (The graph-pass mode of
+    amp.convert_hybrid_block.)"""
+    from .. import passes as _passes
+
     block.hybridize(True)
-    object.__setattr__(block, "_variant_builder",
-                       ("amp_graph", target_dtype))
+    block.pass_pipeline().register(_passes.AmpPass(target_dtype))
     block._jit_variants.clear()
     block(*example_inputs)  # force one build so stats are available
     return block._amp_stats

@@ -217,6 +217,35 @@ register(
     "CheckpointHandler when none is passed explicitly; empty = require "
     "an explicit directory.")
 register(
+    "MXTPU_PASSES", str, "auto",
+    "Graph-pass pipeline master switch (mxnet_tpu/passes; "
+    "docs/passes.md). 'auto' runs each block's registered passes plus "
+    "the env-driven policies; a comma list (e.g. 'amp,remat') "
+    "force-adds those named passes to every pipeline; '0' disables ALL "
+    "graph passes so every seam compiles its captured program verbatim "
+    "— bitwise-identical to the pre-pipeline framework.")
+register(
+    "MXTPU_REMAT_POLICY", str, "none",
+    "Rematerialization policy the remat pass applies to training "
+    "graphs: none | dots (sqrt-N segmented jax.checkpoint keeping "
+    "matmul/conv outputs) | full (segments save only boundary values) "
+    "| auto (estimate the fwd+bwd peak residency per policy via the "
+    "passes/memory.py liveness walk + the compile registry and pick "
+    "the cheapest one fitting MXTPU_REMAT_BUDGET_MB / device memory).")
+register(
+    "MXTPU_REMAT_BUDGET_MB", int, 0,
+    "HBM budget (MB) the remat 'auto' policy fits the training program "
+    "into. 0 = use the device's memory_stats bytes_limit; CPU reports "
+    "none, so 'auto' resolves to 'none' there without an explicit "
+    "budget.")
+register(
+    "MXTPU_GRAPH_DEDUP", bool, False,
+    "Cross-CachedOp structural dedup: canonicalize every block-seam "
+    "jaxpr (shapes/dtypes/equation graph, modulo variable names and "
+    "constant values) and share ONE compiled executable between "
+    "structurally identical blocks (multi-head models, serving "
+    "replicas). Reuses count in graph_dedup_hits_total.")
+register(
     "MXTPU_BENCH_BUDGET_S", int, 1200,
     "bench.py wall-clock budget (seconds); secondary rows are skipped "
     "with an error row once exceeded so the driver always gets the "

@@ -36,6 +36,7 @@ from .. import _random
 from .. import autograd as ag
 from ..diagnostics import introspect as _introspect
 from ..diagnostics import spans as _spans
+from ..passes import _state as _pass_state
 from ..telemetry import instruments as _telemetry
 from ..base import DeferredInitializationError, normalize_dtype
 from ..device import Device, current_device
@@ -676,8 +677,12 @@ class HybridBlock(Block):
         def cached_fn(param_data, key, *input_datas):
             # host side effect: this body runs once per jit trace (new
             # shape/dtype signature -> one XLA compile), never on cache
-            # hits — the retrace signal jit_trace_count() exposes
-            block._bump_trace(training)
+            # hits — the retrace signal jit_trace_count() exposes.
+            # Suppressed while the pass pipeline (or compile
+            # introspection) re-traces for its own purposes: the
+            # pipeline fires ctx.on_build once per built entry instead.
+            if not _pass_state.suppressed():
+                block._bump_trace(training)
             out_datas, sink = _traced_forward(
                 block, params, training, param_data, key, input_datas)
             # trace-time side effect: remember which params get aux updates
@@ -751,8 +756,25 @@ class HybridBlock(Block):
                 label or type(self).__name__, variant, jitted,
                 (pd, key, *datas))
 
+    def pass_pipeline(self):
+        """This block's graph-pass pipeline (docs/passes.md): a
+        passes.PassManager whose registered passes rewrite every
+        compiled variant — block jit, export, symbol lowering.  Call
+        ``hybridize(True)`` (or clear the jit cache) after changing the
+        pipeline so already-built variants rebuild through it."""
+        from .. import passes as _passes
+
+        pm = getattr(self, "_pass_manager", None)
+        if pm is None:
+            pm = _passes.PassManager()
+            object.__setattr__(self, "_pass_manager", pm)
+        return pm
+
     def _build_jit(self, training):
-        return jax.jit(self._make_cached_fn(training))
+        from .. import passes as _passes
+
+        return _passes.apply(self._make_cached_fn(training),
+                             _passes.block_context(self, training))
 
     def _build_variant(self, training, args):
         """Build the compiled variant honoring any recorded graph rewrite
@@ -766,12 +788,17 @@ class HybridBlock(Block):
         key = _random.next_key()
         datas = [a._data for a in args]
         if kind == "subgraph":
+            from .. import passes as _passes
             from .. import subgraph as _subgraph
 
             part, n_sub = _subgraph.partition_call(
                 cached_fn, payload, pd, key, *datas)
             object.__setattr__(self, "_subgraph_count", n_sub)
-            return jax.jit(part)
+            # bump=False: partition_call already traced cached_fn once
+            # (bump fired there); the partitioned wrapper itself never
+            # self-bumped under a plain jit either
+            return _passes.apply(
+                part, _passes.block_context(self, training, bump=False))
         if kind == "amp_graph":
             from ..amp.graph_pass import build_amp_variant
 
@@ -933,7 +960,15 @@ class HybridBlock(Block):
 
         from jax import export as jax_export
 
-        exp = jax_export.export(jax.jit(infer_fn))(
+        from .. import passes as _passes
+
+        # through the pipeline: a converted/remat'd block exports the
+        # SAME program it runs (apply returns a real jax.jit, which
+        # jax_export requires)
+        jitted = _passes.apply(infer_fn, _passes.PassContext(
+            block=self, label=type(self).__name__, variant="export",
+            kind="export"))
+        exp = jax_export.export(jitted)(
             {n: jax.ShapeDtypeStruct(a.shape, a.dtype)
              for n, a in param_data.items()},
             *[jax.ShapeDtypeStruct(s, d) for s, d in specs])
@@ -1079,7 +1114,13 @@ class SymbolBlock(HybridBlock):
         # shape/dtype change via jit's cache
         jitted = getattr(self, "_sym_jit", None)
         if jitted is None:
-            jitted = jax.jit(self._symbol._lower())
+            from .. import passes as _passes
+
+            jitted = _passes.apply(
+                self._symbol._lower(),
+                _passes.PassContext(block=self,
+                                    label=type(self).__name__,
+                                    variant="symbol", kind="symbol"))
             object.__setattr__(self, "_sym_jit", jitted)
         feed = {}
         for n, a in zip(self._input_names, args):

@@ -229,15 +229,31 @@ class TrainStep:
                            else None)
             n_shards = mesh.shape[axis]
 
+        from .. import passes as _passes
+
+        # the forward body enters the whole-step program through the
+        # graph-pass pipeline (kind=whole_step_fwd): AMP / remat passes
+        # registered on the block rewrite exactly the part of the
+        # program they understand, while optimizer state stays outside
+        # their reach.  Explicit args (no closure captures) so the
+        # pipeline can trace it standalone; resolves to the raw body
+        # when no passes apply.
+        def block_body(tws_, frozen_, key_, *data_ins):
+            pd = dict(frozen_)
+            pd.update(tws_)
+            out_datas, sink = _traced_forward(
+                net, params, True, pd, key_, data_ins)
+            # trace-time side effect: which params get aux updates
+            tstep._sink_params = list(sink.params)
+            return out_datas, tuple(sink.values)
+
+        block_fwd = _passes.wrap_forward(block_body, _passes.PassContext(
+            block=net, label="whole_step", variant=self._variant,
+            kind="whole_step_fwd", training=True))
+
         def fwd_bwd(tws, frozen, key, inputs):
             def block_of(t):
-                pd = dict(frozen)
-                pd.update(t)
-                out_datas, sink = _traced_forward(
-                    net, params, True, pd, key, inputs[:n_data])
-                # trace-time side effect: which params get aux updates
-                tstep._sink_params = list(sink.params)
-                return out_datas, tuple(sink.values)
+                return block_fwd(t, frozen, key, *inputs[:n_data])
 
             def loss_of(out_datas):
                 out = _wrap_tree(out_datas)
@@ -344,8 +360,16 @@ class TrainStep:
     def _jitted(self, donate):
         fn = self._jit_variants.get(donate)
         if fn is None:
-            fn = jax.jit(self._step_fn,
-                         donate_argnums=(0, 2) if donate else ())
+            from .. import passes as _passes
+
+            # the whole-step program compiles through the pipeline seam
+            # too; no shipped pass claims kind=whole_step (the forward
+            # body was already rewritten via wrap_forward), so today
+            # this resolves to the plain donated jit
+            fn = _passes.apply(self._step_fn, _passes.PassContext(
+                label="whole_step", variant=self._variant,
+                kind="whole_step", training=True,
+                donate_argnums=(0, 2) if donate else ()))
             self._jit_variants[donate] = fn
         return fn
 

@@ -1,0 +1,31 @@
+"""Thread-local coordination between the pass pipeline and the blocks
+it traces.
+
+Deliberately import-light (stdlib only): gluon/block.py consults
+`suppressed()` inside every CachedOp body, and the passes package
+proper pulls in jax — this module breaks that cycle.
+"""
+from __future__ import annotations
+
+import threading
+from contextlib import contextmanager
+
+_tls = threading.local()
+
+
+def suppressed():
+    """True while the pipeline (or compile introspection) is re-tracing
+    a captured body for its own purposes.  `cached_fn` checks this so
+    pipeline traces don't double-count in jit_trace_total — the
+    pipeline fires `ctx.on_build` exactly once per built entry
+    instead."""
+    return getattr(_tls, "suppress", 0) > 0
+
+
+@contextmanager
+def suppress_trace_bumps():
+    _tls.suppress = getattr(_tls, "suppress", 0) + 1
+    try:
+        yield
+    finally:
+        _tls.suppress -= 1

@@ -1,0 +1,49 @@
+"""AMP as a graph pass: the reference `low_precision_pass.cc` ported
+onto the pipeline.
+
+The actual dtype rewrite lives in `amp/graph_pass.amp_rewrite` (the
+jaxpr interpreter enforcing the LP16/FP32/widest cast lists); this pass
+adapts it to the jaxpr → jaxpr contract so auto-cast composes with the
+other passes and with every seam — block variants, export, symbol
+lowering, and the whole-step train program's forward body.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from . import manager as _manager
+from .manager import GraphPass
+
+__all__ = ["AmpPass"]
+
+
+class AmpPass(GraphPass):
+    """Rewrite matmul/conv to the target low precision, pin the
+    numerically sensitive ops to fp32, cast outputs back (see
+    amp/graph_pass.py for the op lists).  Per-build AmpStats land on
+    ``ctx.block._amp_stats`` (when a block owns the seam), on
+    ``ctx.notes['amp_stats']``, and accumulate into ``stats`` when one
+    is passed (legacy build_amp_variant contract)."""
+
+    name = "amp"
+    priority = 10  # precision first; remat checkpoints the cast graph
+    kinds = ("block", "export", "symbol", "whole_step_fwd")
+
+    def __init__(self, target_dtype=None, stats=None):
+        self.target_dtype = (jnp.bfloat16 if target_dtype is None
+                             else target_dtype)
+        self.stats_sink = stats
+
+    def run(self, closed, ctx):
+        from ..amp.graph_pass import AmpStats, amp_rewrite
+
+        stats = AmpStats()
+        rewritten = amp_rewrite(closed, self.target_dtype, stats)
+        new_closed = _manager.retrace_flat(rewritten, closed)
+        if self.stats_sink is not None:
+            self.stats_sink.lp16_ops += stats.lp16_ops
+            self.stats_sink.fp32_pinned_ops += stats.fp32_pinned_ops
+        if ctx.block is not None:
+            object.__setattr__(ctx.block, "_amp_stats", stats)
+        ctx.notes["amp_stats"] = stats
+        return new_closed

@@ -1,0 +1,261 @@
+"""Cross-CachedOp dedup: structurally identical captured programs share
+ONE compiled executable.
+
+Multi-head models and serving `ModelRegistry` replicas trace the same
+graph once per block today; XLA compiles each copy.  With
+``MXTPU_GRAPH_DEDUP=1`` every block-seam build canonicalizes its
+(pass-rewritten) jaxpr — de Bruijn variable numbering, shapes/dtypes,
+the equation graph, recursively through nested jaxprs — and looks the
+key up in a process-wide executable cache.  Constants enter the shared
+executable as runtime ARGUMENTS, so two blocks whose programs differ
+only in weight/const values still share.  A hit skips the trace bump
+(the `jit_trace_total` zero-retrace proof) and counts in
+``graph_dedup_hits_total``.
+
+Programs that cannot be canonicalized safely (effects, huge embedded
+constants, identity-hashed callables in eqn params) simply do not
+share — correctness first; the build falls back to a private
+executable.
+"""
+from __future__ import annotations
+
+import threading
+
+import numpy as np
+
+import jax
+from jax.extend import core as jcore
+
+from ..telemetry import instruments as _telemetry
+from . import manager as _manager
+
+__all__ = [
+    "DedupExecutable",
+    "executable_cache_info",
+    "reset_executable_cache",
+    "structural_key",
+]
+
+_CACHE_LOCK = threading.Lock()
+_EXEC_CACHE = {}
+_STATS = {"hits": 0, "misses": 0, "unhashable": 0}
+
+# Embedded constants larger than this make the key unhashable (and the
+# program un-shared) rather than hashing megabytes of weights per build.
+_MAX_CONST_BYTES = 1 << 20
+
+
+class _Unhashable(Exception):
+    pass
+
+
+def _aval_key(aval):
+    return (tuple(getattr(aval, "shape", ())),
+            str(getattr(aval, "dtype", "?")),
+            bool(getattr(aval, "weak_type", False)))
+
+
+def _canon(obj):
+    """Canonicalize one eqn param (or nested const) into a hashable,
+    value-comparable token."""
+    if obj is None or isinstance(obj, (bool, int, float, complex, str,
+                                       bytes)):
+        return obj
+    if isinstance(obj, jcore.Jaxpr):
+        return ("jaxpr", _jaxpr_key(obj))
+    if hasattr(obj, "jaxpr") and hasattr(obj, "consts"):  # ClosedJaxpr
+        # nested consts are BAKED into the shared program, so their
+        # values (not just avals) must participate in the key
+        return ("closed", tuple(_canon(c) for c in obj.consts),
+                _jaxpr_key(obj.jaxpr))
+    if isinstance(obj, np.dtype):
+        return ("dtype", str(obj))
+    if hasattr(obj, "__array__") and hasattr(obj, "dtype") \
+            and hasattr(obj, "shape"):
+        arr = np.asarray(obj)
+        if arr.nbytes > _MAX_CONST_BYTES:
+            raise _Unhashable
+        return ("nd", arr.shape, str(arr.dtype), arr.tobytes())
+    if isinstance(obj, (tuple, list)):
+        return ("seq", tuple(_canon(x) for x in obj))
+    if isinstance(obj, dict):
+        return ("map", tuple((str(k), _canon(v)) for k, v in
+                             sorted(obj.items(), key=lambda kv: str(kv[0]))))
+    if isinstance(obj, (set, frozenset)):
+        return ("set", tuple(sorted(repr(x) for x in obj)))
+    try:
+        hash(obj)
+    except TypeError:
+        raise _Unhashable from None
+    # identity-hashed objects (callables, thunks) are still CORRECT key
+    # components — equal only to themselves — they just never match
+    # across blocks, so such programs don't dedup
+    return ("obj", type(obj).__module__, type(obj).__qualname__, obj)
+
+
+# custom-derivative calls carry memoized rule thunks that hash by
+# identity and would never match across traces.  The primal body
+# (call_jaxpr / fun_jaxpr, which IS part of the key) fully determines
+# what the shared executable computes, and two traces of the same
+# library function (e.g. jax.nn.relu) carry equivalent rules — so the
+# thunks are dropped from the key rather than poisoning every program
+# that contains a relu.
+_RULE_THUNK_PARAMS = frozenset((
+    "jvp_jaxpr_thunk", "jvp_jaxpr_fun", "fwd_jaxpr_thunk",
+    "fwd", "bwd", "jvp", "out_trees",
+))
+_CUSTOM_CALL_PRIMS = frozenset((
+    "custom_jvp_call", "custom_vjp_call",
+    "custom_jvp_call_jaxpr", "custom_vjp_call_jaxpr",
+))
+
+
+def _eqn_params_key(eqn):
+    params = dict(eqn.params)
+    if eqn.primitive.name in _CUSTOM_CALL_PRIMS:
+        for k in _RULE_THUNK_PARAMS:
+            params.pop(k, None)
+    return _canon(params)
+
+
+def _jaxpr_key(jaxpr):
+    if getattr(jaxpr, "effects", None):
+        raise _Unhashable  # effectful programs never share executables
+    ids = {}
+
+    def vid(v):
+        token = ids.get(id(v))
+        if token is None:
+            token = ids[id(v)] = len(ids)
+        return token
+
+    def atom(v):
+        if isinstance(v, jcore.Literal):
+            return ("lit", _canon(v.val))
+        return ("var", vid(v), _aval_key(v.aval))
+
+    parts = [
+        ("const", tuple((vid(v), _aval_key(v.aval))
+                        for v in jaxpr.constvars)),
+        ("in", tuple((vid(v), _aval_key(v.aval)) for v in jaxpr.invars)),
+    ]
+    for eqn in jaxpr.eqns:
+        parts.append((eqn.primitive.name,
+                      tuple(atom(v) for v in eqn.invars),
+                      tuple((vid(v), _aval_key(v.aval))
+                            for v in eqn.outvars),
+                      _eqn_params_key(eqn)))
+    parts.append(("out", tuple(atom(v) for v in jaxpr.outvars)))
+    return tuple(parts)
+
+
+def structural_key(closed):
+    """Canonical key of a ClosedJaxpr modulo var names and TOP-LEVEL
+    const values (consts become runtime args of the shared executable,
+    so only their avals matter).  None ⇒ not safely shareable."""
+    try:
+        return ("prog",
+                tuple(_aval_key(jax.api_util.shaped_abstractify(c))
+                      for c in closed.consts),
+                _jaxpr_key(closed.jaxpr))
+    except _Unhashable:
+        return None
+
+
+class _SharedExec:
+    """One compiled executable serving every structurally identical
+    program: jit of ``run(consts, *flat)`` over the FIRST matching
+    jaxpr (all matches are structurally equal, so evaluating that one
+    with each caller's consts/args is exact)."""
+
+    __slots__ = ("jitted",)
+
+    def __init__(self, closed):
+        jaxpr = closed.jaxpr
+
+        def run_shared(consts, *flat):
+            return jax.core.eval_jaxpr(jaxpr, consts, *flat)
+
+        self.jitted = jax.jit(run_shared)
+
+
+class _Entry:
+    __slots__ = ("shared", "consts", "out_tree", "hit")
+
+    def __init__(self, shared, consts, out_tree, hit):
+        self.shared = shared
+        self.consts = consts
+        self.out_tree = out_tree
+        self.hit = hit
+
+
+class DedupExecutable:
+    """The block-seam executable under MXTPU_GRAPH_DEDUP=1: callable
+    like a jitted function (with ``.lower()`` for compile
+    introspection), backed by the process-wide shared-executable
+    cache."""
+
+    def __init__(self, fn, passes, ctx):
+        self._fn = fn
+        self._passes = passes
+        self._ctx = ctx
+        self._entries = {}
+        self._lock = threading.Lock()
+
+    def _entry(self, args):
+        flat, sig = _manager.signature(args)
+        entry = self._entries.get(sig)
+        if entry is None:
+            with self._lock:
+                entry = self._entries.get(sig)
+                if entry is None:
+                    entry = self._build(args)
+                    self._entries[sig] = entry
+        return entry, flat
+
+    def _build(self, args):
+        ctx = self._ctx
+        closed, out_tree = _manager.trace_closed(self._fn, args)
+        closed = _manager.run_passes(closed, self._passes, ctx)
+        key = structural_key(closed)
+        hit = False
+        if key is None:
+            with _CACHE_LOCK:
+                _STATS["unhashable"] += 1
+            shared = _SharedExec(closed)  # private, unshared
+        else:
+            with _CACHE_LOCK:
+                shared = _EXEC_CACHE.get(key)
+                hit = shared is not None
+                if not hit:
+                    shared = _EXEC_CACHE[key] = _SharedExec(closed)
+                _STATS["hits" if hit else "misses"] += 1
+        if hit:
+            _telemetry.record_dedup_hit(ctx.label)
+        else:
+            # one real build = one trace bump, exactly like a direct jit
+            ctx.fire_on_build()
+        return _Entry(shared, tuple(closed.consts), out_tree, hit)
+
+    def __call__(self, *args):
+        entry, flat = self._entry(args)
+        outs = entry.shared.jitted(list(entry.consts), *flat)
+        return jax.tree_util.tree_unflatten(entry.out_tree, list(outs))
+
+    def lower(self, *args):
+        entry, flat = self._entry(args)
+        return entry.shared.jitted.lower(list(entry.consts), *flat)
+
+
+def executable_cache_info():
+    """{entries, hits, misses, unhashable} of the process-wide shared
+    executable cache (tools/diagnose.py --passes)."""
+    with _CACHE_LOCK:
+        return {"entries": len(_EXEC_CACHE), **_STATS}
+
+
+def reset_executable_cache():
+    with _CACHE_LOCK:
+        _EXEC_CACHE.clear()
+        for k in _STATS:
+            _STATS[k] = 0

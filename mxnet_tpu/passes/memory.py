@@ -1,0 +1,189 @@
+"""Peak-residency estimation: a liveness walk over a captured jaxpr.
+
+The reference stack's NNVM memory planner assigns storage by walking
+the graph in topological order and freeing buffers at their last use;
+the peak of that walk is the plan's residency requirement.  This module
+runs the same walk over a jaxpr (recursing into pjit/remat2/custom-call
+sub-jaxprs) and reports the peak live bytes — a backend-independent
+estimate the remat `auto` policy and the diagnostics compile registry
+use.  XLA's own `memory_analysis().temp_size_in_bytes` is not usable
+for this on CPU: it reports the SUM of temp allocations, not a
+liveness-packed peak, so rematerialization never changes it there.
+
+The estimate is an upper-bound-ish approximation (no buffer aliasing,
+no fusion eliding intermediates), but it moves the right way: wrapping
+segments in ``jax.checkpoint`` drops forward activations from the
+backward program's live set, and the walk sees exactly that.
+"""
+from __future__ import annotations
+
+import itertools
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax.extend import core as jcore
+
+__all__ = [
+    "estimate_peak_bytes",
+    "estimate_training_peak_bytes",
+]
+
+# Call-like primitives whose sub-jaxpr binds the eqn's operands 1:1 —
+# safe to inline into the walk.  Loop/branch primitives (scan, while,
+# cond) slice or select their operands, so they stay opaque: their
+# outputs are counted, their bodies are not expanded.
+_INLINE_PRIMS = ("pjit", "remat2", "closed_call", "core_call",
+                 "custom_jvp_call", "custom_vjp_call",
+                 "custom_jvp_call_jaxpr", "custom_vjp_call_jaxpr")
+
+
+def _aval_bytes(aval):
+    shape = getattr(aval, "shape", None)
+    dtype = getattr(aval, "dtype", None)
+    if shape is None or dtype is None:
+        return 0
+    n = 1
+    for d in shape:
+        try:
+            n *= int(d)
+        except TypeError:  # symbolic dim
+            n *= 1
+    try:
+        itemsize = np.dtype(dtype).itemsize
+    except TypeError:
+        # extended dtypes (PRNG key arrays) — itemsize if exposed
+        itemsize = getattr(dtype, "itemsize", 4)
+    return n * itemsize
+
+
+def _sub_jaxpr(eqn):
+    """(inner Jaxpr, inner consts) when the eqn is an inlineable call,
+    else None."""
+    if eqn.primitive.name not in _INLINE_PRIMS:
+        return None
+    for key in ("jaxpr", "call_jaxpr", "fun_jaxpr"):
+        sub = eqn.params.get(key)
+        if sub is None:
+            continue
+        if hasattr(sub, "jaxpr"):  # ClosedJaxpr
+            inner, consts = sub.jaxpr, list(sub.consts)
+        else:
+            inner, consts = sub, []
+        if len(inner.invars) == len(eqn.invars):
+            return inner, consts
+    return None
+
+
+def estimate_peak_bytes(closed):
+    """Peak live bytes of one program: walk eqns in order, allocate
+    outputs, free each value after its last use.  Program inputs,
+    consts and outputs stay resident for the whole walk (they are real
+    buffers XLA holds)."""
+    jaxpr = closed.jaxpr
+    counter = itertools.count()
+    token_bytes = {}
+    steps = []  # (in_tokens, out_tokens) per flattened eqn
+
+    def new_token(aval):
+        t = next(counter)
+        token_bytes[t] = _aval_bytes(aval)
+        return t
+
+    def walk(j, in_tokens, const_tokens):
+        env = {}
+        for v, t in zip(j.constvars, const_tokens):
+            env[id(v)] = t
+        for v, t in zip(j.invars, in_tokens):
+            env[id(v)] = t
+
+        def read(v):
+            if isinstance(v, jcore.Literal):
+                return None
+            return env.get(id(v))
+
+        for eqn in j.eqns:
+            ins = [read(v) for v in eqn.invars]
+            sub = _sub_jaxpr(eqn)
+            if sub is not None:
+                inner, consts = sub
+                const_ts = [new_token(jax.api_util.shaped_abstractify(c))
+                            for c in consts]
+                inner_outs = walk(inner, ins, const_ts)
+                for v, t in zip(eqn.outvars, inner_outs):
+                    if t is None:  # inner returned a literal
+                        t = new_token(v.aval)
+                        steps.append(((), (t,)))
+                    env[id(v)] = t
+            else:
+                outs = []
+                for v in eqn.outvars:
+                    t = new_token(v.aval)
+                    env[id(v)] = t
+                    outs.append(t)
+                steps.append((tuple(t for t in ins if t is not None),
+                              tuple(outs)))
+        return [read(v) for v in j.outvars]
+
+    in_ts = [new_token(v.aval) for v in jaxpr.invars]
+    const_ts = [new_token(v.aval) for v in jaxpr.constvars]
+    out_ts = walk(jaxpr, in_ts, const_ts)
+
+    last_use = {}
+    for i, (ins, _) in enumerate(steps):
+        for t in ins:
+            last_use[t] = i
+    pinned = set(in_ts) | set(const_ts)
+    pinned.update(t for t in out_ts if t is not None)
+
+    current = set(in_ts) | set(const_ts)
+    cur = sum(token_bytes[t] for t in current)
+    peak = cur
+    for i, (ins, outs) in enumerate(steps):
+        for t in outs:
+            if t not in current:
+                current.add(t)
+                cur += token_bytes[t]
+        peak = max(peak, cur)
+        for t in set(ins) | set(outs):
+            # free at last use; dead values (never read) free immediately
+            if (t in current and t not in pinned
+                    and last_use.get(t, -1) <= i):
+                current.remove(t)
+                cur -= token_bytes[t]
+    return int(peak)
+
+
+def estimate_training_peak_bytes(closed):
+    """Peak live bytes of the fwd+bwd program derived from a forward
+    jaxpr: grad of the summed float outputs w.r.t. every float input —
+    the program whose residency rematerialization actually changes.
+    Falls back to the forward-only estimate when the program has no
+    float outputs or inputs to differentiate."""
+    jaxpr = closed.jaxpr
+
+    def _is_float(aval):
+        dtype = getattr(aval, "dtype", None)
+        return dtype is not None and jnp.issubdtype(dtype, jnp.floating)
+
+    argnums = tuple(i for i, v in enumerate(jaxpr.invars)
+                    if _is_float(v.aval))
+    has_float_out = any(_is_float(v.aval) for v in jaxpr.outvars)
+    if not argnums or not has_float_out:
+        return estimate_peak_bytes(closed)
+
+    def scalar_loss(*flat):
+        outs = jax.core.eval_jaxpr(jaxpr, closed.consts, *flat)
+        total = jnp.zeros((), jnp.float32)
+        for o in outs:
+            if hasattr(o, "dtype") and jnp.issubdtype(o.dtype,
+                                                      jnp.floating):
+                total = total + jnp.sum(o.astype(jnp.float32))
+        return total
+
+    sds = [jax.ShapeDtypeStruct(v.aval.shape, v.aval.dtype)
+           for v in jaxpr.invars]
+    grad_closed = jax.make_jaxpr(
+        jax.grad(scalar_loss, argnums=argnums))(*sds)
+    return estimate_peak_bytes(grad_closed)

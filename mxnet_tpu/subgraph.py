@@ -119,11 +119,14 @@ def _make_sub_jaxpr(eqns, out_needed):
     invars, defined = _free_and_defined(eqns)
     outvars = [v for v in dict.fromkeys(
         ov for eqn in eqns for ov in eqn.outvars) if v in out_needed]
-    from jax._src.linear_util import DebugInfo as _DebugInfo
+    try:  # moved across jax versions; Jaxpr accepts None
+        from jax._src.linear_util import DebugInfo as _DebugInfo
 
-    dbg = _DebugInfo("subgraph", "mxtpu subgraph partition",
-                     tuple(f"in{i}" for i in range(len(invars))),
-                     tuple(f"out{i}" for i in range(len(outvars))))
+        dbg = _DebugInfo("subgraph", "mxtpu subgraph partition",
+                         tuple(f"in{i}" for i in range(len(invars))),
+                         tuple(f"out{i}" for i in range(len(outvars))))
+    except ImportError:
+        dbg = None
     jaxpr = jcore.Jaxpr(constvars=(), invars=list(invars),
                         outvars=list(outvars), eqns=list(eqns),
                         debug_info=dbg)

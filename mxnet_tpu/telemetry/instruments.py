@@ -29,6 +29,9 @@ __all__ = [
     "update_dispatch_total", "fused_bucket_size", "update_donated_bytes",
     "record_update_dispatch", "record_fused_bucket",
     "step_dispatch_total", "step_donated_bytes",
+    "pass_applied_total", "pass_rewrite_ms", "graph_dedup_hits_total",
+    "remat_policy", "record_pass", "record_dedup_hit",
+    "record_remat_policy",
     "data_prefetch_total", "data_prefetch_depth",
     "record_step_dispatch", "record_device_prefetch",
     "compile_flops", "compile_peak_hbm_bytes", "device_memory_bytes",
@@ -58,6 +61,8 @@ _SERVE_BATCH_BUCKETS = (1, 2, 4, 8, 16, 32, 64, 128, 256, 512)
 _FUSED_BUCKETS = (1, 2, 4, 8, 16, 32, 64, 128, 256, 512, 1024)
 _CKPT_MS_BUCKETS = (1.0, 5.0, 10.0, 25.0, 50.0, 100.0, 250.0, 500.0,
                     1000.0, 2500.0, 5000.0, 10000.0, 30000.0)
+_PASS_MS_BUCKETS = (.1, .5, 1.0, 5.0, 10.0, 25.0, 50.0, 100.0, 250.0,
+                    500.0, 1000.0, 5000.0)
 
 # -- compiles ---------------------------------------------------------------
 jit_compile_total = counter(
@@ -169,6 +174,30 @@ step_donated_bytes = counter(
     "Bytes of parameter + optimizer-state buffers donated into "
     "whole-step dispatches so the weights update in place (HBM reuse "
     "instead of a second copy of the model)")
+
+# -- graph-pass pipeline (mxnet_tpu/passes/; docs/passes.md) ----------------
+pass_applied_total = counter(
+    "pass_applied_total",
+    "Graph-pass executions by pass name — one per pass per pipeline "
+    "build (a new block variant / input signature), never per step",
+    ["pass"])
+pass_rewrite_ms = histogram(
+    "pass_rewrite_ms",
+    "Wall ms one graph pass spent rewriting one captured jaxpr "
+    "(trace-time cost, amortized over every later dispatch)",
+    ["pass"], buckets=_PASS_MS_BUCKETS)
+graph_dedup_hits_total = counter(
+    "graph_dedup_hits_total",
+    "Pipeline builds that matched a structurally identical program "
+    "already compiled for another block and reused its executable "
+    "(MXTPU_GRAPH_DEDUP=1)", ["block"])
+remat_policy = gauge(
+    "remat_policy",
+    "Rematerialization policy the remat pass last applied per seam "
+    "label: 0=none, 1=dots, 2=full (MXTPU_REMAT_POLICY; docs/passes.md)",
+    ["block"])
+
+REMAT_POLICY_CODES = {"none": 0, "dots": 1, "full": 2}
 
 # -- input pipeline (gluon/data/dataloader.py device_prefetch) --------------
 data_prefetch_total = counter(
@@ -366,6 +395,28 @@ def record_step_dispatch(path, donated_bytes=0):
     step_dispatch_total.labels(path).inc()
     if donated_bytes:
         step_donated_bytes.inc(donated_bytes)
+
+
+def record_pass(name, ms):
+    """One graph pass rewrote one captured jaxpr in `ms` wall ms."""
+    if not REGISTRY.enabled:
+        return
+    pass_applied_total.labels(name).inc()
+    pass_rewrite_ms.labels(name).observe(ms)
+
+
+def record_dedup_hit(block):
+    """One pipeline build reused another block's shared executable."""
+    if not REGISTRY.enabled:
+        return
+    graph_dedup_hits_total.labels(block).inc()
+
+
+def record_remat_policy(block, policy):
+    """The remat pass applied `policy` at seam `block`."""
+    if not REGISTRY.enabled:
+        return
+    remat_policy.labels(block).set(REMAT_POLICY_CODES.get(policy, -1))
 
 
 def record_device_prefetch(depth):

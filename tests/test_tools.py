@@ -123,6 +123,32 @@ def test_opperf_harness():
     assert all(r["fwd_bwd_ms"] > 0 for r in rows)
 
 
+def test_diagnose_passes_smoke():
+    """tools/diagnose.py --passes: the graph-pass demo runs, the report
+    gains the passes section, and --json carries the same content
+    (docs/passes.md)."""
+    rc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "diagnose.py"),
+         "--steps", "1", "--passes"],
+        env=ENV, capture_output=True, text=True, timeout=300)
+    assert rc.returncode == 0, rc.stderr[-2000:]
+    assert "== graph passes ==" in rc.stdout
+    assert "dedup HybridSequential" in rc.stdout
+    assert "pass amp: applied" in rc.stdout
+
+    rj = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "diagnose.py"),
+         "--steps", "1", "--passes", "--json"],
+        env=ENV, capture_output=True, text=True, timeout=300)
+    assert rj.returncode == 0, rj.stderr[-2000:]
+    report = json.loads(rj.stdout.strip().split("\n")[-1])
+    pr = report["passes"]
+    assert pr["pipeline_enabled"] is True
+    assert pr["pass_applied"].get("amp", 0) >= 1
+    assert pr["executable_cache"]["hits"] >= 1
+    assert sum(pr["dedup_hits"].values()) >= 1
+
+
 def test_ckpt_cli_verify_smoke(tmp_path):
     """tools/ckpt.py verify: exit 0 on a good checkpoint, 1 on a
     corrupted payload, 2 when nothing is committed — the pre-resume

@@ -168,6 +168,80 @@ def _whole_step_report_lines(ws):
     return lines
 
 
+def _passes_demo(hidden):
+    """Short graph-pass workload: two structurally identical Dense heads
+    under MXTPU_GRAPH_DEDUP=1 (the second build is a dedup hit) plus one
+    AMP-converted block through the pipeline, so the pass/dedup/remat
+    series below have something to show."""
+    import mxnet_tpu as mx
+    from mxnet_tpu import amp
+    from mxnet_tpu.gluon import nn
+
+    os.environ["MXTPU_GRAPH_DEDUP"] = "1"
+    x = mx.np.ones((8, hidden))
+
+    def head():
+        net = nn.HybridSequential()
+        net.add(nn.Dense(hidden, activation="relu"), nn.Dense(4))
+        net.initialize()
+        net.hybridize()
+        return net
+
+    a, b = head(), head()
+    a(x)
+    b(x)  # structurally identical: shares a's compiled executable
+    c = head()
+    amp.convert_hybrid_block(c, graph_pass=True, example_inputs=(x,))
+    mx.waitall()
+
+
+def _passes_report():
+    """Graph-pass pipeline state: resolved env config, per-pass apply
+    counts/rewrite timing, dedup hits, remat policy gauge, and the
+    process-wide shared-executable cache (docs/passes.md)."""
+    from mxnet_tpu import env as _env
+    from mxnet_tpu import passes
+    from mxnet_tpu.telemetry import instruments as ti
+
+    policy_names = {v: k for k, v in ti.REMAT_POLICY_CODES.items()}
+    return {
+        "config": {k: _env.get(k) for k in
+                   ("MXTPU_PASSES", "MXTPU_REMAT_POLICY",
+                    "MXTPU_REMAT_BUDGET_MB", "MXTPU_GRAPH_DEDUP")},
+        "pipeline_enabled": passes.pipeline_enabled(),
+        "pass_applied": {labels[0]: int(c.value)
+                         for labels, c in ti.pass_applied_total.series()},
+        "pass_rewrites": {labels[0]: int(h.count)
+                          for labels, h in ti.pass_rewrite_ms.series()},
+        "dedup_hits": {labels[0]: int(c.value) for labels, c in
+                       ti.graph_dedup_hits_total.series()},
+        "remat_policy": {labels[0]: policy_names.get(int(g.value),
+                                                     int(g.value))
+                         for labels, g in ti.remat_policy.series()},
+        "executable_cache": passes.executable_cache_info(),
+    }
+
+
+def _passes_report_lines(pr):
+    lines = ["", "== graph passes =="]
+    cfg = " ".join(f"{k}={v!r}" for k, v in pr["config"].items())
+    lines.append(f"  config: {cfg} (enabled={pr['pipeline_enabled']})")
+    if pr["pass_applied"]:
+        for name, n in sorted(pr["pass_applied"].items()):
+            lines.append(f"  pass {name}: applied {n}x")
+    else:
+        lines.append("  (no passes applied)")
+    for block, n in sorted(pr["dedup_hits"].items()):
+        lines.append(f"  dedup {block}: {n} hit(s)")
+    for block, policy in sorted(pr["remat_policy"].items()):
+        lines.append(f"  remat {block}: policy={policy}")
+    cache = pr["executable_cache"]
+    lines.append(f"  executable cache: {cache['entries']} entries, "
+                 f"{cache['hits']} hits, {cache['misses']} misses, "
+                 f"{cache['unhashable']} unshareable")
+    return lines
+
+
 def main(argv=None):
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     ap.add_argument("--steps", type=int, default=3)
@@ -177,6 +251,9 @@ def main(argv=None):
                     help="machine-readable JSON instead of the text report")
     ap.add_argument("--watchdog-demo", action="store_true",
                     help="stall on purpose and show the watchdog dump")
+    ap.add_argument("--passes", action="store_true",
+                    help="run the graph-pass demo (dedup + pipeline AMP) "
+                         "and print the pass/dedup/remat report section")
     args = ap.parse_args(argv)
 
     os.environ.setdefault("MXTPU_TELEMETRY", "1")
@@ -184,6 +261,8 @@ def main(argv=None):
 
     telemetry.enable()
     _train(args.steps, args.batch, args.hidden)
+    if args.passes:
+        _passes_demo(args.hidden)
     diagnostics.update_device_memory_gauge()
 
     if args.watchdog_demo:
@@ -209,6 +288,7 @@ def main(argv=None):
             "compile_registry": reg,
             "fused_buckets": _fused_buckets(),
             "whole_step": _whole_step_report(),
+            "passes": _passes_report(),
             "device_memory": diagnostics.device_memory(),
             "telemetry": telemetry.dump(),
         }, default=str))
@@ -216,6 +296,8 @@ def main(argv=None):
         print(diagnostics.report())
         print("\n".join(_fused_report_lines(_fused_buckets())))
         print("\n".join(_whole_step_report_lines(_whole_step_report())))
+        if args.passes:
+            print("\n".join(_passes_report_lines(_passes_report())))
 
 
 if __name__ == "__main__":
