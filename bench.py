@@ -619,17 +619,27 @@ def bench_passes_compile_ms(platform):
     from mxnet_tpu import amp
     from mxnet_tpu.gluon import nn
 
+    prev = os.environ.get("MXTPU_GRAPH_DEDUP")
     os.environ["MXTPU_GRAPH_DEDUP"] = "1"
-    mx.seed(0)
-    net = nn.HybridSequential()
-    net.add(nn.Dense(256, activation="relu"), nn.Dense(64))
-    net.initialize()
-    net.hybridize()
-    amp.convert_hybrid_block(net, graph_pass=True)
-    x = mx.np.array(onp.random.RandomState(0).rand(8, 128).astype("f"))
-    t0 = time.perf_counter()
-    net(x).asnumpy()
-    return (time.perf_counter() - t0) * 1000.0
+    try:
+        mx.seed(0)
+        net = nn.HybridSequential()
+        net.add(nn.Dense(256, activation="relu"), nn.Dense(64))
+        net.initialize()
+        net.hybridize()
+        amp.convert_hybrid_block(net, graph_pass=True)
+        x = mx.np.array(onp.random.RandomState(0).rand(8, 128)
+                        .astype("f"))
+        t0 = time.perf_counter()
+        net(x).asnumpy()
+        return (time.perf_counter() - t0) * 1000.0
+    finally:
+        # later rows (peak_hbm_mb reads the whole compile registry) must
+        # not silently inherit the dedup path
+        if prev is None:
+            del os.environ["MXTPU_GRAPH_DEDUP"]
+        else:
+            os.environ["MXTPU_GRAPH_DEDUP"] = prev
 
 
 def bench_peak_hbm_mb(platform):

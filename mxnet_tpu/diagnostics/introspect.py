@@ -41,7 +41,12 @@ _lock = threading.Lock()
 
 
 def capture_enabled():
-    return os.environ.get("MXTPU_DIAG_COMPILE", "1") != "0"
+    try:
+        from .. import env as _env
+
+        return bool(_env.get("MXTPU_DIAG_COMPILE"))
+    except Exception:
+        return os.environ.get("MXTPU_DIAG_COMPILE", "1") != "0"
 
 
 def _first_dict(analysis):
@@ -125,11 +130,11 @@ def capture_compile(block, variant, jitted, args, kwargs=None,
 
 
 def _liveness_enabled():
-    if os.environ.get("MXTPU_DIAG_MEMORY", "0") != "0":
-        return True
     try:
         from .. import env as _env
 
+        if _env.get("MXTPU_DIAG_MEMORY"):  # typed bool: 'off'/'false'=0
+            return True
         return str(_env.get("MXTPU_REMAT_POLICY")).strip().lower() \
             not in ("", "none")
     except Exception:

@@ -239,6 +239,18 @@ register(
     "none, so 'auto' resolves to 'none' there without an explicit "
     "budget.")
 register(
+    "MXTPU_DIAG_COMPILE", bool, True,
+    "Capture per-compile cost/memory analysis (flops, peak HBM, compile "
+    "seconds) into the diagnostics compile registry at each block-seam "
+    "build; 0 skips capture entirely (docs/diagnostics.md).")
+register(
+    "MXTPU_DIAG_MEMORY", bool, False,
+    "Record the backend-independent liveness peak (passes/memory.py "
+    "walk) into every compile-registry entry even when no remat policy "
+    "is active; costs an extra trace (plus a grad trace for train "
+    "variants) per compile. Any MXTPU_REMAT_POLICY other than 'none' "
+    "implies it.")
+register(
     "MXTPU_GRAPH_DEDUP", bool, False,
     "Cross-CachedOp structural dedup: canonicalize every block-seam "
     "jaxpr (shapes/dtypes/equation graph, modulo variable names and "

@@ -93,28 +93,80 @@ def _canon(obj):
     return ("obj", type(obj).__module__, type(obj).__qualname__, obj)
 
 
-# custom-derivative calls carry memoized rule thunks that hash by
-# identity and would never match across traces.  The primal body
-# (call_jaxpr / fun_jaxpr, which IS part of the key) fully determines
-# what the shared executable computes, and two traces of the same
-# library function (e.g. jax.nn.relu) carry equivalent rules — so the
-# thunks are dropped from the key rather than poisoning every program
-# that contains a relu.
-_RULE_THUNK_PARAMS = frozenset((
+# custom-derivative calls carry their rule callables/thunks as params.
+# The raw objects hash by identity (unique per trace), so keying on them
+# would poison every program containing a custom op — but they CANNOT
+# simply be dropped either: the rules decide what jax.vjp through the
+# shared executable computes, and two blocks with identical primal
+# structure but different custom gradients (make_loss's constant-grad
+# bwd vs stop_gradient) must not share one executable.  Each rule param
+# is therefore reduced to a STABLE, semantics-bearing token: jaxpr
+# thunks are forced (all-zeros symbolic-zero pattern — deterministic,
+# trace-time-only cost) and keyed by the traced rule jaxpr; wrapped
+# rule callables are keyed by the identity of their underlying user
+# function, which IS shared across traces of the same library op.  A
+# rule that can't be tokenized makes the program unhashable, so it
+# falls back to a private executable — correctness first.
+_RULE_JAXPR_THUNKS = frozenset((
     "jvp_jaxpr_thunk", "jvp_jaxpr_fun", "fwd_jaxpr_thunk",
-    "fwd", "bwd", "jvp", "out_trees",
 ))
+_RULE_FUN_PARAMS = frozenset(("fwd", "bwd", "jvp"))
+_RULE_DERIVED_PARAMS = frozenset(("out_trees",))  # fixed by the fwd jaxpr
 _CUSTOM_CALL_PRIMS = frozenset((
     "custom_jvp_call", "custom_vjp_call",
     "custom_jvp_call_jaxpr", "custom_vjp_call_jaxpr",
 ))
 
 
+def _rule_fun_token(obj):
+    """Stable token for a wrapped rule callable: the underlying user
+    function (``WrappedFun.f``), equal-by-identity across traces of the
+    same op."""
+    target = getattr(obj, "__self__", obj)  # bound call_wrapped → WrappedFun
+    f = getattr(target, "f", None) or (obj if callable(obj) else None)
+    if f is None:
+        raise _Unhashable
+    return ("rulefn", f)
+
+
+# forcing is top-level only: a rule jaxpr often contains the op itself
+# (jax.nn.relu's jvp recomputes relu), so forcing nested thunks would
+# recurse forever.  Inside a forced rule, nested custom calls are keyed
+# by their primal jaxpr + stable fun tokens, which first-order
+# differentiation through the shared executable never looks past.
+_RULE_DEPTH = threading.local()
+
+
+def _rule_jaxpr_token(eqn, thunk):
+    """Force a rule-jaxpr thunk with the no-symbolic-zeros pattern and
+    key the traced rule itself."""
+    if getattr(_RULE_DEPTH, "d", 0):
+        return ("rulejaxpr", "nested")
+    n = len(eqn.invars) - int(eqn.params.get("num_consts") or 0)
+    _RULE_DEPTH.d = 1
+    try:
+        forced = thunk(*([False] * n))
+        return ("rulejaxpr", _canon(forced))
+    except _Unhashable:
+        raise
+    except Exception:
+        raise _Unhashable from None
+    finally:
+        _RULE_DEPTH.d = 0
+
+
 def _eqn_params_key(eqn):
     params = dict(eqn.params)
     if eqn.primitive.name in _CUSTOM_CALL_PRIMS:
-        for k in _RULE_THUNK_PARAMS:
-            params.pop(k, None)
+        rules = []
+        for k in sorted(params):
+            if k in _RULE_DERIVED_PARAMS:
+                params.pop(k)
+            elif k in _RULE_JAXPR_THUNKS:
+                rules.append((k, _rule_jaxpr_token(eqn, params.pop(k))))
+            elif k in _RULE_FUN_PARAMS:
+                rules.append((k, _rule_fun_token(params.pop(k))))
+        return ("custom", _canon(params), tuple(rules))
     return _canon(params)
 
 

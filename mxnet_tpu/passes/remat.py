@@ -69,7 +69,38 @@ def remat_budget_bytes():
     return None
 
 
-def segmented_remat(closed, policy, n_segments):
+def _seam_platform(closed, ctx):
+    """Platform the rewritten program will actually run on: the devices
+    already committed on the captured consts (traced weights), else the
+    seam block's materialized parameters, else the process default.
+    ``jax.default_backend()`` alone is wrong in a mixed-backend process —
+    a CPU-placed program built under a TPU default would keep the CPU-
+    hostile barrier, and an accelerator program under a CPU default
+    would lose it."""
+    platforms = set()
+
+    def collect(arr):
+        devs = getattr(arr, "devices", None)
+        if callable(devs):
+            try:
+                platforms.update(d.platform for d in devs())
+            except Exception:
+                pass
+
+    for c in closed.consts:
+        collect(c)
+    if not platforms and ctx is not None and ctx.block is not None:
+        try:
+            for _n, p in getattr(ctx.block, "_cached_param_list", ()):
+                collect(p.data()._data)
+        except Exception:
+            pass
+    if len(platforms) == 1:
+        return platforms.pop()
+    return jax.default_backend()
+
+
+def segmented_remat(closed, policy, n_segments, ctx=None):
     """Rewrite ``closed`` so its equations run as ``n_segments``
     contiguous ``jax.checkpoint`` segments; returns a new ClosedJaxpr
     computing bitwise-identical outputs."""
@@ -88,7 +119,7 @@ def segmented_remat(closed, policy, n_segments):
     # check rejects the transposed dots in the recompute); CPU has no
     # HBM to protect, so drop the CSE barrier there and keep it on real
     # accelerators where it preserves the rematerialization.
-    prevent_cse = jax.default_backend() != "cpu"
+    prevent_cse = _seam_platform(closed, ctx) != "cpu"
 
     out_needed = {id(v) for v in jaxpr.outvars
                   if not isinstance(v, jcore.Literal)}
@@ -180,7 +211,7 @@ def choose_policy(closed, ctx):
     for cand in POLICIES:
         try:
             c = closed if cand == "none" else segmented_remat(
-                closed, cand, n_seg)
+                closed, cand, n_seg, ctx)
             estimates[cand] = _memory.estimate_training_peak_bytes(c)
         except Exception:
             estimates[cand] = None
@@ -233,4 +264,4 @@ class RematPass(GraphPass):
         if policy == "none" or len(closed.jaxpr.eqns) < 2:
             return closed
         n_seg = self.segments or default_segments(len(closed.jaxpr.eqns))
-        return segmented_remat(closed, policy, n_seg)
+        return segmented_remat(closed, policy, n_seg, ctx)

@@ -134,24 +134,25 @@ def _make_sub_jaxpr(eqns, out_needed):
 
 
 def _eval_eqn(eqn, invals):
-    """Evaluate one jaxpr equation. Call-like primitives (pjit,
-    custom_jvp/vjp, remat) carry their body as a param and cannot be
-    re-`bind`-ed with plain values — inline their inner jaxpr instead."""
+    """Evaluate one jaxpr equation. Plain call primitives (pjit, remat)
+    inline their inner jaxpr. custom_jvp/vjp calls must NOT be inlined:
+    inlining the primal body discards the custom derivative rule, so
+    differentiating the re-evaluated program would silently use
+    autodiff-of-primal instead of the op's bwd (make_loss, fused
+    BatchNorm, pallas attention). They re-`bind` with their original
+    params instead — `get_bind_params` reconstructs the rule callables,
+    exactly as `jax.core.eval_jaxpr` does."""
     import jax.core as _core
 
     name = eqn.primitive.name
     if name == "pjit" or name == "closed_call":
         inner = eqn.params["jaxpr"]
         return _core.eval_jaxpr(inner.jaxpr, inner.consts, *invals)
-    if name in ("custom_jvp_call", "custom_vjp_call",
-                "custom_vjp_call_jaxpr"):
-        inner = (eqn.params.get("call_jaxpr")
-                 or eqn.params.get("fun_jaxpr"))
-        return _core.eval_jaxpr(inner.jaxpr, inner.consts, *invals)
     if name in ("remat2", "checkpoint"):
         inner = eqn.params["jaxpr"]
         return _core.eval_jaxpr(inner, (), *invals)
-    out = eqn.primitive.bind(*invals, **eqn.params)
+    subfuns, bind_params = eqn.primitive.get_bind_params(eqn.params)
+    out = eqn.primitive.bind(*subfuns, *invals, **bind_params)
     if eqn.primitive.multiple_results and not isinstance(out, (tuple, list)):
         out = [out]
     return out

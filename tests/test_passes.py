@@ -35,13 +35,42 @@ def _x(shape=(4, 8), seed=0):
                        .astype("f"))
 
 
+class _CustomGradNet(gluon.HybridBlock):
+    """Dense → BatchNorm → Dense → make_loss: training-mode BatchNorm
+    and make_loss both differentiate through custom_vjp rules (the
+    hand-written closed-form BN bwd; make_loss's constant-grad bwd that
+    IGNORES the upstream cotangent), so any rewrite that silently
+    replaces a custom rule with autodiff-of-primal fails parity here."""
+
+    def __init__(self):
+        super().__init__()
+        self.d1 = gluon.nn.Dense(32, activation="tanh")
+        self.bn = gluon.nn.BatchNorm(axis=-1)
+        self.d2 = gluon.nn.Dense(8)
+
+    def forward(self, x):
+        from mxnet_tpu import nd
+
+        h = self.bn(self.d1(x))
+        return nd.make_loss(self.d2(h), grad_scale=3.0)
+
+
+def _custom_grad_net(seed=0):
+    mx.seed(seed)
+    net = _CustomGradNet()
+    net.initialize()
+    net.hybridize()
+    return net
+
+
 def _loss_and_grads(net, x):
     with autograd.record():
         out = net(x)
         loss = (out * out).sum()
     loss.backward()
     grads = {n: p.grad().asnumpy().copy()
-             for n, p in net.collect_params().items()}
+             for n, p in net.collect_params().items()
+             if p.grad_req != "null"}  # BN moving stats have no grad
     return loss.asnumpy().copy(), grads
 
 
@@ -185,6 +214,54 @@ def test_remat_bitwise_parity(monkeypatch, policy):
     assert set(g0) == set(g1)
     for n in g0:
         np.testing.assert_array_equal(g0[n], g1[n])
+
+
+@pytest.mark.parametrize("policy", ["dots", "full"])
+def test_remat_preserves_custom_vjp_rules(monkeypatch, policy):
+    # make_loss's bwd returns grad_scale regardless of the upstream
+    # cotangent, and BN's bwd is the closed-form kernel — if remat
+    # segmentation inlined the primal bodies, autodiff-of-primal would
+    # produce very different grads (identity-forward make_loss would
+    # just pass the cotangent through)
+    x = _x((16, 12), seed=14)
+    monkeypatch.setenv("MXTPU_REMAT_POLICY", "none")
+    l0, g0 = _loss_and_grads(_custom_grad_net(seed=77), x)
+    monkeypatch.setenv("MXTPU_REMAT_POLICY", policy)
+    l1, g1 = _loss_and_grads(_custom_grad_net(seed=77), x)
+    np.testing.assert_array_equal(l0, l1)
+    assert set(g0) == set(g1)
+    for n in g0:
+        np.testing.assert_array_equal(g0[n], g1[n])
+
+
+def test_segmented_remat_keeps_custom_vjp_bwd():
+    import jax
+    import jax.numpy as jnp
+
+    from mxnet_tpu.ops.tensor import make_loss
+    from mxnet_tpu.passes import remat
+
+    def body(x):
+        h = jnp.tanh(x * 2.0)
+        return make_loss(h, grad_scale=3.0).sum()
+
+    xb = jnp.linspace(-1.0, 1.0, 8, dtype=jnp.float32)
+    closed, _ = passes.trace_closed(body, (xb,))
+    seg = remat.segmented_remat(closed, "full", 2)
+
+    def f_ref(v):
+        return jax.core.eval_jaxpr(closed.jaxpr, closed.consts, v)[0]
+
+    def f_seg(v):
+        return jax.core.eval_jaxpr(seg.jaxpr, seg.consts, v)[0]
+
+    g_ref = np.asarray(jax.grad(f_ref)(xb))
+    g_seg = np.asarray(jax.grad(f_seg)(xb))
+    np.testing.assert_array_equal(g_ref, g_seg)
+    # and both ARE the custom bwd: 3.0 through tanh' * 2, not the
+    # upstream-cotangent passthrough the identity primal would give
+    expected = 3.0 * (1.0 - np.tanh(2.0 * np.asarray(xb)) ** 2) * 2.0
+    np.testing.assert_allclose(g_ref, expected, rtol=1e-5, atol=1e-6)
 
 
 def test_remat_applies_only_to_training(monkeypatch):
@@ -339,6 +416,63 @@ def test_dedup_grads_bitwise_vs_no_dedup(monkeypatch):
     _ = _mlp(seed=51)(x)
     net = _mlp(seed=51)
     l1, g1 = _loss_and_grads(net, x)
+    np.testing.assert_array_equal(l0, l1)
+    for n in g0:
+        np.testing.assert_array_equal(g0[n], g1[n])
+    assert passes.executable_cache_info()["hits"] >= 1
+
+
+def test_dedup_key_distinguishes_custom_grad_rules():
+    import jax
+    import jax.numpy as jnp
+
+    from mxnet_tpu.ops.tensor import make_loss
+    from mxnet_tpu.passes.dedup import structural_key
+
+    # same library op, two traces: keys MATCH (the dedup win survives —
+    # rule tokens are stable across traces of one custom_vjp op)
+    k1 = structural_key(
+        jax.make_jaxpr(lambda v: make_loss(v * 2.0))(jnp.ones(4)))
+    k2 = structural_key(
+        jax.make_jaxpr(lambda v: make_loss(v * 2.0))(jnp.ones(4)))
+    assert k1 is not None and k1 == k2
+
+    # identical primal graphs, DIFFERENT custom bwd rules: keys differ.
+    # Sharing one executable would apply the first block's bwd to the
+    # second block's training (train variants go through jax.vjp of the
+    # compiled callable).
+    @jax.custom_vjp
+    def ident3(v):
+        return v
+
+    ident3.defvjp(lambda v: (v, v),
+                  lambda r, g: (jnp.full_like(r, 3.0),))
+
+    @jax.custom_vjp
+    def ident9(v):
+        return v
+
+    ident9.defvjp(lambda v: (v, v),
+                  lambda r, g: (jnp.full_like(r, 9.0),))
+
+    k3 = structural_key(
+        jax.make_jaxpr(lambda v: ident3(v * 2.0))(jnp.ones(4)))
+    k9 = structural_key(
+        jax.make_jaxpr(lambda v: ident9(v * 2.0))(jnp.ones(4)))
+    assert k3 is not None and k9 is not None
+    assert k3 != k9
+
+
+def test_dedup_grads_bitwise_with_custom_ops(monkeypatch):
+    # custom_vjp-bearing programs (BN train kernel, make_loss) still
+    # dedup across identical blocks AND keep their custom gradients
+    x = _x((16, 12), seed=15)
+    l0, g0 = _loss_and_grads(_custom_grad_net(seed=88), x)
+    monkeypatch.setenv("MXTPU_GRAPH_DEDUP", "1")
+    passes.reset_executable_cache()
+    # a full first training seeds the cache with the TRAIN variant
+    _ = _loss_and_grads(_custom_grad_net(seed=88), x)
+    l1, g1 = _loss_and_grads(_custom_grad_net(seed=88), x)
     np.testing.assert_array_equal(l0, l1)
     for n in g0:
         np.testing.assert_array_equal(g0[n], g1[n])

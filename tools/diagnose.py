@@ -177,22 +177,30 @@ def _passes_demo(hidden):
     from mxnet_tpu import amp
     from mxnet_tpu.gluon import nn
 
+    prev = os.environ.get("MXTPU_GRAPH_DEDUP")
     os.environ["MXTPU_GRAPH_DEDUP"] = "1"
-    x = mx.np.ones((8, hidden))
+    try:
+        x = mx.np.ones((8, hidden))
 
-    def head():
-        net = nn.HybridSequential()
-        net.add(nn.Dense(hidden, activation="relu"), nn.Dense(4))
-        net.initialize()
-        net.hybridize()
-        return net
+        def head():
+            net = nn.HybridSequential()
+            net.add(nn.Dense(hidden, activation="relu"), nn.Dense(4))
+            net.initialize()
+            net.hybridize()
+            return net
 
-    a, b = head(), head()
-    a(x)
-    b(x)  # structurally identical: shares a's compiled executable
-    c = head()
-    amp.convert_hybrid_block(c, graph_pass=True, example_inputs=(x,))
-    mx.waitall()
+        a, b = head(), head()
+        a(x)
+        b(x)  # structurally identical: shares a's compiled executable
+        c = head()
+        amp.convert_hybrid_block(c, graph_pass=True, example_inputs=(x,))
+        mx.waitall()
+    finally:
+        # the demo must not leave dedup on for everything built after it
+        if prev is None:
+            del os.environ["MXTPU_GRAPH_DEDUP"]
+        else:
+            os.environ["MXTPU_GRAPH_DEDUP"] = prev
 
 
 def _passes_report():
