@@ -507,6 +507,71 @@ def bench_whole_step(platform, iters, warmup):
             batch * iters / dt_w)
 
 
+def bench_numerics_overhead(platform, iters, warmup):
+    """Whole-step latency with MXTPU_NUMERICS=step vs off on the same
+    model: the in-graph is-finite AND-reduce plus its async callback
+    (docs/observability.md). Returns (step_mode_ms, off_ms). The
+    acceptance bar is <=3% overhead; the note carries the ratio."""
+    import numpy as onp
+
+    import mxnet_tpu as mx
+    from mxnet_tpu import gluon
+    from mxnet_tpu.gluon import nn
+
+    batch = 32 if platform == "cpu" else 128
+    feats, classes = (128, 10) if platform == "cpu" else (512, 100)
+    rs = onp.random.RandomState(0)
+    x = mx.np.array(rs.rand(batch, feats).astype("f"))
+    y = mx.np.array(rs.randint(0, classes, (batch,)))
+    lossfn = gluon.loss.SoftmaxCrossEntropyLoss()
+
+    def run(numerics_mode):
+        prev = os.environ.get("MXTPU_NUMERICS")
+        os.environ["MXTPU_NUMERICS"] = numerics_mode
+        try:
+            mx.seed(0)
+            net = nn.HybridSequential()
+            net.add(nn.Dense(256, activation="relu"), nn.Dense(256),
+                    nn.Dense(classes))
+            net.initialize()
+            net.hybridize()
+            trainer = gluon.Trainer(net.collect_params(), "sgd",
+                                    {"learning_rate": 0.05})
+            step = gluon.TrainStep(net, lossfn, trainer)
+            dt, _ = _timeit(lambda: step(x, y),
+                            lambda l: float(l.sum().asnumpy()),
+                            iters, warmup)
+            if step.last_path != "whole_step":
+                raise RuntimeError("numerics bench fell back to phased")
+            return dt / iters * 1000.0
+        finally:
+            if prev is None:
+                os.environ.pop("MXTPU_NUMERICS", None)
+            else:
+                os.environ["MXTPU_NUMERICS"] = prev
+
+    off_ms = run("off")
+    step_ms = run("step")
+    return step_ms, off_ms
+
+
+def bench_flightrec_record_ms(records=1000):
+    """Steady-state flight-recorder cost: wall ms per `records` record()
+    calls into a full ring (the hot-path budget — one dict build + one
+    deque append + one counter bump per event)."""
+    from mxnet_tpu.observability import flight
+
+    flight.reset()
+    for i in range(flight.capacity()):  # steady state: ring already full
+        flight.record("warm", i=i)
+    t0 = time.perf_counter()
+    for i in range(records):
+        flight.record("bench", i=i, value=1.5)
+    dt = time.perf_counter() - t0
+    flight.reset()
+    return dt * 1000.0
+
+
 def bench_ckpt_save_ms(platform, saves=3):
     """Milliseconds per committed checkpoint of ResNet-50-sized training
     state (161 param tensors + SGD-momentum state, ~205 MB of f32)
@@ -797,6 +862,35 @@ def main():
             "note": ab_note})
     except Exception as e:
         rows.append({"metric": "train_step_wholestep_ab", "error": str(e)})
+
+    # observability overhead: numerics step-mode A/B + flight-recorder
+    # hot-path cost; both _ms rows → lower-is-better gate, and the
+    # numerics note carries the vs-off ratio (acceptance bar: <=3%)
+    try:
+        if over_budget():
+            raise TimeoutError("bench budget exhausted")
+        nm_iters = iters if platform != "cpu" else 5
+        nm_ms, off_ms = bench_numerics_overhead(platform, nm_iters, warmup)
+        rows.append({
+            "metric": "train_step_ms_numerics" + suffix,
+            "value": round(nm_ms, 3), "unit": "ms",
+            "note": f"whole-step latency with MXTPU_NUMERICS=step "
+                    f"(fused is-finite AND-reduce + async callback); "
+                    f"vs off: {nm_ms / off_ms:.4f}x "
+                    f"(off={off_ms:.3f}ms; docs/observability.md)"})
+    except Exception as e:
+        rows.append({"metric": "train_step_ms_numerics", "error": str(e)})
+    try:
+        if over_budget():
+            raise TimeoutError("bench budget exhausted")
+        fr_ms = bench_flightrec_record_ms()
+        rows.append({
+            "metric": "flightrec_record_ms" + suffix,
+            "value": round(fr_ms, 3), "unit": "ms",
+            "note": "wall ms per 1000 flight.record() calls into a full "
+                    "ring (steady state; docs/observability.md)"})
+    except Exception as e:
+        rows.append({"metric": "flightrec_record_ms", "error": str(e)})
 
     # serving-engine QPS runs on every platform (cheap MLP — the row
     # measures the batching/dispatch path, which exists on CPU too)

@@ -238,15 +238,34 @@ class LossScaler:
         self._factor = scale_factor
         self._window = scale_window
         self._unskipped = 0
+        self._check_cache = {}  # (shape, dtype) signature -> jitted check
 
     def has_overflow(self, params):
-        for p in params:
-            if p.grad_req == "null":
-                continue
-            g = p.grad()
-            if not bool(jnp.isfinite(g._data).all()):
-                return True
-        return False
+        """True when any gradient holds a non-finite value — ONE fused
+        device reduction (the multi_all_finite kernel) and ONE host sync
+        per step, instead of a per-array isfinite + sync loop."""
+        grads = [p.grad()._data for p in params if p.grad_req != "null"]
+        if not grads:
+            return False
+        import jax
+
+        from ..ops.optimizer_ops import multi_all_finite
+
+        sig = tuple((g.shape, str(g.dtype)) for g in grads)
+        fn = self._check_cache.get(sig)
+        if fn is None:
+            fn = self._check_cache[sig] = jax.jit(
+                lambda *gs: multi_all_finite(*gs))
+        overflow = not bool(fn(*grads)[0])  # the step's one host sync
+        if overflow:
+            try:
+                from ..observability import flight as _flight
+
+                _flight.record("amp_overflow", arrays=len(grads),
+                               loss_scale=float(self.loss_scale))
+            except Exception:
+                pass
+        return overflow
 
     def update_scale(self, overflow):
         if overflow:

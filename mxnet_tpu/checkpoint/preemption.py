@@ -96,6 +96,12 @@ class PreemptionHandler:
         if not self._once.acquire(blocking=False):
             return  # second delivery while the snapshot runs: ignore
         self.preempted = True
+        try:
+            from ..observability import flight as _flight
+
+            _flight.record("preempt", signal=int(signum))
+        except Exception:
+            pass
         saved = False
         try:
             from .. import _checkpoint_io
@@ -121,6 +127,15 @@ class PreemptionHandler:
             print("mxnet_tpu.checkpoint: emergency preemption snapshot "
                   "FAILED; exiting 1 (latest state NOT saved)",
                   file=sys.stderr)
+        try:
+            # the black box rides out with the eviction — synchronous,
+            # like the snapshot: async would race the kill
+            from ..observability import postmortem as _postmortem
+
+            _postmortem.dump(reason="preempt", sync=True,
+                             extra={"snapshot_saved": saved})
+        except Exception:
+            pass
         if self.exit:
             sys.exit(self.exit_code if saved else 1)
         self._once.release()  # stay armed for a later re-delivery

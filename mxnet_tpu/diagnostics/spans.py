@@ -36,6 +36,7 @@ __all__ = [
     "records", "set_ring_capacity", "ring_capacity",
     "current_stack", "all_stacks",
     "mark_step", "current_step",
+    "set_trace_context", "trace_context",
     "step_table", "format_step_table", "emit_chrome_spans",
     "PHASES",
 ]
@@ -63,6 +64,23 @@ _open_stacks = {}
 _open_lock = threading.Lock()
 
 _step = [0]  # training-step index, bumped by Trainer.step via mark_step()
+
+# cross-rank trace correlation (observability.flight.set_identity pushes
+# the process's job/rank here; with the step index already on every
+# record, (job, step) is the trace ID tools/blackbox.py aligns ranks on)
+_trace_ctx = {}
+
+
+def set_trace_context(job=None, rank=None):
+    """Stamp (job, rank) onto every subsequently recorded span."""
+    if job is not None:
+        _trace_ctx["job"] = str(job)
+    if rank is not None:
+        _trace_ctx["rank"] = int(rank)
+
+
+def trace_context():
+    return dict(_trace_ctx)
 
 
 def enabled():
@@ -132,6 +150,8 @@ def span(name, cat="host"):
             "depth": len(st),
             "step": _step[0],
         }
+        if _trace_ctx:
+            rec.update(_trace_ctx)
         with _ring_lock:
             _ring.append(rec)
 

@@ -126,6 +126,10 @@ def _ensure_scanner():
         _scanner.start()
 
 
+_last_beat = [0.0]
+_BEAT_EVERY_S = 1.0
+
+
 def _scan_loop():
     while True:
         # poll fast relative to the shortest plausible deadline so tests
@@ -134,10 +138,21 @@ def _scan_loop():
         now = time.monotonic()
         expired = []
         with _lock:
+            open_sites = [g["site"] for g in _guards.values()]
             for g in _guards.values():
                 if not g["fired"] and now >= g["deadline"]:
                     g["fired"] = True
                     expired.append(dict(g))
+        if open_sites and now - _last_beat[0] >= _BEAT_EVERY_S:
+            # throttled liveness beat: a postmortem of a hung job shows
+            # the watchdog was alive and what it was guarding
+            _last_beat[0] = now
+            try:
+                from ..observability import flight as _flight
+
+                _flight.record("watchdog_beat", sites=open_sites)
+            except Exception:
+                pass
         for g in expired:
             _fire(g)
 
@@ -156,6 +171,15 @@ def _fire(g):
         with open(path, "a") as f:
             f.write(text)
     except OSError:
+        pass
+    try:
+        from ..observability import flight as _flight
+        from ..observability import postmortem as _postmortem
+
+        _flight.record("watchdog", site=g["site"])
+        # the stall evidence, as a bundle other ranks' bundles merge with
+        _postmortem.dump(reason=f"watchdog:{g['site']}", sync=False)
+    except Exception:
         pass
     if str(_opt("MXTPU_WATCHDOG_RAISE", "0")) not in ("0", "", "false"):
         import _thread

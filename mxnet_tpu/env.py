@@ -262,3 +262,46 @@ register(
     "bench.py wall-clock budget (seconds); secondary rows are skipped "
     "with an error row once exceeded so the driver always gets the "
     "headline JSON quickly.")
+register(
+    "MXTPU_NUMERICS", str, "off",
+    "In-graph numerics checking (observability.numerics; "
+    "docs/observability.md): 'step' fuses ONE is-finite AND-reduce over "
+    "every inexact program output into each compiled program (verdict "
+    "delivered asynchronously, read at the step boundary; a trip "
+    "bisects the recorded jaxpr to the first non-finite equation and "
+    "raises NonFiniteError with op/shape/operand-stats attribution); "
+    "'op' re-emits the program with a per-equation is-finite flag "
+    "vector for immediate attribution; 'off' (default) compiles "
+    "programs untouched.")
+register(
+    "MXTPU_FLIGHTREC", bool, True,
+    "Flight recorder (observability.flight): append structured runtime "
+    "events (steps, compiles, collectives, checkpoint commits, serving "
+    "sheds, watchdog beats, numerics trips) to a bounded in-memory "
+    "ring for postmortem bundles. 0 reduces recording to a single "
+    "branch.")
+register(
+    "MXTPU_FLIGHTREC_CAPACITY", int, 4096,
+    "Flight-recorder ring capacity: the postmortem bundle holds the "
+    "LAST this-many events.")
+register(
+    "MXTPU_FLIGHTREC_DIR", str, ".",
+    "Directory postmortem bundles are written to "
+    "(mxtpu_blackbox.rank<N>.json, one per rank).")
+register(
+    "MXTPU_FLIGHTREC_FLUSH_STEPS", int, 0,
+    "Spill the postmortem bundle asynchronously every N training-step "
+    "events, so a SIGKILL'd run still leaves evidence on disk for "
+    "tools/blackbox.py. 0 (default) disables periodic spills; crash "
+    "paths (watchdog, preemption, crash hooks, numerics trips) dump "
+    "regardless.")
+register(
+    "MXTPU_FLIGHTREC_CRASHDUMP", bool, False,
+    "Auto-install the observability crash hooks at import: sys.excepthook "
+    "and atexit write a final postmortem bundle; faulthandler dumps "
+    "native-fault tracebacks to a per-rank sidecar file.")
+register(
+    "MXTPU_JOB_ID", str, "",
+    "Job identity stamped into flight-recorder events and span records; "
+    "(job_id, step) is the cross-rank trace ID tools/blackbox.py aligns "
+    "per-rank postmortem bundles on. Empty = 'local'.")

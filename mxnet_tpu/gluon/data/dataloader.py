@@ -241,13 +241,22 @@ class DataLoader:
             return self._mp_pool
         # fork is cheap (COW dataset) but risky from a multi-threaded
         # parent (the reference accepted the same trade-off — its workers
-        # fork after MXNet init). Python-level threads force spawn; jax's
+        # fork after MXNet init). USER Python threads force spawn; jax's
         # internal threads only warn, since workers never call jax.
-        # MXTPU_MP_START=fork|spawn|forkserver overrides.
+        # Framework service threads (all named "mxtpu-*": the watchdog
+        # scanner, serving batcher, prefetch producers) don't gate the
+        # choice either — they only wait on queues/deadlines and workers
+        # never touch their subsystems, so a long-lived observability
+        # thread must not silently flip every loader to spawn (which
+        # also requires picklable datasets). MXTPU_MP_START overrides.
         from ... import env as _env
 
+        user_threads = [
+            t for t in threading.enumerate()
+            if t is not threading.main_thread()
+            and not t.name.startswith("mxtpu-")]
         start = _env.get("MXTPU_MP_START") or (
-            "fork" if threading.active_count() <= 1 else "spawn")
+            "fork" if not user_threads else "spawn")
         ctx = _mp.get_context(start)
         self._mp_pool = ctx.Pool(self._num_workers,
                                  initializer=_mp_worker_init,
@@ -313,7 +322,8 @@ class DataLoader:
             finally:
                 out_q.put(StopIteration)
 
-        t = threading.Thread(target=producer, daemon=True)
+        t = threading.Thread(target=producer, name="mxtpu-data-producer",
+                             daemon=True)
         t.start()
         try:
             while True:

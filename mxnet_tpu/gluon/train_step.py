@@ -59,6 +59,17 @@ def _wrap_tree(datas):
     return jax.tree_util.tree_map(NDArray, datas)
 
 
+def _numerics_mode():
+    """Live MXTPU_NUMERICS mode; 'off' when observability is broken —
+    the check layer must never take the training step down."""
+    try:
+        from ..observability import numerics as _numerics
+
+        return _numerics.mode()
+    except Exception:
+        return "off"
+
+
 class TrainStep:
     """One training iteration as a single compiled, donated dispatch.
 
@@ -373,6 +384,50 @@ class TrainStep:
             self._jit_variants[donate] = fn
         return fn
 
+    def _numerics_boundary(self, loss_data, step_args):
+        """MXTPU_NUMERICS trip check at the step boundary, BEFORE results
+        are written back — a rejected step leaves params/state at their
+        pre-step values. ``step`` mode pays no extra host sync: the step
+        boundary already waits on the loss, and the effects barrier just
+        flushes the callback the device has by then delivered. On a trip
+        the recorded program is re-run eagerly (:func:`numerics.bisect`)
+        on the live dispatch operands, the attribution lands in an atomic
+        postmortem bundle, and :class:`NonFiniteError` carries all of it.
+        """
+        from ..observability import numerics as _numerics
+
+        jax.block_until_ready(loss_data)
+        _numerics.effects_barrier()
+        trip = _numerics.take_trip(label_prefix="whole_step")
+        if trip is None:
+            return
+        report = trip.get("equation")  # op mode attributes at the callback
+        if report is None:
+            with _spans.span("numerics_bisect", cat="sync"):
+                self._introspecting = True  # the re-trace is not a retrace
+                try:
+                    report = _numerics.bisect_callable(
+                        self._step_fn, *step_args)
+                except Exception:
+                    report = None
+                finally:
+                    self._introspecting = False
+            if report is not None:
+                trip["equation"] = report
+        bundle = None
+        try:
+            from ..observability import postmortem as _postmortem
+
+            bundle = _postmortem.dump(
+                reason="numerics", extra={"numerics_bisect": report})
+        except Exception:
+            pass
+        raise _numerics.NonFiniteError(
+            f"non-finite values in the whole-step program at step "
+            f"{trip.get('step')}: {_numerics.format_report(report)} "
+            f"(postmortem: {bundle})",
+            trip=trip, report=report, bundle=bundle)
+
     # -- execution ---------------------------------------------------------
     def __call__(self, *batch, batch_size=None):
         for a in batch:
@@ -458,6 +513,11 @@ class TrainStep:
             inputs = [jax.device_put(x, shd) for x in inputs]
         donate = _donate_enabled() and _donation_safe(
             (tws, states), (frozen, inputs, key))
+        nmode = _numerics_mode()
+        if donate and nmode == "step":
+            # a tripped check bisects by re-running the recorded program
+            # on THESE operands — they must survive the dispatch
+            donate = False
         fn = self._jitted(donate)
         before = _cache_size(fn)
         t0 = time.perf_counter()
@@ -485,6 +545,10 @@ class TrainStep:
                     compile_seconds=compile_seconds)
             finally:
                 self._introspecting = False
+        if nmode != "off":
+            self._numerics_boundary(
+                loss_data,
+                (tws, frozen, states, key, lrs, wds, ts, hyper, *inputs))
         # write results back into the live containers (the donated
         # buffers are dead; these are the fresh in-place outputs)
         for i, n, p in self._train_items:

@@ -157,6 +157,18 @@ class Trainer:
         self._last_step_end = now
         _telemetry.observe_step(
             None if last is None else now - last, examples=batch_size)
+        try:
+            # the flight recorder's per-step heartbeat: carries enough to
+            # read training health off a postmortem (loss arrives via
+            # flight.record_loss when a loop host-syncs it)
+            from ..observability import flight as _flight
+
+            _flight.record(
+                "step", examples=batch_size,
+                lr=getattr(self._optimizer, "learning_rate", None),
+                dt=None if last is None else now - last)
+        except Exception:
+            pass
 
     def update(self, batch_size, ignore_stale_grad=False,
                _skip_rescale=False):

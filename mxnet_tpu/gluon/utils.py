@@ -81,12 +81,31 @@ def clip_global_norm(arrays, max_norm, check_isfinite=True):
         norm = float(total.asnumpy())
         if not math.isfinite(norm):
             # reference (utils.py clip_global_norm): WARN and skip the
-            # rescale — training code decides what to do with the step
+            # rescale — training code decides what to do with the step.
+            # Attribution beyond the reference: one fused per-array
+            # is-finite pass names WHICH arrays poisoned the norm.
             import warnings
 
+            offenders = _nonfinite_offenders(arrays)
+            detail = ""
+            if offenders:
+                i, a = offenders[0]
+                detail = (
+                    f"; first non-finite array: #{i} "
+                    f"{a.shape}/{a.dtype}"
+                    f" ({len(offenders)} of {len(arrays)} non-finite)")
+            try:
+                from ..observability import flight as _flight
+
+                _flight.record(
+                    "clip_nonfinite", norm=norm,
+                    offenders=[i for i, _ in offenders],
+                    arrays=len(arrays))
+            except Exception:
+                pass
             warnings.warn(
                 f"nan or inf is detected. Clipping results will be "
-                f"undefined (global norm = {norm})", stacklevel=2)
+                f"undefined (global norm = {norm}{detail})", stacklevel=2)
             return norm
         if norm > max_norm:
             for a in arrays:
@@ -95,6 +114,22 @@ def clip_global_norm(arrays, max_norm, check_isfinite=True):
     for a in arrays:
         a *= scale  # multiply by 1.0 when under the limit
     return total
+
+
+def _nonfinite_offenders(arrays):
+    """[(index, array)] of arrays holding non-finite values — one fused
+    device pass + one host read (only runs on the already-failed path)."""
+    import jax.numpy as jnp
+
+    try:
+        flags = apply_op(
+            lambda *xs: jnp.stack([jnp.isfinite(x).all() for x in xs]),
+            *arrays, name="isfinite_flags")
+        finite = _onp.asarray(flags.asnumpy()).astype(bool)
+        return [(i, a) for i, (a, ok) in enumerate(zip(arrays, finite))
+                if not ok]
+    except Exception:
+        return []
 
 
 def check_sha1(filename, sha1_hash):

@@ -320,7 +320,8 @@ class PrefetchingIter(DataIter):
                     return
                 q.put(batches)
 
-        self._thread = threading.Thread(target=worker, daemon=True)
+        self._thread = threading.Thread(
+            target=worker, name="mxtpu-io-prefetch", daemon=True)
         self._thread.start()
 
     def next(self):

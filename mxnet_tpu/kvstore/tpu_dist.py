@@ -73,6 +73,15 @@ class TPUDist(KVStoreBase):
         self._devices = devices  # optional explicit jax device list
         self._optimizer = None
         self._sum_cache = {}
+        try:
+            # stamp (job, rank) into flight events + span records so
+            # tools/blackbox.py can align this rank's postmortem bundle
+            # with its peers on the shared (job_id, step) trace ID
+            from ..observability import flight as _flight
+
+            _flight.set_identity(rank=self.rank, world=self.num_workers)
+        except Exception:
+            pass
         if self.num_workers > 1:
             # establish the cross-process collective context NOW, while rank
             # skew is minimal — later pushpulls may be separated by long

@@ -1,0 +1,55 @@
+"""Observability plane: numerics checking, flight recorder, postmortems.
+
+Three coordinated pieces (docs/observability.md):
+
+  * :mod:`~mxnet_tpu.observability.numerics` — a graph pass
+    (``MXTPU_NUMERICS=off|step|op``) that instruments captured jaxprs
+    with fused is-finite checks and, on a trip, bisects the recorded
+    program to the first non-finite equation;
+  * :mod:`~mxnet_tpu.observability.flight` — the bounded ring of
+    structured runtime events every subsystem reports into;
+  * :mod:`~mxnet_tpu.observability.postmortem` — serializes everything
+    (events + telemetry + spans + compile registry + env snapshot) into
+    one atomic per-rank bundle that ``tools/blackbox.py`` merges across
+    ranks.
+
+Quick use::
+
+    import mxnet_tpu as mx
+    mx.observability.record_event("phase", name="warmup done")
+    path = mx.observability.dump(reason="manual")   # the black box
+
+Set ``MXTPU_FLIGHTREC_CRASHDUMP=1`` to auto-arm the excepthook /
+atexit / faulthandler crash hooks at import.
+"""
+from __future__ import annotations
+
+import os
+
+from . import flight, numerics, postmortem  # noqa: F401
+from .flight import (  # noqa: F401
+    events, record, record_loss, set_identity, trace_id,
+)
+from .numerics import NonFiniteError  # noqa: F401
+from .postmortem import dump, install_crash_hooks  # noqa: F401
+
+__all__ = [
+    "flight", "numerics", "postmortem",
+    "record", "record_event", "record_loss", "events",
+    "set_identity", "trace_id",
+    "dump", "install_crash_hooks", "reset",
+    "NonFiniteError",
+]
+
+record_event = record
+
+
+def reset():
+    """Test hygiene: drop flight events and numerics trip bookkeeping."""
+    flight.reset()
+    numerics.reset()
+
+
+if os.environ.get("MXTPU_FLIGHTREC_CRASHDUMP", "").lower() \
+        not in ("", "0", "false", "off"):
+    install_crash_hooks()

@@ -1,0 +1,224 @@
+"""Flight recorder: a bounded, lock-cheap ring of structured events.
+
+The black-box half of the observability plane (docs/observability.md):
+every notable runtime event — training steps, compile-cache misses,
+collective dispatches, checkpoint commits, serving admissions/sheds,
+watchdog beats, numerics trips — lands here as one small dict. The ring
+is bounded (``MXTPU_FLIGHTREC_CAPACITY``), so a week-long job holds the
+*last* N events, exactly what a postmortem needs; ``postmortem.dump()``
+serializes it (with the telemetry/span/compile-registry snapshots) into
+one atomic bundle that ``tools/blackbox.py`` can merge across ranks.
+
+Hot-path cost: one ``enabled`` check, one dict build, one
+``deque.append`` (atomic under the GIL — no lock on the append path;
+the snapshot in :func:`events` copies under a lock only to get a
+consistent list). ``MXTPU_FLIGHTREC=0`` turns recording into a single
+branch.
+
+Cross-rank correlation: :func:`set_identity` stamps this process's
+``(job_id, rank)`` — called by ``kvstore.tpu_dist`` at init — and every
+event carries the live training-step index, so ``(job_id, step)`` is
+the shared trace ID blackbox.py aligns bundles on. Events also keep a
+``perf_counter`` timestamp (``pc``) on the same clock as diagnostics
+spans, so merged chrome traces interleave events with spans.
+"""
+from __future__ import annotations
+
+import collections
+import os
+import threading
+import time
+
+__all__ = [
+    "record", "events", "reset", "enabled", "set_capacity", "capacity",
+    "set_identity", "identity", "trace_id", "record_loss",
+]
+
+_DEFAULT_CAPACITY = 4096
+_ring = collections.deque(maxlen=_DEFAULT_CAPACITY)
+_lock = threading.Lock()
+
+_identity = {}          # {"job": str, "rank": int, "world": int}
+_step_events = [0]      # "step" events seen, drives periodic flushing
+_capacity_synced = [False]
+
+
+def _env_get(name, default):
+    try:
+        from .. import env as _env
+
+        if name in _env.all_vars():
+            return _env.get(name)
+    except Exception:
+        pass
+    return default
+
+
+def enabled():
+    raw = os.environ.get("MXTPU_FLIGHTREC")
+    if raw is not None:
+        return raw.lower() not in ("", "0", "false", "off")
+    return True
+
+
+def capacity():
+    return _ring.maxlen
+
+
+def set_capacity(n):
+    """Rebound the ring, keeping the newest events up to the new cap;
+    returns the previous capacity."""
+    global _ring
+    n = max(1, int(n))
+    _capacity_synced[0] = True  # an explicit call beats the env default
+    with _lock:
+        prev = _ring.maxlen
+        _ring = collections.deque(_ring, maxlen=n)
+    return prev
+
+
+def _sync_capacity():
+    # one-time: honor MXTPU_FLIGHTREC_CAPACITY without import-order games
+    if _capacity_synced[0]:
+        return
+    _capacity_synced[0] = True
+    n = _env_get("MXTPU_FLIGHTREC_CAPACITY", None)
+    if n is None:
+        raw = os.environ.get("MXTPU_FLIGHTREC_CAPACITY")
+        n = int(raw) if raw else None
+    if n and int(n) != _ring.maxlen:
+        set_capacity(int(n))
+
+
+def set_identity(rank=None, world=None, job=None):
+    """Stamp this process's place in the job — called by
+    ``kvstore.tpu_dist`` at collective init (and by tests). Also pushes
+    the (job, rank) trace context onto diagnostics spans so span records
+    carry the same correlation ID as flight events."""
+    if rank is not None:
+        _identity["rank"] = int(rank)
+    if world is not None:
+        _identity["world"] = int(world)
+    if job is not None:
+        _identity["job"] = str(job)
+    try:
+        from ..diagnostics import spans as _spans
+
+        ident = identity()
+        _spans.set_trace_context(job=ident["job"], rank=ident["rank"])
+    except Exception:
+        pass
+
+
+def identity():
+    """Resolved ``{job, rank, world}``: explicit set_identity beats the
+    MXTPU_JOB_ID / MXTPU_FLIGHTREC_RANK env, beats jax process info."""
+    ident = dict(_identity)
+    if "job" not in ident:
+        job = _env_get("MXTPU_JOB_ID", "") or \
+            os.environ.get("MXTPU_JOB_ID", "")
+        ident["job"] = job or "local"
+    if "rank" not in ident:
+        raw = os.environ.get("MXTPU_FLIGHTREC_RANK")
+        if raw is not None:
+            ident["rank"] = int(raw)
+        else:
+            try:
+                import jax
+
+                ident["rank"] = jax.process_index()
+            except Exception:
+                ident["rank"] = 0
+    if "world" not in ident:
+        try:
+            import jax
+
+            ident["world"] = jax.process_count()
+        except Exception:
+            ident["world"] = 1
+    return ident
+
+
+def trace_id(step=None):
+    """The shared cross-rank trace ID: ``(job_id, step)``."""
+    if step is None:
+        step = _current_step()
+    return (identity()["job"], step)
+
+
+def _current_step():
+    try:
+        from ..diagnostics import spans as _spans
+
+        return _spans.current_step()
+    except Exception:
+        return 0
+
+
+def record(kind, **fields):
+    """Append one structured event. Never raises; a broken observability
+    layer must not take the training loop down with it."""
+    if not enabled():
+        return None
+    _sync_capacity()
+    ev = {"kind": kind, "t": time.time(), "pc": time.perf_counter(),
+          "step": _current_step()}
+    if fields:
+        ev.update(fields)
+    _ring.append(ev)  # deque.append is atomic under the GIL
+    try:
+        from ..telemetry import instruments as _instr
+
+        _instr.record_flight_event(kind)
+    except Exception:
+        pass
+    if kind == "step":
+        _maybe_flush()
+    return ev
+
+
+def record_loss(value, **fields):
+    """Record a host-synced loss value as a ``loss`` event — for loops
+    that already paid the host read (MXTPU_NUMERICS=step does this at
+    every step boundary; eager loops can call it after ``asnumpy()``)."""
+    try:
+        value = float(value)
+    except (TypeError, ValueError):
+        return None
+    return record("loss", value=value, **fields)
+
+
+def _maybe_flush():
+    """Periodic black-box spill: every MXTPU_FLIGHTREC_FLUSH_STEPS step
+    events, write the postmortem bundle asynchronously so a SIGKILL'd
+    run still leaves evidence on disk (the acceptance path for
+    tools/blackbox.py)."""
+    every = _env_get("MXTPU_FLIGHTREC_FLUSH_STEPS", 0)
+    if not every:
+        raw = os.environ.get("MXTPU_FLIGHTREC_FLUSH_STEPS")
+        every = int(raw) if raw else 0
+    if every <= 0:
+        return
+    _step_events[0] += 1
+    if _step_events[0] % int(every):
+        return
+    try:
+        from . import postmortem
+
+        postmortem.dump(reason="periodic", sync=False)
+    except Exception:
+        pass
+
+
+def events():
+    """Snapshot of the ring, oldest first."""
+    with _lock:
+        return list(_ring)
+
+
+def reset():
+    """Drop events and the periodic-flush counter (test hygiene);
+    identity stays — it describes the process, not the run."""
+    with _lock:
+        _ring.clear()
+    _step_events[0] = 0

@@ -42,6 +42,16 @@ from . import memory  # noqa: F401
 register_named_pass("amp", AmpPass)
 register_named_pass("remat", RematPass)
 
+
+def _numerics_factory():
+    # lazy: observability imports jax-heavy bits; only pay when named
+    from ..observability.numerics import NumericsPass
+
+    return NumericsPass()
+
+
+register_named_pass("numerics", _numerics_factory)
+
 __all__ = [
     "AmpPass",
     "DedupExecutable",

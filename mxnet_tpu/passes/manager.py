@@ -17,6 +17,7 @@ what ``MXTPU_PASSES=0`` forces unconditionally.
 """
 from __future__ import annotations
 
+import os
 import threading
 import time
 
@@ -202,6 +203,11 @@ def resolve_passes(ctx):
                                               for p in passes):
         from .remat import RematPass
         passes.append(RematPass(policy))
+    nmode = os.environ.get("MXTPU_NUMERICS", "off").strip().lower()
+    if nmode not in ("", "off", "0", "none") \
+            and not any(p.name == "numerics" for p in passes):
+        from ..observability.numerics import NumericsPass
+        passes.append(NumericsPass())
     passes = [p for p in passes if p.applies(ctx)]
     passes.sort(key=lambda p: (p.priority, p.name))
     return passes

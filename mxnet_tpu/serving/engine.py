@@ -183,6 +183,12 @@ class InferenceEngine:
                     target=self._loop, name=f"mxtpu-serve-{self.name}",
                     daemon=True)
                 self._thread.start()
+        try:
+            from ..observability import flight as _flight
+
+            _flight.record("serve_start", model=self.name)
+        except Exception:
+            pass
         return self
 
     def stop(self, drain=True):
@@ -204,6 +210,13 @@ class InferenceEngine:
                 _instr.record_serve_request(self.name, "error")
         if self._thread is not None:
             self._thread.join(timeout=30)
+        try:
+            from ..observability import flight as _flight
+
+            _flight.record("serve_stop", model=self.name,
+                           drained=bool(drain))
+        except Exception:
+            pass
         return self
 
     def __enter__(self):

@@ -44,6 +44,8 @@ __all__ = [
     "record_compile", "record_trace", "record_fallback", "record_transfer",
     "record_sync", "record_collective", "observe_step", "set_flop_budget",
     "record_serve_request", "record_serve_batch", "nbytes_of",
+    "numerics_trip_total", "flight_events_total", "postmortem_dump_total",
+    "record_numerics_trip", "record_flight_event", "record_postmortem",
 ]
 
 # v5e-class bf16 peak, the default MFU denominator (tools/perf_lab.py's
@@ -267,6 +269,53 @@ serve_timeout_total = counter(
     ["model"])
 
 
+# -- observability plane (mxnet_tpu/observability/; docs/observability.md) --
+numerics_trip_total = counter(
+    "numerics_trip_total",
+    "MXTPU_NUMERICS is-finite checks that tripped, by instrumented "
+    "program label (observability.numerics)", ["label"])
+flight_events_total = counter(
+    "flight_events_total",
+    "Flight-recorder events appended, by kind (observability.flight; "
+    "the ring is bounded — this counter is the lifetime total)", ["kind"])
+postmortem_dump_total = counter(
+    "postmortem_dump_total",
+    "Postmortem bundles written, by reason prefix (watchdog / preempt / "
+    "numerics / crash / exit / periodic / manual)", ["reason"])
+
+
+def record_numerics_trip(label):
+    """One tripped numerics check for the program `label`."""
+    if not REGISTRY.enabled:
+        return
+    numerics_trip_total.labels(label).inc()
+
+
+def record_flight_event(kind):
+    """One event appended to the flight-recorder ring."""
+    if not REGISTRY.enabled:
+        return
+    flight_events_total.labels(kind).inc()
+
+
+def record_postmortem(reason):
+    """One postmortem bundle written for `reason`."""
+    if not REGISTRY.enabled:
+        return
+    postmortem_dump_total.labels(reason).inc()
+
+
+def _flight_record(kind, **fields):
+    """Mirror a telemetry touchpoint into the flight recorder (lazy and
+    guarded — a broken observability layer must not break metrics)."""
+    try:
+        from ..observability import flight as _flight
+
+        _flight.record(kind, **fields)
+    except Exception:
+        pass
+
+
 # -- helpers ----------------------------------------------------------------
 
 def nbytes_of(x):
@@ -282,6 +331,8 @@ def nbytes_of(x):
 
 
 def record_compile(block, variant, seconds):
+    _flight_record("compile", block=str(block), variant=str(variant),
+                   seconds=seconds)
     if not REGISTRY.enabled:
         return
     jit_compile_total.labels(block, variant).inc()
@@ -299,6 +350,8 @@ def record_serve_request(model, outcome, seconds=None):
     error; `seconds` (when the request made it far enough to have a
     latency) lands in the latency histogram. Shed and timeout also bump
     their dedicated counters so overload is visible at a glance."""
+    if outcome != "ok":  # ok requests are too hot for the ring; failures
+        _flight_record("serve_" + str(outcome), model=str(model))
     if not REGISTRY.enabled:
         return
     serve_request_total.labels(model, outcome).inc()
@@ -312,6 +365,8 @@ def record_serve_request(model, outcome, seconds=None):
 
 def record_serve_batch(model, rows, bucket):
     """One executed micro-batch: `rows` real rows padded up to `bucket`."""
+    _flight_record("serve_batch", model=str(model), rows=int(rows),
+                   bucket=int(bucket))
     if not REGISTRY.enabled:
         return
     serve_batch_total.labels(model).inc()
@@ -323,6 +378,8 @@ def record_serve_batch(model, rows, bucket):
 def record_ckpt_save(mode, ms, nbytes, outcome="ok"):
     """One finished checkpoint save: `ms` capture->commit wall ms,
     `nbytes` of committed array payload (this rank's share)."""
+    _flight_record("ckpt_save", mode=str(mode), ms=ms, bytes=int(nbytes),
+                   outcome=str(outcome))
     if not REGISTRY.enabled:
         return
     ckpt_save_total.labels(mode, outcome).inc()
@@ -333,6 +390,7 @@ def record_ckpt_save(mode, ms, nbytes, outcome="ok"):
 
 def record_ckpt_restore(outcome):
     """One restore attempt: ok / corrupt / not_found / error."""
+    _flight_record("ckpt_restore", outcome=str(outcome))
     if not REGISTRY.enabled:
         return
     ckpt_restore_total.labels(outcome).inc()
@@ -359,6 +417,7 @@ def record_sync(site, seconds):
 
 
 def record_collective(op, nbytes, seconds):
+    _flight_record("collective", op=str(op), bytes=int(nbytes))
     if not REGISTRY.enabled:
         return
     collective_total.labels(op).inc()

@@ -21,6 +21,15 @@ jax.config.update("jax_platforms", "cpu")
 
 import pytest  # noqa: E402
 
+# Postmortem bundles (observability) default to CWD; in tests that would
+# litter the repo root with mxtpu_blackbox.rank*.json every time a
+# watchdog/crash path fires. Point them at a throwaway dir instead
+# (tests that assert on bundle contents override this per-test).
+import tempfile  # noqa: E402
+
+os.environ.setdefault(
+    "MXTPU_FLIGHTREC_DIR", tempfile.mkdtemp(prefix="mxtpu-test-blackbox-"))
+
 
 # Quick-smoke subset (reference: pytest.ini marker families). The modules
 # below together run in well under 3 minutes on the 1-core CPU box:

@@ -312,6 +312,52 @@ def test_watchdog_dump_now(fresh):
     assert "manual-site" in text and "thread stacks" in text
 
 
+def test_watchdog_fires_mid_whole_step_dispatch(fresh, tmp_path):
+    """The stall dump fires WHILE a whole-step donated dispatch is in
+    flight and names the `whole_step` guard + span (ISSUE-8 satellite:
+    previously only the phased path was covered)."""
+    from mxnet_tpu.gluon import TrainStep
+
+    net = nn.HybridSequential()
+    net.add(nn.Dense(8, activation="relu"), nn.Dense(4))
+    net.initialize()
+    net.hybridize()
+    trainer = Trainer(net.collect_params(), "sgd", {"learning_rate": 0.1})
+    step = TrainStep(net, lambda out, y: ((out - y) ** 2).mean(), trainer)
+    x = mx.np.ones((4, 6))
+    y = mx.np.zeros((4, 4))
+    step(x, y)  # compile the donated whole-step program
+    assert step.last_path == "whole_step"
+
+    # make the NEXT dispatch stall past the deadline without touching
+    # the compiled program: wrap the cached jit variants
+    def slow(fn):
+        def wrapped(*a, **k):
+            time.sleep(0.6)
+            return fn(*a, **k)
+        return wrapped
+
+    step._jit_variants = {k: slow(v)
+                          for k, v in step._jit_variants.items()}
+    watchdog.configure(MXTPU_WATCHDOG=1,
+                       MXTPU_WATCHDOG_TIMEOUT_S=0.15,
+                       MXTPU_WATCHDOG_FILE=str(tmp_path / "wd.txt"),
+                       MXTPU_WATCHDOG_RAISE=0)
+    try:
+        step(x, y)  # stalled dispatch; watchdog fires mid-flight
+    finally:
+        watchdog.configure(MXTPU_WATCHDOG=None,
+                           MXTPU_WATCHDOG_TIMEOUT_S=None,
+                           MXTPU_WATCHDOG_FILE=None,
+                           MXTPU_WATCHDOG_RAISE=None)
+    assert step.last_path == "whole_step"
+    dump = watchdog.last_dump()
+    assert dump is not None
+    assert "site 'whole_step' stalled" in dump   # the guarded site
+    assert "whole_step" in dump.split("live span stacks")[1] \
+        .split("open watchdog guards")[0]        # the live span names it
+
+
 # -- report with no activity -------------------------------------------------
 
 def test_report_empty_state(fresh):

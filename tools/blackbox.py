@@ -1,0 +1,230 @@
+"""blackbox — merge per-rank postmortem bundles into one picture.
+
+Usage:  python tools/blackbox.py RANK0.json [RANK1.json ...]
+                                 [--trace OUT.trace.json] [--report OUT.txt]
+
+Each rank of a distributed job writes an atomic postmortem bundle
+(``mxtpu_blackbox.rank<N>.json`` — see docs/observability.md): the
+flight-recorder event ring, diagnostics spans, telemetry, the compile
+registry, numerics trips, and the env snapshot. This tool merges N such
+bundles into:
+
+  * a single chrome trace (``chrome://tracing`` / Perfetto) — one
+    process row per rank, span records as duration events and flight
+    events as instants, ALIGNED on the shared (job_id, step) trace ID:
+    each rank's clock is offset so the earliest span of a common step
+    lands at the same tick (ranks have no shared wall clock; the step
+    boundary is the one event they all agree on);
+  * a text stall report: per-rank last step + last events, the
+    straggler (lowest last step — "rank 3"), and what every OTHER rank
+    was doing at the straggler's final step (the 3am question).
+
+Bundles from different jobs (mismatched job_id) are refused — merging
+unrelated timelines answers nothing.
+"""
+from __future__ import annotations
+
+import argparse
+import collections
+import json
+import sys
+
+US = 1e6  # chrome trace timestamps are microseconds
+
+
+def load_bundle(path):
+    with open(path) as f:
+        b = json.load(f)
+    if not isinstance(b, dict) or "events" not in b:
+        raise ValueError(f"{path}: not a postmortem bundle")
+    b.setdefault("identity", {})
+    b["identity"].setdefault("rank", len(path))  # stable-ish fallback
+    b["_path"] = path
+    return b
+
+
+def _rank(b):
+    return int(b["identity"].get("rank", 0))
+
+
+def _job(b):
+    return str(b["identity"].get("job", "local"))
+
+
+def _span_step_t0(b):
+    """step -> earliest span t0 on this rank (the per-step alignment
+    anchor; flight events share the perf_counter clock via their pc)."""
+    anchor = {}
+    for rec in b.get("spans", []):
+        s = rec.get("step", 0)
+        if s not in anchor or rec["t0"] < anchor[s]:
+            anchor[s] = rec["t0"]
+    return anchor
+
+
+def align_offsets(bundles):
+    """Per-rank clock offsets that line ranks up on a common step.
+
+    Picks the highest step EVERY rank has a span anchor for; each rank's
+    offset maps that step's earliest span t0 to tick 0. Ranks lacking
+    the common step (e.g. a rank that died before step 1) fall back to
+    their own earliest span."""
+    anchors = {_rank(b): _span_step_t0(b) for b in bundles}
+    common = None
+    steps = [set(a) for a in anchors.values() if a]
+    if steps and len(steps) == len(bundles):
+        shared = set.intersection(*steps)
+        if shared:
+            common = max(shared)
+    offsets = {}
+    for b in bundles:
+        r = _rank(b)
+        a = anchors[r]
+        if common is not None and common in a:
+            offsets[r] = a[common]
+        elif a:
+            offsets[r] = min(a.values())
+        else:
+            evs = b.get("events", [])
+            offsets[r] = min((e["pc"] for e in evs if "pc" in e),
+                             default=0.0)
+    return offsets, common
+
+
+def chrome_trace(bundles):
+    """The merged chrome-trace dict (pid = rank, step-aligned ticks)."""
+    offsets, common = align_offsets(bundles)
+    out = []
+    for b in bundles:
+        r = _rank(b)
+        off = offsets[r]
+        out.append({"ph": "M", "pid": r, "name": "process_name",
+                    "args": {"name": f"rank {r} ({_job(b)})"}})
+        for rec in b.get("spans", []):
+            out.append({
+                "ph": "X", "pid": r, "tid": rec.get("tid", 0),
+                "name": rec.get("name", "?"), "cat": rec.get("cat", "host"),
+                "ts": (rec["t0"] - off) * US, "dur": rec["dur"] * US,
+                "args": {"step": rec.get("step", 0)},
+            })
+        for ev in b.get("events", []):
+            if "pc" not in ev:
+                continue
+            args = {k: v for k, v in ev.items()
+                    if k not in ("kind", "t", "pc")}
+            out.append({
+                "ph": "i", "pid": r, "tid": 0, "s": "p",
+                "name": ev.get("kind", "?"), "cat": "flight",
+                "ts": (ev["pc"] - off) * US, "args": args,
+            })
+    return {"traceEvents": out,
+            "metadata": {"aligned_on_step": common,
+                         "ranks": sorted(_rank(b) for b in bundles)}}
+
+
+def _last_step(b):
+    steps = [e.get("step", 0) for e in b.get("events", [])]
+    steps += [rec.get("step", 0) for rec in b.get("spans", [])]
+    return max(steps, default=0)
+
+
+def _doing_at(b, step):
+    """What this rank's record shows at/after `step`: open-ended span
+    names and the tail of events from that step on."""
+    evs = [e for e in b.get("events", []) if e.get("step", 0) >= step]
+    spans = [rec for rec in b.get("spans", [])
+             if rec.get("step", 0) >= step]
+    names = collections.Counter(rec.get("name", "?") for rec in spans)
+    return evs[-6:], names.most_common(4)
+
+
+def report(bundles):
+    lines = []
+    w = lines.append
+    bundles = sorted(bundles, key=_rank)
+    job = _job(bundles[0])
+    w(f"blackbox report — job {job!r}, {len(bundles)} rank(s)")
+    w("")
+    last = {_rank(b): _last_step(b) for b in bundles}
+    straggler = min(last, key=lambda r: last[r]) if last else None
+    for b in bundles:
+        r = _rank(b)
+        w(f"rank {r}: last step {last[r]}, "
+          f"{len(b.get('events', []))} events, "
+          f"{len(b.get('spans', []))} spans, reason={b.get('reason')!r}"
+          + ("   <-- STRAGGLER" if r == straggler and len(bundles) > 1
+             else ""))
+        trips = b.get("numerics_trips") or []
+        for t in trips[-3:]:
+            eq = t.get("equation") or {}
+            w(f"  numerics trip @ step {t.get('step')}: "
+              f"{t.get('label')} -> {eq.get('op', '(no attribution)')} "
+              f"{eq.get('out_shapes', '')}")
+        nb = b.get("numerics_bisect")
+        if nb:  # a TrainStep trip consumes its trip record; the bisect
+            w(f"  numerics bisect: eqn {nb.get('eqn')} "
+              f"`{nb.get('op')}` out_shapes={nb.get('out_shapes')}")
+        if b.get("watchdog_dump"):
+            first = str(b["watchdog_dump"]).strip().splitlines()
+            head = next((ln for ln in first if "WATCHDOG" in ln),
+                        first[0] if first else "")
+            w(f"  watchdog fired: {head.strip()}")
+    if straggler is not None and len(bundles) > 1:
+        stall_step = last[straggler]
+        w("")
+        w(f"at rank {straggler}'s final step ({stall_step}), "
+          f"each rank was doing:")
+        for b in bundles:
+            r = _rank(b)
+            evs, spans = _doing_at(b, stall_step)
+            span_s = ", ".join(f"{n}x{c}" for n, c in spans) or "(no spans)"
+            ev_s = " ".join(
+                f"{e.get('kind')}@{e.get('step')}" for e in evs) \
+                or "(no events)"
+            w(f"  rank {r}: spans [{span_s}]  events: {ev_s}")
+    w("")
+    return "\n".join(lines)
+
+
+def merge(paths, trace_path=None, report_path=None):
+    bundles = [load_bundle(p) for p in paths]
+    jobs = {_job(b) for b in bundles}
+    if len(jobs) > 1:
+        raise ValueError(
+            f"bundles span different jobs {sorted(jobs)}; merging "
+            f"unrelated timelines answers nothing — pass one job's "
+            f"bundles")
+    trace = chrome_trace(bundles)
+    text = report(bundles)
+    if trace_path:
+        with open(trace_path, "w") as f:
+            json.dump(trace, f)
+    if report_path:
+        with open(report_path, "w") as f:
+            f.write(text)
+    return trace, text
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(
+        description="merge per-rank postmortem bundles into one "
+                    "chrome trace + stall report")
+    ap.add_argument("bundles", nargs="+",
+                    help="per-rank mxtpu_blackbox.rank<N>.json paths")
+    ap.add_argument("--trace", default="mxtpu_blackbox_trace.json",
+                    help="merged chrome-trace output path")
+    ap.add_argument("--report", default=None,
+                    help="write the text report here too (always printed)")
+    args = ap.parse_args(argv)
+    trace, text = merge(args.bundles, trace_path=args.trace,
+                        report_path=args.report)
+    sys.stdout.write(text)
+    n = len(trace["traceEvents"])
+    sys.stdout.write(
+        f"chrome trace: {args.trace} ({n} events; open in "
+        f"chrome://tracing or Perfetto)\n")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
