@@ -63,6 +63,19 @@ _tls = threading.local()
 _open_stacks = {}
 _open_lock = threading.Lock()
 
+
+def _reinit_after_fork():
+    # spans record from mxtpu service threads; a fork landing inside a
+    # ring/stack critical section (dataloader workers fork from a
+    # threaded parent) would leave the lock held forever in the child
+    global _ring_lock, _open_lock
+    _ring_lock = threading.Lock()
+    _open_lock = threading.Lock()
+
+
+if hasattr(os, "register_at_fork"):
+    os.register_at_fork(after_in_child=_reinit_after_fork)
+
 _step = [0]  # training-step index, bumped by Trainer.step via mark_step()
 
 # cross-rank trace correlation (observability.flight.set_identity pushes

@@ -51,6 +51,20 @@ _dump_count = [0]
 _last_dump = [None]
 
 
+def _reinit_after_fork():
+    # the scanner thread holds _lock about once a second; a fork landing
+    # inside that window (dataloader workers fork from a threaded
+    # parent) would leave it held forever in the child. The scanner
+    # thread itself does not survive fork, so also drop the handle.
+    global _lock, _scanner
+    _lock = threading.Lock()
+    _scanner = None
+
+
+if hasattr(os, "register_at_fork"):
+    os.register_at_fork(after_in_child=_reinit_after_fork)
+
+
 def _opt(key, default):
     if key in _overrides:
         return _overrides[key]

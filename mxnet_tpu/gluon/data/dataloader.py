@@ -245,10 +245,16 @@ class DataLoader:
         # internal threads only warn, since workers never call jax.
         # Framework service threads (all named "mxtpu-*": the watchdog
         # scanner, serving batcher, prefetch producers) don't gate the
-        # choice either — they only wait on queues/deadlines and workers
-        # never touch their subsystems, so a long-lived observability
-        # thread must not silently flip every loader to spawn (which
-        # also requires picklable datasets). MXTPU_MP_START overrides.
+        # choice either — a long-lived observability thread must not
+        # silently flip every loader to spawn (which also requires
+        # picklable datasets). That exemption is safe because the
+        # subsystems those threads hold locks in (flight recorder,
+        # telemetry registry, span ring, watchdog) reinstall fresh locks
+        # via os.register_at_fork(after_in_child=...), so user dataset
+        # code touching NDArray ops or telemetry in a forked worker
+        # can't inherit a lock a service thread held mid-fork. Set
+        # MXTPU_MP_START=spawn for full isolation. MXTPU_MP_START
+        # overrides the heuristic either way.
         from ... import env as _env
 
         user_threads = [

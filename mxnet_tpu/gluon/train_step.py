@@ -514,9 +514,13 @@ class TrainStep:
         donate = _donate_enabled() and _donation_safe(
             (tws, states), (frozen, inputs, key))
         nmode = _numerics_mode()
-        if donate and nmode == "step":
-            # a tripped check bisects by re-running the recorded program
-            # on THESE operands — they must survive the dispatch
+        if donate and nmode != "off":
+            # any active mode raises from _numerics_boundary BEFORE the
+            # writeback loop, so the live param/state containers must
+            # still hold valid (pre-step) buffers for a caller that
+            # catches NonFiniteError and resumes; step mode additionally
+            # bisects by re-running the recorded program on THESE
+            # operands — they must survive the dispatch
             donate = False
         fn = self._jitted(donate)
         before = _cache_size(fn)

@@ -9,11 +9,11 @@ is bounded (``MXTPU_FLIGHTREC_CAPACITY``), so a week-long job holds the
 serializes it (with the telemetry/span/compile-registry snapshots) into
 one atomic bundle that ``tools/blackbox.py`` can merge across ranks.
 
-Hot-path cost: one ``enabled`` check, one dict build, one
-``deque.append`` (atomic under the GIL — no lock on the append path;
-the snapshot in :func:`events` copies under a lock only to get a
-consistent list). ``MXTPU_FLIGHTREC=0`` turns recording into a single
-branch.
+Hot-path cost: one ``enabled`` check, one dict build, one uncontended
+lock acquire around a ``deque.append`` (the lock keeps the snapshot in
+:func:`events` from iterating a mutating deque, which raises
+``RuntimeError`` mid-postmortem). ``MXTPU_FLIGHTREC=0`` turns
+recording into a single branch.
 
 Cross-rank correlation: :func:`set_identity` stamps this process's
 ``(job_id, rank)`` — called by ``kvstore.tpu_dist`` at init — and every
@@ -41,6 +41,19 @@ _lock = threading.Lock()
 _identity = {}          # {"job": str, "rank": int, "world": int}
 _step_events = [0]      # "step" events seen, drives periodic flushing
 _capacity_synced = [False]
+
+
+def _reinit_after_fork():
+    # mxtpu service threads (watchdog scanner, serving batcher) record
+    # events continuously; a fork — dataloader workers fork from a
+    # threaded parent — landing inside the critical section would leave
+    # _lock held forever in the child. Fresh lock, same ring.
+    global _lock
+    _lock = threading.Lock()
+
+
+if hasattr(os, "register_at_fork"):
+    os.register_at_fork(after_in_child=_reinit_after_fork)
 
 
 def _env_get(name, default):
@@ -165,7 +178,11 @@ def record(kind, **fields):
           "step": _current_step()}
     if fields:
         ev.update(fields)
-    _ring.append(ev)  # deque.append is atomic under the GIL
+    # the lock is uncontended on the hot path; appending OUTSIDE it
+    # would let a concurrent events() snapshot die with "deque mutated
+    # during iteration" — exactly when a postmortem dump runs
+    with _lock:
+        _ring.append(ev)
     try:
         from ..telemetry import instruments as _instr
 

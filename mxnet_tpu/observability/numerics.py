@@ -40,8 +40,9 @@ import jax.numpy as jnp
 from ..passes.manager import GraphPass, retrace_flat
 
 __all__ = [
-    "NumericsPass", "NonFiniteError", "mode", "bisect", "bisect_callable",
-    "tripped", "take_trip", "trips", "reset", "effects_barrier",
+    "NumericsPass", "NonFiniteError", "mode", "normalize", "bisect",
+    "bisect_callable", "tripped", "take_trip", "trips", "reset",
+    "effects_barrier",
 ]
 
 MODES = ("off", "step", "op")
@@ -65,6 +66,17 @@ class NonFiniteError(ArithmeticError):
         self.bundle = bundle
 
 
+def normalize(raw):
+    """MXTPU_NUMERICS value -> off|step|op. Unrecognized spellings
+    ('none', '1', 'true', typos) resolve to 'off': pass installation
+    (passes/manager.resolve_passes) and the step-boundary poll
+    (gluon.TrainStep) share THIS function, so a value that installs no
+    NumericsPass must not make TrainStep disable donation and pay the
+    effects barrier for checks that never run."""
+    m = str(raw).strip().lower()
+    return m if m in MODES else "off"
+
+
 def mode():
     """Live MXTPU_NUMERICS value, normalized to off|step|op."""
     import os
@@ -79,10 +91,7 @@ def mode():
         raw = None
     if raw is None:
         raw = os.environ.get("MXTPU_NUMERICS", "off")
-    m = str(raw).strip().lower()
-    if m in ("", "0", "false", "no", "off"):
-        return "off"
-    return m if m in MODES else "step"
+    return normalize(raw)
 
 
 def effects_barrier():

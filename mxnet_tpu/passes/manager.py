@@ -17,7 +17,6 @@ what ``MXTPU_PASSES=0`` forces unconditionally.
 """
 from __future__ import annotations
 
-import os
 import threading
 import time
 
@@ -203,11 +202,13 @@ def resolve_passes(ctx):
                                               for p in passes):
         from .remat import RematPass
         passes.append(RematPass(policy))
-    nmode = os.environ.get("MXTPU_NUMERICS", "off").strip().lower()
-    if nmode not in ("", "off", "0", "none") \
+    # mode() is the ONE normalization of MXTPU_NUMERICS — TrainStep's
+    # step-boundary poll reads the same function, so a value that
+    # installs no pass here also triggers no polling there
+    from ..observability import numerics as _numerics
+    if _numerics.mode() != "off" \
             and not any(p.name == "numerics" for p in passes):
-        from ..observability.numerics import NumericsPass
-        passes.append(NumericsPass())
+        passes.append(_numerics.NumericsPass())
     passes = [p for p in passes if p.applies(ctx)]
     passes.sort(key=lambda p: (p.priority, p.name))
     return passes

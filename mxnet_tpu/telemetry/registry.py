@@ -327,6 +327,23 @@ class Registry:
 REGISTRY = Registry(enabled=os.environ.get("MXTPU_TELEMETRY", "1") != "0")
 
 
+def _reinit_locks_after_fork():
+    # mxtpu service threads mutate counters continuously; a fork —
+    # dataloader workers fork from a threaded parent — landing inside a
+    # registry/metric/series critical section would leave that lock held
+    # forever in the child. Values may be mid-update (GIL keeps them
+    # well-formed); the child only needs working locks.
+    REGISTRY._lock = threading.Lock()
+    for m in list(REGISTRY._metrics.values()):
+        m._lock = threading.Lock()
+        for child in list(m._children.values()):
+            child._lock = threading.Lock()
+
+
+if hasattr(os, "register_at_fork"):
+    os.register_at_fork(after_in_child=_reinit_locks_after_fork)
+
+
 def counter(name, documentation="", labelnames=()):
     return REGISTRY.counter(name, documentation, labelnames)
 

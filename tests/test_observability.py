@@ -178,6 +178,40 @@ def test_numerics_op_mode_clean_is_silent(monkeypatch):
     assert not numerics.tripped()
 
 
+def test_numerics_op_mode_trip_leaves_params_live(monkeypatch):
+    monkeypatch.setenv("MXTPU_NUMERICS", "op")
+    step, x, y = _step_fixture()
+    step(x, y)  # clean warmup
+    w_before = {n: onp.asarray(p.data().asnumpy())
+                for n, p in step._net.collect_params().items()}
+    xbad = mx.np.array(onp.full((8, 12), onp.nan, dtype="f"))
+    with pytest.raises(observability.NonFiniteError):
+        step(xbad, y)
+    # every active mode disables donation: the rejected step raised
+    # before writeback, so the containers must still hold LIVE pre-step
+    # buffers a caller that catches the error can read and resume on
+    for n, p in step._net.collect_params().items():
+        assert onp.array_equal(onp.asarray(p.data().asnumpy()),
+                               w_before[n]), n
+    loss = step(x, y)  # resume on the same containers
+    assert math.isfinite(float(loss.asnumpy()))
+
+
+def test_numerics_unrecognized_value_is_off(monkeypatch):
+    for raw in ("none", "1", "true", "stepp"):
+        monkeypatch.setenv("MXTPU_NUMERICS", raw)
+        assert numerics.mode() == "off"
+    # pass installation and the step-boundary poll share normalize():
+    # a value that installs no NumericsPass behaves exactly like 'off'
+    # (no donation opt-out, no barrier) and a NaN sails through
+    monkeypatch.setenv("MXTPU_NUMERICS", "none")
+    step, x, y = _step_fixture()
+    step(x, y)
+    xbad = mx.np.array(onp.full((8, 12), onp.nan, dtype="f"))
+    loss = step(xbad, y)  # no raise
+    assert not math.isfinite(float(loss.asnumpy()))
+
+
 # -- bisect interpreter -----------------------------------------------------
 
 def test_bisect_finds_first_bad_equation():

@@ -317,8 +317,14 @@ def test_chrome_bridge_counter_events(tmp_path, fresh):
     profiler.set_config(profile_all=True,
                         filename=str(tmp_path / "bridge.json"))
     profiler.set_state("run")
-    assert telemetry.emit_chrome_counters(r) == 3  # counter + hist x2
-    profiler.dump()
+    try:
+        assert telemetry.emit_chrome_counters(r) == 3  # counter + hist x2
+        profiler.dump()
+    finally:
+        # don't leak a recording profiler into later tests (it flips
+        # their own not-recording gates)
+        profiler.set_config(profile_all=False)
+        profiler.set_state("stop")
     events = json.load(open(tmp_path / "bridge.json"))["traceEvents"]
     counters = {e["name"]: e["args"]["value"] for e in events
                 if e.get("ph") == "C"}
