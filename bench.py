@@ -57,6 +57,21 @@ def _latest_bench_snapshot(repo_dir=None):
     return best, parsed if isinstance(parsed, dict) else None
 
 
+def _snapshot_platform(parsed):
+    """Platform a BENCH_r*.json was measured on.  Runs from this bench
+    version onward stamp it; older snapshots are inferred from the
+    metric names (the CPU fallback suffixes every row _CPU_FALLBACK)."""
+    p = parsed.get("platform")
+    if p:
+        return str(p)
+    names = [parsed.get("metric") or ""]
+    for row in parsed.get("rows") or []:
+        names.append(row.get("metric") or "")
+    if any("_CPU_FALLBACK" in n for n in names if n):
+        return "cpu"
+    return "tpu"
+
+
 def _check_regressions(current, threshold=0.03):
     """Compare this run's metrics against the latest BENCH_r*.json; any
     same-named metric that regressed more than `threshold` (default 3%)
@@ -65,9 +80,24 @@ def _check_regressions(current, threshold=0.03):
     regress by DROPPING; latency metrics (name containing `_ms`, e.g.
     trainer_update_ms) regress by RISING — the comparison flips
     accordingly. Metric names embed batch/layout/CPU_FALLBACK, so only
-    like-for-like configs compare."""
+    like-for-like configs compare.
+
+    Cross-platform snapshots never compare: an on-chip r3 number next to
+    a CPU-fallback r5 number is a platform delta, not a regression (and
+    the other direction would hide real ones behind a flattering
+    baseline) — the gate refuses and says so instead of warning."""
     path, prior = _latest_bench_snapshot()
     if prior is None:
+        return []
+    prior_platform = _snapshot_platform(prior)
+    cur_platform = _snapshot_platform(current)
+    if prior_platform != cur_platform:
+        note = (f"regression gate skipped: {os.path.basename(path)} was "
+                f"measured on {prior_platform!r}, this run on "
+                f"{cur_platform!r} — cross-platform deltas are not "
+                f"regressions")
+        print("note: " + note, file=sys.stderr)
+        current["comparison_note"] = note
         return []
 
     def flatten(result):
@@ -555,6 +585,113 @@ def bench_numerics_overhead(platform, iters, warmup):
     return step_ms, off_ms
 
 
+def bench_kernels_overhead(platform, iters, warmup):
+    """Whole-step latency with MXTPU_KERNELS=auto vs 0 on a BN-heavy
+    model (Dense→BatchNorm→Dense, multi-precision SGD — both kernel
+    families eligible). Returns (kernels_ms, off_ms). On CPU the auto
+    dispatch declines on platform and both sides run the XLA path — the
+    row then measures dispatch overhead, and the _CPU_FALLBACK suffix
+    says so; docs/kernels.md has the on-chip expectations."""
+    import numpy as onp
+
+    import mxnet_tpu as mx
+    from mxnet_tpu import gluon
+    from mxnet_tpu.gluon import nn
+
+    batch = 32 if platform == "cpu" else 256
+    feats, classes = (128, 10) if platform == "cpu" else (512, 100)
+    rs = onp.random.RandomState(0)
+    x = mx.np.array(rs.rand(batch, feats).astype("f"), dtype="bfloat16")
+    y = mx.np.array(rs.randint(0, classes, (batch,)))
+    lossfn = gluon.loss.SoftmaxCrossEntropyLoss()
+
+    def run(kernels_mode):
+        prev = os.environ.get("MXTPU_KERNELS")
+        os.environ["MXTPU_KERNELS"] = kernels_mode
+        try:
+            mx.seed(0)
+            net = nn.HybridSequential()
+            net.add(nn.Dense(256, activation="relu"), nn.BatchNorm(),
+                    nn.Dense(classes))
+            net.initialize()
+            net.cast("bfloat16")
+            net.hybridize()
+            trainer = gluon.Trainer(
+                net.collect_params(), "sgd",
+                {"learning_rate": 0.05, "momentum": 0.9,
+                 "multi_precision": True})
+            step = gluon.TrainStep(net, lossfn, trainer)
+            dt, _ = _timeit(lambda: step(x, y),
+                            lambda l: float(l.sum().asnumpy()),
+                            iters, warmup)
+            if step.last_path != "whole_step":
+                raise RuntimeError("kernels bench fell back to phased")
+            return dt / iters * 1000.0
+        finally:
+            if prev is None:
+                os.environ.pop("MXTPU_KERNELS", None)
+            else:
+                os.environ["MXTPU_KERNELS"] = prev
+
+    off_ms = run("0")
+    kernels_ms = run("auto")
+    return kernels_ms, off_ms
+
+
+def bench_kernel_micro_ms(platform, iters=50):
+    """Per-kernel microbenches at an audited shape: wall ms per call of
+    the BN statistics forward, the BN backward, and the fused optimizer
+    ladder, each through its dispatching entry point (kernel on TPU,
+    honest XLA fallback elsewhere — the _CPU_FALLBACK suffix marks the
+    latter). Returns {"bn_fwd": ms, "bn_bwd": ms, "opt": ms}."""
+    import jax
+    import jax.numpy as jnp
+
+    from mxnet_tpu.kernels import norm as knorm
+    from mxnet_tpu.kernels import opt as kopt
+    from mxnet_tpu.optimizer import SGD
+
+    prev = os.environ.get("MXTPU_KERNELS")
+    os.environ["MXTPU_KERNELS"] = "auto"
+    try:
+        m = 2048 if platform != "cpu" else 256
+        c = 512
+        x = jnp.ones((m, c), jnp.bfloat16)
+        g = jnp.ones((c,), jnp.float32)
+        b = jnp.zeros((c,), jnp.float32)
+        s = jnp.zeros((c,), jnp.float32)
+
+        fwd = jax.jit(lambda x_: knorm.bn_train(x_, g, b, s, 1e-5, 1))
+        grad = jax.jit(jax.grad(
+            lambda x_: knorm.bn_train(x_, g, b, s, 1e-5, 1)[0]
+            .astype(jnp.float32).sum()))
+
+        n = (1 << 20) if platform != "cpu" else (1 << 16)
+        w = jnp.ones((n,), jnp.bfloat16)
+        gw = jnp.ones((n,), jnp.bfloat16)
+        master = jnp.ones((n,), jnp.float32)
+        mom = jnp.zeros((n,), jnp.float32)
+        hyper = {"momentum": 0.9, "rescale_grad": 1.0}
+        opt = jax.jit(lambda w_, ma, mo, g_: kopt.param_step(
+            SGD, None, False, True, w_, (ma, mo), g_, 0.01, 1e-4, 1,
+            None, hyper))
+
+        out = {}
+        for name, fn, sync in (
+                ("bn_fwd", lambda: fwd(x), lambda r: r[0].block_until_ready()),
+                ("bn_bwd", lambda: grad(x), lambda r: r.block_until_ready()),
+                ("opt", lambda: opt(w, master, mom, gw),
+                 lambda r: r[0].block_until_ready())):
+            dt, _ = _timeit(fn, sync, iters, 3)
+            out[name] = dt / iters * 1000.0
+        return out
+    finally:
+        if prev is None:
+            os.environ.pop("MXTPU_KERNELS", None)
+        else:
+            os.environ["MXTPU_KERNELS"] = prev
+
+
 def bench_flightrec_record_ms(records=1000):
     """Steady-state flight-recorder cost: wall ms per `records` record()
     calls into a full ring (the hot-path budget — one dict build + one
@@ -880,6 +1017,37 @@ def main():
                     f"(off={off_ms:.3f}ms; docs/observability.md)"})
     except Exception as e:
         rows.append({"metric": "train_step_ms_numerics", "error": str(e)})
+
+    # bandwidth kernels: whole-step A/B (MXTPU_KERNELS=auto vs 0) +
+    # per-kernel microbenches; all _ms rows → lower-is-better gate
+    try:
+        if over_budget():
+            raise TimeoutError("bench budget exhausted")
+        kn_iters = iters if platform != "cpu" else 5
+        kn_ms, koff_ms = bench_kernels_overhead(platform, kn_iters,
+                                                warmup)
+        rows.append({
+            "metric": "train_step_ms_kernels" + suffix,
+            "value": round(kn_ms, 3), "unit": "ms",
+            "note": f"whole-step latency with MXTPU_KERNELS=auto "
+                    f"(Pallas BN + optimizer-ladder kernels); vs "
+                    f"MXTPU_KERNELS=0: {kn_ms / koff_ms:.4f}x "
+                    f"(off={koff_ms:.3f}ms; docs/kernels.md)"})
+    except Exception as e:
+        rows.append({"metric": "train_step_ms_kernels", "error": str(e)})
+    try:
+        if over_budget():
+            raise TimeoutError("bench budget exhausted")
+        micro = bench_kernel_micro_ms(platform)
+        for kname, ms in micro.items():
+            rows.append({
+                "metric": f"kernel_{kname}_ms" + suffix,
+                "value": round(ms, 4), "unit": "ms",
+                "note": "per-call microbench through the dispatching "
+                        "entry point (kernel on TPU, XLA fallback "
+                        "elsewhere; docs/kernels.md)"})
+    except Exception as e:
+        rows.append({"metric": "kernel_micro_ms", "error": str(e)})
     try:
         if over_budget():
             raise TimeoutError("bench budget exhausted")
@@ -988,6 +1156,10 @@ def main():
         result_extra["note"] = note
     result = {
         **result_extra,
+        # stamped so future regression gates can refuse cross-platform
+        # comparisons without inferring from metric-name suffixes
+        "platform": platform,
+        "backend": jax.default_backend(),
         "metric": f"resnet50_train_bf16_b{batch}_{layout.lower()}"
                   "_imgs_per_sec_per_chip" + suffix,
         "value": round(train_img_s, 2),

@@ -305,3 +305,28 @@ register(
     "Job identity stamped into flight-recorder events and span records; "
     "(job_id, step) is the cross-rank trace ID tools/blackbox.py aligns "
     "per-rank postmortem bundles on. Empty = 'local'.")
+register(
+    "MXTPU_KERNELS", str, "off",
+    "Hand-fused Pallas bandwidth kernels for the HBM-bound regions the "
+    "r5 fusion audit ranked worst (mxnet_tpu/kernels; docs/kernels.md): "
+    "'off' (default) never touches a call site — bitwise-identical to "
+    "the XLA paths with zero extra traces; 'auto' uses a kernel at a "
+    "call site only when the passes/memory.py external-bytes model "
+    "predicts it saves HBM traffic over the fused-XLA estimate; 'force' "
+    "uses a kernel whenever shape/dtype/rule support allows. Unsupported "
+    "sites always fall back to the existing XLA path (fallbacks count "
+    "in kernel_dispatch_total and land in the flight recorder).")
+register(
+    "MXTPU_KERNELS_INTERPRET", bool, False,
+    "Run the mxnet_tpu/kernels Pallas kernels in interpret mode so they "
+    "execute off-TPU (CPU parity tests). Without it, non-TPU platforms "
+    "take the XLA fallback even under MXTPU_KERNELS=force.")
+register(
+    "MXTPU_BN_COMPUTE", str, "f32",
+    "Element-wise dtype of the O(N·H·W·C) BatchNorm tensors (ops/nn.py "
+    "_bn_ew_dtype; the r5 audit's top falsifiable prediction): 'f32' "
+    "(default, today's measured-correct config) or 'bf16' — keep the "
+    "big elementwise chains in the activation dtype and promote only "
+    "the reduction accumulators to f32. Applies to the XLA custom-VJP "
+    "path and the Pallas norm kernels alike; A/B on chip before "
+    "changing the default.")

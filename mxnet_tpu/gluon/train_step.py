@@ -374,9 +374,11 @@ class TrainStep:
             from .. import passes as _passes
 
             # the whole-step program compiles through the pipeline seam
-            # too; no shipped pass claims kind=whole_step (the forward
-            # body was already rewritten via wrap_forward), so today
-            # this resolves to the plain donated jit
+            # too; the forward body was already rewritten via
+            # wrap_forward, so the only shipped pass claiming
+            # kind=whole_step is the audit-only KernelPass (when
+            # MXTPU_KERNELS is on) — with kernels off this resolves to
+            # the plain donated jit
             fn = _passes.apply(self._step_fn, _passes.PassContext(
                 label="whole_step", variant=self._variant,
                 kind="whole_step", training=True,

@@ -1,0 +1,29 @@
+"""Hand-fused Pallas TPU kernels for the audited HBM-bandwidth hogs.
+
+Three kernels cover the r5 fusion audit's top external-byte regions:
+
+* ``norm.bn_train`` — one-pass fused batch-norm statistics forward and
+  its matching fused backward (custom_vjp twin of ops/nn.py's
+  ``_bn_train``);
+* ``opt.param_step`` — the fused optimizer ladder (rescale → clip →
+  rule → master-copy cast) as one kernel per parameter.
+
+``dispatch`` owns the policy: sites consult it at trace time and fall
+back to the XLA path whenever the kernel can't run (wrong platform,
+shape, dtype, rule) or — in ``auto`` mode — whenever the
+passes/memory.py byte model predicts no bandwidth win.  This package
+imports no Pallas machinery at module scope, and the kernel modules
+themselves load lazily (PEP 562): a site checking ``dispatch.mode()``
+under MXTPU_KERNELS=off imports ``dispatch`` alone — ``norm``/``opt``
+never load, which tests/test_kernels.py asserts as part of the
+kill-switch contract.
+"""
+import importlib
+
+__all__ = ["dispatch", "norm", "opt"]
+
+
+def __getattr__(name):
+    if name in __all__:
+        return importlib.import_module("." + name, __name__)
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")

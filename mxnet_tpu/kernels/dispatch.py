@@ -1,0 +1,102 @@
+"""Kernel dispatch policy: who decides, and how the decision is audited.
+
+The bandwidth kernels (norm.py, opt.py) never rewrite a traced program —
+each eligible CALL SITE (ops/nn.py batch_norm's training branch, the
+optimizer's `_fused_step_body` loop) consults this module at trace time
+and emits either the Pallas kernel or the existing XLA path into the
+program being captured.  That keeps the kill switch trivial and exact:
+``MXTPU_KERNELS`` unset/off means no site even looks here, so the
+captured programs are bitwise-identical to main with zero extra traces.
+
+The decision ladder (docs/kernels.md has the full table):
+
+  off    site never consulted — the XLA path verbatim;
+  force  kernel whenever platform + shape/dtype/rule support allows;
+  auto   additionally require the passes/memory.py analytic byte model
+         to predict an external-HBM saving — the decision is the byte
+         model's, not a hardcode: sites where the model finds no
+         widening/reduce root to kill (pure-f32 optimizer chains, tiny
+         tensors) keep the XLA path with outcome 'no_savings' /
+         'too_small'.
+
+Every consult records ONE `kernel_dispatch_total{kernel,outcome}`
+sample per trace (never per step); fallbacks also drop a
+``kernel_fallback`` flight-recorder event so postmortems show which
+path a program compiled with.
+"""
+from __future__ import annotations
+
+import jax
+
+from .. import env as _env
+from ..telemetry import instruments as _telemetry
+
+__all__ = [
+    "mode", "platform_ok", "interpret_requested", "record",
+    "auto_accepts", "MIN_AUTO_BYTES", "MIN_AUTO_SAVINGS",
+]
+
+# auto mode declines sites below this size — kernel launch overhead and
+# tiny-region bookkeeping swamp any bandwidth win
+MIN_AUTO_BYTES = 1 << 20
+# and sites where the model predicts less than this fractional saving
+MIN_AUTO_SAVINGS = 0.15
+
+_MODES = {
+    "": "off", "0": "off", "off": "off", "false": "off", "no": "off",
+    "none": "off",
+    "1": "auto", "auto": "auto", "on": "auto", "true": "auto",
+    "yes": "auto",
+    "force": "force", "always": "force",
+}
+
+
+def mode():
+    """Resolved MXTPU_KERNELS mode: 'off' | 'auto' | 'force'."""
+    raw = str(_env.get("MXTPU_KERNELS")).strip().lower()
+    try:
+        return _MODES[raw]
+    except KeyError:
+        raise ValueError(
+            f"MXTPU_KERNELS={raw!r} is not a recognized mode; expected "
+            f"off | auto | force") from None
+
+
+def interpret_requested():
+    """MXTPU_KERNELS_INTERPRET: run kernels in Pallas interpret mode so
+    they execute off-TPU (parity tests)."""
+    return bool(_env.get("MXTPU_KERNELS_INTERPRET"))
+
+
+def platform_ok():
+    """True when Pallas kernels can actually execute here: a TPU-family
+    backend, or interpret mode was requested explicitly."""
+    if interpret_requested():
+        return True
+    try:
+        return jax.devices()[0].platform in ("tpu", "axon")
+    except Exception:
+        return False
+
+
+def auto_accepts(xla_bytes, kernel_bytes):
+    """The `auto` decision on one site, given the analytic byte model's
+    (xla, kernel) external-bytes estimates.  Returns (ok, reason,
+    bytes_saved): reason is 'kernel' on accept, else the fallback
+    outcome name."""
+    saved = int(xla_bytes) - int(kernel_bytes)
+    if xla_bytes < MIN_AUTO_BYTES:
+        return False, "too_small", 0
+    if xla_bytes <= 0 or saved <= 0 \
+            or saved < MIN_AUTO_SAVINGS * xla_bytes:
+        return False, "no_savings", 0
+    return True, "kernel", saved
+
+
+def record(kernel, outcome, bytes_saved=0):
+    """Record one trace-time decision (telemetry + flight recorder);
+    guarded — a broken observability layer must not fail a trace."""
+    try:
+        _telemetry.record_kernel_dispatch(kernel, outcome, bytes_saved)
+    except Exception:
+        pass

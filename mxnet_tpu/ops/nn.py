@@ -9,12 +9,12 @@ unnecessary.
 from __future__ import annotations
 
 import functools
-import os
 
 import jax
 import jax.numpy as jnp
 from jax import lax
 
+from .. import env as _env
 from ..base import normalize_dtype
 from .registry import register_op
 
@@ -282,8 +282,10 @@ def _bn_ew_dtype(x):
     REDUCTION accumulators to f32 (jnp.sum dtype=) — the r4 HLO audit's
     staged experiment: the program hands XLA ~2.9k f32 elementwise ops
     whose only f32-ness is stat math; if any fail to fuse on TPU they
-    double HBM traffic. A/B on chip before changing the default."""
-    if os.environ.get("MXTPU_BN_COMPUTE") == "bf16":
+    double HBM traffic. A/B on chip before changing the default.
+    The Pallas BN kernels (kernels/norm.py) read the same knob, so the
+    elementwise-dtype experiment stays a single switch either way."""
+    if _env.get("MXTPU_BN_COMPUTE") == "bf16":
         return x.dtype
     return jnp.float32
 
@@ -378,8 +380,16 @@ def batch_norm(x, gamma, beta, moving_mean, moving_var, eps=1e-5,
     """
     axis = axis % x.ndim  # normalize negative axis (-1 = channels-last)
     if training and not use_global_stats:
-        out, mean, var = _bn_train(x, gamma, beta, moving_mean,
-                                   float(eps), axis)
+        bn = _bn_train
+        try:
+            from ..kernels import dispatch as _kdispatch
+            if _kdispatch.mode() != "off":
+                from ..kernels import norm as _knorm
+                bn = _knorm.bn_train
+        except ImportError:
+            pass
+        out, mean, var = bn(x, gamma, beta, moving_mean,
+                            float(eps), axis)
         new_mean = moving_mean * momentum + mean.astype(moving_mean.dtype) * (1 - momentum)
         new_var = moving_var * momentum + var.astype(moving_var.dtype) * (1 - momentum)
         return out, new_mean, new_var

@@ -259,36 +259,53 @@ class Optimizer:
                 and cls._rule is not Optimizer._rule)
 
     @staticmethod
+    def _fused_param_step(cls, clip, gn, mp, w, st, g, lr, wd, t, scale,
+                          hyper):
+        """One parameter's ladder inside a fused bucket: rescale →
+        global-norm scale → per-element clip → `cls._rule` (→ master
+        cast).  The XLA reference body — kernels/opt.py's Pallas ladder
+        is its drop-in twin and falls back to it verbatim."""
+        h = dict(hyper)
+        h["t"] = t
+        if mp:
+            # legacy update_multi_precision order: cast the
+            # low-precision grad to f32 FIRST, then rescale/
+            # clip on the f32 master
+            master, inner = st
+            g = g.astype(jnp.float32)
+        g = g * h["rescale_grad"]
+        if gn:
+            g = g * scale
+        if clip is not None:
+            g = jnp.clip(g, -clip, clip)
+        if mp:
+            nm, ni = cls._rule(master, g, inner, lr, wd, h)
+            return nm.astype(w.dtype), (nm, ni)
+        return cls._rule(w, g, st, lr, wd, h)
+
+    @staticmethod
     def _fused_step_body(cls, clip, gn, mp, ws, states, gs, lrs, wds, ts,
                          scale, hyper):
-        """Traced body of one fused bucket: rescale → global-norm scale →
-        per-element clip → `cls._rule`, unrolled over the bucket at trace
-        time. Shared verbatim by `_fused_jitted` and the whole-step
+        """Traced body of one fused bucket, unrolled over the bucket at
+        trace time. Shared verbatim by `_fused_jitted` and the whole-step
         compiled path (gluon/train_step.py) so both produce bitwise-equal
-        numerics — same op order, same dtype promotion."""
+        numerics — same op order, same dtype promotion.  When
+        MXTPU_KERNELS is enabled each parameter's ladder goes through the
+        Pallas dispatch instead (which itself falls back per-param)."""
+        step_one = Optimizer._fused_param_step
+        try:
+            from ..kernels import dispatch as _kdispatch
+            if _kdispatch.mode() != "off":
+                from ..kernels import opt as _kopt
+                step_one = _kopt.param_step
+        except ImportError:
+            pass
         new_ws, new_states = [], []
         for w, st, g, lr, wd, t in zip(ws, states, gs, lrs, wds, ts):
-            h = dict(hyper)
-            h["t"] = t
-            if mp:
-                # legacy update_multi_precision order: cast the
-                # low-precision grad to f32 FIRST, then rescale/
-                # clip on the f32 master
-                master, inner = st
-                g = g.astype(jnp.float32)
-            g = g * h["rescale_grad"]
-            if gn:
-                g = g * scale
-            if clip is not None:
-                g = jnp.clip(g, -clip, clip)
-            if mp:
-                nm, ni = cls._rule(master, g, inner, lr, wd, h)
-                new_ws.append(nm.astype(w.dtype))
-                new_states.append((nm, ni))
-            else:
-                nw, ns = cls._rule(w, g, st, lr, wd, h)
-                new_ws.append(nw)
-                new_states.append(ns)
+            nw, ns = step_one(cls, clip, gn, mp, w, st, g, lr, wd, t,
+                              scale, hyper)
+            new_ws.append(nw)
+            new_states.append(ns)
         return new_ws, new_states
 
     def _fused_jitted(self, n, mp, donate):
@@ -301,7 +318,12 @@ class Optimizer:
         promotion (bf16 math stays bf16)."""
         cls = type(self)
         gn = self.clip_global_norm is not None
-        key = (cls, self.clip_gradient, "fused", n, mp, gn, donate)
+        try:
+            from ..kernels import dispatch as _kdispatch
+            kmode = _kdispatch.mode()
+        except ImportError:
+            kmode = "off"
+        key = (cls, self.clip_gradient, "fused", n, mp, gn, donate, kmode)
         fn = Optimizer._jit_cache.get(key)
         if fn is None:
             clip = self.clip_gradient

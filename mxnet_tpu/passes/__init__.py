@@ -5,7 +5,8 @@ for a captured program (CachedOp variants, export, symbol lowering, the
 whole-step train program) flows through :func:`apply`, which runs the
 resolved passes jaxpr → jaxpr before XLA sees the graph.  Shipped
 passes: :class:`AmpPass` (auto mixed precision), :class:`RematPass`
-(segmented rematerialization with an `auto` cost-model policy), and
+(segmented rematerialization with an `auto` cost-model policy),
+:class:`KernelPass` (the bandwidth-kernel audit; docs/kernels.md), and
 cross-CachedOp structural dedup (MXTPU_GRAPH_DEDUP).  docs/passes.md
 covers the architecture and how to write a custom pass.
 """
@@ -36,11 +37,13 @@ from .dedup import (  # noqa: F401
     reset_executable_cache,
     structural_key,
 )
+from .kernel_pass import KernelPass  # noqa: F401
 from . import _state  # noqa: F401
 from . import memory  # noqa: F401
 
 register_named_pass("amp", AmpPass)
 register_named_pass("remat", RematPass)
+register_named_pass("kernels", KernelPass)
 
 
 def _numerics_factory():
@@ -56,6 +59,7 @@ __all__ = [
     "AmpPass",
     "DedupExecutable",
     "GraphPass",
+    "KernelPass",
     "PassContext",
     "PassManager",
     "RematPass",

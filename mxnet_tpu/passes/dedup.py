@@ -155,6 +155,49 @@ def _rule_jaxpr_token(eqn, thunk):
         _RULE_DEPTH.d = 0
 
 
+def _pallas_key(params):
+    """Structural token for one ``pallas_call``: the traced kernel body
+    plus the launch geometry that selects a Mosaic program.  Anything we
+    can't reduce to structure raises ``_Unhashable`` — the program then
+    takes a private executable, never a wrong shared one.
+
+    Kernel bodies mutate their refs, so they carry jax state effects —
+    internal to the pallas_call, invisible to the surrounding program.
+    They are canonicalized WITH their effect structure (two bodies match
+    only if their read/write effects match positionally) instead of
+    tripping the top-level no-effects rule."""
+    params = dict(params)
+    prev = _EFFECT_TOLERANT[0]
+    _EFFECT_TOLERANT[0] = True
+    try:
+        kernel = ("kernel", _canon(params.pop("jaxpr")))
+    finally:
+        _EFFECT_TOLERANT[0] = prev
+    gm = params.pop("grid_mapping", None)
+    geo = ()
+    if gm is not None:
+        blocks = []
+        for bm in getattr(gm, "block_mappings", ()):
+            blocks.append((
+                tuple(getattr(bm, "block_shape", ())),
+                _canon(getattr(bm, "index_map_jaxpr", None)),
+            ))
+        geo = (tuple(getattr(gm, "grid", ())), tuple(blocks))
+    rest = {}
+    for k, v in params.items():
+        try:
+            rest[k] = _canon(v)
+        except _Unhashable:
+            # compiler params / cost estimates that resist tokenizing
+            # are keyed by repr when stable; an address-bearing repr is
+            # identity, not structure — poison the key instead
+            r = repr(v)
+            if "0x" in r:
+                raise
+            rest[k] = ("repr", r)
+    return ("pallas", kernel, geo, _canon(rest))
+
+
 def _eqn_params_key(eqn):
     params = dict(eqn.params)
     if eqn.primitive.name in _CUSTOM_CALL_PRIMS:
@@ -167,12 +210,40 @@ def _eqn_params_key(eqn):
             elif k in _RULE_FUN_PARAMS:
                 rules.append((k, _rule_fun_token(params.pop(k))))
         return ("custom", _canon(params), tuple(rules))
+    if eqn.primitive.name == "pallas_call":
+        # a Pallas kernel IS a structural feature: two programs share an
+        # executable only when kernel body + grid + block maps agree
+        try:
+            return _pallas_key(params)
+        except _Unhashable:
+            raise
+        except Exception:
+            raise _Unhashable from None
     return _canon(params)
 
 
+# canonicalizing a Pallas kernel body (see _pallas_key): its internal
+# ref state effects become part of the key instead of poisoning it
+_EFFECT_TOLERANT = [False]
+
+
+def _effects_key(effects):
+    toks = []
+    for e in effects:
+        r = repr(e)
+        if "0x" in r:        # address-bearing repr: identity, not structure
+            raise _Unhashable
+        toks.append(r)
+    return tuple(sorted(toks))
+
+
 def _jaxpr_key(jaxpr):
-    if getattr(jaxpr, "effects", None):
-        raise _Unhashable  # effectful programs never share executables
+    effects = getattr(jaxpr, "effects", None)
+    eff_tok = ()
+    if effects:
+        if not _EFFECT_TOLERANT[0]:
+            raise _Unhashable  # effectful programs never share executables
+        eff_tok = _effects_key(effects)
     ids = {}
 
     def vid(v):
@@ -187,6 +258,7 @@ def _jaxpr_key(jaxpr):
         return ("var", vid(v), _aval_key(v.aval))
 
     parts = [
+        ("effects", eff_tok),
         ("const", tuple((vid(v), _aval_key(v.aval))
                         for v in jaxpr.constvars)),
         ("in", tuple((vid(v), _aval_key(v.aval)) for v in jaxpr.invars)),

@@ -1,0 +1,81 @@
+"""KernelPass — the pass-pipeline face of the bandwidth kernels.
+
+The kernels themselves are chosen at TRACE time by their call sites
+(ops/nn.py, optimizer/optimizer.py consulting kernels/dispatch.py):
+rewriting a finished jaxpr can't recover a custom-VJP's nondiff
+arguments, and site dispatch is what keeps ``MXTPU_KERNELS=off``
+bitwise-exact.  What the pipeline CAN do — and this pass does — is
+audit the captured program after the sites have spoken:
+
+* census the ``pallas_call`` equations that actually landed in the
+  graph (how many sites adopted a kernel);
+* run the promoted byte model (:func:`passes.memory.estimate_region_bytes`)
+  over the program and report the residual top external-byte regions —
+  the regions a FUTURE kernel should target next;
+* publish both in ``ctx.notes["kernels"]`` so seam owners, tests and
+  `tools/fusion_audit.py --report` read one consistent account.
+
+Priority 40 places the audit after AmpPass(10) has rewritten dtypes —
+the byte model must see the dtypes XLA will see — and before
+RematPass(90) duplicates region interiors, which would double-count
+bytes that never hit HBM twice.  The pass never edits the jaxpr; it is
+injected by :func:`manager.resolve_passes` whenever MXTPU_KERNELS is
+not off.
+"""
+from __future__ import annotations
+
+from .manager import GraphPass
+
+__all__ = ["KernelPass", "audit_jaxpr"]
+
+# report at most this many residual regions per seam — notes ride in
+# every pipeline entry and postmortem bundle, keep them bounded
+_TOP_REGIONS = 8
+
+
+def audit_jaxpr(closed):
+    """The KernelPass audit of one captured program: pallas_call census
+    plus the byte model's residual hot regions."""
+    from . import memory as _memory
+
+    n_pallas = 0
+
+    def _walk(jaxpr):
+        nonlocal n_pallas
+        for eqn in jaxpr.eqns:
+            if eqn.primitive.name == "pallas_call":
+                n_pallas += 1
+            for v in eqn.params.values():
+                sub = getattr(v, "jaxpr", None)
+                if sub is not None:
+                    _walk(sub)
+
+    _walk(closed.jaxpr)
+    regions = _memory.estimate_region_bytes(closed)
+    top = [{"external_bytes": r["external_bytes"],
+            "eqns": r["eqns"],
+            "prims": dict(sorted(r["prims"].items(),
+                                 key=lambda kv: -kv[1])[:6])}
+           for r in regions[:_TOP_REGIONS]]
+    return {
+        "pallas_calls": n_pallas,
+        "regions": len(regions),
+        "external_bytes_total": sum(r["external_bytes"] for r in regions),
+        "top_regions": top,
+    }
+
+
+class KernelPass(GraphPass):
+    """Audit-only pass: reports kernel adoption and residual HBM-bound
+    regions for the seam being built.  See module docstring."""
+
+    name = "kernels"
+    priority = 40
+    kinds = ("block", "whole_step_fwd", "whole_step")
+
+    def run(self, closed_jaxpr, ctx):
+        try:
+            ctx.notes["kernels"] = audit_jaxpr(closed_jaxpr)
+        except Exception as exc:  # audit must never fail a build
+            ctx.notes["kernels"] = {"error": repr(exc)}
+        return closed_jaxpr

@@ -209,6 +209,13 @@ def resolve_passes(ctx):
     if _numerics.mode() != "off" \
             and not any(p.name == "numerics" for p in passes):
         passes.append(_numerics.NumericsPass())
+    # same one-normalization contract as numerics: kernels.dispatch.mode()
+    # both injects the audit pass here and gates the sites themselves
+    from ..kernels import dispatch as _kdispatch
+    if _kdispatch.mode() != "off" \
+            and not any(p.name == "kernels" for p in passes):
+        from .kernel_pass import KernelPass
+        passes.append(KernelPass())
     passes = [p for p in passes if p.applies(ctx)]
     passes.sort(key=lambda p: (p.priority, p.name))
     return passes

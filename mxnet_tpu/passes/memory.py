@@ -28,6 +28,9 @@ from jax.extend import core as jcore
 __all__ = [
     "estimate_peak_bytes",
     "estimate_training_peak_bytes",
+    "estimate_region_bytes",
+    "norm_region_bytes",
+    "optimizer_region_bytes",
 ]
 
 # Call-like primitives whose sub-jaxpr binds the eqn's operands 1:1 —
@@ -187,3 +190,294 @@ def estimate_training_peak_bytes(closed):
     grad_closed = jax.make_jaxpr(
         jax.grad(scalar_loss, argnums=argnums))(*sds)
     return estimate_peak_bytes(grad_closed)
+
+
+# ---------------------------------------------------------------------------
+# per-region external-bytes model (promoted from tools/fusion_audit.py)
+# ---------------------------------------------------------------------------
+#
+# tools/fusion_audit.py runs this segmentation over the lowered StableHLO
+# text of a whole train step (union-find of fusable ops; external bytes =
+# cross-region SSA edges).  The KernelPass `auto` decision needs the SAME
+# model at the jaxpr level — before lowering, per call site — so the
+# segmentation is promoted here, on top of the liveness walk's flattening
+# (_sub_jaxpr / _aval_bytes).
+#
+# Calibration: the r5 audit's empirical finding is that XLA on TPU treats
+# REDUCTIONS and large WIDENING CONVERTS as fusion roots — their producers
+# fuse in, their consumers start a new kernel, so the value at the boundary
+# round-trips through HBM.  That is exactly what made the BN-stats f32
+# population the worst region of the step.  The model below encodes it:
+#
+#   * anchor prims (conv/dot/gather/...) are their own region;
+#   * reduce prims and >=`widen_threshold`-byte widening converts are
+#     fusion ROOTS: they merge upstream, and everything downstream of
+#     their output belongs to a later region (tracked by a per-value
+#     "root generation" — a step only merges with producers of its own
+#     generation);
+#   * everything else elementwise-ish merges freely within a generation;
+#   * a region's external bytes = bytes of values crossing its boundary
+#     (inputs produced outside + outputs consumed outside), the HBM
+#     traffic a perfectly-fused XLA schedule still pays.
+
+_ANCHOR_PRIMS = frozenset((
+    "conv_general_dilated", "dot_general", "reduce_window_sum",
+    "reduce_window_max", "reduce_window_min", "scatter", "scatter-add",
+    "scatter_add", "gather", "sort", "dynamic_slice", "dynamic_update_slice",
+    "iota", "rng_bit_generator", "random_bits", "fft", "custom_call",
+    "pallas_call", "while", "scan", "cond",
+))
+
+_REDUCE_PRIMS = frozenset((
+    "reduce_sum", "reduce_max", "reduce_min", "reduce_prod", "reduce_and",
+    "reduce_or", "reduce_xor", "argmax", "argmin", "reduce_precision",
+    "cumsum", "cumlogsumexp", "cummax", "cummin", "cumprod",
+))
+
+
+def _flatten_steps(closed):
+    """Flatten a ClosedJaxpr (inlining _INLINE_PRIMS sub-jaxprs, the same
+    walk estimate_peak_bytes does) into a step list for the region model:
+    (prim_name, in_tokens, out_tokens).  Returns (steps, token_bytes,
+    input_tokens, output_tokens, token_dtype_size)."""
+    jaxpr = closed.jaxpr
+    counter = itertools.count()
+    token_bytes = {}
+    token_itemsize = {}
+    steps = []
+
+    def new_token(aval):
+        t = next(counter)
+        token_bytes[t] = _aval_bytes(aval)
+        try:
+            token_itemsize[t] = np.dtype(
+                getattr(aval, "dtype", np.float32)).itemsize
+        except TypeError:
+            token_itemsize[t] = 4
+        return t
+
+    def walk(j, in_tokens, const_tokens):
+        env = {}
+        for v, t in zip(j.constvars, const_tokens):
+            env[id(v)] = t
+        for v, t in zip(j.invars, in_tokens):
+            env[id(v)] = t
+
+        def read(v):
+            if isinstance(v, jcore.Literal):
+                return None
+            return env.get(id(v))
+
+        for eqn in j.eqns:
+            ins = [read(v) for v in eqn.invars]
+            sub = _sub_jaxpr(eqn)
+            if sub is not None:
+                inner, consts = sub
+                const_ts = [new_token(jax.api_util.shaped_abstractify(c))
+                            for c in consts]
+                inner_outs = walk(inner, ins, const_ts)
+                for v, t in zip(eqn.outvars, inner_outs):
+                    if t is None:
+                        t = new_token(v.aval)
+                        steps.append(("literal", (), (t,)))
+                    env[id(v)] = t
+            else:
+                outs = []
+                for v in eqn.outvars:
+                    t = new_token(v.aval)
+                    env[id(v)] = t
+                    outs.append(t)
+                steps.append((eqn.primitive.name,
+                              tuple(t for t in ins if t is not None),
+                              tuple(outs)))
+        return [read(v) for v in j.outvars]
+
+    in_ts = [new_token(v.aval) for v in jaxpr.invars]
+    const_ts = [new_token(v.aval) for v in jaxpr.constvars]
+    out_ts = walk(jaxpr, in_ts, const_ts)
+    boundary_in = set(in_ts) | set(const_ts)
+    boundary_out = set(t for t in out_ts if t is not None)
+    return steps, token_bytes, token_itemsize, boundary_in, boundary_out
+
+
+def estimate_region_bytes(closed, widen_threshold=1 << 20):
+    """Segment one captured jaxpr into XLA-fusion regions and charge each
+    region its external HBM bytes.  Returns regions sorted by external
+    bytes, descending:
+
+        [{"eqns": int, "external_bytes": int, "input_bytes": int,
+          "output_bytes": int, "prims": {name: count}}, ...]
+
+    `widen_threshold`: widening converts producing at least this many
+    bytes are treated as fusion roots (the audit's empirical
+    f32-materialization boundary); smaller ones fuse like elementwise.
+    """
+    steps, token_bytes, token_itemsize, boundary_in, boundary_out = \
+        _flatten_steps(closed)
+
+    producer = {}
+    consumers = {}
+    for i, (_, ins, outs) in enumerate(steps):
+        for t in outs:
+            producer[t] = i
+        for t in ins:
+            consumers.setdefault(t, []).append(i)
+
+    def kind_of(i):
+        prim, ins, outs = steps[i]
+        if prim in _ANCHOR_PRIMS:
+            return "anchor"
+        if prim in _REDUCE_PRIMS or prim.startswith("reduce_"):
+            return "root"
+        if prim == "convert_element_type" and ins and outs:
+            if (token_itemsize[outs[0]] > token_itemsize[ins[0]]
+                    and token_bytes[outs[0]] >= widen_threshold):
+                return "root"
+        return "fuse"
+
+    kinds = [kind_of(i) for i in range(len(steps))]
+
+    # root generation per value: consumers of a root output live one
+    # generation later, so they can never merge back across the boundary
+    gen = {t: 0 for t in boundary_in}
+    step_gen = [0] * len(steps)
+    for i, (_, ins, outs) in enumerate(steps):
+        g = max((gen.get(t, 0) for t in ins), default=0)
+        step_gen[i] = g
+        out_g = g + 1 if kinds[i] == "root" else g
+        for t in outs:
+            gen[t] = out_g
+
+    parent = list(range(len(steps)))
+
+    def find(i):
+        while parent[i] != i:
+            parent[i] = parent[parent[i]]
+            i = parent[i]
+        return i
+
+    def union(a, b):
+        ra, rb = find(a), find(b)
+        if ra != rb:
+            parent[ra] = rb
+
+    for i, (_, ins, _) in enumerate(steps):
+        if kinds[i] == "anchor":
+            continue
+        for t in ins:
+            j = producer.get(t)
+            if j is None or kinds[j] == "anchor":
+                continue
+            # merge with same-generation producers only: a root merges
+            # upstream (its inputs share its generation), while steps
+            # downstream of a root output carry a later generation and
+            # stay in their own region
+            if step_gen[j] == step_gen[i] and kinds[j] != "root":
+                union(i, j)
+            elif kinds[j] == "root" and kinds[i] == "root" \
+                    and step_gen[j] == step_gen[i]:
+                union(i, j)
+
+    regions = {}
+    for i in range(len(steps)):
+        if kinds[i] == "anchor":
+            continue
+        regions.setdefault(find(i), []).append(i)
+
+    out = []
+    for members in regions.values():
+        mset = set(members)
+        in_bytes = out_bytes = 0
+        seen_in, seen_out = set(), set()
+        prims = {}
+        for i in members:
+            prim, ins, outs = steps[i]
+            prims[prim] = prims.get(prim, 0) + 1
+            for t in ins:
+                if t in seen_in:
+                    continue
+                j = producer.get(t)
+                if j is None or j not in mset:
+                    seen_in.add(t)
+                    in_bytes += token_bytes[t]
+            for t in outs:
+                if t in seen_out:
+                    continue
+                used_outside = t in boundary_out or any(
+                    c not in mset for c in consumers.get(t, ()))
+                if used_outside:
+                    seen_out.add(t)
+                    out_bytes += token_bytes[t]
+        out.append({
+            "eqns": len(members),
+            "external_bytes": in_bytes + out_bytes,
+            "input_bytes": in_bytes,
+            "output_bytes": out_bytes,
+            "prims": dict(sorted(prims.items(), key=lambda kv: -kv[1])),
+        })
+    out.sort(key=lambda r: -r["external_bytes"])
+    return out
+
+
+# -- analytic per-site models (what the `auto` dispatch decision reads) -----
+#
+# The jaxpr segmentation above is the honest accounting over a whole
+# captured program (the KernelPass report, the >=30% acceptance test);
+# at a single call site the region shapes are known in closed form, so
+# the dispatch decision uses these O(1) per-channel-ignoring formulas.
+# Both express the same model: reduce/widen roots break the XLA program
+# into passes that round-trip the population through HBM; the Pallas
+# kernel's floor is one (or two, for two-phase stats) reads of the
+# operands plus one write of each output.
+
+def _itemsize(dtype):
+    try:
+        return np.dtype(dtype).itemsize
+    except TypeError:
+        return 4
+
+
+def norm_region_bytes(shape, x_dtype, ew_dtype):
+    """(xla_bytes, kernel_bytes) for ONE BatchNorm training call site —
+    forward and backward regions combined (a site either uses the kernel
+    pair or neither: the residual layout must match).
+
+    XLA (per the root model): fwd reads x, round-trips the centered
+    population xf across the sum/sum² reduce boundary, writes out; bwd
+    reads x and dy, round-trips xhat and the cast dy across the
+    dbeta/dgamma reduce boundary, writes dx.  Kernel: fwd reads x twice
+    (two-phase stats) and writes out; bwd reads x and dy twice and
+    writes dx.  Per-channel vectors are noise and ignored."""
+    n = 1
+    for d in shape:
+        n *= int(d)
+    bx = _itemsize(x_dtype)
+    be = _itemsize(ew_dtype)
+    xla_fwd = n * bx + 2 * n * be + n * bx
+    xla_bwd = 2 * n * bx + 4 * n * be + n * bx
+    k_fwd = 2 * n * bx + n * bx
+    k_bwd = 2 * (2 * n * bx) + n * bx
+    return xla_fwd + xla_bwd, k_fwd + k_bwd
+
+
+def optimizer_region_bytes(w_size, w_dtype, n_state, mp):
+    """(xla_bytes, kernel_bytes) for ONE parameter's fused-update chain.
+
+    The floor both paths pay: read grad, read+write each state leaf,
+    read+write the master/weight, write the low-precision weight copy
+    (mp).  XLA additionally round-trips the widened f32 grad across the
+    mp cast boundary (the audit's optimizer-chain region); without mp
+    there is no widening root, the chain is one region, and the model
+    predicts zero savings — `auto` declines, which is correct: XLA
+    already fuses the pure-f32 chain perfectly."""
+    n = int(w_size)
+    bw = _itemsize(w_dtype)
+    if mp:
+        floor = (n * bw            # read low-precision grad
+                 + 2 * n * 4       # master read+write
+                 + n_state * 2 * n * 4  # state leaves read+write (f32)
+                 + n * bw)         # write low-precision weight copy
+        xla = floor + 2 * n * 4    # g32 round-trip at the cast root
+        return xla, floor
+    floor = (n * bw + 2 * n * bw + n_state * 2 * n * bw)
+    return floor, floor

@@ -46,6 +46,8 @@ __all__ = [
     "record_serve_request", "record_serve_batch", "nbytes_of",
     "numerics_trip_total", "flight_events_total", "postmortem_dump_total",
     "record_numerics_trip", "record_flight_event", "record_postmortem",
+    "kernel_dispatch_total", "kernel_bytes_saved",
+    "record_kernel_dispatch",
 ]
 
 # v5e-class bf16 peak, the default MFU denominator (tools/perf_lab.py's
@@ -284,6 +286,23 @@ postmortem_dump_total = counter(
     "numerics / crash / exit / periodic / manual)", ["reason"])
 
 
+# -- Pallas bandwidth kernels (mxnet_tpu/kernels/; docs/kernels.md) ---------
+kernel_dispatch_total = counter(
+    "kernel_dispatch_total",
+    "Kernel-dispatch decisions by kernel and outcome, recorded once per "
+    "TRACE of a call site (never per step): outcome 'kernel' means the "
+    "Pallas kernel was emitted into the captured program; every other "
+    "outcome names why the site fell back to the XLA path (platform / "
+    "unsupported_shape / unsupported_dtype / unsupported_rule / "
+    "no_savings / too_small)", ["kernel", "outcome"])
+kernel_bytes_saved = counter(
+    "kernel_bytes_saved",
+    "External HBM bytes the passes/memory.py byte model predicts each "
+    "dispatched Pallas kernel saves over the fused-XLA estimate — a "
+    "per-compiled-program prediction accumulated at trace time, not a "
+    "per-step measurement (docs/kernels.md decision table)")
+
+
 def record_numerics_trip(label):
     """One tripped numerics check for the program `label`."""
     if not REGISTRY.enabled:
@@ -303,6 +322,22 @@ def record_postmortem(reason):
     if not REGISTRY.enabled:
         return
     postmortem_dump_total.labels(reason).inc()
+
+
+def record_kernel_dispatch(kernel, outcome, bytes_saved=0):
+    """One trace-time kernel-dispatch decision at a call site: `outcome`
+    is 'kernel' (Pallas emitted) or a fallback reason; `bytes_saved` is
+    the byte model's predicted HBM saving for a dispatched kernel.
+    Fallbacks also land in the flight recorder so postmortems show
+    which path a program actually compiled with."""
+    if outcome != "kernel":
+        _flight_record("kernel_fallback", kernel=str(kernel),
+                       reason=str(outcome))
+    if not REGISTRY.enabled:
+        return
+    kernel_dispatch_total.labels(kernel, outcome).inc()
+    if bytes_saved:
+        kernel_bytes_saved.inc(int(bytes_saved))
 
 
 def _flight_record(kind, **fields):

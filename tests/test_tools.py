@@ -355,3 +355,70 @@ raise RuntimeError("boom")
     assert b["reason"] == "crash:RuntimeError", b["reason"]
     kinds = [e["kind"] for e in b["events"]]
     assert "crash" in kinds and "tick" in kinds
+
+
+def test_fusion_audit_report_smoke(tmp_path):
+    """--report ranks regions by external HBM bytes, annotates kernel
+    coverage, and carries the byte-model predictions for the three
+    audited regions (bn fwd+bwd >= 30%, optimizer mp >= 30%, optimizer
+    non-mp 0% -- which is why auto declines it)."""
+    out = tmp_path / "report.json"
+    r = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "fusion_audit.py"),
+         "--report", "--model", "mlp", "--batch", "32",
+         "--json", str(out)],
+        env=ENV, capture_output=True, text=True, timeout=300)
+    assert r.returncode == 0, r.stderr
+    rep = json.load(open(out))
+    assert rep["model"] == "mlp"
+    assert rep["mode"] == "off"          # MXTPU_KERNELS unset in ENV
+    assert rep["n_regions"] >= 1
+    assert rep["external_bytes_total"] > 0
+    assert set(rep["coverage_bytes"]) == {"covered", "fallback", "uncovered"}
+    preds = rep["kernels"]
+    assert preds["bn_fwd_bwd"]["predicted_reduction"] >= 0.30
+    assert preds["optimizer_mp"]["predicted_reduction"] >= 0.30
+    assert preds["optimizer_f32"]["predicted_reduction"] == 0.0
+    for row in rep["regions"]:
+        assert row["coverage"] in ("covered", "fallback", "uncovered")
+        assert row["external_bytes"] >= 0 and row["rank"] >= 1
+    # Rows arrive ranked by external bytes, descending.
+    sizes = [row["external_bytes"] for row in rep["regions"]]
+    assert sizes == sorted(sizes, reverse=True)
+
+
+def test_bench_platform_stamp_and_cross_platform_gate(monkeypatch):
+    """Every bench snapshot is stamped with its platform, and the >3%
+    regression gate refuses to compare snapshots from different
+    platforms instead of emitting nonsense regressions."""
+    sys.path.insert(0, REPO)
+    import bench
+
+    # Platform inference: explicit stamp > _CPU_FALLBACK marker > tpu.
+    assert bench._snapshot_platform({"platform": "tpu"}) == "tpu"
+    assert bench._snapshot_platform({"platform": "cpu"}) == "cpu"
+    assert bench._snapshot_platform(
+        {"rows": [{"metric": "x_CPU_FALLBACK"}]}) == "cpu"
+    assert bench._snapshot_platform({"rows": [{"metric": "foo_ms"}]}) == "tpu"
+
+    prior = {"platform": "tpu",
+             "rows": [{"metric": "train_step_ms", "value": 100.0}]}
+    monkeypatch.setattr(bench, "_latest_bench_snapshot",
+                        lambda: ("BENCH_r99.json", prior))
+
+    # Cross-platform: refused, noted, zero regressions reported.
+    current = {"platform": "cpu",
+               "rows": [{"metric": "train_step_ms", "value": 500.0}]}
+    assert bench._check_regressions(current) == []
+    assert "platform" in current.get("comparison_note", "")
+
+    # Same platform: a lower-is-better _ms metric rising >3% is flagged.
+    current = {"platform": "tpu",
+               "rows": [{"metric": "train_step_ms", "value": 110.0}]}
+    regs = bench._check_regressions(current)
+    assert any("train_step_ms" in str(reg) for reg in regs)
+
+    # ... and an in-tolerance run passes the gate clean.
+    current = {"platform": "tpu",
+               "rows": [{"metric": "train_step_ms", "value": 101.0}]}
+    assert bench._check_regressions(current) == []
