@@ -638,6 +638,65 @@ def bench_kernels_overhead(platform, iters, warmup):
     return kernels_ms, off_ms
 
 
+def bench_layout_overhead(platform, iters, warmup):
+    """Whole-step latency with MXTPU_LAYOUT=auto vs off on an NCHW
+    conv/BN/relu stack (the LayoutPass target shape). Returns
+    (auto_ms, off_ms, img_s_auto). On CPU both sides run the same math
+    (XLA layout-assigns either way) — the row then measures rewrite +
+    re-layout overhead and the _CPU_FALLBACK suffix says so; on TPU the
+    auto side keeps C in lanes end to end (docs/layout.md)."""
+    import numpy as onp
+
+    import mxnet_tpu as mx
+    from mxnet_tpu import gluon
+    from mxnet_tpu.gluon import nn
+
+    batch = 4 if platform == "cpu" else 64
+    side = 16 if platform == "cpu" else 56
+    widths = (32, 64) if platform == "cpu" else (128, 256, 256)
+    rs = onp.random.RandomState(0)
+    x = mx.np.array(rs.rand(batch, 16, side, side).astype("f"))
+    y = mx.np.array(
+        rs.rand(batch, widths[-1], side, side).astype("f"))
+
+    def run(layout_mode):
+        prev = os.environ.get("MXTPU_LAYOUT")
+        os.environ["MXTPU_LAYOUT"] = layout_mode
+        try:
+            mx.seed(0)
+            net = nn.HybridSequential()
+            c_in = 16
+            for c in widths:
+                net.add(nn.Conv2D(c, 3, padding=1, in_channels=c_in,
+                                  use_bias=False),
+                        nn.BatchNorm(in_channels=c),
+                        nn.Activation("relu"))
+                c_in = c
+            net.initialize()
+            net.hybridize()
+            trainer = gluon.Trainer(
+                net.collect_params(), "sgd",
+                {"learning_rate": 0.05, "momentum": 0.9})
+            step = gluon.TrainStep(
+                net, lambda out, t: ((out - t) ** 2).mean(), trainer)
+            dt, _ = _timeit(lambda: step(x, y),
+                            lambda l: float(l.asnumpy()),
+                            iters, warmup)
+            if step.last_path != "whole_step":
+                raise RuntimeError("layout bench fell back to phased")
+            return dt / iters * 1000.0
+        finally:
+            if prev is None:
+                os.environ.pop("MXTPU_LAYOUT", None)
+            else:
+                os.environ["MXTPU_LAYOUT"] = prev
+
+    off_ms = run("off")
+    auto_ms = run("auto")
+    img_s_auto = batch / (auto_ms / 1000.0)
+    return auto_ms, off_ms, img_s_auto
+
+
 def bench_kernel_micro_ms(platform, iters=50):
     """Per-kernel microbenches at an audited shape: wall ms per call of
     the BN statistics forward, the BN backward, and the fused optimizer
@@ -1035,6 +1094,29 @@ def main():
                     f"(off={koff_ms:.3f}ms; docs/kernels.md)"})
     except Exception as e:
         rows.append({"metric": "train_step_ms_kernels", "error": str(e)})
+
+    # layout pass: whole-step A/B (MXTPU_LAYOUT=auto vs off) on an NCHW
+    # conv stack; the _ms row rides the lower-is-better gate and the
+    # img/s row records the auto-side throughput (docs/layout.md)
+    try:
+        if over_budget():
+            raise TimeoutError("bench budget exhausted")
+        ly_iters = iters if platform != "cpu" else 5
+        ly_ms, ly_off_ms, ly_img_s = bench_layout_overhead(
+            platform, ly_iters, warmup)
+        ly_note = (f"whole-step latency with MXTPU_LAYOUT=auto "
+                   f"(NHWC propagation + persistent HWIO weights); vs "
+                   f"off: {ly_ms / ly_off_ms:.4f}x "
+                   f"(off={ly_off_ms:.3f}ms; docs/layout.md)")
+        rows.append({
+            "metric": "train_step_ms_layout" + suffix,
+            "value": round(ly_ms, 3), "unit": "ms", "note": ly_note})
+        rows.append({
+            "metric": "train_img_s_nhwc_auto" + suffix,
+            "value": round(ly_img_s, 2), "unit": "img/s",
+            "note": ly_note})
+    except Exception as e:
+        rows.append({"metric": "train_step_ms_layout", "error": str(e)})
     try:
         if over_budget():
             raise TimeoutError("bench budget exhausted")

@@ -322,6 +322,24 @@ register(
     "execute off-TPU (CPU parity tests). Without it, non-TPU platforms "
     "take the XLA fallback even under MXTPU_KERNELS=force.")
 register(
+    "MXTPU_LAYOUT", str, "off",
+    "Whole-graph channels-last layout pass (passes/layout.py; "
+    "docs/layout.md): 'off' (default) never consults the pass — "
+    "captured programs and weight buffers are bitwise-identical to main "
+    "with zero extra traces; 'auto' rewrites conv-bearing graphs to "
+    "NHWC/HWIO only when the passes/memory.py external-bytes model "
+    "predicts the saved per-conv relayouts outweigh the boundary "
+    "transposes it must insert; 'nhwc' rewrites whenever a "
+    "channels-first conv is present. Conv weights are re-laid-out "
+    "persistently (one-time OIHW→HWIO device transpose); checkpoints "
+    "round-trip the logical NCHW layout either way.")
+register(
+    "MXTPU_LAYOUT_MIN_BYTES", int, 1 << 20,
+    "MXTPU_LAYOUT=auto declines graphs whose channels-first conv "
+    "activations (inputs + outputs) total fewer external bytes than "
+    "this — relayout bookkeeping swamps any bandwidth win on tiny "
+    "graphs (passes/layout.py).")
+register(
     "MXTPU_BN_COMPUTE", str, "f32",
     "Element-wise dtype of the O(N·H·W·C) BatchNorm tensors (ops/nn.py "
     "_bn_ew_dtype; the r5 audit's top falsifiable prediction): 'f32' "

@@ -66,6 +66,11 @@ class Parameter:
         self._grad_map = None
         self._ctx_list = None
         self._deferred = None  # (init, device_list, default_init)
+        # persistent physical layout (passes/layout.py prepare_block):
+        # None = physical == logical; else data()/grad() buffers hold
+        # transpose(logical, _layout_perm) while self.shape, set_data,
+        # logical_data and every checkpoint stay in the LOGICAL layout
+        self._layout_perm = None
         # tracer visible during CachedOp tracing — THREAD-LOCAL so a trace
         # in one thread cannot leak tracers into concurrent inference
         # threads (reference: cached_op_threadsafe.cc isolation)
@@ -167,8 +172,10 @@ class Parameter:
         grad_req change: reused buffers would feed stale gradients into
         an 'add' accumulation."""
         self._grad_map = {}
+        shape = self._shape if self._layout_perm is None \
+            else tuple(self._shape[i] for i in self._layout_perm)
         for d, arr in self._data_map.items():
-            g = _wrap_out(jnp.zeros(self._shape, self.dtype)).copyto(d)
+            g = _wrap_out(jnp.zeros(shape, self.dtype)).copyto(d)
             self._grad_map[d] = g
             arr._grad = g
             arr._grad_req = self._grad_req
@@ -273,6 +280,17 @@ class Parameter:
         dev = x.device
         return self._data_map.get(dev, self._data_map[self._ctx_list[0]])
 
+    def logical_data(self, ctx=None, device=None):
+        """The value in the parameter's LOGICAL layout (``self.shape``),
+        undoing any persistent physical re-layout — what checkpoints and
+        save_parameters serialize so files stay portable across
+        MXTPU_LAYOUT settings."""
+        arr = self.data(ctx=ctx, device=device)
+        if self._layout_perm is None or self._traced_data is not None:
+            return arr
+        inv = tuple(int(i) for i in _np.argsort(self._layout_perm))
+        return _wrap_out(jnp.transpose(arr._data, inv))
+
     def list_data(self):
         self._check_initialized()
         return [self._data_map[d] for d in self._ctx_list]
@@ -313,12 +331,21 @@ class Parameter:
                 ".initialize() before set_data (reference parity)")
         if not isinstance(data, NDArray):
             data = NDArray(jnp.asarray(data, self.dtype))
+        src = data._data
+        # set_data speaks the LOGICAL layout (checkpoints, user code);
+        # convert to the persistent physical layout once, here, so NCHW
+        # era files load bitwise onto re-laid-out parameters
+        if self._layout_perm is not None:
+            phys = tuple(self._shape[i] for i in self._layout_perm)
+            if tuple(src.shape) == phys and phys != tuple(self._shape):
+                pass  # already physical (internal caller)
+            else:
+                src = jnp.transpose(src, self._layout_perm)
         for d in self._ctx_list:
             arr = self._data_map[d]
             # honor the declared dtype, not the old buffer's — load with
             # dtype_source='saved' retypes the parameter before set_data
-            arr._data = jnp.asarray(
-                data._data, self.dtype or arr._data.dtype)
+            arr._data = jnp.asarray(src, self.dtype or arr._data.dtype)
             arr._version += 1
 
     def zero_grad(self):

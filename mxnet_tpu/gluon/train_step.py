@@ -447,6 +447,14 @@ class TrainStep:
             # complete deferred init BEFORE the (cached) eligibility
             # check — it inspects dtypes and device placement
             self._net._ensure_initialized(batch[:self._n_data])
+        if not getattr(self._net, "_layout_prepared", False):
+            # persistent NHWC weight re-layout BEFORE tws/frozen are
+            # built: the donated whole-step program then updates the
+            # physical (HWIO) buffers in place, never re-transposing
+            # (passes/layout.py; MXTPU_LAYOUT=off returns immediately)
+            from ..passes import layout as _layout_pass
+
+            _layout_pass.prepare_block(self._net, trainer=self._trainer)
         if not self._eligible():
             return self._phased(batch, batch_size)
         if not self._built:

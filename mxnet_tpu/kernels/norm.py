@@ -20,9 +20,11 @@ blocked reduction order differs from XLA's.
 
 `bn_train` is the drop-in custom_vjp twin of `_bn_train`: same
 signature, same residuals, same (dx, dgamma, dbeta, 0·shift) cotangent
-contract.  Unsupported shape/dtype (channel axis not last, C % 128,
-rows % 8) falls back to the exact XLA implementation inside the same
-wrapper, recording the outcome via kernels.dispatch.
+contract.  Unsupported shape/dtype (C % 128, rows % 8, non-float) falls back to
+the exact XLA implementation inside the same wrapper, recording the
+outcome via kernels.dispatch; a channel-axis-not-last site that would
+otherwise qualify records "channels_first" — the LayoutPass
+(MXTPU_LAYOUT) exists to turn those into kernel hits.
 """
 from __future__ import annotations
 
@@ -54,8 +56,22 @@ def _block_rows(m, c):
 
 def _supported(x, axis):
     """None when the kernel pair can run on this site, else the fallback
-    outcome name (the docs/kernels.md taxonomy)."""
-    if x.ndim < 2 or axis != x.ndim - 1:
+    outcome name (the docs/kernels.md taxonomy).
+
+    "channels_first" singles out the sites where ONLY the layout — not
+    the size or dtype — blocks the kernel: the same tensor with its
+    channel axis moved last would qualify.  These are exactly the sites
+    the LayoutPass (MXTPU_LAYOUT, passes/layout.py) converts, so the
+    fusion-audit coverage numbers distinguish "needs NHWC" from
+    "genuinely unkernelable"."""
+    if x.ndim < 2:
+        return "unsupported_shape"
+    if axis != x.ndim - 1:
+        c = x.shape[axis] if 0 <= axis < x.ndim else 0
+        m = x.size // c if c else 0
+        if (c and c % 128 == 0 and c <= 8192 and m >= 8 and m % 8 == 0
+                and x.dtype in (jnp.float32, jnp.bfloat16)):
+            return "channels_first"
         return "unsupported_shape"
     c = x.shape[-1]
     m = x.size // c if c else 0

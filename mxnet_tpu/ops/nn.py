@@ -69,13 +69,18 @@ def _layout_spec(layout):
 
 @register_op("convolution")
 def conv(x, weight, bias=None, stride=None, pad=None, dilate=None, groups=1,
-         layout=None):
+         layout=None, kernel_layout=None):
     """N-d convolution; layout NCHW (default) or NHWC family.
 
     weight (O, I/g, *k) channels-first, (O, *k, I/g) channels-last — matching
     the reference's per-layout weight shapes. Reference:
     src/operator/nn/convolution.cc. Lowers to a single XLA
     conv_general_dilated → MXU; channels-last keeps C in lanes.
+
+    `kernel_layout` overrides the weight spec alone (e.g. "HWIO") — the
+    persistent-relayout path (passes/layout.py) feeds physically
+    transposed weights while the data layout stays whatever `layout`
+    says; output shape and numerics are unchanged.
     """
     nd = x.ndim - 2
     if layout is None:
@@ -85,6 +90,8 @@ def conv(x, weight, bias=None, stride=None, pad=None, dilate=None, groups=1,
         lhs_spec, rhs_spec, lnd = _layout_spec(layout)
         assert lnd == nd, f"layout {layout} does not match input ndim {x.ndim}"
         channels_last = layout[-1] == "C"
+    if kernel_layout is not None:
+        rhs_spec = kernel_layout
     stride = stride or (1,) * nd
     pad = pad or (0,) * nd
     dilate = dilate or (1,) * nd

@@ -38,12 +38,16 @@ from .dedup import (  # noqa: F401
     structural_key,
 )
 from .kernel_pass import KernelPass  # noqa: F401
+from .layout import LayoutPass  # noqa: F401
 from . import _state  # noqa: F401
 from . import memory  # noqa: F401
 
 register_named_pass("amp", AmpPass)
 register_named_pass("remat", RematPass)
 register_named_pass("kernels", KernelPass)
+# force-named layout (MXTPU_PASSES=layout) rewrites unconditionally;
+# MXTPU_LAYOUT owns the auto/off policy via resolve_passes injection
+register_named_pass("layout", lambda: LayoutPass("nhwc"))
 
 
 def _numerics_factory():
@@ -60,6 +64,7 @@ __all__ = [
     "DedupExecutable",
     "GraphPass",
     "KernelPass",
+    "LayoutPass",
     "PassContext",
     "PassManager",
     "RematPass",

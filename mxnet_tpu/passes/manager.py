@@ -216,6 +216,13 @@ def resolve_passes(ctx):
             and not any(p.name == "kernels" for p in passes):
         from .kernel_pass import KernelPass
         passes.append(KernelPass())
+    # and once more for layout: layout.mode() injects the NHWC rewrite
+    # here and gates prepare_block at the CachedOp/TrainStep entries —
+    # MXTPU_LAYOUT=off touches neither (zero extra traces)
+    from . import layout as _layout
+    if _layout.mode() != "off" \
+            and not any(p.name == "layout" for p in passes):
+        passes.append(_layout.LayoutPass())
     passes = [p for p in passes if p.applies(ctx)]
     passes.sort(key=lambda p: (p.priority, p.name))
     return passes

@@ -48,6 +48,8 @@ __all__ = [
     "record_numerics_trip", "record_flight_event", "record_postmortem",
     "kernel_dispatch_total", "kernel_bytes_saved",
     "record_kernel_dispatch",
+    "layout_rewrite_total", "layout_transpose_total",
+    "record_layout_rewrite",
 ]
 
 # v5e-class bf16 peak, the default MFU denominator (tools/perf_lab.py's
@@ -293,14 +295,33 @@ kernel_dispatch_total = counter(
     "TRACE of a call site (never per step): outcome 'kernel' means the "
     "Pallas kernel was emitted into the captured program; every other "
     "outcome names why the site fell back to the XLA path (platform / "
-    "unsupported_shape / unsupported_dtype / unsupported_rule / "
-    "no_savings / too_small)", ["kernel", "outcome"])
+    "channels_first / unsupported_shape / unsupported_dtype / "
+    "unsupported_rule / no_savings / too_small; 'channels_first' means "
+    "the layout, not the size, blocked the kernel — the LayoutPass "
+    "fixes exactly these, so fusion_audit coverage stays honest)",
+    ["kernel", "outcome"])
 kernel_bytes_saved = counter(
     "kernel_bytes_saved",
     "External HBM bytes the passes/memory.py byte model predicts each "
     "dispatched Pallas kernel saves over the fused-XLA estimate — a "
     "per-compiled-program prediction accumulated at trace time, not a "
     "per-step measurement (docs/kernels.md decision table)")
+
+
+# -- layout pass (passes/layout.py; docs/layout.md) -------------------------
+layout_rewrite_total = counter(
+    "layout_rewrite_total",
+    "conv_general_dilated equations the LayoutPass rewrote to "
+    "channels-last (NHWC/HWIO) dimension numbers — accumulated once per "
+    "pipeline build (a new variant / input signature), never per step")
+layout_transpose_total = counter(
+    "layout_transpose_total",
+    "Transpose equations the LayoutPass accounted for per build, by "
+    "origin: 'inserted' — materialized at an unavoidable layout "
+    "boundary (graph inputs/outputs, unrecognized ops); 'elided' — "
+    "avoided relative to the naive per-op channels-last rewrite "
+    "(cancelled transpose pairs + absorbed pre-existing transposes)",
+    ["origin"])
 
 
 def record_numerics_trip(label):
@@ -338,6 +359,19 @@ def record_kernel_dispatch(kernel, outcome, bytes_saved=0):
     kernel_dispatch_total.labels(kernel, outcome).inc()
     if bytes_saved:
         kernel_bytes_saved.inc(int(bytes_saved))
+
+
+def record_layout_rewrite(rewritten, inserted, elided):
+    """One LayoutPass build's accounting: convs rewritten to
+    channels-last plus the transposes it inserted vs elided."""
+    if not REGISTRY.enabled:
+        return
+    if rewritten:
+        layout_rewrite_total.inc(int(rewritten))
+    if inserted:
+        layout_transpose_total.labels("inserted").inc(int(inserted))
+    if elided:
+        layout_transpose_total.labels("elided").inc(int(elided))
 
 
 def _flight_record(kind, **fields):

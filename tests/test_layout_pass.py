@@ -1,0 +1,445 @@
+"""LayoutPass (mxnet_tpu/passes/layout.py; docs/layout.md): whole-graph
+NHWC propagation with transpose elision and persistent weight
+re-layout.  Covers: mode resolution + env registration, the
+MXTPU_LAYOUT=off kill switch (bitwise identity, zero extra traces),
+transpose-eqn-count elision vs the naive per-conv rewrite, NCHW-vs-NHWC
+forward+grad parity, persistent weight re-layout (physical HWIO
+buffers, logical checkpoints, NCHW-era snapshot load), auto-mode
+declines, the channels_first dispatch outcome, telemetry counters, and
+composition with whole-step donation."""
+import numpy as onp
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+import mxnet_tpu as mx
+from mxnet_tpu import env, gluon, passes, telemetry
+from mxnet_tpu.gluon import nn
+from mxnet_tpu.kernels import norm as knorm
+from mxnet_tpu.passes import layout as playout
+from mxnet_tpu.passes.layout import LayoutPass
+from mxnet_tpu.passes.manager import PassContext
+from mxnet_tpu.telemetry import instruments as ti
+
+
+def _conv_stack(seed=0, channels=(8, 16, 16), in_channels=4, bn=True,
+                act=True, pool=True):
+    mx.seed(seed)
+    net = nn.HybridSequential()
+    c_in = in_channels
+    for c in channels:
+        net.add(nn.Conv2D(c, 3, padding=1, in_channels=c_in,
+                          use_bias=False))
+        if bn:
+            net.add(nn.BatchNorm(in_channels=c))
+        if act:
+            net.add(nn.Activation("relu"))
+        c_in = c
+    if pool:
+        net.add(nn.MaxPool2D(2))
+    net.hybridize()
+    net.initialize()
+    rs = onp.random.RandomState(seed + 1)
+    for p in net.collect_params().values():
+        if p.name == "weight" and len(p.shape) == 4:  # conv kernels only
+            p.set_data(mx.np.array(
+                (rs.standard_normal(p.shape) * 0.1).astype("float32")))
+    return net
+
+
+def _x(shape=(2, 4, 8, 8), seed=0):
+    return mx.np.array(
+        onp.random.RandomState(seed).standard_normal(shape)
+        .astype("float32"))
+
+
+def _pure(net):
+    from mxnet_tpu.ndarray.ndarray import NDArray
+
+    def fn(xj):
+        return net(NDArray(xj))._data
+
+    return fn
+
+
+def _n_transpose(closed):
+    return sum(1 for e in closed.jaxpr.eqns
+               if e.primitive.name == "transpose")
+
+
+def _trace_count(block):
+    return sum(c.value for labels, c in ti.jit_trace_total.series()
+               if labels[0] == block)
+
+
+# -- mode resolution + env registration --------------------------------------
+
+def test_mode_normalization(monkeypatch):
+    for raw, want in [("", "off"), ("0", "off"), ("off", "off"),
+                      ("no", "off"), ("false", "off"), ("none", "off"),
+                      ("1", "auto"), ("auto", "auto"), ("on", "auto"),
+                      ("true", "auto"), ("yes", "auto"),
+                      ("nhwc", "nhwc"), ("force", "nhwc"),
+                      ("NHWC", "nhwc"), ("Always", "nhwc")]:
+        monkeypatch.setenv("MXTPU_LAYOUT", raw)
+        assert playout.mode() == want, raw
+    monkeypatch.delenv("MXTPU_LAYOUT")
+    assert playout.mode() == "off"  # default
+
+
+def test_invalid_mode_raises(monkeypatch):
+    monkeypatch.setenv("MXTPU_LAYOUT", "bogus")
+    with pytest.raises(ValueError):
+        playout.mode()
+
+
+def test_env_vars_registered_and_documented():
+    import os
+
+    for name in ("MXTPU_LAYOUT", "MXTPU_LAYOUT_MIN_BYTES"):
+        assert name in env.all_vars()
+        assert f"`{name}`" in env.doc()
+    doc_path = os.path.join(os.path.dirname(__file__), "..", "docs",
+                            "env_vars.md")
+    text = open(doc_path).read()
+    for name in ("MXTPU_LAYOUT", "MXTPU_LAYOUT_MIN_BYTES"):
+        assert f"`{name}`" in text  # docs regenerated from the registry
+
+
+def test_weight_perm():
+    assert playout.weight_perm(2) == (2, 3, 1, 0)
+    assert playout.weight_perm(1) == (2, 1, 0)
+    assert playout.weight_perm(3) == (2, 3, 4, 1, 0)
+
+
+# -- kill switch -------------------------------------------------------------
+
+def test_off_is_bitwise_identity_zero_extra_traces(monkeypatch):
+    monkeypatch.delenv("MXTPU_LAYOUT", raising=False)
+    net_a = _conv_stack(seed=3)
+    x = _x(seed=3)
+    before = _trace_count("HybridSequential")
+    y_a = net_a(x)
+    traces_default = _trace_count("HybridSequential") - before
+
+    monkeypatch.setenv("MXTPU_LAYOUT", "off")
+    net_b = _conv_stack(seed=3)
+    before = _trace_count("HybridSequential")
+    y_b = net_b(x)
+    traces_off = _trace_count("HybridSequential") - before
+
+    assert onp.array_equal(y_a.asnumpy(), y_b.asnumpy())
+    assert traces_off == traces_default  # zero extra traces
+    assert getattr(net_b[0].weight, "_layout_perm", None) is None
+
+
+def test_off_pass_returns_input_unchanged():
+    net = _conv_stack(seed=4)
+    closed, _ = passes.trace_closed(_pure(net), (jnp.zeros((2, 4, 8, 8), jnp.float32),))
+    ctx = PassContext(kind="block")
+    out = LayoutPass("off").run(closed, ctx)
+    assert out is closed
+    assert ctx.notes["layout"]["decision"] == "off"
+
+
+# -- rewrite + elision -------------------------------------------------------
+
+def test_nhwc_rewrite_elides_transposes():
+    """The whole-graph rewrite must beat the naive per-conv conjugation
+    (3 transposes per conv) on a conv/BN/relu stack."""
+    net = _conv_stack(seed=5, channels=(8, 16, 16))
+    closed, _ = passes.trace_closed(_pure(net), (jnp.zeros((2, 4, 8, 8), jnp.float32),))
+    ctx = PassContext(kind="block")
+    out = LayoutPass("nhwc").run(closed, ctx)
+    notes = ctx.notes["layout"]
+    assert notes["decision"] == "rewritten"
+    assert notes["convs_rewritten"] == 3
+    naive = 3 * notes["convs_rewritten"]
+    assert _n_transpose(out) < naive
+    assert notes["transposes_inserted"] < naive
+    assert notes["transposes_elided"] > 0
+    # every conv is NHWC/HWIO now: spec = (batch, feature, *spatial)
+    # positions, so channels-last means feature dim == rank-1
+    for e in out.jaxpr.eqns:
+        if e.primitive.name != "conv_general_dilated":
+            continue
+        dn = e.params["dimension_numbers"]
+        rank = len(dn.lhs_spec)
+        nhwc = (0, rank - 1) + tuple(range(1, rank - 1))
+        assert tuple(dn.lhs_spec) == nhwc
+        assert tuple(dn.out_spec) == nhwc
+
+
+def test_nhwc_rewrite_forward_parity():
+    net = _conv_stack(seed=6)
+    xs = jnp.asarray(
+        onp.random.RandomState(9).standard_normal((2, 4, 8, 8))
+        .astype("float32"))
+    closed, _ = passes.trace_closed(_pure(net), (xs,))
+    out = LayoutPass("nhwc").run(closed, PassContext(kind="block"))
+    y0 = jax.core.eval_jaxpr(closed.jaxpr, closed.consts, xs)[0]
+    y1 = jax.core.eval_jaxpr(out.jaxpr, out.consts, xs)[0]
+    onp.testing.assert_allclose(onp.asarray(y0), onp.asarray(y1),
+                                atol=1e-5, rtol=1e-5)
+
+
+def test_nhwc_rewrite_grad_parity():
+    net = _conv_stack(seed=7, pool=False)
+    xs = jnp.asarray(
+        onp.random.RandomState(10).standard_normal((2, 4, 8, 8))
+        .astype("float32"))
+    closed, _ = passes.trace_closed(_pure(net), (xs,))
+    out = LayoutPass("nhwc").run(closed, PassContext(kind="block"))
+
+    def loss(c):
+        def f(xj):
+            return jnp.sum(
+                jax.core.eval_jaxpr(c.jaxpr, c.consts, xj)[0] ** 2)
+        return f
+
+    g0 = jax.grad(loss(closed))(xs)
+    g1 = jax.grad(loss(out))(xs)
+    onp.testing.assert_allclose(onp.asarray(g0), onp.asarray(g1),
+                                atol=1e-4, rtol=1e-4)
+
+
+def test_already_channels_last_untouched():
+    mx.seed(11)
+    net = nn.HybridSequential()
+    net.add(nn.Conv2D(8, 3, padding=1, in_channels=4, layout="NHWC"))
+    net.hybridize()
+    net.initialize()
+    closed, _ = passes.trace_closed(_pure(net), (jnp.zeros((2, 8, 8, 4), jnp.float32),))
+    ctx = PassContext(kind="block")
+    out = LayoutPass("nhwc").run(closed, ctx)
+    assert out is closed
+    assert ctx.notes["layout"]["decision"] == "no_cf_convs"
+
+
+def test_whole_step_seam_is_audit_only():
+    net = _conv_stack(seed=12)
+    closed, _ = passes.trace_closed(_pure(net), (jnp.zeros((2, 4, 8, 8), jnp.float32),))
+    ctx = PassContext(kind="whole_step")
+    out = LayoutPass("nhwc").run(closed, ctx)
+    assert out is closed
+    assert ctx.notes["layout"]["decision"] == "audit_only"
+
+
+# -- auto scoring ------------------------------------------------------------
+
+def test_auto_declines_small_activations(monkeypatch):
+    net = _conv_stack(seed=13)
+    closed, _ = passes.trace_closed(_pure(net), (jnp.zeros((2, 4, 8, 8), jnp.float32),))
+    monkeypatch.setenv("MXTPU_LAYOUT_MIN_BYTES", str(1 << 30))
+    ctx = PassContext(kind="block")
+    out = LayoutPass("auto").run(closed, ctx)
+    assert out is closed
+    assert ctx.notes["layout"]["decision"] == "too_small"
+
+
+def test_auto_accepts_large_activations(monkeypatch):
+    net = _conv_stack(seed=14)
+    closed, _ = passes.trace_closed(_pure(net), (jnp.zeros((2, 4, 8, 8), jnp.float32),))
+    monkeypatch.setenv("MXTPU_LAYOUT_MIN_BYTES", "1")
+    ctx = PassContext(kind="block")
+    out = LayoutPass("auto").run(closed, ctx)
+    assert ctx.notes["layout"]["decision"] in (
+        "rewritten", "declined_no_savings")
+    if ctx.notes["layout"]["decision"] == "rewritten":
+        assert out is not closed
+
+
+# -- persistent weight re-layout ---------------------------------------------
+
+def test_persistent_relayout_shapes(monkeypatch):
+    monkeypatch.setenv("MXTPU_LAYOUT", "nhwc")
+    net = _conv_stack(seed=15)
+    x = _x(seed=15)
+    net(x)
+    w = net[0].weight
+    assert w._layout_perm == (2, 3, 1, 0)
+    assert tuple(w.shape) == (8, 4, 3, 3)  # logical stays OIHW
+    phys = next(iter(w._data_map.values()))._data.shape
+    assert tuple(phys) == (3, 3, 4, 8)  # physical is HWIO
+    assert tuple(w.logical_data().shape) == (8, 4, 3, 3)
+
+
+def test_relayout_forward_matches_off(monkeypatch):
+    x = _x(seed=16)
+    monkeypatch.setenv("MXTPU_LAYOUT", "off")
+    y_off = _conv_stack(seed=16)(x).asnumpy()
+    monkeypatch.setenv("MXTPU_LAYOUT", "nhwc")
+    y_nhwc = _conv_stack(seed=16)(x).asnumpy()
+    onp.testing.assert_allclose(y_off, y_nhwc, atol=1e-5, rtol=1e-5)
+
+
+def test_checkpoint_roundtrip_stays_logical(monkeypatch, tmp_path):
+    """An NHWC-trained net saves NCHW-logical parameters that an
+    off-mode net loads bitwise — and vice versa (NCHW-era snapshots
+    load into a re-laid-out net)."""
+    x = _x(seed=17)
+    monkeypatch.setenv("MXTPU_LAYOUT", "nhwc")
+    net_a = _conv_stack(seed=17)
+    net_a(x)
+    assert net_a[0].weight._layout_perm is not None
+    f = str(tmp_path / "params")
+    net_a.save_parameters(f)
+
+    monkeypatch.setenv("MXTPU_LAYOUT", "off")
+    net_b = _conv_stack(seed=18)
+    net_b.load_parameters(f)
+    onp.testing.assert_allclose(net_b(x).asnumpy(), net_a(x).asnumpy(),
+                                atol=1e-5, rtol=1e-5)
+
+    # NCHW-era snapshot -> NHWC net
+    f2 = str(tmp_path / "params_nchw")
+    net_b.save_parameters(f2)
+    monkeypatch.setenv("MXTPU_LAYOUT", "nhwc")
+    net_c = _conv_stack(seed=19)
+    net_c(x)  # build + relayout first, then restore over it
+    net_c.load_parameters(f2)
+    onp.testing.assert_allclose(net_c(x).asnumpy(), net_b(x).asnumpy(),
+                                atol=1e-5, rtol=1e-5)
+
+
+def test_snapshot_arrays_are_logical(monkeypatch):
+    from mxnet_tpu.checkpoint import snapshot
+
+    monkeypatch.setenv("MXTPU_LAYOUT", "nhwc")
+    net = _conv_stack(seed=20, channels=(8,), bn=False, act=False,
+                      pool=False)
+    trainer = gluon.Trainer(net.collect_params(), "sgd",
+                            {"learning_rate": 0.1, "momentum": 0.9})
+    step = gluon.TrainStep(net, lambda y, t: ((y - t) ** 2).mean(),
+                           trainer)
+    x = _x(seed=20)
+    t = mx.np.zeros((2, 8, 8, 8))
+    step(x, t)
+    step(x, t)
+    arrays, meta = snapshot.capture(trainer)
+    i = [j for j, p in enumerate(trainer._params)
+         if p is net[0].weight][0]
+    assert tuple(arrays[f"param/{i}"].shape) == (8, 4, 3, 3)  # logical
+    assert meta["layout_perms"][i] == [2, 3, 1, 0]
+    # momentum rides along de-permuted to logical too
+    spec = meta["state_specs"][i]
+    leaves = [spec] if isinstance(spec, str) else list(spec)
+    for key in leaves:
+        if isinstance(key, str) and arrays[key].ndim == 4:
+            assert tuple(arrays[key].shape) == (8, 4, 3, 3)
+    # and the round trip restores bitwise
+    w0 = net[0].weight.logical_data().asnumpy().copy()
+    net[0].weight.set_data(mx.np.zeros(net[0].weight.shape))
+    snapshot.apply(trainer, arrays, meta)
+    assert onp.array_equal(net[0].weight.logical_data().asnumpy(), w0)
+
+
+# -- composition -------------------------------------------------------------
+
+def test_whole_step_training_matches_off(monkeypatch):
+    def run(mode):
+        monkeypatch.setenv("MXTPU_LAYOUT", mode)
+        net = _conv_stack(seed=21, channels=(8, 8), pool=False)
+        trainer = gluon.Trainer(net.collect_params(), "sgd",
+                                {"learning_rate": 0.05, "momentum": 0.9})
+        step = gluon.TrainStep(net, lambda y, t: ((y - t) ** 2).mean(),
+                               trainer)
+        losses = []
+        for i in range(4):
+            x = _x(seed=100 + i)
+            t = mx.np.array(onp.random.RandomState(200 + i)
+                            .standard_normal((2, 8, 8, 8))
+                            .astype("float32"))
+            losses.append(float(step(x, t).asnumpy()))
+        return losses, step.last_path
+
+    l_off, path_off = run("off")
+    l_nhwc, path_nhwc = run("nhwc")
+    assert path_off == path_nhwc == "whole_step"
+    onp.testing.assert_allclose(l_off, l_nhwc, atol=1e-5, rtol=1e-5)
+
+
+def test_channels_first_dispatch_outcome():
+    """kernels/norm._supported singles out layout-blocked sites: a
+    tensor that qualifies in every way except channel position records
+    channels_first, not unsupported_shape."""
+    x_nchw = jnp.zeros((8, 128, 4, 4), jnp.float32)
+    x_nhwc = jnp.zeros((8, 4, 4, 128), jnp.float32)
+    assert knorm._supported(x_nchw, 1) == "channels_first"
+    assert knorm._supported(x_nhwc, 3) is None
+    # genuinely unkernelable stays unsupported_shape
+    assert knorm._supported(jnp.zeros((8, 100, 4, 4)), 1) \
+        == "unsupported_shape"
+    assert knorm._supported(jnp.zeros((8, 100)), 1) == "unsupported_shape"
+
+
+def test_channels_first_outcome_recorded(monkeypatch):
+    """An NCHW BN site under MXTPU_KERNELS=force records the
+    channels_first fallback outcome through the dispatcher."""
+    monkeypatch.setenv("MXTPU_KERNELS", "force")
+    was = telemetry.enabled()
+    telemetry.enable()
+    try:
+        def count():
+            return sum(
+                c.value for labels, c in
+                ti.kernel_dispatch_total.series()
+                if labels == ("bn_fwd", "channels_first"))
+
+        before = count()
+        x = jnp.asarray(
+            onp.random.RandomState(0).standard_normal((4, 128, 4, 4)),
+            jnp.float32)
+        gamma = jnp.ones((128,), jnp.float32)
+        beta = jnp.zeros((128,), jnp.float32)
+        shift = jnp.zeros((128,), jnp.float32)
+        out, mean, var = knorm.bn_train(x, gamma, beta, shift, 1e-5, 1)
+        out.block_until_ready()
+        assert count() > before
+    finally:
+        if not was:
+            telemetry.disable()
+
+
+def test_kernel_dispatch_help_mentions_channels_first():
+    assert "channels_first" in ti.kernel_dispatch_total.documentation
+
+
+# -- telemetry ---------------------------------------------------------------
+
+def test_layout_counters_increment():
+    was = telemetry.enabled()
+    telemetry.enable()
+    try:
+        r0 = ti.layout_rewrite_total.value
+        i0 = sum(c.value for labels, c in
+                 ti.layout_transpose_total.series()
+                 if labels[0] == "inserted")
+        e0 = sum(c.value for labels, c in
+                 ti.layout_transpose_total.series()
+                 if labels[0] == "elided")
+        net = _conv_stack(seed=22)
+        closed, _ = passes.trace_closed(
+            _pure(net), (jnp.zeros((2, 4, 8, 8), jnp.float32),))
+        LayoutPass("nhwc").run(closed, PassContext(kind="block"))
+        assert ti.layout_rewrite_total.value > r0
+        assert sum(c.value for labels, c in
+                   ti.layout_transpose_total.series()
+                   if labels[0] == "inserted") > i0
+        assert sum(c.value for labels, c in
+                   ti.layout_transpose_total.series()
+                   if labels[0] == "elided") > e0
+    finally:
+        if not was:
+            telemetry.disable()
+
+
+def test_diagnose_passes_report_has_layout_section():
+    import tools.diagnose as dg
+
+    pr = dg._passes_report()
+    assert "layout" in pr
+    assert "MXTPU_LAYOUT" in pr["layout"]["config"]
+    lines = dg._passes_report_lines(pr)
+    assert any("layout:" in ln for ln in lines)
