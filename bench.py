@@ -768,6 +768,88 @@ def bench_flightrec_record_ms(records=1000):
     return dt * 1000.0
 
 
+def bench_opsd_overhead(platform, iters, warmup):
+    """Whole-step latency with the live ops server up AND a 10 Hz
+    /metrics scraper attached, vs no server at all (the MXTPU_OPS_PORT
+    unset baseline). Returns (opsd_ms, off_ms, scrape_ms): the A/B
+    proves a polled ops plane doesn't tax the donated training path
+    (GETs only read snapshots), and scrape_ms is the cost of one full
+    /metrics round-trip on a warm registry (docs/observability.md)."""
+    import threading
+    import time as _time
+    import urllib.request
+
+    import numpy as onp
+
+    import mxnet_tpu as mx
+    from mxnet_tpu import gluon
+    from mxnet_tpu.gluon import nn
+    from mxnet_tpu.observability import opsd
+    from mxnet_tpu.telemetry import promparse
+
+    batch = 32 if platform == "cpu" else 128
+    feats, classes = (128, 10) if platform == "cpu" else (512, 100)
+    rs = onp.random.RandomState(0)
+    x = mx.np.array(rs.rand(batch, feats).astype("f"))
+    y = mx.np.array(rs.randint(0, classes, (batch,)))
+    lossfn = gluon.loss.SoftmaxCrossEntropyLoss()
+
+    def run(with_server):
+        mx.seed(0)
+        net = nn.HybridSequential()
+        net.add(nn.Dense(256, activation="relu"), nn.Dense(256),
+                nn.Dense(classes))
+        net.initialize()
+        net.hybridize()
+        trainer = gluon.Trainer(net.collect_params(), "sgd",
+                                {"learning_rate": 0.05})
+        step = gluon.TrainStep(net, lossfn, trainer)
+        srv = scraper = None
+        stop = threading.Event()
+        if with_server:
+            srv = opsd.OpsServer(port=0).start()
+
+            def poll():  # the 10 Hz supervisor this bench models
+                while not stop.is_set():
+                    with urllib.request.urlopen(srv.url + "/metrics",
+                                                timeout=5) as r:
+                        promparse.parse_text(r.read().decode())
+                    stop.wait(0.1)
+
+            scraper = threading.Thread(target=poll, daemon=True)
+            scraper.start()
+        try:
+            dt, _ = _timeit(lambda: step(x, y),
+                            lambda l: float(l.sum().asnumpy()),
+                            iters, warmup)
+            if step.last_path != "whole_step":
+                raise RuntimeError("opsd bench fell back to phased")
+            return dt / iters * 1000.0
+        finally:
+            stop.set()
+            if scraper is not None:
+                scraper.join(timeout=10)
+            if srv is not None:
+                srv.stop()
+
+    off_ms = run(False)
+    opsd_ms = run(True)
+
+    # one /metrics GET on the registry the A/B just populated
+    srv = opsd.OpsServer(port=0).start()
+    try:
+        n = 20
+        t0 = _time.perf_counter()
+        for _ in range(n):
+            with urllib.request.urlopen(srv.url + "/metrics",
+                                        timeout=5) as r:
+                r.read()
+        scrape_ms = (_time.perf_counter() - t0) / n * 1000.0
+    finally:
+        srv.stop()
+    return opsd_ms, off_ms, scrape_ms
+
+
 def bench_ckpt_save_ms(platform, saves=3):
     """Milliseconds per committed checkpoint of ResNet-50-sized training
     state (161 param tensors + SGD-momentum state, ~205 MB of f32)
@@ -1141,6 +1223,29 @@ def main():
                     "ring (steady state; docs/observability.md)"})
     except Exception as e:
         rows.append({"metric": "flightrec_record_ms", "error": str(e)})
+
+    # live ops server: whole-step A/B (server + 10 Hz scraper vs no
+    # server) + one-scrape cost; both _ms rows → lower-is-better gate
+    try:
+        if over_budget():
+            raise TimeoutError("bench budget exhausted")
+        od_iters = iters if platform != "cpu" else 5
+        od_ms, od_off_ms, od_scrape_ms = bench_opsd_overhead(
+            platform, od_iters, warmup)
+        rows.append({
+            "metric": "train_step_ms_opsd" + suffix,
+            "value": round(od_ms, 3), "unit": "ms",
+            "note": f"whole-step latency with the ops server up + a "
+                    f"10 Hz /metrics scraper; vs no server: "
+                    f"{od_ms / od_off_ms:.4f}x (off={od_off_ms:.3f}ms; "
+                    f"docs/observability.md)"})
+        rows.append({
+            "metric": "opsd_scrape_ms" + suffix,
+            "value": round(od_scrape_ms, 3), "unit": "ms",
+            "note": "one GET /metrics round-trip (serialize the full "
+                    "registry to Prometheus text) on a warm registry"})
+    except Exception as e:
+        rows.append({"metric": "train_step_ms_opsd", "error": str(e)})
 
     # serving-engine QPS runs on every platform (cheap MLP — the row
     # measures the batching/dispatch path, which exists on CPU too)

@@ -40,7 +40,7 @@ import time
 import traceback
 
 __all__ = ["guard", "enabled", "configure", "dump_now", "last_dump",
-           "reset"]
+           "reset", "fire_count", "stalled_sites"]
 
 _overrides = {}  # programmatic configure() beats the environment
 _lock = threading.Lock()
@@ -105,6 +105,21 @@ def reset():
 def last_dump():
     """The most recent dump text (None if the watchdog never fired)."""
     return _last_dump[0]
+
+
+def fire_count():
+    """How many times a guard deadline (or dump_now) has fired."""
+    return _dump_count[0]
+
+
+def stalled_sites():
+    """Sites of guards that fired and are STILL open — an ongoing stall.
+    The ops server's /readyz keys on this: a rank goes not-ready while a
+    collective/waitall is past deadline and comes back once the guard
+    exits (the stall resolved), which is exactly the load-balancer
+    semantic — don't route to a wedged rank, resume when it recovers."""
+    with _lock:
+        return sorted({g["site"] for g in _guards.values() if g["fired"]})
 
 
 @contextlib.contextmanager

@@ -340,6 +340,26 @@ register(
     "this — relayout bookkeeping swamps any bandwidth win on tiny "
     "graphs (passes/layout.py).")
 register(
+    "MXTPU_OPS_PORT", int, 0,
+    "Live ops server (observability.opsd; docs/observability.md): start "
+    "a per-process stdlib HTTP server on this port at import, serving "
+    "GET /metrics (Prometheus), /healthz, /readyz, /flight, /steps, "
+    "/identity and POST /postmortem, /profile?ms=N. 0 (default) creates "
+    "no thread or socket. Port 0 is reserved for programmatic "
+    "opsd.start(port=0) ephemeral binds (tests).")
+register(
+    "MXTPU_OPS_HOST", str, "127.0.0.1",
+    "Bind address for the live ops server. Loopback by default; set "
+    "0.0.0.0 when a fleet supervisor (tools/fleetctl.py) or Prometheus "
+    "scrapes ranks across hosts.")
+register(
+    "MXTPU_OPS_TOKEN", str, "",
+    "Optional bearer token for the ops server's mutating POST endpoints "
+    "(/postmortem, /profile): when set, requests must carry "
+    "'Authorization: Bearer <token>' or get 401. GET endpoints stay "
+    "open — they serve the same read-only snapshots a postmortem "
+    "bundle contains.")
+register(
     "MXTPU_BN_COMPUTE", str, "f32",
     "Element-wise dtype of the O(N·H·W·C) BatchNorm tensors (ops/nn.py "
     "_bn_ew_dtype; the r5 audit's top falsifiable prediction): 'f32' "

@@ -76,10 +76,15 @@ class TPUDist(KVStoreBase):
         try:
             # stamp (job, rank) into flight events + span records so
             # tools/blackbox.py can align this rank's postmortem bundle
-            # with its peers on the shared (job_id, step) trace ID
+            # with its peers on the shared (job_id, step) trace ID, and
+            # so the ops server's /identity endpoint answers with this
+            # rank's place in the job (tools/fleetctl.py keys its fleet
+            # table on it)
             from ..observability import flight as _flight
 
             _flight.set_identity(rank=self.rank, world=self.num_workers)
+            _flight.record("dist_init", rank=self.rank,
+                           world=self.num_workers)
         except Exception:
             pass
         if self.num_workers > 1:

@@ -26,7 +26,7 @@ from __future__ import annotations
 
 import os
 
-from . import flight, numerics, postmortem  # noqa: F401
+from . import flight, numerics, opsd, postmortem  # noqa: F401
 from .flight import (  # noqa: F401
     events, record, record_loss, set_identity, trace_id,
 )
@@ -34,7 +34,7 @@ from .numerics import NonFiniteError  # noqa: F401
 from .postmortem import dump, install_crash_hooks  # noqa: F401
 
 __all__ = [
-    "flight", "numerics", "postmortem",
+    "flight", "numerics", "opsd", "postmortem",
     "record", "record_event", "record_loss", "events",
     "set_identity", "trace_id",
     "dump", "install_crash_hooks", "reset",
@@ -53,3 +53,7 @@ def reset():
 if os.environ.get("MXTPU_FLIGHTREC_CRASHDUMP", "").lower() \
         not in ("", "0", "false", "off"):
     install_crash_hooks()
+
+# MXTPU_OPS_PORT=<port> starts the live ops server at import (the
+# per-process HTTP plane supervisors poll); unset/0 touches nothing.
+opsd.start_from_env()

@@ -452,6 +452,20 @@ class InferenceEngine:
                 return round(float(bound) * 1e3, 3)
         return None
 
+    def admission_state(self):
+        """What a submit() would meet right now: ``"ok"`` (admitted),
+        ``"overloaded"`` (queue at bound — the next submit sheds with
+        :class:`Overloaded`), or ``"stopped"``. The ops server's
+        ``/readyz`` reports not-ready unless every registered engine is
+        ``"ok"`` — a front door stops routing to a shedding replica and
+        resumes once its queue drains."""
+        with self._cond:
+            if self._stopping:
+                return "stopped"
+            if len(self._queue) >= self.max_queue:
+                return "overloaded"
+        return "ok"
+
     def stats(self):
         """Live snapshot: queue/in-flight, outcome counters, batch shape,
         latency p50/p99, and the zero-recompile invariant."""

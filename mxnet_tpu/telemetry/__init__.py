@@ -48,6 +48,7 @@ from .registry import (  # noqa: F401
 )
 from .exporters import dump, prometheus_text, write_prometheus  # noqa: F401
 from .chrome import emit_chrome_counters  # noqa: F401
+from . import promparse  # noqa: F401
 from . import instruments  # noqa: F401
 from .instruments import (  # noqa: F401
     nbytes_of,
@@ -69,7 +70,7 @@ __all__ = [
     "counter", "gauge", "histogram",
     "enable", "disable", "enabled", "reset",
     "dump", "prometheus_text", "write_prometheus", "emit_chrome_counters",
-    "instruments",
+    "instruments", "promparse",
     "nbytes_of", "observe_step", "record_collective", "record_compile",
     "record_fallback", "record_serve_batch", "record_serve_request",
     "record_sync", "record_trace", "record_transfer", "set_flop_budget",

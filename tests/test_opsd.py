@@ -1,0 +1,450 @@
+"""Live ops server (ISSUE-13): per-rank HTTP metrics/health/profile
+plane (observability/opsd.py).
+
+The acceptance spine: with MXTPU_OPS_PORT unset nothing is created and
+training is untouched; with a server up, concurrent /metrics scrapes
+during donated whole-step training stay valid Prometheus text on every
+poll with zero retraces, /readyz flips on watchdog fire and serving
+overload and flips BACK on recovery, POST endpoints honor the bearer
+token, and the server survives os.fork (child drops it) and interpreter
+exit (clean shutdown).
+"""
+import json
+import os
+import subprocess
+import sys
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import numpy as onp
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import observability, serving
+from mxnet_tpu.diagnostics import watchdog
+from mxnet_tpu.gluon import Trainer, TrainStep, nn
+from mxnet_tpu.observability import flight, opsd
+from mxnet_tpu.telemetry import promparse
+
+REPO = os.path.join(os.path.dirname(__file__), "..")
+
+
+@pytest.fixture(autouse=True)
+def fresh(tmp_path, monkeypatch):
+    monkeypatch.setenv("MXTPU_FLIGHTREC_DIR", str(tmp_path))
+    monkeypatch.delenv("MXTPU_OPS_TOKEN", raising=False)
+    observability.reset()
+    yield
+    observability.reset()
+
+
+@pytest.fixture()
+def srv():
+    s = opsd.OpsServer(port=0).start()
+    yield s
+    s.stop()
+
+
+def _get(base, path, timeout=5):
+    """(status, headers, parsed-or-text); 4xx/5xx return, not raise."""
+    try:
+        with urllib.request.urlopen(base + path, timeout=timeout) as r:
+            body = r.read().decode()
+            return r.status, dict(r.headers), body
+    except urllib.error.HTTPError as e:
+        return e.code, dict(e.headers), e.read().decode()
+
+
+def _post(base, path, token=None, timeout=15):
+    req = urllib.request.Request(base + path, data=b"", method="POST")
+    if token:
+        req.add_header("Authorization", f"Bearer {token}")
+    try:
+        with urllib.request.urlopen(req, timeout=timeout) as r:
+            return r.status, json.loads(r.read().decode())
+    except urllib.error.HTTPError as e:
+        return e.code, json.loads(e.read().decode())
+
+
+def _step_fixture():
+    net = nn.HybridSequential()
+    net.add(nn.Dense(16, activation="relu"), nn.Dense(4))
+    net.initialize()
+    net.hybridize()
+    trainer = Trainer(net.collect_params(), "sgd", {"learning_rate": 0.05})
+    step = TrainStep(net, lambda out, y: ((out - y) ** 2).mean(), trainer)
+    rs = onp.random.RandomState(0)
+    x = mx.np.array(rs.rand(8, 12).astype("f"))
+    y = mx.np.array(rs.rand(8, 4).astype("f"))
+    return step, x, y
+
+
+# -- opt-in: unset means untouched ------------------------------------------
+
+def test_env_unset_creates_nothing(monkeypatch):
+    monkeypatch.delenv("MXTPU_OPS_PORT", raising=False)
+    assert opsd.start_from_env() is None
+    assert opsd.server() is None
+    assert not any(t.name == "mxtpu-opsd" for t in threading.enumerate())
+
+
+def test_env_zero_or_garbage_creates_nothing(monkeypatch):
+    for raw in ("0", "", "notaport", "-5"):
+        monkeypatch.setenv("MXTPU_OPS_PORT", raw)
+        assert opsd.start_from_env() is None
+    assert opsd.server() is None
+
+
+def test_training_identical_with_server_up(srv):
+    """Same seed, same inputs: a running (and scraped) server changes
+    no training math and adds no traces."""
+    mx.seed(0)
+    step, x, y = _step_fixture()
+    baseline = [float(step(x, y).asnumpy()) for _ in range(3)]
+    traces = step.jit_trace_count()
+    mx.seed(0)
+    step2, x2, y2 = _step_fixture()
+    _get(srv.url, "/metrics")
+    got = []
+    for _ in range(3):
+        got.append(float(step2(x2, y2).asnumpy()))
+        _get(srv.url, "/metrics")
+    assert got == baseline
+    assert step2.jit_trace_count() == traces
+
+
+# -- endpoints --------------------------------------------------------------
+
+def test_metrics_endpoint_is_conformant_prometheus(srv):
+    step, x, y = _step_fixture()
+    step(x, y)
+    code, headers, body = _get(srv.url, "/metrics")
+    assert code == 200
+    assert headers["Content-Type"] == promparse.CONTENT_TYPE
+    fams = promparse.parse_text(body)  # raises on any malformed line
+    assert promparse.sample_value(fams, "step_total") >= 1
+    assert fams["step_time_seconds"]["type"] == "histogram"
+
+
+def test_healthz_and_identity(srv):
+    code, _, body = _get(srv.url, "/healthz")
+    hz = json.loads(body)
+    assert code == 200 and hz["status"] == "ok"
+    assert hz["pid"] == os.getpid()
+
+    flight.set_identity(rank=3, world=8, job="jobZ")
+    try:
+        code, _, body = _get(srv.url, "/identity")
+        ident = json.loads(body)
+        assert code == 200
+        assert (ident["job"], ident["rank"], ident["world"]) == \
+            ("jobZ", 3, 8)
+        assert ident["port"] == srv.port
+    finally:
+        flight._identity.clear()
+
+
+def test_steps_endpoint_reflects_training(srv):
+    step, x, y = _step_fixture()
+    for _ in range(3):
+        step(x, y)
+    code, _, body = _get(srv.url, "/steps")
+    st = json.loads(body)
+    assert code == 200
+    assert st["last_step"] >= 3
+    assert st["steps_observed"] >= 3
+    assert st["step_time_ms_avg"] > 0
+    assert st["step_table"]  # phase rows landed
+    assert st["step_dispatches"].get("whole_step", 0) >= 3
+
+
+def test_flight_endpoint_tail_and_limit(srv):
+    for i in range(30):
+        flight.record("tick", i=i)
+    code, _, body = _get(srv.url, "/flight?n=5")
+    fl = json.loads(body)
+    assert code == 200
+    ticks = [e for e in fl["events"] if e["kind"] == "tick"]
+    assert len(fl["events"]) == 5
+    assert ticks and ticks[-1]["i"] == 29  # newest end of the ring
+    assert fl["total"] >= 30
+    assert fl["capacity"] == flight.capacity()
+
+
+def test_unknown_endpoint_404(srv):
+    code, _, body = _get(srv.url, "/nope")
+    assert code == 404 and "no endpoint" in body
+
+
+# -- concurrent scrape under donated whole-step training --------------------
+
+def test_concurrent_scrapes_during_whole_step_training(srv):
+    """A 10 Hz-ish scraper hammering /metrics + /readyz + /steps during
+    a 20-step donated whole-step run: every poll returns conformant
+    text, the run stays on the whole-step path with zero extra
+    retraces, and nothing deadlocks (the GET side takes no jax locks)."""
+    step, x, y = _step_fixture()
+    step(x, y)  # compile outside the timed/concurrency window
+    assert step.last_path == "whole_step"
+    warm = step.jit_trace_count()
+
+    stop = threading.Event()
+    polls, errors = [], []
+
+    def scraper():
+        while not stop.is_set():
+            code, headers, body = _get(srv.url, "/metrics")
+            try:
+                assert code == 200
+                assert headers["Content-Type"] == promparse.CONTENT_TYPE
+                promparse.parse_text(body)
+                c2, _, _ = _get(srv.url, "/readyz")
+                assert c2 in (200, 503)
+                c3, _, _ = _get(srv.url, "/steps")
+                assert c3 == 200
+            except Exception as e:  # noqa: BLE001 — collected for report
+                errors.append(repr(e))
+                return
+            polls.append(code)
+            time.sleep(0.01)
+
+    threads = [threading.Thread(target=scraper, daemon=True)
+               for _ in range(2)]
+    for t in threads:
+        t.start()
+    try:
+        for _ in range(20):
+            step(x, y)
+    finally:
+        stop.set()
+        for t in threads:
+            t.join(timeout=10)
+    assert not errors, errors
+    assert polls, "scrapers never completed a poll"
+    assert step.last_path == "whole_step"
+    assert step.jit_trace_count() == warm  # zero retraces
+
+
+# -- readiness transitions --------------------------------------------------
+
+def test_readyz_flips_on_watchdog_fire_and_recovers(srv):
+    code, _, _ = _get(srv.url, "/readyz")
+    assert code == 200
+    watchdog.configure(MXTPU_WATCHDOG=1, MXTPU_WATCHDOG_TIMEOUT_S=0.05,
+                       MXTPU_WATCHDOG_FILE=os.devnull)
+    release = threading.Event()
+
+    def stall():
+        with watchdog.guard("opsd-test-stall"):
+            release.wait(10)
+
+    t = threading.Thread(target=stall, daemon=True)
+    t.start()
+    try:
+        deadline = time.monotonic() + 10
+        code, body = None, None
+        while time.monotonic() < deadline:
+            code, _, body = _get(srv.url, "/readyz")
+            if code == 503:
+                break
+            time.sleep(0.02)
+        assert code == 503, "readyz never went not-ready on a stall"
+        rz = json.loads(body)
+        assert not rz["ready"]
+        assert "opsd-test-stall" in \
+            rz["checks"]["watchdog"]["stalled_sites"]
+    finally:
+        release.set()
+        t.join(timeout=10)
+        watchdog.configure(MXTPU_WATCHDOG=None,
+                           MXTPU_WATCHDOG_TIMEOUT_S=None,
+                           MXTPU_WATCHDOG_FILE=None)
+    # guard exited -> the stall resolved -> ready again
+    code, _, body = _get(srv.url, "/readyz")
+    assert code == 200 and json.loads(body)["ready"]
+    watchdog.reset()
+
+
+def test_readyz_flips_on_serving_overload_and_drain(srv):
+    net = nn.Dense(4)
+    net.initialize()
+    net.hybridize()
+    eng = serving.InferenceEngine(net, name="opsd-rz", max_batch_size=4,
+                                  max_queue=2, timeout_ms=0)
+    serving.REGISTRY.register("opsd-rz", eng, start=False)
+    try:
+        code, _, body = _get(srv.url, "/readyz")
+        assert code == 200
+        assert json.loads(body)["checks"]["serving"]["engines"][
+            "opsd-rz"]["admission"] == "ok"
+        # not started: the queue fills to the bound -> next submit sheds
+        for _ in range(2):
+            eng.submit(mx.np.ones((1, 8)))
+        assert eng.admission_state() == "overloaded"
+        code, _, body = _get(srv.url, "/readyz")
+        rz = json.loads(body)
+        assert code == 503 and not rz["ready"]
+        e = rz["checks"]["serving"]["engines"]["opsd-rz"]
+        assert e["admission"] == "overloaded" and e["queue_depth"] == 2
+        # start the batcher: the queue drains and readiness returns
+        eng.start()
+        deadline = time.monotonic() + 10
+        while time.monotonic() < deadline:
+            code, _, _ = _get(srv.url, "/readyz")
+            if code == 200:
+                break
+            time.sleep(0.02)
+        assert code == 200
+    finally:
+        serving.REGISTRY.unregister("opsd-rz")
+    # a stopped-but-registered engine is not ready either
+    eng2 = serving.InferenceEngine(net, name="opsd-rz2")
+    serving.REGISTRY.register("opsd-rz2", eng2)
+    try:
+        eng2.stop()
+        code, _, body = _get(srv.url, "/readyz")
+        assert code == 503
+        assert json.loads(body)["checks"]["serving"]["engines"][
+            "opsd-rz2"]["admission"] == "stopped"
+    finally:
+        serving.REGISTRY.unregister("opsd-rz2")
+
+
+# -- POST endpoints + token auth --------------------------------------------
+
+def test_postmortem_endpoint_writes_bundle(srv, tmp_path):
+    flight.record("before_dump", marker=1)
+    code, body = _post(srv.url, "/postmortem")
+    assert code == 200
+    path = body["path"]
+    assert os.path.dirname(path) == str(tmp_path)
+    with open(path) as f:
+        bundle = json.load(f)
+    assert bundle["reason"] == "opsd"
+    assert any(e["kind"] == "before_dump" for e in bundle["events"])
+
+
+def test_post_requires_bearer_token_when_set(srv, monkeypatch):
+    monkeypatch.setenv("MXTPU_OPS_TOKEN", "sekrit")
+    code, body = _post(srv.url, "/postmortem")
+    assert code == 401
+    code, body = _post(srv.url, "/postmortem", token="wrong")
+    assert code == 401
+    code, body = _post(srv.url, "/postmortem", token="sekrit")
+    assert code == 200 and "path" in body
+    # GETs stay open — they serve read-only snapshots
+    code, _, _ = _get(srv.url, "/metrics")
+    assert code == 200
+
+
+def test_profile_endpoint_captures_trace(srv, tmp_path):
+    step, x, y = _step_fixture()
+    code, body = _post(srv.url, "/profile?ms=50")
+    assert code == 200, body
+    out = body["dir"]
+    assert out.startswith(str(tmp_path))
+    assert os.path.isdir(out)
+    # jax's trace lands under <dir>/plugins/profile/<run>/
+    found = [os.path.join(r, f) for r, _, fs in os.walk(out) for f in fs]
+    assert found, "profiler wrote nothing"
+
+
+# -- lifecycle: singleton, fork, exit ---------------------------------------
+
+def test_singleton_start_stop_idempotent():
+    a = opsd.start(port=0)
+    try:
+        assert opsd.start(port=0) is a  # second start returns the first
+        assert opsd.server() is a
+        code, _, _ = _get(a.url, "/healthz")
+        assert code == 200
+    finally:
+        opsd.stop()
+    assert opsd.server() is None
+    assert not a.running
+    a.stop()  # idempotent
+
+
+def test_fork_child_drops_server_parent_keeps_serving():
+    srv = opsd.start(port=0)
+    try:
+        r, w = os.pipe()
+        pid = os.fork()
+        if pid == 0:  # child
+            try:
+                ok = (opsd.server() is None
+                      and srv._httpd.socket.fileno() == -1)
+                os.write(w, b"1" if ok else b"0")
+            finally:
+                os._exit(0)
+        os.close(w)
+        assert os.read(r, 1) == b"1", \
+            "child kept the singleton or the inherited socket"
+        os.close(r)
+        os.waitpid(pid, 0)
+        # the parent's listener is untouched
+        code, _, _ = _get(srv.url, "/healthz")
+        assert code == 200
+    finally:
+        opsd.stop()
+
+
+def test_dataloader_fork_worker_coexists_with_server(srv):
+    """The opsd thread is an 'mxtpu-*' service thread: a forking
+    DataLoader must neither warn about it nor hang, and the parent's
+    server must keep serving while workers run."""
+    from mxnet_tpu.gluon.data import ArrayDataset, DataLoader
+
+    ds = ArrayDataset(onp.arange(32, dtype="f").reshape(16, 2),
+                      onp.arange(16, dtype="f"))
+    loader = DataLoader(ds, batch_size=4, num_workers=2)
+    seen = 0
+    for batch in loader:
+        seen += batch[0].shape[0]
+        code, _, _ = _get(srv.url, "/healthz")
+        assert code == 200
+    assert seen == 16
+
+
+def test_clean_shutdown_on_interpreter_exit(tmp_path):
+    """MXTPU_OPS_PORT auto-start in a subprocess: the server comes up at
+    import, answers, and the interpreter exits cleanly (atexit stops the
+    listener; daemon thread doesn't wedge shutdown)."""
+    script = tmp_path / "w.py"
+    script.write_text(
+        "import json, sys, urllib.request\n"
+        "import mxnet_tpu  # auto-starts opsd from MXTPU_OPS_PORT\n"
+        "from mxnet_tpu.observability import opsd\n"
+        "srv = opsd.server()\n"
+        "assert srv is not None and srv.running\n"
+        "with urllib.request.urlopen(srv.url + '/healthz', timeout=5) as r:\n"
+        "    assert json.load(r)['status'] == 'ok'\n"
+        "print('PORT', srv.port)\n")
+    env = dict(os.environ, JAX_PLATFORMS="cpu", PYTHONPATH=REPO,
+               MXTPU_OPS_PORT="38941",
+               MXTPU_FLIGHTREC_DIR=str(tmp_path))
+    rc = subprocess.run([sys.executable, str(script)], env=env,
+                        capture_output=True, text=True, timeout=300)
+    assert rc.returncode == 0, rc.stderr[-2000:]
+    assert "PORT 38941" in rc.stdout
+
+
+def test_port_conflict_does_not_kill_import(srv, tmp_path):
+    """A second process pointed at an already-bound port must come up
+    (training > ops plane) with no server rather than crash."""
+    script = tmp_path / "w2.py"
+    script.write_text(
+        "import mxnet_tpu\n"
+        "from mxnet_tpu.observability import opsd\n"
+        "assert opsd.server() is None\n"
+        "print('SURVIVED')\n")
+    env = dict(os.environ, JAX_PLATFORMS="cpu", PYTHONPATH=REPO,
+               MXTPU_OPS_PORT=str(srv.port),
+               MXTPU_OPS_HOST="127.0.0.1",
+               MXTPU_FLIGHTREC_DIR=str(tmp_path))
+    rc = subprocess.run([sys.executable, str(script)], env=env,
+                        capture_output=True, text=True, timeout=300)
+    assert rc.returncode == 0, rc.stderr[-2000:]
+    assert "SURVIVED" in rc.stdout

@@ -403,3 +403,89 @@ def test_kvstore_collective_metrics(fresh):
     byts = {s["labels"]["op"]: s["value"]
             for s in telemetry.dump()["collective_bytes_total"]["samples"]}
     assert byts.get("pushpull", 0) >= 32  # 8 x float32
+
+
+# -- promparse: the strict exposition checker round-trip --------------------
+
+def test_promparse_roundtrips_golden():
+    """parse_text is the inverse of prometheus_text on the golden
+    registry: families, types, label values, and cumulative histogram
+    buckets all survive the round trip."""
+    from mxnet_tpu.telemetry import promparse
+
+    fams = promparse.parse_text(prometheus_text(_golden_registry()))
+    assert fams["requests_total"]["type"] == "counter"
+    assert fams["requests_total"]["help"] == "Total requests"
+    assert promparse.sample_value(fams, "requests_total",
+                                  {"code": "404"}) == 3.0
+    assert promparse.sample_value(fams, "temp_celsius") == 36.6
+    h = fams["lat_seconds"]
+    assert h["type"] == "histogram"
+    buckets = [(s["labels"]["le"], s["value"]) for s in h["samples"]
+               if s["name"] == "lat_seconds_bucket"]
+    assert buckets == [("0.5", 2.0), ("1.0", 2.0), ("+Inf", 3.0)]
+    assert promparse.sample_value(fams, "lat_seconds_sum") == \
+        pytest.approx(2.75)
+    assert promparse.sample_value(fams, "lat_seconds_count") == 3.0
+
+
+def test_promparse_roundtrips_escaped_labels():
+    from mxnet_tpu.telemetry import promparse
+
+    r = Registry()
+    r.counter("esc2_total", 'help with "quotes"\nand\\more', ["msg"]) \
+        .labels('a"b\nc\\d').inc()
+    fams = promparse.parse_text(prometheus_text(r))
+    assert fams["esc2_total"]["help"] == 'help with "quotes"\nand\\more'
+    assert fams["esc2_total"]["samples"][0]["labels"]["msg"] == \
+        'a"b\nc\\d'
+
+
+def test_promparse_roundtrips_live_registry(fresh):
+    """The FULL live registry — every instrumented family after real
+    training — parses strictly, and parsed values match dump()."""
+    net = nn.Dense(4)
+    net.initialize()
+    net.hybridize()
+    net(np.ones((2, 8)))
+    telemetry.instruments.observe_step(0.01, examples=8)
+
+    from mxnet_tpu.telemetry import promparse
+
+    fams = promparse.parse_text(prometheus_text())
+    snap = dump()
+    assert set(fams) == set(snap)
+    assert promparse.sample_value(fams, "step_total") == \
+        snap["step_total"]["samples"][0]["value"]
+    assert promparse.sample_value(
+        fams, "step_time_seconds_count") == \
+        snap["step_time_seconds"]["samples"][0]["count"]
+
+
+def test_promparse_rejects_malformed_text():
+    from mxnet_tpu.telemetry import promparse
+
+    ok = "# TYPE x_total counter\nx_total 1\n"
+    promparse.parse_text(ok)
+    bad = [
+        "x_total 1\n",                                  # no TYPE
+        "# TYPE x_total counter\nx_total one\n",        # bad value
+        "# TYPE x_total counter\nx_total{le=0.5} 1\n",  # unquoted label
+        "# TYPE x_total counter\nx_total 1\n"
+        "# TYPE x_total counter\n",                     # TYPE after samples
+        "# TYPE x_total counter\n# TYPE x_total gauge\nx_total 1\n",
+        "# TYPE x_total widget\nx_total 1\n",           # unknown type
+        '# TYPE x_total counter\nx_total{a="b} 1\n',    # unclosed quote
+    ]
+    for text in bad:
+        with pytest.raises(promparse.ExpositionError):
+            promparse.parse_text(text)
+
+
+def test_promparse_content_type_constant():
+    """The /metrics Content-Type advertises exposition v0.0.4 — what
+    Prometheus' scraper negotiates for the text format."""
+    from mxnet_tpu.telemetry import promparse
+
+    assert promparse.CONTENT_TYPE == \
+        "text/plain; version=0.0.4; charset=utf-8"

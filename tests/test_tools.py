@@ -6,6 +6,7 @@ import json
 import os
 import subprocess
 import sys
+import time
 
 import numpy as np
 import pytest
@@ -422,3 +423,166 @@ def test_bench_platform_stamp_and_cross_platform_gate(monkeypatch):
     current = {"platform": "tpu",
                "rows": [{"metric": "train_step_ms", "value": 101.0}]}
     assert bench._check_regressions(current) == []
+
+
+# -- fleetctl + diagnose --live against live ops servers ---------------------
+
+_WORKER = """
+import os, sys, time
+import numpy as onp
+import mxnet_tpu as mx
+from mxnet_tpu.gluon import Trainer, TrainStep, nn
+from mxnet_tpu.observability import opsd
+
+steps, portfile = int(sys.argv[1]), sys.argv[2]
+srv = opsd.start(port=0)
+net = nn.HybridSequential()
+net.add(nn.Dense(16, activation="relu"), nn.Dense(4))
+net.initialize(); net.hybridize()
+trainer = Trainer(net.collect_params(), "sgd", {"learning_rate": 0.05})
+step = TrainStep(net, lambda out, y: ((out - y) ** 2).mean(), trainer)
+rs = onp.random.RandomState(0)
+x = mx.np.array(rs.rand(8, 12).astype("f"))
+y = mx.np.array(rs.rand(8, 4).astype("f"))
+for _ in range(steps):
+    step(x, y)
+mx.waitall()
+with open(portfile + ".tmp", "w") as f:
+    f.write(str(srv.port))
+os.replace(portfile + ".tmp", portfile)   # port visible only when ready
+deadline = time.time() + 180
+while not os.path.exists(portfile + ".stop") and time.time() < deadline:
+    time.sleep(0.05)
+"""
+
+
+@pytest.fixture(scope="module")
+def fleet(tmp_path_factory):
+    """Two concurrently running rank servers of one job, with skewed
+    step counts (rank 0 at step 8, rank 1 at step 2) so straggler
+    detection has something to find."""
+    tmp = tmp_path_factory.mktemp("fleet")
+    script = tmp / "worker.py"
+    script.write_text(_WORKER)
+    procs, ports = [], {}
+    try:
+        for rank, steps in ((0, 8), (1, 2)):
+            portfile = str(tmp / f"port{rank}")
+            env = dict(ENV, MXTPU_FLIGHTREC_RANK=str(rank),
+                       MXTPU_JOB_ID="fleetjob",
+                       MXTPU_FLIGHTREC_DIR=str(tmp))
+            procs.append(subprocess.Popen(
+                [sys.executable, str(script), str(steps), portfile],
+                env=env, stdout=subprocess.PIPE, stderr=subprocess.PIPE))
+        deadline = time.time() + 180
+        for rank in (0, 1):
+            portfile = str(tmp / f"port{rank}")
+            while not os.path.exists(portfile):
+                if time.time() > deadline:
+                    raise RuntimeError(
+                        f"rank {rank} never published its port: "
+                        + procs[rank].stderr.read().decode()[-2000:])
+                if procs[rank].poll() is not None:
+                    raise RuntimeError(
+                        f"rank {rank} died: "
+                        + procs[rank].stderr.read().decode()[-2000:])
+                time.sleep(0.05)
+            ports[rank] = int(open(portfile).read())
+        yield {"tmp": tmp, "ports": ports}
+    finally:
+        for rank in (0, 1):
+            open(str(tmp / f"port{rank}") + ".stop", "w").close()
+        for p in procs:
+            try:
+                p.wait(timeout=60)
+            except subprocess.TimeoutExpired:
+                p.kill()
+
+
+def test_fleetctl_table_flags_straggler(fleet):
+    sys.path.insert(0, os.path.join(REPO, "tools"))
+    import fleetctl
+
+    eps = [f"127.0.0.1:{fleet['ports'][r]}" for r in (0, 1)]
+    rows = fleetctl.annotate_stragglers(
+        [fleetctl.poll_rank(ep) for ep in eps], skew=2)
+    by_rank = {r["rank"]: r for r in rows}
+    assert set(by_rank) == {0, 1}
+    assert all(r["job"] == "fleetjob" for r in rows)
+    assert by_rank[0]["last_step"] >= 8 and by_rank[1]["last_step"] <= 2
+    assert not by_rank[0]["straggler"]
+    assert by_rank[1]["straggler"]
+
+    table = fleetctl.fleet_table(rows)
+    assert "STRAGGLER" in table
+    assert "job=fleetjob" in table and "stragglers=1" in table
+
+    # CLI: exit code 2 signals stragglers; --json carries the rows
+    rc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "fleetctl.py"),
+         *eps, "--json"],
+        env=ENV, capture_output=True, text=True, timeout=120)
+    assert rc.returncode == 2, rc.stderr[-2000:]
+    out = json.loads(rc.stdout)
+    assert sum(1 for r in out if r["straggler"]) == 1
+
+    # a down endpoint still gets a row, flagged
+    rows = fleetctl.annotate_stragglers(
+        [fleetctl.poll_rank(ep) for ep in eps]
+        + [fleetctl.poll_rank("127.0.0.1:9", timeout=1.0)], skew=2)
+    down = [r for r in rows if r["health"] == "down"]
+    assert down and down[0]["straggler"]
+
+
+def test_fleetctl_postmortem_all_feeds_blackbox(fleet):
+    sys.path.insert(0, os.path.join(REPO, "tools"))
+    import blackbox
+    import fleetctl
+
+    eps = [f"127.0.0.1:{fleet['ports'][r]}" for r in (0, 1)]
+    paths = fleetctl.postmortem_all(eps, timeout=60)
+    assert len(paths) == 2
+    assert not any(str(p).startswith("ERROR") for p in paths.values()), paths
+
+    bundles = [blackbox.load_bundle(p) for p in sorted(set(paths.values()))]
+    assert len(bundles) == 2
+    assert {b["identity"]["rank"] for b in bundles} == {0, 1}
+    text = blackbox.report(bundles)
+    assert "fleetjob" in text
+    assert "STRAGGLER" in text  # rank 1's lower last step
+
+    # the CLI one-shot: --postmortem-all --merge
+    prefix = str(fleet["tmp"] / "merged")
+    rc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "fleetctl.py"),
+         *eps, "--postmortem-all", "--merge", prefix],
+        env=ENV, capture_output=True, text=True, timeout=120)
+    assert rc.returncode == 0, rc.stderr[-2000:]
+    assert os.path.exists(prefix + ".trace.json")
+    assert os.path.exists(prefix + ".report.txt")
+
+
+def test_diagnose_live_mode(fleet):
+    """tools/diagnose.py --live renders the report from a running rank's
+    ops server — no workload, no jax import on the client side."""
+    ep = f"127.0.0.1:{fleet['ports'][0]}"
+    rc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "diagnose.py"),
+         "--live", ep],
+        env=dict(ENV, JAX_PLATFORMS=""), capture_output=True, text=True,
+        timeout=120)
+    assert rc.returncode == 0, rc.stderr[-2000:]
+    assert "== live diagnostics: rank 0" in rc.stdout
+    assert "== per-step phase breakdown ==" in rc.stdout
+    assert "== telemetry (scraped /metrics) ==" in rc.stdout
+    assert "== flight tail ==" in rc.stdout
+
+    rj = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "diagnose.py"),
+         "--live", ep, "--json"],
+        env=ENV, capture_output=True, text=True, timeout=120)
+    assert rj.returncode == 0, rj.stderr[-2000:]
+    doc = json.loads(rj.stdout)
+    assert doc["identity"]["rank"] == 0
+    assert doc["steps"]["last_step"] >= 8
+    assert "step_total" in doc["metrics"]

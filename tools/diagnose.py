@@ -3,6 +3,7 @@ diagnostics report (docs/diagnostics.md explains every section).
 
 Usage:  python tools/diagnose.py [--steps N] [--batch B] [--hidden H]
                                  [--json] [--watchdog-demo]
+        python tools/diagnose.py --live HOST:PORT [--json]
 
 Runs N training steps of a small hybridized MLP with every diagnostics
 layer armed (spans, compile introspection, device-memory gauge), then
@@ -20,7 +21,11 @@ before you need one at 3am.
 
 On a real deployment, skip this tool's toy model: call
 `mxnet_tpu.diagnostics.report()` from your own training loop — the same
-sections fill themselves from whatever ran.
+sections fill themselves from whatever ran. Or better, point `--live`
+at a rank started with MXTPU_OPS_PORT: the report renders from the
+running server's `/metrics` + `/steps` + `/flight` + `/identity`
+(observability/opsd.py) with no workload, no jax import, and no
+perturbation of the job being diagnosed.
 """
 from __future__ import annotations
 
@@ -263,8 +268,113 @@ def _passes_report_lines(pr):
     return lines
 
 
+def _promparse():
+    """Load telemetry/promparse.py by path — the --live mode must work
+    from a bastion without importing mxnet_tpu (and its jax)."""
+    import importlib.util
+
+    path = os.path.join(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        "mxnet_tpu", "telemetry", "promparse.py")
+    spec = importlib.util.spec_from_file_location("_mxtpu_promparse", path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def _live_fetch(endpoint, timeout=5.0):
+    """Pull one running rank's diagnostics surfaces: parsed /metrics,
+    /steps, /flight tail, /identity."""
+    import urllib.request
+
+    base = f"http://{endpoint}"
+
+    def get_json(path):
+        with urllib.request.urlopen(base + path, timeout=timeout) as r:
+            return json.load(r)
+
+    with urllib.request.urlopen(base + "/metrics", timeout=timeout) as r:
+        metrics_text = r.read().decode("utf-8")
+    pp = _promparse()
+    return {
+        "identity": get_json("/identity"),
+        "steps": get_json("/steps"),
+        "flight": get_json("/flight?n=40"),
+        "metrics": pp.parse_text(metrics_text),
+        "_pp": pp,
+    }
+
+
+def _live_report_lines(live):
+    pp = live["_pp"]
+    fam = live["metrics"]
+
+    def v(name, labels=None):
+        return pp.sample_value(fam, name, labels)
+
+    ident = live["identity"]
+    lines = [f"== live diagnostics: rank {ident.get('rank')} "
+             f"(job {ident.get('job')!r}, world {ident.get('world')}, "
+             f"pid {ident.get('pid')}) =="]
+
+    steps = live["steps"]
+    lines += ["", "== per-step phase breakdown =="]
+    table = steps.get("step_table", {})
+    if table:
+        phases = sorted({p for row in table.values() for p in row})
+        hdr = "  step  " + "  ".join(f"{p:>10}" for p in phases)
+        lines.append(hdr)
+        for s in sorted(table, key=lambda k: int(k))[-8:]:
+            row = table[s]
+            lines.append("  " + f"{s:>4}  " + "  ".join(
+                f"{row.get(p, 0) * 1e3:>8.2f}ms" for p in phases))
+    else:
+        lines.append("  (no steps recorded)")
+    lines.append(f"  last step: {steps.get('last_step')}  "
+                 f"avg step: {steps.get('step_time_ms_avg')}ms  "
+                 f"examples/s: {steps.get('examples_per_second')}")
+    if steps.get("step_dispatches"):
+        lines.append("  dispatches: " + "  ".join(
+            f"{p}={int(n)}" for p, n in
+            sorted(steps["step_dispatches"].items())))
+
+    lines += ["", "== telemetry (scraped /metrics) =="]
+    for name in ("step_total", "jit_compile_total", "transfer_bytes_total",
+                 "engine_sync_total", "collective_calls_total",
+                 "flight_events_total", "postmortem_dump_total"):
+        val = v(name)
+        if val is None:  # labeled family: sum its series
+            f = fam.get(name)
+            if f and f["samples"]:
+                val = sum(s["value"] for s in f["samples"]
+                          if not s["name"].endswith(("_sum", "_count"))
+                          and "le" not in s["labels"])
+        if val is not None:
+            lines.append(f"  {name}: {val:g}")
+    lines.append(f"  ({len(fam)} metric families scraped)")
+
+    lines += ["", "== flight tail =="]
+    evs = live["flight"].get("events", [])
+    for ev in evs[-12:]:
+        extra = {k: v for k, v in ev.items()
+                 if k not in ("kind", "t", "pc", "step")}
+        ex = " ".join(f"{k}={v}" for k, v in extra.items())
+        lines.append(f"  step {ev.get('step', 0):>5}  "
+                     f"{ev.get('kind', '?'):<18} {ex}".rstrip())
+    if not evs:
+        lines.append("  (flight ring empty)")
+    lines.append("")
+    lines.append(f"  {live['flight'].get('total', 0)} events in ring "
+                 f"(capacity {live['flight'].get('capacity')})")
+    return lines
+
+
 def main(argv=None):
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--live", metavar="HOST:PORT", default=None,
+                    help="render the report from a running rank's ops "
+                         "server (MXTPU_OPS_PORT) instead of an "
+                         "in-process workload")
     ap.add_argument("--steps", type=int, default=3)
     ap.add_argument("--batch", type=int, default=32)
     ap.add_argument("--hidden", type=int, default=64)
@@ -276,6 +386,15 @@ def main(argv=None):
                     help="run the graph-pass demo (dedup + pipeline AMP) "
                          "and print the pass/dedup/remat report section")
     args = ap.parse_args(argv)
+
+    if args.live:
+        live = _live_fetch(args.live)
+        if args.json:
+            out = {k: v for k, v in live.items() if k != "_pp"}
+            print(json.dumps(out, default=str))
+        else:
+            print("\n".join(_live_report_lines(live)))
+        return
 
     os.environ.setdefault("MXTPU_TELEMETRY", "1")
     from mxnet_tpu import diagnostics, telemetry
