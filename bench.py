@@ -697,6 +697,79 @@ def bench_layout_overhead(platform, iters, warmup):
     return auto_ms, off_ms, img_s_auto
 
 
+def _sharding_bench_run(batch, feats, classes, iters, warmup):
+    """Inner dp8 measurement — needs >=8 visible devices (the CPU row
+    re-launches it in a subprocess with forced virtual devices). Times
+    the one-time ShardingPlan placement and `iters` donated whole-step
+    dispatches over Trainer(mesh=(('dp', -1),))."""
+    import numpy as onp
+
+    import mxnet_tpu as mx
+    from mxnet_tpu import gluon
+    from mxnet_tpu.sharding import ShardingPlan
+
+    mx.seed(0)
+    net = gluon.nn.HybridSequential()
+    net.add(gluon.nn.Dense(512, activation="relu", in_units=feats),
+            gluon.nn.Dense(classes, in_units=512))
+    net.initialize()
+    net.hybridize()
+    rs = onp.random.RandomState(0)
+    x = mx.np.array(rs.rand(batch, feats).astype("f"))
+    y = mx.np.array(rs.randint(0, classes, (batch,)).astype("i4"))
+
+    plan = ShardingPlan("dp=-1")
+    t0 = time.perf_counter()
+    plan.apply(dict(net.collect_params()), label="bench")
+    apply_ms = (time.perf_counter() - t0) * 1000.0
+
+    trainer = gluon.Trainer(net.collect_params(), "sgd",
+                            {"learning_rate": 0.1, "momentum": 0.9},
+                            kvstore="tpu_dist", sharding_plan=plan)
+    step = gluon.TrainStep(
+        net, gluon.loss.SoftmaxCrossEntropyLoss(), trainer)
+    dt, _ = _timeit(lambda: step(x, y),
+                    lambda l: float(l.asnumpy().sum()), iters, warmup)
+    if step.last_path != "whole_step":
+        raise RuntimeError(
+            f"dp8 bench fell back to phased: {step.ineligible_reason()}")
+    return {"img_s": batch * iters / dt, "apply_ms": apply_ms}
+
+
+def bench_sharding(platform, iters, warmup):
+    """dp8 whole-step throughput + one-time plan placement cost
+    (docs/sharding.md). The 8-way CPU mesh needs the process-level
+    --xla_force_host_platform_device_count flag, so on CPU the
+    measurement runs in a subprocess; accelerators use the first 8
+    real devices in-process."""
+    batch = 64 if platform == "cpu" else 256
+    feats, classes = (256, 10) if platform == "cpu" else (512, 100)
+    if platform == "cpu":
+        import subprocess
+
+        flags = (os.environ.get("XLA_FLAGS", "") +
+                 " --xla_force_host_platform_device_count=8").strip()
+        env = dict(os.environ, JAX_PLATFORMS="cpu", XLA_FLAGS=flags)
+        out = subprocess.run(
+            [sys.executable, "-c",
+             "import json, bench; print(json.dumps("
+             f"bench._sharding_bench_run({batch}, {feats}, {classes}, "
+             f"{iters}, {warmup})))"],
+            capture_output=True, text=True, timeout=600,
+            cwd=os.path.dirname(os.path.abspath(__file__)), env=env)
+        if out.returncode != 0:
+            raise RuntimeError(out.stderr.strip()[-400:])
+        res = json.loads(out.stdout.strip().splitlines()[-1])
+    else:
+        import jax
+
+        ndev = len(jax.devices())
+        if ndev < 8:
+            raise RuntimeError(f"dp8 needs 8 devices, have {ndev}")
+        res = _sharding_bench_run(batch, feats, classes, iters, warmup)
+    return res["img_s"], res["apply_ms"]
+
+
 def bench_kernel_micro_ms(platform, iters=50):
     """Per-kernel microbenches at an audited shape: wall ms per call of
     the BN statistics forward, the BN backward, and the fused optimizer
@@ -1199,6 +1272,29 @@ def main():
             "note": ly_note})
     except Exception as e:
         rows.append({"metric": "train_step_ms_layout", "error": str(e)})
+
+    # hybrid parallelism: dp8 whole-step throughput + the one-time
+    # ShardingPlan placement cost; img/s rides the higher-is-better
+    # gate, the _ms row the lower-is-better gate (docs/sharding.md)
+    try:
+        if over_budget():
+            raise TimeoutError("bench budget exhausted")
+        sh_iters = iters if platform != "cpu" else 5
+        sh_img_s, sh_apply_ms = bench_sharding(platform, sh_iters, warmup)
+        rows.append({
+            "metric": "train_img_s_dp8" + suffix,
+            "value": round(sh_img_s, 2), "unit": "img/s",
+            "note": "donated whole-step training over "
+                    "Trainer(kvstore='tpu_dist', mesh=(('dp', -1),)) on "
+                    "an 8-way data-parallel mesh (CPU: forced virtual "
+                    "devices in a subprocess; docs/sharding.md)"})
+        rows.append({
+            "metric": "sharding_apply_ms" + suffix,
+            "value": round(sh_apply_ms, 3), "unit": "ms",
+            "note": "one-time ShardingPlan.apply cost: NamedSharding "
+                    "device_put of params+grads onto the dp8 mesh"})
+    except Exception as e:
+        rows.append({"metric": "train_img_s_dp8", "error": str(e)})
     try:
         if over_budget():
             raise TimeoutError("bench budget exhausted")

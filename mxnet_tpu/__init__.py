@@ -109,6 +109,7 @@ from . import test_utils  # noqa: E402  (mx.test_utils like the reference)
 from . import amp  # noqa: E402  (mx.amp — reference: python/mxnet/amp/)
 from . import serving  # noqa: E402  (batching inference engine; docs/serving.md)
 from . import checkpoint  # noqa: E402  (atomic snapshots; docs/checkpointing.md)
+from . import sharding  # noqa: E402  (hybrid parallelism; docs/sharding.md)
 from . import observability  # noqa: E402  (flight recorder + numerics + postmortems)
 
 waitall = engine.waitall

@@ -542,6 +542,11 @@ def verify_checkpoint(directory, step=None):
     report["mode"] = manifest.get("mode")
     report["library_version"] = manifest.get("library_version")
     report["manifest_step"] = manifest.get("step")
+    # the plan the run trained under (None = unsharded); restore onto
+    # ANY plan is legal — arrays are host-gathered — so this is
+    # provenance, not a constraint
+    report["sharding_plan"] = (manifest.get("meta") or {}).get(
+        "sharding_plan")
     if manifest.get("step") != step:
         report["ok"] = False
         report["errors"].append(

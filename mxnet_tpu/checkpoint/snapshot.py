@@ -163,6 +163,15 @@ def capture(trainer, user_state=None):
         # at save time (arrays are ALWAYS logical — apply re-permutes
         # to whatever the restoring trainer's layout is)
         "layout_perms": layout_perms,
+        # the ShardingPlan this run trained under (docs/sharding.md):
+        # arrays above are host numpy — asnumpy() gathers every shard —
+        # so the payload itself is placement-free; the record is for
+        # provenance (verify_checkpoint) and tooling.  apply() re-places
+        # onto the RESTORING trainer's plan, so replicated↔dp↔dp×tp
+        # moves are just save + restore.
+        "sharding_plan": (trainer.sharding_plan.to_manifest()
+                          if getattr(trainer, "sharding_plan", None)
+                          is not None else None),
         "scale": trainer._scale,
         "user_state": user_state,
     }
@@ -224,6 +233,21 @@ def apply(trainer, arrays, meta):
             grads = p.list_grad()
             if grads:
                 trainer._grad_versions[i] = grads[0]._version
+    # re-place restored arrays onto the RESTORING trainer's plan (which
+    # may differ from the save-time plan recorded in meta): set_data /
+    # the state rebuild above landed everything at default placement,
+    # so a dp=4 checkpoint loads into a replicated run — and vice versa
+    # — by re-running plan application here
+    plan = getattr(trainer, "_sharding_plan", None)
+    if plan is not None:
+        trainer._plan_applied = False
+        trainer._maybe_apply_plan()
+        if trainer._plan_applied:
+            from ..optimizer.optimizer import place_state_like
+
+            for i, p in enumerate(trainer._params):
+                if trainer._states_created[i]:
+                    place_state_like(trainer._states[i], p.data())
     if "rng/key" in arrays:
         from .. import _random
 

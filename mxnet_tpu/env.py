@@ -340,6 +340,24 @@ register(
     "this — relayout bookkeeping swamps any bandwidth win on tiny "
     "graphs (passes/layout.py).")
 register(
+    "MXTPU_MESH", str, "",
+    "Device-mesh axis spec for the sharding subsystem "
+    "(mxnet_tpu/sharding; docs/sharding.md), e.g. 'dp=-1' (data "
+    "parallel over all devices) or 'dp=4,tp=2'. -1 infers that axis "
+    "from the device count. Consulted only when MXTPU_SHARDING=auto "
+    "and the Trainer was given no explicit mesh=/sharding_plan=; empty "
+    "(default) names no mesh.")
+register(
+    "MXTPU_SHARDING", str, "auto",
+    "Sharding-subsystem mode (mxnet_tpu/sharding; docs/sharding.md): "
+    "'off' disables the subsystem entirely — mesh= arguments and "
+    "MXTPU_MESH are ignored, the ShardingPass is never injected, and "
+    "every code path is bitwise-identical to the unsharded framework; "
+    "'auto' (default) builds a plan from explicit Trainer arguments, "
+    "else from MXTPU_MESH; 'plan' accepts explicit arguments only "
+    "(MXTPU_MESH is ignored, so a launcher's env mesh cannot override "
+    "a hand-built plan).")
+register(
     "MXTPU_OPS_PORT", int, 0,
     "Live ops server (observability.opsd; docs/observability.md): start "
     "a per-process stdlib HTTP server on this port at import, serving "

@@ -843,6 +843,18 @@ class HybridBlock(Block):
         param_nds = [p.data() for _, p in params]
         pd = {n: nd._data for n, nd in zip(names, param_nds)}
         key = _random.next_key()
+        if params:
+            # mesh-placed params (sharding.ShardingPlan.apply) commit the
+            # computation to the mesh's device set; the key is committed to
+            # the default device, and jit refuses mixed assignments —
+            # replicate it onto the same mesh.
+            _shd = getattr(pd[names[0]], "sharding", None)
+            _mesh = getattr(_shd, "mesh", None)
+            if _mesh is not None and len(_shd.device_set) > 1:
+                key = jax.device_put(
+                    key,
+                    jax.sharding.NamedSharding(
+                        _mesh, jax.sharding.PartitionSpec()))
         arr_datas = [a._data for a in args]
 
         taping = ag.taping_active() and (

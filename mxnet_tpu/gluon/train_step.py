@@ -104,6 +104,18 @@ class TrainStep:
         self._trainer = trainer
         self._n_data = int(n_data)
         self._batch_axis = int(batch_axis)
+        # a trainer carrying a ShardingPlan makes its mesh this step's
+        # default — Trainer(kvstore='tpu_dist', mesh=(('dp', -1),)) then
+        # trains sharded through this path with no TrainStep arguments.
+        # An EXPLICIT mesh= predates the plan subsystem and keeps its
+        # exact old semantics (no plan, no ShardingPass).
+        self._plan = None
+        if mesh is None:
+            plan = getattr(trainer, "sharding_plan", None)
+            if plan is not None:
+                self._plan = plan
+                mesh = plan.mesh
+                axis = plan.batch_axis
         self._mesh = mesh
         self._axis = axis
         self._built = False
@@ -174,6 +186,19 @@ class TrainStep:
             seen.add(id(p))
             if p._data_map is not None and len(p.list_ctx()) > 1:
                 return f"param {p.name} is replicated across devices"
+        if self._plan is not None:
+            # the whole-step shard_map replicates params (in_specs P());
+            # a plan that tensor-shards any of them needs model-level
+            # collectives the body doesn't trace — those plans train
+            # through the phased path, where params keep their
+            # NamedSharding and XLA's GSPMD partitioner inserts the
+            # tp collectives
+            names_shapes = [(n, p.shape) for n, p in
+                            zip(tr._param_names, tr._params)
+                            if p.shape is not None]
+            if self._plan.shards_params(names_shapes):
+                return ("plan tensor-shards params "
+                        "(GSPMD phased path carries tp)")
         return None
 
     def _eligible(self):
@@ -382,7 +407,8 @@ class TrainStep:
             fn = _passes.apply(self._step_fn, _passes.PassContext(
                 label="whole_step", variant=self._variant,
                 kind="whole_step", training=True,
-                donate_argnums=(0, 2) if donate else ()))
+                donate_argnums=(0, 2) if donate else (),
+                plan=self._plan))
             self._jit_variants[donate] = fn
         return fn
 
@@ -447,6 +473,9 @@ class TrainStep:
             # complete deferred init BEFORE the (cached) eligibility
             # check — it inspects dtypes and device placement
             self._net._ensure_initialized(batch[:self._n_data])
+            # deferred-shape params just materialized: the trainer's
+            # ShardingPlan (if any) can now place them (no-op otherwise)
+            self._trainer._maybe_apply_plan()
         if not getattr(self._net, "_layout_prepared", False):
             # persistent NHWC weight re-layout BEFORE tws/frozen are
             # built: the donated whole-step program then updates the
@@ -466,6 +495,26 @@ class TrainStep:
         backward, Trainer.step) — the fallback contract AND the
         reference semantics the whole-step path is proven against."""
         self._last_path = "phased"
+        if self._plan is not None:
+            from jax.sharding import NamedSharding
+            from jax.sharding import PartitionSpec as P
+
+            # tensor-sharded plans run here (GSPMD carries the tp axes),
+            # but the batch arrives committed to one device while the
+            # plan placed params across the mesh — split it along the
+            # data axis (replicate when the batch doesn't divide).
+            mesh = self._plan.mesh
+            dp = self._plan.axis_sizes()[self._plan.batch_axis]
+            ax = self._batch_axis
+
+            def _place(a):
+                divisible = (len(a.shape) > ax and a.shape[ax] % dp == 0)
+                spec = P(*([None] * ax), self._plan.batch_axis) \
+                    if divisible else P()
+                return NDArray(
+                    jax.device_put(a._data, NamedSharding(mesh, spec)))
+
+            batch = tuple(_place(a) for a in batch)
         with ag.record():
             out = self._net(*batch[:self._n_data])
             loss = self._loss(out, *batch[self._n_data:]) \

@@ -25,7 +25,7 @@ __all__ = ["Trainer"]
 class Trainer:
     def __init__(self, params, optimizer, optimizer_params=None, kvstore=None,
                  compression_params=None, update_on_kvstore=None,
-                 batch_axis=0):  # noqa: ARG002
+                 batch_axis=0, mesh=None, sharding_plan=None):  # noqa: ARG002
         if isinstance(params, dict):
             param_list = [params[k] for k in sorted(params)]
             self._param_names = sorted(params)
@@ -61,6 +61,22 @@ class Trainer:
         if self._update_on_kvstore:
             self._kvstore.set_optimizer(self._optimizer)
         self._kv_initialized = False
+        # hybrid parallelism (mxnet_tpu/sharding; docs/sharding.md):
+        # mesh= is the axes shorthand (Trainer(..., mesh=(('dp', -1),))),
+        # sharding_plan= the full object; resolve_plan folds in
+        # MXTPU_MESH/MXTPU_SHARDING, returning None when the subsystem is
+        # off or nothing names a mesh — that None keeps every path below
+        # bitwise-identical to the unsharded trainer.
+        from ..sharding import resolve_plan as _resolve_plan
+
+        self._sharding_plan = _resolve_plan(
+            sharding_plan if sharding_plan is not None else mesh)
+        self._plan_applied = False
+        if self._sharding_plan is not None and self._kvstore is not None:
+            setter = getattr(self._kvstore, "set_sharding_plan", None)
+            if setter is not None:
+                setter(self._sharding_plan)
+        self._maybe_apply_plan()
         self._last_step_end = None  # telemetry: previous step() finish
         # param index -> grad buffer version seen at its last update;
         # a matching version means the grad is STALE (nothing backprop'd
@@ -78,11 +94,37 @@ class Trainer:
     def set_learning_rate(self, lr):
         self._optimizer.set_learning_rate(lr)
 
+    @property
+    def sharding_plan(self):
+        """The resolved ShardingPlan, or None (unsharded)."""
+        return self._sharding_plan
+
+    def _maybe_apply_plan(self):
+        """Place every param (+grads) per the plan, once all params are
+        initialized.  Deferred-shape models initialize at first forward,
+        so this is re-checked lazily from __init__, step()/update(), and
+        TrainStep — it no-ops after the first successful application and
+        instantly when there is no plan."""
+        plan = self._sharding_plan
+        if plan is None or self._plan_applied:
+            return
+        if any(p._data_map is None for p in self._params):
+            return  # deferred init still pending; try again next call
+        plan.apply(dict(zip(self._param_names, self._params)),
+                   label="trainer")
+        self._plan_applied = True
+
     def _ensure_states(self, i, weight):
         if not self._states_created[i]:
             self._states[i] = self._optimizer.create_state_multi_precision(
                 i, weight)
             self._states_created[i] = True
+            if self._plan_applied:
+                # optimizer state (momentum, fp32 master copies, fused
+                # bucket slices) mirrors its weight's shape — give it
+                # the weight's placement so updates stay local to each
+                # shard instead of pulling state cross-device
+                opt_mod.place_state_like(self._states[i], weight)
 
     def allreduce_grads(self, ignore_stale_grad=False):
         """Aggregate gradients across device copies via the kvstore
@@ -134,6 +176,7 @@ class Trainer:
         """allreduce + optimizer update, scaling grads by 1/batch_size
         (reference: trainer.py:341)."""
         self._optimizer.rescale_grad = self._scale / batch_size
+        self._maybe_apply_plan()
         with _spans.span("allreduce_grads", cat="collective"):
             self.allreduce_grads(ignore_stale_grad)
         with _spans.span("optimizer_update", cat="optimizer"):
@@ -174,6 +217,7 @@ class Trainer:
                _skip_rescale=False):
         if not _skip_rescale:
             self._optimizer.rescale_grad = self._scale / batch_size
+            self._maybe_apply_plan()
         from .. import env as _env
 
         # fused multi-tensor path (default): single-device dense params

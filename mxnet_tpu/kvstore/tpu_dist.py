@@ -73,6 +73,7 @@ class TPUDist(KVStoreBase):
         self._devices = devices  # optional explicit jax device list
         self._optimizer = None
         self._sum_cache = {}
+        self._sharding_plan = None  # set by Trainer (set_sharding_plan)
         try:
             # stamp (job, rank) into flight events + span records so
             # tools/blackbox.py can align this rank's postmortem bundle
@@ -327,9 +328,19 @@ class TPUDist(KVStoreBase):
         from .. import env as _env
         from ..parallel import collectives
 
+        if mesh is None and self._sharding_plan is not None:
+            mesh = self._sharding_plan.mesh
+            axis = self._sharding_plan.batch_axis
         if _env.get("MXTPU_FUSED_UPDATE"):
             return collectives.psum_tree_flat(arrays, mesh=mesh, axis=axis)
         return collectives.psum_tree(arrays, mesh=mesh, axis=axis)
+
+    def set_sharding_plan(self, plan):
+        """Adopt a ShardingPlan (Trainer calls this when constructed
+        with mesh=/sharding_plan=): the plan's mesh and data axis become
+        the defaults for allreduce_sharded, so sharded-gradient reduces
+        need no per-call topology arguments."""
+        self._sharding_plan = plan
 
     def traced_allreduce(self, tree, axis="dp", bucket_mb=None):
         """In-program gradient allreduce for the whole-step compiled path

@@ -103,17 +103,27 @@ def _sync_capacity():
         set_capacity(int(n))
 
 
-def set_identity(rank=None, world=None, job=None):
+def set_identity(rank=None, world=None, job=None, mesh=None, coords=None):
     """Stamp this process's place in the job — called by
     ``kvstore.tpu_dist`` at collective init (and by tests). Also pushes
     the (job, rank) trace context onto diagnostics spans so span records
-    carry the same correlation ID as flight events."""
+    carry the same correlation ID as flight events.
+
+    ``mesh`` ({axis: size}) and ``coords`` ({axis: index}) come from
+    ``ShardingPlan.apply``: they flow through :func:`identity` into the
+    ops server's /identity payload, so tools/fleetctl.py tables can show
+    each rank's (dp, tp) coordinates next to its rank number."""
     if rank is not None:
         _identity["rank"] = int(rank)
     if world is not None:
         _identity["world"] = int(world)
     if job is not None:
         _identity["job"] = str(job)
+    if mesh is not None:
+        _identity["mesh"] = {str(k): int(v) for k, v in dict(mesh).items()}
+    if coords is not None:
+        _identity["coords"] = {str(k): int(v)
+                               for k, v in dict(coords).items()}
     try:
         from ..diagnostics import spans as _spans
 

@@ -30,6 +30,7 @@ from .optimizer import (  # noqa: F401
     Updater,
     create,
     get_updater,
+    place_state_like,
     register,
 )
 

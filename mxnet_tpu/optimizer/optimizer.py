@@ -31,7 +31,7 @@ from ..telemetry import instruments as _telemetry
 
 _REG = registry("optimizer")
 
-__all__ = ["Optimizer", "register", "create"]
+__all__ = ["Optimizer", "register", "create", "place_state_like"]
 
 
 def register(klass):
@@ -612,6 +612,35 @@ def _write_state(state, new_state):
 
 def _zeros_like(weight, dtype=None):
     return _wrap_out(jnp.zeros_like(weight._data, dtype=dtype))
+
+
+def place_state_like(state, weight):
+    """Give optimizer state its weight's device placement.
+
+    State leaves (momentum, variance, fp32 master copies) mirror the
+    weight's shape, so under a ShardingPlan they take the weight's
+    NamedSharding verbatim — each shard's update then reads/writes only
+    local state.  Leaves whose shape differs (scalar counters) and
+    unplaced weights (no sharding attribute, or single-device default)
+    are left alone; the trainer calls this right after state creation,
+    so there is never live donated aliasing to worry about."""
+    sharding = getattr(getattr(weight, "_data", None), "sharding", None)
+    if sharding is None:
+        return state
+
+    def _place(s):
+        if s is None:
+            return
+        if isinstance(s, NDArray):
+            if s.shape == weight.shape:
+                s._data = jax.device_put(s._data, sharding)
+                s._version += 1
+            return
+        for leaf in s:
+            _place(leaf)
+
+    _place(state)
+    return state
 
 
 # ---------------------------------------------------------------------------

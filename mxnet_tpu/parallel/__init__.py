@@ -15,7 +15,8 @@ Modules:
 """
 from . import collectives, mesh, moe, pipeline, ring_attention, ulysses  # noqa: F401
 from .data_parallel import make_data_parallel_step  # noqa: F401
-from .mesh import make_mesh, shard_batch, shard_params  # noqa: F401
+from .mesh import (ShardingError, make_mesh, shard_batch,  # noqa: F401
+                   shard_params)
 from .ring_attention import (  # noqa: F401
     ring_attention_sharded,
     ring_flash_attention_sharded,

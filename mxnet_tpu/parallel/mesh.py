@@ -13,7 +13,17 @@ import jax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec
 
 __all__ = ["make_mesh", "Mesh", "NamedSharding", "PartitionSpec",
-           "data_parallel_mesh", "local_mesh"]
+           "ShardingError", "data_parallel_mesh", "local_mesh"]
+
+
+class ShardingError(ValueError):
+    """A sharding request that cannot be laid out: a mesh spec that does
+    not match the device count, or a parameter dimension that is not
+    divisible by the mesh axis its PartitionSpec assigns it to. Raised
+    eagerly with the param name and spec in the message, instead of
+    letting jax fail later with an opaque shape error. Defined here (not
+    in mxnet_tpu.sharding) so mesh-level helpers can raise it without a
+    circular import; the sharding package re-exports it."""
 
 
 def make_mesh(axes, devices=None):
@@ -35,7 +45,9 @@ def make_mesh(axes, devices=None):
             known *= s
     if unknown:
         if n % known:
-            raise ValueError(f"{n} devices not divisible by {known}")
+            raise ShardingError(
+                f"{n} devices not divisible by {known} "
+                f"(mesh spec {dict(zip(names, axes.values()))})")
         sizes[unknown[0]] = n // known
         for i in unknown[1:]:
             sizes[i] = 1
@@ -43,7 +55,8 @@ def make_mesh(axes, devices=None):
     for s in sizes:
         total *= s
     if total != n:
-        raise ValueError(f"mesh {dict(zip(names, sizes))} != {n} devices")
+        raise ShardingError(
+            f"mesh {dict(zip(names, sizes))} != {n} devices")
     arr = _np.array(devices).reshape(sizes)
     return Mesh(arr, tuple(names))
 
@@ -58,6 +71,31 @@ def local_mesh(axes=None):
     return make_mesh(axes or {"dp": -1}, jax.local_devices())
 
 
+def _check_divisible(name, shape, spec, mesh):
+    """Raise ShardingError naming the param and spec when a sharded
+    dimension is not divisible by the product of its mesh axes — the
+    eager, readable version of the shape error jax would raise deep
+    inside device_put/lowering."""
+    for d, entry in enumerate(spec):
+        if entry is None:
+            continue
+        axes = entry if isinstance(entry, tuple) else (entry,)
+        factor = 1
+        for ax in axes:
+            if ax not in mesh.shape:
+                raise ShardingError(
+                    f"parameter {name}: spec {spec} names mesh axis "
+                    f"{ax!r}, but the mesh has axes "
+                    f"{tuple(mesh.axis_names)}")
+            factor *= mesh.shape[ax]
+        if d >= len(shape) or shape[d] % factor:
+            dim = shape[d] if d < len(shape) else "<missing>"
+            raise ShardingError(
+                f"parameter {name} with shape {tuple(shape)}: dim {d} "
+                f"({dim}) is not divisible by mesh "
+                f"axis {'x'.join(axes)} (size {factor}) in spec {spec}")
+
+
 def shard_params(params, mesh, spec_fn=None):
     """Lay Gluon Parameters (dict name->Parameter) out on a device mesh.
 
@@ -67,12 +105,21 @@ def shard_params(params, mesh, spec_fn=None):
     the kvstore='tpu_dist' path: after this, eager ops and CachedOp jits
     compute with GSPMD semantics and XLA inserts the gradient all-reduce
     during backward (subsuming the reference's push/pull round trip).
+
+    `mesh` may be a built Mesh or an axes spec ({'dp': -1} / (('dp', -1),))
+    — specs go through :func:`make_mesh`, so -1 sizes infer from the
+    device count. A spec that shards a dimension not divisible by its
+    mesh axis raises :class:`ShardingError` naming the param and spec.
     """
+    if not isinstance(mesh, Mesh):
+        mesh = make_mesh(dict(mesh))
     for name, p in params.items():
         if p._data_map is None:
             raise ValueError(f"parameter {name} is not initialized")
-        spec = spec_fn(name, p.shape) if spec_fn is not None else \
-            PartitionSpec()
+        spec = spec_fn(name, p.shape) if spec_fn is not None else None
+        if spec is None:
+            spec = PartitionSpec()
+        _check_divisible(name, p.shape, spec, mesh)
         sh = NamedSharding(mesh, spec)
         for arr in p._data_map.values():
             arr._data = jax.device_put(arr._data, sh)
@@ -80,6 +127,7 @@ def shard_params(params, mesh, spec_fn=None):
             if arr._grad is not None:
                 arr._grad._data = jax.device_put(arr._grad._data, sh)
                 arr._grad._version += 1
+    return mesh
 
 
 def shard_batch(x, mesh, axis="dp"):

@@ -59,6 +59,17 @@ def _numerics_factory():
 
 register_named_pass("numerics", _numerics_factory)
 
+
+def _sharding_factory():
+    # lazy (sharding imports parallel.mesh); a force-named pass carries
+    # no plan of its own — it stamps whatever plan the context holds
+    from ..sharding.shard_pass import ShardingPass
+
+    return ShardingPass()
+
+
+register_named_pass("sharding", _sharding_factory)
+
 __all__ = [
     "AmpPass",
     "DedupExecutable",

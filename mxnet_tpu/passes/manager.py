@@ -59,10 +59,12 @@ class PassContext:
     """Everything a pass may consult about the seam it is rewriting."""
 
     __slots__ = ("block", "label", "variant", "kind", "training",
-                 "donate_argnums", "on_build", "notes")
+                 "donate_argnums", "on_build", "notes", "plan",
+                 "in_shardings", "out_shardings")
 
     def __init__(self, block=None, label="", variant="", kind="block",
-                 training=False, donate_argnums=(), on_build=None):
+                 training=False, donate_argnums=(), on_build=None,
+                 plan=None, in_shardings=None, out_shardings=None):
         self.block = block
         self.label = label or (type(block).__name__ if block is not None
                                else "?")
@@ -75,6 +77,20 @@ class PassContext:
         # (the block's jit_trace_total bump).
         self.on_build = on_build
         self.notes = {}
+        # The ShardingPlan for this seam, or None.  Plan-carrying
+        # contexts get a ShardingPass injected in resolve_passes; a
+        # None plan (mesh=None) never does, so that path compiles the
+        # same program main compiles.  Deliberately per-context, not
+        # process-global: two trainers with different meshes coexist.
+        self.plan = plan
+        # Optional jit placement constraints forwarded verbatim to
+        # jax.jit by apply()/apply_pipeline().  None means "let jax
+        # infer from operands" — the default everywhere today; the
+        # whole-step path places operands with device_put instead
+        # (python scalars in its arg list make pytree-prefix shardings
+        # fragile), so these are for block/export seams and tests.
+        self.in_shardings = in_shardings
+        self.out_shardings = out_shardings
 
     def fire_on_build(self):
         if self.on_build is not None:
@@ -223,6 +239,16 @@ def resolve_passes(ctx):
     if _layout.mode() != "off" \
             and not any(p.name == "layout" for p in passes):
         passes.append(_layout.LayoutPass())
+    # sharding joins only when the context CARRIES a plan (mesh=None →
+    # ctx.plan None → never injected, the kill-switch acceptance
+    # contract) and MXTPU_SHARDING isn't off — the same mode() Trainer
+    # used to resolve that plan in the first place
+    if ctx.plan is not None:
+        from ..sharding import mode as _sharding_mode
+        if _sharding_mode() != "off" \
+                and not any(p.name == "sharding" for p in passes):
+            from ..sharding.shard_pass import ShardingPass
+            passes.append(ShardingPass(ctx.plan))
     passes = [p for p in passes if p.applies(ctx)]
     passes.sort(key=lambda p: (p.priority, p.name))
     return passes
@@ -315,9 +341,24 @@ def apply(fn, ctx):
         from .dedup import DedupExecutable
         return DedupExecutable(fn, passes, ctx)
     if not passes:
-        return jax.jit(fn, donate_argnums=ctx.donate_argnums)
+        return jax.jit(fn, donate_argnums=ctx.donate_argnums,
+                       **_jit_shardings(ctx))
     return jax.jit(pipelined_callable(fn, passes, ctx),
-                   donate_argnums=ctx.donate_argnums)
+                   donate_argnums=ctx.donate_argnums,
+                   **_jit_shardings(ctx))
+
+
+def _jit_shardings(ctx):
+    """in/out_shardings kwargs for jax.jit — only the ones the context
+    actually sets, so the default stays a vanilla jit call (bitwise
+    main, and robust to jax versions where the kwarg default differs
+    from passing None)."""
+    kw = {}
+    if ctx.in_shardings is not None:
+        kw["in_shardings"] = ctx.in_shardings
+    if ctx.out_shardings is not None:
+        kw["out_shardings"] = ctx.out_shardings
+    return kw
 
 
 def apply_pipeline(fn, passes, ctx):
@@ -326,9 +367,11 @@ def apply_pipeline(fn, passes, ctx):
     Ignores the MXTPU_PASSES kill switch: the caller asked for exactly
     these passes."""
     if not passes:
-        return jax.jit(fn, donate_argnums=ctx.donate_argnums)
+        return jax.jit(fn, donate_argnums=ctx.donate_argnums,
+                       **_jit_shardings(ctx))
     return jax.jit(pipelined_callable(fn, passes, ctx),
-                   donate_argnums=ctx.donate_argnums)
+                   donate_argnums=ctx.donate_argnums,
+                   **_jit_shardings(ctx))
 
 
 def wrap_forward(fn, ctx):

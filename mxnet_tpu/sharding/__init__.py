@@ -1,0 +1,17 @@
+"""Hybrid-parallelism subsystem: WHERE every array lives.
+
+``passes/`` owns the trace→compile seam; this package owns placement —
+one :class:`ShardingPlan` (mesh axes + per-parameter PartitionSpec
+rules) threaded through Trainer, TrainStep, kvstore and checkpoint so
+`Trainer(..., kvstore='tpu_dist', mesh=(('dp', -1),))` trains the
+donated one-dispatch whole-step program data-parallel, and
+tensor-sharded plans ride XLA's GSPMD partitioner.  docs/sharding.md
+is the user-facing tour; ``mesh=None`` (and MXTPU_SHARDING=off) keeps
+every code path bitwise-identical to the unsharded framework.
+"""
+from .plan import (ShardingError, ShardingPlan, last_applied,  # noqa: F401
+                   mode, parse_axes, resolve_plan)
+from .shard_pass import ShardingPass  # noqa: F401
+
+__all__ = ["ShardingError", "ShardingPlan", "ShardingPass",
+           "last_applied", "mode", "parse_axes", "resolve_plan"]

@@ -1,0 +1,352 @@
+"""ShardingPlan — the one object that answers "where does every array
+live".
+
+A plan is (mesh axes, per-parameter PartitionSpec rules, batch axis):
+
+    plan = ShardingPlan((("dp", -1),))                    # pure DP
+    plan = ShardingPlan((("dp", 4), ("tp", 2)),
+                        rules=[(r".*dense.*weight", ("tp", None))])
+    plan = ShardingPlan.parse("dp=4,tp=2")                # MXTPU_MESH form
+
+Construction never touches devices; :meth:`mesh` builds the
+``jax.sharding.Mesh`` lazily (``-1`` sizes infer from the device count,
+a fully-specified product smaller than the host's device count takes a
+leading subset — ``dp=4`` on an 8-device host is legal). :meth:`apply`
+places initialized Gluon parameters (and their grad buffers) via
+``parallel.mesh.shard_params`` with :meth:`spec_for` as the spec_fn.
+
+Spec-rule precedence (docs/sharding.md):
+  1. ``spec_fn(name, shape)`` — a non-None return wins outright;
+  2. the first matching regex in ``rules`` (searched, in order);
+  3. replicated (``PartitionSpec()``) — the bitwise-identical default,
+     so a plan with no rules is exactly data parallelism.
+
+``mode()`` is the ONE normalization of MXTPU_SHARDING — Trainer's plan
+resolution and the pass-pipeline injection both read it, so a value
+that resolves no plan here also injects no ShardingPass there:
+
+  off   the subsystem is disabled: ``mesh=`` arguments and MXTPU_MESH
+        are ignored, nothing is placed — bitwise-identical to main;
+  auto  (default) a plan comes from explicit Trainer arguments, else
+        from the MXTPU_MESH env spelling;
+  plan  explicit arguments only — MXTPU_MESH is ignored, so a launch
+        script's env mesh cannot override a hand-built plan.
+
+Checkpoint contract: :meth:`to_manifest`/:meth:`from_manifest`
+round-trip the plan as JSON (``spec_fn`` is recorded as a flag only —
+callables don't serialize); ``checkpoint/snapshot.py`` stores it in
+every manifest and re-places restored arrays onto the RESTORING
+trainer's plan, so replicated↔dp↔dp×tp moves are just save + restore.
+"""
+from __future__ import annotations
+
+import re
+
+from jax.sharding import Mesh, PartitionSpec
+
+from .. import env as _env
+from ..parallel.mesh import ShardingError, make_mesh
+from ..parallel.mesh import shard_params as _shard_params
+from ..telemetry import instruments as _telemetry
+
+__all__ = ["ShardingPlan", "ShardingError", "mode", "parse_axes",
+           "resolve_plan", "last_applied"]
+
+# same normalization table discipline as layout/kernels/numerics mode():
+# the ONE place MXTPU_SHARDING is interpreted
+_MODES = {
+    "": "off", "0": "off", "off": "off", "false": "off", "no": "off",
+    "none": "off",
+    "1": "auto", "auto": "auto", "on": "auto", "true": "auto",
+    "yes": "auto",
+    "plan": "plan", "explicit": "plan",
+}
+
+
+def mode():
+    """Resolved MXTPU_SHARDING mode: 'off' | 'auto' | 'plan'."""
+    raw = str(_env.get("MXTPU_SHARDING")).strip().lower()
+    try:
+        return _MODES[raw]
+    except KeyError:
+        raise ValueError(
+            f"MXTPU_SHARDING={raw!r} is not a recognized mode; expected "
+            f"off | auto | plan") from None
+
+
+def parse_axes(spec):
+    """Normalize a mesh-axes spelling to (("name", size), ...).
+
+    Accepts the MXTPU_MESH string form ('dp=-1', 'dp=4,tp=2'), a dict,
+    or a sequence of (name, size) pairs. Sizes must be positive ints or
+    -1 (infer from device count); anything else raises ShardingError.
+    """
+    if isinstance(spec, str):
+        pairs = []
+        for part in spec.split(","):
+            part = part.strip()
+            if not part:
+                continue
+            if "=" not in part:
+                raise ShardingError(
+                    f"mesh axis {part!r} is not 'name=size' "
+                    f"(MXTPU_MESH spelling, e.g. 'dp=-1' or 'dp=4,tp=2')")
+            name, _, size = part.partition("=")
+            try:
+                size = int(size.strip())
+            except ValueError:
+                raise ShardingError(
+                    f"mesh axis {part!r}: size {size.strip()!r} is not "
+                    f"an integer") from None
+            pairs.append((name.strip(), size))
+    elif isinstance(spec, dict):
+        pairs = list(spec.items())
+    else:
+        pairs = [(str(n), int(s)) for n, s in spec]
+    if not pairs:
+        raise ShardingError("mesh spec names no axes")
+    seen = set()
+    for name, size in pairs:
+        if not name:
+            raise ShardingError("mesh axis with an empty name")
+        if name in seen:
+            raise ShardingError(f"mesh axis {name!r} appears twice")
+        seen.add(name)
+        if size != -1 and size < 1:
+            raise ShardingError(
+                f"mesh axis {name!r}: size must be a positive int or -1 "
+                f"(infer), got {size}")
+    return tuple((str(n), int(s)) for n, s in pairs)
+
+
+def _as_spec(entry):
+    """A rule's spec spelling -> PartitionSpec: already a spec, None
+    (replicated), or a sequence of axis-name/None entries."""
+    if entry is None:
+        return PartitionSpec()
+    if isinstance(entry, PartitionSpec):
+        return entry
+    return PartitionSpec(*entry)
+
+
+# last applied plan + its param table — observability state only
+# (tools/diagnose.py --passes reads it); pass injection never consults
+# this, it is driven by the PassContext's own plan field
+_LAST_APPLIED = [None]
+
+
+def last_applied():
+    """{'plan': manifest, 'params': [...]} of the most recent
+    :meth:`ShardingPlan.apply` in this process, or None."""
+    return _LAST_APPLIED[0]
+
+
+class ShardingPlan:
+    """Mesh axes + per-parameter placement rules (docs/sharding.md)."""
+
+    def __init__(self, axes, rules=None, spec_fn=None, batch_axis=None,
+                 devices=None):
+        self.axes = parse_axes(axes)
+        self.rules = tuple(
+            (str(pat), _as_spec(spec)) for pat, spec in (rules or ()))
+        self.spec_fn = spec_fn
+        # the data-parallel axis batches shard over; default: first axis
+        self.batch_axis = str(batch_axis) if batch_axis is not None \
+            else self.axes[0][0]
+        if self.batch_axis not in {n for n, _ in self.axes}:
+            raise ShardingError(
+                f"batch_axis {self.batch_axis!r} is not a mesh axis "
+                f"(mesh has {tuple(n for n, _ in self.axes)})")
+        self._devices = list(devices) if devices is not None else None
+        self._mesh = None
+        self._compiled_rules = [(re.compile(pat), spec)
+                                for pat, spec in self.rules]
+
+    # -- construction helpers ---------------------------------------------
+    @classmethod
+    def parse(cls, spec, **kw):
+        """Plan from the MXTPU_MESH axis-spec string ('dp=4,tp=2')."""
+        return cls(parse_axes(spec), **kw)
+
+    @classmethod
+    def from_env(cls):
+        """Plan from MXTPU_MESH, or None when the env names no mesh."""
+        raw = str(_env.get("MXTPU_MESH")).strip()
+        if not raw:
+            return None
+        return cls.parse(raw)
+
+    @classmethod
+    def from_manifest(cls, d):
+        """Inverse of :meth:`to_manifest`. The spec_fn flag is restored
+        as None — callables don't serialize; rules and axes round-trip
+        exactly."""
+        if d is None:
+            return None
+        return cls(
+            tuple((str(n), int(s)) for n, s in d["axes"]),
+            rules=[(pat, tuple(e if e is None else str(e) for e in spec))
+                   for pat, spec in d.get("rules") or ()],
+            batch_axis=d.get("batch_axis"))
+
+    def to_manifest(self):
+        """JSON-able plan record for checkpoint manifests: axes with
+        their RESOLVED sizes when a mesh was built (so a dp=-1 plan
+        saved on 4 devices restores knowing it meant dp=4), raw sizes
+        otherwise."""
+        axes = self.axes if self._mesh is None else \
+            tuple(self._mesh.shape.items())
+        return {
+            "axes": [[n, int(s)] for n, s in axes],
+            "rules": [[pat, [None if e is None else str(e) for e in spec]]
+                      for pat, spec in self.rules],
+            "batch_axis": self.batch_axis,
+            "spec_fn": self.spec_fn is not None,
+        }
+
+    # -- mesh --------------------------------------------------------------
+    @property
+    def mesh(self):
+        """The built jax Mesh (lazy; -1 sizes infer from device count)."""
+        if self._mesh is None:
+            import jax
+
+            devices = self._devices
+            if devices is None:
+                devices = list(jax.devices())
+                product = 1
+                fixed = all(s != -1 for _, s in self.axes)
+                for _, s in self.axes:
+                    if s != -1:
+                        product *= s
+                if fixed and product < len(devices):
+                    # dp=4 on an 8-device host: take the leading subset
+                    devices = devices[:product]
+            self._mesh = make_mesh(dict(self.axes), devices)
+        return self._mesh
+
+    def axis_sizes(self):
+        """{axis: resolved size} — builds the mesh if needed."""
+        return dict(self.mesh.shape)
+
+    def process_coords(self):
+        """This process's coordinates on the mesh: the position of its
+        first local device, as {axis: index}. Single-process meshes are
+        at the origin by construction."""
+        import jax
+        import numpy as _np
+
+        mesh = self.mesh
+        local = {id(d) for d in jax.local_devices()}
+        ids = _np.vectorize(id)(mesh.devices)
+        for idx in _np.ndindex(mesh.devices.shape):
+            if ids[idx] in local:
+                return {ax: int(i) for ax, i in zip(mesh.axis_names, idx)}
+        return {ax: 0 for ax in mesh.axis_names}
+
+    # -- specs -------------------------------------------------------------
+    def spec_for(self, name, shape=None):
+        """PartitionSpec for one parameter: spec_fn beats the first
+        matching rule beats replicated."""
+        if self.spec_fn is not None:
+            spec = self.spec_fn(name, shape)
+            if spec is not None:
+                return _as_spec(spec)
+        for pat, spec in self._compiled_rules:
+            if pat.search(name):
+                return spec
+        return PartitionSpec()
+
+    def data_spec(self):
+        """PartitionSpec for an input batch (leading dim over the data
+        axis)."""
+        return PartitionSpec(self.batch_axis)
+
+    def shards_params(self, names_shapes):
+        """True when any of (name, shape) pairs resolves to a
+        non-replicated spec — the tensor-parallel case the whole-step
+        shard_map path cannot host (its in_specs replicate params; XLA's
+        GSPMD path carries tp instead)."""
+        return any(self.spec_for(n, s) != PartitionSpec()
+                   for n, s in names_shapes)
+
+    # -- application -------------------------------------------------------
+    def apply(self, params, label="plan"):
+        """Place initialized params (+ grads) per this plan; returns the
+        mesh. Records the plan table for tools/diagnose.py, bumps
+        sharding_plan_applied_total / the per-axis mesh gauges, and
+        stamps the mesh shape + this rank's coordinates into the
+        flight-recorder identity (tools/fleetctl.py's mesh column)."""
+        mesh = self.mesh
+        _shard_params(params, mesh, spec_fn=self.spec_for)
+        n_dev = mesh.devices.size
+        table = []
+        for name, p in sorted(params.items()):
+            spec = self.spec_for(name, p.shape)
+            factor = 1
+            for entry in spec:
+                for ax in (entry if isinstance(entry, tuple)
+                           else (entry,)) if entry is not None else ():
+                    factor *= mesh.shape[ax]
+            nbytes = _telemetry.nbytes_of(p.data()._data)
+            table.append({"param": name, "spec": str(spec),
+                          "bytes_per_device": nbytes // max(factor, 1)})
+        _LAST_APPLIED[0] = {"plan": self.to_manifest(),
+                            "mesh": dict(mesh.shape),
+                            "devices": int(n_dev),
+                            "params": table}
+        _telemetry.record_sharding_apply(label, dict(mesh.shape),
+                                         params=len(table))
+        try:
+            from ..observability import flight as _flight
+
+            _flight.set_identity(mesh=dict(mesh.shape),
+                                 coords=self.process_coords())
+        except Exception:
+            pass
+        return mesh
+
+    # -- misc --------------------------------------------------------------
+    def __eq__(self, other):
+        return (isinstance(other, ShardingPlan)
+                and self.axes == other.axes
+                and self.rules == other.rules
+                and self.batch_axis == other.batch_axis
+                and self.spec_fn is other.spec_fn)
+
+    def __hash__(self):
+        return hash((self.axes, self.rules, self.batch_axis))
+
+    def __repr__(self):
+        ax = ",".join(f"{n}={s}" for n, s in self.axes)
+        extra = f", rules={len(self.rules)}" if self.rules else ""
+        extra += ", spec_fn" if self.spec_fn is not None else ""
+        return f"ShardingPlan({ax}{extra})"
+
+
+def resolve_plan(explicit=None):
+    """The one plan-resolution rule Trainer uses (mirrors the
+    numerics/kernels/layout one-normalization contract):
+
+      mode 'off'   -> None, always (mesh= and MXTPU_MESH both ignored);
+      mode 'auto'  -> the explicit argument, else MXTPU_MESH, else None;
+      mode 'plan'  -> the explicit argument only.
+
+    ``explicit`` may be a ShardingPlan, a built jax Mesh (wrapped with
+    replicated rules and its own axis names), or any axes spelling
+    parse_axes accepts.
+    """
+    if mode() == "off":
+        return None
+    plan = explicit
+    if plan is not None and not isinstance(plan, ShardingPlan):
+        if isinstance(plan, Mesh):
+            wrapped = ShardingPlan(dict(plan.shape),
+                                   devices=plan.devices.flatten())
+            wrapped._mesh = plan
+            plan = wrapped
+        else:
+            plan = ShardingPlan(plan)
+    if plan is None and mode() == "auto":
+        plan = ShardingPlan.from_env()
+    return plan

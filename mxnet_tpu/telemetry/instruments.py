@@ -50,6 +50,9 @@ __all__ = [
     "record_kernel_dispatch",
     "layout_rewrite_total", "layout_transpose_total",
     "record_layout_rewrite",
+    "sharding_plan_applied_total", "sharding_mesh_axis_size",
+    "sharding_pass_stamp_total",
+    "record_sharding_apply", "record_sharding_stamp",
 ]
 
 # v5e-class bf16 peak, the default MFU denominator (tools/perf_lab.py's
@@ -322,6 +325,45 @@ layout_transpose_total = counter(
     "avoided relative to the naive per-op channels-last rewrite "
     "(cancelled transpose pairs + absorbed pre-existing transposes)",
     ["origin"])
+
+
+# -- sharding (mxnet_tpu/sharding; docs/sharding.md) ------------------------
+sharding_plan_applied_total = counter(
+    "sharding_plan_applied_total",
+    "ShardingPlan.apply placements: every param (+grad) laid out on the "
+    "plan's mesh via NamedSharding — once per trainer, re-counted after "
+    "a checkpoint restore re-places arrays", ["label"])
+sharding_mesh_axis_size = gauge(
+    "sharding_mesh_axis_size",
+    "Resolved size of each mesh axis of the most recently applied plan "
+    "(-1 specs shown post-inference, so dp=-1 on 8 devices reads 8)",
+    ["axis"])
+sharding_pass_stamp_total = counter(
+    "sharding_pass_stamp_total",
+    "ShardingPass stamps: one per pipeline build whose context carried "
+    "a plan (per seam kind) — accumulated at trace time like "
+    "layout_rewrite_total, never per step", ["label", "kind"])
+
+
+def record_sharding_apply(label, axis_sizes, params=0):
+    """One plan application: `axis_sizes` is the resolved {axis: size}
+    mesh shape, `params` the number of parameters placed.  Mirrored to
+    the flight recorder so postmortems show which plan a run trained
+    under."""
+    _flight_record("sharding_apply", label=str(label),
+                   mesh=dict(axis_sizes), params=int(params))
+    if not REGISTRY.enabled:
+        return
+    sharding_plan_applied_total.labels(label).inc()
+    for axis, size in axis_sizes.items():
+        sharding_mesh_axis_size.labels(str(axis)).set(int(size))
+
+
+def record_sharding_stamp(label, kind):
+    """One ShardingPass stamp on a pipeline build."""
+    if not REGISTRY.enabled:
+        return
+    sharding_pass_stamp_total.labels(label, kind).inc()
 
 
 def record_numerics_trip(label):

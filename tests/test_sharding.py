@@ -1,0 +1,518 @@
+"""Hybrid-parallelism subsystem (mxnet_tpu/sharding; ISSUE 14,
+docs/sharding.md): plan construction / MXTPU_MESH-MXTPU_SHARDING
+normalization, -1 axis inference and typed divisibility errors, spec
+rule precedence, the Trainer(mesh=...) whole-step path on an 8-device
+CPU mesh (loss parity vs single device per dtype, one dispatch, zero
+retraces, donation), the mesh=None kill switch (bitwise, ShardingPass
+never injected), checkpoint resharding (dp4 save -> replicated restore
+bitwise, restore onto a plan re-places), and the promoted eager
+dryrun_multichip parity harness."""
+import numpy as onp
+import pytest
+
+import jax
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+import mxnet_tpu as mx
+from mxnet_tpu import autograd as ag
+from mxnet_tpu import gluon, np as mnp, sharding, telemetry
+from mxnet_tpu.sharding import ShardingError, ShardingPlan
+from mxnet_tpu.telemetry import instruments as ti
+
+BATCH, FEATS, OUT = 16, 12, 4
+
+
+# -- plan construction / env normalization -----------------------------------
+
+def test_parse_axes_spellings():
+    want = (("dp", 4), ("tp", 2))
+    assert sharding.parse_axes("dp=4,tp=2") == want
+    assert sharding.parse_axes("dp=4, tp=2") == want
+    assert sharding.parse_axes({"dp": 4, "tp": 2}) == want
+    assert sharding.parse_axes((("dp", 4), ("tp", 2))) == want
+    assert sharding.parse_axes("dp=-1") == (("dp", -1),)
+    with pytest.raises(ShardingError, match="name=size"):
+        sharding.parse_axes("dp")
+    with pytest.raises(ShardingError, match="not an integer"):
+        sharding.parse_axes("dp=x")
+    with pytest.raises(ShardingError, match="appears twice"):
+        sharding.parse_axes("dp=2,dp=2")
+    with pytest.raises(ShardingError, match="names no axes"):
+        sharding.parse_axes("")
+    with pytest.raises(ShardingError, match="positive int or -1"):
+        sharding.parse_axes("dp=0")
+
+
+def test_mode_normalization(monkeypatch):
+    for raw, want in [("off", "off"), ("0", "off"), ("false", "off"),
+                      ("none", "off"), ("", "off"),
+                      ("auto", "auto"), ("1", "auto"), ("on", "auto"),
+                      ("AUTO", "auto"),
+                      ("plan", "plan"), ("explicit", "plan")]:
+        monkeypatch.setenv("MXTPU_SHARDING", raw)
+        assert sharding.mode() == want, raw
+    monkeypatch.setenv("MXTPU_SHARDING", "sideways")
+    with pytest.raises(ValueError, match="MXTPU_SHARDING='sideways'"):
+        sharding.mode()
+
+
+def test_mesh_inference_and_device_subset():
+    assert ShardingPlan("dp=-1").axis_sizes() == {"dp": 8}
+    assert ShardingPlan("dp=-1,tp=2").axis_sizes() == {"dp": 4, "tp": 2}
+    # fully specified below the device count: leading subset, not error
+    sub = ShardingPlan("dp=4")
+    assert sub.axis_sizes() == {"dp": 4}
+    assert sub.mesh.devices.size == 4
+    with pytest.raises(ShardingError, match="devices"):
+        ShardingPlan("dp=3,tp=-1").mesh  # 8 % 3
+
+
+def test_plan_batch_axis_validation():
+    assert ShardingPlan("dp=-1,tp=2").batch_axis == "dp"
+    assert ShardingPlan("dp=-1,tp=2", batch_axis="tp").batch_axis == "tp"
+    with pytest.raises(ShardingError, match="batch_axis"):
+        ShardingPlan("dp=-1", batch_axis="sp")
+
+
+def test_spec_rule_precedence():
+    plan = ShardingPlan(
+        "dp=4,tp=2",
+        rules=[(r".*weight", ("tp", None)), (r".*", None)],
+        spec_fn=lambda name, shape: P(None, "tp")
+        if "special" in name else None)
+    # spec_fn wins outright when it returns non-None
+    assert plan.spec_for("special.weight", (8, 4)) == P(None, "tp")
+    # first matching regex next (order matters: .*weight before .*)
+    assert plan.spec_for("dense0.weight", (8, 4)) == P("tp", None)
+    # catch-all rule spelled None -> replicated
+    assert plan.spec_for("dense0.bias", (8,)) == P()
+    # no rules at all -> replicated default
+    assert ShardingPlan("dp=-1").spec_for("anything", (3,)) == P()
+    assert plan.shards_params([("dense0.weight", (8, 4))])
+    assert not plan.shards_params([("dense0.bias", (8,))])
+
+
+def test_resolve_plan_modes(monkeypatch):
+    monkeypatch.setenv("MXTPU_SHARDING", "off")
+    assert sharding.resolve_plan((("dp", -1),)) is None
+    monkeypatch.setenv("MXTPU_SHARDING", "auto")
+    assert sharding.resolve_plan(None) is None  # no env mesh, no explicit
+    monkeypatch.setenv("MXTPU_MESH", "dp=4,tp=2")
+    p = sharding.resolve_plan(None)
+    assert p is not None and p.axes == (("dp", 4), ("tp", 2))
+    # explicit beats env
+    assert sharding.resolve_plan("dp=-1").axes == (("dp", -1),)
+    # plan mode: env mesh ignored
+    monkeypatch.setenv("MXTPU_SHARDING", "plan")
+    assert sharding.resolve_plan(None) is None
+    assert sharding.resolve_plan("dp=2").axes == (("dp", 2),)
+    # a built jax Mesh wraps, keeping its own axis names/devices
+    monkeypatch.setenv("MXTPU_SHARDING", "auto")
+    from mxnet_tpu.parallel import make_mesh
+    wrapped = sharding.resolve_plan(make_mesh({"data": -1}))
+    assert wrapped.axis_sizes() == {"data": 8}
+    assert wrapped.batch_axis == "data"
+
+
+def test_manifest_roundtrip():
+    plan = ShardingPlan("dp=-1,tp=2",
+                        rules=[(r".*weight", ("tp", None))])
+    plan.mesh  # resolve -1 so the manifest records real sizes
+    d = plan.to_manifest()
+    assert d["axes"] == [["dp", 4], ["tp", 2]]
+    back = ShardingPlan.from_manifest(d)
+    assert back.axes == (("dp", 4), ("tp", 2))
+    assert back.rules == plan.rules
+    assert back.batch_axis == "dp"
+    assert ShardingPlan.from_manifest(None) is None
+
+
+# -- shard_params satellite fix ----------------------------------------------
+
+def test_shard_params_divisibility_error_names_param_and_spec():
+    from mxnet_tpu.parallel import shard_params
+
+    net = gluon.nn.Dense(6, in_units=5)  # 6 % 4 != 0
+    net.initialize()
+    mesh = ShardingPlan("dp=4,tp=2").mesh
+    with pytest.raises(ShardingError) as ei:
+        shard_params(net.collect_params(), mesh,
+                     spec_fn=lambda n, s: P("dp") if "weight" in n
+                     else None)
+    msg = str(ei.value)
+    assert "weight" in msg and "dp" in msg and "(6, 5)" in msg
+    with pytest.raises(ShardingError, match="mesh has axes"):
+        shard_params(net.collect_params(), mesh,
+                     spec_fn=lambda n, s: P("nope"))
+
+
+def test_shard_params_accepts_axes_spec():
+    from mxnet_tpu.parallel import shard_params
+
+    net = gluon.nn.Dense(8, in_units=4)
+    net.initialize()
+    mesh = shard_params(net.collect_params(), {"dp": -1})
+    assert dict(mesh.shape) == {"dp": 8}
+    w = net.collect_params()["weight"].data()._data
+    assert w.sharding.is_equivalent_to(NamedSharding(mesh, P()), w.ndim)
+
+
+# -- whole-step training on a mesh -------------------------------------------
+
+def _data(steps, dtype="float32"):
+    r = onp.random.RandomState(3)
+    xs = [mnp.array(r.standard_normal((BATCH, FEATS)).astype("float32"),
+                    dtype=dtype) for _ in range(steps)]
+    ys = [mnp.array(r.standard_normal((BATCH, OUT)).astype("float32"),
+                    dtype=dtype) for _ in range(steps)]
+    return xs, ys
+
+
+def _run_trainer_mesh(mesh, steps=5, dtype=None, kvstore="tpu_dist"):
+    """Train a hybridized block via Trainer(mesh=...) + TrainStep;
+    returns (losses, final params, step object, trainer)."""
+    mx.seed(0)
+    net = gluon.nn.HybridSequential()
+    net.add(gluon.nn.Dense(16, activation="relu"), gluon.nn.Dense(OUT))
+    net.initialize()
+    if dtype:
+        net.cast(dtype)
+    net.hybridize()
+    loss_fn = gluon.loss.L2Loss()
+    trainer = gluon.Trainer(net.collect_params(), "sgd",
+                            {"learning_rate": 0.1, "momentum": 0.9},
+                            kvstore=kvstore, mesh=mesh)
+    step = gluon.TrainStep(net, loss_fn, trainer)
+    xs, ys = _data(steps, dtype=dtype or "float32")
+    mx.seed(99)
+    losses = []
+    for k in range(steps):
+        losses.append(step(xs[k], ys[k]).asnumpy().astype("float32"))
+    params = {n: p.data().asnumpy().copy()
+              for n, p in sorted(net.collect_params().items())}
+    return losses, params, step, trainer
+
+
+@pytest.mark.parametrize("dtype,rtol,atol", [
+    (None, 1e-5, 1e-6),          # fp32
+    ("float16", 2e-3, 2e-3),
+])
+def test_trainer_mesh_whole_step_parity(dtype, rtol, atol):
+    """Acceptance: Trainer(kvstore='tpu_dist', mesh=(('dp', -1),))
+    trains through the donated whole-step path on the 8-device CPU mesh
+    with loss matching single-device training."""
+    l_mesh, p_mesh, step, trainer = _run_trainer_mesh((("dp", -1),),
+                                                      dtype=dtype)
+    assert step.last_path == "whole_step", step.ineligible_reason()
+    assert trainer.sharding_plan is not None
+    assert trainer.sharding_plan.axis_sizes() == {"dp": 8}
+    l_one, p_one, step1, _ = _run_trainer_mesh(None, dtype=dtype,
+                                               kvstore=None)
+    assert step1.last_path == "whole_step"
+    for a, b in zip(l_mesh, l_one):
+        onp.testing.assert_allclose(a, b, rtol=rtol, atol=atol)
+    for n in p_one:
+        onp.testing.assert_allclose(p_mesh[n], p_one[n],
+                                    rtol=rtol, atol=atol, err_msg=n)
+
+
+def test_mesh_one_dispatch_zero_retrace():
+    """ONE whole-step dispatch per step and zero retraces after warmup
+    over 5 steps on the dp8 mesh."""
+    mx.seed(0)
+    net = gluon.nn.HybridSequential()
+    net.add(gluon.nn.Dense(16, activation="relu"), gluon.nn.Dense(OUT))
+    net.initialize()
+    net.hybridize()
+    trainer = gluon.Trainer(net.collect_params(), "sgd",
+                            {"learning_rate": 0.1, "momentum": 0.9},
+                            kvstore="tpu_dist", mesh=(("dp", -1),))
+    step = gluon.TrainStep(net, gluon.loss.L2Loss(), trainer)
+    xs, ys = _data(5)
+    telemetry.enable()
+    try:
+        per_step, traces = [], []
+        for k in range(5):
+            trainer.set_learning_rate(0.1 / (k + 1))
+            d0 = ti.step_dispatch_total.labels("whole_step").value
+            t0 = step.jit_trace_count()
+            step(xs[k], ys[k])
+            per_step.append(
+                ti.step_dispatch_total.labels("whole_step").value - d0)
+            traces.append(step.jit_trace_count() - t0)
+        assert per_step == [1] * 5, per_step
+        assert traces[0] == 1 and traces[1:] == [0] * 4, traces
+    finally:
+        telemetry.disable()
+
+
+def test_mesh_donation_reuses_buffers(monkeypatch):
+    """Params and optimizer state donate into the sharded step dispatch:
+    old buffers die and the donated-bytes counter advances."""
+    monkeypatch.setenv("MXTPU_DONATE_UPDATE", "1")
+    mx.seed(0)
+    net = gluon.nn.HybridSequential()
+    net.add(gluon.nn.Dense(16, activation="relu"), gluon.nn.Dense(OUT))
+    net.initialize()
+    net.hybridize()
+    trainer = gluon.Trainer(net.collect_params(), "sgd",
+                            {"learning_rate": 0.1, "momentum": 0.9},
+                            kvstore="tpu_dist", mesh=(("dp", -1),))
+    step = gluon.TrainStep(net, gluon.loss.L2Loss(), trainer)
+    xs, ys = _data(2)
+    step(xs[0], ys[0])  # build + first dispatch
+    assert step.last_path == "whole_step", step.ineligible_reason()
+    telemetry.enable()
+    try:
+        old = [p.data()._data for p in net.collect_params().values()]
+        before = ti.step_donated_bytes.value
+        step(xs[1], ys[1])
+        assert ti.step_donated_bytes.value > before
+        assert all(o.is_deleted() for o in old)
+    finally:
+        telemetry.disable()
+
+
+def test_mesh_none_kill_switch_bitwise(monkeypatch):
+    """MXTPU_SHARDING=off ignores mesh= entirely: the run is BITWISE
+    identical to mesh=None, the trainer resolves no plan, and the
+    ShardingPass is never injected."""
+    monkeypatch.setenv("MXTPU_SHARDING", "off")
+    l_off, p_off, step_off, tr_off = _run_trainer_mesh((("dp", -1),))
+    assert tr_off.sharding_plan is None
+    monkeypatch.delenv("MXTPU_SHARDING")
+    l_none, p_none, step_none, tr_none = _run_trainer_mesh(None)
+    assert tr_none.sharding_plan is None
+    for a, b in zip(l_off, l_none):
+        onp.testing.assert_array_equal(a, b)
+    for n in p_none:
+        onp.testing.assert_array_equal(p_off[n], p_none[n]), n
+
+
+def test_sharding_pass_injection_follows_plan():
+    """resolve_passes injects the ShardingPass exactly when the context
+    carries a plan — plan=None (mesh=None) never sees it."""
+    from mxnet_tpu import passes
+
+    ctx = passes.PassContext(label="t", kind="whole_step", training=True)
+    assert not any(p.name == "sharding"
+                   for p in passes.resolve_passes(ctx))
+    ctx = passes.PassContext(label="t", kind="whole_step", training=True,
+                             plan=ShardingPlan("dp=-1"))
+    names = [p.name for p in passes.resolve_passes(ctx)]
+    assert "sharding" in names
+    # kind the pass doesn't claim: filtered out even with a plan
+    ctx = passes.PassContext(label="t", kind="export",
+                             plan=ShardingPlan("dp=-1"))
+    assert not any(p.name == "sharding"
+                   for p in passes.resolve_passes(ctx))
+
+
+def test_pass_context_shardings_forwarded():
+    """PassContext.in_shardings/out_shardings reach jax.jit: the
+    compiled output lands with the requested NamedSharding."""
+    from mxnet_tpu import passes
+
+    plan = ShardingPlan("dp=-1")
+    shd = NamedSharding(plan.mesh, P("dp"))
+    fn = passes.apply_pipeline(
+        lambda x: x * 2.0, [],
+        passes.PassContext(label="t", in_shardings=(shd,),
+                           out_shardings=shd))
+    out = fn(onp.ones((8, 4), onp.float32))
+    assert out.sharding.is_equivalent_to(shd, out.ndim)
+
+
+def test_tensor_sharded_plan_routes_phased():
+    """A plan that tensor-shards params is whole-step-ineligible (typed
+    reason) and trains through the phased/GSPMD path instead."""
+    mx.seed(0)
+    net = gluon.nn.HybridSequential()
+    net.add(gluon.nn.Dense(16, activation="relu"), gluon.nn.Dense(OUT))
+    net.initialize()
+    net.hybridize()
+    plan = ShardingPlan("dp=4,tp=2",
+                        rules=[(r"0\.weight", (None, "tp"))])
+    trainer = gluon.Trainer(net.collect_params(), "sgd",
+                            {"learning_rate": 0.1},
+                            kvstore="tpu_dist", sharding_plan=plan)
+    step = gluon.TrainStep(net, gluon.loss.L2Loss(), trainer)
+    xs, ys = _data(2)
+    loss = step(xs[0], ys[0])
+    assert step.last_path == "phased"
+    assert "tensor-shards" in step.ineligible_reason()
+    assert onp.isfinite(loss.asnumpy()).all()
+    # the tp-sharded weight really is laid out on the mesh
+    w = net.collect_params()["0.weight"].data()._data
+    assert w.sharding.is_equivalent_to(
+        NamedSharding(plan.mesh, P(None, "tp")), w.ndim)
+
+
+# -- promoted dryrun_multichip eager harness ---------------------------------
+
+def test_eager_mesh_parity_conv_bn():
+    """The dryrun_multichip user path, promoted: conv+BN model trained
+    eagerly with Trainer(kvstore='tpu_dist', mesh=...) over dp8 matches
+    single-device training numerically."""
+    def build_and_train(mesh):
+        mx.seed(0)
+        net = gluon.nn.HybridSequential()
+        net.add(gluon.nn.Conv2D(8, 3, padding=1),
+                gluon.nn.BatchNorm(),
+                gluon.nn.Activation("relu"),
+                gluon.nn.GlobalAvgPool2D(),
+                gluon.nn.Flatten(),
+                gluon.nn.Dense(32, activation="relu"),
+                gluon.nn.Dense(16))
+        net.initialize()
+        net.hybridize()
+        xb = onp.random.RandomState(0).rand(8, 3, 8, 8).astype("float32")
+        yb = onp.random.RandomState(1).randint(
+            0, 16, (8,)).astype("int32")
+        x, y = mx.np.array(xb), mx.np.array(yb)
+        net(x)  # finish deferred init
+        trainer = gluon.Trainer(net.collect_params(), "sgd",
+                                {"learning_rate": 0.1, "momentum": 0.9},
+                                kvstore="tpu_dist" if mesh else None,
+                                mesh=mesh)
+        if mesh:
+            from mxnet_tpu.parallel import shard_batch
+
+            trainer._maybe_apply_plan()
+            m = trainer.sharding_plan.mesh
+            x = shard_batch(x, m, "dp")
+            y = shard_batch(y, m, "dp")
+        lossfn = gluon.loss.SoftmaxCrossEntropyLoss()
+        for _ in range(2):
+            with ag.record():
+                loss = lossfn(net(x), y)
+            loss.backward()
+            trainer.step(8)
+        return ({n: p.data().asnumpy() for n, p in
+                 net.collect_params().items()},
+                float(loss.mean().asnumpy()))
+
+    p_mesh, l_mesh = build_and_train((("dp", -1),))
+    p_one, l_one = build_and_train(None)
+    assert onp.isfinite(l_mesh)
+    for n in p_mesh:
+        onp.testing.assert_allclose(
+            p_mesh[n], p_one[n], rtol=2e-4, atol=2e-5, err_msg=n)
+
+
+# -- checkpoint resharding ---------------------------------------------------
+
+def test_checkpoint_dp4_to_replicated_bitwise(tmp_path):
+    """A dp=4 checkpoint restores onto a replicated (mesh-less) run
+    bitwise, and the manifest records the plan."""
+    from mxnet_tpu.checkpoint import CheckpointManager, verify_checkpoint
+
+    l4, p4, step4, tr4 = _run_trainer_mesh((("dp", 4),), steps=3)
+    assert step4.last_path == "whole_step", step4.ineligible_reason()
+    mgr = CheckpointManager(tmp_path, tr4)
+    mgr.save(step=3)
+    mgr.flush()
+    report = verify_checkpoint(tmp_path)
+    assert report["ok"], report["errors"]
+    assert report["sharding_plan"]["axes"] == [["dp", 4]]
+
+    # fresh mesh-less trainer, same architecture: restore must land the
+    # dp4 params bit-for-bit (arrays are host-gathered at capture)
+    mx.seed(1234)  # different init — restore must overwrite it
+    net = gluon.nn.HybridSequential()
+    net.add(gluon.nn.Dense(16, activation="relu"), gluon.nn.Dense(OUT))
+    net.initialize()
+    net.hybridize()
+    xs, _ys = _data(1)
+    net(xs[0])
+    trainer = gluon.Trainer(net.collect_params(), "sgd",
+                            {"learning_rate": 0.1, "momentum": 0.9})
+    assert trainer.sharding_plan is None
+    res = CheckpointManager(tmp_path, trainer).restore()
+    assert res.step == 3
+    got = {n: p.data().asnumpy()
+           for n, p in sorted(net.collect_params().items())}
+    for n in p4:
+        onp.testing.assert_array_equal(got[n], p4[n]), n
+
+
+def test_checkpoint_restore_onto_plan_replaces(tmp_path):
+    """The inverse move: a replicated checkpoint restored into a
+    plan-carrying trainer comes back placed on the plan's mesh."""
+    from mxnet_tpu.checkpoint import CheckpointManager
+
+    l1, p1, _step, tr1 = _run_trainer_mesh(None, steps=2, kvstore=None)
+    mgr = CheckpointManager(tmp_path, tr1)
+    mgr.save(step=2)
+    mgr.flush()
+
+    l8, p8, step8, tr8 = _run_trainer_mesh((("dp", -1),), steps=2)
+    CheckpointManager(tmp_path, tr8).restore()
+    mesh = tr8.sharding_plan.mesh
+    rep = NamedSharding(mesh, P())
+    for p in tr8._params:
+        arr = p.data()._data
+        assert arr.sharding.is_equivalent_to(rep, arr.ndim), p.name
+        g = p.grad()._data
+        assert g.sharding.is_equivalent_to(rep, g.ndim), p.name
+    got = {n: onp.asarray(p.data().asnumpy())
+           for n, p in zip(tr8._param_names, tr8._params)}
+    for n in p1:
+        onp.testing.assert_array_equal(got[n], p1[n]), n
+
+
+# -- observability -----------------------------------------------------------
+
+def test_plan_apply_telemetry_and_identity():
+    """ShardingPlan.apply bumps the applied counter + per-axis gauges,
+    records the diagnose table, and stamps mesh/coords into the
+    flight-recorder identity."""
+    from mxnet_tpu.observability import flight
+
+    net = gluon.nn.Dense(8, in_units=4)
+    net.initialize()
+    plan = ShardingPlan("dp=-1")
+    telemetry.enable()
+    try:
+        before = ti.sharding_plan_applied_total.labels("test").value
+        plan.apply(dict(net.collect_params()), label="test")
+        assert ti.sharding_plan_applied_total.labels("test").value \
+            == before + 1
+        assert ti.sharding_mesh_axis_size.labels("dp").value == 8
+    finally:
+        telemetry.disable()
+    la = sharding.last_applied()
+    assert la["mesh"] == {"dp": 8}
+    rows = {r["param"]: r for r in la["params"]}
+    assert "weight" in rows and rows["weight"]["spec"] == str(P())
+    assert rows["weight"]["bytes_per_device"] == 8 * 4 * 4
+    ident = flight.identity()
+    assert ident["mesh"] == {"dp": 8}
+    assert ident["coords"] == {"dp": 0}
+
+
+def test_diagnose_passes_report_has_sharding_section():
+    import importlib.util
+    import os
+
+    path = os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), "tools", "diagnose.py")
+    spec = importlib.util.spec_from_file_location("_diag", path)
+    diag = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(diag)
+    pr = diag._passes_report()
+    sh = pr["sharding"]
+    assert sh["mode"] in ("off", "auto", "plan")
+    assert "MXTPU_MESH" in sh["config"]
+    lines = "\n".join(diag._passes_report_lines(pr))
+    assert "sharding:" in lines
+
+
+def test_env_mesh_spelling(monkeypatch):
+    monkeypatch.setenv("MXTPU_MESH", "dp=-1")
+    plan = ShardingPlan.from_env()
+    assert plan is not None and plan.axes == (("dp", -1),)
+    monkeypatch.setenv("MXTPU_MESH", "")
+    assert ShardingPlan.from_env() is None
+    monkeypatch.setenv("MXTPU_MESH", "dp=4,tp=2")
+    assert ShardingPlan.from_env().axes == (("dp", 4), ("tp", 2))

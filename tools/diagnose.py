@@ -239,6 +239,27 @@ def _passes_report():
                            ti.layout_transpose_total.series()},
         },
         "executable_cache": passes.executable_cache_info(),
+        "sharding": _sharding_report(),
+    }
+
+
+def _sharding_report():
+    """Sharding-subsystem state: resolved env config, plan applications,
+    per-axis mesh sizes, and the most recently applied plan's param →
+    spec → bytes/device table (docs/sharding.md)."""
+    from mxnet_tpu import env as _env
+    from mxnet_tpu import sharding
+    from mxnet_tpu.telemetry import instruments as ti
+
+    return {
+        "config": {k: _env.get(k) for k in
+                   ("MXTPU_SHARDING", "MXTPU_MESH")},
+        "mode": sharding.mode(),
+        "applied": {labels[0]: int(c.value) for labels, c in
+                    ti.sharding_plan_applied_total.series()},
+        "mesh_axes": {labels[0]: int(g.value) for labels, g in
+                      ti.sharding_mesh_axis_size.series()},
+        "last_applied": sharding.last_applied(),
     }
 
 
@@ -265,6 +286,25 @@ def _passes_report_lines(pr):
     lines.append(f"  executable cache: {cache['entries']} entries, "
                  f"{cache['hits']} hits, {cache['misses']} misses, "
                  f"{cache['unhashable']} unshareable")
+    sh = pr.get("sharding") or {}
+    sh_cfg = " ".join(f"{k}={v!r}" for k, v in
+                      (sh.get("config") or {}).items())
+    lines.append(f"  sharding: {sh_cfg} mode={sh.get('mode')}")
+    for label, n in sorted((sh.get("applied") or {}).items()):
+        lines.append(f"    plan {label}: applied {n}x")
+    if sh.get("mesh_axes"):
+        axes = " ".join(f"{a}={n}" for a, n in
+                        sorted(sh["mesh_axes"].items()))
+        lines.append(f"    mesh axes: {axes}")
+    la = sh.get("last_applied")
+    if la:
+        lines.append(f"    last plan: mesh={la['mesh']} over "
+                     f"{la['devices']} device(s)")
+        lines.append("    param                                    "
+                     "spec                      bytes/device")
+        for row in la["params"]:
+            lines.append(f"    {row['param']:<40} {row['spec']:<25} "
+                         f"{row['bytes_per_device']:>12}")
     return lines
 
 

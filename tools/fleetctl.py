@@ -58,11 +58,12 @@ def poll_rank(endpoint, timeout=3.0):
     row = {"endpoint": endpoint, "health": "down", "ready": False,
            "rank": None, "job": None, "world": None, "last_step": None,
            "step_ms": None, "examples_per_s": None, "queue": None,
-           "error": None}
+           "mesh": None, "coords": None, "error": None}
     try:
         ident = _get(base, "/identity", timeout)
         row.update(rank=ident.get("rank"), job=ident.get("job"),
-                   world=ident.get("world"))
+                   world=ident.get("world"), mesh=ident.get("mesh"),
+                   coords=ident.get("coords"))
         hz = _get(base, "/healthz", timeout)
         row["health"] = hz.get("status", "ok")
         steps = _get(base, "/steps", timeout)
@@ -110,9 +111,22 @@ def annotate_stragglers(rows, skew=DEFAULT_SKEW):
     return rows
 
 
+def _mesh_cell(r):
+    """A rank's place on the device mesh, e.g. 'dp2,tp0 of dp=4,tp=2'
+    (ShardingPlan stamps mesh/coords into the flight identity)."""
+    mesh, coords = r.get("mesh"), r.get("coords")
+    if not mesh:
+        return "-"
+    shape = ",".join(f"{a}={n}" for a, n in mesh.items())
+    if not coords:
+        return shape
+    at = ",".join(f"{a}{i}" for a, i in coords.items())
+    return f"{at} of {shape}"
+
+
 def fleet_table(rows):
     hdr = ["rank", "endpoint", "health", "ready", "step", "step_ms",
-           "ex/s", "queue", ""]
+           "ex/s", "queue", "mesh", ""]
     table = [hdr]
     for r in sorted(rows, key=lambda r: (r["rank"] is None, r["rank"])):
         flag = "STRAGGLER" if r.get("straggler") else ""
@@ -129,6 +143,7 @@ def fleet_table(rows):
             "-" if r["step_ms"] is None else f"{r['step_ms']:.1f}",
             "-" if not r["examples_per_s"] else f"{r['examples_per_s']:.0f}",
             "-" if r["queue"] is None else str(r["queue"]),
+            _mesh_cell(r),
             flag,
         ])
     widths = [max(len(row[i]) for row in table)
