@@ -143,6 +143,22 @@ register(
     "serving.InferenceEngine default per-request deadline; requests "
     "not completed in time fail with serving.RequestTimeout.")
 register(
+    "MXTPU_SERVE_MODE", str, "pipelined",
+    "serving.InferenceEngine execution mode: 'pipelined' (assembler + "
+    "completer threads, host assembly overlaps device compute) or "
+    "'sync' (the serialized PR-3 baseline; docs/serving.md).")
+register(
+    "MXTPU_SERVE_INFLIGHT", int, 2,
+    "serving.InferenceEngine bounded in-flight window: how many "
+    "dispatched-but-unsettled micro-batches the assembler may run "
+    "ahead (2 = double buffering).")
+register(
+    "MXTPU_SERVE_DRAIN_MS", float, 10000.0,
+    "serving.InferenceEngine.stop(drain=True) default drain bound; the "
+    "drain also never outlives the latest queued deadline, and "
+    "requests still queued at the bound are force-dropped (counted in "
+    "serve_drain_dropped_total).")
+register(
     "MXTPU_FUSED_UPDATE", bool, True,
     "Fused multi-tensor optimizer update: bucket the parameter tree by "
     "(rule, weight dtype, multi-precision) and run ONE donated jit "

@@ -120,7 +120,7 @@ def readiness_payload():
                 state = eng.admission_state()
                 engines[name] = {
                     "admission": state,
-                    "queue_depth": len(eng._queue),
+                    "queue_depth": eng.queue_depth(),
                     "max_queue": eng.max_queue,
                     "started": eng.started,
                 }

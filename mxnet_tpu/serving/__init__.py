@@ -1,12 +1,23 @@
-"""In-process inference serving: dynamic micro-batching over the jit
-cache (ISSUE 3 tentpole; docs/serving.md).
+"""In-process inference serving: pipelined continuous batching over the
+jit cache (ISSUE 3 tentpole, rebuilt as a pipeline in ISSUE 15;
+docs/serving.md).
 
-    engine.py    InferenceEngine — bounded queue, batcher thread,
-                 bucket padding, warmup() zero-recompile proof,
-                 admission control, per-request deadlines
-    buckets.py   the batch-bucket ladder (compile-shape vocabulary)
-    registry.py  ModelRegistry — multi-model process, REGISTRY default
-    errors.py    Overloaded / RequestTimeout / EngineStopped
+    engine.py     InferenceEngine — assembler/completer pipeline with a
+                  bounded in-flight window, in-flight joining, bucket
+                  padding, warmup() zero-recompile proof, deadline-aware
+                  bounded drain; mode="sync" keeps the serialized PR-3
+                  loop as the A/B baseline
+    scheduler.py  RequestScheduler — priority classes, strict-priority
+                  dequeue, per-class token-bucket admission
+    frontdoor.py  FrontDoor — N replicas behind one submit(),
+                  least-loaded routing, ops-plane health checks
+    buckets.py    the batch-bucket ladder (compile-shape vocabulary)
+    registry.py   ModelRegistry — multi-model process, replica sets,
+                  REGISTRY default
+    sim.py        SimulatedBlock — deterministic slow device for
+                  pipeline tests/benchmarks
+    errors.py     Overloaded / RateLimited / RequestTimeout /
+                  EngineStopped
 
 Quick start::
 
@@ -15,17 +26,28 @@ Quick start::
     eng.warmup(example_batch)
     with eng:                       # start()/stop()
         y = eng.predict(x)
+        bg = eng.submit(x2, priority="batch")   # rides in spare rows
+        y2 = bg.result()
 """
 from __future__ import annotations
 
 from .buckets import assemble_batch, bucket_ladder, pad_rows, pick_bucket
 from .engine import InferenceEngine, ServeRequest
-from .errors import EngineStopped, Overloaded, RequestTimeout, ServingError
+from .errors import (EngineStopped, Overloaded, RateLimited,
+                     RequestTimeout, ServingError)
+from .frontdoor import FrontDoor, OpsPlaneHealth
 from .registry import REGISTRY, ModelRegistry
+from .scheduler import (DEFAULT_CLASSES, RequestScheduler, ServeClass,
+                        TokenBucket)
+from .sim import SimulatedBlock
 
 __all__ = [
     "InferenceEngine", "ServeRequest",
+    "RequestScheduler", "ServeClass", "TokenBucket", "DEFAULT_CLASSES",
+    "FrontDoor", "OpsPlaneHealth",
     "ModelRegistry", "REGISTRY",
-    "ServingError", "Overloaded", "RequestTimeout", "EngineStopped",
+    "SimulatedBlock",
+    "ServingError", "Overloaded", "RateLimited", "RequestTimeout",
+    "EngineStopped",
     "bucket_ladder", "pick_bucket", "pad_rows", "assemble_batch",
 ]

@@ -1,36 +1,48 @@
-"""Dynamic micro-batching inference engine over a hybridized block.
+"""Continuous-batching inference engine over a hybridized block.
 
-The in-process serving half of the stack (ISSUE 3 tentpole; design
-anchors: TensorFlow Serving's request batching — PAPERS.md "TensorFlow:
-A system for large-scale machine learning" §serving — and bucketed
-compile caching per the TPU cost model, "A Learned Performance Model for
-Tensor Processing Units"):
+The serving hot path, rebuilt as a PIPELINE (ISSUE 15 tentpole; design
+anchors: TF-Serving's request batching — PAPERS.md "TensorFlow" §serving
+— and the bucketed compile cache per "A Learned Performance Model for
+Tensor Processing Units"). The PR-3 engine was a synchronous
+micro-batcher: one thread assembled a batch, dispatched it, and settled
+it before touching the next — so the device idled through every
+host-side pad/assemble/unpack window. Now the batcher is split in two,
+mirroring what the dataloader's ``device_prefetch`` does for training:
 
-  * client threads ``submit()`` single- or multi-row requests into ONE
-    bounded queue; a dedicated batcher thread coalesces them up to
-    ``max_batch_size`` rows or until the oldest request has waited
-    ``max_wait_ms`` (TF-Serving's batch deadline), whichever first;
-  * every batch is padded to a rung of the pre-compiled bucket ladder
-    (buckets.py), so steady state NEVER sees an online XLA compile —
-    ``warmup()`` compiles all rungs up front and proves it (zero
-    retraces re-driving every bucket, per-bucket entries in the
-    diagnostics compile registry);
-  * admission control is a hard queue bound: submits beyond it fail
-    FAST with :class:`~mxnet_tpu.serving.errors.Overloaded` (typed,
-    deterministic — never a blocked client, never a deadlock), and each
-    request carries a deadline enforced on both sides of the queue
-    (:class:`~mxnet_tpu.serving.errors.RequestTimeout`);
-  * everything is observable: request-latency histogram (p50/p99),
-    queue-depth and in-flight gauges, shed/timeout/batch-size counters
-    (telemetry/instruments.py ``serve_*``), and a ``serve`` span per
-    executed batch (diagnostics/spans.py).
+  * the **assembler** thread pops the next micro-batch from the
+    priority scheduler (scheduler.py), pads it to a bucket rung on the
+    host, and ISSUES the dispatch — JAX dispatch is async, so the call
+    returns while the device is still computing, and the assembler
+    immediately starts coalescing + padding the NEXT batch;
+  * dispatched-but-unsettled batches sit in a bounded in-flight window
+    (``max_inflight``, default 2 = double buffering): the assembler runs
+    at most that many batches ahead, which is the backpressure that
+    keeps dispatch-ahead from turning into unbounded device queueing;
+  * the **completer** thread blocks on the OLDEST in-flight batch's
+    results, slices each request's rows off, and settles the futures —
+    a request is "done" only when its output buffers actually exist
+    (the PR-3 engine settled with lazy arrays, deferring device wait to
+    whichever client touched the result first).
 
-The compiled hot path is ``HybridBlock.call_cached_graph`` — predict
-mode, no taping, thread-safe, and never an eager fallback.
+Requests arriving while a dispatch is in flight join the batch the
+assembler is building RIGHT NOW (in-flight joining) — their wait to
+dispatch is bounded by one assembly, not a full round trip. On top of
+the pipeline ride the scheduler's priority classes + per-class token
+buckets, the deadline-aware bounded drain in :meth:`stop`, and the
+replica front door (frontdoor.py).
 
-Defaults come from the typed env registry: MXTPU_SERVE_MAX_BATCH,
-MXTPU_SERVE_QUEUE, MXTPU_SERVE_MAX_WAIT_MS, MXTPU_SERVE_TIMEOUT_MS.
-See docs/serving.md.
+``mode="sync"`` keeps the serialized PR-3 loop (collect → assemble →
+dispatch → block → settle on one thread) for A/B measurement —
+``tools/serve_bench.py --engine sync`` is the baseline the pipeline's
+speedup is quoted against.
+
+Everything else is unchanged contract: bucket-ladder padding so steady
+state never sees an online XLA compile, ``warmup()`` with the
+zero-retrace proof, bounded-queue admission with typed ``Overloaded``
+shedding, per-request deadlines, ``serve_*`` telemetry. Defaults come
+from the typed env registry: MXTPU_SERVE_MAX_BATCH, MXTPU_SERVE_QUEUE,
+MXTPU_SERVE_MAX_WAIT_MS, MXTPU_SERVE_TIMEOUT_MS, MXTPU_SERVE_MODE,
+MXTPU_SERVE_INFLIGHT, MXTPU_SERVE_DRAIN_MS. See docs/serving.md.
 """
 from __future__ import annotations
 
@@ -46,7 +58,8 @@ from ..diagnostics import spans as _spans
 from ..ndarray.ndarray import NDArray
 from ..telemetry import instruments as _instr
 from .buckets import assemble_batch, bucket_ladder, pad_rows, pick_bucket
-from .errors import EngineStopped, Overloaded, RequestTimeout
+from .errors import EngineStopped, RequestTimeout
+from .scheduler import RequestScheduler
 
 __all__ = ["InferenceEngine", "ServeRequest"]
 
@@ -59,22 +72,35 @@ def _to_host(a):
     return _np.asarray(a)
 
 
-class ServeRequest:
-    """One in-flight request: inputs, deadline, and a settable outcome.
+def _wait_ready(datas):
+    """Block until every output buffer exists. Duck-typed so simulated
+    devices (sim.py) and jax arrays both work; plain numpy is a no-op."""
+    for d in datas:
+        ready = getattr(d, "block_until_ready", None)
+        if ready is not None:
+            ready()
 
-    The outcome transition is atomic (first of {batcher result, batcher
-    error, timeout, shed} wins), so the client and the batcher can race
+
+class ServeRequest:
+    """One in-flight request: inputs, class, deadline, and a settable
+    outcome.
+
+    The outcome transition is atomic (first of {completer result, batch
+    error, timeout, shed} wins), so the client and the engine can race
     on a deadline without double-counting or half-set results.
     """
 
-    __slots__ = ("inputs", "rows", "signature", "t_submit", "deadline",
-                 "_event", "_lock", "outcome", "_result", "_error")
+    __slots__ = ("inputs", "rows", "signature", "cls", "t_submit",
+                 "t_dispatch", "deadline", "_event", "_lock", "outcome",
+                 "_result", "_error")
 
-    def __init__(self, inputs, rows, signature, deadline):
+    def __init__(self, inputs, rows, signature, deadline, cls="interactive"):
         self.inputs = inputs
         self.rows = rows
         self.signature = signature
+        self.cls = cls
         self.t_submit = time.monotonic()
+        self.t_dispatch = None  # stamped when the batch is issued
         self.deadline = deadline  # absolute monotonic seconds, or None
         self._event = threading.Event()
         self._lock = threading.Lock()
@@ -110,7 +136,7 @@ class ServeRequest:
         self._event.wait(timeout)
         if not self.done:
             # nothing finished us in time — claim the timeout ourselves
-            # (the batcher skips claimed requests when it reaches them)
+            # (the engine skips claimed requests when it reaches them)
             self._finish("timeout",
                          error=RequestTimeout(
                              f"request not served within "
@@ -120,8 +146,22 @@ class ServeRequest:
         raise self._error
 
 
+class _Flight:
+    """One dispatched-but-unsettled micro-batch in the pipeline window."""
+
+    __slots__ = ("batch", "datas", "rows", "bucket", "t_dispatch")
+
+    def __init__(self, batch, datas, rows, bucket):
+        self.batch = batch
+        self.datas = datas
+        self.rows = rows
+        self.bucket = bucket
+        self.t_dispatch = time.monotonic()
+
+
 class InferenceEngine:
-    """Thread-safe dynamic-batching server around one hybridized block.
+    """Thread-safe continuous-batching server around one hybridized
+    block.
 
     ::
 
@@ -139,7 +179,8 @@ class InferenceEngine:
 
     def __init__(self, block, name="model", max_batch_size=None,
                  max_queue=None, max_wait_ms=None, timeout_ms=None,
-                 buckets=None):
+                 buckets=None, mode=None, max_inflight=None,
+                 classes=None, drain_timeout_ms=None):
         if not hasattr(block, "call_cached_graph"):
             raise TypeError(
                 f"InferenceEngine needs a HybridBlock, got {type(block)}")
@@ -157,67 +198,179 @@ class InferenceEngine:
         self.timeout_s = float(
             timeout_ms if timeout_ms is not None
             else _env.get("MXTPU_SERVE_TIMEOUT_MS")) / 1e3
+        self.drain_timeout_s = float(
+            drain_timeout_ms if drain_timeout_ms is not None
+            else _env.get("MXTPU_SERVE_DRAIN_MS")) / 1e3
+        self.mode = str(mode if mode is not None
+                        else _env.get("MXTPU_SERVE_MODE")).lower()
+        if self.mode not in ("pipelined", "sync"):
+            raise ValueError(
+                f"mode must be 'pipelined' or 'sync', got {self.mode!r}")
+        self.max_inflight = max(1, int(
+            max_inflight if max_inflight is not None
+            else _env.get("MXTPU_SERVE_INFLIGHT")))
         self.buckets = bucket_ladder(self.max_batch_size, buckets)
-        self._cond = threading.Condition()
-        self._queue = collections.deque()
+        self._sched = RequestScheduler(self.name, classes=classes,
+                                       max_queue=self.max_queue)
+        self._lifecycle = threading.Lock()
         self._stopping = False
-        self._thread = None
+        self._force = False  # force-stop: window bound lifted, queue dropped
+        self._threads = ()
         self._warm_traces = None
+        # the pipeline window: dispatched-but-unsettled _Flights, bounded
+        # at max_inflight (the assembler waits on _icond for a free slot)
+        self._icond = threading.Condition()
+        self._inflight = collections.deque()
+        self._inflight_rows = 0
+        self._max_inflight_seen = 0
+        self._drained = threading.Event()  # set each time pipeline empties
         # cached label children: the hot path mutates gauges without
         # re-resolving labels (each child still honors enable/disable)
-        self._g_queue = _instr.serve_queue_depth.labels(self.name)
         self._g_inflight = _instr.serve_in_flight.labels(self.name)
+        self._g_inflight_batches = _instr.serve_inflight_batches.labels(
+            self.name)
+        self._c_dispatch = _instr.serve_dispatch_total.labels(self.name)
 
     # -- lifecycle ---------------------------------------------------------
     @property
     def started(self):
-        return self._thread is not None and self._thread.is_alive()
+        return any(t.is_alive() for t in self._threads)
 
     def start(self):
-        """Start the batcher thread (idempotent)."""
-        with self._cond:
+        """Start the pipeline threads (idempotent)."""
+        with self._lifecycle:
             if self._stopping:
                 raise EngineStopped(f"engine {self.name!r} was stopped")
-            if self._thread is None or not self._thread.is_alive():
-                self._thread = threading.Thread(
-                    target=self._loop, name=f"mxtpu-serve-{self.name}",
-                    daemon=True)
-                self._thread.start()
+            if not self.started:
+                if self.mode == "sync":
+                    self._threads = (threading.Thread(
+                        target=self._loop_sync,
+                        name=f"mxtpu-serve-{self.name}", daemon=True),)
+                else:
+                    self._threads = (
+                        threading.Thread(
+                            target=self._loop_assembler,
+                            name=f"mxtpu-serve-{self.name}-asm",
+                            daemon=True),
+                        threading.Thread(
+                            target=self._loop_completer,
+                            name=f"mxtpu-serve-{self.name}-cpl",
+                            daemon=True),
+                    )
+                for t in self._threads:
+                    t.start()
         try:
             from ..observability import flight as _flight
 
-            _flight.record("serve_start", model=self.name)
+            _flight.record("serve_start", model=self.name, mode=self.mode)
         except Exception:
             pass
         return self
 
-    def stop(self, drain=True):
+    def stop(self, drain=True, drain_timeout_ms=None):
         """Stop accepting work; by default drain queued requests first.
-        With ``drain=False`` pending requests fail with EngineStopped."""
-        with self._cond:
+
+        The drain is DEADLINE-AWARE and bounded: it never blocks past
+        ``drain_timeout_ms`` (default MXTPU_SERVE_DRAIN_MS), nor past
+        the latest deadline among queued requests (after which everything
+        left would have expired anyway). Requests still queued when the
+        drain deadline hits are force-dropped with
+        :class:`EngineStopped` and counted in
+        ``serve_drain_dropped_total``. With ``drain=False`` pending
+        requests fail immediately.
+        """
+        with self._lifecycle:
+            first = not self._stopping
             self._stopping = True
-            if not drain:
-                dropped, self._queue = list(self._queue), \
-                    collections.deque()
-                self._g_queue.set(0)
-            else:
-                dropped = []
-            self._cond.notify_all()
+        self._sched.stop()
+        dropped = []
+        if not drain:
+            self._sched.stop(force=True)
+            self._force = True
+            with self._icond:
+                self._icond.notify_all()
+            dropped = self._sched.drain_all()
+            for r in dropped:
+                if r._finish("error",
+                             error=EngineStopped(
+                                 f"engine {self.name!r} stopped")):
+                    _instr.record_serve_request(self.name, "error")
+        elif not self.started:
+            # never started (or already exited): nothing will ever serve
+            # the queue — dropping now IS the bounded drain
+            self._force_drop()
+        else:
+            timeout_s = (float(drain_timeout_ms) / 1e3
+                         if drain_timeout_ms is not None
+                         else self.drain_timeout_s)
+            deadline = time.monotonic() + timeout_s
+            latest = self._sched.latest_deadline()
+            if latest is not None:
+                deadline = min(deadline, latest)
+            for t in self._threads:
+                t.join(timeout=max(0.0, deadline - time.monotonic()))
+            if any(t.is_alive() for t in self._threads):
+                # drain deadline hit: force the scheduler empty and give
+                # the pipeline a moment to settle what it already
+                # dispatched (device work in flight completes on its own)
+                self._sched.stop(force=True)
+                self._force = True
+                self._force_drop()
+                with self._icond:
+                    self._icond.notify_all()
+                for t in self._threads:
+                    t.join(timeout=2.0)
+        self._fail_unsettled_inflight()
+        if first:
+            try:
+                from ..observability import flight as _flight
+
+                _flight.record("serve_stop", model=self.name,
+                               drained=bool(drain),
+                               dropped=len(dropped))
+            except Exception:
+                pass
+        return self
+
+    def _force_drop(self):
+        """Drop every queued request unserved (bounded-drain expiry)."""
+        dropped = self._sched.drain_all()
+        now = time.monotonic()
         for r in dropped:
             if r._finish("error",
                          error=EngineStopped(
-                             f"engine {self.name!r} stopped")):
-                _instr.record_serve_request(self.name, "error")
-        if self._thread is not None:
-            self._thread.join(timeout=30)
-        try:
-            from ..observability import flight as _flight
+                             f"engine {self.name!r} drain deadline hit; "
+                             "request dropped unserved")):
+                _instr.record_serve_request(self.name, "error",
+                                            now - r.t_submit)
+        if dropped:
+            _instr.serve_drain_dropped_total.labels(self.name).inc(
+                len(dropped))
 
-            _flight.record("serve_stop", model=self.name,
-                           drained=bool(drain))
-        except Exception:
-            pass
-        return self
+    def _fail_unsettled_inflight(self):
+        """Fail any dispatched-but-unsettled requests after the pipeline
+        threads are gone (stop-path stragglers)."""
+        if any(t.is_alive() for t in self._threads):
+            return
+        with self._icond:
+            flights, self._inflight = list(self._inflight), \
+                collections.deque()
+            self._inflight_rows = 0
+        stragglers = 0
+        for fl in flights:
+            if fl is None:
+                continue
+            for r in fl.batch:
+                if r._finish("error", error=EngineStopped(
+                        f"engine {self.name!r} stopped before the "
+                        "dispatched batch settled")):
+                    _instr.record_serve_request(self.name, "error")
+                    stragglers += 1
+        if stragglers:
+            _instr.serve_drain_dropped_total.labels(self.name).inc(
+                stragglers)
+        self._g_inflight.set(0)
+        self._g_inflight_batches.set(0)
 
     def __enter__(self):
         return self.start()
@@ -260,8 +413,9 @@ class InferenceEngine:
 
         for b in self.buckets:
             nds = rung_inputs(b)
-            self._block.call_cached_graph(*nds)
-            if introspect:
+            _wait_ready([o._data for o in self._flatten_out(
+                self._block.call_cached_graph(*nds))])
+            if introspect and hasattr(self._block, "aot_introspect"):
                 self._block.aot_introspect(f"b{b}", *nds, label=self.name)
         traces = self._block.jit_trace_count(False)
         for b in self.buckets:  # re-drive: everything must cache-hit now
@@ -273,8 +427,6 @@ class InferenceEngine:
                 f"recompile(s) re-driving buckets {self.buckets} — "
                 "served shapes would compile online")
         self._warm_traces = self._block.jit_trace_count(False)
-        self._example_trailing = [
-            (tuple(a.shape[1:]), _np.dtype(a.dtype)) for a in ex]
         return {
             "model": self.name,
             "buckets": list(self.buckets),
@@ -290,12 +442,15 @@ class InferenceEngine:
         return self._block.jit_trace_count(False) - self._warm_traces
 
     # -- client side -------------------------------------------------------
-    def submit(self, *inputs, timeout_ms=None):
+    def submit(self, *inputs, timeout_ms=None, priority=None):
         """Enqueue one request; returns a :class:`ServeRequest` handle.
 
         Each input must carry a leading row dimension (1 <= rows <=
-        ``max_batch_size``). Never blocks: a full queue sheds with
-        :class:`Overloaded`, a stopped engine raises
+        ``max_batch_size``). ``priority`` names a scheduler class
+        (default: the highest-priority one, ``"interactive"`` under the
+        stock two-class policy). Never blocks: a full queue sheds with
+        :class:`Overloaded`, a class over its admission rate with
+        :class:`RateLimited`, a stopped engine raises
         :class:`EngineStopped`. ``timeout_ms`` overrides the engine's
         per-request deadline (0 disables it).
         """
@@ -315,104 +470,74 @@ class InferenceEngine:
         tmo = self.timeout_s if timeout_ms is None else float(
             timeout_ms) / 1e3
         deadline = (time.monotonic() + tmo) if tmo > 0 else None
-        req = ServeRequest(tuple(arrays), rows, signature, deadline)
-        with self._cond:
-            if self._stopping:
-                raise EngineStopped(f"engine {self.name!r} is stopped")
-            if len(self._queue) >= self.max_queue:
-                _instr.record_serve_request(self.name, "shed")
-                raise Overloaded(
-                    f"engine {self.name!r} queue at bound "
-                    f"{self.max_queue}; request shed")
-            self._queue.append(req)
-            self._g_queue.set(len(self._queue))
-            self._cond.notify()
+        cls = str(priority) if priority is not None \
+            else self._sched.default_class
+        req = ServeRequest(tuple(arrays), rows, signature, deadline,
+                           cls=cls)
+        if self._stopping:
+            raise EngineStopped(f"engine {self.name!r} is stopped")
+        self._sched.offer(req)  # sheds with Overloaded / RateLimited
         return req
 
-    def predict(self, *inputs, timeout_ms=None):
+    def predict(self, *inputs, timeout_ms=None, priority=None):
         """Synchronous round-trip: submit + wait. Raises Overloaded /
         RequestTimeout / EngineStopped like submit()/result()."""
-        req = self.submit(*inputs, timeout_ms=timeout_ms)
+        req = self.submit(*inputs, timeout_ms=timeout_ms,
+                          priority=priority)
         try:
             return req.result()
         except RequestTimeout:
             _instr.record_serve_request(self.name, "timeout")
             raise
 
-    # -- batcher side ------------------------------------------------------
-    def _expire_locked(self):
-        """Drop finished (client-claimed) and past-deadline requests from
-        the queue; called with the condition held."""
-        now = time.monotonic()
-        keep = collections.deque()
-        for r in self._queue:
-            if r.done:
-                continue  # client already claimed (timeout) — drop
-            if r.deadline is not None and now >= r.deadline:
-                if r._finish("timeout", error=RequestTimeout(
-                        "deadline elapsed while queued")):
-                    _instr.record_serve_request(
-                        self.name, "timeout", now - r.t_submit)
-                continue
-            keep.append(r)
-        if len(keep) != len(self._queue):
-            self._queue = keep
-            self._g_queue.set(len(keep))
+    # -- pipeline: assemble + dispatch ------------------------------------
+    @staticmethod
+    def _flatten_out(out):
+        return out if isinstance(out, (list, tuple)) else (out,)
 
-    def _collect(self):
-        """Pop the next micro-batch: same-signature requests up to
-        ``max_batch_size`` rows, or whatever arrived by the time the
-        oldest one has waited ``max_wait_ms``. None = stopped + drained."""
-        with self._cond:
-            while True:
-                self._expire_locked()
-                if self._queue:
-                    break
-                if self._stopping:
-                    return None
-                self._cond.wait(0.05)
-            head = self._queue.popleft()
-            batch, rows = [head], head.rows
-            launch_at = head.t_submit + self.max_wait_s
-            while rows < self.max_batch_size:
-                if self._queue:
-                    nxt = self._queue[0]
-                    if nxt.done or (
-                            nxt.deadline is not None
-                            and time.monotonic() >= nxt.deadline):
-                        self._expire_locked()
-                        continue
-                    if nxt.signature != head.signature or \
-                            rows + nxt.rows > self.max_batch_size:
-                        break  # different shape family / no room: next batch
-                    self._queue.popleft()
-                    batch.append(nxt)
-                    rows += nxt.rows
-                    continue
-                remaining = launch_at - time.monotonic()
-                if remaining <= 0 or self._stopping:
-                    break
-                self._cond.wait(min(remaining, 0.05))
-            self._g_queue.set(len(self._queue))
-        return batch
-
-    def _run_batch(self, batch):
+    def _assemble_dispatch(self, batch):
+        """Pad the batch to its bucket on the host and ISSUE the
+        dispatch; returns a :class:`_Flight` (or None — the whole batch
+        failed and was settled with the error)."""
         rows = sum(r.rows for r in batch)
         bucket = pick_bucket(self.buckets, rows)
-        self._g_inflight.set(rows)
         try:
-            padded = assemble_batch([r.inputs for r in batch], bucket)
-            nds = [NDArray(jnp.asarray(a)) for a in padded]
             with _spans.span(self.name, cat="serve"):
+                padded = assemble_batch([r.inputs for r in batch], bucket)
+                if getattr(self._block, "_host_native", False):
+                    # simulated devices (sim.py) consume host numpy
+                    # directly — no device transfer to model
+                    nds = [NDArray(a) for a in padded]
+                else:
+                    nds = [NDArray(jnp.asarray(a)) for a in padded]
                 out = self._block.call_cached_graph(*nds)
-            outs = out if isinstance(out, (list, tuple)) else (out,)
-            datas = [o._data for o in outs]
-            _instr.record_serve_batch(self.name, rows, bucket)
-            off, now = 0, time.monotonic()
+            datas = [o._data for o in self._flatten_out(out)]
+            now = time.monotonic()
             for r in batch:
+                r.t_dispatch = now
+            self._c_dispatch.inc()
+            return _Flight(batch, datas, rows, bucket)
+        except Exception as e:  # noqa: BLE001 — batch failure -> per-request
+            now = time.monotonic()
+            for r in batch:
+                if r._finish("error", error=e):
+                    _instr.record_serve_request(
+                        self.name, "error", now - r.t_submit)
+            return None
+
+    def _complete(self, flight):
+        """Block until the flight's outputs exist, slice each request's
+        rows off, and settle the futures."""
+        try:
+            with _spans.span(self.name, cat="serve_complete"):
+                _wait_ready(flight.datas)
+            _instr.record_serve_batch(self.name, flight.rows,
+                                      flight.bucket)
+            off, now = 0, time.monotonic()
+            for r in flight.batch:
                 # slice off exactly this request's rows — bucket padding
                 # never reaches a client
-                sl = [NDArray(d[off:off + r.rows]) for d in datas]
+                sl = [NDArray(d[off:off + r.rows]) for d in flight.datas]
                 res = sl[0] if len(sl) == 1 else tuple(sl)
                 if r._finish("ok", result=res):
                     _instr.record_serve_request(
@@ -420,21 +545,97 @@ class InferenceEngine:
                 off += r.rows
         except Exception as e:  # noqa: BLE001 — batch failure -> per-request
             now = time.monotonic()
-            for r in batch:
+            for r in flight.batch:
                 if r._finish("error", error=e):
                     _instr.record_serve_request(
                         self.name, "error", now - r.t_submit)
-        finally:
-            self._g_inflight.set(0)
 
-    def _loop(self):
+    # -- pipelined mode: assembler + completer threads ---------------------
+    def _loop_assembler(self):
         while True:
-            batch = self._collect()
+            batch = self._sched.collect(self.max_batch_size,
+                                        self.max_wait_s)
+            if batch is None:
+                break
+            # host work (pad/concat) + async dispatch happen OUTSIDE the
+            # window lock: this is exactly the overlap — the device is
+            # still computing the previous flight(s) while we assemble
+            flight = self._assemble_dispatch(batch)
+            if flight is None:
+                continue
+            with self._icond:
+                # the window bound holds even while draining — only a
+                # FORCE stop lifts it (so a dead completer can't wedge
+                # shutdown); a graceful drain keeps dispatch-ahead bounded
+                while (len(self._inflight) >= self.max_inflight
+                       and not self._force):
+                    self._icond.wait(0.05)
+                self._inflight.append(flight)
+                self._inflight_rows += flight.rows
+                depth = len(self._inflight)
+                if depth > self._max_inflight_seen:
+                    self._max_inflight_seen = depth
+                self._g_inflight.set(self._inflight_rows)
+                self._g_inflight_batches.set(depth)
+                self._icond.notify_all()
+        with self._icond:  # sentinel: completer exits after draining
+            self._inflight.append(None)
+            self._icond.notify_all()
+
+    def _loop_completer(self):
+        while True:
+            with self._icond:
+                while not self._inflight:
+                    self._icond.wait(0.05)
+                flight = self._inflight[0]
+                if flight is None:
+                    self._inflight.popleft()
+                    self._g_inflight.set(0)
+                    self._g_inflight_batches.set(0)
+                    return
+            self._complete(flight)  # blocks on device results, settles
+            with self._icond:
+                self._inflight.popleft()
+                self._inflight_rows -= flight.rows
+                self._g_inflight.set(self._inflight_rows)
+                self._g_inflight_batches.set(len(self._inflight))
+                self._icond.notify_all()
+
+    # -- sync mode: the serialized PR-3 baseline ---------------------------
+    def _loop_sync(self):
+        while True:
+            batch = self._sched.collect(self.max_batch_size,
+                                        self.max_wait_s)
             if batch is None:
                 return
-            self._run_batch(batch)
+            flight = self._assemble_dispatch(batch)
+            if flight is None:
+                continue
+            if not self._max_inflight_seen:
+                self._max_inflight_seen = 1
+            self._g_inflight.set(flight.rows)
+            self._g_inflight_batches.set(1)
+            self._complete(flight)
+            self._g_inflight.set(0)
+            self._g_inflight_batches.set(0)
 
     # -- observability -----------------------------------------------------
+    def queue_depth(self):
+        """Queued requests right now (mirrors serve_queue_depth)."""
+        return self._sched.depth()
+
+    def inflight_rows(self):
+        """Rows inside dispatched-but-unsettled batches (mirrors
+        serve_in_flight)."""
+        with self._icond:
+            return self._inflight_rows
+
+    def load(self):
+        """Least-loaded routing score for the front door: queued rows +
+        in-flight rows (the same quantities the serve_queue_depth and
+        serve_in_flight gauges publish)."""
+        return self._sched.depth_rows() + self.inflight_rows()
+
     def _latency_quantile_ms(self, q):
         """Approximate latency quantile (ms) from the telemetry histogram
         (upper bound of the covering bucket); None when no samples or
@@ -459,35 +660,48 @@ class InferenceEngine:
         ``/readyz`` reports not-ready unless every registered engine is
         ``"ok"`` — a front door stops routing to a shedding replica and
         resumes once its queue drains."""
-        with self._cond:
-            if self._stopping:
-                return "stopped"
-            if len(self._queue) >= self.max_queue:
-                return "overloaded"
+        if self._stopping:
+            return "stopped"
+        if self._sched.at_bound():
+            return "overloaded"
         return "ok"
 
     def stats(self):
         """Live snapshot: queue/in-flight, outcome counters, batch shape,
-        latency p50/p99, and the zero-recompile invariant."""
+        latency p50/p99, per-class scheduler state, pipeline window, and
+        the zero-recompile invariant."""
         outcomes = {
             lv[1]: c.value
             for lv, c in _instr.serve_request_total.series()
             if lv[0] == self.name}
         batches = _instr.serve_batch_total.labels(self.name).value
         bs = _instr.serve_batch_size.labels(self.name)
+        with self._icond:
+            inflight_batches = sum(
+                1 for f in self._inflight if f is not None)
+            inflight_rows = self._inflight_rows
+            max_seen = self._max_inflight_seen
         return {
             "model": self.name,
             "started": self.started,
+            "mode": self.mode,
             "buckets": list(self.buckets),
-            "queue_depth": len(self._queue),
+            "queue_depth": self._sched.depth(),
             "max_queue": self.max_queue,
-            "in_flight": _instr.serve_in_flight.labels(self.name).value,
+            "in_flight": inflight_rows,
+            "inflight_batches": inflight_batches,
+            "max_inflight": self.max_inflight,
+            "max_inflight_seen": max_seen,
+            "classes": self._sched.class_stats(),
             "requests": outcomes,
             "batches": batches,
+            "dispatches": self._c_dispatch.value,
             "avg_batch_rows": round(bs.sum / bs.count, 3) if bs.count
             else None,
             "padded_rows":
                 _instr.serve_padded_rows_total.labels(self.name).value,
+            "drain_dropped":
+                _instr.serve_drain_dropped_total.labels(self.name).value,
             "p50_ms": self._latency_quantile_ms(0.50),
             "p99_ms": self._latency_quantile_ms(0.99),
             "recompiles_since_warmup": self.recompiles_since_warmup(),

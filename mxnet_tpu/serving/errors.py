@@ -12,7 +12,8 @@ from __future__ import annotations
 
 from ..base import MXNetError
 
-__all__ = ["ServingError", "Overloaded", "RequestTimeout", "EngineStopped"]
+__all__ = ["ServingError", "Overloaded", "RateLimited", "RequestTimeout",
+           "EngineStopped"]
 
 
 class ServingError(MXNetError):
@@ -23,6 +24,13 @@ class Overloaded(ServingError):
     """Admission control rejected the request: the bounded queue is at
     capacity. Clients should back off / retry against another replica —
     the engine sheds load instead of queueing unboundedly."""
+
+
+class RateLimited(Overloaded):
+    """The request's priority class is over its token-bucket admission
+    rate (scheduler.py). Subclasses :class:`Overloaded` so existing
+    shed handling catches it; catch this type to tell a policy rejection
+    from a capacity one."""
 
 
 class RequestTimeout(ServingError):

@@ -41,6 +41,9 @@ __all__ = [
     "serve_queue_depth", "serve_in_flight",
     "serve_batch_total", "serve_batch_size", "serve_padded_rows_total",
     "serve_shed_total", "serve_timeout_total",
+    "serve_dispatch_total", "serve_inflight_batches",
+    "serve_class_queue_depth", "serve_class_shed_total",
+    "serve_drain_dropped_total",
     "record_compile", "record_trace", "record_fallback", "record_transfer",
     "record_sync", "record_collective", "observe_step", "set_flop_budget",
     "record_serve_request", "record_serve_batch", "nbytes_of",
@@ -273,6 +276,30 @@ serve_shed_total = counter(
 serve_timeout_total = counter(
     "serve_timeout_total",
     "Requests that hit their deadline before a result was ready",
+    ["model"])
+serve_dispatch_total = counter(
+    "serve_dispatch_total",
+    "Micro-batches dispatched to the device (the pipelined engine "
+    "dispatches ahead of completion, so this leads serve_batch_total "
+    "by the in-flight window)", ["model"])
+serve_inflight_batches = gauge(
+    "serve_inflight_batches",
+    "Dispatched-but-unsettled micro-batches right now (pipeline window "
+    "fill; >1 means host assembly is overlapping device compute)",
+    ["model"])
+serve_class_queue_depth = gauge(
+    "serve_class_queue_depth",
+    "Requests queued per priority class (serving/scheduler.py "
+    "strict-priority dequeue)", ["model", "cls"])
+serve_class_shed_total = counter(
+    "serve_class_shed_total",
+    "Requests shed at admission per priority class, by reason: 'queue' "
+    "(shared bound hit -> Overloaded) or 'rate' (class token bucket "
+    "empty -> RateLimited)", ["model", "cls", "reason"])
+serve_drain_dropped_total = counter(
+    "serve_drain_dropped_total",
+    "Requests force-dropped unserved because stop(drain=True) hit its "
+    "bounded drain deadline (or the engine was never started)",
     ["model"])
 
 

@@ -111,6 +111,32 @@ def test_serve_bench_smoke():
     assert row["p50_ms"] is not None and row["p99_ms"] >= row["p50_ms"]
 
 
+def test_serve_bench_open_loop_smoke(tmp_path):
+    """Tier-1-safe open-loop run (~2s): Poisson arrivals against the
+    pipelined engine on the simulated slow block, JSON artifact out."""
+    out = tmp_path / "open.json"
+    rc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "serve_bench.py"),
+         "--mode", "open", "--block", "slow", "--device-ms", "5",
+         "--qps", "80", "--duration-s", "1.5", "--max-batch", "8",
+         "--timeout-ms", "5000", "--json-out", str(out)],
+        env=ENV, capture_output=True, text=True, timeout=300)
+    assert rc.returncode == 0, rc.stderr
+    row = json.loads(rc.stdout.strip().split("\n")[-1])
+    assert row["metric"] == "open_loop_p99_ms"
+    assert row["mode"] == "open" and row["engine_mode"] == "pipelined"
+    assert row["completed"] > 0
+    assert row["p99_ms"] >= row["p50_ms"] > 0
+    assert set(row["classes"]) == {"interactive", "batch"}
+    inter = row["classes"]["interactive"]
+    assert inter["offered"] >= inter["completed"] > 0
+    assert row["recompiles_since_warmup"] == 0
+    # the artifact on disk is the same well-formed object
+    art = json.loads(out.read_text())
+    assert art["metric"] == "open_loop_p99_ms"
+    assert art["completed"] == row["completed"]
+
+
 def test_opperf_harness():
     rc = subprocess.run(
         [sys.executable, os.path.join(REPO, "benchmark", "opperf.py"),

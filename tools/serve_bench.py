@@ -1,30 +1,51 @@
-"""Serving-engine load driver: N client threads against one
-InferenceEngine, emitting `inference_qps` (docs/serving.md).
+"""Serving-engine load driver: closed-loop throughput, open-loop
+latency-under-load, and pipelined-vs-sync A/B (docs/serving.md,
+docs/performance.md).
 
-The closed-loop harness for the serving subsystem (ISSUE 3 tentpole):
-builds a small hybridized MLP, warmup()s every batch bucket (asserting
-zero recompiles — the zero-miss invariant), then drives `--clients`
-threads each issuing `--requests` synchronous predict() round-trips with
-randomized 1..`--rows-max` row counts, so the micro-batcher actually
-exercises coalescing + bucket padding. Prints ONE JSON line:
+Three modes (``--mode``):
 
-  {"metric": "inference_qps", "value": N, "unit": "req/s",
-   "clients": ..., "p50_ms": ..., "p99_ms": ...,
-   "recompiles_since_warmup": 0, "engine": {...engine.stats()...}}
+  closed   (default) N client threads each issuing synchronous
+           predict() round-trips — saturation throughput. Prints ONE
+           JSON line with ``"metric": "inference_qps"`` (schema
+           unchanged since ISSUE 3; tests/test_tools.py pins it).
+  open     Poisson arrivals at ``--qps`` for ``--duration-s`` with a
+           per-priority-class mix (``--mix interactive=0.9,batch=0.1``)
+           — measures what clients actually feel under a given offered
+           load: per-class p50/p95/p99 latency and shed rate, which
+           closed-loop throughput hides entirely (queueing delay only
+           exists when arrivals are independent of completions).
+  compare  The headline A/B for the ISSUE-15 pipeline: closed-loop
+           throughput AND open-loop p99 for ``--engine sync`` (the
+           serialized PR-3 batcher) vs ``--engine pipelined``, same
+           block, same load. Emits the speedup ratios.
 
-Client-side latency percentiles are computed from per-request wall
-clocks (exact, unlike the engine's bucketed histogram estimate, which
-rides along inside "engine"). Shed/timeout counts land in
-engine.stats(); with default knobs and a healthy host both stay 0.
+Blocks (``--block``):
+
+  mlp      a real hybridized Dense stack through the jit cache —
+           exercises warmup()'s zero-recompile proof end to end.
+  slow     serving.SimulatedBlock: a deterministic serial device stream
+           costing ``--device-ms`` per batch plus ``--host-ms`` of
+           synchronous host work at dispatch. This is the honest way to
+           measure pipelining on a small CPU box, where real XLA compute
+           and host assembly fight for the same cores (see
+           serving/sim.py). Device time ≈ host time is the regime the
+           ISSUE-15 acceptance bar quotes.
+
+``--json-out FILE`` additionally writes the result object to a file —
+the committed ``BENCH_serving_pipeline.json`` artifact is a ``compare``
+run captured this way.
 
 Usage:
   python tools/serve_bench.py --clients 8 --requests 50 --max-batch 16
+  python tools/serve_bench.py --mode open --qps 200 --duration-s 5
+  python tools/serve_bench.py --mode compare --block slow --device-ms 10
 """
 from __future__ import annotations
 
 import argparse
 import json
 import os
+import random
 import sys
 import threading
 import time
@@ -32,9 +53,13 @@ import time
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 
-def build_engine(args):
+def build_block(args):
+    if args.block == "slow":
+        from mxnet_tpu import serving
+
+        return serving.SimulatedBlock(device_ms=args.device_ms,
+                                      host_ms=args.host_ms)
     import mxnet_tpu as mx
-    from mxnet_tpu import serving
     from mxnet_tpu.gluon import nn
 
     mx.seed(0)
@@ -43,15 +68,40 @@ def build_engine(args):
             nn.Dense(args.classes))
     net.initialize()
     net.hybridize()
+    return net
+
+
+def build_engine(args, mode=None):
+    import numpy as onp
+
+    from mxnet_tpu import serving
+
+    classes = None
+    if args.rate_interactive or args.rate_batch:
+        classes = (
+            serving.ServeClass("interactive", 0,
+                               rate=args.rate_interactive or None),
+            serving.ServeClass("batch", 10,
+                               rate=args.rate_batch or None),
+        )
     eng = serving.InferenceEngine(
-        net, name="serve_bench", max_batch_size=args.max_batch,
-        max_queue=args.queue, max_wait_ms=args.max_wait_ms,
-        timeout_ms=args.timeout_ms)
-    warm = eng.warmup(mx.np.zeros((1, args.features)))
+        build_block(args), name="serve_bench",
+        max_batch_size=args.max_batch, max_queue=args.queue,
+        max_wait_ms=args.max_wait_ms, timeout_ms=args.timeout_ms,
+        mode=mode or args.engine, max_inflight=args.inflight,
+        classes=classes)
+    warm = eng.warmup(onp.zeros((1, args.features), onp.float32))
     return eng, warm
 
 
-def drive(eng, args):
+def _pct(lat, q):
+    if not lat:
+        return None
+    return round(lat[min(len(lat) - 1, int(q * len(lat)))] * 1e3, 3)
+
+
+# -- closed loop -----------------------------------------------------------
+def drive_closed(eng, args):
     """Run the closed loop; returns (qps, latencies_s, error_counts)."""
     import numpy as onp
 
@@ -93,11 +143,236 @@ def drive(eng, args):
     return len(lat) / dt, sorted(lat), errors
 
 
+def result_closed(args, eng, warm, qps, lat, errors):
+    return {
+        "metric": "inference_qps",
+        "value": round(qps, 2),
+        "unit": "req/s",
+        "mode": "closed",
+        "engine_mode": eng.mode,
+        "clients": args.clients,
+        "requests_per_client": args.requests,
+        "completed": len(lat),
+        "shed": errors["shed"],
+        "timeout": errors["timeout"],
+        "p50_ms": _pct(lat, 0.50),
+        "p99_ms": _pct(lat, 0.99),
+        "recompiles_since_warmup": eng.recompiles_since_warmup(),
+        "warmup": warm,
+        "engine": eng.stats(),
+    }
+
+
+# -- open loop -------------------------------------------------------------
+def parse_mix(spec):
+    """'interactive=0.9,batch=0.1' -> [(class, cumulative_weight)]."""
+    pairs = []
+    for part in spec.split(","):
+        name, _, w = part.partition("=")
+        pairs.append((name.strip(), float(w or 1.0)))
+    total = sum(w for _, w in pairs)
+    if total <= 0:
+        raise ValueError(f"mix weights must sum > 0: {spec!r}")
+    cum, acc = [], 0.0
+    for name, w in pairs:
+        acc += w / total
+        cum.append((name, acc))
+    return cum
+
+
+def drive_open(eng, args):
+    """Poisson arrivals at --qps for --duration-s; per-class latency.
+
+    One arrival thread draws exponential inter-arrival gaps and fires
+    submit() (never blocking on results — that's the open-loop point);
+    a small waiter pool collects result() completions so latency covers
+    the full queue + batch + device round trip.
+    """
+    import numpy as onp
+
+    from mxnet_tpu import serving
+
+    rng = random.Random(0)
+    rs = onp.random.RandomState(0)
+    pool = [onp.asarray(rs.rand(r, args.features), onp.float32)
+            for r in rs.randint(1, args.rows_max + 1, size=64)]
+    mix = parse_mix(args.mix)
+    per_cls = {name: {"lat": [], "shed": 0, "rate_limited": 0,
+                      "timeout": 0, "offered": 0}
+               for name, _ in mix}
+    lock = threading.Lock()
+    pending = []  # (req, cls, t_submit)
+    pcond = threading.Condition(lock)
+    arrivals_done = threading.Event()
+
+    def pick_class():
+        u = rng.random()
+        for name, edge in mix:
+            if u <= edge:
+                return name
+        return mix[-1][0]
+
+    def waiter():
+        while True:
+            with pcond:
+                while not pending and not arrivals_done.is_set():
+                    pcond.wait(0.05)
+                if not pending:
+                    return
+                req, cls, t0 = pending.pop(0)
+            try:
+                req.result()
+                dt = time.perf_counter() - t0
+                with lock:
+                    per_cls[cls]["lat"].append(dt)
+            except serving.RequestTimeout:
+                with lock:
+                    per_cls[cls]["timeout"] += 1
+            except Exception:
+                pass  # stop-path drops: accounted in engine stats
+
+    waiters = [threading.Thread(target=waiter, daemon=True)
+               for _ in range(max(4, args.clients))]
+    with eng:
+        eng.predict(pool[0])  # absorb first-dispatch overheads
+        for t in waiters:
+            t.start()
+        t_end = time.perf_counter() + args.duration_s
+        k = 0
+        while time.perf_counter() < t_end:
+            gap = rng.expovariate(args.qps)  # Poisson process
+            time.sleep(gap)
+            cls = pick_class()
+            x = pool[k % len(pool)]
+            k += 1
+            t0 = time.perf_counter()
+            with lock:
+                per_cls[cls]["offered"] += 1
+            try:
+                req = eng.submit(x, priority=cls)
+            except serving.RateLimited:
+                with lock:
+                    per_cls[cls]["rate_limited"] += 1
+                continue
+            except serving.Overloaded:
+                with lock:
+                    per_cls[cls]["shed"] += 1
+                continue
+            with pcond:
+                pending.append((req, cls, t0))
+                pcond.notify()
+        arrivals_done.set()
+        with pcond:
+            pcond.notify_all()
+        for t in waiters:
+            t.join(timeout=args.timeout_ms / 1e3 + 5.0)
+    return per_cls
+
+
+def result_open(args, eng, warm, per_cls):
+    classes = {}
+    done = 0
+    for name, d in per_cls.items():
+        lat = sorted(d["lat"])
+        done += len(lat)
+        offered = d["offered"]
+        shed = d["shed"] + d["rate_limited"]
+        classes[name] = {
+            "offered": offered,
+            "completed": len(lat),
+            "shed": d["shed"],
+            "rate_limited": d["rate_limited"],
+            "timeout": d["timeout"],
+            "shed_rate": round(shed / offered, 4) if offered else 0.0,
+            "p50_ms": _pct(lat, 0.50),
+            "p95_ms": _pct(lat, 0.95),
+            "p99_ms": _pct(lat, 0.99),
+        }
+    all_lat = sorted(x for d in per_cls.values() for x in d["lat"])
+    return {
+        "metric": "open_loop_p99_ms",
+        "value": _pct(all_lat, 0.99),
+        "unit": "ms",
+        "mode": "open",
+        "engine_mode": eng.mode,
+        "qps_offered": args.qps,
+        "duration_s": args.duration_s,
+        "mix": args.mix,
+        "completed": done,
+        "p50_ms": _pct(all_lat, 0.50),
+        "p95_ms": _pct(all_lat, 0.95),
+        "p99_ms": _pct(all_lat, 0.99),
+        "classes": classes,
+        "recompiles_since_warmup": eng.recompiles_since_warmup(),
+        "warmup": warm,
+        "engine": eng.stats(),
+    }
+
+
+# -- A/B -------------------------------------------------------------------
+def run_compare(args):
+    """sync vs pipelined: closed-loop qps and open-loop p99."""
+    out = {"metric": "serve_pipeline_speedup", "unit": "x",
+           "mode": "compare", "block": args.block,
+           "device_ms": args.device_ms, "host_ms": args.host_ms,
+           "engines": {}}
+    for mode in ("sync", "pipelined"):
+        eng, warm = build_engine(args, mode=mode)
+        qps, lat, errors = drive_closed(eng, args)
+        closed = result_closed(args, eng, warm, qps, lat, errors)
+        eng2, warm2 = build_engine(args, mode=mode)
+        per_cls = drive_open(eng2, args)
+        open_ = result_open(args, eng2, warm2, per_cls)
+        out["engines"][mode] = {
+            "closed_qps": closed["value"],
+            "closed_p99_ms": closed["p99_ms"],
+            "open_p99_ms": open_["p99_ms"],
+            "open_p50_ms": open_["p50_ms"],
+            "open_completed": open_["completed"],
+            "max_inflight_seen":
+                closed["engine"]["max_inflight_seen"],
+            "recompiles_since_warmup":
+                closed["recompiles_since_warmup"],
+            "closed": closed, "open": open_,
+        }
+    sync, pipe = out["engines"]["sync"], out["engines"]["pipelined"]
+    out["value"] = round(pipe["closed_qps"] / sync["closed_qps"], 3) \
+        if sync["closed_qps"] else None
+    out["closed_qps_speedup"] = out["value"]
+    if sync["open_p99_ms"] and pipe["open_p99_ms"]:
+        out["open_p99_ratio"] = round(
+            pipe["open_p99_ms"] / sync["open_p99_ms"], 3)
+    return out
+
+
 def main(argv=None):
     p = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    p.add_argument("--mode", choices=("closed", "open", "compare"),
+                   default="closed")
+    p.add_argument("--engine", choices=("pipelined", "sync"),
+                   default="pipelined",
+                   help="engine execution mode (closed/open modes)")
+    p.add_argument("--inflight", type=int, default=2,
+                   help="bounded in-flight window (pipelined mode)")
+    p.add_argument("--block", choices=("mlp", "slow"), default="mlp")
+    p.add_argument("--device-ms", type=float, default=10.0,
+                   help="simulated device time per batch (--block slow)")
+    p.add_argument("--host-ms", type=float, default=0.0,
+                   help="synchronous host work per dispatch "
+                        "(--block slow)")
     p.add_argument("--clients", type=int, default=8)
     p.add_argument("--requests", type=int, default=50,
-                   help="round-trips per client")
+                   help="round-trips per client (closed mode)")
+    p.add_argument("--qps", type=float, default=100.0,
+                   help="offered Poisson arrival rate (open mode)")
+    p.add_argument("--duration-s", type=float, default=5.0,
+                   help="open-loop run length")
+    p.add_argument("--mix", default="interactive=0.9,batch=0.1",
+                   help="per-class arrival mix (open mode)")
+    p.add_argument("--rate-interactive", type=float, default=0.0,
+                   help="interactive-class token-bucket rate (0 = off)")
+    p.add_argument("--rate-batch", type=float, default=0.0,
+                   help="batch-class token-bucket rate (0 = off)")
     p.add_argument("--max-batch", type=int, default=16)
     p.add_argument("--queue", type=int, default=256)
     p.add_argument("--max-wait-ms", type=float, default=2.0)
@@ -107,33 +382,31 @@ def main(argv=None):
     p.add_argument("--features", type=int, default=128)
     p.add_argument("--hidden", type=int, default=256)
     p.add_argument("--classes", type=int, default=64)
+    p.add_argument("--json-out", default=None,
+                   help="also write the JSON result to this file")
     args = p.parse_args(argv)
 
-    eng, warm = build_engine(args)
-    qps, lat, errors = drive(eng, args)
-    recompiles = eng.recompiles_since_warmup()
+    if args.mode == "compare":
+        result = run_compare(args)
+        recompiles = max(
+            e["recompiles_since_warmup"] or 0
+            for e in result["engines"].values())
+    elif args.mode == "open":
+        eng, warm = build_engine(args)
+        per_cls = drive_open(eng, args)
+        result = result_open(args, eng, warm, per_cls)
+        recompiles = eng.recompiles_since_warmup()
+    else:
+        eng, warm = build_engine(args)
+        qps, lat, errors = drive_closed(eng, args)
+        result = result_closed(args, eng, warm, qps, lat, errors)
+        recompiles = eng.recompiles_since_warmup()
 
-    def pct(q):
-        if not lat:
-            return None
-        return round(lat[min(len(lat) - 1, int(q * len(lat)))] * 1e3, 3)
-
-    result = {
-        "metric": "inference_qps",
-        "value": round(qps, 2),
-        "unit": "req/s",
-        "clients": args.clients,
-        "requests_per_client": args.requests,
-        "completed": len(lat),
-        "shed": errors["shed"],
-        "timeout": errors["timeout"],
-        "p50_ms": pct(0.50),
-        "p99_ms": pct(0.99),
-        "recompiles_since_warmup": recompiles,
-        "warmup": warm,
-        "engine": eng.stats(),
-    }
     print(json.dumps(result))
+    if args.json_out:
+        with open(args.json_out, "w") as f:
+            json.dump(result, f, indent=2, sort_keys=True)
+            f.write("\n")
     if recompiles:
         print(f"ERROR: {recompiles} recompile(s) after warmup — the "
               "bench measured compiles, not serving", file=sys.stderr)
