@@ -969,13 +969,17 @@ def bench_ckpt_save_ms(platform, saves=3):
         shutil.rmtree(ckdir, ignore_errors=True)
 
 
-def bench_serving_qps(platform, clients=8, requests=40):
+def bench_serving_qps(platform, clients=8, requests=40,
+                      trace_sample=None):
     """Serving-engine round-trip QPS: `clients` threads hammering one
     dynamically-batching InferenceEngine through warmup()ed buckets
     (docs/serving.md). A small MLP keeps the row cheap enough to measure
     on the CPU fallback too — the number tracks the engine's
     queue/batch/dispatch overhead and cache-hit dispatch, not model
-    FLOPs. Raises if any served shape recompiled after warmup."""
+    FLOPs. Raises if any served shape recompiled after warmup.
+
+    trace_sample pins MXTPU_TRACE_SAMPLE for the run (restored after) —
+    the serve_qps_traced row A/Bs 0.1 head sampling against off."""
     import threading
 
     import numpy as onp
@@ -984,6 +988,22 @@ def bench_serving_qps(platform, clients=8, requests=40):
     from mxnet_tpu import serving
     from mxnet_tpu.gluon import nn
 
+    prev = os.environ.get("MXTPU_TRACE_SAMPLE")
+    if trace_sample is not None:
+        os.environ["MXTPU_TRACE_SAMPLE"] = str(trace_sample)
+    try:
+        return _bench_serving_qps_run(
+            mx, serving, nn, onp, threading, clients, requests)
+    finally:
+        if trace_sample is not None:
+            if prev is None:
+                os.environ.pop("MXTPU_TRACE_SAMPLE", None)
+            else:
+                os.environ["MXTPU_TRACE_SAMPLE"] = prev
+
+
+def _bench_serving_qps_run(mx, serving, nn, onp, threading, clients,
+                           requests):
     mx.seed(0)
     net = nn.HybridSequential()
     net.add(nn.Dense(256, activation="relu"), nn.Dense(64))
@@ -1357,6 +1377,23 @@ def main():
                     "(docs/serving.md)"})
     except Exception as e:
         rows.append({"metric": "inference_qps", "error": str(e)})
+
+    # request-tracing A/B: the same closed loop with 0.1 head sampling
+    # vs tracing off — the reqtrace acceptance bar is <3% qps regression
+    # when sampled (higher-is-better gate catches a bleed here)
+    try:
+        if over_budget():
+            raise TimeoutError("bench budget exhausted")
+        qps_off = bench_serving_qps(platform, trace_sample=0.0)
+        qps_on = bench_serving_qps(platform, trace_sample=0.1)
+        rows.append({
+            "metric": "serve_qps_traced" + suffix,
+            "value": round(qps_on, 2), "unit": "req/s",
+            "note": f"inference_qps with MXTPU_TRACE_SAMPLE=0.1 request "
+                    f"tracing; vs untraced: {qps_on / qps_off:.4f}x "
+                    f"(off={qps_off:.2f} req/s; docs/observability.md)"})
+    except Exception as e:
+        rows.append({"metric": "serve_qps_traced", "error": str(e)})
 
     # checkpoint commit latency runs on every platform (host-side work:
     # capture + npz + fsync + rename); _ms suffix → lower-is-better gate

@@ -159,6 +159,47 @@ register(
     "requests still queued at the bound are force-dropped (counted in "
     "serve_drain_dropped_total).")
 register(
+    "MXTPU_TRACE_SAMPLE", float, 0.0,
+    "Head-based request-trace sampling fraction for the serving tier "
+    "(observability/reqtrace.py): 0 = off (bit-identical serving path, "
+    "zero extra work), 1 = every request, 0.1 = exactly every 10th "
+    "(deterministic counter, no RNG). Sampled requests emit phase spans "
+    "(admit/queue/assemble/dispatch/device/slice/settle) into the trace "
+    "ring, served by opsd GET /traces.")
+register(
+    "MXTPU_TRACE_RING", int, 1024,
+    "Bounded per-process ring of finished request traces "
+    "(observability/reqtrace.py); a long-running replica keeps the "
+    "newest N traces for /traces and postmortem bundles.")
+register(
+    "MXTPU_SLO_INTERACTIVE_MS", float, 0.0,
+    "Latency objective (ms) for the 'interactive' serving class; 0 "
+    "disables SLO tracking for the class. Any class gets an objective "
+    "via MXTPU_SLO_<CLASS>_MS (docs/observability.md §6).")
+register(
+    "MXTPU_SLO_BATCH_MS", float, 0.0,
+    "Latency objective (ms) for the 'batch' serving class; 0 disables "
+    "SLO tracking for the class.")
+register(
+    "MXTPU_SLO_TARGET", float, 0.99,
+    "SLO success-fraction target: the error budget is 1 - target, and "
+    "the serve_slo_burn_rate gauge is the windowed violation fraction "
+    "over that budget.")
+register(
+    "MXTPU_SLO_WINDOW_S", float, 60.0,
+    "Rolling window (seconds) SLO burn rates are evaluated over; "
+    "violations roll off after this long, which is how a 503'd replica "
+    "recovers its /readyz.")
+register(
+    "MXTPU_SLO_BURN_MAX", float, 1.0,
+    "Burn-rate threshold: a class burning hotter than this drops the "
+    "replica from opsd /readyz rotation (1.0 = spending the error "
+    "budget exactly as fast as the target allows).")
+register(
+    "MXTPU_SLO_MIN_EVENTS", int, 10,
+    "Minimum windowed requests before a class's burn rate can flip "
+    "/readyz — keeps one unlucky request from 503ing an idle replica.")
+register(
     "MXTPU_FUSED_UPDATE", bool, True,
     "Fused multi-tensor optimizer update: bucket the parameter tree by "
     "(rule, weight dtype, multi-precision) and run ONE donated jit "

@@ -1,6 +1,7 @@
-"""Observability plane: numerics checking, flight recorder, postmortems.
+"""Observability plane: numerics checking, flight recorder, request
+tracing, postmortems.
 
-Three coordinated pieces (docs/observability.md):
+Coordinated pieces (docs/observability.md):
 
   * :mod:`~mxnet_tpu.observability.numerics` — a graph pass
     (``MXTPU_NUMERICS=off|step|op``) that instruments captured jaxprs
@@ -8,10 +9,13 @@ Three coordinated pieces (docs/observability.md):
     program to the first non-finite equation;
   * :mod:`~mxnet_tpu.observability.flight` — the bounded ring of
     structured runtime events every subsystem reports into;
+  * :mod:`~mxnet_tpu.observability.reqtrace` — per-request phase traces
+    through the serving pipeline (head-sampled via MXTPU_TRACE_SAMPLE)
+    plus the per-class SLO burn-rate plane that gates opsd ``/readyz``;
   * :mod:`~mxnet_tpu.observability.postmortem` — serializes everything
-    (events + telemetry + spans + compile registry + env snapshot) into
-    one atomic per-rank bundle that ``tools/blackbox.py`` merges across
-    ranks.
+    (events + telemetry + spans + request traces + compile registry +
+    env snapshot) into one atomic per-rank bundle that
+    ``tools/blackbox.py`` merges across ranks.
 
 Quick use::
 
@@ -26,7 +30,7 @@ from __future__ import annotations
 
 import os
 
-from . import flight, numerics, opsd, postmortem  # noqa: F401
+from . import flight, numerics, opsd, postmortem, reqtrace  # noqa: F401
 from .flight import (  # noqa: F401
     events, record, record_loss, set_identity, trace_id,
 )
@@ -34,7 +38,7 @@ from .numerics import NonFiniteError  # noqa: F401
 from .postmortem import dump, install_crash_hooks  # noqa: F401
 
 __all__ = [
-    "flight", "numerics", "opsd", "postmortem",
+    "flight", "numerics", "opsd", "postmortem", "reqtrace",
     "record", "record_event", "record_loss", "events",
     "set_identity", "trace_id",
     "dump", "install_crash_hooks", "reset",
@@ -45,9 +49,11 @@ record_event = record
 
 
 def reset():
-    """Test hygiene: drop flight events and numerics trip bookkeeping."""
+    """Test hygiene: drop flight events, numerics trip bookkeeping, and
+    request traces / SLO windows."""
     flight.reset()
     numerics.reset()
+    reqtrace.reset()
 
 
 if os.environ.get("MXTPU_FLIGHTREC_CRASHDUMP", "").lower() \

@@ -237,10 +237,18 @@ def _maybe_flush():
         pass
 
 
-def events():
-    """Snapshot of the ring, oldest first."""
+def events(kind=None):
+    """Snapshot of the ring, oldest first. ``kind`` filters by
+    event-kind PREFIX (``kind="serve"`` matches serve_batch /
+    serve_shed / serve_start / ... — families share a prefix by
+    convention), so opsd's ``/flight?kind=`` can hand a fleet poller
+    just the serving events without dragging the whole ring."""
     with _lock:
-        return list(_ring)
+        evs = list(_ring)
+    if kind:
+        k = str(kind)
+        evs = [e for e in evs if str(e.get("kind", "")).startswith(k)]
+    return evs
 
 
 def reset():

@@ -12,7 +12,12 @@ the TensorFlow paper's long-running training/serving-fleet framing):
   GET  /readyz           readiness: no ongoing watchdog stall, every
                          registered serving engine admitting (503 + the
                          failing checks otherwise)
-  GET  /flight?n=N       live flight-ring tail as JSON (newest N)
+  GET  /flight?n=N       live flight-ring tail as JSON (newest N);
+                         &kind=PREFIX filters by event-kind prefix
+                         (kind=serve pulls only serving events)
+  GET  /traces?n=N       newest N finished request traces (reqtrace.py:
+                         phase spans, batch links, SLO table, per-phase
+                         summary); &class= / &model= filter
   GET  /steps            step-tracer phase table + last-step/step-rate
   GET  /identity         (job_id, rank, world) + pid/host/port — stamped
                          by kvstore.tpu_dist at collective init
@@ -131,6 +136,21 @@ def readiness_payload():
         }
     except Exception as e:
         checks["serving"] = {"ok": True, "error": repr(e)}
+    try:
+        from . import reqtrace
+
+        # a class burning through its error budget drops this replica
+        # from rotation (front doors poll /readyz); recovery is
+        # automatic once the rolling window sheds the violations
+        burning = reqtrace.slo_burning()
+        checks["slo"] = {
+            "ok": not burning,
+            "burning": burning,
+            "status": reqtrace.slo_status(),
+        }
+        ready &= not burning
+    except Exception as e:
+        checks["slo"] = {"ok": True, "error": repr(e)}
     return {"ready": bool(ready), "checks": checks}
 
 
@@ -169,16 +189,40 @@ def identity_payload(srv=None):
     return out
 
 
-def flight_payload(n=256):
+def flight_payload(n=256, kind=None):
     from . import flight as _flight
 
-    evs = _flight.events()
+    evs = _flight.events(kind=kind)
     n = max(0, int(n))
     return {
         "identity": _flight.identity(),
         "capacity": _flight.capacity(),
+        "kind": kind,
         "total": len(evs),
         "events": evs[-n:] if n else [],
+    }
+
+
+def traces_payload(n=32, cls=None, model=None):
+    """Finished request traces + batch causality links + the live SLO
+    table and per-phase latency breakdown (reqtrace.py). ``n=0`` keeps
+    just the summaries — what fleetctl polls per rank."""
+    from . import flight as _flight
+    from . import reqtrace
+
+    recs = reqtrace.traces(cls=cls, model=model)
+    n = max(0, int(n))
+    return {
+        "identity": _flight.identity(),
+        "sample_rate": reqtrace.sample_rate(),
+        "capacity": reqtrace.ring_capacity(),
+        "class": cls,
+        "model": model,
+        "total": len(recs),
+        "traces": recs[-n:] if n else [],
+        "batches": reqtrace.batches(n),
+        "phases": reqtrace.phase_summary(),
+        "slo": reqtrace.slo_status(),
     }
 
 
@@ -238,12 +282,19 @@ class _Handler(BaseHTTPRequestHandler):
                 self._send(200, identity_payload(self.ops))
             elif url.path == "/flight":
                 n = int(q.get("n", ["256"])[0])
-                self._send(200, flight_payload(n))
+                kind = q.get("kind", [None])[0]
+                self._send(200, flight_payload(n, kind=kind))
+            elif url.path == "/traces":
+                n = int(q.get("n", ["32"])[0])
+                cls = q.get("class", [None])[0]
+                model = q.get("model", [None])[0]
+                self._send(200, traces_payload(n, cls=cls, model=model))
             elif url.path == "/":
                 self._send(200, {
                     "server": "mxtpu-opsd",
                     "endpoints": ["/metrics", "/healthz", "/readyz",
                                   "/steps", "/identity", "/flight",
+                                  "/traces",
                                   "POST /postmortem", "POST /profile"],
                 })
             else:

@@ -110,6 +110,19 @@ def build_bundle(reason, extra=None):
         bundle["watchdog_dump"] = _watchdog.last_dump()
     except Exception:
         bundle["watchdog_dump"] = None
+    try:
+        from . import reqtrace
+
+        # request traces + batch links + SLO table ride in the bundle so
+        # tools/blackbox.py can interleave per-request spans with rank
+        # spans in the merged chrome trace
+        bundle["req_traces"] = reqtrace.traces()
+        bundle["req_batches"] = reqtrace.batches()
+        bundle["slo"] = reqtrace.slo_status()
+    except Exception as e:
+        bundle["req_traces"] = []
+        bundle["req_batches"] = []
+        bundle["slo"] = {"error": repr(e)}
     if extra:
         bundle.update(extra)
     return bundle

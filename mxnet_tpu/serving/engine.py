@@ -58,10 +58,24 @@ from ..diagnostics import spans as _spans
 from ..ndarray.ndarray import NDArray
 from ..telemetry import instruments as _instr
 from .buckets import assemble_batch, bucket_ladder, pad_rows, pick_bucket
-from .errors import EngineStopped, RequestTimeout
+from .errors import EngineStopped, Overloaded, RequestTimeout
 from .scheduler import RequestScheduler
 
 __all__ = ["InferenceEngine", "ServeRequest"]
+
+_REQTRACE = [None]
+
+
+def _reqtrace():
+    """Lazy, cached handle on observability.reqtrace (imported at first
+    use, not at module import — serving loads before observability in
+    the package graph)."""
+    rt = _REQTRACE[0]
+    if rt is None:
+        from ..observability import reqtrace as rt
+
+        _REQTRACE[0] = rt
+    return rt
 
 
 def _to_host(a):
@@ -92,7 +106,7 @@ class ServeRequest:
 
     __slots__ = ("inputs", "rows", "signature", "cls", "t_submit",
                  "t_dispatch", "deadline", "_event", "_lock", "outcome",
-                 "_result", "_error")
+                 "_result", "_error", "model", "trace")
 
     def __init__(self, inputs, rows, signature, deadline, cls="interactive"):
         self.inputs = inputs
@@ -104,12 +118,19 @@ class ServeRequest:
         self.deadline = deadline  # absolute monotonic seconds, or None
         self._event = threading.Event()
         self._lock = threading.Lock()
-        self.outcome = None  # ok | timeout | error (claimed once)
+        self.outcome = None  # ok | timeout | error | shed (claimed once)
         self._result = None
         self._error = None
+        self.model = ""    # owning engine name (SLO attribution)
+        self.trace = None  # reqtrace.ReqTrace when sampled, else None
 
     def _finish(self, outcome, result=None, error=None):
-        """Claim the outcome; True iff this call won the claim."""
+        """Claim the outcome; True iff this call won the claim.
+
+        Every settled request — served, timed out, errored, or shed —
+        funnels through here, so this is also the reqtrace/SLO terminal
+        chokepoint: the trace (when sampled) freezes into the ring with
+        its terminal span, and the latency feeds the class SLO window."""
         with self._lock:
             if self.outcome is not None:
                 return False
@@ -117,6 +138,10 @@ class ServeRequest:
             self._result = result
             self._error = error
         self._event.set()
+        try:
+            _reqtrace().finish(self, outcome, error)
+        except Exception:
+            pass
         return True
 
     @property
@@ -149,14 +174,18 @@ class ServeRequest:
 class _Flight:
     """One dispatched-but-unsettled micro-batch in the pipeline window."""
 
-    __slots__ = ("batch", "datas", "rows", "bucket", "t_dispatch")
+    __slots__ = ("batch", "datas", "rows", "bucket", "t_dispatch",
+                 "batch_id", "traced")
 
-    def __init__(self, batch, datas, rows, bucket):
+    def __init__(self, batch, datas, rows, bucket, batch_id=None,
+                 traced=()):
         self.batch = batch
         self.datas = datas
         self.rows = rows
         self.bucket = bucket
         self.t_dispatch = time.monotonic()
+        self.batch_id = batch_id  # reqtrace causality id (None unsampled)
+        self.traced = traced      # member ReqTraces sharing batch stamps
 
 
 class InferenceEngine:
@@ -474,9 +503,21 @@ class InferenceEngine:
             else self._sched.default_class
         req = ServeRequest(tuple(arrays), rows, signature, deadline,
                            cls=cls)
+        req.model = self.name
+        try:  # head-based sampling decision: None on the unsampled path
+            req.trace = _reqtrace().maybe_start(
+                self.name, cls=cls, rows=rows, deadline=deadline)
+        except Exception:
+            req.trace = None
         if self._stopping:
-            raise EngineStopped(f"engine {self.name!r} is stopped")
-        self._sched.offer(req)  # sheds with Overloaded / RateLimited
+            err = EngineStopped(f"engine {self.name!r} is stopped")
+            req._finish("shed", error=err)  # terminal trace span
+            raise err
+        try:
+            self._sched.offer(req)  # sheds with Overloaded / RateLimited
+        except Overloaded as e:  # includes RateLimited
+            req._finish("shed", error=e)  # terminal span with the reason
+            raise
         return req
 
     def predict(self, *inputs, timeout_ms=None, priority=None):
@@ -501,6 +542,19 @@ class InferenceEngine:
         failed and was settled with the error)."""
         rows = sum(r.rows for r in batch)
         bucket = pick_bucket(self.buckets, rows)
+        # sampled members share batch-wide boundary stamps (ONE
+        # perf_counter read per boundary per batch) and a batch id —
+        # the batch->request causality link; unsampled batches pay one
+        # empty list comprehension here and nothing below
+        traced = [r.trace for r in batch if r.trace is not None]
+        batch_id = None
+        if traced:
+            batch_id = _reqtrace().next_batch_id()
+            t_asm = time.perf_counter()
+            for tr in traced:
+                tr.stamp("assembling", t_asm)  # queue phase closes
+                tr.batch_id = batch_id
+                tr.bucket = bucket
         try:
             with _spans.span(self.name, cat="serve"):
                 padded = assemble_batch([r.inputs for r in batch], bucket)
@@ -510,13 +564,22 @@ class InferenceEngine:
                     nds = [NDArray(a) for a in padded]
                 else:
                     nds = [NDArray(jnp.asarray(a)) for a in padded]
+                if traced:
+                    t_disp = time.perf_counter()
+                    for tr in traced:
+                        tr.stamp("dispatching", t_disp)
                 out = self._block.call_cached_graph(*nds)
             datas = [o._data for o in self._flatten_out(out)]
+            if traced:
+                t_issued = time.perf_counter()
+                for tr in traced:
+                    tr.stamp("dispatched", t_issued)
             now = time.monotonic()
             for r in batch:
                 r.t_dispatch = now
             self._c_dispatch.inc()
-            return _Flight(batch, datas, rows, bucket)
+            return _Flight(batch, datas, rows, bucket,
+                           batch_id=batch_id, traced=traced)
         except Exception as e:  # noqa: BLE001 — batch failure -> per-request
             now = time.monotonic()
             for r in batch:
@@ -531,6 +594,10 @@ class InferenceEngine:
         try:
             with _spans.span(self.name, cat="serve_complete"):
                 _wait_ready(flight.datas)
+            if flight.traced:
+                t_ready = time.perf_counter()
+                for tr in flight.traced:
+                    tr.stamp("ready", t_ready)  # device phase closes
             _instr.record_serve_batch(self.name, flight.rows,
                                       flight.bucket)
             off, now = 0, time.monotonic()
@@ -539,10 +606,16 @@ class InferenceEngine:
                 # never reaches a client
                 sl = [NDArray(d[off:off + r.rows]) for d in flight.datas]
                 res = sl[0] if len(sl) == 1 else tuple(sl)
+                if r.trace is not None:
+                    r.trace.stamp("sliced")
                 if r._finish("ok", result=res):
                     _instr.record_serve_request(
                         self.name, "ok", now - r.t_submit)
                 off += r.rows
+            if flight.traced:
+                _reqtrace().record_batch(
+                    flight.batch_id, self.name, flight.traced,
+                    flight.rows, flight.bucket)
         except Exception as e:  # noqa: BLE001 — batch failure -> per-request
             now = time.monotonic()
             for r in flight.batch:
@@ -705,4 +778,20 @@ class InferenceEngine:
             "p50_ms": self._latency_quantile_ms(0.50),
             "p99_ms": self._latency_quantile_ms(0.99),
             "recompiles_since_warmup": self.recompiles_since_warmup(),
+            "trace_sample": self._trace_sample(),
+            "slo": self._slo_status(),
         }
+
+    def _trace_sample(self):
+        try:
+            return _reqtrace().sample_rate()
+        except Exception:
+            return 0.0
+
+    def _slo_status(self):
+        """This model's per-class SLO table (None when no class has a
+        declared objective or no traffic has been observed)."""
+        try:
+            return _reqtrace().slo_status().get(self.name)
+        except Exception:
+            return None

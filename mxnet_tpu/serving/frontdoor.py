@@ -137,12 +137,17 @@ class FrontDoor:
         sheds, :class:`EngineStopped` when no replica is healthy at
         all."""
         last = None
-        for eng in self._candidates():
+        for tries, eng in enumerate(self._candidates(), start=1):
             try:
                 req = eng.submit(*inputs, timeout_ms=timeout_ms,
                                  priority=priority)
                 with self._lock:
                     self._routed[eng.name] += 1
+                if req.trace is not None:
+                    # routing context on the sampled trace: which
+                    # replica won and how many sheds it took to land
+                    req.trace.annotate(frontdoor=self.name,
+                                       replica=eng.name, tries=tries)
                 return req
             except Overloaded as e:  # includes RateLimited
                 last = e  # shed here — fail over to the next replica

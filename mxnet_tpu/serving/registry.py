@@ -140,6 +140,20 @@ class ModelRegistry:
             engines = dict(self._engines)
         return {n: e.stats() for n, e in sorted(engines.items())}
 
+    def slo_status(self):
+        """{name: per-class SLO table} for every registered model that
+        has a declared objective and observed traffic — the registry
+        slice of ``reqtrace.slo_status()`` (opsd's ``/readyz`` reads the
+        full process-wide table; this is the per-registry view)."""
+        with self._lock:
+            names = sorted(self._engines)
+        try:
+            from ..observability import reqtrace
+        except Exception:
+            return {}
+        table = reqtrace.slo_status()
+        return {n: table[n] for n in names if n in table}
+
     def stop_all(self):
         """Unregister and drain every engine (process shutdown hook)."""
         with self._lock:

@@ -159,6 +159,9 @@ class RequestScheduler:
                     f"engine {self.model!r} queue at bound "
                     f"{self.max_queue}; request shed")
             cq.queue.append(req)
+            tr = getattr(req, "trace", None)
+            if tr is not None:  # reqtrace: admission won — admit phase
+                tr.stamp("admitted")  # closes, queue phase opens
             cq.g_depth.set(len(cq.queue))
             self._set_total_gauge()
             self.cond.notify_all()

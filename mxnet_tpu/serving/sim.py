@@ -106,6 +106,8 @@ class SimulatedBlock:
         self._fn = fn if fn is not None else lambda *a: a[0]
         self._q = queue.Queue()
         self._calls = 0
+        self._done = 0
+        self._busy_s = 0.0
         self._calls_lock = threading.Lock()
         self._device = threading.Thread(
             target=self._device_loop, name="mxtpu-sim-device", daemon=True)
@@ -118,9 +120,13 @@ class SimulatedBlock:
             if item is None:
                 return
             arrays, pending = item
+            t0 = time.perf_counter()
             time.sleep(self.device_ms / 1e3)  # GIL released: "compute"
             out = self._fn(*arrays)
             pending._set(_np.asarray(out))
+            with self._calls_lock:
+                self._done += 1
+                self._busy_s += time.perf_counter() - t0
 
     def close(self):
         self._q.put(None)
@@ -152,3 +158,17 @@ class SimulatedBlock:
     def dispatches(self):
         with self._calls_lock:
             return self._calls
+
+    @property
+    def batches_done(self):
+        """Batches the device stream has finished (vs ``dispatches``
+        enqueued — the gap is the in-flight window)."""
+        with self._calls_lock:
+            return self._done
+
+    @property
+    def busy_ms(self):
+        """Total device-stream busy time — the ground truth a traced
+        request's ``device`` phase spans are checked against."""
+        with self._calls_lock:
+            return self._busy_s * 1e3

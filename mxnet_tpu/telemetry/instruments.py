@@ -44,9 +44,12 @@ __all__ = [
     "serve_dispatch_total", "serve_inflight_batches",
     "serve_class_queue_depth", "serve_class_shed_total",
     "serve_drain_dropped_total",
+    "serve_trace_total", "serve_slo_burn_rate",
+    "serve_slo_violation_total",
     "record_compile", "record_trace", "record_fallback", "record_transfer",
     "record_sync", "record_collective", "observe_step", "set_flop_budget",
-    "record_serve_request", "record_serve_batch", "nbytes_of",
+    "record_serve_request", "record_serve_batch", "record_serve_trace",
+    "set_slo_burn", "record_slo_violation", "nbytes_of",
     "numerics_trip_total", "flight_events_total", "postmortem_dump_total",
     "record_numerics_trip", "record_flight_event", "record_postmortem",
     "kernel_dispatch_total", "kernel_bytes_saved",
@@ -301,6 +304,24 @@ serve_drain_dropped_total = counter(
     "Requests force-dropped unserved because stop(drain=True) hit its "
     "bounded drain deadline (or the engine was never started)",
     ["model"])
+serve_trace_total = counter(
+    "serve_trace_total",
+    "Sampled request traces frozen into the reqtrace ring, by terminal "
+    "outcome (ok / shed / timeout / error); at MXTPU_TRACE_SAMPLE=0 "
+    "this never moves (observability/reqtrace.py)",
+    ["model", "outcome"])
+serve_slo_burn_rate = gauge(
+    "serve_slo_burn_rate",
+    "Per-class SLO burn rate over the rolling MXTPU_SLO_WINDOW_S "
+    "window: windowed bad fraction / error budget (1 - "
+    "MXTPU_SLO_TARGET). 1.0 = burning budget exactly as fast as "
+    "allowed; above MXTPU_SLO_BURN_MAX the replica drops from /readyz "
+    "rotation", ["model", "cls"])
+serve_slo_violation_total = counter(
+    "serve_slo_violation_total",
+    "Requests that violated their class SLO, by kind: 'latency' "
+    "(served but over the objective), 'shed', 'timeout', or 'error'",
+    ["model", "cls", "kind"])
 
 
 # -- observability plane (mxnet_tpu/observability/; docs/observability.md) --
@@ -499,6 +520,28 @@ def record_serve_request(model, outcome, seconds=None):
         serve_timeout_total.labels(model).inc()
     if seconds is not None:
         serve_request_latency_seconds.labels(model).observe(seconds)
+
+
+def record_serve_trace(model, outcome):
+    """One sampled request trace frozen into the reqtrace ring."""
+    if not REGISTRY.enabled:
+        return
+    serve_trace_total.labels(model, outcome).inc()
+
+
+def set_slo_burn(model, cls, burn):
+    """Publish a class's fresh SLO burn rate (reqtrace.slo_observe and
+    every slo_status read keep this live)."""
+    if not REGISTRY.enabled:
+        return
+    serve_slo_burn_rate.labels(model, cls).set(float(burn))
+
+
+def record_slo_violation(model, cls, kind):
+    """One request that blew its class objective, by violation kind."""
+    if not REGISTRY.enabled:
+        return
+    serve_slo_violation_total.labels(model, cls, kind).inc()
 
 
 def record_serve_batch(model, rows, bucket):

@@ -1,0 +1,364 @@
+"""Request-level distributed tracing + SLO plane (ISSUE-16:
+observability/reqtrace.py).
+
+The acceptance spine: MXTPU_TRACE_SAMPLE=0 is bitwise-identical serving
+with zero extra jit traces; sampled requests carry telescoping phase
+spans whose durations sum to the honest end-to-end latency; coalesced
+requests share a batch causality record; shed/expired requests get
+terminal spans with the shed reason visible in opsd ``/traces``; SLO
+burn flips ``/readyz`` to 503 and recovers when the window rolls off;
+blackbox merges request traces from two ranks into one chrome trace.
+"""
+import json
+import os
+import sys
+import time
+import urllib.error
+import urllib.request
+
+import numpy as onp
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import observability, serving
+from mxnet_tpu.observability import flight, opsd, postmortem, reqtrace
+from mxnet_tpu.serving import Overloaded, RateLimited, RequestTimeout
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", "tools"))
+import blackbox  # noqa: E402
+import fleetctl  # noqa: E402
+
+
+@pytest.fixture(autouse=True)
+def fresh(tmp_path, monkeypatch):
+    monkeypatch.setenv("MXTPU_FLIGHTREC_DIR", str(tmp_path))
+    for var in ("MXTPU_TRACE_SAMPLE", "MXTPU_TRACE_RING",
+                "MXTPU_SLO_INTERACTIVE_MS", "MXTPU_SLO_BATCH_MS",
+                "MXTPU_SLO_WINDOW_S", "MXTPU_SLO_MIN_EVENTS"):
+        monkeypatch.delenv(var, raising=False)
+    observability.reset()
+    yield
+    observability.reset()
+
+
+def sim_engine(device_ms=2.0, max_batch=4, **kw):
+    kw.setdefault("max_wait_ms", 1.0)
+    kw.setdefault("timeout_ms", 30_000.0)
+    return serving.InferenceEngine(
+        serving.SimulatedBlock(device_ms=device_ms),
+        name=kw.pop("name", "sim"), max_batch_size=max_batch, **kw)
+
+
+def _get(base, path, timeout=5):
+    """(status, parsed json); 4xx/5xx return, not raise."""
+    try:
+        with urllib.request.urlopen(base + path, timeout=timeout) as r:
+            return r.status, json.loads(r.read().decode())
+    except urllib.error.HTTPError as e:
+        return e.code, json.loads(e.read().decode())
+
+
+# --- sampling ---------------------------------------------------------------
+
+def test_sample_zero_is_bitwise_identical_with_zero_traces(monkeypatch):
+    """The acceptance bar: tracing off = the exact serving path, no
+    extra jit traces, no trace records — and turning sampling ON does
+    not perturb the numerics either (same cached graphs, same bits)."""
+    mx.seed(0)
+    from mxnet_tpu.gluon import nn
+
+    net = nn.HybridSequential()
+    net.add(nn.Dense(8, activation="relu"), nn.Dense(3))
+    net.initialize()
+    net.hybridize()
+
+    def run(sample):
+        monkeypatch.setenv("MXTPU_TRACE_SAMPLE", sample)
+        observability.reset()
+        eng = serving.InferenceEngine(net, name=f"bits-{sample}",
+                                      max_batch_size=4, max_wait_ms=1.0)
+        assert eng.mode == "pipelined"
+        eng.warmup(mx.np.zeros((1, 6)))
+        outs = []
+        with eng:
+            for rows in (1, 2, 3, 4, 1, 3):
+                outs.append(eng.predict(
+                    onp.ones((rows, 6), onp.float32)).asnumpy())
+        assert eng.recompiles_since_warmup() == 0
+        return outs
+
+    off = run("0")
+    assert reqtrace.traces() == []          # zero records at sample 0
+    assert reqtrace.batches() == []
+    on = run("1.0")
+    assert len(reqtrace.traces()) == 6      # every request sampled
+    for a, b in zip(off, on):
+        assert onp.array_equal(a, b)        # bitwise, not approx
+
+
+def test_head_sampling_is_deterministic_counter_not_rng(monkeypatch):
+    monkeypatch.setenv("MXTPU_TRACE_SAMPLE", "0.5")
+    observability.reset()
+    got = [reqtrace.maybe_start("m") is not None for _ in range(10)]
+    assert sum(got) == 5                    # exactly, not statistically
+    monkeypatch.setenv("MXTPU_TRACE_SAMPLE", "0")
+    assert reqtrace.maybe_start("m") is None
+
+
+def test_trace_ring_is_bounded_by_env(monkeypatch):
+    monkeypatch.setenv("MXTPU_TRACE_SAMPLE", "1.0")
+    monkeypatch.setenv("MXTPU_TRACE_RING", "8")
+    observability.reset()
+    eng = sim_engine(device_ms=0.5, name="ringed")
+    with eng:
+        for _ in range(20):
+            eng.predict(onp.zeros((1, 4), onp.float32))
+    recs = reqtrace.traces()
+    assert len(recs) == 8                   # newest 8 of 20
+    assert reqtrace.ring_capacity() == 8
+
+
+# --- span model -------------------------------------------------------------
+
+def test_span_durations_sum_to_honest_end_to_end_latency(monkeypatch):
+    monkeypatch.setenv("MXTPU_TRACE_SAMPLE", "1.0")
+    observability.reset()
+    eng = sim_engine(device_ms=3.0, name="honest")
+    with eng:
+        for _ in range(4):
+            eng.predict(onp.zeros((2, 4), onp.float32))
+    for rec in reqtrace.traces(model="honest"):
+        assert rec["outcome"] == "ok"
+        phases = [s["phase"] for s in rec["spans"]]
+        assert phases == list(reqtrace.PHASES)
+        # telescoping: each span starts where the previous one ended,
+        # so the durations sum to the request's total latency exactly
+        for prev, cur in zip(rec["spans"], rec["spans"][1:]):
+            assert cur["t0"] == pytest.approx(prev["t0"] + prev["dur"])
+        span_ms = sum(s["dur"] for s in rec["spans"]) * 1e3
+        assert span_ms == pytest.approx(rec["total_ms"], abs=1e-6)
+        assert rec["total_ms"] >= 3.0       # device time is in there
+
+
+def test_coalesced_requests_share_batch_causality_record(monkeypatch):
+    monkeypatch.setenv("MXTPU_TRACE_SAMPLE", "1.0")
+    observability.reset()
+    eng = sim_engine(device_ms=1.0, max_batch=4, name="causal")
+    x = onp.zeros((1, 4), onp.float32)
+    r1, r2 = eng.submit(x), eng.submit(x)   # queued before threads start
+    with eng:
+        r1.result(), r2.result()
+    recs = {r["trace_id"]: r for r in reqtrace.traces(model="causal")}
+    t1, t2 = r1.trace.trace_id, r2.trace.trace_id
+    assert recs[t1]["batch"] == recs[t2]["batch"] is not None
+    batch = next(b for b in reqtrace.batches()
+                 if b["batch_id"] == recs[t1]["batch"])
+    assert set(batch["trace_ids"]) >= {t1, t2}
+    assert [s["phase"] for s in batch["spans"]] == \
+        ["assemble", "dispatch", "device"]
+
+
+# --- shed / expired terminal spans ------------------------------------------
+
+def test_shed_and_expired_requests_get_terminal_spans(monkeypatch):
+    monkeypatch.setenv("MXTPU_TRACE_SAMPLE", "1.0")
+    observability.reset()
+    x = onp.zeros((1, 4), onp.float32)
+
+    eng = sim_engine(max_queue=1, name="shed")  # never started: no drain
+    eng.submit(x)
+    with pytest.raises(Overloaded):
+        eng.submit(x)
+    rec = reqtrace.traces(model="shed")[-1]
+    assert (rec["outcome"], rec["reason"]) == ("shed", "queue")
+    assert [s["phase"] for s in rec["spans"]] == ["shed"]
+
+    eng2 = sim_engine(name="expired")           # never started
+    with pytest.raises(RequestTimeout):
+        eng2.submit(x, timeout_ms=20).result()
+    rec = reqtrace.traces(model="expired")[-1]
+    assert (rec["outcome"], rec["reason"]) == ("timeout", "deadline")
+    assert rec["spans"][-1]["phase"] == "timeout"
+
+    eng3 = sim_engine(name="limited", classes=(
+        serving.ServeClass("interactive", 0, rate=1e-4),))
+    with pytest.raises(RateLimited):
+        for _ in range(50):
+            eng3.submit(x, priority="interactive")
+    rec = reqtrace.traces(model="limited")[-1]
+    assert (rec["outcome"], rec["reason"]) == ("shed", "rate")
+
+
+# --- SLO plane --------------------------------------------------------------
+
+def test_slo_burn_flips_readyz_and_recovers(monkeypatch):
+    monkeypatch.setenv("MXTPU_TRACE_SAMPLE", "1.0")
+    monkeypatch.setenv("MXTPU_SLO_INTERACTIVE_MS", "1.0")
+    monkeypatch.setenv("MXTPU_SLO_WINDOW_S", "0.8")
+    monkeypatch.setenv("MXTPU_SLO_MIN_EVENTS", "3")
+    observability.reset()
+    srv = opsd.OpsServer(port=0).start()
+    base = f"http://127.0.0.1:{srv.port}"
+    try:
+        st, _ = _get(base, "/readyz")
+        assert st == 200                    # no traffic: not burning
+        eng = sim_engine(device_ms=10.0, name="slo")  # 10ms >> 1ms SLO
+        with eng:
+            for _ in range(5):
+                eng.predict(onp.zeros((1, 4), onp.float32))
+        st, rz = _get(base, "/readyz")
+        assert st == 503
+        slo = rz["checks"]["slo"]
+        assert not slo["ok"]
+        assert "slo/interactive" in slo["burning"]
+        cls = slo["status"]["slo"]["interactive"]
+        assert cls["burning"] and cls["burn"] > 1.0
+        assert cls["objective_ms"] == 1.0
+        time.sleep(1.0)                     # violations roll off the
+        st, rz = _get(base, "/readyz")      # window without new traffic
+        assert st == 200
+        assert rz["checks"]["slo"]["ok"]
+    finally:
+        srv.stop()
+
+
+def test_slo_untracked_without_objective(monkeypatch):
+    monkeypatch.setenv("MXTPU_TRACE_SAMPLE", "0")   # SLO works unsampled
+    observability.reset()
+    reqtrace.slo_observe("m", "interactive", "ok", 0.5)
+    assert reqtrace.slo_status() == {}      # no objective: no window
+    reqtrace.set_slo_objective("interactive", 100.0)
+    reqtrace.slo_observe("m", "interactive", "ok", 0.5)
+    st = reqtrace.slo_status()["m"]["interactive"]
+    assert st["events"] == 1 and st["bad"] == 1     # 500ms > 100ms
+    assert not st["burning"]                # below MIN_EVENTS floor
+
+
+# --- opsd endpoints ---------------------------------------------------------
+
+def test_opsd_traces_endpoint_filters_and_carries_reasons(monkeypatch):
+    monkeypatch.setenv("MXTPU_TRACE_SAMPLE", "1.0")
+    observability.reset()
+    srv = opsd.OpsServer(port=0).start()
+    base = f"http://127.0.0.1:{srv.port}"
+    try:
+        eng = sim_engine(device_ms=1.0, name="live",
+                         classes=(serving.ServeClass("interactive", 0),
+                                  serving.ServeClass("batch", 10)))
+        with eng:
+            eng.predict(onp.zeros((1, 4), onp.float32),
+                        priority="interactive")
+            eng.predict(onp.zeros((1, 4), onp.float32), priority="batch")
+        eng2 = sim_engine(max_queue=1, name="turned-away")
+        eng2.submit(onp.zeros((1, 4), onp.float32))
+        with pytest.raises(Overloaded):
+            eng2.submit(onp.zeros((1, 4), onp.float32))
+
+        st, tr = _get(base, "/traces")
+        assert st == 200 and tr["total"] == 3
+        by_outcome = {r["outcome"] for r in tr["traces"]}
+        assert by_outcome == {"ok", "shed"}
+        shed = next(r for r in tr["traces"] if r["outcome"] == "shed")
+        assert shed["reason"] == "queue"    # the 3am answer, in-band
+        assert tr["phases"]["device"]["n"] == 2
+
+        st, tr = _get(base, "/traces?class=batch&n=1")
+        assert st == 200
+        assert [r["cls"] for r in tr["traces"]] == ["batch"]
+        st, tr = _get(base, "/traces?model=live")
+        assert {r["model"] for r in tr["traces"]} == {"live"}
+    finally:
+        srv.stop()
+
+
+def test_opsd_flight_kind_filter(monkeypatch):
+    observability.reset()
+    flight.record("serve_start", model="m")
+    flight.record("serve_shed", model="m", reason="queue")
+    flight.record("ckpt_commit", step=1)
+    assert {e["kind"] for e in flight.events(kind="serve")} == \
+        {"serve_start", "serve_shed"}
+    srv = opsd.OpsServer(port=0).start()
+    base = f"http://127.0.0.1:{srv.port}"
+    try:
+        st, fl = _get(base, "/flight?kind=serve")
+        assert st == 200 and fl["kind"] == "serve"
+        assert {e["kind"] for e in fl["events"]} == \
+            {"serve_start", "serve_shed"}
+        st, fl = _get(base, "/flight")
+        assert {e["kind"] for e in fl["events"]} >= {"ckpt_commit"}
+    finally:
+        srv.stop()
+
+
+# --- fleet / postmortem merge ----------------------------------------------
+
+def test_postmortem_bundle_carries_request_traces(monkeypatch):
+    monkeypatch.setenv("MXTPU_TRACE_SAMPLE", "1.0")
+    observability.reset()
+    eng = sim_engine(device_ms=1.0, name="pm")
+    with eng:
+        for _ in range(3):
+            eng.predict(onp.zeros((1, 4), onp.float32))
+    b = postmortem.build_bundle(reason="test")
+    assert len(b["req_traces"]) == 3
+    assert len(b["req_batches"]) >= 1
+    assert "slo" in b
+
+
+def test_blackbox_merges_request_traces_from_two_ranks(
+        monkeypatch, tmp_path):
+    monkeypatch.setenv("MXTPU_TRACE_SAMPLE", "1.0")
+    observability.reset()
+    eng = sim_engine(device_ms=1.0, name="merged")
+    with eng:
+        for _ in range(2):
+            eng.predict(onp.zeros((1, 4), onp.float32))
+    b = json.loads(json.dumps(postmortem.build_bundle(reason="test"),
+                              default=str))
+    paths = []
+    for rank in (0, 1):
+        bb = dict(b, identity={"rank": rank, "job": "j"})
+        p = tmp_path / f"r{rank}.json"
+        p.write_text(json.dumps(bb))
+        paths.append(str(p))
+    trace, text = blackbox.merge(paths)
+    evs = [e for e in trace["traceEvents"] if e.get("cat") == "reqtrace"]
+    assert {e["pid"] for e in evs} == {0, 1}
+    assert {e["name"] for e in evs} >= {"req:device", "req:settle",
+                                        "batch:device"}
+    req_evs = [e for e in evs if e["name"].startswith("req:")]
+    assert all(e["args"]["trace_id"] for e in req_evs)
+    assert "2 req traces" in text
+
+
+def test_fleetctl_renders_slo_and_phase_cells():
+    r = {"slo_burn": 1.3, "slo_burning": ["slo/interactive"],
+         "phases": {"device": {"avg_ms": 6.0, "n": 10},
+                    "queue": {"avg_ms": 2.0, "n": 10}}}
+    assert fleetctl._slo_cell(r) == "1.30x!"
+    assert fleetctl._phase_cell(r) == "device 75%"
+    assert fleetctl._slo_cell({"slo_burn": 0.2}) == "0.20x"
+    assert fleetctl._slo_cell({"slo_burn": None}) == "-"
+    assert fleetctl._phase_cell({"phases": {}}) == "-"
+    row = dict(r, endpoint="h:1", health="ok", ready=False, rank=0,
+               job="j", last_step=None, step_ms=None,
+               examples_per_s=None, queue=3, mesh=None, coords=None,
+               error=None)
+    table = fleetctl.fleet_table(fleetctl.annotate_stragglers([row]))
+    assert "slo" in table.splitlines()[0]
+    assert "1.30x!" in table and "device 75%" in table
+    assert "slo:slo/interactive" in table   # burning lands in the flag
+
+
+def test_engine_stats_expose_trace_sample_and_slo(monkeypatch):
+    monkeypatch.setenv("MXTPU_TRACE_SAMPLE", "1.0")
+    observability.reset()
+    reqtrace.set_slo_objective("interactive", 1000.0)
+    eng = sim_engine(device_ms=1.0, name="statful")
+    with eng:
+        eng.predict(onp.zeros((1, 4), onp.float32))
+    st = eng.stats()
+    assert st["trace_sample"] == 1.0
+    assert st["slo"]["interactive"]["events"] == 1
+    assert st["slo"]["interactive"]["bad"] == 0

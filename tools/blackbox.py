@@ -10,8 +10,10 @@ registry, numerics trips, and the env snapshot. This tool merges N such
 bundles into:
 
   * a single chrome trace (``chrome://tracing`` / Perfetto) — one
-    process row per rank, span records as duration events and flight
-    events as instants, ALIGNED on the shared (job_id, step) trace ID:
+    process row per rank, span records as duration events, flight
+    events as instants, and per-request serving traces (reqtrace.py
+    phase spans + batch causality spans, when the bundle carries them)
+    in their own lanes, ALIGNED on the shared (job_id, step) trace ID:
     each rank's clock is offset so the earliest span of a common step
     lands at the same tick (ranks have no shared wall clock; the step
     boundary is the one event they all agree on);
@@ -30,6 +32,12 @@ import json
 import sys
 
 US = 1e6  # chrome trace timestamps are microseconds
+
+# request-trace lanes: high tid block well clear of real thread ids, one
+# lane per concurrent trace modulo _REQ_LANES; batch spans get their own
+_REQ_TID0 = 9000
+_REQ_LANES = 64
+_BATCH_TID = 8999
 
 
 def load_bundle(path):
@@ -117,6 +125,38 @@ def chrome_trace(bundles):
                 "name": ev.get("kind", "?"), "cat": "flight",
                 "ts": (ev["pc"] - off) * US, "args": args,
             })
+        # request traces (reqtrace.py) interleave with rank spans: same
+        # perf_counter clock, same per-rank offset. Each trace gets its
+        # own lane (tid) so concurrent requests stack side by side.
+        for i, rec in enumerate(b.get("req_traces", [])):
+            tid = _REQ_TID0 + i % _REQ_LANES
+            for sp in rec.get("spans", []):
+                out.append({
+                    "ph": "X", "pid": r, "tid": tid,
+                    "name": f"req:{sp.get('phase', '?')}",
+                    "cat": "reqtrace",
+                    "ts": (sp["t0"] - off) * US, "dur": sp["dur"] * US,
+                    "args": {"trace_id": rec.get("trace_id"),
+                             "model": rec.get("model"),
+                             "cls": rec.get("cls"),
+                             "outcome": rec.get("outcome"),
+                             "reason": rec.get("reason"),
+                             "batch": rec.get("batch"),
+                             "total_ms": rec.get("total_ms")},
+                })
+        for rec in b.get("req_batches", []):
+            for sp in rec.get("spans", []):
+                out.append({
+                    "ph": "X", "pid": r, "tid": _BATCH_TID,
+                    "name": f"batch:{sp.get('phase', '?')}",
+                    "cat": "reqtrace",
+                    "ts": (sp["t0"] - off) * US, "dur": sp["dur"] * US,
+                    "args": {"batch_id": rec.get("batch_id"),
+                             "model": rec.get("model"),
+                             "trace_ids": rec.get("trace_ids"),
+                             "rows": rec.get("rows"),
+                             "bucket": rec.get("bucket")},
+                })
     return {"traceEvents": out,
             "metadata": {"aligned_on_step": common,
                          "ranks": sorted(_rank(b) for b in bundles)}}
@@ -151,7 +191,9 @@ def report(bundles):
         r = _rank(b)
         w(f"rank {r}: last step {last[r]}, "
           f"{len(b.get('events', []))} events, "
-          f"{len(b.get('spans', []))} spans, reason={b.get('reason')!r}"
+          f"{len(b.get('spans', []))} spans, "
+          f"{len(b.get('req_traces', []))} req traces, "
+          f"reason={b.get('reason')!r}"
           + ("   <-- STRAGGLER" if r == straggler and len(bundles) > 1
              else ""))
         trips = b.get("numerics_trips") or []

@@ -8,9 +8,11 @@ Usage:  python tools/fleetctl.py HOST:PORT [HOST:PORT ...]
 Each training/serving rank started with ``MXTPU_OPS_PORT`` exposes the
 live ops plane (``mxnet_tpu/observability/opsd.py``; endpoint table in
 docs/observability.md). fleetctl polls every given endpoint's
-``/identity`` + ``/healthz`` + ``/readyz`` + ``/steps`` and renders ONE
-table — per-rank step, health, readiness, queue depth — with straggler
-detection from step-gauge skew: a rank whose last step trails the fleet
+``/identity`` + ``/healthz`` + ``/readyz`` + ``/steps`` (plus
+``/traces?n=0`` for the request-phase summary) and renders ONE table —
+per-rank step, health, readiness, queue depth, SLO burn rate, and the
+pipeline phase where request latency goes — with straggler detection
+from step-gauge skew: a rank whose last step trails the fleet
 maximum by more than ``--straggler-skew`` (default 2) is flagged, which
 is the live version of the postmortem question ``tools/blackbox.py``
 answers after the fact.
@@ -91,8 +93,21 @@ def poll_rank(endpoint, timeout=3.0):
                                for e in engines.values())
             row["admission"] = {n: e.get("admission")
                                 for n, e in engines.items()}
+        slo = checks.get("slo", {})
+        row["slo_burning"] = sorted(slo.get("burning") or {})
+        burns = [c.get("burn")
+                 for m in (slo.get("status") or {}).values()
+                 for c in m.values() if c.get("burn") is not None]
+        row["slo_burn"] = max(burns) if burns else None
     except (urllib.error.URLError, OSError, ValueError) as e:
         row["error"] = str(getattr(e, "reason", e))
+    # per-phase latency breakdown from the request-trace summary (n=0:
+    # summaries only). Older servers have no /traces — leave it empty.
+    try:
+        tr = _get(base, "/traces?n=0", timeout)
+        row["phases"] = tr.get("phases") or {}
+    except (urllib.error.URLError, OSError, ValueError):
+        row["phases"] = {}
     return row
 
 
@@ -124,14 +139,38 @@ def _mesh_cell(r):
     return f"{at} of {shape}"
 
 
+def _slo_cell(r):
+    """A rank's worst SLO burn rate, '!'-flagged while it is shedding
+    readiness (e.g. '1.30x!'); '-' when no objective is configured."""
+    burn = r.get("slo_burn")
+    if burn is None:
+        return "-"
+    return f"{burn:.2f}x" + ("!" if r.get("slo_burning") else "")
+
+
+def _phase_cell(r):
+    """Where request latency goes on this rank: the heaviest pipeline
+    phase by total time share, e.g. 'device 62%'."""
+    phases = r.get("phases") or {}
+    totals = {p: s.get("avg_ms", 0.0) * s.get("n", 0)
+              for p, s in phases.items()}
+    grand = sum(totals.values())
+    if grand <= 0:
+        return "-"
+    top = max(totals, key=totals.get)
+    return f"{top} {100.0 * totals[top] / grand:.0f}%"
+
+
 def fleet_table(rows):
     hdr = ["rank", "endpoint", "health", "ready", "step", "step_ms",
-           "ex/s", "queue", "mesh", ""]
+           "ex/s", "queue", "slo", "phase", "mesh", ""]
     table = [hdr]
     for r in sorted(rows, key=lambda r: (r["rank"] is None, r["rank"])):
         flag = "STRAGGLER" if r.get("straggler") else ""
         if r.get("stalled"):
             flag = (flag + " stalled:" + ",".join(r["stalled"])).strip()
+        if r.get("slo_burning"):
+            flag = (flag + " slo:" + ",".join(r["slo_burning"])).strip()
         if r.get("error"):
             flag = (flag + f" ({r['error']})").strip()
         table.append([
@@ -143,6 +182,8 @@ def fleet_table(rows):
             "-" if r["step_ms"] is None else f"{r['step_ms']:.1f}",
             "-" if not r["examples_per_s"] else f"{r['examples_per_s']:.0f}",
             "-" if r["queue"] is None else str(r["queue"]),
+            _slo_cell(r),
+            _phase_cell(r),
             _mesh_cell(r),
             flag,
         ])

@@ -33,7 +33,10 @@ Blocks (``--block``):
 
 ``--json-out FILE`` additionally writes the result object to a file —
 the committed ``BENCH_serving_pipeline.json`` artifact is a ``compare``
-run captured this way.
+run captured this way. ``--trace-sample RATE`` turns on request tracing
+(``MXTPU_TRACE_SAMPLE``) for the run; every result then carries a
+``trace`` block with the per-phase latency breakdown and SLO status
+(docs/observability.md).
 
 Usage:
   python tools/serve_bench.py --clients 8 --requests 50 --max-batch 16
@@ -51,6 +54,33 @@ import threading
 import time
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def apply_trace_sample(args):
+    """--trace-sample N sets MXTPU_TRACE_SAMPLE before the engine is
+    built so reqtrace head-samples this run's requests; the trace/SLO
+    summary lands in the result JSON."""
+    if args.trace_sample is not None:
+        os.environ["MXTPU_TRACE_SAMPLE"] = str(args.trace_sample)
+
+
+def trace_summary(eng):
+    """Trace/SLO view of a finished run: sample rate, per-phase latency
+    breakdown, trace counts by outcome, SLO status. Empty when tracing
+    is off."""
+    from mxnet_tpu.observability import reqtrace
+
+    recs = reqtrace.traces()
+    by_outcome = {}
+    for rec in recs:
+        by_outcome[rec["outcome"]] = by_outcome.get(rec["outcome"], 0) + 1
+    return {
+        "sample_rate": reqtrace.sample_rate(),
+        "traces": len(recs),
+        "by_outcome": by_outcome,
+        "phases": reqtrace.phase_summary(),
+        "slo": reqtrace.slo_status().get(eng.name, {}),
+    }
 
 
 def build_block(args):
@@ -160,6 +190,7 @@ def result_closed(args, eng, warm, qps, lat, errors):
         "recompiles_since_warmup": eng.recompiles_since_warmup(),
         "warmup": warm,
         "engine": eng.stats(),
+        "trace": trace_summary(eng),
     }
 
 
@@ -306,6 +337,7 @@ def result_open(args, eng, warm, per_cls):
         "recompiles_since_warmup": eng.recompiles_since_warmup(),
         "warmup": warm,
         "engine": eng.stats(),
+        "trace": trace_summary(eng),
     }
 
 
@@ -382,9 +414,15 @@ def main(argv=None):
     p.add_argument("--features", type=int, default=128)
     p.add_argument("--hidden", type=int, default=256)
     p.add_argument("--classes", type=int, default=64)
+    p.add_argument("--trace-sample", type=float, default=None,
+                   metavar="RATE",
+                   help="set MXTPU_TRACE_SAMPLE for this run (0..1; "
+                        "reqtrace head-sampling — summary lands in the "
+                        "result JSON)")
     p.add_argument("--json-out", default=None,
                    help="also write the JSON result to this file")
     args = p.parse_args(argv)
+    apply_trace_sample(args)
 
     if args.mode == "compare":
         result = run_compare(args)
