@@ -1496,6 +1496,21 @@ def main():
         regressions = [{"error": str(e)}]
     if regressions:
         result["regressions"] = regressions
+    try:
+        # with MXTPU_MEASURE on, the bench programs were measured into
+        # the CostDB — surface the summary + drift verdicts alongside
+        # the headline numbers (docs/performance.md measured-vs-modeled)
+        from mxnet_tpu.observability import costdb, measure
+
+        if measure.enabled():
+            measure.sweep()
+            costdb.db().save()
+            rep = costdb.drift_report()
+            result["costdb"] = dict(costdb.db().summary(),
+                                    tripped=[r["program"]
+                                             for r in rep["tripped"]])
+    except Exception:
+        pass
     print(json.dumps(result))
 
 

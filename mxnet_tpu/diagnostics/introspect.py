@@ -63,6 +63,15 @@ def capture_compile(block, variant, jitted, args, kwargs=None,
     analysis under ``(block, variant)``. Never raises: introspection must
     not be able to fail a training step. Returns the entry dict or None
     (disabled / analysis unavailable on this backend)."""
+    # the measurement plane hooks the same seam: every compiled program
+    # passes through here, so MXTPU_MEASURE=on_compile|cli registers it
+    # for micro-benchmarking even when compile capture itself is off
+    try:
+        from ..observability import measure as _measure
+
+        _measure.maybe_register(block, variant, jitted, args, kwargs)
+    except Exception:
+        pass
     if not capture_enabled():
         return None
     try:

@@ -49,9 +49,30 @@ __all__ = [
 PHASES = ("data", "fwd", "bwd", "collective", "optimizer", "sync",
           "compile", "checkpoint", "serve")
 
-_enabled = os.environ.get("MXTPU_DIAGNOSTICS", "1") != "0"
+def _env_get(name, default):
+    # typed env registry when importable (this module loads very early;
+    # a partially-initialized package must not break span recording)
+    try:
+        from .. import env as _env
 
-_DEFAULT_CAPACITY = int(os.environ.get("MXTPU_DIAG_RING_CAPACITY", "4096"))
+        if name in _env.all_vars():
+            return _env.get(name)
+    except Exception:
+        pass
+    raw = os.environ.get(name)
+    if raw is None:
+        return default
+    if isinstance(default, bool):
+        return raw.lower() not in ("", "0", "false", "off")
+    try:
+        return type(default)(raw)
+    except (TypeError, ValueError):
+        return default
+
+
+_enabled = bool(_env_get("MXTPU_DIAGNOSTICS", True))
+
+_DEFAULT_CAPACITY = int(_env_get("MXTPU_DIAG_RING_CAPACITY", 4096))
 _ring = collections.deque(maxlen=max(1, _DEFAULT_CAPACITY))
 _ring_lock = threading.Lock()
 

@@ -443,3 +443,50 @@ register(
     "the reduction accumulators to f32. Applies to the XLA custom-VJP "
     "path and the Pallas norm kernels alike; A/B on chip before "
     "changing the default.")
+register(
+    "MXTPU_DIAGNOSTICS", bool, True,
+    "Diagnostics span recording (diagnostics/spans.py): per-phase "
+    "timing records feeding the step table, watchdog, and postmortem "
+    "bundles. 0 makes every span a no-op context manager.")
+register(
+    "MXTPU_DIAG_RING_CAPACITY", int, 4096,
+    "Diagnostics span-ring capacity: the per-process ring keeps the "
+    "newest N span records for the step table and postmortem bundles.")
+register(
+    "MXTPU_TELEMETRY", bool, True,
+    "Telemetry registry master switch (telemetry/registry.py): 0 turns "
+    "every counter/gauge/histogram record into a single-branch no-op "
+    "and /metrics serves an empty page.")
+register(
+    "MXTPU_MEASURE", str, "off",
+    "Measurement plane (observability/measure.py; docs/performance.md "
+    "'measured vs modeled'): 'off' (default) never touches a compile — "
+    "runs are bitwise-identical with zero extra traces or dispatches; "
+    "'on_compile' microbenchmarks every program at its compile-registry "
+    "seam (warmed, synchronized wall-clock runs on the live device) and "
+    "records it into the CostDB; 'cli' stashes programs for a deferred "
+    "measure.sweep() (what tools/costdb.py measure drives).")
+register(
+    "MXTPU_MEASURE_RUNS", int, 5,
+    "Timed executions per measured program (p50/p95 come from these).")
+register(
+    "MXTPU_MEASURE_WARMUP", int, 1,
+    "Untimed warmup executions before the timed runs of each measured "
+    "program (absorbs compilation and first-dispatch overhead).")
+register(
+    "MXTPU_COSTDB_PATH", str, "",
+    "CostDB JSON-lines file (observability/costdb.py). Empty = "
+    "<MXTPU_FLIGHTREC_DIR>/mxtpu_costdb.jsonl. Writes are atomic "
+    "(tmp+fsync+replace) and loads merge newest-wins, so many ranks "
+    "may share one path on a common filesystem.")
+register(
+    "MXTPU_COSTDB_AUTOSAVE", bool, True,
+    "Persist the CostDB after every recorded measurement. 0 keeps "
+    "measurements in memory until an explicit CostDB.save() "
+    "(tools/costdb.py or the postmortem path).")
+register(
+    "MXTPU_COSTDB_DRIFT_MAX", float, 8.0,
+    "Drift-auditor trip threshold: a program whose measured-vs-modeled "
+    "bandwidth ratio leaves [1/N, N] against the platform median "
+    "raises a cost_drift flight event and flags in /costdb, diagnose "
+    "--passes, and the fleetctl drift column.")

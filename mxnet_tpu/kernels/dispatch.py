@@ -93,10 +93,23 @@ def auto_accepts(xla_bytes, kernel_bytes):
     return True, "kernel", saved
 
 
-def record(kernel, outcome, bytes_saved=0):
+def record(kernel, outcome, bytes_saved=0, xla_bytes=None,
+           kernel_bytes=None):
     """Record one trace-time decision (telemetry + flight recorder);
-    guarded — a broken observability layer must not fail a trace."""
+    guarded — a broken observability layer must not fail a trace.
+    Sites that reached the byte model also pass their (xla, kernel)
+    analytic scores so the measurement plane can audit the prediction
+    against measured wall time (observability/measure.note_site)."""
     try:
         _telemetry.record_kernel_dispatch(kernel, outcome, bytes_saved)
     except Exception:
         pass
+    if xla_bytes is not None or kernel_bytes is not None:
+        try:
+            from ..observability import measure as _measure
+
+            _measure.note_site(kernel, outcome, xla_bytes=xla_bytes,
+                               kernel_bytes=kernel_bytes,
+                               bytes_saved=bytes_saved)
+        except Exception:
+            pass

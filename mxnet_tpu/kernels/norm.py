@@ -83,22 +83,25 @@ def _supported(x, axis):
 
 
 def _decide(x, axis):
-    """(use_kernel, outcome, bytes_saved) for one BN training site.
-    Records nothing — callers record under their kernel name."""
+    """(use_kernel, outcome, bytes_saved, xla_bytes, kernel_bytes) for
+    one BN training site; the byte scores are None when the ladder
+    exits before reaching the analytic model. Records nothing — callers
+    record under their kernel name."""
     mode = _dispatch.mode()
     if mode == "off":
-        return False, "off", 0
+        return False, "off", 0, None, None
     reason = _supported(x, axis)
     if reason is not None:
-        return False, reason, 0
+        return False, reason, 0, None, None
     if not _dispatch.platform_ok():
-        return False, "platform", 0
+        return False, "platform", 0, None, None
     from ..passes import memory as _memory
     ew = _nn()._bn_ew_dtype(x)
     xla_b, k_b = _memory.norm_region_bytes(x.shape, x.dtype, ew)
     if mode == "force":
-        return True, "kernel", max(0, xla_b - k_b)
-    return _dispatch.auto_accepts(xla_b, k_b)
+        return True, "kernel", max(0, xla_b - k_b), xla_b, k_b
+    ok, outcome, saved = _dispatch.auto_accepts(xla_b, k_b)
+    return ok, outcome, saved, xla_b, k_b
 
 
 # ---------------------------------------------------------------------------
@@ -282,10 +285,11 @@ def _bwd_pallas(x, gamma, shift, mean, inv, dy, dmean_ct, dvar_ct):
 
 
 def _fwd_impl(x, gamma, beta, shift, eps, axis):
-    use_kernel, outcome, saved = _decide(x, axis)
+    use_kernel, outcome, saved, xla_b, k_b = _decide(x, axis)
     # the combined fwd+bwd prediction is attributed to the forward
     # dispatch (a site adopts the kernel PAIR or neither)
-    _dispatch.record("bn_fwd", outcome, saved)
+    _dispatch.record("bn_fwd", outcome, saved, xla_bytes=xla_b,
+                     kernel_bytes=k_b)
     if use_kernel:
         return _fwd_pallas(x, gamma, beta, shift, eps)
     return _nn()._bn_train_impl(x, gamma, beta, shift, eps, axis)
@@ -307,8 +311,9 @@ def _bn_train_fwd(x, gamma, beta, shift, eps, axis):
 
 def _bn_train_bwd(eps, axis, res, cts):
     x, gamma, beta, shift, mean, inv = res
-    use_kernel, outcome, _ = _decide(x, axis)
-    _dispatch.record("bn_bwd", outcome)
+    use_kernel, outcome, _, xla_b, k_b = _decide(x, axis)
+    _dispatch.record("bn_bwd", outcome, xla_bytes=xla_b,
+                     kernel_bytes=k_b)
     if not use_kernel:
         return _nn()._bn_train_bwd(eps, axis, res, cts)
     dy, dmean_ct, dvar_ct = cts

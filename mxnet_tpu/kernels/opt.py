@@ -72,21 +72,24 @@ def _supported(cls, mp, w, st, g):
 
 
 def _decide(cls, mp, w, st, g):
+    """(use_kernel, outcome, bytes_saved, xla_bytes, kernel_bytes); the
+    byte scores are None when the ladder exits before the model."""
     mode = _dispatch.mode()
     if mode == "off":
-        return False, "off", 0
+        return False, "off", 0, None, None
     reason = _supported(cls, mp, w, st, g)
     if reason is not None:
-        return False, reason, 0
+        return False, reason, 0, None, None
     if not _dispatch.platform_ok():
-        return False, "platform", 0
+        return False, "platform", 0, None, None
     leaves = jax.tree_util.tree_leaves(st[1] if mp else st)
     from ..passes import memory as _memory
     xla_b, k_b = _memory.optimizer_region_bytes(
         w.size, w.dtype, len(leaves), mp)
     if mode == "force":
-        return True, "kernel", max(0, xla_b - k_b)
-    return _dispatch.auto_accepts(xla_b, k_b)
+        return True, "kernel", max(0, xla_b - k_b), xla_b, k_b
+    ok, outcome, saved = _dispatch.auto_accepts(xla_b, k_b)
+    return ok, outcome, saved, xla_b, k_b
 
 
 def _ladder_kernel(scal_ref, w_ref, g_ref, *refs, rule, clip, gn, mp,
@@ -196,8 +199,9 @@ def param_step(cls, clip, gn, mp, w, st, g, lr, wd, t, scale, hyper):
     """Pallas-backed twin of Optimizer._fused_param_step — one
     parameter's rescale → clip → rule → cast ladder.  Falls back to the
     XLA body (bitwise-identical numerics) when the kernel can't run."""
-    use_kernel, outcome, saved = _decide(cls, mp, w, st, g)
-    _dispatch.record("opt_" + cls.__name__.lower(), outcome, saved)
+    use_kernel, outcome, saved, xla_b, k_b = _decide(cls, mp, w, st, g)
+    _dispatch.record("opt_" + cls.__name__.lower(), outcome, saved,
+                     xla_bytes=xla_b, kernel_bytes=k_b)
     if not use_kernel:
         return _fallback(cls, clip, gn, mp, w, st, g, lr, wd, t, scale,
                          hyper)

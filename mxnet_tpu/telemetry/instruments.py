@@ -59,6 +59,8 @@ __all__ = [
     "sharding_plan_applied_total", "sharding_mesh_axis_size",
     "sharding_pass_stamp_total",
     "record_sharding_apply", "record_sharding_stamp",
+    "cost_measure_total", "cost_model_drift_ratio",
+    "record_cost_measure", "set_cost_drift",
 ]
 
 # v5e-class bf16 peak, the default MFU denominator (tools/perf_lab.py's
@@ -449,6 +451,39 @@ def record_kernel_dispatch(kernel, outcome, bytes_saved=0):
     kernel_dispatch_total.labels(kernel, outcome).inc()
     if bytes_saved:
         kernel_bytes_saved.inc(int(bytes_saved))
+
+
+# -- measurement plane ------------------------------------------------------
+cost_measure_total = counter(
+    "cost_measure_total",
+    "Programs microbenchmarked into the CostDB by the measurement "
+    "plane (observability/measure.py; MXTPU_MEASURE=on_compile|cli)",
+    ["block", "variant"])
+cost_model_drift_ratio = gauge(
+    "cost_model_drift_ratio",
+    "Predicted-vs-measured drift of the analytic byte model per "
+    "measured program (site='program') and per kernel-dispatch site "
+    "recorded inside it: the program's implied bandwidth over the "
+    "platform median, 1.0 = the model prices it like everything else "
+    "(observability/costdb.py drift auditor)", ["site", "program"])
+
+
+def record_cost_measure(block, variant, wall_ms=None):
+    """One program measured into the CostDB; mirrored to the flight
+    recorder so postmortems show when measurement ran."""
+    _flight_record("cost_measure", block=str(block),
+                   variant=str(variant), wall_ms=wall_ms)
+    if not REGISTRY.enabled:
+        return
+    cost_measure_total.labels(block, variant).inc()
+
+
+def set_cost_drift(site, program, ratio):
+    """Publish one drift-auditor join result."""
+    if not REGISTRY.enabled:
+        return
+    cost_model_drift_ratio.labels(str(site), str(program)).set(
+        float(ratio))
 
 
 def record_layout_rewrite(rewritten, inserted, elided):

@@ -322,9 +322,22 @@ class Registry:
             m.clear()
 
 
+def _enabled_from_env():
+    # typed env registry when importable (telemetry loads before the
+    # package finishes importing; fall back to the raw read)
+    try:
+        from .. import env as _env
+
+        if "MXTPU_TELEMETRY" in _env.all_vars():
+            return bool(_env.get("MXTPU_TELEMETRY"))
+    except Exception:
+        pass
+    return os.environ.get("MXTPU_TELEMETRY", "1") != "0"
+
+
 # The process-wide default registry. MXTPU_TELEMETRY=0 ships the whole
 # subsystem dark (every record_* in instruments.py early-outs).
-REGISTRY = Registry(enabled=os.environ.get("MXTPU_TELEMETRY", "1") != "0")
+REGISTRY = Registry(enabled=_enabled_from_env())
 
 
 def _reinit_locks_after_fork():

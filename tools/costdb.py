@@ -1,0 +1,222 @@
+"""costdb — inspect, fill, and audit the measurement plane's CostDB.
+
+Usage:  python tools/costdb.py list   [--db PATH] [--json] [-n N]
+        python tools/costdb.py measure [--db PATH] [--json]
+                                       [--steps N] [--batch B] [--hidden H]
+        python tools/costdb.py verify [--db PATH] [--json]
+                                      [--threshold X]
+        python tools/costdb.py diff PLATFORM_A PLATFORM_B
+                                      [--db PATH] [--json]
+
+The CostDB (observability/costdb.py) holds on-device program
+measurements keyed by (structural fingerprint, platform); the drift
+auditor joins them against the passes/memory.py analytic byte model
+(docs/performance.md "measured vs modeled").
+
+  list     print the entries (newest last) + the drift table.
+  measure  run a short instrumented training workload with
+           MXTPU_MEASURE=cli, sweep the stashed programs through the
+           microbenchmark harness, and persist the results — the CLI
+           counterpart of running your real job under
+           MXTPU_MEASURE=on_compile.
+  verify   run the drift auditor; exit 1 when any measured program's
+           predicted-vs-measured ratio trips the threshold (CI gate for
+           "the byte model still prices this platform sanely").
+  diff     join the entries of two platforms by program fingerprint
+           and print per-program wall-time ratios — where one platform
+           diverges from the other is where platform-specific tuning
+           (or a platform-specific model) is worth the effort.
+
+`--db PATH` repoints MXTPU_COSTDB_PATH before mxnet_tpu imports, so
+every subcommand works against an explicit file (tests, archived runs).
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def _entry_lines(entries):
+    lines = ["program                                  platform  "
+             "fingerprint       p50 ms    p95 ms       predicted"]
+    for e in entries:
+        p50, p95 = e.get("wall_ms_p50"), e.get("wall_ms_p95")
+        lines.append(
+            f"{e.get('block')}/{e.get('variant'):<30} "
+            f"{str(e.get('platform')):<8} "
+            f"{str(e.get('fingerprint')):<16} "
+            f"{(f'{p50:.3f}' if p50 is not None else '?'):>9} "
+            f"{(f'{p95:.3f}' if p95 is not None else '?'):>9} "
+            f"{int(e.get('predicted_bytes') or 0):>15}")
+    return lines
+
+
+def _drift_lines(rep):
+    lines = [f"drift threshold: {rep['threshold']}x of the platform "
+             "median bandwidth"]
+    for plat, calib in sorted(rep["calibration"].items()):
+        lines.append(f"calibration[{plat}]: "
+                     f"{calib / 1e6:.2f} GB/s implied")
+    if not rep["programs"]:
+        lines.append("(no measurements with analytic predictions)")
+    for r in rep["programs"]:
+        flag = "  TRIPPED" if r["tripped"] else ""
+        lines.append(f"  {r['program']:<40} {r['platform']:<8} "
+                     f"{r['drift_ratio']:>7.2f}x{flag}")
+    return lines
+
+
+def cmd_list(args):
+    from mxnet_tpu.observability import costdb
+
+    d = costdb.db()
+    entries = d.entries()[-args.n:] if args.n else costdb.db().entries()
+    rep = costdb.drift_report()
+    if args.json:
+        print(json.dumps({"path": d.path, "entries": entries,
+                          "drift": rep}, default=str))
+        return 0
+    print(f"costdb: {d.path} ({len(d)} entries)")
+    if entries:
+        print("\n".join(_entry_lines(entries)))
+    print()
+    print("\n".join(_drift_lines(rep)))
+    return 0
+
+
+def cmd_measure(args):
+    os.environ["MXTPU_MEASURE"] = "cli"
+    from mxnet_tpu.observability import costdb, measure
+
+    _workload(args.steps, args.batch, args.hidden)
+    stashed = measure.pending()
+    entries = measure.sweep()
+    path = costdb.db().save()
+    if args.json:
+        print(json.dumps({"path": path, "stashed": stashed,
+                          "measured": entries}, default=str))
+        return 0
+    print(f"stashed {len(stashed)} program(s), measured "
+          f"{len(entries)}, saved: {path}")
+    if entries:
+        print("\n".join(_entry_lines(entries)))
+    return 0
+
+
+def _workload(steps, batch, hidden):
+    """The diagnose-style toy workload: a few TrainStep iterations so
+    the compile seams register their programs for the sweep."""
+    import mxnet_tpu as mx
+    from mxnet_tpu.gluon import Trainer, TrainStep, nn
+
+    net = nn.HybridSequential()
+    net.add(nn.Dense(hidden, activation="relu"), nn.Dense(hidden // 2))
+    net.initialize()
+    net.hybridize()
+    trainer = Trainer(net.collect_params(), "sgd",
+                      {"learning_rate": 0.05})
+    step = TrainStep(net, lambda out: (out * out).sum(axis=-1), trainer)
+    x = mx.np.ones((batch, hidden))
+    for _ in range(steps):
+        step(x, batch_size=batch)
+    mx.waitall()
+
+
+def cmd_verify(args):
+    from mxnet_tpu.observability import costdb
+
+    rep = costdb.drift_report(threshold=args.threshold)
+    if args.json:
+        print(json.dumps(rep, default=str))
+    else:
+        print("\n".join(_drift_lines(rep)))
+    return 1 if rep["tripped"] else 0
+
+
+def cmd_diff(args):
+    from mxnet_tpu.observability import costdb
+
+    by_fp = {}
+    for e in costdb.db().entries():
+        by_fp.setdefault(str(e.get("fingerprint")), {})[
+            str(e.get("platform"))] = e
+    rows = []
+    for fp, plats in sorted(by_fp.items()):
+        a, b = plats.get(args.platform_a), plats.get(args.platform_b)
+        if a is None or b is None:
+            continue
+        pa, pb = a.get("wall_ms_p50"), b.get("wall_ms_p50")
+        rows.append({
+            "fingerprint": fp,
+            "program": f"{a.get('block')}/{a.get('variant')}",
+            f"{args.platform_a}_ms": pa,
+            f"{args.platform_b}_ms": pb,
+            "ratio": (pa / pb) if pa and pb else None,
+        })
+    if args.json:
+        print(json.dumps({"platforms": [args.platform_a,
+                                        args.platform_b],
+                          "programs": rows}, default=str))
+        return 0
+    if not rows:
+        print(f"no programs measured on BOTH {args.platform_a!r} and "
+              f"{args.platform_b!r}")
+        return 0
+    print(f"program                                  "
+          f"{args.platform_a:>10}  {args.platform_b:>10}     ratio")
+    for r in rows:
+        ra = r[f"{args.platform_a}_ms"]
+        rb = r[f"{args.platform_b}_ms"]
+        ratio = r["ratio"]
+        print(f"{r['program']:<40} "
+              f"{(f'{ra:.3f}' if ra else '?'):>10} "
+              f"{(f'{rb:.3f}' if rb else '?'):>10} "
+              f"{(f'{ratio:.2f}x' if ratio else '?'):>9}")
+    return 0
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(
+        description="inspect, fill, and audit the measurement-plane "
+                    "CostDB")
+    ap.add_argument("--db", metavar="PATH", default=None,
+                    help="CostDB file (sets MXTPU_COSTDB_PATH)")
+    ap.add_argument("--json", action="store_true",
+                    help="machine-readable output")
+    sub = ap.add_subparsers(dest="cmd", required=True)
+    p = sub.add_parser("list", help="print entries + the drift table")
+    p.add_argument("-n", type=int, default=0,
+                   help="newest N entries only (0 = all)")
+    p.set_defaults(fn=cmd_list)
+    p = sub.add_parser("measure",
+                       help="run the toy workload under "
+                            "MXTPU_MEASURE=cli and sweep it")
+    p.add_argument("--steps", type=int, default=3)
+    p.add_argument("--batch", type=int, default=32)
+    p.add_argument("--hidden", type=int, default=64)
+    p.set_defaults(fn=cmd_measure)
+    p = sub.add_parser("verify",
+                       help="exit 1 when any program trips the drift "
+                            "auditor")
+    p.add_argument("--threshold", type=float, default=None,
+                   help="override MXTPU_COSTDB_DRIFT_MAX")
+    p.set_defaults(fn=cmd_verify)
+    p = sub.add_parser("diff",
+                       help="join two platforms' measurements by "
+                            "program fingerprint")
+    p.add_argument("platform_a")
+    p.add_argument("platform_b")
+    p.set_defaults(fn=cmd_diff)
+    args = ap.parse_args(argv)
+    if args.db:
+        # before mxnet_tpu imports, so default_path resolves to it
+        os.environ["MXTPU_COSTDB_PATH"] = args.db
+    return args.fn(args)
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -240,6 +240,31 @@ def _passes_report():
         },
         "executable_cache": passes.executable_cache_info(),
         "sharding": _sharding_report(),
+        "costdb": _costdb_report(),
+    }
+
+
+def _costdb_report():
+    """Measurement-plane state: resolved env config, CostDB size, and
+    the drift auditor's predicted-vs-measured join (docs/performance.md
+    'measured vs modeled')."""
+    from mxnet_tpu import env as _env
+    from mxnet_tpu.observability import costdb as _costdb
+    from mxnet_tpu.observability import measure as _measure
+
+    d = _costdb.db()
+    rep = _costdb.drift_report()
+    return {
+        "config": {k: _env.get(k) for k in
+                   ("MXTPU_MEASURE", "MXTPU_COSTDB_PATH",
+                    "MXTPU_COSTDB_DRIFT_MAX")},
+        "mode": _measure.mode(),
+        "path": d.path,
+        "entries": len(d),
+        "pending": _measure.pending(),
+        "calibration": rep["calibration"],
+        "drift": rep["programs"],
+        "tripped": [r["program"] for r in rep["tripped"]],
     }
 
 
@@ -305,6 +330,25 @@ def _passes_report_lines(pr):
         for row in la["params"]:
             lines.append(f"    {row['param']:<40} {row['spec']:<25} "
                          f"{row['bytes_per_device']:>12}")
+    cd = pr.get("costdb") or {}
+    cd_cfg = " ".join(f"{k}={v!r}" for k, v in
+                      (cd.get("config") or {}).items())
+    lines.append(f"  costdb: {cd_cfg} entries={cd.get('entries', 0)}")
+    if cd.get("drift"):
+        lines.append("    program                                  "
+                     "platform  drift    p50 ms      predicted")
+        for row in cd["drift"]:
+            flag = "  TRIPPED" if row.get("tripped") else ""
+            p50 = row.get("wall_ms_p50")
+            lines.append(
+                f"    {row['program']:<40} {row['platform']:<8} "
+                f"{row['drift_ratio']:>6.2f}x "
+                f"{(f'{p50:.3f}' if p50 is not None else '?'):>9} "
+                f"{row.get('predicted_bytes', 0):>14}{flag}")
+    elif cd.get("mode") == "off":
+        lines.append("    (measurement off: MXTPU_MEASURE=off)")
+    else:
+        lines.append("    (no measurements recorded)")
     return lines
 
 
