@@ -1044,6 +1044,64 @@ def _bench_serving_qps_run(mx, serving, nn, onp, threading, clients,
     return clients * requests / dt
 
 
+def bench_decode(platform, sequences=16, new_tokens=24):
+    """KV-cache decode throughput + TTFT through the DecodeEngine
+    (docs/decode.md): `sequences` streamed sequences over TinyCausalLM
+    with continuous slot churn. Returns (tok_s, ttft_p50_ms). Cheap by
+    construction (tiny model, CPU-honest); the engine raises on any
+    recompile after warmup, so the row measures steady-state stepping,
+    never compiles. decode_tok_s rides the higher-is-better gate and
+    decode_ttft_ms the lower-is-better gate."""
+    import threading
+
+    from mxnet_tpu.decode import DecodeEngine, TinyCausalLM
+
+    lm = TinyCausalLM(max_len=128)
+    eng = DecodeEngine(lm, name="bench_decode", num_slots=4,
+                       max_wait_ms=1.0, timeout_ms=60_000.0)
+    eng.warmup()
+    ttft = []
+    tokens = [0]
+    lock = threading.Lock()
+
+    def consume(seq, t0):
+        n = 0
+        for _ in seq.stream():
+            if n == 0:
+                first = time.perf_counter() - t0
+            n += 1
+        with lock:
+            ttft.append(first)
+            tokens[0] += n
+
+    with eng:
+        # absorb first-dispatch overheads before timing
+        eng.submit([1, 2], max_new_tokens=2).result()
+        t0 = time.perf_counter()
+        threads = []
+        for k in range(sequences):
+            prompt = [1 + (k + j) % 50 for j in range(1 + k % 8)]
+            seq = eng.submit(prompt, max_new_tokens=new_tokens)
+            t = threading.Thread(target=consume,
+                                 args=(seq, time.perf_counter()),
+                                 daemon=True)
+            t.start()
+            threads.append(t)
+        for t in threads:
+            t.join(timeout=120)
+        dt = time.perf_counter() - t0
+    recompiles = eng.recompiles_since_warmup()
+    if recompiles:
+        raise RuntimeError(
+            f"{recompiles} recompile(s) after warmup — decode bench "
+            "measured compile time, not token generation")
+    if len(ttft) != sequences:
+        raise RuntimeError(
+            f"only {len(ttft)}/{sequences} sequences completed")
+    ttft.sort()
+    return tokens[0] / dt, ttft[len(ttft) // 2] * 1000.0
+
+
 def bench_passes_compile_ms(platform):
     """Wall-ms of one pipeline build (trace + AMP pass + dedup hashing +
     XLA compile) of a small MLP through the graph-pass seam
@@ -1394,6 +1452,29 @@ def main():
                     f"(off={qps_off:.2f} req/s; docs/observability.md)"})
     except Exception as e:
         rows.append({"metric": "serve_qps_traced", "error": str(e)})
+
+    # KV-cache decode runs on every platform (tiny model — the row
+    # measures the paged-cache stepping path, not model FLOPs);
+    # decode_tok_s → higher-is-better, decode_ttft_ms → lower-is-better
+    try:
+        if over_budget():
+            raise TimeoutError("bench budget exhausted")
+        tok_s, ttft_ms = bench_decode(platform)
+        decode_note = ("decode.DecodeEngine: 16 streamed sequences, "
+                       "4 KV slots, continuous join/retire churn, zero "
+                       "recompiles after warmup enforced "
+                       "(docs/decode.md)")
+        rows.append({
+            "metric": "decode_tok_s" + suffix,
+            "value": round(tok_s, 2), "unit": "tok/s",
+            "note": decode_note})
+        rows.append({
+            "metric": "decode_ttft_ms" + suffix,
+            "value": round(ttft_ms, 3), "unit": "ms",
+            "note": "median time-to-first-token (queue + prefill + "
+                    "first sample) in the same run; " + decode_note})
+    except Exception as e:
+        rows.append({"metric": "decode_tok_s", "error": str(e)})
 
     # checkpoint commit latency runs on every platform (host-side work:
     # capture + npz + fsync + rename); _ms suffix → lower-is-better gate

@@ -108,6 +108,7 @@ from . import diagnostics  # noqa: E402  (spans/compile introspection/watchdog)
 from . import test_utils  # noqa: E402  (mx.test_utils like the reference)
 from . import amp  # noqa: E402  (mx.amp — reference: python/mxnet/amp/)
 from . import serving  # noqa: E402  (batching inference engine; docs/serving.md)
+from . import decode  # noqa: E402  (KV-cache autoregressive decode; docs/decode.md)
 from . import checkpoint  # noqa: E402  (atomic snapshots; docs/checkpointing.md)
 from . import sharding  # noqa: E402  (hybrid parallelism; docs/sharding.md)
 from . import observability  # noqa: E402  (flight recorder + numerics + postmortems)

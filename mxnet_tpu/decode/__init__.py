@@ -1,0 +1,32 @@
+"""Autoregressive KV-cache decode: generation as a first-class workload.
+
+The serving tier's one-shot engines batch fixed-shape requests; this
+package adds the token-by-token half (ISSUE 18) — the workload mix the
+paper's one-runtime thesis is about:
+
+  * :mod:`~mxnet_tpu.decode.cache` — the paged :class:`KVCache` block
+    contract (fixed-slot pool, value-only churn, zero retraces);
+  * :mod:`~mxnet_tpu.decode.model` — the decode-block surface
+    (init_cache / prefill / step / jit_trace_count) and
+    :class:`TinyCausalLM`, its bitwise-testable reference;
+  * :mod:`~mxnet_tpu.decode.sampling` — host-side per-sequence
+    greedy / temperature / top-k (never touches the jit cache);
+  * :mod:`~mxnet_tpu.decode.engine` — :class:`DecodeEngine`, the
+    sequence-level continuous batcher with streaming
+    :class:`SequenceRequest` handles.
+
+See docs/decode.md for the design tour.
+"""
+from __future__ import annotations
+
+from .cache import KVCache, NEG_INF
+from .engine import DecodeEngine, SequenceRequest
+from .model import TinyCausalLM
+from .sampling import SamplingParams, sample_token
+
+__all__ = [
+    "KVCache", "NEG_INF",
+    "TinyCausalLM",
+    "SamplingParams", "sample_token",
+    "DecodeEngine", "SequenceRequest",
+]

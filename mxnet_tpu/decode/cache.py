@@ -1,0 +1,175 @@
+"""The KV-cache block contract: paged slots carried through the trace.
+
+Autoregressive decode reuses attention keys/values across steps instead
+of recomputing the whole prefix per token — the O(L) -> O(1) step-cost
+flip that makes token-by-token serving viable. On XLA that reuse has to
+respect the jit cache: a cache whose arrays grow with the sequence would
+retrace (and recompile) every step. :class:`KVCache` therefore holds a
+FIXED pool —
+
+    k, v     : (num_slots, max_len, num_heads, head_dim)
+    lengths  : (num_slots,) int32   — valid prefix per slot
+
+— where one serving *sequence* owns one slot row for its lifetime.
+Appends advance the slot's length index via ``dynamic_update_slice`` (a
+traced scalar index, never a shape); a retiring sequence frees its slot
+by zeroing its length, and the next sequence reuses the same row. Every
+array shape is static, so slot churn (join / retire / reuse) touches
+only VALUES — the decode step compiles exactly once (the zero-retrace
+invariant tests/test_decode.py pins via ``jit_trace_total``).
+
+The cache rides the traced body the way the BatchNorm aux pair does
+(ops/nn.py): it is a registered pytree whose leaves flow in and out of
+jitted programs as ordinary operands, and every write is wrapped in
+``lax.stop_gradient`` so a cache threaded through a differentiated
+program contributes no gradient paths (custom-VJP-safe: taping through
+a decode step can never try to differentiate a cache update).
+
+Masking contract: position ``p`` of slot ``s`` is valid iff
+``p < lengths[s]``. :meth:`position_mask` renders that as an additive
+bias (0 valid, ``NEG_INF`` invalid) so cached attention and the
+padded-to-``max_len`` uncached reference reduce over bitwise-identical
+operands — the token-parity proof in tests/test_decode.py depends on
+it. See docs/decode.md.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+__all__ = ["KVCache", "NEG_INF"]
+
+#: Additive attention-mask value for invalid cache positions. A finite
+#: large-negative (not -inf) so masked lanes stay NaN-free through
+#: softmax even when a slot is empty.
+NEG_INF = -1e30
+
+
+class KVCache:
+    """Paged key/value pool for one attention site.
+
+    Immutable-functional: every mutator returns a NEW KVCache (the JAX
+    idiom — inside a jitted body the "copy" is elided by XLA's buffer
+    donation/aliasing, outside it is one small dispatch). Slot-assignment
+    bookkeeping (which sequence owns which slot) lives host-side in the
+    DecodeEngine; the cache itself only knows per-slot valid lengths.
+    """
+
+    __slots__ = ("k", "v", "lengths")
+
+    def __init__(self, k, v, lengths):
+        self.k = k
+        self.v = v
+        self.lengths = lengths
+
+    # -- construction ------------------------------------------------------
+    @classmethod
+    def create(cls, num_slots, max_len, num_heads, head_dim,
+               dtype=jnp.float32):
+        """A zeroed pool: ``num_slots`` sequences of up to ``max_len``
+        cached positions each."""
+        shape = (int(num_slots), int(max_len), int(num_heads),
+                 int(head_dim))
+        return cls(jnp.zeros(shape, dtype), jnp.zeros(shape, dtype),
+                   jnp.zeros((int(num_slots),), jnp.int32))
+
+    # -- static geometry ---------------------------------------------------
+    @property
+    def num_slots(self):
+        return self.k.shape[0]
+
+    @property
+    def max_len(self):
+        return self.k.shape[1]
+
+    @property
+    def num_heads(self):
+        return self.k.shape[2]
+
+    @property
+    def head_dim(self):
+        return self.k.shape[3]
+
+    # -- traced mutators ---------------------------------------------------
+    def prefill(self, slot, k_new, v_new, length):
+        """Write a sequence's prompt K/V into its slot.
+
+        ``k_new``/``v_new`` are ``(L_bucket, num_heads, head_dim)`` —
+        the prompt padded UP to a seq-len bucket rung (positions past
+        ``length`` are garbage the mask hides). ``slot`` and ``length``
+        are traced scalars, so every (slot, length) pair reuses the one
+        compiled program per bucket rung."""
+        slot = jnp.asarray(slot, jnp.int32)
+        k_new = lax.stop_gradient(k_new)
+        v_new = lax.stop_gradient(v_new)
+        start = (slot, jnp.int32(0), jnp.int32(0), jnp.int32(0))
+        k = lax.dynamic_update_slice(self.k, k_new[None], start)
+        v = lax.dynamic_update_slice(self.v, v_new[None], start)
+        lengths = self.lengths.at[slot].set(jnp.asarray(length, jnp.int32))
+        return KVCache(k, v, lengths)
+
+    def append(self, k_t, v_t, active):
+        """Append one token's K/V to every ACTIVE slot at its current
+        length; inactive slots are untouched and their lengths hold.
+
+        ``k_t``/``v_t`` are ``(num_slots, num_heads, head_dim)`` (one
+        new position per slot — the fixed ``(num_slots, 1)`` decode-step
+        shape), ``active`` a ``(num_slots,)`` bool mask. Appends into a
+        full slot (length == max_len) are dropped rather than wrapped.
+        """
+        k_t = lax.stop_gradient(k_t)
+        v_t = lax.stop_gradient(v_t)
+        active = jnp.asarray(active, bool)
+        pos = jnp.minimum(self.lengths, self.max_len - 1)
+
+        def write_row(row, tok, p):
+            return lax.dynamic_update_slice(
+                row, tok[None], (p, jnp.int32(0), jnp.int32(0)))
+
+        k_written = jax.vmap(write_row)(self.k, k_t, pos)
+        v_written = jax.vmap(write_row)(self.v, v_t, pos)
+        ok = active & (self.lengths < self.max_len)
+        sel = ok[:, None, None, None]
+        k = jnp.where(sel, k_written, self.k)
+        v = jnp.where(sel, v_written, self.v)
+        lengths = self.lengths + ok.astype(jnp.int32)
+        return KVCache(k, v, lengths)
+
+    def free(self, slot):
+        """Retire a sequence: zero its slot's valid length so the row is
+        reusable. Shapes are untouched — freeing (and the next join's
+        prefill into the same row) can never retrace."""
+        lengths = self.lengths.at[jnp.asarray(slot, jnp.int32)].set(0)
+        return KVCache(self.k, self.v, lengths)
+
+    # -- attention helpers -------------------------------------------------
+    def position_mask(self, dtype=jnp.float32):
+        """(num_slots, max_len) additive bias: 0 where ``p <
+        lengths[s]``, NEG_INF elsewhere — the single masking contract
+        cached attention and the uncached reference share."""
+        pos = jnp.arange(self.max_len)
+        valid = pos[None, :] < self.lengths[:, None]
+        return jnp.where(valid, jnp.asarray(0.0, dtype),
+                         jnp.asarray(NEG_INF, dtype))
+
+    # -- introspection -----------------------------------------------------
+    def occupancy(self):
+        """Live slots (length > 0) — the decode_slot_occupancy gauge's
+        device-side truth."""
+        return jnp.sum(self.lengths > 0)
+
+    def __repr__(self):
+        return (f"KVCache(slots={self.num_slots}, max_len={self.max_len},"
+                f" heads={self.num_heads}, head_dim={self.head_dim})")
+
+
+def _flatten(c):
+    return (c.k, c.v, c.lengths), None
+
+
+def _unflatten(_, children):
+    return KVCache(*children)
+
+
+jax.tree_util.register_pytree_node(KVCache, _flatten, _unflatten)

@@ -1,0 +1,708 @@
+"""DecodeEngine: sequence-level continuous batching over a paged KVCache.
+
+The one-shot :class:`~mxnet_tpu.serving.engine.InferenceEngine` batches
+*requests* — each lives for exactly one micro-batch. Generation inverts
+that: a sequence occupies the device for its whole lifetime, so the
+continuous batcher here joins and retires SEQUENCES against a fixed pool
+of KV-cache slots (cache.py):
+
+  * **join**: a free slot's sequence is admitted through the SAME
+    priority scheduler the one-shot engine uses (scheduler.py — classes,
+    token buckets, Overloaded/RateLimited shedding, deadline expiry all
+    apply unchanged; a queued sequence is rows=1 with a constant
+    signature). Its prompt pads up to a SEQ-LEN bucket rung
+    (buckets.py ``axis="seqlen"``) and prefills the slot row — one
+    compiled program per rung;
+  * **steady state**: every iteration runs the block's decode step at
+    the fixed ``(num_slots, 1)`` shape — inactive slots ride along
+    masked — so the entire churn of joins, retirements, and per-sequence
+    sampling params touches ONE compiled executable (the zero-retrace
+    invariant warmup() proves and ``recompiles_since_warmup`` tracks);
+  * **retire**: EOS, the max-token budget, a full slot row
+    (``context_full``), or a client-claimed timeout frees the slot with
+    a VALUE-only cache write — the next join reuses the row, no retrace;
+  * **stream**: each sampled token is pushed to the sequence's handle as
+    its step settles; :meth:`SequenceRequest.stream` yields tokens while
+    the sequence is still generating (MXTPU_DECODE_STREAM=0 withholds
+    them until retirement for whole-completion clients).
+
+Sampling is host-side (sampling.py) so temperature/top-k/seed live
+outside the jit cache entirely. Observability rides the serving plane:
+reqtrace boundary stamps (joining/prefilled/per-token), TTFT into the
+class SLO window via ``slo_latency_s``, decode_* telemetry, and
+decode_join/decode_retire flight events. The engine exposes the same
+duck-typed surface as InferenceEngine (submit/start/stop/load/
+admission_state/stats), so FrontDoor routing, the ModelRegistry, and
+opsd /readyz compose unchanged. See docs/decode.md.
+"""
+from __future__ import annotations
+
+import threading
+import time
+
+import numpy as _np
+
+from .. import env as _env
+from ..telemetry import instruments as _instr
+from ..serving.buckets import bucket_ladder, pad_axis, pick_bucket
+from ..serving.engine import warm_and_seal
+from ..serving.errors import EngineStopped, Overloaded, RequestTimeout
+from ..serving.scheduler import RequestScheduler
+from .sampling import SamplingParams, sample_token
+
+__all__ = ["DecodeEngine", "SequenceRequest"]
+
+#: Shared scheduler signature for all decode sequences — every queued
+#: sequence is batch-compatible with every other (the shapes that matter
+#: are the engine's, not the request's), so scheduler batch fill works
+#: across the whole queue.
+_DECODE_SIGNATURE = ("decode",)
+
+_REQTRACE = [None]
+
+
+def _reqtrace():
+    """Lazy, cached handle on observability.reqtrace (same layering as
+    serving/engine.py: serving loads before observability)."""
+    rt = _REQTRACE[0]
+    if rt is None:
+        from ..observability import reqtrace as rt
+
+        _REQTRACE[0] = rt
+    return rt
+
+
+def _flight(kind, **fields):
+    try:
+        from ..observability import flight as _fl
+
+        _fl.record(kind, **fields)
+    except Exception:
+        pass
+
+
+class SequenceRequest:
+    """One generation request: prompt in, a stream of tokens out.
+
+    The scheduler-facing surface matches ServeRequest (cls, rows,
+    signature, deadline, t_submit, done, _finish), so decode sequences
+    ride the priority scheduler unchanged. The client-facing surface is
+    a token stream: :meth:`stream` yields tokens as they settle,
+    :meth:`result` blocks for the full completion. The outcome claim is
+    atomic exactly like ServeRequest's — first of {engine retirement,
+    client timeout, shed, stop} wins.
+    """
+
+    __slots__ = ("prompt", "max_new_tokens", "eos_id", "sampling", "rows",
+                 "signature", "cls", "t_submit", "deadline", "model",
+                 "trace", "outcome", "reason", "slot", "stream_enabled",
+                 "slo_latency_s", "t_first_token", "_rng", "_tokens",
+                 "_cv", "_error")
+
+    def __init__(self, prompt, max_new_tokens, eos_id, sampling, deadline,
+                 cls="interactive"):
+        self.prompt = prompt                # host int32 (L,)
+        self.max_new_tokens = int(max_new_tokens)
+        self.eos_id = None if eos_id is None else int(eos_id)
+        self.sampling = sampling
+        self.rows = 1
+        self.signature = _DECODE_SIGNATURE
+        self.cls = cls
+        self.t_submit = time.monotonic()
+        self.deadline = deadline            # queue-wait deadline, or None
+        self.model = ""
+        self.trace = None
+        self.outcome = None                 # ok | timeout | error | shed
+        self.reason = None                  # eos | max_tokens | ...
+        self.slot = None                    # owned KV slot while active
+        self.stream_enabled = True
+        self.slo_latency_s = None           # TTFT — what the SLO judges
+        self.t_first_token = None
+        self._rng = sampling.make_rng()
+        self._tokens = []
+        self._cv = threading.Condition()
+        self._error = None
+
+    # -- engine side -------------------------------------------------------
+    def _push(self, token):
+        """Append one sampled token; wake streamers (unless streaming is
+        withheld — then tokens surface in one burst at retirement)."""
+        now = time.monotonic()
+        if self.t_first_token is None:
+            self.t_first_token = now
+            self.slo_latency_s = now - self.t_submit  # SLO judges TTFT
+        if self.trace is not None:
+            self.trace.stamp("token")
+        with self._cv:
+            self._tokens.append(int(token))
+            if self.stream_enabled:
+                self._cv.notify_all()
+
+    def _finish(self, outcome, result=None, error=None, reason=None):
+        """Claim the outcome; True iff this call won. The reqtrace/SLO
+        terminal chokepoint, same as ServeRequest."""
+        with self._cv:
+            if self.outcome is not None:
+                return False
+            self.outcome = outcome
+            self.reason = reason or outcome
+            self._error = error
+            self._cv.notify_all()
+        try:
+            _reqtrace().finish(self, outcome, error)
+        except Exception:
+            pass
+        return True
+
+    @property
+    def done(self):
+        return self.outcome is not None
+
+    # -- client side -------------------------------------------------------
+    def ttft_ms(self):
+        """Time-to-first-token in ms, or None before the first token."""
+        if self.t_first_token is None:
+            return None
+        return (self.t_first_token - self.t_submit) * 1e3
+
+    def tokens(self):
+        """Tokens generated so far (a snapshot; grows while active)."""
+        with self._cv:
+            return list(self._tokens)
+
+    def stream(self, timeout=None):
+        """Yield tokens as the engine settles them.
+
+        Yields every token exactly once, in order, ending when the
+        sequence retires; raises the typed failure AFTER yielding
+        whatever was generated before it. ``timeout`` (seconds) bounds
+        each inter-token wait, raising RequestTimeout on expiry. With
+        streaming withheld (MXTPU_DECODE_STREAM=0) this blocks until
+        retirement, then yields the whole completion.
+        """
+        i = 0
+        while True:
+            with self._cv:
+                deadline = None if timeout is None \
+                    else time.monotonic() + timeout
+                while True:
+                    live = not self.done
+                    gated = self.stream_enabled or not live
+                    if gated and len(self._tokens) > i:
+                        break
+                    if not live:
+                        break
+                    wait = 0.05 if deadline is None else \
+                        min(0.05, deadline - time.monotonic())
+                    if wait <= 0:
+                        raise RequestTimeout(
+                            f"no token within {timeout:.3f}s")
+                    self._cv.wait(wait)
+                if len(self._tokens) <= i and self.done:
+                    break
+                tok = self._tokens[i]
+            i += 1
+            yield tok
+        if self.outcome != "ok":
+            raise self._error
+
+    def result(self, timeout=None):
+        """Block until retirement; return the full token list or raise
+        the typed failure. ``timeout`` overrides the request deadline
+        (the wait extends to the deadline by default)."""
+        if timeout is None and self.deadline is not None:
+            timeout = max(0.0, self.deadline - time.monotonic())
+        deadline = None if timeout is None else \
+            time.monotonic() + timeout
+        with self._cv:
+            while not self.done:
+                wait = 0.1 if deadline is None else \
+                    min(0.1, deadline - time.monotonic())
+                if wait <= 0:
+                    break
+                self._cv.wait(wait)
+        if not self.done:
+            # claim the timeout ourselves; the engine frees the slot
+            # (reason "abandoned") when it next touches the sequence
+            self._finish("timeout", error=RequestTimeout(
+                f"sequence not completed within "
+                f"{timeout if timeout is not None else 0:.3f}s"))
+        if self.outcome == "ok":
+            return self.tokens()
+        raise self._error
+
+
+class DecodeEngine:
+    """Continuous-batching autoregressive server over a decode block.
+
+    ::
+
+        lm = decode.TinyCausalLM(max_len=128)
+        eng = decode.DecodeEngine(lm, name="lm", num_slots=4)
+        eng.warmup()                     # prefill rungs + the step; sealed
+        eng.start()
+        seq = eng.submit([3, 17, 9], max_new_tokens=32)
+        for tok in seq.stream():         # tokens while it generates
+            ...
+        eng.stop()
+
+    The block is duck-typed (model.py documents the contract):
+    ``init_cache`` / ``prefill`` / ``step`` / ``jit_trace_count``.
+    Lifecycle, admission, and observability mirror InferenceEngine.
+    """
+
+    def __init__(self, block, name="decode", num_slots=None, max_len=None,
+                 prefill_buckets=None, max_queue=None, max_wait_ms=None,
+                 timeout_ms=None, classes=None, stream=None,
+                 drain_timeout_ms=None):
+        for attr in ("init_cache", "prefill", "step", "jit_trace_count"):
+            if not hasattr(block, attr):
+                raise TypeError(
+                    f"DecodeEngine needs a decode block (init_cache/"
+                    f"prefill/step/jit_trace_count); {type(block)} "
+                    f"lacks {attr!r}")
+        self._block = block
+        self.name = str(name)
+        self.num_slots = int(
+            num_slots if num_slots is not None
+            else _env.get("MXTPU_DECODE_SLOTS"))
+        if self.num_slots < 1:
+            raise ValueError(f"num_slots must be >= 1, got "
+                             f"{self.num_slots}")
+        if max_len is not None:
+            self.max_len = int(max_len)
+        else:  # a block that knows its context window wins over the env
+            self.max_len = int(getattr(block, "max_len", None)
+                               or _env.get("MXTPU_DECODE_MAX_LEN"))
+        if prefill_buckets is None:
+            raw = str(_env.get("MXTPU_DECODE_PREFILL_BUCKETS")).strip()
+            if raw:
+                prefill_buckets = [int(t) for t in raw.split(",") if
+                                   t.strip()]
+        self.buckets = bucket_ladder(self.max_len, prefill_buckets,
+                                     axis="seqlen")
+        self.max_queue = int(
+            max_queue if max_queue is not None
+            else _env.get("MXTPU_SERVE_QUEUE"))
+        self.max_wait_s = float(
+            max_wait_ms if max_wait_ms is not None
+            else _env.get("MXTPU_SERVE_MAX_WAIT_MS")) / 1e3
+        self.timeout_s = float(
+            timeout_ms if timeout_ms is not None
+            else _env.get("MXTPU_SERVE_TIMEOUT_MS")) / 1e3
+        self.drain_timeout_s = float(
+            drain_timeout_ms if drain_timeout_ms is not None
+            else _env.get("MXTPU_SERVE_DRAIN_MS")) / 1e3
+        self.stream_enabled = bool(
+            stream if stream is not None
+            else _env.get("MXTPU_DECODE_STREAM"))
+        self._sched = RequestScheduler(self.name, classes=classes,
+                                       max_queue=self.max_queue)
+        self._cache = block.init_cache(self.num_slots, self.max_len)
+        self._free = list(range(self.num_slots))     # loop thread only
+        self._active = {}                            # slot -> sequence
+        self._last = _np.zeros((self.num_slots,), _np.int32)
+        self._mask = _np.zeros((self.num_slots,), bool)
+        self._lifecycle = threading.Lock()
+        self._stopping = False
+        self._thread = None
+        self._warm_traces = None
+        self._g_occupancy = _instr.decode_slot_occupancy.labels(self.name)
+
+    # -- lifecycle ---------------------------------------------------------
+    @property
+    def started(self):
+        t = self._thread
+        return t is not None and t.is_alive()
+
+    def start(self):
+        """Start the decode loop thread (idempotent)."""
+        with self._lifecycle:
+            if self._stopping:
+                raise EngineStopped(f"engine {self.name!r} was stopped")
+            if not self.started:
+                self._thread = threading.Thread(
+                    target=self._loop,
+                    name=f"mxtpu-decode-{self.name}", daemon=True)
+                self._thread.start()
+        _flight("decode_start", model=self.name, slots=self.num_slots,
+                max_len=self.max_len)
+        return self
+
+    def stop(self, drain=True, drain_timeout_ms=None):
+        """Stop accepting sequences; by default finish the live ones.
+
+        A graceful stop lets queued AND active sequences run to
+        retirement, bounded by ``drain_timeout_ms`` (default
+        MXTPU_SERVE_DRAIN_MS). At the bound — or immediately with
+        ``drain=False`` — queued sequences fail with
+        :class:`EngineStopped` and active ones retire with whatever
+        tokens they have (outcome "error", reason "stopped").
+        """
+        with self._lifecycle:
+            first = not self._stopping
+            self._stopping = True
+        self._sched.stop()
+        if not drain:
+            self._sched.stop(force=True)
+            self._fail_queued()
+            self._fail_active()
+        elif not self.started:
+            # never started (or already exited): nothing will ever
+            # serve the queue — dropping now IS the bounded drain
+            self._fail_queued()
+        else:
+            timeout_s = (float(drain_timeout_ms) / 1e3
+                         if drain_timeout_ms is not None
+                         else self.drain_timeout_s)
+            self._thread.join(timeout=timeout_s)
+            if self._thread.is_alive():
+                self._sched.stop(force=True)
+                self._fail_queued()
+                self._fail_active()
+        t = self._thread
+        if t is not None:
+            t.join(timeout=2.0)
+        if first:
+            _flight("decode_stop", model=self.name, drained=bool(drain))
+        return self
+
+    def _fail_queued(self):
+        for r in self._sched.drain_all():
+            if r._finish("error", error=EngineStopped(
+                    f"engine {self.name!r} stopped"), reason="stopped"):
+                _instr.record_serve_request(self.name, "error")
+
+    def _fail_active(self):
+        # claim the outcome; the loop thread observes done-ness and
+        # frees the slots (or _loop already exited and the cache dies
+        # with the engine)
+        for seq in list(self._active.values()):
+            seq._finish("error", error=EngineStopped(
+                f"engine {self.name!r} stopped mid-generation"),
+                reason="stopped")
+
+    def __enter__(self):
+        return self.start()
+
+    def __exit__(self, *exc):
+        self.stop()
+        return False
+
+    # -- warmup ------------------------------------------------------------
+    def warmup(self):
+        """Pre-compile every prefill rung AND the decode step, then
+        prove the cache sealed (shared
+        :func:`~mxnet_tpu.serving.engine.warm_and_seal` proof with the
+        one-shot engine). Runs against a scratch cache — the live pool
+        is untouched. Returns a summary dict."""
+        t0 = time.perf_counter()
+        scratch = {"cache": self._block.init_cache(self.num_slots,
+                                                   self.max_len)}
+        toks = _np.zeros((self.num_slots,), _np.int32)
+        act = _np.zeros((self.num_slots,), bool)
+        act[0] = True
+
+        def drive(rung):
+            if rung == "step":
+                scratch["cache"], logits = self._block.step(
+                    scratch["cache"], toks, act)
+            else:
+                scratch["cache"], logits = self._block.prefill(
+                    scratch["cache"], _np.zeros((int(rung),), _np.int32),
+                    0, 1)
+            _np.asarray(logits)  # settle — compile fully lands
+
+        rungs = [int(b) for b in self.buckets] + ["step"]
+        warm_and_seal(drive, rungs, self._engine_traces,
+                      label="decode shapes")
+        self._warm_traces = self._engine_traces()
+        return {
+            "model": self.name,
+            "prefill_buckets": list(self.buckets),
+            "step_slots": self.num_slots,
+            "compile_traces": self._warm_traces,
+            "seconds": round(time.perf_counter() - t0, 4),
+        }
+
+    def _engine_traces(self):
+        """Compile traces of the variants THIS engine drives (prefill +
+        step) — a caller running the block's other entry points (e.g.
+        ``full_logits`` as a parity reference) must not read as an
+        engine retrace."""
+        return (self._block.jit_trace_count("prefill")
+                + self._block.jit_trace_count("step"))
+
+    def recompiles_since_warmup(self):
+        """Block retraces since warmup() sealed the cache — 0 is the
+        steady-state invariant; None before warmup."""
+        if self._warm_traces is None:
+            return None
+        return self._engine_traces() - self._warm_traces
+
+    # -- client side -------------------------------------------------------
+    def submit(self, prompt, max_new_tokens=16, eos_id=None,
+               temperature=0.0, top_k=0, seed=0, timeout_ms=None,
+               priority=None):
+        """Enqueue one sequence; returns a :class:`SequenceRequest`.
+
+        ``prompt`` is a 1-D int token list/array, 1 <= len <= the top
+        prefill rung. ``max_new_tokens`` bounds generation (the slot's
+        context window may retire it earlier with reason
+        ``context_full``); ``eos_id`` retires on that token.
+        ``temperature``/``top_k``/``seed`` are per-sequence sampling
+        params — host-side, so any mix shares the compiled step.
+        Admission is the scheduler's: a full queue sheds with
+        :class:`Overloaded`, a rate-limited class with RateLimited, a
+        stopped engine raises EngineStopped. ``timeout_ms`` bounds the
+        QUEUE WAIT (generation, once joined, runs to retirement; a
+        client claiming the timeout mid-generation abandons the slot).
+        """
+        prompt = _np.asarray(prompt, _np.int32).reshape(-1)
+        if prompt.size < 1:
+            raise ValueError("prompt must contain at least one token")
+        if prompt.size > self.buckets[-1]:
+            raise ValueError(
+                f"prompt of {prompt.size} tokens exceeds the top "
+                f"prefill bucket {self.buckets[-1]}")
+        if int(max_new_tokens) < 1:
+            raise ValueError(
+                f"max_new_tokens must be >= 1, got {max_new_tokens}")
+        sampling = SamplingParams(temperature, top_k, seed)
+        tmo = self.timeout_s if timeout_ms is None else float(
+            timeout_ms) / 1e3
+        deadline = (time.monotonic() + tmo) if tmo > 0 else None
+        cls = str(priority) if priority is not None \
+            else self._sched.default_class
+        seq = SequenceRequest(prompt, max_new_tokens, eos_id, sampling,
+                              deadline, cls=cls)
+        seq.model = self.name
+        seq.stream_enabled = self.stream_enabled
+        try:
+            seq.trace = _reqtrace().maybe_start(
+                self.name, cls=cls, rows=1, deadline=deadline)
+        except Exception:
+            seq.trace = None
+        if self._stopping:
+            err = EngineStopped(f"engine {self.name!r} is stopped")
+            seq._finish("shed", error=err, reason="stopped")
+            raise err
+        try:
+            self._sched.offer(seq)  # sheds with Overloaded / RateLimited
+        except Overloaded as e:  # includes RateLimited
+            seq._finish("shed", error=e)
+            raise
+        return seq
+
+    # streaming is the submit contract here — the alias is the name the
+    # front door fans out on (FrontDoor.submit_stream tries replicas by
+    # this attribute, so one-shot engines never receive sequences)
+    submit_stream = submit
+
+    def generate(self, prompt, **kwargs):
+        """Submit + stream in one call: yields tokens as they settle.
+        Keyword args are :meth:`submit`'s."""
+        seq = self.submit(prompt, **kwargs)
+        return seq.stream()
+
+    # -- the decode loop ---------------------------------------------------
+    def _loop(self):
+        while True:
+            alive = self._join_ready(block=not self._active)
+            if self._active:
+                self._step_once()
+            elif not alive:
+                break
+        self._g_occupancy.set(0)
+
+    def _join_ready(self, block):
+        """Admit queued sequences into free slots. Blocks only when the
+        engine is idle (no active slots — nothing else to do); with
+        sequences decoding, a peek at queue depth keeps the loop
+        non-blocking. Returns False once the scheduler reports stopped
+        AND the queue is drained."""
+        if not self._free:
+            return True
+        if not block and self._sched.depth() == 0:
+            return not self._sched._stopping
+        batch = self._sched.collect(len(self._free), self.max_wait_s)
+        if batch is None:
+            return False
+        for seq in batch:
+            self._join_one(seq)
+        return True
+
+    def _join_one(self, seq):
+        if seq.done:  # client claimed timeout while queued
+            return
+        slot = self._free.pop()
+        if seq.trace is not None:
+            seq.trace.stamp("joining")  # queue phase closes
+            seq.trace.annotate(slot=slot)
+        length = int(seq.prompt.size)
+        bucket = pick_bucket(self.buckets, length)
+        padded = pad_axis(seq.prompt, bucket, axis=0, fill="zero")
+        t0 = time.perf_counter()
+        try:
+            self._cache, logits = self._block.prefill(
+                self._cache, padded, slot, length)
+            logits = _np.asarray(logits)  # settle
+        except Exception as e:  # noqa: BLE001 — per-sequence failure
+            self._free.append(slot)
+            if seq._finish("error", error=e, reason="error"):
+                _instr.record_serve_request(
+                    self.name, "error",
+                    time.monotonic() - seq.t_submit)
+            return
+        ms = (time.perf_counter() - t0) * 1e3
+        _instr.record_decode_prefill(self.name, ms, bucket, slot)
+        if seq.trace is not None:
+            seq.trace.stamp("prefilled")
+            seq.trace.bucket = bucket
+        seq.slot = slot
+        self._active[slot] = seq
+        self._g_occupancy.set(len(self._active))
+        self._settle_token(slot, seq, logits, stored=length)
+
+    def _step_once(self):
+        """One fixed-shape decode step for every active slot."""
+        self._reap_done()
+        if not self._active:
+            return
+        t0 = time.perf_counter()
+        try:
+            self._cache, logits = self._block.step(
+                self._cache, self._last, self._mask)
+            logits = _np.asarray(logits)  # settle
+        except Exception as e:  # noqa: BLE001 — the step serves every
+            # active sequence; its failure fails them all
+            for slot in list(self._active):
+                self._retire(slot, "error", error=e)
+            return
+        ms = (time.perf_counter() - t0) * 1e3
+        _instr.record_decode_step(self.name, ms, len(self._active))
+        lengths = _np.asarray(self._cache.lengths)
+        for slot, seq in list(self._active.items()):
+            self._settle_token(slot, seq, logits[slot],
+                               stored=int(lengths[slot]))
+
+    def _settle_token(self, slot, seq, logits, stored):
+        """Sample one token for ``slot`` off settled logits, push it to
+        the stream, and either retire the sequence or queue its token
+        for the next step. ``stored`` is the slot's cached positions —
+        the NEXT step must append the token we just sampled, so the row
+        needs stored < max_len to continue."""
+        tok = sample_token(logits, seq.sampling, seq._rng)
+        seq._push(tok)
+        _instr.record_decode_tokens(self.name)
+        n = len(seq._tokens)
+        if seq.eos_id is not None and tok == seq.eos_id:
+            self._retire(slot, "eos")
+        elif n >= seq.max_new_tokens:
+            self._retire(slot, "max_tokens")
+        elif stored >= self.max_len:
+            self._retire(slot, "context_full")
+        else:
+            self._last[slot] = tok
+            self._mask[slot] = True
+
+    def _reap_done(self):
+        """Free slots whose sequences were finished from outside the
+        loop (client-claimed timeout, force-stop)."""
+        for slot, seq in list(self._active.items()):
+            if seq.done:
+                self._retire(slot, "abandoned")
+
+    def _retire(self, slot, reason, error=None):
+        """Free the slot (a value-only cache write — never retraces) and
+        settle the sequence's outcome."""
+        seq = self._active.pop(slot)
+        self._cache = self._cache.free(slot)
+        self._mask[slot] = False
+        self._free.append(slot)
+        self._g_occupancy.set(len(self._active))
+        ttft = None if seq.t_first_token is None \
+            else seq.t_first_token - seq.t_submit
+        _instr.record_decode_retire(self.name, reason,
+                                    len(seq._tokens), ttft)
+        outcome = "ok" if reason in ("eos", "max_tokens",
+                                     "context_full") else "error"
+        if error is None and outcome == "error":
+            error = EngineStopped(
+                f"sequence dropped by engine {self.name!r} ({reason})")
+        if seq._finish(outcome, error=error, reason=reason):
+            _instr.record_serve_request(
+                self.name, outcome, time.monotonic() - seq.t_submit)
+
+    # -- observability (the FrontDoor/registry/opsd surface) ---------------
+    def queue_depth(self):
+        """Sequences waiting for a slot (mirrors serve_queue_depth)."""
+        return self._sched.depth()
+
+    def inflight_rows(self):
+        """Sequences actively generating (slot owners)."""
+        return len(self._active)
+
+    def load(self):
+        """Least-loaded routing score for the front door: queued +
+        active sequences."""
+        return self._sched.depth_rows() + len(self._active)
+
+    def admission_state(self):
+        """"ok" / "overloaded" / "stopped" — same /readyz contract as
+        InferenceEngine.admission_state."""
+        if self._stopping:
+            return "stopped"
+        if self._sched.at_bound():
+            return "overloaded"
+        return "ok"
+
+    def _quantile_ms(self, hist, q):
+        child = hist.labels(self.name)
+        count = child.count
+        if not count:
+            return None
+        target = q * count
+        cum = child.cumulative()
+        for bound, acc in cum:
+            if acc >= target:
+                if bound == float("inf"):
+                    bound = cum[-2][0] if len(cum) > 1 else 0.0
+                return round(float(bound), 3)
+        return None
+
+    def stats(self):
+        """Live snapshot: slots, queue, retirement reasons, token
+        throughput surrogates, TTFT/step quantiles, and the
+        zero-recompile invariant."""
+        reasons = {
+            lv[1]: c.value
+            for lv, c in _instr.decode_sequence_total.series()
+            if lv[0] == self.name}
+        return {
+            "model": self.name,
+            "started": self.started,
+            "slots": self.num_slots,
+            "occupied": len(self._active),
+            "max_len": self.max_len,
+            "prefill_buckets": list(self.buckets),
+            "queue_depth": self._sched.depth(),
+            "max_queue": self.max_queue,
+            "classes": self._sched.class_stats(),
+            "sequences": reasons,
+            "tokens":
+                _instr.decode_tokens_total.labels(self.name).value,
+            "ttft_p50_ms": self._quantile_ms(_instr.decode_ttft_ms, .50),
+            "ttft_p99_ms": self._quantile_ms(_instr.decode_ttft_ms, .99),
+            "step_p50_ms": self._quantile_ms(_instr.decode_step_ms, .50),
+            "prefill_p50_ms":
+                self._quantile_ms(_instr.decode_prefill_ms, .50),
+            "recompiles_since_warmup": self.recompiles_since_warmup(),
+            "slo": self._slo_status(),
+        }
+
+    def _slo_status(self):
+        try:
+            return _reqtrace().slo_status().get(self.name)
+        except Exception:
+            return None

@@ -1,0 +1,226 @@
+"""TinyCausalLM — the decode block contract, and its reference model.
+
+The DecodeEngine (engine.py) is duck-typed over a small "decode block"
+surface, the generation analog of the ``call_cached_graph`` contract the
+one-shot serving engine runs on:
+
+    init_cache(num_slots, max_len)        -> KVCache
+    prefill(cache, tokens, slot, length)  -> (cache, last_logits)
+    step(cache, tokens, active)           -> (cache, logits)
+    full_logits(tokens, length)           -> last_logits   (uncached ref)
+    jit_trace_count()                     -> int           (retrace proof)
+
+``prefill`` consumes one prompt padded to a seq-len bucket rung
+(``tokens`` is ``(L_bucket,)``; positions past ``length`` are pad) and
+writes the slot's K/V; ``step`` is THE steady-state program — fixed
+``(num_slots,)`` token vector, one position per active slot — so every
+decode iteration of every sequence mix hits one compiled executable.
+Slot ids, lengths, and token values are traced scalars/arrays (weak
+types, never static arguments), so no value ever retraces.
+
+:class:`TinyCausalLM` implements that contract as a deterministic
+single-layer causal-attention LM, built for the parity and retrace
+proofs in tests/test_decode.py rather than for quality:
+
+  * parameters are drawn on a coarse dyadic grid (multiples of 1/8) so
+    the h/K/V/Q projections are EXACT in f32 regardless of reduction
+    order — the cached and uncached paths may matmul at different
+    shapes, and exact grids make those bitwise-equal anyway;
+  * cached attention and the uncached reference share one ``_attend``
+    helper over identical ``(max_len,)``-padded operands with the
+    KVCache position-mask contract, so their softmax inputs are
+    bitwise-identical;
+  * every jitted body bumps a host-side trace counter (and the
+    ``jit_trace_total`` telemetry series) exactly the way
+    gluon.HybridBlock does — ``jit_trace_count()`` is the zero-retrace
+    oracle DecodeEngine.warmup() seals against.
+
+Real transformer blocks plug into the engine by exposing the same five
+methods over their own stacked-layer caches. See docs/decode.md.
+"""
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+import numpy as _np
+
+from ..telemetry import instruments as _instr
+from .cache import KVCache
+
+__all__ = ["TinyCausalLM"]
+
+
+def _grid(rs, shape, scale=0.125, span=4):
+    """Deterministic params on the dyadic grid {-span..span} * scale —
+    exactly representable in f32, so matmuls over them are
+    order-insensitive (the parity proof's foundation)."""
+    return (rs.randint(-span, span + 1, shape) * scale).astype(_np.float32)
+
+
+class TinyCausalLM:
+    """Single-layer causal attention LM over a paged :class:`KVCache`.
+
+    ::
+
+        lm = TinyCausalLM(vocab=64, d_model=16, num_heads=2, max_len=64)
+        cache = lm.init_cache(num_slots=4, max_len=64)
+        cache, logits = lm.prefill(cache, padded_prompt, slot=0, length=5)
+        cache, step_logits = lm.step(cache, last_tokens, active)
+
+    All three traced entry points are jitted once per input SIGNATURE:
+    ``prefill`` once per seq-len bucket rung, ``step``/``full_logits``
+    exactly once. ``name`` labels the telemetry series.
+    """
+
+    def __init__(self, vocab=64, d_model=16, num_heads=2, max_len=64,
+                 seed=0, name="TinyCausalLM"):
+        if d_model % num_heads:
+            raise ValueError(f"d_model {d_model} not divisible by "
+                             f"num_heads {num_heads}")
+        self.vocab = int(vocab)
+        self.d_model = int(d_model)
+        self.num_heads = int(num_heads)
+        self.head_dim = self.d_model // self.num_heads
+        self.max_len = int(max_len)
+        self.name = str(name)
+        rs = _np.random.RandomState(seed)
+        d, v = self.d_model, self.vocab
+        self.params = {
+            "embed": jnp.asarray(_grid(rs, (v, d))),
+            "pos": jnp.asarray(_grid(rs, (self.max_len, d))),
+            "wq": jnp.asarray(_grid(rs, (d, d))),
+            "wk": jnp.asarray(_grid(rs, (d, d))),
+            "wv": jnp.asarray(_grid(rs, (d, d))),
+            "wo": jnp.asarray(_grid(rs, (d, d))),
+            "wout": jnp.asarray(_grid(rs, (d, v))),
+        }
+        self._trace_counts = {}
+        self._prefill = jax.jit(self._prefill_body)
+        self._step = jax.jit(self._step_body)
+        self._full = jax.jit(self._full_body)
+
+    # -- trace accounting (the HybridBlock idiom) --------------------------
+    def _bump_trace(self, variant):
+        # host side effect inside a jitted body: runs once per trace
+        # (one XLA compile), never on cache hits — the retrace signal
+        # jit_trace_count() and the jit_trace_total series expose
+        self._trace_counts[variant] = \
+            self._trace_counts.get(variant, 0) + 1
+        _instr.record_trace(self.name, variant)
+
+    def jit_trace_count(self, variant=None):
+        """Traces (= XLA compiles) so far: one variant's count, or the
+        total across prefill/step/full — DecodeEngine.warmup()'s
+        zero-retrace oracle."""
+        if variant is not None:
+            return self._trace_counts.get(variant, 0)
+        return sum(self._trace_counts.values())
+
+    # -- shared attention math (the bitwise-parity contract) ---------------
+    def _project(self, h):
+        """h (..., d) -> (q, k, v) each (..., heads, head_dim)."""
+        p = self.params
+        shape = h.shape[:-1] + (self.num_heads, self.head_dim)
+        return ((h @ p["wq"]).reshape(shape),
+                (h @ p["wk"]).reshape(shape),
+                (h @ p["wv"]).reshape(shape))
+
+    def _attend(self, q, k, v, bias):
+        """One query against a ``(max_len,)``-padded K/V row.
+
+        q ``(heads, head_dim)``; k/v ``(max_len, heads, head_dim)``;
+        bias ``(max_len,)`` additive (0 valid / NEG_INF masked). BOTH
+        the cached path and the uncached reference come through here
+        with identical shapes, so their reductions are bitwise-equal.
+        """
+        scale = 1.0 / math.sqrt(self.head_dim)
+        scores = jnp.einsum("hd,phd->hp", q, k) * scale + bias[None, :]
+        probs = jax.nn.softmax(scores, axis=-1)
+        return jnp.einsum("hp,phd->hd", probs, v)
+
+    def _logits(self, h_last, attn):
+        """feature = residual + projected attention -> vocab logits."""
+        p = self.params
+        feat = h_last + attn.reshape(attn.shape[:-2] + (self.d_model,)) \
+            @ p["wo"]
+        return feat @ p["wout"]
+
+    def _embed(self, tokens, positions):
+        p = self.params
+        return p["embed"][tokens] + p["pos"][positions]
+
+    # -- traced bodies -----------------------------------------------------
+    def _prefill_body(self, cache, tokens, slot, length):
+        """tokens (L_bucket,) int32; returns (cache', logits (vocab,))
+        for the prompt's LAST valid position — the logits the first
+        generated token is sampled from."""
+        self._bump_trace("prefill")
+        L = tokens.shape[0]
+        h = self._embed(tokens, jnp.arange(L))
+        q, k, v = self._project(h)
+        cache = cache.prefill(slot, k, v, length)
+        q_last = jax.lax.dynamic_index_in_dim(
+            q, jnp.asarray(length, jnp.int32) - 1, axis=0, keepdims=False)
+        h_last = jax.lax.dynamic_index_in_dim(
+            h, jnp.asarray(length, jnp.int32) - 1, axis=0, keepdims=False)
+        slot = jnp.asarray(slot, jnp.int32)
+        attn = self._attend(q_last, cache.k[slot], cache.v[slot],
+                            cache.position_mask()[slot])
+        return cache, self._logits(h_last, attn)
+
+    def _step_body(self, cache, tokens, active):
+        """THE decode step: tokens (num_slots,) int32 (each slot's last
+        sampled token), active (num_slots,) bool. Appends one position
+        per active slot and returns (cache', logits (num_slots, vocab)).
+        Fixed shapes — compiles exactly once."""
+        self._bump_trace("step")
+        pos = jnp.minimum(cache.lengths, cache.max_len - 1)
+        h = self._embed(tokens, pos)                # (slots, d)
+        q, k, v = self._project(h)                  # (slots, heads, hd)
+        cache = cache.append(k, v, active)
+        attn = jax.vmap(self._attend)(q, cache.k, cache.v,
+                                      cache.position_mask())
+        return cache, self._logits(h, attn)
+
+    def _full_body(self, tokens, length):
+        """The UNCACHED reference: recompute the whole prefix from
+        scratch (tokens padded to (max_len,)) and return the last valid
+        position's logits. Same padded shapes + position-mask contract
+        as the cached path, so greedy decode through the cache must
+        reproduce it token for token."""
+        self._bump_trace("full")
+        h = self._embed(tokens, jnp.arange(self.max_len))
+        q, k, v = self._project(h)
+        length = jnp.asarray(length, jnp.int32)
+        pos = jnp.arange(self.max_len)
+        bias = jnp.where(pos < length, 0.0, jnp.asarray(-1e30))
+        q_last = jax.lax.dynamic_index_in_dim(q, length - 1, axis=0,
+                                              keepdims=False)
+        h_last = jax.lax.dynamic_index_in_dim(h, length - 1, axis=0,
+                                              keepdims=False)
+        attn = self._attend(q_last, k, v, bias)
+        return self._logits(h_last, attn)
+
+    # -- the decode-block surface ------------------------------------------
+    def init_cache(self, num_slots, max_len=None):
+        """A fresh paged pool sized for this model's heads."""
+        return KVCache.create(num_slots,
+                              self.max_len if max_len is None else max_len,
+                              self.num_heads, self.head_dim)
+
+    def prefill(self, cache, tokens, slot, length):
+        tokens = jnp.asarray(tokens, jnp.int32)
+        return self._prefill(cache, tokens, int(slot), int(length))
+
+    def step(self, cache, tokens, active):
+        return self._step(cache, jnp.asarray(tokens, jnp.int32),
+                          jnp.asarray(active, bool))
+
+    def full_logits(self, tokens, length):
+        """Uncached reference logits for ``tokens[:length]`` (padded or
+        not — anything shorter than max_len is zero-padded here)."""
+        toks = _np.zeros((self.max_len,), _np.int32)
+        toks[:len(tokens)] = _np.asarray(tokens, _np.int32)[:self.max_len]
+        return self._full(jnp.asarray(toks), int(length))

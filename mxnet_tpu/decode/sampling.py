@@ -1,0 +1,75 @@
+"""Per-sequence token sampling — host-side, so params can never retrace.
+
+The decode step's device program is sampling-free: it returns raw logits
+at the fixed ``(num_slots, vocab)`` shape and the engine samples on the
+host, per sequence, from the settled numpy row. Temperature / top-k /
+seed therefore live entirely outside the jit cache — two sequences with
+different sampling params share every compiled program, which is the
+"per-sequence sampling params that never retrace" half of the
+zero-retrace invariant (the other half is the paged cache, see
+cache.py). Greedy is deterministic argmax (the parity oracle); sampled
+modes draw from a per-sequence ``RandomState`` so a (seed, prompt) pair
+replays identically regardless of slot placement or batch mix.
+"""
+from __future__ import annotations
+
+import numpy as _np
+
+__all__ = ["SamplingParams", "sample_token"]
+
+
+class SamplingParams:
+    """One sequence's sampling recipe.
+
+    ``temperature <= 0`` means greedy (argmax; ``top_k``/``seed``
+    ignored). ``top_k > 0`` restricts sampling to the k highest logits.
+    Validated once at submit time; applied host-side every token.
+    """
+
+    __slots__ = ("temperature", "top_k", "seed")
+
+    def __init__(self, temperature=0.0, top_k=0, seed=0):
+        self.temperature = float(temperature)
+        self.top_k = int(top_k)
+        self.seed = int(seed)
+        if self.temperature < 0.0:
+            raise ValueError(f"temperature must be >= 0, got "
+                             f"{self.temperature}")
+        if self.top_k < 0:
+            raise ValueError(f"top_k must be >= 0, got {self.top_k}")
+
+    @property
+    def greedy(self):
+        return self.temperature <= 0.0
+
+    def make_rng(self):
+        """The sequence-lifetime RNG (None for greedy — no randomness)."""
+        return None if self.greedy else _np.random.RandomState(self.seed)
+
+    def __repr__(self):
+        if self.greedy:
+            return "SamplingParams(greedy)"
+        return (f"SamplingParams(temperature={self.temperature}, "
+                f"top_k={self.top_k}, seed={self.seed})")
+
+
+def sample_token(logits, params, rng=None):
+    """Draw one token id from a settled ``(vocab,)`` logits row.
+
+    ``rng`` is the sequence's ``make_rng()`` product, threaded by the
+    engine so consecutive tokens advance one stream (ignored for
+    greedy).
+    """
+    logits = _np.asarray(logits, _np.float64)
+    if params.greedy:
+        return int(_np.argmax(logits))
+    scaled = logits / params.temperature
+    if params.top_k > 0 and params.top_k < scaled.shape[0]:
+        kth = _np.partition(scaled, -params.top_k)[-params.top_k]
+        scaled = _np.where(scaled >= kth, scaled, -_np.inf)
+    scaled = scaled - _np.max(scaled)
+    probs = _np.exp(scaled)
+    probs /= probs.sum()
+    if rng is None:
+        rng = params.make_rng()
+    return int(rng.choice(probs.shape[0], p=probs))

@@ -159,6 +159,29 @@ register(
     "requests still queued at the bound are force-dropped (counted in "
     "serve_drain_dropped_total).")
 register(
+    "MXTPU_DECODE_SLOTS", int, 4,
+    "decode.DecodeEngine default KV-cache slot count: the fixed "
+    "sequence capacity of the paged (num_slots, max_len, ...) pool and "
+    "the batch dimension of the steady-state decode step "
+    "(docs/decode.md).")
+register(
+    "MXTPU_DECODE_MAX_LEN", int, 128,
+    "decode.DecodeEngine default per-slot context window: prompt + "
+    "generated tokens per sequence are capped here (a sequence filling "
+    "its slot row retires with reason 'context_full').")
+register(
+    "MXTPU_DECODE_PREFILL_BUCKETS", str, "",
+    "decode.DecodeEngine prefill seq-len bucket ladder as a "
+    "comma-separated rung list (e.g. '16,64,128'); empty = the "
+    "powers-of-two ladder up to MXTPU_DECODE_MAX_LEN. Every rung is "
+    "pre-compiled by warmup(); prompts pad up to the nearest rung.")
+register(
+    "MXTPU_DECODE_STREAM", bool, True,
+    "decode.DecodeEngine streaming default: on, SequenceRequest.stream() "
+    "yields each token as its step settles; off, tokens are withheld "
+    "until the sequence retires (stream() then yields them in one "
+    "burst) — for clients that want whole completions only.")
+register(
     "MXTPU_TRACE_SAMPLE", float, 0.0,
     "Head-based request-trace sampling fraction for the serving tier "
     "(observability/reqtrace.py): 0 = off (bit-identical serving path, "

@@ -244,6 +244,28 @@ def traces_payload(n=32, cls=None, model=None):
 
     recs = reqtrace.traces(cls=cls, model=model)
     n = max(0, int(n))
+    # decode-sequence traces carry per-token spans; summarize them so
+    # TTFT / inter-token behavior reads off /traces without digging
+    # through span lists (decode/engine.py stamps prefill + token)
+    decode = {"sequences": 0, "tokens": 0}
+    ttfts = []
+    for r in recs:
+        spans = r.get("spans", ())
+        toks = sum(1 for sp in spans if sp["phase"] == "token")
+        if not toks:
+            continue
+        decode["sequences"] += 1
+        decode["tokens"] += toks
+        for sp in spans:
+            if sp["phase"] == "token":
+                # first token span closes at its stamp: TTFT = t0 + dur
+                # relative to trace start
+                ttfts.append((sp["t0"] + sp["dur"] - r["t0"]) * 1e3)
+                break
+    if ttfts:
+        ttfts.sort()
+        decode["ttft_p50_ms"] = round(ttfts[len(ttfts) // 2], 3)
+        decode["ttft_max_ms"] = round(ttfts[-1], 3)
     return {
         "identity": _flight.identity(),
         "sample_rate": reqtrace.sample_rate(),
@@ -254,6 +276,7 @@ def traces_payload(n=32, cls=None, model=None):
         "traces": recs[-n:] if n else [],
         "batches": reqtrace.batches(n),
         "phases": reqtrace.phase_summary(),
+        "decode": decode,
         "slo": reqtrace.slo_status(),
     }
 

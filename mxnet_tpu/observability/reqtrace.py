@@ -83,6 +83,12 @@ _PHASE_OF = {
     "dispatched": "dispatch",   # async dispatch returned
     "ready": "device",          # output buffers exist
     "sliced": "slice",          # this request's rows sliced off
+    # decode-sequence boundaries (decode/engine.py): a sequence trace is
+    # admit | queue | prefill | token* | settle — one token span per
+    # generated token, so inter-token latency reads straight off /traces
+    "joining": "queue",         # the decode loop claimed a KV slot
+    "prefilled": "prefill",     # prompt prefill settled (first logits)
+    "token": "token",           # one sampled token pushed to the stream
 }
 
 _SHED_REASON = {  # error type -> the reason stamped on terminal spans
@@ -220,8 +226,13 @@ def finish(req, outcome, error=None):
     try:
         now = time.perf_counter()
         latency = time.monotonic() - req.t_submit
+        # a request may nominate a different latency for its objective:
+        # decode sequences set slo_latency_s to time-to-first-token, so
+        # the class SLO judges responsiveness rather than penalizing
+        # long (healthy) generations by their total wall time
+        slo_latency = getattr(req, "slo_latency_s", None)
         slo_observe(getattr(req, "model", "") or "", req.cls, outcome,
-                    latency)
+                    latency if slo_latency is None else slo_latency)
         tr = getattr(req, "trace", None)
         if tr is None:
             return None

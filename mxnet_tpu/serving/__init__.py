@@ -31,8 +31,9 @@ Quick start::
 """
 from __future__ import annotations
 
-from .buckets import assemble_batch, bucket_ladder, pad_rows, pick_bucket
-from .engine import InferenceEngine, ServeRequest
+from .buckets import (assemble_batch, bucket_ladder, pad_axis, pad_rows,
+                      pick_bucket)
+from .engine import InferenceEngine, ServeRequest, warm_and_seal
 from .errors import (EngineStopped, Overloaded, RateLimited,
                      RequestTimeout, ServingError)
 from .frontdoor import FrontDoor, OpsPlaneHealth
@@ -49,5 +50,6 @@ __all__ = [
     "SimulatedBlock",
     "ServingError", "Overloaded", "RateLimited", "RequestTimeout",
     "EngineStopped",
-    "bucket_ladder", "pick_bucket", "pad_rows", "assemble_batch",
+    "bucket_ladder", "pick_bucket", "pad_rows", "pad_axis",
+    "assemble_batch", "warm_and_seal",
 ]

@@ -1,61 +1,85 @@
-"""Batch-bucket ladder: the serving engine's compile-shape vocabulary.
+"""Bucket ladders: the serving tier's compile-shape vocabulary.
 
-A TPU serves from a jit cache keyed by exact shapes — a stray batch size
-on the hot path means an online XLA compile (seconds) in front of a
-millisecond request. So the micro-batcher never launches a raw batch:
-every batch is padded UP to the nearest rung of a fixed ladder
-(1/2/4/.../max by default), all rungs are pre-compiled by
-``InferenceEngine.warmup()``, and steady state touches only cached
-executables. Doubling rungs bound the padding waste at <2x worst case
-while keeping the compile count at O(log max_batch) — the bucketing
-trade the TPU cost model motivates (PAPERS.md "A Learned Performance
-Model for Tensor Processing Units").
+A TPU serves from a jit cache keyed by exact shapes — a stray shape on
+the hot path means an online XLA compile (seconds) in front of a
+millisecond request. So nothing dispatches raw: every batch (and, since
+the decode subsystem, every prompt) is padded UP to the nearest rung of
+a fixed ladder (1/2/4/.../max by default), all rungs are pre-compiled
+by warmup, and steady state touches only cached executables. Doubling
+rungs bound the padding waste at <2x worst case while keeping the
+compile count at O(log max) — the bucketing trade the TPU cost model
+motivates (PAPERS.md "A Learned Performance Model for Tensor Processing
+Units").
 
-Stdlib + numpy only: batch assembly is host-side; the single
-device transfer happens in engine.py after padding.
+The ladder is AXIS-NAMED (ISSUE 18): the one-shot engine buckets batch
+ROWS (axis="rows", the historical default — the axis-less calls below
+are unchanged), while the decode engine's prefill buckets sequence
+LENGTH (axis="seqlen"), where padding repeats along a time axis and the
+KV-cache position mask hides the pad. Same ladder math, different
+padding semantics: rows repeat the last ROW (pad must stay in the input
+distribution — zeros can NaN through normalization), seqlen pads are
+masked so zeros are fine and cheapest.
+
+Stdlib + numpy only: assembly is host-side; the single device transfer
+happens in the engines after padding.
 """
 from __future__ import annotations
 
 import numpy as _np
 
-__all__ = ["bucket_ladder", "pick_bucket", "pad_rows", "assemble_batch"]
+__all__ = ["bucket_ladder", "pick_bucket", "pad_rows", "pad_axis",
+           "assemble_batch", "AXES"]
+
+#: The named ladder axes. "rows" buckets batch rows (one-shot serving);
+#: "seqlen" buckets sequence length (decode prefill).
+AXES = ("rows", "seqlen")
 
 
-def bucket_ladder(max_batch, buckets=None):
-    """The sorted tuple of batch buckets to pre-compile.
+def _check_axis(axis):
+    if axis not in AXES:
+        raise ValueError(f"axis must be one of {AXES}, got {axis!r}")
+    return axis
 
-    Default: powers of two up to ``max_batch``, with ``max_batch`` itself
-    always the top rung (so a full batch never pads). An explicit
-    ``buckets`` iterable is validated, deduplicated, sorted, and capped
-    at ``max_batch``.
+
+def bucket_ladder(max_size, buckets=None, axis="rows"):
+    """The sorted tuple of bucket rungs to pre-compile along ``axis``.
+
+    Default: powers of two up to ``max_size``, with ``max_size`` itself
+    always the top rung (so a full batch / max-length prompt never
+    pads). An explicit ``buckets`` iterable is validated, deduplicated,
+    sorted, and capped at ``max_size``. ``axis`` names what the rungs
+    mean — ``"rows"`` (batch rows, the back-compat default) or
+    ``"seqlen"`` (prompt length for decode prefill); the ladder math is
+    axis-independent, the name is validated so call sites state intent.
     """
-    max_batch = int(max_batch)
-    if max_batch < 1:
-        raise ValueError(f"max_batch must be >= 1, got {max_batch}")
+    _check_axis(axis)
+    max_size = int(max_size)
+    if max_size < 1:
+        raise ValueError(f"max_{axis} must be >= 1, got {max_size}")
     if buckets is None:
         ladder, b = [], 1
-        while b < max_batch:
+        while b < max_size:
             ladder.append(b)
             b *= 2
-        ladder.append(max_batch)
+        ladder.append(max_size)
         return tuple(sorted(set(ladder)))
     ladder = sorted({int(b) for b in buckets})
     if not ladder or ladder[0] < 1:
         raise ValueError(f"buckets must be positive ints, got {buckets}")
-    if ladder[-1] > max_batch:
+    if ladder[-1] > max_size:
         raise ValueError(
-            f"bucket {ladder[-1]} exceeds max_batch {max_batch}")
-    if ladder[-1] != max_batch:
-        ladder.append(max_batch)
+            f"bucket {ladder[-1]} exceeds max_{axis} {max_size}")
+    if ladder[-1] != max_size:
+        ladder.append(max_size)
     return tuple(ladder)
 
 
-def pick_bucket(ladder, rows):
-    """Smallest rung >= rows, or None when rows exceeds the top rung
+def pick_bucket(ladder, size):
+    """Smallest rung >= size, or None when size exceeds the top rung
     (the batcher never assembles past the top; submit() rejects
     single requests that big)."""
     for b in ladder:
-        if rows <= b:
+        if size <= b:
             return b
     return None
 
@@ -68,17 +92,40 @@ def pad_rows(arr, bucket):
     sliced off before any result leaves the engine, so their values are
     unobservable.
     """
-    pad = int(bucket) - arr.shape[0]
+    return pad_axis(arr, bucket, axis=0, fill="repeat")
+
+
+def pad_axis(arr, bucket, axis=0, fill="zero"):
+    """Pad ``arr`` up to ``bucket`` along ``axis`` (an integer array
+    dimension, not a ladder-axis name).
+
+    ``fill="repeat"`` repeats the trailing slice (row-padding semantics:
+    pad must stay in the input distribution); ``fill="zero"`` appends
+    zeros (seqlen-padding semantics: the KV-cache position mask hides
+    pad positions, so zeros are correct and cheapest).
+    """
+    arr = _np.asarray(arr)
+    pad = int(bucket) - arr.shape[axis]
     if pad < 0:
         raise ValueError(
-            f"batch of {arr.shape[0]} rows does not fit bucket {bucket}")
+            f"size {arr.shape[axis]} along axis {axis} does not fit "
+            f"bucket {bucket}")
     if pad == 0:
         return arr
-    return _np.concatenate([arr, _np.repeat(arr[-1:], pad, axis=0)])
+    if fill == "repeat":
+        idx = [slice(None)] * arr.ndim
+        idx[axis] = slice(-1, None)
+        return _np.concatenate(
+            [arr, _np.repeat(arr[tuple(idx)], pad, axis=axis)], axis=axis)
+    if fill != "zero":
+        raise ValueError(f"fill must be 'repeat' or 'zero', got {fill!r}")
+    widths = [(0, 0)] * arr.ndim
+    widths[axis] = (0, pad)
+    return _np.pad(arr, widths)
 
 
 def assemble_batch(request_inputs, bucket):
-    """Concatenate per-request host inputs and pad to ``bucket``.
+    """Concatenate per-request host inputs and pad to ``bucket`` rows.
 
     ``request_inputs`` is a list over requests, each a tuple of numpy
     arrays (one per model input, sharing the request's row count).

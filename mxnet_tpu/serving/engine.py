@@ -61,7 +61,7 @@ from .buckets import assemble_batch, bucket_ladder, pad_rows, pick_bucket
 from .errors import EngineStopped, Overloaded, RequestTimeout
 from .scheduler import RequestScheduler
 
-__all__ = ["InferenceEngine", "ServeRequest"]
+__all__ = ["InferenceEngine", "ServeRequest", "warm_and_seal"]
 
 _REQTRACE = [None]
 
@@ -93,6 +93,34 @@ def _wait_ready(datas):
         ready = getattr(d, "block_until_ready", None)
         if ready is not None:
             ready()
+
+
+def warm_and_seal(drive, rungs, trace_count, label="buckets"):
+    """Warm a shape vocabulary and PROVE the jit cache sealed.
+
+    Drives every rung once (compiling whatever misses), snapshots the
+    caller's trace counter, drives every rung AGAIN, and raises if the
+    counter moved — a moving counter means some served shape still
+    misses the jit cache and would compile online on the hot path.
+    Shared by :meth:`InferenceEngine.warmup` (row buckets) and
+    ``decode.DecodeEngine.warmup`` (prefill seq-len rungs + the decode
+    step), so every engine's zero-retrace proof is the same code path.
+    Returns the post-warm trace count (the ``recompiles_since_warmup``
+    baseline).
+    """
+    rungs = list(rungs)
+    for r in rungs:
+        drive(r)
+    before = trace_count()
+    for r in rungs:  # re-drive: everything must cache-hit now
+        drive(r)
+    added = trace_count() - before
+    if added:
+        raise RuntimeError(
+            f"warmup failed to seal the jit cache: {added} "
+            f"recompile(s) re-driving {label} {rungs} — served shapes "
+            "would compile online")
+    return before
 
 
 class ServeRequest:
@@ -409,7 +437,7 @@ class InferenceEngine:
         return False
 
     # -- warmup ------------------------------------------------------------
-    def warmup(self, *example_inputs, introspect=True):
+    def warmup(self, *example_inputs, shapes=None, introspect=True):
         """Pre-compile EVERY bucket, then prove the cache is sealed.
 
         ``example_inputs`` is one example request (each array with a
@@ -420,11 +448,17 @@ class InferenceEngine:
         compile registry under ``(name, "b<rows>")`` with XLA's
         cost/memory analysis (HybridBlock.aot_introspect).
 
-        The proof: after compiling, every rung is driven AGAIN and the
-        predict-variant retrace counter must not move — a moving counter
-        means some served shape misses the jit cache, and warmup raises
-        rather than let an online compile hide on the hot path. Returns
-        a summary dict.
+        ``shapes`` overrides the rung list (a caller-supplied iterable
+        of row counts, each <= ``max_batch_size``) — for warming a
+        deployment's measured shape mix instead of the whole ladder, or
+        re-warming one rung after a cache flush. Default: every ladder
+        bucket.
+
+        The proof (shared :func:`warm_and_seal` path): after compiling,
+        every rung is driven AGAIN and the predict-variant retrace
+        counter must not move — a moving counter means some served
+        shape misses the jit cache, and warmup raises rather than let
+        an online compile hide on the hot path. Returns a summary dict.
         """
         ex = [_to_host(a) for a in example_inputs]
         if not ex or any(a.ndim < 1 for a in ex):
@@ -434,31 +468,39 @@ class InferenceEngine:
         rows = ex[0].shape[0]
         if any(a.shape[0] != rows for a in ex):
             raise ValueError("example inputs disagree on row count")
+        if shapes is None:
+            rungs = list(self.buckets)
+        else:
+            rungs = sorted({int(b) for b in shapes})
+            if not rungs:
+                raise ValueError("shapes must name at least one rung")
+            if rungs[0] < 1 or rungs[-1] > self.max_batch_size:
+                raise ValueError(
+                    f"warmup shapes {rungs} outside "
+                    f"1..{self.max_batch_size}")
         t0 = time.perf_counter()
 
         def rung_inputs(b):
             return [NDArray(jnp.asarray(pad_rows(a[:min(rows, b)], b)))
                     for a in ex]
 
-        for b in self.buckets:
-            nds = rung_inputs(b)
+        def drive(b):
             _wait_ready([o._data for o in self._flatten_out(
-                self._block.call_cached_graph(*nds))])
-            if introspect and hasattr(self._block, "aot_introspect"):
-                self._block.aot_introspect(f"b{b}", *nds, label=self.name)
-        traces = self._block.jit_trace_count(False)
-        for b in self.buckets:  # re-drive: everything must cache-hit now
-            self._block.call_cached_graph(*rung_inputs(b))
-        added = self._block.jit_trace_count(False) - traces
-        if added:
-            raise RuntimeError(
-                f"warmup failed to seal the jit cache: {added} "
-                f"recompile(s) re-driving buckets {self.buckets} — "
-                "served shapes would compile online")
+                self._block.call_cached_graph(*rung_inputs(b)))])
+
+        if introspect and hasattr(self._block, "aot_introspect"):
+            # introspection pass first (it costs an extra AOT compile per
+            # rung, so it must stay out of the seal-proof re-drive below)
+            for b in rungs:
+                self._block.aot_introspect(f"b{b}", *rung_inputs(b),
+                                           label=self.name)
+        warm_and_seal(drive, rungs,
+                      lambda: self._block.jit_trace_count(False),
+                      label="buckets")
         self._warm_traces = self._block.jit_trace_count(False)
         return {
             "model": self.name,
-            "buckets": list(self.buckets),
+            "buckets": rungs,
             "compile_traces": self._warm_traces,
             "seconds": round(time.perf_counter() - t0, 4),
         }

@@ -166,6 +166,48 @@ class FrontDoor:
                           priority=priority)
         return req.result()
 
+    # -- streaming (decode replicas) ---------------------------------------
+    def submit_stream(self, prompt, **kwargs):
+        """Route one generation request to the best decode replica;
+        returns that engine's
+        :class:`~mxnet_tpu.decode.engine.SequenceRequest` (stream
+        tokens off its ``.stream()``).
+
+        Only replicas exposing ``submit_stream`` (decode engines) are
+        candidates — a mixed registry of one-shot and decode replicas
+        routes each request kind to the engines that speak it. Failover
+        semantics match :meth:`submit`: sheds try the next replica,
+        :class:`Overloaded` only when every streaming replica sheds.
+        """
+        last = None
+        cands = [e for e in self._candidates()
+                 if hasattr(e, "submit_stream")]
+        for tries, eng in enumerate(cands, start=1):
+            try:
+                seq = eng.submit_stream(prompt, **kwargs)
+                with self._lock:
+                    self._routed[eng.name] += 1
+                if seq.trace is not None:
+                    seq.trace.annotate(frontdoor=self.name,
+                                       replica=eng.name, tries=tries)
+                return seq
+            except Overloaded as e:  # includes RateLimited
+                last = e
+            except EngineStopped as e:
+                last = e
+        if isinstance(last, Overloaded):
+            raise Overloaded(
+                f"front door {self.name!r}: all {len(cands)} streaming "
+                "replicas shed") from last
+        raise EngineStopped(
+            f"front door {self.name!r}: no healthy streaming replica "
+            f"(of {len(self.engines)} total)") from last
+
+    def generate(self, prompt, **kwargs):
+        """Submit + stream through the front door: yields tokens from
+        the routed replica as they settle."""
+        return self.submit_stream(prompt, **kwargs).stream()
+
     # -- lifecycle ---------------------------------------------------------
     def start(self):
         for e in self.engines:

@@ -31,17 +31,32 @@ class ModelRegistry:
         self._engines = {}
         self._frontdoors = {}
 
+    @staticmethod
+    def _is_engine(obj):
+        """A ready engine, duck-typed on the serving surface the
+        registry and opsd consume (submit / lifecycle / health / stats)
+        — so decode.DecodeEngine (and any future engine kind) registers
+        exactly like InferenceEngine without importing it here."""
+        if isinstance(obj, InferenceEngine):
+            return True
+        return all(hasattr(obj, a) for a in
+                   ("submit", "start", "stop", "admission_state",
+                    "stats", "load"))
+
     def register(self, name, block_or_engine, start=True, **engine_kwargs):
         """Register a model and return its engine.
 
-        ``block_or_engine`` is either a ready :class:`InferenceEngine`
-        (adopted as-is; ``engine_kwargs`` must be empty) or a hybridized
-        block wrapped in a new engine built with ``engine_kwargs``.
+        ``block_or_engine`` is either a ready engine — an
+        :class:`InferenceEngine`, a
+        :class:`~mxnet_tpu.decode.engine.DecodeEngine`, or anything
+        exposing the same serving surface — adopted as-is
+        (``engine_kwargs`` must be empty), or a hybridized block wrapped
+        in a new :class:`InferenceEngine` built with ``engine_kwargs``.
         Duplicate names raise ValueError — replacing a live model is an
         explicit unregister + register, never a silent swap.
         """
         name = str(name)
-        if isinstance(block_or_engine, InferenceEngine):
+        if self._is_engine(block_or_engine):
             if engine_kwargs:
                 raise ValueError(
                     "engine_kwargs only apply when registering a block, "

@@ -46,6 +46,12 @@ __all__ = [
     "serve_drain_dropped_total",
     "serve_trace_total", "serve_slo_burn_rate",
     "serve_slo_violation_total",
+    "decode_tokens_total", "decode_sequence_total",
+    "decode_slot_occupancy", "decode_prefill_ms", "decode_step_ms",
+    "decode_ttft_ms",
+    "record_decode_prefill", "record_decode_step",
+    "record_decode_tokens", "record_decode_retire",
+    "set_decode_occupancy",
     "record_compile", "record_trace", "record_fallback", "record_transfer",
     "record_sync", "record_collective", "observe_step", "set_flop_budget",
     "record_serve_request", "record_serve_batch", "record_serve_trace",
@@ -80,6 +86,8 @@ _CKPT_MS_BUCKETS = (1.0, 5.0, 10.0, 25.0, 50.0, 100.0, 250.0, 500.0,
                     1000.0, 2500.0, 5000.0, 10000.0, 30000.0)
 _PASS_MS_BUCKETS = (.1, .5, 1.0, 5.0, 10.0, 25.0, 50.0, 100.0, 250.0,
                     500.0, 1000.0, 5000.0)
+_DECODE_MS_BUCKETS = (.05, .1, .25, .5, 1.0, 2.5, 5.0, 10.0, 25.0, 50.0,
+                      100.0, 250.0, 500.0, 1000.0, 2500.0)
 
 # -- compiles ---------------------------------------------------------------
 jit_compile_total = counter(
@@ -324,6 +332,39 @@ serve_slo_violation_total = counter(
     "Requests that violated their class SLO, by kind: 'latency' "
     "(served but over the objective), 'shed', 'timeout', or 'error'",
     ["model", "cls", "kind"])
+
+
+# -- autoregressive decode (decode/engine.py; docs/decode.md) ---------------
+decode_tokens_total = counter(
+    "decode_tokens_total",
+    "Tokens generated by the decode engine (one per host-side sample "
+    "off a settled prefill or decode step)", ["model"])
+decode_sequence_total = counter(
+    "decode_sequence_total",
+    "Decode sequences retired, by reason: 'eos', 'max_tokens', "
+    "'context_full' (KV slot row exhausted), 'abandoned' (client "
+    "claimed timeout mid-generation), 'stopped', or 'error'",
+    ["model", "reason"])
+decode_slot_occupancy = gauge(
+    "decode_slot_occupancy",
+    "KV-cache slots owned by live sequences right now, out of the "
+    "engine's fixed MXTPU_DECODE_SLOTS pool", ["model"])
+decode_prefill_ms = histogram(
+    "decode_prefill_ms",
+    "Prompt prefill wall time per joined sequence: dispatch of the "
+    "bucket-padded prompt through logits settled (the device half of "
+    "time-to-first-token)", ["model"], buckets=_DECODE_MS_BUCKETS)
+decode_step_ms = histogram(
+    "decode_step_ms",
+    "One fixed-shape (num_slots, 1) decode step: dispatch through "
+    "logits settled — the inter-token latency floor every active "
+    "sequence shares", ["model"], buckets=_DECODE_MS_BUCKETS)
+decode_ttft_ms = histogram(
+    "decode_ttft_ms",
+    "Time-to-first-token per sequence: submit -> first sampled token "
+    "(queue wait + slot wait + prefill); the latency the decode SLO "
+    "plane judges interactive classes on", ["model"],
+    buckets=_DECODE_MS_BUCKETS)
 
 
 # -- observability plane (mxnet_tpu/observability/; docs/observability.md) --
@@ -589,6 +630,49 @@ def record_serve_batch(model, rows, bucket):
     serve_batch_size.labels(model).observe(rows)
     if bucket > rows:
         serve_padded_rows_total.labels(model).inc(bucket - rows)
+
+
+def record_decode_prefill(model, ms, bucket, slot):
+    """One sequence joined a KV slot: prompt prefilled through a seq-len
+    bucket rung. Lands in the flight ring as ``decode_join`` (joins are
+    rare enough to ring; per-token events are not)."""
+    _flight_record("decode_join", model=str(model), bucket=int(bucket),
+                   slot=int(slot), ms=round(float(ms), 3))
+    if not REGISTRY.enabled:
+        return
+    decode_prefill_ms.labels(model).observe(ms)
+
+
+def record_decode_step(model, ms, active):
+    """One settled (num_slots, 1) decode step with `active` live slots.
+    Too hot for the flight ring — histogram only."""
+    if not REGISTRY.enabled:
+        return
+    decode_step_ms.labels(model).observe(ms)
+
+
+def record_decode_tokens(model, n=1):
+    if not REGISTRY.enabled:
+        return
+    decode_tokens_total.labels(model).inc(n)
+
+
+def record_decode_retire(model, reason, tokens, ttft_s=None):
+    """One sequence retired (slot freed), by reason; `ttft_s` feeds the
+    time-to-first-token histogram when the sequence got that far."""
+    _flight_record("decode_retire", model=str(model), reason=str(reason),
+                   tokens=int(tokens))
+    if not REGISTRY.enabled:
+        return
+    decode_sequence_total.labels(model, reason).inc()
+    if ttft_s is not None:
+        decode_ttft_ms.labels(model).observe(ttft_s * 1e3)
+
+
+def set_decode_occupancy(model, n):
+    if not REGISTRY.enabled:
+        return
+    decode_slot_occupancy.labels(model).set(int(n))
 
 
 def record_ckpt_save(mode, ms, nbytes, outcome="ok"):

@@ -1,0 +1,525 @@
+"""KV-cache decode subsystem (ISSUE-18: mxnet_tpu/decode/).
+
+The acceptance spine: greedy decode through the paged KV cache is
+token-identical to the uncached full-sequence reference for >= 32
+generated tokens; a soak with >= 3 sequence joins and >= 3 retirements
+records ZERO retraces after warmup (``jit_trace_total`` flat) while
+streaming at least one token before the first sequence finishes; paged
+slots free and reuse without recompiles; EOS / max-token / context-full
+retirement; per-class SLO judged on time-to-first-token; and the
+satellites — named-axis bucket ladders, caller-supplied warmup shapes,
+the decode env knobs, FrontDoor streaming, registry adoption.
+"""
+import time
+
+import numpy as onp
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+import mxnet_tpu as mx
+from mxnet_tpu import env as mxenv
+from mxnet_tpu import observability, serving
+from mxnet_tpu.decode import (DecodeEngine, KVCache, SamplingParams,
+                              TinyCausalLM, sample_token)
+from mxnet_tpu.observability import reqtrace
+from mxnet_tpu.serving import Overloaded, bucket_ladder, pad_axis, pad_rows
+from mxnet_tpu.telemetry import instruments as _instr
+
+
+@pytest.fixture(autouse=True)
+def fresh(tmp_path, monkeypatch):
+    monkeypatch.setenv("MXTPU_FLIGHTREC_DIR", str(tmp_path))
+    for var in ("MXTPU_TRACE_SAMPLE", "MXTPU_SLO_INTERACTIVE_MS",
+                "MXTPU_DECODE_SLOTS", "MXTPU_DECODE_MAX_LEN",
+                "MXTPU_DECODE_PREFILL_BUCKETS", "MXTPU_DECODE_STREAM"):
+        monkeypatch.delenv(var, raising=False)
+    observability.reset()
+    yield
+    observability.reset()
+
+
+def _lm(**kw):
+    kw.setdefault("vocab", 64)
+    kw.setdefault("d_model", 16)
+    kw.setdefault("num_heads", 2)
+    kw.setdefault("max_len", 64)
+    return TinyCausalLM(**kw)
+
+
+def _engine(lm, **kw):
+    kw.setdefault("max_wait_ms", 1.0)
+    kw.setdefault("timeout_ms", 30_000.0)
+    kw.setdefault("name", "dec")
+    # two prefill rungs instead of the full pow-2 ladder: warmup cost
+    # is one compile per rung, and most tests only need a short one
+    kw.setdefault("prefill_buckets", [8])
+    return DecodeEngine(lm, **kw)
+
+
+def _greedy_reference(lm, prompt, steps):
+    """Uncached greedy decode: full forward from scratch every token."""
+    seq = list(prompt)
+    out = []
+    for _ in range(steps):
+        tok = int(onp.argmax(onp.asarray(
+            lm.full_logits(seq, len(seq)))))
+        out.append(tok)
+        seq.append(tok)
+    return out
+
+
+def _jit_traces(block_name):
+    """Total jit_trace_total across a block label's variants — the
+    telemetry-side retrace oracle the soak pins flat."""
+    return sum(c.value for lv, c in _instr.jit_trace_total.series()
+               if lv[0] == block_name)
+
+
+# --- the KVCache block contract ---------------------------------------------
+
+def test_kvcache_prefill_append_free_semantics():
+    cache = KVCache.create(3, 8, 2, 4)
+    assert (cache.num_slots, cache.max_len,
+            cache.num_heads, cache.head_dim) == (3, 8, 2, 4)
+    k = onp.random.RandomState(0).rand(4, 2, 4).astype(onp.float32)
+    cache = cache.prefill(1, k, k * 2, 3)
+    assert onp.asarray(cache.lengths).tolist() == [0, 3, 0]
+    assert onp.allclose(onp.asarray(cache.k)[1, :4], k)
+    # append hits each ACTIVE slot at its own length; inactive holds
+    kt = onp.ones((3, 2, 4), onp.float32)
+    cache = cache.append(kt, kt, onp.array([False, True, False]))
+    assert onp.asarray(cache.lengths).tolist() == [0, 4, 0]
+    assert onp.allclose(onp.asarray(cache.k)[1, 3], 1.0)
+    assert int(cache.occupancy()) == 1
+    # the mask contract: 0 where p < length, big-negative elsewhere
+    m = onp.asarray(cache.position_mask())
+    assert (m[1, :4] == 0).all() and (m[1, 4:] < -1e29).all()
+    assert (m[0] < -1e29).all()
+    # free zeroes only the length — a value write, shapes untouched
+    freed = cache.free(1)
+    assert onp.asarray(freed.lengths).tolist() == [0, 0, 0]
+    assert freed.k.shape == cache.k.shape
+
+
+def test_kvcache_append_full_slot_drops():
+    cache = KVCache.create(1, 2, 1, 2)
+    one = onp.ones((1, 1, 2), onp.float32)
+    cache = cache.append(one, one, onp.array([True]))
+    cache = cache.append(one * 2, one * 2, onp.array([True]))
+    assert onp.asarray(cache.lengths).tolist() == [2]
+    full = cache.append(one * 9, one * 9, onp.array([True]))
+    assert onp.asarray(full.lengths).tolist() == [2]     # no wrap
+    assert not onp.any(onp.asarray(full.k) == 9.0)       # dropped
+
+
+def test_kvcache_writes_are_custom_vjp_safe():
+    # taping through a cache write must not build gradient paths into
+    # the pool (the BN-aux-pair contract): grads of cache contents wrt
+    # the written values are stop_gradient'd to zero
+    def through_prefill(x):
+        cache = KVCache.create(2, 4, 1, 2)
+        kv = jnp.broadcast_to(x, (4, 1, 2))
+        return jnp.sum(cache.prefill(0, kv, kv, 4).k)
+
+    def through_append(x):
+        cache = KVCache.create(2, 4, 1, 2)
+        kv = jnp.broadcast_to(x, (2, 1, 2))
+        return jnp.sum(cache.append(kv, kv, jnp.array([True, True])).k)
+
+    one = jnp.float32(1.0)
+    assert float(jax.grad(through_prefill)(one)) == 0.0
+    assert float(jax.grad(through_append)(one)) == 0.0
+
+
+# --- acceptance: cached greedy decode == uncached reference -----------------
+
+def test_greedy_token_parity_32_steps():
+    lm = _lm()
+    steps, prompt = 40, [3, 17, 9, 42, 5]
+    ref = _greedy_reference(lm, prompt, steps)
+
+    cache = lm.init_cache(4)
+    padded = onp.zeros(8, onp.int32)
+    padded[:len(prompt)] = prompt
+    cache, logits = lm.prefill(cache, padded, slot=2, length=len(prompt))
+    got = [int(onp.argmax(onp.asarray(logits)))]
+    last = onp.zeros(4, onp.int32)
+    active = onp.zeros(4, bool)
+    active[2] = True
+    for _ in range(steps - 1):
+        last[2] = got[-1]
+        cache, step_logits = lm.step(cache, last, active)
+        got.append(int(onp.argmax(onp.asarray(step_logits)[2])))
+    assert len(got) >= 32 and got == ref
+    # and the prefill logits themselves are BITWISE the reference's
+    # (shared padded shapes + position-mask contract)
+    c2 = lm.init_cache(4)
+    _, lg = lm.prefill(c2, padded, slot=0, length=len(prompt))
+    assert onp.array_equal(onp.asarray(lg),
+                           onp.asarray(lm.full_logits(prompt,
+                                                      len(prompt))))
+
+
+def test_engine_greedy_matches_reference_end_to_end():
+    lm = _lm()
+    ref = _greedy_reference(lm, [7, 3, 11], 32)
+    eng = _engine(lm, num_slots=2, name="dec-e2e")
+    eng.warmup()
+    with eng:
+        seq = eng.submit([7, 3, 11], max_new_tokens=32)
+        assert seq.result(timeout=30) == ref
+    assert seq.reason == "max_tokens"
+
+
+# --- acceptance: zero-retrace soak with churn + live streaming --------------
+
+def test_soak_churn_zero_retrace_streams_before_finish():
+    lm = _lm(max_len=256)
+    eng = _engine(lm, num_slots=2, name="dec-soak",
+                  prefill_buckets=[32])
+    eng.warmup()
+    telemetry_traces = _jit_traces("TinyCausalLM")
+    block_traces = lm.jit_trace_count()
+    with eng:
+        # first sequence: long enough that its stream provably yields
+        # while generation is still running
+        first = eng.submit(list(range(1, 9)), max_new_tokens=200)
+        stream = first.stream()
+        tok0 = next(stream)
+        done_at_first_token = first.done
+        # >= 3 more joins with varied prompts/lengths/sampling params,
+        # against 2 slots — churn through join/retire/slot-reuse
+        rest = [eng.submit([1 + i] * (3 + 5 * i), max_new_tokens=6 + i,
+                           temperature=0.3 * i, top_k=4, seed=i)
+                for i in range(4)]
+        tail = [tok0] + list(stream)
+        results = [s.result(timeout=30) for s in rest]
+    assert not done_at_first_token       # streamed BEFORE it finished
+    assert len(tail) == 200 and first.reason == "max_tokens"
+    assert [len(r) for r in results] == [6, 7, 8, 9]
+    # >= 5 retirements happened (first + 4); the retrace counters are
+    # FLAT across all of it — telemetry-side and block-side agree
+    assert _jit_traces("TinyCausalLM") == telemetry_traces
+    assert lm.jit_trace_count() == block_traces
+    assert eng.recompiles_since_warmup() == 0
+    st = eng.stats()
+    assert st["occupied"] == 0 and st["sequences"].get("max_tokens") >= 5
+    assert st["tokens"] >= 200 + 6 + 7 + 8 + 9
+
+
+def test_slot_free_reuse_single_slot_no_recompile():
+    lm = _lm()
+    eng = _engine(lm, num_slots=1, name="dec-reuse")
+    eng.warmup()
+    # a caller tracing the block's OTHER entry points (the uncached
+    # parity reference) must not read as an engine retrace
+    lm.full_logits([5], 1)
+    assert lm.jit_trace_count("full") == 1
+    assert eng.recompiles_since_warmup() == 0
+    before = lm.jit_trace_count()
+    with eng:
+        for i in range(3):                # same slot, three lifetimes
+            seq = eng.submit([5 + i, 2], max_new_tokens=4)
+            assert len(seq.result(timeout=30)) == 4
+    assert lm.jit_trace_count() == before
+    assert int(_instr.decode_slot_occupancy.labels(
+        "dec-reuse").value) == 0
+
+
+# --- retirement reasons -----------------------------------------------------
+
+def test_eos_retirement():
+    lm = _lm()
+    ref = _greedy_reference(lm, [3, 17, 9], 8)
+    eng = _engine(lm, num_slots=2, name="dec-eos")
+    eng.warmup()
+    with eng:
+        seq = eng.submit([3, 17, 9], max_new_tokens=50, eos_id=ref[2])
+        toks = seq.result(timeout=30)
+    assert toks == ref[:3] and seq.reason == "eos"
+
+
+def test_context_full_retirement():
+    lm = _lm(max_len=16)
+    eng = _engine(lm, num_slots=1, name="dec-full")
+    eng.warmup()
+    with eng:
+        seq = eng.submit(list(range(1, 9)), max_new_tokens=100)
+        toks = seq.result(timeout=30)
+    # prompt fills 8 of 16 positions; generation appends until the slot
+    # row is exhausted: tokens at stored=8..15, then one more sampled
+    # off the full row -> 9 tokens
+    assert seq.reason == "context_full" and len(toks) == 9
+
+
+def test_submit_validation_and_shedding():
+    lm = _lm()
+    eng = _engine(lm, num_slots=1, max_queue=1, name="dec-shed")
+    with pytest.raises(ValueError):
+        eng.submit([], max_new_tokens=4)
+    with pytest.raises(ValueError):
+        eng.submit(list(range(100)), max_new_tokens=4)  # > top rung
+    with pytest.raises(ValueError):
+        eng.submit([1], max_new_tokens=0)
+    # not started: queue fills, then sheds deterministically
+    eng.submit([1], max_new_tokens=4)
+    with pytest.raises(Overloaded):
+        eng.submit([2], max_new_tokens=4)
+    eng.stop(drain=False)
+    from mxnet_tpu.serving import EngineStopped
+    with pytest.raises(EngineStopped):
+        eng.submit([3], max_new_tokens=4)
+
+
+# --- streaming semantics ----------------------------------------------------
+
+def test_stream_withheld_until_retirement_when_disabled():
+    lm = _lm()
+    eng = _engine(lm, num_slots=1, stream=False, name="dec-nostream")
+    eng.warmup()
+    with eng:
+        seq = eng.submit([4, 4], max_new_tokens=5)
+        toks = list(seq.stream(timeout=30))
+    assert len(toks) == 5 and seq.done    # one burst, after retirement
+
+
+def test_stream_env_default(monkeypatch):
+    monkeypatch.setenv("MXTPU_DECODE_STREAM", "0")
+    eng = _engine(_lm(), name="dec-envstream")
+    assert eng.stream_enabled is False
+    eng.stop(drain=False)
+
+
+# --- per-sequence sampling --------------------------------------------------
+
+def test_sampling_params():
+    logits = onp.array([0.1, 3.0, 0.2, 2.9])
+    assert sample_token(logits, SamplingParams()) == 1      # greedy
+    p = SamplingParams(temperature=0.7, top_k=2, seed=42)
+    draws = {sample_token(logits, p) for _ in range(64)}
+    assert draws <= {1, 3}                # top-2 support only
+    # same seed -> same stream; different seed -> (eventually) differs
+    r1 = [sample_token(logits, p, rng) for rng in [p.make_rng()]
+          for _ in range(8)]
+    r2 = [sample_token(logits, p, rng) for rng in [p.make_rng()]
+          for _ in range(8)]
+    assert r1 == r2
+    with pytest.raises(ValueError):
+        SamplingParams(temperature=-1)
+    with pytest.raises(ValueError):
+        SamplingParams(top_k=-2)
+
+
+def test_mixed_sampling_params_share_compiled_programs():
+    lm = _lm()
+    eng = _engine(lm, num_slots=4, name="dec-mix")
+    eng.warmup()
+    before = lm.jit_trace_count()
+    with eng:
+        seqs = [eng.submit([2, 3], max_new_tokens=6,
+                           temperature=t, top_k=k, seed=s)
+                for t, k, s in ((0.0, 0, 0), (0.5, 3, 1), (2.0, 0, 7),
+                                (0.9, 1, 3))]
+        for s in seqs:
+            assert len(s.result(timeout=30)) == 6
+    assert lm.jit_trace_count() == before   # params never retrace
+
+
+# --- SLO on time-to-first-token ---------------------------------------------
+
+def test_slo_judges_ttft_not_total_latency():
+    # unit: a finished request nominating slo_latency_s (TTFT) is judged
+    # on it, not on the (much larger) submit->finish wall time
+    class R:
+        pass
+
+    r = R()
+    r.t_submit = time.monotonic() - 5.0       # 5s total
+    r.cls = "interactive"
+    r.model = "dec-slo"
+    r.trace = None
+    r.slo_latency_s = 0.001                   # 1ms TTFT
+    reqtrace.set_slo_objective("interactive", 100.0)
+    reqtrace.finish(r, "ok")
+    st = reqtrace.slo_status()["dec-slo"]["interactive"]
+    assert st["events"] == 1 and st["bad"] == 0
+
+
+def test_decode_sequences_feed_class_slo_with_ttft():
+    reqtrace.set_slo_objective("interactive", 60_000.0)
+    lm = _lm()
+    eng = _engine(lm, num_slots=2, name="dec-slo2")
+    eng.warmup()
+    with eng:
+        seqs = [eng.submit([1, 2, 3], max_new_tokens=12)
+                for _ in range(3)]
+        for s in seqs:
+            s.result(timeout=30)
+    assert all(s.slo_latency_s is not None
+               and s.slo_latency_s <= (time.monotonic() - s.t_submit)
+               for s in seqs)
+    st = reqtrace.slo_status()["dec-slo2"]["interactive"]
+    assert st["events"] == 3 and st["bad"] == 0
+
+
+# --- observability wiring ---------------------------------------------------
+
+def test_reqtrace_spans_and_opsd_decode_summary(monkeypatch):
+    monkeypatch.setenv("MXTPU_TRACE_SAMPLE", "1.0")
+    observability.reset()
+    lm = _lm()
+    eng = _engine(lm, num_slots=2, name="dec-trace")
+    eng.warmup()
+    with eng:
+        seq = eng.submit([9, 8, 7], max_new_tokens=5)
+        seq.result(timeout=30)
+    recs = reqtrace.traces(model="dec-trace")
+    assert recs, "sampled decode sequence must land in the trace ring"
+    phases = [sp["phase"] for sp in recs[-1]["spans"]]
+    assert phases[:3] == ["admit", "queue", "prefill"]
+    assert phases.count("token") == 5 and phases[-1] == "settle"
+    # spans telescope: durations sum to the trace total
+    total = sum(sp["dur"] for sp in recs[-1]["spans"]) * 1e3
+    assert total == pytest.approx(recs[-1]["total_ms"], rel=1e-6)
+    from mxnet_tpu.observability import opsd
+    payload = opsd.traces_payload(n=8, model="dec-trace")
+    assert payload["decode"]["sequences"] >= 1
+    assert payload["decode"]["tokens"] >= 5
+    assert payload["decode"]["ttft_p50_ms"] > 0
+
+
+def test_decode_telemetry_and_flight_events():
+    lm = _lm()
+    eng = _engine(lm, num_slots=2, name="dec-tele")
+    eng.warmup()
+    tokens0 = _instr.decode_tokens_total.labels("dec-tele").value
+    with eng:
+        seq = eng.submit([5, 6], max_new_tokens=7)
+        seq.result(timeout=30)
+    assert _instr.decode_tokens_total.labels(
+        "dec-tele").value - tokens0 == 7
+    assert _instr.decode_prefill_ms.labels("dec-tele").count >= 1
+    assert _instr.decode_step_ms.labels("dec-tele").count >= 6
+    assert _instr.decode_ttft_ms.labels("dec-tele").count >= 1
+    from mxnet_tpu.observability import flight
+    kinds = [e["kind"] for e in flight.events()]
+    assert "decode_join" in kinds and "decode_retire" in kinds
+
+
+# --- the serving-tier surface: frontdoor, registry, scheduler classes -------
+
+def test_frontdoor_routes_streams_to_decode_replicas():
+    lm = _lm()
+    dec = _engine(lm, num_slots=2, name="dec-fd")
+    dec.warmup()
+    oneshot = serving.InferenceEngine(
+        serving.SimulatedBlock(device_ms=1.0), name="sim-fd",
+        max_batch_size=4, max_wait_ms=1.0)
+    fd = serving.FrontDoor([oneshot, dec], name="fd")
+    with dec, oneshot:
+        seq = fd.submit_stream([1, 2, 3], max_new_tokens=6)
+        assert len(list(seq.stream(timeout=30))) == 6
+        toks = list(fd.generate([4, 5], max_new_tokens=3))
+        assert len(toks) == 3
+        stats = fd.stats()
+    assert stats["replicas"]["dec-fd"]["routed"] == 2
+    assert stats["replicas"]["sim-fd"]["routed"] == 0
+
+
+def test_registry_adopts_decode_engine():
+    reg = serving.ModelRegistry()
+    lm = _lm()
+    eng = _engine(lm, num_slots=2, name="dec-reg")
+    eng.warmup()
+    adopted = reg.register("dec-reg", eng, start=True)
+    try:
+        assert adopted is eng and "dec-reg" in reg
+        assert reg.stats()["dec-reg"]["slots"] == 2
+        seq = reg.get("dec-reg").submit([1, 2], max_new_tokens=3)
+        assert len(seq.result(timeout=30)) == 3
+    finally:
+        reg.unregister("dec-reg")
+    assert eng.admission_state() == "stopped"
+
+
+def test_sequences_ride_priority_classes():
+    lm = _lm()
+    eng = _engine(lm, num_slots=1, name="dec-cls")
+    eng.warmup()
+    with eng:
+        hi = eng.submit([1], max_new_tokens=3)
+        lo = eng.submit([2], max_new_tokens=3, priority="batch")
+        assert len(hi.result(timeout=30)) == 3
+        assert len(lo.result(timeout=30)) == 3
+    stats = eng.stats()["classes"]
+    assert set(stats) == {"interactive", "batch"}
+
+
+# --- satellites: buckets, warmup shapes, env knobs --------------------------
+
+def test_bucket_ladder_named_axes_back_compat():
+    # the historic axis-less row API is unchanged
+    assert bucket_ladder(8) == (1, 2, 4, 8)
+    assert bucket_ladder(6, [2, 4]) == (2, 4, 6)
+    # named axes: same math, validated name
+    assert bucket_ladder(64, axis="seqlen") == (1, 2, 4, 8, 16, 32, 64)
+    assert bucket_ladder(48, [16], axis="seqlen") == (16, 48)
+    with pytest.raises(ValueError):
+        bucket_ladder(8, axis="columns")
+
+
+def test_pad_axis_fills():
+    a = onp.arange(6, dtype=onp.float32).reshape(2, 3)
+    z = pad_axis(a, 5, axis=1)                     # zero fill (seqlen)
+    assert z.shape == (2, 5) and (z[:, 3:] == 0).all()
+    r = pad_axis(a, 4, axis=0, fill="repeat")      # row semantics
+    assert r.shape == (4, 3) and (r[2] == a[-1]).all()
+    assert pad_rows(a, 4).tolist() == r.tolist()   # pad_rows delegates
+    assert pad_axis(a, 2, axis=0) is a             # exact fit: no copy
+    with pytest.raises(ValueError):
+        pad_axis(a, 1, axis=0)
+    with pytest.raises(ValueError):
+        pad_axis(a, 4, axis=0, fill="mirror")
+
+
+def test_inference_engine_warmup_caller_shapes():
+    eng = serving.InferenceEngine(
+        serving.SimulatedBlock(device_ms=0.5), name="warm-shapes",
+        max_batch_size=8, max_wait_ms=1.0)
+    rep = eng.warmup(onp.ones((1, 4), onp.float32), shapes=[2, 4])
+    assert rep["buckets"] == [2, 4]
+    assert eng.recompiles_since_warmup() == 0
+    with pytest.raises(ValueError):
+        eng.warmup(onp.ones((1, 4), onp.float32), shapes=[16])
+    with pytest.raises(ValueError):
+        eng.warmup(onp.ones((1, 4), onp.float32), shapes=[])
+    eng.stop(drain=False)
+
+
+def test_decode_env_knobs_registered_and_applied(monkeypatch):
+    for name in ("MXTPU_DECODE_SLOTS", "MXTPU_DECODE_MAX_LEN",
+                 "MXTPU_DECODE_PREFILL_BUCKETS", "MXTPU_DECODE_STREAM"):
+        assert name in mxenv.all_vars()
+        assert name in mxenv.doc()
+    monkeypatch.setenv("MXTPU_DECODE_SLOTS", "6")
+    monkeypatch.setenv("MXTPU_DECODE_PREFILL_BUCKETS", "16,32")
+    eng = DecodeEngine(_lm(), name="dec-env")
+    assert eng.num_slots == 6
+    assert eng.max_len == 64                  # the block's window wins
+    assert eng.buckets == (16, 32, 64)
+    eng.stop(drain=False)
+
+
+def test_decode_warmup_seals_prefill_and_step():
+    lm = _lm()
+    eng = _engine(lm, num_slots=2, prefill_buckets=[8, 32],
+                  name="dec-warm")
+    rep = eng.warmup()
+    assert rep["prefill_buckets"] == [8, 32, 64]
+    # one compile per prefill rung + one step (+ nothing on re-drive)
+    assert lm.jit_trace_count("prefill") == 3
+    assert lm.jit_trace_count("step") == 1
+    assert eng.recompiles_since_warmup() == 0
+    eng.stop(drain=False)

@@ -18,6 +18,13 @@ Three modes (``--mode``):
            throughput AND open-loop p99 for ``--engine sync`` (the
            serialized PR-3 batcher) vs ``--engine pipelined``, same
            block, same load. Emits the speedup ratios.
+  decode   Autoregressive KV-cache generation (docs/decode.md): Poisson
+           SEQUENCE arrivals at ``--seq-qps`` into a DecodeEngine over
+           TinyCausalLM, every sequence streamed token-by-token.
+           Headline ``"metric": "decode_tok_s"``; the result also
+           carries TTFT p50/p99, inter-token p99, retirement reasons,
+           and the zero-recompile proof (the run FAILS if any shape
+           retraced after warmup, same contract as the other modes).
 
 Blocks (``--block``):
 
@@ -341,6 +348,105 @@ def result_open(args, eng, warm, per_cls):
     }
 
 
+# -- decode ----------------------------------------------------------------
+def build_decode_engine(args):
+    from mxnet_tpu.decode import DecodeEngine, TinyCausalLM
+
+    lm = TinyCausalLM(max_len=args.decode_max_len)
+    eng = DecodeEngine(
+        lm, name="serve_bench", num_slots=args.num_slots,
+        max_queue=args.queue, max_wait_ms=args.max_wait_ms,
+        timeout_ms=args.timeout_ms)
+    warm = eng.warmup()
+    return eng, warm
+
+
+def drive_decode(eng, args):
+    """Poisson sequence arrivals; one consumer thread per sequence
+    iterates its stream() recording per-token wall-clock timestamps, so
+    TTFT and inter-token gaps cover the full queue + prefill + step
+    round trip as a client feels it."""
+    from mxnet_tpu import serving
+
+    rng = random.Random(0)
+    done = []        # (t_submit, [token timestamps], reason)
+    shed = [0]
+    lock = threading.Lock()
+    threads = []
+
+    def consume(seq, t0):
+        times = []
+        try:
+            for _ in seq.stream():
+                times.append(time.perf_counter())
+        except Exception:
+            pass  # timeout/stop: partial times still count below
+        with lock:
+            done.append((t0, times, seq.reason))
+
+    top = eng.buckets[-1]
+    with eng:
+        t_bench0 = time.perf_counter()
+        for k in range(args.sequences):
+            if k:
+                time.sleep(rng.expovariate(args.seq_qps))
+            n = 1 + (k * 3) % min(8, top)
+            prompt = [1 + (k + j) % 50 for j in range(n)]
+            t0 = time.perf_counter()
+            try:
+                seq = eng.submit(prompt, max_new_tokens=args.new_tokens)
+            except (serving.Overloaded, serving.RateLimited):
+                shed[0] += 1
+                continue
+            t = threading.Thread(target=consume, args=(seq, t0),
+                                 daemon=True)
+            t.start()
+            threads.append(t)
+        for t in threads:
+            t.join(timeout=args.timeout_ms / 1e3 + 5.0)
+        dt = time.perf_counter() - t_bench0
+    return done, shed[0], dt
+
+
+def result_decode(args, eng, warm, done, shed, dt):
+    ttft = sorted(times[0] - t0 for t0, times, _ in done if times)
+    gaps = sorted(b - a for _, times, _ in done
+                  for a, b in zip(times, times[1:]))
+    by_reason = {}
+    for _, _, reason in done:
+        by_reason[reason] = by_reason.get(reason, 0) + 1
+    tokens = sum(len(times) for _, times, _ in done)
+    import jax
+
+    return {
+        # stamped like BENCH_r*.json so regression gates can refuse
+        # cross-platform comparisons (bench.py _snapshot_platform)
+        "platform": jax.default_backend(),
+        "metric": "decode_tok_s",
+        "value": round(tokens / dt, 2) if dt else None,
+        "unit": "tok/s",
+        "mode": "decode",
+        "sequences_offered": args.sequences,
+        "sequences_completed": len(done),
+        "shed": shed,
+        "tokens": tokens,
+        "new_tokens_per_seq": args.new_tokens,
+        "seq_qps_offered": args.seq_qps,
+        "num_slots": eng.num_slots,
+        "max_len": eng.max_len,
+        "prefill_buckets": list(eng.buckets),
+        "by_reason": by_reason,
+        "ttft_p50_ms": _pct(ttft, 0.50),
+        "ttft_p99_ms": _pct(ttft, 0.99),
+        "intertoken_p50_ms": _pct(gaps, 0.50),
+        "intertoken_p99_ms": _pct(gaps, 0.99),
+        "recompiles_since_warmup": eng.recompiles_since_warmup(),
+        "warmup": warm,
+        "engine": eng.stats(),
+        "trace": trace_summary(eng),
+    }
+
+
 # -- A/B -------------------------------------------------------------------
 def run_compare(args):
     """sync vs pipelined: closed-loop qps and open-loop p99."""
@@ -379,7 +485,8 @@ def run_compare(args):
 
 def main(argv=None):
     p = argparse.ArgumentParser(description=__doc__.splitlines()[0])
-    p.add_argument("--mode", choices=("closed", "open", "compare"),
+    p.add_argument("--mode",
+                   choices=("closed", "open", "compare", "decode"),
                    default="closed")
     p.add_argument("--engine", choices=("pipelined", "sync"),
                    default="pipelined",
@@ -414,6 +521,16 @@ def main(argv=None):
     p.add_argument("--features", type=int, default=128)
     p.add_argument("--hidden", type=int, default=256)
     p.add_argument("--classes", type=int, default=64)
+    p.add_argument("--num-slots", type=int, default=4,
+                   help="KV-cache sequence slots (decode mode)")
+    p.add_argument("--decode-max-len", type=int, default=128,
+                   help="per-slot KV window (decode mode)")
+    p.add_argument("--sequences", type=int, default=32,
+                   help="sequences offered (decode mode)")
+    p.add_argument("--new-tokens", type=int, default=32,
+                   help="max tokens generated per sequence (decode)")
+    p.add_argument("--seq-qps", type=float, default=20.0,
+                   help="Poisson sequence arrival rate (decode mode)")
     p.add_argument("--trace-sample", type=float, default=None,
                    metavar="RATE",
                    help="set MXTPU_TRACE_SAMPLE for this run (0..1; "
@@ -429,6 +546,11 @@ def main(argv=None):
         recompiles = max(
             e["recompiles_since_warmup"] or 0
             for e in result["engines"].values())
+    elif args.mode == "decode":
+        eng, warm = build_decode_engine(args)
+        done, shed, dt = drive_decode(eng, args)
+        result = result_decode(args, eng, warm, done, shed, dt)
+        recompiles = eng.recompiles_since_warmup()
     elif args.mode == "open":
         eng, warm = build_engine(args)
         per_cls = drive_open(eng, args)
