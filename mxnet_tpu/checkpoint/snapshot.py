@@ -247,7 +247,9 @@ def apply(trainer, arrays, meta):
 
             for i, p in enumerate(trainer._params):
                 if trainer._states_created[i]:
-                    place_state_like(trainer._states[i], p.data())
+                    place_state_like(trainer._states[i], p.data(),
+                                     plan=plan,
+                                     name=trainer._param_names[i])
     if "rng/key" in arrays:
         from .. import _random
 

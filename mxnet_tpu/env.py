@@ -438,6 +438,24 @@ register(
     "(MXTPU_MESH is ignored, so a launcher's env mesh cannot override "
     "a hand-built plan).")
 register(
+    "MXTPU_SPEC_LAYOUT", bool, True,
+    "SpecLayout rule library for env-driven plans (sharding/layouts.py; "
+    "docs/sharding.md): when MXTPU_MESH names the layout's model axes "
+    "(fsdp/tp), the resolved plan places stock-block params by "
+    "structural role — embeddings, qkv/attention projections, FFN "
+    "in/out, norms, conv — over data/fsdp/tp. 0 keeps env meshes "
+    "placement-free (axes only, params replicate). Plans built in code "
+    "via ShardingPlan.from_layout() carry the library regardless.")
+register(
+    "MXTPU_ZERO", bool, True,
+    "ZeRO optimizer-state sharding (docs/sharding.md): when the plan's "
+    "mesh carries the layout's fsdp axis, optimizer state (momentum, "
+    "variance, fp32 masters) shards along it on the first unsharded "
+    "divisible dim — each rank owns ~1/N of optimizer memory, and the "
+    "donated whole-step program reduce-scatters grads / allgathers "
+    "updated params in-trace. 0 places state exactly like its weight. "
+    "Numerics are identical either way (placement, not math).")
+register(
     "MXTPU_OPS_PORT", int, 0,
     "Live ops server (observability.opsd; docs/observability.md): start "
     "a per-process stdlib HTTP server on this port at import, serving "

@@ -20,7 +20,16 @@ and the PR-4 fused optimizer update — into a single `jax.jit` program:
     batch sharded over the data-parallel axis and gradients reduced
     in-program via the kvstore's `traced_allreduce`
     (`collectives.psum_tree_flat_traced`) — reduce and update compile
-    into the same XLA program, zero extra collective dispatches.
+    into the same XLA program, zero extra collective dispatches;
+  * with a TENSOR/FSDP-sharded plan (any plan whose rules or SpecLayout
+    shard a parameter dim — `plan.shards_params(...)`), the same step
+    body compiles as one donated GSPMD program over the plan's mesh
+    instead of `shard_map`: operands enter committed under the plan's
+    shardings, gradients are pinned to the ZeRO state specs
+    (`plan.state_spec_for`) so XLA lowers the reduce to reduce-scatter,
+    updated params are pinned back to the param specs (all-gather), and
+    optimizer state stays 1/fsdp per device end to end — ZeRO sharding
+    of the fused optimizer buckets with zero eager collectives.
 
 `MXTPU_WHOLE_STEP=0` (or any ineligibility: sparse grads, an optimizer
 overriding `update`, `clip_global_norm`, multi-copy params, gradient
@@ -126,6 +135,10 @@ class TrainStep:
         self._ineligible = None     # cached reason string, None = eligible
         self._eligibility_checked = False
         self._variant = None
+        # True when the plan tensor/FSDP-shards params: the whole-step
+        # program then compiles as one GSPMD partition over the plan's
+        # mesh instead of the manual-collective shard_map body
+        self._tensor_plan = False
 
     # -- introspection ----------------------------------------------------
     @property
@@ -187,18 +200,17 @@ class TrainStep:
             if p._data_map is not None and len(p.list_ctx()) > 1:
                 return f"param {p.name} is replicated across devices"
         if self._plan is not None:
-            # the whole-step shard_map replicates params (in_specs P());
-            # a plan that tensor-shards any of them needs model-level
-            # collectives the body doesn't trace — those plans train
-            # through the phased path, where params keep their
-            # NamedSharding and XLA's GSPMD partitioner inserts the
-            # tp collectives
+            # a plan that tensor/FSDP-shards params takes the GSPMD
+            # whole-step variant: the step body compiles as ONE donated
+            # program over the plan's mesh with every operand entering
+            # under its plan sharding — XLA's partitioner inserts the
+            # tp psums (and the ZeRO reduce-scatter/allgather the state
+            # specs demand) IN-TRACE, where the replicated-params
+            # shard_map body would need hand-written model collectives
             names_shapes = [(n, p.shape) for n, p in
                             zip(tr._param_names, tr._params)
                             if p.shape is not None]
-            if self._plan.shards_params(names_shapes):
-                return ("plan tensor-shards params "
-                        "(GSPMD phased path carries tp)")
+            self._tensor_plan = self._plan.shards_params(names_shapes)
         return None
 
     def _eligible(self):
@@ -238,9 +250,11 @@ class TrainStep:
             buckets.setdefault((str(w.dtype), use_mp), []).append(n)
         self._buckets = [(k, names) for k, names in buckets.items()]
         opt = tr._optimizer
+        mode_tag = ("gspmd" if self._tensor_plan
+                    else "mesh" if self._mesh is not None else "local")
         self._variant = (f"{type(opt).__name__.lower()}"
                          f"-p{len(items)}-b{len(self._buckets)}"
-                         f"-{'mesh' if self._mesh is not None else 'local'}")
+                         f"-{mode_tag}")
         self._step_fn = self._make_step_fn()
         self._built = True
 
@@ -258,7 +272,32 @@ class TrainStep:
         bucket_specs = self._buckets
         mesh, axis = self._mesh, self._axis
         kv = tr._kvstore
-        if mesh is not None:
+        tensor = self._tensor_plan
+        if tensor:
+            # GSPMD whole-step (tensor/FSDP plans): the body computes the
+            # GLOBAL batch as one logical program — no manual psum; the
+            # partitioner derives every collective from the operand
+            # shardings plus these in-trace pins. Pinning grads to the
+            # ZeRO state layout is what turns the backward's gradient
+            # allreduce into reduce-scatter + local fused update +
+            # allgather of the new params (docs/sharding.md).
+            from jax.sharding import NamedSharding
+
+            plan = self._plan
+            pmesh = plan.mesh
+            wshape = {n: p.shape for _i, n, p in self._train_items}
+            w_shard = {n: NamedSharding(pmesh, plan.spec_for(n, s))
+                       for n, s in wshape.items()}
+            s_shard = {n: NamedSharding(pmesh, plan.state_spec_for(n, s))
+                       for n, s in wshape.items()}
+            self._w_shard, self._s_shard = w_shard, s_shard
+
+            def _pin_state(n, st):
+                return jax.tree_util.tree_map(
+                    lambda v: jax.lax.with_sharding_constraint(
+                        v, s_shard[n])
+                    if getattr(v, "shape", None) == wshape[n] else v, st)
+        elif mesh is not None:
             reduce_tree = (kv.traced_allreduce
                            if kv is not None
                            and hasattr(kv, "traced_allreduce")
@@ -337,6 +376,16 @@ class TrainStep:
                 # single copy per param: the tpu_dist pushpull of one
                 # replica is an identity sum — nothing to reduce
                 loss_data, gd, aux = fwd_bwd(tws, frozen, key, inputs)
+            elif tensor:
+                # global-batch GSPMD: the backward's cross-dp gradient
+                # sum is implicit (the partitioner inserts the psum);
+                # pin each grad to its state's ZeRO sharding so the
+                # update computes on the LOCAL 1/N shard — grads arrive
+                # by reduce-scatter instead of full allreduce
+                loss_data, gd, aux = fwd_bwd(tws, frozen, key, inputs)
+                gd = {n: jax.lax.with_sharding_constraint(g, s_shard[n])
+                      if g.shape == wshape[n] else g
+                      for n, g in gd.items()}
             else:
                 from jax.sharding import PartitionSpec as P
 
@@ -385,6 +434,17 @@ class TrainStep:
                 for n, nw, ns in zip(names, nws, nsts):
                     new_ws[n] = nw
                     new_states[n] = ns
+            if tensor:
+                # pin outputs to their operand shardings: the updated
+                # params allgather back to the plan's layout (closing
+                # the ZeRO reduce_scatter -> local rule -> allgather
+                # cycle inside this one program) and state stays 1/N —
+                # in == out shardings is also what lets donation reuse
+                # the buffers and the jit cache never re-specialize
+                new_ws = {n: jax.lax.with_sharding_constraint(
+                    w, w_shard[n]) for n, w in new_ws.items()}
+                new_states = {n: _pin_state(n, st)
+                              for n, st in new_states.items()}
             return loss_data, new_ws, new_states, aux
 
         return step
@@ -499,6 +559,13 @@ class TrainStep:
             from jax.sharding import NamedSharding
             from jax.sharding import PartitionSpec as P
 
+            # MXTPU_WHOLE_STEP=0 reaches here before __call__'s deferred
+            # init ran: materialize params and let the plan place them
+            # BEFORE the batch is committed to the mesh below (both are
+            # idempotent no-ops otherwise)
+            self._net._ensure_initialized(batch[:self._n_data])
+            self._trainer._maybe_apply_plan()
+
             # tensor-sharded plans run here (GSPMD carries the tp axes),
             # but the batch arrives committed to one device while the
             # plan placed params across the mesh — split it along the
@@ -554,22 +621,47 @@ class TrainStep:
             from jax.sharding import NamedSharding
             from jax.sharding import PartitionSpec as P
 
-            # place operands for the shard_map program — params, state
-            # and key replicated, batch split along the data axis; jit
-            # refuses arrays committed to a single device otherwise.
-            # Replicated-to-replicated puts are no-ops after step one
-            # (the program's outputs come back replicated).
             rep = NamedSharding(self._mesh, P())
             shd = NamedSharding(self._mesh, P(self._axis))
+            if self._tensor_plan:
+                # GSPMD whole-step: every operand enters under its PLAN
+                # sharding (params on their specs, state on the ZeRO
+                # layout, batch over the data axis). plan.apply/
+                # place_state_like already put them there, so these are
+                # no-op puts after step one — they exist to commit
+                # stragglers (a fresh frozen buffer, the RNG key).
+                plan = self._plan
+                tws = {n: jax.device_put(v, self._w_shard[n])
+                       for n, v in tws.items()}
+                wshape = {n: p.shape for _i, n, p in self._train_items}
+                states = {
+                    n: jax.tree_util.tree_map(
+                        lambda v, _n=n: jax.device_put(
+                            v, self._s_shard[_n])
+                        if getattr(v, "shape", None) == wshape[_n]
+                        else jax.device_put(v, rep), st)
+                    for n, st in states.items()}
+                frozen = {
+                    n: jax.device_put(v, NamedSharding(
+                        self._mesh, plan.spec_for(n, v.shape)))
+                    for n, v in frozen.items()}
+                key = jax.device_put(key, rep)
+                inputs = [jax.device_put(x, shd) for x in inputs]
+            else:
+                # place operands for the shard_map program — params,
+                # state and key replicated, batch split along the data
+                # axis; jit refuses arrays committed to a single device
+                # otherwise. Replicated-to-replicated puts are no-ops
+                # after step one (the program's outputs come back
+                # replicated).
+                def _rep(v):
+                    return jax.device_put(v, rep)
 
-            def _rep(v):
-                return jax.device_put(v, rep)
-
-            tws = jax.tree_util.tree_map(_rep, tws)
-            states = jax.tree_util.tree_map(_rep, states)
-            frozen = jax.tree_util.tree_map(_rep, frozen)
-            key = _rep(key)
-            inputs = [jax.device_put(x, shd) for x in inputs]
+                tws = jax.tree_util.tree_map(_rep, tws)
+                states = jax.tree_util.tree_map(_rep, states)
+                frozen = jax.tree_util.tree_map(_rep, frozen)
+                key = _rep(key)
+                inputs = [jax.device_put(x, shd) for x in inputs]
         donate = _donate_enabled() and _donation_safe(
             (tws, states), (frozen, inputs, key))
         nmode = _numerics_mode()

@@ -123,8 +123,12 @@ class Trainer:
                 # optimizer state (momentum, fp32 master copies, fused
                 # bucket slices) mirrors its weight's shape — give it
                 # the weight's placement so updates stay local to each
-                # shard instead of pulling state cross-device
-                opt_mod.place_state_like(self._states[i], weight)
+                # shard instead of pulling state cross-device; under a
+                # ZeRO plan (fsdp axis + MXTPU_ZERO) it lands on the
+                # sharded-bucket layout instead, 1/N per rank
+                opt_mod.place_state_like(
+                    self._states[i], weight, plan=self._sharding_plan,
+                    name=self._param_names[i])
 
     def allreduce_grads(self, ignore_stale_grad=False):
         """Aggregate gradients across device copies via the kvstore

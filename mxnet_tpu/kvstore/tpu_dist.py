@@ -335,10 +335,41 @@ class TPUDist(KVStoreBase):
             return collectives.psum_tree_flat(arrays, mesh=mesh, axis=axis)
         return collectives.psum_tree(arrays, mesh=mesh, axis=axis)
 
+    def reduce_scatter_sharded(self, arrays, mesh=None, axis=None):
+        """Reduce-scatter jax.Arrays along the plan's ZeRO axis.
+
+        The eager half of the ZeRO-sharded optimizer contract
+        (docs/sharding.md): each rank ends up owning the reduced 1/n
+        slice of every gradient along `axis`, matching the sharded
+        optimizer-bucket layout that `ShardingPlan.state_spec_for`
+        assigns. The compiled whole-step path gets the same layout for
+        free — GSPMD lowers the in-program sharding constraints to
+        reduce-scatter + all-gather — so this method exists for eager /
+        phased callers that want sharded-state updates without the
+        compiled step. Defaults mesh/axis from the adopted plan's
+        ``zero_axis()``; raises if no ZeRO axis is available.
+        """
+        if mesh is None and self._sharding_plan is not None:
+            mesh = self._sharding_plan.mesh
+        if axis is None and self._sharding_plan is not None:
+            axis = self._sharding_plan.zero_axis()
+        if mesh is None or axis is None:
+            raise ValueError(
+                "reduce_scatter_sharded needs a mesh and a ZeRO axis: "
+                "pass them explicitly or set_sharding_plan() a plan "
+                "whose zero_axis() is not None (fsdp axis present and "
+                "MXTPU_ZERO on)")
+        from ..parallel import collectives
+
+        return jax.tree_util.tree_map(
+            lambda v: collectives.reduce_scatter(v, mesh, axis=axis),
+            arrays)
+
     def set_sharding_plan(self, plan):
         """Adopt a ShardingPlan (Trainer calls this when constructed
         with mesh=/sharding_plan=): the plan's mesh and data axis become
-        the defaults for allreduce_sharded, so sharded-gradient reduces
+        the defaults for allreduce_sharded, and its ``zero_axis()`` the
+        default for reduce_scatter_sharded, so sharded-gradient reduces
         need no per-call topology arguments."""
         self._sharding_plan = plan
 

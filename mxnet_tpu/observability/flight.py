@@ -103,16 +103,20 @@ def _sync_capacity():
         set_capacity(int(n))
 
 
-def set_identity(rank=None, world=None, job=None, mesh=None, coords=None):
+def set_identity(rank=None, world=None, job=None, mesh=None, coords=None,
+                 zero_frac=None):
     """Stamp this process's place in the job — called by
     ``kvstore.tpu_dist`` at collective init (and by tests). Also pushes
     the (job, rank) trace context onto diagnostics spans so span records
     carry the same correlation ID as flight events.
 
-    ``mesh`` ({axis: size}) and ``coords`` ({axis: index}) come from
+    ``mesh`` ({axis: size}), ``coords`` ({axis: index}) and
+    ``zero_frac`` (the 1/fsdp optimizer-state fraction this rank holds
+    under ZeRO, or None when state replicates) come from
     ``ShardingPlan.apply``: they flow through :func:`identity` into the
     ops server's /identity payload, so tools/fleetctl.py tables can show
-    each rank's (dp, tp) coordinates next to its rank number."""
+    each rank's (dp, tp) coordinates and ZeRO shard next to its rank
+    number."""
     if rank is not None:
         _identity["rank"] = int(rank)
     if world is not None:
@@ -124,6 +128,8 @@ def set_identity(rank=None, world=None, job=None, mesh=None, coords=None):
     if coords is not None:
         _identity["coords"] = {str(k): int(v)
                                for k, v in dict(coords).items()}
+    if zero_frac is not None:
+        _identity["zero_frac"] = float(zero_frac)
     try:
         from ..diagnostics import spans as _spans
 

@@ -614,17 +614,29 @@ def _zeros_like(weight, dtype=None):
     return _wrap_out(jnp.zeros_like(weight._data, dtype=dtype))
 
 
-def place_state_like(state, weight):
-    """Give optimizer state its weight's device placement.
+def place_state_like(state, weight, plan=None, name=None):
+    """Give optimizer state its weight's device placement — or, under a
+    ZeRO plan, the sharded-bucket layout.
 
     State leaves (momentum, variance, fp32 master copies) mirror the
     weight's shape, so under a ShardingPlan they take the weight's
     NamedSharding verbatim — each shard's update then reads/writes only
-    local state.  Leaves whose shape differs (scalar counters) and
-    unplaced weights (no sharding attribute, or single-device default)
-    are left alone; the trainer calls this right after state creation,
-    so there is never live donated aliasing to worry about."""
+    local state. With ``plan``/``name`` given and the plan's ZeRO axis
+    live (MXTPU_ZERO + an fsdp mesh axis), same-shape leaves instead
+    take ``plan.state_spec_for(name, shape)`` — the param spec extended
+    along fsdp, so each rank holds 1/N of optimizer memory and the
+    whole-step program's in-trace pins find state already in place.
+    Leaves whose shape differs (scalar counters) and unplaced weights
+    (no sharding attribute, or single-device default) are left alone;
+    the trainer calls this right after state creation, so there is
+    never live donated aliasing to worry about."""
     sharding = getattr(getattr(weight, "_data", None), "sharding", None)
+    if plan is not None and name is not None and \
+            weight.shape is not None and plan.zero_axis() is not None:
+        from jax.sharding import NamedSharding
+
+        sharding = NamedSharding(
+            plan.mesh, plan.state_spec_for(name, weight.shape))
     if sharding is None:
         return state
 

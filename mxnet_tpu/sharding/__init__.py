@@ -9,9 +9,14 @@ tensor-sharded plans ride XLA's GSPMD partitioner.  docs/sharding.md
 is the user-facing tour; ``mesh=None`` (and MXTPU_SHARDING=off) keeps
 every code path bitwise-identical to the unsharded framework.
 """
+from .layouts import (DEFAULT_LAYOUT, RECIPES, SpecLayout,  # noqa: F401
+                      block_roles, plan_recipe, role_from_name,
+                      zero_state_spec)
 from .plan import (ShardingError, ShardingPlan, last_applied,  # noqa: F401
                    mode, parse_axes, resolve_plan)
 from .shard_pass import ShardingPass  # noqa: F401
 
 __all__ = ["ShardingError", "ShardingPlan", "ShardingPass",
+           "SpecLayout", "DEFAULT_LAYOUT", "RECIPES", "block_roles",
+           "plan_recipe", "role_from_name", "zero_state_spec",
            "last_applied", "mode", "parse_axes", "resolve_plan"]

@@ -18,8 +18,19 @@ places initialized Gluon parameters (and their grad buffers) via
 Spec-rule precedence (docs/sharding.md):
   1. ``spec_fn(name, shape)`` — a non-None return wins outright;
   2. the first matching regex in ``rules`` (searched, in order);
-  3. replicated (``PartitionSpec()``) — the bitwise-identical default,
+  3. the attached :class:`~.layouts.SpecLayout` rule library (plans
+     built via :meth:`from_layout` / an MXTPU_MESH naming fsdp/tp
+     axes) — placement by structural role, pruned to the mesh and to
+     divisible shapes;
+  4. replicated (``PartitionSpec()``) — the bitwise-identical default,
      so a plan with no rules is exactly data parallelism.
+
+ZeRO contract: a plan whose mesh carries the layout's fsdp axis also
+answers :meth:`state_spec_for` — optimizer state (momentum, variance,
+fp32 masters) extends its param's spec by sharding along fsdp on the
+first unsharded divisible dim (``layouts.zero_state_spec``), so each
+rank owns ~1/N of optimizer memory. ``MXTPU_ZERO=0`` turns this off
+(state then mirrors its weight's placement verbatim).
 
 ``mode()`` is the ONE normalization of MXTPU_SHARDING — Trainer's plan
 resolution and the pass-pipeline injection both read it, so a value
@@ -145,11 +156,17 @@ class ShardingPlan:
     """Mesh axes + per-parameter placement rules (docs/sharding.md)."""
 
     def __init__(self, axes, rules=None, spec_fn=None, batch_axis=None,
-                 devices=None):
+                 devices=None, layout=None, roles=None):
         self.axes = parse_axes(axes)
         self.rules = tuple(
             (str(pat), _as_spec(spec)) for pat, spec in (rules or ()))
         self.spec_fn = spec_fn
+        # SpecLayout rule library (sharding/layouts.py): placement by
+        # structural role, consulted AFTER spec_fn and regex rules.
+        # ``roles`` (optional) pins {param name: role} from a structural
+        # block walk; without it roles resolve from name tokens.
+        self.layout = layout
+        self.roles = dict(roles) if roles else None
         # the data-parallel axis batches shard over; default: first axis
         self.batch_axis = str(batch_axis) if batch_axis is not None \
             else self.axes[0][0]
@@ -169,12 +186,37 @@ class ShardingPlan:
         return cls(parse_axes(spec), **kw)
 
     @classmethod
+    def from_layout(cls, axes, net=None, layout=None, **kw):
+        """Plan carrying the SpecLayout rule library (sharding/layouts):
+        stock-block params place by structural role over the layout's
+        data/fsdp/tp axes instead of per-weight regex. ``net`` upgrades
+        role resolution from name tokens to the structural block walk;
+        regex ``rules=`` still win on conflict."""
+        from . import layouts as _layouts
+
+        layout = layout or _layouts.DEFAULT_LAYOUT
+        roles = _layouts.block_roles(net) if net is not None else None
+        return cls(axes, layout=layout, roles=roles, **kw)
+
+    @classmethod
     def from_env(cls):
-        """Plan from MXTPU_MESH, or None when the env names no mesh."""
+        """Plan from MXTPU_MESH, or None when the env names no mesh.
+
+        A mesh naming the layout's model axes (fsdp/tp) attaches the
+        default SpecLayout rule library — MXTPU_MESH="dp=2,fsdp=2,tp=2"
+        is a full hybrid plan with no code. MXTPU_SPEC_LAYOUT=0 keeps
+        env meshes placement-free (axes only, params replicate)."""
         raw = str(_env.get("MXTPU_MESH")).strip()
         if not raw:
             return None
-        return cls.parse(raw)
+        axes = parse_axes(raw)
+        if _env.get("MXTPU_SPEC_LAYOUT"):
+            from . import layouts as _layouts
+
+            names = {n for n, _ in axes}
+            if names & set(_layouts.DEFAULT_LAYOUT.model_axes()):
+                return cls.from_layout(axes)
+        return cls(axes)
 
     @classmethod
     def from_manifest(cls, d):
@@ -183,17 +225,25 @@ class ShardingPlan:
         exactly."""
         if d is None:
             return None
+        layout = None
+        if d.get("layout"):
+            from . import layouts as _layouts
+
+            layout = _layouts.SpecLayout(*d["layout"])
         return cls(
             tuple((str(n), int(s)) for n, s in d["axes"]),
             rules=[(pat, tuple(e if e is None else str(e) for e in spec))
                    for pat, spec in d.get("rules") or ()],
-            batch_axis=d.get("batch_axis"))
+            batch_axis=d.get("batch_axis"),
+            layout=layout, roles=d.get("roles"))
 
     def to_manifest(self):
         """JSON-able plan record for checkpoint manifests: axes with
         their RESOLVED sizes when a mesh was built (so a dp=-1 plan
         saved on 4 devices restores knowing it meant dp=4), raw sizes
-        otherwise."""
+        otherwise. The layout round-trips as its axis names, recorded
+        roles verbatim — a restoring process rebuilds the exact specs
+        (layouts are pure functions of axes + roles + shapes)."""
         axes = self.axes if self._mesh is None else \
             tuple(self._mesh.shape.items())
         return {
@@ -202,6 +252,11 @@ class ShardingPlan:
                       for pat, spec in self.rules],
             "batch_axis": self.batch_axis,
             "spec_fn": self.spec_fn is not None,
+            "layout": ([self.layout.data_axis, self.layout.fsdp_axis,
+                        self.layout.tp_axis]
+                       if self.layout is not None else None),
+            "roles": self.roles,
+            "zero_axis": self.zero_axis(),
         }
 
     # -- mesh --------------------------------------------------------------
@@ -247,7 +302,7 @@ class ShardingPlan:
     # -- specs -------------------------------------------------------------
     def spec_for(self, name, shape=None):
         """PartitionSpec for one parameter: spec_fn beats the first
-        matching rule beats replicated."""
+        matching rule beats the layout library beats replicated."""
         if self.spec_fn is not None:
             spec = self.spec_fn(name, shape)
             if spec is not None:
@@ -255,6 +310,15 @@ class ShardingPlan:
         for pat, spec in self._compiled_rules:
             if pat.search(name):
                 return spec
+        if self.layout is not None:
+            from . import layouts as _layouts
+
+            role = (self.roles or {}).get(name)
+            if role is None:
+                role = _layouts.role_from_name(name, shape)
+            if role is not None:
+                return self.layout.spec_for_role(
+                    role, shape, self.axis_sizes())
         return PartitionSpec()
 
     def data_spec(self):
@@ -264,10 +328,48 @@ class ShardingPlan:
 
     def shards_params(self, names_shapes):
         """True when any of (name, shape) pairs resolves to a
-        non-replicated spec — the tensor-parallel case the whole-step
-        shard_map path cannot host (its in_specs replicate params; XLA's
-        GSPMD path carries tp instead)."""
+        non-replicated spec — the tensor/FSDP case. Such plans still
+        ride the donated whole-step path (train_step.py compiles the
+        step as ONE GSPMD program over this mesh); this predicate picks
+        that variant over the replicated-params shard_map body."""
         return any(self.spec_for(n, s) != PartitionSpec()
+                   for n, s in names_shapes)
+
+    # -- ZeRO optimizer-state sharding ------------------------------------
+    def zero_axis(self):
+        """The mesh axis optimizer state shards along (ZeRO), or None.
+
+        The layout's fsdp axis when the mesh carries it (the literal
+        axis name ``fsdp`` for layout-less plans), gated by MXTPU_ZERO —
+        off means state mirrors its weight's placement verbatim."""
+        if not _env.get("MXTPU_ZERO"):
+            return None
+        fsdp = self.layout.fsdp_axis if self.layout is not None \
+            else "fsdp"
+        return fsdp if any(n == fsdp for n, _ in self.axes) else None
+
+    def state_spec_for(self, name, shape):
+        """PartitionSpec for one optimizer-state leaf mirroring param
+        ``name``: the param's own spec, extended along the fsdp axis on
+        the first unsharded divisible dim when ZeRO is on. State leaves
+        whose shape differs from the weight's (scalar counters) stay
+        with the param spec pruned to their rank."""
+        spec = self.spec_for(name, shape)
+        axis = self.zero_axis()
+        if axis is None or shape is None:
+            return spec
+        from . import layouts as _layouts
+
+        return _layouts.zero_state_spec(spec, shape, self.axis_sizes(),
+                                        axis)
+
+    def shards_state(self, names_shapes):
+        """True when ZeRO actually shards any state leaf beyond its
+        param's own spec (the sharded-bucket layout tpu_dist/checkpoint
+        must honor)."""
+        if self.zero_axis() is None:
+            return False
+        return any(self.state_spec_for(n, s) != self.spec_for(n, s)
                    for n, s in names_shapes)
 
     # -- application -------------------------------------------------------
@@ -280,20 +382,33 @@ class ShardingPlan:
         mesh = self.mesh
         _shard_params(params, mesh, spec_fn=self.spec_for)
         n_dev = mesh.devices.size
-        table = []
-        for name, p in sorted(params.items()):
-            spec = self.spec_for(name, p.shape)
-            factor = 1
+
+        def _factor(spec):
+            f = 1
             for entry in spec:
                 for ax in (entry if isinstance(entry, tuple)
                            else (entry,)) if entry is not None else ():
-                    factor *= mesh.shape[ax]
+                    f *= mesh.shape[ax]
+            return max(f, 1)
+
+        table = []
+        for name, p in sorted(params.items()):
+            spec = self.spec_for(name, p.shape)
+            sspec = self.state_spec_for(name, p.shape)
             nbytes = _telemetry.nbytes_of(p.data()._data)
             table.append({"param": name, "spec": str(spec),
-                          "bytes_per_device": nbytes // max(factor, 1)})
+                          "bytes_per_device": nbytes // _factor(spec),
+                          "state_spec": str(sspec),
+                          # per weight-shaped optimizer-state leaf
+                          # (momentum, variance, fp32 master) under the
+                          # ZeRO layout — diagnose's opt-state column
+                          "state_bytes_per_device":
+                              nbytes // _factor(sspec)})
+        zero = self.zero_axis()
         _LAST_APPLIED[0] = {"plan": self.to_manifest(),
                             "mesh": dict(mesh.shape),
                             "devices": int(n_dev),
+                            "zero_axis": zero,
                             "params": table}
         _telemetry.record_sharding_apply(label, dict(mesh.shape),
                                          params=len(table))
@@ -301,7 +416,11 @@ class ShardingPlan:
             from ..observability import flight as _flight
 
             _flight.set_identity(mesh=dict(mesh.shape),
-                                 coords=self.process_coords())
+                                 coords=self.process_coords(),
+                                 # fleetctl's mesh column: 1/N optimizer
+                                 # shard this rank holds under ZeRO
+                                 zero_frac=(1.0 / mesh.shape[zero]
+                                            if zero else None))
         except Exception:
             pass
         return mesh
@@ -312,15 +431,19 @@ class ShardingPlan:
                 and self.axes == other.axes
                 and self.rules == other.rules
                 and self.batch_axis == other.batch_axis
-                and self.spec_fn is other.spec_fn)
+                and self.spec_fn is other.spec_fn
+                and self.layout == other.layout
+                and self.roles == other.roles)
 
     def __hash__(self):
-        return hash((self.axes, self.rules, self.batch_axis))
+        return hash((self.axes, self.rules, self.batch_axis,
+                     self.layout))
 
     def __repr__(self):
         ax = ",".join(f"{n}={s}" for n, s in self.axes)
         extra = f", rules={len(self.rules)}" if self.rules else ""
         extra += ", spec_fn" if self.spec_fn is not None else ""
+        extra += ", layout" if self.layout is not None else ""
         return f"ShardingPlan({ax}{extra})"
 
 

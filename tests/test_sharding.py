@@ -323,9 +323,10 @@ def test_pass_context_shardings_forwarded():
     assert out.sharding.is_equivalent_to(shd, out.ndim)
 
 
-def test_tensor_sharded_plan_routes_phased():
-    """A plan that tensor-shards params is whole-step-ineligible (typed
-    reason) and trains through the phased/GSPMD path instead."""
+def test_tensor_sharded_plan_runs_whole_step():
+    """A plan that tensor-shards params now compiles the donated
+    whole-step GSPMD program (ISSUE 19) instead of falling back to the
+    phased path — and the tp-sharded layout survives the step."""
     mx.seed(0)
     net = gluon.nn.HybridSequential()
     net.add(gluon.nn.Dense(16, activation="relu"), gluon.nn.Dense(OUT))
@@ -339,13 +340,207 @@ def test_tensor_sharded_plan_routes_phased():
     step = gluon.TrainStep(net, gluon.loss.L2Loss(), trainer)
     xs, ys = _data(2)
     loss = step(xs[0], ys[0])
-    assert step.last_path == "phased"
-    assert "tensor-shards" in step.ineligible_reason()
+    assert step.last_path == "whole_step", step.ineligible_reason()
     assert onp.isfinite(loss.asnumpy()).all()
-    # the tp-sharded weight really is laid out on the mesh
+    # the tp-sharded weight really is laid out on the mesh — and stays
+    # there after the donated in-place update
+    step(xs[1], ys[1])
     w = net.collect_params()["0.weight"].data()._data
     assert w.sharding.is_equivalent_to(
         NamedSharding(plan.mesh, P(None, "tp")), w.ndim)
+
+
+# -- hybrid dp x fsdp x tp whole-step (ISSUE 19 tentpole) --------------------
+
+HYBRID = "dp=2,fsdp=2,tp=2"
+
+
+def _run_trainer_hybrid(axes, steps=5, whole=True, monkeypatch=None,
+                        momentum=0.9):
+    """Train via a SpecLayout-derived plan; `whole=False` forces the
+    phased fallback (the parity reference) via MXTPU_WHOLE_STEP=0."""
+    if monkeypatch is not None:
+        if whole:
+            monkeypatch.delenv("MXTPU_WHOLE_STEP", raising=False)
+        else:
+            monkeypatch.setenv("MXTPU_WHOLE_STEP", "0")
+    mx.seed(0)
+    net = gluon.nn.HybridSequential()
+    net.add(gluon.nn.Dense(16, activation="relu"), gluon.nn.Dense(OUT))
+    net.initialize()
+    net.hybridize()
+    plan = ShardingPlan.from_layout(axes, net=net) if axes else None
+    kw = (dict(kvstore="tpu_dist", sharding_plan=plan) if plan
+          else dict(kvstore=None))
+    trainer = gluon.Trainer(net.collect_params(), "sgd",
+                            {"learning_rate": 0.1, "momentum": momentum},
+                            **kw)
+    step = gluon.TrainStep(net, gluon.loss.L2Loss(), trainer)
+    xs, ys = _data(steps)
+    mx.seed(99)
+    losses = [step(xs[k], ys[k]).asnumpy().astype("float32")
+              for k in range(steps)]
+    params = {n: p.data().asnumpy().copy()
+              for n, p in sorted(net.collect_params().items())}
+    return losses, params, step, trainer
+
+
+def test_hybrid_plan_whole_step_bitwise_vs_phased(monkeypatch):
+    """Acceptance: the dp=2,fsdp=2,tp=2 SpecLayout plan compiles the
+    donated whole-step GSPMD program — ONE dispatch per step, zero
+    retraces after warmup — and fp32 losses AND final params are
+    BITWISE equal to the phased three-phase reference over 5 steps."""
+    mx.seed(0)
+    net = gluon.nn.HybridSequential()
+    net.add(gluon.nn.Dense(16, activation="relu"), gluon.nn.Dense(OUT))
+    net.initialize()
+    net.hybridize()
+    plan = ShardingPlan.from_layout(HYBRID, net=net)
+    trainer = gluon.Trainer(net.collect_params(), "sgd",
+                            {"learning_rate": 0.1, "momentum": 0.9},
+                            kvstore="tpu_dist", sharding_plan=plan)
+    step = gluon.TrainStep(net, gluon.loss.L2Loss(), trainer)
+    xs, ys = _data(5)
+    mx.seed(99)
+    telemetry.enable()
+    try:
+        losses_w, per_step, traces = [], [], []
+        for k in range(5):
+            trainer.set_learning_rate(0.1 / (k + 1))
+            d0 = ti.step_dispatch_total.labels("whole_step").value
+            t0 = step.jit_trace_count()
+            losses_w.append(step(xs[k], ys[k]).asnumpy()
+                            .astype("float32"))
+            per_step.append(
+                ti.step_dispatch_total.labels("whole_step").value - d0)
+            traces.append(step.jit_trace_count() - t0)
+    finally:
+        telemetry.disable()
+    assert step.last_path == "whole_step", step.ineligible_reason()
+    assert per_step == [1] * 5, per_step
+    assert traces[0] == 1 and traces[1:] == [0] * 4, traces
+    params_w = {n: p.data().asnumpy().copy()
+                for n, p in sorted(net.collect_params().items())}
+
+    # phased reference with the SAME plan and LR schedule
+    monkeypatch.setenv("MXTPU_WHOLE_STEP", "0")
+    mx.seed(0)
+    net_p = gluon.nn.HybridSequential()
+    net_p.add(gluon.nn.Dense(16, activation="relu"), gluon.nn.Dense(OUT))
+    net_p.initialize()
+    net_p.hybridize()
+    plan_p = ShardingPlan.from_layout(HYBRID, net=net_p)
+    tr_p = gluon.Trainer(net_p.collect_params(), "sgd",
+                         {"learning_rate": 0.1, "momentum": 0.9},
+                         kvstore="tpu_dist", sharding_plan=plan_p)
+    step_p = gluon.TrainStep(net_p, gluon.loss.L2Loss(), tr_p)
+    mx.seed(99)
+    losses_p = []
+    for k in range(5):
+        tr_p.set_learning_rate(0.1 / (k + 1))
+        losses_p.append(step_p(xs[k], ys[k]).asnumpy()
+                        .astype("float32"))
+    assert step_p.last_path == "phased"
+    for k, (a, b) in enumerate(zip(losses_w, losses_p)):
+        onp.testing.assert_array_equal(a, b, err_msg=f"step {k}")
+    for n, p in sorted(net_p.collect_params().items()):
+        onp.testing.assert_array_equal(
+            params_w[n], p.data().asnumpy(), err_msg=n)
+
+
+def test_zero_state_sharded_and_reduced():
+    """ZeRO: optimizer state on an fsdp=4 plan lives 1/4-sharded per
+    device — >=3x smaller than the replicated trainer's copy — and the
+    whole-step program keeps it that way across donated steps."""
+    def state_bytes(trainer):
+        total = 0
+        for st in trainer._states:
+            for v in jax.tree_util.tree_leaves(st):
+                d = getattr(v, "_data", v)
+                if hasattr(d, "addressable_shards"):
+                    s = d.addressable_shards[0].data
+                    total += s.size * s.dtype.itemsize
+        return total
+
+    _l4, _p4, step4, tr4 = _run_trainer_hybrid("dp=2,fsdp=4", steps=3)
+    assert step4.last_path == "whole_step", step4.ineligible_reason()
+    _lr, _pr, _stepr, trr = _run_trainer_hybrid(None, steps=3)
+    b4, br = state_bytes(tr4), state_bytes(trr)
+    assert b4 > 0 and br > 0
+    assert br / b4 >= 3.0, (b4, br)
+    # the layout is the plan's state spec, not an accident of device_put
+    for i, st in enumerate(tr4._states):
+        spec = tr4.sharding_plan.state_spec_for(
+            tr4._param_names[i], tr4._params[i].data().shape)
+        want = NamedSharding(tr4.sharding_plan.mesh, spec)
+        for v in jax.tree_util.tree_leaves(st):
+            d = getattr(v, "_data", v)
+            if getattr(d, "shape", None) == tr4._params[i].data().shape:
+                assert d.sharding.is_equivalent_to(want, d.ndim), \
+                    tr4._param_names[i]
+
+
+def test_zero_checkpoint_roundtrip_bitwise(tmp_path):
+    """ZeRO state saved from an fsdp=4 run restores BITWISE onto a
+    replicated trainer, and the replicated checkpoint restores onto an
+    fsdp=4 plan with state re-placed on the ZeRO layout."""
+    from mxnet_tpu.checkpoint import CheckpointManager
+
+    def host_states(trainer):
+        out = []
+        for st in trainer._states:
+            leaves = [onp.asarray(v.asnumpy() if hasattr(v, "asnumpy")
+                                  else v)
+                      for v in jax.tree_util.tree_leaves(st)]
+            out.append(leaves)
+        return out
+
+    l4, p4, step4, tr4 = _run_trainer_hybrid("dp=2,fsdp=4", steps=3)
+    assert step4.last_path == "whole_step", step4.ineligible_reason()
+    st4 = host_states(tr4)
+    mgr = CheckpointManager(tmp_path, tr4)
+    mgr.save(step=3)
+    mgr.flush()
+
+    # fsdp=4 -> replicated: params AND optimizer state bitwise
+    mx.seed(1234)
+    net = gluon.nn.HybridSequential()
+    net.add(gluon.nn.Dense(16, activation="relu"), gluon.nn.Dense(OUT))
+    net.initialize()
+    net.hybridize()
+    xs, _ys = _data(1)
+    net(xs[0])
+    trainer = gluon.Trainer(net.collect_params(), "sgd",
+                            {"learning_rate": 0.1, "momentum": 0.9})
+    res = CheckpointManager(tmp_path, trainer).restore()
+    assert res.step == 3
+    got = {n: p.data().asnumpy()
+           for n, p in sorted(net.collect_params().items())}
+    for n in p4:
+        onp.testing.assert_array_equal(got[n], p4[n], err_msg=n)
+    for a, b in zip(host_states(trainer), st4):
+        for va, vb in zip(a, b):
+            onp.testing.assert_array_equal(va, vb)
+
+    # replicated -> fsdp=4: restore re-places state on the ZeRO layout
+    l8, p8, step8, tr8 = _run_trainer_hybrid("dp=2,fsdp=4", steps=1)
+    mgr1 = CheckpointManager(tmp_path, trainer)
+    mgr1.save(step=4)
+    mgr1.flush()
+    CheckpointManager(tmp_path, tr8).restore()
+    for a, b in zip(host_states(tr8), st4):
+        for va, vb in zip(a, b):
+            onp.testing.assert_array_equal(va, vb)
+    for i, st in enumerate(tr8._states):
+        shape = tr8._params[i].data().shape
+        spec = tr8.sharding_plan.state_spec_for(
+            tr8._param_names[i], shape)
+        want = NamedSharding(tr8.sharding_plan.mesh, spec)
+        for v in jax.tree_util.tree_leaves(st):
+            d = getattr(v, "_data", v)
+            if getattr(d, "shape", None) == shape:
+                assert d.sharding.is_equivalent_to(want, d.ndim), \
+                    tr8._param_names[i]
 
 
 # -- promoted dryrun_multichip eager harness ---------------------------------

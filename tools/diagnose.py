@@ -324,12 +324,16 @@ def _passes_report_lines(pr):
     la = sh.get("last_applied")
     if la:
         lines.append(f"    last plan: mesh={la['mesh']} over "
-                     f"{la['devices']} device(s)")
+                     f"{la['devices']} device(s)"
+                     + (f" zero_axis={la['zero_axis']}"
+                        if la.get("zero_axis") else ""))
         lines.append("    param                                    "
-                     "spec                      bytes/device")
+                     "spec                      bytes/device "
+                     "opt-state B/dev")
         for row in la["params"]:
             lines.append(f"    {row['param']:<40} {row['spec']:<25} "
-                         f"{row['bytes_per_device']:>12}")
+                         f"{row['bytes_per_device']:>12} "
+                         f"{row.get('state_bytes_per_device', '-'):>15}")
     cd = pr.get("costdb") or {}
     cd_cfg = " ".join(f"{k}={v!r}" for k, v in
                       (cd.get("config") or {}).items())

@@ -61,12 +61,13 @@ def poll_rank(endpoint, timeout=3.0):
     row = {"endpoint": endpoint, "health": "down", "ready": False,
            "rank": None, "job": None, "world": None, "last_step": None,
            "step_ms": None, "examples_per_s": None, "queue": None,
-           "mesh": None, "coords": None, "error": None}
+           "mesh": None, "coords": None, "zero_frac": None, "error": None}
     try:
         ident = _get(base, "/identity", timeout)
         row.update(rank=ident.get("rank"), job=ident.get("job"),
                    world=ident.get("world"), mesh=ident.get("mesh"),
-                   coords=ident.get("coords"))
+                   coords=ident.get("coords"),
+                   zero_frac=ident.get("zero_frac"))
         hz = _get(base, "/healthz", timeout)
         row["health"] = hz.get("status", "ok")
         steps = _get(base, "/steps", timeout)
@@ -141,15 +142,19 @@ def annotate_stragglers(rows, skew=DEFAULT_SKEW):
 
 def _mesh_cell(r):
     """A rank's place on the device mesh, e.g. 'dp2,tp0 of dp=4,tp=2'
-    (ShardingPlan stamps mesh/coords into the flight identity)."""
+    — plus the ZeRO optimizer-state fraction it holds when the plan
+    fsdp-shards state, e.g. '... zero=1/4' (ShardingPlan stamps
+    mesh/coords/zero_frac into the flight identity)."""
     mesh, coords = r.get("mesh"), r.get("coords")
     if not mesh:
         return "-"
     shape = ",".join(f"{a}={n}" for a, n in mesh.items())
+    zf = r.get("zero_frac")
+    zero = f" zero=1/{round(1 / zf)}" if zf else ""
     if not coords:
-        return shape
+        return shape + zero
     at = ",".join(f"{a}{i}" for a, i in coords.items())
-    return f"{at} of {shape}"
+    return f"{at} of {shape}{zero}"
 
 
 def _slo_cell(r):
