@@ -1061,6 +1061,55 @@ def bench_ckpt_save_ms(platform, saves=3):
         shutil.rmtree(ckdir, ignore_errors=True)
 
 
+def bench_reshard_restore_ms(platform, restores=3):
+    """Milliseconds per mesh-migrating restore: a dp=4 checkpoint
+    restored onto a dp=2 trainer with allow_reshard=True — manifest
+    read + plan-compatibility judgment + host arrays re-placed under
+    the new plan's NamedShardings (docs/elasticity.md). Lower is
+    better; the >3% regression gate applies via the _ms suffix."""
+    import shutil
+    import tempfile
+
+    import numpy as onp
+
+    import mxnet_tpu as mx
+    from mxnet_tpu import gluon
+    from mxnet_tpu.sharding import ShardingPlan
+
+    def build(axes):
+        mx.seed(0)
+        net = gluon.nn.HybridSequential()
+        net.add(gluon.nn.Dense(256, activation="relu"),
+                gluon.nn.Dense(64))
+        net.initialize()
+        net.hybridize()
+        plan = ShardingPlan(axes)
+        trainer = gluon.Trainer(net.collect_params(), "sgd",
+                                {"learning_rate": 0.1, "momentum": 0.9},
+                                kvstore="tpu_dist", sharding_plan=plan)
+        step = gluon.TrainStep(net, gluon.loss.L2Loss(), trainer)
+        rs = onp.random.RandomState(3)
+        x = mx.np.array(rs.standard_normal((32, 128)).astype("f"))
+        y = mx.np.array(rs.standard_normal((32, 64)).astype("f"))
+        step(x, y)
+        return trainer
+
+    ckdir = tempfile.mkdtemp(prefix="bench-reshard-")
+    try:
+        mgr4 = mx.checkpoint.CheckpointManager(ckdir, build("dp=4"))
+        mgr4.save(step=1)
+        mgr4.flush()
+        tr2 = build("dp=2")
+        mgr2 = mx.checkpoint.CheckpointManager(ckdir, tr2)
+        mgr2.restore(allow_reshard=True)   # warm: npz read, placement
+        t0 = time.perf_counter()
+        for _ in range(restores):
+            mgr2.restore(allow_reshard=True)
+        return (time.perf_counter() - t0) / restores * 1000.0
+    finally:
+        shutil.rmtree(ckdir, ignore_errors=True)
+
+
 def bench_serving_qps(platform, clients=8, requests=40,
                       trace_sample=None):
     """Serving-engine round-trip QPS: `clients` threads hammering one
@@ -1610,6 +1659,20 @@ def main():
                     "rename; docs/checkpointing.md)"})
     except Exception as e:
         rows.append({"metric": "ckpt_save_ms", "error": str(e)})
+
+    try:
+        if over_budget():
+            raise TimeoutError("bench budget exhausted")
+        rs_ms = bench_reshard_restore_ms(platform)
+        rows.append({
+            "metric": "reshard_restore_ms" + suffix,
+            "value": round(rs_ms, 3), "unit": "ms",
+            "note": "mean of 3 mesh-migrating restores (dp=4 checkpoint "
+                    "onto a dp=2 trainer, allow_reshard=True: manifest "
+                    "read + plan judgment + re-placement; "
+                    "docs/elasticity.md)"})
+    except Exception as e:
+        rows.append({"metric": "reshard_restore_ms", "error": str(e)})
 
     # graph-pass pipeline build latency + peak program footprint run on
     # every platform (cheap MLP / registry read); both lower-is-better

@@ -111,6 +111,7 @@ from . import serving  # noqa: E402  (batching inference engine; docs/serving.md
 from . import decode  # noqa: E402  (KV-cache autoregressive decode; docs/decode.md)
 from . import checkpoint  # noqa: E402  (atomic snapshots; docs/checkpointing.md)
 from . import sharding  # noqa: E402  (hybrid parallelism; docs/sharding.md)
+from . import elastic  # noqa: E402  (topology-change survival; docs/elasticity.md)
 from . import observability  # noqa: E402  (flight recorder + numerics + postmortems)
 
 waitall = engine.waitall

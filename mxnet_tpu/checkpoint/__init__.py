@@ -13,7 +13,8 @@ preemption handling, and exact training resume (docs/checkpointing.md).
 """
 from __future__ import annotations
 
-from .errors import CheckpointCorrupt, CheckpointError, CheckpointNotFound
+from .errors import (CheckpointCorrupt, CheckpointError,
+                     CheckpointNotFound, PlanMismatch)
 from .manager import CheckpointManager, RestoreResult, verify_checkpoint
 from .preemption import PreemptionHandler, install_preemption_handler
 
@@ -21,4 +22,5 @@ __all__ = [
     "CheckpointManager", "RestoreResult", "verify_checkpoint",
     "PreemptionHandler", "install_preemption_handler",
     "CheckpointError", "CheckpointCorrupt", "CheckpointNotFound",
+    "PlanMismatch",
 ]

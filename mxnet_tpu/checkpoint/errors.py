@@ -10,7 +10,8 @@ from __future__ import annotations
 
 from ..base import MXNetError
 
-__all__ = ["CheckpointError", "CheckpointNotFound", "CheckpointCorrupt"]
+__all__ = ["CheckpointError", "CheckpointNotFound", "CheckpointCorrupt",
+           "PlanMismatch"]
 
 
 class CheckpointError(MXNetError):
@@ -24,3 +25,17 @@ class CheckpointNotFound(CheckpointError):
 class CheckpointCorrupt(CheckpointError):
     """A committed checkpoint failed validation (missing files, manifest
     mismatch, or per-array checksum failure)."""
+
+
+class PlanMismatch(CheckpointError):
+    """The checkpoint's recorded ShardingPlan and the restoring trainer's
+    plan disagree on world size (mesh device count). Restoring across
+    world sizes is a topology migration, not a resume — pass
+    ``allow_reshard=True`` to restore() (or use ``mxnet_tpu.elastic.
+    reshard`` / ``tools/ckpt.py reshard``) to opt in explicitly
+    (docs/elasticity.md)."""
+
+    def __init__(self, msg, saved_plan=None, target_plan=None):
+        super().__init__(msg)
+        self.saved_plan = saved_plan      # manifest dict (or None)
+        self.target_plan = target_plan    # manifest dict (or None)

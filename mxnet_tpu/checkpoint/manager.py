@@ -45,7 +45,8 @@ from .._dtype_codec import decode_npz, encode_payload
 from ..diagnostics import spans as _spans
 from ..telemetry import instruments as _telemetry
 from . import snapshot as _snapshot
-from .errors import CheckpointCorrupt, CheckpointError, CheckpointNotFound
+from .errors import (CheckpointCorrupt, CheckpointError,
+                     CheckpointNotFound, PlanMismatch)
 
 __all__ = ["CheckpointManager", "RestoreResult", "verify_checkpoint"]
 
@@ -369,7 +370,7 @@ class CheckpointManager:
             raise first
 
     # -- restore -----------------------------------------------------------
-    def restore(self, step=None, trainer=None):
+    def restore(self, step=None, trainer=None, allow_reshard=False):
         """Load a committed checkpoint into the trainer.
 
         step=None walks committed steps newest-first, skipping corrupt
@@ -378,6 +379,13 @@ class CheckpointManager:
         explicit `step` raises CheckpointNotFound if absent and
         CheckpointCorrupt if damaged — never silently substitutes
         another step. Returns a RestoreResult.
+
+        When both the checkpoint and the trainer carry a ShardingPlan
+        and their world sizes (mesh device counts) differ, restore is a
+        topology migration and raises PlanMismatch unless
+        `allow_reshard=True` opts in (elastic.resharded_restore is the
+        documented front door; docs/elasticity.md). Same-world plan
+        changes re-place silently, as ever — arrays are host-gathered.
         """
         trainer = trainer or self._trainer
         if trainer is None:
@@ -393,7 +401,7 @@ class CheckpointManager:
                 raise CheckpointNotFound(
                     f"no committed checkpoint for step {step} "
                     f"in {self.directory}")
-            return self._load(step, trainer)
+            return self._load(step, trainer, allow_reshard)
         candidates = self.steps()
         if not candidates:
             _telemetry.record_ckpt_restore("not_found")
@@ -402,7 +410,7 @@ class CheckpointManager:
         last_err = None
         for s in reversed(candidates):
             try:
-                return self._load(s, trainer)
+                return self._load(s, trainer, allow_reshard)
             except CheckpointCorrupt as e:  # noqa: PERF203
                 import warnings
 
@@ -415,19 +423,59 @@ class CheckpointManager:
             f"all {len(candidates)} checkpoints in {self.directory} "
             f"are corrupt") from last_err
 
-    def _load(self, step, trainer):
+    def _check_plan(self, manifest, trainer, allow_reshard, d):
+        """The PlanMismatch gate: returns the compatibility report when
+        the restore crosses plans (None for exact resumes). Only a
+        plan-to-plan world-size change is gated — restoring onto a
+        plan-less trainer (host-gathered arrays land replicated) or
+        from a plan-less checkpoint (first placement) stays silent."""
+        saved = (manifest.get("meta") or {}).get("sharding_plan")
+        plan = getattr(trainer, "sharding_plan", None)
+        if saved is None and plan is None:
+            return None
+        from ..elastic import reshard as _reshard
+
+        compat = _reshard.plan_compatibility(saved, plan)
+        if compat["verdict"] == "exact":
+            return None
+        if compat["verdict"] == "reshard" and saved is not None \
+                and plan is not None and not allow_reshard:
+            _telemetry.record_ckpt_restore("plan_mismatch")
+            raise PlanMismatch(
+                f"{d}: checkpoint was saved under a "
+                f"{compat['saved_world']}-device plan "
+                f"({compat['saved_axes']}) but the trainer's plan spans "
+                f"{compat['target_world']} devices "
+                f"({compat['target_axes']}) — a topology migration. "
+                f"Pass allow_reshard=True (or use "
+                f"elastic.resharded_restore / tools/ckpt.py reshard) "
+                f"to opt in (docs/elasticity.md)",
+                saved_plan=saved, target_plan=plan.to_manifest())
+        return compat
+
+    def _load(self, step, trainer, allow_reshard=False):
         d = self.step_dir(step)
         try:
             arrays, manifest = _read_checkpoint(d, verify=self.verify)
         except CheckpointError:
             _telemetry.record_ckpt_restore("corrupt")
             raise
+        compat = self._check_plan(manifest, trainer, allow_reshard, d)
+        t0 = time.perf_counter()
         with _spans.span("ckpt.restore", cat="checkpoint"):
             try:
                 _snapshot.apply(trainer, arrays, manifest["meta"])
             except CheckpointError:
                 _telemetry.record_ckpt_restore("error")
                 raise
+        if compat is not None:
+            # a cross-plan restore IS the reshard (apply re-placed every
+            # array under the target plan): time it and leave the
+            # migration in the flight record
+            _telemetry.record_reshard(
+                (time.perf_counter() - t0) * 1e3,
+                saved_world=compat["saved_world"],
+                target_world=compat["target_world"], site="restore")
         _telemetry.record_ckpt_restore("ok")
         return RestoreResult(step, manifest["meta"].get("user_state"),
                              manifest)

@@ -1,0 +1,33 @@
+"""Elastic training: topology change as a supported event, not a crash
+(docs/elasticity.md).
+
+Three pieces close the ROADMAP's last half-built pillar:
+
+  * :mod:`.reshard` — mesh-migrating checkpoint restore: judge a saved
+    plan against a target plan, gate world-size changes behind the
+    typed :class:`PlanMismatch`, rewrite checkpoints offline for a new
+    mesh, and prove restores bitwise against host-gathered truth;
+  * :mod:`.reentry` — swap a live Trainer onto a new plan: re-place
+    params/state, rebuild the donated whole-step program and kvstore
+    collectives for the new world, rescale the LR
+    (MXTPU_ELASTIC_LR_RESCALE), bump the :func:`world_generation`
+    counter into the flight identity;
+  * :mod:`.policy` — the supervisor's restart brain (backoff, restart
+    budget, clean-exit contract) plus the append-only restart ledger
+    tools/supervisor.py writes into the flight dir.
+"""
+from __future__ import annotations
+
+from .policy import LEDGER_NAME, RestartLedger, RestartPolicy
+from .reentry import (bump_generation, current_generation, reenter,
+                      rescale_factor, rescale_lr, world_generation)
+from .reshard import (PlanMismatch, plan_compatibility, plan_world_size,
+                      reshard_checkpoint, resharded_restore, verify_parity)
+
+__all__ = [
+    "PlanMismatch", "plan_compatibility", "plan_world_size",
+    "resharded_restore", "reshard_checkpoint", "verify_parity",
+    "reenter", "rescale_lr", "rescale_factor",
+    "world_generation", "bump_generation", "current_generation",
+    "RestartPolicy", "RestartLedger", "LEDGER_NAME",
+]

@@ -1,0 +1,153 @@
+"""Elastic Trainer re-entry: rebuild a live trainer for a new topology.
+
+After a rank dies, the supervisor relaunches the job on the surviving
+device set; a *process that survives* (or a freshly restored one that
+wants to change plans mid-flight) instead calls :func:`reenter` to swap
+the trainer onto a new ShardingPlan in place:
+
+  * the plan is swapped and re-applied (params + grads re-placed under
+    the new NamedShardings; optimizer state re-placed per the new
+    plan's ZeRO ``state_spec_for``, so fsdp state re-extends along the
+    new axis);
+  * the kvstore is re-pointed at the new plan and its jitted-collective
+    cache dropped (bucket signatures change with the mesh);
+  * the TrainStep's compiled whole-step program, eligibility verdict,
+    and fused buckets are discarded via :meth:`TrainStep.rebuild` — the
+    next call re-traces ONCE for the new world and then runs
+    zero-retrace again;
+  * the learning rate rescales per :func:`rescale_lr`
+    (``MXTPU_ELASTIC_LR_RESCALE``: linear | sqrt | off) — the global
+    batch shrinks with the data-parallel world, and linear scaling is
+    the classic Goyal et al. rule, sqrt its conservative cousin;
+  * the :func:`world_generation` counter bumps and lands in the flight
+    identity, so opsd ``/identity`` and the fleetctl table show which
+    incarnation of the job each rank is running.
+
+A supervisor-relaunched process doesn't call reenter (its Trainer is
+built fresh on the new plan); it inherits the generation via
+``MXTPU_ELASTIC_GENERATION`` and stamps it at import through
+:func:`current_generation`.
+"""
+from __future__ import annotations
+
+import math
+import os
+
+__all__ = ["reenter", "rescale_lr", "rescale_factor",
+           "world_generation", "bump_generation", "current_generation"]
+
+# this process's world generation: 0 for a first launch, inherited from
+# the supervisor (MXTPU_ELASTIC_GENERATION) for a relaunch, bumped by
+# every in-process reenter()
+_generation = [None]
+
+
+def current_generation():
+    """The generation this process STARTED at (env-inherited, else 0)."""
+    raw = os.environ.get("MXTPU_ELASTIC_GENERATION")
+    try:
+        return int(raw) if raw else 0
+    except ValueError:
+        return 0
+
+
+def world_generation():
+    """The live generation counter: starts at :func:`current_generation`,
+    +1 per :func:`reenter` / :func:`bump_generation`."""
+    if _generation[0] is None:
+        _generation[0] = current_generation()
+    return _generation[0]
+
+
+def bump_generation():
+    """Increment the generation and stamp it into the flight identity
+    (-> opsd /identity -> fleetctl) and the world_generation gauge."""
+    g = world_generation() + 1
+    _generation[0] = g
+    _stamp(g)
+    return g
+
+
+def _stamp(g):
+    from ..telemetry import instruments as _telemetry
+
+    _telemetry.set_world_generation(g)
+    try:
+        from ..observability import flight as _flight
+
+        _flight.set_identity(generation=g)
+    except Exception:
+        pass
+
+
+def rescale_factor(old_world, new_world, mode=None):
+    """LR multiplier for a world-size change: 'linear' (new/old, the
+    Goyal et al. global-batch rule), 'sqrt' (sqrt(new/old)), 'off'
+    (1.0). ``mode=None`` reads MXTPU_ELASTIC_LR_RESCALE."""
+    if mode is None:
+        from .. import env as _env
+
+        mode = _env.get("MXTPU_ELASTIC_LR_RESCALE")
+    mode = str(mode).strip().lower()
+    old_world = max(int(old_world), 1)
+    new_world = max(int(new_world), 1)
+    if mode in ("off", "0", "none", "false", ""):
+        return 1.0
+    if mode == "linear":
+        return new_world / old_world
+    if mode == "sqrt":
+        return math.sqrt(new_world / old_world)
+    raise ValueError(
+        f"MXTPU_ELASTIC_LR_RESCALE={mode!r} is not a recognized mode; "
+        f"expected linear | sqrt | off")
+
+
+def rescale_lr(optimizer, old_world, new_world, mode=None):
+    """Apply :func:`rescale_factor` to an optimizer's learning rate in
+    place; returns the factor. A scheduled LR (lr_scheduler) is left
+    alone — schedules already see the new ``rescale_grad``/batch and
+    must stay the single source of truth."""
+    factor = rescale_factor(old_world, new_world, mode)
+    if factor != 1.0 and getattr(optimizer, "lr_scheduler", None) is None:
+        optimizer.set_learning_rate(optimizer.learning_rate * factor)
+    return factor
+
+
+def reenter(trainer, plan, train_step=None, lr_rescale=None):
+    """Re-enter a live trainer on a new ShardingPlan (docs/elasticity.md).
+
+    ``plan`` is a ShardingPlan, an axes spelling ('dp=2,fsdp=2'), or
+    None (drop to replicated). ``train_step`` (optional) is the
+    TrainStep to rebuild for the new world. Returns a report dict
+    ({'generation', 'old_world', 'new_world', 'lr_factor'}).
+    """
+    import time
+
+    from ..sharding.plan import ShardingPlan
+    from ..telemetry import instruments as _telemetry
+
+    t0 = time.perf_counter()
+    old_plan = trainer.sharding_plan
+    old_world = old_plan.mesh.devices.size if old_plan is not None else 1
+    if plan is not None and not isinstance(plan, ShardingPlan):
+        plan = ShardingPlan(plan)
+    trainer.set_sharding_plan(plan)
+    new_world = plan.mesh.devices.size if plan is not None else 1
+    kv = trainer._kvstore
+    if kv is not None:
+        # mesh-shaped jitted collectives (bucketed allreduce signatures
+        # carry the operand shardings) must rebuild for the new world
+        cache = getattr(kv, "_sum_cache", None)
+        if cache is not None:
+            cache.clear()
+    if train_step is not None:
+        train_step.rebuild()
+    factor = rescale_lr(trainer._optimizer, old_world, new_world,
+                        lr_rescale)
+    g = bump_generation()
+    ms = (time.perf_counter() - t0) * 1e3
+    _telemetry.record_elastic_restart("reenter", generation=g)
+    _telemetry.record_reshard(ms, saved_world=old_world,
+                              target_world=new_world, site="reenter")
+    return {"generation": g, "old_world": old_world,
+            "new_world": new_world, "lr_factor": factor}

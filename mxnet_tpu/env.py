@@ -297,6 +297,35 @@ register(
     "CheckpointHandler when none is passed explicitly; empty = require "
     "an explicit directory.")
 register(
+    "MXTPU_ELASTIC_MAX_RESTARTS", int, 3,
+    "Supervisor restart budget (tools/supervisor.py via "
+    "elastic.RestartPolicy; docs/elasticity.md): lifetime cap on "
+    "restarts after rank deaths before the supervisor gives up and "
+    "exits non-zero. -1 = unlimited.")
+register(
+    "MXTPU_ELASTIC_BACKOFF_S", float, 1.0,
+    "Supervisor restart backoff base (seconds): restart N after a rank "
+    "death waits base * 2^N, capped at MXTPU_ELASTIC_BACKOFF_MAX_S — "
+    "a crash-looping job must not hammer the checkpoint store.")
+register(
+    "MXTPU_ELASTIC_BACKOFF_MAX_S", float, 30.0,
+    "Cap on the supervisor's exponential restart backoff (seconds).")
+register(
+    "MXTPU_ELASTIC_LR_RESCALE", str, "off",
+    "LR rescaling rule when the world size changes at elastic re-entry "
+    "(elastic.rescale_lr; docs/elasticity.md): 'off' (default — the "
+    "bitwise-safe choice when the GLOBAL batch is held constant across "
+    "the migration), 'linear' (lr *= new/old, the Goyal et al. rule "
+    "for per-rank batches — global batch shrinks with the world), or "
+    "'sqrt' (lr *= sqrt(new/old), the conservative variant). Scheduled "
+    "LRs (lr_scheduler) are never touched.")
+register(
+    "MXTPU_ELASTIC_GENERATION", int, 0,
+    "World generation a relaunched rank inherits (stamped by "
+    "tools/supervisor.py on every restart): 0 = first launch, +1 per "
+    "restart / in-process reenter(). Flows into the flight identity, "
+    "opsd /identity, the world_generation gauge, and fleetctl's table.")
+register(
     "MXTPU_PASSES", str, "auto",
     "Graph-pass pipeline master switch (mxnet_tpu/passes; "
     "docs/passes.md). 'auto' runs each block's registered passes plus "

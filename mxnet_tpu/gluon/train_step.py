@@ -155,6 +155,35 @@ class TrainStep:
         """Why this step permanently runs phased (None when eligible)."""
         return self._ineligible
 
+    def rebuild(self, mesh=None, axis="dp"):
+        """Discard the compiled whole-step program and re-adopt the
+        trainer's (possibly new) plan — the elastic re-entry hook
+        (mxnet_tpu/elastic/reentry.py; docs/elasticity.md). The jitted
+        variants, fused buckets, GSPMD shardings, and the cached
+        eligibility verdict all bake the old mesh/world in, so a
+        topology change must drop them; the next call re-traces ONCE
+        for the new world (jit_trace_count() keeps accumulating — the
+        zero-retrace proof is 'exactly one more trace after rebuild').
+        An explicit ``mesh=`` keeps the legacy no-plan semantics, as in
+        __init__."""
+        self._plan = None
+        if mesh is None:
+            plan = getattr(self._trainer, "sharding_plan", None)
+            if plan is not None:
+                self._plan = plan
+                mesh = plan.mesh
+                axis = plan.batch_axis
+        self._mesh = mesh
+        self._axis = axis
+        self._built = False
+        self._jit_variants = {}
+        self._eligibility_checked = False
+        self._ineligible = None
+        self._variant = None
+        self._tensor_plan = False
+        self._step_fn = None
+        return self
+
     # -- eligibility -------------------------------------------------------
     def _check_eligibility(self):
         tr = self._trainer

@@ -99,6 +99,50 @@ class Trainer:
         """The resolved ShardingPlan, or None (unsharded)."""
         return self._sharding_plan
 
+    def set_sharding_plan(self, plan):
+        """Swap this trainer onto a new ShardingPlan (or None ->
+        replicated) — the elastic re-entry hook (mxnet_tpu/elastic;
+        docs/elasticity.md). Re-places params + grads under the new
+        plan immediately when params are live, and re-places created
+        optimizer state per the new plan's ZeRO state specs, so state
+        saved 1/N along one fsdp axis re-extends along the new one.
+        Callers owning a TrainStep must also call its rebuild() — the
+        compiled whole-step program bakes the old mesh in."""
+        self._sharding_plan = plan
+        self._plan_applied = False
+        if self._kvstore is not None:
+            setter = getattr(self._kvstore, "set_sharding_plan", None)
+            if setter is not None:
+                setter(plan)
+        if plan is None:
+            # dropping to replicated: pull live params/grads/state back
+            # onto the default device — an old mesh placement left in
+            # place poisons the next compiled program with mixed-device
+            # operands
+            import jax
+
+            if not any(p._data_map is None for p in self._params):
+                dev = jax.devices()[0]
+                for i, p in enumerate(self._params):
+                    for arr in p._data_map.values():
+                        arr._data = jax.device_put(arr._data, dev)
+                        arr._version += 1
+                        if arr._grad is not None:
+                            arr._grad._data = jax.device_put(
+                                arr._grad._data, dev)
+                            arr._grad._version += 1
+                    if self._states_created[i]:
+                        opt_mod.place_state_like(self._states[i],
+                                                 p.data())
+            return
+        self._maybe_apply_plan()
+        if self._plan_applied:
+            for i, p in enumerate(self._params):
+                if self._states_created[i]:
+                    opt_mod.place_state_like(
+                        self._states[i], p.data(), plan=plan,
+                        name=self._param_names[i])
+
     def _maybe_apply_plan(self):
         """Place every param (+grads) per the plan, once all params are
         initialized.  Deferred-shape models initialize at first forward,

@@ -104,7 +104,7 @@ def _sync_capacity():
 
 
 def set_identity(rank=None, world=None, job=None, mesh=None, coords=None,
-                 zero_frac=None):
+                 zero_frac=None, generation=None):
     """Stamp this process's place in the job — called by
     ``kvstore.tpu_dist`` at collective init (and by tests). Also pushes
     the (job, rank) trace context onto diagnostics spans so span records
@@ -130,6 +130,12 @@ def set_identity(rank=None, world=None, job=None, mesh=None, coords=None,
                                for k, v in dict(coords).items()}
     if zero_frac is not None:
         _identity["zero_frac"] = float(zero_frac)
+    if generation is not None:
+        # elastic world generation (mxnet_tpu/elastic/reentry.py): which
+        # incarnation of the job this process runs — supervisor restarts
+        # and in-process reenter() both bump it; flows to opsd /identity
+        # and the fleetctl table
+        _identity["generation"] = int(generation)
     try:
         from ..diagnostics import spans as _spans
 
@@ -165,6 +171,15 @@ def identity():
             ident["world"] = jax.process_count()
         except Exception:
             ident["world"] = 1
+    if "generation" not in ident:
+        # a supervisor-relaunched rank inherits its generation via env
+        # (tools/supervisor.py stamps MXTPU_ELASTIC_GENERATION)
+        raw = os.environ.get("MXTPU_ELASTIC_GENERATION")
+        if raw:
+            try:
+                ident["generation"] = int(raw)
+            except ValueError:
+                pass
     return ident
 
 

@@ -65,6 +65,8 @@ __all__ = [
     "sharding_plan_applied_total", "sharding_mesh_axis_size",
     "sharding_pass_stamp_total",
     "record_sharding_apply", "record_sharding_stamp",
+    "elastic_restart_total", "reshard_ms", "world_generation",
+    "record_elastic_restart", "record_reshard", "set_world_generation",
     "cost_measure_total", "cost_model_drift_ratio",
     "record_cost_measure", "set_cost_drift",
 ]
@@ -455,6 +457,58 @@ def record_sharding_stamp(label, kind):
     if not REGISTRY.enabled:
         return
     sharding_pass_stamp_total.labels(label, kind).inc()
+
+
+# -- elastic training (mxnet_tpu/elastic; docs/elasticity.md) ---------------
+elastic_restart_total = counter(
+    "elastic_restart_total",
+    "Elastic topology-change events by origin: 'supervisor' — "
+    "tools/supervisor.py relaunched the job after a rank death; "
+    "'reenter' — a live trainer swapped plans in-process via "
+    "elastic.reenter()", ["reason"])
+reshard_ms = histogram(
+    "reshard_ms",
+    "Wall ms of one plan-crossing state move, by site: 'restore' — "
+    "CheckpointManager re-placing a checkpoint's host-gathered arrays "
+    "under a different plan; 'offline' — elastic.reshard_checkpoint "
+    "rewriting a checkpoint dir for a target mesh; 'reenter' — the "
+    "in-process plan swap (re-place + TrainStep rebuild)", ["site"],
+    buckets=_CKPT_MS_BUCKETS)
+world_generation = gauge(
+    "world_generation",
+    "Which incarnation of the elastic job this process runs: 0 at "
+    "first launch, +1 per supervisor restart / in-process reenter() "
+    "(mirrors the flight identity's generation field)")
+
+
+def record_elastic_restart(reason, generation=None):
+    """One topology-change event; also pins the world_generation gauge
+    when the new generation is known. Mirrored to the flight recorder
+    so postmortems show every incarnation boundary."""
+    _flight_record("elastic_restart", reason=str(reason),
+                   generation=generation)
+    if not REGISTRY.enabled:
+        return
+    elastic_restart_total.labels(str(reason)).inc()
+    if generation is not None:
+        world_generation.set(int(generation))
+
+
+def record_reshard(ms, saved_world=None, target_world=None,
+                   site="restore"):
+    """One plan-crossing state move of `ms` wall milliseconds."""
+    _flight_record("reshard", ms=ms, site=str(site),
+                   saved_world=saved_world, target_world=target_world)
+    if not REGISTRY.enabled:
+        return
+    reshard_ms.labels(str(site)).observe(float(ms))
+
+
+def set_world_generation(g):
+    """Pin the world_generation gauge (elastic.bump_generation)."""
+    if not REGISTRY.enabled:
+        return
+    world_generation.set(int(g))
 
 
 def record_numerics_trip(label):

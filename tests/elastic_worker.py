@@ -1,0 +1,171 @@
+"""Worker body for the elastic supervisor tests (pattern:
+tests/ckpt_worker.py). A deterministic training loop whose data is a
+pure function of the step index, so a supervisor-restarted incarnation
+regenerates exactly the batches the dead one would have seen — the
+precondition for asserting the loss trajectory CONTINUES across a
+SIGKILL + restart.
+
+    python tests/elastic_worker.py <outdir> <ckdir> [kill_steps]
+
+The supervisor contract (tools/supervisor.py) provides the role via
+env: MXTPU_ELASTIC_RANK / MXTPU_ELASTIC_WORLD / MXTPU_ELASTIC_GENERATION
+(absent = a baseline run: rank 0, world 1, generation 0).
+
+  rank 0   trains steps 1..TOTAL; restores from <ckdir> first when a
+           committed checkpoint exists (generation > 0 always does);
+           commits a sync checkpoint after every step; appends every
+           loss to <outdir>/losses.jsonl as
+           {"gen", "world", "step", "loss"}; touches <outdir>/done and
+           exits 0 when step TOTAL lands.
+  rank > 0 the sacrificial heartbeat: watches <ckdir> until rank 0
+           commits step kill_steps[generation], then SIGKILLs ITSELF
+           (exit -9 = the rank death the supervisor must notice). A
+           generation past its kill schedule just waits for done and
+           exits 0.
+
+kill_steps is a comma list indexed by generation (default '3'):
+'3' = die once in generation 0; '3,6' = die again in generation 1
+(the slow soak, run under --no-shrink so rank 1 respawns).
+
+The module is import-safe: tests/test_elastic.py imports it and runs
+:func:`train` in-process as the uninterrupted baseline (bitwise the
+same trajectory — same seeds, model, and step-derived data).
+"""
+import json
+import os
+import signal
+import sys
+import time
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as onp  # noqa: E402
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if REPO not in sys.path:
+    sys.path.insert(0, REPO)
+
+import mxnet_tpu as mx  # noqa: E402
+from mxnet_tpu import autograd, gluon  # noqa: E402
+
+TOTAL = 8
+BATCH = 8
+FEATS = 6
+SEED = 42
+
+
+def build():
+    mx.random.seed(SEED)
+    net = gluon.nn.Sequential()
+    net.add(gluon.nn.Dense(8, activation="relu"))
+    net.add(gluon.nn.Dense(1))
+    net.initialize()
+    # momentum: stateful, so a restart is only bitwise if the optimizer
+    # state survives the checkpoint round-trip too
+    trainer = gluon.Trainer(net.collect_params(), "sgd",
+                            {"learning_rate": 0.05, "momentum": 0.9})
+    return net, trainer
+
+
+def batch_for(step):
+    """The batch for `step`, derived ONLY from the step index."""
+    rs = onp.random.RandomState(1000 + step)
+    x = rs.standard_normal((BATCH, FEATS)).astype("float32")
+    y = rs.standard_normal((BATCH, 1)).astype("float32")
+    return mx.np.array(x), mx.np.array(y)
+
+
+def train_one(net, trainer, step):
+    x, y = batch_for(step)
+    loss_fn = gluon.loss.L2Loss()
+    with autograd.record():
+        loss = loss_fn(net(x), y)
+    loss.backward()
+    trainer.step(BATCH)
+    return float(onp.float32(loss.asnumpy().sum()))
+
+
+def train(steps=TOTAL):
+    """The uninterrupted reference: {step: loss} over a fresh model."""
+    net, trainer = build()
+    return {step: train_one(net, trainer, step)
+            for step in range(1, steps + 1)}
+
+
+def record_loss(outdir, generation, world, step, loss):
+    # O_APPEND single-line writes stay intact across generations
+    with open(os.path.join(outdir, "losses.jsonl"), "a",
+              encoding="utf-8") as f:
+        f.write(json.dumps({"gen": generation, "world": world,
+                            "step": step, "loss": loss}) + "\n")
+
+
+def committed_steps(ckdir):
+    from mxnet_tpu.checkpoint import CheckpointManager
+
+    mgr = CheckpointManager.__new__(CheckpointManager)  # scan-only
+    mgr.directory = ckdir
+    try:
+        return mgr.steps()
+    except Exception:
+        return []
+
+
+def run_rank0(outdir, ckdir, generation, world):
+    net, trainer = build()
+    mgr = mx.checkpoint.CheckpointManager(ckdir, trainer, keep_last=3)
+    start = 1
+    if committed_steps(ckdir):
+        result = mgr.restore()
+        start = result.step + 1
+    elif generation > 0:
+        raise SystemExit(
+            f"generation {generation} found no checkpoint to restore")
+    for step in range(start, TOTAL + 1):
+        loss = train_one(net, trainer, step)
+        # loss BEFORE checkpoint: a teardown SIGTERM between the two
+        # must not leave a committed step whose loss was never recorded
+        # (the restarted generation resumes AFTER it — a trajectory
+        # hole); the reverse orphan — a recorded loss with no
+        # checkpoint — is benign, the next generation just re-runs and
+        # re-records that step
+        record_loss(outdir, generation, world, step, loss)
+        mgr.save(step=step, sync=True)
+    with open(os.path.join(outdir, "done"), "w") as f:
+        f.write(str(generation))
+    return 0
+
+
+def run_heartbeat(outdir, ckdir, generation, kill_steps):
+    kill_at = kill_steps[generation] if generation < len(kill_steps) \
+        else None
+    deadline = time.time() + 300
+    while time.time() < deadline:
+        if kill_at is not None and any(s >= kill_at
+                                       for s in committed_steps(ckdir)):
+            os.kill(os.getpid(), signal.SIGKILL)  # the rank death
+        if kill_at is None and \
+                os.path.exists(os.path.join(outdir, "done")):
+            return 0
+        time.sleep(0.05)
+    return 4  # watchdog: the job never finished around us
+
+
+def main(argv):
+    outdir, ckdir = argv[1], argv[2]
+    kill_steps = [int(s) for s in
+                  (argv[3] if len(argv) > 3 else "3").split(",")]
+    rank = int(os.environ.get("MXTPU_ELASTIC_RANK", "0"))
+    world = int(os.environ.get("MXTPU_ELASTIC_WORLD", "1"))
+    generation = int(os.environ.get("MXTPU_ELASTIC_GENERATION", "0"))
+    if rank == 0:
+        return run_rank0(outdir, ckdir, generation, world)
+    return run_heartbeat(outdir, ckdir, generation, kill_steps)
+
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv))

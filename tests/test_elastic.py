@@ -1,0 +1,524 @@
+"""Elastic training subsystem (mxnet_tpu/elastic, tools/supervisor.py;
+ISSUE 20, docs/elasticity.md): plan-compatibility verdicts and the
+PlanMismatch restore gate, mesh-migrating restores proven bitwise
+against the checkpoint's host-gathered truth (dp4 -> dp2·fsdp2,
+fsdp4 -> replicated), offline checkpoint resharding + the ckpt.py CLI,
+in-process Trainer re-entry with zero retraces after the first
+post-migration step, restart policy/ledger units, and the supervisor
+SIGKILL-a-rank end-to-end (fast 2-rank run + slow multi-kill soak)."""
+import json
+import os
+import subprocess
+import sys
+import time
+
+import numpy as onp
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import gluon
+from mxnet_tpu.checkpoint import CheckpointManager, PlanMismatch
+from mxnet_tpu.elastic import (
+    RestartLedger, RestartPolicy, plan_compatibility, plan_world_size,
+    rescale_factor, reshard_checkpoint, resharded_restore, verify_parity,
+    world_generation,
+)
+from mxnet_tpu.sharding import ShardingPlan
+
+REPO = os.path.join(os.path.dirname(__file__), "..")
+BATCH, FEATS, OUT = 16, 12, 4
+
+
+@pytest.fixture(autouse=True)
+def _isolate_elastic_globals():
+    """Snapshot/restore the process-global flight identity and world
+    generation: plan trainers stamp mesh/coords and reenter() bumps the
+    generation, and later-alphabet suites (test_observability) assert a
+    pristine identity."""
+    from mxnet_tpu.elastic import reentry
+    from mxnet_tpu.observability import flight
+
+    ident = dict(flight._identity)
+    gen = reentry._generation[0]
+    yield
+    flight._identity.clear()
+    flight._identity.update(ident)
+    reentry._generation[0] = gen
+
+
+# -- plan compatibility -------------------------------------------------------
+
+def test_plan_world_size():
+    assert plan_world_size(None) == 1
+    assert plan_world_size({"axes": [["dp", 4]]}) == 4
+    assert plan_world_size({"axes": [["dp", 2], ["fsdp", 2],
+                                     ["tp", 2]]}) == 8
+    assert plan_world_size(ShardingPlan("dp=4").to_manifest()) == 4
+
+
+def test_plan_compatibility_verdicts():
+    exact = plan_compatibility("dp=4", "dp=4")
+    assert exact["verdict"] == "exact" and exact["compatible"]
+    rep = plan_compatibility("dp=4", "dp=2,fsdp=2")
+    assert rep["verdict"] == "replace" and rep["compatible"]
+    assert rep["saved_world"] == rep["target_world"] == 4
+    resh = plan_compatibility("dp=4", "dp=2")
+    assert resh["verdict"] == "reshard" and not resh["compatible"]
+    assert (resh["saved_world"], resh["target_world"]) == (4, 2)
+    assert any("allow_reshard" in n for n in resh["notes"])
+    # None = replicated single-device view; plan -> None is a reshard
+    # VERDICT but restore() never gates it (only plan-to-plan raises)
+    assert plan_compatibility("dp=4", None)["verdict"] == "reshard"
+    assert plan_compatibility(None, None)["verdict"] == "exact"
+
+
+def test_plan_compatibility_notes_zero_axis(monkeypatch):
+    monkeypatch.setenv("MXTPU_ZERO", "1")
+    saved = ShardingPlan.from_layout("dp=2,fsdp=4").to_manifest()
+    assert saved.get("zero_axis") == "fsdp"
+    compat = plan_compatibility(saved, "dp=4")
+    assert any("ZeRO" in n for n in compat["notes"])
+
+
+# -- LR rescale ---------------------------------------------------------------
+
+def test_rescale_factor():
+    assert rescale_factor(4, 2, "linear") == pytest.approx(0.5)
+    assert rescale_factor(2, 8, "linear") == pytest.approx(4.0)
+    assert rescale_factor(4, 2, "sqrt") == pytest.approx(0.5 ** 0.5)
+    assert rescale_factor(4, 2, "off") == 1.0
+    with pytest.raises(ValueError, match="linear"):
+        rescale_factor(4, 2, "cubic")
+
+
+def test_rescale_factor_env_default(monkeypatch):
+    monkeypatch.delenv("MXTPU_ELASTIC_LR_RESCALE", raising=False)
+    assert rescale_factor(4, 2) == 1.0  # default 'off': bitwise-safe
+    monkeypatch.setenv("MXTPU_ELASTIC_LR_RESCALE", "linear")
+    assert rescale_factor(4, 2) == pytest.approx(0.5)
+
+
+# -- restart policy / ledger --------------------------------------------------
+
+def test_restart_policy_decide():
+    pol = RestartPolicy(max_restarts=2, backoff_s=0.5, backoff_max_s=10)
+    assert pol.is_clean(0)
+    assert not pol.is_clean(-9)
+    stop = pol.decide({0: 0, 1: 0})
+    assert stop["action"] == "stop" and stop["dead_ranks"] == []
+    first = pol.decide({0: None, 1: -9})  # None = supervisor-killed
+    assert first["action"] == "restart"
+    assert first["dead_ranks"] == [1]
+    assert first["backoff_s"] == pytest.approx(0.5)
+    second = pol.decide({0: -9})
+    assert second["action"] == "restart"
+    assert second["backoff_s"] == pytest.approx(1.0)  # exponential
+    third = pol.decide({0: -9})
+    assert third["action"] == "give_up"
+
+
+def test_restart_policy_clean_codes(monkeypatch):
+    monkeypatch.setenv("MXTPU_CKPT_PREEMPT_EXIT_CODE", "42")
+    pol = RestartPolicy()
+    assert pol.is_clean(42) and pol.is_clean(0)
+    assert pol.decide({0: 42})["action"] == "stop"
+
+
+def test_restart_policy_unlimited():
+    pol = RestartPolicy(max_restarts=-1, backoff_s=0.0)
+    for _ in range(10):
+        assert pol.decide({0: 1})["action"] == "restart"
+
+
+def test_restart_ledger_roundtrip(tmp_path):
+    ledger = RestartLedger(str(tmp_path))
+    assert ledger.entries() == []
+    ledger.append(event="launch", generation=0, world=2)
+    ledger.append(event="restart", generation=0, world=2,
+                  dead_ranks=[1])
+    got = RestartLedger(str(tmp_path)).entries()
+    assert [e["event"] for e in got] == ["launch", "restart"]
+    assert got[1]["dead_ranks"] == [1]
+    with open(ledger.path, encoding="utf-8") as f:
+        assert json.load(f)["entries"] == got
+
+
+# -- mesh-migrating restore (in-process, 8-device CPU mesh) -------------------
+
+def _run_trainer(plan, steps=3):
+    """Train a hybridized block through TrainStep under `plan` (an axes
+    spelling, a ShardingPlan, or None = replicated); returns
+    (losses, step, trainer, net)."""
+    mx.seed(0)
+    net = gluon.nn.HybridSequential()
+    net.add(gluon.nn.Dense(16, activation="relu"), gluon.nn.Dense(OUT))
+    net.initialize()
+    net.hybridize()
+    if plan is not None and not isinstance(plan, ShardingPlan):
+        plan = ShardingPlan(plan)
+    kw = (dict(kvstore="tpu_dist", sharding_plan=plan) if plan
+          else dict(kvstore=None))
+    trainer = gluon.Trainer(net.collect_params(), "sgd",
+                            {"learning_rate": 0.1, "momentum": 0.9}, **kw)
+    step = gluon.TrainStep(net, gluon.loss.L2Loss(), trainer)
+    r = onp.random.RandomState(3)
+    mx.seed(99)
+    losses = []
+    for _ in range(steps):
+        x = mx.np.array(r.standard_normal((BATCH, FEATS))
+                        .astype("float32"))
+        y = mx.np.array(r.standard_normal((BATCH, OUT))
+                        .astype("float32"))
+        losses.append(step(x, y).asnumpy().astype("float32"))
+    return losses, step, trainer, net
+
+
+def _checkpoint_arrays(directory, step):
+    """The checkpoint's own host-gathered truth for verify_parity."""
+    from mxnet_tpu.checkpoint import manager as _mgr
+
+    d = os.path.join(directory, _mgr._STEP_FMT.format(step))
+    arrays, _manifest = _mgr._read_checkpoint(d)
+    return arrays
+
+
+def test_restore_plan_mismatch_gate(tmp_path):
+    """dp=4 -> dp=2 crosses world sizes: plain restore() raises typed
+    PlanMismatch pointing at the elastic front door; allow_reshard=True
+    (via resharded_restore) lands params + optimizer state bitwise."""
+    _l, _s, tr4, _n = _run_trainer("dp=4")
+    mgr = CheckpointManager(tmp_path, tr4)
+    mgr.save(step=3)
+    mgr.flush()
+
+    mx.seed(1234)
+    _l2, _s2, tr2, _n2 = _run_trainer("dp=2", steps=1)
+    with pytest.raises(PlanMismatch, match="allow_reshard"):
+        CheckpointManager(tmp_path, tr2).restore()
+
+    res, compat = resharded_restore(CheckpointManager(tmp_path, tr2))
+    assert res.step == 3
+    assert compat["verdict"] == "reshard"
+    assert (compat["saved_world"], compat["target_world"]) == (4, 2)
+    verify_parity(tr2, _checkpoint_arrays(tmp_path, 3))
+
+
+def test_plan_mismatch_carries_plans(tmp_path):
+    _l, _s, tr4, _n = _run_trainer("dp=4", steps=1)
+    mgr = CheckpointManager(tmp_path, tr4)
+    mgr.save(step=1)
+    mgr.flush()
+    mx.seed(7)
+    _l2, _s2, tr2, _n2 = _run_trainer("dp=2", steps=1)
+    with pytest.raises(PlanMismatch) as ei:
+        CheckpointManager(tmp_path, tr2).restore()
+    assert ei.value.saved_plan["axes"] == [["dp", 4]]
+    assert ei.value.target_plan["axes"] == [["dp", 2]]
+
+
+def test_reshard_dp4_to_dp2_fsdp2_bitwise(tmp_path):
+    """A dp=4 checkpoint restores under a dp=2,fsdp=2 layout plan (same
+    world size: the silent re-place contract) with params AND optimizer
+    state bitwise-equal to the checkpoint's host-gathered truth."""
+    _l, _s, tr4, _n = _run_trainer("dp=4")
+    mgr = CheckpointManager(tmp_path, tr4)
+    mgr.save(step=3)
+    mgr.flush()
+
+    mx.seed(1234)
+    mx.seed(0)
+    net = gluon.nn.HybridSequential()
+    net.add(gluon.nn.Dense(16, activation="relu"), gluon.nn.Dense(OUT))
+    net.initialize()
+    net.hybridize()
+    plan = ShardingPlan.from_layout("dp=2,fsdp=2", net=net)
+    trainer = gluon.Trainer(net.collect_params(), "sgd",
+                            {"learning_rate": 0.1, "momentum": 0.9},
+                            kvstore="tpu_dist", sharding_plan=plan)
+    step = gluon.TrainStep(net, gluon.loss.L2Loss(), trainer)
+    r = onp.random.RandomState(3)
+    x = mx.np.array(r.standard_normal((BATCH, FEATS)).astype("float32"))
+    y = mx.np.array(r.standard_normal((BATCH, OUT)).astype("float32"))
+    step(x, y)  # states exist + placed before restore overwrites them
+
+    res = CheckpointManager(tmp_path, trainer).restore()
+    assert res.step == 3
+    compared = verify_parity(trainer, _checkpoint_arrays(tmp_path, 3))
+    assert compared >= 8  # 4 params + 4 momentum buffers
+
+
+def test_reshard_fsdp4_to_replicated_bitwise(tmp_path):
+    """An fsdp=4 (ZeRO-sharded state) checkpoint restores onto a plain
+    replicated trainer bitwise — state re-gathers from the shards."""
+    mx.seed(0)
+    net4 = gluon.nn.HybridSequential()
+    net4.add(gluon.nn.Dense(16, activation="relu"), gluon.nn.Dense(OUT))
+    net4.initialize()
+    net4.hybridize()
+    plan4 = ShardingPlan.from_layout("fsdp=4", net=net4)
+    tr4 = gluon.Trainer(net4.collect_params(), "sgd",
+                        {"learning_rate": 0.1, "momentum": 0.9},
+                        kvstore="tpu_dist", sharding_plan=plan4)
+    step4 = gluon.TrainStep(net4, gluon.loss.L2Loss(), tr4)
+    r = onp.random.RandomState(3)
+    mx.seed(99)
+    for _ in range(3):
+        x = mx.np.array(r.standard_normal((BATCH, FEATS))
+                        .astype("float32"))
+        y = mx.np.array(r.standard_normal((BATCH, OUT))
+                        .astype("float32"))
+        step4(x, y)
+    mgr = CheckpointManager(tmp_path, tr4)
+    mgr.save(step=3)
+    mgr.flush()
+
+    mx.seed(1234)
+    _l, _s, tr1, _n = _run_trainer(None, steps=1)
+    res = CheckpointManager(tmp_path, tr1).restore()
+    assert res.step == 3
+    verify_parity(tr1, _checkpoint_arrays(tmp_path, 3))
+
+
+def test_offline_reshard_checkpoint(tmp_path):
+    """reshard_checkpoint rewrites a dp=4 checkpoint for dp=2 across 2
+    shard files; the output verifies clean, records the target plan, and
+    restores onto a dp=2 trainer as an exact match — no allow_reshard
+    needed."""
+    from mxnet_tpu.checkpoint import verify_checkpoint
+
+    src, dst = tmp_path / "src", tmp_path / "dst"
+    _l, _s, tr4, _n = _run_trainer("dp=4")
+    mgr = CheckpointManager(src, tr4)
+    mgr.save(step=3)
+    mgr.flush()
+
+    report = reshard_checkpoint(src, dst, "dp=2", target_world=2,
+                                mode="sharded")
+    assert report["step"] == 3
+    assert report["compatibility"]["verdict"] == "reshard"
+    check = verify_checkpoint(dst)
+    assert check["ok"], check["errors"]
+    assert check["sharding_plan"]["axes"] == [["dp", 2]]
+
+    mx.seed(1234)
+    _l2, _s2, tr2, _n2 = _run_trainer("dp=2", steps=1)
+    res = CheckpointManager(dst, tr2).restore()  # exact: no gate
+    assert res.step == 3
+    verify_parity(tr2, _checkpoint_arrays(str(src), 3))
+
+
+def test_ckpt_cli_reshard_and_verify_mesh(tmp_path, capsys):
+    """tools/ckpt.py: `verify --mesh` reports the compatibility verdict;
+    `reshard --dest` writes a retargeted checkpoint. Run in-process
+    (main() returns the rc) to keep the interpreter-spawn cost out of
+    the tier-1 budget."""
+    sys.path.insert(0, os.path.join(REPO, "tools"))
+    import ckpt
+
+    src, dst = str(tmp_path / "src"), str(tmp_path / "dst")
+    _l, _s, tr4, _n = _run_trainer("dp=4", steps=1)
+    mgr = CheckpointManager(src, tr4)
+    mgr.save(step=1)
+    mgr.flush()
+
+    assert ckpt.main(["verify", src, "--mesh", "dp=2", "--json"]) == 0
+    plan = json.loads(capsys.readouterr().out)["plan"]
+    assert plan["verdict"] == "reshard"
+    assert (plan["saved_world"], plan["target_world"]) == (4, 2)
+
+    assert ckpt.main(["reshard", src, "--dest", dst,
+                      "--mesh", "dp=2", "--json"]) == 0
+    rep = json.loads(capsys.readouterr().out)
+    assert rep["compatibility"]["target_world"] == 2
+    assert ckpt.main(["verify", dst, "--mesh", "dp=2"]) == 0
+    assert "-> exact" in capsys.readouterr().out
+
+
+# -- in-process re-entry ------------------------------------------------------
+
+def test_reenter_migrates_and_zero_retrace():
+    """reenter() moves a live dp=4 trainer onto dp=2: the whole-step
+    program rebuilds for the new mesh, the generation bumps into the
+    flight identity, linear LR rescale halves the rate, and the step
+    retraces ONCE post-migration, then never again."""
+    from mxnet_tpu.elastic import reenter
+    from mxnet_tpu.observability import flight
+
+    losses, step, trainer, net = _run_trainer("dp=4", steps=2)
+    assert step.last_path == "whole_step", step.ineligible_reason()
+    gen0 = world_generation()
+    lr0 = trainer.learning_rate
+
+    info = reenter(trainer, ShardingPlan("dp=2"), train_step=step,
+                   lr_rescale="linear")
+    assert info["old_world"] == 4 and info["new_world"] == 2
+    assert info["generation"] == gen0 + 1
+    assert world_generation() == gen0 + 1
+    assert flight.identity()["generation"] == gen0 + 1
+    assert trainer.learning_rate == pytest.approx(lr0 * 0.5)
+    assert info["lr_factor"] == pytest.approx(0.5)
+
+    r = onp.random.RandomState(17)
+    traces = []
+    for _ in range(3):
+        x = mx.np.array(r.standard_normal((BATCH, FEATS))
+                        .astype("float32"))
+        y = mx.np.array(r.standard_normal((BATCH, OUT))
+                        .astype("float32"))
+        t0 = step.jit_trace_count()
+        loss = step(x, y)
+        assert onp.isfinite(loss.asnumpy()).all()
+        traces.append(step.jit_trace_count() - t0)
+    assert step.last_path == "whole_step", step.ineligible_reason()
+    assert traces[0] >= 1 and traces[1:] == [0, 0], traces
+
+    # second hop, down to replicated (plan=None): params/grads/state must
+    # re-place onto the default device or the rebuilt program sees
+    # mixed-device operands
+    info = reenter(trainer, None, train_step=step, lr_rescale="linear")
+    assert info["old_world"] == 2 and info["new_world"] == 1
+    for _ in range(2):
+        x = mx.np.array(r.standard_normal((BATCH, FEATS))
+                        .astype("float32"))
+        y = mx.np.array(r.standard_normal((BATCH, OUT))
+                        .astype("float32"))
+        loss = step(x, y)
+        assert onp.isfinite(loss.asnumpy()).all()
+
+
+# -- supervisor end-to-end ----------------------------------------------------
+
+def _worker_cmd(outdir, ckdir, kill_steps):
+    return [sys.executable, os.path.join(REPO, "tests",
+                                         "elastic_worker.py"),
+            str(outdir), str(ckdir), kill_steps]
+
+
+def _read_losses(outdir):
+    """{step: loss} taking each step's LAST-generation entry, plus the
+    raw entries."""
+    entries = []
+    with open(os.path.join(str(outdir), "losses.jsonl"),
+              encoding="utf-8") as f:
+        for line in f:
+            entries.append(json.loads(line))
+    best = {}
+    for e in entries:
+        cur = best.get(e["step"])
+        if cur is None or e["gen"] >= cur["gen"]:
+            best[e["step"]] = e
+    return {s: e["loss"] for s, e in best.items()}, entries
+
+
+def _baseline_losses():
+    """The uninterrupted reference trajectory, computed in-process by
+    importing the worker module (bitwise the subprocess's: same seeds,
+    model, and step-derived batches)."""
+    sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+    import elastic_worker
+
+    losses = elastic_worker.train()
+    assert sorted(losses) == list(range(1, 9))
+    return losses
+
+
+def _run_supervised(tmp_path, kill_steps, extra=()):
+    outdir = tmp_path / "out"
+    outdir.mkdir(exist_ok=True)
+    flight = tmp_path / "flight"
+    env = dict(os.environ, JAX_PLATFORMS="cpu", PYTHONPATH=REPO)
+    cmd = [sys.executable, os.path.join(REPO, "tools", "supervisor.py"),
+           "--ranks", "2", "--flight-dir", str(flight),
+           "--backoff", "0.05", "--poll", "0.05", *extra, "--",
+           *_worker_cmd(outdir, tmp_path / "ck", kill_steps)]
+    t0 = time.time()
+    rc = subprocess.run(cmd, env=env, capture_output=True, text=True,
+                        timeout=540)
+    assert rc.returncode == 0, \
+        f"supervisor rc={rc.returncode} after {time.time() - t0:.0f}s\n" \
+        f"stdout:\n{rc.stdout}\nstderr:\n{rc.stderr}"
+    return outdir, flight, rc
+
+
+def test_supervisor_sigkill_restart(tmp_path):
+    """Acceptance: SIGKILL a rank mid-run -> the supervisor tears the
+    job down, restarts it on the surviving world with the generation
+    bumped, the restarted rank restores from the latest checkpoint, and
+    the merged loss trajectory is BITWISE the uninterrupted baseline."""
+    baseline = _baseline_losses()
+    outdir, flight, _rc = _run_supervised(tmp_path, "3")
+
+    losses, entries = _read_losses(outdir)
+    assert sorted(losses) == list(range(1, 9))
+    for s in baseline:
+        assert losses[s] == baseline[s], \
+            f"step {s}: {losses[s]} != baseline {baseline[s]}"
+    # every recorded loss — pre-kill and post-restore — sits ON the
+    # baseline trajectory (restore is bitwise, data is step-derived)
+    for e in entries:
+        assert e["loss"] == baseline[e["step"]], e
+
+    ledger = RestartLedger(str(flight)).entries()
+    events = [e["event"] for e in ledger]
+    assert events.count("restart") == 1, events
+    assert events[-1] == "stop"
+    restart = next(e for e in ledger if e["event"] == "restart")
+    assert restart["dead_ranks"] == [1]
+    # the relaunch after the restart runs generation 1 on the shrunken
+    # world (2 ranks -> 1 survivor)
+    relaunch = [e for e in ledger if e["event"] == "launch"][-1]
+    assert relaunch["generation"] == 1 and relaunch["world"] == 1
+    with open(os.path.join(str(outdir), "done"), encoding="utf-8") as f:
+        assert f.read() == "1"
+
+
+@pytest.mark.slow
+def test_supervisor_soak_two_kills(tmp_path):
+    """Soak: the sacrificial rank dies in generation 0 AND again in
+    generation 1 (--no-shrink keeps it respawning); the job still lands
+    the baseline trajectory with two restarts in the ledger."""
+    baseline = _baseline_losses()
+    outdir, flight, _rc = _run_supervised(tmp_path, "3,6",
+                                          extra=("--no-shrink",))
+    losses, _entries = _read_losses(outdir)
+    for s in baseline:
+        assert losses[s] == baseline[s]
+    ledger = RestartLedger(str(flight)).entries()
+    events = [e["event"] for e in ledger]
+    assert events.count("restart") == 2, events
+    assert events[-1] == "stop"
+    assert [e for e in ledger
+            if e["event"] == "launch"][-1]["generation"] == 2
+
+
+def test_supervisor_clean_exit(tmp_path):
+    """All ranks exiting 0 is a finished job: no restart, exit 0."""
+    sys.path.insert(0, os.path.join(REPO, "tools"))
+    import supervisor
+
+    rc = supervisor.run(["--ranks", "2", "--flight-dir", str(tmp_path),
+                         "--poll", "0.02", "--",
+                         sys.executable, "-c", "raise SystemExit(0)"])
+    assert rc == 0
+    events = [e["event"] for e in RestartLedger(str(tmp_path)).entries()]
+    assert events == ["launch", "stop"]
+
+
+def test_supervisor_gives_up(tmp_path):
+    """A rank that dies every incarnation exhausts the restart budget:
+    exit 3, give_up in the ledger, world shrunk along the way."""
+    sys.path.insert(0, os.path.join(REPO, "tools"))
+    import supervisor
+
+    rc = supervisor.run(["--ranks", "2", "--flight-dir", str(tmp_path),
+                         "--max-restarts", "1", "--backoff", "0.01",
+                         "--poll", "0.02", "--no-shrink", "--",
+                         sys.executable, "-c",
+                         "import sys; sys.exit(7 if "
+                         "__import__('os').environ"
+                         "['MXTPU_ELASTIC_RANK'] == '1' else 0)"])
+    assert rc == 3
+    ledger = RestartLedger(str(tmp_path)).entries()
+    events = [e["event"] for e in ledger]
+    assert events.count("restart") == 1
+    assert events[-1] == "give_up"

@@ -61,13 +61,15 @@ def poll_rank(endpoint, timeout=3.0):
     row = {"endpoint": endpoint, "health": "down", "ready": False,
            "rank": None, "job": None, "world": None, "last_step": None,
            "step_ms": None, "examples_per_s": None, "queue": None,
-           "mesh": None, "coords": None, "zero_frac": None, "error": None}
+           "mesh": None, "coords": None, "zero_frac": None,
+           "generation": None, "error": None}
     try:
         ident = _get(base, "/identity", timeout)
         row.update(rank=ident.get("rank"), job=ident.get("job"),
                    world=ident.get("world"), mesh=ident.get("mesh"),
                    coords=ident.get("coords"),
-                   zero_frac=ident.get("zero_frac"))
+                   zero_frac=ident.get("zero_frac"),
+                   generation=ident.get("generation"))
         hz = _get(base, "/healthz", timeout)
         row["health"] = hz.get("status", "ok")
         steps = _get(base, "/steps", timeout)
@@ -191,7 +193,7 @@ def _phase_cell(r):
 
 def fleet_table(rows):
     hdr = ["rank", "endpoint", "health", "ready", "step", "step_ms",
-           "ex/s", "queue", "slo", "phase", "drift", "mesh", ""]
+           "ex/s", "queue", "slo", "phase", "drift", "mesh", "gen", ""]
     table = [hdr]
     for r in sorted(rows, key=lambda r: (r["rank"] is None, r["rank"])):
         flag = "STRAGGLER" if r.get("straggler") else ""
@@ -214,6 +216,10 @@ def fleet_table(rows):
             _phase_cell(r),
             _drift_cell(r),
             _mesh_cell(r),
+            # elastic world generation (docs/elasticity.md): a restarted
+            # fleet shows gen>0 — mixed values mean a rank missed a
+            # supervisor restart
+            "-" if r.get("generation") is None else str(r["generation"]),
             flag,
         ])
     widths = [max(len(row[i]) for row in table)

@@ -1,0 +1,243 @@
+#!/usr/bin/env python
+"""Elastic training supervisor: launch N ranks, restart on rank death
+(docs/elasticity.md — the training-side twin of the serving autoscaler).
+
+    python tools/supervisor.py --ranks 2 [options] -- \
+        python train.py --data ...
+
+The command runs once per rank with ``{rank}``/``{world}``/
+``{generation}`` substituted in its argv and the same values exported as
+``MXTPU_ELASTIC_RANK`` / ``MXTPU_ELASTIC_WORLD`` /
+``MXTPU_ELASTIC_GENERATION`` (plus ``MXTPU_FLIGHTREC_RANK`` so flight
+identities line up without jax.distributed).
+
+Contract watched per rank:
+
+  * exit code — 0 and MXTPU_CKPT_PREEMPT_EXIT_CODE (the
+    PreemptionHandler's snapshot-then-exit path) are CLEAN: when every
+    rank has exited cleanly the job is done and the supervisor stops;
+  * any other exit code is a rank DEATH: the supervisor tears down the
+    survivors, consults elastic.RestartPolicy (exponential backoff,
+    MXTPU_ELASTIC_MAX_RESTARTS budget), and relaunches the job from the
+    latest good checkpoint — the workers' own CheckpointManager.restore
+    — onto the surviving device set (world shrinks by the dead ranks
+    unless --no-shrink) with the generation incremented;
+  * optionally (--ops-ports) each rank's opsd /healthz + /readyz: a
+    rank that stops answering for --health-fails consecutive polls is
+    wedged and gets SIGKILLed, which the exit-code path then treats as
+    a death — liveness watching without any in-band channel.
+
+Every decision lands in the restart ledger
+(<flight-dir>/restart_ledger.json, elastic.RestartLedger) — the
+postmortem record of which incarnations ran and why each ended.
+Exit codes: 0 = job finished cleanly, 3 = restart budget exhausted
+(or the world shrank to nothing), 2 = bad usage.
+"""
+from __future__ import annotations
+
+import argparse
+import os
+import signal
+import subprocess
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+
+def _log(msg):
+    print(f"[supervisor] {msg}", flush=True)
+
+
+def _substitute(argv, rank, world, generation):
+    out = []
+    for a in argv:
+        out.append(a.replace("{rank}", str(rank))
+                   .replace("{world}", str(world))
+                   .replace("{generation}", str(generation)))
+    return out
+
+
+def _launch(argv, rank, world, generation, ledger_path):
+    env = dict(os.environ)
+    env["MXTPU_ELASTIC_RANK"] = str(rank)
+    env["MXTPU_ELASTIC_WORLD"] = str(world)
+    env["MXTPU_ELASTIC_GENERATION"] = str(generation)
+    env["MXTPU_FLIGHTREC_RANK"] = str(rank)
+    env["MXTPU_SUPERVISOR_LEDGER"] = ledger_path
+    return subprocess.Popen(_substitute(argv, rank, world, generation),
+                            env=env)
+
+
+def _health_ok(port, path="/healthz", timeout=1.0):
+    import urllib.request
+
+    try:
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{port}{path}", timeout=timeout) as r:
+            return r.status == 200
+    except Exception:
+        return False
+
+
+def _teardown(procs, grace_s=5.0):
+    """SIGTERM the survivors (the PreemptionHandler's snapshot path),
+    escalate to SIGKILL after the grace window; returns {rank: code}
+    with None for ranks the supervisor had to kill."""
+    codes = {}
+    for rank, p in procs.items():
+        if p.poll() is None:
+            try:
+                p.terminate()
+            except OSError:
+                pass
+    deadline = time.monotonic() + grace_s
+    for rank, p in procs.items():
+        remaining = max(deadline - time.monotonic(), 0.0)
+        try:
+            p.wait(timeout=remaining)
+        except subprocess.TimeoutExpired:
+            try:
+                p.kill()
+            except OSError:
+                pass
+            p.wait()
+            codes[rank] = None  # supervisor-killed, not a death
+            continue
+        # a SIGTERM'd rank that exits via the preemption contract is
+        # clean; one the kernel killed reports -SIGTERM — that was us
+        rc = p.returncode
+        codes[rank] = None if rc == -signal.SIGTERM else rc
+    return codes
+
+
+def run(argv=None):
+    ap = argparse.ArgumentParser(
+        description=__doc__.splitlines()[0],
+        usage="supervisor.py --ranks N [options] -- command ...")
+    ap.add_argument("--ranks", type=int, required=True,
+                    help="initial world size (one process per rank)")
+    ap.add_argument("--flight-dir", default=None,
+                    help="restart-ledger directory (default: "
+                         "MXTPU_FLIGHTREC_DIR, else '.')")
+    ap.add_argument("--max-restarts", type=int, default=None,
+                    help="override MXTPU_ELASTIC_MAX_RESTARTS")
+    ap.add_argument("--backoff", type=float, default=None,
+                    help="override MXTPU_ELASTIC_BACKOFF_S")
+    ap.add_argument("--no-shrink", action="store_true",
+                    help="relaunch at the ORIGINAL world size instead "
+                         "of the surviving device set")
+    ap.add_argument("--ops-ports", default="",
+                    help="comma list of opsd ports, one per rank, to "
+                         "poll /healthz + /readyz (optional)")
+    ap.add_argument("--health-fails", type=int, default=3,
+                    help="consecutive failed health polls before a "
+                         "rank is declared wedged and killed")
+    ap.add_argument("--health-grace", type=float, default=10.0,
+                    help="seconds after (re)launch before health "
+                         "polling starts (startup amnesty)")
+    ap.add_argument("--poll", type=float, default=0.1,
+                    help="child poll interval (seconds)")
+    ap.add_argument("command", nargs=argparse.REMAINDER,
+                    help="-- worker command (argv; {rank}/{world}/"
+                         "{generation} substituted)")
+    args = ap.parse_args(argv)
+    command = args.command
+    if command and command[0] == "--":
+        command = command[1:]
+    if not command:
+        ap.error("no worker command given (put it after --)")
+    if args.ranks < 1:
+        ap.error("--ranks must be >= 1")
+
+    from mxnet_tpu.elastic.policy import RestartLedger, RestartPolicy
+
+    flight_dir = args.flight_dir or os.environ.get(
+        "MXTPU_FLIGHTREC_DIR", ".")
+    os.makedirs(flight_dir, exist_ok=True)
+    ledger = RestartLedger(flight_dir)
+    policy = RestartPolicy(max_restarts=args.max_restarts,
+                           backoff_s=args.backoff)
+    ports = [int(p) for p in args.ops_ports.split(",") if p.strip()]
+
+    world = args.ranks
+    generation = 0
+    while True:
+        _log(f"generation {generation}: launching {world} rank(s)")
+        procs = {r: _launch(command, r, world, generation, ledger.path)
+                 for r in range(world)}
+        ledger.append(event="launch", generation=generation, world=world,
+                      pids={r: p.pid for r, p in procs.items()})
+        health_miss = dict.fromkeys(range(world), 0)
+        started = time.monotonic()
+        exit_codes = {}
+        while True:
+            time.sleep(args.poll)
+            for r, p in procs.items():
+                if r not in exit_codes and p.poll() is not None:
+                    exit_codes[r] = p.returncode
+                    _log(f"rank {r} exited with code {p.returncode}")
+            if ports and time.monotonic() - started > args.health_grace:
+                for r, p in procs.items():
+                    if r in exit_codes or r >= len(ports):
+                        continue
+                    ok = _health_ok(ports[r]) and \
+                        _health_ok(ports[r], "/readyz")
+                    health_miss[r] = 0 if ok else health_miss[r] + 1
+                    if health_miss[r] >= args.health_fails:
+                        _log(f"rank {r} failed {health_miss[r]} health "
+                             f"polls on port {ports[r]}: killing it")
+                        try:
+                            p.kill()
+                        except OSError:
+                            pass
+            if len(exit_codes) == len(procs):
+                break  # everyone is down: decide below
+            if any(not policy.is_clean(c) for c in exit_codes.values()):
+                break  # a death: tear down the survivors now
+        survivors = {r: p for r, p in procs.items()
+                     if r not in exit_codes}
+        if survivors:
+            _log(f"tearing down {len(survivors)} survivor(s)")
+            exit_codes.update(_teardown(survivors))
+        decision = policy.decide(exit_codes)
+        ledger.append(event=decision["action"], generation=generation,
+                      world=world, exit_codes=exit_codes,
+                      dead_ranks=decision["dead_ranks"],
+                      reason=decision["reason"],
+                      backoff_s=decision["backoff_s"],
+                      restarts=policy.restarts)
+        if decision["action"] == "stop":
+            _log("all ranks exited cleanly — job complete")
+            return 0
+        if decision["action"] == "give_up":
+            _log(f"giving up: {decision['reason']} "
+                 f"(dead ranks {decision['dead_ranks']})")
+            return 3
+        new_world = world - len(decision["dead_ranks"]) \
+            if not args.no_shrink else world
+        if new_world < 1:
+            ledger.append(event="give_up", generation=generation,
+                          world=world, reason="no surviving ranks")
+            _log("no surviving ranks to relaunch on")
+            return 3
+        if decision["backoff_s"] > 0:
+            _log(f"backing off {decision['backoff_s']:.2f}s before "
+                 f"restart {policy.restarts}")
+            time.sleep(decision["backoff_s"])
+        generation += 1
+        world = new_world
+        try:
+            from mxnet_tpu.telemetry import instruments as _telemetry
+
+            _telemetry.record_elastic_restart("supervisor",
+                                              generation=generation)
+        except Exception:
+            pass
+        _log(f"restarting on the surviving device set: world={world}, "
+             f"generation={generation}")
+
+
+if __name__ == "__main__":
+    sys.exit(run())
